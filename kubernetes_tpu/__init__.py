@@ -1,0 +1,32 @@
+"""kubernetes_tpu — a TPU-native cluster-orchestration framework.
+
+A ground-up re-design of the capabilities of the reference NVIDIA-GPU
+Kubernetes fork (see SURVEY.md) with a TPU-first resource model:
+
+- Devices are *chips with ICI mesh coordinates*, not opaque counters
+  (cf. reference ``staging/src/k8s.io/api/core/v1/types.go:4018-4056``).
+- Pod requests are *slice shapes* (e.g. ``2x2x4``) with attribute affinity.
+- Placement is *gang + contiguous sub-mesh allocation* on the 3D torus
+  (the reference's extended-resource matcher is flat:
+  ``plugin/pkg/scheduler/core/extended_resources.go:113-150``).
+- Architecture invariants kept from the reference: all state in a
+  strongly-consistent MVCC store, watch-based level-triggered reconcile,
+  declarative desired-state objects, hub-and-spoke through the API
+  server, vendor-neutral node<->device gRPC seam.
+
+Layer map (mirrors SURVEY.md section 1):
+
+- L0/L1  ``api/``            object model, scheme/codec, validation
+- L3     ``storage/``        MVCC store w/ revisions + watch (etcd3 semantics)
+-        ``apiserver/``      REST+watch server, registry, admission
+- L2     ``client/``         REST client, informers, workqueue, leader election
+- L4b    ``scheduler/``      gang + sub-mesh TPU placement
+- L4a    ``controllers/``    workload + node-lifecycle reconcile loops
+- L5     ``node/``           node agent (kubelet equivalent), device manager
+-        ``deviceplugin/``   TPU device plugin (gRPC, libtpu-backed)
+- L6     ``cli/``            ktl command-line client
+- X      ``metrics/``        prometheus-style registries
+-        ``workloads/``      JAX payloads the orchestrator schedules
+"""
+
+__version__ = "0.1.0"

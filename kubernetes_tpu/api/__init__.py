@@ -1,0 +1,1 @@
+from . import meta, scheme, selectors, types, validation  # noqa: F401

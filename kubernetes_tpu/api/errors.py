@@ -1,0 +1,126 @@
+"""API error taxonomy.
+
+Mirrors the reference's structured StatusError machinery
+(``staging/src/k8s.io/apimachinery/pkg/api/errors``) so every layer —
+registry, HTTP server, client — speaks one error language and HTTP
+status codes round-trip losslessly through the REST boundary.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+
+class StatusError(Exception):
+    """Base error carrying an HTTP code + machine-readable reason."""
+
+    code: int = 500
+    reason: str = "InternalError"
+
+    def __init__(self, message: str = "", *, details: Optional[dict] = None):
+        super().__init__(message or self.reason)
+        self.message = message or self.reason
+        self.details = details or {}
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "kind": "Status",
+            "status": "Failure",
+            "code": self.code,
+            "reason": self.reason,
+            "message": self.message,
+            "details": self.details,
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "StatusError":
+        cls = _BY_REASON.get(d.get("reason", ""), StatusError)
+        err = cls(d.get("message", ""), details=d.get("details") or {})
+        err.code = d.get("code", cls.code)
+        return err
+
+
+class NotFoundError(StatusError):
+    code = 404
+    reason = "NotFound"
+
+
+class AlreadyExistsError(StatusError):
+    code = 409
+    reason = "AlreadyExists"
+
+
+class ConflictError(StatusError):
+    """Optimistic-concurrency failure (stale resource_version)."""
+
+    code = 409
+    reason = "Conflict"
+
+
+class InvalidError(StatusError):
+    code = 422
+    reason = "Invalid"
+
+
+class BadRequestError(StatusError):
+    code = 400
+    reason = "BadRequest"
+
+
+class ForbiddenError(StatusError):
+    code = 403
+    reason = "Forbidden"
+
+
+class UnauthorizedError(StatusError):
+    code = 401
+    reason = "Unauthorized"
+
+
+class TimeoutError_(StatusError):
+    code = 504
+    reason = "Timeout"
+
+
+class TooManyRequestsError(StatusError):
+    code = 429
+    reason = "TooManyRequests"
+
+
+class GoneError(StatusError):
+    """Watch from a compacted revision (etcd3 'required revision has been compacted')."""
+
+    code = 410
+    reason = "Expired"
+
+
+class MethodNotAllowedError(StatusError):
+    code = 405
+    reason = "MethodNotAllowed"
+
+
+class ServiceUnavailableError(StatusError):
+    code = 503
+    reason = "ServiceUnavailable"
+
+
+_BY_REASON: dict[str, type[StatusError]] = {
+    c.reason: c
+    for c in [
+        NotFoundError, AlreadyExistsError, ConflictError, InvalidError,
+        BadRequestError, ForbiddenError, UnauthorizedError, TimeoutError_,
+        TooManyRequestsError, GoneError, MethodNotAllowedError,
+        ServiceUnavailableError, StatusError,
+    ]
+}
+
+
+def is_not_found(e: Exception) -> bool:
+    return isinstance(e, NotFoundError)
+
+
+def is_conflict(e: Exception) -> bool:
+    return isinstance(e, ConflictError)
+
+
+def is_already_exists(e: Exception) -> bool:
+    return isinstance(e, AlreadyExistsError)

@@ -1,0 +1,148 @@
+"""Object metadata — the `metav1` equivalent.
+
+Reference: ``staging/src/k8s.io/apimachinery/pkg/apis/meta/v1/types.go``
+(ObjectMeta/TypeMeta/OwnerReference/ListMeta). Every persisted object
+embeds :class:`ObjectMeta`; every list carries :class:`ListMeta` with the
+store revision so informers can resume watches exactly where the LIST
+left off (the resourceVersion contract, SURVEY.md section 7 hard part 2).
+"""
+from __future__ import annotations
+
+import dataclasses
+import datetime
+import uuid
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+def now() -> datetime.datetime:
+    return datetime.datetime.utcnow()
+
+
+def new_uid() -> str:
+    return str(uuid.uuid4())
+
+
+@dataclass
+class OwnerReference:
+    """Backpointer used by the garbage collector and controller adoption.
+
+    Reference: ``metav1.OwnerReference`` + controller_ref util.
+    """
+
+    api_version: str = ""
+    kind: str = ""
+    name: str = ""
+    uid: str = ""
+    controller: bool = False
+    block_owner_deletion: bool = False
+
+
+@dataclass
+class ObjectMeta:
+    name: str = ""
+    namespace: str = ""
+    uid: str = ""
+    #: MVCC mod-revision as a decimal string; "" means unset. Optimistic
+    #: concurrency: updates carrying a stale value get 409 Conflict.
+    resource_version: str = ""
+    #: Monotonic spec generation, bumped by the registry on spec change.
+    generation: int = 0
+    creation_timestamp: Optional[datetime.datetime] = None
+    #: Set (not removed) on delete while finalizers remain — graceful deletion.
+    deletion_timestamp: Optional[datetime.datetime] = None
+    labels: dict[str, str] = field(default_factory=dict)
+    annotations: dict[str, str] = field(default_factory=dict)
+    owner_references: list[OwnerReference] = field(default_factory=list)
+    finalizers: list[str] = field(default_factory=list)
+    #: Server-side name generation prefix (``generate_name`` + random suffix).
+    generate_name: str = ""
+
+
+@dataclass
+class ListMeta:
+    #: Store revision at which the list was read; feed to watch ``from_rev``.
+    resource_version: str = ""
+    #: Continuation token for chunked LIST (opaque).
+    continue_token: str = ""
+
+
+@dataclass
+class TypedObject:
+    """Base for all API objects: TypeMeta + ObjectMeta.
+
+    Subclasses are dataclasses adding ``spec``/``status``/etc. Object
+    identity key is ``namespace/name`` (or ``name`` for cluster-scoped).
+    """
+
+    api_version: str = ""
+    kind: str = ""
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+
+    # -- convenience accessors used throughout the codebase --------------
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+    @property
+    def uid(self) -> str:
+        return self.metadata.uid
+
+    def key(self) -> str:
+        """Cache key: 'namespace/name' or 'name' when cluster-scoped."""
+        if self.metadata.namespace:
+            return f"{self.metadata.namespace}/{self.metadata.name}"
+        return self.metadata.name
+
+
+def controller_ref(owner: TypedObject, api_version: str, kind: str) -> OwnerReference:
+    return OwnerReference(
+        api_version=api_version,
+        kind=kind,
+        name=owner.metadata.name,
+        uid=owner.metadata.uid,
+        controller=True,
+        block_owner_deletion=True,
+    )
+
+
+def get_controller_of(obj: TypedObject) -> Optional[OwnerReference]:
+    for ref in obj.metadata.owner_references:
+        if ref.controller:
+            return ref
+    return None
+
+
+def is_controlled_by(obj: TypedObject, owner: TypedObject) -> bool:
+    ref = get_controller_of(obj)
+    return ref is not None and ref.uid == owner.metadata.uid
+
+
+def split_key(key: str) -> tuple[str, str]:
+    """'namespace/name' -> (namespace, name); 'name' -> ('', name)."""
+    if "/" in key:
+        ns, _, name = key.partition("/")
+        return ns, name
+    return "", key
+
+
+def fresh_meta(name: str = "", namespace: str = "", **kw) -> ObjectMeta:
+    return ObjectMeta(name=name, namespace=namespace, **kw)
+
+
+def stamp_new(meta: ObjectMeta) -> None:
+    """Server-side fill-in at create time (uid, timestamps, generated name)."""
+    if not meta.uid:
+        meta.uid = new_uid()
+    if meta.creation_timestamp is None:
+        meta.creation_timestamp = now()
+    if not meta.name and meta.generate_name:
+        meta.name = meta.generate_name + uuid.uuid4().hex[:6]
+
+
+def is_dataclass_instance(obj) -> bool:
+    return dataclasses.is_dataclass(obj) and not isinstance(obj, type)

@@ -1,0 +1,219 @@
+"""Type registry + codec — the L0 'runtime.Scheme' equivalent.
+
+The reference centralises serialization/conversion/defaulting in
+``staging/src/k8s.io/apimachinery/pkg/runtime`` (``Scheme``,
+codecs). Here the object model is Python dataclasses, so the codec is a
+generic structural serde driven by type hints:
+
+- ``to_dict(obj)``   dataclass -> plain JSON-able dict (None / empty
+  collections elided, datetimes to RFC3339, enums to value).
+- ``from_dict(cls, d)`` dict -> dataclass, recursing through
+  Optional/list/dict type hints; unknown fields are *preserved* in
+  ``obj.__extra__`` so round-tripping never loses data (the reference
+  gets this from protobuf/JSON struct tags).
+- ``Scheme``         maps (api_version, kind) <-> class and applies
+  per-type defaulting functions, like ``runtime.Scheme`` does.
+"""
+from __future__ import annotations
+
+import dataclasses
+import datetime
+import enum
+import json
+import types as _pytypes
+import typing
+from typing import Any, Optional, Type, TypeVar, get_args, get_origin, get_type_hints
+
+T = TypeVar("T")
+
+_RFC3339 = "%Y-%m-%dT%H:%M:%S.%fZ"
+
+
+def _enc_time(dt: datetime.datetime) -> str:
+    if dt.tzinfo is not None:
+        dt = dt.astimezone(datetime.timezone.utc).replace(tzinfo=None)
+    return dt.strftime(_RFC3339)
+
+
+def _dec_time(s: str) -> datetime.datetime:
+    for fmt in (_RFC3339, "%Y-%m-%dT%H:%M:%SZ"):
+        try:
+            return datetime.datetime.strptime(s, fmt)
+        except ValueError:
+            continue
+    return datetime.datetime.fromisoformat(s.replace("Z", "+00:00")).replace(tzinfo=None)
+
+
+def to_dict(obj: Any) -> Any:
+    """Recursively convert an API object into a JSON-able structure."""
+    if obj is None or isinstance(obj, (str, int, float, bool)):
+        return obj
+    if isinstance(obj, enum.Enum):
+        return obj.value
+    if isinstance(obj, datetime.datetime):
+        return _enc_time(obj)
+    if isinstance(obj, (list, tuple)):
+        return [to_dict(x) for x in obj]
+    if isinstance(obj, dict):
+        return {str(k): to_dict(v) for k, v in obj.items()}
+    if dataclasses.is_dataclass(obj):
+        out: dict[str, Any] = {}
+        for f in dataclasses.fields(obj):
+            v = getattr(obj, f.name)
+            if v is None:
+                continue
+            # Elide empty collections and empty strings ("" means unset
+            # throughout the model) to keep wire objects tight, but keep
+            # false/0 scalars (they are meaningful, e.g. replicas: 0).
+            if (isinstance(v, (list, dict, str)) and not v):
+                continue
+            out[f.name] = to_dict(v)
+        extra = getattr(obj, "__extra__", None)
+        if extra:
+            for k, v in extra.items():
+                out.setdefault(k, v)
+        return out
+    raise TypeError(f"cannot serialize {type(obj)!r}")
+
+
+def _resolve_hint(hint: Any) -> Any:
+    """Strip Optional[...] to its inner type; return hint otherwise."""
+    origin = get_origin(hint)
+    if origin is typing.Union or origin is _pytypes.UnionType:
+        args = [a for a in get_args(hint) if a is not type(None)]
+        if len(args) == 1:
+            return args[0]
+    return hint
+
+
+def _coerce(hint: Any, value: Any) -> Any:
+    if value is None:
+        return None
+    hint = _resolve_hint(hint)
+    origin = get_origin(hint)
+    if origin in (list, tuple):
+        (inner,) = get_args(hint) or (Any,)
+        seq = [_coerce(inner, v) for v in value]
+        return tuple(seq) if origin is tuple else seq
+    if origin is dict:
+        args = get_args(hint)
+        vt = args[1] if len(args) == 2 else Any
+        return {k: _coerce(vt, v) for k, v in value.items()}
+    if isinstance(hint, type):
+        if dataclasses.is_dataclass(hint):
+            return from_dict(hint, value)
+        if issubclass(hint, enum.Enum):
+            return hint(value)
+        if issubclass(hint, datetime.datetime):
+            return _dec_time(value) if isinstance(value, str) else value
+        if hint is float and isinstance(value, int):
+            return float(value)
+    return value
+
+
+_HINT_CACHE: dict[type, dict[str, Any]] = {}
+
+
+def _hints(cls: type) -> dict[str, Any]:
+    h = _HINT_CACHE.get(cls)
+    if h is None:
+        h = get_type_hints(cls)
+        _HINT_CACHE[cls] = h
+    return h
+
+
+def from_dict(cls: Type[T], data: dict) -> T:
+    """Build dataclass ``cls`` from a plain dict, preserving unknown keys."""
+    if data is None:
+        return None  # type: ignore[return-value]
+    if not dataclasses.is_dataclass(cls):
+        return data  # type: ignore[return-value]
+    hints = _hints(cls)
+    names = {f.name for f in dataclasses.fields(cls)}
+    kwargs: dict[str, Any] = {}
+    extra: dict[str, Any] = {}
+    for k, v in data.items():
+        if k in names:
+            kwargs[k] = _coerce(hints.get(k, Any), v)
+        else:
+            extra[k] = v
+    obj = cls(**kwargs)  # type: ignore[call-arg]
+    if extra:
+        object.__setattr__(obj, "__extra__", extra)
+    return obj
+
+
+def deepcopy(obj: T) -> T:
+    """Deep-copy via the codec — mirrors generated DeepCopy in the reference."""
+    if obj is None:
+        return None  # type: ignore[return-value]
+    return from_dict(type(obj), to_dict(obj))
+
+
+def encode(obj: Any) -> bytes:
+    return json.dumps(to_dict(obj), separators=(",", ":"), sort_keys=True).encode()
+
+
+class Scheme:
+    """(api_version, kind) <-> class registry with defaulting.
+
+    Reference analog: ``runtime.Scheme`` type registration +
+    ``scheme.Default(obj)`` (``pkg/apis/core/v1/defaults.go``).
+    """
+
+    def __init__(self) -> None:
+        self._by_gvk: dict[tuple[str, str], type] = {}
+        self._by_cls: dict[type, tuple[str, str]] = {}
+        self._defaulters: dict[type, list] = {}
+
+    def register(self, api_version: str, kind: str, cls: type) -> type:
+        self._by_gvk[(api_version, kind)] = cls
+        self._by_cls[cls] = (api_version, kind)
+        return cls
+
+    def add_defaulter(self, cls: type, fn) -> None:
+        self._defaulters.setdefault(cls, []).append(fn)
+
+    def default(self, obj: Any) -> Any:
+        for fn in self._defaulters.get(type(obj), ()):  # pragma: no branch
+            fn(obj)
+        return obj
+
+    def gvk_for(self, obj_or_cls: Any) -> tuple[str, str]:
+        cls = obj_or_cls if isinstance(obj_or_cls, type) else type(obj_or_cls)
+        try:
+            return self._by_cls[cls]
+        except KeyError:
+            raise KeyError(f"type {cls.__name__} not registered in scheme") from None
+
+    def class_for(self, api_version: str, kind: str) -> type:
+        try:
+            return self._by_gvk[(api_version, kind)]
+        except KeyError:
+            raise KeyError(f"no type registered for {api_version}/{kind}") from None
+
+    def decode(self, data: bytes | str | dict) -> Any:
+        """Decode JSON/dict into the registered type named by its TypeMeta."""
+        if isinstance(data, (bytes, str)):
+            data = json.loads(data)
+        api_version = data.get("api_version") or data.get("apiVersion") or ""
+        kind = data.get("kind") or ""
+        cls = self.class_for(api_version, kind)
+        obj = from_dict(cls, data)
+        # Stamp TypeMeta so round-trips are stable.
+        if hasattr(obj, "api_version"):
+            obj.api_version = api_version
+            obj.kind = kind
+        return self.default(obj)
+
+    def encode(self, obj: Any) -> bytes:
+        d = to_dict(obj)
+        gvk = self._by_cls.get(type(obj))
+        if gvk:
+            d["api_version"], d["kind"] = gvk
+        return json.dumps(d, separators=(",", ":"), sort_keys=True).encode()
+
+
+#: Process-global scheme all builtin types register into (the reference's
+#: ``pkg/api.Scheme`` / ``legacyscheme.Scheme`` equivalent).
+DEFAULT_SCHEME = Scheme()

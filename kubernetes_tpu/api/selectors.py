@@ -1,0 +1,150 @@
+"""Label / field selectors and device-attribute selectors.
+
+Reference: ``staging/src/k8s.io/apimachinery/pkg/labels`` (Selector,
+Requirement with In/NotIn/Exists/...), and the fork's
+``ResourceSelector`` over device attributes
+(``staging/src/k8s.io/api/core/v1/types.go:2632-2639``, evaluated at
+``plugin/pkg/scheduler/core/extended_resources.go:152 isDeviceAMatch``).
+
+Selectors here serve three consumers: workload controllers matching pods,
+the scheduler matching node labels, and the TPU sub-mesh allocator
+matching chip attributes (chip type, HBM, topology coords).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Iterable, Mapping, Optional
+
+# Operators mirror metav1.LabelSelectorOperator + fork's ResourceSelector ops.
+OP_IN = "In"
+OP_NOT_IN = "NotIn"
+OP_EXISTS = "Exists"
+OP_DOES_NOT_EXIST = "DoesNotExist"
+OP_GT = "Gt"
+OP_LT = "Lt"
+
+
+@dataclass
+class Requirement:
+    key: str = ""
+    operator: str = OP_IN
+    values: list[str] = field(default_factory=list)
+
+    def matches(self, labels: Mapping[str, str]) -> bool:
+        present = self.key in labels
+        if self.operator == OP_EXISTS:
+            return present
+        if self.operator == OP_DOES_NOT_EXIST:
+            return not present
+        if not present:
+            return False
+        v = str(labels[self.key])
+        if self.operator == OP_IN:
+            return v in self.values
+        if self.operator == OP_NOT_IN:
+            return v not in self.values
+        if self.operator in (OP_GT, OP_LT):
+            try:
+                lhs, rhs = float(v), float(self.values[0])
+            except (ValueError, IndexError):
+                return False
+            return lhs > rhs if self.operator == OP_GT else lhs < rhs
+        return False
+
+
+@dataclass
+class LabelSelector:
+    """match_labels AND match_expressions, all must hold (metav1 semantics).
+
+    An empty selector matches everything; a None selector matches nothing
+    (callers encode that distinction, as the reference does).
+    """
+
+    match_labels: dict[str, str] = field(default_factory=dict)
+    match_expressions: list[Requirement] = field(default_factory=list)
+
+    def matches(self, labels: Mapping[str, str]) -> bool:
+        for k, v in self.match_labels.items():
+            if labels.get(k) != v:
+                return False
+        return all(r.matches(labels) for r in self.match_expressions)
+
+    def empty(self) -> bool:
+        return not self.match_labels and not self.match_expressions
+
+
+def parse_selector(expr: str) -> LabelSelector:
+    """Parse 'a=b,c!=d,e in (x|y),f' (CLI style, cf. labels.Parse)."""
+    sel = LabelSelector()
+    expr = expr.strip()
+    if not expr:
+        return sel
+    for part in expr.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if " in " in part:
+            key, _, vals = part.partition(" in ")
+            vs = [v.strip() for v in vals.strip().strip("()").split("|") if v.strip()]
+            sel.match_expressions.append(Requirement(key.strip(), OP_IN, vs))
+        elif " notin " in part:
+            key, _, vals = part.partition(" notin ")
+            vs = [v.strip() for v in vals.strip().strip("()").split("|") if v.strip()]
+            sel.match_expressions.append(Requirement(key.strip(), OP_NOT_IN, vs))
+        elif "!=" in part:
+            key, _, v = part.partition("!=")
+            sel.match_expressions.append(Requirement(key.strip(), OP_NOT_IN, [v.strip()]))
+        elif "==" in part:
+            key, _, v = part.partition("==")
+            sel.match_labels[key.strip()] = v.strip()
+        elif "=" in part:
+            key, _, v = part.partition("=")
+            sel.match_labels[key.strip()] = v.strip()
+        elif part.startswith("!"):
+            sel.match_expressions.append(Requirement(part[1:].strip(), OP_DOES_NOT_EXIST))
+        else:
+            sel.match_expressions.append(Requirement(part, OP_EXISTS))
+    return sel
+
+
+def format_selector(sel: LabelSelector) -> str:
+    parts = [f"{k}={v}" for k, v in sorted(sel.match_labels.items())]
+    for r in sel.match_expressions:
+        if r.operator == OP_EXISTS:
+            parts.append(r.key)
+        elif r.operator == OP_DOES_NOT_EXIST:
+            parts.append(f"!{r.key}")
+        elif r.operator == OP_IN:
+            parts.append(f"{r.key} in ({'|'.join(r.values)})")
+        elif r.operator == OP_NOT_IN:
+            parts.append(f"{r.key} notin ({'|'.join(r.values)})")
+        else:
+            parts.append(f"{r.key} {r.operator} {r.values[0] if r.values else ''}")
+    return ",".join(parts)
+
+
+def match_field_selector(expr: str, fields: Mapping[str, str]) -> bool:
+    """Field selectors: 'spec.node_name=worker-1,status.phase!=Failed'."""
+    if not expr:
+        return True
+    for part in expr.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "!=" in part:
+            key, _, v = part.partition("!=")
+            if str(fields.get(key.strip(), "")) == v.strip():
+                return False
+        else:
+            key, _, v = part.partition("=")
+            if str(fields.get(key.strip(), "")) != v.strip():
+                return False
+    return True
+
+
+def matches_any(selectors: Iterable[LabelSelector], labels: Mapping[str, str]) -> bool:
+    return any(s.matches(labels) for s in selectors)
+
+
+def matches_all(selectors: Iterable[LabelSelector], labels: Mapping[str, str]) -> bool:
+    return all(s.matches(labels) for s in selectors)

@@ -1,0 +1,886 @@
+"""Core API group — Pod/Node/Service/... with a TPU-first device model.
+
+Reference analog: ``staging/src/k8s.io/api/core/v1/types.go`` (~4.6k
+lines) plus the fork's per-device extended-resource delta
+(``types.go:4018-4056`` ExtendedResourceMap, ``:4036-4051``
+PodExtendedResource, ``:4495`` Binding.Target.ExtendedResources).
+
+TPU-first redesign rather than translation:
+
+- A node advertises a :class:`TpuTopology` — chips with *ICI mesh
+  coordinates* and attributes, plus the slice identity/shape the node
+  belongs to. The reference's device map is flat (ID -> attributes);
+  coords are first-class here because placement is sub-mesh allocation.
+- A pod carries :class:`PodTpuRequest` — either a chip *count* or a
+  *slice shape* (e.g. ``[2,2,4]``) plus attribute affinity. The
+  scheduler writes concrete chip IDs into ``assigned`` via the binding
+  subresource in one atomic store update (reference pattern:
+  ``pkg/registry/core/pod/storage/storage.go:154``).
+- Gang scheduling is first-class via :class:`PodGroup` (reference has
+  none — SURVEY.md section 2.4).
+"""
+from __future__ import annotations
+
+import datetime
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .meta import ListMeta, ObjectMeta, TypedObject
+from .scheme import DEFAULT_SCHEME
+from .selectors import LabelSelector, Requirement
+
+# ---------------------------------------------------------------------------
+# Resource quantities
+# ---------------------------------------------------------------------------
+
+#: Resource name for TPU chips (the ``nvidia.com/gpu`` analog).
+RESOURCE_TPU = "google.com/tpu"
+RESOURCE_CPU = "cpu"
+RESOURCE_MEMORY = "memory"
+RESOURCE_PODS = "pods"
+
+#: ResourceList: resource name -> quantity. cpu in cores, memory in bytes.
+ResourceList = dict
+
+_SUFFIXES = {
+    "k": 1e3, "M": 1e6, "G": 1e9, "T": 1e12, "P": 1e15,
+    "Ki": 2**10, "Mi": 2**20, "Gi": 2**30, "Ti": 2**40, "Pi": 2**50,
+}
+
+
+def parse_quantity(q) -> float:
+    """'100m' -> 0.1, '2Gi' -> 2147483648.0, 4 -> 4.0 (k8s quantity syntax)."""
+    if isinstance(q, (int, float)):
+        return float(q)
+    s = str(q).strip()
+    for suf in sorted(_SUFFIXES, key=len, reverse=True):
+        if s.endswith(suf):
+            return float(s[: -len(suf)]) * _SUFFIXES[suf]
+    if s.endswith("m"):
+        return float(s[:-1]) / 1000.0
+    return float(s)
+
+
+# ---------------------------------------------------------------------------
+# TPU device model (the fork-delta, redesigned)
+# ---------------------------------------------------------------------------
+
+TPU_HEALTHY = "Healthy"
+TPU_UNHEALTHY = "Unhealthy"
+
+
+@dataclass
+class TpuChip:
+    """One chip on a node. Reference analog: ``ExtendedResource``
+    (``types.go:4022-4034``) — but coords are structural, not a string attr."""
+
+    id: str = ""
+    health: str = TPU_HEALTHY
+    #: Global coordinates of this chip in its slice's 3D mesh.
+    coords: list[int] = field(default_factory=list)
+    #: Free-form attributes matched by affinity (chip_type, hbm_gib, ...).
+    attributes: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class TpuTopology:
+    """Node's view of its TPU hardware, published in NodeStatus.
+
+    Replaces the reference's ``ExtendedResourceMap``
+    (``types.go:4018-4020``). The slice identity makes multi-host
+    sub-mesh allocation possible: the scheduler groups nodes by
+    ``slice_id`` and packs boxes in the slice's global ``mesh_shape``.
+    """
+
+    #: e.g. "v5p", "v5e", "v6e".
+    chip_type: str = ""
+    #: Identity of the (multi-host) slice this node belongs to.
+    slice_id: str = ""
+    #: Full mesh shape of the slice, e.g. [4,4,4] for v5p-64 (chips).
+    mesh_shape: list[int] = field(default_factory=list)
+    #: This host's index within the slice (TPU_WORKER_ID seed).
+    worker_index: int = 0
+    #: Chips physically attached to this host.
+    chips: list[TpuChip] = field(default_factory=list)
+
+
+@dataclass
+class PodTpuRequest:
+    """Pod-level TPU claim, referenced from containers by name.
+
+    Reference analog: ``PodExtendedResource`` (``types.go:4036-4051``):
+    Name/Resources/Affinity/Annotations/Assigned. Redesign: adds
+    ``slice_shape`` so a claim can demand a *contiguous sub-mesh*, the
+    unit JAX meshes map onto, instead of only a count.
+    """
+
+    name: str = ""
+    resource: str = RESOURCE_TPU
+    #: Number of chips wanted (used when slice_shape is empty).
+    chips: int = 0
+    #: Contiguous sub-mesh shape wanted, e.g. [2,2,4]. Overrides chips.
+    slice_shape: list[int] = field(default_factory=list)
+    #: All requirements must match a chip's attributes (cf.
+    #: ``ExtendedResourceAffinity.Required``, ``types.go:2632-2639``).
+    affinity: list[Requirement] = field(default_factory=list)
+    annotations: dict[str, str] = field(default_factory=dict)
+    #: Chip IDs chosen by the scheduler; written via the binding
+    #: subresource; the durable record of allocation (the fork's key
+    #: trick: the checkpoint is the API object — SURVEY.md section 5.4).
+    assigned: list[str] = field(default_factory=list)
+
+    def chip_count(self) -> int:
+        if self.slice_shape:
+            n = 1
+            for d in self.slice_shape:
+                n *= d
+            return n
+        return self.chips
+
+
+@dataclass
+class TpuBinding:
+    """Scheduler's device choice for one claim, carried on the Binding.
+
+    Reference analog: ``ExtendedResourceBinding`` (``types.go:4495``).
+    """
+
+    name: str = ""
+    chip_ids: list[str] = field(default_factory=list)
+
+
+# ---------------------------------------------------------------------------
+# Containers & pods
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class EnvVar:
+    name: str = ""
+    value: str = ""
+
+
+@dataclass
+class ContainerPort:
+    name: str = ""
+    container_port: int = 0
+    host_port: int = 0
+    protocol: str = "TCP"
+
+
+@dataclass
+class VolumeMount:
+    name: str = ""
+    mount_path: str = ""
+    read_only: bool = False
+
+
+@dataclass
+class HostPathVolume:
+    path: str = ""
+
+
+@dataclass
+class EmptyDirVolume:
+    medium: str = ""
+
+
+@dataclass
+class ConfigMapVolume:
+    name: str = ""
+
+
+@dataclass
+class SecretVolume:
+    secret_name: str = ""
+
+
+@dataclass
+class Volume:
+    name: str = ""
+    host_path: Optional[HostPathVolume] = None
+    empty_dir: Optional[EmptyDirVolume] = None
+    config_map: Optional[ConfigMapVolume] = None
+    secret: Optional[SecretVolume] = None
+
+
+@dataclass
+class HTTPGetAction:
+    path: str = "/"
+    port: int = 0
+    host: str = ""
+    scheme: str = "HTTP"
+
+
+@dataclass
+class Probe:
+    """Liveness/readiness probe (reference: ``pkg/probe/`` + prober)."""
+
+    exec_command: list[str] = field(default_factory=list)
+    http_get: Optional[HTTPGetAction] = None
+    tcp_port: int = 0
+    initial_delay_seconds: int = 0
+    period_seconds: int = 10
+    timeout_seconds: int = 1
+    success_threshold: int = 1
+    failure_threshold: int = 3
+
+
+@dataclass
+class ResourceRequirements:
+    limits: dict[str, float] = field(default_factory=dict)
+    requests: dict[str, float] = field(default_factory=dict)
+
+
+@dataclass
+class Container:
+    name: str = ""
+    image: str = ""
+    command: list[str] = field(default_factory=list)
+    args: list[str] = field(default_factory=list)
+    working_dir: str = ""
+    env: list[EnvVar] = field(default_factory=list)
+    ports: list[ContainerPort] = field(default_factory=list)
+    resources: ResourceRequirements = field(default_factory=ResourceRequirements)
+    volume_mounts: list[VolumeMount] = field(default_factory=list)
+    liveness_probe: Optional[Probe] = None
+    readiness_probe: Optional[Probe] = None
+    #: Names of PodSpec.tpu_resources entries this container uses.
+    #: Reference analog: ``Container.ExtendedResourceRequests``
+    #: (``types.go:2204``).
+    tpu_requests: list[str] = field(default_factory=list)
+
+
+RESTART_ALWAYS = "Always"
+RESTART_ON_FAILURE = "OnFailure"
+RESTART_NEVER = "Never"
+
+TAINT_NO_SCHEDULE = "NoSchedule"
+TAINT_PREFER_NO_SCHEDULE = "PreferNoSchedule"
+TAINT_NO_EXECUTE = "NoExecute"
+
+
+@dataclass
+class Taint:
+    key: str = ""
+    value: str = ""
+    effect: str = TAINT_NO_SCHEDULE
+    time_added: Optional[datetime.datetime] = None
+
+
+@dataclass
+class Toleration:
+    key: str = ""
+    operator: str = "Equal"  # Equal | Exists
+    value: str = ""
+    effect: str = ""  # "" tolerates all effects
+    toleration_seconds: Optional[int] = None
+
+    def tolerates(self, taint: Taint) -> bool:
+        if self.effect and self.effect != taint.effect:
+            return False
+        if self.operator == "Exists":
+            return self.key == "" or self.key == taint.key
+        return self.key == taint.key and self.value == taint.value
+
+
+@dataclass
+class NodeAffinityTerm:
+    match_expressions: list[Requirement] = field(default_factory=list)
+
+    def matches(self, labels) -> bool:
+        return all(r.matches(labels) for r in self.match_expressions)
+
+
+@dataclass
+class PodAffinityTerm:
+    label_selector: Optional[LabelSelector] = None
+    topology_key: str = "kubernetes.io/hostname"
+    namespaces: list[str] = field(default_factory=list)
+
+
+@dataclass
+class WeightedPodAffinityTerm:
+    weight: int = 1
+    pod_affinity_term: PodAffinityTerm = field(default_factory=PodAffinityTerm)
+
+
+@dataclass
+class Affinity:
+    #: Node must match at least one term (OR of ANDs, metav1 semantics).
+    node_required: list[NodeAffinityTerm] = field(default_factory=list)
+    node_preferred: list[NodeAffinityTerm] = field(default_factory=list)
+    pod_affinity: list[PodAffinityTerm] = field(default_factory=list)
+    pod_anti_affinity: list[PodAffinityTerm] = field(default_factory=list)
+    pod_affinity_preferred: list[WeightedPodAffinityTerm] = field(default_factory=list)
+    pod_anti_affinity_preferred: list[WeightedPodAffinityTerm] = field(default_factory=list)
+
+
+@dataclass
+class PodSpec:
+    containers: list[Container] = field(default_factory=list)
+    init_containers: list[Container] = field(default_factory=list)
+    volumes: list[Volume] = field(default_factory=list)
+    restart_policy: str = RESTART_ALWAYS
+    termination_grace_period_seconds: int = 30
+    active_deadline_seconds: Optional[int] = None
+    node_selector: dict[str, str] = field(default_factory=dict)
+    node_name: str = ""
+    host_network: bool = False
+    hostname: str = ""
+    subdomain: str = ""
+    service_account_name: str = ""
+    scheduler_name: str = "default-scheduler"
+    tolerations: list[Toleration] = field(default_factory=list)
+    affinity: Optional[Affinity] = None
+    priority: Optional[int] = None
+    priority_class_name: str = ""
+    #: TPU claims (fork analog: PodSpec.ExtendedResources, ``types.go:2885``).
+    tpu_resources: list[PodTpuRequest] = field(default_factory=list)
+    #: Name of the PodGroup this pod gangs with ("" = no gang).
+    gang: str = ""
+
+
+POD_PENDING = "Pending"
+POD_RUNNING = "Running"
+POD_SUCCEEDED = "Succeeded"
+POD_FAILED = "Failed"
+POD_UNKNOWN = "Unknown"
+
+COND_POD_SCHEDULED = "PodScheduled"
+COND_POD_INITIALIZED = "Initialized"
+COND_POD_READY = "Ready"
+COND_CONTAINERS_READY = "ContainersReady"
+
+
+@dataclass
+class PodCondition:
+    type: str = ""
+    status: str = "False"  # "True" | "False" | "Unknown"
+    reason: str = ""
+    message: str = ""
+    last_transition_time: Optional[datetime.datetime] = None
+
+
+@dataclass
+class ContainerStateWaiting:
+    reason: str = ""
+    message: str = ""
+
+
+@dataclass
+class ContainerStateRunning:
+    started_at: Optional[datetime.datetime] = None
+
+
+@dataclass
+class ContainerStateTerminated:
+    exit_code: int = 0
+    reason: str = ""
+    message: str = ""
+    started_at: Optional[datetime.datetime] = None
+    finished_at: Optional[datetime.datetime] = None
+
+
+@dataclass
+class ContainerState:
+    waiting: Optional[ContainerStateWaiting] = None
+    running: Optional[ContainerStateRunning] = None
+    terminated: Optional[ContainerStateTerminated] = None
+
+
+@dataclass
+class ContainerStatus:
+    name: str = ""
+    state: ContainerState = field(default_factory=ContainerState)
+    last_state: ContainerState = field(default_factory=ContainerState)
+    ready: bool = False
+    restart_count: int = 0
+    image: str = ""
+    container_id: str = ""
+
+
+@dataclass
+class PodStatus:
+    phase: str = POD_PENDING
+    conditions: list[PodCondition] = field(default_factory=list)
+    message: str = ""
+    reason: str = ""
+    host_ip: str = ""
+    pod_ip: str = ""
+    start_time: Optional[datetime.datetime] = None
+    container_statuses: list[ContainerStatus] = field(default_factory=list)
+    init_container_statuses: list[ContainerStatus] = field(default_factory=list)
+    #: Node a preemptor is waiting on (reference: status.nominatedNodeName).
+    nominated_node_name: str = ""
+
+
+@dataclass
+class Pod(TypedObject):
+    spec: PodSpec = field(default_factory=PodSpec)
+    status: PodStatus = field(default_factory=PodStatus)
+
+
+@dataclass
+class PodTemplateSpec:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodSpec = field(default_factory=PodSpec)
+
+
+@dataclass
+class BindingTarget:
+    node_name: str = ""
+    #: Per-claim chip assignment (fork: Binding.Target.ExtendedResources).
+    tpu_bindings: list[TpuBinding] = field(default_factory=list)
+
+
+@dataclass
+class Binding(TypedObject):
+    """Posted by the scheduler to ``pods/<name>/binding``; the registry
+    writes node_name + assigned chip IDs in ONE GuaranteedUpdate
+    (reference: ``pkg/registry/core/pod/storage/storage.go:130-210``)."""
+
+    target: BindingTarget = field(default_factory=BindingTarget)
+
+
+# ---------------------------------------------------------------------------
+# Nodes
+# ---------------------------------------------------------------------------
+
+NODE_READY = "Ready"
+NODE_MEMORY_PRESSURE = "MemoryPressure"
+NODE_DISK_PRESSURE = "DiskPressure"
+NODE_NETWORK_UNAVAILABLE = "NetworkUnavailable"
+
+# Well-known taints applied by the node lifecycle controller
+# (reference: ``pkg/controller/node``).
+TAINT_NODE_NOT_READY = "node.tpu/not-ready"
+TAINT_NODE_UNREACHABLE = "node.tpu/unreachable"
+TAINT_NODE_UNSCHEDULABLE = "node.tpu/unschedulable"
+
+
+@dataclass
+class NodeCondition:
+    type: str = ""
+    status: str = "Unknown"
+    reason: str = ""
+    message: str = ""
+    last_heartbeat_time: Optional[datetime.datetime] = None
+    last_transition_time: Optional[datetime.datetime] = None
+
+
+@dataclass
+class NodeAddress:
+    type: str = "InternalIP"  # InternalIP | Hostname
+    address: str = ""
+
+
+@dataclass
+class NodeSystemInfo:
+    machine_id: str = ""
+    kernel_version: str = ""
+    os_image: str = ""
+    container_runtime_version: str = ""
+    agent_version: str = ""
+    architecture: str = ""
+
+
+@dataclass
+class NodeSpec:
+    unschedulable: bool = False
+    taints: list[Taint] = field(default_factory=list)
+    provider_id: str = ""
+    pod_cidr: str = ""
+
+
+@dataclass
+class NodeStatus:
+    capacity: dict[str, float] = field(default_factory=dict)
+    allocatable: dict[str, float] = field(default_factory=dict)
+    conditions: list[NodeCondition] = field(default_factory=list)
+    addresses: list[NodeAddress] = field(default_factory=list)
+    node_info: NodeSystemInfo = field(default_factory=NodeSystemInfo)
+    #: The TPU device map (fork: node.Status.ExtendedResources via
+    #: ``kubelet_node_status.go:552-621``).
+    tpu: Optional[TpuTopology] = None
+    daemon_endpoints: dict[str, int] = field(default_factory=dict)
+
+
+@dataclass
+class Node(TypedObject):
+    spec: NodeSpec = field(default_factory=NodeSpec)
+    status: NodeStatus = field(default_factory=NodeStatus)
+
+
+# ---------------------------------------------------------------------------
+# Services / endpoints / namespaces / config
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ServicePort:
+    name: str = ""
+    port: int = 0
+    target_port: int = 0
+    node_port: int = 0
+    protocol: str = "TCP"
+
+
+@dataclass
+class ServiceSpec:
+    selector: dict[str, str] = field(default_factory=dict)
+    ports: list[ServicePort] = field(default_factory=list)
+    cluster_ip: str = ""  # "None" => headless
+    type: str = "ClusterIP"  # ClusterIP | NodePort | LoadBalancer
+
+
+@dataclass
+class ServiceStatus:
+    load_balancer_ip: str = ""
+
+
+@dataclass
+class Service(TypedObject):
+    spec: ServiceSpec = field(default_factory=ServiceSpec)
+    status: ServiceStatus = field(default_factory=ServiceStatus)
+
+
+@dataclass
+class ObjectReference:
+    api_version: str = ""
+    kind: str = ""
+    namespace: str = ""
+    name: str = ""
+    uid: str = ""
+    field_path: str = ""
+
+
+@dataclass
+class EndpointAddress:
+    ip: str = ""
+    hostname: str = ""
+    node_name: str = ""
+    target_ref: Optional[ObjectReference] = None
+
+
+@dataclass
+class EndpointPort:
+    name: str = ""
+    port: int = 0
+    protocol: str = "TCP"
+
+
+@dataclass
+class EndpointSubset:
+    addresses: list[EndpointAddress] = field(default_factory=list)
+    not_ready_addresses: list[EndpointAddress] = field(default_factory=list)
+    ports: list[EndpointPort] = field(default_factory=list)
+
+
+@dataclass
+class Endpoints(TypedObject):
+    subsets: list[EndpointSubset] = field(default_factory=list)
+
+
+NS_ACTIVE = "Active"
+NS_TERMINATING = "Terminating"
+
+
+@dataclass
+class NamespaceSpec:
+    finalizers: list[str] = field(default_factory=lambda: ["kubernetes_tpu"])
+
+
+@dataclass
+class NamespaceStatus:
+    phase: str = NS_ACTIVE
+
+
+@dataclass
+class Namespace(TypedObject):
+    spec: NamespaceSpec = field(default_factory=NamespaceSpec)
+    status: NamespaceStatus = field(default_factory=NamespaceStatus)
+
+
+@dataclass
+class ConfigMap(TypedObject):
+    data: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class Secret(TypedObject):
+    type: str = "Opaque"
+    data: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class EventSource:
+    component: str = ""
+    host: str = ""
+
+
+@dataclass
+class Event(TypedObject):
+    involved_object: ObjectReference = field(default_factory=ObjectReference)
+    reason: str = ""
+    message: str = ""
+    type: str = "Normal"  # Normal | Warning
+    count: int = 1
+    source: EventSource = field(default_factory=EventSource)
+    first_timestamp: Optional[datetime.datetime] = None
+    last_timestamp: Optional[datetime.datetime] = None
+
+
+@dataclass
+class ResourceQuotaSpec:
+    hard: dict[str, float] = field(default_factory=dict)
+
+
+@dataclass
+class ResourceQuotaStatus:
+    hard: dict[str, float] = field(default_factory=dict)
+    used: dict[str, float] = field(default_factory=dict)
+
+
+@dataclass
+class ResourceQuota(TypedObject):
+    spec: ResourceQuotaSpec = field(default_factory=ResourceQuotaSpec)
+    status: ResourceQuotaStatus = field(default_factory=ResourceQuotaStatus)
+
+
+@dataclass
+class LimitRangeItem:
+    type: str = "Container"
+    default: dict[str, float] = field(default_factory=dict)
+    default_request: dict[str, float] = field(default_factory=dict)
+    max: dict[str, float] = field(default_factory=dict)
+    min: dict[str, float] = field(default_factory=dict)
+
+
+@dataclass
+class LimitRangeSpec:
+    limits: list[LimitRangeItem] = field(default_factory=list)
+
+
+@dataclass
+class LimitRange(TypedObject):
+    spec: LimitRangeSpec = field(default_factory=LimitRangeSpec)
+
+
+@dataclass
+class PriorityClass(TypedObject):
+    value: int = 0
+    global_default: bool = False
+    preemption_policy: str = "PreemptLowerPriority"  # or "Never"
+    description: str = ""
+
+
+@dataclass
+class LeaseSpec:
+    holder_identity: str = ""
+    lease_duration_seconds: int = 15
+    acquire_time: Optional[datetime.datetime] = None
+    renew_time: Optional[datetime.datetime] = None
+    lease_transitions: int = 0
+
+
+@dataclass
+class Lease(TypedObject):
+    """Coordination primitive for leader election + node heartbeats."""
+
+    spec: LeaseSpec = field(default_factory=LeaseSpec)
+
+
+# ---------------------------------------------------------------------------
+# Gang scheduling (TPU-first; no reference analog — SURVEY section 2.4)
+# ---------------------------------------------------------------------------
+
+PODGROUP_PENDING = "Pending"
+PODGROUP_SCHEDULED = "Scheduled"
+PODGROUP_RUNNING = "Running"
+PODGROUP_FAILED = "Failed"
+
+
+@dataclass
+class PodGroupSpec:
+    #: All-or-nothing: schedule no member until min_member can all fit.
+    min_member: int = 1
+    #: If set, the whole gang must land on one slice as a contiguous
+    #: sub-mesh of this shape (chips across all members).
+    slice_shape: list[int] = field(default_factory=list)
+    priority: Optional[int] = None
+    #: Give up and fail the gang if unschedulable this long (seconds).
+    schedule_timeout_seconds: int = 0
+
+
+@dataclass
+class PodGroupStatus:
+    phase: str = PODGROUP_PENDING
+    scheduled: int = 0
+    running: int = 0
+    succeeded: int = 0
+    failed: int = 0
+    #: Slice the gang landed on + the box origin/shape, for observability.
+    slice_id: str = ""
+    conditions: list[PodCondition] = field(default_factory=list)
+
+
+@dataclass
+class PodGroup(TypedObject):
+    spec: PodGroupSpec = field(default_factory=PodGroupSpec)
+    status: PodGroupStatus = field(default_factory=PodGroupStatus)
+
+
+# ---------------------------------------------------------------------------
+# List envelope
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ObjectList:
+    """Generic list: items carry their own TypeMeta and are decoded
+    individually through the scheme."""
+
+    api_version: str = "core/v1"
+    kind: str = "List"
+    metadata: ListMeta = field(default_factory=ListMeta)
+    items: list = field(default_factory=list)
+
+
+# ---------------------------------------------------------------------------
+# Registration + defaulting
+# ---------------------------------------------------------------------------
+
+CORE_V1 = "core/v1"
+
+for _kind, _cls in [
+    ("Pod", Pod), ("Node", Node), ("Binding", Binding), ("Service", Service),
+    ("Endpoints", Endpoints), ("Namespace", Namespace), ("ConfigMap", ConfigMap),
+    ("Secret", Secret), ("Event", Event), ("ResourceQuota", ResourceQuota),
+    ("LimitRange", LimitRange), ("PriorityClass", PriorityClass),
+    ("Lease", Lease), ("PodGroup", PodGroup), ("List", ObjectList),
+]:
+    DEFAULT_SCHEME.register(CORE_V1, _kind, _cls)
+
+
+def _default_pod(pod: Pod) -> None:
+    if not pod.spec.restart_policy:
+        pod.spec.restart_policy = RESTART_ALWAYS
+    if not pod.spec.scheduler_name:
+        pod.spec.scheduler_name = "default-scheduler"
+    for c in pod.spec.containers + pod.spec.init_containers:
+        for p in c.ports:
+            if not p.protocol:
+                p.protocol = "TCP"
+
+
+DEFAULT_SCHEME.add_defaulter(Pod, _default_pod)
+
+
+# ---------------------------------------------------------------------------
+# Helpers (reference: pkg/apis/core/v1/helper/helpers.go:465-545)
+# ---------------------------------------------------------------------------
+
+
+def pod_tpu_request(pod: Pod, name: str) -> Optional[PodTpuRequest]:
+    for r in pod.spec.tpu_resources:
+        if r.name == name:
+            return r
+    return None
+
+
+def pod_tpu_chip_count(pod: Pod) -> int:
+    return sum(r.chip_count() for r in pod.spec.tpu_resources)
+
+
+def pod_tpu_assigned(pod: Pod) -> list[str]:
+    out: list[str] = []
+    for r in pod.spec.tpu_resources:
+        out.extend(r.assigned)
+    return out
+
+
+def pod_resource_requests(pod: Pod) -> dict[str, float]:
+    """Effective requests: max(init containers) elementwise-added to sum(containers),
+    mirroring the reference's resource accounting, plus the TPU claim count."""
+    total: dict[str, float] = {}
+    for c in pod.spec.containers:
+        for k, v in c.resources.requests.items():
+            total[k] = total.get(k, 0.0) + parse_quantity(v)
+    for c in pod.spec.init_containers:
+        for k, v in c.resources.requests.items():
+            total[k] = max(total.get(k, 0.0), parse_quantity(v))
+    tpus = pod_tpu_chip_count(pod)
+    if tpus:
+        total[RESOURCE_TPU] = total.get(RESOURCE_TPU, 0.0) + tpus
+    total[RESOURCE_PODS] = total.get(RESOURCE_PODS, 0.0) + 1
+    return total
+
+
+def is_pod_active(pod: Pod) -> bool:
+    return (
+        pod.status.phase not in (POD_SUCCEEDED, POD_FAILED)
+        and pod.metadata.deletion_timestamp is None
+    )
+
+
+def is_pod_terminal(pod: Pod) -> bool:
+    return pod.status.phase in (POD_SUCCEEDED, POD_FAILED)
+
+
+def is_pod_ready(pod: Pod) -> bool:
+    for c in pod.status.conditions:
+        if c.type == COND_POD_READY:
+            return c.status == "True"
+    return False
+
+
+def get_pod_condition(status: PodStatus, cond_type: str) -> Optional[PodCondition]:
+    for c in status.conditions:
+        if c.type == cond_type:
+            return c
+    return None
+
+
+def update_pod_condition(status: PodStatus, cond: PodCondition) -> bool:
+    """Insert/update condition; returns True if anything changed."""
+    import datetime as _dt
+
+    cond.last_transition_time = cond.last_transition_time or _dt.datetime.utcnow()
+    existing = get_pod_condition(status, cond.type)
+    if existing is None:
+        status.conditions.append(cond)
+        return True
+    if (existing.status == cond.status and existing.reason == cond.reason
+            and existing.message == cond.message):
+        return False
+    if existing.status == cond.status:
+        cond.last_transition_time = existing.last_transition_time
+    status.conditions.remove(existing)
+    status.conditions.append(cond)
+    return True
+
+
+def get_node_condition(status: NodeStatus, cond_type: str) -> Optional[NodeCondition]:
+    for c in status.conditions:
+        if c.type == cond_type:
+            return c
+    return None
+
+
+def is_node_ready(node: Node) -> bool:
+    c = get_node_condition(node.status, NODE_READY)
+    return c is not None and c.status == "True"
+
+
+def tolerates_taints(pod: Pod, taints: list[Taint], effects=(TAINT_NO_SCHEDULE, TAINT_NO_EXECUTE)) -> bool:
+    for t in taints:
+        if t.effect not in effects:
+            continue
+        if not any(tol.tolerates(t) for tol in pod.spec.tolerations):
+            return False
+    return True
+
+
+def pod_priority(pod: Pod) -> int:
+    return pod.spec.priority if pod.spec.priority is not None else 0

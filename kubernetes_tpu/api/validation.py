@@ -1,0 +1,277 @@
+"""API validation — reject malformed objects before they hit the store.
+
+Reference: ``pkg/apis/core/validation/validation.go`` (~4.8k lines),
+incl. the fork's extended-resource validation (``:2457,2883-2888,2950``:
+claim names unique, container references resolve, assigned IDs only via
+binding). Field errors accumulate into one Invalid error with a path
+list, like the reference's ``field.ErrorList``.
+"""
+from __future__ import annotations
+
+import re
+from typing import Optional
+
+from . import types as t
+from . import workloads as w
+from .errors import InvalidError
+
+# DNS-1123: what object names must look like.
+_NAME_RE = re.compile(r"^[a-z0-9]([-a-z0-9.]*[a-z0-9])?$")
+_LABEL_KEY_RE = re.compile(r"^([a-z0-9A-Z][-a-z0-9A-Z_.]*)?[a-z0-9A-Z](/([a-z0-9A-Z][-a-z0-9A-Z_.]*)?[a-z0-9A-Z])?$")
+_LABEL_VAL_RE = re.compile(r"^([A-Za-z0-9][-A-Za-z0-9_.]*)?[A-Za-z0-9]$|^$")
+MAX_NAME_LEN = 253
+
+
+class ErrorList:
+    def __init__(self) -> None:
+        self.errors: list[str] = []
+
+    def add(self, path: str, msg: str) -> None:
+        self.errors.append(f"{path}: {msg}")
+
+    def raise_if_any(self, kind: str, name: str) -> None:
+        if self.errors:
+            raise InvalidError(
+                f"{kind} {name!r} is invalid: " + "; ".join(self.errors),
+                details={"errors": self.errors},
+            )
+
+
+def validate_name(name: str, path: str, errs: ErrorList, required: bool = True) -> None:
+    if not name:
+        if required:
+            errs.add(path, "name is required")
+        return
+    if len(name) > MAX_NAME_LEN:
+        errs.add(path, f"must be <= {MAX_NAME_LEN} chars")
+    if not _NAME_RE.match(name):
+        errs.add(path, "must be DNS-1123: lowercase alphanumerics, '-', '.'")
+
+
+def validate_labels(labels: dict, path: str, errs: ErrorList) -> None:
+    for k, v in labels.items():
+        if not _LABEL_KEY_RE.match(k) or len(k) > 317:
+            errs.add(f"{path}.{k}", "invalid label key")
+        if not _LABEL_VAL_RE.match(str(v)) or len(str(v)) > 63:
+            errs.add(f"{path}.{k}", "invalid label value")
+
+
+def validate_object_meta(meta, errs: ErrorList, namespaced: bool = True, path: str = "metadata") -> None:
+    if not meta.name and not meta.generate_name:
+        errs.add(f"{path}.name", "name or generate_name is required")
+    if meta.name:
+        validate_name(meta.name, f"{path}.name", errs)
+    if namespaced and meta.namespace:
+        validate_name(meta.namespace, f"{path}.namespace", errs)
+    if not namespaced and meta.namespace:
+        errs.add(f"{path}.namespace", "cluster-scoped object must not set namespace")
+    validate_labels(meta.labels, f"{path}.labels", errs)
+
+
+# ---------------------------------------------------------------------------
+# Pods
+# ---------------------------------------------------------------------------
+
+
+def _validate_container(c: t.Container, claim_names: set, path: str, errs: ErrorList) -> None:
+    validate_name(c.name, f"{path}.name", errs)
+    if not c.image and not c.command:
+        errs.add(f"{path}.image", "image or command is required")
+    for i, p in enumerate(c.ports):
+        if not (0 < p.container_port < 65536):
+            errs.add(f"{path}.ports[{i}]", "container_port must be 1-65535")
+    for q in c.tpu_requests:
+        # Fork analog: validation.go:2883-2888 — container references
+        # must resolve to a declared pod-level claim.
+        if q not in claim_names:
+            errs.add(f"{path}.tpu_requests", f"no pod tpu_resources entry named {q!r}")
+    for k, v in {**c.resources.requests, **c.resources.limits}.items():
+        try:
+            if t.parse_quantity(v) < 0:
+                errs.add(f"{path}.resources.{k}", "must be non-negative")
+        except ValueError:
+            errs.add(f"{path}.resources.{k}", f"unparseable quantity {v!r}")
+
+
+def validate_pod(pod: t.Pod, is_create: bool = True) -> None:
+    errs = ErrorList()
+    validate_object_meta(pod.metadata, errs)
+    if not pod.spec.containers:
+        errs.add("spec.containers", "at least one container is required")
+    claim_names = {r.name for r in pod.spec.tpu_resources}
+    if len(claim_names) != len(pod.spec.tpu_resources):
+        errs.add("spec.tpu_resources", "claim names must be unique")  # validation.go:2457
+    seen = set()
+    for i, c in enumerate(pod.spec.containers + pod.spec.init_containers):
+        if c.name in seen:
+            errs.add(f"spec.containers[{i}].name", f"duplicate container name {c.name!r}")
+        seen.add(c.name)
+        _validate_container(c, claim_names, f"spec.containers[{i}]", errs)
+    if pod.spec.restart_policy not in (t.RESTART_ALWAYS, t.RESTART_ON_FAILURE, t.RESTART_NEVER):
+        errs.add("spec.restart_policy", f"unknown policy {pod.spec.restart_policy!r}")
+    for i, r in enumerate(pod.spec.tpu_resources):
+        if not r.name:
+            errs.add(f"spec.tpu_resources[{i}].name", "name is required")
+        if r.chips < 0:
+            errs.add(f"spec.tpu_resources[{i}].chips", "must be non-negative")
+        if r.slice_shape and any(d <= 0 for d in r.slice_shape):
+            errs.add(f"spec.tpu_resources[{i}].slice_shape", "dims must be positive")
+        if r.slice_shape and len(r.slice_shape) > 3:
+            errs.add(f"spec.tpu_resources[{i}].slice_shape", "at most 3 dims")
+        if is_create and r.assigned:
+            # Fork analog: validation.go:2950 — only the binding
+            # subresource may write assignments.
+            errs.add(f"spec.tpu_resources[{i}].assigned", "cannot be set on create")
+    errs.raise_if_any("Pod", pod.metadata.name)
+
+
+def validate_pod_update(new: t.Pod, old: t.Pod) -> None:
+    errs = ErrorList()
+    # Spec is mostly immutable after creation (reference semantics);
+    # node_name may only transition empty -> set (via binding).
+    if old.spec.node_name and new.spec.node_name != old.spec.node_name:
+        errs.add("spec.node_name", "is immutable once set")
+    if len(new.spec.containers) != len(old.spec.containers):
+        errs.add("spec.containers", "may not add or remove containers")
+    # TPU claims are immutable through the normal update path; chip
+    # assignments are written only by the binding subresource, which
+    # goes straight to storage (fork analog: validation.go:2950 +
+    # pkg/registry/core/pod/storage/storage.go:154).
+    old_claims = {r.name: r for r in old.spec.tpu_resources}
+    new_claims = {r.name: r for r in new.spec.tpu_resources}
+    if set(old_claims) != set(new_claims):
+        errs.add("spec.tpu_resources", "claims may not be added or removed")
+    else:
+        for name, nr in new_claims.items():
+            o = old_claims[name]
+            if nr.assigned != o.assigned:
+                errs.add(f"spec.tpu_resources[{name}].assigned",
+                         "may only be written via the binding subresource")
+            if (nr.chips, nr.slice_shape, nr.resource) != (o.chips, o.slice_shape, o.resource):
+                errs.add(f"spec.tpu_resources[{name}]", "claim shape is immutable")
+    if new.spec.gang != old.spec.gang:
+        errs.add("spec.gang", "is immutable")
+    errs.raise_if_any("Pod", new.metadata.name)
+
+
+# ---------------------------------------------------------------------------
+# Nodes
+# ---------------------------------------------------------------------------
+
+
+def validate_node(node: t.Node, is_create: bool = True) -> None:
+    errs = ErrorList()
+    validate_object_meta(node.metadata, errs, namespaced=False)
+    for i, taint in enumerate(node.spec.taints):
+        if taint.effect not in (t.TAINT_NO_SCHEDULE, t.TAINT_PREFER_NO_SCHEDULE, t.TAINT_NO_EXECUTE):
+            errs.add(f"spec.taints[{i}].effect", f"unknown effect {taint.effect!r}")
+        if not taint.key:
+            errs.add(f"spec.taints[{i}].key", "key is required")
+    topo = node.status.tpu
+    if topo is not None:
+        ids = [c.id for c in topo.chips]
+        if len(set(ids)) != len(ids):
+            errs.add("status.tpu.chips", "chip ids must be unique")
+        if topo.mesh_shape and any(d <= 0 for d in topo.mesh_shape):
+            errs.add("status.tpu.mesh_shape", "dims must be positive")
+        for i, chip in enumerate(topo.chips):
+            if topo.mesh_shape and len(chip.coords) != len(topo.mesh_shape):
+                errs.add(f"status.tpu.chips[{i}].coords", "rank must match mesh_shape")
+    errs.raise_if_any("Node", node.metadata.name)
+
+
+# ---------------------------------------------------------------------------
+# Workloads
+# ---------------------------------------------------------------------------
+
+
+def _validate_template_matches(selector, template, errs: ErrorList) -> None:
+    if selector is None or selector.empty():
+        errs.add("spec.selector", "selector is required and must be non-empty")
+        return
+    if not selector.matches(template.metadata.labels):
+        errs.add("spec.template.metadata.labels", "must match spec.selector")
+
+
+def validate_replicaset(rs: w.ReplicaSet, is_create: bool = True) -> None:
+    errs = ErrorList()
+    validate_object_meta(rs.metadata, errs)
+    if rs.spec.replicas < 0:
+        errs.add("spec.replicas", "must be non-negative")
+    _validate_template_matches(rs.spec.selector, rs.spec.template, errs)
+    errs.raise_if_any("ReplicaSet", rs.metadata.name)
+
+
+def validate_deployment(d: w.Deployment, is_create: bool = True) -> None:
+    errs = ErrorList()
+    validate_object_meta(d.metadata, errs)
+    if d.spec.replicas < 0:
+        errs.add("spec.replicas", "must be non-negative")
+    _validate_template_matches(d.spec.selector, d.spec.template, errs)
+    if d.spec.strategy.type not in (w.ROLLING_UPDATE, w.RECREATE):
+        errs.add("spec.strategy.type", f"unknown strategy {d.spec.strategy.type!r}")
+    errs.raise_if_any("Deployment", d.metadata.name)
+
+
+def validate_statefulset(s: w.StatefulSet, is_create: bool = True) -> None:
+    errs = ErrorList()
+    validate_object_meta(s.metadata, errs)
+    if s.spec.replicas < 0:
+        errs.add("spec.replicas", "must be non-negative")
+    _validate_template_matches(s.spec.selector, s.spec.template, errs)
+    errs.raise_if_any("StatefulSet", s.metadata.name)
+
+
+def validate_job(j: w.Job, is_create: bool = True) -> None:
+    errs = ErrorList()
+    validate_object_meta(j.metadata, errs)
+    if j.spec.parallelism < 0:
+        errs.add("spec.parallelism", "must be non-negative")
+    if j.spec.completions is not None and j.spec.completions < 0:
+        errs.add("spec.completions", "must be non-negative")
+    if j.spec.gang is not None:
+        g = j.spec.gang
+        if g.min_member < 0:
+            errs.add("spec.gang.min_member", "must be non-negative")
+        if g.slice_shape and any(d <= 0 for d in g.slice_shape):
+            errs.add("spec.gang.slice_shape", "dims must be positive")
+    errs.raise_if_any("Job", j.metadata.name)
+
+
+def validate_podgroup(pg: t.PodGroup, is_create: bool = True) -> None:
+    errs = ErrorList()
+    validate_object_meta(pg.metadata, errs)
+    if pg.spec.min_member < 1:
+        errs.add("spec.min_member", "must be >= 1")
+    if pg.spec.slice_shape and any(d <= 0 for d in pg.spec.slice_shape):
+        errs.add("spec.slice_shape", "dims must be positive")
+    errs.raise_if_any("PodGroup", pg.metadata.name)
+
+
+def validate_service(svc: t.Service, is_create: bool = True) -> None:
+    errs = ErrorList()
+    validate_object_meta(svc.metadata, errs)
+    for i, p in enumerate(svc.spec.ports):
+        if not (0 < p.port < 65536):
+            errs.add(f"spec.ports[{i}].port", "must be 1-65535")
+    errs.raise_if_any("Service", svc.metadata.name)
+
+
+def validate_namespace(ns: t.Namespace, is_create: bool = True) -> None:
+    errs = ErrorList()
+    validate_object_meta(ns.metadata, errs, namespaced=False)
+    errs.raise_if_any("Namespace", ns.metadata.name)
+
+
+#: kind -> (create validator, update validator or None)
+VALIDATORS = {
+    "Pod": (validate_pod, validate_pod_update),
+    "Node": (validate_node, None),
+    "ReplicaSet": (validate_replicaset, None),
+    "Deployment": (validate_deployment, None),
+    "StatefulSet": (validate_statefulset, None),
+    "Job": (validate_job, None),
+    "PodGroup": (validate_podgroup, None),
+    "Service": (validate_service, None),
+    "Namespace": (validate_namespace, None),
+}

@@ -1,0 +1,313 @@
+"""Workload API groups (apps/v1, batch/v1, autoscaling/v1).
+
+Reference: ``staging/src/k8s.io/api/{apps,batch,autoscaling}/v1`` types
+backing the controllers in ``pkg/controller/{deployment,replicaset,
+statefulset,daemon,job,cronjob,podautoscaler}``.
+
+TPU-first additions: ``JobSpec.gang`` creates a PodGroup so a
+distributed training Job is placed all-or-nothing on one contiguous
+sub-mesh (no reference analog — SURVEY.md section 2.4).
+"""
+from __future__ import annotations
+
+import datetime
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .meta import TypedObject
+from .scheme import DEFAULT_SCHEME
+from .selectors import LabelSelector
+from .types import PodTemplateSpec
+
+APPS_V1 = "apps/v1"
+BATCH_V1 = "batch/v1"
+AUTOSCALING_V1 = "autoscaling/v1"
+
+
+# ---------------------------------------------------------------------------
+# ReplicaSet / Deployment
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ReplicaSetSpec:
+    replicas: int = 1
+    min_ready_seconds: int = 0
+    selector: Optional[LabelSelector] = None
+    template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+
+
+@dataclass
+class ReplicaSetStatus:
+    replicas: int = 0
+    fully_labeled_replicas: int = 0
+    ready_replicas: int = 0
+    available_replicas: int = 0
+    observed_generation: int = 0
+
+
+@dataclass
+class ReplicaSet(TypedObject):
+    spec: ReplicaSetSpec = field(default_factory=ReplicaSetSpec)
+    status: ReplicaSetStatus = field(default_factory=ReplicaSetStatus)
+
+
+ROLLING_UPDATE = "RollingUpdate"
+RECREATE = "Recreate"
+
+
+@dataclass
+class RollingUpdateDeployment:
+    #: ints (pod counts) or strings like "25%".
+    max_unavailable: str = "25%"
+    max_surge: str = "25%"
+
+
+@dataclass
+class DeploymentStrategy:
+    type: str = ROLLING_UPDATE
+    rolling_update: RollingUpdateDeployment = field(default_factory=RollingUpdateDeployment)
+
+
+@dataclass
+class DeploymentSpec:
+    replicas: int = 1
+    selector: Optional[LabelSelector] = None
+    template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+    strategy: DeploymentStrategy = field(default_factory=DeploymentStrategy)
+    min_ready_seconds: int = 0
+    revision_history_limit: int = 10
+    paused: bool = False
+
+
+@dataclass
+class DeploymentCondition:
+    type: str = ""
+    status: str = ""
+    reason: str = ""
+    message: str = ""
+    last_transition_time: Optional[datetime.datetime] = None
+
+
+@dataclass
+class DeploymentStatus:
+    observed_generation: int = 0
+    replicas: int = 0
+    updated_replicas: int = 0
+    ready_replicas: int = 0
+    available_replicas: int = 0
+    unavailable_replicas: int = 0
+    conditions: list[DeploymentCondition] = field(default_factory=list)
+
+
+@dataclass
+class Deployment(TypedObject):
+    spec: DeploymentSpec = field(default_factory=DeploymentSpec)
+    status: DeploymentStatus = field(default_factory=DeploymentStatus)
+
+
+# ---------------------------------------------------------------------------
+# StatefulSet — ranked identity for distributed workers
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class StatefulSetSpec:
+    replicas: int = 1
+    selector: Optional[LabelSelector] = None
+    template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+    #: Headless service giving pods stable DNS names (rank identity).
+    service_name: str = ""
+    pod_management_policy: str = "OrderedReady"  # or "Parallel"
+    update_strategy: str = ROLLING_UPDATE
+
+
+@dataclass
+class StatefulSetStatus:
+    observed_generation: int = 0
+    replicas: int = 0
+    ready_replicas: int = 0
+    current_replicas: int = 0
+    updated_replicas: int = 0
+
+
+@dataclass
+class StatefulSet(TypedObject):
+    spec: StatefulSetSpec = field(default_factory=StatefulSetSpec)
+    status: StatefulSetStatus = field(default_factory=StatefulSetStatus)
+
+
+# ---------------------------------------------------------------------------
+# DaemonSet — device plugins, metrics exporters run as these
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class DaemonSetSpec:
+    selector: Optional[LabelSelector] = None
+    template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+    update_strategy: str = ROLLING_UPDATE
+    min_ready_seconds: int = 0
+
+
+@dataclass
+class DaemonSetStatus:
+    desired_number_scheduled: int = 0
+    current_number_scheduled: int = 0
+    number_misscheduled: int = 0
+    number_ready: int = 0
+    number_available: int = 0
+    observed_generation: int = 0
+
+
+@dataclass
+class DaemonSet(TypedObject):
+    spec: DaemonSetSpec = field(default_factory=DaemonSetSpec)
+    status: DaemonSetStatus = field(default_factory=DaemonSetStatus)
+
+
+# ---------------------------------------------------------------------------
+# Job / CronJob — gang-aware batch
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class GangPolicy:
+    """TPU-first: run this Job as a gang on one contiguous sub-mesh."""
+
+    #: Pods that must be co-scheduled; defaults to parallelism.
+    min_member: int = 0
+    #: Slice shape for the whole gang (chips), e.g. [4,4,4] for v5p-64.
+    slice_shape: list[int] = field(default_factory=list)
+    schedule_timeout_seconds: int = 0
+
+
+@dataclass
+class JobSpec:
+    parallelism: int = 1
+    completions: Optional[int] = None
+    active_deadline_seconds: Optional[int] = None
+    backoff_limit: int = 6
+    selector: Optional[LabelSelector] = None
+    template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+    #: Completion index env var injected per pod (stable ranks).
+    completion_mode: str = "Indexed"  # Indexed | NonIndexed
+    gang: Optional[GangPolicy] = None
+
+
+@dataclass
+class JobCondition:
+    type: str = ""  # Complete | Failed
+    status: str = ""
+    reason: str = ""
+    message: str = ""
+    last_transition_time: Optional[datetime.datetime] = None
+
+
+@dataclass
+class JobStatus:
+    active: int = 0
+    succeeded: int = 0
+    failed: int = 0
+    start_time: Optional[datetime.datetime] = None
+    completion_time: Optional[datetime.datetime] = None
+    conditions: list[JobCondition] = field(default_factory=list)
+
+
+@dataclass
+class Job(TypedObject):
+    spec: JobSpec = field(default_factory=JobSpec)
+    status: JobStatus = field(default_factory=JobStatus)
+
+
+@dataclass
+class CronJobSpec:
+    schedule: str = ""  # 5-field cron
+    suspend: bool = False
+    concurrency_policy: str = "Allow"  # Allow | Forbid | Replace
+    starting_deadline_seconds: Optional[int] = None
+    job_template: JobSpec = field(default_factory=JobSpec)
+    successful_jobs_history_limit: int = 3
+    failed_jobs_history_limit: int = 1
+
+
+@dataclass
+class CronJobStatus:
+    active: list[str] = field(default_factory=list)  # job names
+    last_schedule_time: Optional[datetime.datetime] = None
+
+
+@dataclass
+class CronJob(TypedObject):
+    spec: CronJobSpec = field(default_factory=CronJobSpec)
+    status: CronJobStatus = field(default_factory=CronJobStatus)
+
+
+# ---------------------------------------------------------------------------
+# HorizontalPodAutoscaler (reference: pkg/controller/podautoscaler)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class CrossVersionObjectReference:
+    api_version: str = ""
+    kind: str = ""
+    name: str = ""
+
+
+@dataclass
+class HorizontalPodAutoscalerSpec:
+    scale_target_ref: CrossVersionObjectReference = field(
+        default_factory=CrossVersionObjectReference
+    )
+    min_replicas: int = 1
+    max_replicas: int = 1
+    target_cpu_utilization_percentage: int = 80
+
+
+@dataclass
+class HorizontalPodAutoscalerStatus:
+    current_replicas: int = 0
+    desired_replicas: int = 0
+    current_cpu_utilization_percentage: Optional[int] = None
+    last_scale_time: Optional[datetime.datetime] = None
+
+
+@dataclass
+class HorizontalPodAutoscaler(TypedObject):
+    spec: HorizontalPodAutoscalerSpec = field(default_factory=HorizontalPodAutoscalerSpec)
+    status: HorizontalPodAutoscalerStatus = field(default_factory=HorizontalPodAutoscalerStatus)
+
+
+@dataclass
+class PodDisruptionBudgetSpec:
+    min_available: Optional[int] = None
+    max_unavailable: Optional[int] = None
+    selector: Optional[LabelSelector] = None
+
+
+@dataclass
+class PodDisruptionBudgetStatus:
+    disruptions_allowed: int = 0
+    current_healthy: int = 0
+    desired_healthy: int = 0
+    expected_pods: int = 0
+
+
+@dataclass
+class PodDisruptionBudget(TypedObject):
+    spec: PodDisruptionBudgetSpec = field(default_factory=PodDisruptionBudgetSpec)
+    status: PodDisruptionBudgetStatus = field(default_factory=PodDisruptionBudgetStatus)
+
+
+for _kind, _cls, _gv in [
+    ("ReplicaSet", ReplicaSet, APPS_V1),
+    ("Deployment", Deployment, APPS_V1),
+    ("StatefulSet", StatefulSet, APPS_V1),
+    ("DaemonSet", DaemonSet, APPS_V1),
+    ("Job", Job, BATCH_V1),
+    ("CronJob", CronJob, BATCH_V1),
+    ("HorizontalPodAutoscaler", HorizontalPodAutoscaler, AUTOSCALING_V1),
+    ("PodDisruptionBudget", PodDisruptionBudget, "policy/v1"),
+]:
+    DEFAULT_SCHEME.register(_gv, _kind, _cls)

@@ -1,0 +1,1 @@
+from .mvcc import MVCCStore, StoredObject, Watch, WatchEvent  # noqa: F401

@@ -1,0 +1,383 @@
+"""Embedded MVCC store with etcd3 semantics.
+
+The reference stores all cluster state in etcd3 through
+``staging/src/k8s.io/apiserver/pkg/storage/etcd3/store.go`` (``:152
+Create``, ``:263 GuaranteedUpdate``) and fans watches out from
+``etcd3/watcher.go:99``. There is no etcd binary in this environment, so
+this module IS the storage layer: an in-process MVCC keyspace with the
+same contract the apiserver depends on —
+
+- a single monotonically-increasing **revision** stamped on every write;
+- **create** fails if the key is live; **update/delete** take an
+  expected mod-revision and fail with Conflict when stale (the
+  optimistic-concurrency primitive under GuaranteedUpdate);
+- **list** returns a consistent snapshot + the revision it was read at;
+- **watch(prefix, from_rev)** replays history from ``from_rev``
+  (exclusive) then streams live events, in revision order, with no gap
+  between replay and live — the property informers rely on;
+- **compaction** discards history and turns stale watches into
+  GoneError (410), forcing a relist, exactly like etcd.
+
+Durability: optional write-ahead log (JSON lines) + snapshot; components
+are crash-only and resync from watch, so the WAL only needs ordering,
+not group-commit fsync batching.
+
+Concurrency: mutations take a process-wide lock (writes are tiny dict
+ops); watch delivery crosses into asyncio via ``call_soon_threadsafe``
+so the store can be driven from worker threads while informers live on
+the event loop.
+"""
+from __future__ import annotations
+
+import asyncio
+import bisect
+import json
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterable, Optional
+
+from ..api import errors
+
+ADDED = "ADDED"
+MODIFIED = "MODIFIED"
+DELETED = "DELETED"
+BOOKMARK = "BOOKMARK"
+ERROR = "ERROR"
+
+
+@dataclass
+class WatchEvent:
+    type: str = ADDED
+    key: str = ""
+    value: Optional[dict] = None
+    #: Value before this event (for DELETED consumers needing the corpse).
+    prev_value: Optional[dict] = None
+    revision: int = 0
+
+
+@dataclass
+class StoredObject:
+    key: str = ""
+    value: dict = field(default_factory=dict)
+    mod_revision: int = 0
+    create_revision: int = 0
+
+
+class Watch:
+    """One watcher: an unbounded queue bridged onto an asyncio loop.
+
+    ``cancel()`` is idempotent; after cancel the stream ends with None.
+    """
+
+    def __init__(self, store: "MVCCStore", prefix: str, loop: asyncio.AbstractEventLoop):
+        self._store = store
+        self.prefix = prefix
+        self._loop = loop
+        self._queue: asyncio.Queue[Optional[WatchEvent]] = asyncio.Queue()
+        self._cancelled = False
+
+    def _deliver(self, ev: Optional[WatchEvent]) -> None:
+        # Called with store lock held, possibly from a foreign thread.
+        self._loop.call_soon_threadsafe(self._queue.put_nowait, ev)
+
+    def cancel(self) -> None:
+        if not self._cancelled:
+            self._cancelled = True
+            self._store._remove_watch(self)
+            self._loop.call_soon_threadsafe(self._queue.put_nowait, None)
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self) -> WatchEvent:
+        ev = await self._queue.get()
+        if ev is None:
+            raise StopAsyncIteration
+        return ev
+
+    async def next(self, timeout: Optional[float] = None) -> Optional[WatchEvent]:
+        if timeout is None:
+            return await self._queue.get()
+        try:
+            return await asyncio.wait_for(self._queue.get(), timeout)
+        except asyncio.TimeoutError:
+            return None
+
+
+class MVCCStore:
+    def __init__(self, data_dir: Optional[str] = None, history_limit: int = 100_000):
+        self._lock = threading.RLock()
+        #: key -> StoredObject (live keys only).
+        self._data: dict[str, StoredObject] = {}
+        self._rev = 0
+        self._compact_rev = 0
+        #: Event history for watch replay, ascending by revision.
+        self._log: list[WatchEvent] = []
+        self._log_revs: list[int] = []
+        self._history_limit = history_limit
+        self._watches: list[Watch] = []
+        self._data_dir = data_dir
+        self._wal = None
+        if data_dir:
+            os.makedirs(data_dir, exist_ok=True)
+            self._load()
+            self._wal = open(os.path.join(data_dir, "wal.jsonl"), "a", buffering=1)
+
+    # -- persistence ------------------------------------------------------
+
+    def _load(self) -> None:
+        snap = os.path.join(self._data_dir, "snapshot.json")
+        if os.path.exists(snap):
+            with open(snap) as f:
+                state = json.load(f)
+            self._rev = state["rev"]
+            self._compact_rev = state.get("compact_rev", 0)
+            for k, v in state["data"].items():
+                self._data[k] = StoredObject(
+                    key=k, value=v["value"],
+                    mod_revision=v["mod_revision"],
+                    create_revision=v["create_revision"],
+                )
+        wal = os.path.join(self._data_dir, "wal.jsonl")
+        if os.path.exists(wal):
+            with open(wal) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except json.JSONDecodeError:
+                        break  # torn tail write — crash-consistent cutoff
+                    if rec["rev"] <= self._rev:
+                        continue
+                    self._rev = rec["rev"]
+                    key = rec["key"]
+                    if rec["op"] == DELETED:
+                        self._data.pop(key, None)
+                    else:
+                        prev = self._data.get(key)
+                        self._data[key] = StoredObject(
+                            key=key, value=rec["value"], mod_revision=rec["rev"],
+                            create_revision=prev.create_revision if prev else rec["rev"],
+                        )
+        # Event history does not survive restart: everything up to the
+        # recovered revision is effectively compacted, so watches resuming
+        # from a pre-restart revision get GoneError (410) and relist —
+        # the same contract etcd gives after compaction.
+        self._compact_rev = max(self._compact_rev, self._rev)
+
+    def snapshot(self) -> None:
+        """Write a full snapshot and truncate the WAL."""
+        if not self._data_dir:
+            return
+        with self._lock:
+            state = {
+                "rev": self._rev,
+                "compact_rev": self._compact_rev,
+                "data": {
+                    k: {"value": o.value, "mod_revision": o.mod_revision,
+                        "create_revision": o.create_revision}
+                    for k, o in self._data.items()
+                },
+            }
+            tmp = os.path.join(self._data_dir, "snapshot.json.tmp")
+            with open(tmp, "w") as f:
+                json.dump(state, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, os.path.join(self._data_dir, "snapshot.json"))
+            if self._wal:
+                self._wal.close()
+            wal_path = os.path.join(self._data_dir, "wal.jsonl")
+            open(wal_path, "w").close()
+            self._wal = open(wal_path, "a", buffering=1)
+
+    def close(self) -> None:
+        with self._lock:
+            for wch in list(self._watches):
+                wch.cancel()
+            if self._wal:
+                self._wal.close()
+                self._wal = None
+
+    # -- core mutations ---------------------------------------------------
+
+    def _append_event(self, ev: WatchEvent) -> None:
+        self._log.append(ev)
+        self._log_revs.append(ev.revision)
+        if len(self._log) > self._history_limit:
+            cut = len(self._log) - self._history_limit
+            self._compact_rev = self._log_revs[cut - 1]
+            del self._log[:cut]
+            del self._log_revs[:cut]
+        if self._wal:
+            self._wal.write(json.dumps({
+                "rev": ev.revision, "op": ev.type, "key": ev.key,
+                "value": ev.value,
+            }, separators=(",", ":")) + "\n")
+        for wch in self._watches:
+            if ev.key.startswith(wch.prefix):
+                wch._deliver(ev)
+
+    @staticmethod
+    def _freeze(value: dict) -> dict:
+        """Deep-copy on write so the store/WAL/watch-history never alias a
+        dict the caller may mutate later."""
+        return json.loads(json.dumps(value, separators=(",", ":")))
+
+    def create(self, key: str, value: dict) -> int:
+        value = self._freeze(value)
+        with self._lock:
+            if key in self._data:
+                raise errors.AlreadyExistsError(f"key {key!r} already exists")
+            self._rev += 1
+            self._data[key] = StoredObject(
+                key=key, value=value, mod_revision=self._rev, create_revision=self._rev
+            )
+            self._append_event(WatchEvent(ADDED, key, value, None, self._rev))
+            return self._rev
+
+    def get(self, key: str, copy: bool = True) -> StoredObject:
+        """Read one key. ``copy=True`` (default) deep-copies the value so
+        callers can't corrupt store state; readers that immediately decode
+        through the scheme (which copies structurally) may pass False."""
+        with self._lock:
+            obj = self._data.get(key)
+            if obj is None:
+                raise errors.NotFoundError(f"key {key!r} not found")
+            if copy:
+                return StoredObject(obj.key, self._freeze(obj.value),
+                                    obj.mod_revision, obj.create_revision)
+            return obj
+
+    def update(self, key: str, value: dict, expected_revision: Optional[int] = None) -> int:
+        value = self._freeze(value)
+        with self._lock:
+            obj = self._data.get(key)
+            if obj is None:
+                raise errors.NotFoundError(f"key {key!r} not found")
+            if expected_revision is not None and obj.mod_revision != expected_revision:
+                raise errors.ConflictError(
+                    f"key {key!r}: revision mismatch (have {obj.mod_revision}, "
+                    f"caller expected {expected_revision})"
+                )
+            self._rev += 1
+            prev = obj.value
+            self._data[key] = StoredObject(
+                key=key, value=value, mod_revision=self._rev,
+                create_revision=obj.create_revision,
+            )
+            self._append_event(WatchEvent(MODIFIED, key, value, prev, self._rev))
+            return self._rev
+
+    def delete(self, key: str, expected_revision: Optional[int] = None) -> int:
+        with self._lock:
+            obj = self._data.get(key)
+            if obj is None:
+                raise errors.NotFoundError(f"key {key!r} not found")
+            if expected_revision is not None and obj.mod_revision != expected_revision:
+                raise errors.ConflictError(
+                    f"key {key!r}: revision mismatch (have {obj.mod_revision}, "
+                    f"caller expected {expected_revision})"
+                )
+            self._rev += 1
+            del self._data[key]
+            self._append_event(WatchEvent(DELETED, key, obj.value, obj.value, self._rev))
+            return self._rev
+
+    def guaranteed_update(
+        self, key: str, fn: Callable[[Optional[dict]], Optional[dict]],
+        create_if_missing: bool = False, max_retries: int = 100,
+    ) -> tuple[dict, int]:
+        """Retry-on-conflict read-modify-write (etcd3 ``GuaranteedUpdate``,
+        ``store.go:263``). ``fn`` gets the current value (None if absent when
+        ``create_if_missing``) and returns the new value, or None to abort."""
+        for _ in range(max_retries):
+            try:
+                cur = self.get(key, copy=False)
+                base, rev = cur.value, cur.mod_revision
+            except errors.NotFoundError:
+                if not create_if_missing:
+                    raise
+                base, rev = None, None
+            new = fn(json.loads(json.dumps(base)) if base is not None else None)
+            if new is None:
+                return base, rev or 0
+            try:
+                if rev is None:
+                    return new, self.create(key, new)
+                return new, self.update(key, new, expected_revision=rev)
+            except (errors.ConflictError, errors.AlreadyExistsError):
+                continue
+        raise errors.ConflictError(f"guaranteed_update on {key!r}: too much contention")
+
+    # -- reads ------------------------------------------------------------
+
+    def list(self, prefix: str, copy: bool = True) -> tuple[list[StoredObject], int]:
+        with self._lock:
+            items = [o for k, o in self._data.items() if k.startswith(prefix)]
+            items.sort(key=lambda o: o.key)
+            if copy:
+                items = [StoredObject(o.key, self._freeze(o.value),
+                                      o.mod_revision, o.create_revision)
+                         for o in items]
+            return items, self._rev
+
+    def count(self, prefix: str) -> int:
+        with self._lock:
+            return sum(1 for k in self._data if k.startswith(prefix))
+
+    @property
+    def revision(self) -> int:
+        with self._lock:
+            return self._rev
+
+    # -- watch ------------------------------------------------------------
+
+    def watch(self, prefix: str, start_revision: int = 0,
+              loop: Optional[asyncio.AbstractEventLoop] = None) -> Watch:
+        """Stream events for keys under ``prefix`` with revision >
+        ``start_revision``. Raises GoneError if that history was compacted
+        (client must relist). ``start_revision=0`` means 'live only from
+        now' (callers normally pass the revision a LIST returned).
+
+        Must either be called on a running event loop or be given the
+        ``loop`` events should be delivered to (worker threads pass the
+        loop explicitly)."""
+        if loop is None:
+            try:
+                loop = asyncio.get_running_loop()
+            except RuntimeError:
+                raise RuntimeError(
+                    "MVCCStore.watch() called with no running event loop; "
+                    "pass loop= explicitly when watching from a worker thread"
+                ) from None
+        with self._lock:
+            if start_revision and start_revision < self._compact_rev:
+                raise errors.GoneError(
+                    f"revision {start_revision} compacted (compact_rev={self._compact_rev})"
+                )
+            wch = Watch(self, prefix, loop)
+            if start_revision:
+                idx = bisect.bisect_right(self._log_revs, start_revision)
+                for ev in self._log[idx:]:
+                    if ev.key.startswith(prefix):
+                        wch._deliver(ev)
+            self._watches.append(wch)
+            return wch
+
+    def _remove_watch(self, wch: Watch) -> None:
+        with self._lock:
+            try:
+                self._watches.remove(wch)
+            except ValueError:
+                pass
+
+    def compact(self, revision: int) -> None:
+        with self._lock:
+            idx = bisect.bisect_right(self._log_revs, revision)
+            self._compact_rev = max(self._compact_rev, revision)
+            del self._log[:idx]
+            del self._log_revs[:idx]

@@ -1,0 +1,29 @@
+"""Test-suite bootstrap.
+
+- JAX-dependent tests run on a virtual 8-device CPU mesh (multi-chip
+  TPU hardware is not available in CI; sharding is validated the way the
+  reference validates multi-node without a fleet — kubemark, SURVEY.md
+  section 4). Env is set BEFORE any jax import.
+- Coroutine test functions are run via asyncio.run (pytest-asyncio is
+  not in the image).
+"""
+import asyncio
+import inspect
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def pytest_pyfunc_call(pyfuncitem):
+    fn = pyfuncitem.obj
+    if inspect.iscoroutinefunction(fn):
+        kwargs = {k: pyfuncitem.funcargs[k] for k in pyfuncitem._fixtureinfo.argnames}
+        asyncio.run(asyncio.wait_for(fn(**kwargs), timeout=120))
+        return True
+    return None

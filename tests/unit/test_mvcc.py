@@ -1,0 +1,137 @@
+"""MVCC store contract tests (reference tier: etcd3 storage tests)."""
+import asyncio
+
+import pytest
+
+from kubernetes_tpu.api import errors
+from kubernetes_tpu.storage import MVCCStore
+from kubernetes_tpu.storage.mvcc import ADDED, DELETED, MODIFIED
+
+
+def test_create_get_conflict():
+    s = MVCCStore()
+    rev = s.create("/pods/default/a", {"x": 1})
+    assert s.get("/pods/default/a").mod_revision == rev
+    with pytest.raises(errors.AlreadyExistsError):
+        s.create("/pods/default/a", {"x": 2})
+
+
+def test_update_cas():
+    s = MVCCStore()
+    rev = s.create("/k", {"v": 1})
+    rev2 = s.update("/k", {"v": 2}, expected_revision=rev)
+    assert rev2 > rev
+    with pytest.raises(errors.ConflictError):
+        s.update("/k", {"v": 3}, expected_revision=rev)
+    assert s.get("/k").value == {"v": 2}
+
+
+def test_delete_and_not_found():
+    s = MVCCStore()
+    with pytest.raises(errors.NotFoundError):
+        s.get("/nope")
+    s.create("/k", {})
+    s.delete("/k")
+    with pytest.raises(errors.NotFoundError):
+        s.get("/k")
+
+
+def test_list_snapshot_revision():
+    s = MVCCStore()
+    s.create("/pods/ns1/a", {"n": "a"})
+    s.create("/pods/ns1/b", {"n": "b"})
+    s.create("/pods/ns2/c", {"n": "c"})
+    items, rev = s.list("/pods/ns1/")
+    assert [o.key for o in items] == ["/pods/ns1/a", "/pods/ns1/b"]
+    assert rev == s.revision
+
+
+def test_guaranteed_update_retries():
+    s = MVCCStore()
+    s.create("/k", {"count": 0})
+
+    calls = {"n": 0}
+
+    def bump(cur):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            # Interleave a conflicting write mid-transaction.
+            s.update("/k", {"count": 100})
+        cur["count"] += 1
+        return cur
+
+    val, _ = s.guaranteed_update("/k", bump)
+    assert val["count"] == 101
+    assert calls["n"] == 2
+
+
+async def test_watch_live_and_replay():
+    s = MVCCStore()
+    r1 = s.create("/pods/a", {"v": 1})
+    s.create("/other/x", {})
+    loop = asyncio.get_event_loop()
+
+    # Replay from r1: must see only later /pods events, in order.
+    s.update("/pods/a", {"v": 2})
+    s.delete("/pods/a")
+    w = s.watch("/pods/", start_revision=r1, loop=loop)
+    ev1 = await w.next(1)
+    ev2 = await w.next(1)
+    assert (ev1.type, ev1.value) == (MODIFIED, {"v": 2})
+    assert ev2.type == DELETED
+
+    # Live events arrive after replay with no gap.
+    s.create("/pods/b", {"v": 3})
+    ev3 = await w.next(1)
+    assert (ev3.type, ev3.key) == (ADDED, "/pods/b")
+    w.cancel()
+
+
+async def test_watch_compaction_gone():
+    s = MVCCStore()
+    r1 = s.create("/a", {})
+    s.update("/a", {"v": 2})
+    s.compact(s.revision)
+    with pytest.raises(errors.GoneError):
+        s.watch("/", start_revision=r1, loop=asyncio.get_event_loop())
+
+
+async def test_watch_cancel_ends_stream():
+    s = MVCCStore()
+    w = s.watch("/", loop=asyncio.get_event_loop())
+    w.cancel()
+    with pytest.raises(StopAsyncIteration):
+        await w.__anext__()
+
+
+def test_persistence_wal_and_snapshot(tmp_path):
+    d = str(tmp_path / "store")
+    s = MVCCStore(data_dir=d)
+    s.create("/pods/a", {"v": 1})
+    s.update("/pods/a", {"v": 2})
+    s.create("/pods/b", {"v": 3})
+    s.delete("/pods/b")
+    rev = s.revision
+    s.close()
+
+    s2 = MVCCStore(data_dir=d)
+    assert s2.revision == rev
+    assert s2.get("/pods/a").value == {"v": 2}
+    with pytest.raises(errors.NotFoundError):
+        s2.get("/pods/b")
+    s2.snapshot()
+    s2.create("/pods/c", {"v": 4})
+    s2.close()
+
+    s3 = MVCCStore(data_dir=d)
+    assert s3.get("/pods/c").value == {"v": 4}
+    assert s3.get("/pods/a").value == {"v": 2}
+    s3.close()
+
+
+def test_history_limit_compacts():
+    s = MVCCStore(history_limit=10)
+    for i in range(50):
+        s.create(f"/k{i}", {"i": i})
+    with pytest.raises(errors.GoneError):
+        s.watch("/", start_revision=1, loop=asyncio.new_event_loop())

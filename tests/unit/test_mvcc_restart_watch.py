@@ -1,0 +1,76 @@
+"""Regression tests for review findings on the MVCC store."""
+import asyncio
+
+import pytest
+
+from kubernetes_tpu.api import errors
+from kubernetes_tpu.storage import MVCCStore
+
+
+def test_watch_from_pre_restart_revision_is_gone(tmp_path):
+    d = str(tmp_path / "s")
+    s = MVCCStore(data_dir=d)
+    r1 = s.create("/pods/a", {"v": 1})
+    s.update("/pods/a", {"v": 2})
+    s.close()
+
+    s2 = MVCCStore(data_dir=d)
+    # History did not survive the restart; resuming from a pre-restart
+    # revision must 410 (forcing a relist), never silently skip events.
+    with pytest.raises(errors.GoneError):
+        s2.watch("/pods/", start_revision=r1, loop=asyncio.new_event_loop())
+    s2.close()
+
+
+def test_store_values_isolated_from_caller_mutation():
+    s = MVCCStore()
+    v = {"spec": {"x": 1}}
+    s.create("/k", v)
+    v["spec"]["x"] = 999  # caller mutates after write
+    assert s.get("/k").value["spec"]["x"] == 1
+
+    read = s.get("/k")
+    read.value["spec"]["x"] = 777  # reader mutates result
+    assert s.get("/k").value["spec"]["x"] == 1
+
+    items, _ = s.list("/")
+    items[0].value["spec"]["x"] = 555
+    assert s.get("/k").value["spec"]["x"] == 1
+
+
+def test_watch_without_loop_outside_loop_raises():
+    s = MVCCStore()
+    with pytest.raises(RuntimeError, match="no running event loop"):
+        s.watch("/")
+
+
+def test_pod_update_cannot_forge_assignment():
+    from kubernetes_tpu.api import types as t, validation
+    from kubernetes_tpu.api.errors import InvalidError
+    from kubernetes_tpu.api.meta import ObjectMeta
+    from kubernetes_tpu.api.scheme import deepcopy
+
+    old = t.Pod(
+        metadata=ObjectMeta(name="p", namespace="default"),
+        spec=t.PodSpec(
+            containers=[t.Container(name="c", image="i", tpu_requests=["tpu"])],
+            tpu_resources=[t.PodTpuRequest(name="tpu", chips=2)],
+        ),
+    )
+    new = deepcopy(old)
+    new.spec.tpu_resources[0].assigned = ["chip-7"]
+    with pytest.raises(InvalidError, match="binding subresource"):
+        validation.validate_pod_update(new, old)
+
+
+def test_condition_message_change_is_an_update():
+    from kubernetes_tpu.api import types as t
+
+    st = t.PodStatus()
+    c1 = t.PodCondition(type="PodScheduled", status="False", reason="Unschedulable",
+                        message="0/3 nodes free")
+    assert t.update_pod_condition(st, c1)
+    c2 = t.PodCondition(type="PodScheduled", status="False", reason="Unschedulable",
+                        message="1/3 nodes cordoned")
+    assert t.update_pod_condition(st, c2)
+    assert st.conditions[-1].message == "1/3 nodes cordoned"

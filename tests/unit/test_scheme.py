@@ -1,0 +1,108 @@
+"""Serde + scheme round-trip tests (reference tier: apimachinery unit tests)."""
+import datetime
+
+from kubernetes_tpu.api import scheme, types as t, workloads as w
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.api.selectors import LabelSelector, Requirement
+
+
+def mk_pod() -> t.Pod:
+    return t.Pod(
+        metadata=ObjectMeta(
+            name="train-0", namespace="default", uid="u1",
+            labels={"app": "llama"}, creation_timestamp=datetime.datetime(2026, 7, 29, 12, 0, 0),
+        ),
+        spec=t.PodSpec(
+            containers=[t.Container(
+                name="main", image="jax-train:latest",
+                command=["python", "train.py"],
+                resources=t.ResourceRequirements(requests={"cpu": 2.0, "memory": 4.0 * 2**30}),
+                tpu_requests=["tpu"],
+            )],
+            tpu_resources=[t.PodTpuRequest(
+                name="tpu", slice_shape=[2, 2, 1],
+                affinity=[Requirement("chip_type", "In", ["v5p"])],
+            )],
+            gang="llama-gang",
+        ),
+    )
+
+
+def test_pod_round_trip():
+    pod = mk_pod()
+    data = scheme.to_dict(pod)
+    assert data["spec"]["tpu_resources"][0]["slice_shape"] == [2, 2, 1]
+    back = scheme.from_dict(t.Pod, data)
+    assert back.spec.containers[0].resources.requests["cpu"] == 2.0
+    assert back.spec.tpu_resources[0].affinity[0].key == "chip_type"
+    assert back.metadata.creation_timestamp == pod.metadata.creation_timestamp
+    assert scheme.to_dict(back) == data
+
+
+def test_scheme_decode_by_typemeta():
+    pod = mk_pod()
+    raw = scheme.DEFAULT_SCHEME.encode(pod)
+    obj = scheme.DEFAULT_SCHEME.decode(raw)
+    assert isinstance(obj, t.Pod)
+    assert obj.kind == "Pod" and obj.api_version == "core/v1"
+    assert obj.spec.scheduler_name == "default-scheduler"  # defaulted
+
+
+def test_unknown_fields_preserved():
+    data = scheme.to_dict(mk_pod())
+    data["spec_future_field"] = {"x": 1}
+    back = scheme.from_dict(t.Pod, data)
+    assert scheme.to_dict(back)["spec_future_field"] == {"x": 1}
+
+
+def test_deepcopy_isolation():
+    pod = mk_pod()
+    cp = scheme.deepcopy(pod)
+    cp.spec.tpu_resources[0].assigned.append("chip-0")
+    assert pod.spec.tpu_resources[0].assigned == []
+
+
+def test_empty_collections_elided_but_zero_kept():
+    rs = w.ReplicaSet(metadata=ObjectMeta(name="rs"), spec=w.ReplicaSetSpec(replicas=0))
+    d = scheme.to_dict(rs)
+    assert d["spec"]["replicas"] == 0
+    assert "labels" not in d["metadata"]
+
+
+def test_quantity_parsing():
+    assert t.parse_quantity("100m") == 0.1
+    assert t.parse_quantity("2Gi") == 2 * 2**30
+    assert t.parse_quantity("1k") == 1000.0
+    assert t.parse_quantity(4) == 4.0
+
+
+def test_selector_parse_and_match():
+    from kubernetes_tpu.api.selectors import parse_selector
+
+    sel = parse_selector("app=llama,tier in (web|train),!legacy,env!=dev")
+    assert sel.matches({"app": "llama", "tier": "train", "env": "prod"})
+    assert not sel.matches({"app": "llama", "tier": "db", "env": "prod"})
+    assert not sel.matches({"app": "llama", "tier": "train", "legacy": "1"})
+    assert not sel.matches({"app": "llama", "tier": "train", "env": "dev"})
+
+
+def test_requirement_gt_lt():
+    r = Requirement("hbm_gib", "Gt", ["90"])
+    assert r.matches({"hbm_gib": "95"})
+    assert not r.matches({"hbm_gib": "16"})
+
+
+def test_pod_helpers():
+    pod = mk_pod()
+    assert t.pod_tpu_chip_count(pod) == 4
+    reqs = t.pod_resource_requests(pod)
+    assert reqs[t.RESOURCE_TPU] == 4
+    assert reqs["cpu"] == 2.0
+    assert t.is_pod_active(pod)
+
+
+def test_label_selector_semantics():
+    sel = LabelSelector(match_labels={"a": "b"})
+    assert sel.matches({"a": "b", "c": "d"})
+    assert not sel.matches({"a": "x"})
+    assert LabelSelector().matches({"anything": "goes"})

@@ -1,0 +1,96 @@
+import pytest
+
+from kubernetes_tpu.api import types as t, validation, workloads as w
+from kubernetes_tpu.api.errors import InvalidError
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.api.selectors import LabelSelector
+from kubernetes_tpu.api.types import PodTemplateSpec
+
+
+def valid_pod():
+    return t.Pod(
+        metadata=ObjectMeta(name="p", namespace="default"),
+        spec=t.PodSpec(containers=[t.Container(name="c", image="img")]),
+    )
+
+
+def test_valid_pod_passes():
+    validation.validate_pod(valid_pod())
+
+
+def test_bad_name_rejected():
+    pod = valid_pod()
+    pod.metadata.name = "Not_Valid!"
+    with pytest.raises(InvalidError):
+        validation.validate_pod(pod)
+
+
+def test_tpu_claim_reference_must_resolve():
+    pod = valid_pod()
+    pod.spec.containers[0].tpu_requests = ["missing"]
+    with pytest.raises(InvalidError) as ei:
+        validation.validate_pod(pod)
+    assert "tpu_requests" in str(ei.value)
+
+
+def test_assigned_rejected_on_create():
+    pod = valid_pod()
+    pod.spec.containers[0].tpu_requests = ["tpu"]
+    pod.spec.tpu_resources = [t.PodTpuRequest(name="tpu", chips=1, assigned=["chip-0"])]
+    with pytest.raises(InvalidError):
+        validation.validate_pod(pod)
+    pod.spec.tpu_resources[0].assigned = []
+    validation.validate_pod(pod)
+
+
+def test_duplicate_claim_names_rejected():
+    pod = valid_pod()
+    pod.spec.tpu_resources = [t.PodTpuRequest(name="a", chips=1), t.PodTpuRequest(name="a", chips=1)]
+    with pytest.raises(InvalidError):
+        validation.validate_pod(pod)
+
+
+def test_pod_update_node_name_immutable():
+    old = valid_pod()
+    old.spec.node_name = "n1"
+    new = valid_pod()
+    new.spec.node_name = "n2"
+    with pytest.raises(InvalidError):
+        validation.validate_pod_update(new, old)
+
+
+def test_node_chip_coords_rank_checked():
+    node = t.Node(metadata=ObjectMeta(name="n1"))
+    node.status.tpu = t.TpuTopology(
+        chip_type="v5p", mesh_shape=[2, 2, 1],
+        chips=[t.TpuChip(id="c0", coords=[0, 0])],
+    )
+    with pytest.raises(InvalidError):
+        validation.validate_node(node)
+    node.status.tpu.chips[0].coords = [0, 0, 0]
+    validation.validate_node(node)
+
+
+def test_replicaset_selector_must_match_template():
+    rs = w.ReplicaSet(
+        metadata=ObjectMeta(name="rs", namespace="default"),
+        spec=w.ReplicaSetSpec(
+            replicas=2,
+            selector=LabelSelector(match_labels={"app": "x"}),
+            template=PodTemplateSpec(metadata=ObjectMeta(labels={"app": "y"})),
+        ),
+    )
+    with pytest.raises(InvalidError):
+        validation.validate_replicaset(rs)
+    rs.spec.template.metadata.labels = {"app": "x"}
+    validation.validate_replicaset(rs)
+
+
+def test_podgroup_validation():
+    pg = t.PodGroup(metadata=ObjectMeta(name="g", namespace="default"))
+    pg.spec.min_member = 0
+    with pytest.raises(InvalidError):
+        validation.validate_podgroup(pg)
+    pg.spec.min_member = 4
+    pg.spec.slice_shape = [2, 2, 1]
+    validation.validate_podgroup(pg)
