@@ -678,7 +678,7 @@ class PriorityClass(TypedObject):
 @dataclass
 class LeaseSpec:
     holder_identity: str = ""
-    lease_duration_seconds: int = 15
+    lease_duration_seconds: float = 15
     acquire_time: Optional[datetime.datetime] = None
     renew_time: Optional[datetime.datetime] = None
     lease_transitions: int = 0

@@ -1,0 +1,179 @@
+"""Admission chain — mutate-then-validate hooks on every write.
+
+Reference: ``staging/src/k8s.io/apiserver/pkg/admission`` invoked from
+``endpoints/handlers/create.go:37`` plus the in-tree plugins in
+``plugin/pkg/admission/`` — notably the fork's ``resourcev2`` plugin
+(``admission.go:32-118``) which rewrites legacy count-style GPU limits
+into the per-device resource model. :class:`TpuResourceDefaulter` is
+the TPU analog of that compat shim.
+"""
+from __future__ import annotations
+
+import uuid
+from typing import TYPE_CHECKING, Optional
+
+from ..api import errors, types as t
+from ..api.meta import TypedObject
+
+if TYPE_CHECKING:
+    from .registry import Registry, ResourceSpec
+
+
+class AdmissionPlugin:
+    name = "plugin"
+
+    def admit(self, op: str, spec: "ResourceSpec", obj: TypedObject,
+              old: Optional[TypedObject]) -> TypedObject:
+        """Mutate phase: return the (possibly modified) object."""
+        return obj
+
+    def validate(self, op: str, spec: "ResourceSpec", obj: TypedObject,
+                 old: Optional[TypedObject]) -> None:
+        """Validate phase: raise to reject."""
+
+
+class AdmissionChain:
+    def __init__(self, plugins: Optional[list[AdmissionPlugin]] = None):
+        self.plugins = plugins or []
+
+    def admit(self, op: str, spec: "ResourceSpec", obj: TypedObject,
+              old: Optional[TypedObject]) -> TypedObject:
+        for p in self.plugins:
+            obj = p.admit(op, spec, obj, old)
+        for p in self.plugins:
+            p.validate(op, spec, obj, old)
+        return obj
+
+
+class TpuResourceDefaulter(AdmissionPlugin):
+    """Rewrite count-style ``google.com/tpu`` container limits into a
+    named :class:`~kubernetes_tpu.api.types.PodTpuRequest` + container
+    reference, deleting the raw limit.
+
+    Reference: ``plugin/pkg/admission/resourcev2/admission.go:51-118``
+    (``Admit`` + ``newExtendedResource``) — same old->new compat shim,
+    UUID-suffixed claim name and all.
+    """
+
+    name = "TpuResourceDefaulter"
+
+    def admit(self, op, spec, obj, old):
+        if spec.kind != "Pod" or op != "CREATE":
+            return obj
+        pod: t.Pod = obj
+        for c in pod.spec.containers:
+            n = c.resources.limits.pop(t.RESOURCE_TPU, None) or \
+                c.resources.requests.pop(t.RESOURCE_TPU, None)
+            if not n:
+                continue
+            claim_name = f"tpu-{uuid.uuid4().hex[:8]}"
+            pod.spec.tpu_resources.append(
+                t.PodTpuRequest(name=claim_name, chips=int(n)))
+            c.tpu_requests.append(claim_name)
+            c.resources.limits.pop(t.RESOURCE_TPU, None)
+            c.resources.requests.pop(t.RESOURCE_TPU, None)
+        return pod
+
+
+class NamespaceLifecycle(AdmissionPlugin):
+    """Reject creates in missing or terminating namespaces; auto-create
+    the default namespace. Reference: ``plugin/pkg/admission/namespace``."""
+
+    name = "NamespaceLifecycle"
+    _EXEMPT = {"Namespace", "Node", "PriorityClass", "Lease", "Event"}
+
+    def __init__(self, registry: "Registry"):
+        self.registry = registry
+
+    def validate(self, op, spec, obj, old):
+        if op != "CREATE" or spec.kind in self._EXEMPT or not spec.namespaced:
+            return
+        ns_name = obj.metadata.namespace
+        try:
+            ns = self.registry.get("namespaces", "", ns_name)
+        except errors.NotFoundError:
+            if ns_name == "default":
+                self.registry.create(t.Namespace(
+                    metadata=t.ObjectMeta(name="default")))  # type: ignore[attr-defined]
+                return
+            raise errors.ForbiddenError(f"namespace {ns_name!r} not found") from None
+        if ns.status.phase == t.NS_TERMINATING or ns.metadata.deletion_timestamp:
+            raise errors.ForbiddenError(
+                f"namespace {ns_name!r} is terminating; cannot create {spec.kind}")
+
+
+class PriorityResolver(AdmissionPlugin):
+    """Resolve priority_class_name -> numeric priority at admission.
+
+    Reference: priority admission in the scheduler ecosystem; pods carry
+    resolved ``spec.priority`` so the scheduler never does lookups.
+    """
+
+    name = "PriorityResolver"
+
+    def __init__(self, registry: "Registry"):
+        self.registry = registry
+
+    def admit(self, op, spec, obj, old):
+        if spec.kind != "Pod" or op != "CREATE":
+            return obj
+        pod: t.Pod = obj
+        if pod.spec.priority_class_name and pod.spec.priority is None:
+            try:
+                pc = self.registry.get("priorityclasses", "", pod.spec.priority_class_name)
+                pod.spec.priority = pc.value
+            except errors.NotFoundError:
+                raise errors.BadRequestError(
+                    f"priority class {pod.spec.priority_class_name!r} not found") from None
+        if pod.spec.priority is None:
+            pod.spec.priority = 0
+        return pod
+
+
+class ResourceQuotaPlugin(AdmissionPlugin):
+    """Enforce per-namespace hard quotas on create.
+
+    Reference: ``plugin/pkg/admission/resourcequota`` + ``pkg/quota``.
+    Counts pods, TPU chips, cpu/memory requests against every quota in
+    the namespace and rejects if any limit would be exceeded.
+    """
+
+    name = "ResourceQuota"
+
+    def __init__(self, registry: "Registry"):
+        self.registry = registry
+
+    def validate(self, op, spec, obj, old):
+        if spec.kind != "Pod" or op != "CREATE":
+            return
+        pod: t.Pod = obj
+        ns = pod.metadata.namespace
+        quotas, _ = self.registry.list("resourcequotas", ns)
+        if not quotas:
+            return
+        want = t.pod_resource_requests(pod)
+        pods, _ = self.registry.list("pods", ns)
+        used: dict[str, float] = {}
+        for p in pods:
+            if not t.is_pod_active(p):
+                continue
+            for k, v in t.pod_resource_requests(p).items():
+                used[k] = used.get(k, 0.0) + v
+        for q in quotas:
+            for res, hard in q.spec.hard.items():
+                if res not in want:
+                    continue
+                if used.get(res, 0.0) + want[res] > t.parse_quantity(hard):
+                    raise errors.ForbiddenError(
+                        f"exceeded quota {q.metadata.name!r}: requested "
+                        f"{res}={want[res]:g}, used {used.get(res, 0.0):g}, "
+                        f"hard limit {t.parse_quantity(hard):g}")
+
+
+def default_chain(registry: "Registry") -> AdmissionChain:
+    return AdmissionChain([
+        NamespaceLifecycle(registry),
+        TpuResourceDefaulter(),
+        PriorityResolver(registry),
+        ResourceQuotaPlugin(registry),
+    ])

@@ -1,0 +1,485 @@
+"""Generic registry — the per-resource REST strategy layer over storage.
+
+Reference: ``staging/src/k8s.io/apiserver/pkg/registry/generic/registry/
+store.go`` (``:308 Create``) + per-resource strategies in
+``pkg/registry/<group>/<kind>/strategy.go``. One CRUD template runs all
+kinds; per-kind behavior (namespacing, status subresource, validation,
+field extraction for field selectors, graceful deletion) comes from a
+:class:`ResourceSpec`.
+
+The pods/binding subresource reproduces the fork's key atomicity trick:
+node name AND concrete chip assignments land in ONE guaranteed update
+(``pkg/registry/core/pod/storage/storage.go:130-210
+setPodHostAndAnnotations``) so there is no window where a pod is bound
+but deviceless.
+"""
+from __future__ import annotations
+
+import asyncio
+import datetime
+from dataclasses import dataclass, field as dc_field
+from typing import Any, Callable, Optional
+
+from ..api import errors, types as t, validation as val, workloads as w
+from ..api.meta import ObjectMeta, TypedObject, now, stamp_new
+from ..api.scheme import DEFAULT_SCHEME, Scheme, from_dict, to_dict
+from ..api.selectors import match_field_selector, parse_selector
+from ..storage.mvcc import ADDED, DELETED, MODIFIED, MVCCStore, Watch, WatchEvent
+
+
+@dataclass
+class ResourceSpec:
+    plural: str
+    kind: str
+    api_version: str
+    cls: type
+    namespaced: bool = True
+    #: Status handled as a subresource: normal updates keep old status,
+    #: /status updates keep old spec (reference strategy pattern).
+    has_status: bool = True
+    validate_create: Optional[Callable] = None
+    validate_update: Optional[Callable] = None
+    #: Extract flat fields for field-selector matching.
+    field_extractor: Optional[Callable[[Any], dict]] = None
+    #: Graceful deletion (pods): DELETE sets deletion_timestamp first.
+    graceful_delete: bool = False
+
+
+def _pod_fields(pod: t.Pod) -> dict:
+    return {
+        "metadata.name": pod.metadata.name,
+        "metadata.namespace": pod.metadata.namespace,
+        "spec.node_name": pod.spec.node_name,
+        "spec.scheduler_name": pod.spec.scheduler_name,
+        "status.phase": pod.status.phase,
+    }
+
+
+def _node_fields(node: t.Node) -> dict:
+    return {"metadata.name": node.metadata.name,
+            "spec.unschedulable": str(node.spec.unschedulable).lower()}
+
+
+def _event_fields(ev: t.Event) -> dict:
+    return {
+        "metadata.name": ev.metadata.name,
+        "involved_object.kind": ev.involved_object.kind,
+        "involved_object.name": ev.involved_object.name,
+        "reason": ev.reason,
+        "type": ev.type,
+    }
+
+
+def builtin_resources() -> list[ResourceSpec]:
+    """The framework's API surface (reference: pkg/master/master.go
+    InstallLegacyAPI/InstallAPIs resource table)."""
+    core = "core/v1"
+    return [
+        ResourceSpec("pods", "Pod", core, t.Pod, field_extractor=_pod_fields,
+                     validate_create=val.validate_pod,
+                     validate_update=val.validate_pod_update, graceful_delete=True),
+        ResourceSpec("nodes", "Node", core, t.Node, namespaced=False,
+                     field_extractor=_node_fields, validate_create=val.validate_node),
+        ResourceSpec("services", "Service", core, t.Service,
+                     validate_create=val.validate_service),
+        ResourceSpec("endpoints", "Endpoints", core, t.Endpoints, has_status=False),
+        ResourceSpec("namespaces", "Namespace", core, t.Namespace, namespaced=False,
+                     validate_create=val.validate_namespace),
+        ResourceSpec("configmaps", "ConfigMap", core, t.ConfigMap, has_status=False),
+        ResourceSpec("secrets", "Secret", core, t.Secret, has_status=False),
+        ResourceSpec("events", "Event", core, t.Event, has_status=False,
+                     field_extractor=_event_fields),
+        ResourceSpec("resourcequotas", "ResourceQuota", core, t.ResourceQuota),
+        ResourceSpec("limitranges", "LimitRange", core, t.LimitRange, has_status=False),
+        ResourceSpec("priorityclasses", "PriorityClass", core, t.PriorityClass,
+                     namespaced=False, has_status=False),
+        ResourceSpec("leases", "Lease", core, t.Lease, has_status=False),
+        ResourceSpec("podgroups", "PodGroup", core, t.PodGroup,
+                     validate_create=val.validate_podgroup),
+        ResourceSpec("replicasets", "ReplicaSet", "apps/v1", w.ReplicaSet,
+                     validate_create=val.validate_replicaset),
+        ResourceSpec("deployments", "Deployment", "apps/v1", w.Deployment,
+                     validate_create=val.validate_deployment),
+        ResourceSpec("statefulsets", "StatefulSet", "apps/v1", w.StatefulSet,
+                     validate_create=val.validate_statefulset),
+        ResourceSpec("daemonsets", "DaemonSet", "apps/v1", w.DaemonSet),
+        ResourceSpec("jobs", "Job", "batch/v1", w.Job, validate_create=val.validate_job),
+        ResourceSpec("cronjobs", "CronJob", "batch/v1", w.CronJob),
+        ResourceSpec("horizontalpodautoscalers", "HorizontalPodAutoscaler",
+                     "autoscaling/v1", w.HorizontalPodAutoscaler),
+        ResourceSpec("poddisruptionbudgets", "PodDisruptionBudget", "policy/v1",
+                     w.PodDisruptionBudget),
+    ]
+
+
+class Registry:
+    """CRUD over the MVCC store for every registered resource."""
+
+    def __init__(self, store: Optional[MVCCStore] = None,
+                 scheme: Scheme = DEFAULT_SCHEME,
+                 admission: Optional["AdmissionChain"] = None):
+        self.store = store or MVCCStore()
+        self.scheme = scheme
+        self.admission = admission
+        self._by_plural: dict[str, ResourceSpec] = {}
+        self._by_kind: dict[str, ResourceSpec] = {}
+        for spec in builtin_resources():
+            self.add_resource(spec)
+
+    def add_resource(self, spec: ResourceSpec) -> None:
+        self._by_plural[spec.plural] = spec
+        self._by_kind[spec.kind] = spec
+
+    def spec_for(self, plural: str) -> ResourceSpec:
+        try:
+            return self._by_plural[plural]
+        except KeyError:
+            raise errors.NotFoundError(f"unknown resource type {plural!r}") from None
+
+    def spec_for_kind(self, kind: str) -> ResourceSpec:
+        try:
+            return self._by_kind[kind]
+        except KeyError:
+            raise errors.NotFoundError(f"unknown kind {kind!r}") from None
+
+    # -- keys -------------------------------------------------------------
+
+    def _key(self, spec: ResourceSpec, namespace: str, name: str) -> str:
+        if spec.namespaced:
+            if not namespace:
+                raise errors.BadRequestError(f"{spec.plural} is namespaced; namespace required")
+            return f"/registry/{spec.plural}/{namespace}/{name}"
+        return f"/registry/{spec.plural}/{name}"
+
+    def _prefix(self, spec: ResourceSpec, namespace: str = "") -> str:
+        if spec.namespaced and namespace:
+            return f"/registry/{spec.plural}/{namespace}/"
+        return f"/registry/{spec.plural}/"
+
+    # -- codec ------------------------------------------------------------
+
+    def _decode(self, spec: ResourceSpec, value: dict, rev: int) -> TypedObject:
+        obj = from_dict(spec.cls, value)
+        obj.api_version, obj.kind = spec.api_version, spec.kind
+        obj.metadata.resource_version = str(rev)
+        return obj
+
+    def _encode(self, obj: TypedObject) -> dict:
+        d = to_dict(obj)
+        # resource_version is store-owned; never persist it inside the value.
+        d.get("metadata", {}).pop("resource_version", None)
+        return d
+
+    # -- CRUD -------------------------------------------------------------
+
+    def create(self, obj: TypedObject, dry_run: bool = False) -> TypedObject:
+        spec = self.spec_for_kind(type(obj).__name__ if not obj.kind else obj.kind)
+        obj = self.scheme.default(obj)
+        meta = obj.metadata
+        if spec.namespaced and not meta.namespace:
+            meta.namespace = "default"
+        if not spec.namespaced:
+            meta.namespace = ""
+        stamp_new(meta)
+        meta.generation = 1
+        if spec.has_status and hasattr(obj, "status"):
+            # Strategy PrepareForCreate: clients cannot seed status.
+            obj.status = type(obj.status)()
+        if self.admission is not None:
+            obj = self.admission.admit("CREATE", spec, obj, None)
+        if spec.validate_create:
+            spec.validate_create(obj)
+        if dry_run:
+            return obj
+        key = self._key(spec, meta.namespace, meta.name)
+        rev = self.store.create(key, self._encode(obj))
+        meta.resource_version = str(rev)
+        return obj
+
+    def get(self, plural: str, namespace: str, name: str) -> TypedObject:
+        spec = self.spec_for(plural)
+        stored = self.store.get(self._key(spec, namespace, name), copy=False)
+        return self._decode(spec, stored.value, stored.mod_revision)
+
+    def list(self, plural: str, namespace: str = "", label_selector: str = "",
+             field_selector: str = "") -> tuple[list[TypedObject], int]:
+        spec = self.spec_for(plural)
+        stored, rev = self.store.list(self._prefix(spec, namespace), copy=False)
+        sel = parse_selector(label_selector) if label_selector else None
+        if field_selector and not spec.field_extractor:
+            raise errors.BadRequestError(
+                f"{spec.plural} does not support field selectors")
+        out = []
+        for s in stored:
+            obj = self._decode(spec, s.value, s.mod_revision)
+            if sel and not sel.matches(obj.metadata.labels):
+                continue
+            if field_selector and not match_field_selector(
+                    field_selector, spec.field_extractor(obj)):
+                continue
+            out.append(obj)
+        return out, rev
+
+    def update(self, obj: TypedObject, subresource: str = "") -> TypedObject:
+        """Full-object update with optimistic concurrency.
+
+        ``subresource=''``: spec/meta update, status preserved from old.
+        ``subresource='status'``: status update, spec/meta preserved.
+        """
+        spec = self.spec_for_kind(obj.kind or type(obj).__name__)
+        meta = obj.metadata
+        key = self._key(spec, meta.namespace, meta.name)
+        stored = self.store.get(key, copy=False)
+        old = self._decode(spec, stored.value, stored.mod_revision)
+        if meta.resource_version and meta.resource_version != old.metadata.resource_version:
+            raise errors.ConflictError(
+                f"{spec.kind} {obj.key()!r}: stale resource_version "
+                f"{meta.resource_version} (current {old.metadata.resource_version})"
+            )
+        new = obj
+        if spec.has_status and hasattr(obj, "status"):
+            if subresource == "status":
+                full = from_dict(spec.cls, self._encode(old))
+                full.status = obj.status
+                full.metadata = old.metadata
+                new = full
+            else:
+                new.status = old.status
+        if subresource != "status":
+            # Immutable server-owned fields.
+            new.metadata.uid = old.metadata.uid
+            new.metadata.creation_timestamp = old.metadata.creation_timestamp
+            if self._spec_changed(spec, new, old):
+                new.metadata.generation = old.metadata.generation + 1
+            else:
+                new.metadata.generation = old.metadata.generation
+            if self.admission is not None:
+                new = self.admission.admit("UPDATE", spec, new, old)
+            if spec.validate_update:
+                spec.validate_update(new, old)
+            elif spec.validate_create:
+                spec.validate_create(new, False)
+        new.api_version, new.kind = spec.api_version, spec.kind
+        # Finalizer-driven actual deletion: once an object marked for
+        # deletion has no finalizers left, the update removes it.
+        if new.metadata.deletion_timestamp is not None and not new.metadata.finalizers:
+            self.store.delete(key, expected_revision=stored.mod_revision)
+            new.metadata.resource_version = str(self.store.revision)
+            return new
+        rev = self.store.update(key, self._encode(new),
+                                expected_revision=stored.mod_revision)
+        new.metadata.resource_version = str(rev)
+        return new
+
+    def _spec_changed(self, spec: ResourceSpec, new: TypedObject, old: TypedObject) -> bool:
+        if not hasattr(new, "spec"):
+            return False
+        return to_dict(new.spec) != to_dict(old.spec)
+
+    def patch(self, plural: str, namespace: str, name: str, patch: dict,
+              subresource: str = "") -> TypedObject:
+        """JSON merge-patch (RFC 7386), the CLI/controller-friendly verb."""
+        spec = self.spec_for(plural)
+
+        def apply_merge(base: Any, p: Any) -> Any:
+            if not isinstance(p, dict):
+                return p
+            if not isinstance(base, dict):
+                base = {}
+            out = dict(base)
+            for k, v in p.items():
+                if v is None:
+                    out.pop(k, None)
+                else:
+                    out[k] = apply_merge(out.get(k), v)
+            return out
+
+        for _ in range(10):
+            cur = self.get(plural, namespace, name)
+            merged = apply_merge(self._encode(cur), patch)
+            obj = from_dict(spec.cls, merged)
+            obj.api_version, obj.kind = spec.api_version, spec.kind
+            obj.metadata.resource_version = cur.metadata.resource_version
+            try:
+                return self.update(obj, subresource=subresource)
+            except errors.ConflictError:
+                continue
+        raise errors.ConflictError(f"patch {plural}/{namespace}/{name}: too much contention")
+
+    def delete(self, plural: str, namespace: str, name: str,
+               grace_period_seconds: Optional[int] = None,
+               preconditions_uid: str = "") -> TypedObject:
+        spec = self.spec_for(plural)
+        key = self._key(spec, namespace, name)
+        stored = self.store.get(key, copy=False)
+        obj = self._decode(spec, stored.value, stored.mod_revision)
+        if preconditions_uid and obj.metadata.uid != preconditions_uid:
+            raise errors.ConflictError(
+                f"uid precondition failed: have {obj.metadata.uid}, want {preconditions_uid}")
+        graceful = spec.graceful_delete and (grace_period_seconds is None or grace_period_seconds > 0)
+        if obj.metadata.deletion_timestamp is None and (graceful or obj.metadata.finalizers):
+            # First DELETE: mark, don't remove (kubelet / finalizer owners
+            # complete the deletion). Reference: graceful pod termination.
+            obj.metadata.deletion_timestamp = now()
+            if spec.graceful_delete and isinstance(obj, t.Pod):
+                gp = grace_period_seconds
+                if gp is None:
+                    gp = obj.spec.termination_grace_period_seconds
+                obj.spec.termination_grace_period_seconds = gp
+            rev = self.store.update(key, self._encode(obj),
+                                    expected_revision=stored.mod_revision)
+            obj.metadata.resource_version = str(rev)
+            return obj
+        if obj.metadata.finalizers:
+            # Already terminating but finalizers present: no-op.
+            return obj
+        if (obj.metadata.deletion_timestamp is not None and graceful):
+            # Repeated graceful DELETE on an already-terminating pod is an
+            # idempotent no-op; only an explicit grace 0 (the node agent's
+            # confirmation) completes removal — reference semantics.
+            return obj
+        self.store.delete(key, expected_revision=stored.mod_revision)
+        return obj
+
+    def delete_collection(self, plural: str, namespace: str = "",
+                          label_selector: str = "") -> int:
+        items, _ = self.list(plural, namespace, label_selector)
+        n = 0
+        for obj in items:
+            try:
+                self.delete(plural, obj.metadata.namespace, obj.metadata.name,
+                            grace_period_seconds=0)
+                n += 1
+            except errors.NotFoundError:
+                pass
+        return n
+
+    # -- watch ------------------------------------------------------------
+
+    def watch(self, plural: str, namespace: str = "", start_revision: int = 0,
+              label_selector: str = "", field_selector: str = "",
+              loop: Optional[asyncio.AbstractEventLoop] = None) -> "ObjectWatch":
+        spec = self.spec_for(plural)
+        raw = self.store.watch(self._prefix(spec, namespace), start_revision, loop=loop)
+        return ObjectWatch(self, spec, raw, label_selector, field_selector)
+
+    # -- pods/binding subresource ----------------------------------------
+
+    def bind_pod(self, namespace: str, name: str, binding: t.Binding) -> t.Pod:
+        """Atomically set node_name + chip assignments + PodScheduled.
+
+        Reference: ``BindingREST.Create`` -> ``setPodHostAndAnnotations``
+        (``pkg/registry/core/pod/storage/storage.go:138-197``): one
+        GuaranteedUpdate writes host and device IDs together.
+        """
+        spec = self.spec_for("pods")
+        key = self._key(spec, namespace, name)
+        target = binding.target
+
+        def apply(cur: Optional[dict]) -> dict:
+            pod = from_dict(t.Pod, cur)
+            if pod.metadata.deletion_timestamp is not None:
+                raise errors.ConflictError(f"pod {namespace}/{name} is terminating")
+            if pod.spec.node_name and pod.spec.node_name != target.node_name:
+                raise errors.ConflictError(
+                    f"pod {namespace}/{name} already bound to {pod.spec.node_name}")
+            pod.spec.node_name = target.node_name
+            by_name = {b.name: b for b in target.tpu_bindings}
+            for claim in pod.spec.tpu_resources:
+                b = by_name.pop(claim.name, None)
+                if b is not None:
+                    claim.assigned = list(b.chip_ids)
+            if by_name:
+                raise errors.BadRequestError(
+                    f"binding names {sorted(by_name)} match no tpu_resources claim")
+            missing = [c.name for c in pod.spec.tpu_resources if not c.assigned]
+            if missing:
+                raise errors.BadRequestError(
+                    f"binding must assign chips for claims {missing}")
+            t.update_pod_condition(pod.status, t.PodCondition(
+                type=t.COND_POD_SCHEDULED, status="True"))
+            d = to_dict(pod)
+            d.get("metadata", {}).pop("resource_version", None)
+            return d
+
+        value, rev = self.store.guaranteed_update(key, apply)
+        return self._decode(spec, value, rev)
+
+
+class ObjectWatch:
+    """Decoded, selector-filtered watch stream.
+
+    Label-selector transitions are translated the way the reference's
+    watch cache does: an object entering the selected set surfaces as
+    ADDED, leaving it as DELETED.
+    """
+
+    #: Event type surfaced when the underlying stream ends (consumer must
+    #: reconnect/relist). Distinct from a ``None`` idle-timeout return.
+    CLOSED = "CLOSED"
+
+    def __init__(self, registry: Registry, spec: ResourceSpec, raw: Watch,
+                 label_selector: str = "", field_selector: str = ""):
+        self._registry = registry
+        self._spec = spec
+        self._raw = raw
+        self._sel = parse_selector(label_selector) if label_selector else None
+        if field_selector and not spec.field_extractor:
+            raw.cancel()
+            raise errors.BadRequestError(
+                f"{spec.plural} does not support field selectors")
+        self._fsel = field_selector
+
+    def cancel(self) -> None:
+        self._raw.cancel()
+
+    @property
+    def closed(self) -> bool:
+        return self._raw.closed
+
+    def _match(self, obj: Optional[TypedObject]) -> bool:
+        if obj is None:
+            return False
+        if self._sel and not self._sel.matches(obj.metadata.labels):
+            return False
+        if self._fsel and not match_field_selector(
+                self._fsel, self._spec.field_extractor(obj)):
+            return False
+        return True
+
+    async def next(self, timeout: Optional[float] = None):
+        while True:
+            ev = await self._raw.next(timeout)
+            if ev is None:
+                if self._raw.closed:
+                    return (self.CLOSED, None)
+                return None
+            out = self._translate(ev)
+            if out is not None:
+                return out
+
+    def _translate(self, ev: WatchEvent):
+        obj = self._registry._decode(self._spec, ev.value, ev.revision)
+        old = (self._registry._decode(self._spec, ev.prev_value, ev.revision)
+               if ev.prev_value is not None else None)
+        old_match = self._match(old)
+        if ev.type == DELETED:
+            return (DELETED, obj) if old_match else None
+        if self._match(obj):
+            return (ADDED if (ev.type == ADDED or not old_match) else MODIFIED, obj)
+        if old_match:  # left the selected set
+            return (DELETED, old)
+        return None
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self):
+        ev = await self.next()
+        if ev is None:
+            raise StopAsyncIteration
+        return ev
+
+
+# Imported late to avoid a cycle (admission imports registry types).
+from .admission import AdmissionChain  # noqa: E402,F401

@@ -1,0 +1,302 @@
+"""HTTP API server — REST + watch endpoint over the registry.
+
+Reference: the generic apiserver handler chain
+(``staging/src/k8s.io/apiserver/pkg/server/config.go:530
+DefaultBuildHandlerChain``: authn -> audit -> ... -> authz) and route
+installation (``endpoints/installer.go:196 registerResourceHandlers``).
+
+URL scheme (group folded into the path like the reference's /apis):
+
+- ``/api/<group>/<version>/<plural>``                      cluster list/create
+- ``/api/<group>/<version>/namespaces/<ns>/<plural>``      namespaced list/create
+- ``.../<plural>/<name>``                                  get/put/patch/delete
+- ``.../<plural>/<name>/status``                           status subresource
+- ``.../pods/<name>/binding``                              scheduler binding
+- ``?watch=1&resource_version=N``                          JSON-lines stream
+- ``/healthz``, ``/readyz``, ``/version``, ``/metrics``, ``/apis`` discovery
+
+Watch responses are chunked ``{"type": ..., "object": ...}`` lines —
+the transport informers consume. A server-side bookmark keeps idle
+streams alive (and lets clients resume precisely).
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Optional
+
+from aiohttp import web
+
+from ..api import errors
+from ..api.scheme import to_dict
+from ..metrics.registry import REGISTRY as METRICS, Histogram
+from .admission import default_chain
+from .registry import Registry
+
+log = logging.getLogger("apiserver")
+
+REQUEST_LATENCY = Histogram(
+    "apiserver_request_latency_seconds",
+    "API request latency by verb and resource",
+    labels=("verb", "resource"),
+    buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5),
+)
+
+
+class APIServer:
+    def __init__(self, registry: Optional[Registry] = None,
+                 tokens: Optional[dict[str, str]] = None):
+        """``tokens``: bearer token -> username; None disables authn
+        (local/dev mode, like the reference's insecure port)."""
+        self.registry = registry or Registry()
+        if self.registry.admission is None:
+            self.registry.admission = default_chain(self.registry)
+        self.tokens = tokens
+        self.app = web.Application(middlewares=[self._middleware])
+        self._routes()
+        self._runner: Optional[web.AppRunner] = None
+        self.port: Optional[int] = None
+
+    # -- handler chain ----------------------------------------------------
+
+    @web.middleware
+    async def _middleware(self, request: web.Request, handler):
+        # authn -> authz -> handler -> error mapping (reference:
+        # DefaultBuildHandlerChain, compressed).
+        if self.tokens is not None and not request.path.startswith(("/healthz", "/readyz", "/version")):
+            auth = request.headers.get("Authorization", "")
+            token = auth[7:] if auth.startswith("Bearer ") else ""
+            user = self.tokens.get(token)
+            if user is None:
+                return self._err(errors.UnauthorizedError("invalid or missing bearer token"))
+            request["user"] = user
+        import time
+        start = time.perf_counter()
+        try:
+            resp = await handler(request)
+            return resp
+        except errors.StatusError as e:
+            return self._err(e)
+        except web.HTTPException:
+            raise
+        except Exception as e:  # noqa: BLE001
+            log.exception("handler panic on %s %s", request.method, request.path)
+            return self._err(errors.StatusError(f"internal error: {e}"))
+        finally:
+            plural = request.match_info.get("plural", "-")
+            REQUEST_LATENCY.observe(time.perf_counter() - start,
+                                    verb=request.method, resource=plural)
+
+    @staticmethod
+    def _err(e: errors.StatusError) -> web.Response:
+        return web.json_response(e.to_dict(), status=e.code)
+
+    @staticmethod
+    def _obj_response(obj, status: int = 200) -> web.Response:
+        return web.json_response(to_dict(obj), status=status)
+
+    # -- routes -----------------------------------------------------------
+
+    def _routes(self) -> None:
+        r = self.app.router
+        r.add_get("/healthz", self._healthz)
+        r.add_get("/readyz", self._healthz)
+        r.add_get("/version", self._version)
+        r.add_get("/metrics", self._metrics)
+        r.add_get("/apis", self._discovery)
+        base = "/api/{group}/{version}"
+        for prefix in (base + "/namespaces/{namespace}/{plural}", base + "/{plural}"):
+            r.add_get(prefix, self._list_or_watch)
+            r.add_post(prefix, self._create)
+            r.add_delete(prefix, self._delete_collection)
+            r.add_get(prefix + "/{name}", self._get)
+            r.add_put(prefix + "/{name}", self._update)
+            r.add_patch(prefix + "/{name}", self._patch)
+            r.add_delete(prefix + "/{name}", self._delete)
+            r.add_put(prefix + "/{name}/{subresource}", self._update)
+            r.add_patch(prefix + "/{name}/{subresource}", self._patch)
+            r.add_post(prefix + "/{name}/{subresource}", self._subresource_post)
+
+    async def _healthz(self, request):
+        return web.Response(text="ok")
+
+    async def _version(self, request):
+        from .. import __version__
+        return web.json_response({"version": __version__, "platform": "tpu"})
+
+    async def _metrics(self, request):
+        return web.Response(text=METRICS.render(), content_type="text/plain")
+
+    async def _discovery(self, request):
+        out = []
+        for spec in self.registry._by_plural.values():
+            out.append({
+                "name": spec.plural, "kind": spec.kind,
+                "api_version": spec.api_version, "namespaced": spec.namespaced,
+            })
+        return web.json_response({"resources": out})
+
+    # -- helpers ----------------------------------------------------------
+
+    def _ctx(self, request) -> tuple[str, str]:
+        plural = request.match_info["plural"]
+        ns = request.match_info.get("namespace", "")
+        return plural, ns
+
+    async def _body_obj(self, request):
+        raw = await request.read()
+        try:
+            data = json.loads(raw)
+        except json.JSONDecodeError as e:
+            raise errors.BadRequestError(f"invalid JSON body: {e}") from None
+        return data
+
+    # -- verb handlers ----------------------------------------------------
+
+    async def _create(self, request):
+        plural, ns = self._ctx(request)
+        spec = self.registry.spec_for(plural)
+        data = await self._body_obj(request)
+        data.setdefault("api_version", spec.api_version)
+        data.setdefault("kind", spec.kind)
+        obj = self.registry.scheme.decode(data)
+        if ns:
+            obj.metadata.namespace = ns
+        created = await asyncio.to_thread(self.registry.create, obj)
+        return self._obj_response(created, status=201)
+
+    async def _get(self, request):
+        plural, ns = self._ctx(request)
+        obj = self.registry.get(plural, ns, request.match_info["name"])
+        return self._obj_response(obj)
+
+    async def _list_or_watch(self, request):
+        plural, ns = self._ctx(request)
+        q = request.query
+        if q.get("watch") in ("1", "true"):
+            return await self._watch(request, plural, ns)
+        items, rev = self.registry.list(
+            plural, ns, q.get("label_selector", ""), q.get("field_selector", ""))
+        return web.json_response({
+            "kind": "List", "api_version": "core/v1",
+            "metadata": {"resource_version": str(rev)},
+            "items": [to_dict(o) for o in items],
+        })
+
+    @staticmethod
+    def _int_param(value, name: str) -> int:
+        try:
+            return int(value)
+        except (TypeError, ValueError):
+            raise errors.BadRequestError(
+                f"query parameter {name!r} must be an integer, got {value!r}") from None
+
+    async def _watch(self, request, plural: str, ns: str):
+        q = request.query
+        start_rev = self._int_param(q.get("resource_version", "0") or "0",
+                                    "resource_version")
+        try:
+            watch = self.registry.watch(
+                plural, ns, start_rev,
+                q.get("label_selector", ""), q.get("field_selector", ""))
+        except errors.GoneError as e:
+            return self._err(e)
+        resp = web.StreamResponse()
+        resp.content_type = "application/json"
+        resp.headers["Transfer-Encoding"] = "chunked"
+        await resp.prepare(request)
+        try:
+            while True:
+                ev = await watch.next(timeout=10.0)
+                if ev is None:
+                    # Bookmark keeps the connection alive and advances the
+                    # client's resume point (reference: watch bookmarks).
+                    line = json.dumps({
+                        "type": "BOOKMARK",
+                        "object": {"metadata": {"resource_version": str(self.registry.store.revision)}},
+                    })
+                else:
+                    etype, obj = ev
+                    if etype == "CLOSED":
+                        break
+                    line = json.dumps({"type": etype, "object": to_dict(obj)})
+                await resp.write(line.encode() + b"\n")
+        except (ConnectionResetError, asyncio.CancelledError):
+            pass
+        finally:
+            watch.cancel()
+        return resp
+
+    async def _update(self, request):
+        plural, ns = self._ctx(request)
+        spec = self.registry.spec_for(plural)
+        sub = request.match_info.get("subresource", "")
+        if sub == "binding":
+            return await self._subresource_post(request)
+        if sub not in ("", "status"):
+            raise errors.BadRequestError(f"unknown subresource {sub!r}")
+        data = await self._body_obj(request)
+        data.setdefault("api_version", spec.api_version)
+        data.setdefault("kind", spec.kind)
+        obj = self.registry.scheme.decode(data)
+        obj.metadata.namespace = ns or obj.metadata.namespace
+        obj.metadata.name = request.match_info["name"]
+        updated = await asyncio.to_thread(self.registry.update, obj, sub)
+        return self._obj_response(updated)
+
+    async def _patch(self, request):
+        plural, ns = self._ctx(request)
+        sub = request.match_info.get("subresource", "")
+        patch = await self._body_obj(request)
+        updated = await asyncio.to_thread(
+            self.registry.patch, plural, ns, request.match_info["name"], patch, sub)
+        return self._obj_response(updated)
+
+    async def _delete(self, request):
+        plural, ns = self._ctx(request)
+        gp = request.query.get("grace_period_seconds")
+        obj = await asyncio.to_thread(
+            self.registry.delete, plural, ns, request.match_info["name"],
+            self._int_param(gp, "grace_period_seconds") if gp is not None else None,
+            request.query.get("uid", ""))
+        return self._obj_response(obj)
+
+    async def _delete_collection(self, request):
+        plural, ns = self._ctx(request)
+        n = await asyncio.to_thread(
+            self.registry.delete_collection, plural, ns,
+            request.query.get("label_selector", ""))
+        return web.json_response({"deleted": n})
+
+    async def _subresource_post(self, request):
+        plural, ns = self._ctx(request)
+        sub = request.match_info.get("subresource", "")
+        if plural == "pods" and sub == "binding":
+            data = await self._body_obj(request)
+            from ..api.scheme import from_dict
+            from ..api.types import Binding
+            binding = from_dict(Binding, data)
+            pod = await asyncio.to_thread(
+                self.registry.bind_pod, ns, request.match_info["name"], binding)
+            return self._obj_response(pod, status=201)
+        raise errors.BadRequestError(f"unsupported subresource {plural}/{sub}")
+
+    # -- lifecycle --------------------------------------------------------
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        self._runner = web.AppRunner(self.app, access_log=None)
+        await self._runner.setup()
+        # Short shutdown grace: long-lived watch streams would otherwise
+        # hold cleanup for the default 60s (they are safely cancellable —
+        # clients relist on reconnect).
+        site = web.TCPSite(self._runner, host, port, shutdown_timeout=1.0)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]  # type: ignore[union-attr]
+        log.info("apiserver listening on %s:%d", host, self.port)
+        return self.port
+
+    async def stop(self) -> None:
+        if self._runner:
+            await self._runner.cleanup()
+            self._runner = None

@@ -1,0 +1,3 @@
+from .interface import Client  # noqa: F401
+from .local import LocalClient  # noqa: F401
+from .rest import RESTClient  # noqa: F401

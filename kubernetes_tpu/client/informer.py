@@ -1,0 +1,298 @@
+"""Shared informers — the watch-cache every controller reads from.
+
+Reference: client-go ``tools/cache``: ``Reflector.ListAndWatch``
+(``reflector.go:239``), DeltaFIFO, shared informer + thread-safe store
+with indexers. The contract reproduced here:
+
+- LIST at revision R, then WATCH from R — no missed events, no gap;
+- on watch failure or a 410 Gone (compaction), relist and *diff* the
+  new state against the cache, synthesizing ADDED/MODIFIED/DELETED so
+  handlers never observe a discontinuity (``replace`` semantics);
+- handlers are notified after the cache is updated, so a handler
+  reading the lister sees at-least-as-new state;
+- optional periodic resync re-delivers the whole cache as updates
+  (level-triggered controllers depend on this to self-heal).
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+from typing import Any, Callable, Optional
+
+from ..api import errors
+from .interface import Client
+
+log = logging.getLogger("informer")
+
+ADDED = "ADDED"
+MODIFIED = "MODIFIED"
+DELETED = "DELETED"
+BOOKMARK = "BOOKMARK"
+CLOSED = "CLOSED"
+
+
+def _key(obj: Any) -> str:
+    return obj.key()
+
+
+class Indexer:
+    """Thread-unsafe (single-loop) keyed store with secondary indexes."""
+
+    def __init__(self, indexers: Optional[dict[str, Callable[[Any], list[str]]]] = None):
+        self._items: dict[str, Any] = {}
+        self._indexers = dict(indexers or {})
+        self._indexes: dict[str, dict[str, set[str]]] = {n: {} for n in self._indexers}
+
+    def add_indexer(self, name: str, fn: Callable[[Any], list[str]]) -> None:
+        """Register a new index, back-filling it over existing items (lets
+        late controllers add indexes to a shared, already-running informer)."""
+        if name in self._indexers:
+            return
+        self._indexers[name] = fn
+        idx: dict[str, set[str]] = {}
+        for key, obj in self._items.items():
+            for v in fn(obj):
+                idx.setdefault(v, set()).add(key)
+        self._indexes[name] = idx
+
+    def _update_index(self, key: str, old: Any, new: Any) -> None:
+        for name, fn in self._indexers.items():
+            idx = self._indexes[name]
+            if old is not None:
+                for v in fn(old):
+                    bucket = idx.get(v)
+                    if bucket:
+                        bucket.discard(key)
+                        if not bucket:
+                            del idx[v]
+            if new is not None:
+                for v in fn(new):
+                    idx.setdefault(v, set()).add(key)
+
+    def upsert(self, obj: Any) -> Optional[Any]:
+        key = _key(obj)
+        old = self._items.get(key)
+        self._items[key] = obj
+        self._update_index(key, old, obj)
+        return old
+
+    def remove(self, obj_or_key) -> Optional[Any]:
+        key = obj_or_key if isinstance(obj_or_key, str) else _key(obj_or_key)
+        old = self._items.pop(key, None)
+        if old is not None:
+            self._update_index(key, old, None)
+        return old
+
+    def get(self, key: str) -> Optional[Any]:
+        return self._items.get(key)
+
+    def list(self) -> list[Any]:
+        return list(self._items.values())
+
+    def keys(self) -> list[str]:
+        return list(self._items.keys())
+
+    def by_index(self, index_name: str, value: str) -> list[Any]:
+        keys = self._indexes.get(index_name, {}).get(value, ())
+        return [self._items[k] for k in keys]
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+
+class SharedInformer:
+    def __init__(self, client: Client, plural: str, namespace: str = "",
+                 label_selector: str = "", field_selector: str = "",
+                 resync_period: float = 0.0,
+                 indexers: Optional[dict[str, Callable[[Any], list[str]]]] = None):
+        self.client = client
+        self.plural = plural
+        self.namespace = namespace
+        self.label_selector = label_selector
+        self.field_selector = field_selector
+        self.resync_period = resync_period
+        self.store = Indexer(indexers)
+        self._handlers: list[tuple[Callable, Callable, Callable]] = []
+        self._synced = asyncio.Event()
+        self._stopped = False
+        self._task: Optional[asyncio.Task] = None
+        self.last_sync_resource_version = 0
+
+    # -- wiring -----------------------------------------------------------
+
+    def add_handlers(self, on_add: Optional[Callable] = None,
+                     on_update: Optional[Callable] = None,
+                     on_delete: Optional[Callable] = None) -> None:
+        noop = lambda *a: None  # noqa: E731
+        self._handlers.append((on_add or noop, on_update or noop, on_delete or noop))
+
+    @property
+    def has_synced(self) -> bool:
+        return self._synced.is_set()
+
+    async def wait_for_sync(self, timeout: float = 30.0) -> None:
+        await asyncio.wait_for(self._synced.wait(), timeout)
+
+    def start(self) -> "SharedInformer":
+        self._task = asyncio.get_running_loop().create_task(self.run())
+        return self
+
+    async def stop(self) -> None:
+        self._stopped = True
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+
+    # -- reflector --------------------------------------------------------
+
+    async def run(self) -> None:
+        backoff = 0.05
+        while not self._stopped:
+            try:
+                await self._list_and_watch()
+                backoff = 0.05
+            except asyncio.CancelledError:
+                raise
+            except errors.GoneError:
+                log.info("informer(%s): watch revision compacted; relisting", self.plural)
+                continue
+            except Exception as e:  # noqa: BLE001
+                log.warning("informer(%s): ListAndWatch failed: %s", self.plural, e)
+                await asyncio.sleep(backoff + random.random() * backoff)
+                backoff = min(backoff * 2, 5.0)
+
+    async def _list_and_watch(self) -> None:
+        items, rev = await self.client.list(
+            self.plural, self.namespace, self.label_selector, self.field_selector)
+        self._replace(items)
+        self.last_sync_resource_version = rev
+        self._synced.set()
+
+        watch = await self.client.watch(
+            self.plural, self.namespace, rev, self.label_selector, self.field_selector)
+        resync_deadline = (asyncio.get_running_loop().time() + self.resync_period
+                           if self.resync_period else None)
+        try:
+            while not self._stopped:
+                timeout = 1.0
+                ev = await watch.next(timeout=timeout)
+                if resync_deadline and asyncio.get_running_loop().time() >= resync_deadline:
+                    self._resync()
+                    resync_deadline = asyncio.get_running_loop().time() + self.resync_period
+                if ev is None:
+                    continue
+                etype, obj = ev
+                if etype == CLOSED:
+                    # Stream ended (server restart / connection drop):
+                    # surface to run() so it relists and reconnects.
+                    raise ConnectionResetError(
+                        f"watch stream for {self.plural} closed")
+                if etype == BOOKMARK:
+                    rv = obj.get("metadata", {}).get("resource_version") if isinstance(obj, dict) else None
+                    if rv:
+                        self.last_sync_resource_version = int(rv)
+                    continue
+                self._apply(etype, obj)
+        finally:
+            watch.cancel()
+
+    def _replace(self, items: list) -> None:
+        """Replace cache contents, synthesizing deltas for handlers."""
+        new_keys = {_key(o) for o in items}
+        for key in self.store.keys():
+            if key not in new_keys:
+                old = self.store.remove(key)
+                if old is not None:
+                    self._notify(DELETED, old, None)
+        for obj in items:
+            old = self.store.upsert(obj)
+            if old is None:
+                self._notify(ADDED, None, obj)
+            elif old.metadata.resource_version != obj.metadata.resource_version:
+                self._notify(MODIFIED, old, obj)
+
+    def _apply(self, etype: str, obj: Any) -> None:
+        if etype == DELETED:
+            old = self.store.remove(obj)
+            self._notify(DELETED, old or obj, None)
+            return
+        old = self.store.upsert(obj)
+        try:
+            self.last_sync_resource_version = int(obj.metadata.resource_version)
+        except (TypeError, ValueError):
+            pass
+        if etype == ADDED and old is None:
+            self._notify(ADDED, None, obj)
+        else:
+            self._notify(MODIFIED, old, obj)
+
+    def _resync(self) -> None:
+        for obj in self.store.list():
+            self._notify(MODIFIED, obj, obj)
+
+    def _notify(self, etype: str, old: Any, new: Any) -> None:
+        for on_add, on_update, on_delete in self._handlers:
+            try:
+                if etype == ADDED:
+                    on_add(new)
+                elif etype == MODIFIED:
+                    on_update(old, new)
+                else:
+                    on_delete(old)
+            except Exception:  # noqa: BLE001
+                log.exception("informer(%s): handler error", self.plural)
+
+    # -- lister -----------------------------------------------------------
+
+    def get(self, key: str) -> Optional[Any]:
+        return self.store.get(key)
+
+    def list(self) -> list[Any]:
+        return self.store.list()
+
+
+class InformerFactory:
+    """One informer per resource shared by all controllers (reference:
+    SharedInformerFactory in controller-manager wiring)."""
+
+    def __init__(self, client: Client, namespace: str = ""):
+        self.client = client
+        self.namespace = namespace
+        self._informers: dict[str, SharedInformer] = {}
+
+    def informer(self, plural: str,
+                 indexers: Optional[dict[str, Callable]] = None,
+                 resync_period: float = 0.0) -> SharedInformer:
+        inf = self._informers.get(plural)
+        if inf is None:
+            inf = SharedInformer(self.client, plural, self.namespace,
+                                 resync_period=resync_period, indexers=indexers)
+            self._informers[plural] = inf
+        elif indexers:
+            # Late registrations merge into the shared informer's store
+            # (back-filled), rather than being silently dropped.
+            for name, fn in indexers.items():
+                inf.store.add_indexer(name, fn)
+        return inf
+
+    def start_all(self) -> None:
+        for inf in self._informers.values():
+            if inf._task is None:
+                inf.start()
+
+    async def wait_for_sync(self, timeout: float = 30.0) -> None:
+        for inf in self._informers.values():
+            await inf.wait_for_sync(timeout)
+
+    async def stop_all(self) -> None:
+        for inf in self._informers.values():
+            await inf.stop()
+
+
+#: Common indexer: pods by spec.node_name (scheduler + node controllers).
+def pods_by_node(pod) -> list[str]:
+    return [pod.spec.node_name] if pod.spec.node_name else []

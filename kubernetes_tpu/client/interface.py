@@ -1,0 +1,74 @@
+"""Client interface — what every component programs against.
+
+Reference: client-go's typed clientsets + REST client
+(``staging/src/k8s.io/client-go``). Two implementations:
+
+- :class:`~kubernetes_tpu.client.local.LocalClient` — direct registry
+  calls, used in integration tests and the single-binary control plane
+  (the reference's in-process master in
+  ``test/integration/framework/master_utils.go:290``).
+- :class:`~kubernetes_tpu.client.rest.RESTClient` — HTTP to a remote
+  apiserver, used by node agents / CLI / separate-process components.
+
+All methods are async so both implementations compose with informers.
+"""
+from __future__ import annotations
+
+from typing import Any, AsyncIterator, Optional
+
+from ..api.types import Binding
+
+
+class WatchStream:
+    """Async iterator of (event_type, object) tuples; must be cancelled."""
+
+    def cancel(self) -> None:
+        raise NotImplementedError
+
+    async def next(self, timeout: Optional[float] = None):
+        raise NotImplementedError
+
+    def __aiter__(self) -> AsyncIterator:
+        return self
+
+    async def __anext__(self):
+        ev = await self.next()
+        if ev is None:
+            raise StopAsyncIteration
+        return ev
+
+
+class Client:
+    async def create(self, obj: Any) -> Any:
+        raise NotImplementedError
+
+    async def get(self, plural: str, namespace: str, name: str) -> Any:
+        raise NotImplementedError
+
+    async def list(self, plural: str, namespace: str = "", label_selector: str = "",
+                   field_selector: str = "") -> tuple[list, int]:
+        raise NotImplementedError
+
+    async def update(self, obj: Any, subresource: str = "") -> Any:
+        raise NotImplementedError
+
+    async def update_status(self, obj: Any) -> Any:
+        return await self.update(obj, subresource="status")
+
+    async def patch(self, plural: str, namespace: str, name: str, patch: dict,
+                    subresource: str = "") -> Any:
+        raise NotImplementedError
+
+    async def delete(self, plural: str, namespace: str, name: str,
+                     grace_period_seconds: Optional[int] = None, uid: str = "") -> Any:
+        raise NotImplementedError
+
+    async def watch(self, plural: str, namespace: str = "", resource_version: int = 0,
+                    label_selector: str = "", field_selector: str = "") -> WatchStream:
+        raise NotImplementedError
+
+    async def bind(self, namespace: str, name: str, binding: Binding) -> Any:
+        raise NotImplementedError
+
+    async def close(self) -> None:
+        pass

@@ -1,0 +1,107 @@
+"""Lease-based leader election.
+
+Reference: ``staging/src/k8s.io/client-go/tools/leaderelection/
+leaderelection.go:70 Run, :138 renew loop`` — HA control-plane
+components (scheduler, controller-manager) elect one active instance by
+CAS-ing a Lease object; losing the lease stops the callbacks.
+"""
+from __future__ import annotations
+
+import asyncio
+import datetime
+import logging
+from typing import Awaitable, Callable, Optional
+
+from ..api import errors
+from ..api.meta import ObjectMeta, now
+from ..api.types import Lease, LeaseSpec
+from .interface import Client
+
+log = logging.getLogger("leaderelection")
+
+
+class LeaderElector:
+    def __init__(self, client: Client, name: str, identity: str,
+                 namespace: str = "kube-system",
+                 lease_duration: float = 15.0, renew_deadline: float = 10.0,
+                 retry_period: float = 2.0):
+        self.client = client
+        self.name = name
+        self.identity = identity
+        self.namespace = namespace
+        self.lease_duration = lease_duration
+        self.renew_deadline = renew_deadline
+        self.retry_period = retry_period
+        self.is_leader = False
+
+    async def run(self, on_started_leading: Callable[[], Awaitable[None]],
+                  on_stopped_leading: Optional[Callable[[], None]] = None) -> None:
+        """Acquire, then run the payload while renewing; if renewal fails
+        the payload is cancelled (crash-only handoff)."""
+        while True:
+            await self._acquire()
+            self.is_leader = True
+            log.info("%s: %s became leader", self.name, self.identity)
+            payload = asyncio.get_running_loop().create_task(on_started_leading())
+            try:
+                await self._renew_loop()
+            finally:
+                self.is_leader = False
+                payload.cancel()
+                try:
+                    await payload
+                except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                    pass
+                if on_stopped_leading:
+                    on_stopped_leading()
+                log.warning("%s: %s lost leadership", self.name, self.identity)
+
+    async def _acquire(self) -> None:
+        while True:
+            if await self._try_acquire_or_renew():
+                return
+            await asyncio.sleep(self.retry_period)
+
+    async def _renew_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.retry_period)
+            deadline = asyncio.get_running_loop().time() + self.renew_deadline
+            ok = False
+            while asyncio.get_running_loop().time() < deadline:
+                try:
+                    ok = await self._try_acquire_or_renew()
+                    break
+                except Exception:  # noqa: BLE001
+                    await asyncio.sleep(self.retry_period / 4)
+            if not ok:
+                return  # lost it
+
+    async def _try_acquire_or_renew(self) -> bool:
+        try:
+            lease = await self.client.get("leases", self.namespace, self.name)
+        except errors.NotFoundError:
+            lease = Lease(metadata=ObjectMeta(name=self.name, namespace=self.namespace),
+                          spec=LeaseSpec(holder_identity=self.identity,
+                                         lease_duration_seconds=self.lease_duration,
+                                         acquire_time=now(), renew_time=now()))
+            try:
+                await self.client.create(lease)
+                return True
+            except errors.AlreadyExistsError:
+                return False
+        spec = lease.spec
+        if spec.holder_identity and spec.holder_identity != self.identity:
+            expired = (spec.renew_time is None or
+                       (now() - spec.renew_time).total_seconds() > spec.lease_duration_seconds)
+            if not expired:
+                return False
+            spec.lease_transitions += 1
+            spec.acquire_time = now()
+        spec.holder_identity = self.identity
+        spec.renew_time = now()
+        spec.lease_duration_seconds = self.lease_duration
+        try:
+            await self.client.update(lease)
+            return True
+        except (errors.ConflictError, errors.NotFoundError):
+            return False

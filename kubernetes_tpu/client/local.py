@@ -1,0 +1,65 @@
+"""In-process client: direct registry calls.
+
+Used by the single-binary control plane and the integration test tier
+(reference: controllers/scheduler tested against an in-proc master,
+``test/integration/framework/master_utils.go:290-305``). Registry calls
+are quick dict operations; blocking ones are pushed to a thread to keep
+the event loop responsive under load.
+"""
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Optional
+
+from ..api.types import Binding
+from ..apiserver.registry import ObjectWatch, Registry
+from .interface import Client, WatchStream
+
+
+class _LocalWatch(WatchStream):
+    def __init__(self, ow: ObjectWatch):
+        self._ow = ow
+
+    def cancel(self) -> None:
+        self._ow.cancel()
+
+    async def next(self, timeout: Optional[float] = None):
+        return await self._ow.next(timeout)
+
+
+class LocalClient(Client):
+    def __init__(self, registry: Registry):
+        self.registry = registry
+
+    async def create(self, obj: Any) -> Any:
+        return await asyncio.to_thread(self.registry.create, obj)
+
+    async def get(self, plural: str, namespace: str, name: str) -> Any:
+        return self.registry.get(plural, namespace, name)
+
+    async def list(self, plural: str, namespace: str = "", label_selector: str = "",
+                   field_selector: str = "") -> tuple[list, int]:
+        return await asyncio.to_thread(
+            self.registry.list, plural, namespace, label_selector, field_selector)
+
+    async def update(self, obj: Any, subresource: str = "") -> Any:
+        return await asyncio.to_thread(self.registry.update, obj, subresource)
+
+    async def patch(self, plural: str, namespace: str, name: str, patch: dict,
+                    subresource: str = "") -> Any:
+        return await asyncio.to_thread(
+            self.registry.patch, plural, namespace, name, patch, subresource)
+
+    async def delete(self, plural: str, namespace: str, name: str,
+                     grace_period_seconds: Optional[int] = None, uid: str = "") -> Any:
+        return await asyncio.to_thread(
+            self.registry.delete, plural, namespace, name, grace_period_seconds, uid)
+
+    async def watch(self, plural: str, namespace: str = "", resource_version: int = 0,
+                    label_selector: str = "", field_selector: str = "") -> WatchStream:
+        ow = self.registry.watch(plural, namespace, resource_version,
+                                 label_selector, field_selector)
+        return _LocalWatch(ow)
+
+    async def bind(self, namespace: str, name: str, binding: Binding) -> Any:
+        return await asyncio.to_thread(self.registry.bind_pod, namespace, name, binding)

@@ -1,0 +1,68 @@
+"""Event recorder — the 'kubectl describe' breadcrumb trail.
+
+Reference: ``staging/src/k8s.io/client-go/tools/record`` (e.g.
+FailedScheduling events posted at ``plugin/pkg/scheduler/scheduler.go:433``).
+Repeated identical events are aggregated by bumping ``count`` instead of
+flooding the store.
+"""
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import logging
+from typing import Any
+
+from ..api import errors
+from ..api.meta import ObjectMeta, now
+from ..api.scheme import DEFAULT_SCHEME
+from ..api.types import Event, EventSource, ObjectReference
+from .interface import Client
+
+log = logging.getLogger("events")
+
+
+class EventRecorder:
+    def __init__(self, client: Client, component: str, host: str = ""):
+        self.client = client
+        self.source = EventSource(component=component, host=host)
+
+    def _ref(self, obj: Any) -> ObjectReference:
+        try:
+            av, kind = DEFAULT_SCHEME.gvk_for(obj)
+        except KeyError:
+            av, kind = obj.api_version, obj.kind
+        return ObjectReference(api_version=av, kind=kind,
+                               namespace=obj.metadata.namespace,
+                               name=obj.metadata.name, uid=obj.metadata.uid)
+
+    def event(self, obj: Any, event_type: str, reason: str, message: str) -> None:
+        """Fire-and-forget (never let event failures break controllers)."""
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            return
+        loop.create_task(self._emit(obj, event_type, reason, message))
+
+    async def _emit(self, obj: Any, event_type: str, reason: str, message: str) -> None:
+        try:
+            ref = self._ref(obj)
+            # Stable name per (object, reason, message) for aggregation.
+            sig = hashlib.sha1(
+                f"{ref.uid}/{reason}/{message}".encode()).hexdigest()[:10]
+            name = f"{ref.name}.{sig}"
+            ns = ref.namespace or "default"
+            try:
+                ev = await self.client.get("events", ns, name)
+                ev.count += 1
+                ev.last_timestamp = now()
+                await self.client.update(ev)
+            except errors.NotFoundError:
+                ev = Event(
+                    metadata=ObjectMeta(name=name, namespace=ns),
+                    involved_object=ref, reason=reason, message=message,
+                    type=event_type, count=1, source=self.source,
+                    first_timestamp=now(), last_timestamp=now(),
+                )
+                await self.client.create(ev)
+        except Exception as e:  # noqa: BLE001
+            log.debug("event emit failed: %s", e)

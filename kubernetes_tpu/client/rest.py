@@ -1,0 +1,227 @@
+"""HTTP REST client over aiohttp.
+
+Reference: client-go ``rest/`` (request builder, error mapping) — the
+transport every out-of-process component (node agent, CLI, kubemark
+hollow nodes) uses to reach the apiserver. Watches consume the server's
+chunked JSON-lines stream, surfacing BOOKMARK events so reflectors can
+advance their resume revision without traffic.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Any, Optional
+
+import aiohttp
+
+from ..api import errors
+from ..api.scheme import DEFAULT_SCHEME, to_dict
+from ..api.types import Binding
+from .interface import Client, WatchStream
+
+BOOKMARK = "BOOKMARK"
+CLOSED = "CLOSED"
+
+
+def _resource_tables() -> tuple[dict, dict]:
+    from ..apiserver.registry import builtin_resources
+    by_plural: dict[str, tuple[str, bool]] = {}
+    by_kind: dict[str, str] = {}
+    for spec in builtin_resources():
+        by_plural[spec.plural] = (spec.api_version, spec.namespaced)
+        by_kind[spec.kind] = spec.plural
+    return by_plural, by_kind
+
+
+_BY_PLURAL, _BY_KIND = _resource_tables()
+
+
+class _RESTWatch(WatchStream):
+    def __init__(self, session: aiohttp.ClientSession, url: str, params: dict):
+        self._session = session
+        self._url = url
+        self._params = params
+        self._resp: Optional[aiohttp.ClientResponse] = None
+        self._task: Optional[asyncio.Task] = None
+        self._queue: asyncio.Queue = asyncio.Queue()
+        self._closed = False
+        #: True once the server stream has ended (consumer must reconnect).
+        self.closed = False
+
+    async def _run(self) -> None:
+        try:
+            async with self._session.get(self._url, params=self._params,
+                                         timeout=aiohttp.ClientTimeout(total=None)) as resp:
+                if resp.status != 200:
+                    body = await resp.json()
+                    await self._queue.put(("ERROR", errors.StatusError.from_dict(body)))
+                    return
+                self._resp = resp
+                async for line in resp.content:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    msg = json.loads(line)
+                    if msg["type"] == BOOKMARK:
+                        await self._queue.put((BOOKMARK, msg["object"]))
+                        continue
+                    obj = DEFAULT_SCHEME.decode(msg["object"])
+                    await self._queue.put((msg["type"], obj))
+        except (aiohttp.ClientError, asyncio.CancelledError, ConnectionResetError):
+            pass
+        finally:
+            await self._queue.put(None)
+
+    def start(self) -> "_RESTWatch":
+        self._task = asyncio.get_running_loop().create_task(self._run())
+        return self
+
+    def cancel(self) -> None:
+        if not self._closed:
+            self._closed = True
+            if self._task:
+                self._task.cancel()
+
+    async def next(self, timeout: Optional[float] = None):
+        """None on idle timeout; ("CLOSED", None) when the stream ended."""
+        if self.closed:
+            return (CLOSED, None)
+        if timeout is None:
+            ev = await self._queue.get()
+        else:
+            try:
+                ev = await asyncio.wait_for(self._queue.get(), timeout)
+            except asyncio.TimeoutError:
+                return None
+        if ev is None:
+            self.closed = True
+            return (CLOSED, None)
+        if ev[0] == "ERROR":
+            raise ev[1]
+        return ev
+
+
+class RESTClient(Client):
+    def __init__(self, base_url: str, token: str = ""):
+        self.base_url = base_url.rstrip("/")
+        self._headers = {"Authorization": f"Bearer {token}"} if token else {}
+        self._session: Optional[aiohttp.ClientSession] = None
+
+    def _sess(self) -> aiohttp.ClientSession:
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession(headers=self._headers)
+        return self._session
+
+    def _url_for(self, api_version: str, plural: str, namespace: str,
+                 name: str = "", subresource: str = "") -> str:
+        parts = [self.base_url, "api", api_version]
+        if namespace:
+            parts += ["namespaces", namespace]
+        parts.append(plural)
+        if name:
+            parts.append(name)
+        if subresource:
+            parts.append(subresource)
+        return "/".join(parts)
+
+    def _plural_info(self, plural: str) -> tuple[str, bool]:
+        # Static mirror of the server's resource table (avoids discovery RTT).
+        try:
+            return _BY_PLURAL[plural]
+        except KeyError:
+            raise errors.NotFoundError(f"unknown resource type {plural!r}") from None
+
+    async def _check(self, resp: aiohttp.ClientResponse) -> Any:
+        if resp.status >= 400:
+            try:
+                body = await resp.json()
+            except Exception:  # noqa: BLE001
+                raise errors.StatusError(f"HTTP {resp.status}") from None
+            raise errors.StatusError.from_dict(body)
+        return await resp.json()
+
+    async def create(self, obj: Any) -> Any:
+        gvk = DEFAULT_SCHEME.gvk_for(obj)
+        plural = self._plural_for_kind(gvk[1])
+        url = self._url_for(gvk[0], plural, obj.metadata.namespace)
+        async with self._sess().post(url, json=to_dict(obj)) as resp:
+            data = await self._check(resp)
+        return DEFAULT_SCHEME.decode(data)
+
+    def _plural_for_kind(self, kind: str) -> str:
+        try:
+            return _BY_KIND[kind]
+        except KeyError:
+            raise errors.NotFoundError(f"unknown kind {kind!r}") from None
+
+    async def get(self, plural: str, namespace: str, name: str) -> Any:
+        av, namespaced = self._plural_info(plural)
+        url = self._url_for(av, plural, namespace if namespaced else "", name)
+        async with self._sess().get(url) as resp:
+            data = await self._check(resp)
+        return DEFAULT_SCHEME.decode(data)
+
+    async def list(self, plural: str, namespace: str = "", label_selector: str = "",
+                   field_selector: str = "") -> tuple[list, int]:
+        av, namespaced = self._plural_info(plural)
+        url = self._url_for(av, plural, namespace if namespaced else "")
+        params = {}
+        if label_selector:
+            params["label_selector"] = label_selector
+        if field_selector:
+            params["field_selector"] = field_selector
+        async with self._sess().get(url, params=params) as resp:
+            data = await self._check(resp)
+        items = [DEFAULT_SCHEME.decode(i) for i in data["items"]]
+        return items, int(data["metadata"]["resource_version"])
+
+    async def update(self, obj: Any, subresource: str = "") -> Any:
+        gvk = DEFAULT_SCHEME.gvk_for(obj)
+        plural = self._plural_for_kind(gvk[1])
+        url = self._url_for(gvk[0], plural, obj.metadata.namespace,
+                            obj.metadata.name, subresource)
+        async with self._sess().put(url, json=to_dict(obj)) as resp:
+            data = await self._check(resp)
+        return DEFAULT_SCHEME.decode(data)
+
+    async def patch(self, plural: str, namespace: str, name: str, patch: dict,
+                    subresource: str = "") -> Any:
+        av, namespaced = self._plural_info(plural)
+        url = self._url_for(av, plural, namespace if namespaced else "", name, subresource)
+        async with self._sess().patch(url, json=patch) as resp:
+            data = await self._check(resp)
+        return DEFAULT_SCHEME.decode(data)
+
+    async def delete(self, plural: str, namespace: str, name: str,
+                     grace_period_seconds: Optional[int] = None, uid: str = "") -> Any:
+        av, namespaced = self._plural_info(plural)
+        url = self._url_for(av, plural, namespace if namespaced else "", name)
+        params = {}
+        if grace_period_seconds is not None:
+            params["grace_period_seconds"] = str(grace_period_seconds)
+        if uid:
+            params["uid"] = uid
+        async with self._sess().delete(url, params=params) as resp:
+            data = await self._check(resp)
+        return DEFAULT_SCHEME.decode(data)
+
+    async def watch(self, plural: str, namespace: str = "", resource_version: int = 0,
+                    label_selector: str = "", field_selector: str = "") -> WatchStream:
+        av, namespaced = self._plural_info(plural)
+        url = self._url_for(av, plural, namespace if namespaced else "")
+        params = {"watch": "1", "resource_version": str(resource_version)}
+        if label_selector:
+            params["label_selector"] = label_selector
+        if field_selector:
+            params["field_selector"] = field_selector
+        return _RESTWatch(self._sess(), url, params).start()
+
+    async def bind(self, namespace: str, name: str, binding: Binding) -> Any:
+        url = self._url_for("core/v1", "pods", namespace, name, "binding")
+        async with self._sess().post(url, json=to_dict(binding)) as resp:
+            data = await self._check(resp)
+        return DEFAULT_SCHEME.decode(data)
+
+    async def close(self) -> None:
+        if self._session and not self._session.closed:
+            await self._session.close()

@@ -1,0 +1,127 @@
+"""Rate-limited work queues — the controller backpressure primitive.
+
+Reference: ``staging/src/k8s.io/client-go/util/workqueue`` (+
+``apimachinery/pkg/util/workqueue`` consumer types): dedup while
+queued, in-flight tracking with re-add coalescing, per-item exponential
+backoff (5ms base, 1000s cap — the reference's DefaultControllerRateLimiter),
+and delayed adds for requeue-after patterns.
+"""
+from __future__ import annotations
+
+import asyncio
+import heapq
+from typing import Any, Hashable, Optional
+
+
+class WorkQueue:
+    """FIFO with dedup + processing semantics, asyncio-native."""
+
+    def __init__(self) -> None:
+        self._queue: list[Hashable] = []
+        self._dirty: set[Hashable] = set()
+        self._processing: set[Hashable] = set()
+        self._cond = asyncio.Condition()
+        self._shutdown = False
+
+    async def add(self, item: Hashable) -> None:
+        async with self._cond:
+            if self._shutdown or item in self._dirty:
+                return
+            self._dirty.add(item)
+            if item in self._processing:
+                return  # re-add while in flight: picked up on done()
+            self._queue.append(item)
+            self._cond.notify()
+
+    def add_nowait(self, item: Hashable) -> None:
+        """Enqueue from a sync context already on the event loop (informer
+        handlers are invoked on-loop, so this is safe and lock-free)."""
+        if self._shutdown or item in self._dirty:
+            return
+        self._dirty.add(item)
+        if item in self._processing:
+            return
+        self._queue.append(item)
+        asyncio.get_running_loop().create_task(self._notify())
+
+    async def _notify(self) -> None:
+        async with self._cond:
+            self._cond.notify()
+
+    async def get(self) -> Optional[Hashable]:
+        """Next item, or None after shutdown."""
+        async with self._cond:
+            while not self._queue and not self._shutdown:
+                await self._cond.wait()
+            if not self._queue:
+                return None
+            item = self._queue.pop(0)
+            self._dirty.discard(item)
+            self._processing.add(item)
+            return item
+
+    async def done(self, item: Hashable) -> None:
+        async with self._cond:
+            self._processing.discard(item)
+            if item in self._dirty:
+                self._queue.append(item)
+                self._cond.notify()
+
+    async def shut_down(self) -> None:
+        async with self._cond:
+            self._shutdown = True
+            self._cond.notify_all()
+
+    def __len__(self) -> int:
+        return len(self._queue)
+
+
+class RateLimitingQueue(WorkQueue):
+    """WorkQueue + per-item exponential backoff + delayed adds."""
+
+    def __init__(self, base_delay: float = 0.005, max_delay: float = 1000.0):
+        super().__init__()
+        self.base_delay = base_delay
+        self.max_delay = max_delay
+        self._failures: dict[Hashable, int] = {}
+        self._delayed: list[tuple[float, int, Hashable]] = []
+        self._seq = 0
+        self._delay_task: Optional[asyncio.Task] = None
+
+    def num_requeues(self, item: Hashable) -> int:
+        return self._failures.get(item, 0)
+
+    def forget(self, item: Hashable) -> None:
+        self._failures.pop(item, None)
+
+    async def add_rate_limited(self, item: Hashable) -> None:
+        n = self._failures.get(item, 0)
+        self._failures[item] = n + 1
+        delay = min(self.base_delay * (2 ** n), self.max_delay)
+        await self.add_after(item, delay)
+
+    async def add_after(self, item: Hashable, delay: float) -> None:
+        if delay <= 0:
+            await self.add(item)
+            return
+        loop = asyncio.get_running_loop()
+        self._seq += 1
+        heapq.heappush(self._delayed, (loop.time() + delay, self._seq, item))
+        if self._delay_task is None or self._delay_task.done():
+            self._delay_task = loop.create_task(self._drain_delayed())
+
+    async def _drain_delayed(self) -> None:
+        loop = asyncio.get_running_loop()
+        while self._delayed and not self._shutdown:
+            when, _, item = self._delayed[0]
+            now = loop.time()
+            if when > now:
+                await asyncio.sleep(when - now)
+                continue
+            heapq.heappop(self._delayed)
+            await self.add(item)
+
+    async def shut_down(self) -> None:
+        await super().shut_down()
+        if self._delay_task and not self._delay_task.done():
+            self._delay_task.cancel()

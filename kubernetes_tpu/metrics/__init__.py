@@ -1,0 +1,1 @@
+from .registry import REGISTRY, Counter, Gauge, Histogram, MetricsRegistry  # noqa: F401

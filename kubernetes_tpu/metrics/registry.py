@@ -1,0 +1,188 @@
+"""Prometheus-style metrics primitives.
+
+Reference: every component registers prometheus metrics — scheduler
+(``plugin/pkg/scheduler/metrics/metrics.go:31-66``: e2e scheduling /
+algorithm / binding latency histograms — the north-star metrics),
+kubelet (``pkg/kubelet/metrics/metrics.go:49,145`` incl. device-plugin
+allocation latency), apiserver request latencies. This module provides
+Counter/Gauge/Histogram with label vectors and text exposition; no
+prometheus client library lives in the image, so exposition format is
+implemented directly (it is a stable, documented text format).
+"""
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Optional, Sequence
+
+_DEFAULT_BUCKETS = (
+    0.000001, 0.00001, 0.0001, 0.001, 0.0025, 0.005, 0.01, 0.025,
+    0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+
+def _label_key(label_names: Sequence[str], labels: dict) -> tuple:
+    return tuple(str(labels.get(n, "")) for n in label_names)
+
+
+def _fmt_labels(names: Sequence[str], values: tuple, extra: str = "") -> str:
+    parts = [f'{n}="{v}"' for n, v in zip(names, values)]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+class Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, help_: str = "", labels: Sequence[str] = (),
+                 registry: Optional["MetricsRegistry"] = None):
+        self.name = name
+        self.help = help_
+        self.label_names = tuple(labels)
+        self._lock = threading.Lock()
+        (registry if registry is not None else REGISTRY).register(self)
+
+    def render(self) -> str:
+        raise NotImplementedError
+
+
+class Counter(Metric):
+    kind = "counter"
+
+    def __init__(self, *args, **kw):
+        super().__init__(*args, **kw)
+        self._values: dict[tuple, float] = {}
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        key = _label_key(self.label_names, labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, **labels) -> float:
+        return self._values.get(_label_key(self.label_names, labels), 0.0)
+
+    def render(self) -> str:
+        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+        with self._lock:
+            for key, v in sorted(self._values.items()):
+                lines.append(f"{self.name}{_fmt_labels(self.label_names, key)} {v:g}")
+        return "\n".join(lines)
+
+
+class Gauge(Metric):
+    kind = "gauge"
+
+    def __init__(self, *args, **kw):
+        super().__init__(*args, **kw)
+        self._values: dict[tuple, float] = {}
+
+    def set(self, value: float, **labels) -> None:
+        with self._lock:
+            self._values[_label_key(self.label_names, labels)] = value
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        key = _label_key(self.label_names, labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def dec(self, amount: float = 1.0, **labels) -> None:
+        self.inc(-amount, **labels)
+
+    def value(self, **labels) -> float:
+        return self._values.get(_label_key(self.label_names, labels), 0.0)
+
+    def render(self) -> str:
+        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
+        with self._lock:
+            for key, v in sorted(self._values.items()):
+                lines.append(f"{self.name}{_fmt_labels(self.label_names, key)} {v:g}")
+        return "\n".join(lines)
+
+
+class Histogram(Metric):
+    kind = "histogram"
+
+    def __init__(self, name: str, help_: str = "", labels: Sequence[str] = (),
+                 buckets: Sequence[float] = _DEFAULT_BUCKETS,
+                 registry: Optional["MetricsRegistry"] = None):
+        super().__init__(name, help_, labels, registry)
+        self.buckets = tuple(sorted(buckets))
+        self._counts: dict[tuple, list[int]] = {}
+        self._sums: dict[tuple, float] = {}
+        self._totals: dict[tuple, int] = {}
+
+    def observe(self, value: float, **labels) -> None:
+        key = _label_key(self.label_names, labels)
+        with self._lock:
+            counts = self._counts.get(key)
+            if counts is None:
+                counts = [0] * len(self.buckets)
+                self._counts[key] = counts
+            i = bisect.bisect_left(self.buckets, value)
+            if i < len(counts):
+                counts[i] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + value
+            self._totals[key] = self._totals.get(key, 0) + 1
+
+    def quantile(self, q: float, **labels) -> float:
+        """Approximate quantile from bucket boundaries (upper bound)."""
+        key = _label_key(self.label_names, labels)
+        with self._lock:
+            counts = self._counts.get(key)
+            total = self._totals.get(key, 0)
+            if not counts or not total:
+                return 0.0
+            target = q * total
+            cum = 0
+            for i, c in enumerate(counts):
+                cum += c
+                if cum >= target:
+                    return self.buckets[i]
+            return float("inf")
+
+    def count(self, **labels) -> int:
+        return self._totals.get(_label_key(self.label_names, labels), 0)
+
+    def sum(self, **labels) -> float:
+        return self._sums.get(_label_key(self.label_names, labels), 0.0)
+
+    def render(self) -> str:
+        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
+        with self._lock:
+            for key in sorted(self._counts):
+                cum = 0
+                for ub, c in zip(self.buckets, self._counts[key]):
+                    cum += c
+                    lab = _fmt_labels(self.label_names, key, f'le="{ub:g}"')
+                    lines.append(f"{self.name}_bucket{lab} {cum}")
+                lab = _fmt_labels(self.label_names, key, 'le="+Inf"')
+                lines.append(f"{self.name}_bucket{lab} {self._totals[key]}")
+                lines.append(f"{self.name}_sum{_fmt_labels(self.label_names, key)} {self._sums[key]:g}")
+                lines.append(f"{self.name}_count{_fmt_labels(self.label_names, key)} {self._totals[key]}")
+        return "\n".join(lines)
+
+
+class MetricsRegistry:
+    def __init__(self) -> None:
+        self._metrics: dict[str, Metric] = {}
+        self._lock = threading.Lock()
+
+    def register(self, m: Metric) -> None:
+        with self._lock:
+            # Idempotent by name so module reloads in tests don't explode;
+            # the first registration wins (callers share the instance).
+            self._metrics.setdefault(m.name, m)
+
+    def get(self, name: str) -> Optional[Metric]:
+        return self._metrics.get(name)
+
+    def render(self) -> str:
+        with self._lock:
+            metrics = list(self._metrics.values())
+        return "\n".join(m.render() for m in metrics) + "\n"
+
+
+#: Process-global registry (per-component registries are possible by
+#: passing registry= explicitly; components in one test process share).
+REGISTRY = MetricsRegistry()

@@ -76,6 +76,9 @@ class Watch:
         self._loop = loop
         self._queue: asyncio.Queue[Optional[WatchEvent]] = asyncio.Queue()
         self._cancelled = False
+        #: Set once the end-of-stream sentinel has been consumed; lets
+        #: callers distinguish 'stream ended' from 'idle timeout'.
+        self.closed = False
 
     def _deliver(self, ev: Optional[WatchEvent]) -> None:
         # Called with store lock held, possibly from a foreign thread.
@@ -97,12 +100,19 @@ class Watch:
         return ev
 
     async def next(self, timeout: Optional[float] = None) -> Optional[WatchEvent]:
-        if timeout is None:
-            return await self._queue.get()
-        try:
-            return await asyncio.wait_for(self._queue.get(), timeout)
-        except asyncio.TimeoutError:
+        """None on timeout; None with ``self.closed`` set on stream end."""
+        if self.closed:
             return None
+        if timeout is None:
+            ev = await self._queue.get()
+        else:
+            try:
+                ev = await asyncio.wait_for(self._queue.get(), timeout)
+            except asyncio.TimeoutError:
+                return None
+        if ev is None:
+            self.closed = True
+        return ev
 
 
 class MVCCStore:

@@ -1,0 +1,133 @@
+"""HTTP apiserver + RESTClient end-to-end (real sockets on localhost)."""
+import asyncio
+
+import pytest
+
+from kubernetes_tpu.api import errors, types as t
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.client.rest import RESTClient
+
+
+async def start_server(tokens=None):
+    srv = APIServer(tokens=tokens)
+    port = await srv.start()
+    srv.registry.create(t.Namespace(metadata=ObjectMeta(name="default")))
+    return srv, RESTClient(f"http://127.0.0.1:{port}",
+                           token=next(iter(tokens)) if tokens else "")
+
+
+def mk_pod(name="p"):
+    return t.Pod(metadata=ObjectMeta(name=name, namespace="default"),
+                 spec=t.PodSpec(containers=[t.Container(name="c", image="img")]))
+
+
+async def test_crud_over_http():
+    srv, client = await start_server()
+    try:
+        created = await client.create(mk_pod())
+        assert created.metadata.uid
+
+        got = await client.get("pods", "default", "p")
+        assert got.metadata.name == "p"
+
+        got.metadata.labels["x"] = "1"
+        updated = await client.update(got)
+        assert updated.metadata.labels == {"x": "1"}
+
+        items, rev = await client.list("pods", "default")
+        assert len(items) == 1 and rev > 0
+
+        patched = await client.patch("pods", "default", "p",
+                                     {"metadata": {"labels": {"y": "2"}}})
+        assert patched.metadata.labels == {"x": "1", "y": "2"}
+
+        await client.delete("pods", "default", "p", grace_period_seconds=0)
+        with pytest.raises(errors.NotFoundError):
+            await client.get("pods", "default", "p")
+    finally:
+        await client.close()
+        await srv.stop()
+
+
+async def test_watch_stream_over_http():
+    srv, client = await start_server()
+    try:
+        _, rev = await client.list("pods", "default")
+        watch = await client.watch("pods", "default", resource_version=rev)
+        await client.create(mk_pod("w1"))
+        etype, obj = await watch.next(timeout=5)
+        assert etype == "ADDED" and obj.metadata.name == "w1"
+
+        got = await client.get("pods", "default", "w1")
+        got.status.phase = t.POD_RUNNING
+        await client.update_status(got)
+        etype, obj = await watch.next(timeout=5)
+        assert etype == "MODIFIED" and obj.status.phase == t.POD_RUNNING
+        watch.cancel()
+    finally:
+        await client.close()
+        await srv.stop()
+
+
+async def test_binding_over_http():
+    srv, client = await start_server()
+    try:
+        pod = mk_pod("bindme")
+        pod.spec.containers[0].tpu_requests = ["tpu"]
+        pod.spec.tpu_resources = [t.PodTpuRequest(name="tpu", chips=1)]
+        await client.create(pod)
+        binding = t.Binding(target=t.BindingTarget(
+            node_name="n1", tpu_bindings=[t.TpuBinding(name="tpu", chip_ids=["c9"])]))
+        bound = await client.bind("default", "bindme", binding)
+        assert bound.spec.node_name == "n1"
+        assert bound.spec.tpu_resources[0].assigned == ["c9"]
+    finally:
+        await client.close()
+        await srv.stop()
+
+
+async def test_conflict_maps_to_409():
+    srv, client = await start_server()
+    try:
+        created = await client.create(mk_pod())
+        stale = created.metadata.resource_version
+        created.metadata.labels["a"] = "1"
+        await client.update(created)
+        created.metadata.resource_version = stale
+        created.metadata.labels["b"] = "2"
+        with pytest.raises(errors.ConflictError):
+            await client.update(created)
+    finally:
+        await client.close()
+        await srv.stop()
+
+
+async def test_authn_rejects_bad_token():
+    srv, client = await start_server(tokens={"secret": "admin"})
+    try:
+        await client.create(mk_pod())  # good token
+        bad = RESTClient(f"http://127.0.0.1:{srv.port}", token="wrong")
+        with pytest.raises(errors.UnauthorizedError):
+            await bad.get("pods", "default", "p")
+        await bad.close()
+    finally:
+        await client.close()
+        await srv.stop()
+
+
+async def test_invalid_json_is_400():
+    import aiohttp
+
+    srv, client = await start_server()
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                f"http://127.0.0.1:{srv.port}/api/core/v1/namespaces/default/pods",
+                data=b"{not json") as resp:
+                assert resp.status == 400
+                body = await resp.json()
+                assert body["reason"] == "BadRequest"
+    finally:
+        await client.close()
+        await srv.stop()

@@ -1,0 +1,138 @@
+"""Informer/reflector semantics against the in-proc control plane."""
+import asyncio
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.apiserver.registry import Registry
+from kubernetes_tpu.client.informer import SharedInformer, pods_by_node
+from kubernetes_tpu.client.local import LocalClient
+from kubernetes_tpu.client.workqueue import RateLimitingQueue
+
+
+def mk_pod(name, node=""):
+    p = t.Pod(metadata=ObjectMeta(name=name, namespace="default"),
+              spec=t.PodSpec(containers=[t.Container(name="c", image="i")]))
+    p.spec.node_name = node
+    return p
+
+
+async def test_informer_sync_and_events():
+    reg = Registry()
+    client = LocalClient(reg)
+    reg.create(mk_pod("pre"))
+
+    seen = []
+    inf = SharedInformer(client, "pods", "default",
+                         indexers={"by_node": pods_by_node})
+    inf.add_handlers(
+        on_add=lambda o: seen.append(("add", o.metadata.name)),
+        on_update=lambda old, new: seen.append(("upd", new.metadata.name)),
+        on_delete=lambda o: seen.append(("del", o.metadata.name)),
+    )
+    inf.start()
+    await inf.wait_for_sync()
+    assert ("add", "pre") in seen
+    assert inf.get("default/pre") is not None
+
+    reg.create(mk_pod("live", node="n1"))
+    await asyncio.sleep(0.05)
+    assert ("add", "live") in seen
+    assert [p.metadata.name for p in inf.store.by_index("by_node", "n1")] == ["live"]
+
+    pod = reg.get("pods", "default", "live")
+    pod.metadata.labels["x"] = "1"
+    reg.update(pod)
+    await asyncio.sleep(0.05)
+    assert ("upd", "live") in seen
+
+    reg.delete("pods", "default", "live", grace_period_seconds=0)
+    await asyncio.sleep(0.05)
+    assert ("del", "live") in seen
+    assert inf.get("default/live") is None
+    await inf.stop()
+
+
+async def test_informer_relist_after_compaction():
+    reg = Registry(store=__import__("kubernetes_tpu.storage.mvcc", fromlist=["MVCCStore"]).MVCCStore(history_limit=5))
+    client = LocalClient(reg)
+    inf = SharedInformer(client, "pods", "default")
+    inf.start()
+    await inf.wait_for_sync()
+
+    # Blow past history so the informer's watch revision compacts away.
+    for i in range(30):
+        reg.create(mk_pod(f"p{i}"))
+    await asyncio.sleep(0.3)
+    # Informer must have relisted and caught everything.
+    assert len(inf.list()) == 30
+    await inf.stop()
+
+
+async def test_workqueue_dedup_and_backoff():
+    q = RateLimitingQueue(base_delay=0.01, max_delay=0.1)
+    await q.add("k")
+    await q.add("k")
+    assert len(q) == 1
+    item = await q.get()
+    assert item == "k"
+    # re-add while processing: must come back after done()
+    await q.add("k")
+    assert len(q) == 0
+    await q.done("k")
+    assert len(q) == 1
+    item = await q.get()
+    await q.done(item)
+
+    # rate-limited requeue with growing delay
+    await q.add_rate_limited("f")
+    t0 = asyncio.get_running_loop().time()
+    assert await q.get() == "f"
+    await q.done("f")
+    await q.add_rate_limited("f")
+    assert await q.get() == "f"
+    assert asyncio.get_running_loop().time() - t0 >= 0.02
+    assert q.num_requeues("f") == 2
+    q.forget("f")
+    assert q.num_requeues("f") == 0
+    await q.shut_down()
+
+
+async def test_leader_election_single_winner():
+    from kubernetes_tpu.client.leaderelection import LeaderElector
+
+    reg = Registry()
+    reg.create(t.Namespace(metadata=ObjectMeta(name="kube-system")))
+    client = LocalClient(reg)
+
+    active: list[str] = []
+
+    def payload(name):
+        async def run():
+            active.append(name)
+            await asyncio.sleep(30)
+        return run
+
+    e1 = LeaderElector(client, "sched", "alpha", lease_duration=0.5,
+                       renew_deadline=0.3, retry_period=0.1)
+    e2 = LeaderElector(client, "sched", "beta", lease_duration=0.5,
+                       renew_deadline=0.3, retry_period=0.1)
+    t1 = asyncio.create_task(e1.run(payload("alpha")))
+    await asyncio.sleep(0.2)
+    t2 = asyncio.create_task(e2.run(payload("beta")))
+    await asyncio.sleep(0.3)
+    assert active == ["alpha"]
+    assert e1.is_leader and not e2.is_leader
+
+    # Leader dies; standby must take over after lease expiry.
+    t1.cancel()
+    try:
+        await t1
+    except asyncio.CancelledError:
+        pass
+    await asyncio.sleep(1.5)
+    assert "beta" in active and e2.is_leader
+    t2.cancel()
+    try:
+        await t2
+    except asyncio.CancelledError:
+        pass

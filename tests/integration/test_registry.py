@@ -1,0 +1,204 @@
+"""Registry + admission integration (reference tier: test/integration
+against an in-proc master with real storage semantics)."""
+import pytest
+
+from kubernetes_tpu.api import errors, types as t, workloads as w
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.apiserver.admission import default_chain
+from kubernetes_tpu.apiserver.registry import Registry
+
+
+@pytest.fixture
+def registry():
+    r = Registry()
+    r.admission = default_chain(r)
+    r.create(t.Namespace(metadata=ObjectMeta(name="default")))
+    return r
+
+
+def mk_pod(name="p", ns="default", chips=0):
+    pod = t.Pod(metadata=ObjectMeta(name=name, namespace=ns),
+                spec=t.PodSpec(containers=[t.Container(name="c", image="img")]))
+    if chips:
+        pod.spec.containers[0].tpu_requests = ["tpu"]
+        pod.spec.tpu_resources = [t.PodTpuRequest(name="tpu", chips=chips)]
+    return pod
+
+
+def test_create_stamps_server_fields(registry):
+    pod = registry.create(mk_pod())
+    assert pod.metadata.uid and pod.metadata.creation_timestamp
+    assert pod.metadata.resource_version
+    got = registry.get("pods", "default", "p")
+    assert got.metadata.uid == pod.metadata.uid
+
+
+def test_create_clears_client_status(registry):
+    pod = mk_pod()
+    pod.status.phase = t.POD_RUNNING
+    created = registry.create(pod)
+    assert created.status.phase == t.POD_PENDING
+
+
+def test_update_conflict_on_stale_rv(registry):
+    pod = registry.create(mk_pod())
+    stale_rv = pod.metadata.resource_version
+    pod.metadata.labels["a"] = "1"
+    registry.update(pod)
+    pod2 = registry.get("pods", "default", "p")
+    pod2.metadata.resource_version = stale_rv
+    pod2.metadata.labels["b"] = "2"
+    with pytest.raises(errors.ConflictError):
+        registry.update(pod2)
+
+
+def test_status_subresource_isolation(registry):
+    pod = registry.create(mk_pod())
+    # status update must not clobber spec; spec update must not clobber status
+    got = registry.get("pods", "default", "p")
+    got.status.phase = t.POD_RUNNING
+    registry.update(got, subresource="status")
+
+    got2 = registry.get("pods", "default", "p")
+    assert got2.status.phase == t.POD_RUNNING
+    got2.metadata.labels["x"] = "y"
+    got2.status.phase = t.POD_FAILED  # should be ignored on spec path
+    registry.update(got2)
+    got3 = registry.get("pods", "default", "p")
+    assert got3.status.phase == t.POD_RUNNING
+    assert got3.metadata.labels["x"] == "y"
+
+
+def test_generation_bumps_only_on_spec_change(registry):
+    d = w.Deployment(
+        metadata=ObjectMeta(name="d", namespace="default"),
+        spec=w.DeploymentSpec(
+            replicas=1,
+            selector=__import__("kubernetes_tpu.api.selectors", fromlist=["LabelSelector"]).LabelSelector(match_labels={"a": "b"}),
+            template=t.PodTemplateSpec(metadata=ObjectMeta(labels={"a": "b"}),
+                                       spec=t.PodSpec(containers=[t.Container(name="c", image="i")])),
+        ),
+    )
+    created = registry.create(d)
+    assert created.metadata.generation == 1
+    got = registry.get("deployments", "default", "d")
+    got.metadata.labels["note"] = "1"
+    updated = registry.update(got)
+    assert updated.metadata.generation == 1
+    got = registry.get("deployments", "default", "d")
+    got.spec.replicas = 3
+    updated = registry.update(got)
+    assert updated.metadata.generation == 2
+
+
+def test_binding_subresource_atomic(registry):
+    pod = registry.create(mk_pod(chips=2))
+    claim = pod.spec.tpu_resources[0].name
+    binding = t.Binding(
+        metadata=ObjectMeta(name="p", namespace="default"),
+        target=t.BindingTarget(node_name="node-1", tpu_bindings=[
+            t.TpuBinding(name=claim, chip_ids=["c0", "c1"])]))
+    bound = registry.bind_pod("default", "p", binding)
+    assert bound.spec.node_name == "node-1"
+    assert bound.spec.tpu_resources[0].assigned == ["c0", "c1"]
+    cond = t.get_pod_condition(bound.status, t.COND_POD_SCHEDULED)
+    assert cond and cond.status == "True"
+    # Double-bind to a different node must conflict.
+    binding.target.node_name = "node-2"
+    with pytest.raises(errors.ConflictError):
+        registry.bind_pod("default", "p", binding)
+
+
+def test_binding_must_cover_all_claims(registry):
+    registry.create(mk_pod(name="q", chips=2))
+    binding = t.Binding(target=t.BindingTarget(node_name="n1"))
+    with pytest.raises(errors.BadRequestError):
+        registry.bind_pod("default", "q", binding)
+
+
+def test_graceful_delete_then_force(registry):
+    registry.create(mk_pod())
+    first = registry.delete("pods", "default", "p")
+    assert first.metadata.deletion_timestamp is not None
+    # Still present (terminating).
+    assert registry.get("pods", "default", "p").metadata.deletion_timestamp
+    registry.delete("pods", "default", "p", grace_period_seconds=0)
+    with pytest.raises(errors.NotFoundError):
+        registry.get("pods", "default", "p")
+
+
+def test_finalizer_blocks_removal(registry):
+    svc = t.Service(metadata=ObjectMeta(name="s", namespace="default",
+                                        finalizers=["example/protect"]),
+                    spec=t.ServiceSpec(ports=[t.ServicePort(port=80)]))
+    registry.create(svc)
+    registry.delete("services", "default", "s")
+    got = registry.get("services", "default", "s")
+    assert got.metadata.deletion_timestamp is not None
+    got.metadata.finalizers = []
+    registry.update(got)
+    with pytest.raises(errors.NotFoundError):
+        registry.get("services", "default", "s")
+
+
+def test_label_and_field_selectors(registry):
+    registry.create(mk_pod("a"))
+    pb = mk_pod("b")
+    pb.metadata.labels = {"tier": "train"}
+    registry.create(pb)
+    items, _ = registry.list("pods", "default", label_selector="tier=train")
+    assert [p.metadata.name for p in items] == ["b"]
+    pod_a = registry.get("pods", "default", "a")
+    pod_a.status.phase = t.POD_RUNNING
+    registry.update(pod_a, subresource="status")
+    items, _ = registry.list("pods", "default", field_selector="status.phase=Running")
+    assert [p.metadata.name for p in items] == ["a"]
+
+
+def test_merge_patch(registry):
+    registry.create(mk_pod())
+    registry.patch("pods", "default", "p", {"metadata": {"labels": {"x": "1"}}})
+    got = registry.get("pods", "default", "p")
+    assert got.metadata.labels == {"x": "1"}
+    registry.patch("pods", "default", "p", {"metadata": {"labels": {"x": None, "y": "2"}}})
+    got = registry.get("pods", "default", "p")
+    assert got.metadata.labels == {"y": "2"}
+
+
+# -- admission ------------------------------------------------------------
+
+
+def test_tpu_limit_rewritten_to_claim(registry):
+    """The resourcev2-analog shim: count-style limits become claims."""
+    pod = t.Pod(metadata=ObjectMeta(name="gpu-style", namespace="default"),
+                spec=t.PodSpec(containers=[t.Container(
+                    name="c", image="i",
+                    resources=t.ResourceRequirements(limits={t.RESOURCE_TPU: 4}))]))
+    created = registry.create(pod)
+    assert t.RESOURCE_TPU not in created.spec.containers[0].resources.limits
+    assert len(created.spec.tpu_resources) == 1
+    assert created.spec.tpu_resources[0].chips == 4
+    assert created.spec.containers[0].tpu_requests == [created.spec.tpu_resources[0].name]
+
+
+def test_namespace_lifecycle_blocks_unknown_ns(registry):
+    with pytest.raises(errors.ForbiddenError):
+        registry.create(mk_pod(ns="nope"))
+
+
+def test_priority_resolution(registry):
+    registry.create(t.PriorityClass(metadata=ObjectMeta(name="high"), value=1000))
+    pod = mk_pod()
+    pod.spec.priority_class_name = "high"
+    created = registry.create(pod)
+    assert created.spec.priority == 1000
+
+
+def test_quota_enforced(registry):
+    registry.create(t.ResourceQuota(
+        metadata=ObjectMeta(name="q", namespace="default"),
+        spec=t.ResourceQuotaSpec(hard={t.RESOURCE_TPU: 4, "pods": 10})))
+    registry.create(mk_pod("a", chips=3))
+    with pytest.raises(errors.ForbiddenError, match="exceeded quota"):
+        registry.create(mk_pod("b", chips=2))
+    registry.create(mk_pod("c", chips=1))

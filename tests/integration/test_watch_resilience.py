@@ -1,0 +1,108 @@
+"""Regression tests for review findings: dead watch streams, repeated
+graceful deletes, field-selector guards, late indexers, bad int params."""
+import asyncio
+
+import pytest
+
+from kubernetes_tpu.api import errors, types as t
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.apiserver.registry import Registry
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.client.informer import SharedInformer
+from kubernetes_tpu.client.rest import RESTClient
+
+
+def mk_pod(name):
+    return t.Pod(metadata=ObjectMeta(name=name, namespace="default"),
+                 spec=t.PodSpec(containers=[t.Container(name="c", image="i")]))
+
+
+async def test_informer_survives_apiserver_restart():
+    srv = APIServer()
+    port = await srv.start()
+    srv.registry.create(t.Namespace(metadata=ObjectMeta(name="default")))
+    registry = srv.registry  # keep the same store across "restart"
+    client = RESTClient(f"http://127.0.0.1:{port}")
+    inf = SharedInformer(client, "pods", "default")
+    inf.start()
+    await inf.wait_for_sync()
+
+    registry.create(mk_pod("before"))
+    await asyncio.sleep(0.2)
+    assert inf.get("default/before") is not None
+
+    # Kill the server socket; informer's watch stream dies.
+    await srv.stop()
+    registry.create(mk_pod("during-outage"))
+
+    # Restart on the same port with the same registry.
+    srv2 = APIServer(registry=registry)
+    await srv2.start(port=port)
+    # Informer must reconnect, relist, and pick up the missed object.
+    for _ in range(100):
+        if inf.get("default/during-outage") is not None:
+            break
+        await asyncio.sleep(0.1)
+    assert inf.get("default/during-outage") is not None
+    await inf.stop()
+    await client.close()
+    await srv2.stop()
+
+
+def test_repeated_graceful_delete_is_noop():
+    reg = Registry()
+    reg.create(mk_pod("p"))
+    first = reg.delete("pods", "default", "p")
+    assert first.metadata.deletion_timestamp is not None
+    # Idempotent retry must NOT force-remove while the node agent still
+    # owns the grace period.
+    reg.delete("pods", "default", "p")
+    assert reg.get("pods", "default", "p") is not None
+    reg.delete("pods", "default", "p", grace_period_seconds=0)
+    with pytest.raises(errors.NotFoundError):
+        reg.get("pods", "default", "p")
+
+
+def test_unsupported_field_selector_rejected():
+    reg = Registry()
+    reg.create(t.ConfigMap(metadata=ObjectMeta(name="cm", namespace="default")))
+    with pytest.raises(errors.BadRequestError, match="field selectors"):
+        reg.list("configmaps", "default", field_selector="metadata.name=cm")
+
+
+async def test_late_indexer_backfilled():
+    from kubernetes_tpu.client.informer import InformerFactory, pods_by_node
+    from kubernetes_tpu.client.local import LocalClient
+
+    reg = Registry()
+    p = mk_pod("p1")
+    p.spec.node_name = "n1"
+    reg.create(p)
+    factory = InformerFactory(LocalClient(reg))
+    inf_a = factory.informer("pods")
+    inf_a.start()
+    await inf_a.wait_for_sync()
+    # Second consumer registers an indexer after sync: must be back-filled.
+    inf_b = factory.informer("pods", indexers={"by_node": pods_by_node})
+    assert inf_b is inf_a
+    assert [x.metadata.name for x in inf_b.store.by_index("by_node", "n1")] == ["p1"]
+    await inf_a.stop()
+
+
+async def test_bad_int_params_are_400():
+    import aiohttp
+
+    srv = APIServer()
+    port = await srv.start()
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.get(
+                f"http://127.0.0.1:{port}/api/core/v1/namespaces/default/pods",
+                params={"watch": "1", "resource_version": "abc"}) as resp:
+                assert resp.status == 400
+            async with s.delete(
+                f"http://127.0.0.1:{port}/api/core/v1/namespaces/default/pods/x",
+                params={"grace_period_seconds": "zz"}) as resp:
+                assert resp.status == 400
+    finally:
+        await srv.stop()
