@@ -43,6 +43,10 @@ class ResourceSpec:
     field_extractor: Optional[Callable[[Any], dict]] = None
     #: Graceful deletion (pods): DELETE sets deletion_timestamp first.
     graceful_delete: bool = False
+    #: Keep client-supplied status at create time. Nodes set this: the
+    #: node agent both creates the object and owns its status, and test
+    #: rigs (kubemark) seed capacity the same way.
+    preserve_status_on_create: bool = False
 
 
 def _pod_fields(pod: t.Pod) -> dict:
@@ -79,7 +83,8 @@ def builtin_resources() -> list[ResourceSpec]:
                      validate_create=val.validate_pod,
                      validate_update=val.validate_pod_update, graceful_delete=True),
         ResourceSpec("nodes", "Node", core, t.Node, namespaced=False,
-                     field_extractor=_node_fields, validate_create=val.validate_node),
+                     field_extractor=_node_fields, validate_create=val.validate_node,
+                     preserve_status_on_create=True),
         ResourceSpec("services", "Service", core, t.Service,
                      validate_create=val.validate_service),
         ResourceSpec("endpoints", "Endpoints", core, t.Endpoints, has_status=False),
@@ -182,7 +187,8 @@ class Registry:
             meta.namespace = ""
         stamp_new(meta)
         meta.generation = 1
-        if spec.has_status and hasattr(obj, "status"):
+        if (spec.has_status and hasattr(obj, "status")
+                and not spec.preserve_status_on_create):
             # Strategy PrepareForCreate: clients cannot seed status.
             obj.status = type(obj.status)()
         if self.admission is not None:

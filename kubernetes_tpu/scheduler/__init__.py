@@ -1,0 +1,1 @@
+from .scheduler import Scheduler  # noqa: F401

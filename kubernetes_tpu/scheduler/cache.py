@@ -1,0 +1,225 @@
+"""Scheduler cache — node/pod state with assume semantics + chip ledger.
+
+Reference: ``plugin/pkg/scheduler/schedulercache`` (NodeInfo,
+assume/add/remove pod) and the fork's per-device ledger
+``schedulercache/extended_resources.go`` (``:86 AddPod`` debits device
+IDs, ``:114 RemovePod`` credits, ``:154 SetNode`` rebuilds from node
+status minus all pods' Assigned lists).
+
+TPU redesign: the ledger tracks chips *with their mesh coordinates*,
+and maintains a per-slice view (nodes grouped by ``slice_id``) so gang
+allocation can pack one contiguous box across hosts — the structure the
+reference never needed (its devices are flat).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..api import types as t
+
+Coord = tuple[int, ...]
+
+
+@dataclass
+class NodeInfo:
+    node: Optional[t.Node] = None
+    pods: dict = field(default_factory=dict)  # key -> Pod
+    requested: dict = field(default_factory=dict)  # resource -> float
+    #: chip_id -> TpuChip for healthy, unassigned chips.
+    free_chips: dict = field(default_factory=dict)
+    #: chip_id -> pod key holding it.
+    chip_owner: dict = field(default_factory=dict)
+
+    def allocatable(self) -> dict:
+        if self.node is None:
+            return {}
+        alloc = dict(self.node.status.allocatable or self.node.status.capacity)
+        if t.RESOURCE_PODS not in alloc:
+            alloc[t.RESOURCE_PODS] = 110
+        return alloc
+
+    def recompute_chips(self) -> None:
+        """Rebuild the free-chip set from node status minus pod claims
+        (SetNode semantics, ``extended_resources.go:154``)."""
+        self.free_chips = {}
+        self.chip_owner = {}
+        topo = self.node.status.tpu if self.node else None
+        if topo is None:
+            return
+        healthy = {c.id: c for c in topo.chips if c.health == t.TPU_HEALTHY}
+        for key, pod in self.pods.items():
+            for cid in t.pod_tpu_assigned(pod):
+                if cid in healthy:
+                    self.chip_owner[cid] = key
+        self.free_chips = {cid: c for cid, c in healthy.items()
+                           if cid not in self.chip_owner}
+
+    def add_pod(self, pod: t.Pod) -> None:
+        key = pod.key()
+        self.pods[key] = pod
+        for res, amt in t.pod_resource_requests(pod).items():
+            self.requested[res] = self.requested.get(res, 0.0) + amt
+        for cid in t.pod_tpu_assigned(pod):
+            chip = self.free_chips.pop(cid, None)
+            if chip is not None or cid not in self.chip_owner:
+                self.chip_owner[cid] = key
+
+    def remove_pod(self, pod: t.Pod) -> None:
+        key = pod.key()
+        if key not in self.pods:
+            return
+        del self.pods[key]
+        for res, amt in t.pod_resource_requests(pod).items():
+            self.requested[res] = self.requested.get(res, 0.0) - amt
+            if abs(self.requested[res]) < 1e-9:
+                del self.requested[res]
+        topo = self.node.status.tpu if self.node else None
+        healthy = {c.id: c for c in (topo.chips if topo else [])
+                   if c.health == t.TPU_HEALTHY}
+        for cid in t.pod_tpu_assigned(pod):
+            if self.chip_owner.get(cid) == key:
+                del self.chip_owner[cid]
+                if cid in healthy:
+                    self.free_chips[cid] = healthy[cid]
+
+    def free_coords(self) -> dict[Coord, str]:
+        """coords -> chip_id for free chips (geometry view for submesh)."""
+        return {tuple(c.coords): cid for cid, c in self.free_chips.items() if c.coords}
+
+
+@dataclass
+class SliceInfo:
+    """All nodes of one multi-host slice, merged into one geometry."""
+
+    slice_id: str = ""
+    chip_type: str = ""
+    mesh_shape: tuple = ()
+    #: coords -> (node_name, chip_id) for every healthy chip.
+    chips: dict = field(default_factory=dict)
+    node_names: set = field(default_factory=set)
+
+    def free(self, cache: "SchedulerCache") -> dict[Coord, tuple[str, str]]:
+        out = {}
+        for coord, (node_name, chip_id) in self.chips.items():
+            info = cache.nodes.get(node_name)
+            if info and chip_id in info.free_chips:
+                out[coord] = (node_name, chip_id)
+        return out
+
+
+class SchedulerCache:
+    def __init__(self) -> None:
+        self.nodes: dict[str, NodeInfo] = {}
+        self.slices: dict[str, SliceInfo] = {}
+        #: pod key -> node name for assumed (bound-in-flight) pods.
+        self.assumed: dict[str, str] = {}
+        #: pod keys -> Pod for pods known to the cache (assumed or added).
+        self._pod_node: dict[str, str] = {}
+
+    # -- nodes ------------------------------------------------------------
+
+    def set_node(self, node: t.Node) -> None:
+        info = self.nodes.get(node.metadata.name)
+        if info is None:
+            info = NodeInfo(node=node)
+            self.nodes[node.metadata.name] = info
+        else:
+            info.node = node
+        info.recompute_chips()
+        self._rebuild_slice_for(node)
+
+    def remove_node(self, name: str) -> None:
+        info = self.nodes.pop(name, None)
+        if info and info.node and info.node.status.tpu:
+            sid = info.node.status.tpu.slice_id
+            sl = self.slices.get(sid)
+            if sl:
+                sl.node_names.discard(name)
+                sl.chips = {c: v for c, v in sl.chips.items() if v[0] != name}
+                if not sl.node_names:
+                    del self.slices[sid]
+
+    def _rebuild_slice_for(self, node: t.Node) -> None:
+        topo = node.status.tpu
+        if topo is None or not topo.slice_id:
+            return
+        sl = self.slices.get(topo.slice_id)
+        if sl is None:
+            sl = SliceInfo(slice_id=topo.slice_id, chip_type=topo.chip_type,
+                           mesh_shape=tuple(topo.mesh_shape))
+            self.slices[topo.slice_id] = sl
+        sl.mesh_shape = tuple(topo.mesh_shape)
+        sl.chip_type = topo.chip_type
+        sl.node_names.add(node.metadata.name)
+        # Replace this node's chips in the slice geometry.
+        sl.chips = {c: v for c, v in sl.chips.items() if v[0] != node.metadata.name}
+        for chip in topo.chips:
+            if chip.health == t.TPU_HEALTHY and chip.coords:
+                sl.chips[tuple(chip.coords)] = (node.metadata.name, chip.id)
+
+    # -- pods -------------------------------------------------------------
+
+    def _node_for(self, node_name: str) -> NodeInfo:
+        info = self.nodes.get(node_name)
+        if info is None:
+            info = NodeInfo()  # node not seen yet; pods can arrive first
+            self.nodes[node_name] = info
+        return info
+
+    def add_pod(self, pod: t.Pod) -> None:
+        key = pod.key()
+        node_name = pod.spec.node_name
+        if not node_name:
+            return
+        if key in self.assumed:
+            # Confirmation of an assumed pod: replace the assumed copy.
+            prev_node = self.assumed.pop(key)
+            if prev_node != node_name:
+                prev = self.nodes.get(prev_node)
+                if prev and key in prev.pods:
+                    prev.remove_pod(prev.pods[key])
+            else:
+                info = self.nodes[node_name]
+                if key in info.pods:
+                    info.remove_pod(info.pods[key])
+        elif key in self._pod_node:
+            old_node = self._pod_node[key]
+            old_info = self.nodes.get(old_node)
+            if old_info and key in old_info.pods:
+                old_info.remove_pod(old_info.pods[key])
+        self._node_for(node_name).add_pod(pod)
+        self._pod_node[key] = node_name
+
+    def update_pod(self, pod: t.Pod) -> None:
+        self.add_pod(pod)
+
+    def remove_pod(self, pod: t.Pod) -> None:
+        key = pod.key()
+        node_name = self._pod_node.pop(key, None) or pod.spec.node_name
+        self.assumed.pop(key, None)
+        info = self.nodes.get(node_name) if node_name else None
+        if info:
+            existing = info.pods.get(key, pod)
+            info.remove_pod(existing)
+
+    # -- assume / forget (bind-in-flight bookkeeping) ---------------------
+
+    def assume_pod(self, pod: t.Pod, node_name: str) -> None:
+        """Debit resources optimistically before the bind RPC returns
+        (reference: ``scheduler.go`` assume + ER manager AddPod)."""
+        pod.spec.node_name = node_name
+        self._node_for(node_name).add_pod(pod)
+        self.assumed[pod.key()] = node_name
+        self._pod_node[pod.key()] = node_name
+
+    def forget_pod(self, pod: t.Pod) -> None:
+        """Bind failed: credit everything back."""
+        key = pod.key()
+        node_name = self.assumed.pop(key, None)
+        if node_name is None:
+            return
+        self._pod_node.pop(key, None)
+        info = self.nodes.get(node_name)
+        if info and key in info.pods:
+            info.remove_pod(info.pods[key])

@@ -1,0 +1,243 @@
+"""Gang scheduling + cross-host sub-mesh allocation.
+
+No reference analog: the reference schedules one pod at a time
+(``scheduler.go:430 scheduleOne``) and SURVEY.md section 2.4 calls out
+gang/co-scheduling as a first-class gap. Here a PodGroup's members are
+placed **all-or-nothing**:
+
+1. pick a slice (nodes sharing ``slice_id``) whose free chips can host
+   the whole gang — as one contiguous box when the group demands a
+   ``slice_shape``, else as a compact set;
+2. split the box's cells by host and bin-pack member pods onto hosts
+   (first-fit-decreasing; a pod's chips never span hosts — ICI between
+   hosts is the mesh's job, PCIe locality is the pod's);
+3. verify non-TPU predicates per pod on its host;
+4. emit a bind plan: (pod, node, chip bindings). The caller assumes
+   all members in the cache and posts all bindings, rolling back every
+   assume if any bind fails.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..api import types as t
+from .cache import SchedulerCache, SliceInfo
+from .predicates import (_chip_matches, node_is_schedulable,
+                         pod_fits_resources, pod_matches_node_selector,
+                         pod_tolerates_taints)
+from .submesh import allocate_compact, find_box
+
+
+@dataclass
+class GangPlan:
+    slice_id: str = ""
+    #: (pod, node_name, tpu bindings) per member.
+    placements: list = field(default_factory=list)
+
+
+@dataclass
+class GangFailure:
+    reasons: list = field(default_factory=list)
+
+
+def _pod_chip_demand(pod: t.Pod) -> int:
+    return t.pod_tpu_chip_count(pod)
+
+
+def _non_tpu_predicates(pod: t.Pod, info) -> Optional[str]:
+    node = info.node
+    if node is None:
+        return "node unknown"
+    for check in (node_is_schedulable(node), pod_tolerates_taints(pod, node),
+                  pod_matches_node_selector(pod, node),
+                  pod_fits_resources(pod, info)):
+        if check:
+            return check
+    return None
+
+
+def plan_gang(group: t.PodGroup, pods: list[t.Pod],
+              cache: SchedulerCache) -> GangPlan | GangFailure:
+    reasons: list[str] = []
+    tpu_pods = [p for p in pods if _pod_chip_demand(p) > 0]
+    aux_pods = [p for p in pods if _pod_chip_demand(p) == 0]
+    total_chips = sum(_pod_chip_demand(p) for p in tpu_pods)
+
+    candidate_slices = list(cache.slices.values())
+    if not candidate_slices and tpu_pods:
+        return GangFailure(["no TPU slices known to the scheduler"])
+    if not tpu_pods:
+        # Pure-CPU gang: just need co-existing feasible nodes.
+        plan = _plan_aux(aux_pods, cache, {}, [])
+        if isinstance(plan, GangFailure):
+            return plan
+        return GangPlan(placements=plan)
+
+    # Deterministic order: smallest adequate slice first (best fit).
+    candidate_slices.sort(key=lambda s: (len(s.chips), s.slice_id))
+    for sl in candidate_slices:
+        free = sl.free(cache)  # coords -> (node, chip_id)
+        if len(free) < total_chips:
+            reasons.append(f"slice {sl.slice_id}: {len(free)} free chips, "
+                           f"gang needs {total_chips}")
+            continue
+        result = _plan_on_slice(group, tpu_pods, aux_pods, sl, free, cache)
+        if isinstance(result, GangPlan):
+            result.slice_id = sl.slice_id
+            return result
+        reasons.extend(f"slice {sl.slice_id}: {r}" for r in result.reasons)
+    return GangFailure(reasons or ["no feasible slice"])
+
+
+def _plan_on_slice(group: t.PodGroup, tpu_pods: list[t.Pod], aux_pods: list[t.Pod],
+                   sl: SliceInfo, free: dict, cache: SchedulerCache
+                   ) -> GangPlan | GangFailure:
+    total_chips = sum(_pod_chip_demand(p) for p in tpu_pods)
+    # Claim affinity: when every claim in the gang wants the same thing
+    # (the overwhelmingly common case — uniform workers), pre-filter the
+    # free set so the box search only sees eligible chips. Heterogeneous
+    # affinities are re-checked at carve time and fail the slice.
+    claims = [c for p in tpu_pods for c in p.spec.tpu_resources]
+    if claims and any(c.affinity for c in claims):
+        free = {coord: (node_name, chip_id)
+                for coord, (node_name, chip_id) in free.items()
+                if _gang_chip_eligible(cache, node_name, chip_id, claims)}
+        if len(free) < total_chips:
+            return GangFailure([
+                f"only {len(free)} free chips match claim affinity, "
+                f"gang needs {total_chips}"])
+    if group.spec.slice_shape:
+        cells = find_box(set(free), sl.mesh_shape, group.spec.slice_shape)
+        if cells is None:
+            return GangFailure([
+                f"no contiguous {'x'.join(map(str, group.spec.slice_shape))} box free"])
+        vol = len(cells)
+        if vol < total_chips:
+            return GangFailure([f"box volume {vol} < gang demand {total_chips}"])
+    else:
+        cells = allocate_compact(set(free), sl.mesh_shape, total_chips)
+        if cells is None:
+            return GangFailure(["compact allocation failed"])
+
+    # Split cells by host.
+    per_node: dict[str, list[tuple, str]] = {}
+    for cell in cells:
+        node_name, chip_id = free[cell]
+        per_node.setdefault(node_name, []).append((cell, chip_id))
+
+    # First-fit-decreasing: biggest pods onto fullest hosts.
+    pods_desc = sorted(tpu_pods, key=_pod_chip_demand, reverse=True)
+    avail = {n: list(chips) for n, chips in per_node.items()}
+    placements: list = []
+    for pod in pods_desc:
+        demand = _pod_chip_demand(pod)
+        chosen_node = None
+        for node_name in sorted(avail, key=lambda n: len(avail[n]), reverse=True):
+            if len(avail[node_name]) < demand:
+                continue
+            info = cache.nodes.get(node_name)
+            if info is None:
+                continue
+            err = _non_tpu_predicates(pod, _with_planned(info, placements, node_name))
+            if err:
+                continue
+            chosen_node = node_name
+            break
+        if chosen_node is None:
+            return GangFailure([
+                f"pod {pod.metadata.name}: no host in box fits {demand} chips "
+                f"+ cpu/mem predicates"])
+        taken = avail[chosen_node][:demand]
+        avail[chosen_node] = avail[chosen_node][demand:]
+        bindings = _carve_bindings(pod, chosen_node, taken, cache)
+        if bindings is None:
+            return GangFailure([
+                f"pod {pod.metadata.name}: chip attributes do not satisfy claim affinity"])
+        placements.append((pod, chosen_node, bindings))
+
+    aux = _plan_aux(aux_pods, cache, {n: True for n in per_node}, placements)
+    if isinstance(aux, GangFailure):
+        return aux
+    placements.extend(aux)
+    return GangPlan(placements=placements)
+
+
+def _gang_chip_eligible(cache: SchedulerCache, node_name: str, chip_id: str,
+                        claims: list) -> bool:
+    info = cache.nodes.get(node_name)
+    chip = info.free_chips.get(chip_id) if info else None
+    if chip is None:
+        return False
+    return all(_chip_matches(chip, claim) for claim in claims)
+
+
+class _PlannedView:
+    """NodeInfo wrapper adding not-yet-assumed planned pods' requests."""
+
+    def __init__(self, info, extra_requests: dict):
+        self.node = info.node
+        self.free_chips = info.free_chips
+        self._info = info
+        self.requested = dict(info.requested)
+        for res, amt in extra_requests.items():
+            self.requested[res] = self.requested.get(res, 0.0) + amt
+
+    def allocatable(self):
+        return self._info.allocatable()
+
+
+def _with_planned(info, placements: list, node_name: str):
+    extra: dict = {}
+    for pod, n, _ in placements:
+        if n != node_name:
+            continue
+        for res, amt in t.pod_resource_requests(pod).items():
+            extra[res] = extra.get(res, 0.0) + amt
+    return _PlannedView(info, extra) if extra else info
+
+
+def _carve_bindings(pod: t.Pod, node_name: str, taken: list,
+                    cache: SchedulerCache) -> Optional[list[t.TpuBinding]]:
+    """Distribute this host's carved chips over the pod's claims,
+    honoring per-claim attribute affinity."""
+    info = cache.nodes.get(node_name)
+    if info is None:
+        return None
+    chips = {chip_id: info.free_chips.get(chip_id) for _, chip_id in taken}
+    remaining = set(chips)
+    bindings = []
+    for claim in pod.spec.tpu_resources:
+        want = claim.chip_count()
+        ids = sorted(cid for cid in remaining
+                     if chips[cid] is not None and _chip_matches(chips[cid], claim))[:want]
+        if len(ids) < want:
+            return None
+        remaining -= set(ids)
+        bindings.append(t.TpuBinding(name=claim.name, chip_ids=ids))
+    return bindings
+
+
+def _plan_aux(aux_pods: list[t.Pod], cache: SchedulerCache,
+              prefer_nodes: dict, placements: list) -> list | GangFailure:
+    """Place chipless gang members (coordinators, loggers): any feasible
+    node, preferring the gang's slice hosts for locality. ``placements``
+    carries the TPU members already planned so cpu/mem accounting sees
+    the whole gang."""
+    placements = list(placements)
+    n_tpu = len(placements)
+    for pod in aux_pods:
+        chosen = None
+        names = sorted(cache.nodes,
+                       key=lambda n: (0 if n in prefer_nodes else 1, n))
+        for node_name in names:
+            info = cache.nodes.get(node_name)
+            if info is None or info.node is None:
+                continue
+            if _non_tpu_predicates(pod, _with_planned(info, placements, node_name)) is None:
+                chosen = node_name
+                break
+        if chosen is None:
+            return GangFailure([f"pod {pod.metadata.name}: no feasible node"])
+        placements.append((pod, chosen, []))
+    return placements[n_tpu:]

@@ -1,0 +1,40 @@
+"""Scheduler metrics — the north-star latency histograms.
+
+Reference: ``plugin/pkg/scheduler/metrics/metrics.go:31-66``
+(E2eSchedulingLatency, SchedulingAlgorithmLatency, BindingLatency).
+BASELINE.md designates pod-schedule p50 as the headline metric; these
+histograms are what bench.py and the e2e suite read.
+"""
+from ..metrics.registry import Counter, Gauge, Histogram
+
+_LAT_BUCKETS = (0.00005, 0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005,
+                0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0)
+
+E2E_SCHEDULING_LATENCY = Histogram(
+    "scheduler_e2e_scheduling_latency_seconds",
+    "Queue-pop to bind-acknowledged latency per pod",
+    buckets=_LAT_BUCKETS)
+
+ALGORITHM_LATENCY = Histogram(
+    "scheduler_algorithm_latency_seconds",
+    "Predicate+priority+assign phase latency",
+    buckets=_LAT_BUCKETS)
+
+BINDING_LATENCY = Histogram(
+    "scheduler_binding_latency_seconds",
+    "Binding subresource POST latency",
+    buckets=_LAT_BUCKETS)
+
+GANG_SCHEDULING_LATENCY = Histogram(
+    "scheduler_gang_e2e_latency_seconds",
+    "Gang release to all-members-bound latency",
+    buckets=_LAT_BUCKETS)
+
+PODS_SCHEDULED = Counter(
+    "scheduler_pods_scheduled_total", "Successfully bound pods",
+    labels=("result",))
+
+PREEMPTION_VICTIMS = Counter(
+    "scheduler_preemption_victims_total", "Pods evicted by preemption")
+
+PENDING_PODS = Gauge("scheduler_pending_pods", "Pods waiting in queue")

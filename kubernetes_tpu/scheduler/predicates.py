@@ -1,0 +1,172 @@
+"""Fit predicates — can this pod run on this node at all?
+
+Reference: ``plugin/pkg/scheduler/algorithm/predicates/predicates.go``
+(PodFitsResources, PodMatchNodeSelector, PodToleratesNodeTaints,
+NodeCondition checks) plus the fork's per-device phase
+(``core/extended_resources.go:83 hasExtendedResources``). The TPU phase
+here checks chip availability *and geometry*: a shaped claim must have
+a free contiguous box on the node (single-node claims) — counted
+chips alone are not enough.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from ..api import types as t
+from .cache import NodeInfo
+from .submesh import allocate_compact, find_box
+
+
+@dataclass
+class PredicateResult:
+    fits: bool
+    reasons: list[str]
+
+
+def pod_fits_resources(pod: t.Pod, info: NodeInfo) -> Optional[str]:
+    alloc = info.allocatable()
+    requests = t.pod_resource_requests(pod)
+    for res, want in requests.items():
+        if res == t.RESOURCE_TPU:
+            continue  # handled geometrically below
+        have = alloc.get(res)
+        if have is None:
+            if res in (t.RESOURCE_CPU, t.RESOURCE_MEMORY, t.RESOURCE_PODS):
+                have = 0.0
+            else:
+                return f"node lacks resource {res}"
+        if info.requested.get(res, 0.0) + want > have + 1e-9:
+            return (f"insufficient {res}: requested {info.requested.get(res, 0.0):g}"
+                    f"+{want:g} > allocatable {have:g}")
+    return None
+
+
+def pod_matches_node_selector(pod: t.Pod, node: t.Node) -> Optional[str]:
+    labels = node.metadata.labels
+    for k, v in pod.spec.node_selector.items():
+        if labels.get(k) != v:
+            return f"node selector {k}={v} does not match"
+    aff = pod.spec.affinity
+    if aff and aff.node_required:
+        if not any(term.matches(labels) for term in aff.node_required):
+            return "node affinity required terms do not match"
+    return None
+
+
+def pod_tolerates_taints(pod: t.Pod, node: t.Node) -> Optional[str]:
+    for taint in node.spec.taints:
+        if taint.effect not in (t.TAINT_NO_SCHEDULE, t.TAINT_NO_EXECUTE):
+            continue
+        if not any(tol.tolerates(taint) for tol in pod.spec.tolerations):
+            return f"untolerated taint {taint.key}:{taint.effect}"
+    return None
+
+
+def node_is_schedulable(node: t.Node) -> Optional[str]:
+    if node.spec.unschedulable:
+        return "node is unschedulable (cordoned)"
+    cond = t.get_node_condition(node.status, t.NODE_READY)
+    if cond is not None and cond.status != "True":
+        return "node is not Ready"
+    return None
+
+
+def _chip_matches(chip: t.TpuChip, claim: t.PodTpuRequest) -> bool:
+    # Attribute affinity (fork: extended_resources.go:152 isDeviceAMatch).
+    return all(r.matches(chip.attributes) for r in claim.affinity)
+
+
+def pod_fits_tpus(pod: t.Pod, info: NodeInfo) -> Optional[str]:
+    """Per-claim geometric fit. Single-node path: each claim must be
+    satisfiable from this node's free chips alone (gangs use the slice
+    path in gang.py instead)."""
+    if not pod.spec.tpu_resources:
+        return None
+    topo = info.node.status.tpu if info.node else None
+    if topo is None:
+        return "node has no TPUs"
+    # Claims are checked independently but must not share chips.
+    taken: set[str] = set()
+    for claim in pod.spec.tpu_resources:
+        eligible = {cid: c for cid, c in info.free_chips.items()
+                    if cid not in taken and _chip_matches(c, claim)}
+        want = claim.chip_count()
+        if len(eligible) < want:
+            return (f"claim {claim.name!r}: {len(eligible)} matching free "
+                    f"chips, want {want}")
+        coords = {tuple(c.coords): cid for cid, c in eligible.items() if c.coords}
+        if claim.slice_shape:
+            if len(coords) < want:
+                return f"claim {claim.name!r}: chips lack mesh coordinates"
+            cells = find_box(set(coords), topo.mesh_shape, claim.slice_shape)
+            if cells is None:
+                return (f"claim {claim.name!r}: no free contiguous "
+                        f"{'x'.join(map(str, claim.slice_shape))} sub-mesh")
+            for cell in cells:
+                taken.add(coords[cell])
+        else:
+            if len(coords) >= want:
+                cells = allocate_compact(set(coords), topo.mesh_shape, want)
+                for cell in cells or []:
+                    taken.add(coords[cell])
+            else:  # coordless chips (stub plugins): plain counting
+                for cid in list(eligible)[:want]:
+                    taken.add(cid)
+    return None
+
+
+def select_chips(pod: t.Pod, info: NodeInfo) -> Optional[list[t.TpuBinding]]:
+    """Concrete chip choice for a feasible single-node pod (the fork's
+    ``allocateResources``, ``extended_resources.go:113``)."""
+    if not pod.spec.tpu_resources:
+        return []
+    topo = info.node.status.tpu if info.node else None
+    if topo is None:
+        return None
+    bindings: list[t.TpuBinding] = []
+    taken: set[str] = set()
+    for claim in pod.spec.tpu_resources:
+        eligible = {cid: c for cid, c in info.free_chips.items()
+                    if cid not in taken and _chip_matches(c, claim)}
+        want = claim.chip_count()
+        coords = {tuple(c.coords): cid for cid, c in eligible.items() if c.coords}
+        chosen: list[str] = []
+        if claim.slice_shape and len(coords) >= want:
+            cells = find_box(set(coords), topo.mesh_shape, claim.slice_shape)
+            if cells is None:
+                return None
+            chosen = [coords[c] for c in cells]
+        elif len(coords) >= want:
+            cells = allocate_compact(set(coords), topo.mesh_shape, want)
+            if cells is None:
+                return None
+            chosen = [coords[c] for c in cells]
+        else:
+            if len(eligible) < want:
+                return None
+            chosen = sorted(eligible)[:want]
+        taken.update(chosen)
+        bindings.append(t.TpuBinding(name=claim.name, chip_ids=sorted(chosen)))
+    return bindings
+
+
+#: Ordered predicate set (cheap checks first, like the reference's
+#: predicates ordering).
+def run_predicates(pod: t.Pod, info: NodeInfo,
+                   skip_tpu: bool = False) -> PredicateResult:
+    """``skip_tpu=True`` lets the caller run :func:`select_chips` itself
+    (one geometry computation serving fit, score, and selection)."""
+    node = info.node
+    if node is None:
+        return PredicateResult(False, ["node unknown"])
+    checks = [
+        node_is_schedulable(node),
+        pod_tolerates_taints(pod, node),
+        pod_matches_node_selector(pod, node),
+        pod_fits_resources(pod, info),
+    ]
+    if not skip_tpu:
+        checks.append(pod_fits_tpus(pod, info))
+    reasons = [c for c in checks if c]
+    return PredicateResult(not reasons, reasons)

@@ -1,0 +1,138 @@
+"""Scoring — rank feasible nodes.
+
+Reference: ``plugin/pkg/scheduler/algorithm/priorities`` (least
+requested, balanced allocation, selector spread, node-affinity
+preference; map-reduce over nodes). TPU addition:
+:func:`tpu_defrag_score` prefers nodes where the allocation keeps the
+slice's free space contiguous — the scoring half of the fragmentation
+fight (no reference analog; its matcher is flat).
+"""
+from __future__ import annotations
+
+from ..api import types as t
+from .cache import NodeInfo
+from .submesh import allocate_compact, find_box
+
+MAX_SCORE = 10.0
+
+
+def least_requested(pod: t.Pod, info: NodeInfo) -> float:
+    """Favor idle nodes (spreads load)."""
+    alloc = info.allocatable()
+    want = t.pod_resource_requests(pod)
+    score = 0.0
+    n = 0
+    for res in (t.RESOURCE_CPU, t.RESOURCE_MEMORY):
+        cap = alloc.get(res, 0.0)
+        if cap <= 0:
+            continue
+        used = info.requested.get(res, 0.0) + want.get(res, 0.0)
+        score += max(0.0, (cap - used) / cap) * MAX_SCORE
+        n += 1
+    return score / n if n else MAX_SCORE / 2
+
+
+def balanced_allocation(pod: t.Pod, info: NodeInfo) -> float:
+    """Penalize skew between cpu and memory utilization."""
+    alloc = info.allocatable()
+    want = t.pod_resource_requests(pod)
+    fractions = []
+    for res in (t.RESOURCE_CPU, t.RESOURCE_MEMORY):
+        cap = alloc.get(res, 0.0)
+        if cap <= 0:
+            continue
+        fractions.append(min(1.0, (info.requested.get(res, 0.0) + want.get(res, 0.0)) / cap))
+    if len(fractions) < 2:
+        return MAX_SCORE / 2
+    return (1.0 - abs(fractions[0] - fractions[1])) * MAX_SCORE
+
+
+def node_affinity_preferred(pod: t.Pod, info: NodeInfo) -> float:
+    aff = pod.spec.affinity
+    if not aff or not aff.node_preferred or info.node is None:
+        return 0.0
+    labels = info.node.metadata.labels
+    hits = sum(1 for term in aff.node_preferred if term.matches(labels))
+    return MAX_SCORE * hits / len(aff.node_preferred)
+
+
+def selector_spread(pod: t.Pod, info: NodeInfo, sibling_counts: dict[str, int]) -> float:
+    """Fewer same-controller pods on the node = higher score (reference:
+    SelectorSpreadPriority). ``sibling_counts``: node -> count, computed
+    once per scheduling cycle by the caller."""
+    if not sibling_counts:
+        return MAX_SCORE / 2
+    if info.node is None:
+        return 0.0
+    mine = sibling_counts.get(info.node.metadata.name, 0)
+    worst = max(sibling_counts.values())
+    if worst == 0:
+        return MAX_SCORE
+    return MAX_SCORE * (worst - mine) / worst
+
+
+def tpu_defrag_score(pod: t.Pod, info: NodeInfo,
+                     chosen_chip_ids: list[str] | None = None) -> float:
+    """Prefer nodes where the claim packs into corners/used regions.
+
+    Measures how many free chips remain adjacent to the chosen set —
+    fewer exposed free neighbors means tighter packing and larger
+    surviving boxes. ``chosen_chip_ids``: the concrete chips the caller
+    already selected (avoids recomputing the geometry; the scheduler
+    passes the output of ``select_chips``).
+    """
+    chips = t.pod_tpu_chip_count(pod)
+    if not chips:
+        return MAX_SCORE / 2
+    topo = info.node.status.tpu if info.node else None
+    if topo is None:
+        return 0.0
+    coords = info.free_coords()
+    if len(coords) < chips:
+        return 0.0
+    free = set(coords)
+    if chosen_chip_ids is not None:
+        by_id = {cid: coord for coord, cid in coords.items()}
+        cells = [by_id[cid] for cid in chosen_chip_ids if cid in by_id]
+        if len(cells) != len(chosen_chip_ids):
+            return 0.0
+    else:
+        shaped = next((c.slice_shape for c in pod.spec.tpu_resources if c.slice_shape), None)
+        cells = (find_box(free, topo.mesh_shape, shaped) if shaped
+                 else allocate_compact(free, topo.mesh_shape, chips))
+    if not cells:
+        return 0.0
+    from .submesh import _packing_score
+    exposure = _packing_score(list(cells), free, tuple(topo.mesh_shape))
+    worst = 2 * len(cells) * len(topo.mesh_shape)  # all faces exposed
+    return MAX_SCORE * (1.0 - exposure / worst) if worst else MAX_SCORE
+
+
+#: (name, fn(pod, info) -> 0..10, weight)
+DEFAULT_PRIORITIES = [
+    ("LeastRequested", least_requested, 1.0),
+    ("BalancedAllocation", balanced_allocation, 1.0),
+    ("NodeAffinity", node_affinity_preferred, 2.0),
+]
+TPU_DEFRAG_WEIGHT = 2.0
+
+
+def prioritize(pod: t.Pod, infos: list[NodeInfo],
+               sibling_counts: dict[str, int] | None = None,
+               chip_choices: dict[str, list[str]] | None = None) -> dict[str, float]:
+    """``chip_choices``: node name -> chip ids already selected for this
+    pod (from select_chips), so the defrag score reuses the geometry."""
+    scores: dict[str, float] = {}
+    for info in infos:
+        if info.node is None:
+            continue
+        name = info.node.metadata.name
+        total = 0.0
+        for _, fn, weight in DEFAULT_PRIORITIES:
+            total += weight * fn(pod, info)
+        total += TPU_DEFRAG_WEIGHT * tpu_defrag_score(
+            pod, info, (chip_choices or {}).get(name))
+        if sibling_counts is not None:
+            total += 1.0 * selector_spread(pod, info, sibling_counts)
+        scores[name] = total
+    return scores

@@ -1,0 +1,176 @@
+"""Scheduling queue — priority-ordered, gang-aware.
+
+Reference: ``plugin/pkg/scheduler/core/scheduling_queue.go`` (FIFO +
+priority queue with an unschedulable parking lot flushed on cluster
+events). TPU addition: a **gang staging area** — members of a PodGroup
+park until ``min_member`` are present, then the whole gang pops as one
+unit, so partial gangs never consume scheduling cycles or chips.
+"""
+from __future__ import annotations
+
+import asyncio
+import heapq
+import itertools
+from dataclasses import dataclass, field
+from typing import Optional, Union
+
+from ..api import types as t
+
+
+@dataclass(order=True)
+class _Entry:
+    sort_key: tuple
+    item: object = field(compare=False)
+    cancelled: bool = field(default=False, compare=False)
+
+
+@dataclass
+class GangUnit:
+    group_key: str  # namespace/name of the PodGroup
+    pods: list = field(default_factory=list)
+
+
+QueueItem = Union[t.Pod, GangUnit]
+
+
+class SchedulingQueue:
+    def __init__(self):
+        self._heap: list[_Entry] = []
+        self._entries: dict[str, _Entry] = {}
+        self._seq = itertools.count()
+        self._cond = asyncio.Condition()
+        #: gang key -> {pod key -> pod} staged (unbound) members.
+        self._gangs: dict[str, dict[str, t.Pod]] = {}
+        #: gang key -> required member count (from PodGroup.spec.min_member).
+        self._gang_min: dict[str, int] = {}
+        #: gang key -> pod keys already bound. Quorum counts bound +
+        #: staged so a partially-bound gang keeps releasing its remainder.
+        self._gang_bound: dict[str, set[str]] = {}
+        self._closed = False
+
+    # -- producers --------------------------------------------------------
+
+    def _sort_key(self, pod: t.Pod):
+        return (-(t.pod_priority(pod)), next(self._seq))
+
+    async def add_pod(self, pod: t.Pod) -> None:
+        async with self._cond:
+            if pod.spec.gang:
+                self._stage_gang_pod(pod)
+            else:
+                self._push_entry(pod.key(), self._sort_key(pod), pod)
+            self._cond.notify()
+
+    def _push_entry(self, key: str, sort_key, item) -> None:
+        old = self._entries.get(key)
+        if old is not None:
+            old.cancelled = True
+        e = _Entry(sort_key, item)
+        self._entries[key] = e
+        heapq.heappush(self._heap, e)
+
+    def _stage_gang_pod(self, pod: t.Pod) -> None:
+        gk = f"{pod.metadata.namespace}/{pod.spec.gang}"
+        self._gangs.setdefault(gk, {})[pod.key()] = pod
+        self._maybe_release_gang(gk)
+
+    def set_gang_min(self, group_key: str, min_member: int) -> None:
+        """Called when the PodGroup object is seen/updated."""
+        self._gang_min[group_key] = min_member
+        self._maybe_release_gang(group_key)
+
+    def _maybe_release_gang(self, gk: str) -> None:
+        staged = self._gangs.get(gk)
+        need = self._gang_min.get(gk)
+        bound = len(self._gang_bound.get(gk, ()))
+        if not staged or need is None or len(staged) + bound < need:
+            return
+        pods = list(staged.values())
+        best = max(t.pod_priority(p) for p in pods)
+        self._push_entry(f"gang:{gk}", (-best, next(self._seq)),
+                         GangUnit(group_key=gk, pods=pods))
+
+    async def remove_pod(self, pod: t.Pod) -> None:
+        async with self._cond:
+            key = pod.key()
+            e = self._entries.pop(key, None)
+            if e:
+                e.cancelled = True
+            if pod.spec.gang:
+                gk = f"{pod.metadata.namespace}/{pod.spec.gang}"
+                staged = self._gangs.get(gk)
+                if staged:
+                    staged.pop(key, None)
+                bound = self._gang_bound.get(gk)
+                if bound:
+                    bound.discard(key)
+                ge = self._entries.get(f"gang:{gk}")
+                if ge and not ge.cancelled:
+                    ge.cancelled = True
+                    if staged:
+                        self._maybe_release_gang(gk)
+
+    async def requeue(self, item: QueueItem, backoff: float = 0.0) -> None:
+        """Unschedulable item returns to the queue after ``backoff``."""
+        if backoff > 0:
+            loop = asyncio.get_running_loop()
+            loop.call_later(backoff, lambda: loop.create_task(self._requeue_now(item)))
+        else:
+            await self._requeue_now(item)
+
+    async def _requeue_now(self, item: QueueItem) -> None:
+        async with self._cond:
+            if isinstance(item, GangUnit):
+                gk = item.group_key
+                staged = self._gangs.get(gk)
+                if staged:  # releases with current membership
+                    self._maybe_release_gang(gk)
+            else:
+                self._push_entry(item.key(), self._sort_key(item), item)
+            self._cond.notify()
+
+    def gang_pod_confirmed(self, pod: t.Pod) -> None:
+        """A gang member got bound: move it from staging to the bound set
+        so quorum still counts it and the remainder keeps releasing."""
+        gk = f"{pod.metadata.namespace}/{pod.spec.gang}"
+        self._gang_bound.setdefault(gk, set()).add(pod.key())
+        staged = self._gangs.get(gk)
+        if staged:
+            staged.pop(pod.key(), None)
+            if not staged:
+                del self._gangs[gk]
+            else:
+                self._maybe_release_gang(gk)
+
+    def gang_bound_count(self, gk: str) -> int:
+        return len(self._gang_bound.get(gk, ()))
+
+    # -- consumer ---------------------------------------------------------
+
+    async def pop(self) -> Optional[QueueItem]:
+        async with self._cond:
+            while True:
+                while self._heap and self._heap[0].cancelled:
+                    heapq.heappop(self._heap)
+                if self._heap:
+                    e = heapq.heappop(self._heap)
+                    if isinstance(e.item, GangUnit):
+                        self._entries.pop(f"gang:{e.item.group_key}", None)
+                        # Refresh membership at pop time.
+                        staged = self._gangs.get(e.item.group_key)
+                        if staged:
+                            e.item.pods = list(staged.values())
+                    else:
+                        self._entries.pop(e.item.key(), None)
+                    return e.item
+                if self._closed:
+                    return None
+                await self._cond.wait()
+
+    async def close(self) -> None:
+        async with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    def __len__(self) -> int:
+        return sum(1 for e in self._heap if not e.cancelled)

@@ -1,0 +1,420 @@
+"""Scheduler main loop: watch -> queue -> fit -> score -> assume -> bind.
+
+Reference control flow (SURVEY.md section 3.2):
+``plugin/pkg/scheduler/scheduler.go:430 scheduleOne`` ->
+``core/generic_scheduler.go:109 Schedule`` (findNodesThatFit ->
+PrioritizeNodes -> selectHost) -> assume -> async bind -> on failure
+``:199 Preempt``. Differences by design:
+
+- **Gangs**: a GangUnit pops as one item; all members are planned on
+  one slice (gang.py), assumed together, bound concurrently, and
+  rolled back together if any bind fails.
+- **TPU assignment** happens at fit time (predicates select concrete
+  chips) so assume debits exact chip IDs — mirroring the fork's
+  scheduler-cache ER manager, but geometry-aware.
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Optional
+
+from ..api import errors, types as t
+from ..api.scheme import deepcopy
+from ..client.informer import SharedInformer
+from ..client.interface import Client
+from ..client.record import EventRecorder
+from . import metrics as m
+from .cache import SchedulerCache
+from .gang import GangFailure, GangPlan, plan_gang
+from .predicates import run_predicates, select_chips
+from .priorities import prioritize
+from .queue import GangUnit, SchedulingQueue
+
+log = logging.getLogger("scheduler")
+
+
+class Scheduler:
+    def __init__(self, client: Client, name: str = "default-scheduler",
+                 backoff_seconds: float = 1.0):
+        self.client = client
+        self.name = name
+        self.cache = SchedulerCache()
+        self.queue = SchedulingQueue()
+        self.recorder = EventRecorder(client, component=name)
+        self.backoff_seconds = backoff_seconds
+        self._informers: list[SharedInformer] = []
+        self._task: Optional[asyncio.Task] = None
+        self._stopped = False
+
+    # -- wiring (reference: factory.go:137 NewConfigFactory) --------------
+
+    async def start(self) -> None:
+        pods = SharedInformer(self.client, "pods")
+        pods.add_handlers(on_add=self._pod_added, on_update=self._pod_updated,
+                          on_delete=self._pod_deleted)
+        nodes = SharedInformer(self.client, "nodes")
+        nodes.add_handlers(on_add=lambda n: self.cache.set_node(n),
+                           on_update=lambda o, n: self.cache.set_node(n),
+                           on_delete=lambda n: self.cache.remove_node(n.metadata.name))
+        groups = SharedInformer(self.client, "podgroups")
+        groups.add_handlers(on_add=self._group_changed_add,
+                            on_update=self._group_changed)
+        self._informers = [pods, nodes, groups]
+        for inf in self._informers:
+            inf.start()
+        for inf in self._informers:
+            await inf.wait_for_sync()
+        self._task = asyncio.get_running_loop().create_task(self._run())
+
+    async def stop(self) -> None:
+        self._stopped = True
+        await self.queue.close()
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+        for inf in self._informers:
+            await inf.stop()
+
+    # -- informer handlers ------------------------------------------------
+
+    def _relevant(self, pod: t.Pod) -> bool:
+        return (pod.spec.scheduler_name in ("", self.name)
+                and t.is_pod_active(pod))
+
+    def _pod_added(self, pod: t.Pod) -> None:
+        loop = asyncio.get_running_loop()
+        if not pod.spec.node_name and self._relevant(pod):
+            loop.create_task(self.queue.add_pod(pod))
+        elif pod.spec.node_name:
+            self.cache.add_pod(pod)
+            if pod.spec.gang:
+                self.queue.gang_pod_confirmed(pod)
+
+    def _pod_updated(self, old: t.Pod, pod: t.Pod) -> None:
+        loop = asyncio.get_running_loop()
+        if pod.spec.node_name:
+            self.cache.update_pod(pod)
+            if pod.spec.gang:
+                self.queue.gang_pod_confirmed(pod)
+            if not t.is_pod_active(pod):
+                # Terminal pods free their chips for future placements.
+                self.cache.remove_pod(pod)
+        elif self._relevant(pod):
+            loop.create_task(self.queue.add_pod(pod))
+
+    def _pod_deleted(self, pod: t.Pod) -> None:
+        loop = asyncio.get_running_loop()
+        self.cache.remove_pod(pod)
+        loop.create_task(self.queue.remove_pod(pod))
+
+    def _group_changed_add(self, group: t.PodGroup) -> None:
+        self._group_changed(None, group)
+
+    def _group_changed(self, old, group: t.PodGroup) -> None:
+        self.queue.set_gang_min(group.key(), group.spec.min_member)
+
+    # -- main loop --------------------------------------------------------
+
+    async def _run(self) -> None:
+        while not self._stopped:
+            item = await self.queue.pop()
+            if item is None:
+                return
+            m.PENDING_PODS.set(float(len(self.queue)))
+            try:
+                if isinstance(item, GangUnit):
+                    await self._schedule_gang(item)
+                else:
+                    await self._schedule_one(item)
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001
+                log.exception("scheduleOne panic")
+
+    async def _schedule_one(self, pod: t.Pod) -> None:
+        start = time.perf_counter()
+        try:
+            current = await self.client.get("pods", pod.metadata.namespace,
+                                            pod.metadata.name)
+        except errors.NotFoundError:
+            return
+        if current.spec.node_name or not t.is_pod_active(current):
+            return
+        pod = current
+
+        node_name, bindings, reasons = self._find_placement(pod)
+        m.ALGORITHM_LATENCY.observe(time.perf_counter() - start)
+        if node_name is None:
+            await self._handle_unschedulable(pod, reasons)
+            return
+
+        assumed = deepcopy(pod)
+        for claim in assumed.spec.tpu_resources:
+            for b in bindings:
+                if b.name == claim.name:
+                    claim.assigned = list(b.chip_ids)
+        self.cache.assume_pod(assumed, node_name)
+
+        bind_start = time.perf_counter()
+        try:
+            await self.client.bind(pod.metadata.namespace, pod.metadata.name,
+                                   t.Binding(target=t.BindingTarget(
+                                       node_name=node_name, tpu_bindings=bindings)))
+        except Exception as e:  # noqa: BLE001
+            self.cache.forget_pod(assumed)
+            log.warning("bind %s -> %s failed: %s", pod.key(), node_name, e)
+            self.recorder.event(pod, "Warning", "FailedBinding", str(e))
+            await self.queue.requeue(pod, self.backoff_seconds)
+            m.PODS_SCHEDULED.inc(result="bind_error")
+            return
+        m.BINDING_LATENCY.observe(time.perf_counter() - bind_start)
+        m.E2E_SCHEDULING_LATENCY.observe(time.perf_counter() - start)
+        m.PODS_SCHEDULED.inc(result="ok")
+        self.recorder.event(pod, "Normal", "Scheduled",
+                            f"assigned to {node_name}")
+
+    def _find_placement(self, pod: t.Pod):
+        """findNodesThatFit + PrioritizeNodes + selectHost.
+
+        Chip geometry is computed ONCE per node here (select_chips) and
+        reused for the fit decision, the defrag score, and the final
+        binding — the reference recomputes nothing because its matcher
+        is flat; ours is a box search, so reuse matters.
+        """
+        feasible = []
+        reasons: list[str] = []
+        chip_choices: dict[str, list] = {}
+        bindings_by_node: dict[str, list] = {}
+        wants_tpu = bool(pod.spec.tpu_resources)
+        for name, info in self.cache.nodes.items():
+            if info.node is None:
+                continue
+            res = run_predicates(pod, info, skip_tpu=True)
+            if not res.fits:
+                reasons.append(f"{name}: {'; '.join(res.reasons)}")
+                continue
+            if wants_tpu:
+                bindings = select_chips(pod, info)
+                if bindings is None:
+                    from .predicates import pod_fits_tpus
+                    why = pod_fits_tpus(pod, info) or "no feasible chip set"
+                    reasons.append(f"{name}: {why}")
+                    continue
+                bindings_by_node[name] = bindings
+                chip_choices[name] = [cid for b in bindings for cid in b.chip_ids]
+            feasible.append(info)
+        if not feasible:
+            return None, None, reasons
+        sibling_counts = self._sibling_counts(pod)
+        scores = prioritize(pod, feasible, sibling_counts, chip_choices)
+        best = max(scores, key=lambda n: (scores[n], n))
+        return best, bindings_by_node.get(best, []), []
+
+    def _sibling_counts(self, pod: t.Pod) -> dict[str, int]:
+        """Same-controller pods per node (SelectorSpreadPriority input)."""
+        ref = next((r for r in pod.metadata.owner_references if r.controller), None)
+        if ref is None:
+            return {}
+        counts: dict[str, int] = {}
+        for info in self.cache.nodes.values():
+            if info.node is None:
+                continue
+            n = 0
+            for p in info.pods.values():
+                if any(r.uid == ref.uid for r in p.metadata.owner_references):
+                    n += 1
+            counts[info.node.metadata.name] = n
+        return counts
+
+    async def _handle_unschedulable(self, pod: t.Pod, reasons: list[str]) -> None:
+        brief = "; ".join(reasons[:3]) or "no nodes available"
+        log.info("pod %s unschedulable: %s", pod.key(), brief)
+        self.recorder.event(pod, "Warning", "FailedScheduling", brief)
+        cond = t.PodCondition(type=t.COND_POD_SCHEDULED, status="False",
+                              reason="Unschedulable", message=brief)
+        try:
+            current = await self.client.get("pods", pod.metadata.namespace,
+                                            pod.metadata.name)
+            if t.update_pod_condition(current.status, cond):
+                await self.client.update_status(current)
+        except errors.StatusError:
+            pass
+        if t.pod_priority(pod) > 0:
+            victims = await self._preempt(pod)
+            if victims:
+                await self.queue.requeue(pod, 0.1)
+                return
+        await self.queue.requeue(pod, self.backoff_seconds)
+        m.PODS_SCHEDULED.inc(result="unschedulable")
+
+    # -- preemption (reference: generic_scheduler.go:199 Preempt) ---------
+
+    async def _preempt(self, pod: t.Pod) -> list[t.Pod]:
+        """Evict lower-priority pods from the node where doing so costs
+        least and makes ``pod`` feasible."""
+        best_node, best_victims = None, None
+        for name, info in self.cache.nodes.items():
+            if info.node is None:
+                continue
+            victims = self._victims_on_node(pod, info)
+            if victims is None:
+                continue
+            if best_victims is None or self._cheaper(victims, best_victims):
+                best_node, best_victims = name, victims
+        if best_node is None or not best_victims:
+            return []
+        for v in best_victims:
+            try:
+                await self.client.delete("pods", v.metadata.namespace,
+                                         v.metadata.name)
+                m.PREEMPTION_VICTIMS.inc()
+                self.recorder.event(v, "Normal", "Preempted",
+                                    f"by {pod.key()} (priority {t.pod_priority(pod)})")
+            except errors.StatusError:
+                pass
+        try:
+            current = await self.client.get("pods", pod.metadata.namespace,
+                                            pod.metadata.name)
+            current.status.nominated_node_name = best_node
+            await self.client.update_status(current)
+        except errors.StatusError:
+            pass
+        return best_victims
+
+    def _victims_on_node(self, pod: t.Pod, info) -> Optional[list[t.Pod]]:
+        my_prio = t.pod_priority(pod)
+        lower = sorted((p for p in info.pods.values()
+                        if t.pod_priority(p) < my_prio and t.is_pod_active(p)),
+                       key=t.pod_priority)
+        if not lower:
+            return None
+        # Simulate removals cheapest-first until the pod fits.
+        import copy
+        sim = copy.copy(info)
+        sim.pods = dict(info.pods)
+        sim.requested = dict(info.requested)
+        sim.free_chips = dict(info.free_chips)
+        sim.chip_owner = dict(info.chip_owner)
+        victims = []
+        for v in lower:
+            sim.remove_pod(v)
+            victims.append(v)
+            if run_predicates(pod, sim).fits:
+                return victims
+        return None
+
+    @staticmethod
+    def _cheaper(a: list[t.Pod], b: list[t.Pod]) -> bool:
+        ka = (max(t.pod_priority(p) for p in a), len(a))
+        kb = (max(t.pod_priority(p) for p in b), len(b))
+        return ka < kb
+
+    # -- gangs ------------------------------------------------------------
+
+    async def _schedule_gang(self, unit: GangUnit) -> None:
+        start = time.perf_counter()
+        ns, name = unit.group_key.split("/", 1)
+        try:
+            group = await self.client.get("podgroups", ns, name)
+        except errors.NotFoundError:
+            return
+        # Refresh members from the API (queue copies may be stale).
+        pods = []
+        bound = 0
+        for p in unit.pods:
+            try:
+                cur = await self.client.get("pods", p.metadata.namespace,
+                                            p.metadata.name)
+            except errors.NotFoundError:
+                continue
+            if cur.spec.node_name:
+                bound += 1
+            elif t.is_pod_active(cur):
+                pods.append(cur)
+        bound = max(bound, self.queue.gang_bound_count(unit.group_key))
+        if not pods or len(pods) + bound < group.spec.min_member:
+            return  # below quorum; queue re-releases when members return
+
+        # Plan. A partially-bound gang (recovering from a partial bind
+        # failure) can no longer claim the full box — its bound members
+        # already hold chips — so the remainder is planned count-based.
+        if bound:
+            group = deepcopy(group)
+            group.spec.slice_shape = []
+        plan = plan_gang(group, pods, self.cache)
+        m.ALGORITHM_LATENCY.observe(time.perf_counter() - start)
+        if isinstance(plan, GangFailure):
+            brief = "; ".join(plan.reasons[:3])
+            self.recorder.event(group, "Warning", "GangUnschedulable", brief)
+            await self._set_group_phase(group, t.PODGROUP_PENDING, brief)
+            # Members stay staged in the queue; the requeue re-releases the
+            # gang with current membership after backoff.
+            await self.queue.requeue(GangUnit(unit.group_key, pods),
+                                     self.backoff_seconds)
+            m.PODS_SCHEDULED.inc(result="gang_unschedulable", amount=len(pods))
+            return
+
+        # assume all
+        assumed_pods = []
+        for pod, node_name, bindings in plan.placements:
+            assumed = deepcopy(pod)
+            for claim in assumed.spec.tpu_resources:
+                for b in bindings:
+                    if b.name == claim.name:
+                        claim.assigned = list(b.chip_ids)
+            self.cache.assume_pod(assumed, node_name)
+            assumed_pods.append(assumed)
+
+        # bind all concurrently; all-or-nothing
+        async def bind_one(pod, node_name, bindings):
+            await self.client.bind(pod.metadata.namespace, pod.metadata.name,
+                                   t.Binding(target=t.BindingTarget(
+                                       node_name=node_name, tpu_bindings=bindings)))
+
+        bind_start = time.perf_counter()
+        results = await asyncio.gather(
+            *(bind_one(p, n, b) for p, n, b in plan.placements),
+            return_exceptions=True)
+        failures = [r for r in results if isinstance(r, Exception)]
+        if failures:
+            # Forget ONLY the members whose bind failed — successful binds
+            # are durable state; their assumed entries are confirmed by the
+            # watch. The gang requeues for the failed remainder (quorum
+            # counts the bound members).
+            for assumed, result in zip(assumed_pods, results):
+                if isinstance(result, Exception):
+                    self.cache.forget_pod(assumed)
+                else:
+                    self.queue.gang_pod_confirmed(assumed)
+            self.recorder.event(group, "Warning", "GangBindFailed",
+                                f"{len(failures)} binds failed: {failures[0]}")
+            await self.queue.requeue(GangUnit(unit.group_key, pods),
+                                     self.backoff_seconds)
+            m.PODS_SCHEDULED.inc(result="gang_bind_error")
+            return
+        m.BINDING_LATENCY.observe(time.perf_counter() - bind_start)
+        m.GANG_SCHEDULING_LATENCY.observe(time.perf_counter() - start)
+        m.PODS_SCHEDULED.inc(amount=len(plan.placements), result="ok")
+        await self._set_group_phase(group, t.PODGROUP_SCHEDULED,
+                                    f"on slice {plan.slice_id}",
+                                    slice_id=plan.slice_id,
+                                    scheduled=len(plan.placements))
+        self.recorder.event(group, "Normal", "GangScheduled",
+                            f"{len(plan.placements)} pods on slice {plan.slice_id}")
+
+    async def _set_group_phase(self, group: t.PodGroup, phase: str, msg: str,
+                               slice_id: str = "", scheduled: int = 0) -> None:
+        try:
+            cur = await self.client.get("podgroups", group.metadata.namespace,
+                                        group.metadata.name)
+            cur.status.phase = phase
+            cur.status.slice_id = slice_id or cur.status.slice_id
+            cur.status.scheduled = scheduled or cur.status.scheduled
+            await self.client.update_status(cur)
+        except errors.StatusError:
+            pass

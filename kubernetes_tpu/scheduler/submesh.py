@@ -1,0 +1,207 @@
+"""Contiguous ICI sub-mesh allocation on a 3D (torus) chip mesh.
+
+This is the TPU-first replacement for the reference's flat
+extended-resource matcher (``plugin/pkg/scheduler/core/
+extended_resources.go:113-150 allocateResources`` — count + attribute
+matching with no notion of inter-device distance). On TPU, a JAX mesh
+only gets full ICI bandwidth if its chips form a *contiguous axis-
+aligned box* of the slice's 3D mesh (wrap-around links make each axis a
+ring on full-axis slices), so allocation here is geometric:
+
+- **Shaped requests** (``slice_shape=[a,b,c]``): find an axis-aligned
+  a*b*c box of free chips, trying all axis permutations of the shape
+  and all origins, with torus wrap-around per axis. First fit wins
+  among candidates with the best packing score.
+- **Count requests** (``chips=N``): greedy BFS over the free-chip
+  neighbor graph from the most corner-packed free chip, so the chosen
+  set is as compact as connectivity allows.
+- **Scoring** prefers allocations that touch already-used regions
+  (corner packing) to fight fragmentation — the NP-hard part of
+  SURVEY.md section 7, handled with a cheap, deterministic heuristic.
+
+Pure geometry, no API-object types: the scheduler cache feeds it free
+coordinate sets. A C++ fast path (native/submesh.cpp) accelerates the
+box search for big slices; this module is the reference implementation
+and fallback.
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Iterable, Optional, Sequence
+
+Coord = tuple[int, ...]
+
+
+def normalize_shape(shape: Sequence[int], rank: int) -> tuple[int, ...]:
+    """Pad a request shape with 1s up to the mesh rank: [4] -> (4,1,1)."""
+    s = tuple(int(d) for d in shape)
+    if len(s) > rank:
+        # Drop trailing 1s if possible ([2,2,1] on a 2D mesh -> (2,2)).
+        while len(s) > rank and s[-1] == 1:
+            s = s[:-1]
+        if len(s) > rank:
+            return s  # unsatisfiable; caller sees volume/dim mismatch
+    return s + (1,) * (rank - len(s))
+
+
+def box_coords(origin: Coord, shape: Coord, mesh: Coord, torus: bool) -> Optional[list[Coord]]:
+    """Cells of the axis-aligned box at ``origin``; None if out of bounds."""
+    for o, s, m in zip(origin, shape, mesh):
+        if not torus and o + s > m:
+            return None
+        if s > m:
+            return None
+    ranges = []
+    for o, s, m in zip(origin, shape, mesh):
+        ranges.append([(o + i) % m for i in range(s)])
+    return [tuple(c) for c in itertools.product(*ranges)]
+
+
+def _packing_score(cells: list[Coord], free: set[Coord], mesh: Coord) -> float:
+    """Lower is better: prefer boxes whose neighbors are NOT free (touching
+    walls or used regions), keeping the free space consolidated."""
+    cellset = set(cells)
+    free_neighbors = 0
+    for c in cells:
+        for n in _neighbors(c, mesh, True):
+            if n not in cellset and n in free:
+                free_neighbors += 1
+    return free_neighbors
+
+
+def find_box(free: set[Coord], mesh: Sequence[int], shape: Sequence[int],
+             torus: bool = True) -> Optional[list[Coord]]:
+    """Best free axis-aligned box of ``shape`` (any axis permutation).
+
+    Returns the cell list or None. Deterministic: scans origins in
+    lexicographic order, keeps the best packing score.
+    """
+    mesh = tuple(int(m) for m in mesh)
+    rank = len(mesh)
+    shape_n = normalize_shape(shape, rank)
+    if len(shape_n) != rank:
+        return None
+    vol = 1
+    for d in shape_n:
+        vol *= d
+    if vol > len(free):
+        return None
+
+    tried: set[tuple[int, ...]] = set()
+    best: Optional[list[Coord]] = None
+    best_score = float("inf")
+    for perm in set(itertools.permutations(shape_n)):
+        if perm in tried:
+            continue
+        tried.add(perm)
+        if any(p > m for p, m in zip(perm, mesh)):
+            continue
+        # Wrap origins are only meaningful on axes where the box doesn't
+        # already span the whole ring.
+        for origin in itertools.product(*(range(m) for m in mesh)):
+            if not torus and any(o + s > m for o, s, m in zip(origin, perm, mesh)):
+                continue
+            cells = box_coords(origin, perm, mesh, torus)
+            if cells is None or any(c not in free for c in cells):
+                continue
+            score = _packing_score(cells, free, mesh)
+            if score < best_score:
+                best, best_score = cells, score
+                if score == 0:
+                    return best
+    return best
+
+
+def _neighbors(c: Coord, mesh: Coord, torus: bool) -> Iterable[Coord]:
+    seen = {c}  # wrap on size-1/2 axes maps ±1 to self / one cell: dedupe
+    for axis in range(len(mesh)):
+        for d in (-1, 1):
+            n = list(c)
+            if torus:
+                n[axis] = (n[axis] + d) % mesh[axis]
+            else:
+                n[axis] += d
+                if not (0 <= n[axis] < mesh[axis]):
+                    continue
+            nt = tuple(n)
+            if nt not in seen:
+                seen.add(nt)
+                yield nt
+
+
+def allocate_compact(free: set[Coord], mesh: Sequence[int], count: int,
+                     torus: bool = True) -> Optional[list[Coord]]:
+    """Pick ``count`` free chips as compactly as connectivity allows.
+
+    Greedy BFS from the free chip with the fewest free neighbors (most
+    corner-packed), expanding toward cells adjacent to the chosen set.
+    Falls back to lexicographic fill if the free set is disconnected.
+    """
+    if count <= 0:
+        return []
+    if count > len(free):
+        return None
+    mesh = tuple(int(m) for m in mesh)
+
+    # Seed: most-constrained free cell (ties broken lexicographically).
+    def free_degree(c: Coord) -> int:
+        return sum(1 for n in _neighbors(c, mesh, torus) if n in free)
+
+    seed = min(sorted(free), key=free_degree)
+    chosen: list[Coord] = [seed]
+    chosen_set = {seed}
+    frontier: set[Coord] = {n for n in _neighbors(seed, mesh, torus) if n in free}
+    while len(chosen) < count:
+        if frontier:
+            # Prefer frontier cells with most chosen neighbors (compactness),
+            # then fewest free neighbors (corner packing).
+            def key(c: Coord):
+                chosen_adj = sum(1 for n in _neighbors(c, mesh, torus) if n in chosen_set)
+                return (-chosen_adj, free_degree(c), c)
+
+            nxt = min(frontier, key=key)
+            frontier.discard(nxt)
+        else:
+            remaining = sorted(free - chosen_set)
+            if not remaining:
+                return None
+            nxt = remaining[0]
+        chosen.append(nxt)
+        chosen_set.add(nxt)
+        for n in _neighbors(nxt, mesh, torus):
+            if n in free and n not in chosen_set:
+                frontier.add(n)
+    return chosen
+
+
+def shape_for_count(count: int, mesh: Sequence[int]) -> Optional[tuple[int, ...]]:
+    """Smallest-surface box shape with exactly ``count`` cells fitting in
+    ``mesh`` (used to upgrade count requests to shaped ones when exact)."""
+    mesh = tuple(int(m) for m in mesh)
+    best = None
+    best_surface = None
+
+    def boxes(n: int, dims: int):
+        if dims == 1:
+            yield (n,)
+            return
+        for d in range(1, n + 1):
+            if n % d == 0:
+                for rest in boxes(n // d, dims - 1):
+                    yield (d,) + rest
+
+    for shape in boxes(count, len(mesh)):
+        if any(s > m for s, m in zip(sorted(shape, reverse=True),
+                                     sorted(mesh, reverse=True))):
+            continue
+        # surface area ~ communication cost of the bounding box
+        surface = 0
+        for i in range(len(shape)):
+            face = 1
+            for j, s in enumerate(shape):
+                if j != i:
+                    face *= s
+            surface += 2 * face
+        if best is None or surface < best_surface:
+            best, best_surface = shape, surface
+    return best

@@ -1,0 +1,91 @@
+"""Partial gang bind failure: the remainder must recover, chips must
+never double-allocate (review findings on the gang path)."""
+import asyncio
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.meta import ObjectMeta
+
+import sys
+import os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from integration.test_scheduler import make_cluster, mk_node, mk_pod, wait_bound  # noqa: E402
+
+
+async def test_partial_gang_bind_failure_recovers():
+    n1 = mk_node("host-0", chips=[(0, 0, 0), (0, 1, 0), (1, 0, 0), (1, 1, 0)],
+                 mesh=[2, 2, 2], slice_id="sl")
+    n2 = mk_node("host-1", chips=[(0, 0, 1), (0, 1, 1), (1, 0, 1), (1, 1, 1)],
+                 mesh=[2, 2, 2], slice_id="sl")
+    reg, client, sched = await make_cluster([n1, n2])
+    try:
+        # Fail the FIRST bind POST for pod w1, succeed afterwards.
+        real_bind = client.bind
+        fails = {"w1": 1}
+
+        async def flaky_bind(namespace, name, binding):
+            if fails.get(name, 0) > 0:
+                fails[name] -= 1
+                raise ConnectionResetError("synthetic bind failure")
+            return await real_bind(namespace, name, binding)
+
+        sched.client.bind = flaky_bind
+
+        reg.create(t.PodGroup(metadata=ObjectMeta(name="g", namespace="default"),
+                              spec=t.PodGroupSpec(min_member=2)))
+        reg.create(mk_pod("w0", chips=4, gang="g"))
+        reg.create(mk_pod("w1", chips=4, gang="g"))
+
+        p0 = await wait_bound(reg, "w0", timeout=8)
+        p1 = await wait_bound(reg, "w1", timeout=8)
+        assert p0.spec.node_name and p1.spec.node_name, (
+            p0.spec.node_name, p1.spec.node_name)
+        s0 = set(p0.spec.tpu_resources[0].assigned)
+        s1 = set(p1.spec.tpu_resources[0].assigned)
+        assert len(s0) == 4 and len(s1) == 4
+        assert not (s0 & s1), "chips double-allocated after partial failure"
+    finally:
+        await sched.stop()
+
+
+async def test_aux_pod_accounts_for_gang_cpu():
+    # Host has 4 cpu; TPU member wants 3, aux coordinator wants 3: they
+    # must NOT land on the same host both (3+3 > 4).
+    n1 = mk_node("host-0", cpu=4.0, chips=[(0, 0, 0), (0, 1, 0)], mesh=[2, 2, 1],
+                 slice_id="sl")
+    n2 = mk_node("host-1", cpu=4.0)
+    reg, client, sched = await make_cluster([n1, n2])
+    try:
+        reg.create(t.PodGroup(metadata=ObjectMeta(name="g", namespace="default"),
+                              spec=t.PodGroupSpec(min_member=2)))
+        reg.create(mk_pod("worker", cpu=3.0, chips=2, gang="g"))
+        reg.create(mk_pod("coord", cpu=3.0, gang="g"))
+        pw = await wait_bound(reg, "worker", timeout=8)
+        pc = await wait_bound(reg, "coord", timeout=8)
+        assert pw.spec.node_name == "host-0"
+        assert pc.spec.node_name == "host-1", "aux pod overcommitted the TPU host"
+    finally:
+        await sched.stop()
+
+
+async def test_gang_affinity_respected():
+    chips = [(0, 0, 0), (0, 1, 0), (1, 0, 0), (1, 1, 0)]
+    node = mk_node("host-0", chips=chips, mesh=[2, 2, 1], slice_id="sl")
+    # Two chips are a different generation.
+    for c in node.status.tpu.chips[:2]:
+        c.attributes["chip_type"] = "v4"
+    reg, client, sched = await make_cluster([node])
+    try:
+        from kubernetes_tpu.api.selectors import Requirement
+
+        reg.create(t.PodGroup(metadata=ObjectMeta(name="g", namespace="default"),
+                              spec=t.PodGroupSpec(min_member=1)))
+        pod = mk_pod("picky", chips=2, gang="g")
+        pod.spec.tpu_resources[0].affinity = [Requirement("chip_type", "In", ["v5p"])]
+        reg.create(pod)
+        p = await wait_bound(reg, "picky", timeout=8)
+        assert p.spec.node_name == "host-0"
+        topo = reg.get("nodes", "", "host-0").status.tpu
+        types = {c.id: c.attributes["chip_type"] for c in topo.chips}
+        assert all(types[cid] == "v5p" for cid in p.spec.tpu_resources[0].assigned)
+    finally:
+        await sched.stop()

@@ -1,0 +1,237 @@
+"""Scheduler end-to-end against the in-proc control plane (reference
+tier: test/integration/scheduler)."""
+import asyncio
+
+import pytest
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.apiserver.admission import default_chain
+from kubernetes_tpu.apiserver.registry import Registry
+from kubernetes_tpu.client.local import LocalClient
+from kubernetes_tpu.scheduler.scheduler import Scheduler
+
+
+def mk_node(name, cpu=8.0, mem=32 * 2**30, tpu=None, slice_id="", mesh=None,
+            chips=None):
+    node = t.Node(metadata=ObjectMeta(name=name))
+    node.status.capacity = {"cpu": cpu, "memory": mem, "pods": 110}
+    node.status.allocatable = dict(node.status.capacity)
+    node.status.conditions = [t.NodeCondition(type=t.NODE_READY, status="True")]
+    if chips is not None:
+        node.status.tpu = t.TpuTopology(
+            chip_type="v5p", slice_id=slice_id or f"slice-{name}",
+            mesh_shape=mesh or [2, 2, 1],
+            chips=[t.TpuChip(id=f"{name}-c{i}", coords=list(co),
+                             attributes={"chip_type": "v5p"})
+                   for i, co in enumerate(chips)])
+        node.status.capacity[t.RESOURCE_TPU] = float(len(chips))
+        node.status.allocatable[t.RESOURCE_TPU] = float(len(chips))
+    return node
+
+
+def mk_pod(name, cpu=1.0, chips=0, slice_shape=None, gang="", priority=None):
+    pod = t.Pod(metadata=ObjectMeta(name=name, namespace="default"),
+                spec=t.PodSpec(containers=[t.Container(
+                    name="c", image="i",
+                    resources=t.ResourceRequirements(requests={"cpu": cpu}))]))
+    if chips or slice_shape:
+        pod.spec.containers[0].tpu_requests = ["tpu"]
+        pod.spec.tpu_resources = [t.PodTpuRequest(
+            name="tpu", chips=chips, slice_shape=slice_shape or [])]
+    pod.spec.gang = gang
+    if priority is not None:
+        pod.spec.priority = priority
+    return pod
+
+
+async def make_cluster(nodes):
+    reg = Registry()
+    reg.admission = default_chain(reg)
+    reg.create(t.Namespace(metadata=ObjectMeta(name="default")))
+    client = LocalClient(reg)
+    for n in nodes:
+        reg.create(n)
+    sched = Scheduler(client, backoff_seconds=0.2)
+    await sched.start()
+    return reg, client, sched
+
+
+async def wait_bound(reg, name, timeout=5.0):
+    for _ in range(int(timeout / 0.05)):
+        pod = reg.get("pods", "default", name)
+        if pod.spec.node_name:
+            return pod
+        await asyncio.sleep(0.05)
+    return reg.get("pods", "default", name)
+
+
+async def test_schedules_cpu_pod():
+    reg, client, sched = await make_cluster([mk_node("n1"), mk_node("n2")])
+    try:
+        reg.create(mk_pod("p1"))
+        pod = await wait_bound(reg, "p1")
+        assert pod.spec.node_name in ("n1", "n2")
+        cond = t.get_pod_condition(pod.status, t.COND_POD_SCHEDULED)
+        assert cond and cond.status == "True"
+    finally:
+        await sched.stop()
+
+
+async def test_assigns_contiguous_chips():
+    square = [(0, 0, 0), (0, 1, 0), (1, 0, 0), (1, 1, 0)]
+    reg, client, sched = await make_cluster([
+        mk_node("tpu-1", chips=square, mesh=[2, 2, 1])])
+    try:
+        reg.create(mk_pod("train", slice_shape=[2, 1, 1]))
+        pod = await wait_bound(reg, "train")
+        assert pod.spec.node_name == "tpu-1"
+        assigned = pod.spec.tpu_resources[0].assigned
+        assert len(assigned) == 2
+        # The two chips must be mesh neighbors (contiguity).
+        topo = reg.get("nodes", "", "tpu-1").status.tpu
+        coords = {c.id: tuple(c.coords) for c in topo.chips}
+        a, b = [coords[c] for c in assigned]
+        assert sum(abs(x - y) for x, y in zip(a, b)) == 1
+    finally:
+        await sched.stop()
+
+
+async def test_unschedulable_sets_condition_then_recovers():
+    reg, client, sched = await make_cluster([mk_node("small", cpu=1.0)])
+    try:
+        reg.create(mk_pod("big", cpu=4.0))
+        await asyncio.sleep(0.5)
+        pod = reg.get("pods", "default", "big")
+        assert not pod.spec.node_name
+        cond = t.get_pod_condition(pod.status, t.COND_POD_SCHEDULED)
+        assert cond and cond.status == "False" and cond.reason == "Unschedulable"
+        # Add capacity; backoff retry must place it.
+        reg.create(mk_node("big-node", cpu=16.0))
+        pod = await wait_bound(reg, "big", timeout=5)
+        assert pod.spec.node_name == "big-node"
+    finally:
+        await sched.stop()
+
+
+async def test_tpu_chips_not_double_allocated():
+    square = [(0, 0, 0), (0, 1, 0), (1, 0, 0), (1, 1, 0)]
+    reg, client, sched = await make_cluster([
+        mk_node("tpu-1", chips=square, mesh=[2, 2, 1])])
+    try:
+        reg.create(mk_pod("a", chips=2))
+        reg.create(mk_pod("b", chips=2))
+        pa = await wait_bound(reg, "a")
+        pb = await wait_bound(reg, "b")
+        assert pa.spec.node_name and pb.spec.node_name
+        sa = set(pa.spec.tpu_resources[0].assigned)
+        sb = set(pb.spec.tpu_resources[0].assigned)
+        assert sa and sb and not (sa & sb)
+        # A third 2-chip pod must stay pending (0 free chips).
+        reg.create(mk_pod("c", chips=2))
+        await asyncio.sleep(0.4)
+        assert not reg.get("pods", "default", "c").spec.node_name
+        # Free chips by deleting a; c must then schedule.
+        reg.delete("pods", "default", "a", grace_period_seconds=0)
+        pc = await wait_bound(reg, "c", timeout=5)
+        assert pc.spec.node_name
+    finally:
+        await sched.stop()
+
+
+async def test_gang_all_or_nothing():
+    # Two 4-chip hosts forming one 2x2x2 slice.
+    n1 = mk_node("host-0", chips=[(0, 0, 0), (0, 1, 0), (1, 0, 0), (1, 1, 0)],
+                 mesh=[2, 2, 2], slice_id="sl")
+    n2 = mk_node("host-1", chips=[(0, 0, 1), (0, 1, 1), (1, 0, 1), (1, 1, 1)],
+                 mesh=[2, 2, 2], slice_id="sl")
+    reg, client, sched = await make_cluster([n1, n2])
+    try:
+        reg.create(t.PodGroup(metadata=ObjectMeta(name="g", namespace="default"),
+                              spec=t.PodGroupSpec(min_member=2,
+                                                  slice_shape=[2, 2, 2])))
+        reg.create(mk_pod("w0", chips=4, gang="g"))
+        # Only one member staged: nothing must bind yet.
+        await asyncio.sleep(0.4)
+        assert not reg.get("pods", "default", "w0").spec.node_name
+        reg.create(mk_pod("w1", chips=4, gang="g"))
+        p0 = await wait_bound(reg, "w0")
+        p1 = await wait_bound(reg, "w1")
+        assert {p0.spec.node_name, p1.spec.node_name} == {"host-0", "host-1"}
+        assert len(p0.spec.tpu_resources[0].assigned) == 4
+        assert len(p1.spec.tpu_resources[0].assigned) == 4
+        group = reg.get("podgroups", "default", "g")
+        assert group.status.phase == t.PODGROUP_SCHEDULED
+        assert group.status.slice_id == "sl"
+    finally:
+        await sched.stop()
+
+
+async def test_gang_does_not_partially_consume():
+    # Slice only has 4 chips but gang needs 8: neither member may bind,
+    # and a small non-gang pod must still get chips afterwards.
+    n1 = mk_node("host-0", chips=[(0, 0, 0), (0, 1, 0), (1, 0, 0), (1, 1, 0)],
+                 mesh=[2, 2, 1], slice_id="sl")
+    reg, client, sched = await make_cluster([n1])
+    try:
+        reg.create(t.PodGroup(metadata=ObjectMeta(name="g", namespace="default"),
+                              spec=t.PodGroupSpec(min_member=2)))
+        reg.create(mk_pod("w0", chips=4, gang="g"))
+        reg.create(mk_pod("w1", chips=4, gang="g"))
+        await asyncio.sleep(0.5)
+        assert not reg.get("pods", "default", "w0").spec.node_name
+        assert not reg.get("pods", "default", "w1").spec.node_name
+        reg.create(mk_pod("solo", chips=4))
+        pod = await wait_bound(reg, "solo")
+        assert pod.spec.node_name == "host-0"
+    finally:
+        await sched.stop()
+
+
+async def test_preemption_by_priority():
+    reg, client, sched = await make_cluster([mk_node("n1", cpu=4.0)])
+    try:
+        reg.create(mk_pod("low", cpu=3.0, priority=0))
+        await wait_bound(reg, "low")
+        reg.create(mk_pod("high", cpu=3.0, priority=1000))
+        pod = await wait_bound(reg, "high", timeout=8)
+        assert pod.spec.node_name == "n1"
+        low = reg.get("pods", "default", "low")
+        assert low.metadata.deletion_timestamp is not None
+    finally:
+        await sched.stop()
+
+
+async def test_taints_and_tolerations():
+    tainted = mk_node("dedicated")
+    tainted.spec.taints = [t.Taint(key="team", value="ml", effect="NoSchedule")]
+    reg, client, sched = await make_cluster([tainted])
+    try:
+        reg.create(mk_pod("plain"))
+        await asyncio.sleep(0.4)
+        assert not reg.get("pods", "default", "plain").spec.node_name
+        tolerant = mk_pod("tolerant")
+        tolerant.spec.tolerations = [t.Toleration(key="team", operator="Equal",
+                                                  value="ml", effect="NoSchedule")]
+        reg.create(tolerant)
+        pod = await wait_bound(reg, "tolerant")
+        assert pod.spec.node_name == "dedicated"
+    finally:
+        await sched.stop()
+
+
+async def test_unhealthy_chips_not_allocated():
+    chips = [(0, 0, 0), (0, 1, 0), (1, 0, 0), (1, 1, 0)]
+    node = mk_node("tpu-1", chips=chips, mesh=[2, 2, 1])
+    node.status.tpu.chips[0].health = t.TPU_UNHEALTHY
+    reg, client, sched = await make_cluster([node])
+    try:
+        reg.create(mk_pod("p", chips=4))
+        await asyncio.sleep(0.4)
+        assert not reg.get("pods", "default", "p").spec.node_name
+        reg.create(mk_pod("q", chips=3))
+        pod = await wait_bound(reg, "q")
+        bad = node.status.tpu.chips[0].id
+        assert bad not in pod.spec.tpu_resources[0].assigned
+    finally:
+        await sched.stop()
