@@ -1,0 +1,9 @@
+import os as _os
+import sys as _sys
+
+# Generated protobuf module references itself as top-level `api_pb2`.
+_sys.path.insert(0, _os.path.dirname(_os.path.abspath(__file__)))
+
+from . import api_pb2  # noqa: E402,F401
+from .service import (TpuDevicePluginClient, TpuDevicePluginServicer,  # noqa: E402,F401
+                      add_servicer_to_server)

@@ -1,0 +1,102 @@
+"""gRPC service plumbing for the device-plugin API.
+
+grpc_tools (the protoc gRPC python plugin) is not in the image, so the
+service/stub layer is written against grpc's generic handler API with
+protoc-generated message classes — functionally identical to generated
+``*_pb2_grpc.py`` code (method paths follow the same
+``/package.Service/Method`` convention, so foreign gRPC clients
+interoperate).
+"""
+from __future__ import annotations
+
+from typing import Iterator
+
+import grpc
+
+from . import api_pb2 as pb
+
+SERVICE = "tpudeviceplugin.v1.TpuDevicePlugin"
+
+
+class TpuDevicePluginServicer:
+    """Subclass and override; default implementations reject."""
+
+    def GetPluginInfo(self, request: pb.Empty, context) -> pb.PluginInfo:
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "GetPluginInfo")
+
+    def ListAndWatch(self, request: pb.Empty, context) -> Iterator[pb.TopologyUpdate]:
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "ListAndWatch")
+
+    def AdmitPod(self, request: pb.AdmitPodRequest, context) -> pb.AdmitPodResponse:
+        return pb.AdmitPodResponse(allowed=True)
+
+    def InitContainer(self, request: pb.InitContainerRequest,
+                      context) -> pb.InitContainerResponse:
+        return pb.InitContainerResponse()
+
+
+def add_servicer_to_server(servicer: TpuDevicePluginServicer, server: grpc.Server) -> None:
+    handlers = {
+        "GetPluginInfo": grpc.unary_unary_rpc_method_handler(
+            servicer.GetPluginInfo,
+            request_deserializer=pb.Empty.FromString,
+            response_serializer=pb.PluginInfo.SerializeToString),
+        "ListAndWatch": grpc.unary_stream_rpc_method_handler(
+            servicer.ListAndWatch,
+            request_deserializer=pb.Empty.FromString,
+            response_serializer=pb.TopologyUpdate.SerializeToString),
+        "AdmitPod": grpc.unary_unary_rpc_method_handler(
+            servicer.AdmitPod,
+            request_deserializer=pb.AdmitPodRequest.FromString,
+            response_serializer=pb.AdmitPodResponse.SerializeToString),
+        "InitContainer": grpc.unary_unary_rpc_method_handler(
+            servicer.InitContainer,
+            request_deserializer=pb.InitContainerRequest.FromString,
+            response_serializer=pb.InitContainerResponse.SerializeToString),
+    }
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(SERVICE, handlers),))
+
+
+class TpuDevicePluginClient:
+    """Blocking client over a unix socket (callers wrap in to_thread)."""
+
+    def __init__(self, socket_path: str):
+        self.socket_path = socket_path
+        self._channel = grpc.insecure_channel(f"unix://{socket_path}")
+        p = f"/{SERVICE}/"
+        self._get_info = self._channel.unary_unary(
+            p + "GetPluginInfo", request_serializer=pb.Empty.SerializeToString,
+            response_deserializer=pb.PluginInfo.FromString)
+        self._law = self._channel.unary_stream(
+            p + "ListAndWatch", request_serializer=pb.Empty.SerializeToString,
+            response_deserializer=pb.TopologyUpdate.FromString)
+        self._admit = self._channel.unary_unary(
+            p + "AdmitPod", request_serializer=pb.AdmitPodRequest.SerializeToString,
+            response_deserializer=pb.AdmitPodResponse.FromString)
+        self._init = self._channel.unary_unary(
+            p + "InitContainer",
+            request_serializer=pb.InitContainerRequest.SerializeToString,
+            response_deserializer=pb.InitContainerResponse.FromString)
+
+    def get_plugin_info(self, timeout: float = 5.0) -> pb.PluginInfo:
+        return self._get_info(pb.Empty(), timeout=timeout)
+
+    def list_and_watch(self) -> Iterator[pb.TopologyUpdate]:
+        return self._law(pb.Empty())
+
+    def admit_pod(self, namespace: str, name: str, uid: str,
+                  chip_ids: list[str], timeout: float = 5.0) -> pb.AdmitPodResponse:
+        return self._admit(pb.AdmitPodRequest(
+            pod_namespace=namespace, pod_name=name, pod_uid=uid,
+            chip_ids=chip_ids), timeout=timeout)
+
+    def init_container(self, namespace: str, name: str, uid: str,
+                       container: str, chip_ids: list[str],
+                       timeout: float = 5.0) -> pb.InitContainerResponse:
+        return self._init(pb.InitContainerRequest(
+            pod_namespace=namespace, pod_name=name, pod_uid=uid,
+            container_name=container, chip_ids=chip_ids), timeout=timeout)
+
+    def close(self) -> None:
+        self._channel.close()

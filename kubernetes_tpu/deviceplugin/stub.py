@@ -1,0 +1,136 @@
+"""Stub TPU device plugin — the hardware-free test double.
+
+Reference: ``pkg/kubelet/cm/devicemanager/device_plugin_stub.go:57
+NewDevicePluginStub`` — an in-process fake vendor plugin serving the
+real gRPC API over a temp socket; the pattern for exercising the whole
+device flow (registration, ListAndWatch, admit, init) without chips.
+Used by unit tests, node e2e, and kubemark hollow TPU nodes.
+"""
+from __future__ import annotations
+
+import itertools
+import os
+import queue
+import threading
+from concurrent import futures
+from typing import Iterator, Optional
+
+import grpc
+
+from . import api_pb2 as pb
+from .service import TpuDevicePluginServicer, add_servicer_to_server
+
+
+def make_topology(chip_type: str = "v5p", slice_id: str = "stub-slice",
+                  mesh_shape: tuple = (2, 2, 1), worker_index: int = 0,
+                  host_chips: Optional[list[tuple]] = None,
+                  id_prefix: str = "chip") -> pb.TopologyUpdate:
+    """Build a TopologyUpdate; ``host_chips``: list of coord tuples this
+    host owns (default: the whole mesh)."""
+    if host_chips is None:
+        host_chips = list(itertools.product(*(range(d) for d in mesh_shape)))
+    u = pb.TopologyUpdate(chip_type=chip_type, slice_id=slice_id,
+                          mesh_shape=list(mesh_shape), worker_index=worker_index)
+    for i, coords in enumerate(host_chips):
+        u.chips.add(id=f"{id_prefix}-{i}", health="Healthy",
+                    coords=list(coords),
+                    attributes={"chip_type": chip_type})
+    return u
+
+
+class StubTpuPlugin(TpuDevicePluginServicer):
+    def __init__(self, topology: pb.TopologyUpdate, resource: str = "google.com/tpu"):
+        self.resource = resource
+        self._topology = topology
+        self._subscribers: list[queue.Queue] = []
+        self._lock = threading.Lock()
+        self.admit_calls: list[pb.AdmitPodRequest] = []
+        self.init_calls: list[pb.InitContainerRequest] = []
+        #: Set to a reason string to make AdmitPod reject.
+        self.reject_reason: Optional[str] = None
+        self._server: Optional[grpc.Server] = None
+        self.socket_path: Optional[str] = None
+
+    # -- service ----------------------------------------------------------
+
+    def GetPluginInfo(self, request, context) -> pb.PluginInfo:
+        return pb.PluginInfo(resource=self.resource, version="v1")
+
+    def ListAndWatch(self, request, context) -> Iterator[pb.TopologyUpdate]:
+        q: queue.Queue = queue.Queue()
+        with self._lock:
+            self._subscribers.append(q)
+            snapshot = pb.TopologyUpdate()
+            snapshot.CopyFrom(self._topology)
+        yield snapshot
+        try:
+            while True:
+                update = q.get()
+                if update is None:
+                    return
+                yield update
+        finally:
+            with self._lock:
+                if q in self._subscribers:
+                    self._subscribers.remove(q)
+
+    def AdmitPod(self, request, context) -> pb.AdmitPodResponse:
+        self.admit_calls.append(request)
+        if self.reject_reason:
+            return pb.AdmitPodResponse(allowed=False, reason=self.reject_reason)
+        known = {c.id for c in self._topology.chips}
+        missing = [c for c in request.chip_ids if c not in known]
+        if missing:
+            return pb.AdmitPodResponse(allowed=False,
+                                       reason=f"unknown chips {missing}")
+        return pb.AdmitPodResponse(allowed=True)
+
+    def InitContainer(self, request, context) -> pb.InitContainerResponse:
+        self.init_calls.append(request)
+        resp = pb.InitContainerResponse()
+        topo = self._topology
+        resp.envs["TPU_VISIBLE_CHIPS"] = ",".join(request.chip_ids)
+        resp.envs["TPU_CHIP_TYPE"] = topo.chip_type
+        resp.envs["TPU_SLICE_ID"] = topo.slice_id
+        resp.envs["TPU_WORKER_ID"] = str(topo.worker_index)
+        resp.envs["TPU_MESH_SHAPE"] = "x".join(str(d) for d in topo.mesh_shape)
+        coords = {c.id: c.coords for c in topo.chips}
+        resp.envs["TPU_CHIP_COORDS"] = ";".join(
+            ",".join(map(str, coords[cid])) for cid in request.chip_ids
+            if cid in coords)
+        resp.annotations["tpu.dev/chips"] = ",".join(request.chip_ids)
+        return resp
+
+    # -- mutation from tests ----------------------------------------------
+
+    def set_chip_health(self, chip_id: str, health: str) -> None:
+        with self._lock:
+            for c in self._topology.chips:
+                if c.id == chip_id:
+                    c.health = health
+            update = pb.TopologyUpdate()
+            update.CopyFrom(self._topology)
+            for q in self._subscribers:
+                q.put(update)
+
+    # -- lifecycle --------------------------------------------------------
+
+    def serve(self, socket_path: str) -> None:
+        if os.path.exists(socket_path):
+            os.unlink(socket_path)
+        os.makedirs(os.path.dirname(socket_path), exist_ok=True)
+        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=8))
+        add_servicer_to_server(self, self._server)
+        self._server.add_insecure_port(f"unix://{socket_path}")
+        self._server.start()
+        self.socket_path = socket_path
+
+    def stop(self) -> None:
+        with self._lock:
+            for q in self._subscribers:
+                q.put(None)
+        if self._server:
+            self._server.stop(grace=0.2)
+            self._server = None
+        if self.socket_path and os.path.exists(self.socket_path):
+            os.unlink(self.socket_path)

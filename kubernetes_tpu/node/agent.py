@@ -1,0 +1,532 @@
+"""Node agent — the kubelet equivalent.
+
+Reference control flow (SURVEY.md section 3.3): ``pkg/kubelet/kubelet.go
+:1361 Run -> :1772 syncLoop / :1839 syncLoopIteration`` selecting over
+the apiserver pod watch, PLEG events (1s container relist,
+``pleg/generic.go:130``), sync ticker and prober results; per-pod
+workers serialize syncs (``pod_workers.go:153``); admission runs the
+device manager's AdmitPod (``container_manager_linux.go:619``);
+container start merges device-plugin options
+(``kubelet_pods.go:467 GenerateRunContainerOptions``); node status
+posts every 10s incl. the device capacity merge
+(``kubelet_node_status.go:552-621``).
+
+Asyncio redesign: one task per pod (worker), a PLEG task that polls the
+runtime and nudges workers, a status loop, and a heartbeat Lease. All
+state is rebuilt from the apiserver + runtime on restart (crash-only).
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+import socket
+import time
+from typing import Optional
+
+from ..api import errors, types as t
+from ..api.meta import ObjectMeta, now
+from ..client.informer import SharedInformer
+from ..client.interface import Client
+from ..client.record import EventRecorder
+from .devicemanager import DeviceManager
+from .probes import ProbeManager
+from .runtime import (STATE_EXITED, STATE_RUNNING, ContainerConfig,
+                      ContainerRuntime, ContainerStatus as RtStatus)
+
+log = logging.getLogger("nodeagent")
+
+
+class NodeAgent:
+    def __init__(self, client: Client, node_name: str, runtime: ContainerRuntime,
+                 device_manager: Optional[DeviceManager] = None,
+                 capacity: Optional[dict] = None,
+                 labels: Optional[dict] = None,
+                 status_interval: float = 10.0,
+                 heartbeat_interval: float = 5.0,
+                 pleg_interval: float = 1.0,
+                 max_pods: int = 110,
+                 address: str = ""):
+        self.client = client
+        self.node_name = node_name
+        self.runtime = runtime
+        self.device_manager = device_manager
+        self.capacity = capacity or {"cpu": 4.0, "memory": 8.0 * 2**30}
+        self.capacity.setdefault(t.RESOURCE_PODS, float(max_pods))
+        self.labels = labels or {}
+        self.status_interval = status_interval
+        self.heartbeat_interval = heartbeat_interval
+        self.pleg_interval = pleg_interval
+        self.address = address or socket.gethostname()
+        self.recorder = EventRecorder(client, component="node-agent", host=node_name)
+        self.probes = ProbeManager()
+
+        self._pods: dict[str, t.Pod] = {}        # key -> desired pod
+        self._workers: dict[str, asyncio.Task] = {}
+        self._worker_wake: dict[str, asyncio.Event] = {}
+        self._containers: dict[str, dict[str, str]] = {}  # pod key -> {container name -> cid}
+        self._restart_counts: dict[str, dict[str, int]] = {}
+        self._restart_at: dict[str, dict[str, float]] = {}
+        self._admitted: set[str] = set()
+        self._tasks: list[asyncio.Task] = []
+        self._informer: Optional[SharedInformer] = None
+        self._stopped = False
+
+    # -- lifecycle --------------------------------------------------------
+
+    async def start(self) -> None:
+        if self.device_manager:
+            self.device_manager.on_topology_changed = self._on_topology_changed
+            await self.device_manager.start()
+        await self._register_node()
+        self._informer = SharedInformer(
+            self.client, "pods",
+            field_selector=f"spec.node_name={self.node_name}")
+        self._informer.add_handlers(on_add=self._pod_changed_add,
+                                    on_update=self._pod_changed,
+                                    on_delete=self._pod_gone)
+        self._informer.start()
+        await self._informer.wait_for_sync()
+        loop = asyncio.get_running_loop()
+        self._tasks = [
+            loop.create_task(self._node_status_loop()),
+            loop.create_task(self._heartbeat_loop()),
+            loop.create_task(self._pleg_loop()),
+        ]
+
+    async def stop(self) -> None:
+        self._stopped = True
+        for task in self._tasks + list(self._workers.values()):
+            task.cancel()
+        for task in self._tasks + list(self._workers.values()):
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+        if self._informer:
+            await self._informer.stop()
+        if self.device_manager:
+            await self.device_manager.stop()
+        await self.probes.stop_all()
+
+    # -- node registration + status (kubelet_node_status.go) --------------
+
+    def _build_node(self) -> t.Node:
+        node = t.Node(metadata=ObjectMeta(
+            name=self.node_name,
+            labels={"kubernetes.io/hostname": self.node_name, **self.labels}))
+        node.status.capacity = dict(self.capacity)
+        if self.device_manager:
+            node.status.capacity.update(self.device_manager.capacity())
+            node.status.tpu = self.device_manager.topology()
+        node.status.allocatable = dict(node.status.capacity)
+        node.status.addresses = [t.NodeAddress(type="Hostname", address=self.address)]
+        node.status.conditions = [t.NodeCondition(
+            type=t.NODE_READY, status="True", reason="AgentReady",
+            last_heartbeat_time=now(), last_transition_time=now())]
+        node.status.node_info = t.NodeSystemInfo(
+            agent_version="kubernetes-tpu/0.1", architecture="tpu-vm")
+        return node
+
+    async def _register_node(self) -> None:
+        node = self._build_node()
+        try:
+            await self.client.create(node)
+            log.info("registered node %s", self.node_name)
+        except errors.AlreadyExistsError:
+            await self._post_status()
+
+    async def _post_status(self) -> None:
+        try:
+            cur = await self.client.get("nodes", "", self.node_name)
+        except errors.NotFoundError:
+            await self._register_node()
+            return
+        fresh = self._build_node()
+        # Keep conditions' transition times stable when unchanged.
+        old_ready = t.get_node_condition(cur.status, t.NODE_READY)
+        new_ready = t.get_node_condition(fresh.status, t.NODE_READY)
+        if old_ready and new_ready and old_ready.status == new_ready.status:
+            new_ready.last_transition_time = old_ready.last_transition_time
+        cur.status = fresh.status
+        try:
+            await self.client.update_status(cur)
+        except errors.ConflictError:
+            pass  # next tick wins
+
+    async def _node_status_loop(self) -> None:
+        while not self._stopped:
+            try:
+                await self._post_status()
+            except Exception:  # noqa: BLE001
+                log.exception("node status post failed")
+            await asyncio.sleep(self.status_interval)
+
+    async def _heartbeat_loop(self) -> None:
+        """Cheap liveness signal via a Lease (modern kubelet pattern;
+        the node controller reads renew_time)."""
+        while not self._stopped:
+            try:
+                await self._renew_heartbeat()
+            except Exception:  # noqa: BLE001
+                log.debug("heartbeat failed", exc_info=True)
+            await asyncio.sleep(self.heartbeat_interval)
+
+    async def _renew_heartbeat(self) -> None:
+        name = f"node-{self.node_name}"
+        try:
+            lease = await self.client.get("leases", "kube-system", name)
+            lease.spec.renew_time = now()
+            await self.client.update(lease)
+        except errors.NotFoundError:
+            lease = t.Lease(metadata=ObjectMeta(name=name, namespace="kube-system"),
+                            spec=t.LeaseSpec(holder_identity=self.node_name,
+                                             lease_duration_seconds=self.heartbeat_interval * 8,
+                                             renew_time=now()))
+            try:
+                await self.client.create(lease)
+            except errors.AlreadyExistsError:
+                pass
+        except errors.ConflictError:
+            pass
+
+    def _on_topology_changed(self) -> None:
+        if not self._stopped:
+            asyncio.get_running_loop().create_task(self._post_status())
+
+    # -- pod source handlers ---------------------------------------------
+
+    def _pod_changed_add(self, pod: t.Pod) -> None:
+        self._pod_changed(None, pod)
+
+    def _pod_changed(self, old, pod: t.Pod) -> None:
+        self._pods[pod.key()] = pod
+        self._ensure_worker(pod.key())
+
+    def _pod_gone(self, pod: t.Pod) -> None:
+        # Object force-removed from the store: tear down local state.
+        # The worker may have exited already (terminal pod), so ensure
+        # one exists to run the teardown pass.
+        key = pod.key()
+        self._pods.pop(key, None)
+        self._ensure_worker(key)
+
+    def _ensure_worker(self, key: str) -> None:
+        if key not in self._workers or self._workers[key].done():
+            self._worker_wake[key] = asyncio.Event()
+            self._workers[key] = asyncio.get_running_loop().create_task(
+                self._pod_worker(key))
+        self._nudge(key)
+
+    def _nudge(self, key: str) -> None:
+        ev = self._worker_wake.get(key)
+        if ev:
+            ev.set()
+
+    # -- per-pod worker (pod_workers.go:153 managePodLoop) ----------------
+
+    async def _pod_worker(self, key: str) -> None:
+        wake = self._worker_wake[key]
+        try:
+            while not self._stopped:
+                wake.clear()
+                pod = self._pods.get(key)
+                done = await self._sync_pod(key, pod)
+                if done:
+                    return
+                try:
+                    await asyncio.wait_for(wake.wait(), timeout=2.0)
+                except asyncio.TimeoutError:
+                    pass
+        except asyncio.CancelledError:
+            raise
+        except Exception:  # noqa: BLE001
+            log.exception("pod worker %s crashed", key)
+        finally:
+            self._workers.pop(key, None)
+            self._worker_wake.pop(key, None)
+
+    async def _sync_pod(self, key: str, pod: Optional[t.Pod]) -> bool:
+        """One reconcile pass; returns True when the worker can exit."""
+        if pod is None:
+            await self._teardown_pod(key)
+            return True
+        if pod.metadata.deletion_timestamp is not None:
+            await self._terminate_pod(pod)
+            return True
+        if t.is_pod_terminal(pod):
+            return True
+
+        # Admission (once): device verification (kubelet.go:898 chain).
+        if key not in self._admitted:
+            reason, retriable = await self._admit(pod)
+            if reason is not None:
+                if retriable:
+                    return False  # plugin not up yet: retry on next wake
+                await self._reject_pod(pod, reason)
+                return True
+            self._admitted.add(key)
+
+        statuses = await self._runtime_statuses(pod.metadata.uid)
+        await self._ensure_containers(pod, statuses)
+        # Re-list only if _ensure_containers started something new.
+        statuses = await self._runtime_statuses(pod.metadata.uid)
+        await self._update_pod_status(pod, statuses)
+        return False
+
+    async def _admit(self, pod: t.Pod) -> tuple[Optional[str], bool]:
+        """(rejection reason or None, retriable). A plugin that has not
+        reported topology YET is a transient condition (agent restart
+        races the plugin handshake) — retriable, never a terminal
+        rejection of a validly-bound workload."""
+        running = len([p for p in self._pods.values()
+                       if t.is_pod_active(p) and p.key() != pod.key()])
+        if running + 1 > int(self.capacity.get(t.RESOURCE_PODS, 110)):
+            return "node is at max pods", False
+        if pod.spec.tpu_resources and self.device_manager is None:
+            return "node has no device manager but pod requests TPUs", False
+        if self.device_manager is not None and pod.spec.tpu_resources:
+            if not self.device_manager.ready.is_set():
+                return "device plugin has not reported topology yet", True
+            return await self.device_manager.admit_pod(pod), False
+        return None, False
+
+    async def _reject_pod(self, pod: t.Pod, reason: str) -> None:
+        log.warning("rejecting pod %s: %s", pod.key(), reason)
+        self.recorder.event(pod, "Warning", "PodRejected", reason)
+        try:
+            cur = await self.client.get("pods", pod.metadata.namespace,
+                                        pod.metadata.name)
+            cur.status.phase = t.POD_FAILED
+            cur.status.reason = "NodeRejected"
+            cur.status.message = reason
+            await self.client.update_status(cur)
+        except errors.StatusError:
+            pass
+
+    # -- container reconciliation ----------------------------------------
+
+    async def _runtime_statuses(self, pod_uid: str) -> dict[str, RtStatus]:
+        out = {}
+        for st in await self.runtime.list_containers():
+            if st.pod_uid == pod_uid:
+                out[st.id] = st
+        return out
+
+    async def _ensure_containers(self, pod: t.Pod,
+                                 statuses: dict[str, RtStatus]) -> None:
+        key = pod.key()
+        cmap = self._containers.setdefault(key, {})
+        rcounts = self._restart_counts.setdefault(key, {})
+        rat = self._restart_at.setdefault(key, {})
+        for container in pod.spec.containers:
+            cid = cmap.get(container.name)
+            st = statuses.get(cid) if cid else None
+            if st is not None and st.state == STATE_RUNNING:
+                continue
+            if st is not None and st.state == STATE_EXITED:
+                policy = pod.spec.restart_policy
+                should_restart = (policy == t.RESTART_ALWAYS or
+                                  (policy == t.RESTART_ON_FAILURE and st.exit_code != 0))
+                if not should_restart:
+                    continue
+                # Crash-loop backoff: exponential in restart count (the
+                # reference's image-pull/backoff behavior, simplified).
+                n = rcounts.get(container.name, 0)
+                delay = min(0.5 * (2 ** n), 60.0)
+                nxt = rat.get(container.name, 0.0)
+                if nxt == 0.0:
+                    rat[container.name] = time.time() + delay
+                    continue
+                if time.time() < nxt:
+                    continue
+                rcounts[container.name] = n + 1
+                rat[container.name] = 0.0
+                self.recorder.event(pod, "Normal", "Restarting",
+                                    f"container {container.name} (count {n + 1})")
+                # The replaced container's runtime record (and log file)
+                # must not accumulate across restarts.
+                await self.runtime.remove_container(st.id)
+            await self._start_container(pod, container, cmap)
+
+    async def _start_container(self, pod: t.Pod, container: t.Container,
+                               cmap: dict[str, str]) -> None:
+        env = {e.name: e.value for e in container.env}
+        mounts: list[tuple] = []
+        devices: list[str] = []
+        if self.device_manager and container.tpu_requests:
+            try:
+                denv, dmounts, ddevs, _ann = \
+                    await self.device_manager.container_options(pod, container)
+            except Exception as e:  # noqa: BLE001
+                self.recorder.event(pod, "Warning", "DeviceOptionsFailed", str(e))
+                return
+            env.update(denv)
+            mounts.extend(dmounts)
+            devices.extend(ddevs)
+        env.setdefault("POD_NAME", pod.metadata.name)
+        env.setdefault("POD_NAMESPACE", pod.metadata.namespace)
+        env.setdefault("NODE_NAME", self.node_name)
+        config = ContainerConfig(
+            pod_namespace=pod.metadata.namespace, pod_name=pod.metadata.name,
+            pod_uid=pod.metadata.uid, name=container.name, image=container.image,
+            command=list(container.command), args=list(container.args),
+            env=env, working_dir=container.working_dir,
+            mounts=mounts, devices=devices)
+        try:
+            cid = await self.runtime.start_container(config)
+        except Exception as e:  # noqa: BLE001
+            self.recorder.event(pod, "Warning", "FailedStart",
+                                f"{container.name}: {e}")
+            return
+        cmap[container.name] = cid
+        self.recorder.event(pod, "Normal", "Started",
+                            f"container {container.name}")
+        if container.liveness_probe or container.readiness_probe:
+            self.probes.add(pod, container, cid,
+                            on_liveness_fail=self._liveness_failed)
+
+    def _liveness_failed(self, pod_key: str, container_name: str, cid: str) -> None:
+        async def restart():
+            await self.runtime.stop_container(cid, grace_seconds=1.0)
+            self._nudge(pod_key)
+        asyncio.get_running_loop().create_task(restart())
+
+    # -- status calculation (kubelet syncPod status half) -----------------
+
+    async def _update_pod_status(self, pod: t.Pod,
+                                 statuses: dict[str, RtStatus]) -> None:
+        key = pod.key()
+        cmap = self._containers.get(key, {})
+        cstatuses: list[t.ContainerStatus] = []
+        for container in pod.spec.containers:
+            cid = cmap.get(container.name)
+            st = statuses.get(cid) if cid else None
+            cs = t.ContainerStatus(name=container.name, image=container.image,
+                                   container_id=cid or "",
+                                   restart_count=self._restart_counts
+                                   .get(key, {}).get(container.name, 0))
+            if st is None:
+                cs.state.waiting = t.ContainerStateWaiting(reason="ContainerCreating")
+            elif st.state == STATE_RUNNING:
+                ready = self.probes.is_ready(key, container.name)
+                cs.state.running = t.ContainerStateRunning()
+                cs.ready = ready
+            else:
+                cs.state.terminated = t.ContainerStateTerminated(
+                    exit_code=st.exit_code,
+                    reason="Completed" if st.exit_code == 0 else "Error",
+                    message=st.message)
+            cstatuses.append(cs)
+        phase = self._compute_phase(pod, cstatuses)
+        all_ready = bool(cstatuses) and all(
+            cs.ready or cs.state.terminated is not None for cs in cstatuses)
+
+        try:
+            cur = await self.client.get("pods", pod.metadata.namespace,
+                                        pod.metadata.name)
+        except errors.NotFoundError:
+            return
+        changed = (cur.status.phase != phase)
+        cur.status.phase = phase
+        cur.status.host_ip = self.address
+        cur.status.pod_ip = self.address
+        if cur.status.start_time is None:
+            cur.status.start_time = now()
+            changed = True
+        old = [(c.name, c.ready, bool(c.state.running), bool(c.state.terminated),
+                c.restart_count) for c in cur.status.container_statuses]
+        new = [(c.name, c.ready, bool(c.state.running), bool(c.state.terminated),
+                c.restart_count) for c in cstatuses]
+        if old != new:
+            changed = True
+        cur.status.container_statuses = cstatuses
+        changed |= t.update_pod_condition(cur.status, t.PodCondition(
+            type=t.COND_POD_READY, status="True" if all_ready else "False"))
+        changed |= t.update_pod_condition(cur.status, t.PodCondition(
+            type=t.COND_CONTAINERS_READY, status="True" if all_ready else "False"))
+        if changed:
+            try:
+                await self.client.update_status(cur)
+            except errors.StatusError:
+                pass
+
+    @staticmethod
+    def _compute_phase(pod: t.Pod, cstatuses: list[t.ContainerStatus]) -> str:
+        if not cstatuses:
+            return t.POD_PENDING
+        running = sum(1 for c in cstatuses if c.state.running)
+        terminated = [c for c in cstatuses if c.state.terminated]
+        waiting = sum(1 for c in cstatuses if c.state.waiting)
+        if waiting and not running:
+            return t.POD_PENDING
+        if len(terminated) == len(cstatuses):
+            policy = pod.spec.restart_policy
+            if policy == t.RESTART_ALWAYS:
+                return t.POD_RUNNING  # restarting
+            if all(c.state.terminated.exit_code == 0 for c in terminated):
+                return t.POD_SUCCEEDED
+            if policy == t.RESTART_NEVER:
+                return t.POD_FAILED
+            return t.POD_RUNNING  # OnFailure keeps retrying
+        return t.POD_RUNNING
+
+    # -- termination ------------------------------------------------------
+
+    async def _terminate_pod(self, pod: t.Pod) -> None:
+        key = pod.key()
+        log.info("terminating pod %s", key)
+        gp = pod.spec.termination_grace_period_seconds
+        grace = float(gp) if gp is not None else 1.0
+        cmap = self._containers.get(key, {})
+        self.probes.remove_pod(key)
+        for cid in cmap.values():
+            await self.runtime.stop_container(cid, grace_seconds=grace)
+        for cid in cmap.values():
+            await self.runtime.remove_container(cid)
+        self._containers.pop(key, None)
+        self._restart_counts.pop(key, None)
+        self._restart_at.pop(key, None)
+        self._admitted.discard(key)
+        # Confirm deletion: grace-0 delete completes removal (the node
+        # agent is the only caller allowed to finish a pod's deletion).
+        try:
+            await self.client.delete("pods", pod.metadata.namespace,
+                                     pod.metadata.name, grace_period_seconds=0,
+                                     uid=pod.metadata.uid)
+        except errors.StatusError:
+            pass
+
+    async def _teardown_pod(self, key: str) -> None:
+        cmap = self._containers.pop(key, {})
+        self.probes.remove_pod(key)
+        for cid in cmap.values():
+            await self.runtime.stop_container(cid, grace_seconds=1.0)
+            await self.runtime.remove_container(cid)
+        self._restart_counts.pop(key, None)
+        self._restart_at.pop(key, None)
+        self._admitted.discard(key)
+
+    # -- PLEG (pleg/generic.go:110) ---------------------------------------
+
+    async def _pleg_loop(self) -> None:
+        last: dict[str, str] = {}
+        while not self._stopped:
+            try:
+                current: dict[str, str] = {}
+                for st in await self.runtime.list_containers():
+                    current[st.id] = st.state
+                for cid, state in current.items():
+                    if last.get(cid) != state:
+                        self._nudge_owner(cid)
+                for cid in set(last) - set(current):
+                    self._nudge_owner(cid)
+                last = current
+            except Exception:  # noqa: BLE001
+                log.exception("pleg relist failed")
+            await asyncio.sleep(self.pleg_interval)
+
+    def _nudge_owner(self, cid: str) -> None:
+        for key, cmap in self._containers.items():
+            if cid in cmap.values():
+                self._nudge(key)
+                return

@@ -1,0 +1,120 @@
+"""Liveness/readiness probes.
+
+Reference: ``pkg/kubelet/prober`` + ``pkg/probe`` (exec/http/tcp).
+Each probed container gets a task per probe; liveness failures call
+back into the agent (restart), readiness feeds the pod Ready condition.
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Callable, Optional
+
+from ..api import types as t
+
+log = logging.getLogger("probes")
+
+
+async def run_probe(probe: t.Probe, host: str = "127.0.0.1") -> bool:
+    try:
+        if probe.exec_command:
+            proc = await asyncio.create_subprocess_exec(
+                *probe.exec_command,
+                stdout=asyncio.subprocess.DEVNULL,
+                stderr=asyncio.subprocess.DEVNULL)
+            try:
+                code = await asyncio.wait_for(proc.wait(), probe.timeout_seconds)
+            except asyncio.TimeoutError:
+                proc.kill()
+                return False
+            return code == 0
+        if probe.http_get is not None:
+            import aiohttp
+            url = (f"{probe.http_get.scheme.lower()}://"
+                   f"{probe.http_get.host or host}:{probe.http_get.port}"
+                   f"{probe.http_get.path}")
+            timeout = aiohttp.ClientTimeout(total=probe.timeout_seconds)
+            async with aiohttp.ClientSession(timeout=timeout) as s:
+                async with s.get(url) as resp:
+                    return 200 <= resp.status < 400
+        if probe.tcp_port:
+            fut = asyncio.open_connection(host, probe.tcp_port)
+            reader, writer = await asyncio.wait_for(fut, probe.timeout_seconds)
+            writer.close()
+            return True
+    except Exception:  # noqa: BLE001
+        return False
+    return True
+
+
+class ProbeManager:
+    def __init__(self) -> None:
+        self._tasks: dict[tuple, asyncio.Task] = {}
+        self._ready: dict[tuple, bool] = {}
+
+    def add(self, pod: t.Pod, container: t.Container, cid: str,
+            on_liveness_fail: Optional[Callable] = None) -> None:
+        key = pod.key()
+        # Keyed WITHOUT the container id so a restarted container
+        # replaces (cancels) the old probe loop instead of leaking it.
+        if container.readiness_probe:
+            self._ready[(key, container.name)] = False
+            self._spawn((key, container.name, "readiness"),
+                        self._readiness_loop(key, container, cid))
+        else:
+            self._ready[(key, container.name)] = True
+        if container.liveness_probe and on_liveness_fail:
+            self._spawn((key, container.name, "liveness"),
+                        self._liveness_loop(key, container, cid, on_liveness_fail))
+
+    def _spawn(self, tkey: tuple, coro) -> None:
+        old = self._tasks.pop(tkey, None)
+        if old:
+            old.cancel()
+        self._tasks[tkey] = asyncio.get_running_loop().create_task(coro)
+
+    def is_ready(self, pod_key: str, container_name: str) -> bool:
+        return self._ready.get((pod_key, container_name), True)
+
+    async def _readiness_loop(self, key: str, container: t.Container, cid: str) -> None:
+        probe = container.readiness_probe
+        await asyncio.sleep(probe.initial_delay_seconds)
+        successes = failures = 0
+        while True:
+            ok = await run_probe(probe)
+            if ok:
+                successes += 1
+                failures = 0
+                if successes >= probe.success_threshold:
+                    self._ready[(key, container.name)] = True
+            else:
+                failures += 1
+                successes = 0
+                if failures >= probe.failure_threshold:
+                    self._ready[(key, container.name)] = False
+            await asyncio.sleep(probe.period_seconds)
+
+    async def _liveness_loop(self, key: str, container: t.Container, cid: str,
+                             on_fail: Callable) -> None:
+        probe = container.liveness_probe
+        await asyncio.sleep(probe.initial_delay_seconds)
+        failures = 0
+        while True:
+            ok = await run_probe(probe)
+            failures = 0 if ok else failures + 1
+            if failures >= probe.failure_threshold:
+                log.info("liveness failed for %s/%s; restarting", key, container.name)
+                on_fail(key, container.name, cid)
+                return
+            await asyncio.sleep(probe.period_seconds)
+
+    def remove_pod(self, pod_key: str) -> None:
+        for tkey in [k for k in self._tasks if k[0] == pod_key]:
+            self._tasks.pop(tkey).cancel()
+        for rkey in [k for k in self._ready if k[0] == pod_key]:
+            del self._ready[rkey]
+
+    async def stop_all(self) -> None:
+        for task in self._tasks.values():
+            task.cancel()
+        self._tasks.clear()

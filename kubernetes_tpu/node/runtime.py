@@ -1,0 +1,232 @@
+"""Container runtime seam — the CRI analog.
+
+Reference: the kubelet drives containers through a gRPC CRI (26 RPCs,
+``pkg/kubelet/apis/cri/v1alpha1/runtime/api.proto``) implemented by
+dockershim/containerd. Here the seam is an in-process interface with
+two implementations:
+
+- :class:`ProcessRuntime` — pods run as real OS processes (the
+  node-local dataplane of this framework; container image == command).
+  Env/devices injected by the device manager arrive via
+  ``ContainerConfig``. Logs stream to per-container files, giving
+  ``ktl logs`` something real to read.
+- :class:`FakeRuntime` — in-memory, for unit tests and kubemark hollow
+  nodes (reference: fake docker client + hollow kubelet,
+  ``pkg/kubemark/hollow_kubelet.go:49``).
+"""
+from __future__ import annotations
+
+import asyncio
+import os
+import signal
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+STATE_CREATED = "created"
+STATE_RUNNING = "running"
+STATE_EXITED = "exited"
+
+
+@dataclass
+class ContainerConfig:
+    pod_namespace: str = ""
+    pod_name: str = ""
+    pod_uid: str = ""
+    name: str = ""
+    image: str = ""
+    command: list[str] = field(default_factory=list)
+    args: list[str] = field(default_factory=list)
+    env: dict[str, str] = field(default_factory=dict)
+    working_dir: str = ""
+    mounts: list[tuple] = field(default_factory=list)  # (host, container, ro)
+    devices: list[str] = field(default_factory=list)
+    annotations: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class ContainerStatus:
+    id: str = ""
+    name: str = ""
+    pod_uid: str = ""
+    state: str = STATE_CREATED
+    exit_code: int = 0
+    started_at: float = 0.0
+    finished_at: float = 0.0
+    message: str = ""
+
+
+class ContainerRuntime:
+    async def start_container(self, config: ContainerConfig) -> str:
+        raise NotImplementedError
+
+    async def stop_container(self, container_id: str, grace_seconds: float = 30.0) -> None:
+        raise NotImplementedError
+
+    async def remove_container(self, container_id: str) -> None:
+        raise NotImplementedError
+
+    async def list_containers(self) -> list[ContainerStatus]:
+        raise NotImplementedError
+
+    async def container_logs(self, container_id: str, tail: Optional[int] = None) -> str:
+        raise NotImplementedError
+
+
+class ProcessRuntime(ContainerRuntime):
+    """Pods as local OS processes under a per-node root directory."""
+
+    def __init__(self, root_dir: str):
+        self.root_dir = root_dir
+        os.makedirs(root_dir, exist_ok=True)
+        self._procs: dict[str, asyncio.subprocess.Process] = {}
+        self._status: dict[str, ContainerStatus] = {}
+        self._waiters: dict[str, asyncio.Task] = {}
+        self._seq = 0
+
+    def _log_path(self, cid: str) -> str:
+        return os.path.join(self.root_dir, "logs", f"{cid}.log")
+
+    async def start_container(self, config: ContainerConfig) -> str:
+        self._seq += 1
+        cid = f"proc-{config.pod_uid[:8]}-{config.name}-{self._seq}"
+        argv = list(config.command) + list(config.args)
+        if not argv:
+            raise RuntimeError(f"container {config.name}: no command (image "
+                               f"{config.image!r} is not a registry image in "
+                               f"the process runtime)")
+        env = dict(os.environ)
+        env.update(config.env)
+        env["KTPU_POD"] = f"{config.pod_namespace}/{config.pod_name}"
+        os.makedirs(os.path.dirname(self._log_path(cid)), exist_ok=True)
+        log_f = open(self._log_path(cid), "wb")
+        try:
+            proc = await asyncio.create_subprocess_exec(
+                *argv, stdout=log_f, stderr=asyncio.subprocess.STDOUT,
+                env=env, cwd=config.working_dir or None,
+                start_new_session=True)
+        except (FileNotFoundError, PermissionError) as e:
+            log_f.close()
+            st = ContainerStatus(id=cid, name=config.name, pod_uid=config.pod_uid,
+                                 state=STATE_EXITED, exit_code=127,
+                                 started_at=time.time(), finished_at=time.time(),
+                                 message=str(e))
+            self._status[cid] = st
+            return cid
+        finally:
+            try:
+                log_f.close()
+            except Exception:  # noqa: BLE001
+                pass
+        self._procs[cid] = proc
+        self._status[cid] = ContainerStatus(
+            id=cid, name=config.name, pod_uid=config.pod_uid,
+            state=STATE_RUNNING, started_at=time.time())
+        self._waiters[cid] = asyncio.get_running_loop().create_task(
+            self._wait(cid, proc))
+        return cid
+
+    async def _wait(self, cid: str, proc) -> None:
+        code = await proc.wait()
+        st = self._status.get(cid)
+        if st and st.state != STATE_EXITED:
+            st.state = STATE_EXITED
+            st.exit_code = code if code is not None else -1
+            st.finished_at = time.time()
+
+    async def stop_container(self, container_id: str, grace_seconds: float = 30.0) -> None:
+        proc = self._procs.get(container_id)
+        st = self._status.get(container_id)
+        if proc is None or st is None or st.state == STATE_EXITED:
+            return
+        try:
+            os.killpg(proc.pid, signal.SIGTERM)
+        except (ProcessLookupError, PermissionError):
+            pass
+        try:
+            await asyncio.wait_for(proc.wait(), timeout=max(grace_seconds, 0.1))
+        except asyncio.TimeoutError:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+            await proc.wait()
+
+    async def remove_container(self, container_id: str) -> None:
+        await self.stop_container(container_id, grace_seconds=0.1)
+        self._procs.pop(container_id, None)
+        self._status.pop(container_id, None)
+        w = self._waiters.pop(container_id, None)
+        if w:
+            w.cancel()
+        try:
+            os.unlink(self._log_path(container_id))
+        except OSError:
+            pass
+
+    async def list_containers(self) -> list[ContainerStatus]:
+        return list(self._status.values())
+
+    async def container_logs(self, container_id: str, tail: Optional[int] = None) -> str:
+        try:
+            with open(self._log_path(container_id), "r", errors="replace") as f:
+                lines = f.readlines()
+        except FileNotFoundError:
+            return ""
+        if tail is not None:
+            lines = lines[-tail:]
+        return "".join(lines)
+
+    async def shutdown(self) -> None:
+        for cid in list(self._procs):
+            await self.stop_container(cid, grace_seconds=0.2)
+        for w in self._waiters.values():
+            w.cancel()
+
+
+class FakeRuntime(ContainerRuntime):
+    """In-memory runtime for hollow nodes/unit tests. Containers 'run'
+    until told to exit via :meth:`exit_container` (or forever)."""
+
+    def __init__(self, start_delay: float = 0.0):
+        self._status: dict[str, ContainerStatus] = {}
+        self._configs: dict[str, ContainerConfig] = {}
+        self._logs: dict[str, str] = {}
+        self._seq = 0
+        self.start_delay = start_delay
+
+    async def start_container(self, config: ContainerConfig) -> str:
+        if self.start_delay:
+            await asyncio.sleep(self.start_delay)
+        self._seq += 1
+        cid = f"fake-{config.pod_uid[:8]}-{config.name}-{self._seq}"
+        self._status[cid] = ContainerStatus(
+            id=cid, name=config.name, pod_uid=config.pod_uid,
+            state=STATE_RUNNING, started_at=time.time())
+        self._configs[cid] = config
+        self._logs[cid] = f"(fake) started {config.name}\n"
+        return cid
+
+    def exit_container(self, container_id: str, code: int = 0) -> None:
+        st = self._status.get(container_id)
+        if st and st.state == STATE_RUNNING:
+            st.state = STATE_EXITED
+            st.exit_code = code
+            st.finished_at = time.time()
+
+    def container_config(self, container_id: str) -> Optional[ContainerConfig]:
+        return self._configs.get(container_id)
+
+    async def stop_container(self, container_id: str, grace_seconds: float = 30.0) -> None:
+        self.exit_container(container_id, code=137)
+
+    async def remove_container(self, container_id: str) -> None:
+        self._status.pop(container_id, None)
+        self._configs.pop(container_id, None)
+        self._logs.pop(container_id, None)
+
+    async def list_containers(self) -> list[ContainerStatus]:
+        return list(self._status.values())
+
+    async def container_logs(self, container_id: str, tail: Optional[int] = None) -> str:
+        return self._logs.get(container_id, "")

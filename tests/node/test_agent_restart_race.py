@@ -1,0 +1,54 @@
+"""Regression: agent restart must not kill TPU pods that were validly
+bound before the device plugin handshake completes (review finding)."""
+import asyncio
+import os
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.apiserver.registry import Registry
+from kubernetes_tpu.client.local import LocalClient
+from kubernetes_tpu.deviceplugin.stub import StubTpuPlugin, make_topology
+from kubernetes_tpu.node.agent import NodeAgent
+from kubernetes_tpu.node.devicemanager import DeviceManager
+from kubernetes_tpu.node.runtime import FakeRuntime
+
+
+async def test_bound_tpu_pod_survives_agent_restart_race(tmp_path):
+    reg = Registry()
+    reg.create(t.Namespace(metadata=ObjectMeta(name="default")))
+    reg.create(t.Namespace(metadata=ObjectMeta(name="kube-system")))
+    client = LocalClient(reg)
+
+    # A TPU pod already bound to this node (from a previous agent life).
+    pod = t.Pod(metadata=ObjectMeta(name="train", namespace="default"),
+                spec=t.PodSpec(containers=[t.Container(
+                    name="c", image="i", command=["x"], tpu_requests=["tpu"])],
+                    tpu_resources=[t.PodTpuRequest(name="tpu", chips=1)]))
+    reg.create(pod)
+    reg.bind_pod("default", "train", t.Binding(target=t.BindingTarget(
+        node_name="worker-0",
+        tpu_bindings=[t.TpuBinding(name="tpu", chip_ids=["tpu-0"])])))
+
+    # Start the agent FIRST; delay the plugin (the race under test).
+    plugin_dir = str(tmp_path / "plugins")
+    dm = DeviceManager(plugin_dir, poll_interval=0.1)
+    agent = NodeAgent(client, "worker-0", FakeRuntime(), device_manager=dm,
+                      status_interval=0.3, heartbeat_interval=0.3,
+                      pleg_interval=0.1)
+    await agent.start()
+    await asyncio.sleep(0.6)  # agent syncs the pod; plugin still absent
+    assert reg.get("pods", "default", "train").status.phase != t.POD_FAILED, \
+        "pod terminally rejected during plugin startup window"
+
+    plugin = StubTpuPlugin(make_topology(mesh_shape=(2, 2, 1), id_prefix="tpu"))
+    plugin.serve(os.path.join(plugin_dir, "tpu.sock"))
+    try:
+        for _ in range(80):
+            p = reg.get("pods", "default", "train")
+            if p.status.phase == t.POD_RUNNING:
+                break
+            await asyncio.sleep(0.1)
+        assert reg.get("pods", "default", "train").status.phase == t.POD_RUNNING
+    finally:
+        await agent.stop()
+        plugin.stop()

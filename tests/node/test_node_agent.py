@@ -1,0 +1,237 @@
+"""Node agent e2e (reference tier: test/e2e_node — kubelet + runtime on
+one machine, incl. gpu_device_plugin.go scenarios with the stub)."""
+import asyncio
+import os
+
+import pytest
+
+from kubernetes_tpu.api import errors, types as t
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.apiserver.admission import default_chain
+from kubernetes_tpu.apiserver.registry import Registry
+from kubernetes_tpu.client.local import LocalClient
+from kubernetes_tpu.deviceplugin.stub import StubTpuPlugin, make_topology
+from kubernetes_tpu.node.agent import NodeAgent
+from kubernetes_tpu.node.devicemanager import DeviceManager
+from kubernetes_tpu.node.runtime import FakeRuntime, ProcessRuntime
+from kubernetes_tpu.scheduler.scheduler import Scheduler
+
+
+async def cluster_with_node(tmp_path, runtime=None, with_tpu=True, sched=True):
+    reg = Registry()
+    reg.admission = default_chain(reg)
+    reg.create(t.Namespace(metadata=ObjectMeta(name="default")))
+    reg.create(t.Namespace(metadata=ObjectMeta(name="kube-system")))
+    client = LocalClient(reg)
+    runtime = runtime or FakeRuntime()
+
+    plugin = dm = None
+    if with_tpu:
+        plugin_dir = str(tmp_path / "plugins")
+        plugin = StubTpuPlugin(make_topology(mesh_shape=(2, 2, 1),
+                                             slice_id="s0", id_prefix="tpu"))
+        plugin.serve(os.path.join(plugin_dir, "tpu.sock"))
+        dm = DeviceManager(plugin_dir, poll_interval=0.1)
+
+    agent = NodeAgent(client, "worker-0", runtime, device_manager=dm,
+                      status_interval=0.3, heartbeat_interval=0.3,
+                      pleg_interval=0.1)
+    await agent.start()
+
+    scheduler = None
+    if sched:
+        scheduler = Scheduler(client, backoff_seconds=0.2)
+        await scheduler.start()
+    return reg, client, agent, scheduler, plugin, runtime
+
+
+async def teardown(agent, scheduler, plugin):
+    if scheduler:
+        await scheduler.stop()
+    await agent.stop()
+    if plugin:
+        plugin.stop()
+
+
+def mk_pod(name, command=None, chips=0, restart="Never"):
+    pod = t.Pod(metadata=ObjectMeta(name=name, namespace="default"),
+                spec=t.PodSpec(restart_policy=restart,
+                               containers=[t.Container(
+                                   name="main", image="test-image",
+                                   command=command or ["sleep", "60"])]))
+    if chips:
+        pod.spec.containers[0].tpu_requests = ["tpu"]
+        pod.spec.tpu_resources = [t.PodTpuRequest(name="tpu", chips=chips)]
+    return pod
+
+
+async def wait_for(fn, timeout=8.0, interval=0.05):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while asyncio.get_running_loop().time() < deadline:
+        result = fn()
+        if result:
+            return result
+        await asyncio.sleep(interval)
+    return fn()
+
+
+async def test_node_registers_with_tpu_topology(tmp_path):
+    reg, client, agent, sched, plugin, rt = await cluster_with_node(tmp_path)
+    try:
+        def topo_complete():
+            n = reg.get("nodes", "", "worker-0")
+            return n if (n.status.tpu and len(n.status.tpu.chips) == 4) else None
+
+        node = await wait_for(topo_complete)
+        assert node and node.status.tpu is not None
+        assert len(node.status.tpu.chips) == 4
+        assert node.status.capacity[t.RESOURCE_TPU] == 4.0
+        assert node.status.tpu.slice_id == "s0"
+        assert all(len(c.coords) == 3 for c in node.status.tpu.chips)
+        # Heartbeat lease exists and renews.
+        lease = reg.get("leases", "kube-system", "node-worker-0")
+        assert lease.spec.renew_time is not None
+    finally:
+        await teardown(agent, sched, plugin)
+
+
+async def test_pod_lifecycle_to_succeeded(tmp_path):
+    rt = FakeRuntime()
+    reg, client, agent, sched, plugin, _ = await cluster_with_node(tmp_path, runtime=rt)
+    try:
+        reg.create(mk_pod("job1"))
+        pod = await wait_for(
+            lambda: (p := reg.get("pods", "default", "job1")).status.phase == t.POD_RUNNING and p)
+        pod = reg.get("pods", "default", "job1")
+        assert pod.status.phase == t.POD_RUNNING
+        assert pod.spec.node_name == "worker-0"
+        cid = pod.status.container_statuses[0].container_id
+        rt.exit_container(cid, code=0)
+        await wait_for(lambda: reg.get("pods", "default", "job1").status.phase == t.POD_SUCCEEDED)
+        assert reg.get("pods", "default", "job1").status.phase == t.POD_SUCCEEDED
+    finally:
+        await teardown(agent, sched, plugin)
+
+
+async def test_tpu_pod_gets_device_env(tmp_path):
+    rt = FakeRuntime()
+    reg, client, agent, sched, plugin, _ = await cluster_with_node(tmp_path, runtime=rt)
+    try:
+        reg.create(mk_pod("train", chips=2))
+        pod = await wait_for(
+            lambda: (p := reg.get("pods", "default", "train")).status.phase == t.POD_RUNNING and p)
+        pod = reg.get("pods", "default", "train")
+        cid = pod.status.container_statuses[0].container_id
+        config = rt.container_config(cid)
+        assert config is not None
+        env = config.env
+        assigned = pod.spec.tpu_resources[0].assigned
+        assert env["TPU_VISIBLE_CHIPS"] == ",".join(assigned)
+        assert env["TPU_SLICE_ID"] == "s0"
+        assert env["TPU_MESH_SHAPE"] == "2x2x1"
+        assert env["TPU_WORKER_ID"] == "0"
+        assert len(plugin.init_calls) == 1
+        assert len(plugin.admit_calls) == 1
+    finally:
+        await teardown(agent, sched, plugin)
+
+
+async def test_graceful_delete_stops_containers(tmp_path):
+    rt = FakeRuntime()
+    reg, client, agent, sched, plugin, _ = await cluster_with_node(tmp_path, runtime=rt)
+    try:
+        reg.create(mk_pod("doomed"))
+        await wait_for(lambda: reg.get("pods", "default", "doomed").status.phase == t.POD_RUNNING)
+        reg.delete("pods", "default", "doomed")  # graceful
+        # Agent must stop containers and confirm the delete (grace 0).
+        def gone():
+            try:
+                reg.get("pods", "default", "doomed")
+                return False
+            except errors.NotFoundError:
+                return True
+        assert await wait_for(gone)
+        sts = await rt.list_containers()
+        assert all(s.state != "running" for s in sts)
+    finally:
+        await teardown(agent, sched, plugin)
+
+
+async def test_chip_health_transition_updates_node(tmp_path):
+    reg, client, agent, sched, plugin, rt = await cluster_with_node(tmp_path)
+    try:
+        await wait_for(lambda: (n := reg.get("nodes", "", "worker-0")).status.tpu
+                       and len(n.status.tpu.chips) == 4)
+        plugin.set_chip_health("tpu-0", t.TPU_UNHEALTHY)
+
+        def unhealthy_visible():
+            node = reg.get("nodes", "", "worker-0")
+            if not node.status.tpu:
+                return False
+            chips = {c.id: c.health for c in node.status.tpu.chips}
+            return (chips.get("tpu-0") == t.TPU_UNHEALTHY
+                    and node.status.capacity.get(t.RESOURCE_TPU) == 3.0)
+        assert await wait_for(unhealthy_visible)
+    finally:
+        await teardown(agent, sched, plugin)
+
+
+async def test_admit_rejects_unknown_chip(tmp_path):
+    rt = FakeRuntime()
+    reg, client, agent, sched, plugin, _ = await cluster_with_node(
+        tmp_path, runtime=rt, sched=False)
+    try:
+        await wait_for(lambda: (n := reg.get("nodes", "", "worker-0")).status.tpu
+                       and bool(n.status.tpu.chips))
+        # Bind manually with a chip the plugin never advertised.
+        pod = mk_pod("forged", chips=1)
+        reg.create(pod)
+        reg.bind_pod("default", "forged", t.Binding(target=t.BindingTarget(
+            node_name="worker-0",
+            tpu_bindings=[t.TpuBinding(name="tpu", chip_ids=["ghost-chip"])])))
+        assert await wait_for(
+            lambda: reg.get("pods", "default", "forged").status.phase == t.POD_FAILED)
+        pod = reg.get("pods", "default", "forged")
+        assert "does not exist" in pod.status.message
+    finally:
+        await teardown(agent, sched, plugin)
+
+
+async def test_restart_policy_always_restarts(tmp_path):
+    rt = FakeRuntime()
+    reg, client, agent, sched, plugin, _ = await cluster_with_node(tmp_path, runtime=rt)
+    try:
+        reg.create(mk_pod("crashy", restart="Always"))
+        await wait_for(lambda: reg.get("pods", "default", "crashy").status.phase == t.POD_RUNNING)
+        pod = reg.get("pods", "default", "crashy")
+        cid = pod.status.container_statuses[0].container_id
+        rt.exit_container(cid, code=1)
+        def restarted():
+            p = reg.get("pods", "default", "crashy")
+            if not p.status.container_statuses:
+                return False
+            cs = p.status.container_statuses[0]
+            return cs.restart_count >= 1 and cs.state.running is not None
+        assert await wait_for(restarted, timeout=12)
+    finally:
+        await teardown(agent, sched, plugin)
+
+
+async def test_process_runtime_real_execution(tmp_path):
+    rt = ProcessRuntime(str(tmp_path / "rt"))
+    reg, client, agent, sched, plugin, _ = await cluster_with_node(
+        tmp_path, runtime=rt, with_tpu=False)
+    try:
+        pod = mk_pod("echo", command=["python3", "-c",
+                                      "print('hello from pod'); import sys; sys.exit(0)"])
+        reg.create(pod)
+        assert await wait_for(
+            lambda: reg.get("pods", "default", "echo").status.phase == t.POD_SUCCEEDED,
+            timeout=15)
+        pod = reg.get("pods", "default", "echo")
+        cid = pod.status.container_statuses[0].container_id
+        logs = await rt.container_logs(cid)
+        assert "hello from pod" in logs
+    finally:
+        await teardown(agent, sched, plugin)
+        await rt.shutdown()
