@@ -1,0 +1,23 @@
+"""TPU workload payloads scheduled by the framework.
+
+The reference ships GPU payloads to prove end-to-end device access and
+scale: the ``cuda-vector-add`` e2e image
+(``test/images/cuda-vector-add/Dockerfile:15-26``) and — per
+``BASELINE.json`` — a JAX FSDP training job on a gang-scheduled v5p
+slice. These are their TPU-native equivalents, written jax-first:
+
+- :mod:`.vector_add` — pallas add kernel asserting a live TPU core
+  (the ``tpu-vector-add`` smoke payload).
+- :mod:`.mnist` — small MLP classifier, the "JAX MNIST" baseline
+  config (synthetic data; the image has no dataset egress).
+- :mod:`.lm` — decoder-only transformer LM with dp/fsdp/tp/sp
+  sharding over a ``jax.sharding.Mesh``; the flagship gang-scheduled
+  training job. Sequence parallelism is ring attention over the ``sp``
+  mesh axis (:mod:`.ring_attention`), so long-context jobs scale with
+  the contiguous sub-mesh the scheduler allocates.
+
+The orchestrator hands a PodGroup one contiguous ICI sub-mesh; these
+workloads map ``jax.make_mesh`` axes onto it (SURVEY.md section 2.4).
+"""
+
+from . import lm, mnist, ring_attention, sharding, vector_add  # noqa: F401

@@ -1,0 +1,195 @@
+"""Flagship gang-scheduled workload: decoder-only transformer LM.
+
+The BASELINE's "JAX FSDP training on a gang-scheduled v5p slice"
+payload. Pure JAX, designed for the MXU and XLA's compilation model:
+
+- layers stacked on a leading axis and run with ``lax.scan`` (one
+  traced layer body, static shapes, fast compiles);
+- bfloat16 compute with float32 master params and float32 softmax /
+  loss accumulation;
+- sharding by annotation only — params over ``(fsdp, tp)``, batch over
+  ``(dp, fsdp)``, sequence over ``sp`` (ring attention) — XLA inserts
+  the all-gathers / reduce-scatters / all-reduces on the mesh;
+- RoPE positions, RMSNorm, SwiGLU FFN, tied embeddings.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .ring_attention import ring_attention
+from .sharding import ACT_SPEC, shard
+
+
+@dataclasses.dataclass(frozen=True)
+class LMConfig:
+    vocab: int = 256
+    d_model: int = 128
+    n_layers: int = 2
+    n_heads: int = 4
+    d_ff: int = 512
+    rope_base: float = 10_000.0
+    param_dtype: Any = jnp.float32
+    compute_dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+#: PartitionSpecs mirroring the params pytree. Leading ``None`` is the
+#: stacked-layers axis.
+def param_specs(cfg: LMConfig) -> dict:
+    return {
+        "embed": P(None, "fsdp"),
+        "layers": {
+            "ln1": P(None, None),
+            "wq": P(None, "fsdp", "tp"),
+            "wk": P(None, "fsdp", "tp"),
+            "wv": P(None, "fsdp", "tp"),
+            "wo": P(None, "tp", "fsdp"),
+            "ln2": P(None, None),
+            "w1": P(None, "fsdp", "tp"),
+            "w3": P(None, "fsdp", "tp"),
+            "w2": P(None, "tp", "fsdp"),
+        },
+        "ln_f": P(None),
+    }
+
+
+def init_params(rng, cfg: LMConfig) -> dict:
+    keys = jax.random.split(rng, 8)
+    e, f, l = cfg.d_model, cfg.d_ff, cfg.n_layers
+    dt = cfg.param_dtype
+
+    def norm(key, shape, scale):
+        return (jax.random.normal(key, shape) * scale).astype(dt)
+
+    return {
+        "embed": norm(keys[0], (cfg.vocab, e), e ** -0.5),
+        "layers": {
+            "ln1": jnp.ones((l, e), dt),
+            "wq": norm(keys[1], (l, e, e), e ** -0.5),
+            "wk": norm(keys[2], (l, e, e), e ** -0.5),
+            "wv": norm(keys[3], (l, e, e), e ** -0.5),
+            "wo": norm(keys[4], (l, e, e), (2 * l * e) ** -0.5),
+            "ln2": jnp.ones((l, e), dt),
+            "w1": norm(keys[5], (l, e, f), e ** -0.5),
+            "w3": norm(keys[6], (l, e, f), e ** -0.5),
+            "w2": norm(keys[7], (l, f, e), (2 * l * f) ** -0.5),
+        },
+        "ln_f": jnp.ones((e,), dt),
+    }
+
+
+def _rms_norm(x, scale):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x.astype(jnp.float32) * jax.lax.rsqrt(var + 1e-6)).astype(x.dtype) * scale
+
+
+def _rope(x, cfg: LMConfig):
+    """x: [B, H, T, D]; global positions (T is the full sequence under
+    jit's global-view semantics; sp sharding is carried by the data)."""
+    d = x.shape[-1]
+    t = x.shape[2]
+    freqs = cfg.rope_base ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+    ang = jnp.arange(t, dtype=jnp.float32)[:, None] * freqs[None, :]
+    cos, sin = jnp.cos(ang), jnp.sin(ang)
+    x1, x2 = x[..., 0::2], x[..., 1::2]
+    y1 = x1 * cos - x2 * sin
+    y2 = x1 * sin + x2 * cos
+    return jnp.stack([y1, y2], axis=-1).reshape(x.shape).astype(x.dtype)
+
+
+def forward(params: dict, tokens, cfg: LMConfig, mesh) -> jax.Array:
+    """tokens [B, T] int32 -> logits [B, T, vocab] float32."""
+    cdt = cfg.compute_dtype
+    act = NamedSharding(mesh, ACT_SPEC)
+    b, t = tokens.shape
+    h, dh = cfg.n_heads, cfg.head_dim
+
+    x = params["embed"].astype(cdt)[tokens]
+    x = lax.with_sharding_constraint(x, act)
+
+    def layer(x, lp):
+        y = _rms_norm(x, lp["ln1"].astype(cdt))
+        q = (y @ lp["wq"].astype(cdt)).reshape(b, t, h, dh).transpose(0, 2, 1, 3)
+        k = (y @ lp["wk"].astype(cdt)).reshape(b, t, h, dh).transpose(0, 2, 1, 3)
+        v = (y @ lp["wv"].astype(cdt)).reshape(b, t, h, dh).transpose(0, 2, 1, 3)
+        q, k = _rope(q, cfg), _rope(k, cfg)
+        o = ring_attention(q, k, v, mesh)
+        o = o.transpose(0, 2, 1, 3).reshape(b, t, h * dh)
+        x = x + lax.with_sharding_constraint(o @ lp["wo"].astype(cdt), act)
+
+        y = _rms_norm(x, lp["ln2"].astype(cdt))
+        gate = jax.nn.silu(y @ lp["w1"].astype(cdt)) * (y @ lp["w3"].astype(cdt))
+        x = x + lax.with_sharding_constraint(gate @ lp["w2"].astype(cdt), act)
+        return x, None
+
+    x, _ = lax.scan(layer, x, params["layers"])
+    x = _rms_norm(x, params["ln_f"].astype(cdt))
+    return (x @ params["embed"].astype(cdt).T).astype(jnp.float32)
+
+
+def loss_fn(params: dict, batch, cfg: LMConfig, mesh) -> jax.Array:
+    """batch [B, T+1] int32 -> mean next-token cross-entropy."""
+    inputs, targets = batch[:, :-1], batch[:, 1:]
+    logits = forward(params, inputs, cfg, mesh)
+    logz = jax.scipy.special.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(logz - gold)
+
+
+def make_optimizer(lr: float = 3e-3):
+    return optax.adamw(lr, b1=0.9, b2=0.95, weight_decay=0.01)
+
+
+def init_sharded(rng, cfg: LMConfig, mesh, lr: float = 3e-3):
+    """Params + optimizer state, laid out on the mesh. The opt state
+    inherits each param's sharding (built by tree ops on sharded leaves)."""
+    params = shard(mesh, init_params(rng, cfg), param_specs(cfg))
+    opt_state = make_optimizer(lr).init(params)
+    return params, opt_state
+
+
+def make_train_step(cfg: LMConfig, mesh, lr: float = 3e-3):
+    """Jitted full training step: fwd + bwd + AdamW update."""
+    opt = make_optimizer(lr)
+
+    def step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch, cfg, mesh)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    return jax.jit(step, donate_argnums=(0, 1))
+
+
+def make_forward(cfg: LMConfig, mesh):
+    return jax.jit(lambda params, tokens: forward(params, tokens, cfg, mesh))
+
+
+def synthetic_batch(rng, cfg: LMConfig, mesh, batch: int, seq: int):
+    """Deterministic learnable stream: next token = (3*tok + 7) % vocab
+    with occasional noise. [B, T+1]; batch dim sharded over (dp,fsdp)
+    (T+1 stays replicated — forward re-shards the T-length slice onto
+    sp via its activation constraints)."""
+    k1, k_mask, k_val = jax.random.split(rng, 3)
+    start = jax.random.randint(k1, (batch, 1), 0, cfg.vocab)
+    # Powers of 3 reduced mod vocab with Python ints — 3**t overflows
+    # int32 from t=20 and would silently degrade the stream.
+    pow3, p = [], 1
+    for _ in range(seq + 1):
+        pow3.append(p)
+        p = (p * 3) % cfg.vocab
+    steps = jnp.arange(seq + 1)
+    toks = (start * jnp.asarray(pow3) + 7 * steps) % cfg.vocab
+    noise = jax.random.bernoulli(k_mask, 0.02, toks.shape)
+    rand = jax.random.randint(k_val, toks.shape, 0, cfg.vocab)
+    toks = jnp.where(noise, rand, toks).astype(jnp.int32)
+    return jax.device_put(toks, NamedSharding(mesh, P(("dp", "fsdp"), None)))

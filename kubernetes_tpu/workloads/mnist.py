@@ -1,0 +1,72 @@
+"""JAX "MNIST" baseline payload (BASELINE config 2).
+
+Small MLP classifier trained on synthetic digits (a fixed random
+class-prototype projection plus noise — the image has no dataset
+egress), jitted end-to-end. Used by e2e tests as the single-chip
+training payload between vector-add and the flagship LM.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+
+N_CLASSES = 10
+DIM = 784
+
+
+def _synthetic(rng, n: int, seed: int = 0):
+    # Class prototypes are a function of `seed` only — fixed across
+    # batches; `rng` varies the samples.
+    protos = jax.random.normal(jax.random.PRNGKey(seed + 7919), (N_CLASSES, DIM))
+    kx, kn = jax.random.split(rng)
+    labels = jax.random.randint(kx, (n,), 0, N_CLASSES)
+    x = protos[labels] + 0.5 * jax.random.normal(kn, (n, DIM))
+    return x.astype(jnp.float32), labels
+
+
+def init_params(rng, hidden: int = 128):
+    k1, k2 = jax.random.split(rng)
+    return {
+        "w1": jax.random.normal(k1, (DIM, hidden)) * DIM ** -0.5,
+        "b1": jnp.zeros((hidden,)),
+        "w2": jax.random.normal(k2, (hidden, N_CLASSES)) * hidden ** -0.5,
+        "b2": jnp.zeros((N_CLASSES,)),
+    }
+
+
+def forward(params, x):
+    h = jax.nn.relu(x @ params["w1"] + params["b1"])
+    return h @ params["w2"] + params["b2"]
+
+
+def train(steps: int = 60, batch: int = 256, lr: float = 1e-2,
+          seed: int = 0) -> float:
+    """Returns final held-out accuracy (expected >0.9)."""
+    rng = jax.random.PRNGKey(seed)
+    params = init_params(rng)
+    opt = optax.adam(lr)
+    opt_state = opt.init(params)
+
+    def loss_fn(p, x, y):
+        logits = forward(p, x)
+        return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+
+    @jax.jit
+    def step(p, s, x, y):
+        loss, g = jax.value_and_grad(loss_fn)(p, x, y)
+        updates, s = opt.update(g, s)
+        return optax.apply_updates(p, updates), s, loss
+
+    for i in range(steps):
+        x, y = _synthetic(jax.random.fold_in(rng, i + 1), batch)
+        params, opt_state, _ = step(params, opt_state, x, y)
+
+    xt, yt = _synthetic(jax.random.fold_in(rng, 10_000), 1024)
+    acc = jnp.mean(jnp.argmax(forward(params, xt), -1) == yt)
+    return float(acc)
+
+
+if __name__ == "__main__":
+    import json
+    print(json.dumps({"accuracy": train()}))

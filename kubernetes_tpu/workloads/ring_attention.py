@@ -1,0 +1,99 @@
+"""Ring attention — causal attention with the sequence axis sharded.
+
+Long-context support for the flagship training workload: each device in
+the ``sp`` mesh axis holds one block of the sequence; K/V blocks rotate
+around the ring via ``lax.ppermute`` (ICI neighbor exchange on a real
+slice) while each device accumulates its queries' output with the
+numerically-stable streaming-softmax (flash-attention style) update.
+Peak memory per device is O(T/sp), so max context length scales with
+the sub-mesh the scheduler allocates — the orchestration requirement
+identified in SURVEY.md section 5.7.
+
+No reference analog (the reference is an orchestrator); the algorithm
+follows the public ring-attention formulation (PAPERS.md).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+try:  # jax >= 0.4.35 re-export
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_NEG = -1e30
+
+
+def _ring_block(q, k, v, *, axis: str):
+    """Per-device body. q/k/v: [B, H, Tl, D] local blocks."""
+    sp = lax.psum(1, axis)
+    i = lax.axis_index(axis)
+    bsz, heads, t_local, d = q.shape
+    scale = 1.0 / (d ** 0.5)
+    q32 = q.astype(jnp.float32) * scale
+
+    q_pos = i * t_local + jnp.arange(t_local)[:, None]
+    perm = [(s, (s + 1) % sp) for s in range(sp)]
+
+    def contrib(s, o, m, l, k_blk, v_blk):
+        # After s rotations we hold the block that started on device i-s.
+        j = (i - s) % sp
+        k_pos = j * t_local + jnp.arange(t_local)[None, :]
+        mask = jnp.where(q_pos >= k_pos, 0.0, _NEG)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q32, k_blk.astype(jnp.float32))
+        scores = scores + mask
+        m_new = jnp.maximum(m, scores.max(axis=-1, keepdims=True))
+        p = jnp.exp(scores - m_new)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + p.sum(axis=-1, keepdims=True)
+        o_new = o * corr + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v_blk.astype(jnp.float32))
+        return o_new, m_new, l_new
+
+    def step(s, carry):
+        o, m, l, k_blk, v_blk = carry
+        o, m, l = contrib(s, o, m, l, k_blk, v_blk)
+        k_blk = lax.ppermute(k_blk, axis, perm)
+        v_blk = lax.ppermute(v_blk, axis, perm)
+        return o, m, l, k_blk, v_blk
+
+    # Derive the carry from q so it is device-varying from the start
+    # (shard_map's VMA typing rejects an unvarying initial carry).
+    o0 = jnp.zeros_like(q32)
+    m0 = jnp.full_like(q32[..., :1], _NEG)
+    l0 = jnp.zeros_like(q32[..., :1])
+    # sp-1 rotated steps, then the final block peeled so its K/V are
+    # not pointlessly ppermuted (2 ICI transfers saved per layer/step).
+    o, m, l, k_last, v_last = lax.fori_loop(
+        0, sp - 1, step, (o0, m0, l0, k, v))
+    o, m, l = contrib(sp - 1, o, m, l, k_last, v_last)
+    return (o / jnp.maximum(l, 1e-30)).astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh, *, seq_axis: str = "sp"):
+    """Causal MHA over [B, H, T, D] with batch on (dp,fsdp), heads on
+    tp, sequence on the ring axis. Degenerates to ordinary blockwise
+    attention when the ring has one member."""
+    spec = P(("dp", "fsdp"), "tp", seq_axis, None)
+    fn = _shard_map(
+        functools.partial(_ring_block, axis=seq_axis), mesh=mesh,
+        in_specs=(spec, spec, spec), out_specs=spec)
+    return fn(q, k, v)
+
+
+def reference_attention(q, k, v):
+    """Plain global causal attention, for numerics tests."""
+    d = q.shape[-1]
+    scores = jnp.einsum(
+        "bhqd,bhkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32))
+    scores = scores / (d ** 0.5)
+    t = q.shape[2]
+    mask = jnp.where(
+        jnp.arange(t)[:, None] >= jnp.arange(t)[None, :], 0.0, _NEG)
+    p = jax.nn.softmax(scores + mask, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)

@@ -1,0 +1,67 @@
+"""Mesh construction + parameter/activation sharding rules.
+
+The scheduler allocates a contiguous ICI sub-mesh (submesh.py) and the
+device plugin exports its shape to the job; this module turns that into
+a ``jax.sharding.Mesh`` with the canonical training axes:
+
+- ``dp``   pure data parallelism (gradients all-reduced),
+- ``fsdp`` data parallelism with parameters sharded (ZeRO-3 style;
+           XLA inserts the all-gathers/reduce-scatters),
+- ``sp``   sequence/context parallelism (ring attention over ICI),
+- ``tp``   tensor parallelism (attention heads + FFN columns).
+
+Batch is sharded over ``(dp, fsdp)``, sequence over ``sp``. Matmul
+operands stay large and bfloat16 so XLA tiles them onto the MXU.
+"""
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXES = ("dp", "fsdp", "sp", "tp")
+
+#: Activations [batch, seq, embed].
+ACT_SPEC = P(("dp", "fsdp"), "sp", None)
+#: Token batches [batch, seq].
+DATA_SPEC = P(("dp", "fsdp"), "sp")
+
+
+def default_axis_sizes(n_devices: int) -> dict[str, int]:
+    """Factor a device count into (dp, fsdp, sp, tp) sizes.
+
+    Prefers giving each parallelism style a non-trivial axis when the
+    count allows (8 -> fsdp=2, sp=2, tp=2), then grows dp — the axis
+    whose collectives are cheapest — with whatever remains.
+    """
+    sizes = {"dp": 1, "fsdp": 1, "sp": 1, "tp": 1}
+    remaining = n_devices
+    for axis in ("tp", "sp", "fsdp"):
+        if remaining % 2 == 0:
+            sizes[axis] = 2
+            remaining //= 2
+    sizes["dp"] = remaining
+    return sizes
+
+
+def make_mesh(devices=None, *, dp: int = 1, fsdp: int = 1, sp: int = 1,
+              tp: int = 1) -> Mesh:
+    """Mesh with all four canonical axes (unused axes get size 1, so
+    every model code path is identical regardless of scale)."""
+    if devices is None:
+        devices = jax.devices()
+    want = dp * fsdp * sp * tp
+    if len(devices) < want:
+        raise ValueError(f"need {want} devices, have {len(devices)}")
+    grid = np.asarray(devices[:want]).reshape(dp, fsdp, sp, tp)
+    return Mesh(grid, AXES)
+
+
+def mesh_for(n_devices: int, devices=None) -> Mesh:
+    return make_mesh(devices, **default_axis_sizes(n_devices))
+
+
+def shard(mesh: Mesh, tree, spec_tree):
+    """device_put a pytree according to a matching tree of PartitionSpecs."""
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), tree, spec_tree)

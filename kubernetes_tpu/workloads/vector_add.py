@@ -1,0 +1,43 @@
+"""tpu-vector-add — the e2e smoke payload.
+
+TPU-native equivalent of the reference's ``cuda-vector-add`` image
+(``test/images/cuda-vector-add/Dockerfile:15-26``, run by
+``test/e2e/scheduling/nvidia-gpus.go`` on every advertised device): a
+minimal pallas kernel that proves the pod really has a live TPU core.
+Falls back to pallas interpret mode off-TPU so the same payload runs
+under hollow/CI clusters.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _add_kernel(x_ref, y_ref, o_ref):
+    o_ref[...] = x_ref[...] + y_ref[...]
+
+
+def vector_add(x, y):
+    return pl.pallas_call(
+        _add_kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        interpret=jax.default_backend() != "tpu",
+    )(x, y)
+
+
+def smoke_test(n: int = 1 << 16) -> dict:
+    """Returns the payload's report; raises if the device lied."""
+    x = jnp.arange(n, dtype=jnp.float32)
+    y = jnp.full((n,), 2.0, jnp.float32)
+    out = jax.jit(vector_add)(x, y)
+    if not jnp.allclose(out, x + 2.0):
+        raise AssertionError("vector_add mismatch")
+    dev = jax.devices()[0]
+    return {"ok": True, "n": n, "platform": dev.platform,
+            "device": str(dev)}
+
+
+if __name__ == "__main__":
+    import json
+    print(json.dumps(smoke_test()))
