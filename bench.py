@@ -1,0 +1,31 @@
+"""Headline benchmark — ONE JSON line.
+
+Runs the scheduler density harness at the reference's
+``test/integration/scheduler_perf`` scale (3k pods / 100 fake nodes)
+and reports saturation pod throughput. Baseline: the reference's
+cluster-saturation floor of 8 pods/s
+(``test/e2e/scalability/density.go:56,280``; BASELINE.md).
+"""
+import asyncio
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from kubernetes_tpu.perf.density import run_density  # noqa: E402
+
+
+def main() -> None:
+    res = asyncio.run(run_density(n_nodes=100, n_pods=3000))
+    print(json.dumps({
+        "metric": "scheduler_pod_throughput",
+        "value": res["pods_per_second"],
+        "unit": "pods/s",
+        "vs_baseline": round(res["pods_per_second"] / 8.0, 2),
+        "detail": res,
+    }))
+
+
+if __name__ == "__main__":
+    main()

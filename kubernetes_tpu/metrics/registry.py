@@ -46,6 +46,14 @@ class Metric:
     def render(self) -> str:
         raise NotImplementedError
 
+    def reset(self) -> None:
+        """Drop all samples (benchmark harnesses isolate runs with this)."""
+        with self._lock:
+            for attr in ("_values", "_counts", "_sums", "_totals"):
+                d = getattr(self, attr, None)
+                if d is not None:
+                    d.clear()
+
 
 class Counter(Metric):
     kind = "counter"

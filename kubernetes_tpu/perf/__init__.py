@@ -1,0 +1,2 @@
+"""Performance harnesses (reference: ``test/integration/scheduler_perf``
+and the kubemark hollow-node rig, SURVEY.md section 4)."""

@@ -1,0 +1,132 @@
+"""Scheduler density harness — pods/s + schedule-latency percentiles.
+
+Reference analog: ``test/integration/scheduler_perf`` (schedule 3k pods
+onto 100 API-object-only fake nodes, print pods/s; README.md:20-30) and
+the density e2e's >= 8 pods/s saturation floor
+(``test/e2e/scalability/density.go:56,280``). Nodes here are pure API
+objects — no node agents — exactly like the reference harness; hollow
+node agents (kubemark) live in :mod:`kubernetes_tpu.perf.hollow`.
+
+Run directly: ``python -m kubernetes_tpu.perf.density [nodes] [pods]``.
+"""
+from __future__ import annotations
+
+import asyncio
+import time
+
+from ..api import types as t
+from ..api.meta import ObjectMeta
+from ..apiserver.admission import default_chain
+from ..apiserver.registry import Registry
+from ..client.local import LocalClient
+from ..scheduler import metrics as sched_metrics
+from ..scheduler.scheduler import Scheduler
+
+
+def hollow_node(name: str, cpu: float = 32.0, mem: float = 128 * 2**30,
+                pods: int = 110, tpu_chips: int = 0, slice_id: str = "",
+                mesh_shape=None) -> t.Node:
+    """API-object node; optionally advertises a TPU topology."""
+    node = t.Node(metadata=ObjectMeta(
+        name=name, labels={"kubernetes.io/hostname": name}))
+    node.status.capacity = {"cpu": cpu, "memory": mem, "pods": float(pods)}
+    node.status.conditions = [t.NodeCondition(type=t.NODE_READY, status="True")]
+    if tpu_chips:
+        if mesh_shape:
+            shape = mesh_shape
+        elif tpu_chips % 4 == 0:
+            shape = [2, 2, tpu_chips // 4]
+        else:
+            shape = [tpu_chips, 1, 1]
+        if shape[0] * shape[1] * shape[2] != tpu_chips:
+            raise ValueError(f"mesh_shape {shape} != {tpu_chips} chips")
+        node.status.tpu = t.TpuTopology(
+            chip_type="v5p", slice_id=slice_id or f"slice-{name}",
+            mesh_shape=shape,
+            chips=[t.TpuChip(id=f"{name}-c{i}",
+                             coords=[i % shape[0], (i // shape[0]) % shape[1],
+                                     i // (shape[0] * shape[1])],
+                             attributes={"chip_type": "v5p"})
+                   for i in range(tpu_chips)])
+        node.status.capacity[t.RESOURCE_TPU] = float(tpu_chips)
+    node.status.allocatable = dict(node.status.capacity)
+    return node
+
+
+def density_pod(name: str, cpu: float = 0.1, mem: float = 64 * 2**20) -> t.Pod:
+    return t.Pod(
+        metadata=ObjectMeta(name=name, namespace="default",
+                            labels={"app": "density"}),
+        spec=t.PodSpec(containers=[t.Container(
+            name="c", image="pause",
+            resources=t.ResourceRequirements(
+                requests={"cpu": cpu, "memory": mem}))]))
+
+
+async def run_density(n_nodes: int = 100, n_pods: int = 3000,
+                      timeout: float = 600.0) -> dict:
+    """Create nodes, start the scheduler, pour pods in, wait until every
+    pod is bound. Returns throughput + latency percentiles."""
+    for m in (sched_metrics.E2E_SCHEDULING_LATENCY,
+              sched_metrics.ALGORITHM_LATENCY,
+              sched_metrics.BINDING_LATENCY,
+              sched_metrics.PODS_SCHEDULED):
+        m.reset()  # isolate this run from earlier ones in the process
+    reg = Registry()
+    reg.admission = default_chain(reg)
+    reg.create(t.Namespace(metadata=ObjectMeta(name="default")))
+    for i in range(n_nodes):
+        reg.create(hollow_node(f"hollow-{i:04d}"))
+    client = LocalClient(reg)
+    sched = Scheduler(client, backoff_seconds=0.5)
+    await sched.start()
+
+    bound: dict[str, str] = {}  # pod -> node
+    done = asyncio.Event()
+    stream = await client.watch("pods", namespace="default")
+
+    async def count_bound():
+        async for ev_type, pod in stream:
+            if ev_type in ("ADDED", "MODIFIED") and pod.spec.node_name:
+                bound[pod.metadata.name] = pod.spec.node_name
+                if len(bound) >= n_pods:
+                    done.set()
+                    return
+
+    counter = asyncio.create_task(count_bound())
+    start = time.perf_counter()
+    try:
+        for i in range(n_pods):
+            reg.create(density_pod(f"density-{i:05d}"))
+            if i % 500 == 499:
+                await asyncio.sleep(0)  # let the scheduler breathe
+        await asyncio.wait_for(done.wait(), timeout)
+        wall = time.perf_counter() - start
+    finally:
+        stream.cancel()
+        counter.cancel()
+        await sched.stop()
+
+    per_node: dict[str, int] = {}
+    for node_name in bound.values():
+        per_node[node_name] = per_node.get(node_name, 0) + 1
+    hist = sched_metrics.E2E_SCHEDULING_LATENCY
+    return {
+        "nodes": n_nodes,
+        "pods": n_pods,
+        "wall_seconds": round(wall, 3),
+        "pods_per_second": round(n_pods / wall, 2),
+        "max_pods_per_node": max(per_node.values(), default=0),
+        "schedule_latency_p50_ms": round(hist.quantile(0.50) * 1e3, 3),
+        "schedule_latency_p90_ms": round(hist.quantile(0.90) * 1e3, 3),
+        "schedule_latency_p99_ms": round(hist.quantile(0.99) * 1e3, 3),
+    }
+
+
+if __name__ == "__main__":
+    import json
+    import sys
+
+    nodes = int(sys.argv[1]) if len(sys.argv) > 1 else 100
+    pods = int(sys.argv[2]) if len(sys.argv) > 2 else 3000
+    print(json.dumps(asyncio.run(run_density(nodes, pods))))

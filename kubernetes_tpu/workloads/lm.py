@@ -175,10 +175,10 @@ def make_forward(cfg: LMConfig, mesh):
 
 
 def synthetic_batch(rng, cfg: LMConfig, mesh, batch: int, seq: int):
-    """Deterministic learnable stream: next token = (3*tok + 7) % vocab
-    with occasional noise. [B, T+1]; batch dim sharded over (dp,fsdp)
-    (T+1 stays replicated — forward re-shards the T-length slice onto
-    sp via its activation constraints)."""
+    """Deterministic learnable stream tok_n = (3^n * tok_0 + 7n) % vocab
+    with 2% replacement noise. [B, T+1]; batch dim sharded over
+    (dp,fsdp) (T+1 stays replicated — forward re-shards the T-length
+    slice onto sp via its activation constraints)."""
     k1, k_mask, k_val = jax.random.split(rng, 3)
     start = jax.random.randint(k1, (batch, 1), 0, cfg.vocab)
     # Powers of 3 reduced mod vocab with Python ints — 3**t overflows

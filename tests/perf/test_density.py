@@ -1,0 +1,16 @@
+"""Density harness sanity (small scale; reference tier:
+test/integration/scheduler_perf)."""
+from kubernetes_tpu.perf.density import run_density
+
+
+async def test_density_small():
+    res = await run_density(n_nodes=10, n_pods=100, timeout=60)
+    assert res["pods_per_second"] > 8.0  # the reference saturation floor
+    assert res["schedule_latency_p50_ms"] < 5000
+
+
+async def test_density_respects_capacity():
+    # 2 nodes x 110 pod slots: 200 pods must all bind without any node
+    # exceeding its pods allocatable.
+    res = await run_density(n_nodes=2, n_pods=200, timeout=60)
+    assert res["max_pods_per_node"] <= 110
