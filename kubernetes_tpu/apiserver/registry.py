@@ -323,6 +323,11 @@ class Registry:
             raise errors.ConflictError(
                 f"uid precondition failed: have {obj.metadata.uid}, want {preconditions_uid}")
         graceful = spec.graceful_delete and (grace_period_seconds is None or grace_period_seconds > 0)
+        if graceful and isinstance(obj, t.Pod) and not obj.spec.node_name:
+            # Unscheduled pods have no node agent to confirm termination:
+            # delete immediately (reference: pkg/registry/core/pod/strategy.go
+            # CheckGracefulDelete zeroes grace when the pod is unassigned).
+            graceful = False
         if obj.metadata.deletion_timestamp is None and (graceful or obj.metadata.finalizers):
             # First DELETE: mark, don't remove (kubelet / finalizer owners
             # complete the deletion). Reference: graceful pod termination.

@@ -1,0 +1,8 @@
+"""Controllers — level-triggered reconcile loops over the API server.
+
+Reference: ``pkg/controller/`` (36.6k LoC) driven by
+``cmd/kube-controller-manager/app/controllermanager.go:332
+NewControllerInitializers``. Each controller is an informer-fed,
+workqueue-drained reconcile loop (the pattern of
+``pkg/controller/replicaset/replica_set.go:178,433,572``).
+"""

@@ -1,0 +1,133 @@
+"""DaemonSet controller — one pod per eligible node.
+
+Reference: ``pkg/controller/daemon`` (2.0k LoC). As in the reference era
+(v1.9), the controller itself places pods by setting ``spec.nodeName``
+directly — daemon pods bypass the scheduler, which is what lets the TPU
+device plugin and metrics exporter run even on NotReady nodes.
+Tolerations/nodeSelector/taints are evaluated here.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..api import errors
+from ..api import types as t
+from ..api import workloads as w
+from ..api.meta import is_controlled_by
+from ..client.informer import InformerFactory
+from ..client.interface import Client
+from .base import Controller, PodControl, is_pod_active, is_pod_ready
+
+
+def node_eligible(ds: w.DaemonSet, node: t.Node) -> bool:
+    template = ds.spec.template
+    # Unschedulable nodes stay eligible: daemon pods ARE the node's
+    # plumbing (matches the reference's critical-daemon behavior).
+    for k, v in template.spec.node_selector.items():
+        if node.metadata.labels.get(k) != v:
+            return False
+    for taint in node.spec.taints:
+        if taint.effect not in ("NoSchedule", "NoExecute"):
+            continue
+        # Not-ready/unreachable taints are tolerated by default: daemons
+        # must keep running to fix the node.
+        if taint.key in (t.TAINT_NODE_NOT_READY, t.TAINT_NODE_UNREACHABLE,
+                         t.TAINT_NODE_UNSCHEDULABLE):
+            continue
+        if not any(tol.tolerates(taint) for tol in template.spec.tolerations):
+            return False
+    if template.spec.affinity and template.spec.affinity.node_required:
+        if not any(term.matches(node.metadata.labels)
+                   for term in template.spec.affinity.node_required):
+            return False
+    return True
+
+
+class DaemonSetController(Controller):
+    name = "daemonset-controller"
+
+    def __init__(self, client: Client, factory: InformerFactory,
+                 workers: int = 2):
+        super().__init__(client, factory, workers)
+        self.pod_control = PodControl(client, self.recorder)
+        self.ds_informer = self.watch("daemonsets")
+        self.pod_informer = self.watch("pods")
+        self.node_informer = self.watch("nodes")
+        self.ds_informer.add_handlers(
+            on_add=self.enqueue_obj,
+            on_update=lambda o, n: self.enqueue_obj(n),
+            on_delete=self.enqueue_obj)
+        self.pod_informer.add_handlers(
+            on_add=lambda p: self.enqueue_owner(p, "DaemonSet"),
+            on_update=lambda o, n: self.enqueue_owner(n, "DaemonSet"),
+            on_delete=lambda p: self.enqueue_owner(p, "DaemonSet"))
+        # Any node change can flip eligibility for every DaemonSet.
+        self.node_informer.add_handlers(
+            on_add=lambda n: self._enqueue_all(),
+            on_update=lambda o, n: self._enqueue_all(),
+            on_delete=lambda n: self._enqueue_all())
+
+    def _enqueue_all(self) -> None:
+        for ds in self.ds_informer.list():
+            self.enqueue_obj(ds)
+
+    def _pods_by_node(self, ds: w.DaemonSet) -> dict[str, list[t.Pod]]:
+        out: dict[str, list[t.Pod]] = {}
+        for pod in self.pod_informer.list():
+            if pod.metadata.namespace != ds.metadata.namespace:
+                continue
+            if not is_controlled_by(pod, ds):
+                continue
+            out.setdefault(pod.spec.node_name, []).append(pod)
+        return out
+
+    async def sync(self, key: str) -> Optional[float]:
+        ds = self.ds_informer.get(key)
+        if ds is None or ds.metadata.deletion_timestamp is not None:
+            return None
+        by_node = self._pods_by_node(ds)
+        eligible = {n.metadata.name for n in self.node_informer.list()
+                    if node_eligible(ds, n)}
+
+        for node_name in eligible:
+            pods = [p for p in by_node.get(node_name, []) if is_pod_active(p)]
+            if not pods:
+                def place(pod, node=node_name):
+                    pod.spec.node_name = node
+                await self.pod_control.create_pod(
+                    ds, ds.spec.template,
+                    generate_name=f"{ds.metadata.name}-", mutate=place)
+            elif len(pods) > 1:
+                for pod in pods[1:]:
+                    await self.pod_control.delete_pod(ds, pod)
+
+        for node_name, pods in by_node.items():
+            if node_name and node_name not in eligible:
+                for pod in pods:
+                    if is_pod_active(pod):
+                        await self.pod_control.delete_pod(ds, pod)
+
+        await self._update_status(ds, by_node, eligible)
+        return None
+
+    async def _update_status(self, ds, by_node, eligible) -> None:
+        scheduled = {n: ps for n, ps in by_node.items()
+                     if n and any(is_pod_active(p) for p in ps)}
+        new = w.DaemonSetStatus(
+            desired_number_scheduled=len(eligible),
+            current_number_scheduled=sum(1 for n in scheduled if n in eligible),
+            number_misscheduled=sum(1 for n in scheduled if n not in eligible),
+            number_ready=sum(
+                1 for n, ps in scheduled.items()
+                if any(is_pod_ready(p) for p in ps)),
+            number_available=sum(
+                1 for n, ps in scheduled.items()
+                if any(is_pod_ready(p) for p in ps)),
+            observed_generation=ds.metadata.generation)
+        if new == ds.status:
+            return
+        fresh = w.DaemonSet(metadata=ds.metadata, spec=ds.spec, status=new)
+        try:
+            await self.client.update(fresh, subresource="status")
+        except errors.NotFoundError:
+            pass

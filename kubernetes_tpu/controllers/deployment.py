@@ -1,0 +1,233 @@
+"""Deployment controller — declarative rollouts over ReplicaSets.
+
+Reference: ``pkg/controller/deployment`` (3.1k LoC): hash the pod
+template, own one ReplicaSet per revision, scale the new RS up and old
+RSs down under maxSurge/maxUnavailable (RollingUpdate) or all-at-once
+(Recreate), prune history beyond revisionHistoryLimit, aggregate status.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import math
+from typing import Optional
+
+from ..api import errors
+from ..api import types as t
+from ..api import workloads as w
+from ..api.meta import controller_ref, is_controlled_by, now, split_key
+from ..api.scheme import deepcopy, to_dict
+from ..client.informer import InformerFactory
+from ..client.interface import Client
+from .base import Controller, is_pod_active
+
+#: Label carrying the template hash — the join key between a Deployment
+#: revision, its ReplicaSet, and that RS's pods.
+TEMPLATE_HASH_LABEL = "pod-template-hash"
+REVISION_ANNOTATION = "deployment.tpu/revision"
+
+
+def template_hash(template: t.PodTemplateSpec) -> str:
+    payload = json.dumps(to_dict(template), sort_keys=True, default=str)
+    return hashlib.sha1(payload.encode()).hexdigest()[:10]
+
+
+def _resolve_percent(value, total: int, default: str, round_up: bool) -> int:
+    """Percent -> pod count. maxSurge rounds up, maxUnavailable rounds
+    down (reference: intstr.GetValueFromIntOrPercent usage in
+    ``pkg/controller/deployment/util``)."""
+    s = str(value if value is not None else default)
+    if s.endswith("%"):
+        frac = total * float(s[:-1]) / 100.0
+        return math.ceil(frac) if round_up else math.floor(frac)
+    return int(float(s))
+
+
+class DeploymentController(Controller):
+    name = "deployment-controller"
+
+    def __init__(self, client: Client, factory: InformerFactory,
+                 workers: int = 2):
+        super().__init__(client, factory, workers)
+        self.dep_informer = self.watch("deployments")
+        self.rs_informer = self.watch("replicasets")
+        self.dep_informer.add_handlers(
+            on_add=self.enqueue_obj,
+            on_update=lambda o, n: self.enqueue_obj(n),
+            on_delete=self.enqueue_obj)
+        self.rs_informer.add_handlers(
+            on_add=lambda rs: self.enqueue_owner(rs, "Deployment"),
+            on_update=lambda o, n: self.enqueue_owner(n, "Deployment"),
+            on_delete=lambda rs: self.enqueue_owner(rs, "Deployment"))
+
+    # -- RS bookkeeping ---------------------------------------------------
+
+    def _owned_rss(self, dep: w.Deployment) -> list[w.ReplicaSet]:
+        return [rs for rs in self.rs_informer.list()
+                if rs.metadata.namespace == dep.metadata.namespace
+                and is_controlled_by(rs, dep)]
+
+    async def _new_rs(self, dep: w.Deployment, rss: list[w.ReplicaSet],
+                      hash_: str) -> w.ReplicaSet:
+        for rs in rss:
+            if rs.metadata.labels.get(TEMPLATE_HASH_LABEL) == hash_:
+                return rs
+        template = deepcopy(dep.spec.template)
+        template.metadata.labels = {**template.metadata.labels,
+                                    TEMPLATE_HASH_LABEL: hash_}
+        selector = deepcopy(dep.spec.selector) if dep.spec.selector else None
+        if selector is not None:
+            selector.match_labels = {**selector.match_labels,
+                                     TEMPLATE_HASH_LABEL: hash_}
+        revision = 1 + max(
+            (int(rs.metadata.annotations.get(REVISION_ANNOTATION, 0))
+             for rs in rss), default=0)
+        rs = w.ReplicaSet(
+            metadata=t.ObjectMeta(
+                name=f"{dep.metadata.name}-{hash_}",
+                namespace=dep.metadata.namespace,
+                labels=dict(template.metadata.labels),
+                annotations={REVISION_ANNOTATION: str(revision)},
+                owner_references=[controller_ref(dep, w.APPS_V1, "Deployment")]),
+            spec=w.ReplicaSetSpec(replicas=0,
+                                  min_ready_seconds=dep.spec.min_ready_seconds,
+                                  selector=selector, template=template))
+        try:
+            created = await self.client.create(rs)
+        except errors.AlreadyExistsError:
+            created = await self.client.get("replicasets", rs.metadata.namespace,
+                                            rs.metadata.name)
+        self.recorder.event(dep, "Normal", "ScalingReplicaSet",
+                            f"Created replica set {rs.metadata.name}")
+        return created
+
+    async def _scale_rs(self, rs: w.ReplicaSet, replicas: int) -> w.ReplicaSet:
+        if rs.spec.replicas == replicas:
+            return rs
+        fresh = deepcopy(rs)
+        fresh.spec.replicas = replicas
+        return await self.client.update(fresh)
+
+    # -- reconcile --------------------------------------------------------
+
+    async def sync(self, key: str) -> Optional[float]:
+        dep = self.dep_informer.get(key)
+        if dep is None or dep.metadata.deletion_timestamp is not None:
+            return None
+        rss = self._owned_rss(dep)
+        if dep.spec.paused:
+            await self._update_status(dep, rss)
+            return None
+        hash_ = template_hash(dep.spec.template)
+        new_rs = await self._new_rs(dep, rss, hash_)
+        old_rss = [rs for rs in rss if rs.metadata.name != new_rs.metadata.name]
+
+        if dep.spec.strategy.type == w.RECREATE:
+            await self._rollout_recreate(dep, new_rs, old_rss)
+        else:
+            await self._rollout_rolling(dep, new_rs, old_rss)
+
+        await self._cleanup_history(dep, old_rss)
+        await self._update_status(dep, self._owned_rss(dep))
+        return None
+
+    async def _rollout_recreate(self, dep, new_rs, old_rss) -> None:
+        for rs in old_rss:
+            await self._scale_rs(rs, 0)
+        # Wait until old pods are gone before scaling up the new RS.
+        if any(rs.status.replicas > 0 for rs in old_rss):
+            return
+        await self._scale_rs(new_rs, dep.spec.replicas)
+
+    async def _rollout_rolling(self, dep, new_rs, old_rss) -> None:
+        desired = dep.spec.replicas
+        ru = dep.spec.strategy.rolling_update
+        max_surge = _resolve_percent(ru.max_surge, desired, "25%", round_up=True)
+        max_unavailable = _resolve_percent(ru.max_unavailable, desired, "25%",
+                                           round_up=False)
+        if max_surge == 0 and max_unavailable == 0:
+            max_unavailable = 1
+
+        old_total = sum(rs.spec.replicas for rs in old_rss)
+        all_total = old_total + new_rs.spec.replicas
+
+        # Scale up the new RS bounded by desired + maxSurge.
+        if new_rs.spec.replicas < desired:
+            allowed = desired + max_surge - all_total
+            if allowed > 0:
+                grow = min(allowed, desired - new_rs.spec.replicas)
+                new_rs = await self._scale_rs(new_rs, new_rs.spec.replicas + grow)
+        elif new_rs.spec.replicas > desired:
+            new_rs = await self._scale_rs(new_rs, desired)
+
+        # Scale down old RSs bounded by availability: keep at least
+        # desired - maxUnavailable ready pods across all RSs.
+        available = sum(rs.status.available_replicas
+                        for rs in old_rss) + new_rs.status.available_replicas
+        min_available = desired - max_unavailable
+        can_remove = available - min_available
+        for rs in sorted(old_rss, key=lambda r: r.metadata.name):
+            if can_remove <= 0:
+                break
+            if rs.spec.replicas == 0:
+                continue
+            shrink = min(rs.spec.replicas, can_remove)
+            await self._scale_rs(rs, rs.spec.replicas - shrink)
+            can_remove -= shrink
+
+    async def _cleanup_history(self, dep, old_rss) -> None:
+        dead = [rs for rs in old_rss
+                if rs.spec.replicas == 0 and rs.status.replicas == 0]
+        dead.sort(key=lambda rs: int(
+            rs.metadata.annotations.get(REVISION_ANNOTATION, 0)))
+        excess = len(dead) - dep.spec.revision_history_limit
+        for rs in dead[:max(excess, 0)]:
+            try:
+                await self.client.delete("replicasets", rs.metadata.namespace,
+                                         rs.metadata.name)
+            except errors.NotFoundError:
+                pass
+
+    async def _update_status(self, dep, rss) -> None:
+        hash_ = template_hash(dep.spec.template)
+        updated = sum(rs.status.replicas for rs in rss
+                      if rs.metadata.labels.get(TEMPLATE_HASH_LABEL) == hash_)
+        total = sum(rs.status.replicas for rs in rss)
+        ready = sum(rs.status.ready_replicas for rs in rss)
+        available = sum(rs.status.available_replicas for rs in rss)
+        new = w.DeploymentStatus(
+            observed_generation=dep.metadata.generation,
+            replicas=total, updated_replicas=updated, ready_replicas=ready,
+            available_replicas=available,
+            unavailable_replicas=max(dep.spec.replicas - available, 0),
+            conditions=[deepcopy(c) for c in dep.status.conditions])
+        self._set_condition(
+            new, "Available",
+            "True" if available >= dep.spec.replicas else "False",
+            "MinimumReplicasAvailable" if available >= dep.spec.replicas
+            else "MinimumReplicasUnavailable")
+        complete = (updated == dep.spec.replicas and total == dep.spec.replicas
+                    and available >= dep.spec.replicas)
+        self._set_condition(
+            new, "Progressing", "True",
+            "NewReplicaSetAvailable" if complete else "ReplicaSetUpdated")
+        if new == dep.status:
+            return
+        fresh = w.Deployment(metadata=dep.metadata, spec=dep.spec, status=new)
+        try:
+            await self.client.update(fresh, subresource="status")
+        except errors.NotFoundError:
+            pass
+
+    @staticmethod
+    def _set_condition(status: w.DeploymentStatus, type_: str, value: str,
+                       reason: str) -> None:
+        for c in status.conditions:
+            if c.type == type_:
+                if c.status != value or c.reason != reason:
+                    c.status, c.reason = value, reason
+                    c.last_transition_time = now()
+                return
+        status.conditions = status.conditions + [w.DeploymentCondition(
+            type=type_, status=value, reason=reason,
+            last_transition_time=now())]

@@ -1,0 +1,164 @@
+"""StatefulSet controller — ranked, stable-identity workers.
+
+Reference: ``pkg/controller/statefulset`` (1.7k LoC). Pods are named
+``<set>-<ordinal>`` and carry stable DNS identity via the headless
+service (hostname=pod name, subdomain=serviceName). This is the rank
+substrate for distributed TPU jobs (SURVEY.md section 2.4: "stable
+identity for ranks: StatefulSet + headless Services").
+
+TPU-first addition: every pod gets ``TPU_WORKER_ID=<ordinal>`` and
+``TPU_WORKER_HOSTNAMES`` env so a JAX multi-host job can bootstrap
+``jax.distributed`` without an external coordinator.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Optional
+
+from ..api import errors
+from ..api import types as t
+from ..api import workloads as w
+from ..api.meta import controller_ref, is_controlled_by
+from ..api.scheme import deepcopy, to_dict
+from ..client.informer import InformerFactory
+from ..client.interface import Client
+from .base import Controller, PodControl, is_pod_active, is_pod_ready
+
+POD_NAME_LABEL = "statefulset.tpu/pod-name"
+REVISION_LABEL = "statefulset.tpu/revision"
+
+
+def _revision(spec_template: t.PodTemplateSpec) -> str:
+    payload = json.dumps(to_dict(spec_template), sort_keys=True, default=str)
+    return hashlib.sha1(payload.encode()).hexdigest()[:10]
+
+
+def ordinal_of(pod_name: str, set_name: str) -> int:
+    suffix = pod_name[len(set_name) + 1:]
+    try:
+        return int(suffix)
+    except ValueError:
+        return -1
+
+
+class StatefulSetController(Controller):
+    name = "statefulset-controller"
+
+    def __init__(self, client: Client, factory: InformerFactory,
+                 workers: int = 2):
+        super().__init__(client, factory, workers)
+        self.pod_control = PodControl(client, self.recorder)
+        self.set_informer = self.watch("statefulsets")
+        self.pod_informer = self.watch("pods")
+        self.set_informer.add_handlers(
+            on_add=self.enqueue_obj,
+            on_update=lambda o, n: self.enqueue_obj(n),
+            on_delete=self.enqueue_obj)
+        self.pod_informer.add_handlers(
+            on_add=lambda p: self.enqueue_owner(p, "StatefulSet"),
+            on_update=lambda o, n: self.enqueue_owner(n, "StatefulSet"),
+            on_delete=lambda p: self.enqueue_owner(p, "StatefulSet"))
+
+    def _pods_for(self, st: w.StatefulSet) -> dict[int, t.Pod]:
+        out: dict[int, t.Pod] = {}
+        for pod in self.pod_informer.list():
+            if pod.metadata.namespace != st.metadata.namespace:
+                continue
+            if not is_controlled_by(pod, st):
+                continue
+            o = ordinal_of(pod.metadata.name, st.metadata.name)
+            if o >= 0:
+                out[o] = pod
+        return out
+
+    def _mutator(self, st: w.StatefulSet, ordinal: int, revision: str):
+        hostnames = ",".join(
+            f"{st.metadata.name}-{i}.{st.spec.service_name}"
+            f".{st.metadata.namespace}" if st.spec.service_name else
+            f"{st.metadata.name}-{i}" for i in range(st.spec.replicas))
+
+        def mutate(pod: t.Pod) -> None:
+            pod.spec.hostname = pod.metadata.name
+            pod.spec.subdomain = st.spec.service_name
+            pod.metadata.labels = {**pod.metadata.labels,
+                                   POD_NAME_LABEL: pod.metadata.name,
+                                   REVISION_LABEL: revision}
+            rank_env = [
+                t.EnvVar(name="TPU_WORKER_ID", value=str(ordinal)),
+                t.EnvVar(name="TPU_WORKER_HOSTNAMES", value=hostnames),
+            ]
+            for c in pod.spec.containers:
+                have = {e.name for e in c.env}
+                c.env = c.env + [e for e in rank_env if e.name not in have]
+
+        return mutate
+
+    async def sync(self, key: str) -> Optional[float]:
+        st = self.set_informer.get(key)
+        if st is None or st.metadata.deletion_timestamp is not None:
+            return None
+        revision = _revision(st.spec.template)
+        pods = self._pods_for(st)
+        ordered = st.spec.pod_management_policy != "Parallel"
+
+        # Create missing ordinals [0, replicas), lowest first; in
+        # OrderedReady mode stop at the first not-yet-ready predecessor.
+        for i in range(st.spec.replicas):
+            pod = pods.get(i)
+            if pod is None:
+                await self.pod_control.create_pod(
+                    st, st.spec.template, name=f"{st.metadata.name}-{i}",
+                    mutate=self._mutator(st, i, revision))
+                if ordered:
+                    break
+                continue
+            if ordered and not (is_pod_active(pod) and is_pod_ready(pod)):
+                break
+
+        # Scale down: delete ordinals >= replicas, highest first.
+        extra = sorted((o for o in pods if o >= st.spec.replicas), reverse=True)
+        for o in extra:
+            await self.pod_control.delete_pod(st, pods[o])
+            if ordered:
+                break
+
+        # Rolling update: replace outdated pods, highest ordinal first,
+        # one at a time, only while all other pods are ready.
+        if st.spec.update_strategy == w.ROLLING_UPDATE:
+            current = [pods[o] for o in sorted(pods) if o < st.spec.replicas]
+            if all(is_pod_ready(p) for p in current if is_pod_active(p)):
+                for pod in sorted(
+                        current,
+                        key=lambda p: -ordinal_of(p.metadata.name,
+                                                  st.metadata.name)):
+                    if pod.metadata.deletion_timestamp is not None:
+                        break
+                    if pod.metadata.labels.get(REVISION_LABEL) != revision:
+                        await self.pod_control.delete_pod(st, pod)
+                        break
+
+        await self._update_status(st, revision)
+        return None
+
+    async def _update_status(self, st: w.StatefulSet, revision: str) -> None:
+        pods = self._pods_for(st)
+        active = [p for p in pods.values() if is_pod_active(p)]
+        new = w.StatefulSetStatus(
+            observed_generation=st.metadata.generation,
+            replicas=len(active),
+            ready_replicas=sum(1 for p in active if is_pod_ready(p)),
+            current_replicas=sum(
+                1 for p in active
+                if p.metadata.labels.get(REVISION_LABEL) == revision),
+            updated_replicas=sum(
+                1 for p in active
+                if p.metadata.labels.get(REVISION_LABEL) == revision),
+        )
+        if new == st.status:
+            return
+        fresh = w.StatefulSet(metadata=st.metadata, spec=st.spec, status=new)
+        try:
+            await self.client.update(fresh, subresource="status")
+        except errors.NotFoundError:
+            pass

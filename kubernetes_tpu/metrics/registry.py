@@ -48,11 +48,7 @@ class Metric:
 
     def reset(self) -> None:
         """Drop all samples (benchmark harnesses isolate runs with this)."""
-        with self._lock:
-            for attr in ("_values", "_counts", "_sums", "_totals"):
-                d = getattr(self, attr, None)
-                if d is not None:
-                    d.clear()
+        raise NotImplementedError
 
 
 class Counter(Metric):
@@ -69,6 +65,10 @@ class Counter(Metric):
 
     def value(self, **labels) -> float:
         return self._values.get(_label_key(self.label_names, labels), 0.0)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._values.clear()
 
     def render(self) -> str:
         lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
@@ -99,6 +99,10 @@ class Gauge(Metric):
 
     def value(self, **labels) -> float:
         return self._values.get(_label_key(self.label_names, labels), 0.0)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._values.clear()
 
     def render(self) -> str:
         lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
@@ -132,6 +136,12 @@ class Histogram(Metric):
                 counts[i] += 1
             self._sums[key] = self._sums.get(key, 0.0) + value
             self._totals[key] = self._totals.get(key, 0) + 1
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counts.clear()
+            self._sums.clear()
+            self._totals.clear()
 
     def quantile(self, q: float, **labels) -> float:
         """Approximate quantile from bucket boundaries (upper bound)."""

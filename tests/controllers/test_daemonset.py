@@ -1,0 +1,80 @@
+"""DaemonSet controller (reference tier: pkg/controller/daemon)."""
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api import workloads as w
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.api.selectors import LabelSelector
+from kubernetes_tpu.controllers.daemonset import DaemonSetController
+
+from .util import make_plane, mk_node, pod_template, pods_of, wait_for
+
+
+def mk_ds(name="plugin", node_selector=None):
+    template = pod_template({"app": "plugin"})
+    if node_selector:
+        template.spec.node_selector = node_selector
+    return w.DaemonSet(
+        metadata=ObjectMeta(name=name, namespace="default"),
+        spec=w.DaemonSetSpec(
+            selector=LabelSelector(match_labels={"app": "plugin"}),
+            template=template))
+
+
+async def test_one_pod_per_node_placed_directly():
+    reg, client, factory = make_plane()
+    for i in range(3):
+        reg.create(mk_node(f"n{i}"))
+    ctrl = DaemonSetController(client, factory)
+    await ctrl.start()
+    try:
+        reg.create(mk_ds())
+        await wait_for(lambda: len(pods_of(reg)) == 3)
+        nodes = sorted(p.spec.node_name for p in pods_of(reg))
+        assert nodes == ["n0", "n1", "n2"]  # bypasses the scheduler
+    finally:
+        await ctrl.stop()
+        await factory.stop_all()
+
+
+async def test_node_selector_limits_placement():
+    reg, client, factory = make_plane()
+    reg.create(mk_node("tpu-node", labels={"tpu": "v5p"}))
+    reg.create(mk_node("cpu-node"))
+    ctrl = DaemonSetController(client, factory)
+    await ctrl.start()
+    try:
+        reg.create(mk_ds(node_selector={"tpu": "v5p"}))
+        await wait_for(lambda: len(pods_of(reg)) == 1)
+        assert pods_of(reg)[0].spec.node_name == "tpu-node"
+    finally:
+        await ctrl.stop()
+        await factory.stop_all()
+
+
+async def test_new_node_gets_pod():
+    reg, client, factory = make_plane()
+    reg.create(mk_node("n0"))
+    ctrl = DaemonSetController(client, factory)
+    await ctrl.start()
+    try:
+        reg.create(mk_ds())
+        await wait_for(lambda: len(pods_of(reg)) == 1)
+        reg.create(mk_node("n1"))
+        await wait_for(lambda: len(pods_of(reg)) == 2)
+    finally:
+        await ctrl.stop()
+        await factory.stop_all()
+
+
+async def test_tolerates_notready_taint():
+    reg, client, factory = make_plane()
+    node = mk_node("n0", ready=False)
+    node.spec.taints = [t.Taint(key=t.TAINT_NODE_NOT_READY, effect="NoExecute")]
+    reg.create(node)
+    ctrl = DaemonSetController(client, factory)
+    await ctrl.start()
+    try:
+        reg.create(mk_ds())
+        await wait_for(lambda: len(pods_of(reg)) == 1)
+    finally:
+        await ctrl.stop()
+        await factory.stop_all()

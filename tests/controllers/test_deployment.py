@@ -1,0 +1,119 @@
+"""Deployment controller rollouts (reference tier: pkg/controller/deployment)."""
+from kubernetes_tpu.api import workloads as w
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.api.selectors import LabelSelector
+from kubernetes_tpu.controllers.deployment import (TEMPLATE_HASH_LABEL,
+                                                   DeploymentController,
+                                                   template_hash)
+from kubernetes_tpu.controllers.replicaset import ReplicaSetController
+
+from .util import make_plane, mark_ready, pod_template, pods_of, wait_for
+
+
+def mk_dep(name="dep", replicas=3, image="img:v1"):
+    template = pod_template({"app": "web"})
+    template.spec.containers[0].image = image
+    return w.Deployment(
+        metadata=ObjectMeta(name=name, namespace="default"),
+        spec=w.DeploymentSpec(
+            replicas=replicas,
+            selector=LabelSelector(match_labels={"app": "web"}),
+            template=template))
+
+
+async def start_both(client, factory):
+    dc = DeploymentController(client, factory)
+    rc = ReplicaSetController(client, factory)
+    await dc.start()
+    await rc.start()
+    return dc, rc
+
+
+def rss_of(reg):
+    items, _ = reg.list("replicasets", "default")
+    return items
+
+
+async def test_creates_rs_and_pods():
+    reg, client, factory = make_plane()
+    dc, rc = await start_both(client, factory)
+    try:
+        reg.create(mk_dep(replicas=3))
+        await wait_for(lambda: len(pods_of(reg)) == 3)
+        rss = rss_of(reg)
+        assert len(rss) == 1
+        assert rss[0].spec.replicas == 3
+        assert TEMPLATE_HASH_LABEL in rss[0].spec.template.metadata.labels
+    finally:
+        await rc.stop()
+        await dc.stop()
+        await factory.stop_all()
+
+
+async def test_rolling_update_replaces_revision():
+    reg, client, factory = make_plane()
+    dc, rc = await start_both(client, factory)
+    try:
+        reg.create(mk_dep(replicas=2, image="img:v1"))
+        await wait_for(lambda: len(pods_of(reg)) == 2)
+        for pod in pods_of(reg):
+            pod.spec.node_name = "n1"
+            reg.update(pod)
+            mark_ready(reg, reg.get("pods", "default", pod.metadata.name))
+
+        dep = reg.get("deployments", "default", "dep")
+        dep.spec.template.spec.containers[0].image = "img:v2"
+        reg.update(dep)
+        new_hash = template_hash(dep.spec.template)
+
+        def fake_kubelet():
+            # Keep acting as the node agent: bind + ready every new pod.
+            for p in pods_of(reg):
+                if (p.metadata.deletion_timestamp is None
+                        and p.status.phase != "Running"):
+                    if p.spec.node_name == "":
+                        p.spec.node_name = "n1"
+                        reg.update(p)
+                    mark_ready(reg, reg.get("pods", "default", p.metadata.name))
+
+        def rolled():
+            fake_kubelet()
+            live = [p for p in pods_of(reg)
+                    if p.metadata.deletion_timestamp is None
+                    and p.metadata.labels.get(TEMPLATE_HASH_LABEL) == new_hash]
+            return (len(live) == 2
+                    and all(p.spec.containers[0].image == "img:v2" for p in live))
+        await wait_for(rolled, timeout=10.0)
+
+        def old_drained():
+            fake_kubelet()
+            # Old RS is kept (history) but scaled to zero.
+            old = [rs for rs in rss_of(reg)
+                   if rs.metadata.labels.get(TEMPLATE_HASH_LABEL) != new_hash]
+            return old and all(rs.spec.replicas == 0 for rs in old)
+        await wait_for(old_drained, timeout=10.0)
+    finally:
+        await rc.stop()
+        await dc.stop()
+        await factory.stop_all()
+
+
+async def test_status_aggregates_availability():
+    reg, client, factory = make_plane()
+    dc, rc = await start_both(client, factory)
+    try:
+        reg.create(mk_dep(replicas=2))
+        await wait_for(lambda: len(pods_of(reg)) == 2)
+        for pod in pods_of(reg):
+            mark_ready(reg, pod)
+
+        def available():
+            dep = reg.get("deployments", "default", "dep")
+            conds = {c.type: c.status for c in dep.status.conditions}
+            return (dep.status.available_replicas == 2
+                    and conds.get("Available") == "True")
+        await wait_for(available)
+    finally:
+        await rc.stop()
+        await dc.stop()
+        await factory.stop_all()

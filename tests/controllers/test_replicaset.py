@@ -1,0 +1,104 @@
+"""ReplicaSet controller (reference tier: pkg/controller/replicaset tests)."""
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.api import workloads as w
+from kubernetes_tpu.controllers.replicaset import ReplicaSetController
+
+from .util import make_plane, mk_rs, mark_ready, pods_of, wait_for
+
+
+async def test_scales_up_to_replicas():
+    reg, client, factory = make_plane()
+    ctrl = ReplicaSetController(client, factory)
+    await ctrl.start()
+    try:
+        reg.create(mk_rs(replicas=3))
+        await wait_for(lambda: len(pods_of(reg)) == 3)
+        for pod in pods_of(reg):
+            assert pod.metadata.owner_references[0].kind == "ReplicaSet"
+            assert pod.metadata.labels["app"] == "x"
+    finally:
+        await ctrl.stop()
+        await factory.stop_all()
+
+
+async def test_scales_down_prefers_unready_pods():
+    reg, client, factory = make_plane()
+    ctrl = ReplicaSetController(client, factory)
+    await ctrl.start()
+    try:
+        reg.create(mk_rs(replicas=3))
+        await wait_for(lambda: len(pods_of(reg)) == 3)
+        # Two pods become ready; the third stays pending.
+        ready_names = [p.metadata.name for p in pods_of(reg)[:2]]
+        for pod in pods_of(reg)[:2]:
+            pod.spec.node_name = "n1"
+            reg.update(pod)
+            mark_ready(reg, reg.get("pods", "default", pod.metadata.name))
+        rs = reg.get("replicasets", "default", "rs")
+        rs.spec.replicas = 2
+        reg.update(rs)
+        await wait_for(lambda: len(pods_of(reg)) == 2)
+        assert sorted(p.metadata.name for p in pods_of(reg)) == sorted(ready_names)
+    finally:
+        await ctrl.stop()
+        await factory.stop_all()
+
+
+async def test_replaces_deleted_pod():
+    reg, client, factory = make_plane()
+    ctrl = ReplicaSetController(client, factory)
+    await ctrl.start()
+    try:
+        reg.create(mk_rs(replicas=2))
+        await wait_for(lambda: len(pods_of(reg)) == 2)
+        victim = pods_of(reg)[0].metadata.name
+        reg.delete("pods", "default", victim, grace_period_seconds=0)
+        await wait_for(lambda: len(pods_of(reg)) == 2
+                       and all(p.metadata.name != victim for p in pods_of(reg)))
+    finally:
+        await ctrl.stop()
+        await factory.stop_all()
+
+
+async def test_adopts_matching_orphan():
+    reg, client, factory = make_plane()
+    ctrl = ReplicaSetController(client, factory)
+    await ctrl.start()
+    try:
+        # Orphan pod matching the selector exists before the RS.
+        orphan = t.Pod(
+            metadata=ObjectMeta(
+                name="orphan", namespace="default", labels={"app": "x"}),
+            spec=t.PodSpec(containers=[t.Container(name="c", image="i")]))
+        reg.create(orphan)
+        reg.create(mk_rs(replicas=2))
+        await wait_for(lambda: len(pods_of(reg)) == 2)
+
+        def adopted():
+            p = reg.get("pods", "default", "orphan")
+            refs = p.metadata.owner_references
+            return refs and refs[0].kind == "ReplicaSet" and refs[0].controller
+        await wait_for(adopted)
+    finally:
+        await ctrl.stop()
+        await factory.stop_all()
+
+
+async def test_status_counts_ready():
+    reg, client, factory = make_plane()
+    ctrl = ReplicaSetController(client, factory)
+    await ctrl.start()
+    try:
+        reg.create(mk_rs(replicas=2))
+        await wait_for(lambda: len(pods_of(reg)) == 2)
+        for pod in pods_of(reg):
+            mark_ready(reg, pod)
+
+        def ready_count():
+            rs = reg.get("replicasets", "default", "rs")
+            return rs.status.ready_replicas == 2 and rs.status.replicas == 2
+        await wait_for(ready_count)
+    finally:
+        await ctrl.stop()
+        await factory.stop_all()

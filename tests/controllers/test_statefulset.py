@@ -1,0 +1,86 @@
+"""StatefulSet controller — ranked identity (reference tier:
+pkg/controller/statefulset)."""
+from kubernetes_tpu.api import workloads as w
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.api.selectors import LabelSelector
+from kubernetes_tpu.controllers.statefulset import StatefulSetController
+
+from .util import make_plane, mark_ready, pod_template, pods_of, wait_for
+
+
+def mk_sts(name="workers", replicas=3, policy="OrderedReady"):
+    return w.StatefulSet(
+        metadata=ObjectMeta(name=name, namespace="default"),
+        spec=w.StatefulSetSpec(
+            replicas=replicas,
+            selector=LabelSelector(match_labels={"app": "train"}),
+            template=pod_template({"app": "train"}),
+            service_name="workers-svc",
+            pod_management_policy=policy))
+
+
+async def test_ordered_creation_waits_for_ready():
+    reg, client, factory = make_plane()
+    ctrl = StatefulSetController(client, factory)
+    await ctrl.start()
+    try:
+        reg.create(mk_sts(replicas=3))
+        await wait_for(lambda: len(pods_of(reg)) == 1)
+        assert pods_of(reg)[0].metadata.name == "workers-0"
+        mark_ready(reg, pods_of(reg)[0])
+        await wait_for(lambda: len(pods_of(reg)) == 2)
+        names = sorted(p.metadata.name for p in pods_of(reg))
+        assert names == ["workers-0", "workers-1"]
+        mark_ready(reg, reg.get("pods", "default", "workers-1"))
+        await wait_for(lambda: len(pods_of(reg)) == 3)
+    finally:
+        await ctrl.stop()
+        await factory.stop_all()
+
+
+async def test_parallel_policy_creates_all_at_once():
+    reg, client, factory = make_plane()
+    ctrl = StatefulSetController(client, factory)
+    await ctrl.start()
+    try:
+        reg.create(mk_sts(replicas=4, policy="Parallel"))
+        await wait_for(lambda: len(pods_of(reg)) == 4)
+    finally:
+        await ctrl.stop()
+        await factory.stop_all()
+
+
+async def test_rank_env_injected():
+    reg, client, factory = make_plane()
+    ctrl = StatefulSetController(client, factory)
+    await ctrl.start()
+    try:
+        reg.create(mk_sts(replicas=2, policy="Parallel"))
+        await wait_for(lambda: len(pods_of(reg)) == 2)
+        pod = reg.get("pods", "default", "workers-1")
+        env = {e.name: e.value for e in pod.spec.containers[0].env}
+        assert env["TPU_WORKER_ID"] == "1"
+        assert "workers-0.workers-svc.default" in env["TPU_WORKER_HOSTNAMES"]
+        assert pod.spec.hostname == "workers-1"
+        assert pod.spec.subdomain == "workers-svc"
+    finally:
+        await ctrl.stop()
+        await factory.stop_all()
+
+
+async def test_scale_down_removes_highest_ordinal():
+    reg, client, factory = make_plane()
+    ctrl = StatefulSetController(client, factory)
+    await ctrl.start()
+    try:
+        reg.create(mk_sts(replicas=3, policy="Parallel"))
+        await wait_for(lambda: len(pods_of(reg)) == 3)
+        sts = reg.get("statefulsets", "default", "workers")
+        sts.spec.replicas = 1
+        reg.update(sts)
+        await wait_for(lambda: sorted(
+            p.metadata.name for p in pods_of(reg)
+            if p.metadata.deletion_timestamp is None) == ["workers-0"])
+    finally:
+        await ctrl.stop()
+        await factory.stop_all()

@@ -1,0 +1,72 @@
+"""Shared harness: in-proc control plane + controller under test
+(reference tier: ``test/integration/`` — real registry semantics, no
+kubelet)."""
+import asyncio
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api import workloads as w
+from kubernetes_tpu.api.meta import ObjectMeta, now
+from kubernetes_tpu.api.selectors import LabelSelector
+from kubernetes_tpu.apiserver.admission import default_chain
+from kubernetes_tpu.apiserver.registry import Registry
+from kubernetes_tpu.client.informer import InformerFactory
+from kubernetes_tpu.client.local import LocalClient
+
+
+def make_plane():
+    reg = Registry()
+    reg.admission = default_chain(reg)
+    reg.create(t.Namespace(metadata=ObjectMeta(name="default")))
+    client = LocalClient(reg)
+    factory = InformerFactory(client)
+    return reg, client, factory
+
+
+def pod_template(labels=None, cpu=0.1):
+    return t.PodTemplateSpec(
+        metadata=ObjectMeta(labels=labels or {"app": "x"}),
+        spec=t.PodSpec(containers=[t.Container(
+            name="c", image="img",
+            resources=t.ResourceRequirements(requests={"cpu": cpu}))]))
+
+
+def mk_rs(name="rs", replicas=2, labels=None):
+    labels = labels or {"app": "x"}
+    return w.ReplicaSet(
+        metadata=ObjectMeta(name=name, namespace="default"),
+        spec=w.ReplicaSetSpec(replicas=replicas,
+                              selector=LabelSelector(match_labels=labels),
+                              template=pod_template(labels)))
+
+
+def mk_node(name, labels=None, ready=True):
+    node = t.Node(metadata=ObjectMeta(name=name, labels=labels or {}))
+    node.status.capacity = {"cpu": 8.0, "memory": 32 * 2**30, "pods": 110}
+    node.status.allocatable = dict(node.status.capacity)
+    node.status.conditions = [t.NodeCondition(
+        type=t.NODE_READY, status="True" if ready else "False")]
+    return node
+
+
+async def wait_for(predicate, timeout=5.0, interval=0.02):
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + timeout
+    while loop.time() < deadline:
+        result = predicate()
+        if result:
+            return result
+        await asyncio.sleep(interval)
+    raise AssertionError("condition not met within timeout")
+
+
+def pods_of(reg, ns="default"):
+    items, _ = reg.list("pods", ns)
+    return items
+
+
+def mark_ready(reg, pod):
+    """Simulate the node agent: flip pod Running+Ready via status subresource."""
+    pod.status.phase = t.POD_RUNNING
+    pod.status.conditions = [t.PodCondition(
+        type=t.COND_POD_READY, status="True", last_transition_time=now())]
+    reg.update(pod, subresource="status")

@@ -117,12 +117,23 @@ def test_binding_must_cover_all_claims(registry):
 
 
 def test_graceful_delete_then_force(registry):
-    registry.create(mk_pod())
+    pod = mk_pod()
+    pod.spec.node_name = "n1"  # bound: the node agent owns the grace period
+    registry.create(pod)
     first = registry.delete("pods", "default", "p")
     assert first.metadata.deletion_timestamp is not None
     # Still present (terminating).
     assert registry.get("pods", "default", "p").metadata.deletion_timestamp
     registry.delete("pods", "default", "p", grace_period_seconds=0)
+    with pytest.raises(errors.NotFoundError):
+        registry.get("pods", "default", "p")
+
+
+def test_unbound_pod_deletes_immediately(registry):
+    # No node agent exists to confirm termination for an unscheduled pod
+    # (reference: pod strategy CheckGracefulDelete zeroes the grace).
+    registry.create(mk_pod())
+    registry.delete("pods", "default", "p")
     with pytest.raises(errors.NotFoundError):
         registry.get("pods", "default", "p")
 

@@ -51,7 +51,9 @@ async def test_informer_survives_apiserver_restart():
 
 def test_repeated_graceful_delete_is_noop():
     reg = Registry()
-    reg.create(mk_pod("p"))
+    pod = mk_pod("p")
+    pod.spec.node_name = "n1"  # bound: the node agent owns the grace period
+    reg.create(pod)
     first = reg.delete("pods", "default", "p")
     assert first.metadata.deletion_timestamp is not None
     # Idempotent retry must NOT force-remove while the node agent still
