@@ -1,0 +1,239 @@
+"""CronJob controller — time-based Job creation.
+
+Reference: ``pkg/controller/cronjob`` (0.9k LoC): every sync tick, for
+each CronJob compute the most recent schedule time since the last one;
+if unsatisfied and within startingDeadlineSeconds, create a Job named
+``<cronjob>-<scheduled-unix-minutes>``; honor suspend +
+concurrencyPolicy; prune history beyond the limits.
+"""
+from __future__ import annotations
+
+import asyncio
+import datetime
+from typing import Optional
+
+from ..api import errors
+from ..api import types as t
+from ..api import workloads as w
+from ..api.meta import controller_ref, is_controlled_by, now
+from ..api.scheme import deepcopy
+from ..client.informer import InformerFactory
+from ..client.interface import Client
+from .base import Controller
+
+
+class CronSchedule:
+    """5-field cron (min hour dom mon dow) supporting ``*``, ``*/n``,
+    lists, and ranges — the subset the reference's robfig/cron use needs."""
+
+    _RANGES = ((0, 59), (0, 23), (1, 31), (1, 12), (0, 6))
+
+    def __init__(self, expr: str):
+        fields = expr.split()
+        if len(fields) != 5:
+            raise ValueError(f"cron needs 5 fields, got {expr!r}")
+        self.sets = [self._parse(f, lo, hi)
+                     for f, (lo, hi) in zip(fields, self._RANGES)]
+
+    @staticmethod
+    def _parse(field: str, lo: int, hi: int) -> frozenset:
+        out: set[int] = set()
+        for part in field.split(","):
+            step = 1
+            if "/" in part:
+                part, step_s = part.split("/", 1)
+                step = int(step_s)
+            if part in ("*", ""):
+                start, end = lo, hi
+            elif "-" in part:
+                a, b = part.split("-", 1)
+                start, end = int(a), int(b)
+            else:
+                start = end = int(part)
+            out.update(range(start, end + 1, step))
+        return frozenset(v for v in out if lo <= v <= hi)
+
+    def matches(self, dt: datetime.datetime) -> bool:
+        m, h, dom, mon, dow = self.sets
+        # cron dow: 0=Sunday; datetime.weekday(): 0=Monday.
+        return (dt.minute in m and dt.hour in h and dt.day in dom
+                and dt.month in mon and ((dt.weekday() + 1) % 7) in dow)
+
+    def _day_matches(self, day: datetime.date) -> bool:
+        _, _, dom, mon, dow = self.sets
+        return (day.month in mon and day.day in dom
+                and ((day.weekday() + 1) % 7) in dow)
+
+    def prev_at_or_before(self, dt: datetime.datetime
+                          ) -> Optional[datetime.datetime]:
+        """Latest matching minute <= dt. O(days scanned), not O(minutes):
+        walk days backward, then pick the largest in-day (hour, minute)."""
+        minutes = sorted(self.sets[0], reverse=True)
+        hours = sorted(self.sets[1], reverse=True)
+        end = dt.replace(second=0, microsecond=0)
+        day = end.date()
+        for i in range(4 * 366):  # a full leap cycle bounds any schedule
+            if self._day_matches(day):
+                for hour in hours:
+                    if i == 0 and hour > end.hour:
+                        continue
+                    for minute in minutes:
+                        if i == 0 and hour == end.hour and minute > end.minute:
+                            continue
+                        return datetime.datetime.combine(
+                            day, datetime.time(hour, minute), tzinfo=dt.tzinfo)
+            day -= datetime.timedelta(days=1)
+        return None
+
+    def most_recent(self, since: datetime.datetime,
+                    until: datetime.datetime) -> Optional[datetime.datetime]:
+        """Latest matching minute in (since, until]."""
+        got = self.prev_at_or_before(until)
+        if got is not None and got > since.replace(second=0, microsecond=0):
+            return got
+        return None
+
+
+class CronJobController(Controller):
+    name = "cronjob-controller"
+
+    #: Seconds between schedule scans (reference: 10s resync).
+    tick = 10.0
+
+    def __init__(self, client: Client, factory: InformerFactory,
+                 workers: int = 1):
+        super().__init__(client, factory, workers)
+        self.cj_informer = self.watch("cronjobs")
+        self.job_informer = self.watch("jobs")
+        self.cj_informer.add_handlers(
+            on_add=self.enqueue_obj,
+            on_update=lambda o, n: self.enqueue_obj(n),
+            on_delete=self.enqueue_obj)
+        self.job_informer.add_handlers(
+            on_add=lambda j: self.enqueue_owner(j, "CronJob"),
+            on_update=lambda o, n: self.enqueue_owner(n, "CronJob"),
+            on_delete=lambda j: self.enqueue_owner(j, "CronJob"))
+        self._tick_task: Optional[asyncio.Task] = None
+
+    async def on_start(self) -> None:
+        self._tick_task = asyncio.get_running_loop().create_task(self._ticker())
+
+    async def stop(self) -> None:
+        if self._tick_task:
+            self._tick_task.cancel()
+            try:
+                await self._tick_task
+            except asyncio.CancelledError:
+                pass
+        await super().stop()
+
+    async def _ticker(self) -> None:
+        while True:
+            for cj in self.cj_informer.list():
+                self.enqueue_obj(cj)
+            await asyncio.sleep(self.tick)
+
+    def _jobs_for(self, cj: w.CronJob) -> list[w.Job]:
+        return [j for j in self.job_informer.list()
+                if j.metadata.namespace == cj.metadata.namespace
+                and is_controlled_by(j, cj)]
+
+    @staticmethod
+    def _job_finished(job: w.Job) -> Optional[str]:
+        for c in job.status.conditions:
+            if c.type in ("Complete", "Failed") and c.status == "True":
+                return c.type
+        return None
+
+    async def sync(self, key: str) -> Optional[float]:
+        cj = self.cj_informer.get(key)
+        if cj is None or cj.metadata.deletion_timestamp is not None:
+            return None
+        jobs = self._jobs_for(cj)
+        running = [j for j in jobs if not self._job_finished(j)]
+
+        # Reconcile status.active and prune history.
+        await self._prune(cj, jobs)
+
+        if cj.spec.suspend:
+            return None
+        try:
+            sched = CronSchedule(cj.spec.schedule)
+        except ValueError:
+            self.recorder.event(cj, "Warning", "InvalidSchedule",
+                                f"cannot parse {cj.spec.schedule!r}")
+            return None
+
+        since = (cj.status.last_schedule_time
+                 or cj.metadata.creation_timestamp or now())
+        ts = now()
+        due = sched.most_recent(since, ts)
+        if due is None:
+            return self.tick
+        if cj.spec.starting_deadline_seconds is not None and \
+                (ts - due).total_seconds() > cj.spec.starting_deadline_seconds:
+            self.recorder.event(cj, "Warning", "MissedSchedule",
+                                f"missed start {due.isoformat()}")
+            await self._mark_scheduled(cj, due, running)
+            return self.tick
+
+        if running:
+            policy = cj.spec.concurrency_policy
+            if policy == "Forbid":
+                self.recorder.event(cj, "Normal", "JobAlreadyActive",
+                                    "skipping run: previous still active")
+                await self._mark_scheduled(cj, due, running)
+                return self.tick
+            if policy == "Replace":
+                for j in running:
+                    await self._delete_job(cj, j)
+                running = []
+
+        await self._start_job(cj, due)
+        return self.tick
+
+    async def _start_job(self, cj: w.CronJob, due) -> None:
+        stamp = int(due.timestamp() // 60)
+        job = w.Job(
+            metadata=t.ObjectMeta(
+                name=f"{cj.metadata.name}-{stamp}",
+                namespace=cj.metadata.namespace,
+                owner_references=[controller_ref(cj, w.BATCH_V1, "CronJob")]),
+            spec=deepcopy(cj.spec.job_template))
+        try:
+            await self.client.create(job)
+            self.recorder.event(cj, "Normal", "SuccessfulCreate",
+                                f"Created job {job.metadata.name}")
+        except errors.AlreadyExistsError:
+            pass
+        await self._mark_scheduled(cj, due, self._jobs_for(cj))
+
+    async def _mark_scheduled(self, cj, due, running) -> None:
+        fresh = deepcopy(cj)
+        fresh.status.last_schedule_time = due
+        fresh.status.active = [j.metadata.name for j in running]
+        try:
+            await self.client.update(fresh, subresource="status")
+        except (errors.NotFoundError, errors.ConflictError):
+            pass
+
+    async def _delete_job(self, cj, job) -> None:
+        try:
+            await self.client.delete("jobs", job.metadata.namespace,
+                                     job.metadata.name)
+        except errors.NotFoundError:
+            pass
+
+    async def _prune(self, cj, jobs) -> None:
+        def by_age(js):
+            return sorted(js, key=lambda j: (
+                j.metadata.creation_timestamp.timestamp()
+                if j.metadata.creation_timestamp else 0.0))
+        done_ok = by_age([j for j in jobs if self._job_finished(j) == "Complete"])
+        done_bad = by_age([j for j in jobs if self._job_finished(j) == "Failed"])
+        for j in done_ok[:max(0, len(done_ok)
+                              - cj.spec.successful_jobs_history_limit)]:
+            await self._delete_job(cj, j)
+        for j in done_bad[:max(0, len(done_bad)
+                               - cj.spec.failed_jobs_history_limit)]:
+            await self._delete_job(cj, j)

@@ -13,10 +13,11 @@ from typing import Optional
 from ..api import errors
 from ..api import types as t
 from ..api import workloads as w
-from ..api.meta import is_controlled_by
+from ..api.meta import is_controlled_by, now
 from ..client.informer import InformerFactory
 from ..client.interface import Client
-from .base import Controller, PodControl, is_pod_active, is_pod_ready
+from .base import (Controller, PodControl, is_pod_active, is_pod_ready,
+                   pod_ready_since)
 
 
 def node_eligible(ds: w.DaemonSet, node: t.Node) -> bool:
@@ -90,7 +91,14 @@ class DaemonSetController(Controller):
                     if node_eligible(ds, n)}
 
         for node_name in eligible:
-            pods = [p for p in by_node.get(node_name, []) if is_pod_active(p)]
+            all_here = by_node.get(node_name, [])
+            # Reap terminal daemon pods — the reference daemon controller
+            # deletes failed pods so they don't accumulate unboundedly.
+            for pod in all_here:
+                if (pod.status.phase in (t.POD_FAILED, t.POD_SUCCEEDED)
+                        and pod.metadata.deletion_timestamp is None):
+                    await self.pod_control.delete_pod(ds, pod)
+            pods = [p for p in all_here if is_pod_active(p)]
             if not pods:
                 def place(pod, node=node_name):
                     pod.spec.node_name = node
@@ -111,6 +119,7 @@ class DaemonSetController(Controller):
         return None
 
     async def _update_status(self, ds, by_node, eligible) -> None:
+        ts = now()
         scheduled = {n: ps for n, ps in by_node.items()
                      if n and any(is_pod_active(p) for p in ps)}
         new = w.DaemonSetStatus(
@@ -122,7 +131,8 @@ class DaemonSetController(Controller):
                 if any(is_pod_ready(p) for p in ps)),
             number_available=sum(
                 1 for n, ps in scheduled.items()
-                if any(is_pod_ready(p) for p in ps)),
+                if any(pod_ready_since(p, ds.spec.min_ready_seconds, ts)
+                       for p in ps)),
             observed_generation=ds.metadata.generation)
         if new == ds.status:
             return

@@ -15,7 +15,7 @@ from typing import Optional
 from ..api import errors
 from ..api import types as t
 from ..api import workloads as w
-from ..api.meta import controller_ref, is_controlled_by, now, split_key
+from ..api.meta import controller_ref, is_controlled_by, now
 from ..api.scheme import deepcopy, to_dict
 from ..client.informer import InformerFactory
 from ..client.interface import Client
@@ -160,11 +160,27 @@ class DeploymentController(Controller):
         elif new_rs.spec.replicas > desired:
             new_rs = await self._scale_rs(new_rs, desired)
 
-        # Scale down old RSs bounded by availability: keep at least
-        # desired - maxUnavailable ready pods across all RSs.
+        # First reap unhealthy old replicas — they contribute nothing to
+        # availability, and leaving them gates the rollout forever
+        # (reference: cleanupUnhealthyReplicas in rolling.go).
+        min_available = desired - max_unavailable
+        total_pods = sum(rs.spec.replicas for rs in old_rss) + new_rs.spec.replicas
+        new_unavailable = new_rs.spec.replicas - new_rs.status.available_replicas
+        max_cleanup = total_pods - min_available - new_unavailable
+        refreshed = []
+        for rs in sorted(old_rss, key=lambda r: r.metadata.name):
+            unhealthy = rs.spec.replicas - rs.status.available_replicas
+            if max_cleanup > 0 and unhealthy > 0:
+                shrink = min(unhealthy, max_cleanup)
+                rs = await self._scale_rs(rs, rs.spec.replicas - shrink)
+                max_cleanup -= shrink
+            refreshed.append(rs)
+        old_rss = refreshed
+
+        # Then scale down healthy old replicas bounded by availability:
+        # keep at least desired - maxUnavailable ready pods across all RSs.
         available = sum(rs.status.available_replicas
                         for rs in old_rss) + new_rs.status.available_replicas
-        min_available = desired - max_unavailable
         can_remove = available - min_available
         for rs in sorted(old_rss, key=lambda r: r.metadata.name):
             if can_remove <= 0:

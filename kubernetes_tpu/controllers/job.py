@@ -1,0 +1,240 @@
+"""Job controller — gang-aware batch execution.
+
+Reference: ``pkg/controller/job`` (0.9k LoC): track active/succeeded/
+failed pods, respect parallelism/completions/backoffLimit/
+activeDeadlineSeconds, flip Complete/Failed conditions.
+
+TPU-first delta (no reference analog — SURVEY.md section 2.4): when
+``spec.gang`` is set the controller materializes a :class:`PodGroup`
+before any pod, links every pod to it via ``pod.spec.gang``, and
+**fails/restarts members as a unit**: one failed member tears down the
+whole gang and the next sync recreates it (counted against
+backoffLimit) — the elastic-recovery semantic a multi-host JAX job
+needs (a training step cannot survive a missing worker).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..api import errors
+from ..api import types as t
+from ..api import workloads as w
+from ..api.meta import controller_ref, is_controlled_by, now
+from ..client.informer import InformerFactory
+from ..client.interface import Client
+from .base import Controller, PodControl, is_pod_active
+
+JOB_NAME_LABEL = "job.tpu/name"
+COMPLETION_INDEX_LABEL = "job.tpu/completion-index"
+
+
+def _group_name(job: w.Job) -> str:
+    return f"job-{job.metadata.name}"
+
+
+class JobController(Controller):
+    name = "job-controller"
+
+    def __init__(self, client: Client, factory: InformerFactory,
+                 workers: int = 2):
+        super().__init__(client, factory, workers)
+        self.pod_control = PodControl(client, self.recorder)
+        self.job_informer = self.watch("jobs")
+        self.pod_informer = self.watch("pods")
+        self.job_informer.add_handlers(
+            on_add=self.enqueue_obj,
+            on_update=lambda o, n: self.enqueue_obj(n),
+            on_delete=self.enqueue_obj)
+        self.pod_informer.add_handlers(
+            on_add=lambda p: self.enqueue_owner(p, "Job"),
+            on_update=lambda o, n: self.enqueue_owner(n, "Job"),
+            on_delete=lambda p: self.enqueue_owner(p, "Job"))
+
+    def _pods_for(self, job: w.Job) -> list[t.Pod]:
+        return [p for p in self.pod_informer.list()
+                if p.metadata.namespace == job.metadata.namespace
+                and is_controlled_by(p, job)]
+
+    def _finished(self, job: w.Job) -> Optional[str]:
+        for c in job.status.conditions:
+            if c.type in ("Complete", "Failed") and c.status == "True":
+                return c.type
+        return None
+
+    # -- gang -------------------------------------------------------------
+
+    async def _ensure_podgroup(self, job: w.Job) -> None:
+        gang = job.spec.gang
+        name = _group_name(job)
+        try:
+            await self.client.get("podgroups", job.metadata.namespace, name)
+            return
+        except errors.NotFoundError:
+            pass
+        group = t.PodGroup(
+            metadata=t.ObjectMeta(
+                name=name, namespace=job.metadata.namespace,
+                owner_references=[controller_ref(job, w.BATCH_V1, "Job")]),
+            spec=t.PodGroupSpec(
+                min_member=gang.min_member or job.spec.parallelism,
+                slice_shape=list(gang.slice_shape),
+                schedule_timeout_seconds=gang.schedule_timeout_seconds))
+        try:
+            await self.client.create(group)
+        except errors.AlreadyExistsError:
+            pass
+
+    # -- pod creation -----------------------------------------------------
+
+    def _mutator(self, job: w.Job, index: int):
+        def mutate(pod: t.Pod) -> None:
+            pod.metadata.labels = {**pod.metadata.labels,
+                                   JOB_NAME_LABEL: job.metadata.name}
+            if job.spec.completion_mode == "Indexed":
+                pod.metadata.labels[COMPLETION_INDEX_LABEL] = str(index)
+            if pod.spec.restart_policy == t.RESTART_ALWAYS:
+                pod.spec.restart_policy = t.RESTART_NEVER
+            if job.spec.gang is not None:
+                pod.spec.gang = _group_name(job)
+            if job.spec.completion_mode == "Indexed":
+                # Stable ranks exist only in Indexed mode — NonIndexed
+                # pods are interchangeable and must not all claim rank 0.
+                rank_env = [
+                    t.EnvVar(name="JOB_COMPLETION_INDEX", value=str(index)),
+                    t.EnvVar(name="TPU_WORKER_ID", value=str(index)),
+                ]
+                for c in pod.spec.containers:
+                    have = {e.name for e in c.env}
+                    c.env = c.env + [e for e in rank_env if e.name not in have]
+        return mutate
+
+    async def sync(self, key: str) -> Optional[float]:
+        job = self.job_informer.get(key)
+        if job is None or job.metadata.deletion_timestamp is not None:
+            return None
+        if self._finished(job):
+            return None
+        pods = self._pods_for(job)
+        active = [p for p in pods if is_pod_active(p)]
+        succeeded = sum(1 for p in pods if p.status.phase == t.POD_SUCCEEDED)
+        failed_records = [p for p in pods if p.status.phase == t.POD_FAILED
+                          and p.metadata.deletion_timestamp is None]
+        # Gang restarts absorb failed-pod records into status.failed (the
+        # records are deleted with the gang); non-gang jobs keep the
+        # records, so count whichever representation holds the history.
+        if job.spec.gang is not None:
+            failed = job.status.failed + len(failed_records)
+        else:
+            failed = len(failed_records)
+        completions = job.spec.completions
+        requeue: Optional[float] = None
+
+        # Deadline exceeded?
+        start = job.status.start_time or job.metadata.creation_timestamp
+        if job.spec.active_deadline_seconds is not None and start is not None:
+            elapsed = (now() - start).total_seconds()
+            if elapsed >= job.spec.active_deadline_seconds:
+                await self._fail(job, active, succeeded, failed,
+                                 "DeadlineExceeded",
+                                 "job was active longer than "
+                                 f"{job.spec.active_deadline_seconds}s")
+                return None
+            requeue = job.spec.active_deadline_seconds - elapsed
+
+        if failed > job.spec.backoff_limit:
+            await self._fail(job, active, succeeded, failed,
+                             "BackoffLimitExceeded",
+                             f"job has failed {failed} times")
+            return None
+
+        # Gang: a failed member kills the whole gang; survivors AND the
+        # failed records are torn down so the next sync recreates a full,
+        # co-scheduled set (the failure history lives in status.failed).
+        if job.spec.gang is not None and failed_records:
+            self.recorder.event(job, "Warning", "GangMemberFailed",
+                                "tearing down gang for atomic restart")
+            for pod in active + failed_records:
+                await self.pod_control.delete_pod(job, pod)
+            await self._update_status(job, [], succeeded, failed)
+            return None
+
+        # Complete?
+        if completions is not None:
+            done = succeeded >= completions
+        else:
+            done = succeeded > 0 and not active
+        if done:
+            await self._update_status(job, active, succeeded, failed,
+                                      condition="Complete")
+            self.recorder.event(job, "Normal", "Completed", "job completed")
+            return None
+
+        if job.spec.gang is not None:
+            await self._ensure_podgroup(job)
+
+        # How many pods should be running?
+        want = job.spec.parallelism
+        if completions is not None:
+            want = min(want, completions - succeeded)
+        if job.spec.completion_mode == "Indexed":
+            await self._sync_indexed(job, pods, active, succeeded, want)
+        else:
+            for _ in range(max(want - len(active), 0)):
+                await self.pod_control.create_pod(
+                    job, job.spec.template, mutate=self._mutator(job, 0))
+            for pod in active[max(want, 0):]:
+                await self.pod_control.delete_pod(job, pod)
+
+        await self._update_status(job, self._pods_for(job), succeeded, failed)
+        return requeue
+
+    async def _sync_indexed(self, job, pods, active, succeeded, want) -> None:
+        total = job.spec.completions or job.spec.parallelism
+        done_idx = {p.metadata.labels.get(COMPLETION_INDEX_LABEL)
+                    for p in pods if p.status.phase == t.POD_SUCCEEDED}
+        active_idx = {p.metadata.labels.get(COMPLETION_INDEX_LABEL)
+                      for p in active}
+        budget = want - len(active)
+        for i in range(total):
+            if budget <= 0:
+                break
+            if str(i) in done_idx or str(i) in active_idx:
+                continue
+            await self.pod_control.create_pod(
+                job, job.spec.template,
+                generate_name=f"{job.metadata.name}-{i}-",
+                mutate=self._mutator(job, i))
+            budget -= 1
+
+    async def _fail(self, job, active, succeeded, failed, reason,
+                    message) -> None:
+        for pod in active:
+            await self.pod_control.delete_pod(job, pod)
+        await self._update_status(job, [], succeeded, failed,
+                                  condition="Failed", reason=reason,
+                                  message=message)
+        self.recorder.event(job, "Warning", reason, message)
+
+    async def _update_status(self, job, pods, succeeded, failed,
+                             condition: str = "", reason: str = "",
+                             message: str = "") -> None:
+        active = [p for p in pods if is_pod_active(p)]
+        new = w.JobStatus(
+            active=len(active), succeeded=succeeded, failed=failed,
+            start_time=job.status.start_time or now(),
+            completion_time=job.status.completion_time,
+            conditions=list(job.status.conditions))
+        if condition and not any(c.type == condition and c.status == "True"
+                                 for c in new.conditions):
+            new.conditions = new.conditions + [w.JobCondition(
+                type=condition, status="True", reason=reason, message=message,
+                last_transition_time=now())]
+            if condition == "Complete":
+                new.completion_time = now()
+        if new == job.status:
+            return
+        fresh = w.Job(metadata=job.metadata, spec=job.spec, status=new)
+        try:
+            await self.client.update(fresh, subresource="status")
+        except errors.NotFoundError:
+            pass

@@ -12,7 +12,7 @@ from typing import Optional
 from ..api import errors
 from ..api import types as t
 from ..api import workloads as w
-from ..api.meta import controller_ref, now, split_key
+from ..api.meta import controller_ref, now
 from ..api.scheme import deepcopy
 from ..client.informer import InformerFactory
 from ..client.interface import Client

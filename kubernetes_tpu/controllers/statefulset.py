@@ -148,9 +148,11 @@ class StatefulSetController(Controller):
             observed_generation=st.metadata.generation,
             replicas=len(active),
             ready_replicas=sum(1 for p in active if is_pod_ready(p)),
+            # current = pods still on a prior revision; updated = pods on
+            # the template's revision (rollout progress is their crossover).
             current_replicas=sum(
                 1 for p in active
-                if p.metadata.labels.get(REVISION_LABEL) == revision),
+                if p.metadata.labels.get(REVISION_LABEL) != revision),
             updated_replicas=sum(
                 1 for p in active
                 if p.metadata.labels.get(REVISION_LABEL) == revision),

@@ -1,0 +1,92 @@
+"""CronJob controller + cron expression parsing."""
+import datetime
+
+from kubernetes_tpu.api import workloads as w
+from kubernetes_tpu.api.meta import ObjectMeta, now
+from kubernetes_tpu.api.selectors import LabelSelector
+from kubernetes_tpu.controllers.cronjob import CronJobController, CronSchedule
+
+from .util import make_plane, pod_template, wait_for
+
+
+def dt(*args):
+    return datetime.datetime(*args, tzinfo=datetime.timezone.utc)
+
+
+def test_cron_parse_and_match():
+    s = CronSchedule("*/15 3 * * *")
+    assert s.matches(dt(2026, 7, 29, 3, 30))
+    assert not s.matches(dt(2026, 7, 29, 4, 30))
+    assert not s.matches(dt(2026, 7, 29, 3, 20))
+    # dow: 0 = Sunday; 2026-07-26 is a Sunday.
+    sun = CronSchedule("0 0 * * 0")
+    assert sun.matches(dt(2026, 7, 26, 0, 0))
+    assert not sun.matches(dt(2026, 7, 27, 0, 0))
+    lst = CronSchedule("5,35 1-3 * * *")
+    assert lst.matches(dt(2026, 1, 1, 2, 35))
+    assert not lst.matches(dt(2026, 1, 1, 0, 35))
+
+
+def test_cron_most_recent():
+    s = CronSchedule("*/10 * * * *")
+    got = s.most_recent(dt(2026, 7, 29, 11, 55), dt(2026, 7, 29, 12, 7))
+    assert got == dt(2026, 7, 29, 12, 0)
+    assert s.most_recent(dt(2026, 7, 29, 12, 1), dt(2026, 7, 29, 12, 7)) is None
+
+
+def mk_cronjob(schedule="* * * * *", suspend=False):
+    return w.CronJob(
+        metadata=ObjectMeta(name="nightly", namespace="default"),
+        spec=w.CronJobSpec(
+            schedule=schedule, suspend=suspend,
+            job_template=w.JobSpec(
+                parallelism=1, completions=1,
+                selector=LabelSelector(match_labels={"app": "n"}),
+                template=pod_template({"app": "n"}))))
+
+
+async def test_creates_job_when_due():
+    reg, client, factory = make_plane()
+    ctrl = CronJobController(client, factory)
+    ctrl.tick = 0.05
+    await ctrl.start()
+    try:
+        cj = mk_cronjob("* * * * *")  # due every minute -> due now
+        # Backdate creation so a schedule point exists in (creation, now].
+        reg.create(cj)
+        stored = reg.get("cronjobs", "default", "nightly")
+        stored.status.last_schedule_time = now() - datetime.timedelta(minutes=3)
+        reg.update(stored, subresource="status")
+
+        def has_job():
+            jobs, _ = reg.list("jobs", "default")
+            return len(jobs) == 1 and jobs[0].metadata.owner_references[0].kind == "CronJob"
+        await wait_for(has_job)
+        cj2 = reg.get("cronjobs", "default", "nightly")
+        assert cj2.status.last_schedule_time is not None
+        # No duplicate for the same schedule point.
+        jobs, _ = reg.list("jobs", "default")
+        assert len(jobs) == 1
+    finally:
+        await ctrl.stop()
+        await factory.stop_all()
+
+
+async def test_suspend_blocks_creation():
+    reg, client, factory = make_plane()
+    ctrl = CronJobController(client, factory)
+    ctrl.tick = 0.05
+    await ctrl.start()
+    try:
+        cj = mk_cronjob("* * * * *", suspend=True)
+        reg.create(cj)
+        stored = reg.get("cronjobs", "default", "nightly")
+        stored.status.last_schedule_time = now() - datetime.timedelta(minutes=3)
+        reg.update(stored, subresource="status")
+        import asyncio
+        await asyncio.sleep(0.3)
+        jobs, _ = reg.list("jobs", "default")
+        assert jobs == []
+    finally:
+        await ctrl.stop()
+        await factory.stop_all()

@@ -98,6 +98,45 @@ async def test_rolling_update_replaces_revision():
         await factory.stop_all()
 
 
+async def test_rollout_of_crashlooping_deployment_does_not_deadlock():
+    # OLD pods are crashlooping (never ready); new pods come up healthy.
+    # The rollout must reap the unhealthy old replicas (reference:
+    # cleanupUnhealthyReplicas) instead of gating on their availability
+    # forever.
+    reg, client, factory = make_plane()
+    dc, rc = await start_both(client, factory)
+    try:
+        reg.create(mk_dep(replicas=2, image="img:v1"))
+        await wait_for(lambda: len(pods_of(reg)) == 2)
+        dep = reg.get("deployments", "default", "dep")
+        dep.spec.template.spec.containers[0].image = "img:v2"
+        reg.update(dep)
+        new_hash = template_hash(dep.spec.template)
+
+        def fake_kubelet_new_only():
+            for p in pods_of(reg):
+                if (p.metadata.deletion_timestamp is None
+                        and p.metadata.labels.get(TEMPLATE_HASH_LABEL) == new_hash
+                        and p.status.phase != "Running"):
+                    if p.spec.node_name == "":
+                        p.spec.node_name = "n1"
+                        reg.update(p)
+                    mark_ready(reg, reg.get("pods", "default", p.metadata.name))
+
+        def only_v2_left():
+            fake_kubelet_new_only()
+            live = [p for p in pods_of(reg)
+                    if p.metadata.deletion_timestamp is None]
+            return live and all(
+                p.metadata.labels.get(TEMPLATE_HASH_LABEL) == new_hash
+                for p in live)
+        await wait_for(only_v2_left, timeout=10.0)
+    finally:
+        await rc.stop()
+        await dc.stop()
+        await factory.stop_all()
+
+
 async def test_status_aggregates_availability():
     reg, client, factory = make_plane()
     dc, rc = await start_both(client, factory)

@@ -1,0 +1,132 @@
+"""Job controller incl. gang semantics (reference tier: pkg/controller/job)."""
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api import workloads as w
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.api.selectors import LabelSelector
+from kubernetes_tpu.controllers.job import JobController
+
+from .util import make_plane, pod_template, pods_of, wait_for
+
+
+def mk_job(name="train", parallelism=2, completions=2, gang=None,
+           backoff_limit=6):
+    return w.Job(
+        metadata=ObjectMeta(name=name, namespace="default"),
+        spec=w.JobSpec(parallelism=parallelism, completions=completions,
+                       backoff_limit=backoff_limit,
+                       selector=LabelSelector(match_labels={"app": "j"}),
+                       template=pod_template({"app": "j"}),
+                       gang=gang))
+
+
+def finish(reg, pod, phase):
+    pod.status.phase = phase
+    reg.update(pod, subresource="status")
+
+
+async def test_runs_parallelism_pods_with_indexes():
+    reg, client, factory = make_plane()
+    ctrl = JobController(client, factory)
+    await ctrl.start()
+    try:
+        reg.create(mk_job(parallelism=3, completions=3))
+        await wait_for(lambda: len(pods_of(reg)) == 3)
+        idx = set()
+        for p in pods_of(reg):
+            env = {e.name: e.value for e in p.spec.containers[0].env}
+            idx.add(env["JOB_COMPLETION_INDEX"])
+            assert env["TPU_WORKER_ID"] == env["JOB_COMPLETION_INDEX"]
+            assert p.spec.restart_policy == t.RESTART_NEVER
+        assert idx == {"0", "1", "2"}
+    finally:
+        await ctrl.stop()
+        await factory.stop_all()
+
+
+async def test_completion_and_status():
+    reg, client, factory = make_plane()
+    ctrl = JobController(client, factory)
+    await ctrl.start()
+    try:
+        reg.create(mk_job(parallelism=2, completions=2))
+        await wait_for(lambda: len(pods_of(reg)) == 2)
+        for p in pods_of(reg):
+            finish(reg, p, t.POD_SUCCEEDED)
+
+        def complete():
+            job = reg.get("jobs", "default", "train")
+            return (job.status.succeeded == 2
+                    and any(c.type == "Complete" and c.status == "True"
+                            for c in job.status.conditions)
+                    and job.status.completion_time is not None)
+        await wait_for(complete)
+    finally:
+        await ctrl.stop()
+        await factory.stop_all()
+
+
+async def test_failed_pod_replaced_until_backoff_limit():
+    reg, client, factory = make_plane()
+    ctrl = JobController(client, factory)
+    await ctrl.start()
+    try:
+        reg.create(mk_job(parallelism=1, completions=1, backoff_limit=1))
+        await wait_for(lambda: len(pods_of(reg)) == 1)
+        finish(reg, pods_of(reg)[0], t.POD_FAILED)
+        # One retry allowed.
+        await wait_for(lambda: sum(
+            1 for p in pods_of(reg) if p.status.phase == t.POD_PENDING) == 1)
+        for p in pods_of(reg):
+            if p.status.phase == t.POD_PENDING:
+                finish(reg, p, t.POD_FAILED)
+
+        def failed():
+            job = reg.get("jobs", "default", "train")
+            return any(c.type == "Failed" and c.status == "True"
+                       and c.reason == "BackoffLimitExceeded"
+                       for c in job.status.conditions)
+        await wait_for(failed)
+    finally:
+        await ctrl.stop()
+        await factory.stop_all()
+
+
+async def test_gang_job_creates_podgroup_and_links_pods():
+    reg, client, factory = make_plane()
+    ctrl = JobController(client, factory)
+    await ctrl.start()
+    try:
+        reg.create(mk_job(parallelism=4, completions=4,
+                          gang=w.GangPolicy(slice_shape=[2, 2, 1])))
+        await wait_for(lambda: len(pods_of(reg)) == 4)
+        group = reg.get("podgroups", "default", "job-train")
+        assert group.spec.min_member == 4
+        assert group.spec.slice_shape == [2, 2, 1]
+        assert all(p.spec.gang == "job-train" for p in pods_of(reg))
+    finally:
+        await ctrl.stop()
+        await factory.stop_all()
+
+
+async def test_gang_failure_tears_down_and_restarts_all():
+    reg, client, factory = make_plane()
+    ctrl = JobController(client, factory)
+    await ctrl.start()
+    try:
+        reg.create(mk_job(parallelism=2, completions=2,
+                          gang=w.GangPolicy()))
+        await wait_for(lambda: len(pods_of(reg)) == 2)
+        first_names = {p.metadata.name for p in pods_of(reg)}
+        finish(reg, pods_of(reg)[0], t.POD_FAILED)
+
+        # Whole gang is torn down, then recreated with fresh pods.
+        def regenerated():
+            live = [p for p in pods_of(reg)
+                    if p.metadata.deletion_timestamp is None
+                    and p.status.phase == t.POD_PENDING]
+            return (len(live) == 2
+                    and not ({p.metadata.name for p in live} & first_names))
+        await wait_for(regenerated)
+    finally:
+        await ctrl.stop()
+        await factory.stop_all()

@@ -1,0 +1,63 @@
+"""Gang Job end-to-end: JobController materializes PodGroup + pods, the
+real scheduler gang-places them onto one slice sub-mesh (reference tier:
+test/integration/scheduler; gang flow is the TPU-first delta)."""
+import os
+import sys
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api import workloads as w
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.api.selectors import LabelSelector
+from kubernetes_tpu.client.informer import InformerFactory
+from kubernetes_tpu.controllers.job import JobController
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from integration.test_scheduler import make_cluster, mk_node  # noqa: E402
+from controllers.util import pod_template, wait_for  # noqa: E402
+
+
+async def test_gang_job_schedules_onto_one_slice():
+    # Two hosts forming one 2x2x2 v5p slice, 4 chips each.
+    nodes = [
+        mk_node("host-0", chips=[(0, 0, 0), (0, 1, 0), (1, 0, 0), (1, 1, 0)],
+                mesh=[2, 2, 2], slice_id="sl"),
+        mk_node("host-1", chips=[(0, 0, 1), (0, 1, 1), (1, 0, 1), (1, 1, 1)],
+                mesh=[2, 2, 2], slice_id="sl"),
+    ]
+    reg, client, sched = await make_cluster(nodes)
+    factory = InformerFactory(client)
+    jc = JobController(client, factory)
+    await jc.start()
+    try:
+        template = pod_template({"app": "train"})
+        template.spec.containers[0].tpu_requests = ["tpu"]
+        template.spec.tpu_resources = [t.PodTpuRequest(name="tpu", chips=4)]
+        job = w.Job(
+            metadata=ObjectMeta(name="llm", namespace="default"),
+            spec=w.JobSpec(parallelism=2, completions=2,
+                           selector=LabelSelector(match_labels={"app": "train"}),
+                           template=template,
+                           gang=w.GangPolicy(slice_shape=[2, 2, 2])))
+        reg.create(job)
+
+        def all_bound():
+            pods, _ = reg.list("pods", "default")
+            bound = [p for p in pods if p.spec.node_name]
+            if len(bound) != 2:
+                return None
+            return bound
+        bound = await wait_for(all_bound, timeout=10.0)
+        hosts = {p.spec.node_name for p in bound}
+        assert hosts == {"host-0", "host-1"}
+        chips = set()
+        for p in bound:
+            assigned = p.spec.tpu_resources[0].assigned
+            assert len(assigned) == 4
+            chips.update(assigned)
+        assert len(chips) == 8, "gang must cover the full 2x2x2 sub-mesh"
+        group = reg.get("podgroups", "default", "job-llm")
+        assert group.spec.min_member == 2
+    finally:
+        await jc.stop()
+        await factory.stop_all()
+        await sched.stop()
