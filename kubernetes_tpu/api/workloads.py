@@ -129,6 +129,10 @@ class StatefulSetStatus:
     ready_replicas: int = 0
     current_replicas: int = 0
     updated_replicas: int = 0
+    #: Revision bookkeeping (reference: currentRevision/updateRevision):
+    #: current is promoted to update once the rollout completes.
+    current_revision: str = ""
+    update_revision: str = ""
 
 
 @dataclass
@@ -212,6 +216,15 @@ class JobStatus:
     start_time: Optional[datetime.datetime] = None
     completion_time: Optional[datetime.datetime] = None
     conditions: list[JobCondition] = field(default_factory=list)
+    #: Durable progress accounting: terminal pods are counted exactly once
+    #: by UID, so force-deleting their records (pod GC, gang teardown)
+    #: cannot rewind succeeded/failed. Kubernetes moved to finalizer-based
+    #: tracking for the same reason; persisting in status is the
+    #: API-object-as-checkpoint move (SURVEY.md section 5.4).
+    counted_succeeded_uids: list[str] = field(default_factory=list)
+    counted_failed_uids: list[str] = field(default_factory=list)
+    #: Indexed mode: indexes that have completed (stable across pod GC).
+    completed_indexes: list[int] = field(default_factory=list)
 
 
 @dataclass

@@ -34,6 +34,10 @@ class CronSchedule:
             raise ValueError(f"cron needs 5 fields, got {expr!r}")
         self.sets = [self._parse(f, lo, hi)
                      for f, (lo, hi) in zip(fields, self._RANGES)]
+        # Standard cron: when BOTH dom and dow are restricted, a day
+        # matches if EITHER does (OR); a lone restriction is an AND.
+        self.dom_star = fields[2].startswith("*")
+        self.dow_star = fields[4].startswith("*")
 
     @staticmethod
     def _parse(field: str, lo: int, hi: int) -> frozenset:
@@ -54,15 +58,19 @@ class CronSchedule:
         return frozenset(v for v in out if lo <= v <= hi)
 
     def matches(self, dt: datetime.datetime) -> bool:
-        m, h, dom, mon, dow = self.sets
-        # cron dow: 0=Sunday; datetime.weekday(): 0=Monday.
-        return (dt.minute in m and dt.hour in h and dt.day in dom
-                and dt.month in mon and ((dt.weekday() + 1) % 7) in dow)
+        m, h = self.sets[0], self.sets[1]
+        return dt.minute in m and dt.hour in h and self._day_matches(dt.date())
 
     def _day_matches(self, day: datetime.date) -> bool:
         _, _, dom, mon, dow = self.sets
-        return (day.month in mon and day.day in dom
-                and ((day.weekday() + 1) % 7) in dow)
+        if day.month not in mon:
+            return False
+        dom_ok = day.day in dom
+        # cron dow: 0=Sunday; datetime.weekday(): 0=Monday.
+        dow_ok = ((day.weekday() + 1) % 7) in dow
+        if not self.dom_star and not self.dow_star:
+            return dom_ok or dow_ok
+        return dom_ok and dow_ok
 
     def prev_at_or_before(self, dt: datetime.datetime
                           ) -> Optional[datetime.datetime]:
@@ -200,13 +208,20 @@ class CronJobController(Controller):
                 namespace=cj.metadata.namespace,
                 owner_references=[controller_ref(cj, w.BATCH_V1, "CronJob")]),
             spec=deepcopy(cj.spec.job_template))
+        created = None
         try:
-            await self.client.create(job)
+            created = await self.client.create(job)
             self.recorder.event(cj, "Normal", "SuccessfulCreate",
                                 f"Created job {job.metadata.name}")
         except errors.AlreadyExistsError:
             pass
-        await self._mark_scheduled(cj, due, self._jobs_for(cj))
+        # status.active = still-running owned jobs + the one just created
+        # (the informer has not ingested it yet).
+        running = [j for j in self._jobs_for(cj) if not self._job_finished(j)]
+        if created is not None and all(
+                j.metadata.name != created.metadata.name for j in running):
+            running.append(created)
+        await self._mark_scheduled(cj, due, running)
 
     async def _mark_scheduled(self, cj, due, running) -> None:
         fresh = deepcopy(cj)
@@ -223,6 +238,18 @@ class CronJobController(Controller):
                                      job.metadata.name)
         except errors.NotFoundError:
             pass
+        # Cascade to the job's pods here as well: deletion through the
+        # garbage collector (owner-reference cascade) is asynchronous,
+        # and Replace semantics require the old run to actually stop.
+        pods, _ = await self.client.list("pods", job.metadata.namespace)
+        for pod in pods:
+            refs = pod.metadata.owner_references
+            if any(r.uid == job.metadata.uid for r in refs):
+                try:
+                    await self.client.delete("pods", pod.metadata.namespace,
+                                             pod.metadata.name)
+                except errors.NotFoundError:
+                    pass
 
     async def _prune(self, cj, jobs) -> None:
         def by_age(js):

@@ -116,16 +116,32 @@ class JobController(Controller):
             return None
         pods = self._pods_for(job)
         active = [p for p in pods if is_pod_active(p)]
-        succeeded = sum(1 for p in pods if p.status.phase == t.POD_SUCCEEDED)
-        failed_records = [p for p in pods if p.status.phase == t.POD_FAILED
-                          and p.metadata.deletion_timestamp is None]
-        # Gang restarts absorb failed-pod records into status.failed (the
-        # records are deleted with the gang); non-gang jobs keep the
-        # records, so count whichever representation holds the history.
-        if job.spec.gang is not None:
-            failed = job.status.failed + len(failed_records)
-        else:
-            failed = len(failed_records)
+        # Durable, exactly-once progress accounting: terminal pods are
+        # counted by UID into status, so deleting their records (pod GC,
+        # gang teardown) or an informer-lagged re-sync cannot double-count
+        # or rewind. The status write is resourceVersion-guarded, which
+        # makes the read-modify-write safe.
+        counted_s = set(job.status.counted_succeeded_uids)
+        counted_f = set(job.status.counted_failed_uids)
+        new_s = [p for p in pods if p.status.phase == t.POD_SUCCEEDED
+                 and p.metadata.uid not in counted_s]
+        new_f = [p for p in pods if p.status.phase == t.POD_FAILED
+                 and p.metadata.uid not in counted_f]
+        succeeded = job.status.succeeded + len(new_s)
+        failed = job.status.failed + len(new_f)
+        completed_indexes = set(job.status.completed_indexes)
+        for p in pods:
+            if p.status.phase == t.POD_SUCCEEDED:
+                idx = p.metadata.labels.get(COMPLETION_INDEX_LABEL)
+                if idx is not None:
+                    completed_indexes.add(int(idx))
+        acct = dict(
+            succeeded=succeeded, failed=failed,
+            counted_succeeded_uids=sorted(
+                counted_s | {p.metadata.uid for p in new_s}),
+            counted_failed_uids=sorted(
+                counted_f | {p.metadata.uid for p in new_f}),
+            completed_indexes=sorted(completed_indexes))
         completions = job.spec.completions
         requeue: Optional[float] = None
 
@@ -134,38 +150,39 @@ class JobController(Controller):
         if job.spec.active_deadline_seconds is not None and start is not None:
             elapsed = (now() - start).total_seconds()
             if elapsed >= job.spec.active_deadline_seconds:
-                await self._fail(job, active, succeeded, failed,
-                                 "DeadlineExceeded",
+                await self._fail(job, active, acct, "DeadlineExceeded",
                                  "job was active longer than "
                                  f"{job.spec.active_deadline_seconds}s")
                 return None
             requeue = job.spec.active_deadline_seconds - elapsed
 
         if failed > job.spec.backoff_limit:
-            await self._fail(job, active, succeeded, failed,
-                             "BackoffLimitExceeded",
+            await self._fail(job, active, acct, "BackoffLimitExceeded",
                              f"job has failed {failed} times")
             return None
 
         # Gang: a failed member kills the whole gang; survivors AND the
         # failed records are torn down so the next sync recreates a full,
-        # co-scheduled set (the failure history lives in status.failed).
-        if job.spec.gang is not None and failed_records:
+        # co-scheduled set (failure history is durable in status via the
+        # counted-UID accounting above).
+        if job.spec.gang is not None and new_f:
             self.recorder.event(job, "Warning", "GangMemberFailed",
                                 "tearing down gang for atomic restart")
-            for pod in active + failed_records:
+            for pod in active + new_f:
                 await self.pod_control.delete_pod(job, pod)
-            await self._update_status(job, [], succeeded, failed)
+            await self._update_status(job, [], acct)
             return None
 
         # Complete?
         if completions is not None:
-            done = succeeded >= completions
+            if job.spec.completion_mode == "Indexed":
+                done = len(completed_indexes) >= completions
+            else:
+                done = succeeded >= completions
         else:
             done = succeeded > 0 and not active
         if done:
-            await self._update_status(job, active, succeeded, failed,
-                                      condition="Complete")
+            await self._update_status(job, active, acct, condition="Complete")
             self.recorder.event(job, "Normal", "Completed", "job completed")
             return None
 
@@ -175,9 +192,12 @@ class JobController(Controller):
         # How many pods should be running?
         want = job.spec.parallelism
         if completions is not None:
-            want = min(want, completions - succeeded)
+            remaining = (completions - len(completed_indexes)
+                         if job.spec.completion_mode == "Indexed"
+                         else completions - succeeded)
+            want = min(want, remaining)
         if job.spec.completion_mode == "Indexed":
-            await self._sync_indexed(job, pods, active, succeeded, want)
+            await self._sync_indexed(job, active, completed_indexes, want)
         else:
             for _ in range(max(want - len(active), 0)):
                 await self.pod_control.create_pod(
@@ -185,20 +205,38 @@ class JobController(Controller):
             for pod in active[max(want, 0):]:
                 await self.pod_control.delete_pod(job, pod)
 
-        await self._update_status(job, self._pods_for(job), succeeded, failed)
+        await self._update_status(job, self._pods_for(job), acct)
         return requeue
 
-    async def _sync_indexed(self, job, pods, active, succeeded, want) -> None:
+    async def _sync_indexed(self, job, active, completed_indexes, want) -> None:
         total = job.spec.completions or job.spec.parallelism
-        done_idx = {p.metadata.labels.get(COMPLETION_INDEX_LABEL)
-                    for p in pods if p.status.phase == t.POD_SUCCEEDED}
+        # One live pod per index: reap duplicates (stale-cache double
+        # creates would otherwise leave two pods with the same rank).
+        by_idx: dict[str, list] = {}
+        for p in active:
+            by_idx.setdefault(
+                p.metadata.labels.get(COMPLETION_INDEX_LABEL, ""), []).append(p)
+        survivors = []
+        for idx, group in by_idx.items():
+            group.sort(key=lambda p: (
+                p.metadata.creation_timestamp.timestamp()
+                if p.metadata.creation_timestamp else 0.0))
+            survivors.append(group[0])
+            for dup in group[1:]:
+                await self.pod_control.delete_pod(job, dup)
+        # Enforce a lowered parallelism: drop highest indexes first.
+        survivors.sort(key=lambda p: int(
+            p.metadata.labels.get(COMPLETION_INDEX_LABEL, "0")))
+        for p in survivors[max(want, 0):]:
+            await self.pod_control.delete_pod(job, p)
+        survivors = survivors[:max(want, 0)]
         active_idx = {p.metadata.labels.get(COMPLETION_INDEX_LABEL)
-                      for p in active}
-        budget = want - len(active)
+                      for p in survivors}
+        budget = want - len(survivors)
         for i in range(total):
             if budget <= 0:
                 break
-            if str(i) in done_idx or str(i) in active_idx:
+            if i in completed_indexes or str(i) in active_idx:
                 continue
             await self.pod_control.create_pod(
                 job, job.spec.template,
@@ -206,24 +244,23 @@ class JobController(Controller):
                 mutate=self._mutator(job, i))
             budget -= 1
 
-    async def _fail(self, job, active, succeeded, failed, reason,
-                    message) -> None:
+    async def _fail(self, job, active, acct, reason, message) -> None:
         for pod in active:
             await self.pod_control.delete_pod(job, pod)
-        await self._update_status(job, [], succeeded, failed,
-                                  condition="Failed", reason=reason,
-                                  message=message)
+        await self._update_status(job, [], acct, condition="Failed",
+                                  reason=reason, message=message)
         self.recorder.event(job, "Warning", reason, message)
 
-    async def _update_status(self, job, pods, succeeded, failed,
+    async def _update_status(self, job, pods, acct,
                              condition: str = "", reason: str = "",
                              message: str = "") -> None:
         active = [p for p in pods if is_pod_active(p)]
         new = w.JobStatus(
-            active=len(active), succeeded=succeeded, failed=failed,
+            active=len(active),
             start_time=job.status.start_time or now(),
             completion_time=job.status.completion_time,
-            conditions=list(job.status.conditions))
+            conditions=list(job.status.conditions),
+            **acct)
         if condition and not any(c.type == condition and c.status == "True"
                                  for c in new.conditions):
             new.conditions = new.conditions + [w.JobCondition(

@@ -1,0 +1,242 @@
+"""Node lifecycle controller — failure detection + elastic recovery.
+
+Reference: ``pkg/controller/node/node_controller.go`` (``Run :555``,
+``monitorNodeStatus :619``) + ``taintManager`` (``:185,307-333``):
+
+- every tick, compare each node's heartbeat (NodeStatus Ready condition
+  + its heartbeat Lease, the cheaper signal) against a grace period;
+  stale nodes get Ready=Unknown and the ``unreachable`` NoExecute
+  taint; Ready=False nodes get the ``not-ready`` taint;
+- the taint manager evicts pods from NoExecute-tainted nodes unless
+  tolerated (honoring ``toleration_seconds``); workload controllers
+  then recreate them elsewhere — elasticity is emergent from
+  level-triggered reconcile, exactly as in the reference.
+
+TPU-first delta: a node whose TPU topology reports unhealthy chips gets
+a ``tpu-unhealthy`` NoSchedule taint so new slices avoid it while
+running gangs decide their own fate (gang restart is the Job
+controller's call, not the node controller's).
+"""
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+from ..api import errors
+from ..api import types as t
+from ..api.meta import now
+from ..api.scheme import deepcopy
+from ..client.informer import InformerFactory
+from ..client.interface import Client
+from .base import Controller
+
+TAINT_TPU_UNHEALTHY = "node.tpu/tpu-unhealthy"
+
+
+class NodeLifecycleController(Controller):
+    name = "node-lifecycle-controller"
+
+    def __init__(self, client: Client, factory: InformerFactory,
+                 workers: int = 2,
+                 monitor_interval: float = 5.0,
+                 grace_period: float = 40.0):
+        super().__init__(client, factory, workers)
+        self.monitor_interval = monitor_interval
+        self.grace_period = grace_period
+        self.node_informer = self.watch("nodes")
+        self.pod_informer = self.watch("pods")
+        self.lease_informer = self.watch("leases")
+        # Taint-manager reactions: pods on freshly tainted nodes.
+        self.node_informer.add_handlers(
+            on_add=lambda n: self._enqueue_node_pods(n),
+            on_update=lambda o, n: self._enqueue_node_pods(n))
+        self.pod_informer.add_handlers(
+            on_add=lambda p: self.enqueue(f"pod/{p.key()}"),
+            on_update=lambda o, n: self.enqueue(f"pod/{n.key()}"))
+        self._monitor_task: Optional[asyncio.Task] = None
+        #: pod key -> scheduled eviction task (tolerationSeconds timers).
+        self._evictions: dict[str, asyncio.Task] = {}
+
+    # -- lifecycle --------------------------------------------------------
+
+    async def on_start(self) -> None:
+        self._monitor_task = asyncio.get_running_loop().create_task(
+            self._monitor_loop())
+
+    async def stop(self) -> None:
+        if self._monitor_task:
+            self._monitor_task.cancel()
+            try:
+                await self._monitor_task
+            except asyncio.CancelledError:
+                pass
+        for task in self._evictions.values():
+            task.cancel()
+        self._evictions.clear()
+        await super().stop()
+
+    def _enqueue_node_pods(self, node: t.Node) -> None:
+        for pod in self.pod_informer.list():
+            if pod.spec.node_name == node.metadata.name:
+                self.enqueue(f"pod/{pod.key()}")
+
+    # -- monitorNodeStatus -------------------------------------------------
+
+    async def _monitor_loop(self) -> None:
+        while True:
+            try:
+                await self._monitor_once()
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001
+                import logging
+                logging.getLogger("controller").exception(
+                    "node monitor pass failed")
+            await asyncio.sleep(self.monitor_interval)
+
+    def _heartbeat_of(self, node: t.Node):
+        ready = t.get_node_condition(node.status, t.NODE_READY)
+        beats = []
+        if ready is not None and ready.last_heartbeat_time is not None:
+            beats.append(ready.last_heartbeat_time)
+        lease = self.lease_informer.get(
+            f"kube-system/node-{node.metadata.name}")
+        if lease is not None and lease.spec.renew_time is not None:
+            beats.append(lease.spec.renew_time)
+        return max(beats) if beats else None
+
+    async def _monitor_once(self) -> None:
+        ts = now()
+        for node in self.node_informer.list():
+            ready = t.get_node_condition(node.status, t.NODE_READY)
+            beat = self._heartbeat_of(node)
+            stale = (beat is None
+                     or (ts - beat).total_seconds() > self.grace_period)
+            # Taints reconcile every tick (a swallowed write conflict on
+            # one pass self-heals on the next — level-triggered).
+            if stale:
+                if ready is None or ready.status != "Unknown":
+                    await self._mark_unknown(node)
+                await self._set_taints(node, unreachable=True)
+            elif ready is not None and ready.status == "False":
+                await self._set_taints(node, not_ready=True)
+            elif ready is not None and ready.status == "True":
+                await self._set_taints(node)
+
+    async def _mark_unknown(self, node: t.Node) -> None:
+        fresh = deepcopy(node)
+        ready = t.get_node_condition(fresh.status, t.NODE_READY)
+        if ready is None:
+            ready = t.NodeCondition(type=t.NODE_READY)
+            fresh.status.conditions.append(ready)
+        ready.status = "Unknown"
+        ready.reason = "NodeStatusUnknown"
+        ready.message = "node agent stopped posting status"
+        ready.last_transition_time = now()
+        try:
+            await self.client.update(fresh, subresource="status")
+            self.recorder.event(node, "Warning", "NodeNotReady",
+                                f"node {node.metadata.name} heartbeat stale")
+        except (errors.ConflictError, errors.NotFoundError):
+            pass
+
+    async def _set_taints(self, node: t.Node, unreachable: bool = False,
+                          not_ready: bool = False) -> None:
+        """Reconcile lifecycle taints; TPU health taint rides along."""
+        managed = {t.TAINT_NODE_UNREACHABLE: unreachable,
+                   t.TAINT_NODE_NOT_READY: not_ready,
+                   TAINT_TPU_UNHEALTHY: self._tpu_unhealthy(node)}
+        current = {taint.key for taint in node.spec.taints
+                   if taint.key in managed}
+        desired = {key for key, on in managed.items() if on}
+        if current == desired:
+            return
+        fresh = deepcopy(node)
+        fresh.spec.taints = [taint for taint in fresh.spec.taints
+                             if taint.key not in managed]
+        for key in desired:
+            effect = ("NoSchedule" if key == TAINT_TPU_UNHEALTHY
+                      else "NoExecute")
+            fresh.spec.taints.append(
+                t.Taint(key=key, effect=effect, time_added=now()))
+        try:
+            await self.client.update(fresh)
+        except (errors.ConflictError, errors.NotFoundError):
+            pass
+
+    @staticmethod
+    def _tpu_unhealthy(node: t.Node) -> bool:
+        topo = node.status.tpu
+        if topo is None or not topo.chips:
+            return False
+        return any(c.health != t.TPU_HEALTHY for c in topo.chips)
+
+    # -- taint manager (NoExecute eviction) --------------------------------
+
+    async def sync(self, key: str) -> Optional[float]:
+        if not key.startswith("pod/"):
+            return None
+        pod_key = key[len("pod/"):]
+        pod = self.pod_informer.get(pod_key)
+        if pod is None or pod.metadata.deletion_timestamp is not None \
+                or not pod.spec.node_name:
+            self._cancel_eviction(pod_key)
+            return None
+        node = self.node_informer.get(pod.spec.node_name)
+        if node is None:
+            return None
+        no_execute = [taint for taint in node.spec.taints
+                      if taint.effect == "NoExecute"]
+        if not no_execute:
+            self._cancel_eviction(pod_key)
+            return None
+        # Tolerated forever? tolerationSeconds bounds the stay.
+        delays = []
+        for taint in no_execute:
+            tols = [tol for tol in pod.spec.tolerations if tol.tolerates(taint)]
+            if not tols:
+                delays.append(0.0)
+                continue
+            secs = [tol.toleration_seconds for tol in tols
+                    if tol.toleration_seconds is not None]
+            if secs:
+                base = taint.time_added or now()
+                remaining = max(secs) - (now() - base).total_seconds()
+                delays.append(max(remaining, 0.0))
+            # else: tolerated indefinitely — no delay entry.
+        if not delays:
+            self._cancel_eviction(pod_key)
+            return None
+        delay = min(delays)
+        if delay <= 0:
+            await self._evict(pod)
+        else:
+            self._schedule_eviction(pod_key, delay)
+        return None
+
+    async def _evict(self, pod: t.Pod) -> None:
+        self._cancel_eviction(pod.key())
+        self.recorder.event(pod, "Warning", "TaintEviction",
+                            f"evicting pod from {pod.spec.node_name}")
+        try:
+            await self.client.delete("pods", pod.metadata.namespace,
+                                     pod.metadata.name)
+        except errors.NotFoundError:
+            pass
+
+    def _schedule_eviction(self, pod_key: str, delay: float) -> None:
+        if pod_key in self._evictions:
+            return
+
+        async def later():
+            await asyncio.sleep(delay)
+            self._evictions.pop(pod_key, None)
+            self.enqueue(f"pod/{pod_key}")
+
+        self._evictions[pod_key] = asyncio.get_running_loop().create_task(
+            later())
+
+    def _cancel_eviction(self, pod_key: str) -> None:
+        task = self._evictions.pop(pod_key, None)
+        if task:
+            task.cancel()

@@ -1,0 +1,105 @@
+"""Pod garbage collector.
+
+Reference: ``pkg/kubelet``'s counterpart ``pkg/controller/podgc``:
+- force-delete pods bound to nodes that no longer exist (their node
+  agent can never confirm graceful termination);
+- trim terminated (Succeeded/Failed) pods beyond a threshold, oldest
+  first, so the store does not grow without bound;
+- force-delete pods stuck terminating on unreachable (Ready=Unknown)
+  nodes past their grace period — the step that actually frees a gang's
+  chips for rescheduling when a TPU host dies.
+"""
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+from ..api import errors
+from ..api import types as t
+from ..api.meta import now
+from ..client.informer import InformerFactory
+from ..client.interface import Client
+from .base import Controller
+
+
+class PodGCController(Controller):
+    name = "podgc-controller"
+
+    def __init__(self, client: Client, factory: InformerFactory,
+                 terminated_pod_threshold: int = 1000,
+                 interval: float = 20.0):
+        super().__init__(client, factory, workers=1)
+        self.threshold = terminated_pod_threshold
+        self.interval = interval
+        self.pod_informer = self.watch("pods")
+        self.node_informer = self.watch("nodes")
+        self._task: Optional[asyncio.Task] = None
+
+    async def on_start(self) -> None:
+        self._task = asyncio.get_running_loop().create_task(self._loop())
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+        await super().stop()
+
+    async def _loop(self) -> None:
+        while True:
+            try:
+                await self.gc_once()
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001
+                import logging
+                logging.getLogger("controller").exception("pod gc failed")
+            await asyncio.sleep(self.interval)
+
+    async def sync(self, key: str) -> Optional[float]:  # queue unused
+        return None
+
+    async def gc_once(self) -> None:
+        pods = self.pod_informer.list()
+        nodes = {n.metadata.name for n in self.node_informer.list()}
+        unknown = {n.metadata.name for n in self.node_informer.list()
+                   if (t.get_node_condition(n.status, t.NODE_READY) or
+                       t.NodeCondition()).status == "Unknown"}
+
+        # Orphaned: bound to a node that is gone.
+        for pod in pods:
+            if pod.spec.node_name and pod.spec.node_name not in nodes:
+                await self._force_delete(pod, "node is gone")
+
+        # Stuck terminating on an unreachable node past grace.
+        ts = now()
+        for pod in pods:
+            if (pod.metadata.deletion_timestamp is not None
+                    and pod.spec.node_name in unknown):
+                grace = pod.spec.termination_grace_period_seconds or 0
+                age = (ts - pod.metadata.deletion_timestamp).total_seconds()
+                if age > grace:
+                    await self._force_delete(pod, "node unreachable")
+
+        # Terminated beyond threshold, oldest first.
+        terminated = [p for p in pods
+                      if p.status.phase in (t.POD_SUCCEEDED, t.POD_FAILED)
+                      and p.metadata.deletion_timestamp is None]
+        excess = len(terminated) - self.threshold
+        if excess > 0:
+            terminated.sort(key=lambda p: (
+                p.metadata.creation_timestamp.timestamp()
+                if p.metadata.creation_timestamp else 0.0))
+            for pod in terminated[:excess]:
+                await self._force_delete(pod, "terminated pod threshold")
+
+    async def _force_delete(self, pod: t.Pod, why: str) -> None:
+        try:
+            await self.client.delete("pods", pod.metadata.namespace,
+                                     pod.metadata.name,
+                                     grace_period_seconds=0)
+            self.recorder.event(pod, "Normal", "PodGC", f"force-deleted: {why}")
+        except errors.NotFoundError:
+            pass

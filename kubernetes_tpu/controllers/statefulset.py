@@ -144,18 +144,25 @@ class StatefulSetController(Controller):
     async def _update_status(self, st: w.StatefulSet, revision: str) -> None:
         pods = self._pods_for(st)
         active = [p for p in pods.values() if is_pod_active(p)]
+        updated = sum(1 for p in active
+                      if p.metadata.labels.get(REVISION_LABEL) == revision)
+        # Reference contract (currentRevision/updateRevision): current is
+        # the pre-rollout revision until every replica is on the new one,
+        # at which point it is promoted — so steady state reports
+        # current_replicas == updated_replicas == replicas.
+        current_rev = st.status.current_revision or revision
+        if updated == st.spec.replicas and len(active) == st.spec.replicas:
+            current_rev = revision
         new = w.StatefulSetStatus(
             observed_generation=st.metadata.generation,
             replicas=len(active),
             ready_replicas=sum(1 for p in active if is_pod_ready(p)),
-            # current = pods still on a prior revision; updated = pods on
-            # the template's revision (rollout progress is their crossover).
             current_replicas=sum(
                 1 for p in active
-                if p.metadata.labels.get(REVISION_LABEL) != revision),
-            updated_replicas=sum(
-                1 for p in active
-                if p.metadata.labels.get(REVISION_LABEL) == revision),
+                if p.metadata.labels.get(REVISION_LABEL) == current_rev),
+            updated_replicas=updated,
+            current_revision=current_rev,
+            update_revision=revision,
         )
         if new == st.status:
             return

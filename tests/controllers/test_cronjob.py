@@ -27,6 +27,17 @@ def test_cron_parse_and_match():
     assert not lst.matches(dt(2026, 1, 1, 0, 35))
 
 
+def test_cron_dom_dow_or_semantics():
+    # Both restricted: fire on the 1st OR on Mondays (standard cron).
+    s = CronSchedule("0 9 1 * 1")
+    assert s.matches(dt(2026, 7, 1, 9, 0))   # 1st (a Wednesday)
+    assert s.matches(dt(2026, 7, 6, 9, 0))   # a Monday, not the 1st
+    assert not s.matches(dt(2026, 7, 7, 9, 0))  # Tuesday the 7th
+    # Only dow restricted: AND applies.
+    s2 = CronSchedule("0 9 * * 1")
+    assert not s2.matches(dt(2026, 7, 1, 9, 0))
+
+
 def test_cron_most_recent():
     s = CronSchedule("*/10 * * * *")
     got = s.most_recent(dt(2026, 7, 29, 11, 55), dt(2026, 7, 29, 12, 7))

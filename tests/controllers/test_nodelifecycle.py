@@ -1,0 +1,186 @@
+"""Node failure detection + taint eviction + pod GC (reference tier:
+pkg/controller/node + pkg/controller/podgc; SURVEY.md section 5.3)."""
+import asyncio
+import datetime
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.meta import ObjectMeta, now
+from kubernetes_tpu.controllers.nodelifecycle import (TAINT_TPU_UNHEALTHY,
+                                                      NodeLifecycleController)
+from kubernetes_tpu.controllers.podgc import PodGCController
+
+from .util import make_plane, mk_node, wait_for
+
+
+def stale_node(name, age_seconds=120.0):
+    node = mk_node(name)
+    ready = t.get_node_condition(node.status, t.NODE_READY)
+    ready.last_heartbeat_time = now() - datetime.timedelta(seconds=age_seconds)
+    return node
+
+
+def fresh_node(name):
+    node = mk_node(name)
+    ready = t.get_node_condition(node.status, t.NODE_READY)
+    ready.last_heartbeat_time = now()
+    return node
+
+
+def mk_ctrl(client, factory, grace=0.5, interval=0.05):
+    return NodeLifecycleController(client, factory,
+                                  monitor_interval=interval,
+                                  grace_period=grace)
+
+
+async def test_stale_heartbeat_marks_unknown_and_taints():
+    reg, client, factory = make_plane()
+    reg.create(stale_node("dead"))
+    reg.create(fresh_node("alive"))
+    ctrl = mk_ctrl(client, factory)
+    await ctrl.start()
+    try:
+        def tainted():
+            node = reg.get("nodes", "", "dead")
+            ready = t.get_node_condition(node.status, t.NODE_READY)
+            return (ready.status == "Unknown"
+                    and any(ta.key == t.TAINT_NODE_UNREACHABLE
+                            and ta.effect == "NoExecute"
+                            for ta in node.spec.taints))
+        await wait_for(tainted)
+        alive = reg.get("nodes", "", "alive")
+        assert not alive.spec.taints
+    finally:
+        await ctrl.stop()
+        await factory.stop_all()
+
+
+async def test_lease_renewal_counts_as_heartbeat():
+    reg, client, factory = make_plane()
+    reg.create(t.Namespace(metadata=ObjectMeta(name="kube-system")))
+    node = stale_node("n0", age_seconds=120.0)  # status stale...
+    reg.create(node)
+    # ...but the Lease is fresh (the cheap heartbeat path).
+    reg.create(t.Lease(metadata=ObjectMeta(name="node-n0",
+                                           namespace="kube-system"),
+                       spec=t.LeaseSpec(holder_identity="n0",
+                                        renew_time=now())))
+    ctrl = mk_ctrl(client, factory)
+    await ctrl.start()
+    try:
+        await asyncio.sleep(0.3)
+        node = reg.get("nodes", "", "n0")
+        ready = t.get_node_condition(node.status, t.NODE_READY)
+        assert ready.status == "True"
+        assert not node.spec.taints
+    finally:
+        await ctrl.stop()
+        await factory.stop_all()
+
+
+async def test_noexecute_eviction_and_toleration():
+    reg, client, factory = make_plane()
+    reg.create(stale_node("dead"))
+    victim = t.Pod(metadata=ObjectMeta(name="victim", namespace="default"),
+                   spec=t.PodSpec(node_name="dead",
+                                  containers=[t.Container(name="c", image="i")]))
+    tolerant = t.Pod(
+        metadata=ObjectMeta(name="tolerant", namespace="default"),
+        spec=t.PodSpec(
+            node_name="dead",
+            tolerations=[t.Toleration(key=t.TAINT_NODE_UNREACHABLE,
+                                      operator="Exists", effect="NoExecute")],
+            containers=[t.Container(name="c", image="i")]))
+    reg.create(victim)
+    reg.create(tolerant)
+    ctrl = mk_ctrl(client, factory)
+    await ctrl.start()
+    try:
+        def evicted():
+            got = reg.get("pods", "default", "victim")
+            return got.metadata.deletion_timestamp is not None
+        await wait_for(evicted)
+        assert reg.get("pods", "default",
+                       "tolerant").metadata.deletion_timestamp is None
+    finally:
+        await ctrl.stop()
+        await factory.stop_all()
+
+
+async def test_recovered_node_loses_taints():
+    reg, client, factory = make_plane()
+    reg.create(stale_node("flappy"))
+    ctrl = mk_ctrl(client, factory)
+    await ctrl.start()
+    try:
+        await wait_for(lambda: reg.get("nodes", "", "flappy").spec.taints)
+        # Node agent comes back: fresh heartbeat + Ready=True.
+        node = reg.get("nodes", "", "flappy")
+        ready = t.get_node_condition(node.status, t.NODE_READY)
+        ready.status = "True"
+        ready.last_heartbeat_time = now()
+        reg.update(node, subresource="status")
+        await wait_for(
+            lambda: not reg.get("nodes", "", "flappy").spec.taints)
+    finally:
+        await ctrl.stop()
+        await factory.stop_all()
+
+
+async def test_unhealthy_tpu_chip_taints_noschedule():
+    reg, client, factory = make_plane()
+    node = fresh_node("tpu-host")
+    node.status.tpu = t.TpuTopology(
+        chip_type="v5p", slice_id="sl", mesh_shape=[2, 1, 1],
+        chips=[t.TpuChip(id="c0", coords=[0, 0, 0]),
+               t.TpuChip(id="c1", coords=[1, 0, 0], health=t.TPU_UNHEALTHY)])
+    reg.create(node)
+    ctrl = mk_ctrl(client, factory)
+    await ctrl.start()
+    try:
+        def tpu_tainted():
+            got = reg.get("nodes", "", "tpu-host")
+            return any(ta.key == TAINT_TPU_UNHEALTHY
+                       and ta.effect == "NoSchedule"
+                       for ta in got.spec.taints)
+        await wait_for(tpu_tainted)
+    finally:
+        await ctrl.stop()
+        await factory.stop_all()
+
+
+async def test_podgc_reaps_orphans_and_stuck_terminating():
+    reg, client, factory = make_plane()
+    reg.create(fresh_node("alive"))
+    # Pod bound to a node that does not exist.
+    orphan = t.Pod(metadata=ObjectMeta(name="orphan", namespace="default"),
+                   spec=t.PodSpec(node_name="ghost",
+                                  containers=[t.Container(name="c", image="i")]))
+    reg.create(orphan)
+    # Unreachable node with a pod stuck terminating past its grace.
+    dead = stale_node("dead")
+    ready = t.get_node_condition(dead.status, t.NODE_READY)
+    ready.status = "Unknown"
+    reg.create(dead)
+    stuck = t.Pod(metadata=ObjectMeta(name="stuck", namespace="default"),
+                  spec=t.PodSpec(node_name="dead",
+                                 termination_grace_period_seconds=0,
+                                 containers=[t.Container(name="c", image="i")]))
+    reg.create(stuck)
+    reg.delete("pods", "default", "stuck")  # graceful: marks only
+
+    gc = PodGCController(client, factory, interval=0.05)
+    await gc.start()
+    try:
+        def gone():
+            import kubernetes_tpu.api.errors as e
+            for name in ("orphan", "stuck"):
+                try:
+                    reg.get("pods", "default", name)
+                    return False
+                except e.NotFoundError:
+                    pass
+            return True
+        await wait_for(gone)
+    finally:
+        await gc.stop()
+        await factory.stop_all()

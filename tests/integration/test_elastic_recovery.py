@@ -1,0 +1,80 @@
+"""Elastic recovery end-to-end: a node dies -> lifecycle controller
+taints it -> taint manager evicts -> pod GC frees stuck pods -> the
+ReplicaSet controller recreates capacity -> the scheduler rebinds onto
+the surviving node. Reference semantics: SURVEY.md section 5.3
+(failure detection is the node controller + emergent reconcile)."""
+import datetime
+import os
+import sys
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.meta import ObjectMeta, now
+from kubernetes_tpu.api.selectors import LabelSelector
+from kubernetes_tpu.api import workloads as w
+from kubernetes_tpu.client.informer import InformerFactory
+from kubernetes_tpu.controllers.nodelifecycle import NodeLifecycleController
+from kubernetes_tpu.controllers.podgc import PodGCController
+from kubernetes_tpu.controllers.replicaset import ReplicaSetController
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from integration.test_scheduler import make_cluster, mk_node  # noqa: E402
+from controllers.util import pod_template, wait_for  # noqa: E402
+
+
+async def test_node_death_reschedules_replicaset_pods():
+    n_dead = mk_node("host-dead")
+    n_live = mk_node("host-live")
+    for n in (n_dead, n_live):
+        ready = t.get_node_condition(n.status, t.NODE_READY)
+        ready.last_heartbeat_time = now()
+    reg, client, sched = await make_cluster([n_dead, n_live])
+    factory = InformerFactory(client)
+    nlc = NodeLifecycleController(client, factory,
+                                  monitor_interval=0.05, grace_period=0.4)
+    gc = PodGCController(client, factory, interval=0.05)
+    rc = ReplicaSetController(client, factory)
+    for c in (nlc, gc, rc):
+        await c.start()
+    try:
+        rs = w.ReplicaSet(
+            metadata=ObjectMeta(name="web", namespace="default"),
+            spec=w.ReplicaSetSpec(
+                replicas=2, selector=LabelSelector(match_labels={"app": "web"}),
+                template=pod_template({"app": "web"})))
+        reg.create(rs)
+
+        def all_bound():
+            pods, _ = reg.list("pods", "default")
+            bound = [p for p in pods if p.spec.node_name]
+            return bound if len(bound) == 2 else None
+        await wait_for(all_bound, timeout=10.0)
+
+        # Freshen heartbeats so only host-dead goes stale below.
+        for name in ("host-dead", "host-live"):
+            node = reg.get("nodes", "", name)
+            ready = t.get_node_condition(node.status, t.NODE_READY)
+            ready.last_heartbeat_time = now() + datetime.timedelta(seconds=3600)
+        # host-dead: heartbeat far in the past, never refreshed again.
+        node = reg.get("nodes", "", "host-dead")
+        ready = t.get_node_condition(node.status, t.NODE_READY)
+        ready.last_heartbeat_time = now() - datetime.timedelta(seconds=3600)
+        reg.update(node, subresource="status")
+        live = reg.get("nodes", "", "host-live")
+        lready = t.get_node_condition(live.status, t.NODE_READY)
+        lready.last_heartbeat_time = now() + datetime.timedelta(seconds=3600)
+        reg.update(live, subresource="status")
+
+        # Eventually: 2 pods bound, all on host-live, none terminating.
+        def recovered():
+            pods, _ = reg.list("pods", "default")
+            live_pods = [p for p in pods
+                         if p.metadata.deletion_timestamp is None]
+            return (len(live_pods) == 2
+                    and all(p.spec.node_name == "host-live"
+                            for p in live_pods))
+        await wait_for(recovered, timeout=15.0)
+    finally:
+        for c in (nlc, gc, rc):
+            await c.stop()
+        await factory.stop_all()
+        await sched.stop()
