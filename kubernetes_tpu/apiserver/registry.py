@@ -268,7 +268,9 @@ class Registry:
         new.api_version, new.kind = spec.api_version, spec.kind
         # Finalizer-driven actual deletion: once an object marked for
         # deletion has no finalizers left, the update removes it.
-        if new.metadata.deletion_timestamp is not None and not new.metadata.finalizers:
+        ns_finalizers = (isinstance(new, t.Namespace) and new.spec.finalizers)
+        if new.metadata.deletion_timestamp is not None \
+                and not new.metadata.finalizers and not ns_finalizers:
             self.store.delete(key, expected_revision=stored.mod_revision)
             new.metadata.resource_version = str(self.store.revision)
             return new
@@ -323,6 +325,17 @@ class Registry:
             raise errors.ConflictError(
                 f"uid precondition failed: have {obj.metadata.uid}, want {preconditions_uid}")
         graceful = spec.graceful_delete and (grace_period_seconds is None or grace_period_seconds > 0)
+        # Namespace deletion is finalizer-gated via spec.finalizers: the
+        # namespace controller purges contents, then clears them
+        # (reference: pkg/registry/core/namespace + namespace controller).
+        if isinstance(obj, t.Namespace) and obj.spec.finalizers \
+                and obj.metadata.deletion_timestamp is None:
+            obj.metadata.deletion_timestamp = now()
+            obj.status.phase = t.NS_TERMINATING
+            rev = self.store.update(key, self._encode(obj),
+                                    expected_revision=stored.mod_revision)
+            obj.metadata.resource_version = str(rev)
+            return obj
         if graceful and isinstance(obj, t.Pod) and not obj.spec.node_name:
             # Unscheduled pods have no node agent to confirm termination:
             # delete immediately (reference: pkg/registry/core/pod/strategy.go

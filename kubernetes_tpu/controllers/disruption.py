@@ -1,0 +1,75 @@
+"""Disruption controller — PodDisruptionBudget status.
+
+Reference: ``pkg/controller/disruption``: keep
+``status.disruptions_allowed`` current so voluntary evictions (drain)
+can be admission-checked against it. For a gang-scheduled training job
+a PDB with min_available == gang size means "never voluntarily break
+the gang".
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..api import errors
+from ..api import types as t
+from ..api import workloads as w
+from ..api.scheme import deepcopy
+from ..client.informer import InformerFactory
+from ..client.interface import Client
+from .base import Controller, is_pod_active, is_pod_ready
+
+
+class DisruptionController(Controller):
+    name = "disruption-controller"
+
+    def __init__(self, client: Client, factory: InformerFactory,
+                 workers: int = 1):
+        super().__init__(client, factory, workers)
+        self.pdb_informer = self.watch("poddisruptionbudgets")
+        self.pod_informer = self.watch("pods")
+        self.pdb_informer.add_handlers(
+            on_add=self.enqueue_obj,
+            on_update=lambda o, n: self.enqueue_obj(n))
+        self.pod_informer.add_handlers(
+            on_add=lambda p: self._enqueue_matching(p),
+            on_update=lambda o, n: self._enqueue_matching(n),
+            on_delete=lambda p: self._enqueue_matching(p))
+
+    def _enqueue_matching(self, pod: t.Pod) -> None:
+        for pdb in self.pdb_informer.list():
+            if pdb.metadata.namespace != pod.metadata.namespace:
+                continue
+            if pdb.spec.selector is None or \
+                    pdb.spec.selector.matches(pod.metadata.labels):
+                self.enqueue_obj(pdb)
+
+    async def sync(self, key: str) -> Optional[float]:
+        pdb = self.pdb_informer.get(key)
+        if pdb is None:
+            return None
+        pods = [p for p in self.pod_informer.list()
+                if p.metadata.namespace == pdb.metadata.namespace
+                and (pdb.spec.selector is None
+                     or pdb.spec.selector.matches(p.metadata.labels))
+                and is_pod_active(p)]
+        expected = len(pods)
+        healthy = sum(1 for p in pods if is_pod_ready(p))
+        if pdb.spec.min_available is not None:
+            desired_healthy = pdb.spec.min_available
+        elif pdb.spec.max_unavailable is not None:
+            desired_healthy = max(expected - pdb.spec.max_unavailable, 0)
+        else:
+            desired_healthy = expected
+        allowed = max(healthy - desired_healthy, 0)
+        new = w.PodDisruptionBudgetStatus(
+            disruptions_allowed=allowed, current_healthy=healthy,
+            desired_healthy=desired_healthy, expected_pods=expected)
+        if new == pdb.status:
+            return None
+        fresh = deepcopy(pdb)
+        fresh.status = new
+        try:
+            await self.client.update(fresh, subresource="status")
+        except (errors.ConflictError, errors.NotFoundError):
+            pass
+        return None

@@ -1,0 +1,99 @@
+"""Endpoints controller — Service selector -> ready pod addresses.
+
+Reference: ``pkg/controller/endpoint``: for every Service with a
+selector, maintain an Endpoints object listing the IPs of ready pods
+(unready pods are excluded so traffic never hits a worker that has not
+finished jax init). Headless services (cluster_ip: "None") get the same
+treatment — their Endpoints back the stable DNS identity StatefulSet
+ranks rely on.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..api import errors
+from ..api import types as t
+from ..api.meta import ObjectMeta, controller_ref
+from ..client.informer import InformerFactory
+from ..client.interface import Client
+from .base import Controller, is_pod_active, is_pod_ready
+
+
+class EndpointsController(Controller):
+    name = "endpoints-controller"
+
+    def __init__(self, client: Client, factory: InformerFactory,
+                 workers: int = 2):
+        super().__init__(client, factory, workers)
+        self.svc_informer = self.watch("services")
+        self.pod_informer = self.watch("pods")
+        self.svc_informer.add_handlers(
+            on_add=self.enqueue_obj,
+            on_update=lambda o, n: self.enqueue_obj(n),
+            on_delete=self.enqueue_obj)
+        self.pod_informer.add_handlers(
+            on_add=lambda p: self._enqueue_pod_services(p),
+            on_update=lambda o, n: self._enqueue_pod_services(n),
+            on_delete=lambda p: self._enqueue_pod_services(p))
+
+    def _enqueue_pod_services(self, pod: t.Pod) -> None:
+        for svc in self.svc_informer.list():
+            if svc.metadata.namespace != pod.metadata.namespace:
+                continue
+            sel = svc.spec.selector
+            if sel and all(pod.metadata.labels.get(k) == v
+                           for k, v in sel.items()):
+                self.enqueue_obj(svc)
+
+    async def sync(self, key: str) -> Optional[float]:
+        svc = self.svc_informer.get(key)
+        ns, name = (key.split("/", 1) + [""])[:2]
+        if svc is None:
+            # Service gone: its Endpoints goes too (also handled by GC,
+            # but doing it here keeps the pair atomic-ish).
+            try:
+                await self.client.delete("endpoints", ns, name)
+            except errors.NotFoundError:
+                pass
+            return None
+        if not svc.spec.selector:
+            return None  # manually-managed endpoints
+        addresses, not_ready = [], []
+        for pod in self.pod_informer.list():
+            if pod.metadata.namespace != svc.metadata.namespace:
+                continue
+            if not all(pod.metadata.labels.get(k) == v
+                       for k, v in svc.spec.selector.items()):
+                continue
+            if not is_pod_active(pod) or not pod.status.pod_ip:
+                continue
+            addr = t.EndpointAddress(
+                ip=pod.status.pod_ip, node_name=pod.spec.node_name,
+                hostname=pod.spec.hostname,
+                target_ref=t.ObjectReference(
+                    kind="Pod", namespace=pod.metadata.namespace,
+                    name=pod.metadata.name, uid=pod.metadata.uid))
+            (addresses if is_pod_ready(pod) else not_ready).append(addr)
+        ports = [t.EndpointPort(name=p.name, port=p.target_port or p.port,
+                                protocol=p.protocol)
+                 for p in svc.spec.ports]
+        subset = t.EndpointSubset(addresses=addresses,
+                                  not_ready_addresses=not_ready, ports=ports)
+        desired = t.Endpoints(
+            metadata=ObjectMeta(
+                name=svc.metadata.name, namespace=svc.metadata.namespace,
+                owner_references=[controller_ref(svc, "core/v1", "Service")]),
+            subsets=[subset] if (addresses or not_ready) else [])
+        try:
+            current = await self.client.get("endpoints", svc.metadata.namespace,
+                                            svc.metadata.name)
+            if current.subsets == desired.subsets:
+                return None
+            current.subsets = desired.subsets
+            await self.client.update(current)
+        except errors.NotFoundError:
+            try:
+                await self.client.create(desired)
+            except errors.AlreadyExistsError:
+                pass
+        return None

@@ -1,0 +1,119 @@
+"""Horizontal pod autoscaler controller.
+
+Reference: ``pkg/controller/podautoscaler`` (1.5k LoC): every sync
+period read the scale target's current replica count and the pods' cpu
+utilization, compute
+
+    desired = ceil(current * currentUtilization / targetUtilization)
+
+clamp to [min, max], and write the target's replicas. The reference
+reads heapster; here the metrics source is pluggable — the default
+reads the node agents' reported per-pod usage from a pod annotation
+(``metrics.tpu/cpu-utilization-percent``), and the libtpu metrics
+pipeline can swap in a real source.
+"""
+from __future__ import annotations
+
+import math
+from typing import Callable, Optional
+
+from ..api import errors
+from ..api import types as t
+from ..api import workloads as w
+from ..api.meta import now
+from ..api.scheme import deepcopy
+from ..client.informer import InformerFactory
+from ..client.interface import Client
+from .base import Controller, is_pod_active
+
+UTIL_ANNOTATION = "metrics.tpu/cpu-utilization-percent"
+
+#: Scale only when desired/current departs from 1.0 by more than this
+#: (reference: --horizontal-pod-autoscaler-tolerance, 0.1).
+TOLERANCE = 0.1
+
+MetricsSource = Callable[[t.Pod], Optional[float]]
+
+
+def annotation_metrics(pod: t.Pod) -> Optional[float]:
+    raw = pod.metadata.annotations.get(UTIL_ANNOTATION)
+    try:
+        return float(raw) if raw is not None else None
+    except ValueError:
+        return None
+
+
+class HorizontalPodAutoscalerController(Controller):
+    name = "horizontal-pod-autoscaler"
+
+    def __init__(self, client: Client, factory: InformerFactory,
+                 metrics: MetricsSource = annotation_metrics,
+                 sync_period: float = 15.0):
+        super().__init__(client, factory, workers=1)
+        self.metrics = metrics
+        self.sync_period = sync_period
+        self.hpa_informer = self.watch("horizontalpodautoscalers")
+        self.pod_informer = self.watch("pods")
+        self.hpa_informer.add_handlers(
+            on_add=self.enqueue_obj,
+            on_update=lambda o, n: self.enqueue_obj(n))
+
+    async def sync(self, key: str) -> Optional[float]:
+        hpa = self.hpa_informer.get(key)
+        if hpa is None:
+            return None
+        ref = hpa.spec.scale_target_ref
+        plural = {"Deployment": "deployments", "ReplicaSet": "replicasets",
+                  "StatefulSet": "statefulsets"}.get(ref.kind)
+        if plural is None:
+            return None
+        try:
+            target = await self.client.get(plural, hpa.metadata.namespace,
+                                           ref.name)
+        except errors.NotFoundError:
+            return self.sync_period
+        current = target.spec.replicas
+        selector = target.spec.selector
+        utils = []
+        for pod in self.pod_informer.list():
+            if pod.metadata.namespace != hpa.metadata.namespace:
+                continue
+            if selector is not None and not selector.matches(
+                    pod.metadata.labels):
+                continue
+            if not is_pod_active(pod):
+                continue
+            u = self.metrics(pod)
+            if u is not None:
+                utils.append(u)
+        if not utils or current == 0:
+            return self.sync_period
+        avg = sum(utils) / len(utils)
+        ratio = avg / max(hpa.spec.target_cpu_utilization_percentage, 1)
+        desired = current if abs(ratio - 1.0) <= TOLERANCE else math.ceil(
+            current * ratio)
+        desired = max(hpa.spec.min_replicas, min(hpa.spec.max_replicas,
+                                                 desired))
+        if desired != current:
+            fresh = deepcopy(target)
+            fresh.spec.replicas = desired
+            try:
+                await self.client.update(fresh)
+                self.recorder.event(
+                    hpa, "Normal", "SuccessfulRescale",
+                    f"scaled {ref.kind}/{ref.name} {current} -> {desired} "
+                    f"(cpu {avg:.0f}%)")
+            except (errors.ConflictError, errors.NotFoundError):
+                return 0.5
+        fresh_hpa = deepcopy(hpa)
+        fresh_hpa.status = w.HorizontalPodAutoscalerStatus(
+            current_replicas=current, desired_replicas=desired,
+            current_cpu_utilization_percentage=int(avg),
+            last_scale_time=now() if desired != current
+            else hpa.status.last_scale_time)
+        if fresh_hpa.status != hpa.status:
+            try:
+                await self.client.update(fresh_hpa, subresource="status")
+            except (errors.ConflictError, errors.NotFoundError):
+                pass
+        return self.sync_period

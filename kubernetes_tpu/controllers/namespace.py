@@ -1,0 +1,68 @@
+"""Namespace controller — drains Terminating namespaces.
+
+Reference: ``pkg/controller/namespace``: when a namespace enters
+Terminating (deletion_timestamp set, spec.finalizers pending), delete
+every namespaced object it contains, then clear the ``kubernetes_tpu``
+finalizer; the registry removes the namespace on that update.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..api import errors
+from ..api import types as t
+from ..api.scheme import deepcopy
+from ..client.informer import InformerFactory
+from ..client.interface import Client
+from .base import Controller
+
+#: Namespaced resources purged on namespace deletion.
+NAMESPACED = [
+    "pods", "services", "endpoints", "configmaps", "secrets", "events",
+    "podgroups", "replicasets", "deployments", "statefulsets", "daemonsets",
+    "jobs", "cronjobs", "horizontalpodautoscalers", "poddisruptionbudgets",
+    "resourcequotas", "limitranges", "leases",
+]
+
+
+class NamespaceController(Controller):
+    name = "namespace-controller"
+
+    def __init__(self, client: Client, factory: InformerFactory,
+                 workers: int = 1):
+        super().__init__(client, factory, workers)
+        self.ns_informer = self.watch("namespaces")
+        self.ns_informer.add_handlers(
+            on_add=self.enqueue_obj,
+            on_update=lambda o, n: self.enqueue_obj(n))
+
+    async def sync(self, key: str) -> Optional[float]:
+        ns = self.ns_informer.get(key)
+        if ns is None or ns.metadata.deletion_timestamp is None:
+            return None
+        name = ns.metadata.name
+        remaining = 0
+        for plural in NAMESPACED:
+            try:
+                items, _ = await self.client.list(plural, name)
+            except errors.NotFoundError:
+                continue
+            for obj in items:
+                remaining += 1
+                try:
+                    # Force-delete pods: their node agents may be gone
+                    # with the namespace's workloads anyway.
+                    gp = 0 if plural == "pods" else None
+                    await self.client.delete(plural, name, obj.metadata.name,
+                                             grace_period_seconds=gp)
+                except (errors.NotFoundError, errors.ConflictError):
+                    pass
+        if remaining:
+            return 0.1  # deletions are async; check again shortly
+        fresh = deepcopy(ns)
+        fresh.spec.finalizers = []
+        try:
+            await self.client.update(fresh)
+        except (errors.NotFoundError, errors.ConflictError):
+            pass
+        return None

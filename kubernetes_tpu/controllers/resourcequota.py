@@ -1,0 +1,100 @@
+"""ResourceQuota controller — recompute usage, level-triggered.
+
+Reference: ``pkg/controller/resourcequota`` + ``pkg/quota``: admission
+enforces quotas synchronously (apiserver/admission.py
+ResourceQuotaPlugin); this controller recalculates ``status.used`` from
+actual objects so drift (force deletes, failed pods, admission races)
+self-heals. Tracked resources mirror the admission plugin: pods, cpu,
+memory, and google.com/tpu chips.
+"""
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+from ..api import errors
+from ..api import types as t
+from ..api.scheme import deepcopy
+from ..client.informer import InformerFactory
+from ..client.interface import Client
+from .base import Controller
+
+
+def pod_usage(pod: t.Pod) -> dict[str, float]:
+    """Resource footprint of one pod (terminal pods are free)."""
+    if pod.status.phase in (t.POD_SUCCEEDED, t.POD_FAILED):
+        return {}
+    use = {t.RESOURCE_PODS: 1.0}
+    for c in pod.spec.containers:
+        for res, qty in c.resources.requests.items():
+            use[res] = use.get(res, 0.0) + t.parse_quantity(qty)
+    chips = t.pod_tpu_chip_count(pod)
+    if chips:
+        use[t.RESOURCE_TPU] = use.get(t.RESOURCE_TPU, 0.0) + chips
+    return use
+
+
+class ResourceQuotaController(Controller):
+    name = "resourcequota-controller"
+
+    def __init__(self, client: Client, factory: InformerFactory,
+                 interval: float = 15.0):
+        super().__init__(client, factory, workers=1)
+        self.interval = interval
+        self.quota_informer = self.watch("resourcequotas")
+        self.pod_informer = self.watch("pods")
+        self.quota_informer.add_handlers(
+            on_add=self.enqueue_obj,
+            on_update=lambda o, n: self.enqueue_obj(n))
+        self.pod_informer.add_handlers(
+            on_add=lambda p: self._enqueue_ns(p),
+            on_update=lambda o, n: self._enqueue_ns(n),
+            on_delete=lambda p: self._enqueue_ns(p))
+        self._task: Optional[asyncio.Task] = None
+
+    def _enqueue_ns(self, pod: t.Pod) -> None:
+        for q in self.quota_informer.list():
+            if q.metadata.namespace == pod.metadata.namespace:
+                self.enqueue_obj(q)
+
+    async def on_start(self) -> None:
+        async def resync():
+            while True:
+                await asyncio.sleep(self.interval)
+                for q in self.quota_informer.list():
+                    self.enqueue_obj(q)
+        self._task = asyncio.get_running_loop().create_task(resync())
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+        await super().stop()
+
+    async def sync(self, key: str) -> Optional[float]:
+        quota = self.quota_informer.get(key)
+        if quota is None:
+            return None
+        used: dict[str, float] = {}
+        for pod in self.pod_informer.list():
+            if pod.metadata.namespace != quota.metadata.namespace:
+                continue
+            if pod.metadata.deletion_timestamp is not None:
+                continue
+            for res, qty in pod_usage(pod).items():
+                used[res] = used.get(res, 0.0) + qty
+        tracked = {res: used.get(res, 0.0) for res in quota.spec.hard}
+        if quota.status.used == tracked and \
+                quota.status.hard == quota.spec.hard:
+            return None
+        fresh = deepcopy(quota)
+        fresh.status.hard = dict(quota.spec.hard)
+        fresh.status.used = tracked
+        try:
+            await self.client.update(fresh, subresource="status")
+        except (errors.NotFoundError, errors.ConflictError):
+            pass
+        return None

@@ -1,0 +1,146 @@
+"""Endpoints, ResourceQuota, HPA, and PDB controllers."""
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api import workloads as w
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.api.selectors import LabelSelector
+from kubernetes_tpu.controllers.disruption import DisruptionController
+from kubernetes_tpu.controllers.endpoints import EndpointsController
+from kubernetes_tpu.controllers.hpa import (UTIL_ANNOTATION,
+                                            HorizontalPodAutoscalerController)
+from kubernetes_tpu.controllers.resourcequota import ResourceQuotaController
+
+from .util import make_plane, mark_ready, pod_template, wait_for
+
+
+def mk_pod(name, labels, ip="", ready=False, cpu=0.5, util=None):
+    ann = {UTIL_ANNOTATION: str(util)} if util is not None else {}
+    pod = t.Pod(
+        metadata=ObjectMeta(name=name, namespace="default", labels=labels,
+                            annotations=ann),
+        spec=t.PodSpec(node_name="n1", containers=[t.Container(
+            name="c", image="i",
+            resources=t.ResourceRequirements(requests={"cpu": cpu}))]))
+    pod.status.pod_ip = ip
+    return pod
+
+
+def create_pod(reg, pod):
+    """Create + write status (the registry clears client status on create)."""
+    ip = pod.status.pod_ip
+    created = reg.create(pod)
+    if ip:
+        got = reg.get("pods", "default", created.metadata.name)
+        got.status.pod_ip = ip
+        reg.update(got, subresource="status")
+    return created
+
+
+async def test_endpoints_tracks_ready_pods():
+    reg, client, factory = make_plane()
+    ctrl = EndpointsController(client, factory)
+    await ctrl.start()
+    try:
+        reg.create(t.Service(
+            metadata=ObjectMeta(name="svc", namespace="default"),
+            spec=t.ServiceSpec(selector={"app": "web"},
+                               ports=[t.ServicePort(name="http", port=80)])))
+        create_pod(reg, mk_pod("p1", {"app": "web"}, ip="10.0.0.1"))
+        create_pod(reg, mk_pod("p2", {"app": "web"}, ip="10.0.0.2"))
+        create_pod(reg, mk_pod("other", {"app": "db"}, ip="10.0.0.9"))
+        mark_ready(reg, reg.get("pods", "default", "p1"))
+
+        def endpoints_ok():
+            try:
+                ep = reg.get("endpoints", "default", "svc")
+            except Exception:
+                return False
+            if not ep.subsets:
+                return False
+            ready_ips = {a.ip for a in ep.subsets[0].addresses}
+            unready_ips = {a.ip for a in ep.subsets[0].not_ready_addresses}
+            return (ready_ips == {"10.0.0.1"}
+                    and unready_ips == {"10.0.0.2"}
+                    and ep.subsets[0].ports[0].port == 80)
+        await wait_for(endpoints_ok)
+    finally:
+        await ctrl.stop()
+        await factory.stop_all()
+
+
+async def test_quota_status_recomputed():
+    reg, client, factory = make_plane()
+    quota = t.ResourceQuota(
+        metadata=ObjectMeta(name="q", namespace="default"),
+        spec=t.ResourceQuotaSpec(hard={"cpu": 4.0, "pods": 10.0}))
+    reg.create(quota)
+    reg.create(mk_pod("p1", {"a": "b"}, cpu=0.5))
+    reg.create(mk_pod("p2", {"a": "b"}, cpu=1.5))
+    ctrl = ResourceQuotaController(client, factory, interval=0.1)
+    await ctrl.start()
+    try:
+        def used_ok():
+            q = reg.get("resourcequotas", "default", "q")
+            return (q.status.used.get("cpu") == 2.0
+                    and q.status.used.get("pods") == 2.0
+                    and q.status.hard == {"cpu": 4.0, "pods": 10.0})
+        await wait_for(used_ok)
+    finally:
+        await ctrl.stop()
+        await factory.stop_all()
+
+
+async def test_hpa_scales_deployment_up():
+    reg, client, factory = make_plane()
+    dep = w.Deployment(
+        metadata=ObjectMeta(name="web", namespace="default"),
+        spec=w.DeploymentSpec(
+            replicas=2, selector=LabelSelector(match_labels={"app": "web"}),
+            template=pod_template({"app": "web"})))
+    reg.create(dep)
+    # Two pods at 160% of an 80% target -> desired 4.
+    reg.create(mk_pod("p1", {"app": "web"}, util=160))
+    reg.create(mk_pod("p2", {"app": "web"}, util=160))
+    reg.create(w.HorizontalPodAutoscaler(
+        metadata=ObjectMeta(name="hpa", namespace="default"),
+        spec=w.HorizontalPodAutoscalerSpec(
+            scale_target_ref=w.CrossVersionObjectReference(
+                kind="Deployment", name="web"),
+            min_replicas=1, max_replicas=5,
+            target_cpu_utilization_percentage=80)))
+    ctrl = HorizontalPodAutoscalerController(client, factory, sync_period=0.1)
+    await ctrl.start()
+    try:
+        def scaled():
+            d = reg.get("deployments", "default", "web")
+            h = reg.get("horizontalpodautoscalers", "default", "hpa")
+            return d.spec.replicas == 4 and h.status.desired_replicas == 4
+        await wait_for(scaled)
+    finally:
+        await ctrl.stop()
+        await factory.stop_all()
+
+
+async def test_pdb_status_allows_disruptions():
+    reg, client, factory = make_plane()
+    for i in range(3):
+        pod = mk_pod(f"p{i}", {"app": "train"})
+        reg.create(pod)
+        mark_ready(reg, reg.get("pods", "default", f"p{i}"))
+    reg.create(w.PodDisruptionBudget(
+        metadata=ObjectMeta(name="pdb", namespace="default"),
+        spec=w.PodDisruptionBudgetSpec(
+            min_available=2,
+            selector=LabelSelector(match_labels={"app": "train"}))))
+    ctrl = DisruptionController(client, factory)
+    await ctrl.start()
+    try:
+        def status_ok():
+            pdb = reg.get("poddisruptionbudgets", "default", "pdb")
+            return (pdb.status.expected_pods == 3
+                    and pdb.status.current_healthy == 3
+                    and pdb.status.desired_healthy == 2
+                    and pdb.status.disruptions_allowed == 1)
+        await wait_for(status_ok)
+    finally:
+        await ctrl.stop()
+        await factory.stop_all()

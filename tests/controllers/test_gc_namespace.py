@@ -1,0 +1,111 @@
+"""Garbage collector cascade + namespace drain."""
+import pytest
+
+from kubernetes_tpu.api import errors
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api import workloads as w
+from kubernetes_tpu.api.meta import ObjectMeta, controller_ref
+from kubernetes_tpu.controllers.garbagecollector import GarbageCollector
+from kubernetes_tpu.controllers.namespace import NamespaceController
+
+from kubernetes_tpu.api.selectors import LabelSelector
+
+from .util import make_plane, pod_template, wait_for
+
+
+def mk_dep(name):
+    return w.Deployment(
+        metadata=ObjectMeta(name=name, namespace="default"),
+        spec=w.DeploymentSpec(
+            replicas=1, selector=LabelSelector(match_labels={"app": name}),
+            template=pod_template({"app": name})))
+
+
+def mk_rs(name, owner):
+    return w.ReplicaSet(
+        metadata=ObjectMeta(
+            name=name, namespace="default",
+            owner_references=[controller_ref(owner, w.APPS_V1, "Deployment")]),
+        spec=w.ReplicaSetSpec(
+            replicas=0, selector=LabelSelector(match_labels={"app": name}),
+            template=pod_template({"app": name})))
+
+
+async def test_gc_deletes_orphaned_dependents_cascade():
+    reg, client, factory = make_plane()
+    gc = GarbageCollector(client, factory, interval=0.05)
+    await gc.start()
+    try:
+        dep = reg.create(mk_dep("d"))
+        rs = reg.create(mk_rs("d-abc", dep))
+        pod = t.Pod(metadata=ObjectMeta(
+            name="d-abc-1", namespace="default",
+            owner_references=[controller_ref(rs, w.APPS_V1, "ReplicaSet")]),
+            spec=t.PodSpec(containers=[t.Container(name="c", image="i")]))
+        reg.create(pod)
+
+        reg.delete("deployments", "default", "d")
+
+        def all_gone():
+            for plural, name in (("replicasets", "d-abc"),
+                                 ("pods", "d-abc-1")):
+                try:
+                    reg.get(plural, "default", name)
+                    return False
+                except errors.NotFoundError:
+                    continue
+            return True
+        await wait_for(all_gone, timeout=8.0)
+    finally:
+        await gc.stop()
+        await factory.stop_all()
+
+
+async def test_gc_keeps_objects_with_live_owner():
+    reg, client, factory = make_plane()
+    gc = GarbageCollector(client, factory, interval=0.05)
+    await gc.start()
+    try:
+        dep = reg.create(mk_dep("d"))
+        reg.create(mk_rs("d-abc", dep))
+        import asyncio
+        await asyncio.sleep(0.3)
+        assert reg.get("replicasets", "default", "d-abc") is not None
+    finally:
+        await gc.stop()
+        await factory.stop_all()
+
+
+async def test_namespace_delete_drains_contents():
+    reg, client, factory = make_plane()
+    reg.create(t.Namespace(metadata=ObjectMeta(name="team-a")))
+    reg.create(t.Pod(metadata=ObjectMeta(name="p", namespace="team-a"),
+                     spec=t.PodSpec(containers=[
+                         t.Container(name="c", image="i")])))
+    reg.create(t.ConfigMap(metadata=ObjectMeta(name="cm", namespace="team-a"),
+                           data={"k": "v"}))
+    nc = NamespaceController(client, factory)
+    await nc.start()
+    try:
+        reg.delete("namespaces", "", "team-a")
+        # Terminating, not gone, until drained.
+        got = reg.get("namespaces", "", "team-a")
+        assert got.status.phase == t.NS_TERMINATING
+
+        def fully_gone():
+            try:
+                reg.get("namespaces", "", "team-a")
+                return False
+            except errors.NotFoundError:
+                pass
+            for plural, name in (("pods", "p"), ("configmaps", "cm")):
+                try:
+                    reg.get(plural, "team-a", name)
+                    return False
+                except errors.NotFoundError:
+                    continue
+            return True
+        await wait_for(fully_gone, timeout=8.0)
+    finally:
+        await nc.stop()
+        await factory.stop_all()
