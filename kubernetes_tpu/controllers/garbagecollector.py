@@ -27,6 +27,18 @@ DEFAULT_WATCHED = [
     "resourcequotas", "limitranges", "leases", "nodes", "namespaces",
 ]
 
+_KIND_TO_PLURAL: dict[str, str] = {}
+
+
+def _plural_by_kind() -> dict[str, str]:
+    """kind -> plural, derived from the registry's resource specs (the
+    same table ``client.rest`` builds) rather than naive pluralization."""
+    if not _KIND_TO_PLURAL:
+        from ..apiserver.registry import builtin_resources
+        for spec in builtin_resources():
+            _KIND_TO_PLURAL[spec.kind] = spec.plural
+    return _KIND_TO_PLURAL
+
 
 class GarbageCollector(Controller):
     name = "garbage-collector"
@@ -73,6 +85,29 @@ class GarbageCollector(Controller):
                     uids.add(obj.metadata.uid)
         return uids
 
+    async def _owner_alive(self, ref, namespace: str) -> bool:
+        """Live-read an owner ref against the API (quorum read).
+
+        Informer caches across resources have no ordering guarantee — a
+        dependent can land in the pods cache before its just-created
+        owner reaches the owners' cache. The reference's
+        ``attemptToDeleteItem`` confirms absence with a live read before
+        deleting; do the same here.
+        """
+        plural = _plural_by_kind().get(ref.kind)
+        if plural is None or plural not in self._informers_by_plural:
+            return True  # unknown kind: never cascade on it
+        try:
+            owner = await self.client.get(plural, namespace, ref.name)
+        except errors.NotFoundError:
+            return False
+        except Exception:  # noqa: BLE001 — transport/5xx/bad-ref errors
+            # must not wedge the sweep; be conservative and keep the
+            # dependent until a later pass can confirm.
+            return True
+        return (owner.metadata.uid == ref.uid
+                and owner.metadata.deletion_timestamp is None)
+
     async def sweep_once(self) -> None:
         live = self._live_uids()
         for plural, inf in self._informers_by_plural.items():
@@ -84,8 +119,20 @@ class GarbageCollector(Controller):
                 # are ALL gone is garbage (reference: attemptToDeleteItem).
                 if any(ref.uid in live for ref in refs):
                     continue
+                # Caches say every owner is gone — confirm against the
+                # API before acting on possibly-stale caches.
+                confirmed_gone = True
+                for ref in refs:
+                    if await self._owner_alive(ref, obj.metadata.namespace):
+                        confirmed_gone = False
+                        break
+                if not confirmed_gone:
+                    continue
                 try:
+                    # uid precondition: a recreated same-name object with
+                    # a live owner must not be collected off stale cache.
                     await self.client.delete(plural, obj.metadata.namespace,
-                                             obj.metadata.name)
+                                             obj.metadata.name,
+                                             uid=obj.metadata.uid)
                 except (errors.NotFoundError, errors.ConflictError):
                     pass

@@ -75,6 +75,7 @@ class HorizontalPodAutoscalerController(Controller):
         current = target.spec.replicas
         selector = target.spec.selector
         utils = []
+        matched = 0
         for pod in self.pod_informer.list():
             if pod.metadata.namespace != hpa.metadata.namespace:
                 continue
@@ -83,15 +84,38 @@ class HorizontalPodAutoscalerController(Controller):
                 continue
             if not is_pod_active(pod):
                 continue
+            matched += 1
             u = self.metrics(pod)
             if u is not None:
                 utils.append(u)
         if not utils or current == 0:
             return self.sync_period
+        target_util = max(hpa.spec.target_cpu_utilization_percentage, 1)
         avg = sum(utils) / len(utils)
-        ratio = avg / max(hpa.spec.target_cpu_utilization_percentage, 1)
-        desired = current if abs(ratio - 1.0) <= TOLERANCE else math.ceil(
-            current * ratio)
+        ratio = avg / target_util
+        # Reference replica_calculator.go:122 GetResourceReplicas:
+        # desired = ceil(usageRatio * measuredPodCount) — NOT
+        # spec.replicas, which compounds the ratio while actual pods lag
+        # desired and runs away to max. Pods without metrics are folded
+        # back in conservatively: assumed 0% when scaling up and at
+        # target when scaling down, so freshly-created pods that haven't
+        # reported yet can't trigger a spurious scale-down (or amplify a
+        # scale-up).
+        missing = max(matched - len(utils), 0)
+        if abs(ratio - 1.0) <= TOLERANCE:
+            desired = current
+        elif missing == 0:
+            desired = math.ceil(len(utils) * ratio)
+        else:
+            assumed = 0.0 if ratio > 1.0 else float(target_util)
+            total_pods = len(utils) + missing
+            new_ratio = ((sum(utils) + assumed * missing)
+                         / (total_pods * target_util))
+            if abs(new_ratio - 1.0) <= TOLERANCE or \
+                    (new_ratio > 1.0) != (ratio > 1.0):
+                desired = current
+            else:
+                desired = math.ceil(total_pods * new_ratio)
         desired = max(hpa.spec.min_replicas, min(hpa.spec.max_replicas,
                                                  desired))
         if desired != current:

@@ -46,10 +46,14 @@ class NodeLifecycleController(Controller):
         self.node_informer = self.watch("nodes")
         self.pod_informer = self.watch("pods")
         self.lease_informer = self.watch("leases")
-        # Taint-manager reactions: pods on freshly tainted nodes.
+        # Taint-manager reactions: pods on freshly tainted nodes. Node
+        # status heartbeats arrive every few seconds per node, so only
+        # react when the NoExecute taint set actually changed —
+        # otherwise this is O(nodes * pods) steady-state churn
+        # (reference taint manager diffs taints the same way).
         self.node_informer.add_handlers(
             on_add=lambda n: self._enqueue_node_pods(n),
-            on_update=lambda o, n: self._enqueue_node_pods(n))
+            on_update=self._on_node_update)
         self.pod_informer.add_handlers(
             on_add=lambda p: self.enqueue(f"pod/{p.key()}"),
             on_update=lambda o, n: self.enqueue(f"pod/{n.key()}"))
@@ -74,6 +78,15 @@ class NodeLifecycleController(Controller):
             task.cancel()
         self._evictions.clear()
         await super().stop()
+
+    @staticmethod
+    def _no_execute_taints(node: t.Node) -> set[tuple[str, str]]:
+        return {(taint.key, taint.value) for taint in node.spec.taints
+                if taint.effect == "NoExecute"}
+
+    def _on_node_update(self, old: t.Node, new: t.Node) -> None:
+        if self._no_execute_taints(old) != self._no_execute_taints(new):
+            self._enqueue_node_pods(new)
 
     def _enqueue_node_pods(self, node: t.Node) -> None:
         for pod in self.pod_informer.list():
@@ -120,7 +133,11 @@ class NodeLifecycleController(Controller):
                 await self._set_taints(node, unreachable=True)
             elif ready is not None and ready.status == "False":
                 await self._set_taints(node, not_ready=True)
-            elif ready is not None and ready.status == "True":
+            else:
+                # Fresh heartbeat with Ready True, Unknown, or absent
+                # (e.g. lease renewals resumed before the agent reposted
+                # status): clear lifecycle taints unconditionally so a
+                # healthy node stops evicting pods.
                 await self._set_taints(node)
 
     async def _mark_unknown(self, node: t.Node) -> None:

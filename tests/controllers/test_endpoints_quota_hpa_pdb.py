@@ -1,4 +1,6 @@
 """Endpoints, ResourceQuota, HPA, and PDB controllers."""
+import asyncio
+
 from kubernetes_tpu.api import types as t
 from kubernetes_tpu.api import workloads as w
 from kubernetes_tpu.api.meta import ObjectMeta
@@ -115,6 +117,43 @@ async def test_hpa_scales_deployment_up():
             h = reg.get("horizontalpodautoscalers", "default", "hpa")
             return d.spec.replicas == 4 and h.status.desired_replicas == 4
         await wait_for(scaled)
+    finally:
+        await ctrl.stop()
+        await factory.stop_all()
+
+
+async def test_hpa_missing_metrics_damps_scale_down():
+    """Pods without metrics are assumed at-target on scale-down
+    (reference replica_calculator rebalance): 2 measured at 40%/80% +
+    2 unreported pods -> no spurious halving of the deployment."""
+    reg, client, factory = make_plane()
+    dep = w.Deployment(
+        metadata=ObjectMeta(name="web", namespace="default"),
+        spec=w.DeploymentSpec(
+            replicas=4, selector=LabelSelector(match_labels={"app": "web"}),
+            template=pod_template({"app": "web"})))
+    reg.create(dep)
+    reg.create(mk_pod("p1", {"app": "web"}, util=40))
+    reg.create(mk_pod("p2", {"app": "web"}, util=40))
+    reg.create(mk_pod("p3", {"app": "web"}))  # no metrics yet
+    reg.create(mk_pod("p4", {"app": "web"}))  # no metrics yet
+    reg.create(w.HorizontalPodAutoscaler(
+        metadata=ObjectMeta(name="hpa", namespace="default"),
+        spec=w.HorizontalPodAutoscalerSpec(
+            scale_target_ref=w.CrossVersionObjectReference(
+                kind="Deployment", name="web"),
+            min_replicas=1, max_replicas=8,
+            target_cpu_utilization_percentage=80)))
+    ctrl = HorizontalPodAutoscalerController(client, factory, sync_period=0.1)
+    await ctrl.start()
+    try:
+        # folded ratio = (40+40+80+80)/(4*80) = 0.75 -> desired 3, not 2.
+        def scaled():
+            d = reg.get("deployments", "default", "web")
+            return d.spec.replicas == 3
+        await wait_for(scaled)
+        await asyncio.sleep(0.4)
+        assert reg.get("deployments", "default", "web").spec.replicas == 3
     finally:
         await ctrl.stop()
         await factory.stop_all()
