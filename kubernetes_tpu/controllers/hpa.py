@@ -116,6 +116,12 @@ class HorizontalPodAutoscalerController(Controller):
                 desired = current
             else:
                 desired = math.ceil(total_pods * new_ratio)
+        # Never scale DOWN on an over-target signal: while actual pods
+        # lag spec.replicas (controller still creating them), the
+        # measured count alone would shrink an overloaded workload (the
+        # reference gates this with a downscale-stabilization window).
+        if ratio > 1.0 + TOLERANCE:
+            desired = max(desired, current)
         desired = max(hpa.spec.min_replicas, min(hpa.spec.max_replicas,
                                                  desired))
         if desired != current:

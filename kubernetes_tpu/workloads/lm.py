@@ -37,6 +37,12 @@ class LMConfig:
     rope_base: float = 10_000.0
     param_dtype: Any = jnp.float32
     compute_dtype: Any = jnp.bfloat16
+    #: Rematerialize each layer in backward (``jax.checkpoint``): the
+    #: scan otherwise saves every layer's [B,H,T,T] attention scores as
+    #: residuals, which is O(L*T^2) HBM and OOMs a single chip at
+    #: realistic sizes; recomputing trades ~1/3 more FLOPs for O(L*T)
+    #: residuals — the standard TPU memory/compute trade.
+    remat: bool = True
 
     @property
     def head_dim(self) -> int:
@@ -132,7 +138,8 @@ def forward(params: dict, tokens, cfg: LMConfig, mesh) -> jax.Array:
         x = x + lax.with_sharding_constraint(gate @ lp["w2"].astype(cdt), act)
         return x, None
 
-    x, _ = lax.scan(layer, x, params["layers"])
+    body = jax.checkpoint(layer) if cfg.remat else layer
+    x, _ = lax.scan(body, x, params["layers"])
     x = _rms_norm(x, params["ln_f"].astype(cdt))
     return (x @ params["embed"].astype(cdt).T).astype(jnp.float32)
 
