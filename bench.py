@@ -23,14 +23,19 @@ from kubernetes_tpu.perf.density import run_density  # noqa: E402
 
 
 def main() -> None:
-    sched = asyncio.run(run_density(n_nodes=100, n_pods=3000))
-    sched_line = {
-        "metric": "scheduler_pod_throughput",
-        "value": sched["pods_per_second"],
-        "unit": "pods/s",
-        "vs_baseline": round(sched["pods_per_second"] / 8.0, 2),
-        "detail": sched,
-    }
+    try:
+        sched = asyncio.run(run_density(n_nodes=100, n_pods=3000))
+        sched_line = {
+            "metric": "scheduler_pod_throughput",
+            "value": sched["pods_per_second"],
+            "unit": "pods/s",
+            "vs_baseline": round(sched["pods_per_second"] / 8.0, 2),
+            "detail": sched,
+        }
+    except Exception as exc:  # noqa: BLE001 — never lose the TPU number
+        sched = {"error": str(exc)[:200]}
+        sched_line = {"metric": "scheduler_pod_throughput", "value": 0,
+                      "unit": "pods/s", "vs_baseline": 0, "detail": sched}
 
     try:
         from kubernetes_tpu.perf import chip_bench

@@ -1,0 +1,1 @@
+from .local import LocalCluster, LocalNode  # noqa: F401

@@ -1,0 +1,215 @@
+"""Single-process cluster — the hyperkube / local-up-cluster analog.
+
+Reference: ``cmd/hyperkube/`` (all components in one binary) and
+``hack/local-up-cluster.sh`` (compose apiserver + controller-manager +
+scheduler + kubelet on one machine). Here one asyncio process runs:
+
+- MVCC store (optionally durable under ``data_dir``) + registry +
+  HTTP apiserver;
+- scheduler and controller-manager over the in-process client (same
+  trick as hyperkube: co-located components skip the network);
+- N node agents over the **REST** client (they are logically remote,
+  so they exercise the real HTTP/watch path), each with a
+  ProcessRuntime (pods are real OS processes) or FakeRuntime, a
+  device manager, and a TPU device plugin (stub mesh, or the real
+  hardware plugin probing via jax/libtpu).
+
+This is what ``ktl up`` runs, what the real-TPU e2e drives, and the
+node half is what kubemark-style hollow fleets reuse.
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import tempfile
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..api import errors, types as t
+from ..api.meta import ObjectMeta
+from ..apiserver.admission import default_chain
+from ..apiserver.registry import Registry
+from ..apiserver.server import APIServer
+from ..client.local import LocalClient
+from ..client.rest import RESTClient
+from ..controllers.manager import ControllerManager
+from ..deviceplugin.stub import StubTpuPlugin, make_topology
+from ..node.agent import NodeAgent
+from ..node.devicemanager import DeviceManager
+from ..node.runtime import FakeRuntime, ProcessRuntime
+from ..scheduler.scheduler import Scheduler
+from ..storage.mvcc import MVCCStore
+
+log = logging.getLogger("cluster")
+
+
+@dataclass
+class LocalNode:
+    """One node agent + its runtime + device plugin, inside the cluster
+    process."""
+    name: str
+    agent: NodeAgent
+    runtime: object
+    client: RESTClient
+    plugin: Optional[StubTpuPlugin] = None
+    device_manager: Optional[DeviceManager] = None
+
+    async def stop(self) -> None:
+        await self.agent.stop()
+        if self.plugin is not None:
+            self.plugin.stop()
+        if isinstance(self.runtime, ProcessRuntime):
+            await self.runtime.shutdown()
+        await self.client.close()
+
+
+@dataclass
+class NodeSpec:
+    """How to build one node. ``tpu_chips > 0`` serves a stub plugin
+    with that many chips; ``real_tpu`` probes the actual hardware."""
+    name: str = ""
+    tpu_chips: int = 0
+    mesh_shape: Optional[tuple] = None
+    real_tpu: bool = False
+    fake_runtime: bool = False
+    capacity: dict = field(default_factory=dict)
+    labels: dict = field(default_factory=dict)
+
+
+class LocalCluster:
+    def __init__(self, data_dir: Optional[str] = None,
+                 nodes: Optional[list[NodeSpec]] = None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 tokens: Optional[dict[str, str]] = None,
+                 durable: bool = False,
+                 status_interval: float = 10.0,
+                 heartbeat_interval: float = 5.0):
+        self.data_dir = data_dir or tempfile.mkdtemp(prefix="ktpu-cluster-")
+        self.node_specs = nodes if nodes is not None else [NodeSpec(name="node-0")]
+        self.host = host
+        self._port = port
+        self.tokens = tokens
+        self.durable = durable
+        self.status_interval = status_interval
+        self.heartbeat_interval = heartbeat_interval
+
+        self.registry: Optional[Registry] = None
+        self.server: Optional[APIServer] = None
+        self.scheduler: Optional[Scheduler] = None
+        self.controller_manager: Optional[ControllerManager] = None
+        self.nodes: list[LocalNode] = []
+        self.base_url = ""
+
+    # -- composition -------------------------------------------------------
+
+    async def start(self) -> str:
+        store = MVCCStore(os.path.join(self.data_dir, "state")
+                          if self.durable else None)
+        self.registry = Registry(store=store)
+        self.registry.admission = default_chain(self.registry)
+        local = LocalClient(self.registry)
+        for ns in ("default", "kube-system"):
+            try:
+                self.registry.create(t.Namespace(metadata=ObjectMeta(name=ns)))
+            except errors.AlreadyExistsError:
+                pass  # durable restart
+
+        self.server = APIServer(self.registry, tokens=self.tokens)
+        port = await self.server.start(self.host, self._port)
+        self.base_url = f"http://{self.host}:{port}"
+
+        self.scheduler = Scheduler(local)
+        await self.scheduler.start()
+        self.controller_manager = ControllerManager(local)
+        await self.controller_manager.start()
+
+        for i, spec in enumerate(self.node_specs):
+            self.nodes.append(await self._start_node(spec, i))
+        log.info("cluster up at %s with %d nodes", self.base_url, len(self.nodes))
+        return self.base_url
+
+    async def _start_node(self, spec: NodeSpec, index: int) -> LocalNode:
+        name = spec.name or f"node-{index}"
+        node_dir = os.path.join(self.data_dir, "nodes", name)
+        token = next(iter(self.tokens), "") if self.tokens else ""
+        client = RESTClient(self.base_url, token=token)
+
+        plugin: Optional[StubTpuPlugin] = None
+        device_manager: Optional[DeviceManager] = None
+        if spec.real_tpu or spec.tpu_chips:
+            plugin_dir = os.path.join(node_dir, "device-plugins")
+            if spec.real_tpu:
+                from ..deviceplugin.tpu_plugin import TpuDevicePlugin
+                plugin = TpuDevicePlugin(slice_id=f"slice-{name}")
+            else:
+                chips = spec.tpu_chips
+                shape = spec.mesh_shape or (
+                    (2, 2, chips // 4) if chips % 4 == 0 else (chips, 1, 1))
+                plugin = StubTpuPlugin(make_topology(
+                    mesh_shape=tuple(shape), slice_id=f"slice-{name}",
+                    id_prefix=f"{name}-chip"))
+            plugin.serve(os.path.join(plugin_dir, "tpu.sock"))
+            device_manager = DeviceManager(plugin_dir, poll_interval=0.2)
+
+        runtime = (FakeRuntime() if spec.fake_runtime
+                   else ProcessRuntime(node_dir))
+        agent = NodeAgent(
+            client, name, runtime, device_manager=device_manager,
+            capacity=dict(spec.capacity) or None, labels=dict(spec.labels),
+            status_interval=self.status_interval,
+            heartbeat_interval=self.heartbeat_interval)
+        await agent.start()
+        return LocalNode(name=name, agent=agent, runtime=runtime,
+                         client=client, plugin=plugin,
+                         device_manager=device_manager)
+
+    async def add_node(self, spec: NodeSpec) -> LocalNode:
+        node = await self._start_node(spec, len(self.nodes))
+        self.nodes.append(node)
+        return node
+
+    async def stop(self) -> None:
+        for node in self.nodes:
+            try:
+                await node.stop()
+            except Exception:  # noqa: BLE001
+                log.exception("node %s stop failed", node.name)
+        self.nodes = []
+        if self.controller_manager:
+            await self.controller_manager.stop()
+        if self.scheduler:
+            await self.scheduler.stop()
+        if self.server:
+            await self.server.stop()
+        if self.registry and self.durable:
+            self.registry.store.snapshot()
+
+    # -- conveniences ------------------------------------------------------
+
+    def local_client(self) -> LocalClient:
+        assert self.registry is not None
+        return LocalClient(self.registry)
+
+    async def wait_for_nodes_ready(self, timeout: float = 30.0) -> None:
+        """Block until every node object is Ready with its TPU capacity
+        (if any) published."""
+        client = self.local_client()
+        deadline = asyncio.get_running_loop().time() + timeout
+        want_tpu = {self.node_specs[i].name or f"node-{i}"
+                    for i in range(len(self.node_specs))
+                    if self.node_specs[i].real_tpu or self.node_specs[i].tpu_chips}
+        while True:
+            nodes, _ = await client.list("nodes")
+            ready = {}
+            for node in nodes:
+                cond = t.get_node_condition(node.status, t.NODE_READY)
+                ok = cond is not None and cond.status == "True"
+                if ok and node.metadata.name in want_tpu:
+                    ok = node.status.capacity.get(t.RESOURCE_TPU, 0) > 0
+                ready[node.metadata.name] = ok
+            if len(ready) >= len(self.node_specs) and all(ready.values()):
+                return
+            if asyncio.get_running_loop().time() > deadline:
+                raise TimeoutError(f"nodes not ready after {timeout}s: {ready}")
+            await asyncio.sleep(0.2)

@@ -1,0 +1,178 @@
+"""Real TPU device plugin — enumerates the host's actual chips.
+
+TPU-native analog of the out-of-tree nvidia-gpu-device-plugin the
+reference deploys (``cluster/addons/device-plugins/nvidia-gpu/
+daemonset.yaml:39-41``) serving the device-plugin gRPC service
+(``pkg/kubelet/apis/deviceplugin/v1alpha/api.proto:17-31``) over NVML.
+
+Design difference forced by the hardware: NVML is a side-channel query
+library, but libtpu is the *compute* runtime and a chip is owned by one
+process. A plugin that imported jax/libtpu in-process would hold the
+very chips its pods need. So enumeration runs in a short-lived probe
+subprocess (crash-isolated, like the reference's dlopen shim keeps NVML
+faults out of the kubelet — ``vendor/github.com/mindprince/gonvml/
+bindings.go:19-30``), and the plugin process itself never initializes a
+TPU backend.
+
+``InitContainer`` injects the env a JAX workload needs to find its
+assigned chips (the analog of the NVIDIA runtime's device injection):
+``JAX_PLATFORMS`` (the platform spec the probe validated),
+``TPU_VISIBLE_DEVICES``/``TPU_VISIBLE_CHIPS`` and topology env.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+from typing import Optional
+
+from . import api_pb2 as pb
+from .stub import StubTpuPlugin
+
+RESOURCE_TPU = "google.com/tpu"
+
+#: Runs under the *real* platform env; prints one JSON line.
+_PROBE_SRC = r"""
+import json, sys
+try:
+    import jax
+    devices = jax.local_devices()
+    backend = jax.default_backend()
+except Exception as e:  # noqa: BLE001
+    print(json.dumps({"tpu": False, "error": str(e)}))
+    sys.exit(0)
+if backend != "tpu" or not devices:
+    print(json.dumps({"tpu": False, "backend": backend}))
+    sys.exit(0)
+out = {"tpu": True, "backend": backend,
+       "process_index": devices[0].process_index, "devices": []}
+for d in devices:
+    coords = list(getattr(d, "coords", None) or (d.id, 0, 0))
+    out["devices"].append({
+        "index": d.id,
+        "kind": d.device_kind,
+        "coords": coords,
+        "core_on_chip": getattr(d, "core_on_chip", 0),
+    })
+print(json.dumps(out))
+"""
+
+
+def _probe_env() -> dict[str, str]:
+    """The env the probe (and TPU pods) should run under: the session's
+    real platform spec, undoing any test-harness CPU forcing."""
+    env = dict(os.environ)
+    orig = env.pop("KTPU_JAX_PLATFORMS_ORIG", None)
+    if env.get("JAX_PLATFORMS") == "cpu":
+        if orig:
+            env["JAX_PLATFORMS"] = orig
+        else:
+            env.pop("JAX_PLATFORMS", None)
+    env.pop("XLA_FLAGS", None)  # virtual-device forcing breaks real probes
+    return env
+
+
+def detect_topology(timeout: float = 120.0) -> Optional[dict]:
+    """Probe the host's TPUs in a subprocess. Returns the probe dict or
+    None when the host has no usable TPU (or the probe crashed)."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _PROBE_SRC], env=_probe_env(),
+            capture_output=True, text=True, timeout=timeout)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    line = proc.stdout.strip().splitlines()
+    if not line:
+        return None
+    try:
+        probe = json.loads(line[-1])
+    except json.JSONDecodeError:
+        return None
+    return probe if probe.get("tpu") else None
+
+
+def _chip_type_of(kind: str) -> str:
+    """'TPU v5 lite' -> 'v5e', 'TPU v5p chip' -> 'v5p', else slug."""
+    k = kind.lower()
+    if "v5 lite" in k or "v5e" in k:
+        return "v5e"
+    for tag in ("v5p", "v4", "v3", "v2", "v6e", "v6"):
+        if tag in k:
+            return tag
+    return kind.replace(" ", "-").lower()
+
+
+def topology_from_probe(probe: dict, slice_id: str = "",
+                        id_prefix: str = "tpu") -> pb.TopologyUpdate:
+    devices = probe["devices"]
+    dims = 3
+    bounds = [1] * dims
+    for d in devices:
+        for i, c in enumerate(d["coords"][:dims]):
+            bounds[i] = max(bounds[i], c + 1)
+    update = pb.TopologyUpdate(
+        chip_type=_chip_type_of(devices[0]["kind"]),
+        slice_id=slice_id or f"slice-{os.uname().nodename}",
+        mesh_shape=bounds,
+        worker_index=int(probe.get("process_index", 0)))
+    for d in devices:
+        update.chips.add(
+            id=f"{id_prefix}-{d['index']}", health="Healthy",
+            coords=list(d["coords"][:dims]),
+            attributes={"chip_type": update.chip_type,
+                        "device_kind": d["kind"],
+                        "device_index": str(d["index"])})
+    return update
+
+
+class TpuDevicePlugin(StubTpuPlugin):
+    """The production plugin: real topology from the probe, and
+    InitContainer env that points a JAX pod at its assigned chips."""
+
+    def __init__(self, probe: Optional[dict] = None,
+                 resource: str = RESOURCE_TPU, slice_id: str = ""):
+        probe = probe or detect_topology()
+        if probe is None:
+            raise RuntimeError("no TPU found on this host (probe failed)")
+        super().__init__(topology_from_probe(probe, slice_id=slice_id),
+                         resource=resource)
+        self._probe = probe
+        self._platform_spec = _probe_env().get("JAX_PLATFORMS", "")
+
+    def InitContainer(self, request, context) -> pb.InitContainerResponse:
+        resp = super().InitContainer(request, context)
+        index_of = {c.id: c.attributes.get("device_index", "")
+                    for c in self._topology.chips}
+        indices = [index_of[cid] for cid in request.chip_ids if cid in index_of]
+        resp.envs["TPU_VISIBLE_DEVICES"] = ",".join(indices)
+        if self._platform_spec:
+            resp.envs["JAX_PLATFORMS"] = self._platform_spec
+        else:
+            # Pods under a CPU-forced harness must still see the chip.
+            resp.envs["JAX_PLATFORMS"] = ""
+        return resp
+
+
+def main() -> None:
+    """Run the plugin standalone against a node agent's plugin dir:
+    ``python -m kubernetes_tpu.deviceplugin.tpu_plugin <plugin-dir>``."""
+    import signal
+    import threading
+
+    plugin_dir = sys.argv[1] if len(sys.argv) > 1 else "/var/lib/ktpu/device-plugins"
+    plugin = TpuDevicePlugin()
+    sock = os.path.join(plugin_dir, "tpu.sock")
+    plugin.serve(sock)
+    print(json.dumps({"serving": sock,
+                      "chips": len(plugin._topology.chips),
+                      "chip_type": plugin._topology.chip_type}), flush=True)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    stop.wait()
+    plugin.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -23,26 +23,30 @@ import dataclasses
 import time
 from typing import Optional
 
-#: Peak dense bf16 FLOP/s by device_kind substring (public TPU specs).
+#: (substring, peak dense bf16 FLOP/s per chip, jax devices per chip).
+#: On v2/v3 each jax.devices() entry is one TensorCore (2 per chip);
+#: from v4 on, one device == one chip (public TPU specs).
 PEAK_BF16 = [
-    ("v5 lite", 197e12),   # v5e
-    ("v5e", 197e12),
-    ("v5p", 459e12),
-    ("v5", 459e12),
-    ("v4", 275e12),
-    ("v3", 123e12),
-    ("v2", 46e12),
+    ("v5 lite", 197e12, 1),   # v5e
+    ("v5e", 197e12, 1),
+    ("v5p", 459e12, 1),
+    ("v5", 459e12, 1),
+    ("v4", 275e12, 1),
+    ("v3", 123e12, 2),
+    ("v2", 46e12, 2),
 ]
 DEFAULT_PEAK = 197e12
 
 
 def peak_flops_for(device_kind: str) -> tuple[float, bool]:
-    """(peak bf16 FLOP/s, known) — ``known=False`` means the fallback
-    guess was used and reported MFU must be flagged, not trusted."""
+    """(peak bf16 FLOP/s *per jax device*, known) — ``known=False``
+    means the fallback guess was used and reported MFU must be flagged,
+    not trusted. Dividing by devices-per-chip keeps MFU honest on
+    v2/v3 where one device is half a chip."""
     kind = device_kind.lower()
-    for sub, peak in PEAK_BF16:
+    for sub, peak, devs_per_chip in PEAK_BF16:
         if sub in kind:
-            return peak, True
+            return peak / devs_per_chip, True
     return DEFAULT_PEAK, False
 
 
@@ -59,7 +63,7 @@ class BenchCase:
 
 
 CASES = [
-    BenchCase("lm-350m", d_model=1024, n_layers=8, n_heads=16, d_ff=4096,
+    BenchCase("lm-170m", d_model=1024, n_layers=8, n_heads=16, d_ff=4096,
               vocab=32768, batch=8, seq=1024),
     BenchCase("lm-600m", d_model=2048, n_layers=8, n_heads=16, d_ff=8192,
               vocab=32768, batch=4, seq=2048),
@@ -91,7 +95,7 @@ def run_case(case: BenchCase, steps: int = 10, warmup: int = 2) -> dict:
     # serialized, so fetching the last step's loss bounds all steps).
     # First timed trial after warmup is still slow (tunnel pipeline
     # fill), so run a few trials and keep the best.
-    for _ in range(warmup):
+    for _ in range(max(warmup, 1)):  # >=1: `loss` seeds the first sync
         params, opt_state, loss = step(params, opt_state, batch)
     float(loss)
     best_dt = float("inf")

@@ -16,6 +16,8 @@ import sys
 # (JAX_PLATFORMS=axon): the suite needs 8 virtual devices. Env alone is
 # not enough if a pytest plugin imported jax first — config.update
 # overrides as long as no backend is initialized yet.
+os.environ.setdefault("KTPU_JAX_PLATFORMS_ORIG",
+                      os.environ.get("JAX_PLATFORMS", ""))
 os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax  # noqa: E402

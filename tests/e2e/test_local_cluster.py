@@ -1,0 +1,113 @@
+"""Full-stack e2e over the single-process cluster: HTTP apiserver +
+scheduler + controller-manager + node agents on REST clients.
+
+Reference tier: ``test/e2e/`` run against a local-up cluster
+(``hack/local-up-cluster.sh``); the TPU pod flow mirrors
+``test/e2e/scheduling/nvidia-gpus.go`` with the stub plugin standing in
+for hardware."""
+import asyncio
+import sys
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.api.selectors import LabelSelector
+from kubernetes_tpu.api.workloads import Deployment, DeploymentSpec
+from kubernetes_tpu.client.rest import RESTClient
+from kubernetes_tpu.cluster import LocalCluster
+from kubernetes_tpu.cluster.local import NodeSpec
+
+
+async def wait_for(fn, timeout=30.0, interval=0.1):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while asyncio.get_running_loop().time() < deadline:
+        result = fn() if not asyncio.iscoroutinefunction(fn) else await fn()
+        if asyncio.iscoroutine(result):
+            result = await result
+        if result:
+            return result
+        await asyncio.sleep(interval)
+    raise TimeoutError("condition not met")
+
+
+def fast_cluster(tmp_path, nodes):
+    return LocalCluster(data_dir=str(tmp_path), nodes=nodes,
+                        status_interval=0.3, heartbeat_interval=0.3)
+
+
+async def test_tpu_pod_end_to_end_over_http(tmp_path):
+    """Pod requesting 2 chips: create via REST -> scheduler assigns chip
+    IDs -> agent admits via plugin -> ProcessRuntime runs it with the
+    plugin's env -> Succeeded."""
+    cluster = fast_cluster(tmp_path, [
+        NodeSpec(name="cpu-0"),
+        NodeSpec(name="tpu-0", tpu_chips=4),
+    ])
+    await cluster.start()
+    client = RESTClient(cluster.base_url)
+    try:
+        await cluster.wait_for_nodes_ready(timeout=20)
+        pod = t.Pod(
+            metadata=ObjectMeta(name="tpu-smoke", namespace="default"),
+            spec=t.PodSpec(
+                restart_policy="Never",
+                containers=[t.Container(
+                    name="main", image="inline",
+                    command=[sys.executable, "-c",
+                             "import os; print('chips:', os.environ['TPU_VISIBLE_CHIPS'])"],
+                    tpu_requests=["tpu"])],
+                tpu_resources=[t.PodTpuRequest(name="tpu", chips=2)]))
+        await client.create(pod)
+
+        async def succeeded():
+            got = await client.get("pods", "default", "tpu-smoke")
+            return got if got.status.phase == t.POD_SUCCEEDED else None
+        final = await wait_for(succeeded, timeout=40)
+
+        assert final.spec.node_name == "tpu-0"
+        assigned = final.spec.tpu_resources[0].assigned
+        assert len(assigned) == 2
+        cid = final.status.container_statuses[0].container_id
+        node = next(n for n in cluster.nodes if n.name == "tpu-0")
+        logs = await node.runtime.container_logs(cid)
+        for chip in assigned:
+            assert chip in logs
+    finally:
+        await client.close()
+        await cluster.stop()
+
+
+async def test_deployment_reconciles_over_http(tmp_path):
+    """Deployment -> ReplicaSet -> pods scheduled and Running across the
+    full HTTP stack, then scaled down."""
+    cluster = fast_cluster(tmp_path, [NodeSpec(name="w-0"),
+                                      NodeSpec(name="w-1")])
+    await cluster.start()
+    client = RESTClient(cluster.base_url)
+    try:
+        await cluster.wait_for_nodes_ready(timeout=20)
+        dep = Deployment(
+            metadata=ObjectMeta(name="web", namespace="default"),
+            spec=DeploymentSpec(
+                replicas=3,
+                selector=LabelSelector(match_labels={"app": "web"}),
+                template=t.PodTemplateSpec(
+                    metadata=ObjectMeta(labels={"app": "web"}),
+                    spec=t.PodSpec(containers=[t.Container(
+                        name="main", image="inline",
+                        command=[sys.executable, "-c",
+                                 "import time; time.sleep(300)"])]))))
+        await client.create(dep)
+
+        async def n_running(n):
+            pods, _ = await client.list("pods", "default",
+                                        label_selector="app=web")
+            return len([p for p in pods
+                        if p.status.phase == t.POD_RUNNING]) == n
+        await wait_for(lambda: n_running(3), timeout=40)
+
+        await client.patch("deployments", "default", "web",
+                           {"spec": {"replicas": 1}})
+        await wait_for(lambda: n_running(1), timeout=40)
+    finally:
+        await client.close()
+        await cluster.stop()
