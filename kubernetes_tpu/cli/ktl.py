@@ -1,0 +1,514 @@
+"""ktl — the kubectl analog.
+
+Reference: ``pkg/kubectl/cmd/cmd.go:216 NewKubectlCommand`` (command
+tree) and ``pkg/kubectl/resource/builder.go:934`` (manifest -> typed
+objects via the scheme). Commands::
+
+    ktl up [--nodes N] [--tpu-chips N] [--real-tpu] [--durable] ...
+    ktl get <resource> [name] [-n ns] [-l sel] [-o wide|json|yaml]
+    ktl describe <resource> <name> [-n ns]
+    ktl apply -f file.yaml          (create-or-update, multi-doc)
+    ktl delete <resource> <name> | -f file.yaml
+    ktl logs <pod> [-c container] [--tail N] [-n ns]
+    ktl scale <resource> <name> --replicas N
+    ktl cordon/uncordon/drain <node>
+    ktl top [node]                  (summary-API scrape incl. chips)
+    ktl api-resources | version
+
+Server discovery: ``--server`` > ``$KTL_SERVER`` > the file written by
+``ktl up`` (``$KTL_CONFIG``, default ``~/.ktl/config``).
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import signal
+import sys
+from typing import Any, Optional
+
+from ..api import errors, types as t
+from ..api.scheme import DEFAULT_SCHEME, to_dict
+from ..client.rest import RESTClient
+from . import printers
+
+DEFAULT_CONFIG = os.path.expanduser(
+    os.environ.get("KTL_CONFIG", "~/.ktl/config"))
+
+#: Short aliases (kubectl's singular/abbreviated names).
+ALIASES = {
+    "pod": "pods", "po": "pods",
+    "node": "nodes", "no": "nodes",
+    "deployment": "deployments", "deploy": "deployments",
+    "replicaset": "replicasets", "rs": "replicasets",
+    "statefulset": "statefulsets", "sts": "statefulsets",
+    "daemonset": "daemonsets", "ds": "daemonsets",
+    "job": "jobs", "cronjob": "cronjobs", "cj": "cronjobs",
+    "service": "services", "svc": "services",
+    "namespace": "namespaces", "ns": "namespaces",
+    "configmap": "configmaps", "cm": "configmaps",
+    "secret": "secrets",
+    "podgroup": "podgroups", "pg": "podgroups",
+    "event": "events", "ev": "events",
+    "quota": "resourcequotas", "resourcequota": "resourcequotas",
+    "hpa": "horizontalpodautoscalers",
+    "pdb": "poddisruptionbudgets",
+    "endpoints": "endpoints", "ep": "endpoints",
+    "lease": "leases",
+}
+
+
+def resolve_plural(name: str) -> str:
+    return ALIASES.get(name, name)
+
+
+def load_server(args) -> str:
+    if getattr(args, "server", ""):
+        return args.server
+    if os.environ.get("KTL_SERVER"):
+        return os.environ["KTL_SERVER"]
+    try:
+        with open(DEFAULT_CONFIG) as f:
+            return json.load(f)["server"]
+    except (OSError, KeyError, json.JSONDecodeError):
+        pass
+    raise SystemExit("ktl: no server — run `ktl up`, set $KTL_SERVER, "
+                     "or pass --server URL")
+
+
+def make_client(args) -> RESTClient:
+    return RESTClient(load_server(args), token=os.environ.get("KTL_TOKEN", ""))
+
+
+# -- manifest loading (resource/builder.go analog) -------------------------
+
+def load_manifests(path: str) -> list[Any]:
+    import yaml
+    if path == "-":
+        raw = sys.stdin.read()
+    else:
+        with open(path) as f:
+            raw = f.read()
+    objs = []
+    for doc in yaml.safe_load_all(raw):
+        if not doc:
+            continue
+        if "kind" not in doc:
+            raise SystemExit(f"ktl: manifest document missing 'kind': {doc}")
+        if not doc.get("api_version") and not doc.get("apiVersion"):
+            # Friendly default: infer the group from the kind.
+            from ..client.rest import _BY_KIND, _BY_PLURAL
+            plural = _BY_KIND.get(doc["kind"])
+            if plural:
+                doc["api_version"] = _BY_PLURAL[plural][0]
+        objs.append(DEFAULT_SCHEME.decode(doc))
+    return objs
+
+
+# -- commands --------------------------------------------------------------
+
+async def cmd_get(args) -> int:
+    client = make_client(args)
+    try:
+        plural = resolve_plural(args.resource)
+        if args.name:
+            objs = [await client.get(plural, args.namespace, args.name)]
+        else:
+            objs, _ = await client.list(plural, args.namespace,
+                                        label_selector=args.selector)
+        if args.output == "json":
+            out = [to_dict(o) for o in objs]
+            print(json.dumps(out[0] if args.name else out, indent=2,
+                             default=str))
+        elif args.output == "yaml":
+            import yaml
+            out = [to_dict(o) for o in objs]
+            print(yaml.safe_dump(out[0] if args.name else out,
+                                 sort_keys=False))
+        else:
+            print(printers.print_objects(plural, objs,
+                                         wide=args.output == "wide"))
+        return 0
+    finally:
+        await client.close()
+
+
+async def cmd_describe(args) -> int:
+    client = make_client(args)
+    try:
+        obj = await client.get(resolve_plural(args.resource),
+                               args.namespace, args.name)
+        print(printers.describe(obj))
+        return 0
+    finally:
+        await client.close()
+
+
+async def cmd_apply(args) -> int:
+    client = make_client(args)
+    try:
+        for obj in load_manifests(args.filename):
+            if not obj.metadata.namespace and _namespaced(obj):
+                obj.metadata.namespace = args.namespace
+            kind = obj.kind or type(obj).__name__
+            try:
+                created = await client.create(obj)
+                print(f"{kind.lower()}/{created.metadata.name} created")
+            except errors.AlreadyExistsError:
+                plural = _plural_of(obj)
+                cur = await client.get(plural, obj.metadata.namespace,
+                                       obj.metadata.name)
+                obj.metadata.resource_version = cur.metadata.resource_version
+                obj.metadata.uid = cur.metadata.uid
+                updated = await client.update(obj)
+                print(f"{kind.lower()}/{updated.metadata.name} configured")
+        return 0
+    finally:
+        await client.close()
+
+
+def _plural_of(obj) -> str:
+    from ..client.rest import _BY_KIND
+    return _BY_KIND[DEFAULT_SCHEME.gvk_for(obj)[1]]
+
+
+def _namespaced(obj) -> bool:
+    from ..client.rest import _BY_PLURAL
+    return _BY_PLURAL[_plural_of(obj)][1]
+
+
+async def cmd_delete(args) -> int:
+    client = make_client(args)
+    try:
+        if args.filename:
+            for obj in load_manifests(args.filename):
+                ns = obj.metadata.namespace or args.namespace
+                plural = _plural_of(obj)
+                try:
+                    await client.delete(plural, ns if _namespaced(obj) else "",
+                                        obj.metadata.name)
+                    print(f"{obj.kind.lower()}/{obj.metadata.name} deleted")
+                except errors.NotFoundError:
+                    print(f"{obj.kind.lower()}/{obj.metadata.name} not found")
+            return 0
+        plural = resolve_plural(args.resource)
+        await client.delete(plural, args.namespace, args.name)
+        print(f"{plural}/{args.name} deleted")
+        return 0
+    finally:
+        await client.close()
+
+
+async def _node_daemon_base(client: RESTClient, node_name: str) -> Optional[str]:
+    """Resolve a node's agent server URL from DaemonEndpoints."""
+    node = await client.get("nodes", "", node_name)
+    port = node.status.daemon_endpoints.get("agent")
+    if not port:
+        return None
+    addr = node.status.addresses[0].address if node.status.addresses else ""
+    import aiohttp
+    for host in (addr, "127.0.0.1"):
+        if not host:
+            continue
+        base = f"http://{host}:{port}"
+        try:
+            async with aiohttp.ClientSession() as s:
+                async with s.get(f"{base}/healthz",
+                                 timeout=aiohttp.ClientTimeout(total=2)) as r:
+                    if r.status == 200:
+                        return base
+        except Exception:  # noqa: BLE001 — unresolvable hostname etc.
+            continue
+    return None
+
+
+async def cmd_logs(args) -> int:
+    client = make_client(args)
+    try:
+        pod = await client.get("pods", args.namespace, args.pod)
+        if not pod.spec.node_name:
+            raise SystemExit(f"ktl: pod {args.pod} is not scheduled yet")
+        base = await _node_daemon_base(client, pod.spec.node_name)
+        if base is None:
+            raise SystemExit(f"ktl: node {pod.spec.node_name} has no "
+                             "reachable agent server")
+        container = args.container or "-"
+        import aiohttp
+        params = {"tail": str(args.tail)} if args.tail else {}
+        async with aiohttp.ClientSession() as s:
+            url = f"{base}/logs/{args.namespace}/{args.pod}/{container}"
+            async with s.get(url, params=params) as r:
+                body = await r.text()
+                if r.status != 200:
+                    raise SystemExit(f"ktl: {body.strip()}")
+                sys.stdout.write(body)
+        return 0
+    finally:
+        await client.close()
+
+
+async def cmd_scale(args) -> int:
+    client = make_client(args)
+    try:
+        plural = resolve_plural(args.resource)
+        await client.patch(plural, args.namespace, args.name,
+                           {"spec": {"replicas": args.replicas}})
+        print(f"{plural}/{args.name} scaled to {args.replicas}")
+        return 0
+    finally:
+        await client.close()
+
+
+async def _set_unschedulable(args, value: bool, verb: str) -> int:
+    client = make_client(args)
+    try:
+        await client.patch("nodes", "", args.node,
+                           {"spec": {"unschedulable": value}})
+        print(f"node/{args.node} {verb}")
+        return 0
+    finally:
+        await client.close()
+
+
+async def cmd_cordon(args) -> int:
+    return await _set_unschedulable(args, True, "cordoned")
+
+
+async def cmd_uncordon(args) -> int:
+    return await _set_unschedulable(args, False, "uncordoned")
+
+
+async def cmd_drain(args) -> int:
+    """Cordon + evict every pod on the node (kubectl drain analog;
+    workload controllers reschedule them elsewhere)."""
+    client = make_client(args)
+    try:
+        await client.patch("nodes", "", args.node,
+                           {"spec": {"unschedulable": True}})
+        print(f"node/{args.node} cordoned")
+        pods, _ = await client.list("pods")
+        victims = [p for p in pods if p.spec.node_name == args.node
+                   and t.is_pod_active(p)]
+        for pod in victims:
+            try:
+                await client.delete("pods", pod.metadata.namespace,
+                                    pod.metadata.name,
+                                    grace_period_seconds=args.grace_period)
+                print(f"pod/{pod.metadata.namespace}/{pod.metadata.name} evicted")
+            except errors.NotFoundError:
+                pass
+        print(f"node/{args.node} drained ({len(victims)} pods)")
+        return 0
+    finally:
+        await client.close()
+
+
+async def cmd_top(args) -> int:
+    """Scrape /stats/summary from one node (or all) — nodes, pods and
+    per-chip attribution/health."""
+    client = make_client(args)
+    try:
+        nodes, _ = await client.list("nodes")
+        if args.node:
+            nodes = [n for n in nodes if n.metadata.name == args.node]
+            if not nodes:
+                raise SystemExit(f"ktl: node {args.node!r} not found")
+        import aiohttp
+        rows, chip_rows = [], []
+        for node in nodes:
+            base = await _node_daemon_base(client, node.metadata.name)
+            if base is None:
+                rows.append([node.metadata.name, "-", "-", "unreachable"])
+                continue
+            async with aiohttp.ClientSession() as s:
+                async with s.get(f"{base}/stats/summary") as r:
+                    summary = await r.json()
+            mem = summary["node"]["memory"]
+            rows.append([
+                node.metadata.name,
+                f"{summary['node']['cpu']['load1']:.2f}",
+                f"{mem['used_bytes'] / 2**30:.1f}Gi/{mem['total_bytes'] / 2**30:.1f}Gi",
+                f"{len(summary['pods'])} pods"])
+            for chip in summary.get("tpu", {}).get("chips", []):
+                owner = chip.get("assigned_to")
+                chip_rows.append([
+                    node.metadata.name, chip["id"], chip["health"],
+                    ",".join(map(str, chip["coords"])),
+                    f"{owner['namespace']}/{owner['pod']}" if owner else "<idle>"])
+        print(printers.render_table(["NODE", "LOAD1", "MEMORY", "WORKLOAD"], rows))
+        if chip_rows:
+            print()
+            print(printers.render_table(
+                ["NODE", "CHIP", "HEALTH", "COORDS", "ASSIGNED-TO"], chip_rows))
+        return 0
+    finally:
+        await client.close()
+
+
+async def cmd_api_resources(args) -> int:
+    client = make_client(args)
+    try:
+        import aiohttp
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"{client.base_url}/apis") as r:
+                data = await r.json()
+        rows = [[spec["name"], spec["api_version"],
+                 str(spec["namespaced"]), spec["kind"]]
+                for spec in sorted(data["resources"], key=lambda d: d["name"])]
+        print(printers.render_table(
+            ["NAME", "APIVERSION", "NAMESPACED", "KIND"], rows))
+        return 0
+    finally:
+        await client.close()
+
+
+async def cmd_version(args) -> int:
+    from .. import __version__
+    print(f"ktl version {__version__}")
+    try:
+        client = make_client(args)
+    except SystemExit:
+        return 0
+    try:
+        import aiohttp
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"{client.base_url}/version") as r:
+                print("server:", json.dumps(await r.json()))
+    except Exception:  # noqa: BLE001
+        print("server: unreachable")
+    finally:
+        await client.close()
+    return 0
+
+
+async def cmd_up(args) -> int:
+    """Start a single-process cluster and block until SIGINT/SIGTERM
+    (the local-up-cluster.sh analog)."""
+    from ..cluster.local import LocalCluster, NodeSpec
+
+    specs = []
+    for i in range(args.nodes):
+        specs.append(NodeSpec(
+            name=f"node-{i}",
+            tpu_chips=args.tpu_chips if not args.real_tpu else 0,
+            real_tpu=args.real_tpu and i == 0))
+    cluster = LocalCluster(data_dir=args.data_dir or None, nodes=specs,
+                           host=args.host, port=args.port,
+                           durable=args.durable)
+    base = await cluster.start()
+    os.makedirs(os.path.dirname(DEFAULT_CONFIG), exist_ok=True)
+    with open(DEFAULT_CONFIG, "w") as f:
+        json.dump({"server": base}, f)
+    tpu_note = (" (node-0 probing real TPU)" if args.real_tpu else
+                f" ({args.tpu_chips} stub chips/node)" if args.tpu_chips else "")
+    print(f"cluster up at {base} — {args.nodes} node(s){tpu_note}")
+    print(f"server recorded in {DEFAULT_CONFIG}; try: ktl get nodes")
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            loop.add_signal_handler(sig, stop.set)
+        except NotImplementedError:  # pragma: no cover
+            pass
+    await stop.wait()
+    print("shutting down ...")
+    await cluster.stop()
+    return 0
+
+
+# -- argument parsing ------------------------------------------------------
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="ktl",
+                                description="TPU-cluster CLI (kubectl analog)")
+    p.add_argument("--server", default="", help="apiserver URL")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    def add(name, fn, **kw):
+        sp = sub.add_parser(name, **kw)
+        sp.set_defaults(fn=fn)
+        # default=SUPPRESS so a subcommand-level flag absence does not
+        # clobber the top-level --server value already parsed.
+        sp.add_argument("--server", default=argparse.SUPPRESS,
+                        help=argparse.SUPPRESS)
+        return sp
+
+    sp = add("get", cmd_get, help="list or get resources")
+    sp.add_argument("resource")
+    sp.add_argument("name", nargs="?", default="")
+    sp.add_argument("-n", "--namespace", default="default")
+    sp.add_argument("-l", "--selector", default="")
+    sp.add_argument("-o", "--output", default="",
+                    choices=["", "wide", "json", "yaml"])
+
+    sp = add("describe", cmd_describe, help="show one object in detail")
+    sp.add_argument("resource")
+    sp.add_argument("name")
+    sp.add_argument("-n", "--namespace", default="default")
+
+    sp = add("apply", cmd_apply, help="create-or-update from manifest")
+    sp.add_argument("-f", "--filename", required=True,
+                    help="YAML/JSON file ('-' = stdin)")
+    sp.add_argument("-n", "--namespace", default="default")
+
+    sp = add("delete", cmd_delete, help="delete resources")
+    sp.add_argument("resource", nargs="?", default="")
+    sp.add_argument("name", nargs="?", default="")
+    sp.add_argument("-f", "--filename", default="")
+    sp.add_argument("-n", "--namespace", default="default")
+
+    sp = add("logs", cmd_logs, help="pod container logs")
+    sp.add_argument("pod")
+    sp.add_argument("-c", "--container", default="")
+    sp.add_argument("-n", "--namespace", default="default")
+    sp.add_argument("--tail", type=int, default=0)
+
+    sp = add("scale", cmd_scale, help="set replicas")
+    sp.add_argument("resource")
+    sp.add_argument("name")
+    sp.add_argument("--replicas", type=int, required=True)
+    sp.add_argument("-n", "--namespace", default="default")
+
+    for name, fn in (("cordon", cmd_cordon), ("uncordon", cmd_uncordon)):
+        sp = add(name, fn, help=f"{name} a node")
+        sp.add_argument("node")
+
+    sp = add("drain", cmd_drain, help="cordon + evict all pods")
+    sp.add_argument("node")
+    sp.add_argument("--grace-period", type=int, default=5)
+
+    sp = add("top", cmd_top, help="node/pod/chip stats")
+    sp.add_argument("node", nargs="?", default="")
+
+    add("api-resources", cmd_api_resources, help="list server resources")
+    add("version", cmd_version, help="client+server version")
+
+    sp = add("up", cmd_up, help="run a single-process cluster")
+    sp.add_argument("--nodes", type=int, default=1)
+    sp.add_argument("--tpu-chips", type=int, default=0,
+                    help="stub chips per node")
+    sp.add_argument("--real-tpu", action="store_true",
+                    help="probe real hardware on node-0")
+    sp.add_argument("--host", default="127.0.0.1")
+    sp.add_argument("--port", type=int, default=7070)
+    sp.add_argument("--data-dir", default="")
+    sp.add_argument("--durable", action="store_true",
+                    help="persist state (WAL+snapshot) under --data-dir")
+
+    return p
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        return asyncio.run(args.fn(args))
+    except errors.StatusError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    except KeyboardInterrupt:
+        return 130
+
+
+if __name__ == "__main__":
+    sys.exit(main())

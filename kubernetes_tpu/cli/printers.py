@@ -1,0 +1,184 @@
+"""Table printers for ktl — the ``pkg/printers/`` analog.
+
+One printer per kind (kubectl's human-readable tables); unknown kinds
+fall back to NAME/AGE. ``-o json|yaml|wide`` handled by the CLI layer.
+"""
+from __future__ import annotations
+
+import datetime
+from typing import Any, Callable
+
+from ..api import types as t
+from ..api.meta import now
+
+
+def age(meta) -> str:
+    ts = meta.creation_timestamp
+    if ts is None:
+        return "<unknown>"
+    delta = now() - ts
+    secs = int(delta.total_seconds())
+    if secs < 0:
+        secs = 0
+    for unit, span in (("d", 86400), ("h", 3600), ("m", 60)):
+        if secs >= span:
+            return f"{secs // span}{unit}"
+    return f"{secs}s"
+
+
+def render_table(headers: list[str], rows: list[list[str]]) -> str:
+    widths = [len(h) for h in headers]
+    for row in rows:
+        for i, cell in enumerate(row):
+            widths[i] = max(widths[i], len(str(cell)))
+    fmt = "   ".join(f"{{:<{w}}}" for w in widths)
+    lines = [fmt.format(*headers)]
+    lines += [fmt.format(*(str(c) for c in row)) for row in rows]
+    return "\n".join(lines)
+
+
+def _pod_ready(pod: t.Pod) -> str:
+    total = len(pod.spec.containers)
+    ready = sum(1 for c in pod.status.container_statuses if c.ready)
+    return f"{ready}/{total}"
+
+
+def _pod_status(pod: t.Pod) -> str:
+    if pod.metadata.deletion_timestamp is not None:
+        return "Terminating"
+    if pod.status.reason:
+        return pod.status.reason
+    for cs in pod.status.container_statuses:
+        if cs.state.waiting and cs.state.waiting.reason:
+            return cs.state.waiting.reason
+    return pod.status.phase or "Pending"
+
+
+def pods_table(pods: list[t.Pod], wide: bool = False) -> str:
+    headers = ["NAME", "READY", "STATUS", "RESTARTS", "AGE"]
+    if wide:
+        headers += ["NODE", "CHIPS"]
+    rows = []
+    for p in pods:
+        restarts = sum(c.restart_count for c in p.status.container_statuses)
+        row = [p.metadata.name, _pod_ready(p), _pod_status(p),
+               restarts, age(p.metadata)]
+        if wide:
+            chips = ",".join(cid for r in p.spec.tpu_resources
+                             for cid in r.assigned)
+            row += [p.spec.node_name or "<none>", chips or "<none>"]
+        rows.append(row)
+    return render_table(headers, rows)
+
+
+def nodes_table(nodes: list[t.Node], wide: bool = False) -> str:
+    headers = ["NAME", "STATUS", "TPU", "AGE"]
+    if wide:
+        headers += ["SLICE", "MESH", "ADDRESS"]
+    rows = []
+    for n in nodes:
+        cond = t.get_node_condition(n.status, t.NODE_READY)
+        status = ("Ready" if cond and cond.status == "True" else "NotReady")
+        if n.spec.unschedulable:
+            status += ",SchedulingDisabled"
+        tpu = int(n.status.capacity.get(t.RESOURCE_TPU, 0))
+        row = [n.metadata.name, status, tpu or "<none>", age(n.metadata)]
+        if wide:
+            topo = n.status.tpu
+            addr = n.status.addresses[0].address if n.status.addresses else ""
+            row += [topo.slice_id if topo else "<none>",
+                    "x".join(map(str, topo.mesh_shape)) if topo else "<none>",
+                    addr]
+        rows.append(row)
+    return render_table(headers, rows)
+
+
+def _replicas_table(objs: list, wide: bool) -> str:
+    rows = [[o.metadata.name,
+             f"{getattr(o.status, 'ready_replicas', 0)}/{o.spec.replicas}",
+             getattr(o.status, "updated_replicas",
+                     getattr(o.status, "replicas", 0)),
+             getattr(o.status, "available_replicas", 0),
+             age(o.metadata)] for o in objs]
+    return render_table(["NAME", "READY", "UP-TO-DATE", "AVAILABLE", "AGE"], rows)
+
+
+def _jobs_table(objs: list, wide: bool) -> str:
+    rows = [[o.metadata.name,
+             f"{getattr(o.status, 'succeeded', 0)}/{getattr(o.spec, 'completions', 1) or 1}",
+             age(o.metadata)] for o in objs]
+    return render_table(["NAME", "COMPLETIONS", "AGE"], rows)
+
+
+def _podgroups_table(objs: list, wide: bool) -> str:
+    rows = [[o.metadata.name, o.spec.min_member,
+             getattr(o.status, "phase", ""), age(o.metadata)] for o in objs]
+    return render_table(["NAME", "MIN-MEMBER", "PHASE", "AGE"], rows)
+
+
+def _services_table(objs: list, wide: bool) -> str:
+    rows = [[o.metadata.name, o.spec.cluster_ip or "<none>",
+             ",".join(f"{p.port}/{p.protocol or 'TCP'}"
+                      for p in o.spec.ports) or "<none>",
+             age(o.metadata)] for o in objs]
+    return render_table(["NAME", "CLUSTER-IP", "PORTS", "AGE"], rows)
+
+
+def _events_table(objs: list, wide: bool) -> str:
+    rows = [[age(o.metadata), o.type, o.reason,
+             f"{o.involved_object.kind}/{o.involved_object.name}",
+             (o.message or "")[:80]] for o in objs]
+    return render_table(["AGE", "TYPE", "REASON", "OBJECT", "MESSAGE"], rows)
+
+
+def generic_table(objs: list, wide: bool = False) -> str:
+    return render_table(["NAME", "AGE"],
+                        [[o.metadata.name, age(o.metadata)] for o in objs])
+
+
+PRINTERS: dict[str, Callable[[list, bool], str]] = {
+    "pods": pods_table,
+    "nodes": nodes_table,
+    "deployments": _replicas_table,
+    "replicasets": _replicas_table,
+    "statefulsets": _replicas_table,
+    "jobs": _jobs_table,
+    "podgroups": _podgroups_table,
+    "services": _services_table,
+    "events": _events_table,
+}
+
+
+def print_objects(plural: str, objs: list, wide: bool = False) -> str:
+    if not objs:
+        return "No resources found."
+    return PRINTERS.get(plural, generic_table)(objs, wide)
+
+
+def describe(obj: Any) -> str:
+    """Indented field dump (kubectl describe analog, schema-driven)."""
+    from ..api.scheme import to_dict
+    lines: list[str] = []
+
+    def emit(key: str, value, indent: int) -> None:
+        pad = "  " * indent
+        if isinstance(value, dict):
+            if not value:
+                return
+            lines.append(f"{pad}{key}:")
+            for k, v in value.items():
+                emit(str(k), v, indent + 1)
+        elif isinstance(value, list):
+            if not value:
+                return
+            lines.append(f"{pad}{key}:")
+            for i, v in enumerate(value):
+                emit(f"- [{i}]", v, indent + 1)
+        else:
+            if value in ("", None):
+                return
+            lines.append(f"{pad}{key}: {value}")
+
+    for k, v in to_dict(obj).items():
+        emit(k, v, 0)
+    return "\n".join(lines)

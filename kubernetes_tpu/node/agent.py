@@ -45,7 +45,8 @@ class NodeAgent:
                  heartbeat_interval: float = 5.0,
                  pleg_interval: float = 1.0,
                  max_pods: int = 110,
-                 address: str = ""):
+                 address: str = "",
+                 server_port: Optional[int] = 0):
         self.client = client
         self.node_name = node_name
         self.runtime = runtime
@@ -59,6 +60,9 @@ class NodeAgent:
         self.address = address or socket.gethostname()
         self.recorder = EventRecorder(client, component="node-agent", host=node_name)
         self.probes = ProbeManager()
+        #: kubelet-server analog (server.py); None disables it.
+        self.server_port = server_port
+        self.server = None
 
         self._pods: dict[str, t.Pod] = {}        # key -> desired pod
         self._workers: dict[str, asyncio.Task] = {}
@@ -77,6 +81,10 @@ class NodeAgent:
         if self.device_manager:
             self.device_manager.on_topology_changed = self._on_topology_changed
             await self.device_manager.start()
+        if self.server_port is not None:
+            from .server import NodeAgentServer
+            self.server = NodeAgentServer(self)
+            await self.server.start(port=self.server_port)
         await self._register_node()
         self._informer = SharedInformer(
             self.client, "pods",
@@ -106,6 +114,8 @@ class NodeAgent:
             await self._informer.stop()
         if self.device_manager:
             await self.device_manager.stop()
+        if self.server:
+            await self.server.stop()
         await self.probes.stop_all()
 
     # -- node registration + status (kubelet_node_status.go) --------------
@@ -120,6 +130,9 @@ class NodeAgent:
             node.status.tpu = self.device_manager.topology()
         node.status.allocatable = dict(node.status.capacity)
         node.status.addresses = [t.NodeAddress(type="Hostname", address=self.address)]
+        if self.server and self.server.port:
+            # DaemonEndpoints analog: how ktl logs / scrapers find us.
+            node.status.daemon_endpoints = {"agent": self.server.port}
         node.status.conditions = [t.NodeCondition(
             type=t.NODE_READY, status="True", reason="AgentReady",
             last_heartbeat_time=now(), last_transition_time=now())]

@@ -54,6 +54,9 @@ class ContainerStatus:
     started_at: float = 0.0
     finished_at: float = 0.0
     message: str = ""
+    #: OS pid when the runtime runs real processes (0 otherwise) —
+    #: feeds the stats collector (cAdvisor analog).
+    pid: int = 0
 
 
 class ContainerRuntime:
@@ -121,7 +124,7 @@ class ProcessRuntime(ContainerRuntime):
         self._procs[cid] = proc
         self._status[cid] = ContainerStatus(
             id=cid, name=config.name, pod_uid=config.pod_uid,
-            state=STATE_RUNNING, started_at=time.time())
+            state=STATE_RUNNING, started_at=time.time(), pid=proc.pid)
         self._waiters[cid] = asyncio.get_running_loop().create_task(
             self._wait(cid, proc))
         return cid

@@ -1,0 +1,124 @@
+"""Node agent HTTP server — the kubelet :10250 API analog.
+
+Reference: ``pkg/kubelet/server/server.go:295-403`` — the kubelet
+serves /pods, /containerLogs, /stats (Summary API), /metrics,
+/healthz and /debug/pprof on its own port, found by clients through
+``Node.Status.DaemonEndpoints``. ``ktl logs`` and the metrics scraper
+are the consumers here.
+
+Routes:
+
+- ``GET /healthz``
+- ``GET /pods``                                    desired pods (JSON)
+- ``GET /logs/{namespace}/{pod}/{container}?tail=N``
+- ``GET /stats/summary``                           node+pod+chip stats
+- ``GET /metrics``                                 Prometheus text
+"""
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from aiohttp import web
+
+from ..api.scheme import to_dict
+from ..metrics.registry import REGISTRY as METRICS, Gauge
+from .stats import SummaryCollector
+
+log = logging.getLogger("nodeserver")
+
+CHIP_HEALTHY = Gauge("node_tpu_chip_healthy",
+                     "1 when the chip is Healthy",
+                     labels=("node", "chip"))
+CHIP_ASSIGNED = Gauge("node_tpu_chip_assigned",
+                      "1 when the chip is assigned to a pod",
+                      labels=("node", "chip", "pod"))
+
+
+class NodeAgentServer:
+    def __init__(self, agent, collector: Optional[SummaryCollector] = None):
+        self.agent = agent
+        self.collector = collector or SummaryCollector(
+            agent.node_name,
+            root_dir=getattr(agent.runtime, "root_dir", "/"))
+        self.app = web.Application()
+        r = self.app.router
+        r.add_get("/healthz", self._healthz)
+        r.add_get("/pods", self._pods)
+        r.add_get("/logs/{namespace}/{pod}/{container}", self._logs)
+        r.add_get("/stats/summary", self._summary)
+        r.add_get("/metrics", self._metrics)
+        self._runner: Optional[web.AppRunner] = None
+        self.port: Optional[int] = None
+
+    # -- handlers ----------------------------------------------------------
+
+    async def _healthz(self, request):
+        return web.Response(text="ok")
+
+    async def _pods(self, request):
+        return web.json_response(
+            {"items": [to_dict(p) for _, p in sorted(self.agent._pods.items())]})
+
+    async def _logs(self, request):
+        ns = request.match_info["namespace"]
+        pod = request.match_info["pod"]
+        container = request.match_info["container"]
+        key = f"{ns}/{pod}"
+        cmap = self.agent._containers.get(key, {})
+        if not cmap:
+            raise web.HTTPNotFound(text=f"no containers for pod {key}")
+        if container == "-":  # single-container convenience
+            if len(cmap) != 1:
+                raise web.HTTPBadRequest(
+                    text=f"pod {key} has containers {sorted(cmap)}; pick one")
+            container = next(iter(cmap))
+        cid = cmap.get(container)
+        if cid is None:
+            raise web.HTTPNotFound(
+                text=f"pod {key} has no container {container!r}")
+        tail = request.query.get("tail")
+        text = await self.agent.runtime.container_logs(
+            cid, tail=int(tail) if tail else None)
+        return web.Response(text=text)
+
+    async def _summary(self, request):
+        summary = await self._collect()
+        return web.json_response(summary)
+
+    async def _collect(self) -> dict:
+        statuses = {st.id: st
+                    for st in await self.agent.runtime.list_containers()}
+        topo = (self.agent.device_manager.topology()
+                if self.agent.device_manager else None)
+        summary = self.collector.summary(
+            self.agent._pods, self.agent._containers, statuses, topo)
+        for chip in summary["tpu"].get("chips", []):
+            CHIP_HEALTHY.set(1.0 if chip["health"] == "Healthy" else 0.0,
+                             node=self.agent.node_name, chip=chip["id"])
+            owner = chip.get("assigned_to")
+            CHIP_ASSIGNED.set(
+                1.0 if owner else 0.0, node=self.agent.node_name,
+                chip=chip["id"],
+                pod=f"{owner['namespace']}/{owner['pod']}" if owner else "")
+        return summary
+
+    async def _metrics(self, request):
+        await self._collect()  # refresh chip gauges on scrape
+        return web.Response(text=METRICS.render(), content_type="text/plain")
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        self._runner = web.AppRunner(self.app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, host, port, shutdown_timeout=1.0)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]  # type: ignore[union-attr]
+        log.info("node agent server on %s:%d", host, self.port)
+        return self.port
+
+    async def stop(self) -> None:
+        if self._runner:
+            await self._runner.cleanup()
+            self._runner = None

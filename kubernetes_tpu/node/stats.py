@@ -1,0 +1,155 @@
+"""Node/pod/chip stats — the cAdvisor + Summary-API analog.
+
+Reference: kubelet Summary API (``pkg/kubelet/apis/stats/v1alpha1/
+types.go:121,213-215`` — NodeStats/PodStats + ``AcceleratorStats{Make,
+Model,ID,MemoryTotal,MemoryUsed,DutyCycle}``) fed by cAdvisor's
+accelerator collector (``vendor/github.com/google/cadvisor/
+accelerators/nvidia.go:48-222``: map devices-cgroup minors -> NVML
+handles, per-container attribution).
+
+TPU redesign: attribution comes from the durable pod spec
+(``tpu_resources[].assigned`` — the fork's checkpoint-is-the-API-object
+trick), not from cgroup scraping. Utilization comes from an optional
+``chip_metrics`` callable (the libtpu-metrics seam: on a real TPU-VM a
+sidecar reads libtpu's own counters; the chip's compute process owns
+libtpu, so the node agent must NOT dlopen it in-process). Host cpu/mem
+come from /proc — the runtime's processes ARE the containers here.
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Callable, Optional
+
+from ..api import types as t
+from .runtime import STATE_RUNNING, ContainerStatus as RtStatus
+
+_PAGE = os.sysconf("SC_PAGE_SIZE") if hasattr(os, "sysconf") else 4096
+_TICK = os.sysconf("SC_CLK_TCK") if hasattr(os, "sysconf") else 100
+
+#: chip_id -> {"duty_cycle_pct": float, "hbm_used_bytes": int,
+#: "hbm_total_bytes": int}
+ChipMetricsSource = Callable[[], dict]
+
+
+def _proc_stat(pid: int) -> Optional[dict]:
+    """cpu seconds + rss bytes for one pid (None if gone)."""
+    try:
+        with open(f"/proc/{pid}/stat") as f:
+            parts = f.read().rsplit(")", 1)[1].split()
+        with open(f"/proc/{pid}/statm") as f:
+            rss_pages = int(f.read().split()[1])
+    except (OSError, IndexError, ValueError):
+        return None
+    # fields after comm: index 11/12 are utime/stime (0-based here).
+    utime, stime = int(parts[11]), int(parts[12])
+    return {"cpu_seconds": (utime + stime) / _TICK,
+            "memory_rss_bytes": rss_pages * _PAGE}
+
+
+def _node_memory() -> dict:
+    total = available = 0
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemTotal:"):
+                    total = int(line.split()[1]) * 1024
+                elif line.startswith("MemAvailable:"):
+                    available = int(line.split()[1]) * 1024
+    except OSError:
+        pass
+    return {"total_bytes": total, "available_bytes": available,
+            "used_bytes": max(total - available, 0)}
+
+
+def _node_fs(path: str) -> dict:
+    try:
+        st = os.statvfs(path)
+    except OSError:
+        return {}
+    return {"capacity_bytes": st.f_frsize * st.f_blocks,
+            "available_bytes": st.f_frsize * st.f_bavail}
+
+
+class SummaryCollector:
+    """Builds the /stats/summary document from the agent's live state."""
+
+    def __init__(self, node_name: str, root_dir: str = "/",
+                 chip_metrics: Optional[ChipMetricsSource] = None):
+        self.node_name = node_name
+        self.root_dir = root_dir
+        self.chip_metrics = chip_metrics
+        self._start = time.time()
+
+    def summary(self, pods: dict[str, t.Pod],
+                containers: dict[str, dict[str, str]],
+                statuses: dict[str, RtStatus],
+                topology: Optional[t.TpuTopology]) -> dict:
+        """``pods``: key->Pod; ``containers``: pod key -> {name->cid};
+        ``statuses``: cid -> runtime status."""
+        try:
+            load1, load5, load15 = os.getloadavg()
+        except OSError:
+            load1 = load5 = load15 = 0.0
+        node = {
+            "node_name": self.node_name,
+            "uptime_seconds": round(time.time() - self._start, 1),
+            "cpu": {"cores": os.cpu_count() or 0,
+                    "load1": load1, "load5": load5, "load15": load15},
+            "memory": _node_memory(),
+            "fs": _node_fs(self.root_dir),
+        }
+
+        pod_stats = []
+        for key, pod in sorted(pods.items()):
+            cmap = containers.get(key, {})
+            cstats = []
+            for cname, cid in cmap.items():
+                st = statuses.get(cid)
+                entry = {"name": cname, "container_id": cid,
+                         "state": st.state if st else "unknown"}
+                if st and st.state == STATE_RUNNING and st.pid:
+                    proc = _proc_stat(st.pid)
+                    if proc:
+                        entry.update(proc)
+                cstats.append(entry)
+            pod_stats.append({
+                "pod": {"namespace": pod.metadata.namespace,
+                        "name": pod.metadata.name, "uid": pod.metadata.uid},
+                "containers": cstats,
+                "cpu_seconds": sum(c.get("cpu_seconds", 0.0) for c in cstats),
+                "memory_rss_bytes": sum(c.get("memory_rss_bytes", 0)
+                                        for c in cstats),
+            })
+
+        return {"node": node, "pods": pod_stats,
+                "tpu": self.tpu_stats(pods, topology)}
+
+    def tpu_stats(self, pods: dict[str, t.Pod],
+                  topology: Optional[t.TpuTopology]) -> dict:
+        """Per-chip attribution + utilization (AcceleratorStats analog)."""
+        if topology is None:
+            return {"chips": []}
+        owner: dict[str, dict] = {}
+        for pod in pods.values():
+            for claim in pod.spec.tpu_resources:
+                for cid in claim.assigned:
+                    owner[cid] = {"namespace": pod.metadata.namespace,
+                                  "pod": pod.metadata.name,
+                                  "claim": claim.name}
+        live = self.chip_metrics() if self.chip_metrics else {}
+        chips = []
+        for chip in topology.chips:
+            entry = {
+                "id": chip.id,
+                "health": chip.health,
+                "coords": list(chip.coords),
+                "chip_type": topology.chip_type,
+                "assigned_to": owner.get(chip.id),
+            }
+            entry.update(live.get(chip.id, {}))
+            chips.append(entry)
+        return {"chip_type": topology.chip_type,
+                "slice_id": topology.slice_id,
+                "mesh_shape": list(topology.mesh_shape),
+                "chips": chips}

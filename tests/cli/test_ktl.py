@@ -1,0 +1,150 @@
+"""ktl CLI against a live in-process cluster, plus a real `ktl up`
+subprocess round-trip. Reference: kubectl command tree
+``pkg/kubectl/cmd/cmd.go:216``; local-up ``hack/local-up-cluster.sh``."""
+import asyncio
+import io
+import json
+import os
+import signal
+import subprocess
+import sys
+import contextlib
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.cli import ktl
+from kubernetes_tpu.cluster import LocalCluster
+from kubernetes_tpu.cluster.local import NodeSpec
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def run_ktl(args: list[str], server: str) -> tuple[int, str]:
+    """Run one ktl command on a worker thread (its own event loop),
+    capturing stdout."""
+    buf = io.StringIO()
+
+    def call() -> int:
+        with contextlib.redirect_stdout(buf):
+            return ktl.main(["--server", server] + args)
+    return call, buf
+
+
+async def ktl_out(args: list[str], server: str) -> tuple[int, str]:
+    call, buf = run_ktl(args, server)
+    rc = await asyncio.to_thread(call)
+    return rc, buf.getvalue()
+
+
+async def test_ktl_commands_full_stack(tmp_path):
+    cluster = LocalCluster(data_dir=str(tmp_path),
+                           nodes=[NodeSpec(name="tpu-0", tpu_chips=4)],
+                           status_interval=0.3, heartbeat_interval=0.3)
+    base = await cluster.start()
+    try:
+        await cluster.wait_for_nodes_ready(timeout=20)
+
+        rc, out = await ktl_out(["get", "nodes", "-o", "wide"], base)
+        assert rc == 0 and "tpu-0" in out and "Ready" in out and "2x2x1" in out
+
+        rc, out = await ktl_out(["api-resources"], base)
+        assert rc == 0 and "pods" in out and "podgroups" in out
+
+        # apply a Job manifest (tests YAML path + api_version inference)
+        manifest = tmp_path / "job.yaml"
+        manifest.write_text(f"""
+kind: Job
+metadata:
+  name: hello
+spec:
+  completions: 1
+  template:
+    metadata:
+      labels: {{app: hello}}
+    spec:
+      restart_policy: Never
+      containers:
+      - name: main
+        image: inline
+        command: ["{sys.executable}", "-c", "print('job-output-42')"]
+""")
+        rc, out = await ktl_out(["apply", "-f", str(manifest)], base)
+        assert rc == 0 and "job/hello created" in out
+
+        for _ in range(200):
+            rc, out = await ktl_out(["get", "pods", "-o", "json"], base)
+            pods = json.loads(out)
+            if pods and all(p["status"]["phase"] == "Succeeded" for p in pods):
+                break
+            await asyncio.sleep(0.1)
+        assert pods and pods[0]["status"]["phase"] == "Succeeded"
+        pod_name = pods[0]["metadata"]["name"]
+
+        rc, out = await ktl_out(["logs", pod_name], base)
+        assert rc == 0 and "job-output-42" in out
+
+        rc, out = await ktl_out(["describe", "pod", pod_name], base)
+        assert rc == 0 and "node_name: tpu-0" in out
+
+        rc, out = await ktl_out(["top"], base)
+        assert rc == 0 and "tpu-0" in out and "CHIP" in out
+
+        rc, out = await ktl_out(["get", "jobs"], base)
+        assert rc == 0 and "1/1" in out
+
+        rc, out = await ktl_out(["cordon", "tpu-0"], base)
+        assert rc == 0
+        node = await cluster.local_client().get("nodes", "", "tpu-0")
+        assert node.spec.unschedulable is True
+        rc, out = await ktl_out(["uncordon", "tpu-0"], base)
+        node = await cluster.local_client().get("nodes", "", "tpu-0")
+        assert node.spec.unschedulable is False
+
+        rc, out = await ktl_out(["delete", "jobs", "hello"], base)
+        assert rc == 0 and "deleted" in out
+    finally:
+        await cluster.stop()
+
+
+async def test_ktl_up_subprocess(tmp_path):
+    """The README quickstart: `ktl up` in a real subprocess, then drive
+    it with ktl subcommands through the recorded config file."""
+    cfg = str(tmp_path / "ktlconfig")
+    env = dict(os.environ)
+    env["KTL_CONFIG"] = cfg
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "kubernetes_tpu.cli.ktl", "up",
+         "--nodes", "2", "--tpu-chips", "4", "--port", "0",
+         "--data-dir", str(tmp_path / "data")],
+        env=env, cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, start_new_session=True)
+    try:
+        for _ in range(100):
+            if os.path.exists(cfg):
+                break
+            await asyncio.sleep(0.2)
+            assert proc.poll() is None, proc.stdout.read()
+        assert os.path.exists(cfg), "ktl up never wrote the config file"
+        server = json.load(open(cfg))["server"]
+
+        def cli(*args):
+            return subprocess.run(
+                [sys.executable, "-m", "kubernetes_tpu.cli.ktl", *args],
+                env=env, cwd=REPO, capture_output=True, text=True, timeout=30)
+
+        for _ in range(100):
+            r = cli("get", "nodes")
+            if r.returncode == 0 and r.stdout.count("Ready") >= 2:
+                break
+            await asyncio.sleep(0.2)
+        assert r.stdout.count("node-") >= 2, r.stdout + r.stderr
+
+        r = cli("version")
+        assert "server" in r.stdout
+    finally:
+        os.killpg(proc.pid, signal.SIGTERM)
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            os.killpg(proc.pid, signal.SIGKILL)

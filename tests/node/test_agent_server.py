@@ -1,0 +1,74 @@
+"""Node agent HTTP server (:10250 analog): logs, summary stats with
+per-chip attribution, metrics. Reference:
+``pkg/kubelet/server/server.go:295-403`` + Summary API
+``pkg/kubelet/apis/stats/v1alpha1/types.go:121,213-215``."""
+import sys
+
+import aiohttp
+
+from kubernetes_tpu.api import types as t
+
+from .test_node_agent import cluster_with_node, mk_pod, teardown
+from kubernetes_tpu.node.runtime import ProcessRuntime
+
+
+async def test_server_logs_summary_metrics(tmp_path):
+    reg, client, agent, sched, plugin, rt = await cluster_with_node(
+        tmp_path, runtime=ProcessRuntime(str(tmp_path / "rt")))
+    assert agent.server is not None and agent.server.port
+    base = f"http://127.0.0.1:{agent.server.port}"
+    try:
+        pod = mk_pod("printer",
+                     command=[sys.executable, "-c", "print('hello-from-pod')"],
+                     chips=2)
+        await client.create(pod)
+
+        import asyncio
+        final = None
+        for _ in range(200):
+            final = await client.get("pods", "default", "printer")
+            if final.status.phase == t.POD_SUCCEEDED:
+                break
+            await asyncio.sleep(0.1)
+        assert final.status.phase == t.POD_SUCCEEDED
+
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"{base}/healthz") as r:
+                assert r.status == 200
+
+            async with s.get(f"{base}/logs/default/printer/main") as r:
+                assert r.status == 200
+                assert "hello-from-pod" in await r.text()
+            # single-container shorthand
+            async with s.get(f"{base}/logs/default/printer/-") as r:
+                assert "hello-from-pod" in await r.text()
+            async with s.get(f"{base}/logs/default/printer/nope") as r:
+                assert r.status == 404
+
+            async with s.get(f"{base}/stats/summary") as r:
+                summary = await r.json()
+            assert summary["node"]["node_name"] == "worker-0"
+            assert summary["node"]["memory"]["total_bytes"] > 0
+            chips = summary["tpu"]["chips"]
+            assert len(chips) == 4
+            assigned = [c for c in chips if c["assigned_to"]]
+            assert {c["id"] for c in assigned} == set(
+                final.spec.tpu_resources[0].assigned)
+            assert assigned[0]["assigned_to"]["pod"] == "printer"
+
+            async with s.get(f"{base}/metrics") as r:
+                text = await r.text()
+            assert "node_tpu_chip_healthy" in text
+            assert "node_tpu_chip_assigned" in text
+
+            async with s.get(f"{base}/pods") as r:
+                pods = await r.json()
+            assert any(p["metadata"]["name"] == "printer"
+                       for p in pods["items"])
+
+        # DaemonEndpoints published on the node object
+        node = await client.get("nodes", "", "worker-0")
+        assert node.status.daemon_endpoints.get("agent") == agent.server.port
+    finally:
+        await teardown(agent, sched, plugin)
+        await rt.shutdown()
