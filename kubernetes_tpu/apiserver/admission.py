@@ -131,14 +131,19 @@ class PriorityResolver(AdmissionPlugin):
 
 
 class ResourceQuotaPlugin(AdmissionPlugin):
-    """Enforce per-namespace hard quotas on create.
+    """Enforce per-namespace hard quotas on pod create.
 
     Reference: ``plugin/pkg/admission/resourcequota`` + ``pkg/quota``.
-    Counts pods, TPU chips, cpu/memory requests against every quota in
-    the namespace and rejects if any limit would be exceeded.
+    Redesigned away from the round-1 O(pods-in-namespace) recount per
+    create: admission *charges* ``quota.status.used`` with a CAS update
+    (exactly the reference's synchronous status charge), and the quota
+    controller recalculates usage level-triggered to heal drift
+    (terminated pods, failed creates after the charge, force deletes).
+    Cost per pod create is O(quotas in namespace), not O(pods).
     """
 
     name = "ResourceQuota"
+    CAS_RETRIES = 10
 
     def __init__(self, registry: "Registry"):
         self.registry = registry
@@ -151,23 +156,39 @@ class ResourceQuotaPlugin(AdmissionPlugin):
         quotas, _ = self.registry.list("resourcequotas", ns)
         if not quotas:
             return
-        want = t.pod_resource_requests(pod)
-        pods, _ = self.registry.list("pods", ns)
-        used: dict[str, float] = {}
-        for p in pods:
-            if not t.is_pod_active(p):
-                continue
-            for k, v in t.pod_resource_requests(p).items():
-                used[k] = used.get(k, 0.0) + v
+        from .quota import pod_usage
+        want = pod_usage(pod)
         for q in quotas:
-            for res, hard in q.spec.hard.items():
-                if res not in want:
-                    continue
-                if used.get(res, 0.0) + want[res] > t.parse_quantity(hard):
+            self._charge(ns, q.metadata.name, want)
+
+    def _charge(self, ns: str, quota_name: str, want: dict) -> None:
+        for _ in range(self.CAS_RETRIES):
+            try:
+                cur = self.registry.get("resourcequotas", ns, quota_name)
+            except errors.NotFoundError:
+                return
+            tracked = {res: amt for res, amt in want.items()
+                       if res in cur.spec.hard}
+            if not tracked:
+                return
+            used = dict(cur.status.used)
+            for res, amt in tracked.items():
+                hard = t.parse_quantity(cur.spec.hard[res])
+                if used.get(res, 0.0) + amt > hard:
                     raise errors.ForbiddenError(
-                        f"exceeded quota {q.metadata.name!r}: requested "
-                        f"{res}={want[res]:g}, used {used.get(res, 0.0):g}, "
-                        f"hard limit {t.parse_quantity(hard):g}")
+                        f"exceeded quota {quota_name!r}: requested "
+                        f"{res}={amt:g}, used {used.get(res, 0.0):g}, "
+                        f"hard limit {hard:g}")
+                used[res] = used.get(res, 0.0) + amt
+            cur.status.used = used
+            cur.status.hard = dict(cur.spec.hard)
+            try:
+                self.registry.update(cur, subresource="status")
+                return
+            except errors.ConflictError:
+                continue  # concurrent charge: re-read and retry
+        raise errors.ConflictError(
+            f"quota {quota_name!r}: too much contention charging usage")
 
 
 def default_chain(registry: "Registry") -> AdmissionChain:

@@ -1,0 +1,24 @@
+"""Quota accounting shared by admission and the quota controller.
+
+Reference: ``pkg/quota`` evaluators — one definition of a pod's
+footprint, consumed by both ``plugin/pkg/admission/resourcequota``
+(synchronous enforcement) and ``pkg/controller/resourcequota``
+(usage recalculation / drift healing).
+"""
+from __future__ import annotations
+
+from ..api import types as t
+
+
+def pod_usage(pod: t.Pod) -> dict[str, float]:
+    """Resource footprint of one pod (terminal pods are free)."""
+    if pod.status.phase in (t.POD_SUCCEEDED, t.POD_FAILED):
+        return {}
+    use = {t.RESOURCE_PODS: 1.0}
+    for c in pod.spec.containers:
+        for res, qty in c.resources.requests.items():
+            use[res] = use.get(res, 0.0) + t.parse_quantity(qty)
+    chips = t.pod_tpu_chip_count(pod)
+    if chips:
+        use[t.RESOURCE_TPU] = use.get(t.RESOURCE_TPU, 0.0) + chips
+    return use

@@ -20,18 +20,7 @@ from ..client.interface import Client
 from .base import Controller
 
 
-def pod_usage(pod: t.Pod) -> dict[str, float]:
-    """Resource footprint of one pod (terminal pods are free)."""
-    if pod.status.phase in (t.POD_SUCCEEDED, t.POD_FAILED):
-        return {}
-    use = {t.RESOURCE_PODS: 1.0}
-    for c in pod.spec.containers:
-        for res, qty in c.resources.requests.items():
-            use[res] = use.get(res, 0.0) + t.parse_quantity(qty)
-    chips = t.pod_tpu_chip_count(pod)
-    if chips:
-        use[t.RESOURCE_TPU] = use.get(t.RESOURCE_TPU, 0.0) + chips
-    return use
+from ..apiserver.quota import pod_usage  # shared with admission  # noqa: E402
 
 
 class ResourceQuotaController(Controller):

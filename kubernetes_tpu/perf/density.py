@@ -64,9 +64,17 @@ def density_pod(name: str, cpu: float = 0.1, mem: float = 64 * 2**20) -> t.Pod:
 
 
 async def run_density(n_nodes: int = 100, n_pods: int = 3000,
-                      timeout: float = 600.0) -> dict:
+                      timeout: float = 600.0, via: str = "local",
+                      create_concurrency: int = 64,
+                      max_pods_per_node: int = 110) -> dict:
     """Create nodes, start the scheduler, pour pods in, wait until every
-    pod is bound. Returns throughput + latency percentiles."""
+    pod is bound. Returns throughput + latency percentiles.
+
+    ``via='local'``: direct registry calls (the reference harness shape
+    — in-proc apiserver). ``via='rest'``: everything (scheduler
+    informers+binds, pod creates, the bound-watch) goes through the
+    real HTTP apiserver — JSON serde + chunked watch streams included.
+    """
     for m in (sched_metrics.E2E_SCHEDULING_LATENCY,
               sched_metrics.ALGORITHM_LATENCY,
               sched_metrics.BINDING_LATENCY,
@@ -76,9 +84,20 @@ async def run_density(n_nodes: int = 100, n_pods: int = 3000,
     reg.admission = default_chain(reg)
     reg.create(t.Namespace(metadata=ObjectMeta(name="default")))
     for i in range(n_nodes):
-        reg.create(hollow_node(f"hollow-{i:04d}"))
-    client = LocalClient(reg)
-    sched = Scheduler(client, backoff_seconds=0.5)
+        reg.create(hollow_node(f"hollow-{i:04d}", pods=max_pods_per_node))
+
+    server = None
+    if via == "rest":
+        from ..apiserver.server import APIServer
+        from ..client.rest import RESTClient
+        server = APIServer(reg)
+        port = await server.start()
+        client = RESTClient(f"http://127.0.0.1:{port}")
+        sched_client = RESTClient(f"http://127.0.0.1:{port}")
+    else:
+        client = LocalClient(reg)
+        sched_client = client
+    sched = Scheduler(sched_client, backoff_seconds=0.5)
     await sched.start()
 
     bound: dict[str, str] = {}  # pod -> node
@@ -86,26 +105,43 @@ async def run_density(n_nodes: int = 100, n_pods: int = 3000,
     stream = await client.watch("pods", namespace="default")
 
     async def count_bound():
-        async for ev_type, pod in stream:
+        while True:
+            ev = await stream.next()
+            if ev is None or ev[0] == "CLOSED":
+                return
+            ev_type, pod = ev
+            if ev_type == "BOOKMARK":
+                continue
             if ev_type in ("ADDED", "MODIFIED") and pod.spec.node_name:
                 bound[pod.metadata.name] = pod.spec.node_name
                 if len(bound) >= n_pods:
                     done.set()
                     return
 
+    async def create_all():
+        it = iter(range(n_pods))
+
+        async def worker():
+            for i in it:
+                await client.create(density_pod(f"density-{i:05d}"))
+        await asyncio.gather(*(worker() for _ in range(
+            create_concurrency if via == "rest" else 1)))
+
     counter = asyncio.create_task(count_bound())
     start = time.perf_counter()
     try:
-        for i in range(n_pods):
-            reg.create(density_pod(f"density-{i:05d}"))
-            if i % 500 == 499:
-                await asyncio.sleep(0)  # let the scheduler breathe
+        await create_all()
         await asyncio.wait_for(done.wait(), timeout)
         wall = time.perf_counter() - start
     finally:
         stream.cancel()
         counter.cancel()
         await sched.stop()
+        if via == "rest":
+            await client.close()
+            await sched_client.close()
+        if server:
+            await server.stop()
 
     per_node: dict[str, int] = {}
     for node_name in bound.values():
@@ -114,6 +150,7 @@ async def run_density(n_nodes: int = 100, n_pods: int = 3000,
     return {
         "nodes": n_nodes,
         "pods": n_pods,
+        "via": via,
         "wall_seconds": round(wall, 3),
         "pods_per_second": round(n_pods / wall, 2),
         "max_pods_per_node": max(per_node.values(), default=0),
@@ -129,4 +166,5 @@ if __name__ == "__main__":
 
     nodes = int(sys.argv[1]) if len(sys.argv) > 1 else 100
     pods = int(sys.argv[2]) if len(sys.argv) > 2 else 3000
-    print(json.dumps(asyncio.run(run_density(nodes, pods))))
+    via = sys.argv[3] if len(sys.argv) > 3 else "local"
+    print(json.dumps(asyncio.run(run_density(nodes, pods, via=via))))

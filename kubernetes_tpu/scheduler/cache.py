@@ -30,6 +30,10 @@ class NodeInfo:
     free_chips: dict = field(default_factory=dict)
     #: chip_id -> pod key holding it.
     chip_owner: dict = field(default_factory=dict)
+    #: controller owner uid -> count of its pods on this node
+    #: (SelectorSpreadPriority input, maintained incrementally so
+    #: scheduling is O(nodes), not O(nodes * pods)).
+    owner_counts: dict = field(default_factory=dict)
 
     def allocatable(self) -> dict:
         if self.node is None:
@@ -60,6 +64,9 @@ class NodeInfo:
         self.pods[key] = pod
         for res, amt in t.pod_resource_requests(pod).items():
             self.requested[res] = self.requested.get(res, 0.0) + amt
+        for ref in pod.metadata.owner_references:
+            if ref.controller:
+                self.owner_counts[ref.uid] = self.owner_counts.get(ref.uid, 0) + 1
         for cid in t.pod_tpu_assigned(pod):
             chip = self.free_chips.pop(cid, None)
             if chip is not None or cid not in self.chip_owner:
@@ -74,6 +81,11 @@ class NodeInfo:
             self.requested[res] = self.requested.get(res, 0.0) - amt
             if abs(self.requested[res]) < 1e-9:
                 del self.requested[res]
+        for ref in pod.metadata.owner_references:
+            if ref.controller and ref.uid in self.owner_counts:
+                self.owner_counts[ref.uid] -= 1
+                if self.owner_counts[ref.uid] <= 0:
+                    del self.owner_counts[ref.uid]
         topo = self.node.status.tpu if self.node else None
         healthy = {c.id: c for c in (topo.chips if topo else [])
                    if c.health == t.TPU_HEALTHY}

@@ -47,6 +47,8 @@ class Scheduler:
         self._informers: list[SharedInformer] = []
         self._task: Optional[asyncio.Task] = None
         self._stopped = False
+        self._bind_sem = asyncio.Semaphore(64)
+        self._bind_tasks: set[asyncio.Task] = set()
 
     # -- wiring (reference: factory.go:137 NewConfigFactory) --------------
 
@@ -138,14 +140,14 @@ class Scheduler:
 
     async def _schedule_one(self, pod: t.Pod) -> None:
         start = time.perf_counter()
-        try:
-            current = await self.client.get("pods", pod.metadata.namespace,
-                                            pod.metadata.name)
-        except errors.NotFoundError:
+        # The informer feeds the queue, so the queued copy is the cache's
+        # view (reference: scheduleOne takes the pod from NextPod without
+        # a live GET). Already-bound/terminal pods are skipped here; a
+        # pod deleted-while-queued fails its bind and is dropped then.
+        key = pod.key()
+        if (pod.spec.node_name or not t.is_pod_active(pod)
+                or key in self.cache.assumed or key in self.cache._pod_node):
             return
-        if current.spec.node_name or not t.is_pod_active(current):
-            return
-        pod = current
 
         node_name, bindings, reasons = self._find_placement(pod)
         m.ALGORITHM_LATENCY.observe(time.perf_counter() - start)
@@ -160,23 +162,35 @@ class Scheduler:
                     claim.assigned = list(b.chip_ids)
         self.cache.assume_pod(assumed, node_name)
 
-        bind_start = time.perf_counter()
-        try:
-            await self.client.bind(pod.metadata.namespace, pod.metadata.name,
-                                   t.Binding(target=t.BindingTarget(
-                                       node_name=node_name, tpu_bindings=bindings)))
-        except Exception as e:  # noqa: BLE001
-            self.cache.forget_pod(assumed)
-            log.warning("bind %s -> %s failed: %s", pod.key(), node_name, e)
-            self.recorder.event(pod, "Warning", "FailedBinding", str(e))
-            await self.queue.requeue(pod, self.backoff_seconds)
-            m.PODS_SCHEDULED.inc(result="bind_error")
-            return
-        m.BINDING_LATENCY.observe(time.perf_counter() - bind_start)
-        m.E2E_SCHEDULING_LATENCY.observe(time.perf_counter() - start)
-        m.PODS_SCHEDULED.inc(result="ok")
-        self.recorder.event(pod, "Normal", "Scheduled",
-                            f"assigned to {node_name}")
+        # Bind asynchronously (reference: scheduler.go:484-495 binds in a
+        # goroutine) so the next pod's placement overlaps this pod's RPC;
+        # the semaphore bounds in-flight binds.
+        async def bind_task():
+            bind_start = time.perf_counter()
+            try:
+                async with self._bind_sem:
+                    await self.client.bind(
+                        pod.metadata.namespace, pod.metadata.name,
+                        t.Binding(target=t.BindingTarget(
+                            node_name=node_name, tpu_bindings=bindings)))
+            except Exception as e:  # noqa: BLE001
+                self.cache.forget_pod(assumed)
+                if isinstance(e, errors.NotFoundError):
+                    return  # pod deleted while queued
+                log.warning("bind %s -> %s failed: %s", pod.key(), node_name, e)
+                self.recorder.event(pod, "Warning", "FailedBinding", str(e))
+                await self.queue.requeue(pod, self.backoff_seconds)
+                m.PODS_SCHEDULED.inc(result="bind_error")
+                return
+            m.BINDING_LATENCY.observe(time.perf_counter() - bind_start)
+            m.E2E_SCHEDULING_LATENCY.observe(time.perf_counter() - start)
+            m.PODS_SCHEDULED.inc(result="ok")
+            self.recorder.event(pod, "Normal", "Scheduled",
+                                f"assigned to {node_name}")
+
+        task = asyncio.get_running_loop().create_task(bind_task())
+        self._bind_tasks.add(task)
+        task.add_done_callback(self._bind_tasks.discard)
 
     def _find_placement(self, pod: t.Pod):
         """findNodesThatFit + PrioritizeNodes + selectHost.
@@ -216,20 +230,15 @@ class Scheduler:
         return best, bindings_by_node.get(best, []), []
 
     def _sibling_counts(self, pod: t.Pod) -> dict[str, int]:
-        """Same-controller pods per node (SelectorSpreadPriority input)."""
+        """Same-controller pods per node (SelectorSpreadPriority input).
+        Reads the cache's incrementally-maintained owner index — O(nodes)
+        per placement, where the naive scan was O(nodes * pods) (the
+        round-1 density bottleneck)."""
         ref = next((r for r in pod.metadata.owner_references if r.controller), None)
         if ref is None:
             return {}
-        counts: dict[str, int] = {}
-        for info in self.cache.nodes.values():
-            if info.node is None:
-                continue
-            n = 0
-            for p in info.pods.values():
-                if any(r.uid == ref.uid for r in p.metadata.owner_references):
-                    n += 1
-            counts[info.node.metadata.name] = n
-        return counts
+        return {info.node.metadata.name: info.owner_counts.get(ref.uid, 0)
+                for info in self.cache.nodes.values() if info.node is not None}
 
     async def _handle_unschedulable(self, pod: t.Pod, reasons: list[str]) -> None:
         brief = "; ".join(reasons[:3]) or "no nodes available"

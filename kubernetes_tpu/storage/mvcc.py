@@ -65,24 +65,52 @@ class StoredObject:
 
 
 class Watch:
-    """One watcher: an unbounded queue bridged onto an asyncio loop.
+    """One watcher: a bounded queue bridged onto an asyncio loop.
 
     ``cancel()`` is idempotent; after cancel the stream ends with None.
+
+    Backpressure (reference: the apiserver watch cache terminates
+    watchers that cannot keep up rather than buffering unboundedly —
+    the client relists and re-watches): when more than ``queue_limit``
+    events are in flight, the watch is closed with ``overflowed`` set.
     """
 
-    def __init__(self, store: "MVCCStore", prefix: str, loop: asyncio.AbstractEventLoop):
+    def __init__(self, store: "MVCCStore", prefix: str,
+                 loop: asyncio.AbstractEventLoop, queue_limit: int = 16384):
         self._store = store
         self.prefix = prefix
         self._loop = loop
         self._queue: asyncio.Queue[Optional[WatchEvent]] = asyncio.Queue()
         self._cancelled = False
+        self._queue_limit = queue_limit
+        self._pending = 0
+        self._pending_lock = threading.Lock()
         #: Set once the end-of-stream sentinel has been consumed; lets
         #: callers distinguish 'stream ended' from 'idle timeout'.
         self.closed = False
+        #: True when the stream was closed because the consumer was too
+        #: slow (the client must relist).
+        self.overflowed = False
 
     def _deliver(self, ev: Optional[WatchEvent]) -> None:
         # Called with store lock held, possibly from a foreign thread.
+        if ev is not None:
+            with self._pending_lock:
+                self._pending += 1
+                if self._pending > self._queue_limit:
+                    if not self.overflowed:
+                        self.overflowed = True
+                        # Terminate instead of buffering forever; the
+                        # end-of-stream sentinel jumps the queue.
+                        self._loop.call_soon_threadsafe(
+                            self._queue.put_nowait, None)
+                        self._store._remove_watch(self)
+                    return
         self._loop.call_soon_threadsafe(self._queue.put_nowait, ev)
+
+    def _consumed(self) -> None:
+        with self._pending_lock:
+            self._pending -= 1
 
     def cancel(self) -> None:
         if not self._cancelled:
@@ -97,6 +125,7 @@ class Watch:
         ev = await self._queue.get()
         if ev is None:
             raise StopAsyncIteration
+        self._consumed()
         return ev
 
     async def next(self, timeout: Optional[float] = None) -> Optional[WatchEvent]:
@@ -112,6 +141,8 @@ class Watch:
                 return None
         if ev is None:
             self.closed = True
+        else:
+            self._consumed()
         return ev
 
 
@@ -375,7 +406,8 @@ class MVCCStore:
                 for ev in self._log[idx:]:
                     if ev.key.startswith(prefix):
                         wch._deliver(ev)
-            self._watches.append(wch)
+            if not wch.overflowed:  # replay itself may have overflowed
+                self._watches.append(wch)
             return wch
 
     def _remove_watch(self, wch: Watch) -> None:

@@ -1,0 +1,67 @@
+"""Hollow fleet (kubemark analog) over the real HTTP apiserver:
+pods — including TPU pods — reach Running on hollow nodes.
+Reference: ``pkg/kubemark/hollow_kubelet.go:49``."""
+import asyncio
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.apiserver.admission import default_chain
+from kubernetes_tpu.apiserver.registry import Registry
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.client.local import LocalClient
+from kubernetes_tpu.perf.hollow import HollowFleet
+from kubernetes_tpu.scheduler.scheduler import Scheduler
+
+
+async def test_hollow_fleet_runs_pods():
+    reg = Registry()
+    reg.admission = default_chain(reg)
+    reg.create(t.Namespace(metadata=ObjectMeta(name="default")))
+    reg.create(t.Namespace(metadata=ObjectMeta(name="kube-system")))
+    server = APIServer(reg)
+    port = await server.start()
+    base = f"http://127.0.0.1:{port}"
+
+    fleet = HollowFleet(base, n_nodes=10, tpu_chips=4,
+                        status_interval=0.5, heartbeat_interval=0.5,
+                        pleg_interval=0.3)
+    local = LocalClient(reg)
+    sched = Scheduler(local, backoff_seconds=0.3)
+    try:
+        await fleet.start()
+        await sched.start()
+
+        # wait for all hollow nodes Ready with TPU capacity
+        for _ in range(100):
+            nodes, _ = await local.list("nodes")
+            ready = [n for n in nodes
+                     if (c := t.get_node_condition(n.status, t.NODE_READY))
+                     and c.status == "True"
+                     and n.status.capacity.get(t.RESOURCE_TPU) == 4.0]
+            if len(ready) == 10:
+                break
+            await asyncio.sleep(0.2)
+        assert len(ready) == 10
+
+        for i in range(30):
+            pod = t.Pod(
+                metadata=ObjectMeta(name=f"p-{i:03d}", namespace="default"),
+                spec=t.PodSpec(containers=[t.Container(name="c", image="pause")]))
+            if i % 3 == 0:
+                pod.spec.containers[0].tpu_requests = ["tpu"]
+                pod.spec.tpu_resources = [t.PodTpuRequest(name="tpu", chips=2)]
+            reg.create(pod)
+
+        for _ in range(200):
+            pods, _ = await local.list("pods", "default")
+            running = [p for p in pods if p.status.phase == t.POD_RUNNING]
+            if len(running) == 30:
+                break
+            await asyncio.sleep(0.2)
+        assert len(running) == 30, f"only {len(running)}/30 running"
+        tpu_pods = [p for p in running if p.spec.tpu_resources]
+        assert all(len(p.spec.tpu_resources[0].assigned) == 2 for p in tpu_pods)
+    finally:
+        await sched.stop()
+        await fleet.stop()
+        await server.stop()
