@@ -158,8 +158,22 @@ class ResourceQuotaPlugin(AdmissionPlugin):
             return
         from .quota import pod_usage
         want = pod_usage(pod)
+        charged: list[str] = []
         for q in quotas:
-            self._charge(ns, q.metadata.name, want)
+            try:
+                self._charge(ns, q.metadata.name, want)
+                charged.append(q.metadata.name)
+            except errors.StatusError:
+                # Roll back quotas charged earlier in the loop so a
+                # rejected pod doesn't leave used inflated until the
+                # quota controller's next full recount.
+                negative = {res: -amt for res, amt in want.items()}
+                for name in charged:
+                    try:
+                        self._charge(ns, name, negative)
+                    except errors.StatusError:
+                        pass  # controller resync heals residual drift
+                raise
 
     def _charge(self, ns: str, quota_name: str, want: dict) -> None:
         for _ in range(self.CAS_RETRIES):
@@ -179,7 +193,9 @@ class ResourceQuotaPlugin(AdmissionPlugin):
                         f"exceeded quota {quota_name!r}: requested "
                         f"{res}={amt:g}, used {used.get(res, 0.0):g}, "
                         f"hard limit {hard:g}")
-                used[res] = used.get(res, 0.0) + amt
+                # Clamp: a rollback racing the controller's recount must
+                # not drive usage negative.
+                used[res] = max(0.0, used.get(res, 0.0) + amt)
             cur.status.used = used
             cur.status.hard = dict(cur.spec.hard)
             try:

@@ -1,0 +1,72 @@
+"""Native (C++) fast paths, loaded via ctypes.
+
+No pybind11 in this environment, so each native component is a small
+C ABI (``extern "C"``) shared object compiled on demand with g++ and
+bound with :mod:`ctypes`. Every native path has a pure-Python
+reference implementation that is the semantic source of truth; the
+native library is an accelerator, never a behavior change
+(equivalence is enforced by tests/unit/test_submesh_native.py).
+
+Currently shipped:
+
+- ``submesh.cpp`` — contiguous sub-mesh box search used by the
+  scheduler's TPU placement (see scheduler/submesh.py).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+from typing import Optional
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "submesh.cpp")
+_LIB = os.path.join(_DIR, "_submesh.so")
+
+_submesh_lib: Optional[ctypes.CDLL] = None
+_submesh_tried = False
+
+
+def _build(src: str, lib: str) -> None:
+    """Compile src -> lib atomically (tmp + rename survives races)."""
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=_DIR)
+    os.close(fd)
+    try:
+        subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", src, "-o", tmp],
+            check=True, capture_output=True, timeout=120)
+        os.replace(tmp, lib)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+def load_submesh() -> Optional[ctypes.CDLL]:
+    """The submesh shared library, building it if needed.
+
+    Returns None when g++ is unavailable or the build fails; callers
+    fall back to the Python implementation. Result is cached (including
+    a negative result) for the process lifetime.
+    """
+    global _submesh_lib, _submesh_tried
+    if _submesh_tried:
+        return _submesh_lib
+    _submesh_tried = True
+    try:
+        if (not os.path.exists(_LIB)
+                or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)):
+            _build(_SRC, _LIB)
+        lib = ctypes.CDLL(_LIB)
+        lib.tpu_find_box.restype = ctypes.c_int
+        lib.tpu_find_box.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8),   # free mask
+            ctypes.POINTER(ctypes.c_int32),   # mesh[3]
+            ctypes.POINTER(ctypes.c_int32),   # shape[3]
+            ctypes.c_int32,                   # torus
+            ctypes.POINTER(ctypes.c_int32),   # out[6]
+        ]
+        _submesh_lib = lib
+    except Exception:
+        _submesh_lib = None
+    return _submesh_lib

@@ -1,0 +1,153 @@
+// Contiguous sub-mesh box search — native fast path.
+//
+// Same contract as kubernetes_tpu/scheduler/submesh.py:find_box (which
+// is the reference implementation): given a free/occupied mask over a
+// 3D (torus) chip mesh and a requested box shape, return the best free
+// axis-aligned box over all axis permutations of the shape, scored by
+// corner packing (fewest free neighbors outside the box).
+//
+// Design: a summed-area table over the mesh tiled 2x along each torus
+// axis makes every "is this (possibly wrapped) box fully free?" test
+// and every face-slab score O(1), so a full scan of all origins for
+// one permutation is O(mesh volume). At 8k chips x 6 permutations this
+// is well under a millisecond — the scale the Python reference scan
+// (O(volume) per origin) cannot reach. The scheduler calls this per
+// pod placement, so it is a hot path at density scale.
+//
+// Replaces the role of the reference's flat extended-resource counter
+// (plugin/pkg/scheduler/core; no geometry there) with TPU ICI-aware
+// placement. Exposed via ctypes — no pybind11 in this environment.
+
+#include <algorithm>
+#include <cstdint>
+#include <vector>
+
+namespace {
+
+struct Prefix {
+  // P has dims (tx+1, ty+1, tz+1); P[i][j][k] = sum of tiled mask over
+  // [0,i) x [0,j) x [0,k).
+  std::vector<int32_t> p;
+  int ny1, nz1;
+
+  inline int32_t at(int i, int j, int k) const {
+    return p[(static_cast<int64_t>(i) * ny1 + j) * nz1 + k];
+  }
+
+  // Sum over [l0,h0) x [l1,h1) x [l2,h2) of the tiled mask.
+  inline int32_t rect(int l0, int h0, int l1, int h1, int l2, int h2) const {
+    return at(h0, h1, h2) - at(l0, h1, h2) - at(h0, l1, h2) - at(h0, h1, l2) +
+           at(l0, l1, h2) + at(l0, h1, l2) + at(h0, l1, l2) - at(l0, l1, l2);
+  }
+};
+
+void build_prefix(const uint8_t* mask, const int32_t m[3], bool torus,
+                  int t[3], Prefix& pre) {
+  for (int a = 0; a < 3; ++a) t[a] = torus ? 2 * m[a] : m[a];
+  pre.ny1 = t[1] + 1;
+  pre.nz1 = t[2] + 1;
+  pre.p.assign(static_cast<size_t>(t[0] + 1) * pre.ny1 * pre.nz1, 0);
+  for (int x = 0; x < t[0]; ++x)
+    for (int y = 0; y < t[1]; ++y) {
+      const uint8_t* row = mask + (static_cast<int64_t>(x % m[0]) * m[1] +
+                                   (y % m[1])) * m[2];
+      int32_t acc = 0;
+      for (int z = 0; z < t[2]; ++z) {
+        acc += row[z % m[2]];
+        // P[x+1][y+1][z+1] = row acc + P[x][y+1][z+1] + P[x+1][y][z+1]
+        //                    - P[x][y][z+1]
+        pre.p[(static_cast<int64_t>(x + 1) * pre.ny1 + y + 1) * pre.nz1 + z + 1] =
+            acc + pre.at(x, y + 1, z + 1) + pre.at(x + 1, y, z + 1) -
+            pre.at(x, y, z + 1);
+      }
+    }
+}
+
+}  // namespace
+
+extern "C" {
+
+// free_mask: row-major uint8 over mesh dims, 1 = free chip.
+// mesh, shape: 3 ints (pad with 1s for lower-rank meshes).
+// On success returns 1 and fills out[0..2] = origin, out[3..5] = the
+// winning permutation of shape. Returns 0 when no free box exists.
+int tpu_find_box(const uint8_t* free_mask, const int32_t* mesh_in,
+                 const int32_t* shape_in, int32_t torus_in, int32_t* out) {
+  const bool torus = torus_in != 0;
+  int32_t m[3] = {mesh_in[0], mesh_in[1], mesh_in[2]};
+  int32_t s0[3] = {shape_in[0], shape_in[1], shape_in[2]};
+  for (int a = 0; a < 3; ++a)
+    if (m[a] <= 0 || s0[a] <= 0) return 0;
+
+  int t[3];
+  Prefix pre;
+  build_prefix(free_mask, m, torus, t, pre);
+
+  // Unique permutations in lexicographic order (matches the Python
+  // fallback's sorted(set(permutations(shape)))).
+  int32_t perm[3] = {s0[0], s0[1], s0[2]};
+  std::sort(perm, perm + 3);
+
+  int64_t best_score = -1;
+  int32_t best_origin[3] = {0, 0, 0}, best_shape[3] = {0, 0, 0};
+
+  do {
+    const int32_t s[3] = {perm[0], perm[1], perm[2]};
+    if (s[0] > m[0] || s[1] > m[1] || s[2] > m[2]) continue;
+    const int32_t vol = s[0] * s[1] * s[2];
+    const int32_t hi[3] = {torus ? m[0] : m[0] - s[0] + 1,
+                           torus ? m[1] : m[1] - s[1] + 1,
+                           torus ? m[2] : m[2] - s[2] + 1};
+    for (int o0 = 0; o0 < hi[0]; ++o0)
+      for (int o1 = 0; o1 < hi[1]; ++o1)
+        for (int o2 = 0; o2 < hi[2]; ++o2) {
+          if (pre.rect(o0, o0 + s[0], o1, o1 + s[1], o2, o2 + s[2]) != vol)
+            continue;
+          // Corner-packing score: free cells in the face slabs adjacent
+          // to the box (one cross-section per face; each slab cell is
+          // the unique outside neighbor of one box cell).
+          const int32_t o[3] = {o0, o1, o2};
+          int64_t score = 0;
+          for (int a = 0; a < 3; ++a) {
+            if (s[a] >= m[a]) continue;  // box spans the ring: no outside
+            int l[3] = {o[0], o[1], o[2]}, h[3] = {o[0] + s[0], o[1] + s[1],
+                                                   o[2] + s[2]};
+            if (torus) {
+              int low = (o[a] - 1 + m[a]) % m[a];
+              l[a] = low; h[a] = low + 1;
+              score += pre.rect(l[0], h[0], l[1], h[1], l[2], h[2]);
+              // m==2, s==1: the -1 and +1 neighbor of a box cell are the
+              // same chip; the reference counts it once.
+              if (!(m[a] == 2 && s[a] == 1)) {
+                int high = (o[a] + s[a]) % m[a];
+                l[a] = high; h[a] = high + 1;
+                score += pre.rect(l[0], h[0], l[1], h[1], l[2], h[2]);
+              }
+            } else {
+              if (o[a] - 1 >= 0) {
+                l[a] = o[a] - 1; h[a] = o[a];
+                score += pre.rect(l[0], h[0], l[1], h[1], l[2], h[2]);
+              }
+              if (o[a] + s[a] < m[a]) {
+                l[a] = o[a] + s[a]; h[a] = o[a] + s[a] + 1;
+                score += pre.rect(l[0], h[0], l[1], h[1], l[2], h[2]);
+              }
+            }
+          }
+          if (best_score < 0 || score < best_score) {
+            best_score = score;
+            best_origin[0] = o0; best_origin[1] = o1; best_origin[2] = o2;
+            best_shape[0] = s[0]; best_shape[1] = s[1]; best_shape[2] = s[2];
+            if (score == 0) goto done;
+          }
+        }
+  } while (std::next_permutation(perm, perm + 3));
+
+done:
+  if (best_score < 0) return 0;
+  out[0] = best_origin[0]; out[1] = best_origin[1]; out[2] = best_origin[2];
+  out[3] = best_shape[0]; out[4] = best_shape[1]; out[5] = best_shape[2];
+  return 1;
+}
+
+}  // extern "C"

@@ -32,22 +32,9 @@ def hollow_node(name: str, cpu: float = 32.0, mem: float = 128 * 2**30,
     node.status.capacity = {"cpu": cpu, "memory": mem, "pods": float(pods)}
     node.status.conditions = [t.NodeCondition(type=t.NODE_READY, status="True")]
     if tpu_chips:
-        if mesh_shape:
-            shape = mesh_shape
-        elif tpu_chips % 4 == 0:
-            shape = [2, 2, tpu_chips // 4]
-        else:
-            shape = [tpu_chips, 1, 1]
-        if shape[0] * shape[1] * shape[2] != tpu_chips:
-            raise ValueError(f"mesh_shape {shape} != {tpu_chips} chips")
-        node.status.tpu = t.TpuTopology(
-            chip_type="v5p", slice_id=slice_id or f"slice-{name}",
-            mesh_shape=shape,
-            chips=[t.TpuChip(id=f"{name}-c{i}",
-                             coords=[i % shape[0], (i // shape[0]) % shape[1],
-                                     i // (shape[0] * shape[1])],
-                             attributes={"chip_type": "v5p"})
-                   for i in range(tpu_chips)])
+        from .hollow import hollow_topology
+        node.status.tpu = hollow_topology(name, tpu_chips, mesh_shape,
+                                          slice_id=slice_id)
         node.status.capacity[t.RESOURCE_TPU] = float(tpu_chips)
     node.status.allocatable = dict(node.status.capacity)
     return node
@@ -105,10 +92,13 @@ async def run_density(n_nodes: int = 100, n_pods: int = 3000,
     stream = await client.watch("pods", namespace="default")
 
     async def count_bound():
+        # Watch-first; if the stream closes (slow-consumer overflow at
+        # high density), fall back to relisting — the reflector's
+        # recovery — instead of hanging until the harness timeout.
         while True:
             ev = await stream.next()
             if ev is None or ev[0] == "CLOSED":
-                return
+                break
             ev_type, pod = ev
             if ev_type == "BOOKMARK":
                 continue
@@ -117,6 +107,15 @@ async def run_density(n_nodes: int = 100, n_pods: int = 3000,
                 if len(bound) >= n_pods:
                     done.set()
                     return
+        while not done.is_set():
+            pods, _ = await client.list("pods", namespace="default")
+            for pod in pods:
+                if pod.spec.node_name:
+                    bound[pod.metadata.name] = pod.spec.node_name
+            if len(bound) >= n_pods:
+                done.set()
+                return
+            await asyncio.sleep(0.5)
 
     async def create_all():
         it = iter(range(n_pods))

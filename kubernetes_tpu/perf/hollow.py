@@ -65,11 +65,18 @@ class StaticDeviceManager(DeviceManager):
         return env, [], [], {}
 
 
-def hollow_topology(name: str, chips: int, mesh_shape=None) -> t.TpuTopology:
-    shape = mesh_shape or ((2, 2, chips // 4) if chips % 4 == 0
-                           else (chips, 1, 1))
+def hollow_topology(name: str, chips: int, mesh_shape=None,
+                    slice_id: str = "") -> t.TpuTopology:
+    """Stub TPU topology for hollow nodes — the single source for both
+    agent-backed fleets (here) and API-object-only nodes
+    (:func:`kubernetes_tpu.perf.density.hollow_node`)."""
+    shape = list(mesh_shape) if mesh_shape else (
+        [2, 2, chips // 4] if chips % 4 == 0 else [chips, 1, 1])
+    if shape[0] * shape[1] * shape[2] != chips:
+        raise ValueError(f"mesh_shape {shape} != {chips} chips")
     return t.TpuTopology(
-        chip_type="v5p", slice_id=f"slice-{name}", mesh_shape=list(shape),
+        chip_type="v5p", slice_id=slice_id or f"slice-{name}",
+        mesh_shape=shape,
         chips=[t.TpuChip(
             id=f"{name}-c{i}", health=t.TPU_HEALTHY,
             coords=[i % shape[0], (i // shape[0]) % shape[1],

@@ -126,8 +126,13 @@ class SchedulerCache:
         self.slices: dict[str, SliceInfo] = {}
         #: pod key -> node name for assumed (bound-in-flight) pods.
         self.assumed: dict[str, str] = {}
-        #: pod keys -> Pod for pods known to the cache (assumed or added).
+        #: pod key -> node name for every pod known to the cache
+        #: (assumed or informer-added).
         self._pod_node: dict[str, str] = {}
+
+    def knows_pod(self, key: str) -> bool:
+        """True when the cache already tracks this pod (assumed or added)."""
+        return key in self.assumed or key in self._pod_node
 
     # -- nodes ------------------------------------------------------------
 

@@ -79,6 +79,12 @@ class Scheduler:
                 await self._task
             except asyncio.CancelledError:
                 pass
+        # In-flight binds hold the REST client; finish or cancel them
+        # before the caller tears the client/apiserver down.
+        for task in list(self._bind_tasks):
+            task.cancel()
+        if self._bind_tasks:
+            await asyncio.gather(*self._bind_tasks, return_exceptions=True)
         for inf in self._informers:
             await inf.stop()
 
@@ -146,7 +152,7 @@ class Scheduler:
         # pod deleted-while-queued fails its bind and is dropped then.
         key = pod.key()
         if (pod.spec.node_name or not t.is_pod_active(pod)
-                or key in self.cache.assumed or key in self.cache._pod_node):
+                or self.cache.knows_pod(key)):
             return
 
         node_name, bindings, reasons = self._find_placement(pod)

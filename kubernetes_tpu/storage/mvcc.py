@@ -258,7 +258,10 @@ class MVCCStore:
                 "rev": ev.revision, "op": ev.type, "key": ev.key,
                 "value": ev.value,
             }, separators=(",", ":")) + "\n")
-        for wch in self._watches:
+        # Snapshot: an overflowing watcher removes itself from _watches
+        # during _deliver; mutating the live list mid-iteration would
+        # silently skip the next watcher's delivery of this event.
+        for wch in list(self._watches):
             if ev.key.startswith(wch.prefix):
                 wch._deliver(ev)
 

@@ -1,0 +1,108 @@
+"""Native / numpy / brute-force sub-mesh allocator equivalence + perf.
+
+The C++ path (native/submesh.cpp) and the numpy path must agree with
+the brute-force reference on found-ness and packing score for random
+occupancy patterns, and the production find_box must sustain p99 <
+10ms box searches on an 8k-chip mesh under fragmentation churn
+(VERDICT round-1 item 8; no reference analog — SURVEY §7 hard part).
+"""
+import itertools
+import random
+import time
+
+import pytest
+
+from kubernetes_tpu.native import load_submesh
+from kubernetes_tpu.scheduler import submesh as sm
+
+
+def _assert_valid_box(cells, free, mesh, shape, torus):
+    """cells is a free axis-aligned box of some permutation of shape."""
+    assert cells is not None
+    cellset = set(cells)
+    assert cellset <= free
+    shape_n = sm.normalize_shape(shape, len(mesh))
+    vol = 1
+    for d in shape_n:
+        vol *= d
+    assert len(cellset) == vol
+    # It must be reconstructible as box_coords(origin, perm) for some
+    # origin/permutation.
+    for perm in set(itertools.permutations(shape_n)):
+        for origin in cells:
+            got = sm.box_coords(origin, perm, tuple(mesh), torus)
+            if got is not None and set(got) == cellset:
+                return
+    pytest.fail(f"cells {sorted(cellset)} are not an axis-aligned box of {shape}")
+
+
+@pytest.mark.parametrize("mesh,torus", [
+    ((4, 4, 2), True),
+    ((4, 4, 2), False),
+    ((5, 3), True),
+    ((4, 4), False),
+    ((2, 2, 2), True),
+    ((3, 3, 3), True),
+])
+def test_implementations_agree(mesh, torus):
+    rng = random.Random(0xC0FFEE)
+    all_cells = list(itertools.product(*(range(m) for m in mesh)))
+    lib = load_submesh()
+    for _ in range(40):
+        free = {c for c in all_cells if rng.random() < 0.65}
+        ndims = rng.randint(1, len(mesh))
+        shape = tuple(rng.randint(1, mesh[i]) for i in range(ndims))
+        shape_n = sm.normalize_shape(shape, len(mesh))
+
+        ref = sm._find_box_reference(free, mesh, shape, torus)
+        got_np = sm._find_box_numpy(free, tuple(mesh), shape_n, torus)
+
+        if ref is None:
+            assert got_np is None
+        else:
+            _assert_valid_box(got_np, free, mesh, shape, torus)
+            # Equal packing quality (the actual contract; cell choice may
+            # legitimately differ only if scores tie — here scan order is
+            # pinned, so they must match exactly).
+            assert sm._packing_score(got_np, free, tuple(mesh), torus) == \
+                sm._packing_score(ref, free, tuple(mesh), torus)
+
+        if lib is not None and len(mesh) <= 3:
+            got_c = sm._find_box_native(free, tuple(mesh), shape_n, torus)
+            assert got_c is not NotImplemented
+            if ref is None:
+                assert got_c is None
+            else:
+                _assert_valid_box(got_c, free, mesh, shape, torus)
+                assert sm._packing_score(got_c, free, tuple(mesh), torus) == \
+                    sm._packing_score(ref, free, tuple(mesh), torus)
+
+
+def test_native_library_builds():
+    """The environment ships g++; the fast path must actually exist."""
+    assert load_submesh() is not None
+
+
+def test_find_box_8k_chip_churn_p99():
+    """p99 box search < 10ms on a 16x16x32 (8192 chip) mesh with churn."""
+    mesh = (16, 16, 32)
+    free = set(itertools.product(*(range(m) for m in mesh)))
+    rng = random.Random(7)
+    shapes = [(4, 4, 4), (2, 2, 2), (8, 8, 4), (4, 4, 8), (2, 2, 4)]
+    live = []
+    times = []
+    for i in range(120):
+        shape = shapes[i % len(shapes)]
+        t0 = time.perf_counter()
+        cells = sm.find_box(free, mesh, shape)
+        times.append(time.perf_counter() - t0)
+        if cells is not None:
+            free -= set(cells)
+            live.append(cells)
+        # Churn: free a random earlier allocation every other step.
+        if live and i % 2 == 1:
+            victim = live.pop(rng.randrange(len(live)))
+            free |= set(victim)
+    times.sort()
+    p99 = times[int(len(times) * 0.99) - 1]
+    assert p99 < 0.010, f"p99 box search {p99 * 1e3:.2f}ms >= 10ms"
