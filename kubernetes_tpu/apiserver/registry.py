@@ -128,6 +128,10 @@ class Registry:
         self.admission = admission
         self._by_plural: dict[str, ResourceSpec] = {}
         self._by_kind: dict[str, ResourceSpec] = {}
+        self.service_cidr = "10.96.0.0/16"
+        self.cluster_cidr = "10.64.0.0/16"
+        self._svc_ips = None     # lazy ServiceIPAllocator
+        self._node_cidrs = None  # lazy CIDRAllocator
         for spec in builtin_resources():
             self.add_resource(spec)
 
@@ -197,10 +201,79 @@ class Registry:
             spec.validate_create(obj)
         if dry_run:
             return obj
+        # IP/CIDR allocation happens last — after admission/validation/
+        # dry_run — and is rolled back if the store insert fails
+        # (AlreadyExists on node re-registration must not leak a block).
+        allocated: list[tuple] = []
+        if isinstance(obj, t.Service) and not obj.spec.cluster_ip:
+            self._prepare_service(obj)
+            allocated.append((self._svc_ips.release, obj.spec.cluster_ip))
+        if isinstance(obj, t.Node) and not obj.spec.pod_cidr:
+            self._prepare_node(obj)
+            allocated.append((self._node_cidrs.release, obj.spec.pod_cidr))
         key = self._key(spec, meta.namespace, meta.name)
-        rev = self.store.create(key, self._encode(obj))
+        try:
+            rev = self.store.create(key, self._encode(obj))
+        except Exception:
+            for release, value in allocated:
+                if value and value != "None":
+                    release(value)
+            raise
+        # Client-specified VIP/CIDR: mark it used so the allocators
+        # (if already initialized) never hand the same value out again.
+        if isinstance(obj, t.Service) and self._svc_ips is not None \
+                and obj.spec.cluster_ip and obj.spec.cluster_ip != "None":
+            self._svc_ips.occupy(obj.spec.cluster_ip)
+        if isinstance(obj, t.Node) and self._node_cidrs is not None \
+                and obj.spec.pod_cidr:
+            self._node_cidrs.occupy(obj.spec.pod_cidr)
         meta.resource_version = str(rev)
         return obj
+
+    def _prepare_service(self, svc: t.Service) -> None:
+        """Service create strategy: allocate the cluster VIP (reference:
+        ``pkg/registry/core/service/storage`` + ipallocator). Headless
+        services (cluster_ip "None") keep their sentinel."""
+        if svc.spec.cluster_ip:
+            return
+        if self._svc_ips is None:
+            from ..net.ipam import ServiceIPAllocator
+            alloc = ServiceIPAllocator(self.service_cidr)
+            stored, _rev = self.store.list("/registry/services/", copy=False)
+            for s in stored:
+                ip = (s.value.get("spec") or {}).get("cluster_ip", "")
+                if ip and ip != "None":
+                    alloc.occupy(ip)
+            self._svc_ips = alloc
+        svc.spec.cluster_ip = self._svc_ips.allocate()
+
+    def _prepare_node(self, node: t.Node) -> None:
+        """Node create strategy: assign the pod CIDR at birth so the
+        agent never races the IPAM controller for its first pod IP
+        (the controller keeps covering pre-existing durable nodes)."""
+        if node.spec.pod_cidr:
+            return
+        if self._node_cidrs is None:
+            from ..net.ipam import CIDRAllocator
+            alloc = CIDRAllocator(self.cluster_cidr)
+            stored, _rev = self.store.list("/registry/nodes/", copy=False)
+            for s in stored:
+                cidr = (s.value.get("spec") or {}).get("pod_cidr", "")
+                if cidr:
+                    alloc.occupy(cidr)
+            self._node_cidrs = alloc
+        node.spec.pod_cidr = self._node_cidrs.allocate()
+
+    def _release_ips(self, obj: TypedObject) -> None:
+        """Return an object's IP/CIDR allocation on actual removal —
+        both the delete() path and the finalizer-completion path in
+        update()."""
+        if isinstance(obj, t.Service) and self._svc_ips is not None \
+                and obj.spec.cluster_ip and obj.spec.cluster_ip != "None":
+            self._svc_ips.release(obj.spec.cluster_ip)
+        if isinstance(obj, t.Node) and self._node_cidrs is not None \
+                and obj.spec.pod_cidr:
+            self._node_cidrs.release(obj.spec.pod_cidr)
 
     def get(self, plural: str, namespace: str, name: str) -> TypedObject:
         spec = self.spec_for(plural)
@@ -272,8 +345,18 @@ class Registry:
         if new.metadata.deletion_timestamp is not None \
                 and not new.metadata.finalizers and not ns_finalizers:
             self.store.delete(key, expected_revision=stored.mod_revision)
+            self._release_ips(new)
             new.metadata.resource_version = str(self.store.revision)
             return new
+        # The registry is the ONLY pod-CIDR allocator (a second,
+        # controller-side allocator would race it): nodes that still
+        # lack a CIDR — legacy durable data — get one on their next
+        # write (the IPAM controller just triggers that write).
+        if isinstance(new, t.Node) and subresource != "status":
+            if not new.spec.pod_cidr:
+                self._prepare_node(new)
+            elif self._node_cidrs is not None:
+                self._node_cidrs.occupy(new.spec.pod_cidr)
         rev = self.store.update(key, self._encode(new),
                                 expected_revision=stored.mod_revision)
         new.metadata.resource_version = str(rev)
@@ -363,6 +446,7 @@ class Registry:
             # confirmation) completes removal — reference semantics.
             return obj
         self.store.delete(key, expected_revision=stored.mod_revision)
+        self._release_ips(obj)
         return obj
 
     def delete_collection(self, plural: str, namespace: str = "",

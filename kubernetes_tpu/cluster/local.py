@@ -35,6 +35,7 @@ from ..client.local import LocalClient
 from ..client.rest import RESTClient
 from ..controllers.manager import ControllerManager
 from ..deviceplugin.stub import StubTpuPlugin, make_topology
+from ..net.proxy import ServiceProxy
 from ..node.agent import NodeAgent
 from ..node.devicemanager import DeviceManager
 from ..node.runtime import FakeRuntime, ProcessRuntime
@@ -54,9 +55,12 @@ class LocalNode:
     client: RESTClient
     plugin: Optional[StubTpuPlugin] = None
     device_manager: Optional[DeviceManager] = None
+    proxy: Optional[ServiceProxy] = None
 
     async def stop(self) -> None:
         await self.agent.stop()
+        if self.proxy is not None:
+            await self.proxy.stop()
         if self.plugin is not None:
             self.plugin.stop()
         if isinstance(self.runtime, ProcessRuntime):
@@ -154,15 +158,22 @@ class LocalCluster:
 
         runtime = (FakeRuntime() if spec.fake_runtime
                    else ProcessRuntime(node_dir))
+        # Per-node service proxy (kube-proxy analog) on the dataplane
+        # nodes; fake-runtime (hollow) nodes skip it — no real sockets.
+        proxy: Optional[ServiceProxy] = None
+        if not spec.fake_runtime:
+            proxy = ServiceProxy(client)
+            await proxy.start()
         agent = NodeAgent(
             client, name, runtime, device_manager=device_manager,
             capacity=dict(spec.capacity) or None, labels=dict(spec.labels),
             status_interval=self.status_interval,
-            heartbeat_interval=self.heartbeat_interval)
+            heartbeat_interval=self.heartbeat_interval,
+            proxy=proxy)
         await agent.start()
         return LocalNode(name=name, agent=agent, runtime=runtime,
                          client=client, plugin=plugin,
-                         device_manager=device_manager)
+                         device_manager=device_manager, proxy=proxy)
 
     async def add_node(self, spec: NodeSpec) -> LocalNode:
         node = await self._start_node(spec, len(self.nodes))

@@ -27,6 +27,7 @@ from .garbagecollector import GarbageCollector
 from .hpa import HorizontalPodAutoscalerController
 from .job import JobController
 from .namespace import NamespaceController
+from .nodeipam import NodeIpamController
 from .nodelifecycle import NodeLifecycleController
 from .podgc import PodGCController
 from .replicaset import ReplicaSetController
@@ -44,6 +45,7 @@ DEFAULT_CONTROLLERS: dict[str, Callable[[Client, InformerFactory], Controller]] 
     "job": JobController,
     "cronjob": CronJobController,
     "node-lifecycle": NodeLifecycleController,
+    "node-ipam": NodeIpamController,
     "podgc": PodGCController,
     "garbage-collector": GarbageCollector,
     "namespace": NamespaceController,

@@ -1,0 +1,46 @@
+"""Node IPAM controller — ensures every node carries a pod CIDR.
+
+Reference: ``pkg/controller/node/ipam/range_allocator.go`` — there the
+controller owns the allocator. Here allocation lives in ONE place, the
+registry's node strategy (``apiserver/registry.py _prepare_node``),
+because two independent allocators (controller + create strategy)
+could race each other into assigning the same block. The controller's
+job is the legacy/repair path: a node observed without a CIDR (e.g.
+durable data from before the feature) gets a no-op spec write, which
+the registry turns into an assignment.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..api import errors, types as t
+from ..client.informer import InformerFactory
+from ..client.interface import Client
+from .base import Controller
+
+
+class NodeIpamController(Controller):
+    name = "node-ipam-controller"
+
+    def __init__(self, client: Client, factory: InformerFactory,
+                 workers: int = 1):
+        super().__init__(client, factory, workers)
+        self.node_informer = self.watch("nodes")
+        self.node_informer.add_handlers(
+            on_add=self.enqueue_obj,
+            on_update=lambda o, n: self.enqueue_obj(n))
+
+    async def sync(self, key: str) -> Optional[float]:
+        node = self.node_informer.get(key)
+        if node is None or node.spec.pod_cidr:
+            return None
+        try:
+            cur = await self.client.get("nodes", "", node.metadata.name)
+            if cur.spec.pod_cidr:
+                return None
+            # No-op spec write; the registry update strategy assigns
+            # the CIDR server-side (single-allocator invariant).
+            await self.client.update(cur)
+        except errors.NotFoundError:
+            pass
+        return None

@@ -1,0 +1,15 @@
+"""Cluster networking — pod IPAM, service VIPs, and the proxy dataplane.
+
+Reference split: ``pkg/controller/node/ipam`` (pod CIDR assignment),
+``pkg/registry/core/service/ipallocator`` (cluster-IP bitmap),
+``pkg/proxy/userspace`` (VIP -> endpoint forwarding), and kubelet's
+service env injection (``pkg/kubelet/envvars/envvars.go``).
+"""
+from .ipam import CIDRAllocator, PodIPAllocator, cidr_hosts, int_to_ip, ip_to_int
+from .envvars import service_env_vars
+from .proxy import ServiceProxy
+
+__all__ = [
+    "CIDRAllocator", "PodIPAllocator", "ServiceProxy",
+    "cidr_hosts", "int_to_ip", "ip_to_int", "service_env_vars",
+]

@@ -1,0 +1,51 @@
+"""Service discovery env vars — the pre-DNS Kubernetes mechanism.
+
+Reference: ``pkg/kubelet/envvars/envvars.go`` ``FromServices`` — for
+every service visible to the pod, inject ``{SVC}_SERVICE_HOST``,
+``{SVC}_SERVICE_PORT`` (first port), and ``{SVC}_SERVICE_PORT_{NAME}``
+per named port. The kubelet builds this map from its service informer
+at container start (``kubelet_pods.go getServiceEnvVarMap``).
+"""
+from __future__ import annotations
+
+import re
+from typing import Callable, Iterable, Optional
+
+from ..api import types as t
+
+_NAME_RE = re.compile(r"[^A-Z0-9_]")
+
+#: resolve(service) -> (host, port_map) override, used when a local
+#: ServiceProxy provides the actual reachable address for the VIP.
+Resolver = Callable[[t.Service], Optional[tuple[str, dict[str, int]]]]
+
+
+def _env_name(name: str) -> str:
+    return _NAME_RE.sub("_", name.upper().replace("-", "_"))
+
+
+def service_env_vars(services: Iterable[t.Service], namespace: str,
+                     resolve: Optional[Resolver] = None) -> dict[str, str]:
+    """Env map for a pod in ``namespace``. Headless services (no
+    cluster IP) are skipped — they are DNS-identity only."""
+    env: dict[str, str] = {}
+    for svc in services:
+        if svc.metadata.namespace != namespace:
+            continue
+        host = svc.spec.cluster_ip
+        port_override: dict[str, int] = {}
+        if resolve is not None:
+            r = resolve(svc)
+            if r is not None:
+                host, port_override = r
+        if not host or host == "None":
+            continue
+        base = _env_name(svc.metadata.name)
+        env[f"{base}_SERVICE_HOST"] = host
+        for i, p in enumerate(svc.spec.ports):
+            port = port_override.get(p.name or str(p.port), p.port)
+            if i == 0:
+                env[f"{base}_SERVICE_PORT"] = str(port)
+            if p.name:
+                env[f"{base}_SERVICE_PORT_{_env_name(p.name)}"] = str(port)
+    return env

@@ -1,0 +1,189 @@
+"""IP address management — pod IPs and service cluster IPs.
+
+Reference: ``pkg/controller/node/ipam/range_allocator.go`` (carves one
+pod CIDR per node out of the cluster CIDR) and ``pkg/registry/core/
+service/ipallocator/allocator.go`` (bitmap allocator for service VIPs).
+
+Redesign notes: the reference persists the service-IP bitmap as its own
+etcd object; here both allocators are in-memory and rebuilt from the
+API objects they serve (node.spec.pod_cidr / service.spec.cluster_ip /
+pod.status.pod_ip), which is the crash-only pattern the rest of the
+framework uses — the API object IS the checkpoint.
+"""
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+
+def ip_to_int(ip: str) -> int:
+    a, b, c, d = (int(x) for x in ip.split("."))
+    return (a << 24) | (b << 16) | (c << 8) | d
+
+
+def int_to_ip(n: int) -> str:
+    return f"{(n >> 24) & 255}.{(n >> 16) & 255}.{(n >> 8) & 255}.{n & 255}"
+
+
+def parse_cidr(cidr: str) -> tuple[int, int]:
+    """Return (network int, prefix length)."""
+    ip, _, plen = cidr.partition("/")
+    plen_i = int(plen or "32")
+    mask = ((1 << plen_i) - 1) << (32 - plen_i) if plen_i else 0
+    return ip_to_int(ip) & mask, plen_i
+
+
+def cidr_hosts(cidr: str) -> int:
+    """Usable host addresses (network + broadcast excluded for /30 and
+    wider, matching conventional IPv4 subnetting)."""
+    _, plen = parse_cidr(cidr)
+    size = 1 << (32 - plen)
+    return size - 2 if size > 2 else size
+
+
+class CIDRAllocator:
+    """Carve fixed-size sub-CIDRs out of a cluster CIDR (one per node).
+
+    Reference: ``range_allocator.go`` — same contract (occupy on
+    observe, allocate next free), no etcd bitmap.
+    """
+
+    def __init__(self, cluster_cidr: str = "10.64.0.0/16",
+                 node_prefix_len: int = 24):
+        self.cluster_cidr = cluster_cidr
+        self.node_prefix_len = node_prefix_len
+        net, plen = parse_cidr(cluster_cidr)
+        if node_prefix_len < plen:
+            raise ValueError(f"node prefix /{node_prefix_len} wider than "
+                             f"cluster CIDR {cluster_cidr}")
+        self._net = net
+        self._count = 1 << (node_prefix_len - plen)
+        self._block = 1 << (32 - node_prefix_len)
+        self._used: set[int] = set()
+
+    def occupy(self, cidr: str) -> None:
+        """Mark an externally-observed assignment as used."""
+        net, _ = parse_cidr(cidr)
+        idx = (net - self._net) // self._block
+        if 0 <= idx < self._count:
+            self._used.add(idx)
+
+    def release(self, cidr: str) -> None:
+        net, _ = parse_cidr(cidr)
+        idx = (net - self._net) // self._block
+        self._used.discard(idx)
+
+    def allocate(self) -> str:
+        for idx in range(self._count):
+            if idx not in self._used:
+                self._used.add(idx)
+                return (f"{int_to_ip(self._net + idx * self._block)}"
+                        f"/{self.node_prefix_len}")
+        raise RuntimeError(f"cluster CIDR {self.cluster_cidr} exhausted "
+                           f"({self._count} node blocks)")
+
+
+class PodIPAllocator:
+    """Per-pod IPs from one node's pod CIDR, keyed by pod UID.
+
+    Sequential first-free scan; .1 is reserved for the node itself
+    (the CNI bridge address analog).
+    """
+
+    def __init__(self, cidr: str):
+        self.cidr = cidr
+        net, plen = parse_cidr(cidr)
+        self._base = net + 2          # .0 network, .1 node
+        self._size = max(0, (1 << (32 - plen)) - 3)  # minus broadcast
+        self._by_uid: dict[str, int] = {}
+        self._used: set[int] = set()
+
+    @property
+    def node_ip(self) -> str:
+        net, _ = parse_cidr(self.cidr)
+        return int_to_ip(net + 1)
+
+    def ip_for(self, uid: str) -> str:
+        """Allocate (idempotently) an IP for the pod UID."""
+        if uid in self._by_uid:
+            return int_to_ip(self._base + self._by_uid[uid])
+        for off in range(self._size):
+            if off not in self._used:
+                self._used.add(off)
+                self._by_uid[uid] = off
+                return int_to_ip(self._base + off)
+        raise RuntimeError(f"pod CIDR {self.cidr} exhausted")
+
+    def occupy(self, uid: str, ip: str) -> None:
+        """Adopt an existing pod->IP mapping (agent restart rebuild)."""
+        off = ip_to_int(ip) - self._base
+        if 0 <= off < self._size and uid not in self._by_uid:
+            self._used.add(off)
+            self._by_uid[uid] = off
+
+    def release(self, uid: str) -> None:
+        off = self._by_uid.pop(uid, None)
+        if off is not None:
+            self._used.discard(off)
+
+    def __len__(self) -> int:
+        return len(self._by_uid)
+
+
+class ServiceIPAllocator:
+    """Cluster-IP (VIP) allocator for Services.
+
+    Reference: ``pkg/registry/core/service/ipallocator/allocator.go`` —
+    the bitmap lives in etcd there; here occupancy is rebuilt from the
+    stored Services themselves (registry does this lazily on first
+    allocation).
+    """
+
+    def __init__(self, cidr: str = "10.96.0.0/16"):
+        self.cidr = cidr
+        net, plen = parse_cidr(cidr)
+        self._base = net + 1
+        self._size = max(0, (1 << (32 - plen)) - 2)
+        self._used: set[int] = set()
+
+    def occupy(self, ip: str) -> None:
+        off = ip_to_int(ip) - self._base
+        if 0 <= off < self._size:
+            self._used.add(off)
+
+    def release(self, ip: str) -> None:
+        self._used.discard(ip_to_int(ip) - self._base)
+
+    def allocate(self) -> str:
+        for off in range(self._size):
+            if off not in self._used:
+                self._used.add(off)
+                return int_to_ip(self._base + off)
+        raise RuntimeError(f"service CIDR {self.cidr} exhausted")
+
+
+def default_node_cidr(node_name: str, base: str = "10.88.0.0/16") -> str:
+    """Deterministic fallback CIDR for a standalone agent (no IPAM
+    controller running): hash the node name into the base range."""
+    net, plen = parse_cidr(base)
+    blocks = 1 << (24 - plen)
+    idx = _stable_hash(node_name) % blocks
+    return f"{int_to_ip(net + idx * 256)}/24"
+
+
+def _stable_hash(s: str) -> int:
+    h = 2166136261
+    for ch in s.encode():
+        h = ((h ^ ch) * 16777619) & 0xFFFFFFFF
+    return h
+
+
+def rebuild_pod_allocator(cidr: str, pods: Iterable) -> PodIPAllocator:
+    """Build an allocator pre-occupied with the IPs of existing pods
+    (crash-only restart: state rebuilt from the API)."""
+    alloc = PodIPAllocator(cidr)
+    for pod in pods:
+        ip = getattr(pod.status, "pod_ip", "")
+        uid = pod.metadata.uid
+        if ip and uid:
+            alloc.occupy(uid, ip)
+    return alloc

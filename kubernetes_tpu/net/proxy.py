@@ -1,0 +1,280 @@
+"""Service proxy — the kube-proxy analog (userspace mode).
+
+Reference: ``pkg/proxy/userspace/proxier.go`` — for every service port,
+open a local listener and forward accepted connections to one of the
+service's ready endpoints (round-robin), reprogramming as Services and
+Endpoints change. The reference's iptables mode
+(``pkg/proxy/iptables/proxier.go:973 syncProxyRules``) moves the same
+table into the kernel; a userspace forwarder is the honest equivalent
+for a framework whose dev dataplane is real OS processes without root.
+
+TPU-first note: training traffic (ICI collectives) never crosses this —
+the proxy carries control-plane traffic (rendezvous/coordination
+endpoints, metrics scrapes). Throughput is therefore not the design
+driver; correctness under endpoint churn is.
+
+Routing: endpoints publish virtual pod IPs (identity), which are not
+routable on a dev host. The proxy resolves each endpoint to its node's
+real address via the node informer (``EndpointAddress.node_name``) —
+ProcessRuntime pods share the node's network namespace, exactly like
+hostNetwork pods in the reference.
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Optional
+
+from ..api import types as t
+from ..client.informer import SharedInformer
+from ..client.interface import Client
+
+log = logging.getLogger("proxy")
+
+
+def _port_key(name: str, port: int) -> str:
+    return name or str(port)
+
+
+class _PortForwarder:
+    """One listening socket forwarding to a mutable backend list."""
+
+    def __init__(self, bind_host: str, bind_port: int):
+        self.bind_host = bind_host
+        self.bind_port = bind_port          # 0 = ephemeral
+        self.local_port = 0
+        self.backends: list[tuple[str, int]] = []
+        self._rr = 0
+        self._server: Optional[asyncio.base_events.Server] = None
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._handle, self.bind_host, self.bind_port)
+        self.local_port = self._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        if self._server:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    def pick(self) -> Optional[tuple[str, int]]:
+        if not self.backends:
+            return None
+        self._rr = (self._rr + 1) % len(self.backends)
+        return self.backends[self._rr]
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        backend = self.pick()
+        if backend is None:
+            writer.close()
+            return
+        try:
+            r2, w2 = await asyncio.open_connection(*backend)
+        except OSError:
+            writer.close()
+            return
+
+        async def pipe(src: asyncio.StreamReader, dst: asyncio.StreamWriter):
+            try:
+                while True:
+                    chunk = await src.read(65536)
+                    if not chunk:
+                        break
+                    dst.write(chunk)
+                    await dst.drain()
+            except (ConnectionError, asyncio.CancelledError):
+                pass
+            finally:
+                # Half-close: propagate FIN without discarding data the
+                # peer has not read yet (a full close() here can RST).
+                try:
+                    if dst.can_write_eof():
+                        dst.write_eof()
+                except (OSError, RuntimeError):
+                    pass
+
+        await asyncio.gather(pipe(reader, w2), pipe(r2, writer))
+        for w in (writer, w2):
+            try:
+                w.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+
+class ServiceProxy:
+    """Watches Services/Endpoints/Nodes; keeps one forwarder per
+    service port. ``local_endpoint`` is the seam the node agent uses to
+    point ``{SVC}_SERVICE_HOST/PORT`` env at a reachable address."""
+
+    def __init__(self, client: Client, bind_host: str = "127.0.0.1"):
+        self.client = client
+        self.bind_host = bind_host
+        self._svc = SharedInformer(client, "services")
+        self._eps = SharedInformer(client, "endpoints")
+        self._nodes = SharedInformer(client, "nodes")
+        self._forwarders: dict[tuple[str, str, str], _PortForwarder] = {}
+        self._nodeports: dict[tuple[str, str, str], _PortForwarder] = {}
+        self._dirty: asyncio.Queue[str] = asyncio.Queue()
+        self._task: Optional[asyncio.Task] = None
+        self._stopped = False
+
+    @property
+    def services_informer(self) -> SharedInformer:
+        """Public seam for co-located consumers (the node agent shares
+        this informer instead of opening a second watch stream)."""
+        return self._svc
+
+    async def start(self) -> None:
+        self._svc.add_handlers(
+            on_add=lambda s: self._mark(s.key()),
+            on_update=lambda o, n: self._mark(n.key()),
+            on_delete=lambda s: self._mark(s.key()))
+        self._eps.add_handlers(
+            on_add=lambda e: self._mark(e.key()),
+            on_update=lambda o, n: self._mark(n.key()),
+            on_delete=lambda e: self._mark(e.key()))
+        for inf in (self._svc, self._eps, self._nodes):
+            inf.start()
+        for inf in (self._svc, self._eps, self._nodes):
+            await inf.wait_for_sync()
+        for svc in self._svc.list():
+            self._mark(svc.key())
+        self._task = asyncio.get_running_loop().create_task(self._worker())
+
+    async def stop(self) -> None:
+        self._stopped = True
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+        for fwd in list(self._forwarders.values()) + list(self._nodeports.values()):
+            await fwd.stop()
+        self._forwarders.clear()
+        self._nodeports.clear()
+        for inf in (self._svc, self._eps, self._nodes):
+            await inf.stop()
+
+    # -- table maintenance -------------------------------------------------
+
+    def _mark(self, key: str) -> None:
+        self._dirty.put_nowait(key)
+
+    async def _worker(self) -> None:
+        while not self._stopped:
+            key = await self._dirty.get()
+            try:
+                await self._sync_service(key)
+            except Exception:  # noqa: BLE001
+                log.exception("proxy sync %s failed", key)
+
+    async def _sync_service(self, key: str) -> None:
+        ns, name = key.split("/", 1)
+        svc = self._svc.get(key)
+        if svc is None or svc.spec.cluster_ip == "None":
+            await self._drop_service(ns, name)
+            return
+        backends = self._resolve_backends(ns, name)
+        want: set[tuple[str, str, str]] = set()
+        for p in svc.spec.ports:
+            pk = _port_key(p.name, p.port)
+            fid = (ns, name, pk)
+            want.add(fid)
+            fwd = self._forwarders.get(fid)
+            if fwd is None:
+                fwd = _PortForwarder(self.bind_host, 0)
+                await fwd.start()
+                self._forwarders[fid] = fwd
+            # Endpoint ports match service ports by NAME ("" for the
+            # single unnamed port) — reference endpoint semantics; the
+            # endpoint's port number is the target port.
+            fwd.backends = backends.get(p.name, [])
+            if not (svc.spec.type == "NodePort" and p.node_port):
+                # Port no longer exposed as NodePort (type change or
+                # node_port cleared): tear the listener down.
+                stale = self._nodeports.pop(fid, None)
+                if stale:
+                    await stale.stop()
+            else:
+                np = self._nodeports.get(fid)
+                if np is None or np.bind_port != p.node_port:
+                    if np:
+                        # Drop the stale entry NOW: if start() below
+                        # fails, a dead forwarder must not linger and
+                        # shadow a later rebind to the same port.
+                        await np.stop()
+                        self._nodeports.pop(fid, None)
+                    np = _PortForwarder("", p.node_port)
+                    try:
+                        await np.start()
+                        self._nodeports[fid] = np
+                    except OSError as e:
+                        log.warning("nodeport %s/%s:%s: %s", ns, name,
+                                    p.node_port, e)
+                        np = None
+                if np:
+                    np.backends = backends.get(p.name, [])
+        # Ports removed from the service spec.
+        for fid in [f for f in self._forwarders if f[:2] == (ns, name)]:
+            if fid not in want:
+                await self._forwarders.pop(fid).stop()
+                np = self._nodeports.pop(fid, None)
+                if np:
+                    await np.stop()
+
+    async def _drop_service(self, ns: str, name: str) -> None:
+        for table in (self._forwarders, self._nodeports):
+            for fid in [f for f in table if f[:2] == (ns, name)]:
+                await table.pop(fid).stop()
+
+    def _resolve_backends(self, ns: str, name: str) -> dict[str, list[tuple[str, int]]]:
+        eps = self._eps.get(f"{ns}/{name}")
+        if eps is None:
+            return {}
+        out: dict[str, list[tuple[str, int]]] = {}
+        for subset in eps.subsets:
+            hosts = [self._endpoint_host(a) for a in subset.addresses]
+            hosts = [h for h in hosts if h]
+            for p in subset.ports:
+                out.setdefault(p.name, []).extend((h, p.port) for h in hosts)
+        return out
+
+    def _endpoint_host(self, addr: t.EndpointAddress) -> str:
+        if addr.node_name:
+            node = self._nodes.get(addr.node_name)
+            if node is not None and node.status.addresses:
+                return node.status.addresses[0].address
+        return addr.ip
+
+    # -- lookup API (consumed by the agent's env injection) ---------------
+
+    def local_endpoint(self, namespace: str, name: str,
+                       port: "str | int") -> Optional[tuple[str, int]]:
+        fwd = self._forwarders.get((namespace, name, str(port)))
+        if fwd is None:
+            return None
+        host = self.bind_host or "127.0.0.1"
+        return host, fwd.local_port
+
+    def resolve_service(self, svc: t.Service) -> Optional[tuple[str, dict[str, int]]]:
+        """envvars.Resolver: (reachable host, {port key: local port}).
+
+        All-or-nothing: if ANY service port has no forwarder yet (sync
+        window after a port is added), return None so env injection
+        falls back to the VIP uniformly instead of emitting a localhost
+        host paired with an unforwarded port number."""
+        ports: dict[str, int] = {}
+        host = None
+        for p in svc.spec.ports:
+            pk = _port_key(p.name, p.port)
+            ep = self.local_endpoint(svc.metadata.namespace,
+                                     svc.metadata.name, pk)
+            if ep is None:
+                return None
+            host, ports[pk] = ep[0], ep[1]
+        if host is None:
+            return None
+        return host, ports

@@ -28,6 +28,9 @@ from ..api.meta import ObjectMeta, now
 from ..client.informer import SharedInformer
 from ..client.interface import Client
 from ..client.record import EventRecorder
+from ..net.envvars import service_env_vars
+from ..net.ipam import (PodIPAllocator, default_node_cidr,
+                        rebuild_pod_allocator)
 from .devicemanager import DeviceManager
 from .probes import ProbeManager
 from .runtime import (STATE_EXITED, STATE_RUNNING, ContainerConfig,
@@ -46,7 +49,9 @@ class NodeAgent:
                  pleg_interval: float = 1.0,
                  max_pods: int = 110,
                  address: str = "",
-                 server_port: Optional[int] = 0):
+                 server_port: Optional[int] = 0,
+                 pod_cidr: str = "",
+                 proxy=None):
         self.client = client
         self.node_name = node_name
         self.runtime = runtime
@@ -63,16 +68,27 @@ class NodeAgent:
         #: kubelet-server analog (server.py); None disables it.
         self.server_port = server_port
         self.server = None
+        #: Pod IPAM: the CNI analog. The IPAM controller's assignment
+        #: (node.spec.pod_cidr) is adopted when it appears; until then a
+        #: deterministic per-node fallback keeps standalone agents
+        #: (no controller-manager) functional.
+        self.ipam = PodIPAllocator(pod_cidr or default_node_cidr(node_name))
+        #: Local ServiceProxy (net/proxy.py); when present, service env
+        #: vars point at its reachable forwarder ports instead of VIPs.
+        self.proxy = proxy
 
         self._pods: dict[str, t.Pod] = {}        # key -> desired pod
         self._workers: dict[str, asyncio.Task] = {}
         self._worker_wake: dict[str, asyncio.Event] = {}
         self._containers: dict[str, dict[str, str]] = {}  # pod key -> {container name -> cid}
+        self._pod_uids: dict[str, str] = {}      # pod key -> uid (for teardown)
         self._restart_counts: dict[str, dict[str, int]] = {}
         self._restart_at: dict[str, dict[str, float]] = {}
         self._admitted: set[str] = set()
         self._tasks: list[asyncio.Task] = []
         self._informer: Optional[SharedInformer] = None
+        self._svc_informer: Optional[SharedInformer] = None
+        self._own_svc_informer = False
         self._stopped = False
 
     # -- lifecycle --------------------------------------------------------
@@ -86,6 +102,15 @@ class NodeAgent:
             self.server = NodeAgentServer(self)
             await self.server.start(port=self.server_port)
         await self._register_node()
+        # Crash-only IP rebuild BEFORE the pod informer spawns workers:
+        # a worker allocating a first-free IP must not collide with
+        # another pod's pre-crash address.
+        try:
+            pods, _ = await self.client.list(
+                "pods", field_selector=f"spec.node_name={self.node_name}")
+            self.ipam = rebuild_pod_allocator(self.ipam.cidr, pods)
+        except errors.StatusError:
+            pass
         self._informer = SharedInformer(
             self.client, "pods",
             field_selector=f"spec.node_name={self.node_name}")
@@ -93,7 +118,17 @@ class NodeAgent:
                                     on_update=self._pod_changed,
                                     on_delete=self._pod_gone)
         self._informer.start()
+        if self.proxy is not None:
+            # Share the proxy's services informer (it is already
+            # started): one watch stream per node, not two.
+            self._svc_informer = self.proxy.services_informer
+            self._own_svc_informer = False
+        else:
+            self._svc_informer = SharedInformer(self.client, "services")
+            self._svc_informer.start()
+            self._own_svc_informer = True
         await self._informer.wait_for_sync()
+        await self._svc_informer.wait_for_sync()
         loop = asyncio.get_running_loop()
         self._tasks = [
             loop.create_task(self._node_status_loop()),
@@ -112,6 +147,8 @@ class NodeAgent:
                 pass
         if self._informer:
             await self._informer.stop()
+        if self._svc_informer and self._own_svc_informer:
+            await self._svc_informer.stop()
         if self.device_manager:
             await self.device_manager.stop()
         if self.server:
@@ -143,10 +180,17 @@ class NodeAgent:
     async def _register_node(self) -> None:
         node = self._build_node()
         try:
-            await self.client.create(node)
+            created = await self.client.create(node)
             log.info("registered node %s", self.node_name)
+            self._adopt_cidr(created.spec.pod_cidr)
         except errors.AlreadyExistsError:
             await self._post_status()
+
+    def _adopt_cidr(self, cidr: str) -> None:
+        """Adopt the server-assigned pod CIDR (registry strategy or IPAM
+        controller) before any pod IPs leave the fallback range."""
+        if cidr and cidr != self.ipam.cidr and len(self.ipam) == 0:
+            self.ipam = PodIPAllocator(cidr)
 
     async def _post_status(self) -> None:
         try:
@@ -154,6 +198,7 @@ class NodeAgent:
         except errors.NotFoundError:
             await self._register_node()
             return
+        self._adopt_cidr(cur.spec.pod_cidr)
         fresh = self._build_node()
         # Keep conditions' transition times stable when unchanged.
         old_ready = t.get_node_condition(cur.status, t.NODE_READY)
@@ -213,6 +258,7 @@ class NodeAgent:
 
     def _pod_changed(self, old, pod: t.Pod) -> None:
         self._pods[pod.key()] = pod
+        self._pod_uids[pod.key()] = pod.metadata.uid
         self._ensure_worker(pod.key())
 
     def _pod_gone(self, pod: t.Pod) -> None:
@@ -221,6 +267,10 @@ class NodeAgent:
         # one exists to run the teardown pass.
         key = pod.key()
         self._pods.pop(key, None)
+        # IP release happens in the teardown worker AFTER containers
+        # stop — releasing here would let a new pod grab the address
+        # while the old processes still run.
+        self._pod_uids[key] = pod.metadata.uid
         self._ensure_worker(key)
 
     def _ensure_worker(self, key: str) -> None:
@@ -379,6 +429,15 @@ class NodeAgent:
         env.setdefault("POD_NAME", pod.metadata.name)
         env.setdefault("POD_NAMESPACE", pod.metadata.namespace)
         env.setdefault("NODE_NAME", self.node_name)
+        env.setdefault("POD_IP", self.ipam.ip_for(pod.metadata.uid))
+        # Service discovery env (kubelet_pods.go getServiceEnvVarMap);
+        # container-specified env always wins.
+        if self._svc_informer is not None:
+            resolve = self.proxy.resolve_service if self.proxy else None
+            for k, v in service_env_vars(self._svc_informer.list(),
+                                         pod.metadata.namespace,
+                                         resolve=resolve).items():
+                env.setdefault(k, v)
         config = ContainerConfig(
             pod_namespace=pod.metadata.namespace, pod_name=pod.metadata.name,
             pod_uid=pod.metadata.uid, name=container.name, image=container.image,
@@ -442,7 +501,10 @@ class NodeAgent:
         changed = (cur.status.phase != phase)
         cur.status.phase = phase
         cur.status.host_ip = self.address
-        cur.status.pod_ip = self.address
+        pod_ip = self.ipam.ip_for(pod.metadata.uid)
+        if cur.status.pod_ip != pod_ip:
+            cur.status.pod_ip = pod_ip
+            changed = True
         if cur.status.start_time is None:
             cur.status.start_time = now()
             changed = True
@@ -500,6 +562,8 @@ class NodeAgent:
         self._restart_counts.pop(key, None)
         self._restart_at.pop(key, None)
         self._admitted.discard(key)
+        self._pod_uids.pop(key, None)
+        self.ipam.release(pod.metadata.uid)
         # Confirm deletion: grace-0 delete completes removal (the node
         # agent is the only caller allowed to finish a pod's deletion).
         try:
@@ -518,6 +582,9 @@ class NodeAgent:
         self._restart_counts.pop(key, None)
         self._restart_at.pop(key, None)
         self._admitted.discard(key)
+        uid = self._pod_uids.pop(key, None)
+        if uid:
+            self.ipam.release(uid)
 
     # -- PLEG (pleg/generic.go:110) ---------------------------------------
 

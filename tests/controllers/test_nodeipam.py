@@ -1,0 +1,61 @@
+"""Node IPAM tests — registry single-allocator + controller repair
+path (reference: range_allocator_test.go)."""
+import pytest
+
+from kubernetes_tpu.api.scheme import to_dict
+from kubernetes_tpu.controllers.nodeipam import NodeIpamController
+
+from .util import make_plane, mk_node, wait_for
+
+
+@pytest.mark.asyncio
+async def test_nodes_get_distinct_pod_cidrs_at_create():
+    reg, client, factory = make_plane()
+    n1 = await client.create(mk_node("n1"))
+    n2 = await client.create(mk_node("n2"))
+    cidrs = [n1.spec.pod_cidr, n2.spec.pod_cidr]
+    assert all(cidrs) and len(set(cidrs)) == 2
+    assert all(c.startswith("10.64.") and c.endswith("/24") for c in cidrs)
+
+
+@pytest.mark.asyncio
+async def test_explicit_cidr_respected_and_occupied():
+    reg, client, factory = make_plane()
+    n1 = mk_node("n1")
+    n1.spec.pod_cidr = "10.64.0.0/24"
+    created = await client.create(n1)
+    assert created.spec.pod_cidr == "10.64.0.0/24"
+    n2 = await client.create(mk_node("n2"))
+    assert n2.spec.pod_cidr != "10.64.0.0/24"
+
+
+@pytest.mark.asyncio
+async def test_controller_repairs_legacy_node():
+    reg, client, factory = make_plane()
+    # Legacy durable data: node written straight into the store with no
+    # CIDR (bypasses the create strategy).
+    legacy = mk_node("legacy")
+    legacy.metadata.uid = "legacy-uid"
+    d = to_dict(legacy)
+    d["metadata"].pop("resource_version", None)
+    reg.store.create("/registry/nodes/legacy", d)
+    assert reg.get("nodes", "", "legacy").spec.pod_cidr == ""
+
+    ctl = NodeIpamController(client, factory)
+    await ctl.start()
+    try:
+        cidr = await wait_for(
+            lambda: reg.get("nodes", "", "legacy").spec.pod_cidr)
+        assert cidr.startswith("10.64.")
+    finally:
+        await ctl.stop()
+
+
+@pytest.mark.asyncio
+async def test_cidr_released_on_node_delete():
+    reg, client, factory = make_plane()
+    n1 = await client.create(mk_node("n1"))
+    first = n1.spec.pod_cidr
+    await client.delete("nodes", "", "n1")
+    n2 = await client.create(mk_node("n2"))
+    assert n2.spec.pod_cidr == first

@@ -1,0 +1,76 @@
+"""IPAM unit tests (reference tier: ipallocator/range_allocator unit
+tests)."""
+import pytest
+
+from kubernetes_tpu.net.ipam import (CIDRAllocator, PodIPAllocator,
+                                     ServiceIPAllocator, cidr_hosts,
+                                     default_node_cidr, int_to_ip, ip_to_int,
+                                     rebuild_pod_allocator)
+
+
+def test_ip_roundtrip():
+    for ip in ("10.64.0.0", "10.64.3.255", "255.255.255.255", "0.0.0.1"):
+        assert int_to_ip(ip_to_int(ip)) == ip
+
+
+def test_cidr_hosts():
+    assert cidr_hosts("10.0.0.0/24") == 254
+    assert cidr_hosts("10.0.0.0/30") == 2
+
+
+def test_cidr_allocator_distinct_blocks():
+    alloc = CIDRAllocator("10.64.0.0/16", 24)
+    a, b = alloc.allocate(), alloc.allocate()
+    assert a == "10.64.0.0/24" and b == "10.64.1.0/24"
+    alloc.release(a)
+    assert alloc.allocate() == a
+
+
+def test_cidr_allocator_occupy_skips():
+    alloc = CIDRAllocator("10.64.0.0/16", 24)
+    alloc.occupy("10.64.0.0/24")
+    assert alloc.allocate() == "10.64.1.0/24"
+
+
+def test_cidr_allocator_exhaustion():
+    alloc = CIDRAllocator("10.64.0.0/23", 24)
+    alloc.allocate(), alloc.allocate()
+    with pytest.raises(RuntimeError):
+        alloc.allocate()
+
+
+def test_pod_ip_allocator_idempotent_and_distinct():
+    alloc = PodIPAllocator("10.64.5.0/24")
+    ip1 = alloc.ip_for("uid-1")
+    ip2 = alloc.ip_for("uid-2")
+    assert ip1 != ip2
+    assert alloc.ip_for("uid-1") == ip1          # idempotent
+    assert ip1.startswith("10.64.5.")
+    assert ip1 != alloc.node_ip == "10.64.5.1"
+    alloc.release("uid-1")
+    assert alloc.ip_for("uid-3") == ip1          # first-free reuse
+
+
+def test_pod_ip_rebuild_from_api():
+    class P:
+        def __init__(self, uid, ip):
+            self.metadata = type("M", (), {"uid": uid})()
+            self.status = type("S", (), {"pod_ip": ip})()
+
+    alloc = rebuild_pod_allocator("10.64.5.0/24", [P("u1", "10.64.5.2")])
+    assert alloc.ip_for("u1") == "10.64.5.2"
+    assert alloc.ip_for("u2") != "10.64.5.2"
+
+
+def test_service_ip_allocator():
+    alloc = ServiceIPAllocator("10.96.0.0/24")
+    a = alloc.allocate()
+    alloc.occupy("10.96.0.2")
+    b = alloc.allocate()
+    assert a == "10.96.0.1" and b == "10.96.0.3"
+
+
+def test_default_node_cidr_deterministic():
+    assert default_node_cidr("node-a") == default_node_cidr("node-a")
+    assert default_node_cidr("node-a") != default_node_cidr("node-b")
+    assert default_node_cidr("node-a").endswith("/24")

@@ -1,0 +1,163 @@
+"""Service proxy tests — VIP table maintenance + real TCP forwarding
+(reference tier: pkg/proxy/userspace proxier tests)."""
+import asyncio
+
+import pytest
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.net.envvars import service_env_vars
+from kubernetes_tpu.net.proxy import ServiceProxy
+
+from tests.controllers.util import make_plane, wait_for
+
+
+async def echo_server(reply: bytes):
+    async def handle(reader, writer):
+        await reader.read(100)
+        writer.write(reply)
+        await writer.drain()
+        writer.close()
+
+    server = await asyncio.start_server(handle, "127.0.0.1", 0)
+    return server, server.sockets[0].getsockname()[1]
+
+
+def mk_service(name="web", port=8080, selector=None):
+    return t.Service(
+        metadata=ObjectMeta(name=name, namespace="default"),
+        spec=t.ServiceSpec(selector=selector or {"app": name},
+                           ports=[t.ServicePort(name="http", port=port)]))
+
+
+def mk_endpoints(name, backends):
+    return t.Endpoints(
+        metadata=ObjectMeta(name=name, namespace="default"),
+        subsets=[t.EndpointSubset(
+            addresses=[t.EndpointAddress(ip=ip) for ip, _ in backends],
+            ports=[t.EndpointPort(name="http", port=backends[0][1])])])
+
+
+async def fetch(host, port, payload=b"ping"):
+    reader, writer = await asyncio.open_connection(host, port)
+    writer.write(payload)
+    await writer.drain()
+    data = await reader.read(100)
+    writer.close()
+    return data
+
+
+@pytest.mark.asyncio
+async def test_proxy_forwards_and_round_robins():
+    reg, client, _ = make_plane()
+    s1, p1 = await echo_server(b"one")
+    s2, p2 = await echo_server(b"two")
+    client_sync = client
+    await client_sync.create(mk_service("web", 8080))
+    # Endpoints on loopback with REAL ports (node resolution falls back
+    # to the endpoint IP when no node object matches).
+    await client_sync.create(t.Endpoints(
+        metadata=ObjectMeta(name="web", namespace="default"),
+        subsets=[t.EndpointSubset(
+            addresses=[t.EndpointAddress(ip="127.0.0.1")],
+            ports=[t.EndpointPort(name="http", port=p1)])]))
+
+    proxy = ServiceProxy(client)
+    await proxy.start()
+    try:
+        await wait_for(lambda: proxy.local_endpoint("default", "web", "http"))
+        host, port = proxy.local_endpoint("default", "web", "http")
+        assert await fetch(host, port) == b"one"
+
+        # Endpoint churn: repoint at the second backend.
+        eps = await client.get("endpoints", "default", "web")
+        eps.subsets[0].ports[0].port = p2
+        await client.update(eps)
+        await wait_for(lambda: proxy._forwarders[
+            ("default", "web", "http")].backends == [("127.0.0.1", p2)])
+        assert await fetch(host, port) == b"two"
+    finally:
+        await proxy.stop()
+        s1.close(), s2.close()
+
+
+@pytest.mark.asyncio
+async def test_proxy_resolves_endpoint_via_node_address():
+    """Virtual pod IPs route to the node's real address (hostNetwork
+    semantics for ProcessRuntime pods)."""
+    reg, client, _ = make_plane()
+    server, port = await echo_server(b"via-node")
+    node = t.Node(metadata=ObjectMeta(name="n1"))
+    node.status.addresses = [t.NodeAddress(type="Hostname", address="127.0.0.1")]
+    await client.create(node)
+    svc = t.Service(metadata=ObjectMeta(name="db", namespace="default"),
+                    spec=t.ServiceSpec(selector={"app": "db"},
+                                       ports=[t.ServicePort(port=5432)]))
+    await client.create(svc)
+    await client.create(t.Endpoints(
+        metadata=ObjectMeta(name="db", namespace="default"),
+        subsets=[t.EndpointSubset(
+            addresses=[t.EndpointAddress(ip="10.64.0.7", node_name="n1")],
+            ports=[t.EndpointPort(name="", port=port)])]))
+    proxy = ServiceProxy(client)
+    await proxy.start()
+    try:
+        await wait_for(lambda: proxy.local_endpoint("default", "db", str(5432)))
+        host, lport = proxy.local_endpoint("default", "db", "5432")
+        assert await fetch(host, lport) == b"via-node"
+    finally:
+        await proxy.stop()
+        server.close()
+
+
+@pytest.mark.asyncio
+async def test_proxy_service_delete_closes_listener():
+    reg, client, _ = make_plane()
+    await client.create(mk_service("tmp", 9000))
+    proxy = ServiceProxy(client)
+    await proxy.start()
+    try:
+        await wait_for(lambda: proxy.local_endpoint("default", "tmp", "http"))
+        await client.delete("services", "default", "tmp")
+        await wait_for(lambda: proxy.local_endpoint("default", "tmp", "http") is None)
+    finally:
+        await proxy.stop()
+
+
+def test_service_env_vars_and_resolver():
+    svc = mk_service("my-web", 8080)
+    svc.spec.cluster_ip = "10.96.0.5"
+    env = service_env_vars([svc], "default")
+    assert env["MY_WEB_SERVICE_HOST"] == "10.96.0.5"
+    assert env["MY_WEB_SERVICE_PORT"] == "8080"
+    assert env["MY_WEB_SERVICE_PORT_HTTP"] == "8080"
+    # Headless and cross-namespace services are skipped.
+    headless = mk_service("hl", 1)
+    headless.spec.cluster_ip = "None"
+    other = mk_service("other", 2)
+    other.metadata.namespace = "prod"
+    other.spec.cluster_ip = "10.96.0.9"
+    assert service_env_vars([headless, other], "default") == {}
+    # A resolver (the local proxy) overrides host and ports.
+    env = service_env_vars([svc], "default",
+                           resolve=lambda s: ("127.0.0.1", {"http": 40001}))
+    assert env["MY_WEB_SERVICE_HOST"] == "127.0.0.1"
+    assert env["MY_WEB_SERVICE_PORT"] == "40001"
+
+
+@pytest.mark.asyncio
+async def test_cluster_ip_allocated_and_released_by_registry():
+    reg, client, _ = make_plane()
+    a = await client.create(mk_service("a", 80))
+    b = await client.create(mk_service("b", 80))
+    assert a.spec.cluster_ip and b.spec.cluster_ip
+    assert a.spec.cluster_ip != b.spec.cluster_ip
+    assert a.spec.cluster_ip.startswith("10.96.")
+    await client.delete("services", "default", "a")
+    c = await client.create(mk_service("c", 80))
+    assert c.spec.cluster_ip == a.spec.cluster_ip  # released VIP reused
+    # Headless stays headless.
+    hl = mk_service("hl", 80)
+    hl.spec.cluster_ip = "None"
+    created = await client.create(hl)
+    assert created.spec.cluster_ip == "None"
