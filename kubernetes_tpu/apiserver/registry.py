@@ -204,38 +204,20 @@ class Registry:
         # IP/CIDR allocation happens last — after admission/validation/
         # dry_run — and is rolled back if the store insert fails
         # (AlreadyExists on node re-registration must not leak a block).
-        allocated: list[tuple] = []
-        if isinstance(obj, t.Service) and not obj.spec.cluster_ip:
-            self._prepare_service(obj)
-            allocated.append((self._svc_ips.release, obj.spec.cluster_ip))
-        if isinstance(obj, t.Node) and not obj.spec.pod_cidr:
-            self._prepare_node(obj)
-            allocated.append((self._node_cidrs.release, obj.spec.pod_cidr))
+        self._claim_ips(obj)
         key = self._key(spec, meta.namespace, meta.name)
         try:
             rev = self.store.create(key, self._encode(obj))
         except Exception:
-            for release, value in allocated:
-                if value and value != "None":
-                    release(value)
+            self._release_ips(obj)
             raise
-        # Client-specified VIP/CIDR: mark it used so the allocators
-        # (if already initialized) never hand the same value out again.
-        if isinstance(obj, t.Service) and self._svc_ips is not None \
-                and obj.spec.cluster_ip and obj.spec.cluster_ip != "None":
-            self._svc_ips.occupy(obj.spec.cluster_ip)
-        if isinstance(obj, t.Node) and self._node_cidrs is not None \
-                and obj.spec.pod_cidr:
-            self._node_cidrs.occupy(obj.spec.pod_cidr)
         meta.resource_version = str(rev)
         return obj
 
-    def _prepare_service(self, svc: t.Service) -> None:
-        """Service create strategy: allocate the cluster VIP (reference:
-        ``pkg/registry/core/service/storage`` + ipallocator). Headless
-        services (cluster_ip "None") keep their sentinel."""
-        if svc.spec.cluster_ip:
-            return
+    def _ensure_svc_allocator(self) -> None:
+        """Lazy-build the VIP allocator, occupancy rebuilt from stored
+        Services (reference keeps the bitmap in etcd; here the objects
+        ARE the checkpoint)."""
         if self._svc_ips is None:
             from ..net.ipam import ServiceIPAllocator
             alloc = ServiceIPAllocator(self.service_cidr)
@@ -245,14 +227,8 @@ class Registry:
                 if ip and ip != "None":
                     alloc.occupy(ip)
             self._svc_ips = alloc
-        svc.spec.cluster_ip = self._svc_ips.allocate()
 
-    def _prepare_node(self, node: t.Node) -> None:
-        """Node create strategy: assign the pod CIDR at birth so the
-        agent never races the IPAM controller for its first pod IP
-        (the controller keeps covering pre-existing durable nodes)."""
-        if node.spec.pod_cidr:
-            return
+    def _ensure_node_allocator(self) -> None:
         if self._node_cidrs is None:
             from ..net.ipam import CIDRAllocator
             alloc = CIDRAllocator(self.cluster_cidr)
@@ -262,7 +238,45 @@ class Registry:
                 if cidr:
                     alloc.occupy(cidr)
             self._node_cidrs = alloc
+
+    def _prepare_service(self, svc: t.Service) -> None:
+        """Service create strategy: allocate the cluster VIP (reference:
+        ``pkg/registry/core/service/storage`` + ipallocator). Headless
+        services (cluster_ip "None") keep their sentinel."""
+        if svc.spec.cluster_ip:
+            return
+        self._ensure_svc_allocator()
+        svc.spec.cluster_ip = self._svc_ips.allocate()
+
+    def _prepare_node(self, node: t.Node) -> None:
+        """Node create strategy: assign the pod CIDR at birth so the
+        agent never races the IPAM controller for its first pod IP
+        (the controller keeps covering pre-existing durable nodes)."""
+        if node.spec.pod_cidr:
+            return
+        self._ensure_node_allocator()
         node.spec.pod_cidr = self._node_cidrs.allocate()
+
+    def _claim_ips(self, obj: TypedObject) -> None:
+        """Create-path counterpart of :meth:`_release_ips`: allocate the
+        VIP/CIDR when absent, or claim (occupy) an explicit value —
+        rejecting a VIP another service already holds."""
+        if isinstance(obj, t.Service):
+            if not obj.spec.cluster_ip:
+                self._prepare_service(obj)
+            elif obj.spec.cluster_ip != "None":
+                self._ensure_svc_allocator()
+                if self._svc_ips.is_used(obj.spec.cluster_ip):
+                    raise errors.InvalidError(
+                        f"Service {obj.metadata.name!r}: spec.cluster_ip "
+                        f"{obj.spec.cluster_ip} is already allocated")
+                self._svc_ips.occupy(obj.spec.cluster_ip)
+        if isinstance(obj, t.Node):
+            if not obj.spec.pod_cidr:
+                self._prepare_node(obj)
+            else:
+                self._ensure_node_allocator()
+                self._node_cidrs.occupy(obj.spec.pod_cidr)
 
     def _release_ips(self, obj: TypedObject) -> None:
         """Return an object's IP/CIDR allocation on actual removal —
@@ -357,6 +371,15 @@ class Registry:
                 self._prepare_node(new)
             elif self._node_cidrs is not None:
                 self._node_cidrs.occupy(new.spec.pod_cidr)
+        # Cluster IP is immutable for a Service's lifetime (reference:
+        # service strategy ValidateUpdate) — mutation would desync the
+        # allocator and every proxy/env consumer.
+        if isinstance(new, t.Service) and subresource != "status" \
+                and isinstance(old, t.Service) and old.spec.cluster_ip \
+                and new.spec.cluster_ip != old.spec.cluster_ip:
+            raise errors.InvalidError(
+                f"Service {new.metadata.name!r}: spec.cluster_ip is "
+                f"immutable ({old.spec.cluster_ip} -> {new.spec.cluster_ip})")
         rev = self.store.update(key, self._encode(new),
                                 expected_revision=stored.mod_revision)
         new.metadata.resource_version = str(rev)

@@ -38,6 +38,7 @@ from ..deviceplugin.stub import StubTpuPlugin, make_topology
 from ..net.proxy import ServiceProxy
 from ..node.agent import NodeAgent
 from ..node.devicemanager import DeviceManager
+from ..node.eviction import EvictionManager, Thresholds
 from ..node.runtime import FakeRuntime, ProcessRuntime
 from ..scheduler.scheduler import Scheduler
 from ..storage.mvcc import MVCCStore
@@ -161,15 +162,21 @@ class LocalCluster:
         # Per-node service proxy (kube-proxy analog) on the dataplane
         # nodes; fake-runtime (hollow) nodes skip it — no real sockets.
         proxy: Optional[ServiceProxy] = None
+        eviction: Optional[EvictionManager] = None
         if not spec.fake_runtime:
             proxy = ServiceProxy(client)
             await proxy.start()
+            # Conservative thresholds: dev boxes legitimately run with
+            # fuller disks than production nodes.
+            eviction = EvictionManager(Thresholds(
+                memory_available_bytes=50 * 2**20,
+                fs_available_fraction=0.02))
         agent = NodeAgent(
             client, name, runtime, device_manager=device_manager,
             capacity=dict(spec.capacity) or None, labels=dict(spec.labels),
             status_interval=self.status_interval,
             heartbeat_interval=self.heartbeat_interval,
-            proxy=proxy)
+            proxy=proxy, eviction=eviction)
         await agent.start()
         return LocalNode(name=name, agent=agent, runtime=runtime,
                          client=client, plugin=plugin,

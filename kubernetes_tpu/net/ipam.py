@@ -150,6 +150,9 @@ class ServiceIPAllocator:
         if 0 <= off < self._size:
             self._used.add(off)
 
+    def is_used(self, ip: str) -> bool:
+        return (ip_to_int(ip) - self._base) in self._used
+
     def release(self, ip: str) -> None:
         self._used.discard(ip_to_int(ip) - self._base)
 

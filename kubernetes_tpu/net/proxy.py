@@ -84,8 +84,8 @@ class _PortForwarder:
                         break
                     dst.write(chunk)
                     await dst.drain()
-            except (ConnectionError, asyncio.CancelledError):
-                pass
+            except (OSError, asyncio.CancelledError):
+                pass  # OSError covers ConnectionError, ETIMEDOUT, EBADF
             finally:
                 # Half-close: propagate FIN without discarding data the
                 # peer has not read yet (a full close() here can RST).
@@ -95,7 +95,8 @@ class _PortForwarder:
                 except (OSError, RuntimeError):
                     pass
 
-        await asyncio.gather(pipe(reader, w2), pipe(r2, writer))
+        await asyncio.gather(pipe(reader, w2), pipe(r2, writer),
+                             return_exceptions=True)
         for w in (writer, w2):
             try:
                 w.close()
@@ -135,6 +136,14 @@ class ServiceProxy:
             on_add=lambda e: self._mark(e.key()),
             on_update=lambda o, n: self._mark(n.key()),
             on_delete=lambda e: self._mark(e.key()))
+        # Node churn changes endpoint-host resolution: re-sync every
+        # service when a node appears or its addresses change (rare
+        # events; full re-mark is fine).
+        self._nodes.add_handlers(
+            on_add=lambda n: self._mark_all(),
+            on_update=lambda o, n: (
+                self._mark_all()
+                if o.status.addresses != n.status.addresses else None))
         for inf in (self._svc, self._eps, self._nodes):
             inf.start()
         for inf in (self._svc, self._eps, self._nodes):
@@ -162,6 +171,10 @@ class ServiceProxy:
 
     def _mark(self, key: str) -> None:
         self._dirty.put_nowait(key)
+
+    def _mark_all(self) -> None:
+        for svc in self._svc.list():
+            self._mark(svc.key())
 
     async def _worker(self) -> None:
         while not self._stopped:

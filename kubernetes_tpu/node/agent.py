@@ -32,7 +32,9 @@ from ..net.envvars import service_env_vars
 from ..net.ipam import (PodIPAllocator, default_node_cidr,
                         rebuild_pod_allocator)
 from .devicemanager import DeviceManager
+from .eviction import EvictionManager, pick_preemption_victims
 from .probes import ProbeManager
+from .stats import _proc_stat
 from .runtime import (STATE_EXITED, STATE_RUNNING, ContainerConfig,
                       ContainerRuntime, ContainerStatus as RtStatus)
 
@@ -51,7 +53,8 @@ class NodeAgent:
                  address: str = "",
                  server_port: Optional[int] = 0,
                  pod_cidr: str = "",
-                 proxy=None):
+                 proxy=None,
+                 eviction: Optional[EvictionManager] = None):
         self.client = client
         self.node_name = node_name
         self.runtime = runtime
@@ -76,15 +79,19 @@ class NodeAgent:
         #: Local ServiceProxy (net/proxy.py); when present, service env
         #: vars point at its reachable forwarder ports instead of VIPs.
         self.proxy = proxy
+        #: Node-pressure eviction manager (eviction.py); None disables.
+        self.eviction = eviction
 
         self._pods: dict[str, t.Pod] = {}        # key -> desired pod
         self._workers: dict[str, asyncio.Task] = {}
         self._worker_wake: dict[str, asyncio.Event] = {}
         self._containers: dict[str, dict[str, str]] = {}  # pod key -> {container name -> cid}
         self._pod_uids: dict[str, str] = {}      # pod key -> uid (for teardown)
+        self._pleg_statuses: dict[str, RtStatus] = {}  # last PLEG relist
         self._restart_counts: dict[str, dict[str, int]] = {}
         self._restart_at: dict[str, dict[str, float]] = {}
         self._admitted: set[str] = set()
+        self._evicted: set[str] = set()          # pod UIDs; terminal, never resync
         self._tasks: list[asyncio.Task] = []
         self._informer: Optional[SharedInformer] = None
         self._svc_informer: Optional[SharedInformer] = None
@@ -129,6 +136,12 @@ class NodeAgent:
             self._own_svc_informer = True
         await self._informer.wait_for_sync()
         await self._svc_informer.wait_for_sync()
+        if self.eviction is not None:
+            self.eviction.pod_source = lambda: list(self._pods.values())
+            self.eviction.evict = self.evict_pod
+            if self.eviction.pod_usage is None:
+                self.eviction.pod_usage = self._pod_rss
+            self.eviction.start()
         loop = asyncio.get_running_loop()
         self._tasks = [
             loop.create_task(self._node_status_loop()),
@@ -153,6 +166,8 @@ class NodeAgent:
             await self.device_manager.stop()
         if self.server:
             await self.server.stop()
+        if self.eviction is not None:
+            await self.eviction.stop()
         await self.probes.stop_all()
 
     # -- node registration + status (kubelet_node_status.go) --------------
@@ -173,6 +188,8 @@ class NodeAgent:
         node.status.conditions = [t.NodeCondition(
             type=t.NODE_READY, status="True", reason="AgentReady",
             last_heartbeat_time=now(), last_transition_time=now())]
+        if self.eviction is not None:
+            node.status.conditions.extend(self.eviction.conditions())
         node.status.node_info = t.NodeSystemInfo(
             agent_version="kubernetes-tpu/0.1", architecture="tpu-vm")
         return node
@@ -314,7 +331,16 @@ class NodeAgent:
             await self._teardown_pod(key)
             return True
         if pod.metadata.deletion_timestamp is not None:
+            self._evicted.discard(pod.metadata.uid)
             await self._terminate_pod(pod)
+            return True
+        if pod.metadata.uid in self._evicted:
+            # Evicted pods are terminal: keep containers down, never
+            # restart them, and never overwrite the Evicted status
+            # (reference: eviction marks the pod Failed and the pod
+            # worker treats it as terminal).
+            for cid in self._containers.get(key, {}).values():
+                await self.runtime.stop_container(cid, grace_seconds=0.5)
             return True
         if t.is_pod_terminal(pod):
             return True
@@ -341,9 +367,18 @@ class NodeAgent:
         reported topology YET is a transient condition (agent restart
         races the plugin handshake) — retriable, never a terminal
         rejection of a validly-bound workload."""
-        running = len([p for p in self._pods.values()
-                       if t.is_pod_active(p) and p.key() != pod.key()])
-        if running + 1 > int(self.capacity.get(t.RESOURCE_PODS, 110)):
+        active = [p for p in self._pods.values()
+                  if t.is_pod_active(p) and p.key() != pod.key()]
+        if len(active) + 1 > int(self.capacity.get(t.RESOURCE_PODS, 110)):
+            # Critical-pod preemption (preemption.go): evict the
+            # lowest-priority pod to admit a critical one.
+            victims = pick_preemption_victims(active, pod)
+            if victims:
+                for victim in victims:
+                    await self.evict_pod(
+                        victim, "Preempted",
+                        f"Preempted to admit critical pod {pod.key()}")
+                return "awaiting preemption of lower-priority pods", True
             return "node is at max pods", False
         if pod.spec.tpu_resources and self.device_manager is None:
             return "node has no device manager but pod requests TPUs", False
@@ -468,6 +503,8 @@ class NodeAgent:
     async def _update_pod_status(self, pod: t.Pod,
                                  statuses: dict[str, RtStatus]) -> None:
         key = pod.key()
+        if pod.metadata.uid in self._evicted:
+            return  # terminal Evicted status must never be overwritten
         cmap = self._containers.get(key, {})
         cstatuses: list[t.ContainerStatus] = []
         for container in pod.spec.containers:
@@ -585,6 +622,7 @@ class NodeAgent:
         uid = self._pod_uids.pop(key, None)
         if uid:
             self.ipam.release(uid)
+            self._evicted.discard(uid)
 
     # -- PLEG (pleg/generic.go:110) ---------------------------------------
 
@@ -593,8 +631,11 @@ class NodeAgent:
         while not self._stopped:
             try:
                 current: dict[str, str] = {}
+                statuses: dict[str, RtStatus] = {}
                 for st in await self.runtime.list_containers():
                     current[st.id] = st.state
+                    statuses[st.id] = st
+                self._pleg_statuses = statuses
                 for cid, state in current.items():
                     if last.get(cid) != state:
                         self._nudge_owner(cid)
@@ -604,6 +645,44 @@ class NodeAgent:
             except Exception:  # noqa: BLE001
                 log.exception("pleg relist failed")
             await asyncio.sleep(self.pleg_interval)
+
+    def _pod_rss(self, pod: t.Pod) -> float:
+        """Memory RSS of a pod's live containers (eviction ranking
+        input), from the PLEG's last relist — no extra runtime calls."""
+        total = 0.0
+        for cid in self._containers.get(pod.key(), {}).values():
+            st = self._pleg_statuses.get(cid)
+            if st is not None and st.state == STATE_RUNNING and st.pid:
+                proc = _proc_stat(st.pid)
+                if proc:
+                    total += proc["memory_rss_bytes"]
+        return total
+
+    # -- eviction (eviction_manager.go:151 + preemption.go) ---------------
+
+    async def evict_pod(self, pod: t.Pod, reason: str, message: str) -> None:
+        """Kill a pod's containers and fail it in the API; its workload
+        controller replaces it elsewhere. The pod object survives (the
+        Failed status is what Job/RS accounting reads)."""
+        key = pod.key()
+        self._evicted.add(pod.metadata.uid)
+        self.recorder.event(pod, "Warning", reason, message)
+        self.probes.remove_pod(key)
+        for cid in self._containers.get(key, {}).values():
+            await self.runtime.stop_container(cid, grace_seconds=1.0)
+        try:
+            cur = await self.client.get("pods", pod.metadata.namespace,
+                                        pod.metadata.name)
+            cur.status.phase = t.POD_FAILED
+            cur.status.reason = reason
+            cur.status.message = message
+            await self.client.update_status(cur)
+        except errors.StatusError:
+            pass
+        uid = self._pod_uids.get(key)
+        if uid:
+            self.ipam.release(uid)
+        self._nudge(key)
 
     def _nudge_owner(self, cid: str) -> None:
         for key, cmap in self._containers.items():

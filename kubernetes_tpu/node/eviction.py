@@ -1,0 +1,211 @@
+"""Node-pressure eviction — the kubelet eviction manager analog.
+
+Reference: ``pkg/kubelet/eviction/eviction_manager.go:151`` — a control
+loop observes node memory/disk, flips MemoryPressure/DiskPressure node
+conditions when signals cross thresholds, and evicts pods one at a time
+ranked by (usage exceeds request, priority, usage-over-request delta)
+(``pkg/kubelet/eviction/helpers.go`` rankMemoryPressure) until the
+signal clears. Evicted pods are failed with reason "Evicted" so their
+workload controllers replace them elsewhere.
+
+Also here: critical-pod admission preemption (``pkg/kubelet/preemption/
+preemption.go``) — when a critical pod cannot be admitted for capacity,
+lower-priority pods are evicted to make room.
+
+TPU note: a TPU training pod is gang-scheduled and expensive to move;
+chips pin it to this node. Eviction therefore ranks TPU claimants last
+within their priority band (evicting one kills the whole gang's step
+progress), which falls out of priority ranking when jobs use a higher
+PriorityClass — but we also add an explicit tiebreak so a BestEffort
+sidecar always goes before a same-priority chip holder.
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from dataclasses import dataclass
+from typing import Awaitable, Callable, Optional
+
+from ..api import types as t
+from .stats import _node_fs, _node_memory
+
+log = logging.getLogger("eviction")
+
+#: Priority at or above which a pod is "critical" — never evicted for
+#: node pressure and allowed to preempt at admission (reference:
+#: scheduling.SystemCriticalPriority = 2e9).
+CRITICAL_PRIORITY = 2_000_000_000
+
+
+@dataclass
+class Thresholds:
+    """Eviction signals (reference: --eviction-hard defaults
+    ``memory.available<100Mi,nodefs.available<10%``)."""
+    memory_available_bytes: int = 100 * 2**20
+    fs_available_fraction: float = 0.10
+    #: Min seconds between evictions (the reference's housekeeping
+    #: interval; prevents cascading kills before stats settle).
+    eviction_cooldown: float = 10.0
+
+
+@dataclass
+class NodeUsage:
+    memory_available: int
+    memory_capacity: int
+    fs_available: int
+    fs_capacity: int
+
+
+def read_node_usage(root_dir: str = "/") -> NodeUsage:
+    mem = _node_memory()
+    fs = _node_fs(root_dir)
+    return NodeUsage(
+        memory_available=mem.get("available_bytes", 0),
+        memory_capacity=mem.get("total_bytes", 0),
+        fs_available=fs.get("available_bytes", 0),
+        fs_capacity=fs.get("capacity_bytes", 0))
+
+
+def pod_memory_request(pod: t.Pod) -> float:
+    return sum(c.resources.requests.get("memory", 0.0)
+               for c in pod.spec.containers)
+
+
+def rank_for_eviction(pods: list[t.Pod],
+                      usage: Callable[[t.Pod], float]) -> list[t.Pod]:
+    """Most-evictable first. Reference ordering (helpers.go): pods whose
+    usage exceeds their request, then lower priority, then largest
+    usage-over-request. Added TPU tiebreak: chip holders last."""
+
+    def key(pod: t.Pod):
+        used = usage(pod)
+        req = pod_memory_request(pod)
+        return (
+            0 if used > req else 1,                 # over request first
+            t.pod_priority(pod),                    # low priority first
+            1 if pod.spec.tpu_resources else 0,     # chip holders last
+            -(used - req),                          # biggest overage first
+        )
+
+    return sorted(pods, key=key)
+
+
+class EvictionManager:
+    """Drives pressure conditions + evictions for one node agent.
+
+    ``usage_source``: () -> NodeUsage (injectable for tests).
+    ``pod_usage``: pod -> memory rss bytes (from the summary collector).
+    ``evict``: async (pod, reason, message) — the agent's kill hook.
+    """
+
+    def __init__(self, thresholds: Optional[Thresholds] = None,
+                 usage_source: Optional[Callable[[], NodeUsage]] = None,
+                 pod_usage: Optional[Callable[[t.Pod], float]] = None,
+                 evict: Optional[Callable[[t.Pod, str, str], Awaitable[None]]] = None,
+                 interval: float = 10.0):
+        self.thresholds = thresholds or Thresholds()
+        self.usage_source = usage_source or read_node_usage
+        #: None until the agent injects its RSS reader (or a test fake).
+        self.pod_usage = pod_usage
+        self.evict = evict
+        self.interval = interval
+        self.memory_pressure = False
+        self.disk_pressure = False
+        self._last_eviction = float("-inf")
+        self._task: Optional[asyncio.Task] = None
+        #: () -> list[t.Pod]: active pods on the node (set by the agent).
+        self.pod_source: Callable[[], list[t.Pod]] = list
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        self._task = asyncio.get_running_loop().create_task(self._loop())
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+
+    async def _loop(self) -> None:
+        while True:
+            try:
+                await self.synchronize()
+            except Exception:  # noqa: BLE001
+                log.exception("eviction synchronize failed")
+            await asyncio.sleep(self.interval)
+
+    # -- one observation/eviction pass ------------------------------------
+
+    async def synchronize(self) -> Optional[t.Pod]:
+        """Observe signals, update pressure, evict at most one pod.
+        Returns the evicted pod (tests assert on it)."""
+        usage = self.usage_source()
+        th = self.thresholds
+        # memory_capacity == 0 means the stats read failed (no signal);
+        # available == 0 with a real capacity is full exhaustion —
+        # exactly when eviction matters most.
+        self.memory_pressure = (
+            usage.memory_capacity > 0 and
+            usage.memory_available < th.memory_available_bytes)
+        self.disk_pressure = bool(
+            usage.fs_capacity and
+            usage.fs_available / usage.fs_capacity < th.fs_available_fraction)
+        if not (self.memory_pressure or self.disk_pressure):
+            return None
+        now = time.monotonic()
+        if now - self._last_eviction < th.eviction_cooldown:
+            return None
+        candidates = [p for p in self.pod_source()
+                      if t.pod_priority(p) < CRITICAL_PRIORITY
+                      and p.metadata.deletion_timestamp is None
+                      and not t.is_pod_terminal(p)]
+        if not candidates or self.evict is None:
+            return None
+        victim = rank_for_eviction(candidates,
+                                   self.pod_usage or (lambda p: 0.0))[0]
+        signal = ("memory" if self.memory_pressure else "disk")
+        msg = (f"The node had {signal} pressure "
+               f"(available memory {usage.memory_available >> 20}Mi, "
+               f"fs available {usage.fs_available >> 20}Mi)")
+        log.warning("evicting pod %s: %s", victim.key(), msg)
+        await self.evict(victim, "Evicted", msg)
+        self._last_eviction = now
+        return victim
+
+    # -- node conditions (merged into NodeStatus by the agent) ------------
+
+    def conditions(self) -> list[t.NodeCondition]:
+        return [
+            t.NodeCondition(
+                type=t.NODE_MEMORY_PRESSURE,
+                status="True" if self.memory_pressure else "False",
+                reason=("KubeletHasInsufficientMemory" if self.memory_pressure
+                        else "KubeletHasSufficientMemory")),
+            t.NodeCondition(
+                type=t.NODE_DISK_PRESSURE,
+                status="True" if self.disk_pressure else "False",
+                reason=("KubeletHasDiskPressure" if self.disk_pressure
+                        else "KubeletHasNoDiskPressure")),
+        ]
+
+
+def pick_preemption_victims(pods: list[t.Pod], incoming: t.Pod,
+                            slots_needed: int = 1) -> Optional[list[t.Pod]]:
+    """Critical-pod admission preemption (``preemption.go``): choose the
+    lowest-priority active pods to evict so ``incoming`` fits; None when
+    preemption cannot help (victims would not be lower priority)."""
+    if t.pod_priority(incoming) < CRITICAL_PRIORITY:
+        return None
+    candidates = sorted(
+        (p for p in pods
+         if t.pod_priority(p) < t.pod_priority(incoming)
+         and p.metadata.deletion_timestamp is None
+         and not t.is_pod_terminal(p)),
+        key=t.pod_priority)
+    if len(candidates) < slots_needed:
+        return None
+    return candidates[:slots_needed]

@@ -72,6 +72,22 @@ def node_is_schedulable(node: t.Node) -> Optional[str]:
     return None
 
 
+def node_pressure_allows(pod: t.Pod, node: t.Node) -> Optional[str]:
+    """CheckNodeMemoryPressure / CheckNodeDiskPressure (reference:
+    ``algorithm/predicates/predicates.go``): under MemoryPressure only
+    pods with memory requests (non-BestEffort) may land; under
+    DiskPressure nothing may."""
+    disk = t.get_node_condition(node.status, t.NODE_DISK_PRESSURE)
+    if disk is not None and disk.status == "True":
+        return "node has DiskPressure"
+    mem = t.get_node_condition(node.status, t.NODE_MEMORY_PRESSURE)
+    if mem is not None and mem.status == "True":
+        requests = t.pod_resource_requests(pod)
+        if not requests.get(t.RESOURCE_MEMORY):
+            return "node has MemoryPressure (best-effort pod rejected)"
+    return None
+
+
 def _chip_matches(chip: t.TpuChip, claim: t.PodTpuRequest) -> bool:
     # Attribute affinity (fork: extended_resources.go:152 isDeviceAMatch).
     return all(r.matches(chip.attributes) for r in claim.affinity)
@@ -162,6 +178,7 @@ def run_predicates(pod: t.Pod, info: NodeInfo,
         return PredicateResult(False, ["node unknown"])
     checks = [
         node_is_schedulable(node),
+        node_pressure_allows(pod, node),
         pod_tolerates_taints(pod, node),
         pod_matches_node_selector(pod, node),
         pod_fits_resources(pod, info),

@@ -1,0 +1,198 @@
+"""Node-pressure eviction + critical-pod preemption tests
+(reference tier: pkg/kubelet/eviction/eviction_manager_test.go,
+preemption_test.go)."""
+import asyncio
+
+import pytest
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.client.local import LocalClient
+from kubernetes_tpu.node.agent import NodeAgent
+from kubernetes_tpu.node.eviction import (CRITICAL_PRIORITY, EvictionManager,
+                                          NodeUsage, Thresholds,
+                                          pick_preemption_victims,
+                                          rank_for_eviction)
+from kubernetes_tpu.node.runtime import FakeRuntime
+from kubernetes_tpu.scheduler.predicates import node_pressure_allows
+
+from tests.controllers.util import make_plane, wait_for
+
+
+def mk_pod(name, priority=0, mem_request=0.0, tpu=False, uid=None):
+    res = t.ResourceRequirements(requests={"memory": mem_request}
+                                 if mem_request else {})
+    pod = t.Pod(metadata=ObjectMeta(name=name, namespace="default",
+                                    uid=uid or f"uid-{name}"),
+                spec=t.PodSpec(containers=[
+                    t.Container(name="c", image="img", resources=res)]))
+    pod.spec.priority = priority
+    if tpu:
+        pod.spec.tpu_resources = [t.PodTpuRequest(name="tpu", chips=1)]
+    return pod
+
+
+def usage(memory_available=10 * 2**20, fs_available=90, fs_capacity=100):
+    return NodeUsage(memory_available=memory_available,
+                     memory_capacity=2**30,
+                     fs_available=fs_available, fs_capacity=fs_capacity)
+
+
+def test_rank_over_request_then_priority_then_tpu():
+    over = mk_pod("over", priority=10, mem_request=100.0)     # uses 200
+    low = mk_pod("low", priority=0, mem_request=300.0)        # under request
+    tpu = mk_pod("tpu", priority=0, mem_request=300.0, tpu=True)
+    crit = mk_pod("crit", priority=100, mem_request=300.0)
+    rss = {"over": 200.0, "low": 100.0, "tpu": 100.0, "crit": 100.0}
+    ranked = rank_for_eviction([crit, tpu, low, over],
+                               lambda p: rss[p.metadata.name])
+    names = [p.metadata.name for p in ranked]
+    assert names[0] == "over"            # usage > request evicts first
+    assert names[1] == "low"             # then lowest priority, no chips
+    assert names[2] == "tpu"             # chip holder protected in band
+    assert names[3] == "crit"
+
+
+@pytest.mark.asyncio
+async def test_synchronize_evicts_one_and_sets_pressure():
+    evicted = []
+
+    async def evict(pod, reason, message):
+        evicted.append((pod.metadata.name, reason))
+
+    mgr = EvictionManager(
+        thresholds=Thresholds(memory_available_bytes=100 * 2**20,
+                              eviction_cooldown=9999),
+        usage_source=lambda: usage(memory_available=10 * 2**20),
+        pod_usage=lambda p: 0.0, evict=evict)
+    mgr.pod_source = lambda: [mk_pod("a", priority=5), mk_pod("b", priority=0)]
+    victim = await mgr.synchronize()
+    assert victim.metadata.name == "b" and evicted == [("b", "Evicted")]
+    assert mgr.memory_pressure and not mgr.disk_pressure
+    conds = {c.type: c.status for c in mgr.conditions()}
+    assert conds == {"MemoryPressure": "True", "DiskPressure": "False"}
+    # Cooldown: no second eviction this window.
+    assert await mgr.synchronize() is None
+
+
+@pytest.mark.asyncio
+async def test_no_eviction_without_pressure_and_critical_exempt():
+    async def evict(pod, reason, message):
+        raise AssertionError("must not evict")
+
+    mgr = EvictionManager(
+        thresholds=Thresholds(eviction_cooldown=0),
+        usage_source=lambda: usage(memory_available=2**30),
+        pod_usage=lambda p: 0.0, evict=evict)
+    mgr.pod_source = lambda: [mk_pod("a")]
+    assert await mgr.synchronize() is None
+    assert not mgr.memory_pressure
+
+    # Under pressure but only critical pods: nothing to evict.
+    mgr2 = EvictionManager(
+        thresholds=Thresholds(eviction_cooldown=0),
+        usage_source=lambda: usage(memory_available=1),
+        pod_usage=lambda p: 0.0, evict=evict)
+    mgr2.pod_source = lambda: [mk_pod("sys", priority=CRITICAL_PRIORITY)]
+    assert await mgr2.synchronize() is None
+    assert mgr2.memory_pressure
+
+
+def test_disk_pressure_signal():
+    mgr = EvictionManager(
+        thresholds=Thresholds(fs_available_fraction=0.10),
+        usage_source=lambda: usage(memory_available=2**30,
+                                   fs_available=5, fs_capacity=100))
+    mgr.pod_source = list
+    asyncio.run(mgr.synchronize())
+    assert mgr.disk_pressure and not mgr.memory_pressure
+
+
+def test_pick_preemption_victims():
+    low = mk_pod("low", priority=0)
+    mid = mk_pod("mid", priority=50)
+    crit = mk_pod("crit", priority=CRITICAL_PRIORITY)
+    # Non-critical incoming never preempts.
+    assert pick_preemption_victims([low], mk_pod("x", priority=100)) is None
+    # Critical incoming takes the lowest-priority victim.
+    victims = pick_preemption_victims([mid, low], crit)
+    assert [v.metadata.name for v in victims] == ["low"]
+    # A critical pod cannot preempt another critical pod.
+    assert pick_preemption_victims([mk_pod("c2", priority=CRITICAL_PRIORITY)],
+                                   crit) is None
+
+
+def test_scheduler_pressure_predicate():
+    node = t.Node(metadata=ObjectMeta(name="n"))
+    node.status.conditions = [t.NodeCondition(type=t.NODE_MEMORY_PRESSURE,
+                                              status="True")]
+    besteffort = mk_pod("be")
+    burstable = mk_pod("bu", mem_request=1024.0)
+    assert node_pressure_allows(besteffort, node) is not None
+    assert node_pressure_allows(burstable, node) is None
+    node.status.conditions.append(
+        t.NodeCondition(type=t.NODE_DISK_PRESSURE, status="True"))
+    assert node_pressure_allows(burstable, node) is not None
+
+
+@pytest.mark.asyncio
+async def test_agent_eviction_end_to_end():
+    """Agent under fake memory pressure fails the pod via the API and
+    publishes MemoryPressure in node status."""
+    reg, client, factory = make_plane()
+    mgr = EvictionManager(
+        thresholds=Thresholds(memory_available_bytes=100 * 2**20,
+                              eviction_cooldown=9999),
+        usage_source=lambda: usage(memory_available=1 * 2**20),
+        pod_usage=lambda p: 0.0, interval=0.1)
+    agent = NodeAgent(client, "n0", FakeRuntime(), eviction=mgr,
+                      status_interval=0.1, heartbeat_interval=5.0,
+                      pleg_interval=0.1, server_port=None)
+    await agent.start()
+    try:
+        pod = mk_pod("victim")
+        pod.spec.node_name = "n0"
+        await client.create(pod)
+
+        def evicted():
+            got = reg.get("pods", "default", "victim")
+            return got.status.phase == t.POD_FAILED and \
+                got.status.reason == "Evicted"
+        await wait_for(evicted)
+
+        def pressured():
+            node = reg.get("nodes", "", "n0")
+            c = t.get_node_condition(node.status, t.NODE_MEMORY_PRESSURE)
+            return c is not None and c.status == "True"
+        await wait_for(pressured)
+    finally:
+        await agent.stop()
+
+
+@pytest.mark.asyncio
+async def test_agent_critical_pod_preempts_at_max_pods():
+    reg, client, factory = make_plane()
+    agent = NodeAgent(client, "n0", FakeRuntime(), max_pods=1,
+                      status_interval=5.0, heartbeat_interval=5.0,
+                      pleg_interval=0.1, server_port=None)
+    await agent.start()
+    try:
+        filler = mk_pod("filler")
+        filler.spec.node_name = "n0"
+        await client.create(filler)
+        await wait_for(lambda: reg.get("pods", "default", "filler")
+                       .status.phase == t.POD_RUNNING)
+
+        crit = mk_pod("crit", priority=CRITICAL_PRIORITY)
+        crit.spec.node_name = "n0"
+        await client.create(crit)
+
+        def preempted_and_admitted():
+            f = reg.get("pods", "default", "filler")
+            c = reg.get("pods", "default", "crit")
+            return (f.status.phase == t.POD_FAILED and
+                    f.status.reason == "Preempted" and
+                    c.status.phase == t.POD_RUNNING)
+        await wait_for(preempted_and_admitted, timeout=10.0)
+    finally:
+        await agent.stop()
