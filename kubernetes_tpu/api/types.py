@@ -155,9 +155,43 @@ class TpuBinding:
 
 
 @dataclass
+class KeySelector:
+    """Selects one key of a ConfigMap/Secret in the pod's namespace."""
+    name: str = ""
+    key: str = ""
+    optional: bool = False
+
+
+@dataclass
+class FieldRef:
+    """Downward-API field selector (reference: ``ObjectFieldSelector``).
+    Supported paths: metadata.name, metadata.namespace, metadata.uid,
+    spec.node_name, status.pod_ip, status.host_ip."""
+    field_path: str = ""
+
+
+@dataclass
+class EnvVarSource:
+    config_map_key_ref: Optional[KeySelector] = None
+    secret_key_ref: Optional[KeySelector] = None
+    field_ref: Optional[FieldRef] = None
+
+
+@dataclass
 class EnvVar:
     name: str = ""
     value: str = ""
+    value_from: Optional[EnvVarSource] = None
+
+
+@dataclass
+class EnvFromSource:
+    """Bulk env import (reference: ``EnvFromSource``): every data key of
+    the named ConfigMap/Secret becomes ``{prefix}{key}``."""
+    prefix: str = ""
+    config_map_ref: str = ""
+    secret_ref: str = ""
+    optional: bool = False
 
 
 @dataclass
@@ -240,6 +274,7 @@ class Container:
     args: list[str] = field(default_factory=list)
     working_dir: str = ""
     env: list[EnvVar] = field(default_factory=list)
+    env_from: list[EnvFromSource] = field(default_factory=list)
     ports: list[ContainerPort] = field(default_factory=list)
     resources: ResourceRequirements = field(default_factory=ResourceRequirements)
     volume_mounts: list[VolumeMount] = field(default_factory=list)
@@ -609,8 +644,12 @@ class ConfigMap(TypedObject):
 
 @dataclass
 class Secret(TypedObject):
+    """``data`` values are base64 (reference wire format, no guessing);
+    ``string_data`` is the plaintext write-convenience field, merged
+    into ``data`` by the create/update strategy."""
     type: str = "Opaque"
     data: dict[str, str] = field(default_factory=dict)
+    string_data: dict[str, str] = field(default_factory=dict)
 
 
 @dataclass

@@ -257,6 +257,23 @@ def validate_service(svc: t.Service, is_create: bool = True) -> None:
     errs.raise_if_any("Service", svc.metadata.name)
 
 
+def validate_secret(sec: t.Secret, is_create: bool = True) -> None:
+    """``data`` values must be valid base64 (reference:
+    ``validation.go ValidateSecret``); plaintext belongs in
+    ``string_data``, which the strategy merges before validation."""
+    import base64
+    import binascii
+    errs = ErrorList()
+    validate_object_meta(sec.metadata, errs)
+    for key, value in sec.data.items():
+        try:
+            base64.b64decode(value, validate=True)
+        except (binascii.Error, ValueError):
+            errs.add(f"data[{key}]",
+                     "must be base64 (use string_data for plaintext)")
+    errs.raise_if_any("Secret", sec.metadata.name)
+
+
 def validate_namespace(ns: t.Namespace, is_create: bool = True) -> None:
     errs = ErrorList()
     validate_object_meta(ns.metadata, errs, namespaced=False)

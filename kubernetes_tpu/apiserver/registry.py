@@ -64,6 +64,16 @@ def _node_fields(node: t.Node) -> dict:
             "spec.unschedulable": str(node.spec.unschedulable).lower()}
 
 
+def _merge_secret_string_data(sec: t.Secret) -> None:
+    """Secret strategy: fold the plaintext ``string_data`` convenience
+    field into base64 ``data`` (reference: pkg/registry/core/secret
+    strategy + Secret.StringData semantics)."""
+    import base64 as _b64
+    for k, v in sec.string_data.items():
+        sec.data[k] = _b64.b64encode(v.encode()).decode()
+    sec.string_data = {}
+
+
 def _event_fields(ev: t.Event) -> dict:
     return {
         "metadata.name": ev.metadata.name,
@@ -91,7 +101,8 @@ def builtin_resources() -> list[ResourceSpec]:
         ResourceSpec("namespaces", "Namespace", core, t.Namespace, namespaced=False,
                      validate_create=val.validate_namespace),
         ResourceSpec("configmaps", "ConfigMap", core, t.ConfigMap, has_status=False),
-        ResourceSpec("secrets", "Secret", core, t.Secret, has_status=False),
+        ResourceSpec("secrets", "Secret", core, t.Secret, has_status=False,
+                     validate_create=val.validate_secret),
         ResourceSpec("events", "Event", core, t.Event, has_status=False,
                      field_extractor=_event_fields),
         ResourceSpec("resourcequotas", "ResourceQuota", core, t.ResourceQuota),
@@ -195,6 +206,8 @@ class Registry:
                 and not spec.preserve_status_on_create):
             # Strategy PrepareForCreate: clients cannot seed status.
             obj.status = type(obj.status)()
+        if isinstance(obj, t.Secret):
+            _merge_secret_string_data(obj)
         if self.admission is not None:
             obj = self.admission.admit("CREATE", spec, obj, None)
         if spec.validate_create:
@@ -202,14 +215,21 @@ class Registry:
         if dry_run:
             return obj
         # IP/CIDR allocation happens last — after admission/validation/
-        # dry_run — and is rolled back if the store insert fails
-        # (AlreadyExists on node re-registration must not leak a block).
-        self._claim_ips(obj)
+        # dry_run. An already-existing object must surface AlreadyExists
+        # (ktl apply's create-then-update fallback depends on it), never
+        # a VIP-collision error against itself — so claims are skipped
+        # when the key exists, and rollback releases ONLY values this
+        # call allocated (releasing a duplicate explicit value would
+        # free a block the stored owner still holds).
         key = self._key(spec, meta.namespace, meta.name)
+        rollback: list = []
+        if not self.store.exists(key):
+            rollback = self._claim_ips(obj)
         try:
             rev = self.store.create(key, self._encode(obj))
         except Exception:
-            self._release_ips(obj)
+            for release, value in rollback:
+                release(value)
             raise
         meta.resource_version = str(rev)
         return obj
@@ -257,13 +277,17 @@ class Registry:
         self._ensure_node_allocator()
         node.spec.pod_cidr = self._node_cidrs.allocate()
 
-    def _claim_ips(self, obj: TypedObject) -> None:
+    def _claim_ips(self, obj: TypedObject) -> list:
         """Create-path counterpart of :meth:`_release_ips`: allocate the
         VIP/CIDR when absent, or claim (occupy) an explicit value —
-        rejecting a VIP another service already holds."""
+        rejecting one another object already holds. Returns
+        ``[(release_fn, value), ...]`` for exactly what this call took,
+        so a failed create rolls back nothing it does not own."""
+        rollback: list = []
         if isinstance(obj, t.Service):
             if not obj.spec.cluster_ip:
                 self._prepare_service(obj)
+                rollback.append((self._svc_ips.release, obj.spec.cluster_ip))
             elif obj.spec.cluster_ip != "None":
                 self._ensure_svc_allocator()
                 if self._svc_ips.is_used(obj.spec.cluster_ip):
@@ -271,12 +295,20 @@ class Registry:
                         f"Service {obj.metadata.name!r}: spec.cluster_ip "
                         f"{obj.spec.cluster_ip} is already allocated")
                 self._svc_ips.occupy(obj.spec.cluster_ip)
+                rollback.append((self._svc_ips.release, obj.spec.cluster_ip))
         if isinstance(obj, t.Node):
             if not obj.spec.pod_cidr:
                 self._prepare_node(obj)
+                rollback.append((self._node_cidrs.release, obj.spec.pod_cidr))
             else:
                 self._ensure_node_allocator()
+                if self._node_cidrs.is_used(obj.spec.pod_cidr):
+                    raise errors.InvalidError(
+                        f"Node {obj.metadata.name!r}: spec.pod_cidr "
+                        f"{obj.spec.pod_cidr} is already allocated")
                 self._node_cidrs.occupy(obj.spec.pod_cidr)
+                rollback.append((self._node_cidrs.release, obj.spec.pod_cidr))
+        return rollback
 
     def _release_ips(self, obj: TypedObject) -> None:
         """Return an object's IP/CIDR allocation on actual removal —
@@ -342,6 +374,8 @@ class Registry:
             # Immutable server-owned fields.
             new.metadata.uid = old.metadata.uid
             new.metadata.creation_timestamp = old.metadata.creation_timestamp
+            if isinstance(new, t.Secret):
+                _merge_secret_string_data(new)
             if self._spec_changed(spec, new, old):
                 new.metadata.generation = old.metadata.generation + 1
             else:

@@ -67,6 +67,10 @@ class CIDRAllocator:
         if 0 <= idx < self._count:
             self._used.add(idx)
 
+    def is_used(self, cidr: str) -> bool:
+        net, _ = parse_cidr(cidr)
+        return (net - self._net) // self._block in self._used
+
     def release(self, cidr: str) -> None:
         net, _ = parse_cidr(cidr)
         idx = (net - self._net) // self._block

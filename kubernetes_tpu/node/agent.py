@@ -19,7 +19,9 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import socket
+import tempfile
 import time
 from typing import Optional
 
@@ -35,6 +37,7 @@ from .devicemanager import DeviceManager
 from .eviction import EvictionManager, pick_preemption_victims
 from .probes import ProbeManager
 from .stats import _proc_stat
+from .volumes import VolumeError, VolumeManager, resolve_env
 from .runtime import (STATE_EXITED, STATE_RUNNING, ContainerConfig,
                       ContainerRuntime, ContainerStatus as RtStatus)
 
@@ -81,6 +84,10 @@ class NodeAgent:
         self.proxy = proxy
         #: Node-pressure eviction manager (eviction.py); None disables.
         self.eviction = eviction
+        #: ConfigMap/Secret/EmptyDir materialization (volumes.py).
+        vol_dir = getattr(runtime, "root_dir", None) or os.path.join(
+            tempfile.gettempdir(), f"ktpu-{node_name}")
+        self.volumes = VolumeManager(client, vol_dir)
 
         self._pods: dict[str, t.Pod] = {}        # key -> desired pod
         self._workers: dict[str, asyncio.Task] = {}
@@ -448,8 +455,19 @@ class NodeAgent:
 
     async def _start_container(self, pod: t.Pod, container: t.Container,
                                cmap: dict[str, str]) -> None:
-        env = {e.name: e.value for e in container.env}
-        mounts: list[tuple] = []
+        pod_ip = self.ipam.ip_for(pod.metadata.uid)
+        try:
+            env = await resolve_env(
+                self.client, pod, container,
+                {"status.pod_ip": pod_ip, "status.host_ip": self.address})
+            volume_paths = await self.volumes.materialize(pod)
+            mounts = self.volumes.mounts_for(container, volume_paths)
+        except (VolumeError, OSError) as e:
+            # Transient by contract (missing object now, ENOSPC/EACCES
+            # during projection): the worker retries next sync
+            # (reference mount/env backoff).
+            self.recorder.event(pod, "Warning", "FailedMount", str(e))
+            return
         devices: list[str] = []
         if self.device_manager and container.tpu_requests:
             try:
@@ -464,7 +482,7 @@ class NodeAgent:
         env.setdefault("POD_NAME", pod.metadata.name)
         env.setdefault("POD_NAMESPACE", pod.metadata.namespace)
         env.setdefault("NODE_NAME", self.node_name)
-        env.setdefault("POD_IP", self.ipam.ip_for(pod.metadata.uid))
+        env.setdefault("POD_IP", pod_ip)
         # Service discovery env (kubelet_pods.go getServiceEnvVarMap);
         # container-specified env always wins.
         if self._svc_informer is not None:
@@ -601,6 +619,7 @@ class NodeAgent:
         self._admitted.discard(key)
         self._pod_uids.pop(key, None)
         self.ipam.release(pod.metadata.uid)
+        self.volumes.teardown(pod.metadata.uid)
         # Confirm deletion: grace-0 delete completes removal (the node
         # agent is the only caller allowed to finish a pod's deletion).
         try:
@@ -623,6 +642,7 @@ class NodeAgent:
         if uid:
             self.ipam.release(uid)
             self._evicted.discard(uid)
+            self.volumes.teardown(uid)
 
     # -- PLEG (pleg/generic.go:110) ---------------------------------------
 
@@ -668,8 +688,14 @@ class NodeAgent:
         self._evicted.add(pod.metadata.uid)
         self.recorder.event(pod, "Warning", reason, message)
         self.probes.remove_pod(key)
-        for cid in self._containers.get(key, {}).values():
+        # Actually reclaim node resources: remove containers (logs +
+        # sandbox dirs) and projected volumes, not just stop processes —
+        # a disk-pressure eviction that frees no bytes never clears the
+        # signal (reference: eviction reclaims via container/image GC).
+        for cid in self._containers.pop(key, {}).values():
             await self.runtime.stop_container(cid, grace_seconds=1.0)
+            await self.runtime.remove_container(cid)
+        self.volumes.teardown(pod.metadata.uid)
         try:
             cur = await self.client.get("pods", pod.metadata.namespace,
                                         pod.metadata.name)

@@ -205,7 +205,9 @@ def pick_preemption_victims(pods: list[t.Pod], incoming: t.Pod,
          if t.pod_priority(p) < t.pod_priority(incoming)
          and p.metadata.deletion_timestamp is None
          and not t.is_pod_terminal(p)),
-        key=t.pod_priority)
+        # Same TPU tiebreak as rank_for_eviction: within a priority
+        # band, a chip-less sidecar goes before a gang member.
+        key=lambda p: (t.pod_priority(p), 1 if p.spec.tpu_resources else 0))
     if len(candidates) < slots_needed:
         return None
     return candidates[:slots_needed]

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import asyncio
 import os
+import shutil
 import signal
 import time
 from dataclasses import dataclass, field
@@ -81,6 +82,10 @@ class ProcessRuntime(ContainerRuntime):
 
     def __init__(self, root_dir: str):
         self.root_dir = root_dir
+        #: The "image" of a process container is the host environment at
+        #: runtime creation; keep its cwd importable after the cwd moves
+        #: into the per-container sandbox.
+        self._host_cwd = os.getcwd()
         os.makedirs(root_dir, exist_ok=True)
         self._procs: dict[str, asyncio.subprocess.Process] = {}
         self._status: dict[str, ContainerStatus] = {}
@@ -101,12 +106,32 @@ class ProcessRuntime(ContainerRuntime):
         env = dict(os.environ)
         env.update(config.env)
         env["KTPU_POD"] = f"{config.pod_namespace}/{config.pod_name}"
+        # Mount projection without privileges: a per-container sandbox
+        # dir where each mount path appears as a symlink to its host
+        # source, and which is the default cwd — so a container reading
+        # its declared mount_path (relative, or absolute re-rooted
+        # under the sandbox) sees the volume. A real CRI runtime would
+        # bind-mount instead (reference: dockershim container config).
+        sandbox = os.path.join(self.root_dir, "sandboxes", cid)
+        os.makedirs(sandbox, exist_ok=True)
+        for host, cpath, _ro in config.mounts:
+            link = os.path.join(sandbox, cpath.lstrip("/"))
+            os.makedirs(os.path.dirname(link), exist_ok=True)
+            if os.path.islink(link) or os.path.exists(link):
+                try:
+                    os.unlink(link)
+                except OSError:
+                    continue
+            os.symlink(host, link)
+        env["KTPU_SANDBOX"] = sandbox
+        env["PYTHONPATH"] = (f"{self._host_cwd}:{env['PYTHONPATH']}"
+                             if env.get("PYTHONPATH") else self._host_cwd)
         os.makedirs(os.path.dirname(self._log_path(cid)), exist_ok=True)
         log_f = open(self._log_path(cid), "wb")
         try:
             proc = await asyncio.create_subprocess_exec(
                 *argv, stdout=log_f, stderr=asyncio.subprocess.STDOUT,
-                env=env, cwd=config.working_dir or None,
+                env=env, cwd=config.working_dir or sandbox,
                 start_new_session=True)
         except (FileNotFoundError, PermissionError) as e:
             log_f.close()
@@ -166,6 +191,8 @@ class ProcessRuntime(ContainerRuntime):
             os.unlink(self._log_path(container_id))
         except OSError:
             pass
+        shutil.rmtree(os.path.join(self.root_dir, "sandboxes", container_id),
+                      ignore_errors=True)
 
     async def list_containers(self) -> list[ContainerStatus]:
         return list(self._status.values())

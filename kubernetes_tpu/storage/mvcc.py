@@ -283,6 +283,10 @@ class MVCCStore:
             self._append_event(WatchEvent(ADDED, key, value, None, self._rev))
             return self._rev
 
+    def exists(self, key: str) -> bool:
+        with self._lock:
+            return key in self._data
+
     def get(self, key: str, copy: bool = True) -> StoredObject:
         """Read one key. ``copy=True`` (default) deep-copies the value so
         callers can't corrupt store state; readers that immediately decode

@@ -59,3 +59,14 @@ async def test_cidr_released_on_node_delete():
     await client.delete("nodes", "", "n1")
     n2 = await client.create(mk_node("n2"))
     assert n2.spec.pod_cidr == first
+
+
+@pytest.mark.asyncio
+async def test_duplicate_explicit_cidr_rejected():
+    from kubernetes_tpu.api import errors
+    reg, client, factory = make_plane()
+    n1 = await client.create(mk_node("n1"))
+    thief = mk_node("thief")
+    thief.spec.pod_cidr = n1.spec.pod_cidr
+    with pytest.raises(errors.InvalidError):
+        await client.create(thief)

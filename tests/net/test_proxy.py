@@ -161,3 +161,21 @@ async def test_cluster_ip_allocated_and_released_by_registry():
     hl.spec.cluster_ip = "None"
     created = await client.create(hl)
     assert created.spec.cluster_ip == "None"
+
+
+@pytest.mark.asyncio
+async def test_recreate_service_with_own_vip_surfaces_already_exists():
+    """ktl apply's create-then-update fallback depends on AlreadyExists
+    (not a VIP-collision error) when re-creating an existing object."""
+    from kubernetes_tpu.api import errors
+    reg, client, _ = make_plane()
+    created = await client.create(mk_service("a", 80))
+    clone = mk_service("a", 80)
+    clone.spec.cluster_ip = created.spec.cluster_ip
+    with pytest.raises(errors.AlreadyExistsError):
+        await client.create(clone)
+    # ... and the stored service's VIP is still allocated afterwards.
+    dup = mk_service("thief", 80)
+    dup.spec.cluster_ip = created.spec.cluster_ip
+    with pytest.raises(errors.InvalidError):
+        await client.create(dup)

@@ -88,9 +88,15 @@ async def test_node_registers_with_tpu_topology(tmp_path):
         assert node.status.capacity[t.RESOURCE_TPU] == 4.0
         assert node.status.tpu.slice_id == "s0"
         assert all(len(c.coords) == 3 for c in node.status.tpu.chips)
-        # Heartbeat lease exists and renews.
-        lease = reg.get("leases", "kube-system", "node-worker-0")
-        assert lease.spec.renew_time is not None
+        # Heartbeat lease exists and renews (created by the heartbeat
+        # loop, which can lag the first topology-bearing status post).
+        def lease_exists():
+            try:
+                return reg.get("leases", "kube-system", "node-worker-0")
+            except errors.NotFoundError:
+                return None
+        lease = await wait_for(lease_exists)
+        assert lease and lease.spec.renew_time is not None
     finally:
         await teardown(agent, sched, plugin)
 

@@ -1,0 +1,192 @@
+"""Volume materialization + env valueFrom/envFrom tests (reference
+tier: pkg/volume/{configmap,secret} + kubelet_pods env tests)."""
+import base64
+import os
+
+import pytest
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.node.agent import NodeAgent
+from kubernetes_tpu.node.runtime import ProcessRuntime
+from kubernetes_tpu.node.volumes import (VolumeError, VolumeManager,
+                                         resolve_env, secret_bytes)
+
+from tests.controllers.util import make_plane, wait_for
+
+
+def mk_pod(name="p", volumes=(), containers=None, uid="uid-1"):
+    return t.Pod(
+        metadata=ObjectMeta(name=name, namespace="default", uid=uid),
+        spec=t.PodSpec(containers=containers or
+                       [t.Container(name="c", image="img")],
+                       volumes=list(volumes)))
+
+
+@pytest.mark.asyncio
+async def test_configmap_volume_materialized_and_refreshed(tmp_path):
+    reg, client, _ = make_plane()
+    await client.create(t.ConfigMap(
+        metadata=ObjectMeta(name="cfg", namespace="default"),
+        data={"app.conf": "threads=4", "drop.me": "x"}))
+    vm = VolumeManager(client, str(tmp_path))
+    pod = mk_pod(volumes=[t.Volume(name="conf",
+                                   config_map=t.ConfigMapVolume(name="cfg"))])
+    paths = await vm.materialize(pod)
+    vdir = paths["conf"]
+    assert open(os.path.join(vdir, "app.conf")).read() == "threads=4"
+
+    cm = await client.get("configmaps", "default", "cfg")
+    cm.data = {"app.conf": "threads=8"}          # key dropped + value changed
+    await client.update(cm)
+    await vm.materialize(pod)
+    assert open(os.path.join(vdir, "app.conf")).read() == "threads=8"
+    assert not os.path.exists(os.path.join(vdir, "drop.me"))
+
+
+@pytest.mark.asyncio
+async def test_secret_volume_base64_and_mode(tmp_path):
+    reg, client, _ = make_plane()
+    await client.create(t.Secret(
+        metadata=ObjectMeta(name="sec", namespace="default"),
+        data={"token": base64.b64encode(b"s3cr3t").decode()},
+        string_data={"plain": "pass1234"}))   # merged to base64 server-side
+    vm = VolumeManager(client, str(tmp_path))
+    pod = mk_pod(volumes=[t.Volume(name="s",
+                                   secret=t.SecretVolume(secret_name="sec"))])
+    paths = await vm.materialize(pod)
+    token = os.path.join(paths["s"], "token")
+    assert open(token, "rb").read() == b"s3cr3t"
+    assert oct(os.stat(token).st_mode & 0o777) == "0o600"
+    # string_data survives round-trip as plaintext bytes — even values
+    # that happen to look like base64 ("pass1234") are not re-decoded.
+    assert open(os.path.join(paths["s"], "plain")).read() == "pass1234"
+    stored = reg.get("secrets", "default", "sec")
+    assert stored.string_data == {}
+
+    # Raw non-base64 data is rejected at the API.
+    from kubernetes_tpu.api import errors
+    with pytest.raises(errors.InvalidError):
+        await client.create(t.Secret(
+            metadata=ObjectMeta(name="bad", namespace="default"),
+            data={"x": "!!not base64"}))
+
+
+@pytest.mark.asyncio
+async def test_missing_configmap_raises_volume_error(tmp_path):
+    reg, client, _ = make_plane()
+    vm = VolumeManager(client, str(tmp_path))
+    pod = mk_pod(volumes=[t.Volume(name="conf",
+                                   config_map=t.ConfigMapVolume(name="nope"))])
+    with pytest.raises(VolumeError):
+        await vm.materialize(pod)
+
+
+@pytest.mark.asyncio
+async def test_mounts_for_and_teardown(tmp_path):
+    reg, client, _ = make_plane()
+    vm = VolumeManager(client, str(tmp_path))
+    pod = mk_pod(volumes=[t.Volume(name="scratch",
+                                   empty_dir=t.EmptyDirVolume()),
+                          t.Volume(name="host",
+                                   host_path=t.HostPathVolume(path="/opt"))])
+    paths = await vm.materialize(pod)
+    c = t.Container(name="c", volume_mounts=[
+        t.VolumeMount(name="scratch", mount_path="/scratch"),
+        t.VolumeMount(name="host", mount_path="/opt", read_only=True)])
+    mounts = vm.mounts_for(c, paths)
+    assert mounts == [(paths["scratch"], "/scratch", False),
+                      ("/opt", "/opt", True)]
+    with pytest.raises(VolumeError):
+        vm.mounts_for(t.Container(name="c", volume_mounts=[
+            t.VolumeMount(name="ghost", mount_path="/g")]), paths)
+    assert os.path.isdir(paths["scratch"])
+    vm.teardown(pod.metadata.uid)
+    assert not os.path.exists(paths["scratch"])
+
+
+@pytest.mark.asyncio
+async def test_resolve_env_all_sources():
+    reg, client, _ = make_plane()
+    await client.create(t.ConfigMap(
+        metadata=ObjectMeta(name="cfg", namespace="default"),
+        data={"LOG_LEVEL": "debug", "MODE": "fast"}))
+    await client.create(t.Secret(
+        metadata=ObjectMeta(name="sec", namespace="default"),
+        data={"TOKEN": base64.b64encode(b"tok123").decode()}))
+    pod = mk_pod()
+    pod.spec.node_name = "n7"
+    container = t.Container(
+        name="c",
+        env_from=[t.EnvFromSource(config_map_ref="cfg", prefix="CFG_")],
+        env=[
+            t.EnvVar(name="PLAIN", value="v"),
+            t.EnvVar(name="TOK", value_from=t.EnvVarSource(
+                secret_key_ref=t.KeySelector(name="sec", key="TOKEN"))),
+            t.EnvVar(name="LVL", value_from=t.EnvVarSource(
+                config_map_key_ref=t.KeySelector(name="cfg", key="LOG_LEVEL"))),
+            t.EnvVar(name="MY_NODE", value_from=t.EnvVarSource(
+                field_ref=t.FieldRef(field_path="spec.node_name"))),
+            t.EnvVar(name="MY_IP", value_from=t.EnvVarSource(
+                field_ref=t.FieldRef(field_path="status.pod_ip"))),
+            t.EnvVar(name="MISSING_OK", value_from=t.EnvVarSource(
+                config_map_key_ref=t.KeySelector(name="cfg", key="nope",
+                                                 optional=True))),
+        ])
+    env = await resolve_env(client, pod, container,
+                            {"status.pod_ip": "10.64.0.9"})
+    assert env["CFG_LOG_LEVEL"] == "debug" and env["CFG_MODE"] == "fast"
+    assert env["PLAIN"] == "v"
+    assert env["TOK"] == "tok123"
+    assert env["LVL"] == "debug"
+    assert env["MY_NODE"] == "n7"
+    assert env["MY_IP"] == "10.64.0.9"
+    assert "MISSING_OK" not in env
+
+    with pytest.raises(VolumeError):
+        await resolve_env(client, pod, t.Container(name="c", env=[
+            t.EnvVar(name="X", value_from=t.EnvVarSource(
+                secret_key_ref=t.KeySelector(name="nope", key="k")))]))
+
+
+def test_secret_bytes():
+    assert secret_bytes(base64.b64encode(b"abc").decode()) == b"abc"
+    with pytest.raises(VolumeError):
+        secret_bytes("!!not base64")
+
+
+@pytest.mark.asyncio
+async def test_pod_consumes_configmap_end_to_end(tmp_path):
+    """ProcessRuntime sandbox: the container reads its mounted ConfigMap
+    file at the declared mount path and echoes it to its logs."""
+    reg, client, _ = make_plane()
+    await client.create(t.ConfigMap(
+        metadata=ObjectMeta(name="cfg", namespace="default"),
+        data={"greeting.txt": "hello-from-configmap"}))
+    runtime = ProcessRuntime(str(tmp_path))
+    agent = NodeAgent(client, "n0", runtime, status_interval=5.0,
+                      heartbeat_interval=5.0, pleg_interval=0.1,
+                      server_port=None)
+    await agent.start()
+    try:
+        pod = t.Pod(
+            metadata=ObjectMeta(name="reader", namespace="default"),
+            spec=t.PodSpec(
+                restart_policy="Never",
+                node_name="n0",
+                volumes=[t.Volume(name="conf",
+                                  config_map=t.ConfigMapVolume(name="cfg"))],
+                containers=[t.Container(
+                    name="c", image="local",
+                    command=["python3", "-c",
+                             "print(open('etc/conf/greeting.txt').read())"],
+                    volume_mounts=[t.VolumeMount(name="conf",
+                                                 mount_path="/etc/conf")])]))
+        await client.create(pod)
+        await wait_for(lambda: reg.get("pods", "default", "reader")
+                       .status.phase == t.POD_SUCCEEDED, timeout=15.0)
+        cid = agent._containers["default/reader"]["c"]
+        logs = await runtime.container_logs(cid)
+        assert "hello-from-configmap" in logs
+    finally:
+        await agent.stop()
