@@ -1,0 +1,98 @@
+"""RBAC API types — Role/ClusterRole + bindings.
+
+Reference: ``staging/src/k8s.io/api/rbac/v1/types.go`` and the RBAC
+authorizer in ``plugin/pkg/auth/authorizer/rbac``. Same shape, reduced
+to the fields the authorizer consumes: rules are (verbs, resources,
+resource_names); subjects are users/groups (service accounts fold into
+users as ``system:serviceaccount:<ns>:<name>``, the reference's own
+encoding).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from .meta import TypedObject
+
+#: Wildcard matching anything (verbs, resources, names).
+ALL = "*"
+
+#: Implicit group carried by every authenticated request (reference:
+#: ``user.AllAuthenticated``).
+GROUP_AUTHENTICATED = "system:authenticated"
+#: Superuser group — bypasses authorization entirely (reference:
+#: ``authorizer.PrivilegedGroup`` / system:masters).
+GROUP_MASTERS = "system:masters"
+
+
+@dataclass
+class PolicyRule:
+    verbs: list[str] = field(default_factory=list)
+    resources: list[str] = field(default_factory=list)
+    #: Restrict to specific object names ([] = any).
+    resource_names: list[str] = field(default_factory=list)
+
+    def matches(self, verb: str, resource: str, name: str) -> bool:
+        if ALL not in self.verbs and verb not in self.verbs:
+            return False
+        if ALL not in self.resources and resource not in self.resources:
+            return False
+        if self.resource_names and ALL not in self.resource_names \
+                and name not in self.resource_names:
+            return False
+        return True
+
+
+@dataclass
+class Subject:
+    kind: str = "User"  # User | Group
+    name: str = ""
+
+
+@dataclass
+class RoleRef:
+    kind: str = "Role"  # Role | ClusterRole
+    name: str = ""
+
+
+@dataclass
+class Role(TypedObject):
+    """Namespaced permission set."""
+    rules: list[PolicyRule] = field(default_factory=list)
+
+
+@dataclass
+class ClusterRole(TypedObject):
+    """Cluster-wide permission set."""
+    rules: list[PolicyRule] = field(default_factory=list)
+
+
+@dataclass
+class RoleBinding(TypedObject):
+    """Grants a Role (or ClusterRole) within the binding's namespace."""
+    role_ref: RoleRef = field(default_factory=RoleRef)
+    subjects: list[Subject] = field(default_factory=list)
+
+
+@dataclass
+class ClusterRoleBinding(TypedObject):
+    """Grants a ClusterRole across all namespaces."""
+    role_ref: RoleRef = field(default_factory=RoleRef)
+    subjects: list[Subject] = field(default_factory=list)
+
+
+RBAC_V1 = "rbac/v1"
+
+from .scheme import DEFAULT_SCHEME  # noqa: E402  (registration, like workloads.py)
+
+for _kind, _cls in [("Role", Role), ("ClusterRole", ClusterRole),
+                    ("RoleBinding", RoleBinding),
+                    ("ClusterRoleBinding", ClusterRoleBinding)]:
+    DEFAULT_SCHEME.register(RBAC_V1, _kind, _cls)
+
+
+def subject_matches(subject: Subject, user: str, groups: set[str]) -> bool:
+    if subject.kind == "User":
+        return subject.name == user or subject.name == ALL
+    if subject.kind == "Group":
+        return subject.name in groups
+    return False

@@ -1,0 +1,68 @@
+"""Audit logging — structured request records.
+
+Reference: ``staging/src/k8s.io/apiserver/pkg/audit/`` — policy-driven
+event levels (None/Metadata/Request/RequestResponse) written by a log
+backend as JSON lines. Here: one event per API request, emitted after
+the response (ResponseComplete stage), with the request body attached
+at Request level and above. Read-only verbs can be excluded by policy
+(the common production config).
+"""
+from __future__ import annotations
+
+import datetime
+import json
+import logging
+from typing import IO, Optional
+
+log = logging.getLogger("audit")
+
+LEVEL_NONE = "None"
+LEVEL_METADATA = "Metadata"
+LEVEL_REQUEST = "Request"
+
+_READ_VERBS = {"get", "list", "watch"}
+
+
+class AuditLogger:
+    """JSON-lines audit backend. ``path`` or ``stream``; level selects
+    how much is recorded; ``omit_reads`` drops get/list/watch events."""
+
+    def __init__(self, path: str = "", stream: Optional[IO] = None,
+                 level: str = LEVEL_METADATA, omit_reads: bool = False):
+        self.level = level
+        self.omit_reads = omit_reads
+        self._stream = stream
+        self._path = path
+        if path and stream is None:
+            self._stream = open(path, "a", buffering=1)
+
+    def close(self) -> None:
+        if self._path and self._stream:
+            self._stream.close()
+            self._stream = None
+
+    def record(self, *, user: str, verb: str, resource: str,
+               namespace: str, name: str, code: int,
+               latency_seconds: float, body: Optional[dict] = None) -> None:
+        if self.level == LEVEL_NONE or self._stream is None:
+            return
+        if self.omit_reads and verb in _READ_VERBS:
+            return
+        event = {
+            "stage": "ResponseComplete",
+            "timestamp": datetime.datetime.now(
+                datetime.timezone.utc).isoformat(),
+            "user": user,
+            "verb": verb,
+            "resource": resource,
+            "namespace": namespace,
+            "name": name,
+            "code": code,
+            "latency_seconds": round(latency_seconds, 6),
+        }
+        if self.level == LEVEL_REQUEST and body is not None:
+            event["request_object"] = body
+        try:
+            self._stream.write(json.dumps(event) + "\n")
+        except (OSError, ValueError):
+            log.exception("audit write failed")

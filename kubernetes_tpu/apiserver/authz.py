@@ -1,0 +1,121 @@
+"""Authorization — RBAC authorizer + mode selection.
+
+Reference: ``plugin/pkg/auth/authorizer/rbac/rbac.go`` (RuleResolver
+walking bindings -> roles -> rules) and the apiserver's
+``--authorization-mode`` (AlwaysAllow / RBAC). The resolver reads the
+registry directly (in-proc store reads are cheap and always current —
+the reference uses informers for the same data).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..api import errors, rbac
+from .registry import Registry
+
+
+class Attributes:
+    """One authorization question (reference: ``authorizer.Attributes``)."""
+
+    ANONYMOUS = "system:anonymous"
+
+    def __init__(self, user: str, groups: set[str], verb: str,
+                 resource: str, namespace: str = "", name: str = ""):
+        self.user = user
+        # Anonymous callers are NOT system:authenticated (reference:
+        # anonymous gets system:unauthenticated) — otherwise an
+        # any-logged-in-user grant would extend to unauthenticated ones.
+        implicit = ("system:unauthenticated" if user == self.ANONYMOUS
+                    else rbac.GROUP_AUTHENTICATED)
+        self.groups = groups | {implicit}
+        self.verb = verb
+        self.resource = resource
+        self.namespace = namespace
+        self.name = name
+
+    def __repr__(self) -> str:  # for Forbidden messages + audit
+        scope = f" in {self.namespace!r}" if self.namespace else ""
+        return (f"user {self.user!r} {self.verb} "
+                f"{self.resource}/{self.name or '*'}{scope}")
+
+
+class Authorizer:
+    def authorize(self, attrs: Attributes) -> bool:
+        raise NotImplementedError
+
+
+class AlwaysAllow(Authorizer):
+    """Dev mode — the reference's insecure/AlwaysAllow stance."""
+
+    def authorize(self, attrs: Attributes) -> bool:
+        return True
+
+
+class RBACAuthorizer(Authorizer):
+    def __init__(self, registry: Registry):
+        self.registry = registry
+
+    def authorize(self, attrs: Attributes) -> bool:
+        if rbac.GROUP_MASTERS in attrs.groups:
+            return True
+        # Cluster-wide grants.
+        for binding in self._list("clusterrolebindings", ""):
+            if not self._bound(binding, attrs):
+                continue
+            rules = self._role_rules(binding.role_ref, "")
+            if self._rules_allow(rules, attrs):
+                return True
+        # Namespaced grants (only meaningful for namespaced requests).
+        if attrs.namespace:
+            for binding in self._list("rolebindings", attrs.namespace):
+                if not self._bound(binding, attrs):
+                    continue
+                rules = self._role_rules(binding.role_ref, attrs.namespace)
+                if self._rules_allow(rules, attrs):
+                    return True
+        return False
+
+    def _list(self, plural: str, namespace: str) -> list:
+        try:
+            items, _rev = self.registry.list(plural, namespace)
+            return items
+        except errors.StatusError:
+            return []
+
+    def _bound(self, binding, attrs: Attributes) -> bool:
+        return any(rbac.subject_matches(s, attrs.user, attrs.groups)
+                   for s in binding.subjects)
+
+    def _role_rules(self, ref: rbac.RoleRef, namespace: str) -> list:
+        try:
+            if ref.kind == "ClusterRole":
+                role = self.registry.get("clusterroles", "", ref.name)
+            else:
+                role = self.registry.get("roles", namespace, ref.name)
+        except errors.StatusError:
+            return []
+        return role.rules
+
+    @staticmethod
+    def _rules_allow(rules: list, attrs: Attributes) -> bool:
+        return any(rule.matches(attrs.verb, attrs.resource, attrs.name)
+                   for rule in rules)
+
+
+def verb_for_request(method: str, has_name: bool, is_watch: bool) -> str:
+    """HTTP -> RBAC verb (reference: ``RequestInfoFactory``)."""
+    if is_watch:
+        return "watch"
+    if method == "GET":
+        return "get" if has_name else "list"
+    return {"POST": "create", "PUT": "update", "PATCH": "patch",
+            "DELETE": "delete" if has_name else "deletecollection"}.get(
+                method, method.lower())
+
+
+def make_authorizer(mode: str, registry: Registry) -> Optional[Authorizer]:
+    if mode == "RBAC":
+        return RBACAuthorizer(registry)
+    if mode in ("", "AlwaysAllow"):
+        return AlwaysAllow()
+    raise ValueError(f"unknown authorization mode {mode!r}")

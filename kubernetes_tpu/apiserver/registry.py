@@ -20,7 +20,7 @@ import datetime
 from dataclasses import dataclass, field as dc_field
 from typing import Any, Callable, Optional
 
-from ..api import errors, types as t, validation as val, workloads as w
+from ..api import errors, rbac as r, types as t, validation as val, workloads as w
 from ..api.meta import ObjectMeta, TypedObject, now, stamp_new
 from ..api.scheme import DEFAULT_SCHEME, Scheme, from_dict, to_dict
 from ..api.selectors import match_field_selector, parse_selector
@@ -125,6 +125,13 @@ def builtin_resources() -> list[ResourceSpec]:
                      "autoscaling/v1", w.HorizontalPodAutoscaler),
         ResourceSpec("poddisruptionbudgets", "PodDisruptionBudget", "policy/v1",
                      w.PodDisruptionBudget),
+        ResourceSpec("roles", "Role", r.RBAC_V1, r.Role, has_status=False),
+        ResourceSpec("clusterroles", "ClusterRole", r.RBAC_V1, r.ClusterRole,
+                     namespaced=False, has_status=False),
+        ResourceSpec("rolebindings", "RoleBinding", r.RBAC_V1, r.RoleBinding,
+                     has_status=False),
+        ResourceSpec("clusterrolebindings", "ClusterRoleBinding", r.RBAC_V1,
+                     r.ClusterRoleBinding, namespaced=False, has_status=False),
     ]
 
 
@@ -290,6 +297,11 @@ class Registry:
                 rollback.append((self._svc_ips.release, obj.spec.cluster_ip))
             elif obj.spec.cluster_ip != "None":
                 self._ensure_svc_allocator()
+                if not self._svc_ips.contains(obj.spec.cluster_ip):
+                    raise errors.InvalidError(
+                        f"Service {obj.metadata.name!r}: spec.cluster_ip "
+                        f"{obj.spec.cluster_ip} is outside the service "
+                        f"CIDR {self.service_cidr}")
                 if self._svc_ips.is_used(obj.spec.cluster_ip):
                     raise errors.InvalidError(
                         f"Service {obj.metadata.name!r}: spec.cluster_ip "
@@ -302,6 +314,12 @@ class Registry:
                 rollback.append((self._node_cidrs.release, obj.spec.pod_cidr))
             else:
                 self._ensure_node_allocator()
+                if not self._node_cidrs.contains(obj.spec.pod_cidr):
+                    raise errors.InvalidError(
+                        f"Node {obj.metadata.name!r}: spec.pod_cidr "
+                        f"{obj.spec.pod_cidr} is not a /"
+                        f"{self._node_cidrs.node_prefix_len} block of the "
+                        f"cluster CIDR {self.cluster_cidr}")
                 if self._node_cidrs.is_used(obj.spec.pod_cidr):
                     raise errors.InvalidError(
                         f"Node {obj.metadata.name!r}: spec.pod_cidr "

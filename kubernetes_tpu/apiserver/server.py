@@ -32,6 +32,8 @@ from ..api import errors
 from ..api.scheme import to_dict
 from ..metrics.registry import REGISTRY as METRICS, Histogram
 from .admission import default_chain
+from .audit import LEVEL_REQUEST, AuditLogger
+from .authz import Attributes, Authorizer, verb_for_request
 from .registry import Registry
 
 log = logging.getLogger("apiserver")
@@ -46,13 +48,23 @@ REQUEST_LATENCY = Histogram(
 
 class APIServer:
     def __init__(self, registry: Optional[Registry] = None,
-                 tokens: Optional[dict[str, str]] = None):
+                 tokens: Optional[dict[str, str]] = None,
+                 authorizer: Optional[Authorizer] = None,
+                 user_groups: Optional[dict[str, set]] = None,
+                 audit: Optional[AuditLogger] = None):
         """``tokens``: bearer token -> username; None disables authn
-        (local/dev mode, like the reference's insecure port)."""
+        (local/dev mode, like the reference's insecure port).
+        ``authorizer``: None = AlwaysAllow; pass
+        ``authz.RBACAuthorizer(registry)`` for RBAC mode.
+        ``user_groups``: username -> extra groups (e.g. system:masters).
+        ``audit``: optional AuditLogger recording every request."""
         self.registry = registry or Registry()
         if self.registry.admission is None:
             self.registry.admission = default_chain(self.registry)
         self.tokens = tokens
+        self.authorizer = authorizer
+        self.user_groups = user_groups or {}
+        self.audit = audit
         self.app = web.Application(middlewares=[self._middleware])
         self._routes()
         self._runner: Optional[web.AppRunner] = None
@@ -62,8 +74,8 @@ class APIServer:
 
     @web.middleware
     async def _middleware(self, request: web.Request, handler):
-        # authn -> authz -> handler -> error mapping (reference:
-        # DefaultBuildHandlerChain, compressed).
+        # authn -> authz -> handler -> audit -> error mapping
+        # (reference: DefaultBuildHandlerChain, compressed).
         if self.tokens is not None and not request.path.startswith(("/healthz", "/readyz", "/version")):
             auth = request.headers.get("Authorization", "")
             token = auth[7:] if auth.startswith("Bearer ") else ""
@@ -71,22 +83,65 @@ class APIServer:
             if user is None:
                 return self._err(errors.UnauthorizedError("invalid or missing bearer token"))
             request["user"] = user
+        attrs = self._attributes(request)
         import time
         start = time.perf_counter()
+        code = 500
         try:
+            if attrs is not None and self.authorizer is not None \
+                    and not self.authorizer.authorize(attrs):
+                resp = self._err(errors.ForbiddenError(f"forbidden: {attrs}"))
+                code = resp.status
+                return resp
             resp = await handler(request)
+            code = resp.status
             return resp
         except errors.StatusError as e:
+            code = e.code
             return self._err(e)
-        except web.HTTPException:
+        except web.HTTPException as e:
+            code = e.status
             raise
         except Exception as e:  # noqa: BLE001
             log.exception("handler panic on %s %s", request.method, request.path)
             return self._err(errors.StatusError(f"internal error: {e}"))
         finally:
+            elapsed = time.perf_counter() - start
             plural = request.match_info.get("plural", "-")
-            REQUEST_LATENCY.observe(time.perf_counter() - start,
-                                    verb=request.method, resource=plural)
+            REQUEST_LATENCY.observe(elapsed, verb=request.method,
+                                    resource=plural)
+            if self.audit is not None and attrs is not None:
+                await self._audit(request, attrs, code, elapsed)
+
+    def _attributes(self, request: web.Request) -> Optional[Attributes]:
+        """Authorization attributes for resource requests; None for
+        non-resource paths (/healthz, /metrics, ... need authn only)."""
+        plural = request.match_info.get("plural")
+        if not plural:
+            return None
+        name = request.match_info.get("name", "")
+        sub = request.match_info.get("subresource", "")
+        verb = verb_for_request(request.method, bool(name),
+                                request.query.get("watch") in ("1", "true"))
+        user = request.get("user", "system:anonymous")
+        groups = set(self.user_groups.get(user, ()))
+        resource = f"{plural}/{sub}" if sub else plural
+        return Attributes(user, groups, verb, resource,
+                          request.match_info.get("namespace", ""), name)
+
+    async def _audit(self, request: web.Request, attrs: Attributes,
+                     code: int, elapsed: float) -> None:
+        body = None
+        if self.audit.level == LEVEL_REQUEST and request.method in (
+                "POST", "PUT", "PATCH"):
+            try:
+                body = json.loads(await request.read())
+            except (json.JSONDecodeError, UnicodeDecodeError):
+                body = {"_unparseable": True}
+        self.audit.record(
+            user=attrs.user, verb=attrs.verb, resource=attrs.resource,
+            namespace=attrs.namespace, name=attrs.name, code=code,
+            latency_seconds=elapsed, body=body)
 
     @staticmethod
     def _err(e: errors.StatusError) -> web.Response:

@@ -77,8 +77,22 @@ def load_server(args) -> str:
                      "or pass --server URL")
 
 
+def load_token(args) -> str:
+    if os.environ.get("KTL_TOKEN"):
+        return os.environ["KTL_TOKEN"]
+    # Only trust the recorded token for the recorded server.
+    try:
+        with open(DEFAULT_CONFIG) as f:
+            cfg = json.load(f)
+        if cfg.get("token") and cfg.get("server") == load_server(args):
+            return cfg["token"]
+    except (OSError, json.JSONDecodeError, SystemExit):
+        pass
+    return ""
+
+
 def make_client(args) -> RESTClient:
-    return RESTClient(load_server(args), token=os.environ.get("KTL_TOKEN", ""))
+    return RESTClient(load_server(args), token=load_token(args))
 
 
 # -- manifest loading (resource/builder.go analog) -------------------------
@@ -386,20 +400,41 @@ async def cmd_up(args) -> int:
     """Start a single-process cluster and block until SIGINT/SIGTERM
     (the local-up-cluster.sh analog)."""
     from ..cluster.local import LocalCluster, NodeSpec
+    from ..util.features import GATES
 
+    if getattr(args, "feature_gates", ""):
+        GATES.parse(args.feature_gates)
     specs = []
     for i in range(args.nodes):
         specs.append(NodeSpec(
             name=f"node-{i}",
             tpu_chips=args.tpu_chips if not args.real_tpu else 0,
             real_tpu=args.real_tpu and i == 0))
+    authz_mode = getattr(args, "authorization_mode", "AlwaysAllow")
+    tokens = user_groups = None
+    admin_token = ""
+    if authz_mode == "RBAC":
+        # Bootstrap credential (reference: kubeadm's admin.conf): an
+        # admin token in system:masters, used by the node agents and
+        # recorded for the CLI — without it RBAC mode is a
+        # chicken-and-egg brick (nobody could create the first binding).
+        import secrets
+        from ..api.rbac import GROUP_MASTERS
+        admin_token = secrets.token_urlsafe(24)
+        tokens = {admin_token: "admin"}
+        user_groups = {"admin": {GROUP_MASTERS}}
     cluster = LocalCluster(data_dir=args.data_dir or None, nodes=specs,
                            host=args.host, port=args.port,
-                           durable=args.durable)
+                           durable=args.durable,
+                           tokens=tokens, user_groups=user_groups,
+                           authorization_mode=authz_mode,
+                           audit_log=getattr(args, "audit_log", ""))
     base = await cluster.start()
     os.makedirs(os.path.dirname(DEFAULT_CONFIG), exist_ok=True)
     with open(DEFAULT_CONFIG, "w") as f:
-        json.dump({"server": base}, f)
+        json.dump({"server": base, "token": admin_token}, f)
+    if admin_token:
+        os.chmod(DEFAULT_CONFIG, 0o600)
     tpu_note = (" (node-0 probing real TPU)" if args.real_tpu else
                 f" ({args.tpu_chips} stub chips/node)" if args.tpu_chips else "")
     print(f"cluster up at {base} — {args.nodes} node(s){tpu_note}")
@@ -495,6 +530,12 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--data-dir", default="")
     sp.add_argument("--durable", action="store_true",
                     help="persist state (WAL+snapshot) under --data-dir")
+    sp.add_argument("--feature-gates", default="",
+                    help="comma-separated Gate=true|false overrides")
+    sp.add_argument("--authorization-mode", default="AlwaysAllow",
+                    choices=["AlwaysAllow", "RBAC"])
+    sp.add_argument("--audit-log", default="",
+                    help="write request audit JSONL to this path")
 
     return p
 

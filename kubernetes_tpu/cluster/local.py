@@ -89,7 +89,10 @@ class LocalCluster:
                  tokens: Optional[dict[str, str]] = None,
                  durable: bool = False,
                  status_interval: float = 10.0,
-                 heartbeat_interval: float = 5.0):
+                 heartbeat_interval: float = 5.0,
+                 authorization_mode: str = "AlwaysAllow",
+                 user_groups: Optional[dict] = None,
+                 audit_log: str = ""):
         self.data_dir = data_dir or tempfile.mkdtemp(prefix="ktpu-cluster-")
         self.node_specs = nodes if nodes is not None else [NodeSpec(name="node-0")]
         self.host = host
@@ -98,6 +101,9 @@ class LocalCluster:
         self.durable = durable
         self.status_interval = status_interval
         self.heartbeat_interval = heartbeat_interval
+        self.authorization_mode = authorization_mode
+        self.user_groups = user_groups
+        self.audit_log = audit_log
 
         self.registry: Optional[Registry] = None
         self.server: Optional[APIServer] = None
@@ -120,7 +126,16 @@ class LocalCluster:
             except errors.AlreadyExistsError:
                 pass  # durable restart
 
-        self.server = APIServer(self.registry, tokens=self.tokens)
+        from ..apiserver.audit import AuditLogger
+        from ..apiserver.authz import make_authorizer
+        from ..util.features import GATES
+        audit = self._audit = (
+            AuditLogger(path=self.audit_log)
+            if self.audit_log and GATES.enabled("AuditLogging") else None)
+        self.server = APIServer(
+            self.registry, tokens=self.tokens,
+            authorizer=make_authorizer(self.authorization_mode, self.registry),
+            user_groups=self.user_groups, audit=audit)
         port = await self.server.start(self.host, self._port)
         self.base_url = f"http://{self.host}:{port}"
 
@@ -161,11 +176,13 @@ class LocalCluster:
                    else ProcessRuntime(node_dir))
         # Per-node service proxy (kube-proxy analog) on the dataplane
         # nodes; fake-runtime (hollow) nodes skip it — no real sockets.
+        from ..util.features import GATES
         proxy: Optional[ServiceProxy] = None
         eviction: Optional[EvictionManager] = None
-        if not spec.fake_runtime:
+        if not spec.fake_runtime and GATES.enabled("ServiceProxy"):
             proxy = ServiceProxy(client)
             await proxy.start()
+        if not spec.fake_runtime and GATES.enabled("NodePressureEviction"):
             # Conservative thresholds: dev boxes legitimately run with
             # fuller disks than production nodes.
             eviction = EvictionManager(Thresholds(
@@ -200,6 +217,8 @@ class LocalCluster:
             await self.scheduler.stop()
         if self.server:
             await self.server.stop()
+        if getattr(self, "_audit", None):
+            self._audit.close()
         if self.registry and self.durable:
             self.registry.store.snapshot()
 

@@ -67,6 +67,14 @@ class CIDRAllocator:
         if 0 <= idx < self._count:
             self._used.add(idx)
 
+    def contains(self, cidr: str) -> bool:
+        """Whether ``cidr`` is one of this allocator's node blocks."""
+        net, plen = parse_cidr(cidr)
+        if plen != self.node_prefix_len:
+            return False
+        idx = (net - self._net) // self._block
+        return 0 <= idx < self._count and net == self._net + idx * self._block
+
     def is_used(self, cidr: str) -> bool:
         net, _ = parse_cidr(cidr)
         return (net - self._net) // self._block in self._used
@@ -153,6 +161,9 @@ class ServiceIPAllocator:
         off = ip_to_int(ip) - self._base
         if 0 <= off < self._size:
             self._used.add(off)
+
+    def contains(self, ip: str) -> bool:
+        return 0 <= ip_to_int(ip) - self._base < self._size
 
     def is_used(self, ip: str) -> bool:
         return (ip_to_int(ip) - self._base) in self._used

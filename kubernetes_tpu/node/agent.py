@@ -379,7 +379,9 @@ class NodeAgent:
         if len(active) + 1 > int(self.capacity.get(t.RESOURCE_PODS, 110)):
             # Critical-pod preemption (preemption.go): evict the
             # lowest-priority pod to admit a critical one.
-            victims = pick_preemption_victims(active, pod)
+            from ..util.features import GATES
+            victims = (pick_preemption_victims(active, pod)
+                       if GATES.enabled("PodPriority") else None)
             if victims:
                 for victim in victims:
                     await self.evict_pod(

@@ -114,14 +114,27 @@ class ProcessRuntime(ContainerRuntime):
         # bind-mount instead (reference: dockershim container config).
         sandbox = os.path.join(self.root_dir, "sandboxes", cid)
         os.makedirs(sandbox, exist_ok=True)
+        mount_paths = sorted(c.rstrip("/") for _, c, _ in config.mounts)
+        for a, b in zip(mount_paths, mount_paths[1:]):
+            if b == a or b.startswith(a + "/"):
+                raise RuntimeError(
+                    f"container {config.name}: mount paths {a!r} and "
+                    f"{b!r} nest; nested mounts are not supported by "
+                    f"the process runtime")
         for host, cpath, _ro in config.mounts:
             link = os.path.join(sandbox, cpath.lstrip("/"))
             os.makedirs(os.path.dirname(link), exist_ok=True)
-            if os.path.islink(link) or os.path.exists(link):
-                try:
-                    os.unlink(link)
-                except OSError:
-                    continue
+            if os.path.islink(link):
+                os.unlink(link)
+            elif os.path.exists(link):
+                # Nested/duplicate mount paths cannot be projected with
+                # symlinks — fail the start loudly (the agent surfaces
+                # FailedStart + retries) instead of silently running the
+                # container without its volume.
+                raise RuntimeError(
+                    f"container {config.name}: mount path {cpath!r} "
+                    f"conflicts with another mount (nested mounts are "
+                    f"not supported by the process runtime)")
             os.symlink(host, link)
         env["KTPU_SANDBOX"] = sandbox
         env["PYTHONPATH"] = (f"{self._host_cwd}:{env['PYTHONPATH']}"

@@ -259,7 +259,8 @@ class Scheduler:
                 await self.client.update_status(current)
         except errors.StatusError:
             pass
-        if t.pod_priority(pod) > 0:
+        from ..util.features import GATES
+        if t.pod_priority(pod) > 0 and GATES.enabled("PodPriority"):
             victims = await self._preempt(pod)
             if victims:
                 await self.queue.requeue(pod, 0.1)

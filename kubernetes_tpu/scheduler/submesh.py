@@ -101,9 +101,11 @@ def find_box(free: set[Coord], mesh: Sequence[int], shape: Sequence[int],
         return None
 
     if rank <= 3:
-        result = _find_box_native(free, mesh, shape_n, torus)
-        if result is not NotImplemented:
-            return result
+        from ..util.features import GATES
+        if GATES.enabled("NativeSubmeshFastPath"):
+            result = _find_box_native(free, mesh, shape_n, torus)
+            if result is not NotImplemented:
+                return result
     return _find_box_numpy(free, mesh, shape_n, torus)
 
 

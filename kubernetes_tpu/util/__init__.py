@@ -1,0 +1,1 @@
+"""Shared utilities (reference: apimachinery pkg/util + pkg/features)."""

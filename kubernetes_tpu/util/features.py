@@ -1,0 +1,95 @@
+"""Feature gates — runtime on/off switches for graduated features.
+
+Reference: ``pkg/features/kube_features.go`` + the map-typed
+``--feature-gates`` flag (``staging/.../util/feature/feature_gate.go``).
+The fork's signature move was flipping ``DevicePlugins`` to Beta/true
+(``kube_features.go:252``); the TPU build's device path is GA from
+birth, so the gated surface here is the newer operational machinery.
+
+Usage::
+
+    from kubernetes_tpu.util.features import GATES
+    if GATES.enabled("NodePressureEviction"): ...
+
+Components read the process-global ``GATES``; tests may build a private
+``FeatureGates(overrides=...)`` and inject it.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+ALPHA = "Alpha"
+BETA = "Beta"
+GA = "GA"
+
+
+@dataclass(frozen=True)
+class Feature:
+    name: str
+    default: bool
+    stage: str
+    description: str = ""
+
+
+#: The gate table (reference: kube_features.go's known-features map).
+KNOWN_FEATURES = {f.name: f for f in [
+    Feature("TpuDevicePlugins", True, GA,
+            "device-plugin seam for TPU chips (fork: DevicePlugins beta)"),
+    Feature("GangScheduling", True, GA,
+            "all-or-nothing PodGroup placement"),
+    Feature("SubMeshAllocation", True, GA,
+            "contiguous ICI sub-mesh allocation for slice_shape claims"),
+    Feature("PodPriority", True, BETA,
+            "priority-based scheduler preemption + kubelet critical-pod "
+            "admission preemption (reference: PodPriority beta)"),
+    Feature("NodePressureEviction", True, BETA,
+            "memory/disk-pressure pod eviction on the node agent"),
+    Feature("ServiceProxy", True, BETA,
+            "per-node userspace VIP forwarder + service env injection"),
+    Feature("NativeSubmeshFastPath", True, BETA,
+            "C++ sub-mesh search fast path (falls back to numpy)"),
+    Feature("AuditLogging", True, BETA,
+            "structured request audit capability; actual logging still "
+            "requires an --audit-log path"),
+]}
+
+
+class FeatureGates:
+    def __init__(self, overrides: dict | None = None):
+        self._enabled = {name: f.default for name, f in KNOWN_FEATURES.items()}
+        for name, value in (overrides or {}).items():
+            self.set(name, value)
+
+    def enabled(self, name: str) -> bool:
+        try:
+            return self._enabled[name]
+        except KeyError:
+            raise ValueError(f"unknown feature gate {name!r} (known: "
+                             f"{', '.join(sorted(KNOWN_FEATURES))})") from None
+
+    def set(self, name: str, value: bool) -> None:
+        if name not in KNOWN_FEATURES:
+            raise ValueError(f"unknown feature gate {name!r} (known: "
+                             f"{', '.join(sorted(KNOWN_FEATURES))})")
+        if KNOWN_FEATURES[name].stage == GA and not value:
+            raise ValueError(f"feature gate {name!r} is GA and cannot be "
+                             f"disabled")
+        self._enabled[name] = value
+
+    def parse(self, spec: str) -> "FeatureGates":
+        """Apply ``"Gate=true,Other=false"`` (the --feature-gates flag
+        format). Returns self for chaining."""
+        for part in filter(None, (p.strip() for p in spec.split(","))):
+            name, eq, raw = part.partition("=")
+            if not eq or raw.lower() not in ("true", "false"):
+                raise ValueError(
+                    f"feature gate must be <name>=true|false, got {part!r}")
+            self.set(name.strip(), raw.lower() == "true")
+        return self
+
+    def as_dict(self) -> dict[str, bool]:
+        return dict(self._enabled)
+
+
+#: Process-global gates (reference: utilfeature.DefaultFeatureGate).
+GATES = FeatureGates()

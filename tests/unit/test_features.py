@@ -1,0 +1,63 @@
+"""Feature gate tests (reference: feature_gate_test.go)."""
+import pytest
+
+from kubernetes_tpu.util.features import (BETA, GA, KNOWN_FEATURES,
+                                          FeatureGates)
+
+
+def test_defaults():
+    g = FeatureGates()
+    assert g.enabled("GangScheduling")
+    assert g.enabled("PodPriority")
+    assert g.enabled("AuditLogging")
+
+
+def test_parse_and_overrides():
+    g = FeatureGates().parse("PodPriority=false, AuditLogging=false")
+    assert not g.enabled("PodPriority")
+    assert not g.enabled("AuditLogging")
+    assert FeatureGates({"NodePressureEviction": False}) \
+        .enabled("NodePressureEviction") is False
+
+
+def test_unknown_and_ga_guard():
+    g = FeatureGates()
+    with pytest.raises(ValueError):
+        g.enabled("NoSuchGate")
+    with pytest.raises(ValueError):
+        g.parse("NoSuchGate=true")
+    with pytest.raises(ValueError):
+        g.parse("PodPriority=maybe")
+    with pytest.raises(ValueError):
+        g.set("GangScheduling", False)      # GA cannot be disabled
+    assert KNOWN_FEATURES["GangScheduling"].stage == GA
+    assert KNOWN_FEATURES["PodPriority"].stage == BETA
+
+
+def test_gated_preemption_disabled(monkeypatch):
+    """PodPriority=false switches off kubelet critical preemption."""
+    from kubernetes_tpu.util import features
+    from kubernetes_tpu.node.eviction import CRITICAL_PRIORITY
+
+    g = FeatureGates({"PodPriority": False})
+    monkeypatch.setattr(features, "GATES", g)
+    # agent._admit reads features.GATES at call time via late import.
+    import asyncio
+    from kubernetes_tpu.api import types as t
+    from kubernetes_tpu.api.meta import ObjectMeta
+    from kubernetes_tpu.node.agent import NodeAgent
+    from kubernetes_tpu.node.runtime import FakeRuntime
+    from tests.controllers.util import make_plane
+
+    async def run():
+        reg, client, _ = make_plane()
+        agent = NodeAgent(client, "n0", FakeRuntime(), max_pods=0,
+                          server_port=None)
+        crit = t.Pod(metadata=ObjectMeta(name="c", namespace="default",
+                                         uid="u1"),
+                     spec=t.PodSpec(containers=[t.Container(name="c")]))
+        crit.spec.priority = CRITICAL_PRIORITY
+        reason, retriable = await agent._admit(crit)
+        assert reason == "node is at max pods" and not retriable
+
+    asyncio.run(run())
