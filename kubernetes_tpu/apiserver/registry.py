@@ -64,6 +64,15 @@ def _node_fields(node: t.Node) -> dict:
             "spec.unschedulable": str(node.spec.unschedulable).lower()}
 
 
+def _parses(check, value: str) -> bool:
+    """Run an allocator range check on user input; malformed addresses
+    are simply out of range (InvalidError), never a 500."""
+    try:
+        return check(value)
+    except (ValueError, IndexError):
+        return False
+
+
 def _merge_secret_string_data(sec: t.Secret) -> None:
     """Secret strategy: fold the plaintext ``string_data`` convenience
     field into base64 ``data`` (reference: pkg/registry/core/secret
@@ -297,7 +306,7 @@ class Registry:
                 rollback.append((self._svc_ips.release, obj.spec.cluster_ip))
             elif obj.spec.cluster_ip != "None":
                 self._ensure_svc_allocator()
-                if not self._svc_ips.contains(obj.spec.cluster_ip):
+                if not _parses(self._svc_ips.contains, obj.spec.cluster_ip):
                     raise errors.InvalidError(
                         f"Service {obj.metadata.name!r}: spec.cluster_ip "
                         f"{obj.spec.cluster_ip} is outside the service "
@@ -314,7 +323,7 @@ class Registry:
                 rollback.append((self._node_cidrs.release, obj.spec.pod_cidr))
             else:
                 self._ensure_node_allocator()
-                if not self._node_cidrs.contains(obj.spec.pod_cidr):
+                if not _parses(self._node_cidrs.contains, obj.spec.pod_cidr):
                     raise errors.InvalidError(
                         f"Node {obj.metadata.name!r}: spec.pod_cidr "
                         f"{obj.spec.pod_cidr} is not a /"

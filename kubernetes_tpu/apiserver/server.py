@@ -136,8 +136,9 @@ class APIServer:
                 "POST", "PUT", "PATCH"):
             try:
                 body = json.loads(await request.read())
-            except (json.JSONDecodeError, UnicodeDecodeError):
-                body = {"_unparseable": True}
+            except Exception:  # noqa: BLE001 — audit must never alter
+                body = {"_unreadable": True}  # the response (disconnects,
+                # payload errors, bad JSON all land here)
         self.audit.record(
             user=attrs.user, verb=attrs.verb, resource=attrs.resource,
             namespace=attrs.namespace, name=attrs.name, code=code,

@@ -431,10 +431,11 @@ async def cmd_up(args) -> int:
                            audit_log=getattr(args, "audit_log", ""))
     base = await cluster.start()
     os.makedirs(os.path.dirname(DEFAULT_CONFIG), exist_ok=True)
-    with open(DEFAULT_CONFIG, "w") as f:
+    # 0600 from birth — the admin token must never be world-readable,
+    # even for a moment.
+    fd = os.open(DEFAULT_CONFIG, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+    with os.fdopen(fd, "w") as f:
         json.dump({"server": base, "token": admin_token}, f)
-    if admin_token:
-        os.chmod(DEFAULT_CONFIG, 0o600)
     tpu_note = (" (node-0 probing real TPU)" if args.real_tpu else
                 f" ({args.tpu_chips} stub chips/node)" if args.tpu_chips else "")
     print(f"cluster up at {base} — {args.nodes} node(s){tpu_note}")

@@ -114,13 +114,17 @@ class ProcessRuntime(ContainerRuntime):
         # bind-mount instead (reference: dockershim container config).
         sandbox = os.path.join(self.root_dir, "sandboxes", cid)
         os.makedirs(sandbox, exist_ok=True)
-        mount_paths = sorted(c.rstrip("/") for _, c, _ in config.mounts)
-        for a, b in zip(mount_paths, mount_paths[1:]):
-            if b == a or b.startswith(a + "/"):
-                raise RuntimeError(
-                    f"container {config.name}: mount paths {a!r} and "
-                    f"{b!r} nest; nested mounts are not supported by "
-                    f"the process runtime")
+        mount_paths = [c.rstrip("/") for _, c, _ in config.mounts]
+        for i, a in enumerate(mount_paths):
+            for b in mount_paths[i + 1:]:
+                # All pairs, not just sort-adjacent ones: '/data' and
+                # '/data/sub' must be caught even with '/data-x' between
+                # them lexicographically.
+                if a == b or b.startswith(a + "/") or a.startswith(b + "/"):
+                    raise RuntimeError(
+                        f"container {config.name}: mount paths {a!r} and "
+                        f"{b!r} nest; nested mounts are not supported by "
+                        f"the process runtime")
         for host, cpath, _ro in config.mounts:
             link = os.path.join(sandbox, cpath.lstrip("/"))
             os.makedirs(os.path.dirname(link), exist_ok=True)

@@ -26,7 +26,7 @@ from .cache import SchedulerCache, SliceInfo
 from .predicates import (_chip_matches, node_is_schedulable,
                          pod_fits_resources, pod_matches_node_selector,
                          pod_tolerates_taints)
-from .submesh import allocate_compact, find_box
+from .submesh import allocate_compact, find_box, find_box_containing
 
 
 @dataclass
@@ -58,7 +58,13 @@ def _non_tpu_predicates(pod: t.Pod, info) -> Optional[str]:
 
 
 def plan_gang(group: t.PodGroup, pods: list[t.Pod],
-              cache: SchedulerCache) -> GangPlan | GangFailure:
+              cache: SchedulerCache,
+              must_include: Optional[dict] = None) -> GangPlan | GangFailure:
+    """``must_include``: coords -> (node, chip_id) already held by bound
+    gang members (partial-bind recovery). A shaped gang must then find a
+    full-shape box *containing* those coords, so the recovered gang is
+    still one contiguous sub-mesh; only the unbound ``pods`` are
+    planned."""
     reasons: list[str] = []
     tpu_pods = [p for p in pods if _pod_chip_demand(p) > 0]
     aux_pods = [p for p in pods if _pod_chip_demand(p) == 0]
@@ -77,12 +83,16 @@ def plan_gang(group: t.PodGroup, pods: list[t.Pod],
     # Deterministic order: smallest adequate slice first (best fit).
     candidate_slices.sort(key=lambda s: (len(s.chips), s.slice_id))
     for sl in candidate_slices:
+        if must_include and not all(sl.chips.get(c) == nc
+                                    for c, nc in must_include.items()):
+            continue  # survivors' chips live elsewhere
         free = sl.free(cache)  # coords -> (node, chip_id)
         if len(free) < total_chips:
             reasons.append(f"slice {sl.slice_id}: {len(free)} free chips, "
                            f"gang needs {total_chips}")
             continue
-        result = _plan_on_slice(group, tpu_pods, aux_pods, sl, free, cache)
+        result = _plan_on_slice(group, tpu_pods, aux_pods, sl, free, cache,
+                                must_include or {})
         if isinstance(result, GangPlan):
             result.slice_id = sl.slice_id
             return result
@@ -91,8 +101,10 @@ def plan_gang(group: t.PodGroup, pods: list[t.Pod],
 
 
 def _plan_on_slice(group: t.PodGroup, tpu_pods: list[t.Pod], aux_pods: list[t.Pod],
-                   sl: SliceInfo, free: dict, cache: SchedulerCache
+                   sl: SliceInfo, free: dict, cache: SchedulerCache,
+                   must_include: Optional[dict] = None
                    ) -> GangPlan | GangFailure:
+    must_include = must_include or {}
     total_chips = sum(_pod_chip_demand(p) for p in tpu_pods)
     # Claim affinity: when every claim in the gang wants the same thing
     # (the overwhelmingly common case — uniform workers), pre-filter the
@@ -108,11 +120,20 @@ def _plan_on_slice(group: t.PodGroup, tpu_pods: list[t.Pod], aux_pods: list[t.Po
                 f"only {len(free)} free chips match claim affinity, "
                 f"gang needs {total_chips}"])
     if group.spec.slice_shape:
-        cells = find_box(set(free), sl.mesh_shape, group.spec.slice_shape)
-        if cells is None:
-            return GangFailure([
-                f"no contiguous {'x'.join(map(str, group.spec.slice_shape))} box free"])
-        vol = len(cells)
+        shape_txt = "x".join(map(str, group.spec.slice_shape))
+        if must_include:
+            cells = find_box_containing(set(free), sl.mesh_shape,
+                                        group.spec.slice_shape,
+                                        set(must_include))
+            if cells is None:
+                return GangFailure([
+                    f"no contiguous {shape_txt} box containing the "
+                    f"{len(must_include)} chips bound members hold"])
+        else:
+            cells = find_box(set(free), sl.mesh_shape, group.spec.slice_shape)
+            if cells is None:
+                return GangFailure([f"no contiguous {shape_txt} box free"])
+        vol = len(cells) - len(must_include)
         if vol < total_chips:
             return GangFailure([f"box volume {vol} < gang demand {total_chips}"])
     else:
@@ -120,9 +141,12 @@ def _plan_on_slice(group: t.PodGroup, tpu_pods: list[t.Pod], aux_pods: list[t.Po
         if cells is None:
             return GangFailure(["compact allocation failed"])
 
-    # Split cells by host.
+    # Split cells by host (bound survivors' cells are excluded — their
+    # pods already hold those chips).
     per_node: dict[str, list[tuple, str]] = {}
     for cell in cells:
+        if cell in must_include:
+            continue
         node_name, chip_id = free[cell]
         per_node.setdefault(node_name, []).append((cell, chip_id))
 

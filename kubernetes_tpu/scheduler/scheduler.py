@@ -332,6 +332,38 @@ class Scheduler:
 
     # -- gangs ------------------------------------------------------------
 
+    def _bound_gang_cells(self, bound_pods: list[t.Pod]) -> Optional[dict]:
+        """Mesh coords held by bound gang members: coords -> (node,
+        chip_id). None when any assignment cannot be resolved against
+        the cache's slice geometry (node/slice gone)."""
+        held: dict = {}
+        by_node_chip = {}
+        for sl in self.cache.slices.values():
+            for coord, (node_name, chip_id) in sl.chips.items():
+                by_node_chip[(node_name, chip_id)] = coord
+        for pod in bound_pods:
+            for claim in pod.spec.tpu_resources:
+                for chip_id in claim.assigned:
+                    coord = by_node_chip.get((pod.spec.node_name, chip_id))
+                    if coord is None:
+                        return None
+                    held[coord] = (pod.spec.node_name, chip_id)
+        return held
+
+    async def _evict_gang_survivors(self, group, bound_pods: list[t.Pod],
+                                    why: str) -> None:
+        """Delete bound members of a partially-bound gang so their
+        controller recreates them and the gang re-plans whole."""
+        for pod in bound_pods:
+            self.recorder.event(
+                group, "Warning", "GangRecoveryEvict",
+                f"evicting bound member {pod.key()}: {why}")
+            try:
+                await self.client.delete("pods", pod.metadata.namespace,
+                                         pod.metadata.name)
+            except errors.StatusError:
+                pass
+
     async def _schedule_gang(self, unit: GangUnit) -> None:
         start = time.perf_counter()
         ns, name = unit.group_key.split("/", 1)
@@ -339,35 +371,50 @@ class Scheduler:
             group = await self.client.get("podgroups", ns, name)
         except errors.NotFoundError:
             return
-        # Refresh members from the API (queue copies may be stale).
+        # Refresh FULL membership from the API: the queued unit only
+        # carries unbound members, but recovery must see the bound ones
+        # (their chips anchor the contiguity constraint).
         pods = []
-        bound = 0
-        for p in unit.pods:
-            try:
-                cur = await self.client.get("pods", p.metadata.namespace,
-                                            p.metadata.name)
-            except errors.NotFoundError:
+        bound_pods = []
+        members, _rev = await self.client.list("pods", ns)
+        for cur in members:
+            if cur.spec.gang != name or not t.is_pod_active(cur):
+                # Terminated members keep node_name + assigned chips in
+                # their corpse; they must not anchor recovery geometry.
                 continue
             if cur.spec.node_name:
-                bound += 1
-            elif t.is_pod_active(cur):
+                bound_pods.append(cur)
+            else:
                 pods.append(cur)
-        bound = max(bound, self.queue.gang_bound_count(unit.group_key))
+        bound = max(len(bound_pods), self.queue.gang_bound_count(unit.group_key))
         if not pods or len(pods) + bound < group.spec.min_member:
             return  # below quorum; queue re-releases when members return
 
         # Plan. A partially-bound gang (recovering from a partial bind
-        # failure) can no longer claim the full box — its bound members
-        # already hold chips — so the remainder is planned count-based.
-        if bound:
-            group = deepcopy(group)
-            group.spec.slice_shape = []
-        plan = plan_gang(group, pods, self.cache)
+        # failure) must STILL land as one contiguous box: the remainder
+        # is planned inside a full-shape box anchored on the chips the
+        # bound members hold. If no such box exists, the bound members
+        # are evicted so the whole gang re-plans from scratch — the
+        # contiguity guarantee is never silently dropped.
+        must_include = None
+        if bound_pods and group.spec.slice_shape:
+            must_include = self._bound_gang_cells(bound_pods)
+            if must_include is None:
+                await self._evict_gang_survivors(group, bound_pods,
+                                                "bound chips unresolvable")
+                await self.queue.requeue(GangUnit(unit.group_key, pods),
+                                        self.backoff_seconds)
+                return
+        plan = plan_gang(group, pods, self.cache, must_include=must_include)
         m.ALGORITHM_LATENCY.observe(time.perf_counter() - start)
         if isinstance(plan, GangFailure):
             brief = "; ".join(plan.reasons[:3])
             self.recorder.event(group, "Warning", "GangUnschedulable", brief)
             await self._set_group_phase(group, t.PODGROUP_PENDING, brief)
+            if must_include is not None:
+                # Recovery could not keep the gang contiguous around the
+                # survivors: evict them so the full shape re-plans.
+                await self._evict_gang_survivors(group, bound_pods, brief)
             # Members stay staged in the queue; the requeue re-releases the
             # gang with current membership after backoff.
             await self.queue.requeue(GangUnit(unit.group_key, pods),

@@ -351,3 +351,56 @@ def shape_for_count(count: int, mesh: Sequence[int]) -> Optional[tuple[int, ...]
         if best is None or surface < best_surface:
             best, best_surface = shape, surface
     return best
+
+
+def find_box_containing(available: set[Coord], mesh: Sequence[int],
+                        shape: Sequence[int], required: Iterable[Coord],
+                        torus: bool = True) -> Optional[list[Coord]]:
+    """Box of ``shape`` (any axis permutation) covering every coord in
+    ``required``, with all cells drawn from ``available``.
+
+    Gang partial-bind recovery uses this: already-bound members hold
+    chips at ``required`` coords, and the recovered gang must still be
+    one contiguous box — so the remainder is planned inside a full-shape
+    box anchored on the survivors. The required coords prune the origin
+    space to a handful of candidates per axis, so a plain scan suffices
+    even at large mesh sizes.
+    """
+    req = {tuple(int(c) for c in r) for r in required}
+    if not req:
+        return find_box(available, mesh, shape, torus)
+    mesh_t = tuple(int(m) for m in mesh)
+    rank = len(mesh_t)
+    shape_n = normalize_shape(shape, rank)
+    if len(shape_n) != rank or any(len(r) != rank for r in req):
+        return None
+    avail = set(available) | req
+
+    for perm in sorted(set(itertools.permutations(shape_n))):
+        dim_opts: list[list[int]] = []
+        for d in range(rank):
+            s, m = perm[d], mesh_t[d]
+            coords_d = {r[d] for r in req}
+            if s > m:
+                break  # infeasible axis assignment
+            if s == m:
+                opts = [0]
+            elif torus:
+                opts = [o for o in range(m)
+                        if all((c - o) % m < s for c in coords_d)]
+            else:
+                lo = max(max(coords_d) - s + 1, 0)
+                hi = min(min(coords_d), m - s)
+                opts = list(range(lo, hi + 1))
+            if not opts:
+                break
+            dim_opts.append(opts)
+        if len(dim_opts) != rank:
+            continue
+        for origin in itertools.product(*dim_opts):
+            cells = [tuple((origin[d] + off[d]) % mesh_t[d]
+                           for d in range(rank))
+                     for off in itertools.product(*(range(s) for s in perm))]
+            if all(c in avail for c in cells):
+                return cells
+    return None
