@@ -1,0 +1,172 @@
+"""CustomResourceDefinitions — user-defined API types.
+
+Reference: ``staging/src/k8s.io/apiextensions-apiserver`` — a CRD
+object registers a new REST resource; custom objects are schemaless
+maps validated against optional OpenAPI-ish props. Redesign: the
+apiserver's routes are already parameterized (/api/{group}/{version}/
+{plural}), so installing a CRD is purely a registry-table operation —
+no route surgery, no separate apiextensions server.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .errors import InvalidError
+from .meta import TypedObject
+from .scheme import DEFAULT_SCHEME, to_dict
+
+EXTENSIONS_V1 = "apiextensions/v1"
+
+SCOPE_NAMESPACED = "Namespaced"
+SCOPE_CLUSTER = "Cluster"
+
+
+@dataclass
+class CRDNames:
+    plural: str = ""
+    singular: str = ""
+    kind: str = ""
+    short_names: list[str] = field(default_factory=list)
+
+
+@dataclass
+class SchemaProps:
+    """Minimal OpenAPI v3 subset (reference: JSONSchemaProps): enough
+    for type checks + required fields, recursively."""
+    type: str = ""  # object | string | integer | number | boolean | array
+    required: list[str] = field(default_factory=list)
+    properties: dict[str, "SchemaProps"] = field(default_factory=dict)
+    items: Optional["SchemaProps"] = None
+
+
+@dataclass
+class CRDSpec:
+    group: str = ""
+    version: str = "v1"
+    scope: str = SCOPE_NAMESPACED
+    names: CRDNames = field(default_factory=CRDNames)
+    #: Validation applied to the custom object's top level (commonly a
+    #: {"type": "object", "properties": {"spec": {...}}} schema).
+    schema: Optional[SchemaProps] = None
+
+
+@dataclass
+class CRDCondition:
+    type: str = ""
+    status: str = ""
+    reason: str = ""
+    message: str = ""
+
+
+@dataclass
+class CRDStatus:
+    conditions: list[CRDCondition] = field(default_factory=list)
+
+
+@dataclass
+class CustomResourceDefinition(TypedObject):
+    spec: CRDSpec = field(default_factory=CRDSpec)
+    status: CRDStatus = field(default_factory=CRDStatus)
+
+    def api_version_str(self) -> str:
+        return f"{self.spec.group}/{self.spec.version}"
+
+
+@dataclass
+class CustomResource(TypedObject):
+    """Generic custom object: free-form spec/status dicts; any other
+    top-level fields ride the scheme's unknown-key (__extra__)
+    preservation. Each installed CRD gets its own subclass so the
+    scheme's class<->gvk mapping stays one-to-one."""
+    spec: dict = field(default_factory=dict)
+    status: dict = field(default_factory=dict)
+
+
+_TYPE_CHECKS = {
+    "string": lambda v: isinstance(v, str),
+    "integer": lambda v: isinstance(v, int) and not isinstance(v, bool),
+    "number": lambda v: isinstance(v, (int, float)) and not isinstance(v, bool),
+    "boolean": lambda v: isinstance(v, bool),
+    "object": lambda v: isinstance(v, dict),
+    "array": lambda v: isinstance(v, list),
+}
+
+
+def validate_against_schema(value, schema: SchemaProps, path: str,
+                            errs: list[str]) -> None:
+    if schema.type and not _TYPE_CHECKS.get(schema.type, lambda v: True)(value):
+        errs.append(f"{path}: expected {schema.type}, "
+                    f"got {type(value).__name__}")
+        return
+    if isinstance(value, dict):
+        for req in schema.required:
+            if req not in value:
+                errs.append(f"{path}.{req}: required")
+        for key, sub in schema.properties.items():
+            if key in value:
+                validate_against_schema(value[key], sub, f"{path}.{key}", errs)
+    if isinstance(value, list) and schema.items is not None:
+        for i, item in enumerate(value):
+            validate_against_schema(item, schema.items, f"{path}[{i}]", errs)
+
+
+def make_cr_validator(crd: CustomResourceDefinition):
+    """Create-validator closure for one CRD's custom objects."""
+    schema = crd.spec.schema
+
+    def validate(obj, is_create: bool = True) -> None:
+        if schema is None:
+            return
+        data = to_dict(obj)
+        data.pop("metadata", None)
+        data.pop("api_version", None)
+        data.pop("kind", None)
+        errs: list[str] = []
+        validate_against_schema(data, schema, crd.spec.names.kind, errs)
+        if errs:
+            raise InvalidError("; ".join(errs))
+
+    return validate
+
+
+def validate_crd(crd: CustomResourceDefinition, is_create: bool = True) -> None:
+    errs = []
+    names = crd.spec.names
+    if not crd.spec.group or "/" in crd.spec.group:
+        errs.append("spec.group: required, no slashes")
+    if not names.plural or not names.plural.islower():
+        errs.append("spec.names.plural: required lowercase")
+    if not names.kind:
+        errs.append("spec.names.kind: required")
+    if crd.spec.scope not in (SCOPE_NAMESPACED, SCOPE_CLUSTER):
+        errs.append(f"spec.scope: must be {SCOPE_NAMESPACED} or {SCOPE_CLUSTER}")
+    if crd.metadata.name != f"{names.plural}.{crd.spec.group}":
+        errs.append(f"metadata.name: must be "
+                    f"'{names.plural}.{crd.spec.group}'")
+    if errs:
+        raise InvalidError("; ".join(errs))
+
+
+def validate_crd_update(new: CustomResourceDefinition,
+                        old: CustomResourceDefinition) -> None:
+    """Identity fields are immutable (reference: CRD strategy): only the
+    schema may change; the registry re-installs the validator."""
+    validate_crd(new, is_create=False)
+    frozen = []
+    if new.spec.group != old.spec.group:
+        frozen.append("spec.group")
+    if new.spec.version != old.spec.version:
+        frozen.append("spec.version")
+    if new.spec.scope != old.spec.scope:
+        frozen.append("spec.scope")
+    if (new.spec.names.plural, new.spec.names.kind) != \
+            (old.spec.names.plural, old.spec.names.kind):
+        frozen.append("spec.names")
+    if frozen:
+        raise InvalidError(f"CRD {new.metadata.name!r}: immutable fields "
+                           f"changed: {', '.join(frozen)}")
+
+
+DEFAULT_SCHEME.register(EXTENSIONS_V1, "CustomResourceDefinition",
+                        CustomResourceDefinition)

@@ -1,0 +1,133 @@
+"""Strategic merge patch — list-aware patching with merge keys.
+
+Reference: ``apimachinery/pkg/util/strategicpatch`` — unlike RFC 7386
+JSON merge-patch (which replaces lists wholesale), a strategic patch
+merges lists of objects by a per-type **merge key** (containers by
+name, taints by key, conditions by type...), so a patch touching one
+container does not clobber its siblings. The reference reads merge keys
+from struct tags; here they live in :data:`MERGE_KEYS`, keyed by the
+dataclass element type, and the patcher walks the typed object model
+(``typing`` hints) alongside the raw dicts.
+
+Directives (same wire format as the reference):
+
+- ``{"$patch": "delete", <mergeKey>: v}`` in a list removes the element;
+- ``{"$patch": "replace"}`` as a list element replaces the whole list
+  with the patch's remaining elements;
+- ``null`` values delete map keys (as in merge-patch).
+"""
+from __future__ import annotations
+
+import dataclasses
+import typing
+from typing import Any, Optional, get_args, get_origin, get_type_hints
+
+from . import types as t
+
+#: element dataclass -> field acting as the merge key.
+MERGE_KEYS: dict[type, str] = {
+    t.Container: "name",
+    t.EnvVar: "name",
+    t.EnvFromSource: "config_map_ref",
+    t.ContainerPort: "container_port",
+    t.Volume: "name",
+    t.VolumeMount: "mount_path",
+    t.Taint: "key",
+    t.Toleration: "key",
+    t.NodeCondition: "type",
+    t.PodCondition: "type",
+    t.ServicePort: "port",
+    t.PodTpuRequest: "name",
+    t.NodeAddress: "type",
+}
+
+_DIRECTIVE = "$patch"
+
+
+def _element_type(cls: type, field_name: str) -> Optional[type]:
+    """Element dataclass of a ``list[...]`` field, else None."""
+    try:
+        hints = get_type_hints(cls)
+    except Exception:  # noqa: BLE001 — unresolvable hints = atomic
+        return None
+    hint = hints.get(field_name)
+    if hint is None:
+        return None
+    if get_origin(hint) is list:
+        (elem,) = get_args(hint) or (None,)
+        return elem if dataclasses.is_dataclass(elem) else None
+    return None
+
+
+def _field_type(cls: type, field_name: str) -> Optional[type]:
+    """Nested dataclass type of a field (unwrapping Optional)."""
+    try:
+        hints = get_type_hints(cls)
+    except Exception:  # noqa: BLE001
+        return None
+    hint = hints.get(field_name)
+    if hint is None:
+        return None
+    if get_origin(hint) is typing.Union:
+        args = [a for a in get_args(hint) if a is not type(None)]
+        hint = args[0] if len(args) == 1 else None
+    return hint if dataclasses.is_dataclass(hint) else None
+
+
+def strategic_merge(base: Any, patch: Any, cls: Optional[type]) -> Any:
+    """Merge ``patch`` into ``base`` (plain dicts/lists/scalars), guided
+    by the dataclass ``cls`` describing ``base``'s shape."""
+    if isinstance(patch, dict) and isinstance(base, dict):
+        out = dict(base)
+        for key, pval in patch.items():
+            if pval is None:
+                out.pop(key, None)
+                continue
+            bval = out.get(key)
+            if isinstance(pval, list) and cls is not None:
+                elem = _element_type(cls, key)
+                mk = MERGE_KEYS.get(elem) if elem else None
+                if mk is not None and isinstance(bval, list):
+                    out[key] = _merge_list(bval, pval, elem, mk)
+                    continue
+            if isinstance(pval, dict):
+                sub = _field_type(cls, key) if cls is not None else None
+                out[key] = strategic_merge(bval if isinstance(bval, dict)
+                                           else {}, pval, sub)
+                continue
+            out[key] = pval
+        return out
+    return patch
+
+
+def _merge_list(base: list, patch: list, elem: type, merge_key: str) -> list:
+    out = [dict(item) if isinstance(item, dict) else item for item in base]
+    for pitem in patch:
+        if not isinstance(pitem, dict):
+            return patch  # scalar elements: replace wholesale
+        directive = pitem.get(_DIRECTIVE)
+        if directive == "replace":
+            # Remaining patch elements become the list.
+            return [p for p in patch
+                    if not (isinstance(p, dict) and p.get(_DIRECTIVE))]
+        key_val = pitem.get(merge_key)
+        if directive == "delete":
+            out = [item for item in out
+                   if not (isinstance(item, dict)
+                           and item.get(merge_key) == key_val)]
+            continue
+        if key_val is None:
+            out.append({k: v for k, v in pitem.items() if k != _DIRECTIVE})
+            continue
+        for i, item in enumerate(out):
+            if isinstance(item, dict) and item.get(merge_key) == key_val:
+                out[i] = strategic_merge(item, pitem, elem)
+                break
+        else:
+            out.append({k: v for k, v in pitem.items() if k != _DIRECTIVE})
+    return out
+
+
+#: Wire content types (reference: types.go PatchType).
+MERGE_PATCH = "application/merge-patch+json"
+STRATEGIC_MERGE_PATCH = "application/strategic-merge-patch+json"

@@ -171,6 +171,14 @@ class Scheme:
         self._by_cls[cls] = (api_version, kind)
         return cls
 
+    def unregister(self, api_version: str, kind: str) -> None:
+        """Remove a dynamically-registered type (CRD deletion) so dead
+        classes do not accumulate in a process-global scheme."""
+        cls = self._by_gvk.pop((api_version, kind), None)
+        if cls is not None:
+            self._by_cls.pop(cls, None)
+        self._defaulters.pop(cls, None)
+
     def add_defaulter(self, cls: type, fn) -> None:
         self._defaulters.setdefault(cls, []).append(fn)
 

@@ -20,7 +20,8 @@ import datetime
 from dataclasses import dataclass, field as dc_field
 from typing import Any, Callable, Optional
 
-from ..api import errors, rbac as r, types as t, validation as val, workloads as w
+from ..api import errors, extensions as ext, rbac as r, types as t, \
+    validation as val, workloads as w
 from ..api.meta import ObjectMeta, TypedObject, now, stamp_new
 from ..api.scheme import DEFAULT_SCHEME, Scheme, from_dict, to_dict
 from ..api.selectors import match_field_selector, parse_selector
@@ -55,6 +56,7 @@ def _pod_fields(pod: t.Pod) -> dict:
         "metadata.namespace": pod.metadata.namespace,
         "spec.node_name": pod.spec.node_name,
         "spec.scheduler_name": pod.spec.scheduler_name,
+        "spec.gang": pod.spec.gang,
         "status.phase": pod.status.phase,
     }
 
@@ -141,6 +143,10 @@ def builtin_resources() -> list[ResourceSpec]:
                      has_status=False),
         ResourceSpec("clusterrolebindings", "ClusterRoleBinding", r.RBAC_V1,
                      r.ClusterRoleBinding, namespaced=False, has_status=False),
+        ResourceSpec("customresourcedefinitions", "CustomResourceDefinition",
+                     ext.EXTENSIONS_V1, ext.CustomResourceDefinition,
+                     namespaced=False, validate_create=ext.validate_crd,
+                     validate_update=ext.validate_crd_update),
     ]
 
 
@@ -161,6 +167,15 @@ class Registry:
         self._node_cidrs = None  # lazy CIDRAllocator
         for spec in builtin_resources():
             self.add_resource(spec)
+        # Durable restart: re-install custom resources already defined.
+        stored, _rev = self.store.list(
+            "/registry/customresourcedefinitions/", copy=False)
+        for s in stored:
+            crd = from_dict(ext.CustomResourceDefinition, s.value)
+            try:
+                self._install_crd(crd)
+            except errors.StatusError:
+                pass  # name collision with a builtin added since
 
     def add_resource(self, spec: ResourceSpec) -> None:
         self._by_plural[spec.plural] = spec
@@ -237,6 +252,8 @@ class Registry:
         # when the key exists, and rollback releases ONLY values this
         # call allocated (releasing a duplicate explicit value would
         # free a block the stored owner still holds).
+        if isinstance(obj, ext.CustomResourceDefinition):
+            self._check_crd_collision(obj)
         key = self._key(spec, meta.namespace, meta.name)
         rollback: list = []
         if not self.store.exists(key):
@@ -247,6 +264,8 @@ class Registry:
             for release, value in rollback:
                 release(value)
             raise
+        if isinstance(obj, ext.CustomResourceDefinition):
+            self._install_crd(obj)
         meta.resource_version = str(rev)
         return obj
 
@@ -337,6 +356,61 @@ class Registry:
                 rollback.append((self._node_cidrs.release, obj.spec.pod_cidr))
         return rollback
 
+    # -- CRDs (apiextensions-apiserver analog) ----------------------------
+
+    def _install_crd(self, crd: ext.CustomResourceDefinition) -> None:
+        """Dynamically add the CRD's resource: the HTTP routes are
+        parameterized, so a registry-table entry is all installation
+        takes (reference: apiextensions' dynamic handler)."""
+        names = crd.spec.names
+        self._check_crd_collision(crd)
+        gv = crd.api_version_str()
+        # One subclass per CRD keeps the scheme's class<->gvk bijective.
+        cls = type(names.kind, (ext.CustomResource,), {})
+        self.scheme.register(gv, names.kind, cls)
+        self.add_resource(ResourceSpec(
+            plural=names.plural, kind=names.kind, api_version=gv, cls=cls,
+            namespaced=crd.spec.scope == ext.SCOPE_NAMESPACED,
+            validate_create=ext.make_cr_validator(crd)))
+
+    def _check_crd_collision(self, crd: ext.CustomResourceDefinition) -> None:
+        """Reject plural OR kind collisions with builtins and with other
+        CRDs. Re-installing the same CRD (same group/version/kind on the
+        same plural — the update/reload path) is allowed."""
+        gv = crd.api_version_str()
+        names = crd.spec.names
+        existing = self._by_plural.get(names.plural)
+        if existing is not None and not (
+                existing.api_version == gv and existing.kind == names.kind
+                and issubclass(existing.cls, ext.CustomResource)):
+            raise errors.InvalidError(
+                f"CRD {crd.metadata.name!r}: plural {names.plural!r} "
+                f"collides with an existing resource")
+        by_kind = self._by_kind.get(names.kind)
+        if by_kind is not None and by_kind.plural != names.plural:
+            raise errors.InvalidError(
+                f"CRD {crd.metadata.name!r}: kind {names.kind!r} "
+                f"collides with an existing resource")
+
+    def _uninstall_crd(self, crd: ext.CustomResourceDefinition) -> None:
+        """Remove the resource + purge its stored objects (reference:
+        the CRD finalizer deletes CRs before the definition goes)."""
+        names = crd.spec.names
+        spec = self._by_plural.get(names.plural)
+        if spec is None or not issubclass(spec.cls, ext.CustomResource):
+            return
+        prefix = f"/registry/{names.plural}/"
+        stored, _rev = self.store.list(prefix, copy=False)
+        for s in stored:
+            try:
+                self.store.delete(s.key, expected_revision=s.mod_revision)
+            except errors.StatusError:
+                pass
+        self._by_plural.pop(names.plural, None)
+        if self._by_kind.get(names.kind) is spec:
+            self._by_kind.pop(names.kind, None)
+        self.scheme.unregister(crd.api_version_str(), names.kind)
+
     def _release_ips(self, obj: TypedObject) -> None:
         """Return an object's IP/CIDR allocation on actual removal —
         both the delete() path and the finalizer-completion path in
@@ -421,6 +495,8 @@ class Registry:
                 and not new.metadata.finalizers and not ns_finalizers:
             self.store.delete(key, expected_revision=stored.mod_revision)
             self._release_ips(new)
+            if isinstance(new, ext.CustomResourceDefinition):
+                self._uninstall_crd(new)
             new.metadata.resource_version = str(self.store.revision)
             return new
         # The registry is the ONLY pod-CIDR allocator (a second,
@@ -443,6 +519,10 @@ class Registry:
                 f"immutable ({old.spec.cluster_ip} -> {new.spec.cluster_ip})")
         rev = self.store.update(key, self._encode(new),
                                 expected_revision=stored.mod_revision)
+        if isinstance(new, ext.CustomResourceDefinition):
+            # Schema may have changed: refresh the validator closure
+            # (identity fields are immutable per validate_crd_update).
+            self._install_crd(new)
         new.metadata.resource_version = str(rev)
         return new
 
@@ -452,8 +532,10 @@ class Registry:
         return to_dict(new.spec) != to_dict(old.spec)
 
     def patch(self, plural: str, namespace: str, name: str, patch: dict,
-              subresource: str = "") -> TypedObject:
-        """JSON merge-patch (RFC 7386), the CLI/controller-friendly verb."""
+              subresource: str = "", strategic: bool = False) -> TypedObject:
+        """JSON merge-patch (RFC 7386) or, with ``strategic=True``,
+        strategic merge patch (list merge by per-type keys — see
+        ``api/patch.py``)."""
         spec = self.spec_for(plural)
 
         def apply_merge(base: Any, p: Any) -> Any:
@@ -471,7 +553,11 @@ class Registry:
 
         for _ in range(10):
             cur = self.get(plural, namespace, name)
-            merged = apply_merge(self._encode(cur), patch)
+            if strategic:
+                from ..api.patch import strategic_merge
+                merged = strategic_merge(self._encode(cur), patch, spec.cls)
+            else:
+                merged = apply_merge(self._encode(cur), patch)
             obj = from_dict(spec.cls, merged)
             obj.api_version, obj.kind = spec.api_version, spec.kind
             obj.metadata.resource_version = cur.metadata.resource_version
@@ -531,6 +617,8 @@ class Registry:
             return obj
         self.store.delete(key, expected_revision=stored.mod_revision)
         self._release_ips(obj)
+        if isinstance(obj, ext.CustomResourceDefinition):
+            self._uninstall_crd(obj)
         return obj
 
     def delete_collection(self, plural: str, namespace: str = "",

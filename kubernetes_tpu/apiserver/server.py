@@ -305,8 +305,11 @@ class APIServer:
         plural, ns = self._ctx(request)
         sub = request.match_info.get("subresource", "")
         patch = await self._body_obj(request)
+        from ..api.patch import STRATEGIC_MERGE_PATCH
+        strategic = request.content_type == STRATEGIC_MERGE_PATCH
         updated = await asyncio.to_thread(
-            self.registry.patch, plural, ns, request.match_info["name"], patch, sub)
+            self.registry.patch, plural, ns, request.match_info["name"],
+            patch, sub, strategic)
         return self._obj_response(updated)
 
     async def _delete(self, request):

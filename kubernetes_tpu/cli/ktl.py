@@ -116,7 +116,8 @@ def load_manifests(path: str) -> list[Any]:
             plural = _BY_KIND.get(doc["kind"])
             if plural:
                 doc["api_version"] = _BY_PLURAL[plural][0]
-        objs.append(DEFAULT_SCHEME.decode(doc))
+        from ..client.rest import decode_obj
+        objs.append(decode_obj(doc))
     return objs
 
 
@@ -436,6 +437,9 @@ async def cmd_up(args) -> int:
     fd = os.open(DEFAULT_CONFIG, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
     with os.fdopen(fd, "w") as f:
         json.dump({"server": base, "token": admin_token}, f)
+    # O_CREAT's mode only applies to NEW files; a pre-existing config
+    # from an older run may be 0644 — tighten it regardless.
+    os.chmod(DEFAULT_CONFIG, 0o600)
     tpu_note = (" (node-0 probing real TPU)" if args.real_tpu else
                 f" ({args.tpu_chips} stub chips/node)" if args.tpu_chips else "")
     print(f"cluster up at {base} — {args.nodes} node(s){tpu_note}")

@@ -56,7 +56,9 @@ class Client:
         return await self.update(obj, subresource="status")
 
     async def patch(self, plural: str, namespace: str, name: str, patch: dict,
-                    subresource: str = "") -> Any:
+                    subresource: str = "", strategic: bool = False) -> Any:
+        """``strategic=True`` selects strategic-merge-patch semantics
+        (list merge by per-type keys) instead of RFC 7386."""
         raise NotImplementedError
 
     async def delete(self, plural: str, namespace: str, name: str,

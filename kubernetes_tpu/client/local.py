@@ -46,9 +46,10 @@ class LocalClient(Client):
         return await asyncio.to_thread(self.registry.update, obj, subresource)
 
     async def patch(self, plural: str, namespace: str, name: str, patch: dict,
-                    subresource: str = "") -> Any:
+                    subresource: str = "", strategic: bool = False) -> Any:
         return await asyncio.to_thread(
-            self.registry.patch, plural, namespace, name, patch, subresource)
+            self.registry.patch, plural, namespace, name, patch, subresource,
+            strategic)
 
     async def delete(self, plural: str, namespace: str, name: str,
                      grace_period_seconds: Optional[int] = None, uid: str = "") -> Any:

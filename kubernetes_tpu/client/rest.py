@@ -23,6 +23,20 @@ BOOKMARK = "BOOKMARK"
 CLOSED = "CLOSED"
 
 
+def decode_obj(data: dict):
+    """Scheme decode with a CustomResource fallback: a client that has
+    not locally registered a CRD's kind still gets a usable object."""
+    try:
+        return DEFAULT_SCHEME.decode(data)
+    except KeyError:
+        from ..api.extensions import CustomResource
+        from ..api.scheme import from_dict
+        obj = from_dict(CustomResource, data)
+        obj.api_version = data.get("api_version", "")
+        obj.kind = data.get("kind", "")
+        return obj
+
+
 def _resource_tables() -> tuple[dict, dict]:
     from ..apiserver.registry import builtin_resources
     by_plural: dict[str, tuple[str, bool]] = {}
@@ -65,7 +79,7 @@ class _RESTWatch(WatchStream):
                     if msg["type"] == BOOKMARK:
                         await self._queue.put((BOOKMARK, msg["object"]))
                         continue
-                    obj = DEFAULT_SCHEME.decode(msg["object"])
+                    obj = decode_obj(msg["object"])
                     await self._queue.put((msg["type"], obj))
         except (aiohttp.ClientError, asyncio.CancelledError, ConnectionResetError):
             pass
@@ -106,6 +120,13 @@ class RESTClient(Client):
         self.base_url = base_url.rstrip("/")
         self._headers = {"Authorization": f"Bearer {token}"} if token else {}
         self._session: Optional[aiohttp.ClientSession] = None
+        #: Discovery-learned resources (CRDs): plural -> (gv, namespaced).
+        #: TTL'd so CRD deletion/recreation is picked up (the static
+        #: builtin table never goes stale and never expires).
+        self._dynamic: dict[str, tuple[str, bool]] = {}
+        self._dynamic_kinds: dict[str, str] = {}
+        self._discovery_at = 0.0
+        self.discovery_ttl = 15.0
 
     def _sess(self) -> aiohttp.ClientSession:
         if self._session is None or self._session.closed:
@@ -124,12 +145,34 @@ class RESTClient(Client):
             parts.append(subresource)
         return "/".join(parts)
 
-    def _plural_info(self, plural: str) -> tuple[str, bool]:
-        # Static mirror of the server's resource table (avoids discovery RTT).
+    async def _plural_info(self, plural: str) -> tuple[str, bool]:
+        """Static mirror of the server's resource table (avoids discovery
+        RTT); unknown plurals (CRDs installed at runtime) fall back to
+        the /apis discovery document, cached per client."""
         try:
             return _BY_PLURAL[plural]
         except KeyError:
-            raise errors.NotFoundError(f"unknown resource type {plural!r}") from None
+            pass
+        await self._refresh_discovery()  # no-op within the TTL window
+        try:
+            return self._dynamic[plural]
+        except KeyError:
+            raise errors.NotFoundError(
+                f"unknown resource type {plural!r}") from None
+
+    async def _refresh_discovery(self) -> None:
+        import time
+        if time.monotonic() - self._discovery_at < self.discovery_ttl \
+                and self._dynamic:
+            return
+        async with self._sess().get(f"{self.base_url}/apis") as resp:
+            data = await self._check(resp)
+        self._dynamic.clear()
+        self._dynamic_kinds.clear()
+        for res in data.get("resources", []):
+            self._dynamic[res["name"]] = (res["api_version"], res["namespaced"])
+            self._dynamic_kinds[res["kind"]] = res["name"]
+        self._discovery_at = time.monotonic()
 
     async def _check(self, resp: aiohttp.ClientResponse) -> Any:
         if resp.status >= 400:
@@ -141,29 +184,40 @@ class RESTClient(Client):
         return await resp.json()
 
     async def create(self, obj: Any) -> Any:
-        gvk = DEFAULT_SCHEME.gvk_for(obj)
-        plural = self._plural_for_kind(gvk[1])
+        try:
+            gvk = DEFAULT_SCHEME.gvk_for(obj)
+        except KeyError:
+            # Generic CustomResource instance: TypeMeta carries the GVK.
+            if not (obj.api_version and obj.kind):
+                raise
+            gvk = (obj.api_version, obj.kind)
+        plural = await self._plural_for_kind(gvk[1])
         url = self._url_for(gvk[0], plural, obj.metadata.namespace)
         async with self._sess().post(url, json=to_dict(obj)) as resp:
             data = await self._check(resp)
-        return DEFAULT_SCHEME.decode(data)
+        return decode_obj(data)
 
-    def _plural_for_kind(self, kind: str) -> str:
+    async def _plural_for_kind(self, kind: str) -> str:
         try:
             return _BY_KIND[kind]
+        except KeyError:
+            pass
+        await self._refresh_discovery()  # no-op within the TTL window
+        try:
+            return self._dynamic_kinds[kind]
         except KeyError:
             raise errors.NotFoundError(f"unknown kind {kind!r}") from None
 
     async def get(self, plural: str, namespace: str, name: str) -> Any:
-        av, namespaced = self._plural_info(plural)
+        av, namespaced = await self._plural_info(plural)
         url = self._url_for(av, plural, namespace if namespaced else "", name)
         async with self._sess().get(url) as resp:
             data = await self._check(resp)
-        return DEFAULT_SCHEME.decode(data)
+        return decode_obj(data)
 
     async def list(self, plural: str, namespace: str = "", label_selector: str = "",
                    field_selector: str = "") -> tuple[list, int]:
-        av, namespaced = self._plural_info(plural)
+        av, namespaced = await self._plural_info(plural)
         url = self._url_for(av, plural, namespace if namespaced else "")
         params = {}
         if label_selector:
@@ -172,29 +226,35 @@ class RESTClient(Client):
             params["field_selector"] = field_selector
         async with self._sess().get(url, params=params) as resp:
             data = await self._check(resp)
-        items = [DEFAULT_SCHEME.decode(i) for i in data["items"]]
+        items = [decode_obj(i) for i in data["items"]]
         return items, int(data["metadata"]["resource_version"])
 
     async def update(self, obj: Any, subresource: str = "") -> Any:
         gvk = DEFAULT_SCHEME.gvk_for(obj)
-        plural = self._plural_for_kind(gvk[1])
+        plural = await self._plural_for_kind(gvk[1])
         url = self._url_for(gvk[0], plural, obj.metadata.namespace,
                             obj.metadata.name, subresource)
         async with self._sess().put(url, json=to_dict(obj)) as resp:
             data = await self._check(resp)
-        return DEFAULT_SCHEME.decode(data)
+        return decode_obj(data)
 
     async def patch(self, plural: str, namespace: str, name: str, patch: dict,
-                    subresource: str = "") -> Any:
-        av, namespaced = self._plural_info(plural)
+                    subresource: str = "", strategic: bool = False) -> Any:
+        av, namespaced = await self._plural_info(plural)
         url = self._url_for(av, plural, namespace if namespaced else "", name, subresource)
-        async with self._sess().patch(url, json=patch) as resp:
+        if strategic:
+            from ..api.patch import STRATEGIC_MERGE_PATCH
+            kwargs = {"data": json.dumps(patch).encode(),
+                      "headers": {"Content-Type": STRATEGIC_MERGE_PATCH}}
+        else:
+            kwargs = {"json": patch}
+        async with self._sess().patch(url, **kwargs) as resp:
             data = await self._check(resp)
-        return DEFAULT_SCHEME.decode(data)
+        return decode_obj(data)
 
     async def delete(self, plural: str, namespace: str, name: str,
                      grace_period_seconds: Optional[int] = None, uid: str = "") -> Any:
-        av, namespaced = self._plural_info(plural)
+        av, namespaced = await self._plural_info(plural)
         url = self._url_for(av, plural, namespace if namespaced else "", name)
         params = {}
         if grace_period_seconds is not None:
@@ -203,11 +263,11 @@ class RESTClient(Client):
             params["uid"] = uid
         async with self._sess().delete(url, params=params) as resp:
             data = await self._check(resp)
-        return DEFAULT_SCHEME.decode(data)
+        return decode_obj(data)
 
     async def watch(self, plural: str, namespace: str = "", resource_version: int = 0,
                     label_selector: str = "", field_selector: str = "") -> WatchStream:
-        av, namespaced = self._plural_info(plural)
+        av, namespaced = await self._plural_info(plural)
         url = self._url_for(av, plural, namespace if namespaced else "")
         params = {"watch": "1", "resource_version": str(resource_version)}
         if label_selector:
@@ -220,7 +280,7 @@ class RESTClient(Client):
         url = self._url_for("core/v1", "pods", namespace, name, "binding")
         async with self._sess().post(url, json=to_dict(binding)) as resp:
             data = await self._check(resp)
-        return DEFAULT_SCHEME.decode(data)
+        return decode_obj(data)
 
     async def close(self) -> None:
         if self._session and not self._session.closed:

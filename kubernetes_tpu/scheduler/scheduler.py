@@ -376,7 +376,8 @@ class Scheduler:
         # (their chips anchor the contiguity constraint).
         pods = []
         bound_pods = []
-        members, _rev = await self.client.list("pods", ns)
+        members, _rev = await self.client.list(
+            "pods", ns, field_selector=f"spec.gang={name}")
         for cur in members:
             if cur.spec.gang != name or not t.is_pod_active(cur):
                 # Terminated members keep node_name + assigned chips in

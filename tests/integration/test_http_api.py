@@ -131,3 +131,22 @@ async def test_invalid_json_is_400():
     finally:
         await client.close()
         await srv.stop()
+
+
+async def test_strategic_patch_over_http():
+    """Content-type application/strategic-merge-patch+json selects
+    list-merge semantics over the wire."""
+    srv, client = await start_server()
+    try:
+        pod = mk_pod("sp")
+        pod.spec.containers.append(t.Container(name="side", image="side:v1"))
+        await client.create(pod)
+        updated = await client.patch(
+            "pods", "default", "sp",
+            {"spec": {"containers": [{"name": "c", "image": "img:v2"}]}},
+            strategic=True)
+        assert {c.name: c.image for c in updated.spec.containers} == \
+            {"c": "img:v2", "side": "side:v1"}
+    finally:
+        await client.close()
+        await srv.stop()

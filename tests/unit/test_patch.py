@@ -1,0 +1,97 @@
+"""Strategic merge patch tests (reference: strategicpatch tests)."""
+import asyncio
+
+import pytest
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.api.patch import strategic_merge
+from kubernetes_tpu.api.scheme import to_dict
+
+from tests.controllers.util import make_plane
+
+
+def mk_pod_dict():
+    pod = t.Pod(metadata=ObjectMeta(name="p", namespace="default"),
+                spec=t.PodSpec(containers=[
+                    t.Container(name="app", image="app:v1",
+                                env=[t.EnvVar(name="A", value="1"),
+                                     t.EnvVar(name="B", value="2")]),
+                    t.Container(name="sidecar", image="side:v1")]))
+    return to_dict(pod)
+
+
+def test_containers_merge_by_name():
+    base = mk_pod_dict()
+    patch = {"spec": {"containers": [{"name": "app", "image": "app:v2"}]}}
+    out = strategic_merge(base, patch, t.Pod)
+    containers = {c["name"]: c for c in out["spec"]["containers"]}
+    assert len(containers) == 2, "sibling container clobbered"
+    assert containers["app"]["image"] == "app:v2"
+    assert containers["sidecar"]["image"] == "side:v1"
+    # env inside the merged container also merges by name
+    assert {e["name"]: e["value"] for e in containers["app"]["env"]} == \
+        {"A": "1", "B": "2"}
+
+
+def test_nested_env_merge_and_delete_directive():
+    base = mk_pod_dict()
+    patch = {"spec": {"containers": [
+        {"name": "app", "env": [{"name": "B", "value": "20"},
+                                {"name": "C", "value": "3"},
+                                {"$patch": "delete", "name": "A"}]}]}}
+    out = strategic_merge(base, patch, t.Pod)
+    app = next(c for c in out["spec"]["containers"] if c["name"] == "app")
+    assert {e["name"]: e["value"] for e in app["env"]} == \
+        {"B": "20", "C": "3"}
+
+
+def test_replace_directive():
+    base = mk_pod_dict()
+    patch = {"spec": {"containers": [
+        {"$patch": "replace"},
+        {"name": "only", "image": "x"}]}}
+    out = strategic_merge(base, patch, t.Pod)
+    assert [c["name"] for c in out["spec"]["containers"]] == ["only"]
+
+
+def test_taints_merge_by_key_and_scalar_lists_replace():
+    node = t.Node(metadata=ObjectMeta(name="n"))
+    node.spec.taints = [t.Taint(key="a", value="1", effect="NoSchedule")]
+    base = to_dict(node)
+    patch = {"spec": {"taints": [{"key": "b", "effect": "NoExecute"}]}}
+    out = strategic_merge(base, patch, t.Node)
+    assert {x["key"] for x in out["spec"]["taints"]} == {"a", "b"}
+    # Scalar list (finalizers): replaced wholesale (atomic).
+    patch = {"metadata": {"finalizers": ["x"]}}
+    out = strategic_merge(base, patch, t.Node)
+    assert out["metadata"]["finalizers"] == ["x"]
+
+
+def test_null_deletes_map_keys():
+    base = {"metadata": {"labels": {"a": "1", "b": "2"}}}
+    patch = {"metadata": {"labels": {"a": None}}}
+    out = strategic_merge(base, patch, t.Pod)
+    assert out["metadata"]["labels"] == {"b": "2"}
+
+
+@pytest.mark.asyncio
+async def test_registry_strategic_patch_end_to_end():
+    reg, client, _ = make_plane()
+    pod = t.Pod(metadata=ObjectMeta(name="p", namespace="default"),
+                spec=t.PodSpec(containers=[
+                    t.Container(name="app", image="app:v1"),
+                    t.Container(name="side", image="side:v1")]))
+    await client.create(pod)
+    # Merge-patch would clobber the sidecar; strategic must not.
+    updated = await client.patch(
+        "pods", "default", "p",
+        {"spec": {"containers": [{"name": "app", "image": "app:v2"}]}},
+        strategic=True)
+    names = {c.name: c.image for c in updated.spec.containers}
+    assert names == {"app": "app:v2", "side": "side:v1"}
+    # Plain merge-patch keeps RFC 7386 semantics (list replaced).
+    updated = await client.patch(
+        "pods", "default", "p",
+        {"metadata": {"labels": {"x": "y"}}})
+    assert updated.metadata.labels == {"x": "y"}
