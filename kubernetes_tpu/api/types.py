@@ -230,12 +230,19 @@ class SecretVolume:
 
 
 @dataclass
+class PersistentVolumeClaimVolume:
+    claim_name: str = ""
+    read_only: bool = False
+
+
+@dataclass
 class Volume:
     name: str = ""
     host_path: Optional[HostPathVolume] = None
     empty_dir: Optional[EmptyDirVolume] = None
     config_map: Optional[ConfigMapVolume] = None
     secret: Optional[SecretVolume] = None
+    persistent_volume_claim: Optional[PersistentVolumeClaimVolume] = None
 
 
 @dataclass
@@ -787,6 +794,78 @@ class ObjectList:
 
 
 # ---------------------------------------------------------------------------
+# Persistent storage (reference: PV/PVC in core/v1/types.go + StorageClass)
+# ---------------------------------------------------------------------------
+
+PV_AVAILABLE = "Available"
+PV_BOUND = "Bound"
+PV_RELEASED = "Released"
+PVC_PENDING = "Pending"
+PVC_BOUND = "Bound"
+
+RECLAIM_RETAIN = "Retain"
+RECLAIM_DELETE = "Delete"
+
+#: The built-in dynamic provisioner (reference analog: the in-tree
+#: host-path provisioner used by local-up clusters).
+PROVISIONER_HOSTPATH = "kubernetes-tpu/host-path"
+
+
+@dataclass
+class PersistentVolumeSpec:
+    #: {"storage": bytes} — same quantity convention as pod resources.
+    capacity: dict[str, float] = field(default_factory=dict)
+    access_modes: list[str] = field(default_factory=lambda: ["ReadWriteOnce"])
+    storage_class_name: str = ""
+    host_path: Optional[HostPathVolume] = None
+    claim_ref: Optional[ObjectReference] = None
+    persistent_volume_reclaim_policy: str = RECLAIM_RETAIN
+
+
+@dataclass
+class PersistentVolumeStatus:
+    phase: str = PV_AVAILABLE
+    message: str = ""
+
+
+@dataclass
+class PersistentVolume(TypedObject):
+    spec: PersistentVolumeSpec = field(default_factory=PersistentVolumeSpec)
+    status: PersistentVolumeStatus = field(default_factory=PersistentVolumeStatus)
+
+
+@dataclass
+class PersistentVolumeClaimSpec:
+    access_modes: list[str] = field(default_factory=lambda: ["ReadWriteOnce"])
+    #: {"storage": bytes} requested.
+    resources: ResourceRequirements = field(default_factory=ResourceRequirements)
+    storage_class_name: str = ""
+    volume_name: str = ""
+
+
+@dataclass
+class PersistentVolumeClaimStatus:
+    phase: str = PVC_PENDING
+    capacity: dict[str, float] = field(default_factory=dict)
+
+
+@dataclass
+class PersistentVolumeClaim(TypedObject):
+    spec: PersistentVolumeClaimSpec = field(
+        default_factory=PersistentVolumeClaimSpec)
+    status: PersistentVolumeClaimStatus = field(
+        default_factory=PersistentVolumeClaimStatus)
+
+
+@dataclass
+class StorageClass(TypedObject):
+    provisioner: str = ""
+    reclaim_policy: str = RECLAIM_DELETE
+    #: Provisioner parameters (host-path: {"base_dir": ...}).
+    parameters: dict[str, str] = field(default_factory=dict)
+
+
+# ---------------------------------------------------------------------------
 # Registration + defaulting
 # ---------------------------------------------------------------------------
 
@@ -798,8 +877,12 @@ for _kind, _cls in [
     ("Secret", Secret), ("Event", Event), ("ResourceQuota", ResourceQuota),
     ("LimitRange", LimitRange), ("PriorityClass", PriorityClass),
     ("Lease", Lease), ("PodGroup", PodGroup), ("List", ObjectList),
+    ("PersistentVolume", PersistentVolume),
+    ("PersistentVolumeClaim", PersistentVolumeClaim),
 ]:
     DEFAULT_SCHEME.register(CORE_V1, _kind, _cls)
+
+DEFAULT_SCHEME.register("storage/v1", "StorageClass", StorageClass)
 
 
 def _default_pod(pod: Pod) -> None:

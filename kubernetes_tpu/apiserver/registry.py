@@ -143,6 +143,12 @@ def builtin_resources() -> list[ResourceSpec]:
                      has_status=False),
         ResourceSpec("clusterrolebindings", "ClusterRoleBinding", r.RBAC_V1,
                      r.ClusterRoleBinding, namespaced=False, has_status=False),
+        ResourceSpec("persistentvolumes", "PersistentVolume", core,
+                     t.PersistentVolume, namespaced=False),
+        ResourceSpec("persistentvolumeclaims", "PersistentVolumeClaim", core,
+                     t.PersistentVolumeClaim),
+        ResourceSpec("storageclasses", "StorageClass", "storage/v1",
+                     t.StorageClass, namespaced=False, has_status=False),
         ResourceSpec("customresourcedefinitions", "CustomResourceDefinition",
                      ext.EXTENSIONS_V1, ext.CustomResourceDefinition,
                      namespaced=False, validate_create=ext.validate_crd,

@@ -56,6 +56,13 @@ ALIASES = {
     "pdb": "poddisruptionbudgets",
     "endpoints": "endpoints", "ep": "endpoints",
     "lease": "leases",
+    "pv": "persistentvolumes", "persistentvolume": "persistentvolumes",
+    "pvc": "persistentvolumeclaims",
+    "persistentvolumeclaim": "persistentvolumeclaims",
+    "sc": "storageclasses", "storageclass": "storageclasses",
+    "crd": "customresourcedefinitions", "crds": "customresourcedefinitions",
+    "role": "roles", "clusterrole": "clusterroles",
+    "rolebinding": "rolebindings", "clusterrolebinding": "clusterrolebindings",
 }
 
 

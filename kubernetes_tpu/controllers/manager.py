@@ -33,6 +33,7 @@ from .podgc import PodGCController
 from .replicaset import ReplicaSetController
 from .resourcequota import ResourceQuotaController
 from .statefulset import StatefulSetController
+from .volume import PersistentVolumeBinder
 
 log = logging.getLogger("controller-manager")
 
@@ -46,6 +47,7 @@ DEFAULT_CONTROLLERS: dict[str, Callable[[Client, InformerFactory], Controller]] 
     "cronjob": CronJobController,
     "node-lifecycle": NodeLifecycleController,
     "node-ipam": NodeIpamController,
+    "persistentvolume-binder": PersistentVolumeBinder,
     "podgc": PodGCController,
     "garbage-collector": GarbageCollector,
     "namespace": NamespaceController,

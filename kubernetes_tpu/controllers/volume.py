@@ -1,0 +1,248 @@
+"""PersistentVolume binder — static binding + host-path provisioning.
+
+Reference: ``pkg/controller/volume/persistentvolume`` — the PV binder
+matches pending claims to Available volumes (capacity, access modes,
+storage class), binds both sides, and releases/deletes volumes when
+claims go away; dynamic provisioning creates volumes on demand via the
+storage class's provisioner. Here the one in-tree provisioner is
+host-path (``PROVISIONER_HOSTPATH``) — the local-up/dev posture; real
+deployments would add drivers behind the same seam.
+
+Crash recovery: the PV's ``claim_ref`` is the single source of binding
+truth. A half-finished bind (claim_ref set, PVC not yet updated) is
+completed on the next sync because the claim looks for a PV already
+reserved for it before matching fresh ones; a periodic reconcile pass
+releases Bound PVs whose claim vanished while the controller was down.
+"""
+from __future__ import annotations
+
+import asyncio
+import os
+import shutil
+import uuid
+from typing import Optional
+
+from ..api import errors, types as t
+from ..api.meta import ObjectMeta
+from ..client.informer import InformerFactory
+from ..client.interface import Client
+from .base import Controller
+
+
+def _storage(quantities: dict) -> float:
+    return t.parse_quantity(quantities.get("storage", 0.0))
+
+
+def _pv_matches(pv: t.PersistentVolume, pvc: t.PersistentVolumeClaim) -> bool:
+    if pv.status.phase != t.PV_AVAILABLE or pv.spec.claim_ref is not None:
+        return False
+    if pv.spec.storage_class_name != pvc.spec.storage_class_name:
+        return False
+    if not set(pvc.spec.access_modes) <= set(pv.spec.access_modes):
+        return False
+    return _storage(pv.spec.capacity) >= \
+        _storage(pvc.spec.resources.requests)
+
+
+class PersistentVolumeBinder(Controller):
+    name = "persistentvolume-binder"
+
+    def __init__(self, client: Client, factory: InformerFactory,
+                 provision_dir: str = "", workers: int = 1,
+                 resync_seconds: float = 30.0):
+        super().__init__(client, factory, workers)
+        self.provision_dir = provision_dir or "/tmp/ktpu-pv"
+        self.resync_seconds = resync_seconds
+        self.pvc_informer = self.watch("persistentvolumeclaims")
+        self.pv_informer = self.watch("persistentvolumes")
+        self.sc_informer = self.watch("storageclasses")
+        self.pvc_informer.add_handlers(
+            on_add=self.enqueue_obj,
+            on_update=lambda o, n: self.enqueue_obj(n),
+            on_delete=self._pvc_gone)
+        # A PV turning Available can unblock pending claims.
+        self.pv_informer.add_handlers(
+            on_add=lambda pv: self._enqueue_pending_claims(),
+            on_update=lambda o, n: self._enqueue_pending_claims())
+        self._resync_task: Optional[asyncio.Task] = None
+
+    async def on_start(self) -> None:
+        self._resync_task = asyncio.get_running_loop().create_task(
+            self._resync_loop())
+
+    async def stop(self) -> None:
+        if self._resync_task:
+            self._resync_task.cancel()
+            try:
+                await self._resync_task
+            except asyncio.CancelledError:
+                pass
+        await super().stop()
+
+    def _enqueue_pending_claims(self) -> None:
+        for pvc in self.pvc_informer.list():
+            if pvc.status.phase != t.PVC_BOUND:
+                self.enqueue_obj(pvc)
+
+    def _pvc_gone(self, pvc: t.PersistentVolumeClaim) -> None:
+        self.enqueue(f"orphan-scan::{pvc.metadata.uid}")
+
+    async def _resync_loop(self) -> None:
+        """Level-triggered safety net: deletions missed while down (the
+        informer can't replay them) must still release their PVs."""
+        while True:
+            await asyncio.sleep(self.resync_seconds)
+            self.enqueue("orphan-scan::periodic")
+
+    async def sync(self, key: str) -> Optional[float]:
+        if key.startswith("orphan-scan::"):
+            await self._scan_orphaned_pvs()
+            return None
+        pvc = self.pvc_informer.get(key)
+        if pvc is None or pvc.status.phase == t.PVC_BOUND:
+            return None
+        # Crash recovery: a PV already reserved for this claim wins over
+        # any fresh match (a half-finished bind completes, never forks).
+        pv = self._reserved_for(pvc) or self._find_pv(pvc)
+        if pv is None:
+            if pvc.spec.volume_name:
+                # Explicitly requested volume not (yet) available: wait
+                # for it — never silently provision a substitute
+                # (reference: volume_name pins the claim).
+                self.recorder.event(pvc, "Normal", "WaitingForVolume",
+                                    f"waiting for volume "
+                                    f"{pvc.spec.volume_name!r}")
+                return None
+            pv = await self._provision(pvc)
+        if pv is None:
+            self.recorder.event(pvc, "Normal", "WaitingForVolume",
+                                "no matching PersistentVolume; waiting")
+            return None  # a future PV add re-enqueues
+        await self._bind(pv, pvc)
+        return None
+
+    def _reserved_for(self, pvc: t.PersistentVolumeClaim
+                      ) -> Optional[t.PersistentVolume]:
+        for pv in self.pv_informer.list():
+            ref = pv.spec.claim_ref
+            if ref is not None and ref.uid == pvc.metadata.uid:
+                return pv
+        return None
+
+    def _find_pv(self, pvc: t.PersistentVolumeClaim
+                 ) -> Optional[t.PersistentVolume]:
+        if pvc.spec.volume_name:
+            pv = self.pv_informer.get(pvc.spec.volume_name)
+            return pv if pv is not None and _pv_matches(pv, pvc) else None
+        # Smallest adequate volume first (reference: best-fit).
+        candidates = [pv for pv in self.pv_informer.list()
+                      if _pv_matches(pv, pvc)]
+        candidates.sort(key=lambda pv: (_storage(pv.spec.capacity),
+                                        pv.metadata.name))
+        return candidates[0] if candidates else None
+
+    async def _provision(self, pvc: t.PersistentVolumeClaim
+                         ) -> Optional[t.PersistentVolume]:
+        sc = self.sc_informer.get(pvc.spec.storage_class_name) \
+            if pvc.spec.storage_class_name else None
+        if sc is None or sc.provisioner != t.PROVISIONER_HOSTPATH:
+            return None
+        base = sc.parameters.get("base_dir", self.provision_dir)
+        name = f"pvc-{pvc.metadata.uid or uuid.uuid4().hex[:12]}"
+        path = os.path.join(base, name)
+        os.makedirs(path, exist_ok=True)
+        pv = t.PersistentVolume(
+            metadata=ObjectMeta(name=name,
+                                annotations={"pv.kubernetes-tpu/provisioned-by":
+                                             sc.provisioner}),
+            spec=t.PersistentVolumeSpec(
+                capacity={"storage": _storage(pvc.spec.resources.requests)},
+                access_modes=list(pvc.spec.access_modes),
+                storage_class_name=pvc.spec.storage_class_name,
+                host_path=t.HostPathVolume(path=path),
+                persistent_volume_reclaim_policy=sc.reclaim_policy))
+        try:
+            created = await self.client.create(pv)
+        except errors.AlreadyExistsError:
+            created = await self.client.get("persistentvolumes", "", name)
+        self.recorder.event(pvc, "Normal", "Provisioned",
+                            f"created volume {name} at {path}")
+        return created
+
+    async def _bind(self, pv: t.PersistentVolume,
+                    pvc: t.PersistentVolumeClaim) -> None:
+        # PV side first (claim_ref is the lock against double-bind),
+        # then the claim. Each step is idempotent, so a crash or
+        # conflict anywhere resumes via _reserved_for on the next sync.
+        cur_pv = await self.client.get("persistentvolumes", "",
+                                       pv.metadata.name)
+        if cur_pv.spec.claim_ref is None:
+            cur_pv.spec.claim_ref = t.ObjectReference(
+                kind="PersistentVolumeClaim",
+                namespace=pvc.metadata.namespace,
+                name=pvc.metadata.name, uid=pvc.metadata.uid)
+            cur_pv = await self.client.update(cur_pv)
+        elif cur_pv.spec.claim_ref.uid != pvc.metadata.uid:
+            return  # raced another claim; re-sync finds the next PV
+        if cur_pv.status.phase != t.PV_BOUND:
+            cur_pv.status.phase = t.PV_BOUND
+            await self.client.update_status(cur_pv)
+
+        cur = await self.client.get("persistentvolumeclaims",
+                                    pvc.metadata.namespace, pvc.metadata.name)
+        if cur.spec.volume_name != pv.metadata.name:
+            cur.spec.volume_name = pv.metadata.name
+            cur = await self.client.update(cur)
+        if cur.status.phase != t.PVC_BOUND:
+            cur.status.phase = t.PVC_BOUND
+            cur.status.capacity = dict(pv.spec.capacity)
+            await self.client.update_status(cur)
+            self.recorder.event(cur, "Normal", "Bound",
+                                f"bound to volume {pv.metadata.name}")
+
+    # -- release path ------------------------------------------------------
+
+    async def _scan_orphaned_pvs(self) -> None:
+        """Release every PV bound to a claim that no longer exists.
+        Driven by both PVC delete events and the periodic resync, so
+        deletions missed while the controller was down still converge."""
+        claims_by_uid = {pvc.metadata.uid: pvc
+                         for pvc in self.pvc_informer.list()}
+        for pv in self.pv_informer.list():
+            ref = pv.spec.claim_ref
+            if ref is None or ref.uid in claims_by_uid:
+                continue
+            try:
+                await self.client.get("persistentvolumeclaims",
+                                      ref.namespace, ref.name)
+                continue  # live read says it exists; informer lag
+            except errors.NotFoundError:
+                pass
+            await self._release_pv(pv)
+
+    async def _release_pv(self, pv: t.PersistentVolume) -> None:
+        if pv.spec.persistent_volume_reclaim_policy == t.RECLAIM_DELETE:
+            # Delete the API object FIRST; only scrub data once the
+            # object is actually gone (an admission/authz rejection must
+            # not orphan a live PV with destroyed backing data).
+            try:
+                await self.client.delete("persistentvolumes", "",
+                                         pv.metadata.name)
+            except errors.NotFoundError:
+                pass
+            except errors.StatusError:
+                return  # retried by the next orphan scan
+            if pv.spec.host_path and pv.metadata.annotations.get(
+                    "pv.kubernetes-tpu/provisioned-by"):
+                shutil.rmtree(pv.spec.host_path.path, ignore_errors=True)
+            return
+        # Retain: one spec write clearing the ref, one status write to
+        # Released. If the second fails, the next scan cannot see the
+        # dangling ref anymore — so flip the STATUS first.
+        cur = await self.client.get("persistentvolumes", "", pv.metadata.name)
+        if cur.status.phase != t.PV_RELEASED:
+            cur.status.phase = t.PV_RELEASED
+            cur = await self.client.update_status(cur)
+        if cur.spec.claim_ref is not None:
+            cur.spec.claim_ref = None
+            await self.client.update(cur)

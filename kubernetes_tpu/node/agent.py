@@ -463,7 +463,9 @@ class NodeAgent:
                 self.client, pod, container,
                 {"status.pod_ip": pod_ip, "status.host_ip": self.address})
             volume_paths = await self.volumes.materialize(pod)
-            mounts = self.volumes.mounts_for(container, volume_paths)
+            mounts = self.volumes.mounts_for(
+                container, volume_paths,
+                read_only=self.volumes.read_only_volumes(pod))
         except (VolumeError, OSError) as e:
             # Transient by contract (missing object now, ENOSPC/EACCES
             # during projection): the worker retries next sync

@@ -75,17 +75,50 @@ class VolumeManager:
                 self._project(vdir, {k: secret_bytes(v)
                                      for k, v in data.items()}, mode=0o600)
                 paths[vol.name] = vdir
+            elif vol.persistent_volume_claim is not None:
+                paths[vol.name] = await self._pvc_path(
+                    pod, vol.persistent_volume_claim.claim_name)
             else:
                 raise VolumeError(f"volume {vol.name!r}: no supported source")
         return paths
+
+    async def _pvc_path(self, pod: t.Pod, claim_name: str) -> str:
+        """Resolve a bound claim to its PV's host path (the
+        WaitForAttachAndMount analog: unbound claims are transient)."""
+        try:
+            pvc = await self.client.get("persistentvolumeclaims",
+                                        pod.metadata.namespace, claim_name)
+        except errors.NotFoundError:
+            raise VolumeError(f"claim {claim_name!r} not found") from None
+        if pvc.status.phase != t.PVC_BOUND or not pvc.spec.volume_name:
+            raise VolumeError(f"claim {claim_name!r} is not bound yet")
+        try:
+            pv = await self.client.get("persistentvolumes", "",
+                                       pvc.spec.volume_name)
+        except errors.NotFoundError:
+            raise VolumeError(
+                f"volume {pvc.spec.volume_name!r} not found") from None
+        if pv.spec.host_path is None:
+            raise VolumeError(f"volume {pv.metadata.name!r} has no "
+                              f"host_path source this runtime can mount")
+        return pv.spec.host_path.path
 
     def teardown(self, pod_uid: str) -> None:
         shutil.rmtree(os.path.join(self.base_dir, "pods", pod_uid),
                       ignore_errors=True)
 
     @staticmethod
-    def mounts_for(container: t.Container,
-                   paths: dict[str, str]) -> list[tuple]:
+    def read_only_volumes(pod: t.Pod) -> frozenset:
+        """Volumes forced read-only at the VOLUME level (PVC read_only);
+        ORed with each mount's own read_only flag."""
+        return frozenset(
+            v.name for v in pod.spec.volumes
+            if v.persistent_volume_claim is not None
+            and v.persistent_volume_claim.read_only)
+
+    @staticmethod
+    def mounts_for(container: t.Container, paths: dict[str, str],
+                   read_only: frozenset = frozenset()) -> list[tuple]:
         """ContainerConfig.mounts tuples (host, container, ro) for this
         container's volume_mounts."""
         mounts = []
@@ -95,7 +128,8 @@ class VolumeManager:
                 raise VolumeError(
                     f"container {container.name!r} mounts unknown volume "
                     f"{vm.name!r}")
-            mounts.append((host, vm.mount_path, vm.read_only))
+            mounts.append((host, vm.mount_path,
+                           vm.read_only or vm.name in read_only))
         return mounts
 
     # -- sources -----------------------------------------------------------

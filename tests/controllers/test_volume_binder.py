@@ -1,0 +1,229 @@
+"""PV binder tests (reference tier: persistentvolume controller
+tests)."""
+import os
+
+import pytest
+
+from kubernetes_tpu.api import errors, types as t
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.controllers.volume import PersistentVolumeBinder
+
+from .util import make_plane, wait_for
+
+GB = 2**30
+
+
+def mk_pv(name, storage=10 * GB, sc="", path="/data", reclaim=t.RECLAIM_RETAIN):
+    return t.PersistentVolume(
+        metadata=ObjectMeta(name=name),
+        spec=t.PersistentVolumeSpec(
+            capacity={"storage": float(storage)}, storage_class_name=sc,
+            host_path=t.HostPathVolume(path=path),
+            persistent_volume_reclaim_policy=reclaim))
+
+
+def mk_pvc(name, storage=5 * GB, sc=""):
+    return t.PersistentVolumeClaim(
+        metadata=ObjectMeta(name=name, namespace="default"),
+        spec=t.PersistentVolumeClaimSpec(
+            storage_class_name=sc,
+            resources=t.ResourceRequirements(
+                requests={"storage": float(storage)})))
+
+
+@pytest.mark.asyncio
+async def test_static_binding_best_fit():
+    reg, client, factory = make_plane()
+    await client.create(mk_pv("big", storage=100 * GB))
+    await client.create(mk_pv("small", storage=10 * GB))
+    ctl = PersistentVolumeBinder(client, factory)
+    await ctl.start()
+    try:
+        await client.create(mk_pvc("claim"))
+
+        def bound():
+            pvc = reg.get("persistentvolumeclaims", "default", "claim")
+            return pvc if pvc.status.phase == t.PVC_BOUND else None
+        pvc = await wait_for(bound)
+        assert pvc.spec.volume_name == "small"      # best fit
+        pv = reg.get("persistentvolumes", "", "small")
+        assert pv.status.phase == t.PV_BOUND
+        assert pv.spec.claim_ref.name == "claim"
+        # The other volume stays available.
+        assert reg.get("persistentvolumes", "", "big").status.phase == \
+            t.PV_AVAILABLE
+    finally:
+        await ctl.stop()
+
+
+@pytest.mark.asyncio
+async def test_pvc_waits_then_binds_when_pv_appears():
+    reg, client, factory = make_plane()
+    ctl = PersistentVolumeBinder(client, factory)
+    await ctl.start()
+    try:
+        await client.create(mk_pvc("claim"))
+        await wait_for(lambda: reg.get("persistentvolumeclaims", "default",
+                                       "claim").status.phase == t.PVC_PENDING
+                       or True)
+        await client.create(mk_pv("late"))
+        await wait_for(lambda: reg.get("persistentvolumeclaims", "default",
+                                       "claim").status.phase == t.PVC_BOUND)
+    finally:
+        await ctl.stop()
+
+
+@pytest.mark.asyncio
+async def test_dynamic_hostpath_provisioning(tmp_path):
+    reg, client, factory = make_plane()
+    await client.create(t.StorageClass(
+        metadata=ObjectMeta(name="fast"),
+        provisioner=t.PROVISIONER_HOSTPATH,
+        reclaim_policy=t.RECLAIM_DELETE,
+        parameters={"base_dir": str(tmp_path)}))
+    ctl = PersistentVolumeBinder(client, factory)
+    await ctl.start()
+    try:
+        await client.create(mk_pvc("dyn", sc="fast"))
+
+        def bound():
+            pvc = reg.get("persistentvolumeclaims", "default", "dyn")
+            return pvc if pvc.status.phase == t.PVC_BOUND else None
+        pvc = await wait_for(bound)
+        pv = reg.get("persistentvolumes", "", pvc.spec.volume_name)
+        path = pv.spec.host_path.path
+        assert path.startswith(str(tmp_path)) and os.path.isdir(path)
+
+        # Delete reclaim: PVC deletion removes the PV and its directory.
+        await client.delete("persistentvolumeclaims", "default", "dyn")
+        def gone():
+            try:
+                reg.get("persistentvolumes", "", pv.metadata.name)
+                return False
+            except errors.NotFoundError:
+                return True
+        await wait_for(gone)
+        await wait_for(lambda: not os.path.exists(path))
+    finally:
+        await ctl.stop()
+
+
+@pytest.mark.asyncio
+async def test_retain_releases_but_never_rebinds():
+    reg, client, factory = make_plane()
+    await client.create(mk_pv("keep", reclaim=t.RECLAIM_RETAIN))
+    ctl = PersistentVolumeBinder(client, factory)
+    await ctl.start()
+    try:
+        await client.create(mk_pvc("a"))
+        await wait_for(lambda: reg.get("persistentvolumeclaims", "default",
+                                       "a").status.phase == t.PVC_BOUND)
+        await client.delete("persistentvolumeclaims", "default", "a")
+        await wait_for(lambda: reg.get("persistentvolumes", "", "keep")
+                       .status.phase == t.PV_RELEASED)
+        # A new claim must NOT grab the released (dirty) volume.
+        await client.create(mk_pvc("b"))
+        import asyncio
+        await asyncio.sleep(0.5)
+        assert reg.get("persistentvolumeclaims", "default", "b") \
+            .status.phase == t.PVC_PENDING
+    finally:
+        await ctl.stop()
+
+
+@pytest.mark.asyncio
+async def test_string_quantities_parse():
+    reg, client, factory = make_plane()
+    pv = mk_pv("q", storage=0)
+    pv.spec.capacity = {"storage": "10Gi"}
+    await client.create(pv)
+    pvc = mk_pvc("q", storage=0)
+    pvc.spec.resources.requests = {"storage": "5Gi"}
+    ctl = PersistentVolumeBinder(client, factory)
+    await ctl.start()
+    try:
+        await client.create(pvc)
+        await wait_for(lambda: reg.get("persistentvolumeclaims", "default",
+                                       "q").status.phase == t.PVC_BOUND)
+    finally:
+        await ctl.stop()
+
+
+@pytest.mark.asyncio
+async def test_half_finished_bind_resumes_on_reserved_pv():
+    """Crash recovery: PV carries claim_ref but the PVC was never
+    updated — the next sync completes THAT bind instead of forking."""
+    reg, client, factory = make_plane()
+    pvc = await client.create(mk_pvc("c"))
+    pv = mk_pv("reserved")
+    pv.spec.claim_ref = t.ObjectReference(
+        kind="PersistentVolumeClaim", namespace="default", name="c",
+        uid=pvc.metadata.uid)
+    await client.create(pv)
+    await client.create(mk_pv("fresh"))
+    ctl = PersistentVolumeBinder(client, factory)
+    await ctl.start()
+    try:
+        def bound():
+            got = reg.get("persistentvolumeclaims", "default", "c")
+            return got if got.status.phase == t.PVC_BOUND else None
+        got = await wait_for(bound)
+        assert got.spec.volume_name == "reserved"
+        assert reg.get("persistentvolumes", "", "fresh").spec.claim_ref is None
+    finally:
+        await ctl.stop()
+
+
+@pytest.mark.asyncio
+async def test_orphan_scan_releases_missed_deletions():
+    """A PVC deleted while the controller was down still releases its
+    PV (periodic reconcile, not just the informer delete event)."""
+    reg, client, factory = make_plane()
+    pvc = await client.create(mk_pvc("gone"))
+    pv = mk_pv("held", reclaim=t.RECLAIM_RETAIN)
+    pv.spec.claim_ref = t.ObjectReference(
+        kind="PersistentVolumeClaim", namespace="default", name="gone",
+        uid=pvc.metadata.uid)
+    await client.create(pv)
+    got = reg.get("persistentvolumes", "", "held")
+    got.status.phase = t.PV_BOUND
+    reg.update(got, subresource="status")
+    reg.delete("persistentvolumeclaims", "default", "gone")
+
+    ctl = PersistentVolumeBinder(client, factory, resync_seconds=0.2)
+    await ctl.start()
+    try:
+        await wait_for(lambda: reg.get("persistentvolumes", "", "held")
+                       .status.phase == t.PV_RELEASED)
+        assert reg.get("persistentvolumes", "", "held").spec.claim_ref is None
+    finally:
+        await ctl.stop()
+
+
+@pytest.mark.asyncio
+async def test_explicit_volume_name_never_substituted(tmp_path):
+    """A claim pinned to a named volume waits for it — never silently
+    provisioned a substitute, even with a provisioning storage class."""
+    import asyncio
+    reg, client, factory = make_plane()
+    await client.create(t.StorageClass(
+        metadata=ObjectMeta(name="fast"), provisioner=t.PROVISIONER_HOSTPATH,
+        parameters={"base_dir": str(tmp_path)}))
+    pvc = mk_pvc("pinned", sc="fast")
+    pvc.spec.volume_name = "my-pv"
+    ctl = PersistentVolumeBinder(client, factory)
+    await ctl.start()
+    try:
+        await client.create(pvc)
+        await asyncio.sleep(0.5)
+        got = reg.get("persistentvolumeclaims", "default", "pinned")
+        assert got.status.phase == t.PVC_PENDING
+        assert got.spec.volume_name == "my-pv"
+        pvs, _ = reg.list("persistentvolumes")
+        assert pvs == [], "provisioned a substitute for a pinned claim"
+        # The named volume appears -> binds.
+        await client.create(mk_pv("my-pv", sc="fast"))
+        await wait_for(lambda: reg.get("persistentvolumeclaims", "default",
+                                       "pinned").status.phase == t.PVC_BOUND)
+    finally:
+        await ctl.stop()
