@@ -25,6 +25,15 @@ from kubernetes_tpu.perf.density import run_density  # noqa: E402
 def main() -> None:
     try:
         sched = asyncio.run(run_density(n_nodes=100, n_pods=3000))
+        # REST-path density: same flow through the real HTTP apiserver
+        # (JSON serde + chunked watch streams), at a size that keeps
+        # bench wall-time modest; the full 30k/1000 via-REST figure is
+        # `python -m kubernetes_tpu.perf.density 1000 30000 rest`.
+        try:
+            sched["rest"] = asyncio.run(
+                run_density(n_nodes=200, n_pods=2000, via="rest"))
+        except Exception as exc:  # noqa: BLE001
+            sched["rest"] = {"error": str(exc)[:200]}
         sched_line = {
             "metric": "scheduler_pod_throughput",
             "value": sched["pods_per_second"],

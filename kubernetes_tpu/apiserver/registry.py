@@ -168,7 +168,9 @@ class Registry:
         self._by_plural: dict[str, ResourceSpec] = {}
         self._by_kind: dict[str, ResourceSpec] = {}
         self.service_cidr = "10.96.0.0/16"
-        self.cluster_cidr = "10.64.0.0/16"
+        #: /12 -> 4096 node /24 blocks (reference-scale kubemark fleets
+        #: run 1000+ hollow nodes; a /16's 256 blocks exhaust there).
+        self.cluster_cidr = "10.64.0.0/12"
         self._svc_ips = None     # lazy ServiceIPAllocator
         self._node_cidrs = None  # lazy CIDRAllocator
         for spec in builtin_resources():

@@ -65,6 +65,8 @@ class APIServer:
         self.authorizer = authorizer
         self.user_groups = user_groups or {}
         self.audit = audit
+        #: Requests slower than this log a slow-op line (SLO: 1s p99).
+        self.slow_request_threshold = 1.0
         self.app = web.Application(middlewares=[self._middleware])
         self._routes()
         self._runner: Optional[web.AppRunner] = None
@@ -110,6 +112,12 @@ class APIServer:
             plural = request.match_info.get("plural", "-")
             REQUEST_LATENCY.observe(elapsed, verb=request.method,
                                     resource=plural)
+            if elapsed > self.slow_request_threshold \
+                    and request.query.get("watch") not in ("1", "true"):
+                # utiltrace-style slow-op line (the reference's 1s API
+                # latency SLO is the bar worth logging against).
+                log.info("slow request: %s %s took %.1fms (code %d)",
+                         request.method, request.path, 1e3 * elapsed, code)
             if self.audit is not None and attrs is not None:
                 await self._audit(request, attrs, code, elapsed)
 

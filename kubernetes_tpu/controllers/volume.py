@@ -60,10 +60,17 @@ class PersistentVolumeBinder(Controller):
             on_add=self.enqueue_obj,
             on_update=lambda o, n: self.enqueue_obj(n),
             on_delete=self._pvc_gone)
-        # A PV turning Available can unblock pending claims.
+        # Only transitions that can UNBLOCK a claim re-enqueue pending
+        # claims — the binder's own per-bind writes must not trigger
+        # O(claims^2) churn during a provisioning burst.
         self.pv_informer.add_handlers(
             on_add=lambda pv: self._enqueue_pending_claims(),
-            on_update=lambda o, n: self._enqueue_pending_claims())
+            on_update=lambda o, n: (
+                self._enqueue_pending_claims()
+                if (o.status.phase != t.PV_AVAILABLE
+                    and n.status.phase == t.PV_AVAILABLE)
+                or (o.spec.claim_ref is not None
+                    and n.spec.claim_ref is None) else None))
         self._resync_task: Optional[asyncio.Task] = None
 
     async def on_start(self) -> None:
@@ -213,9 +220,11 @@ class PersistentVolumeBinder(Controller):
             if ref is None or ref.uid in claims_by_uid:
                 continue
             try:
-                await self.client.get("persistentvolumeclaims",
-                                      ref.namespace, ref.name)
-                continue  # live read says it exists; informer lag
+                got = await self.client.get("persistentvolumeclaims",
+                                            ref.namespace, ref.name)
+                if got.metadata.uid == ref.uid:
+                    continue  # truly live; informer lag
+                # Same name, NEW claim: the bound one is still gone.
             except errors.NotFoundError:
                 pass
             await self._release_pv(pv)

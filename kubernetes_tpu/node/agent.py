@@ -425,6 +425,9 @@ class NodeAgent:
         cmap = self._containers.setdefault(key, {})
         rcounts = self._restart_counts.setdefault(key, {})
         rat = self._restart_at.setdefault(key, {})
+        if not await self._ensure_init_containers(pod, statuses, cmap,
+                                                  rcounts, rat):
+            return  # still initializing; main containers wait
         for container in pod.spec.containers:
             cid = cmap.get(container.name)
             st = statuses.get(cid) if cid else None
@@ -454,6 +457,46 @@ class NodeAgent:
                 # must not accumulate across restarts.
                 await self.runtime.remove_container(st.id)
             await self._start_container(pod, container, cmap)
+
+    async def _ensure_init_containers(self, pod: t.Pod,
+                                      statuses: dict[str, RtStatus],
+                                      cmap: dict[str, str],
+                                      rcounts: dict[str, int],
+                                      rat: dict[str, float]) -> bool:
+        """Run init containers SEQUENTIALLY to completion before any
+        main container starts (reference: kubelet computePodActions'
+        nextInitContainerToStart). Returns True once all succeeded.
+        A failed init container restarts with crash-loop backoff unless
+        restart_policy is Never (then the pod fails on status calc)."""
+        for container in pod.spec.init_containers:
+            cid = cmap.get(container.name)
+            st = statuses.get(cid) if cid else None
+            if st is None:
+                await self._start_container(pod, container, cmap)
+                return False
+            if st.state == STATE_RUNNING:
+                return False  # wait for it
+            if st.exit_code == 0:
+                continue  # done; next init container
+            if pod.spec.restart_policy == t.RESTART_NEVER:
+                return False  # terminal; phase calc reports Failed
+            n = rcounts.get(container.name, 0)
+            delay = min(0.5 * (2 ** n), 60.0)
+            nxt = rat.get(container.name, 0.0)
+            if nxt == 0.0:
+                rat[container.name] = time.time() + delay
+                return False
+            if time.time() < nxt:
+                return False
+            rcounts[container.name] = n + 1
+            rat[container.name] = 0.0
+            self.recorder.event(pod, "Normal", "Restarting",
+                                f"init container {container.name} "
+                                f"(count {n + 1})")
+            await self.runtime.remove_container(st.id)
+            await self._start_container(pod, container, cmap)
+            return False
+        return True
 
     async def _start_container(self, pod: t.Pod, container: t.Container,
                                cmap: dict[str, str]) -> None:
@@ -528,8 +571,9 @@ class NodeAgent:
         if pod.metadata.uid in self._evicted:
             return  # terminal Evicted status must never be overwritten
         cmap = self._containers.get(key, {})
-        cstatuses: list[t.ContainerStatus] = []
-        for container in pod.spec.containers:
+
+        def status_of(container: t.Container,
+                      waiting_reason: str) -> t.ContainerStatus:
             cid = cmap.get(container.name)
             st = statuses.get(cid) if cid else None
             cs = t.ContainerStatus(name=container.name, image=container.image,
@@ -537,7 +581,7 @@ class NodeAgent:
                                    restart_count=self._restart_counts
                                    .get(key, {}).get(container.name, 0))
             if st is None:
-                cs.state.waiting = t.ContainerStateWaiting(reason="ContainerCreating")
+                cs.state.waiting = t.ContainerStateWaiting(reason=waiting_reason)
             elif st.state == STATE_RUNNING:
                 ready = self.probes.is_ready(key, container.name)
                 cs.state.running = t.ContainerStateRunning()
@@ -547,8 +591,27 @@ class NodeAgent:
                     exit_code=st.exit_code,
                     reason="Completed" if st.exit_code == 0 else "Error",
                     message=st.message)
-            cstatuses.append(cs)
-        phase = self._compute_phase(pod, cstatuses)
+            return cs
+
+        istatuses = [status_of(c, "PodInitializing")
+                     for c in pod.spec.init_containers]
+        initialized = all(cs.state.terminated is not None
+                          and cs.state.terminated.exit_code == 0
+                          for cs in istatuses)
+        init_failed_terminally = (
+            pod.spec.restart_policy == t.RESTART_NEVER
+            and any(cs.state.terminated is not None
+                    and cs.state.terminated.exit_code != 0
+                    for cs in istatuses))
+        cstatuses = [status_of(
+            c, "ContainerCreating" if initialized else "PodInitializing")
+            for c in pod.spec.containers]
+        if init_failed_terminally:
+            phase = t.POD_FAILED
+        elif not initialized:
+            phase = t.POD_PENDING
+        else:
+            phase = self._compute_phase(pod, cstatuses)
         all_ready = bool(cstatuses) and all(
             cs.ready or cs.state.terminated is not None for cs in cstatuses)
 
@@ -573,7 +636,17 @@ class NodeAgent:
                 c.restart_count) for c in cstatuses]
         if old != new:
             changed = True
+        old_init = [(c.name, bool(c.state.terminated), c.restart_count)
+                    for c in cur.status.init_container_statuses]
+        new_init = [(c.name, bool(c.state.terminated), c.restart_count)
+                    for c in istatuses]
+        if old_init != new_init:
+            changed = True
         cur.status.container_statuses = cstatuses
+        cur.status.init_container_statuses = istatuses
+        changed |= t.update_pod_condition(cur.status, t.PodCondition(
+            type=t.COND_POD_INITIALIZED,
+            status="True" if initialized else "False"))
         changed |= t.update_pod_condition(cur.status, t.PodCondition(
             type=t.COND_POD_READY, status="True" if all_ready else "False"))
         changed |= t.update_pod_condition(cur.status, t.PodCondition(

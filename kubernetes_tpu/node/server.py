@@ -48,6 +48,10 @@ class NodeAgentServer:
         r.add_get("/logs/{namespace}/{pod}/{container}", self._logs)
         r.add_get("/stats/summary", self._summary)
         r.add_get("/metrics", self._metrics)
+        # /debug/pprof analog (server.go:295-403): live task + thread
+        # stack dumps for hung-agent diagnosis.
+        r.add_get("/debug/tasks", self._debug_tasks)
+        r.add_get("/debug/stacks", self._debug_stacks)
         self._runner: Optional[web.AppRunner] = None
         self.port: Optional[int] = None
 
@@ -106,6 +110,26 @@ class NodeAgentServer:
     async def _metrics(self, request):
         await self._collect()  # refresh chip gauges on scrape
         return web.Response(text=METRICS.render(), content_type="text/plain")
+
+    async def _debug_tasks(self, request):
+        import asyncio
+        lines = []
+        for task in asyncio.all_tasks():
+            coro = task.get_coro()
+            lines.append(f"{task.get_name()}: "
+                         f"{getattr(coro, '__qualname__', coro)} "
+                         f"{'done' if task.done() else 'running'}")
+        return web.Response(text="\n".join(sorted(lines)) + "\n")
+
+    async def _debug_stacks(self, request):
+        import sys
+        import traceback
+        out = []
+        for thread_id, frame in sys._current_frames().items():
+            out.append(f"--- thread {thread_id} ---")
+            out.extend(line.rstrip()
+                       for line in traceback.format_stack(frame))
+        return web.Response(text="\n".join(out) + "\n")
 
     # -- lifecycle ---------------------------------------------------------
 

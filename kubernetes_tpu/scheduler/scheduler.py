@@ -25,6 +25,7 @@ from ..api.scheme import deepcopy
 from ..client.informer import SharedInformer
 from ..client.interface import Client
 from ..client.record import EventRecorder
+from ..util.trace import Trace
 from . import metrics as m
 from .cache import SchedulerCache
 from .gang import GangFailure, GangPlan, plan_gang
@@ -49,6 +50,10 @@ class Scheduler:
         self._stopped = False
         self._bind_sem = asyncio.Semaphore(64)
         self._bind_tasks: set[asyncio.Task] = set()
+        #: Placements slower than this log an op trace (utiltrace
+        #: LogIfLong threshold; the reference uses 100ms).
+        self.trace_threshold = 0.1
+        self._ring_offset = 0
 
     # -- wiring (reference: factory.go:137 NewConfigFactory) --------------
 
@@ -155,10 +160,16 @@ class Scheduler:
                 or self.cache.knows_pod(key)):
             return
 
+        # Op trace (reference: generic_scheduler.go:110-141 utiltrace) —
+        # logged only when this placement ran long.
+        trace = Trace("schedule-one", pod=key)
         node_name, bindings, reasons = self._find_placement(pod)
+        trace.step("placement computed")
         m.ALGORITHM_LATENCY.observe(time.perf_counter() - start)
         if node_name is None:
             await self._handle_unschedulable(pod, reasons)
+            trace.step("handled unschedulable")
+            trace.log_if_long(self.trace_threshold)
             return
 
         assumed = deepcopy(pod)
@@ -167,6 +178,8 @@ class Scheduler:
                 if b.name == claim.name:
                     claim.assigned = list(b.chip_ids)
         self.cache.assume_pod(assumed, node_name)
+        trace.step("assumed in cache")
+        trace.log_if_long(self.trace_threshold)
 
         # Bind asynchronously (reference: scheduler.go:484-495 binds in a
         # goroutine) so the next pod's placement overlaps this pod's RPC;
@@ -211,8 +224,21 @@ class Scheduler:
         chip_choices: dict[str, list] = {}
         bindings_by_node: dict[str, list] = {}
         wants_tpu = bool(pod.spec.tpu_resources)
-        for name, info in self.cache.nodes.items():
-            if info.node is None:
+        # Node sampling (reference: percentageOfNodesToScore +
+        # equivalence of findNodesThatFit's numFeasibleNodesToFind): at
+        # fleet scale, stop once enough feasible nodes are collected
+        # instead of scanning everything per pod. TPU pods always scan
+        # fully — chip geometry makes every node's answer distinct.
+        # A rotating start offset spreads load across the fleet.
+        names = list(self.cache.nodes)  # insertion-order snapshot; the
+        n = len(names)                  # ring offset does the spreading
+        enough = n if (wants_tpu or n <= 100) else max(100, n // 20)
+        start_at = self._ring_offset % n if n else 0
+        self._ring_offset += 1
+        for idx in range(n):
+            name = names[(start_at + idx) % n]
+            info = self.cache.nodes.get(name)
+            if info is None or info.node is None:
                 continue
             res = run_predicates(pod, info, skip_tpu=True)
             if not res.fits:
@@ -228,6 +254,8 @@ class Scheduler:
                 bindings_by_node[name] = bindings
                 chip_choices[name] = [cid for b in bindings for cid in b.chip_ids]
             feasible.append(info)
+            if len(feasible) >= enough:
+                break
         if not feasible:
             return None, None, reasons
         sibling_counts = self._sibling_counts(pod)

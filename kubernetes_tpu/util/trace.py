@@ -1,0 +1,58 @@
+"""Operation tracing — log slow multi-step operations with timings.
+
+Reference: ``apiserver/pkg/util/trace/trace.go:33-79`` — create a Trace
+at the top of an operation, mark steps as they complete, and
+``LogIfLong`` emits one structured line (total + per-step durations)
+ONLY when the operation exceeded its threshold. Used by the reference
+scheduler (``generic_scheduler.go:110-141``) and apiserver handlers;
+wired the same way here.
+"""
+from __future__ import annotations
+
+import logging
+import time
+from typing import Optional
+
+log = logging.getLogger("trace")
+
+
+class Trace:
+    def __init__(self, name: str, **fields):
+        self.name = name
+        self.fields = fields
+        self.start = time.perf_counter()
+        self.steps: list[tuple[float, str]] = []
+
+    def step(self, msg: str) -> None:
+        self.steps.append((time.perf_counter(), msg))
+
+    def total_seconds(self) -> float:
+        return time.perf_counter() - self.start
+
+    def log_if_long(self, threshold: float,
+                    logger: Optional[logging.Logger] = None) -> bool:
+        """One line with per-step splits when total > threshold.
+        Returns whether it logged (tests hook this)."""
+        total = self.total_seconds()
+        if total <= threshold:
+            return False
+        parts = []
+        prev = self.start
+        for ts, msg in self.steps:
+            parts.append(f"{msg} {1e3 * (ts - prev):.1f}ms")
+            prev = ts
+        tail = 1e3 * (self.start + total - prev)
+        if self.steps and tail > 0.05:
+            parts.append(f"(rest) {tail:.1f}ms")
+        ctx = " ".join(f"{k}={v}" for k, v in self.fields.items())
+        (logger or log).info("Trace %r%s (%.1fms): %s", self.name,
+                             f" [{ctx}]" if ctx else "", 1e3 * total,
+                             "; ".join(parts) or "no steps")
+        return True
+
+    def __enter__(self) -> "Trace":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        # Context-manager use defaults to a 100ms threshold.
+        self.log_if_long(0.1)

@@ -1,0 +1,105 @@
+"""Init container tests (reference: kubelet computePodActions
+nextInitContainerToStart semantics)."""
+import pytest
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.node.agent import NodeAgent
+from kubernetes_tpu.node.runtime import FakeRuntime
+
+from tests.controllers.util import make_plane, wait_for
+
+
+def mk_pod(name, restart="Always"):
+    return t.Pod(
+        metadata=ObjectMeta(name=name, namespace="default"),
+        spec=t.PodSpec(
+            restart_policy=restart, node_name="n0",
+            init_containers=[t.Container(name="init-a", image="i"),
+                             t.Container(name="init-b", image="i")],
+            containers=[t.Container(name="main", image="i")]))
+
+
+async def start_agent(client):
+    agent = NodeAgent(client, "n0", FakeRuntime(), status_interval=5.0,
+                      heartbeat_interval=5.0, pleg_interval=0.05,
+                      server_port=None)
+    await agent.start()
+    return agent
+
+
+def running_container(rt, name):
+    for st in rt._status.values():
+        if st.name == name and st.state == "running":
+            return st
+    return None
+
+
+@pytest.mark.asyncio
+async def test_init_containers_run_sequentially_then_main():
+    reg, client, _ = make_plane()
+    agent = await start_agent(client)
+    rt = agent.runtime
+    try:
+        await client.create(mk_pod("p"))
+        # init-a starts; init-b and main must NOT.
+        st_a = await wait_for(lambda: running_container(rt, "init-a"))
+        assert running_container(rt, "init-b") is None
+        assert running_container(rt, "main") is None
+        pod = reg.get("pods", "default", "p")
+        assert pod.status.phase == t.POD_PENDING
+
+        rt.exit_container(st_a.id, 0)
+        st_b = await wait_for(lambda: running_container(rt, "init-b"))
+        assert running_container(rt, "main") is None
+        rt.exit_container(st_b.id, 0)
+        await wait_for(lambda: running_container(rt, "main"))
+
+        def initialized():
+            pod = reg.get("pods", "default", "p")
+            cond = t.get_pod_condition(pod.status, t.COND_POD_INITIALIZED)
+            return (pod.status.phase == t.POD_RUNNING and cond
+                    and cond.status == "True")
+        await wait_for(initialized)
+        pod = reg.get("pods", "default", "p")
+        assert len(pod.status.init_container_statuses) == 2
+        assert all(c.state.terminated.exit_code == 0
+                   for c in pod.status.init_container_statuses)
+    finally:
+        await agent.stop()
+
+
+@pytest.mark.asyncio
+async def test_failed_init_restarts_with_backoff():
+    reg, client, _ = make_plane()
+    agent = await start_agent(client)
+    rt = agent.runtime
+    try:
+        await client.create(mk_pod("p"))
+        st_a = await wait_for(lambda: running_container(rt, "init-a"))
+        rt.exit_container(st_a.id, 1)
+        # restarted (new cid), main still absent
+        def restarted():
+            st = running_container(rt, "init-a")
+            return st if st and st.id != st_a.id else None
+        await wait_for(restarted, timeout=10.0)
+        assert running_container(rt, "main") is None
+    finally:
+        await agent.stop()
+
+
+@pytest.mark.asyncio
+async def test_failed_init_with_never_fails_pod():
+    reg, client, _ = make_plane()
+    agent = await start_agent(client)
+    rt = agent.runtime
+    try:
+        await client.create(mk_pod("p", restart="Never"))
+        st_a = await wait_for(lambda: running_container(rt, "init-a"))
+        rt.exit_container(st_a.id, 7)
+        await wait_for(lambda: reg.get("pods", "default", "p")
+                       .status.phase == t.POD_FAILED)
+        assert running_container(rt, "main") is None
+        assert running_container(rt, "init-b") is None
+    finally:
+        await agent.stop()

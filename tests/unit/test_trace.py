@@ -1,0 +1,31 @@
+"""Op-trace tests (reference: trace_test.go)."""
+import logging
+import time
+
+from kubernetes_tpu.util.trace import Trace
+
+
+def test_trace_logs_only_when_slow(caplog):
+    with caplog.at_level(logging.INFO, logger="trace"):
+        tr = Trace("fast-op", pod="default/p")
+        tr.step("a")
+        assert not tr.log_if_long(10.0)      # fast: silent
+        assert caplog.records == []
+
+        tr2 = Trace("slow-op", pod="default/q")
+        time.sleep(0.02)
+        tr2.step("phase one")
+        time.sleep(0.01)
+        tr2.step("phase two")
+        assert tr2.log_if_long(0.001)
+        msg = caplog.records[-1].getMessage()
+        assert "slow-op" in msg and "phase one" in msg and "phase two" in msg
+        assert "default/q" in msg
+
+
+def test_trace_context_manager(caplog):
+    with caplog.at_level(logging.INFO, logger="trace"):
+        with Trace("ctx-op") as tr:
+            time.sleep(0.12)
+            tr.step("work")
+        assert any("ctx-op" in r.getMessage() for r in caplog.records)
