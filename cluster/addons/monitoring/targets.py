@@ -1,0 +1,25 @@
+#!/usr/bin/env python3
+"""Regenerate Prometheus file_sd targets from Node daemon endpoints.
+
+Usage: ktl get nodes -o json | python3 targets.py > node-targets.json
+"""
+import json
+import sys
+
+
+def main() -> None:
+    doc = json.load(sys.stdin)
+    targets = []
+    for node in doc.get("items", []):
+        port = (node.get("status", {})
+                .get("daemon_endpoints", {}).get("agent"))
+        addrs = node.get("status", {}).get("addresses", [])
+        if not port or not addrs:
+            continue
+        targets.append(f"{addrs[0]['address']}:{port}")
+    print(json.dumps([{"labels": {"job": "ktpu-node-agents"},
+                       "targets": sorted(targets)}], indent=2))
+
+
+if __name__ == "__main__":
+    main()

@@ -857,6 +857,24 @@ class PersistentVolumeClaim(TypedObject):
         default_factory=PersistentVolumeClaimStatus)
 
 
+#: Secret type carrying a service-account bearer token (reference:
+#: ``SecretTypeServiceAccountToken``).
+SECRET_TYPE_SA_TOKEN = "kubernetes-tpu/service-account-token"
+
+
+@dataclass
+class ServiceAccount(TypedObject):
+    """Workload identity (reference: core/v1 ServiceAccount). RBAC
+    subjects use the encoded user name
+    ``system:serviceaccount:<namespace>:<name>``."""
+    secrets: list[str] = field(default_factory=list)
+    automount_token: bool = True
+
+
+def service_account_user(namespace: str, name: str) -> str:
+    return f"system:serviceaccount:{namespace}:{name}"
+
+
 @dataclass
 class StorageClass(TypedObject):
     provisioner: str = ""
@@ -879,6 +897,7 @@ for _kind, _cls in [
     ("Lease", Lease), ("PodGroup", PodGroup), ("List", ObjectList),
     ("PersistentVolume", PersistentVolume),
     ("PersistentVolumeClaim", PersistentVolumeClaim),
+    ("ServiceAccount", ServiceAccount),
 ]:
     DEFAULT_SCHEME.register(CORE_V1, _kind, _cls)
 

@@ -207,10 +207,52 @@ class ResourceQuotaPlugin(AdmissionPlugin):
             f"quota {quota_name!r}: too much contention charging usage")
 
 
+class ServiceAccountPlugin(AdmissionPlugin):
+    """Default pods to the "default" ServiceAccount and mount its token
+    secret (reference: ``plugin/pkg/admission/serviceaccount`` — it also
+    rejects pods whose SA does not exist; here a missing SA just skips
+    the mount, because the default SA is created asynchronously by the
+    controller and workload pods must not race it)."""
+
+    name = "ServiceAccount"
+    MOUNT_PATH = "/var/run/secrets/kubernetes-tpu/serviceaccount"
+    VOLUME = "ktpu-sa-token"
+
+    def __init__(self, registry):
+        self.registry = registry
+
+    def admit(self, op, spec, obj, old):
+        if spec.kind != "Pod" or op != "CREATE":
+            return obj
+        pod: t.Pod = obj
+        if not pod.spec.service_account_name:
+            pod.spec.service_account_name = "default"
+        try:
+            sa = self.registry.get("serviceaccounts",
+                                   pod.metadata.namespace,
+                                   pod.spec.service_account_name)
+        except errors.NotFoundError:
+            return obj
+        if not sa.automount_token or not sa.secrets:
+            return obj
+        if any(v.name == self.VOLUME for v in pod.spec.volumes):
+            return obj
+        pod.spec.volumes.append(t.Volume(
+            name=self.VOLUME,
+            secret=t.SecretVolume(secret_name=sa.secrets[0])))
+        for c in pod.spec.containers + pod.spec.init_containers:
+            if not any(m.name == self.VOLUME for m in c.volume_mounts):
+                c.volume_mounts.append(t.VolumeMount(
+                    name=self.VOLUME, mount_path=self.MOUNT_PATH,
+                    read_only=True))
+        return obj
+
+
 def default_chain(registry: "Registry") -> AdmissionChain:
     return AdmissionChain([
         NamespaceLifecycle(registry),
         TpuResourceDefaulter(),
         PriorityResolver(registry),
+        ServiceAccountPlugin(registry),
         ResourceQuotaPlugin(registry),
     ])

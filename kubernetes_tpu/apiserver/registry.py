@@ -143,6 +143,8 @@ def builtin_resources() -> list[ResourceSpec]:
                      has_status=False),
         ResourceSpec("clusterrolebindings", "ClusterRoleBinding", r.RBAC_V1,
                      r.ClusterRoleBinding, namespaced=False, has_status=False),
+        ResourceSpec("serviceaccounts", "ServiceAccount", core,
+                     t.ServiceAccount, has_status=False),
         ResourceSpec("persistentvolumes", "PersistentVolume", core,
                      t.PersistentVolume, namespaced=False),
         ResourceSpec("persistentvolumeclaims", "PersistentVolumeClaim", core,

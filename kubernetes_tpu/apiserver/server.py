@@ -67,6 +67,18 @@ class APIServer:
         self.audit = audit
         #: Requests slower than this log a slow-op line (SLO: 1s p99).
         self.slow_request_threshold = 1.0
+        #: Max concurrent non-watch requests (reference: the
+        #: max-in-flight filter in DefaultBuildHandlerChain); beyond it
+        #: requests get 429 and clients back off.
+        self.max_inflight = 400
+        self._inflight = 0
+        #: token -> (namespace, sa name) reverse index over SA token
+        #: Secrets, rebuilt at most every ttl seconds — O(1) lookups,
+        #: bounded by the number of SA secrets (unknown tokens cost a
+        #: dict miss, never a scan or a cache entry).
+        self._sa_index: dict[str, tuple[str, str]] = {}
+        self._sa_index_at = float("-inf")
+        self.sa_index_ttl = 10.0
         self.app = web.Application(middlewares=[self._middleware])
         self._routes()
         self._runner: Optional[web.AppRunner] = None
@@ -81,15 +93,27 @@ class APIServer:
         if self.tokens is not None and not request.path.startswith(("/healthz", "/readyz", "/version")):
             auth = request.headers.get("Authorization", "")
             token = auth[7:] if auth.startswith("Bearer ") else ""
-            user = self.tokens.get(token)
+            user = self.tokens.get(token) or self._sa_user(token)
             if user is None:
                 return self._err(errors.UnauthorizedError("invalid or missing bearer token"))
             request["user"] = user
         attrs = self._attributes(request)
+        is_watch = request.query.get("watch") in ("1", "true")
         import time
         start = time.perf_counter()
         code = 500
+        admitted = False
         try:
+            if not is_watch:
+                # Max-in-flight INSIDE the try block: 429s must reach
+                # the latency metric + audit log — overload is exactly
+                # when telemetry matters.
+                if self._inflight >= self.max_inflight:
+                    raise errors.TooManyRequestsError(
+                        f"too many requests in flight "
+                        f"({self.max_inflight}); retry")
+                self._inflight += 1
+                admitted = True
             if attrs is not None and self.authorizer is not None \
                     and not self.authorizer.authorize(attrs):
                 resp = self._err(errors.ForbiddenError(f"forbidden: {attrs}"))
@@ -108,6 +132,8 @@ class APIServer:
             log.exception("handler panic on %s %s", request.method, request.path)
             return self._err(errors.StatusError(f"internal error: {e}"))
         finally:
+            if admitted:
+                self._inflight -= 1
             elapsed = time.perf_counter() - start
             plural = request.match_info.get("plural", "-")
             REQUEST_LATENCY.observe(elapsed, verb=request.method,
@@ -120,6 +146,51 @@ class APIServer:
                          request.method, request.path, 1e3 * elapsed, code)
             if self.audit is not None and attrs is not None:
                 await self._audit(request, attrs, code, elapsed)
+
+    def _sa_user(self, token: str) -> Optional[str]:
+        """Resolve a bearer against service-account token Secrets
+        (reference: the token authenticator; tokens here are opaque,
+        not JWTs). A revoked/deleted ServiceAccount's token stops
+        working: resolution requires the SA object to still exist."""
+        if not token:
+            return None
+        import time
+        now = time.monotonic()
+        if now - self._sa_index_at > self.sa_index_ttl:
+            self._rebuild_sa_index()
+            self._sa_index_at = now
+        hit = self._sa_index.get(token)
+        if hit is None:
+            return None
+        ns, sa_name = hit
+        from ..api import types as t
+        try:
+            self.registry.get("serviceaccounts", ns, sa_name)
+        except errors.StatusError:
+            return None  # SA deleted: token is dead even if the
+            #              secret GC has not caught up yet
+        return t.service_account_user(ns, sa_name)
+
+    def _rebuild_sa_index(self) -> None:
+        import base64
+        from ..api import types as t
+        index: dict[str, tuple[str, str]] = {}
+        try:
+            secrets, _rev = self.registry.list("secrets")
+        except errors.StatusError:
+            secrets = []
+        for s in secrets:
+            if s.type != t.SECRET_TYPE_SA_TOKEN:
+                continue
+            try:
+                value = base64.b64decode(
+                    s.data.get("token", ""), validate=True).decode()
+            except Exception:  # noqa: BLE001
+                continue
+            sa = s.metadata.annotations.get(
+                "kubernetes-tpu/service-account.name", "default")
+            index[value] = (s.metadata.namespace, sa)
+        self._sa_index = index
 
     def _attributes(self, request: web.Request) -> Optional[Attributes]:
         """Authorization attributes for resource requests; None for

@@ -32,6 +32,7 @@ from .nodelifecycle import NodeLifecycleController
 from .podgc import PodGCController
 from .replicaset import ReplicaSetController
 from .resourcequota import ResourceQuotaController
+from .serviceaccount import ServiceAccountController
 from .statefulset import StatefulSetController
 from .volume import PersistentVolumeBinder
 
@@ -48,6 +49,7 @@ DEFAULT_CONTROLLERS: dict[str, Callable[[Client, InformerFactory], Controller]] 
     "node-lifecycle": NodeLifecycleController,
     "node-ipam": NodeIpamController,
     "persistentvolume-binder": PersistentVolumeBinder,
+    "serviceaccount": ServiceAccountController,
     "podgc": PodGCController,
     "garbage-collector": GarbageCollector,
     "namespace": NamespaceController,

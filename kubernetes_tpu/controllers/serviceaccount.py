@@ -1,0 +1,125 @@
+"""ServiceAccount + token controllers.
+
+Reference: ``pkg/controller/serviceaccount`` — two loops: one ensures
+every Active namespace has a "default" ServiceAccount, the other mints
+a token Secret per ServiceAccount and records it in ``sa.secrets``.
+Tokens here are opaque bearer strings (not JWTs): the apiserver's authn
+resolves them against token Secrets, yielding the RBAC user
+``system:serviceaccount:<ns>:<name>``.
+"""
+from __future__ import annotations
+
+import base64
+import secrets as pysecrets
+from typing import Optional
+
+from ..api import errors, types as t
+from ..api.meta import ObjectMeta
+from ..client.informer import InformerFactory
+from ..client.interface import Client
+from .base import Controller
+
+DEFAULT_SA = "default"
+TOKEN_KEY = "token"
+
+
+class ServiceAccountController(Controller):
+    """Ensures the default ServiceAccount + a token Secret per SA."""
+
+    name = "serviceaccount-controller"
+
+    def __init__(self, client: Client, factory: InformerFactory,
+                 workers: int = 1):
+        super().__init__(client, factory, workers)
+        self.ns_informer = self.watch("namespaces")
+        self.sa_informer = self.watch("serviceaccounts")
+        self.secret_informer = self.watch("secrets")
+        self.ns_informer.add_handlers(
+            on_add=lambda ns: self.enqueue(f"ns::{ns.metadata.name}"),
+            on_update=lambda o, n: self.enqueue(f"ns::{n.metadata.name}"))
+        self.sa_informer.add_handlers(
+            on_add=lambda sa: self.enqueue(sa.key()),
+            on_update=lambda o, n: self.enqueue(n.key()),
+            # Level-triggered recreate of the default SA + revocation of
+            # the deleted SA's token secret (reference TokensController
+            # deletes tokens of deleted SAs).
+            on_delete=lambda sa: (
+                self.enqueue(f"ns::{sa.metadata.namespace}"),
+                self.enqueue(f"revoke::{sa.metadata.namespace}/"
+                             f"{sa.metadata.name}")))
+        # A deleted token secret is re-minted while its SA lives.
+        self.secret_informer.add_handlers(
+            on_delete=lambda sec: (
+                self.enqueue(f"{sec.metadata.namespace}/"
+                             f"{sec.metadata.name.removesuffix('-token')}")
+                if sec.type == t.SECRET_TYPE_SA_TOKEN
+                and sec.metadata.name.endswith("-token") else None))
+
+    async def sync(self, key: str) -> Optional[float]:
+        if key.startswith("ns::"):
+            await self._ensure_default_sa(key[4:])
+            return None
+        if key.startswith("revoke::"):
+            await self._revoke_token(key[len("revoke::"):])
+            return None
+        sa = self.sa_informer.get(key)
+        if sa is None:
+            return None
+        await self._ensure_token(sa)
+        return None
+
+    async def _revoke_token(self, sa_key: str) -> None:
+        """Delete the token secret of a deleted ServiceAccount —
+        possession of the old bearer must stop granting its identity."""
+        ns, name = sa_key.split("/", 1)
+        try:
+            await self.client.get("serviceaccounts", ns, name)
+            return  # recreated meanwhile; keep the token
+        except errors.NotFoundError:
+            pass
+        try:
+            await self.client.delete("secrets", ns, f"{name}-token")
+        except errors.NotFoundError:
+            pass
+
+    async def _ensure_default_sa(self, ns_name: str) -> None:
+        ns = self.ns_informer.get(ns_name)
+        if ns is None or ns.status.phase != t.NS_ACTIVE:
+            return
+        try:
+            await self.client.get("serviceaccounts", ns_name, DEFAULT_SA)
+        except errors.NotFoundError:
+            try:
+                await self.client.create(t.ServiceAccount(
+                    metadata=ObjectMeta(name=DEFAULT_SA, namespace=ns_name)))
+            except (errors.AlreadyExistsError, errors.ForbiddenError):
+                pass  # raced / namespace terminating
+
+    async def _ensure_token(self, sa: t.ServiceAccount) -> None:
+        ns = sa.metadata.namespace
+        secret_name = f"{sa.metadata.name}-token"
+        try:
+            await self.client.get("secrets", ns, secret_name)
+            have_secret = True
+        except errors.NotFoundError:
+            have_secret = False
+        if not have_secret:
+            token = pysecrets.token_urlsafe(32)
+            secret = t.Secret(
+                metadata=ObjectMeta(
+                    name=secret_name, namespace=ns,
+                    annotations={"kubernetes-tpu/service-account.name":
+                                 sa.metadata.name}),
+                type=t.SECRET_TYPE_SA_TOKEN,
+                data={TOKEN_KEY: base64.b64encode(token.encode()).decode(),
+                      "namespace": base64.b64encode(ns.encode()).decode()})
+            try:
+                await self.client.create(secret)
+            except (errors.AlreadyExistsError, errors.ForbiddenError):
+                pass
+        if secret_name not in sa.secrets:
+            cur = await self.client.get("serviceaccounts", ns,
+                                        sa.metadata.name)
+            if secret_name not in cur.secrets:
+                cur.secrets.append(secret_name)
+                await self.client.update(cur)

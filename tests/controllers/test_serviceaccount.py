@@ -1,0 +1,162 @@
+"""ServiceAccount controller + admission + token authn tests
+(reference tier: serviceaccounts_controller_test.go + admission)."""
+import base64
+
+import pytest
+
+from kubernetes_tpu.api import errors, rbac, types as t
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.apiserver.authz import RBACAuthorizer
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.client.rest import RESTClient
+from kubernetes_tpu.controllers.serviceaccount import (ServiceAccountController,
+                                                       TOKEN_KEY)
+
+from .util import make_plane, wait_for
+
+
+@pytest.mark.asyncio
+async def test_default_sa_and_token_created_per_namespace():
+    reg, client, factory = make_plane()
+    ctl = ServiceAccountController(client, factory)
+    await ctl.start()
+    try:
+        await client.create(t.Namespace(metadata=ObjectMeta(name="prod")))
+
+        def ready():
+            try:
+                sa = reg.get("serviceaccounts", "prod", "default")
+                sec = reg.get("secrets", "prod", "default-token")
+                return sa if sa.secrets == ["default-token"] and \
+                    sec.type == t.SECRET_TYPE_SA_TOKEN else None
+            except errors.NotFoundError:
+                return None
+        sa = await wait_for(ready)
+        sec = reg.get("secrets", "prod", "default-token")
+        token = base64.b64decode(sec.data[TOKEN_KEY]).decode()
+        assert len(token) > 20
+        # Deleted default SA is recreated (level-triggered).
+        reg.delete("serviceaccounts", "prod", "default")
+        await wait_for(lambda: _exists(reg, "serviceaccounts", "prod", "default"))
+    finally:
+        await ctl.stop()
+
+
+def _exists(reg, plural, ns, name):
+    try:
+        reg.get(plural, ns, name)
+        return True
+    except errors.NotFoundError:
+        return False
+
+
+@pytest.mark.asyncio
+async def test_admission_defaults_sa_and_mounts_token():
+    reg, client, factory = make_plane()
+    # SA + token already present (controller normally does this).
+    reg.create(t.ServiceAccount(metadata=ObjectMeta(name="default",
+                                                    namespace="default"),
+                                secrets=["default-token"]))
+    reg.create(t.Secret(metadata=ObjectMeta(name="default-token",
+                                            namespace="default"),
+                        type=t.SECRET_TYPE_SA_TOKEN,
+                        data={TOKEN_KEY: base64.b64encode(b"tok").decode()}))
+    pod = t.Pod(metadata=ObjectMeta(name="p", namespace="default"),
+                spec=t.PodSpec(containers=[t.Container(name="c", image="i")]))
+    created = await client.create(pod)
+    assert created.spec.service_account_name == "default"
+    assert any(v.name == "ktpu-sa-token" and
+               v.secret.secret_name == "default-token"
+               for v in created.spec.volumes)
+    mount = created.spec.containers[0].volume_mounts[0]
+    assert mount.read_only and "serviceaccount" in mount.mount_path
+
+
+@pytest.mark.asyncio
+async def test_sa_token_authenticates_and_rbac_grants():
+    reg, client, factory = make_plane()
+    token = "sa-bearer-token-xyz"
+    # Token resolution requires the SA object to exist (revocation).
+    reg.create(t.ServiceAccount(metadata=ObjectMeta(name="robot",
+                                                    namespace="default")))
+    reg.create(t.Secret(
+        metadata=ObjectMeta(name="robot-token", namespace="default",
+                            annotations={"kubernetes-tpu/service-account.name":
+                                         "robot"}),
+        type=t.SECRET_TYPE_SA_TOKEN,
+        data={TOKEN_KEY: base64.b64encode(token.encode()).decode()}))
+    reg.create(rbac.Role(
+        metadata=ObjectMeta(name="reader", namespace="default"),
+        rules=[rbac.PolicyRule(verbs=["list"], resources=["pods"])]))
+    reg.create(rbac.RoleBinding(
+        metadata=ObjectMeta(name="robot-reads", namespace="default"),
+        role_ref=rbac.RoleRef(kind="Role", name="reader"),
+        subjects=[rbac.Subject(
+            kind="User",
+            name=t.service_account_user("default", "robot"))]))
+
+    server = APIServer(reg, tokens={"human": "human"},
+                       authorizer=RBACAuthorizer(reg))
+    port = await server.start()
+    sa_client = RESTClient(f"http://127.0.0.1:{port}", token=token)
+    bad_client = RESTClient(f"http://127.0.0.1:{port}", token="nope")
+    try:
+        items, _ = await sa_client.list("pods", "default")
+        assert items == []
+        with pytest.raises(errors.ForbiddenError):
+            await sa_client.list("secrets", "default")
+        with pytest.raises(errors.UnauthorizedError):
+            await bad_client.list("pods", "default")
+    finally:
+        await sa_client.close()
+        await bad_client.close()
+        await server.stop()
+
+
+@pytest.mark.asyncio
+async def test_deleted_sa_token_revoked_and_secret_recreated():
+    reg, client, factory = make_plane()
+    ctl = ServiceAccountController(client, factory)
+    await ctl.start()
+    try:
+        await client.create(t.ServiceAccount(
+            metadata=ObjectMeta(name="robot", namespace="default")))
+        await wait_for(lambda: _exists(reg, "secrets", "default",
+                                       "robot-token"))
+        # Secret deleted while the SA lives: re-minted.
+        reg.delete("secrets", "default", "robot-token")
+        await wait_for(lambda: _exists(reg, "secrets", "default",
+                                       "robot-token"))
+        # SA deleted: its token secret is revoked.
+        reg.delete("serviceaccounts", "default", "robot")
+        await wait_for(lambda: not _exists(reg, "secrets", "default",
+                                           "robot-token"))
+    finally:
+        await ctl.stop()
+
+
+@pytest.mark.asyncio
+async def test_deleted_sa_token_stops_authenticating():
+    """Even before secret GC, a deleted SA's token must not resolve."""
+    reg, client, factory = make_plane()
+    token = "bearer-abc"
+    reg.create(t.ServiceAccount(metadata=ObjectMeta(name="robot",
+                                                    namespace="default")))
+    reg.create(t.Secret(
+        metadata=ObjectMeta(name="robot-token", namespace="default",
+                            annotations={"kubernetes-tpu/service-account.name":
+                                         "robot"}),
+        type=t.SECRET_TYPE_SA_TOKEN,
+        data={TOKEN_KEY: base64.b64encode(token.encode()).decode()}))
+    server = APIServer(reg, tokens={"h": "human"})
+    port = await server.start()
+    sa_client = RESTClient(f"http://127.0.0.1:{port}", token=token)
+    try:
+        items, _ = await sa_client.list("pods", "default")   # works
+        reg.delete("serviceaccounts", "default", "robot")
+        server._sa_index_at = float("-inf")  # force index refresh
+        with pytest.raises(errors.UnauthorizedError):
+            await sa_client.list("pods", "default")
+    finally:
+        await sa_client.close()
+        await server.stop()

@@ -150,3 +150,19 @@ async def test_strategic_patch_over_http():
     finally:
         await client.close()
         await srv.stop()
+
+
+async def test_max_inflight_returns_429():
+    srv, client = await start_server()
+    srv.max_inflight = 0  # everything over the limit
+    try:
+        with pytest.raises(errors.TooManyRequestsError):
+            await client.list("pods", "default")
+        # watches are exempt (long-lived streams don't consume slots)
+        stream = await client.watch("pods", namespace="default")
+        ev = await stream.next(timeout=0.3)   # None (idle) — no 429 raise
+        assert ev is None or ev[0] in ("BOOKMARK", "CLOSED")
+        stream.cancel()
+    finally:
+        await client.close()
+        await srv.stop()
