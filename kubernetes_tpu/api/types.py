@@ -860,6 +860,12 @@ class PersistentVolumeClaim(TypedObject):
 #: Secret type carrying a service-account bearer token (reference:
 #: ``SecretTypeServiceAccountToken``).
 SECRET_TYPE_SA_TOKEN = "kubernetes-tpu/service-account-token"
+#: Annotations binding a token Secret to its ServiceAccount (reference:
+#: ``ServiceAccountNameKey`` / ``ServiceAccountUIDKey``). Both writer
+#: (serviceaccount controller) and reader (apiserver authenticator)
+#: use these constants.
+SA_NAME_ANNOTATION = "kubernetes-tpu/service-account.name"
+SA_UID_ANNOTATION = "kubernetes-tpu/service-account.uid"
 
 
 @dataclass

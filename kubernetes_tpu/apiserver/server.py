@@ -98,7 +98,12 @@ class APIServer:
                 return self._err(errors.UnauthorizedError("invalid or missing bearer token"))
             request["user"] = user
         attrs = self._attributes(request)
-        is_watch = request.query.get("watch") in ("1", "true")
+        # Long-running exemption from max-in-flight applies only to
+        # requests that ARE watches (collection GET) — '?watch=1' on a
+        # mutating verb must not bypass the limiter.
+        is_watch = (request.method == "GET"
+                    and not request.match_info.get("name")
+                    and request.query.get("watch") in ("1", "true"))
         import time
         start = time.perf_counter()
         code = 500
@@ -162,19 +167,29 @@ class APIServer:
         hit = self._sa_index.get(token)
         if hit is None:
             return None
-        ns, sa_name = hit
+        ns, sa_name, sa_uid, secret_name = hit
         from ..api import types as t
         try:
-            self.registry.get("serviceaccounts", ns, sa_name)
+            sa = self.registry.get("serviceaccounts", ns, sa_name)
         except errors.StatusError:
             return None  # SA deleted: token is dead even if the
             #              secret GC has not caught up yet
+        # Two anti-spoof/anti-replay checks (reference: signed JWTs
+        # carry the SA UID; opaque tokens verify structurally):
+        # 1. the SA object must REFERENCE the token secret — a caller
+        #    who can only create Secrets cannot mint an identity;
+        # 2. the secret's recorded SA UID must match — a token leaked
+        #    before delete/recreate dies with its original SA.
+        if secret_name not in sa.secrets:
+            return None
+        if sa_uid and sa.metadata.uid != sa_uid:
+            return None
         return t.service_account_user(ns, sa_name)
 
     def _rebuild_sa_index(self) -> None:
         import base64
         from ..api import types as t
-        index: dict[str, tuple[str, str]] = {}
+        index: dict[str, tuple] = {}
         try:
             secrets, _rev = self.registry.list("secrets")
         except errors.StatusError:
@@ -187,9 +202,9 @@ class APIServer:
                     s.data.get("token", ""), validate=True).decode()
             except Exception:  # noqa: BLE001
                 continue
-            sa = s.metadata.annotations.get(
-                "kubernetes-tpu/service-account.name", "default")
-            index[value] = (s.metadata.namespace, sa)
+            sa = s.metadata.annotations.get(t.SA_NAME_ANNOTATION, "default")
+            uid = s.metadata.annotations.get(t.SA_UID_ANNOTATION, "")
+            index[value] = (s.metadata.namespace, sa, uid, s.metadata.name)
         self._sa_index = index
 
     def _attributes(self, request: web.Request) -> Optional[Attributes]:

@@ -57,6 +57,7 @@ class LocalNode:
     plugin: Optional[StubTpuPlugin] = None
     device_manager: Optional[DeviceManager] = None
     proxy: Optional[ServiceProxy] = None
+    cri_server: Optional[object] = None
 
     async def stop(self) -> None:
         await self.agent.stop()
@@ -64,7 +65,15 @@ class LocalNode:
             await self.proxy.stop()
         if self.plugin is not None:
             self.plugin.stop()
-        if isinstance(self.runtime, ProcessRuntime):
+        if self.cri_server is not None:
+            from ..cri import RemoteRuntime
+            if isinstance(self.runtime, RemoteRuntime):
+                self.runtime.close()
+            inner = self.cri_server.runtime
+            self.cri_server.stop()
+            if isinstance(inner, ProcessRuntime):
+                await inner.shutdown()
+        elif isinstance(self.runtime, ProcessRuntime):
             await self.runtime.shutdown()
         await self.client.close()
 
@@ -77,7 +86,14 @@ class NodeSpec:
     tpu_chips: int = 0
     mesh_shape: Optional[tuple] = None
     real_tpu: bool = False
+    #: Fail container starts when chips are assigned but no local TPU
+    #: device nodes exist (real device-node deployments; tunneled
+    #: TPU-VMs keep this off).
+    strict_devices: bool = False
     fake_runtime: bool = False
+    #: Interpose the CRI gRPC seam: the agent talks to its runtime over
+    #: a unix-socket RemoteRuntime instead of in-proc calls.
+    via_cri: bool = False
     capacity: dict = field(default_factory=dict)
     labels: dict = field(default_factory=dict)
 
@@ -174,6 +190,12 @@ class LocalCluster:
 
         runtime = (FakeRuntime() if spec.fake_runtime
                    else ProcessRuntime(node_dir))
+        cri_server = None
+        if spec.via_cri:
+            from ..cri import CRIServer, RemoteRuntime
+            cri_server = CRIServer(runtime)
+            cri_server.serve(os.path.join(node_dir, "cri.sock"))
+            runtime = RemoteRuntime(cri_server.socket_path)
         # Per-node service proxy (kube-proxy analog) on the dataplane
         # nodes; fake-runtime (hollow) nodes skip it — no real sockets.
         from ..util.features import GATES
@@ -188,16 +210,26 @@ class LocalCluster:
             eviction = EvictionManager(Thresholds(
                 memory_available_bytes=50 * 2**20,
                 fs_available_fraction=0.02))
+        # Runtime hook injecting TPU device nodes + libtpu env.
+        # Strictness (fail starts without device access) is opt-in via
+        # NodeSpec.strict_devices: TPU-VMs reached through a tunnel
+        # (this environment) legitimately have no local /dev/accel*.
+        hook = None
+        if spec.real_tpu or spec.tpu_chips:
+            from ..node.runtimehook import TpuRuntimeHook
+            hook = TpuRuntimeHook(
+                allow_missing_devices=not spec.strict_devices)
         agent = NodeAgent(
             client, name, runtime, device_manager=device_manager,
             capacity=dict(spec.capacity) or None, labels=dict(spec.labels),
             status_interval=self.status_interval,
             heartbeat_interval=self.heartbeat_interval,
-            proxy=proxy, eviction=eviction)
+            proxy=proxy, eviction=eviction, runtime_hook=hook)
         await agent.start()
         return LocalNode(name=name, agent=agent, runtime=runtime,
                          client=client, plugin=plugin,
-                         device_manager=device_manager, proxy=proxy)
+                         device_manager=device_manager, proxy=proxy,
+                         cri_server=cri_server)
 
     async def add_node(self, spec: NodeSpec) -> LocalNode:
         node = await self._start_node(spec, len(self.nodes))

@@ -98,18 +98,29 @@ class ServiceAccountController(Controller):
     async def _ensure_token(self, sa: t.ServiceAccount) -> None:
         ns = sa.metadata.namespace
         secret_name = f"{sa.metadata.name}-token"
+        have_secret = False
         try:
-            await self.client.get("secrets", ns, secret_name)
-            have_secret = True
+            existing = await self.client.get("secrets", ns, secret_name)
+            if existing.metadata.annotations.get(
+                    t.SA_UID_ANNOTATION) == sa.metadata.uid:
+                have_secret = True
+            else:
+                # Token minted for a PREVIOUS incarnation of this SA
+                # name: a delete/recreate must invalidate leaked
+                # bearers (reference binds tokens to the SA UID).
+                try:
+                    await self.client.delete("secrets", ns, secret_name)
+                except errors.NotFoundError:
+                    pass
         except errors.NotFoundError:
-            have_secret = False
+            pass
         if not have_secret:
             token = pysecrets.token_urlsafe(32)
             secret = t.Secret(
                 metadata=ObjectMeta(
                     name=secret_name, namespace=ns,
-                    annotations={"kubernetes-tpu/service-account.name":
-                                 sa.metadata.name}),
+                    annotations={t.SA_NAME_ANNOTATION: sa.metadata.name,
+                                 t.SA_UID_ANNOTATION: sa.metadata.uid}),
                 type=t.SECRET_TYPE_SA_TOKEN,
                 data={TOKEN_KEY: base64.b64encode(token.encode()).decode(),
                       "namespace": base64.b64encode(ns.encode()).decode()})

@@ -1,0 +1,10 @@
+"""CRI — container-runtime gRPC seam (see api.proto).
+
+``CRIServer`` exposes any in-proc :class:`~kubernetes_tpu.node.runtime.
+ContainerRuntime` over a unix socket; ``RemoteRuntime`` is the node
+agent's client side (``pkg/kubelet/remote/remote_runtime.go`` analog),
+itself a ContainerRuntime — so the agent is transport-agnostic.
+"""
+from .service import CRIServer, RemoteRuntime
+
+__all__ = ["CRIServer", "RemoteRuntime"]

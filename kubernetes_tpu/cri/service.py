@@ -1,0 +1,225 @@
+"""CRI gRPC server + remote-runtime client.
+
+Same plumbing style as deviceplugin/service.py: grpc_tools is absent,
+so handlers are registered through grpc's generic handler API with
+protoc-generated messages; method paths follow /package.Service/Method,
+interoperable with foreign gRPC stacks (a containerd shim could serve
+this socket).
+
+Threading: grpc.server runs handlers on its own thread pool while the
+runtime lives on the agent's asyncio loop — handlers bridge with
+``asyncio.run_coroutine_threadsafe``; the client is blocking and the
+agent-side RemoteRuntime wraps calls in ``asyncio.to_thread``.
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+from concurrent import futures
+from typing import Optional
+
+import grpc
+
+from ..node.runtime import ContainerConfig, ContainerRuntime, ContainerStatus
+from . import cri_pb2 as pb
+
+log = logging.getLogger("cri")
+
+SERVICE = "cri.v1.RuntimeService"
+RUNTIME_VERSION = "0.1"
+
+
+def _to_pb_status(st: ContainerStatus) -> pb.ContainerStatus:
+    return pb.ContainerStatus(
+        id=st.id, name=st.name, pod_uid=st.pod_uid, state=st.state,
+        exit_code=st.exit_code, started_at=st.started_at or 0.0,
+        finished_at=st.finished_at or 0.0, message=st.message,
+        pid=st.pid or 0)
+
+
+def _from_pb_status(m: pb.ContainerStatus) -> ContainerStatus:
+    return ContainerStatus(
+        id=m.id, name=m.name, pod_uid=m.pod_uid, state=m.state,
+        exit_code=m.exit_code, started_at=m.started_at,
+        finished_at=m.finished_at, message=m.message, pid=m.pid)
+
+
+class CRIServer:
+    """Serves a ContainerRuntime over a unix socket. The runtime's
+    coroutines execute on ``loop`` (the loop that owns the runtime)."""
+
+    def __init__(self, runtime: ContainerRuntime,
+                 loop: Optional[asyncio.AbstractEventLoop] = None):
+        self.runtime = runtime
+        self.loop = loop
+        self._server: Optional[grpc.Server] = None
+        self.socket_path = ""
+
+    def _call(self, coro):
+        future = asyncio.run_coroutine_threadsafe(coro, self.loop)
+        return future.result(timeout=120)
+
+    # -- handlers (run on grpc's thread pool) -----------------------------
+
+    def Version(self, request, context):
+        return pb.VersionResponse(
+            runtime_name=type(self.runtime).__name__,
+            runtime_version=RUNTIME_VERSION)
+
+    def CreateContainer(self, request, context):
+        c = request.config
+        config = ContainerConfig(
+            pod_namespace=c.pod_namespace, pod_name=c.pod_name,
+            pod_uid=c.pod_uid, name=c.name, image=c.image,
+            command=list(c.command), args=list(c.args),
+            env={e.key: e.value for e in c.envs},
+            working_dir=c.working_dir,
+            mounts=[(m.host_path, m.container_path, m.readonly)
+                    for m in c.mounts],
+            devices=list(c.devices))
+        try:
+            cid = self._call(self.runtime.start_container(config))
+        except Exception as e:  # noqa: BLE001
+            context.abort(grpc.StatusCode.INTERNAL, str(e))
+        return pb.CreateContainerResponse(container_id=cid)
+
+    def StopContainer(self, request, context):
+        self._call(self.runtime.stop_container(
+            request.container_id, grace_seconds=request.grace_seconds or 1.0))
+        return pb.Empty()
+
+    def RemoveContainer(self, request, context):
+        self._call(self.runtime.remove_container(request.container_id))
+        return pb.Empty()
+
+    def ListContainers(self, request, context):
+        statuses = self._call(self.runtime.list_containers())
+        return pb.ListContainersResponse(
+            containers=[_to_pb_status(st) for st in statuses])
+
+    def ContainerLogs(self, request, context):
+        try:
+            content = self._call(self.runtime.container_logs(
+                request.container_id,
+                tail=request.tail if request.tail else None))
+        except Exception as e:  # noqa: BLE001
+            context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+        return pb.ContainerLogsResponse(content=content)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def serve(self, socket_path: str) -> None:
+        """Start serving (call from the loop that owns the runtime)."""
+        if self.loop is None:
+            self.loop = asyncio.get_running_loop()
+        os.makedirs(os.path.dirname(socket_path), exist_ok=True)
+        if os.path.exists(socket_path):
+            os.unlink(socket_path)
+        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=8))
+        handlers = {
+            "Version": grpc.unary_unary_rpc_method_handler(
+                self.Version, request_deserializer=pb.VersionRequest.FromString,
+                response_serializer=pb.VersionResponse.SerializeToString),
+            "CreateContainer": grpc.unary_unary_rpc_method_handler(
+                self.CreateContainer,
+                request_deserializer=pb.CreateContainerRequest.FromString,
+                response_serializer=pb.CreateContainerResponse.SerializeToString),
+            "StopContainer": grpc.unary_unary_rpc_method_handler(
+                self.StopContainer,
+                request_deserializer=pb.StopContainerRequest.FromString,
+                response_serializer=pb.Empty.SerializeToString),
+            "RemoveContainer": grpc.unary_unary_rpc_method_handler(
+                self.RemoveContainer,
+                request_deserializer=pb.RemoveContainerRequest.FromString,
+                response_serializer=pb.Empty.SerializeToString),
+            "ListContainers": grpc.unary_unary_rpc_method_handler(
+                self.ListContainers,
+                request_deserializer=pb.ListContainersRequest.FromString,
+                response_serializer=pb.ListContainersResponse.SerializeToString),
+            "ContainerLogs": grpc.unary_unary_rpc_method_handler(
+                self.ContainerLogs,
+                request_deserializer=pb.ContainerLogsRequest.FromString,
+                response_serializer=pb.ContainerLogsResponse.SerializeToString),
+        }
+        self._server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(SERVICE, handlers),))
+        self._server.add_insecure_port(f"unix://{socket_path}")
+        self._server.start()
+        self.socket_path = socket_path
+        log.info("CRI server on unix://%s", socket_path)
+
+    def stop(self) -> None:
+        if self._server:
+            self._server.stop(grace=1.0)
+            self._server = None
+
+
+class RemoteRuntime(ContainerRuntime):
+    """ContainerRuntime over the CRI socket — the agent plugs this in
+    exactly like an in-proc runtime (remote_runtime.go analog)."""
+
+    def __init__(self, socket_path: str):
+        self.socket_path = socket_path
+        self._channel = grpc.insecure_channel(f"unix://{socket_path}")
+        p = f"/{SERVICE}/"
+
+        def u(method, req_cls, resp_cls):
+            return self._channel.unary_unary(
+                p + method, request_serializer=req_cls.SerializeToString,
+                response_deserializer=resp_cls.FromString)
+        self._version = u("Version", pb.VersionRequest, pb.VersionResponse)
+        self._create = u("CreateContainer", pb.CreateContainerRequest,
+                         pb.CreateContainerResponse)
+        self._stop = u("StopContainer", pb.StopContainerRequest, pb.Empty)
+        self._remove = u("RemoveContainer", pb.RemoveContainerRequest,
+                         pb.Empty)
+        self._list = u("ListContainers", pb.ListContainersRequest,
+                       pb.ListContainersResponse)
+        self._logs = u("ContainerLogs", pb.ContainerLogsRequest,
+                       pb.ContainerLogsResponse)
+
+    def version(self) -> tuple[str, str]:
+        resp = self._version(pb.VersionRequest(version=RUNTIME_VERSION),
+                             timeout=10)
+        return resp.runtime_name, resp.runtime_version
+
+    async def start_container(self, config: ContainerConfig) -> str:
+        req = pb.CreateContainerRequest(config=pb.ContainerConfig(
+            pod_namespace=config.pod_namespace, pod_name=config.pod_name,
+            pod_uid=config.pod_uid, name=config.name, image=config.image,
+            command=list(config.command), args=list(config.args),
+            envs=[pb.KeyValue(key=k, value=v) for k, v in config.env.items()],
+            working_dir=config.working_dir,
+            mounts=[pb.Mount(host_path=h, container_path=c, readonly=ro)
+                    for h, c, ro in config.mounts],
+            devices=list(config.devices)))
+        resp = await asyncio.to_thread(self._create, req, timeout=120)
+        return resp.container_id
+
+    async def stop_container(self, container_id: str,
+                             grace_seconds: float = 30.0) -> None:
+        await asyncio.to_thread(
+            self._stop, pb.StopContainerRequest(
+                container_id=container_id, grace_seconds=grace_seconds),
+            timeout=max(30.0, grace_seconds + 10))
+
+    async def remove_container(self, container_id: str) -> None:
+        await asyncio.to_thread(
+            self._remove, pb.RemoveContainerRequest(container_id=container_id),
+            timeout=60)
+
+    async def list_containers(self) -> list[ContainerStatus]:
+        resp = await asyncio.to_thread(
+            self._list, pb.ListContainersRequest(), timeout=30)
+        return [_from_pb_status(m) for m in resp.containers]
+
+    async def container_logs(self, container_id: str,
+                             tail: Optional[int] = None) -> str:
+        resp = await asyncio.to_thread(
+            self._logs, pb.ContainerLogsRequest(
+                container_id=container_id, tail=tail or 0), timeout=30)
+        return resp.content
+
+    def close(self) -> None:
+        self._channel.close()

@@ -11,6 +11,9 @@ Currently shipped:
 
 - ``submesh.cpp`` — contiguous sub-mesh box search used by the
   scheduler's TPU placement (see scheduler/submesh.py).
+- ``tpu_hook.cpp`` — the container runtime hook binary (NVIDIA
+  Container Runtime analog) injecting TPU device nodes + libtpu env
+  (see node/runtimehook.py).
 """
 from __future__ import annotations
 
@@ -40,6 +43,40 @@ def _build(src: str, lib: str) -> None:
     finally:
         if os.path.exists(tmp):
             os.unlink(tmp)
+
+
+_HOOK_SRC = os.path.join(_DIR, "tpu_hook.cpp")
+_HOOK_BIN = os.path.join(_DIR, "_tpu_hook")
+_hook_path: Optional[str] = None
+_hook_tried = False
+
+
+def build_tpu_hook() -> Optional[str]:
+    """Path to the runtime-hook binary, building it if needed; None
+    when the toolchain is unavailable (callers use the Python
+    fallback). Cached, including a negative result."""
+    global _hook_path, _hook_tried
+    if _hook_tried:
+        return _hook_path
+    _hook_tried = True
+    try:
+        if (not os.path.exists(_HOOK_BIN)
+                or os.path.getmtime(_HOOK_BIN) < os.path.getmtime(_HOOK_SRC)):
+            fd, tmp = tempfile.mkstemp(dir=_DIR)
+            os.close(fd)
+            try:
+                subprocess.run(
+                    ["g++", "-O2", "-std=c++17", _HOOK_SRC, "-o", tmp],
+                    check=True, capture_output=True, timeout=120)
+                os.chmod(tmp, 0o755)
+                os.replace(tmp, _HOOK_BIN)
+            finally:
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
+        _hook_path = _HOOK_BIN
+    except Exception:
+        _hook_path = None
+    return _hook_path
 
 
 def load_submesh() -> Optional[ctypes.CDLL]:

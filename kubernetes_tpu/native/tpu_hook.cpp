@@ -1,0 +1,121 @@
+// tpu_hook — native container runtime hook for TPU access.
+//
+// Reference analog: the NVIDIA Container Runtime selected via docker
+// hooks (pkg/kubelet/dockershim/docker_hooks.go:139-160) — a native
+// pre-start step that injects device nodes + driver libraries into a
+// container. The TPU equivalent discovers the chip device nodes
+// (/dev/accel* or VFIO) and libtpu.so, and emits the env/device
+// directives the runtime merges into the container config.
+//
+// Protocol (line-based; no JSON so the binary has zero deps):
+//   stdin:   chip <chip-id>        (one per assigned chip; may be none)
+//            allow-missing         (dev boxes: no devices is not fatal)
+//            dev-root <path>       (tests: scan here instead of /dev)
+//   stdout:  device <path>
+//            env <KEY>=<VALUE>
+//   exit 0 = ok; exit 1 = requested chips but no device access.
+//
+// Built on demand by kubernetes_tpu/native/__init__.py (g++ -O2), like
+// submesh.cpp; the Python fallback in node/runtimehook.py mirrors the
+// same discovery and is the semantic source of truth.
+
+#include <dirent.h>
+#include <string.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <cstdio>
+#include <string>
+#include <vector>
+
+static bool exists(const std::string& p) {
+  struct stat st;
+  return ::stat(p.c_str(), &st) == 0;
+}
+
+static std::vector<std::string> scan_devices(const std::string& dev_root) {
+  std::vector<std::string> found;
+  // TPU-VM device nodes: /dev/accel0..N (newer stacks) or /dev/vfio.
+  DIR* d = ::opendir(dev_root.c_str());
+  if (d != nullptr) {
+    while (dirent* e = ::readdir(d)) {
+      if (strncmp(e->d_name, "accel", 5) == 0) {
+        found.push_back(dev_root + "/" + e->d_name);
+      }
+    }
+    ::closedir(d);
+  }
+  if (found.empty() && exists(dev_root + "/vfio")) {
+    found.push_back(dev_root + "/vfio");
+  }
+  return found;
+}
+
+static std::string find_libtpu() {
+  const char* candidates[] = {
+      "/usr/lib/libtpu.so",
+      "/usr/local/lib/libtpu.so",
+      "/lib/libtpu.so",
+  };
+  for (const char* c : candidates) {
+    if (exists(c)) return c;
+  }
+  // pip-installed libtpu (the TPU-VM default): probe the venv.
+  const char* venv = ::getenv("VIRTUAL_ENV");
+  if (venv != nullptr) {
+    std::string p = std::string(venv) + "/lib";
+    DIR* d = ::opendir(p.c_str());
+    if (d != nullptr) {
+      while (dirent* e = ::readdir(d)) {
+        std::string sub = p + "/" + e->d_name + "/site-packages/libtpu/libtpu.so";
+        if (e->d_name[0] != '.' && exists(sub)) {
+          ::closedir(d);
+          return sub;
+        }
+      }
+      ::closedir(d);
+    }
+  }
+  return "";
+}
+
+int main() {
+  std::vector<std::string> chips;
+  bool allow_missing = false;
+  std::string dev_root = "/dev";
+
+  char line[4096];
+  while (fgets(line, sizeof line, stdin) != nullptr) {
+    std::string s(line);
+    while (!s.empty() && (s.back() == '\n' || s.back() == '\r')) s.pop_back();
+    if (s.rfind("chip ", 0) == 0) {
+      chips.push_back(s.substr(5));
+    } else if (s == "allow-missing") {
+      allow_missing = true;
+    } else if (s.rfind("dev-root ", 0) == 0) {
+      dev_root = s.substr(9);
+    }
+  }
+
+  std::vector<std::string> devices = scan_devices(dev_root);
+  if (devices.empty() && !chips.empty() && !allow_missing) {
+    fprintf(stderr,
+            "tpu_hook: container assigned %zu chip(s) but no TPU device "
+            "nodes under %s\n",
+            chips.size(), dev_root.c_str());
+    return 1;
+  }
+  for (const std::string& dev : devices) {
+    printf("device %s\n", dev.c_str());
+  }
+  std::string libtpu = find_libtpu();
+  if (!libtpu.empty()) {
+    printf("env TPU_LIBRARY_PATH=%s\n", libtpu.c_str());
+  }
+  if (!devices.empty()) {
+    printf("env TPU_RUNTIME_HOOK=native\n");
+  }
+  // Chip visibility is already decided by the scheduler + device
+  // plugin; the hook just confirms device access exists.
+  return 0;
+}

@@ -57,7 +57,8 @@ class NodeAgent:
                  server_port: Optional[int] = 0,
                  pod_cidr: str = "",
                  proxy=None,
-                 eviction: Optional[EvictionManager] = None):
+                 eviction: Optional[EvictionManager] = None,
+                 runtime_hook=None):
         self.client = client
         self.node_name = node_name
         self.runtime = runtime
@@ -84,6 +85,8 @@ class NodeAgent:
         self.proxy = proxy
         #: Node-pressure eviction manager (eviction.py); None disables.
         self.eviction = eviction
+        #: Container runtime hook (runtimehook.py); None disables.
+        self.runtime_hook = runtime_hook
         #: ConfigMap/Secret/EmptyDir materialization (volumes.py).
         vol_dir = getattr(runtime, "root_dir", None) or os.path.join(
             tempfile.gettempdir(), f"ktpu-{node_name}")
@@ -526,6 +529,21 @@ class NodeAgent:
             env.update(denv)
             mounts.extend(dmounts)
             devices.extend(ddevs)
+        if self.runtime_hook is not None:
+            # Runtime hook (docker_hooks.go -> NVIDIA runtime analog):
+            # inject TPU device nodes + libtpu env for matching
+            # containers; strict mode fails the start instead of
+            # running a chip-assigned container blind.
+            try:
+                henv, hdevs = await self.runtime_hook.run(
+                    pod, container, t.pod_tpu_assigned(pod))
+            except Exception as e:  # noqa: BLE001
+                self.recorder.event(pod, "Warning", "RuntimeHookFailed",
+                                    f"{container.name}: {e}")
+                return
+            for k, v in henv.items():
+                env.setdefault(k, v)
+            devices.extend(d for d in hdevs if d not in devices)
         env.setdefault("POD_NAME", pod.metadata.name)
         env.setdefault("POD_NAMESPACE", pod.metadata.namespace)
         env.setdefault("NODE_NAME", self.node_name)

@@ -1,0 +1,144 @@
+"""Container runtime hooks — device/env injection at container start.
+
+Reference: ``pkg/kubelet/dockershim/docker_hooks.go`` — JSON hook
+configs in a hooks.d directory select a container runtime (``nvidia``)
+by image prefix or pod annotation; the selected runtime injects driver
+devices/libraries. TPU redesign: the hook IS the injection step — a
+native binary (``native/tpu_hook.cpp``, the NVIDIA Container Runtime
+analog) discovers TPU device nodes + libtpu and returns device/env
+directives the agent merges into the container config. A Python
+fallback performs the same discovery when the native toolchain is
+unavailable; both speak the same line protocol.
+"""
+from __future__ import annotations
+
+import asyncio
+import glob
+import json
+import logging
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..api import types as t
+
+log = logging.getLogger("runtimehook")
+
+
+@dataclass
+class HookConfig:
+    """One hook selection rule (docker_hooks.go's JSON shape)."""
+    name: str = "tpu"
+    #: Match containers whose image starts with any of these.
+    images: list[str] = field(default_factory=list)
+    #: Match pods carrying any of these annotation keys.
+    annotations: list[str] = field(default_factory=list)
+    #: Always match containers that request TPU chips.
+    match_tpu_requests: bool = True
+
+    def matches(self, pod: t.Pod, container: t.Container) -> bool:
+        if self.match_tpu_requests and container.tpu_requests:
+            return True
+        if any(container.image.startswith(p) for p in self.images if p):
+            return True
+        return any(k in pod.metadata.annotations for k in self.annotations)
+
+
+def load_hook_configs(hooks_dir: str) -> list[HookConfig]:
+    """Load ``*.json`` hook configs (reference: loadHooks scanning
+    hooks.d); malformed files are skipped with a log line."""
+    configs = []
+    for path in sorted(glob.glob(os.path.join(hooks_dir, "*.json"))):
+        try:
+            with open(path) as f:
+                raw = json.load(f)
+            configs.append(HookConfig(
+                name=raw.get("name", os.path.basename(path)),
+                images=list(raw.get("images", [])),
+                annotations=list(raw.get("annotations", [])),
+                match_tpu_requests=bool(raw.get("match_tpu_requests", False))))
+        except (OSError, ValueError) as e:
+            log.warning("skipping hook config %s: %s", path, e)
+    return configs
+
+
+class TpuRuntimeHook:
+    """Runs the hook step for matching containers and merges the
+    resulting devices/env. ``allow_missing_devices=True`` is the dev
+    posture (ProcessRuntime on a CPU box); real TPU nodes run strict —
+    a chip-assigned container without device access must fail loudly,
+    not start blind."""
+
+    def __init__(self, hooks_dir: str = "",
+                 allow_missing_devices: bool = True,
+                 dev_root: str = "/dev"):
+        self.configs = (load_hook_configs(hooks_dir) if hooks_dir
+                        else [HookConfig()])
+        self.allow_missing_devices = allow_missing_devices
+        self.dev_root = dev_root
+
+    async def run(self, pod: t.Pod, container: t.Container,
+                  assigned_chips: list[str]
+                  ) -> tuple[dict[str, str], list[str]]:
+        """(env, devices) for the container; ({}, []) when no hook
+        matches. Raises RuntimeError when device access is required but
+        absent (strict mode)."""
+        if not any(c.matches(pod, container) for c in self.configs):
+            return {}, []
+        return await self._invoke(assigned_chips)
+
+    async def _invoke(self, chips: list[str]) -> tuple[dict, list]:
+        from ..native import build_tpu_hook
+        # First call may compile the binary — off the event loop, or a
+        # slow g++ would stall heartbeats and every pod sync.
+        binary = await asyncio.to_thread(build_tpu_hook)
+        stdin_lines = [f"chip {c}" for c in chips]
+        if self.allow_missing_devices:
+            stdin_lines.append("allow-missing")
+        if self.dev_root != "/dev":
+            stdin_lines.append(f"dev-root {self.dev_root}")
+        if binary is not None:
+            proc = await asyncio.create_subprocess_exec(
+                binary, stdin=asyncio.subprocess.PIPE,
+                stdout=asyncio.subprocess.PIPE,
+                stderr=asyncio.subprocess.PIPE)
+            out, err = await proc.communicate(
+                ("\n".join(stdin_lines) + "\n").encode())
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"tpu_hook failed: {err.decode().strip() or 'exit '}"
+                    f"{proc.returncode}")
+            return self._parse(out.decode())
+        return self._python_fallback(chips)
+
+    @staticmethod
+    def _parse(output: str) -> tuple[dict, list]:
+        env: dict[str, str] = {}
+        devices: list[str] = []
+        for line in output.splitlines():
+            if line.startswith("device "):
+                devices.append(line[7:].strip())
+            elif line.startswith("env ") and "=" in line[4:]:
+                key, _, value = line[4:].partition("=")
+                env[key] = value
+        return env, devices
+
+    def _python_fallback(self, chips: list[str]) -> tuple[dict, list]:
+        """Same discovery as tpu_hook.cpp (semantic source of truth)."""
+        devices = sorted(glob.glob(os.path.join(self.dev_root, "accel*")))
+        vfio = os.path.join(self.dev_root, "vfio")
+        if not devices and os.path.exists(vfio):
+            devices = [vfio]
+        if not devices and chips and not self.allow_missing_devices:
+            raise RuntimeError(
+                f"container assigned {len(chips)} chip(s) but no TPU "
+                f"device nodes under {self.dev_root}")
+        env: dict[str, str] = {}
+        for cand in ("/usr/lib/libtpu.so", "/usr/local/lib/libtpu.so",
+                     "/lib/libtpu.so"):
+            if os.path.exists(cand):
+                env["TPU_LIBRARY_PATH"] = cand
+                break
+        if devices:
+            env["TPU_RUNTIME_HOOK"] = "python-fallback"
+        return env, devices

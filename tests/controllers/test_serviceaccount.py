@@ -76,13 +76,14 @@ async def test_admission_defaults_sa_and_mounts_token():
 async def test_sa_token_authenticates_and_rbac_grants():
     reg, client, factory = make_plane()
     token = "sa-bearer-token-xyz"
-    # Token resolution requires the SA object to exist (revocation).
+    # Token resolution requires the SA to exist AND to reference the
+    # secret (anti-minting) — the controller normally wires both.
     reg.create(t.ServiceAccount(metadata=ObjectMeta(name="robot",
-                                                    namespace="default")))
+                                                    namespace="default"),
+                                secrets=["robot-token"]))
     reg.create(t.Secret(
         metadata=ObjectMeta(name="robot-token", namespace="default",
-                            annotations={"kubernetes-tpu/service-account.name":
-                                         "robot"}),
+                            annotations={t.SA_NAME_ANNOTATION: "robot"}),
         type=t.SECRET_TYPE_SA_TOKEN,
         data={TOKEN_KEY: base64.b64encode(token.encode()).decode()}))
     reg.create(rbac.Role(
@@ -141,11 +142,11 @@ async def test_deleted_sa_token_stops_authenticating():
     reg, client, factory = make_plane()
     token = "bearer-abc"
     reg.create(t.ServiceAccount(metadata=ObjectMeta(name="robot",
-                                                    namespace="default")))
+                                                    namespace="default"),
+                                secrets=["robot-token"]))
     reg.create(t.Secret(
         metadata=ObjectMeta(name="robot-token", namespace="default",
-                            annotations={"kubernetes-tpu/service-account.name":
-                                         "robot"}),
+                            annotations={t.SA_NAME_ANNOTATION: "robot"}),
         type=t.SECRET_TYPE_SA_TOKEN,
         data={TOKEN_KEY: base64.b64encode(token.encode()).decode()}))
     server = APIServer(reg, tokens={"h": "human"})
@@ -160,3 +161,62 @@ async def test_deleted_sa_token_stops_authenticating():
     finally:
         await sa_client.close()
         await server.stop()
+
+
+@pytest.mark.asyncio
+async def test_secret_only_attacker_cannot_mint_identity():
+    """A principal who can only create Secrets must not be able to
+    forge a ServiceAccount identity (privilege-escalation guard: the
+    SA object must reference the token secret)."""
+    reg, client, factory = make_plane()
+    reg.create(t.ServiceAccount(metadata=ObjectMeta(name="victim",
+                                                    namespace="default")))
+    forged = "forged-token"
+    reg.create(t.Secret(
+        metadata=ObjectMeta(name="evil", namespace="default",
+                            annotations={t.SA_NAME_ANNOTATION: "victim"}),
+        type=t.SECRET_TYPE_SA_TOKEN,
+        data={TOKEN_KEY: base64.b64encode(forged.encode()).decode()}))
+    server = APIServer(reg, tokens={"h": "human"})
+    port = await server.start()
+    attacker = RESTClient(f"http://127.0.0.1:{port}", token=forged)
+    try:
+        with pytest.raises(errors.UnauthorizedError):
+            await attacker.list("pods", "default")
+    finally:
+        await attacker.close()
+        await server.stop()
+
+
+@pytest.mark.asyncio
+async def test_recreated_sa_invalidates_old_token():
+    """Delete+recreate of an SA mints a FRESH token; the old bearer
+    (possibly leaked) dies with the old UID."""
+    reg, client, factory = make_plane()
+    ctl = ServiceAccountController(client, factory)
+    await ctl.start()
+    try:
+        await client.create(t.ServiceAccount(
+            metadata=ObjectMeta(name="robot", namespace="default")))
+        await wait_for(lambda: _exists(reg, "secrets", "default",
+                                       "robot-token"))
+        old = reg.get("secrets", "default", "robot-token").data[TOKEN_KEY]
+        old_uid = reg.get("serviceaccounts", "default",
+                          "robot").metadata.uid
+        reg.delete("serviceaccounts", "default", "robot")
+        await client.create(t.ServiceAccount(
+            metadata=ObjectMeta(name="robot", namespace="default")))
+
+        def rotated():
+            try:
+                sec = reg.get("secrets", "default", "robot-token")
+            except errors.NotFoundError:
+                return None
+            new_uid = reg.get("serviceaccounts", "default",
+                              "robot").metadata.uid
+            ann = sec.metadata.annotations.get(t.SA_UID_ANNOTATION)
+            return sec if (ann == new_uid and new_uid != old_uid
+                           and sec.data[TOKEN_KEY] != old) else None
+        await wait_for(rotated)
+    finally:
+        await ctl.stop()

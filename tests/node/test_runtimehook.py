@@ -1,0 +1,102 @@
+"""Runtime hook tests — native binary + Python fallback equivalence
+(reference tier: docker_hooks_test.go)."""
+import json
+import os
+
+import pytest
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.native import build_tpu_hook
+from kubernetes_tpu.node.runtimehook import (HookConfig, TpuRuntimeHook,
+                                             load_hook_configs)
+
+
+def mk_pod(annotations=None):
+    return t.Pod(metadata=ObjectMeta(name="p", namespace="default",
+                                     annotations=annotations or {}))
+
+
+def test_hook_config_matching():
+    cfg = HookConfig(images=["tpu-"], annotations=["ktpu/tpu"],
+                     match_tpu_requests=True)
+    tpu_c = t.Container(name="c", image="x", tpu_requests=["tpu"])
+    img_c = t.Container(name="c", image="tpu-train:v1")
+    plain = t.Container(name="c", image="busybox")
+    assert cfg.matches(mk_pod(), tpu_c)
+    assert cfg.matches(mk_pod(), img_c)
+    assert not cfg.matches(mk_pod(), plain)
+    assert cfg.matches(mk_pod({"ktpu/tpu": "1"}), plain)
+
+
+def test_load_hook_configs(tmp_path):
+    (tmp_path / "tpu.json").write_text(json.dumps(
+        {"name": "tpu", "images": ["tpu-"], "match_tpu_requests": True}))
+    (tmp_path / "broken.json").write_text("{nope")
+    configs = load_hook_configs(str(tmp_path))
+    assert len(configs) == 1 and configs[0].images == ["tpu-"]
+
+
+def test_native_binary_builds_and_discovers(tmp_path):
+    binary = build_tpu_hook()
+    assert binary is not None and os.access(binary, os.X_OK)
+    # Fake /dev with two accel nodes.
+    (tmp_path / "accel0").write_text("")
+    (tmp_path / "accel1").write_text("")
+    import subprocess
+    out = subprocess.run(
+        [binary], input=f"chip c0\ndev-root {tmp_path}\n",
+        capture_output=True, text=True, timeout=30)
+    assert out.returncode == 0, out.stderr
+    assert f"device {tmp_path}/accel0" in out.stdout
+    assert f"device {tmp_path}/accel1" in out.stdout
+    assert "env TPU_RUNTIME_HOOK=native" in out.stdout
+    # Strict mode with no devices: non-zero exit.
+    out = subprocess.run(
+        [binary], input=f"chip c0\ndev-root {tmp_path}/empty\n",
+        capture_output=True, text=True, timeout=30)
+    assert out.returncode == 1 and "no TPU device nodes" in out.stderr
+    # allow-missing: clean exit, no devices.
+    out = subprocess.run(
+        [binary], input=f"chip c0\nallow-missing\ndev-root {tmp_path}/empty\n",
+        capture_output=True, text=True, timeout=30)
+    assert out.returncode == 0 and "device " not in out.stdout
+
+
+@pytest.mark.asyncio
+async def test_hook_manager_merges_native_output(tmp_path):
+    (tmp_path / "accel0").write_text("")
+    hook = TpuRuntimeHook(dev_root=str(tmp_path))
+    pod = mk_pod()
+    env, devices = await hook.run(
+        pod, t.Container(name="c", tpu_requests=["tpu"]), ["chip-0"])
+    assert devices == [f"{tmp_path}/accel0"]
+    assert env.get("TPU_RUNTIME_HOOK") in ("native", "python-fallback")
+    # Non-matching container: no-op.
+    env, devices = await hook.run(pod, t.Container(name="c", image="b"), [])
+    assert env == {} and devices == []
+
+
+@pytest.mark.asyncio
+async def test_hook_strict_mode_raises(tmp_path):
+    hook = TpuRuntimeHook(allow_missing_devices=False,
+                          dev_root=str(tmp_path / "none"))
+    with pytest.raises(RuntimeError):
+        await hook.run(mk_pod(), t.Container(name="c", tpu_requests=["tpu"]),
+                       ["chip-0"])
+
+
+def test_python_fallback_matches_native(tmp_path):
+    """Both implementations speak the same discovery semantics."""
+    (tmp_path / "accel0").write_text("")
+    hook = TpuRuntimeHook(dev_root=str(tmp_path))
+    env_py, dev_py = hook._python_fallback(["c0"])
+    assert dev_py == [f"{tmp_path}/accel0"]
+    binary = build_tpu_hook()
+    if binary:
+        import subprocess
+        out = subprocess.run(
+            [binary], input=f"chip c0\ndev-root {tmp_path}\n",
+            capture_output=True, text=True, timeout=30)
+        env_n, dev_n = TpuRuntimeHook._parse(out.stdout)
+        assert dev_n == dev_py
