@@ -44,6 +44,28 @@ def _dec_time(s: str) -> datetime.datetime:
     return datetime.datetime.fromisoformat(s.replace("Z", "+00:00")).replace(tzinfo=None)
 
 
+#: Per-class field names whose default list/dict is NON-empty: an
+#: explicitly empty value there is meaningful (e.g. Namespace
+#: spec.finalizers=[] means "no finalizers", not "use the default") and
+#: must survive the wire instead of decoding back to the default.
+_KEEP_EMPTY: dict[type, frozenset] = {}
+
+
+def _keep_empty_fields(cls: type) -> frozenset:
+    cached = _KEEP_EMPTY.get(cls)
+    if cached is None:
+        keep = set()
+        for f in dataclasses.fields(cls):
+            if f.default_factory is not dataclasses.MISSING:
+                try:
+                    if f.default_factory():
+                        keep.add(f.name)
+                except Exception:  # noqa: BLE001 — exotic factory: elide
+                    pass
+        cached = _KEEP_EMPTY[cls] = frozenset(keep)
+    return cached
+
+
 def to_dict(obj: Any) -> Any:
     """Recursively convert an API object into a JSON-able structure."""
     if obj is None or isinstance(obj, (str, int, float, bool)):
@@ -64,9 +86,13 @@ def to_dict(obj: Any) -> Any:
                 continue
             # Elide empty collections and empty strings ("" means unset
             # throughout the model) to keep wire objects tight, but keep
-            # false/0 scalars (they are meaningful, e.g. replicas: 0).
+            # false/0 scalars (they are meaningful, e.g. replicas: 0)
+            # and empty collections on fields whose DEFAULT is
+            # non-empty (an explicit [] there is a real value).
             if (isinstance(v, (list, dict, str)) and not v):
-                continue
+                if isinstance(v, str) or \
+                        f.name not in _keep_empty_fields(type(obj)):
+                    continue
             out[f.name] = to_dict(v)
         extra = getattr(obj, "__extra__", None)
         if extra:

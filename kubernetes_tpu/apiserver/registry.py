@@ -236,6 +236,9 @@ class Registry:
     def create(self, obj: TypedObject, dry_run: bool = False) -> TypedObject:
         spec = self.spec_for_kind(type(obj).__name__ if not obj.kind else obj.kind)
         obj = self.scheme.default(obj)
+        # Stamp TypeMeta like update() does — clients must get fully
+        # typed objects back regardless of transport.
+        obj.api_version, obj.kind = spec.api_version, spec.kind
         meta = obj.metadata
         if spec.namespaced and not meta.namespace:
             meta.namespace = "default"

@@ -270,6 +270,31 @@ async def cmd_logs(args) -> int:
         await client.close()
 
 
+async def cmd_exec(args) -> int:
+    """Run a command in a running container (kubectl exec analog)."""
+    client = make_client(args)
+    try:
+        pod = await client.get("pods", args.namespace, args.pod)
+        if not pod.spec.node_name:
+            raise SystemExit(f"ktl: pod {args.pod} is not scheduled yet")
+        base = await _node_daemon_base(client, pod.spec.node_name)
+        if base is None:
+            raise SystemExit(f"ktl: node {pod.spec.node_name} has no "
+                             "reachable agent server")
+        container = args.container or "-"
+        import aiohttp
+        async with aiohttp.ClientSession() as s:
+            url = f"{base}/exec/{args.namespace}/{args.pod}/{container}"
+            async with s.post(url, json={"command": args.cmd}) as r:
+                if r.status != 200:
+                    raise SystemExit(f"ktl: {(await r.text()).strip()}")
+                body = await r.json()
+        sys.stdout.write(body["output"])
+        return int(body["exit_code"])
+    finally:
+        await client.close()
+
+
 async def cmd_scale(args) -> int:
     client = make_client(args)
     try:
@@ -530,6 +555,12 @@ def build_parser() -> argparse.ArgumentParser:
 
     add("api-resources", cmd_api_resources, help="list server resources")
     add("version", cmd_version, help="client+server version")
+
+    sp = add("exec", cmd_exec, help="run a command in a container")
+    sp.add_argument("pod")
+    sp.add_argument("cmd", nargs="+", help="command (prefix with -- )")
+    sp.add_argument("-n", "--namespace", default="default")
+    sp.add_argument("-c", "--container", default="")
 
     sp = add("up", cmd_up, help="run a single-process cluster")
     sp.add_argument("--nodes", type=int, default=1)

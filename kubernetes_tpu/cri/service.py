@@ -56,9 +56,9 @@ class CRIServer:
         self._server: Optional[grpc.Server] = None
         self.socket_path = ""
 
-    def _call(self, coro):
+    def _call(self, coro, timeout: float = 120.0):
         future = asyncio.run_coroutine_threadsafe(coro, self.loop)
-        return future.result(timeout=120)
+        return future.result(timeout=timeout)
 
     # -- handlers (run on grpc's thread pool) -----------------------------
 
@@ -97,6 +97,25 @@ class CRIServer:
         statuses = self._call(self.runtime.list_containers())
         return pb.ListContainersResponse(
             containers=[_to_pb_status(st) for st in statuses])
+
+    def ExecSync(self, request, context):
+        exec_timeout = request.timeout or 30.0
+        try:
+            code, output = self._call(
+                self.runtime.exec_in_container(
+                    request.container_id, list(request.command),
+                    timeout=exec_timeout),
+                # The bridge deadline must outlast the exec's own
+                # timeout or long execs abort mid-flight server-side.
+                timeout=exec_timeout + 30.0)
+        except KeyError as e:
+            context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+        except NotImplementedError:
+            context.abort(grpc.StatusCode.UNIMPLEMENTED,
+                          "runtime does not support exec")
+        except Exception as e:  # noqa: BLE001
+            context.abort(grpc.StatusCode.INTERNAL, str(e))
+        return pb.ExecSyncResponse(exit_code=code, output=output)
 
     def ContainerLogs(self, request, context):
         try:
@@ -137,6 +156,10 @@ class CRIServer:
                 self.ListContainers,
                 request_deserializer=pb.ListContainersRequest.FromString,
                 response_serializer=pb.ListContainersResponse.SerializeToString),
+            "ExecSync": grpc.unary_unary_rpc_method_handler(
+                self.ExecSync,
+                request_deserializer=pb.ExecSyncRequest.FromString,
+                response_serializer=pb.ExecSyncResponse.SerializeToString),
             "ContainerLogs": grpc.unary_unary_rpc_method_handler(
                 self.ContainerLogs,
                 request_deserializer=pb.ContainerLogsRequest.FromString,
@@ -178,6 +201,7 @@ class RemoteRuntime(ContainerRuntime):
                        pb.ListContainersResponse)
         self._logs = u("ContainerLogs", pb.ContainerLogsRequest,
                        pb.ContainerLogsResponse)
+        self._exec = u("ExecSync", pb.ExecSyncRequest, pb.ExecSyncResponse)
 
     def version(self) -> tuple[str, str]:
         resp = self._version(pb.VersionRequest(version=RUNTIME_VERSION),
@@ -220,6 +244,21 @@ class RemoteRuntime(ContainerRuntime):
             self._logs, pb.ContainerLogsRequest(
                 container_id=container_id, tail=tail or 0), timeout=30)
         return resp.content
+
+    async def exec_in_container(self, container_id: str, argv: list[str],
+                                timeout: float = 30.0) -> tuple[int, str]:
+        try:
+            resp = await asyncio.to_thread(
+                self._exec, pb.ExecSyncRequest(
+                    container_id=container_id, command=argv, timeout=timeout),
+                timeout=timeout + 45)
+        except grpc.RpcError as e:
+            if e.code() == grpc.StatusCode.UNIMPLEMENTED:
+                # Round-trip the seam contract: callers (the agent's
+                # /exec route) map this to 501, not 500.
+                raise NotImplementedError(e.details()) from None
+            raise
+        return resp.exit_code, resp.output
 
     def close(self) -> None:
         self._channel.close()

@@ -76,6 +76,13 @@ class ContainerRuntime:
     async def container_logs(self, container_id: str, tail: Optional[int] = None) -> str:
         raise NotImplementedError
 
+    async def exec_in_container(self, container_id: str, argv: list[str],
+                                timeout: float = 30.0) -> tuple[int, str]:
+        """Run a command in the container's context; (exit code,
+        combined output). Reference: the kubelet exec path
+        (``pkg/kubelet/server/server.go`` exec handlers)."""
+        raise NotImplementedError
+
 
 class ProcessRuntime(ContainerRuntime):
     """Pods as local OS processes under a per-node root directory."""
@@ -86,6 +93,7 @@ class ProcessRuntime(ContainerRuntime):
         #: runtime creation; keep its cwd importable after the cwd moves
         #: into the per-container sandbox.
         self._host_cwd = os.getcwd()
+        self._configs: dict[str, ContainerConfig] = {}
         os.makedirs(root_dir, exist_ok=True)
         self._procs: dict[str, asyncio.subprocess.Process] = {}
         self._status: dict[str, ContainerStatus] = {}
@@ -95,6 +103,18 @@ class ProcessRuntime(ContainerRuntime):
     def _log_path(self, cid: str) -> str:
         return os.path.join(self.root_dir, "logs", f"{cid}.log")
 
+    def _container_env(self, config: ContainerConfig, cid: str) -> dict:
+        """The container's full environment — shared by start and exec
+        so an exec'd command sees exactly what the main process does
+        (KTPU_POD, KTPU_SANDBOX, PYTHONPATH included)."""
+        env = dict(os.environ)
+        env.update(config.env)
+        env["KTPU_POD"] = f"{config.pod_namespace}/{config.pod_name}"
+        env["KTPU_SANDBOX"] = os.path.join(self.root_dir, "sandboxes", cid)
+        env["PYTHONPATH"] = (f"{self._host_cwd}:{env['PYTHONPATH']}"
+                             if env.get("PYTHONPATH") else self._host_cwd)
+        return env
+
     async def start_container(self, config: ContainerConfig) -> str:
         self._seq += 1
         cid = f"proc-{config.pod_uid[:8]}-{config.name}-{self._seq}"
@@ -103,9 +123,7 @@ class ProcessRuntime(ContainerRuntime):
             raise RuntimeError(f"container {config.name}: no command (image "
                                f"{config.image!r} is not a registry image in "
                                f"the process runtime)")
-        env = dict(os.environ)
-        env.update(config.env)
-        env["KTPU_POD"] = f"{config.pod_namespace}/{config.pod_name}"
+        env = self._container_env(config, cid)
         # Mount projection without privileges: a per-container sandbox
         # dir where each mount path appears as a symlink to its host
         # source, and which is the default cwd — so a container reading
@@ -140,9 +158,6 @@ class ProcessRuntime(ContainerRuntime):
                     f"conflicts with another mount (nested mounts are "
                     f"not supported by the process runtime)")
             os.symlink(host, link)
-        env["KTPU_SANDBOX"] = sandbox
-        env["PYTHONPATH"] = (f"{self._host_cwd}:{env['PYTHONPATH']}"
-                             if env.get("PYTHONPATH") else self._host_cwd)
         os.makedirs(os.path.dirname(self._log_path(cid)), exist_ok=True)
         log_f = open(self._log_path(cid), "wb")
         try:
@@ -164,6 +179,7 @@ class ProcessRuntime(ContainerRuntime):
             except Exception:  # noqa: BLE001
                 pass
         self._procs[cid] = proc
+        self._configs[cid] = config
         self._status[cid] = ContainerStatus(
             id=cid, name=config.name, pod_uid=config.pod_uid,
             state=STATE_RUNNING, started_at=time.time(), pid=proc.pid)
@@ -204,6 +220,7 @@ class ProcessRuntime(ContainerRuntime):
         w = self._waiters.pop(container_id, None)
         if w:
             w.cancel()
+        self._configs.pop(container_id, None)
         try:
             os.unlink(self._log_path(container_id))
         except OSError:
@@ -223,6 +240,35 @@ class ProcessRuntime(ContainerRuntime):
         if tail is not None:
             lines = lines[-tail:]
         return "".join(lines)
+
+    async def exec_in_container(self, container_id: str, argv: list[str],
+                                timeout: float = 30.0) -> tuple[int, str]:
+        """Run argv with the container's env + sandbox cwd — the
+        process-runtime shape of `kubectl exec` (same mounts view via
+        the sandbox symlinks)."""
+        config = self._configs.get(container_id)
+        if config is None:
+            raise KeyError(f"unknown container {container_id!r}")
+        env = self._container_env(config, container_id)
+        sandbox = env["KTPU_SANDBOX"]
+        proc = await asyncio.create_subprocess_exec(
+            *argv, stdout=asyncio.subprocess.PIPE,
+            stderr=asyncio.subprocess.STDOUT, env=env,
+            cwd=config.working_dir or
+            (sandbox if os.path.isdir(sandbox) else None),
+            start_new_session=True)
+        try:
+            out, _ = await asyncio.wait_for(proc.communicate(), timeout)
+        except asyncio.TimeoutError:
+            # Kill the whole process GROUP (a bare kill() leaves
+            # grandchildren running), then reap the child.
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                proc.kill()
+            await proc.wait()
+            return 124, "exec timed out"
+        return proc.returncode or 0, out.decode(errors="replace")
 
     async def shutdown(self) -> None:
         for cid in list(self._procs):
@@ -277,3 +323,9 @@ class FakeRuntime(ContainerRuntime):
 
     async def container_logs(self, container_id: str, tail: Optional[int] = None) -> str:
         return self._logs.get(container_id, "")
+
+    async def exec_in_container(self, container_id: str, argv: list[str],
+                                timeout: float = 30.0) -> tuple[int, str]:
+        if container_id not in self._status:
+            raise KeyError(f"unknown container {container_id!r}")
+        return 0, f"(fake exec) {' '.join(argv)}\n"

@@ -46,6 +46,7 @@ class NodeAgentServer:
         r.add_get("/healthz", self._healthz)
         r.add_get("/pods", self._pods)
         r.add_get("/logs/{namespace}/{pod}/{container}", self._logs)
+        r.add_post("/exec/{namespace}/{pod}/{container}", self._exec)
         r.add_get("/stats/summary", self._summary)
         r.add_get("/metrics", self._metrics)
         # /debug/pprof analog (server.go:295-403): live task + thread
@@ -65,6 +66,13 @@ class NodeAgentServer:
             {"items": [to_dict(p) for _, p in sorted(self.agent._pods.items())]})
 
     async def _logs(self, request):
+        cid = self._resolve_cid(request)
+        tail = request.query.get("tail")
+        text = await self.agent.runtime.container_logs(
+            cid, tail=int(tail) if tail else None)
+        return web.Response(text=text)
+
+    def _resolve_cid(self, request) -> str:
         ns = request.match_info["namespace"]
         pod = request.match_info["pod"]
         container = request.match_info["container"]
@@ -72,7 +80,7 @@ class NodeAgentServer:
         cmap = self.agent._containers.get(key, {})
         if not cmap:
             raise web.HTTPNotFound(text=f"no containers for pod {key}")
-        if container == "-":  # single-container convenience
+        if container == "-":
             if len(cmap) != 1:
                 raise web.HTTPBadRequest(
                     text=f"pod {key} has containers {sorted(cmap)}; pick one")
@@ -81,10 +89,31 @@ class NodeAgentServer:
         if cid is None:
             raise web.HTTPNotFound(
                 text=f"pod {key} has no container {container!r}")
-        tail = request.query.get("tail")
-        text = await self.agent.runtime.container_logs(
-            cid, tail=int(tail) if tail else None)
-        return web.Response(text=text)
+        return cid
+
+    async def _exec(self, request):
+        """kubelet exec analog (server.go exec handlers): run a command
+        in the container's context, return {exit_code, output}."""
+        cid = self._resolve_cid(request)
+        try:
+            body = await request.json()
+            argv = [str(a) for a in body["command"]]
+            timeout = float(body.get("timeout", 30.0))
+            if not argv:
+                raise ValueError("empty command")
+        except Exception:  # noqa: BLE001
+            raise web.HTTPBadRequest(
+                text='body must be {"command": ["prog", ...], '
+                     '"timeout": seconds?}') from None
+        try:
+            code, output = await self.agent.runtime.exec_in_container(
+                cid, argv, timeout=timeout)
+        except KeyError as e:
+            raise web.HTTPNotFound(text=str(e)) from None
+        except NotImplementedError:
+            raise web.HTTPNotImplemented(
+                text="runtime does not support exec") from None
+        return web.json_response({"exit_code": code, "output": output})
 
     async def _summary(self, request):
         summary = await self._collect()

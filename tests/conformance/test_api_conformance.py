@@ -1,0 +1,184 @@
+"""API conformance — generic verb semantics for EVERY registered
+resource (reference: test/conformance's API-behavior listing).
+
+One parametrized pass asserts the contract the rest of the framework
+relies on: create/get/list/update/patch/delete round-trips, server-
+owned metadata (uid, creation timestamp, monotonically advancing
+resource versions), optimistic concurrency, watch delivery, status
+subresource isolation, and namespace scoping — uniformly, so a new
+resource added to the registry inherits the whole contract check.
+"""
+import asyncio
+
+import pytest
+
+from kubernetes_tpu.api import errors
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.apiserver.registry import Registry, builtin_resources
+from kubernetes_tpu.client.local import LocalClient
+
+#: Resources whose create paths need bespoke required fields.
+SKIP = {
+    "events",          # recorder-owned, dedup semantics
+    "bindings",        # subresource-only
+}
+
+
+def minimal_object(spec) -> object:
+    obj = spec.cls()
+    obj.metadata = ObjectMeta(name=f"conf-{spec.plural[:12]}")
+    if spec.namespaced:
+        obj.metadata.namespace = "default"
+    if spec.kind == "Pod":
+        obj.spec.containers = [t.Container(name="c", image="img")]
+    if spec.kind == "Namespace":
+        obj.metadata.name = "conf-ns"
+        # Conformance exercises plain API delete semantics; the
+        # finalizer dance is the namespace controller's test scope.
+        obj.spec.finalizers = []
+    if spec.kind in ("ReplicaSet", "Deployment", "StatefulSet"):
+        from kubernetes_tpu.api.selectors import LabelSelector
+        obj.spec.selector = LabelSelector(match_labels={"app": "conf"})
+        obj.spec.template = t.PodTemplateSpec(
+            metadata=ObjectMeta(labels={"app": "conf"}),
+            spec=t.PodSpec(containers=[t.Container(name="c", image="img")]))
+    if spec.kind == "CustomResourceDefinition":
+        from kubernetes_tpu.api import extensions as ext
+        obj.spec = ext.CRDSpec(group="conf.example", version="v1",
+                               names=ext.CRDNames(plural="confwidgets",
+                                                  kind="ConfWidget"))
+        obj.metadata.name = "confwidgets.conf.example"
+    return obj
+
+
+CASES = [spec for spec in builtin_resources() if spec.plural not in SKIP]
+
+
+@pytest.mark.parametrize("spec", CASES, ids=lambda s: s.plural)
+def test_crud_conformance(spec):
+    reg = Registry()
+    reg.create(t.Namespace(metadata=ObjectMeta(name="default")))
+    obj = minimal_object(spec)
+
+    created = reg.create(obj)
+    assert created.metadata.uid, f"{spec.plural}: no uid stamped"
+    assert created.metadata.creation_timestamp is not None
+    assert created.metadata.resource_version
+    assert created.api_version == spec.api_version
+    assert created.kind == spec.kind
+
+    # Duplicate create -> AlreadyExists.
+    with pytest.raises(errors.AlreadyExistsError):
+        reg.create(minimal_object(spec))
+
+    got = reg.get(spec.plural, created.metadata.namespace,
+                  created.metadata.name)
+    assert got.metadata.uid == created.metadata.uid
+
+    items, rev = reg.list(spec.plural, created.metadata.namespace)
+    assert any(o.metadata.uid == created.metadata.uid for o in items)
+    assert rev >= int(created.metadata.resource_version)
+
+    # Update advances resource_version; stale RV conflicts.
+    got.metadata.labels["conformance"] = "true"
+    updated = reg.update(got)
+    assert int(updated.metadata.resource_version) > \
+        int(created.metadata.resource_version)
+    stale = reg.get(spec.plural, created.metadata.namespace,
+                    created.metadata.name)
+    stale.metadata.resource_version = created.metadata.resource_version
+    stale.metadata.labels["x"] = "y"
+    with pytest.raises(errors.ConflictError):
+        reg.update(stale)
+
+    # Merge-patch.
+    patched = reg.patch(spec.plural, created.metadata.namespace,
+                        created.metadata.name,
+                        {"metadata": {"labels": {"patched": "1"}}})
+    assert patched.metadata.labels.get("patched") == "1"
+    # uid is server-owned: a patch cannot change it.
+    same = reg.patch(spec.plural, created.metadata.namespace,
+                     created.metadata.name,
+                     {"metadata": {"uid": "forged"}})
+    assert same.metadata.uid == created.metadata.uid
+
+    # Label-selector list.
+    items, _ = reg.list(spec.plural, created.metadata.namespace,
+                        label_selector="patched=1")
+    assert len(items) == 1
+    items, _ = reg.list(spec.plural, created.metadata.namespace,
+                        label_selector="patched=0")
+    assert items == []
+
+
+@pytest.mark.parametrize("spec", CASES, ids=lambda s: s.plural)
+def test_status_subresource_isolation(spec):
+    if not spec.has_status:
+        pytest.skip("no status subresource")
+    reg = Registry()
+    reg.create(t.Namespace(metadata=ObjectMeta(name="default")))
+    created = reg.create(minimal_object(spec))
+    # A spec/meta update must not alter status; /status must not alter
+    # labels. Generic: set a label via update, then write status and
+    # confirm the label survived.
+    got = reg.get(spec.plural, created.metadata.namespace,
+                  created.metadata.name)
+    got.metadata.labels["keep"] = "me"
+    got = reg.update(got)
+    got2 = reg.get(spec.plural, created.metadata.namespace,
+                   created.metadata.name)
+    got2.metadata.labels.pop("keep", None)
+    reg.update(got2, subresource="status")
+    final = reg.get(spec.plural, created.metadata.namespace,
+                    created.metadata.name)
+    assert final.metadata.labels.get("keep") == "me", \
+        f"{spec.plural}: /status write clobbered metadata"
+
+
+@pytest.mark.parametrize("spec", CASES, ids=lambda s: s.plural)
+def test_delete_and_watch_conformance(spec):
+    async def run():
+        reg = Registry()
+        reg.create(t.Namespace(metadata=ObjectMeta(name="default")))
+        client = LocalClient(reg)
+        created = reg.create(minimal_object(spec))
+        _, rev = reg.list(spec.plural, created.metadata.namespace)
+        stream = await client.watch(spec.plural,
+                                    created.metadata.namespace, rev)
+        await client.delete(spec.plural, created.metadata.namespace,
+                            created.metadata.name,
+                            grace_period_seconds=0)
+        # Deletion must surface on the watch (possibly after MODIFIED
+        # events for graceful-delete marking).
+        for _ in range(10):
+            ev = await asyncio.wait_for(stream.next(timeout=2.0), 4.0)
+            assert ev is not None, f"{spec.plural}: no watch delivery"
+            if ev[0] == "DELETED":
+                break
+        else:
+            raise AssertionError(f"{spec.plural}: DELETED never delivered")
+        stream.cancel()
+        with pytest.raises(errors.NotFoundError):
+            reg.get(spec.plural, created.metadata.namespace,
+                    created.metadata.name)
+
+    asyncio.run(run())
+
+
+def test_namespaced_scoping():
+    reg = Registry()
+    for ns in ("a", "b"):
+        reg.create(t.Namespace(metadata=ObjectMeta(name=ns)))
+    for ns in ("a", "b"):
+        reg.create(t.ConfigMap(metadata=ObjectMeta(name="same", namespace=ns),
+                               data={"ns": ns}))
+    assert reg.get("configmaps", "a", "same").data["ns"] == "a"
+    assert reg.get("configmaps", "b", "same").data["ns"] == "b"
+    items, _ = reg.list("configmaps", "a")
+    assert {o.metadata.namespace for o in items} == {"a"}
+    all_items, _ = reg.list("configmaps", "")
+    assert {o.metadata.namespace for o in all_items} >= {"a", "b"}
+    # Cluster-scoped resources reject namespaces in keys.
+    with pytest.raises(errors.StatusError):
+        reg.get("nodes", "", "nope")

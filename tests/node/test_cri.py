@@ -137,3 +137,28 @@ async def test_local_cluster_via_cri(tmp_path):
     finally:
         await client.close()
         await cluster.stop()
+
+
+@pytest.mark.asyncio
+async def test_exec_over_cri_and_in_process(tmp_path):
+    inner = ProcessRuntime(str(tmp_path))
+    server = CRIServer(inner)
+    server.serve(str(tmp_path / "cri.sock"))
+    remote = RemoteRuntime(server.socket_path)
+    try:
+        cid = await remote.start_container(ContainerConfig(
+            pod_namespace="default", pod_name="p", pod_uid="u1", name="c",
+            image="local", command=["sleep", "30"],
+            env={"EXEC_MARK": "here"}))
+        code, out = await remote.exec_in_container(
+            cid, ["python3", "-c", "import os; print(os.environ['EXEC_MARK'])"])
+        assert code == 0 and "here" in out
+        code, out = await remote.exec_in_container(
+            cid, ["python3", "-c", "raise SystemExit(9)"])
+        assert code == 9
+        with pytest.raises(Exception):
+            await remote.exec_in_container("nope", ["true"])
+    finally:
+        remote.close()
+        server.stop()
+        await inner.shutdown()
