@@ -285,7 +285,8 @@ async def cmd_exec(args) -> int:
         import aiohttp
         async with aiohttp.ClientSession() as s:
             url = f"{base}/exec/{args.namespace}/{args.pod}/{container}"
-            async with s.post(url, json={"command": args.cmd}) as r:
+            async with s.post(url, json={"command": args.cmd,
+                                         "timeout": args.timeout}) as r:
                 if r.status != 200:
                     raise SystemExit(f"ktl: {(await r.text()).strip()}")
                 body = await r.json()
@@ -561,6 +562,8 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("cmd", nargs="+", help="command (prefix with -- )")
     sp.add_argument("-n", "--namespace", default="default")
     sp.add_argument("-c", "--container", default="")
+    sp.add_argument("--timeout", type=float, default=30.0,
+                    help="kill the command after this many seconds")
 
     sp = add("up", cmd_up, help="run a single-process cluster")
     sp.add_argument("--nodes", type=int, default=1)

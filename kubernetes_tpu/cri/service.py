@@ -253,10 +253,13 @@ class RemoteRuntime(ContainerRuntime):
                     container_id=container_id, command=argv, timeout=timeout),
                 timeout=timeout + 45)
         except grpc.RpcError as e:
+            # Round-trip the seam contract: callers (the agent's /exec
+            # route) map NotImplementedError->501 and KeyError->404,
+            # same as the in-process runtime raises.
             if e.code() == grpc.StatusCode.UNIMPLEMENTED:
-                # Round-trip the seam contract: callers (the agent's
-                # /exec route) map this to 501, not 500.
                 raise NotImplementedError(e.details()) from None
+            if e.code() == grpc.StatusCode.NOT_FOUND:
+                raise KeyError(e.details()) from None
             raise
         return resp.exit_code, resp.output
 

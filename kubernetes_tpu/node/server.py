@@ -97,6 +97,8 @@ class NodeAgentServer:
         cid = self._resolve_cid(request)
         try:
             body = await request.json()
+            if not isinstance(body.get("command"), list):
+                raise ValueError("command must be a list")
             argv = [str(a) for a in body["command"]]
             timeout = float(body.get("timeout", 30.0))
             if not argv:

@@ -43,6 +43,26 @@ class LMConfig:
     #: realistic sizes; recomputing trades ~1/3 more FLOPs for O(L*T)
     #: residuals — the standard TPU memory/compute trade.
     remat: bool = True
+    #: Remat policy: "full" recomputes everything (min memory);
+    #: "dots" saves matmul outputs and recomputes only cheap
+    #: elementwise ops (jax.checkpoint_policies.dots_with_no_batch_dims
+    #: _saveable) — attention scores have batch dims so the O(T^2)
+    #: buffers are still recomputed, but the expensive MXU work is not,
+    #: buying back most of remat's ~33% FLOP overhead.
+    remat_policy: str = "dots"
+    #: Attention kernel: "ring" (sequence-parallel ring over the sp
+    #: axis; degenerates to blockwise on one device) or "flash" (the
+    #: pallas TPU flash-attention kernel — fastest single-device path;
+    #: only valid when the sequence axis is unsharded).
+    attn_impl: str = "ring"
+
+    def __post_init__(self):
+        if self.remat_policy not in ("full", "dots"):
+            raise ValueError(f"remat_policy must be 'full' or 'dots', "
+                             f"got {self.remat_policy!r}")
+        if self.attn_impl not in ("ring", "flash"):
+            raise ValueError(f"attn_impl must be 'ring' or 'flash', "
+                             f"got {self.attn_impl!r}")
 
     @property
     def head_dim(self) -> int:
@@ -113,6 +133,22 @@ def _rope(x, cfg: LMConfig):
     return jnp.stack([y1, y2], axis=-1).reshape(x.shape).astype(x.dtype)
 
 
+def _flash_attention(q, k, v):
+    """Causal flash attention via the public pallas TPU kernel
+    (jax.experimental.pallas.ops.tpu.flash_attention) — O(T) memory,
+    fused softmax, the single-device fast path. Off-TPU the reference
+    kernel substitutes (pallas kernels need a TPU backend); ON TPU,
+    kernel errors surface loudly — silently degrading to the O(T^2)
+    path would misreport which kernel a benchmark ran."""
+    if jax.devices()[0].platform != "tpu":
+        from .ring_attention import reference_attention
+        return reference_attention(q, k, v).astype(q.dtype)
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        flash_attention as _pallas_flash)
+    return _pallas_flash(q, k, v, causal=True,
+                         sm_scale=1.0 / (q.shape[-1] ** 0.5))
+
+
 def forward(params: dict, tokens, cfg: LMConfig, mesh) -> jax.Array:
     """tokens [B, T] int32 -> logits [B, T, vocab] float32."""
     cdt = cfg.compute_dtype
@@ -129,7 +165,14 @@ def forward(params: dict, tokens, cfg: LMConfig, mesh) -> jax.Array:
         k = (y @ lp["wk"].astype(cdt)).reshape(b, t, h, dh).transpose(0, 2, 1, 3)
         v = (y @ lp["wv"].astype(cdt)).reshape(b, t, h, dh).transpose(0, 2, 1, 3)
         q, k = _rope(q, cfg), _rope(k, cfg)
-        o = ring_attention(q, k, v, mesh)
+        if cfg.attn_impl == "flash":
+            if mesh.shape.get("sp", 1) != 1:
+                raise ValueError("attn_impl='flash' requires an unsharded "
+                                 "sequence axis (sp=1); use 'ring' for "
+                                 "sequence parallelism")
+            o = _flash_attention(q, k, v)
+        else:
+            o = ring_attention(q, k, v, mesh)
         o = o.transpose(0, 2, 1, 3).reshape(b, t, h * dh)
         x = x + lax.with_sharding_constraint(o @ lp["wo"].astype(cdt), act)
 
@@ -138,7 +181,15 @@ def forward(params: dict, tokens, cfg: LMConfig, mesh) -> jax.Array:
         x = x + lax.with_sharding_constraint(gate @ lp["w2"].astype(cdt), act)
         return x, None
 
-    body = jax.checkpoint(layer) if cfg.remat else layer
+    if cfg.remat:
+        if cfg.remat_policy == "dots":
+            body = jax.checkpoint(
+                layer,
+                policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+        else:
+            body = jax.checkpoint(layer)
+    else:
+        body = layer
     x, _ = lax.scan(body, x, params["layers"])
     x = _rms_norm(x, params["ln_f"].astype(cdt))
     return (x @ params["embed"].astype(cdt).T).astype(jnp.float32)

@@ -1,0 +1,31 @@
+
+
+def test_flash_attn_config_and_fallback():
+    """attn_impl='flash' trains on CPU via the reference-kernel
+    substitute (pallas needs TPU); config typos are rejected; flash
+    refuses a sharded sequence axis."""
+    import asyncio
+
+    import jax
+    import pytest
+
+    from kubernetes_tpu.workloads import lm
+    from kubernetes_tpu.workloads.sharding import make_mesh
+
+    with pytest.raises(ValueError):
+        lm.LMConfig(attn_impl="fash")
+    with pytest.raises(ValueError):
+        lm.LMConfig(remat_policy="dot")
+
+    mesh = make_mesh(jax.devices()[:1])
+    cfg_ring = lm.LMConfig(vocab=128, d_model=64, n_layers=2, n_heads=2,
+                           d_ff=128, attn_impl="ring")
+    cfg_flash = lm.LMConfig(vocab=128, d_model=64, n_layers=2, n_heads=2,
+                            d_ff=128, attn_impl="flash")
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (2, 33), 0, 128)
+    losses = {}
+    for name, cfg in [("ring", cfg_ring), ("flash", cfg_flash)]:
+        params, opt = lm.init_sharded(jax.random.PRNGKey(0), cfg, mesh)
+        _, _, loss = lm.make_train_step(cfg, mesh)(params, opt, tokens)
+        losses[name] = float(loss)
+    assert abs(losses["ring"] - losses["flash"]) < 5e-2, losses
