@@ -283,7 +283,10 @@ async def cmd_exec(args) -> int:
                              "reachable agent server")
         container = args.container or "-"
         import aiohttp
-        async with aiohttp.ClientSession() as s:
+        # The HTTP call must outlive the exec's own timeout (aiohttp's
+        # default 300s total would abort long execs client-side).
+        client_timeout = aiohttp.ClientTimeout(total=args.timeout + 30)
+        async with aiohttp.ClientSession(timeout=client_timeout) as s:
             url = f"{base}/exec/{args.namespace}/{args.pod}/{container}"
             async with s.post(url, json={"command": args.cmd,
                                          "timeout": args.timeout}) as r:

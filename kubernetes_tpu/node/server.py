@@ -103,6 +103,8 @@ class NodeAgentServer:
             timeout = float(body.get("timeout", 30.0))
             if not argv:
                 raise ValueError("empty command")
+            if not (0 < timeout <= 3600):  # rejects NaN/inf/negatives
+                raise ValueError("timeout must be in (0, 3600]")
         except Exception:  # noqa: BLE001
             raise web.HTTPBadRequest(
                 text='body must be {"command": ["prog", ...], '

@@ -122,8 +122,12 @@ class SliceInfo:
 
 class SchedulerCache:
     def __init__(self) -> None:
+        from .equivalence import EquivalenceCache
         self.nodes: dict[str, NodeInfo] = {}
         self.slices: dict[str, SliceInfo] = {}
+        #: Predicate equivalence cache (equivalence_cache.go analog);
+        #: invalidated per node on every accounting mutation below.
+        self.equiv = EquivalenceCache()
         #: pod key -> node name for assumed (bound-in-flight) pods.
         self.assumed: dict[str, str] = {}
         #: pod key -> node name for every pod known to the cache
@@ -145,8 +149,10 @@ class SchedulerCache:
             info.node = node
         info.recompute_chips()
         self._rebuild_slice_for(node)
+        self.equiv.invalidate_node(node.metadata.name)
 
     def remove_node(self, name: str) -> None:
+        self.equiv.invalidate_node(name)
         info = self.nodes.pop(name, None)
         if info and info.node and info.node.status.tpu:
             sid = info.node.status.tpu.slice_id
@@ -196,6 +202,7 @@ class SchedulerCache:
                 prev = self.nodes.get(prev_node)
                 if prev and key in prev.pods:
                     prev.remove_pod(prev.pods[key])
+                self.equiv.invalidate_node(prev_node)
             else:
                 info = self.nodes[node_name]
                 if key in info.pods:
@@ -205,8 +212,10 @@ class SchedulerCache:
             old_info = self.nodes.get(old_node)
             if old_info and key in old_info.pods:
                 old_info.remove_pod(old_info.pods[key])
+            self.equiv.invalidate_node(old_node)
         self._node_for(node_name).add_pod(pod)
         self._pod_node[key] = node_name
+        self.equiv.invalidate_node(node_name)
 
     def update_pod(self, pod: t.Pod) -> None:
         self.add_pod(pod)
@@ -219,6 +228,8 @@ class SchedulerCache:
         if info:
             existing = info.pods.get(key, pod)
             info.remove_pod(existing)
+        if node_name:
+            self.equiv.invalidate_node(node_name)
 
     # -- assume / forget (bind-in-flight bookkeeping) ---------------------
 
@@ -229,6 +240,7 @@ class SchedulerCache:
         self._node_for(node_name).add_pod(pod)
         self.assumed[pod.key()] = node_name
         self._pod_node[pod.key()] = node_name
+        self.equiv.invalidate_node(node_name)
 
     def forget_pod(self, pod: t.Pod) -> None:
         """Bind failed: credit everything back."""
@@ -240,3 +252,4 @@ class SchedulerCache:
         info = self.nodes.get(node_name)
         if info and key in info.pods:
             info.remove_pod(info.pods[key])
+        self.equiv.invalidate_node(node_name)

@@ -1,0 +1,72 @@
+"""Predicate equivalence cache.
+
+Reference: ``plugin/pkg/scheduler/core/equivalence_cache.go`` — pods
+that are interchangeable for predicate purposes (same requests,
+selectors, tolerations, affinity) share cached per-node fit results,
+so scheduling N identical replicas costs one predicate pass plus cache
+hits instead of N full scans. Entries are invalidated per node on ANY
+accounting change there (add/remove/assume/forget/node update) —
+correctness first, the hit rate comes from the untouched nodes.
+
+TPU pods are NEVER cached: chip geometry changes with every allocation
+on the node, so their fit answer is inherently per-state.
+"""
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+from ..api import types as t
+from ..api.scheme import to_dict
+
+
+def equivalence_hash(pod: t.Pod) -> Optional[int]:
+    """Equivalence-class key, or None when the pod must not be cached.
+    The payload must cover EVERY pod field any predicate reads
+    (requests, selectors, tolerations, affinity, pressure-relevant
+    requests) — adding a predicate that reads a new field means
+    extending this payload."""
+    if pod.spec.tpu_resources:
+        return None
+    payload = {
+        "req": t.pod_resource_requests(pod),
+        "sel": pod.spec.node_selector,
+        "tol": [(x.key, x.operator, x.value, x.effect)
+                for x in pod.spec.tolerations],
+        "aff": to_dict(pod.spec.affinity) if pod.spec.affinity else None,
+    }
+    return hash(json.dumps(payload, sort_keys=True, default=str))
+
+
+class EquivalenceCache:
+    #: Max equivalence classes kept per node — one-off pods each mint a
+    #: fresh class, and accounting-quiet (full/cordoned) nodes never
+    #: invalidate, so an unbounded map grows monotonically. FIFO evict.
+    MAX_CLASSES_PER_NODE = 128
+
+    def __init__(self):
+        #: node name -> {eq hash: (fits, reasons)} (insertion-ordered)
+        self._by_node: dict[str, dict[int, tuple[bool, list[str]]]] = {}
+        self.hits = 0
+        self.misses = 0
+
+    def lookup(self, node_name: str, eq: int) -> Optional[tuple[bool, list]]:
+        got = self._by_node.get(node_name, {}).get(eq)
+        if got is None:
+            self.misses += 1
+        else:
+            self.hits += 1
+        return got
+
+    def store(self, node_name: str, eq: int, fits: bool,
+              reasons: list) -> None:
+        entries = self._by_node.setdefault(node_name, {})
+        while len(entries) >= self.MAX_CLASSES_PER_NODE:
+            entries.pop(next(iter(entries)))
+        entries[eq] = (fits, list(reasons))
+
+    def invalidate_node(self, node_name: str) -> None:
+        self._by_node.pop(node_name, None)
+
+    def invalidate_all(self) -> None:
+        self._by_node.clear()

@@ -108,11 +108,32 @@ def tpu_defrag_score(pod: t.Pod, info: NodeInfo,
     return MAX_SCORE * (1.0 - exposure / worst) if worst else MAX_SCORE
 
 
+def resource_limits(pod: t.Pod, info: NodeInfo) -> float:
+    """Score nodes able to satisfy the pod's LIMITS (not just requests)
+    — burstable pods land where their ceiling actually fits.
+    Reference: ``algorithm/priorities/resource_limits.go``
+    (ResourceLimitsPriorityMap, alpha-gated in the fork,
+    ``algorithmprovider/defaults/defaults.go:112-116``)."""
+    limits: dict[str, float] = {}
+    for c in pod.spec.containers:
+        for res, amount in c.resources.limits.items():
+            limits[res] = limits.get(res, 0.0) + t.parse_quantity(amount)
+    if not limits:
+        return 0.0
+    alloc = info.allocatable()
+    for res in (t.RESOURCE_CPU, t.RESOURCE_MEMORY):
+        want = limits.get(res)
+        if want and alloc.get(res, 0.0) - info.requested.get(res, 0.0) < want:
+            return 0.0
+    return MAX_SCORE
+
+
 #: (name, fn(pod, info) -> 0..10, weight)
 DEFAULT_PRIORITIES = [
     ("LeastRequested", least_requested, 1.0),
     ("BalancedAllocation", balanced_allocation, 1.0),
     ("NodeAffinity", node_affinity_preferred, 2.0),
+    ("ResourceLimits", resource_limits, 1.0),
 ]
 TPU_DEFRAG_WEIGHT = 2.0
 

@@ -235,14 +235,27 @@ class Scheduler:
         enough = n if (wants_tpu or n <= 100) else max(100, n // 20)
         start_at = self._ring_offset % n if n else 0
         self._ring_offset += 1
+        # Equivalence cache (equivalence_cache.go analog): identical
+        # pods reuse per-node predicate verdicts until that node's
+        # accounting changes.
+        from .equivalence import equivalence_hash
+        eq = equivalence_hash(pod)
         for idx in range(n):
             name = names[(start_at + idx) % n]
             info = self.cache.nodes.get(name)
             if info is None or info.node is None:
                 continue
-            res = run_predicates(pod, info, skip_tpu=True)
-            if not res.fits:
-                reasons.append(f"{name}: {'; '.join(res.reasons)}")
+            cached = (self.cache.equiv.lookup(name, eq)
+                      if eq is not None else None)
+            if cached is not None:
+                fits, cached_reasons = cached
+            else:
+                res = run_predicates(pod, info, skip_tpu=True)
+                fits, cached_reasons = res.fits, res.reasons
+                if eq is not None:
+                    self.cache.equiv.store(name, eq, fits, cached_reasons)
+            if not fits:
+                reasons.append(f"{name}: {'; '.join(cached_reasons)}")
                 continue
             if wants_tpu:
                 bindings = select_chips(pod, info)

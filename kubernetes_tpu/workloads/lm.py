@@ -15,6 +15,7 @@ payload. Pure JAX, designed for the MXU and XLA's compilation model:
 from __future__ import annotations
 
 import dataclasses
+import math
 from typing import Any
 
 import jax
@@ -166,10 +167,11 @@ def forward(params: dict, tokens, cfg: LMConfig, mesh) -> jax.Array:
         v = (y @ lp["wv"].astype(cdt)).reshape(b, t, h, dh).transpose(0, 2, 1, 3)
         q, k = _rope(q, cfg), _rope(k, cfg)
         if cfg.attn_impl == "flash":
-            if mesh.shape.get("sp", 1) != 1:
-                raise ValueError("attn_impl='flash' requires an unsharded "
-                                 "sequence axis (sp=1); use 'ring' for "
-                                 "sequence parallelism")
+            if math.prod(mesh.shape.values()) != 1:
+                raise ValueError(
+                    "attn_impl='flash' is the single-device fast path "
+                    "(the pallas custom call has no SPMD partitioning "
+                    "rule); use 'ring' on multi-device meshes")
             o = _flash_attention(q, k, v)
         else:
             o = ring_attention(q, k, v, mesh)

@@ -1,0 +1,80 @@
+"""Equivalence cache tests (reference: equivalence_cache_test.go)."""
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.scheduler.cache import SchedulerCache
+from kubernetes_tpu.scheduler.equivalence import (EquivalenceCache,
+                                                  equivalence_hash)
+
+
+def mk_pod(name, cpu=1.0, tpu=False, selector=None):
+    pod = t.Pod(metadata=ObjectMeta(name=name, namespace="default",
+                                    uid=f"u-{name}"),
+                spec=t.PodSpec(node_selector=selector or {},
+                               containers=[t.Container(
+                                   name="c", image="i",
+                                   resources=t.ResourceRequirements(
+                                       requests={"cpu": cpu}))]))
+    if tpu:
+        pod.spec.tpu_resources = [t.PodTpuRequest(name="tpu", chips=1)]
+    return pod
+
+
+def mk_node(name, cpu=8.0):
+    node = t.Node(metadata=ObjectMeta(name=name))
+    node.status.capacity = {"cpu": cpu, "memory": 32.0 * 2**30, "pods": 110.0}
+    node.status.allocatable = dict(node.status.capacity)
+    node.status.conditions = [t.NodeCondition(type=t.NODE_READY, status="True")]
+    return node
+
+
+def test_hash_classes():
+    a, b = mk_pod("a"), mk_pod("b")
+    assert equivalence_hash(a) == equivalence_hash(b)  # names don't matter
+    assert equivalence_hash(mk_pod("c", cpu=2.0)) != equivalence_hash(a)
+    assert equivalence_hash(mk_pod("d", selector={"x": "y"})) != \
+        equivalence_hash(a)
+    # TPU pods are never cached: geometry is per-state.
+    assert equivalence_hash(mk_pod("e", tpu=True)) is None
+
+
+def test_lookup_store_invalidate():
+    ec = EquivalenceCache()
+    assert ec.lookup("n1", 42) is None
+    ec.store("n1", 42, True, [])
+    assert ec.lookup("n1", 42) == (True, [])
+    ec.invalidate_node("n1")
+    assert ec.lookup("n1", 42) is None
+    assert ec.hits == 1 and ec.misses == 2
+
+
+def test_cache_mutations_invalidate():
+    cache = SchedulerCache()
+    cache.set_node(mk_node("n1"))
+    cache.set_node(mk_node("n2"))
+    cache.equiv.store("n1", 7, True, [])
+    cache.equiv.store("n2", 7, True, [])
+    # assume touches only its node.
+    cache.assume_pod(mk_pod("p1"), "n1")
+    assert cache.equiv.lookup("n1", 7) is None
+    assert cache.equiv.lookup("n2", 7) == (True, [])
+    # node update invalidates.
+    cache.equiv.store("n2", 7, True, [])
+    cache.set_node(mk_node("n2", cpu=4.0))
+    assert cache.equiv.lookup("n2", 7) is None
+
+
+def test_stale_verdict_never_survives_accounting_change():
+    """The load-bearing property: a node filled up after a cached 'fits'
+    must not keep serving 'fits'."""
+    cache = SchedulerCache()
+    cache.set_node(mk_node("n1", cpu=2.0))
+    from kubernetes_tpu.scheduler.predicates import run_predicates
+    pod = mk_pod("p", cpu=1.5)
+    eq = equivalence_hash(pod)
+    res = run_predicates(pod, cache.nodes["n1"], skip_tpu=True)
+    cache.equiv.store("n1", eq, res.fits, res.reasons)
+    assert cache.equiv.lookup("n1", eq)[0] is True
+    cache.assume_pod(mk_pod("filler", cpu=1.5), "n1")
+    assert cache.equiv.lookup("n1", eq) is None  # must recompute
+    res2 = run_predicates(pod, cache.nodes["n1"], skip_tpu=True)
+    assert not res2.fits

@@ -4,8 +4,6 @@ def test_flash_attn_config_and_fallback():
     """attn_impl='flash' trains on CPU via the reference-kernel
     substitute (pallas needs TPU); config typos are rejected; flash
     refuses a sharded sequence axis."""
-    import asyncio
-
     import jax
     import pytest
 
