@@ -436,21 +436,19 @@ async def cmd_version(args) -> int:
 async def cmd_up(args) -> int:
     """Start a single-process cluster and block until SIGINT/SIGTERM
     (the local-up-cluster.sh analog)."""
-    from ..cluster.local import LocalCluster, NodeSpec
+    from ..cluster.config import config_from_args
+    from ..cluster.local import LocalCluster
     from ..util.features import GATES
 
-    if getattr(args, "feature_gates", ""):
-        GATES.parse(args.feature_gates)
-    specs = []
-    for i in range(args.nodes):
-        specs.append(NodeSpec(
-            name=f"node-{i}",
-            tpu_chips=args.tpu_chips if not args.real_tpu else 0,
-            real_tpu=args.real_tpu and i == 0))
-    authz_mode = getattr(args, "authorization_mode", "AlwaysAllow")
+    # All file/flag precedence lives in config_from_args — cmd_up reads
+    # the merged config unconditionally.
+    cfg = config_from_args(args)
+    specs = cfg.nodes
+    if cfg.feature_gates:
+        GATES.parse(cfg.feature_gates)
     tokens = user_groups = None
     admin_token = ""
-    if authz_mode == "RBAC":
+    if cfg.authorization_mode == "RBAC":
         # Bootstrap credential (reference: kubeadm's admin.conf): an
         # admin token in system:masters, used by the node agents and
         # recorded for the CLI — without it RBAC mode is a
@@ -460,12 +458,12 @@ async def cmd_up(args) -> int:
         admin_token = secrets.token_urlsafe(24)
         tokens = {admin_token: "admin"}
         user_groups = {"admin": {GROUP_MASTERS}}
-    cluster = LocalCluster(data_dir=args.data_dir or None, nodes=specs,
-                           host=args.host, port=args.port,
-                           durable=args.durable,
-                           tokens=tokens, user_groups=user_groups,
-                           authorization_mode=authz_mode,
-                           audit_log=getattr(args, "audit_log", ""))
+    cluster = LocalCluster(
+        data_dir=cfg.data_dir or None, nodes=specs,
+        host=cfg.host, port=cfg.port, durable=cfg.durable,
+        tokens=tokens, user_groups=user_groups,
+        authorization_mode=cfg.authorization_mode,
+        audit_log=cfg.audit_log)
     base = await cluster.start()
     os.makedirs(os.path.dirname(DEFAULT_CONFIG), exist_ok=True)
     # 0600 from birth — the admin token must never be world-readable,
@@ -476,9 +474,11 @@ async def cmd_up(args) -> int:
     # O_CREAT's mode only applies to NEW files; a pre-existing config
     # from an older run may be 0644 — tighten it regardless.
     os.chmod(DEFAULT_CONFIG, 0o600)
-    tpu_note = (" (node-0 probing real TPU)" if args.real_tpu else
-                f" ({args.tpu_chips} stub chips/node)" if args.tpu_chips else "")
-    print(f"cluster up at {base} — {args.nodes} node(s){tpu_note}")
+    real = [s.name for s in specs if s.real_tpu]
+    stub = sum(s.tpu_chips for s in specs)
+    tpu_note = (f" ({', '.join(real)} probing real TPU)" if real else
+                f" ({stub} stub chips total)" if stub else "")
+    print(f"cluster up at {base} — {len(specs)} node(s){tpu_note}")
     print(f"server recorded in {DEFAULT_CONFIG}; try: ktl get nodes")
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()
@@ -569,21 +569,29 @@ def build_parser() -> argparse.ArgumentParser:
                     help="kill the command after this many seconds")
 
     sp = add("up", cmd_up, help="run a single-process cluster")
-    sp.add_argument("--nodes", type=int, default=1)
-    sp.add_argument("--tpu-chips", type=int, default=0,
+    # SUPPRESS defaults: flag PRESENCE marks it explicitly passed, so
+    # config_from_args can layer flags over --config file values
+    # without default-value sentinels (real defaults live in
+    # cluster/config.py ClusterConfig).
+    S = argparse.SUPPRESS
+    sp.add_argument("--config", default="",
+                    help="ClusterConfig YAML (componentconfig analog); "
+                         "explicit flags override file values")
+    sp.add_argument("--nodes", type=int, default=S)
+    sp.add_argument("--tpu-chips", type=int, default=S,
                     help="stub chips per node")
-    sp.add_argument("--real-tpu", action="store_true",
+    sp.add_argument("--real-tpu", action="store_true", default=S,
                     help="probe real hardware on node-0")
-    sp.add_argument("--host", default="127.0.0.1")
-    sp.add_argument("--port", type=int, default=7070)
-    sp.add_argument("--data-dir", default="")
-    sp.add_argument("--durable", action="store_true",
+    sp.add_argument("--host", default=S)
+    sp.add_argument("--port", type=int, default=S)
+    sp.add_argument("--data-dir", default=S)
+    sp.add_argument("--durable", action="store_true", default=S,
                     help="persist state (WAL+snapshot) under --data-dir")
-    sp.add_argument("--feature-gates", default="",
+    sp.add_argument("--feature-gates", default=S,
                     help="comma-separated Gate=true|false overrides")
-    sp.add_argument("--authorization-mode", default="AlwaysAllow",
+    sp.add_argument("--authorization-mode", default=S,
                     choices=["AlwaysAllow", "RBAC"])
-    sp.add_argument("--audit-log", default="",
+    sp.add_argument("--audit-log", default=S,
                     help="write request audit JSONL to this path")
 
     return p

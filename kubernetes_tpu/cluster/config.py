@@ -1,0 +1,91 @@
+"""Cluster component config — declarative `ktl up` configuration.
+
+Reference: ``pkg/apis/componentconfig`` (serializable component configs
+as API-shaped objects, loadable from files) — the flags-versus-config
+duality the reference components share. One YAML document configures
+the whole single-process cluster:
+
+    kind: ClusterConfig
+    port: 7070
+    durable: true
+    feature_gates: "PodPriority=true"
+    authorization_mode: RBAC
+    audit_log: /tmp/audit.jsonl
+    nodes:
+      - {name: tpu-0, real_tpu: true, via_cri: true}
+      - {name: cpu-0}
+      - {name: hollow-0, fake_runtime: true, tpu_chips: 4}
+
+CLI flags override file values (the reference's precedence).
+"""
+from __future__ import annotations
+
+import dataclasses
+
+from .local import NodeSpec
+
+
+@dataclasses.dataclass
+class ClusterConfig:
+    host: str = "127.0.0.1"
+    port: int = 7070
+    data_dir: str = ""
+    durable: bool = False
+    feature_gates: str = ""
+    authorization_mode: str = "AlwaysAllow"
+    audit_log: str = ""
+    nodes: list = dataclasses.field(default_factory=list)
+
+
+_NODE_FIELDS = {f.name for f in dataclasses.fields(NodeSpec)}
+_CLUSTER_FIELDS = {f.name for f in dataclasses.fields(ClusterConfig)}
+
+
+def load_cluster_config(path: str) -> ClusterConfig:
+    import yaml
+    with open(path) as f:
+        raw = yaml.safe_load(f) or {}
+    if not isinstance(raw, dict):
+        raise ValueError(f"{path}: document must be a mapping")
+    if raw.get("kind", "ClusterConfig") != "ClusterConfig":
+        raise ValueError(f"{path}: kind must be ClusterConfig")
+    unknown = set(raw) - _CLUSTER_FIELDS - {"kind", "api_version"}
+    if unknown:
+        raise ValueError(f"{path}: unknown fields {sorted(unknown)}")
+    cfg = ClusterConfig(**{k: v for k, v in raw.items()
+                           if k in _CLUSTER_FIELDS and k != "nodes"})
+    for i, n in enumerate(raw.get("nodes") or []):
+        if not isinstance(n, dict):
+            raise ValueError(f"{path}: nodes[{i}] must be a mapping")
+        bad = set(n) - _NODE_FIELDS
+        if bad:
+            raise ValueError(f"{path}: nodes[{i}]: unknown fields "
+                             f"{sorted(bad)}")
+        if n.get("mesh_shape"):
+            n = {**n, "mesh_shape": tuple(n["mesh_shape"])}
+        cfg.nodes.append(NodeSpec(**n))
+    return cfg
+
+
+def config_from_args(args) -> ClusterConfig:
+    """THE single merge point for ``ktl up``: file config (if any) as
+    the base, every flag the user actually passed on top (flags use
+    argparse.SUPPRESS defaults, so presence == explicitly passed), and
+    a default node set when neither defines nodes."""
+    path = getattr(args, "config", "")
+    cfg = load_cluster_config(path) if path else ClusterConfig()
+    for name in ("host", "port", "data_dir", "durable", "feature_gates",
+                 "authorization_mode", "audit_log"):
+        if hasattr(args, name):
+            setattr(cfg, name, getattr(args, name))
+    node_flags = any(hasattr(args, k)
+                     for k in ("nodes", "tpu_chips", "real_tpu"))
+    if node_flags or not cfg.nodes:
+        count = getattr(args, "nodes", 1)
+        chips = getattr(args, "tpu_chips", 0)
+        real = getattr(args, "real_tpu", False)
+        cfg.nodes = [NodeSpec(name=f"node-{i}",
+                              tpu_chips=chips if not real else 0,
+                              real_tpu=real and i == 0)
+                     for i in range(count)]
+    return cfg

@@ -1,0 +1,72 @@
+"""ClusterConfig (componentconfig analog) tests."""
+import pytest
+
+from kubernetes_tpu.cluster.config import ClusterConfig, load_cluster_config
+
+
+def test_load_full_config(tmp_path):
+    p = tmp_path / "cluster.yaml"
+    p.write_text("""
+kind: ClusterConfig
+port: 7171
+durable: true
+feature_gates: "PodPriority=false"
+authorization_mode: RBAC
+nodes:
+  - {name: tpu-0, tpu_chips: 4, mesh_shape: [2, 2, 1], via_cri: true}
+  - {name: cpu-0}
+  - {name: hollow-0, fake_runtime: true}
+""")
+    cfg = load_cluster_config(str(p))
+    assert cfg.port == 7171 and cfg.durable
+    assert cfg.authorization_mode == "RBAC"
+    assert len(cfg.nodes) == 3
+    assert cfg.nodes[0].name == "tpu-0" and cfg.nodes[0].via_cri
+    assert cfg.nodes[0].mesh_shape == (2, 2, 1)
+    assert cfg.nodes[2].fake_runtime
+
+
+def test_unknown_fields_rejected(tmp_path):
+    p = tmp_path / "bad.yaml"
+    p.write_text("kind: ClusterConfig\nbogus: 1\n")
+    with pytest.raises(ValueError):
+        load_cluster_config(str(p))
+    p.write_text("kind: ClusterConfig\nnodes: [{name: a, wat: 1}]\n")
+    with pytest.raises(ValueError):
+        load_cluster_config(str(p))
+    p.write_text("kind: Other\n")
+    with pytest.raises(ValueError):
+        load_cluster_config(str(p))
+
+
+def test_flag_overrides(tmp_path):
+    """Flags layer over file values by PRESENCE (SUPPRESS defaults), so
+    an explicit flag equal to the built-in default still overrides."""
+    import argparse
+
+    from kubernetes_tpu.cluster.config import config_from_args
+
+    def args(**kw):
+        ns = argparse.Namespace(config=str(tmp_path / "c.yaml"))
+        for k, v in kw.items():
+            setattr(ns, k, v)
+        return ns
+
+    (tmp_path / "c.yaml").write_text(
+        "kind: ClusterConfig\nport: 9000\ndurable: true\n"
+        "authorization_mode: RBAC\n"
+        "nodes: [{name: filenode, tpu_chips: 2}]\n")
+    cfg = config_from_args(args())
+    assert cfg.port == 9000 and cfg.durable              # file wins
+    assert [s.name for s in cfg.nodes] == ["filenode"]
+    cfg = config_from_args(args(port=9999))
+    assert cfg.port == 9999 and cfg.durable              # flag overrides
+    # Explicit flag EQUAL to the built-in default still overrides.
+    cfg = config_from_args(args(authorization_mode="AlwaysAllow"))
+    assert cfg.authorization_mode == "AlwaysAllow"
+    # Node flags replace the file's node list.
+    cfg = config_from_args(args(nodes=3))
+    assert [s.name for s in cfg.nodes] == ["node-0", "node-1", "node-2"]
+    # No file at all: defaults + one node.
+    cfg = config_from_args(argparse.Namespace(config=""))
+    assert cfg.port == 7070 and len(cfg.nodes) == 1

@@ -194,7 +194,7 @@ async def test_orphan_scan_releases_missed_deletions():
     await ctl.start()
     try:
         await wait_for(lambda: reg.get("persistentvolumes", "", "held")
-                       .status.phase == t.PV_RELEASED)
+                       .status.phase == t.PV_RELEASED, timeout=20.0)
         assert reg.get("persistentvolumes", "", "held").spec.claim_ref is None
     finally:
         await ctl.stop()
