@@ -80,6 +80,12 @@ def config_from_args(args) -> ClusterConfig:
             setattr(cfg, name, getattr(args, name))
     node_flags = any(hasattr(args, k)
                      for k in ("nodes", "tpu_chips", "real_tpu"))
+    if node_flags and cfg.nodes:
+        # Silently discarding the file's typed node list for a rebuilt
+        # default one would lose configuration; make the conflict loud.
+        raise ValueError(
+            "--nodes/--tpu-chips/--real-tpu conflict with the config "
+            "file's `nodes:` list; edit the file or drop the flags")
     if node_flags or not cfg.nodes:
         count = getattr(args, "nodes", 1)
         chips = getattr(args, "tpu_chips", 0)

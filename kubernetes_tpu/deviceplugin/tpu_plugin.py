@@ -49,12 +49,19 @@ out = {"tpu": True, "backend": backend,
        "process_index": devices[0].process_index, "devices": []}
 for d in devices:
     coords = list(getattr(d, "coords", None) or (d.id, 0, 0))
-    out["devices"].append({
+    entry = {
         "index": d.id,
         "kind": d.device_kind,
         "coords": coords,
         "core_on_chip": getattr(d, "core_on_chip", 0),
-    })
+    }
+    try:
+        ms = d.memory_stats() or {}
+        entry["memory"] = {"hbm_used_bytes": ms.get("bytes_in_use", 0),
+                           "hbm_total_bytes": ms.get("bytes_limit", 0)}
+    except Exception:  # noqa: BLE001 — not exposed on every backend
+        pass
+    out["devices"].append(entry)
 print(json.dumps(out))
 """
 
@@ -139,6 +146,19 @@ class TpuDevicePlugin(StubTpuPlugin):
                          resource=resource)
         self._probe = probe
         self._platform_spec = _probe_env().get("JAX_PLATFORMS", "")
+
+    def chip_metrics(self) -> dict:
+        """Per-chip HBM stats from the startup probe — the
+        AcceleratorStats/DCGM seam (``node/stats.py chip_metrics``).
+        Values are a snapshot (the plugin process must not own libtpu;
+        the probe pays a full jax init, too heavy per scrape) and {} on
+        backends that expose no memory stats (e.g. tunneled TPU-VMs)."""
+        out = {}
+        for d in self._probe.get("devices", []):
+            mem = d.get("memory")
+            if mem and mem.get("hbm_total_bytes"):
+                out[f"tpu-{d['index']}"] = dict(mem)
+        return out
 
     def InitContainer(self, request, context) -> pb.InitContainerResponse:
         resp = super().InitContainer(request, context)

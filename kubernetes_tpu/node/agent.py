@@ -58,7 +58,8 @@ class NodeAgent:
                  pod_cidr: str = "",
                  proxy=None,
                  eviction: Optional[EvictionManager] = None,
-                 runtime_hook=None):
+                 runtime_hook=None,
+                 chip_metrics=None):
         self.client = client
         self.node_name = node_name
         self.runtime = runtime
@@ -87,6 +88,9 @@ class NodeAgent:
         self.eviction = eviction
         #: Container runtime hook (runtimehook.py); None disables.
         self.runtime_hook = runtime_hook
+        #: Per-chip utilization source for /stats/summary (stats.py
+        #: ChipMetricsSource; the device plugin provides it).
+        self.chip_metrics = chip_metrics
         #: ConfigMap/Secret/EmptyDir materialization (volumes.py).
         vol_dir = getattr(runtime, "root_dir", None) or os.path.join(
             tempfile.gettempdir(), f"ktpu-{node_name}")

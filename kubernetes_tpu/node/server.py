@@ -38,9 +38,12 @@ CHIP_ASSIGNED = Gauge("node_tpu_chip_assigned",
 class NodeAgentServer:
     def __init__(self, agent, collector: Optional[SummaryCollector] = None):
         self.agent = agent
+        # Single construction site for the default collector — the
+        # agent's chip_metrics seam (device plugin HBM stats) rides in.
         self.collector = collector or SummaryCollector(
             agent.node_name,
-            root_dir=getattr(agent.runtime, "root_dir", "/"))
+            root_dir=getattr(agent.runtime, "root_dir", "/"),
+            chip_metrics=getattr(agent, "chip_metrics", None))
         self.app = web.Application()
         r = self.app.router
         r.add_get("/healthz", self._healthz)

@@ -193,9 +193,13 @@ async def test_orphan_scan_releases_missed_deletions():
     ctl = PersistentVolumeBinder(client, factory, resync_seconds=0.2)
     await ctl.start()
     try:
-        await wait_for(lambda: reg.get("persistentvolumes", "", "held")
-                       .status.phase == t.PV_RELEASED, timeout=20.0)
-        assert reg.get("persistentvolumes", "", "held").spec.claim_ref is None
+        def released():
+            pv = reg.get("persistentvolumes", "", "held")
+            # Release is two writes (status first, then the ref clear);
+            # converged means BOTH landed.
+            return pv.status.phase == t.PV_RELEASED and \
+                pv.spec.claim_ref is None
+        await wait_for(released, timeout=20.0)
     finally:
         await ctl.stop()
 

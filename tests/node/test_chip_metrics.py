@@ -1,0 +1,36 @@
+"""Per-chip HBM metrics seam (AcceleratorStats/DCGM analog)."""
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.deviceplugin.tpu_plugin import TpuDevicePlugin
+from kubernetes_tpu.node.stats import SummaryCollector
+
+FAKE_PROBE = {
+    "tpu": True, "backend": "tpu", "process_index": 0,
+    "devices": [
+        {"index": 0, "kind": "TPU v5 lite", "coords": [0, 0, 0],
+         "memory": {"hbm_used_bytes": 2 << 30, "hbm_total_bytes": 16 << 30}},
+        {"index": 1, "kind": "TPU v5 lite", "coords": [1, 0, 0]},  # no stats
+    ],
+}
+
+
+def test_plugin_chip_metrics_from_probe():
+    plugin = TpuDevicePlugin(probe=FAKE_PROBE)
+    metrics = plugin.chip_metrics()
+    assert metrics == {"tpu-0": {"hbm_used_bytes": 2 << 30,
+                                 "hbm_total_bytes": 16 << 30}}
+
+
+def test_summary_merges_chip_metrics():
+    plugin = TpuDevicePlugin(probe=FAKE_PROBE)
+    topo_pb = plugin._topology
+    topo = t.TpuTopology(
+        chip_type=topo_pb.chip_type, slice_id=topo_pb.slice_id,
+        mesh_shape=list(topo_pb.mesh_shape),
+        chips=[t.TpuChip(id=c.id, health=c.health, coords=list(c.coords))
+               for c in topo_pb.chips])
+    collector = SummaryCollector("n0", chip_metrics=plugin.chip_metrics)
+    summary = collector.summary({}, {}, {}, topo)
+    by_id = {c["id"]: c for c in summary["tpu"]["chips"]}
+    assert by_id["tpu-0"]["hbm_used_bytes"] == 2 << 30
+    assert by_id["tpu-0"]["hbm_total_bytes"] == 16 << 30
+    assert "hbm_used_bytes" not in by_id["tpu-1"]
