@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# Headline benchmark + the reference-scale density run.
+# (reference: test/integration/scheduler_perf/test-performance.sh)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+python bench.py
+if [[ "${FULL:-}" == "1" ]]; then
+  python -m kubernetes_tpu.perf.density 1000 30000 rest
+fi

@@ -1,0 +1,6 @@
+#!/usr/bin/env bash
+# Single-process cluster (reference: hack/local-up-cluster.sh) — thin
+# wrapper over `ktl up`; all flags pass through.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec ./ktl up "$@"

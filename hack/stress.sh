@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# Race-shaped stress runs (reference: KUBE_RACE="-race" in
+# hack/make-rules/test.sh:107 — Python has no race detector, so the
+# equivalent discipline is hammering the concurrency-heavy suites until
+# ordering bugs surface; every flake found this way is a real race).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+N="${1:-10}"
+SUITES=(
+  tests/node/test_agent_restart_race.py
+  tests/node/test_eviction.py
+  tests/integration/test_gang_recovery.py
+  tests/integration/test_watch_resilience.py
+  tests/e2e/test_chaos.py
+  tests/unit/test_mvcc.py
+)
+for i in $(seq 1 "$N"); do
+  echo "=== stress round $i/$N ==="
+  python -m pytest "${SUITES[@]}" -q
+done

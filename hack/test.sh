@@ -1,0 +1,5 @@
+#!/usr/bin/env bash
+# Full test suite (reference: hack/make-rules/test.sh).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec python -m pytest tests/ -q "$@"

@@ -16,7 +16,9 @@ the whole single-process cluster:
       - {name: cpu-0}
       - {name: hollow-0, fake_runtime: true, tpu_chips: 4}
 
-CLI flags override file values (the reference's precedence).
+Scalar CLI flags override file values (the reference's precedence);
+node-shape flags (--nodes/--tpu-chips/--real-tpu) conflict loudly with
+a file `nodes:` list instead of silently replacing it.
 """
 from __future__ import annotations
 
@@ -69,9 +71,10 @@ def load_cluster_config(path: str) -> ClusterConfig:
 
 def config_from_args(args) -> ClusterConfig:
     """THE single merge point for ``ktl up``: file config (if any) as
-    the base, every flag the user actually passed on top (flags use
-    argparse.SUPPRESS defaults, so presence == explicitly passed), and
-    a default node set when neither defines nodes."""
+    the base, every scalar flag the user actually passed on top (flags
+    use argparse.SUPPRESS defaults, so presence == explicitly passed),
+    and a default node set when neither defines nodes. Node-shape flags
+    combined with a file `nodes:` list raise (no silent replacement)."""
     path = getattr(args, "config", "")
     cfg = load_cluster_config(path) if path else ClusterConfig()
     for name in ("host", "port", "data_dir", "durable", "feature_gates",

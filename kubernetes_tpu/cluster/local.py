@@ -225,9 +225,7 @@ class LocalCluster:
             status_interval=self.status_interval,
             heartbeat_interval=self.heartbeat_interval,
             proxy=proxy, eviction=eviction, runtime_hook=hook,
-            chip_metrics=(plugin.chip_metrics
-                          if spec.real_tpu and plugin is not None
-                          and hasattr(plugin, "chip_metrics") else None))
+            chip_metrics=plugin.chip_metrics if spec.real_tpu else None)
         await agent.start()
         return LocalNode(name=name, agent=agent, runtime=runtime,
                          client=client, plugin=plugin,

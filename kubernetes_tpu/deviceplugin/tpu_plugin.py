@@ -157,7 +157,13 @@ class TpuDevicePlugin(StubTpuPlugin):
         for d in self._probe.get("devices", []):
             mem = d.get("memory")
             if mem and mem.get("hbm_total_bytes"):
-                out[f"tpu-{d['index']}"] = dict(mem)
+                # 'used' at probe time (before any workload owns the
+                # chip) is NOT live utilization — publish it under a
+                # name that says so; total is static and trustworthy.
+                out[f"tpu-{d['index']}"] = {
+                    "hbm_total_bytes": mem["hbm_total_bytes"],
+                    "hbm_used_at_probe_bytes": mem.get("hbm_used_bytes", 0),
+                }
         return out
 
     def InitContainer(self, request, context) -> pb.InitContainerResponse:

@@ -64,16 +64,13 @@ def test_flag_overrides(tmp_path):
     # Explicit flag EQUAL to the built-in default still overrides.
     cfg = config_from_args(args(authorization_mode="AlwaysAllow"))
     assert cfg.authorization_mode == "AlwaysAllow"
-    # Node flags against a file node list are a loud conflict.
-    import pytest as _pytest
-    with _pytest.raises(ValueError):
-        config_from_args(args(nodes=3))
     # No file at all: defaults + one node.
     cfg = config_from_args(argparse.Namespace(config=""))
     assert cfg.port == 7070 and len(cfg.nodes) == 1
 
 
 def test_node_flags_conflict_with_file_nodes(tmp_path):
+    """Node-shape flags against a file node list are a loud conflict."""
     import argparse
 
     from kubernetes_tpu.cluster.config import config_from_args

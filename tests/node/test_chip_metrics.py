@@ -16,8 +16,8 @@ FAKE_PROBE = {
 def test_plugin_chip_metrics_from_probe():
     plugin = TpuDevicePlugin(probe=FAKE_PROBE)
     metrics = plugin.chip_metrics()
-    assert metrics == {"tpu-0": {"hbm_used_bytes": 2 << 30,
-                                 "hbm_total_bytes": 16 << 30}}
+    assert metrics == {"tpu-0": {"hbm_total_bytes": 16 << 30,
+                                 "hbm_used_at_probe_bytes": 2 << 30}}
 
 
 def test_summary_merges_chip_metrics():
@@ -31,6 +31,6 @@ def test_summary_merges_chip_metrics():
     collector = SummaryCollector("n0", chip_metrics=plugin.chip_metrics)
     summary = collector.summary({}, {}, {}, topo)
     by_id = {c["id"]: c for c in summary["tpu"]["chips"]}
-    assert by_id["tpu-0"]["hbm_used_bytes"] == 2 << 30
+    assert by_id["tpu-0"]["hbm_used_at_probe_bytes"] == 2 << 30
     assert by_id["tpu-0"]["hbm_total_bytes"] == 16 << 30
-    assert "hbm_used_bytes" not in by_id["tpu-1"]
+    assert "hbm_total_bytes" not in by_id["tpu-1"]
