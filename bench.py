@@ -34,6 +34,14 @@ def main() -> None:
                 run_density(n_nodes=200, n_pods=2000, via="rest"))
         except Exception as exc:  # noqa: BLE001
             sched["rest"] = {"error": str(exc)[:200]}
+        # Pod STARTUP latency through the full real stack (HTTP
+        # apiserver + scheduler + agents + real processes), vs the
+        # reference's 5s p50/p90/p99 SLO (metrics_util.go:46).
+        try:
+            from kubernetes_tpu.perf.startup_bench import run_startup
+            sched["startup"] = asyncio.run(run_startup(30, 2))
+        except Exception as exc:  # noqa: BLE001
+            sched["startup"] = {"error": str(exc)[:200]}
         sched_line = {
             "metric": "scheduler_pod_throughput",
             "value": sched["pods_per_second"],

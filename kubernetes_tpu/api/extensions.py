@@ -168,5 +168,72 @@ def validate_crd_update(new: CustomResourceDefinition,
                            f"changed: {', '.join(frozen)}")
 
 
+# ---------------------------------------------------------------------------
+# API aggregation (reference: kube-aggregator APIService)
+# ---------------------------------------------------------------------------
+
+AGGREGATION_V1 = "apiregistration/v1"
+
+
+@dataclass
+class APIServiceSpec:
+    """Delegate one group/version to an external apiserver (reference:
+    ``staging/src/k8s.io/kube-aggregator`` APIService). The target is a
+    direct URL (dev posture) or an in-cluster Service reference
+    resolved through its Endpoints."""
+    group: str = ""
+    version: str = "v1"
+    #: Direct base URL of the extension apiserver (e.g.
+    #: "http://127.0.0.1:9443"); takes precedence over service_*.
+    url: str = ""
+    service_namespace: str = ""
+    service_name: str = ""
+    service_port: int = 0
+
+
+@dataclass
+class APIServiceCondition:
+    type: str = ""       # Available
+    status: str = ""
+    reason: str = ""
+    message: str = ""
+
+
+@dataclass
+class APIServiceStatus:
+    conditions: list[APIServiceCondition] = field(default_factory=list)
+
+
+@dataclass
+class APIService(TypedObject):
+    spec: APIServiceSpec = field(default_factory=APIServiceSpec)
+    status: APIServiceStatus = field(default_factory=APIServiceStatus)
+
+
+def validate_apiservice(svc: APIService, is_create: bool = True) -> None:
+    errs = []
+    if not svc.spec.group or "/" in svc.spec.group:
+        errs.append("spec.group: required, no slashes")
+    if not svc.spec.version:
+        errs.append("spec.version: required")
+    if not svc.spec.url and not (svc.spec.service_namespace
+                                 and svc.spec.service_name
+                                 and svc.spec.service_port):
+        errs.append("spec: either url or service_{namespace,name,port} "
+                    "is required")
+    if svc.metadata.name != f"{svc.spec.version}.{svc.spec.group}":
+        errs.append(f"metadata.name: must be "
+                    f"'{svc.spec.version}.{svc.spec.group}'")
+    if errs:
+        raise InvalidError("; ".join(errs))
+
+
+def validate_apiservice_update(new: APIService, old: APIService) -> None:
+    """Updates must hold every create-time invariant (group shape,
+    name binding, target presence)."""
+    validate_apiservice(new, is_create=False)
+
+
 DEFAULT_SCHEME.register(EXTENSIONS_V1, "CustomResourceDefinition",
                         CustomResourceDefinition)
+DEFAULT_SCHEME.register(AGGREGATION_V1, "APIService", APIService)

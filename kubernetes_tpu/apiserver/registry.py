@@ -155,6 +155,10 @@ def builtin_resources() -> list[ResourceSpec]:
                      ext.EXTENSIONS_V1, ext.CustomResourceDefinition,
                      namespaced=False, validate_create=ext.validate_crd,
                      validate_update=ext.validate_crd_update),
+        ResourceSpec("apiservices", "APIService", ext.AGGREGATION_V1,
+                     ext.APIService, namespaced=False,
+                     validate_create=ext.validate_apiservice,
+                     validate_update=ext.validate_apiservice_update),
     ]
 
 

@@ -79,6 +79,9 @@ class APIServer:
         self._sa_index: dict[str, tuple[str, str]] = {}
         self._sa_index_at = float("-inf")
         self.sa_index_ttl = 10.0
+        self._agg_discovery: list = []
+        self._agg_discovery_at = float("-inf")
+        self._proxy_session = None
         self.app = web.Application(middlewares=[self._middleware])
         self._routes()
         self._runner: Optional[web.AppRunner] = None
@@ -124,6 +127,22 @@ class APIServer:
                 resp = self._err(errors.ForbiddenError(f"forbidden: {attrs}"))
                 code = resp.status
                 return resp
+            # Aggregation: delegate group/versions claimed by an
+            # APIService and NOT served locally (local resources win,
+            # like the reference's delegation chain ordering).
+            group = request.match_info.get("group")
+            if group:
+                version = request.match_info.get("version", "")
+                plural = request.match_info.get("plural", "")
+                spec = self.registry._by_plural.get(plural)
+                local = (spec is not None and
+                         spec.api_version == f"{group}/{version}")
+                if not local:
+                    target = self._apiservice_target(group, version)
+                    if target is not None:
+                        resp = await self._proxy(request, target)
+                        code = resp.status
+                        return resp
             resp = await handler(request)
             code = resp.status
             return resp
@@ -285,7 +304,119 @@ class APIServer:
                 "name": spec.plural, "kind": spec.kind,
                 "api_version": spec.api_version, "namespaced": spec.namespaced,
             })
+        out.extend(await self._aggregated_discovery())
         return web.json_response({"resources": out})
+
+    async def _aggregated_discovery(self) -> list:
+        """Merge aggregated apiservers' resources into /apis (reference:
+        the aggregator's discovery merge), filtered to each APIService's
+        claimed group and briefly cached."""
+        import time
+        if time.monotonic() - self._agg_discovery_at < 15.0:
+            return self._agg_discovery
+        merged: list = []
+        try:
+            services, _rev = self.registry.list("apiservices")
+        except errors.StatusError:
+            services = []
+        if services:
+            import aiohttp
+            for svc in services:
+                target = self._apiservice_target(svc.spec.group,
+                                                 svc.spec.version)
+                if target is None:
+                    continue
+                gv = f"{svc.spec.group}/{svc.spec.version}"
+                try:
+                    timeout = aiohttp.ClientTimeout(total=5)
+                    async with aiohttp.ClientSession(timeout=timeout) as s:
+                        async with s.get(f"{target}/apis") as resp:
+                            data = await resp.json()
+                    merged.extend(r for r in data.get("resources", [])
+                                  if r.get("api_version") == gv)
+                except Exception:  # noqa: BLE001 — extension down: skip
+                    continue
+        self._agg_discovery = merged
+        self._agg_discovery_at = time.monotonic()
+        return merged
+
+    # -- aggregation (kube-aggregator analog) -----------------------------
+
+    def _apiservice_target(self, group: str, version: str) -> Optional[str]:
+        """Base URL of the APIService delegated this group/version, or
+        None when served locally. Resolution: direct url, else the
+        referenced Service's first ready endpoint via its node address
+        (same hostNetwork convention the ServiceProxy uses)."""
+        try:
+            services, _rev = self.registry.list("apiservices")
+        except errors.StatusError:
+            return None
+        for svc in services:
+            if (svc.spec.group, svc.spec.version) != (group, version):
+                continue
+            if svc.spec.url:
+                return svc.spec.url.rstrip("/")
+            try:
+                eps = self.registry.get("endpoints",
+                                        svc.spec.service_namespace,
+                                        svc.spec.service_name)
+            except errors.StatusError:
+                return None
+            for subset in eps.subsets:
+                for addr in subset.addresses:
+                    host = addr.ip
+                    if addr.node_name:
+                        try:
+                            node = self.registry.get("nodes", "",
+                                                     addr.node_name)
+                            if node.status.addresses:
+                                host = node.status.addresses[0].address
+                        except errors.StatusError:
+                            pass
+                    return f"http://{host}:{svc.spec.service_port}"
+            return None
+        return None
+
+    def _proxy_sess(self):
+        """One long-lived session for the aggregation data path (the
+        RESTClient._sess pattern) — per-request sessions would pay
+        connector setup + a fresh TCP connection every call."""
+        import aiohttp
+        if self._proxy_session is None or self._proxy_session.closed:
+            self._proxy_session = aiohttp.ClientSession()
+        return self._proxy_session
+
+    async def _proxy(self, request: web.Request, target: str) -> web.StreamResponse:
+        """Reverse-proxy one request to an extension apiserver,
+        streaming the response. Watch streams run unbounded; everything
+        else gets a deadline so a hung extension cannot pin
+        max-in-flight slots forever."""
+        import aiohttp
+        url = target + request.rel_url.path_qs
+        body = await request.read()
+        is_watch = request.query.get("watch") in ("1", "true")
+        timeout = aiohttp.ClientTimeout(
+            total=None if is_watch else 60.0)
+        try:
+            upstream = await self._proxy_sess().request(
+                request.method, url, data=body or None, timeout=timeout,
+                headers={k: v for k, v in request.headers.items()
+                         if k.lower() in ("content-type", "accept")})
+        except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+            return self._err(errors.ServiceUnavailableError(
+                f"aggregated apiserver unreachable: {e}"))
+        try:
+            resp = web.StreamResponse(status=upstream.status)
+            resp.content_type = upstream.content_type or "application/json"
+            await resp.prepare(request)
+            async for chunk in upstream.content.iter_any():
+                await resp.write(chunk)
+            return resp
+        except (ConnectionResetError, asyncio.CancelledError,
+                asyncio.TimeoutError):
+            return resp
+        finally:
+            upstream.close()
 
     # -- helpers ----------------------------------------------------------
 
@@ -450,6 +581,8 @@ class APIServer:
         return self.port
 
     async def stop(self) -> None:
+        if self._proxy_session is not None and not self._proxy_session.closed:
+            await self._proxy_session.close()
         if self._runner:
             await self._runner.cleanup()
             self._runner = None

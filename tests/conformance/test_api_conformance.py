@@ -49,6 +49,11 @@ def minimal_object(spec) -> object:
                                names=ext.CRDNames(plural="confwidgets",
                                                   kind="ConfWidget"))
         obj.metadata.name = "confwidgets.conf.example"
+    if spec.kind == "APIService":
+        from kubernetes_tpu.api import extensions as ext
+        obj.spec = ext.APIServiceSpec(group="conf.example", version="v1",
+                                      url="http://127.0.0.1:1")
+        obj.metadata.name = "v1.conf.example"
     return obj
 
 

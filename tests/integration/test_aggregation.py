@@ -1,0 +1,108 @@
+"""API aggregation tests — a second ("extension") apiserver serves a
+group the main server proxies to (reference tier: kube-aggregator
+integration tests)."""
+import pytest
+
+from kubernetes_tpu.api import errors, extensions as ext, types as t
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.apiserver.registry import Registry
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.client.rest import RESTClient
+
+
+def mk_extension_registry():
+    """Extension apiserver registry serving metricwidgets.metrics.example."""
+    reg = Registry()
+    reg.create(t.Namespace(metadata=ObjectMeta(name="default")))
+    reg.create(ext.CustomResourceDefinition(
+        metadata=ObjectMeta(name="metricwidgets.metrics.example"),
+        spec=ext.CRDSpec(group="metrics.example", version="v1",
+                         names=ext.CRDNames(plural="metricwidgets",
+                                            kind="MetricWidget"))))
+    return reg
+
+
+def mk_apiservice(url):
+    return ext.APIService(
+        metadata=ObjectMeta(name="v1.metrics.example"),
+        spec=ext.APIServiceSpec(group="metrics.example", version="v1",
+                                url=url))
+
+
+async def test_aggregated_crud_and_discovery():
+    ext_srv = APIServer(mk_extension_registry())
+    ext_port = await ext_srv.start()
+    main = APIServer(Registry())
+    main.registry.create(t.Namespace(metadata=ObjectMeta(name="default")))
+    main_port = await main.start()
+    client = RESTClient(f"http://127.0.0.1:{main_port}")
+    try:
+        main.registry.create(mk_apiservice(f"http://127.0.0.1:{ext_port}"))
+
+        # Discovery through the MAIN server includes the remote group,
+        # so the plain REST client can resolve the plural.
+        cr = ext.CustomResource(
+            metadata=ObjectMeta(name="w1", namespace="default"),
+            spec={"series": "mfu"})
+        cr.api_version, cr.kind = "metrics.example/v1", "MetricWidget"
+        created = await client.create(cr)
+        assert created.spec == {"series": "mfu"}
+
+        got = await client.get("metricwidgets", "default", "w1")
+        assert got.kind == "MetricWidget"
+        items, _rev = await client.list("metricwidgets", "default")
+        assert len(items) == 1
+        # The object lives in the EXTENSION registry, not the main one.
+        assert ext_srv.registry.get("metricwidgets", "default",
+                                    "w1").spec == {"series": "mfu"}
+        with pytest.raises(errors.NotFoundError):
+            main.registry.spec_for("metricwidgets")
+
+        await client.delete("metricwidgets", "default", "w1")
+        with pytest.raises(errors.NotFoundError):
+            await client.get("metricwidgets", "default", "w1")
+
+        # Local resources always win over aggregation.
+        pods, _ = await client.list("pods", "default")
+        assert pods == []
+    finally:
+        await client.close()
+        await main.stop()
+        await ext_srv.stop()
+
+
+async def test_aggregated_backend_down_returns_503():
+    main = APIServer(Registry())
+    main.registry.create(t.Namespace(metadata=ObjectMeta(name="default")))
+    main_port = await main.start()
+    client = RESTClient(f"http://127.0.0.1:{main_port}")
+    try:
+        main.registry.create(mk_apiservice("http://127.0.0.1:1"))
+        with pytest.raises(errors.StatusError) as ei:
+            # Unknown plural would 404 from discovery first; hit the
+            # proxy path via an explicit group/version URL.
+            import aiohttp
+            async with aiohttp.ClientSession() as s:
+                async with s.get(
+                        f"http://127.0.0.1:{main_port}"
+                        f"/api/metrics.example/v1/widgets") as r:
+                    assert r.status == 503
+                    raise errors.StatusError.from_dict(await r.json())
+        assert ei.value.code == 503
+    finally:
+        await client.close()
+        await main.stop()
+
+
+def test_apiservice_validation():
+    with pytest.raises(errors.InvalidError):
+        ext.validate_apiservice(ext.APIService(
+            metadata=ObjectMeta(name="bad"),
+            spec=ext.APIServiceSpec(group="g", version="v1", url="http://x")))
+    with pytest.raises(errors.InvalidError):
+        ext.validate_apiservice(ext.APIService(
+            metadata=ObjectMeta(name="v1.g"),
+            spec=ext.APIServiceSpec(group="g", version="v1")))
+    ext.validate_apiservice(ext.APIService(
+        metadata=ObjectMeta(name="v1.g"),
+        spec=ext.APIServiceSpec(group="g", version="v1", url="http://x")))

@@ -14,3 +14,13 @@ async def test_density_respects_capacity():
     # exceeding its pods allocatable.
     res = await run_density(n_nodes=2, n_pods=200, timeout=60)
     assert res["max_pods_per_node"] <= 110
+
+
+async def test_startup_latency_meets_slo():
+    """Pod startup (create -> Running) through the full real stack must
+    beat the reference's 5s SLO with wide margin (metrics_util.go:46)."""
+    from kubernetes_tpu.perf.startup_bench import run_startup
+    res = await run_startup(n_pods=8, n_nodes=1)
+    assert res.get("pods") == 8, res
+    assert res["startup_p99_ms"] < res["slo_ms"], res
+    assert res["slo_met"]
