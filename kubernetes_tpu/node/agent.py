@@ -59,7 +59,8 @@ class NodeAgent:
                  proxy=None,
                  eviction: Optional[EvictionManager] = None,
                  runtime_hook=None,
-                 chip_metrics=None):
+                 chip_metrics=None,
+                 dynamic_config: bool = True):
         self.client = client
         self.node_name = node_name
         self.runtime = runtime
@@ -95,6 +96,15 @@ class NodeAgent:
         vol_dir = getattr(runtime, "root_dir", None) or os.path.join(
             tempfile.gettempdir(), f"ktpu-{node_name}")
         self.volumes = VolumeManager(client, vol_dir)
+        self._node_dir = vol_dir
+        #: Dynamic config from a ConfigMap (dynamicconfig.py); source
+        #: discovery piggybacks on the node-status loop, so an agent
+        #: with no config-source annotation pays nothing.
+        self.dynamic_config = None
+        if dynamic_config:
+            from .dynamicconfig import DynamicConfigManager
+            self.dynamic_config = DynamicConfigManager(
+                self, checkpoint_dir=self._node_dir)
 
         self._pods: dict[str, t.Pod] = {}        # key -> desired pod
         self._workers: dict[str, asyncio.Task] = {}
@@ -150,6 +160,8 @@ class NodeAgent:
             self._own_svc_informer = True
         await self._informer.wait_for_sync()
         await self._svc_informer.wait_for_sync()
+        if self.dynamic_config is not None:
+            await self.dynamic_config.start()
         if self.eviction is not None:
             self.eviction.pod_source = lambda: list(self._pods.values())
             self.eviction.evict = self.evict_pod
@@ -182,6 +194,8 @@ class NodeAgent:
             await self.server.stop()
         if self.eviction is not None:
             await self.eviction.stop()
+        if self.dynamic_config is not None:
+            await self.dynamic_config.stop()
         await self.probes.stop_all()
 
     # -- node registration + status (kubelet_node_status.go) --------------
@@ -230,6 +244,9 @@ class NodeAgent:
             await self._register_node()
             return
         self._adopt_cidr(cur.spec.pod_cidr)
+        if self.dynamic_config is not None:
+            # Source discovery piggybacks on this existing read.
+            self.dynamic_config.observe_node(cur)
         fresh = self._build_node()
         # Keep conditions' transition times stable when unchanged.
         old_ready = t.get_node_condition(cur.status, t.NODE_READY)

@@ -1,0 +1,188 @@
+"""Dynamic agent configuration — settings from a ConfigMap.
+
+Reference: ``pkg/kubelet/kubeletconfig`` (dynamic kubelet config): the
+kubelet watches a ConfigMap named by its Node object, validates each
+new payload, checkpoints the last-known-good to disk, and rolls back to
+it when a new payload is invalid (e2e:
+``test/e2e_node/dynamic_kubelet_config_test.go``).
+
+Redesign: the agent's tunables are plain attributes read every loop
+tick, so "applying" config is assignment — no restart needed. The
+ConfigMap is named by the node's ``kubernetes-tpu/config-source``
+annotation (namespace/name); validation is strict (unknown keys or
+out-of-range values reject the WHOLE payload, reference behavior), and
+the last-known-good JSON checkpoint under the runtime root survives
+agent restarts.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+from typing import Optional
+
+from ..api import errors, types as t
+
+log = logging.getLogger("dynamicconfig")
+
+CONFIG_SOURCE_ANNOTATION = "kubernetes-tpu/config-source"
+
+#: key -> (parse, validate) for every tunable the agent accepts.
+_SCHEMA = {
+    "status_interval": (float, lambda v: 0.1 <= v <= 300),
+    "heartbeat_interval": (float, lambda v: 0.1 <= v <= 300),
+    "pleg_interval": (float, lambda v: 0.05 <= v <= 60),
+    "max_pods": (int, lambda v: 1 <= v <= 10000),
+    "eviction_memory_available_bytes": (int, lambda v: v >= 0),
+    "eviction_fs_available_fraction": (float, lambda v: 0 <= v <= 1),
+}
+
+
+def parse_agent_config(data: dict) -> dict:
+    """Validate a ConfigMap's data into typed settings; raises
+    ValueError on ANY unknown key or invalid value (all-or-nothing,
+    like the reference's config validation)."""
+    out = {}
+    for key, raw in data.items():
+        if key not in _SCHEMA:
+            raise ValueError(f"unknown config key {key!r} "
+                             f"(known: {sorted(_SCHEMA)})")
+        parse, ok = _SCHEMA[key]
+        try:
+            value = parse(raw)
+        except (TypeError, ValueError):
+            raise ValueError(f"{key}: cannot parse {raw!r}") from None
+        if not ok(value):
+            raise ValueError(f"{key}: {value!r} out of range")
+        out[key] = value
+    return out
+
+
+class DynamicConfigManager:
+    """Watches the node's config-source ConfigMap and applies valid
+    payloads to the agent; invalid payloads keep the current settings
+    and surface an event. The last-known-good checkpoint restores
+    settings on restart even if the API copy has gone bad."""
+
+    def __init__(self, agent, checkpoint_dir: str,
+                 poll_interval: float = 5.0):
+        self.agent = agent
+        self.poll_interval = poll_interval
+        #: checkpoint_dir MUST be per-node (the agent passes its volume
+        #: dir) — a shared path would bleed one node's config into every
+        #: other agent on the machine at restore time.
+        self.checkpoint_path = os.path.join(
+            checkpoint_dir, "agent-config-checkpoint.json")
+        self.last_applied: Optional[dict] = None
+        self._task: Optional[asyncio.Task] = None
+        self._source_rv = ""
+        #: "namespace/name" of the config ConfigMap; fed by the agent's
+        #: own node-status loop (observe_node) so watching for a source
+        #: costs ZERO extra API calls.
+        self._source_ref = ""
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self) -> None:
+        self._restore_checkpoint()
+        self._task = asyncio.get_running_loop().create_task(self._loop())
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+
+    def _restore_checkpoint(self) -> None:
+        try:
+            with open(self.checkpoint_path) as f:
+                settings = parse_agent_config(json.load(f))
+        except (OSError, ValueError, json.JSONDecodeError):
+            return
+        self._apply(settings)
+        log.info("restored last-known-good agent config from %s",
+                 self.checkpoint_path)
+
+    # -- reconcile ---------------------------------------------------------
+
+    async def _loop(self) -> None:
+        while True:
+            try:
+                await self.sync_once()
+            except Exception:  # noqa: BLE001
+                log.exception("dynamic config sync failed")
+            await asyncio.sleep(self.poll_interval)
+
+    def observe_node(self, node: t.Node) -> None:
+        """Called by the agent's status loop with the freshly-read Node
+        object — piggybacks source discovery on an existing API call."""
+        self._source_ref = node.metadata.annotations.get(
+            CONFIG_SOURCE_ANNOTATION, "")
+
+    async def sync_once(self) -> None:
+        ns, _, name = self._source_ref.partition("/")
+        if not ns or not name:
+            return
+        try:
+            cm = await self.agent.client.get("configmaps", ns, name)
+        except errors.NotFoundError:
+            return  # keep current settings (reference: missing = no-op)
+        if cm.metadata.resource_version == self._source_rv:
+            return
+        try:
+            settings = parse_agent_config(cm.data)
+            if self.agent.eviction is None and any(
+                    k.startswith("eviction_") for k in settings):
+                raise ValueError(
+                    "eviction_* keys set but this agent runs no "
+                    "eviction manager (the setting would be a silent "
+                    "no-op)")
+        except ValueError as e:
+            # Invalid payload: REJECT whole thing, keep last-known-good
+            # (the rollback half of the reference's checkpoint dance).
+            self._source_rv = cm.metadata.resource_version
+            self.agent.recorder.event(
+                self._node_ref(), "Warning", "InvalidAgentConfig", str(e))
+            log.warning("rejecting agent config %s/%s: %s", ns, name, e)
+            return
+        self._apply(settings)
+        self._checkpoint(cm.data)
+        self._source_rv = cm.metadata.resource_version
+        self.agent.recorder.event(
+            self._node_ref(), "Normal", "AgentConfigApplied",
+            f"applied {sorted(settings)} from {ns}/{name}")
+        log.info("applied agent config %s/%s: %s", ns, name, settings)
+
+    def _node_ref(self):
+        node = t.Node()
+        node.kind = "Node"
+        node.metadata.name = self.agent.node_name
+        return node
+
+    def _apply(self, settings: dict) -> None:
+        agent = self.agent
+        for key, value in settings.items():
+            if key == "max_pods":
+                agent.capacity[t.RESOURCE_PODS] = float(value)
+            elif key == "eviction_memory_available_bytes":
+                if agent.eviction is not None:
+                    agent.eviction.thresholds.memory_available_bytes = value
+            elif key == "eviction_fs_available_fraction":
+                if agent.eviction is not None:
+                    agent.eviction.thresholds.fs_available_fraction = value
+            else:
+                setattr(agent, key, value)
+        self.last_applied = dict(settings)
+
+    def _checkpoint(self, raw_data: dict) -> None:
+        try:
+            os.makedirs(os.path.dirname(self.checkpoint_path), exist_ok=True)
+            tmp = self.checkpoint_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(raw_data, f)
+            os.replace(tmp, self.checkpoint_path)
+        except OSError:
+            log.exception("config checkpoint write failed")

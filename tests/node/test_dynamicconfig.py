@@ -1,0 +1,117 @@
+"""Dynamic agent config tests (reference tier:
+test/e2e_node/dynamic_kubelet_config_test.go)."""
+import json
+
+import pytest
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.node.agent import NodeAgent
+from kubernetes_tpu.node.dynamicconfig import (CONFIG_SOURCE_ANNOTATION,
+                                               parse_agent_config)
+from kubernetes_tpu.node.eviction import EvictionManager, Thresholds
+from kubernetes_tpu.node.runtime import FakeRuntime
+
+from tests.controllers.util import make_plane, wait_for
+
+
+def test_parse_agent_config_strict():
+    ok = parse_agent_config({"status_interval": "2.5", "max_pods": "50"})
+    assert ok == {"status_interval": 2.5, "max_pods": 50}
+    with pytest.raises(ValueError):
+        parse_agent_config({"bogus": "1"})
+    with pytest.raises(ValueError):
+        parse_agent_config({"max_pods": "0"})           # out of range
+    with pytest.raises(ValueError):
+        parse_agent_config({"status_interval": "nope"})  # unparseable
+    # All-or-nothing: one bad key rejects the valid ones too.
+    with pytest.raises(ValueError):
+        parse_agent_config({"max_pods": "50", "bogus": "1"})
+
+
+async def start_agent(client, tmp_path, **kw):
+    # Fast status loop: source discovery piggybacks on the node-status
+    # read, so the test needs it ticking quickly.
+    agent = NodeAgent(client, "n0", FakeRuntime(), status_interval=0.1,
+                      heartbeat_interval=5.0, pleg_interval=0.2,
+                      server_port=None, **kw)
+    agent.dynamic_config.poll_interval = 0.1
+    agent.dynamic_config.checkpoint_path = str(tmp_path / "ckpt.json")
+    await agent.start()
+    return agent
+
+
+async def annotate_source(reg, client, ref):
+    node = await client.get("nodes", "", "n0")
+    node.metadata.annotations[CONFIG_SOURCE_ANNOTATION] = ref
+    await client.update(node)
+
+
+@pytest.mark.asyncio
+async def test_config_applied_and_rolled_back(tmp_path):
+    reg, client, _ = make_plane()
+    await client.create(t.ConfigMap(
+        metadata=ObjectMeta(name="agent-cfg", namespace="default"),
+        data={"status_interval": "1.5", "max_pods": "7"}))
+    agent = await start_agent(client, tmp_path)
+    try:
+        await annotate_source(reg, client, "default/agent-cfg")
+        await wait_for(lambda: agent.status_interval == 1.5, timeout=10.0)
+        assert agent.capacity[t.RESOURCE_PODS] == 7.0
+        assert json.load(open(agent.dynamic_config.checkpoint_path)) == \
+            {"status_interval": "1.5", "max_pods": "7"}
+
+        # Invalid update: settings stay, event surfaces.
+        cm = await client.get("configmaps", "default", "agent-cfg")
+        cm.data = {"status_interval": "-4"}
+        await client.update(cm)
+
+        def rejected():
+            evs, _ = reg.list("events", "default")
+            return any(e.reason == "InvalidAgentConfig" for e in evs)
+        await wait_for(rejected)
+        assert agent.status_interval == 1.5          # unchanged
+        # Valid update applies again.
+        cm = await client.get("configmaps", "default", "agent-cfg")
+        cm.data = {"status_interval": "2.0"}
+        await client.update(cm)
+        await wait_for(lambda: agent.status_interval == 2.0)
+    finally:
+        await agent.stop()
+
+
+@pytest.mark.asyncio
+async def test_checkpoint_restores_on_restart(tmp_path):
+    reg, client, _ = make_plane()
+    (tmp_path / "ckpt.json").write_text(
+        json.dumps({"status_interval": "3.5"}))
+    agent = NodeAgent(client, "n0", FakeRuntime(), status_interval=5.0,
+                      heartbeat_interval=5.0, server_port=None)
+    agent.dynamic_config.checkpoint_path = str(tmp_path / "ckpt.json")
+    agent.dynamic_config.poll_interval = 60
+    await agent.start()
+    try:
+        assert agent.status_interval == 3.5  # last-known-good restored
+    finally:
+        await agent.stop()
+
+
+@pytest.mark.asyncio
+async def test_eviction_thresholds_reconfigurable(tmp_path):
+    reg, client, _ = make_plane()
+    ev = EvictionManager(Thresholds(memory_available_bytes=100),
+                         usage_source=lambda: None, interval=3600)
+    ev.usage_source = lambda: __import__(
+        "kubernetes_tpu.node.eviction", fromlist=["NodeUsage"]).NodeUsage(
+        memory_available=2**30, memory_capacity=2**31,
+        fs_available=1, fs_capacity=1)
+    reg.create(t.Namespace(metadata=ObjectMeta(name="kube-system")))
+    await client.create(t.ConfigMap(
+        metadata=ObjectMeta(name="cfg", namespace="kube-system"),
+        data={"eviction_memory_available_bytes": "123456"}))
+    agent = await start_agent(client, tmp_path, eviction=ev)
+    try:
+        await annotate_source(reg, client, "kube-system/cfg")
+        await wait_for(lambda: ev.thresholds.memory_available_bytes == 123456)
+    finally:
+        await agent.stop()
