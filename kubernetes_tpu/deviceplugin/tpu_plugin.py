@@ -80,13 +80,28 @@ def _probe_env() -> dict[str, str]:
     return env
 
 
-def detect_topology(timeout: float = 120.0) -> Optional[dict]:
-    """Probe the host's TPUs in a subprocess. Returns the probe dict or
-    None when the host has no usable TPU (or the probe crashed)."""
+def _find_libtpu() -> Optional[str]:
+    """Locate libtpu.so without importing it (the jax wheel vendors it
+    as the ``libtpu`` package)."""
+    if os.environ.get("TPU_LIBRARY_PATH"):
+        return os.environ["TPU_LIBRARY_PATH"]
     try:
-        proc = subprocess.run(
-            [sys.executable, "-c", _PROBE_SRC], env=_probe_env(),
-            capture_output=True, text=True, timeout=timeout)
+        import importlib.util
+        spec = importlib.util.find_spec("libtpu")
+        if spec and spec.submodule_search_locations:
+            path = os.path.join(
+                list(spec.submodule_search_locations)[0], "libtpu.so")
+            if os.path.exists(path):
+                return path
+    except Exception:  # noqa: BLE001
+        pass
+    return None
+
+
+def _run_probe(cmd: list[str], timeout: float) -> Optional[dict]:
+    try:
+        proc = subprocess.run(cmd, env=_probe_env(), capture_output=True,
+                              text=True, timeout=timeout)
     except (OSError, subprocess.TimeoutExpired):
         return None
     line = proc.stdout.strip().splitlines()
@@ -97,6 +112,42 @@ def detect_topology(timeout: float = 120.0) -> Optional[dict]:
     except json.JSONDecodeError:
         return None
     return probe if probe.get("tpu") else None
+
+
+def detect_topology(timeout: float = 120.0) -> Optional[dict]:
+    """Probe the host's TPUs in a crash-isolated subprocess. Returns
+    the probe dict or None when the host has no usable TPU.
+
+    Two probes, same JSON contract: the native PJRT binary
+    (``native/libtpu_probe.cpp``, the gonvml-analog dlopen shim) is
+    tried first — it enumerates local chips without paying a Python/
+    jax startup; the jax subprocess is the fallback and also covers
+    non-local backends (e.g. tunneled TPU-VMs) that only the installed
+    jax plugin can reach.
+
+    ``timeout`` is a total budget for the whole chain (first call may
+    additionally pay a one-time g++ build of the native probe, itself
+    bounded at 300s)."""
+    import time
+
+    from kubernetes_tpu.native import build_libtpu_probe
+    deadline = time.monotonic() + timeout
+    native = build_libtpu_probe()
+    if native:
+        cmd = [native]
+        lib = _find_libtpu()
+        if lib:
+            cmd.append(lib)
+        # The native probe is near-instant when there's no local TPU;
+        # cap it at half the budget so the jax fallback always gets a
+        # usable share.
+        probe = _run_probe(cmd, max(1.0, (deadline - time.monotonic()) / 2))
+        if probe is not None:
+            return probe
+    remaining = deadline - time.monotonic()
+    if remaining <= 0:
+        return None
+    return _run_probe([sys.executable, "-c", _PROBE_SRC], remaining)
 
 
 def _chip_type_of(kind: str) -> str:

@@ -14,6 +14,10 @@ Currently shipped:
 - ``tpu_hook.cpp`` — the container runtime hook binary (NVIDIA
   Container Runtime analog) injecting TPU device nodes + libtpu env
   (see node/runtimehook.py).
+- ``libtpu_probe.cpp`` — chip-enumeration probe that dlopen()s
+  libtpu.so and walks the PJRT C API (the gonvml analog,
+  vendor/github.com/mindprince/gonvml/bindings.go:19-30); exec'd by
+  deviceplugin/tpu_plugin.py as a crash-isolated subprocess.
 """
 from __future__ import annotations
 
@@ -31,18 +35,31 @@ _submesh_lib: Optional[ctypes.CDLL] = None
 _submesh_tried = False
 
 
-def _build(src: str, lib: str) -> None:
-    """Compile src -> lib atomically (tmp + rename survives races)."""
-    fd, tmp = tempfile.mkstemp(suffix=".so", dir=_DIR)
+def _compile(src: str, out: str, flags: list[str], libs: list[str] = (),
+             executable: bool = False, timeout: float = 120) -> None:
+    """g++ src -> out atomically (tmp + rename survives races).
+    ``libs`` (-l...) go after the source for correct link order."""
+    fd, tmp = tempfile.mkstemp(dir=_DIR)
     os.close(fd)
     try:
         subprocess.run(
-            ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", src, "-o", tmp],
-            check=True, capture_output=True, timeout=120)
-        os.replace(tmp, lib)
+            ["g++", "-O2", "-std=c++17", *flags, src, *libs, "-o", tmp],
+            check=True, capture_output=True, timeout=timeout)
+        if executable:
+            os.chmod(tmp, 0o755)
+        os.replace(tmp, out)
     finally:
         if os.path.exists(tmp):
             os.unlink(tmp)
+
+
+def _stale(out: str, src: str) -> bool:
+    return (not os.path.exists(out)
+            or os.path.getmtime(out) < os.path.getmtime(src))
+
+
+def _build(src: str, lib: str) -> None:
+    _compile(src, lib, ["-shared", "-fPIC"])
 
 
 _HOOK_SRC = os.path.join(_DIR, "tpu_hook.cpp")
@@ -60,23 +77,61 @@ def build_tpu_hook() -> Optional[str]:
         return _hook_path
     _hook_tried = True
     try:
-        if (not os.path.exists(_HOOK_BIN)
-                or os.path.getmtime(_HOOK_BIN) < os.path.getmtime(_HOOK_SRC)):
-            fd, tmp = tempfile.mkstemp(dir=_DIR)
-            os.close(fd)
-            try:
-                subprocess.run(
-                    ["g++", "-O2", "-std=c++17", _HOOK_SRC, "-o", tmp],
-                    check=True, capture_output=True, timeout=120)
-                os.chmod(tmp, 0o755)
-                os.replace(tmp, _HOOK_BIN)
-            finally:
-                if os.path.exists(tmp):
-                    os.unlink(tmp)
+        if _stale(_HOOK_BIN, _HOOK_SRC):
+            _compile(_HOOK_SRC, _HOOK_BIN, [], executable=True)
         _hook_path = _HOOK_BIN
     except Exception:
         _hook_path = None
     return _hook_path
+
+
+_PROBE_SRC = os.path.join(_DIR, "libtpu_probe.cpp")
+_PROBE_BIN = os.path.join(_DIR, "_libtpu_probe")
+_probe_path: Optional[str] = None
+_probe_tried = False
+
+
+def _pjrt_include_dir() -> Optional[str]:
+    """A directory containing xla/pjrt/c/pjrt_c_api.h (the PJRT C API
+    is header-only; the tensorflow wheel ships it)."""
+    # Explicit operator override wins (mirrors TPU_LIBRARY_PATH
+    # precedence in deviceplugin/tpu_plugin.py _find_libtpu).
+    candidates = [os.environ.get("PJRT_C_API_INCLUDE", "")]
+    try:
+        import importlib.util
+        spec = importlib.util.find_spec("tensorflow")  # located, NOT imported
+        if spec and spec.submodule_search_locations:
+            candidates.append(os.path.join(
+                list(spec.submodule_search_locations)[0], "include"))
+    except Exception:
+        pass
+    for cand in candidates:
+        if cand and os.path.exists(
+                os.path.join(cand, "xla", "pjrt", "c", "pjrt_c_api.h")):
+            return cand
+    return None
+
+
+def build_libtpu_probe() -> Optional[str]:
+    """Path to the libtpu probe binary, building it if needed; None
+    when the toolchain or the PJRT header is unavailable (callers use
+    the Python jax probe). Cached, including a negative result."""
+    global _probe_path, _probe_tried
+    if _probe_tried:
+        return _probe_path
+    _probe_tried = True
+    try:
+        if _stale(_PROBE_BIN, _PROBE_SRC):
+            inc = _pjrt_include_dir()
+            if inc is None:
+                _probe_path = None
+                return None
+            _compile(_PROBE_SRC, _PROBE_BIN, ["-I", inc], libs=["-ldl"],
+                     executable=True, timeout=300)
+        _probe_path = _PROBE_BIN
+    except Exception:
+        _probe_path = None
+    return _probe_path
 
 
 def load_submesh() -> Optional[ctypes.CDLL]:

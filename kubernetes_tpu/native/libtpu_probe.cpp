@@ -1,0 +1,265 @@
+// libtpu_probe — native TPU enumeration via the PJRT C API.
+//
+// The gonvml analog for TPUs (reference: vendor/github.com/mindprince/
+// gonvml/bindings.go:19-30 dlopen()s libnvidia-ml.so and binds a
+// handful of query functions behind function pointers so the kubelet
+// never links the driver).  Here the driver-equivalent is libtpu.so,
+// whose stable C surface is the PJRT C API: we dlopen it, resolve
+// GetPjrtApi, create a client, and enumerate chips with mesh
+// coordinates + HBM stats.
+//
+// Unlike NVML, libtpu is the *compute* runtime: creating a PJRT client
+// takes ownership of the host's chips.  So this is a short-lived probe
+// binary (crash-isolated from the node agent / device plugin, which
+// exec it and parse one JSON line from stdout), not a resident daemon.
+// The JSON contract matches the plugin's Python jax probe
+// (deviceplugin/tpu_plugin.py _PROBE_SRC) so either can serve.
+//
+// Build: g++ -O2 -std=c++17 -I<dir containing xla/pjrt/c/pjrt_c_api.h>
+//        libtpu_probe.cpp -ldl -o _libtpu_probe
+// Run:   _libtpu_probe [path/to/libtpu.so]
+
+#include <dlfcn.h>
+
+#include <cstddef>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "xla/pjrt/c/pjrt_c_api.h"
+
+namespace {
+
+// JSON string escaping for the few vendor strings we emit.
+std::string jesc(const std::string& s) {
+  std::string out;
+  out.reserve(s.size() + 2);
+  for (char c : s) {
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\n': out += "\\n"; break;
+      case '\t': out += "\\t"; break;
+      default:
+        if (static_cast<unsigned char>(c) < 0x20) {
+          char buf[8];
+          std::snprintf(buf, sizeof buf, "\\u%04x", c);
+          out += buf;
+        } else {
+          out += c;
+        }
+    }
+  }
+  return out;
+}
+
+// Print {"tpu": false, ...} and exit 0: "no TPU" is a answer, not a
+// failure — the caller treats a non-zero exit / garbage stdout as a
+// crashed probe instead.
+[[noreturn]] void no_tpu(const std::string& why) {
+  std::printf("{\"tpu\": false, \"error\": \"%s\", \"source\": \"libtpu_probe\"}\n",
+              jesc(why).c_str());
+  std::exit(0);
+}
+
+std::string error_message(const PJRT_Api* api, PJRT_Error* err) {
+  PJRT_Error_Message_Args margs;
+  std::memset(&margs, 0, sizeof margs);
+  margs.struct_size = PJRT_Error_Message_Args_STRUCT_SIZE;
+  margs.error = err;
+  api->PJRT_Error_Message(&margs);
+  std::string msg(margs.message, margs.message_size);
+  PJRT_Error_Destroy_Args dargs;
+  std::memset(&dargs, 0, sizeof dargs);
+  dargs.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+  dargs.error = err;
+  api->PJRT_Error_Destroy(&dargs);
+  return msg;
+}
+
+#define CHECK_PJRT(api, call)                          \
+  do {                                                 \
+    PJRT_Error* _err = (call);                         \
+    if (_err) no_tpu(error_message((api), _err));      \
+  } while (0)
+
+// An older same-major plugin's PJRT_Api struct may end before a member
+// this (newer) header declares — dereferencing past api->struct_size
+// would read garbage function pointers.  Guard every member that
+// postdates the API's earliest revisions (pjrt_c_api.h:104 prescribes
+// exactly this struct_size discipline).
+#define API_HAS(api, field) \
+  ((api)->struct_size > offsetof(PJRT_Api, field) && (api)->field != nullptr)
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  // Candidate library paths: argv[1], $TPU_LIBRARY_PATH, then the
+  // sonames the dynamic loader may know.
+  std::vector<std::string> candidates;
+  if (argc > 1) candidates.push_back(argv[1]);
+  if (const char* p = std::getenv("TPU_LIBRARY_PATH")) candidates.push_back(p);
+  candidates.push_back("libtpu.so");
+
+  void* handle = nullptr;
+  std::string dlerr;
+  for (const auto& c : candidates) {
+    if (c.empty()) continue;  // dlopen("") resolves to the main program
+    handle = dlopen(c.c_str(), RTLD_NOW | RTLD_LOCAL);
+    if (handle) break;
+    const char* e = dlerror();
+    if (e) dlerr = e;
+  }
+  if (!handle) no_tpu("dlopen libtpu.so failed: " + dlerr);
+
+  using GetPjrtApiFn = const PJRT_Api* (*)();
+  auto get_api = reinterpret_cast<GetPjrtApiFn>(dlsym(handle, "GetPjrtApi"));
+  if (!get_api) no_tpu("GetPjrtApi symbol missing (not a PJRT plugin)");
+  const PJRT_Api* api = get_api();
+  if (!api) no_tpu("GetPjrtApi returned null");
+  if (api->pjrt_api_version.major_version != PJRT_API_MAJOR) {
+    no_tpu("PJRT ABI major mismatch: plugin " +
+           std::to_string(api->pjrt_api_version.major_version) +
+           " vs header " + std::to_string(PJRT_API_MAJOR));
+  }
+
+  if (API_HAS(api, PJRT_Plugin_Initialize)) {
+    PJRT_Plugin_Initialize_Args init;
+    std::memset(&init, 0, sizeof init);
+    init.struct_size = PJRT_Plugin_Initialize_Args_STRUCT_SIZE;
+    CHECK_PJRT(api, api->PJRT_Plugin_Initialize(&init));
+  }
+
+  // Takes ownership of the chips for the probe's lifetime — the reason
+  // this runs as a short-lived subprocess (see file docstring).
+  PJRT_Client_Create_Args cc;
+  std::memset(&cc, 0, sizeof cc);
+  cc.struct_size = PJRT_Client_Create_Args_STRUCT_SIZE;
+  CHECK_PJRT(api, api->PJRT_Client_Create(&cc));
+  PJRT_Client* client = cc.client;
+
+  PJRT_Client_PlatformName_Args pn;
+  std::memset(&pn, 0, sizeof pn);
+  pn.struct_size = PJRT_Client_PlatformName_Args_STRUCT_SIZE;
+  pn.client = client;
+  CHECK_PJRT(api, api->PJRT_Client_PlatformName(&pn));
+  std::string platform(pn.platform_name, pn.platform_name_size);
+
+  PJRT_Client_Devices_Args dv;
+  std::memset(&dv, 0, sizeof dv);
+  dv.struct_size = PJRT_Client_Devices_Args_STRUCT_SIZE;
+  dv.client = client;
+  CHECK_PJRT(api, api->PJRT_Client_Devices(&dv));
+
+  std::string devices_json;
+  int process_index = 0;
+  for (size_t i = 0; i < dv.num_devices; ++i) {
+    PJRT_Device* dev = dv.devices[i];
+
+    PJRT_Device_IsAddressable_Args ia;
+    std::memset(&ia, 0, sizeof ia);
+    ia.struct_size = PJRT_Device_IsAddressable_Args_STRUCT_SIZE;
+    ia.device = dev;
+    CHECK_PJRT(api, api->PJRT_Device_IsAddressable(&ia));
+    if (!ia.is_addressable) continue;  // local_devices() semantics
+
+    PJRT_Device_GetDescription_Args gd;
+    std::memset(&gd, 0, sizeof gd);
+    gd.struct_size = PJRT_Device_GetDescription_Args_STRUCT_SIZE;
+    gd.device = dev;
+    CHECK_PJRT(api, api->PJRT_Device_GetDescription(&gd));
+    PJRT_DeviceDescription* desc = gd.device_description;
+
+    PJRT_DeviceDescription_Id_Args id;
+    std::memset(&id, 0, sizeof id);
+    id.struct_size = PJRT_DeviceDescription_Id_Args_STRUCT_SIZE;
+    id.device_description = desc;
+    CHECK_PJRT(api, api->PJRT_DeviceDescription_Id(&id));
+
+    PJRT_DeviceDescription_ProcessIndex_Args pi;
+    std::memset(&pi, 0, sizeof pi);
+    pi.struct_size = PJRT_DeviceDescription_ProcessIndex_Args_STRUCT_SIZE;
+    pi.device_description = desc;
+    CHECK_PJRT(api, api->PJRT_DeviceDescription_ProcessIndex(&pi));
+    process_index = pi.process_index;
+
+    PJRT_DeviceDescription_Kind_Args kd;
+    std::memset(&kd, 0, sizeof kd);
+    kd.struct_size = PJRT_DeviceDescription_Kind_Args_STRUCT_SIZE;
+    kd.device_description = desc;
+    CHECK_PJRT(api, api->PJRT_DeviceDescription_Kind(&kd));
+    std::string kind(kd.device_kind, kd.device_kind_size);
+
+    // TPU PJRT publishes mesh position as the "coords" Int64List
+    // attribute (what jax Device.coords reads); core_on_chip is a
+    // scalar attribute on multi-core-per-chip generations.
+    std::vector<int64_t> coords;
+    int64_t core_on_chip = 0;
+    PJRT_DeviceDescription_Attributes_Args at;
+    std::memset(&at, 0, sizeof at);
+    at.struct_size = PJRT_DeviceDescription_Attributes_Args_STRUCT_SIZE;
+    at.device_description = desc;
+    CHECK_PJRT(api, api->PJRT_DeviceDescription_Attributes(&at));
+    for (size_t a = 0; a < at.num_attributes; ++a) {
+      const PJRT_NamedValue& nv = at.attributes[a];
+      std::string name(nv.name, nv.name_size);
+      if (name == "coords" && nv.type == PJRT_NamedValue_kInt64List) {
+        coords.assign(nv.int64_array_value,
+                      nv.int64_array_value + nv.value_size);
+      } else if (name == "core_on_chip" && nv.type == PJRT_NamedValue_kInt64) {
+        core_on_chip = nv.int64_value;
+      }
+    }
+    if (coords.empty()) coords = {id.id, 0, 0};
+
+    std::string mem_json;
+    if (API_HAS(api, PJRT_Device_MemoryStats)) {
+      PJRT_Device_MemoryStats_Args ms;
+      std::memset(&ms, 0, sizeof ms);
+      ms.struct_size = PJRT_Device_MemoryStats_Args_STRUCT_SIZE;
+      ms.device = dev;
+      PJRT_Error* merr = api->PJRT_Device_MemoryStats(&ms);
+      if (merr) {
+        error_message(api, merr);  // UNIMPLEMENTED on some backends; drop
+      } else if (ms.bytes_limit_is_set) {
+        mem_json = ", \"memory\": {\"hbm_used_bytes\": " +
+                   std::to_string(ms.bytes_in_use) +
+                   ", \"hbm_total_bytes\": " + std::to_string(ms.bytes_limit) +
+                   "}";
+      }
+    }
+
+    std::string coords_json;
+    for (size_t c = 0; c < coords.size(); ++c) {
+      if (c) coords_json += ", ";
+      coords_json += std::to_string(coords[c]);
+    }
+    if (!devices_json.empty()) devices_json += ", ";
+    devices_json += "{\"index\": " + std::to_string(id.id) +
+                    ", \"kind\": \"" + jesc(kind) +
+                    "\", \"coords\": [" + coords_json +
+                    "], \"core_on_chip\": " + std::to_string(core_on_chip) +
+                    mem_json + "}";
+  }
+
+  bool is_tpu = platform.find("tpu") != std::string::npos || platform.find("axon") != std::string::npos;
+  std::printf(
+      "{\"tpu\": %s, \"backend\": \"%s\", \"process_index\": %d, "
+      "\"pjrt_api\": \"%d.%d\", \"source\": \"libtpu_probe\", "
+      "\"devices\": [%s]}\n",
+      (is_tpu && !devices_json.empty()) ? "true" : "false",
+      jesc(platform).c_str(), process_index,
+      api->pjrt_api_version.major_version,
+      api->pjrt_api_version.minor_version, devices_json.c_str());
+
+  PJRT_Client_Destroy_Args cd;
+  std::memset(&cd, 0, sizeof cd);
+  cd.struct_size = PJRT_Client_Destroy_Args_STRUCT_SIZE;
+  cd.client = client;
+  PJRT_Error* derr = api->PJRT_Client_Destroy(&cd);
+  if (derr) error_message(api, derr);
+  return 0;
+}

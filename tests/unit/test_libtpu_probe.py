@@ -1,0 +1,108 @@
+"""Native libtpu/PJRT probe (native/libtpu_probe.cpp) — the gonvml
+dlopen-shim analog (vendor/github.com/mindprince/gonvml/bindings.go).
+
+The binary's contract: always exit 0 with one JSON line on stdout
+(``tpu`` true/false); crashes/garbage are what the caller treats as
+probe failure. On hosts without local TPU hardware it must report
+``tpu: false`` rather than wedge or die — that is what keeps the node
+agent crash-isolated from driver faults.
+"""
+import json
+import os
+import subprocess
+
+import pytest
+
+from kubernetes_tpu.deviceplugin import tpu_plugin
+from kubernetes_tpu.deviceplugin.tpu_plugin import topology_from_probe
+from kubernetes_tpu.native import build_libtpu_probe
+
+
+@pytest.fixture(scope="module")
+def probe_bin():
+    path = build_libtpu_probe()
+    if path is None:
+        pytest.skip("no g++ toolchain or PJRT header available")
+    return path
+
+
+def test_probe_missing_library_reports_no_tpu(probe_bin, tmp_path):
+    """dlopen failure is an answer (tpu: false), not a crash."""
+    proc = subprocess.run(
+        [probe_bin, str(tmp_path / "nonexistent-libtpu.so")],
+        capture_output=True, text=True, timeout=60,
+        env={**os.environ, "TPU_LIBRARY_PATH": ""})
+    assert proc.returncode == 0
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["tpu"] is False
+    assert out["source"] == "libtpu_probe"
+    assert "dlopen" in out["error"]
+
+
+def test_probe_not_a_pjrt_plugin(probe_bin):
+    """A resolvable .so without GetPjrtApi must be rejected cleanly.
+    libm is always loadable and is certainly not a PJRT plugin."""
+    proc = subprocess.run(
+        [probe_bin, "libm.so.6"], capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["tpu"] is False
+    assert "GetPjrtApi" in out["error"]
+
+
+def test_probe_real_libtpu_terminates(probe_bin):
+    """Against the real libtpu.so the probe must terminate with a JSON
+    verdict either way: chips enumerated (real TPU-VM host) or a clean
+    tpu:false (no local hardware, e.g. tunneled backends)."""
+    lib = tpu_plugin._find_libtpu()
+    if lib is None:
+        pytest.skip("no libtpu.so in this environment")
+    proc = subprocess.run(
+        [probe_bin, lib], capture_output=True, text=True, timeout=180)
+    assert proc.returncode == 0
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["source"] == "libtpu_probe"
+    if out["tpu"]:
+        assert out["devices"], "tpu:true must come with devices"
+        for dev in out["devices"]:
+            assert len(dev["coords"]) >= 1
+            assert dev["kind"]
+
+
+def test_native_probe_json_feeds_topology():
+    """The native probe's JSON is drop-in for topology_from_probe —
+    same contract as the jax probe."""
+    probe = {
+        "tpu": True, "backend": "tpu", "process_index": 1,
+        "source": "libtpu_probe", "pjrt_api": "0.90",
+        "devices": [
+            {"index": 0, "kind": "TPU v5p chip", "coords": [0, 0, 0],
+             "core_on_chip": 0,
+             "memory": {"hbm_used_bytes": 0, "hbm_total_bytes": 96 << 30}},
+            {"index": 1, "kind": "TPU v5p chip", "coords": [1, 0, 0],
+             "core_on_chip": 0},
+        ],
+    }
+    topo = topology_from_probe(probe)
+    assert topo.chip_type == "v5p"
+    assert list(topo.mesh_shape) == [2, 1, 1]
+    assert topo.worker_index == 1
+    assert [list(c.coords) for c in topo.chips] == [[0, 0, 0], [1, 0, 0]]
+
+
+def test_detect_topology_falls_back_to_jax(monkeypatch):
+    """When the native probe reports no local TPU (or can't build),
+    detect_topology must still consult the jax probe."""
+    calls = []
+
+    def fake_run(cmd, timeout):
+        calls.append(cmd)
+        if cmd and str(cmd[0]).endswith("_libtpu_probe"):
+            return None  # native: no local hardware
+        return {"tpu": True, "devices": [
+            {"index": 0, "kind": "TPU v5 lite", "coords": [0, 0, 0]}]}
+
+    monkeypatch.setattr(tpu_plugin, "_run_probe", fake_run)
+    probe = tpu_plugin.detect_topology()
+    assert probe is not None and probe["tpu"]
+    assert len(calls) >= 1
