@@ -455,6 +455,9 @@ class PodStatus:
     init_container_statuses: list[ContainerStatus] = field(default_factory=list)
     #: Node a preemptor is waiting on (reference: status.nominatedNodeName).
     nominated_node_name: str = ""
+    #: Guaranteed / Burstable / BestEffort (reference: status.qosClass,
+    #: computed by qos.go GetPodQOS; here node/containermanager.py).
+    qos_class: str = ""
 
 
 @dataclass

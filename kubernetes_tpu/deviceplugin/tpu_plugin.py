@@ -131,8 +131,8 @@ def detect_topology(timeout: float = 120.0) -> Optional[dict]:
     import time
 
     from kubernetes_tpu.native import build_libtpu_probe
+    native = build_libtpu_probe()  # one-time compile outside the budget
     deadline = time.monotonic() + timeout
-    native = build_libtpu_probe()
     if native:
         cmd = [native]
         lib = _find_libtpu()

@@ -97,12 +97,17 @@ std::string error_message(const PJRT_Api* api, PJRT_Error* err) {
 }  // namespace
 
 int main(int argc, char** argv) {
-  // Candidate library paths: argv[1], $TPU_LIBRARY_PATH, then the
-  // sonames the dynamic loader may know.
+  // Candidate library paths: an explicit argv[1] is authoritative (no
+  // soname fallback — a caller that named a path wants THAT library,
+  // and a surprise fallback would seize the host's chips); otherwise
+  // $TPU_LIBRARY_PATH, then the soname the dynamic loader knows.
   std::vector<std::string> candidates;
-  if (argc > 1) candidates.push_back(argv[1]);
-  if (const char* p = std::getenv("TPU_LIBRARY_PATH")) candidates.push_back(p);
-  candidates.push_back("libtpu.so");
+  if (argc > 1) {
+    candidates.push_back(argv[1]);
+  } else {
+    if (const char* p = std::getenv("TPU_LIBRARY_PATH")) candidates.push_back(p);
+    candidates.push_back("libtpu.so");
+  }
 
   void* handle = nullptr;
   std::string dlerr;

@@ -33,6 +33,7 @@ from ..client.record import EventRecorder
 from ..net.envvars import service_env_vars
 from ..net.ipam import (PodIPAllocator, default_node_cidr,
                         rebuild_pod_allocator)
+from . import containermanager as cm
 from .devicemanager import DeviceManager
 from .eviction import EvictionManager, pick_preemption_victims
 from .probes import ProbeManager
@@ -60,13 +61,17 @@ class NodeAgent:
                  eviction: Optional[EvictionManager] = None,
                  runtime_hook=None,
                  chip_metrics=None,
-                 dynamic_config: bool = True):
+                 dynamic_config: bool = True,
+                 reserved: Optional[cm.Reserved] = None):
         self.client = client
         self.node_name = node_name
         self.runtime = runtime
         self.device_manager = device_manager
         self.capacity = capacity or {"cpu": 4.0, "memory": 8.0 * 2**30}
         self.capacity.setdefault(t.RESOURCE_PODS, float(max_pods))
+        #: --system-reserved/--kube-reserved + eviction headroom; shapes
+        #: status.allocatable and admission (container_manager_linux.go).
+        self.reserved = reserved or cm.Reserved()
         self.labels = labels or {}
         self.status_interval = status_interval
         self.heartbeat_interval = heartbeat_interval
@@ -208,7 +213,11 @@ class NodeAgent:
         if self.device_manager:
             node.status.capacity.update(self.device_manager.capacity())
             node.status.tpu = self.device_manager.topology()
-        node.status.allocatable = dict(node.status.capacity)
+        # Scheduler packs against allocatable, not raw capacity
+        # (node_container_manager.go): capacity minus reserved minus
+        # eviction headroom.
+        node.status.allocatable = cm.compute_allocatable(
+            node.status.capacity, self.reserved)
         node.status.addresses = [t.NodeAddress(type="Hostname", address=self.address)]
         if self.server and self.server.port:
             # DaemonEndpoints analog: how ktl logs / scrapers find us.
@@ -413,6 +422,13 @@ class NodeAgent:
                         f"Preempted to admit critical pod {pod.key()}")
                 return "awaiting preemption of lower-priority pods", True
             return "node is at max pods", False
+        # GeneralPredicates at admission (lifecycle/predicate.go): the
+        # pod's effective requests must fit remaining allocatable.
+        fit_reason = cm.fit_failures(
+            pod, active,
+            cm.compute_allocatable(self.capacity, self.reserved))
+        if fit_reason is not None:
+            return fit_reason, False
         if pod.spec.tpu_resources and self.device_manager is None:
             return "node has no device manager but pod requests TPUs", False
         if self.device_manager is not None and pod.spec.tpu_resources:
@@ -582,7 +598,9 @@ class NodeAgent:
             pod_uid=pod.metadata.uid, name=container.name, image=container.image,
             command=list(container.command), args=list(container.args),
             env=env, working_dir=container.working_dir,
-            mounts=mounts, devices=devices)
+            mounts=mounts, devices=devices,
+            oom_score_adj=cm.oom_score_adj(
+                pod, container, self.capacity.get("memory", 0.0)))
         try:
             cid = await self.runtime.start_container(config)
         except Exception as e:  # noqa: BLE001
@@ -662,6 +680,10 @@ class NodeAgent:
         changed = (cur.status.phase != phase)
         cur.status.phase = phase
         cur.status.host_ip = self.address
+        qos = cm.qos_class(pod)
+        if cur.status.qos_class != qos:
+            cur.status.qos_class = qos
+            changed = True
         pod_ip = self.ipam.ip_for(pod.metadata.uid)
         if cur.status.pod_ip != pod_ip:
             cur.status.pod_ip = pod_ip

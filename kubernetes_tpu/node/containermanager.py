@@ -1,0 +1,166 @@
+"""Container manager — QoS classes, node allocatable, OOM scoring.
+
+Analog of ``pkg/kubelet/cm`` (``container_manager_linux.go``) for a
+node agent whose runtime is unprivileged OS processes. The reference
+enforces resource isolation through a cgroup hierarchy; a process
+runtime has no cgroup authority, so this module implements the
+enforcement points that exist without one, faithfully to the reference
+semantics:
+
+- **QoS classes** (``pkg/apis/core/v1/helper/qos/qos.go GetPodQOS``):
+  Guaranteed / Burstable / BestEffort from requests-vs-limits shape,
+  published on pod status.
+- **Node allocatable** (``pkg/kubelet/cm/node_container_manager.go``):
+  capacity minus system-reserved, kube-reserved, and the hard-eviction
+  memory threshold; published in node status so the *scheduler* packs
+  against allocatable, not raw capacity.
+- **Allocatable-based admission** (``pkg/kubelet/lifecycle/
+  predicate.go GeneralPredicates``): a bound pod whose resource
+  requests no longer fit the node's remaining allocatable is rejected
+  at admission.
+- **OOM score adj** (``pkg/kubelet/qos/policy.go GetContainerOOMScoreAdjust``):
+  Guaranteed -998, BestEffort 1000, Burstable interpolated from the
+  memory-request fraction — applied to the real spawned process via
+  ``/proc/<pid>/oom_score_adj``, which the kernel honors with no
+  cgroup needed. The node-pressure eviction manager (eviction.py) is
+  the userspace complement.
+"""
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Iterable, Optional
+
+from ..api import types as t
+
+log = logging.getLogger("containermanager")
+
+QOS_GUARANTEED = "Guaranteed"
+QOS_BURSTABLE = "Burstable"
+QOS_BEST_EFFORT = "BestEffort"
+
+#: Resources that participate in QoS classification (qos.go supported
+#: QoS resources). TPU chips deliberately excluded: they are integer
+#: devices, not compressible/overcommittable resources.
+_QOS_RESOURCES = ("cpu", "memory")
+
+#: policy.go constants.
+_GUARANTEED_OOM = -998
+_BEST_EFFORT_OOM = 1000
+_CRITICAL_POD_OOM = -997
+
+
+def qos_class(pod: t.Pod) -> str:
+    """``GetPodQOS``: Guaranteed iff every container has cpu+memory
+    limits with requests equal to limits (or unset, defaulted to
+    limits); BestEffort iff no container has any cpu/memory request or
+    limit; else Burstable."""
+    requests: dict[str, float] = {}
+    limits: dict[str, float] = {}
+    guaranteed = True
+    containers = list(pod.spec.containers) + list(
+        getattr(pod.spec, "init_containers", []) or [])
+    for c in containers:
+        for res in _QOS_RESOURCES:
+            # Quantities are stored un-normalized ("512Mi" is a valid
+            # spec value); parse at read like every other consumer.
+            req = c.resources.requests.get(res)
+            lim = c.resources.limits.get(res)
+            req = None if req is None else t.parse_quantity(req)
+            lim = None if lim is None else t.parse_quantity(lim)
+            if req is not None:
+                requests[res] = requests.get(res, 0.0) + req
+            if lim is not None:
+                limits[res] = limits.get(res, 0.0) + lim
+            if lim is None:
+                guaranteed = False
+            elif req is not None and req != lim:
+                guaranteed = False
+    if not requests and not limits:
+        return QOS_BEST_EFFORT
+    if guaranteed and all(res in limits for res in _QOS_RESOURCES):
+        return QOS_GUARANTEED
+    return QOS_BURSTABLE
+
+
+def oom_score_adj(pod: t.Pod, container: t.Container,
+                  memory_capacity: float) -> int:
+    """``GetContainerOOMScoreAdjust``: critical pods and Guaranteed
+    pods are nearly unkillable; BestEffort dies first; Burstable is
+    interpolated so larger reservations are safer."""
+    if t.pod_priority(pod) >= 2_000_000_000:
+        return _CRITICAL_POD_OOM
+    cls = qos_class(pod)
+    if cls == QOS_GUARANTEED:
+        return _GUARANTEED_OOM
+    if cls == QOS_BEST_EFFORT:
+        return _BEST_EFFORT_OOM
+    req = t.parse_quantity(container.resources.requests.get("memory", 0.0))
+    if memory_capacity <= 0 or req <= 0:
+        return _BEST_EFFORT_OOM - 1
+    adj = int(1000 - (1000.0 * req) / memory_capacity)
+    # policy.go clamps to [2, 999] so Burstable never ties Guaranteed
+    # or BestEffort.
+    return max(2, min(adj, 999))
+
+
+@dataclass
+class Reserved:
+    """--system-reserved / --kube-reserved / hard-eviction headroom."""
+    system: dict[str, float] = field(default_factory=dict)
+    kube: dict[str, float] = field(default_factory=dict)
+    #: Mirrors eviction.Thresholds.memory_available_bytes — allocatable
+    #: already excludes what eviction will defend.
+    eviction_memory_bytes: float = 100 * 2**20
+
+
+def compute_allocatable(capacity: dict[str, float],
+                        reserved: Optional[Reserved] = None) -> dict[str, float]:
+    """``node_container_manager.go GetNodeAllocatableAbsolute``:
+    allocatable = capacity - system-reserved - kube-reserved -
+    hard-eviction (memory only), floored at zero. Device resources
+    (google.com/tpu) are never reserved."""
+    reserved = reserved or Reserved()
+    out = dict(capacity)
+    for pool in (reserved.system, reserved.kube):
+        for res, val in pool.items():
+            if res in out:
+                out[res] = max(0.0, out[res] - val)
+    if "memory" in out:
+        out["memory"] = max(0.0, out["memory"] - reserved.eviction_memory_bytes)
+    return out
+
+
+def fit_failures(pod: t.Pod, active: Iterable[t.Pod],
+                 allocatable: dict[str, float]) -> Optional[str]:
+    """GeneralPredicates-at-admission: do ``pod``'s effective requests
+    fit into allocatable minus the sum of active pods' requests?
+    Returns a human reason or None. Resources absent from allocatable
+    are unconstrained (the device manager owns chip admission)."""
+    used: dict[str, float] = {}
+    for p in active:
+        for res, val in t.pod_resource_requests(p).items():
+            used[res] = used.get(res, 0.0) + val
+    for res, val in t.pod_resource_requests(pod).items():
+        if res not in allocatable or res == t.RESOURCE_PODS:
+            continue
+        free = allocatable[res] - used.get(res, 0.0)
+        if val > free:
+            return (f"insufficient {res}: requested {val:g}, "
+                    f"free {max(free, 0.0):g} of allocatable "
+                    f"{allocatable[res]:g}")
+    return None
+
+
+def apply_oom_score_adj(pid: int, adj: int) -> bool:
+    """Write /proc/<pid>/oom_score_adj (works for our own unprivileged
+    children when raising the score; lowering below the parent's needs
+    CAP_SYS_RESOURCE — failures are expected and non-fatal, exactly the
+    crash-only posture of the reference's oom_linux.go)."""
+    try:
+        with open(f"/proc/{pid}/oom_score_adj", "w") as f:
+            f.write(str(adj))
+        return True
+    except OSError as exc:
+        log.debug("oom_score_adj(%d)=%d failed: %s", pid, adj, exc)
+        return False

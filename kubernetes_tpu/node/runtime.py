@@ -43,6 +43,8 @@ class ContainerConfig:
     mounts: list[tuple] = field(default_factory=list)  # (host, container, ro)
     devices: list[str] = field(default_factory=list)
     annotations: dict[str, str] = field(default_factory=dict)
+    #: QoS-derived OOM score (qos/policy.go); 0 = leave kernel default.
+    oom_score_adj: int = 0
 
 
 @dataclass
@@ -178,6 +180,13 @@ class ProcessRuntime(ContainerRuntime):
                 log_f.close()
             except Exception:  # noqa: BLE001
                 pass
+        if config.oom_score_adj:
+            # Real kernel enforcement point for QoS without cgroups:
+            # BestEffort (+1000) dies to the OOM killer before
+            # Guaranteed (-998). Lowering below our own score needs
+            # CAP_SYS_RESOURCE; apply_oom_score_adj degrades gracefully.
+            from .containermanager import apply_oom_score_adj
+            apply_oom_score_adj(proc.pid, config.oom_score_adj)
         self._procs[cid] = proc
         self._configs[cid] = config
         self._status[cid] = ContainerStatus(

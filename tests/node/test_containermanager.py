@@ -1,0 +1,208 @@
+"""Container manager (node/containermanager.py) — QoS classification,
+node allocatable, allocatable admission, OOM scoring.
+
+Reference semantics: qos.go GetPodQOS, node_container_manager.go
+allocatable math, lifecycle/predicate.go admission,
+qos/policy.go GetContainerOOMScoreAdjust.
+"""
+import pytest
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.node import containermanager as cm
+
+
+def mkpod(name="p", containers=None, priority=0):
+    pod = t.Pod(metadata=ObjectMeta(name=name, namespace="default", uid=name))
+    pod.spec.containers = containers or [t.Container(name="c", image="i")]
+    if priority:
+        pod.spec.priority = priority
+    return pod
+
+
+def ctr(name="c", requests=None, limits=None):
+    c = t.Container(name=name, image="i")
+    c.resources.requests = requests or {}
+    c.resources.limits = limits or {}
+    return c
+
+
+class TestQosClass:
+    def test_best_effort(self):
+        assert cm.qos_class(mkpod()) == cm.QOS_BEST_EFFORT
+
+    def test_guaranteed_requests_equal_limits(self):
+        pod = mkpod(containers=[ctr(
+            requests={"cpu": 1.0, "memory": 1 << 30},
+            limits={"cpu": 1.0, "memory": 1 << 30})])
+        assert cm.qos_class(pod) == cm.QOS_GUARANTEED
+
+    def test_guaranteed_limits_only(self):
+        # Requests default to limits when unset (qos.go treats
+        # limits-only as Guaranteed).
+        pod = mkpod(containers=[ctr(limits={"cpu": 1.0, "memory": 1 << 30})])
+        assert cm.qos_class(pod) == cm.QOS_GUARANTEED
+
+    def test_burstable_requests_below_limits(self):
+        pod = mkpod(containers=[ctr(
+            requests={"cpu": 0.5, "memory": 1 << 29},
+            limits={"cpu": 1.0, "memory": 1 << 30})])
+        assert cm.qos_class(pod) == cm.QOS_BURSTABLE
+
+    def test_burstable_partial_resources(self):
+        pod = mkpod(containers=[ctr(requests={"memory": 1 << 29})])
+        assert cm.qos_class(pod) == cm.QOS_BURSTABLE
+
+    def test_string_quantities_parsed(self):
+        # Quantities are stored un-normalized; "1Gi" == 2**30 must
+        # classify Guaranteed, not crash or demote.
+        pod = mkpod(containers=[ctr(
+            requests={"cpu": "500m", "memory": "1Gi"},
+            limits={"cpu": 0.5, "memory": float(2**30)})])
+        assert cm.qos_class(pod) == cm.QOS_GUARANTEED
+        adj = cm.oom_score_adj(
+            mkpod(containers=[ctr(requests={"memory": "4Gi"})]),
+            ctr(requests={"memory": "4Gi"}), 8 * 2**30)
+        assert adj == 500
+
+    def test_one_besteffort_container_degrades_guaranteed(self):
+        pod = mkpod(containers=[
+            ctr("a", limits={"cpu": 1.0, "memory": 1 << 30}),
+            ctr("b"),
+        ])
+        assert cm.qos_class(pod) == cm.QOS_BURSTABLE
+
+
+class TestAllocatable:
+    def test_subtracts_reserved_and_eviction(self):
+        cap = {"cpu": 8.0, "memory": 16.0 * 2**30, t.RESOURCE_PODS: 110,
+               "google.com/tpu": 4}
+        alloc = cm.compute_allocatable(cap, cm.Reserved(
+            system={"cpu": 0.5, "memory": 1 << 30},
+            kube={"cpu": 0.5, "memory": 1 << 30},
+            eviction_memory_bytes=100 * 2**20))
+        assert alloc["cpu"] == 7.0
+        assert alloc["memory"] == 16.0 * 2**30 - 2 * 2**30 - 100 * 2**20
+        assert alloc["google.com/tpu"] == 4  # devices never reserved
+        assert alloc[t.RESOURCE_PODS] == 110
+
+    def test_floors_at_zero(self):
+        alloc = cm.compute_allocatable(
+            {"cpu": 1.0}, cm.Reserved(system={"cpu": 4.0}))
+        assert alloc["cpu"] == 0.0
+
+    def test_reserved_for_unlisted_resource_ignored(self):
+        alloc = cm.compute_allocatable(
+            {"cpu": 1.0}, cm.Reserved(system={"ephemeral-storage": 1e9}))
+        assert alloc == {"cpu": 1.0}
+
+
+class TestFitFailures:
+    def test_fits(self):
+        pod = mkpod(containers=[ctr(requests={"cpu": 1.0})])
+        assert cm.fit_failures(pod, [], {"cpu": 2.0}) is None
+
+    def test_rejects_over_allocatable(self):
+        running = mkpod("r", containers=[ctr(requests={"cpu": 1.5})])
+        pod = mkpod(containers=[ctr(requests={"cpu": 1.0})])
+        reason = cm.fit_failures(pod, [running], {"cpu": 2.0})
+        assert reason is not None and "insufficient cpu" in reason
+
+    def test_unconstrained_resource_passes(self):
+        pod = mkpod(containers=[ctr(requests={"hugepages-2Mi": 1e9})])
+        assert cm.fit_failures(pod, [], {"cpu": 1.0}) is None
+
+
+class TestOomScore:
+    def test_guaranteed_near_unkillable(self):
+        pod = mkpod(containers=[ctr(limits={"cpu": 1.0, "memory": 1 << 30})])
+        assert cm.oom_score_adj(pod, pod.spec.containers[0], 8 * 2**30) == -998
+
+    def test_best_effort_dies_first(self):
+        pod = mkpod()
+        assert cm.oom_score_adj(pod, pod.spec.containers[0], 8 * 2**30) == 1000
+
+    def test_burstable_interpolated_and_clamped(self):
+        pod = mkpod(containers=[ctr(requests={"memory": 4.0 * 2**30},
+                                    limits={"memory": 8.0 * 2**30})])
+        adj = cm.oom_score_adj(pod, pod.spec.containers[0], 8 * 2**30)
+        assert adj == 500
+        # Huge request clamps at 2, never reaching Guaranteed's -998.
+        pod2 = mkpod(containers=[ctr(requests={"memory": 7.999 * 2**30},
+                                     limits={"memory": 8.5 * 2**30})])
+        assert cm.oom_score_adj(pod2, pod2.spec.containers[0], 8 * 2**30) == 2
+
+    def test_critical_pod(self):
+        pod = mkpod(priority=2_000_000_000)
+        assert cm.oom_score_adj(pod, pod.spec.containers[0], 8 * 2**30) == -997
+
+
+class TestApplyOomScoreAdj:
+    def test_applies_to_own_process(self):
+        import os
+        before = open(f"/proc/{os.getpid()}/oom_score_adj").read().strip()
+        try:
+            # Raising one's own score never needs privileges.
+            assert cm.apply_oom_score_adj(os.getpid(), int(before) + 1 if int(before) < 1000 else 1000)
+        finally:
+            cm.apply_oom_score_adj(os.getpid(), int(before))
+
+    def test_missing_pid_is_nonfatal(self):
+        assert cm.apply_oom_score_adj(2**22 + 12345, 500) is False
+
+
+async def test_agent_rejects_pod_over_allocatable(tmp_path):
+    """End-to-end through the agent: a bound pod whose memory request
+    exceeds node allocatable is rejected (not started) with an
+    insufficient-resources reason, and node status advertises
+    allocatable = capacity - reserved."""
+    import asyncio
+
+    from kubernetes_tpu.apiserver.admission import default_chain
+    from kubernetes_tpu.apiserver.registry import Registry
+    from kubernetes_tpu.client.local import LocalClient
+    from kubernetes_tpu.node.agent import NodeAgent
+    from kubernetes_tpu.node.runtime import FakeRuntime
+
+    reg = Registry()
+    reg.admission = default_chain(reg)
+    reg.create(t.Namespace(metadata=ObjectMeta(name="default")))
+    client = LocalClient(reg)
+    agent = NodeAgent(
+        client, "worker-0", FakeRuntime(),
+        capacity={"cpu": 4.0, "memory": 2.0 * 2**30},
+        status_interval=0.3, heartbeat_interval=0.3, pleg_interval=0.1,
+        reserved=cm.Reserved(system={"cpu": 1.0},
+                             eviction_memory_bytes=100 * 2**20))
+    await agent.start()
+    try:
+        node = await client.get("nodes", None, "worker-0")
+        assert node.status.allocatable["cpu"] == 3.0
+        assert node.status.allocatable["memory"] == 2.0 * 2**30 - 100 * 2**20
+
+        pod = mkpod("big", containers=[ctr(requests={"memory": 3.0 * 2**30})])
+        pod.spec.node_name = "worker-0"
+        await client.create(pod)
+        got = None
+        for _ in range(80):
+            await asyncio.sleep(0.05)
+            got = await client.get("pods", "default", "big")
+            if got.status.phase == t.POD_FAILED:
+                break
+        assert got is not None and got.status.phase == t.POD_FAILED
+        assert "insufficient memory" in got.status.message
+
+        # A fitting pod is admitted, runs, and reports its QoS class.
+        ok = mkpod("small", containers=[ctr(requests={"memory": 1 << 28})])
+        ok.spec.node_name = "worker-0"
+        await client.create(ok)
+        got = None
+        for _ in range(80):
+            await asyncio.sleep(0.05)
+            got = await client.get("pods", "default", "small")
+            if got.status.phase == t.POD_RUNNING:
+                break
+        assert got is not None and got.status.phase == t.POD_RUNNING
+        assert got.status.qos_class == cm.QOS_BURSTABLE
+    finally:
+        await agent.stop()

@@ -42,9 +42,19 @@ async def start_agent(client, tmp_path, **kw):
 
 
 async def annotate_source(reg, client, ref):
-    node = await client.get("nodes", "", "n0")
-    node.metadata.annotations[CONFIG_SOURCE_ANNOTATION] = ref
-    await client.update(node)
+    # Read-modify-write retried on conflict: the agent's fast status
+    # loop updates the node concurrently and optimistic concurrency is
+    # supposed to reject our stale write.
+    from kubernetes_tpu.api import errors
+    for _ in range(50):
+        node = await client.get("nodes", "", "n0")
+        node.metadata.annotations[CONFIG_SOURCE_ANNOTATION] = ref
+        try:
+            await client.update(node)
+            return
+        except errors.ConflictError:
+            continue
+    raise AssertionError("could not annotate node after 50 attempts")
 
 
 @pytest.mark.asyncio
