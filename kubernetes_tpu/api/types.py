@@ -38,6 +38,9 @@ RESOURCE_TPU = "google.com/tpu"
 RESOURCE_CPU = "cpu"
 RESOURCE_MEMORY = "memory"
 RESOURCE_PODS = "pods"
+#: Producer: TTL controller (controllers/ttl.py); consumer: node agent
+#: config-read cache (node/volumes.py ObjectCache).
+TTL_ANNOTATION = "node.alpha.kubernetes.io/ttl"
 
 #: ResourceList: resource name -> quantity. cpu in cores, memory in bytes.
 ResourceList = dict

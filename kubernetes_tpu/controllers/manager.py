@@ -34,6 +34,7 @@ from .replicaset import ReplicaSetController
 from .resourcequota import ResourceQuotaController
 from .serviceaccount import ServiceAccountController
 from .statefulset import StatefulSetController
+from .ttl import TTLController
 from .volume import PersistentVolumeBinder
 
 log = logging.getLogger("controller-manager")
@@ -57,6 +58,7 @@ DEFAULT_CONTROLLERS: dict[str, Callable[[Client, InformerFactory], Controller]] 
     "resourcequota": ResourceQuotaController,
     "horizontal-pod-autoscaler": HorizontalPodAutoscalerController,
     "disruption": DisruptionController,
+    "ttl": TTLController,
 }
 
 

@@ -1,0 +1,77 @@
+"""TTL controller — scales node object-cache TTL with cluster size.
+
+Reference: ``pkg/controller/ttl/ttl_controller.go`` — annotates every
+node with ``node.alpha.kubernetes.io/ttl``, the number of seconds
+agents may serve ConfigMaps/Secrets from cache before re-fetching.
+Small clusters get 0 (always fresh); big clusters get minutes, cutting
+the O(pods) config reads that would otherwise hammer the apiserver at
+fleet scale. The node agent's volume manager honors the annotation
+(``node/volumes.py`` ObjectCache).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..api import errors
+from ..api.types import TTL_ANNOTATION
+from ..client.informer import InformerFactory
+from ..client.interface import Client
+from .base import Controller
+
+__all__ = ["TTLController", "TTL_ANNOTATION", "ttl_for_cluster_size"]
+
+#: (cluster-size upper bound, ttl seconds) — reference tiers
+#: (ttl_controller.go ttlBoundaries).
+TTL_BOUNDARIES = [(100, 0), (500, 15), (1000, 30), (5000, 60),
+                  (float("inf"), 300)]
+
+
+def ttl_for_cluster_size(n_nodes: int) -> int:
+    for bound, ttl in TTL_BOUNDARIES:
+        if n_nodes <= bound:
+            return ttl
+    return 300
+
+
+class TTLController(Controller):
+    name = "ttl-controller"
+
+    def __init__(self, client: Client, factory: InformerFactory,
+                 workers: int = 1):
+        super().__init__(client, factory, workers)
+        self.node_informer = self.watch("nodes")
+        # Every add/delete can move the cluster across a boundary; the
+        # reference re-enqueues all nodes only when the *tier* changes.
+        self.node_informer.add_handlers(
+            on_add=lambda n: self._tier_check(),
+            on_delete=lambda n: self._tier_check(),
+            on_update=lambda o, n: self.enqueue_obj(n))
+        self._last_ttl: Optional[int] = None
+
+    def _desired_ttl(self) -> int:
+        return ttl_for_cluster_size(len(self.node_informer.list()))
+
+    def _tier_check(self) -> None:
+        ttl = self._desired_ttl()
+        if ttl == self._last_ttl:
+            return
+        self._last_ttl = ttl
+        for node in self.node_informer.list():
+            self.enqueue_obj(node)
+
+    async def sync(self, key: str) -> Optional[float]:
+        node = self.node_informer.get(key)
+        if node is None:
+            return None
+        want = str(self._desired_ttl())
+        if node.metadata.annotations.get(TTL_ANNOTATION) == want:
+            return None
+        try:
+            cur = await self.client.get("nodes", "", node.metadata.name)
+            cur.metadata.annotations[TTL_ANNOTATION] = want
+            await self.client.update(cur)
+        except errors.NotFoundError:
+            return None
+        except errors.ConflictError:
+            return 0.5  # stale read; retry shortly
+        return None

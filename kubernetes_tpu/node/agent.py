@@ -38,7 +38,7 @@ from .devicemanager import DeviceManager
 from .eviction import EvictionManager, pick_preemption_victims
 from .probes import ProbeManager
 from .stats import _proc_stat
-from .volumes import VolumeError, VolumeManager, resolve_env
+from .volumes import ObjectCache, VolumeError, VolumeManager, resolve_env
 from .runtime import (STATE_EXITED, STATE_RUNNING, ContainerConfig,
                       ContainerRuntime, ContainerStatus as RtStatus)
 
@@ -72,6 +72,11 @@ class NodeAgent:
         #: --system-reserved/--kube-reserved + eviction headroom; shapes
         #: status.allocatable and admission (container_manager_linux.go).
         self.reserved = reserved or cm.Reserved()
+        #: Dead-container GC (container_gc.go); runtime + pod_source
+        #: are (re)bound at start(). Set to None to disable.
+        from .containergc import ContainerGC
+        self.container_gc: Optional[ContainerGC] = ContainerGC(
+            runtime, lambda: [])
         self.labels = labels or {}
         self.status_interval = status_interval
         self.heartbeat_interval = heartbeat_interval
@@ -98,9 +103,14 @@ class NodeAgent:
         #: ChipMetricsSource; the device plugin provides it).
         self.chip_metrics = chip_metrics
         #: ConfigMap/Secret/EmptyDir materialization (volumes.py).
+        #: Config reads go through a TTL cache driven by the TTL
+        #: controller's node annotation (ttl_controller.go consumer).
+        self._config_ttl = 0.0
+        self.object_cache = ObjectCache(
+            client, ttl_source=lambda: self._config_ttl)
         vol_dir = getattr(runtime, "root_dir", None) or os.path.join(
             tempfile.gettempdir(), f"ktpu-{node_name}")
-        self.volumes = VolumeManager(client, vol_dir)
+        self.volumes = VolumeManager(self.object_cache, vol_dir)
         self._node_dir = vol_dir
         #: Dynamic config from a ConfigMap (dynamicconfig.py); source
         #: discovery piggybacks on the node-status loop, so an agent
@@ -120,6 +130,10 @@ class NodeAgent:
         self._restart_counts: dict[str, dict[str, int]] = {}
         self._restart_at: dict[str, dict[str, float]] = {}
         self._admitted: set[str] = set()
+        #: Serializes admit-check + commit: two pods racing through
+        #: _admit must observe each other (kubelet HandlePodAdditions
+        #: admits sequentially for the same reason).
+        self._admit_lock = asyncio.Lock()
         self._evicted: set[str] = set()          # pod UIDs; terminal, never resync
         self._tasks: list[asyncio.Task] = []
         self._informer: Optional[SharedInformer] = None
@@ -173,6 +187,10 @@ class NodeAgent:
             if self.eviction.pod_usage is None:
                 self.eviction.pod_usage = self._pod_rss
             self.eviction.start()
+        if self.container_gc is not None:
+            self.container_gc.runtime = self.runtime
+            self.container_gc.pod_source = lambda: list(self._pods.values())
+            self.container_gc.start()
         loop = asyncio.get_running_loop()
         self._tasks = [
             loop.create_task(self._node_status_loop()),
@@ -199,6 +217,8 @@ class NodeAgent:
             await self.server.stop()
         if self.eviction is not None:
             await self.eviction.stop()
+        if self.container_gc is not None:
+            await self.container_gc.stop()
         if self.dynamic_config is not None:
             await self.dynamic_config.stop()
         await self.probes.stop_all()
@@ -253,6 +273,11 @@ class NodeAgent:
             await self._register_node()
             return
         self._adopt_cidr(cur.spec.pod_cidr)
+        try:
+            self._config_ttl = float(
+                cur.metadata.annotations.get(t.TTL_ANNOTATION, 0))
+        except (TypeError, ValueError):
+            self._config_ttl = 0.0
         if self.dynamic_config is not None:
             # Source discovery piggybacks on this existing read.
             self.dynamic_config.observe_node(cur)
@@ -387,13 +412,15 @@ class NodeAgent:
 
         # Admission (once): device verification (kubelet.go:898 chain).
         if key not in self._admitted:
-            reason, retriable = await self._admit(pod)
-            if reason is not None:
-                if retriable:
-                    return False  # plugin not up yet: retry on next wake
-                await self._reject_pod(pod, reason)
-                return True
-            self._admitted.add(key)
+            async with self._admit_lock:
+                if key not in self._admitted:
+                    reason, retriable = await self._admit(pod)
+                    if reason is not None:
+                        if retriable:
+                            return False  # plugin not up: retry on wake
+                        await self._reject_pod(pod, reason)
+                        return True
+                    self._admitted.add(key)
 
         statuses = await self._runtime_statuses(pod.metadata.uid)
         await self._ensure_containers(pod, statuses)
@@ -407,8 +434,13 @@ class NodeAgent:
         reported topology YET is a transient condition (agent restart
         races the plugin handshake) — retriable, never a terminal
         rejection of a validly-bound workload."""
+        # Only ADMITTED pods count against capacity: a sibling still
+        # waiting in its own _admit must not terminally reject this pod
+        # (and vice versa) when only one of them fits; admissions are
+        # serialized by _admit_lock so the winner is deterministic.
         active = [p for p in self._pods.values()
-                  if t.is_pod_active(p) and p.key() != pod.key()]
+                  if t.is_pod_active(p) and p.key() != pod.key()
+                  and p.key() in self._admitted]
         if len(active) + 1 > int(self.capacity.get(t.RESOURCE_PODS, 110)):
             # Critical-pod preemption (preemption.go): evict the
             # lowest-priority pod to admit a critical one.
@@ -543,7 +575,7 @@ class NodeAgent:
         pod_ip = self.ipam.ip_for(pod.metadata.uid)
         try:
             env = await resolve_env(
-                self.client, pod, container,
+                self.object_cache, pod, container,
                 {"status.pod_ip": pod_ip, "status.host_ip": self.address})
             volume_paths = await self.volumes.materialize(pod)
             mounts = self.volumes.mounts_for(

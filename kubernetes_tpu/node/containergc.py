@@ -1,0 +1,135 @@
+"""Container garbage collection — dead container records, logs,
+sandboxes.
+
+Analog of ``pkg/kubelet/container/container_gc.go`` +
+``kuberuntime_gc.go evictContainers``: a periodic pass removes exited
+container records (and, in the process runtime, their log files and
+sandbox dirs) under a three-knob policy:
+
+- ``min_age``: an exited container is not evictable until it has been
+  dead this long (status must have a chance to be observed/reported).
+- ``max_per_pod_container``: per (pod, container-name) keep at most N
+  exited records total (reference MaxPerPodContainer counts all dead
+  records). Floor of 1 for live pods: the NEWEST exited record of a
+  live pod's container is always kept — the agent's sync loop and
+  restart-backoff read it, and ``ktl logs`` serves from it.
+- ``max_containers``: global cap on dead records (< 0 = unlimited),
+  oldest evicted first.
+
+Containers whose pod no longer exists are evicted wholesale (the
+reference's ``evictableContainers`` of deleted pods), which is also
+what reclaims sandbox disk after pod churn on a long-lived node.
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from dataclasses import dataclass
+from typing import Callable, Iterable, Optional
+
+from ..api import types as t
+from .runtime import STATE_EXITED, ContainerRuntime, ContainerStatus
+
+log = logging.getLogger("containergc")
+
+
+@dataclass
+class GCPolicy:
+    """Reference defaults: MinAge=0s, MaxPerPodContainer=1,
+    MaxContainers=-1 (``kubelet/apis/kubeletconfig``); we default
+    min_age to 60s so a crash-looping container's last status is
+    never collected between observation ticks."""
+    min_age: float = 60.0
+    max_per_pod_container: int = 1
+    max_containers: int = -1
+
+
+class ContainerGC:
+    """One node agent's GC loop.
+
+    ``pod_source``: () -> iterable of the agent's known pods (live
+    set; containers of pods absent from it are fully evictable).
+    """
+
+    def __init__(self, runtime: ContainerRuntime,
+                 pod_source: Callable[[], Iterable[t.Pod]],
+                 policy: Optional[GCPolicy] = None,
+                 interval: float = 60.0):
+        self.runtime = runtime
+        self.pod_source = pod_source
+        self.policy = policy or GCPolicy()
+        self.interval = interval
+        self._task: Optional[asyncio.Task] = None
+
+    def start(self) -> None:
+        self._task = asyncio.get_running_loop().create_task(self._loop())
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+
+    async def _loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.interval)
+            try:
+                await self.collect()
+            except Exception:  # noqa: BLE001 — GC must never kill the agent
+                log.exception("container GC pass failed")
+
+    async def collect(self) -> list[str]:
+        """One GC pass; returns removed container ids (tests assert)."""
+        statuses = await self.runtime.list_containers()
+        now = time.time()
+        live_uids = {p.metadata.uid for p in self.pod_source()}
+        dead = [s for s in statuses
+                if s.state == STATE_EXITED
+                and now - (s.finished_at or now) >= self.policy.min_age]
+
+        to_remove: list[ContainerStatus] = []
+        # 1. Containers of deleted pods: evict wholesale.
+        orphans = [s for s in dead if s.pod_uid not in live_uids]
+        to_remove.extend(orphans)
+
+        # 2. Per live (pod, container-name): keep the newest always,
+        #    plus up to max_per_pod_container older instances.
+        groups: dict[tuple[str, str], list[ContainerStatus]] = {}
+        for s in dead:
+            if s.pod_uid in live_uids:
+                groups.setdefault((s.pod_uid, s.name), []).append(s)
+        kept: list[ContainerStatus] = []
+        for members in groups.values():
+            members.sort(key=lambda s: s.finished_at, reverse=True)
+            keep = max(self.policy.max_per_pod_container, 1)
+            kept.extend(members[:keep])
+            to_remove.extend(members[keep:])
+
+        # 3. Global cap over what's left (oldest first). Never touches
+        #    the newest record of a live pod's container.
+        if self.policy.max_containers >= 0:
+            survivors = sorted(kept, key=lambda s: s.finished_at)
+            newest = {max(ms, key=lambda s: s.finished_at).id
+                      for ms in groups.values()}
+            excess = len(survivors) - self.policy.max_containers
+            for s in survivors:
+                if excess <= 0:
+                    break
+                if s.id in newest:
+                    continue
+                to_remove.append(s)
+                excess -= 1
+
+        removed = []
+        for s in to_remove:
+            try:
+                await self.runtime.remove_container(s.id)
+                removed.append(s.id)
+            except Exception as exc:  # noqa: BLE001
+                log.warning("failed to remove container %s: %s", s.id, exc)
+        if removed:
+            log.info("container GC removed %d dead containers", len(removed))
+        return removed

@@ -68,6 +68,10 @@ def qos_class(pod: t.Pod) -> str:
             lim = c.resources.limits.get(res)
             req = None if req is None else t.parse_quantity(req)
             lim = None if lim is None else t.parse_quantity(lim)
+            # qos.go skips zero quantities: requests: {cpu: "0"} is
+            # BestEffort, not Burstable.
+            req = None if req == 0 else req
+            lim = None if lim == 0 else lim
             if req is not None:
                 requests[res] = requests.get(res, 0.0) + req
             if lim is not None:

@@ -21,6 +21,7 @@ import base64
 import binascii
 import os
 import shutil
+import time
 from typing import Optional
 
 from ..api import errors, types as t
@@ -43,8 +44,50 @@ def secret_bytes(value: str) -> bytes:
         raise VolumeError(f"secret value is not valid base64: {e}") from None
 
 
+class ObjectCache:
+    """TTL read-through cache for ConfigMaps/Secrets — the consumer
+    side of the TTL controller (``ttl_controller.go``): the node's
+    ``node.alpha.kubernetes.io/ttl`` annotation (surfaced through
+    ``ttl_source``) bounds how stale config reads may be, trading
+    freshness for O(pods) fewer apiserver GETs at fleet scale. Duck-
+    types ``Client.get``; everything except configmaps/secrets passes
+    through uncached (PV/PVC bindings must always be fresh)."""
+
+    _CACHED = ("configmaps", "secrets")
+
+    def __init__(self, client: Client, ttl_source=lambda: 0.0):
+        self.client = client
+        self.ttl_source = ttl_source
+        self._cache: dict[tuple, tuple[float, object]] = {}
+
+    async def get(self, plural: str, namespace, name: str):
+        if plural not in self._CACHED:
+            return await self.client.get(plural, namespace, name)
+        ttl = self.ttl_source()
+        key = (plural, namespace, name)
+        now = time.monotonic()
+        if ttl > 0:
+            hit = self._cache.get(key)
+            if hit is not None:
+                if hit[0] > now:
+                    return hit[1]
+                del self._cache[key]  # expired: don't pin the object
+        obj = await self.client.get(plural, namespace, name)
+        if ttl > 0:
+            if len(self._cache) >= 128:
+                # Amortized sweep so entries for long-gone pods'
+                # configs don't accumulate over the node's lifetime.
+                self._cache = {k: v for k, v in self._cache.items()
+                               if v[0] > now}
+            self._cache[key] = (now + ttl, obj)
+        else:
+            self._cache.pop(key, None)
+        return obj
+
+
 class VolumeManager:
     def __init__(self, client: Client, base_dir: str):
+        #: A Client or an ObjectCache (only ``.get`` is used).
         self.client = client
         self.base_dir = base_dir
 

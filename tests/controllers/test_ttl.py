@@ -1,0 +1,79 @@
+"""TTL controller (controllers/ttl.py) + agent-side ObjectCache.
+
+Reference: pkg/controller/ttl/ttl_controller.go (annotation scaled by
+cluster size) and its kubelet-side consumer (config cache TTL).
+"""
+import asyncio
+
+import pytest
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.controllers.ttl import (TTL_ANNOTATION, TTLController,
+                                            ttl_for_cluster_size)
+from tests.controllers.util import make_plane, wait_for
+
+
+def test_tiers_match_reference():
+    assert ttl_for_cluster_size(1) == 0
+    assert ttl_for_cluster_size(100) == 0
+    assert ttl_for_cluster_size(101) == 15
+    assert ttl_for_cluster_size(700) == 30
+    assert ttl_for_cluster_size(4000) == 60
+    assert ttl_for_cluster_size(100000) == 300
+
+
+async def test_annotates_nodes(monkeypatch):
+    reg, client, factory = make_plane()
+    # Shrink the first boundary so the tier flip is testable with 3 nodes.
+    import kubernetes_tpu.controllers.ttl as ttlmod
+    monkeypatch.setattr(ttlmod, "TTL_BOUNDARIES",
+                        [(2, 0), (float("inf"), 15)])
+    for i in range(2):
+        await client.create(t.Node(metadata=ObjectMeta(name=f"n{i}")))
+    ctl = TTLController(client, factory)
+    await ctl.start()
+    try:
+        await wait_for(lambda: reg.get("nodes", "", "n0")
+                       .metadata.annotations.get(TTL_ANNOTATION) == "0")
+        # Crossing the boundary re-annotates every node.
+        await client.create(t.Node(metadata=ObjectMeta(name="n2")))
+        for name in ("n0", "n1", "n2"):
+            await wait_for(
+                lambda name=name: reg.get("nodes", "", name)
+                .metadata.annotations.get(TTL_ANNOTATION) == "15")
+    finally:
+        await ctl.stop()
+
+
+async def test_object_cache_honors_ttl():
+    from kubernetes_tpu.node.volumes import ObjectCache
+
+    reg, client, factory = make_plane()
+    await client.create(t.ConfigMap(
+        metadata=ObjectMeta(name="cfg", namespace="default"),
+        data={"k": "v1"}))
+    ttl = 0.0
+    cache = ObjectCache(client, ttl_source=lambda: ttl)
+
+    got = await cache.get("configmaps", "default", "cfg")
+    assert got.data["k"] == "v1"
+
+    # ttl=0: always fresh.
+    cm = await client.get("configmaps", "default", "cfg")
+    cm.data = {"k": "v2"}
+    await client.update(cm)
+    assert (await cache.get("configmaps", "default", "cfg")).data["k"] == "v2"
+
+    # ttl>0: stale reads allowed within the window.
+    ttl = 30.0
+    assert (await cache.get("configmaps", "default", "cfg")).data["k"] == "v2"
+    cm = await client.get("configmaps", "default", "cfg")
+    cm.data = {"k": "v3"}
+    await client.update(cm)
+    assert (await cache.get("configmaps", "default", "cfg")).data["k"] == "v2"
+
+    # Non-config kinds bypass the cache entirely.
+    await client.create(t.Node(metadata=ObjectMeta(name="n0")))
+    node = await cache.get("nodes", "", "n0")
+    assert node.metadata.name == "n0"

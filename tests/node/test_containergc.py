@@ -1,0 +1,111 @@
+"""Container GC (node/containergc.py) — dead-record eviction policy.
+
+Reference semantics: container_gc.go / kuberuntime_gc.go
+evictContainers (min_age, max_per_pod_container keep-newest, global
+cap, deleted-pod wholesale eviction).
+"""
+import asyncio
+import time
+
+import pytest
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.node.containergc import ContainerGC, GCPolicy
+from kubernetes_tpu.node.runtime import ContainerConfig, FakeRuntime
+
+
+def mkpod(uid):
+    return t.Pod(metadata=ObjectMeta(name=uid, namespace="default", uid=uid))
+
+
+async def spawn_exited(rt, pod_uid, name, finished_ago=120.0, code=0):
+    cid = await rt.start_container(ContainerConfig(
+        pod_uid=pod_uid, name=name, command=["x"]))
+    rt.exit_container(cid, code)
+    rt._status[cid].finished_at = time.time() - finished_ago
+    return cid
+
+
+async def test_respects_min_age():
+    rt = FakeRuntime()
+    dead_old = await spawn_exited(rt, "gone", "c", finished_ago=120)
+    dead_new = await spawn_exited(rt, "gone", "c", finished_ago=1)
+    gc = ContainerGC(rt, lambda: [], GCPolicy(min_age=60))
+    removed = await gc.collect()
+    assert dead_old in removed and dead_new not in removed
+
+
+async def test_keeps_newest_for_live_pod():
+    rt = FakeRuntime()
+    pod = mkpod("live")
+    cids = [await spawn_exited(rt, "live", "c", finished_ago=300 - i)
+            for i in range(3)]
+    gc = ContainerGC(rt, lambda: [pod],
+                     GCPolicy(min_age=0, max_per_pod_container=1))
+    removed = await gc.collect()
+    # Newest (= last spawned, smallest finished_ago) always survives.
+    assert cids[2] not in removed
+    assert set(removed) == {cids[0], cids[1]}
+
+
+async def test_deleted_pod_evicted_wholesale():
+    rt = FakeRuntime()
+    for i in range(3):
+        await spawn_exited(rt, "gone", f"c{i}")
+    running = await rt.start_container(ContainerConfig(
+        pod_uid="gone", name="still-running", command=["x"]))
+    gc = ContainerGC(rt, lambda: [], GCPolicy(min_age=0))
+    removed = await gc.collect()
+    assert len(removed) == 3
+    # Running containers are never GC'd even for deleted pods (the
+    # agent kills them; GC only reaps dead records).
+    assert running not in removed
+
+
+async def test_global_cap_spares_newest():
+    rt = FakeRuntime()
+    pods = [mkpod(f"p{i}") for i in range(3)]
+    newest = {}
+    for i, p in enumerate(pods):
+        await spawn_exited(rt, p.metadata.uid, "c", finished_ago=500 - i)
+        newest[p.metadata.uid] = await spawn_exited(
+            rt, p.metadata.uid, "c", finished_ago=100 - i)
+    gc = ContainerGC(rt, lambda: pods,
+                     GCPolicy(min_age=0, max_per_pod_container=2,
+                              max_containers=3))
+    removed = await gc.collect()
+    remaining = {s.id for s in await rt.list_containers()}
+    for cid in newest.values():
+        assert cid in remaining
+    assert len(remaining) == 6 - len(removed) <= 3 + len(pods) - 3 + 3
+
+
+async def test_agent_wires_gc(tmp_path):
+    """The agent starts/stops its GC loop and binds the live pod set."""
+    from kubernetes_tpu.apiserver.admission import default_chain
+    from kubernetes_tpu.apiserver.registry import Registry
+    from kubernetes_tpu.client.local import LocalClient
+    from kubernetes_tpu.node.agent import NodeAgent
+
+    reg = Registry()
+    reg.admission = default_chain(reg)
+    reg.create(t.Namespace(metadata=ObjectMeta(name="default")))
+    client = LocalClient(reg)
+    rt = FakeRuntime()
+    agent = NodeAgent(client, "n0", rt, status_interval=5,
+                      heartbeat_interval=5, pleg_interval=0.1,
+                      server_port=None)
+    agent.container_gc.policy = GCPolicy(min_age=0)
+    agent.container_gc.interval = 0.1
+    await agent.start()
+    try:
+        # A dead container from a pod the API never knew about.
+        await spawn_exited(rt, "orphan-uid", "c")
+        for _ in range(50):
+            await asyncio.sleep(0.05)
+            if not await rt.list_containers():
+                break
+        assert await rt.list_containers() == []
+    finally:
+        await agent.stop()

@@ -53,6 +53,12 @@ class TestQosClass:
         pod = mkpod(containers=[ctr(requests={"memory": 1 << 29})])
         assert cm.qos_class(pod) == cm.QOS_BURSTABLE
 
+    def test_zero_quantities_are_unset(self):
+        # qos.go skips zero quantities: requests {cpu: "0"} is
+        # BestEffort, not Burstable.
+        pod = mkpod(containers=[ctr(requests={"cpu": "0"})])
+        assert cm.qos_class(pod) == cm.QOS_BEST_EFFORT
+
     def test_string_quantities_parsed(self):
         # Quantities are stored un-normalized; "1Gi" == 2**30 must
         # classify Guaranteed, not crash or demote.
