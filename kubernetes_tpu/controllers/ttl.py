@@ -43,10 +43,16 @@ class TTLController(Controller):
         # Every add/delete can move the cluster across a boundary; the
         # reference re-enqueues all nodes only when the *tier* changes.
         self.node_informer.add_handlers(
-            on_add=lambda n: self._tier_check(),
+            on_add=self._on_add,
             on_delete=lambda n: self._tier_check(),
             on_update=lambda o, n: self.enqueue_obj(n))
         self._last_ttl: Optional[int] = None
+
+    def _on_add(self, node) -> None:
+        # The new node needs its annotation even when the tier didn't
+        # move; _tier_check alone would skip it.
+        self.enqueue_obj(node)
+        self._tier_check()
 
     def _desired_ttl(self) -> int:
         return ttl_for_cluster_size(len(self.node_informer.list()))

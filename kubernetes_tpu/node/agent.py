@@ -434,13 +434,20 @@ class NodeAgent:
         reported topology YET is a transient condition (agent restart
         races the plugin handshake) — retriable, never a terminal
         rejection of a validly-bound workload."""
-        # Only ADMITTED pods count against capacity: a sibling still
-        # waiting in its own _admit must not terminally reject this pod
-        # (and vice versa) when only one of them fits; admissions are
-        # serialized by _admit_lock so the winner is deterministic.
+        # Capacity/fit accounting counts pods that are ADMITTED or
+        # already RUNNING on the node: a sibling still waiting in its
+        # own _admit must not terminally reject this pod (mutual
+        # rejection), but pods whose containers survived an agent
+        # restart (in-memory _admitted lost) must still hold their
+        # capacity — otherwise a newly bound pod could steal it and
+        # get a running workload rejected at re-admission.
+        running_uids = {s.pod_uid
+                        for s in await self.runtime.list_containers()
+                        if s.state == STATE_RUNNING}
         active = [p for p in self._pods.values()
                   if t.is_pod_active(p) and p.key() != pod.key()
-                  and p.key() in self._admitted]
+                  and (p.key() in self._admitted
+                       or p.metadata.uid in running_uids)]
         if len(active) + 1 > int(self.capacity.get(t.RESOURCE_PODS, 110)):
             # Critical-pod preemption (preemption.go): evict the
             # lowest-priority pod to admit a critical one.

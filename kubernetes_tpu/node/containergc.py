@@ -95,8 +95,8 @@ class ContainerGC:
         orphans = [s for s in dead if s.pod_uid not in live_uids]
         to_remove.extend(orphans)
 
-        # 2. Per live (pod, container-name): keep the newest always,
-        #    plus up to max_per_pod_container older instances.
+        # 2. Per live (pod, container-name): keep the newest
+        #    max(max_per_pod_container, 1) dead records total.
         groups: dict[tuple[str, str], list[ContainerStatus]] = {}
         for s in dead:
             if s.pod_uid in live_uids:

@@ -63,13 +63,16 @@ class ObjectCache:
     async def get(self, plural: str, namespace, name: str):
         if plural not in self._CACHED:
             return await self.client.get(plural, namespace, name)
+        # Entries store FETCH time and are judged against the TTL in
+        # force at READ time, so a lowered node annotation tightens
+        # freshness for already-cached entries immediately.
         ttl = self.ttl_source()
         key = (plural, namespace, name)
         now = time.monotonic()
         if ttl > 0:
             hit = self._cache.get(key)
             if hit is not None:
-                if hit[0] > now:
+                if now - hit[0] < ttl:
                     return hit[1]
                 del self._cache[key]  # expired: don't pin the object
         obj = await self.client.get(plural, namespace, name)
@@ -78,8 +81,8 @@ class ObjectCache:
                 # Amortized sweep so entries for long-gone pods'
                 # configs don't accumulate over the node's lifetime.
                 self._cache = {k: v for k, v in self._cache.items()
-                               if v[0] > now}
-            self._cache[key] = (now + ttl, obj)
+                               if now - v[0] < ttl}
+            self._cache[key] = (now, obj)
         else:
             self._cache.pop(key, None)
         return obj

@@ -1,0 +1,263 @@
+"""Mixture-of-Experts transformer — expert parallelism over the mesh.
+
+The orchestrator's job for MoE is the same as for dense models —
+allocate a contiguous sub-mesh and export its shape — but the workload
+exercises the one parallelism style the dense LM doesn't: **expert
+parallelism (ep)**. Experts live sharded across the ``ep`` axis, each
+token is routed to its top-k experts, and XLA turns the
+token-sharded ↔ expert-sharded einsum boundary into ``all_to_all``
+collectives over ICI (the GShard/Switch dispatch pattern — no hand-
+written collectives, just sharding constraints; reference framework
+has no MoE analog, cf. SURVEY §2.4 "strategies live inside the
+scheduled workload").
+
+TPU-first choices:
+- dispatch/combine as dense one-hot einsums (static shapes, batched
+  matmuls on the MXU; no gather/scatter or dynamic shapes that would
+  defeat XLA tiling),
+- fixed expert capacity (``capacity_factor``) so every step compiles
+  once; overflow tokens are dropped (their combine weight is zero),
+  the standard trade,
+- bf16 compute, fp32 router (small but precision-critical), aux
+  load-balancing loss (Switch-style) to keep experts utilized.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .lm import _rms_norm, _rope, make_optimizer
+from .ring_attention import ring_attention
+from .sharding import shard
+
+MOE_AXES = ("dp", "ep", "sp", "tp")
+
+#: Activations [batch, seq, embed]: batch over (dp, ep) — the ep axis
+#: doubles as data parallelism outside the expert computation, which
+#: is what makes the all_to_all boundary an *exchange*, not a gather.
+MOE_ACT_SPEC = P(("dp", "ep"), "sp", None)
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    vocab: int = 256
+    d_model: int = 128
+    n_layers: int = 2
+    n_heads: int = 4
+    d_ff: int = 256
+    n_experts: int = 4
+    top_k: int = 2
+    #: Per-expert buffer = capacity_factor * top_k * tokens / experts.
+    capacity_factor: float = 1.25
+    aux_loss_weight: float = 1e-2
+    rope_base: float = 10_000.0
+    param_dtype: Any = jnp.float32
+    compute_dtype: Any = jnp.bfloat16
+    remat: bool = True
+
+    def __post_init__(self):
+        if self.d_model % self.n_heads:
+            raise ValueError("d_model must divide into heads")
+        if not 1 <= self.top_k <= self.n_experts:
+            raise ValueError("need 1 <= top_k <= n_experts")
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+def make_moe_mesh(devices=None, *, dp: int = 1, ep: int = 1, sp: int = 1,
+                  tp: int = 1) -> Mesh:
+    if devices is None:
+        devices = jax.devices()
+    want = dp * ep * sp * tp
+    if len(devices) < want:
+        raise ValueError(f"need {want} devices, have {len(devices)}")
+    grid = np.asarray(devices[:want]).reshape(dp, ep, sp, tp)
+    return Mesh(grid, MOE_AXES)
+
+
+def param_specs(cfg: MoEConfig) -> dict:
+    return {
+        "embed": P(None, None),
+        "layers": {
+            "ln1": P(None, None),
+            "wq": P(None, None, "tp"),
+            "wk": P(None, None, "tp"),
+            "wv": P(None, None, "tp"),
+            "wo": P(None, "tp", None),
+            "ln2": P(None, None),
+            "router": P(None, None, None),
+            # Experts sharded over ep (each device owns E/ep experts),
+            # expert FFN columns over tp.
+            "w1": P(None, "ep", None, "tp"),
+            "w3": P(None, "ep", None, "tp"),
+            "w2": P(None, "ep", "tp", None),
+        },
+        "ln_f": P(None),
+    }
+
+
+def init_params(rng, cfg: MoEConfig) -> dict:
+    pdt = cfg.param_dtype
+    keys = iter(jax.random.split(rng, 16))
+
+    def norm(key, shape, scale):
+        return (jax.random.normal(key, shape) * scale).astype(pdt)
+
+    L, d, ff, E = cfg.n_layers, cfg.d_model, cfg.d_ff, cfg.n_experts
+    return {
+        "embed": norm(next(keys), (cfg.vocab, d), d ** -0.5),
+        "layers": {
+            "ln1": jnp.ones((L, d), pdt),
+            "wq": norm(next(keys), (L, d, d), d ** -0.5),
+            "wk": norm(next(keys), (L, d, d), d ** -0.5),
+            "wv": norm(next(keys), (L, d, d), d ** -0.5),
+            "wo": norm(next(keys), (L, d, d), d ** -0.5),
+            "ln2": jnp.ones((L, d), pdt),
+            "router": norm(next(keys), (L, d, E), d ** -0.5),
+            "w1": norm(next(keys), (L, E, d, ff), d ** -0.5),
+            "w3": norm(next(keys), (L, E, d, ff), d ** -0.5),
+            "w2": norm(next(keys), (L, E, ff, d), ff ** -0.5),
+        },
+        "ln_f": jnp.ones((d,), pdt),
+    }
+
+
+def _route(y, router_w, cfg: MoEConfig):
+    """Top-k routing (GShard): returns (dispatch [N,E,C] one-hot,
+    combine [N,E,C] weights, aux load-balance loss). N = B*T tokens,
+    C = per-expert capacity. fp32 throughout — router logits are tiny
+    but decide where FLOPs go."""
+    N, E = y.shape[0], cfg.n_experts
+    capacity = max(1, int(cfg.capacity_factor * cfg.top_k * N / E))
+    logits = (y.astype(jnp.float32) @ router_w.astype(jnp.float32))  # [N,E]
+    probs = jax.nn.softmax(logits, axis=-1)
+
+    # Switch-style aux loss: mean prob mass * mean top-1 assignment
+    # fraction per expert, scaled by E (minimized at uniform).
+    top1 = jnp.argmax(probs, axis=-1)
+    me = jnp.mean(probs, axis=0)
+    ce = jnp.mean(jax.nn.one_hot(top1, E, dtype=jnp.float32), axis=0)
+    aux = E * jnp.sum(me * ce)
+
+    dispatch = jnp.zeros((N, E, capacity), jnp.float32)
+    combine = jnp.zeros((N, E, capacity), jnp.float32)
+    # Position within each expert's buffer accumulates across the k
+    # routing rounds (an expert can be chosen at different ranks by
+    # different tokens).
+    fill = jnp.zeros((E,), jnp.int32)
+    masked = probs
+    gate_sum = jnp.zeros((N,), jnp.float32)
+    picks = []
+    for _ in range(cfg.top_k):
+        idx = jnp.argmax(masked, axis=-1)                      # [N]
+        gate = jnp.take_along_axis(probs, idx[:, None], -1)[:, 0]
+        picks.append((idx, gate))
+        gate_sum = gate_sum + gate
+        masked = masked * (1.0 - jax.nn.one_hot(idx, E, dtype=masked.dtype))
+    for idx, gate in picks:
+        onehot = jax.nn.one_hot(idx, E, dtype=jnp.int32)       # [N,E]
+        pos = fill[None, :] + jnp.cumsum(onehot, axis=0) - onehot  # [N,E]
+        pos_tok = jnp.sum(pos * onehot, axis=-1)               # [N]
+        fits = pos_tok < capacity
+        gate_n = jnp.where(gate_sum > 0, gate / gate_sum, 0.0)
+        oh_cap = (jax.nn.one_hot(idx, E, dtype=jnp.float32)[:, :, None]
+                  * jax.nn.one_hot(jnp.minimum(pos_tok, capacity - 1),
+                                   capacity, dtype=jnp.float32)[:, None, :])
+        keep = fits.astype(jnp.float32)[:, None, None]
+        dispatch = dispatch + oh_cap * keep
+        combine = combine + oh_cap * keep * gate_n[:, None, None]
+        fill = fill + jnp.sum(onehot * fits[:, None].astype(jnp.int32), axis=0)
+    return dispatch, combine, aux
+
+
+def _moe_ffn(y, lp, cfg: MoEConfig, mesh):
+    """[B,T,d] -> [B,T,d] through top-k routed experts. The einsum
+    pair (token-sharded -> expert-sharded -> token-sharded) is where
+    XLA inserts the all_to_all over ep."""
+    cdt = cfg.compute_dtype
+    b, t, d = y.shape
+    yf = y.reshape(b * t, d)
+    dispatch, combine, aux = _route(yf, lp["router"], cfg)
+    # Expert buffers [E, C, d], E sharded over ep.
+    expert_in = jnp.einsum("nec,nd->ecd", dispatch.astype(cdt), yf)
+    expert_in = lax.with_sharding_constraint(
+        expert_in, NamedSharding(mesh, P("ep", None, None)))
+    h = jax.nn.silu(jnp.einsum("ecd,edf->ecf", expert_in, lp["w1"].astype(cdt)))
+    h = h * jnp.einsum("ecd,edf->ecf", expert_in, lp["w3"].astype(cdt))
+    out = jnp.einsum("ecf,efd->ecd", h, lp["w2"].astype(cdt))
+    out = lax.with_sharding_constraint(
+        out, NamedSharding(mesh, P("ep", None, None)))
+    mixed = jnp.einsum("nec,ecd->nd", combine.astype(cdt), out)
+    return mixed.reshape(b, t, d), aux
+
+
+def forward(params: dict, tokens, cfg: MoEConfig, mesh):
+    """tokens [B,T] -> (logits [B,T,vocab] fp32, mean aux loss)."""
+    cdt = cfg.compute_dtype
+    act = NamedSharding(mesh, MOE_ACT_SPEC)
+    b, t = tokens.shape
+    h, dh = cfg.n_heads, cfg.head_dim
+
+    x = params["embed"].astype(cdt)[tokens]
+    x = lax.with_sharding_constraint(x, act)
+
+    def layer(carry, lp):
+        x, aux_total = carry
+        y = _rms_norm(x, lp["ln1"].astype(cdt))
+        q = (y @ lp["wq"].astype(cdt)).reshape(b, t, h, dh).transpose(0, 2, 1, 3)
+        k = (y @ lp["wk"].astype(cdt)).reshape(b, t, h, dh).transpose(0, 2, 1, 3)
+        v = (y @ lp["wv"].astype(cdt)).reshape(b, t, h, dh).transpose(0, 2, 1, 3)
+        q, k = _rope(q, cfg), _rope(k, cfg)
+        o = ring_attention(q, k, v, mesh, batch_axes=("dp", "ep"))
+        o = o.transpose(0, 2, 1, 3).reshape(b, t, h * dh)
+        x = x + lax.with_sharding_constraint(o @ lp["wo"].astype(cdt), act)
+
+        y = _rms_norm(x, lp["ln2"].astype(cdt))
+        moe_out, aux = _moe_ffn(y, lp, cfg, mesh)
+        x = x + lax.with_sharding_constraint(moe_out, act)
+        return (x, aux_total + aux), None
+
+    body = jax.checkpoint(layer) if cfg.remat else layer
+    (x, aux_total), _ = lax.scan(body, (x, jnp.float32(0.0)), params["layers"])
+    x = _rms_norm(x, params["ln_f"].astype(cdt))
+    logits = (x @ params["embed"].astype(cdt).T).astype(jnp.float32)
+    return logits, aux_total / cfg.n_layers
+
+
+def loss_fn(params, batch, cfg: MoEConfig, mesh):
+    inputs, targets = batch[:, :-1], batch[:, 1:]
+    logits, aux = forward(params, inputs, cfg, mesh)
+    logz = jax.scipy.special.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(logz - gold) + cfg.aux_loss_weight * aux
+
+
+def init_sharded(rng, cfg: MoEConfig, mesh, lr: float = 3e-3):
+    params = shard(mesh, init_params(rng, cfg), param_specs(cfg))
+    opt_state = make_optimizer(lr).init(params)
+    return params, opt_state
+
+
+def make_train_step(cfg: MoEConfig, mesh, lr: float = 3e-3):
+    opt = make_optimizer(lr)
+
+    def step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch, cfg, mesh)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    return jax.jit(step, donate_argnums=(0, 1))
+
+
+def synthetic_batch(rng, cfg: MoEConfig, mesh, batch: int, seq: int):
+    toks = jax.random.randint(rng, (batch, seq + 1), 0, cfg.vocab, jnp.int32)
+    return jax.device_put(toks, NamedSharding(mesh, P(("dp", "ep"), None)))

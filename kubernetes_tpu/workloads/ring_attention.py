@@ -75,11 +75,13 @@ def _ring_block(q, k, v, *, axis: str):
     return (o / jnp.maximum(l, 1e-30)).astype(q.dtype)
 
 
-def ring_attention(q, k, v, mesh, *, seq_axis: str = "sp"):
-    """Causal MHA over [B, H, T, D] with batch on (dp,fsdp), heads on
-    tp, sequence on the ring axis. Degenerates to ordinary blockwise
-    attention when the ring has one member."""
-    spec = P(("dp", "fsdp"), "tp", seq_axis, None)
+def ring_attention(q, k, v, mesh, *, seq_axis: str = "sp",
+                   batch_axes: tuple = ("dp", "fsdp")):
+    """Causal MHA over [B, H, T, D] with batch on ``batch_axes``
+    (dense LM: (dp, fsdp); MoE: (dp, ep)), heads on tp, sequence on
+    the ring axis. Degenerates to ordinary blockwise attention when
+    the ring has one member."""
+    spec = P(batch_axes, "tp", seq_axis, None)
     fn = _shard_map(
         functools.partial(_ring_block, axis=seq_axis), mesh=mesh,
         in_specs=(spec, spec, spec), out_specs=spec)
