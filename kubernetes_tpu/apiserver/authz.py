@@ -113,9 +113,34 @@ def verb_for_request(method: str, has_name: bool, is_watch: bool) -> str:
                 method, method.lower())
 
 
+#: Usernames minted by bootstrap.mint_node_credential.
+NODE_USER_PREFIX = "system:serviceaccount:kube-system:node-"
+
+
+class NodeRestriction(Authorizer):
+    """NodeRestriction-lite (reference: the node authorizer +
+    NodeRestriction admission): node identities must not read secrets
+    in kube-system — that namespace holds every OTHER node's token
+    secret and all bootstrap tokens, so one compromised node must not
+    be able to mint or steal cluster-wide identities. Workload-
+    namespace secrets stay readable (pod volumes need them; per-pod
+    graph scoping as in the reference node authorizer is future work).
+    Everything else delegates to the wrapped authorizer."""
+
+    def __init__(self, inner: Authorizer):
+        self.inner = inner
+
+    def authorize(self, attrs: Attributes) -> bool:
+        if (attrs.user.startswith(NODE_USER_PREFIX)
+                and attrs.resource.split("/")[0] == "secrets"
+                and attrs.namespace == "kube-system"):
+            return False
+        return self.inner.authorize(attrs)
+
+
 def make_authorizer(mode: str, registry: Registry) -> Optional[Authorizer]:
     if mode == "RBAC":
-        return RBACAuthorizer(registry)
+        return NodeRestriction(RBACAuthorizer(registry))
     if mode in ("", "AlwaysAllow"):
         return AlwaysAllow()
     raise ValueError(f"unknown authorization mode {mode!r}")

@@ -1,0 +1,184 @@
+"""Bootstrap tokens + node credential minting — the kubeadm analog.
+
+Reference flow (``cmd/kubeadm``): ``kubeadm token create`` writes a
+``bootstrap.kubernetes.io/token`` Secret in kube-system; the
+apiserver's bootstrap-token authenticator maps a ``<id>.<secret>``
+bearer to user ``system:bootstrap:<id>`` in group
+``system:bootstrappers``; RBAC lets that group request a node
+credential (there: a CSR the controller signs into a
+``system:node:<name>`` client cert); ``kubeadm join`` then runs the
+kubelet with it.
+
+This environment has no TLS stack, so the CSR-signing step is replaced
+by its end state: ``mint_node_credential`` creates a per-node
+ServiceAccount (kube-system/``node-<name>``) + token Secret and a
+ClusterRoleBinding to the ``system:node`` ClusterRole, and returns the
+bearer token. Same trust shape — a short-lived, revocable, auditable
+bootstrap secret is exchanged for a durable, least-privilege node
+identity — over the SA-token machinery the server already verifies
+(``server.py _sa_user``: UID-bound, revocable).
+"""
+from __future__ import annotations
+
+import base64
+import datetime
+import re
+import secrets as pysecrets
+from typing import Optional
+
+from ..api import errors, rbac, types as t
+from ..api.meta import ObjectMeta, now
+from .registry import Registry
+
+SECRET_TYPE_BOOTSTRAP = "bootstrap.kubernetes.io/token"
+GROUP_BOOTSTRAPPERS = "system:bootstrappers"
+BOOTSTRAP_USER_PREFIX = "system:bootstrap:"
+NODE_ROLE = "system:node"
+NODES_NAMESPACE = "kube-system"
+
+_TOKEN_RE = re.compile(r"^([a-z0-9]{6})\.([a-z0-9]{16})$")
+_ALPHABET = "abcdefghijklmnopqrstuvwxyz0123456789"
+
+
+def generate_token() -> str:
+    """``<6 char id>.<16 char secret>`` (kubeadm token format)."""
+    gen = lambda n: "".join(pysecrets.choice(_ALPHABET) for _ in range(n))  # noqa: E731
+    return f"{gen(6)}.{gen(16)}"
+
+
+def make_bootstrap_secret(token: str, ttl_seconds: float = 24 * 3600,
+                          description: str = "") -> t.Secret:
+    m = _TOKEN_RE.match(token)
+    if not m:
+        raise ValueError("bootstrap token must look like abcdef.0123456789abcdef")
+    token_id, token_secret = m.groups()
+    expiry = (datetime.datetime.now(datetime.timezone.utc)
+              + datetime.timedelta(seconds=ttl_seconds))
+    b64 = lambda s: base64.b64encode(s.encode()).decode()  # noqa: E731
+    return t.Secret(
+        metadata=ObjectMeta(name=f"bootstrap-token-{token_id}",
+                            namespace=NODES_NAMESPACE),
+        type=SECRET_TYPE_BOOTSTRAP,
+        data={
+            "token-id": b64(token_id),
+            "token-secret": b64(token_secret),
+            "expiration": b64(expiry.isoformat()),
+            "usage-bootstrap-authentication": b64("true"),
+            **({"description": b64(description)} if description else {}),
+        })
+
+
+def _field(secret: t.Secret, key: str) -> str:
+    try:
+        return base64.b64decode(secret.data.get(key, ""), validate=True).decode()
+    except Exception:  # noqa: BLE001 — malformed field == absent
+        return ""
+
+
+def resolve_bootstrap_token(registry: Registry, token: str) -> Optional[str]:
+    """Bearer -> ``system:bootstrap:<id>`` or None. Constant-shape
+    lookups: secret fetched by name, comparison via compare_digest."""
+    m = _TOKEN_RE.match(token or "")
+    if not m:
+        return None
+    token_id, token_secret = m.groups()
+    try:
+        secret = registry.get("secrets", NODES_NAMESPACE,
+                              f"bootstrap-token-{token_id}")
+    except errors.StatusError:
+        return None
+    if secret.type != SECRET_TYPE_BOOTSTRAP:
+        return None
+    if not pysecrets.compare_digest(_field(secret, "token-secret"),
+                                    token_secret):
+        return None
+    if _field(secret, "usage-bootstrap-authentication") != "true":
+        return None
+    exp = _field(secret, "expiration")
+    if exp:
+        try:
+            when = datetime.datetime.fromisoformat(exp)
+        except ValueError:
+            return None  # unparseable expiry: fail closed
+        if when <= datetime.datetime.now(datetime.timezone.utc):
+            return None
+    return BOOTSTRAP_USER_PREFIX + token_id
+
+
+#: What a node agent needs (reference: the system:node ClusterRole +
+#: NodeRestriction; we grant the union the agent actually exercises).
+NODE_RULES = [
+    rbac.PolicyRule(verbs=["*"], resources=["nodes", "nodes/status"]),
+    rbac.PolicyRule(verbs=["get", "list", "watch", "update", "patch",
+                           "create", "delete"],
+                    resources=["pods", "pods/status"]),
+    rbac.PolicyRule(verbs=["create", "update", "patch"],
+                    resources=["events"]),
+    rbac.PolicyRule(verbs=["*"], resources=["leases"]),
+    rbac.PolicyRule(verbs=["get", "list", "watch"],
+                    resources=["configmaps", "secrets", "services",
+                               "endpoints", "persistentvolumeclaims",
+                               "persistentvolumes"]),
+]
+
+
+def mint_node_credential(registry: Registry, node_name: str) -> dict:
+    """The CSR-signing analog: durable node identity for ``node_name``.
+    Idempotent; returns {"token", "user", "server_note"}."""
+    if not re.match(r"^[a-z0-9]([a-z0-9.-]{0,61}[a-z0-9])?$", node_name or ""):
+        raise errors.InvalidError("node_name must be a DNS-1123 name")
+    sa_name = f"node-{node_name}"
+
+    try:
+        registry.get("clusterroles", "", NODE_ROLE)
+    except errors.NotFoundError:
+        registry.create(rbac.ClusterRole(
+            metadata=ObjectMeta(name=NODE_ROLE), rules=list(NODE_RULES)))
+
+    try:
+        sa = registry.get("serviceaccounts", NODES_NAMESPACE, sa_name)
+    except errors.NotFoundError:
+        sa = registry.create(t.ServiceAccount(
+            metadata=ObjectMeta(name=sa_name, namespace=NODES_NAMESPACE)))
+
+    user = t.service_account_user(NODES_NAMESPACE, sa_name)
+    binding_name = f"{NODE_ROLE}:{node_name}"
+    try:
+        registry.get("clusterrolebindings", "", binding_name)
+    except errors.NotFoundError:
+        registry.create(rbac.ClusterRoleBinding(
+            metadata=ObjectMeta(name=binding_name),
+            role_ref=rbac.RoleRef(kind="ClusterRole", name=NODE_ROLE),
+            subjects=[rbac.Subject(kind="User", name=user)]))
+
+    # Token secret: reuse a live one bound to this SA's UID, else mint
+    # (same UID-binding rule as the ServiceAccount token controller).
+    secret_name = f"{sa_name}-token"
+    token = ""
+    try:
+        existing = registry.get("secrets", NODES_NAMESPACE, secret_name)
+        if existing.metadata.annotations.get(
+                t.SA_UID_ANNOTATION) == sa.metadata.uid:
+            token = _field(existing, "token")
+        else:
+            registry.delete("secrets", NODES_NAMESPACE, secret_name)
+    except errors.NotFoundError:
+        pass
+    if not token:
+        token = pysecrets.token_urlsafe(32)
+        registry.create(t.Secret(
+            metadata=ObjectMeta(
+                name=secret_name, namespace=NODES_NAMESPACE,
+                annotations={t.SA_NAME_ANNOTATION: sa_name,
+                             t.SA_UID_ANNOTATION: sa.metadata.uid}),
+            type=t.SECRET_TYPE_SA_TOKEN,
+            data={"token": base64.b64encode(token.encode()).decode(),
+                  "namespace": base64.b64encode(
+                      NODES_NAMESPACE.encode()).decode()}))
+        # The SA must reference its token secret or _sa_user rejects it
+        # (anti-spoof check #1).
+        sa = registry.get("serviceaccounts", NODES_NAMESPACE, sa_name)
+        if secret_name not in sa.secrets:
+            sa.secrets.append(secret_name)
+            registry.update(sa)
+    return {"token": token, "user": user, "node_name": node_name}

@@ -96,7 +96,8 @@ class APIServer:
         if self.tokens is not None and not request.path.startswith(("/healthz", "/readyz", "/version")):
             auth = request.headers.get("Authorization", "")
             token = auth[7:] if auth.startswith("Bearer ") else ""
-            user = self.tokens.get(token) or self._sa_user(token)
+            user = (self.tokens.get(token) or self._sa_user(token)
+                    or self._bootstrap_user(token))
             if user is None:
                 return self._err(errors.UnauthorizedError("invalid or missing bearer token"))
             request["user"] = user
@@ -205,6 +206,11 @@ class APIServer:
             return None
         return t.service_account_user(ns, sa_name)
 
+    def _bootstrap_user(self, token: str) -> Optional[str]:
+        """Bootstrap-token authenticator (kubeadm flow; bootstrap.py)."""
+        from .bootstrap import resolve_bootstrap_token
+        return resolve_bootstrap_token(self.registry, token)
+
     def _rebuild_sa_index(self) -> None:
         import base64
         from ..api import types as t
@@ -237,10 +243,23 @@ class APIServer:
         verb = verb_for_request(request.method, bool(name),
                                 request.query.get("watch") in ("1", "true"))
         user = request.get("user", "system:anonymous")
-        groups = set(self.user_groups.get(user, ()))
+        groups = self._groups_for(user)
         resource = f"{plural}/{sub}" if sub else plural
         return Attributes(user, groups, verb, resource,
                           request.match_info.get("namespace", ""), name)
+
+    def _groups_for(self, user: str) -> set[str]:
+        """Configured + username-implied groups (reference: the
+        authenticators attach these; here usernames are canonical).
+        The single source for both RBAC attributes and the bootstrap
+        endpoint's gate."""
+        from .bootstrap import BOOTSTRAP_USER_PREFIX, GROUP_BOOTSTRAPPERS
+        groups = set(self.user_groups.get(user, ()))
+        if user.startswith(BOOTSTRAP_USER_PREFIX):
+            groups.add(GROUP_BOOTSTRAPPERS)
+        if user.startswith("system:serviceaccount:"):
+            groups.add("system:serviceaccounts")
+        return groups
 
     async def _audit(self, request: web.Request, attrs: Attributes,
                      code: int, elapsed: float) -> None:
@@ -274,6 +293,10 @@ class APIServer:
         r.add_get("/version", self._version)
         r.add_get("/metrics", self._metrics)
         r.add_get("/apis", self._discovery)
+        # kubeadm-join analog: exchange a bootstrap token for a durable
+        # node credential (bootstrap.py; the CSR-signing step's end
+        # state, authz'd to system:bootstrappers explicitly below).
+        r.add_post("/bootstrap/v1/node-credentials", self._node_credentials)
         base = "/api/{group}/{version}"
         for prefix in (base + "/namespaces/{namespace}/{plural}", base + "/{plural}"):
             r.add_get(prefix, self._list_or_watch)
@@ -289,6 +312,30 @@ class APIServer:
 
     async def _healthz(self, request):
         return web.Response(text="ok")
+
+    async def _node_credentials(self, request):
+        """POST {"node_name": ...} -> {"token", "user", "node_name"}.
+        Callers: bootstrap-token users (system:bootstrappers) or
+        cluster admins; this is a non-resource path, so the group gate
+        lives here rather than in RBAC rules."""
+        from ..api import rbac as rbacapi
+        from .bootstrap import GROUP_BOOTSTRAPPERS, mint_node_credential
+        user = request.get("user", "system:anonymous")
+        groups = self._groups_for(user)
+        if self.tokens is not None and GROUP_BOOTSTRAPPERS not in groups \
+                and rbacapi.GROUP_MASTERS not in groups:
+            return self._err(errors.ForbiddenError(
+                f"user {user!r} is not a bootstrapper"))
+        try:
+            body = await request.json()
+            node_name = body.get("node_name", "")
+        except Exception:  # noqa: BLE001
+            return self._err(errors.InvalidError("body must be JSON"))
+        cred = mint_node_credential(self.registry, node_name)
+        # The fresh SA token must authenticate immediately — invalidate
+        # the authenticator's index instead of waiting out its TTL.
+        self._sa_index_at = float("-inf")
+        return web.json_response(cred)
 
     async def _version(self, request):
         from .. import __version__

@@ -26,6 +26,7 @@ import json
 import os
 import signal
 import sys
+import tempfile
 from typing import Any, Optional
 
 from ..api import errors, types as t
@@ -493,6 +494,127 @@ async def cmd_up(args) -> int:
     return 0
 
 
+# -- kubeadm analog: token management + join -------------------------------
+
+async def cmd_token(args) -> int:
+    """``ktl token create|list|delete`` (kubeadm token analog; the
+    secrets live in kube-system as bootstrap.kubernetes.io/token)."""
+    from ..apiserver.bootstrap import (NODES_NAMESPACE,
+                                       SECRET_TYPE_BOOTSTRAP,
+                                       generate_token,
+                                       make_bootstrap_secret)
+    client = make_client(args)
+    try:
+        if args.action == "create":
+            token = generate_token()
+            await client.create(make_bootstrap_secret(
+                token, ttl_seconds=args.ttl * 3600,
+                description=args.description))
+            print(token)
+            return 0
+        if args.action == "list":
+            secrets, _ = await client.list("secrets", NODES_NAMESPACE)
+            import base64 as b64
+            rows = [("TOKEN-ID", "EXPIRES", "DESCRIPTION")]
+            for s in secrets:
+                if s.type != SECRET_TYPE_BOOTSTRAP:
+                    continue
+
+                def dec(k, s=s):
+                    # Malformed fields render as <invalid>, same
+                    # fail-soft stance as the server-side _field().
+                    try:
+                        return b64.b64decode(
+                            s.data.get(k, ""), validate=True).decode()
+                    except Exception:  # noqa: BLE001
+                        return "<invalid>"
+                rows.append((dec("token-id"), dec("expiration"),
+                             dec("description") if "description" in s.data
+                             else ""))
+            for row in rows:
+                print(f"{row[0]:<10} {row[1]:<34} {row[2]}")
+            return 0
+        # delete
+        await client.delete("secrets", NODES_NAMESPACE,
+                            f"bootstrap-token-{args.token_id}")
+        print(f"bootstrap token {args.token_id!r} deleted")
+        return 0
+    finally:
+        await client.close()
+
+
+async def cmd_join(args) -> int:
+    """``ktl join --server URL --token id.secret`` — exchange the
+    bootstrap token for a node credential and run a node agent against
+    the remote apiserver (kubeadm join analog; multi-host path)."""
+    import socket as socketlib
+
+    import aiohttp
+
+    from ..node.agent import NodeAgent
+    from ..node.devicemanager import DeviceManager
+    from ..node.eviction import EvictionManager
+    from ..node.runtime import ProcessRuntime
+
+    server = load_server(args)
+    node_name = args.name or socketlib.gethostname().lower()
+
+    # 1. Bootstrap-token -> durable node credential.
+    async with aiohttp.ClientSession() as sess:
+        resp = await sess.post(
+            f"{server}/bootstrap/v1/node-credentials",
+            json={"node_name": node_name},
+            headers={"Authorization": f"Bearer {args.token}"})
+        if resp.status != 200:
+            # Body may be anything (older server's 404 page, proxy
+            # error) — never crash on it.
+            try:
+                body = await resp.json()
+                detail = body.get("message", body)
+            except Exception:  # noqa: BLE001
+                detail = (await resp.text())[:200]
+            print(f"join rejected ({resp.status}): {detail}", file=sys.stderr)
+            return 1
+        body = await resp.json()
+    cred = body["token"]
+    print(f"joined as {body['user']}")
+
+    # 2. Run the node agent with the minted identity.
+    client = RESTClient(server, token=cred)
+    # Private by default: pod volumes (decoded Secrets) land here —
+    # never a predictable world-readable /tmp path.
+    node_dir = args.data_dir or os.path.join(
+        os.path.expanduser("~/.ktl"), "nodes", node_name)
+    os.makedirs(node_dir, mode=0o700, exist_ok=True)
+    os.chmod(node_dir, 0o700)  # pre-existing dirs tightened too
+    runtime = ProcessRuntime(node_dir)
+    dm = None
+    if args.real_tpu or args.tpu_chips:
+        plugin_dir = os.path.join(node_dir, "device-plugins")
+        if args.real_tpu:
+            from ..deviceplugin.tpu_plugin import TpuDevicePlugin
+            plugin = TpuDevicePlugin(slice_id=f"slice-{node_name}")
+        else:
+            from ..deviceplugin.stub import StubTpuPlugin, make_topology
+            plugin = StubTpuPlugin(make_topology(
+                mesh_shape=(args.tpu_chips, 1, 1), slice_id=node_name))
+        plugin.serve(os.path.join(plugin_dir, "tpu.sock"))
+        dm = DeviceManager(plugin_dir)
+    agent = NodeAgent(client, node_name, runtime, device_manager=dm,
+                      eviction=EvictionManager(), server_port=0)
+    await agent.start()
+    print(f"node agent {node_name!r} running against {server} "
+          "(SIGINT to leave)")
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    await agent.stop()
+    await client.close()
+    return 0
+
+
 # -- argument parsing ------------------------------------------------------
 
 def build_parser() -> argparse.ArgumentParser:
@@ -567,6 +689,23 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("-c", "--container", default="")
     sp.add_argument("--timeout", type=float, default=30.0,
                     help="kill the command after this many seconds")
+
+    sp = add("token", cmd_token, help="manage bootstrap tokens (kubeadm analog)")
+    sp.add_argument("action", choices=["create", "list", "delete"])
+    sp.add_argument("token_id", nargs="?", default="",
+                    help="token id (delete)")
+    sp.add_argument("--ttl", type=float, default=24.0,
+                    help="token lifetime in hours (create)")
+    sp.add_argument("--description", default="")
+
+    sp = add("join", cmd_join, help="join this host as a node (kubeadm join)")
+    sp.add_argument("--token", required=True, help="bootstrap token id.secret")
+    sp.add_argument("--name", default="", help="node name (default: hostname)")
+    sp.add_argument("--tpu-chips", type=int, default=0,
+                    help="serve a stub plugin with N chips")
+    sp.add_argument("--real-tpu", action="store_true", default=False,
+                    help="probe real TPU hardware")
+    sp.add_argument("--data-dir", default="")
 
     sp = add("up", cmd_up, help="run a single-process cluster")
     # SUPPRESS defaults: flag PRESENCE marks it explicitly passed, so

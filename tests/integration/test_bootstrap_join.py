@@ -1,0 +1,198 @@
+"""Bootstrap tokens + node join (apiserver/bootstrap.py, ktl join) —
+the kubeadm analog (reference: cmd/kubeadm token flow + TLS bootstrap,
+whose end state here is a UID-bound node ServiceAccount token)."""
+import asyncio
+import datetime
+
+import aiohttp
+import pytest
+
+from kubernetes_tpu.api import errors, rbac, types as t
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.apiserver import bootstrap
+from kubernetes_tpu.apiserver.authz import make_authorizer
+from kubernetes_tpu.apiserver.registry import Registry
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.client.rest import RESTClient
+
+
+def make_registry():
+    reg = Registry()
+    reg.create(t.Namespace(metadata=ObjectMeta(name="default")))
+    reg.create(t.Namespace(metadata=ObjectMeta(name="kube-system")))
+    return reg
+
+
+async def start_server(reg):
+    server = APIServer(
+        reg, tokens={"root-token": "root"},
+        authorizer=make_authorizer("RBAC", reg),
+        user_groups={"root": {rbac.GROUP_MASTERS}})
+    port = await server.start()
+    return server, f"http://127.0.0.1:{port}"
+
+
+def test_token_format_and_resolution():
+    reg = make_registry()
+    token = bootstrap.generate_token()
+    assert bootstrap._TOKEN_RE.match(token)
+    reg.create(bootstrap.make_bootstrap_secret(token))
+    user = bootstrap.resolve_bootstrap_token(reg, token)
+    assert user == f"system:bootstrap:{token.split('.')[0]}"
+    # Wrong secret half, malformed, unknown id: all rejected.
+    tid = token.split(".")[0]
+    assert bootstrap.resolve_bootstrap_token(reg, f"{tid}.{'x' * 16}") is None
+    assert bootstrap.resolve_bootstrap_token(reg, "nope") is None
+    assert bootstrap.resolve_bootstrap_token(
+        reg, "aaaaaa.aaaaaaaaaaaaaaaa") is None
+
+
+def test_expired_token_rejected():
+    reg = make_registry()
+    token = bootstrap.generate_token()
+    reg.create(bootstrap.make_bootstrap_secret(token, ttl_seconds=-60))
+    assert bootstrap.resolve_bootstrap_token(reg, token) is None
+
+
+def test_usage_flag_required():
+    import base64
+    reg = make_registry()
+    token = bootstrap.generate_token()
+    secret = bootstrap.make_bootstrap_secret(token)
+    secret.data["usage-bootstrap-authentication"] = (
+        base64.b64encode(b"false").decode())
+    reg.create(secret)
+    assert bootstrap.resolve_bootstrap_token(reg, token) is None
+
+
+async def test_join_flow_over_http():
+    """Full kubeadm-join shape over the real HTTP chain: bootstrap
+    token -> credential mint -> node identity with least privilege."""
+    reg = make_registry()
+    server, base = await start_server(reg)
+    token = bootstrap.generate_token()
+    reg.create(bootstrap.make_bootstrap_secret(token))
+    try:
+        # 1. The bootstrap token authenticates but has NO resource
+        # powers (401 for garbage, 403 for resources).
+        boot = RESTClient(base, token=token)
+        with pytest.raises(errors.ForbiddenError):
+            await boot.list("secrets", "kube-system")
+        await boot.close()
+
+        # 2. It may mint a node credential.
+        async with aiohttp.ClientSession() as sess:
+            resp = await sess.post(
+                f"{base}/bootstrap/v1/node-credentials",
+                json={"node_name": "worker-9"},
+                headers={"Authorization": f"Bearer {token}"})
+            assert resp.status == 200, await resp.text()
+            cred = await resp.json()
+        assert cred["user"] == "system:serviceaccount:kube-system:node-worker-9"
+
+        # 3. Anonymous/garbage tokens may not.
+        async with aiohttp.ClientSession() as sess:
+            resp = await sess.post(
+                f"{base}/bootstrap/v1/node-credentials",
+                json={"node_name": "evil"},
+                headers={"Authorization": "Bearer nonsense"})
+            assert resp.status == 401
+
+        # 4. A plain authenticated user (no bootstrappers group) may not.
+        server.tokens["user-token"] = "mallory"
+        async with aiohttp.ClientSession() as sess:
+            resp = await sess.post(
+                f"{base}/bootstrap/v1/node-credentials",
+                json={"node_name": "evil"},
+                headers={"Authorization": "Bearer user-token"})
+            assert resp.status == 403
+
+        # 5. The minted identity can do node work but not admin work.
+        node_client = RESTClient(base, token=cred["token"])
+        node = t.Node(metadata=ObjectMeta(name="worker-9"))
+        created = await node_client.create(node)
+        assert created.metadata.name == "worker-9"
+        pods, _ = await node_client.list("pods", "default")
+        assert pods == []
+        with pytest.raises(errors.ForbiddenError):
+            await node_client.delete("clusterrolebindings", "",
+                                     "system:node:worker-9")
+        with pytest.raises(errors.ForbiddenError):
+            await node_client.create(t.Secret(metadata=ObjectMeta(
+                name="stolen", namespace="kube-system")))
+        # NodeRestriction-lite: one compromised node must not read the
+        # bootstrap tokens / other nodes' token secrets in kube-system
+        # (mint-or-steal-identities attack) — but workload-namespace
+        # secrets stay readable for pod volumes.
+        with pytest.raises(errors.ForbiddenError):
+            await node_client.list("secrets", "kube-system")
+        with pytest.raises(errors.ForbiddenError):
+            await node_client.get("secrets", "kube-system",
+                                  "node-worker-9-token")
+        assert (await node_client.list("secrets", "default"))[0] == []
+        await node_client.close()
+
+        # 6. Idempotent re-join returns the same identity.
+        async with aiohttp.ClientSession() as sess:
+            resp = await sess.post(
+                f"{base}/bootstrap/v1/node-credentials",
+                json={"node_name": "worker-9"},
+                headers={"Authorization": f"Bearer {token}"})
+            again = await resp.json()
+        assert again["token"] == cred["token"]
+    finally:
+        await server.stop()
+
+
+async def test_joined_agent_runs_against_remote_server(tmp_path):
+    """A node agent running purely on the minted credential registers,
+    heartbeats, and runs a pod — the multi-host join path minus the
+    second host."""
+    from kubernetes_tpu.node.agent import NodeAgent
+    from kubernetes_tpu.node.runtime import FakeRuntime
+    from kubernetes_tpu.scheduler.scheduler import Scheduler
+
+    reg = make_registry()
+    server, base = await start_server(reg)
+    token = bootstrap.generate_token()
+    reg.create(bootstrap.make_bootstrap_secret(token))
+    try:
+        async with aiohttp.ClientSession() as sess:
+            resp = await sess.post(
+                f"{base}/bootstrap/v1/node-credentials",
+                json={"node_name": "joined-0"},
+                headers={"Authorization": f"Bearer {token}"})
+            cred = await resp.json()
+
+        client = RESTClient(base, token=cred["token"])
+        agent = NodeAgent(client, "joined-0", FakeRuntime(),
+                          status_interval=0.3, heartbeat_interval=0.3,
+                          pleg_interval=0.1, server_port=None)
+        root = RESTClient(base, token="root-token")
+        sched = Scheduler(root, backoff_seconds=0.2)
+        await agent.start()
+        await sched.start()
+        try:
+            node = await root.get("nodes", "", "joined-0")
+            ready = t.get_node_condition(node.status, t.NODE_READY)
+            assert ready and ready.status == "True"
+
+            pod = t.Pod(metadata=ObjectMeta(name="p1", namespace="default"),
+                        spec=t.PodSpec(containers=[t.Container(
+                            name="c", image="i", command=["sleep", "9"])]))
+            await root.create(pod)
+            got = None
+            for _ in range(100):
+                await asyncio.sleep(0.1)
+                got = await root.get("pods", "default", "p1")
+                if got.status.phase == t.POD_RUNNING:
+                    break
+            assert got is not None and got.status.phase == t.POD_RUNNING
+            assert got.spec.node_name == "joined-0"
+        finally:
+            await sched.stop()
+            await agent.stop()
+            await client.close()
+            await root.close()
+    finally:
+        await server.stop()
