@@ -1,0 +1,35 @@
+#!/usr/bin/env bash
+# Race/sanitizer discipline — the KUBE_RACE="-race" analog
+# (reference: hack/make-rules/test.sh:107,285,331).
+#
+# Three tiers:
+#   1. TSAN: native sub-mesh allocator hammered by concurrent readers
+#      (the scheduler's production calling pattern).
+#   2. ASAN+UBSAN: randomized input sweep over the same native code.
+#   3. Python: asyncio debug mode (slow-callback + non-awaited
+#      detection) over the concurrency-heavy suites (one stress round;
+#      hack/stress.sh loops more).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+SRC=kubernetes_tpu/native/submesh.cpp
+DRIVER=kubernetes_tpu/native/submesh_race_test.cpp
+TMP="$(mktemp -d)"
+trap 'rm -rf "$TMP"' EXIT
+
+echo "=== 1/3 TSAN: concurrent sub-mesh allocation ==="
+g++ -O1 -g -std=c++17 -fsanitize=thread "$SRC" "$DRIVER" -o "$TMP/tsan" -lpthread
+"$TMP/tsan"
+
+echo "=== 2/3 ASAN+UBSAN: randomized sweep ==="
+g++ -O1 -g -std=c++17 -fsanitize=address,undefined -fno-sanitize-recover=all \
+    "$SRC" "$DRIVER" -o "$TMP/asan" -lpthread
+"$TMP/asan"
+
+echo "=== 3/3 asyncio debug: concurrency-heavy suites ==="
+PYTHONASYNCIODEBUG=1 python -X dev -W error::RuntimeWarning -m pytest -q \
+  tests/node/test_agent_restart_race.py \
+  tests/integration/test_watch_resilience.py \
+  tests/unit/test_mvcc.py
+
+echo "race.sh: all tiers clean"

@@ -133,7 +133,10 @@ class NodeRestriction(Authorizer):
     def authorize(self, attrs: Attributes) -> bool:
         if (attrs.user.startswith(NODE_USER_PREFIX)
                 and attrs.resource.split("/")[0] == "secrets"
-                and attrs.namespace == "kube-system"):
+                # "" = cluster-wide list/watch, which spans every
+                # namespace including kube-system — same denial, or the
+                # namespaced check is a bypassable fiction.
+                and attrs.namespace in ("", "kube-system")):
             return False
         return self.inner.authorize(attrs)
 

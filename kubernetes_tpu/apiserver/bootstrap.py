@@ -100,6 +100,11 @@ def resolve_bootstrap_token(registry: Registry, token: str) -> Optional[str]:
             when = datetime.datetime.fromisoformat(exp)
         except ValueError:
             return None  # unparseable expiry: fail closed
+        if when.tzinfo is None:
+            # Hand-written naive timestamps: treat as UTC rather than
+            # raising on the aware/naive comparison (fail closed, not
+            # fail crashed — authn runs before the error-mapping try).
+            when = when.replace(tzinfo=datetime.timezone.utc)
         if when <= datetime.datetime.now(datetime.timezone.utc):
             return None
     return BOOTSTRAP_USER_PREFIX + token_id
@@ -160,7 +165,10 @@ def mint_node_credential(registry: Registry, node_name: str) -> dict:
         if existing.metadata.annotations.get(
                 t.SA_UID_ANNOTATION) == sa.metadata.uid:
             token = _field(existing, "token")
-        else:
+        if not token:
+            # Stale UID, or a matching secret whose token field is
+            # missing/undecodable — either way re-mint from scratch
+            # (create below would otherwise 409 forever).
             registry.delete("secrets", NODES_NAMESPACE, secret_name)
     except errors.NotFoundError:
         pass

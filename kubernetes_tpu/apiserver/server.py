@@ -322,8 +322,19 @@ class APIServer:
         from .bootstrap import GROUP_BOOTSTRAPPERS, mint_node_credential
         user = request.get("user", "system:anonymous")
         groups = self._groups_for(user)
+        def record(code: int, name: str = "") -> None:
+            # Credential minting MUST be auditable — this is a
+            # non-resource path, so the middleware's attrs-gated audit
+            # skips it; record explicitly (audit may be disabled).
+            if self.audit is not None:
+                self.audit.record(user=user, verb="mint",
+                                  resource="node-credentials",
+                                  namespace="kube-system", name=name,
+                                  code=code, latency_seconds=0.0)
+
         if self.tokens is not None and GROUP_BOOTSTRAPPERS not in groups \
                 and rbacapi.GROUP_MASTERS not in groups:
+            record(403)
             return self._err(errors.ForbiddenError(
                 f"user {user!r} is not a bootstrapper"))
         try:
@@ -331,7 +342,12 @@ class APIServer:
             node_name = body.get("node_name", "")
         except Exception:  # noqa: BLE001
             return self._err(errors.InvalidError("body must be JSON"))
-        cred = mint_node_credential(self.registry, node_name)
+        try:
+            cred = mint_node_credential(self.registry, node_name)
+        except errors.StatusError as e:
+            record(e.code, node_name)
+            raise
+        record(200, node_name)
         # The fresh SA token must authenticate immediately — invalidate
         # the authenticator's index instead of waiting out its TTL.
         self._sa_index_at = float("-inf")

@@ -26,7 +26,6 @@ import json
 import os
 import signal
 import sys
-import tempfile
 from typing import Any, Optional
 
 from ..api import errors, types as t
@@ -608,7 +607,10 @@ async def cmd_join(args) -> int:
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()
     for sig in (signal.SIGINT, signal.SIGTERM):
-        loop.add_signal_handler(sig, stop.set)
+        try:
+            loop.add_signal_handler(sig, stop.set)
+        except NotImplementedError:  # same guard as cmd_up
+            signal.signal(sig, lambda *_: stop.set())
     await stop.wait()
     await agent.stop()
     await client.close()

@@ -1,0 +1,103 @@
+// Sanitizer driver for the native sub-mesh allocator (submesh.cpp).
+//
+// The KUBE_RACE analog for the repo's C++ (reference:
+// hack/make-rules/test.sh:107 runs the Go suite under the race
+// detector; Python has no TSAN, but the native fast path does).
+// hack/race.sh compiles this file together with submesh.cpp under
+// -fsanitize=thread and -fsanitize=address,undefined and runs it:
+//
+// - Phase 1 (TSAN): the production contract is many scheduler worker
+//   calls against a shared read-only free-mask snapshot; N threads
+//   hammer tpu_find_box concurrently on one mask. Any shared mutable
+//   state inside the allocator is a bug TSAN flags.
+// - Phase 2 (ASAN/UBSAN): randomized mesh/shape sweeps checking the
+//   returned box is in bounds and actually free — out-of-bounds reads
+//   or UB in the index arithmetic surface here.
+//
+// Exit 0 = clean. Any sanitizer report aborts with nonzero.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <thread>
+#include <vector>
+
+extern "C" int tpu_find_box(const uint8_t* free_mask, const int32_t* mesh_in,
+                            const int32_t* shape_in, int32_t torus,
+                            int32_t* out);
+
+namespace {
+
+// Deterministic LCG — sanitizer runs must reproduce.
+uint32_t lcg(uint32_t& s) { return s = s * 1664525u + 1013904223u; }
+
+void fill_mask(std::vector<uint8_t>& mask, uint32_t seed, int percent_free) {
+  uint32_t s = seed;
+  for (auto& m : mask) m = (lcg(s) % 100u) < static_cast<uint32_t>(percent_free);
+}
+
+int check_box(const std::vector<uint8_t>& mask, const int32_t mesh[3],
+              const int32_t out[6]) {
+  // out = {x, y, z, sx, sy, sz}; every covered chip must be free and
+  // in bounds (modulo torus wrap which find_box may use).
+  for (int dx = 0; dx < out[3]; ++dx)
+    for (int dy = 0; dy < out[4]; ++dy)
+      for (int dz = 0; dz < out[5]; ++dz) {
+        int x = (out[0] + dx) % mesh[0];
+        int y = (out[1] + dy) % mesh[1];
+        int z = (out[2] + dz) % mesh[2];
+        size_t idx = (static_cast<size_t>(x) * mesh[1] + y) * mesh[2] + z;
+        if (idx >= mask.size() || !mask[idx]) return 0;
+      }
+  return 1;
+}
+
+}  // namespace
+
+int main() {
+  // Phase 1: concurrent readers over one shared mask (TSAN target).
+  {
+    const int32_t mesh[3] = {8, 8, 4};
+    std::vector<uint8_t> mask(8 * 8 * 4);
+    fill_mask(mask, 42, 70);
+    std::vector<std::thread> threads;
+    for (int t = 0; t < 8; ++t) {
+      threads.emplace_back([&mask, &mesh, t] {
+        const int32_t shapes[4][3] = {{2, 2, 1}, {4, 2, 2}, {1, 1, 4}, {8, 8, 4}};
+        for (int i = 0; i < 200; ++i) {
+          int32_t out[6];
+          const int32_t* shape = shapes[(t + i) % 4];
+          int rc = tpu_find_box(mask.data(), mesh, shape, i % 2, out);
+          if (rc == 1 && !check_box(mask, mesh, out)) {
+            std::fprintf(stderr, "thread %d: invalid box\n", t);
+            std::exit(2);
+          }
+        }
+      });
+    }
+    for (auto& th : threads) th.join();
+  }
+
+  // Phase 2: randomized single-thread sweep (ASAN/UBSAN target).
+  {
+    uint32_t s = 7;
+    for (int iter = 0; iter < 500; ++iter) {
+      int32_t mesh[3] = {static_cast<int32_t>(1 + lcg(s) % 8),
+                         static_cast<int32_t>(1 + lcg(s) % 8),
+                         static_cast<int32_t>(1 + lcg(s) % 4)};
+      std::vector<uint8_t> mask(static_cast<size_t>(mesh[0]) * mesh[1] * mesh[2]);
+      fill_mask(mask, lcg(s), static_cast<int>(lcg(s) % 101));
+      int32_t shape[3] = {static_cast<int32_t>(1 + lcg(s) % 9),
+                          static_cast<int32_t>(1 + lcg(s) % 9),
+                          static_cast<int32_t>(1 + lcg(s) % 5)};
+      int32_t out[6];
+      int rc = tpu_find_box(mask.data(), mesh, shape, lcg(s) % 2, out);
+      if (rc == 1 && !check_box(mask, mesh, out)) {
+        std::fprintf(stderr, "iter %d: invalid box\n", iter);
+        return 2;
+      }
+    }
+  }
+  std::puts("submesh sanitizer driver: OK");
+  return 0;
+}

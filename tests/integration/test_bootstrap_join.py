@@ -129,6 +129,10 @@ async def test_join_flow_over_http():
         with pytest.raises(errors.ForbiddenError):
             await node_client.get("secrets", "kube-system",
                                   "node-worker-9-token")
+        # Cluster-wide (namespace-less) list spans kube-system — must
+        # be denied too, or the namespaced denial is a fiction.
+        with pytest.raises(errors.ForbiddenError):
+            await node_client.list("secrets", None)
         assert (await node_client.list("secrets", "default"))[0] == []
         await node_client.close()
 
