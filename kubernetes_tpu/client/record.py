@@ -25,6 +25,13 @@ class EventRecorder:
     def __init__(self, client: Client, component: str, host: str = ""):
         self.client = client
         self.source = EventSource(component=component, host=host)
+        # Client-side correlation (reference: EventCorrelator LRU):
+        # remembers which event names this process already created so
+        # first-occurrence events cost ONE create (the common case —
+        # e.g. per-pod Scheduled at density scale) and repeats go
+        # straight to update without a probing GET.
+        self._seen: dict[str, None] = {}
+        self._seen_limit = 4096
 
     def _ref(self, obj: Any) -> ObjectReference:
         try:
@@ -51,18 +58,34 @@ class EventRecorder:
                 f"{ref.uid}/{reason}/{message}".encode()).hexdigest()[:10]
             name = f"{ref.name}.{sig}"
             ns = ref.namespace or "default"
-            try:
+            key = f"{ns}/{name}"
+
+            async def bump() -> None:
                 ev = await self.client.get("events", ns, name)
                 ev.count += 1
                 ev.last_timestamp = now()
                 await self.client.update(ev)
-            except errors.NotFoundError:
-                ev = Event(
+
+            if key in self._seen:
+                try:
+                    await bump()
+                    return
+                except errors.NotFoundError:
+                    self._seen.pop(key, None)  # expired/pruned server-side
+            try:
+                await self.client.create(Event(
                     metadata=ObjectMeta(name=name, namespace=ns),
                     involved_object=ref, reason=reason, message=message,
                     type=event_type, count=1, source=self.source,
                     first_timestamp=now(), last_timestamp=now(),
-                )
-                await self.client.create(ev)
+                ))
+            except errors.AlreadyExistsError:
+                await bump()  # another component got there first
+            if len(self._seen) >= self._seen_limit:
+                # FIFO prune (dict preserves insertion order) — a miss
+                # just pays one extra round trip.
+                for stale in list(self._seen)[: self._seen_limit // 2]:
+                    del self._seen[stale]
+            self._seen[key] = None
         except Exception as e:  # noqa: BLE001
             log.debug("event emit failed: %s", e)

@@ -24,9 +24,11 @@ class PredicateResult:
     reasons: list[str]
 
 
-def pod_fits_resources(pod: t.Pod, info: NodeInfo) -> Optional[str]:
+def pod_fits_resources(pod: t.Pod, info: NodeInfo,
+                       requests=None) -> Optional[str]:
     alloc = info.allocatable()
-    requests = t.pod_resource_requests(pod)
+    if requests is None:
+        requests = t.pod_resource_requests(pod)
     for res, want in requests.items():
         if res == t.RESOURCE_TPU:
             continue  # handled geometrically below
@@ -170,9 +172,12 @@ def select_chips(pod: t.Pod, info: NodeInfo) -> Optional[list[t.TpuBinding]]:
 #: Ordered predicate set (cheap checks first, like the reference's
 #: predicates ordering).
 def run_predicates(pod: t.Pod, info: NodeInfo,
-                   skip_tpu: bool = False) -> PredicateResult:
+                   skip_tpu: bool = False,
+                   requests=None) -> PredicateResult:
     """``skip_tpu=True`` lets the caller run :func:`select_chips` itself
-    (one geometry computation serving fit, score, and selection)."""
+    (one geometry computation serving fit, score, and selection).
+    ``requests``: precomputed pod_resource_requests, computed once per
+    pod by the scheduler instead of once per (pod, node)."""
     node = info.node
     if node is None:
         return PredicateResult(False, ["node unknown"])
@@ -181,7 +186,7 @@ def run_predicates(pod: t.Pod, info: NodeInfo,
         node_pressure_allows(pod, node),
         pod_tolerates_taints(pod, node),
         pod_matches_node_selector(pod, node),
-        pod_fits_resources(pod, info),
+        pod_fits_resources(pod, info, requests),
     ]
     if not skip_tpu:
         checks.append(pod_fits_tpus(pod, info))

@@ -16,10 +16,13 @@ from .submesh import allocate_compact, find_box
 MAX_SCORE = 10.0
 
 
-def least_requested(pod: t.Pod, info: NodeInfo) -> float:
-    """Favor idle nodes (spreads load)."""
+def least_requested(pod: t.Pod, info: NodeInfo, want=None) -> float:
+    """Favor idle nodes (spreads load). ``want``: precomputed
+    pod_resource_requests (prioritize() computes it once per pod; the
+    per-(pod,node) recompute dominated density profiles)."""
     alloc = info.allocatable()
-    want = t.pod_resource_requests(pod)
+    if want is None:
+        want = t.pod_resource_requests(pod)
     score = 0.0
     n = 0
     for res in (t.RESOURCE_CPU, t.RESOURCE_MEMORY):
@@ -32,10 +35,11 @@ def least_requested(pod: t.Pod, info: NodeInfo) -> float:
     return score / n if n else MAX_SCORE / 2
 
 
-def balanced_allocation(pod: t.Pod, info: NodeInfo) -> float:
+def balanced_allocation(pod: t.Pod, info: NodeInfo, want=None) -> float:
     """Penalize skew between cpu and memory utilization."""
     alloc = info.allocatable()
-    want = t.pod_resource_requests(pod)
+    if want is None:
+        want = t.pod_resource_requests(pod)
     fractions = []
     for res in (t.RESOURCE_CPU, t.RESOURCE_MEMORY):
         cap = alloc.get(res, 0.0)
@@ -47,7 +51,8 @@ def balanced_allocation(pod: t.Pod, info: NodeInfo) -> float:
     return (1.0 - abs(fractions[0] - fractions[1])) * MAX_SCORE
 
 
-def node_affinity_preferred(pod: t.Pod, info: NodeInfo) -> float:
+def node_affinity_preferred(pod: t.Pod, info: NodeInfo,
+                            want=None) -> float:
     aff = pod.spec.affinity
     if not aff or not aff.node_preferred or info.node is None:
         return 0.0
@@ -108,7 +113,7 @@ def tpu_defrag_score(pod: t.Pod, info: NodeInfo,
     return MAX_SCORE * (1.0 - exposure / worst) if worst else MAX_SCORE
 
 
-def resource_limits(pod: t.Pod, info: NodeInfo) -> float:
+def resource_limits(pod: t.Pod, info: NodeInfo, want=None) -> float:
     """Score nodes able to satisfy the pod's LIMITS (not just requests)
     — burstable pods land where their ceiling actually fits.
     Reference: ``algorithm/priorities/resource_limits.go``
@@ -122,8 +127,8 @@ def resource_limits(pod: t.Pod, info: NodeInfo) -> float:
         return 0.0
     alloc = info.allocatable()
     for res in (t.RESOURCE_CPU, t.RESOURCE_MEMORY):
-        want = limits.get(res)
-        if want and alloc.get(res, 0.0) - info.requested.get(res, 0.0) < want:
+        ceil_amt = limits.get(res)
+        if ceil_amt and alloc.get(res, 0.0) - info.requested.get(res, 0.0) < ceil_amt:
             return 0.0
     return MAX_SCORE
 
@@ -144,13 +149,14 @@ def prioritize(pod: t.Pod, infos: list[NodeInfo],
     """``chip_choices``: node name -> chip ids already selected for this
     pod (from select_chips), so the defrag score reuses the geometry."""
     scores: dict[str, float] = {}
+    want = t.pod_resource_requests(pod)  # once, not per node
     for info in infos:
         if info.node is None:
             continue
         name = info.node.metadata.name
         total = 0.0
         for _, fn, weight in DEFAULT_PRIORITIES:
-            total += weight * fn(pod, info)
+            total += weight * fn(pod, info, want)
         total += TPU_DEFRAG_WEIGHT * tpu_defrag_score(
             pod, info, (chip_choices or {}).get(name))
         if sibling_counts is not None:

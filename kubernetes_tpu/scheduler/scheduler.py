@@ -50,6 +50,8 @@ class Scheduler:
         self._stopped = False
         self._bind_sem = asyncio.Semaphore(64)
         self._bind_tasks: set[asyncio.Task] = set()
+        #: Max in-flight+queued async binds before placement pauses.
+        self.max_bind_backlog = 256
         #: Placements slower than this log an op trace (utiltrace
         #: LogIfLong threshold; the reference uses 100ms).
         self.trace_threshold = 0.1
@@ -207,6 +209,13 @@ class Scheduler:
             self.recorder.event(pod, "Normal", "Scheduled",
                                 f"assigned to {node_name}")
 
+        # Backpressure: placement may run ahead of binds (pipelining),
+        # but not unboundedly — at density scale an uncapped backlog
+        # grows O(pods) tasks and turns the e2e latency metric into a
+        # pure backlog readout.
+        if len(self._bind_tasks) >= self.max_bind_backlog:
+            await asyncio.wait(self._bind_tasks,
+                               return_when=asyncio.FIRST_COMPLETED)
         task = asyncio.get_running_loop().create_task(bind_task())
         self._bind_tasks.add(task)
         task.add_done_callback(self._bind_tasks.discard)
@@ -240,6 +249,7 @@ class Scheduler:
         # accounting changes.
         from .equivalence import equivalence_hash
         eq = equivalence_hash(pod)
+        requests = t.pod_resource_requests(pod)  # once per pod
         for idx in range(n):
             name = names[(start_at + idx) % n]
             info = self.cache.nodes.get(name)
@@ -250,7 +260,8 @@ class Scheduler:
             if cached is not None:
                 fits, cached_reasons = cached
             else:
-                res = run_predicates(pod, info, skip_tpu=True)
+                res = run_predicates(pod, info, skip_tpu=True,
+                                     requests=requests)
                 fits, cached_reasons = res.fits, res.reasons
                 if eq is not None:
                     self.cache.equiv.store(name, eq, fits, cached_reasons)

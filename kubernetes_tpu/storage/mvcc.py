@@ -146,11 +146,93 @@ class Watch:
         return ev
 
 
+class _PrefixIndexedMap(dict):
+    """dict[str, StoredObject] with a secondary index bucketing keys by
+    their first two path segments (``/registry/<plural>/``), so prefix
+    lists cost O(bucket) instead of O(total keys). At reference density
+    (30k pods + their events + nodes) the full-keyspace startswith scan
+    was the apiserver's single hottest path — every LIST and every
+    quota-admission check paid it."""
+
+    def __init__(self):
+        super().__init__()
+        self.buckets: dict[str, dict] = {}
+
+    @staticmethod
+    def bucket_key(key: str):
+        """'/registry/pods/default/x' -> '/registry/pods/'; None when
+        the key has fewer than two '/'-terminated segments."""
+        i = key.find("/", 1)
+        if i == -1:
+            return None
+        j = key.find("/", i + 1)
+        if j == -1:
+            return None
+        return key[: j + 1]
+
+    def __setitem__(self, key, value):
+        super().__setitem__(key, value)
+        bk = self.bucket_key(key)
+        if bk is not None:
+            self.buckets.setdefault(bk, {})[key] = value
+
+    def __delitem__(self, key):
+        super().__delitem__(key)
+        bk = self.bucket_key(key)
+        if bk is not None:
+            bucket = self.buckets.get(bk)
+            if bucket is not None:
+                bucket.pop(key, None)
+                if not bucket:
+                    del self.buckets[bk]
+
+    def pop(self, key, *default):
+        try:
+            value = self[key]
+        except KeyError:
+            if default:
+                return default[0]
+            raise
+        self.__delitem__(key)
+        return value
+
+    def prefix_items(self, prefix: str):
+        """(key, value) pairs under ``prefix`` — bucket-indexed when the
+        prefix reaches into a single bucket, full scan otherwise."""
+        bk = self.bucket_key(prefix)
+        if bk is not None and prefix.startswith(bk):
+            bucket = self.buckets.get(bk, {})
+            if prefix == bk:
+                return list(bucket.items())
+            return [(k, v) for k, v in bucket.items() if k.startswith(prefix)]
+        return [(k, v) for k, v in self.items() if k.startswith(prefix)]
+
+    def prefix_count(self, prefix: str) -> int:
+        """O(1) for whole-bucket prefixes (the quota-admission path)."""
+        bk = self.bucket_key(prefix)
+        if bk is not None and prefix.startswith(bk):
+            bucket = self.buckets.get(bk, {})
+            if prefix == bk:
+                return len(bucket)
+            return sum(1 for k in bucket if k.startswith(prefix))
+        return sum(1 for k in self if k.startswith(prefix))
+
+    # The bucket index is maintained only through __setitem__/
+    # __delitem__/pop — the mutators MVCCStore uses. The rest would
+    # silently desync it; fail loudly instead.
+    def _unsupported(self, *a, **kw):
+        raise NotImplementedError(
+            "mutator bypasses the prefix index; use item assignment/del/pop")
+
+    update = setdefault = clear = popitem = _unsupported
+    __ior__ = _unsupported
+
+
 class MVCCStore:
     def __init__(self, data_dir: Optional[str] = None, history_limit: int = 100_000):
         self._lock = threading.RLock()
         #: key -> StoredObject (live keys only).
-        self._data: dict[str, StoredObject] = {}
+        self._data: _PrefixIndexedMap = _PrefixIndexedMap()
         self._rev = 0
         self._compact_rev = 0
         #: Event history for watch replay, ascending by revision.
@@ -365,7 +447,7 @@ class MVCCStore:
 
     def list(self, prefix: str, copy: bool = True) -> tuple[list[StoredObject], int]:
         with self._lock:
-            items = [o for k, o in self._data.items() if k.startswith(prefix)]
+            items = [o for _k, o in self._data.prefix_items(prefix)]
             items.sort(key=lambda o: o.key)
             if copy:
                 items = [StoredObject(o.key, self._freeze(o.value),
@@ -375,7 +457,7 @@ class MVCCStore:
 
     def count(self, prefix: str) -> int:
         with self._lock:
-            return sum(1 for k in self._data if k.startswith(prefix))
+            return self._data.prefix_count(prefix)
 
     @property
     def revision(self) -> int:
