@@ -277,6 +277,22 @@ class ResourceRequirements:
 
 
 @dataclass
+class LifecycleHandler:
+    """Exec-style hook action (reference: ``v1.Handler``; exec is the
+    one action the process runtime can honor faithfully — it runs in
+    the container's env + sandbox, like ``ktl exec``)."""
+    exec_command: list[str] = field(default_factory=list)
+
+
+@dataclass
+class Lifecycle:
+    """postStart/preStop hooks (reference: ``v1.Lifecycle``,
+    ``pkg/kubelet/lifecycle handlers.go``)."""
+    post_start: Optional[LifecycleHandler] = None
+    pre_stop: Optional[LifecycleHandler] = None
+
+
+@dataclass
 class Container:
     name: str = ""
     image: str = ""
@@ -290,6 +306,7 @@ class Container:
     volume_mounts: list[VolumeMount] = field(default_factory=list)
     liveness_probe: Optional[Probe] = None
     readiness_probe: Optional[Probe] = None
+    lifecycle: Optional[Lifecycle] = None
     #: Names of PodSpec.tpu_resources entries this container uses.
     #: Reference analog: ``Container.ExtendedResourceRequests``
     #: (``types.go:2204``).

@@ -109,6 +109,23 @@ def validate_pod(pod: t.Pod, is_create: bool = True) -> None:
         _validate_container(c, claim_names, f"spec.containers[{i}]", errs)
     if pod.spec.restart_policy not in (t.RESTART_ALWAYS, t.RESTART_ON_FAILURE, t.RESTART_NEVER):
         errs.add("spec.restart_policy", f"unknown policy {pod.spec.restart_policy!r}")
+    aff = pod.spec.affinity
+    if aff is not None:
+        # Required inter-pod terms need a selector and a topology key
+        # (validation.go ValidatePodAffinityTerm) — a selector-less
+        # required term would match nothing and wedge the pod forever.
+        terms = ([("spec.affinity.pod_affinity", tm) for tm in aff.pod_affinity]
+                 + [("spec.affinity.pod_anti_affinity", tm)
+                    for tm in aff.pod_anti_affinity]
+                 + [("spec.affinity.pod_affinity_preferred", wt.pod_affinity_term)
+                    for wt in aff.pod_affinity_preferred]
+                 + [("spec.affinity.pod_anti_affinity_preferred", wt.pod_affinity_term)
+                    for wt in aff.pod_anti_affinity_preferred])
+        for path, term in terms:
+            if term.label_selector is None:
+                errs.add(path, "label_selector is required")
+            if not term.topology_key:
+                errs.add(path, "topology_key is required")
     for i, r in enumerate(pod.spec.tpu_resources):
         if not r.name:
             errs.add(f"spec.tpu_resources[{i}].name", "name is required")

@@ -248,11 +248,100 @@ class ServiceAccountPlugin(AdmissionPlugin):
         return obj
 
 
+class LimitRanger(AdmissionPlugin):
+    """Default and bound container resources from the namespace's
+    LimitRange objects (reference: ``plugin/pkg/admission/limitranger``).
+
+    Mutate: a container missing a request/limit for a resource named in
+    ``default_request``/``default`` gets it filled in. Validate: every
+    container request/limit must sit within [min, max]. Runs BEFORE
+    ResourceQuota in the chain so quota charges see defaulted values
+    (same ordering as the reference's plugin list)."""
+
+    name = "LimitRanger"
+
+    def __init__(self, registry: "Registry"):
+        self.registry = registry
+
+    def _items(self, ns: str) -> list[t.LimitRangeItem]:
+        try:
+            ranges, _ = self.registry.list("limitranges", ns)
+        except errors.StatusError:
+            return []
+        return [item for lr in ranges for item in lr.spec.limits
+                if item.type == "Container"]
+
+    def admit(self, op, spec, obj, old):
+        if spec.kind != "Pod" or op != "CREATE":
+            return obj
+        items = self._items(obj.metadata.namespace)
+        if not items:
+            return obj
+        for c in list(obj.spec.containers) + list(obj.spec.init_containers):
+            for item in items:
+                for res, val in item.default_request.items():
+                    c.resources.requests.setdefault(res, val)
+                for res, val in item.default.items():
+                    c.resources.limits.setdefault(res, val)
+                    # Reference: a defaulted limit also backs a missing
+                    # request so the pod stays Burstable, not invalid.
+                    c.resources.requests.setdefault(res, val)
+        return obj
+
+    def validate(self, op, spec, obj, old):
+        if spec.kind != "Pod" or op != "CREATE":
+            return
+        items = self._items(obj.metadata.namespace)
+        if not items:
+            return
+        for c in list(obj.spec.containers) + list(obj.spec.init_containers):
+            for item in items:
+                # A bound on an ABSENT value must reject, or the policy
+                # is a no-op for containers that just omit the field
+                # (reference minConstraint "No request is specified" /
+                # maxConstraint "No limit is specified"). admit() ran
+                # first, so LimitRange defaults have already filled in
+                # what they could.
+                for res, lo in item.min.items():
+                    got = c.resources.requests.get(res)
+                    if got is None:
+                        raise errors.ForbiddenError(
+                            f"container {c.name!r}: no {res} request, but "
+                            f"LimitRange sets min {lo}")
+                    if t.parse_quantity(got) < t.parse_quantity(lo):
+                        raise errors.ForbiddenError(
+                            f"container {c.name!r}: {res} request {got} "
+                            f"is below LimitRange min {lo}")
+                    lim = c.resources.limits.get(res)
+                    if lim is not None and t.parse_quantity(lim) < \
+                            t.parse_quantity(lo):
+                        raise errors.ForbiddenError(
+                            f"container {c.name!r}: {res} limit {lim} "
+                            f"is below LimitRange min {lo}")
+                for res, hi in item.max.items():
+                    got = c.resources.limits.get(res)
+                    if got is None:
+                        raise errors.ForbiddenError(
+                            f"container {c.name!r}: no {res} limit, but "
+                            f"LimitRange sets max {hi}")
+                    if t.parse_quantity(got) > t.parse_quantity(hi):
+                        raise errors.ForbiddenError(
+                            f"container {c.name!r}: {res} limit {got} "
+                            f"exceeds LimitRange max {hi}")
+                    req = c.resources.requests.get(res)
+                    if req is not None and t.parse_quantity(req) > \
+                            t.parse_quantity(hi):
+                        raise errors.ForbiddenError(
+                            f"container {c.name!r}: {res} request {req} "
+                            f"exceeds LimitRange max {hi}")
+
+
 def default_chain(registry: "Registry") -> AdmissionChain:
     return AdmissionChain([
         NamespaceLifecycle(registry),
         TpuResourceDefaulter(),
         PriorityResolver(registry),
         ServiceAccountPlugin(registry),
+        LimitRanger(registry),
         ResourceQuotaPlugin(registry),
     ])

@@ -649,12 +649,30 @@ class NodeAgent:
         cmap[container.name] = cid
         self.recorder.event(pod, "Normal", "Started",
                             f"container {container.name}")
+        # postStart hook (lifecycle handlers.go): failure kills the
+        # container; the restart policy decides what happens next —
+        # exactly a crashed container.
+        if container.lifecycle is not None and container.lifecycle.post_start:
+            code = await self._run_lifecycle_hook(pod, container, cid,
+                                                  "post_start")
+            if code != 0:
+                await self.runtime.stop_container(cid, grace_seconds=1.0)
+                return
         if container.liveness_probe or container.readiness_probe:
             self.probes.add(pod, container, cid,
                             on_liveness_fail=self._liveness_failed)
 
     def _liveness_failed(self, pod_key: str, container_name: str, cid: str) -> None:
         async def restart():
+            # Every kill path runs preStop first (killContainer).
+            pod = self._pods.get(pod_key)
+            if pod is not None:
+                container = next(
+                    (c for c in pod.spec.containers
+                     if c.name == container_name), None)
+                if container is not None:
+                    await self._run_lifecycle_hook(
+                        pod, container, cid, "pre_stop", timeout=5.0)
             await self.runtime.stop_container(cid, grace_seconds=1.0)
             self._nudge(pod_key)
         asyncio.get_running_loop().create_task(restart())
@@ -779,6 +797,58 @@ class NodeAgent:
 
     # -- termination ------------------------------------------------------
 
+    async def _run_lifecycle_hook(self, pod: t.Pod, container: t.Container,
+                                  cid: str, which: str,
+                                  timeout: float = 30.0) -> int:
+        """Run an exec lifecycle hook in the container's env/sandbox;
+        returns the exit code (0 when absent). Never raises."""
+        lc = container.lifecycle
+        hook = getattr(lc, which, None) if lc is not None else None
+        if hook is None or not hook.exec_command:
+            return 0
+        try:
+            code, out = await asyncio.wait_for(
+                self.runtime.exec_in_container(
+                    cid, list(hook.exec_command), timeout=timeout),
+                timeout=timeout + 1.0)
+        except Exception as e:  # noqa: BLE001
+            code, out = 1, str(e)
+        if code != 0:
+            reason = ("FailedPostStartHook" if which == "post_start"
+                      else "FailedPreStopHook")
+            self.recorder.event(pod, "Warning", reason,
+                                f"{container.name}: exit {code}: "
+                                f"{str(out)[:120]}")
+        return code
+
+    async def _run_pre_stop_hooks(self, pod: t.Pod, cmap: dict[str, str],
+                                  grace: float) -> None:
+        """preStop for every still-running container, CONCURRENTLY and
+        bounded by ONE grace budget for the whole pod — N hanging hooks
+        must cost grace total, not N x grace (kuberuntime killContainer
+        deducts hook time from the container's remaining grace)."""
+        by_name = {c.name: c for c in
+                   list(pod.spec.containers) + list(pod.spec.init_containers)}
+        budget = max(grace, 1.0)
+        hooks = []
+        for name, cid in cmap.items():
+            container = by_name.get(name)
+            if container is None or container.lifecycle is None \
+                    or container.lifecycle.pre_stop is None:
+                continue
+            st = self._pleg_statuses.get(cid)
+            if st is not None and st.state != STATE_RUNNING:
+                continue  # nothing to exec in
+            hooks.append(self._run_lifecycle_hook(
+                pod, container, cid, "pre_stop", timeout=budget))
+        if hooks:
+            try:
+                await asyncio.wait_for(
+                    asyncio.gather(*hooks, return_exceptions=True),
+                    timeout=budget + 1.0)
+            except asyncio.TimeoutError:
+                pass  # hooks overran the pod's budget; proceed to kill
+
     async def _terminate_pod(self, pod: t.Pod) -> None:
         key = pod.key()
         log.info("terminating pod %s", key)
@@ -786,6 +856,7 @@ class NodeAgent:
         grace = float(gp) if gp is not None else 1.0
         cmap = self._containers.get(key, {})
         self.probes.remove_pod(key)
+        await self._run_pre_stop_hooks(pod, cmap, grace)
         for cid in cmap.values():
             await self.runtime.stop_container(cid, grace_seconds=grace)
         for cid in cmap.values():
@@ -869,7 +940,9 @@ class NodeAgent:
         # sandbox dirs) and projected volumes, not just stop processes —
         # a disk-pressure eviction that frees no bytes never clears the
         # signal (reference: eviction reclaims via container/image GC).
-        for cid in self._containers.pop(key, {}).values():
+        cmap = self._containers.pop(key, {})
+        await self._run_pre_stop_hooks(pod, cmap, grace=1.0)
+        for cid in cmap.values():
             await self.runtime.stop_container(cid, grace_seconds=1.0)
             await self.runtime.remove_container(cid)
         self.volumes.teardown(pod.metadata.uid)

@@ -133,6 +133,10 @@ class SchedulerCache:
         #: pod key -> node name for every pod known to the cache
         #: (assumed or informer-added).
         self._pod_node: dict[str, str] = {}
+        #: pod key -> pod, for pods carrying REQUIRED anti-affinity
+        #: terms (the symmetry check in podaffinity.py scans only
+        #: these; empty in affinity-free clusters -> zero cost).
+        self.anti_affinity_pods: dict[str, t.Pod] = {}
 
     def knows_pod(self, key: str) -> bool:
         """True when the cache already tracks this pod (assumed or added)."""
@@ -215,6 +219,11 @@ class SchedulerCache:
             self.equiv.invalidate_node(old_node)
         self._node_for(node_name).add_pod(pod)
         self._pod_node[key] = node_name
+        aff = pod.spec.affinity
+        if aff is not None and aff.pod_anti_affinity:
+            self.anti_affinity_pods[key] = pod
+        else:
+            self.anti_affinity_pods.pop(key, None)
         self.equiv.invalidate_node(node_name)
 
     def update_pod(self, pod: t.Pod) -> None:
@@ -224,6 +233,7 @@ class SchedulerCache:
         key = pod.key()
         node_name = self._pod_node.pop(key, None) or pod.spec.node_name
         self.assumed.pop(key, None)
+        self.anti_affinity_pods.pop(key, None)
         info = self.nodes.get(node_name) if node_name else None
         if info:
             existing = info.pods.get(key, pod)
@@ -240,6 +250,9 @@ class SchedulerCache:
         self._node_for(node_name).add_pod(pod)
         self.assumed[pod.key()] = node_name
         self._pod_node[pod.key()] = node_name
+        aff = pod.spec.affinity
+        if aff is not None and aff.pod_anti_affinity:
+            self.anti_affinity_pods[pod.key()] = pod
         self.equiv.invalidate_node(node_name)
 
     def forget_pod(self, pod: t.Pod) -> None:
@@ -249,6 +262,7 @@ class SchedulerCache:
         if node_name is None:
             return
         self._pod_node.pop(key, None)
+        self.anti_affinity_pods.pop(key, None)
         info = self.nodes.get(node_name)
         if info and key in info.pods:
             info.remove_pod(info.pods[key])

@@ -1,0 +1,158 @@
+"""Inter-pod affinity/anti-affinity — predicate + priority.
+
+Reference: ``algorithm/predicates/predicates.go MatchInterPodAffinity``
+and ``algorithm/priorities/interpod_affinity.go``. Semantics (v1.9
+required terms):
+
+- **affinity**: each term needs an existing pod matching its selector
+  (in the term's namespaces; default = the incoming pod's) running in
+  the candidate node's topology domain. First-pod bootstrap rule: a
+  term nothing matches yet is satisfied everywhere IF the incoming pod
+  itself matches it (else a replica group could never start).
+- **anti-affinity**: no matching pod may run in the candidate's domain;
+  plus the symmetric check — an existing pod's required anti-affinity
+  term matching the incoming pod forbids that pod's domain.
+
+Scale shape: the reference evaluates terms per (pod, node), which is
+the O(nodes x pods) trap VERDICT flagged elsewhere; here an
+:class:`AffinityContext` is built ONCE per incoming pod (a single scan
+of cached pods, skipped entirely when neither the pod nor the cluster
+uses affinity — the cache counts anti-affinity pods incrementally) and
+every node check is O(terms) set lookups.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..api import types as t
+
+HOSTNAME_KEY = "kubernetes.io/hostname"
+
+
+def _term_namespaces(term: t.PodAffinityTerm, pod_ns: str) -> set[str]:
+    return set(term.namespaces) if term.namespaces else {pod_ns}
+
+
+def _matches(term: t.PodAffinityTerm, other: t.Pod, pod_ns: str) -> bool:
+    if other.metadata.namespace not in _term_namespaces(term, pod_ns):
+        return False
+    sel = term.label_selector
+    return sel is not None and sel.matches(other.metadata.labels)
+
+
+def _topo_value(node: t.Node, key: str) -> Optional[str]:
+    if key == HOSTNAME_KEY:
+        # Every node has an implicit hostname value even if unlabeled.
+        return node.metadata.labels.get(key, node.metadata.name)
+    return node.metadata.labels.get(key)
+
+
+@dataclass
+class _TermDomains:
+    term: t.PodAffinityTerm
+    #: Topology values where a matching pod runs.
+    values: set = field(default_factory=set)
+    #: Bootstrap rule: term matches the incoming pod itself.
+    self_match: bool = False
+
+
+@dataclass
+class AffinityContext:
+    required: list[_TermDomains]
+    anti: list[_TermDomains]
+    #: (topology_key, value) domains forbidden by EXISTING pods'
+    #: required anti-affinity terms that match the incoming pod.
+    forbidden_by_existing: set
+    #: Weighted preferred terms: (weight, _TermDomains), anti negated.
+    preferred: list
+
+    def node_allows(self, node: t.Node) -> Optional[str]:
+        """Reason the node is infeasible, or None."""
+        for td in self.required:
+            value = _topo_value(node, td.term.topology_key)
+            if value is None:
+                # A node without the topology key can never satisfy a
+                # required term (reference semantics); admitting it via
+                # the bootstrap rule would silently drop the constraint
+                # for every later replica too.
+                return (f"node lacks topology key "
+                        f"{td.term.topology_key!r} required by pod affinity")
+            if value in td.values:
+                continue
+            if not td.values and td.self_match:
+                continue  # first pod of its own group
+            return ("pod affinity: no pod matching "
+                    f"{td.term.label_selector} in this "
+                    f"{td.term.topology_key} domain")
+        for td in self.anti:
+            value = _topo_value(node, td.term.topology_key)
+            if value is not None and value in td.values:
+                return ("pod anti-affinity: matching pod already in "
+                        f"this {td.term.topology_key} domain")
+        for key, value in self.forbidden_by_existing:
+            if _topo_value(node, key) == value:
+                return ("existing pod's anti-affinity forbids this "
+                        f"{key} domain")
+        return None
+
+    def score(self, node: t.Node) -> float:
+        total = 0.0
+        for weight, td in self.preferred:
+            value = _topo_value(node, td.term.topology_key)
+            if value is not None and value in td.values:
+                total += weight
+        return total
+
+
+def build_context(pod: t.Pod, cache) -> Optional[AffinityContext]:
+    """None when no affinity applies (the common, zero-cost case)."""
+    aff = pod.spec.affinity
+    has_own = bool(aff and (aff.pod_affinity or aff.pod_anti_affinity
+                            or aff.pod_affinity_preferred
+                            or aff.pod_anti_affinity_preferred))
+    cluster_has_anti = bool(getattr(cache, "anti_affinity_pods", None))
+    if not has_own and not cluster_has_anti:
+        return None
+    ns = pod.metadata.namespace
+
+    required = [_TermDomains(term) for term in (aff.pod_affinity if aff else [])]
+    anti = [_TermDomains(term) for term in (aff.pod_anti_affinity if aff else [])]
+    preferred = [(wt.weight, _TermDomains(wt.pod_affinity_term))
+                 for wt in (aff.pod_affinity_preferred if aff else [])]
+    preferred += [(-wt.weight, _TermDomains(wt.pod_affinity_term))
+                  for wt in (aff.pod_anti_affinity_preferred if aff else [])]
+    for td in required + anti:
+        td.self_match = _matches(td.term, pod, ns)
+
+    own_terms = required + anti + [td for _w, td in preferred]
+    incoming_key = pod.key()
+    if own_terms:  # affinity-free pods skip the cluster scan entirely
+        for info in cache.nodes.values():
+            if info.node is None:
+                continue
+            for other in info.pods.values():
+                if other.key() == incoming_key:
+                    continue
+                for td in own_terms:
+                    if _matches(td.term, other, ns):
+                        value = _topo_value(info.node, td.term.topology_key)
+                        if value is not None:
+                            td.values.add(value)
+
+    forbidden = set()
+    for other_key, other in getattr(cache, "anti_affinity_pods", {}).items():
+        if other_key == incoming_key:
+            continue
+        info = cache.nodes.get(other.spec.node_name)
+        if info is None or info.node is None:
+            continue
+        other_aff = other.spec.affinity
+        for term in other_aff.pod_anti_affinity:
+            if _matches(term, pod, other.metadata.namespace):
+                value = _topo_value(info.node, term.topology_key)
+                if value is not None:
+                    forbidden.add((term.topology_key, value))
+    return AffinityContext(required=required, anti=anti,
+                           forbidden_by_existing=forbidden,
+                           preferred=preferred)

@@ -250,6 +250,12 @@ class Scheduler:
         from .equivalence import equivalence_hash
         eq = equivalence_hash(pod)
         requests = t.pod_resource_requests(pod)  # once per pod
+        # Inter-pod affinity context (podaffinity.py): built once per
+        # pod; None in affinity-free clusters. NOT part of the
+        # equivalence-cached predicates — its verdict depends on other
+        # pods, not node accounting.
+        from .podaffinity import build_context
+        affinity_ctx = build_context(pod, self.cache)
         for idx in range(n):
             name = names[(start_at + idx) % n]
             info = self.cache.nodes.get(name)
@@ -268,6 +274,11 @@ class Scheduler:
             if not fits:
                 reasons.append(f"{name}: {'; '.join(cached_reasons)}")
                 continue
+            if affinity_ctx is not None:
+                why = affinity_ctx.node_allows(info.node)
+                if why is not None:
+                    reasons.append(f"{name}: {why}")
+                    continue
             if wants_tpu:
                 bindings = select_chips(pod, info)
                 if bindings is None:
@@ -284,6 +295,18 @@ class Scheduler:
             return None, None, reasons
         sibling_counts = self._sibling_counts(pod)
         scores = prioritize(pod, feasible, sibling_counts, chip_choices)
+        if affinity_ctx is not None and affinity_ctx.preferred:
+            # Normalize to the same 0..MAX_SCORE band as the other
+            # priorities (interpod_affinity.go normalizes before
+            # weighting) — a weight-100 soft preference must not swamp
+            # LeastRequested/defrag.
+            raw = {info.node.metadata.name: affinity_ctx.score(info.node)
+                   for info in feasible}
+            peak = max((abs(v) for v in raw.values()), default=0.0)
+            if peak > 0:
+                from .priorities import MAX_SCORE
+                for name, v in raw.items():
+                    scores[name] += MAX_SCORE * v / peak
         best = max(scores, key=lambda n: (scores[n], n))
         return best, bindings_by_node.get(best, []), []
 

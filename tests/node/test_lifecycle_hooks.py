@@ -1,0 +1,122 @@
+"""Container lifecycle hooks (postStart/preStop) through the real
+agent + process runtime (reference: pkg/kubelet/lifecycle handlers.go,
+kuberuntime killContainer's preStop-first ordering)."""
+import asyncio
+import os
+
+import pytest
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.apiserver.admission import default_chain
+from kubernetes_tpu.apiserver.registry import Registry
+from kubernetes_tpu.client.local import LocalClient
+from kubernetes_tpu.node.agent import NodeAgent
+from kubernetes_tpu.node.runtime import ProcessRuntime
+
+
+async def make_agent(tmp_path):
+    reg = Registry()
+    reg.admission = default_chain(reg)
+    reg.create(t.Namespace(metadata=ObjectMeta(name="default")))
+    client = LocalClient(reg)
+    agent = NodeAgent(client, "n0", ProcessRuntime(str(tmp_path / "rt")),
+                      status_interval=5, heartbeat_interval=5,
+                      pleg_interval=0.1, server_port=None)
+    await agent.start()
+    return reg, client, agent
+
+
+def hook_pod(name, post_start=None, pre_stop=None, command=None):
+    c = t.Container(name="main", image="x",
+                    command=command or ["sleep", "30"])
+    c.lifecycle = t.Lifecycle(
+        post_start=(t.LifecycleHandler(exec_command=post_start)
+                    if post_start else None),
+        pre_stop=(t.LifecycleHandler(exec_command=pre_stop)
+                  if pre_stop else None))
+    pod = t.Pod(metadata=ObjectMeta(name=name, namespace="default"),
+                spec=t.PodSpec(restart_policy="Never", containers=[c]))
+    pod.spec.node_name = "n0"
+    return pod
+
+
+async def wait_phase(client, name, phase, ticks=100):
+    got = None
+    for _ in range(ticks):
+        await asyncio.sleep(0.05)
+        got = await client.get("pods", "default", name)
+        if got.status.phase == phase:
+            return got
+    return got
+
+
+async def test_post_start_runs(tmp_path):
+    marker = str(tmp_path / "post-start-ran")
+    reg, client, agent = await make_agent(tmp_path)
+    try:
+        await client.create(hook_pod(
+            "p1", post_start=["touch", marker], command=["sleep", "5"]))
+        got = await wait_phase(client, "p1", t.POD_RUNNING)
+        assert got.status.phase == t.POD_RUNNING
+        for _ in range(40):
+            if os.path.exists(marker):
+                break
+            await asyncio.sleep(0.05)
+        assert os.path.exists(marker)
+    finally:
+        await agent.stop()
+
+
+async def test_post_start_failure_kills_container(tmp_path):
+    reg, client, agent = await make_agent(tmp_path)
+    try:
+        await client.create(hook_pod("p2", post_start=["false"]))
+        # restart_policy Never + killed container -> Failed.
+        got = await wait_phase(client, "p2", t.POD_FAILED)
+        assert got.status.phase == t.POD_FAILED
+        evs, _ = reg.list("events", "default")
+        assert any(e.reason == "FailedPostStartHook" for e in evs)
+    finally:
+        await agent.stop()
+
+
+async def test_pre_stop_runs_before_termination(tmp_path):
+    marker = str(tmp_path / "pre-stop-ran")
+    reg, client, agent = await make_agent(tmp_path)
+    try:
+        await client.create(hook_pod("p3", pre_stop=["touch", marker]))
+        got = await wait_phase(client, "p3", t.POD_RUNNING)
+        assert got.status.phase == t.POD_RUNNING
+        await client.delete("pods", "default", "p3")
+        for _ in range(100):
+            if os.path.exists(marker):
+                break
+            await asyncio.sleep(0.05)
+        assert os.path.exists(marker)
+    finally:
+        await agent.stop()
+
+
+async def test_pre_stop_failure_does_not_block_kill(tmp_path):
+    reg, client, agent = await make_agent(tmp_path)
+    try:
+        await client.create(hook_pod("p4", pre_stop=["false"]))
+        got = await wait_phase(client, "p4", t.POD_RUNNING)
+        assert got.status.phase == t.POD_RUNNING
+        await client.delete("pods", "default", "p4")
+        # Pod still goes away despite the failing hook.
+        gone = False
+        from kubernetes_tpu.api import errors
+        for _ in range(100):
+            await asyncio.sleep(0.05)
+            try:
+                await client.get("pods", "default", "p4")
+            except errors.NotFoundError:
+                gone = True
+                break
+        assert gone
+        evs, _ = reg.list("events", "default")
+        assert any(e.reason == "FailedPreStopHook" for e in evs)
+    finally:
+        await agent.stop()

@@ -1,0 +1,106 @@
+"""LimitRanger admission (reference: plugin/pkg/admission/limitranger)."""
+import pytest
+
+from kubernetes_tpu.api import errors, types as t
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.apiserver.admission import default_chain
+from kubernetes_tpu.apiserver.registry import Registry
+
+
+def make_reg(limits=None):
+    reg = Registry()
+    reg.admission = default_chain(reg)
+    reg.create(t.Namespace(metadata=ObjectMeta(name="default")))
+    if limits is not None:
+        reg.create(t.LimitRange(
+            metadata=ObjectMeta(name="lr", namespace="default"),
+            spec=t.LimitRangeSpec(limits=[limits])))
+    return reg
+
+
+def mkpod(name="p", requests=None, limits=None):
+    c = t.Container(name="c", image="i")
+    c.resources.requests = dict(requests or {})
+    c.resources.limits = dict(limits or {})
+    return t.Pod(metadata=ObjectMeta(name=name, namespace="default"),
+                 spec=t.PodSpec(containers=[c]))
+
+
+def test_defaults_filled_in():
+    reg = make_reg(t.LimitRangeItem(
+        default_request={"cpu": 0.25, "memory": 128 * 2**20},
+        default={"memory": 256 * 2**20}))
+    created = reg.create(mkpod())
+    res = created.spec.containers[0].resources
+    assert res.requests["cpu"] == 0.25
+    assert res.requests["memory"] == 128 * 2**20
+    assert res.limits["memory"] == 256 * 2**20
+
+
+def test_defaulted_limit_backs_missing_request():
+    reg = make_reg(t.LimitRangeItem(default={"cpu": 1.0}))
+    created = reg.create(mkpod())
+    res = created.spec.containers[0].resources
+    assert res.limits["cpu"] == 1.0
+    assert res.requests["cpu"] == 1.0
+
+
+def test_explicit_values_not_overridden():
+    reg = make_reg(t.LimitRangeItem(default_request={"cpu": 0.25}))
+    created = reg.create(mkpod(requests={"cpu": 2.0}))
+    assert created.spec.containers[0].resources.requests["cpu"] == 2.0
+
+
+def test_min_max_enforced():
+    reg = make_reg(t.LimitRangeItem(min={"memory": 64 * 2**20},
+                                    max={"cpu": 2.0}))
+    with pytest.raises(errors.ForbiddenError, match="below LimitRange min"):
+        reg.create(mkpod("small", requests={"memory": 1 * 2**20},
+                         limits={"cpu": 1.0}))
+    with pytest.raises(errors.ForbiddenError, match="exceeds LimitRange max"):
+        reg.create(mkpod("big", requests={"memory": 128 * 2**20},
+                         limits={"cpu": 8.0}))
+    # In-range passes.
+    reg.create(mkpod("ok", requests={"memory": 128 * 2**20},
+                     limits={"cpu": 1.0}))
+
+
+def test_missing_bounded_value_rejected():
+    """A bound on an absent field rejects — otherwise the policy is a
+    no-op for containers that omit it (reference minConstraint /
+    maxConstraint)."""
+    reg = make_reg(t.LimitRangeItem(max={"cpu": 2.0}))
+    with pytest.raises(errors.ForbiddenError, match="no cpu limit"):
+        reg.create(mkpod("unbounded"))
+    reg2 = make_reg(t.LimitRangeItem(min={"memory": 64 * 2**20}))
+    with pytest.raises(errors.ForbiddenError, match="no memory request"):
+        reg2.create(mkpod("unrequested"))
+    # A `default` entry heals omission: admit fills it in first.
+    reg3 = make_reg(t.LimitRangeItem(max={"cpu": 2.0}, default={"cpu": 1.0}))
+    created = reg3.create(mkpod("defaulted"))
+    assert created.spec.containers[0].resources.limits["cpu"] == 1.0
+
+
+def test_string_quantities():
+    reg = make_reg(t.LimitRangeItem(max={"memory": "1Gi"}))
+    with pytest.raises(errors.ForbiddenError):
+        reg.create(mkpod("big", limits={"memory": "2Gi"}))
+    reg.create(mkpod("ok", limits={"memory": "512Mi"}))
+
+
+def test_no_limitrange_no_effect():
+    reg = make_reg(None)
+    created = reg.create(mkpod())
+    assert created.spec.containers[0].resources.requests == {}
+
+
+def test_defaults_feed_quota_accounting():
+    """LimitRanger runs before ResourceQuota: the charge must see the
+    defaulted request (reference plugin ordering)."""
+    reg = make_reg(t.LimitRangeItem(default_request={"cpu": 1.0}))
+    reg.create(t.ResourceQuota(
+        metadata=ObjectMeta(name="q", namespace="default"),
+        spec=t.ResourceQuotaSpec(hard={"cpu": 1.5})))
+    reg.create(mkpod("first"))  # charges 1.0 defaulted cpu
+    with pytest.raises(errors.ForbiddenError):
+        reg.create(mkpod("second"))  # 2.0 > 1.5

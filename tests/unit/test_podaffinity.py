@@ -1,0 +1,183 @@
+"""Inter-pod affinity/anti-affinity (scheduler/podaffinity.py).
+
+Reference semantics: predicates.go MatchInterPodAffinity +
+interpod_affinity.go priority, incl. the first-pod bootstrap rule and
+the existing-pods'-anti-affinity symmetry check.
+"""
+import asyncio
+
+import pytest
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.api.selectors import LabelSelector
+from kubernetes_tpu.scheduler.cache import SchedulerCache
+from kubernetes_tpu.scheduler.podaffinity import build_context
+
+
+def mk_node(name, labels=None):
+    node = t.Node(metadata=ObjectMeta(
+        name=name, labels={"kubernetes.io/hostname": name, **(labels or {})}))
+    node.status.capacity = {"cpu": 8.0, "memory": 16 * 2**30, "pods": 110.0}
+    node.status.allocatable = dict(node.status.capacity)
+    return node
+
+
+def mk_pod(name, labels=None, node="", affinity=None, ns="default"):
+    pod = t.Pod(metadata=ObjectMeta(name=name, namespace=ns,
+                                    labels=labels or {}),
+                spec=t.PodSpec(containers=[t.Container(name="c", image="i")]))
+    pod.spec.node_name = node
+    pod.spec.affinity = affinity
+    return pod
+
+
+def term(match, key="kubernetes.io/hostname", namespaces=()):
+    return t.PodAffinityTerm(
+        label_selector=LabelSelector(match_labels=dict(match)),
+        topology_key=key, namespaces=list(namespaces))
+
+
+def cache_with(nodes, pods):
+    cache = SchedulerCache()
+    for n in nodes:
+        cache.set_node(n)
+    for p in pods:
+        cache.add_pod(p)
+    return cache
+
+
+def test_no_affinity_zero_cost():
+    cache = cache_with([mk_node("n0")], [mk_pod("p0", node="n0")])
+    assert build_context(mk_pod("new"), cache) is None
+
+
+def test_required_affinity_colocates():
+    cache = cache_with(
+        [mk_node("n0"), mk_node("n1")],
+        [mk_pod("web", labels={"app": "web"}, node="n0")])
+    aff = t.Affinity(pod_affinity=[term({"app": "web"})])
+    ctx = build_context(mk_pod("sidecar", affinity=aff), cache)
+    assert ctx.node_allows(cache.nodes["n0"].node) is None
+    assert "pod affinity" in ctx.node_allows(cache.nodes["n1"].node)
+
+
+def test_affinity_bootstrap_first_pod():
+    """A term matched by nothing yet — but by the pod ITSELF — must not
+    wedge: the first replica of a self-affine group schedules anywhere."""
+    cache = cache_with([mk_node("n0")], [])
+    aff = t.Affinity(pod_affinity=[term({"app": "db"})])
+    ctx = build_context(mk_pod("db-0", labels={"app": "db"}, affinity=aff),
+                        cache)
+    assert ctx.node_allows(cache.nodes["n0"].node) is None
+    # A pod that does NOT match its own unmatched term stays pending.
+    ctx2 = build_context(mk_pod("other", labels={"app": "x"}, affinity=aff),
+                         cache)
+    assert ctx2.node_allows(cache.nodes["n0"].node) is not None
+
+
+def test_required_anti_affinity_spreads():
+    cache = cache_with(
+        [mk_node("n0"), mk_node("n1")],
+        [mk_pod("db-0", labels={"app": "db"}, node="n0")])
+    aff = t.Affinity(pod_anti_affinity=[term({"app": "db"})])
+    ctx = build_context(mk_pod("db-1", labels={"app": "db"}, affinity=aff),
+                        cache)
+    assert "anti-affinity" in ctx.node_allows(cache.nodes["n0"].node)
+    assert ctx.node_allows(cache.nodes["n1"].node) is None
+
+
+def test_existing_pods_anti_affinity_symmetry():
+    """An EXISTING pod's required anti-affinity forbids the incoming
+    pod from its domain even when the incoming pod carries no terms."""
+    lonely_aff = t.Affinity(pod_anti_affinity=[term({"app": "noisy"})])
+    cache = cache_with(
+        [mk_node("n0"), mk_node("n1")],
+        [mk_pod("lonely", labels={"app": "quiet"}, node="n0",
+                affinity=lonely_aff)])
+    incoming = mk_pod("noisy-1", labels={"app": "noisy"})
+    ctx = build_context(incoming, cache)
+    assert ctx is not None  # cluster has anti-affinity pods
+    assert "existing pod's anti-affinity" in \
+        ctx.node_allows(cache.nodes["n0"].node)
+    assert ctx.node_allows(cache.nodes["n1"].node) is None
+
+
+def test_topology_key_zone():
+    cache = cache_with(
+        [mk_node("n0", {"zone": "a"}), mk_node("n1", {"zone": "a"}),
+         mk_node("n2", {"zone": "b"})],
+        [mk_pod("db-0", labels={"app": "db"}, node="n0")])
+    aff = t.Affinity(pod_anti_affinity=[term({"app": "db"}, key="zone")])
+    ctx = build_context(mk_pod("db-1", labels={"app": "db"}, affinity=aff),
+                        cache)
+    # Whole zone 'a' is forbidden, zone 'b' is fine.
+    assert ctx.node_allows(cache.nodes["n1"].node) is not None
+    assert ctx.node_allows(cache.nodes["n2"].node) is None
+
+
+def test_namespace_scoping():
+    cache = cache_with(
+        [mk_node("n0")],
+        [mk_pod("other-ns", labels={"app": "db"}, node="n0", ns="prod")])
+    aff = t.Affinity(pod_anti_affinity=[term({"app": "db"})])
+    # Term defaults to the incoming pod's namespace: prod pod invisible.
+    ctx = build_context(mk_pod("db-1", labels={"app": "db"}, affinity=aff),
+                        cache)
+    assert ctx.node_allows(cache.nodes["n0"].node) is None
+    # Explicit namespaces include it.
+    aff2 = t.Affinity(pod_anti_affinity=[term({"app": "db"},
+                                              namespaces=["prod"])])
+    ctx2 = build_context(mk_pod("db-2", labels={"app": "db"}, affinity=aff2),
+                         cache)
+    assert ctx2.node_allows(cache.nodes["n0"].node) is not None
+
+
+def test_preferred_scores():
+    cache = cache_with(
+        [mk_node("n0"), mk_node("n1")],
+        [mk_pod("cachepod", labels={"app": "cache"}, node="n0")])
+    aff = t.Affinity(pod_affinity_preferred=[t.WeightedPodAffinityTerm(
+        weight=5, pod_affinity_term=term({"app": "cache"}))])
+    ctx = build_context(mk_pod("web", affinity=aff), cache)
+    assert ctx.score(cache.nodes["n0"].node) == 5.0
+    assert ctx.score(cache.nodes["n1"].node) == 0.0
+
+
+async def test_scheduler_end_to_end_anti_affinity():
+    """Through the real scheduler: two anti-affine pods land on two
+    different nodes; a third stays Pending with a reason."""
+    from kubernetes_tpu.apiserver.admission import default_chain
+    from kubernetes_tpu.apiserver.registry import Registry
+    from kubernetes_tpu.client.local import LocalClient
+    from kubernetes_tpu.scheduler.scheduler import Scheduler
+
+    reg = Registry()
+    reg.admission = default_chain(reg)
+    reg.create(t.Namespace(metadata=ObjectMeta(name="default")))
+    for i in range(2):
+        node = mk_node(f"n{i}")
+        reg.create(node)
+    client = LocalClient(reg)
+    sched = Scheduler(client, backoff_seconds=0.2)
+    await sched.start()
+    try:
+        aff = t.Affinity(pod_anti_affinity=[term({"app": "db"})])
+        for i in range(3):
+            await client.create(mk_pod(f"db-{i}", labels={"app": "db"},
+                                       affinity=aff))
+        nodes_used = set()
+        for _ in range(100):
+            await asyncio.sleep(0.05)
+            pods, _ = await client.list("pods", "default")
+            nodes_used = {p.spec.node_name for p in pods if p.spec.node_name}
+            if len(nodes_used) == 2:
+                break
+        assert nodes_used == {"n0", "n1"}
+        third = next(p for p in pods if not p.spec.node_name)
+        # Stays pending: both domains hold a matching pod.
+        await asyncio.sleep(0.3)
+        got = await client.get("pods", "default", third.metadata.name)
+        assert not got.spec.node_name
+    finally:
+        await sched.stop()
