@@ -111,16 +111,14 @@ def validate_pod(pod: t.Pod, is_create: bool = True) -> None:
         errs.add("spec.restart_policy", f"unknown policy {pod.spec.restart_policy!r}")
     aff = pod.spec.affinity
     if aff is not None:
-        # Required inter-pod terms need a selector and a topology key
+        # REQUIRED inter-pod terms need a selector and a topology key
         # (validation.go ValidatePodAffinityTerm) — a selector-less
         # required term would match nothing and wedge the pod forever.
+        # Preferred (soft) terms without a selector are a harmless
+        # zero-score no-op and stay legal, as in the reference.
         terms = ([("spec.affinity.pod_affinity", tm) for tm in aff.pod_affinity]
                  + [("spec.affinity.pod_anti_affinity", tm)
-                    for tm in aff.pod_anti_affinity]
-                 + [("spec.affinity.pod_affinity_preferred", wt.pod_affinity_term)
-                    for wt in aff.pod_affinity_preferred]
-                 + [("spec.affinity.pod_anti_affinity_preferred", wt.pod_affinity_term)
-                    for wt in aff.pod_anti_affinity_preferred])
+                    for tm in aff.pod_anti_affinity])
         for path, term in terms:
             if term.label_selector is None:
                 errs.add(path, "label_selector is required")

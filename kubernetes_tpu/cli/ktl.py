@@ -479,6 +479,9 @@ async def cmd_up(args) -> int:
     tpu_note = (f" ({', '.join(real)} probing real TPU)" if real else
                 f" ({stub} stub chips total)" if stub else "")
     print(f"cluster up at {base} — {len(specs)} node(s){tpu_note}")
+    if cluster.dns is not None:
+        print(f"cluster DNS at {cluster.dns.address} "
+              f"(pods get KTPU_DNS_SERVER)")
     print(f"server recorded in {DEFAULT_CONFIG}; try: ktl get nodes")
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()

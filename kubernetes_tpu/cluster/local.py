@@ -125,6 +125,7 @@ class LocalCluster:
         self.server: Optional[APIServer] = None
         self.scheduler: Optional[Scheduler] = None
         self.controller_manager: Optional[ControllerManager] = None
+        self.dns = None
         self.nodes: list[LocalNode] = []
         self.base_url = ""
 
@@ -159,6 +160,13 @@ class LocalCluster:
         await self.scheduler.start()
         self.controller_manager = ControllerManager(local)
         await self.controller_manager.start()
+
+        # Cluster DNS (kube-dns addon analog): A records for services +
+        # headless per-pod rank hostnames; agents inject
+        # KTPU_DNS_SERVER into every pod env.
+        from ..net.dns import ClusterDNS
+        self.dns = ClusterDNS(local, host=self.host)
+        await self.dns.start()
 
         for i, spec in enumerate(self.node_specs):
             self.nodes.append(await self._start_node(spec, i))
@@ -226,6 +234,8 @@ class LocalCluster:
             heartbeat_interval=self.heartbeat_interval,
             proxy=proxy, eviction=eviction, runtime_hook=hook,
             chip_metrics=plugin.chip_metrics if spec.real_tpu else None)
+        if self.dns is not None:
+            agent.dns_server = self.dns.address
         await agent.start()
         return LocalNode(name=name, agent=agent, runtime=runtime,
                          client=client, plugin=plugin,
@@ -244,6 +254,8 @@ class LocalCluster:
             except Exception:  # noqa: BLE001
                 log.exception("node %s stop failed", node.name)
         self.nodes = []
+        if self.dns is not None:
+            await self.dns.stop()
         if self.controller_manager:
             await self.controller_manager.stop()
         if self.scheduler:

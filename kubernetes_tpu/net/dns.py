@@ -1,0 +1,204 @@
+"""Cluster DNS — the kube-dns addon analog.
+
+Reference: the kube-dns/skydns addon (``cluster/addons/dns``) answering
+``<svc>.<ns>.svc.cluster.local`` with the Service's cluster IP and —
+for headless services (the StatefulSet rank-discovery substrate,
+SURVEY §2.4) — per-pod records ``<hostname>.<svc>.<ns>.svc.<domain>``
+from Endpoints.
+
+TPU-native shape: an in-process asyncio UDP responder fed by the same
+service/endpoints informers the proxy uses (one watch stream, no
+separate resolver fleet). Pods get ``KTPU_DNS_SERVER=<ip>:<port>`` in
+their env; a JAX multi-host job can resolve its peers' pod IPs by
+rank hostname without an external coordinator. Only A/IN queries are
+answered (the addon's job here); everything else returns NOTIMP.
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+import struct
+from typing import Optional
+
+from ..api import types as t
+from ..client.informer import SharedInformer
+
+log = logging.getLogger("clusterdns")
+
+_FLAG_RESPONSE = 0x8180   # QR | RD | RA, NOERROR
+_FLAG_NXDOMAIN = 0x8183
+_FLAG_NOTIMP = 0x8184 | 0x0004  # NOTIMP rcode
+
+
+def _parse_query(data: bytes) -> Optional[tuple[int, str, int, int, bytes]]:
+    """(txn id, lowercase name, qtype, qclass, question bytes) or None."""
+    if len(data) < 12:
+        return None
+    txn, flags, qd, _an, _ns, _ar = struct.unpack("!HHHHHH", data[:12])
+    if flags & 0x8000 or qd != 1:
+        return None
+    labels = []
+    pos = 12
+    while pos < len(data):
+        ln = data[pos]
+        if ln == 0:
+            pos += 1
+            break
+        if ln > 63 or pos + 1 + ln > len(data):
+            return None
+        labels.append(data[pos + 1: pos + 1 + ln].decode("ascii", "replace"))
+        pos += 1 + ln
+    if pos + 4 > len(data):
+        return None
+    qtype, qclass = struct.unpack("!HH", data[pos: pos + 4])
+    return txn, ".".join(labels).lower(), qtype, qclass, data[12: pos + 4]
+
+
+def _response(txn: int, question: bytes, ips: list[str],
+              flags: int = _FLAG_RESPONSE, ttl: int = 5) -> bytes:
+    head = struct.pack("!HHHHHH", txn, flags, 1, len(ips), 0, 0)
+    out = head + question
+    for ip in ips:
+        try:
+            raw = bytes(int(x) for x in ip.split("."))
+        except ValueError:
+            continue
+        # 0xc00c: compression pointer to the question name at offset 12.
+        out += struct.pack("!HHHIH", 0xC00C, 1, 1, ttl, 4) + raw
+    return out
+
+
+class ClusterDNS(asyncio.DatagramProtocol):
+    """Start with ``await dns.start()``; resolve() is the pure core."""
+
+    def __init__(self, client, domain: str = "cluster.local",
+                 host: str = "127.0.0.1", port: int = 0):
+        self.client = client
+        self.domain = domain.strip(".").lower()
+        self.host = host
+        self.port = port
+        self.services: Optional[SharedInformer] = None
+        self.endpoints: Optional[SharedInformer] = None
+        self._transport = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self) -> int:
+        self.services = SharedInformer(self.client, "services")
+        self.endpoints = SharedInformer(self.client, "endpoints")
+        self.services.start()
+        self.endpoints.start()
+        await self.services.wait_for_sync()
+        await self.endpoints.wait_for_sync()
+        loop = asyncio.get_running_loop()
+        self._transport, _ = await loop.create_datagram_endpoint(
+            lambda: self, local_addr=(self.host, self.port))
+        self.port = self._transport.get_extra_info("sockname")[1]
+        log.info("cluster DNS serving on %s:%d for *.%s",
+                 self.host, self.port, self.domain)
+        return self.port
+
+    async def stop(self) -> None:
+        if self._transport is not None:
+            self._transport.close()
+        for inf in (self.services, self.endpoints):
+            if inf is not None:
+                await inf.stop()
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    # -- resolution --------------------------------------------------------
+
+    def resolve(self, name: str) -> Optional[list[str]]:
+        """A records for ``name`` or None (NXDOMAIN).
+
+        ``<svc>.<ns>.svc.<domain>``            -> cluster IP, or every
+                                                  ready pod IP (headless)
+        ``<hostname>.<svc>.<ns>.svc.<domain>`` -> that pod's IP
+        """
+        name = name.strip(".").lower()
+        suffix = f".svc.{self.domain}"
+        if not name.endswith(suffix):
+            return None
+        parts = name[: -len(suffix)].split(".")
+        if len(parts) == 2:
+            svc_name, ns = parts
+            svc = self.services.get(f"{ns}/{svc_name}")
+            if svc is None:
+                return None
+            if svc.spec.cluster_ip and svc.spec.cluster_ip != "None":
+                return [svc.spec.cluster_ip]
+            return self._endpoint_ips(ns, svc_name)  # headless
+        if len(parts) == 3:
+            hostname, svc_name, ns = parts
+            ep = self.endpoints.get(f"{ns}/{svc_name}")
+            if ep is None:
+                return None
+            ips = [a.ip for subset in ep.subsets for a in subset.addresses
+                   if a.hostname == hostname and a.ip]
+            return ips or None
+        return None
+
+    def _endpoint_ips(self, ns: str, svc_name: str) -> Optional[list[str]]:
+        ep = self.endpoints.get(f"{ns}/{svc_name}")
+        if ep is None:
+            return None
+        ips = [a.ip for subset in ep.subsets for a in subset.addresses if a.ip]
+        return ips or None
+
+    # -- UDP ---------------------------------------------------------------
+
+    def connection_made(self, transport) -> None:
+        self._transport = transport
+
+    def datagram_received(self, data: bytes, addr) -> None:
+        try:
+            parsed = _parse_query(data)
+            if parsed is None:
+                return
+            txn, name, qtype, qclass, question = parsed
+            if qtype != 1 or qclass != 1:  # A / IN only
+                self._transport.sendto(
+                    _response(txn, question, [], flags=_FLAG_NOTIMP), addr)
+                return
+            ips = self.resolve(name)
+            if ips:
+                self._transport.sendto(_response(txn, question, ips), addr)
+            else:
+                self._transport.sendto(
+                    _response(txn, question, [], flags=_FLAG_NXDOMAIN), addr)
+        except Exception:  # noqa: BLE001 — a bad packet must not kill DNS
+            log.exception("dns query handling failed")
+
+
+def make_query(name: str, txn: int = 0x1234) -> bytes:
+    """Build an A/IN query (client side; also what tests use)."""
+    out = struct.pack("!HHHHHH", txn, 0x0100, 1, 0, 0, 0)
+    for label in name.strip(".").split("."):
+        raw = label.encode()
+        out += bytes([len(raw)]) + raw
+    return out + b"\x00" + struct.pack("!HH", 1, 1)
+
+
+def parse_answer_ips(data: bytes) -> list[str]:
+    """Extract A-record IPs from a response built by :func:`_response`."""
+    if len(data) < 12:
+        return []
+    _txn, flags, _qd, an, _ns, _ar = struct.unpack("!HHHHHH", data[:12])
+    if flags & 0x000F:  # rcode != NOERROR
+        return []
+    pos = 12
+    while pos < len(data) and data[pos] != 0:  # skip question name
+        pos += 1 + data[pos]
+    pos += 5  # null + qtype + qclass
+    ips = []
+    for _ in range(an):
+        if pos + 16 > len(data):
+            break
+        rdlen = struct.unpack("!H", data[pos + 10: pos + 12])[0]
+        if rdlen == 4:
+            ips.append(".".join(str(b) for b in data[pos + 12: pos + 16]))
+        pos += 12 + rdlen
+    return ips

@@ -102,6 +102,9 @@ class NodeAgent:
         #: Per-chip utilization source for /stats/summary (stats.py
         #: ChipMetricsSource; the device plugin provides it).
         self.chip_metrics = chip_metrics
+        #: "ip:port" of the cluster DNS (net/dns.py), injected into pod
+        #: env as KTPU_DNS_SERVER when set.
+        self.dns_server = ""
         #: ConfigMap/Secret/EmptyDir materialization (volumes.py).
         #: Config reads go through a TTL cache driven by the TTL
         #: controller's node annotation (ttl_controller.go consumer).
@@ -624,6 +627,11 @@ class NodeAgent:
         env.setdefault("POD_NAMESPACE", pod.metadata.namespace)
         env.setdefault("NODE_NAME", self.node_name)
         env.setdefault("POD_IP", pod_ip)
+        if self.dns_server:
+            # Cluster DNS (net/dns.py): processes have no /etc/resolv.conf
+            # of their own, so the resolver address rides the env
+            # (the kubelet's DNS config analog).
+            env.setdefault("KTPU_DNS_SERVER", self.dns_server)
         # Service discovery env (kubelet_pods.go getServiceEnvVarMap);
         # container-specified env always wins.
         if self._svc_informer is not None:
@@ -656,6 +664,12 @@ class NodeAgent:
             code = await self._run_lifecycle_hook(pod, container, cid,
                                                   "post_start")
             if code != 0:
+                # Every kill path runs preStop first (killContainer) —
+                # including this one; the hook may hold cleanup the
+                # next restart depends on.
+                await self._run_lifecycle_hook(
+                    pod, container, cid, "pre_stop",
+                    timeout=self._pod_grace(pod))
                 await self.runtime.stop_container(cid, grace_seconds=1.0)
                 return
         if container.liveness_probe or container.readiness_probe:
@@ -672,7 +686,8 @@ class NodeAgent:
                      if c.name == container_name), None)
                 if container is not None:
                     await self._run_lifecycle_hook(
-                        pod, container, cid, "pre_stop", timeout=5.0)
+                        pod, container, cid, "pre_stop",
+                        timeout=self._pod_grace(pod))
             await self.runtime.stop_container(cid, grace_seconds=1.0)
             self._nudge(pod_key)
         asyncio.get_running_loop().create_task(restart())
@@ -797,6 +812,11 @@ class NodeAgent:
 
     # -- termination ------------------------------------------------------
 
+    @staticmethod
+    def _pod_grace(pod: t.Pod) -> float:
+        gp = pod.spec.termination_grace_period_seconds
+        return max(float(gp) if gp is not None else 1.0, 1.0)
+
     async def _run_lifecycle_hook(self, pod: t.Pod, container: t.Container,
                                   cid: str, which: str,
                                   timeout: float = 30.0) -> int:
@@ -822,11 +842,12 @@ class NodeAgent:
         return code
 
     async def _run_pre_stop_hooks(self, pod: t.Pod, cmap: dict[str, str],
-                                  grace: float) -> None:
+                                  grace: float) -> float:
         """preStop for every still-running container, CONCURRENTLY and
         bounded by ONE grace budget for the whole pod — N hanging hooks
-        must cost grace total, not N x grace (kuberuntime killContainer
-        deducts hook time from the container's remaining grace)."""
+        must cost grace total, not N x grace. Returns seconds spent so
+        callers deduct hook time from the remaining stop grace
+        (kuberuntime killContainer semantics)."""
         by_name = {c.name: c for c in
                    list(pod.spec.containers) + list(pod.spec.init_containers)}
         budget = max(grace, 1.0)
@@ -841,13 +862,16 @@ class NodeAgent:
                 continue  # nothing to exec in
             hooks.append(self._run_lifecycle_hook(
                 pod, container, cid, "pre_stop", timeout=budget))
-        if hooks:
-            try:
-                await asyncio.wait_for(
-                    asyncio.gather(*hooks, return_exceptions=True),
-                    timeout=budget + 1.0)
-            except asyncio.TimeoutError:
-                pass  # hooks overran the pod's budget; proceed to kill
+        if not hooks:
+            return 0.0
+        started = time.monotonic()
+        try:
+            await asyncio.wait_for(
+                asyncio.gather(*hooks, return_exceptions=True),
+                timeout=budget + 1.0)
+        except asyncio.TimeoutError:
+            pass  # hooks overran the pod's budget; proceed to kill
+        return time.monotonic() - started
 
     async def _terminate_pod(self, pod: t.Pod) -> None:
         key = pod.key()
@@ -856,9 +880,10 @@ class NodeAgent:
         grace = float(gp) if gp is not None else 1.0
         cmap = self._containers.get(key, {})
         self.probes.remove_pod(key)
-        await self._run_pre_stop_hooks(pod, cmap, grace)
+        spent = await self._run_pre_stop_hooks(pod, cmap, grace)
+        stop_grace = max(grace - spent, 1.0)
         for cid in cmap.values():
-            await self.runtime.stop_container(cid, grace_seconds=grace)
+            await self.runtime.stop_container(cid, grace_seconds=stop_grace)
         for cid in cmap.values():
             await self.runtime.remove_container(cid)
         self._containers.pop(key, None)

@@ -1,0 +1,131 @@
+"""Cluster DNS (net/dns.py) — kube-dns addon analog."""
+import asyncio
+import socket
+
+import pytest
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.net.dns import (ClusterDNS, make_query,
+                                    parse_answer_ips, _parse_query)
+from tests.controllers.util import make_plane
+
+
+def mk_service(name, cluster_ip, ns="default"):
+    return t.Service(metadata=ObjectMeta(name=name, namespace=ns),
+                     spec=t.ServiceSpec(cluster_ip=cluster_ip))
+
+
+def mk_endpoints(name, addrs, ns="default"):
+    return t.Endpoints(
+        metadata=ObjectMeta(name=name, namespace=ns),
+        subsets=[t.EndpointSubset(addresses=[
+            t.EndpointAddress(ip=ip, hostname=host) for host, ip in addrs])])
+
+
+async def make_dns(objs):
+    reg, client, _ = make_plane()
+    for obj in objs:
+        await client.create(obj)
+    dns = ClusterDNS(client)
+    await dns.start()
+    return dns
+
+
+async def test_service_a_record():
+    dns = await make_dns([mk_service("web", "10.96.0.7")])
+    try:
+        assert dns.resolve("web.default.svc.cluster.local") == ["10.96.0.7"]
+        assert dns.resolve("Web.Default.svc.cluster.local.") == ["10.96.0.7"]
+        assert dns.resolve("nope.default.svc.cluster.local") is None
+        assert dns.resolve("web.other.svc.cluster.local") is None
+        assert dns.resolve("example.com") is None
+    finally:
+        await dns.stop()
+
+
+async def test_headless_service_returns_pod_ips():
+    dns = await make_dns([
+        mk_service("workers", "None"),
+        mk_endpoints("workers", [("workers-0", "10.64.0.2"),
+                                 ("workers-1", "10.64.1.2")])])
+    try:
+        assert sorted(dns.resolve("workers.default.svc.cluster.local")) == \
+            ["10.64.0.2", "10.64.1.2"]
+        # Rank hostname -> that pod only (STS peer discovery).
+        assert dns.resolve(
+            "workers-1.workers.default.svc.cluster.local") == ["10.64.1.2"]
+        assert dns.resolve(
+            "workers-9.workers.default.svc.cluster.local") is None
+    finally:
+        await dns.stop()
+
+
+async def test_udp_wire_round_trip():
+    dns = await make_dns([mk_service("api", "10.96.0.1")])
+    try:
+        loop = asyncio.get_running_loop()
+        sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        sock.setblocking(False)
+        query = make_query("api.default.svc.cluster.local")
+        await loop.sock_sendto(sock, query, ("127.0.0.1", dns.port))
+        data = await asyncio.wait_for(loop.sock_recv(sock, 512), 5.0)
+        assert parse_answer_ips(data) == ["10.96.0.1"]
+        # NXDOMAIN for unknown names.
+        await loop.sock_sendto(sock, make_query("gone.default.svc.cluster.local"),
+                               ("127.0.0.1", dns.port))
+        data = await asyncio.wait_for(loop.sock_recv(sock, 512), 5.0)
+        assert parse_answer_ips(data) == []
+        sock.close()
+    finally:
+        await dns.stop()
+
+
+def test_query_parser_rejects_garbage():
+    assert _parse_query(b"short") is None
+    assert _parse_query(b"\x00" * 12) is None  # qdcount 0
+    q = make_query("a.b.svc.cluster.local", txn=7)
+    txn, name, qtype, qclass, _ = _parse_query(q)
+    assert (txn, name, qtype, qclass) == (7, "a.b.svc.cluster.local", 1, 1)
+
+
+async def test_cluster_injects_dns_env(tmp_path):
+    """LocalCluster starts the DNS and pods see KTPU_DNS_SERVER; a pod
+    can resolve a service through it (full in-cluster loop)."""
+    from kubernetes_tpu.cluster.local import LocalCluster, NodeSpec
+
+    cluster = LocalCluster(nodes=[NodeSpec()])
+    await cluster.start()
+    client = cluster.local_client()
+    try:
+        await client.create(mk_service("db", "10.96.3.3"))
+        pod = t.Pod(metadata=ObjectMeta(name="resolver", namespace="default"),
+                    spec=t.PodSpec(restart_policy="Never",
+                                   containers=[t.Container(
+                                       name="main", image="x",
+                                       command=["python", "-c", (
+                                           "import os,socket,sys;"
+                                           "sys.path.insert(0, os.environ['KTPU_REPO']);"
+                                           "from kubernetes_tpu.net.dns import make_query, parse_answer_ips;"
+                                           "host, port = os.environ['KTPU_DNS_SERVER'].split(':');"
+                                           "s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM);"
+                                           "s.settimeout(5);"
+                                           "s.sendto(make_query('db.default.svc.cluster.local'), (host, int(port)));"
+                                           "print('resolved:', parse_answer_ips(s.recv(512))[0])"
+                                       )])]))
+        pod.spec.containers[0].env = [t.EnvVar(name="KTPU_REPO", value=str(
+            __import__("pathlib").Path(__file__).resolve().parents[2]))]
+        await client.create(pod)
+        got = None
+        for _ in range(120):
+            await asyncio.sleep(0.1)
+            got = await client.get("pods", "default", "resolver")
+            if got.status.phase in (t.POD_SUCCEEDED, t.POD_FAILED):
+                break
+        assert got is not None and got.status.phase == t.POD_SUCCEEDED
+        ln = cluster.nodes[0]
+        cid = next(iter((await ln.agent.runtime.list_containers())), None)
+        logs = await ln.agent.runtime.container_logs(cid.id)
+        assert "resolved: 10.96.3.3" in logs
+    finally:
+        await cluster.stop()
