@@ -42,6 +42,14 @@ def main() -> None:
             sched["startup"] = asyncio.run(run_startup(30, 2))
         except Exception as exc:  # noqa: BLE001
             sched["startup"] = {"error": str(exc)[:200]}
+        # Gang + contiguous sub-mesh throughput (no reference analog —
+        # the TPU-first scheduling path): 8x 64-chip slices at 75%
+        # fill, every gang verified to land as a contiguous box.
+        try:
+            from kubernetes_tpu.perf.gang_bench import run_gang_bench
+            sched["gang"] = asyncio.run(run_gang_bench(8))
+        except Exception as exc:  # noqa: BLE001
+            sched["gang"] = {"error": str(exc)[:200]}
         sched_line = {
             "metric": "scheduler_pod_throughput",
             "value": sched["pods_per_second"],

@@ -115,13 +115,22 @@ def validate_pod(pod: t.Pod, is_create: bool = True) -> None:
         # (validation.go ValidatePodAffinityTerm) — a selector-less
         # required term would match nothing and wedge the pod forever.
         # Preferred (soft) terms without a selector are a harmless
-        # zero-score no-op and stay legal, as in the reference.
-        terms = ([("spec.affinity.pod_affinity", tm) for tm in aff.pod_affinity]
-                 + [("spec.affinity.pod_anti_affinity", tm)
-                    for tm in aff.pod_anti_affinity])
-        for path, term in terms:
+        # zero-score no-op and stay legal, but still need a topology
+        # key (the reference validates it for weighted terms too — a
+        # keyless soft term silently scores zero everywhere).
+        required = ([("spec.affinity.pod_affinity", tm)
+                     for tm in aff.pod_affinity]
+                    + [("spec.affinity.pod_anti_affinity", tm)
+                       for tm in aff.pod_anti_affinity])
+        soft = ([("spec.affinity.pod_affinity_preferred", wt.pod_affinity_term)
+                 for wt in aff.pod_affinity_preferred]
+                + [("spec.affinity.pod_anti_affinity_preferred",
+                    wt.pod_affinity_term)
+                   for wt in aff.pod_anti_affinity_preferred])
+        for path, term in required:
             if term.label_selector is None:
                 errs.add(path, "label_selector is required")
+        for path, term in required + soft:
             if not term.topology_key:
                 errs.add(path, "topology_key is required")
     for i, r in enumerate(pod.spec.tpu_resources):

@@ -262,10 +262,6 @@ class LimitRanger(AdmissionPlugin):
 
     def __init__(self, registry: "Registry"):
         self.registry = registry
-        #: (id(obj), items) — validate reuses admit's lookup within the
-        #: same admission pass (the list cannot change between phases);
-        #: identity miss (a later plugin replaced the object) recomputes.
-        self._pass_cache: tuple = (0, None)
 
     def _items(self, ns: str) -> list[t.LimitRangeItem]:
         try:
@@ -279,7 +275,6 @@ class LimitRanger(AdmissionPlugin):
         if spec.kind != "Pod" or op != "CREATE":
             return obj
         items = self._items(obj.metadata.namespace)
-        self._pass_cache = (id(obj), items)
         if not items:
             return obj
         for c in list(obj.spec.containers) + list(obj.spec.init_containers):
@@ -296,9 +291,7 @@ class LimitRanger(AdmissionPlugin):
     def validate(self, op, spec, obj, old):
         if spec.kind != "Pod" or op != "CREATE":
             return
-        cached_id, cached_items = self._pass_cache
-        items = (cached_items if cached_id == id(obj)
-                 else self._items(obj.metadata.namespace))
+        items = self._items(obj.metadata.namespace)
         if not items:
             return
         for c in list(obj.spec.containers) + list(obj.spec.init_containers):

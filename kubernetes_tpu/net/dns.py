@@ -56,16 +56,21 @@ def _parse_query(data: bytes) -> Optional[tuple[int, str, int, int, bytes]]:
 
 def _response(txn: int, question: bytes, ips: list[str],
               flags: int = _FLAG_RESPONSE, ttl: int = 5) -> bytes:
-    head = struct.pack("!HHHHHH", txn, flags, 1, len(ips), 0, 0)
-    out = head + question
+    # Encode first, count after: a non-IPv4 endpoint address (user-
+    # created Endpoints can hold anything) must be dropped without
+    # desyncing the header's answer count from the records present.
+    records = []
     for ip in ips:
         try:
             raw = bytes(int(x) for x in ip.split("."))
         except ValueError:
             continue
+        if len(raw) != 4:
+            continue
         # 0xc00c: compression pointer to the question name at offset 12.
-        out += struct.pack("!HHHIH", 0xC00C, 1, 1, ttl, 4) + raw
-    return out
+        records.append(struct.pack("!HHHIH", 0xC00C, 1, 1, ttl, 4) + raw)
+    head = struct.pack("!HHHHHH", txn, flags, 1, len(records), 0, 0)
+    return head + question + b"".join(records)
 
 
 class ClusterDNS(asyncio.DatagramProtocol):

@@ -876,8 +876,7 @@ class NodeAgent:
     async def _terminate_pod(self, pod: t.Pod) -> None:
         key = pod.key()
         log.info("terminating pod %s", key)
-        gp = pod.spec.termination_grace_period_seconds
-        grace = float(gp) if gp is not None else 1.0
+        grace = self._pod_grace(pod)
         cmap = self._containers.get(key, {})
         self.probes.remove_pod(key)
         spent = await self._run_pre_stop_hooks(pod, cmap, grace)

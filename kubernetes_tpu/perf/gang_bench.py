@@ -1,0 +1,213 @@
+"""Gang + sub-mesh scheduling throughput at fleet scale.
+
+The TPU-first counterpart of the density harness: the reference has no
+gang scheduler to benchmark (SURVEY §2.4 — pods place one at a time),
+so this measures the framework's distinguishing path — all-or-nothing
+gangs onto CONTIGUOUS ICI sub-meshes — at a v5p-fleet scale the
+single-chip e2e cannot reach:
+
+- fleet: ``n_slices`` pods x (4x4x4 = 64-chip) slices, 4 chips/host
+  (16 hosts per slice), built as API-object hollow nodes;
+- load: ``n_gangs`` PodGroups each demanding a contiguous 2x2x2
+  sub-mesh (8 chips = 2 pods x 4 chips), poured in at once;
+- checks: every scheduled gang's chip set IS a contiguous box (the
+  guarantee, not just a count), reported next to gangs/s.
+
+Run: ``python -m kubernetes_tpu.perf.gang_bench [slices] [gangs]``.
+Defaults fill 75% of fleet capacity so fragmentation pressure is real.
+"""
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Optional
+
+from ..api import types as t
+from ..api.meta import ObjectMeta
+from ..apiserver.admission import default_chain
+from ..apiserver.registry import Registry
+from ..client.local import LocalClient
+from ..scheduler.scheduler import Scheduler
+
+CHIPS_PER_HOST = 4
+SLICE_MESH = [4, 4, 4]          # 64 chips, 16 hosts per slice
+GANG_SHAPE = [2, 2, 2]          # 8 chips -> 2 pods x 4 chips
+
+
+def build_slice(reg: Registry, slice_idx: int) -> None:
+    sx, sy, sz = SLICE_MESH
+    # Each host owns a 2x2x1 slab (the physical v5p host tile) so gang
+    # boxes tile across whole hosts, mirroring real slice wiring.
+    tiles = [[(bx * 2 + dx, by * 2 + dy, z)
+              for dx in range(2) for dy in range(2)]
+             for z in range(sz)
+             for bx in range(sx // 2) for by in range(sy // 2)]
+    slice_id = f"slice-{slice_idx:03d}"
+    for h, own in enumerate(tiles):
+        name = f"{slice_id}-host-{h:02d}"
+        node = t.Node(metadata=ObjectMeta(name=name))
+        node.status.capacity = {"cpu": 64.0, "memory": 256 * 2**30,
+                                "pods": 110.0,
+                                t.RESOURCE_TPU: float(CHIPS_PER_HOST)}
+        node.status.allocatable = dict(node.status.capacity)
+        node.status.conditions = [t.NodeCondition(type=t.NODE_READY,
+                                                  status="True")]
+        node.status.tpu = t.TpuTopology(
+            chip_type="v5p", slice_id=slice_id, mesh_shape=list(SLICE_MESH),
+            chips=[t.TpuChip(id=f"{name}-c{i}", coords=list(co),
+                             attributes={"chip_type": "v5p"})
+                   for i, co in enumerate(own)])
+        reg.create(node)
+
+
+def gang_objects(idx: int) -> tuple[t.PodGroup, list[t.Pod]]:
+    gname = f"gang-{idx:04d}"
+    import math
+    chips_total = math.prod(GANG_SHAPE)
+    members = chips_total // CHIPS_PER_HOST
+    group = t.PodGroup(
+        metadata=ObjectMeta(name=gname, namespace="default"),
+        spec=t.PodGroupSpec(min_member=members,
+                            slice_shape=list(GANG_SHAPE)))
+    pods = []
+    for m in range(members):
+        pod = t.Pod(metadata=ObjectMeta(name=f"{gname}-{m}",
+                                        namespace="default"),
+                    spec=t.PodSpec(containers=[t.Container(
+                        name="c", image="train",
+                        resources=t.ResourceRequirements(
+                            requests={"cpu": 1.0}),
+                        tpu_requests=["tpu"])]))
+        pod.spec.tpu_resources = [t.PodTpuRequest(name="tpu",
+                                                  chips=CHIPS_PER_HOST)]
+        pod.spec.gang = gname
+        pods.append(pod)
+    return group, pods
+
+
+def _factorizations(n: int):
+    """All (a, b, c) with a*b*c == n — derived, not hardcoded, so the
+    checker tracks GANG_SHAPE edits instead of false-alarming."""
+    out = []
+    for a in range(1, n + 1):
+        if n % a:
+            continue
+        rest = n // a
+        for b in range(1, rest + 1):
+            if rest % b == 0:
+                out.append((a, b, rest // b))
+    return out
+
+
+def _is_contiguous_box(coords: list[tuple], mesh: list[int]) -> bool:
+    """The gang guarantee: chips form an axis-aligned box (allowing
+    torus wraparound) with volume == len(coords)."""
+    n = len(coords)
+    for dims in _factorizations(n):
+        for origin in coords:
+            cells = {tuple((origin[a] + d[a]) % mesh[a] for a in range(3))
+                     for d in _box_offsets(dims)}
+            if cells == set(coords):
+                return True
+    return False
+
+
+def _box_offsets(dims):
+    return [(x, y, z) for x in range(dims[0]) for y in range(dims[1])
+            for z in range(dims[2])]
+
+
+async def run_gang_bench(n_slices: int = 8, n_gangs: Optional[int] = None,
+                         timeout: float = 600.0) -> dict:
+    reg = Registry()
+    reg.admission = default_chain(reg)
+    reg.create(t.Namespace(metadata=ObjectMeta(name="default")))
+    for s in range(n_slices):
+        build_slice(reg, s)
+    import math
+    fleet_chips = n_slices * math.prod(SLICE_MESH)
+    if n_gangs is None:
+        n_gangs = int(0.75 * fleet_chips / math.prod(GANG_SHAPE))
+
+    client = LocalClient(reg)
+    sched = Scheduler(client, backoff_seconds=0.5)
+    await sched.start()
+    members = math.prod(GANG_SHAPE) // CHIPS_PER_HOST
+    want_bound = n_gangs * members
+    # Watch bound pods instead of poll-decoding the whole pod list per
+    # tick — at fleet scale the poll loop otherwise dominates the very
+    # wall-clock it measures.
+    bound_keys: set[str] = set()
+    done = asyncio.Event()
+    stream = await client.watch("pods", namespace="default")
+
+    async def count_bound():
+        while not done.is_set():
+            ev = await stream.next()
+            if ev is None or ev[0] == "CLOSED":
+                return
+            ev_type, pod = ev
+            if ev_type in ("ADDED", "MODIFIED") and pod.spec.node_name:
+                bound_keys.add(pod.key())
+                if len(bound_keys) >= want_bound:
+                    done.set()
+
+    counter = asyncio.create_task(count_bound())
+    try:
+        start = time.perf_counter()
+        for i in range(n_gangs):
+            group, pods = gang_objects(i)
+            await client.create(group)
+            for pod in pods:
+                await client.create(pod)
+        try:
+            await asyncio.wait_for(done.wait(), timeout)
+        except asyncio.TimeoutError:
+            raise TimeoutError(
+                f"only {len(bound_keys)}/{want_bound} pods bound") from None
+        wall = time.perf_counter() - start
+    finally:
+        stream.cancel()
+        counter.cancel()
+        await sched.stop()
+    pods, _ = reg.list("pods", "default")
+    bound = [p for p in pods if p.spec.node_name]
+
+    # Verify contiguity of EVERY gang (the guarantee is the product).
+    chip_coords = {}
+    for items, _ in [reg.list("nodes", "")]:
+        for node in items:
+            if node.status.tpu:
+                for chip in node.status.tpu.chips:
+                    chip_coords[chip.id] = tuple(chip.coords)
+    by_gang: dict[str, list] = {}
+    slices_of: dict[str, set] = {}
+    for p in bound:
+        by_gang.setdefault(p.spec.gang, []).extend(
+            chip_coords[cid] for r in p.spec.tpu_resources
+            for cid in r.assigned)
+        slices_of.setdefault(p.spec.gang, set()).add(
+            p.spec.node_name.rsplit("-host-", 1)[0])
+    non_contiguous = sum(
+        1 for g, coords in by_gang.items()
+        if len(slices_of[g]) != 1
+        or not _is_contiguous_box(coords, SLICE_MESH))
+
+    return {
+        "slices": n_slices,
+        "fleet_chips": fleet_chips,
+        "gangs": n_gangs,
+        "pods": want_bound,
+        "wall_seconds": round(wall, 3),
+        "gangs_per_second": round(n_gangs / wall, 2),
+        "pods_per_second": round(want_bound / wall, 2),
+        "non_contiguous_gangs": non_contiguous,
+    }
+
+
+if __name__ == "__main__":
+    import json
+    import sys
+    ns = int(sys.argv[1]) if len(sys.argv) > 1 else 8
+    ng = int(sys.argv[2]) if len(sys.argv) > 2 else None
+    print(json.dumps(asyncio.run(run_gang_bench(ns, ng))))

@@ -1,0 +1,26 @@
+"""Gang/sub-mesh throughput harness (perf/gang_bench.py) at a small,
+CI-friendly scale — the contiguity verification is the point."""
+import pytest
+
+from kubernetes_tpu.perf.gang_bench import (_is_contiguous_box,
+                                            run_gang_bench)
+
+
+async def test_gang_bench_small_fleet():
+    result = await run_gang_bench(n_slices=2, n_gangs=8, timeout=60)
+    assert result["pods"] == 16
+    assert result["non_contiguous_gangs"] == 0
+    assert result["gangs_per_second"] > 1.0
+
+
+def test_contiguity_checker():
+    mesh = [4, 4, 4]
+    box = [(x, y, z) for x in range(2) for y in range(2) for z in range(2)]
+    assert _is_contiguous_box(box, mesh)
+    # Same volume, split across the mesh: not a box.
+    scattered = box[:7] + [(3, 3, 3)]
+    assert not _is_contiguous_box(scattered, mesh)
+    # Torus wraparound across the x edge IS a box.
+    wrapped = [((x + 3) % 4, y, z)
+               for x in range(2) for y in range(2) for z in range(2)]
+    assert _is_contiguous_box(wrapped, mesh)
