@@ -454,9 +454,14 @@ class Registry:
                 f"{spec.plural} does not support field selectors")
         out = []
         for s in stored:
+            if sel is not None:
+                # Label prefilter on the RAW stored dict — decoding
+                # every filtered-out object was a dominant cost for
+                # selector lists at density scale.
+                raw_labels = (s.value.get("metadata") or {}).get("labels") or {}
+                if not sel.matches(raw_labels):
+                    continue
             obj = self._decode(spec, s.value, s.mod_revision)
-            if sel and not sel.matches(obj.metadata.labels):
-                continue
             if field_selector and not match_field_selector(
                     field_selector, spec.field_extractor(obj)):
                 continue

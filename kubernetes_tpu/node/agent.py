@@ -669,7 +669,7 @@ class NodeAgent:
                 # next restart depends on.
                 await self._run_lifecycle_hook(
                     pod, container, cid, "pre_stop",
-                    timeout=self._pod_grace(pod))
+                    timeout=max(self._pod_grace(pod), 1.0))
                 await self.runtime.stop_container(cid, grace_seconds=1.0)
                 return
         if container.liveness_probe or container.readiness_probe:
@@ -687,7 +687,7 @@ class NodeAgent:
                 if container is not None:
                     await self._run_lifecycle_hook(
                         pod, container, cid, "pre_stop",
-                        timeout=self._pod_grace(pod))
+                        timeout=max(self._pod_grace(pod), 1.0))
             await self.runtime.stop_container(cid, grace_seconds=1.0)
             self._nudge(pod_key)
         asyncio.get_running_loop().create_task(restart())
@@ -814,8 +814,10 @@ class NodeAgent:
 
     @staticmethod
     def _pod_grace(pod: t.Pod) -> float:
+        """Raw grace seconds — 0 means force delete (no hooks, no
+        waiting); callers needing a floor clamp locally."""
         gp = pod.spec.termination_grace_period_seconds
-        return max(float(gp) if gp is not None else 1.0, 1.0)
+        return max(float(gp) if gp is not None else 1.0, 0.0)
 
     async def _run_lifecycle_hook(self, pod: t.Pod, container: t.Container,
                                   cid: str, which: str,
@@ -879,8 +881,11 @@ class NodeAgent:
         grace = self._pod_grace(pod)
         cmap = self._containers.get(key, {})
         self.probes.remove_pod(key)
-        spent = await self._run_pre_stop_hooks(pod, cmap, grace)
-        stop_grace = max(grace - spent, 1.0)
+        if grace > 0:
+            spent = await self._run_pre_stop_hooks(pod, cmap, grace)
+            stop_grace = max(grace - spent, 1.0)
+        else:
+            stop_grace = 0.0  # force delete: no hooks, immediate kill
         for cid in cmap.values():
             await self.runtime.stop_container(cid, grace_seconds=stop_grace)
         for cid in cmap.values():

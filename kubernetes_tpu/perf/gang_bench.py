@@ -147,7 +147,12 @@ async def run_gang_bench(n_slices: int = 8, n_gangs: Optional[int] = None,
             if ev is None or ev[0] == "CLOSED":
                 return
             ev_type, pod = ev
-            if ev_type in ("ADDED", "MODIFIED") and pod.spec.node_name:
+            if ev_type == "DELETED":
+                # Gang recovery may evict members; with no controller
+                # to replace them the count must go back down, not
+                # stick at a phantom total.
+                bound_keys.discard(pod.key())
+            elif ev_type in ("ADDED", "MODIFIED") and pod.spec.node_name:
                 bound_keys.add(pod.key())
                 if len(bound_keys) >= want_bound:
                     done.set()
@@ -175,11 +180,11 @@ async def run_gang_bench(n_slices: int = 8, n_gangs: Optional[int] = None,
 
     # Verify contiguity of EVERY gang (the guarantee is the product).
     chip_coords = {}
-    for items, _ in [reg.list("nodes", "")]:
-        for node in items:
-            if node.status.tpu:
-                for chip in node.status.tpu.chips:
-                    chip_coords[chip.id] = tuple(chip.coords)
+    nodes, _ = reg.list("nodes", "")
+    for node in nodes:
+        if node.status.tpu:
+            for chip in node.status.tpu.chips:
+                chip_coords[chip.id] = tuple(chip.coords)
     by_gang: dict[str, list] = {}
     slices_of: dict[str, set] = {}
     for p in bound:
@@ -197,7 +202,7 @@ async def run_gang_bench(n_slices: int = 8, n_gangs: Optional[int] = None,
         "slices": n_slices,
         "fleet_chips": fleet_chips,
         "gangs": n_gangs,
-        "pods": want_bound,
+        "pods": len(bound),  # actual, not the target — evictions show
         "wall_seconds": round(wall, 3),
         "gangs_per_second": round(n_gangs / wall, 2),
         "pods_per_second": round(want_bound / wall, 2),

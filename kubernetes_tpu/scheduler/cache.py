@@ -142,6 +142,17 @@ class SchedulerCache:
         """True when the cache already tracks this pod (assumed or added)."""
         return key in self.assumed or key in self._pod_node
 
+    def bound_copy(self, key: str):
+        """The cache's copy of a bound/assumed pod (carries the chip
+        assignment debited at assume time), or None. The cache is
+        updated synchronously at bind — ahead of the informer — so
+        gang recovery reads it first."""
+        node_name = self._pod_node.get(key)
+        if node_name is None:
+            return None
+        info = self.nodes.get(node_name)
+        return info.pods.get(key) if info else None
+
     # -- nodes ------------------------------------------------------------
 
     def set_node(self, node: t.Node) -> None:

@@ -46,6 +46,7 @@ class Scheduler:
         self.recorder = EventRecorder(client, component=name)
         self.backoff_seconds = backoff_seconds
         self._informers: list[SharedInformer] = []
+        self._pod_informer: Optional[SharedInformer] = None
         self._task: Optional[asyncio.Task] = None
         self._stopped = False
         self._bind_sem = asyncio.Semaphore(64)
@@ -63,6 +64,12 @@ class Scheduler:
         pods = SharedInformer(self.client, "pods")
         pods.add_handlers(on_add=self._pod_added, on_update=self._pod_updated,
                           on_delete=self._pod_deleted)
+        # Gang membership lookups are by_index, not full-store scans —
+        # O(members) per gang at 30k-pod density.
+        pods.store.add_indexer(
+            "gang", lambda p: ([f"{p.metadata.namespace}/{p.spec.gang}"]
+                               if p.spec.gang else []))
+        self._pod_informer = pods
         nodes = SharedInformer(self.client, "nodes")
         nodes.add_handlers(on_add=lambda n: self.cache.set_node(n),
                            on_update=lambda o, n: self.cache.set_node(n),
@@ -446,19 +453,26 @@ class Scheduler:
             group = await self.client.get("podgroups", ns, name)
         except errors.NotFoundError:
             return
-        # Refresh FULL membership from the API: the queued unit only
-        # carries unbound members, but recovery must see the bound ones
-        # (their chips anchor the contiguity constraint).
+        # Refresh FULL membership from the INFORMER (by_index — the
+        # live LIST this replaces decoded every pod in the namespace
+        # per gang, the dominant cost at fleet scale). The informer can
+        # lag the API, so the scheduler CACHE — updated synchronously
+        # at assume/bind — is consulted first: a member the cache knows
+        # is bound (with the cache's chip assignment) even if its
+        # MODIFIED event hasn't arrived; re-planning it would
+        # double-book chips.
         pods = []
         bound_pods = []
-        members, _rev = await self.client.list(
-            "pods", ns, field_selector=f"spec.gang={name}")
+        members = self._pod_informer.store.by_index("gang", unit.group_key)
         for cur in members:
             if cur.spec.gang != name or not t.is_pod_active(cur):
                 # Terminated members keep node_name + assigned chips in
                 # their corpse; they must not anchor recovery geometry.
                 continue
-            if cur.spec.node_name:
+            cached = self.cache.bound_copy(cur.key())
+            if cached is not None:
+                bound_pods.append(cached)
+            elif cur.spec.node_name:
                 bound_pods.append(cur)
             else:
                 pods.append(cur)
