@@ -496,6 +496,112 @@ async def cmd_up(args) -> int:
     return 0
 
 
+async def cmd_rollout(args) -> int:
+    """``ktl rollout status|history|undo deployment/<name>`` (reference:
+    ``kubectl rollout``; undo copies the target revision's ReplicaSet
+    template back into the deployment spec)."""
+    from ..api import workloads as w  # noqa: F401 — kinds registered
+
+    client = make_client(args)
+    try:
+        kind, _, name = args.target.partition("/")
+        if kind not in ("deployment", "deployments", "deploy") or not name:
+            print("rollout supports deployment/<name>", file=sys.stderr)
+            return 1
+        ns = args.namespace
+
+        async def owned_replicasets():
+            rss, _ = await client.list("replicasets", ns)
+            return sorted(
+                (rs for rs in rss if any(
+                    r.kind == "Deployment" and r.name == name and r.controller
+                    for r in rs.metadata.owner_references)),
+                key=lambda rs: int(rs.metadata.annotations.get(
+                    "deployment.tpu/revision", 0)))
+
+        if args.action == "status":
+            loop = asyncio.get_running_loop()
+            deadline = loop.time() + args.timeout  # wall deadline, not
+            while loop.time() < deadline:          # an iteration count
+                dep = await client.get("deployments", ns, name)
+                want = dep.spec.replicas
+                st = dep.status
+                # Gate on observedGeneration first (kubectl does): the
+                # status is from the PREVIOUS rollout until the
+                # controller has seen this generation — without the
+                # gate a just-updated deployment reports instant
+                # false success.
+                if (st.observed_generation >= dep.metadata.generation
+                        and st.updated_replicas >= want
+                        and st.available_replicas >= want
+                        and st.replicas == want):
+                    print(f"deployment {name!r} successfully rolled out")
+                    return 0
+                print(f"waiting: {st.updated_replicas}/{want} updated, "
+                      f"{st.available_replicas}/{want} available")
+                await asyncio.sleep(0.1)
+            print(f"deployment {name!r} rollout timed out", file=sys.stderr)
+            return 1
+
+        if args.action == "history":
+            print(f"{'REVISION':<10}{'REPLICASET':<40}REPLICAS")
+            for rs in await owned_replicasets():
+                rev = rs.metadata.annotations.get("deployment.tpu/revision", "?")
+                print(f"{rev:<10}{rs.metadata.name:<40}{rs.spec.replicas}")
+            return 0
+
+        # undo
+        rss = await owned_replicasets()
+        if not rss:
+            print(f"no rollout history for {name!r}", file=sys.stderr)
+            return 1
+        dep = await client.get("deployments", ns, name)
+        if args.to_revision:
+            target = next(
+                (rs for rs in rss if rs.metadata.annotations.get(
+                    "deployment.tpu/revision") == str(args.to_revision)), None)
+            if target is None:
+                print(f"revision {args.to_revision} not found", file=sys.stderr)
+                return 1
+        else:
+            # "Previous" = highest-revision RS that is NOT the current
+            # template's RS (named <deploy>-<template hash> by the
+            # controller). A rollback reuses the old RS without
+            # re-numbering it, so rss[-2] would make undo-after-undo a
+            # no-op; kubectl's undo/undo toggles between the last two
+            # templates.
+            from ..controllers.deployment import template_hash
+            current_rs = f"{name}-{template_hash(dep.spec.template)}"
+            target = next(
+                (rs for rs in reversed(rss)
+                 if rs.metadata.name != current_rs), None)
+            if target is None:
+                print("no previous revision to roll back to", file=sys.stderr)
+                return 1
+        template = target.spec.template
+        # Strip the controller-owned hash label before re-submitting.
+        template.metadata.labels = {
+            k: v for k, v in template.metadata.labels.items()
+            if k != "pod-template-hash"}
+        # Read-modify-write retried on conflict: the deployment
+        # controller updates status concurrently.
+        for attempt in range(20):
+            dep.spec.template = template
+            try:
+                await client.update(dep)
+                break
+            except errors.ConflictError:
+                if attempt == 19:
+                    raise
+                await asyncio.sleep(0.05)
+                dep = await client.get("deployments", ns, name)
+        rev = target.metadata.annotations.get("deployment.tpu/revision", "?")
+        print(f"deployment {name!r} rolled back to revision {rev}")
+        return 0
+    finally:
+        await client.close()
+
+
 # -- kubeadm analog: token management + join -------------------------------
 
 async def cmd_token(args) -> int:
@@ -694,6 +800,14 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("-c", "--container", default="")
     sp.add_argument("--timeout", type=float, default=30.0,
                     help="kill the command after this many seconds")
+
+    sp = add("rollout", cmd_rollout, help="status/history/undo a rollout")
+    sp.add_argument("action", choices=["status", "history", "undo"])
+    sp.add_argument("target", help="deployment/<name>")
+    sp.add_argument("-n", "--namespace", default="default")
+    sp.add_argument("--to-revision", type=int, default=0)
+    sp.add_argument("--timeout", type=float, default=60.0,
+                    help="status wait bound (seconds)")
 
     sp = add("token", cmd_token, help="manage bootstrap tokens (kubeadm analog)")
     sp.add_argument("action", choices=["create", "list", "delete"])
