@@ -501,6 +501,9 @@ async def cmd_rollout(args) -> int:
     ``kubectl rollout``; undo copies the target revision's ReplicaSet
     template back into the deployment spec)."""
     from ..api import workloads as w  # noqa: F401 — kinds registered
+    from ..controllers.deployment import (REVISION_ANNOTATION,
+                                          TEMPLATE_HASH_LABEL,
+                                          template_hash)
 
     client = make_client(args)
     try:
@@ -517,7 +520,7 @@ async def cmd_rollout(args) -> int:
                     r.kind == "Deployment" and r.name == name and r.controller
                     for r in rs.metadata.owner_references)),
                 key=lambda rs: int(rs.metadata.annotations.get(
-                    "deployment.tpu/revision", 0)))
+                    REVISION_ANNOTATION, 0)))
 
         if args.action == "status":
             loop = asyncio.get_running_loop()
@@ -546,7 +549,7 @@ async def cmd_rollout(args) -> int:
         if args.action == "history":
             print(f"{'REVISION':<10}{'REPLICASET':<40}REPLICAS")
             for rs in await owned_replicasets():
-                rev = rs.metadata.annotations.get("deployment.tpu/revision", "?")
+                rev = rs.metadata.annotations.get(REVISION_ANNOTATION, "?")
                 print(f"{rev:<10}{rs.metadata.name:<40}{rs.spec.replicas}")
             return 0
 
@@ -559,7 +562,7 @@ async def cmd_rollout(args) -> int:
         if args.to_revision:
             target = next(
                 (rs for rs in rss if rs.metadata.annotations.get(
-                    "deployment.tpu/revision") == str(args.to_revision)), None)
+                    REVISION_ANNOTATION) == str(args.to_revision)), None)
             if target is None:
                 print(f"revision {args.to_revision} not found", file=sys.stderr)
                 return 1
@@ -570,7 +573,6 @@ async def cmd_rollout(args) -> int:
             # re-numbering it, so rss[-2] would make undo-after-undo a
             # no-op; kubectl's undo/undo toggles between the last two
             # templates.
-            from ..controllers.deployment import template_hash
             current_rs = f"{name}-{template_hash(dep.spec.template)}"
             target = next(
                 (rs for rs in reversed(rss)
@@ -582,7 +584,7 @@ async def cmd_rollout(args) -> int:
         # Strip the controller-owned hash label before re-submitting.
         template.metadata.labels = {
             k: v for k, v in template.metadata.labels.items()
-            if k != "pod-template-hash"}
+            if k != TEMPLATE_HASH_LABEL}
         # Read-modify-write retried on conflict: the deployment
         # controller updates status concurrently.
         for attempt in range(20):
@@ -595,7 +597,7 @@ async def cmd_rollout(args) -> int:
                     raise
                 await asyncio.sleep(0.05)
                 dep = await client.get("deployments", ns, name)
-        rev = target.metadata.annotations.get("deployment.tpu/revision", "?")
+        rev = target.metadata.annotations.get(REVISION_ANNOTATION, "?")
         print(f"deployment {name!r} rolled back to revision {rev}")
         return 0
     finally:

@@ -1,0 +1,95 @@
+"""Scheduler extender — out-of-process filter/prioritize webhooks.
+
+Reference: ``plugin/pkg/scheduler/core/extender.go`` (HTTPExtender) +
+the policy file's ``extenders`` stanza: after built-in predicates run,
+each extender's ``filter`` verb gets {pod, node names} and returns the
+survivors + per-node failure reasons; ``prioritize`` returns host
+priorities merged into the score map with the extender's weight.
+
+Wire format mirrors the reference's ExtenderArgs / ExtenderFilterResult
+/ HostPriorityList shapes (JSON over POST), so an existing extender
+webhook ports by swapping field spellings only:
+
+    POST <url_prefix>/<filter_verb>     {"pod": {...}, "node_names": [...]}
+      -> {"node_names": [...], "failed_nodes": {name: reason}, "error": ""}
+    POST <url_prefix>/<prioritize_verb> {"pod": {...}, "node_names": [...]}
+      -> [{"host": name, "score": float}, ...]
+
+Failure policy (reference semantics): a failing FILTER aborts the
+placement attempt (retried with backoff) unless ``ignorable`` — an
+ignorable extender degrades to a no-op; prioritize errors are dropped
+either way (scores are best-effort).
+"""
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+from typing import Optional
+
+from ..api import types as t
+from ..api.scheme import to_dict
+
+log = logging.getLogger("scheduler.extender")
+
+
+@dataclass
+class SchedulerExtender:
+    url_prefix: str
+    filter_verb: str = "filter"
+    prioritize_verb: str = "prioritize"
+    weight: float = 1.0
+    #: Managed resources gate (reference: ManagedResources) — when set,
+    #: only pods requesting one of these resources consult the extender.
+    managed_resources: tuple = ()
+    timeout: float = 5.0
+    ignorable: bool = False
+
+    _session = None  # lazy aiohttp session, shared per extender
+
+    def interested(self, pod: t.Pod) -> bool:
+        if not self.managed_resources:
+            return True
+        requests = t.pod_resource_requests(pod)
+        return any(res in requests for res in self.managed_resources)
+
+    async def _post(self, verb: str, pod: t.Pod, node_names: list[str]):
+        import aiohttp
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession()
+        url = f"{self.url_prefix.rstrip('/')}/{verb}"
+        async with self._session.post(
+                url, json={"pod": to_dict(pod), "node_names": node_names},
+                timeout=aiohttp.ClientTimeout(total=self.timeout)) as resp:
+            resp.raise_for_status()
+            return await resp.json()
+
+    async def filter(self, pod: t.Pod, node_names: list[str]
+                     ) -> tuple[list[str], dict[str, str]]:
+        """(surviving names, {failed name: reason}). Raises on
+        transport/extender error — the scheduler applies the
+        ignorable policy."""
+        if not self.filter_verb:
+            return node_names, {}
+        body = await self._post(self.filter_verb, pod, node_names)
+        if body.get("error"):
+            raise RuntimeError(body["error"])
+        survivors = body.get("node_names")
+        failed = dict(body.get("failed_nodes") or {})
+        if survivors is None:
+            survivors = [n for n in node_names if n not in failed]
+        # Never trust names we didn't submit: a stale/buggy extender
+        # must not resurrect nodes the built-in predicates rejected.
+        sent = set(node_names)
+        return [n for n in survivors if n in sent], failed
+
+    async def prioritize(self, pod: t.Pod,
+                         node_names: list[str]) -> dict[str, float]:
+        if not self.prioritize_verb:
+            return {}
+        body = await self._post(self.prioritize_verb, pod, node_names)
+        return {e["host"]: float(e.get("score", 0)) for e in body
+                if e.get("host") in node_names}
+
+    async def close(self) -> None:
+        if self._session is not None and not self._session.closed:
+            await self._session.close()

@@ -49,6 +49,10 @@ class Scheduler:
         self._pod_informer: Optional[SharedInformer] = None
         self._task: Optional[asyncio.Task] = None
         self._stopped = False
+        #: Out-of-process filter/prioritize webhooks (extender.py;
+        #: reference core/extender.go). Consulted after built-in
+        #: predicates/priorities for pods they manage.
+        self.extenders: list = []
         self._bind_sem = asyncio.Semaphore(64)
         self._bind_tasks: set[asyncio.Task] = set()
         #: Max in-flight+queued async binds before placement pauses.
@@ -99,6 +103,11 @@ class Scheduler:
             task.cancel()
         if self._bind_tasks:
             await asyncio.gather(*self._bind_tasks, return_exceptions=True)
+        for ext in self.extenders:
+            try:
+                await ext.close()
+            except Exception:  # noqa: BLE001
+                pass
         for inf in self._informers:
             await inf.stop()
 
@@ -172,7 +181,11 @@ class Scheduler:
         # Op trace (reference: generic_scheduler.go:110-141 utiltrace) —
         # logged only when this placement ran long.
         trace = Trace("schedule-one", pod=key)
-        node_name, bindings, reasons = self._find_placement(pod)
+        if self.extenders and any(e.interested(pod) for e in self.extenders):
+            node_name, bindings, reasons = \
+                await self._find_placement_extended(pod)
+        else:
+            node_name, bindings, reasons = self._find_placement(pod)
         trace.step("placement computed")
         m.ALGORITHM_LATENCY.observe(time.perf_counter() - start)
         if node_name is None:
@@ -227,8 +240,12 @@ class Scheduler:
         self._bind_tasks.add(task)
         task.add_done_callback(self._bind_tasks.discard)
 
-    def _find_placement(self, pod: t.Pod):
+    def _find_placement(self, pod: t.Pod, return_candidates: bool = False):
         """findNodesThatFit + PrioritizeNodes + selectHost.
+
+        ``return_candidates=True`` stops before selectHost and returns
+        (scores, bindings_by_node, reasons) — the extender phase picks
+        the host after its filter/prioritize round trips.
 
         Chip geometry is computed ONCE per node here (select_chips) and
         reused for the fit decision, the defrag score, and the final
@@ -314,7 +331,49 @@ class Scheduler:
                 from .priorities import MAX_SCORE
                 for name, v in raw.items():
                     scores[name] += MAX_SCORE * v / peak
+        if return_candidates:
+            return scores, bindings_by_node, reasons
         best = max(scores, key=lambda n: (scores[n], n))
+        return best, bindings_by_node.get(best, []), []
+
+    async def _find_placement_extended(self, pod: t.Pod):
+        """_find_placement + the extender phase (core/extender.go):
+        built-in predicates/priorities first, then each interested
+        extender filters the survivors and adds weighted priorities."""
+        scores, bindings_by_node, reasons = self._find_placement(
+            pod, return_candidates=True)
+        if not scores:
+            return None, None, reasons
+        names = list(scores)
+        for ext in self.extenders:
+            if not ext.interested(pod):
+                continue
+            try:
+                names, failed = await ext.filter(pod, names)
+                reasons.extend(f"{n}: {why} (extender)"
+                               for n, why in failed.items())
+            except Exception as e:  # noqa: BLE001
+                if ext.ignorable:
+                    log.warning("ignorable extender %s filter failed: %s",
+                                ext.url_prefix, e)
+                    continue
+                # Non-ignorable extender down: the placement attempt
+                # fails and the pod retries with backoff (reference
+                # semantics; the extender owns resources we cannot
+                # account for locally).
+                return None, None, [f"extender {ext.url_prefix} failed: {e}"]
+            if not names:
+                return None, None, reasons or ["extender filtered all nodes"]
+            try:
+                extra = await ext.prioritize(pod, names)
+            except Exception as e:  # noqa: BLE001 — scores best-effort
+                log.warning("extender %s prioritize failed: %s",
+                            ext.url_prefix, e)
+                extra = {}
+            for n, s in extra.items():
+                if n in scores:
+                    scores[n] += ext.weight * s
+        best = max(names, key=lambda n: (scores[n], n))
         return best, bindings_by_node.get(best, []), []
 
     def _sibling_counts(self, pod: t.Pod) -> dict[str, int]:
@@ -452,6 +511,19 @@ class Scheduler:
         try:
             group = await self.client.get("podgroups", ns, name)
         except errors.NotFoundError:
+            return
+        # The gang planner does not consult extenders; silently
+        # bypassing a NON-ignorable one would double-book whatever
+        # external resource it guards. Refuse loudly instead (the gang
+        # retries if the config changes); ignorable extenders are
+        # advisory and skippable by contract.
+        blocking = [e for e in self.extenders if not e.ignorable
+                    and any(e.interested(p) for p in unit.pods)]
+        if blocking:
+            for pod in unit.pods:
+                await self._handle_unschedulable(pod, [
+                    f"gang scheduling does not support non-ignorable "
+                    f"extender {blocking[0].url_prefix}"])
             return
         # Refresh FULL membership from the INFORMER (by_index — the
         # live LIST this replaces decoded every pod in the namespace

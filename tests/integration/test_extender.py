@@ -1,0 +1,168 @@
+"""Scheduler extender webhooks (scheduler/extender.py; reference
+core/extender.go) — filter, prioritize, failure policy."""
+import asyncio
+
+import pytest
+from aiohttp import web
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.apiserver.admission import default_chain
+from kubernetes_tpu.apiserver.registry import Registry
+from kubernetes_tpu.client.local import LocalClient
+from kubernetes_tpu.scheduler.extender import SchedulerExtender
+from kubernetes_tpu.scheduler.scheduler import Scheduler
+
+
+def mk_node(name):
+    node = t.Node(metadata=ObjectMeta(name=name))
+    node.status.capacity = {"cpu": 8.0, "memory": 32 * 2**30, "pods": 110.0}
+    node.status.allocatable = dict(node.status.capacity)
+    node.status.conditions = [t.NodeCondition(type=t.NODE_READY,
+                                              status="True")]
+    return node
+
+
+def mk_pod(name, res=None):
+    pod = t.Pod(metadata=ObjectMeta(name=name, namespace="default"),
+                spec=t.PodSpec(containers=[t.Container(
+                    name="c", image="i",
+                    resources=t.ResourceRequirements(
+                        requests=dict(res or {"cpu": 0.1})))]))
+    return pod
+
+
+async def start_extender_app(filter_fn=None, prioritize_fn=None):
+    app = web.Application()
+    calls = {"filter": 0, "prioritize": 0}
+
+    async def handle_filter(request):
+        calls["filter"] += 1
+        body = await request.json()
+        if filter_fn is None:
+            return web.json_response({"node_names": body["node_names"]})
+        return web.json_response(filter_fn(body))
+
+    async def handle_prioritize(request):
+        calls["prioritize"] += 1
+        body = await request.json()
+        out = prioritize_fn(body) if prioritize_fn else []
+        return web.json_response(out)
+
+    app.router.add_post("/filter", handle_filter)
+    app.router.add_post("/prioritize", handle_prioritize)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    return f"http://127.0.0.1:{port}", runner, calls
+
+
+async def make_cluster(n_nodes=3):
+    reg = Registry()
+    reg.admission = default_chain(reg)
+    reg.create(t.Namespace(metadata=ObjectMeta(name="default")))
+    for i in range(n_nodes):
+        reg.create(mk_node(f"n{i}"))
+    client = LocalClient(reg)
+    sched = Scheduler(client, backoff_seconds=0.2)
+    return reg, client, sched
+
+
+async def wait_bound(client, name, ticks=100):
+    for _ in range(ticks):
+        await asyncio.sleep(0.05)
+        pod = await client.get("pods", "default", name)
+        if pod.spec.node_name:
+            return pod
+    return pod
+
+
+async def test_extender_filter_restricts_nodes():
+    url, runner, calls = await start_extender_app(
+        filter_fn=lambda b: {"node_names": ["n1"],
+                             "failed_nodes": {"n0": "gpu busy",
+                                              "n2": "gpu busy"}})
+    reg, client, sched = await make_cluster()
+    sched.extenders = [SchedulerExtender(url_prefix=url)]
+    await sched.start()
+    try:
+        await client.create(mk_pod("p1"))
+        pod = await wait_bound(client, "p1")
+        assert pod.spec.node_name == "n1"
+        assert calls["filter"] >= 1
+    finally:
+        await sched.stop()
+        for ext in sched.extenders:
+            await ext.close()
+        await runner.cleanup()
+
+
+async def test_extender_prioritize_steers_choice():
+    url, runner, calls = await start_extender_app(
+        prioritize_fn=lambda b: [{"host": "n2", "score": 100.0}])
+    reg, client, sched = await make_cluster()
+    sched.extenders = [SchedulerExtender(url_prefix=url, weight=2.0)]
+    await sched.start()
+    try:
+        await client.create(mk_pod("p1"))
+        pod = await wait_bound(client, "p1")
+        assert pod.spec.node_name == "n2"
+        assert calls["prioritize"] >= 1
+    finally:
+        await sched.stop()
+        for ext in sched.extenders:
+            await ext.close()
+        await runner.cleanup()
+
+
+async def test_non_ignorable_extender_down_blocks_scheduling():
+    reg, client, sched = await make_cluster()
+    sched.extenders = [SchedulerExtender(
+        url_prefix="http://127.0.0.1:1", timeout=0.3)]
+    await sched.start()
+    try:
+        await client.create(mk_pod("p1"))
+        await asyncio.sleep(1.0)
+        pod = await client.get("pods", "default", "p1")
+        assert not pod.spec.node_name  # placement attempts keep failing
+    finally:
+        await sched.stop()
+        for ext in sched.extenders:
+            await ext.close()
+
+
+async def test_ignorable_extender_down_degrades_to_noop():
+    reg, client, sched = await make_cluster()
+    sched.extenders = [SchedulerExtender(
+        url_prefix="http://127.0.0.1:1", timeout=0.3, ignorable=True)]
+    await sched.start()
+    try:
+        await client.create(mk_pod("p1"))
+        pod = await wait_bound(client, "p1")
+        assert pod.spec.node_name
+    finally:
+        await sched.stop()
+        for ext in sched.extenders:
+            await ext.close()
+
+
+async def test_managed_resources_gate():
+    """Extender consulted only for pods requesting its resource."""
+    url, runner, calls = await start_extender_app(
+        filter_fn=lambda b: {"node_names": ["n0"]})
+    reg, client, sched = await make_cluster()
+    sched.extenders = [SchedulerExtender(
+        url_prefix=url, managed_resources=("example.com/fpga",))]
+    await sched.start()
+    try:
+        await client.create(mk_pod("plain"))
+        pod = await wait_bound(client, "plain")
+        assert pod.spec.node_name
+        assert calls["filter"] == 0  # not interested -> never called
+    finally:
+        await sched.stop()
+        for ext in sched.extenders:
+            await ext.close()
+        await runner.cleanup()
