@@ -632,6 +632,14 @@ class NodeAgent:
             # of their own, so the resolver address rides the env
             # (the kubelet's DNS config analog).
             env.setdefault("KTPU_DNS_SERVER", self.dns_server)
+        # Stable job identity for checkpoint dirs (workloads/
+        # checkpoint.py): every member of a gang — and every
+        # incarnation of a controller-owned pod — must compute the
+        # SAME name without coordination.
+        owner = next((r.name for r in pod.metadata.owner_references
+                      if r.controller), "")
+        env.setdefault("KTPU_JOB_NAME",
+                       pod.spec.gang or owner or pod.metadata.name)
         # Service discovery env (kubelet_pods.go getServiceEnvVarMap);
         # container-specified env always wins.
         if self._svc_informer is not None:

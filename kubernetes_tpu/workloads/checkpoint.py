@@ -1,0 +1,109 @@
+"""Training-job checkpoint/restore — the workload half of elasticity.
+
+The orchestrator's crash-only story (SURVEY §5.4) covers CLUSTER
+state; a preempted/evicted training pod also needs its MODEL state
+back, and the reference's answer is "bring your own" (app
+checkpointing is outside the orchestrator). This module is that
+bring-your-own, TPU-native: Orbax (the JAX checkpoint library)
+writing sharded arrays per host, composed with the orchestrator's
+primitives —
+
+- the job identity the agent injects as ``KTPU_JOB_NAME`` (gang name,
+  else controller name, else pod name) keys the checkpoint dir, so
+  every gang member and every incarnation agrees without
+  coordination,
+- restore happens on the pod's NEXT incarnation after eviction/node
+  death (the controllers recreate it; `latest_step` finds where to
+  resume),
+- save is atomic per step (Orbax finalizes a step dir only when
+  complete), so a pod killed mid-save resumes from the previous step.
+
+:func:`kubernetes_tpu.workloads.lm.train` wires the resume idiom into
+the flagship LM loop; the e2e tier drives a real evicted pod through
+it.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Any, Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def _manager(ckpt_dir: str, max_to_keep: int = 3):
+    import orbax.checkpoint as ocp
+    mgr = ocp.CheckpointManager(
+        ckpt_dir, options=ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep, create=True))
+    try:
+        yield mgr
+    finally:
+        mgr.close()  # always — leaked managers keep worker threads
+
+
+def checkpoint_dir(base: str = "", job: str = "") -> str:
+    """Canonical location: <base>/<job>. Inside a pod, ``KTPU_JOB_NAME``
+    (agent-injected) identifies the job; callers can override both."""
+    base = base or os.environ.get("KTPU_CHECKPOINT_DIR", "/tmp/ktpu-ckpt")
+    job = job or os.environ.get("KTPU_JOB_NAME") \
+        or os.environ.get("POD_NAME", "job")
+    return os.path.join(base, job)
+
+
+def save(step: int, state: Any, ckpt_dir: str,
+         max_to_keep: int = 3) -> None:
+    """Save a pytree (params/opt_state/...) for ``step``; blocks until
+    durable (the orchestrator may kill the pod any time after)."""
+    import orbax.checkpoint as ocp
+    with _manager(ckpt_dir, max_to_keep) as mgr:
+        mgr.save(step, args=ocp.args.StandardSave(state))
+        mgr.wait_until_finished()
+
+
+def latest_step(ckpt_dir: str) -> Optional[int]:
+    if not os.path.isdir(ckpt_dir):
+        return None
+    with _manager(ckpt_dir) as mgr:
+        return mgr.latest_step()
+
+
+def as_template(state: Any) -> Any:
+    """Shape/dtype/sharding skeleton of a pytree — metadata only, so
+    the live arrays can be freed before restore lands the new copy
+    (peak memory = one model state, not two)."""
+    import orbax.checkpoint as ocp
+    return jax.tree.map(ocp.utils.to_shape_dtype_struct, state)
+
+
+def restore(ckpt_dir: str, like: Any, step: Optional[int] = None) -> Any:
+    """Restore the pytree saved at ``step`` (default: latest), sharded
+    like the ``like`` template — real arrays or :func:`as_template`
+    skeletons; arrays land directly on device with the template's
+    sharding, no host round-trip."""
+    import orbax.checkpoint as ocp
+    if not os.path.isdir(ckpt_dir):
+        # Checked BEFORE the manager exists: create=True would leave a
+        # phantom empty dir behind the FileNotFoundError.
+        raise FileNotFoundError(f"no checkpoint under {ckpt_dir!r}")
+    with _manager(ckpt_dir) as mgr:
+        if step is None:
+            step = mgr.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint under {ckpt_dir!r}")
+        template = as_template(like)
+        return mgr.restore(step, args=ocp.args.StandardRestore(template))
+
+
+def resume_or_init(ckpt_dir: str, init_fn, *init_args):
+    """(state, start_step): restore the latest checkpoint or build a
+    fresh state — the idiom a gang member runs at startup so eviction
+    + reschedule is a resume, not a restart."""
+    step = latest_step(ckpt_dir)
+    fresh = init_fn(*init_args)
+    if step is None:
+        return fresh, 0
+    template = as_template(fresh)
+    del fresh  # free device memory before the restored copy lands
+    return restore(ckpt_dir, template, step), step + 1

@@ -234,6 +234,37 @@ def make_forward(cfg: LMConfig, mesh):
     return jax.jit(lambda params, tokens: forward(params, tokens, cfg, mesh))
 
 
+def train(cfg: LMConfig, mesh, steps: int, batch: int, seq: int,
+          lr: float = 3e-3, ckpt_dir: str = "",
+          checkpoint_every: int = 50, rng_seed: int = 0) -> dict:
+    """Elastic training loop: resumes from the job's checkpoint when
+    one exists (workloads/checkpoint.py — eviction + reschedule is a
+    resume, not a restart), saving every ``checkpoint_every`` steps.
+    Returns {"final_step", "loss", "resumed_from"}."""
+    from . import checkpoint as ckpt
+
+    ckpt_dir = ckpt_dir or ckpt.checkpoint_dir()
+    rng = jax.random.PRNGKey(rng_seed)
+
+    def init():
+        params, opt_state = init_sharded(rng, cfg, mesh, lr)
+        return {"params": params, "opt_state": opt_state}
+
+    state, start = ckpt.resume_or_init(ckpt_dir, init)
+    step_fn = make_train_step(cfg, mesh, lr)
+    params, opt_state = state["params"], state["opt_state"]
+    loss = None
+    for step in range(start, steps):
+        data = synthetic_batch(jax.random.fold_in(rng, step), cfg, mesh,
+                               batch, seq)
+        params, opt_state, loss = step_fn(params, opt_state, data)
+        if checkpoint_every and (step + 1) % checkpoint_every == 0:
+            ckpt.save(step, {"params": params, "opt_state": opt_state},
+                      ckpt_dir)
+    return {"final_step": steps, "resumed_from": start,
+            "loss": float(loss) if loss is not None else None}
+
+
 def synthetic_batch(rng, cfg: LMConfig, mesh, batch: int, seq: int):
     """Deterministic learnable stream tok_n = (3^n * tok_0 + 7n) % vocab
     with 2% replacement noise. [B, T+1]; batch dim sharded over

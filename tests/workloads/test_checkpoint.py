@@ -1,0 +1,95 @@
+"""Checkpoint/restore (workloads/checkpoint.py) — the resume-after-
+eviction idiom on the virtual 8-device mesh."""
+import jax
+import jax.numpy as jnp
+import pytest
+
+from kubernetes_tpu.workloads import checkpoint as ckpt
+from kubernetes_tpu.workloads import lm
+from kubernetes_tpu.workloads.sharding import make_mesh
+
+
+def small_cfg():
+    return lm.LMConfig(vocab=64, d_model=32, n_layers=2, n_heads=2, d_ff=64)
+
+
+def test_save_restore_round_trip(tmp_path):
+    cfg = small_cfg()
+    mesh = make_mesh(jax.devices()[:4], fsdp=2, tp=2)
+    params, opt_state = lm.init_sharded(jax.random.PRNGKey(0), cfg, mesh)
+    step_fn = lm.make_train_step(cfg, mesh)
+    batch = lm.synthetic_batch(jax.random.PRNGKey(1), cfg, mesh, 4, 16)
+    params, opt_state, loss0 = step_fn(params, opt_state, batch)
+
+    d = str(tmp_path / "job-a")
+    ckpt.save(3, {"params": params}, d)
+    assert ckpt.latest_step(d) == 3
+
+    like = {"params": lm.init_sharded(jax.random.PRNGKey(9), cfg, mesh)[0]}
+    restored = ckpt.restore(d, like)
+    flat_a = jax.tree.leaves(params)
+    flat_b = jax.tree.leaves(restored["params"])
+    for a, b in zip(flat_a, flat_b):
+        assert jnp.allclose(a, b), "restored params differ"
+        # Sharding follows the template (device-direct restore).
+    assert flat_b[0].sharding == flat_a[0].sharding
+
+
+def test_resume_or_init_idiom(tmp_path):
+    cfg = small_cfg()
+    mesh = make_mesh(jax.devices()[:1])
+    d = str(tmp_path / "job-b")
+
+    def init():
+        return {"params": lm.init_params(jax.random.PRNGKey(0), cfg)}
+
+    state, start = ckpt.resume_or_init(d, init)
+    assert start == 0  # fresh job
+
+    state["marker"] = jnp.float32(42.0)
+    ckpt.save(7, state, d)
+
+    # "Evicted + rescheduled": the next incarnation resumes.
+    def init2():
+        fresh = init()
+        fresh["marker"] = jnp.float32(0.0)
+        return fresh
+
+    state2, start2 = ckpt.resume_or_init(d, init2)
+    assert start2 == 8
+    assert float(state2["marker"]) == 42.0
+
+
+def test_max_to_keep_prunes(tmp_path):
+    d = str(tmp_path / "job-c")
+    for s in range(5):
+        ckpt.save(s, {"x": jnp.arange(4.0)}, d, max_to_keep=2)
+    assert ckpt.latest_step(d) == 4
+    # Old steps pruned; restore of a pruned step fails cleanly.
+    with pytest.raises(Exception):
+        ckpt.restore(d, {"x": jnp.arange(4.0)}, step=0)
+
+
+def test_restore_missing_dir_raises(tmp_path):
+    missing = str(tmp_path / "nope")
+    with pytest.raises(FileNotFoundError):
+        ckpt.restore(missing, {"x": jnp.zeros(1)})
+    # And no phantom dir was created as a side effect.
+    import os
+    assert not os.path.exists(missing)
+
+
+def test_lm_train_resumes(tmp_path):
+    """The wired-in idiom: lm.train interrupted mid-run resumes from
+    its checkpoint instead of restarting."""
+    cfg = small_cfg()
+    mesh = make_mesh(jax.devices()[:1])
+    d = str(tmp_path / "lm-job")
+    first = lm.train(cfg, mesh, steps=4, batch=2, seq=16,
+                     ckpt_dir=d, checkpoint_every=2)
+    assert first["resumed_from"] == 0
+    # "Evicted": a new incarnation picks up at the last checkpoint.
+    second = lm.train(cfg, mesh, steps=6, batch=2, seq=16,
+                      ckpt_dir=d, checkpoint_every=2)
+    assert second["resumed_from"] == 4  # saved at step 3 -> resume at 4
+    assert second["final_step"] == 6
