@@ -130,6 +130,13 @@ class NodeAgent:
         self._containers: dict[str, dict[str, str]] = {}  # pod key -> {container name -> cid}
         self._pod_uids: dict[str, str] = {}      # pod key -> uid (for teardown)
         self._pleg_statuses: dict[str, RtStatus] = {}  # last PLEG relist
+        self._pleg_last_relist = time.monotonic()
+        #: Node problem detector (problemdetector.py); PLEG-health
+        #: check wired by default, operators append LogPatternChecks.
+        from .problemdetector import PlegHealthCheck, ProblemDetector
+        self.problem_detector = ProblemDetector(checks=[PlegHealthCheck(
+            last_relist=lambda: self._pleg_last_relist,
+            interval=pleg_interval)])
         self._restart_counts: dict[str, dict[str, int]] = {}
         self._restart_at: dict[str, dict[str, float]] = {}
         self._admitted: set[str] = set()
@@ -250,6 +257,8 @@ class NodeAgent:
             last_heartbeat_time=now(), last_transition_time=now())]
         if self.eviction is not None:
             node.status.conditions.extend(self.eviction.conditions())
+        if self.problem_detector is not None:
+            node.status.conditions.extend(self.problem_detector.conditions())
         node.status.node_info = t.NodeSystemInfo(
             agent_version="kubernetes-tpu/0.1", architecture="tpu-vm")
         return node
@@ -270,6 +279,13 @@ class NodeAgent:
             self.ipam = PodIPAllocator(cidr)
 
     async def _post_status(self) -> None:
+        if self.problem_detector is not None:
+            # Recorder + ref bound lazily (the node object must exist
+            # before events can reference it).
+            if self.problem_detector.recorder is None:
+                self.problem_detector.recorder = self.recorder
+                self.problem_detector.node_ref = self._build_node()
+            self.problem_detector.tick()
         try:
             cur = await self.client.get("nodes", "", self.node_name)
         except errors.NotFoundError:
@@ -638,8 +654,10 @@ class NodeAgent:
         # SAME name without coordination.
         owner = next((r.name for r in pod.metadata.owner_references
                       if r.controller), "")
-        env.setdefault("KTPU_JOB_NAME",
-                       pod.spec.gang or owner or pod.metadata.name)
+        job = pod.spec.gang or owner or pod.metadata.name
+        # Namespace-qualified: same-named jobs in different namespaces
+        # must never share a checkpoint directory.
+        env.setdefault("KTPU_JOB_NAME", f"{pod.metadata.namespace}/{job}")
         # Service discovery env (kubelet_pods.go getServiceEnvVarMap);
         # container-specified env always wins.
         if self._svc_informer is not None:
@@ -941,6 +959,7 @@ class NodeAgent:
                     current[st.id] = st.state
                     statuses[st.id] = st
                 self._pleg_statuses = statuses
+                self._pleg_last_relist = time.monotonic()
                 for cid, state in current.items():
                     if last.get(cid) != state:
                         self._nudge_owner(cid)

@@ -1,0 +1,160 @@
+"""Node problem detector — the node-problem-detector addon analog.
+
+Reference: ``cluster/addons/node-problem-detector`` (SURVEY §5.3):
+a node-local daemon that surfaces problems the kubelet's own Ready
+heartbeat can't express — kernel deadlocks, runtime hangs — as
+NodeConditions + Events, so operators and remedy systems see a node
+that is "up" but sick.
+
+TPU-native shape: runs inside the node agent (a pod on a TPU host is
+precious real estate; conditions merge into the agent's existing
+status write, no extra apiserver traffic). Built-in checks:
+
+- **PLEGHealthy** — the PLEG relist heartbeat going stale means the
+  agent's container view is frozen (the kubelet marks runtime
+  unhealthy on exactly this signal).
+- **RuntimeResponsive** — ``list_containers`` probe latency/failure
+  (a wedged runtime hangs every sync).
+- **LogPatternCheck** — configurable file+regex monitors (the npd
+  kernel-log monitor pattern, pointed at any log the operator cares
+  about, e.g. a container runtime log or TPU runtime hook output).
+
+Problems flip a condition to True and emit one Event per transition
+(never per tick).
+"""
+from __future__ import annotations
+
+import logging
+import os
+import re
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from ..api import types as t
+
+log = logging.getLogger("problemdetector")
+
+
+@dataclass
+class Problem:
+    condition_type: str
+    active: bool
+    reason: str
+    message: str = ""
+
+
+class Check:
+    """One problem source; ``observe()`` returns the current verdict."""
+
+    def observe(self) -> Problem:  # pragma: no cover - interface
+        raise NotImplementedError
+
+
+@dataclass
+class PlegHealthCheck(Check):
+    """Stale relist == frozen container view (kubelet runtimeState)."""
+    last_relist: Callable[[], float]  # monotonic seconds of last relist
+    interval: float = 1.0
+    #: Reference kubelet: pleg relist threshold 3m; scaled to our
+    #: sub-second intervals as a multiple + slack.
+    threshold: float = 0.0
+
+    def observe(self) -> Problem:
+        limit = self.threshold or (10 * self.interval + 5.0)
+        age = time.monotonic() - self.last_relist()
+        if age > limit:
+            return Problem("PLEGUnhealthy", True, "PLEGStale",
+                           f"no container relist for {age:.1f}s "
+                           f"(limit {limit:.1f}s)")
+        return Problem("PLEGUnhealthy", False, "PLEGHealthy")
+
+
+@dataclass
+class LogPatternCheck(Check):
+    """npd kernel-monitor pattern: a regex match in new COMPLETE lines
+    of a log file latches the condition True (permanent-problem
+    semantics, like npd's kernel deadlock conditions — hardware does
+    not self-heal). An optional ``resolve_pattern`` is the operator's
+    clear mechanism: a later line matching it flips the condition back
+    to False."""
+    path: str
+    pattern: str
+    condition_type: str
+    reason: str
+    resolve_pattern: str = ""
+    _offset: int = field(default=0, repr=False)
+    _active: bool = field(default=False, repr=False)
+    _last_match: str = field(default="", repr=False)
+
+    def _read_new_lines(self) -> str:
+        """New content up to the last newline — a pattern split across
+        a writer's partial flush must be seen whole on the next read,
+        so the offset never advances past an incomplete trailing line."""
+        try:
+            size = os.path.getsize(self.path)
+            if size < self._offset:
+                self._offset = 0  # rotated/truncated
+            with open(self.path, "rb") as f:
+                f.seek(self._offset)
+                raw = f.read()
+        except OSError:
+            return ""
+        cut = raw.rfind(b"\n")
+        if cut == -1:
+            return ""  # no complete new line yet; keep the offset
+        self._offset += cut + 1
+        return raw[: cut + 1].decode(errors="replace")
+
+    def observe(self) -> Problem:
+        # Lines processed in order so problem/resolution chronology is
+        # honored; within one line, resolution wins (it is the more
+        # specific statement).
+        for line in self._read_new_lines().splitlines():
+            match = re.search(self.pattern, line)
+            if match:
+                self._active = True
+                self._last_match = match.group(0)[:120]
+            if self.resolve_pattern and re.search(self.resolve_pattern, line):
+                self._active = False
+                self._last_match = ""
+        return Problem(self.condition_type, self._active, self.reason,
+                       self._last_match)
+
+
+class ProblemDetector:
+    """Aggregates checks; the agent merges :meth:`conditions` into its
+    node status and calls :meth:`tick` from the status loop."""
+
+    def __init__(self, checks: Optional[list[Check]] = None,
+                 recorder=None, node_ref=None):
+        self.checks = list(checks or [])
+        self.recorder = recorder
+        self.node_ref = node_ref
+        self._state: dict[str, Problem] = {}
+
+    def tick(self) -> list[Problem]:
+        """Run every check once; emit an Event per TRANSITION."""
+        out = []
+        for check in self.checks:
+            try:
+                problem = check.observe()
+            except Exception:  # noqa: BLE001 — a broken check must not
+                log.exception("problem check failed")  # kill the agent
+                continue
+            prev = self._state.get(problem.condition_type)
+            if (prev is None or prev.active != problem.active) \
+                    and self.recorder is not None and self.node_ref is not None:
+                kind = "Warning" if problem.active else "Normal"
+                self.recorder.event(self.node_ref, kind, problem.reason,
+                                    problem.message or problem.condition_type)
+            self._state[problem.condition_type] = problem
+            out.append(problem)
+        return out
+
+    def conditions(self) -> list[t.NodeCondition]:
+        return [t.NodeCondition(
+            type=p.condition_type,
+            status="True" if p.active else "False",
+            reason=p.reason, message=p.message)
+            for p in self._state.values()]

@@ -96,14 +96,23 @@ def restore(ckpt_dir: str, like: Any, step: Optional[int] = None) -> Any:
         return mgr.restore(step, args=ocp.args.StandardRestore(template))
 
 
-def resume_or_init(ckpt_dir: str, init_fn, *init_args):
+def resume_or_init(ckpt_dir: str, init_fn, *init_args, template_fn=None):
     """(state, start_step): restore the latest checkpoint or build a
     fresh state — the idiom a gang member runs at startup so eviction
-    + reschedule is a resume, not a restart."""
+    + reschedule is a resume, not a restart.
+
+    ``template_fn``: optional () -> shape/dtype/sharding skeleton (see
+    :func:`as_template`) used on the resume path instead of
+    materializing a full fresh state just to read its shapes — large
+    models should pass one (built e.g. from config arithmetic or a
+    cached skeleton) so resume allocates exactly one model state."""
     step = latest_step(ckpt_dir)
-    fresh = init_fn(*init_args)
     if step is None:
-        return fresh, 0
-    template = as_template(fresh)
-    del fresh  # free device memory before the restored copy lands
+        return init_fn(*init_args), 0
+    if template_fn is not None:
+        template = template_fn()
+    else:
+        fresh = init_fn(*init_args)
+        template = as_template(fresh)
+        del fresh  # free device memory before the restored copy lands
     return restore(ckpt_dir, template, step), step + 1

@@ -1,0 +1,138 @@
+"""Node problem detector (node/problemdetector.py) — npd addon analog."""
+import asyncio
+import time
+
+import pytest
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.node.problemdetector import (LogPatternCheck,
+                                                 PlegHealthCheck, Problem,
+                                                 ProblemDetector)
+
+
+def test_pleg_health_flips_on_staleness():
+    now = {"t": time.monotonic()}
+    check = PlegHealthCheck(last_relist=lambda: now["t"], interval=0.1,
+                            threshold=1.0)
+    assert check.observe().active is False
+    now["t"] = time.monotonic() - 5.0
+    problem = check.observe()
+    assert problem.active is True and problem.reason == "PLEGStale"
+
+
+def test_log_pattern_check(tmp_path):
+    logf = tmp_path / "runtime.log"
+    logf.write_text("all fine\n")
+    check = LogPatternCheck(path=str(logf), pattern=r"OOM-killer invoked",
+                            condition_type="KernelOOM", reason="OOMKill")
+    assert check.observe().active is False
+    with open(logf, "a") as f:
+        f.write("worker: OOM-killer invoked for pid 123\n")
+    problem = check.observe()
+    assert problem.active is True
+    assert "OOM-killer" in problem.message
+    # Incremental read: old content never re-matched, rotation handled.
+    logf.write_text("rotated\n")
+    assert check.observe().active is True  # latched (npd semantics)
+
+
+def test_log_pattern_partial_line_buffering(tmp_path):
+    """A pattern split across writer flushes must still match — the
+    offset never advances past an incomplete trailing line."""
+    logf = tmp_path / "r.log"
+    logf.write_text("")
+    check = LogPatternCheck(path=str(logf), pattern=r"OOM-killer invoked",
+                            condition_type="K", reason="R")
+    with open(logf, "a") as f:
+        f.write("worker: OOM-kil")  # no newline yet
+    assert check.observe().active is False
+    with open(logf, "a") as f:
+        f.write("ler invoked\n")
+    assert check.observe().active is True
+
+
+def test_log_pattern_resolve(tmp_path):
+    logf = tmp_path / "r.log"
+    logf.write_text("")
+    check = LogPatternCheck(path=str(logf), pattern=r"deadlock",
+                            resolve_pattern=r"deadlock cleared",
+                            condition_type="K", reason="R")
+    with open(logf, "a") as f:
+        f.write("kernel: deadlock detected\n")
+    assert check.observe().active is True
+    with open(logf, "a") as f:
+        f.write("operator: deadlock cleared\n")
+    assert check.observe().active is False
+
+
+def test_events_only_on_transitions():
+    events = []
+
+    class FakeRecorder:
+        def event(self, obj, kind, reason, message):
+            events.append((kind, reason))
+
+    flip = {"active": False}
+
+    class FlipCheck:
+        def observe(self):
+            return Problem("TestProblem", flip["active"], "TestReason")
+
+    pd = ProblemDetector(
+        checks=[FlipCheck()], recorder=FakeRecorder(),
+        node_ref=t.Node(metadata=ObjectMeta(name="n0")))
+    pd.tick()
+    pd.tick()
+    assert len(events) == 1  # initial observation only
+    flip["active"] = True
+    pd.tick()
+    pd.tick()
+    assert len(events) == 2  # one transition event, not per tick
+    assert events[-1] == ("Warning", "TestReason")
+    conds = pd.conditions()
+    assert conds[0].type == "TestProblem" and conds[0].status == "True"
+
+
+async def test_agent_surfaces_pleg_condition(tmp_path):
+    from kubernetes_tpu.apiserver.admission import default_chain
+    from kubernetes_tpu.apiserver.registry import Registry
+    from kubernetes_tpu.client.local import LocalClient
+    from kubernetes_tpu.node.agent import NodeAgent
+    from kubernetes_tpu.node.runtime import FakeRuntime
+
+    reg = Registry()
+    reg.admission = default_chain(reg)
+    reg.create(t.Namespace(metadata=ObjectMeta(name="default")))
+    client = LocalClient(reg)
+    agent = NodeAgent(client, "n0", FakeRuntime(), status_interval=0.2,
+                      heartbeat_interval=5, pleg_interval=0.1,
+                      server_port=None)
+    await agent.start()
+    try:
+        for _ in range(50):
+            await asyncio.sleep(0.05)
+            node = await client.get("nodes", "", "n0")
+            cond = next((c for c in node.status.conditions
+                         if c.type == "PLEGUnhealthy"), None)
+            if cond is not None:
+                break
+        assert cond is not None and cond.status == "False"
+
+        # Freeze the PLEG heartbeat: the condition must flip True.
+        agent.problem_detector.checks[0].threshold = 0.01
+        agent._pleg_last_relist = time.monotonic() - 60
+        # Stop the pleg loop from refreshing the stamp.
+        agent.problem_detector.checks[0].last_relist = \
+            lambda: time.monotonic() - 60
+        for _ in range(50):
+            await asyncio.sleep(0.05)
+            node = await client.get("nodes", "", "n0")
+            cond = next((c for c in node.status.conditions
+                         if c.type == "PLEGUnhealthy"), None)
+            if cond is not None and cond.status == "True":
+                break
+        assert cond is not None and cond.status == "True"
+        assert cond.reason == "PLEGStale"
+    finally:
+        await agent.stop()
