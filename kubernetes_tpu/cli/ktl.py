@@ -258,13 +258,31 @@ async def cmd_logs(args) -> int:
         container = args.container or "-"
         import aiohttp
         params = {"tail": str(args.tail)} if args.tail else {}
+        follow = getattr(args, "follow", False)
+        if follow:
+            params["follow"] = "1"
+        # Unbounded timeout ONLY for follow (the stream lives as long
+        # as the container); plain fetches keep aiohttp's default so a
+        # wedged agent errors instead of hanging the CLI.
+        timeout = aiohttp.ClientTimeout(total=None) if follow else None
         async with aiohttp.ClientSession() as s:
             url = f"{base}/logs/{args.namespace}/{args.pod}/{container}"
-            async with s.get(url, params=params) as r:
-                body = await r.text()
+            async with s.get(url, params=params, timeout=timeout) as r:
                 if r.status != 200:
-                    raise SystemExit(f"ktl: {body.strip()}")
-                sys.stdout.write(body)
+                    raise SystemExit(f"ktl: {(await r.text()).strip()}")
+                out_buf = getattr(sys.stdout, "buffer", None)
+                # Incremental decoder for text-only stdout (tests,
+                # redirects): chunk boundaries may split multi-byte
+                # characters, so never decode chunks independently.
+                import codecs
+                dec = codecs.getincrementaldecoder("utf-8")("replace")
+                async for chunk in r.content.iter_any():
+                    if out_buf is not None:
+                        out_buf.write(chunk)  # raw bytes to the terminal
+                        out_buf.flush()
+                    else:
+                        sys.stdout.write(dec.decode(chunk))
+                        sys.stdout.flush()
         return 0
     finally:
         await client.close()
@@ -774,6 +792,8 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("-c", "--container", default="")
     sp.add_argument("-n", "--namespace", default="default")
     sp.add_argument("--tail", type=int, default=0)
+    sp.add_argument("-f", "--follow", action="store_true", default=False,
+                    help="stream new output until the container exits")
 
     sp = add("scale", cmd_scale, help="set replicas")
     sp.add_argument("resource")

@@ -87,14 +87,21 @@ class LogPatternCheck(Check):
     _active: bool = field(default=False, repr=False)
     _last_match: str = field(default="", repr=False)
 
+    _inode: int = field(default=-1, repr=False)
+
     def _read_new_lines(self) -> str:
         """New content up to the last newline — a pattern split across
         a writer's partial flush must be seen whole on the next read,
-        so the offset never advances past an incomplete trailing line."""
+        so the offset never advances past an incomplete trailing line.
+        Rotation detected by inode change OR shrinkage (a copytruncate
+        that regrows past the old offset between ticks is still missed
+        if the inode survives — inherent to offset tailing)."""
         try:
-            size = os.path.getsize(self.path)
-            if size < self._offset:
-                self._offset = 0  # rotated/truncated
+            st = os.stat(self.path)
+            if st.st_ino != self._inode or st.st_size < self._offset:
+                if self._inode != -1 or st.st_size < self._offset:
+                    self._offset = 0  # rotated/truncated/replaced
+                self._inode = st.st_ino
             with open(self.path, "rb") as f:
                 f.seek(self._offset)
                 raw = f.read()

@@ -71,9 +71,79 @@ class NodeAgentServer:
     async def _logs(self, request):
         cid = self._resolve_cid(request)
         tail = request.query.get("tail")
-        text = await self.agent.runtime.container_logs(
-            cid, tail=int(tail) if tail else None)
-        return web.Response(text=text)
+        if request.query.get("follow") not in ("1", "true"):
+            text = await self.agent.runtime.container_logs(
+                cid, tail=int(tail) if tail else None)
+            return web.Response(text=text)
+        return await self._follow_logs(request, cid,
+                                       int(tail) if tail else None)
+
+    async def _follow_logs(self, request, cid: str, tail):
+        """kubectl logs -f: chunked stream of new output until the
+        container exits (plus one final drain). Process-runtime logs
+        stream by BYTE OFFSET from the file — O(new bytes) per tick
+        however large the log grows; other runtimes fall back to a
+        full-read character diff."""
+        import asyncio as aio
+        import os
+
+        from .runtime import STATE_RUNNING
+
+        resp = web.StreamResponse()
+        resp.content_type = "text/plain"
+        await resp.prepare(request)
+
+        async def is_running() -> bool:
+            # PLEG's last relist — no per-tick full runtime listing.
+            # A container newer than the last relist isn't there yet;
+            # ask the runtime directly for that (brief) window.
+            st = self.agent._pleg_statuses.get(cid)
+            if st is None:
+                for cur in await self.agent.runtime.list_containers():
+                    if cur.id == cid:
+                        return cur.state == STATE_RUNNING
+                return False
+            return st.state == STATE_RUNNING
+
+        path_of = getattr(self.agent.runtime, "_log_path", None)
+        log_path = path_of(cid) if callable(path_of) else None
+        if log_path is not None and os.path.exists(log_path):
+            with open(log_path, "rb") as f:
+                data = f.read()
+            offset = len(data)  # bytes consumed, INDEPENDENT of tail trim
+            if tail:
+                data = b"\n".join(data.splitlines()[-tail:] or [b""]) + \
+                    (b"\n" if data.endswith(b"\n") else b"")
+            await resp.write(data)
+            while True:
+                running = await is_running()
+                size = os.path.getsize(log_path)
+                if size > offset:
+                    with open(log_path, "rb") as f:
+                        f.seek(offset)
+                        chunk = f.read()
+                    offset += len(chunk)
+                    await resp.write(chunk)
+                if not running:
+                    break
+                await aio.sleep(0.5)
+        else:
+            full = await self.agent.runtime.container_logs(cid)
+            sent = len(full)
+            initial = "\n".join(full.splitlines()[-tail:]) + "\n" \
+                if tail and full else full
+            await resp.write(initial.encode())
+            while True:
+                running = await is_running()
+                full = await self.agent.runtime.container_logs(cid)
+                if len(full) > sent:
+                    await resp.write(full[sent:].encode())
+                    sent = len(full)
+                if not running:
+                    break
+                await aio.sleep(0.5)
+        await resp.write_eof()
+        return resp
 
     def _resolve_cid(self, request) -> str:
         ns = request.match_info["namespace"]

@@ -72,3 +72,43 @@ async def test_server_logs_summary_metrics(tmp_path):
     finally:
         await teardown(agent, sched, plugin)
         await rt.shutdown()
+
+
+async def test_logs_follow_streams_until_exit(tmp_path):
+    """kubectl logs -f analog: the stream delivers output written
+    AFTER the request started and closes when the container exits."""
+    import asyncio
+
+    reg, client, agent, sched, plugin, rt = await cluster_with_node(
+        tmp_path, runtime=ProcessRuntime(str(tmp_path / "rt")),
+        with_tpu=False)
+    base = f"http://127.0.0.1:{agent.server.port}"
+    try:
+        pod = mk_pod("streamer", command=[
+            sys.executable, "-u", "-c",
+            "import time\n"
+            "print('line-1', flush=True)\n"
+            "time.sleep(1.2)\n"
+            "print('line-2', flush=True)\n"])
+        await client.create(pod)
+        for _ in range(200):
+            await asyncio.sleep(0.05)
+            got = await client.get("pods", "default", "streamer")
+            if got.status.phase == t.POD_RUNNING:
+                break
+        assert got.status.phase == t.POD_RUNNING
+        chunks = []
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"{base}/logs/default/streamer/-",
+                             params={"follow": "1"},
+                             timeout=aiohttp.ClientTimeout(total=30)) as r:
+                assert r.status == 200
+                async for chunk in r.content.iter_any():
+                    chunks.append(chunk.decode())
+        text = "".join(chunks)
+        # line-2 was printed ~1.2s after the stream opened; receiving
+        # it proves follow, and stream closure proves exit detection.
+        assert "line-1" in text and "line-2" in text
+    finally:
+        await teardown(agent, sched, plugin)
+        await rt.shutdown()
