@@ -468,6 +468,56 @@ class Registry:
             out.append(obj)
         return out, rev
 
+    def list_page(self, plural: str, namespace: str = "",
+                  label_selector: str = "", field_selector: str = "",
+                  limit: int = 0, continue_token: str = ""
+                  ) -> tuple[list[TypedObject], int, str]:
+        """Paginated LIST (reference: meta.v1 ListOptions limit/continue,
+        ``etcd3/store.go`` range pagination). Items are key-ordered;
+        the opaque continue token resumes after the last key served.
+
+        Divergence from etcd-backed pagination, documented: pages read
+        the CURRENT revision, not the first page's snapshot — objects
+        created/deleted between pages may appear/miss (the reference's
+        own "inconsistent continue" fallback after compaction has the
+        same contract). ``limit`` counts items POST-selector, like the
+        reference."""
+        import base64 as b64
+        after = ""
+        if continue_token:
+            try:
+                decoded = b64.b64decode(continue_token, validate=True).decode()
+                _rev, after = decoded.split("\x00", 1)
+            except Exception:  # noqa: BLE001
+                raise errors.BadRequestError("malformed continue token") from None
+        spec = self.spec_for(plural)
+        stored, rev = self.store.list(self._prefix(spec, namespace), copy=False)
+        sel = parse_selector(label_selector) if label_selector else None
+        if field_selector and not spec.field_extractor:
+            raise errors.BadRequestError(
+                f"{spec.plural} does not support field selectors")
+        out: list[TypedObject] = []
+        cont = ""
+        for s in stored:  # store.list returns key-sorted items
+            if after and s.key <= after:
+                continue
+            if sel is not None:
+                raw_labels = (s.value.get("metadata") or {}).get("labels") or {}
+                if not sel.matches(raw_labels):
+                    continue
+            obj = self._decode(spec, s.value, s.mod_revision)
+            if field_selector and not match_field_selector(
+                    field_selector, spec.field_extractor(obj)):
+                continue
+            if limit and len(out) >= limit:
+                # One extra match proves there are more pages.
+                cont = b64.b64encode(
+                    f"{rev}\x00{last_key}".encode()).decode()
+                break
+            out.append(obj)
+            last_key = s.key
+        return out, rev, cont
+
     def update(self, obj: TypedObject, subresource: str = "") -> TypedObject:
         """Full-object update with optimistic concurrency.
 

@@ -520,6 +520,20 @@ class APIServer:
         q = request.query
         if q.get("watch") in ("1", "true"):
             return await self._watch(request, plural, ns)
+        limit = self._int_param(q.get("limit", "0") or "0", "limit")
+        if limit or q.get("continue"):
+            items, rev, cont = self.registry.list_page(
+                plural, ns, q.get("label_selector", ""),
+                q.get("field_selector", ""), limit=limit,
+                continue_token=q.get("continue", ""))
+            meta = {"resource_version": str(rev)}
+            if cont:
+                meta["continue"] = cont
+            return web.json_response({
+                "kind": "List", "api_version": "core/v1",
+                "metadata": meta,
+                "items": [to_dict(o) for o in items],
+            })
         items, rev = self.registry.list(
             plural, ns, q.get("label_selector", ""), q.get("field_selector", ""))
         return web.json_response({

@@ -137,7 +137,7 @@ async def cmd_get(args) -> int:
         if args.name:
             objs = [await client.get(plural, args.namespace, args.name)]
         else:
-            objs, _ = await client.list(plural, args.namespace,
+            objs, rev = await client.list(plural, args.namespace,
                                         label_selector=args.selector)
         if args.output == "json":
             out = [to_dict(o) for o in objs]
@@ -151,6 +151,29 @@ async def cmd_get(args) -> int:
         else:
             print(printers.print_objects(plural, objs,
                                          wide=args.output == "wide"))
+        if getattr(args, "watch", False) and not args.name:
+            # kubectl get -w: stream changes after the initial table,
+            # one re-printed row per event, until interrupted.
+            stream = await client.watch(plural, args.namespace, rev,
+                                        label_selector=args.selector)
+            try:
+                while True:
+                    ev = await stream.next()
+                    if ev is None or ev[0] == "CLOSED":
+                        break
+                    ev_type, obj = ev
+                    if ev_type == "BOOKMARK":
+                        continue
+                    row = printers.print_objects(plural, [obj],
+                                                 wide=args.output == "wide")
+                    body = row.splitlines()[1:] or [""]  # drop the header
+                    marker = "- " if ev_type == "DELETED" else "  "
+                    print(marker + "\n".join(body))
+                    sys.stdout.flush()
+            except (KeyboardInterrupt, asyncio.CancelledError):
+                pass
+            finally:
+                stream.cancel()
         return 0
     finally:
         await client.close()
@@ -770,6 +793,8 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("-l", "--selector", default="")
     sp.add_argument("-o", "--output", default="",
                     choices=["", "wide", "json", "yaml"])
+    sp.add_argument("-w", "--watch", action="store_true", default=False,
+                    help="stream changes after the initial list")
 
     sp = add("describe", cmd_describe, help="show one object in detail")
     sp.add_argument("resource")

@@ -216,7 +216,10 @@ class RESTClient(Client):
         return decode_obj(data)
 
     async def list(self, plural: str, namespace: str = "", label_selector: str = "",
-                   field_selector: str = "") -> tuple[list, int]:
+                   field_selector: str = "", chunk_size: int = 0) -> tuple[list, int]:
+        """Full list. ``chunk_size`` > 0 fetches in pages under the
+        hood (meta.v1 limit/continue — bounds each response's size at
+        30k-object scale) but still returns the complete result."""
         av, namespaced = await self._plural_info(plural)
         url = self._url_for(av, plural, namespace if namespaced else "")
         params = {}
@@ -224,10 +227,37 @@ class RESTClient(Client):
             params["label_selector"] = label_selector
         if field_selector:
             params["field_selector"] = field_selector
+        if chunk_size:
+            params["limit"] = str(chunk_size)
+        items: list = []
+        while True:
+            async with self._sess().get(url, params=params) as resp:
+                data = await self._check(resp)
+            items.extend(decode_obj(i) for i in data["items"])
+            cont = data["metadata"].get("continue", "")
+            if not cont:
+                return items, int(data["metadata"]["resource_version"])
+            params["continue"] = cont
+
+    async def list_page(self, plural: str, namespace: str = "",
+                        label_selector: str = "", field_selector: str = "",
+                        limit: int = 0, continue_token: str = ""
+                        ) -> tuple[list, int, str]:
+        """One page + the continue token ('' on the last page)."""
+        av, namespaced = await self._plural_info(plural)
+        url = self._url_for(av, plural, namespace if namespaced else "")
+        params = {"limit": str(limit)} if limit else {}
+        if label_selector:
+            params["label_selector"] = label_selector
+        if field_selector:
+            params["field_selector"] = field_selector
+        if continue_token:
+            params["continue"] = continue_token
         async with self._sess().get(url, params=params) as resp:
             data = await self._check(resp)
-        items = [decode_obj(i) for i in data["items"]]
-        return items, int(data["metadata"]["resource_version"])
+        return ([decode_obj(i) for i in data["items"]],
+                int(data["metadata"]["resource_version"]),
+                data["metadata"].get("continue", ""))
 
     async def update(self, obj: Any, subresource: str = "") -> Any:
         gvk = DEFAULT_SCHEME.gvk_for(obj)
