@@ -487,7 +487,8 @@ class Registry:
         if continue_token:
             try:
                 decoded = b64.b64decode(continue_token, validate=True).decode()
-                _rev, after = decoded.split("\x00", 1)
+                tok_rev, after = decoded.split("\x00", 1)
+                int(tok_rev)  # token carries the minting revision; must be numeric
             except Exception:  # noqa: BLE001
                 raise errors.BadRequestError("malformed continue token") from None
         spec = self.spec_for(plural)
@@ -498,6 +499,9 @@ class Registry:
                 f"{spec.plural} does not support field selectors")
         out: list[TypedObject] = []
         cont = ""
+        # Defensive init only: cont is minted after >=1 append today,
+        # but a reorder of the limit check must not hit a NameError.
+        last_key = after
         for s in stored:  # store.list returns key-sorted items
             if after and s.key <= after:
                 continue

@@ -65,6 +65,12 @@ class APIServer:
         self.authorizer = authorizer
         self.user_groups = user_groups or {}
         self.audit = audit
+        #: Cluster DNS "ip:port" advertised to joining nodes via the
+        #: node-credentials response (kubeadm's cluster-info analog);
+        #: set by the cluster composer once DNS is up. Loopback-bound
+        #: DNS is only reachable by same-host joiners — the composer
+        #: should bind a routable host for true multi-host.
+        self.dns_address = ""
         #: Requests slower than this log a slow-op line (SLO: 1s p99).
         self.slow_request_threshold = 1.0
         #: Max concurrent non-watch requests (reference: the
@@ -351,6 +357,8 @@ class APIServer:
         # The fresh SA token must authenticate immediately — invalidate
         # the authenticator's index instead of waiting out its TTL.
         self._sa_index_at = float("-inf")
+        if self.dns_address:
+            cred["dns_server"] = self.dns_address
         return web.json_response(cred)
 
     async def _version(self, request):

@@ -753,6 +753,9 @@ async def cmd_join(args) -> int:
         dm = DeviceManager(plugin_dir)
     agent = NodeAgent(client, node_name, runtime, device_manager=dm,
                       eviction=EvictionManager(), server_port=0)
+    # Cluster DNS rides the credential response (see _node_credentials)
+    # so pods here resolve rank hostnames exactly like local-node pods.
+    agent.dns_server = body.get("dns_server", "")
     await agent.start()
     print(f"node agent {node_name!r} running against {server} "
           "(SIGINT to leave)")

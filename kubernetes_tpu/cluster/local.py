@@ -167,6 +167,9 @@ class LocalCluster:
         from ..net.dns import ClusterDNS
         self.dns = ClusterDNS(local, host=self.host)
         await self.dns.start()
+        # Joining nodes learn the DNS address with their credential, so
+        # pods on joined hosts get KTPU_DNS_SERVER like local ones do.
+        self.server.dns_address = self.dns.address
 
         for i, spec in enumerate(self.node_specs):
             self.nodes.append(await self._start_node(spec, i))

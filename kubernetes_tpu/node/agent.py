@@ -879,13 +879,27 @@ class NodeAgent:
         by_name = {c.name: c for c in
                    list(pod.spec.containers) + list(pod.spec.init_containers)}
         budget = max(grace, 1.0)
-        hooks = []
+        candidates = []
         for name, cid in cmap.items():
             container = by_name.get(name)
             if container is None or container.lifecycle is None \
                     or container.lifecycle.pre_stop is None:
                 continue
-            st = self._pleg_statuses.get(cid)
+            candidates.append((container, cid))
+        if not candidates:
+            return 0.0
+        # Fresh liveness, not _pleg_statuses: a container that exited
+        # since the last relist must not get a preStop exec attempt
+        # (and the spurious FailedPreStopHook event it would emit).
+        # Only paid when a hook actually exists (it's an RPC under CRI).
+        live = dict(self._pleg_statuses)
+        try:
+            live.update({st.id: st for st in await self.runtime.list_containers()})
+        except Exception:  # noqa: BLE001 — fall back to last relist
+            pass
+        hooks = []
+        for container, cid in candidates:
+            st = live.get(cid)
             if st is not None and st.state != STATE_RUNNING:
                 continue  # nothing to exec in
             hooks.append(self._run_lifecycle_hook(

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import base64
 import binascii
+import copy
 import os
 import shutil
 import time
@@ -73,7 +74,10 @@ class ObjectCache:
             hit = self._cache.get(key)
             if hit is not None:
                 if now - hit[0] < ttl:
-                    return hit[1]
+                    # Copy on hit: the store's no-alias invariant
+                    # (api/scheme.py) extends here — a consumer that
+                    # mutates its ConfigMap must not poison later reads.
+                    return copy.deepcopy(hit[1])
                 del self._cache[key]  # expired: don't pin the object
         obj = await self.client.get(plural, namespace, name)
         if ttl > 0:
@@ -82,7 +86,7 @@ class ObjectCache:
                 # configs don't accumulate over the node's lifetime.
                 self._cache = {k: v for k, v in self._cache.items()
                                if now - v[0] < ttl}
-            self._cache[key] = (now, obj)
+            self._cache[key] = (now, copy.deepcopy(obj))
         else:
             self._cache.pop(key, None)
         return obj

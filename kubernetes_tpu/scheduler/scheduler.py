@@ -371,8 +371,18 @@ class Scheduler:
                             ext.url_prefix, e)
                 extra = {}
             for n, s in extra.items():
-                if n in scores:
-                    scores[n] += ext.weight * s
+                if n not in scores:
+                    continue
+                # Clamp to the reference's 0..10 HostPriority band
+                # (core/extender.go) so a misbehaving extender's
+                # unbounded score cannot silently dominate the
+                # built-in priorities' normalized range. Non-numeric
+                # scores are dropped like any other prioritize error.
+                try:
+                    scores[n] += ext.weight * max(0.0, min(10.0, float(s)))
+                except (TypeError, ValueError):
+                    log.warning("extender %s returned non-numeric score "
+                                "%r for %s", ext.url_prefix, s, n)
         best = max(names, key=lambda n: (scores[n], n))
         return best, bindings_by_node.get(best, []), []
 

@@ -144,6 +144,18 @@ async def test_join_flow_over_http():
                 headers={"Authorization": f"Bearer {token}"})
             again = await resp.json()
         assert again["token"] == cred["token"]
+
+        # 7. Cluster DNS rides the credential when advertised, so
+        # joined-node pods get KTPU_DNS_SERVER like local ones.
+        assert "dns_server" not in cred  # not advertised above
+        server.dns_address = "10.0.0.5:5353"
+        async with aiohttp.ClientSession() as sess:
+            resp = await sess.post(
+                f"{base}/bootstrap/v1/node-credentials",
+                json={"node_name": "worker-9"},
+                headers={"Authorization": f"Bearer {token}"})
+            with_dns = await resp.json()
+        assert with_dns["dns_server"] == "10.0.0.5:5353"
     finally:
         await server.stop()
 
