@@ -1,0 +1,67 @@
+"""Standalone apiserver process — ``python -m kubernetes_tpu.apiserver``.
+
+Reference analog: ``cmd/kube-apiserver`` (the apiserver as its own
+binary with its own address space). The all-in-one ``ktl up`` composes
+everything in-process; this entry exists for deployments — and
+benchmarks — where the apiserver must not share a GIL/event loop with
+its clients: the REST-path density harness runs it as a subprocess so
+the wire path measured is the one a real deployment has.
+
+Prints ``LISTENING <port>`` on stdout once serving (parent processes
+wait for that line), then runs until SIGTERM/SIGINT.
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import signal
+import sys
+
+from ..api import errors
+from ..api import types as t
+from ..api.meta import ObjectMeta
+from .registry import Registry
+from .server import APIServer
+
+
+async def amain(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="kubernetes-tpu-apiserver")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0,
+                   help="0 picks a free port (printed on stdout)")
+    p.add_argument("--data-dir", default="",
+                   help="durable WAL/snapshot dir; empty = in-memory")
+    p.add_argument("--namespaces", default="default,kube-system",
+                   help="comma-separated namespaces to ensure at boot")
+    args = p.parse_args(argv)
+
+    store = None
+    if args.data_dir:
+        import os
+
+        from ..storage.mvcc import MVCCStore
+        store = MVCCStore(os.path.join(args.data_dir, "state"))
+    registry = Registry(store=store)
+    for ns in filter(None, args.namespaces.split(",")):
+        try:
+            registry.create(t.Namespace(metadata=ObjectMeta(name=ns)))
+        except errors.AlreadyExistsError:
+            pass  # durable restart
+    server = APIServer(registry)
+    port = await server.start(args.host, args.port)
+    print(f"LISTENING {port}", flush=True)
+
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            loop.add_signal_handler(sig, stop.set)
+        except NotImplementedError:
+            signal.signal(sig, lambda *_: stop.set())
+    await stop.wait()
+    await server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(asyncio.run(amain()))

@@ -719,6 +719,18 @@ class Registry:
         raw = self.store.watch(self._prefix(spec, namespace), start_revision, loop=loop)
         return ObjectWatch(self, spec, raw, label_selector, field_selector)
 
+    def watch_raw(self, plural: str, namespace: str = "",
+                  start_revision: int = 0, label_selector: str = "",
+                  loop: Optional[asyncio.AbstractEventLoop] = None
+                  ) -> "RawObjectWatch":
+        """Raw-dict watch for wire serving (no typed decode per event);
+        see :class:`RawObjectWatch`. Field-selector watchers must use
+        :meth:`watch`."""
+        spec = self.spec_for(plural)
+        raw = self.store.watch(self._prefix(spec, namespace), start_revision,
+                               loop=loop)
+        return RawObjectWatch(raw, label_selector)
+
     # -- pods/binding subresource ----------------------------------------
 
     def bind_pod(self, namespace: str, name: str, binding: t.Binding) -> t.Pod:
@@ -835,6 +847,70 @@ class ObjectWatch:
         if ev is None:
             raise StopAsyncIteration
         return ev
+
+
+class RawObjectWatch:
+    """Label-selector-filtered watch yielding STORE-OWNED raw dicts.
+
+    The HTTP watch fast path — the role of the reference's watch cache
+    (``staging/src/k8s.io/apiserver/pkg/storage/cacher.go``): events a
+    wire watcher only re-serializes must not pay a full typed decode +
+    re-encode per watcher. Label selectors match the raw
+    ``metadata.labels`` dict (same trick the list path uses); field
+    selectors need typed extraction, so those watchers take the
+    :class:`ObjectWatch` path.
+
+    ``next`` yields ``(etype, payload_dict, revision, which)`` where
+    ``which`` is ``"cur"`` or ``"prev"`` — a cache key component: the
+    same store revision can surface different payloads to different
+    watchers (a selector-left MODIFIED surfaces the corpse as DELETED).
+    Payload dicts alias the store log: consumers MUST NOT mutate them.
+    """
+
+    CLOSED = ObjectWatch.CLOSED
+
+    def __init__(self, raw: Watch, label_selector: str = ""):
+        self._raw = raw
+        self._sel = parse_selector(label_selector) if label_selector else None
+
+    def cancel(self) -> None:
+        self._raw.cancel()
+
+    @property
+    def closed(self) -> bool:
+        return self._raw.closed
+
+    def _match(self, value: Optional[dict]) -> bool:
+        if value is None:
+            return False
+        if self._sel is None:
+            return True
+        labels = (value.get("metadata") or {}).get("labels") or {}
+        return self._sel.matches(labels)
+
+    async def next(self, timeout: Optional[float] = None):
+        while True:
+            ev = await self._raw.next(timeout)
+            if ev is None:
+                if self._raw.closed:
+                    return (self.CLOSED, None, 0, "cur")
+                return None
+            out = self._translate(ev)
+            if out is not None:
+                return out
+
+    def _translate(self, ev: WatchEvent):
+        # Mirrors ObjectWatch._translate on raw dicts (same
+        # selector-transition semantics as the reference watch cache).
+        old_match = self._match(ev.prev_value)
+        if ev.type == DELETED:
+            return (DELETED, ev.value, ev.revision, "cur") if old_match else None
+        if self._match(ev.value):
+            etype = ADDED if (ev.type == ADDED or not old_match) else MODIFIED
+            return (etype, ev.value, ev.revision, "cur")
+        if old_match:  # left the selected set
+            return (DELETED, ev.prev_value, ev.revision, "prev")
+        return None
 
 
 # Imported late to avoid a cycle (admission imports registry types).

@@ -42,7 +42,8 @@ REQUEST_LATENCY = Histogram(
     "apiserver_request_latency_seconds",
     "API request latency by verb and resource",
     labels=("verb", "resource"),
-    buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5),
+    buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+             0.5, 0.75, 1.0, 1.5, 2.5),
 )
 
 
@@ -78,6 +79,9 @@ class APIServer:
         #: requests get 429 and clients back off.
         self.max_inflight = 400
         self._inflight = 0
+        #: (etype, revision, which) -> encoded watch line; serialize-
+        #: once fan-out across watchers (see _encode_watch_event).
+        self._watch_enc: dict[tuple, bytes] = {}
         #: token -> (namespace, sa name) reverse index over SA token
         #: Secrets, rebuilt at most every ttl seconds — O(1) lookups,
         #: bounded by the number of SA secrets (unknown tokens cost a
@@ -504,6 +508,15 @@ class APIServer:
             raise errors.BadRequestError(f"invalid JSON body: {e}") from None
         return data
 
+    async def _mutate(self, fn, *args):
+        """Run a registry mutation: direct when the store is in-memory
+        (sub-ms pure-CPU work — the to_thread handoff costs more than
+        it buys and the GIL serializes it anyway), via a worker thread
+        when a WAL append can block on disk."""
+        if not self.registry.store.durable:
+            return fn(*args)
+        return await asyncio.to_thread(fn, *args)
+
     # -- verb handlers ----------------------------------------------------
 
     async def _create(self, request):
@@ -515,7 +528,7 @@ class APIServer:
         obj = self.registry.scheme.decode(data)
         if ns:
             obj.metadata.namespace = ns
-        created = await asyncio.to_thread(self.registry.create, obj)
+        created = await self._mutate(self.registry.create, obj)
         return self._obj_response(created, status=201)
 
     async def _get(self, request):
@@ -558,16 +571,45 @@ class APIServer:
             raise errors.BadRequestError(
                 f"query parameter {name!r} must be an integer, got {value!r}") from None
 
+    def _encode_watch_event(self, etype: str, payload: dict, rev: int,
+                            which: str) -> bytes:
+        """One JSON encode per store event, shared by every raw watcher
+        (the watch cache's serialize-once fan-out; without this, N pod
+        watchers cost N encodes per event and the apiserver event loop
+        — shared with every in-process component — eats the REST-path
+        latency SLO). ``which`` disambiguates selector-left corpses
+        surfacing at the same revision."""
+        key = (etype, rev, which)
+        line = self._watch_enc.get(key)
+        if line is None:
+            # Shallow-copy to inject the store-owned resource_version
+            # without mutating the store log's dict.
+            obj = {**payload,
+                   "metadata": {**(payload.get("metadata") or {}),
+                                "resource_version": str(rev)}}
+            line = json.dumps({"type": etype, "object": obj}).encode() + b"\n"
+            if len(self._watch_enc) >= 4096:
+                self._watch_enc.clear()
+            self._watch_enc[key] = line
+        return line
+
     async def _watch(self, request, plural: str, ns: str):
         q = request.query
         start_rev = self._int_param(q.get("resource_version", "0") or "0",
                                     "resource_version")
+        field_selector = q.get("field_selector", "")
         try:
-            watch = self.registry.watch(
-                plural, ns, start_rev,
-                q.get("label_selector", ""), q.get("field_selector", ""))
+            if field_selector:
+                # Field selectors need typed extraction — slow path.
+                watch = self.registry.watch(
+                    plural, ns, start_rev,
+                    q.get("label_selector", ""), field_selector)
+            else:
+                watch = self.registry.watch_raw(
+                    plural, ns, start_rev, q.get("label_selector", ""))
         except errors.GoneError as e:
             return self._err(e)
+        raw_mode = not field_selector
         resp = web.StreamResponse()
         resp.content_type = "application/json"
         resp.headers["Transfer-Encoding"] = "chunked"
@@ -578,16 +620,23 @@ class APIServer:
                 if ev is None:
                     # Bookmark keeps the connection alive and advances the
                     # client's resume point (reference: watch bookmarks).
-                    line = json.dumps({
+                    line = (json.dumps({
                         "type": "BOOKMARK",
                         "object": {"metadata": {"resource_version": str(self.registry.store.revision)}},
-                    })
+                    }).encode() + b"\n")
+                elif raw_mode:
+                    etype, payload, rev, which = ev
+                    if etype == "CLOSED":
+                        break
+                    line = self._encode_watch_event(etype, payload, rev, which)
                 else:
                     etype, obj = ev
                     if etype == "CLOSED":
                         break
-                    line = json.dumps({"type": etype, "object": to_dict(obj)})
-                await resp.write(line.encode() + b"\n")
+                    line = (json.dumps(
+                        {"type": etype, "object": to_dict(obj)}).encode()
+                        + b"\n")
+                await resp.write(line)
         except (ConnectionResetError, asyncio.CancelledError):
             pass
         finally:
@@ -608,7 +657,7 @@ class APIServer:
         obj = self.registry.scheme.decode(data)
         obj.metadata.namespace = ns or obj.metadata.namespace
         obj.metadata.name = request.match_info["name"]
-        updated = await asyncio.to_thread(self.registry.update, obj, sub)
+        updated = await self._mutate(self.registry.update, obj, sub)
         return self._obj_response(updated)
 
     async def _patch(self, request):
@@ -617,7 +666,7 @@ class APIServer:
         patch = await self._body_obj(request)
         from ..api.patch import STRATEGIC_MERGE_PATCH
         strategic = request.content_type == STRATEGIC_MERGE_PATCH
-        updated = await asyncio.to_thread(
+        updated = await self._mutate(
             self.registry.patch, plural, ns, request.match_info["name"],
             patch, sub, strategic)
         return self._obj_response(updated)
@@ -625,7 +674,7 @@ class APIServer:
     async def _delete(self, request):
         plural, ns = self._ctx(request)
         gp = request.query.get("grace_period_seconds")
-        obj = await asyncio.to_thread(
+        obj = await self._mutate(
             self.registry.delete, plural, ns, request.match_info["name"],
             self._int_param(gp, "grace_period_seconds") if gp is not None else None,
             request.query.get("uid", ""))
@@ -633,6 +682,9 @@ class APIServer:
 
     async def _delete_collection(self, request):
         plural, ns = self._ctx(request)
+        # Always a worker thread: O(collection) work would monopolize
+        # the event loop even without a WAL (_mutate's inline fast path
+        # is for single-object sub-ms mutations only).
         n = await asyncio.to_thread(
             self.registry.delete_collection, plural, ns,
             request.query.get("label_selector", ""))
@@ -646,7 +698,7 @@ class APIServer:
             from ..api.scheme import from_dict
             from ..api.types import Binding
             binding = from_dict(Binding, data)
-            pod = await asyncio.to_thread(
+            pod = await self._mutate(
                 self.registry.bind_pod, ns, request.match_info["name"], binding)
             return self._obj_response(pod, status=201)
         raise errors.BadRequestError(f"unsupported subresource {plural}/{sub}")

@@ -1,2 +1,10 @@
 """Performance harnesses (reference: ``test/integration/scheduler_perf``
 and the kubemark hollow-node rig, SURVEY.md section 4)."""
+
+
+def pct(sorted_vals, q: float) -> float:
+    """Nearest-rank percentile from a pre-sorted list — the one
+    definition every harness in this package reports with."""
+    if not sorted_vals:
+        return 0.0
+    return sorted_vals[min(len(sorted_vals) - 1, int(q * len(sorted_vals)))]

@@ -12,6 +12,7 @@ Run directly: ``python -m kubernetes_tpu.perf.density [nodes] [pods]``.
 from __future__ import annotations
 
 import asyncio
+import json
 import time
 
 from ..api import types as t
@@ -50,6 +51,131 @@ def density_pod(name: str, cpu: float = 0.1, mem: float = 64 * 2**20) -> t.Pod:
                 requests={"cpu": cpu, "memory": mem}))]))
 
 
+async def _spawn_apiserver() -> tuple:
+    """Start ``python -m kubernetes_tpu.apiserver`` as a subprocess and
+    wait for its LISTENING line. The real-deployment wire path: the
+    apiserver has its own process/GIL, like ``cmd/kube-apiserver``."""
+    import os
+    import sys
+    proc = await asyncio.create_subprocess_exec(
+        sys.executable, "-m", "kubernetes_tpu.apiserver", "--port", "0",
+        stdout=asyncio.subprocess.PIPE,
+        cwd=os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))))
+    line = await asyncio.wait_for(proc.stdout.readline(), 30.0)
+    if not line.startswith(b"LISTENING "):
+        proc.terminate()
+        raise RuntimeError(f"apiserver subprocess said {line!r}")
+    return proc, int(line.split()[1])
+
+
+def _parse_latency_histogram(text: str, name: str, verb: str = "") -> dict:
+    """Percentiles for one Prometheus histogram out of /metrics text
+    (upper-bound quantiles, like Histogram.quantile)."""
+    buckets: dict[float, int] = {}
+    for line in text.splitlines():
+        if not line.startswith(name + "_bucket"):
+            continue
+        if verb and f'verb="{verb}"' not in line:
+            continue
+        labels, _, count = line.partition("} ")
+        le = labels.split('le="', 1)[1].split('"', 1)[0]
+        edge = float("inf") if le == "+Inf" else float(le)
+        buckets[edge] = buckets.get(edge, 0) + int(count)
+    if not buckets:
+        return {}
+    edges = sorted(buckets)
+    total = buckets[edges[-1]]  # +Inf cumulative = all observations
+    out = {}
+    for q in (0.5, 0.9, 0.99):
+        target = q * total
+        for e in edges:
+            if buckets[e] >= target:
+                out[f"p{int(q * 100)}_ms"] = round(e * 1e3, 3)
+                break
+    out["count"] = total
+    return out
+
+
+async def _run_density_rest(n_nodes: int, n_pods: int, timeout: float,
+                            create_concurrency: int,
+                            max_pods_per_node: int) -> dict:
+    """The via='rest' arm of :func:`run_density`: apiserver and loadgen
+    subprocesses, scheduler in-process, everything over HTTP. Every
+    child is terminated on any failure path."""
+    import os
+    import sys
+
+    from ..client.rest import RESTClient
+    server_proc, port = await _spawn_apiserver()
+    sched = client = sched_client = gen = None
+    try:
+        client = RESTClient(f"http://127.0.0.1:{port}")
+        sched_client = RESTClient(f"http://127.0.0.1:{port}")
+        sem = asyncio.Semaphore(create_concurrency)
+
+        async def _create_node(i):
+            async with sem:
+                await client.create(
+                    hollow_node(f"hollow-{i:04d}", pods=max_pods_per_node))
+        await asyncio.gather(*(_create_node(i) for i in range(n_nodes)))
+        sched = Scheduler(sched_client, backoff_seconds=0.5)
+        await sched.start()
+
+        # Load from a separate process; this process runs ONLY the
+        # scheduler (real deployments never co-schedule the load
+        # source's CPU with the scheduler's).
+        gen = await asyncio.create_subprocess_exec(
+            sys.executable, "-m", "kubernetes_tpu.perf.loadgen",
+            "--server", client.base_url, "--pods", str(n_pods),
+            "--concurrency", str(create_concurrency),
+            "--timeout", str(timeout),
+            stdout=asyncio.subprocess.PIPE,
+            cwd=os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))))
+        # Loadgen's worst case is two sequential bound-waits (saturation
+        # + paced), each up to its --timeout, plus creation wall time.
+        raw = await asyncio.wait_for(gen.stdout.readline(),
+                                     2 * timeout + 60.0)
+        await gen.wait()
+        load = json.loads(raw)
+        # Scrape the subprocess apiserver's own request-latency
+        # histogram — the SLO metric (reference scrapes
+        # apiserver_request_latencies_summary the same way,
+        # metrics_util.go:136).
+        import aiohttp
+        async with aiohttp.ClientSession() as s:
+            async with s.get(client.base_url + "/metrics") as r:
+                api_latency = _parse_latency_histogram(
+                    await r.text(), "apiserver_request_latency_seconds")
+    finally:
+        if sched is not None:
+            await sched.stop()
+        if client is not None:
+            await client.close()
+        if sched_client is not None:
+            await sched_client.close()
+        for proc in (gen, server_proc):
+            if proc is None or proc.returncode is not None:
+                continue
+            proc.terminate()
+            try:
+                await asyncio.wait_for(proc.wait(), 10.0)
+            except asyncio.TimeoutError:
+                proc.kill()
+
+    bind = sched_metrics.BINDING_LATENCY
+    out = {
+        "nodes": n_nodes,
+        "via": "rest",
+        "max_pods_per_node": max_pods_per_node,
+        "bind_call_p99_ms": round(bind.quantile(0.99) * 1e3, 3),
+        "api_request_latency": api_latency,
+    }
+    out.update(load)  # pods, wall, pods/s, external schedule latencies
+    return out
+
+
 async def run_density(n_nodes: int = 100, n_pods: int = 3000,
                       timeout: float = 600.0, via: str = "local",
                       create_concurrency: int = 64,
@@ -58,32 +184,32 @@ async def run_density(n_nodes: int = 100, n_pods: int = 3000,
     pod is bound. Returns throughput + latency percentiles.
 
     ``via='local'``: direct registry calls (the reference harness shape
-    — in-proc apiserver). ``via='rest'``: everything (scheduler
-    informers+binds, pod creates, the bound-watch) goes through the
-    real HTTP apiserver — JSON serde + chunked watch streams included.
+    — in-proc apiserver). ``via='rest'``: three real processes — the
+    apiserver a subprocess (cmd/kube-apiserver shape), the load source
+    a subprocess (``perf/loadgen.py``, the density e2e's external
+    client), and the scheduler here — all talking over HTTP. The
+    result's schedule latencies are then the EXTERNALLY observed
+    create→bound times, and ``api_request_latency`` carries the
+    apiserver's own per-request percentiles (the BASELINE "API call
+    latency p99 < 1s" SLO instrument) scraped from its /metrics.
     """
     for m in (sched_metrics.E2E_SCHEDULING_LATENCY,
               sched_metrics.ALGORITHM_LATENCY,
               sched_metrics.BINDING_LATENCY,
               sched_metrics.PODS_SCHEDULED):
         m.reset()  # isolate this run from earlier ones in the process
+
+    if via == "rest":
+        return await _run_density_rest(
+            n_nodes, n_pods, timeout, create_concurrency, max_pods_per_node)
+
     reg = Registry()
     reg.admission = default_chain(reg)
     reg.create(t.Namespace(metadata=ObjectMeta(name="default")))
     for i in range(n_nodes):
         reg.create(hollow_node(f"hollow-{i:04d}", pods=max_pods_per_node))
-
-    server = None
-    if via == "rest":
-        from ..apiserver.server import APIServer
-        from ..client.rest import RESTClient
-        server = APIServer(reg)
-        port = await server.start()
-        client = RESTClient(f"http://127.0.0.1:{port}")
-        sched_client = RESTClient(f"http://127.0.0.1:{port}")
-    else:
-        client = LocalClient(reg)
-        sched_client = client
+    client = LocalClient(reg)
+    sched_client = client
     sched = Scheduler(sched_client, backoff_seconds=0.5)
     await sched.start()
 
@@ -118,13 +244,8 @@ async def run_density(n_nodes: int = 100, n_pods: int = 3000,
             await asyncio.sleep(0.5)
 
     async def create_all():
-        it = iter(range(n_pods))
-
-        async def worker():
-            for i in it:
-                await client.create(density_pod(f"density-{i:05d}"))
-        await asyncio.gather(*(worker() for _ in range(
-            create_concurrency if via == "rest" else 1)))
+        for i in range(n_pods):
+            await client.create(density_pod(f"density-{i:05d}"))
 
     counter = asyncio.create_task(count_bound())
     start = time.perf_counter()
@@ -136,11 +257,6 @@ async def run_density(n_nodes: int = 100, n_pods: int = 3000,
         stream.cancel()
         counter.cancel()
         await sched.stop()
-        if via == "rest":
-            await client.close()
-            await sched_client.close()
-        if server:
-            await server.stop()
 
     per_node: dict[str, int] = {}
     for node_name in bound.values():

@@ -1,0 +1,193 @@
+"""Density load generator — a separate PROCESS posing as the user.
+
+``python -m kubernetes_tpu.perf.loadgen --server URL --pods N``
+
+Reference analog: the density e2e runs kubectl/client-go load from
+outside the control plane (``test/e2e/scalability/density.go``); the
+scheduler never shares an address space with the load source. Two
+phases, mirroring how the reference separates saturation throughput
+(``density.go:364`` pods/s floor) from latency measurement (pod startup
+latency measured on a controlled tail, ``density.go:452-477``):
+
+- **saturation**: pour ``--pods`` in open-loop at full concurrency;
+  report pods/s (latency under an open firehose is backlog arithmetic,
+  not pipeline speed, so it is reported but not the headline).
+- **paced**: create ``--paced-pods`` at ``--rate``/s (below measured
+  saturation); the create→bound percentiles are then the honest
+  pod-schedule latency a real workload sees.
+
+Prints ONE JSON line. The watch consumer decodes raw JSON only (it
+needs two fields), keeping the load source's CPU footprint small on
+shared boxes.
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+import time
+
+import aiohttp
+
+from ..client.rest import RESTClient
+from . import pct as _pct
+from .density import density_pod
+
+
+class _BoundWatcher:
+    """Raw-JSON pods watch: name -> first-seen-bound wall time."""
+
+    def __init__(self, server: str, namespace: str = "default"):
+        self.server = server
+        self.namespace = namespace
+        self.bound_at: dict[str, float] = {}
+        self._task: asyncio.Task | None = None
+        self._session: aiohttp.ClientSession | None = None
+        self.waiters: list[tuple[int, asyncio.Event]] = []
+
+    async def start(self) -> None:
+        self._session = aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=None))
+        self._task = asyncio.create_task(self._run())
+
+    async def _run(self) -> None:
+        url = (f"{self.server}/api/core/v1/namespaces/{self.namespace}"
+               f"/pods?watch=1")
+        while True:
+            try:
+                async with self._session.get(url) as resp:
+                    async for raw in resp.content:
+                        ev = json.loads(raw)
+                        if ev.get("type") not in ("ADDED", "MODIFIED"):
+                            continue
+                        obj = ev.get("object") or {}
+                        if (obj.get("spec") or {}).get("node_name"):
+                            name = obj["metadata"]["name"]
+                            if name not in self.bound_at:
+                                self.bound_at[name] = time.perf_counter()
+                                if self.waiters:
+                                    self.notify()
+                    # Stream ended (server restart): reconnect + the
+                    # relist below covers anything missed.
+            except asyncio.CancelledError:
+                return
+            except Exception:  # noqa: BLE001 — reconnect like a reflector
+                await asyncio.sleep(0.2)
+            for n, evt in self.waiters:
+                if len(self.bound_at) >= n:
+                    evt.set()
+            await asyncio.sleep(0.1)
+
+    def notify(self) -> None:
+        for n, evt in self.waiters:
+            if len(self.bound_at) >= n:
+                evt.set()
+
+    async def wait_for(self, n: int, timeout: float) -> None:
+        evt = asyncio.Event()
+        self.waiters.append((n, evt))
+        self.notify()
+        try:
+            await asyncio.wait_for(evt.wait(), timeout)
+        finally:
+            self.waiters.remove((n, evt))
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+        if self._session:
+            await self._session.close()
+
+
+async def run_load(server: str, n_pods: int, concurrency: int = 64,
+                   timeout: float = 600.0, namespace: str = "default",
+                   paced_pods: int = 300, rate: float = 100.0) -> dict:
+    client = RESTClient(server)
+    watcher = _BoundWatcher(server, namespace)
+    await watcher.start()
+
+    # Watch-event arrival drives the waiters; poke them on a timer too
+    # (covers events that raced the waiter registration).
+    async def poker():
+        while True:
+            watcher.notify()
+            await asyncio.sleep(0.1)
+    poke = asyncio.create_task(poker())
+
+    created_at: dict[str, float] = {}
+    out: dict = {}
+    try:
+        # Phase A: saturation throughput (open loop).
+        async def create_all():
+            it = iter(range(n_pods))
+
+            async def worker():
+                for i in it:
+                    name = f"density-{i:05d}"
+                    created_at[name] = time.perf_counter()
+                    await client.create(density_pod(name))
+            await asyncio.gather(*(worker() for _ in range(concurrency)))
+
+        start = time.perf_counter()
+        await create_all()
+        await watcher.wait_for(n_pods, timeout)
+        wall = time.perf_counter() - start
+        sat_lats = sorted(watcher.bound_at[n] - created_at[n]
+                          for n in watcher.bound_at if n in created_at)
+        out.update({
+            "pods": n_pods,
+            "bound": len(watcher.bound_at),
+            "wall_seconds": round(wall, 3),
+            "pods_per_second": round(n_pods / wall, 2),
+            "saturation_latency_p50_ms": round(_pct(sat_lats, 0.5) * 1e3, 1),
+            "saturation_latency_p99_ms": round(_pct(sat_lats, 0.99) * 1e3, 1),
+        })
+
+        # Phase B: paced latency (closed-ish loop below saturation).
+        if paced_pods > 0 and rate > 0:
+            paced_created: dict[str, float] = {}
+            interval = 1.0 / rate
+            for i in range(paced_pods):
+                name = f"paced-{i:05d}"
+                t0 = time.perf_counter()
+                paced_created[name] = t0
+                await client.create(density_pod(name))
+                sleep = interval - (time.perf_counter() - t0)
+                if sleep > 0:
+                    await asyncio.sleep(sleep)
+            await watcher.wait_for(n_pods + paced_pods, timeout)
+            lats = sorted(watcher.bound_at[n] - paced_created[n]
+                          for n in paced_created if n in watcher.bound_at)
+            out.update({
+                "paced_pods": paced_pods,
+                "paced_rate": rate,
+                "schedule_latency_p50_ms": round(_pct(lats, 0.50) * 1e3, 1),
+                "schedule_latency_p90_ms": round(_pct(lats, 0.90) * 1e3, 1),
+                "schedule_latency_p99_ms": round(_pct(lats, 0.99) * 1e3, 1),
+            })
+    finally:
+        poke.cancel()
+        await watcher.stop()
+        await client.close()
+    return out
+
+
+async def amain(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="ktpu-loadgen")
+    p.add_argument("--server", required=True)
+    p.add_argument("--pods", type=int, default=2000)
+    p.add_argument("--concurrency", type=int, default=64)
+    p.add_argument("--timeout", type=float, default=600.0)
+    p.add_argument("--paced-pods", type=int, default=300)
+    p.add_argument("--rate", type=float, default=100.0)
+    args = p.parse_args(argv)
+    out = await run_load(args.server, args.pods, args.concurrency,
+                         args.timeout, paced_pods=args.paced_pods,
+                         rate=args.rate)
+    print(json.dumps(out), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(asyncio.run(amain()))

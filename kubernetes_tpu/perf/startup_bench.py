@@ -69,8 +69,10 @@ async def run_startup(n_pods: int = 30, n_nodes: int = 2,
     if not lats:
         return {"error": "no pods reached Running"}
 
+    from . import pct as _pct
+
     def pct(p: float) -> float:
-        return round(lats[min(len(lats) - 1, int(p * len(lats)))] * 1e3, 1)
+        return round(_pct(lats, p) * 1e3, 1)
 
     p50, p90, p99 = pct(0.50), pct(0.90), pct(0.99)
     return {

@@ -173,4 +173,8 @@ class SchedulingQueue:
             self._cond.notify_all()
 
     def __len__(self) -> int:
-        return sum(1 for e in self._heap if not e.cancelled)
+        # _entries holds exactly the live (non-cancelled) heap entries
+        # — _push_entry maps, pop/remove/replace unmap — so this is
+        # O(1) where scanning the heap was O(pending) per loop
+        # iteration (it showed up at density scale).
+        return len(self._entries)

@@ -207,9 +207,13 @@ class Scheduler:
         # goroutine) so the next pod's placement overlaps this pod's RPC;
         # the semaphore bounds in-flight binds.
         async def bind_task():
-            bind_start = time.perf_counter()
             try:
                 async with self._bind_sem:
+                    # Clock starts INSIDE the semaphore: BindingLatency
+                    # is the binding API call (reference BindingLatency
+                    # = the POST), not pipeline queueing — that lives
+                    # in E2E_SCHEDULING_LATENCY.
+                    bind_start = time.perf_counter()
                     await self.client.bind(
                         pod.metadata.namespace, pod.metadata.name,
                         t.Binding(target=t.BindingTarget(

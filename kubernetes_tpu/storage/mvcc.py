@@ -247,6 +247,11 @@ class MVCCStore:
             self._load()
             self._wal = open(os.path.join(data_dir, "wal.jsonl"), "a", buffering=1)
 
+    @property
+    def durable(self) -> bool:
+        """True when writes append to a WAL (may block on disk)."""
+        return self._wal is not None
+
     # -- persistence ------------------------------------------------------
 
     def _load(self) -> None:

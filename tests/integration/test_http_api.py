@@ -70,6 +70,49 @@ async def test_watch_stream_over_http():
         await srv.stop()
 
 
+async def test_label_selector_watch_transitions_over_http():
+    """The raw watch fast path (RawObjectWatch) must keep the typed
+    path's selector-transition semantics: entering the selected set
+    surfaces ADDED, leaving it DELETED — and carry resource_version."""
+    srv, client = await start_server()
+    try:
+        _, rev = await client.list("pods", "default")
+        watch = await client.watch("pods", "default", resource_version=rev,
+                                   label_selector="app=web")
+        # Non-matching create: invisible.
+        await client.create(mk_pod("other"))
+        pod = mk_pod("sel")
+        pod.metadata.labels["app"] = "web"
+        created = await client.create(pod)
+        etype, obj = await watch.next(timeout=5)
+        assert etype == "ADDED" and obj.metadata.name == "sel"
+        assert int(obj.metadata.resource_version) > 0
+
+        got = await client.get("pods", "default", "sel")
+        got.metadata.annotations["n"] = "1"
+        await client.update(got)
+        etype, obj = await watch.next(timeout=5)
+        assert etype == "MODIFIED" and obj.metadata.annotations == {"n": "1"}
+
+        # Label removed -> leaves the selected set -> DELETED.
+        got = await client.get("pods", "default", "sel")
+        got.metadata.labels.pop("app")
+        await client.update(got)
+        etype, obj = await watch.next(timeout=5)
+        assert etype == "DELETED" and obj.metadata.name == "sel"
+        watch.cancel()
+
+        # Field-selector watch (typed slow path) still serves.
+        fw = await client.watch("pods", "default", resource_version=rev,
+                                field_selector="metadata.name=other")
+        etype, obj = await fw.next(timeout=5)
+        assert etype == "ADDED" and obj.metadata.name == "other"
+        fw.cancel()
+    finally:
+        await client.close()
+        await srv.stop()
+
+
 async def test_binding_over_http():
     srv, client = await start_server()
     try:
