@@ -135,6 +135,13 @@ class LocalCluster:
         store = MVCCStore(os.path.join(self.data_dir, "state")
                           if self.durable else None)
         self.registry = Registry(store=store)
+        # Loopback pod-IP space: every 127/8 address is bindable and
+        # routable on one host with zero configuration, so the pod IPs
+        # the framework assigns (and cluster DNS serves) are REAL for
+        # this single-host runtime — a rank-0 pod can listen on its pod
+        # IP and peers can dial what DNS returns (the CNI-bridge role).
+        # Multi-host joins route over the apiserver, not pod IPs.
+        self.registry.cluster_cidr = "127.64.0.0/12"
         self.registry.admission = default_chain(self.registry)
         local = LocalClient(self.registry)
         for ns in ("default", "kube-system"):

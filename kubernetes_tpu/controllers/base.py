@@ -240,3 +240,22 @@ def claim_pods(owner: TypedObject, selector, pods: Iterable) -> list:
                 selector.matches(pod.metadata.labels):
             claimed.append(pod)
     return claimed
+
+
+def rank_hostnames(base: str, count: int, service: str,
+                   namespace: str) -> str:
+    """Comma list of stable rank hostnames (``<base>-<i>[.<svc>.<ns>]``)
+    for TPU_WORKER_HOSTNAMES — ONE format shared by the StatefulSet and
+    Indexed-Job controllers, because :mod:`..workloads.rendezvous`
+    parses it (rank order = list order; FQDN suffixing happens there)."""
+    return ",".join(
+        f"{base}-{i}.{service}.{namespace}" if service else f"{base}-{i}"
+        for i in range(count))
+
+
+def merge_container_env(containers, extra) -> None:
+    """Append ``extra`` EnvVars to every container that doesn't already
+    define them (user template wins over controller injection)."""
+    for c in containers:
+        have = {e.name for e in c.env}
+        c.env = c.env + [e for e in extra if e.name not in have]

@@ -22,7 +22,8 @@ from ..api import workloads as w
 from ..api.meta import controller_ref, is_controlled_by, now
 from ..client.informer import InformerFactory
 from ..client.interface import Client
-from .base import Controller, PodControl, is_pod_active
+from .base import (Controller, PodControl, is_pod_active,
+                   merge_container_env, rank_hostnames)
 
 JOB_NAME_LABEL = "job.tpu/name"
 COMPLETION_INDEX_LABEL = "job.tpu/completion-index"
@@ -99,13 +100,29 @@ class JobController(Controller):
             if job.spec.completion_mode == "Indexed":
                 # Stable ranks exist only in Indexed mode — NonIndexed
                 # pods are interchangeable and must not all claim rank 0.
+                # Stable DNS identity too (upstream Indexed Jobs set
+                # hostname=$(job)-$(index) the same way): with the
+                # template carrying spec.subdomain of a headless
+                # Service, rank hostnames resolve via cluster DNS and
+                # TPU_WORKER_HOSTNAMES lets jax.distributed bootstrap
+                # with no external coordinator (workloads/rendezvous.py).
+                pod.spec.hostname = f"{job.metadata.name}-{index}"
                 rank_env = [
                     t.EnvVar(name="JOB_COMPLETION_INDEX", value=str(index)),
                     t.EnvVar(name="TPU_WORKER_ID", value=str(index)),
                 ]
-                for c in pod.spec.containers:
-                    have = {e.name for e in c.env}
-                    c.env = c.env + [e for e in rank_env if e.name not in have]
+                total = job.spec.completions or job.spec.parallelism
+                if pod.spec.subdomain and job.spec.parallelism >= total:
+                    # Hostnames only when ALL ranks run concurrently
+                    # (the gang case): with parallelism < completions a
+                    # worker would wait on ranks that are never up and
+                    # deadlock jax.distributed into its backoff limit.
+                    rank_env.append(t.EnvVar(
+                        name="TPU_WORKER_HOSTNAMES",
+                        value=rank_hostnames(
+                            job.metadata.name, total, pod.spec.subdomain,
+                            job.metadata.namespace)))
+                merge_container_env(pod.spec.containers, rank_env)
         return mutate
 
     async def sync(self, key: str) -> Optional[float]:

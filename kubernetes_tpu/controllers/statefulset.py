@@ -23,7 +23,8 @@ from ..api.meta import controller_ref, is_controlled_by
 from ..api.scheme import deepcopy, to_dict
 from ..client.informer import InformerFactory
 from ..client.interface import Client
-from .base import Controller, PodControl, is_pod_active, is_pod_ready
+from .base import (Controller, PodControl, is_pod_active, is_pod_ready,
+                   merge_container_env, rank_hostnames)
 
 POD_NAME_LABEL = "statefulset.tpu/pod-name"
 REVISION_LABEL = "statefulset.tpu/revision"
@@ -73,10 +74,9 @@ class StatefulSetController(Controller):
         return out
 
     def _mutator(self, st: w.StatefulSet, ordinal: int, revision: str):
-        hostnames = ",".join(
-            f"{st.metadata.name}-{i}.{st.spec.service_name}"
-            f".{st.metadata.namespace}" if st.spec.service_name else
-            f"{st.metadata.name}-{i}" for i in range(st.spec.replicas))
+        hostnames = rank_hostnames(st.metadata.name, st.spec.replicas,
+                                   st.spec.service_name,
+                                   st.metadata.namespace)
 
         def mutate(pod: t.Pod) -> None:
             pod.spec.hostname = pod.metadata.name
@@ -84,13 +84,10 @@ class StatefulSetController(Controller):
             pod.metadata.labels = {**pod.metadata.labels,
                                    POD_NAME_LABEL: pod.metadata.name,
                                    REVISION_LABEL: revision}
-            rank_env = [
+            merge_container_env(pod.spec.containers, [
                 t.EnvVar(name="TPU_WORKER_ID", value=str(ordinal)),
                 t.EnvVar(name="TPU_WORKER_HOSTNAMES", value=hostnames),
-            ]
-            for c in pod.spec.containers:
-                have = {e.name for e in c.env}
-                c.env = c.env + [e for e in rank_env if e.name not in have]
+            ])
 
         return mutate
 

@@ -1,0 +1,130 @@
+"""Multi-host rendezvous from framework-injected env + cluster DNS.
+
+The piece SURVEY §7 hard-part 3 calls "multi-host slice coordination":
+a gang-scheduled job's N pods must find each other and call
+``jax.distributed.initialize`` with **no external coordinator** —
+using only what the framework itself provides:
+
+- ``TPU_WORKER_ID``         this pod's rank (Indexed Job / StatefulSet),
+- ``TPU_WORKER_HOSTNAMES``  comma list of rank hostnames (rank order),
+- ``KTPU_DNS_SERVER``       the cluster DNS address (``net/dns.py``),
+- ``KTPU_COORD_PORT``       coordinator port (optional, default 8476).
+
+Rank 0's hostname is resolved through the cluster DNS (a plain A/IN
+query against the UDP responder — the glibc-resolver role, since pods
+in this runtime do not get /etc/resolv.conf rewritten), and every rank
+dials ``<rank0-ip>:<port>``. Reference analog: jax multi-host bootstrap
+over DCN (megascale/jax.distributed), which likewise needs only a
+coordinator address and a rank.
+"""
+from __future__ import annotations
+
+import os
+import random
+import socket
+import struct
+import time
+from typing import Optional
+
+DEFAULT_COORD_PORT = 8476
+
+
+def dns_query(name: str, server: str, timeout: float = 2.0) -> Optional[str]:
+    """One A/IN query against the cluster DNS; first IP or None."""
+    host, _, port = server.partition(":")
+    txn = random.randrange(1 << 16)
+    q = struct.pack("!HHHHHH", txn, 0x0100, 1, 0, 0, 0)
+    for label in name.strip(".").split("."):
+        q += bytes([len(label)]) + label.encode()
+    q += b"\x00" + struct.pack("!HH", 1, 1)  # QTYPE=A, QCLASS=IN
+    with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+        s.settimeout(timeout)
+        s.sendto(q, (host, int(port or 53)))
+        try:
+            data, _ = s.recvfrom(512)
+        except socket.timeout:
+            return None
+    if len(data) < 12 or struct.unpack("!H", data[:2])[0] != txn:
+        return None
+    flags, _qd, an = struct.unpack("!HHH", data[2:8])
+    if flags & 0x000F or an == 0:  # RCODE != NOERROR, or no answers
+        return None
+    # Skip the question section, then parse the first A answer.
+    pos = 12
+    while pos < len(data) and data[pos] != 0:
+        pos += 1 + data[pos]
+    pos += 5  # root label + qtype + qclass
+    for _ in range(an):
+        if pos + 12 > len(data):
+            return None
+        if data[pos] & 0xC0:  # compressed name pointer
+            pos += 2
+        else:
+            while pos < len(data) and data[pos] != 0:
+                pos += 1 + data[pos]
+            pos += 1
+        if pos + 10 > len(data):
+            return None  # truncated/malformed RR header: treat as NXDOMAIN
+        rtype, _rclass, _ttl, rdlen = struct.unpack(
+            "!HHIH", data[pos: pos + 10])
+        pos += 10
+        if rtype == 1 and rdlen == 4:
+            return ".".join(str(b) for b in data[pos: pos + 4])
+        pos += rdlen
+    return None
+
+
+def _fqdn(hostname: str, domain: str = "cluster.local") -> str:
+    """Short rank hostnames (``<pod>.<svc>.<ns>``) -> DNS FQDN."""
+    name = hostname.strip(".")
+    return name if name.endswith(f".svc.{domain}") else f"{name}.svc.{domain}"
+
+
+def resolve_rank0(timeout: float = 60.0) -> str:
+    """Resolve rank 0's pod IP via the cluster DNS, retrying until the
+    coordinator pod is scheduled, running, and in Endpoints (the
+    rendezvous race every multi-host bootstrap has)."""
+    hostnames = os.environ["TPU_WORKER_HOSTNAMES"].split(",")
+    dns = os.environ["KTPU_DNS_SERVER"]
+    name = _fqdn(hostnames[0])
+    deadline = time.monotonic() + timeout
+    while True:
+        ip = dns_query(name, dns)
+        if ip:
+            return ip
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                f"rank-0 hostname {name!r} did not resolve via {dns} "
+                f"within {timeout}s")
+        time.sleep(0.5)
+
+
+def initialize_from_env(timeout: float = 60.0) -> int:
+    """``jax.distributed.initialize`` from framework env; returns rank.
+
+    Call before any other jax API. Idempotent per process (jax raises
+    on double-initialize; callers restarting in-process should not).
+    """
+    import jax
+    rank = int(os.environ["TPU_WORKER_ID"])
+    n = len(os.environ["TPU_WORKER_HOSTNAMES"].split(","))
+    port = int(os.environ.get("KTPU_COORD_PORT", DEFAULT_COORD_PORT))
+    if n == 1:
+        return 0  # single-process: nothing to rendezvous
+    coord_ip = (os.environ.get("POD_IP", "") if rank == 0
+                else resolve_rank0(timeout))
+    if not coord_ip:
+        coord_ip = resolve_rank0(timeout)
+    # Rank 0 binds its OWN pod IP, not the wildcard: pod IPs are unique
+    # (loopback-range locally, CNI-assigned on real hosts), so a stale
+    # coordinator from a torn-down gang incarnation — or another job on
+    # the same host — can never collide on the port and crash-loop the
+    # fresh gang into its backoff limit.
+    bind = (f"{os.environ['POD_IP']}:{port}"
+            if rank == 0 and os.environ.get("POD_IP") else None)
+    jax.distributed.initialize(
+        coordinator_address=f"{coord_ip}:{port}",
+        num_processes=n, process_id=rank,
+        coordinator_bind_address=bind,
+        initialization_timeout=int(timeout))  # jaxlib wants an int
+    return rank

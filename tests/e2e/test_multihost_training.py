@@ -1,0 +1,180 @@
+"""Multi-process jax.distributed training THROUGH the framework.
+
+The flagship claim (SURVEY §7 hard-part 3, VERDICT r2 top item): a gang
+Job's N pods are N real OS processes that rendezvous using ONLY
+framework-provided machinery — Job-controller rank env
+(TPU_WORKER_ID/TPU_WORKER_HOSTNAMES), agent-injected POD_IP and
+KTPU_DNS_SERVER, cluster DNS rank-hostname records over real loopback
+pod IPs — then run sharded train steps with cross-process collectives
+(Gloo over the resolved sockets) and exit 0.
+
+The second test kills one member mid-run: gang semantics tear down and
+recreate the whole gang, and Orbax resume continues from the last
+committed step — the final value proves no step was lost or repeated.
+
+Reference bar: ``test/e2e_node/gpu_device_plugin.go:46`` (assignment
+survives restarts) had no multi-process training analog; this is the
+TPU-first extension.
+"""
+import asyncio
+import os
+import signal
+import sys
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api import workloads as w
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.client.rest import RESTClient
+from kubernetes_tpu.cluster import LocalCluster
+from kubernetes_tpu.cluster.local import NodeSpec
+
+from .test_local_cluster import fast_cluster, wait_for
+
+N_WORKERS = 2
+
+
+def _headless_service(name: str) -> t.Service:
+    return t.Service(
+        metadata=ObjectMeta(name=name, namespace="default"),
+        spec=t.ServiceSpec(cluster_ip="None",
+                           selector={"job.tpu/name": "train"},
+                           ports=[t.ServicePort(port=8476)]))
+
+
+def _train_job(ckpt_dir: str, total_steps: int, step_delay: float = 0.0,
+               backoff_limit: int = 6) -> w.Job:
+    env = [
+        t.EnvVar(name="TOTAL_STEPS", value=str(total_steps)),
+        t.EnvVar(name="STEP_DELAY", value=str(step_delay)),
+        t.EnvVar(name="CKPT_DIR", value=ckpt_dir),
+    ]
+    template = w.PodTemplateSpec(spec=t.PodSpec(
+        restart_policy="Never",
+        subdomain="train-svc",
+        termination_grace_period_seconds=1,
+        containers=[t.Container(
+            name="worker", image="inline",
+            command=[sys.executable, "-m",
+                     "kubernetes_tpu.workloads.distributed_demo"],
+            env=env)]))
+    return w.Job(
+        metadata=ObjectMeta(name="train", namespace="default"),
+        spec=w.JobSpec(parallelism=N_WORKERS, completions=N_WORKERS,
+                       completion_mode="Indexed",
+                       backoff_limit=backoff_limit,
+                       template=template,
+                       gang=w.GangPolicy(min_member=N_WORKERS)))
+
+
+def _expected_final(n: int, total: int) -> float:
+    # Step s adds mean_over_ranks(rank + 1 + s) = (n-1)/2 + 1 + s.
+    return sum((n - 1) / 2 + 1 + s for s in range(total))
+
+
+async def _job_finished(client):
+    job = await client.get("jobs", "default", "train")
+    for c in job.status.conditions:
+        if c.type in ("Complete", "Failed") and c.status == "True":
+            return job
+    return None
+
+
+async def test_gang_job_multiprocess_jax_distributed(tmp_path):
+    """N pods = N OS processes; rendezvous via framework env + cluster
+    DNS; sharded steps with cross-process collectives; all exit 0."""
+    total = 6
+    ckpt = str(tmp_path / "ckpt")
+    cluster = fast_cluster(tmp_path / "cluster",
+                           [NodeSpec(name=f"w-{i}") for i in range(N_WORKERS)])
+    await cluster.start()
+    client = RESTClient(cluster.base_url)
+    try:
+        await cluster.wait_for_nodes_ready(timeout=20)
+        await client.create(_headless_service("train-svc"))
+        await client.create(_train_job(ckpt, total))
+
+        job = await wait_for(lambda: _job_finished(client), timeout=120,
+                             interval=0.5)
+        conds = {c.type: c.status for c in job.status.conditions}
+        assert conds.get("Complete") == "True", job.status
+        assert job.status.succeeded == N_WORKERS
+
+        # Every rank converged to the exactly-computable final value on
+        # its FIRST attempt (start step 0).
+        expect = _expected_final(N_WORKERS, total)
+        for r in range(N_WORKERS):
+            path = os.path.join(ckpt, f"done-rank{r}-attempt0")
+            assert os.path.exists(path), os.listdir(ckpt)
+            assert abs(float(open(path).read()) - expect) < 1e-3
+    finally:
+        await client.close()
+        await cluster.stop()
+
+
+async def test_gang_kill_midrun_recovers_and_resumes(tmp_path):
+    """SIGKILL one member mid-run: the gang is torn down and recreated
+    as a unit, and Orbax resume continues from the last committed step
+    — proven by the exact final value and a nonzero resume step."""
+    total = 60
+    delay = 0.25  # ~15s run: a wide window to kill into
+    ckpt = str(tmp_path / "ckpt")
+    cluster = fast_cluster(tmp_path / "cluster",
+                           [NodeSpec(name=f"w-{i}") for i in range(N_WORKERS)])
+    await cluster.start()
+    client = RESTClient(cluster.base_url)
+    try:
+        await cluster.wait_for_nodes_ready(timeout=20)
+        await client.create(_headless_service("train-svc"))
+        await client.create(_train_job(ckpt, total, step_delay=delay))
+
+        # Wait until training demonstrably progresses (a checkpoint
+        # landed), then SIGKILL rank 1's real OS process.
+        async def progressed():
+            from kubernetes_tpu.workloads.checkpoint import latest_step
+            try:
+                s = latest_step(ckpt)
+            except Exception:
+                return None
+            return s if s and s >= 3 else None
+        await wait_for(progressed, timeout=90, interval=0.5)
+
+        victim_pid = None
+        pods, _ = await client.list("pods", "default",
+                                    label_selector="job.tpu/name=train")
+        running = [p for p in pods if p.status.phase == t.POD_RUNNING]
+        assert running, [p.status.phase for p in pods]
+        victim = running[-1]
+        for node in cluster.nodes:
+            if node.name != victim.spec.node_name:
+                continue
+            for st in await node.runtime.list_containers():
+                if st.pod_uid == victim.metadata.uid and st.pid:
+                    victim_pid = st.pid
+        assert victim_pid, "victim pid not found"
+        os.kill(victim_pid, signal.SIGKILL)
+
+        job = await wait_for(lambda: _job_finished(client), timeout=180,
+                             interval=0.5)
+        conds = {c.type: c.status for c in job.status.conditions}
+        assert conds.get("Complete") == "True", (job.status,
+                                                 os.listdir(ckpt))
+        # The completing attempt RESUMED (attempt marker > 0) and the
+        # final value is exact — no step lost or double-applied across
+        # the kill/recreate boundary.
+        expect = _expected_final(N_WORKERS, total)
+        markers = [f for f in os.listdir(ckpt) if f.startswith("done-")]
+        finals = {}
+        for m in markers:
+            rank = int(m.split("-rank")[1].split("-")[0])
+            attempt = int(m.split("-attempt")[1])
+            finals.setdefault(rank, []).append(
+                (attempt, float(open(os.path.join(ckpt, m)).read())))
+        assert set(finals) == set(range(N_WORKERS)), markers
+        resumed = [a for r in finals.values() for a, _ in r if a > 0]
+        assert resumed, f"no resumed attempt in {markers}"
+        for r, attempts in finals.items():
+            last = max(attempts)
+            assert abs(last[1] - expect) < 1e-3, (r, attempts, expect)
+    finally:
+        await client.close()
+        await cluster.stop()
