@@ -1,0 +1,249 @@
+"""Cluster PKI — CA, server certs, client-identity certs.
+
+Reference: kubeadm's certs phase (``cmd/kubeadm/app/phases/certs/``)
+mints a self-signed CA, an apiserver serving cert, and per-component
+client certs; the apiserver authenticates client certs by chain
+verification and maps Subject CN -> user, Subject O -> groups
+(``staging/src/k8s.io/apiserver/pkg/authentication/request/x509/
+x509.go:83 New``, the CommonNameUserConversion at ``:107``).
+
+TPU-native shape: one small module over ``cryptography`` producing PEM
+files on disk; the apiserver and node server load them into stdlib
+``ssl`` contexts (no custom TLS code). Identity convention preserved
+exactly — CN is the username, each O is a group — so RBAC rules work
+identically for cert- and token-authenticated callers.
+"""
+from __future__ import annotations
+
+import datetime
+import ipaddress
+import os
+from dataclasses import dataclass
+
+from cryptography import x509
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.x509.oid import ExtendedKeyUsageOID, NameOID
+
+_ONE_DAY = datetime.timedelta(days=1)
+
+
+def _new_key():
+    # ECDSA P-256: small, fast handshakes; kubeadm moved the same way.
+    return ec.generate_private_key(ec.SECP256R1())
+
+
+def _write(path: str, data: bytes, private: bool = False) -> None:
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC,
+                 0o600 if private else 0o644)
+    with os.fdopen(fd, "wb") as f:
+        f.write(data)
+
+
+def _key_pem(key) -> bytes:
+    return key.private_bytes(
+        serialization.Encoding.PEM,
+        serialization.PrivateFormat.PKCS8,
+        serialization.NoEncryption())
+
+
+@dataclass
+class CertPair:
+    cert_path: str
+    key_path: str
+
+
+class CertAuthority:
+    """A CA on disk: ``<dir>/ca.crt`` + ``<dir>/ca.key``.
+
+    ``ensure`` is idempotent (loads an existing CA), so every component
+    of a restarted cluster keeps verifying the same chain.
+    """
+
+    def __init__(self, directory: str):
+        self.dir = directory
+        self.ca_cert_path = os.path.join(directory, "ca.crt")
+        self.ca_key_path = os.path.join(directory, "ca.key")
+        self._key = None
+        self._cert = None
+
+    # -- CA lifecycle -----------------------------------------------------
+
+    def ensure(self, common_name: str = "kubernetes-tpu-ca") -> "CertAuthority":
+        if os.path.exists(self.ca_cert_path) and os.path.exists(self.ca_key_path):
+            self._key = serialization.load_pem_private_key(
+                open(self.ca_key_path, "rb").read(), password=None)
+            self._cert = x509.load_pem_x509_certificate(
+                open(self.ca_cert_path, "rb").read())
+            return self
+        key = _new_key()
+        name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, common_name)])
+        now = datetime.datetime.now(datetime.timezone.utc)
+        cert = (x509.CertificateBuilder()
+                .subject_name(name).issuer_name(name)
+                .public_key(key.public_key())
+                .serial_number(x509.random_serial_number())
+                .not_valid_before(now - _ONE_DAY)
+                .not_valid_after(now + datetime.timedelta(days=3650))
+                .add_extension(x509.BasicConstraints(ca=True, path_length=None),
+                               critical=True)
+                .add_extension(x509.KeyUsage(
+                    digital_signature=True, key_cert_sign=True, crl_sign=True,
+                    content_commitment=False, key_encipherment=False,
+                    data_encipherment=False, key_agreement=False,
+                    encipher_only=False, decipher_only=False), critical=True)
+                .sign(key, hashes.SHA256()))
+        _write(self.ca_key_path, _key_pem(key), private=True)
+        _write(self.ca_cert_path, cert.public_bytes(serialization.Encoding.PEM))
+        self._key, self._cert = key, cert
+        return self
+
+    @property
+    def cert_pem(self) -> bytes:
+        return open(self.ca_cert_path, "rb").read()
+
+    def fingerprint(self) -> str:
+        """sha256 of the CA cert (DER) — the kubeadm
+        ``discovery-token-ca-cert-hash`` pin a joiner verifies."""
+        import hashlib
+        der = self._cert.public_bytes(serialization.Encoding.DER)
+        return "sha256:" + hashlib.sha256(der).hexdigest()
+
+    # -- issuance ---------------------------------------------------------
+
+    def _issue(self, subject: x509.Name, *, sans=None, client: bool,
+               days: int = 365):
+        key = _new_key()
+        now = datetime.datetime.now(datetime.timezone.utc)
+        eku = (ExtendedKeyUsageOID.CLIENT_AUTH if client
+               else ExtendedKeyUsageOID.SERVER_AUTH)
+        b = (x509.CertificateBuilder()
+             .subject_name(subject).issuer_name(self._cert.subject)
+             .public_key(key.public_key())
+             .serial_number(x509.random_serial_number())
+             .not_valid_before(now - _ONE_DAY)
+             .not_valid_after(now + datetime.timedelta(days=days))
+             .add_extension(x509.BasicConstraints(ca=False, path_length=None),
+                            critical=True)
+             .add_extension(x509.ExtendedKeyUsage([eku]), critical=False))
+        if sans:
+            alt = []
+            for san in sans:
+                try:
+                    alt.append(x509.IPAddress(ipaddress.ip_address(san)))
+                except ValueError:
+                    alt.append(x509.DNSName(san))
+            b = b.add_extension(x509.SubjectAlternativeName(alt), critical=False)
+        return key, b.sign(self._key, hashes.SHA256())
+
+    def issue_server_cert(self, name: str, sans: list[str],
+                          out_dir: str = "") -> CertPair:
+        subject = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, name)])
+        key, cert = self._issue(subject, sans=sans, client=False)
+        out = out_dir or self.dir
+        pair = CertPair(os.path.join(out, f"{name}.crt"),
+                        os.path.join(out, f"{name}.key"))
+        _write(pair.key_path, _key_pem(key), private=True)
+        _write(pair.cert_path, cert.public_bytes(serialization.Encoding.PEM))
+        return pair
+
+    def issue_client_cert(self, user: str, groups: list[str] = (),
+                          out_dir: str = "", filename: str = "") -> CertPair:
+        """CN = user, O = groups — the reference identity convention."""
+        attrs = [x509.NameAttribute(NameOID.COMMON_NAME, user)]
+        for g in groups:
+            attrs.append(x509.NameAttribute(NameOID.ORGANIZATION_NAME, g))
+        key, cert = self._issue(x509.Name(attrs), client=True)
+        out = out_dir or self.dir
+        base = filename or user.replace(":", "-").replace("/", "-")
+        pair = CertPair(os.path.join(out, f"{base}.crt"),
+                        os.path.join(out, f"{base}.key"))
+        _write(pair.key_path, _key_pem(key), private=True)
+        _write(pair.cert_path, cert.public_bytes(serialization.Encoding.PEM))
+        return pair
+
+    def sign_csr_pem(self, csr_pem: bytes, user: str,
+                     groups: list[str] = (), days: int = 365) -> bytes:
+        """Sign a CSR's PUBLIC KEY for the server-decided identity
+        (CN/O come from ``user``/``groups``, never from the CSR —
+        a joiner must not pick its own identity). Returns cert PEM.
+        The TLS-bootstrap end state: the private key never leaves the
+        node (reference: ``pkg/kubelet/certificate/kubelet.go:96``)."""
+        csr = x509.load_pem_x509_csr(csr_pem)
+        if not csr.is_signature_valid:
+            raise ValueError("CSR signature invalid")
+        attrs = [x509.NameAttribute(NameOID.COMMON_NAME, user)]
+        for g in groups:
+            attrs.append(x509.NameAttribute(NameOID.ORGANIZATION_NAME, g))
+        now = datetime.datetime.now(datetime.timezone.utc)
+        cert = (x509.CertificateBuilder()
+                .subject_name(x509.Name(attrs))
+                .issuer_name(self._cert.subject)
+                .public_key(csr.public_key())
+                .serial_number(x509.random_serial_number())
+                .not_valid_before(now - _ONE_DAY)
+                .not_valid_after(now + datetime.timedelta(days=days))
+                .add_extension(x509.BasicConstraints(ca=False, path_length=None),
+                               critical=True)
+                .add_extension(x509.ExtendedKeyUsage(
+                    [ExtendedKeyUsageOID.CLIENT_AUTH]), critical=False)
+                .sign(self._key, hashes.SHA256()))
+        return cert.public_bytes(serialization.Encoding.PEM)
+
+
+def make_csr_pem(key_path: str, common_name: str) -> bytes:
+    """Generate a key at ``key_path`` (0600) and return a CSR PEM for
+    it — the joiner half of the CSR flow."""
+    key = _new_key()
+    _write(key_path, _key_pem(key), private=True)
+    csr = (x509.CertificateSigningRequestBuilder()
+           .subject_name(x509.Name(
+               [x509.NameAttribute(NameOID.COMMON_NAME, common_name)]))
+           .sign(key, hashes.SHA256()))
+    return csr.public_bytes(serialization.Encoding.PEM)
+
+
+def identity_from_der(der: bytes) -> tuple[str, list[str]]:
+    """(user, groups) from a peer cert (DER) — CN and O values."""
+    cert = x509.load_der_x509_certificate(der)
+    cn = cert.subject.get_attributes_for_oid(NameOID.COMMON_NAME)
+    orgs = cert.subject.get_attributes_for_oid(NameOID.ORGANIZATION_NAME)
+    return (cn[0].value if cn else "", [o.value for o in orgs])
+
+
+def server_ssl_context(pair: CertPair, ca_path: str = ""):
+    """TLS-server context; with ``ca_path``, client certs are REQUESTED
+    and verified against the CA when presented (CERT_OPTIONAL — tokens
+    over TLS remain a valid way in, like the reference's authenticator
+    union), and a cert failing chain verification aborts the handshake."""
+    import ssl
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(pair.cert_path, pair.key_path)
+    if ca_path:
+        ctx.load_verify_locations(ca_path)
+        ctx.verify_mode = ssl.CERT_OPTIONAL
+    return ctx
+
+
+def client_ssl_context(ca_path: str, cert_path: str = "",
+                       key_path: str = ""):
+    """THE client-side TLS context (RESTClient and ktl join both use
+    it — one place for policy like hostname checking): trust the
+    cluster CA; with ``cert_path``, authenticate with an identity cert."""
+    import ssl
+    ctx = ssl.create_default_context(cafile=ca_path)
+    ctx.check_hostname = False  # CA-pinned; SANs may not cover aliases
+    if cert_path:
+        ctx.load_cert_chain(cert_path, key_path or None)
+    return ctx
+
+
+def fingerprint_pem(cert_pem: bytes) -> str:
+    """sha256:<hex> of a PEM cert's DER — computed LOCALLY by joiners
+    over the bytes they actually received, so a server cannot assert a
+    fingerprint for a CA it didn't send."""
+    import hashlib
+    cert = x509.load_pem_x509_certificate(cert_pem)
+    der = cert.public_bytes(serialization.Encoding.DER)
+    return "sha256:" + hashlib.sha256(der).hexdigest()

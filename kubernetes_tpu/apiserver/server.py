@@ -72,6 +72,9 @@ class APIServer:
         #: DNS is only reachable by same-host joiners — the composer
         #: should bind a routable host for true multi-host.
         self.dns_address = ""
+        #: CertAuthority when the cluster runs TLS (certs.py); enables
+        #: GET /bootstrap/v1/ca and the CSR-signing join endpoint.
+        self.cert_authority = None
         #: Requests slower than this log a slow-op line (SLO: 1s p99).
         self.slow_request_threshold = 1.0
         #: Max concurrent non-watch requests (reference: the
@@ -103,13 +106,31 @@ class APIServer:
     async def _middleware(self, request: web.Request, handler):
         # authn -> authz -> handler -> audit -> error mapping
         # (reference: DefaultBuildHandlerChain, compressed).
-        if self.tokens is not None and not request.path.startswith(("/healthz", "/readyz", "/version")):
-            auth = request.headers.get("Authorization", "")
-            token = auth[7:] if auth.startswith("Bearer ") else ""
-            user = (self.tokens.get(token) or self._sa_user(token)
-                    or self._bootstrap_user(token))
+        if self.tokens is not None and not request.path.startswith(
+                ("/healthz", "/readyz", "/version", "/bootstrap/v1/ca")):
+            # x509 first (reference: the authenticator union tries the
+            # request cert before bearer tokens, x509.go:83): a client
+            # cert that survived chain verification in the handshake
+            # carries CN=user / O=groups.
+            user = None
+            ssl_obj = (request.transport.get_extra_info("ssl_object")
+                       if request.transport is not None else None)
+            if ssl_obj is not None:
+                der = ssl_obj.getpeercert(binary_form=True)
+                if der:
+                    from .certs import identity_from_der
+                    cn, orgs = identity_from_der(der)
+                    if cn:
+                        user = cn
+                        request["cert_groups"] = set(orgs)
             if user is None:
-                return self._err(errors.UnauthorizedError("invalid or missing bearer token"))
+                auth = request.headers.get("Authorization", "")
+                token = auth[7:] if auth.startswith("Bearer ") else ""
+                user = (self.tokens.get(token) or self._sa_user(token)
+                        or self._bootstrap_user(token))
+            if user is None:
+                return self._err(errors.UnauthorizedError(
+                    "no valid client certificate or bearer token"))
             request["user"] = user
         attrs = self._attributes(request)
         # Long-running exemption from max-in-flight applies only to
@@ -253,7 +274,7 @@ class APIServer:
         verb = verb_for_request(request.method, bool(name),
                                 request.query.get("watch") in ("1", "true"))
         user = request.get("user", "system:anonymous")
-        groups = self._groups_for(user)
+        groups = self._groups_for(user) | request.get("cert_groups", set())
         resource = f"{plural}/{sub}" if sub else plural
         return Attributes(user, groups, verb, resource,
                           request.match_info.get("namespace", ""), name)
@@ -307,6 +328,11 @@ class APIServer:
         # node credential (bootstrap.py; the CSR-signing step's end
         # state, authz'd to system:bootstrappers explicitly below).
         r.add_post("/bootstrap/v1/node-credentials", self._node_credentials)
+        # TLS bootstrap (kubeadm discovery + kubelet TLS bootstrap):
+        # the CA cert is public (joiners verify it against a sha256
+        # pin); CSR signing needs a bootstrap token.
+        r.add_get("/bootstrap/v1/ca", self._serve_ca)
+        r.add_post("/bootstrap/v1/sign-csr", self._sign_csr)
         base = "/api/{group}/{version}"
         for prefix in (base + "/namespaces/{namespace}/{plural}", base + "/{plural}"):
             r.add_get(prefix, self._list_or_watch)
@@ -331,7 +357,7 @@ class APIServer:
         from ..api import rbac as rbacapi
         from .bootstrap import GROUP_BOOTSTRAPPERS, mint_node_credential
         user = request.get("user", "system:anonymous")
-        groups = self._groups_for(user)
+        groups = self._groups_for(user) | request.get("cert_groups", set())
         def record(code: int, name: str = "") -> None:
             # Credential minting MUST be auditable — this is a
             # non-resource path, so the middleware's attrs-gated audit
@@ -364,6 +390,72 @@ class APIServer:
         if self.dns_address:
             cred["dns_server"] = self.dns_address
         return web.json_response(cred)
+
+    async def _serve_ca(self, request):
+        """Public CA cert + fingerprint (kubeadm cluster-info analog:
+        joiners verify the cert against an out-of-band sha256 pin, so
+        serving it needs no authn — see middleware exemption)."""
+        if self.cert_authority is None:
+            raise errors.NotFoundError("cluster does not run TLS")
+        return web.json_response({
+            "ca_pem": self.cert_authority.cert_pem.decode(),
+            "fingerprint": self.cert_authority.fingerprint(),
+        })
+
+    async def _sign_csr(self, request):
+        """POST {"node_name", "csr_pem"} -> {"cert_pem"}: sign a
+        joiner's CSR as the node identity (CN/O chosen server-side —
+        the CSR only contributes a public key). Gated exactly like
+        node-credentials: bootstrap token or cluster admin. The private
+        key never crosses the wire (kubelet.go:96 TLS bootstrap)."""
+        from ..api import rbac as rbacapi
+        from .bootstrap import (GROUP_BOOTSTRAPPERS, NODES_NAMESPACE,
+                                mint_node_credential)
+        if self.cert_authority is None:
+            raise errors.NotFoundError("cluster does not run TLS")
+        user = request.get("user", "system:anonymous")
+        groups = self._groups_for(user) | request.get("cert_groups", set())
+        if self.tokens is not None and GROUP_BOOTSTRAPPERS not in groups \
+                and rbacapi.GROUP_MASTERS not in groups:
+            return self._err(errors.ForbiddenError(
+                f"user {user!r} is not a bootstrapper"))
+        def record(code: int, name: str = "") -> None:
+            if self.audit is not None:
+                self.audit.record(user=user, verb="sign", resource="csr",
+                                  namespace=NODES_NAMESPACE, name=name,
+                                  code=code, latency_seconds=0.0)
+        try:
+            body = await request.json()
+            node_name = body.get("node_name", "")
+            csr_pem = body.get("csr_pem", "").encode()
+        except Exception:  # noqa: BLE001
+            record(400)
+            return self._err(errors.InvalidError("body must be JSON"))
+        # Validate the CSR BEFORE any durable mutation: a garbage CSR
+        # must not leave behind a credential Secret + ClusterRoleBinding
+        # nobody received (and must not audit as a success).
+        try:
+            from cryptography import x509 as _x509
+            _x509.load_pem_x509_csr(csr_pem)
+        except Exception as e:  # noqa: BLE001
+            record(400, node_name)
+            return self._err(errors.InvalidError(f"bad CSR: {e}"))
+        # Reuse the credential mint for the RBAC objects + name checks;
+        # the cert carries the same username so bindings apply as-is.
+        try:
+            cred = mint_node_credential(self.registry, node_name)
+            cert_pem = self.cert_authority.sign_csr_pem(
+                csr_pem, user=cred["user"])
+        except errors.StatusError as e:
+            record(e.code, node_name)
+            raise
+        except ValueError as e:
+            record(400, node_name)
+            return self._err(errors.InvalidError(f"bad CSR: {e}"))
+        record(200, node_name)
+        return web.json_response({"cert_pem": cert_pem.decode(),
+                                  "user": cred["user"],
+                                  "node_name": node_name})
 
     async def _version(self, request):
         from .. import __version__
@@ -705,16 +797,23 @@ class APIServer:
 
     # -- lifecycle --------------------------------------------------------
 
-    async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+    async def start(self, host: str = "127.0.0.1", port: int = 0,
+                    ssl_context=None) -> int:
+        """``ssl_context``: a server context from
+        ``certs.server_ssl_context`` makes this an HTTPS-only endpoint
+        with x509 client-cert authn (plaintext connections are refused
+        by TLS itself — the reference's secure port)."""
         self._runner = web.AppRunner(self.app, access_log=None)
         await self._runner.setup()
         # Short shutdown grace: long-lived watch streams would otherwise
         # hold cleanup for the default 60s (they are safely cancellable —
         # clients relist on reconnect).
-        site = web.TCPSite(self._runner, host, port, shutdown_timeout=1.0)
+        site = web.TCPSite(self._runner, host, port, shutdown_timeout=1.0,
+                           ssl_context=ssl_context)
         await site.start()
         self.port = site._server.sockets[0].getsockname()[1]  # type: ignore[union-attr]
-        log.info("apiserver listening on %s:%d", host, self.port)
+        log.info("apiserver listening on %s:%d (%s)", host, self.port,
+                 "https" if ssl_context else "http")
         return self.port
 
     async def stop(self) -> None:

@@ -98,8 +98,25 @@ def load_token(args) -> str:
     return ""
 
 
+def load_tls(args) -> dict:
+    """{ca, client_cert, client_key} for the recorded server (the
+    admin.conf role: ktl up writes them, every command trusts them)."""
+    out = {"ca_file": os.environ.get("KTL_CA", "")}
+    try:
+        with open(DEFAULT_CONFIG) as f:
+            cfg = json.load(f)
+        if cfg.get("server") == load_server(args):
+            out["ca_file"] = out["ca_file"] or cfg.get("ca", "")
+            out["client_cert"] = cfg.get("client_cert", "")
+            out["client_key"] = cfg.get("client_key", "")
+    except (OSError, json.JSONDecodeError, SystemExit):
+        pass
+    return out
+
+
 def make_client(args) -> RESTClient:
-    return RESTClient(load_server(args), token=load_token(args))
+    return RESTClient(load_server(args), token=load_token(args),
+                      **load_tls(args))
 
 
 # -- manifest loading (resource/builder.go analog) -------------------------
@@ -441,10 +458,9 @@ async def cmd_top(args) -> int:
 async def cmd_api_resources(args) -> int:
     client = make_client(args)
     try:
-        import aiohttp
-        async with aiohttp.ClientSession() as s:
-            async with s.get(f"{client.base_url}/apis") as r:
-                data = await r.json()
+        # The client's own session: it carries the cluster CA trust.
+        async with client._sess().get(f"{client.base_url}/apis") as r:
+            data = await r.json()
         rows = [[spec["name"], spec["api_version"],
                  str(spec["namespaced"]), spec["kind"]]
                 for spec in sorted(data["resources"], key=lambda d: d["name"])]
@@ -463,10 +479,8 @@ async def cmd_version(args) -> int:
     except SystemExit:
         return 0
     try:
-        import aiohttp
-        async with aiohttp.ClientSession() as s:
-            async with s.get(f"{client.base_url}/version") as r:
-                print("server:", json.dumps(await r.json()))
+        async with client._sess().get(f"{client.base_url}/version") as r:
+            print("server:", json.dumps(await r.json()))
     except Exception:  # noqa: BLE001
         print("server: unreachable")
     finally:
@@ -504,14 +518,20 @@ async def cmd_up(args) -> int:
         host=cfg.host, port=cfg.port, durable=cfg.durable,
         tokens=tokens, user_groups=user_groups,
         authorization_mode=cfg.authorization_mode,
-        audit_log=cfg.audit_log)
+        audit_log=cfg.audit_log,
+        tls=not getattr(args, "insecure", False))
     base = await cluster.start()
     os.makedirs(os.path.dirname(DEFAULT_CONFIG), exist_ok=True)
     # 0600 from birth — the admin token must never be world-readable,
     # even for a moment.
+    record = {"server": base, "token": admin_token}
+    if cluster.tls:
+        record["ca"] = cluster.ca_file
+        record["client_cert"] = cluster.admin_cert.cert_path
+        record["client_key"] = cluster.admin_cert.key_path
     fd = os.open(DEFAULT_CONFIG, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
     with os.fdopen(fd, "w") as f:
-        json.dump({"server": base, "token": admin_token}, f)
+        json.dump(record, f)
     # O_CREAT's mode only applies to NEW files; a pre-existing config
     # from an older run may be 0644 — tighten it regardless.
     os.chmod(DEFAULT_CONFIG, 0o600)
@@ -709,13 +729,81 @@ async def cmd_join(args) -> int:
 
     server = load_server(args)
     node_name = args.name or socketlib.gethostname().lower()
+    # Private by default: pod volumes (decoded Secrets) land here —
+    # never a predictable world-readable /tmp path.
+    node_dir = args.data_dir or os.path.join(
+        os.path.expanduser("~/.ktl"), "nodes", node_name)
+    os.makedirs(node_dir, mode=0o700, exist_ok=True)
+    os.chmod(node_dir, 0o700)  # pre-existing dirs tightened too
 
-    # 1. Bootstrap-token -> durable node credential.
+    # 0. TLS discovery (kubeadm discovery-token flow): fetch the
+    # cluster CA over an unverified-yet-encrypted channel, check it
+    # against the --ca-hash pin, THEN trust it for everything after.
+    ca_file = client_cert = client_key = ""
+    if server.startswith("https://"):
+        from ..apiserver.certs import (client_ssl_context, fingerprint_pem,
+                                       make_csr_pem)
+        if not args.ca_hash and not args.insecure_skip_ca_verification:
+            # kubeadm refuses unpinned discovery without an explicit
+            # opt-in; silent trust-on-first-use would hand the
+            # bootstrap token to any MITM on the join path.
+            print("ktl join over https needs --ca-hash sha256:<hex> "
+                  "(printed by `ktl up`), or the explicit "
+                  "--insecure-skip-ca-verification opt-in",
+                  file=sys.stderr)
+            return 1
+        async with aiohttp.ClientSession(
+                connector=aiohttp.TCPConnector(ssl=False)) as sess:
+            resp = await sess.get(f"{server}/bootstrap/v1/ca")
+            if resp.status != 200:
+                print(f"CA fetch failed ({resp.status})", file=sys.stderr)
+                return 1
+            info = await resp.json()
+        # Hash what we RECEIVED — a server-asserted fingerprint would
+        # let a MITM echo the real cluster's pin for its own CA.
+        fp = fingerprint_pem(info["ca_pem"].encode())
+        if args.ca_hash and args.ca_hash != fp:
+            print(f"CA fingerprint mismatch: received={fp} "
+                  f"pin={args.ca_hash} — refusing to join",
+                  file=sys.stderr)
+            return 1
+        if not args.ca_hash:
+            print(f"WARNING: trusting cluster CA without verification "
+                  f"(--insecure-skip-ca-verification): {fp}")
+        ca_file = os.path.join(node_dir, "ca.crt")
+        with open(ca_file, "w") as f:
+            f.write(info["ca_pem"])
+        # TLS bootstrap: key stays local, only the CSR travels.
+        client_key = os.path.join(node_dir, "node.key")
+        csr = make_csr_pem(client_key, f"system:node:{node_name}")
+        join_ctx = client_ssl_context(ca_file)
+        async with aiohttp.ClientSession() as sess:
+            resp = await sess.post(
+                f"{server}/bootstrap/v1/sign-csr",
+                json={"node_name": node_name, "csr_pem": csr.decode()},
+                headers={"Authorization": f"Bearer {args.token}"},
+                ssl=join_ctx)
+            if resp.status != 200:
+                print(f"CSR signing failed ({resp.status}): "
+                      f"{(await resp.text())[:200]}", file=sys.stderr)
+                return 1
+            signed = await resp.json()
+        client_cert = os.path.join(node_dir, "node.crt")
+        with open(client_cert, "w") as f:
+            f.write(signed["cert_pem"])
+        print(f"node certificate minted for {signed['user']}")
+
+    # 1. Bootstrap-token -> durable node credential (token beside the
+    # cert: agents authenticate with either; the response also carries
+    # the cluster DNS address).
+    ssl_arg = {}
+    if ca_file:
+        ssl_arg["ssl"] = join_ctx
     async with aiohttp.ClientSession() as sess:
         resp = await sess.post(
             f"{server}/bootstrap/v1/node-credentials",
             json={"node_name": node_name},
-            headers={"Authorization": f"Bearer {args.token}"})
+            headers={"Authorization": f"Bearer {args.token}"}, **ssl_arg)
         if resp.status != 200:
             # Body may be anything (older server's 404 page, proxy
             # error) — never crash on it.
@@ -730,14 +818,9 @@ async def cmd_join(args) -> int:
     cred = body["token"]
     print(f"joined as {body['user']}")
 
-    # 2. Run the node agent with the minted identity.
-    client = RESTClient(server, token=cred)
-    # Private by default: pod volumes (decoded Secrets) land here —
-    # never a predictable world-readable /tmp path.
-    node_dir = args.data_dir or os.path.join(
-        os.path.expanduser("~/.ktl"), "nodes", node_name)
-    os.makedirs(node_dir, mode=0o700, exist_ok=True)
-    os.chmod(node_dir, 0o700)  # pre-existing dirs tightened too
+    # 2. Run the node agent with the minted identity (cert-first).
+    client = RESTClient(server, token=cred, ca_file=ca_file,
+                        client_cert=client_cert, client_key=client_key)
     runtime = ProcessRuntime(node_dir)
     dm = None
     if args.real_tpu or args.tpu_chips:
@@ -875,6 +958,13 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--real-tpu", action="store_true", default=False,
                     help="probe real TPU hardware")
     sp.add_argument("--data-dir", default="")
+    sp.add_argument("--ca-hash", default="",
+                    help="sha256:<hex> pin for the cluster CA "
+                         "(kubeadm discovery-token-ca-cert-hash)")
+    sp.add_argument("--insecure-skip-ca-verification", action="store_true",
+                    default=False,
+                    help="join without a CA pin (MITM-exposed; the "
+                         "kubeadm unsafe-skip flag analog)")
 
     sp = add("up", cmd_up, help="run a single-process cluster")
     # SUPPRESS defaults: flag PRESENCE marks it explicitly passed, so
@@ -885,6 +975,9 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--config", default="",
                     help="ClusterConfig YAML (componentconfig analog); "
                          "explicit flags override file values")
+    sp.add_argument("--insecure", action="store_true", default=False,
+                    help="serve plaintext HTTP (default: TLS-only from "
+                         "a cluster CA under <data-dir>/pki)")
     sp.add_argument("--nodes", type=int, default=S)
     sp.add_argument("--tpu-chips", type=int, default=S,
                     help="stub chips per node")

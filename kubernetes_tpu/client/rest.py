@@ -116,9 +116,18 @@ class _RESTWatch(WatchStream):
 
 
 class RESTClient(Client):
-    def __init__(self, base_url: str, token: str = ""):
+    def __init__(self, base_url: str, token: str = "",
+                 ca_file: str = "", client_cert: str = "",
+                 client_key: str = ""):
+        """``ca_file`` makes https URLs verify against the cluster CA;
+        ``client_cert``/``client_key`` authenticate with an x509
+        identity cert (CN=user, O=groups) instead of / beside a token."""
         self.base_url = base_url.rstrip("/")
         self._headers = {"Authorization": f"Bearer {token}"} if token else {}
+        self._ssl = None
+        if ca_file:
+            from ..apiserver.certs import client_ssl_context
+            self._ssl = client_ssl_context(ca_file, client_cert, client_key)
         self._session: Optional[aiohttp.ClientSession] = None
         #: Discovery-learned resources (CRDs): plural -> (gv, namespaced).
         #: TTL'd so CRD deletion/recreation is picked up (the static
@@ -130,7 +139,10 @@ class RESTClient(Client):
 
     def _sess(self) -> aiohttp.ClientSession:
         if self._session is None or self._session.closed:
-            self._session = aiohttp.ClientSession(headers=self._headers)
+            connector = (aiohttp.TCPConnector(ssl=self._ssl)
+                         if self._ssl is not None else None)
+            self._session = aiohttp.ClientSession(headers=self._headers,
+                                                  connector=connector)
         return self._session
 
     def _url_for(self, api_version: str, plural: str, namespace: str,

@@ -108,7 +108,12 @@ class LocalCluster:
                  heartbeat_interval: float = 5.0,
                  authorization_mode: str = "AlwaysAllow",
                  user_groups: Optional[dict] = None,
-                 audit_log: str = ""):
+                 audit_log: str = "",
+                 tls: bool = True):
+        """``tls=True`` (default): the apiserver serves HTTPS only from
+        a cluster CA minted under ``<data_dir>/pki`` — plaintext
+        connections are refused by the handshake itself; pass
+        ``tls=False`` for the reference's insecure-port mode."""
         self.data_dir = data_dir or tempfile.mkdtemp(prefix="ktpu-cluster-")
         self.node_specs = nodes if nodes is not None else [NodeSpec(name="node-0")]
         self.host = host
@@ -120,6 +125,10 @@ class LocalCluster:
         self.authorization_mode = authorization_mode
         self.user_groups = user_groups
         self.audit_log = audit_log
+        self.tls = tls
+        self.ca = None
+        self.ca_file = ""
+        self.admin_cert = None  # CertPair (CN=admin, O=system:masters)
 
         self.registry: Optional[Registry] = None
         self.server: Optional[APIServer] = None
@@ -160,8 +169,23 @@ class LocalCluster:
             self.registry, tokens=self.tokens,
             authorizer=make_authorizer(self.authorization_mode, self.registry),
             user_groups=self.user_groups, audit=audit)
-        port = await self.server.start(self.host, self._port)
-        self.base_url = f"http://{self.host}:{port}"
+        ssl_ctx = None
+        if self.tls:
+            from ..apiserver.certs import (CertAuthority,
+                                           server_ssl_context)
+            pki = os.path.join(self.data_dir, "pki")
+            self.ca = CertAuthority(pki).ensure()
+            sans = {self.host, "localhost", "127.0.0.1"}
+            pair = self.ca.issue_server_cert("apiserver", sorted(sans))
+            self.admin_cert = self.ca.issue_client_cert(
+                "admin", ["system:masters"], out_dir=pki)
+            self.ca_file = self.ca.ca_cert_path
+            self.server.cert_authority = self.ca
+            ssl_ctx = server_ssl_context(pair, self.ca.ca_cert_path)
+        port = await self.server.start(self.host, self._port,
+                                       ssl_context=ssl_ctx)
+        scheme = "https" if self.tls else "http"
+        self.base_url = f"{scheme}://{self.host}:{port}"
 
         self.scheduler = Scheduler(local)
         await self.scheduler.start()
@@ -187,7 +211,8 @@ class LocalCluster:
         name = spec.name or f"node-{index}"
         node_dir = os.path.join(self.data_dir, "nodes", name)
         token = next(iter(self.tokens), "") if self.tokens else ""
-        client = RESTClient(self.base_url, token=token)
+        client = RESTClient(self.base_url, token=token,
+                            ca_file=self.ca_file)
 
         plugin: Optional[StubTpuPlugin] = None
         device_manager: Optional[DeviceManager] = None
@@ -278,6 +303,17 @@ class LocalCluster:
             self.registry.store.snapshot()
 
     # -- conveniences ------------------------------------------------------
+
+    def make_client(self, token: str = "") -> RESTClient:
+        """A RESTClient wired for this cluster's transport: CA-trusting
+        HTTPS + the admin identity cert under TLS (kubeadm admin.conf
+        analog), plain HTTP otherwise."""
+        if not self.tls:
+            return RESTClient(self.base_url, token=token)
+        return RESTClient(
+            self.base_url, token=token, ca_file=self.ca_file,
+            client_cert=self.admin_cert.cert_path if not token else "",
+            client_key=self.admin_cert.key_path if not token else "")
 
     def local_client(self) -> LocalClient:
         assert self.registry is not None

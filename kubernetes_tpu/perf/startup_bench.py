@@ -26,7 +26,7 @@ async def run_startup(n_pods: int = 30, n_nodes: int = 2,
         nodes=[NodeSpec(name=f"bench-{i}") for i in range(n_nodes)],
         status_interval=1.0, heartbeat_interval=2.0)
     url = await cluster.start()
-    client = RESTClient(url)
+    client = cluster.make_client()
     created_at: dict[str, float] = {}
     running_at: dict[str, float] = {}
     stream = None

@@ -36,11 +36,14 @@ async def ktl_out(args: list[str], server: str) -> tuple[int, str]:
     return rc, buf.getvalue()
 
 
-async def test_ktl_commands_full_stack(tmp_path):
+async def test_ktl_commands_full_stack(tmp_path, monkeypatch):
     cluster = LocalCluster(data_dir=str(tmp_path),
                            nodes=[NodeSpec(name="tpu-0", tpu_chips=4)],
                            status_interval=0.3, heartbeat_interval=0.3)
     base = await cluster.start()
+    # ktl discovers the cluster CA the way an operator would ($KTL_CA /
+    # the ktl-up config file); in-process tests use the env route.
+    monkeypatch.setenv("KTL_CA", cluster.ca_file)
     try:
         await cluster.wait_for_nodes_ready(timeout=20)
 

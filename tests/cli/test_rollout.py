@@ -25,9 +25,10 @@ def mk_deploy(image):
                     command=["sleep", "60"])]))))
 
 
-async def test_rollout_status_history_undo(tmp_path):
+async def test_rollout_status_history_undo(tmp_path, monkeypatch):
     cluster = LocalCluster(data_dir=str(tmp_path), nodes=[NodeSpec()])
     server = await cluster.start()
+    monkeypatch.setenv("KTL_CA", cluster.ca_file)  # see test_ktl.py
     client = cluster.local_client()
     try:
         await client.create(mk_deploy("img:v1"))

@@ -49,7 +49,7 @@ async def test_scheduler_and_controller_crash_mid_rollout():
     cluster = LocalCluster(nodes=[NodeSpec(name="n0"), NodeSpec(name="n1")],
                            status_interval=0.5, heartbeat_interval=0.5)
     url = await cluster.start()
-    client = RESTClient(url)
+    client = cluster.make_client()
     try:
         await cluster.wait_for_nodes_ready(20)
         await client.create(mk_deployment(replicas=4))
@@ -98,7 +98,7 @@ async def test_durable_cluster_restart_recovers_workloads(tmp_path):
                            durable=True, status_interval=0.5,
                            heartbeat_interval=0.5)
     url = await cluster.start()
-    client = RESTClient(url)
+    client = cluster.make_client()
     try:
         await cluster.wait_for_nodes_ready(20)
         await client.create(mk_deployment(name="keep", replicas=2))
@@ -115,7 +115,7 @@ async def test_durable_cluster_restart_recovers_workloads(tmp_path):
                             durable=True, status_interval=0.5,
                             heartbeat_interval=0.5)
     url2 = await cluster2.start()
-    client2 = RESTClient(url2)
+    client2 = cluster2.make_client()
     try:
         dep = await client2.get("deployments", "default", "keep")
         assert dep.metadata.uid == uid_before, "identity lost across restart"

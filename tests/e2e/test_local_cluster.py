@@ -43,7 +43,7 @@ async def test_tpu_pod_end_to_end_over_http(tmp_path):
         NodeSpec(name="tpu-0", tpu_chips=4),
     ])
     await cluster.start()
-    client = RESTClient(cluster.base_url)
+    client = cluster.make_client()
     try:
         await cluster.wait_for_nodes_ready(timeout=20)
         pod = t.Pod(
@@ -82,7 +82,7 @@ async def test_deployment_reconciles_over_http(tmp_path):
     cluster = fast_cluster(tmp_path, [NodeSpec(name="w-0"),
                                       NodeSpec(name="w-1")])
     await cluster.start()
-    client = RESTClient(cluster.base_url)
+    client = cluster.make_client()
     try:
         await cluster.wait_for_nodes_ready(timeout=20)
         dep = Deployment(

@@ -87,7 +87,7 @@ async def test_gang_job_multiprocess_jax_distributed(tmp_path):
     cluster = fast_cluster(tmp_path / "cluster",
                            [NodeSpec(name=f"w-{i}") for i in range(N_WORKERS)])
     await cluster.start()
-    client = RESTClient(cluster.base_url)
+    client = cluster.make_client()
     try:
         await cluster.wait_for_nodes_ready(timeout=20)
         await client.create(_headless_service("train-svc"))
@@ -121,7 +121,7 @@ async def test_gang_kill_midrun_recovers_and_resumes(tmp_path):
     cluster = fast_cluster(tmp_path / "cluster",
                            [NodeSpec(name=f"w-{i}") for i in range(N_WORKERS)])
     await cluster.start()
-    client = RESTClient(cluster.base_url)
+    client = cluster.make_client()
     try:
         await cluster.wait_for_nodes_ready(timeout=20)
         await client.create(_headless_service("train-svc"))

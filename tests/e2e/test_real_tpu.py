@@ -33,7 +33,7 @@ async def test_vector_add_on_real_chip(tmp_path):
         nodes=[NodeSpec(name="tpu-vm-0", real_tpu=True)],
         status_interval=0.3, heartbeat_interval=0.3)
     await cluster.start()
-    client = RESTClient(cluster.base_url)
+    client = cluster.make_client()
     try:
         await cluster.wait_for_nodes_ready(timeout=30)
         node = await client.get("nodes", "", "tpu-vm-0")

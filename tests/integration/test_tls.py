@@ -1,0 +1,210 @@
+"""TLS + x509 authn end-to-end (real sockets, real handshakes).
+
+Reference: the apiserver secure port with x509 client-cert authn
+(``staging/src/k8s.io/apiserver/pkg/authentication/request/x509/
+x509.go:83``), kubeadm's cert phase, and the kubelet TLS bootstrap
+(``pkg/kubelet/certificate/kubelet.go:96``).
+"""
+import ssl
+
+import aiohttp
+import pytest
+
+from kubernetes_tpu.api import errors, rbac, types as t
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.apiserver import bootstrap
+from kubernetes_tpu.apiserver.authz import make_authorizer
+from kubernetes_tpu.apiserver.certs import (CertAuthority, make_csr_pem,
+                                            server_ssl_context)
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.client.rest import RESTClient
+
+
+async def tls_server(tmp_path):
+    ca = CertAuthority(str(tmp_path / "pki")).ensure()
+    pair = ca.issue_server_cert("apiserver", ["127.0.0.1", "localhost"])
+    srv = APIServer(tokens={},
+                    authorizer=make_authorizer("RBAC", None))
+    srv.authorizer = make_authorizer("RBAC", srv.registry)
+    srv.cert_authority = ca
+    srv.registry.create(t.Namespace(metadata=ObjectMeta(name="default")))
+    srv.registry.create(t.Namespace(metadata=ObjectMeta(name="kube-system")))
+    port = await srv.start(
+        ssl_context=server_ssl_context(pair, ca.ca_cert_path))
+    return srv, ca, f"https://127.0.0.1:{port}"
+
+
+async def test_plaintext_refused_and_cert_identity(tmp_path):
+    srv, ca, base = await tls_server(tmp_path)
+    admin = ca.issue_client_cert("admin", ["system:masters"],
+                                 out_dir=str(tmp_path / "pki"))
+    try:
+        # 1. Plaintext HTTP against the TLS port: refused by TLS itself.
+        with pytest.raises(aiohttp.ClientError):
+            async with aiohttp.ClientSession() as s:
+                await s.get(base.replace("https://", "http://") + "/apis")
+
+        # 2. TLS without any credential: 401.
+        anon = RESTClient(base, ca_file=ca.ca_cert_path)
+        with pytest.raises(errors.UnauthorizedError):
+            await anon.list("pods", "default")
+        await anon.close()
+
+        # 3. Admin client cert: CN=admin + O=system:masters -> full RBAC.
+        c = RESTClient(base, ca_file=ca.ca_cert_path,
+                       client_cert=admin.cert_path, client_key=admin.key_path)
+        pods, _ = await c.list("pods", "default")
+        assert pods == []
+        created = await c.create(t.Secret(metadata=ObjectMeta(
+            name="s1", namespace="kube-system")))
+        assert created.metadata.uid
+        await c.close()
+
+        # 4. A cert identity WITHOUT privileged groups is authenticated
+        # but not authorized (authn != authz).
+        bob = ca.issue_client_cert("bob", out_dir=str(tmp_path / "pki"))
+        c2 = RESTClient(base, ca_file=ca.ca_cert_path,
+                        client_cert=bob.cert_path, client_key=bob.key_path)
+        with pytest.raises(errors.ForbiddenError):
+            await c2.list("secrets", "kube-system")
+        await c2.close()
+
+        # 5. A cert from a DIFFERENT CA fails the handshake outright.
+        other = CertAuthority(str(tmp_path / "pki2")).ensure()
+        evil = other.issue_client_cert("admin", ["system:masters"])
+        ctx = ssl.create_default_context(cafile=ca.ca_cert_path)
+        ctx.check_hostname = False
+        ctx.load_cert_chain(evil.cert_path, evil.key_path)
+        with pytest.raises(aiohttp.ClientError):
+            async with aiohttp.ClientSession(
+                    connector=aiohttp.TCPConnector(ssl=ctx)) as s:
+                async with s.get(f"{base}/api/core/v1/namespaces/default/pods") as r:
+                    await r.read()
+    finally:
+        await srv.stop()
+
+
+async def test_csr_tls_bootstrap_flow(tmp_path):
+    """kubeadm-join end state with CERTS: fetch CA (pin-verified), CSR
+    signed via bootstrap token, node identity works over mTLS with node
+    RBAC — and the private key never left this 'node'."""
+    srv, ca, base = await tls_server(tmp_path)
+    token = bootstrap.generate_token()
+    srv.registry.create(bootstrap.make_bootstrap_secret(token))
+    try:
+        # 1. Fetch the CA anonymously over TLS; verify the pin.
+        async with aiohttp.ClientSession(
+                connector=aiohttp.TCPConnector(ssl=False)) as s:
+            async with s.get(f"{base}/bootstrap/v1/ca") as r:
+                assert r.status == 200
+                info = await r.json()
+        assert info["fingerprint"] == ca.fingerprint()
+        ca_file = str(tmp_path / "fetched-ca.crt")
+        open(ca_file, "w").write(info["ca_pem"])
+
+        # 2. Generate key locally, send only the CSR with the token.
+        key_path = str(tmp_path / "node.key")
+        csr = make_csr_pem(key_path, "system:node:worker-1")
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                    f"{base}/bootstrap/v1/sign-csr",
+                    json={"node_name": "worker-1", "csr_pem": csr.decode()},
+                    headers={"Authorization": f"Bearer {token}"},
+                    ssl=ssl.create_default_context(cafile=ca_file)
+                    ) as r:
+                assert r.status == 200, await r.text()
+                out = await r.json()
+        cert_path = str(tmp_path / "node.crt")
+        open(cert_path, "w").write(out["cert_pem"])
+
+        # 3. The minted identity does node work over mTLS...
+        node = RESTClient(base, ca_file=ca_file,
+                          client_cert=cert_path, client_key=key_path)
+        created = await node.create(t.Node(metadata=ObjectMeta(name="worker-1")))
+        assert created.metadata.name == "worker-1"
+        # ... but NodeRestriction-lite still applies (no kube-system
+        # secrets), proving cert groups flow into RBAC attributes.
+        with pytest.raises(errors.ForbiddenError):
+            await node.list("secrets", "kube-system")
+        await node.close()
+
+        # 4. A garbage CSR is a 400, not a signed cert — and it must
+        # not leave a durable credential/RBAC trail behind.
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                    f"{base}/bootstrap/v1/sign-csr",
+                    json={"node_name": "worker-2", "csr_pem": "junk"},
+                    headers={"Authorization": f"Bearer {token}"},
+                    ssl=ssl.create_default_context(cafile=ca_file)) as r:
+                assert r.status in (400, 422), await r.text()
+        with pytest.raises(errors.NotFoundError):
+            srv.registry.get("serviceaccounts", "kube-system", "node-worker-2")
+
+        # 5. No token, no signature.
+        csr2 = make_csr_pem(str(tmp_path / "n2.key"), "x")
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                    f"{base}/bootstrap/v1/sign-csr",
+                    json={"node_name": "worker-3", "csr_pem": csr2.decode()},
+                    ssl=ssl.create_default_context(cafile=ca_file)) as r:
+                assert r.status == 401
+    finally:
+        await srv.stop()
+
+
+async def test_agent_runs_cert_only_over_mtls(tmp_path):
+    """The TLS-bootstrap END STATE: a node agent authenticating with
+    ONLY its minted cert (no bearer token anywhere) registers,
+    heartbeats to Ready, and its RBAC node powers apply."""
+    import asyncio
+
+    from kubernetes_tpu.node.agent import NodeAgent
+    from kubernetes_tpu.node.runtime import FakeRuntime
+
+    srv, ca, base = await tls_server(tmp_path)
+    token = bootstrap.generate_token()
+    srv.registry.create(bootstrap.make_bootstrap_secret(token))
+    try:
+        key_path = str(tmp_path / "agent.key")
+        csr = make_csr_pem(key_path, "ignored")
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                    f"{base}/bootstrap/v1/sign-csr",
+                    json={"node_name": "joined-tls", "csr_pem": csr.decode()},
+                    headers={"Authorization": f"Bearer {token}"},
+                    ssl=ssl.create_default_context(
+                        cafile=ca.ca_cert_path)) as r:
+                assert r.status == 200, await r.text()
+                out = await r.json()
+        cert_path = str(tmp_path / "agent.crt")
+        open(cert_path, "w").write(out["cert_pem"])
+
+        client = RESTClient(base, ca_file=ca.ca_cert_path,
+                            client_cert=cert_path, client_key=key_path)
+        agent = NodeAgent(client, "joined-tls", FakeRuntime(),
+                          status_interval=0.3, heartbeat_interval=0.3,
+                          pleg_interval=0.1, server_port=None)
+        admin = ca.issue_client_cert("root", ["system:masters"],
+                                     out_dir=str(tmp_path / "pki"))
+        root = RESTClient(base, ca_file=ca.ca_cert_path,
+                          client_cert=admin.cert_path,
+                          client_key=admin.key_path)
+        await agent.start()
+        try:
+            ready = None
+            for _ in range(100):
+                await asyncio.sleep(0.1)
+                try:
+                    node = await root.get("nodes", "", "joined-tls")
+                except errors.NotFoundError:
+                    continue
+                ready = t.get_node_condition(node.status, t.NODE_READY)
+                if ready and ready.status == "True":
+                    break
+            assert ready and ready.status == "True"
+        finally:
+            await agent.stop()
+            await client.close()
+            await root.close()
+    finally:
+        await srv.stop()

@@ -111,7 +111,7 @@ async def test_local_cluster_via_cri(tmp_path):
                            data_dir=str(tmp_path),
                            status_interval=0.5, heartbeat_interval=1.0)
     url = await cluster.start()
-    client = RESTClient(url)
+    client = cluster.make_client()
     try:
         await cluster.wait_for_nodes_ready(20)
         pod = t.Pod(metadata=ObjectMeta(name="p", namespace="default"),
