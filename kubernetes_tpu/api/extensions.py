@@ -237,3 +237,61 @@ def validate_apiservice_update(new: APIService, old: APIService) -> None:
 DEFAULT_SCHEME.register(EXTENSIONS_V1, "CustomResourceDefinition",
                         CustomResourceDefinition)
 DEFAULT_SCHEME.register(AGGREGATION_V1, "APIService", APIService)
+
+
+# ---------------------------------------------------------------------------
+# Admission webhooks — out-of-tree policy intercepting API writes.
+# Reference: staging/src/k8s.io/apiserver/pkg/admission/plugin/webhook/
+# {mutating,validating}/admission.go (Admit at mutating/admission.go:199)
+# and the admissionregistration.k8s.io API group. Wire shape preserved:
+# the server POSTs an AdmissionReview{request} and the hook answers
+# AdmissionReview{response{uid, allowed, patch?, status?}}; mutating
+# patches are RFC 6902 JSONPatch (base64 on the wire, like the
+# reference's patchType: JSONPatch).
+# ---------------------------------------------------------------------------
+
+ADMISSION_V1 = "admissionregistration/v1"
+
+FAILURE_POLICY_FAIL = "Fail"
+FAILURE_POLICY_IGNORE = "Ignore"
+
+
+@dataclass
+class WebhookRule:
+    """Which (operation, resource) pairs a webhook intercepts.
+
+    Reference: admissionregistration RuleWithOperations. Plural-based
+    (the framework's resources are flat plurals); ``"*"`` matches all.
+    """
+
+    operations: list[str] = field(default_factory=lambda: ["*"])
+    resources: list[str] = field(default_factory=list)
+
+
+@dataclass
+class Webhook:
+    name: str = ""
+    #: Endpoint URL (reference also supports service refs; here the
+    #: dataplane has no in-cluster HTTPS services, so URL only).
+    url: str = ""
+    rules: list[WebhookRule] = field(default_factory=list)
+    #: Fail (reject the API request when the hook is unreachable) or
+    #: Ignore (admit as if allowed) — admission.go failurePolicy.
+    failure_policy: str = FAILURE_POLICY_FAIL
+    timeout_seconds: float = 10.0
+
+
+@dataclass
+class MutatingWebhookConfiguration(TypedObject):
+    webhooks: list[Webhook] = field(default_factory=list)
+
+
+@dataclass
+class ValidatingWebhookConfiguration(TypedObject):
+    webhooks: list[Webhook] = field(default_factory=list)
+
+
+DEFAULT_SCHEME.register(ADMISSION_V1, "MutatingWebhookConfiguration",
+                        MutatingWebhookConfiguration)
+DEFAULT_SCHEME.register(ADMISSION_V1, "ValidatingWebhookConfiguration",
+                        ValidatingWebhookConfiguration)

@@ -75,6 +75,21 @@ def _parses(check, value: str) -> bool:
         return False
 
 
+def _json_merge(base: Any, p: Any) -> Any:
+    """RFC 7386 JSON merge-patch (None deletes; dicts merge deep)."""
+    if not isinstance(p, dict):
+        return p
+    if not isinstance(base, dict):
+        base = {}
+    out = dict(base)
+    for k, v in p.items():
+        if v is None:
+            out.pop(k, None)
+        else:
+            out[k] = _json_merge(out.get(k), v)
+    return out
+
+
 def _merge_secret_string_data(sec: t.Secret) -> None:
     """Secret strategy: fold the plaintext ``string_data`` convenience
     field into base64 ``data`` (reference: pkg/registry/core/secret
@@ -159,6 +174,14 @@ def builtin_resources() -> list[ResourceSpec]:
                      ext.APIService, namespaced=False,
                      validate_create=ext.validate_apiservice,
                      validate_update=ext.validate_apiservice_update),
+        ResourceSpec("mutatingwebhookconfigurations",
+                     "MutatingWebhookConfiguration", ext.ADMISSION_V1,
+                     ext.MutatingWebhookConfiguration, namespaced=False,
+                     has_status=False),
+        ResourceSpec("validatingwebhookconfigurations",
+                     "ValidatingWebhookConfiguration", ext.ADMISSION_V1,
+                     ext.ValidatingWebhookConfiguration, namespaced=False,
+                     has_status=False),
     ]
 
 
@@ -607,33 +630,30 @@ class Registry:
             return False
         return to_dict(new.spec) != to_dict(old.spec)
 
+    def preview_patch(self, cur: TypedObject, patch: dict,
+                      strategic: bool = False) -> dict:
+        """The merged object dict a patch WOULD produce against ``cur``
+        — shared by :meth:`patch` and the apiserver's webhook path
+        (hooks must see the post-merge object, not the raw patch)."""
+        spec = self.spec_for_kind(cur.kind or type(cur).__name__)
+        if strategic:
+            from ..api.patch import strategic_merge
+            merged = strategic_merge(self._encode(cur), patch, spec.cls)
+        else:
+            merged = _json_merge(self._encode(cur), patch)
+        merged.setdefault("api_version", spec.api_version)
+        merged.setdefault("kind", spec.kind)
+        return merged
+
     def patch(self, plural: str, namespace: str, name: str, patch: dict,
               subresource: str = "", strategic: bool = False) -> TypedObject:
         """JSON merge-patch (RFC 7386) or, with ``strategic=True``,
         strategic merge patch (list merge by per-type keys — see
         ``api/patch.py``)."""
         spec = self.spec_for(plural)
-
-        def apply_merge(base: Any, p: Any) -> Any:
-            if not isinstance(p, dict):
-                return p
-            if not isinstance(base, dict):
-                base = {}
-            out = dict(base)
-            for k, v in p.items():
-                if v is None:
-                    out.pop(k, None)
-                else:
-                    out[k] = apply_merge(out.get(k), v)
-            return out
-
         for _ in range(10):
             cur = self.get(plural, namespace, name)
-            if strategic:
-                from ..api.patch import strategic_merge
-                merged = strategic_merge(self._encode(cur), patch, spec.cls)
-            else:
-                merged = apply_merge(self._encode(cur), patch)
+            merged = self.preview_patch(cur, patch, strategic)
             obj = from_dict(spec.cls, merged)
             obj.api_version, obj.kind = spec.api_version, spec.kind
             obj.metadata.resource_version = cur.metadata.resource_version

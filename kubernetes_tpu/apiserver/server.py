@@ -75,6 +75,11 @@ class APIServer:
         #: CertAuthority when the cluster runs TLS (certs.py); enables
         #: GET /bootstrap/v1/ca and the CSR-signing join endpoint.
         self.cert_authority = None
+        #: External admission webhooks (webhooks.py): mutating hooks run
+        #: before the registry's in-tree chain, validating hooks on the
+        #: final request object; zero overhead while no config exists.
+        from .webhooks import WebhookDispatcher
+        self.webhooks = WebhookDispatcher(self.registry)
         #: Requests slower than this log a slow-op line (SLO: 1s p99).
         self.slow_request_threshold = 1.0
         #: Max concurrent non-watch requests (reference: the
@@ -113,16 +118,30 @@ class APIServer:
             # cert that survived chain verification in the handshake
             # carries CN=user / O=groups.
             user = None
-            ssl_obj = (request.transport.get_extra_info("ssl_object")
-                       if request.transport is not None else None)
+            transport = request.transport
+            ssl_obj = (transport.get_extra_info("ssl_object")
+                       if transport is not None else None)
             if ssl_obj is not None:
-                der = ssl_obj.getpeercert(binary_form=True)
-                if der:
-                    from .certs import identity_from_der
-                    cn, orgs = identity_from_der(der)
-                    if cn:
-                        user = cn
-                        request["cert_groups"] = set(orgs)
+                # Parse the peer cert ONCE per connection (it cannot
+                # change mid-connection) — x509 parsing on every
+                # request of a node agent's watch/heartbeat stream is
+                # pure repeated work on the hot path.
+                ident = getattr(transport, "_ktpu_cert_identity", None)
+                if ident is None:
+                    der = ssl_obj.getpeercert(binary_form=True)
+                    if der:
+                        from .certs import identity_from_der
+                        ident = identity_from_der(der)
+                    else:
+                        ident = ("", [])
+                    try:
+                        transport._ktpu_cert_identity = ident
+                    except AttributeError:
+                        pass  # slotted transport: re-parse per request
+                cn, orgs = ident
+                if cn:
+                    user = cn
+                    request["cert_groups"] = set(orgs)
             if user is None:
                 auth = request.headers.get("Authorization", "")
                 token = auth[7:] if auth.startswith("Bearer ") else ""
@@ -620,7 +639,15 @@ class APIServer:
         obj = self.registry.scheme.decode(data)
         if ns:
             obj.metadata.namespace = ns
+        if self.webhooks.has_hooks("CREATE", plural):
+            d = await self.webhooks.run_mutating(
+                "CREATE", plural, ns, obj.metadata.name, to_dict(obj))
+            await self.webhooks.run_validating(
+                "CREATE", plural, ns, obj.metadata.name, d)
+            obj = self.registry.scheme.decode(d)
         created = await self._mutate(self.registry.create, obj)
+        if plural.endswith("webhookconfigurations"):
+            self.webhooks.invalidate()
         return self._obj_response(created, status=201)
 
     async def _get(self, request):
@@ -749,37 +776,99 @@ class APIServer:
         obj = self.registry.scheme.decode(data)
         obj.metadata.namespace = ns or obj.metadata.namespace
         obj.metadata.name = request.match_info["name"]
+        if not sub and self.webhooks.has_hooks("UPDATE", plural):
+            try:
+                old = to_dict(self.registry.get(plural, ns,
+                                                obj.metadata.name))
+            except errors.NotFoundError:
+                old = None
+            d = await self.webhooks.run_mutating(
+                "UPDATE", plural, ns, obj.metadata.name, to_dict(obj), old)
+            await self.webhooks.run_validating(
+                "UPDATE", plural, ns, obj.metadata.name, d, old)
+            obj = self.registry.scheme.decode(d)
         updated = await self._mutate(self.registry.update, obj, sub)
+        if plural.endswith("webhookconfigurations"):
+            self.webhooks.invalidate()
         return self._obj_response(updated)
 
     async def _patch(self, request):
         plural, ns = self._ctx(request)
         sub = request.match_info.get("subresource", "")
+        name = request.match_info["name"]
         patch = await self._body_obj(request)
         from ..api.patch import STRATEGIC_MERGE_PATCH
         strategic = request.content_type == STRATEGIC_MERGE_PATCH
+        if not sub and self.webhooks.has_hooks("UPDATE", plural):
+            # A patch is an UPDATE to webhooks (reference semantics —
+            # otherwise PATCH would be a policy bypass): compute the
+            # merged object, run the hooks on it, persist as a
+            # conflict-guarded update carrying any hook mutations.
+            for attempt in range(3):
+                old_obj = self.registry.get(plural, ns, name)
+                merged = self.registry.preview_patch(
+                    old_obj, patch, strategic)
+                old = to_dict(old_obj)
+                d = await self.webhooks.run_mutating(
+                    "UPDATE", plural, ns, name, merged, old)
+                await self.webhooks.run_validating(
+                    "UPDATE", plural, ns, name, d, old)
+                obj = self.registry.scheme.decode(d)
+                obj.metadata.resource_version = \
+                    old_obj.metadata.resource_version
+                try:
+                    updated = await self._mutate(
+                        self.registry.update, obj, sub)
+                    return self._obj_response(updated)
+                except errors.ConflictError:
+                    if attempt == 2:
+                        raise
         updated = await self._mutate(
-            self.registry.patch, plural, ns, request.match_info["name"],
-            patch, sub, strategic)
+            self.registry.patch, plural, ns, name, patch, sub, strategic)
+        if plural.endswith("webhookconfigurations"):
+            self.webhooks.invalidate()
         return self._obj_response(updated)
 
     async def _delete(self, request):
         plural, ns = self._ctx(request)
+        name = request.match_info["name"]
+        if self.webhooks.has_hooks("DELETE", plural):
+            try:
+                old = to_dict(self.registry.get(plural, ns, name))
+            except errors.NotFoundError:
+                old = None
+            if old is not None:
+                await self.webhooks.run_validating(
+                    "DELETE", plural, ns, name, None, old)
         gp = request.query.get("grace_period_seconds")
         obj = await self._mutate(
-            self.registry.delete, plural, ns, request.match_info["name"],
+            self.registry.delete, plural, ns, name,
             self._int_param(gp, "grace_period_seconds") if gp is not None else None,
             request.query.get("uid", ""))
+        if plural.endswith("webhookconfigurations"):
+            self.webhooks.invalidate()
         return self._obj_response(obj)
 
     async def _delete_collection(self, request):
         plural, ns = self._ctx(request)
+        selector = request.query.get("label_selector", "")
+        if self.webhooks.has_hooks("DELETE", plural):
+            # A collection delete is N deletes to webhooks — otherwise
+            # it would be the policy bypass the single-delete path
+            # closes. Any denial rejects the whole operation (nothing
+            # is deleted), keeping it atomic for the caller.
+            objs, _ = self.registry.list(plural, ns, selector)
+            for obj in objs:
+                await self.webhooks.run_validating(
+                    "DELETE", plural, ns, obj.metadata.name,
+                    None, to_dict(obj))
         # Always a worker thread: O(collection) work would monopolize
         # the event loop even without a WAL (_mutate's inline fast path
         # is for single-object sub-ms mutations only).
         n = await asyncio.to_thread(
-            self.registry.delete_collection, plural, ns,
-            request.query.get("label_selector", ""))
+            self.registry.delete_collection, plural, ns, selector)
+        if plural.endswith("webhookconfigurations"):
+            self.webhooks.invalidate()
         return web.json_response({"deleted": n})
 
     async def _subresource_post(self, request):
@@ -817,6 +906,7 @@ class APIServer:
         return self.port
 
     async def stop(self) -> None:
+        await self.webhooks.close()
         if self._proxy_session is not None and not self._proxy_session.closed:
             await self._proxy_session.close()
         if self._runner:

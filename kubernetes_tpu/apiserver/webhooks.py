@@ -1,0 +1,246 @@
+"""External admission webhooks — out-of-tree policy on API writes.
+
+Reference: ``staging/src/k8s.io/apiserver/pkg/admission/plugin/webhook/
+mutating/admission.go:199 Admit`` and ``.../validating/``. The server
+POSTs ``AdmissionReview{request:{uid, operation, resource, namespace,
+name, object, old_object}}`` to every matching webhook; a mutating
+hook may answer with a base64 RFC 6902 JSONPatch (``patch_type:
+"JSONPatch"``), a validating hook answers allowed/denied with a
+status message. ``failure_policy`` decides what an unreachable hook
+means (Fail -> the API request is rejected; Ignore -> admitted).
+
+Placement: the dispatcher runs in the apiserver's async handlers —
+mutating hooks before the registry's in-tree chain, validating hooks
+on the final (mutated) request object before storage. Writes made
+through the in-process ``LocalClient`` backdoor do not traverse HTTP
+and therefore skip webhooks, exactly like they skip authn — the wire
+path is the policy surface.
+
+Configs are plain API objects (Mutating/ValidatingWebhookConfiguration,
+``api/extensions.py``), listed from the registry with a short TTL so
+registering a webhook takes effect within a second without a watch.
+"""
+from __future__ import annotations
+
+import asyncio
+import base64
+import json
+import logging
+import time
+import uuid
+from typing import Any, Optional
+
+from ..api import errors
+from ..api import extensions as ext
+
+log = logging.getLogger("admission.webhooks")
+
+
+def apply_json_patch(doc: Any, patch: list[dict]) -> Any:
+    """Minimal RFC 6902: add / remove / replace over dicts and lists
+    ("-" appends). Unknown ops or bad paths raise ValueError — a
+    webhook's malformed patch must reject the request, not corrupt the
+    object."""
+    import copy
+    doc = copy.deepcopy(doc)
+    for op in patch:
+        try:
+            _apply_one(doc, op)
+        except (IndexError, KeyError, TypeError) as e:
+            # The documented contract is ValueError on ANY bad patch —
+            # a stale list index must reject the request, not 500.
+            raise ValueError(f"bad patch op {op!r}: {e}") from None
+    return doc
+
+
+def _apply_one(doc: Any, op: dict) -> None:
+    action = op.get("op")
+    path = op.get("path", "")
+    if not path.startswith("/"):
+        raise ValueError(f"bad path {path!r}")
+    keys = [p.replace("~1", "/").replace("~0", "~")
+            for p in path[1:].split("/")]
+    cur: Any = doc
+    for k in keys[:-1]:
+        cur = _step(cur, k)
+    last = keys[-1]
+    if action == "add":
+        if isinstance(cur, list):
+            idx = len(cur) if last == "-" else int(last)
+            cur.insert(idx, op["value"])
+        elif isinstance(cur, dict):
+            cur[last] = op["value"]
+        else:
+            raise ValueError(f"cannot add into {type(cur).__name__}")
+    elif action == "replace":
+        if isinstance(cur, list):
+            cur[int(last)] = op["value"]
+        elif isinstance(cur, dict):
+            if last not in cur:
+                raise ValueError(f"replace of missing key {path!r}")
+            cur[last] = op["value"]
+        else:
+            raise ValueError(f"cannot replace in {type(cur).__name__}")
+    elif action == "remove":
+        if isinstance(cur, list):
+            del cur[int(last)]
+        elif isinstance(cur, dict):
+            if last not in cur:
+                raise ValueError(f"remove of missing key {path!r}")
+            del cur[last]
+        else:
+            raise ValueError(f"cannot remove from {type(cur).__name__}")
+    else:
+        raise ValueError(f"unsupported op {action!r}")
+
+
+def _step(cur: Any, key: str) -> Any:
+    if isinstance(cur, list):
+        return cur[int(key)]
+    if isinstance(cur, dict):
+        if key not in cur:
+            raise ValueError(f"missing path segment {key!r}")
+        return cur[key]
+    raise ValueError(f"cannot traverse {type(cur).__name__}")
+
+
+class WebhookDispatcher:
+    """Lists webhook configs from the registry (TTL-cached) and calls
+    matching hooks for an (operation, resource) write."""
+
+    def __init__(self, registry, ttl: float = 1.0):
+        self.registry = registry
+        self.ttl = ttl
+        self._cache: tuple[float, list, list] = (float("-inf"), [], [])
+        self._session = None
+
+    def invalidate(self) -> None:
+        """Drop the TTL snapshot — the server calls this when a webhook
+        configuration itself is written, so `create config; create pod`
+        inside one TTL window still intercepts the pod."""
+        self._cache = (float("-inf"), [], [])
+
+    def _configs(self) -> tuple[list, list]:
+        now = time.monotonic()
+        at, mut, val = self._cache
+        if now - at < self.ttl:
+            return mut, val
+        try:
+            mut, _ = self.registry.list("mutatingwebhookconfigurations")
+            val, _ = self.registry.list("validatingwebhookconfigurations")
+        except errors.StatusError:
+            mut, val = [], []
+        self._cache = (now, mut, val)
+        return mut, val
+
+    @staticmethod
+    def _matches(hook: ext.Webhook, operation: str, plural: str) -> bool:
+        for rule in hook.rules:
+            ops = rule.operations or ["*"]
+            if "*" not in ops and operation not in ops:
+                continue
+            if "*" in rule.resources or plural in rule.resources:
+                return True
+        return False
+
+    def has_hooks(self, operation: str, plural: str) -> bool:
+        mut, val = self._configs()
+        return any(self._matches(h, operation, plural)
+                   for cfg in mut + val for h in cfg.webhooks)
+
+    async def _call(self, hook: ext.Webhook, review: dict) -> Optional[dict]:
+        """One hook round trip; None means unreachable/invalid (the
+        failure_policy decides what that means)."""
+        import aiohttp
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession()
+        try:
+            async with self._session.post(
+                    hook.url, json=review,
+                    timeout=aiohttp.ClientTimeout(
+                        total=hook.timeout_seconds)) as resp:
+                if resp.status != 200:
+                    return None
+                body = await resp.json()
+            return body.get("response") or None
+        except (aiohttp.ClientError, asyncio.TimeoutError, ValueError) as e:
+            log.warning("webhook %s (%s) failed: %s", hook.name, hook.url, e)
+            return None
+
+    def _review(self, operation: str, plural: str, namespace: str,
+                name: str, obj: Optional[dict],
+                old: Optional[dict]) -> dict:
+        return {"kind": "AdmissionReview",
+                "api_version": ext.ADMISSION_V1,
+                "request": {"uid": str(uuid.uuid4()),
+                            "operation": operation,
+                            "resource": plural,
+                            "namespace": namespace,
+                            "name": name,
+                            "object": obj,
+                            "old_object": old}}
+
+    @staticmethod
+    def _enforce(hook: ext.Webhook, resp: Optional[dict]) -> bool:
+        """Shared unreachable/denied policy: returns False when an
+        Ignore-policy hook should simply be skipped; raises on denial
+        or on an unreachable Fail-policy hook."""
+        if resp is None:
+            if hook.failure_policy == ext.FAILURE_POLICY_IGNORE:
+                return False
+            raise errors.ForbiddenError(
+                f"admission webhook {hook.name!r} unreachable "
+                f"(failurePolicy=Fail)")
+        if not resp.get("allowed", False):
+            msg = (resp.get("status") or {}).get("message", "denied")
+            raise errors.ForbiddenError(
+                f"admission webhook {hook.name!r} denied the "
+                f"request: {msg}")
+        return True
+
+    async def run_mutating(self, operation: str, plural: str,
+                           namespace: str, name: str, obj: dict,
+                           old: Optional[dict] = None) -> dict:
+        """Run matching mutating hooks in config order; returns the
+        (possibly patched) object dict. Raises ForbiddenError on denial
+        or on unreachable Fail-policy hooks."""
+        mut, _ = self._configs()
+        for cfg in mut:
+            for hook in cfg.webhooks:
+                if not self._matches(hook, operation, plural):
+                    continue
+                resp = await self._call(hook, self._review(
+                    operation, plural, namespace, name, obj, old))
+                if not self._enforce(hook, resp):
+                    continue
+                patch_b64 = resp.get("patch")
+                if patch_b64:
+                    try:
+                        patch = json.loads(base64.b64decode(patch_b64))
+                        obj = apply_json_patch(obj, patch)
+                    except (ValueError, json.JSONDecodeError) as e:
+                        raise errors.ForbiddenError(
+                            f"admission webhook {hook.name!r} returned a "
+                            f"bad patch: {e}") from None
+        return obj
+
+    async def run_validating(self, operation: str, plural: str,
+                             namespace: str, name: str,
+                             obj: Optional[dict],
+                             old: Optional[dict] = None) -> None:
+        """Run matching validating hooks CONCURRENTLY (they cannot
+        mutate, so order is irrelevant — reference does the same)."""
+        _, val = self._configs()
+        hooks = [h for cfg in val for h in cfg.webhooks
+                 if self._matches(h, operation, plural)]
+        if not hooks:
+            return
+        review = self._review(operation, plural, namespace, name, obj, old)
+        results = await asyncio.gather(
+            *(self._call(h, review) for h in hooks))
+        for hook, resp in zip(hooks, results):
+            self._enforce(hook, resp)
+
+    async def close(self) -> None:
+        if self._session is not None and not self._session.closed:
+            await self._session.close()

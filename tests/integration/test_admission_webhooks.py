@@ -1,0 +1,224 @@
+"""External admission webhooks through a REAL HTTP hook backend.
+
+Reference: ``staging/src/k8s.io/apiserver/pkg/admission/plugin/webhook/
+mutating/admission.go:199`` + ``.../validating/`` — AdmissionReview in,
+allowed/patch out, failurePolicy honored, denials audited (the 403
+flows through the server's standard audit middleware).
+"""
+import base64
+import json
+
+import pytest
+from aiohttp import web
+
+from kubernetes_tpu.api import errors, extensions as ext, types as t
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.apiserver.webhooks import apply_json_patch
+from kubernetes_tpu.client.rest import RESTClient
+
+
+def mk_pod(name="p", labels=None):
+    return t.Pod(metadata=ObjectMeta(name=name, namespace="default",
+                                     labels=labels or {}),
+                 spec=t.PodSpec(containers=[t.Container(name="c", image="i")]))
+
+
+class HookBackend:
+    """An out-of-tree admission controller: mutates pods with a label,
+    denies anything labeled block=true, and records every review."""
+
+    def __init__(self):
+        self.reviews: list[dict] = []
+        self.app = web.Application()
+        self.app.router.add_post("/mutate", self.mutate)
+        self.app.router.add_post("/validate", self.validate)
+        self._runner = None
+        self.base = ""
+
+    async def start(self):
+        self._runner = web.AppRunner(self.app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+        self.base = f"http://127.0.0.1:{port}"
+
+    async def stop(self):
+        if self._runner:
+            await self._runner.cleanup()
+
+    async def mutate(self, request):
+        review = await request.json()
+        self.reviews.append(review)
+        req = review["request"]
+        patch = [{"op": "add", "path": "/metadata/labels/mutated",
+                  "value": "yes"}]
+        if not (req["object"]["metadata"].get("labels")):
+            patch.insert(0, {"op": "add", "path": "/metadata/labels",
+                             "value": {}})
+        return web.json_response({"response": {
+            "uid": req["uid"], "allowed": True,
+            "patch": base64.b64encode(json.dumps(patch).encode()).decode(),
+            "patch_type": "JSONPatch"}})
+
+    async def validate(self, request):
+        review = await request.json()
+        self.reviews.append(review)
+        req = review["request"]
+        obj = req.get("object") or req.get("old_object") or {}
+        labels = (obj.get("metadata") or {}).get("labels") or {}
+        allowed = labels.get("block") != "true"
+        return web.json_response({"response": {
+            "uid": req["uid"], "allowed": allowed,
+            "status": {"message": "blocked by policy"}}})
+
+
+async def start_stack():
+    hook = HookBackend()
+    await hook.start()
+    srv = APIServer()
+    srv.registry.create(t.Namespace(metadata=ObjectMeta(name="default")))
+    port = await srv.start()
+    client = RESTClient(f"http://127.0.0.1:{port}")
+    return hook, srv, client
+
+
+def hook_cfg(kind, name, url, resources, policy=ext.FAILURE_POLICY_FAIL,
+             operations=("*",)):
+    cls = (ext.MutatingWebhookConfiguration if kind == "m"
+           else ext.ValidatingWebhookConfiguration)
+    return cls(metadata=ObjectMeta(name=name), webhooks=[ext.Webhook(
+        name=f"{name}.hook", url=url, failure_policy=policy,
+        timeout_seconds=3.0,
+        rules=[ext.WebhookRule(operations=list(operations),
+                               resources=list(resources))])])
+
+
+async def test_mutating_and_validating_through_real_hook():
+    hook, srv, client = await start_stack()
+    try:
+        await client.create(hook_cfg("m", "mutator", hook.base + "/mutate",
+                                     ["pods"]))
+        await client.create(hook_cfg("v", "policy", hook.base + "/validate",
+                                     ["pods"]))
+
+        # CREATE is mutated by the hook's JSONPatch.
+        created = await client.create(mk_pod("a"))
+        assert created.metadata.labels.get("mutated") == "yes"
+        ops = [r["request"]["operation"] for r in hook.reviews]
+        assert "CREATE" in ops
+
+        # Validating hook denies by policy -> 403 at the client.
+        with pytest.raises(errors.ForbiddenError, match="blocked by policy"):
+            await client.create(mk_pod("b", labels={"block": "true"}))
+
+        # UPDATE path: flipping the label on a live object is denied.
+        got = await client.get("pods", "default", "a")
+        got.metadata.labels["block"] = "true"
+        with pytest.raises(errors.ForbiddenError):
+            await client.update(got)
+
+        # PATCH is an UPDATE to webhooks — no policy bypass via patch.
+        with pytest.raises(errors.ForbiddenError):
+            await client.patch("pods", "default", "a",
+                               {"metadata": {"labels": {"block": "true"}}})
+        # An allowed patch carries the mutation AND the patch content.
+        patched = await client.patch("pods", "default", "a",
+                                     {"metadata": {"labels": {"x": "1"}}})
+        assert patched.metadata.labels.get("x") == "1"
+        assert patched.metadata.labels.get("mutated") == "yes"
+
+        # DELETE consults validating hooks with the old object.
+        with pytest.raises(errors.ForbiddenError):
+            await client.create(mk_pod("blocked", labels={"block": "true"}))
+        # Deleting an allowed pod works; hooks saw a DELETE review.
+        await client.delete("pods", "default", "a", grace_period_seconds=0)
+        assert any(r["request"]["operation"] == "DELETE"
+                   for r in hook.reviews)
+
+        # Unmatched resources skip the hooks entirely.
+        n_before = len(hook.reviews)
+        await client.create(t.ConfigMap(
+            metadata=ObjectMeta(name="cm", namespace="default")))
+        assert len(hook.reviews) == n_before
+
+        # DELETE-collection is N deletes to webhooks (no bypass): a
+        # protected pod (labeled via the registry backdoor, as a
+        # controller would) blocks the whole collection delete.
+        await client.create(mk_pod("guarded"))
+        got = srv.registry.get("pods", "default", "guarded")
+        got.metadata.labels["block"] = "true"
+        srv.registry.update(got)
+        import aiohttp
+        async with aiohttp.ClientSession() as s:
+            async with s.delete(
+                    f"{client.base_url}/api/core/v1/namespaces/default/pods"
+                    ) as r:
+                assert r.status == 403, await r.text()
+        assert srv.registry.get("pods", "default", "guarded")  # survived
+    finally:
+        await client.close()
+        await srv.stop()
+        await hook.stop()
+
+
+async def test_failure_policy():
+    hook, srv, client = await start_stack()
+    try:
+        dead = "http://127.0.0.1:1/nothing"
+        await client.create(hook_cfg("v", "fail-closed", dead, ["secrets"]))
+        with pytest.raises(errors.ForbiddenError, match="unreachable"):
+            await client.create(t.Secret(
+                metadata=ObjectMeta(name="s", namespace="default")))
+
+        await client.create(hook_cfg("v", "fail-open", dead, ["configmaps"],
+                                     policy=ext.FAILURE_POLICY_IGNORE))
+        cm = await client.create(t.ConfigMap(
+            metadata=ObjectMeta(name="c", namespace="default")))
+        assert cm.metadata.uid  # Ignore: admitted despite the dead hook
+    finally:
+        await client.close()
+        await srv.stop()
+        await hook.stop()
+
+
+async def test_webhooks_compose_with_crds():
+    hook, srv, client = await start_stack()
+    try:
+        crd = ext.CustomResourceDefinition(
+            metadata=ObjectMeta(name="widgets.acme.io"),
+            spec=ext.CRDSpec(group="acme.io", version="v1",
+                             names=ext.CRDNames(plural="widgets",
+                                                kind="Widget")))
+        await client.create(crd)
+        await client.create(hook_cfg("m", "crd-mutator",
+                                     hook.base + "/mutate", ["widgets"]))
+        cr = ext.CustomResource(
+            metadata=ObjectMeta(name="w1", namespace="default"),
+            spec={"size": 3})
+        cr.api_version, cr.kind = "acme.io/v1", "Widget"
+        w = await client.create(cr)
+        assert w.metadata.labels.get("mutated") == "yes"
+    finally:
+        await client.close()
+        await srv.stop()
+        await hook.stop()
+
+
+def test_apply_json_patch_ops():
+    doc = {"a": {"b": [1, 2]}, "keep": 1}
+    out = apply_json_patch(doc, [
+        {"op": "add", "path": "/a/c", "value": "x"},
+        {"op": "add", "path": "/a/b/-", "value": 3},
+        {"op": "replace", "path": "/a/b/0", "value": 9},
+        {"op": "remove", "path": "/keep"},
+    ])
+    assert out == {"a": {"b": [9, 2, 3], "c": "x"}}
+    assert doc == {"a": {"b": [1, 2]}, "keep": 1}  # input untouched
+    for bad in ([{"op": "replace", "path": "/nope", "value": 1}],
+                [{"op": "remove", "path": "/nope"}],
+                [{"op": "test", "path": "/a", "value": 1}],
+                [{"op": "add", "path": "bad", "value": 1}]):
+        with pytest.raises(ValueError):
+            apply_json_patch(doc, bad)
