@@ -21,12 +21,14 @@ from typing import Optional
 
 import grpc
 
-from ..node.runtime import ContainerConfig, ContainerRuntime, ContainerStatus
+from ..node.runtime import (ContainerConfig, ContainerRuntime,
+                            ContainerStatus, SandboxStatus)
 from . import cri_pb2 as pb
 
 log = logging.getLogger("cri")
 
 SERVICE = "cri.v1.RuntimeService"
+IMAGE_SERVICE = "cri.v1.ImageService"
 RUNTIME_VERSION = "0.1"
 
 
@@ -72,6 +74,7 @@ class CRIServer:
         config = ContainerConfig(
             pod_namespace=c.pod_namespace, pod_name=c.pod_name,
             pod_uid=c.pod_uid, name=c.name, image=c.image,
+            sandbox_id=c.sandbox_id,
             command=list(c.command), args=list(c.args),
             env={e.key: e.value for e in c.envs},
             working_dir=c.working_dir,
@@ -126,6 +129,98 @@ class CRIServer:
             context.abort(grpc.StatusCode.NOT_FOUND, str(e))
         return pb.ContainerLogsResponse(content=content)
 
+    # -- sandbox handlers --------------------------------------------------
+
+    def RunPodSandbox(self, request, context):
+        try:
+            sid = self._call(self.runtime.run_pod_sandbox(
+                request.pod_namespace, request.pod_name, request.pod_uid))
+        except NotImplementedError:
+            context.abort(grpc.StatusCode.UNIMPLEMENTED,
+                          "runtime has no sandbox support")
+        except Exception as e:  # noqa: BLE001
+            context.abort(grpc.StatusCode.INTERNAL, str(e))
+        return pb.RunPodSandboxResponse(sandbox_id=sid)
+
+    def StopPodSandbox(self, request, context):
+        try:
+            self._call(self.runtime.stop_pod_sandbox(request.sandbox_id))
+        except NotImplementedError:
+            context.abort(grpc.StatusCode.UNIMPLEMENTED,
+                          "runtime has no sandbox support")
+        return pb.Empty()
+
+    def RemovePodSandbox(self, request, context):
+        try:
+            self._call(self.runtime.remove_pod_sandbox(request.sandbox_id))
+        except NotImplementedError:
+            context.abort(grpc.StatusCode.UNIMPLEMENTED,
+                          "runtime has no sandbox support")
+        return pb.Empty()
+
+    def ListPodSandboxes(self, request, context):
+        try:
+            sbs = self._call(self.runtime.list_pod_sandboxes())
+        except NotImplementedError:
+            context.abort(grpc.StatusCode.UNIMPLEMENTED,
+                          "runtime has no sandbox support")
+        return pb.ListPodSandboxesResponse(sandboxes=[
+            pb.SandboxStatus(id=s.id, pod_namespace=s.pod_namespace,
+                             pod_name=s.pod_name, pod_uid=s.pod_uid,
+                             state=s.state, created_at=s.created_at)
+            for s in sbs])
+
+    # -- image handlers ----------------------------------------------------
+
+    def PullImage(self, request, context):
+        try:
+            digest = self._call(self.runtime.pull_image(request.ref),
+                                timeout=300.0)
+        except NotImplementedError:
+            context.abort(grpc.StatusCode.UNIMPLEMENTED,
+                          "runtime has no image support")
+        except FileNotFoundError as e:
+            context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+        except ValueError as e:  # digest mismatch
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
+        except Exception as e:  # noqa: BLE001
+            context.abort(grpc.StatusCode.INTERNAL, str(e))
+        return pb.PullImageResponse(digest=digest)
+
+    @staticmethod
+    def _to_pb_image(i) -> pb.Image:
+        return pb.Image(ref=i.ref, digest=i.digest,
+                        size_bytes=i.size_bytes, path=i.path,
+                        last_used_at=i.last_used_at, builtin=i.builtin)
+
+    def ImageStatus(self, request, context):
+        try:
+            info = self._call(self.runtime.image_status(request.ref))
+        except NotImplementedError:
+            context.abort(grpc.StatusCode.UNIMPLEMENTED,
+                          "runtime has no image support")
+        if info is None:
+            return pb.ImageStatusResponse(present=False)
+        return pb.ImageStatusResponse(present=True,
+                                      image=self._to_pb_image(info))
+
+    def RemoveImage(self, request, context):
+        try:
+            self._call(self.runtime.remove_image(request.ref))
+        except NotImplementedError:
+            context.abort(grpc.StatusCode.UNIMPLEMENTED,
+                          "runtime has no image support")
+        return pb.Empty()
+
+    def ListImages(self, request, context):
+        try:
+            infos = self._call(self.runtime.list_images())
+        except NotImplementedError:
+            context.abort(grpc.StatusCode.UNIMPLEMENTED,
+                          "runtime has no image support")
+        return pb.ListImagesResponse(
+            images=[self._to_pb_image(i) for i in infos])
+
     # -- lifecycle ---------------------------------------------------------
 
     def serve(self, socket_path: str) -> None:
@@ -164,9 +259,45 @@ class CRIServer:
                 self.ContainerLogs,
                 request_deserializer=pb.ContainerLogsRequest.FromString,
                 response_serializer=pb.ContainerLogsResponse.SerializeToString),
+            "RunPodSandbox": grpc.unary_unary_rpc_method_handler(
+                self.RunPodSandbox,
+                request_deserializer=pb.RunPodSandboxRequest.FromString,
+                response_serializer=pb.RunPodSandboxResponse.SerializeToString),
+            "StopPodSandbox": grpc.unary_unary_rpc_method_handler(
+                self.StopPodSandbox,
+                request_deserializer=pb.PodSandboxIdRequest.FromString,
+                response_serializer=pb.Empty.SerializeToString),
+            "RemovePodSandbox": grpc.unary_unary_rpc_method_handler(
+                self.RemovePodSandbox,
+                request_deserializer=pb.PodSandboxIdRequest.FromString,
+                response_serializer=pb.Empty.SerializeToString),
+            "ListPodSandboxes": grpc.unary_unary_rpc_method_handler(
+                self.ListPodSandboxes,
+                request_deserializer=pb.ListPodSandboxesRequest.FromString,
+                response_serializer=pb.ListPodSandboxesResponse.SerializeToString),
+        }
+        image_handlers = {
+            "PullImage": grpc.unary_unary_rpc_method_handler(
+                self.PullImage,
+                request_deserializer=pb.PullImageRequest.FromString,
+                response_serializer=pb.PullImageResponse.SerializeToString),
+            "ImageStatus": grpc.unary_unary_rpc_method_handler(
+                self.ImageStatus,
+                request_deserializer=pb.ImageRefRequest.FromString,
+                response_serializer=pb.ImageStatusResponse.SerializeToString),
+            "RemoveImage": grpc.unary_unary_rpc_method_handler(
+                self.RemoveImage,
+                request_deserializer=pb.ImageRefRequest.FromString,
+                response_serializer=pb.Empty.SerializeToString),
+            "ListImages": grpc.unary_unary_rpc_method_handler(
+                self.ListImages,
+                request_deserializer=pb.ListImagesRequest.FromString,
+                response_serializer=pb.ListImagesResponse.SerializeToString),
         }
         self._server.add_generic_rpc_handlers(
-            (grpc.method_handlers_generic_handler(SERVICE, handlers),))
+            (grpc.method_handlers_generic_handler(SERVICE, handlers),
+             grpc.method_handlers_generic_handler(IMAGE_SERVICE,
+                                                  image_handlers)))
         self._server.add_insecure_port(f"unix://{socket_path}")
         self._server.start()
         self.socket_path = socket_path
@@ -202,6 +333,28 @@ class RemoteRuntime(ContainerRuntime):
         self._logs = u("ContainerLogs", pb.ContainerLogsRequest,
                        pb.ContainerLogsResponse)
         self._exec = u("ExecSync", pb.ExecSyncRequest, pb.ExecSyncResponse)
+        self._run_sandbox = u("RunPodSandbox", pb.RunPodSandboxRequest,
+                              pb.RunPodSandboxResponse)
+        self._stop_sandbox = u("StopPodSandbox", pb.PodSandboxIdRequest,
+                               pb.Empty)
+        self._remove_sandbox = u("RemovePodSandbox", pb.PodSandboxIdRequest,
+                                 pb.Empty)
+        self._list_sandboxes = u("ListPodSandboxes",
+                                 pb.ListPodSandboxesRequest,
+                                 pb.ListPodSandboxesResponse)
+        pi = f"/{IMAGE_SERVICE}/"
+
+        def iu(method, req_cls, resp_cls):
+            return self._channel.unary_unary(
+                pi + method, request_serializer=req_cls.SerializeToString,
+                response_deserializer=resp_cls.FromString)
+        self._pull = iu("PullImage", pb.PullImageRequest,
+                        pb.PullImageResponse)
+        self._image_status = iu("ImageStatus", pb.ImageRefRequest,
+                                pb.ImageStatusResponse)
+        self._remove_image = iu("RemoveImage", pb.ImageRefRequest, pb.Empty)
+        self._list_images = iu("ListImages", pb.ListImagesRequest,
+                               pb.ListImagesResponse)
 
     def version(self) -> tuple[str, str]:
         resp = self._version(pb.VersionRequest(version=RUNTIME_VERSION),
@@ -212,6 +365,7 @@ class RemoteRuntime(ContainerRuntime):
         req = pb.CreateContainerRequest(config=pb.ContainerConfig(
             pod_namespace=config.pod_namespace, pod_name=config.pod_name,
             pod_uid=config.pod_uid, name=config.name, image=config.image,
+            sandbox_id=config.sandbox_id,
             command=list(config.command), args=list(config.args),
             envs=[pb.KeyValue(key=k, value=v) for k, v in config.env.items()],
             working_dir=config.working_dir,
@@ -262,6 +416,104 @@ class RemoteRuntime(ContainerRuntime):
                 raise KeyError(e.details()) from None
             raise
         return resp.exit_code, resp.output
+
+    @staticmethod
+    def _unimpl(e: "grpc.RpcError"):
+        """A server predating an RPC answers UNIMPLEMENTED — surface it
+        as NotImplementedError so agent compat paths treat an old
+        remote runtime exactly like an old in-proc one."""
+        if e.code() == grpc.StatusCode.UNIMPLEMENTED:
+            raise NotImplementedError(e.details()) from None
+        raise e
+
+    # -- sandbox -----------------------------------------------------------
+
+    async def run_pod_sandbox(self, namespace: str, name: str,
+                              uid: str) -> str:
+        try:
+            resp = await asyncio.to_thread(
+                self._run_sandbox, pb.RunPodSandboxRequest(
+                    pod_namespace=namespace, pod_name=name, pod_uid=uid),
+                timeout=60)
+        except grpc.RpcError as e:
+            self._unimpl(e)
+        return resp.sandbox_id
+
+    async def stop_pod_sandbox(self, sandbox_id: str) -> None:
+        try:
+            await asyncio.to_thread(
+                self._stop_sandbox,
+                pb.PodSandboxIdRequest(sandbox_id=sandbox_id), timeout=60)
+        except grpc.RpcError as e:
+            self._unimpl(e)
+
+    async def remove_pod_sandbox(self, sandbox_id: str) -> None:
+        try:
+            await asyncio.to_thread(
+                self._remove_sandbox,
+                pb.PodSandboxIdRequest(sandbox_id=sandbox_id), timeout=60)
+        except grpc.RpcError as e:
+            self._unimpl(e)
+
+    async def list_pod_sandboxes(self) -> list[SandboxStatus]:
+        try:
+            resp = await asyncio.to_thread(
+                self._list_sandboxes, pb.ListPodSandboxesRequest(),
+                timeout=30)
+        except grpc.RpcError as e:
+            self._unimpl(e)
+        return [SandboxStatus(id=s.id, pod_namespace=s.pod_namespace,
+                              pod_name=s.pod_name, pod_uid=s.pod_uid,
+                              state=s.state, created_at=s.created_at)
+                for s in resp.sandboxes]
+
+    # -- images ------------------------------------------------------------
+
+    async def pull_image(self, ref: str) -> str:
+        try:
+            resp = await asyncio.to_thread(
+                self._pull, pb.PullImageRequest(ref=ref), timeout=300)
+        except grpc.RpcError as e:
+            # Round-trip the store's exception contract over the seam.
+            if e.code() == grpc.StatusCode.NOT_FOUND:
+                raise FileNotFoundError(e.details()) from None
+            if e.code() == grpc.StatusCode.FAILED_PRECONDITION:
+                raise ValueError(e.details()) from None
+            self._unimpl(e)
+        return resp.digest
+
+    async def image_status(self, ref: str):
+        try:
+            resp = await asyncio.to_thread(
+                self._image_status, pb.ImageRefRequest(ref=ref), timeout=30)
+        except grpc.RpcError as e:
+            self._unimpl(e)
+        if not resp.present:
+            return None
+        from ..node.images import ImageInfo
+        i = resp.image
+        return ImageInfo(ref=i.ref, digest=i.digest, size_bytes=i.size_bytes,
+                         path=i.path, last_used_at=i.last_used_at,
+                         builtin=i.builtin)
+
+    async def remove_image(self, ref: str) -> None:
+        try:
+            await asyncio.to_thread(
+                self._remove_image, pb.ImageRefRequest(ref=ref), timeout=60)
+        except grpc.RpcError as e:
+            self._unimpl(e)
+
+    async def list_images(self) -> list:
+        from ..node.images import ImageInfo
+        try:
+            resp = await asyncio.to_thread(
+                self._list_images, pb.ListImagesRequest(), timeout=30)
+        except grpc.RpcError as e:
+            self._unimpl(e)
+        return [ImageInfo(ref=i.ref, digest=i.digest,
+                          size_bytes=i.size_bytes, path=i.path,
+                          last_used_at=i.last_used_at, builtin=i.builtin)
+                for i in resp.images]
 
     def close(self) -> None:
         self._channel.close()

@@ -666,9 +666,37 @@ class NodeAgent:
                                          pod.metadata.namespace,
                                          resolve=resolve).items():
                 env.setdefault(k, v)
+        # EnsureImageExists (image_manager.go): pull-if-absent before
+        # the container references it; pull failures are retried by the
+        # pod worker like the reference's ImagePullBackOff.
+        try:
+            if await self.runtime.image_status(container.image) is None:
+                self.recorder.event(pod, "Normal", "Pulling",
+                                    f"pulling image {container.image!r}")
+                await self.runtime.pull_image(container.image)
+                self.recorder.event(pod, "Normal", "Pulled",
+                                    f"pulled image {container.image!r}")
+        except NotImplementedError:
+            pass  # runtime has no image half (direct-runtime users)
+        except Exception as e:  # noqa: BLE001
+            self.recorder.event(pod, "Warning", "FailedPull",
+                                f"{container.image}: {e}")
+            return
+        # Pod sandbox (RunPodSandbox): every container of the pod joins
+        # ONE sandbox; idempotent per pod uid.
+        sandbox_id = ""
+        try:
+            sandbox_id = await self.runtime.run_pod_sandbox(
+                pod.metadata.namespace, pod.metadata.name, pod.metadata.uid)
+        except NotImplementedError:
+            pass  # pre-sandbox runtime: private per-container sandboxes
+        except Exception as e:  # noqa: BLE001
+            self.recorder.event(pod, "Warning", "FailedSandbox", str(e))
+            return
         config = ContainerConfig(
             pod_namespace=pod.metadata.namespace, pod_name=pod.metadata.name,
             pod_uid=pod.metadata.uid, name=container.name, image=container.image,
+            sandbox_id=sandbox_id,
             command=list(container.command), args=list(container.args),
             env=env, working_dir=container.working_dir,
             mounts=mounts, devices=devices,
@@ -915,6 +943,20 @@ class NodeAgent:
             pass  # hooks overran the pod's budget; proceed to kill
         return time.monotonic() - started
 
+    async def _remove_pod_sandboxes(self, uid: str) -> None:
+        """Best-effort sandbox teardown for a pod's uid: pre-sandbox
+        runtimes are a no-op, and a transient runtime error must never
+        abort the caller's bookkeeping cleanup (the GC pass is the
+        backstop for anything left behind)."""
+        try:
+            for sb in await self.runtime.list_pod_sandboxes():
+                if sb.pod_uid == uid:
+                    await self.runtime.remove_pod_sandbox(sb.id)
+        except NotImplementedError:
+            pass  # pre-sandbox runtime
+        except Exception as e:  # noqa: BLE001
+            log.warning("sandbox teardown for pod uid %s failed: %s", uid, e)
+
     async def _terminate_pod(self, pod: t.Pod) -> None:
         key = pod.key()
         log.info("terminating pod %s", key)
@@ -930,6 +972,9 @@ class NodeAgent:
             await self.runtime.stop_container(cid, grace_seconds=stop_grace)
         for cid in cmap.values():
             await self.runtime.remove_container(cid)
+        # Sandbox teardown after its containers (StopPodSandbox ->
+        # RemovePodSandbox ordering in the reference kubelet).
+        await self._remove_pod_sandboxes(pod.metadata.uid)
         self._containers.pop(key, None)
         self._restart_counts.pop(key, None)
         self._restart_at.pop(key, None)
@@ -960,6 +1005,9 @@ class NodeAgent:
             self.ipam.release(uid)
             self._evicted.discard(uid)
             self.volumes.teardown(uid)
+            # Sandbox goes with its pod on the force-delete path too
+            # (grace-0 deletes reach here, not _terminate_pod).
+            await self._remove_pod_sandboxes(uid)
 
     # -- PLEG (pleg/generic.go:110) ---------------------------------------
 

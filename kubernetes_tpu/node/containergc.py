@@ -55,11 +55,14 @@ class ContainerGC:
     def __init__(self, runtime: ContainerRuntime,
                  pod_source: Callable[[], Iterable[t.Pod]],
                  policy: Optional[GCPolicy] = None,
-                 interval: float = 60.0):
+                 interval: float = 60.0,
+                 image_budget_bytes: int = 512 * 2**20):
         self.runtime = runtime
         self.pod_source = pod_source
         self.policy = policy or GCPolicy()
         self.interval = interval
+        #: Byte budget for pulled image artifacts (< 0 disables).
+        self.image_budget_bytes = image_budget_bytes
         self._task: Optional[asyncio.Task] = None
 
     def start(self) -> None:
@@ -132,4 +135,66 @@ class ContainerGC:
                 log.warning("failed to remove container %s: %s", s.id, exc)
         if removed:
             log.info("container GC removed %d dead containers", len(removed))
+
+        # Sandbox GC (kuberuntime_gc.go evictSandboxes): a sandbox whose
+        # pod is gone and whose containers are all removed is garbage —
+        # the backstop for teardown paths the agent missed (crash
+        # between container and sandbox removal).
+        try:
+            remaining = {s.pod_uid for s in await self.runtime.list_containers()}
+            for sb in await self.runtime.list_pod_sandboxes():
+                if sb.pod_uid not in live_uids and sb.pod_uid not in remaining:
+                    try:
+                        await self.runtime.remove_pod_sandbox(sb.id)
+                    except Exception as exc:  # noqa: BLE001
+                        log.warning("failed to remove sandbox %s: %s",
+                                    sb.id, exc)
+        except NotImplementedError:
+            pass  # pre-sandbox runtime
+
+        # Image GC rides the same pass (image_gc_manager.go): LRU-evict
+        # pulled artifacts over budget, pinning images any live pod's
+        # containers still reference. Kubelet-side over the seam's
+        # ListImages/RemoveImage only — works identically against the
+        # in-proc runtime and a remote CRI server.
+        try:
+            await self.collect_images()
+        except NotImplementedError:
+            pass  # runtime has no image half
+        except Exception:  # noqa: BLE001 — GC must never kill the agent
+            log.exception("image GC pass failed")
         return removed
+
+    async def collect_images(self) -> list[str]:
+        """One image-GC pass; returns evicted refs."""
+        if self.image_budget_bytes < 0:
+            return []
+        in_use = {c.image for p in self.pod_source()
+                  for c in (list(p.spec.containers)
+                            + list(p.spec.init_containers))}
+        evicted: list[str] = []
+        skipped: set[str] = set()
+        while True:
+            # Re-list per eviction: shared-digest refs occupy disk
+            # ONCE, so subtracting per-ref sizes locally would end the
+            # pass over budget; the runtime's view is the truth.
+            images = [i for i in await self.runtime.list_images()
+                      if not getattr(i, "builtin", False)]
+            total = sum({i.digest: i.size_bytes for i in images}.values())
+            if total <= self.image_budget_bytes:
+                break
+            victims = [i for i in sorted(images, key=lambda i: i.last_used_at)
+                       if i.ref not in in_use and i.ref not in skipped]
+            if not victims:
+                break  # everything left is pinned or failed to remove
+            victim = victims[0]
+            try:
+                await self.runtime.remove_image(victim.ref)
+                evicted.append(victim.ref)
+            except Exception as exc:  # noqa: BLE001
+                log.warning("failed to remove image %s: %s",
+                            victim.ref, exc)
+                skipped.add(victim.ref)
+        if evicted:
+            log.info("image GC evicted %d images", len(evicted))
+        return evicted

@@ -1,0 +1,174 @@
+"""Image store — the image-pull + image-GC half of the runtime.
+
+Reference: the kubelet's EnsureImageExists path (``pkg/kubelet/images/
+image_manager.go``) over the CRI ImageService (``api.proto:90``), and
+the disk-pressure image GC (``pkg/kubelet/images/image_gc_manager.go``).
+
+TPU-native shape: the process runtime's "image" is a verified artifact
+— a binary, archive, or wheel a training job needs — not an OCI layer
+stack. Refs:
+
+- ``inline``/empty: the built-in image (the host env); always present.
+- ``file:///abs/path`` or a plain path: a single-file artifact copied
+  into the content-addressed store; append ``#sha256=<hex>`` and the
+  pull VERIFIES the content hash (supply-chain check the reference
+  delegates to registry digests).
+
+Stored as ``<dir>/<sha256>/<basename>`` with a json sidecar per ref.
+Image GC is kubelet-side (``containergc.ContainerGC.collect_images``)
+over the seam's ListImages/RemoveImage, so it works identically for a
+remote CRI runtime.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+def is_artifact_ref(ref: str) -> bool:
+    """Artifact refs are path-shaped (``file://...``, absolute or
+    relative paths). Anything else ("inline", "pause", "img:v1", ...)
+    is a name for the built-in host environment — the process runtime's
+    containers are commands, their default image IS the host (docstring
+    above); only path refs have bytes to pull/verify/GC."""
+    return ref.startswith(("file://", "/", "./"))
+
+
+@dataclass
+class ImageInfo:
+    ref: str = ""
+    digest: str = ""
+    size_bytes: int = 0
+    path: str = ""
+    last_used_at: float = 0.0
+    #: Built-ins are not evictable and occupy no store bytes.
+    builtin: bool = False
+
+
+class ImageNotPresentError(KeyError):
+    """start_container with a never-pulled image (the agent's
+    EnsureImageExists must run first)."""
+
+
+class ImageStore:
+    def __init__(self, directory: str):
+        self.dir = directory
+        os.makedirs(directory, exist_ok=True)
+        #: ref -> ImageInfo (rebuilt from sidecars — crash-only).
+        self._images: dict[str, ImageInfo] = {}
+        self._load()
+
+    # -- persistence (crash-only: sidecars are the truth) ------------------
+
+    def _sidecar(self, digest: str) -> str:
+        # digest carries the "sha256:" prefix; the on-disk dir is the
+        # bare hex (shared with the artifact itself).
+        return os.path.join(self.dir, digest.split(":", 1)[-1], "image.json")
+
+    def _load(self) -> None:
+        for d in os.listdir(self.dir) if os.path.isdir(self.dir) else []:
+            try:
+                meta = json.load(open(os.path.join(self.dir, d, "image.json")))
+                for rec in meta.get("images", []):
+                    info = ImageInfo(**rec)
+                    if os.path.exists(info.path):
+                        self._images[info.ref] = info
+            except (OSError, ValueError, TypeError):
+                continue
+
+    def _save(self, info: ImageInfo) -> None:
+        """Rewrite the digest's sidecar with EVERY ref sharing it —
+        one sidecar per digest dir, many refs (same content pulled
+        under several names must all survive a restart)."""
+        recs = [i.__dict__ for i in self._images.values()
+                if i.digest == info.digest]
+        if info.ref not in {r["ref"] for r in recs}:
+            recs.append(info.__dict__)
+        os.makedirs(os.path.dirname(self._sidecar(info.digest)), exist_ok=True)
+        with open(self._sidecar(info.digest), "w") as f:
+            json.dump({"images": recs}, f)
+
+    # -- resolution --------------------------------------------------------
+
+    @staticmethod
+    def parse_ref(ref: str) -> tuple[str, str]:
+        """(source path, expected sha256 hex or '')."""
+        want = ""
+        if "#sha256=" in ref:
+            ref, _, want = ref.partition("#sha256=")
+        if ref.startswith("file://"):
+            ref = ref[len("file://"):]
+        return ref, want.lower()
+
+    # -- ImageService verbs ------------------------------------------------
+
+    def pull(self, ref: str) -> ImageInfo:
+        """Idempotent fetch+verify into the store."""
+        if not is_artifact_ref(ref):
+            return ImageInfo(ref=ref or "inline", builtin=True,
+                             last_used_at=time.time())
+        cached = self._images.get(ref)
+        if cached is not None and os.path.exists(cached.path):
+            cached.last_used_at = time.time()
+            self._save(cached)
+            return cached
+        src, want = self.parse_ref(ref)
+        if not os.path.isfile(src):
+            raise FileNotFoundError(
+                f"image ref {ref!r}: {src!r} is not a file (the process "
+                f"runtime pulls single-file artifacts)")
+        h = hashlib.sha256()
+        with open(src, "rb") as f:
+            for chunk in iter(lambda: f.read(1 << 20), b""):
+                h.update(chunk)
+        digest = h.hexdigest()
+        if want and want != digest:
+            raise ValueError(
+                f"image ref {ref!r}: digest mismatch (want sha256:{want}, "
+                f"got sha256:{digest}) — refusing the artifact")
+        dest = os.path.join(self.dir, digest, os.path.basename(src))
+        os.makedirs(os.path.dirname(dest), exist_ok=True)
+        if not os.path.exists(dest):
+            shutil.copy2(src, dest)
+        info = ImageInfo(ref=ref, digest=f"sha256:{digest}",
+                         size_bytes=os.path.getsize(dest), path=dest,
+                         last_used_at=time.time())
+        self._images[ref] = info
+        self._save(info)
+        return info
+
+    def status(self, ref: str) -> Optional[ImageInfo]:
+        if not is_artifact_ref(ref):
+            return ImageInfo(ref=ref or "inline", builtin=True)
+        info = self._images.get(ref)
+        if info is None or not os.path.exists(info.path):
+            return None
+        return info
+
+    def remove(self, ref: str) -> None:
+        if not is_artifact_ref(ref):
+            return  # built-ins are not removable
+        info = self._images.pop(ref, None)
+        if info is None:
+            return
+        # Other refs may share the digest dir (same content, different
+        # name) — only delete when this was the last one; otherwise
+        # rewrite the sidecar without this ref.
+        if not any(i.digest == info.digest for i in self._images.values()):
+            shutil.rmtree(os.path.dirname(info.path), ignore_errors=True)
+        else:
+            survivor = next(i for i in self._images.values()
+                            if i.digest == info.digest)
+            self._save(survivor)
+
+    def list(self) -> list[ImageInfo]:
+        return list(self._images.values())
+
+    def total_bytes(self) -> int:
+        # Shared-digest refs count once, like the disk they occupy.
+        return sum({i.digest: i.size_bytes
+                    for i in self._images.values()}.values())

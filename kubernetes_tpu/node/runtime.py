@@ -36,6 +36,10 @@ class ContainerConfig:
     pod_uid: str = ""
     name: str = ""
     image: str = ""
+    #: Pod sandbox this container joins (run_pod_sandbox's id); empty =
+    #: the runtime fabricates a private per-container sandbox
+    #: (pre-sandbox compatibility for direct runtime users).
+    sandbox_id: str = ""
     command: list[str] = field(default_factory=list)
     args: list[str] = field(default_factory=list)
     env: dict[str, str] = field(default_factory=dict)
@@ -62,6 +66,24 @@ class ContainerStatus:
     pid: int = 0
 
 
+SANDBOX_READY = "ready"
+SANDBOX_NOTREADY = "notready"
+
+
+@dataclass
+class SandboxStatus:
+    """Pod-level sandbox (reference: PodSandbox — the pause container's
+    role). For the process runtime a sandbox is the pod's shared
+    directory + lifecycle record; containers of one pod join it."""
+
+    id: str = ""
+    pod_namespace: str = ""
+    pod_name: str = ""
+    pod_uid: str = ""
+    state: str = SANDBOX_READY
+    created_at: float = 0.0
+
+
 class ContainerRuntime:
     async def start_container(self, config: ContainerConfig) -> str:
         raise NotImplementedError
@@ -85,6 +107,37 @@ class ContainerRuntime:
         (``pkg/kubelet/server/server.go`` exec handlers)."""
         raise NotImplementedError
 
+    # -- pod sandbox (RunPodSandbox/... in the reference CRI) -------------
+
+    async def run_pod_sandbox(self, namespace: str, name: str,
+                              uid: str) -> str:
+        raise NotImplementedError
+
+    async def stop_pod_sandbox(self, sandbox_id: str) -> None:
+        raise NotImplementedError
+
+    async def remove_pod_sandbox(self, sandbox_id: str) -> None:
+        raise NotImplementedError
+
+    async def list_pod_sandboxes(self) -> list[SandboxStatus]:
+        raise NotImplementedError
+
+    # -- images (the CRI ImageService, api.proto:90) ----------------------
+
+    async def pull_image(self, ref: str) -> str:
+        """Fetch+verify ``ref``; returns the digest (EnsureImageExists)."""
+        raise NotImplementedError
+
+    async def image_status(self, ref: str):
+        """ImageInfo or None (not present)."""
+        raise NotImplementedError
+
+    async def remove_image(self, ref: str) -> None:
+        raise NotImplementedError
+
+    async def list_images(self) -> list:
+        raise NotImplementedError
+
 
 class ProcessRuntime(ContainerRuntime):
     """Pods as local OS processes under a per-node root directory."""
@@ -100,10 +153,16 @@ class ProcessRuntime(ContainerRuntime):
         self._procs: dict[str, asyncio.subprocess.Process] = {}
         self._status: dict[str, ContainerStatus] = {}
         self._waiters: dict[str, asyncio.Task] = {}
+        self._sandboxes: dict[str, SandboxStatus] = {}
+        from .images import ImageStore
+        self.images = ImageStore(os.path.join(root_dir, "images"))
         self._seq = 0
 
     def _log_path(self, cid: str) -> str:
         return os.path.join(self.root_dir, "logs", f"{cid}.log")
+
+    def _sandbox_dir(self, sid: str) -> str:
+        return os.path.join(self.root_dir, "sandboxes", sid)
 
     def _container_env(self, config: ContainerConfig, cid: str) -> dict:
         """The container's full environment — shared by start and exec
@@ -112,10 +171,69 @@ class ProcessRuntime(ContainerRuntime):
         env = dict(os.environ)
         env.update(config.env)
         env["KTPU_POD"] = f"{config.pod_namespace}/{config.pod_name}"
-        env["KTPU_SANDBOX"] = os.path.join(self.root_dir, "sandboxes", cid)
+        env["KTPU_SANDBOX"] = self._sandbox_dir(config.sandbox_id or cid)
         env["PYTHONPATH"] = (f"{self._host_cwd}:{env['PYTHONPATH']}"
                              if env.get("PYTHONPATH") else self._host_cwd)
+        img = self.images.status(config.image)
+        if img is not None and not img.builtin:
+            # The pulled artifact's path — how a process container
+            # consumes its "image" (binary/archive/wheel).
+            env["KTPU_IMAGE"] = img.path
         return env
+
+    # -- pod sandbox -------------------------------------------------------
+
+    async def run_pod_sandbox(self, namespace: str, name: str,
+                              uid: str) -> str:
+        sid = f"sb-{uid[:12]}"
+        existing = self._sandboxes.get(sid)
+        if existing is not None and existing.state == SANDBOX_READY:
+            return sid  # idempotent: the pod's sandbox already runs
+        os.makedirs(self._sandbox_dir(sid), exist_ok=True)
+        self._sandboxes[sid] = SandboxStatus(
+            id=sid, pod_namespace=namespace, pod_name=name, pod_uid=uid,
+            state=SANDBOX_READY, created_at=time.time())
+        return sid
+
+    async def stop_pod_sandbox(self, sandbox_id: str) -> None:
+        sb = self._sandboxes.get(sandbox_id)
+        if sb is None:
+            return
+        # Stopping the sandbox stops every container still in it
+        # (reference: StopPodSandbox kills the pod's netns holder and
+        # the kubelet expects containers to die with it).
+        for cid, cfg in list(self._configs.items()):
+            if cfg.sandbox_id == sandbox_id:
+                await self.stop_container(cid, grace_seconds=1.0)
+        sb.state = SANDBOX_NOTREADY
+
+    async def remove_pod_sandbox(self, sandbox_id: str) -> None:
+        await self.stop_pod_sandbox(sandbox_id)
+        for cid, cfg in list(self._configs.items()):
+            if cfg.sandbox_id == sandbox_id:
+                await self.remove_container(cid)
+        self._sandboxes.pop(sandbox_id, None)
+        shutil.rmtree(self._sandbox_dir(sandbox_id), ignore_errors=True)
+
+    async def list_pod_sandboxes(self) -> list[SandboxStatus]:
+        return list(self._sandboxes.values())
+
+    # -- images ------------------------------------------------------------
+
+    async def pull_image(self, ref: str) -> str:
+        # Hashing/copying a large artifact would stall the loop — the
+        # store is sync (thread-safe for distinct refs), so thread it.
+        info = await asyncio.to_thread(self.images.pull, ref)
+        return info.digest
+
+    async def image_status(self, ref: str):
+        return self.images.status(ref)
+
+    async def remove_image(self, ref: str) -> None:
+        self.images.remove(ref)
+
+    async def list_images(self) -> list:
+        return self.images.list()
 
     async def start_container(self, config: ContainerConfig) -> str:
         self._seq += 1
@@ -125,14 +243,21 @@ class ProcessRuntime(ContainerRuntime):
             raise RuntimeError(f"container {config.name}: no command (image "
                                f"{config.image!r} is not a registry image in "
                                f"the process runtime)")
+        from .images import ImageNotPresentError, is_artifact_ref
+        if is_artifact_ref(config.image) \
+                and self.images.status(config.image) is None:
+            # Reference contract: CreateContainer with an unpulled image
+            # fails; EnsureImageExists (the agent) must pull first.
+            raise ImageNotPresentError(
+                f"image {config.image!r} not present; pull it first")
         env = self._container_env(config, cid)
-        # Mount projection without privileges: a per-container sandbox
-        # dir where each mount path appears as a symlink to its host
+        # Mount projection without privileges: a per-(pod-)sandbox dir
+        # where each mount path appears as a symlink to its host
         # source, and which is the default cwd — so a container reading
         # its declared mount_path (relative, or absolute re-rooted
         # under the sandbox) sees the volume. A real CRI runtime would
         # bind-mount instead (reference: dockershim container config).
-        sandbox = os.path.join(self.root_dir, "sandboxes", cid)
+        sandbox = self._sandbox_dir(config.sandbox_id or cid)
         os.makedirs(sandbox, exist_ok=True)
         mount_paths = [c.rstrip("/") for _, c, _ in config.mounts]
         for i, a in enumerate(mount_paths):
@@ -149,6 +274,14 @@ class ProcessRuntime(ContainerRuntime):
             link = os.path.join(sandbox, cpath.lstrip("/"))
             os.makedirs(os.path.dirname(link), exist_ok=True)
             if os.path.islink(link):
+                if os.readlink(link) != host and config.sandbox_id:
+                    # A SIBLING container in this shared pod sandbox
+                    # already mounts a different volume here; silently
+                    # re-pointing would swap its volume mid-run.
+                    raise RuntimeError(
+                        f"container {config.name}: mount path {cpath!r} "
+                        f"already bound to a different source by another "
+                        f"container in the pod sandbox")
                 os.unlink(link)
             elif os.path.exists(link):
                 # Nested/duplicate mount paths cannot be projected with
@@ -221,6 +354,13 @@ class ProcessRuntime(ContainerRuntime):
             except (ProcessLookupError, PermissionError):
                 pass
             await proc.wait()
+        # Record the exit HERE, not only in the _wait task — a caller
+        # observing statuses right after stop must see exited (the
+        # waiter sets the same fields idempotently when it runs).
+        if st.state != STATE_EXITED:
+            st.state = STATE_EXITED
+            st.exit_code = proc.returncode if proc.returncode is not None else -1
+            st.finished_at = time.time()
 
     async def remove_container(self, container_id: str) -> None:
         await self.stop_container(container_id, grace_seconds=0.1)
@@ -294,6 +434,8 @@ class FakeRuntime(ContainerRuntime):
         self._status: dict[str, ContainerStatus] = {}
         self._configs: dict[str, ContainerConfig] = {}
         self._logs: dict[str, str] = {}
+        self._sandboxes: dict[str, SandboxStatus] = {}
+        self._images: dict[str, float] = {}
         self._seq = 0
         self.start_delay = start_delay
 
@@ -338,3 +480,50 @@ class FakeRuntime(ContainerRuntime):
         if container_id not in self._status:
             raise KeyError(f"unknown container {container_id!r}")
         return 0, f"(fake exec) {' '.join(argv)}\n"
+
+    # -- sandbox + images (hollow-node fakes) ------------------------------
+
+    async def run_pod_sandbox(self, namespace: str, name: str,
+                              uid: str) -> str:
+        sid = f"sb-{uid[:12]}"
+        self._sandboxes.setdefault(sid, SandboxStatus(
+            id=sid, pod_namespace=namespace, pod_name=name, pod_uid=uid,
+            state=SANDBOX_READY, created_at=time.time()))
+        self._sandboxes[sid].state = SANDBOX_READY
+        return sid
+
+    async def stop_pod_sandbox(self, sandbox_id: str) -> None:
+        sb = self._sandboxes.get(sandbox_id)
+        if sb is not None:
+            for cid, cfg in list(self._configs.items()):
+                if cfg.sandbox_id == sandbox_id:
+                    self.exit_container(cid, code=137)
+            sb.state = SANDBOX_NOTREADY
+
+    async def remove_pod_sandbox(self, sandbox_id: str) -> None:
+        await self.stop_pod_sandbox(sandbox_id)
+        self._sandboxes.pop(sandbox_id, None)
+
+    async def list_pod_sandboxes(self) -> list[SandboxStatus]:
+        return list(self._sandboxes.values())
+
+    async def pull_image(self, ref: str) -> str:
+        self._images[ref] = time.time()
+        return f"sha256:fake-{abs(hash(ref)):x}"
+
+    async def image_status(self, ref: str):
+        from .images import ImageInfo, is_artifact_ref
+        if not is_artifact_ref(ref):
+            return ImageInfo(ref=ref or "inline", builtin=True)
+        if ref not in self._images:
+            return None
+        return ImageInfo(ref=ref, digest=f"sha256:fake-{abs(hash(ref)):x}",
+                         last_used_at=self._images[ref])
+
+    async def remove_image(self, ref: str) -> None:
+        self._images.pop(ref, None)
+
+    async def list_images(self) -> list:
+        from .images import ImageInfo
+        return [ImageInfo(ref=r, last_used_at=at)
+                for r, at in self._images.items()]

@@ -1,0 +1,189 @@
+"""Image service + pod sandboxes, in-proc and over the CRI seam.
+
+Reference: the CRI ImageService (``api.proto:90``), EnsureImageExists
+(``pkg/kubelet/images/image_manager.go``), image GC
+(``image_gc_manager.go``), and the PodSandbox lifecycle.
+"""
+import asyncio
+import os
+
+import pytest
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.node.containergc import ContainerGC
+from kubernetes_tpu.node.images import ImageNotPresentError, ImageStore
+from kubernetes_tpu.node.runtime import (SANDBOX_NOTREADY, SANDBOX_READY,
+                                         ContainerConfig, ProcessRuntime)
+
+
+def make_artifact(tmp_path, name="model.bin", content=b"weights-v1"):
+    p = tmp_path / name
+    p.write_bytes(content)
+    return str(p)
+
+
+def test_image_store_pull_verify_remove(tmp_path):
+    store = ImageStore(str(tmp_path / "store"))
+    src = make_artifact(tmp_path)
+
+    # Builtins: always present, never pulled bytes.
+    assert store.status("inline").builtin
+    assert store.status("img:v1").builtin
+    assert store.pull("pause").builtin
+
+    info = store.pull(src)
+    assert info.digest.startswith("sha256:")
+    assert os.path.exists(info.path)
+    assert store.status(src).digest == info.digest
+    # Idempotent; updates last_used.
+    again = store.pull(src)
+    assert again.path == info.path
+
+    # Digest pinning: the right pin passes, a wrong pin refuses.
+    good = info.digest.split(":", 1)[1]
+    pinned = f"file://{src}#sha256={good}"
+    assert store.pull(pinned).digest == info.digest
+    with pytest.raises(ValueError, match="digest mismatch"):
+        store.pull(f"file://{src}#sha256={'0' * 64}")
+
+    # Missing source: pull error, status None.
+    with pytest.raises(FileNotFoundError):
+        store.pull(str(tmp_path / "nope.bin"))
+    assert store.status(str(tmp_path / "nope.bin")) is None
+
+    store.remove(src)
+    assert store.status(src) is None
+    # The pinned ref shares the digest, so the bytes stay on disk ...
+    assert os.path.exists(info.path)
+    store.remove(pinned)
+    # ... and go only with the last ref.
+    assert not os.path.exists(info.path)
+
+    # Crash-only: a second store over the same dir rebuilds from disk.
+    store.pull(pinned)
+    store2 = ImageStore(str(tmp_path / "store"))
+    assert store2.status(pinned) is not None
+
+
+async def test_runtime_requires_pulled_artifact(tmp_path):
+    rt = ProcessRuntime(str(tmp_path / "rt"))
+    src = make_artifact(tmp_path)
+    cfg = ContainerConfig(pod_uid="u1", name="c", image=src,
+                          command=["true"])
+    with pytest.raises(ImageNotPresentError):
+        await rt.start_container(cfg)
+    await rt.pull_image(src)
+    cid = await rt.start_container(cfg)
+    # The artifact's store path rides the env.
+    env = rt._container_env(cfg, cid)
+    assert env["KTPU_IMAGE"].endswith("model.bin")
+    await rt.remove_container(cid)
+    await rt.shutdown()
+
+
+async def test_pod_sandbox_shared_and_torn_down(tmp_path):
+    """Two containers of one pod share ONE sandbox dir; removing the
+    sandbox stops and removes what is left in it."""
+    rt = ProcessRuntime(str(tmp_path / "rt"))
+    sid = await rt.run_pod_sandbox("default", "p", "uid-12345678")
+    assert sid == await rt.run_pod_sandbox("default", "p", "uid-12345678")
+
+    async def wait_exited(cid, timeout=15.0):
+        for _ in range(int(timeout / 0.1)):
+            st = {s.id: s for s in await rt.list_containers()}[cid]
+            if st.state == "exited":
+                return st
+            await asyncio.sleep(0.1)
+        raise TimeoutError(cid)
+
+    c1 = await rt.start_container(ContainerConfig(
+        pod_uid="uid-12345678", name="a", sandbox_id=sid,
+        command=["python3", "-c",
+                 "import os;open('shared.txt','w').write('x')"]))
+    assert (await wait_exited(c1)).exit_code == 0
+    c2 = await rt.start_container(ContainerConfig(
+        pod_uid="uid-12345678", name="b", sandbox_id=sid,
+        command=["python3", "-c",
+                 "print(open('shared.txt').read())"]))
+    assert (await wait_exited(c2)).exit_code == 0
+    logs = await rt.container_logs(c2)
+    assert "x" in logs  # b saw a's file: same sandbox cwd
+
+    sleeper = await rt.start_container(ContainerConfig(
+        pod_uid="uid-12345678", name="s", sandbox_id=sid,
+        command=["sleep", "30"]))
+    await rt.stop_pod_sandbox(sid)
+    sbs = {s.id: s for s in await rt.list_pod_sandboxes()}
+    assert sbs[sid].state == SANDBOX_NOTREADY
+    st = {s.id: s for s in await rt.list_containers()}[sleeper]
+    assert st.state == "exited"  # sandbox stop took its containers
+
+    await rt.remove_pod_sandbox(sid)
+    assert not any(s.id == sid for s in await rt.list_pod_sandboxes())
+    assert not os.path.isdir(os.path.join(str(tmp_path / "rt"),
+                                          "sandboxes", sid))
+    await rt.shutdown()
+
+
+async def test_image_gc_over_seam(tmp_path):
+    """Kubelet-side image GC through list/remove only: LRU eviction to
+    budget, in-use images pinned."""
+    rt = ProcessRuntime(str(tmp_path / "rt"))
+    old = make_artifact(tmp_path, "old.bin", b"o" * 100)
+    used = make_artifact(tmp_path, "used.bin", b"u" * 100)
+    new = make_artifact(tmp_path, "new.bin", b"n" * 100)
+    await rt.pull_image(old)
+    await asyncio.sleep(0.02)
+    await rt.pull_image(used)
+    await asyncio.sleep(0.02)
+    await rt.pull_image(new)
+
+    pod = t.Pod(metadata=ObjectMeta(name="p", namespace="default", uid="u"),
+                spec=t.PodSpec(containers=[t.Container(name="c", image=used)]))
+    gc = ContainerGC(rt, pod_source=lambda: [pod], image_budget_bytes=150)
+    evicted = await gc.collect_images()
+    # old (LRU) goes first; used is pinned despite being older than new.
+    assert old in evicted and used not in evicted
+    refs = {i.ref for i in await rt.list_images()}
+    assert used in refs
+    await rt.shutdown()
+
+
+async def test_full_cri_seam_roundtrip(tmp_path):
+    """Sandbox + image + container lifecycle entirely over the gRPC
+    socket — what a containerd replacement must implement."""
+    from kubernetes_tpu.cri import CRIServer, RemoteRuntime
+    backend = ProcessRuntime(str(tmp_path / "rt"))
+    server = CRIServer(backend)
+    server.serve(str(tmp_path / "cri.sock"))
+    remote = RemoteRuntime(server.socket_path)
+    try:
+        src = make_artifact(tmp_path)
+        assert await remote.image_status(src) is None
+        digest = await remote.pull_image(src)
+        assert digest.startswith("sha256:")
+        assert (await remote.image_status(src)).digest == digest
+        assert any(i.ref == src for i in await remote.list_images())
+
+        with pytest.raises(ValueError):
+            await remote.pull_image(f"file://{src}#sha256={'0' * 64}")
+        with pytest.raises(FileNotFoundError):
+            await remote.pull_image(str(tmp_path / "missing.bin"))
+
+        sid = await remote.run_pod_sandbox("default", "p", "uid-abcdefgh")
+        cid = await remote.start_container(ContainerConfig(
+            pod_uid="uid-abcdefgh", name="c", image=src, sandbox_id=sid,
+            command=["sleep", "5"]))
+        sbs = await remote.list_pod_sandboxes()
+        assert [s.state for s in sbs if s.id == sid] == [SANDBOX_READY]
+        await remote.remove_pod_sandbox(sid)
+        statuses = {s.id: s for s in await remote.list_containers()}
+        assert cid not in statuses  # removed with its sandbox
+
+        await remote.remove_image(src)
+        assert await remote.image_status(src) is None
+    finally:
+        remote.close()
+        server.stop()
+        await backend.shutdown()
