@@ -328,8 +328,73 @@ async def cmd_logs(args) -> int:
         await client.close()
 
 
+async def exec_interactive(base: str, namespace: str, pod: str,
+                           container: str, argv: list[str],
+                           stdin_source=None, out=None,
+                           timeout: float = 3600.0) -> int:
+    """Drive the node server's WebSocket exec stream: binary frames are
+    stdio; the closing text frame carries the exit code. Reusable by
+    tests (stdin_source: async iterator of bytes; None = process stdin)."""
+    import aiohttp
+    out = out or (lambda b: (sys.stdout.write(
+        b.decode(errors="replace")), sys.stdout.flush()))
+    from urllib.parse import quote
+    url = (f"{base}/exec/{namespace}/{pod}/{container}/stream"
+           f"?timeout={timeout}"
+           + "".join(f"&command={quote(a)}" for a in argv))
+    exit_code = 1
+    async with aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=timeout + 30)) as s:
+        async with s.ws_connect(url) as ws:
+            async def feed():
+                try:
+                    if stdin_source is None:
+                        # A DAEMON thread reads local stdin: blocked
+                        # readline threads from run_in_executor are
+                        # joined at interpreter exit and would hang
+                        # ktl after the remote command finishes.
+                        import queue as queuelib
+                        import threading
+                        q: asyncio.Queue = asyncio.Queue()
+                        loop = asyncio.get_running_loop()
+
+                        def pump():
+                            for line in sys.stdin:
+                                loop.call_soon_threadsafe(
+                                    q.put_nowait, line.encode())
+                            loop.call_soon_threadsafe(q.put_nowait, None)
+                        threading.Thread(target=pump, daemon=True).start()
+                        while True:
+                            chunk = await q.get()
+                            if chunk is None:
+                                break
+                            await ws.send_bytes(chunk)
+                    else:
+                        async for chunk in stdin_source:
+                            await ws.send_bytes(chunk)
+                    await ws.send_str("EOF")
+                except (ConnectionResetError, asyncio.CancelledError):
+                    pass
+            feeder = asyncio.get_running_loop().create_task(feed())
+            try:
+                async for msg in ws:
+                    if msg.type == aiohttp.WSMsgType.BINARY:
+                        out(msg.data)
+                    elif msg.type == aiohttp.WSMsgType.TEXT:
+                        body = json.loads(msg.data)
+                        if "exit_code" in body:
+                            exit_code = int(body["exit_code"])
+                        if body.get("error"):
+                            print(f"ktl: {body['error']}", file=sys.stderr)
+                        break
+            finally:
+                feeder.cancel()
+    return exit_code
+
+
 async def cmd_exec(args) -> int:
-    """Run a command in a running container (kubectl exec analog)."""
+    """Run a command in a running container (kubectl exec analog);
+    ``-i`` switches to the interactive WebSocket stream."""
     client = make_client(args)
     try:
         pod = await client.get("pods", args.namespace, args.pod)
@@ -340,14 +405,24 @@ async def cmd_exec(args) -> int:
             raise SystemExit(f"ktl: node {pod.spec.node_name} has no "
                              "reachable agent server")
         container = args.container or "-"
+        if getattr(args, "stdin", False):
+            # Interactive sessions outlive the one-shot default; an
+            # EXPLICIT --timeout always wins (None = flag omitted).
+            timeout = args.timeout if args.timeout is not None else 3600.0
+            return await exec_interactive(
+                base, args.namespace, args.pod, container, args.cmd,
+                timeout=timeout)
         import aiohttp
         # The HTTP call must outlive the exec's own timeout (aiohttp's
         # default 300s total would abort long execs client-side).
-        client_timeout = aiohttp.ClientTimeout(total=args.timeout + 30)
+        client_timeout = aiohttp.ClientTimeout(
+            total=(args.timeout if args.timeout is not None else 30.0) + 30)
         async with aiohttp.ClientSession(timeout=client_timeout) as s:
             url = f"{base}/exec/{args.namespace}/{args.pod}/{container}"
+            one_shot_timeout = (args.timeout if args.timeout is not None
+                                else 30.0)
             async with s.post(url, json={"command": args.cmd,
-                                         "timeout": args.timeout}) as r:
+                                         "timeout": one_shot_timeout}) as r:
                 if r.status != 200:
                     raise SystemExit(f"ktl: {(await r.text()).strip()}")
                 body = await r.json()
@@ -355,6 +430,95 @@ async def cmd_exec(args) -> int:
         return int(body["exit_code"])
     finally:
         await client.close()
+
+
+async def forward_port(base: str, namespace: str, pod: str,
+                       local_port: int, remote_port: int,
+                       ready: Optional[asyncio.Event] = None,
+                       stop: Optional[asyncio.Event] = None,
+                       on_bound=None) -> int:
+    """Listen on 127.0.0.1:local_port; tunnel each connection through
+    the node server's port-forward WebSocket to the pod's remote_port.
+    Runs until ``stop`` (or forever). Returns the bound local port."""
+    import aiohttp
+
+    async def handle(reader, writer):
+        url = f"{base}/portforward/{namespace}/{pod}/{remote_port}"
+        try:
+            async with aiohttp.ClientSession() as s:
+                async with s.ws_connect(url) as ws:
+                    async def ws_to_tcp():
+                        try:
+                            async for msg in ws:
+                                if msg.type == aiohttp.WSMsgType.BINARY:
+                                    writer.write(msg.data)
+                                    await writer.drain()
+                        except (ConnectionResetError,
+                                asyncio.CancelledError):
+                            pass
+                        finally:
+                            writer.close()
+                    pump = asyncio.get_running_loop().create_task(ws_to_tcp())
+                    try:
+                        while True:
+                            data = await reader.read(65536)
+                            if not data:
+                                break
+                            await ws.send_bytes(data)
+                    finally:
+                        pump.cancel()
+                        await ws.close()
+        except aiohttp.ClientError as e:
+            print(f"ktl: port-forward stream failed: {e}", file=sys.stderr)
+            writer.close()
+
+    server = await asyncio.start_server(handle, "127.0.0.1", local_port)
+    bound = server.sockets[0].getsockname()[1]
+    if on_bound is not None:
+        on_bound(bound)
+    if ready is not None:
+        ready.set()
+    try:
+        if stop is None:
+            await asyncio.Event().wait()  # forever (SIGINT exits)
+        else:
+            await stop.wait()
+    finally:
+        server.close()
+        await server.wait_closed()
+    return bound
+
+
+async def cmd_port_forward(args) -> int:
+    """kubectl port-forward analog: LOCAL:REMOTE over the node server's
+    WebSocket tunnel."""
+    client = make_client(args)
+    try:
+        pod = await client.get("pods", args.namespace, args.pod)
+        if not pod.spec.node_name:
+            raise SystemExit(f"ktl: pod {args.pod} is not scheduled yet")
+        base = await _node_daemon_base(client, pod.spec.node_name)
+        if base is None:
+            raise SystemExit(f"ktl: node {pod.spec.node_name} has no "
+                             "reachable agent server")
+    finally:
+        await client.close()
+    local_s, _, remote_s = args.ports.partition(":")
+    local = int(local_s)
+    remote = int(remote_s) if remote_s else local
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            loop.add_signal_handler(sig, stop.set)
+        except NotImplementedError:
+            signal.signal(sig, lambda *_: stop.set())
+    await forward_port(
+        base, args.namespace, args.pod, local, remote, stop=stop,
+        on_bound=lambda p: print(f"forwarding 127.0.0.1:{p} -> "
+                                 f"{args.pod}:{remote} (Ctrl-C to stop)",
+                                 flush=True))
+    return 0
 
 
 async def cmd_scale(args) -> int:
@@ -931,8 +1095,21 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("cmd", nargs="+", help="command (prefix with -- )")
     sp.add_argument("-n", "--namespace", default="default")
     sp.add_argument("-c", "--container", default="")
-    sp.add_argument("--timeout", type=float, default=30.0,
-                    help="kill the command after this many seconds")
+    sp.add_argument("-i", "--stdin", action="store_true", default=False,
+                    help="interactive: stream local stdin to the "
+                         "command over a WebSocket (use with -t/-it)")
+    sp.add_argument("-t", "--tty", action="store_true", default=False,
+                    help="accepted for kubectl parity (streams are "
+                         "pipe-based; no pty allocation)")
+    sp.add_argument("--timeout", type=float, default=None,
+                    help="kill the command after this many seconds "
+                         "(default 30, or 3600 with -i)")
+
+    sp = add("port-forward", cmd_port_forward,
+             help="tunnel a local port to a pod port")
+    sp.add_argument("pod")
+    sp.add_argument("ports", help="LOCAL[:REMOTE] (0 = pick a free port)")
+    sp.add_argument("-n", "--namespace", default="default")
 
     sp = add("rollout", cmd_rollout, help="status/history/undo a rollout")
     sp.add_argument("action", choices=["status", "history", "undo"])

@@ -107,6 +107,19 @@ class ContainerRuntime:
         (``pkg/kubelet/server/server.go`` exec handlers)."""
         raise NotImplementedError
 
+    async def exec_stream(self, container_id: str, argv: list[str],
+                          on_output, stdin: "asyncio.Queue",
+                          timeout: float = 3600.0) -> int:
+        """INTERACTIVE exec (kubectl exec -it): run argv in the
+        container's context with a live stdin/stdout pipe.
+
+        ``on_output``: async callable awaited with each output chunk
+        (bytes). ``stdin``: asyncio.Queue of bytes chunks; ``None``
+        closes the child's stdin (EOF). Returns the exit code.
+        Reference: the kubelet's getExec streaming endpoint
+        (``pkg/kubelet/server/server.go:316``)."""
+        raise NotImplementedError
+
     # -- pod sandbox (RunPodSandbox/... in the reference CRI) -------------
 
     async def run_pod_sandbox(self, namespace: str, name: str,
@@ -418,6 +431,71 @@ class ProcessRuntime(ContainerRuntime):
             await proc.wait()
             return 124, "exec timed out"
         return proc.returncode or 0, out.decode(errors="replace")
+
+    async def exec_stream(self, container_id: str, argv: list[str],
+                          on_output, stdin: "asyncio.Queue",
+                          timeout: float = 3600.0) -> int:
+        """Interactive exec with live pipes (same env/sandbox view as
+        :meth:`exec_in_container`)."""
+        config = self._configs.get(container_id)
+        if config is None:
+            raise KeyError(f"unknown container {container_id!r}")
+        env = self._container_env(config, container_id)
+        sandbox = env["KTPU_SANDBOX"]
+        proc = await asyncio.create_subprocess_exec(
+            *argv, stdin=asyncio.subprocess.PIPE,
+            stdout=asyncio.subprocess.PIPE,
+            stderr=asyncio.subprocess.STDOUT, env=env,
+            cwd=config.working_dir or
+            (sandbox if os.path.isdir(sandbox) else None),
+            start_new_session=True)
+
+        async def pump_in():
+            try:
+                while True:
+                    chunk = await stdin.get()
+                    if chunk is None:
+                        break
+                    proc.stdin.write(chunk)
+                    await proc.stdin.drain()
+            except (ConnectionResetError, BrokenPipeError):
+                pass
+            finally:
+                try:
+                    proc.stdin.close()
+                except Exception:  # noqa: BLE001
+                    pass
+
+        async def pump_out():
+            while True:
+                chunk = await proc.stdout.read(4096)
+                if not chunk:
+                    return
+                await on_output(chunk)
+
+        def kill():
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                proc.kill()
+
+        feeder = asyncio.get_running_loop().create_task(pump_in())
+        try:
+            await asyncio.wait_for(pump_out(), timeout)
+            await asyncio.wait_for(proc.wait(), 10.0)
+        except asyncio.TimeoutError:
+            kill()
+            await proc.wait()
+            return 124
+        except BaseException:
+            # on_output failing (client hung up mid-session) must not
+            # leak the running child — kill, reap, re-raise.
+            kill()
+            await proc.wait()
+            raise
+        finally:
+            feeder.cancel()
+        return proc.returncode or 0
 
     async def shutdown(self) -> None:
         for cid in list(self._procs):

@@ -50,6 +50,17 @@ class NodeAgentServer:
         r.add_get("/pods", self._pods)
         r.add_get("/logs/{namespace}/{pod}/{container}", self._logs)
         r.add_post("/exec/{namespace}/{pod}/{container}", self._exec)
+        # Interactive streams (server.go:316-323 getExec/getAttach/
+        # getPortForward). Deviation from the reference's SPDY channel
+        # protocol, documented: WebSockets carry the streams — binary
+        # frames are payload bytes, one final text frame is JSON
+        # {"exit_code": N} for exec.
+        r.add_get("/exec/{namespace}/{pod}/{container}/stream",
+                  self._exec_stream)
+        r.add_get("/attach/{namespace}/{pod}/{container}/stream",
+                  self._attach)
+        r.add_get("/portforward/{namespace}/{pod}/{port}",
+                  self._portforward)
         r.add_get("/stats/summary", self._summary)
         r.add_get("/metrics", self._metrics)
         # /debug/pprof analog (server.go:295-403): live task + thread
@@ -191,6 +202,177 @@ class NodeAgentServer:
             raise web.HTTPNotImplemented(
                 text="runtime does not support exec") from None
         return web.json_response({"exit_code": code, "output": output})
+
+    async def _exec_stream(self, request):
+        """Interactive exec over a WebSocket (kubectl exec -it): query
+        param ``command`` (repeated) is argv; client binary frames are
+        stdin; server binary frames are output; the closing text frame
+        carries {"exit_code": N}."""
+        import asyncio as aio
+        import json as jsonlib
+        cid = self._resolve_cid(request)
+        argv = request.query.getall("command", [])
+        if not argv:
+            raise web.HTTPBadRequest(text="command query params required")
+        try:
+            timeout = float(request.query.get("timeout", 3600))
+            if not (0 < timeout <= 86400):  # rejects NaN/inf/negatives
+                raise ValueError
+        except ValueError:
+            raise web.HTTPBadRequest(
+                text="timeout must be in (0, 86400]") from None
+        ws = web.WebSocketResponse()
+        await ws.prepare(request)
+        stdin: aio.Queue = aio.Queue()
+
+        async def on_output(chunk: bytes) -> None:
+            await ws.send_bytes(chunk)
+
+        async def reader():
+            async for msg in ws:
+                if msg.type == web.WSMsgType.BINARY:
+                    await stdin.put(msg.data)
+                elif msg.type == web.WSMsgType.TEXT and msg.data == "EOF":
+                    await stdin.put(None)
+            await stdin.put(None)  # socket closed = EOF
+
+        reader_task = aio.get_running_loop().create_task(reader())
+        try:
+            code = await self.agent.runtime.exec_stream(
+                cid, argv, on_output=on_output, stdin=stdin,
+                timeout=timeout)
+            await ws.send_str(jsonlib.dumps({"exit_code": code}))
+        except KeyError as e:
+            await ws.send_str(jsonlib.dumps(
+                {"error": str(e), "exit_code": 127}))
+        except NotImplementedError:
+            await ws.send_str(jsonlib.dumps(
+                {"error": "runtime does not support streaming exec",
+                 "exit_code": 501}))
+        finally:
+            reader_task.cancel()
+            await ws.close()
+        return ws
+
+    async def _attach(self, request):
+        """Attach to the RUNNING container's output (kubectl attach):
+        a WebSocket streaming log growth from 'now' until the container
+        exits or the client leaves. The process runtime cannot inject
+        stdin into an already-started process (its stdin is closed at
+        start), so attach is output-only — documented deviation."""
+        import asyncio as aio
+        import json as jsonlib
+        import os as oslib
+
+        from .runtime import STATE_RUNNING
+        cid = self._resolve_cid(request)
+        ws = web.WebSocketResponse()
+        await ws.prepare(request)
+        runtime = self.agent.runtime
+        log_path = None
+        if hasattr(runtime, "_log_path"):
+            log_path = runtime._log_path(cid)
+
+        # A send-only WS handler still must DRAIN incoming frames or
+        # the peer's CLOSE is never processed and both sides hang in
+        # the close handshake (and server shutdown waits on us).
+        async def drain():
+            async for _ in ws:
+                pass
+        drainer = aio.get_running_loop().create_task(drain())
+        try:
+            offset = (oslib.path.getsize(log_path)
+                      if log_path and oslib.path.exists(log_path) else 0)
+            if request.query.get("from_start") in ("1", "true"):
+                offset = 0
+            while not ws.closed:
+                chunk = b""
+                if log_path and oslib.path.exists(log_path):
+                    with open(log_path, "rb") as f:
+                        f.seek(offset)
+                        chunk = f.read(65536)
+                        offset += len(chunk)
+                if chunk:
+                    await ws.send_bytes(chunk)
+                    continue  # drain quickly while output flows
+                st = self.agent._pleg_statuses.get(cid)
+                if st is None:
+                    sts = {s.id: s for s in await runtime.list_containers()}
+                    st = sts.get(cid)
+                if st is None or st.state != STATE_RUNNING:
+                    await ws.send_str(jsonlib.dumps(
+                        {"detached": True,
+                         "exit_code": st.exit_code if st else -1}))
+                    break
+                await aio.sleep(0.2)
+        except (ConnectionResetError, aio.CancelledError):
+            pass
+        finally:
+            drainer.cancel()
+            await ws.close()
+        return ws
+
+    async def _portforward(self, request):
+        """Port-forward tunnel (kubectl port-forward): WebSocket binary
+        frames <-> a TCP connection to the pod's port. Pod IPs are real
+        loopback addresses in this runtime, so the dial targets the pod
+        IP first and falls back to localhost (host-network processes)."""
+        import asyncio as aio
+        ns = request.match_info["namespace"]
+        pod_name = request.match_info["pod"]
+        port = int(request.match_info["port"])
+        key = f"{ns}/{pod_name}"
+        pod = self.agent._pods.get(key)
+        if pod is None:
+            raise web.HTTPNotFound(text=f"pod {key} not on this node")
+        pod_ip = self.agent.ipam.ip_for(pod.metadata.uid)
+        # Loopback-range pod IPs are genuinely bindable, so the pod IS
+        # reachable at its own address and a 127.0.0.1 fallback would
+        # silently tunnel to unrelated HOST services on that port.
+        # Non-loopback pod CIDRs (standalone agents) have no bindable
+        # pod IPs — there, host-network localhost is the honest target.
+        hosts = (pod_ip,) if pod_ip.startswith("127.") \
+            else (pod_ip, "127.0.0.1")
+        reader = writer = None
+        for host in hosts:
+            try:
+                reader, writer = await aio.wait_for(
+                    aio.open_connection(host, port), 5.0)
+                break
+            except (OSError, aio.TimeoutError):
+                continue
+        if writer is None:
+            raise web.HTTPBadGateway(
+                text=f"pod {key}: nothing listening on port {port}")
+        ws = web.WebSocketResponse()
+        await ws.prepare(request)
+
+        async def tcp_to_ws():
+            try:
+                while True:
+                    data = await reader.read(65536)
+                    if not data:
+                        break
+                    await ws.send_bytes(data)
+            except (ConnectionResetError, aio.CancelledError):
+                pass
+            finally:
+                if not ws.closed:
+                    await ws.close()
+
+        pump = aio.get_running_loop().create_task(tcp_to_ws())
+        try:
+            async for msg in ws:
+                if msg.type == web.WSMsgType.BINARY:
+                    writer.write(msg.data)
+                    await writer.drain()
+        except (ConnectionResetError, aio.CancelledError):
+            pass
+        finally:
+            pump.cancel()
+            writer.close()
+            await ws.close()
+        return ws
 
     async def _summary(self, request):
         summary = await self._collect()

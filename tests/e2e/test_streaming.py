@@ -1,0 +1,117 @@
+"""Interactive exec / attach / port-forward e2e (VERDICT r2 item 9).
+
+Reference: ``pkg/kubelet/server/server.go:316-323``
+(getExec/getAttach/getPortForward) and kubectl exec/attach/port-forward.
+Everything runs through the real stack: TLS apiserver, scheduler,
+agent + ProcessRuntime, the node server's WebSocket streams, and ktl's
+own client helpers.
+"""
+import asyncio
+import sys
+
+import aiohttp
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.cli.ktl import exec_interactive, forward_port
+from kubernetes_tpu.cluster.local import NodeSpec
+
+from .test_local_cluster import fast_cluster, wait_for
+
+
+def mk_pod(name, command):
+    return t.Pod(metadata=ObjectMeta(name=name, namespace="default"),
+                 spec=t.PodSpec(containers=[t.Container(
+                     name="main", image="inline", command=command)]))
+
+
+async def running(client, name):
+    got = await client.get("pods", "default", name)
+    return got if got.status.phase == t.POD_RUNNING else None
+
+
+async def node_base(cluster):
+    node = cluster.nodes[0]
+    return f"http://127.0.0.1:{node.agent.server.port}"
+
+
+async def test_interactive_exec_attach_portforward(tmp_path):
+    cluster = fast_cluster(tmp_path, [NodeSpec(name="n0")])
+    await cluster.start()
+    client = cluster.make_client()
+    try:
+        await cluster.wait_for_nodes_ready(timeout=20)
+
+        # A long-running pod that prints a heartbeat (attach material)
+        # and serves HTTP on its own pod IP (port-forward material).
+        await client.create(mk_pod("svc", [
+            sys.executable, "-u", "-c",
+            "import http.server, os, threading, time, functools\n"
+            "ip = os.environ['POD_IP']\n"
+            "srv = http.server.HTTPServer((ip, 8080),\n"
+            "    http.server.SimpleHTTPRequestHandler)\n"
+            "threading.Thread(target=srv.serve_forever, daemon=True).start()\n"
+            "print('serving on', ip, flush=True)\n"
+            "i = 0\n"
+            "while True:\n"
+            "    i += 1\n"
+            "    print('beat', i, flush=True)\n"
+            "    time.sleep(0.3)\n"]))
+        await wait_for(lambda: running(client, "svc"), timeout=30)
+        base = await node_base(cluster)
+
+        # 1. INTERACTIVE exec: drive a real shell over the WebSocket —
+        # send a command, read its output, exit cleanly.
+        out = bytearray()
+
+        async def stdin_lines():
+            yield b"echo marker-$((6*7))\n"
+            await asyncio.sleep(0.5)
+            yield b"exit 0\n"
+
+        code = await exec_interactive(
+            base, "default", "svc", "main", ["/bin/sh"],
+            stdin_source=stdin_lines(), out=out.extend, timeout=30)
+        assert code == 0
+        assert b"marker-42" in bytes(out), bytes(out)
+
+        # 2. attach: frames stream the RUNNING container's new output.
+        got = bytearray()
+        async with aiohttp.ClientSession() as s:
+            async with s.ws_connect(
+                    f"{base}/attach/default/svc/main/stream") as ws:
+                deadline = asyncio.get_running_loop().time() + 15
+                while asyncio.get_running_loop().time() < deadline:
+                    msg = await ws.receive(timeout=15)
+                    if msg.type == aiohttp.WSMsgType.BINARY:
+                        got.extend(msg.data)
+                        if b"beat" in bytes(got):
+                            break
+        assert b"beat" in bytes(got)
+
+        # 3. port-forward: local TCP -> WS tunnel -> pod's HTTP server
+        # on its loopback pod IP.
+        ready = asyncio.Event()
+        stop = asyncio.Event()
+        local_port = 38123
+        task = asyncio.get_running_loop().create_task(
+            forward_port(base, "default", "svc", local_port, 8080,
+                         ready=ready, stop=stop))
+        await asyncio.wait_for(ready.wait(), 10)
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"http://127.0.0.1:{local_port}/",
+                             timeout=aiohttp.ClientTimeout(total=10)) as r:
+                assert r.status == 200
+                body = await r.text()
+        assert body  # directory listing served through the tunnel
+        stop.set()
+        await task
+
+        # 4. port-forward against a port nobody listens on: clean 502
+        # at the stream level, not a hang.
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"{base}/portforward/default/svc/39999") as r:
+                assert r.status == 502
+    finally:
+        await client.close()
+        await cluster.stop()
