@@ -605,15 +605,21 @@ async def cmd_top(args) -> int:
                 f"{len(summary['pods'])} pods"])
             for chip in summary.get("tpu", {}).get("chips", []):
                 owner = chip.get("assigned_to")
+                hbm = chip.get("hbm_used_bytes")
                 chip_rows.append([
                     node.metadata.name, chip["id"], chip["health"],
                     ",".join(map(str, chip["coords"])),
-                    f"{owner['namespace']}/{owner['pod']}" if owner else "<idle>"])
+                    f"{owner['namespace']}/{owner['pod']}" if owner else "<idle>",
+                    (f"{chip['mfu'] * 100:.2f}%" if "mfu" in chip else "-"),
+                    (f"{chip['tokens_per_sec']:.0f}"
+                     if "tokens_per_sec" in chip else "-"),
+                    (f"{hbm / 2**30:.1f}Gi" if hbm is not None else "-")])
         print(printers.render_table(["NODE", "LOAD1", "MEMORY", "WORKLOAD"], rows))
         if chip_rows:
             print()
             print(printers.render_table(
-                ["NODE", "CHIP", "HEALTH", "COORDS", "ASSIGNED-TO"], chip_rows))
+                ["NODE", "CHIP", "HEALTH", "COORDS", "ASSIGNED-TO",
+                 "MFU", "TOK/S", "HBM"], chip_rows))
         return 0
     finally:
         await client.close()

@@ -67,7 +67,8 @@ class CRIServer:
     def Version(self, request, context):
         return pb.VersionResponse(
             runtime_name=type(self.runtime).__name__,
-            runtime_version=RUNTIME_VERSION)
+            runtime_version=RUNTIME_VERSION,
+            root_dir=getattr(self.runtime, "root_dir", ""))
 
     def CreateContainer(self, request, context):
         c = request.config
@@ -316,6 +317,7 @@ class RemoteRuntime(ContainerRuntime):
     def __init__(self, socket_path: str):
         self.socket_path = socket_path
         self._channel = grpc.insecure_channel(f"unix://{socket_path}")
+        self.root_dir: str = ""
         p = f"/{SERVICE}/"
 
         def u(method, req_cls, resp_cls):
@@ -355,10 +357,18 @@ class RemoteRuntime(ContainerRuntime):
         self._remove_image = iu("RemoveImage", pb.ImageRefRequest, pb.Empty)
         self._list_images = iu("ListImages", pb.ListImagesRequest,
                                pb.ListImagesResponse)
+        try:
+            self.version()  # learn the runtime's state root (if served)
+        except grpc.RpcError:
+            pass  # server not up yet; callers may retry version() later
 
     def version(self) -> tuple[str, str]:
         resp = self._version(pb.VersionRequest(version=RUNTIME_VERSION),
                              timeout=10)
+        # Same-host runtimes advertise their state root so the agent's
+        # stats collector can read workload-published metrics files.
+        if resp.root_dir:
+            self.root_dir = resp.root_dir
         return resp.runtime_name, resp.runtime_version
 
     async def start_container(self, config: ContainerConfig) -> str:

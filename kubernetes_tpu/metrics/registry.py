@@ -104,6 +104,16 @@ class Gauge(Metric):
         with self._lock:
             self._values.clear()
 
+    def remove(self, **labels) -> None:
+        """Drop one labeled series — a gauge for a deleted object must
+        stop being exported, not freeze at its last value."""
+        with self._lock:
+            self._values.pop(_label_key(self.label_names, labels), None)
+
+    def labeled_keys(self) -> list[tuple]:
+        with self._lock:
+            return list(self._values)
+
     def render(self) -> str:
         lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
         with self._lock:

@@ -33,6 +33,20 @@ CHIP_HEALTHY = Gauge("node_tpu_chip_healthy",
 CHIP_ASSIGNED = Gauge("node_tpu_chip_assigned",
                       "1 when the chip is assigned to a pod",
                       labels=("node", "chip", "pod"))
+# Live training pipeline (workloads/metrics_reporter.py -> stats.py):
+# the DCGM-exporter role for TPU chips, per pod and per chip.
+TRAIN_TOKENS = Gauge("node_training_tokens_per_sec",
+                     "Live tokens/s reported by the pod's training loop",
+                     labels=("node", "pod"))
+TRAIN_MFU = Gauge("node_training_mfu",
+                  "Live MFU reported by the pod's training loop",
+                  labels=("node", "pod"))
+TRAIN_STEP_MS = Gauge("node_training_step_ms",
+                      "Live per-step wall time (ms)",
+                      labels=("node", "pod"))
+CHIP_HBM_USED = Gauge("node_tpu_chip_hbm_used_bytes",
+                      "Live HBM in use on the chip",
+                      labels=("node", "chip"))
 
 
 class NodeAgentServer:
@@ -42,7 +56,7 @@ class NodeAgentServer:
         # agent's chip_metrics seam (device plugin HBM stats) rides in.
         self.collector = collector or SummaryCollector(
             agent.node_name,
-            root_dir=getattr(agent.runtime, "root_dir", "/"),
+            root_dir=getattr(agent.runtime, "root_dir", "") or "/",
             chip_metrics=getattr(agent, "chip_metrics", None))
         self.app = web.Application()
         r = self.app.router
@@ -393,6 +407,24 @@ class NodeAgentServer:
                 1.0 if owner else 0.0, node=self.agent.node_name,
                 chip=chip["id"],
                 pod=f"{owner['namespace']}/{owner['pod']}" if owner else "")
+            if "hbm_used_bytes" in chip:
+                CHIP_HBM_USED.set(float(chip["hbm_used_bytes"]),
+                                  node=self.agent.node_name,
+                                  chip=chip["id"])
+        for p in summary["pods"]:
+            rec = p.get("training")
+            if rec is None or rec.get("stale"):
+                continue
+            pod_label = f"{p['pod']['namespace']}/{p['pod']['name']}"
+            if "tokens_per_sec" in rec:
+                TRAIN_TOKENS.set(rec["tokens_per_sec"],
+                                 node=self.agent.node_name, pod=pod_label)
+            if "mfu" in rec:
+                TRAIN_MFU.set(rec["mfu"], node=self.agent.node_name,
+                              pod=pod_label)
+            if "step_time_ms" in rec:
+                TRAIN_STEP_MS.set(rec["step_time_ms"],
+                                  node=self.agent.node_name, pod=pod_label)
         return summary
 
     async def _metrics(self, request):

@@ -101,6 +101,7 @@ class SummaryCollector:
         }
 
         pod_stats = []
+        training_by_uid: dict[str, dict] = {}
         for key, pod in sorted(pods.items()):
             cmap = containers.get(key, {})
             cstats = []
@@ -113,30 +114,65 @@ class SummaryCollector:
                     if proc:
                         entry.update(proc)
                 cstats.append(entry)
-            pod_stats.append({
+            entry = {
                 "pod": {"namespace": pod.metadata.namespace,
                         "name": pod.metadata.name, "uid": pod.metadata.uid},
                 "containers": cstats,
                 "cpu_seconds": sum(c.get("cpu_seconds", 0.0) for c in cstats),
                 "memory_rss_bytes": sum(c.get("memory_rss_bytes", 0)
                                         for c in cstats),
-            })
+            }
+            training = self._training_report(pod, cmap)
+            if training is not None:
+                entry["training"] = training
+                training_by_uid[pod.metadata.uid] = training
+            pod_stats.append(entry)
 
         return {"node": node, "pods": pod_stats,
-                "tpu": self.tpu_stats(pods, topology)}
+                "tpu": self.tpu_stats(pods, topology, training_by_uid)}
+
+    def _training_report(self, pod: t.Pod,
+                         cmap: dict[str, str]) -> Optional[dict]:
+        """The pod's live training metrics, published by the workload
+        itself into its sandbox (workloads/metrics_reporter.py — the
+        cAdvisor-accelerator-loop inversion: the libtpu owner reports,
+        the agent ingests)."""
+        from ..workloads.metrics_reporter import read_report
+        # Pod-level sandbox first (sb-<uid>), then private per-cid
+        # sandboxes (pre-sandbox runtime compatibility).
+        dirs = [os.path.join(self.root_dir, "sandboxes",
+                             f"sb-{pod.metadata.uid[:12]}")]
+        dirs += [os.path.join(self.root_dir, "sandboxes", cid)
+                 for cid in cmap.values()]
+        for d in dirs:
+            rec = read_report(d)
+            if rec is not None:
+                return rec
+        return None
 
     def tpu_stats(self, pods: dict[str, t.Pod],
-                  topology: Optional[t.TpuTopology]) -> dict:
-        """Per-chip attribution + utilization (AcceleratorStats analog)."""
+                  topology: Optional[t.TpuTopology],
+                  training_by_uid: Optional[dict] = None) -> dict:
+        """Per-chip attribution + utilization (AcceleratorStats analog).
+        Live numbers win over probe-time statics: a chip assigned to a
+        reporting pod carries that pod's CURRENT hbm/MFU/tokens-s."""
         if topology is None:
             return {"chips": []}
         owner: dict[str, dict] = {}
+        live_by_chip: dict[str, dict] = {}
         for pod in pods.values():
             for claim in pod.spec.tpu_resources:
                 for cid in claim.assigned:
                     owner[cid] = {"namespace": pod.metadata.namespace,
                                   "pod": pod.metadata.name,
                                   "claim": claim.name}
+                    rec = (training_by_uid or {}).get(pod.metadata.uid)
+                    if rec is not None and not rec.get("stale"):
+                        live_by_chip[cid] = {
+                            k: rec[k] for k in
+                            ("hbm_used_bytes", "hbm_total_bytes", "mfu",
+                             "tokens_per_sec", "step_time_ms")
+                            if k in rec}
         live = self.chip_metrics() if self.chip_metrics else {}
         chips = []
         for chip in topology.chips:
@@ -148,6 +184,7 @@ class SummaryCollector:
                 "assigned_to": owner.get(chip.id),
             }
             entry.update(live.get(chip.id, {}))
+            entry.update(live_by_chip.get(chip.id, {}))
             chips.append(entry)
         return {"chip_type": topology.chip_type,
                 "slice_id": topology.slice_id,

@@ -278,10 +278,25 @@ def train(cfg: LMConfig, mesh, steps: int, batch: int, seq: int,
     step_fn = make_train_step(cfg, mesh, lr)
     params, opt_state = state["params"], state["opt_state"]
     loss = None
+    # Live metrics to the node agent (metrics_reporter.py): step time,
+    # tokens/s, MFU, HBM — no-op outside a pod sandbox.
+    import time as _time
+
+    from .metrics_reporter import TrainingMetricsReporter
+    from ..perf.chip_bench import BenchCase, train_flops_per_token
+    reporter = TrainingMetricsReporter(
+        flops_per_token=train_flops_per_token(BenchCase(
+            "train", cfg.d_model, cfg.n_layers, cfg.n_heads, cfg.d_ff,
+            cfg.vocab, batch, seq)))
     for step in range(start, steps):
+        t0 = _time.perf_counter()
         data = synthetic_batch(jax.random.fold_in(rng, step), cfg, mesh,
                                batch, seq)
         params, opt_state, loss = step_fn(params, opt_state, data)
+        if reporter.enabled:
+            loss.block_until_ready()  # honest step time when reporting
+            reporter.report(step, _time.perf_counter() - t0, batch * seq,
+                            loss=float(loss))
         if checkpoint_every and (step + 1) % checkpoint_every == 0:
             ckpt.save(step, {"params": params, "opt_state": opt_state},
                       ckpt_dir)

@@ -1,0 +1,128 @@
+"""In-workload training metrics reporter — the live half of the
+accelerator-metrics pipeline.
+
+Reference: the cAdvisor accelerator collector samples NVML continuously
+per container (``vendor/github.com/google/cadvisor/accelerators/
+nvidia.go:48-222``). A TPU chip's counters live with the process that
+owns libtpu — the workload — so the TPU-native pipeline inverts the
+flow: the training loop itself publishes step metrics to a well-known
+file in its pod sandbox (``$KTPU_SANDBOX/training-metrics.json``,
+atomic rename per write) and the node agent's stats collector ingests
+it into /stats/summary and /metrics. No sockets, no sidecar, crash-only
+(a dead workload's file simply goes stale and the collector marks it).
+
+Wired into :func:`kubernetes_tpu.workloads.lm.train`; any workload can
+use the reporter directly.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional
+
+#: A report older than this is a dead/hung workload's leftover.
+STALE_AFTER_SECONDS = 120.0
+
+REPORT_BASENAME = "training-metrics.json"
+
+
+def _device_memory_stats() -> dict:
+    """HBM in-use/limit from jax, when a device exposes memory_stats
+    (real TPUs do; CPU returns {})."""
+    try:
+        import jax
+        stats = jax.local_devices()[0].memory_stats() or {}
+    except Exception:  # noqa: BLE001 — metrics must never kill training
+        return {}
+    out = {}
+    if "bytes_in_use" in stats:
+        out["hbm_used_bytes"] = int(stats["bytes_in_use"])
+    if "bytes_limit" in stats:
+        out["hbm_total_bytes"] = int(stats["bytes_limit"])
+    return out
+
+
+class TrainingMetricsReporter:
+    """Publish per-step training metrics for the node agent to scrape.
+
+    ``flops_per_token``: analytic train FLOPs/token (e.g.
+    ``perf.chip_bench.train_flops_per_token``); with it and a known
+    chip peak, reports include MFU.
+    """
+
+    def __init__(self, path: str = "",
+                 flops_per_token: Optional[float] = None,
+                 peak_flops: Optional[float] = None):
+        sandbox = os.environ.get("KTPU_SANDBOX", "")
+        self.path = path or (os.path.join(sandbox, REPORT_BASENAME)
+                             if sandbox else "")
+        self.flops_per_token = flops_per_token
+        if peak_flops is None and flops_per_token is not None:
+            try:
+                import jax
+
+                from ..perf.chip_bench import peak_flops_for
+                peak_flops, known = peak_flops_for(
+                    jax.devices()[0].device_kind)
+                if not known:
+                    peak_flops = None  # a guessed peak makes MFU noise
+            except Exception:  # noqa: BLE001
+                peak_flops = None
+        self.peak_flops = peak_flops
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.path)
+
+    def report(self, step: int, step_time_s: float, tokens: int,
+               loss: Optional[float] = None,
+               hbm_used_bytes: Optional[int] = None,
+               hbm_total_bytes: Optional[int] = None) -> Optional[dict]:
+        """Write one report (atomic); returns the dict or None when
+        disabled. Never raises — metrics must not kill training.
+        HBM defaults to jax's device memory_stats; workloads that know
+        better (or run off-TPU) pass it explicitly."""
+        if not self.path or step_time_s <= 0:
+            return None
+        try:
+            rec = {
+                "step": step,
+                "step_time_ms": round(step_time_s * 1e3, 2),
+                "tokens_per_sec": round(tokens / step_time_s, 1),
+                "timestamp": time.time(),
+            }
+            if loss is not None:
+                rec["loss"] = round(float(loss), 4)
+            if self.flops_per_token and self.peak_flops:
+                rec["mfu"] = round(
+                    tokens / step_time_s * self.flops_per_token
+                    / self.peak_flops, 4)
+            rec.update(_device_memory_stats())
+            if hbm_used_bytes is not None:
+                rec["hbm_used_bytes"] = int(hbm_used_bytes)
+            if hbm_total_bytes is not None:
+                rec["hbm_total_bytes"] = int(hbm_total_bytes)
+            tmp = f"{self.path}.tmp"
+            with open(tmp, "w") as f:
+                json.dump(rec, f)
+            os.replace(tmp, self.path)  # readers never see a torn file
+            return rec
+        except Exception:  # noqa: BLE001
+            return None
+
+
+def read_report(sandbox_dir: str,
+                now: Optional[float] = None) -> Optional[dict]:
+    """Node-agent side: the pod's latest report, with ``stale`` set
+    when the workload stopped publishing."""
+    path = os.path.join(sandbox_dir, REPORT_BASENAME)
+    try:
+        with open(path) as f:
+            rec = json.load(f)
+    except (OSError, ValueError):
+        return None
+    age = (now or time.time()) - rec.get("timestamp", 0)
+    rec["age_seconds"] = round(age, 1)
+    rec["stale"] = age > STALE_AFTER_SECONDS
+    return rec

@@ -71,3 +71,96 @@ async def test_vector_add_on_real_chip(tmp_path):
     finally:
         await client.close()
         await cluster.stop()
+
+
+async def test_live_training_metrics_on_real_chip(tmp_path):
+    """VERDICT r2 item 7 'done' criterion: a real LM training pod on
+    the actual chip publishes live metrics, and the summary a
+    ``ktl top`` scrape reads shows MOVING per-chip MFU/tokens-s/HBM."""
+    import aiohttp
+
+    cluster = LocalCluster(
+        data_dir=str(tmp_path),
+        nodes=[NodeSpec(name="tpu-vm-0", real_tpu=True)],
+        status_interval=0.3, heartbeat_interval=0.3)
+    await cluster.start()
+    client = cluster.make_client()
+    try:
+        await cluster.wait_for_nodes_ready(timeout=30)
+        train_src = (
+            "from kubernetes_tpu.workloads import lm\n"
+            "from kubernetes_tpu.workloads.sharding import make_mesh\n"
+            "import jax\n"
+            "cfg = lm.LMConfig(vocab=2048, d_model=512, n_layers=2,\n"
+            "                  n_heads=8, d_ff=2048)\n"
+            "mesh = make_mesh(jax.devices()[:1])\n"
+            "out = lm.train(cfg, mesh, steps=200, batch=4, seq=256,\n"
+            "               checkpoint_every=0)\n"
+            "print('trained', out)\n")
+        pod = t.Pod(
+            metadata=ObjectMeta(name="train-live", namespace="default"),
+            spec=t.PodSpec(
+                restart_policy="Never",
+                containers=[t.Container(
+                    name="main", image="inline",
+                    command=[sys.executable, "-u", "-c", train_src],
+                    tpu_requests=["tpu"])],
+                tpu_resources=[t.PodTpuRequest(name="tpu", chips=1)]))
+        await client.create(pod)
+
+        base = f"http://127.0.0.1:{cluster.nodes[0].agent.server.port}"
+
+        async def live_chip():
+            async with aiohttp.ClientSession() as s:
+                async with s.get(f"{base}/stats/summary") as r:
+                    summary = await r.json()
+            for chip in summary.get("tpu", {}).get("chips", []):
+                if chip.get("assigned_to") and "tokens_per_sec" in chip:
+                    return chip
+            return None
+
+        # Compile takes a while on the tunnel; wait for the first report.
+        chip = None
+        deadline = asyncio.get_running_loop().time() + 240
+        while asyncio.get_running_loop().time() < deadline:
+            chip = await live_chip()
+            if chip is not None:
+                break
+            got = await client.get("pods", "default", "train-live")
+            assert got.status.phase != t.POD_FAILED, got.status
+            await asyncio.sleep(1.0)
+        assert chip is not None, "no live chip metrics appeared"
+        assert chip["tokens_per_sec"] > 0
+        # HBM only when the backend exposes memory_stats (the axon
+        # tunnel in this environment answers None; a local libtpu
+        # reports bytes_in_use/bytes_limit).
+        if "hbm_used_bytes" in chip:
+            assert chip["hbm_used_bytes"] > 0
+
+        # MOVING: the step counter advances between scrapes, and a
+        # post-compile report carries a real MFU (the FIRST report
+        # absorbs the ~30s tunnel compile, flattening its rate to ~0).
+        async def training_rec():
+            async with aiohttp.ClientSession() as s:
+                async with s.get(f"{base}/stats/summary") as r:
+                    summary = await r.json()
+            recs = [p.get("training") for p in summary["pods"]
+                    if p["pod"]["name"] == "train-live"]
+            return recs[0] if recs else None
+
+        rec1 = await training_rec()
+        assert rec1 is not None
+        rec2 = None
+        for _ in range(120):
+            await asyncio.sleep(0.5)
+            rec2 = await training_rec()
+            if rec2 and rec2["step"] > rec1["step"] + 1 \
+                    and rec2.get("mfu", 0) > 0:
+                break
+        assert rec2 and rec2["step"] > rec1["step"], (rec1, rec2)
+        assert 0 < rec2.get("mfu", 0) < 1.5, rec2
+    finally:
+        await client.delete("pods", "default", "train-live",
+                            grace_period_seconds=0)
+        await client.close()
+        await cluster.stop()

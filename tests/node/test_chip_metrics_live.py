@@ -1,0 +1,140 @@
+"""Live accelerator-metrics pipeline (VERDICT r2 item 7).
+
+Workload publishes per-step metrics into its sandbox
+(``workloads/metrics_reporter.py``) -> the stats collector ingests
+them -> /stats/summary carries MOVING per-pod + per-chip numbers ->
+/metrics serves them -> every metric name the Grafana dashboard
+queries resolves against a real scrape.
+"""
+import asyncio
+import json
+import os
+import re
+import sys
+
+import aiohttp
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.cluster.local import LocalCluster, NodeSpec
+from kubernetes_tpu.workloads.metrics_reporter import (
+    TrainingMetricsReporter, read_report)
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def test_reporter_roundtrip(tmp_path):
+    from kubernetes_tpu.workloads.metrics_reporter import REPORT_BASENAME
+    path = tmp_path / REPORT_BASENAME
+    rep = TrainingMetricsReporter(path=str(path),
+                                  flops_per_token=1e9, peak_flops=1e14)
+    rec = rep.report(step=7, step_time_s=0.25, tokens=8192, loss=2.5)
+    assert rec["tokens_per_sec"] == 32768.0
+    assert rec["mfu"] == round(32768.0 * 1e9 / 1e14, 4)
+    got = read_report(str(tmp_path))
+    assert got["step"] == 7 and not got["stale"]
+    # Stale detection: backdate the timestamp.
+    rec["timestamp"] -= 10_000
+    json.dump(rec, open(path, "w"))
+    assert read_report(str(tmp_path))["stale"]
+
+
+def _worker_src() -> str:
+    return (
+        "import time\n"
+        "from kubernetes_tpu.workloads.metrics_reporter import "
+        "TrainingMetricsReporter\n"
+        "rep = TrainingMetricsReporter(flops_per_token=1e9, peak_flops=1e14)\n"
+        "assert rep.enabled\n"
+        "for s in range(10_000):\n"
+        "    rep.report(s, 0.05, 4096, hbm_used_bytes=123456789)\n"
+        "    time.sleep(0.05)\n")
+
+
+async def test_live_pipeline_and_dashboard_names(tmp_path):
+    """A training pod with 2 assigned chips reports; summary + metrics
+    go LIVE (numbers move between scrapes) and the Grafana dashboard's
+    metric names all resolve."""
+    cluster = LocalCluster(nodes=[NodeSpec(name="n0", tpu_chips=4)],
+                           status_interval=0.3, heartbeat_interval=0.3)
+    await cluster.start()
+    client = cluster.make_client()
+    try:
+        await cluster.wait_for_nodes_ready(timeout=20)
+        pod = t.Pod(
+            metadata=ObjectMeta(name="train", namespace="default"),
+            spec=t.PodSpec(containers=[t.Container(
+                name="main", image="inline",
+                command=[sys.executable, "-u", "-c", _worker_src()],
+                tpu_requests=["tpu"])],
+                tpu_resources=[t.PodTpuRequest(name="tpu", chips=2)]))
+        await client.create(pod)
+
+        base = f"http://127.0.0.1:{cluster.nodes[0].agent.server.port}"
+
+        async def training_summary():
+            async with aiohttp.ClientSession() as s:
+                async with s.get(f"{base}/stats/summary") as r:
+                    return await r.json()
+
+        # Wait until the pod reports.
+        rec = None
+        for _ in range(100):
+            summary = await training_summary()
+            recs = [p.get("training") for p in summary["pods"]
+                    if p["pod"]["name"] == "train"]
+            if recs and recs[0]:
+                rec = recs[0]
+                break
+            await asyncio.sleep(0.2)
+        assert rec is not None, summary
+        assert rec["tokens_per_sec"] > 0 and not rec["stale"]
+
+        # The numbers MOVE (step advances between scrapes).
+        step1 = rec["step"]
+        for _ in range(50):
+            await asyncio.sleep(0.2)
+            summary = await training_summary()
+            rec2 = [p.get("training") for p in summary["pods"]
+                    if p["pod"]["name"] == "train"][0]
+            if rec2 and rec2["step"] > step1:
+                break
+        assert rec2["step"] > step1, (step1, rec2)
+
+        # Assigned chips carry the live numbers; idle chips don't.
+        chips = summary["tpu"]["chips"]
+        assigned = [c for c in chips if c.get("assigned_to")]
+        idle = [c for c in chips if not c.get("assigned_to")]
+        assert len(assigned) == 2 and assigned[0]["tokens_per_sec"] > 0
+        assert all("tokens_per_sec" not in c for c in idle)
+
+        # Every metric name the dashboard queries resolves against the
+        # union of real scrapes (node server /metrics serves the global
+        # registry, which includes scheduler + apiserver series).
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"{base}/metrics") as r:
+                scrape = await r.text()
+        served = set(re.findall(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)\{?",
+                                scrape, re.M))
+        served |= set(re.findall(r"^# TYPE (\S+)", scrape, re.M))
+        # The live-pipeline gauges must have REAL samples, not just
+        # registrations.
+        assert re.search(r"^node_training_mfu\{", scrape, re.M), scrape[:800]
+        assert re.search(r"^node_tpu_chip_hbm_used_bytes\{.*\} 1\.23", scrape,
+                         re.M)
+        dash = json.load(open(os.path.join(
+            REPO, "cluster/addons/monitoring/grafana-tpu-dashboard.json")))
+        exprs = [tgt["expr"] for panel in dash["panels"]
+                 for tgt in panel["targets"]]
+        wanted = set()
+        for expr in exprs:
+            wanted.update(re.findall(
+                r"\b([a-z][a-z0-9_]*_(?:total|bucket|seconds|bytes|ms|"
+                r"pct|mfu|healthy|assigned|per_sec))\b", expr))
+        assert wanted, exprs  # the extraction matched something
+        missing = {m for m in wanted if m not in served}
+        assert not missing, (missing, sorted(served))
+    finally:
+        await client.close()
+        await cluster.stop()
