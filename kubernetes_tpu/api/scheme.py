@@ -252,12 +252,79 @@ def _decoders(cls: type) -> dict[str, Any]:
     return d
 
 
+#: Per-dataclass exec-compiled decode functions (the reference gets the
+#: same effect from generated codecs). None = class not compilable
+#: (frozen/slots/__post_init__/required fields) -> generic path.
+_COMPILED_DECODE: dict[type, Any] = {}
+_MISS = object()
+
+
+def _compile_decode(cls: type):
+    """Build a specialized ``dict -> cls`` decoder.
+
+    Bypasses ``cls(**kwargs)`` (keyword parsing + a generated __init__
+    that re-assigns every field) by writing defaults straight into a
+    ``__new__``-made instance's ``__dict__`` and overwriting with
+    dispatched coercions. Only for plain dataclasses — anything with
+    ``__post_init__``, ``__slots__``, frozen semantics, or required
+    (default-less) fields keeps the generic path, whose behavior
+    (e.g. TypeError on a missing required field) must not change."""
+    if (getattr(cls, "__post_init__", None) is not None
+            or any("__slots__" in k.__dict__ for k in cls.__mro__)
+            or cls.__dataclass_params__.frozen):  # type: ignore[attr-defined]
+        return None
+    flds = dataclasses.fields(cls)
+    ns: dict[str, Any] = {"__new": object.__new__, "__cls": cls,
+                          "__disp": _decoders(cls), "__MISS": _MISS}
+    lines = ["def __decode(data):",
+             "    obj = __new(__cls)",
+             "    d = obj.__dict__"]
+    for i, f in enumerate(flds):
+        if f.default is not dataclasses.MISSING:
+            ns[f"__c{i}"] = f.default
+            lines.append(f"    d[{f.name!r}] = __c{i}")
+        elif f.default_factory is not dataclasses.MISSING:
+            if f.default_factory is list:
+                lines.append(f"    d[{f.name!r}] = []")
+            elif f.default_factory is dict:
+                lines.append(f"    d[{f.name!r}] = {{}}")
+            else:
+                ns[f"__f{i}"] = f.default_factory
+                lines.append(f"    d[{f.name!r}] = __f{i}()")
+        else:
+            return None  # required field: keep generic error behavior
+    lines += [
+        "    extra = None",
+        "    for k, v in data.items():",
+        "        c = __disp.get(k, __MISS)",
+        "        if c is __MISS:",
+        "            if extra is None:",
+        "                extra = {}",
+        "            extra[k] = v",
+        "        elif c is None or v is None:",
+        "            d[k] = v",
+        "        else:",
+        "            d[k] = c(v)",
+        "    if extra is not None:",
+        "        d['__extra__'] = extra",
+        "    return obj",
+    ]
+    exec("\n".join(lines), ns)  # noqa: S102 — codegen over our own fields
+    return ns["__decode"]
+
+
 def from_dict(cls: Type[T], data: dict) -> T:
     """Build dataclass ``cls`` from a plain dict, preserving unknown keys."""
     if data is None:
         return None  # type: ignore[return-value]
-    if not dataclasses.is_dataclass(cls):
-        return data  # type: ignore[return-value]
+    try:
+        fn = _COMPILED_DECODE[cls]
+    except KeyError:
+        if not dataclasses.is_dataclass(cls):
+            return data  # type: ignore[return-value]
+        fn = _COMPILED_DECODE[cls] = _compile_decode(cls)
+    if fn is not None:
+        return fn(data)
     decoders = _decoders(cls)
     kwargs: dict[str, Any] = {}
     extra: dict[str, Any] = {}

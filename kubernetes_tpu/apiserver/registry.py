@@ -751,6 +751,16 @@ class Registry:
                                loop=loop)
         return RawObjectWatch(raw, label_selector)
 
+    async def run(self, fn, *args):
+        """Async dispatch for a registry call: inline when the store is
+        purely in-memory (sub-ms CPU work — a to_thread handoff costs
+        more than it buys and the GIL serializes it anyway), via a
+        worker thread when a WAL append may block on disk. The single
+        policy point shared by LocalClient and the apiserver."""
+        if self.store.durable:
+            return await asyncio.to_thread(fn, *args)
+        return fn(*args)
+
     # -- pods/binding subresource ----------------------------------------
 
     def bind_pod(self, namespace: str, name: str, binding: t.Binding) -> t.Pod:

@@ -620,13 +620,10 @@ class APIServer:
         return data
 
     async def _mutate(self, fn, *args):
-        """Run a registry mutation: direct when the store is in-memory
-        (sub-ms pure-CPU work — the to_thread handoff costs more than
-        it buys and the GIL serializes it anyway), via a worker thread
-        when a WAL append can block on disk."""
-        if not self.registry.store.durable:
-            return fn(*args)
-        return await asyncio.to_thread(fn, *args)
+        """Dispatch a registry mutation via the shared policy point
+        (:meth:`Registry.run`): inline for in-memory stores, worker
+        thread when a WAL append can block on disk."""
+        return await self.registry.run(fn, *args)
 
     # -- verb handlers ----------------------------------------------------
 

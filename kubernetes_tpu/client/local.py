@@ -2,13 +2,15 @@
 
 Used by the single-binary control plane and the integration test tier
 (reference: controllers/scheduler tested against an in-proc master,
-``test/integration/framework/master_utils.go:290-305``). Registry calls
-are quick dict operations; blocking ones are pushed to a thread to keep
-the event loop responsive under load.
+``test/integration/framework/master_utils.go:290-305``).
+
+Dispatch goes through :meth:`Registry.run` — inline for in-memory
+stores (microsecond dict ops; a to_thread round trip costs ~1ms of
+jittery handoff and dominated the gang-bench wall clock), worker
+thread when the store's WAL can block on disk.
 """
 from __future__ import annotations
 
-import asyncio
 from typing import Any, Optional
 
 from ..api.types import Binding
@@ -31,29 +33,32 @@ class LocalClient(Client):
     def __init__(self, registry: Registry):
         self.registry = registry
 
+    async def _call(self, fn, *args):
+        return await self.registry.run(fn, *args)
+
     async def create(self, obj: Any) -> Any:
-        return await asyncio.to_thread(self.registry.create, obj)
+        return await self._call(self.registry.create, obj)
 
     async def get(self, plural: str, namespace: str, name: str) -> Any:
         return self.registry.get(plural, namespace, name)
 
     async def list(self, plural: str, namespace: str = "", label_selector: str = "",
                    field_selector: str = "") -> tuple[list, int]:
-        return await asyncio.to_thread(
+        return await self._call(
             self.registry.list, plural, namespace, label_selector, field_selector)
 
     async def update(self, obj: Any, subresource: str = "") -> Any:
-        return await asyncio.to_thread(self.registry.update, obj, subresource)
+        return await self._call(self.registry.update, obj, subresource)
 
     async def patch(self, plural: str, namespace: str, name: str, patch: dict,
                     subresource: str = "", strategic: bool = False) -> Any:
-        return await asyncio.to_thread(
+        return await self._call(
             self.registry.patch, plural, namespace, name, patch, subresource,
             strategic)
 
     async def delete(self, plural: str, namespace: str, name: str,
                      grace_period_seconds: Optional[int] = None, uid: str = "") -> Any:
-        return await asyncio.to_thread(
+        return await self._call(
             self.registry.delete, plural, namespace, name, grace_period_seconds, uid)
 
     async def watch(self, plural: str, namespace: str = "", resource_version: int = 0,
@@ -63,4 +68,4 @@ class LocalClient(Client):
         return _LocalWatch(ow)
 
     async def bind(self, namespace: str, name: str, binding: Binding) -> Any:
-        return await asyncio.to_thread(self.registry.bind_pod, namespace, name, binding)
+        return await self._call(self.registry.bind_pod, namespace, name, binding)
