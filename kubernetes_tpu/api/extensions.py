@@ -273,7 +273,14 @@ class Webhook:
     name: str = ""
     #: Endpoint URL (reference also supports service refs; here the
     #: dataplane has no in-cluster HTTPS services, so URL only).
+    #: https:// is the contract (the reference mandates it — review
+    #: bodies carry full objects, Secrets included); http:// is
+    #: admitted only for loopback hosts (test/dev), anything else is
+    #: rejected at config validation.
     url: str = ""
+    #: PEM CA bundle verifying the hook's serving cert (reference
+    #: clientConfig.caBundle); empty = system trust store.
+    ca_bundle: str = ""
     rules: list[WebhookRule] = field(default_factory=list)
     #: Fail (reject the API request when the hook is unreachable) or
     #: Ignore (admit as if allowed) — admission.go failurePolicy.
@@ -289,6 +296,34 @@ class MutatingWebhookConfiguration(TypedObject):
 @dataclass
 class ValidatingWebhookConfiguration(TypedObject):
     webhooks: list[Webhook] = field(default_factory=list)
+
+
+def validate_webhook_configuration(cfg, is_create: bool = True) -> None:
+    """URL policy for admission webhooks: https required (review
+    bodies carry whole objects — Secret data included on CREATE), with
+    a loopback-only http exception for test/dev hooks, matching the
+    spirit of the reference's mandatory caBundle+https clientConfig."""
+    from urllib.parse import urlparse
+    errs = []
+    for i, hook in enumerate(cfg.webhooks):
+        if not hook.name:
+            errs.append(f"webhooks[{i}].name: required")
+        parsed = urlparse(hook.url)
+        if parsed.scheme == "https":
+            pass
+        elif parsed.scheme == "http" and parsed.hostname in (
+                "127.0.0.1", "localhost", "::1"):
+            pass
+        else:
+            errs.append(
+                f"webhooks[{i}].url: must be https:// "
+                f"(http:// only for loopback hosts), got {hook.url!r}")
+    if errs:
+        raise InvalidError("; ".join(errs))
+
+
+def validate_webhook_configuration_update(new, old) -> None:
+    validate_webhook_configuration(new, is_create=False)
 
 
 DEFAULT_SCHEME.register(ADMISSION_V1, "MutatingWebhookConfiguration",

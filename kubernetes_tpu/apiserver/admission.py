@@ -37,10 +37,16 @@ class AdmissionChain:
         self.plugins = plugins or []
 
     def admit(self, op: str, spec: "ResourceSpec", obj: TypedObject,
-              old: Optional[TypedObject]) -> TypedObject:
+              old: Optional[TypedObject],
+              dry_run: bool = False) -> TypedObject:
+        """``dry_run=True`` skips plugins whose validate phase has
+        durable side effects (``charges_state`` — the quota charge):
+        a dry-run pass must never double-charge against the real one."""
         for p in self.plugins:
             obj = p.admit(op, spec, obj, old)
         for p in self.plugins:
+            if dry_run and getattr(p, "charges_state", False):
+                continue
             p.validate(op, spec, obj, old)
         return obj
 
@@ -144,6 +150,9 @@ class ResourceQuotaPlugin(AdmissionPlugin):
 
     name = "ResourceQuota"
     CAS_RETRIES = 10
+    #: validate() CHARGES quota status — skipped under dry-run
+    #: admission so a preview pass cannot double-charge.
+    charges_state = True
 
     def __init__(self, registry: "Registry"):
         self.registry = registry

@@ -142,8 +142,9 @@ class CertAuthority:
         subject = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, name)])
         key, cert = self._issue(subject, sans=sans, client=False)
         out = out_dir or self.dir
-        pair = CertPair(os.path.join(out, f"{name}.crt"),
-                        os.path.join(out, f"{name}.key"))
+        base = name.replace(":", "-").replace("/", "-")
+        pair = CertPair(os.path.join(out, f"{base}.crt"),
+                        os.path.join(out, f"{base}.key"))
         _write(pair.key_path, _key_pem(key), private=True)
         _write(pair.cert_path, cert.public_bytes(serialization.Encoding.PEM))
         return pair
@@ -164,12 +165,19 @@ class CertAuthority:
         return pair
 
     def sign_csr_pem(self, csr_pem: bytes, user: str,
-                     groups: list[str] = (), days: int = 365) -> bytes:
+                     groups: list[str] = (), days: int = 365,
+                     server_auth: bool = False,
+                     sans: list[str] = ()) -> bytes:
         """Sign a CSR's PUBLIC KEY for the server-decided identity
         (CN/O come from ``user``/``groups``, never from the CSR —
         a joiner must not pick its own identity). Returns cert PEM.
         The TLS-bootstrap end state: the private key never leaves the
-        node (reference: ``pkg/kubelet/certificate/kubelet.go:96``)."""
+        node (reference: ``pkg/kubelet/certificate/kubelet.go:96``).
+
+        ``server_auth=True`` mints a SERVING cert instead (the kubelet
+        serving-cert CSR flow): EKU serverAuth, SANs from ``sans`` —
+        the caller (apiserver endpoint) decides which claimed addresses
+        to admit, like the reference's CSR approver does."""
         csr = x509.load_pem_x509_csr(csr_pem)
         if not csr.is_signature_valid:
             raise ValueError("CSR signature invalid")
@@ -177,18 +185,28 @@ class CertAuthority:
         for g in groups:
             attrs.append(x509.NameAttribute(NameOID.ORGANIZATION_NAME, g))
         now = datetime.datetime.now(datetime.timezone.utc)
-        cert = (x509.CertificateBuilder()
-                .subject_name(x509.Name(attrs))
-                .issuer_name(self._cert.subject)
-                .public_key(csr.public_key())
-                .serial_number(x509.random_serial_number())
-                .not_valid_before(now - _ONE_DAY)
-                .not_valid_after(now + datetime.timedelta(days=days))
-                .add_extension(x509.BasicConstraints(ca=False, path_length=None),
-                               critical=True)
-                .add_extension(x509.ExtendedKeyUsage(
-                    [ExtendedKeyUsageOID.CLIENT_AUTH]), critical=False)
-                .sign(self._key, hashes.SHA256()))
+        eku = (ExtendedKeyUsageOID.SERVER_AUTH if server_auth
+               else ExtendedKeyUsageOID.CLIENT_AUTH)
+        b = (x509.CertificateBuilder()
+             .subject_name(x509.Name(attrs))
+             .issuer_name(self._cert.subject)
+             .public_key(csr.public_key())
+             .serial_number(x509.random_serial_number())
+             .not_valid_before(now - _ONE_DAY)
+             .not_valid_after(now + datetime.timedelta(days=days))
+             .add_extension(x509.BasicConstraints(ca=False, path_length=None),
+                            critical=True)
+             .add_extension(x509.ExtendedKeyUsage([eku]), critical=False))
+        if sans:
+            alt = []
+            for san in sans:
+                try:
+                    alt.append(x509.IPAddress(ipaddress.ip_address(san)))
+                except ValueError:
+                    alt.append(x509.DNSName(san))
+            b = b.add_extension(x509.SubjectAlternativeName(alt),
+                                critical=False)
+        cert = b.sign(self._key, hashes.SHA256())
         return cert.public_bytes(serialization.Encoding.PEM)
 
 
@@ -212,31 +230,58 @@ def identity_from_der(der: bytes) -> tuple[str, list[str]]:
     return (cn[0].value if cn else "", [o.value for o in orgs])
 
 
-def server_ssl_context(pair: CertPair, ca_path: str = ""):
-    """TLS-server context; with ``ca_path``, client certs are REQUESTED
-    and verified against the CA when presented (CERT_OPTIONAL — tokens
-    over TLS remain a valid way in, like the reference's authenticator
-    union), and a cert failing chain verification aborts the handshake."""
+def server_ssl_context(pair: CertPair, ca_path: str = "",
+                       require_client_cert: bool = False):
+    """TLS-server context; with ``ca_path``, client certs are verified
+    against the CA. Default CERT_OPTIONAL — tokens over TLS remain a
+    valid way in, like the reference's authenticator union; a presented
+    cert failing chain verification still aborts the handshake.
+    ``require_client_cert=True`` (the node server: kubelet requires
+    delegated authn on :10250) refuses connections without a valid
+    cluster client cert at the handshake."""
     import ssl
     ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
     ctx.load_cert_chain(pair.cert_path, pair.key_path)
     if ca_path:
         ctx.load_verify_locations(ca_path)
-        ctx.verify_mode = ssl.CERT_OPTIONAL
+        ctx.verify_mode = (ssl.CERT_REQUIRED if require_client_cert
+                           else ssl.CERT_OPTIONAL)
     return ctx
 
 
 def client_ssl_context(ca_path: str, cert_path: str = "",
-                       key_path: str = ""):
+                       key_path: str = "", check_hostname: bool = True):
     """THE client-side TLS context (RESTClient and ktl join both use
     it — one place for policy like hostname checking): trust the
-    cluster CA; with ``cert_path``, authenticate with an identity cert."""
+    cluster CA; with ``cert_path``, authenticate with an identity cert.
+    Hostname verification is ON — serving certs carry their reachable
+    addresses in SANs (issue_server_cert / the serving-CSR flow), so a
+    cert minted for one endpoint cannot be replayed as another at a
+    different address. ``check_hostname=False`` only for callers that
+    pin the peer another way (e.g. the join flow's CA fingerprint,
+    checked before any credential is sent)."""
     import ssl
     ctx = ssl.create_default_context(cafile=ca_path)
-    ctx.check_hostname = False  # CA-pinned; SANs may not cover aliases
+    ctx.check_hostname = check_hostname
     if cert_path:
         ctx.load_cert_chain(cert_path, key_path or None)
     return ctx
+
+
+def local_host_sans(extra: list[str] = ()) -> list[str]:
+    """The addresses this host answers on, for serving-cert SANs:
+    loopback names + the machine hostname + its resolved IP (when
+    resolvable). One derivation shared by the apiserver cert, node
+    serving certs, and the join flow's claimed set — divergence here
+    means one endpoint verifies where another fails."""
+    import socket
+    sans = {"localhost", "127.0.0.1", socket.gethostname()}
+    try:
+        sans.add(socket.gethostbyname(socket.gethostname()))
+    except OSError:
+        pass
+    sans.update(extra)
+    return sorted(s for s in sans if s)
 
 
 def fingerprint_pem(cert_pem: bytes) -> str:

@@ -177,11 +177,15 @@ def builtin_resources() -> list[ResourceSpec]:
         ResourceSpec("mutatingwebhookconfigurations",
                      "MutatingWebhookConfiguration", ext.ADMISSION_V1,
                      ext.MutatingWebhookConfiguration, namespaced=False,
-                     has_status=False),
+                     has_status=False,
+                     validate_create=ext.validate_webhook_configuration,
+                     validate_update=ext.validate_webhook_configuration_update),
         ResourceSpec("validatingwebhookconfigurations",
                      "ValidatingWebhookConfiguration", ext.ADMISSION_V1,
                      ext.ValidatingWebhookConfiguration, namespaced=False,
-                     has_status=False),
+                     has_status=False,
+                     validate_create=ext.validate_webhook_configuration,
+                     validate_update=ext.validate_webhook_configuration_update),
     ]
 
 
@@ -280,7 +284,8 @@ class Registry:
         if isinstance(obj, t.Secret):
             _merge_secret_string_data(obj)
         if self.admission is not None:
-            obj = self.admission.admit("CREATE", spec, obj, None)
+            obj = self.admission.admit("CREATE", spec, obj, None,
+                                       dry_run=dry_run)
         if spec.validate_create:
             spec.validate_create(obj)
         if dry_run:
@@ -545,11 +550,16 @@ class Registry:
             last_key = s.key
         return out, rev, cont
 
-    def update(self, obj: TypedObject, subresource: str = "") -> TypedObject:
+    def update(self, obj: TypedObject, subresource: str = "",
+               dry_run: bool = False) -> TypedObject:
         """Full-object update with optimistic concurrency.
 
         ``subresource=''``: spec/meta update, status preserved from old.
         ``subresource='status'``: status update, spec/meta preserved.
+        ``dry_run=True`` stops after defaulting + admission +
+        validation and returns the would-be object (no allocator or
+        store side effects) — the apiserver uses it to show validating
+        webhooks the post-in-tree-admission object.
         """
         spec = self.spec_for_kind(obj.kind or type(obj).__name__)
         meta = obj.metadata
@@ -581,12 +591,15 @@ class Registry:
             else:
                 new.metadata.generation = old.metadata.generation
             if self.admission is not None:
-                new = self.admission.admit("UPDATE", spec, new, old)
+                new = self.admission.admit("UPDATE", spec, new, old,
+                                           dry_run=dry_run)
             if spec.validate_update:
                 spec.validate_update(new, old)
             elif spec.validate_create:
                 spec.validate_create(new, False)
         new.api_version, new.kind = spec.api_version, spec.kind
+        if dry_run:
+            return new
         # Finalizer-driven actual deletion: once an object marked for
         # deletion has no finalizers left, the update removes it.
         ns_finalizers = (isinstance(new, t.Namespace) and new.spec.finalizers)

@@ -346,6 +346,12 @@ class APIServer:
         # kubeadm-join analog: exchange a bootstrap token for a durable
         # node credential (bootstrap.py; the CSR-signing step's end
         # state, authz'd to system:bootstrappers explicitly below).
+        # TokenReview (reference: authentication.k8s.io/v1) — the
+        # delegated-authn half of the kubelet model: node servers POST
+        # a caller's bearer token here and get back its identity
+        # (kubelet --authentication-token-webhook).
+        r.add_post("/apis/authentication/v1/tokenreviews",
+                   self._token_review)
         r.add_post("/bootstrap/v1/node-credentials", self._node_credentials)
         # TLS bootstrap (kubeadm discovery + kubelet TLS bootstrap):
         # the CA cert is public (joiners verify it against a sha256
@@ -367,6 +373,32 @@ class APIServer:
 
     async def _healthz(self, request):
         return web.Response(text="ok")
+
+    async def _token_review(self, request):
+        """POST {"spec": {"token": ...}} -> TokenReview with status
+        {authenticated, user:{username, groups}}. Runs the same
+        authenticator union the request middleware uses (static tokens,
+        SA tokens, bootstrap tokens). Caller must be authenticated
+        (non-resource path: authn-only, like /metrics)."""
+        try:
+            body = await request.json()
+            token = str((body.get("spec") or {}).get("token") or "")
+        except Exception:  # noqa: BLE001
+            return self._err(errors.InvalidError(
+                'body must be {"spec": {"token": "..."}}'))
+        user = None
+        if token and self.tokens is not None:
+            user = (self.tokens.get(token) or self._sa_user(token)
+                    or self._bootstrap_user(token))
+        if user:
+            status = {"authenticated": True,
+                      "user": {"username": user,
+                               "groups": sorted(self._groups_for(user))}}
+        else:
+            status = {"authenticated": False}
+        return web.json_response({"kind": "TokenReview",
+                                  "api_version": "authentication/v1",
+                                  "status": status})
 
     async def _node_credentials(self, request):
         """POST {"node_name": ...} -> {"token", "user", "node_name"}.
@@ -426,7 +458,13 @@ class APIServer:
         joiner's CSR as the node identity (CN/O chosen server-side —
         the CSR only contributes a public key). Gated exactly like
         node-credentials: bootstrap token or cluster admin. The private
-        key never crosses the wire (kubelet.go:96 TLS bootstrap)."""
+        key never crosses the wire (kubelet.go:96 TLS bootstrap).
+
+        ``"usage": "serving"`` (+ ``"sans": [...]``) mints the node's
+        SERVING cert instead — the kubelet serving-cert CSR flow. The
+        claimed SANs are admitted plus the connection's observed peer
+        address (the same trust the reference's default node-serving
+        approver places in node-reported addresses)."""
         from ..api import rbac as rbacapi
         from .bootstrap import (GROUP_BOOTSTRAPPERS, NODES_NAMESPACE,
                                 mint_node_credential)
@@ -447,9 +485,46 @@ class APIServer:
             body = await request.json()
             node_name = body.get("node_name", "")
             csr_pem = body.get("csr_pem", "").encode()
+            serving = body.get("usage", "") == "serving"
+            sans = [str(s) for s in body.get("sans", [])][:16]
         except Exception:  # noqa: BLE001
             record(400)
             return self._err(errors.InvalidError("body must be JSON"))
+        if serving:
+            # SAN admission policy (the reference's serving-cert CSR
+            # approver restricts SANs to the Node's recorded
+            # addresses): a bootstrap token must NOT mint a
+            # cluster-CA serverAuth cert for arbitrary names — that
+            # would defeat client hostname verification cluster-wide.
+            # Admitted: the observed peer address, loopback, and the
+            # node name when it is a bare single label (never an
+            # FQDN/IP someone else answers on). Everything else is
+            # dropped.
+            peer = request.remote or ""
+            admitted = []
+            for claim in sans:
+                if not claim:
+                    continue
+                if claim == peer or (claim == node_name
+                                     and "." not in claim):
+                    admitted.append(claim)
+                elif "." in claim and peer:
+                    # FQDN hostnames are admitted only when OUR
+                    # resolver maps them to the requester — so a
+                    # bootstrap token cannot mint a cert for the
+                    # apiserver's (or anyone else's) name.
+                    import socket as socketlib
+                    try:
+                        resolved = await asyncio.to_thread(
+                            socketlib.gethostbyname, claim)
+                    except OSError:
+                        continue
+                    if resolved == peer:
+                        admitted.append(claim)
+            sans = admitted
+            for addr in (peer, "127.0.0.1", "localhost"):
+                if addr and addr not in sans:
+                    sans.append(addr)
         # Validate the CSR BEFORE any durable mutation: a garbage CSR
         # must not leave behind a credential Secret + ClusterRoleBinding
         # nobody received (and must not audit as a success).
@@ -464,7 +539,8 @@ class APIServer:
         try:
             cred = mint_node_credential(self.registry, node_name)
             cert_pem = self.cert_authority.sign_csr_pem(
-                csr_pem, user=cred["user"])
+                csr_pem, user=cred["user"], server_auth=serving,
+                sans=sans if serving else ())
         except errors.StatusError as e:
             record(e.code, node_name)
             raise
@@ -639,9 +715,18 @@ class APIServer:
         if self.webhooks.has_hooks("CREATE", plural):
             d = await self.webhooks.run_mutating(
                 "CREATE", plural, ns, obj.metadata.name, to_dict(obj))
-            await self.webhooks.run_validating(
-                "CREATE", plural, ns, obj.metadata.name, d)
             obj = self.registry.scheme.decode(d)
+            # Validating hooks see the FINAL request object — in-tree
+            # defaulting + admission applied (dry-run pass) — matching
+            # the reference's mutate-everything-then-validate ordering
+            # (admission.go: validating phase after all mutation). The
+            # extra pass is skipped when no validating hook matches.
+            if self.webhooks.has_validating("CREATE", plural):
+                admitted = await self._mutate(
+                    self.registry.create, obj, True)
+                await self.webhooks.run_validating(
+                    "CREATE", plural, ns, obj.metadata.name,
+                    to_dict(admitted))
         created = await self._mutate(self.registry.create, obj)
         if plural.endswith("webhookconfigurations"):
             self.webhooks.invalidate()
@@ -781,9 +866,15 @@ class APIServer:
                 old = None
             d = await self.webhooks.run_mutating(
                 "UPDATE", plural, ns, obj.metadata.name, to_dict(obj), old)
-            await self.webhooks.run_validating(
-                "UPDATE", plural, ns, obj.metadata.name, d, old)
             obj = self.registry.scheme.decode(d)
+            # Validate on the post-in-tree-admission object (see
+            # _create); dry-run has no allocator/store side effects.
+            if self.webhooks.has_validating("UPDATE", plural):
+                admitted = await self._mutate(
+                    self.registry.update, obj, sub, True)
+                await self.webhooks.run_validating(
+                    "UPDATE", plural, ns, obj.metadata.name,
+                    to_dict(admitted), old)
         updated = await self._mutate(self.registry.update, obj, sub)
         if plural.endswith("webhookconfigurations"):
             self.webhooks.invalidate()
@@ -808,11 +899,16 @@ class APIServer:
                 old = to_dict(old_obj)
                 d = await self.webhooks.run_mutating(
                     "UPDATE", plural, ns, name, merged, old)
-                await self.webhooks.run_validating(
-                    "UPDATE", plural, ns, name, d, old)
                 obj = self.registry.scheme.decode(d)
                 obj.metadata.resource_version = \
                     old_obj.metadata.resource_version
+                # Validate on the post-in-tree-admission object (see
+                # _create).
+                if self.webhooks.has_validating("UPDATE", plural):
+                    admitted = await self._mutate(
+                        self.registry.update, obj, sub, True)
+                    await self.webhooks.run_validating(
+                        "UPDATE", plural, ns, name, to_dict(admitted), old)
                 try:
                     updated = await self._mutate(
                         self.registry.update, obj, sub)

@@ -113,12 +113,17 @@ class WebhookDispatcher:
         self.ttl = ttl
         self._cache: tuple[float, list, list] = (float("-inf"), [], [])
         self._session = None
+        #: ca_bundle PEM -> SSLContext (see _hook_ssl).
+        self._ssl_cache: dict[str, Any] = {}
 
     def invalidate(self) -> None:
         """Drop the TTL snapshot — the server calls this when a webhook
         configuration itself is written, so `create config; create pod`
-        inside one TTL window still intercepts the pod."""
+        inside one TTL window still intercepts the pod. SSL contexts
+        go too: rotated/deleted ca_bundles must not pin stale trust
+        (and the dict stays bounded by the live config set)."""
         self._cache = (float("-inf"), [], [])
+        self._ssl_cache.clear()
 
     def _configs(self) -> tuple[list, list]:
         now = time.monotonic()
@@ -148,15 +153,39 @@ class WebhookDispatcher:
         return any(self._matches(h, operation, plural)
                    for cfg in mut + val for h in cfg.webhooks)
 
+    def has_validating(self, operation: str, plural: str) -> bool:
+        """Gate for the dry-run admission preview: the extra in-tree
+        pass is only worth paying when a validating hook will actually
+        see its output."""
+        _, val = self._configs()
+        return any(self._matches(h, operation, plural)
+                   for cfg in val for h in cfg.webhooks)
+
+    def _hook_ssl(self, hook: ext.Webhook):
+        """Per-hook TLS trust: ``ca_bundle`` (PEM) verifies the hook's
+        serving cert (reference clientConfig.caBundle); without one,
+        the system trust store applies. Contexts are cached by bundle
+        content — building an SSLContext per call is milliseconds."""
+        if not hook.url.startswith("https://") or not hook.ca_bundle:
+            return None
+        ctx = self._ssl_cache.get(hook.ca_bundle)
+        if ctx is None:
+            import ssl
+            ctx = ssl.create_default_context(cadata=hook.ca_bundle)
+            self._ssl_cache[hook.ca_bundle] = ctx
+        return ctx
+
     async def _call(self, hook: ext.Webhook, review: dict) -> Optional[dict]:
         """One hook round trip; None means unreachable/invalid (the
         failure_policy decides what that means)."""
         import aiohttp
         if self._session is None or self._session.closed:
             self._session = aiohttp.ClientSession()
+        ssl_ctx = self._hook_ssl(hook)
         try:
             async with self._session.post(
                     hook.url, json=review,
+                    **({"ssl": ssl_ctx} if ssl_ctx is not None else {}),
                     timeout=aiohttp.ClientTimeout(
                         total=hook.timeout_seconds)) as resp:
                 if resp.status != 200:

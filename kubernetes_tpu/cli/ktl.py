@@ -262,24 +262,43 @@ async def cmd_delete(args) -> int:
         await client.close()
 
 
-async def _node_daemon_base(client: RESTClient, node_name: str) -> Optional[str]:
-    """Resolve a node's agent server URL from DaemonEndpoints."""
+def _ssl_kw(ssl_ctx) -> dict:
+    """aiohttp request kwargs for an optional TLS context."""
+    return {"ssl": ssl_ctx} if ssl_ctx is not None else {}
+
+
+async def _node_daemon_base(client: RESTClient,
+                            node_name: str) -> Optional[tuple[str, Any]]:
+    """Resolve a node's agent server from DaemonEndpoints: (base URL,
+    ssl context or None). ``agent_tls`` in the endpoints means the node
+    serves HTTPS requiring a cluster client cert (kubelet :10250
+    model) — the apiserver credentials double as that identity."""
     node = await client.get("nodes", "", node_name)
     port = node.status.daemon_endpoints.get("agent")
     if not port:
         return None
+    tls = bool(node.status.daemon_endpoints.get("agent_tls"))
+    ssl_ctx = client.ssl_context if tls else None
+    if tls and ssl_ctx is None:
+        # Unreachable-for-us, not fatal: per-node callers (ktl top
+        # iterates every node) must keep going.
+        print(f"ktl: node {node_name} requires TLS but no cluster "
+              "CA/client cert is configured", file=sys.stderr)
+        return None
+    scheme = "https" if tls else "http"
     addr = node.status.addresses[0].address if node.status.addresses else ""
     import aiohttp
     for host in (addr, "127.0.0.1"):
         if not host:
             continue
-        base = f"http://{host}:{port}"
+        base = f"{scheme}://{host}:{port}"
         try:
             async with aiohttp.ClientSession() as s:
                 async with s.get(f"{base}/healthz",
-                                 timeout=aiohttp.ClientTimeout(total=2)) as r:
+                                 timeout=aiohttp.ClientTimeout(total=2),
+                                 **_ssl_kw(ssl_ctx)) as r:
                     if r.status == 200:
-                        return base
+                        return base, ssl_ctx
         except Exception:  # noqa: BLE001 — unresolvable hostname etc.
             continue
     return None
@@ -291,10 +310,11 @@ async def cmd_logs(args) -> int:
         pod = await client.get("pods", args.namespace, args.pod)
         if not pod.spec.node_name:
             raise SystemExit(f"ktl: pod {args.pod} is not scheduled yet")
-        base = await _node_daemon_base(client, pod.spec.node_name)
-        if base is None:
+        conn = await _node_daemon_base(client, pod.spec.node_name)
+        if conn is None:
             raise SystemExit(f"ktl: node {pod.spec.node_name} has no "
                              "reachable agent server")
+        base, node_ssl = conn
         container = args.container or "-"
         import aiohttp
         params = {"tail": str(args.tail)} if args.tail else {}
@@ -307,7 +327,8 @@ async def cmd_logs(args) -> int:
         timeout = aiohttp.ClientTimeout(total=None) if follow else None
         async with aiohttp.ClientSession() as s:
             url = f"{base}/logs/{args.namespace}/{args.pod}/{container}"
-            async with s.get(url, params=params, timeout=timeout) as r:
+            async with s.get(url, params=params, timeout=timeout,
+                             **_ssl_kw(node_ssl)) as r:
                 if r.status != 200:
                     raise SystemExit(f"ktl: {(await r.text()).strip()}")
                 out_buf = getattr(sys.stdout, "buffer", None)
@@ -331,7 +352,7 @@ async def cmd_logs(args) -> int:
 async def exec_interactive(base: str, namespace: str, pod: str,
                            container: str, argv: list[str],
                            stdin_source=None, out=None,
-                           timeout: float = 3600.0) -> int:
+                           timeout: float = 3600.0, ssl_ctx=None) -> int:
     """Drive the node server's WebSocket exec stream: binary frames are
     stdio; the closing text frame carries the exit code. Reusable by
     tests (stdin_source: async iterator of bytes; None = process stdin)."""
@@ -345,7 +366,7 @@ async def exec_interactive(base: str, namespace: str, pod: str,
     exit_code = 1
     async with aiohttp.ClientSession(
             timeout=aiohttp.ClientTimeout(total=timeout + 30)) as s:
-        async with s.ws_connect(url) as ws:
+        async with s.ws_connect(url, **_ssl_kw(ssl_ctx)) as ws:
             async def feed():
                 try:
                     if stdin_source is None:
@@ -400,10 +421,11 @@ async def cmd_exec(args) -> int:
         pod = await client.get("pods", args.namespace, args.pod)
         if not pod.spec.node_name:
             raise SystemExit(f"ktl: pod {args.pod} is not scheduled yet")
-        base = await _node_daemon_base(client, pod.spec.node_name)
-        if base is None:
+        conn = await _node_daemon_base(client, pod.spec.node_name)
+        if conn is None:
             raise SystemExit(f"ktl: node {pod.spec.node_name} has no "
                              "reachable agent server")
+        base, node_ssl = conn
         container = args.container or "-"
         if getattr(args, "stdin", False):
             # Interactive sessions outlive the one-shot default; an
@@ -411,7 +433,7 @@ async def cmd_exec(args) -> int:
             timeout = args.timeout if args.timeout is not None else 3600.0
             return await exec_interactive(
                 base, args.namespace, args.pod, container, args.cmd,
-                timeout=timeout)
+                timeout=timeout, ssl_ctx=node_ssl)
         import aiohttp
         # The HTTP call must outlive the exec's own timeout (aiohttp's
         # default 300s total would abort long execs client-side).
@@ -422,7 +444,8 @@ async def cmd_exec(args) -> int:
             one_shot_timeout = (args.timeout if args.timeout is not None
                                 else 30.0)
             async with s.post(url, json={"command": args.cmd,
-                                         "timeout": one_shot_timeout}) as r:
+                                         "timeout": one_shot_timeout},
+                              **_ssl_kw(node_ssl)) as r:
                 if r.status != 200:
                     raise SystemExit(f"ktl: {(await r.text()).strip()}")
                 body = await r.json()
@@ -436,7 +459,7 @@ async def forward_port(base: str, namespace: str, pod: str,
                        local_port: int, remote_port: int,
                        ready: Optional[asyncio.Event] = None,
                        stop: Optional[asyncio.Event] = None,
-                       on_bound=None) -> int:
+                       on_bound=None, ssl_ctx=None) -> int:
     """Listen on 127.0.0.1:local_port; tunnel each connection through
     the node server's port-forward WebSocket to the pod's remote_port.
     Runs until ``stop`` (or forever). Returns the bound local port."""
@@ -446,7 +469,7 @@ async def forward_port(base: str, namespace: str, pod: str,
         url = f"{base}/portforward/{namespace}/{pod}/{remote_port}"
         try:
             async with aiohttp.ClientSession() as s:
-                async with s.ws_connect(url) as ws:
+                async with s.ws_connect(url, **_ssl_kw(ssl_ctx)) as ws:
                     async def ws_to_tcp():
                         try:
                             async for msg in ws:
@@ -497,10 +520,11 @@ async def cmd_port_forward(args) -> int:
         pod = await client.get("pods", args.namespace, args.pod)
         if not pod.spec.node_name:
             raise SystemExit(f"ktl: pod {args.pod} is not scheduled yet")
-        base = await _node_daemon_base(client, pod.spec.node_name)
-        if base is None:
+        conn = await _node_daemon_base(client, pod.spec.node_name)
+        if conn is None:
             raise SystemExit(f"ktl: node {pod.spec.node_name} has no "
                              "reachable agent server")
+        base, node_ssl = conn
     finally:
         await client.close()
     local_s, _, remote_s = args.ports.partition(":")
@@ -515,6 +539,7 @@ async def cmd_port_forward(args) -> int:
             signal.signal(sig, lambda *_: stop.set())
     await forward_port(
         base, args.namespace, args.pod, local, remote, stop=stop,
+        ssl_ctx=node_ssl,
         on_bound=lambda p: print(f"forwarding 127.0.0.1:{p} -> "
                                  f"{args.pod}:{remote} (Ctrl-C to stop)",
                                  flush=True))
@@ -590,12 +615,14 @@ async def cmd_top(args) -> int:
         import aiohttp
         rows, chip_rows = [], []
         for node in nodes:
-            base = await _node_daemon_base(client, node.metadata.name)
-            if base is None:
+            conn = await _node_daemon_base(client, node.metadata.name)
+            if conn is None:
                 rows.append([node.metadata.name, "-", "-", "unreachable"])
                 continue
+            base, node_ssl = conn
             async with aiohttp.ClientSession() as s:
-                async with s.get(f"{base}/stats/summary") as r:
+                async with s.get(f"{base}/stats/summary",
+                                 **_ssl_kw(node_ssl)) as r:
                     summary = await r.json()
             mem = summary["node"]["memory"]
             rows.append([
@@ -946,7 +973,11 @@ async def cmd_join(args) -> int:
         # TLS bootstrap: key stays local, only the CSR travels.
         client_key = os.path.join(node_dir, "node.key")
         csr = make_csr_pem(client_key, f"system:node:{node_name}")
-        join_ctx = client_ssl_context(ca_file)
+        # CA-fingerprint-pinned (checked above) — hostname verification
+        # stays off: the user-supplied --server address is routinely a
+        # routable IP absent from the apiserver cert's SANs, and the
+        # pin already binds the peer to the cluster CA.
+        join_ctx = client_ssl_context(ca_file, check_hostname=False)
         async with aiohttp.ClientSession() as sess:
             resp = await sess.post(
                 f"{server}/bootstrap/v1/sign-csr",
@@ -962,6 +993,29 @@ async def cmd_join(args) -> int:
         with open(client_cert, "w") as f:
             f.write(signed["cert_pem"])
         print(f"node certificate minted for {signed['user']}")
+        # Node SERVING cert (kubelet serving-cert CSR flow): the node
+        # server refuses plain HTTP under cluster TLS — exec on this
+        # host must not be open to anyone who can reach the port.
+        serving_key = os.path.join(node_dir, "node-serving.key")
+        serving_csr = make_csr_pem(serving_key, f"system:node:{node_name}")
+        from ..apiserver.certs import local_host_sans
+        claimed = local_host_sans([node_name])
+        async with aiohttp.ClientSession() as sess:
+            resp = await sess.post(
+                f"{server}/bootstrap/v1/sign-csr",
+                json={"node_name": node_name,
+                      "csr_pem": serving_csr.decode(),
+                      "usage": "serving", "sans": claimed},
+                headers={"Authorization": f"Bearer {args.token}"},
+                ssl=join_ctx)
+            if resp.status != 200:
+                print(f"serving-cert signing failed ({resp.status}): "
+                      f"{(await resp.text())[:200]}", file=sys.stderr)
+                return 1
+            serving_signed = await resp.json()
+        serving_cert = os.path.join(node_dir, "node-serving.crt")
+        with open(serving_cert, "w") as f:
+            f.write(serving_signed["cert_pem"])
 
     # 1. Bootstrap-token -> durable node credential (token beside the
     # cert: agents authenticate with either; the response also carries
@@ -989,8 +1043,11 @@ async def cmd_join(args) -> int:
     print(f"joined as {body['user']}")
 
     # 2. Run the node agent with the minted identity (cert-first).
+    # Same trust model as the join itself: CA-fingerprint-pinned, so
+    # hostname verification stays off for the user-supplied --server.
     client = RESTClient(server, token=cred, ca_file=ca_file,
-                        client_cert=client_cert, client_key=client_key)
+                        client_cert=client_cert, client_key=client_key,
+                        check_hostname=False)
     runtime = ProcessRuntime(node_dir)
     dm = None
     if args.real_tpu or args.tpu_chips:
@@ -1006,6 +1063,10 @@ async def cmd_join(args) -> int:
         dm = DeviceManager(plugin_dir)
     agent = NodeAgent(client, node_name, runtime, device_manager=dm,
                       eviction=EvictionManager(), server_port=0)
+    if ca_file:
+        from ..apiserver.certs import CertPair, server_ssl_context
+        agent.server_tls = server_ssl_context(
+            CertPair(serving_cert, serving_key), ca_file)
     # Cluster DNS rides the credential response (see _node_credentials)
     # so pods here resolve rank hostnames exactly like local-node pods.
     agent.dns_server = body.get("dns_server", "")

@@ -118,16 +118,20 @@ class _RESTWatch(WatchStream):
 class RESTClient(Client):
     def __init__(self, base_url: str, token: str = "",
                  ca_file: str = "", client_cert: str = "",
-                 client_key: str = ""):
+                 client_key: str = "", check_hostname: bool = True):
         """``ca_file`` makes https URLs verify against the cluster CA;
         ``client_cert``/``client_key`` authenticate with an x509
-        identity cert (CN=user, O=groups) instead of / beside a token."""
+        identity cert (CN=user, O=groups) instead of / beside a token.
+        ``check_hostname=False`` only for callers that pinned the peer
+        another way (the join flow's CA fingerprint — its --server
+        address is routinely absent from the apiserver cert SANs)."""
         self.base_url = base_url.rstrip("/")
         self._headers = {"Authorization": f"Bearer {token}"} if token else {}
         self._ssl = None
         if ca_file:
             from ..apiserver.certs import client_ssl_context
-            self._ssl = client_ssl_context(ca_file, client_cert, client_key)
+            self._ssl = client_ssl_context(ca_file, client_cert, client_key,
+                                           check_hostname=check_hostname)
         self._session: Optional[aiohttp.ClientSession] = None
         #: Discovery-learned resources (CRDs): plural -> (gv, namespaced).
         #: TTL'd so CRD deletion/recreation is picked up (the static
@@ -136,6 +140,31 @@ class RESTClient(Client):
         self._dynamic_kinds: dict[str, str] = {}
         self._discovery_at = 0.0
         self.discovery_ttl = 15.0
+
+    async def token_review(self, token: str) -> Optional[tuple[str, set]]:
+        """Delegated authn (authentication/v1 TokenReview): resolve a
+        SUBJECT's bearer token to (username, groups) using this
+        client's own credential; None if not authenticated. The node
+        server uses it for token-bearing callers (kubelet
+        --authentication-token-webhook model)."""
+        url = f"{self.base_url}/apis/authentication/v1/tokenreviews"
+        async with self._sess().post(
+                url, json={"spec": {"token": token}}) as resp:
+            if resp.status != 200:
+                return None
+            body = await resp.json()
+        status = body.get("status") or {}
+        if not status.get("authenticated"):
+            return None
+        user = status.get("user") or {}
+        return user.get("username", ""), set(user.get("groups") or ())
+
+    @property
+    def ssl_context(self):
+        """The client TLS context (CA trust + identity cert), or None.
+        Node-server consumers (ktl logs/exec/top) reuse it — same CA,
+        same identity — for the kubelet-analog HTTPS endpoints."""
+        return self._ssl
 
     def _sess(self) -> aiohttp.ClientSession:
         if self._session is None or self._session.closed:

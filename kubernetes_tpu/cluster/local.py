@@ -173,10 +173,14 @@ class LocalCluster:
         if self.tls:
             from ..apiserver.certs import (CertAuthority,
                                            server_ssl_context)
+            from ..apiserver.certs import local_host_sans
             pki = os.path.join(self.data_dir, "pki")
             self.ca = CertAuthority(pki).ensure()
-            sans = {self.host, "localhost", "127.0.0.1"}
-            pair = self.ca.issue_server_cert("apiserver", sorted(sans))
+            # Clients verify hostnames against SANs (certs.py), so the
+            # cert must cover every address this apiserver answers on —
+            # including the routable ones multi-host joiners dial.
+            pair = self.ca.issue_server_cert(
+                "apiserver", local_host_sans([self.host]))
             self.admin_cert = self.ca.issue_client_cert(
                 "admin", ["system:masters"], out_dir=pki)
             self.ca_file = self.ca.ca_cert_path
@@ -269,6 +273,23 @@ class LocalCluster:
             heartbeat_interval=self.heartbeat_interval,
             proxy=proxy, eviction=eviction, runtime_hook=hook,
             chip_metrics=plugin.chip_metrics if spec.real_tpu else None)
+        if self.ca is not None:
+            # Node serving cert (kubelet :10250 TLS): clients verify
+            # the node's address against SANs; the handshake requires a
+            # cluster client cert (exec = code execution on this host).
+            from ..apiserver.certs import local_host_sans, server_ssl_context
+            node_pki = os.path.join(node_dir, "pki")
+            pair = self.ca.issue_server_cert(
+                f"system:node:{name}", local_host_sans([self.host]),
+                out_dir=node_pki)
+            # CERT_OPTIONAL + TokenReview: cert clients authenticate at
+            # the handshake, token clients per-request (the kubelet's
+            # authenticator union). When the apiserver itself runs
+            # authn-disabled (tokens=None, dev mode), the node server
+            # admits anonymous the same way.
+            agent.server_tls = server_ssl_context(
+                pair, self.ca.ca_cert_path)
+            agent.server_allow_anonymous = self.tokens is None
         if self.dns is not None:
             agent.dns_server = self.dns.address
         await agent.start()

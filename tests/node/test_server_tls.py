@@ -1,0 +1,139 @@
+"""Node-server TLS + peer-identity authorization.
+
+Reference: the kubelet's :10250 requires TLS and delegated authn/authz
+(``pkg/kubelet/server``); containers here are host processes, so exec
+without it is arbitrary code execution for anyone reaching the port.
+The node server runs the kubelet's authenticator union: x509 client
+certs at the handshake (CERT_OPTIONAL), bearer tokens per-request via
+the apiserver's TokenReview, then a local two-tier policy: read routes
+for any authenticated identity, privileged routes (exec/logs/debug)
+only for system:masters or the node's own identity.
+"""
+import ssl
+
+import aiohttp
+import pytest
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.apiserver.certs import (CertAuthority,
+                                            client_ssl_context,
+                                            server_ssl_context)
+from kubernetes_tpu.apiserver.registry import Registry
+from kubernetes_tpu.client.local import LocalClient
+from kubernetes_tpu.node.agent import NodeAgent
+from kubernetes_tpu.node.runtime import FakeRuntime
+
+
+class TokenClient(LocalClient):
+    """LocalClient + a stub TokenReview (the RESTClient method's shape)
+    so delegated token authn is testable without a full apiserver."""
+
+    def __init__(self, registry, tokens):
+        super().__init__(registry)
+        self._tokens = tokens
+
+    async def token_review(self, token):
+        ident = self._tokens.get(token)
+        return None if ident is None else (ident[0], set(ident[1]))
+
+
+async def _agent_with_tls(tmp_path, tokens=None):
+    ca = CertAuthority(str(tmp_path / "pki")).ensure()
+    pair = ca.issue_server_cert("system:node:n0",
+                                ["127.0.0.1", "localhost"],
+                                out_dir=str(tmp_path / "pki"))
+    reg = Registry()
+    reg.create(t.Namespace(metadata=ObjectMeta(name="default")))
+    client = TokenClient(reg, tokens or {})
+    agent = NodeAgent(client, "n0", FakeRuntime(),
+                      status_interval=0.2, heartbeat_interval=0.2)
+    agent.server_tls = server_ssl_context(pair, ca.ca_cert_path)
+    await agent.start()
+    return ca, agent
+
+
+async def test_node_server_authn_union_and_tiers(tmp_path):
+    ca, agent = await _agent_with_tls(
+        tmp_path, tokens={"admintok": ("admin2", ["system:masters"]),
+                          "viewtok": ("viewer2", ["system:monitoring"])})
+    base = f"https://127.0.0.1:{agent.server.port}"
+    pki = str(tmp_path / "pki")
+    admin = ca.issue_client_cert("admin", ["system:masters"], out_dir=pki)
+    plebe = ca.issue_client_cert("viewer", ["system:monitoring"],
+                                 out_dir=pki)
+    try:
+        # 1. No credential at all: TLS connects (CERT_OPTIONAL) but
+        # every route 401s.
+        anon = ssl.create_default_context(cafile=ca.ca_cert_path)
+        anon.check_hostname = False
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"{base}/healthz", ssl=anon) as r:
+                assert r.status == 401
+
+        # 2. Plain HTTP against the TLS port: refused by TLS itself.
+        with pytest.raises(aiohttp.ClientError):
+            async with aiohttp.ClientSession() as s:
+                await s.get(base.replace("https://", "http://") + "/healthz",
+                            timeout=aiohttp.ClientTimeout(total=3))
+
+        # 3. Cert identities: any valid identity reads stats; only
+        # privileged ones exec.
+        view = client_ssl_context(ca.ca_cert_path, plebe.cert_path,
+                                  plebe.key_path, check_hostname=False)
+        root = client_ssl_context(ca.ca_cert_path, admin.cert_path,
+                                  admin.key_path, check_hostname=False)
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"{base}/stats/summary", ssl=view) as r:
+                assert r.status == 200
+            async with s.post(f"{base}/exec/default/p/c",
+                              json={"command": ["true"]}, ssl=view) as r:
+                assert r.status == 403  # authenticated but not authorized
+            async with s.get(f"{base}/logs/default/p/c", ssl=view) as r:
+                assert r.status == 403
+            async with s.post(f"{base}/exec/default/p/c",
+                              json={"command": ["true"]}, ssl=root) as r:
+                assert r.status == 404  # authorized; no such pod
+
+        # 4. Bearer tokens through delegated TokenReview: same tiers.
+        async with aiohttp.ClientSession() as s:
+            hdr = {"Authorization": "Bearer viewtok"}
+            async with s.get(f"{base}/stats/summary", ssl=anon,
+                             headers=hdr) as r:
+                assert r.status == 200
+            async with s.get(f"{base}/logs/default/p/c", ssl=anon,
+                             headers=hdr) as r:
+                assert r.status == 403
+            hdr = {"Authorization": "Bearer admintok"}
+            async with s.get(f"{base}/logs/default/p/c", ssl=anon,
+                             headers=hdr) as r:
+                assert r.status == 404  # authorized; pod doesn't exist
+            hdr = {"Authorization": "Bearer bogus"}
+            async with s.get(f"{base}/healthz", ssl=anon,
+                             headers=hdr) as r:
+                assert r.status == 401
+    finally:
+        await agent.stop()
+
+
+async def test_node_server_own_identity_is_privileged(tmp_path):
+    """The node's own cert (system:node:<name>) passes the privileged
+    tier — agents may call their own server (self-debug), other nodes'
+    identities may not."""
+    ca, agent = await _agent_with_tls(tmp_path)
+    base = f"https://127.0.0.1:{agent.server.port}"
+    pki = str(tmp_path / "pki")
+    own = ca.issue_client_cert("system:node:n0", out_dir=pki)
+    other = ca.issue_client_cert("system:node:n1", out_dir=pki)
+    try:
+        own_ctx = client_ssl_context(ca.ca_cert_path, own.cert_path,
+                                     own.key_path, check_hostname=False)
+        other_ctx = client_ssl_context(ca.ca_cert_path, other.cert_path,
+                                       other.key_path, check_hostname=False)
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"{base}/logs/default/p/c", ssl=own_ctx) as r:
+                assert r.status == 404  # authorized; pod doesn't exist
+            async with s.get(f"{base}/logs/default/p/c", ssl=other_ctx) as r:
+                assert r.status == 403
+    finally:
+        await agent.stop()
