@@ -19,6 +19,21 @@ def now() -> datetime.datetime:
     return datetime.datetime.utcnow()
 
 
+_STAMP_FMT = "%Y-%m-%dT%H:%M:%S.%fZ"
+
+
+def stamp(dt: datetime.datetime) -> str:
+    """RFC3339 string for ad-hoc timestamp maps (e.g. PDB
+    disrupted_pods). One format, shared with :func:`parse_stamp` —
+    writer and reader must never drift."""
+    return dt.strftime(_STAMP_FMT)
+
+
+def parse_stamp(s: str) -> datetime.datetime:
+    """Inverse of :func:`stamp`; raises ValueError on junk."""
+    return datetime.datetime.strptime(s, _STAMP_FMT)
+
+
 def new_uid() -> str:
     return str(uuid.uuid4())
 

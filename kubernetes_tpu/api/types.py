@@ -508,6 +508,25 @@ class Binding(TypedObject):
     target: BindingTarget = field(default_factory=BindingTarget)
 
 
+@dataclass
+class Eviction(TypedObject):
+    """Posted to ``pods/<name>/eviction`` — the PDB-gated voluntary
+    delete (reference: policy Eviction,
+    ``pkg/registry/core/pod/storage/eviction.go:57-120``). The server
+    refuses with 429 while the budget allows no disruption; on success
+    the pod is deleted with ``grace_period_seconds``.
+
+    ``override_budget=True`` is the priority-policy escape hatch
+    (scheduler preemption, dead-node escalation): the allowed check is
+    skipped but the disruption is still RECORDED in the PDB's
+    ``disrupted_pods`` accounting. RBAC-wise it rides the same
+    pods/eviction create verb — grant that verb only to components
+    trusted to preempt."""
+
+    grace_period_seconds: Optional[int] = None
+    override_budget: bool = False
+
+
 # ---------------------------------------------------------------------------
 # Nodes
 # ---------------------------------------------------------------------------
@@ -919,7 +938,8 @@ class StorageClass(TypedObject):
 CORE_V1 = "core/v1"
 
 for _kind, _cls in [
-    ("Pod", Pod), ("Node", Node), ("Binding", Binding), ("Service", Service),
+    ("Pod", Pod), ("Node", Node), ("Binding", Binding),
+    ("Eviction", Eviction), ("Service", Service),
     ("Endpoints", Endpoints), ("Namespace", Namespace), ("ConfigMap", ConfigMap),
     ("Secret", Secret), ("Event", Event), ("ResourceQuota", ResourceQuota),
     ("LimitRange", LimitRange), ("PriorityClass", PriorityClass),

@@ -305,6 +305,16 @@ class PodDisruptionBudgetStatus:
     current_healthy: int = 0
     desired_healthy: int = 0
     expected_pods: int = 0
+    #: Controller's view of the generation its numbers were computed
+    #: from — the eviction subresource refuses (429) while stale
+    #: (reference: eviction.go checkAndDecrement observedGeneration).
+    observed_generation: int = 0
+    #: pod name -> RFC3339 eviction-approved time. The eviction
+    #: handler records approved-but-not-yet-deleted pods here so the
+    #: disruption controller excludes them from current_healthy until
+    #: they actually go (or the entry times out, ~2min — crashed
+    #: deleters must not pin the budget). eviction.go DisruptedPods.
+    disrupted_pods: dict[str, str] = field(default_factory=dict)
 
 
 @dataclass

@@ -28,6 +28,18 @@ from cryptography.x509.oid import ExtendedKeyUsageOID, NameOID
 _ONE_DAY = datetime.timedelta(days=1)
 
 
+def _san_entries(sans) -> list:
+    """IP-vs-DNS classification for SubjectAlternativeName entries —
+    the one place both issuance paths (_issue, sign_csr_pem) share."""
+    alt = []
+    for san in sans:
+        try:
+            alt.append(x509.IPAddress(ipaddress.ip_address(san)))
+        except ValueError:
+            alt.append(x509.DNSName(san))
+    return alt
+
+
 def _new_key():
     # ECDSA P-256: small, fast handshakes; kubeadm moved the same way.
     return ec.generate_private_key(ec.SECP256R1())
@@ -128,13 +140,9 @@ class CertAuthority:
                             critical=True)
              .add_extension(x509.ExtendedKeyUsage([eku]), critical=False))
         if sans:
-            alt = []
-            for san in sans:
-                try:
-                    alt.append(x509.IPAddress(ipaddress.ip_address(san)))
-                except ValueError:
-                    alt.append(x509.DNSName(san))
-            b = b.add_extension(x509.SubjectAlternativeName(alt), critical=False)
+            b = b.add_extension(
+                x509.SubjectAlternativeName(_san_entries(sans)),
+                critical=False)
         return key, b.sign(self._key, hashes.SHA256())
 
     def issue_server_cert(self, name: str, sans: list[str],
@@ -198,14 +206,9 @@ class CertAuthority:
                             critical=True)
              .add_extension(x509.ExtendedKeyUsage([eku]), critical=False))
         if sans:
-            alt = []
-            for san in sans:
-                try:
-                    alt.append(x509.IPAddress(ipaddress.ip_address(san)))
-                except ValueError:
-                    alt.append(x509.DNSName(san))
-            b = b.add_extension(x509.SubjectAlternativeName(alt),
-                                critical=False)
+            b = b.add_extension(
+                x509.SubjectAlternativeName(_san_entries(sans)),
+                critical=False)
         cert = b.sign(self._key, hashes.SHA256())
         return cert.public_bytes(serialization.Encoding.PEM)
 

@@ -22,7 +22,8 @@ from typing import Any, Callable, Optional
 
 from ..api import errors, extensions as ext, rbac as r, types as t, \
     validation as val, workloads as w
-from ..api.meta import ObjectMeta, TypedObject, now, stamp_new
+from ..api.meta import ObjectMeta, TypedObject, now, stamp as meta_stamp, \
+    stamp_new
 from ..api.scheme import DEFAULT_SCHEME, Scheme, from_dict, to_dict
 from ..api.selectors import match_field_selector, parse_selector
 from ..storage.mvcc import ADDED, DELETED, MODIFIED, MVCCStore, Watch, WatchEvent
@@ -773,6 +774,97 @@ class Registry:
         if self.store.durable:
             return await asyncio.to_thread(fn, *args)
         return fn(*args)
+
+    # -- pods/eviction subresource ----------------------------------------
+
+    EVICTION_CAS_RETRIES = 20
+    #: disrupted_pods entries older than this are the PDB controller's
+    #: to prune; the eviction handler refuses only on a huge backlog.
+    MAX_DISRUPTED_PODS = 2000
+
+    def evict_pod(self, namespace: str, name: str,
+                  eviction: t.Eviction) -> t.Pod:
+        """The PDB-gated voluntary delete (reference:
+        ``pkg/registry/core/pod/storage/eviction.go:57-120`` Create +
+        checkAndDecrement). Finds the PDB covering the pod,
+        verify-and-decrements ``status.disruptions_allowed`` with CAS
+        retry, records the pod in ``disrupted_pods``, then deletes.
+        429 (TooManyRequests) when the budget allows no disruption —
+        the caller's signal to retry later, never to bypass.
+
+        ``eviction.override_budget`` (priority policy: preemption,
+        dead-node escalation) skips the allowed check but still records
+        the disruption so the controller's arithmetic stays honest."""
+        pod = self.get("pods", namespace, name)
+        pdbs, _rev = self.list("poddisruptionbudgets", namespace)
+        # selector None = match-all, the SAME rule the disruption
+        # controller applies — the gate and the arithmetic must agree.
+        covering = [p for p in pdbs
+                    if p.spec.selector is None
+                    or p.spec.selector.matches(pod.metadata.labels)]
+        if eviction.override_budget:
+            # The escape hatch must actually open: record the
+            # disruption in EVERY covering budget, no gate — a dead
+            # node's pod covered by two overlapping PDBs still has to
+            # go somewhere else.
+            for pdb in covering:
+                self._check_and_decrement(
+                    pdb.metadata.namespace, pdb.metadata.name,
+                    pod.metadata.name, override=True)
+        elif len(covering) > 1:
+            # Reference parity: ambiguous coverage is a hard error for
+            # VOLUNTARY evictions.
+            raise errors.ServiceUnavailableError(
+                f"pod {namespace}/{name} is covered by more than one "
+                f"PodDisruptionBudget ({sorted(p.metadata.name for p in covering)})")
+        elif covering:
+            self._check_and_decrement(covering[0].metadata.namespace,
+                                      covering[0].metadata.name,
+                                      pod.metadata.name, override=False)
+        return self.delete(
+            "pods", namespace, name,
+            grace_period_seconds=eviction.grace_period_seconds)
+
+    def _check_and_decrement(self, ns: str, pdb_name: str, pod_name: str,
+                             override: bool = False) -> None:
+        for _ in range(self.EVICTION_CAS_RETRIES):
+            try:
+                pdb = self.get("poddisruptionbudgets", ns, pdb_name)
+            except errors.NotFoundError:
+                return  # PDB vanished: nothing gates the eviction
+            st = pdb.status
+            # details.cause distinguishes a budget refusal from other
+            # 429s (e.g. apiserver max-in-flight) — the escalation
+            # clocks in nodelifecycle/drain key on it (reference:
+            # StatusCause Type "DisruptionBudget", eviction.go).
+            cause = {"cause": "DisruptionBudget", "budget": pdb_name}
+            if not override:
+                if st.observed_generation < pdb.metadata.generation:
+                    raise errors.TooManyRequestsError(
+                        f"cannot evict {pod_name}: the disruption "
+                        f"budget {pdb_name!r} is still being processed "
+                        f"by the server", details=cause)
+                if len(st.disrupted_pods) >= self.MAX_DISRUPTED_PODS:
+                    raise errors.ForbiddenError(
+                        f"too many evictions not yet confirmed by the "
+                        f"disruption controller for {pdb_name!r}",
+                        details=cause)
+                if st.disruptions_allowed <= 0:
+                    raise errors.TooManyRequestsError(
+                        f"cannot evict {pod_name}: it would violate "
+                        f"the disruption budget {pdb_name!r} "
+                        f"(needs {st.desired_healthy} healthy, has "
+                        f"{st.current_healthy})", details=cause)
+                st.disruptions_allowed -= 1
+            st.disrupted_pods = dict(st.disrupted_pods)
+            st.disrupted_pods[pod_name] = meta_stamp(now())
+            try:
+                self.update(pdb, subresource="status")
+                return
+            except errors.ConflictError:
+                continue
+        raise errors.ConflictError(
+            f"too much contention updating disruption budget {pdb_name!r}")
 
     # -- pods/binding subresource ----------------------------------------
 

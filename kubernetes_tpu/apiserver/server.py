@@ -975,6 +975,18 @@ class APIServer:
             pod = await self._mutate(
                 self.registry.bind_pod, ns, request.match_info["name"], binding)
             return self._obj_response(pod, status=201)
+        if plural == "pods" and sub == "eviction":
+            data = await self._body_obj(request)
+            from ..api.scheme import from_dict
+            from ..api.types import Eviction
+            eviction = from_dict(Eviction, data)
+            await self._mutate(self.registry.evict_pod, ns,
+                               request.match_info["name"], eviction)
+            # Reference returns a Status, not the pod.
+            return web.json_response(
+                {"kind": "Status", "status": "Success",
+                 "message": f"pod {ns}/{request.match_info['name']} evicted"},
+                status=201)
         raise errors.BadRequestError(f"unsupported subresource {plural}/{sub}")
 
     # -- lifecycle --------------------------------------------------------

@@ -578,25 +578,97 @@ async def cmd_uncordon(args) -> int:
 
 
 async def cmd_drain(args) -> int:
-    """Cordon + evict every pod on the node (kubectl drain analog;
-    workload controllers reschedule them elsewhere)."""
+    """Cordon + evict every pod on the node through the PDB-gated
+    Eviction subresource (kubectl drain analog). Filters match
+    kubectl: DaemonSet pods abort the drain unless --ignore-daemonsets
+    (then they are skipped — their controller would recreate them
+    here anyway); controller-less pods abort unless --force. A pod
+    whose PodDisruptionBudget allows no disruption makes the server
+    answer 429; drain retries until --timeout, then reports which
+    pods blocked — it NEVER deletes around the budget unless
+    --disable-eviction explicitly asks for raw deletes."""
+    import time as timelib
     client = make_client(args)
     try:
         await client.patch("nodes", "", args.node,
                            {"spec": {"unschedulable": True}})
         print(f"node/{args.node} cordoned")
         pods, _ = await client.list("pods")
-        victims = [p for p in pods if p.spec.node_name == args.node
+        on_node = [p for p in pods if p.spec.node_name == args.node
                    and t.is_pod_active(p)]
-        for pod in victims:
-            try:
-                await client.delete("pods", pod.metadata.namespace,
-                                    pod.metadata.name,
-                                    grace_period_seconds=args.grace_period)
-                print(f"pod/{pod.metadata.namespace}/{pod.metadata.name} evicted")
-            except errors.NotFoundError:
-                pass
-        print(f"node/{args.node} drained ({len(victims)} pods)")
+
+        def has_owner(pod, kind=""):
+            return any(not kind or ref.kind == kind
+                       for ref in pod.metadata.owner_references)
+
+        ds_pods = [p for p in on_node if has_owner(p, "DaemonSet")]
+        if ds_pods and not args.ignore_daemonsets:
+            names = ", ".join(f"{p.metadata.namespace}/{p.metadata.name}"
+                              for p in ds_pods)
+            print(f"ktl: cannot drain: DaemonSet-managed pods present "
+                  f"({names}); use --ignore-daemonsets", file=sys.stderr)
+            return 1
+        unmanaged = [p for p in on_node if not has_owner(p)]
+        if unmanaged and not args.force:
+            names = ", ".join(f"{p.metadata.namespace}/{p.metadata.name}"
+                              for p in unmanaged)
+            print(f"ktl: cannot drain: pods without a controller would "
+                  f"not be rescheduled ({names}); use --force",
+                  file=sys.stderr)
+            return 1
+        victims = [p for p in on_node if p not in ds_pods]
+
+        deadline = timelib.monotonic() + args.timeout
+        blocked: dict[str, str] = {}
+        evicted = 0
+        pending = list(victims)
+        while pending:
+            still = []
+            for pod in pending:
+                ref = f"{pod.metadata.namespace}/{pod.metadata.name}"
+                try:
+                    if args.disable_eviction:
+                        await client.delete(
+                            "pods", pod.metadata.namespace,
+                            pod.metadata.name,
+                            grace_period_seconds=args.grace_period)
+                    else:
+                        await client.evict(
+                            pod.metadata.namespace, pod.metadata.name,
+                            t.Eviction(
+                                grace_period_seconds=args.grace_period))
+                    print(f"pod/{ref} evicted")
+                    evicted += 1
+                    blocked.pop(ref, None)
+                except errors.NotFoundError:
+                    evicted += 1
+                    blocked.pop(ref, None)
+                except errors.TooManyRequestsError as e:
+                    blocked[ref] = str(e)
+                    still.append(pod)
+                except errors.StatusError as e:
+                    # Per-pod failure (e.g. ambiguous multi-PDB 503):
+                    # report and move on like kubectl — one bad pod
+                    # must not strand the rest of the drain.
+                    print(f"ktl: pod/{ref} eviction failed: {e}",
+                          file=sys.stderr)
+                    blocked[ref] = str(e)
+            pending = still
+            if pending:
+                if timelib.monotonic() >= deadline:
+                    for ref, why in blocked.items():
+                        print(f"ktl: pod/{ref} not evicted: {why}",
+                              file=sys.stderr)
+                    print(f"ktl: drain timed out with "
+                          f"{len(pending)} pods blocked by disruption "
+                          f"budgets", file=sys.stderr)
+                    return 1
+                await asyncio.sleep(1.0)
+        if blocked:  # permanent per-pod failures (already reported)
+            print(f"ktl: drain incomplete: {len(blocked)} pods failed "
+                  f"to evict", file=sys.stderr)
+            return 1
+        print(f"node/{args.node} drained ({evicted} pods)")
         return 0
     finally:
         await client.close()
@@ -1150,6 +1222,14 @@ def build_parser() -> argparse.ArgumentParser:
     sp = add("drain", cmd_drain, help="cordon + evict all pods")
     sp.add_argument("node")
     sp.add_argument("--grace-period", type=int, default=5)
+    sp.add_argument("--ignore-daemonsets", action="store_true",
+                    help="skip DaemonSet-managed pods instead of aborting")
+    sp.add_argument("--force", action="store_true",
+                    help="evict pods that no controller would recreate")
+    sp.add_argument("--timeout", type=float, default=60.0,
+                    help="seconds to keep retrying PDB-blocked evictions")
+    sp.add_argument("--disable-eviction", action="store_true",
+                    help="raw-delete instead of the PDB-gated Eviction API")
 
     sp = add("top", cmd_top, help="node/pod/chip stats")
     sp.add_argument("node", nargs="?", default="")

@@ -72,5 +72,10 @@ class Client:
     async def bind(self, namespace: str, name: str, binding: Binding) -> Any:
         raise NotImplementedError
 
+    async def evict(self, namespace: str, name: str, eviction: Any) -> Any:
+        """PDB-gated voluntary delete (pods/<name>/eviction). Raises
+        TooManyRequestsError while the budget allows no disruption."""
+        raise NotImplementedError
+
     async def close(self) -> None:
         pass

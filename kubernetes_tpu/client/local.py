@@ -69,3 +69,7 @@ class LocalClient(Client):
 
     async def bind(self, namespace: str, name: str, binding: Binding) -> Any:
         return await self._call(self.registry.bind_pod, namespace, name, binding)
+
+    async def evict(self, namespace: str, name: str, eviction: Any) -> Any:
+        return await self._call(self.registry.evict_pod, namespace, name,
+                                eviction)

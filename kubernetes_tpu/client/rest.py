@@ -353,6 +353,11 @@ class RESTClient(Client):
             data = await self._check(resp)
         return decode_obj(data)
 
+    async def evict(self, namespace: str, name: str, eviction: Any) -> Any:
+        url = self._url_for("core/v1", "pods", namespace, name, "eviction")
+        async with self._sess().post(url, json=to_dict(eviction)) as resp:
+            return await self._check(resp)
+
     async def close(self) -> None:
         if self._session and not self._session.closed:
             await self._session.close()

@@ -43,6 +43,11 @@ class DisruptionController(Controller):
                     pdb.spec.selector.matches(pod.metadata.labels):
                 self.enqueue_obj(pdb)
 
+    #: disrupted_pods entries older than this are dropped — an
+    #: approved eviction whose deleter crashed must not pin the budget
+    #: forever (reference: DeletionTimeout, disruption.go).
+    DISRUPTION_TIMEOUT_S = 120.0
+
     async def sync(self, key: str) -> Optional[float]:
         pdb = self.pdb_informer.get(key)
         if pdb is None:
@@ -53,7 +58,28 @@ class DisruptionController(Controller):
                      or pdb.spec.selector.matches(p.metadata.labels))
                 and is_pod_active(p)]
         expected = len(pods)
-        healthy = sum(1 for p in pods if is_pod_ready(p))
+        # Eviction-approved pods (disrupted_pods, stamped by the
+        # eviction subresource) count as already-gone even while the
+        # delete is in flight — otherwise N callers could each pass
+        # the allowed check against the same healthy count. Entries
+        # expire (crashed deleter) or clear when the pod is deleted
+        # or observed running-and-ready again past its stamp.
+        from ..api.meta import now as meta_now, parse_stamp
+        ts = meta_now()
+        active_names = {p.metadata.name for p in pods}
+        disrupted = {}
+        for pod_name, stamp in pdb.status.disrupted_pods.items():
+            if pod_name not in active_names:
+                continue  # deleted: entry served its purpose
+            try:
+                t0 = parse_stamp(stamp)
+            except ValueError:
+                continue
+            if (ts - t0).total_seconds() < self.DISRUPTION_TIMEOUT_S:
+                disrupted[pod_name] = stamp
+        healthy = sum(1 for p in pods
+                      if is_pod_ready(p)
+                      and p.metadata.name not in disrupted)
         if pdb.spec.min_available is not None:
             desired_healthy = pdb.spec.min_available
         elif pdb.spec.max_unavailable is not None:
@@ -63,13 +89,19 @@ class DisruptionController(Controller):
         allowed = max(healthy - desired_healthy, 0)
         new = w.PodDisruptionBudgetStatus(
             disruptions_allowed=allowed, current_healthy=healthy,
-            desired_healthy=desired_healthy, expected_pods=expected)
+            desired_healthy=desired_healthy, expected_pods=expected,
+            observed_generation=pdb.metadata.generation,
+            disrupted_pods=disrupted)
+        # With in-flight disruptions, ALWAYS come back (even when the
+        # status is unchanged this tick) — a crashed deleter's entry
+        # expires only if someone re-examines it.
+        requeue = (self.DISRUPTION_TIMEOUT_S / 2) if disrupted else None
         if new == pdb.status:
-            return None
+            return requeue
         fresh = deepcopy(pdb)
         fresh.status = new
         try:
             await self.client.update(fresh, subresource="status")
         except (errors.ConflictError, errors.NotFoundError):
             pass
-        return None
+        return requeue

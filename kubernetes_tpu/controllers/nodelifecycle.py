@@ -20,6 +20,7 @@ controller's call, not the node controller's).
 from __future__ import annotations
 
 import asyncio
+import time
 from typing import Optional
 
 from ..api import errors
@@ -60,6 +61,9 @@ class NodeLifecycleController(Controller):
         self._monitor_task: Optional[asyncio.Task] = None
         #: pod key -> scheduled eviction task (tolerationSeconds timers).
         self._evictions: dict[str, asyncio.Task] = {}
+        #: pod key -> monotonic time its eviction was first
+        #: PDB-blocked (escalation clock, see _evict).
+        self._pdb_blocked: dict[str, float] = {}
 
     # -- lifecycle --------------------------------------------------------
 
@@ -231,15 +235,46 @@ class NodeLifecycleController(Controller):
             self._schedule_eviction(pod_key, delay)
         return None
 
+    #: How long taint eviction respects a blocking PDB before
+    #: escalating: a NoExecute-tainted node is (or is about to be)
+    #: gone, so after this grace the disruption is involuntary — the
+    #: override still records accounting in the budget.
+    PDB_ESCALATE_S = 120.0
+
     async def _evict(self, pod: t.Pod) -> None:
-        self._cancel_eviction(pod.key())
+        # Keep the escalation clock: this is a RETRY of an eviction in
+        # progress, not a cancellation.
+        self._cancel_eviction(pod.key(), reset_clock=False)
         self.recorder.event(pod, "Warning", "TaintEviction",
                             f"evicting pod from {pod.spec.node_name}")
         try:
-            await self.client.delete("pods", pod.metadata.namespace,
-                                     pod.metadata.name)
+            await self.client.evict(
+                pod.metadata.namespace, pod.metadata.name,
+                t.Eviction(override_budget=self._escalated(pod)))
+            self._pdb_blocked.pop(pod.key(), None)
         except errors.NotFoundError:
-            pass
+            self._pdb_blocked.pop(pod.key(), None)
+        except errors.TooManyRequestsError as e:
+            # Only a BUDGET refusal (details.cause, stamped by the
+            # eviction subresource) advances the escalation clock — an
+            # apiserver max-in-flight 429 under overload must never
+            # convert into a budget override.
+            if e.details.get("cause") != "DisruptionBudget":
+                self._schedule_eviction(pod.key(), 10.0)
+                return
+            # Budget says no: note when we first asked and retry —
+            # voluntary for PDB_ESCALATE_S, involuntary after.
+            self._pdb_blocked.setdefault(pod.key(), time.monotonic())
+            self.recorder.event(
+                pod, "Warning", "TaintEvictionBlocked",
+                "eviction blocked by a PodDisruptionBudget; will "
+                f"escalate in {self.PDB_ESCALATE_S:.0f}s")
+            self._schedule_eviction(pod.key(), 10.0)
+
+    def _escalated(self, pod: t.Pod) -> bool:
+        first = self._pdb_blocked.get(pod.key())
+        return (first is not None
+                and time.monotonic() - first >= self.PDB_ESCALATE_S)
 
     def _schedule_eviction(self, pod_key: str, delay: float) -> None:
         if pod_key in self._evictions:
@@ -253,7 +288,12 @@ class NodeLifecycleController(Controller):
         self._evictions[pod_key] = asyncio.get_running_loop().create_task(
             later())
 
-    def _cancel_eviction(self, pod_key: str) -> None:
+    def _cancel_eviction(self, pod_key: str, reset_clock: bool = True) -> None:
         task = self._evictions.pop(pod_key, None)
         if task:
             task.cancel()
+        if reset_clock:
+            # The pod is no longer under taint eviction (taint cleared,
+            # pod gone/tolerating): a stale escalation stamp must not
+            # let a FUTURE same-named pod punch through its PDB.
+            self._pdb_blocked.pop(pod_key, None)

@@ -21,7 +21,7 @@ code execution as the agent's user. Under cluster TLS the server takes
 an ``ssl_context`` built with ``require_client_cert=True`` — the
 handshake itself rejects anyone without a valid cluster client cert —
 and authorizes the peer's cert identity (CN=user, O=groups) per route
-tier: read routes (healthz/stats/metrics/pods) for any authenticated
+tier: read routes (healthz/stats/metrics) for any authenticated
 cluster identity, privileged routes (logs/exec/attach/portforward/
 debug) only for ``system:masters`` or the node's own identity. This
 collapses the reference's SubjectAccessReview round trip into a local
@@ -42,8 +42,10 @@ from .stats import SummaryCollector
 
 log = logging.getLogger("nodeserver")
 
-#: Route prefixes any authenticated cluster identity may GET.
-_READ_PREFIXES = ("/healthz", "/stats", "/metrics", "/pods")
+#: Route prefixes any authenticated cluster identity may GET. /pods is
+#: NOT here: full pod specs (env vars, commands, volume defs) are
+#: privileged in the reference too (nodes/proxy, same tier as exec).
+_READ_PREFIXES = ("/healthz", "/stats", "/metrics")
 
 CHIP_HEALTHY = Gauge("node_tpu_chip_healthy",
                      "1 when the chip is Healthy",

@@ -441,8 +441,14 @@ class Scheduler:
             return []
         for v in best_victims:
             try:
-                await self.client.delete("pods", v.metadata.namespace,
-                                         v.metadata.name)
+                # Preemption is priority policy: it OVERRIDES the
+                # budget check but still accounts the disruption in
+                # the PDB (reference semantics: eviction API with the
+                # scheduler's authority; disruption.go arithmetic must
+                # see preempted pods as disrupted).
+                await self.client.evict(
+                    v.metadata.namespace, v.metadata.name,
+                    t.Eviction(override_budget=True))
                 m.PREEMPTION_VICTIMS.inc()
                 self.recorder.event(v, "Normal", "Preempted",
                                     f"by {pod.key()} (priority {t.pod_priority(pod)})")
@@ -514,8 +520,12 @@ class Scheduler:
                 group, "Warning", "GangRecoveryEvict",
                 f"evicting bound member {pod.key()}: {why}")
             try:
-                await self.client.delete("pods", pod.metadata.namespace,
-                                         pod.metadata.name)
+                # The gang is already broken (this IS the recovery), so
+                # its own PDB would always refuse — override, but keep
+                # the disruption accounted.
+                await self.client.evict(
+                    pod.metadata.namespace, pod.metadata.name,
+                    t.Eviction(override_budget=True))
             except errors.StatusError:
                 pass
 

@@ -1,0 +1,173 @@
+"""The PDB-gated Eviction subresource.
+
+Reference: ``pkg/registry/core/pod/storage/eviction.go:57-120``
+(Create + checkAndDecrement) — voluntary deletes go through
+``POST pods/<name>/eviction``, which verify-and-decrements
+``PodDisruptionBudget.status.disruptions_allowed`` with CAS retry and
+records in-flight disruptions in ``disrupted_pods``; 429 means "the
+budget says no, retry later", never "bypass".
+"""
+import asyncio
+
+import pytest
+
+from kubernetes_tpu.api import errors, types as t, workloads as w
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.api.selectors import LabelSelector
+from kubernetes_tpu.apiserver.registry import Registry
+from kubernetes_tpu.client.local import LocalClient
+
+
+def mk_pod(name, labels=None):
+    return t.Pod(metadata=ObjectMeta(name=name, namespace="default",
+                                     labels=labels or {"app": "x"}),
+                 spec=t.PodSpec(containers=[t.Container(name="c", image="i")]))
+
+
+def mk_pdb(name="budget", min_available=1, labels=None):
+    return w.PodDisruptionBudget(
+        metadata=ObjectMeta(name=name, namespace="default"),
+        spec=w.PodDisruptionBudgetSpec(
+            min_available=min_available,
+            selector=LabelSelector(match_labels=labels or {"app": "x"})))
+
+
+def set_status(reg, pdb_name, allowed, healthy=1, desired=1,
+               observed=None, disrupted=None):
+    pdb = reg.get("poddisruptionbudgets", "default", pdb_name)
+    pdb.status = w.PodDisruptionBudgetStatus(
+        disruptions_allowed=allowed, current_healthy=healthy,
+        desired_healthy=desired,
+        observed_generation=(pdb.metadata.generation
+                             if observed is None else observed),
+        disrupted_pods=disrupted or {})
+    return reg.update(pdb, subresource="status")
+
+
+def fresh_registry():
+    reg = Registry()
+    reg.create(t.Namespace(metadata=ObjectMeta(name="default")))
+    return reg
+
+
+def test_eviction_gates_on_budget():
+    reg = fresh_registry()
+    reg.create(mk_pod("p1"))
+    reg.create(mk_pdb())
+    set_status(reg, "budget", allowed=0)
+
+    with pytest.raises(errors.TooManyRequestsError):
+        reg.evict_pod("default", "p1", t.Eviction())
+    # Refused: the pod is still there and the budget untouched.
+    assert reg.get("pods", "default", "p1")
+    assert reg.get("poddisruptionbudgets", "default",
+                   "budget").status.disrupted_pods == {}
+
+    set_status(reg, "budget", allowed=1)
+    reg.evict_pod("default", "p1", t.Eviction())
+    with pytest.raises(errors.NotFoundError):
+        reg.get("pods", "default", "p1")
+    pdb = reg.get("poddisruptionbudgets", "default", "budget")
+    assert pdb.status.disruptions_allowed == 0
+    assert "p1" in pdb.status.disrupted_pods
+
+
+def test_eviction_without_pdb_is_plain_delete():
+    reg = fresh_registry()
+    reg.create(mk_pod("free", labels={"app": "other"}))
+    reg.create(mk_pdb())  # selector app=x does not cover it
+    reg.evict_pod("default", "free", t.Eviction())
+    with pytest.raises(errors.NotFoundError):
+        reg.get("pods", "default", "free")
+
+
+def test_eviction_refuses_stale_budget():
+    """observed_generation < generation: the controller has not yet
+    processed a spec change — refuse rather than act on stale numbers
+    (eviction.go checkAndDecrement, first clause)."""
+    reg = fresh_registry()
+    reg.create(mk_pod("p1"))
+    reg.create(mk_pdb())
+    set_status(reg, "budget", allowed=5, observed=0)
+    with pytest.raises(errors.TooManyRequestsError):
+        reg.evict_pod("default", "p1", t.Eviction())
+
+
+def test_eviction_multiple_pdbs_is_error():
+    reg = fresh_registry()
+    reg.create(mk_pod("p1"))
+    reg.create(mk_pdb("a"))
+    reg.create(mk_pdb("b"))
+    with pytest.raises(errors.ServiceUnavailableError):
+        reg.evict_pod("default", "p1", t.Eviction())
+
+
+def test_override_budget_bypasses_but_accounts():
+    """Preemption/dead-node policy: the allowed check is skipped but
+    the disruption still lands in disrupted_pods."""
+    reg = fresh_registry()
+    reg.create(mk_pod("p1"))
+    reg.create(mk_pdb())
+    set_status(reg, "budget", allowed=0)
+    reg.evict_pod("default", "p1", t.Eviction(override_budget=True))
+    with pytest.raises(errors.NotFoundError):
+        reg.get("pods", "default", "p1")
+    pdb = reg.get("poddisruptionbudgets", "default", "budget")
+    assert "p1" in pdb.status.disrupted_pods
+
+
+async def test_concurrent_evictions_cannot_over_disrupt():
+    """Budget of ONE disruption, many concurrent evictors: the CAS on
+    PDB status guarantees exactly one wins — the race the reference's
+    RetryOnConflict loop exists for."""
+    reg = fresh_registry()
+    for i in range(6):
+        reg.create(mk_pod(f"p{i}"))
+    reg.create(mk_pdb(min_available=5))
+    set_status(reg, "budget", allowed=1, healthy=6, desired=5)
+    client = LocalClient(reg)
+
+    async def try_evict(i):
+        try:
+            await client.evict("default", f"p{i}", t.Eviction())
+            return True
+        except errors.TooManyRequestsError:
+            return False
+        except errors.ConflictError:
+            return False
+
+    results = await asyncio.gather(*(try_evict(i) for i in range(6)))
+    assert sum(results) == 1, results
+    pods, _ = reg.list("pods", "default")
+    assert len(pods) == 5
+    pdb = reg.get("poddisruptionbudgets", "default", "budget")
+    assert pdb.status.disruptions_allowed == 0
+    assert len(pdb.status.disrupted_pods) == 1
+
+
+def test_override_with_multiple_pdbs_still_evicts():
+    """The escape hatch must open even under ambiguous coverage: a
+    dead node's pod covered by two overlapping budgets still has to
+    go — accounted in BOTH."""
+    reg = fresh_registry()
+    reg.create(mk_pod("p1"))
+    reg.create(mk_pdb("a"))
+    reg.create(mk_pdb("b"))
+    reg.evict_pod("default", "p1", t.Eviction(override_budget=True))
+    with pytest.raises(errors.NotFoundError):
+        reg.get("pods", "default", "p1")
+    for name in ("a", "b"):
+        pdb = reg.get("poddisruptionbudgets", "default", name)
+        assert "p1" in pdb.status.disrupted_pods, name
+
+
+def test_budget_429_carries_cause():
+    """Consumers (drain retry, taint-eviction escalation) distinguish
+    a budget refusal from other 429s by details.cause."""
+    reg = fresh_registry()
+    reg.create(mk_pod("p1"))
+    reg.create(mk_pdb())
+    set_status(reg, "budget", allowed=0)
+    with pytest.raises(errors.TooManyRequestsError) as ei:
+        reg.evict_pod("default", "p1", t.Eviction())
+    assert ei.value.details.get("cause") == "DisruptionBudget"
