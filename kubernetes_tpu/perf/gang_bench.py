@@ -60,8 +60,9 @@ def build_slice(reg: Registry, slice_idx: int) -> None:
         reg.create(node)
 
 
-def gang_objects(idx: int) -> tuple[t.PodGroup, list[t.Pod]]:
-    gname = f"gang-{idx:04d}"
+def gang_objects(idx: int, prefix: str = "gang",
+                 priority: int = 0) -> tuple[t.PodGroup, list[t.Pod]]:
+    gname = f"{prefix}-{idx:04d}"
     import math
     chips_total = math.prod(GANG_SHAPE)
     members = chips_total // CHIPS_PER_HOST
@@ -81,6 +82,8 @@ def gang_objects(idx: int) -> tuple[t.PodGroup, list[t.Pod]]:
         pod.spec.tpu_resources = [t.PodTpuRequest(name="tpu",
                                                   chips=CHIPS_PER_HOST)]
         pod.spec.gang = gname
+        if priority:
+            pod.spec.priority = priority
         pods.append(pod)
     return group, pods
 
@@ -171,12 +174,104 @@ async def run_gang_bench(n_slices: int = 8, n_gangs: Optional[int] = None,
             raise TimeoutError(
                 f"only {len(bound_keys)}/{want_bound} pods bound") from None
         wall = time.perf_counter() - start
+    except BaseException:
+        await sched.stop()
+        raise
     finally:
         stream.cancel()
         counter.cancel()
+    # --- phase 2: gang-over-gang preemption under a FULL fleet --------
+    # Top the fleet up to 100% with filler gangs, THEN pour in
+    # high-priority gangs: every box is occupied, so each arrival must
+    # carve a contiguous box out of the standing gangs (atomic victim
+    # selection, box reservation, re-plan) — the r4 scheduler path.
+    members = math.prod(GANG_SHAPE) // CHIPS_PER_HOST
+    total_boxes = fleet_chips // math.prod(GANG_SHAPE)
+    n_fill = total_boxes - n_gangs
+    if n_fill > 0:
+        fill_want = (n_gangs + n_fill) * members
+        fdone = asyncio.Event()
+        try:
+            fstream = await client.watch("pods", namespace="default")
+        except BaseException:
+            await sched.stop()
+            raise
+        fill_keys: set[str] = set(bound_keys)
+
+        async def count_fill():
+            while not fdone.is_set():
+                ev = await fstream.next()
+                if ev is None or ev[0] == "CLOSED":
+                    return
+                ev_type, pod = ev
+                if ev_type == "DELETED":
+                    fill_keys.discard(pod.key())
+                elif ev_type in ("ADDED", "MODIFIED") and pod.spec.node_name:
+                    fill_keys.add(pod.key())
+                    if len(fill_keys) >= fill_want:
+                        fdone.set()
+
+        fcounter = asyncio.create_task(count_fill())
+        try:
+            for i in range(n_fill):
+                group, fpods = gang_objects(i, prefix="fill")
+                await client.create(group)
+                for pod in fpods:
+                    await client.create(pod)
+            await asyncio.wait_for(fdone.wait(), timeout)
+        except BaseException:
+            await sched.stop()
+            raise
+        finally:
+            fstream.cancel()
+            fcounter.cancel()
+
+    n_preempt = max(1, n_gangs // 8)
+    want_preempt = n_preempt * members
+    preempt_bound: set[str] = set()
+    pdone = asyncio.Event()
+    try:
+        pstream = await client.watch("pods", namespace="default")
+    except BaseException:
+        await sched.stop()
+        raise
+
+    async def count_preempt():
+        while not pdone.is_set():
+            ev = await pstream.next()
+            if ev is None or ev[0] == "CLOSED":
+                return
+            ev_type, pod = ev
+            if not pod.metadata.name.startswith("pre-"):
+                continue
+            if ev_type == "DELETED":
+                preempt_bound.discard(pod.key())
+            elif ev_type in ("ADDED", "MODIFIED") and pod.spec.node_name:
+                preempt_bound.add(pod.key())
+                if len(preempt_bound) >= want_preempt:
+                    pdone.set()
+
+    pcounter = asyncio.create_task(count_preempt())
+    try:
+        pstart = time.perf_counter()
+        for i in range(n_preempt):
+            group, ppods = gang_objects(i, prefix="pre", priority=1000)
+            await client.create(group)
+            for pod in ppods:
+                await client.create(pod)
+        try:
+            await asyncio.wait_for(pdone.wait(), timeout)
+        except asyncio.TimeoutError:
+            raise TimeoutError(
+                f"preemption: only {len(preempt_bound)}/{want_preempt} "
+                f"bound") from None
+        pwall = time.perf_counter() - pstart
+    finally:
+        pstream.cancel()
+        pcounter.cancel()
         await sched.stop()
     pods, _ = reg.list("pods", "default")
-    bound = [p for p in pods if p.spec.node_name]
+    bound = [p for p in pods if p.spec.node_name and t.is_pod_active(p)]
 
     # Verify contiguity of EVERY gang (the guarantee is the product).
     chip_coords = {}
@@ -197,6 +292,7 @@ async def run_gang_bench(n_slices: int = 8, n_gangs: Optional[int] = None,
         1 for g, coords in by_gang.items()
         if len(slices_of[g]) != 1
         or not _is_contiguous_box(coords, SLICE_MESH))
+    high_bound = sum(1 for p in bound if p.metadata.name.startswith("pre-"))
 
     return {
         "slices": n_slices,
@@ -207,6 +303,18 @@ async def run_gang_bench(n_slices: int = 8, n_gangs: Optional[int] = None,
         "gangs_per_second": round(n_gangs / wall, 2),
         "pods_per_second": round(want_bound / wall, 2),
         "non_contiguous_gangs": non_contiguous,
+        "preemption": {
+            "high_prio_gangs": n_preempt,
+            "high_prio_pods_bound": high_bound,
+            # low-prio pods created minus those still standing = the
+            # pods the high-prio wave displaced.
+            "victims_evicted": (
+                want_bound + max(n_fill, 0) * members
+                - sum(1 for p in bound
+                      if not p.metadata.name.startswith("pre-"))),
+            "wall_seconds": round(pwall, 3),
+            "gangs_per_second": round(n_preempt / pwall, 2),
+        },
     }
 
 

@@ -10,15 +10,48 @@ TPU redesign: the ledger tracks chips *with their mesh coordinates*,
 and maintains a per-slice view (nodes grouped by ``slice_id``) so gang
 allocation can pack one contiguous box across hosts — the structure the
 reference never needed (its devices are flat).
+
+Nominated-capacity **reservations**: after preemption, the capacity the
+victims free is HELD for the preemptor (pod or gang) until it binds or
+the reservation expires — the reference keeps nominated pods visible to
+lower-priority scheduling (``generic_scheduler.go`` nominated-pod
+handling); without it, any pod scheduled in the next iterations steals
+the freed space and the preemptor livelocks through requeues.
 """
 from __future__ import annotations
 
+import time as _time
 from dataclasses import dataclass, field
 from typing import Optional
 
 from ..api import types as t
 
 Coord = tuple[int, ...]
+
+
+@dataclass
+class Reservation:
+    """Capacity held for a preemptor until it binds or expires.
+
+    Single-pod form: ``node_name`` + ``requests`` (+ the victims'
+    freed ``chip_ids``). Gang form: ``slice_id`` + ``cells`` (the
+    contiguous box carved by gang preemption) + ``node_requests``
+    (CPU/mem held per box host — chips alone would let a CPU-only
+    squatter take the host and starve the gang's predicates).
+    ``priority`` gates who must respect it: placements for pods of
+    priority <= the reservation's see it as consumed capacity
+    (nominated-pod semantics); only a STRICTLY higher-priority pod
+    may compete for the space."""
+
+    owner: str = ""                 # preemptor pod key or gang group key
+    priority: int = 0
+    expires: float = 0.0            # monotonic deadline
+    node_name: str = ""
+    requests: dict = field(default_factory=dict)
+    chip_ids: set = field(default_factory=set)
+    slice_id: str = ""
+    cells: dict = field(default_factory=dict)  # coord -> (node, chip_id)
+    node_requests: dict = field(default_factory=dict)  # node -> requests
 
 
 @dataclass
@@ -100,6 +133,35 @@ class NodeInfo:
         return {tuple(c.coords): cid for cid, c in self.free_chips.items() if c.coords}
 
 
+class ReservedNodeView:
+    """A NodeInfo as seen by a pod that must honor reservations:
+    reserved requests debited from headroom, reserved chips removed
+    from the free set. Predicates/select_chips read only these
+    attributes, so the view is cheap and copy-free."""
+
+    def __init__(self, info: "NodeInfo", extra_requests: dict,
+                 blocked_chips: set):
+        self._info = info
+        self.node = info.node
+        self.pods = info.pods
+        self.owner_counts = info.owner_counts
+        self.chip_owner = info.chip_owner
+        self.requested = dict(info.requested)
+        for res, amt in extra_requests.items():
+            self.requested[res] = self.requested.get(res, 0.0) + amt
+        self.free_chips = (
+            {cid: c for cid, c in info.free_chips.items()
+             if cid not in blocked_chips}
+            if blocked_chips else info.free_chips)
+
+    def allocatable(self) -> dict:
+        return self._info.allocatable()
+
+    def free_coords(self) -> dict:
+        return {tuple(c.coords): cid for cid, c in self.free_chips.items()
+                if c.coords}
+
+
 @dataclass
 class SliceInfo:
     """All nodes of one multi-host slice, merged into one geometry."""
@@ -137,6 +199,87 @@ class SchedulerCache:
         #: terms (the symmetry check in podaffinity.py scans only
         #: these; empty in affinity-free clusters -> zero cost).
         self.anti_affinity_pods: dict[str, t.Pod] = {}
+        #: owner (pod key / gang group key) -> Reservation.
+        self.reservations: dict[str, Reservation] = {}
+
+    # -- reservations ------------------------------------------------------
+
+    def reserve(self, res: Reservation, ttl: float = 120.0) -> None:
+        res.expires = _time.monotonic() + ttl
+        self.reservations[res.owner] = res
+        for name in ({res.node_name} | {n for n, _ in res.cells.values()}):
+            if name:
+                self.equiv.invalidate_node(name)
+
+    def release_reservation(self, owner: str) -> None:
+        res = self.reservations.pop(owner, None)
+        if res is not None:
+            for name in ({res.node_name} | {n for n, _ in res.cells.values()}):
+                if name:
+                    self.equiv.invalidate_node(name)
+
+    def _live_reservations(self):
+        now = _time.monotonic()
+        dead = [k for k, r in self.reservations.items() if r.expires <= now]
+        for k in dead:
+            self.release_reservation(k)
+        return self.reservations.values()
+
+    def node_reserved(self, node_name: str, exclude_owner: str = "",
+                      below_priority: Optional[int] = None
+                      ) -> tuple[dict, set]:
+        """(requests, chip_ids) held on ``node_name`` by live
+        reservations a pod of priority ``below_priority`` must honor
+        (reservation.priority >= pod priority). ``exclude_owner``: the
+        preemptor itself — its own hold is its to consume."""
+        req: dict = {}
+        chips: set = set()
+        for r in self._live_reservations():
+            if r.owner == exclude_owner:
+                continue
+            if below_priority is not None and r.priority < below_priority:
+                continue
+            if r.node_name == node_name:
+                for res_name, amt in r.requests.items():
+                    req[res_name] = req.get(res_name, 0.0) + amt
+                chips |= r.chip_ids
+            for res_name, amt in r.node_requests.get(node_name,
+                                                     {}).items():
+                req[res_name] = req.get(res_name, 0.0) + amt
+            for coord, (n, chip_id) in r.cells.items():
+                if n == node_name:
+                    chips.add(chip_id)
+        return req, chips
+
+    def reserved_cells(self, slice_id: str, exclude_owner: str = "",
+                       below_priority: Optional[int] = None) -> set:
+        """Box cells a gang plan must avoid on this slice."""
+        out: set = set()
+        for r in self._live_reservations():
+            if r.owner == exclude_owner or r.slice_id != slice_id:
+                continue
+            if below_priority is not None and r.priority < below_priority:
+                continue
+            out |= set(r.cells)
+        return out
+
+    def reserved_node_chips(self, exclude_owner: str = "",
+                            below_priority: Optional[int] = None
+                            ) -> dict[str, set]:
+        """node -> chip ids held by single-pod (nominated) reservations
+        — the per-chip complement of :meth:`reserved_cells` for gang
+        planning over slices."""
+        out: dict[str, set] = {}
+        for r in self._live_reservations():
+            if r.owner == exclude_owner or not r.chip_ids:
+                continue
+            if below_priority is not None and r.priority < below_priority:
+                continue
+            out.setdefault(r.node_name, set()).update(r.chip_ids)
+        return out
+
+    def has_reservations(self) -> bool:
+        return bool(self.reservations)
 
     def knows_pod(self, key: str) -> bool:
         """True when the cache already tracks this pod (assumed or added)."""
@@ -242,6 +385,7 @@ class SchedulerCache:
 
     def remove_pod(self, pod: t.Pod) -> None:
         key = pod.key()
+        self.release_reservation(key)  # deleted preemptor frees its hold
         node_name = self._pod_node.pop(key, None) or pod.spec.node_name
         self.assumed.pop(key, None)
         self.anti_affinity_pods.pop(key, None)
@@ -257,6 +401,8 @@ class SchedulerCache:
     def assume_pod(self, pod: t.Pod, node_name: str) -> None:
         """Debit resources optimistically before the bind RPC returns
         (reference: ``scheduler.go`` assume + ER manager AddPod)."""
+        # The preemptor landed: its nominated hold has served.
+        self.release_reservation(pod.key())
         pod.spec.node_name = node_name
         self._node_for(node_name).add_pod(pod)
         self.assumed[pod.key()] = node_name

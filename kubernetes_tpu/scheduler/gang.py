@@ -82,11 +82,26 @@ def plan_gang(group: t.PodGroup, pods: list[t.Pod],
 
     # Deterministic order: smallest adequate slice first (best fit).
     candidate_slices.sort(key=lambda s: (len(s.chips), s.slice_id))
+    gang_priority = max((t.pod_priority(p) for p in pods), default=0)
+    # Slice-independent: computed once, not per candidate slice.
+    blocked = cache.reserved_node_chips(exclude_owner=group.key(),
+                                        below_priority=gang_priority)
     for sl in candidate_slices:
         if must_include and not all(sl.chips.get(c) == nc
                                     for c, nc in must_include.items()):
             continue  # survivors' chips live elsewhere
         free = sl.free(cache)  # coords -> (node, chip_id)
+        # Cells held for ANOTHER preemptor (gang-preemption box or a
+        # nominated pod's chips) are off-limits to equal-or-lower
+        # priority plans; this group's own reservation is its to use.
+        held = cache.reserved_cells(sl.slice_id,
+                                    exclude_owner=group.key(),
+                                    below_priority=gang_priority)
+        if held:
+            free = {c: v for c, v in free.items() if c not in held}
+        if blocked:
+            free = {c: (n, cid) for c, (n, cid) in free.items()
+                    if cid not in blocked.get(n, ())}
         if len(free) < total_chips:
             reasons.append(f"slice {sl.slice_id}: {len(free)} free chips, "
                            f"gang needs {total_chips}")

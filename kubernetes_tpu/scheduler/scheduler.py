@@ -80,7 +80,9 @@ class Scheduler:
                            on_delete=lambda n: self.cache.remove_node(n.metadata.name))
         groups = SharedInformer(self.client, "podgroups")
         groups.add_handlers(on_add=self._group_changed_add,
-                            on_update=self._group_changed)
+                            on_update=self._group_changed,
+                            on_delete=lambda g:
+                            self.cache.release_reservation(g.key()))
         self._informers = [pods, nodes, groups]
         for inf in self._informers:
             inf.start()
@@ -284,20 +286,35 @@ class Scheduler:
         # pods, not node accounting.
         from .podaffinity import build_context
         affinity_ctx = build_context(pod, self.cache)
+        my_prio = t.pod_priority(pod)
+        my_key = pod.key()
+        any_reservations = self.cache.has_reservations()
         for idx in range(n):
             name = names[(start_at + idx) % n]
             info = self.cache.nodes.get(name)
             if info is None or info.node is None:
                 continue
+            reserved = False
+            if any_reservations:
+                res_req, res_chips = self.cache.node_reserved(
+                    name, exclude_owner=my_key, below_priority=my_prio)
+                if res_req or res_chips:
+                    # Nominated capacity held for a preemptor this pod
+                    # must not steal: evaluate against a debited view,
+                    # and bypass the equivalence cache (its verdicts
+                    # ignore priority).
+                    from .cache import ReservedNodeView
+                    info = ReservedNodeView(info, res_req, res_chips)
+                    reserved = True
             cached = (self.cache.equiv.lookup(name, eq)
-                      if eq is not None else None)
+                      if eq is not None and not reserved else None)
             if cached is not None:
                 fits, cached_reasons = cached
             else:
                 res = run_predicates(pod, info, skip_tpu=True,
                                      requests=requests)
                 fits, cached_reasons = res.fits, res.reasons
-                if eq is not None:
+                if eq is not None and not reserved:
                     self.cache.equiv.store(name, eq, fits, cached_reasons)
             if not fits:
                 reasons.append(f"{name}: {'; '.join(cached_reasons)}")
@@ -439,6 +456,20 @@ class Scheduler:
                 best_node, best_victims = name, victims
         if best_node is None or not best_victims:
             return []
+        # HOLD what the victims free for this preemptor (nominated
+        # capacity): without the reservation, any pod scheduled in the
+        # next iterations steals it and the preemptor livelocks
+        # through repeated requeues (reference: nominated pods stay
+        # visible to lower-priority scheduling).
+        from .cache import Reservation
+        victim_chips = {cid for v in best_victims
+                        if v.spec.node_name == best_node
+                        for cid in t.pod_tpu_assigned(v)}
+        self.cache.reserve(Reservation(
+            owner=pod.key(), priority=t.pod_priority(pod),
+            node_name=best_node,
+            requests=t.pod_resource_requests(pod),
+            chip_ids=victim_chips))
         for v in best_victims:
             try:
                 # Preemption is priority policy: it OVERRIDES the
@@ -510,6 +541,144 @@ class Scheduler:
                         return None
                     held[coord] = (pod.spec.node_name, chip_id)
         return held
+
+    # -- gang preemption (SURVEY hard-part 1: sub-mesh gang allocation
+    # WITH preemption; reference seed generic_scheduler.go:199, lifted
+    # to gang granularity) -------------------------------------------------
+
+    def _box_candidates(self, sl, shape):
+        """Every distinct axis-aligned box of ``shape`` (all
+        orientations, torus wraparound — submesh.box_coords, the SAME
+        geometry find_box searches) over the slice's healthy cells, as
+        {coord: (node, chip_id)} dicts. Rank-generic via
+        normalize_shape, deduped (a dim spanning the whole mesh yields
+        identical wrapped boxes from every origin)."""
+        from itertools import permutations, product
+        from .submesh import box_coords, normalize_shape
+        mesh = tuple(int(m) for m in sl.mesh_shape)
+        rank = len(mesh)
+        shape_n = normalize_shape(shape, rank)
+        if len(shape_n) != rank:
+            return
+        seen: set = set()
+        for dims in sorted(set(permutations(shape_n))):
+            if any(d > m for d, m in zip(dims, mesh)):
+                continue
+            for origin in product(*(range(m) for m in mesh)):
+                coords = box_coords(origin, dims, mesh, torus=True)
+                if coords is None:
+                    continue
+                key = frozenset(coords)
+                if key in seen:
+                    continue
+                seen.add(key)
+                cells = {}
+                for c in coords:
+                    v = sl.chips.get(c)
+                    if v is None:
+                        cells = None
+                        break
+                    cells[c] = v
+                if cells:
+                    yield cells
+
+    def _gang_members_of(self, ns: str, gang: str) -> list[t.Pod]:
+        members = self._pod_informer.store.by_index("gang", f"{ns}/{gang}")
+        return [p for p in members if t.is_pod_active(p)]
+
+    def _box_victims(self, sl, cells: dict,
+                     gang_prio: int) -> Optional[dict[str, t.Pod]]:
+        """Victim set that frees this box, at GANG granularity: evicting
+        one gang member triggers survivor recovery of the whole gang,
+        so the whole gang IS the victim — its full cost counts
+        (cheapest-victim accounting is wrong otherwise). None when any
+        occupant outranks the preemptor or holds a reservation."""
+        victims: dict[str, t.Pod] = {}
+        for coord, (node_name, chip_id) in cells.items():
+            info = self.cache.nodes.get(node_name)
+            if info is None:
+                return None
+            owner_key = info.chip_owner.get(chip_id)
+            if owner_key is None:
+                continue  # free cell
+            owner = info.pods.get(owner_key)
+            if owner is None or t.pod_priority(owner) >= gang_prio:
+                return None
+            if owner_key in victims:
+                continue
+            if owner.spec.gang:
+                for member in self._gang_members_of(
+                        owner.metadata.namespace, owner.spec.gang):
+                    if t.pod_priority(member) >= gang_prio:
+                        return None  # a member outranks us: untouchable
+                    victims[member.key()] = member
+            else:
+                victims[owner_key] = owner
+        return victims
+
+    async def _preempt_gang(self, group: t.PodGroup, pods: list[t.Pod],
+                            gang_prio: int) -> bool:
+        """Carve ONE contiguous box for a higher-priority gang by
+        evicting whole lower-priority gangs (+ loose pods), then
+        reserve the box for this group until it plans and binds."""
+        shape = group.spec.slice_shape
+        if not shape:
+            return False
+        best = None  # (cost, slice, cells, victims)
+        for sl in self.cache.slices.values():
+            held = self.cache.reserved_cells(
+                sl.slice_id, exclude_owner=group.key(),
+                below_priority=gang_prio)
+            for cells in self._box_candidates(sl, shape):
+                if held and any(c in held for c in cells):
+                    continue
+                victims = self._box_victims(sl, cells, gang_prio)
+                if victims is None or not victims:
+                    continue  # free boxes were the planner's job
+                cost = (max(t.pod_priority(v) for v in victims.values()),
+                        len(victims))
+                if best is None or cost < best[0]:
+                    best = (cost, sl, cells, victims)
+        if best is None:
+            return False
+        _cost, sl, cells, victims = best
+        from .cache import Reservation
+        # Hold CPU/mem on the box hosts too, pro-rated by their chip
+        # share — chips alone would let a CPU-only squatter bind there
+        # and fail the gang's resource predicates forever.
+        total_req: dict = {}
+        for p in pods:
+            for res, amt in t.pod_resource_requests(p).items():
+                total_req[res] = total_req.get(res, 0.0) + amt
+        chips_per_node: dict[str, int] = {}
+        for _c, (node_name, _cid) in cells.items():
+            chips_per_node[node_name] = chips_per_node.get(node_name, 0) + 1
+        node_requests = {
+            node_name: {res: amt * count / len(cells)
+                        for res, amt in total_req.items()
+                        if res != t.RESOURCE_TPU}
+            for node_name, count in chips_per_node.items()}
+        self.cache.reserve(Reservation(
+            owner=group.key(), priority=gang_prio,
+            slice_id=sl.slice_id, cells=dict(cells),
+            node_requests=node_requests))
+        evicted_gangs = {v.spec.gang for v in victims.values() if v.spec.gang}
+        self.recorder.event(
+            group, "Normal", "GangPreemption",
+            f"evicting {len(victims)} pods ({len(evicted_gangs)} gangs) "
+            f"to free a {'x'.join(map(str, shape))} box on {sl.slice_id}")
+        for v in victims.values():
+            try:
+                await self.client.evict(
+                    v.metadata.namespace, v.metadata.name,
+                    t.Eviction(override_budget=True))
+                m.PREEMPTION_VICTIMS.inc()
+                self.recorder.event(
+                    v, "Normal", "Preempted",
+                    f"by gang {group.key()} (priority {gang_prio})")
+            except errors.StatusError:
+                pass
+        return True
 
     async def _evict_gang_survivors(self, group, bound_pods: list[t.Pod],
                                     why: str) -> None:
@@ -601,12 +770,35 @@ class Scheduler:
                 # Recovery could not keep the gang contiguous around the
                 # survivors: evict them so the full shape re-plans.
                 await self._evict_gang_survivors(group, bound_pods, brief)
+            else:
+                # Atomic gang-over-gang preemption: a high-priority
+                # gang arriving into a full fleet carves a contiguous
+                # box out of lower-priority gangs and holds it
+                # (reservation) until its own plan lands.
+                from ..util.features import GATES
+                gang_prio = max((t.pod_priority(p) for p in pods),
+                                default=0)
+                if (gang_prio > 0 and GATES.enabled("PodPriority")
+                        and group.key() not in self.cache.reservations
+                        and await self._preempt_gang(group, pods,
+                                                     gang_prio)):
+                    # Victims are terminating; retry soon, not at full
+                    # backoff.
+                    await self.queue.requeue(GangUnit(unit.group_key, pods),
+                                             0.1)
+                    m.PODS_SCHEDULED.inc(result="gang_preempting",
+                                         amount=len(pods))
+                    return
             # Members stay staged in the queue; the requeue re-releases the
             # gang with current membership after backoff.
             await self.queue.requeue(GangUnit(unit.group_key, pods),
                                      self.backoff_seconds)
             m.PODS_SCHEDULED.inc(result="gang_unschedulable", amount=len(pods))
             return
+
+        # The plan landed: any preemption box held for this gang has
+        # served its purpose (assume debits the real chips now).
+        self.cache.release_reservation(unit.group_key)
 
         # assume all
         assumed_pods = []

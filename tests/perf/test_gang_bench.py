@@ -8,9 +8,17 @@ from kubernetes_tpu.perf.gang_bench import (_is_contiguous_box,
 
 async def test_gang_bench_small_fleet():
     result = await run_gang_bench(n_slices=2, n_gangs=8, timeout=60)
-    assert result["pods"] == 16
+    # 2 slices x 64 chips = 16 boxes: 8 initial gangs + 8 fillers
+    # (phase 2 tops the fleet to 100%), minus the boxes the high-prio
+    # wave reclaimed, plus the high-prio pods themselves -> still one
+    # pod per box at the end.
+    assert result["pods"] == 32
     assert result["non_contiguous_gangs"] == 0
     assert result["gangs_per_second"] > 1.0
+    pre = result["preemption"]
+    assert pre["high_prio_pods_bound"] == pre["high_prio_gangs"] * 2
+    assert pre["victims_evicted"] > 0
+    assert pre["gangs_per_second"] > 0.5
 
 
 def test_contiguity_checker():
