@@ -49,8 +49,19 @@ class LMConfig:
     #: elementwise ops (jax.checkpoint_policies.dots_with_no_batch_dims
     #: _saveable) — attention scores have batch dims so the O(T^2)
     #: buffers are still recomputed, but the expensive MXU work is not,
-    #: buying back most of remat's ~33% FLOP overhead.
+    #: buying back most of remat's ~33% FLOP overhead. (Pinning the
+    #: attention output as a saved residual was tried in r4 and
+    #: MEASURED SLOWER at every length with 1024-token flash blocks —
+    #: the extra [B,H,T,D] residual write costs more than re-running
+    #: the fused kernel.)
     remat_policy: str = "dots"
+    #: Cross-entropy in row-chunks of this many tokens so the
+    #: [B*T, vocab] float32 logits tensor is never materialized (~1 GiB
+    #: at 8k tokens/V=32k). A MEMORY knob, not a speed one: measured
+    #: ~1 MFU point SLOWER on v5e (XLA already streams the fused
+    #: unembed+logsumexp well), so it stays off by default and exists
+    #: for configs that need the headroom (bigger batch/longer T).
+    loss_chunk: int = 0
     #: Attention kernel: "ring" (sequence-parallel ring over the sp
     #: axis; degenerates to blockwise on one device) or "flash" (the
     #: pallas TPU flash-attention kernel — fastest single-device path;
@@ -64,6 +75,10 @@ class LMConfig:
         if self.attn_impl not in ("ring", "flash"):
             raise ValueError(f"attn_impl must be 'ring' or 'flash', "
                              f"got {self.attn_impl!r}")
+        if self.loss_chunk < 0:
+            raise ValueError(
+                f"loss_chunk must be >= 0 (0 disables chunking), "
+                f"got {self.loss_chunk}")
 
     @property
     def head_dim(self) -> int:
@@ -148,23 +163,28 @@ def _flash_attention(q, k, v):
     kernel errors surface loudly — silently degrading to the O(T^2)
     path would misreport which kernel a benchmark ran.
 
-    Block sizes are pinned to 512 (clamped to T): the kernel's
-    defaults left >2x on the table on v5e — measured 114.8ms -> 52.8ms
-    per 4-layer fwd+bwd at B4/H16/T2048/D128, vs 69.8ms for the naive
-    O(T^2) path — because small k-blocks under-fill the MXU pipeline
-    on the bwd dq/dkv passes."""
+    Block sizes are pinned to 1024 (clamped to T): the kernel's
+    defaults left >2x on the table on v5e (small k-blocks under-fill
+    the MXU pipeline on the bwd dq/dkv passes), and the r4 sweep moved
+    the sweet spot from 512 to 1024 — measured at B1/H16/T8192/D128:
+    512-blocks 0.490 MFU, 1024-blocks 0.506; 2048 fails to compile
+    (VMEM)."""
     if jax.devices()[0].platform != "tpu":
         from .ring_attention import reference_attention
         return reference_attention(q, k, v).astype(q.dtype)
     from jax.experimental.pallas.ops.tpu.flash_attention import (
         BlockSizes, flash_attention as _pallas_flash)
     t = q.shape[2]
-    # Largest power-of-two divisor of T up to 512 (the kernel requires
-    # block | T; 512 is the measured sweet spot, and e.g. T=640 still
-    # gets 128 like the kernel's own defaults).
-    b = min(512, t)
-    while t % b:
+    # Largest divisor of T up to 1024 that is a multiple of 128 (the
+    # kernel wants lane-aligned blocks; 1024 is the measured sweet
+    # spot — see docstring). Halve until it divides T; fall back to
+    # T itself only when T < 128 (tiny test shapes).
+    b = min(1024, t)
+    while t % b or (b % 128 and b < t):
         b //= 2
+        if b == 0:
+            b = t
+            break
     bs = BlockSizes(
         block_q=b, block_k_major=b, block_k=b, block_b=1,
         block_q_major_dkv=b, block_k_major_dkv=b, block_k_dkv=b,
@@ -174,8 +194,10 @@ def _flash_attention(q, k, v):
                          block_sizes=bs)
 
 
-def forward(params: dict, tokens, cfg: LMConfig, mesh) -> jax.Array:
-    """tokens [B, T] int32 -> logits [B, T, vocab] float32."""
+def hidden_states(params: dict, tokens, cfg: LMConfig, mesh) -> jax.Array:
+    """tokens [B, T] int32 -> final hidden states [B, T, d_model]
+    (post-ln_f, pre-unembed). The chunked loss unembeds per T-chunk;
+    :func:`forward` unembeds wholesale for logits consumers."""
     cdt = cfg.compute_dtype
     act = NamedSharding(mesh, ACT_SPEC)
     b, t = tokens.shape
@@ -217,13 +239,57 @@ def forward(params: dict, tokens, cfg: LMConfig, mesh) -> jax.Array:
     else:
         body = layer
     x, _ = lax.scan(body, x, params["layers"])
-    x = _rms_norm(x, params["ln_f"].astype(cdt))
+    return _rms_norm(x, params["ln_f"].astype(cdt))
+
+
+def forward(params: dict, tokens, cfg: LMConfig, mesh) -> jax.Array:
+    """tokens [B, T] int32 -> logits [B, T, vocab] float32."""
+    cdt = cfg.compute_dtype
+    x = hidden_states(params, tokens, cfg, mesh)
     return (x @ params["embed"].astype(cdt).T).astype(jnp.float32)
+
+
+def _chunked_xent(x, targets, embed, chunk: int) -> jax.Array:
+    """Mean next-token cross-entropy WITHOUT materializing [B,T,V]
+    float32 logits: flatten (B,T) into one token axis, scan over
+    row-chunks, unembed each chunk, reduce to (logsumexp - gold) in
+    float32, discard the chunk logits. The scan body is
+    rematerialized, so backward recomputes one chunk's logits at a
+    time — peak live logits go from O(B*T*V) to O(chunk*V), ~1 GiB ->
+    ~256 MiB at 8k tokens/V=32k/chunk=2k."""
+    b, t, e = x.shape
+    flat_x = x.reshape(b * t, e)
+    flat_t = targets.reshape(b * t)
+    n = (b * t) // chunk
+    m = n * chunk
+
+    def body(_, args):
+        xc, tc = args  # [chunk, E], [chunk]
+        logits = (xc @ embed.T).astype(jnp.float32)  # [chunk, V]
+        logz = jax.scipy.special.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, tc[:, None], axis=-1)[:, 0]
+        return None, jnp.sum(logz - gold)
+
+    xs = (flat_x[:m].reshape(n, chunk, e), flat_t[:m].reshape(n, chunk))
+    _, sums = lax.scan(jax.checkpoint(body), None, xs)
+    total = jnp.sum(sums)
+    if m < b * t:  # ragged tail (B*T not divisible by chunk)
+        logits = (flat_x[m:] @ embed.T).astype(jnp.float32)
+        logz = jax.scipy.special.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, flat_t[m:, None], axis=-1)[:, 0]
+        total = total + jnp.sum(logz - gold)
+    return total / (b * t)
 
 
 def loss_fn(params: dict, batch, cfg: LMConfig, mesh) -> jax.Array:
     """batch [B, T+1] int32 -> mean next-token cross-entropy."""
     inputs, targets = batch[:, :-1], batch[:, 1:]
+    b, t = inputs.shape
+    if cfg.loss_chunk and b * t > cfg.loss_chunk:
+        x = hidden_states(params, inputs, cfg, mesh)
+        return _chunked_xent(x, targets,
+                             params["embed"].astype(cfg.compute_dtype),
+                             cfg.loss_chunk)
     logits = forward(params, inputs, cfg, mesh)
     logz = jax.scipy.special.logsumexp(logits, axis=-1)
     gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
@@ -234,20 +300,45 @@ def make_optimizer(lr: float = 3e-3):
     return optax.adamw(lr, b1=0.9, b2=0.95, weight_decay=0.01)
 
 
+def _is_mixed(cfg: LMConfig) -> bool:
+    """Mixed-precision storage: working params in a low-precision dtype
+    (bfloat16), float32 MASTER copy living in the optimizer state —
+    the standard TPU recipe. fwd/bwd read half the weight bytes
+    (measured +4 MFU points at 8k tokens on v5e); AdamW math runs
+    entirely in float32 against the master, so convergence matches the
+    float32 configuration."""
+    return cfg.param_dtype != jnp.float32
+
+
 def init_sharded(rng, cfg: LMConfig, mesh, lr: float = 3e-3):
     """Params + optimizer state, laid out on the mesh. The opt state
-    inherits each param's sharding (built by tree ops on sharded leaves)."""
+    inherits each param's sharding (built by tree ops on sharded
+    leaves). Mixed precision (see :func:`_is_mixed`): opt_state is
+    (adamw_state_over_master, master_fp32)."""
     params = shard(mesh, init_params(rng, cfg), param_specs(cfg))
-    opt_state = make_optimizer(lr).init(params)
-    return params, opt_state
+    if _is_mixed(cfg):
+        master = jax.tree_util.tree_map(
+            lambda p: p.astype(jnp.float32), params)
+        return params, (make_optimizer(lr).init(master), master)
+    return params, make_optimizer(lr).init(params)
 
 
 def make_train_step(cfg: LMConfig, mesh, lr: float = 3e-3):
-    """Jitted full training step: fwd + bwd + AdamW update."""
+    """Jitted full training step: fwd + bwd + AdamW update (against
+    the fp32 master when params are stored low-precision)."""
     opt = make_optimizer(lr)
 
     def step(params, opt_state, batch):
         loss, grads = jax.value_and_grad(loss_fn)(params, batch, cfg, mesh)
+        if _is_mixed(cfg):
+            inner, master = opt_state
+            g32 = jax.tree_util.tree_map(
+                lambda g: g.astype(jnp.float32), grads)
+            updates, inner = opt.update(g32, inner, master)
+            master = optax.apply_updates(master, updates)
+            params = jax.tree_util.tree_map(
+                lambda mstr, p: mstr.astype(p.dtype), master, params)
+            return params, (inner, master), loss
         updates, opt_state = opt.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state, loss
 

@@ -84,3 +84,51 @@ def test_vector_add_smoke():
 @pytest.mark.slow
 def test_mnist_learns():
     assert mnist.train(steps=40) > 0.85
+
+
+def test_mixed_precision_master_matches_fp32():
+    """bf16 working params + fp32 master (lm._is_mixed): the AdamW math
+    runs against the master, so short-horizon losses match the fp32
+    configuration to bf16 resolution."""
+    import jax
+    import jax.numpy as jnp
+    from kubernetes_tpu.workloads import lm
+    from kubernetes_tpu.workloads.sharding import make_mesh
+
+    mesh = make_mesh(jax.devices()[:1])
+    finals = {}
+    for tag, dt in (("fp32", jnp.float32), ("mixed", jnp.bfloat16)):
+        cfg = lm.LMConfig(vocab=128, d_model=64, n_layers=2, n_heads=4,
+                          d_ff=128, param_dtype=dt)
+        params, opt_state = lm.init_sharded(jax.random.PRNGKey(0), cfg, mesh)
+        if dt == jnp.bfloat16:
+            # Mixed layout: (adamw_state, fp32 master) beside bf16 params.
+            assert jax.tree_util.tree_leaves(params)[0].dtype == jnp.bfloat16
+            assert jax.tree_util.tree_leaves(
+                opt_state[1])[0].dtype == jnp.float32
+        step = lm.make_train_step(cfg, mesh)
+        loss = None
+        for i in range(10):
+            data = lm.synthetic_batch(jax.random.PRNGKey(i), cfg, mesh, 4, 32)
+            params, opt_state, loss = step(params, opt_state, data)
+        finals[tag] = float(loss)
+    assert abs(finals["fp32"] - finals["mixed"]) < 0.05, finals
+
+
+def test_chunked_xent_matches_unchunked():
+    import jax
+    from kubernetes_tpu.workloads import lm
+    from kubernetes_tpu.workloads.sharding import make_mesh
+
+    mesh = make_mesh(jax.devices()[:1])
+    base = dict(vocab=128, d_model=64, n_layers=2, n_heads=4, d_ff=128)
+    batch = lm.synthetic_batch(jax.random.PRNGKey(3),
+                               lm.LMConfig(**base), mesh, 4, 96)
+    params = lm.init_params(jax.random.PRNGKey(0), lm.LMConfig(**base))
+    ref = float(lm.loss_fn(params, batch, lm.LMConfig(**base, loss_chunk=0),
+                           mesh))
+    # 4*96=384 tokens; chunk 100 leaves a ragged tail of 84.
+    for chunk in (64, 100, 384):
+        got = float(lm.loss_fn(params, batch,
+                               lm.LMConfig(**base, loss_chunk=chunk), mesh))
+        assert abs(got - ref) < 1e-4, (chunk, got, ref)
