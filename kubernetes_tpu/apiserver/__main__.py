@@ -19,12 +19,14 @@ import sys
 
 from ..api import errors
 from ..api import types as t
+from ..util.gctune import tune_control_plane_gc
 from ..api.meta import ObjectMeta
 from .registry import Registry
 from .server import APIServer
 
 
 async def amain(argv=None) -> int:
+    tune_control_plane_gc()
     p = argparse.ArgumentParser(prog="kubernetes-tpu-apiserver")
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=0,

@@ -69,7 +69,10 @@ class Client:
                     label_selector: str = "", field_selector: str = "") -> WatchStream:
         raise NotImplementedError
 
-    async def bind(self, namespace: str, name: str, binding: Binding) -> Any:
+    async def bind(self, namespace: str, name: str, binding: Binding,
+                   decode: bool = True) -> Any:
+        """``decode=False``: high-rate callers (the scheduler) may skip
+        typing the response; implementations may ignore the hint."""
         raise NotImplementedError
 
     async def evict(self, namespace: str, name: str, eviction: Any) -> Any:

@@ -67,7 +67,9 @@ class LocalClient(Client):
                                  label_selector, field_selector)
         return _LocalWatch(ow)
 
-    async def bind(self, namespace: str, name: str, binding: Binding) -> Any:
+    async def bind(self, namespace: str, name: str, binding: Binding,
+                   decode: bool = True) -> Any:
+        del decode  # in-proc: the typed object is free
         return await self._call(self.registry.bind_pod, namespace, name, binding)
 
     async def evict(self, namespace: str, name: str, eviction: Any) -> Any:

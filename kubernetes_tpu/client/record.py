@@ -22,7 +22,8 @@ log = logging.getLogger("events")
 
 
 class EventRecorder:
-    def __init__(self, client: Client, component: str, host: str = ""):
+    def __init__(self, client: Client, component: str, host: str = "",
+                 qps: float = 50.0, burst: int = 100):
         self.client = client
         self.source = EventSource(component=component, host=host)
         # Client-side correlation (reference: EventCorrelator LRU):
@@ -32,6 +33,40 @@ class EventRecorder:
         # straight to update without a probing GET.
         self._seen: dict[str, None] = {}
         self._seen_limit = 4096
+        # Normal-event rate limit (reference: kubelet --event-qps /
+        # --event-burst + client-go's sink rate limiter). At 30k-pod
+        # density the per-pod Scheduled events alone were a third of
+        # all apiserver requests — telemetry must not compete with the
+        # control path. Warnings always pass (they carry diagnosis).
+        self._qps = qps
+        self._burst = float(burst)
+        self._tokens = float(burst)
+        self._last_refill = 0.0
+        self.dropped = 0
+
+    def _allow(self, event_type: str) -> bool:
+        if event_type != "Normal" or self._qps <= 0:
+            return True
+        import time
+        now_m = time.monotonic()
+        if self._last_refill:
+            self._tokens = min(
+                self._burst,
+                self._tokens + (now_m - self._last_refill) * self._qps)
+        self._last_refill = now_m
+        if self._tokens >= 1.0:
+            self._tokens -= 1.0
+            return True
+        self.dropped += 1
+        # Dropping silently would make "where did my events go" a
+        # mystery: log the first drop and every 1000th after (the
+        # reference's client-go logs each dropped event — at the rates
+        # this limiter exists for, that would itself be spam).
+        if self.dropped == 1 or self.dropped % 1000 == 0:
+            log.info("event rate limit: dropped %d Normal events from "
+                     "%s (qps=%g burst=%g)", self.dropped,
+                     self.source.component, self._qps, self._burst)
+        return False
 
     def _ref(self, obj: Any) -> ObjectReference:
         try:
@@ -44,6 +79,8 @@ class EventRecorder:
 
     def event(self, obj: Any, event_type: str, reason: str, message: str) -> None:
         """Fire-and-forget (never let event failures break controllers)."""
+        if not self._allow(event_type):
+            return
         try:
             loop = asyncio.get_running_loop()
         except RuntimeError:

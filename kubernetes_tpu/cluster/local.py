@@ -141,6 +141,8 @@ class LocalCluster:
     # -- composition -------------------------------------------------------
 
     async def start(self) -> str:
+        from ..util.gctune import tune_control_plane_gc
+        tune_control_plane_gc()
         store = MVCCStore(os.path.join(self.data_dir, "state")
                           if self.durable else None)
         self.registry = Registry(store=store)

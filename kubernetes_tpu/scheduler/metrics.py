@@ -8,8 +8,8 @@ histograms are what bench.py and the e2e suite read.
 from ..metrics.registry import Counter, Gauge, Histogram
 
 _LAT_BUCKETS = (0.00005, 0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005,
-                0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
-                30.0, 60.0, 120.0, 300.0, 600.0, 1200.0)
+                0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 0.75, 1.0, 1.5, 2.5,
+                5.0, 10.0, 30.0, 60.0, 120.0, 300.0, 600.0, 1200.0)
 
 E2E_SCHEDULING_LATENCY = Histogram(
     "scheduler_e2e_scheduling_latency_seconds",

@@ -65,6 +65,11 @@ class Scheduler:
     # -- wiring (reference: factory.go:137 NewConfigFactory) --------------
 
     async def start(self) -> None:
+        # Control-plane GC policy (util/gctune.py): automatic gen2
+        # passes over millions of live API objects were the bind-p99
+        # tail at density scale.
+        from ..util.gctune import tune_control_plane_gc
+        tune_control_plane_gc()
         pods = SharedInformer(self.client, "pods")
         pods.add_handlers(on_add=self._pod_added, on_update=self._pod_updated,
                           on_delete=self._pod_deleted)
@@ -219,7 +224,8 @@ class Scheduler:
                     await self.client.bind(
                         pod.metadata.namespace, pod.metadata.name,
                         t.Binding(target=t.BindingTarget(
-                            node_name=node_name, tpu_bindings=bindings)))
+                            node_name=node_name, tpu_bindings=bindings)),
+                        decode=False)
             except Exception as e:  # noqa: BLE001
                 self.cache.forget_pod(assumed)
                 if isinstance(e, errors.NotFoundError):
@@ -815,7 +821,8 @@ class Scheduler:
         async def bind_one(pod, node_name, bindings):
             await self.client.bind(pod.metadata.namespace, pod.metadata.name,
                                    t.Binding(target=t.BindingTarget(
-                                       node_name=node_name, tpu_bindings=bindings)))
+                                       node_name=node_name, tpu_bindings=bindings)),
+                                   decode=False)
 
         bind_start = time.perf_counter()
         results = await asyncio.gather(

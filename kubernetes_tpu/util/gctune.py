@@ -1,0 +1,37 @@
+"""CPython GC policy for control-plane processes.
+
+The reference's control plane is Go, whose GC is concurrent; CPython's
+generational collector is stop-the-world, and its gen2 pass SCANS every
+tracked object. At 30k-pod density that is millions of live dataclass
+nodes: measured in the density harness, 35 automatic gen2 collections
+cost 20.9s of pauses (max 1314ms) in a 120s run — the entire
+bind-latency p99 tail and ~18% of wall clock.
+
+The framework's API objects are TREES (no parent backrefs), so they die
+by reference counting; gen2 finds almost nothing to free (RSS measured
+flat at ~308MB across a 30k run with gen2 effectively off). True cycles
+(exception tracebacks, closures) accumulate slowly, so gen2 is not
+disabled — its threshold is raised so it runs orders of magnitude less
+often, bounding leak growth without putting 1.3s pauses on the hot
+path.
+
+Called by long-running control-plane entrypoints (scheduler start,
+apiserver main, cluster composer). Idempotent and process-global by
+nature (CPython has one collector).
+"""
+from __future__ import annotations
+
+import gc
+
+#: gen0/gen1 are left exactly as the embedder configured them (cheap,
+#: young garbage is real); ONLY gen2 is raised — it fires after 10_000
+#: gen1 passes instead of 10, rare enough to stay off
+#: latency-sensitive windows, finite so cycle leaks stay bounded in
+#: week-long processes.
+_GEN2_THRESHOLD = 10_000
+
+
+def tune_control_plane_gc() -> None:
+    gen0, gen1, gen2 = gc.get_threshold()
+    if gen2 < _GEN2_THRESHOLD:
+        gc.set_threshold(gen0, gen1, _GEN2_THRESHOLD)

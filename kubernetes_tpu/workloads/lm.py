@@ -175,16 +175,12 @@ def _flash_attention(q, k, v):
     from jax.experimental.pallas.ops.tpu.flash_attention import (
         BlockSizes, flash_attention as _pallas_flash)
     t = q.shape[2]
-    # Largest divisor of T up to 1024 that is a multiple of 128 (the
-    # kernel wants lane-aligned blocks; 1024 is the measured sweet
-    # spot — see docstring). Halve until it divides T; fall back to
-    # T itself only when T < 128 (tiny test shapes).
-    b = min(1024, t)
-    while t % b or (b % 128 and b < t):
-        b //= 2
-        if b == 0:
-            b = t
-            break
+    # Largest divisor of T up to 1024, preferring lane-aligned
+    # (multiple-of-128) blocks; 1024 is the measured sweet spot — see
+    # docstring. Trace-time-only scan, so O(min(T,1024)) is free.
+    divisors = [d for d in range(1, min(1024, t) + 1) if t % d == 0]
+    aligned = [d for d in divisors if d % 128 == 0]
+    b = max(aligned) if aligned else max(divisors)
     bs = BlockSizes(
         block_q=b, block_k_major=b, block_k=b, block_b=1,
         block_q_major_dkv=b, block_k_major_dkv=b, block_k_dkv=b,

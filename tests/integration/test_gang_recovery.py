@@ -22,11 +22,11 @@ async def test_partial_gang_bind_failure_recovers():
         real_bind = client.bind
         fails = {"w1": 1}
 
-        async def flaky_bind(namespace, name, binding):
+        async def flaky_bind(namespace, name, binding, decode=True):
             if fails.get(name, 0) > 0:
                 fails[name] -= 1
                 raise ConnectionResetError("synthetic bind failure")
-            return await real_bind(namespace, name, binding)
+            return await real_bind(namespace, name, binding, decode=decode)
 
         sched.client.bind = flaky_bind
 
@@ -130,11 +130,11 @@ async def test_shaped_gang_recovery_keeps_contiguity():
         real_bind = client.bind
         fails = {"w1": 1}
 
-        async def flaky_bind(namespace, name, binding):
+        async def flaky_bind(namespace, name, binding, decode=True):
             if fails.get(name, 0) > 0:
                 fails[name] -= 1
                 raise ConnectionResetError("synthetic bind failure")
-            return await real_bind(namespace, name, binding)
+            return await real_bind(namespace, name, binding, decode=decode)
 
         sched.client.bind = flaky_bind
         reg.create(t.PodGroup(

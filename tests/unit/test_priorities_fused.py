@@ -1,0 +1,84 @@
+"""The fused prioritize() pass vs the documented priority functions.
+
+prioritize() promises to produce EXACTLY the sum the individual
+priority functions give (they are the unit-testable definitions; the
+fused pass is the density-scale hot path). This property test pins the
+two together so neither can silently diverge.
+"""
+import random
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.api.selectors import Requirement
+from kubernetes_tpu.scheduler import priorities as P
+from kubernetes_tpu.scheduler.cache import SchedulerCache
+
+
+def _ref_scores(pod, infos, sibling_counts, chip_choices):
+    """The documented definition: weighted sum of the individual
+    priority functions (the pre-fusion prioritize loop)."""
+    scores = {}
+    want = t.pod_resource_requests(pod)
+    for info in infos:
+        if info.node is None:
+            continue
+        name = info.node.metadata.name
+        total = 0.0
+        for _, fn, weight in P.DEFAULT_PRIORITIES:
+            total += weight * fn(pod, info, want)
+        total += P.TPU_DEFRAG_WEIGHT * P.tpu_defrag_score(
+            pod, info, (chip_choices or {}).get(name))
+        if sibling_counts is not None:
+            total += 1.0 * P.selector_spread(pod, info, sibling_counts)
+        scores[name] = total
+    return scores
+
+
+def _build_cache(rng):
+    cache = SchedulerCache()
+    for i in range(25):
+        n = t.Node(metadata=ObjectMeta(name=f"n{i}",
+                                       labels={"zone": f"z{i % 3}"}))
+        n.status.capacity = {"cpu": rng.choice([4.0, 8.0, 0.0]),
+                             "memory": rng.choice([2 ** 33, 2 ** 34]),
+                             "pods": 110}
+        n.status.allocatable = dict(n.status.capacity)
+        n.status.conditions = [t.NodeCondition(type=t.NODE_READY,
+                                               status="True")]
+        cache.set_node(n)
+        for j in range(rng.randrange(3)):
+            p = t.Pod(
+                metadata=ObjectMeta(name=f"p{i}-{j}", namespace="default"),
+                spec=t.PodSpec(node_name=f"n{i}", containers=[t.Container(
+                    name="c", image="i",
+                    resources=t.ResourceRequirements(
+                        requests={"cpu": rng.choice([0.5, 1.0]),
+                                  "memory": 2 ** 30}))]))
+            cache.add_pod(p)
+    return cache
+
+
+def test_fused_prioritize_matches_documented_sum():
+    rng = random.Random(7)
+    cache = _build_cache(rng)
+    infos = list(cache.nodes.values())
+    for trial in range(50):
+        pod = t.Pod(
+            metadata=ObjectMeta(name="x", namespace="default"),
+            spec=t.PodSpec(containers=[t.Container(
+                name="c", image="i",
+                resources=t.ResourceRequirements(
+                    requests={"cpu": rng.choice([0.1, 2.0]),
+                              "memory": rng.choice([2 ** 28, 2 ** 32])},
+                    limits=rng.choice([{}, {"cpu": "3"},
+                                       {"memory": str(2 ** 33)}])))]))
+        if trial % 3 == 0:
+            pod.spec.affinity = t.Affinity(node_preferred=[
+                t.NodeAffinityTerm(match_expressions=[
+                    Requirement(key="zone", operator="In", values=["z1"])])])
+        sib = rng.choice([None, {}, {"n1": 2, "n2": 0}, {"n3": 0}])
+        fused = P.prioritize(pod, infos, sib)
+        ref = _ref_scores(pod, infos, sib, None)
+        assert fused.keys() == ref.keys()
+        for k in ref:
+            assert abs(fused[k] - ref[k]) < 1e-9, (trial, k, fused[k], ref[k])
