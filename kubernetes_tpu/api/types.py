@@ -854,12 +854,28 @@ PROVISIONER_HOSTPATH = "kubernetes-tpu/host-path"
 
 
 @dataclass
+class CSIVolumeSource:
+    """Out-of-process driver-backed volume (the CSI-analog seam,
+    ``volumedriver/api.proto``; reference: core/v1 CSIPersistentVolumeSource
+    consumed by ``pkg/volume/csi/csi_plugin.go:40``). ``driver`` names
+    the socket under the node's volume-drivers dir; ``volume_handle``
+    is the driver's own volume id."""
+
+    driver: str = ""
+    volume_handle: str = ""
+    read_only: bool = False
+    volume_attributes: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
 class PersistentVolumeSpec:
     #: {"storage": bytes} — same quantity convention as pod resources.
     capacity: dict[str, float] = field(default_factory=dict)
     access_modes: list[str] = field(default_factory=lambda: ["ReadWriteOnce"])
     storage_class_name: str = ""
     host_path: Optional[HostPathVolume] = None
+    #: Driver-backed source — exactly one of host_path/csi is set.
+    csi: Optional[CSIVolumeSource] = None
     claim_ref: Optional[ObjectReference] = None
     persistent_volume_reclaim_policy: str = RECLAIM_RETAIN
 

@@ -17,13 +17,17 @@ stage bug surfaced as VolumeError.
 """
 from __future__ import annotations
 
+import asyncio
 import base64
 import binascii
 import copy
 import os
 import shutil
 import time
+from urllib.parse import quote as _urlquote
 from typing import Optional
+
+import grpc
 
 from ..api import errors, types as t
 from ..client.interface import Client
@@ -93,10 +97,20 @@ class ObjectCache:
 
 
 class VolumeManager:
-    def __init__(self, client: Client, base_dir: str):
+    def __init__(self, client: Client, base_dir: str,
+                 driver_dir: str = ""):
         #: A Client or an ObjectCache (only ``.get`` is used).
         self.client = client
         self.base_dir = base_dir
+        #: Out-of-process volume drivers (the CSI-analog seam,
+        #: volumedriver/): sockets under <base_dir>/volume-drivers by
+        #: convention, same discovery pattern as device plugins.
+        from ..volumedriver import DriverRegistry
+        self.drivers = DriverRegistry(
+            driver_dir or os.path.join(base_dir, "volume-drivers"))
+        #: pod uid -> [(driver name, volume_handle, target path)] of
+        #: driver-published volumes, unpublished at teardown.
+        self._published: dict[str, list[tuple[str, str, str]]] = {}
 
     def pod_volume_dir(self, pod_uid: str, volume: str = "") -> str:
         path = os.path.join(self.base_dir, "pods", pod_uid, "volumes")
@@ -127,14 +141,17 @@ class VolumeManager:
                 paths[vol.name] = vdir
             elif vol.persistent_volume_claim is not None:
                 paths[vol.name] = await self._pvc_path(
-                    pod, vol.persistent_volume_claim.claim_name)
+                    pod, vol.persistent_volume_claim.claim_name, vol.name)
             else:
                 raise VolumeError(f"volume {vol.name!r}: no supported source")
         return paths
 
-    async def _pvc_path(self, pod: t.Pod, claim_name: str) -> str:
-        """Resolve a bound claim to its PV's host path (the
-        WaitForAttachAndMount analog: unbound claims are transient)."""
+    async def _pvc_path(self, pod: t.Pod, claim_name: str,
+                        volume_name: str) -> str:
+        """Resolve a bound claim to a host path (the
+        WaitForAttachAndMount analog: unbound claims are transient).
+        host_path PVs pass through; csi PVs go out-of-process through
+        the driver seam (Stage once per volume, Publish per pod)."""
         try:
             pvc = await self.client.get("persistentvolumeclaims",
                                         pod.metadata.namespace, claim_name)
@@ -148,14 +165,87 @@ class VolumeManager:
         except errors.NotFoundError:
             raise VolumeError(
                 f"volume {pvc.spec.volume_name!r} not found") from None
-        if pv.spec.host_path is None:
-            raise VolumeError(f"volume {pv.metadata.name!r} has no "
-                              f"host_path source this runtime can mount")
-        return pv.spec.host_path.path
+        if pv.spec.host_path is not None:
+            return pv.spec.host_path.path
+        if pv.spec.csi is not None:
+            return await self._driver_publish(pod, pv, volume_name)
+        raise VolumeError(f"volume {pv.metadata.name!r} has no "
+                          f"host_path or csi source this runtime can mount")
+
+    def _staging_path(self, driver: str, handle: str) -> str:
+        # Percent-encode the handle: distinct handles must never
+        # collide onto one staging dir ("a/b" vs "a_b").
+        return os.path.join(self.base_dir, "staging", driver,
+                            _urlquote(handle, safe=""))
+
+    async def _driver_publish(self, pod: t.Pod, pv: t.PersistentVolume,
+                              volume_name: str) -> str:
+        """Stage + Publish through the out-of-process driver. Blocking
+        gRPC runs on a worker thread — mounts must not stall the
+        agent's event loop on a slow driver."""
+        src = pv.spec.csi
+        client = self.drivers.get(src.driver)
+        if client is None:
+            raise VolumeError(
+                f"volume driver {src.driver!r} is not registered "
+                f"(no socket in {self.drivers.driver_dir})")
+        staging = self._staging_path(src.driver, src.volume_handle)
+        target = self.pod_volume_dir(pod.metadata.uid, volume_name)
+        params = dict(src.volume_attributes)
+
+        def call() -> str:
+            try:
+                client.stage(src.volume_handle, staging, params,
+                             src.read_only)
+                return client.publish(
+                    src.volume_handle, staging, target,
+                    pod.metadata.uid, params, src.read_only)
+            except grpc.RpcError as e:
+                raise VolumeError(
+                    f"driver {src.driver!r} failed: "
+                    f"{e.code().name}: {e.details()}") from None
+
+        host_path = await asyncio.to_thread(call)
+        rec = (src.driver, src.volume_handle, target)
+        published = self._published.setdefault(pod.metadata.uid, [])
+        if rec not in published:
+            published.append(rec)
+        return host_path
 
     def teardown(self, pod_uid: str) -> None:
-        shutil.rmtree(os.path.join(self.base_dir, "pods", pod_uid),
-                      ignore_errors=True)
+        """Unpublish driver volumes, unstage the ones whose last
+        publisher this was, remove the pod dir. Driver RPCs are
+        blocking gRPC, so with a running loop the cleanup moves to a
+        worker thread (pod deletion must not stall the agent's loop on
+        a hung driver); best-effort throughout — a dead driver must
+        not wedge deletion (crash-only, like the reference's
+        orphaned-volume cleanup)."""
+        published = self._published.pop(pod_uid, ())
+        # (driver, handle) still held by OTHER pods stay staged.
+        still_held = {(d, h) for recs in self._published.values()
+                      for d, h, _ in recs}
+
+        def cleanup() -> None:
+            for driver, handle, target in published:
+                client = self.drivers.get(driver)
+                if client is not None:
+                    try:
+                        client.unpublish(handle, target, pod_uid)
+                        if (driver, handle) not in still_held:
+                            client.unstage(
+                                handle, self._staging_path(driver, handle))
+                    except Exception:  # noqa: BLE001
+                        pass
+            shutil.rmtree(os.path.join(self.base_dir, "pods", pod_uid),
+                          ignore_errors=True)
+
+        if published:
+            try:
+                asyncio.get_running_loop().run_in_executor(None, cleanup)
+                return
+            except RuntimeError:
+                pass  # no loop (tests, sync callers): run inline
+        cleanup()
 
     @staticmethod
     def read_only_volumes(pod: t.Pod) -> frozenset:

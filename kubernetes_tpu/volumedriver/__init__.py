@@ -1,0 +1,16 @@
+"""Out-of-process volume drivers — the CSI-analog seam.
+
+The one vendor-neutral gRPC boundary of the reference's storage stack
+(``pkg/volume/csi/csi_plugin.go:40`` over ``pkg/volume/plugins.go:49``)
+rebuilt on the device-plugin pattern: drivers serve ``api.proto`` on a
+unix socket under the agent's ``volume-drivers/`` directory; the agent
+consumes them through :class:`DriverRegistry` knowing only the wire
+contract. ``checkpoint_driver`` is the shipped example (a
+checkpoint-store mount for elastic training jobs).
+"""
+from .registry import DriverRegistry
+from .service import (VolumeDriverClient, VolumeDriverServicer,
+                      add_servicer_to_server, serve)
+
+__all__ = ["DriverRegistry", "VolumeDriverClient", "VolumeDriverServicer",
+           "add_servicer_to_server", "serve"]

@@ -1,0 +1,111 @@
+"""Example out-of-process driver: a checkpoint-store mount.
+
+The storage pattern TPU training actually needs: every pod of an
+elastic job mounts the SAME durable checkpoint directory, so an
+evicted-and-rescheduled worker resumes from the store
+(``workloads/checkpoint.py`` reads/writes it). Stage materializes the
+store's volume directory once per node; Publish gives each pod a
+stable path into it (a symlink, this runtime's bind-mount analog) and
+drops a breadcrumb so operators can see who mounted what.
+
+Run out-of-process:
+``python -m kubernetes_tpu.volumedriver.checkpoint_driver \
+    --socket <dir>/checkpoint-store.sock --store <backing_dir>``
+
+Reference analog: a CSI driver deployment's node plugin
+(``pkg/volume/csi/csi_attacher.go`` consumers), collapsed to the
+node-only subset this runtime's API carries.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import grpc
+
+from . import api_pb2 as pb
+from .service import VolumeDriverServicer
+
+DRIVER_NAME = "checkpoint-store"
+
+
+class CheckpointStoreDriver(VolumeDriverServicer):
+    def __init__(self, store_dir: str):
+        self.store_dir = store_dir
+        os.makedirs(store_dir, exist_ok=True)
+
+    def _volume_dir(self, volume_id: str) -> str:
+        safe = volume_id.replace("/", "_")
+        return os.path.join(self.store_dir, safe)
+
+    def GetDriverInfo(self, request, context) -> pb.DriverInfo:
+        return pb.DriverInfo(name=DRIVER_NAME, version="1.0")
+
+    def NodeStageVolume(self, request, context) -> pb.StageResponse:
+        vdir = self._volume_dir(request.volume_id)
+        os.makedirs(vdir, exist_ok=True)
+        # Store metadata written once (idempotent): which job this
+        # checkpoint volume belongs to, from PV volume_attributes.
+        meta = os.path.join(vdir, ".store.json")
+        if not os.path.exists(meta):
+            with open(meta, "w") as f:
+                json.dump({"volume_id": request.volume_id,
+                           "created": time.time(),
+                           "parameters": dict(request.parameters)}, f)
+        return pb.StageResponse()
+
+    def NodePublishVolume(self, request, context) -> pb.PublishResponse:
+        vdir = self._volume_dir(request.volume_id)
+        if not os.path.isdir(vdir):
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION,
+                          f"volume {request.volume_id} is not staged")
+        target = request.target_path
+        os.makedirs(os.path.dirname(target), exist_ok=True)
+        # Symlink = this runtime's bind mount (ProcessRuntime projects
+        # host paths the same way). Idempotent republish.
+        if os.path.islink(target):
+            os.unlink(target)
+        elif os.path.isdir(target):
+            os.rmdir(target)
+        os.symlink(vdir, target)
+        with open(os.path.join(vdir, ".publishers.json"), "a") as f:
+            f.write(json.dumps({"pod_uid": request.pod_uid,
+                                "at": time.time()}) + "\n")
+        return pb.PublishResponse(host_path=target)
+
+    def NodeUnpublishVolume(self, request, context) -> pb.UnpublishResponse:
+        if os.path.islink(request.target_path):
+            os.unlink(request.target_path)
+        return pb.UnpublishResponse()
+
+    def NodeUnstageVolume(self, request, context) -> pb.UnstageResponse:
+        # The STORE is durable by definition — unstage is a no-op
+        # beyond forgetting node-local state (none here).
+        return pb.UnstageResponse()
+
+
+def main(argv=None) -> int:
+    import argparse
+    import signal
+    import threading
+
+    from .service import serve
+
+    p = argparse.ArgumentParser(prog="checkpoint-store-driver")
+    p.add_argument("--socket", required=True)
+    p.add_argument("--store", required=True)
+    args = p.parse_args(argv)
+    server = serve(CheckpointStoreDriver(args.store), args.socket)
+    print(f"SERVING {args.socket}", flush=True)
+    stop = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_: stop.set())
+    stop.wait()
+    server.stop(grace=1.0)
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -1,0 +1,45 @@
+"""Driver discovery — sockets in a directory, like device plugins.
+
+Reference: kubelet plugin registration
+(``pkg/kubelet/util/pluginwatcher`` in later reference versions; the
+device-plugin socket-dir convention in this one). A driver named
+``store`` serves on ``<dir>/store.sock``; the agent resolves PV specs
+whose ``driver`` field says ``store`` through that socket. No watch
+machinery: mounts are infrequent, so an on-demand stat of the socket
+path is honest and race-free (a dead socket fails the mount, which
+retries on the next pod sync — crash-only).
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from .service import VolumeDriverClient
+
+
+class DriverRegistry:
+    def __init__(self, driver_dir: str):
+        self.driver_dir = driver_dir
+        self._clients: dict[str, VolumeDriverClient] = {}
+
+    def get(self, driver: str) -> Optional[VolumeDriverClient]:
+        """Client for ``driver``, or None when its socket is absent."""
+        path = os.path.join(self.driver_dir, f"{driver}.sock")
+        if not os.path.exists(path):
+            self._drop(driver)
+            return None
+        client = self._clients.get(driver)
+        if client is None or client.socket_path != path:
+            self._drop(driver)
+            client = VolumeDriverClient(path)
+            self._clients[driver] = client
+        return client
+
+    def _drop(self, driver: str) -> None:
+        old = self._clients.pop(driver, None)
+        if old is not None:
+            old.close()
+
+    def close(self) -> None:
+        for name in list(self._clients):
+            self._drop(name)

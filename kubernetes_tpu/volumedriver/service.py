@@ -1,0 +1,139 @@
+"""gRPC plumbing for the volume-driver API (CSI-analog seam).
+
+Same approach as ``deviceplugin/service.py``: grpc_tools is not in the
+image, so servicer/stub are written against grpc's generic handler API
+with protoc-generated messages — wire-identical to generated
+``*_pb2_grpc.py`` (method paths follow ``/package.Service/Method``),
+so foreign gRPC drivers interoperate.
+
+Reference seam: ``pkg/volume/csi/csi_client.go`` (the kubelet's CSI
+node client) over ``pkg/volume/plugins.go:49``'s plugin boundary.
+"""
+from __future__ import annotations
+
+import grpc
+
+from . import api_pb2 as pb
+
+SERVICE = "tpuvolumedriver.v1.VolumeDriver"
+
+
+class VolumeDriverServicer:
+    """Subclass and override; defaults reject (a driver that forgets a
+    method must fail loudly, not no-op a mount)."""
+
+    def GetDriverInfo(self, request: pb.Empty, context) -> pb.DriverInfo:
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "GetDriverInfo")
+
+    def NodeStageVolume(self, request: pb.StageRequest,
+                        context) -> pb.StageResponse:
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "NodeStageVolume")
+
+    def NodePublishVolume(self, request: pb.PublishRequest,
+                          context) -> pb.PublishResponse:
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "NodePublishVolume")
+
+    def NodeUnpublishVolume(self, request: pb.UnpublishRequest,
+                            context) -> pb.UnpublishResponse:
+        return pb.UnpublishResponse()
+
+    def NodeUnstageVolume(self, request: pb.UnstageRequest,
+                          context) -> pb.UnstageResponse:
+        return pb.UnstageResponse()
+
+
+def add_servicer_to_server(servicer: VolumeDriverServicer,
+                           server: grpc.Server) -> None:
+    handlers = {
+        "GetDriverInfo": grpc.unary_unary_rpc_method_handler(
+            servicer.GetDriverInfo,
+            request_deserializer=pb.Empty.FromString,
+            response_serializer=pb.DriverInfo.SerializeToString),
+        "NodeStageVolume": grpc.unary_unary_rpc_method_handler(
+            servicer.NodeStageVolume,
+            request_deserializer=pb.StageRequest.FromString,
+            response_serializer=pb.StageResponse.SerializeToString),
+        "NodePublishVolume": grpc.unary_unary_rpc_method_handler(
+            servicer.NodePublishVolume,
+            request_deserializer=pb.PublishRequest.FromString,
+            response_serializer=pb.PublishResponse.SerializeToString),
+        "NodeUnpublishVolume": grpc.unary_unary_rpc_method_handler(
+            servicer.NodeUnpublishVolume,
+            request_deserializer=pb.UnpublishRequest.FromString,
+            response_serializer=pb.UnpublishResponse.SerializeToString),
+        "NodeUnstageVolume": grpc.unary_unary_rpc_method_handler(
+            servicer.NodeUnstageVolume,
+            request_deserializer=pb.UnstageRequest.FromString,
+            response_serializer=pb.UnstageResponse.SerializeToString),
+    }
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(SERVICE, handlers),))
+
+
+class VolumeDriverClient:
+    """Agent-side stub over a driver's unix socket."""
+
+    def __init__(self, socket_path: str, timeout: float = 10.0):
+        self.socket_path = socket_path
+        self.timeout = timeout
+        self._channel = grpc.insecure_channel(f"unix://{socket_path}")
+
+    def _call(self, method: str, request, response_cls):
+        rpc = self._channel.unary_unary(
+            f"/{SERVICE}/{method}",
+            request_serializer=type(request).SerializeToString,
+            response_deserializer=response_cls.FromString)
+        return rpc(request, timeout=self.timeout)
+
+    def info(self) -> pb.DriverInfo:
+        return self._call("GetDriverInfo", pb.Empty(), pb.DriverInfo)
+
+    def stage(self, volume_id: str, staging_path: str,
+              parameters: dict, read_only: bool) -> None:
+        self._call("NodeStageVolume",
+                   pb.StageRequest(volume_id=volume_id,
+                                   staging_path=staging_path,
+                                   parameters=parameters,
+                                   read_only=read_only),
+                   pb.StageResponse)
+
+    def publish(self, volume_id: str, staging_path: str, target_path: str,
+                pod_uid: str, parameters: dict, read_only: bool) -> str:
+        resp = self._call(
+            "NodePublishVolume",
+            pb.PublishRequest(volume_id=volume_id, staging_path=staging_path,
+                              target_path=target_path, pod_uid=pod_uid,
+                              parameters=parameters, read_only=read_only),
+            pb.PublishResponse)
+        return resp.host_path or target_path
+
+    def unpublish(self, volume_id: str, target_path: str,
+                  pod_uid: str) -> None:
+        self._call("NodeUnpublishVolume",
+                   pb.UnpublishRequest(volume_id=volume_id,
+                                       target_path=target_path,
+                                       pod_uid=pod_uid),
+                   pb.UnpublishResponse)
+
+    def unstage(self, volume_id: str, staging_path: str) -> None:
+        self._call("NodeUnstageVolume",
+                   pb.UnstageRequest(volume_id=volume_id,
+                                     staging_path=staging_path),
+                   pb.UnstageResponse)
+
+    def close(self) -> None:
+        self._channel.close()
+
+
+def serve(servicer: VolumeDriverServicer, socket_path: str) -> grpc.Server:
+    """Start a driver server on a unix socket (driver-side helper)."""
+    import os
+    from concurrent import futures
+    os.makedirs(os.path.dirname(socket_path), exist_ok=True)
+    if os.path.exists(socket_path):
+        os.unlink(socket_path)
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
+    add_servicer_to_server(servicer, server)
+    server.add_insecure_port(f"unix://{socket_path}")
+    server.start()
+    return server

@@ -1,0 +1,170 @@
+"""The out-of-process volume-driver seam (CSI analog).
+
+Reference: ``pkg/volume/csi/csi_plugin.go:40`` over the vendor-neutral
+plugin boundary ``pkg/volume/plugins.go:49``. The proof mirrors
+``test_cri_swap.py``: the agent's volume manager talks ONLY the wire
+contract — the shipped checkpoint-store driver runs as a real separate
+process, and a second, differently-implemented driver swaps in behind
+the same socket convention.
+"""
+import asyncio
+import os
+import subprocess
+import sys
+
+import pytest
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.apiserver.registry import Registry
+from kubernetes_tpu.client.local import LocalClient
+from kubernetes_tpu.node.volumes import VolumeError, VolumeManager
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def make_pv_pvc(reg, driver="checkpoint-store", handle="job-ckpt-1",
+                attrs=None):
+    pv = t.PersistentVolume(
+        metadata=ObjectMeta(name="pv-ckpt"),
+        spec=t.PersistentVolumeSpec(
+            capacity={"storage": float(2 ** 30)},
+            access_modes=["ReadWriteMany"],
+            csi=t.CSIVolumeSource(driver=driver, volume_handle=handle,
+                                  volume_attributes=attrs or {"job": "lm"})))
+    reg.create(pv)
+    pvc = t.PersistentVolumeClaim(
+        metadata=ObjectMeta(name="ckpt", namespace="default"),
+        spec=t.PersistentVolumeClaimSpec(
+            access_modes=["ReadWriteMany"],
+            resources=t.ResourceRequirements(
+                requests={"storage": float(2 ** 30)})))
+    pvc = reg.create(pvc)
+    pvc.spec.volume_name = "pv-ckpt"
+    pvc = reg.update(pvc)
+    pvc.status.phase = t.PVC_BOUND
+    reg.update(pvc, subresource="status")
+    return pv, pvc
+
+
+def mk_pod(name, uid_suffix=""):
+    pod = t.Pod(metadata=ObjectMeta(name=name, namespace="default"),
+                spec=t.PodSpec(
+                    containers=[t.Container(name="c", image="i")],
+                    volumes=[t.Volume(
+                        name="ckpt",
+                        persistent_volume_claim=t.PersistentVolumeClaimVolume(
+                            claim_name="ckpt"))]))
+    return pod
+
+
+async def test_checkpoint_driver_out_of_process(tmp_path):
+    """The shipped driver in its own PROCESS: stage + publish through
+    the socket, data durable in the store across pods, unpublish on
+    teardown — the agent never imports the driver."""
+    driver_dir = tmp_path / "volume-drivers"
+    store = tmp_path / "store"
+    driver_dir.mkdir()
+    sock = driver_dir / "checkpoint-store.sock"
+    proc = subprocess.Popen(
+        [sys.executable, "-m",
+         "kubernetes_tpu.volumedriver.checkpoint_driver",
+         "--socket", str(sock), "--store", str(store)],
+        cwd=REPO, stdout=subprocess.PIPE, text=True)
+    try:
+        assert proc.stdout.readline().startswith("SERVING")
+        reg = Registry()
+        reg.create(t.Namespace(metadata=ObjectMeta(name="default")))
+        make_pv_pvc(reg)
+        vm = VolumeManager(LocalClient(reg), str(tmp_path / "agent"),
+                           driver_dir=str(driver_dir))
+
+        pod1 = reg.create(mk_pod("w0"))
+        paths = await vm.materialize(pod1)
+        ckpt = paths["ckpt"]
+        with open(os.path.join(ckpt, "step-100.ckpt"), "w") as f:
+            f.write("weights")
+
+        # A second pod mounting the same claim sees the SAME store —
+        # the elastic-training resume property.
+        pod2 = reg.create(mk_pod("w1"))
+        paths2 = await vm.materialize(pod2)
+        with open(os.path.join(paths2["ckpt"], "step-100.ckpt")) as f:
+            assert f.read() == "weights"
+
+        # Teardown unpublishes pod1's mount (async off-loop —
+        # a hung driver must not stall the agent); the store survives.
+        vm.teardown(pod1.metadata.uid)
+        for _ in range(50):
+            if not os.path.lexists(ckpt):
+                break
+            await asyncio.sleep(0.1)
+        assert not os.path.lexists(ckpt)
+        with open(os.path.join(paths2["ckpt"], "step-100.ckpt")) as f:
+            assert f.read() == "weights"
+        # Driver breadcrumbs record both publishers.
+        pubs = open(os.path.join(str(store), "job-ckpt-1",
+                                 ".publishers.json")).read()
+        assert pod1.metadata.uid in pubs and pod2.metadata.uid in pubs
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+
+
+async def test_second_driver_swaps_behind_the_same_contract(tmp_path):
+    """A differently-implemented driver (plain per-volume dirs, no
+    symlinks, host_path returned from its own tree) serves the same
+    proto — the agent code is untouched. The swap proof."""
+    import grpc
+
+    from kubernetes_tpu.volumedriver import (VolumeDriverServicer, serve)
+    from kubernetes_tpu.volumedriver import api_pb2 as pb
+
+    class FlatDirDriver(VolumeDriverServicer):
+        def __init__(self, root):
+            self.root = root
+
+        def GetDriverInfo(self, request, context):
+            return pb.DriverInfo(name="flatdir", version="2.0")
+
+        def NodeStageVolume(self, request, context):
+            os.makedirs(os.path.join(self.root, request.volume_id),
+                        exist_ok=True)
+            return pb.StageResponse()
+
+        def NodePublishVolume(self, request, context):
+            # Publishes INTO ITS OWN tree: per-pod subdir, no symlink.
+            d = os.path.join(self.root, request.volume_id, request.pod_uid)
+            os.makedirs(d, exist_ok=True)
+            return pb.PublishResponse(host_path=d)
+
+    driver_dir = tmp_path / "volume-drivers"
+    driver_dir.mkdir()
+    server = serve(FlatDirDriver(str(tmp_path / "flat")),
+                   str(driver_dir / "flatdir.sock"))
+    try:
+        reg = Registry()
+        reg.create(t.Namespace(metadata=ObjectMeta(name="default")))
+        make_pv_pvc(reg, driver="flatdir", handle="vol7")
+        vm = VolumeManager(LocalClient(reg), str(tmp_path / "agent"),
+                           driver_dir=str(driver_dir))
+        pod = reg.create(mk_pod("w0"))
+        paths = await vm.materialize(pod)
+        assert paths["ckpt"].startswith(str(tmp_path / "flat"))
+        assert os.path.isdir(paths["ckpt"])
+    finally:
+        server.stop(grace=1.0)
+
+
+async def test_missing_driver_is_transient(tmp_path):
+    """No socket -> VolumeError (the pod worker's retry contract),
+    never a crash or a silent empty mount."""
+    reg = Registry()
+    reg.create(t.Namespace(metadata=ObjectMeta(name="default")))
+    make_pv_pvc(reg, driver="not-installed")
+    vm = VolumeManager(LocalClient(reg), str(tmp_path / "agent"),
+                       driver_dir=str(tmp_path / "volume-drivers"))
+    pod = reg.create(mk_pod("w0"))
+    with pytest.raises(VolumeError, match="not-installed"):
+        await vm.materialize(pod)
