@@ -83,7 +83,7 @@ async def test_live_pipeline_and_dashboard_names(tmp_path):
 
         # Wait until the pod reports.
         rec = None
-        for _ in range(100):
+        for _ in range(200):
             summary = await training_summary()
             recs = [p.get("training") for p in summary["pods"]
                     if p["pod"]["name"] == "train"]
@@ -96,7 +96,7 @@ async def test_live_pipeline_and_dashboard_names(tmp_path):
 
         # The numbers MOVE (step advances between scrapes).
         step1 = rec["step"]
-        for _ in range(50):
+        for _ in range(100):
             await asyncio.sleep(0.2)
             summary = await training_summary()
             rec2 = [p.get("training") for p in summary["pods"]
