@@ -43,7 +43,8 @@ class AuditLogger:
 
     def record(self, *, user: str, verb: str, resource: str,
                namespace: str, name: str, code: int,
-               latency_seconds: float, body: Optional[dict] = None) -> None:
+               latency_seconds: float, body: Optional[dict] = None,
+               impersonated_by: str = "") -> None:
         if self.level == LEVEL_NONE or self._stream is None:
             return
         if self.omit_reads and verb in _READ_VERBS:
@@ -60,6 +61,10 @@ class AuditLogger:
             "code": code,
             "latency_seconds": round(latency_seconds, 6),
         }
+        if impersonated_by:
+            # Both identities on the record (reference: audit events
+            # carry impersonatedUser alongside user).
+            event["impersonated_by"] = impersonated_by
         if self.level == LEVEL_REQUEST and body is not None:
             event["request_object"] = body
         try:

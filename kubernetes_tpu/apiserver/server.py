@@ -80,6 +80,15 @@ class APIServer:
         #: final request object; zero overhead while no config exists.
         from .webhooks import WebhookDispatcher
         self.webhooks = WebhookDispatcher(self.registry)
+        #: External token authenticator in the union (reference: the
+        #: webhook TokenReview authenticator, --authentication-token-
+        #: webhook-config-file): consulted after static/SA/bootstrap
+        #: tokens miss; the endpoint answers authentication/v1
+        #: TokenReview. ``authn_webhook_ssl``: optional ssl context for
+        #: a private CA.
+        self.authn_webhook_url = ""
+        self.authn_webhook_ssl = None
+        self._authn_webhook_cache: dict[str, tuple] = {}
         #: Requests slower than this log a slow-op line (SLO: 1s p99).
         self.slow_request_threshold = 1.0
         #: Max concurrent non-watch requests (reference: the
@@ -147,10 +156,31 @@ class APIServer:
                 token = auth[7:] if auth.startswith("Bearer ") else ""
                 user = (self.tokens.get(token) or self._sa_user(token)
                         or self._bootstrap_user(token))
+                if user is None and token and self.authn_webhook_url:
+                    # Union tail: the external TokenReview webhook.
+                    hit = await self._webhook_user(token)
+                    if hit is not None:
+                        user, webhook_groups = hit
+                        request["cert_groups"] = set(webhook_groups)
             if user is None:
                 return self._err(errors.UnauthorizedError(
                     "no valid client certificate or bearer token"))
             request["user"] = user
+            # Impersonation (reference: WithImpersonation,
+            # staging/.../server/config.go:530-543): a caller holding
+            # the ``impersonate`` verb acts as another identity; audit
+            # records BOTH. Runs after authn, before authz — all
+            # downstream decisions see the impersonated identity.
+            imp_user = request.headers.get("Impersonate-User", "")
+            if imp_user:
+                resp = self._impersonate(request, user, imp_user)
+                if resp is not None:
+                    return resp
+            elif "Impersonate-Group" in request.headers:
+                # Group-without-user is an error in the reference, not
+                # a silent no-op the caller would misread as applied.
+                return self._err(errors.BadRequestError(
+                    "Impersonate-Group requires Impersonate-User"))
         attrs = self._attributes(request)
         # Long-running exemption from max-in-flight applies only to
         # requests that ARE watches (collection GET) — '?watch=1' on a
@@ -221,6 +251,87 @@ class APIServer:
                          request.method, request.path, 1e3 * elapsed, code)
             if self.audit is not None and attrs is not None:
                 await self._audit(request, attrs, code, elapsed)
+
+    def _impersonate(self, request, user: str, imp_user: str):
+        """Authorize + apply Impersonate-User/-Group. Returns an error
+        response to send, or None on success (request identity
+        rewritten in place)."""
+        groups = self._groups_for(user) | request.get("cert_groups", set())
+        imp_groups = request.headers.getall("Impersonate-Group", [])
+
+        def allowed(resource: str, name: str) -> bool:
+            if self.authorizer is None:
+                return True
+            return self.authorizer.authorize(Attributes(
+                user, groups, "impersonate", resource, "", name))
+
+        if not allowed("users", imp_user):
+            return self._err(errors.ForbiddenError(
+                f"user {user!r} cannot impersonate user {imp_user!r}"))
+        for g in imp_groups:
+            if not allowed("groups", g):
+                return self._err(errors.ForbiddenError(
+                    f"user {user!r} cannot impersonate group {g!r}"))
+        request["impersonated_by"] = user
+        request["user"] = imp_user
+        # The impersonated identity's groups are EXACTLY the requested
+        # ones (reference semantics) — never the impersonator's own
+        # cert groups, and NOT the target's configured user_groups
+        # either: 'impersonate users/alice' must not smuggle in
+        # system:masters just because alice holds it (that requires
+        # 'impersonate groups/system:masters'). _attributes honors
+        # this via the impersonated_by marker.
+        request["cert_groups"] = set(imp_groups)
+        return None
+
+    async def _webhook_user(self, token: str):
+        """(user, groups) from the external TokenReview webhook, or
+        None. Verdicts cache 30s (denials 5s) — the webhook must not
+        sit on every request's hot path."""
+        import time as _time
+        cached = self._authn_webhook_cache.get(token)
+        if cached is not None and cached[2] > _time.monotonic():
+            return cached[0]
+        import aiohttp
+        result = None
+        try:
+            kw = ({"ssl": self.authn_webhook_ssl}
+                  if self.authn_webhook_ssl is not None else {})
+            async with aiohttp.ClientSession() as s:
+                async with s.post(self.authn_webhook_url,
+                                  json={"spec": {"token": token}},
+                                  timeout=aiohttp.ClientTimeout(total=5),
+                                  **kw) as r:
+                    if r.status == 200:
+                        body = await r.json()
+                        status = body.get("status") or {}
+                        u = status.get("user") or {}
+                        # authenticated:true WITHOUT a username is a
+                        # broken webhook, not an identity — an empty
+                        # user must never pass authn.
+                        if status.get("authenticated") \
+                                and u.get("username"):
+                            result = (u["username"],
+                                      list(u.get("groups") or ()))
+        except Exception as e:  # noqa: BLE001 — authn webhook down: deny
+            log.warning("authn webhook failed: %s", e)
+            return None  # not cached: recover as soon as it is back
+        ttl = 30.0 if result else 5.0
+        self._authn_webhook_cache[token] = (result, None,
+                                            _time.monotonic() + ttl)
+        if len(self._authn_webhook_cache) > 4096:
+            # Hard size bound: expired entries first, then OLDEST
+            # (insertion order) — a flood of unique junk tokens must
+            # not grow memory or turn every insert into an O(n) scan
+            # that evicts nothing.
+            now_m = _time.monotonic()
+            for k in [k for k, v in self._authn_webhook_cache.items()
+                      if v[2] <= now_m]:
+                del self._authn_webhook_cache[k]
+            while len(self._authn_webhook_cache) > 4096:
+                self._authn_webhook_cache.pop(
+                    next(iter(self._authn_webhook_cache)))
+        return result
 
     def _sa_user(self, token: str) -> Optional[str]:
         """Resolve a bearer against service-account token Secrets
@@ -293,7 +404,14 @@ class APIServer:
         verb = verb_for_request(request.method, bool(name),
                                 request.query.get("watch") in ("1", "true"))
         user = request.get("user", "system:anonymous")
-        groups = self._groups_for(user) | request.get("cert_groups", set())
+        if request.get("impersonated_by"):
+            # Impersonated identities carry EXACTLY the requested
+            # groups (set by _impersonate) — configured user_groups of
+            # the target must not leak in (see _impersonate).
+            groups = set(request.get("cert_groups", set()))
+        else:
+            groups = self._groups_for(user) | request.get("cert_groups",
+                                                          set())
         resource = f"{plural}/{sub}" if sub else plural
         return Attributes(user, groups, verb, resource,
                           request.match_info.get("namespace", ""), name)
@@ -324,7 +442,8 @@ class APIServer:
         self.audit.record(
             user=attrs.user, verb=attrs.verb, resource=attrs.resource,
             namespace=attrs.namespace, name=attrs.name, code=code,
-            latency_seconds=elapsed, body=body)
+            latency_seconds=elapsed, body=body,
+            impersonated_by=request.get("impersonated_by", ""))
 
     @staticmethod
     def _err(e: errors.StatusError) -> web.Response:

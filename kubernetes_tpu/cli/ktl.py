@@ -115,7 +115,11 @@ def load_tls(args) -> dict:
 
 
 def make_client(args) -> RESTClient:
+    groups = tuple(getattr(args, "as_group", None) or ()) + tuple(
+        getattr(args, "as_group_sub", None) or ())
     return RESTClient(load_server(args), token=load_token(args),
+                      impersonate_user=getattr(args, "as_user", "") or "",
+                      impersonate_groups=groups,
                       **load_tls(args))
 
 
@@ -1164,6 +1168,10 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="ktl",
                                 description="TPU-cluster CLI (kubectl analog)")
     p.add_argument("--server", default="", help="apiserver URL")
+    p.add_argument("--as", dest="as_user", default="",
+                   help="impersonate this user (RBAC 'impersonate' verb)")
+    p.add_argument("--as-group", dest="as_group", action="append",
+                   default=[], help="impersonate this group (repeatable)")
     sub = p.add_subparsers(dest="command", required=True)
 
     def add(name, fn, **kw):
@@ -1173,6 +1181,13 @@ def build_parser() -> argparse.ArgumentParser:
         # clobber the top-level --server value already parsed.
         sp.add_argument("--server", default=argparse.SUPPRESS,
                         help=argparse.SUPPRESS)
+        sp.add_argument("--as", dest="as_user", default=argparse.SUPPRESS,
+                        help=argparse.SUPPRESS)
+        # Separate dest: subparsers OVERWRITE parent namespace values,
+        # so appending to as_group here would silently drop top-level
+        # --as-group entries; make_client merges both dests.
+        sp.add_argument("--as-group", dest="as_group_sub", action="append",
+                        default=argparse.SUPPRESS, help=argparse.SUPPRESS)
         return sp
 
     sp = add("get", cmd_get, help="list or get resources")

@@ -118,15 +118,29 @@ class _RESTWatch(WatchStream):
 class RESTClient(Client):
     def __init__(self, base_url: str, token: str = "",
                  ca_file: str = "", client_cert: str = "",
-                 client_key: str = "", check_hostname: bool = True):
+                 client_key: str = "", check_hostname: bool = True,
+                 impersonate_user: str = "",
+                 impersonate_groups: tuple = ()):
         """``ca_file`` makes https URLs verify against the cluster CA;
         ``client_cert``/``client_key`` authenticate with an x509
         identity cert (CN=user, O=groups) instead of / beside a token.
         ``check_hostname=False`` only for callers that pinned the peer
         another way (the join flow's CA fingerprint — its --server
-        address is routinely absent from the apiserver cert SANs)."""
+        address is routinely absent from the apiserver cert SANs).
+        ``impersonate_user``/``impersonate_groups``: act as another
+        identity (kubectl --as / --as-group; RBAC 'impersonate' verb
+        required server-side)."""
         self.base_url = base_url.rstrip("/")
         self._headers = {"Authorization": f"Bearer {token}"} if token else {}
+        if impersonate_user:
+            self._headers["Impersonate-User"] = impersonate_user
+        # aiohttp headers dicts can't repeat keys; use a CIMultiDict.
+        if impersonate_groups:
+            from multidict import CIMultiDict
+            h = CIMultiDict(self._headers)
+            for g in impersonate_groups:
+                h.add("Impersonate-Group", g)
+            self._headers = h
         self._ssl = None
         if ca_file:
             from ..apiserver.certs import client_ssl_context

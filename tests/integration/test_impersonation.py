@@ -1,0 +1,195 @@
+"""Impersonation + the webhook token authenticator.
+
+Reference: ``WithImpersonation`` in the generic apiserver handler
+chain (``staging/src/k8s.io/apiserver/pkg/server/config.go:530-543``)
+— RBAC-gated by the ``impersonate`` verb on users/groups, with audit
+carrying BOTH identities — and the TokenReview webhook authenticator
+in the union (``--authentication-token-webhook``).
+"""
+import json
+
+import pytest
+from aiohttp import web
+
+from kubernetes_tpu.api import errors, rbac, types as t
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.apiserver.audit import AuditLogger
+from kubernetes_tpu.apiserver.authz import RBACAuthorizer
+from kubernetes_tpu.apiserver.registry import Registry
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.client.rest import RESTClient
+
+from .test_authz_audit import grant_role, make_registry
+
+
+def grant(reg, name, rules):
+    reg.create(rbac.ClusterRole(metadata=ObjectMeta(name=f"{name}-cr"),
+                                rules=rules))
+    reg.create(rbac.ClusterRoleBinding(
+        metadata=ObjectMeta(name=f"{name}-crb"),
+        role_ref=rbac.RoleRef(kind="ClusterRole", name=f"{name}-cr"),
+        subjects=[rbac.Subject(kind="User", name=name)]))
+
+
+async def start_rbac_server(tmp_path=None):
+    reg = make_registry()
+    audit = (AuditLogger(path=str(tmp_path / "audit.jsonl"))
+             if tmp_path is not None else None)
+    srv = APIServer(reg,
+                    tokens={"imptok": "impersonator", "bobtok": "bob"},
+                    authorizer=RBACAuthorizer(reg), audit=audit)
+    port = await srv.start()
+    return reg, srv, f"http://127.0.0.1:{port}", audit
+
+
+async def test_impersonation_rbac_gated_and_audited(tmp_path):
+    reg, srv, base, audit = await start_rbac_server(tmp_path)
+    try:
+        # impersonator may impersonate USER alice (and only alice) and
+        # GROUP viewers (and only viewers).
+        grant(reg, "impersonator", [
+            rbac.PolicyRule(verbs=["impersonate"], resources=["users"],
+                            resource_names=["alice"]),
+            rbac.PolicyRule(verbs=["impersonate"], resources=["groups"],
+                            resource_names=["viewers"])])
+        grant_role(reg, "default", "alice", ["get", "list"], ["pods"])
+
+        # --as alice: alice's permissions apply, not the impersonator's.
+        as_alice = RESTClient(base, token="imptok",
+                              impersonate_user="alice")
+        pods, _ = await as_alice.list("pods", "default")
+        assert pods == []
+        with pytest.raises(errors.ForbiddenError):
+            await as_alice.create(t.Pod(
+                metadata=ObjectMeta(name="p", namespace="default"),
+                spec=t.PodSpec(containers=[t.Container(name="c",
+                                                       image="i")])))
+        await as_alice.close()
+
+        # A user not in resource_names is refused.
+        as_charlie = RESTClient(base, token="imptok",
+                                impersonate_user="charlie")
+        with pytest.raises(errors.ForbiddenError, match="impersonate"):
+            await as_charlie.list("pods", "default")
+        await as_charlie.close()
+
+        # A caller without the impersonate verb is refused outright.
+        bob = RESTClient(base, token="bobtok", impersonate_user="alice")
+        with pytest.raises(errors.ForbiddenError, match="impersonate"):
+            await bob.list("pods", "default")
+        await bob.close()
+
+        # Group impersonation: permissions bound to the GROUP apply.
+        reg.create(rbac.ClusterRole(
+            metadata=ObjectMeta(name="viewers-cr"),
+            rules=[rbac.PolicyRule(verbs=["list"], resources=["nodes"])]))
+        reg.create(rbac.ClusterRoleBinding(
+            metadata=ObjectMeta(name="viewers-crb"),
+            role_ref=rbac.RoleRef(kind="ClusterRole", name="viewers-cr"),
+            subjects=[rbac.Subject(kind="Group", name="viewers")]))
+        as_group = RESTClient(base, token="imptok",
+                              impersonate_user="alice",
+                              impersonate_groups=("viewers",))
+        nodes, _ = await as_group.list("nodes")
+        assert nodes == []
+        await as_group.close()
+        # ...but a group outside resource_names is refused.
+        bad_group = RESTClient(base, token="imptok",
+                               impersonate_user="alice",
+                               impersonate_groups=("system:masters",))
+        with pytest.raises(errors.ForbiddenError, match="impersonate"):
+            await bad_group.list("pods", "default")
+        await bad_group.close()
+
+        # Audit carries BOTH identities.
+        audit.close()
+        events = [json.loads(line) for line in
+                  open(tmp_path / "audit.jsonl")]
+        mine = [e for e in events
+                if e.get("impersonated_by") == "impersonator"]
+        assert mine and all(e["user"] == "alice" for e in mine), events
+    finally:
+        await srv.stop()
+
+
+async def test_webhook_authenticator_in_union(tmp_path):
+    """An external TokenReview endpoint authenticates tokens the
+    built-in authenticators don't know."""
+    reviews = []
+
+    async def review(request):
+        body = await request.json()
+        token = body["spec"]["token"]
+        reviews.append(token)
+        if token == "ext-1":
+            return web.json_response({"status": {
+                "authenticated": True,
+                "user": {"username": "external-user",
+                         "groups": ["ext-team"]}}})
+        return web.json_response({"status": {"authenticated": False}})
+
+    app = web.Application()
+    app.router.add_post("/authenticate", review)
+    runner = web.AppRunner(app, access_log=None)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    hook_port = site._server.sockets[0].getsockname()[1]
+
+    reg, srv, base, _ = await start_rbac_server()
+    srv.authn_webhook_url = f"http://127.0.0.1:{hook_port}/authenticate"
+    try:
+        grant_role(reg, "default", "external-user", ["list"], ["pods"])
+        ext = RESTClient(base, token="ext-1")
+        pods, _ = await ext.list("pods", "default")
+        assert pods == []
+        # Second request hits the verdict cache, not the webhook.
+        await ext.list("pods", "default")
+        assert reviews.count("ext-1") == 1, reviews
+        await ext.close()
+
+        bad = RESTClient(base, token="nope")
+        with pytest.raises(errors.UnauthorizedError):
+            await bad.list("pods", "default")
+        await bad.close()
+    finally:
+        await srv.stop()
+        await runner.cleanup()
+
+
+async def test_impersonation_does_not_inherit_target_user_groups():
+    """'impersonate users/alice' must NOT smuggle in alice's configured
+    groups (e.g. system:masters) — that requires impersonating the
+    GROUP explicitly. The escalation the reference semantics forbid."""
+    reg = make_registry()
+    srv = APIServer(reg, tokens={"imptok": "impersonator"},
+                    authorizer=RBACAuthorizer(reg),
+                    user_groups={"alice": {"system:masters"}})
+    port = await srv.start()
+    base = f"http://127.0.0.1:{port}"
+    try:
+        grant(reg, "impersonator", [
+            rbac.PolicyRule(verbs=["impersonate"], resources=["users"],
+                            resource_names=["alice"])])
+        as_alice = RESTClient(base, token="imptok",
+                              impersonate_user="alice")
+        # alice-the-real-user would be cluster-admin via user_groups;
+        # impersonated-alice has exactly NO granted groups.
+        with pytest.raises(errors.ForbiddenError):
+            await as_alice.list("secrets", "default")
+        await as_alice.close()
+    finally:
+        await srv.stop()
+
+
+async def test_group_without_user_is_rejected():
+    reg, srv, base, _ = await start_rbac_server()
+    try:
+        c = RESTClient(base, token="imptok",
+                       impersonate_groups=("viewers",))
+        with pytest.raises(errors.BadRequestError,
+                           match="Impersonate-User"):
+            await c.list("pods", "default")
+        await c.close()
+    finally:
+        await srv.stop()
