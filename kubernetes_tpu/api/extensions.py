@@ -43,7 +43,12 @@ class SchemaProps:
 @dataclass
 class CRDSpec:
     group: str = ""
+    #: STORAGE version (also served).
     version: str = "v1"
+    #: Additional SERVED versions (conversion strategy None — same
+    #: schema, api_version rewritten on the wire; reference:
+    #: apiextensions served/storage flags).
+    served_versions: list[str] = field(default_factory=list)
     scope: str = SCOPE_NAMESPACED
     names: CRDNames = field(default_factory=CRDNames)
     #: Validation applied to the custom object's top level (commonly a

@@ -362,6 +362,33 @@ class Scheme:
         self._by_gvk: dict[tuple[str, str], type] = {}
         self._by_cls: dict[type, tuple[str, str]] = {}
         self._defaulters: dict[type, list] = {}
+        #: (api_version, kind) -> (to_hub, from_hub) dict->dict wire
+        #: transforms for served-but-not-stored versions (see
+        #: api/versioning.py). Scoped to the scheme, like class
+        #: registration — two registries must not share CRD versions.
+        self._conversions: dict[tuple[str, str], tuple] = {}
+
+    # -- version conversion (api/versioning.py machinery) -----------------
+
+    def register_conversion(self, api_version: str, kind: str,
+                            to_hub_fn, from_hub_fn) -> None:
+        self._conversions[(api_version, kind)] = (to_hub_fn, from_hub_fn)
+
+    def unregister_conversion(self, api_version: str, kind: str) -> None:
+        self._conversions.pop((api_version, kind), None)
+
+    def convertible(self, api_version: str, kind: str) -> bool:
+        return (api_version, kind) in self._conversions
+
+    def conversions_for_kind(self, kind: str) -> list[str]:
+        """Registered external api_versions for ``kind``."""
+        return [av for av, k in self._conversions if k == kind]
+
+    def to_hub(self, api_version: str, kind: str, data: dict) -> dict:
+        return self._conversions[(api_version, kind)][0](data)
+
+    def from_hub(self, api_version: str, kind: str, data: dict) -> dict:
+        return self._conversions[(api_version, kind)][1](data)
 
     def register(self, api_version: str, kind: str, cls: type) -> type:
         self._by_gvk[(api_version, kind)] = cls

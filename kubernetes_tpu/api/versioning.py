@@ -1,0 +1,152 @@
+"""API version evolution — external versions + conversion via the hub.
+
+Reference: ``pkg/apis/`` keeps internal ("hub") types with per-version
+external types, conversion functions, and defaulting; the apiserver
+decodes any served version to the hub, stores ONE version, and encodes
+responses back to the version the client asked for — that is what
+makes rolling upgrades and wire-compat evolution possible.
+
+Redesign for the dataclass scheme: conversions are registered at the
+WIRE level (dict -> dict), which serves both typed built-ins and
+dynamically-installed CRDs through one mechanism, and preserves
+unknown fields by construction. The storage version is always the
+hub's ``api_version``; serving an older version costs one dict
+transform per request on that version only.
+
+Proof instance: ``core/v1beta1 PodGroup`` — the gang API's previous
+shape (``members`` count + ``topology`` string) served alongside the
+v1 hub (``min_member`` + ``slice_shape`` list), stored as v1.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from .meta import TypedObject
+from .scheme import DEFAULT_SCHEME
+
+# Conversion storage lives ON the Scheme (scoped like class
+# registration — two registries must not share CRD versions); these
+# module-level helpers operate on DEFAULT_SCHEME, where the builtin
+# versions below register.
+
+def register_conversion(api_version: str, kind: str,
+                        to_hub: Callable[[dict], dict],
+                        from_hub: Callable[[dict], dict]) -> None:
+    DEFAULT_SCHEME.register_conversion(api_version, kind, to_hub, from_hub)
+
+
+def unregister_conversion(api_version: str, kind: str) -> None:
+    DEFAULT_SCHEME.unregister_conversion(api_version, kind)
+
+
+def convertible(api_version: str, kind: str) -> bool:
+    return DEFAULT_SCHEME.convertible(api_version, kind)
+
+
+def to_hub(api_version: str, kind: str, data: dict) -> dict:
+    return DEFAULT_SCHEME.to_hub(api_version, kind, data)
+
+
+def from_hub(api_version: str, kind: str, data: dict) -> dict:
+    return DEFAULT_SCHEME.from_hub(api_version, kind, data)
+
+
+def identity_conversion(external_av: str, hub_av: str):
+    """(to_hub, from_hub) that only rewrite api_version — the CRD
+    multi-version case with conversion strategy None (same schema,
+    several served versions)."""
+
+    def up(d: dict) -> dict:
+        return {**d, "api_version": hub_av}
+
+    def down(d: dict) -> dict:
+        return {**d, "api_version": external_av}
+
+    return up, down
+
+
+# ---------------------------------------------------------------------------
+# core/v1beta1 PodGroup — the served-but-not-stored gang API version.
+# ---------------------------------------------------------------------------
+
+CORE_V1BETA1 = "core/v1beta1"
+
+
+@dataclass
+class PodGroupV1Beta1Spec:
+    #: v1 renamed this to ``min_member``.
+    members: int = 0
+    #: v1 structured this into ``slice_shape: list[int]``.
+    topology: str = ""
+    priority: Optional[int] = None
+    schedule_timeout_seconds: int = 0
+
+
+@dataclass
+class PodGroupV1Beta1(TypedObject):
+    """The beta gang group: same semantics, older field shapes. Exists
+    so old clients keep working against a new server (decode +
+    default + convert up) and new objects stay readable by old
+    clients (convert down)."""
+
+    spec: PodGroupV1Beta1Spec = field(default_factory=PodGroupV1Beta1Spec)
+    #: Status shape did not change across versions.
+    status: dict = field(default_factory=dict)
+
+
+DEFAULT_SCHEME.register(CORE_V1BETA1, "PodGroup", PodGroupV1Beta1)
+
+
+def _default_podgroup_v1beta1(obj: PodGroupV1Beta1) -> None:
+    if obj.spec.members <= 0:
+        obj.spec.members = 1
+
+
+DEFAULT_SCHEME.add_defaulter(PodGroupV1Beta1, _default_podgroup_v1beta1)
+
+
+def _topology_to_shape(topology: str) -> list[int]:
+    if not topology:
+        return []
+    try:
+        return [int(x) for x in topology.lower().split("x")]
+    except ValueError:
+        from . import errors
+        raise errors.InvalidError(
+            f"spec.topology: must look like '2x2x2', got {topology!r}"
+        ) from None
+
+
+def _shape_to_topology(shape: list) -> str:
+    return "x".join(str(int(d)) for d in shape) if shape else ""
+
+
+def _podgroup_up(d: dict) -> dict:
+    """v1beta1 wire dict -> v1 wire dict (the hub)."""
+    out = {**d, "api_version": "core/v1"}
+    spec = dict(d.get("spec") or {})
+    members = spec.pop("members", 0) or 1  # beta defaulting
+    topology = spec.pop("topology", "")
+    spec["min_member"] = members
+    shape = _topology_to_shape(topology)
+    if shape:
+        spec["slice_shape"] = shape
+    out["spec"] = spec
+    return out
+
+
+def _podgroup_down(d: dict) -> dict:
+    """v1 wire dict -> v1beta1 wire dict."""
+    out = {**d, "api_version": CORE_V1BETA1}
+    spec = dict(d.get("spec") or {})
+    spec["members"] = spec.pop("min_member", 1)
+    shape = spec.pop("slice_shape", [])
+    topology = _shape_to_topology(shape)
+    if topology:
+        spec["topology"] = topology
+    out["spec"] = spec
+    return out
+
+
+register_conversion(CORE_V1BETA1, "PodGroup", _podgroup_up, _podgroup_down)

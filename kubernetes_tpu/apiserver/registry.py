@@ -418,6 +418,23 @@ class Registry:
             plural=names.plural, kind=names.kind, api_version=gv, cls=cls,
             namespaced=crd.spec.scope == ext.SCOPE_NAMESPACED,
             validate_create=ext.make_cr_validator(crd)))
+        # Multi-version serving (conversion strategy None): extra
+        # served versions get identity conversions to the storage
+        # version — decode/encode swap api_version only. Scoped to
+        # THIS registry's scheme; versions dropped by a CRD update are
+        # unregistered (operators must be able to retire a version).
+        # Reference: apiextensions served/storage version flags.
+        from ..api import versioning
+        prefix = f"{crd.spec.group}/"
+        wanted = {f"{crd.spec.group}/{v}" for v in crd.spec.served_versions
+                  if v != crd.spec.version}
+        for av in self.scheme.conversions_for_kind(names.kind):
+            if av.startswith(prefix) and av not in wanted:
+                self.scheme.unregister_conversion(av, names.kind)
+        for extra_gv in wanted:
+            self.scheme.register_conversion(
+                extra_gv, names.kind,
+                *versioning.identity_conversion(extra_gv, gv))
 
     def _check_crd_collision(self, crd: ext.CustomResourceDefinition) -> None:
         """Reject plural OR kind collisions with builtins and with other
@@ -456,6 +473,9 @@ class Registry:
         if self._by_kind.get(names.kind) is spec:
             self._by_kind.pop(names.kind, None)
         self.scheme.unregister(crd.api_version_str(), names.kind)
+        for extra in crd.spec.served_versions:
+            self.scheme.unregister_conversion(
+                f"{crd.spec.group}/{extra}", names.kind)
 
     def _release_ips(self, obj: TypedObject) -> None:
         """Return an object's IP/CIDR allocation on actual removal —

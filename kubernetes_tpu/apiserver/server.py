@@ -216,8 +216,11 @@ class APIServer:
                 version = request.match_info.get("version", "")
                 plural = request.match_info.get("plural", "")
                 spec = self.registry._by_plural.get(plural)
+                gv = f"{group}/{version}"
                 local = (spec is not None and
-                         spec.api_version == f"{group}/{version}")
+                         (spec.api_version == gv
+                          or self.registry.scheme.convertible(
+                              gv, spec.kind)))
                 if not local:
                     target = self._apiservice_target(group, version)
                     if target is not None:
@@ -449,9 +452,51 @@ class APIServer:
     def _err(e: errors.StatusError) -> web.Response:
         return web.json_response(e.to_dict(), status=e.code)
 
-    @staticmethod
-    def _obj_response(obj, status: int = 200) -> web.Response:
-        return web.json_response(to_dict(obj), status=status)
+    def _obj_response(self, obj, status: int = 200,
+                      convert: str = "") -> web.Response:
+        d = to_dict(obj)
+        if convert:
+            d = self.registry.scheme.from_hub(convert, obj.kind, d)
+        return web.json_response(d, status=status)
+
+    def _conv_version(self, request, spec) -> str:
+        """The served-but-not-stored version this request speaks, or ""
+        when it speaks the storage version. Conversion happens at the
+        server edge (reference: the apiserver decodes any served
+        version to the hub, stores one, and answers in kind)."""
+        group = request.match_info.get("group")
+        version = request.match_info.get("version")
+        if not group or not version:
+            return ""
+        rv = f"{group}/{version}"
+        if rv == spec.api_version:
+            return ""
+        if self.registry.scheme.convertible(rv, spec.kind):
+            return rv
+        return ""
+
+    def _body_to_hub(self, data: dict, rv: str, spec) -> dict:
+        """Versioned request body -> hub-versioned wire dict, applying
+        the VERSION'S OWN defaulting first (a beta default may differ
+        from the hub's). A body claiming a DIFFERENT version than the
+        URL is a 400 (reference behavior) — silently converting a
+        v1-shaped body "up" from v1beta1 would corrupt it."""
+        scheme = self.registry.scheme
+        body_av = data.get("api_version", "")
+        if body_av and body_av != rv:
+            raise errors.BadRequestError(
+                f"body api_version {body_av!r} does not match the "
+                f"request URL's {rv!r}")
+        data = dict(data)
+        data.setdefault("api_version", rv)
+        data.setdefault("kind", spec.kind)
+        try:
+            versioned = scheme.decode(data)
+            data = to_dict(versioned)
+            data["api_version"], data["kind"] = rv, spec.kind
+        except KeyError:
+            pass  # no class registered for this version: convert raw
+        return scheme.to_hub(rv, spec.kind, data)
 
     # -- routes -----------------------------------------------------------
 
@@ -826,6 +871,9 @@ class APIServer:
         plural, ns = self._ctx(request)
         spec = self.registry.spec_for(plural)
         data = await self._body_obj(request)
+        conv = self._conv_version(request, spec)
+        if conv:
+            data = self._body_to_hub(data, conv, spec)
         data.setdefault("api_version", spec.api_version)
         data.setdefault("kind", spec.kind)
         obj = self.registry.scheme.decode(data)
@@ -849,18 +897,28 @@ class APIServer:
         created = await self._mutate(self.registry.create, obj)
         if plural.endswith("webhookconfigurations"):
             self.webhooks.invalidate()
-        return self._obj_response(created, status=201)
+        return self._obj_response(created, status=201, convert=conv)
 
     async def _get(self, request):
         plural, ns = self._ctx(request)
+        spec = self.registry.spec_for(plural)
         obj = self.registry.get(plural, ns, request.match_info["name"])
-        return self._obj_response(obj)
+        return self._obj_response(
+            obj, convert=self._conv_version(request, spec))
 
     async def _list_or_watch(self, request):
         plural, ns = self._ctx(request)
         q = request.query
         if q.get("watch") in ("1", "true"):
             return await self._watch(request, plural, ns)
+        spec = self.registry.spec_for(plural)
+        conv = self._conv_version(request, spec)
+
+        def emit(o):
+            d = to_dict(o)
+            return (self.registry.scheme.from_hub(conv, spec.kind, d)
+                    if conv else d)
+
         limit = self._int_param(q.get("limit", "0") or "0", "limit")
         if limit or q.get("continue"):
             items, rev, cont = self.registry.list_page(
@@ -873,14 +931,14 @@ class APIServer:
             return web.json_response({
                 "kind": "List", "api_version": "core/v1",
                 "metadata": meta,
-                "items": [to_dict(o) for o in items],
+                "items": [emit(o) for o in items],
             })
         items, rev = self.registry.list(
             plural, ns, q.get("label_selector", ""), q.get("field_selector", ""))
         return web.json_response({
             "kind": "List", "api_version": "core/v1",
             "metadata": {"resource_version": str(rev)},
-            "items": [to_dict(o) for o in items],
+            "items": [emit(o) for o in items],
         })
 
     @staticmethod
@@ -915,6 +973,8 @@ class APIServer:
 
     async def _watch(self, request, plural: str, ns: str):
         q = request.query
+        spec = self.registry.spec_for(plural)
+        conv = self._conv_version(request, spec)
         start_rev = self._int_param(q.get("resource_version", "0") or "0",
                                     "resource_version")
         field_selector = q.get("field_selector", "")
@@ -948,13 +1008,29 @@ class APIServer:
                     etype, payload, rev, which = ev
                     if etype == "CLOSED":
                         break
-                    line = self._encode_watch_event(etype, payload, rev, which)
+                    if conv:
+                        # Versioned watcher: per-event conversion off
+                        # the shared encode cache (only THIS watcher
+                        # pays; storage-version watchers keep the
+                        # serialize-once fast path).
+                        obj = self.registry.scheme.from_hub(conv, spec.kind, {
+                            **payload,
+                            "metadata": {**(payload.get("metadata") or {}),
+                                         "resource_version": str(rev)}})
+                        line = (json.dumps({"type": etype, "object": obj})
+                                .encode() + b"\n")
+                    else:
+                        line = self._encode_watch_event(etype, payload, rev,
+                                                        which)
                 else:
                     etype, obj = ev
                     if etype == "CLOSED":
                         break
+                    d = to_dict(obj)
+                    if conv:
+                        d = self.registry.scheme.from_hub(conv, spec.kind, d)
                     line = (json.dumps(
-                        {"type": etype, "object": to_dict(obj)}).encode()
+                        {"type": etype, "object": d}).encode()
                         + b"\n")
                 await resp.write(line)
         except (ConnectionResetError, asyncio.CancelledError):
@@ -972,6 +1048,9 @@ class APIServer:
         if sub not in ("", "status"):
             raise errors.BadRequestError(f"unknown subresource {sub!r}")
         data = await self._body_obj(request)
+        conv = self._conv_version(request, spec)
+        if conv:
+            data = self._body_to_hub(data, conv, spec)
         data.setdefault("api_version", spec.api_version)
         data.setdefault("kind", spec.kind)
         obj = self.registry.scheme.decode(data)
@@ -997,15 +1076,47 @@ class APIServer:
         updated = await self._mutate(self.registry.update, obj, sub)
         if plural.endswith("webhookconfigurations"):
             self.webhooks.invalidate()
-        return self._obj_response(updated)
+        return self._obj_response(updated, convert=conv)
 
     async def _patch(self, request):
         plural, ns = self._ctx(request)
+        spec = self.registry.spec_for(plural)
         sub = request.match_info.get("subresource", "")
         name = request.match_info["name"]
         patch = await self._body_obj(request)
         from ..api.patch import STRATEGIC_MERGE_PATCH
         strategic = request.content_type == STRATEGIC_MERGE_PATCH
+        conv = self._conv_version(request, spec) if not sub else ""
+        if conv:
+            # A versioned PATCH merges in the VERSIONED field space
+            # (the reference patches the converted object): convert
+            # the current object down, merge, convert the result up,
+            # persist as a conflict-guarded full update.
+            scheme = self.registry.scheme
+            for attempt in range(10):
+                old_obj = self.registry.get(plural, ns, name)
+                down = scheme.from_hub(conv, spec.kind, to_dict(old_obj))
+                if strategic:
+                    from ..api.patch import strategic_merge
+                    try:
+                        vcls = scheme.class_for(conv, spec.kind)
+                    except KeyError:
+                        vcls = None  # CRD alternate version: no class
+                    merged = strategic_merge(down, patch, vcls)
+                else:
+                    from .registry import _json_merge
+                    merged = _json_merge(down, patch)
+                hub = self._body_to_hub(merged, conv, spec)
+                obj = scheme.decode(hub)
+                obj.metadata.resource_version = \
+                    old_obj.metadata.resource_version
+                try:
+                    updated = await self._mutate(
+                        self.registry.update, obj, sub)
+                    return self._obj_response(updated, convert=conv)
+                except errors.ConflictError:
+                    if attempt == 9:
+                        raise
         if not sub and self.webhooks.has_hooks("UPDATE", plural):
             # A patch is an UPDATE to webhooks (reference semantics —
             # otherwise PATCH would be a policy bypass): compute the
@@ -1044,6 +1155,8 @@ class APIServer:
     async def _delete(self, request):
         plural, ns = self._ctx(request)
         name = request.match_info["name"]
+        del_conv = self._conv_version(request,
+                                      self.registry.spec_for(plural))
         if self.webhooks.has_hooks("DELETE", plural):
             try:
                 old = to_dict(self.registry.get(plural, ns, name))
@@ -1059,7 +1172,7 @@ class APIServer:
             request.query.get("uid", ""))
         if plural.endswith("webhookconfigurations"):
             self.webhooks.invalidate()
-        return self._obj_response(obj)
+        return self._obj_response(obj, convert=del_conv)
 
     async def _delete_collection(self, request):
         plural, ns = self._ctx(request)

@@ -1,0 +1,212 @@
+"""API version evolution: v1beta1 PodGroup + multi-version CRDs.
+
+Reference: ``pkg/apis/`` external/internal types with conversion +
+defaulting per version — the machinery behind rolling upgrades. Here
+the proof instance is the gang API: an OLD client speaking
+``core/v1beta1 PodGroup`` (``members``/``topology``) works against the
+server while storage and new clients stay on v1
+(``min_member``/``slice_shape``), plus a CRD served at two versions.
+"""
+import asyncio
+
+import pytest
+
+from kubernetes_tpu.api import errors, extensions as ext, types as t
+from kubernetes_tpu.api import versioning
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.apiserver.registry import Registry
+from kubernetes_tpu.apiserver.server import APIServer
+
+import aiohttp
+
+
+def test_podgroup_conversion_round_trip():
+    beta = {"api_version": "core/v1beta1", "kind": "PodGroup",
+            "metadata": {"name": "g", "namespace": "default"},
+            "spec": {"members": 4, "topology": "2x2x2",
+                     "priority": 100},
+            "future_field": "preserved"}
+    hub = versioning.to_hub("core/v1beta1", "PodGroup", beta)
+    assert hub["api_version"] == "core/v1"
+    assert hub["spec"]["min_member"] == 4
+    assert hub["spec"]["slice_shape"] == [2, 2, 2]
+    assert hub["spec"]["priority"] == 100
+    assert hub["future_field"] == "preserved"  # unknown keys survive
+    down = versioning.from_hub("core/v1beta1", "PodGroup", hub)
+    assert down["spec"]["members"] == 4
+    assert down["spec"]["topology"] == "2x2x2"
+    assert down["api_version"] == "core/v1beta1"
+
+
+def test_bad_topology_is_invalid():
+    with pytest.raises(errors.InvalidError, match="topology"):
+        versioning.to_hub("core/v1beta1", "PodGroup",
+                          {"spec": {"topology": "not-a-shape"}})
+
+
+async def _server():
+    srv = APIServer()
+    srv.registry.create(t.Namespace(metadata=ObjectMeta(name="default")))
+    port = await srv.start()
+    return srv, f"http://127.0.0.1:{port}"
+
+
+async def test_old_client_new_server_round_trip():
+    """The wire proof: POST/GET/LIST/WATCH as v1beta1, store + serve v1."""
+    srv, base = await _server()
+    beta_url = f"{base}/api/core/v1beta1/namespaces/default/podgroups"
+    v1_url = f"{base}/api/core/v1/namespaces/default/podgroups"
+    try:
+        async with aiohttp.ClientSession() as s:
+            # Old client creates with the OLD field shapes.
+            r = await s.post(beta_url, json={
+                "kind": "PodGroup",
+                "metadata": {"name": "gang", "namespace": "default"},
+                "spec": {"members": 4, "topology": "2x2x2"}})
+            assert r.status == 201, await r.text()
+            body = await r.json()
+            # ...and gets the answer back in ITS version.
+            assert body["api_version"] == "core/v1beta1"
+            assert body["spec"]["members"] == 4
+            assert body["spec"]["topology"] == "2x2x2"
+            assert "min_member" not in body["spec"]
+
+            # STORED as the hub version.
+            stored = srv.registry.get("podgroups", "default", "gang")
+            assert stored.api_version == "core/v1"
+            assert stored.spec.min_member == 4
+            assert stored.spec.slice_shape == [2, 2, 2]
+
+            # New client reads v1 shapes at the v1 URL.
+            v1 = await (await s.get(f"{v1_url}/gang")).json()
+            assert v1["spec"]["min_member"] == 4
+            assert "members" not in v1["spec"]
+
+            # Old client lists + watches in its version.
+            lst = await (await s.get(beta_url)).json()
+            assert lst["items"][0]["spec"]["topology"] == "2x2x2"
+            rv = lst["metadata"]["resource_version"]
+            async with s.get(f"{beta_url}?watch=1&resource_version={rv}") as w:
+                r2 = await s.put(f"{beta_url}/gang", json={
+                    "kind": "PodGroup",
+                    "metadata": {"name": "gang", "namespace": "default",
+                                 "resource_version":
+                                     body["metadata"]["resource_version"]},
+                    "spec": {"members": 6, "topology": "2x2x2"}})
+                assert r2.status == 200, await r2.text()
+                import json as jsonlib
+                line = await asyncio.wait_for(w.content.readline(), 5)
+                ev = jsonlib.loads(line)
+                assert ev["type"] == "MODIFIED"
+                assert ev["object"]["spec"]["members"] == 6
+                assert ev["object"]["api_version"] == "core/v1beta1"
+
+            # Beta DEFAULTING applies on the beta wire: members omitted
+            # -> 1.
+            r = await s.post(beta_url, json={
+                "kind": "PodGroup",
+                "metadata": {"name": "g2", "namespace": "default"},
+                "spec": {}})
+            assert r.status == 201
+            assert srv.registry.get("podgroups", "default",
+                                    "g2").spec.min_member == 1
+    finally:
+        await srv.stop()
+
+
+async def test_crd_served_at_two_versions():
+    srv, base = await _server()
+    try:
+        srv.registry.create(ext.CustomResourceDefinition(
+            metadata=ObjectMeta(name="widgets.acme.io"),
+            spec=ext.CRDSpec(group="acme.io", version="v1",
+                             served_versions=["v1beta1"],
+                             names=ext.CRDNames(plural="widgets",
+                                                kind="Widget"))))
+        async with aiohttp.ClientSession() as s:
+            beta_url = (f"{base}/api/acme.io/v1beta1/namespaces/default"
+                        f"/widgets")
+            r = await s.post(beta_url, json={
+                "kind": "Widget",
+                "metadata": {"name": "w1", "namespace": "default"},
+                "spec": {"size": 3}})
+            assert r.status == 201, await r.text()
+            body = await r.json()
+            assert body["api_version"] == "acme.io/v1beta1"
+
+            # Stored + served at v1 too.
+            stored = srv.registry.get("widgets", "default", "w1")
+            assert stored.api_version == "acme.io/v1"
+            v1 = await (await s.get(
+                f"{base}/api/acme.io/v1/namespaces/default/widgets/w1")
+            ).json()
+            assert v1["api_version"] == "acme.io/v1"
+            assert v1["spec"]["size"] == 3
+            beta = await (await s.get(f"{beta_url}/w1")).json()
+            assert beta["api_version"] == "acme.io/v1beta1"
+    finally:
+        await srv.stop()
+
+
+async def test_versioned_patch_and_delete():
+    """PATCH merges in the VERSIONED field space; DELETE answers in the
+    request's version; a body claiming the wrong version is a 400."""
+    srv, base = await _server()
+    beta_url = f"{base}/api/core/v1beta1/namespaces/default/podgroups"
+    try:
+        async with aiohttp.ClientSession() as s:
+            r = await s.post(beta_url, json={
+                "kind": "PodGroup",
+                "metadata": {"name": "g", "namespace": "default"},
+                "spec": {"members": 2, "topology": "2x2x1"}})
+            assert r.status == 201, await r.text()
+
+            # Merge-patch against the beta shape.
+            r = await s.patch(f"{beta_url}/g",
+                              json={"spec": {"members": 5}},
+                              headers={"Content-Type":
+                                       "application/merge-patch+json"})
+            assert r.status == 200, await r.text()
+            body = await r.json()
+            assert body["api_version"] == "core/v1beta1"
+            assert body["spec"]["members"] == 5
+            assert body["spec"]["topology"] == "2x2x1"  # untouched
+            stored = srv.registry.get("podgroups", "default", "g")
+            assert stored.spec.min_member == 5
+            assert stored.spec.slice_shape == [2, 2, 1]
+
+            # Wrong-version body on the beta URL: 400, not corruption.
+            r = await s.post(beta_url, json={
+                "api_version": "core/v1", "kind": "PodGroup",
+                "metadata": {"name": "bad", "namespace": "default"},
+                "spec": {"min_member": 4}})
+            assert r.status == 400, await r.text()
+
+            # DELETE answers in the request's version.
+            r = await s.delete(f"{beta_url}/g")
+            assert r.status == 200
+            body = await r.json()
+            assert body["api_version"] == "core/v1beta1"
+            assert body["spec"]["members"] == 5
+    finally:
+        await srv.stop()
+
+
+async def test_versioned_paginated_list():
+    srv, base = await _server()
+    beta_url = f"{base}/api/core/v1beta1/namespaces/default/podgroups"
+    try:
+        async with aiohttp.ClientSession() as s:
+            for i in range(3):
+                r = await s.post(beta_url, json={
+                    "kind": "PodGroup",
+                    "metadata": {"name": f"g{i}", "namespace": "default"},
+                    "spec": {"members": i + 1}})
+                assert r.status == 201
+            page = await (await s.get(f"{beta_url}?limit=2")).json()
+            assert len(page["items"]) == 2
+            for item in page["items"]:
+                assert "members" in item["spec"], item
+                assert "min_member" not in item["spec"]
+    finally:
+        await srv.stop()
