@@ -266,46 +266,11 @@ async def cmd_delete(args) -> int:
         await client.close()
 
 
-def _ssl_kw(ssl_ctx) -> dict:
-    """aiohttp request kwargs for an optional TLS context."""
-    return {"ssl": ssl_ctx} if ssl_ctx is not None else {}
-
-
-async def _node_daemon_base(client: RESTClient,
-                            node_name: str) -> Optional[tuple[str, Any]]:
-    """Resolve a node's agent server from DaemonEndpoints: (base URL,
-    ssl context or None). ``agent_tls`` in the endpoints means the node
-    serves HTTPS requiring a cluster client cert (kubelet :10250
-    model) — the apiserver credentials double as that identity."""
-    node = await client.get("nodes", "", node_name)
-    port = node.status.daemon_endpoints.get("agent")
-    if not port:
-        return None
-    tls = bool(node.status.daemon_endpoints.get("agent_tls"))
-    ssl_ctx = client.ssl_context if tls else None
-    if tls and ssl_ctx is None:
-        # Unreachable-for-us, not fatal: per-node callers (ktl top
-        # iterates every node) must keep going.
-        print(f"ktl: node {node_name} requires TLS but no cluster "
-              "CA/client cert is configured", file=sys.stderr)
-        return None
-    scheme = "https" if tls else "http"
-    addr = node.status.addresses[0].address if node.status.addresses else ""
-    import aiohttp
-    for host in (addr, "127.0.0.1"):
-        if not host:
-            continue
-        base = f"{scheme}://{host}:{port}"
-        try:
-            async with aiohttp.ClientSession() as s:
-                async with s.get(f"{base}/healthz",
-                                 timeout=aiohttp.ClientTimeout(total=2),
-                                 **_ssl_kw(ssl_ctx)) as r:
-                    if r.status == 200:
-                        return base, ssl_ctx
-        except Exception:  # noqa: BLE001 — unresolvable hostname etc.
-            continue
-    return None
+# Node agent resolution is shared with every other node-server
+# consumer (HPA scraping etc.) — client/nodeaccess.py is the one
+# implementation of the DaemonEndpoints protocol.
+from ..client.nodeaccess import resolve_node_agent as _node_daemon_base  # noqa: E402
+from ..client.nodeaccess import ssl_kw as _ssl_kw  # noqa: E402
 
 
 async def cmd_logs(args) -> int:

@@ -1,0 +1,67 @@
+"""Resolving a node's agent server (the kubelet :10250 analog).
+
+ONE implementation of the DaemonEndpoints protocol — scheme from
+``agent_tls``, address candidates (published address, then loopback),
+credentials policy — shared by every consumer (``ktl logs/exec/top``,
+the HPA metrics scraper). A TLS node with no cluster credentials is
+REFUSED, never scraped over an unverified channel: fabricated metrics
+or logs from a man-in-the-middle are worse than none.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Any, Optional
+
+from ..api import errors
+
+log = logging.getLogger("nodeaccess")
+
+
+def ssl_kw(ssl_ctx) -> dict:
+    """aiohttp request kwargs for an optional TLS context."""
+    return {"ssl": ssl_ctx} if ssl_ctx is not None else {}
+
+
+async def resolve_node_agent(client, node_name: str,
+                             probe: bool = True
+                             ) -> Optional[tuple[str, Any]]:
+    """(base URL, ssl context or None) for the node's agent server, or
+    None when unreachable/unresolvable. ``client`` supplies both the
+    Node object and (for TLS nodes) its own credentials
+    (``client.ssl_context``). ``probe=False`` skips the /healthz
+    reachability check (callers that tolerate a failing first
+    request)."""
+    try:
+        node = await client.get("nodes", "", node_name)
+    except errors.StatusError:
+        return None
+    port = node.status.daemon_endpoints.get("agent")
+    if not port:
+        return None
+    tls = bool(node.status.daemon_endpoints.get("agent_tls"))
+    ssl_ctx = getattr(client, "ssl_context", None) if tls else None
+    if tls and ssl_ctx is None:
+        log.warning("node %s requires TLS but no cluster CA/client "
+                    "credentials are configured; refusing to connect "
+                    "unverified", node_name)
+        return None
+    scheme = "https" if tls else "http"
+    addr = (node.status.addresses[0].address
+            if node.status.addresses else "")
+    import aiohttp
+    for host in (addr, "127.0.0.1"):
+        if not host:
+            continue
+        base = f"{scheme}://{host}:{port}"
+        if not probe:
+            return base, ssl_ctx
+        try:
+            async with aiohttp.ClientSession() as s:
+                async with s.get(f"{base}/healthz",
+                                 timeout=aiohttp.ClientTimeout(total=2),
+                                 **ssl_kw(ssl_ctx)) as r:
+                    if r.status == 200:
+                        return base, ssl_ctx
+        except Exception:  # noqa: BLE001 — unresolvable hostname etc.
+            continue
+    return None

@@ -195,7 +195,18 @@ class LocalCluster:
 
         self.scheduler = Scheduler(local)
         await self.scheduler.start()
-        self.controller_manager = ControllerManager(local)
+        scrape_ssl = None
+        if self.ca is not None:
+            # The HPA's real metrics pipeline scrapes TLS node servers
+            # with the cluster admin identity (check_hostname off: node
+            # serving certs are dialed by published address with a
+            # loopback fallback; trust is the CA chain + client cert).
+            from ..apiserver.certs import client_ssl_context
+            scrape_ssl = client_ssl_context(
+                self.ca.ca_cert_path, self.admin_cert.cert_path,
+                self.admin_cert.key_path, check_hostname=False)
+        self.controller_manager = ControllerManager(
+            local, node_scrape_ssl=scrape_ssl)
         await self.controller_manager.start()
 
         # Cluster DNS (kube-dns addon analog): A records for services +

@@ -7,15 +7,19 @@ utilization, compute
     desired = ceil(current * currentUtilization / targetUtilization)
 
 clamp to [min, max], and write the target's replicas. The reference
-reads heapster; here the metrics source is pluggable — the default
-reads the node agents' reported per-pod usage from a pod annotation
-(``metrics.tpu/cpu-utilization-percent``), and the libtpu metrics
-pipeline can swap in a real source.
+reads heapster; here the DEFAULT source is the real pipeline — the
+node agents' ``/stats/summary`` scraped through DaemonEndpoints, the
+same path ``ktl top`` uses — with utilization derived as
+rate(cpu_seconds) over the pod's requested cores. The annotation
+source (``metrics.tpu/cpu-utilization-percent``) remains for tests
+and simulations.
 """
 from __future__ import annotations
 
+import inspect
 import math
-from typing import Callable, Optional
+import time
+from typing import Awaitable, Callable, Optional, Union
 
 from ..api import errors
 from ..api import types as t
@@ -32,10 +36,14 @@ UTIL_ANNOTATION = "metrics.tpu/cpu-utilization-percent"
 #: (reference: --horizontal-pod-autoscaler-tolerance, 0.1).
 TOLERANCE = 0.1
 
-MetricsSource = Callable[[t.Pod], Optional[float]]
+#: Sync (annotation/tests) or async (real scrape) per-pod utilization%.
+MetricsSource = Callable[[t.Pod],
+                         Union[Optional[float],
+                               Awaitable[Optional[float]]]]
 
 
 def annotation_metrics(pod: t.Pod) -> Optional[float]:
+    """Test/simulation source: utilization% from a pod annotation."""
     raw = pod.metadata.annotations.get(UTIL_ANNOTATION)
     try:
         return float(raw) if raw is not None else None
@@ -43,14 +51,113 @@ def annotation_metrics(pod: t.Pod) -> Optional[float]:
         return None
 
 
+class SummaryMetricsSource:
+    """The real pipeline: per-pod cpu_seconds from each node agent's
+    ``/stats/summary`` (found via Node.status.daemon_endpoints — the
+    ``ktl top`` path), utilization% = Δcpu_seconds/Δwall over the
+    pod's requested cores. Needs two samples before it reports (rate,
+    not level); node scrapes are cached ``ttl`` seconds so N pods on
+    one node cost one GET per sync wave.
+
+    ``ssl_context``: cluster credentials for TLS node servers; when
+    absent, ``client.ssl_context`` is used, and a TLS node with NO
+    credentials is refused (nodeaccess policy) — fabricated metrics
+    from an unverified channel are worse than none.
+    """
+
+    def __init__(self, client: Client, ssl_context=None, ttl: float = 10.0):
+        self.client = client
+        if ssl_context is not None and \
+                getattr(client, "ssl_context", None) is None:
+            # nodeaccess reads credentials off the client; carry the
+            # explicitly-supplied context for clients without one.
+            client = _ClientWithSSL(client, ssl_context)
+            self.client = client
+        self.ttl = ttl
+        #: node name -> (scrape monotonic ts, {pod uid: cpu_seconds})
+        self._scrapes: dict[str, tuple[float, dict]] = {}
+        #: pod uid -> (sample scrape ts, cpu_seconds) previous sample —
+        #: keyed by the SCRAPE timestamp, so a re-read inside the cache
+        #: TTL yields "no new sample" (None), never a spurious 0% rate.
+        self._prev: dict[str, tuple[float, float]] = {}
+
+    async def _node_pods_cpu(self, node_name: str) -> tuple[float, dict]:
+        cached = self._scrapes.get(node_name)
+        if cached is not None and time.monotonic() - cached[0] < self.ttl:
+            return cached
+        from ..client.nodeaccess import resolve_node_agent, ssl_kw
+        usage: dict[str, float] = {}
+        conn = await resolve_node_agent(self.client, node_name,
+                                        probe=False)
+        if conn is not None:
+            base, ssl_ctx = conn
+            import aiohttp
+            try:
+                async with aiohttp.ClientSession() as s:
+                    async with s.get(f"{base}/stats/summary",
+                                     timeout=aiohttp.ClientTimeout(total=3),
+                                     **ssl_kw(ssl_ctx)) as r:
+                        if r.status == 200:
+                            summary = await r.json()
+                            for p in summary.get("pods", []):
+                                usage[p["pod"]["uid"]] = float(
+                                    p.get("cpu_seconds", 0.0))
+            except Exception:  # noqa: BLE001 — node unreachable: no samples
+                pass
+        entry = (time.monotonic(), usage)
+        self._scrapes[node_name] = entry
+        # Prune rate state for pods that no longer exist anywhere we
+        # scrape — long-running managers must not leak one entry per
+        # pod uid ever seen.
+        if len(self._prev) > 4096:
+            live = {uid for _, u in self._scrapes.values() for uid in u}
+            for uid in [u for u in self._prev if u not in live]:
+                del self._prev[uid]
+        return entry
+
+    async def __call__(self, pod: t.Pod) -> Optional[float]:
+        if not pod.spec.node_name:
+            return None
+        requested = t.pod_resource_requests(pod).get(t.RESOURCE_CPU, 0.0)
+        if requested <= 0:
+            return None  # reference: no request, no utilization%
+        scrape_ts, usage = await self._node_pods_cpu(pod.spec.node_name)
+        cpu_s = usage.get(pod.metadata.uid)
+        if cpu_s is None:
+            return None
+        prev = self._prev.get(pod.metadata.uid)
+        if prev is not None and prev[0] == scrape_ts:
+            return None  # same sample as last time: no rate yet
+        self._prev[pod.metadata.uid] = (scrape_ts, cpu_s)
+        if prev is None or scrape_ts - prev[0] <= 0:
+            return None  # first sample: a rate needs two points
+        rate = max(0.0, cpu_s - prev[1]) / (scrape_ts - prev[0])
+        return 100.0 * rate / requested
+
+
+class _ClientWithSSL:
+    """Wrap a Client with an explicit ssl_context attribute for
+    nodeaccess (LocalClient has none; the composer supplies creds)."""
+
+    def __init__(self, inner, ssl_context):
+        self._inner = inner
+        self.ssl_context = ssl_context
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
 class HorizontalPodAutoscalerController(Controller):
     name = "horizontal-pod-autoscaler"
 
     def __init__(self, client: Client, factory: InformerFactory,
-                 metrics: MetricsSource = annotation_metrics,
+                 metrics: Optional[MetricsSource] = None,
                  sync_period: float = 15.0):
         super().__init__(client, factory, workers=1)
-        self.metrics = metrics
+        #: Default: the REAL pipeline (node /stats/summary). Pass
+        #: ``annotation_metrics`` for tests/simulations.
+        self.metrics = metrics or SummaryMetricsSource(
+            client, ssl_context=getattr(client, "ssl_context", None))
         self.sync_period = sync_period
         self.hpa_informer = self.watch("horizontalpodautoscalers")
         self.pod_informer = self.watch("pods")
@@ -86,6 +193,8 @@ class HorizontalPodAutoscalerController(Controller):
                 continue
             matched += 1
             u = self.metrics(pod)
+            if inspect.isawaitable(u):  # async source (real scrape)
+                u = await u
             if u is not None:
                 utils.append(u)
         if not utils or current == 0:

@@ -64,8 +64,12 @@ DEFAULT_CONTROLLERS: dict[str, Callable[[Client, InformerFactory], Controller]] 
 
 class ControllerManager:
     def __init__(self, client: Client, controllers: Optional[list[str]] = None,
-                 leader_elect: bool = False, identity: str = ""):
+                 leader_elect: bool = False, identity: str = "",
+                 node_scrape_ssl=None):
         self.client = client
+        #: Cluster credentials for scraping TLS node servers (the HPA's
+        #: real metrics pipeline); the composer wires CA + identity.
+        self.node_scrape_ssl = node_scrape_ssl
         self.names = list(controllers or DEFAULT_CONTROLLERS)
         self.leader_elect = leader_elect
         self.identity = identity or f"cm-{uuid.uuid4().hex[:8]}"
@@ -78,8 +82,18 @@ class ControllerManager:
         """Build fresh controllers + informers (a re-elected manager must
         relist, not trust caches from a previous term)."""
         self.factory = InformerFactory(self.client)
-        self.controllers = [DEFAULT_CONTROLLERS[name](self.client, self.factory)
-                            for name in self.names]
+        self.controllers = []
+        for name in self.names:
+            cls = DEFAULT_CONTROLLERS[name]
+            if name == "horizontal-pod-autoscaler" \
+                    and self.node_scrape_ssl is not None:
+                from .hpa import SummaryMetricsSource
+                self.controllers.append(cls(
+                    self.client, self.factory,
+                    metrics=SummaryMetricsSource(
+                        self.client, ssl_context=self.node_scrape_ssl)))
+            else:
+                self.controllers.append(cls(self.client, self.factory))
         for c in self.controllers:
             await c.start()
         log.info("controller-manager: %d controllers running",

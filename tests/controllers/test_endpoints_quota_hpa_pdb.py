@@ -7,7 +7,7 @@ from kubernetes_tpu.api.meta import ObjectMeta
 from kubernetes_tpu.api.selectors import LabelSelector
 from kubernetes_tpu.controllers.disruption import DisruptionController
 from kubernetes_tpu.controllers.endpoints import EndpointsController
-from kubernetes_tpu.controllers.hpa import (UTIL_ANNOTATION,
+from kubernetes_tpu.controllers.hpa import (UTIL_ANNOTATION, annotation_metrics,
                                             HorizontalPodAutoscalerController)
 from kubernetes_tpu.controllers.resourcequota import ResourceQuotaController
 
@@ -109,7 +109,7 @@ async def test_hpa_scales_deployment_up():
                 kind="Deployment", name="web"),
             min_replicas=1, max_replicas=5,
             target_cpu_utilization_percentage=80)))
-    ctrl = HorizontalPodAutoscalerController(client, factory, sync_period=0.1)
+    ctrl = HorizontalPodAutoscalerController(client, factory, metrics=annotation_metrics, sync_period=0.1)
     await ctrl.start()
     try:
         def scaled():
@@ -144,7 +144,7 @@ async def test_hpa_missing_metrics_damps_scale_down():
                 kind="Deployment", name="web"),
             min_replicas=1, max_replicas=8,
             target_cpu_utilization_percentage=80)))
-    ctrl = HorizontalPodAutoscalerController(client, factory, sync_period=0.1)
+    ctrl = HorizontalPodAutoscalerController(client, factory, metrics=annotation_metrics, sync_period=0.1)
     await ctrl.start()
     try:
         # folded ratio = (40+40+80+80)/(4*80) = 0.75 -> desired 3, not 2.

@@ -1,0 +1,84 @@
+"""HPA on the REAL metrics pipeline (r3 verdict item 9).
+
+The default MetricsSource scrapes the node agents' /stats/summary
+(the ktl top path) and derives utilization from rate(cpu_seconds)
+over requested cores — here proven end-to-end: a deployment of
+genuinely CPU-burning processes is observed and scaled up, with no
+annotations anywhere.
+"""
+import asyncio
+import sys
+
+from kubernetes_tpu.api import types as t, workloads as w
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.api.selectors import LabelSelector
+from kubernetes_tpu.client.informer import InformerFactory
+from kubernetes_tpu.cluster.local import LocalCluster, NodeSpec
+from kubernetes_tpu.controllers.hpa import (
+    HorizontalPodAutoscalerController, SummaryMetricsSource)
+
+BURN = ("import time\n"
+        "end = time.time() + 120\n"
+        "while time.time() < end:\n"
+        "    sum(i * i for i in range(10000))\n")
+
+
+async def test_hpa_scales_on_observed_cpu(tmp_path):
+    cluster = LocalCluster(data_dir=str(tmp_path),
+                           nodes=[NodeSpec(name="n0")],
+                           status_interval=0.3, heartbeat_interval=0.3)
+    await cluster.start()
+    client = cluster.make_client()
+    local = cluster.local_client()
+    factory = InformerFactory(local)
+    # Real scrape source, tight cadence for the test.
+    ctrl = HorizontalPodAutoscalerController(
+        local, factory,
+        metrics=SummaryMetricsSource(local, ssl_context=client.ssl_context,
+                                     ttl=0.5),
+        sync_period=0.5)
+    await ctrl.start()
+    try:
+        await cluster.wait_for_nodes_ready(timeout=20)
+        dep = w.Deployment(
+            metadata=ObjectMeta(name="burner", namespace="default"),
+            spec=w.DeploymentSpec(
+                replicas=1,
+                selector=LabelSelector(match_labels={"app": "burn"}),
+                template=t.PodTemplateSpec(
+                    metadata=ObjectMeta(labels={"app": "burn"}),
+                    spec=t.PodSpec(containers=[t.Container(
+                        name="c", image="inline",
+                        command=[sys.executable, "-c", BURN],
+                        resources=t.ResourceRequirements(
+                            requests={"cpu": 0.05}))]))))
+        await client.create(dep)
+        await client.create(w.HorizontalPodAutoscaler(
+            metadata=ObjectMeta(name="burner", namespace="default"),
+            spec=w.HorizontalPodAutoscalerSpec(
+                scale_target_ref=t.ObjectReference(kind="Deployment",
+                                                   name="burner"),
+                min_replicas=1, max_replicas=3,
+                target_cpu_utilization_percentage=50)))
+
+        # A 100%-core burner against a 0.05-core request is ~2000%
+        # utilization: the controller must observe it from the real
+        # stats pipeline and scale up.
+        scaled = None
+        for _ in range(200):
+            cur = await client.get("deployments", "default", "burner")
+            if cur.spec.replicas > 1:
+                scaled = cur.spec.replicas
+                break
+            await asyncio.sleep(0.2)
+        assert scaled and scaled > 1, "HPA never scaled on observed usage"
+        # Status reflects the pipeline (exact % races later sync waves
+        # that include freshly-started replicas).
+        hpa = await client.get("horizontalpodautoscalers", "default",
+                               "burner")
+        assert hpa.status.desired_replicas >= 2, hpa.status
+    finally:
+        await ctrl.stop()
+        await factory.stop_all()
+        await client.close()
+        await cluster.stop()
