@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# Soak tier (reference: test/soak/ + test/e2e/lifecycle): sustained
+# churn with invariant checks and an upgrade-under-load exercise.
+#
+#   KTPU_SOAK_SECONDS=300 hack/soak.sh     # longer soak (default 60s)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+exec python -m pytest tests/e2e/test_soak.py -q -m slow "$@"

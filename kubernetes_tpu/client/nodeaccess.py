@@ -22,15 +22,14 @@ def ssl_kw(ssl_ctx) -> dict:
     return {"ssl": ssl_ctx} if ssl_ctx is not None else {}
 
 
-async def resolve_node_agent(client, node_name: str,
-                             probe: bool = True
+async def resolve_node_agent(client, node_name: str
                              ) -> Optional[tuple[str, Any]]:
     """(base URL, ssl context or None) for the node's agent server, or
     None when unreachable/unresolvable. ``client`` supplies both the
     Node object and (for TLS nodes) its own credentials
-    (``client.ssl_context``). ``probe=False`` skips the /healthz
-    reachability check (callers that tolerate a failing first
-    request)."""
+    (``client.ssl_context``). Candidates are PROBED (/healthz) so the
+    loopback fallback actually engages when the published address is
+    unreachable — a cheap GET that every consumer needs anyway."""
     try:
         node = await client.get("nodes", "", node_name)
     except errors.StatusError:
@@ -53,8 +52,6 @@ async def resolve_node_agent(client, node_name: str,
         if not host:
             continue
         base = f"{scheme}://{host}:{port}"
-        if not probe:
-            return base, ssl_ctx
         try:
             async with aiohttp.ClientSession() as s:
                 async with s.get(f"{base}/healthz",

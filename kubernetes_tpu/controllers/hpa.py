@@ -67,10 +67,10 @@ class SummaryMetricsSource:
 
     def __init__(self, client: Client, ssl_context=None, ttl: float = 10.0):
         self.client = client
-        if ssl_context is not None and \
-                getattr(client, "ssl_context", None) is None:
-            # nodeaccess reads credentials off the client; carry the
-            # explicitly-supplied context for clients without one.
+        if ssl_context is not None:
+            # nodeaccess reads credentials off the client; an
+            # EXPLICIT context always wins (the composer builds it for
+            # node-serving-cert specifics, e.g. hostname policy).
             client = _ClientWithSSL(client, ssl_context)
             self.client = client
         self.ttl = ttl
@@ -87,8 +87,7 @@ class SummaryMetricsSource:
             return cached
         from ..client.nodeaccess import resolve_node_agent, ssl_kw
         usage: dict[str, float] = {}
-        conn = await resolve_node_agent(self.client, node_name,
-                                        probe=False)
+        conn = await resolve_node_agent(self.client, node_name)
         if conn is not None:
             base, ssl_ctx = conn
             import aiohttp
@@ -106,9 +105,14 @@ class SummaryMetricsSource:
                 pass
         entry = (time.monotonic(), usage)
         self._scrapes[node_name] = entry
-        # Prune rate state for pods that no longer exist anywhere we
-        # scrape — long-running managers must not leak one entry per
-        # pod uid ever seen.
+        # Prune: stale node scrapes first (departed nodes must not pin
+        # their dead pods as "live"), then rate state for pods absent
+        # from every fresh scrape — long-running managers must not
+        # leak one entry per pod uid ever seen.
+        now_m = time.monotonic()
+        for name in [n for n, (ts, _) in self._scrapes.items()
+                     if now_m - ts > 5 * self.ttl]:
+            del self._scrapes[name]
         if len(self._prev) > 4096:
             live = {uid for _, u in self._scrapes.values() for uid in u}
             for uid in [u for u in self._prev if u not in live]:
@@ -131,7 +135,12 @@ class SummaryMetricsSource:
         self._prev[pod.metadata.uid] = (scrape_ts, cpu_s)
         if prev is None or scrape_ts - prev[0] <= 0:
             return None  # first sample: a rate needs two points
-        rate = max(0.0, cpu_s - prev[1]) / (scrape_ts - prev[0])
+        if cpu_s < prev[1]:
+            # Counter RESET (agent/container restart): a fabricated 0%
+            # would read as a real measurement and could scale down a
+            # busy workload — report "no sample" instead.
+            return None
+        rate = (cpu_s - prev[1]) / (scrape_ts - prev[0])
         return 100.0 * rate / requested
 
 

@@ -78,22 +78,24 @@ class ControllerManager:
         self._run_task: Optional[asyncio.Task] = None
         self._elector: Optional[LeaderElector] = None
 
+    def _ctor_kwargs(self, name: str) -> dict:
+        """Composer-supplied per-controller configuration; keeps the
+        construction loop uniform."""
+        if name == "horizontal-pod-autoscaler" \
+                and self.node_scrape_ssl is not None:
+            from .hpa import SummaryMetricsSource
+            return {"metrics": SummaryMetricsSource(
+                self.client, ssl_context=self.node_scrape_ssl)}
+        return {}
+
     async def _run_controllers(self) -> None:
         """Build fresh controllers + informers (a re-elected manager must
         relist, not trust caches from a previous term)."""
         self.factory = InformerFactory(self.client)
-        self.controllers = []
-        for name in self.names:
-            cls = DEFAULT_CONTROLLERS[name]
-            if name == "horizontal-pod-autoscaler" \
-                    and self.node_scrape_ssl is not None:
-                from .hpa import SummaryMetricsSource
-                self.controllers.append(cls(
-                    self.client, self.factory,
-                    metrics=SummaryMetricsSource(
-                        self.client, ssl_context=self.node_scrape_ssl)))
-            else:
-                self.controllers.append(cls(self.client, self.factory))
+        self.controllers = [
+            DEFAULT_CONTROLLERS[name](self.client, self.factory,
+                                      **self._ctor_kwargs(name))
+            for name in self.names]
         for c in self.controllers:
             await c.start()
         log.info("controller-manager: %d controllers running",

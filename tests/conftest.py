@@ -32,6 +32,12 @@ def pytest_pyfunc_call(pyfuncitem):
     fn = pyfuncitem.obj
     if inspect.iscoroutinefunction(fn):
         kwargs = {k: pyfuncitem.funcargs[k] for k in pyfuncitem._fixtureinfo.argnames}
-        asyncio.run(asyncio.wait_for(fn(**kwargs), timeout=120))
+        # slow-marked tests (soak tier) size their own budget: the
+        # churn duration is operator-set via KTPU_SOAK_SECONDS.
+        timeout = 120.0
+        if pyfuncitem.get_closest_marker("slow") is not None:
+            soak = float(os.environ.get("KTPU_SOAK_SECONDS", "60"))
+            timeout = max(timeout, 2 * soak + 180)
+        asyncio.run(asyncio.wait_for(fn(**kwargs), timeout=timeout))
         return True
     return None
