@@ -49,6 +49,9 @@ class ResourceSpec:
     #: node agent both creates the object and owns its status, and test
     #: rigs (kubemark) seed capacity the same way.
     preserve_status_on_create: bool = False
+    #: RBAC-style names ("system:node") are path segments, not
+    #: DNS-1123 labels (validation.go ValidatePathSegmentName).
+    path_segment_name: bool = False
 
 
 def _pod_fields(pod: t.Pod) -> dict:
@@ -152,13 +155,16 @@ def builtin_resources() -> list[ResourceSpec]:
                      "autoscaling/v1", w.HorizontalPodAutoscaler),
         ResourceSpec("poddisruptionbudgets", "PodDisruptionBudget", "policy/v1",
                      w.PodDisruptionBudget),
-        ResourceSpec("roles", "Role", r.RBAC_V1, r.Role, has_status=False),
+        ResourceSpec("roles", "Role", r.RBAC_V1, r.Role, has_status=False,
+                     path_segment_name=True),
         ResourceSpec("clusterroles", "ClusterRole", r.RBAC_V1, r.ClusterRole,
-                     namespaced=False, has_status=False),
+                     namespaced=False, has_status=False,
+                     path_segment_name=True),
         ResourceSpec("rolebindings", "RoleBinding", r.RBAC_V1, r.RoleBinding,
-                     has_status=False),
+                     has_status=False, path_segment_name=True),
         ResourceSpec("clusterrolebindings", "ClusterRoleBinding", r.RBAC_V1,
-                     r.ClusterRoleBinding, namespaced=False, has_status=False),
+                     r.ClusterRoleBinding, namespaced=False, has_status=False,
+                     path_segment_name=True),
         ResourceSpec("serviceaccounts", "ServiceAccount", core,
                      t.ServiceAccount, has_status=False),
         ResourceSpec("persistentvolumes", "PersistentVolume", core,
@@ -287,6 +293,11 @@ class Registry:
         if self.admission is not None:
             obj = self.admission.admit("CREATE", spec, obj, None,
                                        dry_run=dry_run)
+        # Generic meta validation on EVERY kind (reference:
+        # ValidateObjectMeta), AFTER mutating admission — metadata a
+        # plugin rewrites must not bypass the checks.
+        val.validate_meta_generic(obj.metadata, spec.namespaced,
+                                  spec.path_segment_name)
         if spec.validate_create:
             spec.validate_create(obj)
         if dry_run:
@@ -583,6 +594,9 @@ class Registry:
         webhooks the post-in-tree-admission object.
         """
         spec = self.spec_for_kind(obj.kind or type(obj).__name__)
+        if subresource == "status" and not spec.has_status:
+            raise errors.MethodNotAllowedError(
+                f"{spec.kind} has no status subresource")
         meta = obj.metadata
         key = self._key(spec, meta.namespace, meta.name)
         stored = self.store.get(key, copy=False)
@@ -614,6 +628,8 @@ class Registry:
             if self.admission is not None:
                 new = self.admission.admit("UPDATE", spec, new, old,
                                            dry_run=dry_run)
+            val.validate_meta_generic(new.metadata, spec.namespaced,
+                                      spec.path_segment_name)
             if spec.validate_update:
                 spec.validate_update(new, old)
             elif spec.validate_create:

@@ -119,11 +119,18 @@ def test_crud_conformance(spec):
 
 @pytest.mark.parametrize("spec", CASES, ids=lambda s: s.plural)
 def test_status_subresource_isolation(spec):
-    if not spec.has_status:
-        pytest.skip("no status subresource")
     reg = Registry()
     reg.create(t.Namespace(metadata=ObjectMeta(name="default")))
     created = reg.create(minimal_object(spec))
+    if not spec.has_status:
+        # Not a skip: kinds WITHOUT a status subresource must REJECT
+        # /status writes (405) instead of silently treating them as
+        # full updates — the closed half of the r3 conformance gap.
+        with pytest.raises(errors.MethodNotAllowedError):
+            reg.update(reg.get(spec.plural, created.metadata.namespace,
+                               created.metadata.name),
+                       subresource="status")
+        return
     # A spec/meta update must not alter status; /status must not alter
     # labels. Generic: set a label via update, then write status and
     # confirm the label survived.

@@ -94,3 +94,58 @@ def test_podgroup_validation():
     pg.spec.min_member = 4
     pg.spec.slice_shape = [2, 2, 1]
     validation.validate_podgroup(pg)
+
+
+def test_volume_cross_refs_and_sources():
+    import pytest
+    from kubernetes_tpu.api import errors, types as t, validation as val
+    from kubernetes_tpu.api.meta import ObjectMeta
+
+    def pod(volumes, mounts):
+        return t.Pod(metadata=ObjectMeta(name="p", namespace="default"),
+                     spec=t.PodSpec(
+                         volumes=volumes,
+                         containers=[t.Container(name="c", image="i",
+                                                 volume_mounts=mounts)]))
+
+    # Mount referencing an undeclared volume.
+    with pytest.raises(errors.InvalidError, match="no spec.volumes"):
+        val.validate_pod(pod([], [t.VolumeMount(name="ghost",
+                                                mount_path="/x")]))
+    # Duplicate volume names.
+    with pytest.raises(errors.InvalidError, match="duplicate volume"):
+        val.validate_pod(pod(
+            [t.Volume(name="v", empty_dir=t.EmptyDirVolume()),
+             t.Volume(name="v", empty_dir=t.EmptyDirVolume())], []))
+    # More than one source.
+    with pytest.raises(errors.InvalidError, match="more than one"):
+        val.validate_pod(pod(
+            [t.Volume(name="v", empty_dir=t.EmptyDirVolume(),
+                      host_path=t.HostPathVolume(path="/tmp"))], []))
+    # Valid cross-ref passes.
+    val.validate_pod(pod(
+        [t.Volume(name="v", empty_dir=t.EmptyDirVolume())],
+        [t.VolumeMount(name="v", mount_path="/x")]))
+
+
+def test_generic_meta_validation_everywhere():
+    import pytest
+    from kubernetes_tpu.api import errors, types as t
+    from kubernetes_tpu.api.meta import ObjectMeta
+    from kubernetes_tpu.apiserver.registry import Registry
+
+    reg = Registry()
+    reg.create(t.Namespace(metadata=ObjectMeta(name="default")))
+    # A kind with NO bespoke validator still gets label-charset checks.
+    with pytest.raises(errors.InvalidError, match="label"):
+        reg.create(t.ConfigMap(metadata=ObjectMeta(
+            name="cm", namespace="default",
+            labels={"bad key!": "x"})))
+    with pytest.raises(errors.InvalidError, match="DNS-1123"):
+        reg.create(t.ConfigMap(metadata=ObjectMeta(
+            name="Bad_Name", namespace="default")))
+    # RBAC names are path segments: colons are legal, slashes not.
+    from kubernetes_tpu.api import rbac
+    reg.create(rbac.ClusterRole(metadata=ObjectMeta(name="system:mine")))
+    with pytest.raises(errors.InvalidError, match="'/'"):
+        reg.create(rbac.ClusterRole(metadata=ObjectMeta(name="a/b")))
