@@ -1,0 +1,154 @@
+"""CNI plugin seam — out-of-process pod network setup.
+
+Reference: the Container Network Interface the kubelet drives through
+``pkg/kubelet/network/cni`` — plugins are EXECUTABLES, invoked with
+``CNI_COMMAND=ADD|DEL``, ``CNI_CONTAINERID``, ``CNI_NETNS``,
+``CNI_IFNAME``, ``CNI_PATH`` in the environment and the network
+configuration JSON on stdin; ADD answers a result JSON carrying the
+assigned IPs. This module implements exactly that contract (spec
+version 0.4.0 fields), so real CNI-shaped plugins drop in.
+
+Discovery mirrors the kubelet: the lexicographically-first ``.conf`` /
+``.conflist`` file in the conf dir names the plugin (``type``), which
+must exist in the bin dir. No conf file = no CNI; the agent falls back
+to its built-in loopback IPAM (this runtime's noop-networking mode,
+like a kubelet before its CNI conf arrives — except pods still get
+usable loopback IPs, so single-host clusters work out of the box).
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+from typing import Optional
+
+log = logging.getLogger("cni")
+
+
+class CNIError(Exception):
+    """Plugin invocation failed; pod start retries (transient by
+    contract, like every other sync-path failure)."""
+
+
+class CNIInvoker:
+    def __init__(self, conf_dir: str, bin_dir: str):
+        self.conf_dir = conf_dir
+        self.bin_dir = bin_dir
+        self._conf_cache: tuple[float, Optional[dict]] = (0.0, None)
+
+    def load_config(self) -> Optional[dict]:
+        """First network config by filename, or None (no CNI). A short
+        TTL cache keeps the disk scan off the per-container hot path
+        while conf changes still apply within a second, no restart
+        (kubelet re-reads the same way)."""
+        import time
+        ts, cached = self._conf_cache
+        now = time.monotonic()
+        if now - ts < 1.0:
+            return cached
+        conf = self._read_config()
+        self._conf_cache = (now, conf)
+        return conf
+
+    def _read_config(self) -> Optional[dict]:
+        try:
+            names = sorted(os.listdir(self.conf_dir))
+        except OSError:
+            return None
+        for name in names:
+            if not name.endswith((".conf", ".conflist")):
+                continue
+            path = os.path.join(self.conf_dir, name)
+            try:
+                with open(path) as f:
+                    conf = json.load(f)
+            except (OSError, ValueError) as e:
+                log.warning("skipping CNI conf %s: %s", path, e)
+                continue
+            if name.endswith(".conflist"):
+                plugins = conf.get("plugins") or []
+                if not plugins:
+                    continue
+                # Chained plugins: this runtime drives the FIRST one
+                # (interface creation); chaining is a plugin concern.
+                first = dict(plugins[0])
+                first.setdefault("name", conf.get("name", ""))
+                first.setdefault("cniVersion", conf.get("cniVersion",
+                                                        "0.4.0"))
+                conf = first
+            if conf.get("type"):
+                return conf
+        return None
+
+    @property
+    def enabled(self) -> bool:
+        return self.load_config() is not None
+
+    async def _invoke(self, command: str, conf: dict, container_id: str,
+                      netns: str) -> dict:
+        plugin = os.path.join(self.bin_dir, conf["type"])
+        if not os.path.exists(plugin):
+            raise CNIError(f"CNI plugin binary {plugin!r} not found")
+        env = {**os.environ,
+               "CNI_COMMAND": command,
+               "CNI_CONTAINERID": container_id,
+               "CNI_NETNS": netns,
+               "CNI_IFNAME": "eth0",
+               "CNI_PATH": self.bin_dir}
+        proc = await asyncio.create_subprocess_exec(
+            plugin, env=env,
+            stdin=asyncio.subprocess.PIPE,
+            stdout=asyncio.subprocess.PIPE,
+            stderr=asyncio.subprocess.PIPE)
+        try:
+            out, err = await asyncio.wait_for(
+                proc.communicate(json.dumps(conf).encode()), 30.0)
+        except asyncio.TimeoutError:
+            proc.kill()
+            raise CNIError(f"CNI {command} timed out") from None
+        if proc.returncode != 0:
+            # Spec: errors are JSON {code, msg} on stdout.
+            detail = (out or err).decode(errors="replace")[:300]
+            raise CNIError(f"CNI {command} failed "
+                           f"(rc={proc.returncode}): {detail}")
+        if command == "DEL" or not out.strip():
+            return {}
+        try:
+            return json.loads(out)
+        except ValueError as e:
+            raise CNIError(f"CNI {command}: bad result JSON: {e}") from None
+
+    async def add(self, pod_uid: str, pod_namespace: str,
+                  pod_name: str) -> str:
+        """ADD the pod to the network; returns its IP. The sandbox id
+        is the pod uid (process runtime: no real netns — the plugin
+        receives a pod-scoped placeholder path, exactly what it would
+        get from a sandbox runtime)."""
+        conf = self.load_config()
+        if conf is None:
+            raise CNIError("no CNI configuration present")
+        conf = {**conf,
+                # The args every conformant runtime passes through.
+                "runtimeConfig": {},
+                "args": {"K8S_POD_NAMESPACE": pod_namespace,
+                         "K8S_POD_NAME": pod_name,
+                         "K8S_POD_UID": pod_uid}}
+        result = await self._invoke("ADD", conf, pod_uid,
+                                    f"/var/run/netns/{pod_uid}")
+        ips = result.get("ips") or []
+        if not ips or "address" not in ips[0]:
+            raise CNIError(f"CNI ADD returned no ips: {result}")
+        return ips[0]["address"].split("/", 1)[0]
+
+    async def delete(self, pod_uid: str) -> None:
+        """DEL is best-effort and idempotent per spec."""
+        conf = self.load_config()
+        if conf is None:
+            return
+        try:
+            await self._invoke("DEL", conf, pod_uid,
+                               f"/var/run/netns/{pod_uid}")
+        except CNIError as e:
+            log.warning("CNI DEL for %s failed (continuing): %s",
+                        pod_uid, e)

@@ -108,14 +108,22 @@ class PodIPAllocator:
         self._size = max(0, (1 << (32 - plen)) - 3)  # minus broadcast
         self._by_uid: dict[str, int] = {}
         self._used: set[int] = set()
+        #: uid -> IP OUTSIDE the node CIDR (CNI-plugin-assigned: the
+        #: plugin owns its ranges; the allocator just records).
+        self._external: dict[str, str] = {}
 
     @property
     def node_ip(self) -> str:
         net, _ = parse_cidr(self.cidr)
         return int_to_ip(net + 1)
 
+    def has(self, uid: str) -> bool:
+        return uid in self._by_uid or uid in self._external
+
     def ip_for(self, uid: str) -> str:
         """Allocate (idempotently) an IP for the pod UID."""
+        if uid in self._external:
+            return self._external[uid]
         if uid in self._by_uid:
             return int_to_ip(self._base + self._by_uid[uid])
         for off in range(self._size):
@@ -126,19 +134,30 @@ class PodIPAllocator:
         raise RuntimeError(f"pod CIDR {self.cidr} exhausted")
 
     def occupy(self, uid: str, ip: str) -> None:
-        """Adopt an existing pod->IP mapping (agent restart rebuild)."""
-        off = ip_to_int(ip) - self._base
-        if 0 <= off < self._size and uid not in self._by_uid:
+        """Adopt an existing pod->IP mapping (agent restart rebuild,
+        or a CNI plugin's assignment — which may live outside the node
+        CIDR, or not be IPv4 at all; the plugin owns its ranges)."""
+        if uid in self._by_uid or uid in self._external:
+            return
+        try:
+            off = ip_to_int(ip) - self._base
+        except (ValueError, IndexError):
+            self._external[uid] = ip  # e.g. IPv6 from a dual-stack plugin
+            return
+        if 0 <= off < self._size:
             self._used.add(off)
             self._by_uid[uid] = off
+        else:
+            self._external[uid] = ip
 
     def release(self, uid: str) -> None:
+        self._external.pop(uid, None)
         off = self._by_uid.pop(uid, None)
         if off is not None:
             self._used.discard(off)
 
     def __len__(self) -> int:
-        return len(self._by_uid)
+        return len(self._by_uid) + len(self._external)
 
 
 class ServiceIPAllocator:

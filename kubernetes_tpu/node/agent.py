@@ -121,6 +121,16 @@ class NodeAgent:
             tempfile.gettempdir(), f"ktpu-{node_name}")
         self.volumes = VolumeManager(self.object_cache, vol_dir)
         self._node_dir = vol_dir
+        #: CNI plugin seam (net/cni.py): executables under
+        #: <node_dir>/cni/bin driven by the first conf in
+        #: <node_dir>/cni/net.d, exactly the kubelet's contract. With
+        #: no conf present the built-in loopback IPAM applies.
+        from ..net.cni import CNIInvoker
+        cni_root = os.path.join(vol_dir, "cni")
+        self.cni = CNIInvoker(os.path.join(cni_root, "net.d"),
+                              os.path.join(cni_root, "bin"))
+        self._cni_added: set[str] = set()
+
         #: Dynamic config from a ConfigMap (dynamicconfig.py); source
         #: discovery piggybacks on the node-status loop, so an agent
         #: with no config-source annotation pays nothing.
@@ -609,10 +619,40 @@ class NodeAgent:
             return False
         return True
 
+    async def _ensure_pod_ip(self, pod: t.Pod) -> str:
+        """Pod IP via the CNI plugin when one is configured (ADD once
+        per pod; the plugin's assignment is adopted into the allocator
+        so status/DNS/env all see it), else built-in loopback IPAM."""
+        uid = pod.metadata.uid
+        if uid not in self._cni_added and self.cni.enabled:
+            if self.ipam.has(uid):
+                # Agent-restart rebuild: the pod already carries its
+                # plugin-assigned IP (from status). Do NOT re-ADD — a
+                # new assignment mid-lifetime would diverge from what
+                # running containers hold; just remember to DEL later.
+                self._cni_added.add(uid)
+            else:
+                ip = await self.cni.add(uid, pod.metadata.namespace,
+                                        pod.metadata.name)
+                self._cni_added.add(uid)
+                self.ipam.release(uid)
+                self.ipam.occupy(uid, ip)
+        return self.ipam.ip_for(uid)
+
+    async def _release_pod_ip(self, uid: str) -> None:
+        # DEL unconditionally when a conf is present (idempotent per
+        # spec; delete() no-ops without one): _cni_added is in-memory
+        # only, and a pod networked before an agent restart must still
+        # get its DEL or the plugin leaks the assignment.
+        self._cni_added.discard(uid)
+        await self.cni.delete(uid)
+        self.ipam.release(uid)
+
     async def _start_container(self, pod: t.Pod, container: t.Container,
                                cmap: dict[str, str]) -> None:
-        pod_ip = self.ipam.ip_for(pod.metadata.uid)
+        from ..net.cni import CNIError
         try:
+            pod_ip = await self._ensure_pod_ip(pod)
             env = await resolve_env(
                 self.object_cache, pod, container,
                 {"status.pod_ip": pod_ip, "status.host_ip": self.address})
@@ -620,6 +660,13 @@ class NodeAgent:
             mounts = self.volumes.mounts_for(
                 container, volume_paths,
                 read_only=self.volumes.read_only_volumes(pod))
+        except CNIError as e:
+            # Transient like every other sync-path failure: the worker
+            # retries (a missing/broken network plugin must not KILL
+            # the pod worker).
+            self.recorder.event(pod, "Warning", "FailedCreatePodSandBox",
+                                f"network setup: {e}")
+            return
         except (VolumeError, OSError) as e:
             # Transient by contract (missing object now, ENOSPC/EACCES
             # during projection): the worker retries next sync
@@ -993,7 +1040,7 @@ class NodeAgent:
         self._restart_at.pop(key, None)
         self._admitted.discard(key)
         self._pod_uids.pop(key, None)
-        self.ipam.release(pod.metadata.uid)
+        await self._release_pod_ip(pod.metadata.uid)
         self.volumes.teardown(pod.metadata.uid)
         # Confirm deletion: grace-0 delete completes removal (the node
         # agent is the only caller allowed to finish a pod's deletion).
@@ -1015,7 +1062,7 @@ class NodeAgent:
         self._admitted.discard(key)
         uid = self._pod_uids.pop(key, None)
         if uid:
-            self.ipam.release(uid)
+            await self._release_pod_ip(uid)
             self._evicted.discard(uid)
             self.volumes.teardown(uid)
             # Sandbox goes with its pod on the force-delete path too
@@ -1088,7 +1135,7 @@ class NodeAgent:
             pass
         uid = self._pod_uids.get(key)
         if uid:
-            self.ipam.release(uid)
+            await self._release_pod_ip(uid)
         self._nudge(key)
 
     def _nudge_owner(self, cid: str) -> None:
