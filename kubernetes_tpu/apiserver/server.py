@@ -636,10 +636,25 @@ class APIServer:
             raise errors.NotFoundError("cluster does not run TLS")
         user = request.get("user", "system:anonymous")
         groups = self._groups_for(user) | request.get("cert_groups", set())
-        if self.tokens is not None and GROUP_BOOTSTRAPPERS not in groups \
-                and rbacapi.GROUP_MASTERS not in groups:
-            return self._err(errors.ForbiddenError(
-                f"user {user!r} is not a bootstrapper"))
+
+        def authorized(node_name: str) -> bool:
+            """Bootstrappers and admins sign for any node; a node's
+            OWN identity may renew itself (kubelet cert rotation,
+            pkg/kubelet/certificate) — and only itself. The identity
+            this endpoint MINTS is the node ServiceAccount user
+            (mint_node_credential), so that is what a rotating node
+            authenticates as; the kubelet-style system:node:<name>
+            form is accepted too."""
+            if self.tokens is None:
+                return True
+            from ..api.types import service_account_user
+            own = {f"system:node:{node_name}",
+                   service_account_user(NODES_NAMESPACE,
+                                        f"node-{node_name}")}
+            return (GROUP_BOOTSTRAPPERS in groups
+                    or rbacapi.GROUP_MASTERS in groups
+                    or user in own)
+
         def record(code: int, name: str = "") -> None:
             if self.audit is not None:
                 self.audit.record(user=user, verb="sign", resource="csr",
@@ -654,6 +669,11 @@ class APIServer:
         except Exception:  # noqa: BLE001
             record(400)
             return self._err(errors.InvalidError("body must be JSON"))
+        if not authorized(node_name):
+            record(403, node_name)
+            return self._err(errors.ForbiddenError(
+                f"user {user!r} may not sign certificates for node "
+                f"{node_name!r}"))
         if serving:
             # SAN admission policy (the reference's serving-cert CSR
             # approver restricts SANs to the Node's recorded

@@ -1104,14 +1104,35 @@ async def cmd_join(args) -> int:
         dm = DeviceManager(plugin_dir)
     agent = NodeAgent(client, node_name, runtime, device_manager=dm,
                       eviction=EvictionManager(), server_port=0)
+    rotator = None
     if ca_file:
         from ..apiserver.certs import CertPair, server_ssl_context
         agent.server_tls = server_ssl_context(
             CertPair(serving_cert, serving_key), ca_file)
+
+        # Certificate rotation (kubelet pkg/kubelet/certificate): the
+        # agent renews its own client + serving certs through the CSR
+        # endpoint before they expire; live contexts reload in place.
+        from ..node.certrotation import CertRotator
+
+        def reload_tls():
+            client.rebuild_ssl(ca_file, client_cert, client_key,
+                               check_hostname=False)
+            # Server context: reload the pair in place — new
+            # handshakes pick it up, existing connections finish.
+            agent.server_tls.load_cert_chain(serving_cert, serving_key)
+
+        rotator = CertRotator(server, node_name, ca_file,
+                              client_cert, client_key,
+                              serving_cert=serving_cert,
+                              serving_key=serving_key,
+                              on_rotated=reload_tls)
     # Cluster DNS rides the credential response (see _node_credentials)
     # so pods here resolve rank hostnames exactly like local-node pods.
     agent.dns_server = body.get("dns_server", "")
     await agent.start()
+    if rotator is not None:
+        rotator.start()
     print(f"node agent {node_name!r} running against {server} "
           "(SIGINT to leave)")
     stop = asyncio.Event()
@@ -1122,6 +1143,8 @@ async def cmd_join(args) -> int:
         except NotImplementedError:  # same guard as cmd_up
             signal.signal(sig, lambda *_: stop.set())
     await stop.wait()
+    if rotator is not None:
+        await rotator.stop()
     await agent.stop()
     await client.close()
     return 0

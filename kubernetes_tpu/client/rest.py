@@ -180,6 +180,34 @@ class RESTClient(Client):
         same identity — for the kubelet-analog HTTPS endpoints."""
         return self._ssl
 
+    #: Strong refs to in-flight old-session close tasks (asyncio keeps
+    #: only weak refs; an unreferenced close task can be GC'd before
+    #: running, leaking the connector's sockets).
+    _close_tasks: set = set()
+
+    def rebuild_ssl(self, ca_file: str, client_cert: str = "",
+                    client_key: str = "",
+                    check_hostname: bool = True) -> None:
+        """Reload TLS material (cert rotation): the next request gets
+        a fresh session/connector with the new identity. Closing the
+        old session interrupts requests still using it — long-lived
+        watches reconnect by design (reflector semantics), which is
+        exactly the behavior rotation wants: streams move to the new
+        credential."""
+        from ..apiserver.certs import client_ssl_context
+        self._ssl = client_ssl_context(ca_file, client_cert, client_key,
+                                       check_hostname=check_hostname)
+        if self._session is not None and not self._session.closed:
+            session = self._session
+            self._session = None
+            try:
+                task = asyncio.get_running_loop().create_task(
+                    session.close())
+                RESTClient._close_tasks.add(task)
+                task.add_done_callback(RESTClient._close_tasks.discard)
+            except RuntimeError:
+                pass  # no loop: abandoned session is GC'd
+
     def _sess(self) -> aiohttp.ClientSession:
         if self._session is None or self._session.closed:
             connector = (aiohttp.TCPConnector(ssl=self._ssl)

@@ -1,0 +1,151 @@
+"""Client/serving certificate rotation for node agents.
+
+Reference: ``pkg/kubelet/certificate`` — the kubelet watches its own
+certificate's lifetime and requests a replacement through the CSR flow
+when ~70-80% has elapsed, so credentials roll without restarts or
+operator action. Same shape here: a background task checks the client
+(and optionally serving) cert; past the rotation threshold it mints a
+fresh key LOCALLY, has the apiserver sign the CSR using the CURRENT
+identity (the endpoint authorizes self-renewal: ``system:node:X`` may
+sign only for node X), atomically replaces the files, and notifies the
+consumer so live TLS contexts pick up the new pair.
+"""
+from __future__ import annotations
+
+import asyncio
+import datetime
+import logging
+import os
+from typing import Callable, Optional
+
+log = logging.getLogger("certrotation")
+
+
+def cert_lifetime_fraction(cert_path: str) -> float:
+    """Elapsed fraction of the cert's validity window (0..1+)."""
+    from cryptography import x509
+    with open(cert_path, "rb") as f:
+        cert = x509.load_pem_x509_certificate(f.read())
+    start = cert.not_valid_before_utc
+    end = cert.not_valid_after_utc
+    now = datetime.datetime.now(datetime.timezone.utc)
+    total = (end - start).total_seconds()
+    if total <= 0:
+        return 1.0
+    return (now - start).total_seconds() / total
+
+
+class CertRotator:
+    """Rotates a joined agent's client cert (and serving cert) via the
+    ``/bootstrap/v1/sign-csr`` endpoint, authenticated with the
+    current (still-valid) client cert."""
+
+    def __init__(self, server: str, node_name: str, ca_file: str,
+                 cert_path: str, key_path: str,
+                 serving_cert: str = "", serving_key: str = "",
+                 check_interval: float = 3600.0,
+                 rotate_at: float = 0.7,
+                 on_rotated: Optional[Callable[[], None]] = None):
+        self.server = server
+        self.node_name = node_name
+        self.ca_file = ca_file
+        self.cert_path = cert_path
+        self.key_path = key_path
+        self.serving_cert = serving_cert
+        self.serving_key = serving_key
+        self.check_interval = check_interval
+        self.rotate_at = rotate_at
+        self.on_rotated = on_rotated
+        self._task: Optional[asyncio.Task] = None
+
+    def start(self) -> None:
+        self._task = asyncio.get_running_loop().create_task(self._loop())
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+
+    async def _loop(self) -> None:
+        while True:
+            try:
+                await self.maybe_rotate()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 — retry next tick
+                log.warning("cert rotation check failed: %s", e)
+            await asyncio.sleep(self.check_interval)
+
+    async def maybe_rotate(self) -> bool:
+        """Rotate whichever certs have crossed the threshold — EACH
+        keyed to its own lifetime, so a failed serving rotation is
+        retried next tick even after the client cert already rolled
+        (and vice versa), and a partial success still reloads live
+        contexts via on_rotated."""
+        pairs = [("client", self.cert_path, self.key_path)]
+        if self.serving_cert and self.serving_key:
+            pairs.append(("serving", self.serving_cert, self.serving_key))
+        rotated = False
+        errors_seen: list[Exception] = []
+        for usage, cert_path, key_path in pairs:
+            try:
+                if cert_lifetime_fraction(cert_path) < self.rotate_at:
+                    continue
+                log.info("%s cert for %s past rotation threshold: "
+                         "rotating", usage, self.node_name)
+                await self._rotate_one(cert_path, key_path, usage)
+                rotated = True
+            except Exception as e:  # noqa: BLE001 — keep going; retried
+                errors_seen.append(e)
+        if rotated and self.on_rotated is not None:
+            self.on_rotated()
+        if errors_seen:
+            raise errors_seen[0]
+        return rotated
+
+    async def _rotate_one(self, cert_path: str, key_path: str,
+                          usage: str) -> None:
+        import aiohttp
+
+        from ..apiserver.certs import (client_ssl_context, local_host_sans,
+                                       make_csr_pem)
+        # Fresh key in a temp path; the private key never travels.
+        new_key = key_path + ".rotate"
+        csr = make_csr_pem(new_key, f"system:node:{self.node_name}")
+        body = {"node_name": self.node_name, "csr_pem": csr.decode()}
+        if usage == "serving":
+            body["usage"] = "serving"
+            body["sans"] = local_host_sans([self.node_name])
+        # Authenticate with the CURRENT cert (self-renewal); hostname
+        # checking follows the join flow's CA-pinned posture.
+        ctx = client_ssl_context(self.ca_file, self.cert_path,
+                                 self.key_path, check_hostname=False)
+        new_cert = cert_path + ".rotate"
+        try:
+            async with aiohttp.ClientSession() as s:
+                async with s.post(
+                        f"{self.server}/bootstrap/v1/sign-csr",
+                        json=body, ssl=ctx,
+                        timeout=aiohttp.ClientTimeout(total=15)) as r:
+                    if r.status != 200:
+                        raise RuntimeError(
+                            f"sign-csr ({usage}) failed ({r.status}): "
+                            f"{(await r.text())[:200]}")
+                    signed = await r.json()
+            with open(new_cert, "w") as f:
+                f.write(signed["cert_pem"])
+            # Atomic swap; consumers reload both on on_rotated.
+            os.replace(new_key, key_path)
+            os.replace(new_cert, cert_path)
+        finally:
+            # ANY failure path must not leave a live private key (or a
+            # half-written cert) behind on disk.
+            for leftover in (new_key, new_cert):
+                try:
+                    os.unlink(leftover)
+                except OSError:
+                    pass
+        log.info("rotated %s cert for %s", usage, self.node_name)
