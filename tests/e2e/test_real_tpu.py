@@ -108,11 +108,13 @@ async def test_live_training_metrics_on_real_chip(tmp_path):
                 tpu_resources=[t.PodTpuRequest(name="tpu", chips=1)]))
         await client.create(pod)
 
-        base = f"http://127.0.0.1:{cluster.nodes[0].agent.server.port}"
+        base = f"https://127.0.0.1:{cluster.nodes[0].agent.server.port}"
+        node_ssl = client.ssl_context
 
         async def live_chip():
             async with aiohttp.ClientSession() as s:
-                async with s.get(f"{base}/stats/summary") as r:
+                async with s.get(f"{base}/stats/summary",
+                                 ssl=node_ssl) as r:
                     summary = await r.json()
             for chip in summary.get("tpu", {}).get("chips", []):
                 if chip.get("assigned_to") and "tokens_per_sec" in chip:
@@ -142,7 +144,8 @@ async def test_live_training_metrics_on_real_chip(tmp_path):
         # absorbs the ~30s tunnel compile, flattening its rate to ~0).
         async def training_rec():
             async with aiohttp.ClientSession() as s:
-                async with s.get(f"{base}/stats/summary") as r:
+                async with s.get(f"{base}/stats/summary",
+                                 ssl=node_ssl) as r:
                     summary = await r.json()
             recs = [p.get("training") for p in summary["pods"]
                     if p["pod"]["name"] == "train-live"]

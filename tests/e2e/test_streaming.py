@@ -31,8 +31,10 @@ async def running(client, name):
 
 
 async def node_base(cluster):
+    # Node servers serve HTTPS under cluster TLS (kubelet :10250
+    # model); the cluster client's identity doubles as the credential.
     node = cluster.nodes[0]
-    return f"http://127.0.0.1:{node.agent.server.port}"
+    return f"https://127.0.0.1:{node.agent.server.port}"
 
 
 async def test_interactive_exec_attach_portforward(tmp_path):
@@ -69,9 +71,11 @@ async def test_interactive_exec_attach_portforward(tmp_path):
             await asyncio.sleep(0.5)
             yield b"exit 0\n"
 
+        node_ssl = client.ssl_context
         code = await exec_interactive(
             base, "default", "svc", "main", ["/bin/sh"],
-            stdin_source=stdin_lines(), out=out.extend, timeout=30)
+            stdin_source=stdin_lines(), out=out.extend, timeout=30,
+            ssl_ctx=node_ssl)
         assert code == 0
         assert b"marker-42" in bytes(out), bytes(out)
 
@@ -79,7 +83,8 @@ async def test_interactive_exec_attach_portforward(tmp_path):
         got = bytearray()
         async with aiohttp.ClientSession() as s:
             async with s.ws_connect(
-                    f"{base}/attach/default/svc/main/stream") as ws:
+                    f"{base}/attach/default/svc/main/stream",
+                    ssl=node_ssl) as ws:
                 deadline = asyncio.get_running_loop().time() + 15
                 while asyncio.get_running_loop().time() < deadline:
                     msg = await ws.receive(timeout=15)
@@ -96,7 +101,7 @@ async def test_interactive_exec_attach_portforward(tmp_path):
         local_port = 38123
         task = asyncio.get_running_loop().create_task(
             forward_port(base, "default", "svc", local_port, 8080,
-                         ready=ready, stop=stop))
+                         ready=ready, stop=stop, ssl_ctx=node_ssl))
         await asyncio.wait_for(ready.wait(), 10)
         async with aiohttp.ClientSession() as s:
             async with s.get(f"http://127.0.0.1:{local_port}/",
@@ -110,7 +115,8 @@ async def test_interactive_exec_attach_portforward(tmp_path):
         # 4. port-forward against a port nobody listens on: clean 502
         # at the stream level, not a hang.
         async with aiohttp.ClientSession() as s:
-            async with s.get(f"{base}/portforward/default/svc/39999") as r:
+            async with s.get(f"{base}/portforward/default/svc/39999",
+                             ssl=node_ssl) as r:
                 assert r.status == 502
     finally:
         await client.close()
