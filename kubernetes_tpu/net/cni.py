@@ -36,6 +36,11 @@ class CNIInvoker:
         self.conf_dir = conf_dir
         self.bin_dir = bin_dir
         self._conf_cache: tuple[float, Optional[dict]] = (0.0, None)
+        #: pod uid -> (args, last ADD result): chained DEL passes the
+        #: cached ADD result as prevResult (spec conflist DEL; a
+        #: portmap-style meta-plugin cannot tear down without it).
+        #: In-memory: after an agent restart DEL runs bare, best-effort.
+        self._add_state: dict[str, tuple[dict, dict]] = {}
 
     def load_config(self) -> Optional[dict]:
         """First network config by filename, or None (no CNI). A short
@@ -52,6 +57,11 @@ class CNIInvoker:
         return conf
 
     def _read_config(self) -> Optional[dict]:
+        """Normalized network config: {"name", "cniVersion",
+        "plugins": [plugin conf, ...]} — a bare ``.conf`` becomes a
+        one-element chain, a ``.conflist`` keeps its full chain (the
+        spec's conflist semantics: every plugin runs in order on ADD
+        with ``prevResult`` threading through, reverse order on DEL)."""
         try:
             names = sorted(os.listdir(self.conf_dir))
         except OSError:
@@ -66,19 +76,34 @@ class CNIInvoker:
             except (OSError, ValueError) as e:
                 log.warning("skipping CNI conf %s: %s", path, e)
                 continue
+            if not isinstance(conf, dict):
+                log.warning("skipping CNI conf %s: not an object", path)
+                continue
+            net_name = conf.get("name", "")
+            version = conf.get("cniVersion", "0.4.0")
             if name.endswith(".conflist"):
-                plugins = conf.get("plugins") or []
-                if not plugins:
+                raw = conf.get("plugins") or []
+                plugins = []
+                for pl in raw:
+                    if isinstance(pl, dict) and pl.get("type"):
+                        plugins.append(dict(pl))
+                    else:
+                        # An invalid entry must be VISIBLE — silently
+                        # running a partial chain (say, minus the
+                        # firewall step) is worse than failing.
+                        log.warning("CNI conf %s: dropping invalid "
+                                    "plugin entry %r", path, pl)
+                if not plugins or len(plugins) != len(raw):
+                    continue  # invalid network config: try the next file
+            else:
+                if not conf.get("type"):
                     continue
-                # Chained plugins: this runtime drives the FIRST one
-                # (interface creation); chaining is a plugin concern.
-                first = dict(plugins[0])
-                first.setdefault("name", conf.get("name", ""))
-                first.setdefault("cniVersion", conf.get("cniVersion",
-                                                        "0.4.0"))
-                conf = first
-            if conf.get("type"):
-                return conf
+                plugins = [conf]
+            for pl in plugins:
+                pl.setdefault("name", net_name)
+                pl.setdefault("cniVersion", version)
+            return {"name": net_name, "cniVersion": version,
+                    "plugins": plugins}
         return None
 
     @property
@@ -125,30 +150,61 @@ class CNIInvoker:
         is the pod uid (process runtime: no real netns — the plugin
         receives a pod-scoped placeholder path, exactly what it would
         get from a sandbox runtime)."""
-        conf = self.load_config()
-        if conf is None:
+        net = self.load_config()
+        if net is None:
             raise CNIError("no CNI configuration present")
-        conf = {**conf,
-                # The args every conformant runtime passes through.
-                "runtimeConfig": {},
-                "args": {"K8S_POD_NAMESPACE": pod_namespace,
-                         "K8S_POD_NAME": pod_name,
-                         "K8S_POD_UID": pod_uid}}
-        result = await self._invoke("ADD", conf, pod_uid,
-                                    f"/var/run/netns/{pod_uid}")
+        args = {"K8S_POD_NAMESPACE": pod_namespace,
+                "K8S_POD_NAME": pod_name,
+                "K8S_POD_UID": pod_uid}
+        result: dict = {}
+        # Chain semantics: every plugin runs in order; each sees the
+        # previous plugin's result as prevResult; the LAST result is
+        # the network's outcome (spec conflist ADD). A mid-chain
+        # failure tears the chain back DOWN before raising (the
+        # kubelet's teardown-on-setup-failure) — otherwise the
+        # caller's retry re-ADDs into plugins still holding the first
+        # attempt's state.
+        try:
+            for plugin_conf in net["plugins"]:
+                conf = {**plugin_conf, "runtimeConfig": {}, "args": args}
+                if result:
+                    conf["prevResult"] = result
+                out = await self._invoke("ADD", conf, pod_uid,
+                                         f"/var/run/netns/{pod_uid}")
+                # A chained plugin that answers nothing passes the
+                # previous result through unchanged (meta-plugins).
+                if out:
+                    result = out
+        except CNIError:
+            self._add_state[pod_uid] = (args, result)
+            await self.delete(pod_uid)
+            raise
+        self._add_state[pod_uid] = (args, result)
         ips = result.get("ips") or []
         if not ips or "address" not in ips[0]:
+            await self.delete(pod_uid)
             raise CNIError(f"CNI ADD returned no ips: {result}")
         return ips[0]["address"].split("/", 1)[0]
 
     async def delete(self, pod_uid: str) -> None:
-        """DEL is best-effort and idempotent per spec."""
-        conf = self.load_config()
-        if conf is None:
+        """DEL is best-effort and idempotent per spec; chained plugins
+        tear down in REVERSE order with the cached ADD result as
+        prevResult (spec conflist DEL) — bare after an agent restart,
+        when the in-memory cache is gone."""
+        net = self.load_config()
+        if net is None:
+            self._add_state.pop(pod_uid, None)
             return
-        try:
-            await self._invoke("DEL", conf, pod_uid,
-                               f"/var/run/netns/{pod_uid}")
-        except CNIError as e:
-            log.warning("CNI DEL for %s failed (continuing): %s",
-                        pod_uid, e)
+        args, prev = self._add_state.pop(pod_uid, ({}, {}))
+        for plugin_conf in reversed(net["plugins"]):
+            conf = {**plugin_conf, "runtimeConfig": {}}
+            if args:
+                conf["args"] = args
+            if prev:
+                conf["prevResult"] = prev
+            try:
+                await self._invoke("DEL", conf, pod_uid,
+                                   f"/var/run/netns/{pod_uid}")
+            except CNIError as e:
+                log.warning("CNI DEL (%s) for %s failed (continuing): %s",
+                            plugin_conf.get("type"), pod_uid, e)

@@ -124,3 +124,86 @@ async def test_second_plugin_swaps_behind_the_conf(tmp_path):
 async def test_no_conf_means_builtin_ipam(tmp_path):
     cni = CNIInvoker(str(tmp_path / "none"), str(tmp_path / "bin"))
     assert not cni.enabled
+
+
+async def test_conflist_chain_runs_all_plugins(tmp_path):
+    """A .conflist runs EVERY plugin in order on ADD (prevResult
+    threading through; the last result wins) and in reverse on DEL —
+    the spec's chain semantics."""
+    net_d, bin_d = str(tmp_path / "net.d"), str(tmp_path / "bin")
+    os.makedirs(net_d), os.makedirs(bin_d)
+    trace = str(tmp_path / "trace.log")
+
+    def plugin(name, body_lines):
+        path = os.path.join(bin_d, name)
+        with open(path, "w") as f:
+            f.write("#!/usr/bin/env python3\n"
+                    "import json, os, sys\n"
+                    "conf = json.load(sys.stdin)\n"
+                    f"open({trace!r}, 'a').write("
+                    f"os.environ['CNI_COMMAND'] + ':' + {name!r} + chr(10))\n"
+                    + "\n".join(body_lines) + "\n")
+        os.chmod(path, os.stat(path).st_mode | stat.S_IEXEC)
+
+    plugin("ipam-main", [
+        "if os.environ['CNI_COMMAND'] == 'ADD':",
+        "    print(json.dumps({'ips': [{'address': '10.5.0.9/24'}]}))"])
+    plugin("meta-tuner", [
+        "if os.environ['CNI_COMMAND'] == 'ADD':",
+        "    assert conf.get('prevResult', {}).get('ips'), conf",
+        "    print(json.dumps(conf['prevResult']))"])  # pass-through
+    with open(os.path.join(net_d, "00-chain.conflist"), "w") as f:
+        json.dump({"cniVersion": "0.4.0", "name": "chain",
+                   "plugins": [{"type": "ipam-main"},
+                               {"type": "meta-tuner"}]}, f)
+
+    cni = CNIInvoker(net_d, bin_d)
+    ip = await cni.add("uid-c", "default", "p")
+    assert ip == "10.5.0.9"
+    await cni.delete("uid-c")
+    lines = open(trace).read().splitlines()
+    assert lines == ["ADD:ipam-main", "ADD:meta-tuner",
+                     "DEL:meta-tuner", "DEL:ipam-main"], lines
+
+
+async def test_mid_chain_add_failure_tears_down(tmp_path):
+    """A failing plugin mid-chain unwinds the ones that already ran
+    (teardown-on-setup-failure), so the caller's retry re-ADDs into a
+    clean slate instead of colliding with leaked state."""
+    net_d, bin_d = str(tmp_path / "net.d"), str(tmp_path / "bin")
+    os.makedirs(net_d), os.makedirs(bin_d)
+    trace = str(tmp_path / "trace.log")
+
+    def plugin(name, body_lines):
+        path = os.path.join(bin_d, name)
+        with open(path, "w") as f:
+            f.write("#!/usr/bin/env python3\n"
+                    "import json, os, sys\n"
+                    "conf = json.load(sys.stdin)\n"
+                    f"open({trace!r}, 'a').write("
+                    f"os.environ['CNI_COMMAND'] + ':' + {name!r} + chr(10))\n"
+                    + "\n".join(body_lines) + "\n")
+        os.chmod(path, os.stat(path).st_mode | stat.S_IEXEC)
+
+    plugin("good-ipam", [
+        "if os.environ['CNI_COMMAND'] == 'ADD':",
+        "    print(json.dumps({'ips': [{'address': '10.6.0.2/24'}]}))"])
+    plugin("broken", [
+        "if os.environ['CNI_COMMAND'] == 'ADD':",
+        "    print(json.dumps({'code': 11, 'msg': 'boom'}))",
+        "    sys.exit(1)"])
+    with open(os.path.join(net_d, "00-c.conflist"), "w") as f:
+        json.dump({"cniVersion": "0.4.0", "name": "c",
+                   "plugins": [{"type": "good-ipam"},
+                               {"type": "broken"}]}, f)
+
+    cni = CNIInvoker(net_d, bin_d)
+    import pytest
+    from kubernetes_tpu.net.cni import CNIError
+    with pytest.raises(CNIError, match="boom"):
+        await cni.add("uid-f", "default", "p")
+    lines = open(trace).read().splitlines()
+    # good-ipam was unwound (DEL) after broken failed; DEL runs the
+    # whole chain in reverse best-effort.
+    assert lines[0] == "ADD:good-ipam" and lines[1] == "ADD:broken"
+    assert "DEL:good-ipam" in lines, lines
