@@ -1,5 +1,7 @@
 """Performance harnesses (reference: ``test/integration/scheduler_perf``
 and the kubemark hollow-node rig, SURVEY.md section 4)."""
+import asyncio
+import time
 
 
 def pct(sorted_vals, q: float) -> float:
@@ -8,3 +10,39 @@ def pct(sorted_vals, q: float) -> float:
     if not sorted_vals:
         return 0.0
     return sorted_vals[min(len(sorted_vals) - 1, int(q * len(sorted_vals)))]
+
+
+async def run_paced_creates(n: int, rate: float, create_one) -> dict:
+    """The paced load loop both density arms share: create ``n`` pods
+    named ``paced-{i:05d}`` at ``rate``/s (sleep-compensated), returning
+    name -> create wall time. Sub-saturation pacing is what makes the
+    resulting create->bound times an honest latency number instead of
+    backlog arithmetic (reference splits these the same way,
+    density.go:364 vs :452-477)."""
+    created: dict = {}
+    interval = 1.0 / rate
+    for i in range(n):
+        name = f"paced-{i:05d}"
+        t0 = time.perf_counter()
+        created[name] = t0
+        await create_one(name)
+        sleep = interval - (time.perf_counter() - t0)
+        if sleep > 0:
+            await asyncio.sleep(sleep)
+    return created
+
+
+def latency_percentiles(created: dict, bound_at: dict, prefix: str = "",
+                        exclude=frozenset(), key: str = "schedule_latency",
+                        ndigits: int = 2) -> dict:
+    """create->bound percentiles for pods whose timestamps are trusted
+    (``exclude`` drops pods whose bound time came from a coarse relist
+    poll rather than a watch event)."""
+    lats = sorted(bound_at[n] - created[n] for n in created
+                  if n.startswith(prefix) and n in bound_at
+                  and n not in exclude)
+    return {
+        f"{key}_p50_ms": round(pct(lats, 0.50) * 1e3, ndigits),
+        f"{key}_p90_ms": round(pct(lats, 0.90) * 1e3, ndigits),
+        f"{key}_p99_ms": round(pct(lats, 0.99) * 1e3, ndigits),
+    }

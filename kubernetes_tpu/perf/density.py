@@ -15,6 +15,7 @@ import asyncio
 import json
 import time
 
+from . import latency_percentiles, run_paced_creates
 from ..api import types as t
 from ..api.meta import ObjectMeta
 from ..apiserver.admission import default_chain
@@ -99,7 +100,8 @@ def _parse_latency_histogram(text: str, name: str, verb: str = "") -> dict:
 
 async def _run_density_rest(n_nodes: int, n_pods: int, timeout: float,
                             create_concurrency: int,
-                            max_pods_per_node: int) -> dict:
+                            max_pods_per_node: int,
+                            paced_pods: int, paced_rate: float) -> dict:
     """The via='rest' arm of :func:`run_density`: apiserver and loadgen
     subprocesses, scheduler in-process, everything over HTTP. Every
     child is terminated on any failure path."""
@@ -130,6 +132,7 @@ async def _run_density_rest(n_nodes: int, n_pods: int, timeout: float,
             "--server", client.base_url, "--pods", str(n_pods),
             "--concurrency", str(create_concurrency),
             "--timeout", str(timeout),
+            "--paced-pods", str(paced_pods), "--rate", str(paced_rate),
             stdout=asyncio.subprocess.PIPE,
             cwd=os.path.dirname(os.path.dirname(os.path.dirname(
                 os.path.abspath(__file__)))))
@@ -179,7 +182,9 @@ async def _run_density_rest(n_nodes: int, n_pods: int, timeout: float,
 async def run_density(n_nodes: int = 100, n_pods: int = 3000,
                       timeout: float = 600.0, via: str = "local",
                       create_concurrency: int = 64,
-                      max_pods_per_node: int = 110) -> dict:
+                      max_pods_per_node: int = 110,
+                      paced_pods: int = 300,
+                      paced_rate: float = 100.0) -> dict:
     """Create nodes, start the scheduler, pour pods in, wait until every
     pod is bound. Returns throughput + latency percentiles.
 
@@ -201,7 +206,8 @@ async def run_density(n_nodes: int = 100, n_pods: int = 3000,
 
     if via == "rest":
         return await _run_density_rest(
-            n_nodes, n_pods, timeout, create_concurrency, max_pods_per_node)
+            n_nodes, n_pods, timeout, create_concurrency, max_pods_per_node,
+            paced_pods, paced_rate)
 
     reg = Registry()
     reg.admission = default_chain(reg)
@@ -213,14 +219,39 @@ async def run_density(n_nodes: int = 100, n_pods: int = 3000,
     sched = Scheduler(sched_client, backoff_seconds=0.5)
     await sched.start()
 
+    # Two phases, same shape as perf/loadgen.py (and the reference's
+    # split between the saturation pods/s floor, density.go:364, and
+    # the controlled-tail latency measurement, density.go:452-477):
+    # an open-loop blast for throughput, then a PACED phase below
+    # saturation whose externally observed create->bound times are the
+    # honest schedule-latency percentiles. The r4 regression taught
+    # why: under an open firehose the scheduler's placement loop runs
+    # ahead of its pipelined binds, so per-pod pop->bind-ack latency is
+    # backlog depth x bind time — backlog arithmetic, not speed.
+    created_at: dict[str, float] = {}
+    bound_at: dict[str, float] = {}
+    relisted: set[str] = set()  # bound time from a 0.5s poll, not a watch
     bound: dict[str, str] = {}  # pod -> node
+    want = 0
     done = asyncio.Event()
     stream = await client.watch("pods", namespace="default")
+
+    def _note(pod, from_relist: bool = False) -> None:
+        name = pod.metadata.name
+        if name not in bound_at:
+            bound_at[name] = time.perf_counter()
+            bound[name] = pod.spec.node_name
+            if from_relist:
+                relisted.add(name)
+        if len(bound_at) >= want:
+            done.set()
 
     async def count_bound():
         # Watch-first; if the stream closes (slow-consumer overflow at
         # high density), fall back to relisting — the reflector's
         # recovery — instead of hanging until the harness timeout.
+        # Relist-stamped bound times quantize to the poll interval, so
+        # they count for completion but are excluded from percentiles.
         while True:
             ev = await stream.next()
             if ev is None or ev[0] == "CLOSED":
@@ -229,30 +260,48 @@ async def run_density(n_nodes: int = 100, n_pods: int = 3000,
             if ev_type == "BOOKMARK":
                 continue
             if ev_type in ("ADDED", "MODIFIED") and pod.spec.node_name:
-                bound[pod.metadata.name] = pod.spec.node_name
-                if len(bound) >= n_pods:
-                    done.set()
-                    return
-        while not done.is_set():
+                _note(pod)
+        while True:
             pods, _ = await client.list("pods", namespace="default")
             for pod in pods:
                 if pod.spec.node_name:
-                    bound[pod.metadata.name] = pod.spec.node_name
-            if len(bound) >= n_pods:
-                done.set()
-                return
+                    _note(pod, from_relist=True)
             await asyncio.sleep(0.5)
 
     async def create_all():
         for i in range(n_pods):
-            await client.create(density_pod(f"density-{i:05d}"))
+            name = f"density-{i:05d}"
+            created_at[name] = time.perf_counter()
+            await client.create(density_pod(name))
 
     counter = asyncio.create_task(count_bound())
+    want = n_pods
     start = time.perf_counter()
+    paced_out: dict = {}
     try:
         await create_all()
         await asyncio.wait_for(done.wait(), timeout)
         wall = time.perf_counter() - start
+
+        # Phase B: paced latency (closed-ish loop below saturation). A
+        # timeout here reports a paced_error instead of discarding the
+        # phase-A throughput already measured.
+        if paced_pods > 0 and paced_rate > 0:
+            done.clear()
+            want = n_pods + paced_pods
+            paced_out = {"paced_pods": paced_pods, "paced_rate": paced_rate}
+            created_at.update(await run_paced_creates(
+                paced_pods, paced_rate,
+                lambda name: client.create(density_pod(name))))
+            try:
+                await asyncio.wait_for(done.wait(), timeout)
+                paced_out.update(latency_percentiles(
+                    created_at, bound_at, prefix="paced-",
+                    exclude=relisted, ndigits=3))
+            except asyncio.TimeoutError:
+                paced_out["paced_error"] = (
+                    f"timeout: {len(bound_at) - n_pods}/{paced_pods} "
+                    f"paced pods bound within {timeout}s")
     finally:
         stream.cancel()
         counter.cancel()
@@ -262,17 +311,26 @@ async def run_density(n_nodes: int = 100, n_pods: int = 3000,
     for node_name in bound.values():
         per_node[node_name] = per_node.get(node_name, 0) + 1
     hist = sched_metrics.E2E_SCHEDULING_LATENCY
-    return {
+    out = {
         "nodes": n_nodes,
         "pods": n_pods,
         "via": via,
         "wall_seconds": round(wall, 3),
         "pods_per_second": round(n_pods / wall, 2),
         "max_pods_per_node": max(per_node.values(), default=0),
-        "schedule_latency_p50_ms": round(hist.quantile(0.50) * 1e3, 3),
-        "schedule_latency_p90_ms": round(hist.quantile(0.90) * 1e3, 3),
-        "schedule_latency_p99_ms": round(hist.quantile(0.99) * 1e3, 3),
+        # Internal pop->bind-ack histogram, kept as a diagnostic only:
+        # at saturation it reads the bind backlog, not pipeline speed.
+        "e2e_histogram_p50_ms": round(hist.quantile(0.50) * 1e3, 3),
     }
+    sat = latency_percentiles(created_at, bound_at, prefix="density-",
+                              exclude=relisted, key="saturation_latency",
+                              ndigits=3)
+    sat.pop("saturation_latency_p90_ms", None)
+    out.update(sat)
+    if relisted:
+        out["relist_stamped"] = len(relisted)
+    out.update(paced_out)
+    return out
 
 
 if __name__ == "__main__":

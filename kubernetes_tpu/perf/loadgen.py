@@ -31,7 +31,7 @@ import time
 import aiohttp
 
 from ..client.rest import RESTClient
-from . import pct as _pct
+from . import latency_percentiles, pct as _pct, run_paced_creates
 from .density import density_pod
 
 
@@ -146,26 +146,12 @@ async def run_load(server: str, n_pods: int, concurrency: int = 64,
 
         # Phase B: paced latency (closed-ish loop below saturation).
         if paced_pods > 0 and rate > 0:
-            paced_created: dict[str, float] = {}
-            interval = 1.0 / rate
-            for i in range(paced_pods):
-                name = f"paced-{i:05d}"
-                t0 = time.perf_counter()
-                paced_created[name] = t0
-                await client.create(density_pod(name))
-                sleep = interval - (time.perf_counter() - t0)
-                if sleep > 0:
-                    await asyncio.sleep(sleep)
+            paced_created = await run_paced_creates(
+                paced_pods, rate,
+                lambda name: client.create(density_pod(name)))
             await watcher.wait_for(n_pods + paced_pods, timeout)
-            lats = sorted(watcher.bound_at[n] - paced_created[n]
-                          for n in paced_created if n in watcher.bound_at)
-            out.update({
-                "paced_pods": paced_pods,
-                "paced_rate": rate,
-                "schedule_latency_p50_ms": round(_pct(lats, 0.50) * 1e3, 1),
-                "schedule_latency_p90_ms": round(_pct(lats, 0.90) * 1e3, 1),
-                "schedule_latency_p99_ms": round(_pct(lats, 0.99) * 1e3, 1),
-            })
+            out.update({"paced_pods": paced_pods, "paced_rate": rate})
+            out.update(latency_percentiles(paced_created, watcher.bound_at))
     finally:
         poke.cancel()
         await watcher.stop()

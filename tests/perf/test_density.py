@@ -4,15 +4,21 @@ from kubernetes_tpu.perf.density import run_density
 
 
 async def test_density_small():
-    res = await run_density(n_nodes=10, n_pods=100, timeout=60)
+    res = await run_density(n_nodes=10, n_pods=100, timeout=60,
+                            paced_pods=50, paced_rate=50.0)
     assert res["pods_per_second"] > 8.0  # the reference saturation floor
+    # The headline percentiles come from the PACED phase (external
+    # create->bound under sub-saturation load), not the open-loop blast.
+    assert res["paced_pods"] == 50
     assert res["schedule_latency_p50_ms"] < 5000
+    assert "saturation_latency_p50_ms" in res
 
 
 async def test_density_respects_capacity():
     # 2 nodes x 110 pod slots: 200 pods must all bind without any node
     # exceeding its pods allocatable.
-    res = await run_density(n_nodes=2, n_pods=200, timeout=60)
+    res = await run_density(n_nodes=2, n_pods=200, timeout=60,
+                            paced_pods=0)
     assert res["max_pods_per_node"] <= 110
 
 
