@@ -838,28 +838,78 @@ class Registry:
         covering = [p for p in pdbs
                     if p.spec.selector is None
                     or p.spec.selector.matches(pod.metadata.labels)]
-        if eviction.override_budget:
-            # The escape hatch must actually open: record the
-            # disruption in EVERY covering budget, no gate — a dead
-            # node's pod covered by two overlapping PDBs still has to
-            # go somewhere else.
-            for pdb in covering:
-                self._check_and_decrement(
-                    pdb.metadata.namespace, pdb.metadata.name,
-                    pod.metadata.name, override=True)
-        elif len(covering) > 1:
-            # Reference parity: ambiguous coverage is a hard error for
-            # VOLUNTARY evictions.
-            raise errors.ServiceUnavailableError(
-                f"pod {namespace}/{name} is covered by more than one "
-                f"PodDisruptionBudget ({sorted(p.metadata.name for p in covering)})")
-        elif covering:
-            self._check_and_decrement(covering[0].metadata.namespace,
-                                      covering[0].metadata.name,
-                                      pod.metadata.name, override=False)
-        return self.delete(
-            "pods", namespace, name,
-            grace_period_seconds=eviction.grace_period_seconds)
+        charged: list[tuple[str, str, bool]] = []  # (ns, pdb, decremented)
+        try:
+            if eviction.override_budget:
+                # The escape hatch must actually open: record the
+                # disruption in EVERY covering budget, no gate — a dead
+                # node's pod covered by two overlapping PDBs still has
+                # to go somewhere else.
+                for pdb in covering:
+                    self._check_and_decrement(
+                        pdb.metadata.namespace, pdb.metadata.name,
+                        pod.metadata.name, override=True)
+                    charged.append((pdb.metadata.namespace,
+                                    pdb.metadata.name, False))
+            elif len(covering) > 1:
+                # Reference parity: ambiguous coverage is a hard error
+                # for VOLUNTARY evictions. details.cause marks this a
+                # BUDGET refusal — callers' escalation clocks key on it
+                # and must never start on a generic 503.
+                raise errors.ServiceUnavailableError(
+                    f"pod {namespace}/{name} is covered by more than one "
+                    f"PodDisruptionBudget "
+                    f"({sorted(p.metadata.name for p in covering)})",
+                    details={"cause": "DisruptionBudget"})
+            elif covering:
+                self._check_and_decrement(covering[0].metadata.namespace,
+                                          covering[0].metadata.name,
+                                          pod.metadata.name, override=False)
+                charged.append((covering[0].metadata.namespace,
+                                covering[0].metadata.name, True))
+        except errors.StatusError:
+            # A later budget's CAS storm must not leave an earlier
+            # budget charged for a disruption that never happened.
+            for cns, cname, decremented in charged:
+                self._refund_charge(cns, cname, pod.metadata.name,
+                                    decremented)
+            raise
+        try:
+            return self.delete(
+                "pods", namespace, name,
+                grace_period_seconds=eviction.grace_period_seconds)
+        except errors.StatusError:
+            # The delete did not happen (pod vanished between get and
+            # delete, store refusal): a charged-but-undisrupted budget
+            # would block legitimate evictions for the controller's
+            # disrupted-pods timeout, so best-effort refund it.
+            for cns, cname, decremented in charged:
+                self._refund_charge(cns, cname, pod.metadata.name,
+                                    decremented)
+            raise
+
+    def _refund_charge(self, ns: str, pdb_name: str, pod_name: str,
+                       decremented: bool) -> None:
+        """Best-effort undo of _check_and_decrement's accounting."""
+        for _ in range(self.EVICTION_CAS_RETRIES):
+            try:
+                pdb = self.get("poddisruptionbudgets", ns, pdb_name)
+            except errors.NotFoundError:
+                return
+            st = pdb.status
+            if pod_name not in st.disrupted_pods:
+                return  # controller already pruned it
+            st.disrupted_pods = {k: v for k, v in st.disrupted_pods.items()
+                                 if k != pod_name}
+            if decremented:
+                st.disruptions_allowed += 1
+            try:
+                self.update(pdb, subresource="status")
+                return
+            except errors.ConflictError:
+                continue
+            except errors.StatusError:
+                return  # refund is best-effort by design
 
     def _check_and_decrement(self, ns: str, pdb_name: str, pod_name: str,
                              override: bool = False) -> None:

@@ -29,7 +29,7 @@ from typing import Optional
 from aiohttp import web
 
 from ..api import errors
-from ..api.scheme import to_dict
+from ..api.scheme import deepcopy as obj_deepcopy, to_dict
 from ..metrics.registry import REGISTRY as METRICS, Histogram
 from .admission import default_chain
 from .audit import LEVEL_REQUEST, AuditLogger
@@ -706,9 +706,16 @@ class APIServer:
                     if resolved == peer:
                         admitted.append(claim)
             sans = admitted
-            for addr in (peer, "127.0.0.1", "localhost"):
-                if addr and addr not in sans:
-                    sans.append(addr)
+            if peer and peer not in sans:
+                sans.append(peer)
+            # Loopback SANs only for loopback joiners (local/dev): a
+            # remote node's serving cert valid for 127.0.0.1 would
+            # verify as ANY node whenever a client falls back to
+            # loopback — one compromised node impersonates them all.
+            if not peer or peer in ("127.0.0.1", "::1", "localhost"):
+                for addr in ("127.0.0.1", "localhost"):
+                    if addr not in sans:
+                        sans.append(addr)
         # Validate the CSR BEFORE any durable mutation: a garbage CSR
         # must not leave behind a credential Secret + ClusterRoleBinding
         # nobody received (and must not audit as a success).
@@ -909,8 +916,13 @@ class APIServer:
             # (admission.go: validating phase after all mutation). The
             # extra pass is skipped when no validating hook matches.
             if self.webhooks.has_validating("CREATE", plural):
+                # Deep-copy for the preview: dry_run skips store side
+                # effects but stamp/default/admission still mutate the
+                # instance in place, and the real write below must not
+                # receive a pre-mutated object (idempotence of every
+                # admission plugin is not a contract we want to lean on).
                 admitted = await self._mutate(
-                    self.registry.create, obj, True)
+                    self.registry.create, obj_deepcopy(obj), True)
                 await self.webhooks.run_validating(
                     "CREATE", plural, ns, obj.metadata.name,
                     to_dict(admitted))
@@ -1089,7 +1101,7 @@ class APIServer:
             # _create); dry-run has no allocator/store side effects.
             if self.webhooks.has_validating("UPDATE", plural):
                 admitted = await self._mutate(
-                    self.registry.update, obj, sub, True)
+                    self.registry.update, obj_deepcopy(obj), sub, True)
                 await self.webhooks.run_validating(
                     "UPDATE", plural, ns, obj.metadata.name,
                     to_dict(admitted), old)
@@ -1130,6 +1142,22 @@ class APIServer:
                 obj = scheme.decode(hub)
                 obj.metadata.resource_version = \
                     old_obj.metadata.resource_version
+                # Admission webhooks see the merged hub object, exactly
+                # as on the storage-version PATCH path below — a served
+                # alternate version must not be a policy bypass.
+                if self.webhooks.has_hooks("UPDATE", plural):
+                    old = to_dict(old_obj)
+                    d = await self.webhooks.run_mutating(
+                        "UPDATE", plural, ns, name, to_dict(obj), old)
+                    obj = scheme.decode(d)
+                    obj.metadata.resource_version = \
+                        old_obj.metadata.resource_version
+                    if self.webhooks.has_validating("UPDATE", plural):
+                        admitted = await self._mutate(
+                            self.registry.update, obj_deepcopy(obj), sub, True)
+                        await self.webhooks.run_validating(
+                            "UPDATE", plural, ns, name,
+                            to_dict(admitted), old)
                 try:
                     updated = await self._mutate(
                         self.registry.update, obj, sub)
@@ -1156,7 +1184,7 @@ class APIServer:
                 # _create).
                 if self.webhooks.has_validating("UPDATE", plural):
                     admitted = await self._mutate(
-                        self.registry.update, obj, sub, True)
+                        self.registry.update, obj_deepcopy(obj), sub, True)
                     await self.webhooks.run_validating(
                         "UPDATE", plural, ns, name, to_dict(admitted), old)
                 try:

@@ -262,14 +262,31 @@ class NodeLifecycleController(Controller):
             if e.details.get("cause") != "DisruptionBudget":
                 self._schedule_eviction(pod.key(), 10.0)
                 return
-            # Budget says no: note when we first asked and retry —
-            # voluntary for PDB_ESCALATE_S, involuntary after.
-            self._pdb_blocked.setdefault(pod.key(), time.monotonic())
-            self.recorder.event(
-                pod, "Warning", "TaintEvictionBlocked",
-                "eviction blocked by a PodDisruptionBudget; will "
-                f"escalate in {self.PDB_ESCALATE_S:.0f}s")
-            self._schedule_eviction(pod.key(), 10.0)
+            self._note_pdb_blocked(pod, "a PodDisruptionBudget")
+        except errors.ServiceUnavailableError as e:
+            # Ambiguous coverage (>1 PDB) is a 503 from the eviction
+            # subresource, marked by details.cause. Only THAT 503
+            # starts the escalation clock — a generic 503 (apiserver
+            # draining, proxy hiccup) escalating into override_budget
+            # would punch through healthy budgets, the exact failure
+            # the 429 path's cause check prevents. The pod still sits
+            # on a NoExecute-tainted node, so after PDB_ESCALATE_S the
+            # retry goes out with override_budget, which records in
+            # EVERY covering budget instead of gating.
+            if e.details.get("cause") != "DisruptionBudget":
+                self._schedule_eviction(pod.key(), 10.0)
+                return
+            self._note_pdb_blocked(pod, "overlapping PodDisruptionBudgets")
+
+    def _note_pdb_blocked(self, pod: t.Pod, why: str) -> None:
+        # Budget says no: note when we first asked and retry —
+        # voluntary for PDB_ESCALATE_S, involuntary after.
+        self._pdb_blocked.setdefault(pod.key(), time.monotonic())
+        self.recorder.event(
+            pod, "Warning", "TaintEvictionBlocked",
+            f"eviction blocked by {why}; will "
+            f"escalate in {self.PDB_ESCALATE_S:.0f}s")
+        self._schedule_eviction(pod.key(), 10.0)
 
     def _escalated(self, pod: t.Pod) -> bool:
         first = self._pdb_blocked.get(pod.key())

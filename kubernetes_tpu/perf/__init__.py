@@ -37,10 +37,14 @@ def latency_percentiles(created: dict, bound_at: dict, prefix: str = "",
                         ndigits: int = 2) -> dict:
     """create->bound percentiles for pods whose timestamps are trusted
     (``exclude`` drops pods whose bound time came from a coarse relist
-    poll rather than a watch event)."""
+    poll rather than a watch event). An empty trusted sample returns {}
+    — 0.0ms percentiles would read as an impossibly good measurement,
+    not as "nothing was measured"."""
     lats = sorted(bound_at[n] - created[n] for n in created
                   if n.startswith(prefix) and n in bound_at
                   and n not in exclude)
+    if not lats:
+        return {}
     return {
         f"{key}_p50_ms": round(pct(lats, 0.50) * 1e3, ndigits),
         f"{key}_p90_ms": round(pct(lats, 0.90) * 1e3, ndigits),

@@ -290,10 +290,10 @@ async def run_density(n_nodes: int = 100, n_pods: int = 3000,
             done.clear()
             want = n_pods + paced_pods
             paced_out = {"paced_pods": paced_pods, "paced_rate": paced_rate}
-            created_at.update(await run_paced_creates(
-                paced_pods, paced_rate,
-                lambda name: client.create(density_pod(name))))
             try:
+                created_at.update(await run_paced_creates(
+                    paced_pods, paced_rate,
+                    lambda name: client.create(density_pod(name))))
                 await asyncio.wait_for(done.wait(), timeout)
                 paced_out.update(latency_percentiles(
                     created_at, bound_at, prefix="paced-",
@@ -302,6 +302,8 @@ async def run_density(n_nodes: int = 100, n_pods: int = 3000,
                 paced_out["paced_error"] = (
                     f"timeout: {len(bound_at) - n_pods}/{paced_pods} "
                     f"paced pods bound within {timeout}s")
+            except Exception as exc:  # noqa: BLE001 — keep phase A
+                paced_out["paced_error"] = str(exc)[:200]
     finally:
         stream.cancel()
         counter.cancel()

@@ -38,6 +38,21 @@ def pytest_pyfunc_call(pyfuncitem):
         if pyfuncitem.get_closest_marker("slow") is not None:
             soak = float(os.environ.get("KTPU_SOAK_SECONDS", "60"))
             timeout = max(timeout, 2 * soak + 180)
-        asyncio.run(asyncio.wait_for(fn(**kwargs), timeout=timeout))
+        async def _run():
+            try:
+                await asyncio.wait_for(fn(**kwargs), timeout=timeout)
+            finally:
+                # Collect garbage WHILE the loop is still running:
+                # aiohttp transports/connectors dropped without close()
+                # otherwise reach their finalizers after asyncio.run
+                # closed the loop and raise unraisable "Event loop is
+                # closed" — noise that would mask real teardown bugs.
+                import gc
+                for _ in range(2):  # 2nd pass: subprocess transports
+                    gc.collect()
+                    # One tick so call_soon'd close callbacks scheduled
+                    # by the finalizers run before the loop shuts down.
+                    await asyncio.sleep(0)
+        asyncio.run(_run())
         return True
     return None
