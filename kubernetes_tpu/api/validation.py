@@ -11,6 +11,7 @@ from __future__ import annotations
 import re
 from typing import Optional
 
+from . import rbac as rb
 from . import types as t
 from . import workloads as w
 from .errors import InvalidError
@@ -351,13 +352,539 @@ def validate_podgroup(pg: t.PodGroup, is_create: bool = True) -> None:
     errs.raise_if_any("PodGroup", pg.metadata.name)
 
 
+_SERVICE_TYPES = ("ClusterIP", "NodePort", "LoadBalancer")
+_PROTOCOLS = ("TCP", "UDP", "SCTP")
+#: The reference's --service-node-port-range default
+#: (``pkg/master/master.go`` DefaultServiceNodePortRange).
+NODE_PORT_RANGE = (30000, 32767)
+
+
+def _valid_ip(s: str) -> bool:
+    import ipaddress
+    try:
+        ipaddress.ip_address(s)
+        return True
+    except ValueError:
+        return False
+
+
 def validate_service(svc: t.Service, is_create: bool = True) -> None:
+    """Reference: ``validation.go ValidateService`` — port ranges and
+    uniqueness, NodePort range, protocol/type enums, clusterIP syntax."""
     errs = ErrorList()
     validate_object_meta(svc.metadata, errs)
+    if not svc.spec.ports:
+        errs.add("spec.ports", "at least one port is required")
+    names = set()
     for i, p in enumerate(svc.spec.ports):
         if not (0 < p.port < 65536):
             errs.add(f"spec.ports[{i}].port", "must be 1-65535")
+        if p.target_port and not (0 < p.target_port < 65536):
+            errs.add(f"spec.ports[{i}].target_port", "must be 1-65535")
+        if p.protocol not in _PROTOCOLS:
+            errs.add(f"spec.ports[{i}].protocol",
+                     f"must be one of {_PROTOCOLS}")
+        if len(svc.spec.ports) > 1:
+            if not p.name:
+                errs.add(f"spec.ports[{i}].name",
+                         "required when more than one port is defined")
+            elif p.name in names:
+                errs.add(f"spec.ports[{i}].name", f"duplicate {p.name!r}")
+            names.add(p.name)
+        if p.node_port:
+            lo, hi = NODE_PORT_RANGE
+            if not (lo <= p.node_port <= hi):
+                errs.add(f"spec.ports[{i}].node_port",
+                         f"must be in the node-port range {lo}-{hi}")
+            if svc.spec.type == "ClusterIP":
+                errs.add(f"spec.ports[{i}].node_port",
+                         "may not be set for type ClusterIP")
+    if svc.spec.type not in _SERVICE_TYPES:
+        errs.add("spec.type", f"must be one of {_SERVICE_TYPES}")
+    ip = svc.spec.cluster_ip
+    if ip and ip != "None" and not _valid_ip(ip):
+        errs.add("spec.cluster_ip", f"must be empty, 'None', or an IP; got {ip!r}")
+    validate_labels(svc.spec.selector, "spec.selector", errs)
     errs.raise_if_any("Service", svc.metadata.name)
+
+
+def validate_service_update(new: t.Service, old: t.Service) -> None:
+    validate_service(new, is_create=False)
+    errs = ErrorList()
+    # Reference: ValidateServiceUpdate — clusterIP is immutable once
+    # allocated (flipping it would strand every established flow).
+    if old.spec.cluster_ip and new.spec.cluster_ip != old.spec.cluster_ip:
+        errs.add("spec.cluster_ip", "is immutable once set")
+    errs.raise_if_any("Service", new.metadata.name)
+
+
+def validate_endpoints(ep: t.Endpoints, is_create: bool = True) -> None:
+    """Reference: ``validation.go ValidateEndpoints``."""
+    errs = ErrorList()
+    validate_object_meta(ep.metadata, errs)
+    for i, ss in enumerate(ep.subsets):
+        for fname in ("addresses", "not_ready_addresses"):
+            for j, a in enumerate(getattr(ss, fname)):
+                if not _valid_ip(a.ip):
+                    errs.add(f"subsets[{i}].{fname}[{j}].ip",
+                             f"invalid IP {a.ip!r}")
+        for j, p in enumerate(ss.ports):
+            if not (0 < p.port < 65536):
+                errs.add(f"subsets[{i}].ports[{j}].port", "must be 1-65535")
+            if p.protocol not in _PROTOCOLS:
+                errs.add(f"subsets[{i}].ports[{j}].protocol",
+                         f"must be one of {_PROTOCOLS}")
+    errs.raise_if_any("Endpoints", ep.metadata.name)
+
+
+_CONFIG_KEY_RE = re.compile(r"^[-._a-zA-Z0-9]+$")
+MAX_CONFIG_BYTES = 1024 * 1024  # reference: MaxSecretSize / ConfigMap cap
+
+
+def validate_configmap(cm: t.ConfigMap, is_create: bool = True) -> None:
+    errs = ErrorList()
+    validate_object_meta(cm.metadata, errs)
+    total = 0
+    for k, v in cm.data.items():
+        if not _CONFIG_KEY_RE.match(k):
+            errs.add(f"data[{k!r}]",
+                     "key must match [-._a-zA-Z0-9]+")
+        total += len(k.encode()) + len(str(v).encode())  # bytes, not chars
+    if total > MAX_CONFIG_BYTES:
+        errs.add("data", f"total size {total} exceeds {MAX_CONFIG_BYTES}")
+    errs.raise_if_any("ConfigMap", cm.metadata.name)
+
+
+def validate_event(ev: t.Event, is_create: bool = True) -> None:
+    errs = ErrorList()
+    validate_object_meta(ev.metadata, errs)
+    if not ev.involved_object.kind or not ev.involved_object.name:
+        errs.add("involved_object", "kind and name are required")
+    if ev.type not in ("Normal", "Warning"):
+        errs.add("type", "must be Normal or Warning")
+    errs.raise_if_any("Event", ev.metadata.name)
+
+
+def _validate_quantities(d: dict, path: str, errs: ErrorList) -> None:
+    for k, v in d.items():
+        try:
+            if t.parse_quantity(v) < 0:
+                errs.add(f"{path}[{k}]", "must be non-negative")
+        except ValueError:
+            errs.add(f"{path}[{k}]", f"unparseable quantity {v!r}")
+
+
+def validate_resourcequota(rq: t.ResourceQuota,
+                           is_create: bool = True) -> None:
+    errs = ErrorList()
+    validate_object_meta(rq.metadata, errs)
+    _validate_quantities(rq.spec.hard, "spec.hard", errs)
+    errs.raise_if_any("ResourceQuota", rq.metadata.name)
+
+
+def validate_limitrange(lr: t.LimitRange, is_create: bool = True) -> None:
+    """Reference: ``validation.go ValidateLimitRange`` — per-item
+    quantity syntax plus the min <= default_request <= default <= max
+    ordering for every resource that appears."""
+    errs = ErrorList()
+    validate_object_meta(lr.metadata, errs)
+    for i, item in enumerate(lr.spec.limits):
+        p = f"spec.limits[{i}]"
+        if item.type not in ("Container", "Pod"):
+            errs.add(f"{p}.type", "must be Container or Pod")
+        for fname in ("min", "max", "default", "default_request"):
+            _validate_quantities(getattr(item, fname), f"{p}.{fname}", errs)
+        ordered = ("min", "default_request", "default", "max")
+        resources = set()
+        for fname in ordered:
+            resources.update(getattr(item, fname))
+        for res in sorted(resources):
+            chain = []
+            for fname in ordered:
+                v = getattr(item, fname).get(res)
+                if v is None:
+                    continue
+                try:
+                    chain.append((fname, t.parse_quantity(v)))
+                except ValueError:
+                    break  # already reported above
+            for (an, av), (bn, bv) in zip(chain, chain[1:]):
+                if av > bv:
+                    errs.add(f"{p}", f"{an}[{res}]={av} exceeds {bn}[{res}]={bv}")
+    errs.raise_if_any("LimitRange", lr.metadata.name)
+
+
+#: Reference: ``pkg/apis/scheduling/validation`` — user classes are
+#: capped below the system band.
+MAX_PRIORITY = 1_000_000_000
+
+
+def validate_priorityclass(pc: t.PriorityClass,
+                           is_create: bool = True) -> None:
+    errs = ErrorList()
+    validate_object_meta(pc.metadata, errs, namespaced=False)
+    if (abs(pc.value) > MAX_PRIORITY
+            and not pc.metadata.name.startswith("system-")):
+        errs.add("value", f"must be within ±{MAX_PRIORITY} for user classes")
+    if pc.preemption_policy not in ("PreemptLowerPriority", "Never"):
+        errs.add("preemption_policy",
+                 "must be PreemptLowerPriority or Never")
+    errs.raise_if_any("PriorityClass", pc.metadata.name)
+
+
+def validate_priorityclass_update(new: t.PriorityClass,
+                                  old: t.PriorityClass) -> None:
+    validate_priorityclass(new, is_create=False)
+    errs = ErrorList()
+    # Reference: priority value is immutable — running pods captured it.
+    if new.value != old.value:
+        errs.add("value", "is immutable")
+    errs.raise_if_any("PriorityClass", new.metadata.name)
+
+
+def validate_lease(lease: t.Lease, is_create: bool = True) -> None:
+    errs = ErrorList()
+    validate_object_meta(lease.metadata, errs)
+    if lease.spec.lease_duration_seconds <= 0:
+        errs.add("spec.lease_duration_seconds", "must be positive")
+    errs.raise_if_any("Lease", lease.metadata.name)
+
+
+def validate_serviceaccount(sa: t.ServiceAccount,
+                            is_create: bool = True) -> None:
+    errs = ErrorList()
+    validate_object_meta(sa.metadata, errs)
+    for i, s in enumerate(sa.secrets):
+        validate_name(s, f"secrets[{i}]", errs)
+    errs.raise_if_any("ServiceAccount", sa.metadata.name)
+
+
+# ---------------------------------------------------------------------------
+# Storage
+# ---------------------------------------------------------------------------
+
+_ACCESS_MODES = ("ReadWriteOnce", "ReadOnlyMany", "ReadWriteMany")
+
+
+def _validate_access_modes(modes, path: str, errs: ErrorList) -> None:
+    if not modes:
+        errs.add(path, "at least one access mode is required")
+    for m in modes:
+        if m not in _ACCESS_MODES:
+            errs.add(path, f"unknown access mode {m!r}")
+
+
+def validate_persistentvolume(pv: t.PersistentVolume,
+                              is_create: bool = True) -> None:
+    """Reference: ``validation.go ValidatePersistentVolume``."""
+    errs = ErrorList()
+    validate_object_meta(pv.metadata, errs, namespaced=False)
+    storage = pv.spec.capacity.get("storage")
+    if storage is None:
+        errs.add("spec.capacity.storage", "is required")
+    else:
+        try:
+            if t.parse_quantity(storage) <= 0:
+                errs.add("spec.capacity.storage", "must be positive")
+        except ValueError:
+            errs.add("spec.capacity.storage",
+                     f"unparseable quantity {storage!r}")
+    _validate_access_modes(pv.spec.access_modes, "spec.access_modes", errs)
+    sources = [s for s in (pv.spec.host_path, pv.spec.csi) if s is not None]
+    if len(sources) != 1:
+        errs.add("spec", "exactly one volume source (host_path or csi) "
+                         "is required")
+    if pv.spec.persistent_volume_reclaim_policy not in (
+            t.RECLAIM_RETAIN, t.RECLAIM_DELETE):
+        errs.add("spec.persistent_volume_reclaim_policy",
+                 "must be Retain or Delete")
+    errs.raise_if_any("PersistentVolume", pv.metadata.name)
+
+
+def validate_persistentvolume_update(new: t.PersistentVolume,
+                                     old: t.PersistentVolume) -> None:
+    validate_persistentvolume(new, is_create=False)
+    errs = ErrorList()
+    # Reference: the backing source is immutable.
+    if (new.spec.host_path, new.spec.csi) != (old.spec.host_path,
+                                              old.spec.csi):
+        errs.add("spec", "volume source is immutable")
+    errs.raise_if_any("PersistentVolume", new.metadata.name)
+
+
+def validate_persistentvolumeclaim(pvc: t.PersistentVolumeClaim,
+                                   is_create: bool = True) -> None:
+    errs = ErrorList()
+    validate_object_meta(pvc.metadata, errs)
+    _validate_access_modes(pvc.spec.access_modes, "spec.access_modes", errs)
+    req = pvc.spec.resources.requests.get("storage")
+    if req is None:
+        errs.add("spec.resources.requests.storage", "is required")
+    else:
+        try:
+            if t.parse_quantity(req) <= 0:
+                errs.add("spec.resources.requests.storage",
+                         "must be positive")
+        except ValueError:
+            errs.add("spec.resources.requests.storage",
+                     f"unparseable quantity {req!r}")
+    errs.raise_if_any("PersistentVolumeClaim", pvc.metadata.name)
+
+
+def validate_persistentvolumeclaim_update(new: t.PersistentVolumeClaim,
+                                          old: t.PersistentVolumeClaim
+                                          ) -> None:
+    validate_persistentvolumeclaim(new, is_create=False)
+    errs = ErrorList()
+    # Reference: PVC spec is immutable after creation except the
+    # storage request, which may only GROW (expansion).
+    if new.spec.access_modes != old.spec.access_modes:
+        errs.add("spec.access_modes", "is immutable")
+    if new.spec.storage_class_name != old.spec.storage_class_name:
+        errs.add("spec.storage_class_name", "is immutable")
+    if old.spec.volume_name and new.spec.volume_name != old.spec.volume_name:
+        errs.add("spec.volume_name", "is immutable once bound")
+    try:
+        n = t.parse_quantity(new.spec.resources.requests.get("storage", 0))
+        o = t.parse_quantity(old.spec.resources.requests.get("storage", 0))
+        if n < o:
+            errs.add("spec.resources.requests.storage",
+                     "may not shrink (expansion only)")
+    except ValueError:
+        pass  # syntax already reported by the create-shape pass
+    errs.raise_if_any("PersistentVolumeClaim", new.metadata.name)
+
+
+def validate_storageclass(sc: t.StorageClass,
+                          is_create: bool = True) -> None:
+    errs = ErrorList()
+    validate_object_meta(sc.metadata, errs, namespaced=False)
+    if not sc.provisioner:
+        errs.add("provisioner", "is required")
+    if sc.reclaim_policy not in (t.RECLAIM_RETAIN, t.RECLAIM_DELETE):
+        errs.add("reclaim_policy", "must be Retain or Delete")
+    errs.raise_if_any("StorageClass", sc.metadata.name)
+
+
+def validate_storageclass_update(new: t.StorageClass,
+                                 old: t.StorageClass) -> None:
+    validate_storageclass(new, is_create=False)
+    errs = ErrorList()
+    if new.provisioner != old.provisioner:
+        errs.add("provisioner", "is immutable")
+    if new.parameters != old.parameters:
+        errs.add("parameters", "is immutable")
+    errs.raise_if_any("StorageClass", new.metadata.name)
+
+
+# ---------------------------------------------------------------------------
+# RBAC
+# ---------------------------------------------------------------------------
+
+
+def _validate_rules(rules, errs: ErrorList) -> None:
+    for i, rule in enumerate(rules):
+        if not rule.verbs:
+            errs.add(f"rules[{i}].verbs", "at least one verb is required")
+        if not rule.resources:
+            errs.add(f"rules[{i}].resources",
+                     "at least one resource is required")
+
+
+def validate_role(role, is_create: bool = True) -> None:
+    errs = ErrorList()
+    _validate_rules(role.rules, errs)
+    errs.raise_if_any(type(role).__name__, role.metadata.name)
+
+
+def validate_rolebinding(b, is_create: bool = True) -> None:
+    errs = ErrorList()
+    if not b.role_ref.name:
+        errs.add("role_ref.name", "is required")
+    if b.role_ref.kind not in ("Role", "ClusterRole"):
+        errs.add("role_ref.kind", "must be Role or ClusterRole")
+    if isinstance(b, rb.ClusterRoleBinding) and b.role_ref.kind != "ClusterRole":
+        errs.add("role_ref.kind",
+                 "ClusterRoleBinding may only reference a ClusterRole")
+    for i, s in enumerate(b.subjects):
+        if not s.name:
+            errs.add(f"subjects[{i}].name", "is required")
+        if s.kind not in ("User", "Group", "ServiceAccount"):
+            errs.add(f"subjects[{i}].kind",
+                     "must be User, Group, or ServiceAccount")
+    errs.raise_if_any(type(b).__name__, b.metadata.name)
+
+
+def validate_rolebinding_update(new, old) -> None:
+    validate_rolebinding(new, is_create=False)
+    errs = ErrorList()
+    # Reference: ValidateRoleBindingUpdate — roleRef is immutable
+    # (changing it silently re-points every subject's grant).
+    if (new.role_ref.kind, new.role_ref.name) != (old.role_ref.kind,
+                                                  old.role_ref.name):
+        errs.add("role_ref", "is immutable; delete and recreate the binding")
+    errs.raise_if_any(type(new).__name__, new.metadata.name)
+
+
+# ---------------------------------------------------------------------------
+# Remaining workloads
+# ---------------------------------------------------------------------------
+
+
+def validate_daemonset(ds: w.DaemonSet, is_create: bool = True) -> None:
+    errs = ErrorList()
+    validate_object_meta(ds.metadata, errs)
+    _validate_template_matches(ds.spec.selector, ds.spec.template, errs)
+    if ds.spec.update_strategy not in (w.ROLLING_UPDATE, "OnDelete"):
+        errs.add("spec.update_strategy",
+                 f"unknown strategy {ds.spec.update_strategy!r}")
+    errs.raise_if_any("DaemonSet", ds.metadata.name)
+
+
+def _selector_immutable(new_sel, old_sel, errs: ErrorList) -> None:
+    """apps/v1 semantics: label selectors are immutable — mutating one
+    silently orphans or captures pods (the reference made this a hard
+    rule at v1, ValidateDeploymentUpdate et al.). Full structural
+    comparison: a changed expression key/op/values is as much a
+    mutation as a changed match_label."""
+    from .scheme import to_dict
+    def key(s):
+        return None if s is None else to_dict(s)
+    if key(new_sel) != key(old_sel):
+        errs.add("spec.selector", "is immutable in apps/v1")
+
+
+def validate_deployment_update(new: w.Deployment, old: w.Deployment) -> None:
+    validate_deployment(new, is_create=False)
+    errs = ErrorList()
+    _selector_immutable(new.spec.selector, old.spec.selector, errs)
+    errs.raise_if_any("Deployment", new.metadata.name)
+
+
+def validate_replicaset_update(new: w.ReplicaSet, old: w.ReplicaSet) -> None:
+    validate_replicaset(new, is_create=False)
+    errs = ErrorList()
+    _selector_immutable(new.spec.selector, old.spec.selector, errs)
+    errs.raise_if_any("ReplicaSet", new.metadata.name)
+
+
+def validate_statefulset_update(new: w.StatefulSet,
+                                old: w.StatefulSet) -> None:
+    validate_statefulset(new, is_create=False)
+    errs = ErrorList()
+    _selector_immutable(new.spec.selector, old.spec.selector, errs)
+    if new.spec.service_name != old.spec.service_name:
+        errs.add("spec.service_name", "is immutable")
+    errs.raise_if_any("StatefulSet", new.metadata.name)
+
+
+def validate_daemonset_update(new: w.DaemonSet, old: w.DaemonSet) -> None:
+    validate_daemonset(new, is_create=False)
+    errs = ErrorList()
+    _selector_immutable(new.spec.selector, old.spec.selector, errs)
+    errs.raise_if_any("DaemonSet", new.metadata.name)
+
+
+def validate_job_update(new: w.Job, old: w.Job) -> None:
+    validate_job(new, is_create=False)
+    from .scheme import to_dict
+    errs = ErrorList()
+    # Reference: ValidateJobUpdate — completions/selector/template/gang
+    # frozen; parallelism is the one mutable knob (scale).
+    if new.spec.completions != old.spec.completions:
+        errs.add("spec.completions", "is immutable")
+    if new.spec.completion_mode != old.spec.completion_mode:
+        errs.add("spec.completion_mode", "is immutable")
+    if to_dict(new.spec.selector) != to_dict(old.spec.selector):
+        errs.add("spec.selector", "is immutable")
+    if to_dict(new.spec.template) != to_dict(old.spec.template):
+        errs.add("spec.template", "is immutable")
+    if to_dict(new.spec.gang) != to_dict(old.spec.gang):
+        errs.add("spec.gang", "is immutable")
+    errs.raise_if_any("Job", new.metadata.name)
+
+
+def validate_cronjob(cj: w.CronJob, is_create: bool = True) -> None:
+    """Reference: ``pkg/apis/batch/validation ValidateCronJob`` — the
+    schedule string parses AT ADMISSION with the same parser the
+    controller runs, so a typo fails the create instead of wedging the
+    controller's sync loop."""
+    from ..util.cron import CronSchedule
+    errs = ErrorList()
+    validate_object_meta(cj.metadata, errs)
+    if not cj.spec.schedule:
+        errs.add("spec.schedule", "is required")
+    else:
+        try:
+            CronSchedule(cj.spec.schedule)
+        except (ValueError, IndexError) as e:
+            errs.add("spec.schedule", f"invalid cron expression: {e}")
+    if cj.spec.concurrency_policy not in ("Allow", "Forbid", "Replace"):
+        errs.add("spec.concurrency_policy",
+                 "must be Allow, Forbid, or Replace")
+    if (cj.spec.starting_deadline_seconds is not None
+            and cj.spec.starting_deadline_seconds < 0):
+        errs.add("spec.starting_deadline_seconds", "must be non-negative")
+    for fname in ("successful_jobs_history_limit",
+                  "failed_jobs_history_limit"):
+        if getattr(cj.spec, fname) < 0:
+            errs.add(f"spec.{fname}", "must be non-negative")
+    if cj.spec.job_template.parallelism < 0:
+        errs.add("spec.job_template.parallelism", "must be non-negative")
+    errs.raise_if_any("CronJob", cj.metadata.name)
+
+
+def validate_hpa(hpa: w.HorizontalPodAutoscaler,
+                 is_create: bool = True) -> None:
+    """Reference: ``pkg/apis/autoscaling/validation``."""
+    errs = ErrorList()
+    validate_object_meta(hpa.metadata, errs)
+    ref = hpa.spec.scale_target_ref
+    if not ref.kind or not ref.name:
+        errs.add("spec.scale_target_ref", "kind and name are required")
+    if hpa.spec.min_replicas < 1:
+        errs.add("spec.min_replicas", "must be >= 1")
+    if hpa.spec.max_replicas < hpa.spec.min_replicas:
+        errs.add("spec.max_replicas", "must be >= spec.min_replicas")
+    # >=1 only: targets above 100% are legal and common on multi-core
+    # pods (reference: autoscaling validation requires only positive).
+    if hpa.spec.target_cpu_utilization_percentage < 1:
+        errs.add("spec.target_cpu_utilization_percentage",
+                 "must be >= 1")
+    errs.raise_if_any("HorizontalPodAutoscaler", hpa.metadata.name)
+
+
+def validate_pdb(pdb: w.PodDisruptionBudget, is_create: bool = True) -> None:
+    """Reference: ``pkg/apis/policy/validation`` — min_available and
+    max_unavailable are mutually exclusive, and the selector must be
+    well-formed (a malformed one would silently cover nothing,
+    defeating the budget)."""
+    errs = ErrorList()
+    validate_object_meta(pdb.metadata, errs)
+    has_min = pdb.spec.min_available is not None
+    has_max = pdb.spec.max_unavailable is not None
+    if has_min and has_max:
+        errs.add("spec", "min_available and max_unavailable "
+                         "are mutually exclusive")
+    if not has_min and not has_max:
+        errs.add("spec", "one of min_available or max_unavailable "
+                         "is required")
+    if has_min and pdb.spec.min_available < 0:
+        errs.add("spec.min_available", "must be non-negative")
+    if has_max and pdb.spec.max_unavailable < 0:
+        errs.add("spec.max_unavailable", "must be non-negative")
+    if pdb.spec.selector is not None:
+        validate_labels(pdb.spec.selector.match_labels,
+                        "spec.selector.match_labels", errs)
+    errs.raise_if_any("PodDisruptionBudget", pdb.metadata.name)
+
+
+def validate_secret_update(new: t.Secret, old: t.Secret) -> None:
+    validate_secret(new, is_create=False)
+    errs = ErrorList()
+    if new.type != old.type:
+        errs.add("type", "is immutable")
+    errs.raise_if_any("Secret", new.metadata.name)
 
 
 def validate_secret(sec: t.Secret, is_create: bool = True) -> None:
@@ -387,11 +914,33 @@ def validate_namespace(ns: t.Namespace, is_create: bool = True) -> None:
 VALIDATORS = {
     "Pod": (validate_pod, validate_pod_update),
     "Node": (validate_node, None),
-    "ReplicaSet": (validate_replicaset, None),
-    "Deployment": (validate_deployment, None),
-    "StatefulSet": (validate_statefulset, None),
-    "Job": (validate_job, None),
+    "ReplicaSet": (validate_replicaset, validate_replicaset_update),
+    "Deployment": (validate_deployment, validate_deployment_update),
+    "StatefulSet": (validate_statefulset, validate_statefulset_update),
+    "DaemonSet": (validate_daemonset, validate_daemonset_update),
+    "Job": (validate_job, validate_job_update),
+    "CronJob": (validate_cronjob, None),
+    "HorizontalPodAutoscaler": (validate_hpa, None),
+    "PodDisruptionBudget": (validate_pdb, None),
     "PodGroup": (validate_podgroup, None),
-    "Service": (validate_service, None),
+    "Service": (validate_service, validate_service_update),
+    "Endpoints": (validate_endpoints, None),
+    "ConfigMap": (validate_configmap, None),
+    "Secret": (validate_secret, validate_secret_update),
+    "Event": (validate_event, None),
+    "ResourceQuota": (validate_resourcequota, None),
+    "LimitRange": (validate_limitrange, None),
+    "PriorityClass": (validate_priorityclass, validate_priorityclass_update),
+    "Lease": (validate_lease, None),
+    "ServiceAccount": (validate_serviceaccount, None),
+    "PersistentVolume": (validate_persistentvolume,
+                         validate_persistentvolume_update),
+    "PersistentVolumeClaim": (validate_persistentvolumeclaim,
+                              validate_persistentvolumeclaim_update),
+    "StorageClass": (validate_storageclass, validate_storageclass_update),
+    "Role": (validate_role, None),
+    "ClusterRole": (validate_role, None),
+    "RoleBinding": (validate_rolebinding, validate_rolebinding_update),
+    "ClusterRoleBinding": (validate_rolebinding, validate_rolebinding_update),
     "Namespace": (validate_namespace, None),
 }

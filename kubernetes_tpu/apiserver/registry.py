@@ -116,9 +116,12 @@ def _event_fields(ev: t.Event) -> dict:
 
 def builtin_resources() -> list[ResourceSpec]:
     """The framework's API surface (reference: pkg/master/master.go
-    InstallLegacyAPI/InstallAPIs resource table)."""
+    InstallLegacyAPI/InstallAPIs resource table). Every kind present in
+    ``validation.VALIDATORS`` gets its field validators wired
+    automatically (see the fill loop at the end) — a kind listed there
+    can never silently fall back to metadata-only checks again."""
     core = "core/v1"
-    return [
+    specs = [
         ResourceSpec("pods", "Pod", core, t.Pod, field_extractor=_pod_fields,
                      validate_create=val.validate_pod,
                      validate_update=val.validate_pod_update, graceful_delete=True),
@@ -194,6 +197,13 @@ def builtin_resources() -> list[ResourceSpec]:
                      validate_create=ext.validate_webhook_configuration,
                      validate_update=ext.validate_webhook_configuration_update),
     ]
+    for spec in specs:
+        create_v, update_v = val.VALIDATORS.get(spec.kind, (None, None))
+        if spec.validate_create is None and create_v is not None:
+            spec.validate_create = create_v
+        if spec.validate_update is None and update_v is not None:
+            spec.validate_update = update_v
+    return specs
 
 
 class Registry:

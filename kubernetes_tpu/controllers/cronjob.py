@@ -20,86 +20,7 @@ from ..api.scheme import deepcopy
 from ..client.informer import InformerFactory
 from ..client.interface import Client
 from .base import Controller
-
-
-class CronSchedule:
-    """5-field cron (min hour dom mon dow) supporting ``*``, ``*/n``,
-    lists, and ranges — the subset the reference's robfig/cron use needs."""
-
-    _RANGES = ((0, 59), (0, 23), (1, 31), (1, 12), (0, 6))
-
-    def __init__(self, expr: str):
-        fields = expr.split()
-        if len(fields) != 5:
-            raise ValueError(f"cron needs 5 fields, got {expr!r}")
-        self.sets = [self._parse(f, lo, hi)
-                     for f, (lo, hi) in zip(fields, self._RANGES)]
-        # Standard cron: when BOTH dom and dow are restricted, a day
-        # matches if EITHER does (OR); a lone restriction is an AND.
-        self.dom_star = fields[2].startswith("*")
-        self.dow_star = fields[4].startswith("*")
-
-    @staticmethod
-    def _parse(field: str, lo: int, hi: int) -> frozenset:
-        out: set[int] = set()
-        for part in field.split(","):
-            step = 1
-            if "/" in part:
-                part, step_s = part.split("/", 1)
-                step = int(step_s)
-            if part in ("*", ""):
-                start, end = lo, hi
-            elif "-" in part:
-                a, b = part.split("-", 1)
-                start, end = int(a), int(b)
-            else:
-                start = end = int(part)
-            out.update(range(start, end + 1, step))
-        return frozenset(v for v in out if lo <= v <= hi)
-
-    def matches(self, dt: datetime.datetime) -> bool:
-        m, h = self.sets[0], self.sets[1]
-        return dt.minute in m and dt.hour in h and self._day_matches(dt.date())
-
-    def _day_matches(self, day: datetime.date) -> bool:
-        _, _, dom, mon, dow = self.sets
-        if day.month not in mon:
-            return False
-        dom_ok = day.day in dom
-        # cron dow: 0=Sunday; datetime.weekday(): 0=Monday.
-        dow_ok = ((day.weekday() + 1) % 7) in dow
-        if not self.dom_star and not self.dow_star:
-            return dom_ok or dow_ok
-        return dom_ok and dow_ok
-
-    def prev_at_or_before(self, dt: datetime.datetime
-                          ) -> Optional[datetime.datetime]:
-        """Latest matching minute <= dt. O(days scanned), not O(minutes):
-        walk days backward, then pick the largest in-day (hour, minute)."""
-        minutes = sorted(self.sets[0], reverse=True)
-        hours = sorted(self.sets[1], reverse=True)
-        end = dt.replace(second=0, microsecond=0)
-        day = end.date()
-        for i in range(4 * 366):  # a full leap cycle bounds any schedule
-            if self._day_matches(day):
-                for hour in hours:
-                    if i == 0 and hour > end.hour:
-                        continue
-                    for minute in minutes:
-                        if i == 0 and hour == end.hour and minute > end.minute:
-                            continue
-                        return datetime.datetime.combine(
-                            day, datetime.time(hour, minute), tzinfo=dt.tzinfo)
-            day -= datetime.timedelta(days=1)
-        return None
-
-    def most_recent(self, since: datetime.datetime,
-                    until: datetime.datetime) -> Optional[datetime.datetime]:
-        """Latest matching minute in (since, until]."""
-        got = self.prev_at_or_before(until)
-        if got is not None and got > since.replace(second=0, microsecond=0):
-            return got
-        return None
+from ..util.cron import CronSchedule
 
 
 class CronJobController(Controller):

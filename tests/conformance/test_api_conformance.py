@@ -37,12 +37,38 @@ def minimal_object(spec) -> object:
         # Conformance exercises plain API delete semantics; the
         # finalizer dance is the namespace controller's test scope.
         obj.spec.finalizers = []
-    if spec.kind in ("ReplicaSet", "Deployment", "StatefulSet"):
+    if spec.kind in ("ReplicaSet", "Deployment", "StatefulSet", "DaemonSet"):
         from kubernetes_tpu.api.selectors import LabelSelector
         obj.spec.selector = LabelSelector(match_labels={"app": "conf"})
         obj.spec.template = t.PodTemplateSpec(
             metadata=ObjectMeta(labels={"app": "conf"}),
             spec=t.PodSpec(containers=[t.Container(name="c", image="img")]))
+    # Required fields under full field validation (the same minimums a
+    # real client must supply; see api/validation.py VALIDATORS).
+    if spec.kind == "Service":
+        obj.spec.ports = [t.ServicePort(port=80)]
+    if spec.kind == "CronJob":
+        obj.spec.schedule = "*/5 * * * *"
+    if spec.kind == "HorizontalPodAutoscaler":
+        from kubernetes_tpu.api.workloads import CrossVersionObjectReference
+        obj.spec.scale_target_ref = CrossVersionObjectReference(
+            kind="Deployment", name="conf")
+    if spec.kind == "PodDisruptionBudget":
+        obj.spec.min_available = 0
+    if spec.kind == "PersistentVolume":
+        obj.spec.capacity = {"storage": "1Gi"}
+        obj.spec.host_path = t.HostPathVolume(path="/tmp/conf-pv")
+    if spec.kind == "PersistentVolumeClaim":
+        obj.spec.resources = t.ResourceRequirements(
+            requests={"storage": "1Gi"})
+    if spec.kind == "StorageClass":
+        obj.provisioner = "conf.example/provisioner"
+    if spec.kind in ("RoleBinding", "ClusterRoleBinding"):
+        from kubernetes_tpu.api import rbac as rb
+        obj.role_ref = rb.RoleRef(
+            kind="ClusterRole" if spec.kind == "ClusterRoleBinding"
+            else "Role", name="conf")
+        obj.subjects = [rb.Subject(kind="User", name="conf")]
     if spec.kind == "CustomResourceDefinition":
         from kubernetes_tpu.api import extensions as ext
         obj.spec = ext.CRDSpec(group="conf.example", version="v1",

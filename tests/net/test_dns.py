@@ -13,7 +13,8 @@ from tests.controllers.util import make_plane
 
 def mk_service(name, cluster_ip, ns="default"):
     return t.Service(metadata=ObjectMeta(name=name, namespace=ns),
-                     spec=t.ServiceSpec(cluster_ip=cluster_ip))
+                     spec=t.ServiceSpec(cluster_ip=cluster_ip,
+                                        ports=[t.ServicePort(port=80)]))
 
 
 def mk_endpoints(name, addrs, ns="default"):

@@ -1,0 +1,356 @@
+"""Table-driven field validation across every registered kind.
+
+Reference: the per-kind validators in
+``pkg/apis/core/validation/validation.go`` (+ the batch / autoscaling /
+policy / rbac / scheduling validation packages). Each case is
+(name, build-valid, mutate-to-invalid, expected-substring); the
+update table is (name, build-old, mutate-new, expected-substring).
+"""
+import pytest
+
+from kubernetes_tpu.api import rbac as rb, types as t, validation, workloads as w
+from kubernetes_tpu.api.errors import InvalidError
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.api.scheme import deepcopy
+from kubernetes_tpu.api.selectors import LabelSelector
+from kubernetes_tpu.api.types import PodTemplateSpec
+
+
+def meta(name="x", namespaced=True):
+    return ObjectMeta(name=name, namespace="default" if namespaced else "")
+
+
+def tmpl(labels=None):
+    return PodTemplateSpec(
+        metadata=ObjectMeta(labels=labels or {"app": "a"}),
+        spec=t.PodSpec(containers=[t.Container(name="c", image="i")]))
+
+
+def mk_service():
+    return t.Service(metadata=meta(), spec=t.ServiceSpec(
+        ports=[t.ServicePort(port=80, target_port=8080)]))
+
+
+def mk_pv():
+    return t.PersistentVolume(
+        metadata=ObjectMeta(name="pv1"),
+        spec=t.PersistentVolumeSpec(
+            capacity={"storage": "1Gi"},
+            host_path=t.HostPathVolume(path="/tmp/pv1")))
+
+
+def mk_pvc():
+    return t.PersistentVolumeClaim(
+        metadata=meta(),
+        spec=t.PersistentVolumeClaimSpec(
+            resources=t.ResourceRequirements(requests={"storage": "1Gi"})))
+
+
+def mk_cronjob():
+    return w.CronJob(metadata=meta(),
+                     spec=w.CronJobSpec(schedule="*/5 * * * *"))
+
+
+def mk_hpa():
+    return w.HorizontalPodAutoscaler(
+        metadata=meta(),
+        spec=w.HorizontalPodAutoscalerSpec(
+            scale_target_ref=w.CrossVersionObjectReference(
+                kind="Deployment", name="d"),
+            min_replicas=1, max_replicas=3))
+
+
+def mk_pdb():
+    return w.PodDisruptionBudget(
+        metadata=meta(),
+        spec=w.PodDisruptionBudgetSpec(
+            min_available=1,
+            selector=LabelSelector(match_labels={"app": "a"})))
+
+
+def mk_binding():
+    return rb.RoleBinding(
+        metadata=ObjectMeta(name="b", namespace="default"),
+        role_ref=rb.RoleRef(kind="Role", name="r"),
+        subjects=[rb.Subject(kind="User", name="alice")])
+
+
+def mk_limitrange():
+    return t.LimitRange(metadata=meta(), spec=t.LimitRangeSpec(limits=[
+        t.LimitRangeItem(type="Container", min={"cpu": "100m"},
+                         default_request={"cpu": "200m"},
+                         default={"cpu": "500m"}, max={"cpu": 1.0})]))
+
+
+# (case id, validator, builder, mutator, expected error substring)
+CREATE_CASES = [
+    ("service-no-ports", validation.validate_service, mk_service,
+     lambda s: s.spec.ports.clear(), "at least one port"),
+    ("service-bad-port", validation.validate_service, mk_service,
+     lambda s: setattr(s.spec.ports[0], "port", 70000), "1-65535"),
+    ("service-bad-proto", validation.validate_service, mk_service,
+     lambda s: setattr(s.spec.ports[0], "protocol", "ICMP"), "protocol"),
+    ("service-dup-port-names", validation.validate_service, mk_service,
+     lambda s: s.spec.ports.extend([
+         t.ServicePort(name="a", port=81), t.ServicePort(name="a", port=82)]),
+     "duplicate"),
+    ("service-unnamed-multiport", validation.validate_service, mk_service,
+     lambda s: s.spec.ports.append(t.ServicePort(port=81)),
+     "required when more than one"),
+    ("service-nodeport-range", validation.validate_service, mk_service,
+     lambda s: (setattr(s.spec, "type", "NodePort"),
+                setattr(s.spec.ports[0], "node_port", 80)),
+     "node-port range"),
+    ("service-nodeport-on-clusterip", validation.validate_service,
+     mk_service,
+     lambda s: setattr(s.spec.ports[0], "node_port", 30080),
+     "type ClusterIP"),
+    ("service-bad-type", validation.validate_service, mk_service,
+     lambda s: setattr(s.spec, "type", "ExternalName"), "spec.type"),
+    ("service-bad-clusterip", validation.validate_service, mk_service,
+     lambda s: setattr(s.spec, "cluster_ip", "not-an-ip"), "cluster_ip"),
+    ("endpoints-bad-ip", validation.validate_endpoints,
+     lambda: t.Endpoints(metadata=meta(), subsets=[t.EndpointSubset(
+         addresses=[t.EndpointAddress(ip="10.0.0.1")],
+         ports=[t.EndpointPort(port=80)])]),
+     lambda e: setattr(e.subsets[0].addresses[0], "ip", "999.1.1.1"),
+     "invalid IP"),
+    ("configmap-bad-key", validation.validate_configmap,
+     lambda: t.ConfigMap(metadata=meta(), data={"ok.key": "v"}),
+     lambda c: c.data.update({"bad key!": "v"}), "key must match"),
+    ("event-no-target", validation.validate_event,
+     lambda: t.Event(metadata=meta(), involved_object=t.ObjectReference(
+         kind="Pod", name="p"), reason="r"),
+     lambda e: setattr(e.involved_object, "name", ""), "involved_object"),
+    ("quota-bad-quantity", validation.validate_resourcequota,
+     lambda: t.ResourceQuota(metadata=meta(),
+                             spec=t.ResourceQuotaSpec(hard={"cpu": "4"})),
+     lambda q: q.spec.hard.update({"memory": "4Gx"}), "unparseable"),
+    ("limitrange-bad-type", validation.validate_limitrange, mk_limitrange,
+     lambda lr: setattr(lr.spec.limits[0], "type", "Volume"),
+     "Container or Pod"),
+    ("limitrange-min-over-max", validation.validate_limitrange,
+     mk_limitrange,
+     lambda lr: lr.spec.limits[0].min.update({"cpu": "2"}), "exceeds"),
+    ("limitrange-default-over-max", validation.validate_limitrange,
+     mk_limitrange,
+     lambda lr: lr.spec.limits[0].default.update({"cpu": "1500m"}),
+     "exceeds"),
+    ("priorityclass-huge", validation.validate_priorityclass,
+     lambda: t.PriorityClass(metadata=ObjectMeta(name="pc"), value=10),
+     lambda pc: setattr(pc, "value", 2_000_000_000), "user classes"),
+    ("priorityclass-bad-policy", validation.validate_priorityclass,
+     lambda: t.PriorityClass(metadata=ObjectMeta(name="pc"), value=10),
+     lambda pc: setattr(pc, "preemption_policy", "Sometimes"),
+     "preemption_policy"),
+    ("lease-nonpositive", validation.validate_lease,
+     lambda: t.Lease(metadata=meta()),
+     lambda le: setattr(le.spec, "lease_duration_seconds", 0), "positive"),
+    ("sa-bad-secret-name", validation.validate_serviceaccount,
+     lambda: t.ServiceAccount(metadata=meta()),
+     lambda sa: sa.secrets.append("Bad_Name"), "DNS-1123"),
+    ("pv-no-capacity", validation.validate_persistentvolume, mk_pv,
+     lambda pv: pv.spec.capacity.clear(), "capacity.storage"),
+    ("pv-bad-quantity", validation.validate_persistentvolume, mk_pv,
+     lambda pv: pv.spec.capacity.update({"storage": "10Q4"}),
+     "unparseable"),
+    ("pv-two-sources", validation.validate_persistentvolume, mk_pv,
+     lambda pv: setattr(pv.spec, "csi",
+                        t.CSIVolumeSource(driver="d", volume_handle="h")),
+     "exactly one volume source"),
+    ("pv-bad-reclaim", validation.validate_persistentvolume, mk_pv,
+     lambda pv: setattr(pv.spec, "persistent_volume_reclaim_policy",
+                        "Recycle"), "Retain or Delete"),
+    ("pv-bad-access-mode", validation.validate_persistentvolume, mk_pv,
+     lambda pv: setattr(pv.spec, "access_modes", ["ReadWriteTwice"]),
+     "access mode"),
+    ("pvc-no-request", validation.validate_persistentvolumeclaim, mk_pvc,
+     lambda pvc: pvc.spec.resources.requests.clear(), "storage"),
+    ("storageclass-no-provisioner", validation.validate_storageclass,
+     lambda: t.StorageClass(metadata=ObjectMeta(name="sc"),
+                            provisioner="p"),
+     lambda sc: setattr(sc, "provisioner", ""), "provisioner"),
+    ("role-empty-verbs", validation.validate_role,
+     lambda: rb.Role(metadata=ObjectMeta(name="r", namespace="default"),
+                     rules=[rb.PolicyRule(verbs=["get"],
+                                          resources=["pods"])]),
+     lambda r: setattr(r.rules[0], "verbs", []), "verb"),
+    ("binding-no-roleref", validation.validate_rolebinding, mk_binding,
+     lambda b: setattr(b.role_ref, "name", ""), "role_ref.name"),
+    ("binding-bad-subject-kind", validation.validate_rolebinding,
+     mk_binding,
+     lambda b: setattr(b.subjects[0], "kind", "Robot"), "subjects[0].kind"),
+    ("clusterbinding-role-ref", validation.validate_rolebinding,
+     lambda: rb.ClusterRoleBinding(
+         metadata=ObjectMeta(name="b"),
+         role_ref=rb.RoleRef(kind="ClusterRole", name="r"),
+         subjects=[rb.Subject(kind="Group", name="g")]),
+     lambda b: setattr(b.role_ref, "kind", "Role"),
+     "only reference a ClusterRole"),
+    ("daemonset-selector-mismatch", validation.validate_daemonset,
+     lambda: w.DaemonSet(metadata=meta(), spec=w.DaemonSetSpec(
+         selector=LabelSelector(match_labels={"app": "a"}),
+         template=tmpl())),
+     lambda ds: setattr(ds.spec, "template", tmpl({"app": "b"})),
+     "must match"),
+    ("cronjob-bad-schedule", validation.validate_cronjob, mk_cronjob,
+     lambda cj: setattr(cj.spec, "schedule", "every five minutes"),
+     "cron"),
+    ("cronjob-6-fields", validation.validate_cronjob, mk_cronjob,
+     lambda cj: setattr(cj.spec, "schedule", "* * * * * *"), "5 fields"),
+    ("cronjob-bad-concurrency", validation.validate_cronjob, mk_cronjob,
+     lambda cj: setattr(cj.spec, "concurrency_policy", "Maybe"),
+     "concurrency_policy"),
+    ("cronjob-negative-deadline", validation.validate_cronjob, mk_cronjob,
+     lambda cj: setattr(cj.spec, "starting_deadline_seconds", -1),
+     "non-negative"),
+    ("hpa-no-target", validation.validate_hpa, mk_hpa,
+     lambda h: setattr(h.spec.scale_target_ref, "name", ""),
+     "scale_target_ref"),
+    ("hpa-min-zero", validation.validate_hpa, mk_hpa,
+     lambda h: setattr(h.spec, "min_replicas", 0), "min_replicas"),
+    ("hpa-max-below-min", validation.validate_hpa, mk_hpa,
+     lambda h: (setattr(h.spec, "min_replicas", 3),
+                setattr(h.spec, "max_replicas", 2)), "max_replicas"),
+    ("hpa-bad-target-pct", validation.validate_hpa, mk_hpa,
+     lambda h: setattr(h.spec, "target_cpu_utilization_percentage", 0),
+     ">= 1"),
+    ("pdb-both-fields", validation.validate_pdb, mk_pdb,
+     lambda p: setattr(p.spec, "max_unavailable", 1),
+     "mutually exclusive"),
+    ("pdb-neither-field", validation.validate_pdb, mk_pdb,
+     lambda p: setattr(p.spec, "min_available", None), "one of"),
+    ("pdb-negative", validation.validate_pdb, mk_pdb,
+     lambda p: setattr(p.spec, "min_available", -1), "non-negative"),
+]
+
+
+@pytest.mark.parametrize(
+    "case", CREATE_CASES, ids=[c[0] for c in CREATE_CASES])
+def test_create_validation(case):
+    _, validator, build, mutate, want = case
+    obj = build()
+    validator(obj)  # the valid shape passes
+    mutate(obj)
+    with pytest.raises(InvalidError) as ei:
+        validator(obj)
+    assert want in str(ei.value), f"missing {want!r} in: {ei.value}"
+
+
+# (case id, update validator, builder, mutate-new, expected substring)
+UPDATE_CASES = [
+    ("service-clusterip-frozen", validation.validate_service_update,
+     lambda: (lambda s: (setattr(s.spec, "cluster_ip", "10.0.0.1"), s)[1])(
+         mk_service()),
+     lambda s: setattr(s.spec, "cluster_ip", "10.0.0.2"), "immutable"),
+    ("deployment-selector-frozen", validation.validate_deployment_update,
+     lambda: w.Deployment(metadata=meta(), spec=w.DeploymentSpec(
+         selector=LabelSelector(match_labels={"app": "a"}),
+         template=tmpl())),
+     lambda d: (setattr(d.spec, "selector",
+                        LabelSelector(match_labels={"app": "b"})),
+                setattr(d.spec, "template", tmpl({"app": "b"}))),
+     "immutable"),
+    ("statefulset-service-frozen", validation.validate_statefulset_update,
+     lambda: w.StatefulSet(metadata=meta(), spec=w.StatefulSetSpec(
+         selector=LabelSelector(match_labels={"app": "a"}),
+         template=tmpl(), service_name="svc-a")),
+     lambda s: setattr(s.spec, "service_name", "svc-b"), "immutable"),
+    ("job-completions-frozen", validation.validate_job_update,
+     lambda: w.Job(metadata=meta(), spec=w.JobSpec(completions=4)),
+     lambda j: setattr(j.spec, "completions", 8), "immutable"),
+    ("priorityclass-value-frozen", validation.validate_priorityclass_update,
+     lambda: t.PriorityClass(metadata=ObjectMeta(name="pc"), value=100),
+     lambda pc: setattr(pc, "value", 200), "immutable"),
+    ("pvc-shrink", validation.validate_persistentvolumeclaim_update,
+     mk_pvc,
+     lambda p: p.spec.resources.requests.update({"storage": "512Mi"}),
+     "may not shrink"),
+    ("pvc-class-frozen", validation.validate_persistentvolumeclaim_update,
+     mk_pvc,
+     lambda p: setattr(p.spec, "storage_class_name", "other"),
+     "immutable"),
+    ("pv-source-frozen", validation.validate_persistentvolume_update,
+     mk_pv,
+     lambda p: setattr(p.spec, "host_path",
+                       t.HostPathVolume(path="/tmp/other")), "immutable"),
+    ("storageclass-provisioner-frozen",
+     validation.validate_storageclass_update,
+     lambda: t.StorageClass(metadata=ObjectMeta(name="sc"),
+                            provisioner="p1"),
+     lambda sc: setattr(sc, "provisioner", "p2"), "immutable"),
+    ("binding-roleref-frozen", validation.validate_rolebinding_update,
+     mk_binding,
+     lambda b: setattr(b.role_ref, "name", "other"), "immutable"),
+    ("secret-type-frozen", validation.validate_secret_update,
+     lambda: t.Secret(metadata=meta(), type="Opaque"),
+     lambda s: setattr(s, "type", "kubernetes-tpu/tls"), "immutable"),
+]
+
+
+@pytest.mark.parametrize(
+    "case", UPDATE_CASES, ids=[c[0] for c in UPDATE_CASES])
+def test_update_validation(case):
+    _, validator, build, mutate, want = case
+    old = build()
+    unchanged = deepcopy(old)
+    validator(unchanged, old)  # no-op update passes
+    new = deepcopy(old)
+    mutate(new)
+    with pytest.raises(InvalidError) as ei:
+        validator(new, old)
+    assert want in str(ei.value), f"missing {want!r} in: {ei.value}"
+
+
+def test_hpa_target_above_100_allowed():
+    h = mk_hpa()
+    h.spec.target_cpu_utilization_percentage = 150  # multi-core target
+    validation.validate_hpa(h)
+
+
+def test_selector_expression_mutation_rejected():
+    """Same-length match_expressions swap must still trip immutability."""
+    from kubernetes_tpu.api.selectors import Requirement
+    sel = LabelSelector(match_labels={"app": "a"},
+                        match_expressions=[
+                            Requirement(key="tier", operator="In",
+                                        values=["web"])])
+    old = w.Deployment(metadata=meta(), spec=w.DeploymentSpec(
+        selector=sel, template=tmpl({"app": "a", "tier": "web"})))
+    new = deepcopy(old)
+    new.spec.selector.match_expressions[0].key = "zone"
+    new.spec.template = tmpl({"app": "a", "zone": "web"})
+    with pytest.raises(InvalidError, match="immutable"):
+        validation.validate_deployment_update(new, old)
+
+
+def test_job_template_immutable():
+    old = w.Job(metadata=meta(), spec=w.JobSpec(template=tmpl()))
+    new = deepcopy(old)
+    new.spec.template.spec.containers[0].image = "other"
+    with pytest.raises(InvalidError, match="spec.template"):
+        validation.validate_job_update(new, old)
+
+
+def test_pvc_expansion_allowed():
+    old = mk_pvc()
+    new = deepcopy(old)
+    new.spec.resources.requests["storage"] = "2Gi"
+    validation.validate_persistentvolumeclaim_update(new, old)
+
+
+def test_job_parallelism_scalable():
+    old = w.Job(metadata=meta(), spec=w.JobSpec(parallelism=2))
+    new = deepcopy(old)
+    new.spec.parallelism = 5
+    validation.validate_job_update(new, old)
+
+
+def test_every_registered_kind_has_a_field_validator():
+    """The r4 verdict's gap: ~15 of 29 kinds fell through to
+    metadata-only checks. The registry fill-loop + VALIDATORS table
+    closes it; this pins every builtin (CRDs get make_cr_validator)."""
+    from kubernetes_tpu.apiserver.registry import builtin_resources
+    missing = [s.kind for s in builtin_resources()
+               if s.validate_create is None]
+    assert missing == [], f"kinds without field validation: {missing}"
