@@ -131,3 +131,4 @@ def _merge_list(base: list, patch: list, elem: type, merge_key: str) -> list:
 #: Wire content types (reference: types.go PatchType).
 MERGE_PATCH = "application/merge-patch+json"
 STRATEGIC_MERGE_PATCH = "application/strategic-merge-patch+json"
+JSON_PATCH = "application/json-patch+json"  # RFC 6902, body is a list

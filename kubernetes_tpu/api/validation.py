@@ -523,8 +523,13 @@ def validate_priorityclass(pc: t.PriorityClass,
                            is_create: bool = True) -> None:
     errs = ErrorList()
     validate_object_meta(pc.metadata, errs, namespaced=False)
+    # Only the two KNOWN system classes escape the user band — a bare
+    # "system-" prefix check would let anyone mint "system-mine" and
+    # outrank node-critical workloads (reference:
+    # scheduling validation's SystemPriorityClasses allowlist).
     if (abs(pc.value) > MAX_PRIORITY
-            and not pc.metadata.name.startswith("system-")):
+            and pc.metadata.name not in ("system-cluster-critical",
+                                         "system-node-critical")):
         errs.add("value", f"must be within ±{MAX_PRIORITY} for user classes")
     if pc.preemption_policy not in ("PreemptLowerPriority", "Never"):
         errs.add("preemption_policy",

@@ -667,15 +667,8 @@ class Registry:
                 self._prepare_node(new)
             elif self._node_cidrs is not None:
                 self._node_cidrs.occupy(new.spec.pod_cidr)
-        # Cluster IP is immutable for a Service's lifetime (reference:
-        # service strategy ValidateUpdate) — mutation would desync the
-        # allocator and every proxy/env consumer.
-        if isinstance(new, t.Service) and subresource != "status" \
-                and isinstance(old, t.Service) and old.spec.cluster_ip \
-                and new.spec.cluster_ip != old.spec.cluster_ip:
-            raise errors.InvalidError(
-                f"Service {new.metadata.name!r}: spec.cluster_ip is "
-                f"immutable ({old.spec.cluster_ip} -> {new.spec.cluster_ip})")
+        # (Cluster-IP immutability lives in validate_service_update —
+        # one definition of the rule, enforced on every update path.)
         rev = self.store.update(key, self._encode(new),
                                 expected_revision=stored.mod_revision)
         if isinstance(new, ext.CustomResourceDefinition):
@@ -690,13 +683,25 @@ class Registry:
             return False
         return to_dict(new.spec) != to_dict(old.spec)
 
-    def preview_patch(self, cur: TypedObject, patch: dict,
+    def preview_patch(self, cur: TypedObject, patch,
                       strategic: bool = False) -> dict:
         """The merged object dict a patch WOULD produce against ``cur``
         — shared by :meth:`patch` and the apiserver's webhook path
-        (hooks must see the post-merge object, not the raw patch)."""
+        (hooks must see the post-merge object, not the raw patch).
+        A LIST patch is RFC 6902 JSON Patch (the body shape is
+        self-describing: merge patches are objects, op lists are
+        arrays — reference types.go JSONPatchType)."""
         spec = self.spec_for_kind(cur.kind or type(cur).__name__)
-        if strategic:
+        if isinstance(patch, list):
+            from .webhooks import apply_json_patch
+            try:
+                merged = apply_json_patch(self._encode(cur), patch)
+            except ValueError as e:
+                raise errors.BadRequestError(str(e)) from None
+            if not isinstance(merged, dict):
+                raise errors.BadRequestError(
+                    "json patch must produce an object")
+        elif strategic:
             from ..api.patch import strategic_merge
             merged = strategic_merge(self._encode(cur), patch, spec.cls)
         else:
@@ -705,11 +710,11 @@ class Registry:
         merged.setdefault("kind", spec.kind)
         return merged
 
-    def patch(self, plural: str, namespace: str, name: str, patch: dict,
+    def patch(self, plural: str, namespace: str, name: str, patch,
               subresource: str = "", strategic: bool = False) -> TypedObject:
-        """JSON merge-patch (RFC 7386) or, with ``strategic=True``,
-        strategic merge patch (list merge by per-type keys — see
-        ``api/patch.py``)."""
+        """JSON merge-patch (RFC 7386), RFC 6902 JSON Patch (list
+        body), or, with ``strategic=True``, strategic merge patch
+        (list merge by per-type keys — see ``api/patch.py``)."""
         spec = self.spec_for(plural)
         for _ in range(10):
             cur = self.get(plural, namespace, name)

@@ -1116,8 +1116,16 @@ class APIServer:
         sub = request.match_info.get("subresource", "")
         name = request.match_info["name"]
         patch = await self._body_obj(request)
-        from ..api.patch import STRATEGIC_MERGE_PATCH
+        from ..api.patch import JSON_PATCH, STRATEGIC_MERGE_PATCH
         strategic = request.content_type == STRATEGIC_MERGE_PATCH
+        # RFC 6902 bodies are arrays; merge-patch bodies are objects.
+        # The content type and the body shape must agree.
+        if request.content_type == JSON_PATCH and not isinstance(patch, list):
+            raise errors.BadRequestError(
+                "json-patch body must be an array of ops")
+        if request.content_type != JSON_PATCH and isinstance(patch, list):
+            raise errors.BadRequestError(
+                f"array patch body requires Content-Type {JSON_PATCH}")
         conv = self._conv_version(request, spec) if not sub else ""
         if conv:
             # A versioned PATCH merges in the VERSIONED field space
@@ -1128,7 +1136,15 @@ class APIServer:
             for attempt in range(10):
                 old_obj = self.registry.get(plural, ns, name)
                 down = scheme.from_hub(conv, spec.kind, to_dict(old_obj))
-                if strategic:
+                if isinstance(patch, list):
+                    # RFC 6902 ops apply in the VERSIONED field space,
+                    # like the merge flavors below.
+                    from .webhooks import apply_json_patch
+                    try:
+                        merged = apply_json_patch(down, patch)
+                    except ValueError as e:
+                        raise errors.BadRequestError(str(e)) from None
+                elif strategic:
                     from ..api.patch import strategic_merge
                     try:
                         vcls = scheme.class_for(conv, spec.kind)

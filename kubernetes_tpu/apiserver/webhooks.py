@@ -90,6 +90,25 @@ def _apply_one(doc: Any, op: dict) -> None:
             del cur[last]
         else:
             raise ValueError(f"cannot remove from {type(cur).__name__}")
+    elif action == "test":
+        have = _step(cur, last) if last else cur
+        if have != op.get("value"):
+            raise ValueError(
+                f"test failed at {path!r}: {have!r} != {op.get('value')!r}")
+    elif action in ("move", "copy"):
+        frm = op.get("from", "")
+        if not frm.startswith("/"):
+            raise ValueError(f"bad from path {frm!r}")
+        fkeys = [p.replace("~1", "/").replace("~0", "~")
+                 for p in frm[1:].split("/")]
+        src = doc
+        for k in fkeys[:-1]:
+            src = _step(src, k)
+        import copy as _copy
+        value = _copy.deepcopy(_step(src, fkeys[-1]))  # no aliasing
+        if action == "move":
+            _apply_one(doc, {"op": "remove", "path": frm})
+        _apply_one(doc, {"op": "add", "path": path, "value": value})
     else:
         raise ValueError(f"unsupported op {action!r}")
 

@@ -538,6 +538,87 @@ async def _set_unschedulable(args, value: bool, verb: str) -> int:
         await client.close()
 
 
+async def cmd_patch(args) -> int:
+    """``ktl patch`` (reference: ``pkg/kubectl/cmd/patch.go``) — the
+    three patch flavors over the existing merge engines: strategic
+    (api/patch.py:77), RFC 7386 merge, RFC 6902 json."""
+    client = make_client(args)
+    try:
+        plural = resolve_plural(args.resource)
+        try:
+            body = json.loads(args.patch)
+        except json.JSONDecodeError as e:
+            print(f"error: -p is not valid JSON: {e}", file=sys.stderr)
+            return 1
+        if args.type == "json" and not isinstance(body, list):
+            print("error: --type json expects an array of RFC 6902 ops",
+                  file=sys.stderr)
+            return 1
+        if args.type != "json" and not isinstance(body, dict):
+            print(f"error: --type {args.type} expects a JSON object",
+                  file=sys.stderr)
+            return 1
+        await client.patch(plural, args.namespace, args.name, body,
+                           strategic=(args.type == "strategic"))
+        print(f"{plural}/{args.name} patched")
+        return 0
+    finally:
+        await client.close()
+
+
+def _parse_kv_edits(pairs: list[str], what: str) -> dict:
+    """kubectl's edit syntax: ``k=v`` sets, ``k-`` removes. Returns
+    key -> value-or-None (None = remove; a merge patch treats null as
+    delete, RFC 7386)."""
+    out: dict = {}
+    for p in pairs:
+        if p.endswith("-") and "=" not in p:
+            out[p[:-1]] = None
+        elif "=" in p:
+            k, _, v = p.partition("=")
+            out[k] = v
+        else:
+            raise ValueError(
+                f"invalid {what} {p!r}: use key=value to set, key- to remove")
+    return out
+
+
+async def _metadata_edit(args, field: str) -> int:
+    client = make_client(args)
+    try:
+        plural = resolve_plural(args.resource)
+        try:
+            edits = _parse_kv_edits(args.pairs, field[:-1])
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        if not args.overwrite:
+            cur = await client.get(plural, args.namespace, args.name)
+            existing = getattr(cur.metadata, field)
+            clash = [k for k, v in edits.items()
+                     if v is not None and k in existing
+                     and existing[k] != v]
+            if clash:
+                print(f"error: {field} {clash} already set; use "
+                      f"--overwrite to replace", file=sys.stderr)
+                return 1
+        await client.patch(plural, args.namespace, args.name,
+                           {"metadata": {field: edits}})
+        verbed = "labeled" if field == "labels" else "annotated"
+        print(f"{plural}/{args.name} {verbed}")
+        return 0
+    finally:
+        await client.close()
+
+
+async def cmd_label(args) -> int:
+    return await _metadata_edit(args, "labels")
+
+
+async def cmd_annotate(args) -> int:
+    return await _metadata_edit(args, "annotations")
+
+
 async def cmd_cordon(args) -> int:
     return await _set_unschedulable(args, True, "cordoned")
 
@@ -1217,6 +1298,28 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("name")
     sp.add_argument("--replicas", type=int, required=True)
     sp.add_argument("-n", "--namespace", default="default")
+
+    sp = add("patch", cmd_patch, help="patch an object in place")
+    sp.add_argument("resource")
+    sp.add_argument("name")
+    sp.add_argument("-p", "--patch", required=True,
+                    help="patch body as JSON")
+    sp.add_argument("--type", default="strategic",
+                    choices=["strategic", "merge", "json"],
+                    help="strategic merge (default), RFC 7386 merge, "
+                         "or RFC 6902 json ops")
+    sp.add_argument("-n", "--namespace", default="default")
+
+    for vname, vfn in (("label", cmd_label), ("annotate", cmd_annotate)):
+        sp = add(vname, vfn,
+                 help=f"{vname} objects (key=value sets, key- removes)")
+        sp.add_argument("resource")
+        sp.add_argument("name")
+        sp.add_argument("pairs", nargs="+",
+                        help="key=value to set, key- to remove")
+        sp.add_argument("--overwrite", action="store_true", default=False,
+                        help="allow replacing existing values")
+        sp.add_argument("-n", "--namespace", default="default")
 
     for name, fn in (("cordon", cmd_cordon), ("uncordon", cmd_uncordon)):
         sp = add(name, fn, help=f"{name} a node")

@@ -351,11 +351,18 @@ class RESTClient(Client):
             data = await self._check(resp)
         return decode_obj(data)
 
-    async def patch(self, plural: str, namespace: str, name: str, patch: dict,
+    async def patch(self, plural: str, namespace: str, name: str, patch,
                     subresource: str = "", strategic: bool = False) -> Any:
+        """A dict patch is a JSON merge patch (or strategic merge with
+        ``strategic=True``); a LIST patch is RFC 6902 JSON Patch and
+        sets its content type automatically."""
         av, namespaced = await self._plural_info(plural)
         url = self._url_for(av, plural, namespace if namespaced else "", name, subresource)
-        if strategic:
+        if isinstance(patch, list):
+            from ..api.patch import JSON_PATCH
+            kwargs = {"data": json.dumps(patch).encode(),
+                      "headers": {"Content-Type": JSON_PATCH}}
+        elif strategic:
             from ..api.patch import STRATEGIC_MERGE_PATCH
             kwargs = {"data": json.dumps(patch).encode(),
                       "headers": {"Content-Type": STRATEGIC_MERGE_PATCH}}

@@ -18,11 +18,32 @@ class CronSchedule:
     lists, and ranges — the subset the reference's robfig/cron use needs."""
 
     _RANGES = ((0, 59), (0, 23), (1, 31), (1, 12), (0, 6))
+    #: robfig/cron's @-macros (reference cronjob controller accepts
+    #: these; "@every" is deliberately unsupported — the reference
+    #: controller's schedule spec doesn't use it either).
+    _MACROS = {
+        "@yearly": "0 0 1 1 *", "@annually": "0 0 1 1 *",
+        "@monthly": "0 0 1 * *", "@weekly": "0 0 * * 0",
+        "@daily": "0 0 * * *", "@midnight": "0 0 * * *",
+        "@hourly": "0 * * * *",
+    }
+    _MON_NAMES = {n: i + 1 for i, n in enumerate(
+        "JAN FEB MAR APR MAY JUN JUL AUG SEP OCT NOV DEC".split())}
+    _DOW_NAMES = {n: i for i, n in enumerate(
+        "SUN MON TUE WED THU FRI SAT".split())}
 
     def __init__(self, expr: str):
+        expr = expr.strip()
+        if expr.startswith("@"):
+            try:
+                expr = self._MACROS[expr.lower()]
+            except KeyError:
+                raise ValueError(f"unknown cron macro {expr!r}") from None
         fields = expr.split()
         if len(fields) != 5:
             raise ValueError(f"cron needs 5 fields, got {expr!r}")
+        fields[3] = self._subst_names(fields[3], self._MON_NAMES)
+        fields[4] = self._subst_names(fields[4], self._DOW_NAMES)
         self.sets = [self._parse(f, lo, hi)
                      for f, (lo, hi) in zip(fields, self._RANGES)]
         # Standard cron: when BOTH dom and dow are restricted, a day
@@ -31,11 +52,20 @@ class CronSchedule:
         self.dow_star = fields[4].startswith("*")
 
     @staticmethod
+    def _subst_names(field: str, names: dict[str, int]) -> str:
+        """MON/JAN-style aliases -> numbers (robfig accepts both)."""
+        def repl(tok: str) -> str:
+            return str(names.get(tok.upper(), tok))
+        import re as _re
+        return _re.sub(r"[A-Za-z]+", lambda m: repl(m.group()), field)
+
+    @staticmethod
     def _parse(field: str, lo: int, hi: int) -> frozenset:
         out: set[int] = set()
         for part in field.split(","):
             step = 1
-            if "/" in part:
+            stepped = "/" in part
+            if stepped:
                 part, step_s = part.split("/", 1)
                 step = int(step_s)
                 if step < 1:
@@ -45,6 +75,10 @@ class CronSchedule:
             elif "-" in part:
                 a, b = part.split("-", 1)
                 start, end = int(a), int(b)
+            elif stepped:
+                # robfig: "30/10" = range from 30 to the field max
+                # stepped by 10 (30,40,50), NOT the single value 30.
+                start, end = int(part), hi
             else:
                 start = end = int(part)
             # Out-of-range or inverted bounds raise instead of silently

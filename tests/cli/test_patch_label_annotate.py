@@ -1,0 +1,129 @@
+"""ktl patch / label / annotate (reference: pkg/kubectl/cmd/patch.go,
+label.go, annotate.go) against a live in-process apiserver."""
+import asyncio
+import contextlib
+import io
+import json
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.cli import ktl
+
+
+async def ktl_out(args, server):
+    buf = io.StringIO()
+    err = io.StringIO()
+
+    def call():
+        with contextlib.redirect_stdout(buf), contextlib.redirect_stderr(err):
+            return ktl.main(["--server", server] + args)
+    rc = await asyncio.to_thread(call)
+    return rc, buf.getvalue() + err.getvalue()
+
+
+async def start_server():
+    srv = APIServer()
+    srv.registry.create(t.Namespace(metadata=ObjectMeta(name="default")))
+    srv.registry.create(t.ConfigMap(
+        metadata=ObjectMeta(name="cm", namespace="default"),
+        data={"a": "1"}))
+    srv.registry.create(t.Pod(
+        metadata=ObjectMeta(name="p", namespace="default",
+                            labels={"app": "x"}),
+        spec=t.PodSpec(containers=[
+            t.Container(name="c", image="img",
+                        env=[t.EnvVar(name="A", value="1")])])))
+    port = await srv.start()
+    return srv, f"http://127.0.0.1:{port}"
+
+
+async def test_patch_merge_and_json_types():
+    srv, base = await start_server()
+    try:
+        # merge patch (RFC 7386): null deletes.
+        rc, out = await ktl_out(
+            ["patch", "configmap", "cm", "--type", "merge",
+             "-p", json.dumps({"data": {"b": "2", "a": None}})], base)
+        assert rc == 0, out
+        cm = srv.registry.get("configmaps", "default", "cm")
+        assert cm.data == {"b": "2"}
+
+        # json patch (RFC 6902).
+        rc, out = await ktl_out(
+            ["patch", "configmap", "cm", "--type", "json",
+             "-p", json.dumps([
+                 {"op": "add", "path": "/data/c", "value": "3"},
+                 {"op": "remove", "path": "/data/b"}])], base)
+        assert rc == 0, out
+        cm = srv.registry.get("configmaps", "default", "cm")
+        assert cm.data == {"c": "3"}
+
+        # strategic patch on a pod keeps the container list merged by
+        # name instead of replaced.
+        rc, out = await ktl_out(
+            ["patch", "pods", "p",
+             "-p", json.dumps({"spec": {"containers": [
+                 {"name": "c", "image": "img2"}]}})], base)
+        assert rc == 0, out
+        pod = srv.registry.get("pods", "default", "p")
+        assert pod.spec.containers[0].image == "img2"
+        assert pod.spec.containers[0].env == [
+            t.EnvVar(name="A", value="1")], \
+            "strategic merge must preserve unpatched container fields"
+
+        # type/body mismatch errors cleanly.
+        rc, out = await ktl_out(
+            ["patch", "configmap", "cm", "--type", "json",
+             "-p", "{}"], base)
+        assert rc == 1 and "array" in out
+        rc, out = await ktl_out(
+            ["patch", "configmap", "cm", "-p", "not json"], base)
+        assert rc == 1 and "JSON" in out
+    finally:
+        await srv.stop()
+
+
+async def test_label_and_annotate():
+    srv, base = await start_server()
+    try:
+        rc, out = await ktl_out(
+            ["label", "pods", "p", "tier=web", "zone=a"], base)
+        assert rc == 0, out
+        pod = srv.registry.get("pods", "default", "p")
+        assert pod.metadata.labels["tier"] == "web"
+        assert pod.metadata.labels["zone"] == "a"
+
+        # Changing an existing value needs --overwrite.
+        rc, out = await ktl_out(["label", "pods", "p", "tier=db"], base)
+        assert rc == 1 and "--overwrite" in out
+        pod = srv.registry.get("pods", "default", "p")
+        assert pod.metadata.labels["tier"] == "web"
+        rc, out = await ktl_out(
+            ["label", "pods", "p", "tier=db", "--overwrite"], base)
+        assert rc == 0, out
+        assert srv.registry.get(
+            "pods", "default", "p").metadata.labels["tier"] == "db"
+
+        # key- removes.
+        rc, out = await ktl_out(["label", "pods", "p", "zone-"], base)
+        assert rc == 0, out
+        assert "zone" not in srv.registry.get(
+            "pods", "default", "p").metadata.labels
+
+        # annotate mirrors label on the annotations map.
+        rc, out = await ktl_out(
+            ["annotate", "pods", "p", "team=infra"], base)
+        assert rc == 0, out
+        assert srv.registry.get(
+            "pods", "default", "p").metadata.annotations["team"] == "infra"
+        rc, out = await ktl_out(["annotate", "pods", "p", "team-"], base)
+        assert rc == 0, out
+        assert "team" not in srv.registry.get(
+            "pods", "default", "p").metadata.annotations
+
+        # malformed pair errors cleanly.
+        rc, out = await ktl_out(["label", "pods", "p", "justakey"], base)
+        assert rc == 1 and "key=value" in out
+    finally:
+        await srv.stop()
