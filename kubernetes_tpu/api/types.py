@@ -616,6 +616,10 @@ class ServiceSpec:
     ports: list[ServicePort] = field(default_factory=list)
     cluster_ip: str = ""  # "None" => headless
     type: str = "ClusterIP"  # ClusterIP | NodePort | LoadBalancer
+    #: "None" | "ClientIP" — ClientIP pins a client to one endpoint
+    #: for the timeout (iptables: recent-module lists per SEP chain).
+    session_affinity: str = "None"
+    session_affinity_timeout_seconds: int = 10800
 
 
 @dataclass

@@ -401,6 +401,11 @@ def validate_service(svc: t.Service, is_create: bool = True) -> None:
                          "may not be set for type ClusterIP")
     if svc.spec.type not in _SERVICE_TYPES:
         errs.add("spec.type", f"must be one of {_SERVICE_TYPES}")
+    if svc.spec.session_affinity not in ("None", "ClientIP"):
+        errs.add("spec.session_affinity", "must be None or ClientIP")
+    if svc.spec.session_affinity_timeout_seconds <= 0:
+        errs.add("spec.session_affinity_timeout_seconds",
+                 "must be positive")
     ip = svc.spec.cluster_ip
     if ip and ip != "None" and not _valid_ip(ip):
         errs.add("spec.cluster_ip", f"must be empty, 'None', or an IP; got {ip!r}")

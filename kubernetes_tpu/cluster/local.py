@@ -216,8 +216,19 @@ class LocalCluster:
         self.dns = ClusterDNS(local, host=self.host)
         await self.dns.start()
         # Joining nodes learn the DNS address with their credential, so
-        # pods on joined hosts get KTPU_DNS_SERVER like local ones do.
+        # pods on joined hosts get KTPU_DNS_SERVER into every pod env.
         self.server.dns_address = self.dns.address
+
+        # Kernel NAT dataplane (opt-in, root-only): renders + applies
+        # the same iptables rulesets kube-proxy's iptables mode would.
+        # The userspace proxy stays on either way — it carries traffic
+        # wherever the kernel path can't.
+        self.iptables_syncer = None
+        if GATES.enabled("IptablesProxier"):
+            from ..net.iptables import IptablesSyncer
+            self.iptables_syncer = IptablesSyncer(
+                local, cluster_cidr=self.registry.cluster_cidr)
+            await self.iptables_syncer.start()
 
         for i, spec in enumerate(self.node_specs):
             self.nodes.append(await self._start_node(spec, i))
@@ -323,6 +334,8 @@ class LocalCluster:
             except Exception:  # noqa: BLE001
                 log.exception("node %s stop failed", node.name)
         self.nodes = []
+        if getattr(self, "iptables_syncer", None) is not None:
+            await self.iptables_syncer.stop()
         if self.dns is not None:
             await self.dns.stop()
         if self.controller_manager:

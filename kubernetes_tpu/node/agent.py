@@ -130,6 +130,10 @@ class NodeAgent:
         self.cni = CNIInvoker(os.path.join(cni_root, "net.d"),
                               os.path.join(cni_root, "bin"))
         self._cni_added: set[str] = set()
+        #: hostPort DNAT bookkeeping (reference: kubelet's hostport
+        #: syncer); renders always, programs the kernel only with root.
+        from ..net.iptables import HostportManager
+        self.hostports = HostportManager()
 
         #: Dynamic config from a ConfigMap (dynamicconfig.py); source
         #: discovery piggybacks on the node-status loop, so an agent
@@ -637,7 +641,12 @@ class NodeAgent:
                 self._cni_added.add(uid)
                 self.ipam.release(uid)
                 self.ipam.occupy(uid, ip)
-        return self.ipam.ip_for(uid)
+        pod_ip = self.ipam.ip_for(uid)
+        from ..net.iptables import find_hostports
+        if find_hostports(pod):
+            # Offloaded: applying DNAT rules shells out under root.
+            await asyncio.to_thread(self.hostports.note_pod, pod, pod_ip)
+        return pod_ip
 
     async def _release_pod_ip(self, uid: str) -> None:
         # DEL unconditionally when a conf is present (idempotent per
@@ -645,6 +654,7 @@ class NodeAgent:
         # only, and a pod networked before an agent restart must still
         # get its DEL or the plugin leaks the assignment.
         self._cni_added.discard(uid)
+        await asyncio.to_thread(self.hostports.forget_pod, uid)
         await self.cni.delete(uid)
         self.ipam.release(uid)
 

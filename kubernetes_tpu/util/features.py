@@ -46,6 +46,10 @@ KNOWN_FEATURES = {f.name: f for f in [
             "memory/disk-pressure pod eviction on the node agent"),
     Feature("ServiceProxy", True, BETA,
             "per-node userspace VIP forwarder + service env injection"),
+    Feature("IptablesProxier", False, ALPHA,
+            "kernel NAT service dataplane: render + iptables-restore "
+            "rulesets from Services/Endpoints (needs root; userspace "
+            "proxy stays on as fallback)"),
     Feature("NativeSubmeshFastPath", True, BETA,
             "C++ sub-mesh search fast path (falls back to numpy)"),
     Feature("AuditLogging", True, BETA,
