@@ -311,6 +311,7 @@ class Container:
     #: Reference analog: ``Container.ExtendedResourceRequests``
     #: (``types.go:2204``).
     tpu_requests: list[str] = field(default_factory=list)
+    security_context: Optional[SecurityContext] = None
 
 
 RESTART_ALWAYS = "Always"
@@ -379,6 +380,30 @@ class Affinity:
 
 
 @dataclass
+class SecurityContext:
+    """Container-level security settings (reference:
+    ``staging/src/k8s.io/api/core/v1/types.go SecurityContext``),
+    restricted to what a process runtime can truly enforce: uid/gid
+    via setuid/setgid at spawn, read-only mounts, and rlimits derived
+    from resource limits."""
+    run_as_user: Optional[int] = None
+    run_as_group: Optional[int] = None
+    run_as_non_root: bool = False
+    read_only_root_filesystem: bool = False
+
+
+@dataclass
+class PodSecurityContext:
+    """Pod-level defaults every container inherits unless it overrides
+    (reference: ``PodSecurityContext``). ``fs_group`` is the group
+    ownership applied to the pod's writable volume dirs."""
+    run_as_user: Optional[int] = None
+    run_as_group: Optional[int] = None
+    run_as_non_root: bool = False
+    fs_group: Optional[int] = None
+
+
+@dataclass
 class PodSpec:
     containers: list[Container] = field(default_factory=list)
     init_containers: list[Container] = field(default_factory=list)
@@ -401,6 +426,7 @@ class PodSpec:
     tpu_resources: list[PodTpuRequest] = field(default_factory=list)
     #: Name of the PodGroup this pod gangs with ("" = no gang).
     gang: str = ""
+    security_context: Optional[PodSecurityContext] = None
 
 
 POD_PENDING = "Pending"
@@ -768,6 +794,32 @@ class PriorityClass(TypedObject):
 
 
 @dataclass
+class UidRange:
+    min: int = 0
+    max: int = 0
+
+
+@dataclass
+class PodSecurityPolicySpec:
+    """PSP-lite (reference: ``pkg/security/podsecuritypolicy/``): the
+    subset a process runtime can enforce — who a pod may run as, and
+    what of the host it may touch."""
+    #: "RunAsAny" | "MustRunAs" (within ranges) | "MustRunAsNonRoot"
+    run_as_user_rule: str = "RunAsAny"
+    run_as_user_ranges: list[UidRange] = field(default_factory=list)
+    #: hostPath volumes allowed at all?
+    allow_host_paths: bool = True
+    #: every hostPath mount must be read_only in every container
+    read_only_host_paths: bool = False
+
+
+@dataclass
+class PodSecurityPolicy(TypedObject):
+    spec: PodSecurityPolicySpec = field(
+        default_factory=PodSecurityPolicySpec)
+
+
+@dataclass
 class LeaseSpec:
     holder_identity: str = ""
     lease_duration_seconds: float = 15
@@ -971,6 +1023,7 @@ for _kind, _cls in [
     DEFAULT_SCHEME.register(CORE_V1, _kind, _cls)
 
 DEFAULT_SCHEME.register("storage/v1", "StorageClass", StorageClass)
+DEFAULT_SCHEME.register("policy/v1", "PodSecurityPolicy", PodSecurityPolicy)
 
 
 def _default_pod(pod: Pod) -> None:

@@ -189,6 +189,29 @@ def validate_pod(pod: t.Pod, is_create: bool = True) -> None:
                          "mount_path is required")
     if pod.spec.restart_policy not in (t.RESTART_ALWAYS, t.RESTART_ON_FAILURE, t.RESTART_NEVER):
         errs.add("spec.restart_policy", f"unknown policy {pod.spec.restart_policy!r}")
+    # Security contexts: uids/gids must be sane; run_as_non_root with
+    # an explicit root uid is self-contradictory (validation.go
+    # ValidateSecurityContext).
+    sec_ctxs = []
+    if pod.spec.security_context is not None:
+        sec_ctxs.append(("spec.security_context",
+                         pod.spec.security_context))
+        fsg = pod.spec.security_context.fs_group
+        if fsg is not None and fsg < 0:
+            errs.add("spec.security_context.fs_group",
+                     "must be non-negative")
+    for i, c in enumerate(pod.spec.containers + pod.spec.init_containers):
+        if c.security_context is not None:
+            sec_ctxs.append((f"containers[{c.name}].security_context",
+                             c.security_context))
+    for path, sc in sec_ctxs:
+        for fname in ("run_as_user", "run_as_group"):
+            v = getattr(sc, fname)
+            if v is not None and v < 0:
+                errs.add(f"{path}.{fname}", "must be non-negative")
+        if sc.run_as_non_root and sc.run_as_user == 0:
+            errs.add(f"{path}", "run_as_non_root with run_as_user=0 "
+                                "is contradictory")
     aff = pod.spec.affinity
     if aff is not None:
         # REQUIRED inter-pod terms need a selector and a topology key
@@ -403,7 +426,10 @@ def validate_service(svc: t.Service, is_create: bool = True) -> None:
         errs.add("spec.type", f"must be one of {_SERVICE_TYPES}")
     if svc.spec.session_affinity not in ("None", "ClientIP"):
         errs.add("spec.session_affinity", "must be None or ClientIP")
-    if svc.spec.session_affinity_timeout_seconds <= 0:
+    elif (svc.spec.session_affinity == "ClientIP"
+          and svc.spec.session_affinity_timeout_seconds <= 0):
+        # Only meaningful (and only validated, like the reference)
+        # when ClientIP affinity is actually on.
         errs.add("spec.session_affinity_timeout_seconds",
                  "must be positive")
     ip = svc.spec.cluster_ip
@@ -889,6 +915,26 @@ def validate_pdb(pdb: w.PodDisruptionBudget, is_create: bool = True) -> None:
     errs.raise_if_any("PodDisruptionBudget", pdb.metadata.name)
 
 
+def validate_podsecuritypolicy(psp: t.PodSecurityPolicy,
+                               is_create: bool = True) -> None:
+    """Reference: ``pkg/apis/policy`` PSP validation (rule enums +
+    range sanity)."""
+    errs = ErrorList()
+    validate_object_meta(psp.metadata, errs, namespaced=False)
+    rule = psp.spec.run_as_user_rule
+    if rule not in ("RunAsAny", "MustRunAs", "MustRunAsNonRoot"):
+        errs.add("spec.run_as_user_rule",
+                 "must be RunAsAny, MustRunAs, or MustRunAsNonRoot")
+    if rule == "MustRunAs" and not psp.spec.run_as_user_ranges:
+        errs.add("spec.run_as_user_ranges",
+                 "required when run_as_user_rule is MustRunAs")
+    for i, r in enumerate(psp.spec.run_as_user_ranges):
+        if r.min < 0 or r.max < r.min:
+            errs.add(f"spec.run_as_user_ranges[{i}]",
+                     "needs 0 <= min <= max")
+    errs.raise_if_any("PodSecurityPolicy", psp.metadata.name)
+
+
 def validate_secret_update(new: t.Secret, old: t.Secret) -> None:
     validate_secret(new, is_create=False)
     errs = ErrorList()
@@ -932,6 +978,7 @@ VALIDATORS = {
     "CronJob": (validate_cronjob, None),
     "HorizontalPodAutoscaler": (validate_hpa, None),
     "PodDisruptionBudget": (validate_pdb, None),
+    "PodSecurityPolicy": (validate_podsecuritypolicy, None),
     "PodGroup": (validate_podgroup, None),
     "Service": (validate_service, validate_service_update),
     "Endpoints": (validate_endpoints, None),

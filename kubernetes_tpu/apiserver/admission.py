@@ -345,6 +345,83 @@ class LimitRanger(AdmissionPlugin):
                             f"exceeds LimitRange max {hi}")
 
 
+class PodSecurity(AdmissionPlugin):
+    """PSP-lite gate (reference: ``pkg/security/podsecuritypolicy/``
+    admission). Zero-cost while no PodSecurityPolicy objects exist;
+    once any do, every pod CREATE must satisfy at least one policy:
+
+    - ``run_as_user_rule``: RunAsAny / MustRunAs (the pod's effective
+      uid — container override else pod default — must sit inside one
+      of the policy's ranges, and must be SET) / MustRunAsNonRoot
+      (set and nonzero).
+    - ``allow_host_paths`` / ``read_only_host_paths``: whether hostPath
+      volumes are admitted, and whether every container mount of one
+      must be read_only.
+
+    Validate-only (no mutation): matching the reference's reject-at-
+    admission behavior for out-of-policy pods."""
+
+    name = "PodSecurity"
+
+    def __init__(self, registry: "Registry"):
+        self.registry = registry
+
+    def validate(self, op, spec, obj, old):
+        if spec.kind != "Pod" or op != "CREATE":
+            return
+        try:
+            policies, _ = self.registry.list("podsecuritypolicies", "")
+        except errors.StatusError:
+            return
+        if not policies:
+            return
+        reasons = []
+        for psp in sorted(policies, key=lambda p: p.metadata.name):
+            why = self._violates(obj, psp)
+            if why is None:
+                return  # satisfied by this policy
+            reasons.append(f"{psp.metadata.name}: {why}")
+        raise errors.ForbiddenError(
+            f"pod {obj.metadata.name!r} rejected by every "
+            f"PodSecurityPolicy ({'; '.join(reasons)})")
+
+    @staticmethod
+    def _effective_uid(pod: t.Pod, container: t.Container):
+        if container.security_context is not None \
+                and container.security_context.run_as_user is not None:
+            return container.security_context.run_as_user
+        if pod.spec.security_context is not None:
+            return pod.spec.security_context.run_as_user
+        return None
+
+    def _violates(self, pod: t.Pod, psp: t.PodSecurityPolicy):
+        s = psp.spec
+        for c in list(pod.spec.containers) + list(pod.spec.init_containers):
+            uid = self._effective_uid(pod, c)
+            if s.run_as_user_rule == "MustRunAsNonRoot":
+                if uid is None or uid == 0:
+                    return (f"container {c.name!r} must run as a "
+                            f"non-root uid")
+            elif s.run_as_user_rule == "MustRunAs":
+                if uid is None:
+                    return f"container {c.name!r} must set run_as_user"
+                if not any(r.min <= uid <= r.max
+                           for r in s.run_as_user_ranges):
+                    return (f"container {c.name!r} uid {uid} outside "
+                            f"allowed ranges")
+        host_vols = {v.name for v in pod.spec.volumes
+                     if v.host_path is not None}
+        if host_vols and not s.allow_host_paths:
+            return f"hostPath volumes not allowed ({sorted(host_vols)})"
+        if host_vols and s.read_only_host_paths:
+            for c in list(pod.spec.containers) + list(pod.spec.init_containers):
+                for vm in c.volume_mounts:
+                    if vm.name in host_vols and not vm.read_only:
+                        return (f"hostPath mount {vm.name!r} in container "
+                                f"{c.name!r} must be read_only")
+        return None
+
+
 def default_chain(registry: "Registry") -> AdmissionChain:
     return AdmissionChain([
         NamespaceLifecycle(registry),
@@ -353,4 +430,5 @@ def default_chain(registry: "Registry") -> AdmissionChain:
         ServiceAccountPlugin(registry),
         LimitRanger(registry),
         ResourceQuotaPlugin(registry),
+        PodSecurity(registry),
     ])

@@ -158,6 +158,9 @@ def builtin_resources() -> list[ResourceSpec]:
                      "autoscaling/v1", w.HorizontalPodAutoscaler),
         ResourceSpec("poddisruptionbudgets", "PodDisruptionBudget", "policy/v1",
                      w.PodDisruptionBudget),
+        ResourceSpec("podsecuritypolicies", "PodSecurityPolicy", "policy/v1",
+                     t.PodSecurityPolicy, namespaced=False,
+                     has_status=False),
         ResourceSpec("roles", "Role", r.RBAC_V1, r.Role, has_status=False,
                      path_segment_name=True),
         ResourceSpec("clusterroles", "ClusterRole", r.RBAC_V1, r.ClusterRole,

@@ -72,6 +72,9 @@ class CRIServer:
 
     def CreateContainer(self, request, context):
         c = request.config
+        # HasField: an absent linux block must not read as uid 0.
+        lin = c.linux if c.HasField("linux") else pb.LinuxSecurity(
+            run_as_user=-1, run_as_group=-1)
         config = ContainerConfig(
             pod_namespace=c.pod_namespace, pod_name=c.pod_name,
             pod_uid=c.pod_uid, name=c.name, image=c.image,
@@ -81,7 +84,11 @@ class CRIServer:
             working_dir=c.working_dir,
             mounts=[(m.host_path, m.container_path, m.readonly)
                     for m in c.mounts],
-            devices=list(c.devices))
+            devices=list(c.devices),
+            run_as_user=None if lin.run_as_user < 0 else lin.run_as_user,
+            run_as_group=None if lin.run_as_group < 0 else lin.run_as_group,
+            rlimits=[(r.resource, r.soft, r.hard) for r in lin.rlimits],
+            oom_score_adj=int(lin.oom_score_adj))
         try:
             cid = self._call(self.runtime.start_container(config))
         except Exception as e:  # noqa: BLE001
@@ -381,7 +388,15 @@ class RemoteRuntime(ContainerRuntime):
             working_dir=config.working_dir,
             mounts=[pb.Mount(host_path=h, container_path=c, readonly=ro)
                     for h, c, ro in config.mounts],
-            devices=list(config.devices)))
+            devices=list(config.devices),
+            linux=pb.LinuxSecurity(
+                run_as_user=(-1 if config.run_as_user is None
+                             else config.run_as_user),
+                run_as_group=(-1 if config.run_as_group is None
+                              else config.run_as_group),
+                rlimits=[pb.Rlimit(resource=r, soft=int(s), hard=int(h))
+                         for r, s, h in config.rlimits],
+                oom_score_adj=config.oom_score_adj)))
         resp = await asyncio.to_thread(self._create, req, timeout=120)
         return resp.container_id
 

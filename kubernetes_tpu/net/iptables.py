@@ -314,8 +314,14 @@ class HostportManager:
     inspectable either way."""
 
     def __init__(self):
+        import threading
         self._pods: dict[str, PodPortMapping] = {}  # uid -> mapping
         self._prev_chains: set[str] = set()
+        #: note_pod/forget_pod are offloaded to worker threads by
+        #: independent per-pod workers; the whole read-render-apply
+        #: must be atomic or interleaved applies can -X a chain the
+        #: other thread's ruleset still references.
+        self._lock = threading.Lock()
         self.last_rendered = ""
         self.applied = False
 
@@ -327,23 +333,28 @@ class HostportManager:
             return
         mapping = PodPortMapping(
             pod.metadata.namespace, pod.metadata.name, pod_ip, ports)
-        if self._pods.get(pod.metadata.uid) == mapping:
-            return
-        self._pods[pod.metadata.uid] = mapping
-        self._sync()
+        with self._lock:
+            if self._pods.get(pod.metadata.uid) == mapping:
+                return
+            self._pods[pod.metadata.uid] = mapping
+            self._sync_locked()
 
     def forget_pod(self, uid: str) -> None:
-        if self._pods.pop(uid, None) is not None:
-            self._sync()
+        with self._lock:
+            if self._pods.pop(uid, None) is not None:
+                self._sync_locked()
 
-    def _sync(self) -> None:
+    def _sync_locked(self) -> None:
         self.last_rendered = render_hostport_rules(
             sorted(self._pods.values(), key=lambda m: (m.namespace, m.name)))
         to_apply = with_stale_chain_cleanup(self.last_rendered,
                                             self._prev_chains)
         self._prev_chains = declared_dynamic_chains(self.last_rendered)
-        ensure_jump_rules()
+        # Apply first (creates KUBE-HOSTPORTS), then hook it into the
+        # built-ins; the jump targets must exist before -I can succeed.
         self.applied = apply_rules(to_apply)
+        if self.applied:
+            ensure_jump_rules(hostports=True)
 
 
 class IptablesSyncer:
@@ -419,8 +430,11 @@ class IptablesSyncer:
         to_apply = with_stale_chain_cleanup(self.last_rendered,
                                             self._prev_chains)
         self._prev_chains = declared_dynamic_chains(self.last_rendered)
-        ensure_jump_rules()
+        # Apply first (creates the KUBE-* chains), then hook them into
+        # the built-ins — a jump to a not-yet-created chain fails.
         self.applied = apply_rules(to_apply)
+        if self.applied:
+            ensure_jump_rules()
         self.syncs += 1
 
 
@@ -430,39 +444,53 @@ def can_apply() -> bool:
     return os.geteuid() == 0 and shutil.which("iptables-restore") is not None
 
 
-def jump_rule_specs() -> list[tuple[str, str, list[str]]]:
+def jump_rule_specs(hostports: bool = False) -> list[tuple[str, str, list[str]]]:
     """(table, builtin chain, rule args) hooking the KUBE-* chains into
     the kernel's built-ins — without these the restored rulesets are
     inert. Reference: Proxier's iptablesJumpChains +
     ensureKubeHostportChains; kube-proxy installs them with EnsureRule,
     separately from the restore payload (appending them inside a
-    --noflush restore would duplicate them every sync)."""
+    --noflush restore would duplicate them every sync).
+
+    ``hostports=True`` returns the KUBE-HOSTPORTS hooks instead — only
+    the HostportManager installs those (its restore is what creates
+    that chain; ensuring a jump to a chain that never exists would
+    fail every service sync on hostport-less clusters)."""
+    if hostports:
+        hp = ["-m", "comment", "--comment", "kube hostport portals",
+              "-m", "addrtype", "--dst-type", "LOCAL",
+              "-j", HOSTPORTS_CHAIN]
+        return [("nat", "PREROUTING", hp), ("nat", "OUTPUT", hp)]
     portal = ["-m", "comment", "--comment", "kubernetes service portals",
               "-j", SERVICES_CHAIN]
-    hp = ["-m", "comment", "--comment", "kube hostport portals",
-          "-m", "addrtype", "--dst-type", "LOCAL", "-j", HOSTPORTS_CHAIN]
     return [
         ("nat", "PREROUTING", portal),
         ("nat", "OUTPUT", portal),
         ("nat", "POSTROUTING",
          ["-m", "comment", "--comment", "kubernetes postrouting rules",
           "-j", POSTROUTING_CHAIN]),
+        # The filter-table KUBE-SERVICES (no-endpoint REJECTs) must be
+        # reachable from every path a client's SYN can take: local
+        # processes (OUTPUT), pod-forwarded traffic (FORWARD), and
+        # NodePort traffic addressed to the node itself (INPUT).
+        ("filter", "INPUT", portal),
+        ("filter", "OUTPUT", portal),
+        ("filter", "FORWARD", portal),
         ("filter", "FORWARD",
          ["-m", "comment", "--comment", "kubernetes forwarding rules",
           "-j", FORWARD_CHAIN]),
-        ("nat", "PREROUTING", hp),
-        ("nat", "OUTPUT", hp),
     ]
 
 
-def ensure_jump_rules() -> bool:
+def ensure_jump_rules(hostports: bool = False) -> bool:
     """Idempotently install the built-in-chain jumps (``-C`` probe,
-    ``-I`` on miss). Root-gated like apply_rules."""
+    ``-I`` on miss). Root-gated like apply_rules. Call AFTER the first
+    apply_rules — the jumps target chains the restore creates."""
     if not can_apply():
         return False
     import subprocess
     ok = True
-    for table, chain, args in jump_rule_specs():
+    for table, chain, args in jump_rule_specs(hostports):
         try:
             probe = subprocess.run(
                 ["iptables", "-t", table, "-C", chain, *args],

@@ -134,6 +134,10 @@ class NodeAgent:
         #: syncer); renders always, programs the kernel only with root.
         from ..net.iptables import HostportManager
         self.hostports = HostportManager()
+        #: PodUidIsolation: pod uid -> allocated OS uid (see
+        #: _pod_uid_for); freed at pod teardown.
+        self._uid_alloc: dict[str, int] = {}
+        self._uid_next = 0
 
         #: Dynamic config from a ConfigMap (dynamicconfig.py); source
         #: discovery piggybacks on the node-status loop, so an agent
@@ -623,6 +627,78 @@ class NodeAgent:
             return False
         return True
 
+    #: Per-pod uid allocation band for PodUidIsolation (below the
+    #: nobody/nogroup region, above typical human uids).
+    POD_UID_BASE = 64000
+    POD_UID_COUNT = 1000
+
+    def _pod_uid_for(self, pod_uid: str) -> int:
+        """Stable per-pod OS uid under the PodUidIsolation gate; slots
+        recycle only after the pod is gone (reference analog: PSP's
+        MustRunAs range allocation, done node-side here because the
+        process runtime has no user namespaces)."""
+        got = self._uid_alloc.get(pod_uid)
+        if got is not None:
+            return got
+        in_use = set(self._uid_alloc.values())
+        for off in range(self.POD_UID_COUNT):
+            cand = self.POD_UID_BASE + \
+                (self._uid_next + off) % self.POD_UID_COUNT
+            if cand not in in_use:
+                self._uid_next = (self._uid_next + off + 1) % self.POD_UID_COUNT
+                self._uid_alloc[pod_uid] = cand
+                return cand
+        raise RuntimeError("pod uid band exhausted")
+
+    def _security_opts(self, pod: t.Pod, container: t.Container):
+        """(uid, gid, rlimits) for a container spawn: container
+        security_context overrides pod-level, which overrides the
+        per-pod allocation (PodUidIsolation + root only). rlimits are
+        derived for any security-opted pod: no cores, bounded fds, and
+        address space from the memory limit (the no-cgroup analog of
+        the memory limit, alongside the OOM-score QoS mapping)."""
+        import resource
+
+        from ..util.features import GATES
+        sc_pod = pod.spec.security_context
+        sc_c = container.security_context
+        uid = gid = None
+        if sc_c is not None and sc_c.run_as_user is not None:
+            uid = sc_c.run_as_user
+        elif sc_pod is not None and sc_pod.run_as_user is not None:
+            uid = sc_pod.run_as_user
+        if sc_c is not None and sc_c.run_as_group is not None:
+            gid = sc_c.run_as_group
+        elif sc_pod is not None and sc_pod.run_as_group is not None:
+            gid = sc_pod.run_as_group
+        elif sc_pod is not None and sc_pod.fs_group is not None:
+            gid = sc_pod.fs_group
+        isolated = (GATES.enabled("PodUidIsolation")
+                    and os.geteuid() == 0)
+        if uid is None and isolated:
+            uid = self._pod_uid_for(pod.metadata.uid)
+        if uid is not None and gid is None:
+            gid = uid
+        rlimits: list[tuple] = []
+        if uid is not None or sc_pod is not None or sc_c is not None:
+            rlimits.append((resource.RLIMIT_CORE, 0, 0))
+            # Clamp to the agent's own hard cap: an unprivileged agent
+            # cannot RAISE a hard limit, and a failed setrlimit in the
+            # child would crash-loop the pod with an opaque error.
+            cur_hard = resource.getrlimit(resource.RLIMIT_NOFILE)[1]
+            if cur_hard == resource.RLIM_INFINITY:
+                cur_hard = 4096
+            hard = min(4096, cur_hard)
+            rlimits.append((resource.RLIMIT_NOFILE, min(1024, hard), hard))
+            mem = container.resources.limits.get("memory")
+            if mem:
+                # Address space needs headroom over RSS (mappings,
+                # shared libs): 2x the limit + 1GiB. RLIMIT_RSS is a
+                # no-op on modern kernels; AS is the enforceable one.
+                bound = int(t.parse_quantity(mem)) * 2 + 2**30
+                rlimits.append((resource.RLIMIT_AS, bound, bound))
+        return uid, gid, rlimits
+
     async def _ensure_pod_ip(self, pod: t.Pod) -> str:
         """Pod IP via the CNI plugin when one is configured (ADD once
         per pod; the plugin's assignment is adopted into the allocator
@@ -763,6 +839,13 @@ class NodeAgent:
         except Exception as e:  # noqa: BLE001
             self.recorder.event(pod, "Warning", "FailedSandbox", str(e))
             return
+        run_uid, run_gid, rlimits = self._security_opts(pod, container)
+        if run_uid is not None and os.geteuid() == 0:
+            # Pod-private volume tree: without this, any pod could read
+            # any other pod's projected Secrets/emptyDirs on the node.
+            await asyncio.to_thread(
+                self.volumes.secure_pod_dir, pod.metadata.uid,
+                run_uid, run_gid if run_gid is not None else run_uid)
         config = ContainerConfig(
             pod_namespace=pod.metadata.namespace, pod_name=pod.metadata.name,
             pod_uid=pod.metadata.uid, name=container.name, image=container.image,
@@ -771,7 +854,8 @@ class NodeAgent:
             env=env, working_dir=container.working_dir,
             mounts=mounts, devices=devices,
             oom_score_adj=cm.oom_score_adj(
-                pod, container, self.capacity.get("memory", 0.0)))
+                pod, container, self.capacity.get("memory", 0.0)),
+            run_as_user=run_uid, run_as_group=run_gid, rlimits=rlimits)
         try:
             cid = await self.runtime.start_container(config)
         except Exception as e:  # noqa: BLE001
@@ -1050,6 +1134,7 @@ class NodeAgent:
         self._restart_at.pop(key, None)
         self._admitted.discard(key)
         self._pod_uids.pop(key, None)
+        self._uid_alloc.pop(pod.metadata.uid, None)
         await self._release_pod_ip(pod.metadata.uid)
         self.volumes.teardown(pod.metadata.uid)
         # Confirm deletion: grace-0 delete completes removal (the node

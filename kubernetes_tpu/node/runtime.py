@@ -20,9 +20,31 @@ import asyncio
 import os
 import shutil
 import signal
+import subprocess
 import time
 from dataclasses import dataclass, field
 from typing import Optional
+
+
+def _make_preexec(uid: Optional[int], gid: Optional[int],
+                  rlimits: list[tuple]):
+    """Child-side identity/limit drop, run between fork and exec.
+    Order matters: rlimits while still privileged, then gid (setuid
+    last would lose the right to setgid). Reference analog: the OCI
+    runtime's process.user + rlimits spec fields."""
+    if uid is None and gid is None and not rlimits:
+        return None
+
+    def preexec() -> None:
+        import resource as res
+        for rname, soft, hard in rlimits:
+            res.setrlimit(rname, (soft, hard))
+        if gid is not None:
+            os.setgroups([])
+            os.setgid(gid)
+        if uid is not None:
+            os.setuid(uid)
+    return preexec
 
 STATE_CREATED = "created"
 STATE_RUNNING = "running"
@@ -49,6 +71,15 @@ class ContainerConfig:
     annotations: dict[str, str] = field(default_factory=dict)
     #: QoS-derived OOM score (qos/policy.go); 0 = leave kernel default.
     oom_score_adj: int = 0
+    #: Security context resolved by the agent (container override else
+    #: pod default else per-pod allocation): the spawn setuid/setgids
+    #: to these. None = inherit the agent's identity.
+    run_as_user: Optional[int] = None
+    run_as_group: Optional[int] = None
+    #: (resource.RLIMIT_*, soft, hard) applied in the child before
+    #: exec — the no-cgroup enforcement point for nofile/core/address-
+    #: space, like oom_score_adj is for memory pressure.
+    rlimits: list[tuple] = field(default_factory=list)
 
 
 @dataclass
@@ -306,14 +337,38 @@ class ProcessRuntime(ContainerRuntime):
                     f"conflicts with another mount (nested mounts are "
                     f"not supported by the process runtime)")
             os.symlink(host, link)
+        if config.run_as_user is not None and os.geteuid() == 0:
+            # The sandbox is the container's default cwd: it must be
+            # writable by the pod's identity and closed to other pods.
+            os.chown(sandbox, config.run_as_user,
+                     config.run_as_group
+                     if config.run_as_group is not None
+                     else config.run_as_user)
+            os.chmod(sandbox, 0o700)
         os.makedirs(os.path.dirname(self._log_path(cid)), exist_ok=True)
         log_f = open(self._log_path(cid), "wb")
+        preexec = _make_preexec(config.run_as_user, config.run_as_group,
+                                list(config.rlimits))
+        if preexec is not None and config.run_as_user is not None \
+                and os.geteuid() != 0:
+            # An explicitly requested identity the runtime cannot grant
+            # must FAIL the start, never silently run as the agent.
+            log_f.close()
+            st = ContainerStatus(
+                id=cid, name=config.name, pod_uid=config.pod_uid,
+                state=STATE_EXITED, exit_code=126,
+                started_at=time.time(), finished_at=time.time(),
+                message=f"run_as_user={config.run_as_user} requires a "
+                        f"privileged (root) node agent")
+            self._status[cid] = st
+            return cid
         try:
             proc = await asyncio.create_subprocess_exec(
                 *argv, stdout=log_f, stderr=asyncio.subprocess.STDOUT,
                 env=env, cwd=config.working_dir or sandbox,
-                start_new_session=True)
-        except (FileNotFoundError, PermissionError) as e:
+                start_new_session=True, preexec_fn=preexec)
+        except (FileNotFoundError, PermissionError,
+                subprocess.SubprocessError) as e:
             log_f.close()
             st = ContainerStatus(id=cid, name=config.name, pod_uid=config.pod_uid,
                                  state=STATE_EXITED, exit_code=127,

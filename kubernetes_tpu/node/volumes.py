@@ -116,6 +116,23 @@ class VolumeManager:
         path = os.path.join(self.base_dir, "pods", pod_uid, "volumes")
         return os.path.join(path, volume) if volume else path
 
+    def secure_pod_dir(self, pod_uid: str, uid: int, gid: int) -> None:
+        """Close the cross-pod read hole: the pod's volume tree becomes
+        0700 and owned by the pod's allocated identity, so a process
+        running as ANOTHER pod's uid cannot traverse into it
+        (reference analog: fsGroup ownership management in the volume
+        manager, ``pkg/volume/volume_linux.go SetVolumeOwnership``).
+        Root-agent only — chown needs CAP_CHOWN."""
+        top = self.pod_volume_dir(pod_uid)
+        os.makedirs(top, exist_ok=True)
+        for dirpath, dirnames, filenames in os.walk(top):
+            os.chown(dirpath, uid, gid)
+            for f in filenames:
+                p = os.path.join(dirpath, f)
+                if not os.path.islink(p):
+                    os.chown(p, uid, gid)
+        os.chmod(top, 0o700)
+
     async def materialize(self, pod: t.Pod) -> dict[str, str]:
         """Write/refresh every pod volume; returns volume name -> host
         path. ConfigMap/Secret content is re-projected on each call, so

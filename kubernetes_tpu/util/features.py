@@ -46,6 +46,10 @@ KNOWN_FEATURES = {f.name: f for f in [
             "memory/disk-pressure pod eviction on the node agent"),
     Feature("ServiceProxy", True, BETA,
             "per-node userspace VIP forwarder + service env injection"),
+    Feature("PodUidIsolation", False, ALPHA,
+            "per-pod uid/gid allocation + private volume dirs on "
+            "privileged (root) node agents; pods cannot read each "
+            "other's files"),
     Feature("IptablesProxier", False, ALPHA,
             "kernel NAT service dataplane: render + iptables-restore "
             "rulesets from Services/Endpoints (needs root; userspace "

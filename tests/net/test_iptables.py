@@ -256,19 +256,24 @@ async def test_syncer_renders_on_churn():
 def test_jump_rule_specs_cover_every_top_chain():
     """The restored chains are inert unless hooked into the kernel's
     built-ins (reference: iptablesJumpChains): service portals from
-    PREROUTING+OUTPUT, SNAT from POSTROUTING, forward-accept from
-    FORWARD, hostports from PREROUTING+OUTPUT."""
+    nat PREROUTING+OUTPUT AND filter INPUT/OUTPUT/FORWARD (the
+    no-endpoint REJECTs live in filter), SNAT from POSTROUTING,
+    forward-accept from FORWARD; hostports (separate set — only the
+    HostportManager creates that chain) from nat PREROUTING+OUTPUT."""
     specs = ipt.jump_rule_specs()
     by_target = {}
     for table, chain, args in specs:
         by_target.setdefault(args[-1], []).append((table, chain))
-    assert set(by_target[ipt.SERVICES_CHAIN]) == {("nat", "PREROUTING"),
-                                                 ("nat", "OUTPUT")}
+    assert set(by_target[ipt.SERVICES_CHAIN]) == {
+        ("nat", "PREROUTING"), ("nat", "OUTPUT"),
+        ("filter", "INPUT"), ("filter", "OUTPUT"), ("filter", "FORWARD")}
     assert by_target[ipt.POSTROUTING_CHAIN] == [("nat", "POSTROUTING")]
     assert by_target[ipt.FORWARD_CHAIN] == [("filter", "FORWARD")]
-    assert set(by_target[ipt.HOSTPORTS_CHAIN]) == {("nat", "PREROUTING"),
-                                                   ("nat", "OUTPUT")}
-    for _, _, args in specs:
+    assert ipt.HOSTPORTS_CHAIN not in by_target  # hostports=True only
+    hp = ipt.jump_rule_specs(hostports=True)
+    assert {(tb, ch) for tb, ch, _ in hp} == {("nat", "PREROUTING"),
+                                             ("nat", "OUTPUT")}
+    for _, _, args in specs + hp:
         assert "-j" in args  # every spec is a jump
 
 
@@ -306,7 +311,7 @@ def test_hostport_note_pod_idempotent():
                                            host_port=8080)])]))
     mgr.note_pod(pod, "10.200.0.5")
     calls = []
-    mgr._sync = lambda: calls.append(1)  # spy on re-syncs
+    mgr._sync_locked = lambda: calls.append(1)  # spy on re-syncs
     mgr.note_pod(pod, "10.200.0.5")  # same mapping: no work
     assert calls == []
     mgr.note_pod(pod, "10.200.0.6")  # IP changed: re-sync

@@ -1,0 +1,268 @@
+"""Pod security: uid/gid drop, rlimits, volume-dir isolation, and the
+PSP-lite admission gate (reference: SecurityContext in
+``staging/src/k8s.io/api/core/v1/types.go`` enforced by
+``pkg/security/podsecuritypolicy/``). The enforcement tests need a
+root agent (this is real setuid, not simulation) and skip elsewhere."""
+import asyncio
+import os
+
+import pytest
+
+from kubernetes_tpu.api import errors, types as t
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.apiserver.admission import default_chain
+from kubernetes_tpu.apiserver.registry import Registry
+from kubernetes_tpu.client.local import LocalClient
+from kubernetes_tpu.node.agent import NodeAgent
+from kubernetes_tpu.node.runtime import ContainerConfig, ProcessRuntime
+from kubernetes_tpu.scheduler.scheduler import Scheduler
+from kubernetes_tpu.util.features import GATES
+
+needs_root = pytest.mark.skipif(os.geteuid() != 0,
+                                reason="setuid needs a root agent")
+
+
+async def wait_for(fn, timeout=10.0, interval=0.05):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while asyncio.get_running_loop().time() < deadline:
+        result = fn()
+        if result:
+            return result
+        await asyncio.sleep(interval)
+    return fn()
+
+
+def fresh_registry():
+    reg = Registry()
+    reg.admission = default_chain(reg)
+    reg.create(t.Namespace(metadata=ObjectMeta(name="default")))
+    reg.create(t.Namespace(metadata=ObjectMeta(name="kube-system")))
+    return reg
+
+
+def mk_pod(name, command, run_as_user=None, volumes=(), mounts=(),
+           restart="Never"):
+    pod = t.Pod(metadata=ObjectMeta(name=name, namespace="default"),
+                spec=t.PodSpec(
+                    restart_policy=restart,
+                    volumes=list(volumes),
+                    containers=[t.Container(
+                        name="main", image="test-image", command=command,
+                        volume_mounts=list(mounts))]))
+    if run_as_user is not None:
+        pod.spec.security_context = t.PodSecurityContext(
+            run_as_user=run_as_user)
+    return pod
+
+
+# ---------------------------------------------------------------------------
+# Runtime enforcement
+# ---------------------------------------------------------------------------
+
+
+@needs_root
+async def test_container_runs_as_requested_uid(tmp_path):
+    rt = ProcessRuntime(str(tmp_path / "rt"))
+    cid = await rt.start_container(ContainerConfig(
+        pod_uid="sec1", name="idcheck", image="host",
+        command=["sh", "-c", "id -u; id -g"],
+        run_as_user=64101, run_as_group=64102))
+    st = None
+    for _ in range(100):
+        st = [s for s in await rt.list_containers() if s.id == cid][0]
+        if st.state == "exited":
+            break
+        await asyncio.sleep(0.05)
+    assert st.exit_code == 0, (st.exit_code, st.message)
+    logs = await rt.container_logs(cid)
+    assert "64101" in logs and "64102" in logs
+    await rt.shutdown()
+
+
+async def test_explicit_uid_without_root_fails_loudly(tmp_path, monkeypatch):
+    """A requested identity the runtime cannot grant must fail the
+    start (exit 126 + message), never silently run as the agent."""
+    monkeypatch.setattr(os, "geteuid", lambda: 1000)
+    rt = ProcessRuntime(str(tmp_path / "rt"))
+    cid = await rt.start_container(ContainerConfig(
+        pod_uid="sec2", name="denied", image="host",
+        command=["sh", "-c", "true"], run_as_user=64101))
+    st = [s for s in await rt.list_containers() if s.id == cid][0]
+    assert st.state == "exited" and st.exit_code == 126
+    assert "privileged" in st.message
+    await rt.shutdown()
+
+
+@needs_root
+async def test_rlimits_applied(tmp_path):
+    rt = ProcessRuntime(str(tmp_path / "rt"))
+    import resource
+    cid = await rt.start_container(ContainerConfig(
+        pod_uid="sec3", name="lim", image="host",
+        command=["sh", "-c", "ulimit -n"],
+        rlimits=[(resource.RLIMIT_NOFILE, 1024, 4096)]))
+    for _ in range(100):
+        st = [s for s in await rt.list_containers() if s.id == cid][0]
+        if st.state == "exited":
+            break
+        await asyncio.sleep(0.05)
+    logs = await rt.container_logs(cid)
+    assert "1024" in logs
+    await rt.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Two pods on one node: provable isolation
+# ---------------------------------------------------------------------------
+
+
+@needs_root
+async def test_pods_cannot_read_each_others_volumes(tmp_path):
+    """The r4 hole: every container ran as the agent's uid, so nothing
+    stopped a pod from reading another pod's Secret projection. Under
+    PodUidIsolation each pod gets its own uid and a 0700 volume tree;
+    a second pod's attempt to read the first's volume dir must fail."""
+    GATES.set("PodUidIsolation", True)
+    reg = fresh_registry()
+    client = LocalClient(reg)
+    rt = ProcessRuntime(str(tmp_path / "rt"))
+    agent = NodeAgent(client, "worker-0", rt,
+                      status_interval=0.3, heartbeat_interval=0.3,
+                      pleg_interval=0.1)
+    await agent.start()
+    sched = Scheduler(client, backoff_seconds=0.2)
+    await sched.start()
+    try:
+        vol = t.Volume(name="data", empty_dir=t.EmptyDirVolume())
+        mount = t.VolumeMount(name="data", mount_path="/data")
+        writer = mk_pod(
+            "writer", ["sh", "-c",
+                       "echo topsecret > data/secret.txt && sleep 60"],
+            volumes=[vol], mounts=[mount], restart="Never")
+        reg.create(writer)
+        await wait_for(lambda: reg.get("pods", "default", "writer")
+                       .status.phase == t.POD_RUNNING)
+        victim_dir = agent.volumes.pod_volume_dir(
+            reg.get("pods", "default", "writer").metadata.uid, "data")
+        await wait_for(
+            lambda: os.path.exists(os.path.join(victim_dir, "secret.txt")))
+
+        # The agent (root) can see the file; the ATTACKER POD cannot.
+        probe = mk_pod(
+            "snoop", ["sh", "-c",
+                      f"cat {victim_dir}/secret.txt && echo LEAKED; "
+                      f"exit 0"])
+        reg.create(probe)
+        await wait_for(lambda: reg.get("pods", "default", "snoop")
+                       .status.phase in (t.POD_SUCCEEDED, t.POD_FAILED))
+        cid = agent._containers["default/snoop"]["main"]
+        logs = await rt.container_logs(cid)
+        assert "LEAKED" not in logs, logs
+        assert "denied" in logs.lower(), logs
+
+        # Distinct uids were actually allocated.
+        uids = set(agent._uid_alloc.values())
+        assert len(uids) == 2, agent._uid_alloc
+        assert all(NodeAgent.POD_UID_BASE <= u <
+                   NodeAgent.POD_UID_BASE + NodeAgent.POD_UID_COUNT
+                   for u in uids)
+    finally:
+        GATES.set("PodUidIsolation", False)
+        await sched.stop()
+        await agent.stop()
+        await rt.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# PSP-lite admission
+# ---------------------------------------------------------------------------
+
+
+def test_psp_rejects_out_of_range_uid():
+    reg = fresh_registry()
+    reg.create(t.PodSecurityPolicy(
+        metadata=ObjectMeta(name="restricted"),
+        spec=t.PodSecurityPolicySpec(
+            run_as_user_rule="MustRunAs",
+            run_as_user_ranges=[t.UidRange(min=64000, max=65000)])))
+    with pytest.raises(errors.ForbiddenError, match="outside allowed"):
+        reg.create(mk_pod("bad", ["sleep", "1"], run_as_user=100))
+    with pytest.raises(errors.ForbiddenError, match="must set"):
+        reg.create(mk_pod("unset", ["sleep", "1"]))
+    reg.create(mk_pod("ok", ["sleep", "1"], run_as_user=64500))
+
+
+def test_psp_nonroot_rule():
+    reg = fresh_registry()
+    reg.create(t.PodSecurityPolicy(
+        metadata=ObjectMeta(name="nonroot"),
+        spec=t.PodSecurityPolicySpec(run_as_user_rule="MustRunAsNonRoot")))
+    with pytest.raises(errors.ForbiddenError, match="non-root"):
+        reg.create(mk_pod("root", ["sleep", "1"], run_as_user=0))
+    reg.create(mk_pod("fine", ["sleep", "1"], run_as_user=2000))
+
+
+def test_psp_hostpath_rules():
+    reg = fresh_registry()
+    reg.create(t.PodSecurityPolicy(
+        metadata=ObjectMeta(name="ro-host"),
+        spec=t.PodSecurityPolicySpec(read_only_host_paths=True)))
+    vol = t.Volume(name="h", host_path=t.HostPathVolume(path="/tmp"))
+    rw = mk_pod("rw", ["sleep", "1"], volumes=[vol],
+                mounts=[t.VolumeMount(name="h", mount_path="/h")])
+    with pytest.raises(errors.ForbiddenError, match="read_only"):
+        reg.create(rw)
+    ro = mk_pod("ro", ["sleep", "1"], volumes=[vol],
+                mounts=[t.VolumeMount(name="h", mount_path="/h",
+                                      read_only=True)])
+    reg.create(ro)
+
+    reg2 = fresh_registry()
+    reg2.create(t.PodSecurityPolicy(
+        metadata=ObjectMeta(name="no-host"),
+        spec=t.PodSecurityPolicySpec(allow_host_paths=False)))
+    with pytest.raises(errors.ForbiddenError, match="not allowed"):
+        reg2.create(mk_pod("hp", ["sleep", "1"], volumes=[vol],
+                           mounts=[t.VolumeMount(name="h",
+                                                 mount_path="/h")]))
+
+
+def test_psp_any_policy_admits():
+    """Multiple policies: satisfying ANY one admits (reference
+    semantics — policies are alternatives, not conjunctions)."""
+    reg = fresh_registry()
+    reg.create(t.PodSecurityPolicy(
+        metadata=ObjectMeta(name="strict"),
+        spec=t.PodSecurityPolicySpec(run_as_user_rule="MustRunAsNonRoot")))
+    reg.create(t.PodSecurityPolicy(
+        metadata=ObjectMeta(name="permissive"),
+        spec=t.PodSecurityPolicySpec()))
+    reg.create(mk_pod("anything", ["sleep", "1"]))  # permissive admits
+
+
+def test_psp_validation():
+    from kubernetes_tpu.api.errors import InvalidError
+    reg = fresh_registry()
+    with pytest.raises(InvalidError, match="run_as_user_ranges"):
+        reg.create(t.PodSecurityPolicy(
+            metadata=ObjectMeta(name="x"),
+            spec=t.PodSecurityPolicySpec(run_as_user_rule="MustRunAs")))
+    with pytest.raises(InvalidError, match="min <= max"):
+        reg.create(t.PodSecurityPolicy(
+            metadata=ObjectMeta(name="y"),
+            spec=t.PodSecurityPolicySpec(
+                run_as_user_rule="MustRunAs",
+                run_as_user_ranges=[t.UidRange(min=10, max=5)])))
+
+
+def test_security_context_field_validation():
+    from kubernetes_tpu.api.errors import InvalidError
+    reg = fresh_registry()
+    bad = mk_pod("neg", ["sleep", "1"], run_as_user=-5)
+    with pytest.raises(InvalidError, match="non-negative"):
+        reg.create(bad)
+    contradictory = mk_pod("c", ["sleep", "1"])
+    contradictory.spec.security_context = t.PodSecurityContext(
+        run_as_user=0, run_as_non_root=True)
+    with pytest.raises(InvalidError, match="contradictory"):
+        reg.create(contradictory)
