@@ -1,17 +1,21 @@
-"""Audit logging — structured request records.
+"""Audit logging — policy-driven structured request records.
 
-Reference: ``staging/src/k8s.io/apiserver/pkg/audit/`` — policy-driven
-event levels (None/Metadata/Request/RequestResponse) written by a log
-backend as JSON lines. Here: one event per API request, emitted after
-the response (ResponseComplete stage), with the request body attached
-at Request level and above. Read-only verbs can be excluded by policy
-(the common production config).
+Reference: ``staging/src/k8s.io/apiserver/pkg/audit/`` — a Policy maps
+each request to a level (None/Metadata/Request) via first-matching-rule
+(``pkg/audit/policy/checker.go LevelAndStages``), and events flow to
+backends: a JSON-lines log backend, and/or a BATCHING webhook backend
+(``plugin/pkg/audit/webhook/webhook.go``: bounded buffer, max-size/
+max-wait batches, retry with backoff; drop-oldest on overflow rather
+than blocking API serving). One event per request at ResponseComplete,
+request body attached at Request level.
 """
 from __future__ import annotations
 
+import asyncio
 import datetime
 import json
 import logging
+from dataclasses import dataclass, field
 from typing import IO, Optional
 
 log = logging.getLogger("audit")
@@ -19,38 +23,249 @@ log = logging.getLogger("audit")
 LEVEL_NONE = "None"
 LEVEL_METADATA = "Metadata"
 LEVEL_REQUEST = "Request"
+_LEVELS = (LEVEL_NONE, LEVEL_METADATA, LEVEL_REQUEST)
 
 _READ_VERBS = {"get", "list", "watch"}
 
 
+@dataclass
+class AuditRule:
+    """One policy rule (reference: ``pkg/apis/audit Policy.Rules``).
+    Empty selector lists match everything; all non-empty selectors must
+    match (AND), rules evaluate in order, first match wins."""
+    level: str = LEVEL_METADATA
+    users: list[str] = field(default_factory=list)
+    verbs: list[str] = field(default_factory=list)
+    resources: list[str] = field(default_factory=list)
+    namespaces: list[str] = field(default_factory=list)
+
+    def matches(self, user: str, verb: str, resource: str,
+                namespace: str) -> bool:
+        return ((not self.users or user in self.users)
+                and (not self.verbs or verb in self.verbs)
+                and (not self.resources or resource in self.resources)
+                and (not self.namespaces or namespace in self.namespaces))
+
+
+class AuditPolicy:
+    """Ordered rules + default level (the rule-less tail every real
+    policy file ends with)."""
+
+    def __init__(self, rules: Optional[list[AuditRule]] = None,
+                 default_level: str = LEVEL_METADATA):
+        self.rules = rules or []
+        self.default_level = default_level
+        for r in self.rules:
+            if r.level not in _LEVELS:
+                raise ValueError(f"unknown audit level {r.level!r} "
+                                 f"(known: {_LEVELS})")
+        if default_level not in _LEVELS:
+            raise ValueError(f"unknown audit level {default_level!r}")
+
+    def level_for(self, user: str, verb: str, resource: str,
+                  namespace: str) -> str:
+        for rule in self.rules:
+            if rule.matches(user, verb, resource, namespace):
+                return rule.level
+        return self.default_level
+
+    @classmethod
+    def from_file(cls, path: str) -> "AuditPolicy":
+        """Load a policy file (YAML or JSON):
+
+        .. code-block:: yaml
+
+            default_level: Metadata
+            rules:
+            - level: None
+              resources: [events, leases]
+            - level: Metadata
+              resources: [secrets]      # never log secret bodies
+            - level: Request
+              verbs: [create, update, patch, delete]
+        """
+        import yaml
+        with open(path) as f:
+            # YAML is a JSON superset: one parser, one error surface
+            # (same approach as cluster/config.py load_cluster_config).
+            data = yaml.safe_load(f.read())
+        if not isinstance(data, dict):
+            raise ValueError(f"audit policy {path}: expected a mapping")
+        rules = [AuditRule(
+            level=r.get("level", LEVEL_METADATA),
+            users=list(r.get("users", [])),
+            verbs=list(r.get("verbs", [])),
+            resources=list(r.get("resources", [])),
+            namespaces=list(r.get("namespaces", [])),
+        ) for r in data.get("rules", [])]
+        return cls(rules, data.get("default_level", LEVEL_METADATA))
+
+
+class AuditWebhookBackend:
+    """Batching webhook delivery (reference: webhook.go ModeBatch).
+
+    Events buffer in a bounded deque (drop-oldest + counter on
+    overflow — audit must never block or fail API serving); a flush
+    task posts ``{"kind": "EventList", "items": [...]}`` batches of up
+    to ``max_batch_size`` every ``max_batch_wait`` seconds (sooner when
+    a batch fills), retrying each batch with exponential backoff."""
+
+    def __init__(self, url: str, buffer_size: int = 10000,
+                 max_batch_size: int = 400, max_batch_wait: float = 5.0,
+                 retries: int = 4, initial_backoff: float = 0.5,
+                 ssl=None):
+        from collections import deque
+        self.url = url
+        self.max_batch_size = max_batch_size
+        self.max_batch_wait = max_batch_wait
+        self.retries = retries
+        self.initial_backoff = initial_backoff
+        self.ssl = ssl
+        self._buf = deque(maxlen=buffer_size)
+        self.dropped = 0
+        self.delivered = 0
+        self._task: Optional[asyncio.Task] = None
+        self._wake: Optional[asyncio.Event] = None
+        self._session = None
+        self._stopped = False
+
+    def enqueue(self, event: dict) -> None:
+        if len(self._buf) == self._buf.maxlen:
+            self.dropped += 1  # deque drops the oldest itself
+        self._buf.append(event)
+        if self._wake is not None and \
+                len(self._buf) >= self.max_batch_size:
+            self._wake.set()
+
+    def start(self) -> None:
+        import aiohttp
+        self._wake = asyncio.Event()
+        self._session = aiohttp.ClientSession()  # one conn, reused
+        self._task = asyncio.get_running_loop().create_task(self._run())
+
+    async def stop(self) -> None:
+        self._stopped = True
+        if self._task:
+            if self._wake is not None:
+                self._wake.set()
+            try:
+                await asyncio.wait_for(self._task, 10.0)
+            except asyncio.TimeoutError:
+                # wait_for cancelled + awaited the drain task; whatever
+                # it was carrying plus the buffer is LOST — the loss
+                # counter must say so, not read zero.
+                lost = len(self._buf)
+                self._buf.clear()
+                self.dropped += lost
+                log.warning("audit webhook: shutdown drain timed out; "
+                            "%d buffered events lost (in-flight batch "
+                            "may also be lost)", lost)
+        if self._session is not None:
+            await self._session.close()
+            self._session = None
+
+    async def _run(self) -> None:
+        while True:
+            try:
+                await asyncio.wait_for(self._wake.wait(),
+                                       self.max_batch_wait)
+            except asyncio.TimeoutError:
+                pass
+            self._wake.clear()
+            while self._buf:
+                batch = []
+                while self._buf and len(batch) < self.max_batch_size:
+                    batch.append(self._buf.popleft())
+                await self._post(batch)
+            if self._stopped:
+                return
+
+    async def _post(self, batch: list[dict]) -> None:
+        import aiohttp
+        payload = {"kind": "EventList", "items": batch}
+        backoff = self.initial_backoff
+        err = ""
+        for attempt in range(self.retries):
+            try:
+                async with self._session.post(
+                        self.url, json=payload, ssl=self.ssl,
+                        timeout=aiohttp.ClientTimeout(total=10)) as r:
+                    if r.status < 400:
+                        self.delivered += len(batch)
+                        return
+                    err = f"HTTP {r.status}"
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001
+                err = str(e)
+            if attempt < self.retries - 1:
+                await asyncio.sleep(backoff)
+                backoff *= 2
+        self.dropped += len(batch)
+        log.warning("audit webhook: dropped a batch of %d after %d "
+                    "attempts (%s)", len(batch), self.retries, err)
+
+
 class AuditLogger:
-    """JSON-lines audit backend. ``path`` or ``stream``; level selects
-    how much is recorded; ``omit_reads`` drops get/list/watch events."""
+    """Audit pipeline front-end. ``policy`` (per-rule levels) governs
+    what is recorded; without one, the flat ``level`` + ``omit_reads``
+    knobs apply globally (the pre-policy behavior, kept). Events go to
+    the JSON-lines stream (``path``/``stream``) and, when configured,
+    the batching ``webhook`` backend."""
 
     def __init__(self, path: str = "", stream: Optional[IO] = None,
-                 level: str = LEVEL_METADATA, omit_reads: bool = False):
+                 level: str = LEVEL_METADATA, omit_reads: bool = False,
+                 policy: Optional[AuditPolicy] = None,
+                 webhook: Optional[AuditWebhookBackend] = None):
         self.level = level
         self.omit_reads = omit_reads
+        self.policy = policy
+        self.webhook = webhook
         self._stream = stream
         self._path = path
         if path and stream is None:
             self._stream = open(path, "a", buffering=1)
+
+    def start(self) -> None:
+        """Start async backends (call on a running loop)."""
+        if self.webhook is not None:
+            self.webhook.start()
+
+    async def aclose(self) -> None:
+        if self.webhook is not None:
+            await self.webhook.stop()
+        self.close()
 
     def close(self) -> None:
         if self._path and self._stream:
             self._stream.close()
             self._stream = None
 
+    def _level_for(self, user: str, verb: str, resource: str,
+                   namespace: str) -> str:
+        if self.policy is not None:
+            return self.policy.level_for(user, verb, resource, namespace)
+        if self.omit_reads and verb in _READ_VERBS:
+            return LEVEL_NONE
+        return self.level
+
+    def wants_body(self, user: str, verb: str, resource: str,
+                   namespace: str) -> bool:
+        """The server reads the request body back only when the
+        EFFECTIVE level for this request wants it."""
+        return self._level_for(user, verb, resource,
+                               namespace) == LEVEL_REQUEST
+
     def record(self, *, user: str, verb: str, resource: str,
                namespace: str, name: str, code: int,
                latency_seconds: float, body: Optional[dict] = None,
                impersonated_by: str = "") -> None:
-        if self.level == LEVEL_NONE or self._stream is None:
-            return
-        if self.omit_reads and verb in _READ_VERBS:
+        level = self._level_for(user, verb, resource, namespace)
+        if level == LEVEL_NONE:
             return
         event = {
             "stage": "ResponseComplete",
+            "level": level,
             "timestamp": datetime.datetime.now(
                 datetime.timezone.utc).isoformat(),
             "user": user,
@@ -65,9 +280,12 @@ class AuditLogger:
             # Both identities on the record (reference: audit events
             # carry impersonatedUser alongside user).
             event["impersonated_by"] = impersonated_by
-        if self.level == LEVEL_REQUEST and body is not None:
+        if level == LEVEL_REQUEST and body is not None:
             event["request_object"] = body
-        try:
-            self._stream.write(json.dumps(event) + "\n")
-        except (OSError, ValueError):
-            log.exception("audit write failed")
+        if self._stream is not None:
+            try:
+                self._stream.write(json.dumps(event) + "\n")
+            except (OSError, ValueError):
+                log.exception("audit write failed")
+        if self.webhook is not None:
+            self.webhook.enqueue(event)

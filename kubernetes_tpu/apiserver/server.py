@@ -32,7 +32,7 @@ from ..api import errors
 from ..api.scheme import deepcopy as obj_deepcopy, to_dict
 from ..metrics.registry import REGISTRY as METRICS, Histogram
 from .admission import default_chain
-from .audit import LEVEL_REQUEST, AuditLogger
+from .audit import AuditLogger
 from .authz import Attributes, Authorizer, verb_for_request
 from .registry import Registry
 
@@ -435,8 +435,9 @@ class APIServer:
     async def _audit(self, request: web.Request, attrs: Attributes,
                      code: int, elapsed: float) -> None:
         body = None
-        if self.audit.level == LEVEL_REQUEST and request.method in (
-                "POST", "PUT", "PATCH"):
+        if request.method in ("POST", "PUT", "PATCH") and \
+                self.audit.wants_body(attrs.user, attrs.verb,
+                                      attrs.resource, attrs.namespace):
             try:
                 body = json.loads(await request.read())
             except Exception:  # noqa: BLE001 — audit must never alter

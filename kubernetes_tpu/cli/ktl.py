@@ -837,7 +837,8 @@ async def cmd_up(args) -> int:
         host=cfg.host, port=cfg.port, durable=cfg.durable,
         tokens=tokens, user_groups=user_groups,
         authorization_mode=cfg.authorization_mode,
-        audit_log=cfg.audit_log,
+        audit_log=cfg.audit_log, audit_policy=cfg.audit_policy,
+        audit_webhook=cfg.audit_webhook,
         tls=not getattr(args, "insecure", False))
     base = await cluster.start()
     os.makedirs(os.path.dirname(DEFAULT_CONFIG), exist_ok=True)
@@ -1424,6 +1425,12 @@ def build_parser() -> argparse.ArgumentParser:
                     choices=["AlwaysAllow", "RBAC"])
     sp.add_argument("--audit-log", default=S,
                     help="write request audit JSONL to this path")
+    sp.add_argument("--audit-policy", default=S,
+                    help="per-rule audit policy file (YAML/JSON: "
+                         "default_level + rules of level/users/verbs/"
+                         "resources/namespaces)")
+    sp.add_argument("--audit-webhook", default=S,
+                    help="POST batched audit events to this URL")
 
     return p
 

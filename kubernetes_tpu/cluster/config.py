@@ -36,6 +36,8 @@ class ClusterConfig:
     feature_gates: str = ""
     authorization_mode: str = "AlwaysAllow"
     audit_log: str = ""
+    audit_policy: str = ""
+    audit_webhook: str = ""
     nodes: list = dataclasses.field(default_factory=list)
 
 
@@ -78,7 +80,8 @@ def config_from_args(args) -> ClusterConfig:
     path = getattr(args, "config", "")
     cfg = load_cluster_config(path) if path else ClusterConfig()
     for name in ("host", "port", "data_dir", "durable", "feature_gates",
-                 "authorization_mode", "audit_log"):
+                 "authorization_mode", "audit_log", "audit_policy",
+                 "audit_webhook"):
         if hasattr(args, name):
             setattr(cfg, name, getattr(args, name))
     node_flags = any(hasattr(args, k)

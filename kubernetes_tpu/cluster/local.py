@@ -109,6 +109,8 @@ class LocalCluster:
                  authorization_mode: str = "AlwaysAllow",
                  user_groups: Optional[dict] = None,
                  audit_log: str = "",
+                 audit_policy: str = "",
+                 audit_webhook: str = "",
                  tls: bool = True):
         """``tls=True`` (default): the apiserver serves HTTPS only from
         a cluster CA minted under ``<data_dir>/pki`` — plaintext
@@ -125,6 +127,8 @@ class LocalCluster:
         self.authorization_mode = authorization_mode
         self.user_groups = user_groups
         self.audit_log = audit_log
+        self.audit_policy = audit_policy
+        self.audit_webhook = audit_webhook
         self.tls = tls
         self.ca = None
         self.ca_file = ""
@@ -161,12 +165,25 @@ class LocalCluster:
             except errors.AlreadyExistsError:
                 pass  # durable restart
 
-        from ..apiserver.audit import AuditLogger
+        from ..apiserver.audit import (AuditLogger, AuditPolicy,
+                                       AuditWebhookBackend)
         from ..apiserver.authz import make_authorizer
         from ..util.features import GATES
-        audit = self._audit = (
-            AuditLogger(path=self.audit_log)
-            if self.audit_log and GATES.enabled("AuditLogging") else None)
+        audit = self._audit = None
+        if self.audit_policy and not (self.audit_log or self.audit_webhook):
+            raise ValueError(
+                "--audit-policy needs a backend: pass --audit-log "
+                "and/or --audit-webhook (a policy with nowhere to "
+                "write would silently audit nothing)")
+        if GATES.enabled("AuditLogging") and (
+                self.audit_log or self.audit_webhook):
+            audit = self._audit = AuditLogger(
+                path=self.audit_log,
+                policy=(AuditPolicy.from_file(self.audit_policy)
+                        if self.audit_policy else None),
+                webhook=(AuditWebhookBackend(self.audit_webhook)
+                         if self.audit_webhook else None))
+            audit.start()
         self.server = APIServer(
             self.registry, tokens=self.tokens,
             authorizer=make_authorizer(self.authorization_mode, self.registry),
@@ -345,7 +362,7 @@ class LocalCluster:
         if self.server:
             await self.server.stop()
         if getattr(self, "_audit", None):
-            self._audit.close()
+            await self._audit.aclose()
         if self.registry and self.durable:
             self.registry.store.snapshot()
 

@@ -1,0 +1,212 @@
+"""Policy-driven audit + batching webhook backend.
+
+Reference: ``staging/src/k8s.io/apiserver/pkg/audit/policy/checker.go``
+(first-matching-rule levels) and
+``plugin/pkg/audit/webhook/webhook.go`` (ModeBatch: bounded buffer,
+batch size/wait, retry)."""
+import asyncio
+import io
+import json
+
+import pytest
+from aiohttp import web
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.apiserver.audit import (AuditLogger, AuditPolicy,
+                                            AuditRule,
+                                            AuditWebhookBackend)
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.client.rest import RESTClient
+
+POLICY = AuditPolicy(rules=[
+    AuditRule(level="None", resources=["events", "leases"]),
+    AuditRule(level="Metadata", resources=["secrets"]),
+    AuditRule(level="Request",
+              verbs=["create", "update", "patch", "delete"]),
+], default_level="Metadata")
+
+
+def test_policy_first_match_wins():
+    # events are silenced even for writes (rule 1 precedes rule 3).
+    assert POLICY.level_for("u", "create", "events", "default") == "None"
+    # secret WRITES stay Metadata — bodies of secrets never logged.
+    assert POLICY.level_for("u", "create", "secrets", "default") == "Metadata"
+    assert POLICY.level_for("u", "create", "pods", "default") == "Request"
+    assert POLICY.level_for("u", "get", "pods", "default") == "Metadata"
+
+
+def test_policy_selector_and_semantics():
+    p = AuditPolicy(rules=[
+        AuditRule(level="Request", users=["admin"], resources=["pods"]),
+    ], default_level="None")
+    assert p.level_for("admin", "create", "pods", "x") == "Request"
+    assert p.level_for("admin", "create", "services", "x") == "None"
+    assert p.level_for("bob", "create", "pods", "x") == "None"
+
+
+def test_policy_file_roundtrip(tmp_path):
+    f = tmp_path / "policy.yaml"
+    f.write_text("""
+default_level: Metadata
+rules:
+- level: "None"
+  resources: [events]
+- level: Request
+  verbs: [create]
+  namespaces: [prod]
+""")
+    p = AuditPolicy.from_file(str(f))
+    assert p.level_for("u", "create", "pods", "prod") == "Request"
+    assert p.level_for("u", "create", "pods", "dev") == "Metadata"
+    assert p.level_for("u", "update", "events", "prod") == "None"
+    with pytest.raises(ValueError, match="unknown audit level"):
+        AuditPolicy(rules=[AuditRule(level="Everything")])
+
+
+async def test_policy_through_apiserver():
+    """The policy decides per-request what the log records: resource
+    levels, body capture, and silence."""
+    stream = io.StringIO()
+    audit = AuditLogger(stream=stream, policy=POLICY)
+    srv = APIServer(audit=audit)
+    srv.registry.create(t.Namespace(metadata=ObjectMeta(name="default")))
+    port = await srv.start()
+    client = RESTClient(f"http://127.0.0.1:{port}")
+    try:
+        await client.create(t.Pod(
+            metadata=ObjectMeta(name="p", namespace="default"),
+            spec=t.PodSpec(containers=[t.Container(name="c", image="i")])))
+        await client.create(t.Secret(
+            metadata=ObjectMeta(name="s", namespace="default"),
+            string_data={"k": "v"}))
+        await client.get("pods", "default", "p")
+        # events: silenced entirely.
+        await client.create(t.Event(
+            metadata=ObjectMeta(name="e", namespace="default"),
+            involved_object=t.ObjectReference(kind="Pod", name="p"),
+            reason="Test"))
+    finally:
+        await client.close()
+        await srv.stop()
+    events = [json.loads(ln) for ln in stream.getvalue().splitlines()]
+    by = {(e["verb"], e["resource"]): e for e in events}
+    pod_create = by[("create", "pods")]
+    assert pod_create["level"] == "Request"
+    assert pod_create["request_object"]["metadata"]["name"] == "p"
+    sec_create = by[("create", "secrets")]
+    assert sec_create["level"] == "Metadata"
+    assert "request_object" not in sec_create, \
+        "secret bodies must never reach the audit log"
+    assert by[("get", "pods")]["level"] == "Metadata"
+    assert ("create", "events") not in by
+
+
+class Receiver:
+    """Audit webhook sink; optionally fails the first N posts."""
+
+    def __init__(self, fail_first: int = 0):
+        self.batches: list[list[dict]] = []
+        self.posts = 0
+        self.fail_first = fail_first
+        self.app = web.Application()
+        self.app.router.add_post("/audit", self.handle)
+
+    async def start(self):
+        self._runner = web.AppRunner(self.app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+        self.url = f"http://127.0.0.1:{port}/audit"
+
+    async def stop(self):
+        await self._runner.cleanup()
+
+    async def handle(self, request):
+        self.posts += 1
+        if self.posts <= self.fail_first:
+            return web.Response(status=503)
+        body = await request.json()
+        assert body["kind"] == "EventList"
+        self.batches.append(body["items"])
+        return web.Response(status=200)
+
+
+async def test_webhook_batches_under_load():
+    """Load: every event is delivered, batched (far fewer posts than
+    events), each batch bounded by max_batch_size."""
+    rx = Receiver()
+    await rx.start()
+    hook = AuditWebhookBackend(rx.url, max_batch_size=50,
+                               max_batch_wait=0.2)
+    audit = AuditLogger(stream=io.StringIO(), webhook=hook)
+    srv = APIServer(audit=audit)
+    srv.registry.create(t.Namespace(metadata=ObjectMeta(name="default")))
+    audit.start()
+    port = await srv.start()
+    client = RESTClient(f"http://127.0.0.1:{port}")
+    n = 300
+    try:
+        await asyncio.gather(*(client.create(t.ConfigMap(
+            metadata=ObjectMeta(name=f"cm-{i}", namespace="default"),
+            data={"i": str(i)})) for i in range(n)))
+        for _ in range(100):
+            if sum(len(b) for b in rx.batches) >= n + 1:
+                break
+            await asyncio.sleep(0.1)
+    finally:
+        await client.close()
+        await srv.stop()
+        await audit.aclose()
+        await rx.stop()
+    delivered = [e for b in rx.batches for e in b]
+    creates = [e for e in delivered
+               if e["verb"] == "create" and e["resource"] == "configmaps"]
+    assert len(creates) == n, f"delivered {len(creates)}/{n}"
+    assert all(len(b) <= 50 for b in rx.batches)
+    assert len(rx.batches) < n / 2, \
+        f"{len(rx.batches)} posts for {n} events — not batching"
+    assert hook.dropped == 0
+
+
+async def test_webhook_retries_through_outage():
+    """The first posts 503; retry-with-backoff must still land every
+    event, and the failure never surfaces to API clients."""
+    rx = Receiver(fail_first=2)
+    await rx.start()
+    hook = AuditWebhookBackend(rx.url, max_batch_size=10,
+                               max_batch_wait=0.1,
+                               retries=5, initial_backoff=0.05)
+    audit = AuditLogger(stream=io.StringIO(), webhook=hook)
+    srv = APIServer(audit=audit)
+    srv.registry.create(t.Namespace(metadata=ObjectMeta(name="default")))
+    audit.start()
+    port = await srv.start()
+    client = RESTClient(f"http://127.0.0.1:{port}")
+    try:
+        for i in range(5):
+            await client.create(t.ConfigMap(
+                metadata=ObjectMeta(name=f"r-{i}", namespace="default")))
+        for _ in range(100):
+            if sum(len(b) for b in rx.batches) >= 6:
+                break
+            await asyncio.sleep(0.1)
+    finally:
+        await client.close()
+        await srv.stop()
+        await audit.aclose()
+        await rx.stop()
+    delivered = [e for b in rx.batches for e in b]
+    assert len([e for e in delivered if e["resource"] == "configmaps"]) == 5
+    assert rx.posts > len(rx.batches)  # the 503s forced retries
+    assert hook.dropped == 0
+
+
+async def test_webhook_overflow_drops_oldest_never_blocks():
+    hook = AuditWebhookBackend("http://127.0.0.1:1/none", buffer_size=10)
+    for i in range(25):
+        hook.enqueue({"i": i})
+    assert len(hook._buf) == 10
+    assert hook.dropped == 15
+    assert hook._buf[0]["i"] == 15  # oldest dropped, newest kept
