@@ -185,22 +185,29 @@ class AuditWebhookBackend:
         payload = {"kind": "EventList", "items": batch}
         backoff = self.initial_backoff
         err = ""
-        for attempt in range(self.retries):
-            try:
-                async with self._session.post(
-                        self.url, json=payload, ssl=self.ssl,
-                        timeout=aiohttp.ClientTimeout(total=10)) as r:
-                    if r.status < 400:
-                        self.delivered += len(batch)
-                        return
-                    err = f"HTTP {r.status}"
-            except asyncio.CancelledError:
-                raise
-            except Exception as e:  # noqa: BLE001
-                err = str(e)
-            if attempt < self.retries - 1:
-                await asyncio.sleep(backoff)
-                backoff *= 2
+        try:
+            for attempt in range(self.retries):
+                try:
+                    async with self._session.post(
+                            self.url, json=payload, ssl=self.ssl,
+                            timeout=aiohttp.ClientTimeout(total=10)) as r:
+                        if r.status < 400:
+                            self.delivered += len(batch)
+                            return
+                        err = f"HTTP {r.status}"
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:  # noqa: BLE001
+                    err = str(e)
+                if attempt < self.retries - 1:
+                    await asyncio.sleep(backoff)
+                    backoff *= 2
+        except asyncio.CancelledError:
+            # Shutdown-drain timeout cancelled us mid-batch: the honest
+            # loss counter includes the batch in hand, not just what
+            # stop() finds left in the buffer.
+            self.dropped += len(batch)
+            raise
         self.dropped += len(batch)
         log.warning("audit webhook: dropped a batch of %d after %d "
                     "attempts (%s)", len(batch), self.retries, err)

@@ -88,6 +88,11 @@ def gang_objects(idx: int, prefix: str = "gang",
     return group, pods
 
 
+def _bench_prefix(pod) -> str:
+    """'pre-0003-1' -> 'pre' (the load-tier tag in pod names)."""
+    return pod.metadata.name.split("-", 1)[0]
+
+
 def _factorizations(n: int):
     """All (a, b, c) with a*b*c == n — derived, not hardcoded, so the
     checker tracks GANG_SHAPE edits instead of false-alarming."""
@@ -122,6 +127,8 @@ def _box_offsets(dims):
 
 async def run_gang_bench(n_slices: int = 8, n_gangs: Optional[int] = None,
                          timeout: float = 600.0) -> dict:
+    from ..scheduler import metrics as sm
+    sm.PREEMPTION_LATENCY.reset()  # isolate this run
     reg = Registry()
     reg.admission = default_chain(reg)
     reg.create(t.Namespace(metadata=ObjectMeta(name="default")))
@@ -226,9 +233,19 @@ async def run_gang_bench(n_slices: int = 8, n_gangs: Optional[int] = None,
             fstream.cancel()
             fcounter.cancel()
 
-    n_preempt = max(1, n_gangs // 8)
+    # Scale: carve HALF the fleet's boxes (>=32 gangs at the default
+    # 8-slice fleet), in two MIXED-priority tiers poured together —
+    # prio-1000 and prio-500 gangs compete for overlapping victims,
+    # and the 500s must also yield to the 1000s. The fleet is at 100%
+    # (phase-2 fill), so every single gang below must displace
+    # standing gangs; all of them binding within the timeout is the
+    # no-livelock proof.
+    n_preempt = min(total_boxes, max(2, total_boxes // 2))
     want_preempt = n_preempt * members
     preempt_bound: set[str] = set()
+    gang_created: dict[str, float] = {}
+    gang_bound_at: dict[str, float] = {}
+    gang_members_bound: dict[str, int] = {}
     pdone = asyncio.Event()
     try:
         pstream = await client.watch("pods", namespace="default")
@@ -242,12 +259,18 @@ async def run_gang_bench(n_slices: int = 8, n_gangs: Optional[int] = None,
             if ev is None or ev[0] == "CLOSED":
                 return
             ev_type, pod = ev
-            if not pod.metadata.name.startswith("pre-"):
+            if _bench_prefix(pod) not in ("pre", "mid"):
                 continue
             if ev_type == "DELETED":
                 preempt_bound.discard(pod.key())
             elif ev_type in ("ADDED", "MODIFIED") and pod.spec.node_name:
-                preempt_bound.add(pod.key())
+                if pod.key() not in preempt_bound:
+                    preempt_bound.add(pod.key())
+                    g = pod.spec.gang
+                    gang_members_bound[g] = gang_members_bound.get(g, 0) + 1
+                    if gang_members_bound[g] == members \
+                            and g not in gang_bound_at:
+                        gang_bound_at[g] = time.perf_counter()
                 if len(preempt_bound) >= want_preempt:
                     pdone.set()
 
@@ -255,7 +278,11 @@ async def run_gang_bench(n_slices: int = 8, n_gangs: Optional[int] = None,
     try:
         pstart = time.perf_counter()
         for i in range(n_preempt):
-            group, ppods = gang_objects(i, prefix="pre", priority=1000)
+            # Alternate tiers so high/mid arrivals interleave.
+            prefix, prio = (("pre", 1000) if i % 2 == 0
+                            else ("mid", 500))
+            group, ppods = gang_objects(i, prefix=prefix, priority=prio)
+            gang_created[group.metadata.name] = time.perf_counter()
             await client.create(group)
             for pod in ppods:
                 await client.create(pod)
@@ -270,6 +297,12 @@ async def run_gang_bench(n_slices: int = 8, n_gangs: Optional[int] = None,
         pstream.cancel()
         pcounter.cancel()
         await sched.stop()
+    # Per-gang create -> all-members-bound percentiles (externally
+    # observed), plus the scheduler's own decision->bound histogram.
+    from . import pct
+    glats = sorted(gang_bound_at[g] - gang_created[g]
+                   for g in gang_created if g in gang_bound_at)
+    ph = sm.PREEMPTION_LATENCY
     pods, _ = reg.list("pods", "default")
     bound = [p for p in pods if p.spec.node_name and t.is_pod_active(p)]
 
@@ -304,16 +337,25 @@ async def run_gang_bench(n_slices: int = 8, n_gangs: Optional[int] = None,
         "pods_per_second": round(want_bound / wall, 2),
         "non_contiguous_gangs": non_contiguous,
         "preemption": {
-            "high_prio_gangs": n_preempt,
+            "gangs": n_preempt,
+            "priorities": [1000, 500],
+            "fleet_full_before": n_fill >= 0,
             "high_prio_pods_bound": high_bound,
             # low-prio pods created minus those still standing = the
-            # pods the high-prio wave displaced.
+            # pods the preempting waves displaced.
             "victims_evicted": (
                 want_bound + max(n_fill, 0) * members
                 - sum(1 for p in bound
-                      if not p.metadata.name.startswith("pre-"))),
+                      if _bench_prefix(p) not in ("pre", "mid"))),
             "wall_seconds": round(pwall, 3),
             "gangs_per_second": round(n_preempt / pwall, 2),
+            # External clock: gang create -> all members bound.
+            "preempt_to_bound_p50_ms": round(pct(glats, 0.5) * 1e3, 1),
+            "preempt_to_bound_p99_ms": round(pct(glats, 0.99) * 1e3, 1),
+            "gangs_measured": len(glats),
+            # Scheduler clock: preemption decision -> all bound.
+            "decision_to_bound_p50_ms": round(ph.quantile(0.5) * 1e3, 1),
+            "decision_to_bound_p99_ms": round(ph.quantile(0.99) * 1e3, 1),
         },
     }
 

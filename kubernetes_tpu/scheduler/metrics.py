@@ -31,6 +31,12 @@ GANG_SCHEDULING_LATENCY = Histogram(
     "Gang release to all-members-bound latency",
     buckets=_LAT_BUCKETS)
 
+PREEMPTION_LATENCY = Histogram(
+    "scheduler_preemption_latency_seconds",
+    "Preemption decision to all-members-bound latency per gang "
+    "(victim eviction + box reservation + re-plan + bind)",
+    buckets=_LAT_BUCKETS)
+
 PODS_SCHEDULED = Counter(
     "scheduler_pods_scheduled_total", "Successfully bound pods",
     labels=("result",))

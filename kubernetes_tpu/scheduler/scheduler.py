@@ -54,6 +54,9 @@ class Scheduler:
         #: predicates/priorities for pods they manage.
         self.extenders: list = []
         self._bind_sem = asyncio.Semaphore(64)
+        #: gang key -> perf_counter at preemption decision; observed
+        #: into PREEMPTION_LATENCY when the gang's plan finally binds.
+        self._preempt_started: dict[str, float] = {}
         self._bind_tasks: set[asyncio.Task] = set()
         #: Max in-flight+queued async binds before placement pauses.
         self.max_bind_backlog = 256
@@ -86,8 +89,7 @@ class Scheduler:
         groups = SharedInformer(self.client, "podgroups")
         groups.add_handlers(on_add=self._group_changed_add,
                             on_update=self._group_changed,
-                            on_delete=lambda g:
-                            self.cache.release_reservation(g.key()))
+                            on_delete=self._group_deleted)
         self._informers = [pods, nodes, groups]
         for inf in self._informers:
             inf.start()
@@ -155,6 +157,13 @@ class Scheduler:
 
     def _group_changed(self, old, group: t.PodGroup) -> None:
         self.queue.set_gang_min(group.key(), group.spec.min_member)
+
+    def _group_deleted(self, group: t.PodGroup) -> None:
+        self.cache.release_reservation(group.key())
+        # A gang deleted mid-preemption must not leave a stale clock
+        # that a future same-named gang would observe as an hours-long
+        # preemption latency.
+        self._preempt_started.pop(group.key(), None)
 
     # -- main loop --------------------------------------------------------
 
@@ -710,6 +719,7 @@ class Scheduler:
         try:
             group = await self.client.get("podgroups", ns, name)
         except errors.NotFoundError:
+            self._preempt_started.pop(unit.group_key, None)
             return
         # The gang planner does not consult extenders; silently
         # bypassing a NON-ignorable one would double-book whatever
@@ -788,6 +798,11 @@ class Scheduler:
                         and group.key() not in self.cache.reservations
                         and await self._preempt_gang(group, pods,
                                                      gang_prio)):
+                    # Clock the whole carve: decision -> victims gone
+                    # -> re-plan -> all members bound (observed when
+                    # the plan lands below).
+                    self._preempt_started.setdefault(
+                        group.key(), time.perf_counter())
                     # Victims are terminating; retry soon, not at full
                     # backoff.
                     await self.queue.requeue(GangUnit(unit.group_key, pods),
@@ -847,6 +862,9 @@ class Scheduler:
             return
         m.BINDING_LATENCY.observe(time.perf_counter() - bind_start)
         m.GANG_SCHEDULING_LATENCY.observe(time.perf_counter() - start)
+        preempt_t0 = self._preempt_started.pop(unit.group_key, None)
+        if preempt_t0 is not None:
+            m.PREEMPTION_LATENCY.observe(time.perf_counter() - preempt_t0)
         m.PODS_SCHEDULED.inc(amount=len(plan.placements), result="ok")
         await self._set_group_phase(group, t.PODGROUP_SCHEDULED,
                                     f"on slice {plan.slice_id}",

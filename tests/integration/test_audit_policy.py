@@ -152,7 +152,7 @@ async def test_webhook_batches_under_load():
             metadata=ObjectMeta(name=f"cm-{i}", namespace="default"),
             data={"i": str(i)})) for i in range(n)))
         for _ in range(100):
-            if sum(len(b) for b in rx.batches) >= n + 1:
+            if sum(len(b) for b in rx.batches) >= n:
                 break
             await asyncio.sleep(0.1)
     finally:
