@@ -65,5 +65,23 @@ async def amain(argv=None) -> int:
     return 0
 
 
+def main() -> int:
+    """cProfile seam (KTPU_PROFILE=<stats path>): the decode-share
+    measurement (perf/decode_share.py) profiles this process across a
+    density run and attributes CPU to codec vs everything else."""
+    import os
+    profile_path = os.environ.get("KTPU_PROFILE", "")
+    if not profile_path:
+        return asyncio.run(amain())
+    import cProfile
+    prof = cProfile.Profile()
+    prof.enable()
+    try:
+        return asyncio.run(amain())
+    finally:
+        prof.disable()
+        prof.dump_stats(profile_path)
+
+
 if __name__ == "__main__":
-    sys.exit(asyncio.run(amain()))
+    sys.exit(main())

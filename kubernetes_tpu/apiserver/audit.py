@@ -185,6 +185,7 @@ class AuditWebhookBackend:
         payload = {"kind": "EventList", "items": batch}
         backoff = self.initial_backoff
         err = ""
+        delivered_ok = False
         try:
             for attempt in range(self.retries):
                 try:
@@ -193,6 +194,7 @@ class AuditWebhookBackend:
                             timeout=aiohttp.ClientTimeout(total=10)) as r:
                         if r.status < 400:
                             self.delivered += len(batch)
+                            delivered_ok = True
                             return
                         err = f"HTTP {r.status}"
                 except asyncio.CancelledError:
@@ -205,8 +207,11 @@ class AuditWebhookBackend:
         except asyncio.CancelledError:
             # Shutdown-drain timeout cancelled us mid-batch: the honest
             # loss counter includes the batch in hand, not just what
-            # stop() finds left in the buffer.
-            self.dropped += len(batch)
+            # stop() finds left in the buffer — UNLESS the 2xx already
+            # landed and the cancel merely hit the context exit
+            # (counting it dropped too would over-report loss).
+            if not delivered_ok:
+                self.dropped += len(batch)
             raise
         self.dropped += len(batch)
         log.warning("audit webhook: dropped a batch of %d after %d "

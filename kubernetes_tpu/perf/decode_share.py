@@ -1,0 +1,109 @@
+"""Decode-share measurement — what fraction of control-plane CPU goes
+to JSON wire codec work?
+
+VERDICT r4 #8: the reference negotiates protobuf on the watch/list hot
+path (``apimachinery/pkg/runtime/serializer/protobuf/protobuf.go``)
+because JSON decode dominates control-plane CPU at density scale. This
+harness produces the NUMBER that decision needs here: it runs the
+three-process REST density arm with cProfile on both the apiserver
+subprocess (KTPU_PROFILE seam in ``apiserver/__main__.py``) and the
+scheduler (this process), then attributes exclusive CPU time to codec
+frames — the ``json`` module (C scanner + Python fallbacks) and the
+scheme's ``to_dict``/``from_dict``/``decode``/``encode`` — versus
+everything else.
+
+Run: ``python -m kubernetes_tpu.perf.decode_share [nodes] [pods]``.
+"""
+from __future__ import annotations
+
+import asyncio
+import cProfile
+import json
+import os
+import pstats
+import tempfile
+
+#: A frame is "codec" when its file or function matches these — the
+#: full wire path: raw JSON scan/emit + dataclass hydration.
+_CODEC_FILES = ("json/decoder.py", "json/encoder.py", "json/__init__.py",
+                "json/scanner.py", "api/scheme.py")
+_CODEC_FUNCS = ("loads", "dumps", "to_dict", "from_dict", "decode",
+                "encode", "__decode", "raw_decode", "iterencode",
+                "scanstring", "_from_dict", "_to_dict")
+
+
+def codec_share(stats_path: str) -> dict:
+    """{total_s, codec_s, share} from a cProfile stats dump, by
+    EXCLUSIVE (tottime) attribution so frames are counted once."""
+    st = pstats.Stats(stats_path)
+    total = 0.0
+    codec = 0.0
+    rows = []
+    for (fname, _line, func), (cc, nc, tt, ct, callers) in \
+            st.stats.items():  # noqa: B007
+        total += tt
+        # Attribution is FILE-scoped (json stdlib, api/scheme.py) plus
+        # the C-extension json frames; a bare function-name match
+        # would swallow unrelated to_dict/encode/decode frames (aiohttp
+        # charset codecs, errors.to_dict) and inflate the share a
+        # go/no-go threshold sits on.
+        is_codec = (any(fname.endswith(f) for f in _CODEC_FILES)
+                    or (fname == "~" and "_json" in func))
+        if is_codec:
+            codec += tt
+            rows.append((tt, f"{os.path.basename(fname)}:{func}"))
+    rows.sort(reverse=True)
+    return {
+        "total_cpu_s": round(total, 3),
+        "codec_cpu_s": round(codec, 3),
+        "share": round(codec / total, 4) if total else 0.0,
+        "top_codec_frames": [f"{name} {tt:.2f}s" for tt, name in rows[:6]],
+    }
+
+
+async def run_decode_share(n_nodes: int = 200, n_pods: int = 6000,
+                           timeout: float = 600.0) -> dict:
+    from .density import run_density
+    tmp = tempfile.mkdtemp(prefix="ktpu-decode-")
+    api_stats = os.path.join(tmp, "apiserver.pstats")
+    sched_stats = os.path.join(tmp, "scheduler.pstats")
+    os.environ["KTPU_PROFILE"] = api_stats  # inherited by the subprocess
+    prof = cProfile.Profile()
+    prof.enable()
+    try:
+        density = await run_density(n_nodes=n_nodes, n_pods=n_pods,
+                                    via="rest", timeout=timeout,
+                                    create_concurrency=16)
+    finally:
+        prof.disable()
+        os.environ.pop("KTPU_PROFILE", None)
+        prof.dump_stats(sched_stats)
+    # The apiserver dumps its stats at SIGTERM (density's cleanup).
+    for _ in range(50):
+        if os.path.exists(api_stats):
+            break
+        await asyncio.sleep(0.1)
+    out = {
+        "nodes": n_nodes,
+        "pods": n_pods,
+        "pods_per_second": density.get("pods_per_second"),
+        "scheduler": codec_share(sched_stats),
+        "threshold": 0.20,
+    }
+    if os.path.exists(api_stats):
+        out["apiserver"] = codec_share(api_stats)
+        worst = max(out["apiserver"]["share"], out["scheduler"]["share"])
+    else:
+        out["apiserver"] = {"error": "no stats dump (apiserver killed "
+                                     "before SIGTERM handling?)"}
+        worst = out["scheduler"]["share"]
+    out["max_share"] = round(worst, 4)
+    out["binary_codec_warranted"] = worst > 0.20
+    return out
+
+
+if __name__ == "__main__":
+    import sys
+    nodes = int(sys.argv[1]) if len(sys.argv) > 1 else 200
+    pods = int(sys.argv[2]) if len(sys.argv) > 2 else 6000
+    print(json.dumps(asyncio.run(run_decode_share(nodes, pods))))

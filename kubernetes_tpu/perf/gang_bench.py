@@ -262,7 +262,14 @@ async def run_gang_bench(n_slices: int = 8, n_gangs: Optional[int] = None,
             if _bench_prefix(pod) not in ("pre", "mid"):
                 continue
             if ev_type == "DELETED":
-                preempt_bound.discard(pod.key())
+                if pod.key() in preempt_bound:
+                    # A mid gang CAN be a high gang's victim (the
+                    # tiers overlap); keep the per-gang count honest
+                    # so a rebind re-stamps its bound time.
+                    preempt_bound.discard(pod.key())
+                    g = pod.spec.gang
+                    gang_members_bound[g] = gang_members_bound.get(g, 1) - 1
+                    gang_bound_at.pop(g, None)
             elif ev_type in ("ADDED", "MODIFIED") and pod.spec.node_name:
                 if pod.key() not in preempt_bound:
                     preempt_bound.add(pod.key())

@@ -156,28 +156,31 @@ def _rope(x, cfg: LMConfig):
 
 
 def _flash_attention(q, k, v):
-    """Causal flash attention via the public pallas TPU kernel
-    (jax.experimental.pallas.ops.tpu.flash_attention) — O(T) memory,
-    fused softmax, the single-device fast path. Off-TPU the reference
-    kernel substitutes (pallas kernels need a TPU backend); ON TPU,
-    kernel errors surface loudly — silently degrading to the O(T^2)
-    path would misreport which kernel a benchmark ran.
+    """Causal flash attention on TPU, kernel chosen by length:
 
-    Block sizes are pinned to 1024 (clamped to T): the kernel's
-    defaults left >2x on the table on v5e (small k-blocks under-fill
-    the MXU pipeline on the bwd dq/dkv passes), and the r4 sweep moved
-    the sweet spot from 512 to 1024 — measured at B1/H16/T8192/D128:
-    512-blocks 0.490 MFU, 1024-blocks 0.506; 2048 fails to compile
-    (VMEM)."""
+    - T >= 2048: the SPLASH kernel
+      (pallas.ops.tpu.splash_attention) with 2048-wide q blocks,
+      1024 kv blocks, and the fused dq/dkv backward. Measured on v5e
+      at B1/H16/T8192/D128 fwd+bwd: old flash@1024 29.0ms; splash
+      q1024/kv1024 25.9ms; splash q2048/kv1024 fused **18.0ms**
+      (q4096 and kv2048 fail VMEM compile; kv512 regresses to 29ms).
+    - shorter T: the classic flash kernel with 1024 blocks (the r4
+      sweep's winner there; splash's wide-q advantage needs enough
+      q blocks per head to pipeline).
+
+    Off-TPU the reference O(T^2) attention substitutes (pallas needs a
+    TPU backend); ON TPU, kernel errors surface loudly — silently
+    degrading would misreport which kernel a benchmark ran."""
     if jax.devices()[0].platform != "tpu":
         from .ring_attention import reference_attention
         return reference_attention(q, k, v).astype(q.dtype)
+    t = q.shape[2]
+    if t >= 2048 and t % 1024 == 0:
+        return _splash_attention(q, k, v)
     from jax.experimental.pallas.ops.tpu.flash_attention import (
         BlockSizes, flash_attention as _pallas_flash)
-    t = q.shape[2]
     # Largest divisor of T up to 1024, preferring lane-aligned
-    # (multiple-of-128) blocks; 1024 is the measured sweet spot — see
-    # docstring. Trace-time-only scan, so O(min(T,1024)) is free.
+    # (multiple-of-128) blocks. Trace-time-only scan: O(min(T,1024)).
     divisors = [d for d in range(1, min(1024, t) + 1) if t % d == 0]
     aligned = [d for d in divisors if d % 128 == 0]
     b = max(aligned) if aligned else max(divisors)
@@ -188,6 +191,40 @@ def _flash_attention(q, k, v):
     return _pallas_flash(q, k, v, causal=True,
                          sm_scale=1.0 / (q.shape[-1] ** 0.5),
                          block_sizes=bs)
+
+
+def _splash_attention(q, k, v):
+    """Causal splash attention, blocks tuned on the v5e train step
+    (600M model, r5 sweep; full numbers in the commit):
+
+    - ``block_q`` 2048 at batch 1, else 1024: the fused-bwd residuals
+      live in scoped VMEM and scale with batch x block_q — B1/T8k at
+      2048 is the 18.0ms sweet spot (vs 29.0ms for the old flash
+      kernel), B2+/bq2048 overflows the 16M scoped limit.
+    - ``block_kv_compute`` 512 under a 1024 kv I/O block: the fwd
+      compute sub-block overlaps the next kv fetch — measured
+      t8k 0.5495 -> 0.5576 MFU; also +0.3/+0.1 pts at t4k/t2k.
+      Halving the DKV compute block the same way was NEGATIVE
+      (t8k 0.5489), as was shrinking dkv I/O blocks to 512 (0.541).
+
+    The kernel takes per-head [T, D] inputs pre-scaled by sm_scale;
+    vmap carries the batch dim."""
+    from jax.experimental.pallas.ops.tpu.splash_attention import (
+        splash_attention_kernel as sk, splash_attention_mask as sm)
+    b, h, t = q.shape[0], q.shape[1], q.shape[2]
+    # block_q must divide T (kernel grid = T // block_q, asserted by
+    # the mask-info builder) — T=3072 etc. takes the 1024 block.
+    bq = min(2048 if b <= 1 and t % 2048 == 0 else 1024, t)
+    bkv = min(1024, t)
+    mask = sm.MultiHeadMask([sm.CausalMask((t, t)) for _ in range(h)])
+    bs = sk.BlockSizes(block_q=bq, block_kv=bkv,
+                       block_kv_compute=min(512, bkv),
+                       block_q_dkv=bq, block_kv_dkv=bkv,
+                       block_kv_dkv_compute=bkv,
+                       use_fused_bwd_kernel=True)
+    kernel = sk.make_splash_mha_single_device(mask, block_sizes=bs)
+    scale = q.shape[-1] ** -0.5
+    return jax.vmap(kernel)(q * scale, k, v)
 
 
 def hidden_states(params: dict, tokens, cfg: LMConfig, mesh) -> jax.Array:
