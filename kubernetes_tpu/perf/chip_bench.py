@@ -93,6 +93,7 @@ CASES = [
     # O(T^2) score tensors dominate; narrower weights don't pay).
     _case("lm-600m-t2k", 4, 2048, dtype="float32"),
     _case("lm-600m-t512-flash", 16, 512, "flash"),
+    _case("lm-600m-t1k-flash", 8, 1024, "flash"),
     _case("lm-600m-t2k-flash", 4, 2048, "flash"),
     _case("lm-600m-t4k-flash", 2, 4096, "flash"),
     _case("lm-600m-t8k-flash", 1, 8192, "flash"),

@@ -158,15 +158,16 @@ def _rope(x, cfg: LMConfig):
 def _flash_attention(q, k, v):
     """Causal flash attention on TPU, kernel chosen by length:
 
-    - T >= 2048: the SPLASH kernel
-      (pallas.ops.tpu.splash_attention) with 2048-wide q blocks,
-      1024 kv blocks, and the fused dq/dkv backward. Measured on v5e
-      at B1/H16/T8192/D128 fwd+bwd: old flash@1024 29.0ms; splash
-      q1024/kv1024 25.9ms; splash q2048/kv1024 fused **18.0ms**
-      (q4096 and kv2048 fail VMEM compile; kv512 regresses to 29ms).
-    - shorter T: the classic flash kernel with 1024 blocks (the r4
-      sweep's winner there; splash's wide-q advantage needs enough
-      q blocks per head to pipeline).
+    - T a multiple of 1024, or exactly 512 (the MEASURED shapes): the
+      SPLASH kernel (pallas.ops.tpu.splash_attention) — see
+      :func:`_splash_attention` for the tuned blocks. Measured on the
+      v5e train step it beats the classic flash kernel at EVERY such
+      length, not just long context: t512 0.564 -> 0.589, t1k (flash)
+      0.549 -> 0.578, t8k 0.513 -> 0.557; raw fwd+bwd attention at
+      B1/H16/T8192/D128: flash@1024 29.0ms vs splash 18.0ms.
+    - other T (incl. odd multiples of 512 like 1536, which would force
+      splash onto the kv512 config the r4 sweep measured REGRESSING):
+      the classic flash kernel with divisor blocks.
 
     Off-TPU the reference O(T^2) attention substitutes (pallas needs a
     TPU backend); ON TPU, kernel errors surface loudly — silently
@@ -175,7 +176,7 @@ def _flash_attention(q, k, v):
         from .ring_attention import reference_attention
         return reference_attention(q, k, v).astype(q.dtype)
     t = q.shape[2]
-    if t >= 2048 and t % 1024 == 0:
+    if t % 1024 == 0 or t == 512:
         return _splash_attention(q, k, v)
     from jax.experimental.pallas.ops.tpu.flash_attention import (
         BlockSizes, flash_attention as _pallas_flash)
@@ -212,10 +213,16 @@ def _splash_attention(q, k, v):
     from jax.experimental.pallas.ops.tpu.splash_attention import (
         splash_attention_kernel as sk, splash_attention_mask as sm)
     b, h, t = q.shape[0], q.shape[1], q.shape[2]
-    # block_q must divide T (kernel grid = T // block_q, asserted by
-    # the mask-info builder) — T=3072 etc. takes the 1024 block.
-    bq = min(2048 if b <= 1 and t % 2048 == 0 else 1024, t)
-    bkv = min(1024, t)
+    # Every block must divide T (kernel grid = T // block, asserted by
+    # the mask-info builder) — T=3072 etc. takes the 1024 q block,
+    # T=512 clamps everything to 512.
+    if b <= 1 and t % 2048 == 0:
+        bq = 2048
+    elif t % 1024 == 0:
+        bq = 1024
+    else:
+        bq = 512  # t == 512 (the dispatch gate admits nothing else)
+    bkv = 1024 if t % 1024 == 0 else 512
     mask = sm.MultiHeadMask([sm.CausalMask((t, t)) for _ in range(h)])
     bs = sk.BlockSizes(block_q=bq, block_kv=bkv,
                        block_kv_compute=min(512, bkv),
