@@ -517,6 +517,13 @@ class APIServer:
         # (kubelet --authentication-token-webhook).
         r.add_post("/apis/authentication/v1/tokenreviews",
                    self._token_review)
+        # Access reviews (reference: authorization.k8s.io/v1,
+        # ``kubectl auth can-i``): virtual create-only resources that
+        # evaluate the live authorizer instead of persisting anything.
+        r.add_post("/apis/authorization/v1/selfsubjectaccessreviews",
+                   self._access_review)
+        r.add_post("/apis/authorization/v1/subjectaccessreviews",
+                   self._access_review)
         r.add_post("/bootstrap/v1/node-credentials", self._node_credentials)
         # TLS bootstrap (kubeadm discovery + kubelet TLS bootstrap):
         # the CA cert is public (joiners verify it against a sha256
@@ -564,6 +571,71 @@ class APIServer:
         return web.json_response({"kind": "TokenReview",
                                   "api_version": "authentication/v1",
                                   "status": status})
+
+    async def _access_review(self, request):
+        """POST Self/SubjectAccessReview -> status {allowed, reason}.
+
+        Reference: ``staging/src/k8s.io/apiserver/plugin/pkg/
+        authorizer`` + the authorization.k8s.io/v1 virtual resources.
+        Self-review answers for the CALLER (post-impersonation, so
+        ``--as`` composes); subject-review answers for a spec-named
+        identity and is gated on the caller holding ``create`` on
+        ``subjectaccessreviews`` — otherwise any authenticated user
+        could map out everyone else's permissions."""
+        self_review = request.path.endswith("selfsubjectaccessreviews")
+        kind = ("SelfSubjectAccessReview" if self_review
+                else "SubjectAccessReview")
+        try:
+            body = await request.json()
+            spec = body.get("spec") or {}
+            ra = spec.get("resource_attributes") or {}
+        except Exception:  # noqa: BLE001
+            return self._err(errors.InvalidError(
+                'body must be {"spec": {"resource_attributes": ...}}'))
+        verb = str(ra.get("verb") or "")
+        resource = str(ra.get("resource") or "")
+        if not verb or not resource:
+            return self._err(errors.InvalidError(
+                "spec.resource_attributes needs verb and resource"))
+        caller = request.get("user", Attributes.ANONYMOUS)
+        # Mirror _attributes exactly — a review must answer what a real
+        # request would get. Impersonated identities carry ONLY the
+        # requested groups (the target's configured user_groups must
+        # not leak in).
+        if request.get("impersonated_by"):
+            caller_groups = set(request.get("cert_groups", set()))
+        else:
+            caller_groups = (self._groups_for(caller)
+                             | request.get("cert_groups", set()))
+        if self_review:
+            subject, subj_groups = caller, caller_groups
+        else:
+            gate = Attributes(caller, caller_groups, "create",
+                              "subjectaccessreviews")
+            if self.authorizer is not None \
+                    and not self.authorizer.authorize(gate):
+                return self._err(errors.ForbiddenError(
+                    f"forbidden: {gate}"))
+            subject = str(spec.get("user") or "")
+            if not subject:
+                return self._err(errors.InvalidError(
+                    "SubjectAccessReview spec.user is required"))
+            # The subject's real requests get configured+implied groups
+            # from _groups_for; spec.groups adds to that (the reference
+            # SAR likewise unions authenticator-attached groups).
+            subj_groups = (self._groups_for(subject)
+                           | set(spec.get("groups") or []))
+        attrs = Attributes(subject, subj_groups, verb, resource,
+                           str(ra.get("namespace") or ""),
+                           str(ra.get("name") or ""))
+        allowed = (self.authorizer is None
+                   or self.authorizer.authorize(attrs))
+        status = {"allowed": allowed}
+        if not allowed:
+            status["reason"] = f"no RBAC rule grants {attrs}"
+        return web.json_response({"kind": kind,
+                                  "api_version": "authorization/v1",
+                                  "status": status}, status=201)
 
     async def _node_credentials(self, request):
         """POST {"node_name": ...} -> {"token", "user", "node_name"}.

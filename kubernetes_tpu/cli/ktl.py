@@ -619,6 +619,116 @@ async def cmd_annotate(args) -> int:
     return await _metadata_edit(args, "annotations")
 
 
+async def cmd_auth_can_i(args) -> int:
+    """``ktl auth can-i VERB RESOURCE [NAME]`` (reference:
+    ``pkg/kubectl/cmd/auth/cani.go``) — SelfSubjectAccessReview, so
+    ``--as``/``--as-group`` answer for the impersonated identity.
+    Exit 0 = yes, 1 = no (scriptable, like kubectl)."""
+    client = make_client(args)
+    try:
+        plural = resolve_plural(args.resource)
+        allowed, reason = await client.access_review(
+            args.verb, plural, namespace=args.namespace,
+            name=args.name)
+        print("yes" if allowed else "no")
+        if not allowed and reason and not args.quiet:
+            print(reason, file=sys.stderr)
+        return 0 if allowed else 1
+    finally:
+        await client.close()
+
+
+def _condition_met(obj, want_type: str, want_status: str) -> bool:
+    conds = getattr(getattr(obj, "status", None), "conditions", None) or []
+    return any(c.type == want_type and c.status == want_status
+               for c in conds)
+
+
+async def cmd_wait(args) -> int:
+    """``ktl wait RESOURCE NAME --for condition=Type[=Status] | delete``
+    (reference: ``pkg/kubectl/cmd/wait``). Watch-driven: takes the
+    list's resourceVersion, then blocks on the watch stream instead of
+    polling."""
+    import time
+    client = make_client(args)
+    try:
+        plural = resolve_plural(args.resource)
+        target = args.wait_for
+        if target == "delete":
+            want_type = want_status = ""
+        elif target.startswith("condition="):
+            rest = target[len("condition="):]
+            want_type, _, want_status = rest.partition("=")
+            want_status = want_status or "True"
+        else:
+            print("error: --for must be condition=Type[=Status] or "
+                  "delete", file=sys.stderr)
+            return 1
+        deadline = time.monotonic() + args.timeout
+
+        def satisfied(obj) -> bool:
+            return _condition_met(obj, want_type, want_status)
+
+        async def check_current() -> tuple[Optional[int], int]:
+            """(exit code or None, list RV) from a fresh list — the
+            startup check and every CLOSED-reconnect use the same
+            logic, and the RV pins the watch so no transition can slip
+            between the list and the stream."""
+            items, rev, _ = await client.list_page(plural, args.namespace)
+            current = {o.metadata.name: o for o in items}
+            if target == "delete" and args.name not in current:
+                print(f"{plural}/{args.name} deleted")
+                return 0, rev
+            if target != "delete" and args.name in current \
+                    and satisfied(current[args.name]):
+                print(f"{plural}/{args.name} condition met")
+                return 0, rev
+            return None, rev
+
+        # Initial state first — the condition may already hold (or the
+        # object may already be gone).
+        done, rev = await check_current()
+        if done is not None:
+            return done
+        w = await client.watch(plural, args.namespace, resource_version=rev)
+        try:
+            while True:
+                remain = deadline - time.monotonic()
+                if remain <= 0:
+                    print(f"error: timed out waiting for {target} on "
+                          f"{plural}/{args.name}", file=sys.stderr)
+                    return 1
+                ev = await w.next(timeout=min(remain, 5.0))
+                if ev is None:
+                    continue
+                etype, obj = ev
+                if etype == "CLOSED":
+                    # Stream ended (apiserver restart / compaction):
+                    # reconnect from a fresh list rather than failing.
+                    done, rev = await check_current()
+                    if done is not None:
+                        return done
+                    w.cancel()
+                    w = await client.watch(plural, args.namespace,
+                                           resource_version=rev)
+                    continue
+                if etype not in ("ADDED", "MODIFIED", "DELETED"):
+                    continue
+                if obj.metadata.name != args.name:
+                    continue
+                if target == "delete":
+                    if etype == "DELETED":
+                        print(f"{plural}/{args.name} deleted")
+                        return 0
+                elif etype != "DELETED" and satisfied(obj):
+                    print(f"{plural}/{args.name} condition met")
+                    return 0
+        finally:
+            w.cancel()
+    finally:
+        await client.close()
+
+
 async def cmd_cordon(args) -> int:
     return await _set_unschedulable(args, True, "cordoned")
 
@@ -1321,6 +1431,26 @@ def build_parser() -> argparse.ArgumentParser:
         sp.add_argument("--overwrite", action="store_true", default=False,
                         help="allow replacing existing values")
         sp.add_argument("-n", "--namespace", default="default")
+
+    sp = add("auth", cmd_auth_can_i,
+             help="check API access (auth can-i VERB RESOURCE [NAME])")
+    sp.add_argument("subverb", choices=["can-i"],
+                    help="only can-i is supported")
+    sp.add_argument("verb")
+    sp.add_argument("resource")
+    sp.add_argument("name", nargs="?", default="")
+    sp.add_argument("-n", "--namespace", default="default")
+    sp.add_argument("-q", "--quiet", action="store_true", default=False,
+                    help="suppress the denial reason on stderr")
+
+    sp = add("wait", cmd_wait,
+             help="block until a condition holds or an object is gone")
+    sp.add_argument("resource")
+    sp.add_argument("name")
+    sp.add_argument("--for", dest="wait_for", required=True,
+                    help="condition=Type[=Status] or delete")
+    sp.add_argument("--timeout", type=float, default=60.0)
+    sp.add_argument("-n", "--namespace", default="default")
 
     for name, fn in (("cordon", cmd_cordon), ("uncordon", cmd_uncordon)):
         sp = add(name, fn, help=f"{name} a node")

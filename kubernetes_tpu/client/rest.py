@@ -173,6 +173,30 @@ class RESTClient(Client):
         user = status.get("user") or {}
         return user.get("username", ""), set(user.get("groups") or ())
 
+    async def access_review(self, verb: str, resource: str,
+                            namespace: str = "", name: str = "",
+                            user: str = "",
+                            groups: tuple = ()) -> tuple[bool, str]:
+        """authorization/v1 access review -> (allowed, reason).
+
+        Without ``user``: SelfSubjectAccessReview — "can *I* do this?"
+        (``kubectl auth can-i``). With ``user``: SubjectAccessReview —
+        asks about someone else; needs ``create subjectaccessreviews``.
+        """
+        which = ("subjectaccessreviews" if user
+                 else "selfsubjectaccessreviews")
+        spec: dict = {"resource_attributes": {
+            "verb": verb, "resource": resource,
+            "namespace": namespace, "name": name}}
+        if user:
+            spec["user"] = user
+            spec["groups"] = list(groups)
+        url = f"{self.base_url}/apis/authorization/v1/{which}"
+        async with self._sess().post(url, json={"spec": spec}) as resp:
+            body = await self._check(resp)
+        status = body.get("status") or {}
+        return bool(status.get("allowed")), status.get("reason", "")
+
     @property
     def ssl_context(self):
         """The client TLS context (CA trust + identity cert), or None.
