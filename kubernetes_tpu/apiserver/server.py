@@ -407,17 +407,21 @@ class APIServer:
         verb = verb_for_request(request.method, bool(name),
                                 request.query.get("watch") in ("1", "true"))
         user = request.get("user", "system:anonymous")
-        if request.get("impersonated_by"):
-            # Impersonated identities carry EXACTLY the requested
-            # groups (set by _impersonate) — configured user_groups of
-            # the target must not leak in (see _impersonate).
-            groups = set(request.get("cert_groups", set()))
-        else:
-            groups = self._groups_for(user) | request.get("cert_groups",
-                                                          set())
+        groups = self._request_groups(request, user)
         resource = f"{plural}/{sub}" if sub else plural
         return Attributes(user, groups, verb, resource,
                           request.match_info.get("namespace", ""), name)
+
+    def _request_groups(self, request, user: str) -> set[str]:
+        """The authorization groups a request's identity carries — the
+        ONE place this is computed (both _attributes and the access
+        reviews must agree, or can-i answers diverge from real
+        requests). Impersonated identities carry EXACTLY the requested
+        groups (set by _impersonate) — configured user_groups of the
+        target must not leak in."""
+        if request.get("impersonated_by"):
+            return set(request.get("cert_groups", set()))
+        return self._groups_for(user) | request.get("cert_groups", set())
 
     def _groups_for(self, user: str) -> set[str]:
         """Configured + username-implied groups (reference: the
@@ -589,24 +593,25 @@ class APIServer:
             body = await request.json()
             spec = body.get("spec") or {}
             ra = spec.get("resource_attributes") or {}
+            if not isinstance(spec, dict) or not isinstance(ra, dict):
+                raise TypeError
+            verb = str(ra.get("verb") or "")
+            resource = str(ra.get("resource") or "")
+            raw_groups = spec.get("groups") or []
+            if not isinstance(raw_groups, (list, tuple)):
+                raise TypeError
+            spec_groups = {str(g) for g in raw_groups}
         except Exception:  # noqa: BLE001
             return self._err(errors.InvalidError(
-                'body must be {"spec": {"resource_attributes": ...}}'))
-        verb = str(ra.get("verb") or "")
-        resource = str(ra.get("resource") or "")
+                'body must be {"spec": {"resource_attributes": '
+                '{"verb", "resource", ...}, "groups": [...]}}'))
         if not verb or not resource:
             return self._err(errors.InvalidError(
                 "spec.resource_attributes needs verb and resource"))
         caller = request.get("user", Attributes.ANONYMOUS)
-        # Mirror _attributes exactly — a review must answer what a real
-        # request would get. Impersonated identities carry ONLY the
-        # requested groups (the target's configured user_groups must
-        # not leak in).
-        if request.get("impersonated_by"):
-            caller_groups = set(request.get("cert_groups", set()))
-        else:
-            caller_groups = (self._groups_for(caller)
-                             | request.get("cert_groups", set()))
+        # Same group derivation as _attributes — a review must answer
+        # what a real request would get.
+        caller_groups = self._request_groups(request, caller)
         if self_review:
             subject, subj_groups = caller, caller_groups
         else:
@@ -623,8 +628,7 @@ class APIServer:
             # The subject's real requests get configured+implied groups
             # from _groups_for; spec.groups adds to that (the reference
             # SAR likewise unions authenticator-attached groups).
-            subj_groups = (self._groups_for(subject)
-                           | set(spec.get("groups") or []))
+            subj_groups = self._groups_for(subject) | spec_groups
         attrs = Attributes(subject, subj_groups, verb, resource,
                            str(ra.get("namespace") or ""),
                            str(ra.get("name") or ""))

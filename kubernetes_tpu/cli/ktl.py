@@ -720,7 +720,13 @@ async def cmd_wait(args) -> int:
                     if etype == "DELETED":
                         print(f"{plural}/{args.name} deleted")
                         return 0
-                elif etype != "DELETED" and satisfied(obj):
+                elif etype == "DELETED":
+                    # kubectl wait errors out immediately here — the
+                    # condition can never come true on a gone object.
+                    print(f"error: {plural}/{args.name} was deleted "
+                          f"while waiting for {target}", file=sys.stderr)
+                    return 1
+                elif satisfied(obj):
                     print(f"{plural}/{args.name} condition met")
                     return 0
         finally:

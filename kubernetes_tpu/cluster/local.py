@@ -241,7 +241,15 @@ class LocalCluster:
         # The userspace proxy stays on either way — it carries traffic
         # wherever the kernel path can't.
         self.iptables_syncer = None
-        if GATES.enabled("IptablesProxier"):
+        self.ipvs_syncer = None
+        if GATES.enabled("IpvsProxier"):
+            # IPVS mode wins when both gates are on (it subsumes the
+            # iptables mode's job and the two fight over KUBE-SERVICES).
+            from ..net.ipvs import IpvsSyncer
+            self.ipvs_syncer = IpvsSyncer(
+                local, cluster_cidr=self.registry.cluster_cidr)
+            await self.ipvs_syncer.start()
+        elif GATES.enabled("IptablesProxier"):
             from ..net.iptables import IptablesSyncer
             self.iptables_syncer = IptablesSyncer(
                 local, cluster_cidr=self.registry.cluster_cidr)
@@ -353,6 +361,8 @@ class LocalCluster:
         self.nodes = []
         if getattr(self, "iptables_syncer", None) is not None:
             await self.iptables_syncer.stop()
+        if getattr(self, "ipvs_syncer", None) is not None:
+            await self.ipvs_syncer.stop()
         if self.dns is not None:
             await self.dns.stop()
         if self.controller_manager:

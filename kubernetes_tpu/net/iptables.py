@@ -482,15 +482,19 @@ def jump_rule_specs(hostports: bool = False) -> list[tuple[str, str, list[str]]]
     ]
 
 
-def ensure_jump_rules(hostports: bool = False) -> bool:
+def ensure_jump_rules(hostports: bool = False,
+                      specs: list | None = None) -> bool:
     """Idempotently install the built-in-chain jumps (``-C`` probe,
     ``-I`` on miss). Root-gated like apply_rules. Call AFTER the first
-    apply_rules — the jumps target chains the restore creates."""
+    apply_rules — the jumps target chains the restore creates.
+    ``specs`` overrides the default spec list (the ipvs mode's ruleset
+    creates a different chain set, so it supplies its own)."""
     if not can_apply():
         return False
     import subprocess
     ok = True
-    for table, chain, args in jump_rule_specs(hostports):
+    for table, chain, args in (specs if specs is not None
+                               else jump_rule_specs(hostports)):
         try:
             probe = subprocess.run(
                 ["iptables", "-t", table, "-C", chain, *args],

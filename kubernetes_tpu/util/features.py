@@ -54,6 +54,11 @@ KNOWN_FEATURES = {f.name: f for f in [
             "kernel NAT service dataplane: render + iptables-restore "
             "rulesets from Services/Endpoints (needs root; userspace "
             "proxy stays on as fallback)"),
+    Feature("IpvsProxier", False, ALPHA,
+            "IPVS kernel dataplane: virtual servers per service port, "
+            "incremental ipvsadm deltas + ipset-driven static iptables "
+            "(needs root+ipvsadm; userspace proxy stays on as "
+            "fallback; mutually exclusive with IptablesProxier)"),
     Feature("NativeSubmeshFastPath", True, BETA,
             "C++ sub-mesh search fast path (falls back to numpy)"),
     Feature("AuditLogging", True, BETA,

@@ -98,6 +98,19 @@ async def test_wait_for_condition(monkeypatch):
             ["wait", "pod", "w1", "--for", "condition=Gone",
              "--timeout", "0.5"], base)
         assert rc == 1
+        # Deletion mid-wait fails FAST (kubectl semantics), not at
+        # the timeout.
+        import time
+        async def reap():
+            await asyncio.sleep(0.3)
+            reg.delete("pods", "default", "w1", grace_period_seconds=0)
+        task = asyncio.get_running_loop().create_task(reap())
+        begin = time.monotonic()
+        rc, _ = await ktl_out(
+            ["wait", "pod", "w1", "--for", "condition=Gone",
+             "--timeout", "60"], base)
+        await task
+        assert rc == 1 and time.monotonic() - begin < 30
     finally:
         await server.stop()
 
