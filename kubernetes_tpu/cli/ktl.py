@@ -955,6 +955,7 @@ async def cmd_up(args) -> int:
         authorization_mode=cfg.authorization_mode,
         audit_log=cfg.audit_log, audit_policy=cfg.audit_policy,
         audit_webhook=cfg.audit_webhook,
+        scheduler_policy=cfg.scheduler_policy,
         tls=not getattr(args, "insecure", False))
     base = await cluster.start()
     os.makedirs(os.path.dirname(DEFAULT_CONFIG), exist_ok=True)
@@ -1567,6 +1568,9 @@ def build_parser() -> argparse.ArgumentParser:
                          "resources/namespaces)")
     sp.add_argument("--audit-webhook", default=S,
                     help="POST batched audit events to this URL")
+    sp.add_argument("--scheduler-policy", default=S,
+                    help="scheduler Policy file (YAML/JSON) selecting "
+                         "predicates, priority weights, and extenders")
 
     return p
 

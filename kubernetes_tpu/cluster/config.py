@@ -38,6 +38,7 @@ class ClusterConfig:
     audit_log: str = ""
     audit_policy: str = ""
     audit_webhook: str = ""
+    scheduler_policy: str = ""
     nodes: list = dataclasses.field(default_factory=list)
 
 
@@ -81,7 +82,7 @@ def config_from_args(args) -> ClusterConfig:
     cfg = load_cluster_config(path) if path else ClusterConfig()
     for name in ("host", "port", "data_dir", "durable", "feature_gates",
                  "authorization_mode", "audit_log", "audit_policy",
-                 "audit_webhook"):
+                 "audit_webhook", "scheduler_policy"):
         if hasattr(args, name):
             setattr(cfg, name, getattr(args, name))
     node_flags = any(hasattr(args, k)

@@ -111,6 +111,7 @@ class LocalCluster:
                  audit_log: str = "",
                  audit_policy: str = "",
                  audit_webhook: str = "",
+                 scheduler_policy: str = "",
                  tls: bool = True):
         """``tls=True`` (default): the apiserver serves HTTPS only from
         a cluster CA minted under ``<data_dir>/pki`` — plaintext
@@ -129,6 +130,9 @@ class LocalCluster:
         self.audit_log = audit_log
         self.audit_policy = audit_policy
         self.audit_webhook = audit_webhook
+        #: Scheduler Policy file (scheduler/policy.py; reference
+        #: kube-scheduler --policy-config-file).
+        self.scheduler_policy = scheduler_policy
         self.tls = tls
         self.ca = None
         self.ca_file = ""
@@ -210,7 +214,11 @@ class LocalCluster:
         scheme = "https" if self.tls else "http"
         self.base_url = f"{scheme}://{self.host}:{port}"
 
-        self.scheduler = Scheduler(local)
+        sched_policy = None
+        if self.scheduler_policy:
+            from ..scheduler.policy import load_policy
+            sched_policy = load_policy(self.scheduler_policy)
+        self.scheduler = Scheduler(local, policy=sched_policy)
         await self.scheduler.start()
         scrape_ssl = None
         if self.ca is not None:
@@ -246,8 +254,13 @@ class LocalCluster:
             # IPVS mode wins when both gates are on (it subsumes the
             # iptables mode's job and the two fight over KUBE-SERVICES).
             from ..net.ipvs import IpvsSyncer
+            # NodePort virtual servers need concrete node addresses
+            # (IPVS has no --dst-type LOCAL analog; the reference binds
+            # node IPs to kube-ipvs0). Every node of a local cluster is
+            # this host.
             self.ipvs_syncer = IpvsSyncer(
-                local, cluster_cidr=self.registry.cluster_cidr)
+                local, cluster_cidr=self.registry.cluster_cidr,
+                node_ips=(self.host,))
             await self.ipvs_syncer.start()
         elif GATES.enabled("IptablesProxier"):
             from ..net.iptables import IptablesSyncer

@@ -205,26 +205,35 @@ def parse_ipvsadm_save(text: str) -> list[VirtualServer]:
 def render_ipsets(state: IpvsState) -> str:
     """``ipset restore`` input for the three reference sets. The
     static iptables ruleset matches against these sets, which is what
-    keeps the iptables side O(1) in services."""
-    lines = [
-        f"create {SET_CLUSTER_IP} hash:ip,port -exist",
-        f"flush {SET_CLUSTER_IP}",
-        f"create {SET_LOOP_BACK} hash:ip,port,ip -exist",
-        f"flush {SET_LOOP_BACK}",
-        f"create {SET_NODE_PORT_TCP} bitmap:port range 0-65535 -exist",
-        f"flush {SET_NODE_PORT_TCP}",
-        f"create {SET_NODE_PORT_UDP} bitmap:port range 0-65535 -exist",
-        f"flush {SET_NODE_PORT_UDP}",
-    ]
-    for ip, proto, port in state.cluster_ip_entries:
-        lines.append(f"add {SET_CLUSTER_IP} {ip},{proto}:{port} -exist")
-    for ip, proto, port in state.loopback_entries:
+    keeps the iptables side O(1) in services.
+
+    Build-and-swap, not flush-in-place: each set's entries are loaded
+    into a same-typed ``<name>-tmp`` set and atomically ``swap``ped in,
+    so no packet ever races a half-populated set (a flush-then-add
+    window would drop the hairpin SNAT mark mid-sync; the reference
+    avoids the window by syncing per-entry deltas)."""
+    specs = [
+        (SET_CLUSTER_IP, "hash:ip,port",
+         [f"{ip},{proto}:{port}"
+          for ip, proto, port in state.cluster_ip_entries]),
         # src ip == real-server ip and dst == itself: hairpin, must SNAT.
-        lines.append(f"add {SET_LOOP_BACK} {ip},{proto}:{port},{ip} -exist")
-    for port in state.node_ports.get("tcp", ()):
-        lines.append(f"add {SET_NODE_PORT_TCP} {port} -exist")
-    for port in state.node_ports.get("udp", ()):
-        lines.append(f"add {SET_NODE_PORT_UDP} {port} -exist")
+        (SET_LOOP_BACK, "hash:ip,port,ip",
+         [f"{ip},{proto}:{port},{ip}"
+          for ip, proto, port in state.loopback_entries]),
+        (SET_NODE_PORT_TCP, "bitmap:port range 0-65535",
+         [str(p) for p in state.node_ports.get("tcp", ())]),
+        (SET_NODE_PORT_UDP, "bitmap:port range 0-65535",
+         [str(p) for p in state.node_ports.get("udp", ())]),
+    ]
+    lines = []
+    for name, settype, entries in specs:
+        tmp = f"{name}-tmp"
+        lines.append(f"create {name} {settype} -exist")
+        lines.append(f"create {tmp} {settype} -exist")
+        lines.append(f"flush {tmp}")
+        lines.extend(f"add {tmp} {e} -exist" for e in entries)
+        lines.append(f"swap {tmp} {name}")
+        lines.append(f"destroy {tmp}")
     return "\n".join(lines) + "\n"
 
 

@@ -23,9 +23,10 @@ from typing import Optional
 
 from ..api import types as t
 from .cache import SchedulerCache, SliceInfo
-from .predicates import (_chip_matches, node_is_schedulable,
-                         pod_fits_resources, pod_matches_node_selector,
-                         pod_tolerates_taints)
+from .predicates import (PRED_NODE_CONDITION, PRED_NODE_SELECTOR,
+                         PRED_RESOURCES, PRED_TAINTS, _chip_matches,
+                         node_is_schedulable, pod_fits_resources,
+                         pod_matches_node_selector, pod_tolerates_taints)
 from .submesh import allocate_compact, find_box, find_box_containing
 
 
@@ -45,13 +46,19 @@ def _pod_chip_demand(pod: t.Pod) -> int:
     return t.pod_tpu_chip_count(pod)
 
 
-def _non_tpu_predicates(pod: t.Pod, info) -> Optional[str]:
+def _non_tpu_predicates(pod: t.Pod, info, enabled=None) -> Optional[str]:
+    """``enabled``: policy-selected predicate set (policy.py canonical
+    keys; None = all) — gangs honor the same policy as single pods."""
     node = info.node
     if node is None:
         return "node unknown"
-    for check in (node_is_schedulable(node), pod_tolerates_taints(pod, node),
-                  pod_matches_node_selector(pod, node),
-                  pod_fits_resources(pod, info)):
+    on = enabled.__contains__ if enabled is not None else lambda _k: True
+    for check in (
+            node_is_schedulable(node) if on(PRED_NODE_CONDITION) else None,
+            pod_tolerates_taints(pod, node) if on(PRED_TAINTS) else None,
+            pod_matches_node_selector(pod, node)
+            if on(PRED_NODE_SELECTOR) else None,
+            pod_fits_resources(pod, info) if on(PRED_RESOURCES) else None):
         if check:
             return check
     return None
@@ -59,7 +66,8 @@ def _non_tpu_predicates(pod: t.Pod, info) -> Optional[str]:
 
 def plan_gang(group: t.PodGroup, pods: list[t.Pod],
               cache: SchedulerCache,
-              must_include: Optional[dict] = None) -> GangPlan | GangFailure:
+              must_include: Optional[dict] = None,
+              enabled=None) -> GangPlan | GangFailure:
     """``must_include``: coords -> (node, chip_id) already held by bound
     gang members (partial-bind recovery). A shaped gang must then find a
     full-shape box *containing* those coords, so the recovered gang is
@@ -75,7 +83,7 @@ def plan_gang(group: t.PodGroup, pods: list[t.Pod],
         return GangFailure(["no TPU slices known to the scheduler"])
     if not tpu_pods:
         # Pure-CPU gang: just need co-existing feasible nodes.
-        plan = _plan_aux(aux_pods, cache, {}, [])
+        plan = _plan_aux(aux_pods, cache, {}, [], enabled=enabled)
         if isinstance(plan, GangFailure):
             return plan
         return GangPlan(placements=plan)
@@ -107,7 +115,7 @@ def plan_gang(group: t.PodGroup, pods: list[t.Pod],
                            f"gang needs {total_chips}")
             continue
         result = _plan_on_slice(group, tpu_pods, aux_pods, sl, free, cache,
-                                must_include or {})
+                                must_include or {}, enabled=enabled)
         if isinstance(result, GangPlan):
             result.slice_id = sl.slice_id
             return result
@@ -117,8 +125,8 @@ def plan_gang(group: t.PodGroup, pods: list[t.Pod],
 
 def _plan_on_slice(group: t.PodGroup, tpu_pods: list[t.Pod], aux_pods: list[t.Pod],
                    sl: SliceInfo, free: dict, cache: SchedulerCache,
-                   must_include: Optional[dict] = None
-                   ) -> GangPlan | GangFailure:
+                   must_include: Optional[dict] = None,
+                   enabled=None) -> GangPlan | GangFailure:
     must_include = must_include or {}
     total_chips = sum(_pod_chip_demand(p) for p in tpu_pods)
     # Claim affinity: when every claim in the gang wants the same thing
@@ -178,7 +186,7 @@ def _plan_on_slice(group: t.PodGroup, tpu_pods: list[t.Pod], aux_pods: list[t.Po
             info = cache.nodes.get(node_name)
             if info is None:
                 continue
-            err = _non_tpu_predicates(pod, _with_planned(info, placements, node_name))
+            err = _non_tpu_predicates(pod, _with_planned(info, placements, node_name), enabled)
             if err:
                 continue
             chosen_node = node_name
@@ -195,7 +203,8 @@ def _plan_on_slice(group: t.PodGroup, tpu_pods: list[t.Pod], aux_pods: list[t.Po
                 f"pod {pod.metadata.name}: chip attributes do not satisfy claim affinity"])
         placements.append((pod, chosen_node, bindings))
 
-    aux = _plan_aux(aux_pods, cache, {n: True for n in per_node}, placements)
+    aux = _plan_aux(aux_pods, cache, {n: True for n in per_node}, placements,
+                    enabled=enabled)
     if isinstance(aux, GangFailure):
         return aux
     placements.extend(aux)
@@ -258,7 +267,8 @@ def _carve_bindings(pod: t.Pod, node_name: str, taken: list,
 
 
 def _plan_aux(aux_pods: list[t.Pod], cache: SchedulerCache,
-              prefer_nodes: dict, placements: list) -> list | GangFailure:
+              prefer_nodes: dict, placements: list,
+              enabled=None) -> list | GangFailure:
     """Place chipless gang members (coordinators, loggers): any feasible
     node, preferring the gang's slice hosts for locality. ``placements``
     carries the TPU members already planned so cpu/mem accounting sees
@@ -273,7 +283,8 @@ def _plan_aux(aux_pods: list[t.Pod], cache: SchedulerCache,
             info = cache.nodes.get(node_name)
             if info is None or info.node is None:
                 continue
-            if _non_tpu_predicates(pod, _with_planned(info, placements, node_name)) is None:
+            if _non_tpu_predicates(pod, _with_planned(info, placements, node_name),
+                                   enabled) is None:
                 chosen = node_name
                 break
         if chosen is None:

@@ -1,0 +1,189 @@
+"""Scheduler policy file — operator-selected predicates/priorities/extenders.
+
+Reference: ``plugin/pkg/scheduler/api/types.go`` (Policy,
+PredicatePolicy, PriorityPolicy, ExtenderConfig) loaded by
+``factory.go CreateFromConfig``: a JSON/YAML document that names which
+fit predicates run, which priorities score (with weights), and which
+out-of-process extenders participate. The TPU chip fit/selection phase
+is NOT policy-selectable — like the reference's extended-resources
+assigner (``core/extended_resources.go``, invoked unconditionally after
+predicates in ``core/generic_scheduler.go``), it is structural: the
+binding needs concrete chip IDs, so there is no meaningful scheduler
+without it.
+
+File shape (both snake_case and the reference's camelCase accepted)::
+
+    kind: Policy
+    predicates:
+      - name: PodFitsResources
+      - name: PodToleratesNodeTaints
+    priorities:
+      - name: LeastRequestedPriority
+        weight: 1
+    extenders:
+      - urlPrefix: http://127.0.0.1:9998/scheduler
+        filterVerb: filter
+        prioritizeVerb: prioritize
+        weight: 2
+        managedResources: [example.com/widget]
+        ignorable: true
+
+Omitting ``predicates``/``priorities`` entirely keeps the defaults;
+an empty list means "none of them" (reference semantics: the policy is
+the complete list).
+"""
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .extender import SchedulerExtender
+from .predicates import (PRED_INTERPOD_AFFINITY, PRED_NODE_CONDITION,
+                         PRED_NODE_PRESSURE, PRED_NODE_SELECTOR,
+                         PRED_RESOURCES, PRED_TAINTS)
+from .priorities import (DEFAULT_PRIORITIES, PRI_BALANCED,
+                         PRI_INTERPOD_AFFINITY, PRI_LEAST_REQUESTED,
+                         PRI_NODE_AFFINITY, PRI_RESOURCE_LIMITS,
+                         PRI_SELECTOR_SPREAD, PRI_TPU_DEFRAG,
+                         TPU_DEFRAG_WEIGHT)
+
+#: Canonical predicate key -> accepted policy-file spellings.
+#: Canonical keys are what predicates.run_predicates gates on.
+PREDICATE_ALIASES: dict[str, tuple[str, ...]] = {
+    PRED_NODE_CONDITION: (PRED_NODE_CONDITION, "NodeSchedulable"),
+    PRED_NODE_PRESSURE: (PRED_NODE_PRESSURE, "CheckNodeMemoryPressure",
+                         "CheckNodeDiskPressure"),
+    PRED_TAINTS: (PRED_TAINTS,),
+    PRED_NODE_SELECTOR: (PRED_NODE_SELECTOR, "PodMatchNodeSelector"),
+    PRED_RESOURCES: (PRED_RESOURCES,),
+    PRED_INTERPOD_AFFINITY: (PRED_INTERPOD_AFFINITY,),
+}
+
+#: Canonical priority key -> accepted spellings (reference names end in
+#: "Priority"; the short forms are this repo's DEFAULT_PRIORITIES keys).
+PRIORITY_ALIASES: dict[str, tuple[str, ...]] = {
+    PRI_LEAST_REQUESTED: (PRI_LEAST_REQUESTED, "LeastRequestedPriority"),
+    PRI_BALANCED: (PRI_BALANCED, "BalancedResourceAllocation"),
+    PRI_NODE_AFFINITY: (PRI_NODE_AFFINITY, "NodeAffinityPriority",
+                        "NodePreferAvoidPodsPriority"),
+    PRI_RESOURCE_LIMITS: (PRI_RESOURCE_LIMITS, "ResourceLimitsPriority"),
+    PRI_SELECTOR_SPREAD: (PRI_SELECTOR_SPREAD, "SelectorSpreadPriority"),
+    PRI_TPU_DEFRAG: (PRI_TPU_DEFRAG, "TpuDefragPriority"),
+    PRI_INTERPOD_AFFINITY: (PRI_INTERPOD_AFFINITY,
+                            "InterPodAffinityPriority"),
+}
+
+_PREDICATE_BY_SPELLING = {s: canon for canon, spells in
+                          PREDICATE_ALIASES.items() for s in spells}
+_PRIORITY_BY_SPELLING = {s: canon for canon, spells in
+                         PRIORITY_ALIASES.items() for s in spells}
+
+#: Default weights: DEFAULT_PRIORITIES + the fused-loop extras.
+DEFAULT_WEIGHTS: dict[str, float] = {
+    **{name: w for name, _fn, w in DEFAULT_PRIORITIES},
+    PRI_SELECTOR_SPREAD: 1.0,
+    PRI_TPU_DEFRAG: TPU_DEFRAG_WEIGHT,
+    PRI_INTERPOD_AFFINITY: 1.0,
+}
+
+
+@dataclass
+class SchedulerPolicy:
+    #: None = default set; otherwise the canonical predicate keys to run.
+    enabled_predicates: Optional[frozenset] = None
+    #: None = DEFAULT_WEIGHTS; otherwise canonical name -> weight, with
+    #: unlisted priorities at weight 0 (the policy is the whole list).
+    priority_weights: Optional[dict] = None
+    extenders: list = field(default_factory=list)
+
+    def weight(self, name: str) -> float:
+        if self.priority_weights is None:
+            return DEFAULT_WEIGHTS[name]
+        return self.priority_weights.get(name, 0.0)
+
+    def predicate_enabled(self, name: str) -> bool:
+        return (self.enabled_predicates is None
+                or name in self.enabled_predicates)
+
+
+def _get(d: dict, *names, default=None):
+    for n in names:
+        if n in d:
+            return d[n]
+    return default
+
+
+def parse_policy(raw: dict, source: str = "<policy>") -> SchedulerPolicy:
+    if not isinstance(raw, dict):
+        raise ValueError(f"{source}: policy document must be a mapping")
+    if raw.get("kind", "Policy") != "Policy":
+        raise ValueError(f"{source}: kind must be Policy")
+    pol = SchedulerPolicy()
+    preds = raw.get("predicates")
+    if preds is not None:
+        enabled = set()
+        for i, p in enumerate(preds):
+            name = p.get("name") if isinstance(p, dict) else p
+            canon = _PREDICATE_BY_SPELLING.get(name or "")
+            if canon is None:
+                raise ValueError(
+                    f"{source}: predicates[{i}]: unknown predicate "
+                    f"{name!r} (known: {sorted(_PREDICATE_BY_SPELLING)})")
+            enabled.add(canon)
+        pol.enabled_predicates = frozenset(enabled)
+    prios = raw.get("priorities")
+    if prios is not None:
+        weights: dict[str, float] = {}
+        for i, p in enumerate(prios):
+            if not isinstance(p, dict):
+                p = {"name": p}
+            name = p.get("name")
+            canon = _PRIORITY_BY_SPELLING.get(name or "")
+            if canon is None:
+                raise ValueError(
+                    f"{source}: priorities[{i}]: unknown priority "
+                    f"{name!r} (known: {sorted(_PRIORITY_BY_SPELLING)})")
+            try:
+                w = float(p.get("weight", 1.0))
+            except (TypeError, ValueError):
+                raise ValueError(f"{source}: priorities[{i}]: weight "
+                                 f"must be a number") from None
+            if w < 0:
+                raise ValueError(
+                    f"{source}: priorities[{i}]: negative weight")
+            weights[canon] = weights.get(canon, 0.0) + w
+        pol.priority_weights = weights
+    for i, e in enumerate(raw.get("extenders") or []):
+        if not isinstance(e, dict):
+            raise ValueError(f"{source}: extenders[{i}] must be a mapping")
+        url = _get(e, "url_prefix", "urlPrefix")
+        if not url:
+            raise ValueError(f"{source}: extenders[{i}]: urlPrefix required")
+        pol.extenders.append(SchedulerExtender(
+            url_prefix=url,
+            filter_verb=_get(e, "filter_verb", "filterVerb",
+                             default="filter"),
+            prioritize_verb=_get(e, "prioritize_verb", "prioritizeVerb",
+                                 default="prioritize"),
+            weight=float(_get(e, "weight", default=1.0)),
+            managed_resources=tuple(
+                _get(e, "managed_resources", "managedResources",
+                     default=()) or ()),
+            timeout=float(_get(e, "timeout", "httpTimeout", default=5.0)),
+            ignorable=bool(_get(e, "ignorable", default=False)),
+        ))
+    return pol
+
+
+def load_policy(path: str) -> SchedulerPolicy:
+    """Load a Policy file. ``.json`` parses as JSON, anything else as
+    YAML (reference kube-scheduler's --policy-config-file accepts both)."""
+    with open(path) as f:
+        text = f.read()
+    if path.endswith(".json"):
+        raw = json.loads(text)
+    else:
+        import yaml
+        raw = yaml.safe_load(text) or {}
+    return parse_policy(raw, source=path)

@@ -18,6 +18,17 @@ from .cache import NodeInfo
 from .submesh import allocate_compact, find_box
 
 
+#: Canonical policy-file keys for each predicate (policy.py maps the
+#: reference spellings onto these; every gate site imports these names
+#: so a typo is an ImportError, not a silently-skipped predicate).
+PRED_NODE_CONDITION = "CheckNodeCondition"
+PRED_NODE_PRESSURE = "CheckNodePressure"
+PRED_TAINTS = "PodToleratesNodeTaints"
+PRED_NODE_SELECTOR = "MatchNodeSelector"
+PRED_RESOURCES = "PodFitsResources"
+PRED_INTERPOD_AFFINITY = "MatchInterPodAffinity"
+
+
 @dataclass
 class PredicateResult:
     fits: bool
@@ -173,20 +184,27 @@ def select_chips(pod: t.Pod, info: NodeInfo) -> Optional[list[t.TpuBinding]]:
 #: predicates ordering).
 def run_predicates(pod: t.Pod, info: NodeInfo,
                    skip_tpu: bool = False,
-                   requests=None) -> PredicateResult:
+                   requests=None,
+                   enabled=None) -> PredicateResult:
     """``skip_tpu=True`` lets the caller run :func:`select_chips` itself
     (one geometry computation serving fit, score, and selection).
     ``requests``: precomputed pod_resource_requests, computed once per
-    pod by the scheduler instead of once per (pod, node)."""
+    pod by the scheduler instead of once per (pod, node).
+    ``enabled``: policy-selected predicate set (policy.py canonical
+    keys); None runs everything. The TPU phase is structural and not
+    gated (see policy.py module docstring)."""
     node = info.node
     if node is None:
         return PredicateResult(False, ["node unknown"])
+    on = enabled.__contains__ if enabled is not None else lambda _k: True
     checks = [
-        node_is_schedulable(node),
-        node_pressure_allows(pod, node),
-        pod_tolerates_taints(pod, node),
-        pod_matches_node_selector(pod, node),
-        pod_fits_resources(pod, info, requests),
+        node_is_schedulable(node) if on(PRED_NODE_CONDITION) else None,
+        node_pressure_allows(pod, node) if on(PRED_NODE_PRESSURE) else None,
+        pod_tolerates_taints(pod, node) if on(PRED_TAINTS) else None,
+        pod_matches_node_selector(pod, node)
+        if on(PRED_NODE_SELECTOR) else None,
+        pod_fits_resources(pod, info, requests)
+        if on(PRED_RESOURCES) else None,
     ]
     if not skip_tpu:
         checks.append(pod_fits_tpus(pod, info))

@@ -133,21 +133,34 @@ def resource_limits(pod: t.Pod, info: NodeInfo, want=None) -> float:
     return MAX_SCORE
 
 
+#: Canonical policy-file keys (see predicates.py note on why these are
+#: shared constants, not inline literals).
+PRI_LEAST_REQUESTED = "LeastRequested"
+PRI_BALANCED = "BalancedAllocation"
+PRI_NODE_AFFINITY = "NodeAffinity"
+PRI_RESOURCE_LIMITS = "ResourceLimits"
+PRI_SELECTOR_SPREAD = "SelectorSpread"
+PRI_TPU_DEFRAG = "TpuDefrag"
+PRI_INTERPOD_AFFINITY = "InterPodAffinity"
+
 #: (name, fn(pod, info) -> 0..10, weight)
 DEFAULT_PRIORITIES = [
-    ("LeastRequested", least_requested, 1.0),
-    ("BalancedAllocation", balanced_allocation, 1.0),
-    ("NodeAffinity", node_affinity_preferred, 2.0),
-    ("ResourceLimits", resource_limits, 1.0),
+    (PRI_LEAST_REQUESTED, least_requested, 1.0),
+    (PRI_BALANCED, balanced_allocation, 1.0),
+    (PRI_NODE_AFFINITY, node_affinity_preferred, 2.0),
+    (PRI_RESOURCE_LIMITS, resource_limits, 1.0),
 ]
 TPU_DEFRAG_WEIGHT = 2.0
 
 
 def prioritize(pod: t.Pod, infos: list[NodeInfo],
                sibling_counts: dict[str, int] | None = None,
-               chip_choices: dict[str, list[str]] | None = None) -> dict[str, float]:
+               chip_choices: dict[str, list[str]] | None = None,
+               weights: dict[str, float] | None = None) -> dict[str, float]:
     """``chip_choices``: node name -> chip ids already selected for this
     pod (from select_chips), so the defrag score reuses the geometry.
+    ``weights``: policy-file priority weights (policy.py canonical
+    names; unlisted = 0); None keeps the defaults below.
 
     One fused pass per node producing EXACTLY the sum the individual
     priority functions above give (they remain the documented,
@@ -157,6 +170,20 @@ def prioritize(pod: t.Pod, infos: list[NodeInfo],
     pod-level facts per (pod, node), which starved the async bind
     pipeline and showed up as bind_call p99 in BENCH rest_30k."""
     scores: dict[str, float] = {}
+    # Per-priority weights hoisted once (the default path multiplies by
+    # the same constants the pre-weights code had inlined).
+    if weights is None:
+        w_lr = w_ba = w_lim = w_spread = 1.0
+        w_aff = 2.0
+        w_defrag = TPU_DEFRAG_WEIGHT
+    else:
+        g = weights.get
+        w_lr = g(PRI_LEAST_REQUESTED, 0.0)
+        w_ba = g(PRI_BALANCED, 0.0)
+        w_aff = g(PRI_NODE_AFFINITY, 0.0)
+        w_lim = g(PRI_RESOURCE_LIMITS, 0.0)
+        w_spread = g(PRI_SELECTOR_SPREAD, 0.0)
+        w_defrag = g(PRI_TPU_DEFRAG, 0.0)
     # Pod-level facts hoisted out of the per-node loop.
     want = t.pod_resource_requests(pod)
     want_cpu = want.get(t.RESOURCE_CPU, 0.0)
@@ -195,32 +222,34 @@ def prioritize(pod: t.Pod, infos: list[NodeInfo],
             frac_mem = (req_mem + want_mem) / cap_mem
             free_sum += max(0.0, 1.0 - frac_mem)
             n_res += 1
-        total = (free_sum / n_res * MAX_SCORE) if n_res else half
+        total = w_lr * ((free_sum / n_res * MAX_SCORE) if n_res else half)
         if frac_cpu is not None and frac_mem is not None:
-            total += (1.0 - abs(min(1.0, frac_cpu)
-                                - min(1.0, frac_mem))) * MAX_SCORE
+            total += w_ba * (1.0 - abs(min(1.0, frac_cpu)
+                                       - min(1.0, frac_mem))) * MAX_SCORE
         else:
-            total += half
-        if preferred:  # NodeAffinity, weight 2
+            total += w_ba * half
+        if preferred and w_aff:  # NodeAffinity, default weight 2
             labels = node.metadata.labels
             hits = sum(1 for term in preferred if term.matches(labels))
-            total += 2.0 * MAX_SCORE * hits / len(preferred)
-        if limits:  # ResourceLimits, weight 1 (0 when no limits)
+            total += w_aff * MAX_SCORE * hits / len(preferred)
+        if limits and w_lim:  # ResourceLimits (0 when no limits)
             fits = not ((lim_cpu and cap_cpu - req_cpu < lim_cpu)
                         or (lim_mem and cap_mem - req_mem < lim_mem))
-            total += MAX_SCORE if fits else 0.0
-        if chips:
-            total += TPU_DEFRAG_WEIGHT * tpu_defrag_score(
+            total += w_lim * (MAX_SCORE if fits else 0.0)
+        if not w_defrag:
+            pass
+        elif chips:
+            total += w_defrag * tpu_defrag_score(
                 pod, info, (chip_choices or {}).get(name))
         else:
-            total += TPU_DEFRAG_WEIGHT * half
-        if sibling_counts is not None:
+            total += w_defrag * half
+        if sibling_counts is not None and w_spread:
             if not sibling_counts:
-                total += half
+                total += w_spread * half
             elif worst_sib == 0:
-                total += MAX_SCORE
+                total += w_spread * MAX_SCORE
             else:
-                total += MAX_SCORE * (worst_sib
-                                      - sibling_counts.get(name, 0)) / worst_sib
+                total += w_spread * MAX_SCORE * (
+                    worst_sib - sibling_counts.get(name, 0)) / worst_sib
         scores[name] = total
     return scores

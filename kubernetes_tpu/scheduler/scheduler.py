@@ -38,9 +38,18 @@ log = logging.getLogger("scheduler")
 
 class Scheduler:
     def __init__(self, client: Client, name: str = "default-scheduler",
-                 backoff_seconds: float = 1.0):
+                 backoff_seconds: float = 1.0, policy=None):
         self.client = client
         self.name = name
+        #: Policy file selection of predicates/priorities/extenders
+        #: (policy.py; reference factory.go CreateFromConfig). Fixed for
+        #: the scheduler's lifetime — the equivalence cache's verdicts
+        #: assume the predicate set never changes mid-run.
+        self.policy = policy
+        self._enabled_predicates = (policy.enabled_predicates
+                                    if policy is not None else None)
+        self._priority_weights = (policy.priority_weights
+                                  if policy is not None else None)
         self.cache = SchedulerCache()
         self.queue = SchedulingQueue()
         self.recorder = EventRecorder(client, component=name)
@@ -52,7 +61,7 @@ class Scheduler:
         #: Out-of-process filter/prioritize webhooks (extender.py;
         #: reference core/extender.go). Consulted after built-in
         #: predicates/priorities for pods they manage.
-        self.extenders: list = []
+        self.extenders: list = list(policy.extenders) if policy else []
         self._bind_sem = asyncio.Semaphore(64)
         #: gang key -> perf_counter at preemption decision; observed
         #: into PREEMPTION_LATENCY when the gang's plan finally binds.
@@ -300,7 +309,17 @@ class Scheduler:
         # equivalence-cached predicates — its verdict depends on other
         # pods, not node accounting.
         from .podaffinity import build_context
-        affinity_ctx = build_context(pod, self.cache)
+        # Policy can disable the required check (predicate) and the
+        # soft score (priority) independently; the context is built if
+        # either is active.
+        from .predicates import PRED_INTERPOD_AFFINITY
+        from .priorities import PRI_INTERPOD_AFFINITY
+        aff_pred_on = (self.policy is None or
+                       self.policy.predicate_enabled(PRED_INTERPOD_AFFINITY))
+        aff_weight = (1.0 if self.policy is None
+                      else self.policy.weight(PRI_INTERPOD_AFFINITY))
+        affinity_ctx = (build_context(pod, self.cache)
+                        if aff_pred_on or aff_weight > 0 else None)
         my_prio = t.pod_priority(pod)
         my_key = pod.key()
         any_reservations = self.cache.has_reservations()
@@ -327,14 +346,15 @@ class Scheduler:
                 fits, cached_reasons = cached
             else:
                 res = run_predicates(pod, info, skip_tpu=True,
-                                     requests=requests)
+                                     requests=requests,
+                                     enabled=self._enabled_predicates)
                 fits, cached_reasons = res.fits, res.reasons
                 if eq is not None and not reserved:
                     self.cache.equiv.store(name, eq, fits, cached_reasons)
             if not fits:
                 reasons.append(f"{name}: {'; '.join(cached_reasons)}")
                 continue
-            if affinity_ctx is not None:
+            if affinity_ctx is not None and aff_pred_on:
                 why = affinity_ctx.node_allows(info.node)
                 if why is not None:
                     reasons.append(f"{name}: {why}")
@@ -354,8 +374,10 @@ class Scheduler:
         if not feasible:
             return None, None, reasons
         sibling_counts = self._sibling_counts(pod)
-        scores = prioritize(pod, feasible, sibling_counts, chip_choices)
-        if affinity_ctx is not None and affinity_ctx.preferred:
+        scores = prioritize(pod, feasible, sibling_counts, chip_choices,
+                            weights=self._priority_weights)
+        if (affinity_ctx is not None and affinity_ctx.preferred
+                and aff_weight > 0):
             # Normalize to the same 0..MAX_SCORE band as the other
             # priorities (interpod_affinity.go normalizes before
             # weighting) — a weight-100 soft preference must not swamp
@@ -366,7 +388,7 @@ class Scheduler:
             if peak > 0:
                 from .priorities import MAX_SCORE
                 for name, v in raw.items():
-                    scores[name] += MAX_SCORE * v / peak
+                    scores[name] += aff_weight * MAX_SCORE * v / peak
         if return_candidates:
             return scores, bindings_by_node, reasons
         best = max(scores, key=lambda n: (scores[n], n))
@@ -527,7 +549,8 @@ class Scheduler:
         for v in lower:
             sim.remove_pod(v)
             victims.append(v)
-            if run_predicates(pod, sim).fits:
+            if run_predicates(pod, sim,
+                              enabled=self._enabled_predicates).fits:
                 return victims
         return None
 
@@ -776,7 +799,8 @@ class Scheduler:
                 await self.queue.requeue(GangUnit(unit.group_key, pods),
                                         self.backoff_seconds)
                 return
-        plan = plan_gang(group, pods, self.cache, must_include=must_include)
+        plan = plan_gang(group, pods, self.cache, must_include=must_include,
+                         enabled=self._enabled_predicates)
         m.ALGORITHM_LATENCY.observe(time.perf_counter() - start)
         if isinstance(plan, GangFailure):
             brief = "; ".join(plan.reasons[:3])

@@ -213,8 +213,6 @@ def test_jump_rule_specs_cover_static_chains():
 def test_static_iptables_is_service_count_independent():
     """The whole point of ipvs mode: iptables rules don't grow with
     services (everything service-shaped lives in the ipsets)."""
-    assert (ipvs.render_iptables(cluster_cidr="10.0.0.0/8")
-            == ipvs.render_iptables(cluster_cidr="10.0.0.0/8"))
     rules = ipvs.render_iptables(cluster_cidr="10.0.0.0/8")
     assert "KUBE-LOOP-BACK" in rules and "KUBE-CLUSTER-IP" in rules
     assert rules.count("-A KUBE-SERVICES") == 4  # fixed, not per-svc
@@ -236,6 +234,11 @@ async def test_syncer_computes_on_churn():
     client = RESTClient(f"http://127.0.0.1:{port}")
     syncer = ipvs.IpvsSyncer(client, cluster_cidr="10.200.0.0/16",
                              min_sync_interval=0.05)
+    # Never program the test host's kernel, even when the suite runs
+    # as root with ipvsadm/ipset installed — this test asserts the
+    # computed artifacts and applied=False.
+    real_can_apply = ipvs.can_apply
+    ipvs.can_apply = lambda: False
     try:
         await syncer.start()
         await client.create(svc("web", "10.96.0.10",
@@ -254,6 +257,7 @@ async def test_syncer_computes_on_churn():
         assert syncer.applied is False
         assert syncer.last_state.dummy_addresses == ["10.96.0.10"]
     finally:
+        ipvs.can_apply = real_can_apply
         await syncer.stop()
         await client.close()
         await server.stop()

@@ -1,0 +1,188 @@
+"""Scheduler policy file — predicate selection, priority weights,
+extender construction (reference plugin/pkg/scheduler/api + factory.go
+CreateFromConfig)."""
+import json
+
+import pytest
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.scheduler import priorities as P
+from kubernetes_tpu.scheduler.cache import SchedulerCache
+from kubernetes_tpu.scheduler.policy import (
+    DEFAULT_WEIGHTS, load_policy, parse_policy)
+from kubernetes_tpu.scheduler.predicates import run_predicates
+
+
+def _node(name, taints=(), cpu=8.0):
+    n = t.Node(metadata=ObjectMeta(name=name))
+    n.status.capacity = {"cpu": cpu, "memory": 2 ** 34, "pods": 110}
+    n.status.allocatable = dict(n.status.capacity)
+    n.status.conditions = [t.NodeCondition(type=t.NODE_READY, status="True")]
+    n.spec.taints = list(taints)
+    return n
+
+
+def _pod(cpu="1"):
+    return t.Pod(metadata=ObjectMeta(name="p", namespace="default"),
+                 spec=t.PodSpec(containers=[t.Container(
+                     name="c", image="i",
+                     resources=t.ResourceRequirements(
+                         requests={"cpu": cpu}))]))
+
+
+def _info(node):
+    cache = SchedulerCache()
+    cache.set_node(node)
+    return cache.nodes[node.metadata.name]
+
+
+class TestParse:
+    def test_reference_spellings_accepted(self):
+        pol = parse_policy({
+            "kind": "Policy",
+            "predicates": [{"name": "PodFitsResources"},
+                           {"name": "PodMatchNodeSelector"}],
+            "priorities": [{"name": "LeastRequestedPriority", "weight": 3}],
+        })
+        assert pol.enabled_predicates == frozenset(
+            {"PodFitsResources", "MatchNodeSelector"})
+        assert pol.priority_weights == {"LeastRequested": 3.0}
+        # Unlisted priorities drop to 0 (the policy is the whole list).
+        assert pol.weight("BalancedAllocation") == 0.0
+
+    def test_unknown_names_rejected(self):
+        with pytest.raises(ValueError, match="unknown predicate"):
+            parse_policy({"predicates": [{"name": "NoSuchPredicate"}]})
+        with pytest.raises(ValueError, match="unknown priority"):
+            parse_policy({"priorities": [{"name": "NoSuchPriority"}]})
+        with pytest.raises(ValueError, match="negative"):
+            parse_policy({"priorities": [
+                {"name": "LeastRequested", "weight": -1}]})
+
+    def test_omitted_sections_keep_defaults(self):
+        pol = parse_policy({"kind": "Policy"})
+        assert pol.enabled_predicates is None
+        assert pol.priority_weights is None
+        assert pol.weight("NodeAffinity") == DEFAULT_WEIGHTS["NodeAffinity"]
+        assert pol.predicate_enabled("PodToleratesNodeTaints")
+
+    def test_extenders_built(self):
+        pol = parse_policy({"extenders": [{
+            "urlPrefix": "http://127.0.0.1:9998/sched",
+            "filterVerb": "f", "prioritizeVerb": "p", "weight": 2,
+            "managedResources": ["example.com/widget"],
+            "ignorable": True}]})
+        (ext,) = pol.extenders
+        assert ext.url_prefix == "http://127.0.0.1:9998/sched"
+        assert ext.filter_verb == "f"
+        assert ext.weight == 2.0
+        assert ext.managed_resources == ("example.com/widget",)
+        assert ext.ignorable
+
+    def test_load_json_and_yaml(self, tmp_path):
+        doc = {"kind": "Policy",
+               "predicates": [{"name": "PodFitsResources"}]}
+        jp = tmp_path / "policy.json"
+        jp.write_text(json.dumps(doc))
+        assert load_policy(str(jp)).enabled_predicates == frozenset(
+            {"PodFitsResources"})
+        yp = tmp_path / "policy.yaml"
+        yp.write_text("kind: Policy\npredicates:\n- name: PodFitsResources\n")
+        assert load_policy(str(yp)).enabled_predicates == frozenset(
+            {"PodFitsResources"})
+        with pytest.raises(ValueError, match="kind"):
+            parse_policy({"kind": "NotAPolicy"})
+
+
+class TestPredicateGating:
+    def test_disabled_taint_predicate_admits_tainted_node(self):
+        node = _node("n1", taints=[t.Taint(key="k", value="v",
+                                           effect=t.TAINT_NO_SCHEDULE)])
+        info = _info(node)
+        pod = _pod()
+        assert not run_predicates(pod, info).fits
+        enabled = frozenset({"PodFitsResources", "CheckNodeCondition"})
+        assert run_predicates(pod, info, enabled=enabled).fits
+
+    def test_disabled_resources_predicate_overcommits(self):
+        info = _info(_node("n1", cpu=1.0))
+        pod = _pod(cpu="64")
+        assert not run_predicates(pod, info).fits
+        assert run_predicates(
+            pod, info,
+            enabled=frozenset({"CheckNodeCondition"})).fits
+
+
+class TestPriorityWeights:
+    def test_default_weights_equal_legacy_path(self):
+        infos = [_info(_node(f"n{i}", cpu=4.0 + i)) for i in range(4)]
+        pod = _pod()
+        legacy = P.prioritize(pod, infos, {}, None)
+        explicit = P.prioritize(pod, infos, {}, None,
+                                weights=dict(DEFAULT_WEIGHTS))
+        assert legacy == explicit
+
+    def test_zero_weight_silences_a_priority(self):
+        # Two nodes: n-big has more free cpu (LeastRequested prefers it).
+        big, small = _info(_node("n-big", cpu=64.0)), _info(_node("n-small"))
+        pod = _pod()
+        default = P.prioritize(pod, [big, small], None, None)
+        assert default["n-big"] > default["n-small"]
+        flat = P.prioritize(pod, [big, small], None, None,
+                            weights={"BalancedAllocation": 1.0})
+        # With LeastRequested off, the remaining balanced-allocation
+        # score no longer separates by free cpu the same way.
+        assert flat["n-big"] != default["n-big"]
+
+    def test_weight_scales_component(self):
+        info = _info(_node("n1"))
+        pod = _pod()
+        w1 = P.prioritize(pod, [info], None, None,
+                          weights={"LeastRequested": 1.0})
+        w3 = P.prioritize(pod, [info], None, None,
+                          weights={"LeastRequested": 3.0})
+        assert w3["n1"] == pytest.approx(3 * w1["n1"])
+
+
+class TestGangPolicy:
+    def test_gang_honors_disabled_predicates(self):
+        """A policy that drops PodToleratesNodeTaints must apply to gang
+        planning too, not just scheduleOne (pure-CPU gang on a tainted
+        node)."""
+        from kubernetes_tpu.scheduler.gang import GangPlan, plan_gang
+        cache = SchedulerCache()
+        cache.set_node(_node("n1", taints=[t.Taint(
+            key="k", value="v", effect=t.TAINT_NO_SCHEDULE)]))
+        group = t.PodGroup(metadata=ObjectMeta(name="g", namespace="default"))
+        pods = [_pod()]
+        pods[0].metadata.name = "g-0"
+        denied = plan_gang(group, pods, cache)
+        assert not isinstance(denied, GangPlan)
+        allowed = plan_gang(group, pods, cache,
+                            enabled=frozenset({"PodFitsResources"}))
+        assert isinstance(allowed, GangPlan)
+        assert allowed.placements[0][1] == "n1"
+
+
+class TestSchedulerWiring:
+    def test_scheduler_accepts_policy_and_builds_extenders(self):
+        from kubernetes_tpu.scheduler.scheduler import Scheduler
+        pol = parse_policy({
+            "predicates": [{"name": "PodFitsResources"}],
+            "priorities": [{"name": "LeastRequestedPriority"}],
+            "extenders": [{"urlPrefix": "http://x/sched"}]})
+
+        class _FakeClient:
+            pass
+
+        s = Scheduler(_FakeClient(), policy=pol)
+        assert s._enabled_predicates == frozenset({"PodFitsResources"})
+        assert s._priority_weights == {"LeastRequested": 1.0}
+        assert len(s.extenders) == 1
+
+    def test_cluster_config_field(self, tmp_path):
+        from kubernetes_tpu.cluster.config import load_cluster_config
+        p = tmp_path / "cluster.yaml"
+        p.write_text("kind: ClusterConfig\nscheduler_policy: /tmp/pol.yaml\n")
+        assert load_cluster_config(str(p)).scheduler_policy == "/tmp/pol.yaml"
