@@ -956,6 +956,7 @@ async def cmd_up(args) -> int:
         audit_log=cfg.audit_log, audit_policy=cfg.audit_policy,
         audit_webhook=cfg.audit_webhook,
         scheduler_policy=cfg.scheduler_policy,
+        encryption_provider_config=cfg.encryption_provider_config,
         tls=not getattr(args, "insecure", False))
     base = await cluster.start()
     os.makedirs(os.path.dirname(DEFAULT_CONFIG), exist_ok=True)
@@ -1571,6 +1572,10 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--scheduler-policy", default=S,
                     help="scheduler Policy file (YAML/JSON) selecting "
                          "predicates, priority weights, and extenders")
+    sp.add_argument("--encryption-provider-config", default=S,
+                    help="EncryptionConfig file: encrypt listed resources "
+                         "(e.g. secrets) at rest in the WAL/snapshot; "
+                         "first provider writes, all providers read")
 
     return p
 

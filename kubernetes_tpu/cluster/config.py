@@ -39,6 +39,7 @@ class ClusterConfig:
     audit_policy: str = ""
     audit_webhook: str = ""
     scheduler_policy: str = ""
+    encryption_provider_config: str = ""
     nodes: list = dataclasses.field(default_factory=list)
 
 
@@ -82,7 +83,8 @@ def config_from_args(args) -> ClusterConfig:
     cfg = load_cluster_config(path) if path else ClusterConfig()
     for name in ("host", "port", "data_dir", "durable", "feature_gates",
                  "authorization_mode", "audit_log", "audit_policy",
-                 "audit_webhook", "scheduler_policy"):
+                 "audit_webhook", "scheduler_policy",
+                 "encryption_provider_config"):
         if hasattr(args, name):
             setattr(cfg, name, getattr(args, name))
     node_flags = any(hasattr(args, k)

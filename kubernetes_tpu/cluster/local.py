@@ -112,6 +112,7 @@ class LocalCluster:
                  audit_policy: str = "",
                  audit_webhook: str = "",
                  scheduler_policy: str = "",
+                 encryption_provider_config: str = "",
                  tls: bool = True):
         """``tls=True`` (default): the apiserver serves HTTPS only from
         a cluster CA minted under ``<data_dir>/pki`` — plaintext
@@ -133,6 +134,9 @@ class LocalCluster:
         #: Scheduler Policy file (scheduler/policy.py; reference
         #: kube-scheduler --policy-config-file).
         self.scheduler_policy = scheduler_policy
+        #: EncryptionConfig file (storage/encryption.py; reference
+        #: --experimental-encryption-provider-config).
+        self.encryption_provider_config = encryption_provider_config
         self.tls = tls
         self.ca = None
         self.ca_file = ""
@@ -151,8 +155,14 @@ class LocalCluster:
     async def start(self) -> str:
         from ..util.gctune import tune_control_plane_gc
         tune_control_plane_gc()
+        transformers = None
+        if self.encryption_provider_config:
+            from ..storage.encryption import load_encryption_config
+            transformers = load_encryption_config(
+                self.encryption_provider_config)
         store = MVCCStore(os.path.join(self.data_dir, "state")
-                          if self.durable else None)
+                          if self.durable else None,
+                          transformers=transformers)
         self.registry = Registry(store=store)
         # Loopback pod-IP space: every 127/8 address is bindable and
         # routable on one host with zero configuration, so the pod IPs

@@ -160,17 +160,28 @@ def parse_policy(raw: dict, source: str = "<policy>") -> SchedulerPolicy:
         url = _get(e, "url_prefix", "urlPrefix")
         if not url:
             raise ValueError(f"{source}: extenders[{i}]: urlPrefix required")
+        try:
+            weight = float(_get(e, "weight", default=1.0))
+            timeout = float(_get(e, "timeout", "httpTimeout", default=5.0))
+        except (TypeError, ValueError):
+            raise ValueError(f"{source}: extenders[{i}]: weight and "
+                             f"timeout must be numbers") from None
+        if weight < 0:
+            raise ValueError(f"{source}: extenders[{i}]: negative weight")
+        if timeout <= 0:
+            raise ValueError(f"{source}: extenders[{i}]: timeout must be "
+                             f"positive")
         pol.extenders.append(SchedulerExtender(
             url_prefix=url,
             filter_verb=_get(e, "filter_verb", "filterVerb",
                              default="filter"),
             prioritize_verb=_get(e, "prioritize_verb", "prioritizeVerb",
                                  default="prioritize"),
-            weight=float(_get(e, "weight", default=1.0)),
+            weight=weight,
             managed_resources=tuple(
                 _get(e, "managed_resources", "managedResources",
                      default=()) or ()),
-            timeout=float(_get(e, "timeout", "httpTimeout", default=5.0)),
+            timeout=timeout,
             ignorable=bool(_get(e, "ignorable", default=False)),
         ))
     return pol

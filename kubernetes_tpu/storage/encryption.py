@@ -1,0 +1,261 @@
+"""Encryption at rest for stored API objects (secrets by default).
+
+Reference: ``staging/src/k8s.io/apiserver/pkg/storage/value/`` — value
+transformers (identity, aescbc, aesgcm, secretbox) selected per
+resource by ``--experimental-encryption-provider-config``, an
+``EncryptionConfig`` document where the FIRST provider encrypts new
+writes and every listed provider can decrypt (key rotation = prepend a
+new key, restart, rewrite objects, drop the old key).
+
+TPU-native placement differs deliberately: the reference transforms at
+the etcd-client boundary because etcd is a separate process reachable
+over a network; this framework's MVCC store is embedded, so "at rest"
+means the WAL and snapshot on disk. Values are enveloped at the
+persistence boundary (``mvcc.py _append_event / snapshot / _load``)
+and the in-memory store stays plaintext — get/list/watch never pay a
+decrypt, and a stolen disk yields ciphertext only.
+
+Envelope (JSON-friendly, self-describing)::
+
+    {"__enc__": {"p": "aesgcm", "kid": "key1", "n": "<b64>", "d": "<b64>"}}
+
+Plaintext values read back unchanged (migration: enabling encryption
+on an existing data dir re-encrypts each object as it is next
+written; calling ``MVCCStore.snapshot()`` does it eagerly — the
+snapshot writer passes every stored value through the transformer).
+
+Config file (reference EncryptionConfig shape)::
+
+    kind: EncryptionConfig
+    resources:
+      - resources: [secrets]
+        providers:
+          - aesgcm:
+              keys:
+                - name: key1
+                  secret: <base64 16/24/32-byte key>
+          - identity: {}
+"""
+from __future__ import annotations
+
+import base64
+import json
+import os
+from dataclasses import dataclass, field
+
+ENVELOPE_FIELD = "__enc__"
+
+
+class DecryptError(Exception):
+    """Ciphertext present but no configured provider/key can open it —
+    surfaced loudly at load: silently dropping objects would look like
+    data loss, and passing ciphertext through would corrupt decoders."""
+
+
+@dataclass
+class _Key:
+    name: str
+    secret: bytes
+
+
+class AesGcmProvider:
+    """AEAD (the provider to prefer). 12-byte random nonce per write;
+    the envelope's ``kid`` selects the decrypt key directly — no
+    trial decryption."""
+
+    name = "aesgcm"
+
+    def __init__(self, keys: list[_Key]):
+        if not keys:
+            raise ValueError("aesgcm: at least one key required")
+        for k in keys:
+            if len(k.secret) not in (16, 24, 32):
+                raise ValueError(
+                    f"aesgcm key {k.name!r}: secret must be 16/24/32 bytes, "
+                    f"got {len(k.secret)}")
+        self._keys = {k.name: k.secret for k in keys}
+        self._write_key = keys[0]
+
+    def encrypt(self, plaintext: bytes) -> dict:
+        from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+        nonce = os.urandom(12)
+        ct = AESGCM(self._write_key.secret).encrypt(nonce, plaintext, None)
+        return {"p": self.name, "kid": self._write_key.name,
+                "n": base64.b64encode(nonce).decode(),
+                "d": base64.b64encode(ct).decode()}
+
+    def decrypt(self, env: dict) -> bytes | None:
+        if env.get("p") != self.name:
+            return None
+        secret = self._keys.get(env.get("kid", ""))
+        if secret is None:
+            return None
+        from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+        return AESGCM(secret).decrypt(
+            base64.b64decode(env["n"]), base64.b64decode(env["d"]), None)
+
+
+class AesCbcProvider:
+    """CBC with PKCS7 (reference parity; aesgcm is the better choice —
+    CBC has no integrity tag, kept for config compatibility)."""
+
+    name = "aescbc"
+
+    def __init__(self, keys: list[_Key]):
+        if not keys:
+            raise ValueError("aescbc: at least one key required")
+        for k in keys:
+            if len(k.secret) not in (16, 24, 32):
+                raise ValueError(
+                    f"aescbc key {k.name!r}: secret must be 16/24/32 bytes, "
+                    f"got {len(k.secret)}")
+        self._keys = {k.name: k.secret for k in keys}
+        self._write_key = keys[0]
+
+    def encrypt(self, plaintext: bytes) -> dict:
+        from cryptography.hazmat.primitives import padding
+        from cryptography.hazmat.primitives.ciphers import (
+            Cipher, algorithms, modes)
+        iv = os.urandom(16)
+        padder = padding.PKCS7(128).padder()
+        padded = padder.update(plaintext) + padder.finalize()
+        enc = Cipher(algorithms.AES(self._write_key.secret),
+                     modes.CBC(iv)).encryptor()
+        ct = enc.update(padded) + enc.finalize()
+        return {"p": self.name, "kid": self._write_key.name,
+                "n": base64.b64encode(iv).decode(),
+                "d": base64.b64encode(ct).decode()}
+
+    def decrypt(self, env: dict) -> bytes | None:
+        if env.get("p") != self.name:
+            return None
+        secret = self._keys.get(env.get("kid", ""))
+        if secret is None:
+            return None
+        from cryptography.hazmat.primitives import padding
+        from cryptography.hazmat.primitives.ciphers import (
+            Cipher, algorithms, modes)
+        dec = Cipher(algorithms.AES(secret),
+                     modes.CBC(base64.b64decode(env["n"]))).decryptor()
+        padded = dec.update(base64.b64decode(env["d"])) + dec.finalize()
+        unpadder = padding.PKCS7(128).unpadder()
+        return unpadder.update(padded) + unpadder.finalize()
+
+
+class IdentityProvider:
+    """Plaintext passthrough. As the FIRST provider it disables
+    encryption for new writes while later providers still decrypt old
+    data (the reference's decrypt-only migration posture)."""
+
+    name = "identity"
+
+    def __init__(self, _keys=None):
+        pass
+
+    def encrypt(self, plaintext: bytes) -> dict | None:
+        return None  # caller stores plaintext
+
+    def decrypt(self, env: dict) -> bytes | None:
+        return None  # envelopes are never identity's
+
+
+_PROVIDERS = {p.name: p for p in (AesGcmProvider, AesCbcProvider,
+                                  IdentityProvider)}
+
+
+@dataclass
+class Transformer:
+    """Provider chain for one resource set: first provider writes,
+    every provider gets a shot at reads."""
+
+    providers: list = field(default_factory=list)
+
+    def for_write(self, value: dict) -> dict:
+        if not self.providers:
+            return value
+        env = self.providers[0].encrypt(
+            json.dumps(value, separators=(",", ":")).encode())
+        if env is None:  # identity first = encryption off
+            return value
+        return {ENVELOPE_FIELD: env}
+
+    def for_read(self, value: dict) -> dict:
+        env = value.get(ENVELOPE_FIELD) if isinstance(value, dict) else None
+        if env is None:
+            return value  # plaintext (pre-encryption data, or identity)
+        for p in self.providers:
+            try:
+                pt = p.decrypt(env)
+            except Exception as e:  # noqa: BLE001 — InvalidTag, padding
+                # Corrupt ciphertext or a key whose secret changed under
+                # its kid: surface WITH context, not a raw crypto trace.
+                raise DecryptError(
+                    f"provider={env.get('p')!r} kid={env.get('kid')!r}: "
+                    f"ciphertext failed to decrypt ({type(e).__name__}: "
+                    f"{e}) — corrupted record, or the key's secret "
+                    f"changed while keeping its name?") from e
+            if pt is not None:
+                try:
+                    return json.loads(pt)
+                except ValueError as e:
+                    raise DecryptError(
+                        f"provider={env.get('p')!r} kid={env.get('kid')!r}:"
+                        f" decrypted bytes are not JSON ({e}) — wrong key "
+                        f"under the right name?") from e
+        raise DecryptError(
+            f"no configured provider/key decrypts envelope "
+            f"(provider={env.get('p')!r} kid={env.get('kid')!r}) — "
+            f"was a rotation key dropped before rewriting old objects?")
+
+
+def load_encryption_config(path: str) -> dict[str, Transformer]:
+    """Parse an EncryptionConfig file into {key-prefix: Transformer}
+    consumable by ``MVCCStore(transformers=...)``. Resource names are
+    plurals; the registry stores under ``/registry/<plural>/``."""
+    with open(path) as f:
+        text = f.read()
+    if path.endswith(".json"):
+        raw = json.loads(text)
+    else:
+        import yaml
+        raw = yaml.safe_load(text) or {}
+    if not isinstance(raw, dict):
+        raise ValueError(f"{path}: document must be a mapping")
+    if raw.get("kind", "EncryptionConfig") != "EncryptionConfig":
+        raise ValueError(f"{path}: kind must be EncryptionConfig")
+    out: dict[str, Transformer] = {}
+    for i, entry in enumerate(raw.get("resources") or []):
+        plurals = entry.get("resources") or []
+        if not plurals:
+            raise ValueError(f"{path}: resources[{i}]: empty resource list")
+        providers = []
+        for j, pconf in enumerate(entry.get("providers") or []):
+            if not isinstance(pconf, dict) or len(pconf) != 1:
+                raise ValueError(
+                    f"{path}: resources[{i}].providers[{j}]: each entry "
+                    f"is one provider mapping, e.g. 'aesgcm: {{keys: ...}}'")
+            (pname, pbody), = pconf.items()
+            cls = _PROVIDERS.get(pname)
+            if cls is None:
+                raise ValueError(
+                    f"{path}: resources[{i}].providers[{j}]: unknown "
+                    f"provider {pname!r} (known: {sorted(_PROVIDERS)})")
+            keys = [
+                _Key(name=k.get("name", ""),
+                     secret=base64.b64decode(k.get("secret", "")))
+                for k in (pbody or {}).get("keys") or []]
+            for k in keys:
+                if not k.name:
+                    raise ValueError(
+                        f"{path}: resources[{i}].providers[{j}]: every "
+                        f"key needs a name (it becomes the envelope kid)")
+            providers.append(cls(keys))
+        if not providers:
+            raise ValueError(f"{path}: resources[{i}]: no providers")
+        tf = Transformer(providers)
+        for plural in plurals:
+            # First matching entry wins (reference transformer-chain
+            # semantics): a plural repeated in a later stanza does not
+            # silently change which providers write it.
+            out.setdefault(f"/registry/{plural}/", tf)
+    return out

@@ -229,8 +229,17 @@ class _PrefixIndexedMap(dict):
 
 
 class MVCCStore:
-    def __init__(self, data_dir: Optional[str] = None, history_limit: int = 100_000):
+    def __init__(self, data_dir: Optional[str] = None, history_limit: int = 100_000,
+                 transformers: Optional[dict] = None):
+        """``transformers``: key-prefix -> encryption.Transformer,
+        applied at the persistence boundary only (WAL append, snapshot
+        write, load) — the in-memory store, watch history, and every
+        read path stay plaintext. See storage/encryption.py for why
+        "at rest" means the disk here, not the client-server hop the
+        reference transforms at. Calling :meth:`snapshot` after
+        enabling encryption eagerly rewrites all existing plaintext."""
         self._lock = threading.RLock()
+        self._transformers = dict(transformers or {})
         #: key -> StoredObject (live keys only).
         self._data: _PrefixIndexedMap = _PrefixIndexedMap()
         self._rev = 0
@@ -254,6 +263,34 @@ class MVCCStore:
 
     # -- persistence ------------------------------------------------------
 
+    def _disk(self, key: str, value):
+        """Value as persisted: enveloped when a transformer claims the
+        key's prefix, unchanged otherwise (and for delete tombstones)."""
+        if value is None or not self._transformers:
+            return value
+        for prefix, tf in self._transformers.items():
+            if key.startswith(prefix):
+                return tf.for_write(value)
+        return value
+
+    def _from_disk(self, key: str, value):
+        if value is None:
+            return value
+        for prefix, tf in self._transformers.items():
+            if key.startswith(prefix):
+                return tf.for_read(value)
+        if isinstance(value, dict) and "__enc__" in value:
+            # Enveloped on disk but no transformer claims the key: the
+            # operator restarted without --encryption-provider-config
+            # (or dropped this resource from it). Serving the envelope
+            # as the object would be silent corruption — fail the load.
+            from .encryption import DecryptError
+            raise DecryptError(
+                f"{key}: encrypted at rest but no encryption provider "
+                f"is configured for it — restart with the same "
+                f"--encryption-provider-config used to write it")
+        return value
+
     def _load(self) -> None:
         snap = os.path.join(self._data_dir, "snapshot.json")
         if os.path.exists(snap):
@@ -263,7 +300,7 @@ class MVCCStore:
             self._compact_rev = state.get("compact_rev", 0)
             for k, v in state["data"].items():
                 self._data[k] = StoredObject(
-                    key=k, value=v["value"],
+                    key=k, value=self._from_disk(k, v["value"]),
                     mod_revision=v["mod_revision"],
                     create_revision=v["create_revision"],
                 )
@@ -287,7 +324,8 @@ class MVCCStore:
                     else:
                         prev = self._data.get(key)
                         self._data[key] = StoredObject(
-                            key=key, value=rec["value"], mod_revision=rec["rev"],
+                            key=key, value=self._from_disk(key, rec["value"]),
+                            mod_revision=rec["rev"],
                             create_revision=prev.create_revision if prev else rec["rev"],
                         )
         # Event history does not survive restart: everything up to the
@@ -305,7 +343,8 @@ class MVCCStore:
                 "rev": self._rev,
                 "compact_rev": self._compact_rev,
                 "data": {
-                    k: {"value": o.value, "mod_revision": o.mod_revision,
+                    k: {"value": self._disk(k, o.value),
+                        "mod_revision": o.mod_revision,
                         "create_revision": o.create_revision}
                     for k, o in self._data.items()
                 },
@@ -343,7 +382,7 @@ class MVCCStore:
         if self._wal:
             self._wal.write(json.dumps({
                 "rev": ev.revision, "op": ev.type, "key": ev.key,
-                "value": ev.value,
+                "value": self._disk(ev.key, ev.value),
             }, separators=(",", ":")) + "\n")
         # Snapshot: an overflowing watcher removes itself from _watches
         # during _deliver; mutating the live list mid-iteration would

@@ -257,7 +257,9 @@ async def test_syncer_computes_on_churn():
         assert syncer.applied is False
         assert syncer.last_state.dummy_addresses == ["10.96.0.10"]
     finally:
-        ipvs.can_apply = real_can_apply
+        # Stop BEFORE restoring can_apply: an in-flight sync thread
+        # would otherwise see the real can_apply and program the kernel.
         await syncer.stop()
+        ipvs.can_apply = real_can_apply
         await client.close()
         await server.stop()

@@ -1,0 +1,189 @@
+"""Encryption at rest (storage/encryption.py + the MVCC persistence
+boundary). Reference: apiserver/pkg/storage/value transformers +
+EncryptionConfig."""
+import base64
+import json
+import os
+
+import pytest
+
+from kubernetes_tpu.storage import encryption as enc
+from kubernetes_tpu.storage.mvcc import MVCCStore
+
+
+def _b64key(b: bytes = b"0" * 32) -> str:
+    return base64.b64encode(b).decode()
+
+
+def _config(tmp_path, providers, resources=("secrets",), name="enc.yaml"):
+    import yaml
+    doc = {"kind": "EncryptionConfig",
+           "resources": [{"resources": list(resources),
+                          "providers": providers}]}
+    p = tmp_path / name
+    p.write_text(yaml.safe_dump(doc))
+    return str(p)
+
+
+def _aesgcm(secret=None, kid="key1"):
+    return {"aesgcm": {"keys": [{"name": kid,
+                                 "secret": secret or _b64key()}]}}
+
+
+class TestProviders:
+    def test_aesgcm_round_trip_and_kid(self):
+        tf = enc.Transformer([enc.AesGcmProvider(
+            [enc._Key("key1", b"1" * 32)])])
+        env = tf.for_write({"marker-field": "marker-value"})
+        assert set(env) == {enc.ENVELOPE_FIELD}
+        body = env[enc.ENVELOPE_FIELD]
+        assert body["p"] == "aesgcm" and body["kid"] == "key1"
+        assert tf.for_read(env) == {"marker-field": "marker-value"}
+        # Ciphertext really is opaque: the plaintext never appears.
+        assert "marker" not in json.dumps(env)
+
+    def test_aescbc_round_trip(self):
+        tf = enc.Transformer([enc.AesCbcProvider(
+            [enc._Key("k", b"2" * 16)])])
+        assert tf.for_read(tf.for_write({"x": "y"})) == {"x": "y"}
+
+    def test_rotation_first_key_writes_all_keys_read(self):
+        old = enc.AesGcmProvider([enc._Key("old", b"3" * 32)])
+        env = enc.Transformer([old]).for_write({"v": 1})
+        # Rotation: new key prepended; old data still reads, new data
+        # writes under the new kid.
+        rotated = enc.Transformer([enc.AesGcmProvider(
+            [enc._Key("new", b"4" * 32), enc._Key("old", b"3" * 32)])])
+        assert rotated.for_read(env) == {"v": 1}
+        assert rotated.for_write({"v": 2})[
+            enc.ENVELOPE_FIELD]["kid"] == "new"
+
+    def test_unknown_kid_fails_loudly(self):
+        a = enc.Transformer([enc.AesGcmProvider([enc._Key("a", b"5" * 32)])])
+        b = enc.Transformer([enc.AesGcmProvider([enc._Key("b", b"6" * 32)])])
+        with pytest.raises(enc.DecryptError, match="kid='a'"):
+            b.for_read(a.for_write({}))
+
+    def test_identity_first_disables_writes_but_still_reads_old(self):
+        gcm = enc.AesGcmProvider([enc._Key("k", b"7" * 32)])
+        env = enc.Transformer([gcm]).for_write({"s": 1})
+        migrating = enc.Transformer([enc.IdentityProvider(), gcm])
+        assert migrating.for_write({"s": 2}) == {"s": 2}  # plaintext
+        assert migrating.for_read(env) == {"s": 1}  # old data readable
+
+    def test_corrupt_ciphertext_raises_decrypt_error_with_context(self):
+        tf = enc.Transformer([enc.AesGcmProvider([enc._Key("k1", b"c" * 32)])])
+        env = tf.for_write({"v": 1})
+        env[enc.ENVELOPE_FIELD]["d"] = base64.b64encode(
+            b"not-real-ciphertext!").decode()
+        with pytest.raises(enc.DecryptError, match="kid='k1'"):
+            tf.for_read(env)
+
+    def test_duplicate_plural_first_entry_wins(self, tmp_path):
+        import yaml
+        doc = {"kind": "EncryptionConfig", "resources": [
+            {"resources": ["secrets"],
+             "providers": [_aesgcm(kid="first")]},
+            {"resources": ["secrets"],
+             "providers": [_aesgcm(secret=_b64key(b"z" * 32),
+                                   kid="second")]}]}
+        p = tmp_path / "dup.yaml"
+        p.write_text(yaml.safe_dump(doc))
+        tfs = enc.load_encryption_config(str(p))
+        env = tfs["/registry/secrets/"].for_write({})
+        assert env[enc.ENVELOPE_FIELD]["kid"] == "first"
+
+    def test_plaintext_passthrough_on_read(self):
+        tf = enc.Transformer([enc.AesGcmProvider([enc._Key("k", b"8" * 32)])])
+        assert tf.for_read({"plain": True}) == {"plain": True}
+
+    def test_bad_key_length_rejected(self):
+        with pytest.raises(ValueError, match="16/24/32"):
+            enc.AesGcmProvider([enc._Key("k", b"short")])
+
+
+class TestConfigFile:
+    def test_load_builds_prefix_map(self, tmp_path):
+        path = _config(tmp_path, [_aesgcm(), {"identity": {}}],
+                       resources=("secrets", "configmaps"))
+        tfs = enc.load_encryption_config(path)
+        assert set(tfs) == {"/registry/secrets/", "/registry/configmaps/"}
+        tf = tfs["/registry/secrets/"]
+        assert tf.for_read(tf.for_write({"d": 1})) == {"d": 1}
+
+    def test_unknown_provider_rejected(self, tmp_path):
+        path = _config(tmp_path, [{"kms": {}}])
+        with pytest.raises(ValueError, match="unknown provider"):
+            enc.load_encryption_config(path)
+
+    def test_key_without_name_rejected(self, tmp_path):
+        path = _config(tmp_path, [
+            {"aesgcm": {"keys": [{"secret": _b64key()}]}}])
+        with pytest.raises(ValueError, match="needs a name"):
+            enc.load_encryption_config(path)
+
+
+class TestMvccAtRest:
+    def _transformers(self):
+        return {"/registry/secrets/": enc.Transformer(
+            [enc.AesGcmProvider([enc._Key("key1", b"9" * 32)])])}
+
+    def test_wal_holds_ciphertext_memory_holds_plaintext(self, tmp_path):
+        store = MVCCStore(str(tmp_path), transformers=self._transformers())
+        store.create("/registry/secrets/default/tok",
+                     {"data": {"password": "hunter2"}})
+        store.create("/registry/pods/default/p", {"name": "visible-pod"})
+        assert store.get("/registry/secrets/default/tok").value[
+            "data"]["password"] == "hunter2"
+        wal = (tmp_path / "wal.jsonl").read_text()
+        assert "hunter2" not in wal
+        assert enc.ENVELOPE_FIELD in wal
+        assert "visible-pod" in wal  # unlisted resources stay plaintext
+        store.close()
+
+    def test_recovery_decrypts_wal_and_snapshot(self, tmp_path):
+        tfs = self._transformers()
+        store = MVCCStore(str(tmp_path), transformers=tfs)
+        store.create("/registry/secrets/default/a", {"v": "snap-me"})
+        store.snapshot()
+        store.update("/registry/secrets/default/a", {"v": "wal-me"})
+        store.close()
+        snap = (tmp_path / "snapshot.json").read_text()
+        assert "snap-me" not in snap
+        re = MVCCStore(str(tmp_path), transformers=tfs)
+        assert re.get("/registry/secrets/default/a").value == {"v": "wal-me"}
+        re.close()
+
+    def test_snapshot_is_the_eager_migration(self, tmp_path):
+        plain = MVCCStore(str(tmp_path))
+        plain.create("/registry/secrets/default/s", {"v": "legacy"})
+        plain.close()
+        tfs = self._transformers()
+        store = MVCCStore(str(tmp_path), transformers=tfs)
+        assert store.get("/registry/secrets/default/s").value == {
+            "v": "legacy"}
+        store.snapshot()
+        store.close()
+        assert "legacy" not in (tmp_path / "snapshot.json").read_text()
+        re = MVCCStore(str(tmp_path), transformers=tfs)
+        assert re.get("/registry/secrets/default/s").value == {"v": "legacy"}
+        re.close()
+
+    def test_recovery_without_config_fails_loudly(self, tmp_path):
+        """Restarting with no --encryption-provider-config must not
+        serve envelopes as objects (silent corruption)."""
+        store = MVCCStore(str(tmp_path), transformers=self._transformers())
+        store.create("/registry/secrets/default/s", {"v": 1})
+        store.close()
+        with pytest.raises(enc.DecryptError, match="no encryption provider"):
+            MVCCStore(str(tmp_path))
+
+    def test_recovery_without_keys_fails_loudly(self, tmp_path):
+        tfs = self._transformers()
+        store = MVCCStore(str(tmp_path), transformers=tfs)
+        store.create("/registry/secrets/default/s", {"v": 1})
+        store.close()
+        wrong = {"/registry/secrets/": enc.Transformer(
+            [enc.AesGcmProvider([enc._Key("other", b"a" * 32)])])}
+        with pytest.raises(enc.DecryptError):
+            MVCCStore(str(tmp_path), transformers=wrong)

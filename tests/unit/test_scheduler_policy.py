@@ -80,6 +80,17 @@ class TestParse:
         assert ext.managed_resources == ("example.com/widget",)
         assert ext.ignorable
 
+    def test_extender_weight_timeout_validated(self):
+        with pytest.raises(ValueError, match="negative weight"):
+            parse_policy({"extenders": [
+                {"urlPrefix": "http://x", "weight": -2}]})
+        with pytest.raises(ValueError, match="must be numbers"):
+            parse_policy({"extenders": [
+                {"urlPrefix": "http://x", "weight": "high"}]})
+        with pytest.raises(ValueError, match="timeout must be positive"):
+            parse_policy({"extenders": [
+                {"urlPrefix": "http://x", "timeout": 0}]})
+
     def test_load_json_and_yaml(self, tmp_path):
         doc = {"kind": "Policy",
                "predicates": [{"name": "PodFitsResources"}]}
