@@ -422,12 +422,179 @@ class PodSecurity(AdmissionPlugin):
         return None
 
 
+class DefaultTolerationSeconds(AdmissionPlugin):
+    """Give every pod bounded tolerations for the not-ready and
+    unreachable NoExecute taints, so a dead node's pods are evicted
+    after ``default_seconds`` instead of immediately (no toleration) or
+    never (operator forgot one).
+
+    Reference: ``plugin/pkg/admission/defaulttolerationseconds/
+    admission.go`` — same 300s default, same already-tolerates check.
+    """
+
+    name = "DefaultTolerationSeconds"
+
+    def __init__(self, default_seconds: int = 300):
+        self.default_seconds = default_seconds
+
+    def admit(self, op, spec, obj, old):
+        if spec.kind != "Pod" or op != "CREATE":
+            return obj
+        pod: t.Pod = obj
+        for key in (t.TAINT_NODE_NOT_READY, t.TAINT_NODE_UNREACHABLE):
+            probe = t.Taint(key=key, effect=t.TAINT_NO_EXECUTE)
+            if any(tol.tolerates(probe) for tol in pod.spec.tolerations):
+                continue
+            pod.spec.tolerations.append(t.Toleration(
+                key=key, operator="Exists", effect=t.TAINT_NO_EXECUTE,
+                toleration_seconds=self.default_seconds))
+        return pod
+
+
+class ExtendedResourceToleration(AdmissionPlugin):
+    """Pods that claim TPU chips automatically tolerate taints keyed by
+    the TPU resource name — operators taint accelerator nodes
+    ``google.com/tpu=present:NoSchedule`` and only chip-requesting pods
+    land there, with no per-pod toleration boilerplate.
+
+    Reference: ``plugin/pkg/admission/extendedresourcetoleration/
+    admission.go`` (one Exists-toleration per requested extended
+    resource).
+    """
+
+    name = "ExtendedResourceToleration"
+
+    def admit(self, op, spec, obj, old):
+        if spec.kind != "Pod" or op != "CREATE":
+            return obj
+        pod: t.Pod = obj
+        if not pod.spec.tpu_resources:
+            return pod
+        # Skip only when the pod already TOLERATES a tpu-keyed taint
+        # (exact-duplicate semantics, reference MergeTolerations): a
+        # narrow Equal toleration for some other value must not
+        # suppress the Exists one or the pod stays unschedulable on
+        # the very nodes this plugin opens up.
+        probe = t.Taint(key=t.RESOURCE_TPU, effect=t.TAINT_NO_SCHEDULE)
+        if not any(tol.tolerates(probe) and tol.operator == "Exists"
+                   for tol in pod.spec.tolerations):
+            pod.spec.tolerations.append(t.Toleration(
+                key=t.RESOURCE_TPU, operator="Exists"))
+        return pod
+
+
+class PodNodeSelector(AdmissionPlugin):
+    """Merge the namespace's ``scheduler.tpu/node-selector`` annotation
+    into every pod's node selector; a pod contradicting its namespace's
+    selector is rejected (namespaces as placement boundaries — e.g. a
+    team's namespace pinned to its reserved slice hosts).
+
+    Reference: ``plugin/pkg/admission/podnodeselector/admission.go``
+    (annotation ``scheduler.alpha.kubernetes.io/node-selector``).
+    """
+
+    name = "PodNodeSelector"
+    ANNOTATION = "scheduler.tpu/node-selector"
+
+    def __init__(self, registry: "Registry"):
+        self.registry = registry
+
+    def admit(self, op, spec, obj, old):
+        if spec.kind != "Pod" or op != "CREATE":
+            return obj
+        pod: t.Pod = obj
+        try:
+            ns = self.registry.get("namespaces", "", pod.metadata.namespace)
+        except errors.NotFoundError:
+            return pod  # NamespaceLifecycle owns that rejection
+        raw = (ns.metadata.annotations or {}).get(self.ANNOTATION, "")
+        if not raw:
+            return pod
+        selector = {}
+        for part in raw.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            k, eq, v = part.partition("=")
+            k, v = k.strip(), v.strip()
+            if not eq or not k:
+                # A malformed annotation silently dropped would strip
+                # the namespace's placement boundary (or merge an
+                # unmatchable "" key leaving every pod Pending with no
+                # pointer at the typo) — reject it at the source.
+                raise errors.ForbiddenError(
+                    f"namespace {pod.metadata.namespace!r} annotation "
+                    f"{self.ANNOTATION} is malformed at {part!r} "
+                    f"(want comma-separated key=value)")
+            selector[k] = v
+        for k, v in selector.items():
+            have = pod.spec.node_selector.get(k)
+            if have is not None and have != v:
+                raise errors.ForbiddenError(
+                    f"pod node selector {k}={have!r} conflicts with "
+                    f"namespace {pod.metadata.namespace!r} selector "
+                    f"{k}={v!r}")
+            pod.spec.node_selector[k] = v
+        return pod
+
+
+class DefaultStorageClass(AdmissionPlugin):
+    """Stamp PVCs that name no storage class with the cluster default
+    (the StorageClass annotated ``storageclass.tpu/is-default-class``).
+    Two defaults is operator error — rejected loudly rather than picked
+    arbitrarily.
+
+    Reference: ``plugin/pkg/admission/storageclass/setdefault/
+    admission.go``. Divergence: the reference distinguishes nil (apply
+    default) from "" (explicitly classless); dataclass fields have no
+    nil, so "" means unset here and an intentionally classless PVC sets
+    ``storage_class_name: "-"`` (normalized back to empty).
+    """
+
+    name = "DefaultStorageClass"
+    ANNOTATION = "storageclass.tpu/is-default-class"
+    NO_CLASS = "-"
+
+    def __init__(self, registry: "Registry"):
+        self.registry = registry
+
+    def admit(self, op, spec, obj, old):
+        if spec.kind != "PersistentVolumeClaim" or op != "CREATE":
+            return obj
+        pvc = obj
+        if pvc.spec.storage_class_name == self.NO_CLASS:
+            pvc.spec.storage_class_name = ""
+            pvc.metadata.annotations["volume.tpu/no-class"] = "true"
+            return pvc
+        if pvc.spec.storage_class_name:
+            return pvc
+        if pvc.metadata.annotations.get("volume.tpu/no-class") == "true":
+            return pvc
+        classes, _rev = self.registry.list("storageclasses")
+        defaults = [
+            sc for sc in classes
+            if (sc.metadata.annotations or {}).get(self.ANNOTATION) == "true"]
+        if not defaults:
+            return pvc
+        if len(defaults) > 1:
+            names = sorted(sc.metadata.name for sc in defaults)
+            raise errors.ForbiddenError(
+                f"{len(defaults)} default StorageClasses ({names}); "
+                f"mark exactly one with {self.ANNOTATION}=true")
+        pvc.spec.storage_class_name = defaults[0].metadata.name
+        return pvc
+
+
 def default_chain(registry: "Registry") -> AdmissionChain:
     return AdmissionChain([
         NamespaceLifecycle(registry),
         TpuResourceDefaulter(),
         PriorityResolver(registry),
         ServiceAccountPlugin(registry),
+        DefaultTolerationSeconds(),
+        ExtendedResourceToleration(),
+        PodNodeSelector(registry),
+        DefaultStorageClass(registry),
         LimitRanger(registry),
         ResourceQuotaPlugin(registry),
         PodSecurity(registry),

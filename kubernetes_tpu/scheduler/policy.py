@@ -149,9 +149,11 @@ def parse_policy(raw: dict, source: str = "<policy>") -> SchedulerPolicy:
             except (TypeError, ValueError):
                 raise ValueError(f"{source}: priorities[{i}]: weight "
                                  f"must be a number") from None
-            if w < 0:
+            import math
+            if not math.isfinite(w) or w < 0:
                 raise ValueError(
-                    f"{source}: priorities[{i}]: negative weight")
+                    f"{source}: priorities[{i}]: weight must be finite "
+                    f"and non-negative")
             weights[canon] = weights.get(canon, 0.0) + w
         pol.priority_weights = weights
     for i, e in enumerate(raw.get("extenders") or []):
@@ -160,17 +162,21 @@ def parse_policy(raw: dict, source: str = "<policy>") -> SchedulerPolicy:
         url = _get(e, "url_prefix", "urlPrefix")
         if not url:
             raise ValueError(f"{source}: extenders[{i}]: urlPrefix required")
+        import math
         try:
             weight = float(_get(e, "weight", default=1.0))
             timeout = float(_get(e, "timeout", "httpTimeout", default=5.0))
         except (TypeError, ValueError):
             raise ValueError(f"{source}: extenders[{i}]: weight and "
                              f"timeout must be numbers") from None
-        if weight < 0:
-            raise ValueError(f"{source}: extenders[{i}]: negative weight")
-        if timeout <= 0:
+        # Non-finite values pass plain comparisons ('nan' < 0 is False)
+        # and would NaN-poison every score / hang the HTTP call.
+        if not math.isfinite(weight) or weight < 0:
+            raise ValueError(f"{source}: extenders[{i}]: weight must be "
+                             f"finite and non-negative")
+        if not math.isfinite(timeout) or timeout <= 0:
             raise ValueError(f"{source}: extenders[{i}]: timeout must be "
-                             f"positive")
+                             f"finite and positive")
         pol.extenders.append(SchedulerExtender(
             url_prefix=url,
             filter_verb=_get(e, "filter_verb", "filterVerb",

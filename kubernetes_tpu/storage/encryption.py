@@ -58,23 +58,30 @@ class _Key:
     secret: bytes
 
 
-class AesGcmProvider:
+class _AesProvider:
+    """Shared key handling: AES key-size validation, kid-addressed key
+    map, first key writes."""
+
+    name = "aes"
+
+    def __init__(self, keys: list[_Key]):
+        if not keys:
+            raise ValueError(f"{self.name}: at least one key required")
+        for k in keys:
+            if len(k.secret) not in (16, 24, 32):
+                raise ValueError(
+                    f"{self.name} key {k.name!r}: secret must be "
+                    f"16/24/32 bytes, got {len(k.secret)}")
+        self._keys = {k.name: k.secret for k in keys}
+        self._write_key = keys[0]
+
+
+class AesGcmProvider(_AesProvider):
     """AEAD (the provider to prefer). 12-byte random nonce per write;
     the envelope's ``kid`` selects the decrypt key directly — no
     trial decryption."""
 
     name = "aesgcm"
-
-    def __init__(self, keys: list[_Key]):
-        if not keys:
-            raise ValueError("aesgcm: at least one key required")
-        for k in keys:
-            if len(k.secret) not in (16, 24, 32):
-                raise ValueError(
-                    f"aesgcm key {k.name!r}: secret must be 16/24/32 bytes, "
-                    f"got {len(k.secret)}")
-        self._keys = {k.name: k.secret for k in keys}
-        self._write_key = keys[0]
 
     def encrypt(self, plaintext: bytes) -> dict:
         from cryptography.hazmat.primitives.ciphers.aead import AESGCM
@@ -95,22 +102,11 @@ class AesGcmProvider:
             base64.b64decode(env["n"]), base64.b64decode(env["d"]), None)
 
 
-class AesCbcProvider:
+class AesCbcProvider(_AesProvider):
     """CBC with PKCS7 (reference parity; aesgcm is the better choice —
     CBC has no integrity tag, kept for config compatibility)."""
 
     name = "aescbc"
-
-    def __init__(self, keys: list[_Key]):
-        if not keys:
-            raise ValueError("aescbc: at least one key required")
-        for k in keys:
-            if len(k.secret) not in (16, 24, 32):
-                raise ValueError(
-                    f"aescbc key {k.name!r}: secret must be 16/24/32 bytes, "
-                    f"got {len(k.secret)}")
-        self._keys = {k.name: k.secret for k in keys}
-        self._write_key = keys[0]
 
     def encrypt(self, plaintext: bytes) -> dict:
         from cryptography.hazmat.primitives import padding

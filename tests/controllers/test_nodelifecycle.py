@@ -80,8 +80,17 @@ async def test_lease_renewal_counts_as_heartbeat():
 async def test_noexecute_eviction_and_toleration():
     reg, client, factory = make_plane()
     reg.create(stale_node("dead"))
+    # Explicit 0-second tolerations: without them the
+    # DefaultTolerationSeconds plugin grants the production 300s grace
+    # and this test would wait five minutes for the eviction.
     victim = t.Pod(metadata=ObjectMeta(name="victim", namespace="default"),
                    spec=t.PodSpec(node_name="dead",
+                                  tolerations=[t.Toleration(
+                                      key=key, operator="Exists",
+                                      effect="NoExecute",
+                                      toleration_seconds=0)
+                                      for key in (t.TAINT_NODE_NOT_READY,
+                                                  t.TAINT_NODE_UNREACHABLE)],
                                   containers=[t.Container(name="c", image="i")]))
     tolerant = t.Pod(
         metadata=ObjectMeta(name="tolerant", namespace="default"),

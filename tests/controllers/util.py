@@ -22,12 +22,22 @@ def make_plane():
     return reg, client, factory
 
 
-def pod_template(labels=None, cpu=0.1):
+def pod_template(labels=None, cpu=0.1, fast_evict=False):
+    """``fast_evict=True``: explicit 0-second NoExecute tolerations so
+    DefaultTolerationSeconds' production 300s grace doesn't slow tests
+    that assert on node-death rescheduling."""
+    tolerations = []
+    if fast_evict:
+        tolerations = [
+            t.Toleration(key=key, operator="Exists",
+                         effect=t.TAINT_NO_EXECUTE, toleration_seconds=0)
+            for key in (t.TAINT_NODE_NOT_READY, t.TAINT_NODE_UNREACHABLE)]
     return t.PodTemplateSpec(
         metadata=ObjectMeta(labels=labels or {"app": "x"}),
         spec=t.PodSpec(containers=[t.Container(
             name="c", image="img",
-            resources=t.ResourceRequirements(requests={"cpu": cpu}))]))
+            resources=t.ResourceRequirements(requests={"cpu": cpu}))],
+            tolerations=tolerations))
 
 
 def mk_rs(name="rs", replicas=2, labels=None):

@@ -40,7 +40,7 @@ async def test_node_death_reschedules_replicaset_pods():
             metadata=ObjectMeta(name="web", namespace="default"),
             spec=w.ReplicaSetSpec(
                 replicas=2, selector=LabelSelector(match_labels={"app": "web"}),
-                template=pod_template({"app": "web"})))
+                template=pod_template({"app": "web"}, fast_evict=True)))
         reg.create(rs)
 
         def all_bound():

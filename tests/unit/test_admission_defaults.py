@@ -1,0 +1,168 @@
+"""The r5 defaulting admission plugins: DefaultTolerationSeconds,
+ExtendedResourceToleration, PodNodeSelector, DefaultStorageClass.
+References: plugin/pkg/admission/{defaulttolerationseconds,
+extendedresourcetoleration,podnodeselector,storageclass/setdefault}."""
+import pytest
+
+from kubernetes_tpu.api import errors, types as t
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.apiserver.admission import default_chain
+from kubernetes_tpu.apiserver.registry import Registry
+
+
+def _registry():
+    reg = Registry()
+    reg.admission = default_chain(reg)
+    reg.create(t.Namespace(metadata=ObjectMeta(name="default")))
+    return reg
+
+
+def _pod(name="p", ns="default", **spec_kw):
+    return t.Pod(metadata=ObjectMeta(name=name, namespace=ns),
+                 spec=t.PodSpec(containers=[t.Container(name="c", image="i")],
+                                **spec_kw))
+
+
+class TestDefaultTolerationSeconds:
+    def test_pod_gets_bounded_notready_unreachable_tolerations(self):
+        reg = _registry()
+        reg.create(_pod())
+        pod = reg.get("pods", "default", "p")
+        by_key = {tol.key: tol for tol in pod.spec.tolerations}
+        for key in (t.TAINT_NODE_NOT_READY, t.TAINT_NODE_UNREACHABLE):
+            assert by_key[key].toleration_seconds == 300
+            assert by_key[key].effect == t.TAINT_NO_EXECUTE
+
+    def test_existing_toleration_not_overridden(self):
+        reg = _registry()
+        reg.create(_pod(tolerations=[t.Toleration(
+            key=t.TAINT_NODE_NOT_READY, operator="Exists",
+            effect=t.TAINT_NO_EXECUTE, toleration_seconds=7)]))
+        pod = reg.get("pods", "default", "p")
+        mine = [tol for tol in pod.spec.tolerations
+                if tol.key == t.TAINT_NODE_NOT_READY]
+        assert [tol.toleration_seconds for tol in mine] == [7]
+
+
+class TestExtendedResourceToleration:
+    def test_tpu_pod_tolerates_tpu_taint(self):
+        reg = _registry()
+        reg.create(_pod(tpu_resources=[t.PodTpuRequest(name="w", chips=4)]))
+        pod = reg.get("pods", "default", "p")
+        tols = [tol for tol in pod.spec.tolerations
+                if tol.key == t.RESOURCE_TPU]
+        assert tols and tols[0].operator == "Exists"
+
+    def test_narrow_equal_toleration_does_not_suppress_exists(self):
+        """A value-specific toleration that would NOT tolerate the real
+        node taint must not stop the plugin (MergeTolerations skips
+        exact duplicates only)."""
+        reg = _registry()
+        reg.create(_pod(
+            tpu_resources=[t.PodTpuRequest(name="w", chips=1)],
+            tolerations=[t.Toleration(key=t.RESOURCE_TPU, operator="Equal",
+                                      value="v5",
+                                      effect=t.TAINT_NO_SCHEDULE)]))
+        pod = reg.get("pods", "default", "p")
+        assert any(tol.key == t.RESOURCE_TPU and tol.operator == "Exists"
+                   for tol in pod.spec.tolerations)
+
+    def test_chipless_pod_untouched(self):
+        reg = _registry()
+        reg.create(_pod())
+        pod = reg.get("pods", "default", "p")
+        assert not any(tol.key == t.RESOURCE_TPU
+                       for tol in pod.spec.tolerations)
+
+
+class TestPodNodeSelector:
+    def _ns(self, reg, selector):
+        reg.create(t.Namespace(metadata=ObjectMeta(
+            name="team-a",
+            annotations={"scheduler.tpu/node-selector": selector})))
+
+    def test_namespace_selector_merged(self):
+        reg = _registry()
+        self._ns(reg, "pool=reserved, tier=gold")
+        reg.create(_pod(ns="team-a"))
+        pod = reg.get("pods", "team-a", "p")
+        assert pod.spec.node_selector["pool"] == "reserved"
+        assert pod.spec.node_selector["tier"] == "gold"
+
+    def test_conflicting_pod_selector_rejected(self):
+        reg = _registry()
+        self._ns(reg, "pool=reserved")
+        with pytest.raises(errors.ForbiddenError, match="conflicts"):
+            reg.create(_pod(ns="team-a",
+                            node_selector={"pool": "spot"}))
+
+    def test_malformed_annotation_rejected_not_silently_merged(self):
+        reg = _registry()
+        self._ns(reg, "pool=a, =oops")
+        with pytest.raises(errors.ForbiddenError, match="malformed"):
+            reg.create(_pod(ns="team-a"))
+        reg2 = _registry()
+        reg2.create(t.Namespace(metadata=ObjectMeta(
+            name="team-a",
+            annotations={"scheduler.tpu/node-selector": "pool reserved"})))
+        with pytest.raises(errors.ForbiddenError, match="malformed"):
+            reg2.create(_pod(ns="team-a"))
+
+    def test_matching_pod_selector_accepted(self):
+        reg = _registry()
+        self._ns(reg, "pool=reserved")
+        reg.create(_pod(ns="team-a", node_selector={"pool": "reserved"}))
+
+
+class TestDefaultStorageClass:
+    def _sc(self, name, default=False):
+        ann = {"storageclass.tpu/is-default-class": "true"} if default else {}
+        return t.StorageClass(metadata=ObjectMeta(name=name,
+                                                  annotations=ann),
+                              provisioner="tpu/checkpoint-store")
+
+    def _pvc(self, name="claim", cls=""):
+        return t.PersistentVolumeClaim(
+            metadata=ObjectMeta(name=name, namespace="default"),
+            spec=t.PersistentVolumeClaimSpec(
+                storage_class_name=cls,
+                resources=t.ResourceRequirements(
+                    requests={"storage": "1Gi"})))
+
+    def test_default_class_stamped(self):
+        reg = _registry()
+        reg.create(self._sc("fast", default=True))
+        reg.create(self._sc("slow"))
+        reg.create(self._pvc())
+        pvc = reg.get("persistentvolumeclaims", "default", "claim")
+        assert pvc.spec.storage_class_name == "fast"
+
+    def test_explicit_class_kept(self):
+        reg = _registry()
+        reg.create(self._sc("fast", default=True))
+        reg.create(self._sc("slow"))
+        reg.create(self._pvc(cls="slow"))
+        assert reg.get("persistentvolumeclaims", "default",
+                       "claim").spec.storage_class_name == "slow"
+
+    def test_no_default_leaves_unset(self):
+        reg = _registry()
+        reg.create(self._sc("slow"))
+        reg.create(self._pvc())
+        assert reg.get("persistentvolumeclaims", "default",
+                       "claim").spec.storage_class_name == ""
+
+    def test_two_defaults_rejected(self):
+        reg = _registry()
+        reg.create(self._sc("a", default=True))
+        reg.create(self._sc("b", default=True))
+        with pytest.raises(errors.ForbiddenError, match="exactly one"):
+            reg.create(self._pvc())
+
+    def test_dash_means_intentionally_classless(self):
+        reg = _registry()
+        reg.create(self._sc("fast", default=True))
+        reg.create(self._pvc(cls="-"))
+        pvc = reg.get("persistentvolumeclaims", "default", "claim")
+        assert pvc.spec.storage_class_name == ""
+        assert pvc.metadata.annotations.get("volume.tpu/no-class") == "true"

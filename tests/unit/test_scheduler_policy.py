@@ -81,15 +81,25 @@ class TestParse:
         assert ext.ignorable
 
     def test_extender_weight_timeout_validated(self):
-        with pytest.raises(ValueError, match="negative weight"):
+        with pytest.raises(ValueError, match="non-negative"):
             parse_policy({"extenders": [
                 {"urlPrefix": "http://x", "weight": -2}]})
         with pytest.raises(ValueError, match="must be numbers"):
             parse_policy({"extenders": [
                 {"urlPrefix": "http://x", "weight": "high"}]})
-        with pytest.raises(ValueError, match="timeout must be positive"):
+        with pytest.raises(ValueError, match="finite and positive"):
             parse_policy({"extenders": [
                 {"urlPrefix": "http://x", "timeout": 0}]})
+        # nan/inf pass plain comparisons; they must still be rejected.
+        with pytest.raises(ValueError, match="finite"):
+            parse_policy({"extenders": [
+                {"urlPrefix": "http://x", "weight": "nan"}]})
+        with pytest.raises(ValueError, match="finite"):
+            parse_policy({"extenders": [
+                {"urlPrefix": "http://x", "timeout": "inf"}]})
+        with pytest.raises(ValueError, match="finite"):
+            parse_policy({"priorities": [
+                {"name": "LeastRequested", "weight": "nan"}]})
 
     def test_load_json_and_yaml(self, tmp_path):
         doc = {"kind": "Policy",
