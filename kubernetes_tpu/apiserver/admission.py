@@ -441,6 +441,8 @@ class DefaultTolerationSeconds(AdmissionPlugin):
         if spec.kind != "Pod" or op != "CREATE":
             return obj
         pod: t.Pod = obj
+        if pod.spec.tolerations is None:  # explicit JSON null
+            pod.spec.tolerations = []
         for key in (t.TAINT_NODE_NOT_READY, t.TAINT_NODE_UNREACHABLE):
             probe = t.Taint(key=key, effect=t.TAINT_NO_EXECUTE)
             if any(tol.tolerates(probe) for tol in pod.spec.tolerations):
@@ -470,6 +472,8 @@ class ExtendedResourceToleration(AdmissionPlugin):
         pod: t.Pod = obj
         if not pod.spec.tpu_resources:
             return pod
+        if pod.spec.tolerations is None:  # explicit JSON null
+            pod.spec.tolerations = []
         # Skip only when the pod already TOLERATES a tpu-keyed taint
         # (exact-duplicate semantics, reference MergeTolerations): a
         # narrow Equal toleration for some other value must not
@@ -478,8 +482,12 @@ class ExtendedResourceToleration(AdmissionPlugin):
         probe = t.Taint(key=t.RESOURCE_TPU, effect=t.TAINT_NO_SCHEDULE)
         if not any(tol.tolerates(probe) and tol.operator == "Exists"
                    for tol in pod.spec.tolerations):
+            # effect=NoSchedule exactly (reference parity): an
+            # effect-less toleration would also tolerate NoExecute,
+            # pinning pods to a TPU node an operator is draining.
             pod.spec.tolerations.append(t.Toleration(
-                key=t.RESOURCE_TPU, operator="Exists"))
+                key=t.RESOURCE_TPU, operator="Exists",
+                effect=t.TAINT_NO_SCHEDULE))
         return pod
 
 
@@ -510,6 +518,8 @@ class PodNodeSelector(AdmissionPlugin):
         raw = (ns.metadata.annotations or {}).get(self.ANNOTATION, "")
         if not raw:
             return pod
+        if pod.spec.node_selector is None:  # explicit JSON null
+            pod.spec.node_selector = {}
         selector = {}
         for part in raw.split(","):
             part = part.strip()
@@ -562,6 +572,8 @@ class DefaultStorageClass(AdmissionPlugin):
         if spec.kind != "PersistentVolumeClaim" or op != "CREATE":
             return obj
         pvc = obj
+        if pvc.metadata.annotations is None:  # explicit JSON null
+            pvc.metadata.annotations = {}
         if pvc.spec.storage_class_name == self.NO_CLASS:
             pvc.spec.storage_class_name = ""
             pvc.metadata.annotations["volume.tpu/no-class"] = "true"

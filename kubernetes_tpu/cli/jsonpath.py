@@ -1,0 +1,188 @@
+"""JSONPath subset for ``ktl get -o jsonpath`` / ``custom-columns`` /
+``--sort-by``.
+
+Reference: ``pkg/util/jsonpath`` (kubectl's template dialect, itself a
+subset of JSONPath). Supported here — the constructs kubectl's docs
+actually demonstrate:
+
+- ``{.a.b.c}`` dotted field access (maps / object attributes)
+- ``{.items[*].x}`` wildcard over lists, ``{.items[2].x}`` index,
+  negative indices
+- ``{range .items[*]}...{end}`` iteration with nested expressions
+- ``{.a['b.c']}`` quoted key access (keys containing dots)
+- plain text between expressions, ``\n`` / ``\t`` escapes
+- top-level ``$`` is implicit and accepted
+
+Filters (``?(@...)``), unions, and slices are not implemented; using
+them raises with the offending token named.
+"""
+from __future__ import annotations
+
+import re
+from typing import Any
+
+
+class JsonPathError(ValueError):
+    pass
+
+
+_SEG = re.compile(
+    r"""
+    \.(?P<field>[A-Za-z_][A-Za-z0-9_\-]*)      # .field
+  | \[\s*'(?P<qkey>[^']*)'\s*\]                # ['key.with.dots']
+  | \[\s*"(?P<dqkey>[^"]*)"\s*\]               # ["key"]
+  | \[\s*(?P<index>-?\d+)\s*\]                 # [3] / [-1]
+  | \[\s*(?P<star>\*)\s*\]                     # [*]
+    """,
+    re.VERBOSE,
+)
+
+
+def _parse_path(expr: str, source: str) -> list:
+    """``.a.b[0][*]['k']`` -> segment list."""
+    expr = expr.strip()
+    # kubectl --sort-by/custom-columns accept both {.x} and .x forms.
+    if expr.startswith("{") and expr.endswith("}"):
+        expr = expr[1:-1].strip()
+    if expr.startswith("$"):
+        expr = expr[1:]
+    segs: list = []
+    pos = 0
+    while pos < len(expr):
+        m = _SEG.match(expr, pos)
+        if not m:
+            raise JsonPathError(
+                f"{source}: unsupported jsonpath syntax at "
+                f"{expr[pos:pos + 20]!r} (filters/unions/slices are not "
+                f"implemented)")
+        if m.group("field") is not None:
+            segs.append(("key", m.group("field")))
+        elif m.group("qkey") is not None:
+            segs.append(("key", m.group("qkey")))
+        elif m.group("dqkey") is not None:
+            segs.append(("key", m.group("dqkey")))
+        elif m.group("index") is not None:
+            segs.append(("index", int(m.group("index"))))
+        else:
+            segs.append(("star", None))
+        pos = m.end()
+    return segs
+
+
+def _get_one(obj: Any, kind: str, arg) -> list:
+    """Apply one segment to one value -> list of results (missing
+    fields vanish, matching kubectl's lenient lookups)."""
+    if kind == "key":
+        if isinstance(obj, dict):
+            return [obj[arg]] if arg in obj else []
+        if hasattr(obj, arg):
+            return [getattr(obj, arg)]
+        return []
+    if kind == "index":
+        if isinstance(obj, (list, tuple)):
+            try:
+                return [obj[arg]]
+            except IndexError:
+                return []
+        return []
+    # star
+    if isinstance(obj, dict):
+        return list(obj.values())
+    if isinstance(obj, (list, tuple)):
+        return list(obj)
+    return []
+
+
+def eval_path(segs: list, data: Any) -> list:
+    """Evaluate parsed segments against data -> flat result list."""
+    current = [data]
+    for kind, arg in segs:
+        nxt: list = []
+        for obj in current:
+            nxt.extend(_get_one(obj, kind, arg))
+        current = nxt
+    return current
+
+
+def find(expr: str, data: Any, source: str = "jsonpath") -> list:
+    return eval_path(_parse_path(expr, source), data)
+
+
+def _fmt(v: Any) -> str:
+    if v is None:
+        return "<none>"
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, (dict, list)):
+        import json
+        return json.dumps(v, separators=(",", ":"), default=str)
+    return str(v)
+
+
+_TOKEN = re.compile(r"\{([^{}]*)\}")
+
+
+def render_template(template: str, data: Any) -> str:
+    """kubectl ``-o jsonpath=`` template: text + {expr} + range/end."""
+    template = template.replace("\\n", "\n").replace("\\t", "\t")
+    tokens: list = []  # ("text", s) | ("expr", segs) | ("range", segs) | ("end",)
+    pos = 0
+    for m in _TOKEN.finditer(template):
+        if m.start() > pos:
+            tokens.append(("text", template[pos:m.start()]))
+        body = m.group(1).strip()
+        if ((body.startswith('"') and body.endswith('"'))
+                or (body.startswith("'") and body.endswith("'"))):
+            # kubectl's quoted-literal idiom: {range ...}{.x}{"\n"}{end}
+            tokens.append(("text", body[1:-1]))
+        elif body == "end":
+            tokens.append(("end",))
+        elif body.startswith("range"):
+            tokens.append(("range", _parse_path(body[len("range"):],
+                                                "jsonpath")))
+        else:
+            tokens.append(("expr", _parse_path(body, "jsonpath")))
+        pos = m.end()
+    if pos < len(template):
+        tokens.append(("text", template[pos:]))
+
+    def emit(toks: list, scope: Any) -> str:
+        out: list[str] = []
+        i = 0
+        while i < len(toks):
+            tok = toks[i]
+            if tok[0] == "text":
+                out.append(tok[1])
+                i += 1
+            elif tok[0] == "expr":
+                out.append(" ".join(_fmt(v)
+                                    for v in eval_path(tok[1], scope)))
+                i += 1
+            elif tok[0] == "range":
+                depth, j = 1, i + 1
+                while j < len(toks):
+                    if toks[j][0] == "range":
+                        depth += 1
+                    elif toks[j][0] == "end":
+                        depth -= 1
+                        if depth == 0:
+                            break
+                    j += 1
+                if j == len(toks):
+                    raise JsonPathError("jsonpath: {range} without {end}")
+                body = toks[i + 1:j]
+                for item in eval_path(tok[1], scope):
+                    out.append(emit(body, item))
+                i = j + 1
+            else:  # stray end
+                raise JsonPathError("jsonpath: {end} without {range}")
+        return "".join(out)
+
+    return emit(tokens, data)
+
+
+def sort_key(expr: str, data: Any):
+    """--sort-by key: first match of expr, None sorts first. Mixed
+    types fall back to string comparison (kubectl behavior)."""
+    got = find(expr, data, source="--sort-by")
+    return got[0] if got else None

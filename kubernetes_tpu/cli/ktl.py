@@ -160,7 +160,47 @@ async def cmd_get(args) -> int:
         else:
             objs, rev = await client.list(plural, args.namespace,
                                         label_selector=args.selector)
-        if args.output == "json":
+        if getattr(args, "sort_by", ""):
+            from .jsonpath import sort_key
+            vals = [sort_key(args.sort_by, to_dict(o)) for o in objs]
+            # Homogeneous numbers sort numerically (kubectl); anything
+            # mixed falls back to strings. None always sorts first.
+            numeric = all(isinstance(v, (int, float))
+                          and not isinstance(v, bool)
+                          for v in vals if v is not None)
+            def _key(pair):
+                v = pair[0]
+                if v is None:
+                    return (0, 0.0, "")
+                return (1, float(v), "") if numeric else (1, 0.0, str(v))
+            objs = [o for _v, o in sorted(zip(vals, objs), key=_key)]
+        if args.output.startswith("jsonpath="):
+            from .jsonpath import render_template
+            template = args.output[len("jsonpath="):]
+            data = (to_dict(objs[0]) if args.name
+                    else {"items": [to_dict(o) for o in objs]})
+            sys.stdout.write(render_template(template, data))
+            sys.stdout.flush()
+        elif args.output.startswith("custom-columns="):
+            from .jsonpath import find
+            cols = []
+            for part in args.output[len("custom-columns="):].split(","):
+                header, _, expr = part.partition(":")
+                if not header or not expr:
+                    raise errors.BadRequestError(
+                        f"custom-columns: want HEADER:jsonpath, got "
+                        f"{part!r}")
+                cols.append((header, expr))
+            rows = []
+            for o in objs:
+                d = to_dict(o)
+                row = []
+                for _h, expr in cols:
+                    got = find(expr, d, source="custom-columns")
+                    row.append(str(got[0]) if got else "<none>")
+                rows.append(row)
+            print(printers.render_table([h for h, _ in cols], rows))
+        elif args.output == "json":
             out = [to_dict(o) for o in objs]
             print(json.dumps(out[0] if args.name else out, indent=2,
                              default=str))
@@ -169,9 +209,21 @@ async def cmd_get(args) -> int:
             out = [to_dict(o) for o in objs]
             print(yaml.safe_dump(out[0] if args.name else out,
                                  sort_keys=False))
-        else:
+        elif args.output in ("", "wide"):
             print(printers.print_objects(plural, objs,
                                          wide=args.output == "wide"))
+        else:
+            # -o lost its argparse choices= when jsonpath=/custom-
+            # columns= arrived; unknown formats must still be loud.
+            raise errors.BadRequestError(
+                f"unknown output format {args.output!r} (want wide, "
+                f"json, yaml, jsonpath=..., custom-columns=...)")
+        if getattr(args, "watch", False) and (
+                args.output.startswith("jsonpath=")
+                or args.output.startswith("custom-columns=")):
+            raise errors.BadRequestError(
+                "-w with jsonpath/custom-columns output is not "
+                "supported (the stream would mix formats)")
         if getattr(args, "watch", False) and not args.name:
             # kubectl get -w: stream changes after the initial table,
             # one re-printed row per event, until interrupted.
@@ -906,6 +958,103 @@ async def cmd_api_resources(args) -> int:
         await client.close()
 
 
+def _explain_type(tp):
+    """Human name for a dataclass field annotation."""
+    import typing
+    origin = typing.get_origin(tp)
+    if origin in (list, tuple):
+        args = typing.get_args(tp)
+        inner = _explain_type(args[0]) if args else "object"
+        return f"[]{inner}"
+    if origin is dict:
+        args = typing.get_args(tp)
+        if len(args) == 2:
+            return f"map[{_explain_type(args[0])}]{_explain_type(args[1])}"
+        return "map"
+    if origin is typing.Union:  # Optional[X]
+        inner = [a for a in typing.get_args(tp) if a is not type(None)]
+        return _explain_type(inner[0]) if inner else "object"
+    if isinstance(tp, str):
+        return tp
+    return getattr(tp, "__name__", str(tp))
+
+
+def _explain_target(tp):
+    """The dataclass to recurse into for a field annotation, if any."""
+    import dataclasses
+    import typing
+    origin = typing.get_origin(tp)
+    if origin in (list, tuple):
+        args = typing.get_args(tp)
+        return _explain_target(args[0]) if args else None
+    if origin is dict:
+        args = typing.get_args(tp)
+        return _explain_target(args[1]) if len(args) == 2 else None
+    if origin is typing.Union:
+        inner = [a for a in typing.get_args(tp) if a is not type(None)]
+        return _explain_target(inner[0]) if inner else None
+    return tp if dataclasses.is_dataclass(tp) else None
+
+
+async def cmd_explain(args) -> int:
+    """Field documentation from scheme introspection (kubectl explain;
+    reference drives this from OpenAPI — here the dataclasses ARE the
+    schema, so the answer comes straight from the registered types,
+    no server round trip)."""
+    import dataclasses
+    import inspect
+    import typing
+    from ..apiserver.registry import Registry
+
+    path = args.resource.split(".")
+    plural = resolve_plural(path[0])
+    try:
+        spec = Registry().spec_for(plural)
+    except errors.StatusError:
+        print(f"Error: unknown resource {path[0]!r} "
+              f"(try: ktl api-resources)", file=sys.stderr)
+        return 1
+    cls = spec.cls
+    walked = [plural]
+    for seg in path[1:]:
+        if not dataclasses.is_dataclass(cls):
+            print(f"Error: {'.'.join(walked)} has no fields to descend "
+                  f"into", file=sys.stderr)
+            return 1
+        hints = typing.get_type_hints(cls)
+        fields = {f.name: f for f in dataclasses.fields(cls)}
+        if seg not in fields:
+            print(f"Error: field {seg!r} not found in {'.'.join(walked)} "
+                  f"(fields: {', '.join(sorted(fields))})", file=sys.stderr)
+            return 1
+        nxt = _explain_target(hints.get(seg, fields[seg].type))
+        if nxt is None:
+            print(f"{'.'.join(walked + [seg])}: "
+                  f"{_explain_type(hints.get(seg, fields[seg].type))} "
+                  f"(scalar — nothing further to explain)")
+            return 0
+        cls = nxt
+        walked.append(seg)
+
+    print(f"KIND:     {spec.kind}")
+    print(f"VERSION:  {spec.api_version}")
+    print(f"RESOURCE: {'.'.join(walked)} <{cls.__name__}>")
+    doc = inspect.getdoc(cls)
+    if doc and doc.startswith(f"{cls.__name__}("):
+        doc = ""  # auto-generated dataclass signature, not prose
+    if doc:
+        print("\nDESCRIPTION:")
+        for line in doc.splitlines():
+            print(f"     {line}")
+    if dataclasses.is_dataclass(cls):
+        hints = typing.get_type_hints(cls)
+        print("\nFIELDS:")
+        for f in dataclasses.fields(cls):
+            tname = _explain_type(hints.get(f.name, f.type))
+            print(f"   {f.name:<28} <{tname}>")
+    return 0
+
+
 async def cmd_version(args) -> int:
     from .. import __version__
     print(f"ktl version {__version__}")
@@ -1384,9 +1533,20 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("-n", "--namespace", default="default")
     sp.add_argument("-l", "--selector", default="")
     sp.add_argument("-o", "--output", default="",
-                    choices=["", "wide", "json", "yaml"])
+                    help="''|wide|json|yaml|jsonpath=TEMPLATE|"
+                         "custom-columns=H:expr,...")
+    sp.add_argument("--sort-by", default="",
+                    help="jsonpath expression to sort the list by, "
+                         "e.g. {.metadata.name}")
     sp.add_argument("-w", "--watch", action="store_true", default=False,
                     help="stream changes after the initial list")
+
+    sp = add("explain", cmd_explain,
+             help="field documentation for a resource, e.g. "
+                  "'ktl explain pods.spec.containers'")
+    sp.add_argument("resource",
+                    help="resource or dotted field path "
+                         "(pods | pods.spec.tolerations)")
 
     sp = add("describe", cmd_describe, help="show one object in detail")
     sp.add_argument("resource")
@@ -1589,6 +1749,13 @@ def main(argv: Optional[list[str]] = None) -> int:
         return 1
     except KeyboardInterrupt:
         return 130
+    except Exception as e:  # noqa: BLE001 — bad jsonpath input must
+        # print cleanly; every other exception stays a loud traceback
+        from .jsonpath import JsonPathError
+        if isinstance(e, JsonPathError):
+            print(f"Error: {e}", file=sys.stderr)
+            return 1
+        raise
 
 
 if __name__ == "__main__":

@@ -1,0 +1,173 @@
+"""ktl get -o jsonpath= / custom-columns= / --sort-by, and ktl explain
+(reference: pkg/util/jsonpath, kubectl get printers, kubectl explain)."""
+import asyncio
+import contextlib
+import io
+
+import pytest
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.cli import ktl
+from kubernetes_tpu.cli.jsonpath import (
+    JsonPathError, find, render_template, sort_key)
+
+
+async def ktl_out(args, server=""):
+    buf, err = io.StringIO(), io.StringIO()
+
+    def call():
+        with contextlib.redirect_stdout(buf), contextlib.redirect_stderr(err):
+            argv = (["--server", server] if server else []) + args
+            return ktl.main(argv)
+    rc = await asyncio.to_thread(call)
+    return rc, buf.getvalue(), err.getvalue()
+
+
+async def start_server():
+    srv = APIServer()
+    srv.registry.create(t.Namespace(metadata=ObjectMeta(name="default")))
+    for name, node, cpu in (("b-pod", "n2", "2"), ("a-pod", "n1", "1")):
+        srv.registry.create(t.Pod(
+            metadata=ObjectMeta(name=name, namespace="default"),
+            spec=t.PodSpec(node_name=node, containers=[t.Container(
+                name="c", image=f"img-{name}",
+                resources=t.ResourceRequirements(
+                    requests={"cpu": cpu}))])))
+    port = await srv.start()
+    return srv, f"http://127.0.0.1:{port}"
+
+
+class TestJsonPathUnit:
+    DATA = {"metadata": {"name": "x", "labels": {"a.b/c": "v"}},
+            "items": [{"n": 1}, {"n": 2}, {"n": 3}]}
+
+    def test_dotted_and_quoted(self):
+        assert find("{.metadata.name}"[1:-1], self.DATA) == ["x"]
+        assert find(".metadata.labels['a.b/c']", self.DATA) == ["v"]
+
+    def test_wildcard_index_negative(self):
+        assert find(".items[*].n", self.DATA) == [1, 2, 3]
+        assert find(".items[1].n", self.DATA) == [2]
+        assert find(".items[-1].n", self.DATA) == [3]
+        assert find(".items[9].n", self.DATA) == []
+
+    def test_template_and_range(self):
+        out = render_template(
+            "{range .items[*]}n={.n}\\n{end}", self.DATA)
+        assert out == "n=1\nn=2\nn=3\n"
+
+    def test_quoted_literal_idiom(self):
+        out = render_template(
+            '{range .items[*]}{.n}{"\\n"}{end}', self.DATA)
+        assert out == "1\n2\n3\n"
+
+    def test_unsupported_syntax_is_loud(self):
+        with pytest.raises(JsonPathError, match="unsupported"):
+            find(".items[?(@.n==1)]", self.DATA)
+        with pytest.raises(JsonPathError, match="without"):
+            render_template("{range .items[*]}x", self.DATA)
+
+    def test_sort_key_missing_sorts_first(self):
+        assert sort_key(".metadata.name", {}) is None
+        assert sort_key(".metadata.name", self.DATA) == "x"
+
+
+class TestGetFormats:
+    async def test_jsonpath_output(self):
+        srv, base = await start_server()
+        try:
+            rc, out, err = await ktl_out(
+                ["get", "pods",
+                 "-o", "jsonpath={range .items[*]}{.metadata.name} "
+                       "{.spec.node_name}\\n{end}"], base)
+            assert rc == 0, err
+            assert "a-pod n1" in out and "b-pod n2" in out
+            rc, out, err = await ktl_out(
+                ["get", "pods", "a-pod",
+                 "-o", "jsonpath={.spec.containers[0].image}"], base)
+            assert rc == 0, err
+            assert out.strip() == "img-a-pod"
+        finally:
+            await srv.stop()
+
+    async def test_custom_columns_and_sort_by(self):
+        srv, base = await start_server()
+        try:
+            rc, out, err = await ktl_out(
+                ["get", "pods", "--sort-by", "{.metadata.name}",
+                 "-o", "custom-columns=NAME:.metadata.name,"
+                       "CPU:.spec.containers[0].resources.requests.cpu"],
+                base)
+            assert rc == 0, err
+            lines = out.strip().splitlines()
+            assert lines[0].split() == ["NAME", "CPU"]
+            # sorted by name: a-pod before b-pod
+            assert lines[1].split() == ["a-pod", "1"]
+            assert lines[2].split() == ["b-pod", "2"]
+        finally:
+            await srv.stop()
+
+    async def test_sort_by_numeric_not_lexicographic(self):
+        srv, base = await start_server()
+        try:
+            for name, prio in (("p10", 10), ("p2", 2), ("p9", 9)):
+                srv.registry.create(t.Pod(
+                    metadata=ObjectMeta(name=name, namespace="default"),
+                    spec=t.PodSpec(priority=prio, containers=[
+                        t.Container(name="c", image="i")])))
+            rc, out, err = await ktl_out(
+                ["get", "pods", "--sort-by", "{.spec.priority}",
+                 "-o", "custom-columns=NAME:.metadata.name"], base)
+            assert rc == 0, err
+            names = [ln.strip() for ln in out.strip().splitlines()[1:]]
+            # a-pod/b-pod have priority 0 via admission defaulting
+            assert names.index("p2") < names.index("p9") < names.index("p10")
+        finally:
+            await srv.stop()
+
+    async def test_watch_with_template_formats_rejected(self):
+        srv, base = await start_server()
+        try:
+            rc, out, err = await ktl_out(
+                ["get", "pods", "-w",
+                 "-o", "jsonpath={.items[*].metadata.name}"], base)
+            assert rc != 0
+            assert "not supported" in out + err
+        finally:
+            await srv.stop()
+
+    async def test_unknown_output_is_rejected(self):
+        srv, base = await start_server()
+        try:
+            rc, out, err = await ktl_out(
+                ["get", "pods", "-o", "yamll"], base)
+            assert rc != 0
+            assert "unknown output format" in out + err
+        finally:
+            await srv.stop()
+
+
+class TestExplain:
+    async def test_explain_resource_and_path(self):
+        rc, out, err = await ktl_out(["explain", "pods"])
+        assert rc == 0, err
+        assert "KIND:     Pod" in out
+        assert "spec" in out
+        rc, out, err = await ktl_out(
+            ["explain", "pods.spec.tolerations"])
+        assert rc == 0, err
+        assert "<Toleration>" in out
+        assert "toleration_seconds" in out
+
+    async def test_explain_scalar_and_errors(self):
+        rc, out, err = await ktl_out(["explain", "pods.spec.node_name"])
+        assert rc == 0, err
+        assert "scalar" in out
+        rc, out, err = await ktl_out(["explain", "pods.spec.bogus"])
+        assert rc == 1
+        assert "not found" in err
+        rc, out, err = await ktl_out(["explain", "nosuchthing"])
+        assert rc == 1
+        assert "unknown resource" in err

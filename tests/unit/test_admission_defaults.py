@@ -75,6 +75,32 @@ class TestExtendedResourceToleration:
                        for tol in pod.spec.tolerations)
 
 
+class TestNullFields:
+    def test_explicit_null_collections_survive_admission(self):
+        """Wire payloads with explicit JSON nulls decode to None; the
+        defaulting plugins must normalize, not crash the apiserver."""
+        reg = _registry()
+        pod = _pod()
+        pod.spec.tolerations = None
+        pod.spec.node_selector = None
+        reg.create(pod)
+        got = reg.get("pods", "default", "p")
+        assert any(tol.key == t.TAINT_NODE_NOT_READY
+                   for tol in got.spec.tolerations)
+
+    def test_tpu_toleration_scoped_to_noschedule(self):
+        """Reference parity: the auto toleration must NOT tolerate
+        NoExecute, or draining a broken TPU node never evicts."""
+        reg = _registry()
+        reg.create(_pod(tpu_resources=[t.PodTpuRequest(name="w", chips=1)]))
+        pod = reg.get("pods", "default", "p")
+        tol = next(x for x in pod.spec.tolerations
+                   if x.key == t.RESOURCE_TPU)
+        assert tol.effect == t.TAINT_NO_SCHEDULE
+        assert not tol.tolerates(t.Taint(key=t.RESOURCE_TPU,
+                                         effect=t.TAINT_NO_EXECUTE))
+
+
 class TestPodNodeSelector:
     def _ns(self, reg, selector):
         reg.create(t.Namespace(metadata=ObjectMeta(
