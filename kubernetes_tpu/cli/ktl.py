@@ -152,6 +152,14 @@ def load_manifests(path: str) -> list[Any]:
 # -- commands --------------------------------------------------------------
 
 async def cmd_get(args) -> int:
+    if getattr(args, "watch", False) and (
+            args.output.startswith("jsonpath=")
+            or args.output.startswith("custom-columns=")):
+        # Rejected before ANY fetch: valid-looking output followed by
+        # a late failure is worse for scripts than an up-front error.
+        print("Error: -w with jsonpath/custom-columns output is not "
+              "supported (the stream would mix formats)", file=sys.stderr)
+        return 1
     client = make_client(args)
     try:
         plural = resolve_plural(args.resource)
@@ -182,7 +190,7 @@ async def cmd_get(args) -> int:
             sys.stdout.write(render_template(template, data))
             sys.stdout.flush()
         elif args.output.startswith("custom-columns="):
-            from .jsonpath import find
+            from .jsonpath import _fmt, find
             cols = []
             for part in args.output[len("custom-columns="):].split(","):
                 header, _, expr = part.partition(":")
@@ -197,7 +205,7 @@ async def cmd_get(args) -> int:
                 row = []
                 for _h, expr in cols:
                     got = find(expr, d, source="custom-columns")
-                    row.append(str(got[0]) if got else "<none>")
+                    row.append(_fmt(got[0]) if got else "<none>")
                 rows.append(row)
             print(printers.render_table([h for h, _ in cols], rows))
         elif args.output == "json":
@@ -218,12 +226,6 @@ async def cmd_get(args) -> int:
             raise errors.BadRequestError(
                 f"unknown output format {args.output!r} (want wide, "
                 f"json, yaml, jsonpath=..., custom-columns=...)")
-        if getattr(args, "watch", False) and (
-                args.output.startswith("jsonpath=")
-                or args.output.startswith("custom-columns=")):
-            raise errors.BadRequestError(
-                "-w with jsonpath/custom-columns output is not "
-                "supported (the stream would mix formats)")
         if getattr(args, "watch", False) and not args.name:
             # kubectl get -w: stream changes after the initial table,
             # one re-printed row per event, until interrupted.
@@ -263,25 +265,174 @@ async def cmd_describe(args) -> int:
         await client.close()
 
 
+#: Marks objects as ktl-applied; prune only ever deletes objects
+#: carrying it (reference: kubectl.kubernetes.io/last-applied-
+#: configuration gating apply --prune).
+LAST_APPLIED = "ktl.tpu/last-applied"
+
+#: Types apply --prune sweeps even when the file set no longer
+#: contains any object of that type (kubectl's default prune
+#: whitelist — without it, deleting the last Service from the
+#: directory would never prune the live one).
+PRUNE_TYPES = ["configmaps", "secrets", "services", "deployments",
+               "replicasets", "statefulsets", "daemonsets", "jobs",
+               "cronjobs", "pods", "persistentvolumeclaims", "podgroups"]
+
+
 async def cmd_apply(args) -> int:
     client = make_client(args)
+    prune = getattr(args, "prune", False)
+    selector = getattr(args, "selector", "")
+    if prune and not selector:
+        print("Error: --prune requires -l/--selector (it bounds the "
+              "sweep; pruning everything ever applied is never what "
+              "you want)", file=sys.stderr)
+        return 1
+    applied: set[tuple[str, str, str]] = set()  # (plural, ns, name)
     try:
         for obj in load_manifests(args.filename):
             if not obj.metadata.namespace and _namespaced(obj):
                 obj.metadata.namespace = args.namespace
             kind = obj.kind or type(obj).__name__
+            if obj.metadata.annotations is None:  # explicit JSON null
+                obj.metadata.annotations = {}
+            # The stamp records the APPLIED manifest (pre-defaulting),
+            # compact JSON like the reference annotation.
+            obj.metadata.annotations[LAST_APPLIED] = json.dumps(
+                to_dict(obj), separators=(",", ":"), default=str)
+            plural = _plural_of(obj)
+            ns = obj.metadata.namespace if _namespaced(obj) else ""
+            applied.add((plural, ns, obj.metadata.name))
             try:
                 created = await client.create(obj)
                 print(f"{kind.lower()}/{created.metadata.name} created")
             except errors.AlreadyExistsError:
-                plural = _plural_of(obj)
                 cur = await client.get(plural, obj.metadata.namespace,
                                        obj.metadata.name)
                 obj.metadata.resource_version = cur.metadata.resource_version
                 obj.metadata.uid = cur.metadata.uid
                 updated = await client.update(obj)
                 print(f"{kind.lower()}/{updated.metadata.name} configured")
+        if prune:
+            sweep = set(PRUNE_TYPES) | {p for p, _ns, _n in applied}
+            for plural in sorted(sweep):
+                from ..client.rest import _BY_PLURAL
+                if plural not in _BY_PLURAL:
+                    continue
+                namespaced = _BY_PLURAL[plural][1]
+                ns = args.namespace if namespaced else ""
+                objs, _rev = await client.list(plural, ns,
+                                               label_selector=selector)
+                for live in objs:
+                    if LAST_APPLIED not in (live.metadata.annotations or {}):
+                        continue  # never applied by ktl: not ours to prune
+                    key = (plural, ns if namespaced else "",
+                           live.metadata.name)
+                    if key in applied:
+                        continue
+                    await client.delete(plural, key[1], live.metadata.name)
+                    print(f"{live.kind.lower()}/{live.metadata.name} pruned")
         return 0
+    finally:
+        await client.close()
+
+
+async def cmd_edit(args) -> int:
+    """kubectl edit: fetch -> $EDITOR -> CAS update. The buffer carries
+    the live resource_version, so a concurrent writer surfaces as a
+    conflict instead of a silent overwrite (reference:
+    pkg/kubectl/cmd/edit.go)."""
+    import subprocess
+    import tempfile
+
+    import yaml
+    client = make_client(args)
+    try:
+        plural = resolve_plural(args.resource)
+        ns = args.namespace
+        cur = await client.get(plural, ns, args.name)
+        cur_dict = to_dict(cur)
+        # Decoded objects may carry empty TypeMeta (the wire stamps it,
+        # the dataclass default is "") — without kind in the buffer the
+        # re-decode would fall back to CustomResource.
+        if not cur_dict.get("kind") or not cur_dict.get("api_version"):
+            av, kind = DEFAULT_SCHEME.gvk_for(cur)
+            cur_dict.setdefault("kind", kind)
+            cur_dict.setdefault("api_version", av)
+            cur_dict = {"kind": cur_dict.pop("kind"),
+                        "api_version": cur_dict.pop("api_version"),
+                        **cur_dict}
+        doc = yaml.safe_dump(cur_dict, sort_keys=False)
+        editor = (os.environ.get("KTL_EDITOR")
+                  or os.environ.get("EDITOR") or "vi")
+        with tempfile.NamedTemporaryFile(
+                "w+", suffix=".yaml", prefix=f"ktl-edit-{args.name}-",
+                delete=False) as f:
+            f.write(f"# Editing {plural}/{args.name}. Lines starting "
+                    f"with '#' are ignored; an empty file aborts.\n")
+            f.write(doc)
+            path = f.name
+        try:
+            import shlex
+            # editor stays unquoted (EDITOR may carry flags); the path
+            # must be quoted or a TMPDIR with spaces word-splits it.
+            rc = await asyncio.to_thread(
+                subprocess.call, f"{editor} {shlex.quote(path)}",
+                shell=True)
+            if rc != 0:
+                print(f"Error: editor exited {rc}; edit aborted "
+                      f"(buffer kept at {path})", file=sys.stderr)
+                return 1
+            with open(path) as f:
+                text = "\n".join(ln for ln in f.read().splitlines()
+                                 if not ln.lstrip().startswith("#"))
+            if not text.strip():
+                print("Edit cancelled (empty file).")
+                return 0
+            raw = yaml.safe_load(text)
+            if not isinstance(raw, dict):
+                print(f"Error: buffer must be a YAML mapping, got "
+                      f"{type(raw).__name__} (kept at {path})",
+                      file=sys.stderr)
+                return 1
+            if cur_dict == raw:
+                print("Edit cancelled, no changes made.")
+                return 0
+            from ..client.rest import decode_obj
+            if (raw.get("kind") != cur_dict["kind"]
+                    or raw.get("api_version") != cur_dict["api_version"]):
+                # Editing identity is not editing the object; an
+                # unregistered kind would otherwise decode into the
+                # CustomResource fallback and fail later with a
+                # confusing scheme error.
+                print(f"Error: kind/api_version may not be changed by "
+                      f"edit (buffer kept at {path})", file=sys.stderr)
+                return 1
+            edited = decode_obj(raw)
+            # Keep the fetched CAS token even if the user deleted the
+            # metadata block; a user-edited one is respected (it's how
+            # you deliberately force a conflict check against older).
+            if not edited.metadata.resource_version:
+                edited.metadata.resource_version = \
+                    cur.metadata.resource_version
+            try:
+                await client.update(edited)
+            except errors.ConflictError:
+                print(f"Error: {plural}/{args.name} changed while you "
+                      f"were editing; re-run ktl edit (your buffer is "
+                      f"kept at {path})", file=sys.stderr)
+                return 1
+            except errors.StatusError as e:
+                print(f"Error: {e} (your buffer is kept at {path})",
+                      file=sys.stderr)
+                return 1
+            print(f"{edited.kind.lower()}/{args.name} edited")
+            os.unlink(path)
+            return 0
+        except yaml.YAMLError as e:
+            print(f"Error: buffer is not valid YAML: {e} (kept at "
+                  f"{path})", file=sys.stderr)
+            return 1
     finally:
         await client.close()
 
@@ -1556,6 +1707,17 @@ def build_parser() -> argparse.ArgumentParser:
     sp = add("apply", cmd_apply, help="create-or-update from manifest")
     sp.add_argument("-f", "--filename", required=True,
                     help="YAML/JSON file ('-' = stdin)")
+    sp.add_argument("-n", "--namespace", default="default")
+    sp.add_argument("-l", "--selector", default="",
+                    help="label selector bounding --prune")
+    sp.add_argument("--prune", action="store_true", default=False,
+                    help="delete selector-matching ktl-applied objects "
+                         "absent from this file set")
+
+    sp = add("edit", cmd_edit,
+             help="edit a live object in $EDITOR (KTL_EDITOR wins)")
+    sp.add_argument("resource")
+    sp.add_argument("name")
     sp.add_argument("-n", "--namespace", default="default")
 
     sp = add("delete", cmd_delete, help="delete resources")

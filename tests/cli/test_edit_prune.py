@@ -1,0 +1,216 @@
+"""ktl edit (EDITOR round-trip, CAS conflict) and ktl apply --prune
+(reference: pkg/kubectl/cmd/{edit,apply}.go)."""
+import asyncio
+import contextlib
+import io
+import os
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.cli import ktl
+
+
+async def ktl_out(args, server):
+    buf, err = io.StringIO(), io.StringIO()
+
+    def call():
+        with contextlib.redirect_stdout(buf), contextlib.redirect_stderr(err):
+            return ktl.main(["--server", server] + args)
+    rc = await asyncio.to_thread(call)
+    return rc, buf.getvalue(), err.getvalue()
+
+
+async def start_server():
+    srv = APIServer()
+    srv.registry.create(t.Namespace(metadata=ObjectMeta(name="default")))
+    srv.registry.create(t.ConfigMap(
+        metadata=ObjectMeta(name="cm", namespace="default"),
+        data={"color": "blue"}))
+    port = await srv.start()
+    return srv, f"http://127.0.0.1:{port}"
+
+
+def _manifests(tmp_path, names, labels='{app: demo}'):
+    docs = []
+    for n in names:
+        docs.append(f"""kind: ConfigMap
+api_version: core/v1
+metadata:
+  name: {n}
+  namespace: default
+  labels: {labels}
+data:
+  k: v
+""")
+    p = tmp_path / "set.yaml"
+    p.write_text("---\n".join(docs))
+    return str(p)
+
+
+class TestEdit:
+    async def test_edit_round_trip(self, tmp_path):
+        srv, base = await start_server()
+        try:
+            # "Editor" = sed swapping blue -> green.
+            os.environ["KTL_EDITOR"] = "sed -i s/blue/green/"
+            rc, out, err = await ktl_out(
+                ["edit", "configmap", "cm"], base)
+            assert rc == 0, err
+            assert "edited" in out
+            assert srv.registry.get("configmaps", "default",
+                                    "cm").data["color"] == "green"
+        finally:
+            os.environ.pop("KTL_EDITOR", None)
+            await srv.stop()
+
+    async def test_edit_no_change_cancels(self, tmp_path):
+        srv, base = await start_server()
+        try:
+            os.environ["KTL_EDITOR"] = "true"  # touch nothing
+            rc, out, err = await ktl_out(["edit", "configmap", "cm"], base)
+            assert rc == 0, err
+            assert "no changes" in out
+        finally:
+            os.environ.pop("KTL_EDITOR", None)
+            await srv.stop()
+
+    async def test_edit_conflict_is_loud(self, tmp_path):
+        srv, base = await start_server()
+        try:
+            # "Editor" mutates the buffer AND a concurrent writer bumps
+            # the live object -> CAS conflict.
+            script = tmp_path / "editor.sh"
+            script.write_text("#!/bin/sh\nsed -i s/blue/green/ \"$1\"\n")
+            script.chmod(0o755)
+            os.environ["KTL_EDITOR"] = f"{script} "
+
+            orig_call = __import__("subprocess").call
+
+            def racing_call(cmd, shell=False):
+                cm = srv.registry.get("configmaps", "default", "cm")
+                cm.data["color"] = "red"
+                srv.registry.update(cm)
+                return orig_call(cmd, shell=shell)
+
+            import subprocess
+            subprocess.call, saved = racing_call, subprocess.call
+            try:
+                rc, out, err = await ktl_out(
+                    ["edit", "configmap", "cm"], base)
+            finally:
+                subprocess.call = saved
+            assert rc == 1
+            assert "changed while you were editing" in err
+            assert srv.registry.get("configmaps", "default",
+                                    "cm").data["color"] == "red"
+        finally:
+            os.environ.pop("KTL_EDITOR", None)
+            await srv.stop()
+
+
+class TestEditEdgeCases:
+    async def test_non_dict_buffer_is_clean_error(self, tmp_path):
+        srv, base = await start_server()
+        try:
+            script = tmp_path / "wreck.sh"
+            script.write_text('#!/bin/sh\necho oops > "$1"\n')
+            script.chmod(0o755)
+            os.environ["KTL_EDITOR"] = str(script)
+            rc, out, err = await ktl_out(["edit", "configmap", "cm"], base)
+            assert rc == 1
+            assert "YAML mapping" in err
+        finally:
+            os.environ.pop("KTL_EDITOR", None)
+            await srv.stop()
+
+    async def test_identity_change_rejected(self, tmp_path):
+        srv, base = await start_server()
+        try:
+            script = tmp_path / "rekind.sh"
+            script.write_text(
+                '#!/bin/sh\nsed -i s/ConfigMap/Secret/ "$1"\n')
+            script.chmod(0o755)
+            os.environ["KTL_EDITOR"] = str(script)
+            rc, out, err = await ktl_out(["edit", "configmap", "cm"], base)
+            assert rc == 1
+            assert "may not be changed" in err
+        finally:
+            os.environ.pop("KTL_EDITOR", None)
+            await srv.stop()
+
+
+class TestApplyPrune:
+    async def test_apply_with_null_annotations(self, tmp_path):
+        srv, base = await start_server()
+        try:
+            p = tmp_path / "null-ann.yaml"
+            p.write_text("""kind: ConfigMap
+api_version: core/v1
+metadata:
+  name: nullann
+  namespace: default
+  annotations: null
+data: {}
+""")
+            rc, out, err = await ktl_out(["apply", "-f", str(p)], base)
+            assert rc == 0, err
+            got = srv.registry.get("configmaps", "default", "nullann")
+            assert ktl.LAST_APPLIED in got.metadata.annotations
+        finally:
+            await srv.stop()
+
+    async def test_prune_deletes_absent_applied_objects(self, tmp_path):
+        srv, base = await start_server()
+        try:
+            rc, out, err = await ktl_out(
+                ["apply", "-f", _manifests(tmp_path, ["a", "b"])], base)
+            assert rc == 0, err
+            rc, out, err = await ktl_out(
+                ["apply", "-f", _manifests(tmp_path, ["a"]),
+                 "-l", "app=demo", "--prune"], base)
+            assert rc == 0, err
+            assert "configmap/b pruned" in out
+            names = {c.metadata.name
+                     for c in srv.registry.list("configmaps", "default")[0]}
+            assert "a" in names and "b" not in names
+            # cm was never ktl-applied and has no matching label: kept.
+            assert "cm" in names
+        finally:
+            await srv.stop()
+
+    async def test_prune_never_touches_unannotated_or_unselected(
+            self, tmp_path):
+        srv, base = await start_server()
+        try:
+            # Hand-created object WITH the selector label but no
+            # last-applied annotation: prune must not delete it.
+            srv.registry.create(t.ConfigMap(
+                metadata=ObjectMeta(name="handmade", namespace="default",
+                                    labels={"app": "demo"}),
+                data={}))
+            # ktl-applied object with a DIFFERENT label: out of scope.
+            rc, _out, err = await ktl_out(
+                ["apply", "-f", _manifests(tmp_path, ["other"],
+                                           labels="{app: else}")], base)
+            assert rc == 0, err
+            rc, out, err = await ktl_out(
+                ["apply", "-f", _manifests(tmp_path, ["a"]),
+                 "-l", "app=demo", "--prune"], base)
+            assert rc == 0, err
+            names = {c.metadata.name
+                     for c in srv.registry.list("configmaps", "default")[0]}
+            assert {"handmade", "other", "a"} <= names
+        finally:
+            await srv.stop()
+
+    async def test_prune_requires_selector(self, tmp_path):
+        srv, base = await start_server()
+        try:
+            rc, out, err = await ktl_out(
+                ["apply", "-f", _manifests(tmp_path, ["a"]), "--prune"],
+                base)
+            assert rc == 1
+            assert "requires -l" in err
+        finally:
+            await srv.stop()
