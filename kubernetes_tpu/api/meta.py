@@ -38,6 +38,14 @@ def new_uid() -> str:
     return str(uuid.uuid4())
 
 
+#: Deletion-propagation finalizers (reference: metav1.FinalizerOrphan-
+#: Dependents / FinalizerDeleteDependents). Set by the registry when a
+#: DELETE carries propagationPolicy Orphan/Foreground; processed by the
+#: garbage collector, which then clears them to complete the deletion.
+FINALIZER_ORPHAN = "orphan"
+FINALIZER_FOREGROUND = "foregroundDeletion"
+
+
 @dataclass
 class OwnerReference:
     """Backpointer used by the garbage collector and controller adoption.

@@ -653,8 +653,15 @@ class Registry:
         # Finalizer-driven actual deletion: once an object marked for
         # deletion has no finalizers left, the update removes it.
         ns_finalizers = (isinstance(new, t.Namespace) and new.spec.finalizers)
+        # Scheduled pods keep their graceful contract: clearing the last
+        # finalizer must hand the pod to the node agent's termination
+        # flow (grace-0 confirmation completes it), not hard-delete a
+        # pod whose containers are still running.
+        graceful_pod = (spec.graceful_delete and isinstance(new, t.Pod)
+                        and bool(new.spec.node_name))
         if new.metadata.deletion_timestamp is not None \
-                and not new.metadata.finalizers and not ns_finalizers:
+                and not new.metadata.finalizers and not ns_finalizers \
+                and not graceful_pod:
             self.store.delete(key, expected_revision=stored.mod_revision)
             self._release_ips(new)
             if isinstance(new, ext.CustomResourceDefinition):
@@ -733,7 +740,15 @@ class Registry:
 
     def delete(self, plural: str, namespace: str, name: str,
                grace_period_seconds: Optional[int] = None,
-               preconditions_uid: str = "") -> TypedObject:
+               preconditions_uid: str = "",
+               propagation_policy: str = "") -> TypedObject:
+        """``propagation_policy``: "" / "Background" (delete now, GC
+        cascades later — the default), "Orphan" (GC strips dependents'
+        owner refs so they survive), "Foreground" (GC deletes
+        dependents FIRST; the owner stays terminating until none
+        remain). Reference: metav1.DeletionPropagation, carried as the
+        orphan/foregroundDeletion finalizers so a crash mid-cascade
+        resumes instead of leaking."""
         spec = self.spec_for(plural)
         key = self._key(spec, namespace, name)
         stored = self.store.get(key, copy=False)
@@ -741,6 +756,23 @@ class Registry:
         if preconditions_uid and obj.metadata.uid != preconditions_uid:
             raise errors.ConflictError(
                 f"uid precondition failed: have {obj.metadata.uid}, want {preconditions_uid}")
+        if propagation_policy not in ("", "Background", "Orphan",
+                                      "Foreground"):
+            raise errors.BadRequestError(
+                f"propagation_policy must be Background, Orphan, or "
+                f"Foreground; got {propagation_policy!r}")
+        from ..api.meta import FINALIZER_FOREGROUND, FINALIZER_ORPHAN
+        want_fin = {"Orphan": FINALIZER_ORPHAN,
+                    "Foreground": FINALIZER_FOREGROUND}.get(propagation_policy)
+        if want_fin and want_fin not in obj.metadata.finalizers:
+            obj.metadata.finalizers.append(want_fin)
+            if obj.metadata.deletion_timestamp is not None:
+                # Already terminating: the no-op branches below would
+                # silently drop the just-requested policy — persist it.
+                rev = self.store.update(key, self._encode(obj),
+                                        expected_revision=stored.mod_revision)
+                obj.metadata.resource_version = str(rev)
+                return obj
         graceful = spec.graceful_delete and (grace_period_seconds is None or grace_period_seconds > 0)
         # Namespace deletion is finalizer-gated via spec.finalizers: the
         # namespace controller purges contents, then clears them

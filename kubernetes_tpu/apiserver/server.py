@@ -1310,7 +1310,8 @@ class APIServer:
         obj = await self._mutate(
             self.registry.delete, plural, ns, name,
             self._int_param(gp, "grace_period_seconds") if gp is not None else None,
-            request.query.get("uid", ""))
+            request.query.get("uid", ""),
+            request.query.get("propagation_policy", ""))
         if plural.endswith("webhookconfigurations"):
             self.webhooks.invalidate()
         return self._obj_response(obj, convert=del_conv)

@@ -447,8 +447,14 @@ def _namespaced(obj) -> bool:
     return _BY_PLURAL[_plural_of(obj)][1]
 
 
+#: --cascade spelling -> DeleteOptions propagationPolicy.
+_CASCADE = {"background": "Background", "foreground": "Foreground",
+            "orphan": "Orphan"}
+
+
 async def cmd_delete(args) -> int:
     client = make_client(args)
+    policy = _CASCADE.get(getattr(args, "cascade", "background"), "")
     try:
         if args.filename:
             for obj in load_manifests(args.filename):
@@ -456,13 +462,15 @@ async def cmd_delete(args) -> int:
                 plural = _plural_of(obj)
                 try:
                     await client.delete(plural, ns if _namespaced(obj) else "",
-                                        obj.metadata.name)
+                                        obj.metadata.name,
+                                        propagation_policy=policy)
                     print(f"{obj.kind.lower()}/{obj.metadata.name} deleted")
                 except errors.NotFoundError:
                     print(f"{obj.kind.lower()}/{obj.metadata.name} not found")
             return 0
         plural = resolve_plural(args.resource)
-        await client.delete(plural, args.namespace, args.name)
+        await client.delete(plural, args.namespace, args.name,
+                            propagation_policy=policy)
         print(f"{plural}/{args.name} deleted")
         return 0
     finally:
@@ -1725,6 +1733,11 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("name", nargs="?", default="")
     sp.add_argument("-f", "--filename", default="")
     sp.add_argument("-n", "--namespace", default="default")
+    sp.add_argument("--cascade", default="background",
+                    choices=sorted(_CASCADE),
+                    help="dependent handling: background (GC cascades "
+                         "after), foreground (dependents first), orphan "
+                         "(dependents survive)")
 
     sp = add("logs", cmd_logs, help="pod container logs")
     sp.add_argument("pod")

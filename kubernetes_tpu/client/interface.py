@@ -62,7 +62,8 @@ class Client:
         raise NotImplementedError
 
     async def delete(self, plural: str, namespace: str, name: str,
-                     grace_period_seconds: Optional[int] = None, uid: str = "") -> Any:
+                     grace_period_seconds: Optional[int] = None, uid: str = "",
+                     propagation_policy: str = "") -> Any:
         raise NotImplementedError
 
     async def watch(self, plural: str, namespace: str = "", resource_version: int = 0,

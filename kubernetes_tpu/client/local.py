@@ -57,9 +57,11 @@ class LocalClient(Client):
             strategic)
 
     async def delete(self, plural: str, namespace: str, name: str,
-                     grace_period_seconds: Optional[int] = None, uid: str = "") -> Any:
+                     grace_period_seconds: Optional[int] = None, uid: str = "",
+                     propagation_policy: str = "") -> Any:
         return await self._call(
-            self.registry.delete, plural, namespace, name, grace_period_seconds, uid)
+            self.registry.delete, plural, namespace, name,
+            grace_period_seconds, uid, propagation_policy)
 
     async def watch(self, plural: str, namespace: str = "", resource_version: int = 0,
                     label_selector: str = "", field_selector: str = "") -> WatchStream:

@@ -397,7 +397,8 @@ class RESTClient(Client):
         return decode_obj(data)
 
     async def delete(self, plural: str, namespace: str, name: str,
-                     grace_period_seconds: Optional[int] = None, uid: str = "") -> Any:
+                     grace_period_seconds: Optional[int] = None, uid: str = "",
+                     propagation_policy: str = "") -> Any:
         av, namespaced = await self._plural_info(plural)
         url = self._url_for(av, plural, namespace if namespaced else "", name)
         params = {}
@@ -405,6 +406,8 @@ class RESTClient(Client):
             params["grace_period_seconds"] = str(grace_period_seconds)
         if uid:
             params["uid"] = uid
+        if propagation_policy:
+            params["propagation_policy"] = propagation_policy
         async with self._sess().delete(url, params=params) as resp:
             data = await self._check(resp)
         return decode_obj(data)

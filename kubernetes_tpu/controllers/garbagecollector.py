@@ -14,6 +14,8 @@ import asyncio
 from typing import Optional
 
 from ..api import errors
+from ..api.meta import FINALIZER_FOREGROUND, FINALIZER_ORPHAN
+from ..api.scheme import deepcopy
 from ..client.informer import InformerFactory
 from ..client.interface import Client
 from .base import Controller
@@ -53,7 +55,17 @@ class GarbageCollector(Controller):
             inf = self.watch(plural)
             self._informers_by_plural[plural] = inf
             # A deletion anywhere may orphan dependents: sweep soon.
-            inf.add_handlers(on_delete=lambda obj: self.enqueue("sweep"))
+            # An object turning terminating-with-propagation-finalizer
+            # is only an UPDATE — without reacting to it, every stage
+            # of an orphan/foreground cascade would wait out the full
+            # sweep interval (4 stages of a Deployment tree = 40s).
+            inf.add_handlers(
+                on_delete=lambda obj: self.enqueue("sweep"),
+                on_update=lambda old, new: self.enqueue("sweep")
+                if (new.metadata.deletion_timestamp is not None
+                    and (FINALIZER_ORPHAN in new.metadata.finalizers
+                         or FINALIZER_FOREGROUND in new.metadata.finalizers))
+                else None)
         self._task: Optional[asyncio.Task] = None
 
     async def on_start(self) -> None:
@@ -81,7 +93,12 @@ class GarbageCollector(Controller):
         uids: set[str] = set()
         for inf in self._informers_by_plural.values():
             for obj in inf.list():
-                if obj.metadata.deletion_timestamp is None:
+                if (obj.metadata.deletion_timestamp is None
+                        # Terminating-with-orphan counts as alive: its
+                        # dependents are pending ORPHANING — collecting
+                        # them before the refs are stripped would defeat
+                        # the requested policy.
+                        or FINALIZER_ORPHAN in obj.metadata.finalizers):
                     uids.add(obj.metadata.uid)
         return uids
 
@@ -106,9 +123,109 @@ class GarbageCollector(Controller):
             # dependent until a later pass can confirm.
             return True
         return (owner.metadata.uid == ref.uid
-                and owner.metadata.deletion_timestamp is None)
+                and (owner.metadata.deletion_timestamp is None
+                     or FINALIZER_ORPHAN in owner.metadata.finalizers))
+
+    def _dependents_of(self, uid: str) -> list:
+        """(plural, obj) for every cached object owner-referencing uid."""
+        out = []
+        for plural, inf in self._informers_by_plural.items():
+            for obj in inf.list():
+                if any(ref.uid == uid for ref in obj.metadata.owner_references):
+                    out.append((plural, obj))
+        return out
+
+    async def _live_dependents_of(self, uid: str, namespace: str) -> list:
+        """Dependents confirmed against the API, not caches: clearing a
+        propagation finalizer off stale caches would orphan-delete (or
+        complete a foreground owner) against the requested policy — the
+        same cross-cache race _owner_alive documents, on the other side.
+        Only called for owners carrying a propagation finalizer, so the
+        per-plural lists are rare."""
+        out = []
+        for plural in self._informers_by_plural:
+            try:
+                objs, _rev = await self.client.list(plural, namespace)
+            except Exception:  # noqa: BLE001 — unreadable plural: be
+                continue      # conservative, caches cover it next sweep
+            for obj in objs:
+                if any(ref.uid == uid
+                       for ref in obj.metadata.owner_references):
+                    out.append((plural, obj))
+        return out
+
+    async def _process_propagation(self) -> None:
+        """Terminating owners carrying the orphan/foregroundDeletion
+        finalizer (set by DELETE propagationPolicy; reference
+        garbagecollector.go attemptToOrphan / attemptToDeleteItem's
+        blocking-dependents path). Orphan: strip dependents' owner refs
+        so they survive, then clear the finalizer. Foreground: delete
+        dependents first (transitively foreground); the owner completes
+        only when none remain. Per-owner failures are isolated — one
+        webhook-rejected update must not wedge collection cluster-wide."""
+        for plural, inf in self._informers_by_plural.items():
+            for obj in inf.list():
+                if obj.metadata.deletion_timestamp is None:
+                    continue
+                fins = obj.metadata.finalizers
+                if (FINALIZER_ORPHAN not in fins
+                        and FINALIZER_FOREGROUND not in fins):
+                    continue
+                try:
+                    await self._propagate_one(plural, obj)
+                except Exception as e:  # noqa: BLE001
+                    import logging
+                    logging.getLogger("garbagecollector").warning(
+                        "propagation for %s/%s failed (retrying next "
+                        "sweep): %s", plural, obj.metadata.name, e)
+
+    async def _propagate_one(self, plural: str, obj) -> None:
+        uid = obj.metadata.uid
+        ns = obj.metadata.namespace
+        if FINALIZER_ORPHAN in obj.metadata.finalizers:
+            ok = True
+            for dep_plural, dep in await self._live_dependents_of(uid, ns):
+                patched = deepcopy(dep)
+                patched.metadata.owner_references = [
+                    r for r in patched.metadata.owner_references
+                    if r.uid != uid]
+                try:
+                    await self.client.update(patched)
+                except errors.ConflictError:
+                    ok = False  # retry next sweep with fresh obj
+                except errors.NotFoundError:
+                    pass
+            if ok:
+                await self._clear_finalizer(plural, obj, FINALIZER_ORPHAN)
+            return
+        deps = await self._live_dependents_of(uid, ns)
+        for dep_plural, dep in deps:
+            if dep.metadata.deletion_timestamp is not None:
+                continue
+            try:
+                # Transitive: the whole dependent TREE must be gone
+                # before this owner completes (reference foreground
+                # guarantee), so dependents foreground-delete too.
+                await self.client.delete(
+                    dep_plural, dep.metadata.namespace,
+                    dep.metadata.name, uid=dep.metadata.uid,
+                    propagation_policy="Foreground")
+            except (errors.NotFoundError, errors.ConflictError):
+                pass
+        if not deps:
+            await self._clear_finalizer(plural, obj, FINALIZER_FOREGROUND)
+
+    async def _clear_finalizer(self, plural: str, obj, fin: str) -> None:
+        patched = deepcopy(obj)
+        patched.metadata.finalizers = [
+            f for f in patched.metadata.finalizers if f != fin]
+        try:
+            await self.client.update(patched)
+        except (errors.ConflictError, errors.NotFoundError):
+            pass  # next sweep retries against fresh state
 
     async def sweep_once(self) -> None:
+        await self._process_propagation()
         live = self._live_uids()
         for plural, inf in self._informers_by_plural.items():
             for obj in inf.list():

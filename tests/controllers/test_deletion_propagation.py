@@ -1,0 +1,121 @@
+"""Deletion propagation policies (reference: metav1.DeletionPropagation
++ the GC's attemptToOrphan / blocking-dependents paths)."""
+import pytest
+
+from kubernetes_tpu.api import errors
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api import workloads as w
+from kubernetes_tpu.api.meta import (
+    FINALIZER_FOREGROUND, FINALIZER_ORPHAN, ObjectMeta, controller_ref)
+from kubernetes_tpu.api.selectors import LabelSelector
+from kubernetes_tpu.controllers.garbagecollector import GarbageCollector
+
+from .util import make_plane, pod_template, wait_for
+
+
+def mk_rs(name):
+    return w.ReplicaSet(
+        metadata=ObjectMeta(name=name, namespace="default"),
+        spec=w.ReplicaSetSpec(
+            replicas=0, selector=LabelSelector(match_labels={"app": name}),
+            template=pod_template({"app": name})))
+
+
+def mk_pod(name, owner):
+    return t.Pod(metadata=ObjectMeta(
+        name=name, namespace="default",
+        owner_references=[controller_ref(owner, w.APPS_V1, "ReplicaSet")]),
+        spec=t.PodSpec(containers=[t.Container(name="c", image="i")]))
+
+
+async def test_orphan_strips_refs_and_dependents_survive():
+    reg, client, factory = make_plane()
+    gc = GarbageCollector(client, factory, interval=0.05)
+    await gc.start()
+    try:
+        rs = reg.create(mk_rs("keepers"))
+        reg.create(mk_pod("keeper-0", rs))
+        out = reg.delete("replicasets", "default", "keepers",
+                         propagation_policy="Orphan")
+        assert FINALIZER_ORPHAN in out.metadata.finalizers
+        assert out.metadata.deletion_timestamp is not None
+
+        def owner_gone_pod_alive():
+            try:
+                reg.get("replicasets", "default", "keepers")
+                return False
+            except errors.NotFoundError:
+                pass
+            pod = reg.get("pods", "default", "keeper-0")
+            return (not pod.metadata.owner_references
+                    and pod.metadata.deletion_timestamp is None)
+        await wait_for(owner_gone_pod_alive, timeout=8.0)
+        # The orphaned pod stays orphaned: further sweeps don't collect.
+        import asyncio
+        await asyncio.sleep(0.3)
+        assert reg.get("pods", "default",
+                       "keeper-0").metadata.deletion_timestamp is None
+    finally:
+        await gc.stop()
+
+
+async def test_foreground_deletes_dependents_first():
+    reg, client, factory = make_plane()
+    gc = GarbageCollector(client, factory, interval=0.05)
+    await gc.start()
+    try:
+        rs = reg.create(mk_rs("fg"))
+        reg.create(mk_pod("fg-0", rs))
+        out = reg.delete("replicasets", "default", "fg",
+                         propagation_policy="Foreground")
+        assert FINALIZER_FOREGROUND in out.metadata.finalizers
+        # Owner must remain (terminating) while the dependent exists,
+        # then both disappear — dependent strictly first.
+        saw_terminating_owner_with_dependent = []
+
+        def both_gone():
+            dep_exists = True
+            try:
+                reg.get("pods", "default", "fg-0")
+            except errors.NotFoundError:
+                dep_exists = False
+            try:
+                owner = reg.get("replicasets", "default", "fg")
+                if dep_exists and owner.metadata.deletion_timestamp:
+                    saw_terminating_owner_with_dependent.append(True)
+                return False
+            except errors.NotFoundError:
+                return not dep_exists
+        await wait_for(both_gone, timeout=8.0)
+        assert saw_terminating_owner_with_dependent
+    finally:
+        await gc.stop()
+
+
+async def test_background_still_cascades():
+    reg, client, factory = make_plane()
+    gc = GarbageCollector(client, factory, interval=0.05)
+    await gc.start()
+    try:
+        rs = reg.create(mk_rs("bg"))
+        reg.create(mk_pod("bg-0", rs))
+        reg.delete("replicasets", "default", "bg",
+                   propagation_policy="Background")
+
+        def gone():
+            try:
+                reg.get("pods", "default", "bg-0")
+                return False
+            except errors.NotFoundError:
+                return True
+        await wait_for(gone, timeout=8.0)
+    finally:
+        await gc.stop()
+
+
+async def test_bad_policy_rejected():
+    reg, _client, _factory = make_plane()
+    reg.create(mk_rs("x"))
+    with pytest.raises(errors.BadRequestError, match="propagation_policy"):
+        reg.delete("replicasets", "default", "x",
+                   propagation_policy="Sideways")
