@@ -29,6 +29,7 @@ import sys
 from typing import Any, Optional
 
 from ..api import errors, types as t
+from ..api.meta import ObjectMeta
 from ..api.scheme import DEFAULT_SCHEME, to_dict
 from ..client.rest import RESTClient
 from . import printers
@@ -1360,6 +1361,26 @@ async def cmd_rollout(args) -> int:
                 print(f"{rev:<10}{rs.metadata.name:<40}{rs.spec.replicas}")
             return 0
 
+        if args.action in ("pause", "resume"):
+            want = args.action == "pause"
+            for attempt in range(20):
+                dep = await client.get("deployments", ns, name)
+                if dep.spec.paused == want:
+                    print(f"deployment {name!r} already "
+                          f"{'paused' if want else 'resumed'}")
+                    return 0
+                dep.spec.paused = want
+                try:
+                    await client.update(dep)
+                    print(f"deployment {name!r} "
+                          f"{'paused' if want else 'resumed'}")
+                    return 0
+                except errors.ConflictError:
+                    if attempt == 19:
+                        raise
+                    await asyncio.sleep(0.05)
+            return 1
+
         # undo
         rss = await owned_replicasets()
         if not rss:
@@ -1406,6 +1427,124 @@ async def cmd_rollout(args) -> int:
                 dep = await client.get("deployments", ns, name)
         rev = target.metadata.annotations.get(REVISION_ANNOTATION, "?")
         print(f"deployment {name!r} rolled back to revision {rev}")
+        return 0
+    finally:
+        await client.close()
+
+
+async def cmd_run(args) -> int:
+    """``ktl run NAME --image=IMG`` — imperative pod (default) or, with
+    ``--restart=Always``, a Deployment (reference: kubectl run's
+    generator selection in pkg/kubectl/run.go)."""
+    from ..api import workloads as w
+    from ..api.selectors import LabelSelector
+    client = make_client(args)
+    try:
+        labels = {"run": args.name}
+        for e in args.env or []:
+            if "=" not in e:
+                print(f"Error: --env wants KEY=VALUE, got {e!r}",
+                      file=sys.stderr)
+                return 1
+        container = t.Container(
+            name=args.name, image=args.image,
+            command=list(args.cmd or []),
+            env=[t.EnvVar(name=k, value=v) for k, v in
+                 (e.split("=", 1) for e in args.env or [])])
+        if args.port:
+            container.ports = [t.ContainerPort(container_port=args.port)]
+        if args.restart == "Always":
+            dep = w.Deployment(
+                metadata=ObjectMeta(name=args.name, namespace=args.namespace,
+                                    labels=dict(labels)),
+                spec=w.DeploymentSpec(
+                    replicas=args.replicas,
+                    selector=LabelSelector(match_labels=dict(labels)),
+                    template=t.PodTemplateSpec(
+                        metadata=ObjectMeta(labels=dict(labels)),
+                        spec=t.PodSpec(containers=[container]))))
+            await client.create(dep)
+            print(f"deployment/{args.name} created")
+        else:
+            pod = t.Pod(
+                metadata=ObjectMeta(name=args.name, namespace=args.namespace,
+                                    labels=dict(labels)),
+                spec=t.PodSpec(containers=[container],
+                               restart_policy=args.restart))
+            await client.create(pod)
+            print(f"pod/{args.name} created")
+        return 0
+    finally:
+        await client.close()
+
+
+async def cmd_expose(args) -> int:
+    """``ktl expose deployment NAME --port=P`` — Service from a
+    workload's selector (reference: kubectl expose / service
+    generators)."""
+    client = make_client(args)
+    try:
+        plural = resolve_plural(args.resource)
+        obj = await client.get(plural, args.namespace, args.name)
+        if plural == "pods":
+            selector = dict(obj.metadata.labels)
+        else:
+            raw_sel = getattr(obj.spec, "selector", None)
+            if isinstance(raw_sel, dict):  # Service-style plain map
+                selector = dict(raw_sel)
+            elif raw_sel is not None and hasattr(raw_sel, "match_labels"):
+                selector = dict(raw_sel.match_labels)
+                if not selector and getattr(raw_sel, "match_expressions",
+                                            None):
+                    print(f"Error: {plural}/{args.name} selects only by "
+                          f"expressions; a Service needs equality labels",
+                          file=sys.stderr)
+                    return 1
+            else:
+                selector = {}
+        if not selector:
+            print(f"Error: {plural}/{args.name} has no selector/labels "
+                  f"to expose", file=sys.stderr)
+            return 1
+        svc = t.Service(
+            metadata=ObjectMeta(name=args.service_name or args.name,
+                                namespace=args.namespace,
+                                labels=dict(obj.metadata.labels)),
+            spec=t.ServiceSpec(
+                selector=selector,
+                type=args.type,
+                ports=[t.ServicePort(
+                    port=args.port,
+                    target_port=args.target_port or args.port)]))
+        await client.create(svc)
+        print(f"service/{svc.metadata.name} exposed")
+        return 0
+    finally:
+        await client.close()
+
+
+async def cmd_autoscale(args) -> int:
+    """``ktl autoscale deployment NAME --min --max [--cpu-percent]`` —
+    creates an HPA targeting the workload (reference: kubectl
+    autoscale)."""
+    from ..api import workloads as w
+    client = make_client(args)
+    try:
+        plural = resolve_plural(args.resource)
+        obj = await client.get(plural, args.namespace, args.name)
+        if args.max < max(args.min, 1):
+            print("Error: --max must be >= --min and >= 1",
+                  file=sys.stderr)
+            return 1
+        hpa = w.HorizontalPodAutoscaler(
+            metadata=ObjectMeta(name=args.name, namespace=args.namespace),
+            spec=w.HorizontalPodAutoscalerSpec(
+                scale_target_ref=w.CrossVersionObjectReference(
+                    kind=obj.kind or "Deployment", name=args.name),
+                min_replicas=args.min, max_replicas=args.max,
+                target_cpu_utilization_percentage=args.cpu_percent))
+        await client.create(hpa)
+        print(f"horizontalpodautoscaler/{args.name} autoscaled")
         return 0
     finally:
         await client.close()
@@ -1839,12 +1978,49 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("-n", "--namespace", default="default")
 
     sp = add("rollout", cmd_rollout, help="status/history/undo a rollout")
-    sp.add_argument("action", choices=["status", "history", "undo"])
+    sp.add_argument("action", choices=["status", "history", "undo",
+                                       "pause", "resume"])
     sp.add_argument("target", help="deployment/<name>")
     sp.add_argument("-n", "--namespace", default="default")
     sp.add_argument("--to-revision", type=int, default=0)
     sp.add_argument("--timeout", type=float, default=60.0,
                     help="status wait bound (seconds)")
+
+    sp = add("run", cmd_run, help="run an image as a pod (or deployment)")
+    sp.add_argument("name")
+    sp.add_argument("--image", required=True)
+    sp.add_argument("-n", "--namespace", default="default")
+    sp.add_argument("--restart", default="Never",
+                    choices=["Never", "OnFailure", "Always"],
+                    help="Always creates a Deployment")
+    sp.add_argument("--replicas", type=int, default=1,
+                    help="replicas for --restart=Always")
+    sp.add_argument("--port", type=int, default=0)
+    sp.add_argument("--env", action="append", default=[],
+                    help="KEY=VALUE (repeatable)")
+    sp.add_argument("cmd", nargs="*", default=[],
+                    help="command to run (after --)")
+
+    sp = add("expose", cmd_expose,
+             help="create a Service for a workload's selector")
+    sp.add_argument("resource", help="deployment|replicaset|pod|...")
+    sp.add_argument("name")
+    sp.add_argument("--port", type=int, required=True)
+    sp.add_argument("--target-port", type=int, default=0)
+    sp.add_argument("--type", default="ClusterIP",
+                    choices=["ClusterIP", "NodePort"])
+    sp.add_argument("--name", dest="service_name", default="",
+                    help="service name (defaults to the workload's)")
+    sp.add_argument("-n", "--namespace", default="default")
+
+    sp = add("autoscale", cmd_autoscale,
+             help="create an HPA for a workload")
+    sp.add_argument("resource")
+    sp.add_argument("name")
+    sp.add_argument("--min", type=int, default=1)
+    sp.add_argument("--max", type=int, required=True)
+    sp.add_argument("--cpu-percent", type=int, default=80)
+    sp.add_argument("-n", "--namespace", default="default")
 
     sp = add("token", cmd_token, help="manage bootstrap tokens (kubeadm analog)")
     sp.add_argument("action", choices=["create", "list", "delete"])

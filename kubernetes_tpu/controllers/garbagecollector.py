@@ -126,15 +126,6 @@ class GarbageCollector(Controller):
                 and (owner.metadata.deletion_timestamp is None
                      or FINALIZER_ORPHAN in owner.metadata.finalizers))
 
-    def _dependents_of(self, uid: str) -> list:
-        """(plural, obj) for every cached object owner-referencing uid."""
-        out = []
-        for plural, inf in self._informers_by_plural.items():
-            for obj in inf.list():
-                if any(ref.uid == uid for ref in obj.metadata.owner_references):
-                    out.append((plural, obj))
-        return out
-
     async def _live_dependents_of(self, uid: str, namespace: str) -> list:
         """Dependents confirmed against the API, not caches: clearing a
         propagation finalizer off stale caches would orphan-delete (or
@@ -144,10 +135,12 @@ class GarbageCollector(Controller):
         per-plural lists are rare."""
         out = []
         for plural in self._informers_by_plural:
-            try:
-                objs, _rev = await self.client.list(plural, namespace)
-            except Exception:  # noqa: BLE001 — unreadable plural: be
-                continue      # conservative, caches cover it next sweep
+            # A failed list must ABORT this owner's propagation (the
+            # caller logs and retries next sweep): skipping the plural
+            # would clear the finalizer off an incomplete dependent
+            # set — orphaning nothing, or completing a foreground
+            # owner whose dependents still exist.
+            objs, _rev = await self.client.list(plural, namespace)
             for obj in objs:
                 if any(ref.uid == uid
                        for ref in obj.metadata.owner_references):

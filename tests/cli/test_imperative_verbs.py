@@ -1,0 +1,165 @@
+"""ktl run / expose / autoscale / rollout pause|resume (reference:
+pkg/kubectl/{run,expose,autoscale,rollout}.go)."""
+import asyncio
+import contextlib
+import io
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.cli import ktl
+
+
+async def ktl_out(args, server):
+    buf, err = io.StringIO(), io.StringIO()
+
+    def call():
+        with contextlib.redirect_stdout(buf), contextlib.redirect_stderr(err):
+            return ktl.main(["--server", server] + args)
+    rc = await asyncio.to_thread(call)
+    return rc, buf.getvalue(), err.getvalue()
+
+
+async def start_server():
+    srv = APIServer()
+    srv.registry.create(t.Namespace(metadata=ObjectMeta(name="default")))
+    port = await srv.start()
+    return srv, f"http://127.0.0.1:{port}"
+
+
+class TestRun:
+    async def test_run_pod(self):
+        srv, base = await start_server()
+        try:
+            rc, out, err = await ktl_out(
+                ["run", "worker", "--image", "train:v1", "--env", "A=1",
+                 "--port", "8080", "--", "python", "train.py"], base)
+            assert rc == 0, err
+            pod = srv.registry.get("pods", "default", "worker")
+            c = pod.spec.containers[0]
+            assert c.image == "train:v1"
+            assert c.command == ["python", "train.py"]
+            assert c.env[0].name == "A" and c.env[0].value == "1"
+            assert c.ports[0].container_port == 8080
+            assert pod.spec.restart_policy == "Never"
+            assert pod.metadata.labels == {"run": "worker"}
+        finally:
+            await srv.stop()
+
+    async def test_bad_env_is_clean_error(self):
+        srv, base = await start_server()
+        try:
+            rc, out, err = await ktl_out(
+                ["run", "w", "--image", "i", "--env", "NOEQUALS"], base)
+            assert rc == 1
+            assert "KEY=VALUE" in err
+        finally:
+            await srv.stop()
+
+    async def test_run_deployment(self):
+        srv, base = await start_server()
+        try:
+            rc, out, err = await ktl_out(
+                ["run", "web", "--image", "srv:v1", "--restart", "Always",
+                 "--replicas", "3"], base)
+            assert rc == 0, err
+            dep = srv.registry.get("deployments", "default", "web")
+            assert dep.spec.replicas == 3
+            assert dep.spec.selector.match_labels == {"run": "web"}
+            assert dep.spec.template.spec.containers[0].image == "srv:v1"
+        finally:
+            await srv.stop()
+
+
+class TestExpose:
+    async def test_expose_deployment(self):
+        srv, base = await start_server()
+        try:
+            rc, _out, err = await ktl_out(
+                ["run", "web", "--image", "i", "--restart", "Always"], base)
+            assert rc == 0, err
+            rc, out, err = await ktl_out(
+                ["expose", "deployment", "web", "--port", "80",
+                 "--target-port", "8080"], base)
+            assert rc == 0, err
+            svc = srv.registry.get("services", "default", "web")
+            assert svc.spec.selector == {"run": "web"}
+            assert svc.spec.ports[0].port == 80
+            assert svc.spec.ports[0].target_port == 8080
+        finally:
+            await srv.stop()
+
+    async def test_expose_pod_uses_labels(self):
+        srv, base = await start_server()
+        try:
+            rc, _out, err = await ktl_out(
+                ["run", "solo", "--image", "i"], base)
+            assert rc == 0, err
+            rc, out, err = await ktl_out(
+                ["expose", "pod", "solo", "--port", "9000",
+                 "--name", "solo-svc", "--type", "NodePort"], base)
+            assert rc == 0, err
+            svc = srv.registry.get("services", "default", "solo-svc")
+            assert svc.spec.selector == {"run": "solo"}
+            assert svc.spec.type == "NodePort"
+        finally:
+            await srv.stop()
+
+
+class TestAutoscale:
+    async def test_autoscale_creates_hpa(self):
+        srv, base = await start_server()
+        try:
+            rc, _out, err = await ktl_out(
+                ["run", "web", "--image", "i", "--restart", "Always"], base)
+            assert rc == 0, err
+            rc, out, err = await ktl_out(
+                ["autoscale", "deployment", "web", "--min", "2",
+                 "--max", "7", "--cpu-percent", "60"], base)
+            assert rc == 0, err
+            hpa = srv.registry.get("horizontalpodautoscalers",
+                                   "default", "web")
+            assert hpa.spec.min_replicas == 2
+            assert hpa.spec.max_replicas == 7
+            assert hpa.spec.target_cpu_utilization_percentage == 60
+            assert hpa.spec.scale_target_ref.name == "web"
+        finally:
+            await srv.stop()
+
+    async def test_autoscale_rejects_bad_bounds(self):
+        srv, base = await start_server()
+        try:
+            rc, _out, err = await ktl_out(
+                ["run", "web", "--image", "i", "--restart", "Always"], base)
+            assert rc == 0, err
+            rc, out, err = await ktl_out(
+                ["autoscale", "deployment", "web", "--min", "5",
+                 "--max", "2"], base)
+            assert rc == 1
+            assert "--max must be" in err
+        finally:
+            await srv.stop()
+
+
+class TestRolloutPauseResume:
+    async def test_pause_resume_round_trip(self):
+        srv, base = await start_server()
+        try:
+            rc, _out, err = await ktl_out(
+                ["run", "web", "--image", "i", "--restart", "Always"], base)
+            assert rc == 0, err
+            rc, out, err = await ktl_out(
+                ["rollout", "pause", "deployment/web"], base)
+            assert rc == 0, err
+            assert srv.registry.get("deployments", "default",
+                                    "web").spec.paused is True
+            rc, out, err = await ktl_out(
+                ["rollout", "pause", "deployment/web"], base)
+            assert rc == 0 and "already" in out
+            rc, out, err = await ktl_out(
+                ["rollout", "resume", "deployment/web"], base)
+            assert rc == 0, err
+            assert srv.registry.get("deployments", "default",
+                                    "web").spec.paused is False
+        finally:
+            await srv.stop()
