@@ -344,7 +344,11 @@ class LocalCluster:
             status_interval=self.status_interval,
             heartbeat_interval=self.heartbeat_interval,
             proxy=proxy, eviction=eviction, runtime_hook=hook,
-            chip_metrics=plugin.chip_metrics if spec.real_tpu else None)
+            chip_metrics=plugin.chip_metrics if spec.real_tpu else None,
+            # Static pods (reference --pod-manifest-path): drop a Pod
+            # YAML into <data>/nodes/<name>/manifests and the agent
+            # runs it kubelet-owned, mirror posted for observability.
+            pod_manifest_path=os.path.join(node_dir, "manifests"))
         if self.ca is not None:
             # Node serving cert (kubelet :10250 TLS): clients verify
             # the node's address against SANs; the handshake requires a

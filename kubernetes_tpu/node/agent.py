@@ -62,7 +62,8 @@ class NodeAgent:
                  runtime_hook=None,
                  chip_metrics=None,
                  dynamic_config: bool = True,
-                 reserved: Optional[cm.Reserved] = None):
+                 reserved: Optional[cm.Reserved] = None,
+                 pod_manifest_path: str = ""):
         self.client = client
         self.node_name = node_name
         self.runtime = runtime
@@ -170,6 +171,16 @@ class NodeAgent:
         self._admit_lock = asyncio.Lock()
         self._evicted: set[str] = set()          # pod UIDs; terminal, never resync
         self._tasks: list[asyncio.Task] = []
+        #: Static pods (staticpods.py; reference --pod-manifest-path):
+        #: manifests in this dir run kubelet-owned, no apiserver needed.
+        self.pod_manifest_path = pod_manifest_path
+        self.static_source = None
+        self._static_keys: set[str] = set()
+        #: Strong refs to static-pod background tasks (mirror reposts,
+        #: manifest-edit replacements): loops hold tasks weakly, and a
+        #: GC'd repost task would silently never run. Cancelled in
+        #: stop().
+        self._static_tasks: set[asyncio.Task] = set()
         self._informer: Optional[SharedInformer] = None
         self._svc_informer: Optional[SharedInformer] = None
         self._own_svc_informer = False
@@ -204,6 +215,13 @@ class NodeAgent:
                                     on_update=self._pod_changed,
                                     on_delete=self._pod_gone)
         self._informer.start()
+        if self.pod_manifest_path:
+            from .staticpods import StaticPodSource
+            self.static_source = StaticPodSource(
+                self.pod_manifest_path, self.node_name,
+                on_pod=self._static_pod_changed,
+                on_gone=self._static_pod_gone)
+            self.static_source.start()
         if self.proxy is not None:
             # Share the proxy's services informer (it is already
             # started): one watch stream per node, not two.
@@ -243,6 +261,13 @@ class NodeAgent:
                 await task
             except (asyncio.CancelledError, Exception):  # noqa: BLE001
                 pass
+        if self.static_source:
+            await self.static_source.stop()
+        for task in list(self._static_tasks):
+            task.cancel()
+        if self._static_tasks:
+            await asyncio.gather(*self._static_tasks,
+                                 return_exceptions=True)
         if self._informer:
             await self._informer.stop()
         if self._svc_informer and self._own_svc_informer:
@@ -385,15 +410,132 @@ class NodeAgent:
 
     # -- pod source handlers ---------------------------------------------
 
+    def _spawn_static(self, coro) -> None:
+        task = asyncio.get_running_loop().create_task(coro)
+        self._static_tasks.add(task)
+        task.add_done_callback(self._static_tasks.discard)
+
     def _pod_changed_add(self, pod: t.Pod) -> None:
         self._pod_changed(None, pod)
 
     def _pod_changed(self, old, pod: t.Pod) -> None:
+        from .staticpods import is_mirror
+        if is_mirror(pod):
+            # The manifest FILE is authoritative for a static pod; its
+            # mirror is observability only (reference: kubelet ignores
+            # API state for file-source pods). A GRACEFUL api delete
+            # only marks the mirror terminating — nobody would ever
+            # confirm it, so finish the delete and repost.
+            key = pod.key()
+            if (pod.metadata.deletion_timestamp is not None
+                    and key in self._static_keys):
+                static = self._pods.get(key)
+
+                async def refresh_mirror():
+                    try:
+                        await self.client.delete(
+                            "pods", pod.metadata.namespace,
+                            pod.metadata.name, grace_period_seconds=0)
+                    except errors.StatusError:
+                        pass
+                    if static is not None:
+                        await self._ensure_mirror(static)
+                self._spawn_static(refresh_mirror())
+            return
         self._pods[pod.key()] = pod
         self._pod_uids[pod.key()] = pod.metadata.uid
         self._ensure_worker(pod.key())
 
+    def _static_pod_changed(self, pod: t.Pod) -> None:
+        key = pod.key()
+        self._static_keys.add(key)
+        old = self._pods.get(key)
+        if old is not None and old.metadata.uid != pod.metadata.uid:
+            # Edited manifest = new identity: tear the old containers
+            # down fully before starting the replacement (the worker
+            # exits after teardown; then re-add).
+            async def replace():
+                self._pods.pop(key, None)
+                # The worker may have ALREADY exited (terminal pod,
+                # restart_policy Never): _ensure_worker spawns one to
+                # run the teardown pass — _nudge alone would leak the
+                # old uid's containers/IP/volumes (same situation
+                # _pod_gone documents).
+                self._ensure_worker(key)
+                worker = self._workers.get(key)
+                if worker is not None:
+                    try:
+                        await worker
+                    except Exception:  # noqa: BLE001
+                        pass
+                self._pod_changed(None, pod)
+                await self._ensure_mirror(pod)
+            self._spawn_static(replace())
+            return
+        self._pod_changed(None, pod)
+        self._spawn_static(self._ensure_mirror(pod))
+
+    def _static_pod_gone(self, pod: t.Pod) -> None:
+        key = pod.key()
+        self._static_keys.discard(key)
+        self._pod_gone(pod)
+
+        async def drop_mirror():
+            try:
+                await self.client.delete(
+                    "pods", pod.metadata.namespace, pod.metadata.name,
+                    grace_period_seconds=0)
+            except errors.StatusError:
+                pass
+        self._spawn_static(drop_mirror())
+
+    async def _ensure_mirror(self, pod: t.Pod) -> None:
+        """Create/refresh the read-only API mirror of a static pod
+        (reference mirror_client.go). Best-effort: static pods must run
+        with the apiserver down; the mirror appears when it returns."""
+        from ..api.scheme import deepcopy
+        from .staticpods import MIRROR_ANNOTATION
+        mirror = deepcopy(pod)
+        mirror.metadata.uid = ""
+        mirror.metadata.resource_version = ""
+        mirror.metadata.annotations[MIRROR_ANNOTATION] = pod.metadata.uid
+        try:
+            await self.client.create(mirror)
+        except errors.AlreadyExistsError:
+            try:
+                cur = await self.client.get(
+                    "pods", pod.metadata.namespace, pod.metadata.name)
+                if (cur.metadata.annotations or {}).get(
+                        MIRROR_ANNOTATION) == pod.metadata.uid:
+                    return
+                # Stale mirror of an older manifest: replace.
+                await self.client.delete(
+                    "pods", pod.metadata.namespace, pod.metadata.name,
+                    grace_period_seconds=0)
+                await self.client.create(mirror)
+            except errors.StatusError:
+                pass
+        except errors.StatusError as e:
+            log.debug("mirror create for %s deferred: %s", pod.key(), e)
+
     def _pod_gone(self, pod: t.Pod) -> None:
+        from .staticpods import is_mirror
+        key = pod.key()
+        if is_mirror(pod) and key not in self._static_keys:
+            # A mirror deletion during static-pod teardown must not
+            # clobber _pod_uids with the MIRROR's registry uid while
+            # the in-flight teardown still needs the static uid to
+            # release the right IP/volumes/sandboxes. Mirrors never
+            # carry local state of their own.
+            return
+        if key in self._static_keys:
+            # Someone deleted the MIRROR via the API: the manifest file
+            # still exists, so the static pod keeps running and the
+            # kubelet reposts the mirror (reference semantics).
+            static = self._pods.get(key)
+            if static is not None:
+                self._spawn_static(self._ensure_mirror(static))
+            return
         # Object force-removed from the store: tear down local state.
         # The worker may have exited already (terminal pod), so ensure
         # one exists to run the teardown pass.

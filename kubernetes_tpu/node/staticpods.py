@@ -1,0 +1,172 @@
+"""Static pods — kubelet-owned pods from a manifest directory.
+
+Reference: ``pkg/kubelet/config/file.go`` (the file pod source merged
+by PodConfig alongside the apiserver watch) + mirror pods
+(``pkg/kubelet/pod/mirror_client.go``): the node agent runs manifests
+dropped into ``--pod-manifest-path`` WITHOUT any apiserver involvement
+— the mechanism the reference uses to self-host control planes — and
+posts read-only *mirror* pods so the cluster can observe them. The
+manifest file is authoritative: API deletes of the mirror just get the
+mirror recreated; editing/removing the FILE restarts/stops the pod.
+
+Identity: a static pod's uid hashes (node, name, manifest content), so
+editing the manifest changes the uid and the agent's worker tears down
+the old containers and starts fresh — the reference's
+update-by-recreate semantics without tracking file diffs.
+
+Like the device manager's plugin watcher, discovery is a directory
+poll (no fsnotify dependency; same trade documented at
+``devicemanager.py:11``).
+"""
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import logging
+import os
+from typing import Callable, Optional
+
+from ..api import types as t
+from ..client.rest import decode_obj
+
+log = logging.getLogger("node.staticpods")
+
+#: Annotation marking how a pod entered the system (reference:
+#: kubernetes.io/config.source).
+SOURCE_ANNOTATION = "config.tpu/source"
+SOURCE_FILE = "file"
+#: On MIRROR pods: the static pod's uid (reference:
+#: kubernetes.io/config.mirror).
+MIRROR_ANNOTATION = "config.tpu/mirror"
+
+
+def is_mirror(pod: t.Pod) -> bool:
+    return MIRROR_ANNOTATION in (pod.metadata.annotations or {})
+
+
+class StaticPodSource:
+    """Polls a manifest directory; surfaces adds/updates/removes as
+    normalized Pod objects through the agent's pod-source callbacks."""
+
+    def __init__(self, manifest_dir: str, node_name: str,
+                 on_pod: Callable[[t.Pod], None],
+                 on_gone: Callable[[t.Pod], None],
+                 interval: float = 2.0):
+        self.manifest_dir = manifest_dir
+        self.node_name = node_name
+        self.on_pod = on_pod
+        self.on_gone = on_gone
+        self.interval = interval
+        #: file path -> (uid, Pod) currently live.
+        self._current: dict[str, tuple[str, t.Pod]] = {}
+        self._task: Optional[asyncio.Task] = None
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self) -> None:
+        os.makedirs(self.manifest_dir, exist_ok=True)
+        self.sync_once()
+        self._task = asyncio.get_running_loop().create_task(self._loop())
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+
+    async def _loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.interval)
+            try:
+                self.sync_once()
+            except Exception:  # noqa: BLE001 — one bad pass must not
+                log.exception("static pod sync failed")  # kill the loop
+
+    # -- core -------------------------------------------------------------
+
+    def _parse(self, path: str) -> Optional[t.Pod]:
+        import yaml
+        try:
+            with open(path) as f:
+                content = f.read()
+            raw = yaml.safe_load(content)
+        except Exception as e:  # noqa: BLE001 — malformed file: log, skip
+            log.warning("static manifest %s unreadable: %s", path, e)
+            return None
+        if not isinstance(raw, dict) or raw.get("kind", "Pod") != "Pod":
+            log.warning("static manifest %s: not a Pod document", path)
+            return None
+        raw.setdefault("kind", "Pod")
+        raw.setdefault("api_version", "core/v1")
+        try:
+            pod = decode_obj(raw)
+        except Exception as e:  # noqa: BLE001
+            log.warning("static manifest %s does not decode: %s", path, e)
+            return None
+        if pod.spec.tpu_resources:
+            # Device assignment is a scheduler+binding flow; a pod that
+            # bypasses both cannot get chips. Loud skip, not a mystery
+            # stuck pod.
+            log.warning("static manifest %s requests TPUs — static pods "
+                        "cannot carry chip assignments; skipping", path)
+            return None
+        if not pod.metadata.name:
+            log.warning("static manifest %s: pod has no name", path)
+            return None
+        # Reference file.go: name gets the node suffix so two nodes
+        # running the same manifest don't collide in mirror space.
+        if not pod.metadata.name.endswith(f"-{self.node_name}"):
+            pod.metadata.name = f"{pod.metadata.name}-{self.node_name}"
+        pod.metadata.namespace = pod.metadata.namespace or "default"
+        pod.spec.node_name = self.node_name
+        pod.metadata.annotations[SOURCE_ANNOTATION] = SOURCE_FILE
+        # Content-addressed identity: an edited manifest is a NEW pod
+        # (old containers torn down by the uid change).
+        pod.metadata.uid = hashlib.sha1(
+            f"{self.node_name}\x00{pod.metadata.name}\x00{content}"
+            .encode()).hexdigest()
+        return pod
+
+    def sync_once(self) -> None:
+        seen: dict[str, tuple[str, t.Pod]] = {}
+        try:
+            names = sorted(os.listdir(self.manifest_dir))
+        except FileNotFoundError:
+            names = []
+        keys_to_path: dict[str, str] = {}
+        for fname in names:
+            if not fname.endswith((".yaml", ".yml", ".json")):
+                continue
+            path = os.path.join(self.manifest_dir, fname)
+            pod = self._parse(path)
+            if pod is None:
+                continue
+            key = pod.key()
+            if key in keys_to_path:
+                # Two files, one pod identity: first (sorted) file wins
+                # deterministically, loudly — otherwise removing either
+                # file would permanently stop the pod the OTHER still
+                # declares (the reference file source rejects dupes).
+                log.warning("static manifest %s duplicates pod %s from "
+                            "%s; ignoring it", path, key,
+                            keys_to_path[key])
+                continue
+            keys_to_path[key] = path
+            seen[path] = (pod.metadata.uid, pod)
+        for path, (uid, pod) in seen.items():
+            prev = self._current.get(path)
+            if prev is None or prev[0] != uid:
+                self.on_pod(pod)
+        for path, (_uid, pod) in list(self._current.items()):
+            # Gone only when NO live manifest still claims the pod key:
+            # deleting the winning duplicate hands the identity to the
+            # surviving file (which just emitted via on_pod above), and
+            # a gone for the same key would tear that replacement down.
+            if path not in seen and pod.key() not in keys_to_path:
+                self.on_gone(pod)
+        self._current = seen
+
+    def pods(self) -> list[t.Pod]:
+        return [pod for _uid, pod in self._current.values()]

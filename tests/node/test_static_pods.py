@@ -1,0 +1,199 @@
+"""Static pods: manifest-dir file source + mirror pods (reference:
+pkg/kubelet/config/file.go + pod/mirror_client.go)."""
+import asyncio
+import os
+
+from kubernetes_tpu.api import errors, types as t
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.apiserver.admission import default_chain
+from kubernetes_tpu.apiserver.registry import Registry
+from kubernetes_tpu.client.local import LocalClient
+from kubernetes_tpu.node.agent import NodeAgent
+from kubernetes_tpu.node.runtime import FakeRuntime
+from kubernetes_tpu.node.staticpods import (
+    MIRROR_ANNOTATION, SOURCE_ANNOTATION, StaticPodSource)
+
+
+MANIFEST = """kind: Pod
+api_version: core/v1
+metadata:
+  name: cp
+spec:
+  containers:
+    - name: main
+      image: control-plane:v{v}
+"""
+
+
+def running(runtime):
+    from kubernetes_tpu.node.runtime import STATE_RUNNING
+    return sum(1 for s in runtime._status.values()
+               if s.state == STATE_RUNNING)
+
+
+async def wait_for(cond, timeout=6.0):
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + timeout
+    while loop.time() < deadline:
+        got = cond()
+        if got:
+            return got
+        await asyncio.sleep(0.05)
+    raise AssertionError("condition not met in time")
+
+
+async def make_agent(tmp_path):
+    reg = Registry()
+    reg.admission = default_chain(reg)
+    reg.create(t.Namespace(metadata=ObjectMeta(name="default")))
+    client = LocalClient(reg)
+    runtime = FakeRuntime()
+    manifests = str(tmp_path / "manifests")
+    agent = NodeAgent(client, "node-a", runtime,
+                      status_interval=0.3, heartbeat_interval=0.3,
+                      pleg_interval=0.1, pod_manifest_path=manifests)
+    await agent.start()
+    agent.static_source.interval = 0.1  # fast polls for the test
+    return reg, client, agent, runtime, manifests
+
+
+class TestSource:
+    def test_parse_normalizes_identity(self, tmp_path):
+        d = str(tmp_path)
+        (tmp_path / "cp.yaml").write_text(MANIFEST.format(v=1))
+        got = []
+        src = StaticPodSource(d, "node-a", on_pod=got.append,
+                              on_gone=lambda p: None)
+        src.sync_once()
+        (pod,) = got
+        assert pod.metadata.name == "cp-node-a"
+        assert pod.spec.node_name == "node-a"
+        assert pod.metadata.annotations[SOURCE_ANNOTATION] == "file"
+        uid1 = pod.metadata.uid
+        # Same content -> no re-emit; edited content -> new uid emit.
+        src.sync_once()
+        assert len(got) == 1
+        (tmp_path / "cp.yaml").write_text(MANIFEST.format(v=2))
+        src.sync_once()
+        assert len(got) == 2 and got[1].metadata.uid != uid1
+
+    def test_duplicate_names_first_file_wins(self, tmp_path):
+        (tmp_path / "a.yaml").write_text(MANIFEST.format(v=1))
+        (tmp_path / "b.yaml").write_text(MANIFEST.format(v=2))
+        added, gone = [], []
+        src = StaticPodSource(str(tmp_path), "n", on_pod=added.append,
+                              on_gone=gone.append)
+        src.sync_once()
+        assert [p.spec.containers[0].image for p in added] == \
+            ["control-plane:v1"]
+        # Removing the WINNER hands the identity to the survivor —
+        # never a net teardown while a manifest still claims the key.
+        (tmp_path / "a.yaml").unlink()
+        src.sync_once()
+        assert [p.spec.containers[0].image for p in added] == \
+            ["control-plane:v1", "control-plane:v2"]
+        assert gone == []
+        # Removing the last file really stops it.
+        (tmp_path / "b.yaml").unlink()
+        src.sync_once()
+        assert len(gone) == 1
+
+    def test_tpu_claims_rejected(self, tmp_path):
+        (tmp_path / "bad.yaml").write_text("""kind: Pod
+api_version: core/v1
+metadata: {name: bad}
+spec:
+  tpu_resources: [{name: w, chips: 2}]
+  containers: [{name: c, image: i}]
+""")
+        got = []
+        src = StaticPodSource(str(tmp_path), "n", on_pod=got.append,
+                              on_gone=lambda p: None)
+        src.sync_once()
+        assert got == []
+
+
+class TestAgentIntegration:
+    async def test_static_pod_runs_and_mirrors(self, tmp_path):
+        reg, client, agent, runtime, manifests = await make_agent(tmp_path)
+        try:
+            with open(os.path.join(manifests, "cp.yaml"), "w") as f:
+                f.write(MANIFEST.format(v=1))
+
+            def mirror_running():
+                try:
+                    pod = reg.get("pods", "default", "cp-node-a")
+                except errors.NotFoundError:
+                    return None
+                return pod if pod.status.phase == t.POD_RUNNING else None
+            mirror = await wait_for(mirror_running)
+            assert MIRROR_ANNOTATION in mirror.metadata.annotations
+            assert running(runtime) >= 1
+        finally:
+            await agent.stop()
+
+    async def test_mirror_delete_recreates_pod_keeps_running(self, tmp_path):
+        reg, client, agent, runtime, manifests = await make_agent(tmp_path)
+        try:
+            with open(os.path.join(manifests, "cp.yaml"), "w") as f:
+                f.write(MANIFEST.format(v=1))
+
+            def get_mirror():
+                try:
+                    return reg.get("pods", "default", "cp-node-a")
+                except errors.NotFoundError:
+                    return None
+            first = await wait_for(get_mirror)
+            # An API delete of the MIRROR must not stop the static pod:
+            # the kubelet owns the lifecycle and reposts the mirror.
+            reg.delete("pods", "default", "cp-node-a",
+                       grace_period_seconds=0)
+            recreated = await wait_for(
+                lambda: (m := get_mirror()) is not None
+                and m.metadata.uid != first.metadata.uid and m)
+            assert MIRROR_ANNOTATION in recreated.metadata.annotations
+            assert "default/cp-node-a" in agent._pods  # still running
+        finally:
+            await agent.stop()
+
+    async def test_manifest_remove_stops_pod_and_mirror(self, tmp_path):
+        reg, client, agent, runtime, manifests = await make_agent(tmp_path)
+        try:
+            path = os.path.join(manifests, "cp.yaml")
+            with open(path, "w") as f:
+                f.write(MANIFEST.format(v=1))
+
+            def exists():
+                try:
+                    reg.get("pods", "default", "cp-node-a")
+                    return True
+                except errors.NotFoundError:
+                    return False
+            await wait_for(exists)
+            os.unlink(path)
+            await wait_for(lambda: not exists())
+            await wait_for(lambda: running(runtime) == 0)
+        finally:
+            await agent.stop()
+
+    async def test_manifest_edit_restarts_with_new_image(self, tmp_path):
+        reg, client, agent, runtime, manifests = await make_agent(tmp_path)
+        try:
+            path = os.path.join(manifests, "cp.yaml")
+            with open(path, "w") as f:
+                f.write(MANIFEST.format(v=1))
+
+            def mirror_uid():
+                try:
+                    pod = reg.get("pods", "default", "cp-node-a")
+                except errors.NotFoundError:
+                    return None
+                return pod.metadata.annotations.get(MIRROR_ANNOTATION)
+            uid1 = await wait_for(mirror_uid)
+            with open(path, "w") as f:
+                f.write(MANIFEST.format(v=2))
+            await wait_for(lambda: mirror_uid() not in (None, uid1))
+            static = agent._pods["default/cp-node-a"]
+            assert static.spec.containers[0].image == "control-plane:v2"
+        finally:
+            await agent.stop()
