@@ -17,7 +17,7 @@ from typing import Optional
 from .meta import TypedObject
 from .scheme import DEFAULT_SCHEME
 from .selectors import LabelSelector
-from .types import PodTemplateSpec
+from .types import PersistentVolumeClaim, PodTemplateSpec
 
 APPS_V1 = "apps/v1"
 BATCH_V1 = "batch/v1"
@@ -120,6 +120,13 @@ class StatefulSetSpec:
     service_name: str = ""
     pod_management_policy: str = "OrderedReady"  # or "Parallel"
     update_strategy: str = ROLLING_UPDATE
+    #: Per-replica stable storage (reference: volumeClaimTemplates):
+    #: each template yields a PVC named <template>-<set>-<ordinal>,
+    #: mounted into the pod as a volume of the template's name. Claims
+    #: are NOT owner-referenced — they outlive pods AND the set (the
+    #: whole point of stable storage; deletion is an operator act).
+    volume_claim_templates: list[PersistentVolumeClaim] = field(
+        default_factory=list)
 
 
 @dataclass

@@ -178,6 +178,20 @@ class LocalCluster:
                 self.registry.create(t.Namespace(metadata=ObjectMeta(name=ns)))
             except errors.AlreadyExistsError:
                 pass  # durable restart
+        try:
+            # Default StorageClass (what real clusters ship): classless
+            # PVCs — e.g. StatefulSet volumeClaimTemplates — provision
+            # host-path volumes out of the box via the DefaultStorage-
+            # Class admission stamp + the PV binder's provisioner.
+            self.registry.create(t.StorageClass(
+                metadata=ObjectMeta(
+                    name="standard",
+                    annotations={
+                        "storageclass.tpu/is-default-class": "true"}),
+                provisioner=t.PROVISIONER_HOSTPATH,
+                parameters={"base_dir": os.path.join(self.data_dir, "pv")}))
+        except errors.AlreadyExistsError:
+            pass
 
         from ..apiserver.audit import (AuditLogger, AuditPolicy,
                                        AuditWebhookBackend)

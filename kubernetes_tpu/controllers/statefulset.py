@@ -73,6 +73,28 @@ class StatefulSetController(Controller):
                 out[o] = pod
         return out
 
+    @staticmethod
+    def claim_name(st: w.StatefulSet, template_name: str,
+                   ordinal: int) -> str:
+        """Reference naming: <template>-<set>-<ordinal> — the claim's
+        stability across pod recreation IS the stable-storage contract."""
+        return f"{template_name}-{st.metadata.name}-{ordinal}"
+
+    async def _ensure_claims(self, st: w.StatefulSet, ordinal: int) -> None:
+        """Create this ordinal's PVCs if absent (idempotent; existing
+        claims are never touched — a replacement pod reattaches)."""
+        for tpl in st.spec.volume_claim_templates:
+            name = self.claim_name(st, tpl.metadata.name, ordinal)
+            claim = t.PersistentVolumeClaim(
+                metadata=t.ObjectMeta(  # type: ignore[attr-defined]
+                    name=name, namespace=st.metadata.namespace,
+                    labels=dict(st.spec.template.metadata.labels)),
+                spec=deepcopy(tpl.spec))
+            try:
+                await self.client.create(claim)
+            except errors.AlreadyExistsError:
+                pass
+
     def _mutator(self, st: w.StatefulSet, ordinal: int, revision: str):
         hostnames = rank_hostnames(st.metadata.name, st.spec.replicas,
                                    st.spec.service_name,
@@ -88,6 +110,15 @@ class StatefulSetController(Controller):
                 t.EnvVar(name="TPU_WORKER_ID", value=str(ordinal)),
                 t.EnvVar(name="TPU_WORKER_HOSTNAMES", value=hostnames),
             ])
+            have = {v.name for v in pod.spec.volumes}
+            for tpl in st.spec.volume_claim_templates:
+                if tpl.metadata.name in have:
+                    continue  # template's volume overridden in the pod
+                pod.spec.volumes.append(t.Volume(
+                    name=tpl.metadata.name,
+                    persistent_volume_claim=t.PersistentVolumeClaimVolume(
+                        claim_name=self.claim_name(
+                            st, tpl.metadata.name, ordinal))))
 
         return mutate
 
@@ -104,6 +135,7 @@ class StatefulSetController(Controller):
         for i in range(st.spec.replicas):
             pod = pods.get(i)
             if pod is None:
+                await self._ensure_claims(st, i)
                 await self.pod_control.create_pod(
                     st, st.spec.template, name=f"{st.metadata.name}-{i}",
                     mutate=self._mutator(st, i, revision))

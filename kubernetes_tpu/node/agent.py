@@ -176,6 +176,10 @@ class NodeAgent:
         self.pod_manifest_path = pod_manifest_path
         self.static_source = None
         self._static_keys: set[str] = set()
+        #: key -> latest desired static pod (None = pending removal);
+        #: _apply_static converges to this under a per-key lock.
+        self._static_desired: dict[str, Optional[t.Pod]] = {}
+        self._static_locks: dict[str, asyncio.Lock] = {}
         #: Strong refs to static-pod background tasks (mirror reposts,
         #: manifest-edit replacements): loops hold tasks weakly, and a
         #: GC'd repost task would silently never run. Cancelled in
@@ -251,6 +255,9 @@ class NodeAgent:
             loop.create_task(self._heartbeat_loop()),
             loop.create_task(self._pleg_loop()),
         ]
+        if self.static_source is not None:
+            self._tasks.append(
+                loop.create_task(self._static_reconcile_loop()))
 
     async def stop(self) -> None:
         self._stopped = True
@@ -449,18 +456,32 @@ class NodeAgent:
     def _static_pod_changed(self, pod: t.Pod) -> None:
         key = pod.key()
         self._static_keys.add(key)
-        old = self._pods.get(key)
-        if old is not None and old.metadata.uid != pod.metadata.uid:
-            # Edited manifest = new identity: tear the old containers
-            # down fully before starting the replacement (the worker
-            # exits after teardown; then re-add).
-            async def replace():
+        self._static_desired[key] = pod
+        self._spawn_static(self._apply_static(key))
+
+    def _static_pod_gone(self, pod: t.Pod) -> None:
+        key = pod.key()
+        self._static_keys.discard(key)
+        self._static_desired[key] = None
+        self._spawn_static(self._apply_static(key))
+
+    async def _apply_static(self, key: str) -> None:
+        """Serialized convergence to the LATEST desired static pod for
+        one key. Rapid manifest edits overlap in time; without the
+        per-key lock + re-read-after-teardown, a stale intermediate
+        version could win and the older uid's IP/volumes leak."""
+        lock = self._static_locks.setdefault(key, asyncio.Lock())
+        async with lock:
+            desired = self._static_desired.get(key)
+            current = self._pods.get(key)
+            if desired is not None and current is not None                     and current.metadata.uid == desired.metadata.uid:
+                await self._ensure_mirror(desired)
+                return
+            if current is not None or key in self._workers:
+                # Tear the old identity down COMPLETELY first. The
+                # worker may have already exited (terminal pod):
+                # _ensure_worker spawns one to run the teardown pass.
                 self._pods.pop(key, None)
-                # The worker may have ALREADY exited (terminal pod,
-                # restart_policy Never): _ensure_worker spawns one to
-                # run the teardown pass — _nudge alone would leak the
-                # old uid's containers/IP/volumes (same situation
-                # _pod_gone documents).
                 self._ensure_worker(key)
                 worker = self._workers.get(key)
                 if worker is not None:
@@ -468,26 +489,48 @@ class NodeAgent:
                         await worker
                     except Exception:  # noqa: BLE001
                         pass
-                self._pod_changed(None, pod)
-                await self._ensure_mirror(pod)
-            self._spawn_static(replace())
-            return
-        self._pod_changed(None, pod)
-        self._spawn_static(self._ensure_mirror(pod))
+            # Desired may have advanced while tearing down; converge to
+            # the newest, not to the version that triggered this task.
+            desired = self._static_desired.get(key)
+            if desired is None:
+                self._static_desired.pop(key, None)
+                try:
+                    ns, name = key.split("/", 1)
+                    await self.client.delete(
+                        "pods", ns, name, grace_period_seconds=0)
+                except errors.StatusError:
+                    pass
+                return
+            self._pod_changed(None, desired)
+            await self._ensure_mirror(desired)
 
-    def _static_pod_gone(self, pod: t.Pod) -> None:
-        key = pod.key()
-        self._static_keys.discard(key)
-        self._pod_gone(pod)
-
-        async def drop_mirror():
+    async def _static_reconcile_loop(self) -> None:
+        """Periodic mirror reconciliation: (a) repost mirrors whose
+        create failed while the apiserver was down (the headline static
+        -pod scenario — the mirror appears when it returns); (b) delete
+        mirrors orphaned by manifests removed while the agent was down
+        (reference: kubelet deletes orphaned mirrors on sync)."""
+        from .staticpods import is_mirror
+        while not self._stopped:
+            await asyncio.sleep(self.status_interval)
             try:
-                await self.client.delete(
-                    "pods", pod.metadata.namespace, pod.metadata.name,
-                    grace_period_seconds=0)
-            except errors.StatusError:
-                pass
-        self._spawn_static(drop_mirror())
+                for key in list(self._static_keys):
+                    pod = self._pods.get(key)
+                    if pod is not None:
+                        await self._ensure_mirror(pod)
+                if self._informer is None:
+                    continue
+                for obj in self._informer.list():
+                    if (is_mirror(obj)
+                            and obj.key() not in self._static_keys):
+                        try:
+                            await self.client.delete(
+                                "pods", obj.metadata.namespace,
+                                obj.metadata.name, grace_period_seconds=0)
+                        except errors.StatusError:
+                            pass
+            except Exception:  # noqa: BLE001 — reconcile is best-effort
+                log.exception("static mirror reconcile failed")
 
     async def _ensure_mirror(self, pod: t.Pod) -> None:
         """Create/refresh the read-only API mirror of a static pod

@@ -142,6 +142,15 @@ class StaticPodSource:
             path = os.path.join(self.manifest_dir, fname)
             pod = self._parse(path)
             if pod is None:
+                prev = self._current.get(path)
+                if prev is not None:
+                    # Keep last-known-good: a poll landing mid-write
+                    # (non-atomic editor save) must not read as file
+                    # removal and restart a healthy control-plane pod.
+                    key = prev[1].key()
+                    if key not in keys_to_path:
+                        keys_to_path[key] = path
+                        seen[path] = prev
                 continue
             key = pod.key()
             if key in keys_to_path:

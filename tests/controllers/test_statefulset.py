@@ -84,3 +84,72 @@ async def test_scale_down_removes_highest_ordinal():
     finally:
         await ctrl.stop()
         await factory.stop_all()
+
+
+async def test_volume_claim_templates_per_replica():
+    """volumeClaimTemplates: each ordinal gets <tpl>-<set>-<i> PVCs
+    mounted as pod volumes; claims survive pod deletion and reattach
+    (reference stable-storage contract)."""
+    from kubernetes_tpu.api import types as t
+    reg, client, factory = make_plane()
+    ctrl = StatefulSetController(client, factory)
+    await ctrl.start()
+    try:
+        sts = mk_sts(replicas=2, policy="Parallel")
+        sts.spec.volume_claim_templates = [t.PersistentVolumeClaim(
+            metadata=ObjectMeta(name="ckpt"),
+            spec=t.PersistentVolumeClaimSpec(
+                storage_class_name="fast",
+                resources=t.ResourceRequirements(
+                    requests={"storage": "1Gi"})))]
+        reg.create(sts)
+        await wait_for(lambda: len(pods_of(reg)) == 2)
+        claims, _ = reg.list("persistentvolumeclaims", "default")
+        names = sorted(c.metadata.name for c in claims)
+        assert names == ["ckpt-workers-0", "ckpt-workers-1"]
+        assert claims[0].spec.storage_class_name == "fast"
+        for pod in pods_of(reg):
+            ordinal = pod.metadata.name.rsplit("-", 1)[1]
+            (vol,) = [v for v in pod.spec.volumes if v.name == "ckpt"]
+            assert (vol.persistent_volume_claim.claim_name
+                    == f"ckpt-workers-{ordinal}")
+
+        # Pod replacement reattaches the SAME claim (no new PVC).
+        victim = reg.get("pods", "default", "workers-1")
+        uid_before = {c.metadata.name: c.metadata.uid for c in claims}
+        reg.delete("pods", "default", "workers-1",
+                   grace_period_seconds=0)
+        await wait_for(lambda: any(
+            p.metadata.name == "workers-1"
+            and p.metadata.uid != victim.metadata.uid
+            for p in pods_of(reg)))
+        claims2, _ = reg.list("persistentvolumeclaims", "default")
+        assert {c.metadata.name: c.metadata.uid
+                for c in claims2} == uid_before
+    finally:
+        await ctrl.stop()
+        await factory.stop_all()
+
+
+async def test_claims_survive_set_deletion():
+    from kubernetes_tpu.api import errors, types as t
+    reg, client, factory = make_plane()
+    ctrl = StatefulSetController(client, factory)
+    await ctrl.start()
+    try:
+        sts = mk_sts(replicas=1, policy="Parallel")
+        sts.spec.volume_claim_templates = [t.PersistentVolumeClaim(
+            metadata=ObjectMeta(name="ckpt"),
+            spec=t.PersistentVolumeClaimSpec(
+                resources=t.ResourceRequirements(
+                    requests={"storage": "1Gi"})))]
+        reg.create(sts)
+        await wait_for(lambda: len(pods_of(reg)) == 1)
+        reg.delete("statefulsets", "default", "workers")
+        # The claim has no owner ref: it must outlive the set.
+        claim = reg.get("persistentvolumeclaims", "default",
+                        "ckpt-workers-0")
+        assert claim.metadata.owner_references == []
+    finally:
+        await ctrl.stop()
+        await factory.stop_all()

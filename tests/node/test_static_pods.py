@@ -176,6 +176,74 @@ class TestAgentIntegration:
         finally:
             await agent.stop()
 
+    async def test_rapid_edits_converge_to_latest(self, tmp_path):
+        """Overlapping manifest edits must land on the NEWEST version
+        (a stale intermediate must never win the race)."""
+        reg, client, agent, runtime, manifests = await make_agent(tmp_path)
+        try:
+            path = os.path.join(manifests, "cp.yaml")
+            for v in (1, 2, 3, 4):
+                with open(path, "w") as f:
+                    f.write(MANIFEST.format(v=v))
+                agent.static_source.sync_once()
+
+            def settled():
+                pod = agent._pods.get("default/cp-node-a")
+                return (pod is not None
+                        and pod.spec.containers[0].image
+                        == "control-plane:v4" and pod)
+            await wait_for(settled)
+            # The applier drained: no intermediate overwrite pending.
+            await asyncio.sleep(0.3)
+            assert agent._pods["default/cp-node-a"].spec.containers[
+                0].image == "control-plane:v4"
+        finally:
+            await agent.stop()
+
+    async def test_orphaned_mirror_cleaned_after_restart(self, tmp_path):
+        """A mirror left behind by a manifest removed while the agent
+        was down must be deleted by the reconcile loop."""
+        reg = Registry()
+        reg.admission = default_chain(reg)
+        reg.create(t.Namespace(metadata=ObjectMeta(name="default")))
+        # The ghost: a mirror-annotated pod with no backing manifest.
+        reg.create(t.Pod(
+            metadata=ObjectMeta(name="ghost-node-a", namespace="default",
+                                annotations={MIRROR_ANNOTATION: "dead"}),
+            spec=t.PodSpec(node_name="node-a", containers=[
+                t.Container(name="c", image="i")])))
+        client = LocalClient(reg)
+        agent = NodeAgent(client, "node-a", FakeRuntime(),
+                          status_interval=0.2, heartbeat_interval=0.3,
+                          pleg_interval=0.1,
+                          pod_manifest_path=str(tmp_path / "manifests"))
+        await agent.start()
+        try:
+            def gone():
+                try:
+                    reg.get("pods", "default", "ghost-node-a")
+                    return False
+                except errors.NotFoundError:
+                    return True
+            await wait_for(gone)
+        finally:
+            await agent.stop()
+
+    async def test_mid_write_parse_failure_keeps_pod(self, tmp_path):
+        (tmp_path / "cp.yaml").write_text(MANIFEST.format(v=1))
+        added, gone = [], []
+        src = StaticPodSource(str(tmp_path), "n", on_pod=added.append,
+                              on_gone=gone.append)
+        src.sync_once()
+        assert len(added) == 1
+        # Non-atomic writer caught mid-write: invalid YAML on disk.
+        (tmp_path / "cp.yaml").write_text("kind: Pod\nmetadata: {name: [")
+        src.sync_once()
+        assert gone == []  # last-known-good retained, no teardown
+        (tmp_path / "cp.yaml").write_text(MANIFEST.format(v=2))
+        src.sync_once()
+        assert len(added) == 2  # the finished write lands normally
+
     async def test_manifest_edit_restarts_with_new_image(self, tmp_path):
         reg, client, agent, runtime, manifests = await make_agent(tmp_path)
         try:
