@@ -1,0 +1,143 @@
+"""NetworkPolicy — namespace-scoped pod traffic rules.
+
+Reference: ``staging/src/k8s.io/api/networking/v1/types.go``
+(NetworkPolicy, NetworkPolicySpec, NetworkPolicyIngressRule/EgressRule,
+NetworkPolicyPeer with podSelector/namespaceSelector/ipBlock,
+NetworkPolicyPort, PolicyType). Semantics (the reference's contract):
+
+- a pod is *selected* when any policy's ``pod_selector`` matches it in
+  the policy's namespace; selected pods default-deny the directions
+  listed in ``policy_types`` and allow only what some rule admits;
+- unselected pods are unaffected (allow-all);
+- rules are additive across policies — there is no deny rule.
+
+Enforcement note: the reference apiserver only STORES these objects —
+enforcement belongs to the CNI plugin (Calico etc.). Here the analog
+is ``net/netpolicy.py``: an iptables filter-table renderer over pod
+IPs, applied when privileged, golden-file tested always — the same
+compute-always/apply-when-root posture as the NAT dataplane.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .meta import TypedObject
+from .scheme import DEFAULT_SCHEME
+from .selectors import LabelSelector
+
+NETWORKING_V1 = "networking/v1"
+
+POLICY_INGRESS = "Ingress"
+POLICY_EGRESS = "Egress"
+
+
+@dataclass
+class IPBlock:
+    cidr: str = ""
+    except_cidrs: list[str] = field(default_factory=list)
+
+
+@dataclass
+class NetworkPolicyPeer:
+    """Exactly one of the selectors (or ip_block) per the reference;
+    pod+namespace selector together mean 'pods matching X in
+    namespaces matching Y'."""
+    pod_selector: Optional[LabelSelector] = None
+    namespace_selector: Optional[LabelSelector] = None
+    ip_block: Optional[IPBlock] = None
+
+
+@dataclass
+class NetworkPolicyPort:
+    protocol: str = "TCP"
+    port: int = 0  # 0 = all ports
+
+
+@dataclass
+class NetworkPolicyIngressRule:
+    #: Empty = from anywhere (but still only what rules admit overall).
+    from_peers: list[NetworkPolicyPeer] = field(default_factory=list)
+    ports: list[NetworkPolicyPort] = field(default_factory=list)
+
+
+@dataclass
+class NetworkPolicyEgressRule:
+    to_peers: list[NetworkPolicyPeer] = field(default_factory=list)
+    ports: list[NetworkPolicyPort] = field(default_factory=list)
+
+
+@dataclass
+class NetworkPolicySpec:
+    #: Which pods in this namespace the policy governs; empty selector
+    #: selects ALL pods in the namespace (reference semantics).
+    pod_selector: LabelSelector = field(default_factory=LabelSelector)
+    ingress: list[NetworkPolicyIngressRule] = field(default_factory=list)
+    egress: list[NetworkPolicyEgressRule] = field(default_factory=list)
+    #: Directions this policy participates in. Defaulted at admission:
+    #: Ingress always; Egress when egress rules are present.
+    policy_types: list[str] = field(default_factory=list)
+
+
+@dataclass
+class NetworkPolicy(TypedObject):
+    spec: NetworkPolicySpec = field(default_factory=NetworkPolicySpec)
+
+
+DEFAULT_SCHEME.register(NETWORKING_V1, "NetworkPolicy", NetworkPolicy)
+
+
+def _default_network_policy(np: "NetworkPolicy") -> None:
+    np.spec.policy_types = default_policy_types(np.spec)
+
+
+DEFAULT_SCHEME.add_defaulter(NetworkPolicy, _default_network_policy)
+
+
+def default_policy_types(spec: NetworkPolicySpec) -> list[str]:
+    """Reference defaulting: Ingress always; Egress iff egress rules
+    exist (or it was explicitly listed)."""
+    if spec.policy_types:
+        return spec.policy_types
+    types = [POLICY_INGRESS]
+    if spec.egress:
+        types.append(POLICY_EGRESS)
+    return types
+
+
+def validate_network_policy(np: NetworkPolicy, update: bool = False) -> None:
+    from .errors import InvalidError
+    for i, ptype in enumerate(np.spec.policy_types):
+        if ptype not in (POLICY_INGRESS, POLICY_EGRESS):
+            raise InvalidError(
+                f"spec.policy_types[{i}]: must be Ingress or Egress, "
+                f"got {ptype!r}")
+    for d, rules in (("ingress", np.spec.ingress),
+                     ("egress", np.spec.egress)):
+        for i, rule in enumerate(rules):
+            peers = (rule.from_peers if d == "ingress" else rule.to_peers)
+            for j, peer in enumerate(peers):
+                chosen = [x for x in (peer.pod_selector,
+                                      peer.namespace_selector,
+                                      peer.ip_block) if x is not None]
+                if not chosen:
+                    raise InvalidError(
+                        f"spec.{d}[{i}].peers[{j}]: one of pod_selector,"
+                        f" namespace_selector, ip_block required")
+                if peer.ip_block is not None and (
+                        peer.pod_selector or peer.namespace_selector):
+                    raise InvalidError(
+                        f"spec.{d}[{i}].peers[{j}]: ip_block is "
+                        f"exclusive with the selectors")
+                if peer.ip_block is not None and not peer.ip_block.cidr:
+                    raise InvalidError(
+                        f"spec.{d}[{i}].peers[{j}].ip_block: cidr "
+                        f"required")
+            for j, port in enumerate(rule.ports):
+                if port.protocol not in ("TCP", "UDP"):
+                    raise InvalidError(
+                        f"spec.{d}[{i}].ports[{j}]: protocol must be "
+                        f"TCP or UDP")
+                if not (0 <= port.port <= 65535):
+                    raise InvalidError(
+                        f"spec.{d}[{i}].ports[{j}]: port out of range")

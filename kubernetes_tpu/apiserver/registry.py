@@ -20,8 +20,8 @@ import datetime
 from dataclasses import dataclass, field as dc_field
 from typing import Any, Callable, Optional
 
-from ..api import errors, extensions as ext, rbac as r, types as t, \
-    validation as val, workloads as w
+from ..api import errors, extensions as ext, networking as net, \
+    rbac as r, types as t, validation as val, workloads as w
 from ..api.meta import ObjectMeta, TypedObject, now, stamp as meta_stamp, \
     stamp_new
 from ..api.scheme import DEFAULT_SCHEME, Scheme, from_dict, to_dict
@@ -161,6 +161,11 @@ def builtin_resources() -> list[ResourceSpec]:
         ResourceSpec("podsecuritypolicies", "PodSecurityPolicy", "policy/v1",
                      t.PodSecurityPolicy, namespaced=False,
                      has_status=False),
+        ResourceSpec("networkpolicies", "NetworkPolicy", net.NETWORKING_V1,
+                     net.NetworkPolicy, has_status=False,
+                     validate_create=net.validate_network_policy,
+                     validate_update=lambda new, old:
+                     net.validate_network_policy(new, update=True)),
         ResourceSpec("roles", "Role", r.RBAC_V1, r.Role, has_status=False,
                      path_segment_name=True),
         ResourceSpec("clusterroles", "ClusterRole", r.RBAC_V1, r.ClusterRole,

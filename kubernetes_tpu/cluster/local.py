@@ -274,6 +274,11 @@ class LocalCluster:
         # wherever the kernel path can't.
         self.iptables_syncer = None
         self.ipvs_syncer = None
+        self.netpolicy_syncer = None
+        if GATES.enabled("NetworkPolicy"):
+            from ..net.netpolicy import NetworkPolicySyncer
+            self.netpolicy_syncer = NetworkPolicySyncer(local)
+            await self.netpolicy_syncer.start()
         if GATES.enabled("IpvsProxier"):
             # IPVS mode wins when both gates are on (it subsumes the
             # iptables mode's job and the two fight over KUBE-SERVICES).
@@ -404,6 +409,8 @@ class LocalCluster:
             await self.iptables_syncer.stop()
         if getattr(self, "ipvs_syncer", None) is not None:
             await self.ipvs_syncer.stop()
+        if getattr(self, "netpolicy_syncer", None) is not None:
+            await self.netpolicy_syncer.stop()
         if self.dns is not None:
             await self.dns.stop()
         if self.controller_manager:

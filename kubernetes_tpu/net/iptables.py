@@ -517,25 +517,31 @@ def ensure_jump_rules(hostports: bool = False,
 _KUBE_DYNAMIC_PREFIXES = ("KUBE-SVC-", "KUBE-SEP-", "KUBE-HP-")
 
 
-def declared_dynamic_chains(restore_text: str) -> set[str]:
-    """The per-service/per-endpoint chains a restore text declares."""
+def declared_dynamic_chains(restore_text: str,
+                            prefixes: tuple = _KUBE_DYNAMIC_PREFIXES
+                            ) -> set[str]:
+    """The dynamically-named chains a restore text declares.
+    ``prefixes`` lets other rulesets (netpolicy's KTPU-NP* chains)
+    reuse the stale-chain machinery."""
     out = set()
     for line in restore_text.splitlines():
         if line.startswith(":"):
             name = line[1:].split()[0]
-            if name.startswith(_KUBE_DYNAMIC_PREFIXES):
+            if name.startswith(prefixes):
                 out.add(name)
     return out
 
 
 def with_stale_chain_cleanup(restore_text: str,
-                             prev_chains: set[str]) -> str:
+                             prev_chains: set[str],
+                             prefixes: tuple = _KUBE_DYNAMIC_PREFIXES
+                             ) -> str:
     """--noflush keeps everything we don't mention, so chains for
     deleted Services/Endpoints would accumulate in the kernel forever.
     Declare each stale chain (declaring flushes it) and ``-X`` it at
     the end of its table, the reference's delete-stale-chains pass
     (proxier.go:1593-1608)."""
-    current = declared_dynamic_chains(restore_text)
+    current = declared_dynamic_chains(restore_text, prefixes)
     stale = sorted(prev_chains - current)
     if not stale:
         return restore_text

@@ -1,0 +1,330 @@
+"""NetworkPolicy enforcement — filter-table ruleset renderer + syncer.
+
+The reference apiserver stores NetworkPolicies and leaves enforcement
+to the CNI plugin (Calico, kube-router, ...); those enforcers program
+per-pod iptables *filter* chains. This module is that enforcer for the
+framework's kernel dataplane: compute the full iptables-restore filter
+ruleset from (policies, pods, namespaces) — ALWAYS, golden-file tested
+— and apply it only where privileged, exactly the posture of
+``net/iptables.py``'s NAT side (rationale at ``iptables.py:1-15``).
+
+Chain structure (kube-router-style per-pod firewall chains with a
+VERDICT MARK, not ACCEPT):
+
+    KTPU-NETPOL            dispatch: dst-ip -> per-pod ingress chain,
+                           src-ip -> per-pod egress chain — EVERY
+                           matching chain is traversed (chains RETURN,
+                           never ACCEPT, so when both endpoints of a
+                           connection are governed, both policies are
+                           evaluated; an ACCEPT in the first would end
+                           hook traversal and bypass the second)
+    KTPU-NPP-IN-<h>        one per governed (pod, Ingress): clear the
+                           verdict mark, conntrack RETURN, per-rule
+                           jumps each followed by admit-on-mark
+                           RETURN, final DROP
+    KTPU-NPP-OUT-<h>       same for Egress
+    KTPU-NPR-<h>           one per policy rule: peer matches SET the
+                           mark (0x10000, kube-router's NPC verdict
+                           bit) instead of accepting
+    KTPU-NPB-<h>           one per ipBlock-with-excepts: excepts
+                           RETURN (to the RULE chain, so later peers
+                           of the same rule still evaluate — additive
+                           semantics), then the block sets the mark
+
+Reference semantics implemented: selected pods default-deny per
+``policy_types``; rules are additive across policies; unselected pods
+are untouched (no chain, no dispatch rule).
+"""
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+
+from ..api import types as t
+from ..api.networking import (POLICY_EGRESS, POLICY_INGRESS, NetworkPolicy,
+                              default_policy_types)
+
+DISPATCH_CHAIN = "KTPU-NETPOL"
+#: Verdict mark bit (kube-router NPC uses the same value).
+MARK = "0x10000"
+ADMIT = f"-j MARK --set-xmark {MARK}/{MARK}"
+NP_PREFIXES = ("KTPU-NPP-", "KTPU-NPR-", "KTPU-NPB-")
+
+
+def _h(payload: str) -> str:
+    return hashlib.sha256(payload.encode()).hexdigest()[:12].upper()
+
+
+def pod_chain(direction: str, namespace: str, pod_name: str) -> str:
+    tag = "IN" if direction == POLICY_INGRESS else "OUT"
+    return f"KTPU-NPP-{tag}-{_h(f'{namespace}/{pod_name}/{direction}')}"
+
+
+def rule_chain(policy_key: str, direction: str, index: int) -> str:
+    return f"KTPU-NPR-{_h(f'{policy_key}/{direction}/{index}')}"
+
+
+def block_chain(rchain: str, cidr: str, excepts: tuple) -> str:
+    return f"KTPU-NPB-{_h(f'{rchain}/{cidr}/{sorted(excepts)}')}"
+
+
+@dataclass
+class _Resolved:
+    """One rendered peer: concrete sources + the rule's port list."""
+    peer_ips: list[str] = field(default_factory=list)
+    cidr: str = ""
+    excepts: list[str] = field(default_factory=list)
+    any_peer: bool = False
+    ports: list = field(default_factory=list)
+
+
+def _ns_labels(namespaces: list[t.Namespace]) -> dict[str, dict]:
+    return {ns.metadata.name: dict(ns.metadata.labels)
+            for ns in namespaces}
+
+
+def _resolve_peers(rule_peers, policy_ns: str, pods: list[t.Pod],
+                   namespaces: list[t.Namespace]) -> list[_Resolved]:
+    """Each peer resolves independently (additive)."""
+    out = []
+    ns_labels = _ns_labels(namespaces)
+    for peer in rule_peers:
+        r = _Resolved()
+        if peer.ip_block is not None:
+            r.cidr = peer.ip_block.cidr
+            r.excepts = list(peer.ip_block.except_cidrs)
+        else:
+            if peer.namespace_selector is not None:
+                ns_names = {name for name, labels in ns_labels.items()
+                            if peer.namespace_selector.matches(labels)}
+            else:
+                ns_names = {policy_ns}
+            for pod in pods:
+                if pod.metadata.namespace not in ns_names:
+                    continue
+                if (peer.pod_selector is not None
+                        and not peer.pod_selector.matches(
+                            pod.metadata.labels)):
+                    continue
+                ip = pod.status.pod_ip
+                if ip:
+                    r.peer_ips.append(ip)
+            r.peer_ips.sort()
+        out.append(r)
+    return out
+
+
+def compute_rules(policies: list[NetworkPolicy], pods: list[t.Pod],
+                  namespaces: list[t.Namespace]) -> dict:
+    """-> {(namespace, pod): {"ip":..., direction: [(chain, [_Resolved])]}}
+    for every governed pod with an IP."""
+    governed: dict = {}
+    for np in policies:
+        ptypes = default_policy_types(np.spec)
+        selected = [p for p in pods
+                    if p.metadata.namespace == np.metadata.namespace
+                    and np.spec.pod_selector.matches(p.metadata.labels)
+                    and p.status.pod_ip]
+        if not selected:
+            continue
+        key = f"{np.metadata.namespace}/{np.metadata.name}"
+        for direction, rules in ((POLICY_INGRESS, np.spec.ingress),
+                                 (POLICY_EGRESS, np.spec.egress)):
+            if direction not in ptypes:
+                continue
+            rendered = []
+            for i, rule in enumerate(rules):
+                peers = (rule.from_peers if direction == POLICY_INGRESS
+                         else rule.to_peers)
+                resolved = (_resolve_peers(peers, np.metadata.namespace,
+                                           pods, namespaces)
+                            if peers else [_Resolved(any_peer=True)])
+                for r in resolved:
+                    r.ports = list(rule.ports)
+                rendered.append((rule_chain(key, direction, i), resolved))
+            for pod in selected:
+                pk = (pod.metadata.namespace, pod.metadata.name)
+                governed.setdefault(pk, {"ip": pod.status.pod_ip})
+                governed[pk].setdefault(direction, []).extend(rendered)
+    return governed
+
+
+def _match_ports(ports) -> list[str]:
+    if not ports:
+        return [""]
+    out = []
+    for p in ports:
+        proto = p.protocol.lower()
+        if p.port:
+            out.append(f"-p {proto} --dport {p.port}")
+        else:
+            out.append(f"-p {proto}")
+    return out
+
+
+def render_filter_rules(policies: list[NetworkPolicy], pods: list[t.Pod],
+                        namespaces: list[t.Namespace]) -> str:
+    """Full iptables-restore *filter* input (deterministic ordering —
+    the golden files depend on it)."""
+    governed = compute_rules(policies, pods, namespaces)
+    chains = [f":{DISPATCH_CHAIN} - [0:0]"]
+    rules: list[str] = []
+    rule_bodies: dict[str, list[str]] = {}
+    block_bodies: dict[str, list[str]] = {}
+
+    for (ns, name) in sorted(governed):
+        entry = governed[(ns, name)]
+        ip = entry["ip"]
+        for direction in (POLICY_INGRESS, POLICY_EGRESS):
+            if direction not in entry:
+                continue
+            pchain = pod_chain(direction, ns, name)
+            chains.append(f":{pchain} - [0:0]")
+            flag = "-d" if direction == POLICY_INGRESS else "-s"
+            rules.append(
+                f'-A {DISPATCH_CHAIN} {flag} {ip}/32 -m comment '
+                f'--comment "policy for {ns}/{name}" -j {pchain}')
+            # Clear the verdict bit first: a previous pod chain's
+            # admit must not leak into this one's decision.
+            rules.append(f"-A {pchain} -j MARK --set-xmark 0x0/{MARK}")
+            rules.append(
+                f"-A {pchain} -m conntrack --ctstate RELATED,ESTABLISHED "
+                f"-j RETURN")
+            peer_flag = "-s" if direction == POLICY_INGRESS else "-d"
+            for rchain, resolved in entry[direction]:
+                if rchain not in rule_bodies:
+                    body: list[str] = []
+                    for r in resolved:
+                        for pm in _match_ports(r.ports):
+                            pm_sfx = f" {pm}" if pm else ""
+                            if r.any_peer:
+                                body.append(f"-A {rchain}{pm_sfx} {ADMIT}")
+                            elif r.cidr and r.excepts:
+                                # Excepts RETURN from their OWN chain so
+                                # later peers of this rule still run.
+                                bchain = block_chain(rchain, r.cidr,
+                                                     tuple(r.excepts))
+                                if bchain not in block_bodies:
+                                    bb = [
+                                        f"-A {bchain} {peer_flag} {ex} "
+                                        f"-j RETURN"
+                                        for ex in r.excepts]
+                                    bb.append(
+                                        f"-A {bchain} {peer_flag} "
+                                        f"{r.cidr}{pm_sfx} {ADMIT}")
+                                    block_bodies[bchain] = bb
+                                body.append(f"-A {rchain} -j {bchain}")
+                            elif r.cidr:
+                                body.append(
+                                    f"-A {rchain} {peer_flag} {r.cidr}"
+                                    f"{pm_sfx} {ADMIT}")
+                            else:
+                                for pip in r.peer_ips:
+                                    body.append(
+                                        f"-A {rchain} {peer_flag} "
+                                        f"{pip}/32{pm_sfx} {ADMIT}")
+                    rule_bodies[rchain] = body
+                rules.append(f"-A {pchain} -j {rchain}")
+                rules.append(f"-A {pchain} -m mark --mark {MARK}/{MARK} "
+                             f"-j RETURN")
+            rules.append(
+                f'-A {pchain} -m comment --comment "default deny '
+                f'({direction.lower()})" -j DROP')
+
+    for extra in (rule_bodies, block_bodies):
+        for chain_name in sorted(extra):
+            chains.append(f":{chain_name} - [0:0]")
+    body_rules = [line
+                  for extra in (rule_bodies, block_bodies)
+                  for chain_name in sorted(extra)
+                  for line in extra[chain_name]]
+    return "\n".join(["*filter", *chains, *rules, *body_rules,
+                      "COMMIT"]) + "\n"
+
+
+def jump_rule_specs() -> list[tuple[str, str, list[str]]]:
+    """(table, chain, rule-args) hooks: pod traffic traverses FORWARD
+    (routed netns dataplanes) and INPUT/OUTPUT (the host-local process
+    runtime)."""
+    return [
+        ("filter", "FORWARD", ["-j", DISPATCH_CHAIN]),
+        ("filter", "INPUT", ["-j", DISPATCH_CHAIN]),
+        ("filter", "OUTPUT", ["-j", DISPATCH_CHAIN]),
+    ]
+
+
+class NetworkPolicySyncer:
+    """Watches policies/pods/namespaces; recomputes the filter ruleset
+    on churn; applies via the shared iptables machinery (apply_rules +
+    stale-chain cleanup + ensure_jump_rules) when privileged. Mirrors
+    IptablesSyncer's shape and its to_thread offload — apply blocks on
+    the xtables lock and must not stall the control-plane loop."""
+
+    def __init__(self, client, min_sync_interval: float = 0.25):
+        self.client = client
+        self.min_sync_interval = min_sync_interval
+        self.last_rendered = ""
+        self.applied = False
+        self.syncs = 0
+        self._prev_chains: set[str] = set()
+        self._informers = []
+        self._dirty = None
+        self._task = None
+
+    async def start(self) -> None:
+        import asyncio
+
+        from ..client.informer import SharedInformer
+        self._dirty = asyncio.Event()
+        for plural in ("networkpolicies", "pods", "namespaces"):
+            inf = SharedInformer(self.client, plural)
+            inf.add_handlers(
+                on_add=lambda o: self._dirty.set(),
+                on_update=lambda o, n: self._dirty.set(),
+                on_delete=lambda o: self._dirty.set())
+            inf.start()
+            self._informers.append(inf)
+        for inf in self._informers:
+            await inf.wait_for_sync()
+        self._dirty.set()
+        self._task = asyncio.get_running_loop().create_task(self._loop())
+
+    async def stop(self) -> None:
+        import asyncio
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+        for inf in self._informers:
+            await inf.stop()
+
+    async def _loop(self) -> None:
+        import asyncio
+        while True:
+            await self._dirty.wait()
+            self._dirty.clear()
+            try:
+                await asyncio.to_thread(self.sync)
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — keep syncing on errors
+                import logging
+                logging.getLogger("netpolicy").exception("sync failed")
+            await asyncio.sleep(self.min_sync_interval)
+
+    def sync(self) -> None:
+        from .iptables import (apply_rules, declared_dynamic_chains,
+                               ensure_jump_rules, with_stale_chain_cleanup)
+        pols, pods, nss = self._informers
+        self.last_rendered = render_filter_rules(
+            pols.list(), pods.list(), nss.list())
+        to_apply = with_stale_chain_cleanup(
+            self.last_rendered, self._prev_chains, prefixes=NP_PREFIXES)
+        self._prev_chains = declared_dynamic_chains(
+            self.last_rendered, prefixes=NP_PREFIXES)
+        self.applied = apply_rules(to_apply)
+        if self.applied:
+            ensure_jump_rules(specs=jump_rule_specs())
+        self.syncs += 1

@@ -474,7 +474,8 @@ class NodeAgent:
         async with lock:
             desired = self._static_desired.get(key)
             current = self._pods.get(key)
-            if desired is not None and current is not None                     and current.metadata.uid == desired.metadata.uid:
+            if (desired is not None and current is not None
+                    and current.metadata.uid == desired.metadata.uid):
                 await self._ensure_mirror(desired)
                 return
             if current is not None or key in self._workers:

@@ -54,6 +54,10 @@ KNOWN_FEATURES = {f.name: f for f in [
             "kernel NAT service dataplane: render + iptables-restore "
             "rulesets from Services/Endpoints (needs root; userspace "
             "proxy stays on as fallback)"),
+    Feature("NetworkPolicy", False, ALPHA,
+            "NetworkPolicy enforcement: render + apply per-pod "
+            "iptables filter chains (needs root; rulesets are computed "
+            "and testable either way)"),
     Feature("IpvsProxier", False, ALPHA,
             "IPVS kernel dataplane: virtual servers per service port, "
             "incremental ipvsadm deltas + ipset-driven static iptables "

@@ -1,0 +1,168 @@
+"""NetworkPolicy API + filter-ruleset renderer (reference:
+networking/v1 types; enforcement analog of the CNI enforcers'
+per-pod firewall chains)."""
+import os
+
+import pytest
+
+from kubernetes_tpu.api import errors, networking as n, types as t
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.api.selectors import LabelSelector
+from kubernetes_tpu.apiserver.registry import Registry
+from kubernetes_tpu.net import netpolicy as npf
+
+GOLDEN_DIR = os.path.join(os.path.dirname(__file__), "golden")
+
+
+def _pod(name, ns="default", labels=None, ip=""):
+    p = t.Pod(metadata=ObjectMeta(name=name, namespace=ns,
+                                  labels=labels or {}),
+              spec=t.PodSpec(containers=[t.Container(name="c", image="i")]))
+    p.status.pod_ip = ip
+    return p
+
+
+def _ns(name, labels=None):
+    return t.Namespace(metadata=ObjectMeta(name=name, labels=labels or {}))
+
+
+def fixture():
+    pods = [
+        _pod("web-0", labels={"app": "web"}, ip="10.0.0.10"),
+        _pod("web-1", labels={"app": "web"}, ip="10.0.0.11"),
+        _pod("client", labels={"app": "client"}, ip="10.0.0.20"),
+        _pod("other", labels={"app": "other"}, ip="10.0.0.30"),
+        _pod("monitor", ns="ops", labels={"role": "probe"},
+             ip="10.0.1.5"),
+        _pod("no-ip", labels={"app": "web"}),  # pending: not rendered
+    ]
+    namespaces = [_ns("default"), _ns("ops", labels={"team": "ops"})]
+    policy = n.NetworkPolicy(
+        metadata=ObjectMeta(name="web-allow", namespace="default"),
+        spec=n.NetworkPolicySpec(
+            pod_selector=LabelSelector(match_labels={"app": "web"}),
+            ingress=[
+                n.NetworkPolicyIngressRule(
+                    from_peers=[
+                        n.NetworkPolicyPeer(pod_selector=LabelSelector(
+                            match_labels={"app": "client"})),
+                        n.NetworkPolicyPeer(
+                            namespace_selector=LabelSelector(
+                                match_labels={"team": "ops"})),
+                    ],
+                    ports=[n.NetworkPolicyPort(port=8080)]),
+                n.NetworkPolicyIngressRule(
+                    from_peers=[n.NetworkPolicyPeer(ip_block=n.IPBlock(
+                        cidr="192.168.0.0/16",
+                        except_cidrs=["192.168.9.0/24"]))]),
+            ],
+            egress=[n.NetworkPolicyEgressRule(
+                to_peers=[n.NetworkPolicyPeer(pod_selector=LabelSelector(
+                    match_labels={"app": "client"}))])],
+        ))
+    return [policy], pods, namespaces
+
+
+class TestApi:
+    def test_registry_round_trip_and_defaulting(self):
+        reg = Registry()
+        reg.create(t.Namespace(metadata=ObjectMeta(name="default")))
+        policies, _, _ = fixture()
+        reg.create(policies[0])
+        got = reg.get("networkpolicies", "default", "web-allow")
+        # Egress rules present -> policy_types defaulted to both.
+        assert got.spec.policy_types == ["Ingress", "Egress"]
+        assert got.api_version == "networking/v1"
+
+    def test_validation(self):
+        reg = Registry()
+        reg.create(t.Namespace(metadata=ObjectMeta(name="default")))
+        bad = n.NetworkPolicy(
+            metadata=ObjectMeta(name="bad", namespace="default"),
+            spec=n.NetworkPolicySpec(ingress=[
+                n.NetworkPolicyIngressRule(
+                    from_peers=[n.NetworkPolicyPeer()])]))
+        with pytest.raises(errors.InvalidError, match="one of"):
+            reg.create(bad)
+        bad2 = n.NetworkPolicy(
+            metadata=ObjectMeta(name="bad2", namespace="default"),
+            spec=n.NetworkPolicySpec(ingress=[
+                n.NetworkPolicyIngressRule(
+                    from_peers=[n.NetworkPolicyPeer(
+                        ip_block=n.IPBlock(cidr="10.0.0.0/8"),
+                        pod_selector=LabelSelector())])]))
+        with pytest.raises(errors.InvalidError, match="exclusive"):
+            reg.create(bad2)
+        bad3 = n.NetworkPolicy(
+            metadata=ObjectMeta(name="bad3", namespace="default"),
+            spec=n.NetworkPolicySpec(
+                policy_types=["Sideways"]))
+        with pytest.raises(errors.InvalidError, match="Ingress or Egress"):
+            reg.create(bad3)
+
+
+class TestRenderer:
+    def test_golden(self):
+        policies, pods, namespaces = fixture()
+        got = npf.render_filter_rules(policies, pods, namespaces)
+        path = os.path.join(GOLDEN_DIR, "netpolicy.rules")
+        if os.environ.get("KTPU_REGEN_GOLDEN"):
+            with open(path, "w") as f:
+                f.write(got)
+            pytest.skip("golden regenerated")
+        with open(path) as f:
+            assert got == f.read(), "netpolicy.rules drifted"
+
+    def test_selected_pods_default_deny_with_allows(self):
+        policies, pods, namespaces = fixture()
+        out = npf.render_filter_rules(policies, pods, namespaces)
+        # Both web pods governed for ingress AND egress; client/other
+        # pods untouched.
+        assert out.count('"policy for default/web-0"') == 2
+        assert "10.0.0.20" in out  # client allowed as peer
+        assert '"policy for default/client"' not in out
+        assert '"policy for default/other"' not in out
+        # Peer from the ops namespace via namespace_selector.
+        assert "10.0.1.5/32" in out
+        # ip_block excepts RETURN inside their OWN chain (so later
+        # peers of the same rule still evaluate), block sets the mark.
+        assert "-s 192.168.9.0/24 -j RETURN" in out
+        assert f"-s 192.168.0.0/16 {npf.ADMIT}" in out
+        bline = [ln for ln in out.splitlines()
+                 if "192.168.9.0/24" in ln][0]
+        assert bline.startswith("-A KTPU-NPB-")
+        # Port scoping on rule 0.
+        assert "--dport 8080" in out
+        # Default deny for each governed direction.
+        assert out.count("default deny (ingress)") == 2
+        assert out.count("default deny (egress)") == 2
+        # Pending pod (no IP) is never dispatched.
+        assert "policy for default/no-ip" not in out
+
+    def test_no_accept_verdicts_both_sides_evaluated(self):
+        """Pod chains must RETURN-on-mark, never ACCEPT: an ACCEPT
+        would end hook traversal and skip the OTHER endpoint's policy
+        when both ends of a connection are governed."""
+        policies, pods, namespaces = fixture()
+        out = npf.render_filter_rules(policies, pods, namespaces)
+        assert "-j ACCEPT" not in out
+        assert f"-m mark --mark {npf.MARK}/{npf.MARK} -j RETURN" in out
+        # Every pod chain clears the verdict bit before evaluating.
+        assert out.count(f"-j MARK --set-xmark 0x0/{npf.MARK}") == 4
+
+    def test_unselected_cluster_renders_empty_dispatch(self):
+        out = npf.render_filter_rules([], [], [])
+        assert out == "*filter\n:KTPU-NETPOL - [0:0]\nCOMMIT\n"
+
+    def test_empty_from_peers_allows_anywhere_on_port(self):
+        pol = n.NetworkPolicy(
+            metadata=ObjectMeta(name="open", namespace="default"),
+            spec=n.NetworkPolicySpec(
+                pod_selector=LabelSelector(match_labels={"app": "web"}),
+                ingress=[n.NetworkPolicyIngressRule(
+                    ports=[n.NetworkPolicyPort(port=443)])]))
+        pods = [_pod("web-0", labels={"app": "web"}, ip="10.0.0.10")]
+        out = npf.render_filter_rules([pol], pods, [_ns("default")])
+        assert f"-p tcp --dport 443 {npf.ADMIT}" in out
+        assert "default deny (ingress)" in out
+        assert "default deny (egress)" not in out  # Ingress-only policy
