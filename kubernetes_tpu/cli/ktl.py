@@ -594,6 +594,156 @@ async def exec_interactive(base: str, namespace: str, pod: str,
     return exit_code
 
 
+async def _resolve_exec(client, namespace: str, pod_name: str):
+    """-> (node base URL, ssl ctx) for a scheduled pod's agent server.
+    The one copy of the exec endpoint resolution (cp's chunk loop and
+    exec both ride it)."""
+    pod = await client.get("pods", namespace, pod_name)
+    if not pod.spec.node_name:
+        raise SystemExit(f"ktl: pod {pod_name} is not scheduled yet")
+    conn = await _node_daemon_base(client, pod.spec.node_name)
+    if conn is None:
+        raise SystemExit(f"ktl: node {pod.spec.node_name} has no "
+                         "reachable agent server")
+    return conn
+
+
+async def _exec_on(session, base: str, node_ssl, namespace: str,
+                   pod_name: str, container: str, cmd: list[str],
+                   timeout: float = 60.0) -> tuple[int, str]:
+    url = f"{base}/exec/{namespace}/{pod_name}/{container or '-'}"
+    async with session.post(url, json={"command": cmd,
+                                       "timeout": timeout},
+                            **_ssl_kw(node_ssl)) as r:
+        if r.status != 200:
+            raise SystemExit(f"ktl: {(await r.text()).strip()}")
+        body = await r.json()
+    return int(body["exit_code"]), body["output"]
+
+
+async def _exec_capture(client, namespace: str, pod_name: str,
+                        container: str, cmd: list[str],
+                        timeout: float = 60.0) -> tuple[int, str]:
+    """One-shot exec -> (exit_code, output). Shared by exec and cp."""
+    import aiohttp
+    base, node_ssl = await _resolve_exec(client, namespace, pod_name)
+    client_timeout = aiohttp.ClientTimeout(total=timeout + 30)
+    async with aiohttp.ClientSession(timeout=client_timeout) as s:
+        return await _exec_on(s, base, node_ssl, namespace, pod_name,
+                              container, cmd, timeout)
+
+
+async def cmd_cp(args) -> int:
+    """``ktl cp pod:path local`` / ``ktl cp local pod:path`` — file and
+    directory copy over the exec seam (reference: kubectl cp, which
+    tunnels tar through exec streams; the one-shot exec here is text,
+    so payloads ride base64 — chunked on upload to stay under argv
+    limits)."""
+    def parse(side: str):
+        pod, sep, path = side.partition(":")
+        return (pod, path) if sep else (None, side)
+
+    src_pod, src_path = parse(args.src)
+    dst_pod, dst_path = parse(args.dst)
+    if (src_pod is None) == (dst_pod is None):
+        print("Error: exactly one of src/dst must be pod:path",
+              file=sys.stderr)
+        return 1
+    client = make_client(args)
+    c = args.container
+    pod_name = src_pod or dst_pod
+    try:
+        import aiohttp
+        base, node_ssl = await _resolve_exec(client, args.namespace,
+                                             pod_name)
+        timeout = aiohttp.ClientTimeout(total=300)
+        async with aiohttp.ClientSession(timeout=timeout) as s:
+            async def run(cmd):
+                return await _exec_on(s, base, node_ssl, args.namespace,
+                                      pod_name, c, cmd)
+            if src_pod is not None:
+                return await _cp_download(run, src_pod, src_path,
+                                          dst_path)
+            return await _cp_upload(run, args.src, dst_pod, dst_path)
+    finally:
+        await client.close()
+
+
+async def _cp_download(run, src_pod: str, src_path: str,
+                       dst_path: str) -> int:
+    import base64
+    import shlex
+    q = shlex.quote(src_path)
+    # Explicit dir probe — sniffing tar magic in the payload would
+    # misread a copied .tar FILE as a directory and explode it.
+    rc, _out = await run(["sh", "-c", f"test -d {q}"])
+    is_dir = rc == 0
+    if is_dir:
+        cmd = (f"tar -C \"$(dirname {q})\" -cf - "
+               f"\"$(basename {q})\" | base64")
+    else:
+        cmd = f"base64 < {q}"
+    rc, out = await run(["sh", "-c", cmd])
+    if rc != 0:
+        print(f"Error: reading {src_pod}:{src_path} failed "
+              f"({out.strip()})", file=sys.stderr)
+        return 1
+    data = base64.b64decode(out)
+    if is_dir:
+        import io
+        import tarfile
+        os.makedirs(dst_path, exist_ok=True)
+        with tarfile.open(fileobj=io.BytesIO(data)) as tf:
+            tf.extractall(dst_path, filter="data")
+        print(f"copied {src_pod}:{src_path} -> {dst_path}/")
+    else:
+        if os.path.isdir(dst_path):
+            dst_path = os.path.join(dst_path, os.path.basename(src_path))
+        with open(dst_path, "wb") as f:
+            f.write(data)
+        print(f"copied {src_pod}:{src_path} -> {dst_path}")
+    return 0
+
+
+async def _cp_upload(run, local_src: str, dst_pod: str,
+                     dst_path: str) -> int:
+    import base64
+    import shlex
+    if os.path.isdir(local_src):
+        print("Error: directory upload not supported (tar locally and "
+              "copy the archive)", file=sys.stderr)
+        return 1
+    with open(local_src, "rb") as f:
+        payload = base64.b64encode(f.read()).decode()
+    qd = shlex.quote(dst_path)
+    qtmp = shlex.quote(dst_path + ".b64")
+
+    async def fail(msg, out):
+        print(f"Error: {msg} ({out.strip()})", file=sys.stderr)
+        # Best-effort: don't strand a partial temp file in the pod.
+        await run(["sh", "-c", f"rm -f {qtmp}"])
+        return 1
+
+    rc, out = await run(["sh", "-c", f": > {qtmp}"])
+    if rc != 0:
+        print(f"Error: cannot write in {dst_pod} ({out.strip()})",
+              file=sys.stderr)
+        return 1
+    CHUNK = 48 * 1024
+    for i in range(0, len(payload) or 1, CHUNK):
+        chunk = payload[i:i + CHUNK]  # base64 alphabet: shell-inert
+        rc, out = await run(["sh", "-c",
+                             f"printf %s {chunk} >> {qtmp}"])
+        if rc != 0:
+            return await fail("upload chunk failed", out)
+    rc, out = await run(["sh", "-c",
+                         f"base64 -d < {qtmp} > {qd} && rm {qtmp}"])
+    if rc != 0:
+        return await fail("decode failed", out)
+    print(f"copied {local_src} -> {dst_pod}:{dst_path}")
+    return 0
+
+
 async def cmd_exec(args) -> int:
     """Run a command in a running container (kubectl exec analog);
     ``-i`` switches to the interactive WebSocket stream."""
@@ -618,20 +768,15 @@ async def cmd_exec(args) -> int:
         import aiohttp
         # The HTTP call must outlive the exec's own timeout (aiohttp's
         # default 300s total would abort long execs client-side).
-        client_timeout = aiohttp.ClientTimeout(
-            total=(args.timeout if args.timeout is not None else 30.0) + 30)
+        one_shot_timeout = (args.timeout if args.timeout is not None
+                            else 30.0)
+        client_timeout = aiohttp.ClientTimeout(total=one_shot_timeout + 30)
         async with aiohttp.ClientSession(timeout=client_timeout) as s:
-            url = f"{base}/exec/{args.namespace}/{args.pod}/{container}"
-            one_shot_timeout = (args.timeout if args.timeout is not None
-                                else 30.0)
-            async with s.post(url, json={"command": args.cmd,
-                                         "timeout": one_shot_timeout},
-                              **_ssl_kw(node_ssl)) as r:
-                if r.status != 200:
-                    raise SystemExit(f"ktl: {(await r.text()).strip()}")
-                body = await r.json()
-        sys.stdout.write(body["output"])
-        return int(body["exit_code"])
+            code, output = await _exec_on(
+                s, base, node_ssl, args.namespace, args.pod, container,
+                args.cmd, timeout=one_shot_timeout)
+        sys.stdout.write(output)
+        return code
     finally:
         await client.close()
 
@@ -1955,6 +2100,13 @@ def build_parser() -> argparse.ArgumentParser:
 
     add("api-resources", cmd_api_resources, help="list server resources")
     add("version", cmd_version, help="client+server version")
+
+    sp = add("cp", cmd_cp,
+             help="copy files to/from a container (pod:path <-> local)")
+    sp.add_argument("src", help="pod:path or local path")
+    sp.add_argument("dst", help="local path or pod:path")
+    sp.add_argument("-n", "--namespace", default="default")
+    sp.add_argument("-c", "--container", default="")
 
     sp = add("exec", cmd_exec, help="run a command in a container")
     sp.add_argument("pod")

@@ -195,25 +195,31 @@ def render_filter_rules(policies: list[NetworkPolicy], pods: list[t.Pod],
                 if rchain not in rule_bodies:
                     body: list[str] = []
                     for r in resolved:
-                        for pm in _match_ports(r.ports):
-                            pm_sfx = f" {pm}" if pm else ""
-                            if r.any_peer:
-                                body.append(f"-A {rchain}{pm_sfx} {ADMIT}")
-                            elif r.cidr and r.excepts:
-                                # Excepts RETURN from their OWN chain so
-                                # later peers of this rule still run.
-                                bchain = block_chain(rchain, r.cidr,
-                                                     tuple(r.excepts))
-                                if bchain not in block_bodies:
-                                    bb = [
-                                        f"-A {bchain} {peer_flag} {ex} "
-                                        f"-j RETURN"
-                                        for ex in r.excepts]
+                        pms = _match_ports(r.ports)
+                        if r.cidr and r.excepts:
+                            # Excepts RETURN from their OWN chain so
+                            # later peers of this rule still run. ALL
+                            # the rule's ports live inside the block
+                            # chain behind ONE jump (keying the chain
+                            # per-port would drop every port but the
+                            # first).
+                            bchain = block_chain(rchain, r.cidr,
+                                                 tuple(r.excepts))
+                            if bchain not in block_bodies:
+                                bb = [f"-A {bchain} {peer_flag} {ex} "
+                                      f"-j RETURN" for ex in r.excepts]
+                                for pm in pms:
+                                    pm_sfx = f" {pm}" if pm else ""
                                     bb.append(
                                         f"-A {bchain} {peer_flag} "
                                         f"{r.cidr}{pm_sfx} {ADMIT}")
-                                    block_bodies[bchain] = bb
-                                body.append(f"-A {rchain} -j {bchain}")
+                                block_bodies[bchain] = bb
+                            body.append(f"-A {rchain} -j {bchain}")
+                            continue
+                        for pm in pms:
+                            pm_sfx = f" {pm}" if pm else ""
+                            if r.any_peer:
+                                body.append(f"-A {rchain}{pm_sfx} {ADMIT}")
                             elif r.cidr:
                                 body.append(
                                     f"-A {rchain} {peer_flag} {r.cidr}"

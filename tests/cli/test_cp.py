@@ -1,0 +1,84 @@
+"""ktl cp — file/directory copy over the exec seam (reference:
+kubectl cp's tar-over-exec)."""
+import asyncio
+import contextlib
+import io
+import os
+import sys
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.cli import ktl
+from kubernetes_tpu.cluster.local import NodeSpec
+
+from ..e2e.test_local_cluster import wait_for
+from kubernetes_tpu.cluster.local import LocalCluster
+
+
+def fast_cluster(tmp_path, nodes):
+    # tls=False: ktl.main's --server path has no CA flags in-test.
+    return LocalCluster(data_dir=str(tmp_path), nodes=nodes,
+                        status_interval=0.3, heartbeat_interval=0.3,
+                        tls=False)
+
+
+async def ktl_out(args, server, **client_kw):
+    buf, err = io.StringIO(), io.StringIO()
+
+    def call():
+        with contextlib.redirect_stdout(buf), contextlib.redirect_stderr(err):
+            return ktl.main(["--server", server] + args)
+    rc = await asyncio.to_thread(call)
+    return rc, buf.getvalue(), err.getvalue()
+
+
+async def test_cp_round_trip(tmp_path):
+    cluster = fast_cluster(tmp_path, [NodeSpec(name="n0")])
+    await cluster.start()
+    client = cluster.make_client()
+    try:
+        await cluster.wait_for_nodes_ready(timeout=20)
+        await client.create(t.Pod(
+            metadata=ObjectMeta(name="box", namespace="default"),
+            spec=t.PodSpec(containers=[t.Container(
+                name="main", image="inline",
+                command=[sys.executable, "-c",
+                         "import time; time.sleep(120)"])])))
+
+        async def running():
+            got = await client.get("pods", "default", "box")
+            return got.status.phase == t.POD_RUNNING
+        await wait_for(running, timeout=20)
+
+        src = tmp_path / "src.bin"
+        src.write_bytes(b"binary\x00\x01 payload\n" * 5000)
+        rc, out, err = await ktl_out(
+            ["cp", str(src), "box:upload.bin"], cluster.base_url)
+        assert rc == 0, err
+
+        back = tmp_path / "back.bin"
+        rc, out, err = await ktl_out(
+            ["cp", "box:upload.bin", str(back)], cluster.base_url)
+        assert rc == 0, err
+        assert back.read_bytes() == src.read_bytes()
+
+        # Directory download (tar path).
+        rc, out, err = await ktl_out(
+            ["exec", "box", "--", "sh", "-c",
+             "mkdir -p d && cp upload.bin d/a.bin && echo note > d/n.txt"],
+            cluster.base_url)
+        assert rc == 0, err
+        dl = tmp_path / "dl"
+        rc, out, err = await ktl_out(
+            ["cp", "box:d", str(dl)], cluster.base_url)
+        assert rc == 0, err
+        assert (dl / "d" / "a.bin").read_bytes() == src.read_bytes()
+        assert (dl / "d" / "n.txt").read_text().strip() == "note"
+
+        # Both sides local / both sides pod: loud error.
+        rc, out, err = await ktl_out(
+            ["cp", str(src), str(back)], cluster.base_url)
+        assert rc == 1 and "exactly one" in err
+    finally:
+        await client.close()
+        await cluster.stop()
