@@ -488,14 +488,8 @@ from ..client.nodeaccess import ssl_kw as _ssl_kw  # noqa: E402
 async def cmd_logs(args) -> int:
     client = make_client(args)
     try:
-        pod = await client.get("pods", args.namespace, args.pod)
-        if not pod.spec.node_name:
-            raise SystemExit(f"ktl: pod {args.pod} is not scheduled yet")
-        conn = await _node_daemon_base(client, pod.spec.node_name)
-        if conn is None:
-            raise SystemExit(f"ktl: node {pod.spec.node_name} has no "
-                             "reachable agent server")
-        base, node_ssl = conn
+        base, node_ssl = await _resolve_exec(client, args.namespace,
+                                             args.pod)
         container = args.container or "-"
         import aiohttp
         params = {"tail": str(args.tail)} if args.tail else {}
@@ -621,18 +615,6 @@ async def _exec_on(session, base: str, node_ssl, namespace: str,
     return int(body["exit_code"]), body["output"]
 
 
-async def _exec_capture(client, namespace: str, pod_name: str,
-                        container: str, cmd: list[str],
-                        timeout: float = 60.0) -> tuple[int, str]:
-    """One-shot exec -> (exit_code, output). Shared by exec and cp."""
-    import aiohttp
-    base, node_ssl = await _resolve_exec(client, namespace, pod_name)
-    client_timeout = aiohttp.ClientTimeout(total=timeout + 30)
-    async with aiohttp.ClientSession(timeout=client_timeout) as s:
-        return await _exec_on(s, base, node_ssl, namespace, pod_name,
-                              container, cmd, timeout)
-
-
 async def cmd_cp(args) -> int:
     """``ktl cp pod:path local`` / ``ktl cp local pod:path`` — file and
     directory copy over the exec seam (reference: kubectl cp, which
@@ -658,9 +640,11 @@ async def cmd_cp(args) -> int:
                                              pod_name)
         timeout = aiohttp.ClientTimeout(total=300)
         async with aiohttp.ClientSession(timeout=timeout) as s:
-            async def run(cmd):
+            async def run(cmd, timeout=240.0):
+                # Long transfer steps (multi-GB base64 passes) must fit
+                # inside the session's 300s budget, not the 60s default.
                 return await _exec_on(s, base, node_ssl, args.namespace,
-                                      pod_name, c, cmd)
+                                      pod_name, c, cmd, timeout=timeout)
             if src_pod is not None:
                 return await _cp_download(run, src_pod, src_path,
                                           dst_path)
@@ -679,8 +663,13 @@ async def _cp_download(run, src_pod: str, src_path: str,
     rc, _out = await run(["sh", "-c", f"test -d {q}"])
     is_dir = rc == 0
     if is_dir:
-        cmd = (f"tar -C \"$(dirname {q})\" -cf - "
-               f"\"$(basename {q})\" | base64")
+        # tar's status must fail the copy (a pipeline returns base64's
+        # exit code) and its stderr must stay OUT of the payload (the
+        # runtime merges stderr into stdout, which would corrupt the
+        # base64 stream): stage the archive, then encode it.
+        cmd = (f"t=$(mktemp) && tar -C \"$(dirname {q})\" -cf \"$t\" "
+               f"\"$(basename {q})\" 2>&1 >/dev/null && "
+               f"base64 < \"$t\"; rc=$?; rm -f \"$t\"; exit $rc")
     else:
         cmd = f"base64 < {q}"
     rc, out = await run(["sh", "-c", cmd])
@@ -749,14 +738,8 @@ async def cmd_exec(args) -> int:
     ``-i`` switches to the interactive WebSocket stream."""
     client = make_client(args)
     try:
-        pod = await client.get("pods", args.namespace, args.pod)
-        if not pod.spec.node_name:
-            raise SystemExit(f"ktl: pod {args.pod} is not scheduled yet")
-        conn = await _node_daemon_base(client, pod.spec.node_name)
-        if conn is None:
-            raise SystemExit(f"ktl: node {pod.spec.node_name} has no "
-                             "reachable agent server")
-        base, node_ssl = conn
+        base, node_ssl = await _resolve_exec(client, args.namespace,
+                                             args.pod)
         container = args.container or "-"
         if getattr(args, "stdin", False):
             # Interactive sessions outlive the one-shot default; an
@@ -1088,6 +1071,125 @@ async def cmd_wait(args) -> int:
                     return 0
         finally:
             w.cancel()
+    finally:
+        await client.close()
+
+
+async def cmd_taint(args) -> int:
+    """``ktl taint nodes NAME key=value:Effect`` / ``key:Effect-`` to
+    remove (kubectl taint analog; reference pkg/kubectl/cmd/taint.go).
+    Conflict-retried read-modify-write like the other node mutations."""
+    client = make_client(args)
+    try:
+        spec = args.taint
+        remove = spec.endswith("-")
+        if remove:
+            spec = spec[:-1]
+        if ":" in spec:
+            kv, _, effect = spec.rpartition(":")
+        else:
+            kv, effect = spec, ""  # key- removal form
+        if not kv or (not effect and not remove):
+            print("Error: want key=value:Effect (or key:Effect- / "
+                  "key- to remove)", file=sys.stderr)
+            return 1
+        key, _, value = kv.partition("=")
+        if not remove and effect not in (
+                t.TAINT_NO_SCHEDULE, t.TAINT_PREFER_NO_SCHEDULE,
+                t.TAINT_NO_EXECUTE):
+            print(f"Error: effect must be one of NoSchedule, "
+                  f"PreferNoSchedule, NoExecute; got {effect!r}",
+                  file=sys.stderr)
+            return 1
+        for attempt in range(20):
+            node = await client.get("nodes", "", args.node)
+            taints = list(node.spec.taints)
+            if remove:
+                kept = [x for x in taints
+                        if not (x.key == key
+                                and (not effect or x.effect == effect))]
+                if len(kept) == len(taints):
+                    print(f"Error: node {args.node!r} has no taint "
+                          f"{key!r}", file=sys.stderr)
+                    return 1
+                node.spec.taints = kept
+                verb = "untainted"
+            else:
+                replaced = False
+                for x in taints:
+                    if x.key == key and x.effect == effect:
+                        if x.value == value and not args.overwrite:
+                            print(f"node/{args.node} already has taint "
+                                  f"{spec}")
+                            return 0
+                        if not args.overwrite:
+                            print(f"Error: taint {key}:{effect} exists "
+                                  f"with value {x.value!r}; pass "
+                                  f"--overwrite", file=sys.stderr)
+                            return 1
+                        x.value = value
+                        replaced = True
+                if not replaced:
+                    taints.append(t.Taint(key=key, value=value,
+                                          effect=effect))
+                node.spec.taints = taints
+                verb = "tainted"
+            try:
+                await client.update(node)
+                print(f"node/{args.node} {verb}")
+                return 0
+            except errors.ConflictError:
+                if attempt == 19:
+                    raise
+                await asyncio.sleep(0.05)
+        return 1
+    finally:
+        await client.close()
+
+
+async def cmd_set_image(args) -> int:
+    """``ktl set image deployment/NAME container=image`` (kubectl set
+    image analog) — the rollout-triggering one-liner."""
+    client = make_client(args)
+    try:
+        kind, _, name = args.target.partition("/")
+        plural = resolve_plural(kind)
+        if plural not in ("deployments", "statefulsets", "daemonsets",
+                          "replicasets", "pods"):
+            print(f"Error: set image supports workload kinds, "
+                  f"got {kind!r}", file=sys.stderr)
+            return 1
+        updates = {}
+        for spec in args.images:
+            cname, eq, image = spec.partition("=")
+            if not eq or not cname or not image:
+                print(f"Error: want container=image, got {spec!r}",
+                      file=sys.stderr)
+                return 1
+            updates[cname] = image
+        for attempt in range(20):
+            obj = await client.get(plural, args.namespace, name)
+            containers = (obj.spec.containers if plural == "pods"
+                          else obj.spec.template.spec.containers)
+            missing = set(updates) - {c.name for c in containers}
+            if missing:
+                print(f"Error: no container(s) {sorted(missing)} in "
+                      f"{args.target}", file=sys.stderr)
+                return 1
+            for cont in containers:
+                if cont.name in updates:
+                    cont.image = updates[cont.name]
+            try:
+                await client.update(obj)
+                for cname, image in updates.items():
+                    print(f"{args.target} container {cname} image "
+                          f"set to {image}")
+                return 0
+            except errors.ConflictError:
+                if attempt == 19:
+                    raise
+                await asyncio.sleep(0.05)
+        return 1
     finally:
         await client.close()
 
@@ -2082,6 +2184,21 @@ def build_parser() -> argparse.ArgumentParser:
     for name, fn in (("cordon", cmd_cordon), ("uncordon", cmd_uncordon)):
         sp = add(name, fn, help=f"{name} a node")
         sp.add_argument("node")
+
+    sp = add("taint", cmd_taint, help="add/remove node taints")
+    sp.add_argument("resource", choices=["nodes", "node", "no"],
+                    help="only nodes are taintable")
+    sp.add_argument("node")
+    sp.add_argument("taint",
+                    help="key=value:Effect to add, key:Effect- or "
+                         "key- to remove")
+    sp.add_argument("--overwrite", action="store_true", default=False)
+
+    sp = add("set", cmd_set_image, help="set image on a workload")
+    sp.add_argument("subcommand", choices=["image"])
+    sp.add_argument("target", help="deployment/NAME (or sts/ds/rs/pod)")
+    sp.add_argument("images", nargs="+", help="container=image ...")
+    sp.add_argument("-n", "--namespace", default="default")
 
     sp = add("drain", cmd_drain, help="cordon + evict all pods")
     sp.add_argument("node")

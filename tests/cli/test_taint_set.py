@@ -1,0 +1,94 @@
+"""ktl taint + ktl set image (reference: pkg/kubectl/cmd/{taint,set}.go)."""
+import asyncio
+import contextlib
+import io
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.cli import ktl
+
+
+async def ktl_out(args, server):
+    buf, err = io.StringIO(), io.StringIO()
+
+    def call():
+        with contextlib.redirect_stdout(buf), contextlib.redirect_stderr(err):
+            return ktl.main(["--server", server] + args)
+    rc = await asyncio.to_thread(call)
+    return rc, buf.getvalue(), err.getvalue()
+
+
+async def start_server():
+    srv = APIServer()
+    srv.registry.create(t.Namespace(metadata=ObjectMeta(name="default")))
+    srv.registry.create(t.Node(metadata=ObjectMeta(name="n0")))
+    port = await srv.start()
+    return srv, f"http://127.0.0.1:{port}"
+
+
+class TestTaint:
+    async def test_add_overwrite_remove(self):
+        srv, base = await start_server()
+        try:
+            rc, out, err = await ktl_out(
+                ["taint", "nodes", "n0", "pool=ml:NoSchedule"], base)
+            assert rc == 0, err
+            (taint,) = srv.registry.get("nodes", "", "n0").spec.taints
+            assert (taint.key, taint.value, taint.effect) == \
+                ("pool", "ml", "NoSchedule")
+            # Same value again: idempotent no-op.
+            rc, out, err = await ktl_out(
+                ["taint", "nodes", "n0", "pool=ml:NoSchedule"], base)
+            assert rc == 0 and "already" in out
+            # New value without --overwrite: refused.
+            rc, out, err = await ktl_out(
+                ["taint", "nodes", "n0", "pool=batch:NoSchedule"], base)
+            assert rc == 1 and "--overwrite" in err
+            rc, out, err = await ktl_out(
+                ["taint", "nodes", "n0", "pool=batch:NoSchedule",
+                 "--overwrite"], base)
+            assert rc == 0, err
+            (taint,) = srv.registry.get("nodes", "", "n0").spec.taints
+            assert taint.value == "batch"
+            # Remove by key:Effect-.
+            rc, out, err = await ktl_out(
+                ["taint", "nodes", "n0", "pool:NoSchedule-"], base)
+            assert rc == 0, err
+            assert srv.registry.get("nodes", "", "n0").spec.taints == []
+            # Removing again: loud error.
+            rc, out, err = await ktl_out(
+                ["taint", "nodes", "n0", "pool-"], base)
+            assert rc == 1 and "no taint" in err
+        finally:
+            await srv.stop()
+
+    async def test_bad_effect_rejected(self):
+        srv, base = await start_server()
+        try:
+            rc, out, err = await ktl_out(
+                ["taint", "nodes", "n0", "k=v:Sideways"], base)
+            assert rc == 1 and "effect must be" in err
+        finally:
+            await srv.stop()
+
+
+class TestSetImage:
+    async def test_set_image_on_deployment_and_pod(self):
+        srv, base = await start_server()
+        try:
+            rc, _o, err = await ktl_out(
+                ["run", "web", "--image", "app:v1", "--restart",
+                 "Always"], base)
+            assert rc == 0, err
+            rc, out, err = await ktl_out(
+                ["set", "image", "deployment/web", "web=app:v2"], base)
+            assert rc == 0, err
+            dep = srv.registry.get("deployments", "default", "web")
+            assert dep.spec.template.spec.containers[0].image == "app:v2"
+            # Unknown container: loud, nothing changed.
+            rc, out, err = await ktl_out(
+                ["set", "image", "deployment/web", "nope=x:y"], base)
+            assert rc == 1 and "no container" in err
+        finally:
+            await srv.stop()
