@@ -1679,6 +1679,92 @@ async def cmd_rollout(args) -> int:
         await client.close()
 
 
+async def cmd_create(args) -> int:
+    """``ktl create configmap|secret NAME --from-literal/--from-file``
+    and ``ktl create namespace NAME`` (the reference's imperative
+    creators, pkg/kubectl/cmd/create_*.go)."""
+    import base64 as b64
+    client = make_client(args)
+    try:
+        data: dict = {}
+
+        def put(key, value, source):
+            if not key or "/" in key:
+                print(f"Error: {source}: invalid key {key!r}",
+                      file=sys.stderr)
+                return False
+            if key in data:
+                # kubectl parity: silent last-wins would ship a
+                # configmap missing data the user explicitly passed.
+                print(f"Error: {source}: key {key!r} already exists",
+                      file=sys.stderr)
+                return False
+            data[key] = value
+            return True
+
+        for lit in args.from_literal or []:
+            k, eq, v = lit.partition("=")
+            if not eq or not k:
+                print(f"Error: --from-literal wants KEY=VALUE, got "
+                      f"{lit!r}", file=sys.stderr)
+                return 1
+            if not put(k, v, f"--from-literal {lit!r}"):
+                return 1
+        for path in args.from_file or []:
+            # kubectl: KEY=path, or bare path (key = basename). A bare
+            # path may itself contain '=': treat it as KEY=path only
+            # when the would-be key looks like a key (no separators).
+            key, eq, fpath = path.partition("=")
+            if not eq or not key or "/" in key or os.sep in key:
+                key, fpath = os.path.basename(path), path
+            try:
+                with open(fpath, "rb") as f:
+                    raw = f.read()
+            except OSError as e:
+                print(f"Error: --from-file {fpath}: {e}", file=sys.stderr)
+                return 1
+            if not put(key, raw, f"--from-file {path!r}"):
+                return 1
+        if args.kind == "namespace":
+            if data:
+                print("Error: namespace takes no --from-* flags",
+                      file=sys.stderr)
+                return 1
+            await client.create(t.Namespace(
+                metadata=ObjectMeta(name=args.name)))
+            print(f"namespace/{args.name} created")
+            return 0
+        if args.kind == "configmap":
+            cm_data = {}
+            for k, v in data.items():
+                if isinstance(v, bytes):
+                    try:
+                        v = v.decode()
+                    except UnicodeDecodeError:
+                        print(f"Error: --from-file {k!r} is not UTF-8; "
+                              f"use a secret for binary data",
+                              file=sys.stderr)
+                        return 1
+                cm_data[k] = v
+            await client.create(t.ConfigMap(
+                metadata=ObjectMeta(name=args.name,
+                                    namespace=args.namespace),
+                data=cm_data))
+            print(f"configmap/{args.name} created")
+            return 0
+        sec_data = {
+            k: b64.b64encode(v if isinstance(v, bytes)
+                             else v.encode()).decode()
+            for k, v in data.items()}
+        await client.create(t.Secret(
+            metadata=ObjectMeta(name=args.name, namespace=args.namespace),
+            data=sec_data))
+        print(f"secret/{args.name} created")
+        return 0
+    finally:
+        await client.close()
+
+
 async def cmd_run(args) -> int:
     """``ktl run NAME --image=IMG`` — imperative pod (default) or, with
     ``--restart=Always``, a Deployment (reference: kubectl run's
@@ -2254,6 +2340,17 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--to-revision", type=int, default=0)
     sp.add_argument("--timeout", type=float, default=60.0,
                     help="status wait bound (seconds)")
+
+    sp = add("create", cmd_create,
+             help="imperative create: configmap|secret|namespace")
+    sp.add_argument("kind", choices=["configmap", "secret", "namespace"])
+    sp.add_argument("name")
+    sp.add_argument("--from-literal", action="append", default=[],
+                    help="KEY=VALUE (repeatable)")
+    sp.add_argument("--from-file", action="append", default=[],
+                    help="[KEY=]path (repeatable; key defaults to "
+                         "the basename)")
+    sp.add_argument("-n", "--namespace", default="default")
 
     sp = add("run", cmd_run, help="run an image as a pod (or deployment)")
     sp.add_argument("name")

@@ -141,6 +141,64 @@ class TestAutoscale:
             await srv.stop()
 
 
+class TestCreate:
+    async def test_create_configmap_literal_and_file(self, tmp_path):
+        srv, base = await start_server()
+        try:
+            f = tmp_path / "app.conf"
+            f.write_text("threads=4\n")
+            rc, out, err = await ktl_out(
+                ["create", "configmap", "cfg", "--from-literal", "a=1",
+                 "--from-file", str(f),
+                 "--from-file", f"renamed={f}"], base)
+            assert rc == 0, err
+            cm = srv.registry.get("configmaps", "default", "cfg")
+            assert cm.data == {"a": "1", "app.conf": "threads=4\n",
+                               "renamed": "threads=4\n"}
+        finally:
+            await srv.stop()
+
+    async def test_create_secret_binary_and_namespace(self, tmp_path):
+        import base64
+        srv, base = await start_server()
+        try:
+            f = tmp_path / "key.bin"
+            f.write_bytes(b"\xff\xfebinary")  # invalid UTF-8
+            rc, out, err = await ktl_out(
+                ["create", "secret", "tls", "--from-file", str(f),
+                 "--from-literal", "user=admin"], base)
+            assert rc == 0, err
+            sec = srv.registry.get("secrets", "default", "tls")
+            assert base64.b64decode(sec.data["key.bin"]) == b"\xff\xfebinary"
+            assert base64.b64decode(sec.data["user"]) == b"admin"
+            # Binary into a CONFIGMAP: loud error.
+            rc, out, err = await ktl_out(
+                ["create", "configmap", "bad", "--from-file", str(f)],
+                base)
+            assert rc == 1 and "not UTF-8" in err
+            rc, out, err = await ktl_out(
+                ["create", "namespace", "team-x"], base)
+            assert rc == 0, err
+            srv.registry.get("namespaces", "", "team-x")
+            # Duplicate keys are rejected, not silently last-wins.
+            rc, out, err = await ktl_out(
+                ["create", "configmap", "dup", "--from-literal", "a=1",
+                 "--from-literal", "a=2"], base)
+            assert rc == 1 and "already exists" in err
+            # A bare path containing '=' resolves as a PATH (basename
+            # key), not KEY=path — the right file is read; the '=' in
+            # the derived key is then rejected by server validation
+            # (kubectl's key charset), loudly naming the key.
+            eq_file = f.parent / "weird=name.txt"
+            eq_file.write_text("v")
+            rc, out, err = await ktl_out(
+                ["create", "configmap", "eq", "--from-file",
+                 str(eq_file)], base)
+            assert rc == 1 and "weird=name.txt" in err
+        finally:
+            await srv.stop()
+
+
 class TestRolloutPauseResume:
     async def test_pause_resume_round_trip(self):
         srv, base = await start_server()
