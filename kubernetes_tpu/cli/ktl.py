@@ -496,6 +496,12 @@ async def cmd_logs(args) -> int:
         follow = getattr(args, "follow", False)
         if follow:
             params["follow"] = "1"
+        if getattr(args, "previous", False):
+            if follow:
+                print("Error: --previous cannot follow (the instance "
+                      "already exited)", file=sys.stderr)
+                return 1
+            params["previous"] = "1"
         # Unbounded timeout ONLY for follow (the stream lives as long
         # as the container); plain fetches keep aiohttp's default so a
         # wedged agent errors instead of hanging the CLI.
@@ -613,6 +619,51 @@ async def _exec_on(session, base: str, node_ssl, namespace: str,
             raise SystemExit(f"ktl: {(await r.text()).strip()}")
         body = await r.json()
     return int(body["exit_code"]), body["output"]
+
+
+async def cmd_attach(args) -> int:
+    """``ktl attach POD`` — stream a running container's output
+    (kubectl attach analog over the node server's WebSocket attach
+    stream; Ctrl-C detaches, the container keeps running)."""
+    import aiohttp
+    client = make_client(args)
+    try:
+        base, node_ssl = await _resolve_exec(client, args.namespace,
+                                             args.pod)
+        container = args.container or "-"
+        url = f"{base}/attach/{args.namespace}/{args.pod}/{container}/stream"
+        out_buf = getattr(sys.stdout, "buffer", None)
+        import codecs
+        # Incremental decoder for text-only stdout: frame boundaries
+        # may split multi-byte characters (same fix as cmd_logs).
+        dec = codecs.getincrementaldecoder("utf-8")("replace")
+        try:
+            async with aiohttp.ClientSession() as s:
+                async with s.ws_connect(url, **_ssl_kw(node_ssl)) as ws:
+                    print(f"attached to {args.pod} (Ctrl-C detaches)",
+                          file=sys.stderr)
+                    async for msg in ws:
+                        if msg.type == aiohttp.WSMsgType.BINARY:
+                            if out_buf is not None:
+                                out_buf.write(msg.data)
+                                out_buf.flush()
+                            else:
+                                sys.stdout.write(dec.decode(msg.data))
+                                sys.stdout.flush()
+                        elif msg.type in (aiohttp.WSMsgType.CLOSE,
+                                          aiohttp.WSMsgType.ERROR):
+                            break
+        except aiohttp.WSServerHandshakeError as e:
+            # The server's rejection text (e.g. "pick one" with the
+            # container list) is the actionable part — not a traceback.
+            print(f"ktl: attach refused ({e.status}): "
+                  f"{e.message or e.headers}", file=sys.stderr)
+            return 1
+        except (KeyboardInterrupt, asyncio.CancelledError):
+            return 0  # detach, never kill
+        return 0
+    finally:
+        await client.close()
 
 
 async def cmd_cp(args) -> int:
@@ -2216,6 +2267,9 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("-c", "--container", default="")
     sp.add_argument("-n", "--namespace", default="default")
     sp.add_argument("--tail", type=int, default=0)
+    sp.add_argument("-p", "--previous", action="store_true",
+                    default=False,
+                    help="logs of the previous container instance")
     sp.add_argument("-f", "--follow", action="store_true", default=False,
                     help="stream new output until the container exits")
 
@@ -2303,6 +2357,12 @@ def build_parser() -> argparse.ArgumentParser:
 
     add("api-resources", cmd_api_resources, help="list server resources")
     add("version", cmd_version, help="client+server version")
+
+    sp = add("attach", cmd_attach,
+             help="stream a running container's output (Ctrl-C detaches)")
+    sp.add_argument("pod")
+    sp.add_argument("-c", "--container", default="")
+    sp.add_argument("-n", "--namespace", default="default")
 
     sp = add("cp", cmd_cp,
              help="copy files to/from a container (pod:path <-> local)")

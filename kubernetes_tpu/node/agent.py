@@ -768,9 +768,11 @@ class NodeAgent:
                 rat[container.name] = 0.0
                 self.recorder.event(pod, "Normal", "Restarting",
                                     f"container {container.name} (count {n + 1})")
-                # The replaced container's runtime record (and log file)
-                # must not accumulate across restarts.
-                await self.runtime.remove_container(st.id)
+                # The replaced record is KEPT — it is exactly what
+                # ``ktl logs --previous`` serves. Accumulation is the
+                # container GC's job (max_per_pod_container retains the
+                # newest dead instance per container; the reference's
+                # MaxPerPodContainer contract).
             await self._start_container(pod, container, cmap)
 
     async def _ensure_init_containers(self, pod: t.Pod,

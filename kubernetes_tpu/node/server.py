@@ -200,6 +200,12 @@ class NodeAgentServer:
             {"items": [to_dict(p) for _, p in sorted(self.agent._pods.items())]})
 
     async def _logs(self, request):
+        if request.query.get("previous") in ("1", "true"):
+            cid = await self._resolve_previous_cid(request)
+            text = await self.agent.runtime.container_logs(
+                cid, tail=int(request.query["tail"])
+                if request.query.get("tail") else None)
+            return web.Response(text=text)
         cid = self._resolve_cid(request)
         tail = request.query.get("tail")
         if request.query.get("follow") not in ("1", "true"):
@@ -208,6 +214,39 @@ class NodeAgentServer:
             return web.Response(text=text)
         return await self._follow_logs(request, cid,
                                        int(tail) if tail else None)
+
+    async def _resolve_previous_cid(self, request) -> str:
+        """kubectl logs --previous: the most recently FINISHED earlier
+        instance of the container (dead records are retained by the
+        container GC under its min-age/max-per-pod policy, which is
+        what bounds how far back 'previous' can reach)."""
+        ns = request.match_info["namespace"]
+        pod_name = request.match_info["pod"]
+        container = request.match_info["container"]
+        key = f"{ns}/{pod_name}"
+        uid = self.agent._pod_uids.get(key, "")
+        if not uid:
+            raise web.HTTPNotFound(text=f"pod {key} unknown on this node")
+        cmap = self.agent._containers.get(key, {})
+        if container == "-":
+            if len(cmap) != 1:
+                raise web.HTTPBadRequest(
+                    text=f"pod {key} has containers {sorted(cmap)}; "
+                         f"pick one")
+            container = next(iter(cmap))
+        elif cmap and container not in cmap:
+            raise web.HTTPNotFound(
+                text=f"pod {key} has no container {container!r}")
+        current = cmap.get(container, "")
+        dead = [st for st in await self.agent.runtime.list_containers()
+                if st.pod_uid == uid and st.name == container
+                and st.id != current and st.state != "running"]
+        if not dead:
+            raise web.HTTPNotFound(
+                text=f"no previous instance of {container!r} in {key} "
+                     f"(records may have been garbage-collected)")
+        dead.sort(key=lambda st: st.finished_at or 0.0)
+        return dead[-1].id
 
     async def _follow_logs(self, request, cid: str, tail):
         """kubectl logs -f: chunked stream of new output until the

@@ -1,0 +1,103 @@
+"""ktl logs --previous: restart retains the replaced record for the
+container GC to own (reference MaxPerPodContainer contract), and the
+node server resolves the prior instance."""
+import asyncio
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.apiserver.admission import default_chain
+from kubernetes_tpu.apiserver.registry import Registry
+from kubernetes_tpu.client.local import LocalClient
+from kubernetes_tpu.node.agent import NodeAgent
+from kubernetes_tpu.node.runtime import STATE_EXITED, FakeRuntime
+
+
+async def wait_for(cond, timeout=8.0):
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + timeout
+    while loop.time() < deadline:
+        got = cond()
+        if got:
+            return got
+        await asyncio.sleep(0.05)
+    raise AssertionError("condition not met in time")
+
+
+async def make_agent():
+    reg = Registry()
+    reg.admission = default_chain(reg)
+    reg.create(t.Namespace(metadata=ObjectMeta(name="default")))
+    client = LocalClient(reg)
+    runtime = FakeRuntime()
+    agent = NodeAgent(client, "node-a", runtime,
+                      status_interval=0.3, heartbeat_interval=0.3,
+                      pleg_interval=0.05)
+    await agent.start()
+    return reg, client, agent, runtime
+
+
+async def test_restart_retains_previous_record():
+    reg, client, agent, runtime = await make_agent()
+    try:
+        reg.create(t.Pod(
+            metadata=ObjectMeta(name="crash", namespace="default"),
+            spec=t.PodSpec(node_name="node-a",
+                           restart_policy=t.RESTART_ALWAYS,
+                           containers=[t.Container(name="c", image="i")])))
+
+        def first_cid():
+            cmap = agent._containers.get("default/crash", {})
+            return cmap.get("c")
+        cid1 = await wait_for(first_cid)
+        runtime.exit_container(cid1, code=1)
+
+        def restarted():
+            cid = first_cid()
+            return cid if cid and cid != cid1 else None
+        cid2 = await wait_for(restarted)
+
+        # The replaced record is retained (NOT removed at restart) so
+        # --previous can serve it; GC owns pruning.
+        statuses = {st.id: st
+                    for st in await runtime.list_containers()}
+        assert cid1 in statuses
+        assert statuses[cid1].state == STATE_EXITED
+
+        # The server-side resolution logic: previous = most recently
+        # finished non-current record of the same name.
+        uid = agent._pod_uids["default/crash"]
+        dead = [st for st in statuses.values()
+                if st.pod_uid == uid and st.name == "c"
+                and st.id != cid2 and st.state != "running"]
+        assert [st.id for st in dead] == [cid1]
+    finally:
+        await agent.stop()
+
+
+async def test_gc_keeps_newest_dead_instance():
+    """max_per_pod_container=1: after several restarts only the newest
+    dead record survives a GC pass — exactly what --previous serves."""
+    reg, client, agent, runtime = await make_agent()
+    try:
+        agent.container_gc.policy.min_age = 0.0
+        reg.create(t.Pod(
+            metadata=ObjectMeta(name="crash", namespace="default"),
+            spec=t.PodSpec(node_name="node-a",
+                           restart_policy=t.RESTART_ALWAYS,
+                           containers=[t.Container(name="c", image="i")])))
+        seen = []
+        for _ in range(3):
+            def next_cid():
+                cid = agent._containers.get("default/crash", {}).get("c")
+                return cid if cid and cid not in seen else None
+            cid = await wait_for(next_cid)
+            seen.append(cid)
+            runtime.exit_container(cid, code=1)
+        await wait_for(lambda: len(seen) == 3)
+        await agent.container_gc.collect()
+        statuses = {st.id: st for st in await runtime.list_containers()}
+        dead_ids = [cid for cid in seen[:-1] if cid in statuses]
+        # At most the newest dead instance survives the sweep.
+        assert seen[0] not in statuses
+    finally:
+        await agent.stop()
