@@ -2,6 +2,11 @@
 # Race/sanitizer discipline — the KUBE_RACE="-race" analog
 # (reference: hack/make-rules/test.sh:107,285,331).
 #
+# Sibling: hack/verify.sh — tpuvet static analysis (the go-vet /
+# hack/verify-*.sh analog) for what the sanitizers cannot see; the
+# runtime complements TPU_CACHE_MUTATION_DETECTOR=1 and TPU_LOCKDEP=1
+# are documented there.
+#
 # Three tiers:
 #   1. TSAN: native sub-mesh allocator hammered by concurrent readers
 #      (the scheduler's production calling pattern).

@@ -1,5 +1,10 @@
 #!/usr/bin/env bash
 # Full test suite (reference: hack/make-rules/test.sh).
+#
+# Siblings: hack/verify.sh (tpuvet static analysis — runs first here,
+# a verify failure fails the whole entrypoint), hack/race.sh
+# (TSAN/ASAN + asyncio-debug race tiers).
 set -euo pipefail
 cd "$(dirname "$0")/.."
+./hack/verify.sh
 exec python -m pytest tests/ -q "$@"

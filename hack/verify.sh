@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# Static-analysis gate — the hack/verify-*.sh + `go vet` analog
+# (reference: hack/make-rules/verify.sh driving hack/verify-govet.sh
+# and friends; KUBE_RACE's sibling discipline for what sanitizers
+# cannot see).
+#
+# Runs the tpuvet suite (kubernetes_tpu/analysis/) over the whole
+# package tree and fails on any finding:
+#   swallowed-exception  blanket except that silently discards errors
+#   async-blocking       time.sleep / sync I/O inside async def
+#   feature-gate         gate literals unknown to util/features.py
+#   metric-name          invalid / colliding Prometheus metric names
+#   cache-mutation       in-place mutation of informer/cache objects
+#
+# Suppress a single deliberate line with `# tpuvet: ignore[check-name]`.
+# Runtime complements (env-gated): TPU_CACHE_MUTATION_DETECTOR=1 and
+# TPU_LOCKDEP=1 — see hack/race.sh for the sanitizer tiers.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "=== tpuvet: static analysis over kubernetes_tpu/ ==="
+python -m kubernetes_tpu.analysis "$@" kubernetes_tpu
+echo "verify.sh: tree is clean"

@@ -1,0 +1,21 @@
+"""tpuvet — repo-specific static analysis (the ``go vet`` analog).
+
+Reference: the ``hack/verify-*.sh`` family plus ``go vet`` in the
+make rules, and client-go's cache mutation detector
+(``tools/cache/mutation_detector.go``) for the runtime side.
+
+The framework lives in :mod:`.tpuvet`; the repo-specific passes in
+:mod:`.passes`. Run the suite with ``python -m kubernetes_tpu.analysis``
+(what ``hack/verify.sh`` does) or programmatically::
+
+    from kubernetes_tpu.analysis import run_tree
+    findings = run_tree("kubernetes_tpu")
+
+Adding a pass: subclass :class:`~.tpuvet.Pass`, decorate with
+:func:`~.tpuvet.register`, implement ``check_module`` (per-file) and/or
+``finalize`` (cross-file), and add a good/bad fixture pair to
+``tests/unit/test_tpuvet.py``.
+"""
+from .tpuvet import (Finding, Module, Pass, REGISTRY, register,  # noqa: F401
+                     run_source, run_tree)
+from . import passes  # noqa: F401  (imports register the passes)

@@ -1,0 +1,52 @@
+"""CLI: ``python -m kubernetes_tpu.analysis [--check name]... [path]...``
+
+Exit status 0 when the tree is clean, 1 when any finding survives
+suppression — the contract ``hack/verify.sh`` builds on.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from . import REGISTRY, run_tree
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tpuvet", description="repo-specific static analysis suite")
+    ap.add_argument("paths", nargs="*", help="files/dirs to scan "
+                    "(default: the kubernetes_tpu package)")
+    ap.add_argument("--check", action="append", dest="checks", metavar="NAME",
+                    help="run only this pass (repeatable); default: all")
+    ap.add_argument("--list", action="store_true",
+                    help="list registered passes and exit")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        for name in sorted(REGISTRY):
+            print(f"{name}: {REGISTRY[name]().description}")
+        return 0
+
+    if args.checks:
+        unknown = [c for c in args.checks if c not in REGISTRY]
+        if unknown:
+            print(f"tpuvet: unknown pass(es): {', '.join(unknown)} "
+                  f"(--list shows all)", file=sys.stderr)
+            return 2
+
+    paths = args.paths or [os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))]
+    findings = run_tree(*paths, checks=args.checks)
+    for f in findings:
+        print(f)
+    if findings:
+        print(f"tpuvet: {len(findings)} finding(s) in "
+              f"{len(set(f.path for f in findings))} file(s)",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,416 @@
+"""The repo-specific tpuvet passes.
+
+Each pass encodes a correctness discipline the reference enforces
+mechanically (``go vet``, ``hack/verify-*.sh``, the client-go mutation
+detector) that plain Python gives us no compiler help with.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable
+
+from .tpuvet import Context, Finding, Module, Pass, register
+
+# ---------------------------------------------------------------------------
+# swallowed-exception
+# ---------------------------------------------------------------------------
+
+_BLANKET = {"Exception", "BaseException"}
+
+
+def _is_blanket(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    if isinstance(t, ast.Name):
+        return t.id in _BLANKET
+    if isinstance(t, ast.Tuple):
+        return any(isinstance(e, ast.Name) and e.id in _BLANKET
+                   for e in t.elts)
+    return False
+
+
+def _pure_swallow(body: list[ast.stmt]) -> bool:
+    """True when the handler does nothing observable: only pass /
+    continue / bare constants (docstrings, ``...``)."""
+    for stmt in body:
+        if isinstance(stmt, (ast.Pass, ast.Continue)):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Constant):
+            continue
+        return False
+    return True
+
+
+@register
+class SwallowedExceptionPass(Pass):
+    name = "swallowed-exception"
+    description = ("bare/blanket `except` whose body silently discards the "
+                   "error (no logging, no re-raise, no handling)")
+
+    def check_module(self, ctx: Context, mod: Module) -> Iterable[Finding]:
+        for node in ast.walk(mod.tree):
+            if (isinstance(node, ast.ExceptHandler) and _is_blanket(node)
+                    and _pure_swallow(node.body)):
+                yield Finding(
+                    mod.path, node.lineno, node.col_offset, self.name,
+                    "blanket except swallows the error silently — log at "
+                    "warning level with context, or narrow the exception "
+                    "type")
+
+
+# ---------------------------------------------------------------------------
+# async-blocking
+# ---------------------------------------------------------------------------
+
+#: module.attr calls that block the event loop.
+_BLOCKING_ATTR = {
+    ("time", "sleep"),
+    ("socket", "create_connection"),
+    ("socket", "getaddrinfo"),
+    ("socket", "gethostbyname"),
+    ("subprocess", "run"),
+    ("subprocess", "call"),
+    ("subprocess", "check_call"),
+    ("subprocess", "check_output"),
+    ("subprocess", "Popen"),
+    ("os", "system"),
+    ("os", "popen"),
+    ("urllib", "urlopen"),
+    ("requests", "get"),
+    ("requests", "post"),
+    ("requests", "request"),
+}
+
+
+def _blocking_call_name(call: ast.Call) -> str:
+    f = call.func
+    if (isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name)
+            and (f.value.id, f.attr) in _BLOCKING_ATTR):
+        return f"{f.value.id}.{f.attr}"
+    return ""
+
+
+class _AsyncBodyVisitor(ast.NodeVisitor):
+    """Walks one ``async def`` body without descending into nested
+    function definitions (a nested sync def / lambda is typically a
+    thunk handed to ``run_in_executor`` / ``to_thread`` — off-loop)."""
+
+    def __init__(self) -> None:
+        self.hits: list[ast.Call] = []
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        return  # separate scope
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        return  # visited on its own by the pass
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        return
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if _blocking_call_name(node):
+            self.hits.append(node)
+        self.generic_visit(node)
+
+
+@register
+class AsyncBlockingPass(Pass):
+    name = "async-blocking"
+    description = ("blocking call (time.sleep / sync subprocess / sync "
+                   "socket or HTTP I/O) inside an `async def` body")
+
+    def check_module(self, ctx: Context, mod: Module) -> Iterable[Finding]:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.AsyncFunctionDef):
+                continue
+            v = _AsyncBodyVisitor()
+            for stmt in node.body:
+                v.visit(stmt)
+            for call in v.hits:
+                yield Finding(
+                    mod.path, call.lineno, call.col_offset, self.name,
+                    f"{_blocking_call_name(call)}() blocks the event loop "
+                    f"inside async def {node.name}() — use the asyncio "
+                    f"equivalent or run_in_executor")
+
+
+# ---------------------------------------------------------------------------
+# feature-gate
+# ---------------------------------------------------------------------------
+
+def _known_gates() -> set[str]:
+    from ..util.features import KNOWN_FEATURES
+    return set(KNOWN_FEATURES)
+
+
+_GATE_RECEIVER_RE = re.compile(r"gate", re.IGNORECASE)
+
+
+@register
+class FeatureGatePass(Pass):
+    name = "feature-gate"
+    description = ("feature-gate string literal not registered in "
+                   "util/features.py KNOWN_FEATURES")
+
+    def check_module(self, ctx: Context, mod: Module) -> Iterable[Finding]:
+        if mod.path.endswith("util/features.py"):
+            return
+        known = _known_gates()
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if not (isinstance(f, ast.Attribute)
+                    and f.attr in ("enabled", "set", "parse")):
+                continue
+            try:
+                receiver = ast.unparse(f.value)
+            except (ValueError, RecursionError):  # pragma: no cover
+                continue
+            if not _GATE_RECEIVER_RE.search(receiver):
+                continue
+            if not node.args or not isinstance(node.args[0], ast.Constant) \
+                    or not isinstance(node.args[0].value, str):
+                continue
+            lit = node.args[0].value
+            names = ([p.partition("=")[0].strip()
+                      for p in lit.split(",") if p.strip()]
+                     if f.attr == "parse" else [lit])
+            for gate in names:
+                if gate and gate not in known:
+                    yield Finding(
+                        mod.path, node.lineno, node.col_offset, self.name,
+                        f"unknown feature gate {gate!r} — register it in "
+                        f"util/features.py KNOWN_FEATURES")
+
+
+# ---------------------------------------------------------------------------
+# metric-name
+# ---------------------------------------------------------------------------
+
+_METRIC_CTORS = {"Counter", "Gauge", "Histogram"}
+_METRIC_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+
+
+def _metric_ctor(call: ast.Call) -> str:
+    f = call.func
+    if isinstance(f, ast.Name) and f.id in _METRIC_CTORS:
+        return f.id
+    if isinstance(f, ast.Attribute) and f.attr in _METRIC_CTORS:
+        return f.attr
+    return ""
+
+
+@register
+class MetricNamePass(Pass):
+    name = "metric-name"
+    description = ("Prometheus metric name invalid, or registered from two "
+                   "different sites (the registry is first-wins: the second "
+                   "construction is silently inert)")
+
+    def check_module(self, ctx: Context, mod: Module) -> Iterable[Finding]:
+        if mod.path.endswith("metrics/registry.py"):
+            return  # the primitives themselves, not a registration site
+        sites = ctx.scratch(self.name).setdefault("sites", {})
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call) or not _metric_ctor(node):
+                continue
+            arg = node.args[0] if node.args else None
+            for kw in node.keywords:
+                if kw.arg == "name":
+                    arg = kw.value
+            if not isinstance(arg, ast.Constant) or not isinstance(arg.value, str):
+                continue
+            mname = arg.value
+            if not _METRIC_NAME_RE.match(mname):
+                yield Finding(
+                    mod.path, node.lineno, node.col_offset, self.name,
+                    f"invalid Prometheus metric name {mname!r}")
+            sites.setdefault(mname, []).append(
+                (mod.path, node.lineno, node.col_offset))
+
+    def finalize(self, ctx: Context) -> Iterable[Finding]:
+        sites = ctx.scratch(self.name).get("sites", {})
+        for mname, where in sorted(sites.items()):
+            if len(where) <= 1:
+                continue
+            first = f"{where[0][0]}:{where[0][1]}"
+            for path, line, col in where[1:]:
+                yield Finding(
+                    path, line, col, self.name,
+                    f"metric {mname!r} already registered at {first}; the "
+                    f"registry is first-wins so this instance records "
+                    f"nothing")
+
+
+# ---------------------------------------------------------------------------
+# cache-mutation
+# ---------------------------------------------------------------------------
+
+#: Methods whose result is a shared cached object (or list of them).
+_CACHE_GETTERS = {"get", "list", "by_index", "bound_copy"}
+#: Receiver must look like a cache for the getter to taint.
+_CACHE_RECEIVER_RE = re.compile(
+    r"(informer|lister|\.store\b|^store$|snapshot|\bcache\b)",
+    re.IGNORECASE)
+#: Container-mutators: flagged when invoked on (an attribute of) a
+#: cached object, e.g. ``pod.metadata.labels.update(...)``.
+_MUTATORS = {"append", "extend", "insert", "add", "update", "setdefault",
+             "pop", "popitem", "remove", "discard", "clear", "sort"}
+
+
+def _cache_getter_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    if not isinstance(f, ast.Attribute) or f.attr not in _CACHE_GETTERS:
+        return False
+    try:
+        receiver = ast.unparse(f.value)
+    except (ValueError, RecursionError):  # pragma: no cover
+        return False
+    return bool(_CACHE_RECEIVER_RE.search(receiver))
+
+
+def _root_name(node: ast.AST):
+    """Name node at the base of an Attribute/Subscript chain, or None."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+class _FunctionTaint(ast.NodeVisitor):
+    """Track names bound from cache getters inside one function and flag
+    in-place mutation through them. Conservatively heuristic: rebinding
+    a name (``pod = deepcopy(pod)``) clears its taint."""
+
+    def __init__(self, mod: Module, findings: list[Finding]):
+        self.mod = mod
+        self.findings = findings
+        self.tainted: set[str] = set()       # names holding a cached object
+        self.tainted_lists: set[str] = set() # names holding a cached list
+
+    # -- taint sources ----------------------------------------------------
+
+    def _bind(self, target: ast.expr, value: ast.expr) -> None:
+        if not isinstance(target, ast.Name):
+            return
+        if _cache_getter_call(value):
+            attr = value.func.attr  # type: ignore[union-attr]
+            (self.tainted_lists if attr in ("list", "by_index")
+             else self.tainted).add(target.id)
+            return
+        # Iterating / indexing a cached list yields cached objects.
+        if (isinstance(value, ast.Subscript)
+                and isinstance(value.value, ast.Name)
+                and value.value.id in self.tainted_lists):
+            self.tainted.add(target.id)
+            return
+        # Any other rebind launders the name (deepcopy, fresh object...).
+        self.tainted.discard(target.id)
+        self.tainted_lists.discard(target.id)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        # Flag mutations first (the value may read a tainted name).
+        for target in node.targets:
+            self._flag_store(target)
+        for target in node.targets:
+            if isinstance(target, ast.Tuple):
+                for elt in target.elts:
+                    self._bind(elt, node.value)
+            else:
+                self._bind(target, node.value)
+        # visit (not generic_visit): a mutator call can BE the value
+        # expression (x = pod.metadata.labels.pop("stale")).
+        self.visit(node.value)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            self._flag_store(node.target)
+            self._bind(node.target, node.value)
+            self.visit(node.value)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._flag_store(node.target)
+        self.visit(node.value)
+
+    def visit_For(self, node: ast.For) -> None:
+        it = node.iter
+        if isinstance(node.target, ast.Name):
+            if _cache_getter_call(it) and it.func.attr in ("list", "by_index"):  # type: ignore[union-attr]
+                self.tainted.add(node.target.id)
+            elif isinstance(it, ast.Name) and it.id in self.tainted_lists:
+                self.tainted.add(node.target.id)
+        for stmt in node.body + node.orelse:
+            self.visit(stmt)
+
+    # -- taint sinks ------------------------------------------------------
+
+    def _flag_store(self, target: ast.expr) -> None:
+        if isinstance(target, ast.Tuple):
+            for elt in target.elts:
+                self._flag_store(elt)
+            return
+        if not isinstance(target, (ast.Attribute, ast.Subscript)):
+            return
+        root = _root_name(target)
+        if root in self.tainted:
+            self.findings.append(Finding(
+                self.mod.path, target.lineno, target.col_offset,
+                CacheMutationPass.name,
+                f"in-place mutation of cached object {root!r} obtained "
+                f"from an informer/scheduler cache — deepcopy before "
+                f"modifying (shared-cache corruption)"))
+
+    def visit_Call(self, node: ast.Call) -> None:
+        f = node.func
+        if (isinstance(f, ast.Attribute) and f.attr in _MUTATORS
+                and isinstance(f.value, (ast.Attribute, ast.Subscript))):
+            root = _root_name(f.value)
+            if root in self.tainted:
+                self.findings.append(Finding(
+                    self.mod.path, node.lineno, node.col_offset,
+                    CacheMutationPass.name,
+                    f"{f.attr}() mutates cached object {root!r} obtained "
+                    f"from an informer/scheduler cache in place — deepcopy "
+                    f"before modifying"))
+        self.generic_visit(node)
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        for target in node.targets:
+            self._flag_store(target)
+
+    # Nested defs get their own fresh scope via the pass driver.
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        return
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        return
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        return
+
+
+@register
+class CacheMutationPass(Pass):
+    name = "cache-mutation"
+    description = ("in-place mutation of an object obtained from an "
+                   "informer / scheduler cache (shared-cache corruption: "
+                   "every other consumer sees the edit)")
+
+    #: The cache layers themselves own their objects; consumers don't.
+    _SELF_PATHS = ("client/informer.py", "scheduler/cache.py",
+                   "analysis/")
+
+    def check_module(self, ctx: Context, mod: Module) -> Iterable[Finding]:
+        if any(p in mod.path for p in self._SELF_PATHS):
+            return ()
+        findings: list[Finding] = []
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                v = _FunctionTaint(mod, findings)
+                for stmt in node.body:
+                    v.visit(stmt)
+        return findings

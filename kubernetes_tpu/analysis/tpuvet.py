@@ -1,0 +1,157 @@
+"""tpuvet framework: file walking, pass registry, findings, suppression.
+
+Design mirrors ``go vet``: each pass is a named analyzer over one
+module's AST, with an optional ``finalize`` hook that runs after every
+module has been visited (for cross-file properties like metric-name
+collisions). A finding on a physical line carrying a
+``# tpuvet: ignore`` or ``# tpuvet: ignore[pass-name]`` comment is
+suppressed — the escape hatch for the rare legitimate exception, meant
+to be visible and greppable, not routine.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Iterable, Optional
+
+#: Generated / vendored files the suite never inspects.
+SKIP_FILE_RE = re.compile(r"(_pb2\.py|_pb2_grpc\.py)$")
+_IGNORE_RE = re.compile(r"#\s*tpuvet:\s*ignore(?:\[([A-Za-z0-9_,\- ]+)\])?")
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str
+    line: int
+    col: int
+    check: str
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: [{self.check}] {self.message}"
+
+
+@dataclass
+class Module:
+    """One parsed source file handed to every pass."""
+
+    path: str
+    source: str
+    tree: ast.Module
+    lines: list[str] = field(default_factory=list)
+
+    @classmethod
+    def parse(cls, path: str, source: str) -> "Module":
+        return cls(path=path, source=source, tree=ast.parse(source),
+                   lines=source.splitlines())
+
+
+class Context:
+    """Shared state across passes and modules within one run."""
+
+    def __init__(self) -> None:
+        self.modules: list[Module] = []
+        #: Free-form per-pass scratch space keyed by pass name.
+        self.state: dict[str, dict] = {}
+
+    def scratch(self, pass_name: str) -> dict:
+        return self.state.setdefault(pass_name, {})
+
+
+class Pass:
+    """Base analyzer. Subclass, set ``name``/``description``, register."""
+
+    name = "pass"
+    description = ""
+
+    def check_module(self, ctx: Context, mod: Module) -> Iterable[Finding]:
+        return ()
+
+    def finalize(self, ctx: Context) -> Iterable[Finding]:
+        return ()
+
+
+#: pass name -> pass class (populated by @register at import time).
+REGISTRY: dict[str, type[Pass]] = {}
+
+
+def register(cls: type[Pass]) -> type[Pass]:
+    if cls.name in REGISTRY and REGISTRY[cls.name] is not cls:
+        raise ValueError(f"duplicate tpuvet pass name {cls.name!r}")
+    REGISTRY[cls.name] = cls
+    return cls
+
+
+def _suppressed(mod: Module, f: Finding) -> bool:
+    if not 1 <= f.line <= len(mod.lines):
+        return False
+    m = _IGNORE_RE.search(mod.lines[f.line - 1])
+    if m is None:
+        return False
+    names = m.group(1)
+    if names is None:
+        return True  # blanket ignore
+    return f.check in {n.strip() for n in names.split(",")}
+
+
+def iter_py_files(root: str) -> Iterable[str]:
+    if os.path.isfile(root):
+        yield root
+        return
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames
+                             if not d.startswith(".") and d != "__pycache__")
+        for fn in sorted(filenames):
+            if fn.endswith(".py") and not SKIP_FILE_RE.search(fn):
+                yield os.path.join(dirpath, fn)
+
+
+def _run_modules(modules: list[Module],
+                 checks: Optional[Iterable[str]] = None) -> list[Finding]:
+    ctx = Context()
+    ctx.modules = modules
+    names = list(checks) if checks is not None else sorted(REGISTRY)
+    passes = [REGISTRY[n]() for n in names]
+    by_path = {m.path: m for m in modules}
+    findings: list[Finding] = []
+    for p in passes:
+        for mod in modules:
+            findings.extend(p.check_module(ctx, mod))
+        findings.extend(p.finalize(ctx))
+    findings = [f for f in findings
+                if f.path not in by_path or not _suppressed(by_path[f.path], f)]
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.check))
+    return findings
+
+
+def run_tree(*roots: str, checks: Optional[Iterable[str]] = None
+             ) -> list[Finding]:
+    """Run the (selected) passes over every .py file under ``roots``."""
+    modules = []
+    seen: set = set()
+    for root in roots:
+        for path in iter_py_files(root):
+            # Overlapping roots (e.g. an explicit path plus the default
+            # package) must not double-parse a file — the metric-name
+            # collision pass would see every site twice.
+            real = os.path.realpath(path)
+            if real in seen:
+                continue
+            seen.add(real)
+            with open(path, "r", encoding="utf-8") as fh:
+                src = fh.read()
+            try:
+                modules.append(Module.parse(path, src))
+            except SyntaxError as e:
+                # Fail fast: an unparseable file is finding #1.
+                return [Finding(path, e.lineno or 0, e.offset or 0,
+                                "syntax", f"does not parse: {e.msg}")]
+    return _run_modules(modules, checks)
+
+
+def run_source(source: str, path: str = "<string>",
+               checks: Optional[Iterable[str]] = None) -> list[Finding]:
+    """Run passes over one in-memory snippet (the test-fixture entry)."""
+    return _run_modules([Module.parse(path, source)], checks)

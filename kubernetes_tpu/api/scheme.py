@@ -66,8 +66,10 @@ def _keep_empty_fields(cls: type) -> frozenset:
                 try:
                     if f.default_factory():
                         keep.add(f.name)
-                except Exception:  # noqa: BLE001 — exotic factory: elide
-                    pass
+                except (TypeError, ValueError):
+                    # Exotic factory needing arguments/state: treat the
+                    # field as elidable-when-empty, same as MISSING.
+                    continue
         cached = _KEEP_EMPTY[cls] = frozenset(keep)
     return cached
 

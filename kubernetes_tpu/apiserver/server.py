@@ -389,7 +389,9 @@ class APIServer:
             try:
                 value = base64.b64decode(
                     s.data.get("token", ""), validate=True).decode()
-            except Exception:  # noqa: BLE001
+            except (ValueError, UnicodeDecodeError) as e:
+                log.warning("sa-token secret %s/%s has undecodable token: %s",
+                            s.metadata.namespace, s.metadata.name, e)
                 continue
             sa = s.metadata.annotations.get(t.SA_NAME_ANNOTATION, "default")
             uid = s.metadata.annotations.get(t.SA_UID_ANNOTATION, "")
@@ -864,7 +866,9 @@ class APIServer:
                             data = await resp.json()
                     merged.extend(r for r in data.get("resources", [])
                                   if r.get("api_version") == gv)
-                except Exception:  # noqa: BLE001 — extension down: skip
+                except Exception as e:  # noqa: BLE001
+                    log.warning("aggregated discovery: extension %s "
+                                "unreachable, skipping: %s", target, e)
                     continue
         self._agg_discovery = merged
         self._agg_discovery_at = time.monotonic()

@@ -22,6 +22,7 @@ from typing import Any, Callable, Optional
 
 from ..api import errors
 from .interface import Client
+from .mutation_detector import CacheMutationDetector
 
 log = logging.getLogger("informer")
 
@@ -39,10 +40,14 @@ def _key(obj: Any) -> str:
 class Indexer:
     """Thread-unsafe (single-loop) keyed store with secondary indexes."""
 
-    def __init__(self, indexers: Optional[dict[str, Callable[[Any], list[str]]]] = None):
+    def __init__(self, indexers: Optional[dict[str, Callable[[Any], list[str]]]] = None,
+                 name: str = "indexer"):
         self._items: dict[str, Any] = {}
         self._indexers = dict(indexers or {})
         self._indexes: dict[str, dict[str, set[str]]] = {n: {} for n in self._indexers}
+        #: Env-gated (TPU_CACHE_MUTATION_DETECTOR): snapshots objects at
+        #: upsert and asserts digest stability when they are read back.
+        self.mutation_detector = CacheMutationDetector(name)
 
     def add_indexer(self, name: str, fn: Callable[[Any], list[str]]) -> None:
         """Register a new index, back-filling it over existing items (lets
@@ -75,6 +80,8 @@ class Indexer:
         old = self._items.get(key)
         self._items[key] = obj
         self._update_index(key, old, obj)
+        if self.mutation_detector.enabled:
+            self.mutation_detector.capture(key, obj)
         return old
 
     def remove(self, obj_or_key) -> Optional[Any]:
@@ -82,12 +89,18 @@ class Indexer:
         old = self._items.pop(key, None)
         if old is not None:
             self._update_index(key, old, None)
+            self.mutation_detector.forget(key)
         return old
 
     def get(self, key: str) -> Optional[Any]:
-        return self._items.get(key)
+        obj = self._items.get(key)
+        if self.mutation_detector.enabled and obj is not None:
+            self.mutation_detector.verify(key, obj)
+        return obj
 
     def list(self) -> list[Any]:
+        if self.mutation_detector.enabled:
+            self.mutation_detector.verify_all(self._items)
         return list(self._items.values())
 
     def keys(self) -> list[str]:
@@ -95,6 +108,9 @@ class Indexer:
 
     def by_index(self, index_name: str, value: str) -> list[Any]:
         keys = self._indexes.get(index_name, {}).get(value, ())
+        if self.mutation_detector.enabled:
+            for k in keys:
+                self.mutation_detector.verify(k, self._items[k])
         return [self._items[k] for k in keys]
 
     def __len__(self) -> int:
@@ -112,7 +128,7 @@ class SharedInformer:
         self.label_selector = label_selector
         self.field_selector = field_selector
         self.resync_period = resync_period
-        self.store = Indexer(indexers)
+        self.store = Indexer(indexers, name=f"informer({plural})")
         self._handlers: list[tuple[Callable, Callable, Callable]] = []
         self._synced = asyncio.Event()
         self._stopped = False

@@ -50,8 +50,11 @@ class LeaderElector:
                 payload.cancel()
                 try:
                     await payload
-                except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                except asyncio.CancelledError:
                     pass
+                except Exception as e:  # noqa: BLE001
+                    log.warning("%s: leader payload for %s raised during "
+                                "teardown: %s", self.name, self.identity, e)
                 if on_stopped_leading:
                     on_stopped_leading()
                 log.warning("%s: %s lost leadership", self.name, self.identity)

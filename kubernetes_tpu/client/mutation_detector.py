@@ -1,0 +1,89 @@
+"""Cache mutation detector — the client-go analog, env-gated.
+
+Reference: ``staging/src/k8s.io/client-go/tools/cache/
+mutation_detector.go`` — when ``KUBE_CACHE_MUTATION_DETECTOR`` is set,
+every object entering the watch cache is deep-copied, and the copy is
+periodically compared against the live object; any drift means some
+consumer mutated a shared cached object in place and the process
+panics with the diff.
+
+This port snapshots a digest of the object's canonical repr (the
+dataclass repr covers every field recursively; the wire codec would
+elide default-valued fields and miss default-shaped mutations) at
+upsert and re-checks it on read-back (``get``/``list``/``by_index``)
+instead of on a timer, so a violating test fails at the first read
+after the mutation — deterministically, with the key in hand. Gate:
+``TPU_CACHE_MUTATION_DETECTOR=1`` (or construct with
+``enabled=True``). Disabled, every hook is a single attribute check.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+from typing import Any, Optional
+
+log = logging.getLogger("mutation-detector")
+
+ENV_VAR = "TPU_CACHE_MUTATION_DETECTOR"
+
+
+def enabled_from_env() -> bool:
+    return os.environ.get(ENV_VAR, "").lower() in ("1", "true", "yes", "on")
+
+
+class CacheMutationDetectedError(AssertionError):
+    """A consumer mutated an object it obtained from a shared cache."""
+
+
+class CacheMutationDetector:
+    """Digest snapshots keyed like the cache that owns the detector."""
+
+    def __init__(self, name: str, enabled: Optional[bool] = None):
+        self.name = name
+        self.enabled = enabled_from_env() if enabled is None else enabled
+        self._digests: dict[str, str] = {}
+
+    @staticmethod
+    def digest(obj: Any) -> str:
+        # Dataclass repr covers every field recursively (unlike the wire
+        # codec, which elides default-valued fields — a mutation writing
+        # a default-shaped value would slip through a to_dict digest).
+        if isinstance(obj, (dict, list, tuple, set)):
+            payload = json.dumps(obj, sort_keys=True, default=repr)
+        else:
+            payload = repr(obj)
+        return hashlib.sha1(payload.encode()).hexdigest()
+
+    def capture(self, key: str, obj: Any) -> None:
+        """Snapshot ``obj`` as it enters the cache (upsert path)."""
+        if self.enabled:
+            self._digests[key] = self.digest(obj)
+
+    def forget(self, key: str) -> None:
+        if self.enabled:
+            self._digests.pop(key, None)
+
+    def verify(self, key: str, obj: Any) -> None:
+        """Assert ``obj`` still matches its upsert-time snapshot
+        (read-back path). Raises :class:`CacheMutationDetectedError`."""
+        if not self.enabled or obj is None:
+            return
+        want = self._digests.get(key)
+        if want is None:
+            return
+        got = self.digest(obj)
+        if got != want:
+            raise CacheMutationDetectedError(
+                f"{self.name}: cached object {key!r} was mutated in place "
+                f"after caching (digest {want[:12]} -> {got[:12]}). Some "
+                f"consumer modified a shared cache object — deepcopy "
+                f"before writing.")
+
+    def verify_all(self, items: dict) -> None:
+        for key, obj in items.items():
+            self.verify(key, obj)
+
+    def clear(self) -> None:
+        self._digests.clear()

@@ -59,6 +59,7 @@ async def resolve_node_agent(client, node_name: str
                                  **ssl_kw(ssl_ctx)) as r:
                     if r.status == 200:
                         return base, ssl_ctx
-        except Exception:  # noqa: BLE001 — unresolvable hostname etc.
+        except Exception as e:  # noqa: BLE001 — unresolvable hostname etc.
+            log.debug("node base %s not reachable, trying next: %s", base, e)
             continue
     return None

@@ -17,6 +17,7 @@ and simulations.
 from __future__ import annotations
 
 import inspect
+import logging
 import math
 import time
 from typing import Awaitable, Callable, Optional, Union
@@ -29,6 +30,8 @@ from ..api.scheme import deepcopy
 from ..client.informer import InformerFactory
 from ..client.interface import Client
 from .base import Controller, is_pod_active
+
+log = logging.getLogger("hpa")
 
 UTIL_ANNOTATION = "metrics.tpu/cpu-utilization-percent"
 
@@ -101,8 +104,9 @@ class SummaryMetricsSource:
                             for p in summary.get("pods", []):
                                 usage[p["pod"]["uid"]] = float(
                                     p.get("cpu_seconds", 0.0))
-            except Exception:  # noqa: BLE001 — node unreachable: no samples
-                pass
+            except Exception as e:  # noqa: BLE001 — node unreachable
+                log.warning("hpa: stats scrape of node %s failed, no "
+                            "samples this round: %s", node_name, e)
         entry = (time.monotonic(), usage)
         self._scrapes[node_name] = entry
         # Prune: stale node scrapes first (departed nodes must not pin

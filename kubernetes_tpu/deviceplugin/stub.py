@@ -11,12 +11,12 @@ from __future__ import annotations
 import itertools
 import os
 import queue
-import threading
 from concurrent import futures
 from typing import Iterator, Optional
 
 import grpc
 
+from ..util.lockdep import make_lock
 from . import api_pb2 as pb
 from .service import TpuDevicePluginServicer, add_servicer_to_server
 
@@ -43,7 +43,7 @@ class StubTpuPlugin(TpuDevicePluginServicer):
         self.resource = resource
         self._topology = topology
         self._subscribers: list[queue.Queue] = []
-        self._lock = threading.Lock()
+        self._lock = make_lock("deviceplugin.Stub")
         self.admit_calls: list[pb.AdmitPodRequest] = []
         self.init_calls: list[pb.InitContainerRequest] = []
         #: Set to a reason string to make AdmitPod reject.

@@ -93,7 +93,9 @@ def _find_libtpu() -> Optional[str]:
                 list(spec.submodule_search_locations)[0], "libtpu.so")
             if os.path.exists(path):
                 return path
-    except Exception:  # noqa: BLE001
+    except (ImportError, ValueError, AttributeError, OSError):
+        # libtpu absent or its spec unreadable: no shared object to
+        # advertise; the stub backend takes over.
         pass
     return None
 

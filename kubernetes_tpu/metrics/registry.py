@@ -12,7 +12,8 @@ implemented directly (it is a stable, documented text format).
 from __future__ import annotations
 
 import bisect
-import threading
+
+from ..util.lockdep import make_lock
 from typing import Optional, Sequence
 
 _DEFAULT_BUCKETS = (
@@ -40,7 +41,7 @@ class Metric:
         self.name = name
         self.help = help_
         self.label_names = tuple(labels)
-        self._lock = threading.Lock()
+        self._lock = make_lock(f"metrics.{type(self).__name__}")
         (registry if registry is not None else REGISTRY).register(self)
 
     def render(self) -> str:
@@ -194,7 +195,7 @@ class Histogram(Metric):
 class MetricsRegistry:
     def __init__(self) -> None:
         self._metrics: dict[str, Metric] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("metrics.Registry")
 
     def register(self, m: Metric) -> None:
         with self._lock:

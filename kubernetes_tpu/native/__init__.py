@@ -103,8 +103,8 @@ def _pjrt_include_dir() -> Optional[str]:
         if spec and spec.submodule_search_locations:
             candidates.append(os.path.join(
                 list(spec.submodule_search_locations)[0], "include"))
-    except Exception:
-        pass
+    except (ImportError, ValueError, AttributeError):
+        pass  # tensorflow absent/unlocatable: other candidates remain
     for cand in candidates:
         if cand and os.path.exists(
                 os.path.join(cand, "xla", "pjrt", "c", "pjrt_c_api.h")):

@@ -28,6 +28,7 @@ import logging
 from dataclasses import dataclass, field
 
 from ..api import types as t
+from ..util.lockdep import make_lock
 
 log = logging.getLogger("iptables")
 
@@ -314,14 +315,13 @@ class HostportManager:
     inspectable either way."""
 
     def __init__(self):
-        import threading
         self._pods: dict[str, PodPortMapping] = {}  # uid -> mapping
         self._prev_chains: set[str] = set()
         #: note_pod/forget_pod are offloaded to worker threads by
         #: independent per-pod workers; the whole read-render-apply
         #: must be atomic or interleaved applies can -X a chain the
         #: other thread's ruleset still references.
-        self._lock = threading.Lock()
+        self._lock = make_lock("iptables.Proxier")
         self.last_rendered = ""
         self.applied = False
 

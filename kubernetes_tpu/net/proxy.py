@@ -100,8 +100,8 @@ class _PortForwarder:
         for w in (writer, w2):
             try:
                 w.close()
-            except Exception:  # noqa: BLE001
-                pass
+            except (OSError, RuntimeError):
+                pass  # transport already torn down
 
 
 class ServiceProxy:

@@ -266,8 +266,11 @@ class NodeAgent:
         for task in self._tasks + list(self._workers.values()):
             try:
                 await task
-            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+            except asyncio.CancelledError:
                 pass
+            except Exception as e:  # noqa: BLE001
+                log.warning("agent stop: task %r raised during teardown: %s",
+                            task.get_name(), e)
         if self.static_source:
             await self.static_source.stop()
         for task in list(self._static_tasks):
@@ -488,8 +491,9 @@ class NodeAgent:
                 if worker is not None:
                     try:
                         await worker
-                    except Exception:  # noqa: BLE001
-                        pass
+                    except Exception as e:  # noqa: BLE001
+                        log.warning("static pod %s: teardown worker "
+                                    "failed: %s", key, e)
             # Desired may have advanced while tearing down; converge to
             # the newest, not to the version that triggered this task.
             desired = self._static_desired.get(key)
@@ -1265,8 +1269,9 @@ class NodeAgent:
         live = dict(self._pleg_statuses)
         try:
             live.update({st.id: st for st in await self.runtime.list_containers()})
-        except Exception:  # noqa: BLE001 — fall back to last relist
-            pass
+        except Exception as e:  # noqa: BLE001
+            log.warning("preStop: runtime relist failed, using last PLEG "
+                        "snapshot: %s", e)
         hooks = []
         for container, cid in candidates:
             st = live.get(cid)

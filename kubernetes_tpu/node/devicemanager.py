@@ -86,8 +86,11 @@ class Endpoint:
             self._task.cancel()
             try:
                 await self._task
-            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+            except asyncio.CancelledError:
                 pass
+            except Exception as e:  # noqa: BLE001
+                log.warning("devicemanager stop: watch task raised during "
+                            "teardown: %s", e)
         await asyncio.to_thread(self.client.close)
 
 

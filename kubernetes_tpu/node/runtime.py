@@ -379,8 +379,8 @@ class ProcessRuntime(ContainerRuntime):
         finally:
             try:
                 log_f.close()
-            except Exception:  # noqa: BLE001
-                pass
+            except OSError:
+                pass  # best-effort: log fd may already be gone
         if config.oom_score_adj:
             # Real kernel enforcement point for QoS without cgroups:
             # BestEffort (+1000) dies to the OOM killer before
@@ -518,8 +518,8 @@ class ProcessRuntime(ContainerRuntime):
             finally:
                 try:
                     proc.stdin.close()
-                except Exception:  # noqa: BLE001
-                    pass
+                except (OSError, RuntimeError):
+                    pass  # transport already closed with the process
 
         async def pump_out():
             while True:

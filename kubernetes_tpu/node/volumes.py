@@ -21,6 +21,7 @@ import asyncio
 import base64
 import binascii
 import copy
+import logging
 import os
 import shutil
 import time
@@ -31,6 +32,8 @@ import grpc
 
 from ..api import errors, types as t
 from ..client.interface import Client
+
+log = logging.getLogger("volumes")
 
 
 class VolumeError(Exception):
@@ -251,8 +254,10 @@ class VolumeManager:
                         if (driver, handle) not in still_held:
                             client.unstage(
                                 handle, self._staging_path(driver, handle))
-                    except Exception:  # noqa: BLE001
-                        pass
+                    except Exception as e:  # noqa: BLE001
+                        log.warning("volume %s/%s: unpublish/unstage for "
+                                    "pod %s failed (cleanup continues): %s",
+                                    driver, handle, pod_uid, e)
             shutil.rmtree(os.path.join(self.base_dir, "pods", pod_uid),
                           ignore_errors=True)
 

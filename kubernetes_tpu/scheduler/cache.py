@@ -25,6 +25,7 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from ..api import types as t
+from ..client.mutation_detector import CacheMutationDetector
 
 Coord = tuple[int, ...]
 
@@ -210,6 +211,10 @@ class SchedulerCache:
         self.anti_affinity_pods: dict[str, t.Pod] = {}
         #: owner (pod key / gang group key) -> Reservation.
         self.reservations: dict[str, Reservation] = {}
+        #: Env-gated (TPU_CACHE_MUTATION_DETECTOR): pods/nodes entering
+        #: the cache are digest-snapshotted; read-back via bound_copy
+        #: asserts nobody mutated them in place.
+        self.mutation_detector = CacheMutationDetector("scheduler-cache")
 
     # -- reservations ------------------------------------------------------
 
@@ -294,6 +299,20 @@ class SchedulerCache:
         """True when the cache already tracks this pod (assumed or added)."""
         return key in self.assumed or key in self._pod_node
 
+    def verify_cached(self) -> None:
+        """Re-check every snapshotted node and pod against its
+        upsert-time digest (client-go's periodic CompareObjects sweep;
+        the scheduler runs this once per scheduling cycle when the
+        detector is armed). Raises CacheMutationDetectedError."""
+        det = self.mutation_detector
+        if not det.enabled:
+            return
+        for name, info in self.nodes.items():
+            if info.node is not None:
+                det.verify(f"node/{name}", info.node)
+            for key, pod in info.pods.items():
+                det.verify(key, pod)
+
     def bound_copy(self, key: str):
         """The cache's copy of a bound/assumed pod (carries the chip
         assignment debited at assume time), or None. The cache is
@@ -303,7 +322,10 @@ class SchedulerCache:
         if node_name is None:
             return None
         info = self.nodes.get(node_name)
-        return info.pods.get(key) if info else None
+        pod = info.pods.get(key) if info else None
+        if pod is not None and self.mutation_detector.enabled:
+            self.mutation_detector.verify(key, pod)
+        return pod
 
     # -- nodes ------------------------------------------------------------
 
@@ -317,9 +339,18 @@ class SchedulerCache:
         info.recompute_chips()
         self._rebuild_slice_for(node)
         self.equiv.invalidate_node(node.metadata.name)
+        if self.mutation_detector.enabled:
+            self.mutation_detector.capture(f"node/{node.metadata.name}", node)
 
     def remove_node(self, name: str) -> None:
         self.equiv.invalidate_node(name)
+        self.mutation_detector.forget(f"node/{name}")
+        info = self.nodes.get(name)
+        if info is not None:
+            # The node's pods leave the verifiable cache with it; drop
+            # their snapshots or the detector leaks one per departed pod.
+            for key in info.pods:
+                self.mutation_detector.forget(key)
         info = self.nodes.pop(name, None)
         if info and info.node and info.node.status.tpu:
             sid = info.node.status.tpu.slice_id
@@ -388,6 +419,8 @@ class SchedulerCache:
         else:
             self.anti_affinity_pods.pop(key, None)
         self.equiv.invalidate_node(node_name)
+        if self.mutation_detector.enabled:
+            self.mutation_detector.capture(key, pod)
 
     def update_pod(self, pod: t.Pod) -> None:
         self.add_pod(pod)
@@ -404,6 +437,7 @@ class SchedulerCache:
             info.remove_pod(existing)
         if node_name:
             self.equiv.invalidate_node(node_name)
+        self.mutation_detector.forget(key)
 
     # -- assume / forget (bind-in-flight bookkeeping) ---------------------
 
@@ -420,6 +454,8 @@ class SchedulerCache:
         if aff is not None and aff.pod_anti_affinity:
             self.anti_affinity_pods[pod.key()] = pod
         self.equiv.invalidate_node(node_name)
+        if self.mutation_detector.enabled:
+            self.mutation_detector.capture(pod.key(), pod)
 
     def forget_pod(self, pod: t.Pod) -> None:
         """Bind failed: credit everything back."""
@@ -433,3 +469,4 @@ class SchedulerCache:
         if info and key in info.pods:
             info.remove_pod(info.pods[key])
         self.equiv.invalidate_node(node_name)
+        self.mutation_detector.forget(key)

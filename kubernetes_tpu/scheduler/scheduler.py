@@ -124,8 +124,9 @@ class Scheduler:
         for ext in self.extenders:
             try:
                 await ext.close()
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception as e:  # noqa: BLE001
+                log.warning("extender %s: close failed: %s",
+                            getattr(ext, "name", ext), e)
         for inf in self._informers:
             await inf.stop()
 
@@ -182,6 +183,8 @@ class Scheduler:
             if item is None:
                 return
             m.PENDING_PODS.set(float(len(self.queue)))
+            if self.cache.mutation_detector.enabled:
+                self.cache.verify_cached()
             try:
                 if isinstance(item, GangUnit):
                     await self._schedule_gang(item)

@@ -33,11 +33,11 @@ import asyncio
 import bisect
 import json
 import os
-import threading
 from dataclasses import dataclass, field
 from typing import Any, Callable, Iterable, Optional
 
 from ..api import errors
+from ..util.lockdep import make_lock
 
 ADDED = "ADDED"
 MODIFIED = "MODIFIED"
@@ -84,7 +84,7 @@ class Watch:
         self._cancelled = False
         self._queue_limit = queue_limit
         self._pending = 0
-        self._pending_lock = threading.Lock()
+        self._pending_lock = make_lock("mvcc.WatchStream.pending")
         #: Set once the end-of-stream sentinel has been consumed; lets
         #: callers distinguish 'stream ended' from 'idle timeout'.
         self.closed = False
@@ -238,7 +238,7 @@ class MVCCStore:
         "at rest" means the disk here, not the client-server hop the
         reference transforms at. Calling :meth:`snapshot` after
         enabling encryption eagerly rewrites all existing plaintext."""
-        self._lock = threading.RLock()
+        self._lock = make_lock("mvcc.Store", rlock=True)
         self._transformers = dict(transformers or {})
         #: key -> StoredObject (live keys only).
         self._data: _PrefixIndexedMap = _PrefixIndexedMap()

@@ -1,0 +1,171 @@
+"""lockdep — runtime lock-order and held-across-await checking.
+
+The Linux-kernel-lockdep idea shrunk to this codebase: every
+instrumented ``threading.Lock``/``RLock`` belongs to a named *class*
+(e.g. ``"metrics.Metric"``), and the checker maintains a global graph
+of observed acquisition order between classes. Two detectors:
+
+- **Order inversion**: acquiring class B while holding class A records
+  the edge A→B; a later acquisition of A while holding B is the
+  classic AB/BA deadlock seed and raises :class:`LockOrderError`
+  immediately (no need to actually hit the deadlock window).
+- **Held across await**: a ``threading`` lock held while a coroutine
+  yields to the event loop stalls every other coroutine that touches
+  it (and inverts cooperative-scheduling assumptions). On acquire from
+  a running loop the checker schedules a ``call_soon`` probe; the probe
+  only runs once the coroutine yields, so "probe fired while the lock
+  is still held" is exactly the violation. Recorded in
+  :data:`VIOLATIONS` and logged (raising inside a loop callback would
+  be swallowed by the loop's exception handler).
+
+Gate: ``TPU_LOCKDEP=1`` (checked at :func:`make_lock` call time).
+Disabled, :func:`make_lock` returns a plain stdlib lock — zero
+overhead. Enable + construct explicitly with ``DepLock(name)`` in
+tests.
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import threading
+from typing import Optional, Union
+
+log = logging.getLogger("lockdep")
+
+ENV_VAR = "TPU_LOCKDEP"
+
+#: Held-across-await findings (order inversions raise instead): each
+#: entry is a human-readable description. Tests assert on this.
+VIOLATIONS: list[str] = []
+
+
+def lockdep_enabled() -> bool:
+    return os.environ.get(ENV_VAR, "").lower() in ("1", "true", "yes", "on")
+
+
+class LockOrderError(RuntimeError):
+    """A→B lock order observed after B→A: deadlock-prone inversion."""
+
+
+#: class name -> set of class names acquired while it was held (A -> B
+#: meaning "A held when B acquired": A before B).
+_edges: dict[str, set[str]] = {}
+_edges_lock = threading.Lock()
+_held = threading.local()  # per-thread stack of (class_name, DepLock)
+
+
+def reset() -> None:
+    """Drop the order graph and recorded violations (test isolation)."""
+    with _edges_lock:
+        _edges.clear()
+    VIOLATIONS.clear()
+
+
+def _held_stack() -> list:
+    stack = getattr(_held, "stack", None)
+    if stack is None:
+        stack = _held.stack = []
+    return stack
+
+
+class DepLock:
+    """Instrumented lock. API-compatible with threading.Lock/RLock for
+    the subset this codebase uses (acquire/release/context manager)."""
+
+    def __init__(self, name: str, rlock: bool = False):
+        self.name = name
+        self._inner: Union[threading.Lock, threading.RLock] = (
+            threading.RLock() if rlock else threading.Lock())
+        self._reentrant = rlock
+        #: Hold id, bumped only on the 0->1 / 1->0 depth transitions —
+        #: RLock re-entry keeps the id, so the await-probe can tell
+        #: "still the same hold" from "released and re-acquired".
+        self._gen = 0
+        self._depth = 0  # RLock re-entry depth on the owning thread
+
+    # -- checks -----------------------------------------------------------
+
+    def _check_order(self) -> None:
+        stack = _held_stack()
+        for held_name, held_lock in stack:
+            if held_name == self.name:
+                continue  # same class (two metrics etc.): no ordering
+            with _edges_lock:
+                if self.name in _edges and held_name in _edges[self.name]:
+                    raise LockOrderError(
+                        f"lock order inversion: acquiring {self.name!r} "
+                        f"while holding {held_name!r}, but the opposite "
+                        f"order {self.name!r} -> {held_name!r} was "
+                        f"observed earlier (AB/BA deadlock seed)")
+                _edges.setdefault(held_name, set()).add(self.name)
+
+    def _schedule_await_probe(self) -> None:
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            return  # not on an event-loop thread
+        gen = self._gen
+        def probe() -> None:
+            if self._depth > 0 and self._gen == gen:
+                msg = (f"lock {self.name!r} held across an await: the "
+                       f"event loop ran while the lock was still held "
+                       f"(acquired in a coroutine, not released before "
+                       f"yielding)")
+                VIOLATIONS.append(msg)
+                log.error("lockdep: %s", msg)
+        loop.call_soon(probe)
+
+    # -- lock protocol ----------------------------------------------------
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ok = self._inner.acquire(blocking, timeout)
+        if not ok:
+            return False
+        self._depth += 1
+        if self._depth == 1:
+            self._gen += 1
+            self._check_order_safe()
+            _held_stack().append((self.name, self))
+            self._schedule_await_probe()
+        return True
+
+    def _check_order_safe(self) -> None:
+        try:
+            self._check_order()
+        except LockOrderError:
+            # Leave the lock in a consistent state before surfacing.
+            self._depth -= 1
+            self._gen += 1
+            self._inner.release()
+            raise
+
+    def release(self) -> None:
+        self._depth -= 1
+        if self._depth == 0:
+            self._gen += 1
+            stack = _held_stack()
+            for i in range(len(stack) - 1, -1, -1):
+                if stack[i][1] is self:
+                    del stack[i]
+                    break
+        self._inner.release()
+
+    def locked(self) -> bool:
+        return self._depth > 0
+
+    def __enter__(self) -> "DepLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+def make_lock(name: str, rlock: bool = False
+              ) -> Union[threading.Lock, threading.RLock, DepLock]:
+    """The factory components use: a plain stdlib lock normally, an
+    instrumented :class:`DepLock` under ``TPU_LOCKDEP=1``."""
+    if lockdep_enabled():
+        return DepLock(name, rlock=rlock)
+    return threading.RLock() if rlock else threading.Lock()

@@ -1,0 +1,123 @@
+"""lockdep: seeded A->B/B->A inversion is caught, a lock held across an
+await is caught, and consistent usage stays silent."""
+import asyncio
+import threading
+
+import pytest
+
+from kubernetes_tpu.util import lockdep
+from kubernetes_tpu.util.lockdep import DepLock, LockOrderError, make_lock
+
+
+@pytest.fixture(autouse=True)
+def _clean_graph():
+    lockdep.reset()
+    yield
+    lockdep.reset()
+
+
+def test_seeded_inversion_caught():
+    a, b = DepLock("A"), DepLock("B")
+    with a:
+        with b:
+            pass
+    with b:
+        with pytest.raises(LockOrderError):
+            a.acquire()
+    # The failed acquire must not leave A held.
+    with a:
+        pass
+
+
+def test_consistent_order_is_silent():
+    a, b = DepLock("A"), DepLock("B")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    assert lockdep.VIOLATIONS == []
+
+
+def test_same_class_nesting_allowed():
+    # Two locks of one class (e.g. two Counters): no ordering between them.
+    m1, m2 = DepLock("metrics.Counter"), DepLock("metrics.Counter")
+    with m1:
+        with m2:
+            pass
+    with m2:
+        with m1:
+            pass
+
+
+def test_rlock_reentry():
+    r = DepLock("R", rlock=True)
+    with r:
+        with r:
+            pass
+    assert not r.locked()
+
+
+def test_held_across_await_caught():
+    lock = DepLock("loop-lock")
+
+    async def bad():
+        lock.acquire()
+        await asyncio.sleep(0)   # yields with the lock held
+        lock.release()
+
+    asyncio.run(bad())
+    assert any("held across an await" in v for v in lockdep.VIOLATIONS)
+
+
+def test_rlock_reentry_still_caught_across_await():
+    # Re-entry must not launder the hold id: the outer hold spans the
+    # await even though inner acquire/release pairs happened.
+    r = DepLock("R-loop", rlock=True)
+
+    async def bad():
+        r.acquire()
+        r.acquire()
+        r.release()
+        await asyncio.sleep(0)  # outer hold still live
+        r.release()
+
+    asyncio.run(bad())
+    assert any("held across an await" in v for v in lockdep.VIOLATIONS)
+
+
+def test_release_before_await_is_silent():
+    lock = DepLock("loop-lock-ok")
+
+    async def good():
+        lock.acquire()
+        lock.release()
+        await asyncio.sleep(0)
+
+    asyncio.run(good())
+    assert lockdep.VIOLATIONS == []
+
+
+def test_off_loop_thread_never_probed():
+    lock = DepLock("thread-lock")
+
+    def worker():
+        with lock:
+            pass
+
+    th = threading.Thread(target=worker)
+    th.start()
+    th.join()
+    assert lockdep.VIOLATIONS == []
+
+
+def test_make_lock_disabled_returns_plain(monkeypatch):
+    monkeypatch.delenv(lockdep.ENV_VAR, raising=False)
+    lock = make_lock("x")
+    assert not isinstance(lock, DepLock)
+    assert isinstance(make_lock("x", rlock=True), type(threading.RLock()))
+
+
+def test_make_lock_enabled_returns_deplock(monkeypatch):
+    monkeypatch.setenv(lockdep.ENV_VAR, "1")
+    lock = make_lock("x")
+    assert isinstance(lock, DepLock)

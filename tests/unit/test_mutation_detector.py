@@ -1,0 +1,143 @@
+"""Cache mutation detector: the seeded-bug negative tests — a consumer
+mutating a cached object in place must be caught at the next read-back
+— plus the disabled-by-default and laundering (deepcopy) paths."""
+import pytest
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.api.scheme import deepcopy
+from kubernetes_tpu.client.informer import Indexer
+from kubernetes_tpu.client.mutation_detector import (
+    CacheMutationDetectedError, CacheMutationDetector, enabled_from_env)
+from kubernetes_tpu.scheduler.cache import SchedulerCache
+
+
+def _pod(name="p1", node=""):
+    pod = t.Pod(metadata=ObjectMeta(name=name, namespace="default",
+                                    uid=f"uid-{name}"))
+    pod.spec.node_name = node
+    return pod
+
+
+def _armed_indexer():
+    idx = Indexer(name="test-indexer")
+    idx.mutation_detector.enabled = True
+    return idx
+
+
+def test_seeded_mutation_caught_on_get():
+    idx = _armed_indexer()
+    pod = _pod()
+    idx.upsert(pod)
+    idx.get(pod.key())  # clean read-back passes
+    pod.metadata.labels["seeded"] = "mutation"
+    with pytest.raises(CacheMutationDetectedError):
+        idx.get(pod.key())
+
+
+def test_seeded_mutation_caught_on_list():
+    idx = _armed_indexer()
+    pod = _pod()
+    idx.upsert(pod)
+    assert idx.list() == [pod]
+    pod.status.phase = t.POD_RUNNING
+    with pytest.raises(CacheMutationDetectedError):
+        idx.list()
+
+
+def test_upsert_rebaselines_and_remove_forgets():
+    idx = _armed_indexer()
+    pod = _pod()
+    idx.upsert(pod)
+    # A new (legitimately updated) copy re-baselines the snapshot.
+    newer = deepcopy(pod)
+    newer.status.phase = t.POD_RUNNING
+    idx.upsert(newer)
+    assert idx.get(pod.key()).status.phase == t.POD_RUNNING
+    idx.remove(pod.key())
+    assert idx.get(pod.key()) is None
+
+
+def test_consumer_deepcopy_is_clean():
+    idx = _armed_indexer()
+    pod = _pod()
+    idx.upsert(pod)
+    mine = deepcopy(idx.get(pod.key()))
+    mine.metadata.labels["mine"] = "1"  # copy-on-write: no violation
+    idx.get(pod.key())
+    idx.list()
+
+
+def test_disabled_by_default_zero_cost():
+    idx = Indexer(name="off")
+    assert idx.mutation_detector.enabled == enabled_from_env()
+    pod = _pod()
+    idx.upsert(pod)
+    pod.metadata.labels["whatever"] = "1"
+    idx.get(pod.key())  # no snapshotting, no verification
+
+
+def test_scheduler_cache_catches_pod_mutation():
+    cache = SchedulerCache()
+    cache.mutation_detector.enabled = True
+    pod = _pod(node="n1")
+    cache.add_pod(pod)
+    assert cache.bound_copy(pod.key()) is pod
+    pod.spec.priority = 99  # seeded in-place mutation of the cached pod
+    with pytest.raises(CacheMutationDetectedError):
+        cache.bound_copy(pod.key())
+
+
+def test_scheduler_cache_assume_then_confirm():
+    cache = SchedulerCache()
+    cache.mutation_detector.enabled = True
+    pod = _pod()
+    assumed = deepcopy(pod)
+    cache.assume_pod(assumed, "n1")
+    assert cache.bound_copy(pod.key()) is assumed
+    confirmed = deepcopy(assumed)
+    cache.add_pod(confirmed)
+    assert cache.bound_copy(pod.key()) is confirmed
+    cache.remove_pod(confirmed)
+    assert cache.bound_copy(pod.key()) is None
+
+
+def test_seeded_mutation_caught_via_by_index():
+    idx = Indexer(indexers={"node": lambda p: [p.spec.node_name]},
+                  name="by-index")
+    idx.mutation_detector.enabled = True
+    pod = _pod(node="n1")
+    idx.upsert(pod)
+    assert idx.by_index("node", "n1") == [pod]
+    pod.metadata.labels["seeded"] = "1"
+    with pytest.raises(CacheMutationDetectedError):
+        idx.by_index("node", "n1")
+
+
+def test_scheduler_cache_catches_node_mutation_via_verify_cached():
+    cache = SchedulerCache()
+    cache.mutation_detector.enabled = True
+    node = t.Node(metadata=ObjectMeta(name="n1"))
+    cache.set_node(node)
+    cache.verify_cached()  # clean sweep passes
+    node.metadata.labels["seeded"] = "1"
+    with pytest.raises(CacheMutationDetectedError):
+        cache.verify_cached()
+
+
+def test_remove_node_forgets_its_pods_snapshots():
+    cache = SchedulerCache()
+    cache.mutation_detector.enabled = True
+    node = t.Node(metadata=ObjectMeta(name="n1"))
+    cache.set_node(node)
+    pod = _pod(node="n1")
+    cache.add_pod(pod)
+    cache.remove_node("n1")
+    assert cache.mutation_detector._digests == {}
+
+
+def test_digest_stable_across_equal_objects():
+    a, b = _pod(), _pod()
+    assert CacheMutationDetector.digest(a) == CacheMutationDetector.digest(b)
+    b.metadata.labels["x"] = "1"
+    assert CacheMutationDetector.digest(a) != CacheMutationDetector.digest(b)

@@ -1,0 +1,232 @@
+"""tpuvet static-analysis suite: good/bad fixture pairs per pass, the
+suppression escape hatch, and the tier-1 gate that the real tree is
+clean (what hack/verify.sh enforces)."""
+import os
+
+from kubernetes_tpu.analysis import REGISTRY, run_source, run_tree
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+PKG = os.path.join(REPO, "kubernetes_tpu")
+
+
+def names(findings):
+    return [f.check for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# swallowed-exception
+# ---------------------------------------------------------------------------
+
+def test_swallowed_exception_bad():
+    bad = """
+try:
+    risky()
+except Exception:
+    pass
+"""
+    assert names(run_source(bad, checks=["swallowed-exception"])) == [
+        "swallowed-exception"]
+
+
+def test_swallowed_exception_bare_and_continue():
+    bad = """
+for x in items:
+    try:
+        risky(x)
+    except:
+        continue
+"""
+    assert len(run_source(bad, checks=["swallowed-exception"])) == 1
+
+
+def test_swallowed_exception_good():
+    good = """
+import logging
+log = logging.getLogger(__name__)
+try:
+    risky()
+except Exception as e:
+    log.warning("risky failed: %s", e)
+try:
+    risky()
+except ValueError:
+    pass  # narrow type: deliberate
+try:
+    risky()
+except Exception:
+    fallback()
+"""
+    assert run_source(good, checks=["swallowed-exception"]) == []
+
+
+# ---------------------------------------------------------------------------
+# async-blocking
+# ---------------------------------------------------------------------------
+
+def test_async_blocking_bad():
+    bad = """
+import time, subprocess
+async def reconcile():
+    time.sleep(0.1)
+    subprocess.check_output(["ls"])
+"""
+    got = run_source(bad, checks=["async-blocking"])
+    assert names(got) == ["async-blocking", "async-blocking"]
+
+
+def test_async_blocking_good():
+    good = """
+import asyncio, time
+def sync_helper():
+    time.sleep(0.1)  # fine outside async
+async def reconcile():
+    await asyncio.sleep(0.1)
+    await asyncio.get_running_loop().run_in_executor(
+        None, lambda: time.sleep(0.1))
+"""
+    assert run_source(good, checks=["async-blocking"]) == []
+
+
+# ---------------------------------------------------------------------------
+# feature-gate
+# ---------------------------------------------------------------------------
+
+def test_feature_gate_bad():
+    bad = """
+from kubernetes_tpu.util.features import GATES
+if GATES.enabled("DefinitelyNotAGate"):
+    pass
+GATES.parse("PodPriority=false,AlsoNotAGate=true")
+"""
+    got = run_source(bad, checks=["feature-gate"])
+    assert len(got) == 2
+    assert "DefinitelyNotAGate" in got[0].message
+
+
+def test_feature_gate_good():
+    good = """
+from kubernetes_tpu.util.features import GATES
+if GATES.enabled("PodPriority") and GATES.enabled("GangScheduling"):
+    pass
+GATES.parse("NodePressureEviction=false")
+d.get("unrelated")  # non-gate receivers are not checked
+"""
+    assert run_source(good, checks=["feature-gate"]) == []
+
+
+# ---------------------------------------------------------------------------
+# metric-name
+# ---------------------------------------------------------------------------
+
+def test_metric_name_invalid():
+    bad = """
+from kubernetes_tpu.metrics.registry import Counter
+C = Counter("tpu-bad-name", "dashes are not prometheus")
+"""
+    got = run_source(bad, checks=["metric-name"])
+    assert names(got) == ["metric-name"]
+    assert "invalid" in got[0].message
+
+
+def test_metric_name_collision():
+    bad = """
+from kubernetes_tpu.metrics.registry import Counter, Gauge
+A = Counter("tpu_widgets_total", "first registration wins")
+B = Gauge("tpu_widgets_total", "this instance records nothing")
+"""
+    got = run_source(bad, checks=["metric-name"])
+    assert len(got) == 1 and "already registered" in got[0].message
+
+
+def test_metric_name_good():
+    good = """
+from kubernetes_tpu.metrics.registry import Counter, Histogram
+A = Counter("tpu_widgets_total", "x", labels=("result",))
+B = Histogram("tpu_widget_seconds", "y")
+"""
+    assert run_source(good, checks=["metric-name"]) == []
+
+
+# ---------------------------------------------------------------------------
+# cache-mutation
+# ---------------------------------------------------------------------------
+
+def test_cache_mutation_bad():
+    bad = """
+def sync(self, key):
+    pod = self.pod_informer.get(key)
+    pod.status.phase = "Running"
+    for p in self.pod_informer.list():
+        p.metadata.labels["touched"] = "1"
+    node = self.node_informer.get(key)
+    node.metadata.annotations.update({"a": "1"})
+    stale = node.metadata.labels.pop("stale")  # mutator as assignment RHS
+"""
+    got = run_source(bad, checks=["cache-mutation"])
+    assert names(got) == ["cache-mutation"] * 4
+
+
+def test_cache_mutation_good():
+    good = """
+from kubernetes_tpu.api.scheme import deepcopy
+def sync(self, key):
+    pod = self.pod_informer.get(key)
+    if pod.status.phase == "Running":  # reads are fine
+        return
+    fresh = deepcopy(pod)
+    fresh.status.phase = "Running"     # mutating the copy is fine
+    pod = deepcopy(pod)
+    pod.metadata.labels["x"] = "1"     # rebind launders the name
+    local = build_pod()
+    local.status.phase = "Pending"     # non-cache object
+"""
+    assert run_source(good, checks=["cache-mutation"]) == []
+
+
+# ---------------------------------------------------------------------------
+# framework behavior
+# ---------------------------------------------------------------------------
+
+def test_suppression_comment():
+    src = """
+try:
+    risky()
+except Exception:  # tpuvet: ignore[swallowed-exception]
+    pass
+"""
+    assert run_source(src, checks=["swallowed-exception"]) == []
+    # ...but a different pass name does not suppress it
+    src2 = src.replace("swallowed-exception]", "metric-name]")
+    assert len(run_source(src2, checks=["swallowed-exception"])) == 1
+
+
+def test_registry_has_all_passes():
+    assert {"swallowed-exception", "async-blocking", "feature-gate",
+            "metric-name", "cache-mutation"} <= set(REGISTRY)
+
+
+def test_tree_is_clean():
+    """The hack/verify.sh contract: zero findings over the package."""
+    findings = run_tree(PKG)
+    assert findings == [], "\n".join(str(f) for f in findings)
+
+
+def test_overlapping_roots_do_not_double_parse():
+    # `hack/verify.sh <path>` appends the default package after "$@";
+    # overlapping roots must not manufacture metric-name collisions.
+    findings = run_tree(PKG, PKG)
+    assert findings == [], "\n".join(str(f) for f in findings)
+
+
+def test_cli_exit_codes(tmp_path):
+    from kubernetes_tpu.analysis.__main__ import main
+    bad = tmp_path / "bad.py"
+    bad.write_text("try:\n    x()\nexcept Exception:\n    pass\n")
+    good = tmp_path / "good.py"
+    good.write_text("x = 1\n")
+    assert main([str(good)]) == 0
+    assert main([str(bad)]) == 1
+    assert main(["--check", "metric-name", str(bad)]) == 0  # other pass only
+    assert main(["--check", "no-such-pass", str(bad)]) == 2
+    assert main(["--list"]) == 0
