@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# Functional smoke of the REST control-plane batch path: a <60s density
+# arm (50 nodes / 300 pods) through the real three-process wire path —
+# apiserver subprocess, loadgen subprocess (batchCreate saturation
+# phase), scheduler in-process (bindings:batch via the coalescer).
+# Catches "batch API broke" the way tier-1 unit tests cannot: end to
+# end, over HTTP. Siblings: hack/bench.sh (full headline bench),
+# hack/test.sh (runs this after the static-analysis gate).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+timeout -k 10 60 env JAX_PLATFORMS=cpu python - <<'EOF'
+import asyncio, json, sys
+from kubernetes_tpu.perf.density import run_density
+
+out = asyncio.run(run_density(
+    n_nodes=50, n_pods=300, via="rest", timeout=20.0,
+    create_concurrency=16, paced_pods=50, paced_rate=100.0))
+print(json.dumps(out))
+bound = out.get("bound", 0)
+if bound < 300:
+    sys.exit(f"bench_smoke: only {bound}/300 pods bound")
+p99 = out.get("bind_call_p99_ms")
+if p99 is None or "bind_call_percentiles_approx" in out:
+    sys.exit("bench_smoke: bind_call percentiles are not raw measurements")
+EOF
+echo "bench_smoke: ok"

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import asyncio
 import datetime
+import json
 from dataclasses import dataclass, field as dc_field
 from typing import Any, Callable, Optional
 
@@ -231,6 +232,12 @@ class Registry:
         self.cluster_cidr = "10.64.0.0/12"
         self._svc_ips = None     # lazy ServiceIPAllocator
         self._node_cidrs = None  # lazy CIDRAllocator
+        # Serialize-once response cache (encodecache.py): encoded JSON
+        # bytes per (key, revision), shared by GET / LIST assembly /
+        # the watch fan-out; invalidated on every store write.
+        from .encodecache import EncodeCache
+        self.encode_cache = EncodeCache()
+        self.store.add_write_hook(self.encode_cache.invalidate)
         for spec in builtin_resources():
             self.add_resource(spec)
         # Durable restart: re-install custom resources already defined.
@@ -343,6 +350,23 @@ class Registry:
             self._install_crd(obj)
         meta.resource_version = str(rev)
         return obj
+
+    def create_batch(self, objs: list) -> list:
+        """Create many objects in one dispatch, per-item outcomes.
+
+        Each item runs the FULL single-create pipeline (defaulting,
+        admission, validation, allocator claims) — the batch only
+        amortizes transport/dispatch overhead, never policy. Returns
+        ``[(created, None) | (None, StatusError), ...]`` positionally;
+        partial failure is not an error for the batch (reference: the
+        per-item Status list of bulk APIs)."""
+        out = []
+        for obj in objs:
+            try:
+                out.append((self.create(obj), None))
+            except errors.StatusError as e:
+                out.append((None, e))
+        return out
 
     def _ensure_svc_allocator(self) -> None:
         """Lazy-build the VIP allocator, occupancy rebuilt from stored
@@ -521,6 +545,53 @@ class Registry:
         spec = self.spec_for(plural)
         stored = self.store.get(self._key(spec, namespace, name), copy=False)
         return self._decode(spec, stored.value, stored.mod_revision)
+
+    # -- serialize-once reads (see encodecache.py) ------------------------
+
+    def encoded_value(self, key: str, value: dict, rev: int,
+                      which: str = "cur") -> bytes:
+        """Encoded JSON bytes of a stored object at ``rev``, with the
+        store-owned resource_version injected — cached so every reader
+        of the same revision (GET, LIST assembly, each watch fan-out
+        consumer) shares ONE ``json.dumps``. ``value`` must be the
+        store-owned dict (never mutated here: the injection shallow-
+        copies)."""
+        line = self.encode_cache.get(key, rev, which)
+        if line is None:
+            obj = {**value,
+                   "metadata": {**(value.get("metadata") or {}),
+                                "resource_version": str(rev)}}
+            line = json.dumps(obj, separators=(",", ":")).encode()
+            self.encode_cache.put(key, rev, line, which)
+        return line
+
+    def get_encoded(self, plural: str, namespace: str, name: str) -> bytes:
+        """GET fast path: the object's wire bytes without the typed
+        decode + re-encode round trip (storage-version readers only —
+        version conversion takes the typed path)."""
+        spec = self.spec_for(plural)
+        stored = self.store.get(self._key(spec, namespace, name), copy=False)
+        return self.encoded_value(stored.key, stored.value,
+                                  stored.mod_revision)
+
+    def list_encoded(self, plural: str, namespace: str = "",
+                     label_selector: str = "") -> tuple[list[bytes], int]:
+        """LIST fast path: per-item wire bytes (cache-shared with GET
+        and the watch fan-out) + the list revision. Label selectors
+        match the raw stored dict, like :meth:`list`; field selectors
+        need typed extraction and take the slow path."""
+        spec = self.spec_for(plural)
+        stored, rev = self.store.list(self._prefix(spec, namespace),
+                                      copy=False)
+        sel = parse_selector(label_selector) if label_selector else None
+        out = []
+        for s in stored:
+            if sel is not None:
+                raw_labels = (s.value.get("metadata") or {}).get("labels") or {}
+                if not sel.matches(raw_labels):
+                    continue
+            out.append(self.encoded_value(s.key, s.value, s.mod_revision))
+        return out, rev
 
     def list(self, plural: str, namespace: str = "", label_selector: str = "",
              field_selector: str = "") -> tuple[list[TypedObject], int]:
@@ -1009,45 +1080,99 @@ class Registry:
 
     # -- pods/binding subresource ----------------------------------------
 
-    def bind_pod(self, namespace: str, name: str, binding: t.Binding) -> t.Pod:
+    def bind_pod(self, namespace: str, name: str, binding: t.Binding,
+                 decode: bool = True) -> Optional[t.Pod]:
         """Atomically set node_name + chip assignments + PodScheduled.
 
         Reference: ``BindingREST.Create`` -> ``setPodHostAndAnnotations``
         (``pkg/registry/core/pod/storage/storage.go:138-197``): one
         GuaranteedUpdate writes host and device IDs together.
+        ``decode=False`` skips typing the written pod for callers that
+        only need success/failure (the batch bind path — its response
+        carries per-item status, not pod echoes).
         """
         spec = self.spec_for("pods")
         key = self._key(spec, namespace, name)
         target = binding.target
 
         def apply(cur: Optional[dict]) -> dict:
-            pod = from_dict(t.Pod, cur)
-            if pod.metadata.deletion_timestamp is not None:
+            # Dict-level on the stored value: a bind touches node_name,
+            # claim assignments, and one condition of a pod that is
+            # otherwise UNCHANGED — the full scheme decode + re-encode
+            # this replaces was a measured per-bind hot-path cost at
+            # density scale. ``cur`` is guaranteed_update's private
+            # copy, so in-place mutation is safe. Semantics mirror
+            # the typed path (update_pod_condition) exactly.
+            meta = cur.get("metadata") or {}
+            if meta.get("deletion_timestamp") is not None:
                 raise errors.ConflictError(f"pod {namespace}/{name} is terminating")
-            if pod.spec.node_name and pod.spec.node_name != target.node_name:
+            spec_d = cur.get("spec") or {}
+            bound_to = spec_d.get("node_name") or ""
+            if bound_to and bound_to != target.node_name:
                 raise errors.ConflictError(
-                    f"pod {namespace}/{name} already bound to {pod.spec.node_name}")
-            pod.spec.node_name = target.node_name
+                    f"pod {namespace}/{name} already bound to {bound_to}")
+            spec_d["node_name"] = target.node_name
+            cur["spec"] = spec_d
             by_name = {b.name: b for b in target.tpu_bindings}
-            for claim in pod.spec.tpu_resources:
-                b = by_name.pop(claim.name, None)
+            claims = spec_d.get("tpu_resources") or []
+            for claim in claims:
+                b = by_name.pop(claim.get("name", ""), None)
                 if b is not None:
-                    claim.assigned = list(b.chip_ids)
+                    claim["assigned"] = list(b.chip_ids)
             if by_name:
                 raise errors.BadRequestError(
                     f"binding names {sorted(by_name)} match no tpu_resources claim")
-            missing = [c.name for c in pod.spec.tpu_resources if not c.assigned]
+            missing = [c.get("name", "") for c in claims
+                       if not c.get("assigned")]
             if missing:
                 raise errors.BadRequestError(
                     f"binding must assign chips for claims {missing}")
-            t.update_pod_condition(pod.status, t.PodCondition(
-                type=t.COND_POD_SCHEDULED, status="True"))
-            d = to_dict(pod)
-            d.get("metadata", {}).pop("resource_version", None)
-            return d
+            status_d = cur.get("status") or {}
+            conds = status_d.get("conditions") or []
+            existing = next((c for c in conds
+                             if c.get("type") == t.COND_POD_SCHEDULED), None)
+            if existing is None or existing.get("status") != "True" \
+                    or existing.get("reason") or existing.get("message"):
+                newc = to_dict(t.PodCondition(
+                    type=t.COND_POD_SCHEDULED, status="True",
+                    last_transition_time=now()))
+                if existing is not None:
+                    if existing.get("status") == "True":
+                        # Same truth value: transition time is preserved
+                        # (update_pod_condition semantics).
+                        newc["last_transition_time"] = \
+                            existing.get("last_transition_time")
+                    conds.remove(existing)
+                conds.append(newc)
+            status_d["conditions"] = conds
+            cur["status"] = status_d
+            meta.pop("resource_version", None)
+            return cur
 
         value, rev = self.store.guaranteed_update(key, apply)
+        if not decode:
+            return None
         return self._decode(spec, value, rev)
+
+    def bind_pods_batch(self, namespace: str,
+                        items: list[tuple[str, t.Binding]]) -> list:
+        """Bind many pods in one dispatch, per-item outcomes.
+
+        Each (name, binding) pair runs :meth:`bind_pod`'s full
+        guaranteed-update (atomic node+chips write, conflict checks);
+        only the per-call transport/bookkeeping is amortized. Returns
+        ``[(None, None) | (None, StatusError), ...]`` positionally —
+        success carries no pod echo (callers read results through
+        informers), and one failed member never aborts the rest (the
+        gang path owns rollback policy, not the storage layer)."""
+        out = []
+        for name, binding in items:
+            try:
+                out.append((self.bind_pod(namespace, name, binding,
+                                          decode=False), None))
+            except errors.StatusError as e:
+                out.append((None, e))
+        return out
 
 
 class ObjectWatch:
@@ -1136,10 +1261,12 @@ class RawObjectWatch:
     selectors need typed extraction, so those watchers take the
     :class:`ObjectWatch` path.
 
-    ``next`` yields ``(etype, payload_dict, revision, which)`` where
-    ``which`` is ``"cur"`` or ``"prev"`` — a cache key component: the
-    same store revision can surface different payloads to different
-    watchers (a selector-left MODIFIED surfaces the corpse as DELETED).
+    ``next`` yields ``(etype, payload_dict, revision, which, key)``
+    where ``which`` is ``"cur"`` or ``"prev"`` — a cache key component:
+    the same store revision can surface different payloads to different
+    watchers (a selector-left MODIFIED surfaces the corpse as DELETED)
+    — and ``key`` is the store key, which the serialize-once encode
+    cache (encodecache.py) indexes by.
     Payload dicts alias the store log: consumers MUST NOT mutate them.
     """
 
@@ -1169,7 +1296,7 @@ class RawObjectWatch:
             ev = await self._raw.next(timeout)
             if ev is None:
                 if self._raw.closed:
-                    return (self.CLOSED, None, 0, "cur")
+                    return (self.CLOSED, None, 0, "cur", "")
                 return None
             out = self._translate(ev)
             if out is not None:
@@ -1180,12 +1307,13 @@ class RawObjectWatch:
         # selector-transition semantics as the reference watch cache).
         old_match = self._match(ev.prev_value)
         if ev.type == DELETED:
-            return (DELETED, ev.value, ev.revision, "cur") if old_match else None
+            return ((DELETED, ev.value, ev.revision, "cur", ev.key)
+                    if old_match else None)
         if self._match(ev.value):
             etype = ADDED if (ev.type == ADDED or not old_match) else MODIFIED
-            return (etype, ev.value, ev.revision, "cur")
+            return (etype, ev.value, ev.revision, "cur", ev.key)
         if old_match:  # left the selected set
-            return (DELETED, ev.prev_value, ev.revision, "prev")
+            return (DELETED, ev.prev_value, ev.revision, "prev", ev.key)
         return None
 
 

@@ -24,13 +24,14 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import time
 from typing import Optional
 
 from aiohttp import web
 
 from ..api import errors
 from ..api.scheme import deepcopy as obj_deepcopy, to_dict
-from ..metrics.registry import REGISTRY as METRICS, Histogram
+from ..metrics.registry import REGISTRY as METRICS, Counter, Histogram
 from .admission import default_chain
 from .audit import AuditLogger
 from .authz import Attributes, Authorizer, verb_for_request
@@ -45,6 +46,21 @@ REQUEST_LATENCY = Histogram(
     buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
              0.5, 0.75, 1.0, 1.5, 2.5),
 )
+
+BATCH_REQUESTS = Counter(
+    "apiserver_batch_requests_total",
+    "Batch API requests (:batchCreate / bindings:batch) by kind",
+    labels=("kind",))
+
+BATCH_ITEMS = Counter(
+    "apiserver_batch_items_total",
+    "Per-item outcomes inside batch API requests",
+    labels=("kind", "result"))
+
+#: Per-request item cap for the batch subresources — one request must
+#: not monopolize the event loop (the reference bounds list chunks the
+#: same way; callers split larger batches).
+MAX_BATCH_ITEMS = 512
 
 
 class APIServer:
@@ -96,9 +112,6 @@ class APIServer:
         #: requests get 429 and clients back off.
         self.max_inflight = 400
         self._inflight = 0
-        #: (etype, revision, which) -> encoded watch line; serialize-
-        #: once fan-out across watchers (see _encode_watch_event).
-        self._watch_enc: dict[tuple, bytes] = {}
         #: token -> (namespace, sa name) reverse index over SA token
         #: Secrets, rebuilt at most every ttl seconds — O(1) lookups,
         #: bounded by the number of SA secrets (unknown tokens cost a
@@ -188,7 +201,6 @@ class APIServer:
         is_watch = (request.method == "GET"
                     and not request.match_info.get("name")
                     and request.query.get("watch") in ("1", "true"))
-        import time
         start = time.perf_counter()
         code = 500
         admitted = False
@@ -215,6 +227,8 @@ class APIServer:
             if group:
                 version = request.match_info.get("version", "")
                 plural = request.match_info.get("plural", "")
+                if ":" in plural:  # {plural}:batchCreate action suffix
+                    plural = plural.split(":", 1)[0]
                 spec = self.registry._by_plural.get(plural)
                 gv = f"{group}/{version}"
                 local = (spec is not None and
@@ -343,7 +357,6 @@ class APIServer:
         working: resolution requires the SA object to still exist."""
         if not token:
             return None
-        import time
         now = time.monotonic()
         if now - self._sa_index_at > self.sa_index_ttl:
             self._rebuild_sa_index()
@@ -406,6 +419,13 @@ class APIServer:
             return None
         name = request.match_info.get("name", "")
         sub = request.match_info.get("subresource", "")
+        if ":" in plural:
+            # Batch action suffix ({plural}:batchCreate) — authorization
+            # attributes are those of the underlying per-item verb on
+            # the base resource: a batch must never be a policy bypass.
+            plural = plural.split(":", 1)[0]
+        if request.path.endswith("/bindings:batch"):
+            sub = "binding"
         verb = verb_for_request(request.method, bool(name),
                                 request.query.get("watch") in ("1", "true"))
         user = request.get("user", "system:anonymous")
@@ -539,7 +559,13 @@ class APIServer:
         base = "/api/{group}/{version}"
         for prefix in (base + "/namespaces/{namespace}/{plural}", base + "/{plural}"):
             r.add_get(prefix, self._list_or_watch)
+            # _create also serves POST {plural}:batchCreate — the colon
+            # action suffix lands inside the {plural} segment, so the
+            # collection route matches it without a second resource.
             r.add_post(prefix, self._create)
+            # Batched scheduler binds: one request, N pods/binding
+            # subresource writes (see _bind_batch).
+            r.add_post(prefix + "/bindings:batch", self._bind_batch)
             r.add_delete(prefix, self._delete_collection)
             r.add_get(prefix + "/{name}", self._get)
             r.add_put(prefix + "/{name}", self._update)
@@ -843,7 +869,6 @@ class APIServer:
         """Merge aggregated apiservers' resources into /apis (reference:
         the aggregator's discovery merge), filtered to each APIService's
         claimed group and briefly cached."""
-        import time
         if time.monotonic() - self._agg_discovery_at < 15.0:
             return self._agg_discovery
         merged: list = []
@@ -977,16 +1002,50 @@ class APIServer:
 
     async def _create(self, request):
         plural, ns = self._ctx(request)
+        if plural.endswith(":batchCreate"):
+            return await self._batch_create(
+                request, plural[: -len(":batchCreate")], ns)
         spec = self.registry.spec_for(plural)
         data = await self._body_obj(request)
         conv = self._conv_version(request, spec)
+        created = await self._create_one(plural, ns, spec, data, conv)
+        if plural.endswith("webhookconfigurations"):
+            self.webhooks.invalidate()
+        if not conv:
+            # Encode the response THROUGH the serialize-once cache: the
+            # same bytes serve this reply, the create's watch fan-out
+            # line to every watcher, and any immediate GET.
+            d = to_dict(created)
+            rv = d.get("metadata", {}).pop("resource_version", None)
+            if rv is not None:
+                key = self.registry._key(spec, created.metadata.namespace,
+                                         created.metadata.name)
+                return web.Response(
+                    body=self.registry.encoded_value(key, d, int(rv)),
+                    status=201, content_type="application/json")
+        return self._obj_response(created, status=201, convert=conv)
+
+    def _decode_create_body(self, ns: str, spec, data: dict, conv: str):
+        """Versioned request body dict -> decoded hub object, namespace
+        applied. Shared by the single and batch create paths."""
         if conv:
             data = self._body_to_hub(data, conv, spec)
         data.setdefault("api_version", spec.api_version)
         data.setdefault("kind", spec.kind)
-        obj = self.registry.scheme.decode(data)
+        try:
+            obj = self.registry.scheme.decode(data)
+        except (TypeError, ValueError, KeyError) as e:
+            raise errors.BadRequestError(
+                f"undecodable {spec.kind} body: {e}") from None
         if ns:
             obj.metadata.namespace = ns
+        return obj
+
+    async def _create_one(self, plural: str, ns: str, spec, data: dict,
+                          conv: str):
+        """The full one-object create pipeline (decode, external
+        webhooks, in-tree admission via the registry, store write)."""
+        obj = self._decode_create_body(ns, spec, data, conv)
         if self.webhooks.has_hooks("CREATE", plural):
             d = await self.webhooks.run_mutating(
                 "CREATE", plural, ns, obj.metadata.name, to_dict(obj))
@@ -1007,17 +1066,167 @@ class APIServer:
                 await self.webhooks.run_validating(
                     "CREATE", plural, ns, obj.metadata.name,
                     to_dict(admitted))
-        created = await self._mutate(self.registry.create, obj)
+        return await self._mutate(self.registry.create, obj)
+
+    #: Items per inline dispatch of a batch — the no-webhook path runs
+    #: synchronous create/bind pipelines back to back, and the shared
+    #: event loop (watch fan-out, other requests) must get a turn
+    #: between chunks; MAX_BATCH_ITEMS alone only bounds the stall.
+    BATCH_DISPATCH_CHUNK = 64
+
+    @staticmethod
+    def _batch_items(body, shape: str) -> list:
+        """Validated ``items`` list of a batch request body (shared
+        envelope rules for every batch subresource)."""
+        items = body.get("items") if isinstance(body, dict) else None
+        if not isinstance(items, list):
+            raise errors.BadRequestError(
+                f'batch body must be {{"items": [{shape}, ...]}}')
+        if len(items) > MAX_BATCH_ITEMS:
+            raise errors.BadRequestError(
+                f"batch of {len(items)} exceeds the {MAX_BATCH_ITEMS}-item "
+                f"limit; split the request")
+        return items
+
+    async def _dispatch_batch(self, fn, ready: list) -> list:
+        """Run a registry batch op in event-loop-friendly chunks."""
+        outs: list = []
+        for off in range(0, len(ready), self.BATCH_DISPATCH_CHUNK):
+            outs.extend(await self._mutate(
+                fn, ready[off:off + self.BATCH_DISPATCH_CHUNK]))
+            if off + self.BATCH_DISPATCH_CHUNK < len(ready):
+                await asyncio.sleep(0)  # let watchers/requests breathe
+        return outs
+
+    @staticmethod
+    def _batch_response(kind: str, results: list,
+                        emit=None) -> web.Response:
+        """Positional per-item BatchResult from ``(obj, err)`` pairs;
+        ``emit(obj) -> dict | None`` adds a success payload."""
+        out_items = []
+        for obj, err in results:
+            if err is not None:
+                BATCH_ITEMS.inc(kind=kind, result="error")
+                out_items.append({"status": err.code, "error": err.to_dict()})
+            else:
+                BATCH_ITEMS.inc(kind=kind, result="ok")
+                item = {"status": 201}
+                payload = emit(obj) if emit is not None else None
+                if payload is not None:
+                    item["object"] = payload
+                out_items.append(item)
+        return web.json_response({"kind": "BatchResult", "items": out_items})
+
+    async def _batch_create(self, request, plural: str, ns: str):
+        """POST ``{plural}:batchCreate`` — N creates in one request.
+
+        Validation + admission run per object; HTTP framing, authn/z,
+        audit, and dispatch are paid once. Partial failure is NOT an
+        error for the batch: the response carries a positional per-item
+        status (201 + object, or the item's error Status)."""
+        spec = self.registry.spec_for(plural)
+        items = self._batch_items(await self._body_obj(request), "object")
+        BATCH_REQUESTS.inc(kind="create")
+        conv = self._conv_version(request, spec)
+        # ``?echo=0``: omit created objects from the response — bulk
+        # submitters (loadgen) discard them, and N pod encodes + N
+        # client parses per batch is pure waste on both sides.
+        echo = request.query.get("echo", "1") not in ("0", "false")
+        results: list = [None] * len(items)
+        if self.webhooks.has_hooks("CREATE", plural):
+            # External hooks are per-object async round trips — run each
+            # item through the single-create pipeline (the request still
+            # amortizes framing/authn/audit across the batch).
+            for i, data in enumerate(items):
+                try:
+                    if not isinstance(data, dict):
+                        raise errors.BadRequestError("item must be an object")
+                    results[i] = (await self._create_one(
+                        plural, ns, spec, dict(data), conv), None)
+                except errors.StatusError as e:
+                    results[i] = (None, e)
+        else:
+            decoded, idxs = [], []
+            for i, data in enumerate(items):
+                try:
+                    if not isinstance(data, dict):
+                        raise errors.BadRequestError("item must be an object")
+                    decoded.append(self._decode_create_body(
+                        ns, spec, dict(data), conv))
+                    idxs.append(i)
+                except errors.StatusError as e:
+                    results[i] = (None, e)
+            if decoded:
+                outs = await self._dispatch_batch(
+                    self.registry.create_batch, decoded)
+                for i, res in zip(idxs, outs):
+                    results[i] = res
         if plural.endswith("webhookconfigurations"):
             self.webhooks.invalidate()
-        return self._obj_response(created, status=201, convert=conv)
+
+        def emit(created):
+            if not echo:
+                return None
+            d = to_dict(created)
+            return (self.registry.scheme.from_hub(conv, created.kind, d)
+                    if conv else d)
+
+        return self._batch_response("create", results, emit)
+
+    async def _bind_batch(self, request):
+        """POST ``pods/bindings:batch`` — N scheduler binds, one
+        request. Each item runs the atomic bind_pod guaranteed-update;
+        the response is a positional per-item status list (the bound
+        pod objects are NOT echoed — high-rate callers read results
+        through their informer, the same reason ``bind(decode=False)``
+        exists)."""
+        plural, ns = self._ctx(request)
+        if plural != "pods":
+            raise errors.BadRequestError(
+                f"bindings:batch is a pods subresource, not {plural!r}")
+        items = self._batch_items(await self._body_obj(request),
+                                  '{"name": ..., "target": {...}}')
+        BATCH_REQUESTS.inc(kind="bind")
+        from ..api.scheme import from_dict
+        from ..api.types import Binding
+        results: list = [None] * len(items)
+        pairs, idxs = [], []
+        for i, item in enumerate(items):
+            name = item.get("name", "") if isinstance(item, dict) else ""
+            if not name:
+                results[i] = (None, errors.BadRequestError(
+                    "binding item needs a pod name"))
+                continue
+            try:
+                binding = from_dict(Binding, item)
+            except (TypeError, ValueError) as e:
+                results[i] = (None, errors.BadRequestError(
+                    f"undecodable binding: {e}"))
+                continue
+            pairs.append((name, binding))
+            idxs.append(i)
+        if pairs:
+            import functools
+            outs = await self._dispatch_batch(
+                functools.partial(self.registry.bind_pods_batch, ns), pairs)
+            for i, res in zip(idxs, outs):
+                results[i] = res
+        return self._batch_response("bind", results)
 
     async def _get(self, request):
         plural, ns = self._ctx(request)
         spec = self.registry.spec_for(plural)
+        conv = self._conv_version(request, spec)
+        if not conv:
+            # Serialize-once fast path: the stored dict's cached wire
+            # bytes (shared with LIST and the watch fan-out) instead of
+            # typed decode -> to_dict -> json.dumps per request.
+            return web.Response(
+                body=self.registry.get_encoded(
+                    plural, ns, request.match_info["name"]),
+                content_type="application/json")
         obj = self.registry.get(plural, ns, request.match_info["name"])
-        return self._obj_response(
-            obj, convert=self._conv_version(request, spec))
+        return self._obj_response(obj, convert=conv)
 
     async def _list_or_watch(self, request):
         plural, ns = self._ctx(request)
@@ -1046,6 +1255,17 @@ class APIServer:
                 "metadata": meta,
                 "items": [emit(o) for o in items],
             })
+        if not conv and not q.get("field_selector"):
+            # Serialize-once fast path: assemble the List body from
+            # per-item cached wire bytes (shared with GET and the watch
+            # fan-out) — no typed decode/encode per object. Field
+            # selectors need typed extraction and stay on the slow path.
+            enc, rev = self.registry.list_encoded(
+                plural, ns, q.get("label_selector", ""))
+            body = (b'{"kind":"List","api_version":"core/v1","metadata":'
+                    b'{"resource_version":"' + str(rev).encode()
+                    + b'"},"items":[' + b",".join(enc) + b"]}")
+            return web.Response(body=body, content_type="application/json")
         items, rev = self.registry.list(
             plural, ns, q.get("label_selector", ""), q.get("field_selector", ""))
         return web.json_response({
@@ -1063,26 +1283,18 @@ class APIServer:
                 f"query parameter {name!r} must be an integer, got {value!r}") from None
 
     def _encode_watch_event(self, etype: str, payload: dict, rev: int,
-                            which: str) -> bytes:
+                            which: str, key: str) -> bytes:
         """One JSON encode per store event, shared by every raw watcher
-        (the watch cache's serialize-once fan-out; without this, N pod
-        watchers cost N encodes per event and the apiserver event loop
-        — shared with every in-process component — eats the REST-path
-        latency SLO). ``which`` disambiguates selector-left corpses
-        surfacing at the same revision."""
-        key = (etype, rev, which)
-        line = self._watch_enc.get(key)
-        if line is None:
-            # Shallow-copy to inject the store-owned resource_version
-            # without mutating the store log's dict.
-            obj = {**payload,
-                   "metadata": {**(payload.get("metadata") or {}),
-                                "resource_version": str(rev)}}
-            line = json.dumps({"type": etype, "object": obj}).encode() + b"\n"
-            if len(self._watch_enc) >= 4096:
-                self._watch_enc.clear()
-            self._watch_enc[key] = line
-        return line
+        AND the GET/LIST fast paths (the watch cache's serialize-once
+        fan-out, now backed by the registry's encode cache; without
+        this, N pod watchers cost N encodes per event and the apiserver
+        event loop — shared with every in-process component — eats the
+        REST-path latency SLO). Only the object payload is cached; the
+        event envelope is a cheap byte concat per watcher. ``which``
+        disambiguates selector-left corpses surfacing at the same
+        revision."""
+        obj_b = self.registry.encoded_value(key, payload, rev, which)
+        return b'{"type":"' + etype.encode() + b'","object":' + obj_b + b"}\n"
 
     async def _watch(self, request, plural: str, ns: str):
         q = request.query
@@ -1118,7 +1330,7 @@ class APIServer:
                         "object": {"metadata": {"resource_version": str(self.registry.store.revision)}},
                     }).encode() + b"\n")
                 elif raw_mode:
-                    etype, payload, rev, which = ev
+                    etype, payload, rev, which, ev_key = ev
                     if etype == "CLOSED":
                         break
                     if conv:
@@ -1134,7 +1346,7 @@ class APIServer:
                                 .encode() + b"\n")
                     else:
                         line = self._encode_watch_event(etype, payload, rev,
-                                                        which)
+                                                        which, ev_key)
                 else:
                     etype, obj = ev
                     if etype == "CLOSED":

@@ -76,6 +76,42 @@ class Client:
         typing the response; implementations may ignore the hint."""
         raise NotImplementedError
 
+    async def bind_many(self, namespace: str,
+                        bindings: list) -> list:
+        """Bind many pods: ``bindings`` is ``[(name, Binding), ...]``;
+        returns a positional list of per-item outcomes — None on
+        success, the item's exception instance on failure. A
+        transport-level failure raises for the whole call.
+
+        Default: a sequential loop over :meth:`bind` (kept deliberately
+        on ``self.bind`` so tests monkeypatching ``bind`` keep working);
+        RESTClient overrides with one ``pods/bindings:batch`` round
+        trip — the scheduler's gang bind and bind coalescer depend on
+        that for wire-path throughput."""
+        out = []
+        for name, binding in bindings:
+            try:
+                await self.bind(namespace, name, binding, decode=False)
+                out.append(None)
+            except Exception as e:  # noqa: BLE001 — per-item outcome list
+                out.append(e)
+        return out
+
+    async def create_many(self, objs: list, decode: bool = True) -> list:
+        """Create many objects; returns a positional list of per-item
+        outcomes — the created object, or the item's exception
+        instance. Partial failure does not raise. RESTClient overrides
+        with one ``{plural}:batchCreate`` round trip; ``decode=False``
+        lets implementations skip echoing/typing created objects
+        (successes may then be None)."""
+        out = []
+        for obj in objs:
+            try:
+                out.append(await self.create(obj))
+            except Exception as e:  # noqa: BLE001 — per-item outcome list
+                out.append(e)
+        return out
+
     async def evict(self, namespace: str, name: str, eviction: Any) -> Any:
         """PDB-gated voluntary delete (pods/<name>/eviction). Raises
         TooManyRequestsError while the budget allows no disruption."""

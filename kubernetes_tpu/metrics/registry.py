@@ -128,12 +128,20 @@ class Histogram(Metric):
 
     def __init__(self, name: str, help_: str = "", labels: Sequence[str] = (),
                  buckets: Sequence[float] = _DEFAULT_BUCKETS,
-                 registry: Optional["MetricsRegistry"] = None):
+                 registry: Optional["MetricsRegistry"] = None,
+                 sample_limit: int = 0):
+        """``sample_limit`` > 0 additionally retains up to that many RAW
+        observations per label set, so :meth:`raw_quantile` can report
+        TRUE percentiles — bench harnesses need them: bucket-quantile
+        answers are bucket upper bounds (250.0ms / 100.0ms style round
+        numbers), not measurements."""
         super().__init__(name, help_, labels, registry)
         self.buckets = tuple(sorted(buckets))
+        self.sample_limit = sample_limit
         self._counts: dict[tuple, list[int]] = {}
         self._sums: dict[tuple, float] = {}
         self._totals: dict[tuple, int] = {}
+        self._samples: dict[tuple, list[float]] = {}
 
     def observe(self, value: float, **labels) -> None:
         key = _label_key(self.label_names, labels)
@@ -147,12 +155,30 @@ class Histogram(Metric):
                 counts[i] += 1
             self._sums[key] = self._sums.get(key, 0.0) + value
             self._totals[key] = self._totals.get(key, 0) + 1
+            if self.sample_limit:
+                samples = self._samples.setdefault(key, [])
+                if len(samples) < self.sample_limit:
+                    samples.append(value)
 
     def reset(self) -> None:
         with self._lock:
             self._counts.clear()
             self._sums.clear()
             self._totals.clear()
+            self._samples.clear()
+
+    def raw_quantile(self, q: float, **labels) -> Optional[float]:
+        """Exact nearest-rank percentile over the retained raw samples;
+        None when nothing was retained (no observations, or
+        ``sample_limit`` unset). Once observations exceed the limit the
+        answer covers the first ``sample_limit`` only — still a real
+        measurement, never a bucket edge."""
+        key = _label_key(self.label_names, labels)
+        with self._lock:
+            samples = sorted(self._samples.get(key, ()))
+        if not samples:
+            return None
+        return samples[min(len(samples) - 1, int(q * len(samples)))]
 
     def quantile(self, q: float, **labels) -> float:
         """Approximate quantile from bucket boundaries (upper bound)."""

@@ -36,12 +36,23 @@ from .density import density_pod
 
 
 class _BoundWatcher:
-    """Raw-JSON pods watch: name -> first-seen-bound wall time."""
+    """Raw-JSON pods watch: name -> first-seen-bound wall time.
+
+    Recovery is a real reflector cycle: LIST (recording already-bound
+    pods, stamped into ``relisted`` so latency percentiles can exclude
+    their coarse timestamps), then WATCH from the list's revision. A
+    watch-only reconnect would silently LOSE any bind that happened
+    while disconnected — at 30k scale the server closes slow-consumer
+    streams (overflow), and the old live-only reconnect left the
+    harness waiting forever for events nobody would resend."""
 
     def __init__(self, server: str, namespace: str = "default"):
         self.server = server
         self.namespace = namespace
         self.bound_at: dict[str, float] = {}
+        #: Pods whose bound time came from a relist, not a watch event
+        #: (timestamp quantized to the reconnect, not the bind).
+        self.relisted: set[str] = set()
         self._task: asyncio.Task | None = None
         self._session: aiohttp.ClientSession | None = None
         self.waiters: list[tuple[int, asyncio.Event]] = []
@@ -51,25 +62,52 @@ class _BoundWatcher:
             timeout=aiohttp.ClientTimeout(total=None))
         self._task = asyncio.create_task(self._run())
 
+    def _note(self, obj: dict, from_relist: bool = False) -> None:
+        if (obj.get("spec") or {}).get("node_name"):
+            name = obj["metadata"]["name"]
+            if name not in self.bound_at:
+                self.bound_at[name] = time.perf_counter()
+                if from_relist:
+                    self.relisted.add(name)
+                if self.waiters:
+                    self.notify()
+
     async def _run(self) -> None:
-        url = (f"{self.server}/api/core/v1/namespaces/{self.namespace}"
-               f"/pods?watch=1")
+        base = (f"{self.server}/api/core/v1/namespaces/{self.namespace}"
+                f"/pods")
         while True:
             try:
+                # LIST on EVERY connect, including the first: the watch
+                # task races run_load's creates, and a live-only first
+                # watch would permanently miss any pod bound before the
+                # stream was accepted (the LIST is empty/cheap then).
+                rv = ""
+                async with self._session.get(base) as resp:
+                    if resp.status != 200:
+                        # Error Status body (e.g. 429 shedding):
+                        # falling through would watch live-only and
+                        # lose binds — retry the LIST instead.
+                        await asyncio.sleep(0.2)
+                        continue
+                    data = await resp.json()
+                rv = data.get("metadata", {}).get("resource_version", "")
+                for obj in data.get("items", []):
+                    self._note(obj, from_relist=True)
+                url = f"{base}?watch=1"
+                if rv:
+                    url += f"&resource_version={rv}"
                 async with self._session.get(url) as resp:
+                    if resp.status != 200:
+                        # e.g. 410 Gone (revision compacted): relist.
+                        await asyncio.sleep(0.2)
+                        continue
                     async for raw in resp.content:
                         ev = json.loads(raw)
                         if ev.get("type") not in ("ADDED", "MODIFIED"):
                             continue
-                        obj = ev.get("object") or {}
-                        if (obj.get("spec") or {}).get("node_name"):
-                            name = obj["metadata"]["name"]
-                            if name not in self.bound_at:
-                                self.bound_at[name] = time.perf_counter()
-                                if self.waiters:
-                                    self.notify()
-                    # Stream ended (server restart): reconnect + the
-                    # relist below covers anything missed.
+                        self._note(ev.get("object") or {})
+                    # Stream ended (overflow/server restart): loop back
+                    # to the LIST above — it recovers anything missed.
             except asyncio.CancelledError:
                 return
             except Exception:  # noqa: BLE001 — reconnect like a reflector
@@ -102,7 +140,13 @@ class _BoundWatcher:
 
 async def run_load(server: str, n_pods: int, concurrency: int = 64,
                    timeout: float = 600.0, namespace: str = "default",
-                   paced_pods: int = 300, rate: float = 100.0) -> dict:
+                   paced_pods: int = 300, rate: float = 100.0,
+                   create_batch: int = 32) -> dict:
+    """``create_batch`` > 1 pours the saturation phase through the
+    ``{plural}:batchCreate`` subresource (one request per chunk) — the
+    efficient client a real bulk submitter would be. The PACED phase
+    always creates one pod per request: its create->bound percentiles
+    are the honest single-request latency number."""
     client = RESTClient(server)
     watcher = _BoundWatcher(server, namespace)
     await watcher.start()
@@ -120,13 +164,25 @@ async def run_load(server: str, n_pods: int, concurrency: int = 64,
     try:
         # Phase A: saturation throughput (open loop).
         async def create_all():
+            from itertools import islice
             it = iter(range(n_pods))
 
             async def worker():
-                for i in it:
-                    name = f"density-{i:05d}"
-                    created_at[name] = time.perf_counter()
-                    await client.create(density_pod(name))
+                while True:
+                    chunk = list(islice(it, max(1, create_batch)))
+                    if not chunk:
+                        return
+                    objs = []
+                    for i in chunk:
+                        name = f"density-{i:05d}"
+                        created_at[name] = time.perf_counter()
+                        objs.append(density_pod(name))
+                    if len(objs) == 1 or create_batch <= 1:
+                        await client.create(objs[0])
+                        continue
+                    for r in await client.create_many(objs, decode=False):
+                        if isinstance(r, Exception):
+                            raise r
             await asyncio.gather(*(worker() for _ in range(concurrency)))
 
         start = time.perf_counter()
@@ -134,7 +190,8 @@ async def run_load(server: str, n_pods: int, concurrency: int = 64,
         await watcher.wait_for(n_pods, timeout)
         wall = time.perf_counter() - start
         sat_lats = sorted(watcher.bound_at[n] - created_at[n]
-                          for n in watcher.bound_at if n in created_at)
+                          for n in watcher.bound_at
+                          if n in created_at and n not in watcher.relisted)
         out.update({
             "pods": n_pods,
             "bound": len(watcher.bound_at),
@@ -143,6 +200,8 @@ async def run_load(server: str, n_pods: int, concurrency: int = 64,
             "saturation_latency_p50_ms": round(_pct(sat_lats, 0.5) * 1e3, 1),
             "saturation_latency_p99_ms": round(_pct(sat_lats, 0.99) * 1e3, 1),
         })
+        if watcher.relisted:
+            out["relist_stamped"] = len(watcher.relisted)
 
         # Phase B: paced latency (closed-ish loop below saturation).
         if paced_pods > 0 and rate > 0:
@@ -151,7 +210,8 @@ async def run_load(server: str, n_pods: int, concurrency: int = 64,
                 lambda name: client.create(density_pod(name)))
             await watcher.wait_for(n_pods + paced_pods, timeout)
             out.update({"paced_pods": paced_pods, "paced_rate": rate})
-            out.update(latency_percentiles(paced_created, watcher.bound_at))
+            out.update(latency_percentiles(paced_created, watcher.bound_at,
+                                           exclude=watcher.relisted))
     finally:
         poke.cancel()
         await watcher.stop()
@@ -167,10 +227,13 @@ async def amain(argv=None) -> int:
     p.add_argument("--timeout", type=float, default=600.0)
     p.add_argument("--paced-pods", type=int, default=300)
     p.add_argument("--rate", type=float, default=100.0)
+    p.add_argument("--create-batch", type=int, default=32,
+                   help="saturation-phase pods per :batchCreate request "
+                        "(1 = one create per request)")
     args = p.parse_args(argv)
     out = await run_load(args.server, args.pods, args.concurrency,
                          args.timeout, paced_pods=args.paced_pods,
-                         rate=args.rate)
+                         rate=args.rate, create_batch=args.create_batch)
     print(json.dumps(out), flush=True)
     return 0
 

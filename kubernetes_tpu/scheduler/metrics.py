@@ -24,7 +24,11 @@ ALGORITHM_LATENCY = Histogram(
 BINDING_LATENCY = Histogram(
     "scheduler_binding_latency_seconds",
     "Binding subresource POST latency",
-    buckets=_LAT_BUCKETS)
+    buckets=_LAT_BUCKETS,
+    # Raw samples so the density harness reports TRUE bind-call
+    # percentiles, not bucket upper bounds (the 250.0/100.0ms
+    # artifacts); 100k floats cap ~0.8MB, reset() between runs.
+    sample_limit=100_000)
 
 GANG_SCHEDULING_LATENCY = Histogram(
     "scheduler_gang_e2e_latency_seconds",

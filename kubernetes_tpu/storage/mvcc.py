@@ -75,8 +75,16 @@ class Watch:
     events are in flight, the watch is closed with ``overflowed`` set.
     """
 
+    #: Sized to ride out a reference-scale create burst (30k pods
+    #: arriving faster than a watcher drains while the shared core is
+    #: busy): entries are references into the store log, so buffering
+    #: is cheap, while an overflow costs the consumer a full relist —
+    #: 30k typed decodes — and at density scale relist thrash.
+    DEFAULT_QUEUE_LIMIT = 65536
+
     def __init__(self, store: "MVCCStore", prefix: str,
-                 loop: asyncio.AbstractEventLoop, queue_limit: int = 16384):
+                 loop: asyncio.AbstractEventLoop,
+                 queue_limit: int = DEFAULT_QUEUE_LIMIT):
         self._store = store
         self.prefix = prefix
         self._loop = loop
@@ -249,6 +257,8 @@ class MVCCStore:
         self._log_revs: list[int] = []
         self._history_limit = history_limit
         self._watches: list[Watch] = []
+        #: Key-level write listeners (see :meth:`add_write_hook`).
+        self._write_hooks: list[Callable[[str], None]] = []
         self._data_dir = data_dir
         self._wal = None
         if data_dir:
@@ -371,7 +381,17 @@ class MVCCStore:
 
     # -- core mutations ---------------------------------------------------
 
+    def add_write_hook(self, fn: Callable[[str], None]) -> None:
+        """Register ``fn(key)`` to run on every write (create/update/
+        delete), under the store lock, before watch delivery. Hooks must
+        be cheap, non-blocking leaf operations (the registry's encode
+        cache uses this for invalidate-on-write); they must never call
+        back into the store."""
+        self._write_hooks.append(fn)
+
     def _append_event(self, ev: WatchEvent) -> None:
+        for hook in self._write_hooks:
+            hook(ev.key)
         self._log.append(ev)
         self._log_revs.append(ev.revision)
         if len(self._log) > self._history_limit:

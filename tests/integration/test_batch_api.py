@@ -1,0 +1,202 @@
+"""Batch API (``{plural}:batchCreate`` / ``pods/bindings:batch``):
+per-item partial failure, admission enforcement inside a batch, and
+gang-bind rollback when a batched bind partially fails."""
+import asyncio
+
+import pytest
+
+from kubernetes_tpu.api import errors, types as t
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.client.rest import RESTClient
+
+import sys
+import os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from integration.test_scheduler import (  # noqa: E402
+    make_cluster, mk_node, mk_pod, wait_bound)
+
+
+async def start_server():
+    srv = APIServer()
+    port = await srv.start()
+    srv.registry.create(t.Namespace(metadata=ObjectMeta(name="default")))
+    return srv, RESTClient(f"http://127.0.0.1:{port}")
+
+
+def plain_pod(name="p"):
+    return t.Pod(metadata=ObjectMeta(name=name, namespace="default"),
+                 spec=t.PodSpec(containers=[t.Container(name="c", image="i")]))
+
+
+def binding(node="n1"):
+    return t.Binding(target=t.BindingTarget(node_name=node))
+
+
+async def test_batch_create_partial_failure():
+    """One invalid pod in a batch of 8 -> 7 created, 1 per-item error
+    with a reason; the batch itself is a 200."""
+    srv, client = await start_server()
+    try:
+        objs = [plain_pod(f"b-{i}") for i in range(8)]
+        objs[3].metadata.name = "NOT_A_DNS_NAME"
+        results = await client.create_many(objs)
+        assert len(results) == 8
+        oks = [r for r in results if not isinstance(r, Exception)]
+        errs = [r for r in results if isinstance(r, Exception)]
+        assert len(oks) == 7 and len(errs) == 1
+        assert isinstance(results[3], errors.StatusError)
+        assert "NOT_A_DNS_NAME" in str(results[3])
+        assert all(o.metadata.uid for o in oks)  # full create pipeline ran
+        items, _rev = await client.list("pods", "default")
+        assert len(items) == 7
+    finally:
+        await client.close()
+        await srv.stop()
+
+
+async def test_batch_create_admission_rejection():
+    """In-tree admission (ResourceQuota charge) runs per item inside a
+    batch — a quota of 2 pods admits exactly 2 of 4."""
+    srv, client = await start_server()
+    try:
+        quota = t.ResourceQuota(
+            metadata=ObjectMeta(name="q", namespace="default"),
+            spec=t.ResourceQuotaSpec(hard={"pods": 2.0}))
+        srv.registry.create(quota)
+        results = await client.create_many(
+            [plain_pod(f"q-{i}") for i in range(4)])
+        oks = [r for r in results if not isinstance(r, Exception)]
+        errs = [r for r in results if isinstance(r, Exception)]
+        assert len(oks) == 2 and len(errs) == 2
+        for e in errs:
+            assert isinstance(e, errors.StatusError)
+            assert "quota" in str(e).lower()
+    finally:
+        await client.close()
+        await srv.stop()
+
+
+async def test_batch_bind_partial_failure():
+    """bindings:batch with one nonexistent pod in 8 -> 7 bound, that
+    item fails with a reason; the rest are really bound."""
+    srv, client = await start_server()
+    try:
+        for i in range(7):
+            srv.registry.create(plain_pod(f"w-{i}"))
+        items = [(f"w-{i}", binding()) for i in range(7)]
+        items.insert(4, ("ghost", binding()))
+        results = await client.bind_many("default", items)
+        assert len(results) == 8
+        assert [isinstance(r, Exception) for r in results].count(True) == 1
+        assert isinstance(results[4], errors.NotFoundError)
+        for i in range(7):
+            pod = await client.get("pods", "default", f"w-{i}")
+            assert pod.spec.node_name == "n1"
+    finally:
+        await client.close()
+        await srv.stop()
+
+
+async def test_batch_bind_conflict_item():
+    """An already-bound pod inside a batch surfaces a per-item 409
+    (Conflict), not a whole-batch failure."""
+    srv, client = await start_server()
+    try:
+        srv.registry.create(plain_pod("a"))
+        srv.registry.create(plain_pod("b"))
+        srv.registry.bind_pod("default", "a", binding("other-node"))
+        results = await client.bind_many(
+            "default", [("a", binding("n1")), ("b", binding("n1"))])
+        assert isinstance(results[0], errors.ConflictError)
+        assert results[1] is None
+        pod = await client.get("pods", "default", "b")
+        assert pod.spec.node_name == "n1"
+    finally:
+        await client.close()
+        await srv.stop()
+
+
+async def test_batch_create_bad_body_shapes():
+    srv, client = await start_server()
+    try:
+        url = f"{client.base_url}/api/core/v1/namespaces/default/pods:batchCreate"
+        async with client._sess().post(url, json={"nope": 1}) as resp:
+            assert resp.status == 400
+        bind_url = (f"{client.base_url}/api/core/v1/namespaces/default"
+                    f"/pods/bindings:batch")
+        async with client._sess().post(bind_url, json={"items": 3}) as resp:
+            assert resp.status == 400
+        # Per-item junk stays per-item: a non-dict item errors alone.
+        async with client._sess().post(
+                url, json={"items": [42, {"metadata": {"name": "ok-pod"},
+                                          "spec": {"containers": [
+                                              {"name": "c", "image": "i"}]}}]}
+        ) as resp:
+            assert resp.status == 200
+            body = await resp.json()
+        assert body["items"][0]["status"] >= 400
+        assert body["items"][1]["status"] == 201
+    finally:
+        await client.close()
+        await srv.stop()
+
+
+async def test_gang_bind_rollback_on_batched_partial_failure():
+    """A batched gang bind returning a partial failure must forget ONLY
+    the failed member, keep the bound ones, and recover the remainder
+    with no chip double-allocation (the gang all-or-nothing contract
+    over bindings:batch semantics)."""
+    n1 = mk_node("host-0", chips=[(0, 0, 0), (0, 1, 0), (1, 0, 0), (1, 1, 0)],
+                 mesh=[2, 2, 2], slice_id="sl")
+    n2 = mk_node("host-1", chips=[(0, 0, 1), (0, 1, 1), (1, 0, 1), (1, 1, 1)],
+                 mesh=[2, 2, 2], slice_id="sl")
+    reg, client, sched = await make_cluster([n1, n2])
+    try:
+        real_bind_many = client.bind_many
+        fails = {"w1": 1}
+
+        async def flaky_bind_many(namespace, bindings):
+            # Drop one member from the real batch and hand back a
+            # per-item failure in its slot — exactly the shape a
+            # partial bindings:batch response has on the wire.
+            skip = {i for i, (n, _b) in enumerate(bindings)
+                    if fails.get(n, 0) > 0}
+            for i in skip:
+                fails[bindings[i][0]] -= 1
+            rest = [b for i, b in enumerate(bindings) if i not in skip]
+            rest_results = iter(await real_bind_many(namespace, rest)
+                                if rest else ())
+            return [errors.ConflictError("synthetic partial") if i in skip
+                    else next(rest_results) for i in range(len(bindings))]
+
+        sched.client.bind_many = flaky_bind_many
+
+        reg.create(t.PodGroup(metadata=ObjectMeta(name="g", namespace="default"),
+                              spec=t.PodGroupSpec(min_member=2)))
+        reg.create(mk_pod("w0", chips=4, gang="g"))
+        reg.create(mk_pod("w1", chips=4, gang="g"))
+
+        p0 = await wait_bound(reg, "w0", timeout=8)
+        p1 = await wait_bound(reg, "w1", timeout=8)
+        assert p0.spec.node_name and p1.spec.node_name
+        s0 = set(p0.spec.tpu_resources[0].assigned)
+        s1 = set(p1.spec.tpu_resources[0].assigned)
+        assert len(s0) == 4 and len(s1) == 4
+        assert not (s0 & s1), "chips double-allocated after partial failure"
+    finally:
+        await sched.stop()
+
+
+async def test_single_pod_binds_ride_batch_coalescer():
+    """_schedule_one binds flow through the coalescer and still land;
+    a burst of singleton pods all binds correctly."""
+    reg, client, sched = await make_cluster([mk_node("n1"), mk_node("n2")])
+    try:
+        for i in range(12):
+            reg.create(mk_pod(f"s-{i}", cpu=0.1))
+        for i in range(12):
+            pod = await wait_bound(reg, f"s-{i}", timeout=8)
+            assert pod.spec.node_name in ("n1", "n2")
+    finally:
+        await sched.stop()

@@ -249,3 +249,34 @@ async def test_list_pagination(tmp_path):
     finally:
         await client.close()
         await server.stop()
+
+
+async def test_sequential_binds_reuse_one_connection():
+    """Keep-alive regression (client/rest.py _sess): N sequential
+    creates + binds over the shared session must ride ONE pooled TCP
+    connection — per-request connection setup was wire-path overhead
+    the connector tuning exists to prevent."""
+    srv, client = await start_server()
+    try:
+        from kubernetes_tpu.api.types import Binding, BindingTarget
+        sess = client._sess()
+        conn = sess.connector
+        orig = conn._create_connection
+        dials = 0
+
+        async def counting(*args, **kwargs):
+            nonlocal dials
+            dials += 1
+            return await orig(*args, **kwargs)
+
+        conn._create_connection = counting
+        for i in range(5):
+            await client.create(mk_pod(f"ka-{i}"))
+        for i in range(5):
+            await client.bind("default", f"ka-{i}",
+                              Binding(target=BindingTarget(node_name="n1")),
+                              decode=False)
+        assert dials == 1, f"expected 1 TCP connection, dialed {dials}"
+    finally:
+        await client.close()
+        await srv.stop()

@@ -1,0 +1,101 @@
+"""Microbench of the apiserver request hot path: encode/decode/bind
+cycles (the profile that motivated the serialize-once cache and the
+batch subresources). Slow-marked — perf tier, not tier-1."""
+import json
+import time
+
+import pytest
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.api.scheme import to_dict
+from kubernetes_tpu.apiserver.registry import Registry
+
+
+def rich_pod(name: str) -> t.Pod:
+    return t.Pod(
+        metadata=ObjectMeta(name=name, namespace="default",
+                            labels={"app": "bench", "tier": "web"},
+                            annotations={"k": "v" * 40}),
+        spec=t.PodSpec(containers=[t.Container(
+            name="c", image="registry.example/app:1.2.3",
+            resources=t.ResourceRequirements(
+                requests={"cpu": 0.25, "memory": 128 * 2**20}))]))
+
+
+def _bench(fn, n: int) -> float:
+    fn()  # warm
+    start = time.perf_counter()
+    for _ in range(n):
+        fn()
+    return time.perf_counter() - start
+
+
+@pytest.mark.slow
+def test_repeated_get_serialize_once_speedup():
+    """A repeated GET of an UNCHANGED object must be >= 5x cheaper
+    through the serialize-once cache than through the old typed
+    decode -> to_dict -> json.dumps pipeline."""
+    reg = Registry()
+    reg.create(t.Namespace(metadata=ObjectMeta(name="default")))
+    reg.create(rich_pod("p"))
+
+    def uncached():
+        # The pre-cache GET pipeline, step for step.
+        obj = reg.get("pods", "default", "p")
+        return json.dumps(to_dict(obj)).encode()
+
+    def cached():
+        return reg.get_encoded("pods", "default", "p")
+
+    # Same wire content (modulo separators/key order).
+    assert json.loads(cached()) == json.loads(uncached())
+
+    n = 3000
+    t_uncached = _bench(uncached, n)
+    t_cached = _bench(cached, n)
+    speedup = t_uncached / t_cached
+    print(f"uncached={1e6 * t_uncached / n:.1f}us/get "
+          f"cached={1e6 * t_cached / n:.1f}us/get speedup={speedup:.1f}x")
+    assert speedup >= 5.0, (
+        f"serialize-once GET only {speedup:.1f}x cheaper "
+        f"({t_uncached:.3f}s vs {t_cached:.3f}s over {n} gets)")
+
+
+@pytest.mark.slow
+def test_cache_invalidated_on_write():
+    """A write must invalidate the cached encoding — the next GET
+    serves the new revision's bytes, re-encoded."""
+    reg = Registry()
+    reg.create(t.Namespace(metadata=ObjectMeta(name="default")))
+    reg.create(rich_pod("p"))
+    first = json.loads(reg.get_encoded("pods", "default", "p"))
+    pod = reg.get("pods", "default", "p")
+    pod.metadata.labels["rev"] = "2"
+    reg.update(pod)
+    second = json.loads(reg.get_encoded("pods", "default", "p"))
+    assert second["metadata"]["labels"]["rev"] == "2"
+    assert (second["metadata"]["resource_version"]
+            != first["metadata"]["resource_version"])
+
+
+@pytest.mark.slow
+def test_bind_cycle_microbench():
+    """Bind-cycle cost through the registry (the per-item work a
+    bindings:batch request amortizes transport around): prints the
+    per-bind cost and sanity-bounds it, so hot-path regressions show
+    up in the perf tier."""
+    reg = Registry()
+    reg.create(t.Namespace(metadata=ObjectMeta(name="default")))
+    n = 1000
+    for i in range(n):
+        reg.create(rich_pod(f"b-{i:04d}"))
+    binding = t.Binding(target=t.BindingTarget(node_name="n1"))
+    start = time.perf_counter()
+    out = reg.bind_pods_batch(
+        "default", [(f"b-{i:04d}", binding) for i in range(n)])
+    elapsed = time.perf_counter() - start
+    assert all(err is None for _pod, err in out)
+    per_bind_us = 1e6 * elapsed / n
+    print(f"bind cycle: {per_bind_us:.1f}us/bind ({n} binds)")
+    assert per_bind_us < 5000, f"bind cycle regressed: {per_bind_us:.0f}us"

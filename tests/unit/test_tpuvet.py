@@ -148,6 +148,26 @@ B = Histogram("tpu_widget_seconds", "y")
     assert run_source(good, checks=["metric-name"]) == []
 
 
+def test_metric_name_batch_and_encode_cache_families():
+    """The batch-API and serialize-once-cache metric families
+    (apiserver_batch_*, encode_cache_*) are valid names, and a
+    duplicate registration within the family is still caught."""
+    good = """
+from kubernetes_tpu.metrics.registry import Counter, Gauge
+A = Counter("apiserver_batch_requests_total", "x", labels=("kind",))
+B = Counter("apiserver_batch_items_total", "x", labels=("kind", "result"))
+C = Counter("encode_cache_hits_total", "x")
+D = Counter("encode_cache_misses_total", "x")
+E = Gauge("encode_cache_entries", "x")
+"""
+    assert run_source(good, checks=["metric-name"]) == []
+    bad = good + """
+F = Counter("encode_cache_hits_total", "re-registered: silently inert")
+"""
+    got = run_source(bad, checks=["metric-name"])
+    assert len(got) == 1 and "already registered" in got[0].message
+
+
 # ---------------------------------------------------------------------------
 # cache-mutation
 # ---------------------------------------------------------------------------
