@@ -1,0 +1,29 @@
+#!/usr/bin/env bash
+# Seeded chaos gate: the scripted fault schedule (chaos/harness.py)
+# over the REST control plane — transport resets/500s/hangs/slow
+# replies, watch drops, a store-watch overflow, and a mid-run WAL
+# crash with full control-plane restart — must CONVERGE in <90s:
+# every gang member bound, no chip double-booked, WAL replay
+# byte-identical to the pre-crash durable state, >=5 distinct fault
+# kinds injected. Seed via TPU_CHAOS=<n> (default below) — one seed
+# means one reproducible fault sequence per injection site.
+# Siblings: hack/bench_smoke.sh (perf arm), hack/test.sh (runs both).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+SEED="${TPU_CHAOS:-20260804}"
+
+timeout -k 10 90 env JAX_PLATFORMS=cpu TPU_CHAOS= python - "$SEED" <<'EOF'
+import asyncio, json, sys
+from kubernetes_tpu.chaos.harness import run_chaos
+
+report = asyncio.run(run_chaos(int(sys.argv[1])))
+print(json.dumps({k: v for k, v in report.items() if k != "fingerprints"}))
+if report["fault_kinds"] < 5:
+    sys.exit(f"chaos: only {report['fault_kinds']} fault kinds injected")
+if not report["faults"].get("wal:torn"):
+    sys.exit("chaos: the WAL crash never fired")
+if not report["faults"].get("watch.rest:drop"):
+    sys.exit("chaos: no watch drop fired")
+EOF
+echo "chaos: ok (seed ${SEED})"

@@ -3,13 +3,15 @@
 #
 # Siblings: hack/verify.sh (tpuvet static analysis — runs first here,
 # a verify failure fails the whole entrypoint), hack/bench_smoke.sh
-# (<60s REST density smoke of the batch API path — runs on full-suite
-# invocations; filtered runs skip it, KTPU_SMOKE=1 forces it),
-# hack/race.sh (TSAN/ASAN + asyncio-debug race tiers).
+# (<60s REST density smoke of the batch API path), hack/chaos.sh
+# (<90s seeded fault-schedule convergence gate) — both run on
+# full-suite invocations; filtered runs skip them, KTPU_SMOKE=1
+# forces them; hack/race.sh (TSAN/ASAN + asyncio-debug race tiers).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 ./hack/verify.sh
 if [ "$#" -eq 0 ] || [ "${KTPU_SMOKE:-}" = "1" ]; then
   ./hack/bench_smoke.sh
+  ./hack/chaos.sh
 fi
 exec python -m pytest tests/ -q "$@"

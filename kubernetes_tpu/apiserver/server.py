@@ -477,7 +477,11 @@ class APIServer:
 
     @staticmethod
     def _err(e: errors.StatusError) -> web.Response:
-        return web.json_response(e.to_dict(), status=e.code)
+        # 429/503 carry Retry-After (reference: the max-in-flight filter
+        # and apf send it) so clients back off by the server's clock,
+        # not a guess; the REST client honors it.
+        headers = {"Retry-After": "1"} if e.code in (429, 503) else None
+        return web.json_response(e.to_dict(), status=e.code, headers=headers)
 
     def _obj_response(self, obj, status: int = 200,
                       convert: str = "") -> web.Response:

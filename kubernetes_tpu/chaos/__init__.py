@@ -1,0 +1,28 @@
+"""Deterministic fault injection (chaos) for the control plane.
+
+Arm with ``TPU_CHAOS=<seed>`` (optionally ``TPU_CHAOS_SCHEDULE=...``)
+in the style of the other opt-in runtime detectors
+(``TPU_CACHE_MUTATION_DETECTOR``, ``TPU_LOCKDEP``). See
+:mod:`kubernetes_tpu.chaos.core` for the fault catalog and the
+determinism contract, :mod:`kubernetes_tpu.chaos.driver` for the
+time-driven injector (device-plugin health), and
+:mod:`kubernetes_tpu.chaos.harness` for the scripted convergence
+scenario ``hack/chaos.sh`` and the integration tier share.
+"""
+from .core import (  # noqa: F401
+    ENV_SCHEDULE,
+    ENV_VAR,
+    SITE_DEVICE,
+    SITE_HEARTBEAT,
+    SITE_REST,
+    SITE_WAL,
+    SITE_WATCH_REST,
+    SITE_WATCH_STORE,
+    ChaosController,
+    FaultSpec,
+    InjectedFault,
+    arm,
+    disarm,
+    from_env,
+    parse_schedule,
+)

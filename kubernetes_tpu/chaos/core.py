@@ -1,0 +1,298 @@
+"""Seeded, deterministic fault-injection controller.
+
+Reference lineage: etcd's gofail points and the Kubernetes e2e
+"chaosmonkey" disruptive tier — components expose *injection sites*,
+and an external schedule decides, reproducibly, which calls fail and
+how. There is no goroutine to freeze here, so the sites live at the
+seams failures actually enter a single-process cluster: the REST
+transport, watch streams, the WAL, node heartbeats, and the device
+plugin.
+
+Arming (opt-in, like ``TPU_CACHE_MUTATION_DETECTOR``/``TPU_LOCKDEP``)::
+
+    TPU_CHAOS=<seed>                     # default schedule, seeded
+    TPU_CHAOS_SCHEDULE=rest:error:p=0.02,wal:torn:at=40   # explicit
+
+Determinism contract: every site draws from its OWN rng stream, seeded
+``f"{seed}:{site}"``, and decisions are a pure function of (seed,
+schedule, per-site call index). Cross-site interleaving — which the
+event loop does NOT replay identically — therefore never perturbs a
+site's fault sequence: same seed ⇒ identical per-site fault sequences
+across runs. :meth:`ChaosController.fingerprint` exposes the sequence
+for exactly that assertion.
+
+Fault catalog (site → kinds; ``param`` meaning):
+
+=============== ============================================================
+``rest``        ``error`` (connection reset), ``http500`` (injected 500),
+                ``hang`` (request hangs, then times out), ``slow``
+                (param: added seconds of latency)
+``watch.rest``  ``drop`` (REST watch stream ends mid-flight; client relists)
+``watch.store`` ``overflow`` (MVCC watcher force-overflowed; client relists)
+``wal``         ``torn`` (crash mid-append: partial record on disk),
+                ``flip`` (corrupted record; CRC catches it on replay),
+                ``crash`` (crash before the record reached the disk buffer).
+                All three stop the store until it is rebuilt from disk.
+``heartbeat``   ``miss`` (param: seconds the node agent mutes lease
+                renewals AND status posts — a network partition)
+``deviceplugin``  ``unhealthy`` (param: seconds one chip reports unhealthy)
+=============== ============================================================
+"""
+from __future__ import annotations
+
+import os
+import random
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+from ..metrics.registry import Counter
+from ..util.lockdep import make_lock
+
+ENV_VAR = "TPU_CHAOS"
+ENV_SCHEDULE = "TPU_CHAOS_SCHEDULE"
+
+SITE_REST = "rest"
+SITE_WATCH_REST = "watch.rest"
+SITE_WATCH_STORE = "watch.store"
+SITE_WAL = "wal"
+SITE_HEARTBEAT = "heartbeat"
+SITE_DEVICE = "deviceplugin"
+
+SITES = (SITE_REST, SITE_WATCH_REST, SITE_WATCH_STORE, SITE_WAL,
+         SITE_HEARTBEAT, SITE_DEVICE)
+
+KINDS = {
+    SITE_REST: ("error", "http500", "hang", "slow"),
+    SITE_WATCH_REST: ("drop",),
+    SITE_WATCH_STORE: ("overflow",),
+    SITE_WAL: ("torn", "flip", "crash"),
+    SITE_HEARTBEAT: ("miss",),
+    SITE_DEVICE: ("unhealthy",),
+}
+
+FAULTS_INJECTED = Counter(
+    "chaos_faults_injected_total",
+    "Faults injected by the TPU_CHAOS layer, by site and kind",
+    labels=("site", "kind"))
+
+
+@dataclass(frozen=True)
+class FaultSpec:
+    """One schedule entry: fire ``kind`` at ``site`` when triggered.
+
+    Exactly one trigger should be set — ``at`` (1-based per-site call
+    indices), ``every`` (every Nth call), or ``prob`` (per-call
+    probability off the site's seeded rng stream). ``count`` bounds
+    total fires (0 = unlimited); ``param`` is the kind-specific knob
+    (seconds of delay/mute/unhealth).
+    """
+    site: str
+    kind: str
+    prob: float = 0.0
+    at: tuple[int, ...] = ()
+    every: int = 0
+    count: int = 0
+    param: float = 0.0
+
+    def __post_init__(self):
+        if self.site not in KINDS:
+            raise ValueError(f"unknown chaos site {self.site!r} "
+                             f"(sites: {', '.join(KINDS)})")
+        if self.kind not in KINDS[self.site]:
+            raise ValueError(
+                f"unknown fault kind {self.kind!r} for site {self.site!r} "
+                f"(kinds: {', '.join(KINDS[self.site])})")
+        if not (self.prob or self.at or self.every):
+            # A trigger-less spec can never fire; a schedule typo
+            # (forgotten p=) must not silently inject nothing.
+            raise ValueError(
+                f"chaos spec {self.site}:{self.kind} has no trigger — "
+                f"set prob/at/every")
+
+
+@dataclass(frozen=True)
+class InjectedFault:
+    """One fault the controller decided to inject; ``seq`` is the
+    1-based call index at the site (the determinism coordinate)."""
+    site: str
+    kind: str
+    seq: int
+    param: float = 0.0
+
+
+#: What ``TPU_CHAOS=<seed>`` alone arms: light transport/stream faults
+#: everywhere they are survivable by design. WAL faults are absent —
+#: they stop the store until an operator restart, so they are
+#: schedule-driven only (TPU_CHAOS_SCHEDULE or a harness trigger()).
+DEFAULT_SCHEDULE: tuple[FaultSpec, ...] = (
+    FaultSpec(SITE_REST, "error", prob=0.01),
+    FaultSpec(SITE_REST, "slow", prob=0.05, param=0.01),
+    FaultSpec(SITE_REST, "http500", prob=0.005),
+    FaultSpec(SITE_WATCH_REST, "drop", prob=0.002),
+    FaultSpec(SITE_WATCH_STORE, "overflow", prob=0.0005),
+    FaultSpec(SITE_HEARTBEAT, "miss", prob=0.01, param=1.0),
+    FaultSpec(SITE_DEVICE, "unhealthy", prob=0.02, param=1.0),
+)
+
+
+def parse_schedule(text: str) -> tuple[FaultSpec, ...]:
+    """``site:kind[:key=val]...`` entries, comma-separated. Keys:
+    ``p``/``prob``, ``at`` (``|``-separated indices), ``every``,
+    ``count``, ``param``. Example::
+
+        rest:error:p=0.02,wal:torn:at=40,watch.rest:drop:every=50:count=2
+    """
+    specs = []
+    for entry in text.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        parts = entry.split(":")
+        if len(parts) < 2:
+            raise ValueError(f"chaos schedule entry {entry!r}: "
+                             f"want site:kind[:key=val...]")
+        kw: dict = {"site": parts[0], "kind": parts[1]}
+        for opt in parts[2:]:
+            k, _, v = opt.partition("=")
+            if k in ("p", "prob"):
+                kw["prob"] = float(v)
+            elif k == "at":
+                kw["at"] = tuple(int(x) for x in v.split("|"))
+            elif k == "every":
+                kw["every"] = int(v)
+            elif k == "count":
+                kw["count"] = int(v)
+            elif k == "param":
+                kw["param"] = float(v)
+            else:
+                raise ValueError(
+                    f"chaos schedule entry {entry!r}: unknown key {k!r}")
+        specs.append(FaultSpec(**kw))
+    return tuple(specs)
+
+
+@dataclass
+class _SiteState:
+    rng: random.Random
+    calls: int = 0
+    fired: dict = field(default_factory=dict)  # spec index -> fire count
+    triggers: list = field(default_factory=list)  # queued one-shots
+
+
+class ChaosController:
+    """Deterministic per-site fault decisions + an injection log.
+
+    Injection sites call :meth:`decide` once per operation; the answer
+    (None, or an :class:`InjectedFault`) is a pure function of (seed,
+    schedule, that site's call index) — see the module docstring for
+    the contract. :meth:`trigger` queues an explicit one-shot fault
+    (harness-controlled crash points) that fires on the site's next
+    call, ahead of the schedule.
+    """
+
+    #: Injection log cap — chaos runs are bounded, but a soak with a
+    #: high-probability schedule must not grow memory without limit.
+    MAX_LOG = 100_000
+
+    def __init__(self, seed: int,
+                 schedule: Sequence[FaultSpec] = DEFAULT_SCHEDULE):
+        self.seed = int(seed)
+        self.schedule = tuple(schedule)
+        self._by_site: dict[str, list[tuple[int, FaultSpec]]] = {}
+        for i, spec in enumerate(self.schedule):
+            self._by_site.setdefault(spec.site, []).append((i, spec))
+        self._sites: dict[str, _SiteState] = {}
+        self._lock = make_lock("chaos.Controller")
+        #: Every injected fault, in global decision order.
+        self.injected: list[InjectedFault] = []
+
+    def _site(self, site: str) -> _SiteState:
+        st = self._sites.get(site)
+        if st is None:
+            # Per-site stream: cross-site interleaving cannot perturb
+            # this site's draw sequence.
+            st = _SiteState(rng=random.Random(f"{self.seed}:{site}"))
+            self._sites[site] = st
+        return st
+
+    def trigger(self, site: str, kind: str, param: float = 0.0) -> None:
+        """Queue a one-shot fault to fire on the site's NEXT call."""
+        FaultSpec(site, kind, at=(1,))  # validates site/kind
+        with self._lock:
+            self._site(site).triggers.append((kind, param))
+
+    def decide(self, site: str) -> Optional[InjectedFault]:
+        with self._lock:
+            st = self._site(site)
+            st.calls += 1
+            hit: Optional[tuple[str, float]] = None
+            if st.triggers:
+                hit = st.triggers.pop(0)
+            # Draw the rng for EVERY prob-spec on EVERY call — even
+            # after a hit — so the stream position at call N never
+            # depends on which spec matched earlier calls.
+            for i, spec in self._by_site.get(site, ()):  # noqa: B007
+                fires = (spec.at and st.calls in spec.at) \
+                    or (spec.every and st.calls % spec.every == 0)
+                if spec.prob:
+                    fires = st.rng.random() < spec.prob or fires
+                if not fires or hit is not None:
+                    continue
+                if spec.count and st.fired.get(i, 0) >= spec.count:
+                    continue
+                st.fired[i] = st.fired.get(i, 0) + 1
+                hit = (spec.kind, spec.param)
+            if hit is None:
+                return None
+            fault = InjectedFault(site, hit[0], st.calls, hit[1])
+            if len(self.injected) < self.MAX_LOG:
+                self.injected.append(fault)
+        FAULTS_INJECTED.inc(site=site, kind=fault.kind)
+        return fault
+
+    def calls(self, site: str) -> int:
+        with self._lock:
+            return self._sites[site].calls if site in self._sites else 0
+
+    def fingerprint(self, site: Optional[str] = None) -> list[tuple]:
+        """(site, seq, kind) tuples of every injected fault — the
+        cross-run determinism artifact (compare per site; the global
+        interleaving is scheduler-dependent by design)."""
+        with self._lock:
+            return [(f.site, f.seq, f.kind) for f in self.injected
+                    if site is None or f.site == site]
+
+
+def from_env() -> Optional[ChaosController]:
+    """The controller ``TPU_CHAOS`` arms, or None. ``TPU_CHAOS_SCHEDULE``
+    overrides the default schedule."""
+    raw = os.environ.get(ENV_VAR, "")
+    if not raw:
+        return None
+    try:
+        seed = int(raw)
+    except ValueError:
+        # Non-numeric arming ("1"? no — any string seeds the rng
+        # deterministically via its hash-free repr).
+        seed = int.from_bytes(raw.encode(), "big") % (2 ** 31)
+    text = os.environ.get(ENV_SCHEDULE, "")
+    schedule = parse_schedule(text) if text else DEFAULT_SCHEDULE
+    return ChaosController(seed, schedule)
+
+
+#: Process-global controller consulted by every injection site; None =
+#: chaos disabled (the sites' fast path is one module-attribute check).
+CONTROLLER: Optional[ChaosController] = from_env()
+
+
+def arm(controller: ChaosController) -> ChaosController:
+    """Install ``controller`` as the process-global chaos controller
+    (tests/harnesses; production arms via env at import)."""
+    global CONTROLLER
+    CONTROLLER = controller
+    return controller
+
+
+def disarm() -> None:
+    global CONTROLLER
+    CONTROLLER = None

@@ -1,0 +1,86 @@
+"""Time-driven chaos injector — faults that are external events.
+
+Call-driven sites (REST requests, WAL appends, heartbeats) consult the
+controller inline; a TPU chip going unhealthy is nobody's function
+call, so this driver ticks the ``deviceplugin`` site on a clock and
+applies what fires to the cluster's stub plugins (the hardware-health
+analog of the reference's node-problem-detector fault feeds).
+
+Deterministic target choice: the fault's per-site sequence number picks
+the plugin and chip, so the same seed degrades the same chips in the
+same order — the rng never leaves chaos/core.py.
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Optional, Sequence
+
+from . import core
+
+log = logging.getLogger("chaos")
+
+
+class ChaosDriver:
+    def __init__(self, plugins: Sequence[object], interval: float = 0.5):
+        """``plugins``: StubTpuPlugin-shaped objects (``set_chip_health``
+        + a ``_topology`` with chips). Real-TPU plugins are never
+        driven — chaos must not write to hardware state — and opt out
+        via ``chaos_drivable = False`` (TpuDevicePlugin INHERITS
+        set_chip_health from the stub, so a capability check alone
+        would not exclude it)."""
+        self.plugins = [p for p in plugins
+                        if getattr(p, "chaos_drivable", False)
+                        and hasattr(p, "set_chip_health")]
+        self.interval = interval
+        self._task: Optional[asyncio.Task] = None
+        self._restores: set[asyncio.Task] = set()
+
+    def start(self) -> "ChaosDriver":
+        if self.plugins and core.CONTROLLER is not None:
+            self._task = asyncio.get_running_loop().create_task(self._run())
+        return self
+
+    async def stop(self) -> None:
+        for task in [self._task, *self._restores]:
+            if task is None:
+                continue
+            task.cancel()
+            try:
+                await task
+            except asyncio.CancelledError:
+                pass
+        self._task = None
+        self._restores.clear()
+
+    async def _run(self) -> None:
+        while True:
+            self.tick()
+            await asyncio.sleep(self.interval)
+
+    def tick(self) -> None:
+        """One scheduling decision (tests call this directly for exact
+        control; the background task calls it on the clock)."""
+        c = core.CONTROLLER
+        if c is None or not self.plugins:
+            return
+        fault = c.decide(core.SITE_DEVICE)
+        if fault is None or fault.kind != "unhealthy":
+            return
+        plugin = self.plugins[(fault.seq - 1) % len(self.plugins)]
+        chips = list(plugin._topology.chips)
+        if not chips:
+            return
+        chip = chips[(fault.seq - 1) % len(chips)]
+        log.info("chaos: chip %s on %s unhealthy for %.1fs",
+                 chip.id, plugin.resource, fault.param or 1.0)
+        plugin.set_chip_health(chip.id, "Unhealthy")
+
+        async def restore(chip_id: str = chip.id,
+                          delay: float = fault.param or 1.0) -> None:
+            await asyncio.sleep(delay)
+            plugin.set_chip_health(chip_id, "Healthy")
+
+        task = asyncio.get_running_loop().create_task(restore())
+        self._restores.add(task)
+        task.add_done_callback(self._restores.discard)

@@ -1,0 +1,269 @@
+"""Scripted chaos convergence scenario — the acceptance harness.
+
+One seeded run drives gang workloads through a REST control plane
+(apiserver subprocess-equivalent: real HTTP, real watches) while the
+chaos layer injects transport faults, watch drops, and a mid-run WAL
+crash with full control-plane restart — then asserts the system
+CONVERGED: every gang member bound, no chip double-booked, and the
+recovered store byte-identical to the pre-crash durable state.
+
+Shared by ``tests/integration/test_chaos_convergence.py`` and
+``hack/chaos.sh`` (<90s seeded gate) so the CI arm and the test tier
+exercise one scenario, not two drifting copies.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import shutil
+import tempfile
+import time
+from typing import Optional
+
+from ..api import errors, types as t
+from ..api.meta import ObjectMeta
+from ..apiserver.admission import default_chain
+from ..apiserver.registry import Registry
+from ..apiserver.server import APIServer
+from ..client.rest import RESTClient
+from ..scheduler.scheduler import Scheduler
+from ..storage.mvcc import MVCCStore
+from . import core
+
+#: The fault mix a convergence run faces (WAL crash is trigger()-driven
+#: at a controlled point — see run_chaos). Five distinct fault kinds.
+CONVERGENCE_SCHEDULE = (
+    core.FaultSpec(core.SITE_REST, "error", prob=0.05),
+    core.FaultSpec(core.SITE_REST, "slow", prob=0.10, param=0.005),
+    core.FaultSpec(core.SITE_REST, "http500", prob=0.02),
+    core.FaultSpec(core.SITE_REST, "hang", prob=0.01, param=0.02),
+    core.FaultSpec(core.SITE_WATCH_REST, "drop", prob=0.01),
+    core.FaultSpec(core.SITE_WATCH_STORE, "overflow", prob=0.002),
+)
+
+
+def _mk_node(name: str, z: int, mesh: list) -> t.Node:
+    """One 4-chip host owning the z-layer of a shared slice."""
+    coords = [(x, y, z) for x in range(2) for y in range(2)]
+    node = t.Node(metadata=ObjectMeta(name=name))
+    node.status.capacity = {"cpu": 16.0, "memory": 64 * 2 ** 30, "pods": 110}
+    node.status.conditions = [
+        t.NodeCondition(type=t.NODE_READY, status="True")]
+    node.status.tpu = t.TpuTopology(
+        chip_type="v5p", slice_id="slice-chaos", mesh_shape=mesh,
+        chips=[t.TpuChip(id=f"{name}-c{i}", coords=list(co),
+                         attributes={"chip_type": "v5p"})
+               for i, co in enumerate(coords)])
+    node.status.capacity[t.RESOURCE_TPU] = float(len(coords))
+    node.status.allocatable = dict(node.status.capacity)
+    return node
+
+
+def _mk_gang(name: str, members: int, chips: int) -> list:
+    # slice_shape pins each gang to one contiguous 2x2x1 box (one
+    # host's z-layer) — member demand must total the box volume.
+    objs = [t.PodGroup(metadata=ObjectMeta(name=name, namespace="default"),
+                       spec=t.PodGroupSpec(min_member=members,
+                                           slice_shape=[2, 2, 1]))]
+    for i in range(members):
+        pod = t.Pod(
+            metadata=ObjectMeta(name=f"{name}-{i}", namespace="default"),
+            spec=t.PodSpec(containers=[t.Container(
+                name="c", image="i",
+                resources=t.ResourceRequirements(requests={"cpu": 0.1}),
+                tpu_requests=["tpu"])]))
+        pod.spec.tpu_resources = [t.PodTpuRequest(name="tpu", chips=chips)]
+        pod.spec.gang = name
+        objs.append(pod)
+    return objs
+
+
+async def _create_tolerant(client: RESTClient, obj, deadline: float) -> None:
+    """Create with client-side retries over injected faults — the
+    workload submitter's posture (loadgen does the same)."""
+    while True:
+        try:
+            await client.create(obj)
+            return
+        except errors.AlreadyExistsError:
+            return  # an earlier attempt landed; the response was lost
+        except errors.StatusError:
+            if asyncio.get_running_loop().time() > deadline:
+                raise
+            await asyncio.sleep(0.05)
+
+
+class _Plane:
+    """One incarnation of the control plane over a (possibly recovered)
+    store; the harness crashes and rebuilds it."""
+
+    def __init__(self, data_dir: str, port: int = 0):
+        self.store = MVCCStore(os.path.join(data_dir, "state"),
+                               fsync="batch")
+        self.registry = Registry(store=self.store)
+        self.registry.admission = default_chain(self.registry)
+        try:
+            self.registry.create(
+                t.Namespace(metadata=ObjectMeta(name="default")))
+        except errors.AlreadyExistsError:
+            pass  # recovered store
+        self.server = APIServer(self.registry)
+        self.port = port
+        self.client: Optional[RESTClient] = None
+        self.scheduler: Optional[Scheduler] = None
+
+    async def start(self) -> None:
+        self.port = await self.server.start(port=self.port)
+        self.client = RESTClient(f"http://127.0.0.1:{self.port}")
+        self.client.backoff_base = 0.02
+        self.scheduler = Scheduler(self.client, backoff_seconds=0.2)
+        await self.scheduler.start()
+
+    async def stop(self, crash: bool = False) -> None:
+        if self.scheduler is not None:
+            await self.scheduler.stop()
+        await self.server.stop()
+        if self.client is not None:
+            await self.client.close()
+        if not crash:
+            self.store.close()
+        # On crash the store is abandoned as-is: whatever reached the
+        # WAL is what recovery gets, like a killed process.
+
+
+async def run_chaos(seed: int, n_nodes: int = 4, gangs: int = 4,
+                    gang_size: int = 2, chips_per_pod: int = 2,
+                    timeout: float = 60.0) -> dict:
+    """The scripted scenario; returns a report dict (see keys below).
+    Raises AssertionError on a convergence violation."""
+    t0 = time.perf_counter()
+    controller = core.arm(core.ChaosController(seed, CONVERGENCE_SCHEDULE))
+    # The acceptance gate's fault mix must not depend on a lucky seed:
+    # guarantee one of each headline kind (the WAL crash is triggered
+    # at its controlled point below); the schedule adds the rest.
+    controller.trigger(core.SITE_REST, "error")
+    controller.trigger(core.SITE_REST, "hang", 0.02)
+    controller.trigger(core.SITE_WATCH_REST, "drop")
+    controller.trigger(core.SITE_WATCH_STORE, "overflow")
+    data_dir = tempfile.mkdtemp(prefix="ktpu-chaos-")
+    mesh = [2, 2, n_nodes]
+    report: dict = {"seed": seed, "port": None}
+    plane = _Plane(data_dir)
+    user: Optional[RESTClient] = None
+    try:
+        await plane.start()
+        report["port"] = plane.port
+        for z in range(n_nodes):
+            plane.registry.create(_mk_node(f"chaos-{z}", z, mesh))
+        user = RESTClient(f"http://127.0.0.1:{plane.port}")
+        user.backoff_base = 0.02
+        loop = asyncio.get_running_loop()
+
+        async def wait_bound(names: set, deadline: float) -> None:
+            while True:
+                pods, _ = plane.registry.list("pods", "default")
+                bound = {p.metadata.name for p in pods
+                         if p.spec.node_name
+                         and p.metadata.deletion_timestamp is None}
+                if names <= bound:
+                    return
+                if loop.time() > deadline:
+                    raise AssertionError(
+                        f"convergence timeout: missing {sorted(names - bound)}")
+                await asyncio.sleep(0.1)
+
+        # Wave 1 under transport/watch chaos.
+        wave1 = [f"gang-{g}-{i}" for g in range(gangs // 2)
+                 for i in range(gang_size)]
+        for g in range(gangs // 2):
+            for obj in _mk_gang(f"gang-{g}", gang_size, chips_per_pod):
+                await _create_tolerant(user, obj, loop.time() + 15.0)
+        await wait_bound(set(wave1), loop.time() + timeout / 3)
+
+        # Mid-run WAL crash: the next store write tears the log and the
+        # backend goes down, exactly like a process crash mid-append.
+        controller.trigger(core.SITE_WAL, "torn")
+        for i in range(50):  # writes until one trips the fault
+            try:
+                plane.registry.create(t.ConfigMap(metadata=ObjectMeta(
+                    name=f"crash-bait-{i}", namespace="default")))
+            except errors.ServiceUnavailableError:
+                break
+            await asyncio.sleep(0.02)
+        assert plane.store.wal_failed, "WAL crash fault never fired"
+        pre_crash = plane.store.pre_crash_state
+        await plane.stop(crash=True)
+        await user.close()
+
+        # Recover on the same port: replay must reproduce the durable
+        # state byte for byte, then the control plane converges again.
+        plane = _Plane(data_dir, port=report["port"])
+        recovered = json.dumps(plane.store.state(), sort_keys=True)
+        expected = json.dumps(pre_crash, sort_keys=True)
+        report["wal_recovery_identical"] = recovered == expected
+        assert recovered == expected, "WAL replay diverged from pre-crash state"
+        await plane.start()
+        user = RESTClient(f"http://127.0.0.1:{plane.port}")
+        user.backoff_base = 0.02
+
+        # Wave 2 on the recovered plane, chaos still armed.
+        all_pods = [f"gang-{g}-{i}" for g in range(gangs)
+                    for i in range(gang_size)]
+        for g in range(gangs // 2, gangs):
+            for obj in _mk_gang(f"gang-{g}", gang_size, chips_per_pod):
+                await _create_tolerant(user, obj, loop.time() + 15.0)
+        await wait_bound(set(all_pods), loop.time() + timeout / 2)
+
+        # Invariants: no lost binds (all bound, checked above), no
+        # duplicated binds (no chip held by two live pods), groups done.
+        pods, _ = plane.registry.list("pods", "default")
+        seen: dict = {}
+        for pod in pods:
+            for claim in pod.spec.tpu_resources:
+                for cid in claim.assigned:
+                    key = (pod.spec.node_name, cid)
+                    assert key not in seen, (
+                        f"chip {key} bound to both {seen[key]} and "
+                        f"{pod.metadata.name}")
+                    seen[key] = pod.metadata.name
+        report["pods_bound"] = len([p for p in pods if p.spec.node_name])
+        report["chips_assigned"] = len(seen)
+
+        # End-state durability: a fresh replay of snapshot+WAL equals
+        # the live store exactly.
+        plane.store.fsync_now()
+        replay = MVCCStore(os.path.join(data_dir, "state"))
+        live = json.dumps(plane.store.state(), sort_keys=True)
+        disk = json.dumps(replay.state(), sort_keys=True)
+        replay.close()
+        report["final_replay_identical"] = live == disk
+        assert live == disk, "final WAL replay diverged from live state"
+
+        faults: dict = {}
+        fingerprints: dict = {}
+        for f in controller.injected:
+            faults[f"{f.site}:{f.kind}"] = faults.get(f"{f.site}:{f.kind}", 0) + 1
+            fingerprints.setdefault(f.site, []).append((f.seq, f.kind))
+        report["faults"] = faults
+        #: site -> [(seq, kind)]: the determinism artifact. Two runs of
+        #: one seed agree on every seq both reached (call counts vary
+        #: with timing; the per-seq decisions cannot).
+        report["fingerprints"] = fingerprints
+        report["fault_kinds"] = len({(f.site, f.kind)
+                                     for f in controller.injected})
+        report["elapsed_s"] = round(time.perf_counter() - t0, 2)
+        return report
+    finally:
+        core.disarm()
+        try:
+            if user is not None:
+                await user.close()
+            await plane.stop()
+        except Exception:  # noqa: BLE001 — teardown best-effort
+            logging.getLogger("chaos").warning(
+                "chaos harness teardown failed", exc_info=True)
+        # Deterministic by seed: the on-disk state is reproducible, so
+        # never leave ktpu-chaos-* dirs to accumulate.
+        shutil.rmtree(data_dir, ignore_errors=True)

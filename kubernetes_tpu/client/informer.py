@@ -132,6 +132,9 @@ class SharedInformer:
         self._handlers: list[tuple[Callable, Callable, Callable]] = []
         self._synced = asyncio.Event()
         self._stopped = False
+        #: Whether the current ListAndWatch cycle's LIST succeeded —
+        #: the reflector's backoff resets only on that signal.
+        self._list_ok = False
         self._task: Optional[asyncio.Task] = None
         self.last_sync_resource_version = 0
 
@@ -168,6 +171,7 @@ class SharedInformer:
     async def run(self) -> None:
         backoff = 0.05
         while not self._stopped:
+            self._list_ok = False
             try:
                 await self._list_and_watch()
                 backoff = 0.05
@@ -178,12 +182,20 @@ class SharedInformer:
                 continue
             except Exception as e:  # noqa: BLE001
                 log.warning("informer(%s): ListAndWatch failed: %s", self.plural, e)
+                # Reset the backoff only after a SUCCESSFUL list: a
+                # long-lived watch dying is routine (reconnect fast),
+                # but a crash-looping apiserver that fails every LIST
+                # must see the full exponential climb, not a 50ms
+                # hammer forever.
+                if self._list_ok:
+                    backoff = 0.05
                 await asyncio.sleep(backoff + random.random() * backoff)
                 backoff = min(backoff * 2, 5.0)
 
     async def _list_and_watch(self) -> None:
         items, rev = await self.client.list(
             self.plural, self.namespace, self.label_selector, self.field_selector)
+        self._list_ok = True
         self._replace(items)
         self.last_sync_resource_version = rev
         self._synced.set()

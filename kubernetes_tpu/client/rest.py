@@ -5,11 +5,19 @@ transport every out-of-process component (node agent, CLI, kubemark
 hollow nodes) uses to reach the apiserver. Watches consume the server's
 chunked JSON-lines stream, surfacing BOOKMARK events so reflectors can
 advance their resume revision without traffic.
+
+Every request goes through :meth:`RESTClient._request`: explicit
+connect/total timeouts (a dropped connection must never hang a
+controller forever), capped exponential backoff with jitter for
+idempotent reads, and Retry-After-honoring 429 handling for every verb
+(client-go's rest.Request retry + the flowcontrol backoff, compressed).
+The same seam is the ``rest`` chaos injection site (chaos/core.py).
 """
 from __future__ import annotations
 
 import asyncio
 import json
+import random
 from typing import Any, Optional
 
 import aiohttp
@@ -17,10 +25,26 @@ import aiohttp
 from ..api import errors
 from ..api.scheme import DEFAULT_SCHEME, to_dict
 from ..api.types import Binding
+from ..chaos import core as chaos
+from ..metrics.registry import Counter, Histogram
 from .interface import Client, WatchStream
 
 BOOKMARK = "BOOKMARK"
 CLOSED = "CLOSED"
+
+CLIENT_RETRIES = Counter(
+    "client_retry_total",
+    "REST client request retries by verb and reason",
+    labels=("verb", "reason"))
+
+CLIENT_BACKOFF = Histogram(
+    "client_backoff_seconds",
+    "Seconds the REST client slept backing off before a retry",
+    buckets=(0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0))
+
+#: HTTP statuses a retryable (idempotent) request may retry on — the
+#: server-side/transient family; 4xx client errors never retry.
+_RETRYABLE_STATUS = (500, 502, 503, 504)
 
 
 def decode_obj(data: dict):
@@ -37,6 +61,18 @@ def decode_obj(data: dict):
         return obj
 
 
+def _parse_retry_after(raw: Optional[str]) -> Optional[float]:
+    """Seconds from a Retry-After header (seconds form only; the
+    HTTP-date form is not worth a date parser here), capped so a
+    confused server cannot park a controller for minutes."""
+    if not raw:
+        return None
+    try:
+        return min(max(float(raw), 0.0), 30.0)
+    except ValueError:
+        return None
+
+
 def _resource_tables() -> tuple[dict, dict]:
     from ..apiserver.registry import builtin_resources
     by_plural: dict[str, tuple[str, bool]] = {}
@@ -51,10 +87,18 @@ _BY_PLURAL, _BY_KIND = _resource_tables()
 
 
 class _RESTWatch(WatchStream):
-    def __init__(self, session: aiohttp.ClientSession, url: str, params: dict):
+    def __init__(self, session: aiohttp.ClientSession, url: str, params: dict,
+                 timeout: aiohttp.ClientTimeout):
         self._session = session
         self._url = url
         self._params = params
+        #: total=None (streams live indefinitely) but connect and
+        #: sock_read bounded (RESTClient.watch builds this from its
+        #: connect_timeout/watch_idle_timeout): the server bookmarks
+        #: idle streams every ~10s, so a silent socket means a dead
+        #: peer — surface it so the informer relists instead of
+        #: hanging forever.
+        self._timeout = timeout
         self._resp: Optional[aiohttp.ClientResponse] = None
         self._task: Optional[asyncio.Task] = None
         self._queue: asyncio.Queue = asyncio.Queue()
@@ -65,7 +109,7 @@ class _RESTWatch(WatchStream):
     async def _run(self) -> None:
         try:
             async with self._session.get(self._url, params=self._params,
-                                         timeout=aiohttp.ClientTimeout(total=None)) as resp:
+                                         timeout=self._timeout) as resp:
                 if resp.status != 200:
                     body = await resp.json()
                     await self._queue.put(("ERROR", errors.StatusError.from_dict(body)))
@@ -75,13 +119,19 @@ class _RESTWatch(WatchStream):
                     line = line.strip()
                     if not line:
                         continue
+                    c = chaos.CONTROLLER
+                    if c is not None:
+                        fault = c.decide(chaos.SITE_WATCH_REST)
+                        if fault is not None and fault.kind == "drop":
+                            return  # stream ends; consumer relists
                     msg = json.loads(line)
                     if msg["type"] == BOOKMARK:
                         await self._queue.put((BOOKMARK, msg["object"]))
                         continue
                     obj = decode_obj(msg["object"])
                     await self._queue.put((msg["type"], obj))
-        except (aiohttp.ClientError, asyncio.CancelledError, ConnectionResetError):
+        except (aiohttp.ClientError, asyncio.CancelledError,
+                ConnectionResetError, asyncio.TimeoutError):
             pass
         finally:
             await self._queue.put(None)
@@ -147,6 +197,23 @@ class RESTClient(Client):
             self._ssl = client_ssl_context(ca_file, client_cert, client_key,
                                            check_hostname=check_hostname)
         self._session: Optional[aiohttp.ClientSession] = None
+        #: Per-request deadlines (client-go rest.Config.Timeout analog).
+        #: The old default — ClientTimeout(total=None) — meant one
+        #: dropped connection hung its controller forever; now every
+        #: non-watch request has an explicit connect + total budget,
+        #: overridable per call via ``_request(..., timeout=)``.
+        self.connect_timeout = 5.0
+        self.total_timeout = 30.0
+        #: Idle bound for watch streams (sock_read): the server
+        #: bookmarks every ~10s, so a quiet socket is a dead peer.
+        self.watch_idle_timeout = 60.0
+        #: Retry policy: idempotent reads retry transport errors and
+        #: 5xx with capped exponential backoff + full jitter; 429
+        #: retries for EVERY verb (the server refused before acting)
+        #: honoring its Retry-After header.
+        self.max_retries = 3
+        self.backoff_base = 0.05
+        self.backoff_cap = 2.0
         #: Connector tuning for the ONE shared session every request
         #: rides (see _sess): high-rate single-host clients (the
         #: scheduler firing binds, loadgen firing creates) must reuse
@@ -168,11 +235,12 @@ class RESTClient(Client):
         server uses it for token-bearing callers (kubelet
         --authentication-token-webhook model)."""
         url = f"{self.base_url}/apis/authentication/v1/tokenreviews"
-        async with self._sess().post(
-                url, json={"spec": {"token": token}}) as resp:
-            if resp.status != 200:
-                return None
-            body = await resp.json()
+        try:
+            # Side-effect free: safe to mark idempotent (retryable).
+            body = await self._request("POST", url, idempotent=True,
+                                       json={"spec": {"token": token}})
+        except errors.StatusError:
+            return None
         status = body.get("status") or {}
         if not status.get("authenticated"):
             return None
@@ -198,8 +266,8 @@ class RESTClient(Client):
             spec["user"] = user
             spec["groups"] = list(groups)
         url = f"{self.base_url}/apis/authorization/v1/{which}"
-        async with self._sess().post(url, json={"spec": spec}) as resp:
-            body = await self._check(resp)
+        body = await self._request("POST", url, idempotent=True,
+                                   json={"spec": spec})
         status = body.get("status") or {}
         return bool(status.get("allowed")), status.get("reason", "")
 
@@ -290,8 +358,7 @@ class RESTClient(Client):
         if time.monotonic() - self._discovery_at < self.discovery_ttl \
                 and self._dynamic:
             return
-        async with self._sess().get(f"{self.base_url}/apis") as resp:
-            data = await self._check(resp)
+        data = await self._request("GET", f"{self.base_url}/apis")
         self._dynamic.clear()
         self._dynamic_kinds.clear()
         for res in data.get("resources", []):
@@ -305,8 +372,98 @@ class RESTClient(Client):
                 body = await resp.json()
             except Exception:  # noqa: BLE001
                 raise errors.StatusError(f"HTTP {resp.status}") from None
-            raise errors.StatusError.from_dict(body)
+            err = errors.StatusError.from_dict(body)
+            err.retry_after = _parse_retry_after(resp.headers.get("Retry-After"))
+            raise err
         return await resp.json()
+
+    async def _chaos_fault(self) -> None:
+        """The ``rest`` chaos injection site — consulted once per
+        request ATTEMPT so retries face faults too. Injected failures
+        are raised as the exact exception types the real transport
+        produces, so they exercise the same handler paths."""
+        c = chaos.CONTROLLER
+        if c is None:
+            return
+        fault = c.decide(chaos.SITE_REST)
+        if fault is None:
+            return
+        if fault.kind == "slow":
+            await asyncio.sleep(fault.param or 0.01)
+        elif fault.kind == "error":
+            raise aiohttp.ClientConnectionError("chaos: injected connection reset")
+        elif fault.kind == "hang":
+            # A hung response consumes (a stand-in for) the deadline,
+            # then surfaces the way aiohttp's timeout does.
+            await asyncio.sleep(fault.param or 0.05)
+            raise asyncio.TimeoutError("chaos: injected hung response")
+        elif fault.kind == "http500":
+            raise errors.StatusError("chaos: injected 500")
+
+    async def _request(self, method: str, url: str,
+                       idempotent: Optional[bool] = None,
+                       timeout: Optional[float] = None,
+                       retry_429: bool = True, **kw) -> Any:
+        """One JSON request with deadlines, chaos, and retries.
+
+        ``idempotent`` defaults by verb: GET retries transport errors
+        and 5xx; mutating verbs do NOT (a replayed PUT/DELETE after a
+        lost response flips a success into Conflict/NotFound — the
+        caller owns that trade, and may opt in explicitly for calls
+        with no side effects, e.g. access reviews). 429 retries for
+        every verb — the server refused before acting — waiting out
+        its Retry-After when present, the capped backoff otherwise.
+        """
+        if idempotent is None:
+            idempotent = method == "GET"
+        ct = aiohttp.ClientTimeout(
+            total=self.total_timeout if timeout is None else timeout,
+            connect=self.connect_timeout)
+        backoff = self.backoff_base
+        attempt = 0
+        while True:
+            delay = None
+            try:
+                await self._chaos_fault()
+                async with self._sess().request(method, url, timeout=ct,
+                                                **kw) as resp:
+                    return await self._check(resp)
+            except errors.StatusError as e:
+                if e.code == 429 and retry_429:
+                    reason = "429"
+                    delay = getattr(e, "retry_after", None)
+                elif idempotent and e.code in _RETRYABLE_STATUS:
+                    reason = f"http{e.code}"
+                    # A 503 shedding load names its own retry clock
+                    # too — honor it over our (much shorter) backoff.
+                    delay = getattr(e, "retry_after", None)
+                else:
+                    raise
+                if attempt >= self.max_retries:
+                    raise
+            except (aiohttp.ClientError, ConnectionResetError,
+                    asyncio.TimeoutError) as e:
+                if not idempotent or attempt >= self.max_retries:
+                    # Surface transport failures in the client's ONE
+                    # error taxonomy (LocalClient parity): every caller
+                    # already handling StatusError — scheduler requeue
+                    # paths, controller backoff — now survives a
+                    # dropped connection the same way it survives a
+                    # 503, instead of dying on an aiohttp type it never
+                    # imported.
+                    raise errors.ServiceUnavailableError(
+                        f"transport to {self.base_url}: {e}") from e
+                reason = type(e).__name__
+            attempt += 1
+            # Full jitter on the capped exponential (reference:
+            # client-go flowcontrol.Backoff) — synchronized retry
+            # storms from N controllers are the failure mode.
+            if delay is None:
+                delay = backoff * (0.5 + random.random())
+                backoff = min(backoff * 2, self.backoff_cap)
+            CLIENT_RETRIES.inc(verb=method, reason=reason)
+            CLIENT_BACKOFF.observe(delay)
+            await asyncio.sleep(delay)
 
     async def create(self, obj: Any) -> Any:
         try:
@@ -318,8 +475,7 @@ class RESTClient(Client):
             gvk = (obj.api_version, obj.kind)
         plural = await self._plural_for_kind(gvk[1])
         url = self._url_for(gvk[0], plural, obj.metadata.namespace)
-        async with self._sess().post(url, json=to_dict(obj)) as resp:
-            data = await self._check(resp)
+        data = await self._request("POST", url, json=to_dict(obj))
         return decode_obj(data)
 
     async def _plural_for_kind(self, kind: str) -> str:
@@ -336,8 +492,7 @@ class RESTClient(Client):
     async def get(self, plural: str, namespace: str, name: str) -> Any:
         av, namespaced = await self._plural_info(plural)
         url = self._url_for(av, plural, namespace if namespaced else "", name)
-        async with self._sess().get(url) as resp:
-            data = await self._check(resp)
+        data = await self._request("GET", url)
         return decode_obj(data)
 
     async def list(self, plural: str, namespace: str = "", label_selector: str = "",
@@ -356,8 +511,7 @@ class RESTClient(Client):
             params["limit"] = str(chunk_size)
         items: list = []
         while True:
-            async with self._sess().get(url, params=params) as resp:
-                data = await self._check(resp)
+            data = await self._request("GET", url, params=params)
             items.extend(decode_obj(i) for i in data["items"])
             cont = data["metadata"].get("continue", "")
             if not cont:
@@ -378,8 +532,7 @@ class RESTClient(Client):
             params["field_selector"] = field_selector
         if continue_token:
             params["continue"] = continue_token
-        async with self._sess().get(url, params=params) as resp:
-            data = await self._check(resp)
+        data = await self._request("GET", url, params=params)
         return ([decode_obj(i) for i in data["items"]],
                 int(data["metadata"]["resource_version"]),
                 data["metadata"].get("continue", ""))
@@ -389,8 +542,7 @@ class RESTClient(Client):
         plural = await self._plural_for_kind(gvk[1])
         url = self._url_for(gvk[0], plural, obj.metadata.namespace,
                             obj.metadata.name, subresource)
-        async with self._sess().put(url, json=to_dict(obj)) as resp:
-            data = await self._check(resp)
+        data = await self._request("PUT", url, json=to_dict(obj))
         return decode_obj(data)
 
     async def patch(self, plural: str, namespace: str, name: str, patch,
@@ -410,8 +562,7 @@ class RESTClient(Client):
                       "headers": {"Content-Type": STRATEGIC_MERGE_PATCH}}
         else:
             kwargs = {"json": patch}
-        async with self._sess().patch(url, **kwargs) as resp:
-            data = await self._check(resp)
+        data = await self._request("PATCH", url, **kwargs)
         return decode_obj(data)
 
     async def delete(self, plural: str, namespace: str, name: str,
@@ -426,8 +577,7 @@ class RESTClient(Client):
             params["uid"] = uid
         if propagation_policy:
             params["propagation_policy"] = propagation_policy
-        async with self._sess().delete(url, params=params) as resp:
-            data = await self._check(resp)
+        data = await self._request("DELETE", url, params=params)
         return decode_obj(data)
 
     async def watch(self, plural: str, namespace: str = "", resource_version: int = 0,
@@ -439,7 +589,10 @@ class RESTClient(Client):
             params["label_selector"] = label_selector
         if field_selector:
             params["field_selector"] = field_selector
-        return _RESTWatch(self._sess(), url, params).start()
+        timeout = aiohttp.ClientTimeout(
+            total=None, connect=self.connect_timeout,
+            sock_read=self.watch_idle_timeout)
+        return _RESTWatch(self._sess(), url, params, timeout=timeout).start()
 
     async def bind(self, namespace: str, name: str, binding: Binding,
                    decode: bool = True) -> Any:
@@ -450,8 +603,7 @@ class RESTClient(Client):
         keep-alive session (_sess): sequential binds reuse ONE pooled
         connection, bounded by ``conn_limit_per_host`` under fan-out."""
         url = self._url_for("core/v1", "pods", namespace, name, "binding")
-        async with self._sess().post(url, json=to_dict(binding)) as resp:
-            data = await self._check(resp)
+        data = await self._request("POST", url, json=to_dict(binding))
         return decode_obj(data) if decode else None
 
     async def bind_many(self, namespace: str, bindings: list) -> list:
@@ -469,8 +621,7 @@ class RESTClient(Client):
         url = self._url_for("core/v1", "pods", namespace, "bindings:batch")
         items = [{"name": name, **to_dict(binding)}
                  for name, binding in bindings]
-        async with self._sess().post(url, json={"items": items}) as resp:
-            data = await self._check(resp)
+        data = await self._request("POST", url, json={"items": items})
         out: list = []
         for item in data.get("items", []):
             err = item.get("error")
@@ -506,8 +657,7 @@ class RESTClient(Client):
             if not decode:
                 url += "?echo=0"
             payload = {"items": [to_dict(objs[i]) for i in idxs]}
-            async with self._sess().post(url, json=payload) as resp:
-                data = await self._check(resp)
+            data = await self._request("POST", url, json=payload)
             items = data.get("items", [])
             for pos, i in enumerate(idxs):
                 if pos >= len(items):
@@ -521,8 +671,12 @@ class RESTClient(Client):
 
     async def evict(self, namespace: str, name: str, eviction: Any) -> Any:
         url = self._url_for("core/v1", "pods", namespace, name, "eviction")
-        async with self._sess().post(url, json=to_dict(eviction)) as resp:
-            return await self._check(resp)
+        # retry_429=False: the eviction subresource answers 429 when a
+        # PodDisruptionBudget refuses — an APPLICATION verdict the
+        # caller's policy handles (nodelifecycle's escalation clock),
+        # not a transport condition to wait out here.
+        return await self._request("POST", url, retry_429=False,
+                                   json=to_dict(eviction))
 
     async def close(self) -> None:
         if self._session and not self._session.closed:

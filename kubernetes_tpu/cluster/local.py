@@ -299,6 +299,17 @@ class LocalCluster:
 
         for i, spec in enumerate(self.node_specs):
             self.nodes.append(await self._start_node(spec, i))
+
+        # Fault injection (TPU_CHAOS, chaos/core.py): call-driven sites
+        # arm themselves; the driver covers the time-driven one — stub
+        # TPU chips going unhealthy on the seeded schedule. Real-TPU
+        # plugins are excluded by the driver itself.
+        from ..chaos import core as chaos_core
+        from ..chaos.driver import ChaosDriver
+        self.chaos_driver = None
+        if chaos_core.CONTROLLER is not None:
+            self.chaos_driver = ChaosDriver(
+                [n.plugin for n in self.nodes if n.plugin is not None]).start()
         log.info("cluster up at %s with %d nodes", self.base_url, len(self.nodes))
         return self.base_url
 
@@ -399,6 +410,9 @@ class LocalCluster:
         return node
 
     async def stop(self) -> None:
+        if getattr(self, "chaos_driver", None) is not None:
+            await self.chaos_driver.stop()
+            self.chaos_driver = None
         for node in self.nodes:
             try:
                 await node.stop()

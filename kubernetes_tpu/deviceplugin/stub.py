@@ -39,6 +39,12 @@ def make_topology(chip_type: str = "v5p", slice_id: str = "stub-slice",
 
 
 class StubTpuPlugin(TpuDevicePluginServicer):
+    #: Chaos (chaos/driver.py) may flip this plugin's chip health: the
+    #: topology is synthetic. Subclasses fronting REAL hardware
+    #: (TpuDevicePlugin) override to False — chaos must never write to
+    #: production device state.
+    chaos_drivable = True
+
     def __init__(self, topology: pb.TopologyUpdate, resource: str = "google.com/tpu"):
         self.resource = resource
         self._topology = topology

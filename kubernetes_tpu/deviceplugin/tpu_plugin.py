@@ -190,6 +190,10 @@ class TpuDevicePlugin(StubTpuPlugin):
     """The production plugin: real topology from the probe, and
     InitContainer env that points a JAX pod at its assigned chips."""
 
+    #: Real hardware behind this plugin: the chaos driver must not
+    #: inject health faults here (see StubTpuPlugin.chaos_drivable).
+    chaos_drivable = False
+
     def __init__(self, probe: Optional[dict] = None,
                  resource: str = RESOURCE_TPU, slice_id: str = ""):
         probe = probe or detect_topology()

@@ -189,6 +189,9 @@ class NodeAgent:
         self._svc_informer: Optional[SharedInformer] = None
         self._own_svc_informer = False
         self._stopped = False
+        #: Until when (monotonic) chaos mutes heartbeats + status posts
+        #: (the ``heartbeat`` injection site; 0 = not muted).
+        self._chaos_muted_until = 0.0
 
     # -- lifecycle --------------------------------------------------------
 
@@ -378,10 +381,30 @@ class NodeAgent:
         except errors.ConflictError:
             pass  # next tick wins
 
+    def _chaos_partitioned(self) -> bool:
+        """The ``heartbeat`` chaos site: a ``miss`` fault mutes BOTH
+        liveness signals — lease renewals and status posts — for
+        ``param`` seconds, modeling a control-plane partition of this
+        node (what the nodelifecycle controller's grace period and
+        taint eviction exist to survive)."""
+        from ..chaos import core as chaos
+        now_m = time.monotonic()
+        if now_m < self._chaos_muted_until:
+            return True
+        c = chaos.CONTROLLER
+        if c is None:
+            return False
+        fault = c.decide(chaos.SITE_HEARTBEAT)
+        if fault is not None and fault.kind == "miss":
+            self._chaos_muted_until = now_m + fault.param
+            return True
+        return False
+
     async def _node_status_loop(self) -> None:
         while not self._stopped:
             try:
-                await self._post_status()
+                if not self._chaos_partitioned():
+                    await self._post_status()
             except Exception:  # noqa: BLE001
                 log.exception("node status post failed")
             await asyncio.sleep(self.status_interval)
@@ -391,7 +414,8 @@ class NodeAgent:
         the node controller reads renew_time)."""
         while not self._stopped:
             try:
-                await self._renew_heartbeat()
+                if not self._chaos_partitioned():
+                    await self._renew_heartbeat()
             except Exception:  # noqa: BLE001
                 log.debug("heartbeat failed", exc_info=True)
             await asyncio.sleep(self.heartbeat_interval)

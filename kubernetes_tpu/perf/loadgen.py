@@ -197,9 +197,15 @@ async def run_load(server: str, n_pods: int, concurrency: int = 64,
             "bound": len(watcher.bound_at),
             "wall_seconds": round(wall, 3),
             "pods_per_second": round(n_pods / wall, 2),
-            "saturation_latency_p50_ms": round(_pct(sat_lats, 0.5) * 1e3, 1),
-            "saturation_latency_p99_ms": round(_pct(sat_lats, 0.99) * 1e3, 1),
         })
+        if sat_lats:
+            out.update({
+                "saturation_latency_p50_ms": round(_pct(sat_lats, 0.5) * 1e3, 1),
+                "saturation_latency_p99_ms": round(_pct(sat_lats, 0.99) * 1e3, 1),
+            })
+        # else: every bind was relist-recovered — no trusted samples;
+        # an omitted percentile beats an impossibly-good 0.0ms one
+        # (same rule as perf.latency_percentiles).
         if watcher.relisted:
             out["relist_stamped"] = len(watcher.relisted)
 

@@ -345,13 +345,12 @@ class SchedulerCache:
     def remove_node(self, name: str) -> None:
         self.equiv.invalidate_node(name)
         self.mutation_detector.forget(f"node/{name}")
-        info = self.nodes.get(name)
+        info = self.nodes.pop(name, None)
         if info is not None:
             # The node's pods leave the verifiable cache with it; drop
             # their snapshots or the detector leaks one per departed pod.
             for key in info.pods:
                 self.mutation_detector.forget(key)
-        info = self.nodes.pop(name, None)
         if info and info.node and info.node.status.tpu:
             sid = info.node.status.tpu.slice_id
             sl = self.slices.get(sid)

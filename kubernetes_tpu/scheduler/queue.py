@@ -47,6 +47,10 @@ class SchedulingQueue:
         #: staged so a partially-bound gang keeps releasing its remainder.
         self._gang_bound: dict[str, set[str]] = {}
         self._closed = False
+        #: Strong refs to in-flight wake tasks (the loop holds tasks
+        #: only weakly; an unreferenced notify task can vanish before
+        #: running).
+        self._wake_tasks: set = set()
 
     # -- producers --------------------------------------------------------
 
@@ -77,18 +81,38 @@ class SchedulingQueue:
     def set_gang_min(self, group_key: str, min_member: int) -> None:
         """Called when the PodGroup object is seen/updated."""
         self._gang_min[group_key] = min_member
-        self._maybe_release_gang(group_key)
+        if self._maybe_release_gang(group_key):
+            self._wake_soon()
 
-    def _maybe_release_gang(self, gk: str) -> None:
+    def _maybe_release_gang(self, gk: str) -> bool:
+        """Push the gang unit if quorum is staged; True when pushed.
+        SYNC callers (informer handlers) must then :meth:`_wake_soon`
+        — pushing without a notify left the consumer asleep on a
+        non-empty heap whenever the PodGroup's watch event arrived
+        AFTER its pods (a relist after a dropped watch reorders
+        exactly that way; found by the chaos harness)."""
         staged = self._gangs.get(gk)
         need = self._gang_min.get(gk)
         bound = len(self._gang_bound.get(gk, ()))
         if not staged or need is None or len(staged) + bound < need:
-            return
+            return False
         pods = list(staged.values())
         best = max(t.pod_priority(p) for p in pods)
         self._push_entry(f"gang:{gk}", (-best, next(self._seq)),
                          GangUnit(group_key=gk, pods=pods))
+        return True
+
+    def _wake_soon(self) -> None:
+        """Notify the consumer from a sync (informer handler) context."""
+        async def _notify():
+            async with self._cond:
+                self._cond.notify_all()
+        try:
+            task = asyncio.get_running_loop().create_task(_notify())
+        except RuntimeError:
+            return  # no loop (teardown): nothing to wake
+        self._wake_tasks.add(task)
+        task.add_done_callback(self._wake_tasks.discard)
 
     async def remove_pod(self, pod: t.Pod) -> None:
         async with self._cond:
@@ -107,8 +131,8 @@ class SchedulingQueue:
                 ge = self._entries.get(f"gang:{gk}")
                 if ge and not ge.cancelled:
                     ge.cancelled = True
-                    if staged:
-                        self._maybe_release_gang(gk)
+                    if staged and self._maybe_release_gang(gk):
+                        self._cond.notify()
 
     async def requeue(self, item: QueueItem, backoff: float = 0.0) -> None:
         """Unschedulable item returns to the queue after ``backoff``."""
@@ -139,8 +163,8 @@ class SchedulingQueue:
             staged.pop(pod.key(), None)
             if not staged:
                 del self._gangs[gk]
-            else:
-                self._maybe_release_gang(gk)
+            elif self._maybe_release_gang(gk):
+                self._wake_soon()
 
     def gang_bound_count(self, gk: str) -> int:
         return len(self._gang_bound.get(gk, ()))

@@ -18,9 +18,22 @@ same contract the apiserver depends on —
 - **compaction** discards history and turns stale watches into
   GoneError (410), forcing a relist, exactly like etcd.
 
-Durability: optional write-ahead log (JSON lines) + snapshot; components
-are crash-only and resync from watch, so the WAL only needs ordering,
-not group-commit fsync batching.
+Durability: optional write-ahead log + snapshot. WAL records are
+CRC32-framed JSON lines (``<crc32hex> <json>``); recovery replays the
+longest valid prefix and TRUNCATES a torn/corrupt tail so later appends
+never land mid-garbage (etcd's WAL does the same cut). ``fsync=`` picks
+the durability/latency trade: ``"none"`` (flush per record, no fsync —
+components are crash-only and resync from watch), ``"batch"``
+(group-commit: an append fsyncs when ``fsync_batch`` records or
+``fsync_interval`` seconds have accumulated since the last sync,
+amortizing the cost the way etcd batches raft entries — the bound is
+enforced on the append path, so an idle tail stays unsynced until the
+next write or a quiesce point: ``close``/``snapshot``/``fsync_now``),
+or ``"always"``. The WAL append path is also the
+``wal`` chaos injection site (chaos/core.py): an injected torn/flipped/
+lost record simulates a crash mid-write — the store captures the
+durable-consistent state in ``pre_crash_state``, refuses further
+writes, and recovery must reproduce that state exactly.
 
 Concurrency: mutations take a process-wide lock (writes are tiny dict
 ops); watch delivery crosses into asyncio via ``call_soon_threadsafe``
@@ -33,10 +46,13 @@ import asyncio
 import bisect
 import json
 import os
+import time
+import zlib
 from dataclasses import dataclass, field
 from typing import Any, Callable, Iterable, Optional
 
 from ..api import errors
+from ..chaos import core as chaos
 from ..util.lockdep import make_lock
 
 ADDED = "ADDED"
@@ -103,6 +119,17 @@ class Watch:
     def _deliver(self, ev: Optional[WatchEvent]) -> None:
         # Called with store lock held, possibly from a foreign thread.
         if ev is not None:
+            c = chaos.CONTROLLER
+            if c is not None and not self.overflowed:
+                fault = c.decide(chaos.SITE_WATCH_STORE)
+                if fault is not None and fault.kind == "overflow":
+                    # Forced overflow: same path as a genuinely slow
+                    # consumer — stream terminates, client must relist.
+                    self.overflowed = True
+                    self._loop.call_soon_threadsafe(
+                        self._queue.put_nowait, None)
+                    self._store._remove_watch(self)
+                    return
             with self._pending_lock:
                 self._pending += 1
                 if self._pending > self._queue_limit:
@@ -238,15 +265,35 @@ class _PrefixIndexedMap(dict):
 
 class MVCCStore:
     def __init__(self, data_dir: Optional[str] = None, history_limit: int = 100_000,
-                 transformers: Optional[dict] = None):
+                 transformers: Optional[dict] = None, fsync: str = "none",
+                 fsync_batch: int = 64, fsync_interval: float = 0.05):
         """``transformers``: key-prefix -> encryption.Transformer,
         applied at the persistence boundary only (WAL append, snapshot
         write, load) — the in-memory store, watch history, and every
         read path stay plaintext. See storage/encryption.py for why
         "at rest" means the disk here, not the client-server hop the
         reference transforms at. Calling :meth:`snapshot` after
-        enabling encryption eagerly rewrites all existing plaintext."""
+        enabling encryption eagerly rewrites all existing plaintext.
+
+        ``fsync``: WAL sync policy — "none" | "batch" | "always" (see
+        module docstring); "batch" group-commits: an APPEND fsyncs
+        once ``fsync_batch`` records or ``fsync_interval`` seconds
+        accumulated since the last sync (idle tails sync at
+        close/snapshot/fsync_now, not on a timer)."""
+        if fsync not in ("none", "batch", "always"):
+            raise ValueError(f"fsync must be none|batch|always, got {fsync!r}")
         self._lock = make_lock("mvcc.Store", rlock=True)
+        self._fsync = fsync
+        self._fsync_batch = fsync_batch
+        self._fsync_interval = fsync_interval
+        self._wal_unsynced = 0
+        self._wal_last_sync = time.monotonic()
+        #: True once a WAL fault (chaos) crashed the backend: every
+        #: further mutation raises until the store is rebuilt from disk.
+        self._wal_failed = False
+        #: Canonical state captured the instant a WAL crash fault fired
+        #: — what recovery from disk must reproduce, byte for byte.
+        self.pre_crash_state: Optional[dict] = None
         self._transformers = dict(transformers or {})
         #: key -> StoredObject (live keys only).
         self._data: _PrefixIndexedMap = _PrefixIndexedMap()
@@ -316,33 +363,76 @@ class MVCCStore:
                 )
         wal = os.path.join(self._data_dir, "wal.jsonl")
         if os.path.exists(wal):
-            with open(wal) as f:
-                for line in f:
-                    line = line.strip()
-                    if not line:
-                        continue
-                    try:
-                        rec = json.loads(line)
-                    except json.JSONDecodeError:
-                        break  # torn tail write — crash-consistent cutoff
-                    if rec["rev"] <= self._rev:
-                        continue
-                    self._rev = rec["rev"]
-                    key = rec["key"]
-                    if rec["op"] == DELETED:
-                        self._data.pop(key, None)
-                    else:
-                        prev = self._data.get(key)
-                        self._data[key] = StoredObject(
-                            key=key, value=self._from_disk(key, rec["value"]),
-                            mod_revision=rec["rev"],
-                            create_revision=prev.create_revision if prev else rec["rev"],
-                        )
+            good_end = self._replay_wal(wal)
+            if good_end < os.path.getsize(wal):
+                # Torn/corrupt tail: truncate to the last good record
+                # so future appends extend a clean log instead of
+                # continuing a half-written line (which would poison
+                # every record after it on the NEXT replay).
+                with open(wal, "rb+") as f:
+                    f.truncate(good_end)
         # Event history does not survive restart: everything up to the
         # recovered revision is effectively compacted, so watches resuming
         # from a pre-restart revision get GoneError (410) and relist —
         # the same contract etcd gives after compaction.
         self._compact_rev = max(self._compact_rev, self._rev)
+
+    def _replay_wal(self, wal: str) -> int:
+        """Apply the WAL's longest valid record prefix; returns the
+        byte offset just past the last good record. A record is good
+        when it is a complete line, its CRC (when framed) matches, and
+        it parses — anything else is the crash cut: that record and
+        everything after it never happened."""
+        with open(wal, "rb") as f:
+            raw = f.read()
+        good_end = 0
+        while good_end < len(raw):
+            nl = raw.find(b"\n", good_end)
+            if nl == -1:
+                break  # torn tail: no newline ever made it to disk
+            line = raw[good_end:nl].strip()
+            if line:
+                rec = self._parse_wal_line(line)
+                if rec is None:
+                    break  # bad CRC / truncated JSON — corrupt cutoff
+                self._apply_wal_record(rec)
+            good_end = nl + 1
+        return good_end
+
+    @staticmethod
+    def _parse_wal_line(line: bytes) -> Optional[dict]:
+        """One WAL line -> record dict, or None when corrupt. Framed
+        form is ``<crc32hex> <json>``; bare-JSON lines (pre-CRC WALs)
+        still load, checked only by the parse."""
+        payload = line
+        if not line.startswith(b"{"):
+            crc_hex, _, payload = line.partition(b" ")
+            try:
+                want = int(crc_hex, 16)
+            except ValueError:
+                return None
+            if zlib.crc32(payload) != want:
+                return None
+        try:
+            rec = json.loads(payload)
+        except json.JSONDecodeError:
+            return None
+        return rec if isinstance(rec, dict) and "rev" in rec else None
+
+    def _apply_wal_record(self, rec: dict) -> None:
+        if rec["rev"] <= self._rev:
+            return
+        self._rev = rec["rev"]
+        key = rec["key"]
+        if rec["op"] == DELETED:
+            self._data.pop(key, None)
+        else:
+            prev = self._data.get(key)
+            self._data[key] = StoredObject(
+                key=key, value=self._from_disk(key, rec["value"]),
+                mod_revision=rec["rev"],
+                create_revision=prev.create_revision if prev else rec["rev"],
+            )
 
     def snapshot(self) -> None:
         """Write a full snapshot and truncate the WAL."""
@@ -376,6 +466,10 @@ class MVCCStore:
             for wch in list(self._watches):
                 wch.cancel()
             if self._wal:
+                if self._fsync != "none" and not self._wal.closed:
+                    # Quiesce point: a clean shutdown must not leave a
+                    # mid-batch tail in the page cache only.
+                    self.fsync_now()
                 self._wal.close()
                 self._wal = None
 
@@ -399,17 +493,112 @@ class MVCCStore:
             self._compact_rev = self._log_revs[cut - 1]
             del self._log[:cut]
             del self._log_revs[:cut]
-        if self._wal:
-            self._wal.write(json.dumps({
-                "rev": ev.revision, "op": ev.type, "key": ev.key,
-                "value": self._disk(ev.key, ev.value),
-            }, separators=(",", ":")) + "\n")
+        if self._wal and not self._wal_failed:
+            self._wal.write(self._wal_line(ev.revision, ev.type, ev.key,
+                                           ev.value))
+            self._wal_sync()
         # Snapshot: an overflowing watcher removes itself from _watches
         # during _deliver; mutating the live list mid-iteration would
         # silently skip the next watcher's delivery of this event.
         for wch in list(self._watches):
             if ev.key.startswith(wch.prefix):
                 wch._deliver(ev)
+
+    def _wal_line(self, rev: int, op: str, key: str,
+                  value: Optional[dict]) -> str:
+        payload = json.dumps({
+            "rev": rev, "op": op, "key": key,
+            "value": self._disk(key, value),
+        }, separators=(",", ":"))
+        return f"{zlib.crc32(payload.encode()):08x} {payload}\n"
+
+    def _wal_sync(self) -> None:
+        """Group-commit: fsync per policy, decided at APPEND time.
+        Under "batch", one fsync covers up to ``fsync_batch`` records /
+        ``fsync_interval`` seconds of appends — the etcd raft-entry
+        batching analog. No timer: an idle tail waits for the next
+        append or a quiesce point (close/snapshot/fsync_now)."""
+        if self._fsync == "none":
+            return
+        self._wal_unsynced += 1
+        if self._fsync == "batch" \
+                and self._wal_unsynced < self._fsync_batch \
+                and time.monotonic() - self._wal_last_sync < self._fsync_interval:
+            return
+        self.fsync_now()
+
+    def fsync_now(self) -> None:
+        """Flush + fsync the WAL now (quiesce points: snapshot, close,
+        harness barriers)."""
+        with self._lock:
+            if self._wal is None or self._wal.closed:
+                return
+            self._wal.flush()
+            os.fsync(self._wal.fileno())
+            self._wal_unsynced = 0
+            self._wal_last_sync = time.monotonic()
+
+    @property
+    def wal_failed(self) -> bool:
+        """True once a (chaos-injected) WAL crash stopped the backend;
+        only rebuilding the store from ``data_dir`` recovers."""
+        return self._wal_failed
+
+    def state(self) -> dict:
+        """Canonical, deep-copied snapshot of revision + live keys —
+        the recovery-equality artifact (``json.dumps(..., sort_keys=
+        True)`` of two stores' state() compares byte-identical)."""
+        with self._lock:
+            return {
+                "rev": self._rev,
+                "data": {k: {"value": self._freeze(o.value),
+                             "mod_revision": o.mod_revision,
+                             "create_revision": o.create_revision}
+                         for k, o in sorted(self._data.items())},
+            }
+
+    def _wal_chaos_precheck(self, op: str, key: str,
+                            value: Optional[dict]) -> None:
+        """The ``wal`` chaos site, consulted BEFORE a mutation touches
+        memory. An injected fault is a crash mid-append: the record
+        never applies, the on-disk tail is damaged per the fault kind,
+        and the store refuses every later write (an etcd that lost its
+        disk) until rebuilt from ``data_dir`` — at which point recovery
+        must reproduce :attr:`pre_crash_state` exactly."""
+        if self._wal is None:
+            return
+        if self._wal_failed:
+            raise errors.ServiceUnavailableError(
+                "storage backend unavailable (WAL crashed; rebuild the "
+                "store from its data dir to recover)")
+        c = chaos.CONTROLLER
+        if c is None:
+            return
+        fault = c.decide(chaos.SITE_WAL)
+        if fault is None:
+            return
+        self.pre_crash_state = self.state()
+        line = self._wal_line(self._rev + 1, op, key, value)
+        if fault.kind == "torn":
+            # Crash mid-write: a record prefix, no newline.
+            self._wal.write(line[: max(1, len(line) // 2)])
+        elif fault.kind == "flip":
+            # Full record on disk, one byte corrupted in flight — the
+            # CRC frame catches it on replay.
+            mid = len(line) // 2
+            self._wal.write(line[:mid]
+                            + chr((ord(line[mid]) + 1) % 128 or 1)
+                            + line[mid + 1:])
+        # "crash": the record never reached the disk buffer at all.
+        try:
+            self._wal.flush()
+            os.fsync(self._wal.fileno())
+        except OSError:
+            pass  # the "disk" is dying by definition here
+        self._wal.close()
+        self._wal_failed = True
+        raise errors.ServiceUnavailableError(
+            f"chaos: WAL crashed mid-append ({fault.kind})")
 
     @staticmethod
     def _freeze(value: dict) -> dict:
@@ -422,6 +611,7 @@ class MVCCStore:
         with self._lock:
             if key in self._data:
                 raise errors.AlreadyExistsError(f"key {key!r} already exists")
+            self._wal_chaos_precheck(ADDED, key, value)
             self._rev += 1
             self._data[key] = StoredObject(
                 key=key, value=value, mod_revision=self._rev, create_revision=self._rev
@@ -457,6 +647,7 @@ class MVCCStore:
                     f"key {key!r}: revision mismatch (have {obj.mod_revision}, "
                     f"caller expected {expected_revision})"
                 )
+            self._wal_chaos_precheck(MODIFIED, key, value)
             self._rev += 1
             prev = obj.value
             self._data[key] = StoredObject(
@@ -476,6 +667,7 @@ class MVCCStore:
                     f"key {key!r}: revision mismatch (have {obj.mod_revision}, "
                     f"caller expected {expected_revision})"
                 )
+            self._wal_chaos_precheck(DELETED, key, obj.value)
             self._rev += 1
             del self._data[key]
             self._append_event(WatchEvent(DELETED, key, obj.value, obj.value, self._rev))

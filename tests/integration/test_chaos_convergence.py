@@ -1,0 +1,104 @@
+"""Chaos convergence: the scripted fault schedule (chaos/harness.py)
+over the REST control plane must converge — every gang member bound,
+no chip double-booked, WAL replay byte-identical across the mid-run
+crash — and the fault sequence must be seed-deterministic.
+
+``hack/chaos.sh`` runs the same harness as a <90s CI gate; this tier
+additionally asserts the cross-run determinism contract by running the
+whole scenario twice with one seed.
+"""
+import asyncio
+import os
+
+from kubernetes_tpu.chaos import core
+from kubernetes_tpu.chaos.harness import run_chaos
+
+SEED = int(os.environ.get("TPU_CHAOS") or 20260804)
+
+
+async def test_chaos_schedule_converges():
+    report = await run_chaos(SEED)
+    # >= 5 distinct fault kinds, incl. the WAL crash and a watch drop.
+    assert report["fault_kinds"] >= 5, report["faults"]
+    assert report["faults"].get("wal:torn", 0) >= 1
+    assert report["faults"].get("watch.rest:drop", 0) >= 1
+    assert report["wal_recovery_identical"]
+    assert report["final_replay_identical"]
+    assert report["pods_bound"] == 8
+    assert report["chips_assigned"] == 16
+
+
+async def test_same_seed_identical_fault_sequence_across_runs():
+    """Two full runs, one seed: the REST site's (seq, kind) stream must
+    agree on every call index both runs reached. Call COUNTS vary with
+    timing (retry sleeps, poll loops); the per-index decisions are the
+    deterministic contract. The wal/watch triggers fire at
+    timing-dependent indices by design, so the schedule-driven REST
+    stream is the comparable artifact."""
+    a = await run_chaos(SEED, timeout=45.0)
+    b = await run_chaos(SEED, timeout=45.0)
+    fa = a["fingerprints"].get("rest", [])
+    fb = b["fingerprints"].get("rest", [])
+    assert fa and fb
+    shared = min(max(s for s, _ in fa), max(s for s, _ in fb))
+    assert [e for e in fa if e[0] <= shared] == \
+        [e for e in fb if e[0] <= shared]
+
+
+async def test_chaos_device_fault_taints_and_recovers_node():
+    """The time-driven site end to end over a real cluster: a chip
+    goes unhealthy on the chaos driver's schedule -> agent posts the
+    degraded topology -> nodelifecycle taints the node NoSchedule ->
+    the chip recovers -> the taint clears."""
+    from kubernetes_tpu.client.informer import InformerFactory
+    from kubernetes_tpu.cluster.local import LocalCluster, NodeSpec
+    from kubernetes_tpu.controllers.nodelifecycle import (
+        TAINT_TPU_UNHEALTHY, NodeLifecycleController)
+    controller = core.arm(core.ChaosController(5, ()))
+    cluster = LocalCluster(
+        nodes=[NodeSpec(name="cn-0", tpu_chips=4, fake_runtime=True),
+               NodeSpec(name="cn-1", tpu_chips=4, fake_runtime=True)],
+        tls=False, heartbeat_interval=0.2, status_interval=0.2)
+    nlc = None
+    factory = None
+    try:
+        await cluster.start()
+        await cluster.wait_for_nodes_ready(30.0)
+        assert cluster.chaos_driver is not None
+        local = cluster.local_client()
+        # Fast-tick lifecycle monitor: the cluster's default 5s monitor
+        # can straddle a short unhealthy window; the taint logic under
+        # test is the same.
+        factory = InformerFactory(local)
+        nlc = NodeLifecycleController(local, factory,
+                                      monitor_interval=0.3,
+                                      grace_period=30.0)
+        await nlc.start()
+
+        async def tainted_nodes():
+            nodes, _ = await local.list("nodes")
+            return {n.metadata.name for n in nodes
+                    if any(taint.key == TAINT_TPU_UNHEALTHY
+                           for taint in n.spec.taints)}
+
+        async def wait_taint(want: bool, timeout: float = 20.0) -> set:
+            deadline = asyncio.get_running_loop().time() + timeout
+            while True:
+                names = await tainted_nodes()
+                if bool(names) == want:
+                    return names
+                assert asyncio.get_running_loop().time() < deadline, \
+                    f"taint state never became {want} (tainted={names})"
+                await asyncio.sleep(0.2)
+
+        controller.trigger(core.SITE_DEVICE, "unhealthy", param=6.0)
+        names = await wait_taint(True)
+        assert names, "no node picked up the tpu-unhealthy taint"
+        await wait_taint(False)  # chip restored; taint reconciled away
+    finally:
+        core.disarm()
+        if nlc is not None:
+            await nlc.stop()
+        if factory is not None:
+            await factory.stop_all()
+        await cluster.stop()

@@ -1,0 +1,306 @@
+"""Chaos layer contract tests: determinism, schedule semantics, and
+each injection site's failure + recovery behavior."""
+import asyncio
+import json
+import os
+
+import pytest
+
+from kubernetes_tpu.api import errors, types as t
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.chaos import core
+from kubernetes_tpu.chaos.core import ChaosController, FaultSpec, parse_schedule
+from kubernetes_tpu.chaos.driver import ChaosDriver
+from kubernetes_tpu.storage.mvcc import MVCCStore
+
+
+@pytest.fixture(autouse=True)
+def _disarmed():
+    """Every test starts and ends with chaos disarmed — the suite must
+    never leak an armed controller into unrelated tests."""
+    core.disarm()
+    yield
+    core.disarm()
+
+
+# ---------------------------------------------------------------------------
+# determinism
+# ---------------------------------------------------------------------------
+
+PROB_SCHEDULE = (
+    FaultSpec(core.SITE_REST, "error", prob=0.05),
+    FaultSpec(core.SITE_REST, "slow", prob=0.1, param=0.01),
+    FaultSpec(core.SITE_WAL, "torn", prob=0.03),
+)
+
+
+def test_same_seed_same_fault_sequence():
+    a, b = ChaosController(42, PROB_SCHEDULE), ChaosController(42, PROB_SCHEDULE)
+    for _ in range(500):
+        a.decide(core.SITE_REST)
+        a.decide(core.SITE_WAL)
+    for _ in range(500):  # different interleaving, same per-site counts
+        b.decide(core.SITE_WAL)
+    for _ in range(500):
+        b.decide(core.SITE_REST)
+    assert a.fingerprint(core.SITE_REST) == b.fingerprint(core.SITE_REST)
+    assert a.fingerprint(core.SITE_WAL) == b.fingerprint(core.SITE_WAL)
+    assert a.fingerprint(core.SITE_REST), "schedule should have fired"
+
+
+def test_different_seed_different_sequence():
+    a, b = ChaosController(1, PROB_SCHEDULE), ChaosController(2, PROB_SCHEDULE)
+    for _ in range(500):
+        a.decide(core.SITE_REST)
+        b.decide(core.SITE_REST)
+    assert a.fingerprint(core.SITE_REST) != b.fingerprint(core.SITE_REST)
+
+
+def test_at_every_count_semantics():
+    c = ChaosController(0, (
+        FaultSpec(core.SITE_REST, "error", at=(3, 5)),
+        FaultSpec(core.SITE_WAL, "torn", every=4, count=2),
+    ))
+    rest = [c.decide(core.SITE_REST) for _ in range(6)]
+    assert [f.kind if f else None for f in rest] == \
+        [None, None, "error", None, "error", None]
+    wal = [c.decide(core.SITE_WAL) for _ in range(16)]
+    fired = [i + 1 for i, f in enumerate(wal) if f]
+    assert fired == [4, 8]  # count=2 stops the every=4 train
+
+
+def test_trigger_one_shot_fires_ahead_of_schedule():
+    c = ChaosController(0, ())
+    c.trigger(core.SITE_HEARTBEAT, "miss", param=2.5)
+    f = c.decide(core.SITE_HEARTBEAT)
+    assert (f.kind, f.param) == ("miss", 2.5)
+    assert c.decide(core.SITE_HEARTBEAT) is None
+    with pytest.raises(ValueError):
+        c.trigger(core.SITE_HEARTBEAT, "no-such-kind")
+
+
+def test_schedule_parsing_and_env():
+    specs = parse_schedule("rest:error:p=0.02,wal:torn:at=4|9,"
+                           "watch.rest:drop:every=50:count=2:param=0.5")
+    assert specs[0] == FaultSpec(core.SITE_REST, "error", prob=0.02)
+    assert specs[1].at == (4, 9)
+    assert (specs[2].every, specs[2].count, specs[2].param) == (50, 2, 0.5)
+    with pytest.raises(ValueError):
+        parse_schedule("rest:error:bogus=1")
+    with pytest.raises(ValueError):
+        parse_schedule("nosite:error")
+    os.environ[core.ENV_VAR] = "123"
+    try:
+        c = core.from_env()
+        assert c is not None and c.seed == 123
+        assert c.schedule == core.DEFAULT_SCHEDULE
+    finally:
+        del os.environ[core.ENV_VAR]
+    assert core.from_env() is None
+
+
+# ---------------------------------------------------------------------------
+# REST site: injected faults + retry/backoff behavior
+# ---------------------------------------------------------------------------
+
+def mk_pod(name):
+    return t.Pod(metadata=ObjectMeta(name=name, namespace="default"),
+                 spec=t.PodSpec(containers=[t.Container(name="c", image="i")]))
+
+
+async def _server():
+    from kubernetes_tpu.apiserver.server import APIServer
+    srv = APIServer()
+    port = await srv.start()
+    srv.registry.create(t.Namespace(metadata=ObjectMeta(name="default")))
+    return srv, port
+
+
+async def test_rest_get_retries_injected_faults():
+    from kubernetes_tpu.client.rest import CLIENT_RETRIES, RESTClient
+    srv, port = await _server()
+    srv.registry.create(mk_pod("p"))
+    client = RESTClient(f"http://127.0.0.1:{port}")
+    client.backoff_base = 0.01
+    c = core.arm(ChaosController(1, ()))
+    try:
+        for kind in ("error", "hang", "http500"):
+            c.trigger(core.SITE_REST, kind)
+            pod = await client.get("pods", "default", "p")
+            assert pod.metadata.name == "p", f"retry after {kind} failed"
+        assert CLIENT_RETRIES.value(verb="GET",
+                                    reason="ClientConnectionError") >= 1
+        assert CLIENT_RETRIES.value(verb="GET", reason="http500") >= 1
+    finally:
+        await client.close()
+        await srv.stop()
+
+
+async def test_rest_mutation_does_not_retry_transport_errors():
+    """A POST must never replay on a transport error (the write may
+    have landed); the error surfaces in the StatusError taxonomy."""
+    from kubernetes_tpu.client.rest import RESTClient
+    srv, port = await _server()
+    client = RESTClient(f"http://127.0.0.1:{port}")
+    c = core.arm(ChaosController(1, ()))
+    try:
+        c.trigger(core.SITE_REST, "error")
+        with pytest.raises(errors.ServiceUnavailableError):
+            await client.create(mk_pod("q"))
+        # The create was NOT replayed behind the error:
+        with pytest.raises(errors.NotFoundError):
+            srv.registry.get("pods", "default", "q")
+    finally:
+        await client.close()
+        await srv.stop()
+
+
+async def test_429_has_retry_after_and_client_honors_it():
+    import aiohttp
+    from kubernetes_tpu.client.rest import CLIENT_RETRIES, RESTClient
+    srv, port = await _server()
+    srv.registry.create(mk_pod("p"))
+    srv.max_inflight = 0  # every non-watch request 429s
+    client = RESTClient(f"http://127.0.0.1:{port}")
+    client.max_retries = 1
+    try:
+        async with aiohttp.ClientSession() as s:
+            url = f"http://127.0.0.1:{port}/api/core/v1/namespaces/default/pods/p"
+            async with s.get(url) as r:
+                assert r.status == 429
+                assert r.headers.get("Retry-After") == "1"
+        before = CLIENT_RETRIES.value(verb="GET", reason="429")
+        t0 = asyncio.get_running_loop().time()
+        with pytest.raises(errors.TooManyRequestsError):
+            await client.get("pods", "default", "p")
+        elapsed = asyncio.get_running_loop().time() - t0
+        # One retry, waited out the server's 1s Retry-After clock.
+        assert CLIENT_RETRIES.value(verb="GET", reason="429") == before + 1
+        assert 0.9 < elapsed < 5.0
+    finally:
+        await client.close()
+        await srv.stop()
+
+
+async def test_watch_drop_recovers_via_relist():
+    from kubernetes_tpu.client.informer import SharedInformer
+    from kubernetes_tpu.client.rest import RESTClient
+    srv, port = await _server()
+    client = RESTClient(f"http://127.0.0.1:{port}")
+    c = core.arm(ChaosController(1, ()))
+    inf = SharedInformer(client, "pods", "default")
+    inf.start()
+    try:
+        await inf.wait_for_sync()
+        c.trigger(core.SITE_WATCH_REST, "drop")
+        srv.registry.create(mk_pod("dropped-event"))
+        srv.registry.create(mk_pod("after-drop"))
+        for _ in range(100):
+            if inf.get("default/dropped-event") and inf.get("default/after-drop"):
+                break
+            await asyncio.sleep(0.05)
+        assert inf.get("default/dropped-event") is not None
+        assert inf.get("default/after-drop") is not None
+        assert c.calls(core.SITE_WATCH_REST) >= 1
+    finally:
+        await inf.stop()
+        await client.close()
+        await srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# WAL site: crash -> refuse writes -> byte-identical recovery
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("kind", ["torn", "flip", "crash"])
+def test_wal_crash_fault_recovers_byte_identical(tmp_path, kind):
+    store = MVCCStore(str(tmp_path / "s"), fsync="batch")
+    store.create("/registry/pods/default/a", {"x": 1})
+    store.update("/registry/pods/default/a", {"x": 2})
+    c = core.arm(ChaosController(1, ()))
+    c.trigger(core.SITE_WAL, kind)
+    with pytest.raises(errors.ServiceUnavailableError):
+        store.create("/registry/pods/default/b", {"x": 3})
+    # The store is down until rebuilt; memory never saw the write.
+    assert store.wal_failed
+    with pytest.raises(errors.ServiceUnavailableError):
+        store.update("/registry/pods/default/a", {"x": 9})
+    with pytest.raises(errors.NotFoundError):
+        store.get("/registry/pods/default/b")
+    recovered = MVCCStore(str(tmp_path / "s"))
+    assert json.dumps(recovered.state(), sort_keys=True) == \
+        json.dumps(store.pre_crash_state, sort_keys=True)
+    # And the recovered store takes writes again, on a clean WAL tail.
+    recovered.create("/registry/pods/default/b", {"x": 3})
+    recovered.close()
+    replay = MVCCStore(str(tmp_path / "s"))
+    assert replay.get("/registry/pods/default/b").value == {"x": 3}
+    replay.close()
+
+
+async def test_store_watch_overflow_injection():
+    store = MVCCStore()
+    store.create("/registry/pods/default/a", {"x": 1})
+    w = store.watch("/registry/pods/")
+    c = core.arm(ChaosController(1, ()))
+    c.trigger(core.SITE_WATCH_STORE, "overflow")
+    store.update("/registry/pods/default/a", {"x": 2})
+    ev = await w.next(timeout=1.0)
+    assert ev is None and w.closed and w.overflowed
+
+
+# ---------------------------------------------------------------------------
+# heartbeat + device sites
+# ---------------------------------------------------------------------------
+
+async def test_heartbeat_miss_mutes_agent_then_recovers():
+    from kubernetes_tpu.apiserver.registry import Registry
+    from kubernetes_tpu.client.local import LocalClient
+    from kubernetes_tpu.node.agent import NodeAgent
+    from kubernetes_tpu.node.runtime import FakeRuntime
+    reg = Registry()
+    for ns in ("default", "kube-system"):
+        reg.create(t.Namespace(metadata=ObjectMeta(name=ns)))
+    agent = NodeAgent(LocalClient(reg), "hb-node", FakeRuntime(),
+                      heartbeat_interval=0.05, status_interval=0.05)
+    c = core.arm(ChaosController(1, ()))
+    await agent.start()
+    try:
+        lease_key = "node-hb-node"
+        for _ in range(50):
+            try:
+                reg.get("leases", "kube-system", lease_key)
+                break
+            except errors.NotFoundError:
+                await asyncio.sleep(0.05)
+        c.trigger(core.SITE_HEARTBEAT, "miss", param=0.6)
+        await asyncio.sleep(0.2)  # fault drawn; mute in effect
+        frozen = reg.get("leases", "kube-system", lease_key).spec.renew_time
+        await asyncio.sleep(0.3)  # inside the mute window
+        assert reg.get("leases", "kube-system",
+                       lease_key).spec.renew_time == frozen
+        for _ in range(40):  # mute expires; renewals resume
+            if reg.get("leases", "kube-system",
+                       lease_key).spec.renew_time != frozen:
+                break
+            await asyncio.sleep(0.1)
+        assert reg.get("leases", "kube-system",
+                       lease_key).spec.renew_time != frozen
+    finally:
+        await agent.stop()
+
+
+async def test_device_driver_flips_chip_health_and_restores():
+    from kubernetes_tpu.deviceplugin.stub import StubTpuPlugin, make_topology
+    plugin = StubTpuPlugin(make_topology(mesh_shape=(2, 1, 1)))
+    c = core.arm(ChaosController(1, ()))
+    driver = ChaosDriver([plugin])
+    c.trigger(core.SITE_DEVICE, "unhealthy", param=0.2)
+    driver.tick()
+    assert [ch.health for ch in plugin._topology.chips][0] == "Unhealthy"
+    for _ in range(40):
+        if plugin._topology.chips[0].health == "Healthy":
+            break
+        await asyncio.sleep(0.05)
+    assert plugin._topology.chips[0].health == "Healthy"
+    await driver.stop()

@@ -168,6 +168,25 @@ F = Counter("encode_cache_hits_total", "re-registered: silently inert")
     assert len(got) == 1 and "already registered" in got[0].message
 
 
+def test_metric_name_retry_and_chaos_families():
+    """The client retry/backoff and chaos-injection metric families
+    (client_retry_total, client_backoff_seconds,
+    chaos_faults_injected_total) are valid names, and a duplicate
+    registration within the family is still caught."""
+    good = """
+from kubernetes_tpu.metrics.registry import Counter, Histogram
+A = Counter("client_retry_total", "x", labels=("verb", "reason"))
+B = Histogram("client_backoff_seconds", "x")
+C = Counter("chaos_faults_injected_total", "x", labels=("site", "kind"))
+"""
+    assert run_source(good, checks=["metric-name"]) == []
+    bad = good + """
+D = Counter("client_retry_total", "re-registered: silently inert")
+"""
+    got = run_source(bad, checks=["metric-name"])
+    assert len(got) == 1 and "already registered" in got[0].message
+
+
 # ---------------------------------------------------------------------------
 # cache-mutation
 # ---------------------------------------------------------------------------
