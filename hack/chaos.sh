@@ -7,13 +7,18 @@
 # byte-identical to the pre-crash durable state, >=5 distinct fault
 # kinds injected. Seed via TPU_CHAOS=<n> (default below) — one seed
 # means one reproducible fault sequence per injection site.
-# Siblings: hack/bench_smoke.sh (perf arm), hack/test.sh (runs both).
+# A second pass reruns the scenario with QUEUEING enabled (JobQueueing
+# gate + fair-share admission in the loop): admission must survive the
+# mid-run apiserver crash — pre-crash admissions replay admitted from
+# the WAL with their original stamps (no double admission).
+# Siblings: hack/bench_smoke.sh (perf arm), hack/queue_smoke.sh
+# (admission arm), hack/test.sh (runs all).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 SEED="${TPU_CHAOS:-20260804}"
 
-timeout -k 10 90 env JAX_PLATFORMS=cpu TPU_CHAOS= python - "$SEED" <<'EOF'
+timeout -k 10 150 env JAX_PLATFORMS=cpu TPU_CHAOS= python - "$SEED" <<'EOF'
 import asyncio, json, sys
 from kubernetes_tpu.chaos.harness import run_chaos
 
@@ -25,5 +30,13 @@ if not report["faults"].get("wal:torn"):
     sys.exit("chaos: the WAL crash never fired")
 if not report["faults"].get("watch.rest:drop"):
     sys.exit("chaos: no watch drop fired")
+
+# Same scenario, admission in the loop (different seed stream so the
+# controller's extra traffic faces its own fault sequence).
+qreport = asyncio.run(run_chaos(int(sys.argv[1]) + 1, queueing=True))
+print(json.dumps({k: v for k, v in qreport.items() if k != "fingerprints"}))
+if qreport.get("queueing_admitted", 0) < 4:
+    sys.exit("chaos: queueing pass admitted "
+             f"{qreport.get('queueing_admitted')} gangs, want 4")
 EOF
-echo "chaos: ok (seed ${SEED})"
+echo "chaos: ok (seed ${SEED}, plain + queueing)"

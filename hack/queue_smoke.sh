@@ -1,0 +1,26 @@
+#!/usr/bin/env bash
+# Two-tenant queueing smoke (<60s): the fair-share admission
+# acceptance scenario (queueing/harness.py) over an in-process control
+# plane — tenant A floods 10 gangs into a 32-chip nominal quota and
+# borrows the cohort's idle half; tenant B's single gang then forces a
+# gang-aware reclaim (borrowed gang unadmitted + evicted, requeued not
+# orphaned) and binds while A's backlog is still pending. Catches
+# "admission broke" end to end: DRF order, borrowing, reclaim, the
+# scheduler's suspend gate and admission-release wake path.
+# Siblings: hack/bench_smoke.sh (perf arm), hack/chaos.sh (fault arm),
+# hack/test.sh (runs all three).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+timeout -k 10 60 env JAX_PLATFORMS=cpu python - <<'EOF'
+import asyncio, json, sys
+from kubernetes_tpu.queueing.harness import run_queue_smoke
+
+out = asyncio.run(run_queue_smoke(timeout=30.0))
+print(json.dumps(out))
+if not out["b_bound"] or out["reclaimed_gangs"] < 1:
+    sys.exit("queue_smoke: reclaim did not run")
+if out["a_pending"] < 2:
+    sys.exit("queue_smoke: tenant A's backlog vanished")
+EOF
+echo "queue_smoke: ok"

@@ -4,14 +4,17 @@
 # Siblings: hack/verify.sh (tpuvet static analysis — runs first here,
 # a verify failure fails the whole entrypoint), hack/bench_smoke.sh
 # (<60s REST density smoke of the batch API path), hack/chaos.sh
-# (<90s seeded fault-schedule convergence gate) — both run on
-# full-suite invocations; filtered runs skip them, KTPU_SMOKE=1
-# forces them; hack/race.sh (TSAN/ASAN + asyncio-debug race tiers).
+# (seeded fault-schedule convergence gate, plain + queueing-enabled),
+# hack/queue_smoke.sh (<60s two-tenant fair-share admission smoke) —
+# all run on full-suite invocations; filtered runs skip them,
+# KTPU_SMOKE=1 forces them; hack/race.sh (TSAN/ASAN + asyncio-debug
+# race tiers).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 ./hack/verify.sh
 if [ "$#" -eq 0 ] || [ "${KTPU_SMOKE:-}" = "1" ]; then
   ./hack/bench_smoke.sh
   ./hack/chaos.sh
+  ./hack/queue_smoke.sh
 fi
 exec python -m pytest tests/ -q "$@"

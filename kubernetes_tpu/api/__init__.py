@@ -1,1 +1,1 @@
-from . import meta, scheme, selectors, types, validation  # noqa: F401
+from . import meta, queueing, scheme, selectors, types, validation  # noqa: F401

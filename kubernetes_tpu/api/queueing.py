@@ -1,0 +1,138 @@
+"""Multi-tenant job queueing API — fair-share admission for gang jobs.
+
+Kueue-analog kinds (reference: kueue.x-k8s.io ClusterQueue/LocalQueue,
+arXiv:2510.01256 section on unified quota scheduling):
+
+- :class:`ClusterQueue` (cluster-scoped): a tenant's resource quota —
+  nominal per-resource amounts plus an optional borrowing *cohort*.
+  Queues in one cohort lend idle nominal quota to each other; a
+  borrower is preempted back under its nominal share when the lender's
+  own demand returns (gang-aware reclaim, queueing/fairshare.py).
+- :class:`LocalQueue` (namespaced): the namespace-side handle binding
+  workloads in that namespace to a ClusterQueue. ``PodGroup.spec.queue``
+  names a LocalQueue in the group's namespace.
+
+Admission state lives on the PodGroup (``status.admitted`` — the
+API-object-as-checkpoint move): it rides the MVCC WAL, so a restarted
+QueueController rebuilds usage from listed groups and can never
+double-admit after replay.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .meta import TypedObject
+from .scheme import DEFAULT_SCHEME
+from .validation import ErrorList, validate_object_meta, validate_quota_map
+
+QUEUEING_V1 = "queueing/v1"
+
+#: PodGroup/LocalQueue annotation: projected gang runtime in seconds,
+#: consumed by the backfill pass (EASY-style shadow-time check). The
+#: gang Job controller stamps it from ``spec.active_deadline_seconds``.
+RUNTIME_ANNOTATION = "queueing.tpu/runtime-seconds"
+
+#: LocalQueue annotation marking it the namespace default: PodGroups
+#: created with ``spec.queue == ""`` are admitted into it (apiserver
+#: admission plugin, gated on JobQueueing).
+DEFAULT_QUEUE_ANNOTATION = "queueing.tpu/default-queue"
+
+#: PodGroupStatus.admission_mode values.
+ADMISSION_NOMINAL = "Nominal"      # fit inside the queue's own quota
+ADMISSION_BORROWED = "Borrowed"    # lent idle quota from the cohort
+ADMISSION_BACKFILL = "Backfill"    # jumped the head-of-line blocker
+
+
+@dataclass
+class ClusterQueueSpec:
+    #: Borrowing cohort: queues sharing a cohort name lend each other
+    #: idle nominal quota ("" = no cohort, never borrows or lends).
+    cohort: str = ""
+    #: Nominal per-resource quota, e.g. {"google.com/tpu": 256,
+    #: "cpu": 512, "memory": 2e12}. Admission charges gang demand
+    #: against these.
+    nominal_quota: dict[str, float] = field(default_factory=dict)
+    #: Per-resource cap on quota borrowed beyond nominal; a resource
+    #: absent here may borrow without limit (cohort headroom still
+    #: bounds it). Ignored without a cohort.
+    borrowing_limit: dict[str, float] = field(default_factory=dict)
+
+
+@dataclass
+class ClusterQueueStatus:
+    #: Gangs waiting for admission / currently admitted via this queue.
+    pending: int = 0
+    admitted: int = 0
+    #: Admitted per-resource usage (sum of admitted gang demand).
+    usage: dict[str, float] = field(default_factory=dict)
+    #: The part of ``usage`` above nominal (lent from the cohort).
+    borrowed: dict[str, float] = field(default_factory=dict)
+    #: Per-tenant breakdown: "namespace/localqueue" -> resource usage
+    #: (``ktl describe clusterqueue`` renders usage vs quota from this).
+    tenant_usage: dict[str, dict[str, float]] = field(default_factory=dict)
+
+
+@dataclass
+class ClusterQueue(TypedObject):
+    spec: ClusterQueueSpec = field(default_factory=ClusterQueueSpec)
+    status: ClusterQueueStatus = field(default_factory=ClusterQueueStatus)
+
+
+@dataclass
+class LocalQueueSpec:
+    #: Name of the ClusterQueue this namespace queue feeds into.
+    cluster_queue: str = ""
+
+
+@dataclass
+class LocalQueueStatus:
+    pending: int = 0
+    admitted: int = 0
+
+
+@dataclass
+class LocalQueue(TypedObject):
+    spec: LocalQueueSpec = field(default_factory=LocalQueueSpec)
+    status: LocalQueueStatus = field(default_factory=LocalQueueStatus)
+
+
+def validate_clusterqueue(cq: ClusterQueue, is_create: bool = True) -> None:
+    errs = ErrorList()
+    validate_object_meta(cq.metadata, errs)
+    validate_quota_map("spec.nominal_quota", cq.spec.nominal_quota, errs)
+    validate_quota_map("spec.borrowing_limit", cq.spec.borrowing_limit, errs)
+    if cq.spec.borrowing_limit and not cq.spec.cohort:
+        errs.add("spec.borrowing_limit",
+                 "requires spec.cohort (borrowing happens within a cohort)")
+    errs.raise_if_any("ClusterQueue", cq.metadata.name)
+
+
+def validate_clusterqueue_update(new: ClusterQueue,
+                                 old: ClusterQueue) -> None:
+    validate_clusterqueue(new, is_create=False)
+
+
+def validate_localqueue(lq: LocalQueue, is_create: bool = True) -> None:
+    errs = ErrorList()
+    validate_object_meta(lq.metadata, errs)
+    if not lq.spec.cluster_queue:
+        errs.add("spec.cluster_queue", "required")
+    errs.raise_if_any("LocalQueue", lq.metadata.name)
+
+
+def validate_localqueue_update(new: LocalQueue, old: LocalQueue) -> None:
+    validate_localqueue(new, is_create=False)
+    if new.spec.cluster_queue != old.spec.cluster_queue:
+        # Rebinding a namespace to a different ClusterQueue would
+        # silently move already-admitted usage between tenants'
+        # accounts (Kueue marks the field immutable for the same
+        # reason).
+        from .errors import InvalidError
+        raise InvalidError(
+            f"LocalQueue {new.metadata.name!r}: spec.cluster_queue is "
+            f"immutable (delete and recreate to rebind)")
+
+
+DEFAULT_SCHEME.register(QUEUEING_V1, "ClusterQueue", ClusterQueue)
+DEFAULT_SCHEME.register(QUEUEING_V1, "LocalQueue", LocalQueue)

@@ -855,6 +855,15 @@ class PodGroupSpec:
     priority: Optional[int] = None
     #: Give up and fail the gang if unschedulable this long (seconds).
     schedule_timeout_seconds: int = 0
+    #: LocalQueue (in this namespace) the gang is admitted through.
+    #: Empty = unqueued; with the JobQueueing gate off the field is
+    #: ignored entirely (api/queueing.py).
+    queue: str = ""
+    #: Total gang resource demand charged against the queue's quota at
+    #: admission time (e.g. {"cpu": 8, "memory": 2**34}). Chips default
+    #: from prod(slice_shape) when absent — admission must not depend
+    #: on member pods existing yet.
+    resources: dict[str, float] = field(default_factory=dict)
 
 
 @dataclass
@@ -867,6 +876,24 @@ class PodGroupStatus:
     #: Slice the gang landed on + the box origin/shape, for observability.
     slice_id: str = ""
     conditions: list[PodCondition] = field(default_factory=list)
+    #: Queue admission (queueing/v1): an unadmitted gang with
+    #: ``spec.queue`` set is SUSPENDED — it never enters the scheduling
+    #: heap. Persisted in status so WAL replay reconstructs admitted
+    #: usage exactly (no double admission after a controller restart).
+    admitted: bool = False
+    #: How admission happened: Nominal | Borrowed | Backfill ("" while
+    #: pending). Borrowed gangs are the reclaim victims when the
+    #: lending queue's own demand returns.
+    admission_mode: str = ""
+    #: When admission happened — the backfill pass projects admitted
+    #: gangs' completion (admitted_time + runtime annotation) to compute
+    #: the blocker's shadow time.
+    admitted_time: Optional[datetime.datetime] = None
+    #: ClusterQueue the charge landed in, stamped at admission: usage
+    #: accounting must survive the LocalQueue being deleted afterwards
+    #: (the namespace binding resolved at admission time is the durable
+    #: fact, not the binding's continued existence).
+    admission_cluster_queue: str = ""
 
 
 @dataclass

@@ -8,6 +8,7 @@ list, like the reference's ``field.ErrorList``.
 """
 from __future__ import annotations
 
+import math
 import re
 from typing import Optional
 
@@ -372,7 +373,56 @@ def validate_podgroup(pg: t.PodGroup, is_create: bool = True) -> None:
         errs.add("spec.min_member", "must be >= 1")
     if pg.spec.slice_shape and any(d <= 0 for d in pg.spec.slice_shape):
         errs.add("spec.slice_shape", "dims must be positive")
+    if pg.spec.queue:
+        validate_name(pg.spec.queue, "spec.queue", errs)
+    validate_quota_map("spec.resources", pg.spec.resources, errs)
     errs.raise_if_any("PodGroup", pg.metadata.name)
+
+
+def validate_quota_map(path: str, quotas: dict, errs: ErrorList) -> None:
+    """Resource-name -> amount maps (PodGroup.spec.resources,
+    ClusterQueue quotas): names non-empty strings, amounts non-negative
+    numbers. Shared with api/queueing.py."""
+    for res, amt in quotas.items():
+        if not res or not isinstance(res, str):
+            errs.add(path, f"resource name must be a non-empty string, "
+                           f"got {res!r}")
+        elif isinstance(amt, bool) or not isinstance(amt, (int, float)):
+            errs.add(f"{path}[{res}]", f"must be a number, got {amt!r}")
+        elif not math.isfinite(amt):
+            # json.loads admits the NaN/Infinity literals; NaN compares
+            # False against everything, so it would silently scramble
+            # DRF ordering and headroom math instead of erroring.
+            errs.add(f"{path}[{res}]", f"must be finite, got {amt!r}")
+        elif amt < 0:
+            errs.add(f"{path}[{res}]", "must be >= 0")
+
+
+def validate_podgroup_update(new: t.PodGroup, old: t.PodGroup) -> None:
+    """Queue binding and admitted demand are immutable: rewriting
+    ``spec.queue`` would move the admission charge to a queue that
+    never admitted the gang (bypassing its borrowing limits), and
+    resizing ``spec.resources`` while admitted would silently free
+    quota the gang still physically holds — the same accounting
+    argument behind LocalQueue.spec.cluster_queue immutability.
+
+    Gated on ``JobQueueing`` like the rest of admission: with the gate
+    off nothing charges quota, so the immutability has nothing to
+    protect — and it must not strand a stale ``spec.queue`` from an
+    earlier gated run (gate off = byte-identical update semantics)."""
+    validate_podgroup(new, is_create=False)
+    from ..util.features import GATES
+    if not GATES.enabled("JobQueueing"):
+        return
+    if new.spec.queue != old.spec.queue:
+        raise InvalidError(
+            f"PodGroup {new.metadata.name!r}: spec.queue is immutable "
+            f"(delete and recreate to move queues)")
+    if old.status.admitted and new.spec.resources != old.spec.resources:
+        raise InvalidError(
+            f"PodGroup {new.metadata.name!r}: spec.resources is immutable "
+            f"while admitted (the quota charge would drift from what the "
+            f"gang holds)")
 
 
 _SERVICE_TYPES = ("ClusterIP", "NodePort", "LoadBalancer")
@@ -979,7 +1029,7 @@ VALIDATORS = {
     "HorizontalPodAutoscaler": (validate_hpa, None),
     "PodDisruptionBudget": (validate_pdb, None),
     "PodSecurityPolicy": (validate_podsecuritypolicy, None),
-    "PodGroup": (validate_podgroup, None),
+    "PodGroup": (validate_podgroup, validate_podgroup_update),
     "Service": (validate_service, validate_service_update),
     "Endpoints": (validate_endpoints, None),
     "ConfigMap": (validate_configmap, None),

@@ -191,6 +191,10 @@ class GangPolicy:
     #: Slice shape for the whole gang (chips), e.g. [4,4,4] for v5p-64.
     slice_shape: list[int] = field(default_factory=list)
     schedule_timeout_seconds: int = 0
+    #: LocalQueue the Job's PodGroup is admitted through (queueing/v1
+    #: fair-share admission; "" = unqueued, or the namespace default
+    #: LocalQueue when the JobQueueing gate is on).
+    queue: str = ""
 
 
 @dataclass

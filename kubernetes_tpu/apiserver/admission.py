@@ -597,11 +597,81 @@ class DefaultStorageClass(AdmissionPlugin):
         return pvc
 
 
+class LocalQueueAdmission(AdmissionPlugin):
+    """Namespace -> queue binding for gang admission (JobQueueing gate).
+
+    Mutate: a PodGroup created with ``spec.queue == ""`` is defaulted
+    to the namespace's default LocalQueue (the one annotated
+    ``queueing.tpu/default-queue=true``), so tenants opt a whole
+    namespace into admission without touching every Job.
+    Validate: a named queue must exist and its ClusterQueue must be
+    installed — a dangling reference would suspend the gang forever
+    with no controller ever admitting it.
+
+    Everything is skipped while the gate is off: objects are
+    byte-identical to the ungated build.
+    """
+
+    name = "LocalQueueAdmission"
+
+    def __init__(self, registry: "Registry"):
+        self.registry = registry
+
+    @staticmethod
+    def _gated() -> bool:
+        from ..util.features import GATES
+        return GATES.enabled("JobQueueing")
+
+    def admit(self, op, spec, obj, old):
+        if spec.kind != "PodGroup" or op != "CREATE" or not self._gated():
+            return obj
+        group = obj
+        if group.spec.queue:
+            return group
+        from ..api.queueing import DEFAULT_QUEUE_ANNOTATION
+        queues, _ = self.registry.list("localqueues",
+                                       group.metadata.namespace)
+        defaults = [q for q in queues if q.metadata.annotations.get(
+            DEFAULT_QUEUE_ANNOTATION) == "true"]
+        if len(defaults) > 1:
+            # Ambiguity must be LOUD: silently leaving spec.queue empty
+            # would let the gang bypass admission entirely (same rule
+            # as DefaultStorageClass: mark exactly one).
+            raise errors.BadRequestError(
+                f"{len(defaults)} LocalQueues in namespace "
+                f"{group.metadata.namespace!r} carry "
+                f"{DEFAULT_QUEUE_ANNOTATION}=true; mark exactly one")
+        if defaults:
+            group.spec.queue = defaults[0].metadata.name
+        return group
+
+    def validate(self, op, spec, obj, old):
+        if spec.kind != "PodGroup" or op != "CREATE" or not self._gated():
+            return
+        group = obj
+        if not group.spec.queue:
+            return
+        try:
+            lq = self.registry.get("localqueues", group.metadata.namespace,
+                                   group.spec.queue)
+        except errors.NotFoundError:
+            raise errors.BadRequestError(
+                f"LocalQueue {group.spec.queue!r} not found in namespace "
+                f"{group.metadata.namespace!r}") from None
+        try:
+            self.registry.get("clusterqueues", "", lq.spec.cluster_queue)
+        except errors.NotFoundError:
+            raise errors.BadRequestError(
+                f"LocalQueue {group.spec.queue!r} references missing "
+                f"ClusterQueue {lq.spec.cluster_queue!r}") from None
+
+
 def default_chain(registry: "Registry") -> AdmissionChain:
     return AdmissionChain([
         NamespaceLifecycle(registry),
         TpuResourceDefaulter(),
         PriorityResolver(registry),
+        LocalQueueAdmission(registry),
         ServiceAccountPlugin(registry),
         DefaultTolerationSeconds(),
         ExtendedResourceToleration(),

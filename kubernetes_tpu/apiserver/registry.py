@@ -22,7 +22,8 @@ from dataclasses import dataclass, field as dc_field
 from typing import Any, Callable, Optional
 
 from ..api import errors, extensions as ext, networking as net, \
-    rbac as r, types as t, validation as val, workloads as w
+    queueing as qapi, rbac as r, types as t, validation as val, \
+    workloads as w
 from ..api.meta import ObjectMeta, TypedObject, now, stamp as meta_stamp, \
     stamp_new
 from ..api.scheme import DEFAULT_SCHEME, Scheme, from_dict, to_dict
@@ -146,6 +147,14 @@ def builtin_resources() -> list[ResourceSpec]:
         ResourceSpec("leases", "Lease", core, t.Lease, has_status=False),
         ResourceSpec("podgroups", "PodGroup", core, t.PodGroup,
                      validate_create=val.validate_podgroup),
+        ResourceSpec("clusterqueues", "ClusterQueue", qapi.QUEUEING_V1,
+                     qapi.ClusterQueue, namespaced=False,
+                     validate_create=qapi.validate_clusterqueue,
+                     validate_update=qapi.validate_clusterqueue_update),
+        ResourceSpec("localqueues", "LocalQueue", qapi.QUEUEING_V1,
+                     qapi.LocalQueue,
+                     validate_create=qapi.validate_localqueue,
+                     validate_update=qapi.validate_localqueue_update),
         ResourceSpec("replicasets", "ReplicaSet", "apps/v1", w.ReplicaSet,
                      validate_create=val.validate_replicaset),
         ResourceSpec("deployments", "Deployment", "apps/v1", w.Deployment,

@@ -61,12 +61,13 @@ def _mk_node(name: str, z: int, mesh: list) -> t.Node:
     return node
 
 
-def _mk_gang(name: str, members: int, chips: int) -> list:
+def _mk_gang(name: str, members: int, chips: int, queue: str = "") -> list:
     # slice_shape pins each gang to one contiguous 2x2x1 box (one
     # host's z-layer) — member demand must total the box volume.
     objs = [t.PodGroup(metadata=ObjectMeta(name=name, namespace="default"),
                        spec=t.PodGroupSpec(min_member=members,
-                                           slice_shape=[2, 2, 1]))]
+                                           slice_shape=[2, 2, 1],
+                                           queue=queue))]
     for i in range(members):
         pod = t.Pod(
             metadata=ObjectMeta(name=f"{name}-{i}", namespace="default"),
@@ -99,7 +100,7 @@ class _Plane:
     """One incarnation of the control plane over a (possibly recovered)
     store; the harness crashes and rebuilds it."""
 
-    def __init__(self, data_dir: str, port: int = 0):
+    def __init__(self, data_dir: str, port: int = 0, queueing: bool = False):
         self.store = MVCCStore(os.path.join(data_dir, "state"),
                                fsync="batch")
         self.registry = Registry(store=self.store)
@@ -111,8 +112,11 @@ class _Plane:
             pass  # recovered store
         self.server = APIServer(self.registry)
         self.port = port
+        self.queueing = queueing
         self.client: Optional[RESTClient] = None
         self.scheduler: Optional[Scheduler] = None
+        self.qcontroller = None
+        self.qfactory = None
 
     async def start(self) -> None:
         self.port = await self.server.start(port=self.port)
@@ -120,8 +124,19 @@ class _Plane:
         self.client.backoff_base = 0.02
         self.scheduler = Scheduler(self.client, backoff_seconds=0.2)
         await self.scheduler.start()
+        if self.queueing:
+            # Admission over the SAME faulty wire path: the controller
+            # must converge through transport errors and the WAL crash.
+            from ..client.informer import InformerFactory
+            from ..controllers.queue import QueueController
+            self.qfactory = InformerFactory(self.client)
+            self.qcontroller = QueueController(self.client, self.qfactory)
+            await self.qcontroller.start()
 
     async def stop(self, crash: bool = False) -> None:
+        if self.qcontroller is not None:
+            await self.qcontroller.stop()
+            await self.qfactory.stop_all()
         if self.scheduler is not None:
             await self.scheduler.stop()
         await self.server.stop()
@@ -135,10 +150,22 @@ class _Plane:
 
 async def run_chaos(seed: int, n_nodes: int = 4, gangs: int = 4,
                     gang_size: int = 2, chips_per_pod: int = 2,
-                    timeout: float = 60.0) -> dict:
+                    timeout: float = 60.0, queueing: bool = False) -> dict:
     """The scripted scenario; returns a report dict (see keys below).
-    Raises AssertionError on a convergence violation."""
+    Raises AssertionError on a convergence violation.
+
+    ``queueing=True`` runs the same scenario with fair-share admission
+    in the loop (JobQueueing gate on, every gang submitted through a
+    LocalQueue): the extra invariants are that admission SURVIVES the
+    mid-run apiserver crash (pre-crash admissions replay admitted from
+    the WAL) and that the restarted controller never re-admits — each
+    wave-1 gang's ``admitted_time`` is byte-stable across recovery."""
     t0 = time.perf_counter()
+    from ..util.features import GATES
+    queueing_was_on = GATES.enabled("JobQueueing")
+    if queueing:
+        GATES.set("JobQueueing", True)
+    gang_queue = "chaos-lq" if queueing else ""
     controller = core.arm(core.ChaosController(seed, CONVERGENCE_SCHEDULE))
     # The acceptance gate's fault mix must not depend on a lucky seed:
     # guarantee one of each headline kind (the WAL crash is triggered
@@ -149,10 +176,23 @@ async def run_chaos(seed: int, n_nodes: int = 4, gangs: int = 4,
     controller.trigger(core.SITE_WATCH_STORE, "overflow")
     data_dir = tempfile.mkdtemp(prefix="ktpu-chaos-")
     mesh = [2, 2, n_nodes]
-    report: dict = {"seed": seed, "port": None}
-    plane = _Plane(data_dir)
+    report: dict = {"seed": seed, "port": None, "queueing": queueing}
+    plane = _Plane(data_dir, queueing=queueing)
     user: Optional[RESTClient] = None
     try:
+        if queueing:
+            # Installed BEFORE the server faces chaos: quota for the
+            # whole fleet through one queue, so every gang takes the
+            # admission path.
+            from ..api.queueing import ClusterQueue, ClusterQueueSpec, \
+                LocalQueue, LocalQueueSpec
+            plane.registry.create(ClusterQueue(
+                metadata=ObjectMeta(name="chaos-q"),
+                spec=ClusterQueueSpec(nominal_quota={
+                    t.RESOURCE_TPU: float(n_nodes * 4)})))
+            plane.registry.create(LocalQueue(
+                metadata=ObjectMeta(name="chaos-lq", namespace="default"),
+                spec=LocalQueueSpec(cluster_queue="chaos-q")))
         await plane.start()
         report["port"] = plane.port
         for z in range(n_nodes):
@@ -178,9 +218,17 @@ async def run_chaos(seed: int, n_nodes: int = 4, gangs: int = 4,
         wave1 = [f"gang-{g}-{i}" for g in range(gangs // 2)
                  for i in range(gang_size)]
         for g in range(gangs // 2):
-            for obj in _mk_gang(f"gang-{g}", gang_size, chips_per_pod):
+            for obj in _mk_gang(f"gang-{g}", gang_size, chips_per_pod,
+                                queue=gang_queue):
                 await _create_tolerant(user, obj, loop.time() + 15.0)
         await wait_bound(set(wave1), loop.time() + timeout / 3)
+        pre_crash_admissions: dict = {}
+        if queueing:
+            grp, _ = plane.registry.list("podgroups", "default")
+            for g in grp:
+                assert g.status.admitted, \
+                    f"bound gang {g.metadata.name} was never admitted"
+                pre_crash_admissions[g.metadata.name] = g.status.admitted_time
 
         # Mid-run WAL crash: the next store write tears the log and the
         # backend goes down, exactly like a process crash mid-append.
@@ -199,7 +247,7 @@ async def run_chaos(seed: int, n_nodes: int = 4, gangs: int = 4,
 
         # Recover on the same port: replay must reproduce the durable
         # state byte for byte, then the control plane converges again.
-        plane = _Plane(data_dir, port=report["port"])
+        plane = _Plane(data_dir, port=report["port"], queueing=queueing)
         recovered = json.dumps(plane.store.state(), sort_keys=True)
         expected = json.dumps(pre_crash, sort_keys=True)
         report["wal_recovery_identical"] = recovered == expected
@@ -212,9 +260,31 @@ async def run_chaos(seed: int, n_nodes: int = 4, gangs: int = 4,
         all_pods = [f"gang-{g}-{i}" for g in range(gangs)
                     for i in range(gang_size)]
         for g in range(gangs // 2, gangs):
-            for obj in _mk_gang(f"gang-{g}", gang_size, chips_per_pod):
+            for obj in _mk_gang(f"gang-{g}", gang_size, chips_per_pod,
+                                queue=gang_queue):
                 await _create_tolerant(user, obj, loop.time() + 15.0)
         await wait_bound(set(all_pods), loop.time() + timeout / 2)
+        if queueing:
+            # Admission survived the crash AND was not repeated: every
+            # pre-crash admission replays admitted with its original
+            # stamp (a re-admitting controller would re-stamp), and
+            # admitted usage still fits the quota.
+            grp, _ = plane.registry.list("podgroups", "default")
+            by_name = {g.metadata.name: g for g in grp}
+            for name, stamp in pre_crash_admissions.items():
+                g = by_name.get(name)
+                assert g is not None and g.status.admitted, \
+                    f"gang {name}: admission lost across WAL replay"
+                assert g.status.admitted_time == stamp, \
+                    f"gang {name}: re-admitted after replay " \
+                    f"({g.status.admitted_time} != {stamp})"
+            admitted_chips = sum(
+                gang_size * chips_per_pod for g in grp if g.status.admitted)
+            assert admitted_chips <= n_nodes * 4, \
+                f"double admission: {admitted_chips} chips admitted " \
+                f"over a {n_nodes * 4}-chip quota"
+            report["queueing_admitted"] = len(
+                [g for g in grp if g.status.admitted])
 
         # Invariants: no lost binds (all bound, checked above), no
         # duplicated binds (no chip held by two live pods), groups done.
@@ -257,6 +327,8 @@ async def run_chaos(seed: int, n_nodes: int = 4, gangs: int = 4,
         return report
     finally:
         core.disarm()
+        if queueing and not queueing_was_on:
+            GATES.set("JobQueueing", False)
         try:
             if user is not None:
                 await user.close()

@@ -51,6 +51,8 @@ ALIASES = {
     "configmap": "configmaps", "cm": "configmaps",
     "secret": "secrets",
     "podgroup": "podgroups", "pg": "podgroups",
+    "clusterqueue": "clusterqueues", "cq": "clusterqueues",
+    "localqueue": "localqueues", "lq": "localqueues",
     "event": "events", "ev": "events",
     "quota": "resourcequotas", "resourcequota": "resourcequotas",
     "hpa": "horizontalpodautoscalers",

@@ -116,6 +116,57 @@ def _podgroups_table(objs: list, wide: bool) -> str:
     return render_table(["NAME", "MIN-MEMBER", "PHASE", "AGE"], rows)
 
 
+def _fmt_chips(amount) -> str:
+    return f"{amount:g}" if amount else "0"
+
+
+def _clusterqueues_table(objs: list, wide: bool) -> str:
+    headers = ["NAME", "COHORT", "PENDING", "ADMITTED", "BORROWED",
+               "NOMINAL", "AGE"]
+    rows = []
+    for q in objs:
+        rows.append([
+            q.metadata.name, q.spec.cohort or "<none>",
+            q.status.pending, q.status.admitted,
+            _fmt_chips(q.status.borrowed.get(t.RESOURCE_TPU, 0.0)),
+            _fmt_chips(q.spec.nominal_quota.get(t.RESOURCE_TPU, 0.0)),
+            age(q.metadata)])
+    return render_table(headers, rows)
+
+
+def _localqueues_table(objs: list, wide: bool) -> str:
+    rows = [[q.metadata.name, q.spec.cluster_queue,
+             q.status.pending, q.status.admitted, age(q.metadata)]
+            for q in objs]
+    return render_table(
+        ["NAME", "CLUSTERQUEUE", "PENDING", "ADMITTED", "AGE"], rows)
+
+
+def describe_clusterqueue(cq) -> str:
+    """Per-tenant usage vs quota, then the generic field dump."""
+    lines = [f"Name: {cq.metadata.name}",
+             f"Cohort: {cq.spec.cohort or '<none>'}",
+             f"Pending: {cq.status.pending}",
+             f"Admitted: {cq.status.admitted}",
+             "Quota:"]
+    for res in sorted(cq.spec.nominal_quota):
+        used = cq.status.usage.get(res, 0.0)
+        borrowed = cq.status.borrowed.get(res, 0.0)
+        line = (f"  {res}: {used:g} used / "
+                f"{cq.spec.nominal_quota[res]:g} nominal")
+        if borrowed:
+            line += f" (+{borrowed:g} borrowed)"
+        lines.append(line)
+    if cq.status.tenant_usage:
+        lines.append("Tenants:")
+        for tenant in sorted(cq.status.tenant_usage):
+            usage = cq.status.tenant_usage[tenant]
+            lines.append("  " + tenant + ": " + ", ".join(
+                f"{res}={amt:g}" for res, amt in sorted(usage.items())))
+    lines.append("")
+    return "\n".join(lines) + _describe_fields(cq)
+
+
 def _services_table(objs: list, wide: bool) -> str:
     rows = [[o.metadata.name, o.spec.cluster_ip or "<none>",
              ",".join(f"{p.port}/{p.protocol or 'TCP'}"
@@ -144,6 +195,8 @@ PRINTERS: dict[str, Callable[[list, bool], str]] = {
     "statefulsets": _replicas_table,
     "jobs": _jobs_table,
     "podgroups": _podgroups_table,
+    "clusterqueues": _clusterqueues_table,
+    "localqueues": _localqueues_table,
     "services": _services_table,
     "events": _events_table,
 }
@@ -156,7 +209,15 @@ def print_objects(plural: str, objs: list, wide: bool = False) -> str:
 
 
 def describe(obj: Any) -> str:
-    """Indented field dump (kubectl describe analog, schema-driven)."""
+    """kubectl describe analog: kind-specific summaries for queueing
+    kinds (usage vs quota), generic schema-driven dump otherwise."""
+    if type(obj).__name__ == "ClusterQueue":
+        return describe_clusterqueue(obj)
+    return _describe_fields(obj)
+
+
+def _describe_fields(obj: Any) -> str:
+    """Indented field dump (schema-driven)."""
     from ..api.scheme import to_dict
     lines: list[str] = []
 

@@ -255,7 +255,8 @@ class LocalCluster:
                 self.ca.ca_cert_path, self.admin_cert.cert_path,
                 self.admin_cert.key_path, check_hostname=False)
         self.controller_manager = ControllerManager(
-            local, node_scrape_ssl=scrape_ssl)
+            local, node_scrape_ssl=scrape_ssl,
+            queueing_fits_probe=self._queueing_fits_probe)
         await self.controller_manager.start()
 
         # Cluster DNS (kube-dns addon analog): A records for services +
@@ -408,6 +409,21 @@ class LocalCluster:
         node = await self._start_node(spec, len(self.nodes))
         self.nodes.append(node)
         return node
+
+    def _queueing_fits_probe(self, group) -> bool:
+        """Backfill placement probe for the queue controller: does a
+        free contiguous box of the gang's shape exist in the live
+        scheduler cache right now? Single-binary only — a remote
+        controller-manager falls back to quota-only backfill."""
+        if self.scheduler is None or not group.spec.slice_shape:
+            return True
+        from ..scheduler.submesh import find_box
+        cache = self.scheduler.cache
+        for sl in cache.slices.values():
+            if find_box(set(sl.free(cache)), sl.mesh_shape,
+                        group.spec.slice_shape) is not None:
+                return True
+        return False
 
     async def stop(self) -> None:
         if getattr(self, "chaos_driver", None) is not None:

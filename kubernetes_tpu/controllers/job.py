@@ -40,6 +40,11 @@ class JobController(Controller):
                  workers: int = 2):
         super().__init__(client, factory, workers)
         self.pod_control = PodControl(client, self.recorder)
+        #: Group keys whose teardown reached a terminal verdict
+        #: (deleted / unqueued / already gone) — every later resync of
+        #: the finished Job would otherwise re-issue the probing GET
+        #: forever. FIFO-pruned; a miss just pays one GET.
+        self._group_torn_down: dict[str, None] = {}
         self.job_informer = self.watch("jobs")
         self.pod_informer = self.watch("pods")
         self.job_informer.add_handlers(
@@ -79,7 +84,18 @@ class JobController(Controller):
             spec=t.PodGroupSpec(
                 min_member=gang.min_member or job.spec.parallelism,
                 slice_shape=list(gang.slice_shape),
-                schedule_timeout_seconds=gang.schedule_timeout_seconds))
+                schedule_timeout_seconds=gang.schedule_timeout_seconds,
+                queue=gang.queue))
+        from ..util.features import GATES
+        if job.spec.active_deadline_seconds \
+                and GATES.enabled("JobQueueing"):
+            # Projected runtime for the admission backfill pass
+            # (queueing/fairshare.py shadow-time check). Gated: with
+            # JobQueueing off the created PodGroup must be
+            # byte-identical to the ungated build.
+            from ..api.queueing import RUNTIME_ANNOTATION
+            group.metadata.annotations[RUNTIME_ANNOTATION] = str(
+                job.spec.active_deadline_seconds)
         try:
             await self.client.create(group)
         except errors.AlreadyExistsError:
@@ -130,6 +146,13 @@ class JobController(Controller):
         if job is None or job.metadata.deletion_timestamp is not None:
             return None
         if self._finished(job):
+            # Level-triggered gang teardown: the delete in the
+            # completion/failure transition can be lost (crash or
+            # transient API error between the terminal condition write
+            # and the delete) — re-issuing here keeps a finished gang
+            # from pinning its queue quota forever. No-op when the
+            # group is already gone, unqueued, or the gate is off.
+            await self._delete_podgroup(job)
             return None
         pods = self._pods_for(job)
         active = [p for p in pods if is_pod_active(p)]
@@ -201,6 +224,7 @@ class JobController(Controller):
         if done:
             await self._update_status(job, active, acct, condition="Complete")
             self.recorder.event(job, "Normal", "Completed", "job completed")
+            await self._delete_podgroup(job)
             return None
 
         if job.spec.gang is not None:
@@ -267,6 +291,35 @@ class JobController(Controller):
         await self._update_status(job, [], acct, condition="Failed",
                                   reason=reason, message=message)
         self.recorder.event(job, "Warning", reason, message)
+        await self._delete_podgroup(job)
+
+    async def _delete_podgroup(self, job) -> None:
+        """Terminal Job: the gang is over, so its PodGroup goes now —
+        a PodGroup's lifetime IS the gang's quota hold (queueing/
+        fair-share admission charges a group until it is deleted or
+        Failed; waiting for owner-ref GC at Job deletion would pin the
+        tenant's quota on finished work indefinitely). Only QUEUED
+        gangs: with the gate off, or for a group with no spec.queue
+        (checked on the live group — admission may have defaulted it),
+        there is no quota hold and the PodGroup must keep surviving
+        until Job deletion exactly as before."""
+        from ..util.features import GATES
+        if job.spec.gang is None or not GATES.enabled("JobQueueing"):
+            return
+        ns, name = job.metadata.namespace, _group_name(job)
+        key = f"{ns}/{name}"
+        if key in self._group_torn_down:
+            return
+        try:
+            group = await self.client.get("podgroups", ns, name)
+            if group.spec.queue:
+                await self.client.delete("podgroups", ns, name)
+        except errors.NotFoundError:
+            pass
+        if len(self._group_torn_down) >= 4096:
+            for stale in list(self._group_torn_down)[:2048]:
+                del self._group_torn_down[stale]
+        self._group_torn_down[key] = None
 
     async def _update_status(self, job, pods, acct,
                              condition: str = "", reason: str = "",

@@ -30,6 +30,7 @@ from .namespace import NamespaceController
 from .nodeipam import NodeIpamController
 from .nodelifecycle import NodeLifecycleController
 from .podgc import PodGCController
+from .queue import QueueController
 from .replicaset import ReplicaSetController
 from .resourcequota import ResourceQuotaController
 from .serviceaccount import ServiceAccountController
@@ -59,17 +60,24 @@ DEFAULT_CONTROLLERS: dict[str, Callable[[Client, InformerFactory], Controller]] 
     "horizontal-pod-autoscaler": HorizontalPodAutoscalerController,
     "disruption": DisruptionController,
     "ttl": TTLController,
+    # Gang admission (queueing/): inert unless the JobQueueing gate is
+    # on — it then suspends/admits PodGroups by tenant fair share.
+    "job-queueing": QueueController,
 }
 
 
 class ControllerManager:
     def __init__(self, client: Client, controllers: Optional[list[str]] = None,
                  leader_elect: bool = False, identity: str = "",
-                 node_scrape_ssl=None):
+                 node_scrape_ssl=None, queueing_fits_probe=None):
         self.client = client
         #: Cluster credentials for scraping TLS node servers (the HPA's
         #: real metrics pipeline); the composer wires CA + identity.
         self.node_scrape_ssl = node_scrape_ssl
+        #: Backfill placement probe for the queue controller (the
+        #: single-binary composer wires the live scheduler cache so
+        #: backfill only jumps when a free box actually exists).
+        self.queueing_fits_probe = queueing_fits_probe
         self.names = list(controllers or DEFAULT_CONTROLLERS)
         self.leader_elect = leader_elect
         self.identity = identity or f"cm-{uuid.uuid4().hex[:8]}"
@@ -86,6 +94,8 @@ class ControllerManager:
             from .hpa import SummaryMetricsSource
             return {"metrics": SummaryMetricsSource(
                 self.client, ssl_context=self.node_scrape_ssl)}
+        if name == "job-queueing" and self.queueing_fits_probe is not None:
+            return {"fits_probe": self.queueing_fits_probe}
         return {}
 
     async def _run_controllers(self) -> None:

@@ -125,49 +125,59 @@ def _box_offsets(dims):
             for z in range(dims[2])]
 
 
-async def run_gang_bench(n_slices: int = 8, n_gangs: Optional[int] = None,
-                         timeout: float = 600.0) -> dict:
-    from ..scheduler import metrics as sm
-    sm.PREEMPTION_LATENCY.reset()  # isolate this run
+def _bench_fleet(n_slices: int, n_gangs: Optional[int]):
+    """Shared stanza setup: registry + built slices + the gang-count
+    formula (75% fleet fill). One copy, so the --queued stanza measures
+    the SAME wave it is compared against."""
+    import math
     reg = Registry()
     reg.admission = default_chain(reg)
     reg.create(t.Namespace(metadata=ObjectMeta(name="default")))
     for s in range(n_slices):
         build_slice(reg, s)
-    import math
     fleet_chips = n_slices * math.prod(SLICE_MESH)
     if n_gangs is None:
         n_gangs = int(0.75 * fleet_chips / math.prod(GANG_SHAPE))
+    members = math.prod(GANG_SHAPE) // CHIPS_PER_HOST
+    return reg, fleet_chips, n_gangs, members
+
+
+async def _count_bound(stream, keys: set, want: int,
+                       done: asyncio.Event) -> None:
+    """Watch-based bound-pod counter shared by the bench stanzas (a
+    poll loop decodes the whole pod list per tick and dominates the
+    very wall-clock it measures at fleet scale). DELETED discards:
+    gang recovery may evict members, and with no controller to replace
+    them the count must go back down, not stick at a phantom total."""
+    while not done.is_set():
+        ev = await stream.next()
+        if ev is None or ev[0] == "CLOSED":
+            return
+        ev_type, pod = ev
+        if ev_type == "DELETED":
+            keys.discard(pod.key())
+        elif ev_type in ("ADDED", "MODIFIED") and pod.spec.node_name:
+            keys.add(pod.key())
+            if len(keys) >= want:
+                done.set()
+
+
+async def run_gang_bench(n_slices: int = 8, n_gangs: Optional[int] = None,
+                         timeout: float = 600.0) -> dict:
+    from ..scheduler import metrics as sm
+    sm.PREEMPTION_LATENCY.reset()  # isolate this run
+    import math
+    reg, fleet_chips, n_gangs, members = _bench_fleet(n_slices, n_gangs)
 
     client = LocalClient(reg)
     sched = Scheduler(client, backoff_seconds=0.5)
     await sched.start()
-    members = math.prod(GANG_SHAPE) // CHIPS_PER_HOST
     want_bound = n_gangs * members
-    # Watch bound pods instead of poll-decoding the whole pod list per
-    # tick — at fleet scale the poll loop otherwise dominates the very
-    # wall-clock it measures.
     bound_keys: set[str] = set()
     done = asyncio.Event()
     stream = await client.watch("pods", namespace="default")
-
-    async def count_bound():
-        while not done.is_set():
-            ev = await stream.next()
-            if ev is None or ev[0] == "CLOSED":
-                return
-            ev_type, pod = ev
-            if ev_type == "DELETED":
-                # Gang recovery may evict members; with no controller
-                # to replace them the count must go back down, not
-                # stick at a phantom total.
-                bound_keys.discard(pod.key())
-            elif ev_type in ("ADDED", "MODIFIED") and pod.spec.node_name:
-                bound_keys.add(pod.key())
-                if len(bound_keys) >= want_bound:
-                    done.set()
-
-    counter = asyncio.create_task(count_bound())
+    counter = asyncio.create_task(
+        _count_bound(stream, bound_keys, want_bound, done))
     try:
         start = time.perf_counter()
         for i in range(n_gangs):
@@ -204,21 +214,8 @@ async def run_gang_bench(n_slices: int = 8, n_gangs: Optional[int] = None,
             await sched.stop()
             raise
         fill_keys: set[str] = set(bound_keys)
-
-        async def count_fill():
-            while not fdone.is_set():
-                ev = await fstream.next()
-                if ev is None or ev[0] == "CLOSED":
-                    return
-                ev_type, pod = ev
-                if ev_type == "DELETED":
-                    fill_keys.discard(pod.key())
-                elif ev_type in ("ADDED", "MODIFIED") and pod.spec.node_name:
-                    fill_keys.add(pod.key())
-                    if len(fill_keys) >= fill_want:
-                        fdone.set()
-
-        fcounter = asyncio.create_task(count_fill())
+        fcounter = asyncio.create_task(
+            _count_bound(fstream, fill_keys, fill_want, fdone))
         try:
             for i in range(n_fill):
                 group, fpods = gang_objects(i, prefix="fill")
@@ -367,9 +364,116 @@ async def run_gang_bench(n_slices: int = 8, n_gangs: Optional[int] = None,
     }
 
 
+async def run_queued_gang_bench(n_slices: int = 8,
+                                n_gangs: Optional[int] = None,
+                                timeout: float = 600.0) -> dict:
+    """The same gang wave, submitted THROUGH fair-share admission.
+
+    Two tenant ClusterQueues (one cohort, half the fleet's chips each)
+    split the wave; every gang is born suspended, admitted by the
+    QueueController in DRF order, and only then released into the
+    scheduling heap. Reports admission-wait p50/p99 (true raw-sample
+    percentiles) next to the place rate — the acceptance bar is that
+    admission adds ordering, not throughput loss (rate within 10% of
+    the unqueued stanza).
+    """
+    from ..client.informer import InformerFactory
+    from ..controllers.queue import QueueController
+    from ..queueing import metrics as qm
+    from ..queueing.harness import make_gang, make_queues
+    from ..util.features import GATES
+
+    qm.ADMISSION_WAIT.reset()
+    was_on = GATES.enabled("JobQueueing")
+    # Setup inside the try: an exception must not leak the
+    # process-global gate on.
+    GATES.set("JobQueueing", True)
+    sched = qc = factory = None
+    try:
+        reg, fleet_chips, n_gangs, members = _bench_fleet(n_slices, n_gangs)
+        for obj in make_queues(nominal_chips=fleet_chips / 2.0):
+            reg.create(obj)
+
+        client = LocalClient(reg)
+        factory = InformerFactory(client)
+        # Shared factory: scheduler + controller decode each watch
+        # event once, not once per component (the measured same-process
+        # overhead of the queued stanza).
+        sched = Scheduler(client, backoff_seconds=0.5,
+                          informer_factory=factory)
+        qc = QueueController(client, factory)
+        want_bound = n_gangs * members
+        await sched.start()
+        await qc.start()
+        bound_keys: set[str] = set()
+        done = asyncio.Event()
+        streams = [await client.watch("pods", namespace=ns)
+                   for ns in ("tenant-a", "tenant-b")]
+        counters = [asyncio.create_task(
+            _count_bound(s, bound_keys, want_bound, done)) for s in streams]
+        try:
+            start = time.perf_counter()
+            for i in range(n_gangs):
+                tenant = "a" if i % 2 == 0 else "b"
+                group, pods = make_gang(f"qgang-{i:04d}", f"tenant-{tenant}",
+                                        f"queue-{tenant}")
+                await client.create(group)
+                for pod in pods:
+                    await client.create(pod)
+            try:
+                await asyncio.wait_for(done.wait(), timeout)
+            except asyncio.TimeoutError:
+                raise TimeoutError(
+                    f"queued: only {len(bound_keys)}/{want_bound} "
+                    f"pods bound") from None
+            wall = time.perf_counter() - start
+        finally:
+            for s in streams:
+                s.cancel()
+            for c in counters:
+                c.cancel()
+    finally:
+        if qc is not None:
+            await qc.stop()
+        if sched is not None:
+            await sched.stop()
+        if factory is not None:
+            await factory.stop_all()  # last: the scheduler rides it too
+        if not was_on:
+            GATES.set("JobQueueing", False)
+    groups, _ = reg.list("podgroups", "")
+    admitted = [g for g in groups if g.status.admitted]
+    modes: dict[str, int] = {}
+    for g in admitted:
+        modes[g.status.admission_mode] = modes.get(
+            g.status.admission_mode, 0) + 1
+    p50 = qm.ADMISSION_WAIT.raw_quantile(0.5)
+    p99 = qm.ADMISSION_WAIT.raw_quantile(0.99)
+    return {
+        "slices": n_slices,
+        "gangs": n_gangs,
+        "admitted": len(admitted),
+        "admission_modes": modes,
+        "wall_seconds": round(wall, 3),
+        "gangs_per_second": round(n_gangs / wall, 2),
+        "pods_per_second": round(want_bound / wall, 2),
+        "admission_wait_p50_ms": (round(p50 * 1e3, 2)
+                                  if p50 is not None else None),
+        "admission_wait_p99_ms": (round(p99 * 1e3, 2)
+                                  if p99 is not None else None),
+    }
+
+
 if __name__ == "__main__":
     import json
     import sys
-    ns = int(sys.argv[1]) if len(sys.argv) > 1 else 8
-    ng = int(sys.argv[2]) if len(sys.argv) > 2 else None
-    print(json.dumps(asyncio.run(run_gang_bench(ns, ng))))
+    argv = [a for a in sys.argv[1:] if a != "--queued"]
+    queued = "--queued" in sys.argv[1:]
+    ns = int(argv[0]) if len(argv) > 0 else 8
+    ng = int(argv[1]) if len(argv) > 1 else None
+    out = asyncio.run(run_gang_bench(ns, ng))
+    if queued:
+        # Same wave through admission; rate within 10% of the above is
+        # the "admission is not the bottleneck" acceptance bar.
+        out["queued"] = asyncio.run(run_queued_gang_bench(ns, ng))
+    print(json.dumps(out))

@@ -1,0 +1,14 @@
+"""Multi-tenant job queueing: fair-share admission, quota borrowing,
+and backfill for gang TPU jobs (the Kueue analog).
+
+Layout:
+
+- :mod:`~kubernetes_tpu.api.queueing` — ClusterQueue/LocalQueue kinds;
+- :mod:`.fairshare` — pure DRF/borrow/backfill/reclaim decision math;
+- :mod:`kubernetes_tpu.controllers.queue` — the QueueController
+  driving it over informers;
+- :mod:`.metrics` — the ``queue_*`` metric family;
+- :mod:`.harness` — the two-tenant starvation/reclaim smoke shared by
+  ``hack/queue_smoke.sh`` and the integration tier.
+"""
+from . import fairshare, metrics  # noqa: F401

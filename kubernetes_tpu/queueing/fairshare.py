@@ -1,0 +1,332 @@
+"""Fair-share admission math — pure, deterministic, side-effect free.
+
+The QueueController's decision core, factored out of the informer/API
+machinery so the invariants can be property-tested directly:
+
+- **DRF ordering** (Dominant Resource Fairness, Ghodsi et al., applied
+  per arXiv:2510.01256's tenant-quota scheduling): pending gangs are
+  admitted in the order produced by repeatedly picking the queue with
+  the lowest dominant share, charging the pick hypothetically, and
+  repeating — so a flooding tenant's 2nd..Nth gangs queue behind every
+  other tenant's 1st.
+- **Cohort borrowing**: a queue may exceed its nominal quota using
+  cohort-mates' idle quota, bounded per-resource by its
+  ``borrowing_limit`` and by total cohort headroom (sum of usage never
+  exceeds sum of nominal — the conservation invariant).
+- **Reclaim pricing**: when a queue's own demand returns but borrowers
+  hold its quota, victims are chosen cheapest-first with the SAME cost
+  order the scheduler's gang preemption uses (``scheduler.py
+  _cheaper``: max victim priority, then gang size), most recent
+  admission first among equals (LIFO — the shortest-lived disruption).
+- **EASY backfill**: with the head-of-line gang blocked, a later gang
+  may jump iff it fits outright AND its projected completion
+  (``runtime``) lands before the blocker's *shadow time* — the
+  earliest instant the blocker could start given admitted gangs'
+  projected completions — so the jump can never delay the blocker
+  (arXiv:2010.11307's queued-admission utilization argument).
+
+Everything here operates on plain snapshots (:class:`QueueState`,
+:class:`Workload`); the controller translates API objects in and
+status updates out.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..api.types import RESOURCE_TPU
+
+INF = float("inf")
+
+
+@dataclass
+class QueueState:
+    """One ClusterQueue's accounting snapshot for an admission pass."""
+
+    name: str
+    cohort: str = ""
+    #: Per-resource nominal quota. Resources absent here are UNGOVERNED
+    #: by this queue — demand for them is not charged (so a chips-only
+    #: quota config admits cpu-carrying gangs without modelling cpu).
+    nominal: dict[str, float] = field(default_factory=dict)
+    #: Per-resource cap on usage beyond nominal (missing key = no cap
+    #: beyond cohort headroom). Meaningless without a cohort.
+    borrowing_limit: dict[str, float] = field(default_factory=dict)
+    #: Admitted usage, mutated by :func:`charge` / :func:`release`.
+    usage: dict[str, float] = field(default_factory=dict)
+
+    def governed(self, demand: dict[str, float]) -> dict[str, float]:
+        return {r: a for r, a in demand.items() if r in self.nominal}
+
+    def clone(self) -> "QueueState":
+        """Independent copy for hypothetical charging (DRF scratch,
+        shadow replay, reclaim simulation)."""
+        return QueueState(name=self.name, cohort=self.cohort,
+                          nominal=dict(self.nominal),
+                          borrowing_limit=dict(self.borrowing_limit),
+                          usage=dict(self.usage))
+
+
+@dataclass
+class Workload:
+    """One gang (PodGroup) from admission's point of view."""
+
+    key: str                 # namespace/name of the PodGroup
+    queue: str               # ClusterQueue name
+    demand: dict[str, float] = field(default_factory=dict)
+    priority: int = 0
+    #: Creation stamp (seconds) — FIFO order within a queue.
+    created: float = 0.0
+    #: Projected runtime in seconds (annotation / activeDeadline);
+    #: None = unknown, which disqualifies it from backfilling.
+    runtime: Optional[float] = None
+    #: Set on admitted workloads.
+    admitted_at: Optional[float] = None
+    mode: str = ""           # "", Nominal, Borrowed, Backfill
+
+
+# -- shares -----------------------------------------------------------------
+
+
+def dominant_share(q: QueueState) -> float:
+    """Max over governed resources of usage/nominal. A resource with
+    zero nominal but positive usage dominates everything (inf)."""
+    share = 0.0
+    for res, cap in q.nominal.items():
+        used = q.usage.get(res, 0.0)
+        if used <= 0:
+            continue
+        share = max(share, used / cap if cap > 0 else INF)
+    return share
+
+
+def borrowed(q: QueueState) -> dict[str, float]:
+    return {res: q.usage.get(res, 0.0) - cap
+            for res, cap in q.nominal.items()
+            if q.usage.get(res, 0.0) > cap}
+
+
+def charge(q: QueueState, demand: dict[str, float]) -> None:
+    for res, amt in q.governed(demand).items():
+        q.usage[res] = q.usage.get(res, 0.0) + amt
+
+
+def release(q: QueueState, demand: dict[str, float]) -> None:
+    for res, amt in q.governed(demand).items():
+        q.usage[res] = max(0.0, q.usage.get(res, 0.0) - amt)
+
+
+def cohort_headroom(cohort_queues: list[QueueState]) -> dict[str, float]:
+    """Per-resource idle capacity across the cohort: sum(nominal) -
+    sum(usage), over every resource any member governs."""
+    total: dict[str, float] = {}
+    used: dict[str, float] = {}
+    for q in cohort_queues:
+        for res, cap in q.nominal.items():
+            total[res] = total.get(res, 0.0) + cap
+        for res, amt in q.usage.items():
+            if any(res in m.nominal for m in cohort_queues):
+                used[res] = used.get(res, 0.0) + amt
+    return {res: cap - used.get(res, 0.0) for res, cap in total.items()}
+
+
+# -- admission --------------------------------------------------------------
+
+
+def admission_mode(q: QueueState, cohort_queues: list[QueueState],
+                   demand: dict[str, float]) -> tuple[Optional[str], bool]:
+    """Can ``demand`` be admitted into ``q`` right now?
+
+    Returns ``(mode, needs_reclaim)``: mode is ``"Nominal"`` /
+    ``"Borrowed"`` / None. ``needs_reclaim=True`` means the demand fits
+    the queue's OWN nominal quota but cohort-mates have borrowed it
+    away — the caller should reclaim (preempt borrowers), not reject.
+    """
+    gov = q.governed(demand)
+    fits_nominal = all(q.usage.get(r, 0.0) + a <= q.nominal[r] + 1e-9
+                       for r, a in gov.items())
+    headroom = (cohort_headroom(cohort_queues) if q.cohort
+                else {r: q.nominal[r] - q.usage.get(r, 0.0)
+                      for r in q.nominal})
+    fits_cohort = all(gov[r] <= headroom.get(r, 0.0) + 1e-9 for r in gov)
+    if fits_nominal:
+        return ("Nominal", False) if fits_cohort else (None, True)
+    if not q.cohort:
+        return None, False
+    fits_borrow = all(
+        q.usage.get(r, 0.0) + a
+        <= q.nominal[r] + q.borrowing_limit.get(r, INF) + 1e-9
+        for r, a in gov.items())
+    if fits_borrow and fits_cohort:
+        return "Borrowed", False
+    return None, False
+
+
+def structurally_admissible(q: QueueState,
+                            cohort_queues: list[QueueState],
+                            demand: dict[str, float]) -> bool:
+    """Could ``demand`` EVER be admitted into ``q`` at current quota
+    config, with the whole cohort idle? A gang failing this is
+    inadmissible — it must be skipped, not allowed to become a
+    permanent head-of-line blocker starving its cohort."""
+    gov = q.governed(demand)
+    cohort_total: dict[str, float] = {}
+    for m in cohort_queues:
+        for res, cap in m.nominal.items():
+            cohort_total[res] = cohort_total.get(res, 0.0) + cap
+    for res, amt in gov.items():
+        ceiling = q.nominal[res] + (q.borrowing_limit.get(res, INF)
+                                    if q.cohort else 0.0)
+        ceiling = min(ceiling, cohort_total.get(res, q.nominal[res]))
+        if amt > ceiling + 1e-9:
+            return False
+    return True
+
+
+def pending_order(pending: list[Workload]) -> list[Workload]:
+    """Within-queue order: priority desc, then FIFO, then name."""
+    return sorted(pending, key=lambda w: (-w.priority, w.created, w.key))
+
+
+def drf_order(queues: dict[str, QueueState],
+              pending: list[Workload]) -> list[Workload]:
+    """Global admission order across tenants.
+
+    Deterministic and input-permutation-invariant: repeatedly pick the
+    queue with the lowest (dominant_share, name), emit its head
+    workload, and charge it against a SCRATCH copy of usage so each
+    pick sees the shares the previous picks produced.
+    """
+    scratch = {name: q.clone() for name, q in queues.items()}
+    remaining = {name: pending_order([w for w in pending if w.queue == name])
+                 for name in queues}
+    order: list[Workload] = []
+    while any(remaining.values()):
+        pick = min((name for name, ws in remaining.items() if ws),
+                   key=lambda n: (dominant_share(scratch[n]), n))
+        w = remaining[pick].pop(0)
+        charge(scratch[pick], w.demand)
+        order.append(w)
+    return order
+
+
+# -- backfill ---------------------------------------------------------------
+
+
+def shadow_time(blocker: Workload, queues: dict[str, QueueState],
+                admitted: list[Workload], now: float) -> float:
+    """Earliest time the blocker could be admitted, replaying admitted
+    gangs' projected completions (admitted_at + runtime) in order.
+    Gangs with unknown runtime never complete in the replay; if the
+    blocker still doesn't fit after every known completion, the shadow
+    is +inf (no reservation can be computed)."""
+    sim = {name: q.clone() for name, q in queues.items()}
+
+    def fits_now() -> bool:
+        q = sim.get(blocker.queue)
+        if q is None:
+            return False
+        cohort = [m for m in sim.values() if q.cohort and m.cohort == q.cohort]
+        mode, _ = admission_mode(q, cohort, blocker.demand)
+        return mode is not None
+
+    if fits_now():
+        return now
+    ends = sorted(
+        ((max(now, w.admitted_at + w.runtime), w)
+         for w in admitted
+         if w.runtime is not None and w.admitted_at is not None),
+        key=lambda pair: (pair[0], pair[1].key))
+    for end, w in ends:
+        q = sim.get(w.queue)
+        if q is not None:
+            release(q, w.demand)
+        if fits_now():
+            return end
+    return INF
+
+
+def backfill_ok(candidate: Workload, shadow: float, now: float) -> bool:
+    """May ``candidate`` jump the blocked head? Only with a BOUNDED
+    projected runtime, and only when it completes before the blocker's
+    shadow time. An infinite shadow (blocker waits on unknown-runtime
+    gangs) admits any bounded candidate — it cannot postpone "unknown".
+    """
+    if candidate.runtime is None:
+        return False
+    if math.isinf(shadow):
+        return True
+    return now + candidate.runtime <= shadow + 1e-9
+
+
+# -- reclaim ----------------------------------------------------------------
+
+
+def reclaim_cost(w: Workload) -> tuple:
+    """Victim pricing, aligned with scheduler gang preemption's
+    ``_cheaper`` (max priority, then size), then LIFO by admission."""
+    return (w.priority,
+            w.demand.get(RESOURCE_TPU, 0.0),
+            -(w.admitted_at or 0.0),
+            w.key)
+
+
+def pick_reclaim_victims(lender: QueueState,
+                         demand: dict[str, float],
+                         cohort_queues: list[QueueState],
+                         admitted: list[Workload]) -> list[Workload]:
+    """Choose admitted workloads whose release restores enough cohort
+    headroom for ``demand``. Returns [] when reclaim cannot help (the
+    shortfall is not held by over-nominal queues). Victims come only
+    from queues CURRENTLY over their nominal — a queue within its own
+    quota is never preempted to serve a neighbor. Deliberately not
+    filtered by admission-time mode: a quota shrink can push usage
+    admitted as Nominal over the new nominal, and those chips must be
+    reclaimable or the cohort deadlocks behind an unservable blocker."""
+    gov = lender.governed(demand)
+    if not gov:
+        return []
+    headroom = cohort_headroom(cohort_queues)
+    shortfall = {r: a - headroom.get(r, 0.0)
+                 for r, a in gov.items() if a > headroom.get(r, 0.0) + 1e-9}
+    if not shortfall:
+        return []
+    by_name = {q.name: q for q in cohort_queues}
+    sim_usage = {q.name: dict(q.usage) for q in cohort_queues}
+
+    def over_nominal(qname: str) -> dict[str, float]:
+        q = by_name[qname]
+        return {r: sim_usage[qname].get(r, 0.0) - cap
+                for r, cap in q.nominal.items()
+                if sim_usage[qname].get(r, 0.0) > cap + 1e-9}
+
+    candidates = sorted(
+        (w for w in admitted if w.queue in by_name), key=reclaim_cost)
+    victims: list[Workload] = []
+    for w in candidates:
+        if not shortfall:
+            break
+        over = over_nominal(w.queue)
+        # Only useful if its queue is over nominal in a short resource
+        # AND the victim itself holds some of it — else its eviction
+        # frees nothing the blocker needs (and the cost sort would put
+        # exactly such zero-TPU gangs first).
+        if not any(r in over and w.demand.get(r, 0.0) > 1e-9
+                   for r in shortfall):
+            continue
+        victims.append(w)
+        q = by_name[w.queue]
+        for r, a in q.governed(w.demand).items():
+            sim_usage[w.queue][r] = max(
+                0.0, sim_usage[w.queue].get(r, 0.0) - a)
+        sims = []
+        for m in cohort_queues:
+            s = m.clone()
+            s.usage = sim_usage[m.name]
+            sims.append(s)
+        headroom = cohort_headroom(sims)
+        shortfall = {r: a - headroom.get(r, 0.0)
+                     for r, a in gov.items()
+                     if a > headroom.get(r, 0.0) + 1e-9}
+    return victims if not shortfall else []

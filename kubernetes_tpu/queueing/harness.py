@@ -1,0 +1,211 @@
+"""Two-tenant queueing smoke — the acceptance scenario, shared.
+
+One run drives the whole admission story over an in-process control
+plane (LocalClient + Scheduler + QueueController, one 4x4x4 slice):
+
+1. tenant A floods 10 gangs (80 chips demand) into a 32-chip nominal
+   quota — fair-share admission lets it borrow tenant B's idle quota
+   up to the 64-chip cohort, leaving a pending backlog;
+2. tenant B submits ONE gang — its nominal quota is occupied by A's
+   borrowing, so the controller reclaims (cheapest borrowed A gang
+   unadmitted, bound pods evicted) and B's gang reaches Bound while
+   A's backlog is still pending;
+3. the reclaimed gang is requeued, not orphaned: it survives as a
+   pending PodGroup and re-enters the DRF order.
+
+Shared by ``hack/queue_smoke.sh`` (<60s CI gate) and
+``tests/integration/test_queueing.py`` so the CI arm and the test tier
+exercise one scenario, not two drifting copies. Raises AssertionError
+on any violation; returns a report dict.
+"""
+from __future__ import annotations
+
+import asyncio
+import time
+
+from ..api import types as t
+from ..api.meta import ObjectMeta
+from ..api.queueing import ClusterQueue, ClusterQueueSpec, LocalQueue, \
+    LocalQueueSpec
+from ..apiserver.admission import default_chain
+from ..apiserver.registry import Registry
+from ..client.informer import InformerFactory
+from ..client.local import LocalClient
+from ..controllers.queue import QueueController
+from ..scheduler.scheduler import Scheduler
+from ..util.features import GATES
+
+CHIPS_PER_HOST = 4
+GANG_SHAPE = [2, 2, 2]  # 8 chips -> 2 pods x 4 chips
+
+
+def make_queues(nominal_chips: float = 32.0) -> list:
+    """Two tenants, one borrowing cohort, half the slice each."""
+    objs = []
+    for tenant in ("a", "b"):
+        objs.append(ClusterQueue(
+            metadata=ObjectMeta(name=f"team-{tenant}"),
+            spec=ClusterQueueSpec(
+                cohort="main",
+                nominal_quota={t.RESOURCE_TPU: nominal_chips})))
+        objs.append(t.Namespace(metadata=ObjectMeta(name=f"tenant-{tenant}")))
+        objs.append(LocalQueue(
+            metadata=ObjectMeta(name=f"queue-{tenant}",
+                                namespace=f"tenant-{tenant}"),
+            spec=LocalQueueSpec(cluster_queue=f"team-{tenant}")))
+    return objs
+
+
+def make_gang(name: str, namespace: str, queue: str, priority: int = 0,
+              shape: list = None, chips_per_pod: int = CHIPS_PER_HOST,
+              runtime: float = None) -> tuple:
+    """A queued gang + its member pods. ``shape``/``chips_per_pod``
+    size it (default: one GANG_SHAPE box, host-sized pods);
+    ``runtime`` stamps the backfill projection annotation."""
+    shape = list(shape) if shape is not None else list(GANG_SHAPE)
+    members = 1
+    for d in shape:
+        members *= d
+    members //= chips_per_pod
+    group = t.PodGroup(
+        metadata=ObjectMeta(name=name, namespace=namespace),
+        spec=t.PodGroupSpec(min_member=members, slice_shape=shape,
+                            queue=queue,
+                            priority=priority or None))
+    if runtime is not None:
+        from ..api.queueing import RUNTIME_ANNOTATION
+        group.metadata.annotations[RUNTIME_ANNOTATION] = str(runtime)
+    pods = []
+    for m in range(members):
+        pod = t.Pod(metadata=ObjectMeta(name=f"{name}-{m}",
+                                        namespace=namespace),
+                    spec=t.PodSpec(containers=[t.Container(
+                        name="c", image="train",
+                        resources=t.ResourceRequirements(
+                            requests={"cpu": 0.5}),
+                        tpu_requests=["tpu"])]))
+        pod.spec.tpu_resources = [t.PodTpuRequest(name="tpu",
+                                                  chips=chips_per_pod)]
+        pod.spec.gang = name
+        if priority:
+            pod.spec.priority = priority
+        pods.append(pod)
+    return group, pods
+
+
+async def _wait(predicate, deadline: float, what: str) -> None:
+    loop = asyncio.get_running_loop()
+    while not predicate():
+        if loop.time() > deadline:
+            raise AssertionError(f"queue smoke timeout: {what}")
+        await asyncio.sleep(0.05)
+
+
+async def run_queue_smoke(timeout: float = 30.0,
+                          flood: int = 10) -> dict:
+    """The scripted scenario (see module docstring)."""
+    t0 = time.perf_counter()
+    was_on = GATES.enabled("JobQueueing")
+    # Everything after the flip sits inside the try: an exception in
+    # setup must not leak the process-global gate on.
+    GATES.set("JobQueueing", True)
+    sched = qc = factory = None
+    try:
+        reg = Registry()
+        reg.admission = default_chain(reg)
+        reg.create(t.Namespace(metadata=ObjectMeta(name="default")))
+        from ..perf.gang_bench import build_slice
+        build_slice(reg, 0)  # 4x4x4 = 64 chips over 16 hosts
+        client = LocalClient(reg)
+        for obj in make_queues(nominal_chips=32.0):
+            reg.create(obj)
+        factory = InformerFactory(client)
+        sched = Scheduler(client, backoff_seconds=0.2,
+                          informer_factory=factory)
+        qc = QueueController(client, factory, fits_probe=lambda g: True)
+        loop = asyncio.get_running_loop()
+        await sched.start()
+        await qc.start()
+
+        def bound_gangs(ns: str) -> set:
+            pods, _ = reg.list("pods", ns)
+            out: dict = {}
+            for p in pods:
+                if p.spec.node_name and t.is_pod_active(p):
+                    out.setdefault(p.spec.gang, 0)
+                    out[p.spec.gang] += 1
+            return {g for g, n in out.items() if n >= 2}
+
+        def groups(ns: str) -> list:
+            gs, _ = reg.list("podgroups", ns)
+            return gs
+
+        # Phase 1: tenant A floods. Nominal 32 + borrow up to the
+        # 64-chip cohort -> exactly 8 of the 10 gangs admit and bind.
+        for i in range(flood):
+            group, pods = make_gang(f"flood-{i:02d}", "tenant-a", "queue-a")
+            await client.create(group)
+            for pod in pods:
+                await client.create(pod)
+        await _wait(lambda: len(bound_gangs("tenant-a")) >= 8,
+                    loop.time() + timeout, "tenant A's 8 gangs bound")
+        a_admitted = [g for g in groups("tenant-a") if g.status.admitted]
+        a_pending = [g for g in groups("tenant-a") if not g.status.admitted]
+        assert len(a_admitted) == 8, f"A admitted {len(a_admitted)} != 8"
+        assert len(a_pending) == flood - 8
+        borrowed_modes = [g.status.admission_mode for g in a_admitted]
+        assert borrowed_modes.count("Borrowed") == 4, (
+            f"expected 4 borrowed admissions, got {borrowed_modes}")
+
+        # Phase 2: tenant B's single gang forces reclaim.
+        group, pods = make_gang("bee-00", "tenant-b", "queue-b")
+        await client.create(group)
+        for pod in pods:
+            await client.create(pod)
+        await _wait(lambda: "bee-00" in bound_gangs("tenant-b"),
+                    loop.time() + timeout, "tenant B's gang bound")
+
+        # Reclaim happened: exactly one borrowed A gang back to pending,
+        # requeued not orphaned; A's backlog still pending.
+        a_groups = groups("tenant-a")
+        a_admitted = [g for g in a_groups if g.status.admitted]
+        a_pending = [g for g in a_groups if not g.status.admitted]
+        assert len(a_groups) == flood, "reclaim orphaned a PodGroup"
+        assert len(a_admitted) == 7, f"A admitted {len(a_admitted)} != 7"
+        assert len(a_pending) == flood - 7
+        reclaimed = [g for g in a_pending
+                     if any(p.metadata.deletion_timestamp is not None
+                            for p in reg.list("pods", "tenant-a")[0]
+                            if p.spec.gang == g.metadata.name)]
+        assert reclaimed, "no gang shows evicted members (reclaim missing)"
+        for g in a_pending:
+            assert g.status.phase == t.PODGROUP_PENDING
+            assert g.status.admission_mode == ""
+
+        # Conservation: cohort usage never exceeds cohort nominal.
+        usage = sum(8.0 for g in a_admitted) + 8.0
+        assert usage <= 64.0 + 1e-9, f"cohort over-committed: {usage}"
+
+        # Queue statuses converged (controller publishes counts).
+        await _wait(
+            lambda: (reg.get("clusterqueues", "", "team-b").status.admitted
+                     == 1),
+            loop.time() + timeout, "team-b status.admitted == 1")
+        cq_a = reg.get("clusterqueues", "", "team-a")
+        return {
+            "a_admitted": len(a_admitted),
+            "a_pending": len(a_pending),
+            "b_bound": True,
+            "reclaimed_gangs": len(reclaimed),
+            "team_a_borrowed": dict(cq_a.status.borrowed),
+            "elapsed_s": round(time.perf_counter() - t0, 2),
+        }
+    finally:
+        if qc is not None:
+            await qc.stop()
+        if sched is not None:
+            await sched.stop()
+        if factory is not None:
+            await factory.stop_all()  # last: the scheduler rides it too
+        if not was_on:
+            GATES.set("JobQueueing", False)

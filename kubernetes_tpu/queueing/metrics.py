@@ -1,0 +1,48 @@
+"""Queueing metrics — the ``queue_*`` family.
+
+Covered by the tpuvet metric-name pass fixtures like the batch/chaos
+families; the admission-wait histogram retains raw samples so the gang
+bench's ``--queued`` stanza reports true percentiles, not bucket edges.
+"""
+from ..metrics.registry import Counter, Gauge, Histogram
+
+_WAIT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 300.0, 1800.0)
+
+QUEUE_PENDING = Gauge(
+    "queue_pending_gangs",
+    "Gangs waiting for admission per ClusterQueue",
+    labels=("queue",))
+
+QUEUE_ADMITTED = Gauge(
+    "queue_admitted_gangs",
+    "Gangs currently admitted per ClusterQueue",
+    labels=("queue",))
+
+QUEUE_BORROWED = Gauge(
+    "queue_borrowed_resources",
+    "Usage above nominal quota (lent by the cohort) per queue+resource",
+    labels=("queue", "resource"))
+
+QUEUE_USAGE = Gauge(
+    "queue_resource_usage",
+    "Admitted usage per ClusterQueue and resource",
+    labels=("queue", "resource"))
+
+ADMISSION_WAIT = Histogram(
+    "queue_admission_wait_seconds",
+    "PodGroup create to admission latency",
+    buckets=_WAIT_BUCKETS,
+    # Raw samples: the --queued gang bench reports true p50/p99.
+    sample_limit=100_000)
+
+ADMISSIONS = Counter(
+    "queue_admissions_total",
+    "Gang admissions by queue and mode (Nominal|Borrowed|Backfill)",
+    labels=("queue", "mode"))
+
+RECLAIMS = Counter(
+    "queue_reclaimed_gangs_total",
+    "Borrowed gangs preempted back to pending when the lender's demand "
+    "returned, per (victim) queue",
+    labels=("queue",))

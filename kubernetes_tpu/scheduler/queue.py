@@ -46,6 +46,10 @@ class SchedulingQueue:
         #: gang key -> pod keys already bound. Quorum counts bound +
         #: staged so a partially-bound gang keeps releasing its remainder.
         self._gang_bound: dict[str, set[str]] = {}
+        #: Gangs held back by queue admission (queueing/): a suspended
+        #: gang's members stage as usual but the GangUnit NEVER enters
+        #: the heap until admission clears the flag.
+        self._gang_suspended: set[str] = set()
         self._closed = False
         #: Strong refs to in-flight wake tasks (the loop holds tasks
         #: only weakly; an unreferenced notify task can vanish before
@@ -84,6 +88,25 @@ class SchedulingQueue:
         if self._maybe_release_gang(group_key):
             self._wake_soon()
 
+    def set_gang_suspended(self, group_key: str, suspended: bool) -> None:
+        """Admission gate (sync informer context). Suspending cancels
+        any already-released (unpopped) gang unit; releasing
+        re-evaluates quorum and wakes the consumer — the
+        admission-release wake path."""
+        if suspended:
+            if group_key in self._gang_suspended:
+                return
+            self._gang_suspended.add(group_key)
+            ge = self._entries.pop(f"gang:{group_key}", None)
+            if ge is not None:
+                ge.cancelled = True
+        else:
+            if group_key not in self._gang_suspended:
+                return
+            self._gang_suspended.discard(group_key)
+            if self._maybe_release_gang(group_key):
+                self._wake_soon()
+
     def _maybe_release_gang(self, gk: str) -> bool:
         """Push the gang unit if quorum is staged; True when pushed.
         SYNC callers (informer handlers) must then :meth:`_wake_soon`
@@ -91,6 +114,8 @@ class SchedulingQueue:
         non-empty heap whenever the PodGroup's watch event arrived
         AFTER its pods (a relist after a dropped watch reorders
         exactly that way; found by the chaos harness)."""
+        if gk in self._gang_suspended:
+            return False  # unadmitted: the admission gate (queueing/)
         staged = self._gangs.get(gk)
         need = self._gang_min.get(gk)
         bound = len(self._gang_bound.get(gk, ()))

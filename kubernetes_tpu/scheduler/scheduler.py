@@ -36,6 +36,17 @@ from .queue import GangUnit, SchedulingQueue
 log = logging.getLogger("scheduler")
 
 
+def group_suspended(group: t.PodGroup) -> bool:
+    """Queue-admission suspend gate: a PodGroup bound to a LocalQueue
+    stays out of the scheduling heap until the QueueController admits
+    it. With the JobQueueing gate off, ``spec.queue`` is ignored and
+    behavior is byte-identical to the ungated build."""
+    if not group.spec.queue or group.status.admitted:
+        return False
+    from ..util.features import GATES
+    return GATES.enabled("JobQueueing")
+
+
 class _BindCoalescer:
     """Size/time-windowed batcher for ``_schedule_one``'s async binds.
 
@@ -150,8 +161,16 @@ class _BindCoalescer:
 
 class Scheduler:
     def __init__(self, client: Client, name: str = "default-scheduler",
-                 backoff_seconds: float = 1.0, policy=None):
+                 backoff_seconds: float = 1.0, policy=None,
+                 informer_factory=None):
         self.client = client
+        #: Optional shared InformerFactory (reference: the scheduler
+        #: rides the controller-manager's SharedInformerFactory). When
+        #: given, pods/nodes/podgroups informers come from it — one
+        #: decode per watch event instead of one per component — and
+        #: their lifecycle belongs to the factory owner, not stop().
+        self._factory = informer_factory
+        self._owns_informers = informer_factory is None
         self.name = name
         #: Policy file selection of predicates/priorities/extenders
         #: (policy.py; reference factory.go CreateFromConfig). Fixed for
@@ -197,7 +216,21 @@ class Scheduler:
         # tail at density scale.
         from ..util.gctune import tune_control_plane_gc
         tune_control_plane_gc()
-        pods = SharedInformer(self.client, "pods")
+        if self._factory is not None:
+            pods = self._factory.informer("pods")
+            nodes = self._factory.informer("nodes")
+            groups = self._factory.informer("podgroups")
+        else:
+            pods = SharedInformer(self.client, "pods")
+            nodes = SharedInformer(self.client, "nodes")
+            groups = SharedInformer(self.client, "podgroups")
+        # A shared informer that synced BEFORE our handlers were added
+        # never replays its store to them — without this, a scheduler
+        # riding an already-running factory starts with an empty cache
+        # and nothing ever schedules.
+        replay_nodes = nodes.has_synced
+        replay_pods = pods.has_synced
+        replay_groups = groups.has_synced
         pods.add_handlers(on_add=self._pod_added, on_update=self._pod_updated,
                           on_delete=self._pod_deleted)
         # Gang membership lookups are by_index, not full-store scans —
@@ -206,19 +239,27 @@ class Scheduler:
             "gang", lambda p: ([f"{p.metadata.namespace}/{p.spec.gang}"]
                                if p.spec.gang else []))
         self._pod_informer = pods
-        nodes = SharedInformer(self.client, "nodes")
         nodes.add_handlers(on_add=lambda n: self.cache.set_node(n),
                            on_update=lambda o, n: self.cache.set_node(n),
                            on_delete=lambda n: self.cache.remove_node(n.metadata.name))
-        groups = SharedInformer(self.client, "podgroups")
         groups.add_handlers(on_add=self._group_changed_add,
                             on_update=self._group_changed,
                             on_delete=self._group_deleted)
         self._informers = [pods, nodes, groups]
         for inf in self._informers:
-            inf.start()
+            if inf._task is None:
+                inf.start()
         for inf in self._informers:
             await inf.wait_for_sync()
+        if replay_nodes:
+            for n in nodes.list():
+                self.cache.set_node(n)
+        if replay_pods:
+            for p in pods.list():
+                self._pod_added(p)
+        if replay_groups:
+            for g in groups.list():
+                self._group_changed_add(g)
         self._task = asyncio.get_running_loop().create_task(self._run())
 
     async def stop(self) -> None:
@@ -245,8 +286,9 @@ class Scheduler:
             except Exception as e:  # noqa: BLE001
                 log.warning("extender %s: close failed: %s",
                             getattr(ext, "name", ext), e)
-        for inf in self._informers:
-            await inf.stop()
+        if self._owns_informers:
+            for inf in self._informers:
+                await inf.stop()
 
     # -- informer handlers ------------------------------------------------
 
@@ -284,9 +326,15 @@ class Scheduler:
         self._group_changed(None, group)
 
     def _group_changed(self, old, group: t.PodGroup) -> None:
+        # Admission gate first: an unadmitted queued gang must never be
+        # releasable, and flipping admitted -> suspended (quota reclaim)
+        # must cancel an already-released unit before set_gang_min could
+        # re-release it.
+        self.queue.set_gang_suspended(group.key(), group_suspended(group))
         self.queue.set_gang_min(group.key(), group.spec.min_member)
 
     def _group_deleted(self, group: t.PodGroup) -> None:
+        self.queue.set_gang_suspended(group.key(), False)
         self.cache.release_reservation(group.key())
         # A gang deleted mid-preemption must not leave a stale clock
         # that a future same-named gang would observe as an hours-long
@@ -863,6 +911,12 @@ class Scheduler:
             group = await self.client.get("podgroups", ns, name)
         except errors.NotFoundError:
             self._preempt_started.pop(unit.group_key, None)
+            return
+        if group_suspended(group):
+            # Raced a quota reclaim (suspension landed after this unit
+            # was popped): park the members; the admission-release wake
+            # path re-releases the gang when it is admitted again.
+            self.queue.set_gang_suspended(unit.group_key, True)
             return
         # The gang planner does not consult extenders; silently
         # bypassing a NON-ignorable one would double-book whatever

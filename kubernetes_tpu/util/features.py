@@ -68,6 +68,12 @@ KNOWN_FEATURES = {f.name: f for f in [
     Feature("AuditLogging", True, BETA,
             "structured request audit capability; actual logging still "
             "requires an --audit-log path"),
+    Feature("JobQueueing", False, ALPHA,
+            "multi-tenant fair-share admission for gang jobs: "
+            "ClusterQueue/LocalQueue quotas, DRF ordering, cohort "
+            "borrowing with gang-aware reclaim, and backfill "
+            "(queueing/ + controllers/queue.py); off = PodGroups "
+            "race straight into the scheduling queue as before"),
 ]}
 
 

@@ -1,0 +1,535 @@
+"""Multi-tenant queueing over the in-process control plane.
+
+The acceptance scenarios for ISSUE 5: two-tenant starvation with
+borrowing + gang-aware reclaim (the shared harness), the suspend gate
+and admission-release wake path in the scheduler, EASY backfill, the
+feature-gate-off identity guarantee, and the gang Job passthrough.
+"""
+import asyncio
+
+import pytest
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.api.queueing import (ClusterQueue, ClusterQueueSpec,
+                                         LocalQueue, LocalQueueSpec,
+                                         RUNTIME_ANNOTATION)
+from kubernetes_tpu.apiserver.admission import default_chain
+from kubernetes_tpu.apiserver.registry import Registry
+from kubernetes_tpu.client.informer import InformerFactory
+from kubernetes_tpu.client.local import LocalClient
+from kubernetes_tpu.controllers.queue import QueueController
+from kubernetes_tpu.perf.gang_bench import build_slice
+from kubernetes_tpu.queueing.harness import make_gang, run_queue_smoke
+from kubernetes_tpu.scheduler.scheduler import Scheduler
+from kubernetes_tpu.util.features import GATES
+
+
+@pytest.fixture
+def gate_on():
+    was = GATES.enabled("JobQueueing")
+    GATES.set("JobQueueing", True)
+    yield
+    GATES.set("JobQueueing", was)
+
+
+def _registry() -> Registry:
+    reg = Registry()
+    reg.admission = default_chain(reg)
+    reg.create(t.Namespace(metadata=ObjectMeta(name="default")))
+    build_slice(reg, 0)  # 64 chips / 16 hosts
+    return reg
+
+
+async def _wait(predicate, what: str, timeout: float = 15.0):
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + timeout
+    while not predicate():
+        if loop.time() > deadline:
+            raise AssertionError(f"timeout: {what}")
+        await asyncio.sleep(0.05)
+
+
+def _bound_count(reg, ns, gang):
+    pods, _ = reg.list("pods", ns)
+    return sum(1 for p in pods
+               if p.spec.gang == gang and p.spec.node_name
+               and t.is_pod_active(p))
+
+
+async def test_two_tenant_starvation_and_reclaim():
+    """The shared acceptance scenario: tenant A's flood borrows B's
+    idle quota; B's single gang triggers reclaim and binds while A's
+    backlog is still pending; the reclaimed gang is requeued, not
+    orphaned. (Same code path hack/queue_smoke.sh gates in CI.)"""
+    report = await run_queue_smoke(timeout=30.0)
+    assert report["b_bound"]
+    assert report["a_pending"] >= 2
+    assert report["reclaimed_gangs"] >= 1
+    assert report["team_a_borrowed"] == {t.RESOURCE_TPU: 24.0}
+
+
+async def test_suspend_gate_and_admission_release_wake(gate_on):
+    """No QueueController at all: a queued gang must park outside the
+    scheduling heap; flipping status.admitted over the API is the
+    admission-release wake path that lets it bind."""
+    reg = _registry()
+    client = LocalClient(reg)
+    reg.create(ClusterQueue(metadata=ObjectMeta(name="team-a"),
+                            spec=ClusterQueueSpec(
+                                nominal_quota={t.RESOURCE_TPU: 64.0})))
+    reg.create(LocalQueue(metadata=ObjectMeta(name="lq", namespace="default"),
+                          spec=LocalQueueSpec(cluster_queue="team-a")))
+    sched = Scheduler(client, backoff_seconds=0.2)
+    await sched.start()
+    try:
+        group, pods = make_gang("gated-00", "default", "lq")
+        await client.create(group)
+        for pod in pods:
+            await client.create(pod)
+        await asyncio.sleep(0.5)  # would be long enough to bind unqueued
+        assert _bound_count(reg, "default", "gated-00") == 0, \
+            "suspended gang entered the scheduling heap"
+        assert len(sched.queue) == 0
+        cur = await client.get("podgroups", "default", "gated-00")
+        cur.status.admitted = True
+        cur.status.admission_mode = "Nominal"
+        await client.update_status(cur)
+        await _wait(lambda: _bound_count(reg, "default", "gated-00") == 2,
+                    "admitted gang bound after release")
+    finally:
+        await sched.stop()
+
+
+async def test_gate_off_byte_identical():
+    """JobQueueing off (the default): a PodGroup carrying spec.queue
+    schedules immediately — no admission, no status mutation — exactly
+    today's behavior."""
+    assert not GATES.enabled("JobQueueing")
+    reg = _registry()
+    client = LocalClient(reg)
+    sched = Scheduler(client, backoff_seconds=0.2)
+    await sched.start()
+    try:
+        group, pods = make_gang("ungated-00", "default", "some-queue")
+        await client.create(group)
+        for pod in pods:
+            await client.create(pod)
+        await _wait(lambda: _bound_count(reg, "default", "ungated-00") == 2,
+                    "gate-off gang bound without admission")
+        cur = await client.get("podgroups", "default", "ungated-00")
+        assert cur.status.admitted is False
+        assert cur.status.admission_mode == ""
+        assert cur.status.admitted_time is None
+    finally:
+        await sched.stop()
+
+
+async def test_gate_flip_retro_admits_bound_gangs():
+    """Enabling JobQueueing over a live cluster must not evict healthy
+    running gangs: a gang bound while the gate was OFF is unadmitted +
+    queued + holding chips — exactly what the reclaim sweep repairs —
+    so the first admission pass has to retro-admit it (quota allowing)
+    BEFORE the sweep gets to evict its members."""
+    assert not GATES.enabled("JobQueueing")
+    reg = _registry()
+    client = LocalClient(reg)
+    reg.create(ClusterQueue(metadata=ObjectMeta(name="team-a"),
+                            spec=ClusterQueueSpec(
+                                nominal_quota={t.RESOURCE_TPU: 64.0})))
+    reg.create(LocalQueue(metadata=ObjectMeta(name="lq", namespace="default"),
+                          spec=LocalQueueSpec(cluster_queue="team-a")))
+    sched = Scheduler(client, backoff_seconds=0.2)
+    await sched.start()
+    qc = factory = None
+    try:
+        group, pods = make_gang("legacy-00", "default", "lq")
+        await client.create(group)
+        for pod in pods:
+            await client.create(pod)
+        await _wait(lambda: _bound_count(reg, "default", "legacy-00") == 2,
+                    "gang bound with the gate off")
+        GATES.set("JobQueueing", True)
+        factory = InformerFactory(client)
+        qc = QueueController(client, factory)
+        await qc.start()
+        await _wait(lambda: reg.get("podgroups", "default",
+                                    "legacy-00").status.admitted,
+                    "bound gang retro-admitted on gate flip")
+        assert _bound_count(reg, "default", "legacy-00") == 2, \
+            "gate flip evicted a healthy running gang"
+        pods_now, _ = reg.list("pods", "default")
+        assert all(p.metadata.deletion_timestamp is None for p in pods_now
+                   if p.spec.gang == "legacy-00")
+    finally:
+        if qc is not None:
+            await qc.stop()
+        if factory is not None:
+            await factory.stop_all()
+        await sched.stop()
+        GATES.set("JobQueueing", False)
+
+
+async def test_scheduler_rides_prestarted_factory(gate_on):
+    """A scheduler given an InformerFactory whose informers already
+    ran and synced must replay their stores into its cache/queue —
+    otherwise it starts blind (empty node cache) and never schedules."""
+    reg = _registry()
+    client = LocalClient(reg)
+    reg.create(ClusterQueue(metadata=ObjectMeta(name="team-a"),
+                            spec=ClusterQueueSpec(
+                                nominal_quota={t.RESOURCE_TPU: 64.0})))
+    reg.create(LocalQueue(metadata=ObjectMeta(name="lq", namespace="default"),
+                          spec=LocalQueueSpec(cluster_queue="team-a")))
+    factory = InformerFactory(client)
+    for plural in ("pods", "nodes", "podgroups"):
+        factory.informer(plural)
+    factory.start_all()
+    await factory.wait_for_sync()
+    sched = Scheduler(client, backoff_seconds=0.2,
+                      informer_factory=factory)
+    qc = QueueController(client, factory)
+    await sched.start()
+    await qc.start()
+    try:
+        group, pods = make_gang("late-00", "default", "lq")
+        await client.create(group)
+        for pod in pods:
+            await client.create(pod)
+        await _wait(lambda: _bound_count(reg, "default", "late-00") == 2,
+                    "gang bound by a scheduler on a pre-started factory")
+    finally:
+        await qc.stop()
+        await sched.stop()
+        await factory.stop_all()
+
+
+async def test_make_gang_priority_reaches_the_group():
+    """make_gang(priority=) must stamp the PodGroup spec (the input to
+    DRF ordering and reclaim pricing), not just the member pods."""
+    group, pods = make_gang("prio-00", "default", "lq", priority=7)
+    assert group.spec.priority == 7
+    assert all(p.spec.priority == 7 for p in pods)
+
+
+async def test_gate_flip_spares_dangling_queue_ref():
+    """A gang bound while the gate was off whose spec.queue resolves to
+    nothing (validation permits the name ungated) is UNGOVERNED: the
+    admission pass suspends it rather than retro-admits, so the startup
+    reclaim sweep must not seed it — else gate-enable + restart evicts
+    a healthy running gang with no path back to admission."""
+    assert not GATES.enabled("JobQueueing")
+    reg = _registry()
+    client = LocalClient(reg)
+    sched = Scheduler(client, backoff_seconds=0.2)
+    await sched.start()
+    qc = factory = None
+    try:
+        group, pods = make_gang("orphan-00", "default", "no-such-queue")
+        await client.create(group)
+        for pod in pods:
+            await client.create(pod)
+        await _wait(lambda: _bound_count(reg, "default", "orphan-00") == 2,
+                    "gang bound with the gate off")
+        GATES.set("JobQueueing", True)
+        factory = InformerFactory(client)
+        qc = QueueController(client, factory)
+        await qc.start()
+        await asyncio.sleep(1.0)  # several passes + sweeps
+        assert _bound_count(reg, "default", "orphan-00") == 2, \
+            "gate flip evicted a gang with a dangling queue ref"
+        pods_now, _ = reg.list("pods", "default")
+        assert all(p.metadata.deletion_timestamp is None for p in pods_now
+                   if p.spec.gang == "orphan-00")
+        cur = await client.get("podgroups", "default", "orphan-00")
+        assert cur.status.admitted is False  # suspended, not admitted
+    finally:
+        if qc is not None:
+            await qc.stop()
+        if factory is not None:
+            await factory.stop_all()
+        await sched.stop()
+        GATES.set("JobQueueing", False)
+
+
+async def test_backfill_jumps_blocked_head(gate_on):
+    """EASY backfill: with the head-of-line gang blocked on quota, a
+    small bounded-runtime gang jumps it (mode=Backfill); an
+    unbounded-runtime sibling does not."""
+    reg = _registry()
+    client = LocalClient(reg)
+    reg.create(ClusterQueue(metadata=ObjectMeta(name="team-a"),
+                            spec=ClusterQueueSpec(
+                                nominal_quota={t.RESOURCE_TPU: 12.0})))
+    reg.create(LocalQueue(metadata=ObjectMeta(name="lq", namespace="default"),
+                          spec=LocalQueueSpec(cluster_queue="team-a")))
+    sched = Scheduler(client, backoff_seconds=0.2)
+    factory = InformerFactory(client)
+    qc = QueueController(client, factory)
+    await sched.start()
+    await qc.start()
+    try:
+        # g0: 8 chips, long but BOUNDED runtime -> shadow is computable.
+        g0, p0 = make_gang("long-00", "default", "lq")
+        g0.metadata.annotations[RUNTIME_ANNOTATION] = "3600"
+        await client.create(g0)
+        for p in p0:
+            await client.create(p)
+        await _wait(lambda: _bound_count(reg, "default", "long-00") == 2,
+                    "g0 admitted and bound")
+
+        # Head blocker: 8 chips > 4 free quota. Submitted FIRST so it
+        # owns the head of the DRF order.
+        blocker, bp = make_gang("blocked-00", "default", "lq")
+        await client.create(blocker)
+        for p in bp:
+            await client.create(p)
+        await asyncio.sleep(0.3)
+
+        # Small candidates behind it: one with a short runtime (fits
+        # before the blocker's shadow), one unbounded. 2 members x
+        # 2 chips: a [2,2,1] box binds whether it lands on one host
+        # tile or splits across two.
+        def small_gang(name, runtime=None):
+            return make_gang(name, "default", "lq",
+                             shape=[2, 2, 1], chips_per_pod=2,
+                             runtime=runtime)
+
+        sg, sp = small_gang("short-00", runtime=60)
+        ug, up = small_gang("unbounded-00")
+        await client.create(ug)
+        for p in up:
+            await client.create(p)
+        await client.create(sg)
+        for p in sp:
+            await client.create(p)
+
+        await _wait(lambda: _bound_count(reg, "default", "short-00") == 2,
+                    "bounded candidate backfilled")
+        cur = await client.get("podgroups", "default", "short-00")
+        # Labeled by QUOTA position (within nominal here — not a
+        # reclaim candidate); the jump itself is the event's story.
+        assert cur.status.admitted and cur.status.admission_mode == "Nominal"
+        blocked = await client.get("podgroups", "default", "blocked-00")
+        assert not blocked.status.admitted, "blocker lost its place"
+        unbounded = await client.get("podgroups", "default", "unbounded-00")
+        assert not unbounded.status.admitted, \
+            "unbounded-runtime gang must not backfill"
+    finally:
+        await qc.stop()
+        await factory.stop_all()
+        await sched.stop()
+
+
+async def test_job_gang_queue_passthrough(gate_on):
+    """JobSpec.gang.queue + activeDeadlineSeconds flow onto the
+    materialized PodGroup (spec.queue + runtime annotation), so gang
+    Jobs ride admission with zero extra plumbing."""
+    from kubernetes_tpu.api import workloads as w
+    from kubernetes_tpu.controllers.job import JobController
+    reg = _registry()
+    client = LocalClient(reg)
+    reg.create(ClusterQueue(metadata=ObjectMeta(name="team-a"),
+                            spec=ClusterQueueSpec(
+                                nominal_quota={t.RESOURCE_TPU: 64.0})))
+    reg.create(LocalQueue(metadata=ObjectMeta(name="lq", namespace="default"),
+                          spec=LocalQueueSpec(cluster_queue="team-a")))
+    factory = InformerFactory(client)
+    jc = JobController(client, factory)
+    await jc.start()
+    try:
+        job = w.Job(metadata=ObjectMeta(name="train", namespace="default"),
+                    spec=w.JobSpec(
+                        parallelism=2,
+                        active_deadline_seconds=900,
+                        template=t.PodTemplateSpec(spec=t.PodSpec(
+                            containers=[t.Container(name="c", image="i")])),
+                        gang=w.GangPolicy(min_member=2,
+                                          slice_shape=[2, 2, 2],
+                                          queue="lq")))
+        await client.create(job)
+
+        def group_ready():
+            try:
+                g = reg.get("podgroups", "default", "job-train")
+            except Exception:  # noqa: BLE001
+                return False
+            return g.spec.queue == "lq"
+
+        await _wait(group_ready, "PodGroup carries the Job's queue")
+        g = reg.get("podgroups", "default", "job-train")
+        assert g.metadata.annotations[RUNTIME_ANNOTATION] == "900"
+    finally:
+        await jc.stop()
+        await factory.stop_all()
+
+
+async def test_blocked_cohort_does_not_freeze_others(gate_on):
+    """Head-of-line blocking is per cohort: a gang blocked (or outright
+    inadmissible) in one cohort must not stop a runtime-less gang in an
+    unrelated cohort from admitting into its own idle quota."""
+    reg = _registry()
+    client = LocalClient(reg)
+    # Cohort east: 4-chip quota, will receive an inadmissible 8-chip
+    # gang. Cohort west: idle 32-chip quota.
+    reg.create(ClusterQueue(metadata=ObjectMeta(name="east"),
+                            spec=ClusterQueueSpec(
+                                cohort="east",
+                                nominal_quota={t.RESOURCE_TPU: 4.0})))
+    reg.create(ClusterQueue(metadata=ObjectMeta(name="west"),
+                            spec=ClusterQueueSpec(
+                                cohort="west",
+                                nominal_quota={t.RESOURCE_TPU: 32.0})))
+    reg.create(LocalQueue(metadata=ObjectMeta(name="lq-east",
+                                              namespace="default"),
+                          spec=LocalQueueSpec(cluster_queue="east")))
+    reg.create(LocalQueue(metadata=ObjectMeta(name="lq-west",
+                                              namespace="default"),
+                          spec=LocalQueueSpec(cluster_queue="west")))
+    sched = Scheduler(client, backoff_seconds=0.2)
+    factory = InformerFactory(client)
+    qc = QueueController(client, factory)
+    await sched.start()
+    await qc.start()
+    try:
+        # 8-chip demand into a 4-chip no-borrow cohort: inadmissible.
+        stuck, sp = make_gang("stuck-00", "default", "lq-east")
+        await client.create(stuck)
+        for p in sp:
+            await client.create(p)
+        # Plain gang, NO runtime annotation, different cohort.
+        ok, op = make_gang("fine-00", "default", "lq-west")
+        await client.create(ok)
+        for p in op:
+            await client.create(p)
+        await _wait(lambda: _bound_count(reg, "default", "fine-00") == 2,
+                    "unrelated cohort admitted despite the stuck gang")
+        cur = await client.get("podgroups", "default", "stuck-00")
+        assert not cur.status.admitted
+    finally:
+        await qc.stop()
+        await factory.stop_all()
+        await sched.stop()
+
+
+async def test_admitted_usage_survives_localqueue_deletion(gate_on):
+    """Deleting a LocalQueue must not vanish admitted usage: the gang
+    still holds chips, and the charge target was stamped at admission
+    (status.admission_cluster_queue)."""
+    reg = _registry()
+    client = LocalClient(reg)
+    reg.create(ClusterQueue(metadata=ObjectMeta(name="team-a"),
+                            spec=ClusterQueueSpec(
+                                nominal_quota={t.RESOURCE_TPU: 8.0})))
+    reg.create(LocalQueue(metadata=ObjectMeta(name="lq", namespace="default"),
+                          spec=LocalQueueSpec(cluster_queue="team-a")))
+    sched = Scheduler(client, backoff_seconds=0.2)
+    factory = InformerFactory(client)
+    qc = QueueController(client, factory)
+    await sched.start()
+    await qc.start()
+    try:
+        group, pods = make_gang("pinned-00", "default", "lq")
+        await client.create(group)
+        for pod in pods:
+            await client.create(pod)
+        await _wait(lambda: _bound_count(reg, "default", "pinned-00") == 2,
+                    "gang admitted and bound")
+        await client.delete("localqueues", "default", "lq")
+        await _wait(
+            lambda: not [lq for lq in reg.list("localqueues", "default")[0]],
+            "localqueue gone")
+        await asyncio.sleep(1.5)  # a few admission passes
+        cq = reg.get("clusterqueues", "", "team-a")
+        assert cq.status.usage.get(t.RESOURCE_TPU) == 8.0, (
+            "admitted usage vanished with the LocalQueue: "
+            f"{cq.status.usage}")
+        assert cq.status.admitted == 1
+    finally:
+        await qc.stop()
+        await factory.stop_all()
+        await sched.stop()
+
+
+async def test_completed_gang_job_releases_quota(gate_on):
+    """A PodGroup's lifetime IS the quota hold: when a gang Job
+    completes, the Job controller deletes the group, so the tenant's
+    admitted usage drops and the next pending gang admits. Without the
+    teardown, finished gangs would pin quota forever."""
+    from kubernetes_tpu.api import workloads as w
+    from kubernetes_tpu.controllers.job import JobController
+    reg = _registry()
+    client = LocalClient(reg)
+    reg.create(ClusterQueue(metadata=ObjectMeta(name="team-a"),
+                            spec=ClusterQueueSpec(
+                                nominal_quota={t.RESOURCE_TPU: 8.0})))
+    reg.create(LocalQueue(metadata=ObjectMeta(name="lq", namespace="default"),
+                          spec=LocalQueueSpec(cluster_queue="team-a")))
+    factory = InformerFactory(client)
+    jc = JobController(client, factory)
+    qc = QueueController(client, factory)
+    await jc.start()
+    await qc.start()
+    try:
+        def mk_job(name):
+            return w.Job(
+                metadata=ObjectMeta(name=name, namespace="default"),
+                spec=w.JobSpec(
+                    parallelism=1, completions=1,
+                    template=t.PodTemplateSpec(spec=t.PodSpec(
+                        containers=[t.Container(name="c", image="i")])),
+                    gang=w.GangPolicy(min_member=1, slice_shape=[2, 2, 2],
+                                      queue="lq")))
+
+        await client.create(mk_job("first"))
+
+        def admitted(name):
+            try:
+                return reg.get("podgroups", "default", name).status.admitted
+            except Exception:  # noqa: BLE001
+                return False
+
+        await _wait(lambda: admitted("job-first"), "first gang admitted")
+        # Second gang: quota full (8/8 chips), must wait.
+        await client.create(mk_job("second"))
+        await asyncio.sleep(0.3)
+        assert not admitted("job-second"), "admitted past a full quota"
+
+        # Finish the first job: its pod succeeds.
+        pods, _ = reg.list("pods", "default")
+        for p in pods:
+            if p.metadata.labels.get("job.tpu/name") == "first":
+                p.status.phase = "Succeeded"
+                await client.update_status(p)
+        await _wait(lambda: admitted("job-second"),
+                    "second gang admitted after first completed")
+        with pytest.raises(Exception):
+            reg.get("podgroups", "default", "job-first")
+    finally:
+        await jc.stop()
+        await qc.stop()
+        await factory.stop_all()
+
+
+async def test_default_localqueue_admission_plugin(gate_on):
+    """A namespace default LocalQueue (annotation) is stamped onto
+    PodGroups created without spec.queue; dangling queue refs are
+    rejected at create."""
+    from kubernetes_tpu.api import errors
+    from kubernetes_tpu.api.queueing import DEFAULT_QUEUE_ANNOTATION
+    reg = _registry()
+    reg.create(ClusterQueue(metadata=ObjectMeta(name="team-a"),
+                            spec=ClusterQueueSpec(
+                                nominal_quota={t.RESOURCE_TPU: 64.0})))
+    reg.create(LocalQueue(
+        metadata=ObjectMeta(name="lq", namespace="default",
+                            annotations={DEFAULT_QUEUE_ANNOTATION: "true"}),
+        spec=LocalQueueSpec(cluster_queue="team-a")))
+    created = reg.create(t.PodGroup(
+        metadata=ObjectMeta(name="auto", namespace="default"),
+        spec=t.PodGroupSpec(min_member=1)))
+    assert created.spec.queue == "lq"
+    with pytest.raises(errors.BadRequestError):
+        reg.create(t.PodGroup(
+            metadata=ObjectMeta(name="dangling", namespace="default"),
+            spec=t.PodGroupSpec(min_member=1, queue="no-such-queue")))

@@ -3,7 +3,8 @@ CI-friendly scale — the contiguity verification is the point."""
 import pytest
 
 from kubernetes_tpu.perf.gang_bench import (_is_contiguous_box,
-                                            run_gang_bench)
+                                            run_gang_bench,
+                                            run_queued_gang_bench)
 
 
 async def test_gang_bench_small_fleet():
@@ -24,6 +25,19 @@ async def test_gang_bench_small_fleet():
     assert pre["gangs_per_second"] > 0.5
     assert pre["preempt_to_bound_p99_ms"] >= pre["preempt_to_bound_p50_ms"] > 0
     assert pre["decision_to_bound_p99_ms"] > 0
+
+
+async def test_gang_bench_queued_stanza():
+    """The --queued stanza: the same wave through fair-share admission
+    — every gang admitted (two tenants, DRF order), bound, with TRUE
+    admission-wait percentiles in the report."""
+    result = await run_queued_gang_bench(n_slices=2, n_gangs=8, timeout=60)
+    assert result["admitted"] == 8
+    assert sum(result["admission_modes"].values()) == 8
+    assert result["gangs_per_second"] > 1.0
+    p50, p99 = (result["admission_wait_p50_ms"],
+                result["admission_wait_p99_ms"])
+    assert p50 is not None and p99 is not None and p99 >= p50 > 0
 
 
 def test_contiguity_checker():

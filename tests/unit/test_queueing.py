@@ -1,0 +1,521 @@
+"""Fair-share admission math — property-style invariants.
+
+The engine (queueing/fairshare.py) is pure, so the core guarantees are
+driven with seeded random workload sequences:
+
+- admitted usage never exceeds nominal + borrowable, and cohort usage
+  never exceeds cohort nominal (conservation);
+- DRF order is deterministic AND input-permutation-invariant;
+- borrow reclaim converges (lender admitted, borrower back under
+  pressure, nothing reclaimed that doesn't help);
+- backfill never delays the blocker (every backfilled gang's projected
+  end precedes the blocker's shadow time).
+"""
+import random
+
+import pytest
+
+from kubernetes_tpu.api import errors
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.api.queueing import (
+    ClusterQueue, ClusterQueueSpec, LocalQueue, LocalQueueSpec,
+    validate_clusterqueue, validate_localqueue, validate_localqueue_update)
+from kubernetes_tpu.api.types import RESOURCE_TPU
+from kubernetes_tpu.queueing import fairshare as fs
+
+TPU = RESOURCE_TPU
+
+
+def mk_queues(n=3, nominal=32.0, cohort="main"):
+    return {f"q{i}": fs.QueueState(name=f"q{i}", cohort=cohort,
+                                   nominal={TPU: nominal})
+            for i in range(n)}
+
+
+def mk_workload(i, queue, chips=8.0, **kw):
+    return fs.Workload(key=f"ns/{queue}-g{i:03d}", queue=queue,
+                       demand={TPU: chips}, created=float(i), **kw)
+
+
+# -- conservation ----------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", [1, 7, 42, 20260804])
+def test_admitted_usage_never_exceeds_quota(seed):
+    """Random submit sequences through admission_mode/charge: per-queue
+    usage stays under nominal + borrowing_limit, cohort sum stays under
+    cohort nominal — regardless of arrival pattern."""
+    rng = random.Random(seed)
+    queues = mk_queues(n=rng.randint(2, 5), nominal=rng.choice([16.0, 32.0]))
+    for q in queues.values():
+        if rng.random() < 0.5:
+            q.borrowing_limit = {TPU: rng.choice([0.0, 8.0, 16.0])}
+    cohort = list(queues.values())
+    admitted = []
+    for i in range(200):
+        qname = rng.choice(list(queues))
+        w = mk_workload(i, qname, chips=rng.choice([4.0, 8.0, 16.0]))
+        mode, _needs = fs.admission_mode(queues[qname], cohort, w.demand)
+        if mode is not None:
+            fs.charge(queues[qname], w.demand)
+            w.mode = mode
+            admitted.append(w)
+        if rng.random() < 0.2 and admitted:
+            gone = admitted.pop(rng.randrange(len(admitted)))
+            fs.release(queues[gone.queue], gone.demand)
+        # Invariants after every step:
+        total_nominal = sum(q.nominal[TPU] for q in cohort)
+        total_usage = sum(q.usage.get(TPU, 0.0) for q in cohort)
+        assert total_usage <= total_nominal + 1e-6, "cohort over-committed"
+        for q in cohort:
+            limit = q.nominal[TPU] + q.borrowing_limit.get(TPU, float("inf"))
+            assert q.usage.get(TPU, 0.0) <= limit + 1e-6, \
+                f"{q.name} exceeded nominal+borrowing_limit"
+
+
+def test_no_cohort_never_borrows():
+    q = fs.QueueState(name="solo", nominal={TPU: 8.0})
+    mode, needs = fs.admission_mode(q, [q], {TPU: 8.0})
+    assert mode == "Nominal"
+    fs.charge(q, {TPU: 8.0})
+    mode, needs = fs.admission_mode(q, [q], {TPU: 4.0})
+    assert mode is None and not needs
+
+
+def test_needs_reclaim_flag():
+    """Demand fits the lender's nominal but borrowers hold the cohort:
+    admission_mode must say 'reclaim', not 'reject'."""
+    a = fs.QueueState(name="a", cohort="m", nominal={TPU: 32.0})
+    b = fs.QueueState(name="b", cohort="m", nominal={TPU: 32.0})
+    fs.charge(a, {TPU: 64.0})  # a borrowed everything
+    mode, needs = fs.admission_mode(b, [a, b], {TPU: 8.0})
+    assert mode is None and needs
+
+
+def test_ungoverned_resources_not_charged():
+    q = fs.QueueState(name="q", nominal={TPU: 8.0})
+    mode, _ = fs.admission_mode(q, [q], {TPU: 4.0, "cpu": 1e9})
+    assert mode == "Nominal"
+    fs.charge(q, {TPU: 4.0, "cpu": 1e9})
+    assert "cpu" not in q.usage
+
+
+# -- DRF order -------------------------------------------------------------
+
+
+def test_drf_order_deterministic_and_permutation_invariant():
+    queues = mk_queues(n=3)
+    fs.charge(queues["q0"], {TPU: 24.0})   # q0 busy
+    fs.charge(queues["q1"], {TPU: 8.0})    # q1 lighter
+    pending = [mk_workload(i, f"q{i % 3}") for i in range(30)]
+    ref = [w.key for w in fs.drf_order(queues, pending)]
+    for seed in (3, 5, 11):
+        shuffled = list(pending)
+        random.Random(seed).shuffle(shuffled)
+        # Fresh scratch state every call: drf_order must not mutate.
+        got = [w.key for w in fs.drf_order(queues, shuffled)]
+        assert got == ref, "DRF order depends on input permutation"
+    # Idle queue's first gang precedes the busy queue's next.
+    assert ref[0].startswith("ns/q2"), ref[0]
+
+
+def test_drf_order_interleaves_flood():
+    """One tenant floods; the other's single gang lands near the head,
+    never behind the flood."""
+    queues = mk_queues(n=2)
+    pending = [mk_workload(i, "q0") for i in range(20)]
+    pending.append(mk_workload(99, "q1"))
+    order = [w.key for w in fs.drf_order(queues, pending)]
+    assert order.index("ns/q1-g099") <= 1
+
+
+def test_drf_order_respects_priority_then_fifo_within_queue():
+    queues = mk_queues(n=1)
+    pending = [mk_workload(0, "q0"), mk_workload(1, "q0"),
+               mk_workload(2, "q0", priority=10)]
+    order = [w.key for w in fs.drf_order(queues, pending)]
+    assert order == ["ns/q0-g002", "ns/q0-g000", "ns/q0-g001"]
+
+
+# -- reclaim ---------------------------------------------------------------
+
+
+def test_reclaim_converges():
+    """Lender's demand returns; repeated pick-and-release reaches a
+    state where the lender admits and the borrower is within limits."""
+    a = fs.QueueState(name="a", cohort="m", nominal={TPU: 32.0})
+    b = fs.QueueState(name="b", cohort="m", nominal={TPU: 32.0})
+    admitted = []
+    for i in range(8):  # a fills the whole cohort, 4 borrowed
+        w = mk_workload(i, "a")
+        mode, _ = fs.admission_mode(a, [a, b], w.demand)
+        assert mode is not None
+        w.mode, w.admitted_at = mode, float(i)
+        fs.charge(a, w.demand)
+        admitted.append(w)
+    assert fs.borrowed(a) == {TPU: 32.0}
+    demand = {TPU: 8.0}
+    rounds = 0
+    while True:
+        mode, needs = fs.admission_mode(b, [a, b], demand)
+        if mode is not None:
+            break
+        assert needs, "blocked without reclaim signal: livelock"
+        victims = fs.pick_reclaim_victims(b, demand, [a, b], admitted)
+        assert victims, "reclaim found no victims while a borrows"
+        for v in victims:
+            fs.release(a, v.demand)
+            admitted.remove(v)
+        rounds += 1
+        assert rounds <= 8, "reclaim did not converge"
+    # Exactly enough reclaimed: one 8-chip victim for an 8-chip demand.
+    assert rounds == 1 and len(admitted) == 7
+    fs.charge(b, demand)
+    total = a.usage[TPU] + b.usage[TPU]
+    assert total <= 64.0 + 1e-6
+
+
+def test_reclaim_victim_pricing_lifo_cheapest():
+    """Victims: lowest priority first, smallest, most recent admission
+    first among equals — aligned with scheduler gang preemption."""
+    a = fs.QueueState(name="a", cohort="m", nominal={TPU: 0.0})
+    b = fs.QueueState(name="b", cohort="m", nominal={TPU: 16.0})
+    admitted = [
+        mk_workload(0, "a", chips=8.0, admitted_at=1.0, mode="Borrowed"),
+        mk_workload(1, "a", chips=8.0, admitted_at=2.0, mode="Borrowed"),
+    ]
+    for w in admitted:
+        fs.charge(a, w.demand)
+    victims = fs.pick_reclaim_victims(b, {TPU: 8.0}, [a, b], admitted)
+    assert [v.key for v in victims] == ["ns/a-g001"]  # LIFO
+
+
+def test_reclaim_never_touches_nominal_usage():
+    """A queue within its nominal quota is not a reclaim victim."""
+    a = fs.QueueState(name="a", cohort="m", nominal={TPU: 32.0})
+    b = fs.QueueState(name="b", cohort="m", nominal={TPU: 32.0})
+    w = mk_workload(0, "a", chips=16.0, admitted_at=1.0, mode="Nominal")
+    fs.charge(a, w.demand)
+    assert fs.pick_reclaim_victims(b, {TPU: 48.0}, [a, b], [w]) == []
+
+
+def test_reclaim_skips_victims_not_holding_the_short_resource():
+    """A victim must itself hold some of a short resource: evicting a
+    zero-TPU gang from an over-nominal-in-TPU queue frees nothing the
+    blocker needs — and the cost sort would put exactly such cheapest
+    (0-TPU) gangs first."""
+    a = fs.QueueState(name="a", cohort="m",
+                      nominal={TPU: 8.0, "cpu": 100.0})
+    b = fs.QueueState(name="b", cohort="m", nominal={TPU: 8.0})
+    w_tpu = fs.Workload(key="ns/a-tpu", queue="a", demand={TPU: 16.0},
+                        created=0.0, admitted_at=1.0, mode="Borrowed")
+    w_cpu = fs.Workload(key="ns/a-cpu", queue="a", demand={"cpu": 10.0},
+                        created=0.0, admitted_at=2.0, mode="Nominal")
+    fs.charge(a, w_tpu.demand)
+    fs.charge(a, w_cpu.demand)
+    victims = fs.pick_reclaim_victims(b, {TPU: 8.0}, [a, b],
+                                      [w_tpu, w_cpu])
+    assert [v.key for v in victims] == ["ns/a-tpu"], \
+        "evicted a gang holding none of the short resource"
+
+
+def test_reclaim_after_nominal_shrink():
+    """Over-nominal-ness is judged against CURRENT nominal, not the
+    admission-time mode: shrinking a queue's quota below its admitted
+    Nominal usage must leave those chips reclaimable, or the cohort
+    deadlocks behind a blocker no reclaim can serve."""
+    a = fs.QueueState(name="a", cohort="m", nominal={TPU: 8.0})  # was 32
+    b = fs.QueueState(name="b", cohort="m", nominal={TPU: 32.0})
+    admitted = [mk_workload(i, "a", chips=8.0, admitted_at=float(i),
+                            mode="Nominal") for i in range(4)]
+    for w in admitted:
+        fs.charge(a, w.demand)
+    mode, needs = fs.admission_mode(b, [a, b], {TPU: 16.0})
+    assert mode is None and needs
+    victims = fs.pick_reclaim_victims(b, {TPU: 16.0}, [a, b], admitted)
+    assert victims, "nominal-mode usage over a shrunk quota unreclaimable"
+    # Cohort headroom is 40-32=8, 8 short of the demand: ONE 8-chip
+    # release covers it (no over-reclaim), LIFO picks the newest.
+    assert [v.key for v in victims] == ["ns/a-g003"]
+    for v in victims:
+        fs.release(a, v.demand)
+    mode, _ = fs.admission_mode(b, [a, b], {TPU: 16.0})
+    assert mode == "Nominal"
+
+
+# -- backfill --------------------------------------------------------------
+
+
+def test_backfill_never_delays_blocker():
+    """Shadow-time property: every candidate the policy admits ends at
+    or before the instant the blocker could start."""
+    q = fs.QueueState(name="q", nominal={TPU: 16.0})
+    admitted = [
+        mk_workload(0, "q", chips=8.0, admitted_at=0.0, runtime=100.0),
+        mk_workload(1, "q", chips=8.0, admitted_at=0.0, runtime=50.0),
+    ]
+    for w in admitted:
+        fs.charge(q, w.demand)
+    blocker = mk_workload(2, "q", chips=16.0)
+    now = 10.0
+    shadow = fs.shadow_time(blocker, {"q": q}, admitted, now)
+    assert shadow == 100.0  # both must finish before 16 chips free
+    ok = mk_workload(3, "q", chips=4.0, runtime=40.0)     # ends at 50
+    late = mk_workload(4, "q", chips=4.0, runtime=200.0)  # ends at 210
+    unknown = mk_workload(5, "q", chips=4.0)              # unbounded
+    assert fs.backfill_ok(ok, shadow, now)
+    assert not fs.backfill_ok(late, shadow, now)
+    assert not fs.backfill_ok(unknown, shadow, now)
+    # Simulate: at the shadow instant the backfilled gang is gone, so
+    # the blocker admits exactly when it would have without backfill.
+    fs.charge(q, ok.demand)
+    ok.admitted_at = now
+    shadow2 = fs.shadow_time(blocker, {"q": q}, admitted + [ok], now)
+    assert shadow2 == shadow
+
+
+def test_backfill_infinite_shadow_requires_bounded_runtime():
+    q = fs.QueueState(name="q", nominal={TPU: 16.0})
+    forever = mk_workload(0, "q", chips=16.0, admitted_at=0.0)  # no runtime
+    fs.charge(q, forever.demand)
+    blocker = mk_workload(1, "q", chips=16.0)
+    shadow = fs.shadow_time(blocker, {"q": q}, [forever], 5.0)
+    assert shadow == fs.INF
+    assert fs.backfill_ok(mk_workload(2, "q", runtime=60.0), shadow, 5.0)
+    assert not fs.backfill_ok(mk_workload(3, "q"), shadow, 5.0)
+
+
+def test_shadow_time_immediate_when_fits():
+    q = fs.QueueState(name="q", nominal={TPU: 16.0})
+    blocker = mk_workload(0, "q", chips=8.0)
+    assert fs.shadow_time(blocker, {"q": q}, [], 7.0) == 7.0
+
+
+def test_structurally_admissible():
+    """A gang that can never fit at current quota config is
+    inadmissible — it must be sidelined, not become a permanent
+    head-of-line blocker."""
+    a = fs.QueueState(name="a", cohort="m", nominal={TPU: 32.0})
+    b = fs.QueueState(name="b", cohort="m", nominal={TPU: 32.0})
+    assert fs.structurally_admissible(a, [a, b], {TPU: 64.0})  # cohort max
+    assert not fs.structurally_admissible(a, [a, b], {TPU: 65.0})
+    a.borrowing_limit = {TPU: 8.0}
+    assert not fs.structurally_admissible(a, [a, b], {TPU: 48.0})
+    solo = fs.QueueState(name="s", nominal={TPU: 16.0})
+    assert fs.structurally_admissible(solo, [solo], {TPU: 16.0})
+    assert not fs.structurally_admissible(solo, [solo], {TPU: 17.0})
+    # Fullness is irrelevant: structural means config, not load.
+    fs.charge(solo, {TPU: 16.0})
+    assert fs.structurally_admissible(solo, [solo], {TPU: 16.0})
+
+
+# -- controller helpers ----------------------------------------------------
+
+
+def test_group_demand_defaults_chips_from_slice_shape():
+    from kubernetes_tpu.api import types as t
+    from kubernetes_tpu.controllers.queue import group_demand, group_runtime
+    g = t.PodGroup(metadata=ObjectMeta(name="g", namespace="ns"),
+                   spec=t.PodGroupSpec(slice_shape=[2, 2, 2]))
+    assert group_demand(g) == {TPU: 8.0}
+    g.spec.resources = {"cpu": 4.0}
+    assert group_demand(g) == {"cpu": 4.0, TPU: 8.0}
+    g.spec.resources = {TPU: 4.0}
+    assert group_demand(g) == {TPU: 4.0}  # explicit wins
+    assert group_runtime(g) is None
+    g.metadata.annotations["queueing.tpu/runtime-seconds"] = "120"
+    assert group_runtime(g) == 120.0
+    g.metadata.annotations["queueing.tpu/runtime-seconds"] = "bogus"
+    assert group_runtime(g) is None
+
+
+# -- API validation --------------------------------------------------------
+
+
+def test_clusterqueue_validation():
+    cq = ClusterQueue(metadata=ObjectMeta(name="team-a"),
+                      spec=ClusterQueueSpec(
+                          cohort="main", nominal_quota={TPU: 64.0}))
+    validate_clusterqueue(cq)
+    bad = ClusterQueue(metadata=ObjectMeta(name="team-a"),
+                       spec=ClusterQueueSpec(nominal_quota={TPU: -1.0}))
+    with pytest.raises(errors.InvalidError):
+        validate_clusterqueue(bad)
+    nolimit = ClusterQueue(metadata=ObjectMeta(name="team-a"),
+                           spec=ClusterQueueSpec(
+                               borrowing_limit={TPU: 8.0}))  # no cohort
+    with pytest.raises(errors.InvalidError):
+        validate_clusterqueue(nolimit)
+    # json.loads accepts the NaN/Infinity literals, and NaN compares
+    # False against everything — it must die at validation, not scramble
+    # the DRF math.
+    for amt in (float("nan"), float("inf"), float("-inf")):
+        with pytest.raises(errors.InvalidError):
+            validate_clusterqueue(ClusterQueue(
+                metadata=ObjectMeta(name="team-a"),
+                spec=ClusterQueueSpec(nominal_quota={TPU: amt})))
+
+
+def test_podgroup_queue_and_resources_immutable():
+    """With JobQueueing on, spec.queue can never move and
+    spec.resources freezes while admitted — otherwise the quota charge
+    drifts from what the gang physically holds. With the gate OFF the
+    checks vanish (gate off = byte-identical update semantics; a stale
+    spec.queue from a gated run must stay editable)."""
+    from kubernetes_tpu.api import types as t
+    from kubernetes_tpu.api.validation import validate_podgroup_update
+    from kubernetes_tpu.util.features import GATES
+    old = t.PodGroup(metadata=ObjectMeta(name="g", namespace="ns"),
+                     spec=t.PodGroupSpec(queue="lq",
+                                         resources={TPU: 8.0}))
+    moved = t.PodGroup(metadata=ObjectMeta(name="g", namespace="ns"),
+                       spec=t.PodGroupSpec(queue="other",
+                                           resources={TPU: 8.0}))
+    resized = t.PodGroup(metadata=ObjectMeta(name="g", namespace="ns"),
+                         spec=t.PodGroupSpec(queue="lq",
+                                             resources={TPU: 0.0}))
+    was = GATES.enabled("JobQueueing")
+    GATES.set("JobQueueing", True)
+    try:
+        with pytest.raises(errors.InvalidError):
+            validate_podgroup_update(moved, old)
+        validate_podgroup_update(resized, old)  # pending: resize allowed
+        old.status.admitted = True
+        with pytest.raises(errors.InvalidError):
+            validate_podgroup_update(resized, old)
+        GATES.set("JobQueueing", False)
+        validate_podgroup_update(moved, old)    # gate off: free to edit
+        validate_podgroup_update(resized, old)
+    finally:
+        GATES.set("JobQueueing", was)
+        old.status.admitted = False
+
+
+def test_localqueue_validation_and_immutability():
+    lq = LocalQueue(metadata=ObjectMeta(name="lq", namespace="ns"),
+                    spec=LocalQueueSpec(cluster_queue="team-a"))
+    validate_localqueue(lq)
+    with pytest.raises(errors.InvalidError):
+        validate_localqueue(LocalQueue(
+            metadata=ObjectMeta(name="lq", namespace="ns")))
+    moved = LocalQueue(metadata=ObjectMeta(name="lq", namespace="ns"),
+                       spec=LocalQueueSpec(cluster_queue="team-b"))
+    with pytest.raises(errors.InvalidError):
+        validate_localqueue_update(moved, lq)
+
+
+# -- printers --------------------------------------------------------------
+
+
+def test_clusterqueue_printer_and_describe():
+    from kubernetes_tpu.cli import printers
+    cq = ClusterQueue(metadata=ObjectMeta(name="team-a"),
+                      spec=ClusterQueueSpec(
+                          cohort="main", nominal_quota={TPU: 64.0}))
+    cq.status.pending, cq.status.admitted = 3, 5
+    cq.status.usage = {TPU: 40.0}
+    cq.status.borrowed = {TPU: 8.0}
+    cq.status.tenant_usage = {"ns-a/lq": {TPU: 40.0}}
+    table = printers.print_objects("clusterqueues", [cq])
+    assert "PENDING" in table and "BORROWED" in table and "NOMINAL" in table
+    row = table.splitlines()[1]
+    assert "team-a" in row and "3" in row and "8" in row and "64" in row
+    text = printers.describe(cq)
+    assert "40 used / 64 nominal" in text
+    assert "+8 borrowed" in text
+    assert "ns-a/lq" in text
+
+
+def test_localqueue_printer():
+    from kubernetes_tpu.cli import printers
+    lq = LocalQueue(metadata=ObjectMeta(name="lq", namespace="ns"),
+                    spec=LocalQueueSpec(cluster_queue="team-a"))
+    lq.status.pending, lq.status.admitted = 2, 1
+    table = printers.print_objects("localqueues", [lq])
+    assert "CLUSTERQUEUE" in table and "team-a" in table
+
+
+# -- scheduler suspend gate -------------------------------------------------
+
+
+def test_group_suspended_gate():
+    from kubernetes_tpu.api import types as t
+    from kubernetes_tpu.scheduler.scheduler import group_suspended
+    from kubernetes_tpu.util.features import GATES
+    g = t.PodGroup(metadata=ObjectMeta(name="g", namespace="ns"),
+                   spec=t.PodGroupSpec(queue="lq"))
+    was = GATES.enabled("JobQueueing")
+    try:
+        GATES.set("JobQueueing", False)
+        assert not group_suspended(g)  # gate off: byte-identical path
+        GATES.set("JobQueueing", True)
+        assert group_suspended(g)
+        g.status.admitted = True
+        assert not group_suspended(g)
+        g.status.admitted = False
+        g.spec.queue = ""
+        assert not group_suspended(g)
+    finally:
+        GATES.set("JobQueueing", was)
+
+
+def test_unadmit_overlay_prevents_stale_recharge():
+    """The reclaim mirror of the admitted-overlay: a just-reclaimed
+    gang whose informer copy still shows admitted=True must NOT be
+    re-charged by the next pass — the stale charge fakes a cohort
+    shortfall for the lender and evicts a SECOND healthy borrower
+    before the watch catches up."""
+    from kubernetes_tpu.api import types as t
+    from kubernetes_tpu.controllers.queue import QueueController
+    from kubernetes_tpu.util.features import GATES
+
+    class StubInf:
+        def __init__(self, objs):
+            self._objs = objs
+
+        def list(self):
+            return self._objs
+
+        def add_handlers(self, **_kw):
+            pass
+
+    class StubFactory:
+        def informer(self, plural, indexers=None, resync_period=0.0):
+            return StubInf([])
+
+    was = GATES.enabled("JobQueueing")
+    GATES.set("JobQueueing", True)
+    try:
+        qc = QueueController(client=None, factory=StubFactory())
+    finally:
+        GATES.set("JobQueueing", was)
+    cq = ClusterQueue(metadata=ObjectMeta(name="team-a"),
+                      spec=ClusterQueueSpec(nominal_quota={TPU: 32.0}))
+    lq = LocalQueue(metadata=ObjectMeta(name="lq", namespace="ns"),
+                    spec=LocalQueueSpec(cluster_queue="team-a"))
+    g = t.PodGroup(metadata=ObjectMeta(name="g", namespace="ns"),
+                   spec=t.PodGroupSpec(min_member=2, slice_shape=[2, 2, 2],
+                                       queue="lq"))
+    g.metadata.resource_version = "5"
+    g.status.admitted = True
+    g.status.admission_mode = "Borrowed"
+    g.status.admission_cluster_queue = "team-a"
+    qc.cq_informer = StubInf([cq])
+    qc.lq_informer = StubInf([lq])
+    qc.pg_informer = StubInf([g])
+    queues, admitted, pending, *_ = qc._snapshot()
+    assert queues["team-a"].usage.get(TPU) == 8.0 and len(admitted) == 1
+    # Reclaim written; informer copy (same rv) still stale-admitted.
+    qc._unadmit_overlay.add("ns/g")
+    queues, admitted, pending, *_ = qc._snapshot()
+    assert queues["team-a"].usage.get(TPU, 0.0) == 0.0
+    assert not admitted and len(pending) == 1
+    # Watch catches up (admitted=False, new rv): overlay self-clears.
+    g2 = t.PodGroup(metadata=ObjectMeta(name="g", namespace="ns"),
+                    spec=t.PodGroupSpec(min_member=2, slice_shape=[2, 2, 2],
+                                        queue="lq"))
+    g2.metadata.resource_version = "6"
+    qc.pg_informer = StubInf([g2])
+    queues, admitted, pending, *_ = qc._snapshot()
+    assert "ns/g" not in qc._unadmit_overlay
+    assert not admitted and len(pending) == 1

@@ -187,6 +187,27 @@ D = Counter("client_retry_total", "re-registered: silently inert")
     assert len(got) == 1 and "already registered" in got[0].message
 
 
+def test_metric_name_queueing_family():
+    """The job-queueing metric family (queue_*) is valid, and a
+    duplicate registration within the family is still caught."""
+    good = """
+from kubernetes_tpu.metrics.registry import Counter, Gauge, Histogram
+A = Gauge("queue_pending_gangs", "x", labels=("queue",))
+B = Gauge("queue_admitted_gangs", "x", labels=("queue",))
+C = Gauge("queue_borrowed_resources", "x", labels=("queue", "resource"))
+D = Gauge("queue_resource_usage", "x", labels=("queue", "resource"))
+E = Histogram("queue_admission_wait_seconds", "x")
+F = Counter("queue_admissions_total", "x", labels=("queue", "mode"))
+G = Counter("queue_reclaimed_gangs_total", "x", labels=("queue",))
+"""
+    assert run_source(good, checks=["metric-name"]) == []
+    bad = good + """
+H = Gauge("queue_pending_gangs", "re-registered: silently inert")
+"""
+    got = run_source(bad, checks=["metric-name"])
+    assert len(got) == 1 and "already registered" in got[0].message
+
+
 # ---------------------------------------------------------------------------
 # cache-mutation
 # ---------------------------------------------------------------------------
