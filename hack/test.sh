@@ -5,10 +5,11 @@
 # a verify failure fails the whole entrypoint), hack/bench_smoke.sh
 # (<60s REST density smoke of the batch API path), hack/chaos.sh
 # (seeded fault-schedule convergence gate, plain + queueing-enabled),
-# hack/queue_smoke.sh (<60s two-tenant fair-share admission smoke) —
+# hack/queue_smoke.sh (<60s two-tenant fair-share admission smoke),
+# hack/race.sh (<120s tpusan gate: chaos + queue smoke under explored
+# task-interleaving schedules with the cluster invariants armed) —
 # all run on full-suite invocations; filtered runs skip them,
-# KTPU_SMOKE=1 forces them; hack/race.sh (TSAN/ASAN + asyncio-debug
-# race tiers).
+# KTPU_SMOKE=1 forces them.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 ./hack/verify.sh
@@ -16,5 +17,6 @@ if [ "$#" -eq 0 ] || [ "${KTPU_SMOKE:-}" = "1" ]; then
   ./hack/bench_smoke.sh
   ./hack/chaos.sh
   ./hack/queue_smoke.sh
+  ./hack/race.sh
 fi
 exec python -m pytest tests/ -q "$@"

@@ -11,10 +11,16 @@
 #   feature-gate         gate literals unknown to util/features.py
 #   metric-name          invalid / colliding Prometheus metric names
 #   cache-mutation       in-place mutation of informer/cache objects
+#   task-leak            fire-and-forget create_task, Task discarded
+#   informer-mutation    cached object passed to a param-mutating callee
+#                        (interprocedural cache-mutation)
+#   status-write         status update with no ConflictError guard and
+#                        not reachable from a controller sync()
 #
 # Suppress a single deliberate line with `# tpuvet: ignore[check-name]`.
-# Runtime complements (env-gated): TPU_CACHE_MUTATION_DETECTOR=1 and
-# TPU_LOCKDEP=1 — see hack/race.sh for the sanitizer tiers.
+# Runtime complements (env-gated): TPU_CACHE_MUTATION_DETECTOR=1,
+# TPU_LOCKDEP=1, and TPU_SAN=<seed> (tpusan interleaving explorer +
+# cluster-invariant sanitizer) — see hack/race.sh for the dynamic gate.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 

@@ -1,11 +1,15 @@
-"""tpuvet — repo-specific static analysis (the ``go vet`` analog).
+"""tpuvet + tpusan — repo-specific static and dynamic analysis.
 
 Reference: the ``hack/verify-*.sh`` family plus ``go vet`` in the
 make rules, and client-go's cache mutation detector
 (``tools/cache/mutation_detector.go``) for the runtime side.
 
-The framework lives in :mod:`.tpuvet`; the repo-specific passes in
-:mod:`.passes`. Run the suite with ``python -m kubernetes_tpu.analysis``
+Static: the framework lives in :mod:`.tpuvet`; the repo-specific
+passes in :mod:`.passes`. Dynamic ("tpusan"): :mod:`.interleave` is
+the seeded task-interleaving explorer (``TPU_SAN=<seed>``),
+:mod:`.invariants` the cluster-invariant sanitizer checked on every
+MVCC write — together the deterministic-simulation tier ``hack/race.sh``
+gates on. Run the static suite with ``python -m kubernetes_tpu.analysis``
 (what ``hack/verify.sh`` does) or programmatically::
 
     from kubernetes_tpu.analysis import run_tree
@@ -15,7 +19,22 @@ Adding a pass: subclass :class:`~.tpuvet.Pass`, decorate with
 :func:`~.tpuvet.register`, implement ``check_module`` (per-file) and/or
 ``finalize`` (cross-file), and add a good/bad fixture pair to
 ``tests/unit/test_tpuvet.py``.
+
+The static framework loads LAZILY (PEP 562): production code imports
+this package for the tpusan seams (``analysis.interleave.touch`` in the
+store/scheduler hot paths, ``analysis.invariants`` at store
+construction), and that import must not drag the whole AST linter onto
+the apiserver/scheduler startup path.
 """
-from .tpuvet import (Finding, Module, Pass, REGISTRY, register,  # noqa: F401
-                     run_source, run_tree)
-from . import passes  # noqa: F401  (imports register the passes)
+_STATIC = ("Finding", "Module", "Pass", "REGISTRY", "register",
+           "run_source", "run_tree")
+
+__all__ = list(_STATIC) + ["interleave", "invariants", "passes", "tpuvet"]
+
+
+def __getattr__(name):
+    if name in _STATIC:
+        from . import passes  # noqa: F401  (import registers the passes)
+        from . import tpuvet
+        return getattr(tpuvet, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

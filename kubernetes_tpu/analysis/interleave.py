@@ -1,0 +1,335 @@
+"""tpusan — deterministic interleaving explorer for the asyncio plane.
+
+The control plane's worst bugs are wakeup-order races (the gang-release
+wakeup race, the reclaim bind-vs-eviction window, the `_unadmit_overlay`
+double-charge — all found late, by chaos or by review). This module
+makes that order a *seeded input* instead of an accident of the event
+loop, FoundationDB-simulation style: same seed ⇒ same schedule, so a
+failing interleaving replays under a debugger instead of recurring once
+a month in CI.
+
+Mechanism: asyncio's ready queue (``BaseEventLoop._ready``) is replaced
+with a seeded permuting deque. Only **task steps** (handles whose
+callback is bound to an :class:`asyncio.Task` — creations and wakeups)
+are permuted; infrastructure callbacks (selector/transport plumbing,
+which DOES rely on FIFO delivery order) keep their relative order, so
+real sockets keep working while coroutine interleaving is fuzzed.
+
+Two modes (``TPU_SAN_MODE``):
+
+- ``random`` — uniform seeded choice among runnable task steps.
+- ``dpor`` — DPOR-lite: task steps whose tasks have *touched the same
+  shared object* as the most recently scheduled step are preferentially
+  permuted (true dynamic partial-order reduction explores only
+  conflicting reorderings; this is the bounded, heuristic cut of that
+  idea). Shared-object touches come from :func:`touch` calls wired
+  into the seams: MVCC writes, the scheduling queue's gang paths, the
+  admission pass.
+
+Arming (opt-in, in the style of TPU_CHAOS / TPU_LOCKDEP)::
+
+    TPU_SAN=<seed>            # fuzz every asyncio test / harness loop
+    TPU_SAN_MODE=dpor         # optional; default random
+    TPU_SAN_SCHEDULES=8       # schedules per seed for explore()-based gates
+
+Replay contract: the schedule **fingerprint** (a rolling hash over
+every (candidate-count, chosen-rank) decision) is a pure function of
+(seed, the sequence of ready-queue states). For scenarios without
+wall-clock timers or real I/O the ready states are themselves
+deterministic, so one seed ⇒ one fingerprint ⇒ one interleaving —
+asserted by tests/unit/test_tpusan.py. Sibling: :mod:`.invariants`
+(what must hold on every explored schedule).
+"""
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import os
+import random
+from dataclasses import dataclass
+from typing import Any, Awaitable, Callable, Optional
+from weakref import WeakKeyDictionary
+
+ENV_VAR = "TPU_SAN"
+ENV_MODE = "TPU_SAN_MODE"
+ENV_SCHEDULES = "TPU_SAN_SCHEDULES"
+
+MODES = ("random", "dpor")
+
+#: Probability that a dpor-mode decision restricts itself to the
+#: conflicting candidates (1.0 would never explore benign reorderings).
+DPOR_BIAS = 0.75
+
+#: Per-task cap on remembered touched keys (DPOR hint state only).
+MAX_TOUCHED = 256
+
+#: True once any loop in this process has been installed — the fast
+#: bail for :func:`touch` so disarmed production pays one module-global
+#: check, nothing else.
+ARMED = False
+
+
+def _is_task_step(handle) -> bool:
+    """A ready handle that advances a Task (creation or wakeup): its
+    callback is bound to the Task (``TaskStepMethWrapper`` /
+    ``task_wakeup`` in the C implementation, ``Task.__step`` in pure
+    Python)."""
+    cb = getattr(handle, "_callback", None)
+    return isinstance(getattr(cb, "__self__", None), asyncio.Task)
+
+
+class Interleaver:
+    """One seeded schedule: the decision source + fingerprint."""
+
+    def __init__(self, seed, mode: str = "random"):
+        if mode not in MODES:
+            raise ValueError(f"tpusan mode must be one of {MODES}, got {mode!r}")
+        self.seed = seed
+        self.mode = mode
+        self.rng = random.Random(f"tpusan:{seed}")
+        self.decisions = 0
+        self._h = hashlib.sha256()
+        #: task -> set of shared-object keys it touched (DPOR hints).
+        self._touched: WeakKeyDictionary = WeakKeyDictionary()
+        #: keys touched by the most recently scheduled task step.
+        self._last_keys: frozenset = frozenset()
+
+    # -- scheduling decisions ---------------------------------------------
+
+    def choose(self, buf: list, idxs: list[int]) -> int:
+        """Pick which ready task step runs next; returns its index in
+        ``buf``. Called by :class:`_FuzzReady` with >= 1 candidates."""
+        if self.mode == "dpor" and len(idxs) > 1 and self._last_keys:
+            conflicting = [i for i in idxs
+                           if self._task_keys(buf[i]) & self._last_keys]
+            if conflicting and self.rng.random() < DPOR_BIAS:
+                idxs = conflicting
+        rank = self.rng.randrange(len(idxs)) if len(idxs) > 1 else 0
+        j = idxs[rank]
+        self.decisions += 1
+        self._h.update(b"%d:%d;" % (len(idxs), rank))
+        self._last_keys = frozenset(self._task_keys(buf[j]))
+        return j
+
+    def _task_keys(self, handle) -> set:
+        task = getattr(getattr(handle, "_callback", None), "__self__", None)
+        got = self._touched.get(task) if task is not None else None
+        return got if got is not None else set()
+
+    def note_touch(self, key: str) -> None:
+        task = asyncio.current_task()
+        if task is None:
+            return
+        touched = self._touched.get(task)
+        if touched is None:
+            touched = self._touched[task] = set()
+        if len(touched) < MAX_TOUCHED:
+            touched.add(key)
+
+    # -- artifacts --------------------------------------------------------
+
+    def fingerprint(self) -> str:
+        """``<decisions>:<digest16>`` — the replay-by-seed artifact. Two
+        runs of one seed over a timer-free scenario produce the same
+        string; two seeds over a contended scenario (almost) never do."""
+        return f"{self.decisions}:{self._h.hexdigest()[:16]}"
+
+
+class _FuzzReady(list):
+    """Drop-in for ``BaseEventLoop._ready``. The loop only uses
+    append/popleft/len/bool/clear (collections.deque), so a list
+    subclass with a permuting :meth:`popleft` suffices.
+
+    Policy: only the **contiguous front run of task steps** is
+    permuted. Infrastructure callbacks (selector/transport plumbing)
+    keep FIFO both among themselves AND relative to task steps queued
+    after them — a task resuming from ``await sock_connect`` must not
+    overtake the ``_sock_write_done`` bookkeeping scheduled just before
+    its wakeup (observed: the transport claims the fd, then the late
+    remove_writer raises). Task wakeup order — the surface application
+    races live on — is still fully explored within each run."""
+
+    def __init__(self, san: Interleaver):
+        super().__init__()
+        self.san = san
+
+    def popleft(self):
+        if len(self) <= 1 or not _is_task_step(self[0]):
+            return self.pop(0)
+        n = 1
+        while n < len(self) and _is_task_step(self[n]):
+            n += 1
+        if n == 1:
+            return self.pop(0)
+        return self.pop(self.san.choose(self, list(range(n))))
+
+
+def install(loop: asyncio.AbstractEventLoop, seed,
+            mode: str = "random") -> Interleaver:
+    """Put ``loop`` under a seeded schedule; returns the interleaver
+    (its :meth:`~Interleaver.fingerprint` is the run artifact)."""
+    global ARMED
+    san = Interleaver(seed, mode)
+    ready = _FuzzReady(san)
+    ready.extend(loop._ready)  # normally empty on a fresh loop
+    loop._ready = ready
+    loop._tpusan = san
+    ARMED = True
+    return san
+
+
+def current() -> Optional[Interleaver]:
+    """The interleaver driving the running loop, or None."""
+    if not ARMED:
+        return None
+    try:
+        loop = asyncio.get_running_loop()
+    except RuntimeError:
+        return None
+    return getattr(loop, "_tpusan", None)
+
+
+def touch(key: str) -> None:
+    """Record that the current task touched shared object ``key`` — the
+    DPOR-lite conflict hint. Wired into the seams (MVCC writes, gang
+    release/admission paths); free when tpusan is disarmed."""
+    if not ARMED:
+        return
+    try:
+        loop = asyncio.get_running_loop()
+    except RuntimeError:
+        return
+    san = getattr(loop, "_tpusan", None)
+    if san is not None:
+        san.note_touch(key)
+
+
+# -- drivers ----------------------------------------------------------------
+
+
+@dataclass
+class ScheduleResult:
+    """One explored schedule's verdict."""
+    schedule: int
+    seed: str
+    fingerprint: str
+    decisions: int
+    value: Any = None
+
+
+def run(coro: Awaitable, seed, mode: str = "random",
+        san: Optional[Interleaver] = None) -> tuple[Any, Interleaver]:
+    """``asyncio.run`` under a seeded schedule; returns (result,
+    interleaver). The loop is private and closed afterwards, like
+    asyncio.run's."""
+    loop = asyncio.new_event_loop()
+    installed = san or Interleaver(seed, mode)
+    ready = _FuzzReady(installed)
+    loop._ready = ready
+    loop._tpusan = installed
+    global ARMED
+    ARMED = True
+    asyncio.set_event_loop(loop)
+    try:
+        return loop.run_until_complete(coro), installed
+    finally:
+        # asyncio.run()'s shutdown contract, which this replaces — and
+        # like asyncio.run it must hold on the FAILURE path too (a
+        # failing schedule's plane servers/background tasks must not
+        # leak into the next schedule of the same process): cancel
+        # whatever is still pending so finally-blocks run, drain async
+        # generators, and collect while the loop is still alive
+        # (dropped aiohttp transports finalize through it; after close
+        # they raise "Event loop is closed").
+        try:
+            pending = asyncio.all_tasks(loop)
+            if pending:
+                for task in pending:
+                    task.cancel()
+                loop.run_until_complete(
+                    asyncio.gather(*pending, return_exceptions=True))
+            loop.run_until_complete(loop.shutdown_asyncgens())
+            import gc
+            for _ in range(2):
+                gc.collect()
+        finally:
+            asyncio.set_event_loop(None)
+            loop.close()
+
+
+def explore(factory: Callable[[int], Awaitable], base_seed,
+            schedules: int = 8, mode: str = "random") -> list[ScheduleResult]:
+    """Run ``factory(i)``'s coroutine under ``schedules`` distinct
+    seeded schedules derived from ``base_seed``. Exceptions propagate —
+    a scenario that breaks under some interleaving should fail the
+    gate, with the failing (seed, schedule index) in the traceback
+    context for replay."""
+    out = []
+    for i in range(schedules):
+        seed = f"{base_seed}:{i}"
+        value, san = run(factory(i), seed, mode)
+        out.append(ScheduleResult(
+            schedule=i, seed=seed, fingerprint=san.fingerprint(),
+            decisions=san.decisions, value=value))
+    return out
+
+
+def explore_sanitized(factory: Callable[[int], Awaitable], base_seed,
+                      schedules: int = 8, mode: str = "dpor",
+                      extract: Optional[Callable[[Any], dict]] = None
+                      ) -> dict:
+    """:func:`explore` with the cluster-invariant sanitizer armed for
+    each schedule: every store built during a run self-attaches, the
+    run must end violation-free (AssertionError names the failing
+    (base_seed, schedule) pair for replay), and per-invariant check
+    counts are aggregated — the shared driver behind the chaos and
+    queueing tpusan gates. ``extract(value)`` adds scenario-specific
+    fields to each schedule's report row."""
+    from . import invariants
+
+    rows = []
+    checks_total: dict = {}
+    for i in range(schedules):
+        sanitizer = invariants.arm(invariants.InvariantRegistry())
+        try:
+            value, san = run(factory(i), f"{base_seed}:{i}", mode)
+        finally:
+            invariants.disarm()
+        sanitizer.check_final()
+        sanitizer.assert_clean()
+        for name, n in sanitizer.checks.items():
+            checks_total[name] = checks_total.get(name, 0) + n
+        row = {"schedule": i, "fingerprint": san.fingerprint(),
+               "decisions": san.decisions}
+        if extract is not None:
+            row.update(extract(value))
+        rows.append(row)
+    return {
+        "mode": mode,
+        "schedules": rows,
+        "distinct_fingerprints": len({r["fingerprint"] for r in rows}),
+        "invariant_checks": checks_total,
+    }
+
+
+# -- env arming -------------------------------------------------------------
+
+
+def from_env() -> Optional[str]:
+    """The ``TPU_SAN`` seed, or None when disarmed. Like TPU_CHAOS,
+    any non-empty string is a valid seed (the rng hashes it)."""
+    raw = os.environ.get(ENV_VAR, "")
+    return raw or None
+
+
+def mode_from_env() -> str:
+    mode = os.environ.get(ENV_MODE, "") or "random"
+    if mode not in MODES:
+        raise ValueError(
+            f"{ENV_MODE}={mode!r}: must be one of {', '.join(MODES)}")
+    return mode
+
+
+def schedules_from_env(default: int = 8) -> int:
+    raw = os.environ.get(ENV_SCHEDULES, "")
+    return int(raw) if raw else default

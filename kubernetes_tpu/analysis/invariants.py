@@ -1,0 +1,497 @@
+"""tpusan invariants — cluster properties checked on every store write.
+
+The sanitizer half of tpusan (:mod:`.interleave` is the schedule half):
+a registry of always-on cluster invariants evaluated at the MVCC write
+seam, so ANY interleaving the explorer produces is judged step by step
+instead of only at scenario end. The five registered invariants are the
+ones whose violations this repo has actually paid for (chaos findings,
+PR-review windows):
+
+``chip-double-book``
+    No TPU chip is assigned to two live pods on one node. (The chaos
+    harness asserts this once, at convergence; the sanitizer asserts it
+    on every bind so the transient double-book a converging run hides
+    is still caught.)
+``quota-conservation``
+    Per borrowing cohort, admitted usage never exceeds the cohort's
+    nominal quota: sum(usage) <= sum(nominal) per governed resource —
+    the fairshare conservation invariant, now checked against the
+    durable store instead of the controller's own accounting.
+``gang-atomicity``
+    A gang is never *partially* bound past the quorum grace — measured
+    in STORE REVISIONS, not wall seconds, so the verdict is a pure
+    function of the write stream and replays by seed: 0 < bound <
+    min_member must be a transient state, not one the cluster keeps
+    making progress around (a stuck partial gang holds chips no one
+    can use).
+``admission-monotonicity``
+    ``status.admitted`` never silently flips back to False: the only
+    legal unadmit is an announced reclaim (:func:`note_reclaim`, wired
+    into QueueController._unadmit) or object deletion.
+``wal-replay``
+    Replaying the write stream reproduces the live store exactly: a
+    shadow copy is maintained from the same records the WAL sees, and
+    :meth:`InvariantRegistry.check_final` compares it byte-for-byte
+    against ``store.state()`` — state mutated behind the log's back
+    (the bug class WAL recovery cannot survive) is a violation.
+
+Violations are RECORDED (``log.error`` + ``violations`` list), not
+raised mid-write: raising inside the store would turn a sanitizer
+verdict into an apiserver 500 that retry-tolerant clients swallow.
+Harnesses call :meth:`~InvariantRegistry.assert_clean` at the end.
+
+Arming::
+
+    from kubernetes_tpu.analysis import invariants
+    reg = invariants.arm(invariants.InvariantRegistry())
+    ...  # every MVCCStore constructed while armed self-attaches
+    reg.check_final(); reg.assert_clean()
+    invariants.disarm()
+"""
+from __future__ import annotations
+
+import json
+import logging
+from dataclasses import dataclass, field
+from typing import Optional
+
+log = logging.getLogger("tpusan")
+
+#: Kept literal (mirrors api.types.RESOURCE_TPU) so the store can import
+#: this module without pulling the full API scheme in.
+RESOURCE_TPU = "google.com/tpu"
+
+CHIP_DOUBLE_BOOK = "chip-double-book"
+QUOTA_CONSERVATION = "quota-conservation"
+GANG_ATOMICITY = "gang-atomicity"
+ADMISSION_MONOTONICITY = "admission-monotonicity"
+WAL_REPLAY = "wal-replay"
+
+INVARIANTS = (CHIP_DOUBLE_BOOK, QUOTA_CONSERVATION, GANG_ATOMICITY,
+              ADMISSION_MONOTONICITY, WAL_REPLAY)
+
+#: Store revisions the cluster may advance while a gang sits partially
+#: bound before gang-atomicity fires. Revision-counted (not wall-clock)
+#: so a loaded machine cannot flip the verdict — same write stream,
+#: same verdict. Generous by default: a live bind-in-progress finishes
+#: within a handful of writes; negative tests shrink it.
+DEFAULT_PARTIAL_GRACE_REVS = 500
+
+
+@dataclass(frozen=True)
+class Violation:
+    invariant: str
+    key: str
+    message: str
+    revision: int = 0
+
+    def __str__(self) -> str:
+        return f"[{self.invariant}] {self.key}@r{self.revision}: {self.message}"
+
+
+def _canon(value: dict) -> str:
+    """Canonical serialization for shadow-vs-live comparison."""
+    return json.dumps(value, sort_keys=True, separators=(",", ":"))
+
+
+def _demand(group_value: dict) -> dict:
+    """Gang demand as admission charges it (controllers/queue.py
+    group_demand): explicit spec.resources, chips defaulted from the
+    slice shape."""
+    spec = group_value.get("spec", {}) or {}
+    demand = dict(spec.get("resources", {}) or {})
+    shape = spec.get("slice_shape") or []
+    if RESOURCE_TPU not in demand and shape:
+        chips = 1.0
+        for d in shape:
+            chips *= d
+        demand[RESOURCE_TPU] = float(chips)
+    return demand
+
+
+def _pod_chips(pod_value: dict) -> set:
+    """Chips a pod HOLDS for double-book purposes. A pod with a
+    deletion timestamp has logically released its chips — the scheduler
+    cache frees them at that instant ("terminal pods free their chips")
+    and the remaining teardown overlap is the node runtime's to
+    serialize, so counting a deleting pod would flag every graceful
+    eviction-rebind as a violation."""
+    if (pod_value.get("metadata", {}) or {}).get("deletion_timestamp"):
+        return set()
+    spec = pod_value.get("spec", {}) or {}
+    node = spec.get("node_name", "")
+    if not node:
+        return set()
+    pairs = set()
+    for claim in spec.get("tpu_resources", []) or []:
+        for cid in claim.get("assigned", []) or []:
+            pairs.add((node, cid))
+    return pairs
+
+
+class _StoreState:
+    """Incremental indexes for one attached store — per-write checks
+    stay O(write), not O(cluster)."""
+
+    def __init__(self, store):
+        self.store = store
+        #: (node, chip_id) -> pod key holding it.
+        self.chips: dict = {}
+        self.pod_chips: dict = {}       # pod key -> set[(node, chip)]
+        self.bound_by_gang: dict = {}   # gang key -> set[pod key]
+        self.pod_gang: dict = {}        # pod key -> gang key
+        #: group key -> {"admitted", "cq", "demand", "min_member"}
+        self.groups: dict = {}
+        self.cqs: dict = {}             # name -> {"cohort", "nominal"}
+        self.lqs: dict = {}             # "ns/name" -> cluster queue name
+        self.usage: dict = {}           # cq name -> {resource: charged}
+        self.partial_since: dict = {}   # gang key -> revision when partial
+        #: The write-stream replay: key -> (canonical value JSON,
+        #: mod_rev, create_rev). Serialized at write time so a later
+        #: in-place mutation of the stored dict cannot drag the shadow
+        #: along with it (the exact bug class wal-replay exists for).
+        self.shadow: dict = {}
+        self.shadow_rev = 0
+
+
+class InvariantRegistry:
+    """The armed sanitizer: attach stores, collect violations."""
+
+    def __init__(self, partial_grace_revs: int = DEFAULT_PARTIAL_GRACE_REVS):
+        self.partial_grace_revs = partial_grace_revs
+        self.violations: list[Violation] = []
+        #: invariant -> number of evaluations (the "exercised" artifact
+        #: hack/race.sh asserts on).
+        self.checks: dict[str, int] = {name: 0 for name in INVARIANTS}
+        self._stores: list[_StoreState] = []
+        #: Announced reclaims: unadmits these keys may legally perform.
+        self._reclaim_ok: set = set()
+        #: (invariant, key) already reported — one violation per site,
+        #: not one per write that re-observes it.
+        self._reported: set = set()
+
+    # -- wiring -----------------------------------------------------------
+
+    def attach_store(self, store) -> None:
+        """Seed indexes from the store's current contents and subscribe
+        to its event stream (MVCCStore.__init__ calls this on every
+        store built while the registry is armed — including recovery
+        replays, whose loaded state arrives via the seed walk)."""
+        st = _StoreState(store)
+        self._stores.append(st)
+        for key, obj in list(store._data.items()):
+            st.shadow[key] = (_canon(obj.value), obj.mod_revision,
+                              obj.create_revision)
+            self._index(st, key, obj.value, revision=obj.mod_revision,
+                        seeding=True)
+        st.shadow_rev = store._rev
+        store.add_event_hook(lambda ev, st=st: self._on_event(st, ev))
+
+    def note_reclaim(self, group_key: str) -> None:
+        """QueueController._unadmit announces a reclaim: the next
+        admitted->pending flip of ``group_key`` is legal."""
+        self._reclaim_ok.add(group_key)
+
+    # -- event dispatch ---------------------------------------------------
+
+    def _on_event(self, st: _StoreState, ev) -> None:
+        # Runs under the store lock on the write path: record-only, and
+        # never let a sanitizer bug break a product write.
+        try:
+            self._dispatch(st, ev)
+        except Exception:  # noqa: BLE001 — sanitizer must not take down writes
+            log.exception("tpusan: invariant evaluation failed for %s", ev.key)
+
+    def _dispatch(self, st: _StoreState, ev) -> None:
+        deleted = ev.type == "DELETED"
+        # wal-replay shadow: apply exactly what the WAL saw.
+        if deleted:
+            st.shadow.pop(ev.key, None)
+        else:
+            prev = st.shadow.get(ev.key)
+            st.shadow[ev.key] = (_canon(ev.value), ev.revision,
+                                 prev[2] if prev else ev.revision)
+        st.shadow_rev = ev.revision
+        parts = ev.key.split("/")
+        plural = parts[2] if len(parts) > 2 else ""
+        if plural == "pods":
+            self._on_pod(st, ev, deleted)
+        elif plural == "podgroups":
+            self._on_group(st, ev, deleted)
+        elif plural == "clusterqueues":
+            name = parts[3]
+            if deleted:
+                st.cqs.pop(name, None)
+            else:
+                spec = ev.value.get("spec", {}) or {}
+                st.cqs[name] = {
+                    "cohort": spec.get("cohort", "") or "",
+                    "nominal": dict(spec.get("nominal_quota", {}) or {})}
+        elif plural == "localqueues":
+            lq_key = f"{parts[3]}/{parts[4]}"
+            if deleted:
+                st.lqs.pop(lq_key, None)
+            else:
+                st.lqs[lq_key] = (ev.value.get("spec", {}) or {}).get(
+                    "cluster_queue", "")
+        if plural in ("pods", "podgroups"):
+            self._check_partials(st, ev.revision)
+
+    # -- per-object indexing (shared by seeding and live events) ----------
+
+    def _index(self, st: _StoreState, key: str, value: dict,
+               revision: int, seeding: bool) -> None:
+        parts = key.split("/")
+        plural = parts[2] if len(parts) > 2 else ""
+        if plural == "pods":
+            self._apply_pod(st, f"{parts[3]}/{parts[4]}", parts[3], value,
+                            revision, check=not seeding)
+        elif plural == "podgroups":
+            self._apply_group(st, f"{parts[3]}/{parts[4]}", value,
+                              revision, check=not seeding)
+        elif plural == "clusterqueues":
+            spec = value.get("spec", {}) or {}
+            st.cqs[parts[3]] = {
+                "cohort": spec.get("cohort", "") or "",
+                "nominal": dict(spec.get("nominal_quota", {}) or {})}
+        elif plural == "localqueues":
+            st.lqs[f"{parts[3]}/{parts[4]}"] = (
+                value.get("spec", {}) or {}).get("cluster_queue", "")
+
+    # -- pods: chip ledger + gang bind tracking ---------------------------
+
+    def _on_pod(self, st: _StoreState, ev, deleted: bool) -> None:
+        parts = ev.key.split("/")
+        pk = f"{parts[3]}/{parts[4]}"
+        if deleted:
+            for pair in st.pod_chips.pop(pk, set()):
+                if st.chips.get(pair) == pk:
+                    del st.chips[pair]
+            gk = st.pod_gang.pop(pk, None)
+            if gk is not None:
+                st.bound_by_gang.get(gk, set()).discard(pk)
+                self._update_partial(st, gk, ev.revision)
+            return
+        self._apply_pod(st, pk, parts[3], ev.value, ev.revision, check=True)
+
+    def _apply_pod(self, st: _StoreState, pk: str, ns: str, value: dict,
+                   revision: int, check: bool) -> None:
+        new_pairs = _pod_chips(value)
+        old_pairs = st.pod_chips.get(pk, set())
+        for pair in old_pairs - new_pairs:
+            if st.chips.get(pair) == pk:
+                del st.chips[pair]
+        if check:
+            self.checks[CHIP_DOUBLE_BOOK] += 1
+        for pair in new_pairs:
+            holder = st.chips.get(pair)
+            if holder is not None and holder != pk:
+                self._violate(
+                    CHIP_DOUBLE_BOOK, pk, revision,
+                    f"chip {pair[1]} on node {pair[0]} already assigned "
+                    f"to {holder}")
+            else:
+                st.chips[pair] = pk
+        st.pod_chips[pk] = new_pairs
+        spec = value.get("spec", {}) or {}
+        gang = spec.get("gang", "")
+        gk = f"{ns}/{gang}" if gang else None
+        prev_gk = st.pod_gang.get(pk)
+        if prev_gk and prev_gk != gk:
+            st.bound_by_gang.get(prev_gk, set()).discard(pk)
+        if gk is not None:
+            st.pod_gang[pk] = gk
+            bound = st.bound_by_gang.setdefault(gk, set())
+            deleting = (value.get("metadata", {}) or {}).get(
+                "deletion_timestamp")
+            if spec.get("node_name") and not deleting:
+                bound.add(pk)
+            else:
+                bound.discard(pk)
+            self._update_partial(st, gk, revision)
+        elif prev_gk:
+            st.pod_gang.pop(pk, None)
+            self._update_partial(st, prev_gk, revision)
+
+    # -- podgroups: quota conservation + admission monotonicity -----------
+
+    def _on_group(self, st: _StoreState, ev, deleted: bool) -> None:
+        parts = ev.key.split("/")
+        gk = f"{parts[3]}/{parts[4]}"
+        if deleted:
+            prev = st.groups.pop(gk, None)
+            if prev and prev["admitted"] and prev["cq"]:
+                self._uncharge(st, prev["cq"], prev["demand"])
+            self._reclaim_ok.discard(gk)
+            st.partial_since.pop(gk, None)
+            return
+        self._apply_group(st, gk, ev.value, ev.revision, check=True)
+
+    def _apply_group(self, st: _StoreState, gk: str, value: dict,
+                     revision: int, check: bool) -> None:
+        spec = value.get("spec", {}) or {}
+        status = value.get("status", {}) or {}
+        admitted = bool(status.get("admitted"))
+        queue = spec.get("queue", "") or ""
+        ns = gk.split("/", 1)[0]
+        cq = ""
+        if queue:
+            cq = (status.get("admission_cluster_queue", "")
+                  or st.lqs.get(f"{ns}/{queue}", ""))
+        cur = {"admitted": admitted, "cq": cq, "demand": _demand(value),
+               "min_member": int(spec.get("min_member", 0) or 0)}
+        prev = st.groups.get(gk)
+        st.groups[gk] = cur
+        self._update_partial(st, gk, revision)
+        if prev is None:
+            if admitted and cq:
+                self._charge(st, gk, cq, cur["demand"], revision,
+                             check=check)
+            return
+        if check:
+            self.checks[ADMISSION_MONOTONICITY] += 1
+        if prev["admitted"] and not admitted:
+            if prev["cq"]:
+                self._uncharge(st, prev["cq"], prev["demand"])
+            if check and gk not in self._reclaim_ok:
+                self._violate(
+                    ADMISSION_MONOTONICITY, gk, revision,
+                    "status.admitted flipped to False outside an "
+                    "announced reclaim (note_reclaim) or deletion")
+            self._reclaim_ok.discard(gk)
+        elif not prev["admitted"] and admitted:
+            self._charge(st, gk, cq, cur["demand"], revision, check=check)
+        elif admitted and (prev["cq"] != cq or prev["demand"] != cur["demand"]):
+            if prev["cq"]:
+                self._uncharge(st, prev["cq"], prev["demand"])
+            self._charge(st, gk, cq, cur["demand"], revision, check=check)
+
+    def _charge(self, st: _StoreState, gk: str, cq: str, demand: dict,
+                revision: int, check: bool) -> None:
+        if not cq:
+            return
+        nominal = st.cqs.get(cq, {}).get("nominal", {})
+        usage = st.usage.setdefault(cq, {})
+        for res, amt in demand.items():
+            if res in nominal:  # ungoverned resources are not charged
+                usage[res] = usage.get(res, 0.0) + amt
+        if not check:
+            return
+        self.checks[QUOTA_CONSERVATION] += 1
+        cohort = st.cqs.get(cq, {}).get("cohort", "")
+        members = ([n for n, c in st.cqs.items() if c["cohort"] == cohort]
+                   if cohort else [cq])
+        totals: dict = {}
+        used: dict = {}
+        for name in members:
+            for res, cap in st.cqs.get(name, {}).get("nominal", {}).items():
+                totals[res] = totals.get(res, 0.0) + cap
+            for res, amt in st.usage.get(name, {}).items():
+                used[res] = used.get(res, 0.0) + amt
+        for res, amt in used.items():
+            if amt > totals.get(res, 0.0) + 1e-6:
+                self._violate(
+                    QUOTA_CONSERVATION, gk, revision,
+                    f"cohort {cohort or cq}: admitted {res} usage {amt} "
+                    f"exceeds cohort nominal {totals.get(res, 0.0)} "
+                    f"(admitting {gk} broke conservation)")
+
+    @staticmethod
+    def _uncharge(st: _StoreState, cq: str, demand: dict) -> None:
+        nominal = st.cqs.get(cq, {}).get("nominal", {})
+        usage = st.usage.setdefault(cq, {})
+        for res, amt in demand.items():
+            if res in nominal:
+                usage[res] = max(0.0, usage.get(res, 0.0) - amt)
+
+    # -- gang atomicity ---------------------------------------------------
+
+    def _update_partial(self, st: _StoreState, gk: str,
+                        revision: int) -> None:
+        bound = len(st.bound_by_gang.get(gk, ()))
+        need = st.groups.get(gk, {}).get("min_member", 0)
+        if need and 0 < bound < need:
+            st.partial_since.setdefault(gk, revision)
+        else:
+            st.partial_since.pop(gk, None)
+
+    def _check_partials(self, st: _StoreState, revision: int) -> None:
+        self.checks[GANG_ATOMICITY] += 1
+        for gk, since in list(st.partial_since.items()):
+            if revision - since > self.partial_grace_revs:
+                bound = len(st.bound_by_gang.get(gk, ()))
+                need = st.groups.get(gk, {}).get("min_member", 0)
+                self._violate(
+                    GANG_ATOMICITY, gk, revision,
+                    f"gang partially bound ({bound}/{need}) while the "
+                    f"store advanced {revision - since} revisions "
+                    f"(> {self.partial_grace_revs} quorum grace)")
+
+    # -- final checks -----------------------------------------------------
+
+    def check_final(self) -> None:
+        """End-of-scenario checks: WAL-replay equivalence per attached
+        store + any still-partial gangs."""
+        for st in self._stores:
+            self._check_partials(st, st.store.revision)
+            self.checks[WAL_REPLAY] += 1
+            live = st.store.state()
+            live_flat = {k: (_canon(v["value"]), v["mod_revision"],
+                             v["create_revision"])
+                         for k, v in live["data"].items()}
+            if live["rev"] == st.shadow_rev and live_flat == st.shadow:
+                continue
+            detail = ("revision skew" if live["rev"] != st.shadow_rev
+                      else "content skew")
+            for k in sorted(set(live_flat) | set(st.shadow)):
+                if live_flat.get(k) != st.shadow.get(k):
+                    detail = f"first divergent key: {k}"
+                    break
+            self._violate(
+                WAL_REPLAY, "<store>", live["rev"],
+                f"live store diverged from its own write stream "
+                f"({detail}) — state was mutated behind the log's back")
+
+    # -- verdicts ---------------------------------------------------------
+
+    def _violate(self, invariant: str, key: str, revision: int,
+                 message: str) -> None:
+        if (invariant, key) in self._reported:
+            return
+        self._reported.add((invariant, key))
+        v = Violation(invariant, key, message, revision)
+        self.violations.append(v)
+        log.error("tpusan violation: %s", v)
+
+    def report(self) -> dict:
+        return {"checks": dict(self.checks),
+                "violations": [str(v) for v in self.violations]}
+
+    def assert_clean(self) -> None:
+        if self.violations:
+            lines = "\n  ".join(str(v) for v in self.violations)
+            raise AssertionError(
+                f"tpusan: {len(self.violations)} invariant violation(s):\n"
+                f"  {lines}")
+
+
+#: Process-global registry new stores self-attach to; None = disarmed.
+SANITIZER: Optional[InvariantRegistry] = None
+
+
+def arm(registry: Optional[InvariantRegistry] = None) -> InvariantRegistry:
+    global SANITIZER
+    SANITIZER = registry or InvariantRegistry()
+    return SANITIZER
+
+
+def disarm() -> None:
+    global SANITIZER
+    SANITIZER = None
+
+
+def note_reclaim(group_key: str) -> None:
+    """Module-level seam for QueueController._unadmit: no-op unless a
+    sanitizer is armed."""
+    if SANITIZER is not None:
+        SANITIZER.note_reclaim(group_key)

@@ -414,3 +414,481 @@ class CacheMutationPass(Pass):
                 for stmt in node.body:
                     v.visit(stmt)
         return findings
+
+
+# ---------------------------------------------------------------------------
+# task-leak
+# ---------------------------------------------------------------------------
+
+_SPAWNERS = {"create_task", "ensure_future"}
+
+
+def _is_spawn_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    return ((isinstance(f, ast.Attribute) and f.attr in _SPAWNERS)
+            or (isinstance(f, ast.Name) and f.id in _SPAWNERS))
+
+
+@register
+class TaskLeakPass(Pass):
+    name = "task-leak"
+    description = ("fire-and-forget asyncio.create_task/ensure_future "
+                   "whose Task is discarded: the loop holds tasks only "
+                   "weakly (the task can be GC'd mid-flight) and a crash "
+                   "inside it is swallowed — retain it and handle the "
+                   "exception (util/tasks.py spawn())")
+
+    def check_module(self, ctx: Context, mod: Module) -> Iterable[Finding]:
+        if mod.path.endswith("util/tasks.py"):
+            return  # the remediation helper itself
+        for node in ast.walk(mod.tree):
+            # Bare statement: the Task is dropped on the floor.
+            if isinstance(node, ast.Expr) and _is_spawn_call(node.value):
+                yield Finding(
+                    mod.path, node.lineno, node.col_offset, self.name,
+                    "create_task() result discarded — the task may be "
+                    "GC'd mid-flight and its exception is swallowed; "
+                    "use util.tasks.spawn() or retain + add_done_callback")
+            # A lambda returning the task hands it to a caller that
+            # discards it (call_later(cb) ignores cb's return value).
+            elif isinstance(node, ast.Lambda) and _is_spawn_call(node.body):
+                yield Finding(
+                    mod.path, node.lineno, node.col_offset, self.name,
+                    "lambda spawns a task whose handle the caller "
+                    "discards — same leak as a bare create_task(); use "
+                    "util.tasks.spawn() inside the lambda")
+
+
+# ---------------------------------------------------------------------------
+# informer-mutation (interprocedural)
+# ---------------------------------------------------------------------------
+
+
+class _ParamMutation(ast.NodeVisitor):
+    """Which of one function's parameters does its body mutate in
+    place? (Attribute/Subscript stores, container mutators, del —
+    through the parameter name, unless the name is rebound first.)
+    Also records parameter pass-through call edges for the transitive
+    fixpoint."""
+
+    def __init__(self, params: list[str]):
+        self.live = set(params)       # params not yet rebound
+        self.order = list(params)
+        self.mutated: set[str] = set()
+        #: (callee simple name, callee arg index, own param name)
+        self.passes: list[tuple[str, int, str]] = []
+
+    def _root(self, node):
+        while isinstance(node, (ast.Attribute, ast.Subscript)):
+            node = node.value
+        return node.id if isinstance(node, ast.Name) else None
+
+    def _flag_store(self, target) -> None:
+        if isinstance(target, ast.Tuple):
+            for elt in target.elts:
+                self._flag_store(elt)
+            return
+        if isinstance(target, (ast.Attribute, ast.Subscript)):
+            root = self._root(target)
+            if root in self.live:
+                self.mutated.add(root)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for target in node.targets:
+            self._flag_store(target)
+        self.visit(node.value)
+        for target in node.targets:
+            for elt in (target.elts if isinstance(target, ast.Tuple)
+                        else [target]):
+                if isinstance(elt, ast.Name):
+                    self.live.discard(elt.id)  # rebound: laundered
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            self._flag_store(node.target)
+            self.visit(node.value)
+            if isinstance(node.target, ast.Name):
+                self.live.discard(node.target.id)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._flag_store(node.target)
+        self.visit(node.value)
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        for target in node.targets:
+            self._flag_store(target)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            if f.attr in _MUTATORS:
+                root = self._root(f.value)
+                if root in self.live:
+                    self.mutated.add(root)
+            # Method pass-through: self.helper(param) — arg i maps to
+            # the callee's param i+1 (past self).
+            for i, arg in enumerate(node.args):
+                if isinstance(arg, ast.Name) and arg.id in self.live:
+                    self.passes.append((f.attr, i + 1, arg.id))
+        elif isinstance(f, ast.Name):
+            for i, arg in enumerate(node.args):
+                if isinstance(arg, ast.Name) and arg.id in self.live:
+                    self.passes.append((f.id, i, arg.id))
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node):  # separate scope
+        return
+
+    def visit_AsyncFunctionDef(self, node):
+        return
+
+    def visit_Lambda(self, node):
+        return
+
+
+def _fn_params(fn) -> list[str]:
+    a = fn.args
+    return [p.arg for p in (*a.posonlyargs, *a.args)]
+
+
+class _CacheArgSites(ast.NodeVisitor):
+    """Taint names bound from informer/cache getters (the
+    cache-mutation source model) and record every call that passes a
+    tainted name as a positional argument."""
+
+    def __init__(self, mod: Module):
+        self.mod = mod
+        self.tainted: set[str] = set()
+        self.tainted_lists: set[str] = set()
+        #: (line, col, callee simple name, is_method, arg index, name)
+        self.sites: list[tuple] = []
+
+    def _bind(self, target, value) -> None:
+        if not isinstance(target, ast.Name):
+            return
+        if _cache_getter_call(value):
+            attr = value.func.attr  # type: ignore[union-attr]
+            (self.tainted_lists if attr in ("list", "by_index")
+             else self.tainted).add(target.id)
+            return
+        if (isinstance(value, ast.Subscript)
+                and isinstance(value.value, ast.Name)
+                and value.value.id in self.tainted_lists):
+            self.tainted.add(target.id)
+            return
+        self.tainted.discard(target.id)
+        self.tainted_lists.discard(target.id)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        self.visit(node.value)
+        for target in node.targets:
+            if isinstance(target, ast.Tuple):
+                for elt in target.elts:
+                    self._bind(elt, node.value)
+            else:
+                self._bind(target, node.value)
+
+    def visit_For(self, node: ast.For) -> None:
+        it = node.iter
+        if isinstance(node.target, ast.Name):
+            if _cache_getter_call(it) and it.func.attr in ("list", "by_index"):  # type: ignore[union-attr]
+                self.tainted.add(node.target.id)
+            elif isinstance(it, ast.Name) and it.id in self.tainted_lists:
+                self.tainted.add(node.target.id)
+        for stmt in node.body + node.orelse:
+            self.visit(stmt)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        f = node.func
+        callee = is_method = None
+        if isinstance(f, ast.Attribute):
+            callee, is_method = f.attr, True
+        elif isinstance(f, ast.Name):
+            callee, is_method = f.id, False
+        if callee:
+            for i, arg in enumerate(node.args):
+                name = None
+                if isinstance(arg, ast.Name) and arg.id in self.tainted:
+                    name = arg.id
+                if name is not None:
+                    self.sites.append((node.lineno, node.col_offset,
+                                       callee, is_method, i, name))
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node):
+        return
+
+    def visit_AsyncFunctionDef(self, node):
+        return
+
+    def visit_Lambda(self, node):
+        return
+
+
+@register
+class InformerMutationPass(Pass):
+    name = "informer-mutation"
+    description = ("cached object handed to a function that mutates its "
+                   "parameter in place (interprocedural cache-mutation: "
+                   "the write happens one call away, past what the "
+                   "per-function taint pass can see)")
+
+    _SELF_PATHS = CacheMutationPass._SELF_PATHS
+
+    def check_module(self, ctx: Context, mod: Module) -> Iterable[Finding]:
+        scratch = ctx.scratch(self.name)
+        summaries = scratch.setdefault("summaries", {})
+        sites = scratch.setdefault("sites", [])
+        # Phase A: mutation summaries for every function/method.
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            params = _fn_params(node)
+            v = _ParamMutation(params)
+            for stmt in node.body:
+                v.visit(stmt)
+            is_method = bool(params) and params[0] in ("self", "cls")
+            summaries.setdefault(node.name, []).append({
+                "path": mod.path, "params": params,
+                "mutated": v.mutated, "passes": v.passes,
+                "is_method": is_method})
+        # Phase B inputs: tainted-arg call sites (consumers only).
+        if any(p in mod.path for p in self._SELF_PATHS):
+            return ()
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                v = _CacheArgSites(mod)
+                for stmt in node.body:
+                    v.visit(stmt)
+                for line, col, callee, is_method, i, name in v.sites:
+                    sites.append((mod.path, line, col, callee,
+                                  is_method, i, name))
+        return ()
+
+    def finalize(self, ctx: Context) -> Iterable[Finding]:
+        scratch = ctx.scratch(self.name)
+        summaries = scratch.get("summaries", {})
+        # Transitive closure: f passes param p to g at position j and g
+        # mutates its j-th param => f mutates p.
+        changed = True
+        while changed:
+            changed = False
+            for cands in summaries.values():
+                for s in cands:
+                    for callee, j, pname in s["passes"]:
+                        if pname in s["mutated"]:
+                            continue
+                        if self._position_mutated(summaries, s["path"],
+                                                  callee, j):
+                            s["mutated"].add(pname)
+                            changed = True
+
+        def param_index(is_method_call: bool, arg_i: int) -> int:
+            return arg_i + 1 if is_method_call else arg_i
+
+        for path, line, col, callee, is_method, i, name in \
+                scratch.get("sites", []):
+            j = param_index(is_method, i)
+            if self._position_mutated(summaries, path, callee, j,
+                                      method=is_method):
+                yield Finding(
+                    path, line, col, self.name,
+                    f"cached object {name!r} passed to {callee}(), which "
+                    f"mutates that parameter in place — hand it a "
+                    f"deepcopy/dataclasses.replace copy instead "
+                    f"(shared-cache corruption one call away)")
+
+    @staticmethod
+    def _position_mutated(summaries, caller_path: str, callee: str,
+                          j: int, method: bool = None) -> bool:
+        """Does (any plausible resolution of) ``callee`` mutate its
+        j-th parameter? Same-module definitions win; cross-module
+        matches count only when the name is unique tree-wide —
+        ambiguous common names (update, get...) are skipped rather
+        than guessed."""
+        cands = summaries.get(callee, [])
+        if method is not None:
+            cands = [s for s in cands if s["is_method"] == method]
+        if not cands:
+            return False
+        local = [s for s in cands if s["path"] == caller_path]
+        pick = local if local else (cands if len(cands) == 1 else [])
+        for s in pick:
+            if j < len(s["params"]) and s["params"][j] in s["mutated"]:
+                return True
+        return False
+
+
+# ---------------------------------------------------------------------------
+# status-write (interprocedural)
+# ---------------------------------------------------------------------------
+
+#: Exception names that make a surrounding try a conflict guard.
+_CONFLICT_GUARDS = {"ConflictError", "StatusError", "Exception",
+                    "BaseException"}
+
+
+def _is_status_write(node: ast.Call) -> bool:
+    f = node.func
+    if not isinstance(f, ast.Attribute):
+        return False
+    if f.attr == "update_status":
+        return True
+    if f.attr == "update":
+        for kw in node.keywords:
+            if (kw.arg == "subresource"
+                    and isinstance(kw.value, ast.Constant)
+                    and kw.value.value == "status"):
+                return True
+    return False
+
+
+def _handler_names(handler: ast.ExceptHandler) -> set[str]:
+    t = handler.type
+    if t is None:
+        return {"BaseException"}
+    elts = t.elts if isinstance(t, ast.Tuple) else [t]
+    out = set()
+    for e in elts:
+        if isinstance(e, ast.Name):
+            out.add(e.id)
+        elif isinstance(e, ast.Attribute):
+            out.add(e.attr)
+    return out
+
+
+class _StatusWriteSites(ast.NodeVisitor):
+    """Status-write call sites in one function body, with whether a
+    lexically-enclosing try guards against write conflicts."""
+
+    def __init__(self):
+        self.sites: list[tuple[int, int, bool]] = []
+        self._guard_depth = 0
+
+    def visit_Try(self, node: ast.Try) -> None:
+        guards = any(_handler_names(h) & _CONFLICT_GUARDS
+                     for h in node.handlers)
+        self._guard_depth += 1 if guards else 0
+        for stmt in node.body:
+            self.visit(stmt)
+        self._guard_depth -= 1 if guards else 0
+        # Handlers/else/finally are NOT under this try's guard.
+        for h in node.handlers:
+            for stmt in h.body:
+                self.visit(stmt)
+        for stmt in node.orelse + node.finalbody:
+            self.visit(stmt)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if _is_status_write(node):
+            self.sites.append((node.lineno, node.col_offset,
+                               self._guard_depth > 0))
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node):
+        return
+
+    def visit_AsyncFunctionDef(self, node):
+        return
+
+
+@register
+class StatusWritePass(Pass):
+    name = "status-write"
+    description = ("status update without an rv-conflict guard: not "
+                   "reachable from a controller sync() (whose worker "
+                   "retries ConflictError) and not wrapped in a "
+                   "try/except that handles the conflict — a stale "
+                   "write either raises through an unprepared path or "
+                   "silently loses")
+
+    #: Method names whose callers retry on error even outside the
+    #: Controller worker (reconcile-style loops that catch per cycle).
+    _RETRY_ROOT = "sync"
+
+    def check_module(self, ctx: Context, mod: Module) -> Iterable[Finding]:
+        if mod.path.endswith("client/interface.py"):
+            return ()  # defines the write primitive; not a consumer
+        scratch = ctx.scratch(self.name)
+        per_class = scratch.setdefault("classes", [])
+        loose = scratch.setdefault("functions", [])
+        for node in mod.tree.body:
+            if isinstance(node, ast.ClassDef):
+                base_names = set()
+                for b in node.bases:
+                    if isinstance(b, ast.Name):
+                        base_names.add(b.id)
+                    elif isinstance(b, ast.Attribute):
+                        base_names.add(b.attr)
+                methods = {}
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        methods[item.name] = self._analyze(item)
+                per_class.append({"path": mod.path, "name": node.name,
+                                  "bases": base_names, "methods": methods})
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                loose.append((mod.path, node.name, self._analyze(node)))
+        return ()
+
+    @staticmethod
+    def _analyze(fn) -> dict:
+        v = _StatusWriteSites()
+        calls: set[str] = set()
+        for stmt in fn.body:
+            v.visit(stmt)
+        for node in ast.walk(fn):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == "self"):
+                calls.add(node.func.attr)
+        return {"sites": v.sites, "calls": calls}
+
+    def finalize(self, ctx: Context) -> Iterable[Finding]:
+        scratch = ctx.scratch(self.name)
+        #: Class names whose sync() is framework-retried: Controller
+        #: subclasses (by base name — the worker catches ConflictError
+        #: and requeues) plus the base itself.
+        controllerish = {"Controller"}
+        classes = scratch.get("classes", [])
+        grew = True
+        while grew:  # transitive subclasses, cross-module by name
+            grew = False
+            for c in classes:
+                if c["name"] not in controllerish \
+                        and c["bases"] & controllerish:
+                    controllerish.add(c["name"])
+                    grew = True
+        for c in classes:
+            retried = set()
+            if c["name"] in controllerish and self._RETRY_ROOT in c["methods"]:
+                frontier = [self._RETRY_ROOT]
+                while frontier:
+                    m = frontier.pop()
+                    if m in retried or m not in c["methods"]:
+                        continue
+                    retried.add(m)
+                    frontier.extend(c["methods"][m]["calls"])
+            for mname, info in c["methods"].items():
+                reachable = mname in retried
+                for line, col, guarded in info["sites"]:
+                    if guarded or reachable:
+                        continue
+                    yield Finding(
+                        c["path"], line, col, self.name,
+                        f"status write in {c['name']}.{mname}() has no "
+                        f"conflict guard: not reachable from a "
+                        f"controller sync() and not inside a try that "
+                        f"handles ConflictError/StatusError — retry or "
+                        f"route it through the reconcile loop")
+        for path, fname, info in scratch.get("functions", []):
+            for line, col, guarded in info["sites"]:
+                if not guarded:
+                    yield Finding(
+                        path, line, col, self.name,
+                        f"status write in {fname}() has no conflict "
+                        f"guard — wrap in try/except ConflictError (or "
+                        f"StatusError) with a retry")

@@ -339,3 +339,30 @@ async def run_chaos(seed: int, n_nodes: int = 4, gangs: int = 4,
         # Deterministic by seed: the on-disk state is reproducible, so
         # never leave ktpu-chaos-* dirs to accumulate.
         shutil.rmtree(data_dir, ignore_errors=True)
+
+
+def run_chaos_schedules(seed: int, schedules: int = 8, mode: str = "dpor",
+                        n_nodes: int = 2, gangs: int = 2,
+                        timeout: float = 30.0) -> dict:
+    """The tpusan arm of the chaos gate: the SAME seeded fault scenario
+    explored under ``schedules`` distinct task-interleaving schedules,
+    with the cluster-invariant sanitizer armed — every store write on
+    every schedule is checked (chip double-book, quota conservation,
+    gang atomicity, admission monotonicity, WAL-replay equality), not
+    just the harness's end-state asserts.
+
+    Alternate runs enable queueing so the admission invariants are
+    exercised against real reclaim/admission traffic, not just no-ops.
+    Raises on any convergence failure or invariant violation; the
+    failing (chaos seed, tpusan seed) pair replays it. Returns an
+    aggregate report (fingerprints, invariant check counts)."""
+    from ..analysis import interleave
+
+    rep = interleave.explore_sanitized(
+        lambda i: run_chaos(seed, n_nodes=n_nodes, gangs=gangs,
+                            timeout=timeout, queueing=bool(i % 2)),
+        base_seed=seed, schedules=schedules, mode=mode,
+        extract=lambda v: {"queueing": v["queueing"],
+                           "pods_bound": v["pods_bound"]})
+    rep["seed"] = seed
+    return rep

@@ -16,6 +16,7 @@ from ..api import errors
 from ..api.meta import ObjectMeta, now
 from ..api.scheme import DEFAULT_SCHEME
 from ..api.types import Event, EventSource, ObjectReference
+from ..util.tasks import spawn
 from .interface import Client
 
 log = logging.getLogger("events")
@@ -82,10 +83,11 @@ class EventRecorder:
         if not self._allow(event_type):
             return
         try:
-            loop = asyncio.get_running_loop()
+            asyncio.get_running_loop()
         except RuntimeError:
             return
-        loop.create_task(self._emit(obj, event_type, reason, message))
+        spawn(self._emit(obj, event_type, reason, message),
+              name="event-emit")
 
     async def _emit(self, obj: Any, event_type: str, reason: str, message: str) -> None:
         try:

@@ -12,6 +12,8 @@ import asyncio
 import heapq
 from typing import Any, Hashable, Optional
 
+from ..util.tasks import spawn
+
 
 class WorkQueue:
     """FIFO with dedup + processing semantics, asyncio-native."""
@@ -42,7 +44,7 @@ class WorkQueue:
         if item in self._processing:
             return
         self._queue.append(item)
-        asyncio.get_running_loop().create_task(self._notify())
+        spawn(self._notify(), name="workqueue-notify")
 
     async def _notify(self) -> None:
         async with self._cond:

@@ -35,6 +35,7 @@ import logging
 import math
 from typing import Callable, Optional
 
+from ..analysis import interleave, invariants
 from ..api import errors, types as t
 from ..api.meta import now as meta_now
 from ..api.queueing import RUNTIME_ANNOTATION
@@ -320,6 +321,7 @@ class QueueController(Controller):
     # -- the pass ---------------------------------------------------------
 
     async def _admission_pass(self) -> None:
+        interleave.touch("queue:admission")  # tpusan DPOR hint
         queues, admitted, pending, groups, lq_of, cqs, lqs = self._snapshot()
         wall = meta_now().timestamp()
         order = fs.drf_order(queues, pending)
@@ -473,6 +475,11 @@ class QueueController(Controller):
         PodGroup itself survives — preempted and requeued, never
         orphaned."""
         ns, name = group.metadata.namespace, group.metadata.name
+        # Announce the unadmit BEFORE any write lands: tpusan's
+        # admission-monotonicity invariant treats an unannounced
+        # admitted->pending flip as a violation.
+        invariants.note_reclaim(w.key)
+        interleave.touch(f"gang:{w.key}")
         self._admitted_overlay.pop(w.key, None)
         try:
             cur = await self.client.get("podgroups", ns, name)

@@ -20,7 +20,9 @@
 // Run:   _libtpu_probe [path/to/libtpu.so]
 
 #include <dlfcn.h>
+#include <unistd.h>
 
+#include <csignal>
 #include <cstddef>
 #include <cstdint>
 #include <cstdio>
@@ -94,9 +96,37 @@ std::string error_message(const PJRT_Api* api, PJRT_Error* err) {
 #define API_HAS(api, field) \
   ((api)->struct_size > offsetof(PJRT_Api, field) && (api)->field != nullptr)
 
+// The probe's whole contract is "always terminates with a JSON
+// verdict", but PJRT_Client_Create inside libtpu can block forever on
+// a host with no reachable TPU (or with the chips/lockfile held by
+// another process) — observed wedging the caller for its full
+// subprocess timeout. A SIGALRM watchdog turns that hang into the
+// answer it actually is: tpu:false. Async-signal-safe by construction
+// (write + _exit only); nothing is buffered on stdout until the final
+// verdict, so the direct write cannot interleave with stdio output.
+extern "C" void watchdog_fire(int) {
+  static const char msg[] =
+      "{\"tpu\": false, \"error\": \"watchdog: PJRT initialization did not "
+      "terminate\", \"source\": \"libtpu_probe\"}\n";
+  ssize_t n = write(STDOUT_FILENO, msg, sizeof msg - 1);
+  (void)n;
+  _exit(0);
+}
+
 }  // namespace
 
 int main(int argc, char** argv) {
+  // Watchdog before any PJRT call (see watchdog_fire above).
+  // TPU_PROBE_TIMEOUT_S overrides; default leaves real-hardware init
+  // (~10-20s cold) comfortable room.
+  unsigned watchdog_s = 30;
+  if (const char* w = std::getenv("TPU_PROBE_TIMEOUT_S")) {
+    long v = std::strtol(w, nullptr, 10);
+    if (v > 0) watchdog_s = static_cast<unsigned>(v);
+  }
+  std::signal(SIGALRM, watchdog_fire);
+  alarm(watchdog_s);
+
   // Candidate library paths: an explicit argv[1] is authoritative (no
   // soname fallback — a caller that named a path wants THAT library,
   // and a surprise fallback would seize the host's chips); otherwise
@@ -145,6 +175,12 @@ int main(int argc, char** argv) {
   cc.struct_size = PJRT_Client_Create_Args_STRUCT_SIZE;
   CHECK_PJRT(api, api->PJRT_Client_Create(&cc));
   PJRT_Client* client = cc.client;
+  // Client creation is the hang-prone call; past it, enumeration is
+  // quick queries. Cancel the watchdog so a slow-but-successful probe
+  // (real hardware, ~20s init) can't have its buffered true verdict
+  // discarded by a late alarm firing mid-enumeration or during the
+  // (potentially slow) client destroy below.
+  alarm(0);
 
   PJRT_Client_PlatformName_Args pn;
   std::memset(&pn, 0, sizeof pn);

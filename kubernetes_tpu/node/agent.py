@@ -31,6 +31,7 @@ from ..client.informer import SharedInformer
 from ..client.interface import Client
 from ..client.record import EventRecorder
 from ..net.envvars import service_env_vars
+from ..util.tasks import spawn
 from ..net.ipam import (PodIPAllocator, default_node_cidr,
                         rebuild_pod_allocator)
 from . import containermanager as cm
@@ -440,7 +441,7 @@ class NodeAgent:
 
     def _on_topology_changed(self) -> None:
         if not self._stopped:
-            asyncio.get_running_loop().create_task(self._post_status())
+            spawn(self._post_status(), name="post-status")
 
     # -- pod source handlers ---------------------------------------------
 
@@ -1114,7 +1115,7 @@ class NodeAgent:
                         timeout=max(self._pod_grace(pod), 1.0))
             await self.runtime.stop_container(cid, grace_seconds=1.0)
             self._nudge(pod_key)
-        asyncio.get_running_loop().create_task(restart())
+        spawn(restart(), name="probe-restart")
 
     # -- status calculation (kubelet syncPod status half) -----------------
 

@@ -23,6 +23,7 @@ from typing import Callable, Optional
 
 from ..api import types as t
 from ..deviceplugin import api_pb2 as pb
+from ..util.tasks import spawn
 from ..deviceplugin.service import TpuDevicePluginClient
 from ..metrics.registry import Histogram
 
@@ -183,7 +184,7 @@ class DeviceManager:
         self._endpoints.pop(ep.socket_path, None)
         # Close the dead endpoint's channel (fd/threads) before the next
         # scan dials a fresh one.
-        asyncio.get_running_loop().create_task(ep.stop())
+        spawn(ep.stop(), name="endpoint-stop")
         self._clear_topology_if_from(ep)
 
     def _clear_topology_if_from(self, ep: Endpoint) -> None:

@@ -209,3 +209,22 @@ async def run_queue_smoke(timeout: float = 30.0,
             await factory.stop_all()  # last: the scheduler rides it too
         if not was_on:
             GATES.set("JobQueueing", False)
+
+
+def run_queue_smoke_schedules(base_seed, schedules: int = 4,
+                              mode: str = "dpor",
+                              timeout: float = 30.0) -> dict:
+    """The tpusan arm of the queueing gate: the same two-tenant
+    admission story explored under ``schedules`` seeded interleavings
+    with the invariant sanitizer armed — the DRF/borrow/reclaim path
+    must hold conservation and monotonicity on EVERY schedule, not just
+    the one the event loop happens to produce. Raises on any scenario
+    assert or invariant violation (the tpusan seed replays it)."""
+    from ..analysis import interleave
+
+    rep = interleave.explore_sanitized(
+        lambda i: run_queue_smoke(timeout=timeout),
+        base_seed=base_seed, schedules=schedules, mode=mode,
+        extract=lambda v: {"reclaimed_gangs": v["reclaimed_gangs"]})
+    rep["base_seed"] = base_seed
+    return rep

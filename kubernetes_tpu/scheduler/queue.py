@@ -14,7 +14,9 @@ import itertools
 from dataclasses import dataclass, field
 from typing import Optional, Union
 
+from ..analysis import interleave
 from ..api import types as t
+from ..util.tasks import spawn
 
 
 @dataclass(order=True)
@@ -114,6 +116,7 @@ class SchedulingQueue:
         non-empty heap whenever the PodGroup's watch event arrived
         AFTER its pods (a relist after a dropped watch reorders
         exactly that way; found by the chaos harness)."""
+        interleave.touch(f"gang:{gk}")  # tpusan DPOR hint: release path
         if gk in self._gang_suspended:
             return False  # unadmitted: the admission gate (queueing/)
         staged = self._gangs.get(gk)
@@ -163,7 +166,9 @@ class SchedulingQueue:
         """Unschedulable item returns to the queue after ``backoff``."""
         if backoff > 0:
             loop = asyncio.get_running_loop()
-            loop.call_later(backoff, lambda: loop.create_task(self._requeue_now(item)))
+            loop.call_later(backoff,
+                            lambda: spawn(self._requeue_now(item),
+                                          name="queue-requeue"))
         else:
             await self._requeue_now(item)
 
@@ -182,6 +187,7 @@ class SchedulingQueue:
         """A gang member got bound: move it from staging to the bound set
         so quorum still counts it and the remainder keeps releasing."""
         gk = f"{pod.metadata.namespace}/{pod.spec.gang}"
+        interleave.touch(f"gang:{gk}")  # tpusan DPOR hint: bind path
         self._gang_bound.setdefault(gk, set()).add(pod.key())
         staged = self._gangs.get(gk)
         if staged:

@@ -25,6 +25,7 @@ from ..api.scheme import deepcopy
 from ..client.informer import SharedInformer
 from ..client.interface import Client
 from ..client.record import EventRecorder
+from ..util.tasks import spawn
 from ..util.trace import Trace
 from . import metrics as m
 from .cache import SchedulerCache
@@ -297,16 +298,14 @@ class Scheduler:
                 and t.is_pod_active(pod))
 
     def _pod_added(self, pod: t.Pod) -> None:
-        loop = asyncio.get_running_loop()
         if not pod.spec.node_name and self._relevant(pod):
-            loop.create_task(self.queue.add_pod(pod))
+            spawn(self.queue.add_pod(pod), name="queue-add-pod")
         elif pod.spec.node_name:
             self.cache.add_pod(pod)
             if pod.spec.gang:
                 self.queue.gang_pod_confirmed(pod)
 
     def _pod_updated(self, old: t.Pod, pod: t.Pod) -> None:
-        loop = asyncio.get_running_loop()
         if pod.spec.node_name:
             self.cache.update_pod(pod)
             if pod.spec.gang:
@@ -315,12 +314,11 @@ class Scheduler:
                 # Terminal pods free their chips for future placements.
                 self.cache.remove_pod(pod)
         elif self._relevant(pod):
-            loop.create_task(self.queue.add_pod(pod))
+            spawn(self.queue.add_pod(pod), name="queue-add-pod")
 
     def _pod_deleted(self, pod: t.Pod) -> None:
-        loop = asyncio.get_running_loop()
         self.cache.remove_pod(pod)
-        loop.create_task(self.queue.remove_pod(pod))
+        spawn(self.queue.remove_pod(pod), name="queue-remove-pod")
 
     def _group_changed_add(self, group: t.PodGroup) -> None:
         self._group_changed(None, group)

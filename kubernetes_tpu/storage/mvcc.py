@@ -51,6 +51,7 @@ import zlib
 from dataclasses import dataclass, field
 from typing import Any, Callable, Iterable, Optional
 
+from ..analysis import interleave, invariants
 from ..api import errors
 from ..chaos import core as chaos
 from ..util.lockdep import make_lock
@@ -306,12 +307,18 @@ class MVCCStore:
         self._watches: list[Watch] = []
         #: Key-level write listeners (see :meth:`add_write_hook`).
         self._write_hooks: list[Callable[[str], None]] = []
+        #: Full-event listeners (see :meth:`add_event_hook`).
+        self._event_hooks: list[Callable[[WatchEvent], None]] = []
         self._data_dir = data_dir
         self._wal = None
         if data_dir:
             os.makedirs(data_dir, exist_ok=True)
             self._load()
             self._wal = open(os.path.join(data_dir, "wal.jsonl"), "a", buffering=1)
+        if invariants.SANITIZER is not None:
+            # tpusan: every store built while the sanitizer is armed is
+            # checked on every write (chaos harness restarts included).
+            invariants.SANITIZER.attach_store(self)
 
     @property
     def durable(self) -> bool:
@@ -483,9 +490,18 @@ class MVCCStore:
         back into the store."""
         self._write_hooks.append(fn)
 
+    def add_event_hook(self, fn: Callable[[WatchEvent], None]) -> None:
+        """Like :meth:`add_write_hook` but with the full event (type,
+        key, value, prev_value, revision) — the tpusan invariant seam.
+        Same contract: cheap, non-raising, no store re-entry."""
+        self._event_hooks.append(fn)
+
     def _append_event(self, ev: WatchEvent) -> None:
+        interleave.touch(ev.key)
         for hook in self._write_hooks:
             hook(ev.key)
+        for hook in self._event_hooks:
+            hook(ev)
         self._log.append(ev)
         self._log_revs.append(ev.revision)
         if len(self._log) > self._history_limit:

@@ -61,6 +61,15 @@ def pytest_pyfunc_call(pyfuncitem):
                     # One tick so call_soon'd close callbacks scheduled
                     # by the finalizers run before the loop shuts down.
                     await asyncio.sleep(0)
-        asyncio.run(_run())
+        # TPU_SAN=<seed>: run every coroutine test under a seeded
+        # tpusan interleaving (per-test sub-seed so one env var fuzzes
+        # the whole suite, and a failing test names its replay seed).
+        san_seed = os.environ.get("TPU_SAN", "")
+        if san_seed:
+            from kubernetes_tpu.analysis import interleave
+            interleave.run(_run(), f"{san_seed}:{pyfuncitem.nodeid}",
+                           interleave.mode_from_env())
+        else:
+            asyncio.run(_run())
         return True
     return None

@@ -35,6 +35,15 @@ async def test_scales_down_prefers_unready_pods():
             pod.spec.node_name = "n1"
             reg.update(pod)
             mark_ready(reg, reg.get("pods", "default", pod.metadata.name))
+        # The controller picks scale-down victims from ITS informer
+        # cache, not the registry: scale down only after it has
+        # OBSERVED both ready pods (its published status is the
+        # observation artifact). Without this, the readiness events
+        # race the replicas update and the controller deletes a ready
+        # pod — which then lingers in graceful deletion past the wait
+        # below (the flake tpusan reproduced on ~half of schedules).
+        await wait_for(lambda: reg.get("replicasets", "default", "rs")
+                       .status.ready_replicas == 2)
         rs = reg.get("replicasets", "default", "rs")
         rs.spec.replicas = 2
         reg.update(rs)

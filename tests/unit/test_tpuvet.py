@@ -263,7 +263,190 @@ except Exception:  # tpuvet: ignore[swallowed-exception]
 
 def test_registry_has_all_passes():
     assert {"swallowed-exception", "async-blocking", "feature-gate",
-            "metric-name", "cache-mutation"} <= set(REGISTRY)
+            "metric-name", "cache-mutation", "task-leak",
+            "informer-mutation", "status-write"} <= set(REGISTRY)
+
+
+# ---------------------------------------------------------------------------
+# task-leak
+# ---------------------------------------------------------------------------
+
+def test_task_leak_bad():
+    bad = """
+import asyncio
+def handler(self, pod):
+    asyncio.get_running_loop().create_task(self.queue.add(pod))
+def later(self, loop, item):
+    loop.call_later(1.0, lambda: loop.create_task(self.requeue(item)))
+"""
+    got = run_source(bad, checks=["task-leak"])
+    assert names(got) == ["task-leak", "task-leak"]
+
+
+def test_task_leak_good():
+    good = """
+import asyncio
+from kubernetes_tpu.util.tasks import spawn
+def handler(self, pod):
+    spawn(self.queue.add(pod), name="add")
+def retained(self, coro):
+    task = asyncio.get_running_loop().create_task(coro)
+    self._tasks.append(task)
+    task.add_done_callback(self._tasks.remove)
+def started(self, loop):
+    self._workers = [loop.create_task(self._worker(i)) for i in range(2)]
+"""
+    assert run_source(good, checks=["task-leak"]) == []
+
+
+def test_task_leak_suppression():
+    src = """
+import asyncio
+def fire(self, coro):
+    asyncio.get_running_loop().create_task(coro)  # tpuvet: ignore[task-leak]
+"""
+    assert run_source(src, checks=["task-leak"]) == []
+
+
+# ---------------------------------------------------------------------------
+# informer-mutation (interprocedural)
+# ---------------------------------------------------------------------------
+
+def test_informer_mutation_bad():
+    bad = """
+def scrub(pod):
+    pod.metadata.labels.pop("stale", None)
+
+def sync(self, key):
+    pod = self.pod_informer.get(key)
+    scrub(pod)
+"""
+    got = run_source(bad, checks=["informer-mutation"])
+    assert names(got) == ["informer-mutation"]
+
+
+def test_informer_mutation_transitive():
+    # sync -> relabel -> scrub: the mutation is two calls away.
+    bad = """
+def scrub(pod):
+    pod.metadata.labels.clear()
+
+def relabel(pod):
+    scrub(pod)
+
+def sync(self, key):
+    pod = self.pod_informer.get(key)
+    relabel(pod)
+"""
+    got = run_source(bad, checks=["informer-mutation"])
+    assert names(got) == ["informer-mutation"]
+
+
+def test_informer_mutation_good():
+    good = """
+from copy import deepcopy
+
+def scrub(pod):
+    pod.metadata.labels.pop("stale", None)
+
+def annotate(pod):
+    return dict(pod.metadata.labels)
+
+def sync(self, key):
+    pod = self.pod_informer.get(key)
+    labels = annotate(pod)          # read-only callee: fine
+    fresh = deepcopy(pod)
+    scrub(fresh)                    # laundered copy: fine
+    pod2 = deepcopy(self.pod_informer.get(key))
+    scrub(pod2)                     # rebind launders the name
+"""
+    assert run_source(good, checks=["informer-mutation"]) == []
+
+
+def test_informer_mutation_method_callee():
+    bad = """
+class C:
+    def _strip(self, pod):
+        del pod.metadata.annotations["x"]
+
+    def sync(self, key):
+        pod = self.informer.get(key)
+        self._strip(pod)
+"""
+    got = run_source(bad, checks=["informer-mutation"])
+    assert names(got) == ["informer-mutation"]
+
+
+# ---------------------------------------------------------------------------
+# status-write (interprocedural)
+# ---------------------------------------------------------------------------
+
+def test_status_write_bad_unreachable_method():
+    bad = """
+class Agent:
+    async def heartbeat(self):
+        cur = await self.client.get("nodes", "", self.name)
+        await self.client.update_status(cur)
+"""
+    got = run_source(bad, checks=["status-write"])
+    assert names(got) == ["status-write"]
+
+
+def test_status_write_good_guarded():
+    good = """
+from kubernetes_tpu.api import errors
+
+class Agent:
+    async def heartbeat(self):
+        cur = await self.client.get("nodes", "", self.name)
+        try:
+            await self.client.update_status(cur)
+        except errors.ConflictError:
+            pass  # next tick wins
+"""
+    assert run_source(good, checks=["status-write"]) == []
+
+
+def test_status_write_good_reachable_from_sync():
+    # The Controller worker catches ConflictError and requeues, so any
+    # helper reachable from sync() is conflict-retried by the framework
+    # — including through an intermediate helper.
+    good = """
+class FooController(Controller):
+    async def sync(self, key):
+        obj = self.informer.get(key)
+        await self._reconcile(obj)
+
+    async def _reconcile(self, obj):
+        await self._update_status(obj)
+
+    async def _update_status(self, obj):
+        await self.client.update(obj, subresource="status")
+"""
+    assert run_source(good, checks=["status-write"]) == []
+
+
+def test_status_write_bad_not_a_controller():
+    # Same shape, but the class isn't a Controller: nothing retries.
+    bad = """
+class Foo:
+    async def sync(self, key):
+        await self._update_status(self.informer.get(key))
+
+    async def _update_status(self, obj):
+        await self.client.update(obj, subresource="status")
+"""
+    got = run_source(bad, checks=["status-write"])
+    assert names(got) == ["status-write"]
+
+
+def test_status_write_bad_loose_function():
+    bad = """
+async def publish(client, obj):
+    await client.update_status(obj)
+"""
+    got = run_source(bad, checks=["status-write"])
+    assert names(got) == ["status-write"]
 
 
 def test_tree_is_clean():
