@@ -1,0 +1,37 @@
+#!/usr/bin/env bash
+# Graceful-preemption smoke (<60s): the checkpoint-aware preemption
+# acceptance scenario (queueing/harness.py run_preempt_smoke) over an
+# in-process control plane — signal → checkpoint marker → elastic
+# shrink → regrow → converge, with the seeded ``preempt`` chaos site
+# killing one member between signal and marker (the protocol must
+# converge anyway, from a non-torn step). Then the small-scale
+# reclaim-storm goodput gate (perf/gang_bench.py): graceful goodput
+# must be >= 2x the evict baseline, with real checkpoint-wait
+# percentiles reported.
+# Siblings: hack/queue_smoke.sh (admission arm), hack/chaos.sh (fault
+# arm), hack/race.sh (explored-schedule arm), hack/test.sh (runs all).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+timeout -k 10 60 env JAX_PLATFORMS=cpu python - <<'EOF'
+import asyncio, json, sys
+from kubernetes_tpu.queueing.harness import run_preempt_smoke
+from kubernetes_tpu.perf.gang_bench import run_reclaim_storm_bench
+
+out = asyncio.run(run_preempt_smoke(seed=20260804, timeout=30.0))
+print(json.dumps(out))
+if out["shrink_outcome"] != "checkpointed" or out["checkpoint_step"] < 0:
+    sys.exit("preempt_smoke: shrink round never checkpointed")
+if out["a_bound"] < 16 or out["a_replicas"] != 16:
+    sys.exit("preempt_smoke: elastic regrow did not converge")
+if out["crash_kills"] != 1:
+    sys.exit("preempt_smoke: mid-checkpoint crash site never fired")
+
+storm = asyncio.run(run_reclaim_storm_bench(2, timeout=30.0))
+print(json.dumps(storm))
+if storm["graceful"]["goodput"] < 2 * max(storm["evict"]["goodput"], 0.01):
+    sys.exit(f"preempt_smoke: goodput gate failed "
+             f"(graceful {storm['graceful']['goodput']} vs "
+             f"evict {storm['evict']['goodput']})")
+EOF
+echo "preempt_smoke: ok"

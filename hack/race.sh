@@ -25,10 +25,10 @@ cd "$(dirname "$0")/.."
 
 SEED="${TPU_SAN:-20260804}"
 
-echo "=== 1/3 tpuvet: static analysis tree-clean ==="
+echo "=== 1/4 tpuvet: static analysis tree-clean ==="
 python -m kubernetes_tpu.analysis kubernetes_tpu
 
-echo "=== 2/3 tpusan: chaos convergence x8 schedules (lockdep + mutation detector armed) ==="
+echo "=== 2/4 tpusan: chaos convergence x8 schedules (lockdep + mutation detector armed) ==="
 timeout -k 10 110 env JAX_PLATFORMS=cpu TPU_SAN= TPU_CHAOS= \
     TPU_LOCKDEP=1 TPU_CACHE_MUTATION_DETECTOR=1 python - "$SEED" <<'EOF'
 import json, sys
@@ -51,7 +51,7 @@ if idle:
     sys.exit(f"tpusan: invariants never exercised: {idle}")
 EOF
 
-echo "=== 3/3 tpusan: queue smoke x2 schedules ==="
+echo "=== 3/4 tpusan: queue smoke x2 schedules ==="
 timeout -k 10 90 env JAX_PLATFORMS=cpu TPU_SAN= \
     TPU_LOCKDEP=1 TPU_CACHE_MUTATION_DETECTOR=1 python - "$SEED" <<'EOF'
 import json, sys
@@ -61,6 +61,21 @@ rep = run_queue_smoke_schedules(sys.argv[1], schedules=2)
 print(json.dumps({k: v for k, v in rep.items() if k != "schedules"}))
 if not all(r["reclaimed_gangs"] for r in rep["schedules"]):
     sys.exit("tpusan: reclaim did not run on every schedule")
+EOF
+
+echo "=== 4/4 tpusan: graceful-preemption storm x4 schedules ==="
+# Mid-checkpoint member crash + shrink + regrow, byte-identical
+# convergence facts asserted across every explored schedule
+# (run_preempt_smoke_schedules raises on any divergence).
+timeout -k 10 120 env JAX_PLATFORMS=cpu TPU_SAN= \
+    TPU_LOCKDEP=1 TPU_CACHE_MUTATION_DETECTOR=1 python - "$SEED" <<'EOF'
+import json, sys
+from kubernetes_tpu.queueing.harness import run_preempt_smoke_schedules
+
+rep = run_preempt_smoke_schedules(sys.argv[1], schedules=4)
+print(json.dumps({k: v for k, v in rep.items() if k != "schedules"}))
+if not rep["invariant_checks"].get("checkpoint-monotonic"):
+    sys.exit("tpusan: checkpoint-monotonic never exercised")
 EOF
 
 echo "race.sh: ok (seed ${SEED}; tpuvet clean, invariants held on all schedules)"

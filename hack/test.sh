@@ -6,10 +6,12 @@
 # (<60s REST density smoke of the batch API path), hack/chaos.sh
 # (seeded fault-schedule convergence gate, plain + queueing-enabled),
 # hack/queue_smoke.sh (<60s two-tenant fair-share admission smoke),
-# hack/race.sh (<120s tpusan gate: chaos + queue smoke under explored
-# task-interleaving schedules with the cluster invariants armed) —
-# all run on full-suite invocations; filtered runs skip them,
-# KTPU_SMOKE=1 forces them.
+# hack/preempt_smoke.sh (<60s graceful-preemption storm: signal,
+# checkpoint, shrink, regrow, converge + the goodput gate),
+# hack/race.sh (<120s tpusan gate: chaos + queue + preempt smokes
+# under explored task-interleaving schedules with the cluster
+# invariants armed) — all run on full-suite invocations; filtered
+# runs skip them, KTPU_SMOKE=1 forces them.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 ./hack/verify.sh
@@ -17,6 +19,7 @@ if [ "$#" -eq 0 ] || [ "${KTPU_SMOKE:-}" = "1" ]; then
   ./hack/bench_smoke.sh
   ./hack/chaos.sh
   ./hack/queue_smoke.sh
+  ./hack/preempt_smoke.sh
   ./hack/race.sh
 fi
 exec python -m pytest tests/ -q "$@"

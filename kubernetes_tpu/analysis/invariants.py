@@ -3,7 +3,7 @@
 The sanitizer half of tpusan (:mod:`.interleave` is the schedule half):
 a registry of always-on cluster invariants evaluated at the MVCC write
 seam, so ANY interleaving the explorer produces is judged step by step
-instead of only at scenario end. The five registered invariants are the
+instead of only at scenario end. The six registered invariants are the
 ones whose violations this repo has actually paid for (chaos findings,
 PR-review windows):
 
@@ -34,6 +34,11 @@ PR-review windows):
     :meth:`InvariantRegistry.check_final` compares it byte-for-byte
     against ``store.state()`` — state mutated behind the log's back
     (the bug class WAL recovery cannot survive) is a violation.
+``checkpoint-monotonic``
+    A gang's recorded graceful-preemption resume point
+    (``status.preemption.checkpoint_step``) never decreases — a
+    rewind would make the next incarnation redo or skip training
+    steps (the torn-marker bug class).
 
 Violations are RECORDED (``log.error`` + ``violations`` list), not
 raised mid-write: raising inside the store would turn a sanitizer
@@ -66,9 +71,16 @@ QUOTA_CONSERVATION = "quota-conservation"
 GANG_ATOMICITY = "gang-atomicity"
 ADMISSION_MONOTONICITY = "admission-monotonicity"
 WAL_REPLAY = "wal-replay"
+#: ``status.preemption.checkpoint_step`` never decreases for a live
+#: group: a graceful-preemption round (or a torn/stale marker replay)
+#: that REWINDS the recorded resume point would make the next
+#: incarnation silently redo — or worse, skip — training steps.
+#: Evaluated on every podgroup write (trivially when no preemption
+#: state exists), so the check counter moves with ordinary traffic.
+CHECKPOINT_MONOTONIC = "checkpoint-monotonic"
 
 INVARIANTS = (CHIP_DOUBLE_BOOK, QUOTA_CONSERVATION, GANG_ATOMICITY,
-              ADMISSION_MONOTONICITY, WAL_REPLAY)
+              ADMISSION_MONOTONICITY, WAL_REPLAY, CHECKPOINT_MONOTONIC)
 
 #: Store revisions the cluster may advance while a gang sits partially
 #: bound before gang-atomicity fires. Revision-counted (not wall-clock)
@@ -96,8 +108,10 @@ def _canon(value: dict) -> str:
 
 def _demand(group_value: dict) -> dict:
     """Gang demand as admission charges it (controllers/queue.py
-    group_demand): explicit spec.resources, chips defaulted from the
-    slice shape."""
+    group_demand — keep the two in sync): explicit spec.resources,
+    chips defaulted from the slice shape, scaled by the elastic target
+    (status.replicas / spec.max_replicas) when GracefulPreemption is
+    on."""
     spec = group_value.get("spec", {}) or {}
     demand = dict(spec.get("resources", {}) or {})
     shape = spec.get("slice_shape") or []
@@ -106,6 +120,14 @@ def _demand(group_value: dict) -> dict:
         for d in shape:
             chips *= d
         demand[RESOURCE_TPU] = float(chips)
+    mx = int(spec.get("max_replicas", 0) or 0)
+    if mx:
+        from ..util.features import GATES
+        if GATES.enabled("GracefulPreemption"):
+            status = group_value.get("status", {}) or {}
+            r = int(status.get("replicas", 0) or 0) or mx
+            r = max(int(spec.get("min_replicas", 0) or 0), min(r, mx))
+            demand = {res: amt * r / mx for res, amt in demand.items()}
     return demand
 
 
@@ -338,10 +360,25 @@ class InvariantRegistry:
         if queue:
             cq = (status.get("admission_cluster_queue", "")
                   or st.lqs.get(f"{ns}/{queue}", ""))
+        preempt = status.get("preemption") or {}
+        step_raw = preempt.get("checkpoint_step", -1)
+        # No falsy coercion: step 0 is a REAL checkpoint (a gang
+        # preempted on its first step) and must stay distinguishable
+        # from "never recorded" (-1), or a rewind from 0 goes unseen.
+        step = int(step_raw) if isinstance(step_raw, (int, float)) else -1
         cur = {"admitted": admitted, "cq": cq, "demand": _demand(value),
-               "min_member": int(spec.get("min_member", 0) or 0)}
+               "min_member": int(spec.get("min_member", 0) or 0),
+               "ckpt_step": step}
         prev = st.groups.get(gk)
         st.groups[gk] = cur
+        if check:
+            self.checks[CHECKPOINT_MONOTONIC] += 1
+            if prev is not None and step < prev.get("ckpt_step", -1):
+                self._violate(
+                    CHECKPOINT_MONOTONIC, gk, revision,
+                    f"status.preemption.checkpoint_step rewound "
+                    f"{prev.get('ckpt_step')} -> {step}: the gang's "
+                    f"recorded resume point must only ever rise")
         self._update_partial(st, gk, revision)
         if prev is None:
             if admitted and cq:

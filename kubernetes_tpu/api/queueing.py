@@ -71,6 +71,10 @@ class ClusterQueueStatus:
     #: Per-tenant breakdown: "namespace/localqueue" -> resource usage
     #: (``ktl describe clusterqueue`` renders usage vs quota from this).
     tenant_usage: dict[str, dict[str, float]] = field(default_factory=dict)
+    #: Gangs of this queue currently mid-reclaim (graceful preemption
+    #: signaled / checkpointing, or swept for eviction) — the ``ktl get
+    #: clusterqueues`` RECLAIMING column.
+    reclaiming: int = 0
 
 
 @dataclass

@@ -844,6 +844,72 @@ PODGROUP_SCHEDULED = "Scheduled"
 PODGROUP_RUNNING = "Running"
 PODGROUP_FAILED = "Failed"
 
+#: Graceful-preemption protocol phases (status.preemption.phase).
+#: "" -> Signaled -> Requeued; Checkpointing is the observable middle
+#: state once any member has reported a checkpoint-complete marker.
+PREEMPT_SIGNALED = "Signaled"
+PREEMPT_CHECKPOINTING = "Checkpointing"
+PREEMPT_REQUEUED = "Requeued"
+
+#: How the checkpoint request reaches the workload (spec.checkpoint).
+PREEMPT_SIGNAL_FILE = "file"        # KTPU_PREEMPT_FILE appears
+PREEMPT_SIGNAL_TERM = "sigterm"     # SIGTERM to container processes
+PREEMPT_SIGNAL_BOTH = "both"        # file + SIGTERM (the default)
+PREEMPT_SIGNAL_MODES = (PREEMPT_SIGNAL_FILE, PREEMPT_SIGNAL_TERM,
+                        PREEMPT_SIGNAL_BOTH)
+
+#: Pod annotation the preemption engine stamps to request a
+#: checkpoint; value is the absolute unix deadline (seconds). The node
+#: agent delivers the in-container signal when it appears.
+PREEMPT_ANNOTATION = "preemption.tpu/checkpoint-by"
+
+
+@dataclass
+class CheckpointSpec:
+    """Opt-in graceful preemption contract for a gang (spec.checkpoint).
+
+    ``grace_seconds`` bounds how long every eviction path (gang
+    preemption, fair-share reclaim, elastic shrink) waits between
+    signaling the gang and killing it; 0 disables the protocol for
+    this gang even with the GracefulPreemption gate on. On timeout the
+    kill proceeds exactly like the legacy path — a wedged workload can
+    never hold quota hostage."""
+
+    grace_seconds: float = 0.0
+    #: One of PREEMPT_SIGNAL_MODES.
+    signal: str = PREEMPT_SIGNAL_BOTH
+
+
+@dataclass
+class PreemptionStatus:
+    """Durable graceful-preemption state (status.preemption): rides
+    the WAL like admission state, so a restarted control plane resumes
+    the protocol instead of forgetting a signaled gang."""
+
+    #: "" | Signaled | Checkpointing | Requeued.
+    phase: str = ""
+    #: Pod names the current round signaled (elastic shrink signals
+    #: only the surplus members).
+    signaled: list[str] = field(default_factory=list)
+    #: Pod names whose checkpoint-complete marker has been recorded.
+    checkpointed: list[str] = field(default_factory=list)
+    #: Highest COMPLETED checkpoint step ever recorded for this gang —
+    #: monotonic (the tpusan checkpoint-monotonic invariant); -1 =
+    #: no checkpoint recorded yet.
+    checkpoint_step: int = -1
+    #: When the current round was signaled, and its absolute deadline
+    #: (signaled_time + spec.checkpoint.grace_seconds).
+    signaled_time: Optional[datetime.datetime] = None
+    #: Unix seconds; past it the engine degrades to the hard kill.
+    deadline: float = 0.0
+    #: When the round finished (evict + requeue).
+    requeued_time: Optional[datetime.datetime] = None
+    #: Why the last round ended: "checkpointed" (quorum reported) or
+    #: "deadline" (timed out into the legacy kill).
+    outcome: str = ""
+    #: Completed graceful rounds — observability + revision stamp.
+    rounds: int = 0
+
 
 @dataclass
 class PodGroupSpec:
@@ -864,6 +930,16 @@ class PodGroupSpec:
     #: from prod(slice_shape) when absent — admission must not depend
     #: on member pods existing yet.
     resources: dict[str, float] = field(default_factory=dict)
+    #: Graceful-preemption opt-in (None/grace 0 = legacy hard kill).
+    checkpoint: Optional[CheckpointSpec] = None
+    #: Elastic sizing (0 = fixed-size gang). A gang may run with any
+    #: member count in [min_replicas, max_replicas]; spec.resources /
+    #: slice_shape describe the FULL (max_replicas) size and the quota
+    #: charge scales linearly with status.replicas. Under fair-share
+    #: reclaim an elastic gang shrinks to min_replicas (releasing the
+    #: borrowed delta) instead of dying, and regrows when quota allows.
+    min_replicas: int = 0
+    max_replicas: int = 0
 
 
 @dataclass
@@ -894,6 +970,13 @@ class PodGroupStatus:
     #: (the namespace binding resolved at admission time is the durable
     #: fact, not the binding's continued existence).
     admission_cluster_queue: str = ""
+    #: Graceful-preemption protocol state (None until first signaled).
+    preemption: Optional[PreemptionStatus] = None
+    #: Elastic target size (member count the scheduler may bind up
+    #: to). 0 on non-elastic gangs; set to max_replicas at admission,
+    #: lowered to min_replicas by reclaim shrink, raised again by the
+    #: regrow pass. The quota charge follows this number.
+    replicas: int = 0
 
 
 @dataclass

@@ -376,6 +376,30 @@ def validate_podgroup(pg: t.PodGroup, is_create: bool = True) -> None:
     if pg.spec.queue:
         validate_name(pg.spec.queue, "spec.queue", errs)
     validate_quota_map("spec.resources", pg.spec.resources, errs)
+    ck = pg.spec.checkpoint
+    if ck is not None:
+        if not math.isfinite(ck.grace_seconds) or ck.grace_seconds < 0:
+            errs.add("spec.checkpoint.grace_seconds",
+                     "must be a finite number >= 0")
+        if ck.signal not in t.PREEMPT_SIGNAL_MODES:
+            errs.add("spec.checkpoint.signal",
+                     f"must be one of {t.PREEMPT_SIGNAL_MODES}")
+    mn, mx = pg.spec.min_replicas, pg.spec.max_replicas
+    if (mn == 0) != (mx == 0):
+        errs.add("spec.min_replicas",
+                 "min_replicas and max_replicas must be set together "
+                 "(0 = non-elastic)")
+    elif mx:
+        if mn < 1 or mn > mx:
+            errs.add("spec.min_replicas",
+                     f"need 1 <= min_replicas <= max_replicas, got "
+                     f"{mn}/{mx}")
+        if pg.spec.min_member > mn:
+            # The scheduler's quorum must be reachable at the shrunken
+            # size, or a reclaim shrink would wedge the gang below its
+            # own release threshold.
+            errs.add("spec.min_member",
+                     f"must be <= min_replicas ({mn}) on elastic gangs")
     errs.raise_if_any("PodGroup", pg.metadata.name)
 
 

@@ -195,6 +195,14 @@ class GangPolicy:
     #: fair-share admission; "" = unqueued, or the namespace default
     #: LocalQueue when the JobQueueing gate is on).
     queue: str = ""
+    #: Graceful-preemption opt-in for the Job's gang (seconds the
+    #: workload gets to checkpoint when preempted/reclaimed; 0 = the
+    #: legacy hard kill). Carried into PodGroup.spec.checkpoint.
+    checkpoint_grace_seconds: float = 0.0
+    #: Elastic sizing carried into PodGroup.spec.min/max_replicas
+    #: (0/0 = fixed-size gang).
+    min_replicas: int = 0
+    max_replicas: int = 0
 
 
 @dataclass

@@ -57,9 +57,10 @@ SITE_WATCH_STORE = "watch.store"
 SITE_WAL = "wal"
 SITE_HEARTBEAT = "heartbeat"
 SITE_DEVICE = "deviceplugin"
+SITE_PREEMPT = "preempt"
 
 SITES = (SITE_REST, SITE_WATCH_REST, SITE_WATCH_STORE, SITE_WAL,
-         SITE_HEARTBEAT, SITE_DEVICE)
+         SITE_HEARTBEAT, SITE_DEVICE, SITE_PREEMPT)
 
 KINDS = {
     SITE_REST: ("error", "http500", "hang", "slow"),
@@ -68,6 +69,11 @@ KINDS = {
     SITE_WAL: ("torn", "flip", "crash"),
     SITE_HEARTBEAT: ("miss",),
     SITE_DEVICE: ("unhealthy",),
+    # Mid-checkpoint crash: between a graceful-preemption signal and
+    # the checkpoint-complete marker, force-delete one signaled member
+    # (param selects which, mod the member count). The protocol must
+    # converge, never double-book chips, never resume from a torn step.
+    SITE_PREEMPT: ("kill-member",),
 }
 
 FAULTS_INJECTED = Counter(

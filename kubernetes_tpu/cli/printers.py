@@ -110,10 +110,59 @@ def _jobs_table(objs: list, wide: bool) -> str:
     return render_table(["NAME", "COMPLETIONS", "AGE"], rows)
 
 
+def _elastic_size(pg) -> str:
+    """current/min/max member target, or min_member for fixed gangs."""
+    if not pg.spec.max_replicas:
+        return str(pg.spec.min_member)
+    cur = pg.status.replicas or pg.spec.max_replicas
+    return f"{cur}/{pg.spec.min_replicas}..{pg.spec.max_replicas}"
+
+
 def _podgroups_table(objs: list, wide: bool) -> str:
-    rows = [[o.metadata.name, o.spec.min_member,
-             getattr(o.status, "phase", ""), age(o.metadata)] for o in objs]
-    return render_table(["NAME", "MIN-MEMBER", "PHASE", "AGE"], rows)
+    headers = ["NAME", "MIN-MEMBER", "PHASE", "AGE"]
+    if wide:
+        headers += ["SIZE", "PREEMPTION", "CKPT-STEP"]
+    rows = []
+    for o in objs:
+        row = [o.metadata.name, o.spec.min_member,
+               getattr(o.status, "phase", ""), age(o.metadata)]
+        if wide:
+            st = o.status.preemption
+            row += [_elastic_size(o),
+                    (st.phase or "<none>") if st else "<none>",
+                    (st.checkpoint_step if st and st.checkpoint_step >= 0
+                     else "<none>") if st else "<none>"]
+        rows.append(row)
+    return render_table(headers, rows)
+
+
+def describe_podgroup(pg) -> str:
+    """Gang summary: elastic size, graceful-preemption state, then the
+    generic field dump."""
+    lines = [f"Name: {pg.metadata.name}",
+             f"Phase: {pg.status.phase or 'Pending'}",
+             f"Members: {_elastic_size(pg)} (quorum {pg.spec.min_member})"]
+    if pg.spec.queue:
+        mode = pg.status.admission_mode or "<pending>"
+        lines.append(f"Queue: {pg.spec.queue} "
+                     f"(admitted={pg.status.admitted}, mode={mode})")
+    ck = pg.spec.checkpoint
+    if ck is not None:
+        lines.append(f"Checkpoint: grace={ck.grace_seconds:g}s "
+                     f"signal={ck.signal}")
+    st = pg.status.preemption
+    if st is not None:
+        lines.append(f"Preemption: phase={st.phase or '<idle>'} "
+                     f"rounds={st.rounds}"
+                     + (f" outcome={st.outcome}" if st.outcome else ""))
+        lines.append("Last checkpoint step: "
+                     + (str(st.checkpoint_step)
+                        if st.checkpoint_step >= 0 else "<none>"))
+        if st.signaled:
+            lines.append(f"Signaled: {len(st.checkpointed)}/"
+                         f"{len(st.signaled)} members checkpointed")
+    lines.append("")
+    return "\n".join(lines) + _describe_fields(pg)
 
 
 def _fmt_chips(amount) -> str:
@@ -121,13 +170,13 @@ def _fmt_chips(amount) -> str:
 
 
 def _clusterqueues_table(objs: list, wide: bool) -> str:
-    headers = ["NAME", "COHORT", "PENDING", "ADMITTED", "BORROWED",
-               "NOMINAL", "AGE"]
+    headers = ["NAME", "COHORT", "PENDING", "ADMITTED", "RECLAIMING",
+               "BORROWED", "NOMINAL", "AGE"]
     rows = []
     for q in objs:
         rows.append([
             q.metadata.name, q.spec.cohort or "<none>",
-            q.status.pending, q.status.admitted,
+            q.status.pending, q.status.admitted, q.status.reclaiming,
             _fmt_chips(q.status.borrowed.get(t.RESOURCE_TPU, 0.0)),
             _fmt_chips(q.spec.nominal_quota.get(t.RESOURCE_TPU, 0.0)),
             age(q.metadata)])
@@ -210,9 +259,12 @@ def print_objects(plural: str, objs: list, wide: bool = False) -> str:
 
 def describe(obj: Any) -> str:
     """kubectl describe analog: kind-specific summaries for queueing
-    kinds (usage vs quota), generic schema-driven dump otherwise."""
+    kinds (usage vs quota) and PodGroups (elastic size + preemption
+    state), generic schema-driven dump otherwise."""
     if type(obj).__name__ == "ClusterQueue":
         return describe_clusterqueue(obj)
+    if type(obj).__name__ == "PodGroup":
+        return describe_podgroup(obj)
     return _describe_fields(obj)
 
 

@@ -85,7 +85,14 @@ class JobController(Controller):
                 min_member=gang.min_member or job.spec.parallelism,
                 slice_shape=list(gang.slice_shape),
                 schedule_timeout_seconds=gang.schedule_timeout_seconds,
-                queue=gang.queue))
+                queue=gang.queue,
+                min_replicas=gang.min_replicas,
+                max_replicas=gang.max_replicas))
+        if gang.checkpoint_grace_seconds > 0:
+            # Graceful-preemption opt-in rides the Job spec: the gang
+            # checkpoints (and elastic gangs shrink) instead of dying.
+            group.spec.checkpoint = t.CheckpointSpec(
+                grace_seconds=gang.checkpoint_grace_seconds)
         from ..util.features import GATES
         if job.spec.active_deadline_seconds \
                 and GATES.enabled("JobQueueing"):

@@ -65,13 +65,27 @@ RESYNC_SECONDS = 1.0
 MIN_PASS_INTERVAL = 0.1
 
 
-def group_demand(group: t.PodGroup) -> dict[str, float]:
+def group_demand(group: t.PodGroup,
+                 replicas: Optional[int] = None) -> dict[str, float]:
     """Gang demand charged against quota: explicit ``spec.resources``,
     with chips defaulted from the slice shape so admission never waits
-    for member pods to exist."""
+    for member pods to exist.
+
+    Elastic gangs (GracefulPreemption + spec.max_replicas): the spec
+    describes the FULL size; the charge scales linearly with the
+    current target (``replicas`` override, else status.replicas, else
+    max) — a shrunken gang charges only what it still holds. Mirrored
+    by analysis/invariants.py:_demand; keep the two in sync."""
     demand = dict(group.spec.resources)
     if t.RESOURCE_TPU not in demand and group.spec.slice_shape:
         demand[t.RESOURCE_TPU] = float(math.prod(group.spec.slice_shape))
+    from .. import preemption as gp
+    if gp.enabled() and group.spec.max_replicas:
+        r = replicas if replicas is not None else (
+            group.status.replicas or group.spec.max_replicas)
+        r = max(group.spec.min_replicas, min(r, group.spec.max_replicas))
+        frac = r / group.spec.max_replicas
+        demand = {res: amt * frac for res, amt in demand.items()}
     return demand
 
 
@@ -125,6 +139,11 @@ class QueueController(Controller):
         #: SECOND healthy borrower gets evicted before the watch
         #: catches up.
         self._unadmit_overlay: set[str] = set()
+        #: The elastic mirror: shrink/regrow target writes not yet
+        #: reflected by the informer (key -> replicas). Same phantom-
+        #: shortfall argument as _unadmit_overlay — a just-shrunk
+        #: gang's stale full-size copy must not be re-charged whole.
+        self._replicas_overlay: dict[str, int] = {}
         #: Per-group Workload snapshot, keyed on key ->
         #: (resource_version, Workload). The admission pass runs on
         #: every event burst and rebuilding demand/runtime/timestamps
@@ -187,6 +206,8 @@ class QueueController(Controller):
         # bench's bind bursts (p99 halves with it).
         if (old.spec != new.spec
                 or old.status.admitted != new.status.admitted
+                # Elastic target moves the gang's quota charge.
+                or old.status.replicas != new.status.replicas
                 or old.metadata.deletion_timestamp
                 != new.metadata.deletion_timestamp
                 or (old.status.phase == t.PODGROUP_FAILED)
@@ -211,13 +232,21 @@ class QueueController(Controller):
         lqs = {lq.key(): lq for lq in self.lq_informer.list()}
         cq_names = {cq.metadata.name for cq in self.cq_informer.list()}
         for group in self.pg_informer.list():
-            if not group.spec.queue or group.status.admitted \
-                    or not _group_active(group):
+            if not group.spec.queue or not _group_active(group):
                 continue
             lq = lqs.get(f"{group.metadata.namespace}/{group.spec.queue}")
             if lq is None or lq.spec.cluster_queue not in cq_names:
                 continue
-            self._reclaim_sweep.add(group.key())
+            st = group.status.preemption
+            if st is not None and st.phase in (t.PREEMPT_SIGNALED,
+                                               t.PREEMPT_CHECKPOINTING):
+                # A restart mid graceful round (shrink OR reclaim):
+                # its finisher died with the old process; the sweep's
+                # finish_stale_round completes it past the deadline.
+                self._reclaim_sweep.add(group.key())
+                continue
+            if not group.status.admitted:
+                self._reclaim_sweep.add(group.key())
         self.enqueue(ADMIT_KEY)
 
     async def sync(self, key: str) -> Optional[float]:
@@ -283,9 +312,14 @@ class QueueController(Controller):
                 cq_name = lq.spec.cluster_queue
             if cq_name not in queues:
                 continue  # ClusterQueue itself deleted: nothing governs
+            rep_ov = self._replicas_overlay.get(gk)
+            if rep_ov is not None and (group.status.replicas or 0) == rep_ov:
+                rep_ov = None  # informer caught up
+                self._replicas_overlay.pop(gk, None)
             rv = group.metadata.resource_version
             ent = self._wl_cache.get(gk)
-            if ent is not None and ent[0] == rv and ent[1].queue == cq_name:
+            if ent is not None and ent[0] == rv \
+                    and ent[1].queue == cq_name and rep_ov is None:
                 w = ent[1]
                 if overlay is not None:
                     w.mode, w.admitted_at = overlay[0], overlay[1]
@@ -294,15 +328,17 @@ class QueueController(Controller):
                 adm = group.status.admitted_time
                 w = fs.Workload(
                     key=gk, queue=cq_name,
-                    demand=group_demand(group),
+                    demand=group_demand(group, replicas=rep_ov),
                     priority=group.spec.priority or 0,
                     created=created.timestamp() if created else 0.0,
                     runtime=group_runtime(group),
                     admitted_at=(adm.timestamp() if adm else None)
                     if overlay is None else overlay[1],
                     mode=group.status.admission_mode
-                    if overlay is None else overlay[0])
-                self._wl_cache[gk] = (rv, w)
+                    if overlay is None else overlay[0],
+                    min_demand=self._shrinkable_to(group, rep_ov))
+                if rep_ov is None:
+                    self._wl_cache[gk] = (rv, w)
             groups[gk] = group
             lq_of[gk] = lq_key
             if is_admitted:
@@ -314,9 +350,25 @@ class QueueController(Controller):
         for key in [k for k in self._admitted_overlay if k not in seen]:
             del self._admitted_overlay[key]
         self._unadmit_overlay &= seen
+        for key in [k for k in self._replicas_overlay if k not in seen]:
+            del self._replicas_overlay[key]
         for key in [k for k in self._wl_cache if k not in seen]:
             del self._wl_cache[key]
         return queues, admitted, pending, groups, lq_of, cqs, lqs
+
+    @staticmethod
+    def _shrinkable_to(group: t.PodGroup,
+                       rep_ov: Optional[int]) -> Optional[dict]:
+        """min_replicas demand for an elastic gang still above min —
+        the reclaim planner's shrink option. None otherwise."""
+        from .. import preemption as gp
+        if not gp.enabled() or not group.spec.max_replicas:
+            return None
+        cur = rep_ov if rep_ov is not None else (
+            group.status.replicas or group.spec.max_replicas)
+        if cur <= group.spec.min_replicas:
+            return None
+        return group_demand(group, replicas=group.spec.min_replicas)
 
     # -- the pass ---------------------------------------------------------
 
@@ -358,14 +410,19 @@ class QueueController(Controller):
                     # unwritten admission would release quota the
                     # deferred write then re-spends. Reclaim sees them
                     # next pass, once written.
-                    victims = fs.pick_reclaim_victims(
+                    decisions = fs.plan_reclaim(
                         q, w.demand, cohort,
                         [a for a in admitted
                          if a.key not in pending_writes])
-                    for v in victims:
-                        await self._unadmit(groups[v.key], v, queues)
-                        admitted.remove(v)
-                    if victims:
+                    for v, action in decisions:
+                        if action == fs.RECLAIM_SHRINK:
+                            # Elastic borrower: give back the borrowed
+                            # delta, keep training at min_replicas.
+                            await self._shrink(groups[v.key], v, queues)
+                        else:
+                            await self._unadmit(groups[v.key], v, queues)
+                            admitted.remove(v)
+                    if decisions:
                         mode, _ = fs.admission_mode(q, cohort, w.demand)
                 if mode is not None:
                     decide_admit(w, mode, False)
@@ -414,6 +471,9 @@ class QueueController(Controller):
             if first_err is not None:
                 raise first_err  # e.g. ConflictError: requeue the pass
         self._inadmissible &= set(groups)  # deleted gangs drop out
+        # Regrow AFTER pending admissions: an elastic gang takes back
+        # released quota only when no pending gang (blocker) wants it.
+        await self._regrow(queues, admitted, groups, blockers)
         # Sweep AFTER admitting: a gang bound while the gate was off
         # (or whose admission record raced a crash) gets retro-admitted
         # above if quota allows — only gangs still unadmitted after the
@@ -424,8 +484,19 @@ class QueueController(Controller):
         now_m = asyncio.get_running_loop().time()
         if now_m - self._last_publish >= 0.25:
             self._last_publish = now_m
+            reclaiming: dict[str, int] = {}
+            queue_of = {x.key: x.queue for x in admitted}
+            queue_of.update((x.key, x.queue) for x in pending)
+            for gk, group in groups.items():
+                st = group.status.preemption
+                mid_round = st is not None and st.phase in (
+                    t.PREEMPT_SIGNALED, t.PREEMPT_CHECKPOINTING)
+                if (mid_round or gk in self._reclaim_sweep) \
+                        and gk in queue_of:
+                    reclaiming[queue_of[gk]] = \
+                        reclaiming.get(queue_of[gk], 0) + 1
             await self._publish_status(queues, admitted, pending,
-                                       lq_of, cqs, lqs)
+                                       lq_of, cqs, lqs, reclaiming)
 
     # -- admission state transitions --------------------------------------
 
@@ -467,6 +538,103 @@ class QueueController(Controller):
         self._unadmit_overlay.discard(w.key)
         return True
 
+    async def _shrink(self, group: t.PodGroup, w: fs.Workload,
+                      queues: dict[str, fs.QueueState]) -> None:
+        """Reclaim's elastic alternative to :meth:`_unadmit`: lower the
+        gang's target to min_replicas (releasing the borrowed delta of
+        its charge), then gracefully preempt the surplus bound members
+        — the gang keeps training small instead of dying, and regrows
+        when quota allows."""
+        from .. import preemption as gp
+        target = group.spec.min_replicas
+        ns, name = group.metadata.namespace, group.metadata.name
+        delta = {r: max(0.0, a - (w.min_demand or {}).get(r, 0.0))
+                 for r, a in w.demand.items()}
+        cur = dataclasses.replace(group, status=dataclasses.replace(
+            group.status, replicas=target))
+        try:
+            await self.client.update_status(cur)  # ConflictError -> retry
+        except errors.NotFoundError:
+            return
+        fs.release(queues[w.queue], delta)
+        self._replicas_overlay[w.key] = target
+        w.demand = dict(w.min_demand or {})
+        w.min_demand = None
+        # Crash backstop: the sweep finishes a stale shrink round
+        # (finish_stale_round) if this controller dies before the
+        # engine's finisher evicts the surplus members.
+        self._reclaim_sweep.add(w.key)
+        gp.SHRINKS.inc()
+        self.recorder.event(
+            cur, "Warning", "ElasticShrunk",
+            f"cohort reclaim: shrinking to {target} members; the "
+            f"borrowed slice is released after checkpoint")
+        pods, _ = await self.client.list(
+            "pods", ns, field_selector=f"spec.gang={name}")
+        bound = sorted((p for p in pods
+                        if p.spec.node_name and t.is_pod_active(p)),
+                       key=lambda p: p.metadata.name)
+        surplus = bound[target:]
+        if not surplus:
+            return
+        if not await gp.signal_gang(self.client, cur, surplus,
+                                    reason="reclaim-shrink",
+                                    recorder=self.recorder):
+            for pod in surplus:  # not checkpoint-opted: legacy kill
+                try:
+                    await self.client.evict(
+                        pod.metadata.namespace, pod.metadata.name,
+                        t.Eviction(override_budget=True))
+                except errors.StatusError as e:
+                    log.warning("shrink evict %s failed: %s", pod.key(), e)
+
+    async def _regrow(self, queues: dict[str, fs.QueueState],
+                      admitted: list[fs.Workload],
+                      groups: dict[str, t.PodGroup],
+                      blockers: dict) -> None:
+        """Elastic regrow — the backfill half of shrink: a shrunken
+        gang takes its target back toward max_replicas when the quota
+        fits, unless its cohort has a blocked pending gang (pending
+        demand outranks regrowth). The scheduler's elastic cap reads
+        the raised target on the parked members' next requeue."""
+        from .. import preemption as gp
+        if not gp.enabled():
+            return
+        for w in admitted:
+            group = groups.get(w.key)
+            if group is None or not group.spec.max_replicas:
+                continue
+            cur_target = self._replicas_overlay.get(
+                w.key, group.status.replicas or group.spec.max_replicas)
+            if cur_target >= group.spec.max_replicas:
+                continue
+            q = queues[w.queue]
+            if (q.cohort or q.name) in blockers:
+                continue
+            cohort = [m for m in queues.values()
+                      if q.cohort and m.cohort == q.cohort] or [q]
+            for target in range(group.spec.max_replicas, cur_target, -1):
+                full = group_demand(group, replicas=target)
+                delta = {r: max(0.0, a - w.demand.get(r, 0.0))
+                         for r, a in full.items()}
+                mode, _ = fs.admission_mode(q, cohort, delta)
+                if mode is None:
+                    continue
+                fresh = dataclasses.replace(
+                    group, status=dataclasses.replace(
+                        group.status, replicas=target))
+                try:
+                    await self.client.update_status(fresh)
+                except errors.StatusError:
+                    break  # opportunistic: informer refresh retries
+                fs.charge(q, delta)
+                self._replicas_overlay[w.key] = target
+                w.demand = full
+                self.recorder.event(
+                    fresh, "Normal", "ElasticRegrown",
+                    f"quota allows: target raised to {target} members")
+                break
+
     async def _unadmit(self, group: t.PodGroup, w: fs.Workload,
                        queues: dict[str, fs.QueueState]) -> None:
         """Reclaim one borrowed gang: flip it back to pending FIRST (the
@@ -500,7 +668,25 @@ class QueueController(Controller):
                 f"borrowed quota reclaimed by cohort; gang requeued")
         fs.release(queues[w.queue], w.demand)
         self._unadmit_overlay.add(w.key)
-        await self._evict_bound_members(ns, name)
+        # Graceful path (preemption.py): a checkpoint-opted gang is
+        # SIGNALED and keeps its chips for its grace budget while it
+        # checkpoints; the engine's finisher evicts it after. The
+        # quota was already released above, so the beneficiary admits
+        # now and binds once the chips free — reclaim costs one
+        # checkpoint interval. Gate off / not opted in: evict now,
+        # exactly the legacy path.
+        from .. import preemption as gp
+        graceful = False
+        if gp.eligible(cur):
+            pods, _ = await self.client.list(
+                "pods", ns, field_selector=f"spec.gang={name}")
+            bound = [p for p in pods
+                     if p.spec.node_name and t.is_pod_active(p)]
+            graceful = await gp.signal_gang(
+                self.client, cur, bound, reason="reclaim",
+                recorder=self.recorder)
+        if not graceful:
+            await self._evict_bound_members(ns, name)
         self._reclaim_sweep.add(w.key)
 
     async def _evict_bound_members(self, ns: str, name: str) -> bool:
@@ -527,6 +713,7 @@ class QueueController(Controller):
         unadmitted gang holding chips the cohort thinks are free. Sweep
         each reclaimed gang until no bound member remains (or it was
         re-admitted / deleted)."""
+        from .. import preemption as gp
         for key in list(self._reclaim_sweep):
             ns, name = key.split("/", 1)
             try:
@@ -534,16 +721,34 @@ class QueueController(Controller):
             except errors.NotFoundError:
                 self._reclaim_sweep.discard(key)
                 continue
+            st = group.status.preemption
+            mid_round = st is not None and st.phase in (
+                t.PREEMPT_SIGNALED, t.PREEMPT_CHECKPOINTING)
             if group.status.admitted:
-                self._reclaim_sweep.discard(key)
+                # An ADMITTED gang is swept only for a stale SHRINK
+                # round (its finisher died before evicting the
+                # surplus); a healthy admitted gang drops out.
+                if mid_round:
+                    await gp.finish_stale_round(self.client, group)
+                else:
+                    self._reclaim_sweep.discard(key)
                 continue
+            if mid_round:
+                # Graceful round in flight: its finisher evicts at
+                # quorum/deadline — sweeping now would hard-kill a
+                # checkpointing gang. Past-deadline rounds whose
+                # finisher died are finished here (the crash backstop).
+                if not await gp.finish_stale_round(self.client, group):
+                    continue
             if not await self._evict_bound_members(ns, name):
                 self._reclaim_sweep.discard(key)
 
     # -- status fan-out ---------------------------------------------------
 
     async def _publish_status(self, queues, admitted, pending,
-                              lq_of, cqs, lqs) -> None:
+                              lq_of, cqs, lqs,
+                              reclaiming: Optional[dict] = None) -> None:
+        reclaiming = reclaiming or {}
         by_cq_pending: dict[str, int] = {}
         by_cq_admitted: dict[str, int] = {}
         by_lq: dict[str, list[int]] = {}
@@ -586,9 +791,9 @@ class QueueController(Controller):
                 continue
             st = cq.status
             want = (pending_n, admitted_n, q.usage, fs.borrowed(q),
-                    tenant_usage.get(name, {}))
+                    tenant_usage.get(name, {}), reclaiming.get(name, 0))
             have = (st.pending, st.admitted, st.usage, st.borrowed,
-                    st.tenant_usage)
+                    st.tenant_usage, st.reclaiming)
             if want == have:
                 continue
             try:
@@ -597,6 +802,7 @@ class QueueController(Controller):
                 cur.status.usage = dict(q.usage)
                 cur.status.borrowed = fs.borrowed(q)
                 cur.status.tenant_usage = tenant_usage.get(name, {})
+                cur.status.reclaiming = reclaiming.get(name, 0)
                 await self.client.update_status(cur)
             except errors.StatusError:
                 pass  # informer refresh heals on the next pass

@@ -20,6 +20,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import os
+import signal as _signal
 import socket
 import tempfile
 import time
@@ -186,6 +187,23 @@ class NodeAgent:
         #: GC'd repost task would silently never run. Cancelled in
         #: stop().
         self._static_tasks: set[asyncio.Task] = set()
+        #: Graceful preemption (preemption.py): pod key -> the job
+        #: checkpoint dir computed at container start (the marker
+        #: watch reads it), plus signal-delivery dedup and the
+        #: marker-watcher tasks (strong refs; cancelled in stop()).
+        self._ckpt_dirs: dict[str, str] = {}
+        #: pod key -> the annotation VALUE last delivered: a restarted
+        #: round re-stamps the annotation (new deadline) and must get
+        #: a fresh delivery + marker watcher, not a dedup no-op.
+        self._preempt_delivered: dict[str, str] = {}
+        #: pod key -> when THIS agent first observed the signal; the
+        #: marker watch accepts only markers written after it (the
+        #: checkpoint dir is shared per job, and a survivor of an
+        #: earlier shrink round leaves its old marker behind —
+        #: reporting that stale step would evict members with unsaved
+        #: progress while claiming success).
+        self._preempt_seen: dict[str, float] = {}
+        self._preempt_tasks: set[asyncio.Task] = set()
         self._informer: Optional[SharedInformer] = None
         self._svc_informer: Optional[SharedInformer] = None
         self._own_svc_informer = False
@@ -275,6 +293,11 @@ class NodeAgent:
             except Exception as e:  # noqa: BLE001
                 log.warning("agent stop: task %r raised during teardown: %s",
                             task.get_name(), e)
+        for task in list(self._preempt_tasks):
+            task.cancel()
+        if self._preempt_tasks:
+            await asyncio.gather(*self._preempt_tasks,
+                                 return_exceptions=True)
         if self.static_source:
             await self.static_source.stop()
         for task in list(self._static_tasks):
@@ -674,6 +697,11 @@ class NodeAgent:
             return True
         if t.is_pod_terminal(pod):
             return True
+        if pod.metadata.annotations.get(t.PREEMPT_ANNOTATION):
+            # Graceful preemption signaled for this member: deliver
+            # the in-container checkpoint request and watch for the
+            # completion marker (preemption.py protocol, node half).
+            self._ensure_preempt_signal(pod)
 
         # Admission (once): device verification (kubelet.go:898 chain).
         if key not in self._admitted:
@@ -1021,6 +1049,17 @@ class NodeAgent:
         # Namespace-qualified: same-named jobs in different namespaces
         # must never share a checkpoint directory.
         env.setdefault("KTPU_JOB_NAME", f"{pod.metadata.namespace}/{job}")
+        # Graceful-preemption file-signal contract: the PATH is fixed
+        # at start (env), the FILE appears when the gang is signaled
+        # (_deliver_preempt) — workloads poll
+        # checkpoint.preempt_requested(). The job's checkpoint dir is
+        # remembered so the marker watch reads where the workload
+        # writes (container-spec KTPU_CHECKPOINT_DIR respected).
+        env.setdefault("KTPU_PREEMPT_FILE",
+                       self._preempt_file_path(pod.metadata.uid))
+        from .. import preemption as gp
+        self._ckpt_dirs[pod.key()] = gp.job_checkpoint_dir(
+            env["KTPU_JOB_NAME"], env.get("KTPU_CHECKPOINT_DIR", ""))
         # Service discovery env (kubelet_pods.go getServiceEnvVarMap);
         # container-specified env always wins.
         if self._svc_informer is not None:
@@ -1235,6 +1274,138 @@ class NodeAgent:
             return t.POD_RUNNING  # OnFailure keeps retrying
         return t.POD_RUNNING
 
+    # -- graceful preemption (preemption.py, node half) -------------------
+
+    def _preempt_file_path(self, uid: str) -> str:
+        return os.path.join(self._node_dir, "preempt", uid)
+
+    def _ensure_preempt_signal(self, pod: t.Pod) -> None:
+        """Once per pod: deliver the checkpoint request (the
+        KTPU_PREEMPT_FILE appears; SIGTERM per the annotated signal
+        mode) and spawn the marker watcher that reports the completed
+        step to the control plane."""
+        from ..util.features import GATES
+        if not GATES.enabled("GracefulPreemption"):
+            return
+        key = pod.key()
+        raw = pod.metadata.annotations.get(t.PREEMPT_ANNOTATION, "")
+        if self._preempt_delivered.get(key) == raw:
+            return
+        self._preempt_delivered[key] = raw
+        # A re-stamped annotation is a NEW round: reset the freshness
+        # floor so only markers written from now on count.
+        self._preempt_seen[key] = time.time()
+        deadline_s, _, mode = raw.partition(";")
+        try:
+            deadline = float(deadline_s)
+        except ValueError:
+            deadline = time.time() + 30.0
+        task = asyncio.get_running_loop().create_task(
+            self._deliver_preempt(pod, deadline,
+                                  mode or t.PREEMPT_SIGNAL_BOTH))
+        self._preempt_tasks.add(task)
+        task.add_done_callback(self._preempt_tasks.discard)
+
+    async def _deliver_preempt(self, pod: t.Pod, deadline: float,
+                               mode: str) -> None:
+        key = pod.key()
+        path = self._preempt_file_path(pod.metadata.uid)
+        try:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            with open(path, "w", encoding="utf-8") as f:
+                f.write("1")
+        except OSError as e:
+            log.warning("preempt file for %s failed: %s", key, e)
+        if mode in (t.PREEMPT_SIGNAL_TERM, t.PREEMPT_SIGNAL_BOTH):
+            for cid in self._containers.get(key, {}).values():
+                try:
+                    await self.runtime.signal_container(
+                        cid, _signal.SIGTERM)
+                except NotImplementedError:
+                    break  # file signal alone carries the request
+                except Exception as e:  # noqa: BLE001
+                    log.warning("preempt SIGTERM for %s: %s", key, e)
+        self.recorder.event(pod, "Normal", "PreemptSignaled",
+                            "checkpoint requested; marker watch armed")
+        gang = pod.spec.gang
+        if not gang:
+            return
+        from .. import preemption as gp
+        seen = self._preempt_seen.get(key, time.time())
+        while not self._stopped and time.time() <= deadline:
+            # _ckpt_dirs may lag the signal (pod signaled while its
+            # container start is still materializing volumes/images):
+            # keep watching until the dir is known, not one-shot.
+            ckpt_dir = self._ckpt_dirs.get(key)
+            info = gp.read_marker_info(ckpt_dir) if ckpt_dir else None
+            # Freshness: only a marker written AFTER this round's
+            # signal counts — an earlier round's leftover must not
+            # pass for a new checkpoint.
+            if info is not None and info[1] >= seen - 0.001:
+                step = info[0]
+                await gp.record_member_checkpoint(
+                    self.client, pod.metadata.namespace, gang,
+                    pod.metadata.name, step)
+                self.recorder.event(
+                    pod, "Normal", "CheckpointComplete",
+                    f"checkpoint-complete marker at step {step}")
+                return
+            if key not in self._pods:
+                return  # pod gone before the workload saved
+            await asyncio.sleep(0.1)
+
+    async def _await_preempt_marker(self, pod: t.Pod,
+                                    grace: float) -> float:
+        """Pre-stop half of the protocol: a signaled pod being
+        gracefully deleted gets up to its remaining grace budget for
+        the checkpoint-complete marker before containers stop —
+        timeout degrades to the ordinary kill. Returns seconds spent
+        (the caller deducts them from the stop grace)."""
+        from ..util.features import GATES
+        if not GATES.enabled("GracefulPreemption"):
+            return 0.0
+        raw = pod.metadata.annotations.get(t.PREEMPT_ANNOTATION)
+        ckpt_dir = self._ckpt_dirs.get(pod.key())
+        if not raw or not pod.spec.gang or not ckpt_dir:
+            return 0.0
+        from .. import preemption as gp
+        # Direct graceful-delete path (no engine round in flight):
+        # the delete IS the signal — deliver it now.
+        self._ensure_preempt_signal(pod)
+        # Cap at the ROUND's annotated deadline: a workload that
+        # already exhausted its engine grace must not get a second
+        # full budget on the kill path (the engine only evicts after
+        # its own wait — stacking the two would double the bound).
+        try:
+            round_deadline = float(raw.partition(";")[0])
+            grace = min(grace, max(0.0, round_deadline - time.time()))
+        except ValueError:
+            pass
+        seen = self._preempt_seen.get(pod.key(), time.time())
+        info = gp.read_marker_info(ckpt_dir)
+        if info is not None and info[1] >= seen - 0.001:
+            return 0.0  # already saved THIS round; nothing to wait on
+        start = time.monotonic()
+        while time.monotonic() - start < grace:
+            info = gp.read_marker_info(ckpt_dir)
+            if info is not None and info[1] >= seen - 0.001:
+                await gp.record_member_checkpoint(
+                    self.client, pod.metadata.namespace, pod.spec.gang,
+                    pod.metadata.name, info[0])
+                break
+            await asyncio.sleep(0.05)
+        return time.monotonic() - start
+
+    def _preempt_forget(self, key: str, uid: str) -> None:
+        """Teardown bookkeeping shared by every pod-removal path."""
+        self._ckpt_dirs.pop(key, None)
+        self._preempt_delivered.pop(key, None)
+        self._preempt_seen.pop(key, None)
+        try:
+            os.remove(self._preempt_file_path(uid))
+        except OSError:
+            pass
+
     # -- termination ------------------------------------------------------
 
     @staticmethod
@@ -1336,7 +1507,13 @@ class NodeAgent:
         cmap = self._containers.get(key, {})
         self.probes.remove_pod(key)
         if grace > 0:
-            spent = await self._run_pre_stop_hooks(pod, cmap, grace)
+            # Checkpoint request first (graceful preemption): the
+            # workload gets the pod's real grace budget to publish its
+            # marker before preStop/stop; the spent time comes out of
+            # the same budget — one grace, not stacked grants.
+            spent = await self._await_preempt_marker(pod, grace)
+            spent += await self._run_pre_stop_hooks(
+                pod, cmap, max(grace - spent, 0.0))
             stop_grace = max(grace - spent, 1.0)
         else:
             stop_grace = 0.0  # force delete: no hooks, immediate kill
@@ -1353,6 +1530,7 @@ class NodeAgent:
         self._admitted.discard(key)
         self._pod_uids.pop(key, None)
         self._uid_alloc.pop(pod.metadata.uid, None)
+        self._preempt_forget(key, pod.metadata.uid)
         await self._release_pod_ip(pod.metadata.uid)
         self.volumes.teardown(pod.metadata.uid)
         # Confirm deletion: grace-0 delete completes removal (the node
@@ -1375,6 +1553,7 @@ class NodeAgent:
         self._admitted.discard(key)
         uid = self._pod_uids.pop(key, None)
         if uid:
+            self._preempt_forget(key, uid)
             await self._release_pod_ip(uid)
             self._evicted.discard(uid)
             self.volumes.teardown(uid)
@@ -1419,6 +1598,10 @@ class NodeAgent:
 
     # -- eviction (eviction_manager.go:151 + preemption.go) ---------------
 
+    #: Pressure eviction honors the pod's grace only up to this bound
+    #: (--eviction-max-pod-grace-period analog).
+    EVICTION_MAX_GRACE_SECONDS = 30.0
+
     async def evict_pod(self, pod: t.Pod, reason: str, message: str) -> None:
         """Kill a pod's containers and fail it in the API; its workload
         controller replaces it elsewhere. The pod object survives (the
@@ -1431,10 +1614,26 @@ class NodeAgent:
         # sandbox dirs) and projected volumes, not just stop processes —
         # a disk-pressure eviction that frees no bytes never clears the
         # signal (reference: eviction reclaims via container/image GC).
+        # terminationGracePeriodSeconds is honored on THIS kill path
+        # too (it was hardcoded to 1s): preStop hooks get the pod's
+        # real grace budget and the stop grace is what remains —
+        # pressure eviction is still a kill, but a lawful one. Capped
+        # (reference: soft eviction's maxPodGracePeriodSeconds): the
+        # eviction exists to RELIEVE active pressure, so a pod asking
+        # for minutes of grace must not postpone reclaim that long.
+        grace = min(max(self._pod_grace(pod), 1.0),
+                    self.EVICTION_MAX_GRACE_SECONDS)
+        # Marker wait BEFORE popping the container map: the direct
+        # signal delivery inside it needs the live containers to send
+        # SIGTERM to (popping first silently dropped that half of the
+        # contract for sigterm-mode gangs).
+        spent = await self._await_preempt_marker(pod, grace)
         cmap = self._containers.pop(key, {})
-        await self._run_pre_stop_hooks(pod, cmap, grace=1.0)
+        spent += await self._run_pre_stop_hooks(
+            pod, cmap, max(grace - spent, 0.0))
+        stop_grace = max(grace - spent, 1.0)
         for cid in cmap.values():
-            await self.runtime.stop_container(cid, grace_seconds=1.0)
+            await self.runtime.stop_container(cid, grace_seconds=stop_grace)
             await self.runtime.remove_container(cid)
         self.volumes.teardown(pod.metadata.uid)
         try:

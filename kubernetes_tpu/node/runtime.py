@@ -122,6 +122,14 @@ class ContainerRuntime:
     async def stop_container(self, container_id: str, grace_seconds: float = 30.0) -> None:
         raise NotImplementedError
 
+    async def signal_container(self, container_id: str, sig: int) -> None:
+        """Deliver a signal WITHOUT initiating a stop — the graceful
+        preemption checkpoint request (SIGTERM while the workload
+        keeps running and saving). Optional: runtimes without process
+        signaling raise NotImplementedError and callers fall back to
+        the file-based signal alone."""
+        raise NotImplementedError
+
     async def remove_container(self, container_id: str) -> None:
         raise NotImplementedError
 
@@ -404,6 +412,16 @@ class ProcessRuntime(ContainerRuntime):
             st.state = STATE_EXITED
             st.exit_code = code if code is not None else -1
             st.finished_at = time.time()
+
+    async def signal_container(self, container_id: str, sig: int) -> None:
+        proc = self._procs.get(container_id)
+        st = self._status.get(container_id)
+        if proc is None or st is None or st.state == STATE_EXITED:
+            return
+        try:
+            os.killpg(proc.pid, sig)
+        except (ProcessLookupError, PermissionError):
+            pass
 
     async def stop_container(self, container_id: str, grace_seconds: float = 30.0) -> None:
         proc = self._procs.get(container_id)

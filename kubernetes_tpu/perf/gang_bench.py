@@ -464,11 +464,247 @@ async def run_queued_gang_bench(n_slices: int = 8,
     }
 
 
+#: Reclaim-storm simulation constants: virtual training progress per
+#: wall second, and the evict-baseline's classic PERIODIC checkpoint
+#: cadence (the graceful protocol checkpoints ON SIGNAL instead — the
+#: whole point: reclaim costs one checkpoint write, not the interval).
+STORM_STEP_RATE = 100.0
+STORM_PERIODIC_S = 10.0
+#: Training time gangs accrue before the storm hits.
+STORM_WARMUP_S = 1.5
+
+
+async def _reclaim_storm_once(n_slices: int, graceful: bool, seed: int,
+                              timeout: float) -> dict:
+    """One seeded reclaim storm: tenant A fills the fleet with
+    checkpoint-opted gangs borrowing tenant B's idle half; B then
+    floods its nominal half back, forcing fair-share reclaim of every
+    borrowed A gang. Goodput = fraction of each reclaimed gang's
+    pre-reclaim virtual training steps retained for its next
+    incarnation:
+
+    - ``graceful=False`` (gate off, the evict baseline): retained =
+      the last PERIODIC checkpoint boundary before the kill;
+    - ``graceful=True``: retained = the step the simulated workload
+      saved when signaled (recorded via the protocol's
+      checkpoint-complete path).
+    """
+    import random
+
+    from .. import preemption as gp
+    from ..client.informer import InformerFactory
+    from ..controllers.queue import QueueController
+    from ..queueing.harness import make_gang, make_queues
+    from ..util.features import GATES
+
+    was_q = GATES.enabled("JobQueueing")
+    was_g = GATES.enabled("GracefulPreemption")
+    GATES.set("JobQueueing", True)
+    GATES.set("GracefulPreemption", graceful)
+    gp.CHECKPOINT_WAIT.reset()
+    sched = qc = factory = reporter = stopwatch = None
+    t0 = time.perf_counter()
+    try:
+        reg, fleet_chips, _, members = _bench_fleet(n_slices, None)
+        import math
+        total_boxes = fleet_chips // math.prod(GANG_SHAPE)
+        for obj in make_queues(nominal_chips=fleet_chips / 2.0):
+            reg.create(obj)
+        client = LocalClient(reg)
+        factory = InformerFactory(client)
+        sched = Scheduler(client, backoff_seconds=0.2,
+                          informer_factory=factory)
+        qc = QueueController(client, factory, fits_probe=lambda g: True)
+        await sched.start()
+        await qc.start()
+
+        def bound_count(ns: str) -> dict:
+            pods, _ = reg.list("pods", ns)
+            out: dict = {}
+            for p in pods:
+                if p.spec.node_name and t.is_pod_active(p):
+                    out[p.spec.gang] = out.get(p.spec.gang, 0) + 1
+            return out
+
+        # Tenant A fills the fleet (half nominal, half borrowed).
+        a_gangs = [f"storm-{i:03d}" for i in range(total_boxes)]
+        for name in a_gangs:
+            group, pods = make_gang(name, "tenant-a", "queue-a",
+                                    checkpoint_grace=5.0)
+            await client.create(group)
+            for pod in pods:
+                await client.create(pod)
+        deadline = time.perf_counter() + timeout / 3
+        started: dict[str, float] = {}
+        while len(started) < total_boxes:
+            for g, n in bound_count("tenant-a").items():
+                if n >= members and g not in started:
+                    started[g] = time.perf_counter()
+            if time.perf_counter() > deadline:
+                raise TimeoutError(
+                    f"storm setup: {len(started)}/{total_boxes} A gangs")
+            await asyncio.sleep(0.05)
+
+        def steps_now(g: str) -> float:
+            return max(0.0,
+                       (time.perf_counter() - started[g]) * STORM_STEP_RATE)
+
+        # Simulated workload: checkpoint-on-signal (graceful mode).
+        async def report_checkpoints():
+            while True:
+                groups, _ = reg.list("podgroups", "tenant-a")
+                for g in groups:
+                    st = g.status.preemption
+                    if st is None or st.phase not in (
+                            t.PREEMPT_SIGNALED, t.PREEMPT_CHECKPOINTING):
+                        continue
+                    step = int(steps_now(g.metadata.name))
+                    for member in st.signaled:
+                        if member not in st.checkpointed:
+                            await gp.record_member_checkpoint(
+                                client, "tenant-a", g.metadata.name,
+                                member, step)
+                await asyncio.sleep(0.02)
+
+        reporter = asyncio.create_task(report_checkpoints())
+
+        # Baseline stop clock: first eviction/terminating event per
+        # gang (watch, not poll — the poll would miss fast kills).
+        stopped: dict[str, float] = {}
+        stream = await client.watch("pods", namespace="tenant-a")
+
+        async def watch_stops():
+            while True:
+                ev = await stream.next()
+                if ev is None or ev[0] == "CLOSED":
+                    return
+                ev_type, pod = ev
+                if pod.spec.gang and pod.spec.gang not in stopped and (
+                        ev_type == "DELETED" or not t.is_pod_active(pod)):
+                    stopped[pod.spec.gang] = time.perf_counter()
+
+        stopwatch = asyncio.create_task(watch_stops())
+        await asyncio.sleep(STORM_WARMUP_S)  # accrue training progress
+
+        # The storm: B floods its nominal half back, seeded order.
+        rng = random.Random(seed)
+        b_gangs = [f"bee-{i:03d}" for i in range(total_boxes // 2)]
+        rng.shuffle(b_gangs)
+        storm_t0 = time.perf_counter()
+        for name in b_gangs:
+            group, pods = make_gang(name, "tenant-b", "queue-b")
+            await client.create(group)
+            for pod in pods:
+                await client.create(pod)
+        deadline = time.perf_counter() + timeout
+        while True:
+            bc = bound_count("tenant-b")
+            if sum(1 for g, n in bc.items() if n >= members) \
+                    >= len(b_gangs):
+                break
+            if time.perf_counter() > deadline:
+                raise TimeoutError(
+                    f"storm: only {len(bc)}/{len(b_gangs)} B gangs bound")
+            await asyncio.sleep(0.05)
+        storm_wall = time.perf_counter() - storm_t0
+
+        # Let in-flight graceful rounds finish (Requeued) before
+        # reading resume state.
+        settle = time.perf_counter() + 10.0
+        while graceful and time.perf_counter() < settle:
+            groups, _ = reg.list("podgroups", "tenant-a")
+            if not any(g.status.preemption is not None
+                       and g.status.preemption.phase in (
+                           t.PREEMPT_SIGNALED, t.PREEMPT_CHECKPOINTING)
+                       for g in groups):
+                break
+            await asyncio.sleep(0.05)
+
+        groups, _ = reg.list("podgroups", "tenant-a")
+        reclaimed = [g for g in groups if not g.status.admitted]
+        pre_total = retained_total = 0.0
+        for g in reclaimed:
+            name = g.metadata.name
+            stop_at = stopped.get(name)
+            st = g.status.preemption
+            if graceful and st is not None and st.signaled_time is not None:
+                pre = steps_now(name) if stop_at is None else max(
+                    0.0, (stop_at - started[name]) * STORM_STEP_RATE)
+                retained = max(0, st.checkpoint_step)
+            else:
+                if stop_at is None:
+                    continue
+                pre = (stop_at - started[name]) * STORM_STEP_RATE
+                # Evict baseline: work since the last periodic
+                # checkpoint boundary is lost.
+                boundary = STORM_PERIODIC_S * STORM_STEP_RATE
+                retained = (pre // boundary) * boundary
+            if pre < 1.0:
+                continue
+            pre_total += pre
+            retained_total += min(retained, pre)
+        goodput = retained_total / pre_total if pre_total else 0.0
+        mode = "graceful" if graceful else "evict"
+        gp.GOODPUT.set(goodput, mode=mode)
+        p50 = gp.CHECKPOINT_WAIT.raw_quantile(0.5)
+        p99 = gp.CHECKPOINT_WAIT.raw_quantile(0.99)
+        return {
+            "mode": mode,
+            "a_gangs": total_boxes,
+            "storm_gangs": len(b_gangs),
+            "reclaimed": len(reclaimed),
+            "pre_reclaim_steps": round(pre_total, 1),
+            "retained_steps": round(retained_total, 1),
+            "goodput": round(goodput, 4),
+            "storm_wall_seconds": round(storm_wall, 3),
+            "checkpoint_wait_p50_ms": (round(p50 * 1e3, 2)
+                                       if p50 is not None else None),
+            "checkpoint_wait_p99_ms": (round(p99 * 1e3, 2)
+                                       if p99 is not None else None),
+            "wall_seconds": round(time.perf_counter() - t0, 3),
+        }
+    finally:
+        for task in (reporter, stopwatch):
+            if task is not None:
+                task.cancel()
+        if qc is not None:
+            await qc.stop()
+        if sched is not None:
+            await sched.stop()
+        if factory is not None:
+            await factory.stop_all()
+        GATES.set("GracefulPreemption", was_g)
+        if not was_q:
+            GATES.set("JobQueueing", False)
+
+
+async def run_reclaim_storm_bench(n_slices: int = 4, seed: int = 20260804,
+                                  timeout: float = 120.0) -> dict:
+    """The goodput gate: the SAME seeded reclaim storm run with the
+    legacy evict path and with graceful preemption, side by side. The
+    acceptance bar is graceful goodput >= 2x the evict baseline
+    (hack/preempt_smoke.sh asserts it at small scale)."""
+    evict = await _reclaim_storm_once(n_slices, False, seed, timeout)
+    graceful = await _reclaim_storm_once(n_slices, True, seed, timeout)
+    ratio = graceful["goodput"] / max(evict["goodput"], 0.01)
+    return {
+        "slices": n_slices,
+        "seed": seed,
+        "step_rate_per_s": STORM_STEP_RATE,
+        "baseline_periodic_s": STORM_PERIODIC_S,
+        "evict": evict,
+        "graceful": graceful,
+        "goodput_ratio": round(ratio, 2),
+    }
+
+
 if __name__ == "__main__":
     import json
     import sys
-    argv = [a for a in sys.argv[1:] if a != "--queued"]
+    argv = [a for a in sys.argv[1:]
+            if a not in ("--queued", "--reclaim-storm")]
     queued = "--queued" in sys.argv[1:]
+    storm = "--reclaim-storm" in sys.argv[1:]
     ns = int(argv[0]) if len(argv) > 0 else 8
     ng = int(argv[1]) if len(argv) > 1 else None
     out = asyncio.run(run_gang_bench(ns, ng))
@@ -476,4 +712,7 @@ if __name__ == "__main__":
         # Same wave through admission; rate within 10% of the above is
         # the "admission is not the bottleneck" acceptance bar.
         out["queued"] = asyncio.run(run_queued_gang_bench(ns, ng))
+    if storm:
+        # Checkpoint-aware preemption goodput vs the evict baseline.
+        out["reclaim_storm"] = asyncio.run(run_reclaim_storm_bench(ns))
     print(json.dumps(out))

@@ -1,0 +1,451 @@
+"""Graceful-preemption engine — signal → checkpoint → requeue.
+
+The ONE implementation every eviction path shares (ISSUE 7 / ROADMAP
+open item 5): scheduler gang preemption (``scheduler/scheduler.py
+_preempt_gang``), partial-bind recovery (``_evict_gang_survivors``)
+and the QueueController's fair-share reclaim all route gang evictions
+here. Behind the ``GracefulPreemption`` feature gate (default off =
+the legacy ~1s hard kill, byte-identical); a gang opts in with
+``spec.checkpoint`` (grace seconds + signal mode).
+
+Protocol (state durable in ``PodGroup.status.preemption`` — it rides
+the MVCC WAL like admission state, so a control-plane crash resumes
+the round instead of forgetting a signaled gang):
+
+1. **Signal** — stamp ``phase=Signaled`` with the member set and an
+   absolute deadline (now + grace), then annotate each member pod
+   with :data:`~kubernetes_tpu.api.types.PREEMPT_ANNOTATION`. The
+   node agent sees the annotation and delivers the in-container
+   request (``KTPU_PREEMPT_FILE`` appears; SIGTERM per the signal
+   mode) — see ``node/agent.py``.
+2. **Checkpoint** — the workload saves (Orbax, ``workloads/
+   checkpoint.py``) and writes an atomic checkpoint-complete marker
+   beside the step dir; the agent reads it and calls
+   :func:`record_member_checkpoint`, which appends the member and
+   raises ``checkpoint_step`` MONOTONICALLY (the tpusan
+   checkpoint-monotonic invariant watches exactly this field).
+3. **Requeue** — a finisher task waits until every still-live
+   signaled member reported (members that die mid-checkpoint drop
+   out of the quorum — a crashed pod must not make the gang pay the
+   full deadline) or the deadline passes, then evicts the members
+   (the legacy kill) and stamps ``phase=Requeued`` with the outcome.
+   The workload's next incarnation resumes from the recorded step
+   via ``KTPU_JOB_NAME`` — reclaim costs one checkpoint interval,
+   not the job.
+
+A wedged workload can never hold quota hostage: the deadline path IS
+the legacy eviction, just delayed by the gang's own grace budget.
+The engine is level-triggered and re-entrant — re-invoking it on an
+already-signaled gang past its deadline finishes the round, so a
+crashed finisher task only costs latency, never convergence.
+
+Chaos: the ``preempt`` injection site ("kill-member") force-deletes
+one signaled member between signal and marker — the mid-checkpoint
+crash the protocol must converge through without double-booking
+chips or resuming from a torn step.
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Iterable, Optional
+
+from .api import errors, types as t
+from .api.meta import now as meta_now
+from .metrics.registry import Counter, Gauge, Histogram
+from .util.tasks import spawn
+
+log = logging.getLogger("preemption")
+
+#: Finisher poll cadence while waiting for checkpoint reports.
+POLL_SECONDS = 0.05
+
+#: Checkpoint-complete marker filename, published atomically beside
+#: the Orbax step dirs. Canonical here (import-light — the node agent
+#: reads markers without pulling jax); ``workloads/checkpoint.py``
+#: re-exports it for the workload side.
+MARKER_NAME = "ktpu-preempt-complete.json"
+
+
+def job_checkpoint_dir(job: str, base: str = "") -> str:
+    """Mirror of ``workloads.checkpoint.checkpoint_dir`` without the
+    jax import: the agent computes the same path the workload uses
+    (<base>/<job>, job = the agent-injected ``KTPU_JOB_NAME``)."""
+    import os
+    base = base or os.environ.get("KTPU_CHECKPOINT_DIR", "/tmp/ktpu-ckpt")
+    return os.path.join(base, job)
+
+
+def marker_path(ckpt_dir: str) -> str:
+    import os
+    return os.path.join(ckpt_dir, MARKER_NAME)
+
+
+def read_marker_info(ckpt_dir: str) -> Optional[tuple[int, float]]:
+    """(step, write time) of the published checkpoint-complete marker,
+    or None when absent/unreadable (a torn tmp file is invisible by
+    construction — the writer publishes via rename). Callers use the
+    write time to reject a STALE marker left by an earlier round: the
+    checkpoint dir is shared per job, and a survivor of an elastic
+    shrink never restarts, so nothing clears the old round's marker."""
+    import json
+    try:
+        with open(marker_path(ckpt_dir), encoding="utf-8") as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return None
+    step = data.get("step")
+    if not isinstance(step, int) or step < 0:
+        return None
+    ts = data.get("time")
+    return step, float(ts) if isinstance(ts, (int, float)) else 0.0
+
+
+def read_marker(ckpt_dir: str) -> Optional[int]:
+    info = read_marker_info(ckpt_dir)
+    return info[0] if info is not None else None
+
+CHECKPOINT_WAIT = Histogram(
+    "preemption_checkpoint_wait_seconds",
+    "Signal to quorum-checkpoint-complete (or deadline) per gang round",
+    buckets=(0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+             30.0, 60.0, 300.0),
+    # Raw samples: the --reclaim-storm bench reports true p50/p99.
+    sample_limit=100_000)
+
+SIGNALED = Counter(
+    "preemption_signaled_total",
+    "Graceful-preemption rounds signaled, by initiating path",
+    labels=("reason",))
+
+ROUNDS = Counter(
+    "preemption_rounds_total",
+    "Graceful-preemption rounds finished, by outcome "
+    "(checkpointed|deadline)",
+    labels=("outcome",))
+
+SHRINKS = Counter(
+    "preemption_shrinks_total",
+    "Elastic gangs shrunk to min_replicas under reclaim (instead of "
+    "a full unadmit)")
+
+GOODPUT = Gauge(
+    "preemption_goodput_ratio",
+    "Fraction of pre-reclaim training steps retained across the last "
+    "reclaim storm, per bench mode (evict|graceful)",
+    labels=("mode",))
+
+
+def enabled() -> bool:
+    from .util.features import GATES
+    return GATES.enabled("GracefulPreemption")
+
+
+def eligible(group: Optional[t.PodGroup]) -> bool:
+    """Does this gang take the graceful path? Gate on AND the gang
+    opted in with a positive checkpoint grace budget."""
+    if group is None or not enabled():
+        return False
+    ck = group.spec.checkpoint
+    return ck is not None and ck.grace_seconds > 0
+
+
+def elastic_target(group: t.PodGroup) -> int:
+    """Member count the scheduler may bind up to. 0 = not elastic /
+    gate off (no cap)."""
+    if not enabled() or not group.spec.max_replicas:
+        return 0
+    return min(group.status.replicas or group.spec.max_replicas,
+               group.spec.max_replicas)
+
+
+def _chaos_kill_member(members: list[t.Pod]) -> Optional[t.Pod]:
+    """The ``preempt`` chaos site: a ``kill-member`` fault names one
+    signaled member to force-delete mid-checkpoint."""
+    from .chaos import core as chaos
+    c = chaos.CONTROLLER
+    if c is None or not members:
+        return None
+    fault = c.decide(chaos.SITE_PREEMPT)
+    if fault is not None and fault.kind == "kill-member":
+        return members[int(fault.param) % len(members)]
+    return None
+
+
+async def _update_group_status(client, ns: str, name: str, mutate,
+                               retries: int = 8) -> Optional[t.PodGroup]:
+    """rv-guarded read-modify-write of a PodGroup's status; ``mutate``
+    returns False to abort (stale round). None when the group is gone
+    or the mutation aborted."""
+    for _ in range(retries):
+        try:
+            cur = await client.get("podgroups", ns, name)
+        except errors.NotFoundError:
+            return None
+        if mutate(cur) is False:
+            return None
+        try:
+            await client.update_status(cur)
+            return cur
+        except errors.ConflictError:
+            continue
+        except errors.NotFoundError:
+            return None
+    log.warning("preemption: status write for %s/%s kept conflicting",
+                ns, name)
+    return None
+
+
+async def signal_gang(client, group: t.PodGroup, members: list[t.Pod],
+                      *, reason: str, recorder=None,
+                      wait: bool = False) -> bool:
+    """Start (or resume) a graceful round for ``members`` of ``group``.
+
+    Idempotent/level-triggered: an in-flight round for the same (or a
+    superset) member set is left alone; a round past its deadline is
+    finished here. Returns True when a graceful round is running or
+    was just completed — the caller must NOT hard-evict; False means
+    the caller should fall back to the legacy kill (not eligible).
+
+    ``wait=True`` runs the finisher inline (harness/controller use);
+    the scheduler passes False so placement never blocks on a grace
+    budget.
+    """
+    if not eligible(group):
+        return False
+    members = [p for p in members if t.is_pod_active(p)]
+    if not members:
+        return True  # nothing left to signal; round is trivially done
+    ns = group.metadata.namespace
+    name = group.metadata.name
+    grace = group.spec.checkpoint.grace_seconds
+    names = sorted(p.metadata.name for p in members)
+    deadline = time.time() + grace
+
+    inflight = {"hit": False}
+    round_names = {"names": names}
+
+    def mutate(cur: t.PodGroup):
+        st = cur.status.preemption
+        kept: list[str] = []
+        merged = names
+        if st is not None and st.phase in (t.PREEMPT_SIGNALED,
+                                           t.PREEMPT_CHECKPOINTING):
+            if time.time() <= st.deadline:
+                if set(names) <= set(st.signaled):
+                    inflight["hit"] = True
+                    return False  # round covers us: its finisher owns it
+                # WIDEN the round: a full reclaim arriving while an
+                # elastic-shrink round is mid-flight must cover the
+                # survivors too — a no-op here would leave them to the
+                # sweep's hard kill with no signal. Union the member
+                # sets (keeping reported checkpoints); the old
+                # finisher aborts on the signaled-set change and the
+                # one spawned below takes over.
+                merged = sorted(set(st.signaled) | set(names))
+                kept = [m for m in st.checkpointed if m in merged]
+            # else: stale round (crashed finisher) — restart the clock.
+        cur.status.preemption = t.PreemptionStatus(
+            phase=(t.PREEMPT_CHECKPOINTING if kept
+                   else t.PREEMPT_SIGNALED),
+            signaled=merged, checkpointed=kept,
+            checkpoint_step=st.checkpoint_step if st is not None else -1,
+            signaled_time=meta_now(), deadline=deadline,
+            rounds=st.rounds if st is not None else 0)
+        round_names["names"] = merged
+        return None
+
+    cur = await _update_group_status(client, ns, name, mutate)
+    if cur is None:
+        # In-flight round (finisher owns it) or the group vanished
+        # (NotFound: the gang is over — nothing to signal; the caller
+        # falls back to the legacy kill for any stragglers).
+        return inflight["hit"]
+    names = round_names["names"]
+    SIGNALED.inc(reason=reason)
+    if recorder is not None:
+        recorder.event(group, "Normal", "PreemptionSignaled",
+                       f"{reason}: {len(names)} members have "
+                       f"{grace:g}s to checkpoint")
+    # Mid-checkpoint crash injection (chaos site "preempt"): the
+    # victim dies AFTER the Signaled stamp but BEFORE its signal is
+    # delivered (annotated) — it can never publish a marker, exactly
+    # the member-crash window the protocol must converge through.
+    # Ordered before the annotation loop so a schedule explorer sees
+    # one deterministic story: a dead member is never annotated.
+    victim = _chaos_kill_member(members)
+    if victim is not None:
+        log.warning("chaos: killing member %s between signal and marker",
+                    victim.key())
+        try:
+            await client.delete("pods", victim.metadata.namespace,
+                                victim.metadata.name,
+                                grace_period_seconds=0)
+        except errors.StatusError:
+            pass
+    # Annotate member pods — the node agent's cue to deliver the
+    # in-container signal (file + SIGTERM per spec.checkpoint.signal).
+    # Value: "<unix deadline>;<signal mode>".
+    stamp = f"{deadline!r};{group.spec.checkpoint.signal}"
+    for pod in members:
+        if victim is not None and pod.key() == victim.key():
+            continue
+        try:
+            fresh = await client.get("pods", pod.metadata.namespace,
+                                     pod.metadata.name)
+            if fresh.metadata.annotations.get(t.PREEMPT_ANNOTATION) \
+                    == stamp:
+                continue
+            # Overwrite a STALE stamp (restarted round): the agent
+            # keys its delivery dedup on the value, so an unchanged
+            # old annotation would leave the new round with no marker
+            # watcher — every save would go unreported.
+            fresh.metadata.annotations[t.PREEMPT_ANNOTATION] = stamp
+            await client.update(fresh)
+        except errors.StatusError as e:
+            # Annotation is best-effort delivery acceleration; the
+            # deadline backstop guarantees progress without it.
+            log.debug("preempt annotation for %s: %s", pod.key(), e)
+    coro = _finish_round(client, ns, name, names, deadline,
+                         time.time(), recorder)
+    if wait:
+        await coro
+    else:
+        spawn(coro, name=f"preempt-finish-{ns}/{name}")
+    return True
+
+
+async def finish_stale_round(client, group: t.PodGroup) -> bool:
+    """Crash backstop (the QueueController sweep calls this): a round
+    whose finisher died is completed once its deadline passed — evict
+    + stamp Requeued. False while the round is still in flight (or
+    there is none); the caller must then leave the gang alone."""
+    st = group.status.preemption
+    if st is None or st.phase not in (t.PREEMPT_SIGNALED,
+                                      t.PREEMPT_CHECKPOINTING):
+        return False
+    if time.time() <= st.deadline:
+        return False
+    await _finish_round(client, group.metadata.namespace,
+                        group.metadata.name, sorted(st.signaled),
+                        st.deadline, signaled_at=None)
+    return True
+
+
+async def _finish_round(client, ns: str, name: str, names: list[str],
+                        deadline: float, signaled_at: Optional[float],
+                        recorder=None) -> None:
+    """Wait for every still-live signaled member to report (or the
+    deadline), then evict and stamp Requeued."""
+    outcome = "deadline"
+    while True:
+        try:
+            cur = await client.get("podgroups", ns, name)
+        except errors.NotFoundError:
+            return  # gang deleted mid-round: nothing to requeue
+        st = cur.status.preemption
+        if st is None or sorted(st.signaled) != names:
+            return  # a newer round superseded this finisher
+        live = set()
+        for pod_name in names:
+            try:
+                pod = await client.get("pods", ns, pod_name)
+            except errors.NotFoundError:
+                continue
+            if t.is_pod_active(pod):
+                live.add(pod_name)
+        reported = set(st.checkpointed)
+        if live <= reported:
+            outcome = "checkpointed" if reported else "deadline"
+            break
+        if time.time() > deadline:
+            break
+        await asyncio.sleep(POLL_SECONDS)
+    if signaled_at is not None:
+        CHECKPOINT_WAIT.observe(max(0.0, time.time() - signaled_at))
+    ROUNDS.inc(outcome=outcome)
+    # The kill half — exactly the legacy eviction, checkpoint later.
+    for pod_name in names:
+        try:
+            await client.evict(ns, pod_name,
+                               t.Eviction(override_budget=True))
+        except errors.StatusError:
+            pass
+
+    def mutate(cur: t.PodGroup):
+        st = cur.status.preemption
+        if st is None or sorted(st.signaled) != names \
+                or st.phase == t.PREEMPT_REQUEUED:
+            return False
+        st.phase = t.PREEMPT_REQUEUED
+        st.outcome = outcome
+        st.requeued_time = meta_now()
+        st.rounds += 1
+        return None
+
+    cur = await _update_group_status(client, ns, name, mutate)
+    if cur is not None and recorder is not None:
+        step = cur.status.preemption.checkpoint_step
+        recorder.event(cur, "Normal", "PreemptionRequeued",
+                       f"gang requeued ({outcome}); resume step "
+                       f"{step if step >= 0 else '<none>'}")
+
+
+async def record_member_checkpoint(client, ns: str, gang: str,
+                                   member: str, step: int) -> bool:
+    """A member finished its checkpoint (the node agent read the
+    atomic marker; harnesses call this directly as the simulated
+    workload). ``checkpoint_step`` only ever RISES — a stale or torn
+    marker can never rewind the gang's resume point."""
+
+    def mutate(cur: t.PodGroup):
+        st = cur.status.preemption
+        if st is None:
+            # No engine round in flight — a DIRECT graceful delete
+            # (someone deleted the pod with grace) still records the
+            # resume point; the phase stays idle.
+            st = cur.status.preemption = t.PreemptionStatus()
+        appended = False
+        if member not in st.checkpointed and st.phase in (
+                "", t.PREEMPT_SIGNALED, t.PREEMPT_CHECKPOINTING) \
+                and (not st.signaled or member in st.signaled):
+            st.checkpointed.append(member)
+            appended = True
+        new_step = max(st.checkpoint_step, int(step))
+        if not appended and new_step == st.checkpoint_step:
+            return False
+        st.checkpoint_step = new_step
+        if st.phase == t.PREEMPT_SIGNALED:
+            st.phase = t.PREEMPT_CHECKPOINTING
+        return None
+
+    return await _update_group_status(client, ns, gang, mutate) is not None
+
+
+async def preempt_victims(client, victims: Iterable[t.Pod], *,
+                          reason: str, recorder=None) -> list[t.Pod]:
+    """Shared entry for victim sets that may span gangs (scheduler
+    gang preemption). Gracefully signals every eligible gang; returns
+    the pods the caller must still hard-evict itself (loose pods and
+    members of non-opted-in gangs) — so the gate-off path stays
+    byte-identical in the caller's hands."""
+    by_gang: dict[str, list[t.Pod]] = {}
+    legacy: list[t.Pod] = []
+    for pod in victims:
+        if pod.spec.gang:
+            by_gang.setdefault(
+                f"{pod.metadata.namespace}/{pod.spec.gang}", []).append(pod)
+        else:
+            legacy.append(pod)
+    for gk, members in sorted(by_gang.items()):
+        ns, gname = gk.split("/", 1)
+        try:
+            group = await client.get("podgroups", ns, gname)
+        except errors.StatusError:
+            group = None
+        handled = group is not None and await signal_gang(
+            client, group, members, reason=reason, recorder=recorder)
+        if not handled:
+            legacy.extend(members)
+    return legacy

@@ -84,6 +84,12 @@ class Workload:
     #: Set on admitted workloads.
     admitted_at: Optional[float] = None
     mode: str = ""           # "", Nominal, Borrowed, Backfill
+    #: Elastic gangs above min_replicas: the demand they would charge
+    #: at min_replicas. None = not shrinkable (fixed-size, already at
+    #: min, or the GracefulPreemption gate is off). Reclaim prefers
+    #: shrinking such a gang (releasing demand - min_demand) over
+    #: fully unadmitting anyone at the same priority.
+    min_demand: Optional[dict] = None
 
 
 # -- shares -----------------------------------------------------------------
@@ -272,18 +278,44 @@ def reclaim_cost(w: Workload) -> tuple:
             w.key)
 
 
-def pick_reclaim_victims(lender: QueueState,
-                         demand: dict[str, float],
-                         cohort_queues: list[QueueState],
-                         admitted: list[Workload]) -> list[Workload]:
-    """Choose admitted workloads whose release restores enough cohort
+#: plan_reclaim actions.
+RECLAIM_SHRINK = "shrink"
+RECLAIM_EVICT = "evict"
+
+
+def _unit_released(w: Workload, action: str) -> dict[str, float]:
+    """Demand an action frees: shrink releases the elastic delta;
+    evict releases whatever the gang still charges (full demand, or
+    min_demand if a shrink of the same gang was already applied —
+    callers apply units in order)."""
+    if action == RECLAIM_SHRINK:
+        assert w.min_demand is not None
+        return {r: max(0.0, a - w.min_demand.get(r, 0.0))
+                for r, a in w.demand.items()}
+    return dict(w.demand)
+
+
+def plan_reclaim(lender: QueueState,
+                 demand: dict[str, float],
+                 cohort_queues: list[QueueState],
+                 admitted: list[Workload]
+                 ) -> list[tuple[Workload, str]]:
+    """Choose reclaim actions whose releases restore enough cohort
     headroom for ``demand``. Returns [] when reclaim cannot help (the
     shortfall is not held by over-nominal queues). Victims come only
     from queues CURRENTLY over their nominal — a queue within its own
     quota is never preempted to serve a neighbor. Deliberately not
     filtered by admission-time mode: a quota shrink can push usage
     admitted as Nominal over the new nominal, and those chips must be
-    reclaimable or the cohort deadlocks behind an unservable blocker."""
+    reclaimable or the cohort deadlocks behind an unservable blocker.
+
+    Elastic gangs (``min_demand`` set) are SHRUNK before anyone at the
+    same priority is fully evicted — Kant's unified elasticity: a
+    borrower gives back its borrowed slice sub-meshes and keeps
+    training at min_replicas instead of dying. A shrunken gang may
+    still be fully evicted later in the same plan (releasing its
+    residual min_demand) if shrinking alone cannot cover the
+    shortfall."""
     gov = lender.governed(demand)
     if not gov:
         return []
@@ -301,23 +333,46 @@ def pick_reclaim_victims(lender: QueueState,
                 for r, cap in q.nominal.items()
                 if sim_usage[qname].get(r, 0.0) > cap + 1e-9}
 
-    candidates = sorted(
-        (w for w in admitted if w.queue in by_name), key=reclaim_cost)
-    victims: list[Workload] = []
-    for w in candidates:
+    # Candidate units: (cost, workload, action). Same pricing as the
+    # scheduler's gang preemption (priority, then released size, then
+    # LIFO); at equal priority a shrink sorts before any evict — the
+    # less disruptive release wins ties.
+    units: list[tuple[tuple, Workload, str]] = []
+    for w in admitted:
+        if w.queue not in by_name:
+            continue
+        if w.min_demand is not None:
+            delta = _unit_released(w, RECLAIM_SHRINK)
+            units.append(((w.priority, 0, delta.get(RESOURCE_TPU, 0.0),
+                           -(w.admitted_at or 0.0), w.key),
+                          w, RECLAIM_SHRINK))
+        units.append(((w.priority, 1, w.demand.get(RESOURCE_TPU, 0.0),
+                       -(w.admitted_at or 0.0), w.key),
+                      w, RECLAIM_EVICT))
+    units.sort(key=lambda u: u[0])
+    shrunk: set[str] = set()
+    plan: list[tuple[Workload, str]] = []
+    for _cost, w, action in units:
         if not shortfall:
             break
+        released = _unit_released(w, action)
+        if action == RECLAIM_EVICT and w.key in shrunk:
+            # The shrink already gave back the delta; a full evict now
+            # frees only the residual min-size charge.
+            released = dict(w.min_demand or {})
         over = over_nominal(w.queue)
         # Only useful if its queue is over nominal in a short resource
-        # AND the victim itself holds some of it — else its eviction
-        # frees nothing the blocker needs (and the cost sort would put
+        # AND this release actually frees some of it — else it frees
+        # nothing the blocker needs (and the cost sort would put
         # exactly such zero-TPU gangs first).
-        if not any(r in over and w.demand.get(r, 0.0) > 1e-9
+        if not any(r in over and released.get(r, 0.0) > 1e-9
                    for r in shortfall):
             continue
-        victims.append(w)
+        plan.append((w, action))
+        if action == RECLAIM_SHRINK:
+            shrunk.add(w.key)
         q = by_name[w.queue]
-        for r, a in q.governed(w.demand).items():
+        for r, a in q.governed(released).items():
             sim_usage[w.queue][r] = max(
                 0.0, sim_usage[w.queue].get(r, 0.0) - a)
         sims = []
@@ -329,4 +384,16 @@ def pick_reclaim_victims(lender: QueueState,
         shortfall = {r: a - headroom.get(r, 0.0)
                      for r, a in gov.items()
                      if a > headroom.get(r, 0.0) + 1e-9}
-    return victims if not shortfall else []
+    return plan if not shortfall else []
+
+
+def pick_reclaim_victims(lender: QueueState,
+                         demand: dict[str, float],
+                         cohort_queues: list[QueueState],
+                         admitted: list[Workload]) -> list[Workload]:
+    """Evict-only view of :func:`plan_reclaim` — the pre-elastic
+    interface, exactly equivalent when no workload carries
+    ``min_demand``."""
+    return [w for w, action in plan_reclaim(lender, demand,
+                                            cohort_queues, admitted)
+            if action == RECLAIM_EVICT]

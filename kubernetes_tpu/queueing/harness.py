@@ -23,7 +23,7 @@ from __future__ import annotations
 import asyncio
 import time
 
-from ..api import types as t
+from ..api import errors, types as t
 from ..api.meta import ObjectMeta
 from ..api.queueing import ClusterQueue, ClusterQueueSpec, LocalQueue, \
     LocalQueueSpec
@@ -58,20 +58,38 @@ def make_queues(nominal_chips: float = 32.0) -> list:
 
 def make_gang(name: str, namespace: str, queue: str, priority: int = 0,
               shape: list = None, chips_per_pod: int = CHIPS_PER_HOST,
-              runtime: float = None) -> tuple:
+              runtime: float = None, members: int = None,
+              checkpoint_grace: float = None,
+              elastic: tuple = None, resources: dict = None) -> tuple:
     """A queued gang + its member pods. ``shape``/``chips_per_pod``
     size it (default: one GANG_SHAPE box, host-sized pods);
-    ``runtime`` stamps the backfill projection annotation."""
-    shape = list(shape) if shape is not None else list(GANG_SHAPE)
-    members = 1
-    for d in shape:
-        members *= d
-    members //= chips_per_pod
+    ``runtime`` stamps the backfill projection annotation.
+
+    Graceful-preemption extensions: ``members`` sizes a SHAPELESS gang
+    (pass ``resources`` for its quota demand — compact allocation, no
+    contiguity constraint), ``checkpoint_grace`` opts it into the
+    signal→checkpoint→requeue protocol, ``elastic=(min, max)`` makes
+    it elastic (min_member = min: the gang must stay releasable at its
+    shrunken size)."""
+    shape = list(shape) if shape is not None else (
+        list(GANG_SHAPE) if members is None else [])
+    if members is None:
+        members = 1
+        for d in shape:
+            members *= d
+        members //= chips_per_pod
     group = t.PodGroup(
         metadata=ObjectMeta(name=name, namespace=namespace),
         spec=t.PodGroupSpec(min_member=members, slice_shape=shape,
                             queue=queue,
-                            priority=priority or None))
+                            priority=priority or None,
+                            resources=dict(resources or {})))
+    if checkpoint_grace is not None:
+        group.spec.checkpoint = t.CheckpointSpec(
+            grace_seconds=checkpoint_grace)
+    if elastic is not None:
+        group.spec.min_replicas, group.spec.max_replicas = elastic
+        group.spec.min_member = elastic[0]
     if runtime is not None:
         from ..api.queueing import RUNTIME_ANNOTATION
         group.metadata.annotations[RUNTIME_ANNOTATION] = str(runtime)
@@ -209,6 +227,205 @@ async def run_queue_smoke(timeout: float = 30.0,
             await factory.stop_all()  # last: the scheduler rides it too
         if not was_on:
             GATES.set("JobQueueing", False)
+
+
+async def run_preempt_smoke(seed: int = 0, timeout: float = 45.0) -> dict:
+    """Graceful-preemption acceptance scenario (<60s): signal →
+    checkpoint → shrink → regrow → converge, with a seeded
+    mid-checkpoint member crash.
+
+    One 64-chip slice, two tenants in a cohort (32 nominal each), the
+    JobQueueing + GracefulPreemption gates on:
+
+    1. tenant A runs ONE elastic, checkpoint-opted gang at full size
+       (16 members / 64 chips — 32 borrowed from B);
+    2. a simulated workload watches for the Signaled phase and reports
+       deterministic checkpoint steps (100 per round) for each
+       signaled member;
+    3. tenant B submits a fixed 32-chip gang: reclaim SHRINKS A to
+       min_replicas (8) instead of unadmitting it — the surplus
+       members are signaled, checkpoint, and only then evicted; the
+       ``preempt`` chaos site kills one signaled member between
+       signal and marker (the protocol must converge anyway);
+    4. B finishes (deleted); the regrow pass raises A's target back
+       to 16 and the recreated members bind — convergence.
+
+    Deterministic extract (step numbers, member counts, phases) lets
+    ``run_preempt_smoke_schedules`` assert byte-identical convergence
+    across explored interleavings. Shared by ``hack/preempt_smoke.sh``
+    and the integration tier."""
+    from .. import preemption as gp
+    from ..chaos import core as chaos
+
+    t0 = time.perf_counter()
+    was_q = GATES.enabled("JobQueueing")
+    was_g = GATES.enabled("GracefulPreemption")
+    GATES.set("JobQueueing", True)
+    GATES.set("GracefulPreemption", True)
+    controller = chaos.arm(chaos.ChaosController(int(seed), ()))
+    controller.trigger(chaos.SITE_PREEMPT, "kill-member")
+    sched = qc = factory = None
+    reporter = None
+    try:
+        reg = Registry()
+        reg.admission = default_chain(reg)
+        reg.create(t.Namespace(metadata=ObjectMeta(name="default")))
+        from ..perf.gang_bench import build_slice
+        build_slice(reg, 0)  # 4x4x4 = 64 chips over 16 hosts
+        client = LocalClient(reg)
+        for obj in make_queues(nominal_chips=32.0):
+            reg.create(obj)
+        factory = InformerFactory(client)
+        sched = Scheduler(client, backoff_seconds=0.2,
+                          informer_factory=factory)
+        qc = QueueController(client, factory, fits_probe=lambda g: True)
+        loop = asyncio.get_running_loop()
+        await sched.start()
+        await qc.start()
+
+        async def simulated_workload():
+            """The gang's training side: a member checkpoints only
+            when its own DELIVERED signal (the pod annotation) exists
+            and it is still alive — a chaos-killed member can never
+            publish a marker. Steps are deterministic (100/round)."""
+            while True:
+                groups, _ = reg.list("podgroups", "")
+                for g in groups:
+                    st = g.status.preemption
+                    if st is None or st.phase not in (
+                            t.PREEMPT_SIGNALED, t.PREEMPT_CHECKPOINTING):
+                        continue
+                    step = 100 * (st.rounds + 1)
+                    for member in st.signaled:
+                        if member in st.checkpointed:
+                            continue
+                        try:
+                            pod = reg.get("pods", g.metadata.namespace,
+                                          member)
+                        except errors.NotFoundError:
+                            continue
+                        if not t.is_pod_active(pod) or not \
+                                pod.metadata.annotations.get(
+                                    t.PREEMPT_ANNOTATION):
+                            continue
+                        await gp.record_member_checkpoint(
+                            client, g.metadata.namespace,
+                            g.metadata.name, member, step)
+                await asyncio.sleep(0.05)
+
+        reporter = asyncio.create_task(simulated_workload())
+
+        def bound_members(ns: str, gang: str) -> list:
+            pods, _ = reg.list("pods", ns)
+            return [p for p in pods if p.spec.gang == gang
+                    and p.spec.node_name and t.is_pod_active(p)]
+
+        # Phase 1: A's elastic gang fills the slice (Borrowed mode).
+        group, pods = make_gang("ela-00", "tenant-a", "queue-a",
+                                shape=[4, 4, 4], checkpoint_grace=10.0,
+                                elastic=(8, 16))
+        await client.create(group)
+        for pod in pods:
+            await client.create(pod)
+        await _wait(lambda: len(bound_members("tenant-a", "ela-00")) >= 16,
+                    loop.time() + timeout / 3, "A's 16 members bound")
+
+        # Phase 2: B's fixed gang forces the reclaim storm — A shrinks.
+        bgroup, bpods = make_gang(
+            "bee-00", "tenant-b", "queue-b", members=8,
+            resources={t.RESOURCE_TPU: 32.0})
+        await client.create(bgroup)
+        for pod in bpods:
+            await client.create(pod)
+        await _wait(lambda: len(bound_members("tenant-b", "bee-00")) >= 8,
+                    loop.time() + timeout / 2, "B's gang bound after shrink")
+        await _wait(lambda: len(bound_members("tenant-a", "ela-00")) == 8,
+                    loop.time() + timeout / 2, "A shrunk to 8 members")
+        a = reg.get("podgroups", "tenant-a", "ela-00")
+        assert a.status.admitted, "shrink must keep the gang admitted"
+        assert a.status.replicas == 8, a.status.replicas
+        st = a.status.preemption
+        assert st is not None and st.phase == t.PREEMPT_REQUEUED, st
+        assert st.checkpoint_step == 100, st.checkpoint_step
+        assert st.outcome == "checkpointed", st.outcome
+        crash_kills = sum(1 for f in controller.injected
+                          if f.site == chaos.SITE_PREEMPT)
+        assert crash_kills == 1, "mid-checkpoint crash never fired"
+        # The crashed member reported nothing; the others did. 8
+        # surplus were signaled, one was chaos-killed mid-checkpoint.
+        assert len(st.signaled) == 8 and len(st.checkpointed) == 7, (
+            st.signaled, st.checkpointed)
+
+        # Phase 3: B finishes; A regrows to max and re-fills the slice
+        # (the evicted members' controller-recreated replacements).
+        for pod in bpods:
+            try:
+                await client.delete("pods", "tenant-b",
+                                    pod.metadata.name,
+                                    grace_period_seconds=0)
+            except errors.NotFoundError:
+                pass
+        await client.delete("podgroups", "tenant-b", "bee-00")
+        for m in range(16, 24):  # fresh names: the old ones linger
+            pod = make_gang("ela-00", "tenant-a", "queue-a",
+                            shape=[4, 4, 4])[1][0]
+            pod.metadata.name = f"ela-00-{m}"
+            await client.create(pod)
+        await _wait(lambda: (reg.get("podgroups", "tenant-a", "ela-00")
+                             .status.replicas == 16),
+                    loop.time() + timeout, "A regrown to 16")
+        await _wait(lambda: len(bound_members("tenant-a", "ela-00")) >= 16,
+                    loop.time() + timeout, "A re-filled the slice")
+        a = reg.get("podgroups", "tenant-a", "ela-00")
+        return {
+            "a_admitted": a.status.admitted,
+            "a_replicas": a.status.replicas,
+            "a_bound": len(bound_members("tenant-a", "ela-00")),
+            "shrink_outcome": st.outcome,
+            "checkpoint_step": st.checkpoint_step,
+            "signaled": len(st.signaled),
+            "checkpointed": len(st.checkpointed),
+            "crash_kills": crash_kills,
+            "elapsed_s": round(time.perf_counter() - t0, 2),
+        }
+    finally:
+        chaos.disarm()
+        if reporter is not None:
+            reporter.cancel()
+        if qc is not None:
+            await qc.stop()
+        if sched is not None:
+            await sched.stop()
+        if factory is not None:
+            await factory.stop_all()  # last: the scheduler rides it too
+        if not was_q:
+            GATES.set("JobQueueing", False)
+        if not was_g:
+            GATES.set("GracefulPreemption", False)
+
+
+def run_preempt_smoke_schedules(base_seed, schedules: int = 4,
+                                mode: str = "dpor",
+                                timeout: float = 45.0) -> dict:
+    """tpusan arm of the graceful-preemption gate: the same seeded
+    storm explored under ``schedules`` interleavings with the cluster
+    invariants armed (incl. checkpoint-monotonic), asserting the
+    DETERMINISTIC convergence facts are byte-identical on every
+    schedule."""
+    from ..analysis import interleave
+
+    keys = ("a_admitted", "a_replicas", "a_bound", "shrink_outcome",
+            "checkpoint_step", "signaled", "checkpointed", "crash_kills")
+    rep = interleave.explore_sanitized(
+        lambda i: run_preempt_smoke(seed=int(base_seed) if str(
+            base_seed).isdigit() else 0, timeout=timeout),
+        base_seed=base_seed, schedules=schedules, mode=mode,
+        extract=lambda v: {k: v[k] for k in keys})
+    outcomes = [{k: r[k] for k in keys} for r in rep["schedules"]]
+    assert all(o == outcomes[0] for o in outcomes), (
+        f"convergence diverged across schedules: {outcomes}")
+    rep["base_seed"] = base_seed
+    return rep
 
 
 def run_queue_smoke_schedules(base_seed, schedules: int = 4,

@@ -197,6 +197,16 @@ class SchedulingQueue:
             elif self._maybe_release_gang(gk):
                 self._wake_soon()
 
+    def gang_pod_lost(self, pod: t.Pod) -> None:
+        """A bound member went terminal (evicted/failed): it no longer
+        counts toward quorum — and, under the elastic cap, a stale
+        bound count would permanently park the replacement members
+        (bound ghosts consumed the whole target)."""
+        gk = f"{pod.metadata.namespace}/{pod.spec.gang}"
+        bound = self._gang_bound.get(gk)
+        if bound is not None:
+            bound.discard(pod.key())
+
     def gang_bound_count(self, gk: str) -> int:
         return len(self._gang_bound.get(gk, ()))
 

@@ -302,17 +302,24 @@ class Scheduler:
             spawn(self.queue.add_pod(pod), name="queue-add-pod")
         elif pod.spec.node_name:
             self.cache.add_pod(pod)
-            if pod.spec.gang:
+            if pod.spec.gang and t.is_pod_active(pod):
+                # Active only: a relisted terminating member is a
+                # ghost — it must not count toward quorum or the
+                # elastic cap.
                 self.queue.gang_pod_confirmed(pod)
 
     def _pod_updated(self, old: t.Pod, pod: t.Pod) -> None:
         if pod.spec.node_name:
             self.cache.update_pod(pod)
-            if pod.spec.gang:
+            if pod.spec.gang and t.is_pod_active(pod):
                 self.queue.gang_pod_confirmed(pod)
             if not t.is_pod_active(pod):
-                # Terminal pods free their chips for future placements.
+                # Terminal pods free their chips for future placements
+                # — and stop counting toward gang quorum / the elastic
+                # cap (a ghost bound count would park replacements).
                 self.cache.remove_pod(pod)
+                if pod.spec.gang:
+                    self.queue.gang_pod_lost(pod)
         elif self._relevant(pod):
             spawn(self.queue.add_pod(pod), name="queue-add-pod")
 
@@ -820,6 +827,23 @@ class Scheduler:
                 victims[owner_key] = owner
         return victims
 
+    def _reservation_stolen(self, res, gang_prio: int) -> bool:
+        """True when any cell of this gang's own carved box is now
+        held by an ACTIVE pod of priority >= the gang's — i.e. an
+        occupant the gang may not preempt, so the reservation is
+        permanently unsatisfiable."""
+        for _coord, (node_name, chip_id) in res.cells.items():
+            info = self.cache.nodes.get(node_name)
+            if info is None:
+                continue
+            owner_key = info.chip_owner.get(chip_id)
+            if owner_key is None:
+                continue
+            owner = info.pods.get(owner_key)
+            if owner is not None and t.pod_priority(owner) >= gang_prio:
+                return True
+        return False
+
     async def _preempt_gang(self, group: t.PodGroup, pods: list[t.Pod],
                             gang_prio: int) -> bool:
         """Carve ONE contiguous box for a higher-priority gang by
@@ -871,7 +895,20 @@ class Scheduler:
             group, "Normal", "GangPreemption",
             f"evicting {len(victims)} pods ({len(evicted_gangs)} gangs) "
             f"to free a {'x'.join(map(str, shape))} box on {sl.slice_id}")
-        for v in victims.values():
+        # Graceful preemption (preemption.py, gated): checkpoint-opted
+        # victim gangs are SIGNALED — they keep their chips for their
+        # grace budget while checkpointing, then the engine's finisher
+        # evicts them. The preemptor's reservation holds the box
+        # meanwhile; its requeue loop binds once the chips free. Only
+        # the remainder (loose pods, non-opted gangs, gate off) takes
+        # the legacy hard evict below — byte-identical when gated off.
+        from .. import preemption as gp
+        to_evict = list(victims.values())
+        if gp.enabled():
+            to_evict = await gp.preempt_victims(
+                self.client, victims.values(), reason="gang-preemption",
+                recorder=self.recorder)
+        for v in to_evict:
             try:
                 await self.client.evict(
                     v.metadata.namespace, v.metadata.name,
@@ -888,6 +925,15 @@ class Scheduler:
                                     why: str) -> None:
         """Delete bound members of a partially-bound gang so their
         controller recreates them and the gang re-plans whole."""
+        # Checkpoint-opted gangs get the graceful round first — the
+        # survivors save state before the recovery kill, so the
+        # recreated gang resumes instead of restarting (gate off =
+        # the legacy loop below, byte-identical).
+        from .. import preemption as gp
+        if gp.enabled() and await gp.signal_gang(
+                self.client, group, bound_pods,
+                reason="gang-recovery", recorder=self.recorder):
+            return
         for pod in bound_pods:
             self.recorder.event(
                 group, "Warning", "GangRecoveryEvict",
@@ -956,6 +1002,31 @@ class Scheduler:
         if not pods or len(pods) + bound < group.spec.min_member:
             return  # below quorum; queue re-releases when members return
 
+        # Elastic cap (GracefulPreemption): a shrunken gang must not
+        # bind past status.replicas — its quota charge follows that
+        # target, and binding beyond it would physically over-commit
+        # the cohort. Surplus members park in the queue (the existing
+        # straggler path) and bind when the regrow pass raises the
+        # target. Gate off / non-elastic gangs: target 0, no cap.
+        from .. import preemption as gp
+        target = gp.elastic_target(group)
+        if target:
+            take = max(target - bound, 0)
+            if take < len(pods):
+                pods.sort(key=lambda p: p.metadata.name)
+                parked = len(pods) - take
+                pods = pods[:take]
+                self.recorder.event(
+                    group, "Normal", "ElasticParked",
+                    f"{parked} members beyond elastic target {target} "
+                    f"wait for regrow")
+                if not pods:
+                    await self.queue.requeue(
+                        GangUnit(unit.group_key, []), self.backoff_seconds)
+                    m.PODS_SCHEDULED.inc(result="gang_elastic_parked",
+                                         amount=parked)
+                    return
+
         # Plan. A partially-bound gang (recovering from a partial bind
         # failure) must STILL land as one contiguous box: the remainder
         # is planned inside a full-shape box anchored on the chips the
@@ -1008,6 +1079,22 @@ class Scheduler:
                 from ..util.features import GATES
                 gang_prio = max((t.pod_priority(p) for p in pods),
                                 default=0)
+                res = self.cache.reservations.get(group.key())
+                if res is not None and self._reservation_stolen(res,
+                                                                gang_prio):
+                    # A strictly-higher-priority preemptor legally
+                    # took cells of the box this gang carved (its plan
+                    # ignores lower-priority reservations). The hold
+                    # can never be satisfied now, and while it lives
+                    # the gate below blocks re-carving — the r6
+                    # phase-3 livelock: at small fleets every carve
+                    # collides and the losers sat stale until the
+                    # 120s reservation TTL. Release and re-carve now.
+                    self.cache.release_reservation(group.key())
+                    self.recorder.event(
+                        group, "Normal", "PreemptionRestarted",
+                        "carved box was taken by a higher-priority "
+                        "gang; re-carving")
                 if (gang_prio > 0 and GATES.enabled("PodPriority")
                         and group.key() not in self.cache.reservations
                         and await self._preempt_gang(group, pods,

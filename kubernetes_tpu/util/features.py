@@ -74,6 +74,14 @@ KNOWN_FEATURES = {f.name: f for f in [
             "borrowing with gang-aware reclaim, and backfill "
             "(queueing/ + controllers/queue.py); off = PodGroups "
             "race straight into the scheduling queue as before"),
+    Feature("GracefulPreemption", False, ALPHA,
+            "checkpoint-aware gang preemption (preemption.py): signal "
+            "the gang (SIGTERM + KTPU_PREEMPT file), wait bounded by "
+            "spec.checkpoint.grace_seconds for checkpoint-complete "
+            "markers, then requeue with resume state; elastic gangs "
+            "shrink to spec.min_replicas under reclaim instead of "
+            "dying. Off = every eviction path is the legacy hard "
+            "kill, byte-identical"),
 ]}
 
 

@@ -25,10 +25,21 @@ it.
 from __future__ import annotations
 
 import contextlib
+import json
 import os
+import time
 from typing import Any, Optional
 
 import jax
+
+# Checkpoint-complete marker, written atomically BESIDE the Orbax
+# step dirs after a preemption-requested save finishes. The node
+# agent reads it and reports the step to the control plane
+# (preemption.record_member_checkpoint) — the gang's durable resume
+# point. tmp+rename: a crash mid-write can never leave a torn marker
+# (the step recorded is always a COMPLETED checkpoint). Canonical
+# name + reader live in preemption.py so the agent needs no jax.
+from ..preemption import MARKER_NAME, marker_path, read_marker  # noqa: F401
 
 
 @contextlib.contextmanager
@@ -50,6 +61,42 @@ def checkpoint_dir(base: str = "", job: str = "") -> str:
     job = job or os.environ.get("KTPU_JOB_NAME") \
         or os.environ.get("POD_NAME", "job")
     return os.path.join(base, job)
+
+
+def preempt_requested() -> bool:
+    """In-pod poll: has the orchestrator requested a preemption
+    checkpoint? True when ``KTPU_PREEMPT=1`` (env contract) or the
+    agent-managed ``KTPU_PREEMPT_FILE`` exists (file contract — the
+    agent injects the path at container start and creates the file
+    when the gang is signaled). Training loops check this each step;
+    see :func:`kubernetes_tpu.workloads.lm.train`."""
+    if os.environ.get("KTPU_PREEMPT") == "1":
+        return True
+    path = os.environ.get("KTPU_PREEMPT_FILE", "")
+    return bool(path) and os.path.exists(path)
+
+
+def write_marker(ckpt_dir: str, step: int) -> None:
+    """Atomically publish "checkpoint for ``step`` is durable". Call
+    ONLY after :func:`save` returned — the marker is the agent's cue
+    that eviction may proceed."""
+    os.makedirs(ckpt_dir, exist_ok=True)
+    tmp = marker_path(ckpt_dir) + f".tmp.{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump({"step": int(step), "time": time.time()}, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, marker_path(ckpt_dir))
+
+
+def clear_marker(ckpt_dir: str) -> None:
+    """Remove a stale marker — the resumed incarnation calls this at
+    startup so a NEW preemption round never reads the old round's
+    step."""
+    try:
+        os.remove(marker_path(ckpt_dir))
+    except OSError:
+        pass
 
 
 def save(step: int, state: Any, ckpt_dir: str,

@@ -406,6 +406,9 @@ def train(cfg: LMConfig, mesh, steps: int, batch: int, seq: int,
         return {"params": params, "opt_state": opt_state}
 
     state, start = ckpt.resume_or_init(ckpt_dir, init)
+    # A marker left by the PREVIOUS incarnation's preemption round
+    # must not satisfy a new round's wait.
+    ckpt.clear_marker(ckpt_dir)
     step_fn = make_train_step(cfg, mesh, lr)
     params, opt_state = state["params"], state["opt_state"]
     loss = None
@@ -428,11 +431,24 @@ def train(cfg: LMConfig, mesh, steps: int, batch: int, seq: int,
             loss.block_until_ready()  # honest step time when reporting
             reporter.report(step, _time.perf_counter() - t0, batch * seq,
                             loss=float(loss))
+        if ckpt.preempt_requested():
+            # Graceful preemption: the orchestrator signaled this gang
+            # (KTPU_PREEMPT / the agent's preempt file). Save NOW,
+            # publish the checkpoint-complete marker, and exit cleanly
+            # — the node agent reports the step and eviction proceeds;
+            # the next incarnation resumes from step + 1.
+            ckpt.save(step, {"params": params, "opt_state": opt_state},
+                      ckpt_dir)
+            ckpt.write_marker(ckpt_dir, step)
+            return {"final_step": step + 1, "resumed_from": start,
+                    "loss": float(loss) if loss is not None else None,
+                    "preempted": True}
         if checkpoint_every and (step + 1) % checkpoint_every == 0:
             ckpt.save(step, {"params": params, "opt_state": opt_state},
                       ckpt_dir)
     return {"final_step": steps, "resumed_from": start,
-            "loss": float(loss) if loss is not None else None}
+            "loss": float(loss) if loss is not None else None,
+            "preempted": False}
 
 
 def synthetic_batch(rng, cfg: LMConfig, mesh, batch: int, seq: int):

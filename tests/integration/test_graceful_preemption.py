@@ -1,0 +1,220 @@
+"""Graceful preemption end to end (preemption.py, control half).
+
+Three layers:
+
+1. scheduler gang-over-gang preemption with a checkpoint-opted victim:
+   the victim is SIGNALED (keeps its chips while checkpointing), the
+   preemptor binds only after the round completes, and the victim's
+   PodGroup is Requeued with its recorded resume step;
+2. the full two-tenant storm (signal → checkpoint → elastic shrink →
+   regrow → converge, with the mid-checkpoint member crash) via the
+   shared harness — one scenario, no drifting copies;
+3. a REAL LM gang (workloads/lm.py on the CPU mesh): signal → Orbax
+   save + atomic marker → requeue → resume, asserting the resumed
+   incarnation starts past step 0 and re-runs fewer steps than a
+   restart from scratch — the goodput argument in miniature.
+"""
+import asyncio
+import os
+
+import pytest
+
+from kubernetes_tpu import preemption as gp
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.apiserver.admission import default_chain
+from kubernetes_tpu.apiserver.registry import Registry
+from kubernetes_tpu.client.local import LocalClient
+from kubernetes_tpu.scheduler.scheduler import Scheduler
+from kubernetes_tpu.util.features import GATES
+
+from .test_gang_preemption import _slice_nodes, wait_gang_bound
+
+
+@pytest.fixture
+def gate():
+    GATES.set("GracefulPreemption", True)
+    yield
+    GATES.set("GracefulPreemption", False)
+
+
+async def make_cluster(nodes):
+    reg = Registry()
+    reg.admission = default_chain(reg)
+    reg.create(t.Namespace(metadata=ObjectMeta(name="default")))
+    client = LocalClient(reg)
+    for n in nodes:
+        reg.create(n)
+    sched = Scheduler(client, backoff_seconds=0.2)
+    await sched.start()
+    return reg, client, sched
+
+
+def gang_objects(reg, gname, n_members, chips_each, shape, priority=0,
+                 grace=None):
+    from .test_scheduler import mk_pod
+    group = t.PodGroup(
+        metadata=ObjectMeta(name=gname, namespace="default"),
+        spec=t.PodGroupSpec(min_member=n_members, slice_shape=shape))
+    if grace is not None:
+        group.spec.checkpoint = t.CheckpointSpec(grace_seconds=grace)
+    reg.create(group)
+    for m in range(n_members):
+        pod = mk_pod(f"{gname}-{m}", cpu=0.1, chips=chips_each,
+                     gang=gname, priority=priority)
+        reg.create(pod)
+
+
+async def test_scheduler_preemption_signals_opted_victim(gate):
+    """A high-priority gang carves the box of a checkpoint-opted
+    victim: the victim checkpoints first (chips held meanwhile), the
+    preemptor binds after the round, the victim is Requeued with its
+    resume step."""
+    reg, client, sched = await make_cluster(_slice_nodes())
+    try:
+        gang_objects(reg, "low", 4, 2, [2, 2, 2], priority=0, grace=8.0)
+        assert len(await wait_gang_bound(reg, "low", 4)) == 4
+
+        # The simulated workload: reports a checkpoint for every
+        # signaled member the moment the signal lands.
+        async def workload():
+            while True:
+                g = reg.get("podgroups", "default", "low")
+                st = g.status.preemption
+                if st is not None and st.phase in (
+                        t.PREEMPT_SIGNALED, t.PREEMPT_CHECKPOINTING):
+                    for member in st.signaled:
+                        if member not in st.checkpointed:
+                            await gp.record_member_checkpoint(
+                                client, "default", "low", member, 123)
+                await asyncio.sleep(0.02)
+
+        reporter = asyncio.create_task(workload())
+        try:
+            gang_objects(reg, "high", 4, 2, [2, 2, 2], priority=1000)
+            high = await wait_gang_bound(reg, "high", 4, timeout=15)
+            assert len(high) == 4, "preemptor never bound"
+        finally:
+            reporter.cancel()
+        st = reg.get("podgroups", "default", "low").status.preemption
+        assert st is not None and st.phase == t.PREEMPT_REQUEUED
+        assert st.outcome == "checkpointed"
+        assert st.checkpoint_step == 123
+        pods, _ = reg.list("pods", "default")
+        low_alive = [p for p in pods if p.spec.gang == "low"
+                     and t.is_pod_active(p)]
+        assert not low_alive, "victims must be gone after the round"
+    finally:
+        await sched.stop()
+
+
+async def test_gate_off_is_legacy_hard_evict():
+    """Gate off: a checkpoint-opted victim is evicted exactly like
+    before — no preemption state ever appears."""
+    reg, client, sched = await make_cluster(_slice_nodes())
+    try:
+        gang_objects(reg, "low", 4, 2, [2, 2, 2], priority=0, grace=8.0)
+        assert len(await wait_gang_bound(reg, "low", 4)) == 4
+        gang_objects(reg, "high", 4, 2, [2, 2, 2], priority=1000)
+        assert len(await wait_gang_bound(reg, "high", 4, timeout=12)) == 4
+        assert reg.get("podgroups", "default",
+                       "low").status.preemption is None
+    finally:
+        await sched.stop()
+
+
+async def test_preempt_storm_smoke():
+    """The shared storm scenario (shrink, regrow, mid-checkpoint
+    crash) — the same run hack/preempt_smoke.sh gates on."""
+    from kubernetes_tpu.queueing.harness import run_preempt_smoke
+    out = await run_preempt_smoke(seed=3, timeout=30.0)
+    assert out["a_bound"] >= 16 and out["a_replicas"] == 16
+    assert out["shrink_outcome"] == "checkpointed"
+    assert out["crash_kills"] == 1
+
+
+@pytest.mark.slow
+async def test_lm_gang_signal_checkpoint_requeue_resume(tmp_path, gate,
+                                                        monkeypatch):
+    """Satellite: a REAL LM training job through the whole protocol.
+    The train loop polls checkpoint.preempt_requested(); the signal
+    file appears mid-run; it saves, publishes the marker, and exits;
+    the round requeues the gang with the step; the next incarnation
+    resumes past 0 and re-runs strictly fewer steps than a restart
+    from scratch would."""
+    import jax
+
+    from kubernetes_tpu.workloads import lm
+    from kubernetes_tpu.workloads.sharding import make_mesh
+
+    preempt_file = str(tmp_path / "preempt-signal")
+    monkeypatch.setenv("KTPU_PREEMPT_FILE", preempt_file)
+    ckpt_dir = str(tmp_path / "ckpt" / "default" / "lmgang")
+    # attn_impl="flash" (reference attention off-TPU): the ring
+    # attention shard_map path trips a pre-existing jax-0.4.37 scan
+    # replication bug on this host (fails at the seed commit too).
+    cfg = lm.LMConfig(vocab=64, d_model=32, n_layers=2, n_heads=2,
+                      d_ff=64, attn_impl="flash")
+    mesh = make_mesh(jax.devices()[:1])
+
+    reg = Registry()
+    reg.admission = default_chain(reg)
+    reg.create(t.Namespace(metadata=ObjectMeta(name="default")))
+    client = LocalClient(reg)
+    group = t.PodGroup(
+        metadata=ObjectMeta(name="lmgang", namespace="default"),
+        spec=t.PodGroupSpec(min_member=1, checkpoint=t.CheckpointSpec(
+            grace_seconds=30.0)))
+    reg.create(group)
+    pod = t.Pod(metadata=ObjectMeta(name="lmgang-0", namespace="default"),
+                spec=t.PodSpec(containers=[t.Container(name="c",
+                                                       image="i")]))
+    pod.spec.gang = "lmgang"
+    pod.spec.node_name = "n0"
+    reg.create(pod)
+    pod = reg.get("pods", "default", "lmgang-0")
+
+    total_steps = 30
+    assert await gp.signal_gang(client, group, [pod], reason="test")
+
+    def run_training():
+        return lm.train(cfg, mesh, steps=total_steps, batch=2, seq=8,
+                        ckpt_dir=ckpt_dir, checkpoint_every=0)
+
+    async def deliver_signal_after(delay):
+        await asyncio.sleep(delay)
+        with open(preempt_file, "w") as f:
+            f.write("1")
+
+    delivery = asyncio.create_task(deliver_signal_after(1.0))
+    first = await asyncio.to_thread(run_training)
+    await delivery
+    assert first["preempted"], "signal never interrupted the run"
+    saved_step = first["final_step"] - 1
+    assert 0 <= saved_step < total_steps - 1, saved_step
+
+    # The node-agent half: read the atomic marker, report the step.
+    step = gp.read_marker(ckpt_dir)
+    assert step == saved_step
+    assert await gp.record_member_checkpoint(client, "default", "lmgang",
+                                             "lmgang-0", step)
+
+    def requeued():
+        st = reg.get("podgroups", "default", "lmgang").status.preemption
+        return st.phase == t.PREEMPT_REQUEUED
+    deadline = asyncio.get_running_loop().time() + 10.0
+    while not requeued():
+        assert asyncio.get_running_loop().time() < deadline
+        await asyncio.sleep(0.05)
+    st = reg.get("podgroups", "default", "lmgang").status.preemption
+    assert st.outcome == "checkpointed" and st.checkpoint_step == step
+
+    # "Requeue → resume": the next incarnation picks up from the
+    # recorded step, not from scratch.
+    os.remove(preempt_file)
+    second = await asyncio.to_thread(run_training)
+    assert not second["preempted"]
+    assert second["resumed_from"] == saved_step + 1 > 0
+    rerun = total_steps - second["resumed_from"]
+    assert rerun < total_steps, \
+        "resume must re-run fewer steps than restart-from-scratch"

@@ -1,0 +1,264 @@
+"""Graceful preemption, node half (preemption.py protocol + agent).
+
+The agent's side of signal → checkpoint → requeue with a REAL process
+runtime: the engine's pod annotation makes the agent create the
+``KTPU_PREEMPT_FILE`` (the workload's poll target), the workload's
+atomic checkpoint-complete marker is read back and reported into
+``PodGroup.status.preemption``, and graceful deletion waits for the
+marker bounded by the pod's own grace budget.
+
+Also the evict-grace satellite: node-pressure eviction honors
+``terminationGracePeriodSeconds`` (it was hardcoded to ~1s — a slow
+preStop hook was silently truncated on exactly the kill path that
+most needs it).
+"""
+import asyncio
+import json
+import os
+import time
+
+import pytest
+
+from kubernetes_tpu import preemption as gp
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.apiserver.admission import default_chain
+from kubernetes_tpu.apiserver.registry import Registry
+from kubernetes_tpu.client.local import LocalClient
+from kubernetes_tpu.node.agent import NodeAgent
+from kubernetes_tpu.node.runtime import ProcessRuntime
+from kubernetes_tpu.util.features import GATES
+
+
+@pytest.fixture
+def gate():
+    GATES.set("GracefulPreemption", True)
+    yield
+    GATES.set("GracefulPreemption", False)
+
+
+async def make_agent(tmp_path):
+    reg = Registry()
+    reg.admission = default_chain(reg)
+    reg.create(t.Namespace(metadata=ObjectMeta(name="default")))
+    client = LocalClient(reg)
+    agent = NodeAgent(client, "n0", ProcessRuntime(str(tmp_path / "rt")),
+                      status_interval=5, heartbeat_interval=5,
+                      pleg_interval=0.1, server_port=None)
+    await agent.start()
+    return reg, client, agent
+
+
+def gang_pod(name, gang="g1", command=None, grace=None):
+    # Trap SIGTERM like a real checkpoint-aware workload: the "both"
+    # signal mode delivers it as the checkpoint REQUEST; a workload
+    # that just dies takes the (also correct) all-members-dead fast
+    # path instead of checkpointing.
+    c = t.Container(name="main", image="x",
+                    command=command or ["sh", "-c",
+                                        'trap "" TERM; sleep 30'])
+    pod = t.Pod(metadata=ObjectMeta(name=name, namespace="default"),
+                spec=t.PodSpec(restart_policy="Never", containers=[c]))
+    pod.spec.gang = gang
+    pod.spec.node_name = "n0"
+    if grace is not None:
+        pod.spec.termination_grace_period_seconds = grace
+    return pod
+
+
+async def wait_for(fn, timeout=8.0, interval=0.05):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while asyncio.get_running_loop().time() < deadline:
+        result = fn()
+        if result:
+            return result
+        await asyncio.sleep(interval)
+    return fn()
+
+
+async def wait_running(client, name, ticks=120):
+    for _ in range(ticks):
+        await asyncio.sleep(0.05)
+        got = await client.get("pods", "default", name)
+        if got.status.phase == t.POD_RUNNING:
+            return got
+    raise AssertionError(f"{name} never reached Running")
+
+
+async def test_agent_delivers_signal_and_reports_marker(tmp_path, gate,
+                                                        monkeypatch):
+    """End-to-end node half: engine signals → agent creates the
+    preempt file → (simulated) workload writes the marker → agent
+    reports the step into the PodGroup."""
+    monkeypatch.setenv("KTPU_CHECKPOINT_DIR", str(tmp_path / "ckpt"))
+    reg, client, agent = await make_agent(tmp_path)
+    try:
+        group = t.PodGroup(
+            metadata=ObjectMeta(name="g1", namespace="default"),
+            spec=t.PodGroupSpec(
+                min_member=1,
+                checkpoint=t.CheckpointSpec(grace_seconds=8.0)))
+        reg.create(group)
+        await client.create(gang_pod("g1-0"))
+        await wait_running(client, "g1-0")
+        pod = await client.get("pods", "default", "g1-0")
+        preempt_file = agent._preempt_file_path(pod.metadata.uid)
+        assert agent._ckpt_dirs[pod.key()] == \
+            gp.job_checkpoint_dir("default/g1")
+        assert not os.path.exists(preempt_file)
+
+        ok = await gp.signal_gang(client, group, [pod], reason="test")
+        assert ok
+        # The agent sees the annotation and creates the signal file.
+        await wait_for(lambda: os.path.exists(preempt_file))
+        assert os.path.exists(preempt_file), \
+            "agent never delivered the file signal"
+
+        # The workload checkpoints and publishes the atomic marker
+        # (write time included — the agent rejects stale markers).
+        ckpt_dir = gp.job_checkpoint_dir("default/g1")
+        os.makedirs(ckpt_dir, exist_ok=True)
+        tmp = gp.marker_path(ckpt_dir) + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"step": 17, "time": time.time()}, f)
+        os.replace(tmp, gp.marker_path(ckpt_dir))
+
+        def reported():
+            st = reg.get("podgroups", "default", "g1").status.preemption
+            return st is not None and st.checkpoint_step == 17
+        await wait_for(reported)
+        st = reg.get("podgroups", "default", "g1").status.preemption
+        assert st.checkpoint_step == 17, st
+        assert "g1-0" in st.checkpointed
+
+        def requeued():
+            st = reg.get("podgroups", "default", "g1").status.preemption
+            return st.phase == t.PREEMPT_REQUEUED
+        await wait_for(requeued)
+        assert reg.get("podgroups", "default",
+                       "g1").status.preemption.outcome == "checkpointed"
+    finally:
+        await agent.stop()
+
+
+async def test_stale_marker_from_earlier_round_is_rejected(tmp_path, gate,
+                                                           monkeypatch):
+    """A leftover marker from a previous round (the shared job dir is
+    never cleared by shrink survivors) must NOT pass for a fresh
+    checkpoint: the round times out to 'deadline' instead of evicting
+    members with unsaved progress while claiming success."""
+    monkeypatch.setenv("KTPU_CHECKPOINT_DIR", str(tmp_path / "ckpt"))
+    reg, client, agent = await make_agent(tmp_path)
+    try:
+        ckpt_dir = gp.job_checkpoint_dir("default/g1")
+        os.makedirs(ckpt_dir, exist_ok=True)
+        with open(gp.marker_path(ckpt_dir), "w") as f:
+            json.dump({"step": 100, "time": time.time() - 3600.0}, f)
+        group = t.PodGroup(
+            metadata=ObjectMeta(name="g1", namespace="default"),
+            spec=t.PodGroupSpec(
+                min_member=1,
+                checkpoint=t.CheckpointSpec(grace_seconds=1.0)))
+        reg.create(group)
+        await client.create(gang_pod("g1-0"))
+        await wait_running(client, "g1-0")
+        pod = await client.get("pods", "default", "g1-0")
+        assert await gp.signal_gang(client, group, [pod], reason="test")
+
+        def requeued():
+            st = reg.get("podgroups", "default",
+                         "g1").status.preemption
+            return st is not None and st.phase == t.PREEMPT_REQUEUED
+        await wait_for(requeued, timeout=10.0)
+        st = reg.get("podgroups", "default", "g1").status.preemption
+        assert st.outcome == "deadline", st
+        assert st.checkpoint_step == -1, \
+            "the stale step must never become the resume point"
+    finally:
+        await agent.stop()
+
+
+async def test_graceful_delete_waits_for_marker(tmp_path, gate,
+                                                monkeypatch):
+    """The pre-stop path: a signaled pod being gracefully deleted gets
+    its grace budget for the marker; once the marker lands the stop
+    proceeds without burning the rest of the budget."""
+    monkeypatch.setenv("KTPU_CHECKPOINT_DIR", str(tmp_path / "ckpt"))
+    reg, client, agent = await make_agent(tmp_path)
+    try:
+        group = t.PodGroup(
+            metadata=ObjectMeta(name="g1", namespace="default"),
+            spec=t.PodGroupSpec(
+                min_member=1,
+                checkpoint=t.CheckpointSpec(grace_seconds=6.0)))
+        reg.create(group)
+        # Plain sleep: after the marker lands the stop's SIGTERM must
+        # end the pod promptly (a saved workload has nothing to trap).
+        await client.create(gang_pod("g1-0", grace=6,
+                                     command=["sleep", "30"]))
+        await wait_running(client, "g1-0")
+        pod = await client.get("pods", "default", "g1-0")
+        pod.metadata.annotations[t.PREEMPT_ANNOTATION] = \
+            f"{time.time() + 6.0!r};file"
+        await client.update(pod)
+
+        ckpt_dir = gp.job_checkpoint_dir("default/g1")
+
+        async def workload_saves():
+            await asyncio.sleep(0.8)
+            os.makedirs(ckpt_dir, exist_ok=True)
+            tmp = gp.marker_path(ckpt_dir) + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump({"step": 9, "time": time.time()}, f)
+            os.replace(tmp, gp.marker_path(ckpt_dir))
+
+        saver = asyncio.create_task(workload_saves())
+        t0 = time.monotonic()
+        await client.delete("pods", "default", "g1-0")
+
+        def gone():
+            try:
+                reg.get("pods", "default", "g1-0")
+                return False
+            except Exception:  # noqa: BLE001
+                return True
+        await wait_for(gone, timeout=10.0)
+        elapsed = time.monotonic() - t0
+        await saver
+        assert gone(), "pod never finished terminating"
+        assert elapsed >= 0.7, "delete did not wait for the marker"
+        assert elapsed < 5.0, "marker landed; stop must not burn " \
+                              "the whole grace budget"
+        st = reg.get("podgroups", "default", "g1").status.preemption
+        assert st is not None and st.checkpoint_step == 9
+    finally:
+        await agent.stop()
+
+
+async def test_evict_pod_honors_termination_grace(tmp_path):
+    """Satellite: node-pressure eviction respected ~1s of grace no
+    matter what the pod asked for. A slow preStop hook (2s) under a
+    4s terminationGracePeriodSeconds must now complete."""
+    reg, client, agent = await make_agent(tmp_path)
+    try:
+        marker = str(tmp_path / "pre-stop-finished")
+        c = t.Container(name="main", image="x", command=["sleep", "30"])
+        c.lifecycle = t.Lifecycle(pre_stop=t.LifecycleHandler(
+            exec_command=["sh", "-c", f"sleep 2 && touch {marker}"]))
+        pod = t.Pod(metadata=ObjectMeta(name="slow", namespace="default"),
+                    spec=t.PodSpec(restart_policy="Never",
+                                   containers=[c]))
+        pod.spec.node_name = "n0"
+        pod.spec.termination_grace_period_seconds = 4
+        await client.create(pod)
+        await wait_running(client, "slow")
+        live = await client.get("pods", "default", "slow")
+        await agent.evict_pod(live, "Evicted", "test pressure eviction")
+        assert os.path.exists(marker), \
+            "preStop was truncated: terminationGracePeriodSeconds " \
+            "not honored on the eviction kill path"
+        cur = await client.get("pods", "default", "slow")
+        assert cur.status.phase == t.POD_FAILED
+        assert cur.status.reason == "Evicted"
+    finally:
+        await agent.stop()

@@ -1,0 +1,434 @@
+"""Graceful-preemption engine + API + elastic fairshare units.
+
+The protocol pieces in isolation: gate/eligibility, validation of the
+new PodGroup fields, the signal → checkpoint → requeue round over a
+LocalClient (quorum and deadline paths), checkpoint-step monotonicity
+(engine AND tpusan invariant), elastic demand scaling, the reclaim
+planner's shrink-before-evict preference, and the CLI surfaces.
+"""
+import asyncio
+import time
+
+import pytest
+
+from kubernetes_tpu import preemption as gp
+from kubernetes_tpu.api import errors, types as t
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.api.validation import validate_podgroup
+from kubernetes_tpu.apiserver.admission import default_chain
+from kubernetes_tpu.apiserver.registry import Registry
+from kubernetes_tpu.client.local import LocalClient
+from kubernetes_tpu.queueing import fairshare as fs
+from kubernetes_tpu.util.features import GATES
+
+
+@pytest.fixture
+def gate():
+    GATES.set("GracefulPreemption", True)
+    yield
+    GATES.set("GracefulPreemption", False)
+
+
+def mk_group(name="g1", grace=2.0, elastic=None):
+    g = t.PodGroup(metadata=ObjectMeta(name=name, namespace="default"),
+                   spec=t.PodGroupSpec(min_member=2))
+    if grace is not None:
+        g.spec.checkpoint = t.CheckpointSpec(grace_seconds=grace)
+    if elastic is not None:
+        g.spec.min_replicas, g.spec.max_replicas = elastic
+        g.spec.min_member = elastic[0]
+    return g
+
+
+def mk_cluster():
+    reg = Registry()
+    reg.admission = default_chain(reg)
+    reg.create(t.Namespace(metadata=ObjectMeta(name="default")))
+    return reg, LocalClient(reg)
+
+
+def mk_member(reg, gang, i, bound=True):
+    p = t.Pod(metadata=ObjectMeta(name=f"{gang}-{i}", namespace="default"),
+              spec=t.PodSpec(containers=[t.Container(name="c", image="i")]))
+    p.spec.gang = gang
+    if bound:
+        p.spec.node_name = "n1"
+    reg.create(p)
+    return reg.get("pods", "default", f"{gang}-{i}")
+
+
+# -- gate / eligibility ------------------------------------------------------
+
+
+def test_gate_off_means_not_eligible():
+    assert not gp.enabled()
+    assert not gp.eligible(mk_group())
+
+
+def test_eligibility_requires_positive_grace(gate):
+    assert gp.eligible(mk_group(grace=2.0))
+    assert not gp.eligible(mk_group(grace=0.0))
+    assert not gp.eligible(mk_group(grace=None))
+    assert not gp.eligible(None)
+
+
+def test_elastic_target(gate):
+    g = mk_group(elastic=(2, 8))
+    assert gp.elastic_target(g) == 8          # default: max
+    g.status.replicas = 3
+    assert gp.elastic_target(g) == 3
+    GATES.set("GracefulPreemption", False)
+    assert gp.elastic_target(g) == 0          # gate off: no cap
+    GATES.set("GracefulPreemption", True)
+    assert gp.elastic_target(mk_group()) == 0  # fixed-size: no cap
+
+
+# -- validation --------------------------------------------------------------
+
+
+def test_validate_checkpoint_spec():
+    g = mk_group(grace=5.0)
+    validate_podgroup(g)
+    g.spec.checkpoint.grace_seconds = -1.0
+    with pytest.raises(errors.InvalidError):
+        validate_podgroup(g)
+    g.spec.checkpoint.grace_seconds = float("nan")
+    with pytest.raises(errors.InvalidError):
+        validate_podgroup(g)
+    g.spec.checkpoint = t.CheckpointSpec(grace_seconds=1.0, signal="bogus")
+    with pytest.raises(errors.InvalidError):
+        validate_podgroup(g)
+
+
+def test_validate_elastic_bounds():
+    validate_podgroup(mk_group(grace=None, elastic=(2, 8)))
+    g = mk_group(grace=None)
+    g.spec.min_replicas = 2  # max unset
+    with pytest.raises(errors.InvalidError):
+        validate_podgroup(g)
+    g = mk_group(grace=None, elastic=(8, 2))  # min > max
+    with pytest.raises(errors.InvalidError):
+        validate_podgroup(g)
+    g = mk_group(grace=None, elastic=(2, 8))
+    g.spec.min_member = 4  # quorum above the shrunken size
+    with pytest.raises(errors.InvalidError):
+        validate_podgroup(g)
+
+
+# -- the protocol round ------------------------------------------------------
+
+
+async def _wait(pred, timeout=5.0):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while not pred():
+        assert asyncio.get_running_loop().time() < deadline, "timeout"
+        await asyncio.sleep(0.02)
+
+
+async def test_round_checkpointed_path(gate):
+    reg, client = mk_cluster()
+    g = mk_group(grace=5.0)
+    reg.create(g)
+    pods = [mk_member(reg, "g1", i) for i in range(2)]
+    assert await gp.signal_gang(client, g, pods, reason="test")
+    cur = reg.get("podgroups", "default", "g1")
+    st = cur.status.preemption
+    assert st.phase == t.PREEMPT_SIGNALED
+    assert sorted(st.signaled) == ["g1-0", "g1-1"]
+    for p in pods:  # members were annotated with deadline;mode
+        fresh = reg.get("pods", "default", p.metadata.name)
+        raw = fresh.metadata.annotations[t.PREEMPT_ANNOTATION]
+        deadline, _, mode = raw.partition(";")
+        assert float(deadline) > time.time()
+        assert mode == t.PREEMPT_SIGNAL_BOTH
+    t0 = time.perf_counter()
+    assert await gp.record_member_checkpoint(client, "default", "g1",
+                                             "g1-0", 10)
+    assert await gp.record_member_checkpoint(client, "default", "g1",
+                                             "g1-1", 11)
+
+    def requeued():
+        return (reg.get("podgroups", "default", "g1")
+                .status.preemption.phase == t.PREEMPT_REQUEUED)
+    await _wait(requeued)
+    assert time.perf_counter() - t0 < 3.0, "quorum should beat the grace"
+    st = reg.get("podgroups", "default", "g1").status.preemption
+    assert st.outcome == "checkpointed"
+    assert st.checkpoint_step == 11
+    assert st.rounds == 1
+    pods_now, _ = reg.list("pods", "default")
+    assert all(not t.is_pod_active(p) for p in pods_now)
+
+
+async def test_round_deadline_path_degrades_to_kill(gate):
+    reg, client = mk_cluster()
+    g = mk_group(grace=0.3)
+    reg.create(g)
+    pods = [mk_member(reg, "g1", i) for i in range(2)]
+    assert await gp.signal_gang(client, g, pods, reason="test", wait=True)
+    st = reg.get("podgroups", "default", "g1").status.preemption
+    assert st.phase == t.PREEMPT_REQUEUED
+    assert st.outcome == "deadline"
+    assert st.checkpoint_step == -1
+    pods_now, _ = reg.list("pods", "default")
+    assert all(not t.is_pod_active(p) for p in pods_now), \
+        "a wedged workload must not hold chips past its grace"
+
+
+async def test_dead_member_drops_out_of_quorum(gate):
+    """A member that dies mid-checkpoint must not force the full
+    deadline wait: the quorum is the LIVE signaled members."""
+    reg, client = mk_cluster()
+    g = mk_group(grace=30.0)
+    reg.create(g)
+    pods = [mk_member(reg, "g1", i) for i in range(3)]
+    assert await gp.signal_gang(client, g, pods, reason="test")
+    reg.delete("pods", "default", "g1-2", grace_period_seconds=0)
+    await gp.record_member_checkpoint(client, "default", "g1", "g1-0", 5)
+    await gp.record_member_checkpoint(client, "default", "g1", "g1-1", 5)
+
+    def requeued():
+        return (reg.get("podgroups", "default", "g1")
+                .status.preemption.phase == t.PREEMPT_REQUEUED)
+    await _wait(requeued, timeout=5.0)  # << the 30s grace
+    st = reg.get("podgroups", "default", "g1").status.preemption
+    assert st.outcome == "checkpointed"
+    assert sorted(st.checkpointed) == ["g1-0", "g1-1"]
+
+
+async def test_checkpoint_step_never_rewinds(gate):
+    reg, client = mk_cluster()
+    g = mk_group(grace=5.0)
+    reg.create(g)
+    pods = [mk_member(reg, "g1", i) for i in range(2)]
+    await gp.signal_gang(client, g, pods, reason="test")
+    await gp.record_member_checkpoint(client, "default", "g1", "g1-0", 40)
+    # A stale/torn marker replay must not rewind the resume point.
+    await gp.record_member_checkpoint(client, "default", "g1", "g1-1", 3)
+    st = reg.get("podgroups", "default", "g1").status.preemption
+    assert st.checkpoint_step == 40
+    assert sorted(st.checkpointed) == ["g1-0", "g1-1"]
+
+
+async def test_signal_not_eligible_returns_false():
+    reg, client = mk_cluster()
+    g = mk_group(grace=None)
+    reg.create(g)
+    pods = [mk_member(reg, "g1", i) for i in range(2)]
+    assert not await gp.signal_gang(client, g, pods, reason="test")
+    # Caller falls back to the legacy kill: nothing was stamped.
+    assert reg.get("podgroups", "default", "g1").status.preemption is None
+
+
+async def test_preempt_victims_splits_graceful_and_legacy(gate):
+    reg, client = mk_cluster()
+    opted = mk_group("opted", grace=5.0)
+    legacy = mk_group("legacy", grace=None)
+    reg.create(opted)
+    reg.create(legacy)
+    vs = ([mk_member(reg, "opted", i) for i in range(2)]
+          + [mk_member(reg, "legacy", i) for i in range(2)])
+    loose = t.Pod(metadata=ObjectMeta(name="loose", namespace="default"),
+                  spec=t.PodSpec(node_name="n1", containers=[
+                      t.Container(name="c", image="i")]))
+    reg.create(loose)
+    vs.append(reg.get("pods", "default", "loose"))
+    remainder = await gp.preempt_victims(client, vs, reason="test")
+    names = sorted(p.metadata.name for p in remainder)
+    assert names == ["legacy-0", "legacy-1", "loose"]
+    st = reg.get("podgroups", "default", "opted").status.preemption
+    assert st is not None and st.phase in (t.PREEMPT_SIGNALED,
+                                           t.PREEMPT_CHECKPOINTING,
+                                           t.PREEMPT_REQUEUED)
+
+
+async def test_widening_round_covers_new_members(gate):
+    """A full reclaim landing while a shrink round is mid-flight must
+    WIDEN the round to the survivors — a no-op would leave them to a
+    later hard kill with no signal (review finding)."""
+    reg, client = mk_cluster()
+    g = mk_group(grace=10.0)
+    reg.create(g)
+    pods = [mk_member(reg, "g1", i) for i in range(4)]
+    # Round 1: surplus members only (the shrink).
+    assert await gp.signal_gang(client, g, pods[2:], reason="shrink")
+    await gp.record_member_checkpoint(client, "default", "g1", "g1-2", 7)
+    # Round widens: reclaim signals ALL bound members mid-flight.
+    assert await gp.signal_gang(client, g, pods, reason="reclaim")
+    st = reg.get("podgroups", "default", "g1").status.preemption
+    assert sorted(st.signaled) == ["g1-0", "g1-1", "g1-2", "g1-3"]
+    assert st.checkpointed == ["g1-2"], "reported members must survive"
+    for i in (0, 1, 3):
+        await gp.record_member_checkpoint(client, "default", "g1",
+                                          f"g1-{i}", 7)
+
+    def requeued():
+        return (reg.get("podgroups", "default", "g1")
+                .status.preemption.phase == t.PREEMPT_REQUEUED)
+    await _wait(requeued)
+    st = reg.get("podgroups", "default", "g1").status.preemption
+    assert st.outcome == "checkpointed" and len(st.checkpointed) == 4
+    pods_now, _ = reg.list("pods", "default")
+    assert all(not t.is_pod_active(p) for p in pods_now)
+
+
+def test_read_marker_info_freshness(tmp_path):
+    """Marker carries its write time so a stale round's leftover can
+    be rejected (review finding: the job checkpoint dir is shared and
+    shrink survivors never restart to clear it)."""
+    import json
+    import os
+    d = str(tmp_path)
+    with open(os.path.join(d, gp.MARKER_NAME), "w") as f:
+        json.dump({"step": 100, "time": 1000.0}, f)
+    assert gp.read_marker_info(d) == (100, 1000.0)
+    assert gp.read_marker(d) == 100
+    # Step 0 is a REAL checkpoint, not "absent".
+    with open(os.path.join(d, gp.MARKER_NAME), "w") as f:
+        json.dump({"step": 0, "time": 2000.0}, f)
+    assert gp.read_marker_info(d) == (0, 2000.0)
+
+
+def test_checkpoint_monotonic_sees_step_zero():
+    """Invariant indexing must not coerce step 0 to -1 (review
+    finding): a rewind FROM step 0 is exactly the torn-marker class."""
+    from kubernetes_tpu.analysis import invariants as inv
+    from kubernetes_tpu.storage.mvcc import MVCCStore
+    reg_inv = inv.arm(inv.InvariantRegistry())
+    try:
+        store = MVCCStore()
+        key = "/registry/podgroups/default/g0"
+
+        def gv(step):
+            return {"api_version": "core/v1", "kind": "PodGroup",
+                    "metadata": {"name": "g0", "namespace": "default"},
+                    "spec": {"min_member": 1},
+                    "status": {"preemption": {"phase": "Checkpointing",
+                                              "checkpoint_step": step}}}
+        store.create(key, gv(0))
+        cur = store.get(key)
+        store.update(key, gv(-1), cur.mod_revision)  # rewind from 0
+        assert any(v.invariant == inv.CHECKPOINT_MONOTONIC
+                   for v in reg_inv.violations), reg_inv.report()
+    finally:
+        inv.disarm()
+
+
+# -- elastic demand + reclaim planning --------------------------------------
+
+
+def test_group_demand_scales_with_elastic_target(gate):
+    from kubernetes_tpu.controllers.queue import group_demand
+    g = mk_group(grace=None, elastic=(2, 8))
+    g.spec.slice_shape = [2, 2, 2]  # 8 chips at full size
+    assert group_demand(g)[t.RESOURCE_TPU] == 8.0
+    g.status.replicas = 4
+    assert group_demand(g)[t.RESOURCE_TPU] == 4.0
+    assert group_demand(g, replicas=2)[t.RESOURCE_TPU] == 2.0
+    GATES.set("GracefulPreemption", False)
+    assert group_demand(g)[t.RESOURCE_TPU] == 8.0  # gate off: full
+
+
+def _queues():
+    qa = fs.QueueState(name="a", cohort="m",
+                       nominal={t.RESOURCE_TPU: 32.0})
+    qb = fs.QueueState(name="b", cohort="m",
+                       nominal={t.RESOURCE_TPU: 32.0})
+    return qa, qb
+
+
+def test_plan_reclaim_prefers_shrink_over_evict():
+    qa, qb = _queues()
+    # A borrows the whole cohort: one elastic gang (64, shrinkable to
+    # 32) — the shrink alone covers B's demand; nobody is evicted.
+    w = fs.Workload(key="d/ela", queue="a",
+                    demand={t.RESOURCE_TPU: 64.0},
+                    min_demand={t.RESOURCE_TPU: 32.0}, admitted_at=1.0)
+    fs.charge(qa, w.demand)
+    plan = fs.plan_reclaim(qb, {t.RESOURCE_TPU: 32.0}, [qa, qb], [w])
+    assert plan == [(w, fs.RECLAIM_SHRINK)]
+
+
+def test_plan_reclaim_shrinks_then_evicts_when_short():
+    qa, qb = _queues()
+    w = fs.Workload(key="d/ela", queue="a",
+                    demand={t.RESOURCE_TPU: 64.0},
+                    min_demand={t.RESOURCE_TPU: 48.0}, admitted_at=1.0)
+    fs.charge(qa, w.demand)
+    plan = fs.plan_reclaim(qb, {t.RESOURCE_TPU: 32.0}, [qa, qb], [w])
+    # Shrink frees 16, not enough — the residual 48 goes too.
+    assert plan == [(w, fs.RECLAIM_SHRINK), (w, fs.RECLAIM_EVICT)]
+
+
+def test_pick_reclaim_victims_unchanged_without_elastic():
+    qa, qb = _queues()
+    w1 = fs.Workload(key="d/g1", queue="a",
+                     demand={t.RESOURCE_TPU: 32.0}, admitted_at=1.0)
+    w2 = fs.Workload(key="d/g2", queue="a",
+                     demand={t.RESOURCE_TPU: 32.0}, admitted_at=2.0)
+    for w in (w1, w2):
+        fs.charge(qa, w.demand)
+    victims = fs.pick_reclaim_victims(qb, {t.RESOURCE_TPU: 32.0},
+                                      [qa, qb], [w1, w2])
+    assert victims == [w2]  # LIFO among equals, exactly as before
+
+
+# -- tpusan invariant --------------------------------------------------------
+
+
+def test_checkpoint_monotonic_invariant_catches_rewind():
+    from kubernetes_tpu.analysis import invariants as inv
+    from kubernetes_tpu.storage.mvcc import MVCCStore
+    reg_inv = inv.arm(inv.InvariantRegistry())
+    try:
+        store = MVCCStore()
+        key = "/registry/podgroups/default/g1"
+
+        def group_value(step):
+            return {"api_version": "core/v1", "kind": "PodGroup",
+                    "metadata": {"name": "g1", "namespace": "default"},
+                    "spec": {"min_member": 2},
+                    "status": {"preemption": {"phase": "Checkpointing",
+                                              "checkpoint_step": step}}}
+        store.create(key, group_value(10))
+        cur = store.get(key)
+        store.update(key, group_value(20), cur.mod_revision)
+        assert not reg_inv.violations
+        cur = store.get(key)
+        store.update(key, group_value(5), cur.mod_revision)  # the bug
+        assert any(v.invariant == inv.CHECKPOINT_MONOTONIC
+                   for v in reg_inv.violations), reg_inv.report()
+    finally:
+        inv.disarm()
+
+
+# -- CLI surfaces ------------------------------------------------------------
+
+
+def test_describe_podgroup_shows_preemption_and_elastic(gate):
+    from kubernetes_tpu.cli import printers
+    g = mk_group(grace=5.0, elastic=(2, 8))
+    g.status.replicas = 4
+    g.status.preemption = t.PreemptionStatus(
+        phase=t.PREEMPT_REQUEUED, signaled=["g1-0", "g1-1"],
+        checkpointed=["g1-0"], checkpoint_step=42, outcome="checkpointed",
+        rounds=1)
+    out = printers.describe(g)
+    assert "4/2..8" in out
+    assert "Last checkpoint step: 42" in out
+    assert "phase=Requeued" in out
+    assert "1/2 members checkpointed" in out
+    table = printers.print_objects("podgroups", [g], wide=True)
+    assert "CKPT-STEP" in table and "42" in table
+
+
+def test_clusterqueues_table_has_reclaiming_column():
+    from kubernetes_tpu.api.queueing import ClusterQueue, ClusterQueueSpec
+    from kubernetes_tpu.cli import printers
+    cq = ClusterQueue(metadata=ObjectMeta(name="team-a"),
+                      spec=ClusterQueueSpec(
+                          nominal_quota={t.RESOURCE_TPU: 32.0}))
+    cq.status.reclaiming = 3
+    out = printers.print_objects("clusterqueues", [cq])
+    assert "RECLAIMING" in out
+    assert " 3 " in out or out.rstrip().endswith("3")

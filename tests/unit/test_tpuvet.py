@@ -168,6 +168,26 @@ F = Counter("encode_cache_hits_total", "re-registered: silently inert")
     assert len(got) == 1 and "already registered" in got[0].message
 
 
+def test_metric_name_preemption_and_goodput_family():
+    """The graceful-preemption metric family (preemption_*, the
+    goodput gauge) are valid names, and a duplicate registration
+    within the family is still caught."""
+    good = """
+from kubernetes_tpu.metrics.registry import Counter, Gauge, Histogram
+A = Histogram("preemption_checkpoint_wait_seconds", "x")
+B = Counter("preemption_signaled_total", "x", labels=("reason",))
+C = Counter("preemption_rounds_total", "x", labels=("outcome",))
+D = Counter("preemption_shrinks_total", "x")
+E = Gauge("preemption_goodput_ratio", "x", labels=("mode",))
+"""
+    assert run_source(good, checks=["metric-name"]) == []
+    bad = good + """
+F = Counter("preemption_rounds_total", "re-registered: silently inert")
+"""
+    got = run_source(bad, checks=["metric-name"])
+    assert len(got) == 1 and "already registered" in got[0].message
+
+
 def test_metric_name_retry_and_chaos_families():
     """The client retry/backoff and chaos-injection metric families
     (client_retry_total, client_backoff_seconds,
