@@ -1,0 +1,35 @@
+#!/usr/bin/env bash
+# Control-plane HA gate (<90s): 3 apiserver replicas over quorum WAL
+# replication (storage/replication.py), gang waves through a
+# multi-endpoint failover client, the LEADER CRASHED mid-wave — the
+# scenario (chaos/ha_harness.py, seeded transport + replication
+# faults) must converge: a new leader elected, every gang member
+# bound, ZERO acknowledged writes lost, all surviving replicas'
+# stores byte-identical, and each survivor's WAL replay byte-identical
+# to its live store. Reports time-to-new-leader and the
+# write-unavailability window a continuous writer observed.
+# Siblings: hack/chaos.sh (single-plane fault arm), hack/race.sh
+# stage 5 (this scenario under explored interleavings with the
+# election-safety + committed-never-lost invariants armed),
+# hack/test.sh (runs all).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+SEED="${TPU_CHAOS:-20260804}"
+
+timeout -k 10 90 env JAX_PLATFORMS=cpu TPU_CHAOS= python - "$SEED" <<'EOF'
+import asyncio, json, sys
+from kubernetes_tpu.chaos.ha_harness import run_ha_smoke
+
+report = asyncio.run(run_ha_smoke(int(sys.argv[1])))
+print(json.dumps(report))
+if report["acked_lost"]:
+    sys.exit(f"ha: {report['acked_lost']} acknowledged writes lost")
+if not report["replicas_identical"] or not report["replay_identical"]:
+    sys.exit("ha: replica stores diverged")
+if report["new_leader"] == report["killed"]:
+    sys.exit("ha: no real failover happened")
+if not report["faults"].get("repl:drop"):
+    sys.exit("ha: no replication-message fault fired")
+EOF
+echo "ha_smoke: ok (seed ${SEED}; kill-the-leader converged)"

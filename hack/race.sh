@@ -13,6 +13,10 @@
 #      TPU_CACHE_MUTATION_DETECTOR=1 armed underneath.
 #   3. tpusan over the two-tenant queue smoke — the fair-share
 #      admission/reclaim path under explored schedules.
+#   4. tpusan over the graceful-preemption storm.
+#   5. tpusan over the kill-the-leader HA scenario — quorum WAL
+#      replication with the election-safety and committed-never-lost
+#      invariants checked live.
 #
 # Replay a failure: the report names (chaos seed, tpusan seed) — run
 # the same scenario under that exact pair, or TPU_SAN=<seed> pytest a
@@ -25,14 +29,14 @@ cd "$(dirname "$0")/.."
 
 SEED="${TPU_SAN:-20260804}"
 
-echo "=== 1/4 tpuvet: static analysis tree-clean ==="
+echo "=== 1/5 tpuvet: static analysis tree-clean ==="
 python -m kubernetes_tpu.analysis kubernetes_tpu
 
-echo "=== 2/4 tpusan: chaos convergence x8 schedules (lockdep + mutation detector armed) ==="
+echo "=== 2/5 tpusan: chaos convergence x8 schedules (lockdep + mutation detector armed) ==="
 timeout -k 10 110 env JAX_PLATFORMS=cpu TPU_SAN= TPU_CHAOS= \
     TPU_LOCKDEP=1 TPU_CACHE_MUTATION_DETECTOR=1 python - "$SEED" <<'EOF'
 import json, sys
-from kubernetes_tpu.analysis.invariants import INVARIANTS
+from kubernetes_tpu.analysis.invariants import CORE_INVARIANTS
 from kubernetes_tpu.chaos.harness import run_chaos_schedules
 
 # Any non-empty string is a valid tpusan seed (the replay workflow
@@ -46,12 +50,14 @@ print(json.dumps({k: v for k, v in rep.items() if k != "schedules"}))
 if rep["distinct_fingerprints"] < 8:
     sys.exit(f"tpusan: only {rep['distinct_fingerprints']} distinct "
              f"schedules explored, want 8")
-idle = [n for n in INVARIANTS if not rep["invariant_checks"].get(n)]
+# Core invariants only: the replication pair is exercised by the HA
+# stage below (no replicated plane runs in this scenario).
+idle = [n for n in CORE_INVARIANTS if not rep["invariant_checks"].get(n)]
 if idle:
     sys.exit(f"tpusan: invariants never exercised: {idle}")
 EOF
 
-echo "=== 3/4 tpusan: queue smoke x2 schedules ==="
+echo "=== 3/5 tpusan: queue smoke x2 schedules ==="
 timeout -k 10 90 env JAX_PLATFORMS=cpu TPU_SAN= \
     TPU_LOCKDEP=1 TPU_CACHE_MUTATION_DETECTOR=1 python - "$SEED" <<'EOF'
 import json, sys
@@ -63,7 +69,7 @@ if not all(r["reclaimed_gangs"] for r in rep["schedules"]):
     sys.exit("tpusan: reclaim did not run on every schedule")
 EOF
 
-echo "=== 4/4 tpusan: graceful-preemption storm x4 schedules ==="
+echo "=== 4/5 tpusan: graceful-preemption storm x4 schedules ==="
 # Mid-checkpoint member crash + shrink + regrow, byte-identical
 # convergence facts asserted across every explored schedule
 # (run_preempt_smoke_schedules raises on any divergence).
@@ -76,6 +82,26 @@ rep = run_preempt_smoke_schedules(sys.argv[1], schedules=4)
 print(json.dumps({k: v for k, v in rep.items() if k != "schedules"}))
 if not rep["invariant_checks"].get("checkpoint-monotonic"):
     sys.exit("tpusan: checkpoint-monotonic never exercised")
+EOF
+
+echo "=== 5/5 tpusan: kill-the-leader HA x4 schedules ==="
+# The replicated-control-plane scenario (3 replicas, leader crashed
+# mid-wave) under explored interleavings: election-safety and
+# committed-never-lost checked on every run, convergence facts
+# (pods bound, acked-lost, byte-identity verdicts) byte-identical
+# across schedules (run_ha_smoke_schedules raises on divergence).
+timeout -k 10 120 env JAX_PLATFORMS=cpu TPU_SAN= TPU_CHAOS= \
+    TPU_LOCKDEP=1 TPU_CACHE_MUTATION_DETECTOR=1 python - "$SEED" <<'EOF'
+import json, sys
+from kubernetes_tpu.chaos.ha_harness import run_ha_smoke_schedules
+
+rep = run_ha_smoke_schedules(sys.argv[1], schedules=4)
+print(json.dumps({k: v for k, v in rep.items() if k != "schedules"}))
+for inv in ("election-safety", "committed-never-lost"):
+    if not rep["invariant_checks"].get(inv):
+        sys.exit(f"tpusan: {inv} never exercised")
+if rep["facts"]["acked_lost"]:
+    sys.exit("tpusan: acknowledged writes lost under exploration")
 EOF
 
 echo "race.sh: ok (seed ${SEED}; tpuvet clean, invariants held on all schedules)"

@@ -8,10 +8,12 @@
 # hack/queue_smoke.sh (<60s two-tenant fair-share admission smoke),
 # hack/preempt_smoke.sh (<60s graceful-preemption storm: signal,
 # checkpoint, shrink, regrow, converge + the goodput gate),
-# hack/race.sh (<120s tpusan gate: chaos + queue + preempt smokes
-# under explored task-interleaving schedules with the cluster
-# invariants armed) — all run on full-suite invocations; filtered
-# runs skip them, KTPU_SMOKE=1 forces them.
+# hack/ha_smoke.sh (<90s replicated control plane: kill the leader
+# mid-wave, standby elected, zero acked writes lost, byte-identical
+# convergence), hack/race.sh (<150s tpusan gate: chaos + queue +
+# preempt + HA smokes under explored task-interleaving schedules with
+# the cluster invariants armed) — all run on full-suite invocations;
+# filtered runs skip them, KTPU_SMOKE=1 forces them.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 ./hack/verify.sh
@@ -20,6 +22,7 @@ if [ "$#" -eq 0 ] || [ "${KTPU_SMOKE:-}" = "1" ]; then
   ./hack/chaos.sh
   ./hack/queue_smoke.sh
   ./hack/preempt_smoke.sh
+  ./hack/ha_smoke.sh
   ./hack/race.sh
 fi
 exec python -m pytest tests/ -q "$@"

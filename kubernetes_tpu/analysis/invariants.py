@@ -3,7 +3,7 @@
 The sanitizer half of tpusan (:mod:`.interleave` is the schedule half):
 a registry of always-on cluster invariants evaluated at the MVCC write
 seam, so ANY interleaving the explorer produces is judged step by step
-instead of only at scenario end. The six registered invariants are the
+instead of only at scenario end. The registered invariants are the
 ones whose violations this repo has actually paid for (chaos findings,
 PR-review windows):
 
@@ -39,6 +39,14 @@ PR-review windows):
     (``status.preemption.checkpoint_step``) never decreases — a
     rewind would make the next incarnation redo or skip training
     steps (the torn-marker bug class).
+``election-safety``
+    At most one replica leads any replication term (split-brain means
+    two apiservers acking writes the other never sees); announced by
+    every ReplicaNode election win via :func:`note_leader`.
+``committed-never-lost``
+    Every quorum-committed — i.e. client-ackable — write
+    (:func:`note_commit`) is present, byte-identical at its committed
+    revision, on every CONVERGED replica of the group at final check.
 
 Violations are RECORDED (``log.error`` + ``violations`` list), not
 raised mid-write: raising inside the store would turn a sanitizer
@@ -78,9 +86,28 @@ WAL_REPLAY = "wal-replay"
 #: Evaluated on every podgroup write (trivially when no preemption
 #: state exists), so the check counter moves with ordinary traffic.
 CHECKPOINT_MONOTONIC = "checkpoint-monotonic"
+#: At most ONE replica leads any raft term (storage/replication.py
+#: announces every election win via :func:`note_leader`): two leaders
+#: in one term means split-brain — both would accept and ack writes
+#: the other never sees.
+ELECTION_SAFETY = "election-safety"
+#: Every quorum-committed (client-ackable) write is present on every
+#: CONVERGED replica at final check: committed entries announced via
+#: :func:`note_commit` must appear — key, value, and mod revision —
+#: in each caught-up replica store of the group. A committed entry
+#: missing from a converged replica is an acknowledged write the
+#: cluster lost.
+COMMITTED_NEVER_LOST = "committed-never-lost"
 
-INVARIANTS = (CHIP_DOUBLE_BOOK, QUOTA_CONSERVATION, GANG_ATOMICITY,
-              ADMISSION_MONOTONICITY, WAL_REPLAY, CHECKPOINT_MONOTONIC)
+#: Invariants only exercised when a replicated control plane runs
+#: (the HA harness / race.sh stage 5); the chaos/queueing gates assert
+#: coverage of the CORE set only.
+REPLICATION_INVARIANTS = (ELECTION_SAFETY, COMMITTED_NEVER_LOST)
+
+CORE_INVARIANTS = (CHIP_DOUBLE_BOOK, QUOTA_CONSERVATION, GANG_ATOMICITY,
+                   ADMISSION_MONOTONICITY, WAL_REPLAY, CHECKPOINT_MONOTONIC)
+
+INVARIANTS = CORE_INVARIANTS + REPLICATION_INVARIANTS
 
 #: Store revisions the cluster may advance while a gang sits partially
 #: bound before gang-atomicity fires. Revision-counted (not wall-clock)
@@ -176,6 +203,20 @@ class _StoreState:
         self.shadow_rev = 0
 
 
+class _ReplicaGroup:
+    """Replication-group bookkeeping for the two HA invariants."""
+
+    def __init__(self):
+        #: node_id -> live MVCCStore (re-registered on rebuild).
+        self.stores: dict = {}
+        #: term -> node_id that won it.
+        self.leaders: dict[int, str] = {}
+        #: key -> (rev, op, canonical value) of the LATEST committed
+        #: write per key — what every converged replica must hold.
+        self.acked: dict[str, tuple] = {}
+        self.max_acked_rev = 0
+
+
 class InvariantRegistry:
     """The armed sanitizer: attach stores, collect violations."""
 
@@ -191,6 +232,9 @@ class InvariantRegistry:
         #: (invariant, key) already reported — one violation per site,
         #: not one per write that re-observes it.
         self._reported: set = set()
+        #: Replication groups (storage/replication.py registers every
+        #: ReplicaNode's store and announces leaders/commits).
+        self._replica_groups: dict[str, _ReplicaGroup] = {}
 
     # -- wiring -----------------------------------------------------------
 
@@ -213,6 +257,98 @@ class InvariantRegistry:
         """QueueController._unadmit announces a reclaim: the next
         admitted->pending flip of ``group_key`` is legal."""
         self._reclaim_ok.add(group_key)
+
+    def reseed_store(self, store) -> None:
+        """A snapshot install (MVCCStore.reset_from_state) replaced the
+        store's contents wholesale, outside the event stream: rebuild
+        the shadow and the per-object indexes from the new state, or
+        wal-replay would flag the install itself as divergence."""
+        for st in self._stores:
+            if st.store is not store:
+                continue
+            st.chips.clear()
+            st.pod_chips.clear()
+            st.bound_by_gang.clear()
+            st.pod_gang.clear()
+            st.groups.clear()
+            st.cqs.clear()
+            st.lqs.clear()
+            st.usage.clear()
+            st.partial_since.clear()
+            st.shadow.clear()
+            for key, obj in list(store._data.items()):
+                st.shadow[key] = (_canon(obj.value), obj.mod_revision,
+                                  obj.create_revision)
+                self._index(st, key, obj.value, revision=obj.mod_revision,
+                            seeding=True)
+            st.shadow_rev = store._rev
+
+    # -- replication group seams (storage/replication.py) -----------------
+
+    def register_replica_store(self, group: str, node_id: str,
+                               store) -> None:
+        g = self._replica_groups.setdefault(group, _ReplicaGroup())
+        g.stores[node_id] = store
+
+    def note_leader(self, group: str, node_id: str, term: int) -> None:
+        """A replica won an election: election safety demands no OTHER
+        replica ever claims the same term."""
+        self.checks[ELECTION_SAFETY] += 1
+        g = self._replica_groups.setdefault(group, _ReplicaGroup())
+        prev = g.leaders.get(term)
+        if prev is not None and prev != node_id:
+            self._violate(
+                ELECTION_SAFETY, f"{group}/term-{term}", 0,
+                f"two leaders in term {term}: {prev} and {node_id} "
+                f"(split-brain — both would ack writes)")
+        else:
+            g.leaders[term] = node_id
+
+    def note_commit(self, group: str, rev: int, op: str, key: str,
+                    value) -> None:
+        """A write reached quorum (is client-ackable): record the
+        latest committed write per key for the final
+        committed-never-lost sweep."""
+        g = self._replica_groups.setdefault(group, _ReplicaGroup())
+        prev = g.acked.get(key)
+        if prev is None or rev >= prev[0]:
+            g.acked[key] = (rev, op,
+                            _canon(value) if value is not None else None)
+        g.max_acked_rev = max(g.max_acked_rev, rev)
+
+    def _check_replica_groups(self) -> None:
+        from ..storage.mvcc import DELETED
+        for group, g in self._replica_groups.items():
+            for node_id, store in g.stores.items():
+                if store.revision < g.max_acked_rev:
+                    continue  # not converged (dead/lagging): the
+                    # harness's own convergence asserts cover liveness
+                self.checks[COMMITTED_NEVER_LOST] += 1
+                live = store.state()["data"]
+                for key, (rev, op, canon) in g.acked.items():
+                    cur = live.get(key)
+                    if op == DELETED:
+                        if cur is not None and cur["mod_revision"] <= rev:
+                            self._violate(
+                                COMMITTED_NEVER_LOST, key, rev,
+                                f"replica {node_id}: committed delete at "
+                                f"rev {rev} vanished (key live at rev "
+                                f"{cur['mod_revision']})")
+                        continue
+                    if cur is None or cur["mod_revision"] < rev:
+                        self._violate(
+                            COMMITTED_NEVER_LOST, key, rev,
+                            f"replica {node_id}: committed write at rev "
+                            f"{rev} missing (have "
+                            f"{cur['mod_revision'] if cur else 'nothing'})"
+                            f" — an acknowledged write was lost")
+                    elif cur["mod_revision"] == rev \
+                            and _canon(cur["value"]) != canon:
+                        self._violate(
+                            COMMITTED_NEVER_LOST, key, rev,
+                            f"replica {node_id}: committed write at rev "
+                            f"{rev} has different content than was "
+                            f"acknowledged")
 
     # -- event dispatch ---------------------------------------------------
 
@@ -468,7 +604,9 @@ class InvariantRegistry:
 
     def check_final(self) -> None:
         """End-of-scenario checks: WAL-replay equivalence per attached
-        store + any still-partial gangs."""
+        store, any still-partial gangs, and — when replication ran —
+        committed-entry durability on every converged replica."""
+        self._check_replica_groups()
         for st in self._stores:
             self._check_partials(st, st.store.revision)
             self.checks[WAL_REPLAY] += 1
@@ -532,3 +670,28 @@ def note_reclaim(group_key: str) -> None:
     sanitizer is armed."""
     if SANITIZER is not None:
         SANITIZER.note_reclaim(group_key)
+
+
+def note_store_reset(store) -> None:
+    """Seam for MVCCStore.reset_from_state (snapshot install): rebuild
+    the attached shadow/indexes; no-op unless armed."""
+    if SANITIZER is not None:
+        SANITIZER.reseed_store(store)
+
+
+def register_replica_store(group: str, node_id: str, store) -> None:
+    """Seam for ReplicaNode construction; no-op unless armed."""
+    if SANITIZER is not None:
+        SANITIZER.register_replica_store(group, node_id, store)
+
+
+def note_leader(group: str, node_id: str, term: int) -> None:
+    """Seam for ReplicaNode._become_leader; no-op unless armed."""
+    if SANITIZER is not None:
+        SANITIZER.note_leader(group, node_id, term)
+
+
+def note_commit(group: str, rev: int, op: str, key: str, value) -> None:
+    """Seam for ReplicaNode._set_commit; no-op unless armed."""
+    if SANITIZER is not None:
+        SANITIZER.note_commit(group, rev, op, key, value)

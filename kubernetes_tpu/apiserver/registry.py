@@ -247,6 +247,11 @@ class Registry:
         from .encodecache import EncodeCache
         self.encode_cache = EncodeCache()
         self.store.add_write_hook(self.encode_cache.invalidate)
+        #: Optional storage.replication.ReplicaNode: when set, every
+        #: mutation dispatched through :meth:`run` is acknowledged only
+        #: once quorum-committed (see run()); None = unreplicated, the
+        #: byte-identical single-process path.
+        self.replica = None
         for spec in builtin_resources():
             self.add_resource(spec)
         # Durable restart: re-install custom resources already defined.
@@ -941,10 +946,28 @@ class Registry:
         purely in-memory (sub-ms CPU work — a to_thread handoff costs
         more than it buys and the GIL serializes it anyway), via a
         worker thread when a WAL append may block on disk. The single
-        policy point shared by LocalClient and the apiserver."""
+        policy point shared by LocalClient and the apiserver.
+
+        Replicated control plane (``self.replica`` set): a call that
+        wrote is acknowledged only after ITS OWN highest revision is
+        quorum-committed (per-thread capture — a concurrent neighbor's
+        in-flight write can neither be waited on nor ride this ack) —
+        the client's success response IS the durability promise the
+        committed-never-lost invariant checks. Reads (nothing written)
+        return immediately."""
+        replica = self.replica
+        if replica is None:
+            if self.store.durable:
+                return await asyncio.to_thread(fn, *args)
+            return fn(*args)
         if self.store.durable:
-            return await asyncio.to_thread(fn, *args)
-        return fn(*args)
+            out, rev = await asyncio.to_thread(
+                self.store.last_write_in, fn, *args)
+        else:
+            out, rev = self.store.last_write_in(fn, *args)
+        if rev:
+            await replica.wait_commit(rev)
+        return out
 
     # -- pods/eviction subresource ----------------------------------------
 

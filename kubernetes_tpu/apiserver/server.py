@@ -134,7 +134,8 @@ class APIServer:
         # authn -> authz -> handler -> audit -> error mapping
         # (reference: DefaultBuildHandlerChain, compressed).
         if self.tokens is not None and not request.path.startswith(
-                ("/healthz", "/readyz", "/version", "/bootstrap/v1/ca")):
+                ("/healthz", "/readyz", "/version", "/bootstrap/v1/ca",
+                 "/ha/v1/status")):
             # x509 first (reference: the authenticator union tries the
             # request cert before bearer tokens, x509.go:83): a client
             # cert that survived chain verification in the handshake
@@ -215,6 +216,17 @@ class APIServer:
                         f"({self.max_inflight}); retry")
                 self._inflight += 1
                 admitted = True
+            # Replicated control plane: a FOLLOWER serves reads and
+            # watches from its local store but never mutates — writes
+            # are redirected to the leader with a 307 + Location hint
+            # (the client follows and re-pins); a no-leader window is
+            # a 503 + Retry-After the client waits out.
+            replica = self.registry.replica
+            if replica is not None and request.method != "GET" \
+                    and not replica.is_leader:
+                resp = self._not_leader(request, replica)
+                code = resp.status
+                return resp
             if attrs is not None and self.authorizer is not None \
                     and not self.authorizer.authorize(attrs):
                 resp = self._err(errors.ForbiddenError(f"forbidden: {attrs}"))
@@ -476,6 +488,33 @@ class APIServer:
             impersonated_by=request.get("impersonated_by", ""))
 
     @staticmethod
+    def _not_leader(request: web.Request, replica) -> web.Response:
+        """The follower's answer to a write: 307 with the leader's URL
+        in Location (reference analog: apiserver proxying is not done
+        here — like etcd, the client is told where the leader is), or
+        503 + Retry-After while no leader is known. The 503 carries
+        X-Ktpu-No-Leader so clients know the server refused BEFORE
+        acting — safe to retry for every verb, mutations included."""
+        leader_url = replica.leader_hint()
+        if leader_url:
+            return web.json_response(
+                {"kind": "Status", "status": "Failure", "code": 307,
+                 "message": f"not the leader; retry at {leader_url}"},
+                status=307,
+                headers={"Location": leader_url + str(request.rel_url)})
+        e = errors.ServiceUnavailableError(
+            "no replication leader elected; retry")
+        # Retry-After sized to the election, not the generic 1s: a
+        # no-leader window normally closes within one election timeout,
+        # and a client parked for 1s would DOMINATE the measured
+        # write-unavailability window.
+        retry = max(0.05, getattr(replica, "election_timeout", 0.5))
+        return web.json_response(
+            e.to_dict(), status=e.code,
+            headers={"Retry-After": f"{retry:.2f}",
+                     "X-Ktpu-No-Leader": "1"})
+
+    @staticmethod
     def _err(e: errors.StatusError) -> web.Response:
         # 429/503 carry Retry-After (reference: the max-in-flight filter
         # and apf send it) so clients back off by the server's clock,
@@ -535,6 +574,10 @@ class APIServer:
         r = self.app.router
         r.add_get("/healthz", self._healthz)
         r.add_get("/readyz", self._healthz)
+        # Replication introspection (like etcd's /v3/maintenance/status;
+        # authn-exempt like /healthz): role/term/leader hint/commit rev
+        # — the failover harness's time-to-new-leader probe.
+        r.add_get("/ha/v1/status", self._ha_status)
         r.add_get("/version", self._version)
         r.add_get("/metrics", self._metrics)
         r.add_get("/apis", self._discovery)
@@ -581,6 +624,12 @@ class APIServer:
 
     async def _healthz(self, request):
         return web.Response(text="ok")
+
+    async def _ha_status(self, request):
+        replica = self.registry.replica
+        if replica is None:
+            return web.json_response({"replicated": False})
+        return web.json_response({"replicated": True, **replica.status()})
 
     async def _token_review(self, request):
         """POST {"spec": {"token": ...}} -> TokenReview with status
@@ -1552,8 +1601,13 @@ class APIServer:
         # Always a worker thread: O(collection) work would monopolize
         # the event loop even without a WAL (_mutate's inline fast path
         # is for single-object sub-ms mutations only).
-        n = await asyncio.to_thread(
+        n, wrote_rev = await asyncio.to_thread(
+            self.registry.store.last_write_in,
             self.registry.delete_collection, plural, ns, selector)
+        if wrote_rev and self.registry.replica is not None:
+            # Replicated plane: the deletes ack only at quorum, same as
+            # every run()-dispatched mutation.
+            await self.registry.replica.wait_commit(wrote_rev)
         if plural.endswith("webhookconfigurations"):
             self.webhooks.invalidate()
         return web.json_response({"deleted": n})

@@ -36,6 +36,9 @@ Fault catalog (site → kinds; ``param`` meaning):
 ``heartbeat``   ``miss`` (param: seconds the node agent mutes lease
                 renewals AND status posts — a network partition)
 ``deviceplugin``  ``unhealthy`` (param: seconds one chip reports unhealthy)
+``repl``        ``drop`` (one replication message lost), ``delay`` (param:
+                added seconds), ``partition`` (param: seconds the target
+                replica is cut off from all peers)
 =============== ============================================================
 """
 from __future__ import annotations
@@ -58,9 +61,10 @@ SITE_WAL = "wal"
 SITE_HEARTBEAT = "heartbeat"
 SITE_DEVICE = "deviceplugin"
 SITE_PREEMPT = "preempt"
+SITE_REPL = "repl"
 
 SITES = (SITE_REST, SITE_WATCH_REST, SITE_WATCH_STORE, SITE_WAL,
-         SITE_HEARTBEAT, SITE_DEVICE, SITE_PREEMPT)
+         SITE_HEARTBEAT, SITE_DEVICE, SITE_PREEMPT, SITE_REPL)
 
 KINDS = {
     SITE_REST: ("error", "http500", "hang", "slow"),
@@ -74,6 +78,13 @@ KINDS = {
     # (param selects which, mod the member count). The protocol must
     # converge, never double-book chips, never resume from a torn step.
     SITE_PREEMPT: ("kill-member",),
+    # Control-plane replication transport (storage/replication.py):
+    # "drop" loses one append/vote/snapshot message, "delay" adds
+    # param seconds of latency, "partition" cuts the DESTINATION
+    # replica off from every peer for param seconds. The leader-crash
+    # itself is harness-controlled (ReplicaNode.crash()), like the WAL
+    # crash trigger.
+    SITE_REPL: ("drop", "delay", "partition"),
 }
 
 FAULTS_INJECTED = Counter(

@@ -1,0 +1,471 @@
+"""Kill-the-leader HA convergence scenario — the control-plane
+replication acceptance harness.
+
+One seeded run drives gang workloads through a REPLICATED control plane
+(N apiserver replicas over quorum WAL replication, real HTTP, a
+multi-endpoint failover client) while the chaos layer injects transport
+and replication faults — then CRASHES THE LEADER MID-WAVE and asserts
+the system converged: a new leader elected, every gang member bound, no
+acknowledged write lost, every surviving replica's store byte-identical
+and byte-identical to its own WAL replay.
+
+Shared by ``tests/integration/test_ha_failover.py``, ``hack/ha_smoke.sh``
+(<90s gate), and ``hack/race.sh`` stage 5 (the same scenario under
+explored task-interleaving schedules with the election-safety and
+committed-never-lost invariants armed) — one scenario, not three
+drifting copies. ``perf/density.py run_failover`` reuses
+:class:`HAPlane` for its repeated-kill percentile stanza.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import shutil
+import tempfile
+import time
+from typing import Optional
+
+from ..api import errors, types as t
+from ..api.meta import ObjectMeta
+from ..apiserver.admission import default_chain
+from ..apiserver.registry import Registry
+from ..apiserver.server import APIServer
+from ..client.rest import RESTClient
+from ..scheduler.scheduler import Scheduler
+from ..storage import replication as repl
+from ..storage.mvcc import MVCCStore
+from . import core
+from .harness import _mk_gang, _mk_node
+
+log = logging.getLogger("ha")
+
+#: The fault mix a replicated convergence run faces: the transport
+#: faults PR 4 hardened the client against, plus replication-message
+#: drops and delays. The leader crash itself is scripted (a trigger,
+#: not a probability — the gate must not depend on a lucky seed).
+HA_SCHEDULE = (
+    core.FaultSpec(core.SITE_REST, "error", prob=0.02),
+    core.FaultSpec(core.SITE_REST, "slow", prob=0.05, param=0.005),
+    core.FaultSpec(core.SITE_WATCH_REST, "drop", prob=0.005),
+    core.FaultSpec(core.SITE_REPL, "drop", prob=0.02),
+    core.FaultSpec(core.SITE_REPL, "delay", prob=0.05, param=0.005),
+)
+
+
+class HAMember:
+    """One control-plane replica: store + registry + apiserver +
+    ReplicaNode, rebuild-able after a crash (same data dir)."""
+
+    def __init__(self, node_id: str, data_dir: str,
+                 transport: repl.LocalTransport, seed: int,
+                 election_timeout: float = 0.15,
+                 heartbeat_interval: float = 0.03):
+        self.node_id = node_id
+        self.data_dir = data_dir
+        self.store = MVCCStore(data_dir, fsync="batch")
+        self.registry = Registry(store=self.store)
+        self.registry.admission = default_chain(self.registry)
+        self.node = repl.ReplicaNode(
+            node_id, self.store, transport, seed=seed,
+            election_timeout=election_timeout,
+            heartbeat_interval=heartbeat_interval)
+        self.registry.replica = self.node
+        self.server = APIServer(self.registry)
+        self.port: Optional[int] = None
+
+    async def start(self, port: int = 0) -> None:
+        self.port = await self.server.start(port=port)
+        self.node.advertise_url = f"http://127.0.0.1:{self.port}"
+        await self.node.start()
+
+    async def crash(self) -> None:
+        """Abrupt kill: replication persona dies mid-flight, the HTTP
+        endpoint closes, the store is abandoned exactly as-is."""
+        self.node.crash()
+        await self.server.stop()
+
+    async def stop(self) -> None:
+        await self.server.stop()
+        await self.node.stop()
+        self.store.close()
+
+
+class HAPlane:
+    """N replicas over one in-process replication transport."""
+
+    def __init__(self, data_dir: str, replicas: int = 3, seed: int = 0,
+                 election_timeout: float = 0.15,
+                 heartbeat_interval: float = 0.03):
+        self.data_dir = data_dir
+        self.seed = seed
+        self.election_timeout = election_timeout
+        self.heartbeat_interval = heartbeat_interval
+        self.transport = repl.LocalTransport()
+        self.members: list[HAMember] = [
+            self._make(f"api-{i}") for i in range(replicas)]
+
+    def _make(self, node_id: str) -> HAMember:
+        return HAMember(node_id, os.path.join(self.data_dir, node_id),
+                        self.transport, self.seed,
+                        election_timeout=self.election_timeout,
+                        heartbeat_interval=self.heartbeat_interval)
+
+    async def start(self) -> None:
+        for m in self.members:
+            await m.start()
+
+    @property
+    def nodes(self) -> list:
+        return [m.node for m in self.members]
+
+    def live(self) -> list[HAMember]:
+        return [m for m in self.members if not m.node.crashed]
+
+    def endpoints(self) -> str:
+        return ",".join(f"http://127.0.0.1:{m.port}" for m in self.members)
+
+    async def leader_member(self, timeout: float = 5.0) -> HAMember:
+        node = await repl.wait_for_leader(
+            [m.node for m in self.live()], timeout)
+        return next(m for m in self.members if m.node is node)
+
+    async def rebuild(self, member: HAMember) -> HAMember:
+        """Restart a crashed member from its own data dir (WAL
+        recovery), rejoining as a follower that catches up — the
+        restarted-process path. Returns the fresh member, swapped into
+        ``self.members`` at the same position."""
+        fresh = self._make(member.node_id)
+        await fresh.start(port=member.port or 0)
+        self.members[self.members.index(member)] = fresh
+        return fresh
+
+    async def stop(self) -> None:
+        for m in self.members:
+            if m.node.crashed:
+                continue
+            try:
+                await m.stop()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                log.warning("HA member %s teardown failed", m.node_id,
+                            exc_info=True)
+
+
+class WriteProbe:
+    """Continuous ConfigMap writer through a (failover) client — the
+    ONE availability instrument `run_ha_smoke` and
+    `perf/density.py run_failover` share. It keeps current-term
+    commits flowing on a freshly elected leader (the raft commit
+    restriction needs a current-term write) and measures
+    write-unavailability as the gap between consecutive successful
+    writes straddling a kill timestamp.
+
+    ``acked`` (optional list) collects the store keys of writes whose
+    success response actually came back — the zero-acked-writes-lost
+    set. An AlreadyExists on a retried name means an earlier attempt
+    landed but was never acknowledged to US: it counts for
+    availability (the plane answered authoritatively) and advances to
+    the next name, but is deliberately NOT acked — a lost-ack create
+    must not wedge the probe into retrying one name forever."""
+
+    def __init__(self, client: RESTClient, interval: float = 0.03,
+                 prefix: str = "probe", namespace: str = "default",
+                 acked: Optional[list] = None):
+        self.client = client
+        self.interval = interval
+        self.prefix = prefix
+        self.namespace = namespace
+        self.acked = acked
+        self.success_at: list[float] = []
+        self._stop = asyncio.Event()
+        self._task: Optional[asyncio.Task] = None
+
+    def start(self) -> "WriteProbe":
+        from ..util.tasks import spawn
+        self._task = spawn(self._loop(), name=f"write-probe-{self.prefix}")
+        return self
+
+    async def stop(self) -> None:
+        self._stop.set()
+        if self._task is not None:
+            # Bounded: on the failure path a probe mid-request against
+            # a dead plane would otherwise hold teardown for the
+            # client's full timeout budget.
+            try:
+                await asyncio.wait_for(asyncio.shield(self._task), 2.0)
+            except asyncio.TimeoutError:
+                self._task.cancel()
+                try:
+                    await self._task
+                except asyncio.CancelledError:
+                    pass
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    async def _loop(self) -> None:
+        i = 0
+        while not self._stop.is_set():
+            name = f"{self.prefix}-{i:06d}"
+            try:
+                await self.client.create(t.ConfigMap(metadata=ObjectMeta(
+                    name=name, namespace=self.namespace)))
+                if self.acked is not None:
+                    self.acked.append(
+                        f"/registry/configmaps/{self.namespace}/{name}")
+            except errors.AlreadyExistsError:
+                pass  # availability is back; never acked (see class doc)
+            except errors.StatusError:
+                await asyncio.sleep(self.interval)
+                continue  # the gap IS the datum
+            self.success_at.append(time.perf_counter())
+            i += 1
+            await asyncio.sleep(self.interval)
+
+    def gap_spanning(self, t_kill: float) -> float:
+        """Widest success-to-success gap straddling ``t_kill`` — the
+        write-unavailability window that kill caused (0.0 when writes
+        never stalled across it)."""
+        gap = 0.0
+        for a, b in zip(self.success_at, self.success_at[1:]):
+            if a <= t_kill <= b:
+                gap = max(gap, b - a)
+        return gap
+
+
+async def _create_acked(client: RESTClient, obj, acked: list,
+                        deadline: float) -> None:
+    """Create with retries; records the object's store key in ``acked``
+    ONLY when a success response actually came back — the set the
+    zero-acked-writes-lost assert is over. An AlreadyExists on retry
+    means an earlier attempt landed but was never acknowledged to us,
+    so it is deliberately NOT counted."""
+    plural = {"Namespace": "namespaces", "ConfigMap": "configmaps",
+              "Pod": "pods", "PodGroup": "podgroups", "Node": "nodes"}[
+                  type(obj).__name__]
+    ns = obj.metadata.namespace
+    key = (f"/registry/{plural}/{ns}/{obj.metadata.name}" if ns
+           else f"/registry/{plural}/{obj.metadata.name}")
+    while True:
+        try:
+            await client.create(obj)
+            acked.append(key)
+            return
+        except errors.AlreadyExistsError:
+            return
+        except errors.StatusError:
+            if asyncio.get_running_loop().time() > deadline:
+                raise
+            await asyncio.sleep(0.05)
+
+
+async def run_ha_smoke(seed: int, replicas: int = 3, n_nodes: int = 4,
+                       gangs: int = 4, gang_size: int = 2,
+                       chips_per_pod: int = 2,
+                       timeout: float = 60.0) -> dict:
+    """The scripted kill-the-leader scenario; returns a report dict.
+    Raises AssertionError on any convergence violation.
+
+    Sequence: elect, seed fleet, wave 1 of gangs binds under
+    transport+replication chaos, CRASH THE LEADER mid-wave (wave 2
+    already submitted), measure time-to-new-leader and the write-
+    unavailability window seen by a continuous writer, converge wave 2,
+    then quiesce and assert: no acked write lost, survivors
+    byte-identical, each survivor's WAL replay byte-identical to its
+    live store.
+    """
+    t0 = time.perf_counter()
+    controller = core.arm(core.ChaosController(seed, HA_SCHEDULE))
+    # Guarantee the headline kinds regardless of seed luck.
+    controller.trigger(core.SITE_REPL, "drop")
+    controller.trigger(core.SITE_REPL, "delay", 0.005)
+    controller.trigger(core.SITE_REST, "error")
+    data_dir = tempfile.mkdtemp(prefix="ktpu-ha-")
+    mesh = [2, 2, n_nodes]
+    report: dict = {"seed": seed, "replicas": replicas}
+    acked: list[str] = []
+    plane = HAPlane(data_dir, replicas=replicas, seed=seed)
+    user: Optional[RESTClient] = None
+    sched: Optional[Scheduler] = None
+    sched_client: Optional[RESTClient] = None
+    writer: Optional[WriteProbe] = None
+    loop = asyncio.get_running_loop()
+    try:
+        await plane.start()
+        leader = await plane.leader_member(timeout=10.0)
+        report["first_leader"] = leader.node_id
+        eps = plane.endpoints()
+        user = RESTClient(eps)
+        user.backoff_base = 0.02
+        sched_client = RESTClient(eps)
+        sched_client.backoff_base = 0.02
+        await _create_acked(
+            user, t.Namespace(metadata=ObjectMeta(name="default")),
+            acked, loop.time() + 15.0)
+        for z in range(n_nodes):
+            await _create_acked(user, _mk_node(f"ha-{z}", z, mesh),
+                                acked, loop.time() + 15.0)
+        sched = Scheduler(sched_client, backoff_seconds=0.2)
+        await sched.start()
+
+        # Continuous writer: measures the write-unavailability window
+        # around the crash AND keeps current-term entries flowing so
+        # the new leader's commit index advances (the raft commit
+        # restriction needs a current-term write).
+        writer = WriteProbe(user, acked=acked).start()
+
+        async def wait_bound(names: set, deadline: float) -> None:
+            bound: set = set()
+            while True:
+                live_leader = [m for m in plane.live()
+                               if m.node.is_leader]
+                if live_leader:
+                    pods, _ = live_leader[0].registry.list("pods", "default")
+                    bound = {p.metadata.name for p in pods
+                             if p.spec.node_name
+                             and p.metadata.deletion_timestamp is None}
+                    if names <= bound:
+                        return
+                if loop.time() > deadline:
+                    raise AssertionError(
+                        "HA convergence timeout: missing "
+                        f"{sorted(names - bound)}")
+                await asyncio.sleep(0.1)
+
+        wave1 = {f"gang-{g}-{i}" for g in range(gangs // 2)
+                 for i in range(gang_size)}
+        for g in range(gangs // 2):
+            for obj in _mk_gang(f"gang-{g}", gang_size, chips_per_pod):
+                await _create_acked(user, obj, acked, loop.time() + 20.0)
+        await wait_bound(wave1, loop.time() + timeout / 3)
+
+        # Submit wave 2, then CRASH THE LEADER while it binds.
+        submit = asyncio.gather(*(
+            _create_acked(user, obj, acked, loop.time() + 30.0)
+            for g in range(gangs // 2, gangs)
+            for obj in _mk_gang(f"gang-{g}", gang_size, chips_per_pod)))
+        await asyncio.sleep(0.05)  # let the wave get airborne
+        t_kill = time.perf_counter()
+        await leader.crash()
+        report["killed"] = leader.node_id
+        survivors = [m for m in plane.members if m is not leader]
+        new_node = await repl.wait_for_leader(
+            [m.node for m in survivors], timeout=10.0)
+        report["time_to_new_leader_s"] = round(
+            time.perf_counter() - t_kill, 4)
+        report["new_leader"] = new_node.node_id
+        report["new_term"] = new_node.term
+        assert new_node.node_id != leader.node_id
+
+        await submit
+        all_pods = {f"gang-{g}-{i}" for g in range(gangs)
+                    for i in range(gang_size)}
+        await wait_bound(all_pods, loop.time() + timeout / 2)
+
+        # Quiesce: stop the writer and the scheduler, then let the
+        # survivors drain to one revision before comparing bytes.
+        await writer.stop()
+        report["write_unavailability_s"] = round(
+            writer.gap_spanning(t_kill), 4)
+        writer = None
+        await sched.stop()
+        sched = None
+        await repl.wait_converged([m.node for m in survivors], 10.0)
+
+        # Zero acknowledged writes lost: every key whose create was
+        # acked is live on EVERY survivor (nothing here deletes).
+        states = {m.node_id: m.store.state() for m in survivors}
+        report["acked_writes"] = len(acked)
+        for node_id, state in states.items():
+            missing = [k for k in acked if k not in state["data"]]
+            assert not missing, (
+                f"replica {node_id} lost {len(missing)} acked writes, "
+                f"e.g. {missing[:3]}")
+        # Survivors byte-identical.
+        blobs = {nid: json.dumps(s, sort_keys=True)
+                 for nid, s in states.items()}
+        first = next(iter(blobs.values()))
+        assert all(b == first for b in blobs.values()), \
+            "surviving replicas diverged"
+        report["replicas_identical"] = True
+        # Each survivor's WAL replay reproduces its live store.
+        for m in survivors:
+            m.store.fsync_now()
+            replay = MVCCStore(m.data_dir)
+            disk = json.dumps(replay.state(), sort_keys=True)
+            replay.close()
+            assert disk == blobs[m.node_id], \
+                f"replica {m.node_id}: WAL replay diverged from live store"
+        report["replay_identical"] = True
+
+        pods, _ = survivors[0].registry.list("pods", "default")
+        seen: dict = {}
+        for pod in pods:
+            for claim in pod.spec.tpu_resources:
+                for cid in claim.assigned:
+                    key = (pod.spec.node_name, cid)
+                    assert key not in seen, f"chip {key} double-booked"
+                    seen[key] = pod.metadata.name
+        report["pods_bound"] = len([p for p in pods if p.spec.node_name])
+        report["chips_assigned"] = len(seen)
+        report["acked_lost"] = 0
+
+        faults: dict = {}
+        for f in controller.injected:
+            faults[f"{f.site}:{f.kind}"] = faults.get(
+                f"{f.site}:{f.kind}", 0) + 1
+        report["faults"] = faults
+        report["fault_kinds"] = len({(f.site, f.kind)
+                                     for f in controller.injected})
+        report["elapsed_s"] = round(time.perf_counter() - t0, 2)
+        return report
+    finally:
+        core.disarm()
+        if writer is not None:
+            await writer.stop()
+        try:
+            if sched is not None:
+                await sched.stop()
+            if user is not None:
+                await user.close()
+            if sched_client is not None:
+                await sched_client.close()
+            await plane.stop()
+        except Exception:  # noqa: BLE001 — teardown best-effort
+            log.warning("HA harness teardown failed", exc_info=True)
+        shutil.rmtree(data_dir, ignore_errors=True)
+
+
+def run_ha_smoke_schedules(seed, schedules: int = 4, mode: str = "dpor",
+                           n_nodes: int = 2, gangs: int = 2,
+                           timeout: float = 30.0) -> dict:
+    """The tpusan arm of the HA gate: the SAME seeded kill-the-leader
+    scenario explored under ``schedules`` distinct task-interleaving
+    schedules with the cluster-invariant sanitizer armed — election
+    safety and committed-never-lost are checked live, and the
+    convergence FACTS (pods bound, acked-lost, byte-identity verdicts)
+    must come out identical on every schedule."""
+    from ..analysis import interleave
+
+    try:
+        base = int(seed)
+    except (TypeError, ValueError):
+        base = int.from_bytes(str(seed).encode(), "big") % (2 ** 31)
+    rep = interleave.explore_sanitized(
+        lambda i: run_ha_smoke(base, n_nodes=n_nodes, gangs=gangs,
+                               timeout=timeout),
+        base_seed=seed, schedules=schedules, mode=mode,
+        extract=lambda v: {"facts": {
+            "pods_bound": v["pods_bound"],
+            "chips_assigned": v["chips_assigned"],
+            "acked_lost": v["acked_lost"],
+            "replicas_identical": v["replicas_identical"],
+            "replay_identical": v["replay_identical"]}})
+    facts = [r["facts"] for r in rep["schedules"]]
+    if any(f != facts[0] for f in facts):
+        raise AssertionError(
+            f"HA convergence facts diverged across schedules: {facts}")
+    rep["seed"] = seed
+    rep["facts"] = facts[0]
+    return rep

@@ -37,27 +37,92 @@ class LeaderElector:
     async def run(self, on_started_leading: Callable[[], Awaitable[None]],
                   on_stopped_leading: Optional[Callable[[], None]] = None) -> None:
         """Acquire, then run the payload while renewing; if renewal fails
-        the payload is cancelled (crash-only handoff)."""
-        while True:
-            await self._acquire()
-            self.is_leader = True
-            log.info("%s: %s became leader", self.name, self.identity)
-            payload = asyncio.get_running_loop().create_task(on_started_leading())
-            try:
-                await self._renew_loop()
-            finally:
-                self.is_leader = False
-                payload.cancel()
+        the payload is cancelled (crash-only handoff).
+
+        Graceful stop (this coroutine cancelled, or the payload
+        returning) RELEASES the Lease — holder_identity CAS'd to empty
+        — so a standby acquires on its next retry tick instead of
+        waiting out ``lease_duration`` (reference:
+        ``ReleaseOnCancel``). A crash skips the release by definition
+        and standbys pay the full expiry, which is exactly the
+        fast-handoff-vs-crash-handoff split the tests pin down."""
+        try:
+            while True:
+                await self._acquire()
+                self.is_leader = True
+                log.info("%s: %s became leader", self.name, self.identity)
+                loop = asyncio.get_running_loop()
+                payload = loop.create_task(on_started_leading())
+                renew = loop.create_task(self._renew_loop())
+                payload_done = False
                 try:
-                    await payload
-                except asyncio.CancelledError:
-                    pass
-                except Exception as e:  # noqa: BLE001
-                    log.warning("%s: leader payload for %s raised during "
-                                "teardown: %s", self.name, self.identity, e)
-                if on_stopped_leading:
-                    on_stopped_leading()
-                log.warning("%s: %s lost leadership", self.name, self.identity)
+                    # First-completed wins: renewal failing ends the
+                    # payload (crash-only handoff), and the payload
+                    # finishing — return OR crash — ends leadership
+                    # too. Without watching the payload, a crashed one
+                    # would leave a zombie leader renewing a Lease it
+                    # does nothing with, locking every standby out.
+                    done, _ = await asyncio.wait(
+                        {payload, renew},
+                        return_when=asyncio.FIRST_COMPLETED)
+                    payload_done = payload in done
+                finally:
+                    self.is_leader = False
+                    payload.cancel()
+                    renew.cancel()
+                    try:
+                        await payload
+                    except asyncio.CancelledError:
+                        pass
+                    except Exception as e:  # noqa: BLE001
+                        log.warning("%s: leader payload for %s raised: %s",
+                                    self.name, self.identity, e)
+                    try:
+                        await renew
+                    except asyncio.CancelledError:
+                        pass
+                    if on_stopped_leading:
+                        on_stopped_leading()
+                    log.warning("%s: %s lost leadership", self.name, self.identity)
+                if payload_done:
+                    # The payload chose to stop (or died): hand the
+                    # lease over (outer finally) instead of re-electing
+                    # ourselves to run nothing.
+                    return
+        finally:
+            # Runs on cancellation (and payload crash propagation): if
+            # the lease is plausibly still ours, hand it over NOW.
+            # Shielded so the cancellation that got us here cannot kill
+            # the release mid-flight; bounded so a dead apiserver
+            # degrades to the crash path, not a hung teardown.
+            try:
+                await asyncio.shield(
+                    asyncio.wait_for(self.release(), 2.0))
+            except (asyncio.TimeoutError, asyncio.CancelledError,
+                    errors.StatusError) as e:
+                log.warning("%s: %s could not release the lease (%s); "
+                            "standbys will wait out the full "
+                            "lease_duration", self.name, self.identity, e)
+
+    async def release(self) -> None:
+        """CAS the Lease's holder back to empty if we still hold it —
+        the fast-handoff half of graceful shutdown. Safe to call when
+        not holding: a foreign holder (or a missing Lease) is a no-op.
+        Conflict losses are fine too: someone else already took or
+        touched it, which is the outcome release exists to enable."""
+        try:
+            lease = await self.client.get("leases", self.namespace, self.name)
+        except errors.NotFoundError:
+            return
+        if lease.spec.holder_identity != self.identity:
+            return
+        lease.spec.holder_identity = ""
+        lease.spec.renew_time = now()
+        try:
+            await self.client.update(lease)
+            log.info("%s: %s released the lease", self.name, self.identity)
+        except (errors.ConflictError, errors.NotFoundError):
+            pass  # raced with a taker — the handoff already happened
 
     async def _acquire(self) -> None:
         while True:

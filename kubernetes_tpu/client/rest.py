@@ -42,6 +42,11 @@ CLIENT_BACKOFF = Histogram(
     "Seconds the REST client slept backing off before a retry",
     buckets=(0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0))
 
+CLIENT_REDIRECTS = Counter(
+    "client_redirect_total",
+    "Leader-hint redirects (307/308) the REST client followed, by verb",
+    labels=("verb",))
+
 #: HTTP statuses a retryable (idempotent) request may retry on — the
 #: server-side/transient family; 4xx client errors never retry.
 _RETRYABLE_STATUS = (500, 502, 503, 504)
@@ -166,12 +171,19 @@ class _RESTWatch(WatchStream):
 
 
 class RESTClient(Client):
-    def __init__(self, base_url: str, token: str = "",
+    def __init__(self, base_url, token: str = "",
                  ca_file: str = "", client_cert: str = "",
                  client_key: str = "", check_hostname: bool = True,
                  impersonate_user: str = "",
                  impersonate_groups: tuple = ()):
-        """``ca_file`` makes https URLs verify against the cluster CA;
+        """``base_url`` may name SEVERAL apiserver endpoints — a
+        comma-separated string or a list — for a replicated control
+        plane: requests pin to one endpoint and fail over to the next
+        on connect errors and retryable 5xx, follow 307 leader hints
+        (re-pinning to the leader's origin), and treat a follower's
+        no-leader 503 as a backoff-able wait, so controllers and the
+        scheduler ride a leader crash with no code changes.
+        ``ca_file`` makes https URLs verify against the cluster CA;
         ``client_cert``/``client_key`` authenticate with an x509
         identity cert (CN=user, O=groups) instead of / beside a token.
         ``check_hostname=False`` only for callers that pinned the peer
@@ -180,7 +192,18 @@ class RESTClient(Client):
         ``impersonate_user``/``impersonate_groups``: act as another
         identity (kubectl --as / --as-group; RBAC 'impersonate' verb
         required server-side)."""
-        self.base_url = base_url.rstrip("/")
+        if isinstance(base_url, (list, tuple)):
+            eps = [u.rstrip("/") for u in base_url if u]
+        else:
+            eps = [u.strip().rstrip("/")
+                   for u in base_url.split(",") if u.strip()]
+        if not eps:
+            raise ValueError("RESTClient needs at least one endpoint")
+        #: The failover ring; ``base_url`` is the currently pinned
+        #: endpoint (possibly a redirect-learned leader origin outside
+        #: the ring).
+        self._endpoints = eps
+        self.base_url = eps[0]
         self._headers = {"Authorization": f"Bearer {token}"} if token else {}
         if impersonate_user:
             self._headers["Impersonate-User"] = impersonate_user
@@ -214,6 +237,11 @@ class RESTClient(Client):
         self.max_retries = 3
         self.backoff_base = 0.05
         self.backoff_cap = 2.0
+        #: Leader-hint (307/308) hops one logical request may take.
+        #: Repeated redirects past the first back off (capped
+        #: exponential + full jitter, same knobs as retries) — a stale
+        #: leader hint chasing its own tail must never hot-loop.
+        self.max_redirects = 8
         #: Connector tuning for the ONE shared session every request
         #: rides (see _sess): high-rate single-host clients (the
         #: scheduler firing binds, loadgen firing creates) must reuse
@@ -374,8 +402,42 @@ class RESTClient(Client):
                 raise errors.StatusError(f"HTTP {resp.status}") from None
             err = errors.StatusError.from_dict(body)
             err.retry_after = _parse_retry_after(resp.headers.get("Retry-After"))
+            # A follower with no elected leader refuses BEFORE acting
+            # (marked explicitly) — retryable for every verb, like 429.
+            err.no_leader = resp.headers.get("X-Ktpu-No-Leader") == "1"
             raise err
         return await resp.json()
+
+    def _switch_endpoint(self, url: str) -> str:
+        """Re-pin to the next endpoint in the failover ring and rebase
+        ``url`` onto it; a single-endpoint client is a no-op."""
+        if len(self._endpoints) <= 1:
+            return url
+        old = self.base_url
+        try:
+            i = self._endpoints.index(old)
+        except ValueError:
+            i = -1  # pinned to a redirect-learned origin: rejoin the ring
+        self.base_url = self._endpoints[(i + 1) % len(self._endpoints)]
+        return self._rebase(url, self.base_url)
+
+    @staticmethod
+    def _rebase(url: str, base: str) -> str:
+        from urllib.parse import urlsplit, urlunsplit
+        parts = urlsplit(url)
+        origin = urlsplit(base)
+        return urlunsplit((origin.scheme, origin.netloc, parts.path,
+                           parts.query, ""))
+
+    def _follow_redirect(self, url: str, location: str) -> str:
+        """Absolute Location re-pins the client to the leader's origin;
+        a relative one keeps the current origin."""
+        from urllib.parse import urlsplit
+        s = urlsplit(location)
+        if s.scheme and s.netloc:
+            self.base_url = f"{s.scheme}://{s.netloc}"
+            return location
+        return self._rebase(location, self.base_url)
 
     async def _chaos_fault(self) -> None:
         """The ``rest`` chaos injection site — consulted once per
@@ -421,29 +483,65 @@ class RESTClient(Client):
             connect=self.connect_timeout)
         backoff = self.backoff_base
         attempt = 0
+        redirects = 0
         while True:
             delay = None
             try:
                 await self._chaos_fault()
+                # allow_redirects=False: 307 leader hints are handled
+                # HERE — aiohttp's auto-follow would neither re-pin the
+                # client to the leader nor back off a redirect loop.
                 async with self._sess().request(method, url, timeout=ct,
+                                                allow_redirects=False,
                                                 **kw) as resp:
+                    if resp.status in (307, 308):
+                        location = resp.headers.get("Location", "")
+                        redirects += 1
+                        CLIENT_REDIRECTS.inc(verb=method)
+                        if not location or redirects > self.max_redirects:
+                            raise errors.ServiceUnavailableError(
+                                f"leader redirect loop at {self.base_url} "
+                                f"({redirects} hops)")
+                        url = self._follow_redirect(url, location)
+                        if redirects > 1:
+                            # Stale hints chasing each other (the old
+                            # leader not yet aware it lost): backoff-able
+                            # condition, never a hot loop.
+                            delay = backoff * (0.5 + random.random())
+                            backoff = min(backoff * 2, self.backoff_cap)
+                            CLIENT_BACKOFF.observe(delay)
+                            await asyncio.sleep(delay)
+                        continue
                     return await self._check(resp)
             except errors.StatusError as e:
                 if e.code == 429 and retry_429:
                     reason = "429"
                     delay = getattr(e, "retry_after", None)
+                elif e.code == 503 and getattr(e, "no_leader", False):
+                    # The follower refused BEFORE acting: safe to wait
+                    # out the election and retry for EVERY verb; rotate
+                    # in case this endpoint stays leaderless.
+                    reason = "no-leader"
+                    delay = getattr(e, "retry_after", None)
+                    url = self._switch_endpoint(url)
                 elif idempotent and e.code in _RETRYABLE_STATUS:
                     reason = f"http{e.code}"
                     # A 503 shedding load names its own retry clock
                     # too — honor it over our (much shorter) backoff.
                     delay = getattr(e, "retry_after", None)
+                    url = self._switch_endpoint(url)
                 else:
                     raise
                 if attempt >= self.max_retries:
                     raise
             except (aiohttp.ClientError, ConnectionResetError,
                     asyncio.TimeoutError) as e:
-                if not idempotent or attempt >= self.max_retries:
+                # A connect-phase failure means the request never
+                # reached a server — replay-safe for every verb, and
+                # the signature of a crashed endpoint: fail over.
+                connect_failure = isinstance(e, aiohttp.ClientConnectorError)
+                if not (idempotent or connect_failure) \
+                        or attempt >= self.max_retries:
                     # Surface transport failures in the client's ONE
                     # error taxonomy (LocalClient parity): every caller
                     # already handling StatusError — scheduler requeue
@@ -454,6 +552,7 @@ class RESTClient(Client):
                     raise errors.ServiceUnavailableError(
                         f"transport to {self.base_url}: {e}") from e
                 reason = type(e).__name__
+                url = self._switch_endpoint(url)
             attempt += 1
             # Full jitter on the capped exponential (reference:
             # client-go flowcontrol.Backoff) — synchronized retry

@@ -15,7 +15,7 @@ import asyncio
 import json
 import time
 
-from . import latency_percentiles, run_paced_creates
+from . import latency_percentiles, pct, run_paced_creates
 from ..api import types as t
 from ..api.meta import ObjectMeta
 from ..apiserver.admission import default_chain
@@ -353,10 +353,108 @@ async def run_density(n_nodes: int = 100, n_pods: int = 3000,
     return out
 
 
+def _raw_percentiles(samples: list, prefix: str) -> dict:
+    """p50/p99 over RAW samples in ms via the package's one
+    nearest-rank definition (perf.pct) — same discipline as
+    bind_call_p*, so cross-stanza numbers compare."""
+    if not samples:
+        return {}
+    ordered = sorted(samples)
+    return {f"{prefix}_p{int(q * 100)}_ms": round(pct(ordered, q) * 1e3, 1)
+            for q in (0.5, 0.99)}
+
+
+async def run_failover(replicas: int = 3, kills: int = 5,
+                       write_interval: float = 0.02,
+                       settle: float = 0.4,
+                       seed: int = 20260804) -> dict:
+    """Control-plane failover stanza: a replicated plane
+    (storage/replication.py via chaos/ha_harness.HAPlane), a continuous
+    writer through a multi-endpoint failover client, and ``kills``
+    repeated kill-the-leader events (the crashed member restarts from
+    its own WAL and catches back up between kills, so the pool stays at
+    ``replicas``). Reports time-to-new-leader and write-unavailability
+    window p50/p99 across the kills — the HA analog of the density
+    arm's bind percentiles.
+    """
+    import shutil
+    import tempfile
+
+    from ..api.meta import ObjectMeta
+    from ..chaos.ha_harness import HAPlane, WriteProbe
+    from ..client.rest import RESTClient
+    from ..storage import replication as repl
+
+    data_dir = tempfile.mkdtemp(prefix="ktpu-failover-")
+    plane = HAPlane(data_dir, replicas=replicas, seed=seed)
+    client = None
+    writer = None
+    t_kills: list[float] = []
+    ttnl: list[float] = []
+    try:
+        await plane.start()
+        await plane.leader_member(timeout=10.0)
+        client = RESTClient(plane.endpoints())
+        client.backoff_base = 0.02
+        from ..api import errors as api_errors
+        deadline = asyncio.get_running_loop().time() + 10.0
+        while True:
+            try:
+                await client.create(t.Namespace(
+                    metadata=ObjectMeta(name="default")))
+                break
+            except api_errors.StatusError:
+                # Pre-first-leader window — but bounded: a plane that
+                # never becomes writable must FAIL the bench, not hang.
+                if asyncio.get_running_loop().time() > deadline:
+                    raise
+                await asyncio.sleep(0.05)
+
+        writer = WriteProbe(client, interval=write_interval,
+                            prefix="fw").start()
+        for _k in range(kills):
+            await asyncio.sleep(settle)  # steady-state writes between kills
+            leader = await plane.leader_member(timeout=10.0)
+            t_kill = time.perf_counter()
+            await leader.crash()
+            t_kills.append(t_kill)
+            await repl.wait_for_leader(
+                [m.node for m in plane.live()], timeout=10.0)
+            ttnl.append(time.perf_counter() - t_kill)
+            # Restart the victim from its WAL — back to full strength
+            # (and through the catch-up/snapshot-install path) before
+            # the next kill.
+            await plane.rebuild(leader)
+        await asyncio.sleep(settle)
+        await writer.stop()
+        gaps = [g for g in (writer.gap_spanning(tk) for tk in t_kills) if g]
+        out = {
+            "replicas": replicas,
+            "kills": kills,
+            "writes_acked": len(writer.success_at),
+        }
+        writer = None
+        out.update(_raw_percentiles(ttnl, "time_to_new_leader"))
+        out.update(_raw_percentiles(gaps, "write_unavailability"))
+        return out
+    finally:
+        if writer is not None:
+            await writer.stop()
+        if client is not None:
+            await client.close()
+        await plane.stop()
+        shutil.rmtree(data_dir, ignore_errors=True)
+
+
 if __name__ == "__main__":
     import json
     import sys
 
+    if len(sys.argv) > 1 and sys.argv[1] == "failover":
+        replicas = int(sys.argv[2]) if len(sys.argv) > 2 else 3
+        kills = int(sys.argv[3]) if len(sys.argv) > 3 else 5
+        print(json.dumps(asyncio.run(run_failover(replicas, kills))))
+        sys.exit(0)
     nodes = int(sys.argv[1]) if len(sys.argv) > 1 else 100
     pods = int(sys.argv[2]) if len(sys.argv) > 2 else 3000
     via = sys.argv[3] if len(sys.argv) > 3 else "local"

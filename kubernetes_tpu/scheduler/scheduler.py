@@ -1187,3 +1187,95 @@ class Scheduler:
             await self.client.update_status(cur)
         except errors.StatusError:
             pass
+
+
+class ElectedScheduler:
+    """Active-standby scheduler behind the ``SchedulerLeaderElection``
+    gate (alpha, default off): N instances CAS one Lease
+    (client/leaderelection.py); only the holder runs a Scheduler, so
+    two scheduler processes can never double-bind a chip. Standbys keep
+    a warm InformerFactory — takeover builds its Scheduler on an
+    already-synced cache (Scheduler.start replays synced stores into
+    its handlers) instead of relisting the world.
+
+    Handoffs: a graceful :meth:`stop` releases the Lease
+    (LeaderElector.release) so the standby takes over within its retry
+    period; a crash leaves the Lease to expire and the standby pays
+    ``lease_duration`` — the same fast-vs-crash split the control-plane
+    replication layer has.
+
+    With the gate off, :meth:`start` runs the scheduler directly, no
+    Lease traffic at all — byte-identical to the ungated build.
+    """
+
+    LEASE_NAME = "kube-scheduler"
+
+    def __init__(self, client: Client, identity: str,
+                 name: str = "default-scheduler",
+                 backoff_seconds: float = 1.0, policy=None,
+                 lease_duration: float = 4.0, renew_deadline: float = 3.0,
+                 retry_period: float = 1.0,
+                 lease_namespace: str = "kube-system"):
+        self.client = client
+        self.identity = identity
+        self._sched_kw = {"name": name, "backoff_seconds": backoff_seconds,
+                          "policy": policy}
+        from ..client.informer import InformerFactory
+        self._factory = InformerFactory(client)
+        from ..client.leaderelection import LeaderElector
+        self.elector = LeaderElector(
+            client, self.LEASE_NAME, identity, namespace=lease_namespace,
+            lease_duration=lease_duration, renew_deadline=renew_deadline,
+            retry_period=retry_period)
+        #: The live Scheduler while this instance leads; None as standby.
+        self.scheduler: Optional[Scheduler] = None
+        self._task: Optional[asyncio.Task] = None
+        self._gated = False
+
+    @property
+    def is_leader(self) -> bool:
+        return self.elector.is_leader if self._gated else \
+            self.scheduler is not None
+
+    async def start(self) -> None:
+        from ..util.features import GATES
+        self._gated = GATES.enabled("SchedulerLeaderElection")
+        if not self._gated:
+            self.scheduler = Scheduler(self.client, **self._sched_kw)
+            await self.scheduler.start()
+            return
+        # Warm the shared informers NOW: a standby that takes over
+        # starts scheduling from an already-synced cache.
+        for plural in ("pods", "nodes", "podgroups"):
+            self._factory.informer(plural)
+        self._factory.start_all()
+        self._task = spawn(self.elector.run(self._lead),
+                           name=f"elected-scheduler-{self.identity}")
+
+    async def _lead(self) -> None:
+        sched = Scheduler(self.client, informer_factory=self._factory,
+                          **self._sched_kw)
+        await sched.start()
+        self.scheduler = sched
+        try:
+            await asyncio.Event().wait()  # lead until cancelled
+        finally:
+            self.scheduler = None
+            # Shield: this runs on leadership loss/cancel, and stop()
+            # must complete or in-flight binds leak into the successor.
+            await asyncio.shield(sched.stop())
+
+    async def stop(self) -> None:
+        if not self._gated:
+            if self.scheduler is not None:
+                await self.scheduler.stop()
+                self.scheduler = None
+            return
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+        await self._factory.stop_all()

@@ -46,6 +46,7 @@ import asyncio
 import bisect
 import json
 import os
+import threading as _threading
 import time
 import zlib
 from dataclasses import dataclass, field
@@ -101,9 +102,17 @@ class Watch:
 
     def __init__(self, store: "MVCCStore", prefix: str,
                  loop: asyncio.AbstractEventLoop,
-                 queue_limit: int = DEFAULT_QUEUE_LIMIT):
+                 queue_limit: int = DEFAULT_QUEUE_LIMIT,
+                 start_revision: int = 0):
         self._store = store
         self.prefix = prefix
+        #: Events at or below this revision are never delivered. On a
+        #: single store live events always outrun it; on a REPLICATION
+        #: FOLLOWER a watcher may resume from a revision the follower
+        #: has not applied yet — the lagging entries arrive as "live"
+        #: events and must not be re-delivered to a client that already
+        #: saw them through the leader it listed against.
+        self.start_revision = start_revision
         self._loop = loop
         self._queue: asyncio.Queue[Optional[WatchEvent]] = asyncio.Queue()
         self._cancelled = False
@@ -119,6 +128,8 @@ class Watch:
 
     def _deliver(self, ev: Optional[WatchEvent]) -> None:
         # Called with store lock held, possibly from a foreign thread.
+        if ev is not None and ev.revision <= self.start_revision:
+            return  # the client already observed this revision
         if ev is not None:
             c = chaos.CONTROLLER
             if c is not None and not self.overflowed:
@@ -292,6 +303,36 @@ class MVCCStore:
         #: True once a WAL fault (chaos) crashed the backend: every
         #: further mutation raises until the store is rebuilt from disk.
         self._wal_failed = False
+        #: Replication follower guard: when set (to a human-readable
+        #: reason), every direct mutation raises ServiceUnavailable —
+        #: a follower's state may only advance through
+        #: :meth:`apply_replicated`, or it diverges from the leader.
+        self.writes_blocked: Optional[str] = None
+        #: Raft term stamped into WAL records (and the snapshot) while
+        #: a replication layer drives this store — the log-entry term
+        #: raft's election restriction and consistency checks need to
+        #: SURVIVE A RESTART. 0 (unreplicated) keeps the record format
+        #: byte-identical to the pre-replication WAL.
+        self.wal_term = 0
+        #: Term of the last APPLIED record (never the stamping term —
+        #: a snapshot must claim exactly what its log holds, or a
+        #: restarted node would out-vote genuinely longer logs).
+        self.last_entry_term = 0
+        #: Term of the last record recovered from disk (snapshot term,
+        #: advanced by each replayed WAL record) — what a restarted
+        #: ReplicaNode resumes its (last_term, last_rev) coordinate
+        #: from. Without this, a rebooted replica would claim term 0
+        #: for its whole log and grant votes to candidates with older,
+        #: shorter logs — losing quorum-committed writes.
+        self.recovered_term = 0
+        #: Per-thread capture of the last revision a mutation wrote
+        #: (see :meth:`last_write_in`).
+        self._write_tls = _threading.local()
+        #: True while :meth:`apply_replicated` is inside _append_event;
+        #: lets a replication event hook tell a LOCAL write (to ship to
+        #: followers) from a replicated apply (already shipped). Valid
+        #: only under the store lock, which is where hooks run.
+        self.applying_replicated = False
         #: Canonical state captured the instant a WAL crash fault fired
         #: — what recovery from disk must reproduce, byte for byte.
         self.pre_crash_state: Optional[dict] = None
@@ -362,6 +403,7 @@ class MVCCStore:
                 state = json.load(f)
             self._rev = state["rev"]
             self._compact_rev = state.get("compact_rev", 0)
+            self.recovered_term = state.get("term", 0)
             for k, v in state["data"].items():
                 self._data[k] = StoredObject(
                     key=k, value=self._from_disk(k, v["value"]),
@@ -383,6 +425,7 @@ class MVCCStore:
         # from a pre-restart revision get GoneError (410) and relist —
         # the same contract etcd gives after compaction.
         self._compact_rev = max(self._compact_rev, self._rev)
+        self.last_entry_term = self.recovered_term
 
     def _replay_wal(self, wal: str) -> int:
         """Apply the WAL's longest valid record prefix; returns the
@@ -430,6 +473,7 @@ class MVCCStore:
         if rec["rev"] <= self._rev:
             return
         self._rev = rec["rev"]
+        self.recovered_term = rec.get("term", self.recovered_term)
         key = rec["key"]
         if rec["op"] == DELETED:
             self._data.pop(key, None)
@@ -449,6 +493,7 @@ class MVCCStore:
             state = {
                 "rev": self._rev,
                 "compact_rev": self._compact_rev,
+                "term": self.last_entry_term,
                 "data": {
                     k: {"value": self._disk(k, o.value),
                         "mod_revision": o.mod_revision,
@@ -498,6 +543,9 @@ class MVCCStore:
 
     def _append_event(self, ev: WatchEvent) -> None:
         interleave.touch(ev.key)
+        if self.wal_term:
+            self.last_entry_term = self.wal_term
+        self._write_tls.last_rev = ev.revision
         for hook in self._write_hooks:
             hook(ev.key)
         for hook in self._event_hooks:
@@ -522,10 +570,13 @@ class MVCCStore:
 
     def _wal_line(self, rev: int, op: str, key: str,
                   value: Optional[dict]) -> str:
-        payload = json.dumps({
-            "rev": rev, "op": op, "key": key,
-            "value": self._disk(key, value),
-        }, separators=(",", ":"))
+        rec = {"rev": rev, "op": op, "key": key,
+               "value": self._disk(key, value)}
+        if self.wal_term:
+            # Only replicated stores stamp terms — an unreplicated WAL
+            # stays byte-identical to the pre-replication format.
+            rec["term"] = self.wal_term
+        payload = json.dumps(rec, separators=(",", ":"))
         return f"{zlib.crc32(payload.encode()):08x} {payload}\n"
 
     def _wal_sync(self) -> None:
@@ -622,9 +673,14 @@ class MVCCStore:
         dict the caller may mutate later."""
         return json.loads(json.dumps(value, separators=(",", ":")))
 
+    def _check_write_guard(self) -> None:
+        if self.writes_blocked:
+            raise errors.ServiceUnavailableError(self.writes_blocked)
+
     def create(self, key: str, value: dict) -> int:
         value = self._freeze(value)
         with self._lock:
+            self._check_write_guard()
             if key in self._data:
                 raise errors.AlreadyExistsError(f"key {key!r} already exists")
             self._wal_chaos_precheck(ADDED, key, value)
@@ -655,6 +711,7 @@ class MVCCStore:
     def update(self, key: str, value: dict, expected_revision: Optional[int] = None) -> int:
         value = self._freeze(value)
         with self._lock:
+            self._check_write_guard()
             obj = self._data.get(key)
             if obj is None:
                 raise errors.NotFoundError(f"key {key!r} not found")
@@ -675,6 +732,7 @@ class MVCCStore:
 
     def delete(self, key: str, expected_revision: Optional[int] = None) -> int:
         with self._lock:
+            self._check_write_guard()
             obj = self._data.get(key)
             if obj is None:
                 raise errors.NotFoundError(f"key {key!r} not found")
@@ -688,6 +746,100 @@ class MVCCStore:
             del self._data[key]
             self._append_event(WatchEvent(DELETED, key, obj.value, obj.value, self._rev))
             return self._rev
+
+    def last_write_in(self, fn, *args) -> tuple:
+        """Run ``fn(*args)`` and return ``(result, rev)`` where ``rev``
+        is the highest revision the call itself wrote (0 if it wrote
+        nothing). Capture is per-thread — concurrent requests in other
+        worker threads (or interleaved on the loop between THIS sync
+        call's boundaries) cannot leak their revisions into it — so the
+        replicated ack gate waits on exactly the write it acked, never
+        on a neighbor's in-flight mutation."""
+        self._write_tls.last_rev = 0
+        out = fn(*args)
+        return out, self._write_tls.last_rev
+
+    # -- replication apply path -------------------------------------------
+
+    def apply_replicated(self, op: str, key: str, value: Optional[dict],
+                         rev: int, term: int = 0) -> bool:
+        """Apply one replicated log entry with its LEADER-ASSIGNED
+        revision — the follower half of storage/replication.py. Bypasses
+        the follower write guard and all CAS checks (the leader already
+        arbitrated them), but takes the same path through the WAL, the
+        write/event hooks, and watch delivery, so a follower is fully
+        durable and fully watchable. Idempotent: a resent entry at or
+        below the current revision is a no-op (returns False).
+        ``term`` is the entry's raft term, stamped into the WAL record
+        so the log coordinate survives a restart."""
+        with self._lock:
+            if rev <= self._rev:
+                return False
+            if rev != self._rev + 1:
+                raise ValueError(
+                    f"replicated entry rev {rev} leaves a gap after local "
+                    f"rev {self._rev}; replication must apply contiguously")
+            if term:
+                self.wal_term = term
+            self._wal_chaos_precheck(op, key, value)
+            self._rev = rev
+            prev_obj = self._data.get(key)
+            if op == DELETED:
+                if prev_obj is not None:
+                    del self._data[key]
+                corpse = prev_obj.value if prev_obj is not None else value
+                ev = WatchEvent(DELETED, key, corpse, corpse, rev)
+            else:
+                value = self._freeze(value)
+                self._data[key] = StoredObject(
+                    key=key, value=value, mod_revision=rev,
+                    create_revision=(prev_obj.create_revision
+                                     if prev_obj is not None else rev))
+                ev = WatchEvent(
+                    op, key, value,
+                    prev_obj.value if prev_obj is not None else None, rev)
+            self.applying_replicated = True
+            try:
+                self._append_event(ev)
+            finally:
+                self.applying_replicated = False
+            return True
+
+    def reset_from_state(self, state: dict, term: int = 0) -> None:
+        """Snapshot install: replace the ENTIRE store contents with a
+        leader's canonical :meth:`state` snapshot (a diverged or
+        far-behind replica catching up). Every live watch is cancelled
+        — clients relist, exactly like post-compaction — and on a
+        durable store the snapshot is persisted and the WAL truncated,
+        so recovery replays the installed state, not the divergent
+        pre-install log. ``term``: the raft term of the snapshot's last
+        entry, persisted with it so a post-install restart resumes the
+        true log coordinate."""
+        with self._lock:
+            if term:
+                self.wal_term = term
+                self.last_entry_term = term
+            for wch in list(self._watches):
+                wch.cancel()
+            old_keys = set(self._data)
+            self._data = _PrefixIndexedMap()
+            for k, v in state["data"].items():
+                self._data[k] = StoredObject(
+                    key=k, value=self._freeze(v["value"]),
+                    mod_revision=v["mod_revision"],
+                    create_revision=v["create_revision"])
+            self._rev = state["rev"]
+            # History before the install never happened here: resuming
+            # watchers must relist (GoneError), like after compaction.
+            self._compact_rev = self._rev
+            self._log.clear()
+            self._log_revs.clear()
+            for key in old_keys | set(self._data):
+                for hook in self._write_hooks:
+                    hook(key)
+            if self._data_dir:
+                self.snapshot()
+        invariants.note_store_reset(self)
 
     def guaranteed_update(
         self, key: str, fn: Callable[[Optional[dict]], Optional[dict]],
@@ -761,7 +913,7 @@ class MVCCStore:
                 raise errors.GoneError(
                     f"revision {start_revision} compacted (compact_rev={self._compact_rev})"
                 )
-            wch = Watch(self, prefix, loop)
+            wch = Watch(self, prefix, loop, start_revision=start_revision)
             if start_revision:
                 idx = bisect.bisect_right(self._log_revs, start_revision)
                 for ev in self._log[idx:]:

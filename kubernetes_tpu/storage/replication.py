@@ -1,0 +1,791 @@
+"""Raft-lite quorum replication for the control plane.
+
+Reference lineage: etcd's raft (``go.etcd.io/etcd/raft``) carrying the
+apiserver's storage, compressed to the three mechanisms the cluster
+actually needs and layered over the existing CRC-framed MVCC WAL
+(storage/mvcc.py):
+
+- **single-leader election** with durable term/vote records
+  (``<data_dir>/raft.json``) and the standard log-completeness
+  restriction: a vote is granted only to a candidate whose
+  ``(last_term, last_rev)`` is at least the voter's — so an elected
+  leader always holds every committed entry;
+- **append-entries replication**: every local write on the leader's
+  store is captured at the MVCC event seam and shipped, in revision
+  order, to followers, which apply it through
+  :meth:`~.mvcc.MVCCStore.apply_replicated` — into their own store,
+  their own WAL, and their own watchers (followers are fully durable
+  and fully watchable);
+- **commit at quorum**: a write is acknowledged to the client
+  (:meth:`ReplicaNode.wait_commit`, awaited by ``Registry.run``) only
+  once a majority of replicas hold it. A leader that loses quorum fails
+  the ack with 503 — the write may or may not survive, exactly etcd's
+  "leader changed" answer, and clients retry (create → AlreadyExists
+  on the survivor is the recovery signal).
+
+Divergence recovery is deliberately blunt: a follower whose log cannot
+be verified as a prefix of the leader's (a rejoining crashed ex-leader
+with applied-but-uncommitted entries, or a laggard that outran the
+bounded entry buffer) gets a full **snapshot install**
+(:meth:`~.mvcc.MVCCStore.reset_from_state`) instead of per-entry
+truncation — state transfer is cheap at this scale and cannot be
+subtly wrong.
+
+Determinism: election timeouts are drawn from a per-node rng stream
+seeded ``f"{seed}:{node_id}"`` — the same contract the chaos layer
+gives its sites — so which replica campaigns first is a pure function
+of the seed, not of wall-clock noise, and TPU_SAN schedule exploration
+replays elections. The in-process transport is the ``repl`` chaos site
+(kinds: ``drop``, ``delay``, ``partition``).
+
+Single-process path: a cluster composed WITHOUT a ReplicaNode touches
+none of this — no hook, no guard, no wait — and stays byte-identical
+to the unreplicated control plane. A ``replicas=1`` ReplicaSet elects
+itself at the first timeout and commits every write immediately
+(quorum of one).
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import random
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+from ..analysis import interleave, invariants
+from ..api import errors
+from ..chaos import core as chaos
+from ..metrics.registry import Counter, Gauge
+from ..util.lockdep import make_lock
+from ..util.tasks import spawn
+from .mvcc import MVCCStore, WatchEvent
+
+log = logging.getLogger("replication")
+
+FOLLOWER = "Follower"
+CANDIDATE = "Candidate"
+LEADER = "Leader"
+
+REPL_ELECTIONS = Counter(
+    "replication_elections_total",
+    "Leader elections by node and outcome (won/lost/stepped_down)",
+    labels=("node", "outcome"))
+
+REPL_MESSAGES = Counter(
+    "replication_messages_total",
+    "Replication RPCs sent, by message type and result",
+    labels=("type", "result"))
+
+REPL_COMMIT_REV = Gauge(
+    "replication_commit_revision",
+    "Highest quorum-committed store revision, per node",
+    labels=("node",))
+
+REPL_TERM = Gauge(
+    "replication_term",
+    "Current raft term, per node",
+    labels=("node",))
+
+REPL_SNAPSHOT_INSTALLS = Counter(
+    "replication_snapshot_installs_total",
+    "Full state transfers to diverged/lagging followers",
+    labels=("node",))
+
+#: The follower write-guard reason (also the 503 detail clients see if
+#: a write slips past the apiserver's redirect).
+NOT_LEADER = ("not the replication leader; writes must go through the "
+              "leader (follow the 307 Location hint)")
+
+
+class ReplError(Exception):
+    """Transport-level replication failure (drop/partition/peer dead).
+    Handled like a lost packet: the next round retries."""
+
+
+@dataclass(frozen=True)
+class LogEntry:
+    """One replicated write: the WAL record plus the term it was
+    appended under (the conflict-detection coordinate)."""
+    term: int
+    rev: int
+    op: str
+    key: str
+    value: Optional[dict]
+
+    def to_wire(self) -> dict:
+        return {"term": self.term, "rev": self.rev, "op": self.op,
+                "key": self.key, "value": self.value}
+
+    @staticmethod
+    def from_wire(d: dict) -> "LogEntry":
+        return LogEntry(d["term"], d["rev"], d["op"], d["key"], d["value"])
+
+
+class LocalTransport:
+    """In-process replica-to-replica RPC fabric — every control-plane
+    composition in this repo runs its replicas on one event loop (the
+    chaos/tpusan harness shape), so the transport is direct coroutine
+    dispatch with the ``repl`` chaos site in front of every send.
+
+    Fault kinds: ``drop`` (this message is lost), ``delay`` (param
+    seconds of added latency), ``partition`` (the DESTINATION node is
+    unreachable — both directions — for param seconds). Harnesses may
+    also partition explicitly via :meth:`partition`.
+    """
+
+    def __init__(self):
+        self._nodes: dict[str, "ReplicaNode"] = {}
+        #: node_id -> monotonic deadline while partitioned.
+        self._partitioned: dict[str, float] = {}
+
+    def register(self, node: "ReplicaNode") -> None:
+        self._nodes[node.node_id] = node
+
+    def unregister(self, node_id: str) -> None:
+        self._nodes.pop(node_id, None)
+
+    def peer_ids(self, exclude: str) -> list[str]:
+        return sorted(n for n in self._nodes if n != exclude)
+
+    def node(self, node_id: str) -> Optional["ReplicaNode"]:
+        return self._nodes.get(node_id)
+
+    def advertise_url(self, node_id: str) -> str:
+        node = self._nodes.get(node_id)
+        return node.advertise_url if node is not None else ""
+
+    def partition(self, node_id: str, seconds: float) -> None:
+        """Cut ``node_id`` off from every peer for ``seconds``."""
+        self._partitioned[node_id] = time.monotonic() + seconds
+
+    def _is_partitioned(self, node_id: str, now: float) -> bool:
+        until = self._partitioned.get(node_id)
+        if until is None:
+            return False
+        if now >= until:
+            del self._partitioned[node_id]
+            return False
+        return True
+
+    async def call(self, src: str, dst: str, msg: dict) -> dict:
+        mtype = msg.get("type", "?")
+        c = chaos.CONTROLLER
+        if c is not None:
+            fault = c.decide(chaos.SITE_REPL)
+            if fault is not None:
+                if fault.kind == "drop":
+                    REPL_MESSAGES.inc(type=mtype, result="dropped")
+                    raise ReplError(f"chaos: {src}->{dst} {mtype} dropped")
+                if fault.kind == "delay":
+                    await asyncio.sleep(fault.param or 0.02)
+                elif fault.kind == "partition":
+                    self.partition(dst, fault.param or 0.5)
+        now = time.monotonic()
+        node = self._nodes.get(dst)
+        if node is None or node.crashed \
+                or self._is_partitioned(src, now) \
+                or self._is_partitioned(dst, now):
+            REPL_MESSAGES.inc(type=mtype, result="unreachable")
+            raise ReplError(f"{src}->{dst} {mtype}: peer unreachable")
+        REPL_MESSAGES.inc(type=mtype, result="ok")
+        return await node.handle(src, msg)
+
+
+class ReplicaNode:
+    """One replica: an MVCC store plus its raft-lite persona.
+
+    Lifecycle: :meth:`start` registers with the transport, arms the
+    store's follower write guard, and runs the main loop (election
+    ticker as follower, heartbeat/append rounds as leader).
+    :meth:`stop` steps down cleanly; :meth:`crash` is the abrupt kill
+    the failover scenarios use — tasks die mid-flight, the store is
+    abandoned as-is, peers find out by timeout.
+    """
+
+    #: In-memory entry buffer for follower catch-up; a follower whose
+    #: next needed entry fell out of the buffer gets a snapshot.
+    MAX_BUFFER = 4096
+
+    def __init__(self, node_id: str, store: MVCCStore,
+                 transport: LocalTransport, *, seed: int = 0,
+                 heartbeat_interval: float = 0.03,
+                 election_timeout: float = 0.15,
+                 commit_timeout: float = 5.0,
+                 advertise_url: str = "", group: str = "control-plane"):
+        self.node_id = node_id
+        self.store = store
+        self.transport = transport
+        self.group = group
+        self.advertise_url = advertise_url
+        self.heartbeat_interval = heartbeat_interval
+        self.election_timeout = election_timeout
+        self.commit_timeout = commit_timeout
+        #: Seeded per-node stream: the election-timeout sequence (and so
+        #: the campaign order across replicas) replays by seed.
+        self._rng = random.Random(f"{seed}:{node_id}")
+        self.state = FOLLOWER
+        self.term = 0
+        self.voted_for = ""
+        self.leader_id: Optional[str] = None
+        self.crashed = False
+        self.commit_rev = store.revision
+        #: Last log coordinate. A fresh store boots the common term-0
+        #: base; a RECOVERED store resumes the term its last durable
+        #: record was written under (persisted in every WAL record and
+        #: the snapshot) — without it, a rebooted replica would claim
+        #: term 0 for its whole log and vote for candidates with
+        #: older, shorter logs, un-electing its own committed entries.
+        self.last_rev = store.revision
+        self.last_term = store.last_entry_term
+        self._base_rev = store.revision
+        self._base_term = store.last_entry_term
+        self._entries: dict[int, LogEntry] = {}
+        self._buf_lock = make_lock(f"replication.{node_id}.buffer")
+        self._next_rev: dict[str, int] = {}
+        self._match_rev: dict[str, int] = {}
+        self._commit_waiters: list[tuple[int, asyncio.Future]] = []
+        self._kick = asyncio.Event()
+        self._hb_seen = asyncio.Event()
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._tasks: set = set()
+        self._main_task: Optional[asyncio.Task] = None
+        self._load_term_state()
+        store.writes_blocked = NOT_LEADER
+        store.add_event_hook(self._on_store_event)
+        invariants.register_replica_store(self.group, self.node_id, store)
+
+    # -- durable term/vote ------------------------------------------------
+
+    def _raft_path(self) -> Optional[str]:
+        d = self.store._data_dir
+        return os.path.join(d, "raft.json") if d else None
+
+    def _load_term_state(self) -> None:
+        path = self._raft_path()
+        if not path or not os.path.exists(path):
+            return
+        try:
+            with open(path) as f:
+                st = json.load(f)
+            self.term = int(st.get("term", 0))
+            self.voted_for = st.get("voted_for", "")
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            # A torn raft.json is a fresh follower, not a crash loop:
+            # the worst case is voting twice in an old term, which the
+            # vote-counting quorum still tolerates for a kill-restart.
+            log.warning("%s: unreadable raft state %s: %s — starting at "
+                        "term 0", self.node_id, path, e)
+
+    def _persist_term_state(self) -> None:
+        path = self._raft_path()
+        if not path:
+            return
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"term": self.term, "voted_for": self.voted_for}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    def _set_term(self, term: int, voted_for: str) -> None:
+        self.term = term
+        self.voted_for = voted_for
+        REPL_TERM.set(float(term), node=self.node_id)
+        self._persist_term_state()
+
+    # -- lifecycle --------------------------------------------------------
+
+    @property
+    def is_leader(self) -> bool:
+        return self.state == LEADER and not self.crashed
+
+    def leader_hint(self) -> str:
+        """The current leader's advertised client URL, or ""."""
+        if self.leader_id is None:
+            return ""
+        return self.transport.advertise_url(self.leader_id)
+
+    async def start(self) -> None:
+        self._loop = asyncio.get_running_loop()
+        self.transport.register(self)
+        self._main_task = spawn(self._main(),
+                                name=f"replica-{self.node_id}",
+                                store=self._tasks)
+
+    async def stop(self) -> None:
+        """Clean shutdown: step down so peers elect without waiting out
+        the election timeout... they still must time out (no explicit
+        abdication message — crash-only, like the rest of the repo)."""
+        self.crashed = True
+        self._step_down(self.term, leader=None)
+        if self._main_task is not None:
+            self._main_task.cancel()
+            try:
+                await self._main_task
+            except asyncio.CancelledError:
+                pass
+        self.transport.unregister(self.node_id)
+
+    def crash(self) -> None:
+        """Abrupt kill: the process is gone mid-flight. The store is
+        abandoned exactly as-is (whatever reached ITS wal is what a
+        restart would recover); peers notice only by missed
+        heartbeats."""
+        self.crashed = True
+        for t in list(self._tasks):
+            t.cancel()
+        self._fail_waiters("replica crashed before the write committed")
+
+    # -- local write capture (leader side) --------------------------------
+
+    def _on_store_event(self, ev: WatchEvent) -> None:
+        # Runs under the store lock, possibly from a worker thread
+        # (Registry.run dispatches durable-store mutations to_thread).
+        if self.store.applying_replicated:
+            return  # a replicated apply, not a local write
+        # The entry's term is what the WAL record was STAMPED with
+        # (store.wal_term, read under the same store lock) — not
+        # self.term, which a concurrent step-down on the event loop may
+        # already have advanced past the term this write really ran
+        # under; a mismatch would let a divergent uncommitted tail pass
+        # the overlap term check and survive.
+        entry = LogEntry(self.store.wal_term, ev.revision, ev.type, ev.key,
+                         ev.value)
+        with self._buf_lock:
+            self._entries[ev.revision] = entry
+            self.last_rev = ev.revision
+            self.last_term = entry.term
+            self._trim_buffer()
+        if self._loop is not None and not self.crashed:
+            try:
+                self._loop.call_soon_threadsafe(self._kick.set)
+            except RuntimeError:
+                pass  # loop already closed: shutdown race, nothing to ship
+
+    def _trim_buffer(self) -> None:
+        # Only committed entries may be dropped — an uncommitted entry
+        # still needs shipping; a follower that needs a dropped one
+        # gets a snapshot instead.
+        while len(self._entries) > self.MAX_BUFFER:
+            oldest = min(self._entries)
+            if oldest > self.commit_rev:
+                break
+            del self._entries[oldest]
+
+    def _term_at(self, rev: int) -> Optional[int]:
+        e = self._entries.get(rev)
+        if e is not None:
+            return e.term
+        if rev == self._base_rev:
+            return self._base_term
+        if rev < self._base_rev and self._base_term == 0:
+            return 0
+        return None
+
+    # -- main loop --------------------------------------------------------
+
+    def next_election_timeout(self) -> float:
+        """Seeded jitter in [T, 2T): the sequence — and therefore which
+        replica campaigns first — replays by (seed, node_id)."""
+        return self.election_timeout * (1.0 + self._rng.random())
+
+    async def _main(self) -> None:
+        while not self.crashed:
+            interleave.touch(f"repl:{self.node_id}")
+            if self.state == LEADER:
+                await self._lead_round()
+                try:
+                    await asyncio.wait_for(self._kick.wait(),
+                                           self.heartbeat_interval)
+                except asyncio.TimeoutError:
+                    pass
+                self._kick.clear()
+            else:
+                try:
+                    await asyncio.wait_for(self._hb_seen.wait(),
+                                           self.next_election_timeout())
+                    self._hb_seen.clear()
+                except asyncio.TimeoutError:
+                    await self._campaign()
+
+    # -- election ---------------------------------------------------------
+
+    async def _campaign(self) -> None:
+        self._set_term(self.term + 1, voted_for=self.node_id)
+        self.state = CANDIDATE
+        self.leader_id = None
+        term = self.term
+        peers = self.transport.peer_ids(exclude=self.node_id)
+        log.info("%s: campaigning in term %d (%d peers)",
+                 self.node_id, term, len(peers))
+        with self._buf_lock:
+            last_rev, last_term = self.last_rev, self.last_term
+        msg = {"type": "vote", "term": term, "candidate": self.node_id,
+               "last_rev": last_rev, "last_term": last_term}
+
+        async def ask(peer: str):
+            try:
+                return await asyncio.wait_for(
+                    self.transport.call(self.node_id, peer, msg),
+                    self.election_timeout)
+            except (ReplError, asyncio.TimeoutError) as e:
+                log.debug("%s: vote request to %s failed: %s",
+                          self.node_id, peer, e)
+                return None
+
+        results = await asyncio.gather(*(ask(p) for p in peers))
+        if self.crashed or self.term != term or self.state != CANDIDATE:
+            return  # a heartbeat or higher term arrived mid-campaign
+        votes = 1  # self
+        for r in results:
+            if r is None:
+                continue
+            if r.get("term", 0) > self.term:
+                self._step_down(r["term"])
+                return
+            if r.get("granted") and r.get("term") == term:
+                votes += 1
+        if 2 * votes > len(peers) + 1:
+            self._become_leader()
+        else:
+            REPL_ELECTIONS.inc(node=self.node_id, outcome="lost")
+            self.state = FOLLOWER  # retry after the next seeded timeout
+
+    def _become_leader(self) -> None:
+        self.state = LEADER
+        self.leader_id = self.node_id
+        REPL_ELECTIONS.inc(node=self.node_id, outcome="won")
+        invariants.note_leader(self.group, self.node_id, self.term)
+        with self._buf_lock:
+            nxt = self.last_rev + 1
+        self._next_rev = {p: nxt
+                          for p in self.transport.peer_ids(self.node_id)}
+        self._match_rev = {p: 0
+                           for p in self.transport.peer_ids(self.node_id)}
+        # Open the store for local writes, stamped with our term so
+        # the log coordinate is durable; the apiserver stops
+        # redirecting the instant the guard flips.
+        self.store.wal_term = self.term
+        self.store.writes_blocked = None
+        log.info("%s: leader for term %d at rev %d",
+                 self.node_id, self.term, self.last_rev)
+        self._kick.set()
+        # A lone replica (or a full-quorum singleton round) commits on
+        # its own vote; with peers the first append round advances it.
+        self._advance_commit()
+
+    def _step_down(self, term: int, leader: Optional[str] = None) -> None:
+        if term > self.term:
+            self._set_term(term, voted_for="")
+        was = self.state
+        self.state = FOLLOWER
+        self.leader_id = leader
+        self.store.writes_blocked = NOT_LEADER
+        if was == LEADER:
+            REPL_ELECTIONS.inc(node=self.node_id, outcome="stepped_down")
+            log.warning("%s: stepped down in term %d", self.node_id, term)
+            self._fail_waiters(
+                "leadership lost before the write reached quorum; the "
+                "write may or may not survive — retry against the new "
+                "leader")
+
+    # -- leader replication rounds ----------------------------------------
+
+    async def _lead_round(self) -> None:
+        peers = self.transport.peer_ids(exclude=self.node_id)
+        if peers:
+            await asyncio.gather(*(self._append_to(p) for p in peers))
+        if self.state == LEADER:
+            self._advance_commit()
+
+    async def _append_to(self, peer: str) -> None:
+        try:
+            await self._append_to_inner(peer)
+        except (ReplError, asyncio.TimeoutError) as e:
+            log.debug("%s: append to %s failed: %s", self.node_id, peer, e)
+
+    async def _append_to_inner(self, peer: str) -> None:
+        with self._buf_lock:
+            last_rev = self.last_rev
+            nxt = self._next_rev.get(peer, last_rev + 1)
+            missing = [r for r in range(nxt, last_rev + 1)
+                       if r not in self._entries]
+            entries = ([] if missing else
+                       [self._entries[r].to_wire()
+                        for r in range(nxt, last_rev + 1)])
+        if missing and nxt <= last_rev:
+            await self._install_snapshot(peer)
+            return
+        prev_rev = nxt - 1
+        prev_term = self._term_at(prev_rev)
+        if prev_term is None:
+            await self._install_snapshot(peer)
+            return
+        msg = {"type": "append", "term": self.term, "leader": self.node_id,
+               "prev_rev": prev_rev, "prev_term": prev_term,
+               "entries": entries, "commit_rev": self.commit_rev}
+        resp = await asyncio.wait_for(
+            self.transport.call(self.node_id, peer, msg),
+            self.election_timeout)
+        if self.state != LEADER:
+            return
+        if resp.get("term", 0) > self.term:
+            self._step_down(resp["term"])
+            return
+        if resp.get("ok"):
+            self._match_rev[peer] = max(self._match_rev.get(peer, 0),
+                                        prev_rev + len(entries))
+            self._next_rev[peer] = self._match_rev[peer] + 1
+            return
+        if resp.get("conflict"):
+            await self._install_snapshot(peer)
+            return
+        follower_last = resp.get("last_rev", 0)
+        if follower_last < prev_rev:
+            # Follower is behind the probe point: back up — but only if
+            # its log tail verifiably matches ours there.
+            t = self._term_at(follower_last)
+            if t is None or t != resp.get("last_term", 0):
+                await self._install_snapshot(peer)
+            else:
+                self._next_rev[peer] = follower_last + 1
+        else:
+            # ok=False with a log at/ahead of the probe: unverifiable.
+            await self._install_snapshot(peer)
+
+    async def _install_snapshot(self, peer: str) -> None:
+        with self._buf_lock:
+            last_rev, last_term = self.last_rev, self.last_term
+        msg = {"type": "snapshot", "term": self.term,
+               "leader": self.node_id, "state": self.store.state(),
+               "last_term": last_term, "commit_rev": self.commit_rev}
+        REPL_SNAPSHOT_INSTALLS.inc(node=peer)
+        resp = await asyncio.wait_for(
+            self.transport.call(self.node_id, peer, msg),
+            max(1.0, self.election_timeout))
+        if self.state != LEADER:
+            return
+        if resp.get("term", 0) > self.term:
+            self._step_down(resp["term"])
+            return
+        if resp.get("ok"):
+            self._match_rev[peer] = resp.get("last_rev", last_rev)
+            self._next_rev[peer] = self._match_rev[peer] + 1
+
+    def _advance_commit(self) -> None:
+        if self.state != LEADER:
+            return
+        # Quorum over the REGISTERED membership, not just peers that
+        # have acked something: a freshly joined replica widens the
+        # cluster the instant it registers (its match defaults to 0),
+        # so the majority can never be computed over a stale, smaller
+        # cluster.
+        peers = self.transport.peer_ids(exclude=self.node_id)
+        with self._buf_lock:
+            revs = sorted([self.last_rev]
+                          + [self._match_rev.get(p, 0) for p in peers],
+                          reverse=True)
+        candidate = revs[len(revs) // 2]
+        if candidate <= self.commit_rev:
+            return
+        # Raft's commit restriction: only a CURRENT-term entry advances
+        # the commit index directly (older entries ride along). The
+        # shared term-0 boot base is committed by construction.
+        t = self._term_at(candidate)
+        if candidate > self._base_rev and t != self.term:
+            return
+        self._set_commit(candidate)
+
+    def _set_commit(self, rev: int) -> None:
+        prev = self.commit_rev
+        self.commit_rev = rev
+        REPL_COMMIT_REV.set(float(rev), node=self.node_id)
+        if invariants.SANITIZER is not None:
+            for r in range(prev + 1, rev + 1):
+                e = self._entries.get(r)
+                if e is not None:
+                    invariants.note_commit(self.group, e.rev, e.op, e.key,
+                                           e.value)
+        if self._commit_waiters:
+            still = []
+            for want, fut in self._commit_waiters:
+                if want <= rev:
+                    if not fut.done():
+                        fut.set_result(None)
+                else:
+                    still.append((want, fut))
+            self._commit_waiters = still
+
+    def _fail_waiters(self, reason: str) -> None:
+        waiters, self._commit_waiters = self._commit_waiters, []
+        for _want, fut in waiters:
+            if not fut.done():
+                fut.set_exception(errors.ServiceUnavailableError(reason))
+
+    async def wait_commit(self, rev: int) -> None:
+        """Block until revision ``rev`` is quorum-committed — the ack
+        gate ``Registry.run`` awaits before a write returns to its
+        client. Raises ServiceUnavailable when leadership is lost or
+        quorum stays unreachable past ``commit_timeout``: the write's
+        fate is then genuinely unknown and the client must resolve it
+        by reading (or by the AlreadyExists of its retry)."""
+        if rev <= self.commit_rev:
+            return
+        if not self.is_leader:
+            raise errors.ServiceUnavailableError(NOT_LEADER)
+        fut = asyncio.get_running_loop().create_future()
+        self._commit_waiters.append((rev, fut))
+        self._kick.set()
+        try:
+            await asyncio.wait_for(fut, self.commit_timeout)
+        except asyncio.TimeoutError:
+            self._commit_waiters = [(w, f) for w, f in self._commit_waiters
+                                    if f is not fut]
+            raise errors.ServiceUnavailableError(
+                f"write at revision {rev} did not reach quorum within "
+                f"{self.commit_timeout}s") from None
+
+    # -- follower handlers ------------------------------------------------
+
+    async def handle(self, src: str, msg: dict) -> dict:
+        interleave.touch(f"repl:{self.node_id}")
+        mtype = msg.get("type")
+        if mtype == "append":
+            return self._handle_append(msg)
+        if mtype == "vote":
+            return self._handle_vote(msg)
+        if mtype == "snapshot":
+            return self._handle_snapshot(msg)
+        raise ReplError(f"unknown replication message type {mtype!r}")
+
+    def _observe_leader(self, msg: dict) -> None:
+        if msg["term"] > self.term or self.state != FOLLOWER:
+            self._step_down(msg["term"], leader=msg["leader"])
+        self.leader_id = msg["leader"]
+        self._hb_seen.set()
+
+    def _handle_append(self, msg: dict) -> dict:
+        if msg["term"] < self.term:
+            return {"term": self.term, "ok": False, "stale": True}
+        self._observe_leader(msg)
+        with self._buf_lock:
+            last_rev, last_term = self.last_rev, self.last_term
+        if msg["prev_rev"] > last_rev:
+            return {"term": self.term, "ok": False,
+                    "last_rev": last_rev, "last_term": last_term}
+        t = self._term_at(msg["prev_rev"])
+        if t is None or t != msg["prev_term"]:
+            return {"term": self.term, "ok": False, "conflict": True,
+                    "last_rev": last_rev}
+        for wire in msg["entries"]:
+            e = LogEntry.from_wire(wire)
+            if e.rev <= last_rev:
+                # Overlap: already have it — but a TERM mismatch there
+                # means our tail diverged (we were the minority holder
+                # of an uncommitted entry) and must be rebuilt.
+                mine = self._term_at(e.rev)
+                if mine is not None and mine != e.term:
+                    return {"term": self.term, "ok": False,
+                            "conflict": True, "last_rev": last_rev}
+                continue
+            try:
+                self.store.apply_replicated(e.op, e.key, e.value, e.rev,
+                                            term=e.term)
+            except errors.StatusError as e2:
+                # This replica's own WAL died (chaos): it is crash-only
+                # from here — stop participating, peers re-replicate.
+                log.error("%s: apply of rev %d failed (%s); replica is "
+                          "down until rebuilt", self.node_id, e.rev, e2)
+                self.crash()
+                raise ReplError(f"{self.node_id}: apply failed") from e2
+            with self._buf_lock:
+                self._entries[e.rev] = e
+                self.last_rev, self.last_term = e.rev, e.term
+                self._trim_buffer()
+            last_rev = e.rev
+        commit = min(msg.get("commit_rev", 0), last_rev)
+        if commit > self.commit_rev:
+            self._set_commit(commit)
+        return {"term": self.term, "ok": True, "last_rev": last_rev}
+
+    def _handle_vote(self, msg: dict) -> dict:
+        if msg["term"] < self.term:
+            return {"term": self.term, "granted": False}
+        if msg["term"] > self.term:
+            self._step_down(msg["term"])
+        with self._buf_lock:
+            mine = (self.last_term, self.last_rev)
+        up_to_date = (msg["last_term"], msg["last_rev"]) >= mine
+        if up_to_date and self.voted_for in ("", msg["candidate"]):
+            self._set_term(self.term, voted_for=msg["candidate"])
+            # Granting a vote defers our own campaign a full timeout —
+            # without this, simultaneous timeouts livelock elections.
+            self._hb_seen.set()
+            return {"term": self.term, "granted": True}
+        return {"term": self.term, "granted": False}
+
+    def _handle_snapshot(self, msg: dict) -> dict:
+        if msg["term"] < self.term:
+            return {"term": self.term, "ok": False, "stale": True}
+        self._observe_leader(msg)
+        state = msg["state"]
+        self.store.reset_from_state(state, term=msg["last_term"])
+        with self._buf_lock:
+            self._entries.clear()
+            self.last_rev = state["rev"]
+            self.last_term = msg["last_term"]
+            self._base_rev = state["rev"]
+            self._base_term = msg["last_term"]
+        commit = min(msg.get("commit_rev", state["rev"]), state["rev"])
+        if commit > self.commit_rev:
+            self.commit_rev = commit
+            REPL_COMMIT_REV.set(float(commit), node=self.node_id)
+        log.info("%s: installed snapshot at rev %d (term %d)",
+                 self.node_id, state["rev"], msg["term"])
+        return {"term": self.term, "ok": True, "last_rev": self.last_rev}
+
+    # -- introspection ----------------------------------------------------
+
+    def status(self) -> dict:
+        """The /ha/v1/status payload (and the failover harness's
+        time-to-new-leader probe)."""
+        return {"node": self.node_id, "state": self.state,
+                "term": self.term, "leader": self.leader_id or "",
+                "leader_url": self.leader_hint(),
+                "commit_rev": self.commit_rev, "last_rev": self.last_rev,
+                "crashed": self.crashed}
+
+
+async def wait_for_leader(nodes: list, timeout: float = 5.0) -> ReplicaNode:
+    """Poll until exactly one live node leads; returns it."""
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + timeout
+    while True:
+        leaders = [n for n in nodes if n.is_leader]
+        if leaders:
+            return leaders[0]
+        if loop.time() > deadline:
+            raise TimeoutError(
+                f"no leader elected within {timeout}s: "
+                f"{[n.status() for n in nodes]}")
+        await asyncio.sleep(0.01)
+
+
+async def wait_converged(nodes: list, timeout: float = 5.0) -> int:
+    """Wait until every live node's store reached the leader's
+    revision; returns that revision. Call with writes quiesced."""
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + timeout
+    while True:
+        live = [n for n in nodes if not n.crashed]
+        target = max(n.store.revision for n in live)
+        if all(n.store.revision >= target for n in live):
+            return target
+        if loop.time() > deadline:
+            raise TimeoutError(
+                f"replicas did not converge to rev {target} within "
+                f"{timeout}s: {[n.status() for n in nodes]}")
+        await asyncio.sleep(0.01)

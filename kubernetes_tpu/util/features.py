@@ -74,6 +74,12 @@ KNOWN_FEATURES = {f.name: f for f in [
             "borrowing with gang-aware reclaim, and backfill "
             "(queueing/ + controllers/queue.py); off = PodGroups "
             "race straight into the scheduling queue as before"),
+    Feature("SchedulerLeaderElection", False, ALPHA,
+            "active-standby scheduler: N scheduler processes elect one "
+            "active instance via a Lease (scheduler.ElectedScheduler); "
+            "standbys keep informers warm and take over on leader "
+            "stop/crash — two schedulers can never double-bind. Off = "
+            "the scheduler runs unconditionally, as before"),
     Feature("GracefulPreemption", False, ALPHA,
             "checkpoint-aware gang preemption (preemption.py): signal "
             "the gang (SIGTERM + KTPU_PREEMPT file), wait bounded by "

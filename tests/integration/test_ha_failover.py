@@ -1,0 +1,179 @@
+"""Replicated control plane over real HTTP: follower read/redirect
+semantics, the no-leader window, client failover + watch resume across
+a leader kill, redirect-loop safety, and the full kill-the-leader
+convergence scenario (chaos/ha_harness.py)."""
+import asyncio
+import json
+import tempfile
+
+import pytest
+from aiohttp import web
+
+from kubernetes_tpu.api import errors, types as t
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.chaos.ha_harness import HAPlane, run_ha_smoke
+from kubernetes_tpu.client.informer import SharedInformer
+from kubernetes_tpu.client.rest import CLIENT_REDIRECTS, RESTClient
+from kubernetes_tpu.storage import replication as repl
+
+
+async def _mk_plane(tmp, replicas=3):
+    plane = HAPlane(str(tmp), replicas=replicas, seed=3,
+                    election_timeout=0.1, heartbeat_interval=0.02)
+    await plane.start()
+    leader = await plane.leader_member(timeout=10.0)
+    # Seed through the leader's registry: acked at quorum via run().
+    await leader.registry.run(
+        leader.registry.create,
+        t.Namespace(metadata=ObjectMeta(name="default")))
+    return plane, leader
+
+
+async def test_follower_serves_reads_redirects_writes(tmp_path):
+    plane, leader = await _mk_plane(tmp_path)
+    try:
+        follower = next(m for m in plane.members
+                        if not m.node.is_leader)
+        fclient = RESTClient(f"http://127.0.0.1:{follower.port}")
+        fclient.backoff_base = 0.02
+        # Reads serve from the follower's local store.
+        items, rev = await fclient.list("namespaces")
+        assert any(n.metadata.name == "default" for n in items)
+        # A write through the follower follows the 307 leader hint —
+        # and re-pins the client to the leader's origin.
+        before = CLIENT_REDIRECTS.value(verb="POST")
+        await fclient.create(t.ConfigMap(metadata=ObjectMeta(
+            name="via-follower", namespace="default")))
+        assert CLIENT_REDIRECTS.value(verb="POST") > before
+        assert fclient.base_url == leader.node.advertise_url
+        await repl.wait_converged([m.node for m in plane.members], 5.0)
+        # The write landed everywhere (quorum ack), follower included.
+        assert follower.store.exists(
+            "/registry/configmaps/default/via-follower")
+        # /ha/v1/status tells the truth on both roles.
+        status = await fclient._request(
+            "GET", f"{fclient.base_url}/ha/v1/status")
+        assert status["replicated"] and status["state"] == "Leader"
+        await fclient.close()
+    finally:
+        await plane.stop()
+
+
+async def test_no_leader_window_returns_503_retry_after(tmp_path):
+    """2 replicas, leader killed: the survivor cannot reach quorum, so
+    writes answer 503 + Retry-After + the no-leader marker while reads
+    keep serving."""
+    plane, leader = await _mk_plane(tmp_path, replicas=2)
+    try:
+        survivor = next(m for m in plane.members if m is not leader)
+        await leader.crash()
+        await asyncio.sleep(0.3)  # past the election timeout: no quorum
+        import aiohttp
+        async with aiohttp.ClientSession() as s:
+            url = (f"http://127.0.0.1:{survivor.port}"
+                   f"/api/core/v1/namespaces/default/configmaps")
+            async with s.post(url, json={"metadata": {"name": "x"}},
+                              allow_redirects=False) as resp:
+                assert resp.status == 503
+                assert resp.headers.get("Retry-After")
+                assert resp.headers.get("X-Ktpu-No-Leader") == "1"
+            async with s.get(url) as resp:
+                assert resp.status == 200  # reads stay up
+    finally:
+        await plane.stop()
+
+
+async def test_client_fails_over_and_watch_resumes(tmp_path):
+    """An informer through the multi-endpoint client rides a leader
+    kill: its watch dies with the endpoint, the relist+watch recovery
+    lands on a survivor, and no object is permanently missed."""
+    plane, leader = await _mk_plane(tmp_path)
+    try:
+        client = RESTClient(plane.endpoints())
+        client.backoff_base = 0.02
+        informer = SharedInformer(client, "configmaps",
+                                  namespace="default")
+        informer.start()
+        await informer.wait_for_sync()
+        for i in range(5):
+            await client.create(t.ConfigMap(metadata=ObjectMeta(
+                name=f"pre-{i}", namespace="default")))
+        await leader.crash()
+        survivors = [m for m in plane.members if m is not leader]
+        await repl.wait_for_leader([m.node for m in survivors], 10.0)
+
+        async def write_post():
+            for i in range(5):
+                while True:
+                    try:
+                        await client.create(t.ConfigMap(
+                            metadata=ObjectMeta(name=f"post-{i}",
+                                                namespace="default")))
+                        break
+                    except errors.StatusError:
+                        await asyncio.sleep(0.05)
+        await asyncio.wait_for(write_post(), 20.0)
+
+        async def informer_sees_all():
+            want = {f"pre-{i}" for i in range(5)} \
+                | {f"post-{i}" for i in range(5)}
+            while True:
+                have = {cm.metadata.name for cm in informer.list()}
+                if want <= have:
+                    return
+                await asyncio.sleep(0.05)
+        await asyncio.wait_for(informer_sees_all(), 20.0)
+        await informer.stop()
+        await client.close()
+    finally:
+        await plane.stop()
+
+
+async def test_redirect_loop_backs_off_never_hot_loops():
+    """Repeated 307-to-stale-leader is a backoff-able condition: the
+    client follows a bounded number of hops with capped-exponential
+    sleeps between them, then surfaces 503 — never a hot loop."""
+    hops = []
+
+    async def stale_leader(request):
+        hops.append(asyncio.get_running_loop().time())
+        return web.Response(status=307, headers={
+            "Location": str(request.url)})  # points back at itself
+
+    app = web.Application()
+    app.router.add_post("/api/core/v1/namespaces/default/configmaps",
+                        stale_leader)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    client = RESTClient(f"http://127.0.0.1:{port}")
+    client.backoff_base = 0.01
+    client.max_redirects = 4
+    try:
+        t0 = asyncio.get_running_loop().time()
+        with pytest.raises(errors.ServiceUnavailableError, match="redirect"):
+            await client.create(t.ConfigMap(metadata=ObjectMeta(
+                name="x", namespace="default")))
+        elapsed = asyncio.get_running_loop().time() - t0
+        assert len(hops) == client.max_redirects + 1
+        # Hops 2..N slept at least half the (doubling) backoff base.
+        assert elapsed >= 0.01 * (0.5 + 1.0 + 2.0) * 0.9
+        # client_redirect_total moved (tpuvet metric fixture family).
+        assert CLIENT_REDIRECTS.value(verb="POST") >= len(hops)
+    finally:
+        await client.close()
+        await runner.cleanup()
+
+
+async def test_kill_the_leader_smoke_converges():
+    """The acceptance scenario end to end (small config): leader
+    crashed mid-wave, zero acked writes lost, survivors byte-identical
+    and replay-identical."""
+    report = await run_ha_smoke(1234, n_nodes=2, gangs=2, timeout=30.0)
+    assert report["acked_lost"] == 0
+    assert report["replicas_identical"] and report["replay_identical"]
+    assert report["new_leader"] != report["killed"]
+    assert report["pods_bound"] == 4
+    assert report["time_to_new_leader_s"] > 0

@@ -1,0 +1,135 @@
+"""LeaderElector graceful release: a cancelled (gracefully stopped)
+leader CAS-es the Lease holder back to empty so a standby takes over
+within its retry period — versus the crash path, where the standby
+must wait out the full lease_duration."""
+import asyncio
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.apiserver.registry import Registry
+from kubernetes_tpu.client.leaderelection import LeaderElector
+from kubernetes_tpu.client.local import LocalClient
+
+
+def _setup():
+    reg = Registry()
+    reg.create(t.Namespace(metadata=ObjectMeta(name="kube-system")))
+    return LocalClient(reg)
+
+
+def _elector(client, ident, lease_duration=2.0):
+    return LeaderElector(client, "sched", ident,
+                         lease_duration=lease_duration,
+                         renew_deadline=0.5, retry_period=0.1)
+
+
+async def _idle():
+    await asyncio.sleep(60)
+
+
+async def _wait_leader(elector, timeout):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while not elector.is_leader:
+        if asyncio.get_running_loop().time() > deadline:
+            raise AssertionError(f"{elector.identity} never led")
+        await asyncio.sleep(0.02)
+
+
+async def test_graceful_stop_hands_off_fast():
+    client = _setup()
+    e1, e2 = _elector(client, "alpha"), _elector(client, "beta")
+    t1 = asyncio.create_task(e1.run(_idle))
+    await _wait_leader(e1, 2.0)
+    t2 = asyncio.create_task(e2.run(_idle))
+    await asyncio.sleep(0.2)
+    assert not e2.is_leader
+
+    # Graceful stop: cancellation releases the lease; the standby must
+    # take over well within lease_duration (2s) — a few retry ticks.
+    t0 = asyncio.get_running_loop().time()
+    t1.cancel()
+    try:
+        await t1
+    except asyncio.CancelledError:
+        pass
+    lease = await client.get("leases", "kube-system", "sched")
+    # Released (or already taken by the standby) — never still alpha's.
+    assert lease.spec.holder_identity in ("", "beta")
+    await _wait_leader(e2, 1.0)
+    assert asyncio.get_running_loop().time() - t0 < 1.0
+    t2.cancel()
+    try:
+        await t2
+    except asyncio.CancelledError:
+        pass
+
+
+async def test_crash_handoff_waits_out_the_lease(monkeypatch):
+    client = _setup()
+    e1, e2 = (_elector(client, "alpha", lease_duration=1.2),
+              _elector(client, "beta", lease_duration=1.2))
+    t1 = asyncio.create_task(e1.run(_idle))
+    await _wait_leader(e1, 2.0)
+
+    # A crash never runs release(): simulate by making it a no-op.
+    async def no_release():
+        pass
+    monkeypatch.setattr(e1, "release", no_release)
+    t2 = asyncio.create_task(e2.run(_idle))
+    t1.cancel()
+    try:
+        await t1
+    except asyncio.CancelledError:
+        pass
+    # Standby is still locked out while the stale lease lives...
+    await asyncio.sleep(0.5)
+    assert not e2.is_leader
+    # ...and takes over only after expiry.
+    await _wait_leader(e2, 2.0)
+    t2.cancel()
+    try:
+        await t2
+    except asyncio.CancelledError:
+        pass
+
+
+async def test_crashed_payload_ends_leadership_and_releases():
+    """Regression (review find): a payload that CRASHES must end
+    leadership and release the Lease — not leave a zombie leader
+    renewing a lease it does nothing with while standbys starve."""
+    client = _setup()
+    e1, e2 = _elector(client, "alpha"), _elector(client, "beta")
+
+    async def crashing_payload():
+        await asyncio.sleep(0.1)
+        raise RuntimeError("payload died")
+
+    t1 = asyncio.create_task(e1.run(crashing_payload))
+    await _wait_leader(e1, 2.0)
+    t2 = asyncio.create_task(e2.run(_idle))
+    # The crash ends e1's run() entirely (lease released on the way
+    # out) and the standby takes over fast — not after lease expiry.
+    await asyncio.wait_for(t1, 2.0)
+    assert not e1.is_leader
+    await _wait_leader(e2, 1.0)
+    t2.cancel()
+    try:
+        await t2
+    except asyncio.CancelledError:
+        pass
+
+
+async def test_release_is_a_noop_for_non_holders():
+    client = _setup()
+    e1, e2 = _elector(client, "alpha"), _elector(client, "beta")
+    t1 = asyncio.create_task(e1.run(_idle))
+    await _wait_leader(e1, 2.0)
+    # A standby releasing does not touch the leader's lease.
+    await e2.release()
+    lease = await client.get("leases", "kube-system", "sched")
+    assert lease.spec.holder_identity == "alpha"
+    t1.cancel()
+    try:
+        await t1
+    except asyncio.CancelledError:
+        pass

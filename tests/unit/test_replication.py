@@ -1,0 +1,404 @@
+"""Raft-lite replication (storage/replication.py): elections, quorum
+commit, follower apply, divergence recovery, determinism, and the MVCC
+seams it rides (apply_replicated / writes_blocked / watch filtering)."""
+import asyncio
+import json
+import os
+
+import pytest
+
+from kubernetes_tpu.api import errors
+from kubernetes_tpu.chaos import core as chaos
+from kubernetes_tpu.storage import replication as repl
+from kubernetes_tpu.storage.mvcc import ADDED, DELETED, MODIFIED, MVCCStore
+
+
+def _state(store) -> str:
+    return json.dumps(store.state(), sort_keys=True)
+
+
+async def _cluster(n=3, seed=42, data_dirs=None, election_timeout=0.08,
+                   heartbeat_interval=0.02):
+    tr = repl.LocalTransport()
+    nodes = []
+    for i in range(n):
+        store = MVCCStore(data_dirs[i] if data_dirs else None)
+        node = repl.ReplicaNode(
+            f"n{i}", store, tr, seed=seed,
+            heartbeat_interval=heartbeat_interval,
+            election_timeout=election_timeout)
+        nodes.append(node)
+    for node in nodes:
+        await node.start()
+    return tr, nodes
+
+
+async def _teardown(nodes):
+    for n in nodes:
+        if not n.crashed:
+            await n.stop()
+
+
+async def test_exactly_one_leader_elected():
+    _tr, nodes = await _cluster()
+    try:
+        leader = await repl.wait_for_leader(nodes, 5.0)
+        await asyncio.sleep(0.3)  # several heartbeat rounds
+        leaders = [n for n in nodes if n.is_leader]
+        assert leaders == [leader]
+        assert all(n.leader_id == leader.node_id for n in nodes
+                   if not n.crashed)
+    finally:
+        await _teardown(nodes)
+
+
+async def test_quorum_commit_and_follower_apply():
+    _tr, nodes = await _cluster()
+    try:
+        leader = await repl.wait_for_leader(nodes, 5.0)
+        revs = []
+        for i in range(10):
+            revs.append(leader.store.create(
+                f"/registry/configmaps/default/cm-{i}", {"v": i}))
+        await leader.wait_commit(revs[-1])
+        assert leader.commit_rev >= revs[-1]
+        await repl.wait_converged(nodes, 5.0)
+        s = [_state(n.store) for n in nodes]
+        assert s[0] == s[1] == s[2]
+        # Followers see updates and deletes identically, and
+        # create_revision survives the replicated apply.
+        leader.store.update("/registry/configmaps/default/cm-0", {"v": 99})
+        rev = leader.store.delete("/registry/configmaps/default/cm-1")
+        await leader.wait_commit(rev)
+        await repl.wait_converged(nodes, 5.0)
+        for n in nodes:
+            obj = n.store.get("/registry/configmaps/default/cm-0")
+            assert obj.value == {"v": 99}
+            assert obj.create_revision == revs[0]
+            assert not n.store.exists("/registry/configmaps/default/cm-1")
+        assert _state(nodes[0].store) == _state(nodes[1].store) \
+            == _state(nodes[2].store)
+    finally:
+        await _teardown(nodes)
+
+
+async def test_follower_write_guard():
+    _tr, nodes = await _cluster()
+    try:
+        leader = await repl.wait_for_leader(nodes, 5.0)
+        follower = next(n for n in nodes if n is not leader)
+        with pytest.raises(errors.ServiceUnavailableError):
+            follower.store.create("/registry/configmaps/default/x", {})
+        with pytest.raises(errors.ServiceUnavailableError):
+            follower.store.delete("/registry/configmaps/default/x")
+    finally:
+        await _teardown(nodes)
+
+
+async def test_kill_leader_elects_survivor_no_acked_loss():
+    _tr, nodes = await _cluster()
+    try:
+        leader = await repl.wait_for_leader(nodes, 5.0)
+        acked = []
+        for i in range(5):
+            rev = leader.store.create(
+                f"/registry/configmaps/default/a-{i}", {"v": i})
+            await leader.wait_commit(rev)
+            acked.append(f"/registry/configmaps/default/a-{i}")
+        leader.crash()
+        survivors = [n for n in nodes if n is not leader]
+        new_leader = await repl.wait_for_leader(survivors, 5.0)
+        assert new_leader is not leader
+        assert new_leader.term > leader.term or new_leader.term == leader.term
+        # A current-term write re-opens the commit path, then every
+        # acked pre-crash write must be present on both survivors.
+        rev = new_leader.store.create(
+            "/registry/configmaps/default/post", {})
+        await new_leader.wait_commit(rev)
+        await repl.wait_converged(survivors, 5.0)
+        for n in survivors:
+            for key in acked:
+                assert n.store.exists(key), f"{n.node_id} lost {key}"
+        assert _state(survivors[0].store) == _state(survivors[1].store)
+    finally:
+        await _teardown(nodes)
+
+
+async def test_no_quorum_write_fails_with_503():
+    _tr, nodes = await _cluster()
+    try:
+        leader = await repl.wait_for_leader(nodes, 5.0)
+        leader.commit_timeout = 0.3
+        for n in nodes:
+            if n is not leader:
+                n.crash()
+        rev = leader.store.create("/registry/configmaps/default/solo", {})
+        with pytest.raises(errors.ServiceUnavailableError):
+            await leader.wait_commit(rev)
+    finally:
+        await _teardown(nodes)
+
+
+async def test_crashed_node_rejoins_and_catches_up(tmp_path):
+    dirs = [str(tmp_path / f"n{i}") for i in range(3)]
+    tr, nodes = await _cluster(data_dirs=dirs)
+    try:
+        leader = await repl.wait_for_leader(nodes, 5.0)
+        for i in range(5):
+            rev = leader.store.create(
+                f"/registry/configmaps/default/pre-{i}", {"v": i})
+        await leader.wait_commit(rev)
+        victim = next(n for n in nodes if n is not leader)
+        victim.crash()
+        for i in range(5, 10):
+            rev = leader.store.create(
+                f"/registry/configmaps/default/pre-{i}", {"v": i})
+        await leader.wait_commit(rev)
+        # Restart the victim from its own WAL; it must catch up.
+        store = MVCCStore(dirs[nodes.index(victim)])
+        fresh = repl.ReplicaNode(victim.node_id, store, tr, seed=42,
+                                 heartbeat_interval=0.02,
+                                 election_timeout=0.08)
+        await fresh.start()
+        live = [n for n in nodes if n is not victim] + [fresh]
+        await repl.wait_converged(live, 5.0)
+        assert _state(fresh.store) == _state(leader.store)
+        nodes[nodes.index(victim)] = fresh
+    finally:
+        await _teardown(nodes)
+
+
+async def test_diverged_ex_leader_gets_snapshot_install():
+    """A crashed ex-leader holding applied-but-UNCOMMITTED entries must
+    be rebuilt by snapshot, not merge its phantom writes back in."""
+    tr, nodes = await _cluster()
+    try:
+        leader = await repl.wait_for_leader(nodes, 5.0)
+        rev = leader.store.create("/registry/configmaps/default/base", {})
+        await leader.wait_commit(rev)
+        await repl.wait_converged(nodes, 5.0)
+        # Cut the leader off, then let it apply a local write that can
+        # never commit (the phantom).
+        tr.partition(leader.node_id, 60.0)
+        leader.store.create("/registry/configmaps/default/phantom", {})
+        survivors = [n for n in nodes if n is not leader]
+        new_leader = await repl.wait_for_leader(
+            [n for n in survivors if n.is_leader] or survivors, 5.0)
+        assert new_leader is not leader
+        rev = new_leader.store.create(
+            "/registry/configmaps/default/won", {"v": 1})
+        await new_leader.wait_commit(rev)
+        # Heal the partition: the ex-leader steps down, conflicts on
+        # its divergent tail, and is snapshot-installed.
+        tr._partitioned.pop(leader.node_id, None)
+        await repl.wait_converged(nodes, 5.0)
+        await asyncio.sleep(0.2)
+        assert not leader.is_leader
+        assert not leader.store.exists(
+            "/registry/configmaps/default/phantom"), \
+            "uncommitted phantom write survived divergence recovery"
+        assert leader.store.exists("/registry/configmaps/default/won")
+        assert _state(leader.store) == _state(new_leader.store)
+    finally:
+        await _teardown(nodes)
+
+
+async def test_recovered_replica_keeps_its_log_term(tmp_path):
+    """Regression (review find): log-entry terms ride the WAL and the
+    snapshot, so a restarted replica resumes its TRUE (last_term,
+    last_rev) coordinate. Without this it would claim term 0 for its
+    whole recovered log and vote for a candidate with an older,
+    shorter log — electing away quorum-committed writes."""
+    dirs = [str(tmp_path / f"n{i}") for i in range(3)]
+    tr, nodes = await _cluster(data_dirs=dirs)
+    try:
+        leader = await repl.wait_for_leader(nodes, 5.0)
+        rev = 0
+        for i in range(5):
+            rev = leader.store.create(
+                f"/registry/configmaps/default/t-{i}", {"v": i})
+        await leader.wait_commit(rev)
+        await repl.wait_converged(nodes, 5.0)
+        victim = next(n for n in nodes if n is not leader)
+        want = (victim.last_term, victim.last_rev)
+        assert want[0] >= 1
+        victim.crash()
+        store = MVCCStore(dirs[nodes.index(victim)])
+        fresh = repl.ReplicaNode(victim.node_id, store, tr, seed=42,
+                                 heartbeat_interval=0.02,
+                                 election_timeout=0.08)
+        assert (fresh.last_term, fresh.last_rev) == want
+        # The election restriction holds across the restart: a
+        # same-term candidate with a SHORTER log is refused...
+        resp = fresh._handle_vote(
+            {"type": "vote", "term": fresh.term + 1, "candidate": "x",
+             "last_rev": fresh.last_rev - 1,
+             "last_term": fresh.last_term})
+        assert not resp["granted"]
+        # ...while an at-least-as-complete one gets the vote.
+        resp = fresh._handle_vote(
+            {"type": "vote", "term": fresh.term + 1, "candidate": "y",
+             "last_rev": fresh.last_rev, "last_term": fresh.last_term})
+        assert resp["granted"]
+        store.close()
+        nodes[nodes.index(victim)] = fresh
+        fresh.crashed = True  # never started; skip stop()
+    finally:
+        await _teardown(nodes)
+
+
+async def test_election_timeouts_are_seeded_deterministic():
+    tr = repl.LocalTransport()
+    a1 = repl.ReplicaNode("a", MVCCStore(), tr, seed=7)
+    seq1 = [a1.next_election_timeout() for _ in range(10)]
+    tr2 = repl.LocalTransport()
+    a2 = repl.ReplicaNode("a", MVCCStore(), tr2, seed=7)
+    seq2 = [a2.next_election_timeout() for _ in range(10)]
+    assert seq1 == seq2
+    b = repl.ReplicaNode("b", MVCCStore(), tr2, seed=7)
+    assert [b.next_election_timeout() for _ in range(10)] != seq1
+    a3 = repl.ReplicaNode("a", MVCCStore(), repl.LocalTransport(), seed=8)
+    assert [a3.next_election_timeout() for _ in range(10)] != seq1
+
+
+async def test_term_and_vote_are_durable(tmp_path):
+    store = MVCCStore(str(tmp_path / "n0"))
+    tr = repl.LocalTransport()
+    node = repl.ReplicaNode("n0", store, tr, seed=1)
+    node._set_term(7, voted_for="other")
+    store.close()
+    store2 = MVCCStore(str(tmp_path / "n0"))
+    node2 = repl.ReplicaNode("n0", store2, repl.LocalTransport(), seed=1)
+    assert node2.term == 7
+    assert node2.voted_for == "other"
+    store2.close()
+
+
+async def test_chaos_repl_drop_still_converges():
+    chaos.arm(chaos.ChaosController(5, (
+        chaos.FaultSpec(chaos.SITE_REPL, "drop", prob=0.2),)))
+    try:
+        _tr, nodes = await _cluster()
+        leader = await repl.wait_for_leader(nodes, 10.0)
+        for i in range(10):
+            rev = leader.store.create(
+                f"/registry/configmaps/default/d-{i}", {"v": i})
+            await leader.wait_commit(rev)
+        await repl.wait_converged(nodes, 10.0)
+        assert _state(nodes[0].store) == _state(nodes[1].store) \
+            == _state(nodes[2].store)
+        assert any(f.site == chaos.SITE_REPL
+                   for f in chaos.CONTROLLER.injected)
+    finally:
+        chaos.disarm()
+        await _teardown(nodes)
+
+
+async def test_chaos_repl_partition_heals():
+    """A chaos-injected partition isolates one replica; after it lifts
+    the replica catches back up."""
+    _tr, nodes = await _cluster()
+    try:
+        leader = await repl.wait_for_leader(nodes, 5.0)
+        follower = next(n for n in nodes if n is not leader)
+        chaos.arm(chaos.ChaosController(5, ()))
+        chaos.CONTROLLER.trigger(chaos.SITE_REPL, "partition", 0.3)
+        rev = leader.store.create("/registry/configmaps/default/p", {})
+        await leader.wait_commit(rev)  # quorum = leader + other follower
+        await asyncio.sleep(0.5)  # partition expires
+        await repl.wait_converged(nodes, 5.0)
+        assert follower.store.exists("/registry/configmaps/default/p")
+    finally:
+        chaos.disarm()
+        await _teardown(nodes)
+
+
+# -- MVCC seams -------------------------------------------------------------
+
+
+def test_apply_replicated_idempotent_and_contiguous():
+    store = MVCCStore()
+    assert store.apply_replicated(ADDED, "/registry/configmaps/d/a",
+                                  {"v": 1}, 1)
+    # Resend at or below current rev: no-op, not an error.
+    assert not store.apply_replicated(ADDED, "/registry/configmaps/d/a",
+                                      {"v": 1}, 1)
+    with pytest.raises(ValueError):
+        store.apply_replicated(ADDED, "/registry/configmaps/d/b", {}, 5)
+    store.apply_replicated(MODIFIED, "/registry/configmaps/d/a",
+                           {"v": 2}, 2)
+    obj = store.get("/registry/configmaps/d/a")
+    assert obj.value == {"v": 2}
+    assert obj.create_revision == 1 and obj.mod_revision == 2
+    store.apply_replicated(DELETED, "/registry/configmaps/d/a",
+                           {"v": 2}, 3)
+    assert not store.exists("/registry/configmaps/d/a")
+    assert store.revision == 3
+
+
+def test_apply_replicated_bypasses_write_guard_and_writes_wal(tmp_path):
+    store = MVCCStore(str(tmp_path))
+    store.writes_blocked = "not leader"
+    with pytest.raises(errors.ServiceUnavailableError):
+        store.create("/registry/configmaps/d/x", {})
+    store.apply_replicated(ADDED, "/registry/configmaps/d/x", {"v": 1}, 1)
+    store.fsync_now()
+    store.close()
+    recovered = MVCCStore(str(tmp_path))
+    assert recovered.get("/registry/configmaps/d/x").value == {"v": 1}
+    recovered.close()
+
+
+async def test_replicated_apply_delivers_watch_events():
+    store = MVCCStore()
+    wch = store.watch("/registry/configmaps/")
+    store.apply_replicated(ADDED, "/registry/configmaps/d/a", {"v": 1}, 1)
+    ev = await asyncio.wait_for(wch.next(1.0), 2.0)
+    assert ev.type == ADDED and ev.revision == 1
+    wch.cancel()
+
+
+async def test_watch_filters_already_seen_revisions():
+    """A follower watcher resuming from a revision AHEAD of the local
+    store must not be re-delivered the lagging entries as 'live'."""
+    store = MVCCStore()
+    for rev in (1, 2, 3):
+        store.apply_replicated(ADDED, f"/registry/configmaps/d/c{rev}",
+                               {}, rev)
+    # Client listed at rev 5 elsewhere (the leader) and resumes here.
+    wch = store.watch("/registry/configmaps/", start_revision=5)
+    for rev in (4, 5, 6):
+        store.apply_replicated(ADDED, f"/registry/configmaps/d/c{rev}",
+                               {}, rev)
+    ev = await asyncio.wait_for(wch.next(1.0), 2.0)
+    assert ev.revision == 6, "events <= the resume revision leaked through"
+    wch.cancel()
+
+
+def test_reset_from_state_replaces_contents_and_persists(tmp_path):
+    src = MVCCStore()
+    src.create("/registry/configmaps/d/a", {"v": 1})
+    src.create("/registry/configmaps/d/b", {"v": 2})
+    dst = MVCCStore(str(tmp_path))
+    dst.create("/registry/configmaps/d/stale", {"v": 0})
+    dst.reset_from_state(src.state())
+    assert json.dumps(dst.state(), sort_keys=True) \
+        == json.dumps(src.state(), sort_keys=True)
+    dst.close()
+    replayed = MVCCStore(str(tmp_path))
+    assert json.dumps(replayed.state(), sort_keys=True) \
+        == json.dumps(src.state(), sort_keys=True)
+    replayed.close()
+
+
+def test_reset_from_state_cancels_watches():
+    src = MVCCStore()
+    src.create("/registry/configmaps/d/a", {"v": 1})
+    dst = MVCCStore()
+
+    async def run():
+        wch = dst.watch("/registry/configmaps/")
+        dst.reset_from_state(src.state())
+        ev = await asyncio.wait_for(wch.next(1.0), 2.0)
+        assert ev is None and wch.closed  # stream ended: client relists
+    asyncio.run(run())

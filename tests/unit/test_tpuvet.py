@@ -207,6 +207,27 @@ D = Counter("client_retry_total", "re-registered: silently inert")
     assert len(got) == 1 and "already registered" in got[0].message
 
 
+def test_metric_name_replication_and_redirect_family():
+    """The control-plane replication metric family (replication_*) and
+    the client leader-redirect counter are valid names, and a duplicate
+    registration within the family is still caught."""
+    good = """
+from kubernetes_tpu.metrics.registry import Counter, Gauge
+A = Counter("replication_elections_total", "x", labels=("node", "outcome"))
+B = Counter("replication_messages_total", "x", labels=("type", "result"))
+C = Gauge("replication_commit_revision", "x", labels=("node",))
+D = Gauge("replication_term", "x", labels=("node",))
+E = Counter("replication_snapshot_installs_total", "x", labels=("node",))
+F = Counter("client_redirect_total", "x", labels=("verb",))
+"""
+    assert run_source(good, checks=["metric-name"]) == []
+    bad = good + """
+G = Counter("replication_elections_total", "re-registered: silently inert")
+"""
+    got = run_source(bad, checks=["metric-name"])
+    assert len(got) == 1 and "already registered" in got[0].message
+
+
 def test_metric_name_queueing_family():
     """The job-queueing metric family (queue_*) is valid, and a
     duplicate registration within the family is still caught."""
