@@ -23,5 +23,29 @@ if bound < 300:
 p99 = out.get("bind_call_p99_ms")
 if p99 is None or "bind_call_percentiles_approx" in out:
     sys.exit("bench_smoke: bind_call percentiles are not raw measurements")
+if "approx" in (out.get("api_request_latency") or {}):
+    sys.exit("bench_smoke: api_request_latency fell back to bucket edges")
+EOF
+
+# Throughput floor on the SCALE-OUT path: the 200n/2k REST arm with
+# ApiServerSharding + ApiServerCodecOffload on must hold >= 400 pods/s
+# (PR 9's control-plane wall was ~340-500 before the watch-fan-out
+# batching; a regression below 400 means a hot-path change undid it).
+timeout -k 10 90 env JAX_PLATFORMS=cpu python - <<'EOF'
+import asyncio, json, sys
+from kubernetes_tpu.perf.density import run_density
+
+out = asyncio.run(run_density(
+    n_nodes=200, n_pods=2000, via="rest", timeout=60.0,
+    create_concurrency=16, paced_pods=0,
+    feature_gates="ApiServerSharding=true,ApiServerCodecOffload=true"))
+print(json.dumps(out))
+if out.get("bound", 0) < 2000:
+    sys.exit(f"bench_smoke: only {out.get('bound')}/2000 pods bound "
+             f"on the gated path")
+rate = out.get("pods_per_second", 0.0)
+if rate < 400:
+    sys.exit(f"bench_smoke: gated 200n/2k arm at {rate} pods/s "
+             f"(< 400 floor)")
 EOF
 echo "bench_smoke: ok"

@@ -17,6 +17,10 @@
 #   5. tpusan over the kill-the-leader HA scenario — quorum WAL
 #      replication with the election-safety and committed-never-lost
 #      invariants checked live.
+#   6. tpusan over the SCALE-OUT HA scenario — resource-group sharded
+#      apiserver workers (inline dispatch under tpusan) + follower
+#      read/watch affinity + queue-admission traffic, asserting ALL
+#      EIGHT invariants exercised and byte-identical convergence facts.
 #
 # Replay a failure: the report names (chaos seed, tpusan seed) — run
 # the same scenario under that exact pair, or TPU_SAN=<seed> pytest a
@@ -29,10 +33,10 @@ cd "$(dirname "$0")/.."
 
 SEED="${TPU_SAN:-20260804}"
 
-echo "=== 1/5 tpuvet: static analysis tree-clean ==="
+echo "=== 1/6 tpuvet: static analysis tree-clean ==="
 python -m kubernetes_tpu.analysis kubernetes_tpu
 
-echo "=== 2/5 tpusan: chaos convergence x8 schedules (lockdep + mutation detector armed) ==="
+echo "=== 2/6 tpusan: chaos convergence x8 schedules (lockdep + mutation detector armed) ==="
 timeout -k 10 110 env JAX_PLATFORMS=cpu TPU_SAN= TPU_CHAOS= \
     TPU_LOCKDEP=1 TPU_CACHE_MUTATION_DETECTOR=1 python - "$SEED" <<'EOF'
 import json, sys
@@ -57,7 +61,7 @@ if idle:
     sys.exit(f"tpusan: invariants never exercised: {idle}")
 EOF
 
-echo "=== 3/5 tpusan: queue smoke x2 schedules ==="
+echo "=== 3/6 tpusan: queue smoke x2 schedules ==="
 timeout -k 10 90 env JAX_PLATFORMS=cpu TPU_SAN= \
     TPU_LOCKDEP=1 TPU_CACHE_MUTATION_DETECTOR=1 python - "$SEED" <<'EOF'
 import json, sys
@@ -69,7 +73,7 @@ if not all(r["reclaimed_gangs"] for r in rep["schedules"]):
     sys.exit("tpusan: reclaim did not run on every schedule")
 EOF
 
-echo "=== 4/5 tpusan: graceful-preemption storm x4 schedules ==="
+echo "=== 4/6 tpusan: graceful-preemption storm x4 schedules ==="
 # Mid-checkpoint member crash + shrink + regrow, byte-identical
 # convergence facts asserted across every explored schedule
 # (run_preempt_smoke_schedules raises on any divergence).
@@ -84,7 +88,7 @@ if not rep["invariant_checks"].get("checkpoint-monotonic"):
     sys.exit("tpusan: checkpoint-monotonic never exercised")
 EOF
 
-echo "=== 5/5 tpusan: kill-the-leader HA x4 schedules ==="
+echo "=== 5/6 tpusan: kill-the-leader HA x4 schedules ==="
 # The replicated-control-plane scenario (3 replicas, leader crashed
 # mid-wave) under explored interleavings: election-safety and
 # committed-never-lost checked on every run, convergence facts
@@ -100,6 +104,33 @@ print(json.dumps({k: v for k, v in rep.items() if k != "schedules"}))
 for inv in ("election-safety", "committed-never-lost"):
     if not rep["invariant_checks"].get(inv):
         sys.exit(f"tpusan: {inv} never exercised")
+if rep["facts"]["acked_lost"]:
+    sys.exit("tpusan: acknowledged writes lost under exploration")
+EOF
+
+echo "=== 6/6 tpusan: scale-out HA (sharded + follower reads + queued) x4 schedules ==="
+# The PR-9 path: resource-group sharded apiserver workers (inline
+# dispatch under tpusan — the explorer owns the one loop), client
+# follower read/watch affinity with the bounded-staleness leader
+# fallback, and queue-admission traffic so ALL EIGHT invariants are
+# exercised on the replicated plane. Facts must be byte-identical
+# across schedules (run_ha_smoke_schedules raises on divergence).
+timeout -k 10 150 env JAX_PLATFORMS=cpu TPU_SAN= TPU_CHAOS= \
+    TPU_LOCKDEP=1 TPU_CACHE_MUTATION_DETECTOR=1 python - "$SEED" <<'EOF'
+import json, sys
+from kubernetes_tpu.analysis.invariants import INVARIANTS
+from kubernetes_tpu.chaos.ha_harness import run_ha_smoke_schedules
+
+rep = run_ha_smoke_schedules(sys.argv[1], schedules=4, sharded=True,
+                             read_affinity=True, queued=True)
+print(json.dumps({k: v for k, v in rep.items() if k != "schedules"}))
+idle = [n for n in INVARIANTS if not rep["invariant_checks"].get(n)]
+if idle:
+    sys.exit(f"tpusan: invariants never exercised on the scale-out "
+             f"path: {idle}")
+if not rep["facts"]["queued_admitted"]:
+    sys.exit("tpusan: queue admission never ran (quota invariants "
+             "would be vacuous)")
 if rep["facts"]["acked_lost"]:
     sys.exit("tpusan: acknowledged writes lost under exploration")
 EOF

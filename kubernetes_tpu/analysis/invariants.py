@@ -209,6 +209,12 @@ class _ReplicaGroup:
     def __init__(self):
         #: node_id -> live MVCCStore (re-registered on rebuild).
         self.stores: dict = {}
+        #: Crashed node_ids: a dead replica may legitimately hold a
+        #: DIVERGENT uncommitted tail (it was the minority holder of
+        #: entries that never committed — raft snapshots it away on
+        #: rejoin). Its frozen store can be AHEAD of the acked prefix,
+        #: so the lag filter alone does not skip it.
+        self.down: set = set()
         #: term -> node_id that won it.
         self.leaders: dict[int, str] = {}
         #: key -> (rev, op, canonical value) of the LATEST committed
@@ -289,6 +295,17 @@ class InvariantRegistry:
                                store) -> None:
         g = self._replica_groups.setdefault(group, _ReplicaGroup())
         g.stores[node_id] = store
+        # A rebuilt member (same id, fresh store recovered from its
+        # WAL + snapshot install) is live again and re-enters the
+        # final sweep.
+        g.down.discard(node_id)
+
+    def note_replica_down(self, group: str, node_id: str) -> None:
+        """A replica crashed: its frozen store may hold a divergent
+        uncommitted tail and is excluded from the committed-never-lost
+        sweep until it re-registers (rebuild)."""
+        g = self._replica_groups.setdefault(group, _ReplicaGroup())
+        g.down.add(node_id)
 
     def note_leader(self, group: str, node_id: str, term: int) -> None:
         """A replica won an election: election safety demands no OTHER
@@ -320,6 +337,12 @@ class InvariantRegistry:
         from ..storage.mvcc import DELETED
         for group, g in self._replica_groups.items():
             for node_id, store in g.stores.items():
+                if node_id in g.down:
+                    # Crashed: may hold a divergent uncommitted tail
+                    # AHEAD of the acked prefix (the minority-holder
+                    # case raft snapshots away on rejoin) — the lag
+                    # filter below would not catch it.
+                    continue
                 if store.revision < g.max_acked_rev:
                     continue  # not converged (dead/lagging): the
                     # harness's own convergence asserts cover liveness
@@ -689,6 +712,12 @@ def note_leader(group: str, node_id: str, term: int) -> None:
     """Seam for ReplicaNode._become_leader; no-op unless armed."""
     if SANITIZER is not None:
         SANITIZER.note_leader(group, node_id, term)
+
+
+def note_replica_down(group: str, node_id: str) -> None:
+    """Seam for ReplicaNode.crash; no-op unless armed."""
+    if SANITIZER is not None:
+        SANITIZER.note_replica_down(group, node_id)
 
 
 def note_commit(group: str, rev: int, op: str, key: str, value) -> None:

@@ -35,7 +35,15 @@ async def amain(argv=None) -> int:
                    help="durable WAL/snapshot dir; empty = in-memory")
     p.add_argument("--namespaces", default="default,kube-system",
                    help="comma-separated namespaces to ensure at boot")
+    p.add_argument("--feature-gates", default="",
+                   help='"Gate=true,Other=false" applied to the process-'
+                        "global gate table (e.g. ApiServerSharding=true,"
+                        "ApiServerCodecOffload=true)")
     args = p.parse_args(argv)
+
+    if args.feature_gates:
+        from ..util.features import GATES
+        GATES.parse(args.feature_gates)
 
     store = None
     if args.data_dir:

@@ -1,0 +1,177 @@
+"""Process-pool JSON codec offload for the apiserver event loop.
+
+Reference motivation: the apiserver negotiates protobuf on the hot path
+because serialization dominates control-plane CPU at density scale
+(``apimachinery/pkg/runtime/serializer/protobuf``). This repo stays on
+JSON (``perf/decode_share.py`` is the go/no-go instrument for a binary
+codec), but the event loop must not burn milliseconds serializing a
+30k-pod LIST or decoding a 512-item batchCreate body while binds queue
+behind it. Behind the ``ApiServerCodecOffload`` gate, encode-cache
+*misses* on LIST assembly and decode of large request bodies dispatch
+to a ``concurrent.futures.ProcessPoolExecutor``; everything below the
+size thresholds stays inline — for small objects the pickle round trip
+costs more than the ``json.dumps`` it would save.
+
+Host sizing, stated: the pool runs ``cpu_count - 1`` workers. On a
+single-core host (the bench VM) that is zero spare cores, so the pool
+stays INLINE even with the gate on — offloading to a process competing
+for the same core is pure IPC overhead. The ``codec_pool_*`` metrics
+make the fallback visible: ``codec_pool_inline_total`` counts work the
+thresholds or host kept on the loop, ``codec_pool_submits_total``
+counts real offloads. ``KTPU_CODEC_POOL_WORKERS`` overrides the sizing
+(tests force 1 to exercise the true pool path on any host).
+
+Correctness: pool results re-enter the serialize-once cache through
+:meth:`EncodeCache.finish_async_encode` with a generation token taken
+at dispatch — a write landing while an encode is in flight invalidates
+the key and bumps its generation, so the completed future can never
+resurrect a stale entry (see tests/unit/test_codecpool.py).
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+from ..metrics.registry import Counter, Gauge
+
+CODEC_POOL_SUBMITS = Counter(
+    "codec_pool_submits_total",
+    "Codec jobs dispatched to the process pool, by operation",
+    labels=("op",))
+
+CODEC_POOL_INLINE = Counter(
+    "codec_pool_inline_total",
+    "Codec jobs kept on the event loop (below threshold / no spare "
+    "cores / pool down), by operation and reason",
+    labels=("op", "reason"))
+
+CODEC_POOL_ITEMS = Counter(
+    "codec_pool_items_total",
+    "Objects encoded/decoded through the pool, by operation",
+    labels=("op",))
+
+CODEC_POOL_WORKERS = Gauge(
+    "codec_pool_workers", "Worker processes the codec pool runs (0 = inline)")
+
+CODEC_POOL_STALE_DROPS = Counter(
+    "codec_pool_stale_drops_total",
+    "Pool encode results dropped because a write invalidated the key "
+    "while the encode was in flight")
+
+
+def _encode_many(values: list[dict]) -> list[bytes]:
+    """Worker half of the encode offload: wire bytes per value. Module
+    level so it pickles by reference, not by closure."""
+    dumps = json.dumps
+    return [dumps(v, separators=(",", ":")).encode() for v in values]
+
+
+def _decode_bytes(raw: bytes):
+    return json.loads(raw)
+
+
+def pool_workers() -> int:
+    """Worker count for this host: every core but one (the event loop
+    keeps its own), overridable via KTPU_CODEC_POOL_WORKERS. 0 = the
+    pool stays inline."""
+    env = os.environ.get("KTPU_CODEC_POOL_WORKERS", "")
+    if env:
+        try:
+            return max(0, int(env))
+        except ValueError:
+            pass
+    return max(0, (os.cpu_count() or 1) - 1)
+
+
+class CodecPool:
+    """Lazy process pool + thresholds; safe to construct eagerly (no
+    processes exist until the first over-threshold job).
+
+    Thresholds: ``min_encode_items`` objects per LIST-assembly batch,
+    ``min_decode_bytes`` per request body. Both err toward inline —
+    the offload pays one pickle each way, so it must buy back at least
+    a few hundred microseconds of loop time to be worth dispatching.
+    """
+
+    def __init__(self, workers: Optional[int] = None,
+                 min_encode_items: int = 64,
+                 min_decode_bytes: int = 32 * 1024,
+                 encode_chunk: int = 512):
+        self.workers = pool_workers() if workers is None else workers
+        self.min_encode_items = min_encode_items
+        self.min_decode_bytes = min_decode_bytes
+        #: Objects per pool task — several tasks per big LIST so M
+        #: workers overlap, without per-object dispatch overhead.
+        self.encode_chunk = encode_chunk
+        self._executor = None
+        self._broken = False
+        CODEC_POOL_WORKERS.set(float(self.workers))
+
+    @property
+    def active(self) -> bool:
+        """True when jobs can actually leave the event loop."""
+        return self.workers > 0 and not self._broken
+
+    def _get_executor(self):
+        if self._executor is None:
+            from concurrent.futures import ProcessPoolExecutor
+            self._executor = ProcessPoolExecutor(max_workers=self.workers)
+        return self._executor
+
+    def shutdown(self) -> None:
+        if self._executor is not None:
+            self._executor.shutdown(wait=False, cancel_futures=True)
+            self._executor = None
+
+    async def encode_values(self, values: list[dict]) -> list[bytes]:
+        """Wire-encode ``values`` — through the pool when the batch is
+        big enough and a worker exists, inline otherwise. Order is
+        preserved; output is byte-identical to the inline path
+        (``json.dumps(v, separators=(",", ":"))``)."""
+        if not values:
+            return []
+        if not self.active:
+            CODEC_POOL_INLINE.inc(op="encode", reason="no-workers")
+            return _encode_many(values)
+        if len(values) < self.min_encode_items:
+            CODEC_POOL_INLINE.inc(op="encode", reason="below-threshold")
+            return _encode_many(values)
+        import asyncio
+        loop = asyncio.get_running_loop()
+        chunks = [values[i:i + self.encode_chunk]
+                  for i in range(0, len(values), self.encode_chunk)]
+        try:
+            futs = [loop.run_in_executor(self._get_executor(),
+                                         _encode_many, c) for c in chunks]
+            CODEC_POOL_SUBMITS.inc(len(futs), op="encode")
+            CODEC_POOL_ITEMS.inc(len(values), op="encode")
+            outs = await asyncio.gather(*futs)
+        except Exception:  # noqa: BLE001 — a dead pool degrades to inline
+            self._broken = True
+            CODEC_POOL_INLINE.inc(op="encode", reason="pool-error")
+            return _encode_many(values)
+        return [b for chunk in outs for b in chunk]
+
+    async def decode_body(self, raw: bytes):
+        """``json.loads`` of a request body — pooled when the body is
+        large enough, inline otherwise. Raises the same
+        ``json.JSONDecodeError`` the inline path would."""
+        if not self.active or len(raw) < self.min_decode_bytes:
+            reason = ("no-workers" if not self.active
+                      else "below-threshold")
+            CODEC_POOL_INLINE.inc(op="decode", reason=reason)
+            return json.loads(raw)
+        import asyncio
+        loop = asyncio.get_running_loop()
+        try:
+            CODEC_POOL_SUBMITS.inc(op="decode")
+            CODEC_POOL_ITEMS.inc(op="decode")
+            return await loop.run_in_executor(self._get_executor(),
+                                              _decode_bytes, raw)
+        except json.JSONDecodeError:
+            raise
+        except Exception:  # noqa: BLE001 — a dead pool degrades to inline
+            self._broken = True
+            CODEC_POOL_INLINE.inc(op="decode", reason="pool-error")
+            return json.loads(raw)

@@ -52,6 +52,15 @@ class EncodeCache:
         #: key -> cache keys held for it (write invalidation is O(entries
         #: for that key), never a full scan).
         self._by_key: dict[str, list[tuple[str, int, str]]] = {}
+        #: Async-encode race guard (the codec-pool path): per-key
+        #: invalidation generation, tracked ONLY while an offloaded
+        #: encode of that key is in flight (bounded by in-flight work,
+        #: not by keyspace). A write bumps the generation; a completing
+        #: pool encode whose dispatch-time token no longer matches is
+        #: dropped — it must never resurrect an entry a write
+        #: invalidated while it was in the pool.
+        self._gen: dict[str, int] = {}
+        self._pending: dict[str, int] = {}
 
     def __len__(self) -> int:
         return len(self._data)
@@ -67,22 +76,79 @@ class EncodeCache:
 
     def put(self, key: str, revision: int, line: bytes,
             which: str = "cur") -> None:
-        ck = (key, revision, which)
         with self._lock:
-            if ck in self._data:
-                return
-            if len(self._data) >= self.limit:
-                self._evict_locked()
-            self._data[ck] = line
-            self._by_key.setdefault(key, []).append(ck)
-            ENCODE_CACHE_ENTRIES.set(float(len(self._data)))
+            self._put_locked((key, revision, which), line)
+
+    def _put_locked(self, ck: tuple, line: bytes) -> None:
+        """The one insert path (dup-check, eviction, index, gauge) —
+        shared by :meth:`put` and :meth:`finish_async_encode` so the
+        two can never drift."""
+        if ck in self._data:
+            return
+        if len(self._data) >= self.limit:
+            self._evict_locked()
+        self._data[ck] = line
+        self._by_key.setdefault(ck[0], []).append(ck)
+        ENCODE_CACHE_ENTRIES.set(float(len(self._data)))
 
     def invalidate(self, key: str) -> None:
         """Drop every cached encoding for ``key`` (called on write)."""
         with self._lock:
             for ck in self._by_key.pop(key, ()):
                 self._data.pop(ck, None)
+            if key in self._pending:
+                # An offloaded encode of this key is in flight: its
+                # dispatch-time token is now stale and its completion
+                # must be discarded (finish_async_encode checks).
+                self._gen[key] = self._gen.get(key, 0) + 1
             ENCODE_CACHE_ENTRIES.set(float(len(self._data)))
+
+    # -- async (pool-offloaded) encode guard ------------------------------
+
+    def begin_async_encode(self, key: str) -> int:
+        """Register an offloaded encode of ``key``; returns the token
+        :meth:`finish_async_encode` must present. Call BEFORE reading
+        the store value that will be encoded — a write after the read
+        then provably bumps the generation this token snapshot holds."""
+        with self._lock:
+            self._pending[key] = self._pending.get(key, 0) + 1
+            return self._gen.get(key, 0)
+
+    def abort_async_encode(self, key: str) -> None:
+        """Release a :meth:`begin_async_encode` registration without
+        inserting anything — the cancellation path (client gone mid-
+        LIST, pool failure). Without this, an aborted 30k-pod LIST
+        would leave thousands of ``_pending``/``_gen`` entries behind
+        forever, breaking the bounded-by-in-flight-work invariant."""
+        with self._lock:
+            n = self._pending.get(key, 0) - 1
+            if n <= 0:
+                self._pending.pop(key, None)
+                self._gen.pop(key, None)
+            else:
+                self._pending[key] = n
+
+    def finish_async_encode(self, key: str, revision: int, line: bytes,
+                            token: int, which: str = "cur") -> bool:
+        """Complete an offloaded encode: insert the entry iff no write
+        invalidated ``key`` since :meth:`begin_async_encode` minted the
+        token. Returns False (entry dropped) when the encode lost the
+        race — the write-hook invalidation must win over a stale
+        future completion, or a dead revision's bytes reappear."""
+        with self._lock:
+            n = self._pending.get(key, 0) - 1
+            if n <= 0:
+                self._pending.pop(key, None)
+                current = self._gen.pop(key, 0)
+            else:
+                self._pending[key] = n
+                current = self._gen.get(key, 0)
+            if current != token:
+                from .codecpool import CODEC_POOL_STALE_DROPS
+                CODEC_POOL_STALE_DROPS.inc()
+                return False
+            self._put_locked((key, revision, which), line)
+            return True
 
     def _evict_locked(self) -> None:
         # Oldest quarter by insertion order: one write-heavy burst must

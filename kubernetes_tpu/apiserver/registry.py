@@ -593,19 +593,54 @@ class Registry:
         """LIST fast path: per-item wire bytes (cache-shared with GET
         and the watch fan-out) + the list revision. Label selectors
         match the raw stored dict, like :meth:`list`; field selectors
-        need typed extraction and take the slow path."""
+        need typed extraction and take the slow path. One snapshot/
+        selector walk shared with the codec-pool path
+        (:meth:`list_encoded_parts`) — the misses are simply encoded
+        inline here."""
+        parts, misses, rev = self.list_encoded_parts(plural, namespace,
+                                                     label_selector)
+        cache = self.encode_cache
+        for idx, key, mrev, value, token in misses:
+            line = json.dumps(value, separators=(",", ":")).encode()
+            cache.finish_async_encode(key, mrev, line, token)
+            parts[idx] = line
+        return parts, rev
+
+    def list_encoded_parts(self, plural: str, namespace: str = "",
+                           label_selector: str = ""
+                           ) -> tuple[list, list, int]:
+        """The codec-pool half of the LIST fast path: cached wire bytes
+        where the serialize-once cache has them, and MISS records
+        ``(index, key, mod_revision, value_with_rv, token)`` for the
+        rest, so the apiserver can encode the misses off the event
+        loop and re-enter them through the cache's async-encode guard
+        (``token`` is minted BEFORE the value is read — a write racing
+        the pool encode provably invalidates it). Returns
+        ``(parts, misses, revision)`` with ``parts[index] is None`` at
+        each miss slot."""
         spec = self.spec_for(plural)
         stored, rev = self.store.list(self._prefix(spec, namespace),
                                       copy=False)
         sel = parse_selector(label_selector) if label_selector else None
-        out = []
+        parts: list = []
+        misses: list = []
         for s in stored:
             if sel is not None:
                 raw_labels = (s.value.get("metadata") or {}).get("labels") or {}
                 if not sel.matches(raw_labels):
                     continue
-            out.append(self.encoded_value(s.key, s.value, s.mod_revision))
-        return out, rev
+            line = self.encode_cache.get(s.key, s.mod_revision)
+            if line is None:
+                token = self.encode_cache.begin_async_encode(s.key)
+                obj = {**s.value,
+                       "metadata": {**(s.value.get("metadata") or {}),
+                                    "resource_version": str(s.mod_revision)}}
+                misses.append((len(parts), s.key, s.mod_revision, obj,
+                               token))
+                parts.append(None)
+            else:
+                parts.append(line)
+        return parts, misses, rev
 
     def list(self, plural: str, namespace: str = "", label_selector: str = "",
              field_selector: str = "") -> tuple[list[TypedObject], int]:
@@ -966,8 +1001,33 @@ class Registry:
         else:
             out, rev = self.store.last_write_in(fn, *args)
         if rev:
-            await replica.wait_commit(rev)
+            await self.await_commit(replica, rev)
         return out
+
+    @staticmethod
+    async def await_commit(replica, rev: int) -> None:
+        """Await quorum commit of ``rev`` from WHATEVER loop the caller
+        runs on. The replica's commit machinery (waiter futures,
+        ``_set_commit``) lives on the loop that started it; a sharded
+        apiserver worker awaiting from its own loop must hop — a
+        future created here and completed from the replica's loop
+        would wake through the wrong loop's call_soon (a cross-thread
+        asyncio error, or worse, a silent lost wakeup)."""
+        rloop = getattr(replica, "_loop", None)
+        try:
+            running = asyncio.get_running_loop()
+        except RuntimeError:
+            running = None
+        if rloop is None or rloop is running:
+            await replica.wait_commit(rev)
+            return
+        cfut = asyncio.run_coroutine_threadsafe(
+            replica.wait_commit(rev), rloop)
+        try:
+            await asyncio.wrap_future(cfut)
+        except asyncio.CancelledError:
+            cfut.cancel()
+            raise
 
     # -- pods/eviction subresource ----------------------------------------
 
@@ -1259,6 +1319,17 @@ class ObjectWatch:
             if out is not None:
                 return out
 
+    def next_nowait(self):
+        """An already-delivered (translated) event or None — the
+        fan-out drain primitive (see ``Watch.next_nowait``)."""
+        while True:
+            ev = self._raw.next_nowait()
+            if ev is None:
+                return None
+            out = self._translate(ev)
+            if out is not None:
+                return out
+
     def _translate(self, ev: WatchEvent):
         obj = self._registry._decode(self._spec, ev.value, ev.revision)
         old = (self._registry._decode(self._spec, ev.prev_value, ev.revision)
@@ -1329,6 +1400,19 @@ class RawObjectWatch:
             if ev is None:
                 if self._raw.closed:
                     return (self.CLOSED, None, 0, "cur", "")
+                return None
+            out = self._translate(ev)
+            if out is not None:
+                return out
+
+    def next_nowait(self):
+        """An already-delivered (translated) event or None — lets the
+        HTTP watch handler coalesce every in-flight event into one
+        socket write (the fan-out's syscall count was a measured
+        apiserver CPU cost at density scale)."""
+        while True:
+            ev = self._raw.next_nowait()
+            if ev is None:
                 return None
             out = self._translate(ev)
             if out is not None:

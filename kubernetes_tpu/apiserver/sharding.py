@@ -1,0 +1,222 @@
+"""Resource-group sharded apiserver workers.
+
+Reference motivation: Kant-style horizontal control-plane scaling —
+the single apiserver event loop is the measured wall at density scale
+(BENCH r01→r05: ~336-345 pods/s on the 30k REST arm while the
+scheduler does ~950 in-process). Behind the ``ApiServerSharding``
+gate, non-watch resource requests are partitioned by RESOURCE GROUP
+and dispatched to per-group worker event loops over the shared
+MVCC/WAL store:
+
+- ``pods``      — pods (binds, batch binds, evictions ride along)
+- ``nodes``     — nodes + leases (heartbeat traffic)
+- ``queueing``  — podgroups, clusterqueues, localqueues
+- ``events``    — events (the classic noisy neighbor)
+- everything else stays inline on the router loop.
+
+The router (the aiohttp server loop) keeps the ENTIRE external
+surface: authn/authz, audit, the max-in-flight limiter, redirects,
+metrics, and every watch stream run exactly where they always did —
+only the verb handler body moves to the group's worker. Request bodies
+are pre-read on the router loop before dispatch (aiohttp caches the
+bytes), so handlers never touch the connection from a foreign thread.
+
+Ordering: all mutations of one resource group run through ONE worker,
+so per-key orderings observable today are preserved; cross-group
+ordering was never promised beyond MVCC revision arbitration, which
+the store's process-wide lock provides unchanged. The WAL, the encode
+cache, watch delivery (``call_soon_threadsafe``), and the metrics
+registry are already foreign-thread-safe — sharding leans on exactly
+those seams.
+
+Two execution modes:
+
+- ``thread`` (default): one daemon thread + event loop per shard —
+  real loop decoupling (a 30k LIST on the pods worker no longer
+  delays node heartbeats or election traffic on the router).
+- ``inline``: per-request tasks on the router loop, tagged per shard.
+  Used automatically while TPU_SAN is armed — the interleaving
+  explorer owns exactly one loop, and foreign threads would break
+  schedule replay — so ``hack/race.sh`` explores the sharded
+  dispatch path deterministically.
+
+Single-core honesty: on a 1-CPU host thread mode buys no parallelism
+(the GIL serializes the workers); what it buys is isolation of
+head-of-line blocking between groups. The measured throughput wins on
+such hosts come from the watch fan-out batching and codec paths, not
+from sharding — see README "Control-plane scale-out".
+"""
+from __future__ import annotations
+
+import asyncio
+import threading
+from typing import Optional
+
+from ..metrics.registry import Counter, Gauge
+
+SHARD_REQUESTS = Counter(
+    "apiserver_shard_requests_total",
+    "Requests dispatched to apiserver shard workers, by shard",
+    labels=("shard",))
+
+SHARD_INLINE = Counter(
+    "apiserver_shard_inline_total",
+    "Resource requests served on the router loop (unsharded group, "
+    "watch streams, or sharding off)")
+
+SHARD_DEPTH = Gauge(
+    "apiserver_shard_inflight",
+    "Requests currently in flight per shard worker",
+    labels=("shard",))
+
+#: plural -> shard name. Unlisted plurals stay on the router loop.
+RESOURCE_GROUPS = {
+    "pods": "pods",
+    "nodes": "nodes",
+    "leases": "nodes",
+    "podgroups": "queueing",
+    "clusterqueues": "queueing",
+    "localqueues": "queueing",
+    "events": "events",
+}
+
+SHARD_NAMES = ("pods", "nodes", "queueing", "events")
+
+
+def shard_for(plural: str) -> Optional[str]:
+    """Shard name for a plural (batch action suffixes already
+    stripped by the caller), or None for router-inline resources."""
+    return RESOURCE_GROUPS.get(plural)
+
+
+class _ShardWorker:
+    """One shard: a daemon thread running its own event loop."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(
+            target=self._run, name=f"apiserver-shard-{name}", daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        asyncio.set_event_loop(self.loop)
+        try:
+            self.loop.run_forever()
+        finally:
+            # The worker closes its OWN loop: a stop() whose join
+            # timed out must not leak the loop for the process
+            # lifetime (and no other thread can safely close it).
+            self.loop.close()
+
+    async def dispatch(self, coro):
+        """Run ``coro`` on this shard's loop; awaits (and propagates
+        exceptions/cancellation) from the caller's loop."""
+        cfut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+        try:
+            return await asyncio.wrap_future(cfut)
+        except asyncio.CancelledError:
+            cfut.cancel()
+            raise
+
+    def stop(self, join_timeout: float = 2.0) -> None:
+        def _shutdown():
+            # Cancel, DRAIN, then stop: stopping the loop in the same
+            # callback as the cancellations would return run_forever
+            # before any cancelled handler ran its except/finally
+            # blocks (leaking e.g. the codec path's encode-token
+            # cleanup) and strand the router's dispatch await.
+            from ..util.tasks import spawn
+
+            async def _drain():
+                tasks = [t for t in asyncio.all_tasks(self.loop)
+                         if t is not asyncio.current_task()]
+                for t in tasks:
+                    t.cancel()
+                try:
+                    await asyncio.wait_for(
+                        asyncio.gather(*tasks, return_exceptions=True),
+                        1.0)
+                except asyncio.TimeoutError:
+                    pass  # wedged handler: stop anyway, join bounds us
+                self.loop.stop()
+            spawn(_drain(), name=f"shard-{self.name}-drain")
+        try:
+            self.loop.call_soon_threadsafe(_shutdown)
+            self._thread.join(timeout=join_timeout)
+        except RuntimeError:
+            pass  # loop already closed by its own thread
+
+
+class ShardPool:
+    """The apiserver's shard workers; built lazily on first dispatch
+    so a gated-off server never spawns a thread.
+
+    ``mode``: ``"thread"`` | ``"inline"`` | ``"auto"`` (thread unless
+    TPU_SAN is armed — deterministic exploration owns the one loop).
+    """
+
+    def __init__(self, mode: str = "auto"):
+        if mode == "auto":
+            from ..analysis import invariants
+            import os
+            # Inline when (a) tpusan owns the one loop — foreign
+            # threads would break deterministic schedule replay — or
+            # (b) the host has no spare core: thread workers on a
+            # single CPU pay GIL handoffs + cross-loop hops for zero
+            # parallelism (measured: 200n/2k REST arm DROPPED ~25%
+            # with thread workers on the 1-core bench VM).
+            single_core = (os.cpu_count() or 1) < 2
+            mode = ("inline" if (invariants.SANITIZER is not None
+                                 or os.environ.get("TPU_SAN")
+                                 or single_core)
+                    else "thread")
+        self.mode = mode
+        self._workers: dict[str, _ShardWorker] = {}
+        self._lock = threading.Lock()
+        #: Optional ``fn(name, loop)`` called once per spawned worker
+        #: (the apiserver hangs its loop-lag probe here).
+        self.on_worker = None
+
+    def _worker(self, shard: str) -> _ShardWorker:
+        w = self._workers.get(shard)
+        if w is None:
+            with self._lock:
+                w = self._workers.get(shard)
+                if w is None:
+                    w = _ShardWorker(shard)
+                    self._workers[shard] = w
+                    if self.on_worker is not None:
+                        self.on_worker(shard, w.loop)
+        return w
+
+    async def dispatch(self, shard: str, coro):
+        """Run ``coro`` under shard accounting. Thread mode hops to the
+        shard's loop; inline mode runs it as a task on the caller's
+        loop (a real task boundary, so tpusan explores the reordering
+        the thread mode would produce)."""
+        SHARD_REQUESTS.inc(shard=shard)
+        SHARD_DEPTH.inc(shard=shard)
+        try:
+            if self.mode == "thread":
+                return await self._worker(shard).dispatch(coro)
+            task = asyncio.get_running_loop().create_task(coro)
+            try:
+                return await task
+            except asyncio.CancelledError:
+                task.cancel()
+                raise
+        finally:
+            SHARD_DEPTH.dec(shard=shard)
+
+    def loops(self) -> dict[str, asyncio.AbstractEventLoop]:
+        """Live shard loops (thread mode), for the loop-lag probes."""
+        if self.mode != "thread":
+            return {}
+        return {name: w.loop for name, w in self._workers.items()}
+
+    def stop(self) -> None:
+        with self._lock:
+            workers, self._workers = dict(self._workers), {}
+        for w in workers.values():
+            w.stop()

@@ -61,7 +61,8 @@ class HAMember:
     def __init__(self, node_id: str, data_dir: str,
                  transport: repl.LocalTransport, seed: int,
                  election_timeout: float = 0.15,
-                 heartbeat_interval: float = 0.03):
+                 heartbeat_interval: float = 0.03,
+                 sharded: bool = False):
         self.node_id = node_id
         self.data_dir = data_dir
         self.store = MVCCStore(data_dir, fsync="batch")
@@ -73,6 +74,12 @@ class HAMember:
             heartbeat_interval=heartbeat_interval)
         self.registry.replica = self.node
         self.server = APIServer(self.registry)
+        if sharded:
+            # Explicit per-server pool (not the process-global gate, so
+            # parallel tests never leak gates): under TPU_SAN the pool
+            # auto-selects inline mode — the explorer owns the loop.
+            from ..apiserver.sharding import ShardPool
+            self.server.shards = ShardPool()
         self.port: Optional[int] = None
 
     async def start(self, port: int = 0) -> None:
@@ -97,11 +104,13 @@ class HAPlane:
 
     def __init__(self, data_dir: str, replicas: int = 3, seed: int = 0,
                  election_timeout: float = 0.15,
-                 heartbeat_interval: float = 0.03):
+                 heartbeat_interval: float = 0.03,
+                 sharded: bool = False):
         self.data_dir = data_dir
         self.seed = seed
         self.election_timeout = election_timeout
         self.heartbeat_interval = heartbeat_interval
+        self.sharded = sharded
         self.transport = repl.LocalTransport()
         self.members: list[HAMember] = [
             self._make(f"api-{i}") for i in range(replicas)]
@@ -110,7 +119,8 @@ class HAPlane:
         return HAMember(node_id, os.path.join(self.data_dir, node_id),
                         self.transport, self.seed,
                         election_timeout=self.election_timeout,
-                        heartbeat_interval=self.heartbeat_interval)
+                        heartbeat_interval=self.heartbeat_interval,
+                        sharded=self.sharded)
 
     async def start(self) -> None:
         for m in self.members:
@@ -242,8 +252,9 @@ async def _create_acked(client: RESTClient, obj, acked: list,
     means an earlier attempt landed but was never acknowledged to us,
     so it is deliberately NOT counted."""
     plural = {"Namespace": "namespaces", "ConfigMap": "configmaps",
-              "Pod": "pods", "PodGroup": "podgroups", "Node": "nodes"}[
-                  type(obj).__name__]
+              "Pod": "pods", "PodGroup": "podgroups", "Node": "nodes",
+              "ClusterQueue": "clusterqueues",
+              "LocalQueue": "localqueues"}[type(obj).__name__]
     ns = obj.metadata.namespace
     key = (f"/registry/{plural}/{ns}/{obj.metadata.name}" if ns
            else f"/registry/{plural}/{obj.metadata.name}")
@@ -263,7 +274,10 @@ async def _create_acked(client: RESTClient, obj, acked: list,
 async def run_ha_smoke(seed: int, replicas: int = 3, n_nodes: int = 4,
                        gangs: int = 4, gang_size: int = 2,
                        chips_per_pod: int = 2,
-                       timeout: float = 60.0) -> dict:
+                       timeout: float = 60.0,
+                       sharded: bool = False,
+                       read_affinity: bool = False,
+                       queued: bool = False) -> dict:
     """The scripted kill-the-leader scenario; returns a report dict.
     Raises AssertionError on any convergence violation.
 
@@ -274,6 +288,15 @@ async def run_ha_smoke(seed: int, replicas: int = 3, n_nodes: int = 4,
     then quiesce and assert: no acked write lost, survivors
     byte-identical, each survivor's WAL replay byte-identical to its
     live store.
+
+    ``sharded``: every replica's apiserver runs resource-group shard
+    workers (inline mode under TPU_SAN). ``read_affinity``: the user
+    and scheduler clients route reads/watches to followers with the
+    bounded-staleness fallback. ``queued``: a ClusterQueue/LocalQueue
+    pair is created and gang-0 is admitted through it via a status
+    write — store-level traffic that exercises the quota-conservation
+    and admission-monotonicity invariants on the replicated plane
+    (hack/race.sh's all-eight-invariants stage).
     """
     t0 = time.perf_counter()
     controller = core.arm(core.ChaosController(seed, HA_SCHEDULE))
@@ -285,7 +308,8 @@ async def run_ha_smoke(seed: int, replicas: int = 3, n_nodes: int = 4,
     mesh = [2, 2, n_nodes]
     report: dict = {"seed": seed, "replicas": replicas}
     acked: list[str] = []
-    plane = HAPlane(data_dir, replicas=replicas, seed=seed)
+    plane = HAPlane(data_dir, replicas=replicas, seed=seed,
+                    sharded=sharded)
     user: Optional[RESTClient] = None
     sched: Optional[Scheduler] = None
     sched_client: Optional[RESTClient] = None
@@ -296,9 +320,9 @@ async def run_ha_smoke(seed: int, replicas: int = 3, n_nodes: int = 4,
         leader = await plane.leader_member(timeout=10.0)
         report["first_leader"] = leader.node_id
         eps = plane.endpoints()
-        user = RESTClient(eps)
+        user = RESTClient(eps, read_affinity=read_affinity)
         user.backoff_base = 0.02
-        sched_client = RESTClient(eps)
+        sched_client = RESTClient(eps, read_affinity=read_affinity)
         sched_client.backoff_base = 0.02
         await _create_acked(
             user, t.Namespace(metadata=ObjectMeta(name="default")),
@@ -306,6 +330,17 @@ async def run_ha_smoke(seed: int, replicas: int = 3, n_nodes: int = 4,
         for z in range(n_nodes):
             await _create_acked(user, _mk_node(f"ha-{z}", z, mesh),
                                 acked, loop.time() + 15.0)
+        if queued:
+            from ..api import queueing as qapi
+            await _create_acked(user, qapi.ClusterQueue(
+                metadata=ObjectMeta(name="ha-cq"),
+                spec=qapi.ClusterQueueSpec(
+                    nominal_quota={t.RESOURCE_TPU: 64.0})),
+                acked, loop.time() + 15.0)
+            await _create_acked(user, qapi.LocalQueue(
+                metadata=ObjectMeta(name="ha-lq", namespace="default"),
+                spec=qapi.LocalQueueSpec(cluster_queue="ha-cq")),
+                acked, loop.time() + 15.0)
         sched = Scheduler(sched_client, backoff_seconds=0.2)
         await sched.start()
 
@@ -328,17 +363,66 @@ async def run_ha_smoke(seed: int, replicas: int = 3, n_nodes: int = 4,
                     if names <= bound:
                         return
                 if loop.time() > deadline:
+                    detail = ""
+                    if live_leader:
+                        reg = live_leader[0].registry
+                        pods, _ = reg.list("pods", "default")
+                        groups, _ = reg.list("podgroups", "default")
+                        detail = (
+                            f"; leader={live_leader[0].node_id}"
+                            f" pods={[(p.metadata.name, p.spec.node_name) for p in pods]}"
+                            f" groups={[(g.metadata.name, g.status.phase, g.status.admitted) for g in groups]}")
+                    if sched is not None:
+                        detail += (
+                            f"; sched_queue={len(sched.queue)}"
+                            f" sched_cache_pods={len(sched.cache._pod_node)}"
+                            f" sched_client={sched_client.base_url}")
+                    detail += "; members=" + str(
+                        [(m.node_id, m.port, m.node.state, m.node.crashed,
+                          m.store.revision) for m in plane.members])
+                    detail += "; watches=" + str(
+                        [(m.node_id,
+                          [(w.prefix, w.start_revision, w._pending,
+                            w.closed, w.overflowed)
+                           for w in m.store._watches])
+                         for m in plane.members])
                     raise AssertionError(
                         "HA convergence timeout: missing "
-                        f"{sorted(names - bound)}")
+                        f"{sorted(names - bound)}{detail}")
                 await asyncio.sleep(0.1)
 
         wave1 = {f"gang-{g}-{i}" for g in range(gangs // 2)
                  for i in range(gang_size)}
         for g in range(gangs // 2):
-            for obj in _mk_gang(f"gang-{g}", gang_size, chips_per_pod):
+            queue = "ha-lq" if (queued and g == 0) else ""
+            for obj in _mk_gang(f"gang-{g}", gang_size, chips_per_pod,
+                                queue=queue):
                 await _create_acked(user, obj, acked, loop.time() + 20.0)
         await wait_bound(wave1, loop.time() + timeout / 3)
+
+        if queued:
+            # Admit gang-0 through the queue pair with a durable status
+            # write (what QueueController would do): the charge path
+            # exercises quota-conservation, the admitted transition
+            # exercises admission-monotonicity — on every replica that
+            # applies the entry.
+            deadline = loop.time() + 15.0
+            while True:
+                if loop.time() > deadline:
+                    raise AssertionError(
+                        "queued admission write never landed (15s): "
+                        "conflict/unavailability loop")
+                try:
+                    pg = await user.get("podgroups", "default", "gang-0")
+                    pg.status.admitted = True
+                    pg.status.admission_cluster_queue = "ha-cq"
+                    await user.update(pg, subresource="status")
+                    break
+                except errors.ConflictError:
+                    continue
+                except errors.StatusError:
+                    await asyncio.sleep(0.05)
+            report["queued_admitted"] = True
 
         # Submit wave 2, then CRASH THE LEADER while it binds.
         submit = asyncio.gather(*(
@@ -439,13 +523,19 @@ async def run_ha_smoke(seed: int, replicas: int = 3, n_nodes: int = 4,
 
 def run_ha_smoke_schedules(seed, schedules: int = 4, mode: str = "dpor",
                            n_nodes: int = 2, gangs: int = 2,
-                           timeout: float = 30.0) -> dict:
+                           timeout: float = 30.0,
+                           sharded: bool = False,
+                           read_affinity: bool = False,
+                           queued: bool = False) -> dict:
     """The tpusan arm of the HA gate: the SAME seeded kill-the-leader
     scenario explored under ``schedules`` distinct task-interleaving
     schedules with the cluster-invariant sanitizer armed — election
     safety and committed-never-lost are checked live, and the
     convergence FACTS (pods bound, acked-lost, byte-identity verdicts)
-    must come out identical on every schedule."""
+    must come out identical on every schedule. With ``sharded``/
+    ``read_affinity``/``queued`` this is race.sh's scale-out stage:
+    the sharded dispatch + follower-read path explored with ALL EIGHT
+    invariants exercised."""
     from ..analysis import interleave
 
     try:
@@ -454,14 +544,16 @@ def run_ha_smoke_schedules(seed, schedules: int = 4, mode: str = "dpor",
         base = int.from_bytes(str(seed).encode(), "big") % (2 ** 31)
     rep = interleave.explore_sanitized(
         lambda i: run_ha_smoke(base, n_nodes=n_nodes, gangs=gangs,
-                               timeout=timeout),
+                               timeout=timeout, sharded=sharded,
+                               read_affinity=read_affinity, queued=queued),
         base_seed=seed, schedules=schedules, mode=mode,
         extract=lambda v: {"facts": {
             "pods_bound": v["pods_bound"],
             "chips_assigned": v["chips_assigned"],
             "acked_lost": v["acked_lost"],
             "replicas_identical": v["replicas_identical"],
-            "replay_identical": v["replay_identical"]}})
+            "replay_identical": v["replay_identical"],
+            "queued_admitted": v.get("queued_admitted", False)}})
     facts = [r["facts"] for r in rep["schedules"]]
     if any(f != facts[0] for f in facts):
         raise AssertionError(

@@ -47,6 +47,12 @@ CLIENT_REDIRECTS = Counter(
     "Leader-hint redirects (307/308) the REST client followed, by verb",
     labels=("verb",))
 
+CLIENT_FOLLOWER_READS = Counter(
+    "client_follower_read_total",
+    "Read-affinity traffic: reads/watches routed to follower "
+    "endpoints, and bounded-staleness fallbacks to the leader",
+    labels=("outcome",))
+
 #: HTTP statuses a retryable (idempotent) request may retry on — the
 #: server-side/transient family; 4xx client errors never retry.
 _RETRYABLE_STATUS = (500, 502, 503, 504)
@@ -93,10 +99,12 @@ _BY_PLURAL, _BY_KIND = _resource_tables()
 
 class _RESTWatch(WatchStream):
     def __init__(self, session: aiohttp.ClientSession, url: str, params: dict,
-                 timeout: aiohttp.ClientTimeout):
+                 timeout: aiohttp.ClientTimeout,
+                 headers: Optional[dict] = None):
         self._session = session
         self._url = url
         self._params = params
+        self._headers = headers
         #: total=None (streams live indefinitely) but connect and
         #: sock_read bounded (RESTClient.watch builds this from its
         #: connect_timeout/watch_idle_timeout): the server bookmarks
@@ -113,8 +121,10 @@ class _RESTWatch(WatchStream):
 
     async def _run(self) -> None:
         try:
+            kw = {"headers": self._headers} if self._headers else {}
             async with self._session.get(self._url, params=self._params,
-                                         timeout=self._timeout) as resp:
+                                         timeout=self._timeout,
+                                         **kw) as resp:
                 if resp.status != 200:
                     body = await resp.json()
                     await self._queue.put(("ERROR", errors.StatusError.from_dict(body)))
@@ -175,7 +185,8 @@ class RESTClient(Client):
                  ca_file: str = "", client_cert: str = "",
                  client_key: str = "", check_hostname: bool = True,
                  impersonate_user: str = "",
-                 impersonate_groups: tuple = ()):
+                 impersonate_groups: tuple = (),
+                 read_affinity: bool = False):
         """``base_url`` may name SEVERAL apiserver endpoints — a
         comma-separated string or a list — for a replicated control
         plane: requests pin to one endpoint and fail over to the next
@@ -191,7 +202,17 @@ class RESTClient(Client):
         address is routinely absent from the apiserver cert SANs).
         ``impersonate_user``/``impersonate_groups``: act as another
         identity (kubectl --as / --as-group; RBAC 'impersonate' verb
-        required server-side)."""
+        required server-side).
+        ``read_affinity=True`` (multi-endpoint planes only): GETs,
+        LISTs, and watches route to FOLLOWER endpoints round-robin —
+        bounded-staleness reads carrying X-Ktpu-Max-Staleness
+        (``self.max_staleness``) — so informer relist/watch fan-out
+        stops competing with the write path on the leader. Writes keep
+        the leader-routed 307 machinery unchanged. A follower that
+        cannot meet the bound answers 503 + X-Ktpu-Stale; the client
+        then retries the LEADER once — never counted against the
+        mutation-failover rotation budget (a stale follower is not a
+        dead endpoint)."""
         if isinstance(base_url, (list, tuple)):
             eps = [u.rstrip("/") for u in base_url if u]
         else:
@@ -204,6 +225,13 @@ class RESTClient(Client):
         #: the ring).
         self._endpoints = eps
         self.base_url = eps[0]
+        #: Follower read/watch offload (see class docstring).
+        self.read_affinity = read_affinity and len(eps) > 1
+        #: Staleness bound follower reads tolerate before falling back
+        #: to the leader (sent as X-Ktpu-Max-Staleness; the server
+        #: caps it at its own follower_staleness_bound).
+        self.max_staleness = 2.0
+        self._read_rr = 0
         self._headers = {"Authorization": f"Bearer {token}"} if token else {}
         if impersonate_user:
             self._headers["Impersonate-User"] = impersonate_user
@@ -405,8 +433,33 @@ class RESTClient(Client):
             # A follower with no elected leader refuses BEFORE acting
             # (marked explicitly) — retryable for every verb, like 429.
             err.no_leader = resp.headers.get("X-Ktpu-No-Leader") == "1"
+            # Bounded-staleness refusal of a follower read: retry the
+            # leader (hinted when the follower knows it), never rotate.
+            err.stale = resp.headers.get("X-Ktpu-Stale") == "1"
+            err.leader_url = resp.headers.get("X-Ktpu-Leader", "")
             raise err
         return await resp.json()
+
+    def _read_endpoint(self) -> str:
+        """The follower endpoint the next read routes to: round-robin
+        over the ring EXCLUDING the pinned (write/leader) endpoint, so
+        informer fan-out spreads across followers while the bind path
+        keeps the leader to itself."""
+        others = [ep for ep in self._endpoints if ep != self.base_url]
+        if not others:
+            return self.base_url
+        self._read_rr = (self._read_rr + 1) % len(others)
+        return others[self._read_rr]
+
+    def _retry_endpoint(self, url: str, affinity_read: bool) -> str:
+        """Where a failed request retries: an affinity READ advances to
+        the NEXT follower and never touches ``base_url`` — a crashed
+        or lagging follower must not rotate the write pin off a
+        healthy leader (read failures don't charge the mutation-
+        failover budget). Everything else rotates the ring as before."""
+        if affinity_read and len(self._endpoints) > 1:
+            return self._rebase(url, self._read_endpoint())
+        return self._switch_endpoint(url)
 
     def _switch_endpoint(self, url: str) -> str:
         """Re-pin to the next endpoint in the failover ring and rebase
@@ -481,9 +534,20 @@ class RESTClient(Client):
         ct = aiohttp.ClientTimeout(
             total=self.total_timeout if timeout is None else timeout,
             connect=self.connect_timeout)
+        affinity_read = method == "GET" and self.read_affinity
+        if affinity_read:
+            # Follower read offload: route to a follower endpoint with
+            # the staleness bound attached; writes stay leader-routed.
+            url = self._rebase(url, self._read_endpoint())
+            headers = dict(kw.pop("headers", None) or {})
+            headers.setdefault("X-Ktpu-Max-Staleness",
+                               f"{self.max_staleness:.3f}")
+            kw["headers"] = headers
+            CLIENT_FOLLOWER_READS.inc(outcome="routed")
         backoff = self.backoff_base
         attempt = 0
         redirects = 0
+        stale_used = False
         while True:
             delay = None
             try:
@@ -514,6 +578,22 @@ class RESTClient(Client):
                         continue
                     return await self._check(resp)
             except errors.StatusError as e:
+                if e.code == 503 and getattr(e, "stale", False) \
+                        and not stale_used:
+                    # Bounded-staleness refusal: the follower is ALIVE
+                    # but behind. Retry the leader exactly once —
+                    # immediately, with no attempt charged and no
+                    # endpoint rotation (rotating would walk the ring
+                    # of equally stale followers forever while the
+                    # leader sat reachable the whole time). A second
+                    # stale 503 falls through to the normal retry
+                    # budget below.
+                    stale_used = True
+                    leader = getattr(e, "leader_url", "") or self.base_url
+                    url = self._rebase(url, leader)
+                    CLIENT_FOLLOWER_READS.inc(outcome="stale_fallback")
+                    CLIENT_RETRIES.inc(verb=method, reason="stale-follower")
+                    continue
                 if e.code == 429 and retry_429:
                     reason = "429"
                     delay = getattr(e, "retry_after", None)
@@ -523,13 +603,13 @@ class RESTClient(Client):
                     # in case this endpoint stays leaderless.
                     reason = "no-leader"
                     delay = getattr(e, "retry_after", None)
-                    url = self._switch_endpoint(url)
+                    url = self._retry_endpoint(url, affinity_read)
                 elif idempotent and e.code in _RETRYABLE_STATUS:
                     reason = f"http{e.code}"
                     # A 503 shedding load names its own retry clock
                     # too — honor it over our (much shorter) backoff.
                     delay = getattr(e, "retry_after", None)
-                    url = self._switch_endpoint(url)
+                    url = self._retry_endpoint(url, affinity_read)
                 else:
                     raise
                 if attempt >= self.max_retries:
@@ -549,10 +629,13 @@ class RESTClient(Client):
                     # dropped connection the same way it survives a
                     # 503, instead of dying on an aiohttp type it never
                     # imported.
+                    from urllib.parse import urlsplit
+                    target = urlsplit(url)
                     raise errors.ServiceUnavailableError(
-                        f"transport to {self.base_url}: {e}") from e
+                        f"transport to {target.scheme}://{target.netloc}:"
+                        f" {e}") from e
                 reason = type(e).__name__
-                url = self._switch_endpoint(url)
+                url = self._retry_endpoint(url, affinity_read)
             attempt += 1
             # Full jitter on the capped exponential (reference:
             # client-go flowcontrol.Backoff) — synchronized retry
@@ -691,7 +774,17 @@ class RESTClient(Client):
         timeout = aiohttp.ClientTimeout(
             total=None, connect=self.connect_timeout,
             sock_read=self.watch_idle_timeout)
-        return _RESTWatch(self._sess(), url, params, timeout=timeout).start()
+        headers = None
+        if self.read_affinity:
+            # Watches ride followers too (follower stores are fully
+            # watchable since PR 8); a stale/ended stream surfaces as
+            # CLOSED and the informer relists — through the read
+            # path's leader fallback when followers cannot serve.
+            url = self._rebase(url, self._read_endpoint())
+            headers = {"X-Ktpu-Max-Staleness": f"{self.max_staleness:.3f}"}
+            CLIENT_FOLLOWER_READS.inc(outcome="watch_routed")
+        return _RESTWatch(self._sess(), url, params, timeout=timeout,
+                          headers=headers).start()
 
     async def bind(self, namespace: str, name: str, binding: Binding,
                    decode: bool = True) -> Any:

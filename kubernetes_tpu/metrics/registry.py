@@ -173,12 +173,23 @@ class Histogram(Metric):
         ``sample_limit`` unset). Once observations exceed the limit the
         answer covers the first ``sample_limit`` only — still a real
         measurement, never a bucket edge."""
+        out = self.raw_quantiles((q,), **labels)
+        return out[0] if out else None
+
+    def raw_quantiles(self, qs: Sequence[float],
+                      **labels) -> Optional[list]:
+        """Several nearest-rank percentiles from ONE copy + sort of the
+        retained samples — a scrape-time caller asking for p50/p90/p99
+        of a 120k-sample histogram must not sort it three times under
+        the metric lock (the lock is shared with every observe())."""
         key = _label_key(self.label_names, labels)
         with self._lock:
-            samples = sorted(self._samples.get(key, ()))
+            samples = list(self._samples.get(key, ()))
         if not samples:
             return None
-        return samples[min(len(samples) - 1, int(q * len(samples)))]
+        samples.sort()
+        n = len(samples)
+        return [samples[min(n - 1, int(q * n))] for q in qs]
 
     def quantile(self, q: float, **labels) -> float:
         """Approximate quantile from bucket boundaries (upper bound)."""

@@ -4,6 +4,30 @@ import asyncio
 import time
 
 
+def parse_labeled_family(text: str, metric: str, label: str) -> dict:
+    """``{label_value: float_sample}`` for one single-label Prometheus
+    family out of /metrics text — the ONE parser every harness scrape
+    uses (quantile gauges, loop-lag sums/busy fractions); a registry
+    render-format change breaks one function, not four drifting
+    copies. Lines that fail to parse are skipped; an absent family
+    returns {} (callers treat that as 'server predates the metric')."""
+    out: dict = {}
+    prefix = metric + "{"
+    needle = label + '="'
+    for line in text.splitlines():
+        if not line.startswith(prefix):
+            continue
+        labels, _, value = line.partition("} ")
+        if needle not in labels:
+            continue
+        name = labels.split(needle, 1)[1].split('"', 1)[0]
+        try:
+            out[name] = float(value)
+        except ValueError:
+            continue
+    return out
+
+
 def pct(sorted_vals, q: float) -> float:
     """Nearest-rank percentile from a pre-sorted list — the one
     definition every harness in this package reports with."""

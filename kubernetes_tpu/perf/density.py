@@ -52,14 +52,19 @@ def density_pod(name: str, cpu: float = 0.1, mem: float = 64 * 2**20) -> t.Pod:
                 requests={"cpu": cpu, "memory": mem}))]))
 
 
-async def _spawn_apiserver() -> tuple:
+async def _spawn_apiserver(feature_gates: str = "") -> tuple:
     """Start ``python -m kubernetes_tpu.apiserver`` as a subprocess and
     wait for its LISTENING line. The real-deployment wire path: the
-    apiserver has its own process/GIL, like ``cmd/kube-apiserver``."""
+    apiserver has its own process/GIL, like ``cmd/kube-apiserver``.
+    ``feature_gates``: "Gate=true,..." forwarded to the subprocess —
+    the bench arms flip ApiServerSharding/ApiServerCodecOffload here."""
     import os
     import sys
+    argv = [sys.executable, "-m", "kubernetes_tpu.apiserver", "--port", "0"]
+    if feature_gates:
+        argv += ["--feature-gates", feature_gates]
     proc = await asyncio.create_subprocess_exec(
-        sys.executable, "-m", "kubernetes_tpu.apiserver", "--port", "0",
+        *argv,
         stdout=asyncio.subprocess.PIPE,
         cwd=os.path.dirname(os.path.dirname(os.path.dirname(
             os.path.abspath(__file__)))))
@@ -98,10 +103,31 @@ def _parse_latency_histogram(text: str, name: str, verb: str = "") -> dict:
     return out
 
 
+def _parse_raw_quantiles(text: str) -> dict:
+    """TRUE api-request-latency percentiles from the apiserver's
+    raw-sample quantile gauges (apiserver_request_latency_raw_quantile_ms,
+    recomputed server-side at each scrape). The r05 numbers
+    (p50=0.5/p90=1.0/p99=10.0 ms) were histogram BUCKET EDGES, not
+    measurements — same class of artifact the bind_call_* metrics
+    already fixed. Returns {} when the server predates the gauge."""
+    from . import parse_labeled_family
+    return {f"p{q}_ms": v for q, v in parse_labeled_family(
+        text, "apiserver_request_latency_raw_quantile_ms", "q").items()}
+
+
+def _parse_loop_busy(text: str) -> dict:
+    """Per-loop busy fractions (EWMA gauges) from /metrics text —
+    the loop-lag probe's router/shard attribution snapshot."""
+    from . import parse_labeled_family
+    return parse_labeled_family(text, "apiserver_loop_busy_fraction",
+                                "loop")
+
+
 async def _run_density_rest(n_nodes: int, n_pods: int, timeout: float,
                             create_concurrency: int,
                             max_pods_per_node: int,
-                            paced_pods: int, paced_rate: float) -> dict:
+                            paced_pods: int, paced_rate: float,
+                            feature_gates: str = "") -> dict:
     """The via='rest' arm of :func:`run_density`: apiserver and loadgen
     subprocesses, scheduler in-process, everything over HTTP. Every
     child is terminated on any failure path."""
@@ -109,7 +135,7 @@ async def _run_density_rest(n_nodes: int, n_pods: int, timeout: float,
     import sys
 
     from ..client.rest import RESTClient
-    server_proc, port = await _spawn_apiserver()
+    server_proc, port = await _spawn_apiserver(feature_gates)
     sched = client = sched_client = gen = None
     try:
         client = RESTClient(f"http://127.0.0.1:{port}")
@@ -149,8 +175,15 @@ async def _run_density_rest(n_nodes: int, n_pods: int, timeout: float,
         import aiohttp
         async with aiohttp.ClientSession() as s:
             async with s.get(client.base_url + "/metrics") as r:
-                api_latency = _parse_latency_histogram(
-                    await r.text(), "apiserver_request_latency_seconds")
+                metrics_text = await r.text()
+        api_latency = _parse_raw_quantiles(metrics_text)
+        if not api_latency:
+            # Pre-raw-gauge server: bucket-edge quantiles, marked so
+            # the number is never mistaken for a measurement.
+            api_latency = _parse_latency_histogram(
+                metrics_text, "apiserver_request_latency_seconds")
+            api_latency["approx"] = "bucket-upper-bound"
+        loop_busy = _parse_loop_busy(metrics_text)
     finally:
         if sched is not None:
             await sched.stop()
@@ -173,6 +206,10 @@ async def _run_density_rest(n_nodes: int, n_pods: int, timeout: float,
         "max_pods_per_node": max_pods_per_node,
         "api_request_latency": api_latency,
     }
+    if feature_gates:
+        out["feature_gates"] = feature_gates
+    if loop_busy:
+        out["apiserver_loop_busy"] = loop_busy
     out.update(_bind_call_percentiles())
     out.update(load)  # pods, wall, pods/s, external schedule latencies
     return out
@@ -202,7 +239,8 @@ async def run_density(n_nodes: int = 100, n_pods: int = 3000,
                       create_concurrency: int = 64,
                       max_pods_per_node: int = 110,
                       paced_pods: int = 300,
-                      paced_rate: float = 100.0) -> dict:
+                      paced_rate: float = 100.0,
+                      feature_gates: str = "") -> dict:
     """Create nodes, start the scheduler, pour pods in, wait until every
     pod is bound. Returns throughput + latency percentiles.
 
@@ -225,7 +263,7 @@ async def run_density(n_nodes: int = 100, n_pods: int = 3000,
     if via == "rest":
         return await _run_density_rest(
             n_nodes, n_pods, timeout, create_concurrency, max_pods_per_node,
-            paced_pods, paced_rate)
+            paced_pods, paced_rate, feature_gates=feature_gates)
 
     reg = Registry()
     reg.admission = default_chain(reg)

@@ -138,6 +138,36 @@ class _BoundWatcher:
             await self._session.close()
 
 
+async def _scrape_loop_lag(session: aiohttp.ClientSession,
+                           server: str) -> dict:
+    """{loop_name: cumulative lag_ms} from the apiserver's loop-lag
+    probe (apiserver_loop_lag_ms_sum per router/shard loop); {} when
+    the server predates the probe or the scrape fails. Per-phase
+    DELTAS of this divided by phase wall time are the event-loop busy
+    share the bench reports — the instrument that attributes a flat
+    pods/s curve to the loop (wall) vs everything else."""
+    try:
+        async with session.get(f"{server}/metrics") as resp:
+            if resp.status != 200:
+                return {}
+            text = await resp.text()
+    except Exception:  # noqa: BLE001 — metrics are best-effort here
+        return {}
+    from . import parse_labeled_family
+    return parse_labeled_family(text, "apiserver_loop_lag_ms_sum", "loop")
+
+
+def _loop_busy_share(before: dict, after: dict, wall: float) -> dict:
+    """Per-loop busy share over one phase: seconds the loop ran BEHIND
+    schedule per second of wall time (loop-lag derived; >0.5 means the
+    loop, not the workload, is the wall)."""
+    if not after or wall <= 0:
+        return {}
+    return {name: round((after.get(name, 0.0) - before.get(name, 0.0))
+                        / 1e3 / wall, 4)
+            for name in after}
+
+
 async def run_load(server: str, n_pods: int, concurrency: int = 64,
                    timeout: float = 600.0, namespace: str = "default",
                    paced_pods: int = 300, rate: float = 100.0,
@@ -185,10 +215,15 @@ async def run_load(server: str, n_pods: int, concurrency: int = 64,
                             raise r
             await asyncio.gather(*(worker() for _ in range(concurrency)))
 
+        lag_start = await _scrape_loop_lag(watcher._session, server)
         start = time.perf_counter()
         await create_all()
         await watcher.wait_for(n_pods, timeout)
         wall = time.perf_counter() - start
+        lag_sat = await _scrape_loop_lag(watcher._session, server)
+        busy_sat = _loop_busy_share(lag_start, lag_sat, wall)
+        if busy_sat:
+            out["apiserver_loop_busy_saturation"] = busy_sat
         sat_lats = sorted(watcher.bound_at[n] - created_at[n]
                           for n in watcher.bound_at
                           if n in created_at and n not in watcher.relisted)
@@ -211,6 +246,7 @@ async def run_load(server: str, n_pods: int, concurrency: int = 64,
 
         # Phase B: paced latency (closed-ish loop below saturation).
         if paced_pods > 0 and rate > 0:
+            paced_t0 = time.perf_counter()
             paced_created = await run_paced_creates(
                 paced_pods, rate,
                 lambda name: client.create(density_pod(name)))
@@ -218,6 +254,11 @@ async def run_load(server: str, n_pods: int, concurrency: int = 64,
             out.update({"paced_pods": paced_pods, "paced_rate": rate})
             out.update(latency_percentiles(paced_created, watcher.bound_at,
                                            exclude=watcher.relisted))
+            lag_paced = await _scrape_loop_lag(watcher._session, server)
+            busy_paced = _loop_busy_share(
+                lag_sat, lag_paced, time.perf_counter() - paced_t0)
+            if busy_paced:
+                out["apiserver_loop_busy_paced"] = busy_paced
     finally:
         poke.cancel()
         await watcher.stop()

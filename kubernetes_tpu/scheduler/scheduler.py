@@ -188,6 +188,7 @@ class Scheduler:
         self.backoff_seconds = backoff_seconds
         self._informers: list[SharedInformer] = []
         self._pod_informer: Optional[SharedInformer] = None
+        self._group_informer: Optional[SharedInformer] = None
         self._task: Optional[asyncio.Task] = None
         self._stopped = False
         #: Out-of-process filter/prioritize webhooks (extender.py;
@@ -246,6 +247,7 @@ class Scheduler:
         groups.add_handlers(on_add=self._group_changed_add,
                             on_update=self._group_changed,
                             on_delete=self._group_deleted)
+        self._group_informer = groups
         self._informers = [pods, nodes, groups]
         for inf in self._informers:
             if inf._task is None:
@@ -365,6 +367,15 @@ class Scheduler:
                 raise
             except Exception:  # noqa: BLE001
                 log.exception("scheduleOne panic")
+                if isinstance(item, GangUnit):
+                    # A popped gang unit is the ONLY copy of the
+                    # release decision — single pods re-enter via
+                    # informer resyncs, but a dropped gang unit never
+                    # re-releases (all members stay staged, min is
+                    # already known, no further transition fires).
+                    # Found by tpusan: a mid-failover GET panic here
+                    # wedged the gang for good.
+                    await self.queue.requeue(item, self.backoff_seconds)
 
     async def _schedule_one(self, pod: t.Pod) -> None:
         start = time.perf_counter()
@@ -954,7 +965,28 @@ class Scheduler:
         try:
             group = await self.client.get("podgroups", ns, name)
         except errors.NotFoundError:
+            if self._group_informer is not None \
+                    and self._group_informer.store.get(
+                        unit.group_key) is not None:
+                # The live GET answered 404 but OUR informer still
+                # holds the group: a bounded-staleness follower read
+                # legitimately misses a JUST-CREATED object — that is
+                # not a deletion, and dropping the popped unit on it
+                # wedges the gang forever (nothing re-releases: every
+                # member is staged and min is known). Requeue; the
+                # follower catches up within the staleness bound.
+                # Found by tpusan exploring the read-affinity path.
+                await self.queue.requeue(unit, self.backoff_seconds)
+                return
             self._preempt_started.pop(unit.group_key, None)
+            return
+        except errors.StatusError:
+            # Transport failure (control-plane failover window, retries
+            # exhausted): the unit is already POPPED — dropping it here
+            # would wedge the gang forever, because release fires only
+            # on informer transitions and every member is already
+            # staged. Requeue and retry after backoff.
+            await self.queue.requeue(unit, self.backoff_seconds)
             return
         if group_suspended(group):
             # Raced a quota reclaim (suspension landed after this unit

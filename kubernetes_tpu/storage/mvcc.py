@@ -179,17 +179,41 @@ class Watch:
         """None on timeout; None with ``self.closed`` set on stream end."""
         if self.closed:
             return None
-        if timeout is None:
-            ev = await self._queue.get()
-        else:
-            try:
-                ev = await asyncio.wait_for(self._queue.get(), timeout)
-            except asyncio.TimeoutError:
-                return None
+        try:
+            # Fast path: an already-queued event needs no wait_for —
+            # at fan-out scale the per-event timer + task churn of
+            # wait_for was measurable event-loop time.
+            ev = self._queue.get_nowait()
+        except asyncio.QueueEmpty:
+            if timeout is None:
+                ev = await self._queue.get()
+            else:
+                try:
+                    ev = await asyncio.wait_for(self._queue.get(), timeout)
+                except asyncio.TimeoutError:
+                    return None
         if ev is None:
             self.closed = True
         else:
             self._consumed()
+        return ev
+
+    def next_nowait(self) -> Optional[WatchEvent]:
+        """An already-delivered event, or None when the queue is empty
+        (or the stream just ended — ``self.closed`` distinguishes).
+        The watch fan-out's drain primitive: after one awaited event,
+        the server batches every event already in flight into a single
+        socket write instead of one syscall per event per watcher."""
+        if self.closed:
+            return None
+        try:
+            ev = self._queue.get_nowait()
+        except asyncio.QueueEmpty:
+            return None
+        if ev is None:
+            self.closed = True
+            return None
+        self._consumed()
         return ev
 
 

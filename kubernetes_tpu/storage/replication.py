@@ -232,6 +232,12 @@ class ReplicaNode:
         self.leader_id: Optional[str] = None
         self.crashed = False
         self.commit_rev = store.revision
+        #: Monotonic stamp of the last append/snapshot observed from a
+        #: live leader — the follower-read staleness clock: a follower
+        #: that heard from its leader within the client's bound serves
+        #: the read; one that has not (partition, election) answers
+        #: 503 + X-Ktpu-Stale so the client falls back to the leader.
+        self.last_leader_contact: Optional[float] = None
         #: Last log coordinate. A fresh store boots the common term-0
         #: base; a RECOVERED store resumes the term its last durable
         #: record was written under (persisted in every WAL record and
@@ -335,6 +341,11 @@ class ReplicaNode:
         restart would recover); peers notice only by missed
         heartbeats."""
         self.crashed = True
+        # The frozen store may hold a divergent uncommitted tail (the
+        # minority-holder case a rejoin snapshots away) — tell the
+        # sanitizer to exclude it from the committed-never-lost sweep
+        # until a rebuild re-registers the node.
+        invariants.note_replica_down(self.group, self.node_id)
         for t in list(self._tasks):
             t.cancel()
         self._fail_waiters("replica crashed before the write committed")
@@ -665,7 +676,20 @@ class ReplicaNode:
         if msg["term"] > self.term or self.state != FOLLOWER:
             self._step_down(msg["term"], leader=msg["leader"])
         self.leader_id = msg["leader"]
+        self.last_leader_contact = time.monotonic()
         self._hb_seen.set()
+
+    def read_staleness(self) -> float:
+        """Seconds since this replica last heard from a live leader —
+        the bounded-staleness answer for follower reads. 0 on the
+        leader itself; +inf before any leader contact (elections, a
+        just-booted replica): reads with ANY finite bound then fall
+        back to the leader."""
+        if self.is_leader:
+            return 0.0
+        if self.last_leader_contact is None:
+            return float("inf")
+        return max(0.0, time.monotonic() - self.last_leader_contact)
 
     def _handle_append(self, msg: dict) -> dict:
         if msg["term"] < self.term:

@@ -80,6 +80,23 @@ KNOWN_FEATURES = {f.name: f for f in [
             "standbys keep informers warm and take over on leader "
             "stop/crash — two schedulers can never double-bind. Off = "
             "the scheduler runs unconditionally, as before"),
+    Feature("ApiServerSharding", False, ALPHA,
+            "resource-group sharded apiserver workers: non-watch "
+            "resource requests dispatch to per-group worker loops "
+            "(pods/bindings, nodes, queueing, events) over the shared "
+            "MVCC/WAL, behind a router that keeps the URL surface and "
+            "watch semantics byte-identical (apiserver/sharding.py). "
+            "Off = every request runs on the single router loop, "
+            "byte-identical to the unsharded apiserver"),
+    Feature("ApiServerCodecOffload", False, ALPHA,
+            "process-pool JSON codec offload: encode-cache misses on "
+            "large LIST assembly and decode of large request bodies "
+            "dispatch to a concurrent.futures pool behind the "
+            "serialize-once cache (apiserver/codecpool.py), with a "
+            "size threshold so small objects stay inline; on hosts "
+            "without spare cores the pool stays inline (metric-"
+            "visible). Off = all codec work runs on the event loop, "
+            "byte-identical"),
     Feature("GracefulPreemption", False, ALPHA,
             "checkpoint-aware gang preemption (preemption.py): signal "
             "the gang (SIGTERM + KTPU_PREEMPT file), wait bounded by "

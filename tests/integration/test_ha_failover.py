@@ -177,3 +177,76 @@ async def test_kill_the_leader_smoke_converges():
     assert report["new_leader"] != report["killed"]
     assert report["pods_bound"] == 4
     assert report["time_to_new_leader_s"] > 0
+
+
+async def test_read_affinity_routes_reads_to_followers(tmp_path):
+    """read_affinity: reads carry the staleness bound and land on a
+    follower endpoint (the pinned/leader endpoint keeps the writes);
+    results are the same objects the leader serves."""
+    from kubernetes_tpu.client.rest import CLIENT_FOLLOWER_READS
+    plane, leader = await _mk_plane(tmp_path)
+    client = None
+    try:
+        client = RESTClient(plane.endpoints(), read_affinity=True)
+        client.backoff_base = 0.02
+        # Pin writes to the leader first (307 re-pin).
+        await client.create(t.ConfigMap(metadata=ObjectMeta(
+            name="ra-seed", namespace="default")))
+        assert client.base_url == leader.node.advertise_url
+        await repl.wait_converged([m.node for m in plane.members], 5.0)
+        routed = CLIENT_FOLLOWER_READS.value(outcome="routed")
+        items, _rev = await client.list("configmaps", "default")
+        assert any(c.metadata.name == "ra-seed" for c in items)
+        assert CLIENT_FOLLOWER_READS.value(outcome="routed") > routed
+        # The read endpoint round-robins over non-pinned endpoints.
+        assert client._read_endpoint() != client.base_url
+    finally:
+        if client is not None:
+            await client.close()
+        await plane.stop()
+
+
+async def test_stale_follower_falls_back_to_leader_once(tmp_path):
+    """A follower that cannot meet the staleness bound answers 503 +
+    X-Ktpu-Stale; the client retries the LEADER once — satellite
+    contract: the stale 503 is never charged to the failover rotation
+    budget (base_url stays pinned, no endpoint rotation)."""
+    from kubernetes_tpu.client.rest import CLIENT_FOLLOWER_READS
+    plane, leader = await _mk_plane(tmp_path)
+    client = None
+    try:
+        client = RESTClient(plane.endpoints(), read_affinity=True)
+        client.backoff_base = 0.02
+        await client.create(t.ConfigMap(metadata=ObjectMeta(
+            name="stale-seed", namespace="default")))
+        assert client.base_url == leader.node.advertise_url
+        await repl.wait_converged([m.node for m in plane.members], 5.0)
+        # A zero staleness bound only the leader (staleness 0 by
+        # definition) can meet — every follower refuses regardless of
+        # heartbeat timing, so the test cannot race the 20ms renewal.
+        client.max_staleness = 0.0
+        fallbacks = CLIENT_FOLLOWER_READS.value(outcome="stale_fallback")
+        pinned = client.base_url
+        items, _rev = await client.list("configmaps", "default")
+        assert any(c.metadata.name == "stale-seed" for c in items)
+        assert CLIENT_FOLLOWER_READS.value(
+            outcome="stale_fallback") > fallbacks
+        # No rotation: the write pin is untouched by the stale read.
+        assert client.base_url == pinned
+    finally:
+        if client is not None:
+            await client.close()
+        await plane.stop()
+
+
+async def test_scaleout_smoke_converges():
+    """The PR-9 acceptance scenario: sharded apiservers + follower
+    read/watch affinity + queue admission, leader crashed mid-wave —
+    same convergence bars as the plain smoke."""
+    report = await run_ha_smoke(4321, n_nodes=2, gangs=2, timeout=30.0,
+                                sharded=True, read_affinity=True,
+                                queued=True)
+    assert report["acked_lost"] == 0
+    assert report["replicas_identical"] and report["replay_identical"]
+    assert report["pods_bound"] == 4
+    assert report["queued_admitted"]

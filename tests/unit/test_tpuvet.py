@@ -228,6 +228,36 @@ G = Counter("replication_elections_total", "re-registered: silently inert")
     assert len(got) == 1 and "already registered" in got[0].message
 
 
+def test_metric_name_scaleout_families():
+    """The control-plane scale-out metric families — apiserver shard
+    workers (apiserver_shard_*), the process-pool codec offload
+    (codec_pool_*), the loop-lag probe, and the client follower-read
+    counter — are valid names, and a duplicate registration within
+    the family is still caught."""
+    good = """
+from kubernetes_tpu.metrics.registry import Counter, Gauge, Histogram
+A = Counter("apiserver_shard_requests_total", "x", labels=("shard",))
+B = Counter("apiserver_shard_inline_total", "x")
+C = Gauge("apiserver_shard_inflight", "x", labels=("shard",))
+D = Counter("codec_pool_submits_total", "x", labels=("op",))
+E = Counter("codec_pool_inline_total", "x", labels=("op", "reason"))
+F = Counter("codec_pool_items_total", "x", labels=("op",))
+G = Gauge("codec_pool_workers", "x")
+H = Counter("codec_pool_stale_drops_total", "x")
+I = Counter("client_follower_read_total", "x", labels=("outcome",))
+J = Histogram("apiserver_loop_lag_ms", "x", labels=("loop",))
+K = Gauge("apiserver_loop_busy_fraction", "x", labels=("loop",))
+L = Histogram("apiserver_request_latency_raw_seconds", "x")
+M = Gauge("apiserver_request_latency_raw_quantile_ms", "x", labels=("q",))
+"""
+    assert run_source(good, checks=["metric-name"]) == []
+    bad = good + """
+N = Counter("codec_pool_submits_total", "re-registered: silently inert")
+"""
+    got = run_source(bad, checks=["metric-name"])
+    assert len(got) == 1 and "already registered" in got[0].message
+
+
 def test_metric_name_queueing_family():
     """The job-queueing metric family (queue_*) is valid, and a
     duplicate registration within the family is still caught."""
