@@ -10,7 +10,10 @@
 # checkpoint, shrink, regrow, converge + the goodput gate),
 # hack/ha_smoke.sh (<90s replicated control plane: kill the leader
 # mid-wave, standby elected, zero acked writes lost, byte-identical
-# convergence), hack/race.sh (<150s tpusan gate: chaos + queue +
+# convergence), hack/trace_smoke.sh (ktrace gate: a LocalCluster gang
+# reconstructs a complete create->ready trace through ktl, and the
+# gated 200n/2k arm holds its floor with default sampling within 3%
+# of tracing-off), hack/race.sh (<150s tpusan gate: chaos + queue +
 # preempt + HA smokes under explored task-interleaving schedules with
 # the cluster invariants armed) — all run on full-suite invocations;
 # filtered runs skip them, KTPU_SMOKE=1 forces them.
@@ -23,6 +26,7 @@ if [ "$#" -eq 0 ] || [ "${KTPU_SMOKE:-}" = "1" ]; then
   ./hack/queue_smoke.sh
   ./hack/preempt_smoke.sh
   ./hack/ha_smoke.sh
+  ./hack/trace_smoke.sh
   ./hack/race.sh
 fi
 exec python -m pytest tests/ -q "$@"

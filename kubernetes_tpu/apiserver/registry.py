@@ -21,6 +21,7 @@ import json
 from dataclasses import dataclass, field as dc_field
 from typing import Any, Callable, Optional
 
+from .. import tracing
 from ..api import errors, extensions as ext, networking as net, \
     queueing as qapi, rbac as r, types as t, validation as val, \
     workloads as w
@@ -323,6 +324,34 @@ class Registry:
             meta.namespace = ""
         stamp_new(meta)
         meta.generation = 1
+        # ktrace root: sampled Pods/PodGroups get a durable traceparent
+        # annotation pointing at their "create" span — the id then
+        # rides every watch event, so informers/agents that never saw
+        # this request still join the trace. Disarmed (default): one
+        # bool check; armed-but-unsampled: one rng call, no annotation.
+        create_span = None
+        if not dry_run and tracing.armed() \
+                and spec.plural in ("pods", "podgroups"):
+            anns = meta.annotations
+            if tracing.TRACEPARENT_ANNOTATION not in anns:
+                obj_key = f"{meta.namespace}/{meta.name}" \
+                    if meta.namespace else meta.name
+                attrs = {("pod" if spec.plural == "pods" else "group"):
+                         obj_key}
+                parent = tracing.current()
+                if parent is not None and parent.sampled:
+                    # A traced caller (its request/server span) roots
+                    # this object's lifecycle in ITS trace.
+                    create_span = tracing.start_span(
+                        "create", component="apiserver", parent=parent,
+                        attrs=attrs)
+                else:
+                    create_span = tracing.root_span(
+                        "create", component="apiserver", attrs=attrs)
+                ctx = create_span.context()
+                if ctx is not None:
+                    anns[tracing.TRACEPARENT_ANNOTATION] = \
+                        tracing.encode(ctx)
         if (spec.has_status and hasattr(obj, "status")
                 and not spec.preserve_status_on_create):
             # Strategy PrepareForCreate: clients cannot seed status.
@@ -363,6 +392,10 @@ class Registry:
         if isinstance(obj, ext.CustomResourceDefinition):
             self._install_crd(obj)
         meta.resource_version = str(rev)
+        if create_span is not None:
+            # Ends only on SUCCESS: a failed create's span is dropped
+            # (never collected), matching "no object, no trace".
+            create_span.end()
         return obj
 
     def create_batch(self, objs: list) -> list:

@@ -29,9 +29,11 @@ from typing import Optional
 
 from aiohttp import web
 
+from .. import tracing
 from ..api import errors
 from ..api.scheme import deepcopy as obj_deepcopy, to_dict
 from ..metrics.registry import REGISTRY as METRICS, Counter, Gauge, Histogram
+from ..util.loopprobe import loop_lag_probe
 from ..util.tasks import spawn
 from .admission import default_chain
 from .audit import AuditLogger
@@ -255,6 +257,20 @@ class APIServer:
         is_watch = (request.method == "GET"
                     and not request.match_info.get("name")
                     and request.query.get("watch") in ("1", "true"))
+        # ktrace server span: a sampled traceparent header joins this
+        # request to the caller's trace; the span is ACTIVATED so
+        # everything downstream (registry create stamps, admission,
+        # recorder events) nests under it. No header / disarmed: one
+        # check, the shared no-op span.
+        server_span = tracing.NOOP_SPAN
+        if tracing.armed() and not is_watch:
+            tctx = tracing.decode(
+                request.headers.get(tracing.TRACEPARENT_HEADER))
+            if tctx is not None and tctx.sampled:
+                server_span = tracing.start_span(
+                    f"{request.method} "
+                    f"{request.match_info.get('plural') or request.path}",
+                    component="apiserver", parent=tctx).activate()
         start = time.perf_counter()
         code = 500
         admitted = False
@@ -324,6 +340,7 @@ class APIServer:
             log.exception("handler panic on %s %s", request.method, request.path)
             return self._err(errors.StatusError(f"internal error: {e}"))
         finally:
+            server_span.end(code=code)
             if admitted:
                 self._inflight -= 1
             elapsed = time.perf_counter() - start
@@ -638,20 +655,13 @@ class APIServer:
                                  headers=headers)
 
     async def _loop_lag_probe(self, name: str) -> None:
-        """Lightweight event-loop lag probe: how late a short sleep
-        fires is the time this loop spent busy (or starved by sibling
-        processes) per tick. _sum/_count deltas let the bench arms
-        attribute per-phase wall-vs-loop time; the gauge is a local
-        EWMA for eyeballing /metrics."""
-        loop = asyncio.get_running_loop()
-        busy = 0.0
-        while True:
-            t0 = loop.time()
-            await asyncio.sleep(LOOP_PROBE_INTERVAL)
-            lag = max(0.0, loop.time() - t0 - LOOP_PROBE_INTERVAL)
-            LOOP_LAG.observe(lag * 1e3, loop=name)
-            busy = 0.8 * busy + 0.2 * (lag / (lag + LOOP_PROBE_INTERVAL))
-            LOOP_BUSY.set(round(busy, 4), loop=name)
+        """Lightweight event-loop lag probe (util/loopprobe.py — one
+        implementation shared with the scheduler's
+        scheduler_loop_lag_ms family): _sum/_count deltas let the
+        bench arms attribute per-phase wall-vs-loop time; the gauge is
+        a local EWMA for eyeballing /metrics."""
+        await loop_lag_probe(LOOP_LAG, LOOP_BUSY,
+                             interval=LOOP_PROBE_INTERVAL, loop=name)
 
     def _start_shard_probe(self, name: str, loop) -> None:
         """Give a freshly spawned shard worker loop its own lag probe
@@ -725,6 +735,13 @@ class APIServer:
         r.add_get("/ha/v1/status", self._ha_status)
         r.add_get("/version", self._version)
         r.add_get("/metrics", self._metrics)
+        # ktrace surface (non-resource path: authn-only, like /metrics):
+        # GET serves this process's bounded span collector — in a
+        # LocalCluster every component shares the process, so one GET
+        # sees the whole pod lifecycle; POST ingests spans pushed by
+        # out-of-process components (multi-host agents).
+        r.add_get("/debug/v1/traces", self._debug_traces)
+        r.add_post("/debug/v1/traces", self._debug_traces_ingest)
         r.add_get("/apis", self._discovery)
         # kubeadm-join analog: exchange a bootstrap token for a durable
         # node credential (bootstrap.py; the CSR-signing step's end
@@ -1065,6 +1082,36 @@ class APIServer:
             for q, v in zip((50, 90, 99), vals):
                 REQUEST_LATENCY_RAW_Q.set(round(v * 1e3, 3), q=str(q))
         return web.Response(text=METRICS.render(), content_type="text/plain")
+
+    async def _debug_traces(self, request):
+        """``GET /debug/v1/traces?trace_id=&pod=&component=&limit=`` —
+        matching spans from the in-process collector, oldest first
+        (``ktl trace pod|gang`` reads this)."""
+        q = request.query
+        limit = self._int_param(q.get("limit", "0") or "0", "limit")
+        # No default cap beyond the collector's own ring bound: a
+        # silent half-buffer truncation would read as "incomplete
+        # traces" to an investigation exporting everything.
+        spans = tracing.COLLECTOR.snapshot(
+            trace_id=q.get("trace_id", ""), pod=q.get("pod", ""),
+            component=q.get("component", ""), limit=limit)
+        return web.json_response({
+            "spans": spans,
+            "dropped": tracing.COLLECTOR.dropped,
+            "buffered": len(tracing.COLLECTOR),
+        })
+
+    async def _debug_traces_ingest(self, request):
+        """``POST {"spans": [...]}`` — span ingest for out-of-process
+        components. Malformed items are skipped, never an error: a
+        telemetry push must not drive a remote agent into backoff."""
+        body = await self._body_obj(request)
+        spans = body.get("spans") if isinstance(body, dict) else None
+        if not isinstance(spans, list):
+            raise errors.BadRequestError(
+                'body must be {"spans": [span, ...]}')
+        return web.json_response(
+            {"ingested": tracing.COLLECTOR.ingest(spans)})
 
     async def _discovery(self, request):
         out = []

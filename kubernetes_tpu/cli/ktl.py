@@ -12,7 +12,9 @@ objects via the scheme). Commands::
     ktl logs <pod> [-c container] [--tail N] [-n ns]
     ktl scale <resource> <name> --replicas N
     ktl cordon/uncordon/drain <node>
-    ktl top [node]                  (summary-API scrape incl. chips)
+    ktl top [nodes|pods|<node>]     (summary-API scrape incl. chips;
+                                     'nodes'/'pods' = TPU telemetry views)
+    ktl trace pod|gang <name>       (ktrace lifecycle timeline + events)
     ktl api-resources | version
 
 Server discovery: ``--server`` > ``$KTL_SERVER`` > the file written by
@@ -1352,11 +1354,108 @@ async def cmd_drain(args) -> int:
         await client.close()
 
 
+async def _node_summaries(client, only: str = "") -> list[tuple]:
+    """(node, /stats/summary JSON or None) per node — the scrape the
+    ``ktl top`` family and the cluster monitor share the shape of.
+    Concurrent over one shared session (like ClusterMonitor.sweep):
+    sequential 5s timeouts across a fleet with a few dead node agents
+    would stall the command for minutes."""
+    import aiohttp
+    nodes, _ = await client.list("nodes")
+    if only:
+        nodes = [n for n in nodes if n.metadata.name == only]
+        if not nodes:
+            raise SystemExit(f"ktl: node {only!r} not found")
+
+    async def scrape(node, session):
+        conn = await _node_daemon_base(client, node.metadata.name)
+        if conn is None:
+            return (node, None)
+        base, node_ssl = conn
+        try:
+            async with session.get(f"{base}/stats/summary",
+                                   timeout=aiohttp.ClientTimeout(total=5),
+                                   **_ssl_kw(node_ssl)) as r:
+                return (node, await r.json())
+        except Exception:  # noqa: BLE001 — node down: show unreachable
+            return (node, None)
+
+    async with aiohttp.ClientSession() as session:
+        return list(await asyncio.gather(
+            *(scrape(node, session) for node in nodes)))
+
+
+async def _top_nodes(client) -> int:
+    """``ktl top nodes`` — per-node TPU telemetry rollup (the
+    aggregator's tpu_node_* view, computed from the same scrapes)."""
+    from ..monitoring.aggregator import ClusterMonitor
+    rows = []
+    per_pod: dict = {}
+    for node, summary in await _node_summaries(client):
+        if summary is None:
+            rows.append([node.metadata.name, "-", "-", "-", "-", "-", "-",
+                         "unreachable"])
+            continue
+        agg = ClusterMonitor._aggregate_node(
+            node.metadata.name, summary, per_pod)
+        rows.append([
+            node.metadata.name,
+            str(agg["chips"]),
+            str(agg["healthy"]),
+            str(agg["assigned"]),
+            f"{agg['duty_avg_pct']:.1f}%" if agg["chips"] else "-",
+            (f"{agg['hbm_used_bytes'] / 2**30:.1f}Gi/"
+             f"{agg['hbm_total_bytes'] / 2**30:.1f}Gi"
+             if agg["hbm_total_bytes"] else "-"),
+            (f"{agg['tokens_per_sec']:.0f}"
+             if agg["tokens_per_sec"] else "-"),
+            f"{agg['pods']} pods"])
+    print(printers.render_table(
+        ["NODE", "CHIPS", "HEALTHY", "ASSIGNED", "DUTY", "HBM",
+         "TOK/S", "WORKLOAD"], rows))
+    return 0
+
+
+async def _top_pods(client) -> int:
+    """``ktl top pods`` — per-pod chip attribution + live telemetry
+    (duty cycle, HBM, tokens/s, MFU) across the fleet."""
+    from ..monitoring.aggregator import ClusterMonitor
+    per_pod: dict = {}
+    for node, summary in await _node_summaries(client):
+        if summary is not None:
+            ClusterMonitor._aggregate_node(
+                node.metadata.name, summary, per_pod)
+    rows = []
+    for pkey in sorted(per_pod):
+        rec = per_pod[pkey]
+        rows.append([
+            pkey, rec.get("node", "-"),
+            str(rec.get("chips", 0)),
+            (f"{rec['duty_avg_pct']:.1f}%"
+             if rec.get("chips") else "-"),
+            (f"{rec['hbm_used_bytes'] / 2**30:.1f}Gi"
+             if rec.get("hbm_used_bytes") else "-"),
+            (f"{rec['tokens_per_sec']:.0f}"
+             if "tokens_per_sec" in rec else "-"),
+            (f"{rec['mfu'] * 100:.2f}%" if "mfu" in rec else "-"),
+            (f"{rec['memory_rss_bytes'] / 2**20:.0f}Mi"
+             if rec.get("memory_rss_bytes") else "-")])
+    print(printers.render_table(
+        ["POD", "NODE", "CHIPS", "DUTY", "HBM", "TOK/S", "MFU",
+         "MEMORY"], rows))
+    return 0
+
+
 async def cmd_top(args) -> int:
-    """Scrape /stats/summary from one node (or all) — nodes, pods and
-    per-chip attribution/health."""
+    """Scrape /stats/summary — ``ktl top`` (legacy chip view),
+    ``ktl top nodes`` / ``ktl top pods`` (TPU telemetry rollups), or
+    ``ktl top <node>`` (one node's chip view)."""
     client = make_client(args)
     try:
+        if args.node == "nodes":
+            return await _top_nodes(client)
+        if args.node == "pods":
+            return await _top_pods(client)
         nodes, _ = await client.list("nodes")
         if args.node:
             nodes = [n for n in nodes if n.metadata.name == args.node]
@@ -1397,6 +1496,201 @@ async def cmd_top(args) -> int:
             print(printers.render_table(
                 ["NODE", "CHIP", "HEALTH", "COORDS", "ASSIGNED-TO",
                  "MFU", "TOK/S", "HBM"], chip_rows))
+        return 0
+    finally:
+        await client.close()
+
+
+async def _fetch_trace_spans(client, trace_id: str = "",
+                             pod: str = "") -> list:
+    """Spans from the apiserver's /debug/v1/traces surface (the
+    client's own session carries CA trust + credentials)."""
+    params = {}
+    if trace_id:
+        params["trace_id"] = trace_id
+    if pod:
+        params["pod"] = pod
+    async with client._sess().get(f"{client.base_url}/debug/v1/traces",
+                                  params=params) as r:
+        if r.status != 200:
+            raise SystemExit(f"ktl: /debug/v1/traces answered {r.status}")
+        data = await r.json()
+    return data.get("spans", [])
+
+
+async def _pod_events(client, namespace: str, pod, trace_id: str) -> list:
+    """(epoch ts, text, in_trace) for the pod's Events — interleaved
+    into the trace rendering; ``in_trace`` marks events whose
+    trace.tpu/trace-id annotation matches (the recorder's breadcrumb)."""
+    from .. import tracing
+    try:
+        events, _ = await client.list("events", namespace)
+    except errors.StatusError:
+        return []
+    out = []
+    for ev in events:
+        ref = ev.involved_object
+        if ref.name != pod.metadata.name \
+                or (ref.uid and ref.uid != pod.metadata.uid):
+            continue
+        ts = ev.first_timestamp
+        epoch = ts.timestamp() if ts is not None else 0.0
+        tagged = ev.metadata.annotations.get(
+            tracing.TRACE_ID_ANNOTATION, "")
+        out.append((epoch, f"{ev.type} {ev.reason}: {ev.message}",
+                    bool(trace_id) and tagged == trace_id))
+    out.sort()
+    return out
+
+
+def _render_trace(title: str, trace_id: str, spans: list,
+                  events: list) -> str:
+    """One pod's trace: stage breakdown table, then the span tree with
+    Events interleaved in time order."""
+    from ..tracing import timeline as tlmod
+    lines = [f"TRACE {trace_id}  {title}"]
+    tline = tlmod.pod_timeline(spans)
+    if tline is not None:
+        lines.append(f"  e2e {tline['e2e_ms']:.1f}ms  "
+                     f"complete={str(tline['complete']).lower()}")
+        rows = [[st["stage"], f"+{st['start_ms']:.1f}ms",
+                 f"{st['duration_ms']:.1f}ms",
+                 f"{st['share'] * 100:.1f}%"]
+                for st in tline["stages"]]
+        lines.append(printers.render_table(
+            ["STAGE", "START", "DURATION", "SHARE"], rows))
+    by_id = {s.get("span_id"): s for s in spans}
+
+    def depth(s) -> int:
+        d, cur = 0, s
+        while d < 16:
+            parent = by_id.get(cur.get("parent_id") or "")
+            if parent is None:
+                return d
+            d, cur = d + 1, parent
+        return d
+
+    t0 = min(s.get("start", 0.0) for s in spans) if spans else 0.0
+    items = []
+    for s in spans:
+        extra = ""
+        attrs = s.get("attrs") or {}
+        notes = [f"{k}={v}" for k, v in sorted(attrs.items())
+                 if k not in ("pod", "gang")]
+        if notes:
+            extra = "  [" + " ".join(notes) + "]"
+        items.append((s.get("start", 0.0), 0, (
+            f"{1e3 * (s.get('start', 0.0) - t0):8.1f}ms "
+            f"{'  ' * depth(s)}{s.get('name')} "
+            f"({s.get('component')}) {s.get('duration_ms', 0.0):.1f}ms"
+            f"{extra}")))
+        for ts, msg in s.get("events") or []:
+            items.append((ts, 1, (f"{1e3 * (ts - t0):8.1f}ms "
+                                  f"{'  ' * (depth(s) + 1)}- {msg}")))
+    for epoch, text, in_trace in events:
+        mark = "*" if in_trace else " "
+        items.append((epoch, 2,
+                      f"{1e3 * (epoch - t0):8.1f}ms {mark} event {text}"))
+    items.sort(key=lambda it: (it[0], it[1]))
+    lines.extend(text for _ts, _k, text in items)
+    return "\n".join(lines)
+
+
+async def cmd_trace(args) -> int:
+    """``ktl trace pod <name>`` / ``ktl trace gang <group>`` — render
+    the ktrace lifecycle timeline (create -> queue -> schedule -> bind
+    -> start -> ready) with per-stage durations and Events interleaved.
+    Requires tracing armed at creation time (KTPU_TRACE; see README
+    "Tracing & TPU telemetry")."""
+    from .. import tracing
+    client = make_client(args)
+    try:
+        if args.kind == "pod":
+            pod = await client.get("pods", args.namespace, args.name)
+            ctx = tracing.context_of(pod)
+            if ctx is None:
+                raise SystemExit(
+                    f"ktl: pod {args.namespace}/{args.name} carries no "
+                    f"trace annotation — arm tracing (KTPU_TRACE=1.0) "
+                    f"before creating it")
+            spans = await _fetch_trace_spans(client, trace_id=ctx.trace_id)
+            if not spans:
+                raise SystemExit(
+                    f"ktl: no spans collected for trace {ctx.trace_id} "
+                    f"(collector bounded/rotated, or components run "
+                    f"out-of-process without span push)")
+            events = await _pod_events(client, args.namespace, pod,
+                                       ctx.trace_id)
+            if args.output == "json":
+                from ..tracing import timeline as tlmod
+                print(json.dumps({
+                    "pod": f"{args.namespace}/{args.name}",
+                    "trace_id": ctx.trace_id,
+                    "timeline": tlmod.pod_timeline(spans),
+                    "spans": spans,
+                }, default=str))
+            else:
+                print(_render_trace(f"pod {args.namespace}/{args.name}",
+                                    ctx.trace_id, spans, events))
+            return 0
+        # gang: per-member stage summary + the slowest member's detail.
+        from ..tracing import timeline as tlmod
+        group = await client.get("podgroups", args.namespace, args.name)
+        pods, _ = await client.list("pods", args.namespace)
+        members = sorted((p for p in pods if p.spec.gang == args.name),
+                         key=lambda p: p.metadata.name)
+        if not members:
+            raise SystemExit(f"ktl: gang {args.namespace}/{args.name} "
+                             f"has no member pods")
+        rows, timelines = [], {}
+        for p in members:
+            ctx = tracing.context_of(p)
+            if ctx is None:
+                rows.append([p.metadata.name, "<untraced>", "-", "-",
+                             "-", "-", "-"])
+                continue
+            spans = await _fetch_trace_spans(client,
+                                             trace_id=ctx.trace_id)
+            tline = tlmod.pod_timeline(spans)
+            if tline is None:
+                rows.append([p.metadata.name, ctx.trace_id[:16], "-",
+                             "-", "-", "-", "-"])
+                continue
+            timelines[p.metadata.name] = (ctx, spans, tline)
+            dur = {st["stage"]: st["duration_ms"]
+                   for st in tline["stages"]}
+            rows.append([
+                p.metadata.name, ctx.trace_id[:16],
+                f"{tline['e2e_ms']:.1f}ms",
+                f"{dur.get('queue', 0.0):.1f}ms",
+                f"{dur.get('schedule', 0.0):.1f}ms",
+                f"{dur.get('bind', 0.0):.1f}ms",
+                f"{dur.get('start', 0.0):.1f}ms"])
+        if args.output == "json":
+            print(json.dumps({
+                "gang": f"{args.namespace}/{args.name}",
+                "phase": group.status.phase,
+                "members": {name: tline
+                            for name, (_c, _s, tline)
+                            in timelines.items()},
+            }, default=str))
+            return 0
+        print(f"GANG {args.namespace}/{args.name}  "
+              f"phase={group.status.phase}  members={len(members)}")
+        print(printers.render_table(
+            ["POD", "TRACE", "E2E", "QUEUE", "SCHEDULE", "BIND",
+             "START"], rows))
+        if timelines:
+            slowest = max(timelines.items(),
+                          key=lambda kv: kv[1][2]["e2e_ms"])
+            name, (ctx, spans, _tline) = slowest
+            print(f"\nslowest member: {name}")
+            events = await _pod_events(
+                client, args.namespace,
+                next(p for p in members if p.metadata.name == name),
+                ctx.trace_id)
+            print(_render_trace(f"pod {args.namespace}/{name}",
+                                ctx.trace_id, spans, events))
         return 0
     finally:
         await client.close()
@@ -2354,8 +2648,16 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--disable-eviction", action="store_true",
                     help="raw-delete instead of the PDB-gated Eviction API")
 
-    sp = add("top", cmd_top, help="node/pod/chip stats")
+    sp = add("top", cmd_top, help="node/pod/chip stats "
+                                  "('nodes'/'pods' = TPU telemetry views)")
     sp.add_argument("node", nargs="?", default="")
+
+    sp = add("trace", cmd_trace,
+             help="render a pod's (or gang's) ktrace lifecycle timeline")
+    sp.add_argument("kind", choices=["pod", "gang"])
+    sp.add_argument("name")
+    sp.add_argument("-n", "--namespace", default="default")
+    sp.add_argument("-o", "--output", default="", help="''|json")
 
     add("api-resources", cmd_api_resources, help="list server resources")
     add("version", cmd_version, help="client+server version")

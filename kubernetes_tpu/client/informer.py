@@ -20,6 +20,7 @@ import logging
 import random
 from typing import Any, Callable, Optional
 
+from .. import tracing
 from ..api import errors
 from .interface import Client
 from .mutation_detector import CacheMutationDetector
@@ -263,16 +264,30 @@ class SharedInformer:
             self._notify(MODIFIED, obj, obj)
 
     def _notify(self, etype: str, old: Any, new: Any) -> None:
-        for on_add, on_update, on_delete in self._handlers:
-            try:
-                if etype == ADDED:
-                    on_add(new)
-                elif etype == MODIFIED:
-                    on_update(old, new)
-                else:
-                    on_delete(old)
-            except Exception:  # noqa: BLE001
-                log.exception("informer(%s): handler error", self.plural)
+        # ktrace re-attach: the delivered object's durable traceparent
+        # annotation becomes the current context around its handlers,
+        # so whatever they do (queue adds, status writes, container
+        # starts) joins the pod's trace. Disarmed cost: one bool check
+        # per event; armed-but-unsampled: one annotation get.
+        token = None
+        if tracing.armed():
+            ctx = tracing.context_of(new if new is not None else old)
+            if ctx is not None:
+                token = tracing.attach(ctx)
+        try:
+            for on_add, on_update, on_delete in self._handlers:
+                try:
+                    if etype == ADDED:
+                        on_add(new)
+                    elif etype == MODIFIED:
+                        on_update(old, new)
+                    else:
+                        on_delete(old)
+                except Exception:  # noqa: BLE001
+                    log.exception("informer(%s): handler error", self.plural)
+        finally:
+            if token is not None:
+                tracing.detach(token)
 
     # -- lister -----------------------------------------------------------
 

@@ -12,6 +12,7 @@ import hashlib
 import logging
 from typing import Any
 
+from .. import tracing
 from ..api import errors
 from ..api.meta import ObjectMeta, now
 from ..api.scheme import DEFAULT_SCHEME
@@ -114,6 +115,16 @@ class EventRecorder:
             involved_object=ref, reason=reason, message=message,
             type=event_type, count=1, source=self.source,
             first_timestamp=now(), last_timestamp=now())
+        if tracing.armed():
+            # ktrace breadcrumb: the originating trace id rides the
+            # event (annotation), so ``ktl trace pod`` interleaves the
+            # pod's Events with its spans. The batched spool path
+            # carries the annotation unchanged — a flushed batch item
+            # is this exact object.
+            ctx = tracing.current() or tracing.context_of(obj)
+            if ctx is not None and ctx.sampled:
+                ev.metadata.annotations[tracing.TRACE_ID_ANNOTATION] = \
+                    ctx.trace_id
         if key in self._seen:
             spawn(self._bump_seen(ev, key), name="event-bump")
             return

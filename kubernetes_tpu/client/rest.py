@@ -22,6 +22,7 @@ from typing import Any, Optional
 
 import aiohttp
 
+from .. import tracing
 from ..api import errors
 from ..api.scheme import DEFAULT_SCHEME, to_dict
 from ..api.types import Binding
@@ -544,6 +545,17 @@ class RESTClient(Client):
                                f"{self.max_staleness:.3f}")
             kw["headers"] = headers
             CLIENT_FOLLOWER_READS.inc(outcome="routed")
+        if tracing.armed():
+            # ktrace context propagation: requests issued inside a
+            # sampled trace carry the W3C-style traceparent header so
+            # the apiserver's server span joins the same trace.
+            # Disarmed (the default), the whole seam is this one check.
+            ctx = tracing.current()
+            if ctx is not None and ctx.sampled:
+                headers = dict(kw.pop("headers", None) or {})
+                headers.setdefault(tracing.TRACEPARENT_HEADER,
+                                   tracing.encode(ctx))
+                kw["headers"] = headers
         backoff = self.backoff_base
         attempt = 0
         redirects = 0

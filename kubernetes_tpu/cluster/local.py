@@ -375,7 +375,10 @@ class LocalCluster:
             status_interval=self.status_interval,
             heartbeat_interval=self.heartbeat_interval,
             proxy=proxy, eviction=eviction, runtime_hook=hook,
-            chip_metrics=plugin.chip_metrics if spec.real_tpu else None,
+            # Stub plugins now carry the driver sim (duty cycle / HBM /
+            # ICI counters), so every TPU node feeds the tpu_* gauges —
+            # the DCGM-exporter analog — not just real hardware.
+            chip_metrics=plugin.chip_metrics if plugin is not None else None,
             # Static pods (reference --pod-manifest-path): drop a Pod
             # YAML into <data>/nodes/<name>/manifests and the agent
             # runs it kubelet-owned, mirror posted for observability.

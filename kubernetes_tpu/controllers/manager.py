@@ -66,6 +66,19 @@ DEFAULT_CONTROLLERS: dict[str, Callable[[Client, InformerFactory], Controller]] 
 }
 
 
+def _cluster_monitor(client, factory, **kw):
+    # Imported lazily: monitoring/ pulls in aiohttp-scrape machinery a
+    # controller-only process may never use.
+    from ..monitoring.aggregator import ClusterMonitor
+    return ClusterMonitor(client, factory, **kw)
+
+
+#: metrics-server analog (monitoring/aggregator.py): rolls node /stats
+#: into tpu_cluster_*/tpu_node_* series; inert unless the
+#: ClusterMonitoring gate is on.
+DEFAULT_CONTROLLERS["cluster-monitor"] = _cluster_monitor
+
+
 class ControllerManager:
     def __init__(self, client: Client, controllers: Optional[list[str]] = None,
                  leader_elect: bool = False, identity: str = "",
@@ -96,6 +109,8 @@ class ControllerManager:
                 self.client, ssl_context=self.node_scrape_ssl)}
         if name == "job-queueing" and self.queueing_fits_probe is not None:
             return {"fits_probe": self.queueing_fits_probe}
+        if name == "cluster-monitor" and self.node_scrape_ssl is not None:
+            return {"ssl_context": self.node_scrape_ssl}
         return {}
 
     async def _run_controllers(self) -> None:

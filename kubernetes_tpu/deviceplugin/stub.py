@@ -11,6 +11,7 @@ from __future__ import annotations
 import itertools
 import os
 import queue
+import time
 from concurrent import futures
 from typing import Iterator, Optional
 
@@ -56,6 +57,50 @@ class StubTpuPlugin(TpuDevicePluginServicer):
         self.reject_reason: Optional[str] = None
         self._server: Optional[grpc.Server] = None
         self.socket_path: Optional[str] = None
+        #: Simulated per-chip HBM capacity (v5p-ish 95GiB is overkill
+        #: for a sim; 16GiB keeps the arithmetic readable).
+        self.sim_hbm_total = 16 * 2**30
+        #: Driver-sim state for :meth:`chip_metrics` — ICI byte
+        #: counters advance with wall time so scrapes see motion.
+        self._sim_ici: dict[str, dict[str, float]] = {}
+        self._sim_last = time.monotonic()
+
+    def chip_metrics(self) -> dict:
+        """Per-chip telemetry from the DRIVER SIM — the DCGM/nvml
+        analog of the reference's accelerator stats, hardware-free:
+        duty cycle + HBM occupancy derived deterministically from the
+        chip index (same chip -> same load profile across runs), ICI
+        link tx/rx counters advancing with wall time at a rate
+        proportional to the duty cycle. Unhealthy chips read 0% duty
+        and 0 B/s ICI — exactly what a wedged chip looks like from the
+        host. Feeds ``node/stats.py`` (``chip_metrics`` seam) and the
+        ``tpu_*`` gauge family (node/telemetry.py)."""
+        now = time.monotonic()
+        with self._lock:
+            dt = max(now - self._sim_last, 0.0)
+            self._sim_last = now
+            out: dict = {}
+            for i, chip in enumerate(self._topology.chips):
+                healthy = chip.health == "Healthy"
+                # Deterministic per-chip duty profile: spread across
+                # 35-90% so aggregation has real variance to report.
+                duty = (35.0 + (i * 17) % 56) if healthy else 0.0
+                ici = self._sim_ici.setdefault(
+                    chip.id, {"tx_bytes": 0.0, "rx_bytes": 0.0})
+                # ICI moves proportionally to duty (~1.2 GB/s per 100%
+                # duty per direction — sim scale, not hardware claims).
+                ici["tx_bytes"] += duty / 100.0 * 1.2e9 * dt
+                ici["rx_bytes"] += duty / 100.0 * 1.1e9 * dt
+                out[chip.id] = {
+                    "duty_cycle_pct": duty,
+                    "hbm_total_bytes": self.sim_hbm_total,
+                    "hbm_used_bytes": int(self.sim_hbm_total
+                                          * duty / 100.0 * 0.7),
+                    "ici_tx_bytes": int(ici["tx_bytes"]),
+                    "ici_rx_bytes": int(ici["rx_bytes"]),
+                    "ici_links": 6 if healthy else 0,  # 3D torus degree
+                }
+            return out
 
     # -- service ----------------------------------------------------------
 

@@ -1,0 +1,8 @@
+"""Cluster-level monitoring — the metrics-server / DCGM-rollup analog.
+
+Aggregates every node's ``/stats/summary`` into cluster-level
+``tpu_cluster_*`` / per-node ``tpu_node_*`` series (aggregator.py) and
+keeps a queryable snapshot — the custom-metrics seam the ROADMAP's
+inference-autoscaling item will scale on.
+"""
+from .aggregator import ClusterMonitor  # noqa: F401

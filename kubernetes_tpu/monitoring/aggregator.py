@@ -1,0 +1,321 @@
+"""ClusterMonitor — scrape node ``/stats`` into cluster-level series.
+
+Reference analog: metrics-server (scrape kubelet Summary APIs, serve
+an aggregate) fused with the DCGM->Prometheus rollup the reference
+stack uses for GPU fleets. Each sweep LISTs Nodes, scrapes every
+reachable node agent's ``/stats/summary`` (the same daemon endpoint
+``ktl top`` reads), and publishes:
+
+- per-node ``tpu_node_*`` gauges (chips, healthy, assigned, mean duty
+  cycle, HBM used/total, tokens/s);
+- cluster ``tpu_cluster_*`` gauges (chip counts by state, duty-cycle
+  mean, HBM totals, aggregate tokens/s);
+
+plus an in-memory snapshot (:meth:`latest`) — the custom-metrics seam
+a future autoscaler reads without re-scraping the fleet.
+
+Runs inside the controller-manager (table entry "cluster-monitor"),
+gated by ``ClusterMonitoring`` (beta, default on); a cluster with no
+TPU nodes pays one Node LIST per interval and exports nothing.
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Optional
+
+from ..api import errors
+from ..client.interface import Client
+from ..metrics.registry import Counter, Gauge
+from ..util.tasks import spawn
+
+log = logging.getLogger("clustermonitor")
+
+NODE_CHIPS = Gauge(
+    "tpu_node_chips",
+    "Chips a node reports, by state",
+    labels=("node", "state"))
+
+NODE_DUTY = Gauge(
+    "tpu_node_duty_cycle_avg_pct",
+    "Mean duty cycle across a node's chips (%)",
+    labels=("node",))
+
+NODE_HBM_USED = Gauge(
+    "tpu_node_hbm_used_bytes",
+    "HBM bytes in use across a node's chips",
+    labels=("node",))
+
+NODE_HBM_TOTAL = Gauge(
+    "tpu_node_hbm_total_bytes",
+    "HBM capacity across a node's chips",
+    labels=("node",))
+
+NODE_TOKENS = Gauge(
+    "tpu_node_tokens_per_sec",
+    "Aggregate live training tokens/s reported by a node's pods",
+    labels=("node",))
+
+CLUSTER_CHIPS = Gauge(
+    "tpu_cluster_chips",
+    "Cluster-wide chip counts by state "
+    "(total/healthy/unhealthy/assigned/idle)",
+    labels=("state",))
+
+CLUSTER_DUTY = Gauge(
+    "tpu_cluster_duty_cycle_avg_pct",
+    "Mean duty cycle across every chip in the cluster (%)")
+
+CLUSTER_HBM_USED = Gauge(
+    "tpu_cluster_hbm_used_bytes",
+    "HBM bytes in use across the cluster")
+
+CLUSTER_HBM_TOTAL = Gauge(
+    "tpu_cluster_hbm_total_bytes",
+    "HBM capacity across the cluster")
+
+CLUSTER_TOKENS = Gauge(
+    "tpu_cluster_tokens_per_sec",
+    "Aggregate live training tokens/s across the cluster")
+
+MONITOR_SCRAPES = Counter(
+    "tpu_monitor_scrapes_total",
+    "Node /stats scrapes by the cluster monitor",
+    labels=("result",))
+
+
+class ClusterMonitor:
+    """Matches the controller-table ctor shape (client, factory, **kw);
+    the informer factory is unused — a periodic scrape loop needs live
+    daemon endpoints, not a watch cache."""
+
+    name = "cluster-monitor"
+
+    def __init__(self, client: Client, factory=None, interval: float = 10.0,
+                 ssl_context=None):
+        self.client = client
+        self.interval = interval
+        self._ssl = ssl_context
+        self._task: Optional[asyncio.Task] = None
+        #: Latest aggregated snapshot (see :meth:`latest`).
+        self._snapshot: dict = {"at": 0.0, "nodes": {}, "pods": {},
+                                "cluster": {}}
+        self._exported_nodes: set[str] = set()
+
+    async def start(self) -> None:
+        from ..util.features import GATES
+        if not GATES.enabled("ClusterMonitoring"):
+            return
+        self._task = spawn(self._loop(), name="cluster-monitor")
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    def latest(self) -> dict:
+        """The last completed sweep: ``{"at", "nodes": {name: {...}},
+        "pods": {"ns/name": {...}}, "cluster": {...}}`` — the
+        custom-metrics read seam (autoscalers poll this instead of
+        scraping the fleet again)."""
+        return self._snapshot
+
+    async def _loop(self) -> None:
+        while True:
+            try:
+                await self.sweep()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 — telemetry must
+                log.warning("cluster-monitor sweep failed: %s", e)
+            await asyncio.sleep(self.interval)
+
+    async def sweep(self) -> dict:
+        """One aggregation pass (tests call this directly). Scrapes run
+        CONCURRENTLY over one shared session, so sweep time is the
+        slowest single scrape (sequential 3s timeouts across a fleet
+        with a few dead nodes would push the snapshot minutes stale
+        exactly when freshness matters). A node that is still LISTED
+        but missed this scrape keeps its last-known aggregate, marked
+        ``stale`` — one GC pause must not flap cluster capacity out of
+        the autoscaler seam; series are pruned only for nodes gone
+        from the API."""
+        import aiohttp
+        try:
+            nodes, _rev = await self.client.list("nodes")
+        except errors.StatusError as e:
+            log.warning("cluster-monitor: node list failed: %s", e)
+            return self._snapshot
+        names = [n.metadata.name for n in nodes]
+        async with aiohttp.ClientSession() as session:
+            summaries = await asyncio.gather(
+                *(self._scrape(name, session) for name in names))
+        per_node: dict[str, dict] = {}
+        per_pod: dict[str, dict] = {}
+        prev = self._snapshot["nodes"]
+        for name, summary in zip(names, summaries):
+            if summary is None:
+                last = prev.get(name)
+                if last is not None:
+                    # Listed but unscrapable this round: carry the
+                    # last-known aggregate forward, visibly stale.
+                    per_node[name] = {**last, "stale": True}
+                continue
+            agg = self._aggregate_node(name, summary, per_pod)
+            per_node[name] = agg
+            self._export_node(name, agg)
+        roll = self._cluster_rollup(per_node)
+        self._export_cluster(roll)
+        self._prune_departed(set(names))
+        self._snapshot = {
+            "at": time.time(),
+            "nodes": per_node,
+            "pods": per_pod,
+            # The SAME rollup the gauges exported — the latest()
+            # seam and /metrics must never disagree.
+            "cluster": roll,
+        }
+        return self._snapshot
+
+    async def _scrape(self, node_name: str, session) -> Optional[dict]:
+        from ..client.nodeaccess import resolve_node_agent, ssl_kw
+        import aiohttp
+        conn = await resolve_node_agent(self.client, node_name)
+        if conn is None:
+            MONITOR_SCRAPES.inc(result="unreachable")
+            return None
+        base, node_ssl = conn
+        if self._ssl is not None:
+            node_ssl = self._ssl
+        try:
+            async with session.get(f"{base}/stats/summary",
+                                   timeout=aiohttp.ClientTimeout(total=3),
+                                   **ssl_kw(node_ssl)) as r:
+                if r.status != 200:
+                    MONITOR_SCRAPES.inc(result="error")
+                    return None
+                out = await r.json()
+                MONITOR_SCRAPES.inc(result="ok")
+                return out
+        except Exception as e:  # noqa: BLE001 — node down mid-sweep
+            log.debug("cluster-monitor: scrape of %s failed: %s",
+                      node_name, e)
+            MONITOR_SCRAPES.inc(result="error")
+            return None
+
+    @staticmethod
+    def _aggregate_node(name: str, summary: dict,
+                        per_pod: dict) -> dict:
+        chips = (summary.get("tpu") or {}).get("chips") or []
+        duty = [c["duty_cycle_pct"] for c in chips
+                if "duty_cycle_pct" in c]
+        agg = {
+            "chips": len(chips),
+            "healthy": sum(1 for c in chips
+                           if c.get("health") == "Healthy"),
+            "assigned": sum(1 for c in chips if c.get("assigned_to")),
+            "duty_avg_pct": round(sum(duty) / len(duty), 2) if duty else 0.0,
+            #: Chips actually reporting duty — the cluster mean weights
+            #: by this, so a 1-chip node cannot drag a 256-chip node's
+            #: number to the middle (and non-reporting chips are not
+            #: counted as 0%).
+            "duty_chips": len(duty),
+            "hbm_used_bytes": sum(int(c.get("hbm_used_bytes", 0))
+                                  for c in chips),
+            "hbm_total_bytes": sum(int(c.get("hbm_total_bytes", 0))
+                                   for c in chips),
+            "tokens_per_sec": 0.0,
+            "pods": len(summary.get("pods") or []),
+        }
+        # Per-pod rollup: chip attribution + live training numbers
+        # (the `ktl top pods` rows).
+        chips_by_pod: dict[str, int] = {}
+        duty_by_pod: dict[str, list] = {}
+        hbm_by_pod: dict[str, int] = {}
+        for c in chips:
+            owner = c.get("assigned_to")
+            if not owner:
+                continue
+            pkey = f"{owner['namespace']}/{owner['pod']}"
+            chips_by_pod[pkey] = chips_by_pod.get(pkey, 0) + 1
+            if "duty_cycle_pct" in c:
+                duty_by_pod.setdefault(pkey, []).append(
+                    c["duty_cycle_pct"])
+            hbm_by_pod[pkey] = hbm_by_pod.get(pkey, 0) \
+                + int(c.get("hbm_used_bytes", 0))
+        for p in summary.get("pods") or []:
+            pkey = f"{p['pod']['namespace']}/{p['pod']['name']}"
+            rec = per_pod.setdefault(pkey, {"node": name})
+            rec["chips"] = chips_by_pod.get(pkey, 0)
+            d = duty_by_pod.get(pkey)
+            rec["duty_avg_pct"] = round(sum(d) / len(d), 2) if d else 0.0
+            rec["hbm_used_bytes"] = hbm_by_pod.get(pkey, 0)
+            rec["cpu_seconds"] = p.get("cpu_seconds", 0.0)
+            rec["memory_rss_bytes"] = p.get("memory_rss_bytes", 0)
+            training = p.get("training")
+            if training and not training.get("stale"):
+                for k in ("tokens_per_sec", "mfu", "step_time_ms"):
+                    if k in training:
+                        rec[k] = training[k]
+                agg["tokens_per_sec"] += float(
+                    training.get("tokens_per_sec", 0.0))
+        return agg
+
+    @staticmethod
+    def _export_node(name: str, agg: dict) -> None:
+        NODE_CHIPS.set(float(agg["chips"]), node=name, state="total")
+        NODE_CHIPS.set(float(agg["healthy"]), node=name, state="healthy")
+        NODE_CHIPS.set(float(agg["assigned"]), node=name, state="assigned")
+        NODE_DUTY.set(agg["duty_avg_pct"], node=name)
+        NODE_HBM_USED.set(float(agg["hbm_used_bytes"]), node=name)
+        NODE_HBM_TOTAL.set(float(agg["hbm_total_bytes"]), node=name)
+        NODE_TOKENS.set(round(agg["tokens_per_sec"], 3), node=name)
+
+    @staticmethod
+    def _export_cluster(roll: dict) -> None:
+        for state in ("total", "healthy", "unhealthy", "assigned", "idle"):
+            CLUSTER_CHIPS.set(float(roll[f"chips_{state}"]), state=state)
+        CLUSTER_DUTY.set(roll["duty_avg_pct"])
+        CLUSTER_HBM_USED.set(float(roll["hbm_used_bytes"]))
+        CLUSTER_HBM_TOTAL.set(float(roll["hbm_total_bytes"]))
+        CLUSTER_TOKENS.set(round(roll["tokens_per_sec"], 3))
+
+    @staticmethod
+    def _cluster_rollup(per_node: dict) -> dict:
+        total = sum(a["chips"] for a in per_node.values())
+        healthy = sum(a["healthy"] for a in per_node.values())
+        assigned = sum(a["assigned"] for a in per_node.values())
+        # Chip-weighted mean over chips that REPORT duty — the gauge
+        # says "across every chip", so per-node averages must not
+        # count equally regardless of node size.
+        duty_w = sum(a["duty_avg_pct"] * a.get("duty_chips", 0)
+                     for a in per_node.values())
+        duty_n = sum(a.get("duty_chips", 0) for a in per_node.values())
+        return {
+            "chips_total": total,
+            "chips_healthy": healthy,
+            "chips_unhealthy": total - healthy,
+            "chips_assigned": assigned,
+            "chips_idle": total - assigned,
+            "duty_avg_pct": round(duty_w / duty_n, 2) if duty_n else 0.0,
+            "hbm_used_bytes": sum(a["hbm_used_bytes"]
+                                  for a in per_node.values()),
+            "hbm_total_bytes": sum(a["hbm_total_bytes"]
+                                   for a in per_node.values()),
+            "tokens_per_sec": sum(a["tokens_per_sec"]
+                                  for a in per_node.values()),
+        }
+
+    def _prune_departed(self, live: set[str]) -> None:
+        for name in self._exported_nodes - live:
+            for state in ("total", "healthy", "assigned"):
+                NODE_CHIPS.remove(node=name, state=state)
+            for g in (NODE_DUTY, NODE_HBM_USED, NODE_HBM_TOTAL,
+                      NODE_TOKENS):
+                g.remove(node=name)
+        self._exported_nodes = live

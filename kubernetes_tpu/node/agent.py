@@ -26,6 +26,7 @@ import tempfile
 import time
 from typing import Optional
 
+from .. import tracing
 from ..api import errors, types as t
 from ..api.meta import ObjectMeta, now
 from ..client.informer import SharedInformer
@@ -204,6 +205,12 @@ class NodeAgent:
         #: progress while claiming success).
         self._preempt_seen: dict[str, float] = {}
         self._preempt_tasks: set[asyncio.Task] = set()
+        #: ktrace node half: pod key -> the "startup" span opened when
+        #: a sampled pod first reaches this agent, ended when the pod
+        #: goes Ready (pull/start ride as children). Entries persist
+        #: (ended) until pod teardown so a later sync cannot reopen
+        #: the stage; bounded by pods on the node.
+        self._startup_spans: dict[str, object] = {}
         self._informer: Optional[SharedInformer] = None
         self._svc_informer: Optional[SharedInformer] = None
         self._own_svc_informer = False
@@ -703,6 +710,16 @@ class NodeAgent:
             # completion marker (preemption.py protocol, node half).
             self._ensure_preempt_signal(pod)
 
+        # ktrace: the node's "startup" stage opens when a sampled pod
+        # first reaches this agent and ends when the pod goes Ready
+        # (_update_pod_status); pull/start nest inside it.
+        if tracing.armed() and key not in self._startup_spans:
+            tctx = tracing.context_of(pod)
+            if tctx is not None:
+                self._startup_spans[key] = tracing.start_span(
+                    "startup", component="node", parent=tctx,
+                    attrs={"pod": key, "node": self.node_name})
+
         # Admission (once): device verification (kubelet.go:898 chain).
         if key not in self._admitted:
             async with self._admit_lock:
@@ -1071,11 +1088,20 @@ class NodeAgent:
         # EnsureImageExists (image_manager.go): pull-if-absent before
         # the container references it; pull failures are retried by the
         # pod worker like the reference's ImagePullBackOff.
+        trace_parent = self._startup_span_ctx(pod)
         try:
             if await self.runtime.image_status(container.image) is None:
+                pull_span = tracing.start_span(
+                    "pull", component="node", parent=trace_parent,
+                    attrs={"pod": pod.key(), "image": container.image})
                 self.recorder.event(pod, "Normal", "Pulling",
                                     f"pulling image {container.image!r}")
-                await self.runtime.pull_image(container.image)
+                try:
+                    await self.runtime.pull_image(container.image)
+                except BaseException as e:
+                    pull_span.end(error=str(e))
+                    raise
+                pull_span.end()
                 self.recorder.event(pod, "Normal", "Pulled",
                                     f"pulled image {container.image!r}")
         except NotImplementedError:
@@ -1112,12 +1138,17 @@ class NodeAgent:
             oom_score_adj=cm.oom_score_adj(
                 pod, container, self.capacity.get("memory", 0.0)),
             run_as_user=run_uid, run_as_group=run_gid, rlimits=rlimits)
+        start_span = tracing.start_span(
+            "start", component="node", parent=trace_parent,
+            attrs={"pod": pod.key(), "container": container.name})
         try:
             cid = await self.runtime.start_container(config)
         except Exception as e:  # noqa: BLE001
+            start_span.end(error=str(e))
             self.recorder.event(pod, "Warning", "FailedStart",
                                 f"{container.name}: {e}")
             return
+        start_span.end()
         cmap[container.name] = cid
         self.recorder.event(pod, "Normal", "Started",
                             f"container {container.name}")
@@ -1155,6 +1186,19 @@ class NodeAgent:
             await self.runtime.stop_container(cid, grace_seconds=1.0)
             self._nudge(pod_key)
         spawn(restart(), name="probe-restart")
+
+    def _startup_span_ctx(self, pod: t.Pod):
+        """Parent context for node-half child spans (pull/start): the
+        pod's startup span when open, else the pod's own annotation
+        context. None (-> no-op children) unless armed + sampled."""
+        if not tracing.armed():
+            return None
+        sp = self._startup_spans.get(pod.key())
+        if sp is not None:
+            ctx = sp.context()
+            if ctx is not None:
+                return ctx
+        return tracing.context_of(pod)
 
     # -- status calculation (kubelet syncPod status half) -----------------
 
@@ -1207,6 +1251,12 @@ class NodeAgent:
             phase = self._compute_phase(pod, cstatuses)
         all_ready = bool(cstatuses) and all(
             cs.ready or cs.state.terminated is not None for cs in cstatuses)
+        if all_ready and tracing.armed():
+            # ktrace: Ready closes the startup stage — the trace's end
+            # (Span.end is idempotent; later ready syncs are no-ops).
+            sp = self._startup_spans.get(key)
+            if sp is not None:
+                sp.end(phase=phase)
 
         try:
             cur = await self.client.get("pods", pod.metadata.namespace,
@@ -1530,6 +1580,9 @@ class NodeAgent:
         self._admitted.discard(key)
         self._pod_uids.pop(key, None)
         self._uid_alloc.pop(pod.metadata.uid, None)
+        sp = self._startup_spans.pop(key, None)
+        if sp is not None:
+            sp.end(terminated=True)  # no-op when already Ready-closed
         self._preempt_forget(key, pod.metadata.uid)
         await self._release_pod_ip(pod.metadata.uid)
         self.volumes.teardown(pod.metadata.uid)
@@ -1543,6 +1596,9 @@ class NodeAgent:
             pass
 
     async def _teardown_pod(self, key: str) -> None:
+        sp = self._startup_spans.pop(key, None)
+        if sp is not None:
+            sp.end(torn_down=True)  # no-op when already Ready-closed
         cmap = self._containers.pop(key, {})
         self.probes.remove_pod(key)
         for cid in cmap.values():

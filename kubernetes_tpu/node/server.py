@@ -39,6 +39,7 @@ from aiohttp import web
 from ..api.scheme import to_dict
 from ..metrics.registry import REGISTRY as METRICS, Gauge
 from .stats import SummaryCollector
+from .telemetry import export_tpu_stats
 
 log = logging.getLogger("nodeserver")
 
@@ -94,6 +95,12 @@ class NodeAgentServer:
         #: scrape's hot path; 30s matches the kubelet's default
         #: authn cache TTL order of magnitude.
         self._token_cache: dict[str, tuple] = {}
+        #: Legacy node_tpu_* series hygiene: chip id -> last exported
+        #: pod label (node_tpu_chip_assigned carries a pod label, so a
+        #: re-assignment must remove the OLD labeled series, not just
+        #: overwrite); chips gone from the topology drop all series —
+        #: same discipline the tpu_* family (telemetry.py) applies.
+        self._chip_assigned_label: dict[str, str] = {}
         self.app = web.Application(
             middlewares=[self._authz_middleware] if ssl_context else [])
         r = self.app.router
@@ -544,18 +551,41 @@ class NodeAgentServer:
                 if self.agent.device_manager else None)
         summary = self.collector.summary(
             self.agent._pods, self.agent._containers, statuses, topo)
+        # DCGM-analog per-chip family (tpu_*): duty cycle, HBM, ICI
+        # counters, health — the series the monitoring aggregator rolls
+        # up cluster-wide (telemetry.py owns the gauges + hygiene).
+        export_tpu_stats(self.agent.node_name, summary.get("tpu") or {})
+        seen_chips: set[str] = set()
         for chip in summary["tpu"].get("chips", []):
+            seen_chips.add(chip["id"])
             CHIP_HEALTHY.set(1.0 if chip["health"] == "Healthy" else 0.0,
                              node=self.agent.node_name, chip=chip["id"])
             owner = chip.get("assigned_to")
+            pod_label = (f"{owner['namespace']}/{owner['pod']}"
+                         if owner else "")
+            prev_label = self._chip_assigned_label.get(chip["id"])
+            if prev_label is not None and prev_label != pod_label:
+                # Re-assignment: the old (node, chip, pod) series must
+                # be REMOVED, not left frozen beside the new one.
+                CHIP_ASSIGNED.remove(node=self.agent.node_name,
+                                     chip=chip["id"], pod=prev_label)
+            self._chip_assigned_label[chip["id"]] = pod_label
             CHIP_ASSIGNED.set(
                 1.0 if owner else 0.0, node=self.agent.node_name,
-                chip=chip["id"],
-                pod=f"{owner['namespace']}/{owner['pod']}" if owner else "")
+                chip=chip["id"], pod=pod_label)
             if "hbm_used_bytes" in chip:
                 CHIP_HBM_USED.set(float(chip["hbm_used_bytes"]),
                                   node=self.agent.node_name,
                                   chip=chip["id"])
+        # Chips gone from the topology (plugin restart, slice
+        # re-shape): drop their legacy series instead of freezing them
+        # at the last value — same hygiene as the tpu_* family.
+        for chip_id in set(self._chip_assigned_label) - seen_chips:
+            CHIP_HEALTHY.remove(node=self.agent.node_name, chip=chip_id)
+            CHIP_HBM_USED.remove(node=self.agent.node_name, chip=chip_id)
+            CHIP_ASSIGNED.remove(
+                node=self.agent.node_name, chip=chip_id,
+                pod=self._chip_assigned_label.pop(chip_id))
         for p in summary["pods"]:
             rec = p.get("training")
             if rec is None or rec.get("stale"):

@@ -215,6 +215,48 @@ async def _run_density_rest(n_nodes: int, n_pods: int, timeout: float,
     return out
 
 
+def _scheduler_loop_stats() -> dict:
+    """The scheduler's loop-lag probe numbers (scheduler_loop_lag_ms /
+    scheduler_loop_busy_fraction — the router/shard probes' scheduler
+    sibling), reported beside the apiserver's: ROADMAP item 3 says the
+    scheduler's per-pod CPU now rivals the apiserver's, so both loops'
+    busy fractions belong in one result."""
+    lag = sched_metrics.LOOP_LAG
+    if not lag.count():
+        return {}
+    out = {
+        "scheduler_loop_busy": sched_metrics.LOOP_BUSY.value(),
+        "scheduler_loop_lag_sum_ms": round(lag.sum(), 1),
+    }
+    p99 = lag.raw_quantile(0.99)
+    if p99 is not None:
+        out["scheduler_loop_lag_p99_ms"] = round(p99, 3)
+    return out
+
+
+def _arm_tracing(sample: float):
+    """Arm ktrace at ``sample`` for a harness run; returns the previous
+    rate (None = was not armed by us) for the caller's finally."""
+    if sample <= 0:
+        return None
+    from .. import tracing
+    prev = tracing.set_sample_rate(sample)
+    tracing.COLLECTOR.clear()
+    return prev
+
+
+def _trace_breakdown() -> dict:
+    """Span-derived e2e startup breakdown over the armed run's sampled
+    pods: per-stage (queue/schedule/bind/start) raw-sample percentiles
+    + shares, so a perf PR attacks the measured stage, not a guess."""
+    from .. import tracing
+    from ..tracing import timeline as tlmod
+    breakdown = tlmod.stage_breakdown(tracing.COLLECTOR.snapshot())
+    if not breakdown.get("traces"):
+        return {}
+    return {"startup_breakdown": breakdown}
+
+
 def _bind_call_percentiles() -> dict:
     """TRUE bind-call percentiles from the histogram's retained raw
     samples. The old ``quantile(0.99)`` answer was a bucket UPPER BOUND
@@ -240,7 +282,8 @@ async def run_density(n_nodes: int = 100, n_pods: int = 3000,
                       max_pods_per_node: int = 110,
                       paced_pods: int = 300,
                       paced_rate: float = 100.0,
-                      feature_gates: str = "") -> dict:
+                      feature_gates: str = "",
+                      trace_sample: float = 0.0) -> dict:
     """Create nodes, start the scheduler, pour pods in, wait until every
     pod is bound. Returns throughput + latency percentiles.
 
@@ -253,18 +296,62 @@ async def run_density(n_nodes: int = 100, n_pods: int = 3000,
     create→bound times, and ``api_request_latency`` carries the
     apiserver's own per-request percentiles (the BASELINE "API call
     latency p99 < 1s" SLO instrument) scraped from its /metrics.
+
+    ``trace_sample`` > 0 arms ktrace at that rate for this run and adds
+    a ``startup_breakdown`` stanza: span-derived per-stage
+    (create/queue/schedule/bind) raw percentiles + shares. The REST
+    arm's create spans live in the apiserver SUBPROCESS, so its
+    breakdown covers the scheduler-side stages.
     """
     for m in (sched_metrics.E2E_SCHEDULING_LATENCY,
               sched_metrics.ALGORITHM_LATENCY,
               sched_metrics.BINDING_LATENCY,
-              sched_metrics.PODS_SCHEDULED):
+              sched_metrics.PODS_SCHEDULED,
+              sched_metrics.LOOP_LAG):
         m.reset()  # isolate this run from earlier ones in the process
 
-    if via == "rest":
-        return await _run_density_rest(
-            n_nodes, n_pods, timeout, create_concurrency, max_pods_per_node,
-            paced_pods, paced_rate, feature_gates=feature_gates)
+    prev_rate = _arm_tracing(trace_sample)
+    prev_env = None
+    if prev_rate is not None and via == "rest":
+        # The REST arm's apiserver (and loadgen) are SUBPROCESSES: the
+        # in-process rate does not reach them, and the apiserver is
+        # where pods get stamped — forward the rate via the env they
+        # inherit, or the breakdown would silently come back empty.
+        import os
+        prev_env = os.environ.get("KTPU_TRACE")
+        # str(float()) keeps the decimal point: "1.0", never "1" —
+        # bare "1" means "armed at the DEFAULT rate" in the env
+        # grammar, which would silently sample 1% instead of 100%.
+        os.environ["KTPU_TRACE"] = str(float(trace_sample))
+    try:
+        if via == "rest":
+            out = await _run_density_rest(
+                n_nodes, n_pods, timeout, create_concurrency,
+                max_pods_per_node, paced_pods, paced_rate,
+                feature_gates=feature_gates)
+        else:
+            out = await _run_density_local(
+                n_nodes, n_pods, timeout, via, max_pods_per_node,
+                paced_pods, paced_rate)
+        out.update(_scheduler_loop_stats())
+        if prev_rate is not None:
+            out.update(_trace_breakdown())
+        return out
+    finally:
+        if prev_rate is not None:
+            from .. import tracing
+            tracing.set_sample_rate(prev_rate)
+        if via == "rest" and prev_rate is not None:
+            import os
+            if prev_env is None:
+                os.environ.pop("KTPU_TRACE", None)
+            else:
+                os.environ["KTPU_TRACE"] = prev_env
 
+
+async def _run_density_local(n_nodes: int, n_pods: int, timeout: float,
+                             via: str, max_pods_per_node: int,
+                             paced_pods: int, paced_rate: float) -> dict:
     reg = Registry()
     reg.admission = default_chain(reg)
     reg.create(t.Namespace(metadata=ObjectMeta(name="default")))

@@ -163,11 +163,34 @@ async def _count_bound(stream, keys: set, want: int,
 
 
 async def run_gang_bench(n_slices: int = 8, n_gangs: Optional[int] = None,
-                         timeout: float = 600.0) -> dict:
+                         timeout: float = 600.0,
+                         trace_sample: float = 0.0) -> dict:
+    """``trace_sample`` > 0 arms ktrace for phase 1 and adds a
+    span-derived ``startup_breakdown`` (create/queue/schedule/bind
+    shares as raw-sample percentiles) to the result — the gang-path
+    sibling of run_density's stanza."""
     from ..scheduler import metrics as sm
+    from .density import _arm_tracing, _trace_breakdown
     sm.PREEMPTION_LATENCY.reset()  # isolate this run
     import math
     reg, fleet_chips, n_gangs, members = _bench_fleet(n_slices, n_gangs)
+    prev_rate = _arm_tracing(trace_sample)
+    try:
+        return await _run_gang_bench_inner(
+            reg, fleet_chips, n_gangs, members, n_slices, timeout,
+            traced=prev_rate is not None)
+    finally:
+        if prev_rate is not None:
+            from .. import tracing
+            tracing.set_sample_rate(prev_rate)
+
+
+async def _run_gang_bench_inner(reg, fleet_chips, n_gangs, members,
+                                n_slices, timeout,
+                                traced: bool = False) -> dict:
+    from ..scheduler import metrics as sm
+    from .density import _trace_breakdown
+    import math
 
     client = LocalClient(reg)
     sched = Scheduler(client, backoff_seconds=0.5)
@@ -191,6 +214,9 @@ async def run_gang_bench(n_slices: int = 8, n_gangs: Optional[int] = None,
             raise TimeoutError(
                 f"only {len(bound_keys)}/{want_bound} pods bound") from None
         wall = time.perf_counter() - start
+        # Span-derived breakdown scoped to the CLEAN phase-1 wave
+        # (later phases preempt/rebind, which skews stage shares).
+        breakdown = _trace_breakdown() if traced else {}
     except BaseException:
         await sched.stop()
         raise
@@ -336,6 +362,7 @@ async def run_gang_bench(n_slices: int = 8, n_gangs: Optional[int] = None,
         "fleet_chips": fleet_chips,
         "gangs": n_gangs,
         "pods": len(bound),  # actual, not the target — evictions show
+        **breakdown,
         "wall_seconds": round(wall, 3),
         "gangs_per_second": round(n_gangs / wall, 2),
         "pods_per_second": round(want_bound / wall, 2),

@@ -49,3 +49,19 @@ PREEMPTION_VICTIMS = Counter(
     "scheduler_preemption_victims_total", "Pods evicted by preemption")
 
 PENDING_PODS = Gauge("scheduler_pending_pods", "Pods waiting in queue")
+
+#: Loop-lag probe family (util/loopprobe.py — the apiserver
+#: router/shard probes' scheduler sibling, PR 9 instrumented only
+#: those): how late the scheduler's event loop runs per tick. The
+#: density harness reports the busy fraction beside the apiserver's —
+#: ROADMAP item 3 names scheduler-side CPU as the next wall.
+LOOP_LAG = Histogram(
+    "scheduler_loop_lag_ms",
+    "Event-loop scheduling lag per probe tick on the scheduler loop",
+    buckets=(0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0,
+             250.0, 500.0, 1000.0),
+    sample_limit=20_000)
+
+LOOP_BUSY = Gauge(
+    "scheduler_loop_busy_fraction",
+    "EWMA busy fraction of the scheduler event loop (loop-lag derived)")

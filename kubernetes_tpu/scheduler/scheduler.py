@@ -20,11 +20,13 @@ import logging
 import time
 from typing import Optional
 
+from .. import tracing
 from ..api import errors, types as t
 from ..api.scheme import deepcopy
 from ..client.informer import SharedInformer
 from ..client.interface import Client
 from ..client.record import EventRecorder
+from ..util.loopprobe import loop_lag_probe
 from ..util.tasks import spawn
 from ..util.trace import Trace
 from . import metrics as m
@@ -209,6 +211,12 @@ class Scheduler:
         #: LogIfLong threshold; the reference uses 100ms).
         self.trace_threshold = 0.1
         self._ring_offset = 0
+        #: Open "queue" spans per pod key (ktrace): started when a
+        #: sampled pod enters the scheduling queue, ended at pop.
+        #: Bounded by pending sampled pods; swept on pod delete.
+        self._queue_spans: dict[str, object] = {}
+        #: Loop-lag probe task (scheduler_loop_lag_ms family).
+        self._probe_task: Optional[asyncio.Task] = None
 
     # -- wiring (reference: factory.go:137 NewConfigFactory) --------------
 
@@ -263,11 +271,16 @@ class Scheduler:
         if replay_groups:
             for g in groups.list():
                 self._group_changed_add(g)
+        self._probe_task = spawn(loop_lag_probe(m.LOOP_LAG, m.LOOP_BUSY),
+                                 name="scheduler-loop-probe")
         self._task = asyncio.get_running_loop().create_task(self._run())
 
     async def stop(self) -> None:
         self._stopped = True
         await self.queue.close()
+        if self._probe_task is not None:
+            self._probe_task.cancel()
+            self._probe_task = None
         if self._task:
             self._task.cancel()
             try:
@@ -293,6 +306,53 @@ class Scheduler:
             for inf in self._informers:
                 await inf.stop()
 
+    # -- ktrace lifecycle spans -------------------------------------------
+
+    def _open_queue_span(self, pod: t.Pod) -> None:
+        """Start the pod's "queue" stage span (sampled pods only; one
+        armed() check is the entire disarmed cost)."""
+        if not tracing.armed():
+            return
+        key = pod.key()
+        if key in self._queue_spans:
+            return
+        ctx = tracing.context_of(pod)
+        if ctx is None:
+            return
+        attrs = {"pod": key}
+        if pod.spec.gang:
+            attrs["gang"] = f"{pod.metadata.namespace}/{pod.spec.gang}"
+        self._queue_spans[key] = tracing.start_span(
+            "queue", component="scheduler", parent=ctx, attrs=attrs)
+
+    def _close_queue_span(self, key: str, **attrs) -> None:
+        span = self._queue_spans.pop(key, None)
+        if span is not None:
+            span.end(**attrs)
+
+    def _gang_stage_spans(self, pods: list, name: str,
+                          prev: Optional[list]) -> Optional[list]:
+        """Advance every sampled gang member to lifecycle stage
+        ``name``: end the previous stage's spans (queue spans on the
+        first call, ``prev`` afterwards) and open the next. Returns
+        the open spans (None when nothing is sampled)."""
+        if prev:
+            for sp in prev:
+                sp.end()
+        if not tracing.armed():
+            return None
+        spans = []
+        for p in pods:
+            ctx = tracing.context_of(p)
+            if ctx is None:
+                continue
+            self._close_queue_span(p.key())
+            spans.append(tracing.start_span(
+                name, component="scheduler", parent=ctx,
+                attrs={"pod": p.key(),
+                       "gang": f"{p.metadata.namespace}/{p.spec.gang}"}))
+        return spans or None
+
     # -- informer handlers ------------------------------------------------
 
     def _relevant(self, pod: t.Pod) -> bool:
@@ -301,6 +361,7 @@ class Scheduler:
 
     def _pod_added(self, pod: t.Pod) -> None:
         if not pod.spec.node_name and self._relevant(pod):
+            self._open_queue_span(pod)
             spawn(self.queue.add_pod(pod), name="queue-add-pod")
         elif pod.spec.node_name:
             self.cache.add_pod(pod)
@@ -312,6 +373,10 @@ class Scheduler:
 
     def _pod_updated(self, old: t.Pod, pod: t.Pod) -> None:
         if pod.spec.node_name:
+            # Bound (possibly by another scheduler / a recovery path
+            # that never popped it here): a still-open queue span must
+            # not dangle until pod deletion.
+            self._close_queue_span(pod.key())
             self.cache.update_pod(pod)
             if pod.spec.gang and t.is_pod_active(pod):
                 self.queue.gang_pod_confirmed(pod)
@@ -323,10 +388,12 @@ class Scheduler:
                 if pod.spec.gang:
                     self.queue.gang_pod_lost(pod)
         elif self._relevant(pod):
+            self._open_queue_span(pod)
             spawn(self.queue.add_pod(pod), name="queue-add-pod")
 
     def _pod_deleted(self, pod: t.Pod) -> None:
         self.cache.remove_pod(pod)
+        self._close_queue_span(pod.key(), cancelled="pod deleted")
         spawn(self.queue.remove_pod(pod), name="queue-remove-pod")
 
     def _group_changed_add(self, group: t.PodGroup) -> None:
@@ -386,8 +453,15 @@ class Scheduler:
         key = pod.key()
         if (pod.spec.node_name or not t.is_pod_active(pod)
                 or self.cache.knows_pod(key)):
+            self._close_queue_span(key, skipped="already bound/terminal")
             return
 
+        # ktrace: queue stage ends at pop, schedule stage runs through
+        # placement + assume (NOOP spans unless this pod is sampled).
+        self._close_queue_span(key)
+        ctx = tracing.context_of(pod) if tracing.armed() else None
+        sched_span = tracing.start_span("schedule", component="scheduler",
+                                        parent=ctx, attrs={"pod": key})
         # Op trace (reference: generic_scheduler.go:110-141 utiltrace) —
         # logged only when this placement ran long.
         trace = Trace("schedule-one", pod=key)
@@ -399,6 +473,7 @@ class Scheduler:
         trace.step("placement computed")
         m.ALGORITHM_LATENCY.observe(time.perf_counter() - start)
         if node_name is None:
+            sched_span.end(result="unschedulable")
             await self._handle_unschedulable(pod, reasons)
             trace.step("handled unschedulable")
             trace.log_if_long(self.trace_threshold)
@@ -412,11 +487,15 @@ class Scheduler:
         self.cache.assume_pod(assumed, node_name)
         trace.step("assumed in cache")
         trace.log_if_long(self.trace_threshold)
+        sched_span.end(node=node_name)
 
         # Bind asynchronously (reference: scheduler.go:484-495 binds in a
         # goroutine) so the next pod's placement overlaps this pod's RPC;
         # the semaphore bounds in-flight binds.
         async def bind_task():
+            bind_span = tracing.start_span(
+                "bind", component="scheduler", parent=ctx,
+                attrs={"pod": key, "node": node_name})
             try:
                 async with self._bind_sem:
                     # The coalescer folds concurrent binds into one
@@ -430,6 +509,7 @@ class Scheduler:
                         t.Binding(target=t.BindingTarget(
                             node_name=node_name, tpu_bindings=bindings)))
             except Exception as e:  # noqa: BLE001
+                bind_span.end(error=str(e))
                 self.cache.forget_pod(assumed)
                 if isinstance(e, errors.NotFoundError):
                     return  # pod deleted while queued
@@ -438,6 +518,7 @@ class Scheduler:
                 await self.queue.requeue(pod, self.backoff_seconds)
                 m.PODS_SCHEDULED.inc(result="bind_error")
                 return
+            bind_span.end()
             m.E2E_SCHEDULING_LATENCY.observe(time.perf_counter() - start)
             m.PODS_SCHEDULED.inc(result="ok")
             self.recorder.event(pod, "Normal", "Scheduled",
@@ -960,6 +1041,21 @@ class Scheduler:
                 pass
 
     async def _schedule_gang(self, unit: GangUnit) -> None:
+        # ktrace wrapper: members advance queue -> schedule here, and
+        # schedule -> bind inside (at the batched bind). The finally
+        # ends whatever stage is open on EVERY exit path (requeue,
+        # suspension, unschedulable, success) — a dropped span would
+        # leak and never reach the collector. _run awaits one item at
+        # a time, so the holder never sees two gangs.
+        holder = [self._gang_stage_spans(unit.pods, "schedule", None)]
+        try:
+            await self._schedule_gang_inner(unit, holder)
+        finally:
+            for sp in (holder[0] or ()):
+                sp.end()
+
+    async def _schedule_gang_inner(self, unit: GangUnit,
+                                   _stage: list) -> None:
         start = time.perf_counter()
         ns, name = unit.group_key.split("/", 1)
         try:
@@ -1169,6 +1265,8 @@ class Scheduler:
         # wire path; per-item outcomes keep the all-or-nothing
         # accounting below). The old per-pod fan-out cost a 16-pod gang
         # 16 HTTP requests — the dominant wire-path gang cost.
+        _stage[0] = self._gang_stage_spans(
+            [p for p, _n, _b in plan.placements], "bind", _stage[0])
         bind_start = time.perf_counter()
         try:
             results = await self.client.bind_many(

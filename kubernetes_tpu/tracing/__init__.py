@@ -1,0 +1,23 @@
+"""End-to-end pod-lifecycle tracing (the ktrace layer).
+
+Zero-dependency span layer with W3C-traceparent-style context
+propagation: ``RESTClient`` stamps outgoing requests, the apiserver
+middleware opens a server span and ``Registry.create`` stamps sampled
+Pods/PodGroups with a durable ``trace.tpu/traceparent`` annotation, the
+annotation rides MVCC watch events to every informer (which re-attach
+it around handler delivery), and the scheduler/queue/node-agent open
+child spans — one pod's life (create -> queue -> schedule -> bind ->
+pull -> start -> ready) reconstructs as a single trace.
+
+Armed via ``KTPU_TRACE`` (see context.py); disarmed, every seam costs
+one module-bool check. Finished spans land in the bounded in-process
+:data:`COLLECTOR` (collector.py), surfaced by ``GET /debug/v1/traces``
+and rendered by ``ktl trace pod|gang``.
+"""
+from .collector import COLLECTOR, SpanCollector  # noqa: F401
+from .context import (  # noqa: F401
+    DEFAULT_SAMPLE_RATE, TRACE_ID_ANNOTATION, TRACEPARENT_ANNOTATION,
+    TRACEPARENT_HEADER, TraceContext, armed, attach, context_of, current,
+    decode, detach, encode, sample_rate, sample_root, set_sample_rate,
+    stamp, use)
+from .span import NOOP_SPAN, Span, root_span, start_span  # noqa: F401

@@ -1,0 +1,135 @@
+"""Bounded in-process span collector + JSONL export.
+
+The sink every finished span lands in. Bounded like the watch queues:
+a ring of ``max_spans`` (oldest dropped, counted) — tracing must never
+grow memory with uptime. Exposed three ways:
+
+- ``GET /debug/v1/traces`` on the apiserver (server.py) serves this
+  process's buffer filtered by trace id / pod / component;
+- ``POST /debug/v1/traces`` ingests spans pushed by OUT-of-process
+  components (multi-host agents; in a LocalCluster every component
+  shares this process and no push is needed);
+- ``KTPU_TRACE_EXPORT=<path>`` appends every collected span as one
+  JSON line at process exit (offline analysis; perf harnesses read
+  the buffer directly instead).
+"""
+from __future__ import annotations
+
+import json
+import os
+from collections import deque
+from typing import Optional
+
+from ..metrics.registry import Counter, Gauge
+from ..util.lockdep import make_lock
+
+TRACE_SPANS = Counter(
+    "trace_spans_total",
+    "Finished spans collected, by component",
+    labels=("component",))
+
+TRACE_SPANS_DROPPED = Counter(
+    "trace_spans_dropped_total",
+    "Spans evicted from the bounded collector ring (oldest-first)")
+
+TRACE_BUFFER_SPANS = Gauge(
+    "trace_buffer_spans",
+    "Spans currently retained in the in-process collector")
+
+#: Ring size; override via KTPU_TRACE_BUFFER. Sized for a traced
+#: LocalCluster run (a pod's lifecycle is ~6-8 spans; 16k spans covers
+#: ~2k traced pods) — perf arms sample, so they stay far below it.
+_DEFAULT_MAX = 16384
+
+
+class SpanCollector:
+    def __init__(self, max_spans: Optional[int] = None):
+        if max_spans is None:
+            try:
+                max_spans = int(os.environ.get("KTPU_TRACE_BUFFER", "")
+                                or _DEFAULT_MAX)
+            except ValueError:
+                max_spans = _DEFAULT_MAX
+        self.max_spans = max(1, max_spans)
+        self._spans: deque[dict] = deque(maxlen=self.max_spans)
+        #: Shard workers are real threads; the ring must not corrupt.
+        self._lock = make_lock("tracing.SpanCollector")
+        self.dropped = 0
+
+    def add(self, span: dict) -> None:
+        with self._lock:
+            if len(self._spans) >= self.max_spans:
+                self.dropped += 1
+                TRACE_SPANS_DROPPED.inc()
+            self._spans.append(span)
+            TRACE_BUFFER_SPANS.set(float(len(self._spans)))
+        TRACE_SPANS.inc(component=span.get("component", ""))
+
+    def ingest(self, spans: list) -> int:
+        """Accept externally produced span dicts (the POST surface);
+        returns how many were taken. Malformed items are skipped —
+        telemetry ingest must never 500 a remote agent into backoff."""
+        taken = 0
+        for s in spans:
+            if isinstance(s, dict) and s.get("trace_id") \
+                    and s.get("span_id"):
+                self.add(s)
+                taken += 1
+        return taken
+
+    def snapshot(self, trace_id: str = "", pod: str = "",
+                 component: str = "", limit: int = 0) -> list[dict]:
+        """Matching spans, oldest first. ``pod`` matches the span's
+        ``attrs.pod`` ("ns/name"). ``limit`` keeps the NEWEST N."""
+        with self._lock:
+            spans = list(self._spans)
+        if trace_id:
+            spans = [s for s in spans if s.get("trace_id") == trace_id]
+        if pod:
+            spans = [s for s in spans
+                     if (s.get("attrs") or {}).get("pod") == pod]
+        if component:
+            spans = [s for s in spans if s.get("component") == component]
+        if limit > 0 and len(spans) > limit:
+            spans = spans[-limit:]
+        return spans
+
+    def trace_ids(self) -> set[str]:
+        with self._lock:
+            return {s.get("trace_id", "") for s in self._spans}
+
+    def __len__(self) -> int:
+        return len(self._spans)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+            self.dropped = 0
+            TRACE_BUFFER_SPANS.set(0.0)
+
+    def export_jsonl(self, path: str) -> int:
+        """Append every retained span as one JSON line; returns the
+        span count written."""
+        with self._lock:
+            spans = list(self._spans)
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        with open(path, "a") as f:
+            for s in spans:
+                f.write(json.dumps(s, sort_keys=True) + "\n")
+        return len(spans)
+
+    def dump_jsonl(self) -> str:
+        with self._lock:
+            spans = list(self._spans)
+        return "".join(json.dumps(s, sort_keys=True) + "\n" for s in spans)
+
+
+#: Process-global collector (per-component collectors are possible by
+#: constructing SpanCollector directly; everything in-tree shares).
+COLLECTOR = SpanCollector()
+
+_export_path = os.environ.get("KTPU_TRACE_EXPORT", "")
+if _export_path:
+    import atexit
+
+    atexit.register(lambda: COLLECTOR.export_jsonl(_export_path))

@@ -1,0 +1,186 @@
+"""Trace context — W3C-traceparent-style ids + contextvar propagation.
+
+Reference: the ``traceparent`` header of the W3C Trace Context spec
+(``00-<32 hex trace id>-<16 hex span id>-<2 hex flags>``) — the same
+wire shape OpenTelemetry's kube-apiserver tracing emits — carried here
+on three channels:
+
+- HTTP requests (``client/rest.py`` stamps the header, the apiserver
+  middleware decodes it);
+- object annotations (``trace.tpu/traceparent`` on Pods/PodGroups,
+  stamped by ``Registry.create``) — the durable channel: the id rides
+  MVCC watch events to every informer, so components that never saw
+  the originating request still join the pod's trace;
+- an asyncio :class:`contextvars.ContextVar` inside each process (the
+  in-task channel informers re-attach on handler delivery).
+
+Arming: ``KTPU_TRACE`` env, same opt-in style as TPU_CHAOS/TPU_SAN.
+``1``/``on``/``true`` arms at the DEFAULT sample rate (0.01 — one pod
+in a hundred pays for spans; the other 99 cost one rng call at create
+and nothing after); an explicit float (``0.5``, ``1.0``) arms at that
+rate; unset/``0``/``off`` disarms — the hot path then pays a single
+module-bool check per seam.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import random
+from contextvars import ContextVar
+from dataclasses import dataclass
+from typing import Optional
+
+#: Durable per-object trace pointer (Pods/PodGroups): the full
+#: traceparent of the create span, so later components parent on it.
+TRACEPARENT_ANNOTATION = "trace.tpu/traceparent"
+#: Event breadcrumb (client/record.py): bare trace id, so ``ktl trace
+#: pod`` can interleave the pod's Events with its spans.
+TRACE_ID_ANNOTATION = "trace.tpu/trace-id"
+#: HTTP header (client/rest.py -> apiserver middleware).
+TRACEPARENT_HEADER = "traceparent"
+
+DEFAULT_SAMPLE_RATE = 0.01
+
+#: Id source: a private Random so tracing never perturbs globally
+#: seeded streams (chaos/tpusan own their Random instances; the global
+#: module rng belongs to jitter/backoff callers).
+_rng = random.Random(os.urandom(8))
+
+_CURRENT: ContextVar[Optional["TraceContext"]] = ContextVar(
+    "ktpu_trace_ctx", default=None)
+
+
+@dataclass(frozen=True)
+class TraceContext:
+    trace_id: str  # 32 lowercase hex chars
+    span_id: str   # 16 lowercase hex chars
+    sampled: bool = True
+
+
+def _parse_rate(raw: str) -> float:
+    raw = raw.strip().lower()
+    if raw in ("", "0", "off", "false", "no"):
+        return 0.0
+    if raw in ("1", "on", "true", "yes"):
+        return DEFAULT_SAMPLE_RATE
+    try:
+        rate = float(raw)
+    except ValueError:
+        # Malformed values DISARM (and say so): "0.5x" must not
+        # silently arm at a rate the operator never chose — the
+        # documented contract is that only recognized values arm.
+        import logging
+        logging.getLogger("tracing").warning(
+            "KTPU_TRACE=%r is not a recognized value; tracing stays "
+            "OFF (use 1/on for the default %.2f rate, or a float)",
+            raw, DEFAULT_SAMPLE_RATE)
+        return 0.0
+    return min(max(rate, 0.0), 1.0)
+
+
+#: Effective sample rate; 0.0 = tracing disarmed entirely.
+_RATE = _parse_rate(os.environ.get("KTPU_TRACE", ""))
+
+
+def armed() -> bool:
+    """True when tracing is on at all — the ONE check every hot-path
+    seam makes before touching contexts or annotations."""
+    return _RATE > 0.0
+
+
+def sample_rate() -> float:
+    return _RATE
+
+
+def set_sample_rate(rate: float) -> float:
+    """Re-arm at ``rate`` (tests/harnesses); returns the previous rate
+    so callers can restore it."""
+    global _RATE
+    prev = _RATE
+    _RATE = min(max(float(rate), 0.0), 1.0)
+    return prev
+
+
+def new_trace_id() -> str:
+    return f"{_rng.getrandbits(128):032x}"
+
+
+def new_span_id() -> str:
+    return f"{_rng.getrandbits(64):016x}"
+
+
+def sample_root() -> Optional[TraceContext]:
+    """A fresh root context, subject to the sample rate: None means
+    'this trace is not taken' — callers then stamp/open nothing, which
+    IS the overhead gate (an unsampled pod costs one rng call here and
+    zero work everywhere downstream)."""
+    if _RATE <= 0.0 or (_RATE < 1.0 and _rng.random() >= _RATE):
+        return None
+    return TraceContext(new_trace_id(), new_span_id(), True)
+
+
+def encode(ctx: TraceContext) -> str:
+    return f"00-{ctx.trace_id}-{ctx.span_id}-{'01' if ctx.sampled else '00'}"
+
+
+def decode(header: Optional[str]) -> Optional[TraceContext]:
+    """Strict-enough traceparent parse; None for anything malformed
+    (a bad header must degrade to 'untraced', never to an error)."""
+    if not header:
+        return None
+    parts = header.strip().split("-")
+    if len(parts) != 4:
+        return None
+    version, trace_id, span_id, flags = parts
+    if len(version) != 2 or len(trace_id) != 32 or len(span_id) != 16 \
+            or len(flags) != 2:
+        return None
+    try:
+        int(trace_id, 16), int(span_id, 16), int(flags, 16)
+    except ValueError:
+        return None
+    if trace_id == "0" * 32 or span_id == "0" * 16:
+        return None
+    return TraceContext(trace_id.lower(), span_id.lower(),
+                        bool(int(flags, 16) & 1))
+
+
+# -- contextvar plumbing ---------------------------------------------------
+
+def current() -> Optional[TraceContext]:
+    return _CURRENT.get()
+
+
+def attach(ctx: Optional[TraceContext]):
+    """Set the current context; returns the token for :func:`detach`."""
+    return _CURRENT.set(ctx)
+
+
+def detach(token) -> None:
+    _CURRENT.reset(token)
+
+
+@contextlib.contextmanager
+def use(ctx: Optional[TraceContext]):
+    token = _CURRENT.set(ctx)
+    try:
+        yield ctx
+    finally:
+        _CURRENT.reset(token)
+
+
+# -- object annotations ----------------------------------------------------
+
+def context_of(obj) -> Optional[TraceContext]:
+    """The trace context stamped on an API object (Pod/PodGroup), or
+    None. Cheap by construction: one dict get + decode, and callers
+    gate on :func:`armed` first."""
+    try:
+        raw = obj.metadata.annotations.get(TRACEPARENT_ANNOTATION)
+    except AttributeError:
+        return None
+    return decode(raw)
+
+
+def stamp(obj, ctx: TraceContext) -> None:
+    obj.metadata.annotations[TRACEPARENT_ANNOTATION] = encode(ctx)

@@ -1,0 +1,163 @@
+"""Spans — the unit of the pod-lifecycle trace.
+
+A :class:`Span` is one named, timed stage (``create``, ``queue``,
+``schedule``, ``bind``, ``pull``, ``start``, ``startup``) attributed to
+a component (apiserver/scheduler/node/...). Finished spans land in the
+bounded in-process collector (collector.py); live ones cost two floats
+and a couple of dict slots.
+
+Zero-overhead-when-off contract: :func:`start_span` returns the shared
+:data:`NOOP_SPAN` singleton unless tracing is armed AND the parent
+context is sampled — every call site can therefore use spans
+unconditionally (``span.event(...)``, ``span.end()``) without its own
+gating, and the disarmed cost is one module-bool check.
+"""
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from . import context as tc
+from .context import TraceContext
+
+_SENTINEL = object()
+
+
+class Span:
+    __slots__ = ("trace_id", "span_id", "parent_id", "name", "component",
+                 "start", "_t0", "attrs", "events", "_ended", "_token")
+
+    def __init__(self, name: str, component: str, parent: TraceContext,
+                 attrs: Optional[dict] = None):
+        self.trace_id = parent.trace_id
+        self.span_id = tc.new_span_id()
+        self.parent_id = parent.span_id
+        self.name = name
+        self.component = component
+        self.start = time.time()
+        self._t0 = time.perf_counter()
+        self.attrs = dict(attrs) if attrs else {}
+        self.events: list[tuple[float, str]] = []
+        self._ended = False
+        self._token = None
+
+    @property
+    def noop(self) -> bool:
+        return False
+
+    def context(self) -> TraceContext:
+        """This span's context — children parent on it, and the
+        annotation stamp persists it."""
+        return TraceContext(self.trace_id, self.span_id, True)
+
+    def event(self, msg: str) -> None:
+        self.events.append((self.start + (time.perf_counter() - self._t0),
+                            msg))
+
+    def set(self, key: str, value) -> None:
+        self.attrs[key] = value
+
+    def activate(self) -> "Span":
+        """Make this span's context the current one until :meth:`end`
+        (server-span pattern: everything the handler does nests)."""
+        if self._token is None:
+            self._token = tc.attach(self.context())
+        return self
+
+    def end(self, **attrs) -> None:
+        """Idempotent finish: stamp duration, hand to the collector,
+        restore any activated context."""
+        if self._ended:
+            return
+        self._ended = True
+        if attrs:
+            self.attrs.update(attrs)
+        if self._token is not None:
+            tc.detach(self._token)
+            self._token = None
+        end = self.start + (time.perf_counter() - self._t0)
+        from .collector import COLLECTOR
+        COLLECTOR.add({
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "name": self.name,
+            "component": self.component,
+            "start": self.start,
+            "end": end,
+            "duration_ms": round((end - self.start) * 1e3, 3),
+            "attrs": self.attrs,
+            "events": [[round(ts, 6), msg] for ts, msg in self.events],
+        })
+
+    def __enter__(self) -> "Span":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc_type is not None:
+            self.attrs.setdefault("error", repr(exc))
+        self.end()
+
+
+class _NoopSpan:
+    """The disarmed/unsampled stand-in — every method is a no-op, so
+    call sites never branch on tracing state themselves."""
+    __slots__ = ()
+
+    @property
+    def noop(self) -> bool:
+        return True
+
+    def context(self) -> Optional[TraceContext]:
+        return None
+
+    def event(self, msg: str) -> None:
+        pass
+
+    def set(self, key: str, value) -> None:
+        pass
+
+    def activate(self) -> "_NoopSpan":
+        return self
+
+    def end(self, **attrs) -> None:
+        pass
+
+    def __enter__(self) -> "_NoopSpan":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        pass
+
+
+NOOP_SPAN = _NoopSpan()
+
+
+def start_span(name: str, component: str = "", parent=_SENTINEL,
+               attrs: Optional[dict] = None):
+    """A child span under ``parent`` (default: the current context).
+    Returns :data:`NOOP_SPAN` when tracing is disarmed, there is no
+    parent, or the parent is unsampled — spans exist only inside
+    sampled traces; roots are minted by :func:`root_span` (or the
+    apiserver's create stamp) where the sampling decision lives."""
+    if not tc.armed():
+        return NOOP_SPAN
+    if parent is _SENTINEL:
+        parent = tc.current()
+    if parent is None or not getattr(parent, "sampled", False):
+        return NOOP_SPAN
+    return Span(name, component, parent, attrs)
+
+
+def root_span(name: str, component: str = "",
+              attrs: Optional[dict] = None):
+    """Start a NEW trace (subject to the sample rate) — harnesses and
+    ktl verbs use this so their server-side effects share one trace."""
+    ctx = tc.sample_root()
+    if ctx is None:
+        return NOOP_SPAN
+    span = Span(name, component, ctx, attrs)
+    # The minted root context's span id IS this span (sample_root made
+    # a placeholder id; the span is the trace's real root).
+    span.parent_id = ""
+    return span

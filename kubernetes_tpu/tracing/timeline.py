@@ -1,0 +1,176 @@
+"""Pod-lifecycle timeline reconstruction from spans.
+
+The ONE place stage boundaries are defined — ``ktl trace pod``,
+``hack/trace_smoke.sh``'s completeness gate, and the perf harnesses'
+startup-breakdown stanzas all call :func:`pod_timeline` /
+:func:`stage_breakdown`, so "what counts as the queue stage" cannot
+drift between the CLI and the gates.
+
+Stage model (create -> ready, every wall-clock moment attributed):
+
+    create    trace start        -> queue span start
+    queue     queue span start   -> schedule span start
+    schedule  schedule start     -> bind span start
+    bind      bind start         -> bind end
+    start     bind end           -> startup span end (node: admit,
+              image pull, container start, readiness — pull/start ride
+              as child spans inside ``startup``)
+
+Boundaries are span START times, so inter-component gaps (watch
+delivery, informer dispatch) are charged to the stage that was
+"holding" the pod — the sum of stage durations therefore equals the
+trace's e2e latency BY CONSTRUCTION, and the smoke's 5% check verifies
+the trace against an externally measured wall clock, not against
+itself.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+#: Spans that anchor stage boundaries, in lifecycle order.
+ANCHOR_SPANS = ("create", "queue", "schedule", "bind", "startup")
+#: Stages reported, in order.
+STAGES = ("create", "queue", "schedule", "bind", "start")
+
+
+def _first(spans: Sequence[dict], name: str) -> Optional[dict]:
+    """Earliest span of ``name`` (requeues/retries re-open stages; the
+    first occurrence anchors the boundary, repeats show as events)."""
+    best = None
+    for s in spans:
+        if s.get("name") != name:
+            continue
+        if best is None or s.get("start", 0.0) < best.get("start", 0.0):
+            best = s
+    return best
+
+
+def pod_timeline(spans: Sequence[dict]) -> Optional[dict]:
+    """Reconstruct one pod's stage timeline from its trace's spans.
+
+    Returns ``{"start", "end", "e2e_ms", "complete", "stages": [
+    {"stage", "start_ms", "duration_ms", "share"}, ...]}`` or None when
+    no anchor span is present at all. ``complete`` is True only when
+    the full create->queue->schedule->bind->startup chain is there —
+    the trace_smoke gate's definition of "a complete trace
+    reconstructs"."""
+    anchors = {name: _first(spans, name) for name in ANCHOR_SPANS}
+    present = [n for n in ANCHOR_SPANS if anchors[n] is not None]
+    if not present:
+        return None
+    t0 = min(anchors[n]["start"] for n in present)
+    # The trace ends when the pod is ready (startup span end). With no
+    # startup span (registry-only harnesses, pod not yet on a node)
+    # the LAST ANCHOR's end bounds the timeline and the "start" stage
+    # is omitted — a residual tail must not masquerade as node time.
+    stages_here: tuple = STAGES
+    if anchors["startup"] is not None:
+        t_end = anchors["startup"].get("end", t0)
+    else:
+        stages_here = tuple(s for s in STAGES if s != "start")
+        t_end = max(anchors[n].get("end", t0) for n in present)
+    # Stage boundary = next anchor's start; the last stage runs to the
+    # trace end. Missing anchors collapse their stage to zero at the
+    # next known boundary (and mark the timeline incomplete).
+    starts: list[tuple[str, float]] = []
+    cursor = t0
+    boundary_of = {
+        "create": anchors["create"],
+        "queue": anchors["queue"],
+        "schedule": anchors["schedule"],
+        "bind": anchors["bind"],
+        "start": anchors["bind"],  # start stage begins at bind END
+    }
+    for stage in stages_here:
+        a = boundary_of[stage]
+        if stage == "create":
+            begin = t0
+        elif stage == "start":
+            begin = (a.get("end", cursor) if a is not None else cursor)
+        else:
+            begin = (a.get("start", cursor) if a is not None else cursor)
+        begin = max(begin, cursor)
+        starts.append((stage, begin))
+        cursor = begin
+    e2e = max(t_end - t0, 0.0)
+    stages = []
+    for i, (stage, begin) in enumerate(starts):
+        nxt = starts[i + 1][1] if i + 1 < len(starts) else t_end
+        dur = max(nxt - begin, 0.0)
+        stages.append({
+            "stage": stage,
+            "start_ms": round((begin - t0) * 1e3, 3),
+            "duration_ms": round(dur * 1e3, 3),
+            "share": round(dur / e2e, 4) if e2e > 0 else 0.0,
+        })
+    return {
+        "start": t0,
+        "end": t_end,
+        "e2e_ms": round(e2e * 1e3, 3),
+        "complete": all(anchors[n] is not None for n in ANCHOR_SPANS),
+        "stages": stages,
+    }
+
+
+def check_nesting(spans: Sequence[dict]) -> list[str]:
+    """Structural violations in one trace's spans: a child starting
+    before its parent, or a span ending before it starts. Returns
+    human-readable problems (empty = clean) — the integration test's
+    'monotonic, nested' assertion."""
+    by_id = {s.get("span_id"): s for s in spans}
+    problems = []
+    for s in spans:
+        if s.get("end", 0.0) + 1e-9 < s.get("start", 0.0):
+            problems.append(f"span {s.get('name')} ends before it starts")
+        parent = by_id.get(s.get("parent_id") or "")
+        if parent is not None \
+                and s.get("start", 0.0) + 1e-9 < parent.get("start", 0.0):
+            problems.append(
+                f"span {s.get('name')} starts before its parent "
+                f"{parent.get('name')}")
+    return problems
+
+
+def stage_breakdown(all_spans: Sequence[dict]) -> dict:
+    """Aggregate per-stage breakdown over MANY traces — the perf
+    harnesses' span-derived startup decomposition. Groups spans by
+    trace id, reconstructs each timeline, and reports per-stage
+    raw-sample percentiles (p50/p99 ms, the package's nearest-rank
+    definition) plus each stage's share of total attributed time, so a
+    future perf PR attacks the measured stage, not a guess. Stages
+    with no samples are omitted (registry-only harnesses have no node
+    half, hence no ``start`` stage)."""
+    from ..perf import pct
+    by_trace: dict[str, list] = {}
+    for s in all_spans:
+        by_trace.setdefault(s.get("trace_id", ""), []).append(s)
+    samples: dict[str, list[float]] = {}
+    e2e: list[float] = []
+    traces = 0
+    for spans in by_trace.values():
+        tl = pod_timeline(spans)
+        if tl is None:
+            continue
+        traces += 1
+        e2e.append(tl["e2e_ms"])
+        for st in tl["stages"]:
+            if st["duration_ms"] > 0.0:
+                samples.setdefault(st["stage"], []).append(
+                    st["duration_ms"])
+    total = sum(sum(v) for v in samples.values())
+    out: dict = {"traces": traces}
+    if e2e:
+        ordered = sorted(e2e)
+        out["e2e_p50_ms"] = round(pct(ordered, 0.5), 3)
+        out["e2e_p99_ms"] = round(pct(ordered, 0.99), 3)
+    for stage in STAGES:
+        vals = samples.get(stage)
+        if not vals:
+            continue
+        ordered = sorted(vals)
+        out[stage] = {
+            "p50_ms": round(pct(ordered, 0.5), 3),
+            "p99_ms": round(pct(ordered, 0.99), 3),
+            "share": round(sum(vals) / total, 4) if total > 0 else 0.0,
+        }
+    return out

@@ -105,6 +105,13 @@ KNOWN_FEATURES = {f.name: f for f in [
             "shrink to spec.min_replicas under reclaim instead of "
             "dying. Off = every eviction path is the legacy hard "
             "kill, byte-identical"),
+    Feature("ClusterMonitoring", True, BETA,
+            "cluster-level TPU telemetry rollup (monitoring/"
+            "aggregator.py): the controller-manager scrapes node "
+            "/stats/summary into tpu_cluster_*/tpu_node_* series and "
+            "a queryable snapshot (ktl top nodes|pods; the custom-"
+            "metrics seam for autoscaling). Off = no scrape loop, no "
+            "series"),
 ]}
 
 

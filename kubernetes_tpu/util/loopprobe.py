@@ -1,0 +1,33 @@
+"""Event-loop lag probe — the shared loop-health instrument.
+
+One coroutine per probed loop: how late a short sleep fires is the
+time the loop spent busy (or starved by sibling processes) per tick.
+``_sum``/``_count`` deltas let bench harnesses attribute per-phase
+wall-vs-loop time; the busy gauge is a local EWMA for eyeballing
+/metrics. First grown for the apiserver router/shard loops (PR 9);
+the scheduler loop joined the family here — one implementation, so
+the probes cannot drift.
+"""
+from __future__ import annotations
+
+import asyncio
+
+#: Probe cadence; cheap by construction (one timer per loop).
+PROBE_INTERVAL = 0.05
+
+
+async def loop_lag_probe(lag_hist, busy_gauge,
+                         interval: float = PROBE_INTERVAL,
+                         **labels) -> None:
+    """Run forever (callers own the task): observe per-tick lag in ms
+    into ``lag_hist`` and an EWMA busy fraction into ``busy_gauge``,
+    both under ``labels``."""
+    loop = asyncio.get_running_loop()
+    busy = 0.0
+    while True:
+        t0 = loop.time()
+        await asyncio.sleep(interval)
+        lag = max(0.0, loop.time() - t0 - interval)
+        lag_hist.observe(lag * 1e3, **labels)
+        busy = 0.8 * busy + 0.2 * (lag / (lag + interval))
+        busy_gauge.set(round(busy, 4), **labels)

@@ -6,6 +6,13 @@ at the top of an operation, mark steps as they complete, and
 ONLY when the operation exceeded its threshold. Used by the reference
 scheduler (``generic_scheduler.go:110-141``) and apiserver handlers;
 wired the same way here.
+
+Folded into the span layer (tracing/): when tracing is armed and a
+sampled trace context is current, the Trace ALSO records a span whose
+events are the steps — so ``ktl trace pod`` shows the op's internal
+splits inline. Disarmed, behavior (and every log line) is
+byte-identical to the pre-span Trace: the span half is the shared
+no-op singleton.
 """
 from __future__ import annotations
 
@@ -13,26 +20,45 @@ import logging
 import time
 from typing import Optional
 
+from .. import tracing
+
 log = logging.getLogger("trace")
+
+#: The reference's LogIfLong threshold (context-manager default).
+DEFAULT_THRESHOLD = 0.1
 
 
 class Trace:
-    def __init__(self, name: str, **fields):
+    def __init__(self, name: str, threshold: float = DEFAULT_THRESHOLD,
+                 **fields):
+        """``threshold``: seconds the context-manager form (and
+        argument-less :meth:`log_if_long`) logs above — the previously
+        hard-coded 100ms, now a parameter per call site."""
         self.name = name
+        self.threshold = threshold
         self.fields = fields
         self.start = time.perf_counter()
         self.steps: list[tuple[float, str]] = []
+        #: Span sibling (NOOP unless armed + sampled context current).
+        self._span = tracing.start_span(name, component="optrace",
+                                        attrs=fields or None)
 
     def step(self, msg: str) -> None:
         self.steps.append((time.perf_counter(), msg))
+        self._span.event(msg)
 
     def total_seconds(self) -> float:
         return time.perf_counter() - self.start
 
-    def log_if_long(self, threshold: float,
+    def log_if_long(self, threshold: Optional[float] = None,
                     logger: Optional[logging.Logger] = None) -> bool:
-        """One line with per-step splits when total > threshold.
-        Returns whether it logged (tests hook this)."""
+        """One line with per-step splits when total > threshold
+        (default: this Trace's own threshold). Returns whether it
+        logged (tests hook this). Also ends the span half (idempotent
+        — terminal branches may each call this)."""
+        self._span.end()
+        if threshold is None:
+            threshold = self.threshold
         total = self.total_seconds()
         if total <= threshold:
             return False
@@ -54,5 +80,6 @@ class Trace:
         return self
 
     def __exit__(self, *exc) -> None:
-        # Context-manager use defaults to a 100ms threshold.
-        self.log_if_long(0.1)
+        # Context-manager use logs at this Trace's threshold (the old
+        # hard-coded 100ms is the constructor default).
+        self.log_if_long()

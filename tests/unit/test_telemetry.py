@@ -1,0 +1,192 @@
+"""Node TPU telemetry tier: the stub driver sim, the tpu_* gauge
+export (+ stale-series hygiene), and the cluster monitor's rollup."""
+import pytest
+
+from kubernetes_tpu.deviceplugin.stub import StubTpuPlugin, make_topology
+from kubernetes_tpu.monitoring import aggregator as agg
+from kubernetes_tpu.node import telemetry
+
+
+# -- driver sim ------------------------------------------------------------
+
+def test_stub_chip_metrics_shape():
+    p = StubTpuPlugin(make_topology(mesh_shape=(2, 2, 1)))
+    m = p.chip_metrics()
+    assert len(m) == 4
+    for rec in m.values():
+        for key in ("duty_cycle_pct", "hbm_used_bytes", "hbm_total_bytes",
+                    "ici_tx_bytes", "ici_rx_bytes", "ici_links"):
+            assert key in rec
+        assert 0.0 <= rec["duty_cycle_pct"] <= 100.0
+        assert rec["hbm_used_bytes"] <= rec["hbm_total_bytes"]
+
+
+def test_stub_chip_metrics_deterministic_per_chip():
+    p = StubTpuPlugin(make_topology(mesh_shape=(4, 1, 1)))
+    a, b = p.chip_metrics(), p.chip_metrics()
+    for cid in a:
+        assert a[cid]["duty_cycle_pct"] == b[cid]["duty_cycle_pct"]
+    # Chips carry distinct duty profiles (aggregation needs variance).
+    assert len({r["duty_cycle_pct"] for r in a.values()}) > 1
+
+
+def test_stub_ici_counters_advance():
+    p = StubTpuPlugin(make_topology(mesh_shape=(2, 1, 1)))
+    first = p.chip_metrics()
+    p._sim_last -= 1.0  # pretend a second elapsed
+    second = p.chip_metrics()
+    for cid in first:
+        assert second[cid]["ici_tx_bytes"] > first[cid]["ici_tx_bytes"]
+        assert second[cid]["ici_rx_bytes"] > first[cid]["ici_rx_bytes"]
+
+
+def test_stub_unhealthy_chip_reads_dead():
+    p = StubTpuPlugin(make_topology(mesh_shape=(2, 1, 1),
+                                    id_prefix="chip"))
+    p.set_chip_health("chip-0", "Unhealthy")
+    m = p.chip_metrics()
+    assert m["chip-0"]["duty_cycle_pct"] == 0.0
+    assert m["chip-0"]["ici_links"] == 0
+    assert m["chip-1"]["duty_cycle_pct"] > 0.0
+
+
+# -- tpu_* gauge export ----------------------------------------------------
+
+def _chip(cid, health="Healthy", assigned=None, duty=50.0):
+    return {"id": cid, "health": health, "coords": [0, 0, 0],
+            "assigned_to": assigned, "duty_cycle_pct": duty,
+            "hbm_used_bytes": 100, "hbm_total_bytes": 1000,
+            "ici_tx_bytes": 5, "ici_rx_bytes": 7, "ici_links": 6}
+
+
+def test_export_tpu_stats_sets_gauges():
+    telemetry.export_tpu_stats("n1", {"chips": [
+        _chip("c0", assigned={"namespace": "default", "pod": "p"}),
+        _chip("c1", health="Unhealthy", duty=0.0),
+    ]})
+    assert telemetry.TPU_DUTY_CYCLE.value(node="n1", chip="c0") == 50.0
+    assert telemetry.TPU_CHIP_HEALTHY.value(node="n1", chip="c1") == 0.0
+    assert telemetry.TPU_CHIP_ASSIGNED.value(node="n1", chip="c0") == 1.0
+    assert telemetry.TPU_CHIP_ASSIGNED.value(node="n1", chip="c1") == 0.0
+    assert telemetry.TPU_HBM_TOTAL.value(node="n1", chip="c0") == 1000.0
+    assert telemetry.TPU_ICI_RX.value(node="n1", chip="c0") == 7.0
+    assert telemetry.TPU_LIBTPU_HEALTH.value(node="n1") == 1.0
+
+
+def test_export_tpu_stats_removes_departed_chip_series():
+    telemetry.export_tpu_stats("n2", {"chips": [_chip("c0"), _chip("c1")]})
+    assert telemetry.TPU_DUTY_CYCLE.value(node="n2", chip="c1") == 50.0
+    telemetry.export_tpu_stats("n2", {"chips": [_chip("c0")]})
+    # Departed chip's series is REMOVED, not frozen.
+    assert ("n2", "c1") not in telemetry.TPU_DUTY_CYCLE._values
+    assert telemetry.TPU_DUTY_CYCLE.value(node="n2", chip="c0") == 50.0
+
+
+def test_export_tpu_stats_no_topology_marks_probe_down():
+    telemetry.export_tpu_stats("n3", {"chips": []})
+    assert telemetry.TPU_LIBTPU_HEALTH.value(node="n3") == 0.0
+
+
+# -- cluster monitor rollup ------------------------------------------------
+
+def _summary(chips, pods=()):
+    return {"node": {}, "pods": list(pods), "tpu": {"chips": chips}}
+
+
+def test_aggregate_node_and_cluster_rollup():
+    per_pod: dict = {}
+    s = _summary(
+        [_chip("c0", assigned={"namespace": "default", "pod": "p0"},
+               duty=80.0),
+         _chip("c1", duty=20.0),
+         _chip("c2", health="Unhealthy", duty=0.0)],
+        pods=[{"pod": {"namespace": "default", "name": "p0", "uid": "u0"},
+               "cpu_seconds": 1.5, "memory_rss_bytes": 2048,
+               "training": {"tokens_per_sec": 123.0, "mfu": 0.4}}])
+    a = agg.ClusterMonitor._aggregate_node("n1", s, per_pod)
+    assert a["chips"] == 3 and a["healthy"] == 2 and a["assigned"] == 1
+    assert a["duty_avg_pct"] == pytest.approx(100.0 / 3, abs=0.1)
+    assert a["tokens_per_sec"] == 123.0
+    rec = per_pod["default/p0"]
+    assert rec["chips"] == 1 and rec["node"] == "n1"
+    assert rec["duty_avg_pct"] == 80.0
+    assert rec["tokens_per_sec"] == 123.0
+
+    roll = agg.ClusterMonitor._cluster_rollup({"n1": a})
+    assert roll["chips_total"] == 3
+    assert roll["chips_unhealthy"] == 1
+    assert roll["chips_idle"] == 2
+    assert roll["tokens_per_sec"] == 123.0
+
+
+async def test_monitor_sweep_publishes_gauges(monkeypatch):
+    from kubernetes_tpu.api.meta import ObjectMeta
+    from kubernetes_tpu.api.types import Node
+
+    listed = [Node(metadata=ObjectMeta(name="n1")),
+              Node(metadata=ObjectMeta(name="n2"))]
+
+    class FakeClient:
+        async def list(self, plural, *a, **kw):
+            assert plural == "nodes"
+            return list(listed), 1
+
+    mon = agg.ClusterMonitor(FakeClient(), interval=999.0)
+
+    async def fake_scrape(node_name, session):
+        if node_name == "n2":
+            return None  # unreachable node: skipped, not fatal
+        return _summary([_chip("c0", duty=40.0), _chip("c1", duty=60.0)])
+
+    monkeypatch.setattr(mon, "_scrape", fake_scrape)
+    snap = await mon.sweep()
+    assert snap["nodes"]["n1"]["chips"] == 2
+    assert "n2" not in snap["nodes"]
+    assert agg.CLUSTER_CHIPS.value(state="total") == 2.0
+    assert agg.CLUSTER_DUTY.value() == pytest.approx(50.0)
+    assert agg.NODE_DUTY.value(node="n1") == pytest.approx(50.0)
+    assert mon.latest() is snap
+
+    # Listed-but-unscrapable (one missed scrape): the last-known
+    # aggregate carries forward marked stale — capacity must not flap
+    # out of the autoscaler seam on a transient blip — and the node's
+    # series survive.
+    async def none_scrape(node_name, session):
+        return None
+
+    monkeypatch.setattr(mon, "_scrape", none_scrape)
+    snap = await mon.sweep()
+    assert snap["nodes"]["n1"]["chips"] == 2
+    assert snap["nodes"]["n1"]["stale"] is True
+    assert ("n1",) in agg.NODE_DUTY._values
+
+    # Truly departed (gone from the node LIST): series pruned,
+    # snapshot entry dropped.
+    listed.clear()
+    snap = await mon.sweep()
+    assert snap["nodes"] == {}
+    assert ("n1",) not in agg.NODE_DUTY._values
+
+
+def test_cluster_duty_mean_is_chip_weighted():
+    """8 chips at 90% + 1 chip at 10% -> 81.1%, not (90+10)/2."""
+    per_pod: dict = {}
+    big = agg.ClusterMonitor._aggregate_node(
+        "big", _summary([_chip(f"b{i}", duty=90.0) for i in range(8)]),
+        per_pod)
+    small = agg.ClusterMonitor._aggregate_node(
+        "small", _summary([_chip("s0", duty=10.0)]), per_pod)
+    roll = agg.ClusterMonitor._cluster_rollup({"big": big, "small": small})
+    assert roll["duty_avg_pct"] == pytest.approx(81.11, abs=0.01)
+
+
+async def test_monitor_gate_off_no_loop(monkeypatch):
+    from kubernetes_tpu.util import features
+
+    mon = agg.ClusterMonitor(object(), interval=999.0)
+    monkeypatch.setattr(features.GATES, "_enabled",
+                        {**features.GATES._enabled,
+                         "ClusterMonitoring": False})
+    await mon.start()
+    assert mon._task is None
+    await mon.stop()

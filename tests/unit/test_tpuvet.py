@@ -207,6 +207,33 @@ D = Counter("client_retry_total", "re-registered: silently inert")
     assert len(got) == 1 and "already registered" in got[0].message
 
 
+def test_metric_name_trace_and_tpu_telemetry_families():
+    """The ktrace (trace_*), node TPU telemetry (tpu_*), and
+    scheduler loop-lag families are valid names, and a duplicate
+    registration within the family is still caught."""
+    good = """
+from kubernetes_tpu.metrics.registry import Counter, Gauge, Histogram
+A = Counter("trace_spans_total", "x", labels=("component",))
+B = Counter("trace_spans_dropped_total", "x")
+C = Gauge("trace_buffer_spans", "x")
+D = Gauge("tpu_duty_cycle_pct", "x", labels=("node", "chip"))
+E = Gauge("tpu_hbm_used_bytes", "x", labels=("node", "chip"))
+F = Gauge("tpu_ici_tx_bytes", "x", labels=("node", "chip"))
+G = Gauge("tpu_libtpu_probe_healthy", "x", labels=("node",))
+H = Gauge("tpu_cluster_chips", "x", labels=("state",))
+I = Gauge("tpu_node_duty_cycle_avg_pct", "x", labels=("node",))
+J = Counter("tpu_monitor_scrapes_total", "x", labels=("result",))
+K = Histogram("scheduler_loop_lag_ms", "x")
+L = Gauge("scheduler_loop_busy_fraction", "x")
+"""
+    assert run_source(good, checks=["metric-name"]) == []
+    bad = good + """
+M = Gauge("tpu_duty_cycle_pct", "re-registered: silently inert")
+"""
+    got = run_source(bad, checks=["metric-name"])
+    assert len(got) == 1 and "already registered" in got[0].message
+
+
 def test_metric_name_replication_and_redirect_family():
     """The control-plane replication metric family (replication_*) and
     the client leader-redirect counter are valid names, and a duplicate

@@ -1,0 +1,319 @@
+"""ktrace unit tier: context encode/decode, sampling, collector
+bounds, span nesting, the Trace fold, and timeline reconstruction."""
+import json
+import logging
+import time
+
+import pytest
+
+from kubernetes_tpu import tracing
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.api.types import Pod
+from kubernetes_tpu.tracing import timeline
+from kubernetes_tpu.tracing.collector import SpanCollector
+from kubernetes_tpu.util.trace import Trace
+
+
+@pytest.fixture
+def armed():
+    prev = tracing.set_sample_rate(1.0)
+    tracing.COLLECTOR.clear()
+    yield
+    tracing.set_sample_rate(prev)
+    tracing.COLLECTOR.clear()
+
+
+# -- context encode/decode -------------------------------------------------
+
+def test_traceparent_roundtrip():
+    ctx = tracing.TraceContext(tracing.context.new_trace_id()
+                               if hasattr(tracing, "context")
+                               else "a" * 32, "b" * 16, True)
+    ctx = tracing.TraceContext("a1" * 16, "b2" * 8, True)
+    enc = tracing.encode(ctx)
+    assert enc == f"00-{'a1' * 16}-{'b2' * 8}-01"
+    back = tracing.decode(enc)
+    assert back == ctx
+
+
+def test_decode_unsampled_flag():
+    back = tracing.decode(f"00-{'c' * 32}-{'d' * 16}-00")
+    assert back is not None and back.sampled is False
+
+
+@pytest.mark.parametrize("bad", [
+    None, "", "garbage", "00-short-e" * 3,
+    f"00-{'g' * 32}-{'d' * 16}-01",           # non-hex trace id
+    f"00-{'0' * 32}-{'d' * 16}-01",           # all-zero trace id
+    f"00-{'c' * 32}-{'0' * 16}-01",           # all-zero span id
+    f"00-{'c' * 31}-{'d' * 16}-01",           # wrong length
+    f"zz-{'c' * 32}-{'d' * 16}",              # missing field
+])
+def test_decode_malformed_is_none(bad):
+    assert tracing.decode(bad) is None
+
+
+def test_ids_are_well_formed():
+    from kubernetes_tpu.tracing.context import new_span_id, new_trace_id
+    tid, sid = new_trace_id(), new_span_id()
+    assert len(tid) == 32 and int(tid, 16) >= 0
+    assert len(sid) == 16 and int(sid, 16) >= 0
+
+
+# -- sampling --------------------------------------------------------------
+
+def test_sample_root_disarmed_is_none():
+    prev = tracing.set_sample_rate(0.0)
+    try:
+        assert not tracing.armed()
+        assert tracing.sample_root() is None
+        assert tracing.start_span("x", "t") is tracing.NOOP_SPAN
+        assert tracing.root_span("x", "t") is tracing.NOOP_SPAN
+    finally:
+        tracing.set_sample_rate(prev)
+
+
+def test_sample_rate_statistics(armed):
+    tracing.set_sample_rate(1.0)
+    assert all(tracing.sample_root() is not None for _ in range(20))
+    tracing.set_sample_rate(0.0)
+    assert all(tracing.sample_root() is None for _ in range(20))
+
+
+def test_malformed_ktpu_trace_disarms():
+    from kubernetes_tpu.tracing.context import _parse_rate
+    assert _parse_rate("0.5x") == 0.0   # typo must not arm at 1%
+    assert _parse_rate("nope") == 0.0
+    assert _parse_rate("1") == tracing.DEFAULT_SAMPLE_RATE
+    assert _parse_rate("0.5") == 0.5
+    assert _parse_rate("") == 0.0
+    assert _parse_rate("off") == 0.0
+
+
+def test_unsampled_parent_yields_noop(armed):
+    ctx = tracing.TraceContext("a" * 32, "b" * 16, sampled=False)
+    assert tracing.start_span("child", "t", parent=ctx) is tracing.NOOP_SPAN
+    with tracing.use(ctx):
+        assert tracing.start_span("child", "t") is tracing.NOOP_SPAN
+
+
+# -- contextvar plumbing ---------------------------------------------------
+
+def test_use_restores_previous_context(armed):
+    outer = tracing.TraceContext("1" * 32, "2" * 16, True)
+    inner = tracing.TraceContext("3" * 32, "4" * 16, True)
+    assert tracing.current() is None
+    with tracing.use(outer):
+        assert tracing.current() == outer
+        with tracing.use(inner):
+            assert tracing.current() == inner
+        assert tracing.current() == outer
+    assert tracing.current() is None
+
+
+def test_object_annotation_stamp_and_read(armed):
+    pod = Pod(metadata=ObjectMeta(name="p", namespace="default"))
+    assert tracing.context_of(pod) is None
+    ctx = tracing.sample_root()
+    tracing.stamp(pod, ctx)
+    back = tracing.context_of(pod)
+    assert back.trace_id == ctx.trace_id and back.sampled
+
+
+# -- spans -----------------------------------------------------------------
+
+def test_span_nesting_and_collection(armed):
+    root = tracing.root_span("create", component="apiserver",
+                             attrs={"pod": "default/p0"})
+    assert root.parent_id == ""
+    with tracing.use(root.context()):
+        child = tracing.start_span("queue", component="scheduler")
+        assert child.trace_id == root.trace_id
+        assert child.parent_id == root.span_id
+        child.event("staged")
+        child.end()
+    root.end(code=201)
+    spans = tracing.COLLECTOR.snapshot(trace_id=root.trace_id)
+    assert [s["name"] for s in spans] == ["queue", "create"]
+    q = spans[0]
+    assert q["events"] and q["events"][0][1] == "staged"
+    assert spans[1]["attrs"]["code"] == 201
+    assert timeline.check_nesting(spans) == []
+
+
+def test_span_end_idempotent(armed):
+    root = tracing.root_span("create", "t")
+    root.end()
+    root.end()
+    assert len(tracing.COLLECTOR.snapshot(trace_id=root.trace_id)) == 1
+
+
+def test_span_activate_detaches_on_end(armed):
+    root = tracing.root_span("serve", "apiserver").activate()
+    assert tracing.current().trace_id == root.trace_id
+    root.end()
+    assert tracing.current() is None
+
+
+# -- collector -------------------------------------------------------------
+
+def _span_dict(i: int) -> dict:
+    return {"trace_id": f"{i:032x}", "span_id": f"{i:016x}",
+            "name": "s", "component": "t", "start": float(i),
+            "end": float(i) + 1.0, "duration_ms": 1000.0, "attrs": {},
+            "events": []}
+
+
+def test_collector_bound_drops_oldest():
+    c = SpanCollector(max_spans=4)
+    for i in range(1, 7):
+        c.add(_span_dict(i))
+    assert len(c) == 4
+    assert c.dropped == 2
+    kept = {s["trace_id"] for s in c.snapshot()}
+    assert f"{1:032x}" not in kept and f"{6:032x}" in kept
+
+
+def test_collector_filters_and_limit():
+    c = SpanCollector(max_spans=100)
+    for i in range(1, 11):
+        d = _span_dict(i)
+        d["attrs"] = {"pod": f"default/p{i % 2}"}
+        c.add(d)
+    assert len(c.snapshot(pod="default/p1")) == 5
+    assert len(c.snapshot(limit=3)) == 3
+    assert c.snapshot(trace_id=f"{7:032x}")[0]["span_id"] == f"{7:016x}"
+
+
+def test_collector_ingest_skips_malformed():
+    c = SpanCollector(max_spans=10)
+    taken = c.ingest([_span_dict(1), {"no": "ids"}, "junk", _span_dict(2)])
+    assert taken == 2 and len(c) == 2
+
+
+def test_collector_jsonl_export(tmp_path):
+    c = SpanCollector(max_spans=10)
+    c.add(_span_dict(1))
+    c.add(_span_dict(2))
+    path = str(tmp_path / "spans.jsonl")
+    assert c.export_jsonl(path) == 2
+    lines = [json.loads(line) for line in open(path)]
+    assert len(lines) == 2 and lines[0]["trace_id"] == f"{1:032x}"
+
+
+# -- util.trace fold -------------------------------------------------------
+
+def test_trace_log_line_byte_identical_when_disarmed(caplog):
+    prev = tracing.set_sample_rate(0.0)
+    try:
+        with caplog.at_level(logging.INFO, logger="trace"):
+            tr = Trace("op", pod="default/x")
+            tr.step("phase-a")
+            time.sleep(0.011)
+            assert tr.log_if_long(0.01) is True
+        assert len(caplog.records) == 1
+        msg = caplog.records[0].getMessage()
+        assert msg.startswith("Trace 'op' [pod=default/x] (")
+        assert "phase-a" in msg
+    finally:
+        tracing.set_sample_rate(prev)
+
+
+def test_trace_threshold_parameter(caplog):
+    with caplog.at_level(logging.INFO, logger="trace"):
+        with Trace("fast-op", threshold=30.0):
+            pass  # far below threshold: no line
+        assert not caplog.records
+        with Trace("slow-op", threshold=0.0):
+            time.sleep(0.002)
+        assert len(caplog.records) == 1
+
+
+def test_trace_steps_become_span_events(armed):
+    root = tracing.root_span("create", "t")
+    with tracing.use(root.context()):
+        tr = Trace("schedule-one", pod="default/p")
+        tr.step("placement computed")
+        tr.step("assumed in cache")
+        tr.log_if_long(999.0)  # under threshold: no log, span still ends
+    root.end()
+    spans = tracing.COLLECTOR.snapshot(trace_id=root.trace_id)
+    op = next(s for s in spans if s["name"] == "schedule-one")
+    assert op["component"] == "optrace"
+    assert [e[1] for e in op["events"]] == ["placement computed",
+                                            "assumed in cache"]
+    assert op["attrs"]["pod"] == "default/p"
+
+
+# -- timeline --------------------------------------------------------------
+
+def _mk_span(name, start, end, trace="f" * 32, parent="", **attrs):
+    return {"trace_id": trace, "span_id": f"{hash(name) & (2**64 - 1):016x}",
+            "parent_id": parent, "name": name, "component": "t",
+            "start": start, "end": end,
+            "duration_ms": (end - start) * 1e3, "attrs": attrs,
+            "events": []}
+
+
+def test_timeline_stages_sum_to_e2e():
+    spans = [
+        _mk_span("create", 100.0, 100.001),
+        _mk_span("queue", 100.002, 100.010),
+        _mk_span("schedule", 100.011, 100.015),
+        _mk_span("bind", 100.016, 100.020),
+        _mk_span("startup", 100.022, 100.050),
+    ]
+    tl = timeline.pod_timeline(spans)
+    assert tl["complete"] is True
+    assert abs(sum(s["duration_ms"] for s in tl["stages"])
+               - tl["e2e_ms"]) < 1e-6
+    assert [s["stage"] for s in tl["stages"]] == [
+        "create", "queue", "schedule", "bind", "start"]
+    assert abs(tl["e2e_ms"] - 50.0) < 1e-6
+
+
+def test_timeline_incomplete_without_startup():
+    spans = [
+        _mk_span("create", 100.0, 100.001),
+        _mk_span("queue", 100.002, 100.010),
+        _mk_span("schedule", 100.011, 100.015),
+        _mk_span("bind", 100.016, 100.020),
+    ]
+    tl = timeline.pod_timeline(spans)
+    assert tl["complete"] is False
+    # No phantom "start" stage from residual tail.
+    assert [s["stage"] for s in tl["stages"]] == [
+        "create", "queue", "schedule", "bind"]
+
+
+def test_timeline_none_without_anchors():
+    assert timeline.pod_timeline([]) is None
+    assert timeline.pod_timeline([_mk_span("other", 1.0, 2.0)]) is None
+
+
+def test_check_nesting_flags_violations():
+    parent = _mk_span("create", 100.0, 100.5)
+    child = _mk_span("queue", 99.0, 100.2, parent=parent["span_id"])
+    problems = timeline.check_nesting([parent, child])
+    assert any("starts before its parent" in p for p in problems)
+    assert timeline.check_nesting([parent]) == []
+
+
+def test_stage_breakdown_shares():
+    spans = []
+    for i in range(4):
+        t0 = 100.0 + i
+        trace = f"{i:032x}"
+        spans += [
+            _mk_span("create", t0, t0 + 0.001, trace=trace),
+            _mk_span("queue", t0 + 0.002, t0 + 0.010, trace=trace),
+            _mk_span("schedule", t0 + 0.010, t0 + 0.014, trace=trace),
+            _mk_span("bind", t0 + 0.014, t0 + 0.020, trace=trace),
+            _mk_span("startup", t0 + 0.021, t0 + 0.040, trace=trace),
+        ]
+    out = timeline.stage_breakdown(spans)
+    assert out["traces"] == 4
+    shares = sum(out[s]["share"] for s in ("create", "queue", "schedule",
+                                           "bind", "start"))
+    assert abs(shares - 1.0) < 0.01
+    assert out["queue"]["p50_ms"] == pytest.approx(8.0, abs=0.5)

@@ -1,0 +1,132 @@
+"""tpusan property: span ids never cross-contaminate between
+CONCURRENTLY scheduled gangs. N gangs pour in together under explored
+task interleavings; every collected span must carry exactly the trace
+id its pod's durable annotation names, and span ids must be unique —
+a contextvar leak across awaits (the failure mode the re-attach
+machinery must not have) would show up as a span filed under another
+gang's trace."""
+import asyncio
+
+from kubernetes_tpu import tracing
+from kubernetes_tpu.analysis import interleave
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.meta import ObjectMeta
+
+SCHEDULES = 6
+GANGS = 3
+MEMBERS = 4
+
+
+def _node(name: str, chips: int = 16) -> t.Node:
+    node = t.Node(metadata=ObjectMeta(name=name))
+    node.status.capacity = {"cpu": 64.0, "memory": 256 * 2**30,
+                            "pods": 110.0, t.RESOURCE_TPU: float(chips)}
+    node.status.allocatable = dict(node.status.capacity)
+    node.status.conditions = [
+        t.NodeCondition(type=t.NODE_READY, status="True")]
+    node.status.tpu = t.TpuTopology(
+        chip_type="v5p", slice_id=f"slice-{name}",
+        mesh_shape=[4, 2, 2],
+        chips=[t.TpuChip(id=f"{name}-c{i}", coords=[i % 4, (i // 4) % 2,
+                                                    i // 8],
+                         attributes={"chip_type": "v5p"})
+               for i in range(chips)])
+    return node
+
+
+def _gang(idx: int):
+    gname = f"g{idx}"
+    group = t.PodGroup(
+        metadata=ObjectMeta(name=gname, namespace="default"),
+        spec=t.PodGroupSpec(min_member=MEMBERS, slice_shape=[2, 2, 1]))
+    pods = []
+    for m in range(MEMBERS):
+        pod = t.Pod(
+            metadata=ObjectMeta(name=f"{gname}-{m}", namespace="default"),
+            spec=t.PodSpec(containers=[t.Container(
+                name="c", image="i",
+                resources=t.ResourceRequirements(
+                    requests={"cpu": 0.1}))]))
+        pod.spec.gang = gname
+        pod.spec.containers[0].tpu_requests = ["tpu"]
+        pod.spec.tpu_resources = [t.PodTpuRequest(name="tpu", chips=1)]
+        pods.append(pod)
+    return group, pods
+
+
+async def _scenario(schedule: int) -> dict:
+    from kubernetes_tpu.apiserver.admission import default_chain
+    from kubernetes_tpu.apiserver.registry import Registry
+    from kubernetes_tpu.client.local import LocalClient
+    from kubernetes_tpu.scheduler.scheduler import Scheduler
+
+    reg = Registry()
+    reg.admission = default_chain(reg)
+    reg.create(t.Namespace(metadata=ObjectMeta(name="default")))
+    for n in range(GANGS):
+        reg.create(_node(f"n{n}"))
+    client = LocalClient(reg)
+    sched = Scheduler(client, backoff_seconds=0.2)
+    await sched.start()
+    try:
+        async def pour(idx: int) -> None:
+            group, pods = _gang(idx)
+            await client.create(group)
+            for pod in pods:
+                await client.create(pod)
+                await asyncio.sleep(0)  # interleaving point
+
+        await asyncio.gather(*(pour(i) for i in range(GANGS)))
+
+        async def all_bound() -> bool:
+            pods, _rev = await client.list("pods", "default")
+            return sum(1 for p in pods if p.spec.node_name) \
+                == GANGS * MEMBERS
+
+        for _ in range(400):
+            if await all_bound():
+                break
+            await asyncio.sleep(0.05)
+        assert await all_bound(), "gangs never fully bound"
+        pods, _rev = await client.list("pods", "default")
+        return {p.key(): tracing.context_of(p).trace_id for p in pods}
+    finally:
+        await sched.stop()
+
+
+def test_gang_spans_never_cross_contaminate():
+    prev = tracing.set_sample_rate(1.0)
+    try:
+        for i in range(SCHEDULES):
+            # One schedule at a time: pod NAMES repeat across
+            # schedules, so the collector must be scoped per run or
+            # schedule N's spans would be judged against schedule
+            # N+1's trace ids.
+            tracing.COLLECTOR.clear()
+            [result] = interleave.explore(
+                lambda _i: _scenario(i), f"tracing-gangs:{i}", 1)
+            trace_of_pod = result.value
+            # Distinct gangs (pods) got distinct traces.
+            assert len(set(trace_of_pod.values())) == GANGS * MEMBERS
+            by_pod_spans = {}
+            seen_span_ids = set()
+            for span in tracing.COLLECTOR.snapshot():
+                pod = (span.get("attrs") or {}).get("pod")
+                if pod is None or pod not in trace_of_pod:
+                    continue
+                # THE property: a span attributed to pod P carries
+                # exactly P's trace id — never a sibling gang's.
+                assert span["trace_id"] == trace_of_pod[pod], (
+                    f"schedule {result.schedule} (seed {result.seed}): "
+                    f"span {span['name']} for {pod} filed under "
+                    f"{span['trace_id']}")
+                assert span["span_id"] not in seen_span_ids, (
+                    f"duplicate span id {span['span_id']}")
+                seen_span_ids.add(span["span_id"])
+                by_pod_spans.setdefault(pod, set()).add(span["name"])
+            # Every pod's trace saw the scheduler stages.
+            for pod in trace_of_pod:
+                assert {"create", "queue"} <= by_pod_spans.get(pod, set())
+    finally:
+        tracing.set_sample_rate(prev)
+        tracing.COLLECTOR.clear()
