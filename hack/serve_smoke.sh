@@ -1,0 +1,65 @@
+#!/usr/bin/env bash
+# Inference-serving smoke (ISSUE 11): <60s acceptance of the serving
+# stack end to end on a real LocalCluster (ProcessRuntime model-server
+# pods):
+#
+#   create InferenceService -> warm-pool replicas ready -> open-loop
+#   burst -> autoscaler scales up (replica count + per-replica
+#   time-to-first-ready measured) -> drain scales back down -> SLO
+#   report (raw-sample p50/p99 + attainment %) printed.
+#
+# Tracing is armed (KTPU_TRACE=1.0) so the burst's scale-up pods also
+# reconstruct the span-derived queue/schedule/bind/start startup
+# breakdown — the per-scale-up ktrace view the serving bench reports.
+#
+# Siblings: hack/bench_smoke.sh, hack/queue_smoke.sh,
+# hack/preempt_smoke.sh, hack/trace_smoke.sh; hack/test.sh runs them
+# all on full-suite invocations.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+timeout -k 10 120 env JAX_PLATFORMS=cpu KTPU_TRACE=1.0 python - <<'EOF'
+import asyncio, contextlib, io, json, sys
+
+from kubernetes_tpu.perf.serving_bench import run_serving_bench
+
+report = asyncio.run(run_serving_bench(
+    n_nodes=2, chips_per_node=4, chips_per_replica=1,
+    min_replicas=1, max_replicas=6,
+    rates=(4.0,), burst_rate=20.0,
+    stage_seconds=3.0, burst_seconds=7.0, drain_seconds=5.0,
+    scale_down_stabilization_seconds=2.0, seed=11))
+
+print("serve_smoke: SLO report", flush=True)
+print(json.dumps(report["stages"], indent=2), flush=True)
+print(json.dumps({k: report[k] for k in
+                  ("scale_up", "scale_down", "startup_breakdown")},
+                 indent=2), flush=True)
+
+up = report["scale_up"]
+assert up["replicas_peak"] > up["replicas_before_burst"], \
+    f"autoscaler never scaled up during the burst: {up}"
+assert up["new_replicas"] >= 1 and up["ttfr_s"], \
+    f"no time-to-first-ready samples for scale-up replicas: {up}"
+assert up["ttfr_p99_s"] < 30.0, f"scale-up TTFR pathological: {up}"
+down = report["scale_down"]
+assert down["final_target"] < up["replicas_peak"], \
+    f"drain never scaled down: {down} vs peak {up['replicas_peak']}"
+for st in report["stages"]:
+    assert st["completed"] > 0 and st["errors"] == 0, f"stage failed: {st}"
+    assert st["p99_ms"] >= st["p50_ms"] > 0.0, f"bad percentiles: {st}"
+    assert 0.0 <= st["slo_attainment_pct"] <= 100.0
+# The burst must be VISIBLE in the replica timeline, and its scale-up
+# pods must reconstruct a span-derived startup breakdown (tracing is
+# fully on for this smoke).
+counts = [n for _t, n in report["replica_timeline"]]
+assert max(counts) >= up["replicas_peak"] > min(counts)
+bd = report["startup_breakdown"]
+assert bd.get("traces", 0) >= 1, f"no scale-up startup traces: {bd}"
+print(f"serve_smoke: scaled {up['replicas_before_burst']} -> "
+      f"{up['replicas_peak']} (ttfr p50 {up['ttfr_p50_s']}s), drained "
+      f"to {down['final_target']}; startup breakdown over "
+      f"{bd['traces']} traces", flush=True)
+EOF
+
+echo "serve_smoke: OK"

@@ -13,7 +13,10 @@
 # convergence), hack/trace_smoke.sh (ktrace gate: a LocalCluster gang
 # reconstructs a complete create->ready trace through ktl, and the
 # gated 200n/2k arm holds its floor with default sampling within 3%
-# of tracing-off), hack/race.sh (<150s tpusan gate: chaos + queue +
+# of tracing-off), hack/serve_smoke.sh (<60s inference-serving smoke:
+# InferenceService -> replicas ready -> open-loop burst -> autoscaler
+# scales up -> drain scales down -> SLO report printed),
+# hack/race.sh (<150s tpusan gate: chaos + queue +
 # preempt + HA smokes under explored task-interleaving schedules with
 # the cluster invariants armed) — all run on full-suite invocations;
 # filtered runs skip them, KTPU_SMOKE=1 forces them.
@@ -27,6 +30,7 @@ if [ "$#" -eq 0 ] || [ "${KTPU_SMOKE:-}" = "1" ]; then
   ./hack/preempt_smoke.sh
   ./hack/ha_smoke.sh
   ./hack/trace_smoke.sh
+  ./hack/serve_smoke.sh
   ./hack/race.sh
 fi
 exec python -m pytest tests/ -q "$@"

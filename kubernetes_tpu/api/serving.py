@@ -1,0 +1,223 @@
+"""Inference serving API — autoscaled model serving for user traffic.
+
+KServe/InferenceService-analog kind (reference: serving.kserve.io
+InferenceService fused with autoscaling/v1's min/max-replica contract;
+PAPERS.md "Evaluating Kubernetes Performance for GenAI Inference" is
+the evaluation template this subsystem is measured by):
+
+- :class:`InferenceService` (namespaced): one served model — the model
+  ref, the per-replica chip/slice demand, the replica window the
+  autoscaler moves inside, and the latency SLO the loadgen grades
+  against. The inference controller (``controllers/inference.py``)
+  reconciles it into a headless Service (per-replica DNS + Endpoints
+  discovery, ``net/dns.py``) plus a Deployment of model-server pods
+  (``workloads/model_server.py``), and an HPA-analog loop scales the
+  Deployment on ``ClusterMonitor.latest()`` rollups.
+
+Everything is gated behind ``InferenceAutoscaling`` (alpha, default
+off): with the gate off the controller and the admission defaulter are
+inert and the tree's behavior is byte-identical.
+"""
+from __future__ import annotations
+
+import datetime
+import math
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .meta import TypedObject
+from .scheme import DEFAULT_SCHEME
+from .validation import ErrorList, validate_object_meta
+
+SERVING_V1 = "serving/v1"
+
+#: Pod label joining an InferenceService to its replicas (the selector
+#: the Deployment/Service/endpoint router all key on). Also the marker
+#: the scheduler's gated topology-aware scoring looks for.
+SERVICE_LABEL = "serving.tpu/service"
+
+#: Label on warm-pool image-prepull pods (controller-owned, short-lived;
+#: they pull the model image on candidate nodes ahead of the first
+#: scale-up so time-to-first-ready excludes the cold pull).
+PREPULL_LABEL = "serving.tpu/prepull"
+
+#: Annotation on the Deployment the controller manages, recording the
+#: owning InferenceService (belt + suspenders beside the owner ref).
+MANAGED_ANNOTATION = "serving.tpu/managed-by"
+
+
+@dataclass
+class InferenceServiceSpec:
+    #: Model reference the server loads — a name for the stub server,
+    #: an artifact path (``file://...``) in real deployments.
+    model: str = ""
+    #: Container image for the model-server pods ("" = the built-in
+    #: host environment, the process runtime's default). An artifact
+    #: ref here is what the warm pool pre-pulls.
+    image: str = ""
+    #: Replica window the autoscaler moves within.
+    min_replicas: int = 0      # defaulted to 1 by admission (gated)
+    max_replicas: int = 0      # defaulted to max(min, 1)
+    #: Per-replica TPU demand: chip count, or a contiguous slice shape
+    #: (shape wins when both are set; chips then defaults to its
+    #: volume). 0/empty = a CPU-only server.
+    chips_per_replica: int = 0
+    slice_shape: list[int] = field(default_factory=list)
+    #: Per-replica CPU request (scheduling weight for the server pod).
+    cpu_per_replica: float = 0.5
+    #: Serving port (defaulted to 8100 by admission).
+    port: int = 0
+    #: Request-latency SLO the loadgen grades attainment against (ms).
+    slo_target_ms: float = 0.0  # defaulted to 2000
+    #: Rated per-replica decode throughput (tokens/s). The stub model
+    #: server simulates exactly this speed; the autoscaler uses it to
+    #: turn observed tokens/s into a utilization signal.
+    rated_tokens_per_sec: float = 0.0  # defaulted to 256
+    #: Busy-fraction target the autoscaler holds replicas at (0..1,
+    #: defaulted to 0.65): scale up above it, down below it.
+    target_utilization: float = 0.0
+    #: Scale-down stabilization window (seconds): replicas only shrink
+    #: to the HIGHEST recommendation seen inside the window (reference:
+    #: --horizontal-pod-autoscaler-downscale-stabilization).
+    scale_down_stabilization_seconds: float = 30.0
+    #: Per-decision replica-step rate limits (0 = defaulted: up 4/down 1).
+    scale_up_max_step: int = 0
+    scale_down_max_step: int = 0
+    #: Warm pool: pre-pull the model image on up to this many candidate
+    #: nodes beyond those already serving (0 = min(max-min, 2)).
+    warm_pool_nodes: int = 0
+
+
+@dataclass
+class InferenceServiceStatus:
+    #: Deployment-side counts mirrored for ``ktl get inferenceservices``.
+    replicas: int = 0
+    ready_replicas: int = 0
+    #: The autoscaler's current target.
+    desired_replicas: int = 0
+    last_scale_time: Optional[datetime.datetime] = None
+    last_scale_reason: str = ""
+    #: Observed aggregate decode throughput and mean busy fraction over
+    #: the service's replicas, from the last autoscaler pass.
+    tokens_per_sec: float = 0.0
+    utilization: float = 0.0
+    #: Age of the ClusterMonitor snapshot the last decision used
+    #: (-1 = no decision yet). A stale feed REFUSES to act — this field
+    #: is how operators see that happening.
+    snapshot_age_seconds: float = -1.0
+    #: Nodes whose image store holds this service's artifact image
+    #: (warm pool): recorded when a prepull pod succeeds, BEFORE the
+    #: pod is reaped — the durable record rides the WAL, so a reaped
+    #: prepull cannot be re-created on an already-warm node after a
+    #: controller restart (API-object-as-checkpoint, as ever).
+    warm_nodes: list[str] = field(default_factory=list)
+
+
+@dataclass
+class InferenceService(TypedObject):
+    spec: InferenceServiceSpec = field(default_factory=InferenceServiceSpec)
+    status: InferenceServiceStatus = field(
+        default_factory=InferenceServiceStatus)
+
+
+def replica_chips(spec: InferenceServiceSpec) -> int:
+    """Chips one replica claims: the slice shape's volume when shaped,
+    else the flat count."""
+    if spec.slice_shape:
+        return math.prod(int(d) for d in spec.slice_shape)
+    return spec.chips_per_replica
+
+
+#: The documented defaults for spec fields left 0 — ONE definition
+#: shared by the admission defaulter (stamps them on gated creates)
+#: and :func:`effective_spec` (resolves them at READ time), so an
+#: object created while the gate was off — or updated to zero a field
+#: — can never drive the controller with a port-0 probe or a zero
+#: utilization target.
+DEFAULT_PORT = 8100
+DEFAULT_SLO_MS = 2000.0
+DEFAULT_RATED_TPS = 256.0
+DEFAULT_TARGET_UTILIZATION = 0.65
+
+
+def effective_spec(spec: InferenceServiceSpec) -> InferenceServiceSpec:
+    """A copy with the serving defaults applied to unset (0) fields —
+    what the controller/autoscaler actually operate on."""
+    from dataclasses import replace
+    return replace(
+        spec,
+        min_replicas=spec.min_replicas if spec.min_replicas > 0 else 1,
+        max_replicas=(spec.max_replicas if spec.max_replicas > 0
+                      else max(spec.min_replicas, 1)),
+        chips_per_replica=(replica_chips(spec) if spec.slice_shape
+                           else spec.chips_per_replica),
+        port=spec.port or DEFAULT_PORT,
+        slo_target_ms=spec.slo_target_ms or DEFAULT_SLO_MS,
+        rated_tokens_per_sec=(spec.rated_tokens_per_sec
+                              or DEFAULT_RATED_TPS),
+        target_utilization=(spec.target_utilization
+                            or DEFAULT_TARGET_UTILIZATION))
+
+
+def validate_inferenceservice(svc: InferenceService,
+                              is_create: bool = True) -> None:
+    errs = ErrorList()
+    validate_object_meta(svc.metadata, errs)
+    s = svc.spec
+    if not s.model:
+        errs.add("spec.model", "required (the model the server loads)")
+    if s.min_replicas < 0:
+        errs.add("spec.min_replicas", "must be >= 0")
+    if s.max_replicas and s.max_replicas < max(s.min_replicas, 1):
+        errs.add("spec.max_replicas",
+                 f"must be >= max(min_replicas, 1) (= "
+                 f"{max(s.min_replicas, 1)})")
+    if s.chips_per_replica < 0:
+        errs.add("spec.chips_per_replica", "must be >= 0")
+    for d in s.slice_shape:
+        if int(d) <= 0:
+            errs.add("spec.slice_shape", f"dimension {d!r} must be > 0")
+    if s.slice_shape and s.chips_per_replica and \
+            replica_chips(s) != s.chips_per_replica:
+        errs.add("spec.chips_per_replica",
+                 f"contradicts slice_shape volume {replica_chips(s)} "
+                 f"(set one; the shape wins when both are given)")
+    if s.cpu_per_replica < 0:
+        errs.add("spec.cpu_per_replica", "must be >= 0")
+    if s.port < 0 or s.port > 65535:
+        errs.add("spec.port", "must be a port number")
+    for fname, v in (("slo_target_ms", s.slo_target_ms),
+                     ("rated_tokens_per_sec", s.rated_tokens_per_sec)):
+        if not math.isfinite(v) or v < 0:
+            errs.add(f"spec.{fname}", "must be finite and >= 0")
+    if not 0.0 <= s.target_utilization <= 1.0 \
+            or not math.isfinite(s.target_utilization):
+        errs.add("spec.target_utilization", "must be in [0, 1]")
+    if not math.isfinite(s.scale_down_stabilization_seconds) \
+            or s.scale_down_stabilization_seconds < 0:
+        errs.add("spec.scale_down_stabilization_seconds",
+                 "must be finite and >= 0")
+    if s.scale_up_max_step < 0 or s.scale_down_max_step < 0:
+        errs.add("spec.scale_up_max_step", "steps must be >= 0")
+    if s.warm_pool_nodes < 0:
+        errs.add("spec.warm_pool_nodes", "must be >= 0")
+    errs.raise_if_any("InferenceService", svc.metadata.name)
+
+
+def validate_inferenceservice_update(new: InferenceService,
+                                     old: InferenceService) -> None:
+    validate_inferenceservice(new, is_create=False)
+    if (new.spec.chips_per_replica != old.spec.chips_per_replica
+            or new.spec.slice_shape != old.spec.slice_shape):
+        # Changing per-replica chip geometry under live replicas would
+        # mix incompatible server shapes behind one Service; require a
+        # delete/recreate (KServe treats the predictor shape the same
+        # way — a new revision, not an in-place mutation).
+        from .errors import InvalidError
+        raise InvalidError(
+            f"InferenceService {new.metadata.name!r}: per-replica chip "
+            f"demand (spec.chips_per_replica / spec.slice_shape) is "
+            f"immutable (delete and recreate to reshape)")
+
+
+DEFAULT_SCHEME.register(SERVING_V1, "InferenceService", InferenceService)

@@ -666,12 +666,40 @@ class LocalQueueAdmission(AdmissionPlugin):
                 f"ClusterQueue {lq.spec.cluster_queue!r}") from None
 
 
+class InferenceServiceDefaulter(AdmissionPlugin):
+    """Serving defaults (InferenceAutoscaling gate, in the
+    LocalQueueAdmission style: skipped entirely while the gate is off
+    so created objects stay byte-identical to the ungated build).
+
+    Defaults: replica window [1, max(min, 1)], port 8100, a 2000ms SLO,
+    a 256 tokens/s per-replica rating, and a 0.65 busy-fraction target
+    — the numbers ``hack/serve_smoke.sh`` and the serving bench grade
+    against unless the operator says otherwise.
+    """
+
+    name = "InferenceServiceDefaulter"
+
+    @staticmethod
+    def _gated() -> bool:
+        from ..util.features import GATES
+        return GATES.enabled("InferenceAutoscaling")
+
+    def admit(self, op, spec, obj, old):
+        if spec.kind != "InferenceService" or op != "CREATE" \
+                or not self._gated():
+            return obj
+        from ..api.serving import effective_spec
+        obj.spec = effective_spec(obj.spec)
+        return obj
+
+
 def default_chain(registry: "Registry") -> AdmissionChain:
     return AdmissionChain([
         NamespaceLifecycle(registry),
         TpuResourceDefaulter(),
         PriorityResolver(registry),
         LocalQueueAdmission(registry),
+        InferenceServiceDefaulter(),
         ServiceAccountPlugin(registry),
         DefaultTolerationSeconds(),
         ExtendedResourceToleration(),

@@ -55,6 +55,7 @@ ALIASES = {
     "podgroup": "podgroups", "pg": "podgroups",
     "clusterqueue": "clusterqueues", "cq": "clusterqueues",
     "localqueue": "localqueues", "lq": "localqueues",
+    "inferenceservice": "inferenceservices", "isvc": "inferenceservices",
     "event": "events", "ev": "events",
     "quota": "resourcequotas", "resourcequota": "resourcequotas",
     "hpa": "horizontalpodautoscalers",

@@ -216,6 +216,54 @@ def describe_clusterqueue(cq) -> str:
     return "\n".join(lines) + _describe_fields(cq)
 
 
+def _inferenceservices_table(objs: list, wide: bool) -> str:
+    headers = ["NAME", "MODEL", "READY", "DESIRED", "WINDOW", "TOK/S",
+               "UTIL", "AGE"]
+    if wide:
+        headers += ["CHIPS/REPLICA", "SLO-MS", "LAST-SCALE"]
+    rows = []
+    for o in objs:
+        st, sp = o.status, o.spec
+        row = [o.metadata.name, sp.model or "<none>",
+               f"{st.ready_replicas}/{st.replicas}",
+               st.desired_replicas,
+               f"{sp.min_replicas}..{sp.max_replicas}",
+               f"{st.tokens_per_sec:g}",
+               f"{st.utilization:.2f}",
+               age(o.metadata)]
+        if wide:
+            from ..api.serving import replica_chips
+            row += [replica_chips(sp) or "<none>",
+                    f"{sp.slo_target_ms:g}",
+                    (st.last_scale_reason or "<none>")[:40]]
+        rows.append(row)
+    return render_table(headers, rows)
+
+
+def describe_inferenceservice(isvc) -> str:
+    """Serving summary: replica window + autoscaler state, then the
+    generic field dump."""
+    sp, st = isvc.spec, isvc.status
+    lines = [f"Name: {isvc.metadata.name}",
+             f"Model: {sp.model or '<none>'}",
+             f"Replicas: {st.ready_replicas}/{st.replicas} ready "
+             f"(desired {st.desired_replicas}, window "
+             f"{sp.min_replicas}..{sp.max_replicas})",
+             f"Per replica: {sp.chips_per_replica} chips"
+             + (f" (shape {'x'.join(map(str, sp.slice_shape))})"
+                if sp.slice_shape else ""),
+             f"SLO: {sp.slo_target_ms:g}ms; rated "
+             f"{sp.rated_tokens_per_sec:g} tok/s/replica; target "
+             f"utilization {sp.target_utilization:g}",
+             f"Observed: {st.tokens_per_sec:g} tok/s, utilization "
+             f"{st.utilization:.2f}, snapshot age "
+             f"{st.snapshot_age_seconds:g}s"]
+    if st.last_scale_reason:
+        lines.append(f"Last scale: {st.last_scale_reason}")
+    lines.append("")
+    return "\n".join(lines) + _describe_fields(isvc)
+
+
 def _services_table(objs: list, wide: bool) -> str:
     rows = [[o.metadata.name, o.spec.cluster_ip or "<none>",
              ",".join(f"{p.port}/{p.protocol or 'TCP'}"
@@ -246,6 +294,7 @@ PRINTERS: dict[str, Callable[[list, bool], str]] = {
     "podgroups": _podgroups_table,
     "clusterqueues": _clusterqueues_table,
     "localqueues": _localqueues_table,
+    "inferenceservices": _inferenceservices_table,
     "services": _services_table,
     "events": _events_table,
 }
@@ -265,6 +314,8 @@ def describe(obj: Any) -> str:
         return describe_clusterqueue(obj)
     if type(obj).__name__ == "PodGroup":
         return describe_podgroup(obj)
+    if type(obj).__name__ == "InferenceService":
+        return describe_inferenceservice(obj)
     return _describe_fields(obj)
 
 

@@ -106,6 +106,8 @@ class LocalCluster:
                  durable: bool = False,
                  status_interval: float = 10.0,
                  heartbeat_interval: float = 5.0,
+                 monitor_interval: float = 10.0,
+                 autoscale_interval: float = 2.0,
                  authorization_mode: str = "AlwaysAllow",
                  user_groups: Optional[dict] = None,
                  audit_log: str = "",
@@ -126,6 +128,10 @@ class LocalCluster:
         self.durable = durable
         self.status_interval = status_interval
         self.heartbeat_interval = heartbeat_interval
+        #: Cluster-monitor sweep + inference-autoscaler cadence
+        #: (serving smokes shorten these to act inside their budget).
+        self.monitor_interval = monitor_interval
+        self.autoscale_interval = autoscale_interval
         self.authorization_mode = authorization_mode
         self.user_groups = user_groups
         self.audit_log = audit_log
@@ -256,7 +262,9 @@ class LocalCluster:
                 self.admin_cert.key_path, check_hostname=False)
         self.controller_manager = ControllerManager(
             local, node_scrape_ssl=scrape_ssl,
-            queueing_fits_probe=self._queueing_fits_probe)
+            queueing_fits_probe=self._queueing_fits_probe,
+            monitor_interval=self.monitor_interval,
+            autoscale_interval=self.autoscale_interval)
         await self.controller_manager.start()
 
         # Cluster DNS (kube-dns addon analog): A records for services +

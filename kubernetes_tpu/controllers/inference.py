@@ -1,0 +1,475 @@
+"""InferenceService controller — reconcile + autoscale model serving.
+
+Reference shape: a KServe-style reconciler fused with
+``pkg/controller/podautoscaler``. One controller does both halves:
+
+- **Reconcile** (``sync``): an InferenceService becomes a *headless*
+  Service (per-replica DNS + Endpoints — the discovery substrate
+  ``net/dns.py`` and the endpoint router read) plus a Deployment of
+  model-server pods (``workloads/model_server.py``), both
+  owner-referenced so deletion cascades through the garbage collector.
+  The Deployment is created at ``min_replicas`` immediately — the warm
+  pool's first half: capacity exists before the first request. The
+  second half pre-pulls the model image on candidate nodes via
+  short-lived prepull pods, so scale-up replicas skip the cold pull
+  (the pull/start split stays visible in the ktrace startup breakdown).
+
+- **Autoscale** (``on_start`` ticker): an HPA-analog loop reading the
+  cluster monitor's ``latest()`` rollup (the custom-metrics seam from
+  the telemetry PR) — per-pod tokens/s + busy fraction — and moving
+  ``Deployment.spec.replicas`` inside ``[min, max]`` through the pure
+  decision engine in :mod:`kubernetes_tpu.serving.autoscaler`
+  (stabilization window, rate limits, stale-snapshot refusal).
+
+Everything is inert while the ``InferenceAutoscaling`` gate is off:
+no API traffic, no annotations — byte-identical to the ungated build.
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+import math
+import os
+import sys
+import time
+from typing import Callable, Optional
+
+from ..api import errors, serving as s
+from ..api import types as t
+from ..api import workloads as w
+from ..api.meta import controller_ref, is_controlled_by, now
+from ..api.scheme import deepcopy
+from ..client.informer import InformerFactory
+from ..client.interface import Client
+from ..serving import autoscaler as engine
+from ..util.tasks import spawn
+from .base import Controller, is_pod_active, is_pod_ready
+
+log = logging.getLogger("inference")
+
+
+def _gated() -> bool:
+    from ..util.features import GATES
+    return GATES.enabled("InferenceAutoscaling")
+
+
+class InferenceServiceController(Controller):
+    name = "inference-controller"
+
+    def __init__(self, client: Client, factory: InformerFactory,
+                 metrics_feed: Optional[Callable[[], dict]] = None,
+                 autoscale_interval: float = 2.0,
+                 max_snapshot_age: float = 30.0):
+        super().__init__(client, factory, workers=1)
+        #: ClusterMonitor.latest seam ({} = no monitor wired; the
+        #: autoscaler then refuses every tick, visibly, instead of
+        #: scaling blind). The controller-manager wires the co-located
+        #: monitor's latest() after construction.
+        self.metrics_feed = metrics_feed
+        self.autoscale_interval = autoscale_interval
+        self.max_snapshot_age = max_snapshot_age
+        self._states: dict[str, engine.ServiceState] = {}
+        self._ticker: Optional[asyncio.Task] = None
+        self.isvc_informer = self.watch("inferenceservices")
+        self.dep_informer = self.watch("deployments")
+        self.pod_informer = self.watch("pods")
+        self.node_informer = self.watch("nodes")
+        self.isvc_informer.add_handlers(
+            on_add=self.enqueue_obj,
+            on_update=lambda o, n: self.enqueue_obj(n),
+            on_delete=self._drop_state)
+        self.dep_informer.add_handlers(
+            on_add=lambda d: self.enqueue_owner(d, "InferenceService"),
+            on_update=lambda o, n: self.enqueue_owner(n, "InferenceService"))
+        self.pod_informer.add_handlers(
+            on_add=self._pod_event, on_delete=self._pod_event,
+            on_update=lambda o, n: self._pod_event(n))
+
+    def _pod_event(self, pod: t.Pod) -> None:
+        svc = pod.metadata.labels.get(s.SERVICE_LABEL) \
+            or pod.metadata.labels.get(s.PREPULL_LABEL)
+        if svc:
+            self.enqueue(f"{pod.metadata.namespace}/{svc}")
+
+    def _drop_state(self, isvc) -> None:
+        self._states.pop(isvc.key(), None)
+        for g in (engine.DESIRED, engine.UTILIZATION, engine.SNAPSHOT_AGE):
+            g.remove(service=isvc.key())
+        self.enqueue_obj(isvc)
+
+    async def on_start(self) -> None:
+        self._ticker = spawn(self._autoscale_loop(),
+                             name="inference-autoscaler")
+
+    async def stop(self) -> None:
+        if self._ticker is not None:
+            self._ticker.cancel()
+            try:
+                await self._ticker
+            except asyncio.CancelledError:
+                pass
+            self._ticker = None
+        await super().stop()
+
+    # -- reconcile --------------------------------------------------------
+
+    async def sync(self, key: str) -> Optional[float]:
+        if not _gated():
+            return None
+        isvc = self.isvc_informer.get(key)
+        if isvc is None or isvc.metadata.deletion_timestamp is not None:
+            return None  # owner refs cascade Service/Deployment/pods
+        await self._ensure_service(isvc)
+        dep = await self._ensure_deployment(isvc)
+        await self._sync_warm_pool(isvc)
+        await self._update_status(isvc, dep)
+        return None
+
+    def _selector_labels(self, isvc) -> dict:
+        return {s.SERVICE_LABEL: isvc.metadata.name}
+
+    async def _ensure_service(self, isvc) -> None:
+        name, ns = isvc.metadata.name, isvc.metadata.namespace
+        existing = None
+        try:
+            existing = await self.client.get("services", ns, name)
+        except errors.NotFoundError:
+            pass
+        if existing is not None:
+            return
+        port = s.effective_spec(isvc.spec).port
+        svc = t.Service(
+            metadata=t.ObjectMeta(
+                name=name, namespace=ns,
+                labels=self._selector_labels(isvc),
+                owner_references=[controller_ref(
+                    isvc, s.SERVING_V1, "InferenceService")]),
+            spec=t.ServiceSpec(
+                # Headless: DNS answers per-replica A records and the
+                # endpoint router balances client-side; no VIP hop on
+                # the inference hot path.
+                cluster_ip="None",
+                selector=dict(self._selector_labels(isvc)),
+                ports=[t.ServicePort(name="http", port=port,
+                                     target_port=port)]))
+        try:
+            await self.client.create(svc)
+            self.recorder.event(isvc, "Normal", "CreatedService",
+                                f"created headless service {name}")
+        except errors.AlreadyExistsError:
+            pass
+
+    def _pod_template(self, isvc) -> t.PodTemplateSpec:
+        # Effective spec: an object created while the gate was OFF (no
+        # admission defaults) or updated to zero a field must never
+        # yield a port-0 probe or a 0 tok/s rating.
+        spec = s.effective_spec(isvc.spec)
+        command = [sys.executable, "-m",
+                   "kubernetes_tpu.workloads.model_server",
+                   "--model", spec.model,
+                   "--port", str(spec.port),
+                   "--rated-tokens-per-sec",
+                   f"{spec.rated_tokens_per_sec:g}"]
+        env = []
+        trace = os.environ.get("KTPU_TRACE", "")
+        if trace:
+            # Single-host composition shares the arming env; the server
+            # then opens per-request serve spans (queue/decode split)
+            # and spools them to the apiserver's trace ingest.
+            env.append(t.EnvVar(name="KTPU_TRACE", value=trace))
+            base = getattr(self.client, "base_url", "")
+            if base:
+                env.append(t.EnvVar(name="KTPU_TRACE_INGEST",
+                                    value=f"{base}/debug/v1/traces"))
+        container = t.Container(
+            name="server", image=spec.image, command=command, env=env,
+            resources=t.ResourceRequirements(
+                requests={t.RESOURCE_CPU: spec.cpu_per_replica}),
+            readiness_probe=t.Probe(
+                http_get=t.HTTPGetAction(path="/healthz", port=spec.port),
+                period_seconds=1, timeout_seconds=2, failure_threshold=3))
+        pod_spec = t.PodSpec(containers=[container])
+        chips = s.replica_chips(spec)
+        if chips > 0:
+            pod_spec.tpu_resources = [t.PodTpuRequest(
+                name="tpu", chips=chips,
+                slice_shape=list(spec.slice_shape))]
+            container.tpu_requests = ["tpu"]
+        return t.PodTemplateSpec(
+            metadata=t.ObjectMeta(labels=self._selector_labels(isvc)),
+            spec=pod_spec)
+
+    async def _ensure_deployment(self, isvc) -> Optional[w.Deployment]:
+        from ..api.selectors import LabelSelector
+        name, ns = isvc.metadata.name, isvc.metadata.namespace
+        dep = self.dep_informer.get(f"{ns}/{name}")
+        if dep is not None and is_controlled_by(dep, isvc):
+            # Template drift (model/port/image change) rolls through
+            # the deployment controller; replicas stay autoscaler-owned.
+            want = self._pod_template(isvc)
+            if dep.spec.template != want:
+                fresh = deepcopy(dep)
+                fresh.spec.template = want
+                try:
+                    return await self.client.update(fresh)
+                except (errors.ConflictError, errors.NotFoundError):
+                    return dep
+            return dep
+        if dep is not None:
+            log.warning("deployment %s/%s exists but is not owned by "
+                        "InferenceService %s; leaving it alone", ns, name,
+                        name)
+            return None
+        dep = w.Deployment(
+            metadata=t.ObjectMeta(
+                name=name, namespace=ns,
+                labels=self._selector_labels(isvc),
+                annotations={s.MANAGED_ANNOTATION: isvc.metadata.name},
+                owner_references=[controller_ref(
+                    isvc, s.SERVING_V1, "InferenceService")]),
+            spec=w.DeploymentSpec(
+                replicas=max(isvc.spec.min_replicas, 1),
+                selector=LabelSelector(
+                    match_labels=dict(self._selector_labels(isvc))),
+                template=self._pod_template(isvc)))
+        try:
+            created = await self.client.create(dep)
+        except errors.AlreadyExistsError:
+            return self.dep_informer.get(f"{ns}/{name}")
+        self.recorder.event(
+            isvc, "Normal", "CreatedDeployment",
+            f"created deployment {name} at {dep.spec.replicas} replicas "
+            f"(warm pool)")
+        return created
+
+    # -- warm pool --------------------------------------------------------
+
+    async def _sync_warm_pool(self, isvc) -> None:
+        """Pre-pull the model image on candidate nodes AHEAD of the
+        first scale-up: short-lived prepull pods (restartPolicy=Never,
+        command exits immediately) pinned to nodes not yet serving this
+        model. Once one succeeds, the node's image store holds the
+        artifact and a later replica's ktrace ``pull`` span collapses
+        to a cache hit — time-to-first-ready excludes the cold pull."""
+        from ..node.images import is_artifact_ref
+        spec = s.effective_spec(isvc.spec)
+        if not spec.image or not is_artifact_ref(spec.image):
+            return  # built-in image: nothing to pull anywhere
+        ns, name = isvc.metadata.namespace, isvc.metadata.name
+        want = spec.warm_pool_nodes or min(
+            max(spec.max_replicas - max(spec.min_replicas, 1), 0), 2)
+        pods = [p for p in self.pod_informer.list()
+                if p.metadata.namespace == ns]
+        warm_nodes = {p.spec.node_name for p in pods if p.spec.node_name
+                      and (p.metadata.labels.get(s.SERVICE_LABEL) == name
+                           or p.metadata.labels.get(s.PREPULL_LABEL) == name)}
+        # The DURABLE warm record (status.warm_nodes) joins in: without
+        # it, reaping a Succeeded prepull would erase the only evidence
+        # the node is warm and the next sync — kicked by that very
+        # delete event — would re-create the same prepull forever.
+        warm_nodes |= set(isvc.status.warm_nodes)
+        # Reap finished prepull pods — AFTER recording their node.
+        for p in pods:
+            if p.metadata.labels.get(s.PREPULL_LABEL) == name \
+                    and p.status.phase in ("Succeeded", "Failed"):
+                if p.status.phase == "Succeeded" and p.spec.node_name:
+                    if not await self._record_warm_node(
+                            isvc, p.spec.node_name):
+                        continue  # conflict: retry before the delete
+                    warm_nodes.add(p.spec.node_name)
+                try:
+                    await self.client.delete("pods", ns, p.metadata.name)
+                except errors.NotFoundError:
+                    pass
+        live_prepulls = sum(
+            1 for p in pods
+            if p.metadata.labels.get(s.PREPULL_LABEL) == name
+            and is_pod_active(p))
+        chips = s.replica_chips(spec)
+        candidates = []
+        for node in self.node_informer.list():
+            nname = node.metadata.name
+            if nname in warm_nodes or node.spec.unschedulable:
+                continue
+            cap = node.status.allocatable.get(t.RESOURCE_TPU, 0) \
+                or node.status.capacity.get(t.RESOURCE_TPU, 0)
+            if chips and cap < chips:
+                continue
+            candidates.append(nname)
+        for nname in sorted(candidates)[:max(want - live_prepulls, 0)]:
+            pod = t.Pod(
+                metadata=t.ObjectMeta(
+                    name=f"{name}-prepull-{nname}"[:63], namespace=ns,
+                    labels={s.PREPULL_LABEL: name},
+                    owner_references=[controller_ref(
+                        isvc, s.SERVING_V1, "InferenceService")]),
+                spec=t.PodSpec(
+                    restart_policy=t.RESTART_NEVER,
+                    node_name=nname,  # pre-bound: no scheduler pass
+                    containers=[t.Container(
+                        name="prepull", image=spec.image,
+                        command=[sys.executable, "-c", "pass"])]))
+            try:
+                await self.client.create(pod)
+                self.recorder.event(
+                    isvc, "Normal", "WarmPoolPrepull",
+                    f"pre-pulling {spec.image} on node {nname}")
+            except errors.AlreadyExistsError:
+                pass
+
+    async def _record_warm_node(self, isvc, node: str) -> bool:
+        """Durably mark ``node`` warm for this service (status write,
+        WAL-backed) — must land BEFORE the prepull pod is deleted."""
+        if node in isvc.status.warm_nodes:
+            return True
+        fresh = deepcopy(isvc)
+        fresh.status.warm_nodes = sorted(
+            set(isvc.status.warm_nodes) | {node})
+        try:
+            await self.client.update(fresh, subresource="status")
+            return True
+        except errors.NotFoundError:
+            return True  # service deleted: nothing left to protect
+        except errors.ConflictError:
+            return False  # stale copy: the resync retries the reap
+
+    # -- status -----------------------------------------------------------
+
+    def _replica_pods(self, isvc) -> list[t.Pod]:
+        name, ns = isvc.metadata.name, isvc.metadata.namespace
+        return [p for p in self.pod_informer.list()
+                if p.metadata.namespace == ns and is_pod_active(p)
+                and p.metadata.labels.get(s.SERVICE_LABEL) == name]
+
+    async def _update_status(self, isvc, dep) -> None:
+        pods = self._replica_pods(isvc)
+        new = deepcopy(isvc.status)
+        new.replicas = len(pods)
+        new.ready_replicas = sum(1 for p in pods if is_pod_ready(p))
+        if dep is not None:
+            new.desired_replicas = dep.spec.replicas
+        if new == isvc.status:
+            return
+        fresh = deepcopy(isvc)
+        fresh.status = new
+        try:
+            await self.client.update(fresh, subresource="status")
+        except (errors.ConflictError, errors.NotFoundError):
+            pass
+
+    # -- autoscaler -------------------------------------------------------
+
+    async def _autoscale_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.autoscale_interval)
+            if not _gated():
+                continue
+            try:
+                await self.autoscale_once()
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — one bad tick must not
+                log.exception("autoscale tick failed")  # kill the loop
+
+    def _sample(self, isvc) -> Optional[engine.MetricsSample]:
+        """Fold the monitor snapshot's per-pod rows into one service
+        sample. ``mfu`` carries the model server's busy fraction (the
+        stats pipeline's generic utilization slot)."""
+        if self.metrics_feed is None:
+            return None
+        snap = self.metrics_feed() or {}
+        pods_stats = snap.get("pods") or {}
+        utils, tokens = [], 0.0
+        for p in self._replica_pods(isvc):
+            rec = pods_stats.get(p.key())
+            if rec is None:
+                continue
+            tokens += float(rec.get("tokens_per_sec", 0.0) or 0.0)
+            if "mfu" in rec:
+                utils.append(float(rec["mfu"]))
+        return engine.MetricsSample(
+            utilization=sum(utils) / len(utils) if utils else 0.0,
+            tokens_per_sec=round(tokens, 1),
+            reporting=len(utils),
+            age_seconds=float(snap.get("age_seconds", float("inf"))))
+
+    async def autoscale_once(self) -> None:
+        """One pass over every InferenceService (tests call this
+        directly with a synthetic feed)."""
+        clock = time.monotonic()
+        for isvc in self.isvc_informer.list():
+            key = isvc.key()
+            dep = self.dep_informer.get(key)
+            if dep is None or not is_controlled_by(dep, isvc):
+                continue
+            current = dep.spec.replicas
+            pods = self._replica_pods(isvc)
+            ready = sum(1 for p in pods if is_pod_ready(p))
+            sample = self._sample(isvc)
+            state = self._states.setdefault(key, engine.ServiceState())
+            decision = engine.decide(
+                s.effective_spec(isvc.spec), current, ready, sample,
+                state, clock, max_snapshot_age=self.max_snapshot_age)
+            engine.export_metrics(key, decision, sample, current)
+            state.last_desired = decision.desired
+            await self._apply_decision(isvc, dep, sample, decision)
+
+    async def _apply_decision(self, isvc, dep, sample, decision) -> None:
+        current = dep.spec.replicas
+        changed = not decision.refused and decision.desired != current
+        if changed:
+            fresh = deepcopy(dep)
+            fresh.spec.replicas = decision.desired
+            try:
+                await self.client.update(fresh)
+            except (errors.ConflictError, errors.NotFoundError):
+                return
+            self.recorder.event(
+                isvc, "Normal", "Rescaled",
+                f"scaled {current} -> {decision.desired} "
+                f"({decision.reason})")
+        new = deepcopy(isvc.status)
+        new.desired_replicas = decision.desired
+        if sample is not None:
+            new.tokens_per_sec = sample.tokens_per_sec
+            new.utilization = round(sample.utilization, 4)
+            # inf (no sweep yet) stays -1: JSON has no Infinity.
+            new.snapshot_age_seconds = (
+                round(sample.age_seconds, 3)
+                if math.isfinite(sample.age_seconds) else -1.0)
+        new.last_scale_reason = decision.reason
+        if changed:
+            new.last_scale_time = now()
+        if self._status_material_change(isvc.status, new, changed,
+                                        decision.refused):
+            fresh = deepcopy(isvc)
+            fresh.status = new
+            try:
+                await self.client.update(fresh, subresource="status")
+            except (errors.ConflictError, errors.NotFoundError):
+                pass
+
+    @staticmethod
+    def _status_material_change(old, new, changed: bool,
+                                refused: bool) -> bool:
+        """Whether this tick's status is worth an API write. The
+        snapshot age and the utilization reading drift every tick by
+        nature; writing them verbatim would cost one MVCC write + watch
+        fan-out per service per tick FOREVER at steady state. A write
+        happens only when something an operator acts on moved: the
+        target, a refusal-state flip, a utilization/throughput shift
+        beyond reporting noise, or the very first sample."""
+        if changed or new.desired_replicas != old.desired_replicas:
+            return True
+        stale_kind = "metrics snapshot stale"
+        if refused != old.last_scale_reason.startswith(stale_kind):
+            return True
+        if (old.snapshot_age_seconds < 0) != (new.snapshot_age_seconds
+                                              < 0):
+            return True  # first sample / feed appeared or vanished
+        if abs(new.utilization - old.utilization) >= 0.05:
+            return True
+        if abs(new.tokens_per_sec - old.tokens_per_sec) >= max(
+                1.0, 0.1 * old.tokens_per_sec):
+            return True
+        return False

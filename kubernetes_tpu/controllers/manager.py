@@ -40,6 +40,11 @@ from .volume import PersistentVolumeBinder
 
 log = logging.getLogger("controller-manager")
 
+#: Hard ceiling on how long stop() waits for the run task to honor
+#: cancellation before abandoning it — teardown is bounded by a real
+#: deadline, never by a wedged controller.
+STOP_GRACE_SECONDS = 30.0
+
 #: The controller table (reference: NewControllerInitializers).
 DEFAULT_CONTROLLERS: dict[str, Callable[[Client, InformerFactory], Controller]] = {
     "replicaset": ReplicaSetController,
@@ -66,6 +71,19 @@ DEFAULT_CONTROLLERS: dict[str, Callable[[Client, InformerFactory], Controller]] 
 }
 
 
+def _inference_controller(client, factory, **kw):
+    # Lazy like the monitor: serving/ is only paid for when built.
+    from .inference import InferenceServiceController
+    return InferenceServiceController(client, factory, **kw)
+
+
+#: Inference serving (serving/v1): reconcile InferenceServices into a
+#: headless Service + model-server Deployment and autoscale them on
+#: the cluster monitor's rollups; inert unless the InferenceAutoscaling
+#: gate is on.
+DEFAULT_CONTROLLERS["inference"] = _inference_controller
+
+
 def _cluster_monitor(client, factory, **kw):
     # Imported lazily: monitoring/ pulls in aiohttp-scrape machinery a
     # controller-only process may never use.
@@ -82,7 +100,9 @@ DEFAULT_CONTROLLERS["cluster-monitor"] = _cluster_monitor
 class ControllerManager:
     def __init__(self, client: Client, controllers: Optional[list[str]] = None,
                  leader_elect: bool = False, identity: str = "",
-                 node_scrape_ssl=None, queueing_fits_probe=None):
+                 node_scrape_ssl=None, queueing_fits_probe=None,
+                 monitor_interval: float = 10.0,
+                 autoscale_interval: float = 2.0):
         self.client = client
         #: Cluster credentials for scraping TLS node servers (the HPA's
         #: real metrics pipeline); the composer wires CA + identity.
@@ -91,6 +111,10 @@ class ControllerManager:
         #: single-binary composer wires the live scheduler cache so
         #: backfill only jumps when a free box actually exists).
         self.queueing_fits_probe = queueing_fits_probe
+        #: Cluster-monitor sweep cadence + inference autoscaler tick
+        #: (smokes shorten both; production keeps the defaults).
+        self.monitor_interval = monitor_interval
+        self.autoscale_interval = autoscale_interval
         self.names = list(controllers or DEFAULT_CONTROLLERS)
         self.leader_elect = leader_elect
         self.identity = identity or f"cm-{uuid.uuid4().hex[:8]}"
@@ -109,8 +133,14 @@ class ControllerManager:
                 self.client, ssl_context=self.node_scrape_ssl)}
         if name == "job-queueing" and self.queueing_fits_probe is not None:
             return {"fits_probe": self.queueing_fits_probe}
-        if name == "cluster-monitor" and self.node_scrape_ssl is not None:
-            return {"ssl_context": self.node_scrape_ssl}
+        if name == "cluster-monitor":
+            kw = {"interval": self.monitor_interval}
+            if self.node_scrape_ssl is not None:
+                kw["ssl_context"] = self.node_scrape_ssl
+            return kw
+        if name == "inference":
+            return {"autoscale_interval": self.autoscale_interval,
+                    "max_snapshot_age": max(3 * self.monitor_interval, 10.0)}
         return {}
 
     async def _run_controllers(self) -> None:
@@ -121,6 +151,17 @@ class ControllerManager:
             DEFAULT_CONTROLLERS[name](self.client, self.factory,
                                       **self._ctor_kwargs(name))
             for name in self.names]
+        # The inference autoscaler reads the CO-LOCATED monitor's
+        # latest() snapshot (the custom-metrics seam) — wired after
+        # construction because both live in this manager's table.
+        monitor = next((c for c in self.controllers
+                        if getattr(c, "name", "") == "cluster-monitor"),
+                       None)
+        for c in self.controllers:
+            if getattr(c, "name", "") == "inference-controller" \
+                    and getattr(c, "metrics_feed", None) is None \
+                    and monitor is not None:
+                c.metrics_feed = monitor.latest
         for c in self.controllers:
             await c.start()
         log.info("controller-manager: %d controllers running",
@@ -152,11 +193,16 @@ class ControllerManager:
 
     async def stop(self) -> None:
         if self._run_task:
-            self._run_task.cancel()
-            try:
-                await self._run_task
-            except asyncio.CancelledError:
-                pass
+            # Bounded, re-cancelling wait (util/tasks.cancel_task): a
+            # stop() racing controller STARTUP can lose its first
+            # cancellation to CPython's wait_for swallow (GH-86296)
+            # inside informer.wait_for_sync — the manager then parks on
+            # its run-forever wait with the cancel consumed, and a
+            # plain await here hung e2e teardown for minutes.
+            from ..util.tasks import cancel_task
+            await cancel_task(self._run_task, grace=STOP_GRACE_SECONDS,
+                              name="controller-manager")
+            self._run_task = None
         # _run_controllers' finally handles teardown when cancelled inside
         # the wait; if cancellation landed elsewhere, sweep again.
         if self.controllers:

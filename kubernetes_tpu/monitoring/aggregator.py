@@ -119,11 +119,19 @@ class ClusterMonitor:
             self._task = None
 
     def latest(self) -> dict:
-        """The last completed sweep: ``{"at", "nodes": {name: {...}},
-        "pods": {"ns/name": {...}}, "cluster": {...}}`` — the
-        custom-metrics read seam (autoscalers poll this instead of
-        scraping the fleet again)."""
-        return self._snapshot
+        """The last completed sweep: ``{"at", "age_seconds",
+        "nodes": {name: {...}}, "pods": {"ns/name": {...}},
+        "cluster": {...}}`` — the custom-metrics read seam (autoscalers
+        poll this instead of scraping the fleet again).
+
+        ``age_seconds`` is computed at READ time (inf before the first
+        sweep): the explicit staleness signal consumers gate on — an
+        autoscaler must refuse to act on a frozen rollup instead of
+        silently scaling on numbers from a wedged scrape loop."""
+        snap = dict(self._snapshot)
+        snap["age_seconds"] = (round(time.time() - snap["at"], 3)
+                               if snap["at"] else float("inf"))
+        return snap
 
     async def _loop(self) -> None:
         while True:

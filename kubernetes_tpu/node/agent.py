@@ -1168,8 +1168,13 @@ class NodeAgent:
                 await self.runtime.stop_container(cid, grace_seconds=1.0)
                 return
         if container.liveness_probe or container.readiness_probe:
+            # Probes dial the POD IP (kubelet: prober connects to
+            # PodStatus.PodIP); host-network pods answer on loopback.
+            probe_host = "127.0.0.1" if pod.spec.host_network \
+                else (self.ipam.ip_for(pod.metadata.uid) or "127.0.0.1")
             self.probes.add(pod, container, cid,
-                            on_liveness_fail=self._liveness_failed)
+                            on_liveness_fail=self._liveness_failed,
+                            host=probe_host)
 
     def _liveness_failed(self, pod_key: str, container_name: str, cid: str) -> None:
         async def restart():

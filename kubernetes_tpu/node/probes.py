@@ -53,19 +53,25 @@ class ProbeManager:
         self._ready: dict[tuple, bool] = {}
 
     def add(self, pod: t.Pod, container: t.Container, cid: str,
-            on_liveness_fail: Optional[Callable] = None) -> None:
+            on_liveness_fail: Optional[Callable] = None,
+            host: str = "127.0.0.1") -> None:
+        """``host``: where http/tcp probes dial — the POD IP (kubelet
+        semantics: the prober connects to PodStatus.PodIP, not
+        loopback; a server correctly bound to its pod IP is invisible
+        on 127.0.0.1)."""
         key = pod.key()
         # Keyed WITHOUT the container id so a restarted container
         # replaces (cancels) the old probe loop instead of leaking it.
         if container.readiness_probe:
             self._ready[(key, container.name)] = False
             self._spawn((key, container.name, "readiness"),
-                        self._readiness_loop(key, container, cid))
+                        self._readiness_loop(key, container, cid, host))
         else:
             self._ready[(key, container.name)] = True
         if container.liveness_probe and on_liveness_fail:
             self._spawn((key, container.name, "liveness"),
-                        self._liveness_loop(key, container, cid, on_liveness_fail))
+                        self._liveness_loop(key, container, cid,
+                                            on_liveness_fail, host))
 
     def _spawn(self, tkey: tuple, coro) -> None:
         old = self._tasks.pop(tkey, None)
@@ -76,12 +82,13 @@ class ProbeManager:
     def is_ready(self, pod_key: str, container_name: str) -> bool:
         return self._ready.get((pod_key, container_name), True)
 
-    async def _readiness_loop(self, key: str, container: t.Container, cid: str) -> None:
+    async def _readiness_loop(self, key: str, container: t.Container,
+                              cid: str, host: str = "127.0.0.1") -> None:
         probe = container.readiness_probe
         await asyncio.sleep(probe.initial_delay_seconds)
         successes = failures = 0
         while True:
-            ok = await run_probe(probe)
+            ok = await run_probe(probe, host=host)
             if ok:
                 successes += 1
                 failures = 0
@@ -95,12 +102,13 @@ class ProbeManager:
             await asyncio.sleep(probe.period_seconds)
 
     async def _liveness_loop(self, key: str, container: t.Container, cid: str,
-                             on_fail: Callable) -> None:
+                             on_fail: Callable,
+                             host: str = "127.0.0.1") -> None:
         probe = container.liveness_probe
         await asyncio.sleep(probe.initial_delay_seconds)
         failures = 0
         while True:
-            ok = await run_probe(probe)
+            ok = await run_probe(probe, host=host)
             failures = 0 if ok else failures + 1
             if failures >= probe.failure_threshold:
                 log.info("liveness failed for %s/%s; restarting", key, container.name)

@@ -1,0 +1,467 @@
+"""Open-loop serving loadgen — user traffic against an autoscaled
+InferenceService.
+
+The evaluation template is PAPERS.md "Evaluating Kubernetes
+Performance for GenAI Inference": request p50/p99 and SLO attainment
+under arrival-rate sweeps, plus burst and diurnal patterns, measured
+OPEN-LOOP (arrivals are a Poisson process whose timing never waits on
+completions — a saturated fleet shows up as tail latency, not as a
+politely slowed generator).
+
+One run composes a LocalCluster (ProcessRuntime nodes — the model
+servers are real HTTP processes), creates one InferenceService with
+the ``InferenceAutoscaling`` (+ optionally ``ServingTopologyAware``)
+gate on, then drives stages:
+
+- **sweep**: one stage per arrival rate in ``rates`` (requests/s);
+- **burst**: a step to ``burst_rate`` — the autoscaler's scale-up is
+  measured as replica count over time plus per-new-replica
+  time-to-first-ready (and, when tracing is armed, the span-derived
+  queue/schedule/bind/start startup breakdown per scale-up pod);
+- **drain**: back to the lowest rate, letting the stabilization window
+  expire so the scale-down is visible;
+- **diurnal** (optional): a compressed sinusoidal day.
+
+Latency percentiles are nearest-rank over RAW samples (``perf.pct``);
+SLO attainment = fraction of completed requests within the service's
+``slo_target_ms``. Requests route through the slice-topology-aware
+endpoint router (``serving/router.py``).
+
+CLI::
+
+    python -m kubernetes_tpu.perf.serving_bench \
+        --nodes 2 --chips-per-node 4 --rates 4,8,16 --burst-rate 32
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+import math
+import random
+import time
+from typing import Optional
+
+from . import pct
+
+log = logging.getLogger("serving-bench")
+
+DEFAULT_PROMPT_TOKENS = 64
+DEFAULT_MAX_TOKENS = 32
+
+
+# ---------------------------------------------------------------------------
+# Request driving
+# ---------------------------------------------------------------------------
+
+
+class _OpenLoopDriver:
+    """Fires requests at exponential inter-arrivals; never blocks the
+    arrival clock on completions (the open-loop contract)."""
+
+    def __init__(self, session, router, slo_ms: float, rng: random.Random,
+                 prompt_tokens: int = DEFAULT_PROMPT_TOKENS,
+                 max_tokens: int = DEFAULT_MAX_TOKENS):
+        self.session = session
+        self.router = router
+        self.slo_ms = slo_ms
+        self.rng = rng
+        self.prompt_tokens = prompt_tokens
+        self.max_tokens = max_tokens
+        self.samples: list[dict] = []
+        self._inflight: set = set()
+
+    async def _one(self, stage: str) -> None:
+        from .. import tracing
+        import aiohttp
+        ep = self.router.pick()
+        t0 = time.perf_counter()
+        if ep is None:
+            self.samples.append({"stage": stage, "ok": False,
+                                 "error": "no endpoints", "ms": 0.0})
+            return
+        span = tracing.root_span("request", component="loadgen",
+                                 attrs={"endpoint": ep.url})
+        headers = {}
+        if not span.noop:
+            headers["traceparent"] = tracing.encode(span.context())
+        try:
+            async with self.session.post(
+                    f"{ep.url}/v1/generate",
+                    json={"prompt_tokens": self.prompt_tokens,
+                          "max_tokens": self.max_tokens},
+                    headers=headers,
+                    timeout=aiohttp.ClientTimeout(total=30)) as r:
+                await r.json()
+                ok = r.status == 200
+        except Exception as e:  # noqa: BLE001 — a failed request is a
+            self.samples.append({                 # sample, not a crash
+                "stage": stage, "ok": False, "error": str(e),
+                "ms": round((time.perf_counter() - t0) * 1e3, 2)})
+            span.end(error=str(e))
+            self.router.done(ep)
+            return
+        ms = (time.perf_counter() - t0) * 1e3
+        span.end()
+        self.router.done(ep)
+        self.samples.append({"stage": stage, "ok": ok,
+                             "ms": round(ms, 2)})
+
+    def _fire(self, stage: str) -> None:
+        task = asyncio.get_running_loop().create_task(self._one(stage))
+        self._inflight.add(task)
+        task.add_done_callback(self._inflight.discard)
+
+    async def run_stage(self, stage: str, rate: float,
+                        duration: float) -> int:
+        """Poisson arrivals at ``rate``/s for ``duration``s; returns
+        the offered count. Arrival times are precomputed against the
+        wall clock so a slow loop tick fires the backlog immediately
+        instead of stretching the schedule (open-loop honesty)."""
+        loop = asyncio.get_running_loop()
+        t_end = loop.time() + duration
+        offered = 0
+        next_at = loop.time()
+        while next_at < t_end:
+            delay = next_at - loop.time()
+            if delay > 0:
+                await asyncio.sleep(delay)
+            self._fire(stage)
+            offered += 1
+            next_at += self.rng.expovariate(rate)
+        # Let the stage's tail land (bounded: stragglers count as the
+        # next stage's background, exactly like real traffic).
+        await asyncio.sleep(min(1.0, 2 * DEFAULT_MAX_TOKENS / 256))
+        return offered
+
+    async def run_diurnal(self, stage: str, base: float, peak: float,
+                          duration: float) -> int:
+        """One compressed sinusoidal day: rate(t) sweeps base -> peak
+        -> base over ``duration``."""
+        loop = asyncio.get_running_loop()
+        t0 = loop.time()
+        offered = 0
+        next_at = t0
+        while next_at < t0 + duration:
+            delay = next_at - loop.time()
+            if delay > 0:
+                await asyncio.sleep(delay)
+            self._fire(stage)
+            offered += 1
+            phase = (next_at - t0) / duration            # 0..1
+            rate = base + (peak - base) * 0.5 * (1 - math.cos(
+                2 * math.pi * phase))
+            next_at += self.rng.expovariate(max(rate, 0.1))
+        await asyncio.sleep(1.0)
+        return offered
+
+    async def drain(self, timeout: float = 10.0) -> None:
+        if self._inflight:
+            await asyncio.wait(self._inflight, timeout=timeout)
+
+
+def _stage_report(samples: list[dict], stage: str, offered: int,
+                  rate: float, duration: float, slo_ms: float) -> dict:
+    mine = [s for s in samples if s["stage"] == stage]
+    done = [s for s in mine if s["ok"]]
+    lats = sorted(s["ms"] for s in done)
+    within = sum(1 for s in done if s["ms"] <= slo_ms)
+    return {
+        "stage": stage,
+        "target_rps": round(rate, 2),
+        "offered": offered,
+        "completed": len(done),
+        "errors": len(mine) - len(done),
+        "p50_ms": round(pct(lats, 0.50), 2),
+        "p90_ms": round(pct(lats, 0.90), 2),
+        "p99_ms": round(pct(lats, 0.99), 2),
+        "slo_ms": slo_ms,
+        # Attainment is over EVERY fired request: an errored or
+        # timed-out request is an SLO miss, not a statistics dropout —
+        # otherwise a fleet that sheds load into timeouts reports
+        # better numbers the worse it gets.
+        "slo_attainment_pct": round(100.0 * within / len(mine), 2)
+        if mine else 0.0,
+    }
+
+
+# ---------------------------------------------------------------------------
+# The bench
+# ---------------------------------------------------------------------------
+
+
+async def run_serving_bench(
+        n_nodes: int = 2, chips_per_node: int = 4,
+        chips_per_replica: int = 1,
+        min_replicas: int = 1, max_replicas: int = 0,
+        rated_tokens_per_sec: float = 256.0,
+        rates: tuple = (4.0, 8.0), burst_rate: float = 24.0,
+        stage_seconds: float = 6.0, burst_seconds: float = 10.0,
+        drain_seconds: float = 8.0,
+        diurnal: bool = False, diurnal_seconds: float = 12.0,
+        slo_target_ms: float = 0.0,
+        scale_down_stabilization_seconds: float = 3.0,
+        topology_aware: bool = True,
+        monitor_interval: float = 0.5, autoscale_interval: float = 0.5,
+        seed: int = 1) -> dict:
+    """Full arrival-rate sweep + burst + drain (+ diurnal) against an
+    autoscaled InferenceService on a fresh LocalCluster. Returns the
+    report dict (also what ``__main__`` prints as JSON)."""
+    from ..api import serving as s
+    from ..api import types as t
+    from ..api.meta import ObjectMeta
+    from ..cluster.local import LocalCluster, NodeSpec
+    from ..serving.router import TopologyRouter
+    from ..util.features import GATES
+    import aiohttp
+
+    max_replicas = max_replicas or (n_nodes * chips_per_node
+                                    // max(chips_per_replica, 1))
+    was_scaling = GATES.enabled("InferenceAutoscaling")
+    was_topo = GATES.enabled("ServingTopologyAware")
+    GATES.set("InferenceAutoscaling", True)
+    GATES.set("ServingTopologyAware", bool(topology_aware))
+    cluster = LocalCluster(
+        nodes=[NodeSpec(name=f"serve-{i}", tpu_chips=chips_per_node)
+               for i in range(n_nodes)],
+        tls=False, status_interval=0.5, heartbeat_interval=0.5,
+        monitor_interval=monitor_interval,
+        autoscale_interval=autoscale_interval)
+    t_start = time.monotonic()
+    rng = random.Random(seed)
+    report: dict = {"config": {
+        "nodes": n_nodes, "chips_per_node": chips_per_node,
+        "chips_per_replica": chips_per_replica,
+        "min_replicas": min_replicas, "max_replicas": max_replicas,
+        "rates": list(rates), "burst_rate": burst_rate,
+        "diurnal": diurnal, "seed": seed,
+        "topology_aware": bool(topology_aware),
+    }}
+    try:
+        await cluster.start()
+        await cluster.wait_for_nodes_ready(30.0)
+        client = cluster.local_client()
+        isvc = s.InferenceService(
+            metadata=ObjectMeta(name="bench", namespace="default"),
+            spec=s.InferenceServiceSpec(
+                model="bench-model",
+                min_replicas=min_replicas, max_replicas=max_replicas,
+                chips_per_replica=chips_per_replica,
+                rated_tokens_per_sec=rated_tokens_per_sec,
+                slo_target_ms=slo_target_ms,
+                scale_down_stabilization_seconds=(
+                    scale_down_stabilization_seconds)))
+        isvc = await client.create(isvc)
+        slo_ms = isvc.spec.slo_target_ms  # admission-defaulted if 0
+
+        # Replica-readiness observer: create/ready stamps for every
+        # serving pod (TTFR for scale-up pods), plus a sampled ready-
+        # count timeline.
+        created_at: dict[str, float] = {}
+        ready_at: dict[str, float] = {}
+        live_ready: set[str] = set()
+        timeline: list[tuple[float, int]] = []
+
+        def _note(ev_type, pod):
+            if pod.metadata.labels.get(s.SERVICE_LABEL) != "bench":
+                return
+            name = pod.metadata.name
+            created_at.setdefault(name, time.monotonic())
+            cond = t.get_pod_condition(pod.status, t.COND_POD_READY)
+            ready = cond is not None and cond.status == "True"
+            gone = (ev_type == "DELETED"
+                    or pod.metadata.deletion_timestamp is not None
+                    or pod.status.phase in ("Succeeded", "Failed"))
+            if ready and not gone:
+                ready_at.setdefault(name, time.monotonic())
+                live_ready.add(name)
+            elif gone or not ready:
+                live_ready.discard(name)
+
+        stream = await client.watch("pods", namespace="default")
+
+        async def _observe():
+            while True:
+                ev = await stream.next(timeout=1.0)
+                if ev is None:
+                    continue
+                if ev[0] in ("CLOSED",):
+                    return
+                if ev[0] == "BOOKMARK":
+                    continue
+                _note(ev[0], ev[1])
+
+        async def _sample_timeline():
+            # Live ready replicas: the burst's climb AND the drain's
+            # descent are both visible in this series.
+            while True:
+                timeline.append((round(time.monotonic() - t_start, 2),
+                                 len(live_ready)))
+                await asyncio.sleep(0.5)
+
+        observer = asyncio.get_running_loop().create_task(_observe())
+        sampler = asyncio.get_running_loop().create_task(
+            _sample_timeline())
+
+        async def _wait_ready(n: int, deadline_s: float, what: str):
+            end = time.monotonic() + deadline_s
+            while len(ready_at) < n:
+                if time.monotonic() > end:
+                    raise TimeoutError(
+                        f"{what}: {len(ready_at)}/{n} replicas ready")
+                await asyncio.sleep(0.2)
+
+        await _wait_ready(min_replicas, 60.0, "warm pool")
+        warm_pods = set(ready_at)
+
+        router = TopologyRouter(client, "bench", "default")
+        await router.start()
+        #: (label, offered, rate, duration) — percentiles are computed
+        #: only after the FINAL drain, so a stage's queued tail counts
+        #: against that stage instead of silently vanishing (the
+        #: diurnal peak's overload is exactly the tail that matters).
+        ran: list[tuple] = []
+        try:
+            async with aiohttp.ClientSession() as session:
+                driver = _OpenLoopDriver(session, router, slo_ms, rng)
+                for rate in rates:
+                    label = f"sweep-{rate:g}rps"
+                    offered = await driver.run_stage(
+                        label, rate, stage_seconds)
+                    ran.append((label, offered, rate, stage_seconds))
+
+                burst_t0 = time.monotonic()
+                replicas_before = len(live_ready)
+                offered = await driver.run_stage(
+                    "burst", burst_rate, burst_seconds)
+                ran.append(("burst", offered, burst_rate, burst_seconds))
+                scale_up_pods = {n for n in created_at
+                                 if n not in warm_pods
+                                 and created_at[n] >= burst_t0 - 1.0}
+
+                offered = await driver.run_stage(
+                    "drain", min(rates), drain_seconds)
+                ran.append(("drain", offered, min(rates), drain_seconds))
+
+                if diurnal:
+                    offered = await driver.run_diurnal(
+                        "diurnal", base=min(rates), peak=burst_rate,
+                        duration=diurnal_seconds)
+                    ran.append(("diurnal", offered,
+                                (min(rates) + burst_rate) / 2,
+                                diurnal_seconds))
+                await driver.drain(timeout=30.0)
+        finally:
+            await router.stop()
+        stages = [_stage_report(driver.samples, label, offered, rate,
+                                duration, slo_ms)
+                  for label, offered, rate, duration in ran]
+        for st in stages:
+            log.info("stage %s: %s", st["stage"], st)
+
+        # Scale-down visibility: give the stabilization window one
+        # more beat, then read the deployment's final target.
+        await asyncio.sleep(scale_down_stabilization_seconds + 1.0)
+        dep = await client.get("deployments", "default", "bench")
+        final_isvc = await client.get("inferenceservices", "default",
+                                      "bench")
+        observer.cancel()
+        sampler.cancel()
+        stream.cancel()
+        for task in (observer, sampler):
+            try:
+                await task
+            except asyncio.CancelledError:
+                pass
+
+        ttfr = sorted(ready_at[n] - created_at[n]
+                      for n in scale_up_pods if n in ready_at)
+        # The burst's peak is DEFINED by its pods, not by a timing
+        # window: replicas serving before the burst plus burst-created
+        # replicas that reached Ready (a late-landing scale-up counts;
+        # diurnal re-scaling afterwards does not).
+        report["stages"] = stages
+        report["scale_up"] = {
+            "replicas_before_burst": replicas_before,
+            "replicas_peak": replicas_before + sum(
+                1 for n in scale_up_pods if n in ready_at),
+            "new_replicas": len(scale_up_pods),
+            "ttfr_s": [round(v, 3) for v in ttfr],
+            "ttfr_p50_s": round(pct(ttfr, 0.50), 3),
+            "ttfr_p99_s": round(pct(ttfr, 0.99), 3),
+        }
+        report["scale_down"] = {
+            "final_target": dep.spec.replicas,
+            "status": {
+                "desired": final_isvc.status.desired_replicas,
+                "utilization": final_isvc.status.utilization,
+                "snapshot_age_seconds":
+                    final_isvc.status.snapshot_age_seconds,
+            },
+        }
+        report["replica_timeline"] = timeline
+        report["startup_breakdown"] = _scale_up_breakdown(scale_up_pods)
+        return report
+    finally:
+        await cluster.stop()
+        GATES.set("InferenceAutoscaling", was_scaling)
+        GATES.set("ServingTopologyAware", was_topo)
+
+
+def _scale_up_breakdown(pods: set) -> dict:
+    """Span-derived per-scale-up startup decomposition: the ktrace
+    stage model (queue/schedule/bind/start) over the burst's new pods
+    — "where did time-to-first-ready go". Empty when tracing is off."""
+    from .. import tracing
+    from ..tracing.timeline import stage_breakdown
+    if not tracing.armed() or not pods:
+        return {}
+    spans = tracing.COLLECTOR.snapshot()
+    keys = {f"default/{name}" for name in pods}
+    trace_ids = {s_.get("trace_id") for s_ in spans
+                 if (s_.get("attrs") or {}).get("pod") in keys}
+    mine = [s_ for s_ in spans if s_.get("trace_id") in trace_ids]
+    return stage_breakdown(mine) if mine else {}
+
+
+def main(argv=None) -> int:
+    import argparse
+    import json
+    parser = argparse.ArgumentParser(
+        description="open-loop serving loadgen (ISSUE 11)")
+    parser.add_argument("--nodes", type=int, default=2)
+    parser.add_argument("--chips-per-node", type=int, default=4)
+    parser.add_argument("--chips-per-replica", type=int, default=1)
+    parser.add_argument("--min-replicas", type=int, default=1)
+    parser.add_argument("--max-replicas", type=int, default=0)
+    parser.add_argument("--rates", default="4,8",
+                        help="comma-separated sweep rates (req/s)")
+    parser.add_argument("--burst-rate", type=float, default=24.0)
+    parser.add_argument("--stage-seconds", type=float, default=6.0)
+    parser.add_argument("--burst-seconds", type=float, default=10.0)
+    parser.add_argument("--drain-seconds", type=float, default=8.0)
+    parser.add_argument("--diurnal", action="store_true")
+    parser.add_argument("--diurnal-seconds", type=float, default=12.0)
+    parser.add_argument("--rated-tokens-per-sec", type=float, default=256.0)
+    parser.add_argument("--slo-ms", type=float, default=0.0)
+    parser.add_argument("--no-topology", action="store_true")
+    parser.add_argument("--seed", type=int, default=1)
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    report = asyncio.run(run_serving_bench(
+        n_nodes=args.nodes, chips_per_node=args.chips_per_node,
+        chips_per_replica=args.chips_per_replica,
+        min_replicas=args.min_replicas, max_replicas=args.max_replicas,
+        rates=tuple(float(r) for r in args.rates.split(",") if r),
+        burst_rate=args.burst_rate, stage_seconds=args.stage_seconds,
+        burst_seconds=args.burst_seconds,
+        drain_seconds=args.drain_seconds,
+        diurnal=args.diurnal, diurnal_seconds=args.diurnal_seconds,
+        rated_tokens_per_sec=args.rated_tokens_per_sec,
+        slo_target_ms=args.slo_ms,
+        topology_aware=not args.no_topology, seed=args.seed))
+    print(json.dumps(report, indent=2, default=str))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

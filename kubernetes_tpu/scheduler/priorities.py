@@ -113,6 +113,45 @@ def tpu_defrag_score(pod: t.Pod, info: NodeInfo,
     return MAX_SCORE * (1.0 - exposure / worst) if worst else MAX_SCORE
 
 
+def serving_topology_score(slice_free: set, mesh, chosen_cells,
+                           before_volume: int | None = None,
+                           torus: bool = True) -> float:
+    """Score a serving replica's chip claim by how little it shrinks
+    the slice's largest free contiguous box (``ServingTopologyAware``
+    gate; the fleet-level complement of :func:`tpu_defrag_score`'s
+    within-node packing).
+
+    Large training gangs need whole axis-aligned boxes; a serving
+    replica dropped into the middle of a pristine slice shreds a box no
+    defrag pass can rebuild without migration. Damage = largest free
+    box volume before the claim minus after; the score prefers the
+    placement (usually an already-fragmented slice, or a corner) whose
+    damage is smallest:
+
+        score = MAX_SCORE * (1 - damage / before)
+
+    ``before_volume``: memoized largest-box volume for this slice (the
+    scheduler computes it once per slice per placement pass).
+    """
+    from .submesh import largest_free_box_volume
+    if not chosen_cells:
+        return MAX_SCORE / 2
+    if before_volume is None:
+        before_volume = largest_free_box_volume(slice_free, mesh, torus)
+    if before_volume <= 0:
+        return MAX_SCORE / 2
+    after = largest_free_box_volume(
+        set(slice_free) - set(chosen_cells), mesh, torus)
+    damage = max(before_volume - after, 0)
+    return MAX_SCORE * (1.0 - damage / before_volume)
+
+
+#: Weight of the gated serving anti-fragmentation term (heavier than
+#: defrag: protecting a slice-wide gang box outranks node-local
+#: packing niceties when both disagree).
+SERVING_TOPOLOGY_WEIGHT = 3.0
+
+
 def resource_limits(pod: t.Pod, info: NodeInfo, want=None) -> float:
     """Score nodes able to satisfy the pod's LIMITS (not just requests)
     — burstable pods land where their ceiling actually fits.

@@ -641,6 +641,9 @@ class Scheduler:
         sibling_counts = self._sibling_counts(pod)
         scores = prioritize(pod, feasible, sibling_counts, chip_choices,
                             weights=self._priority_weights)
+        if chip_choices and self._serving_topology_active(pod):
+            self._add_serving_topology_scores(feasible, chip_choices,
+                                              scores)
         if (affinity_ctx is not None and affinity_ctx.preferred
                 and aff_weight > 0):
             # Normalize to the same 0..MAX_SCORE band as the other
@@ -719,6 +722,52 @@ class Scheduler:
             return {}
         return {info.node.metadata.name: info.owner_counts.get(ref.uid, 0)
                 for info in self.cache.nodes.values() if info.node is not None}
+
+    @staticmethod
+    def _serving_topology_active(pod: t.Pod) -> bool:
+        """Gated serving anti-fragmentation scoring applies only to
+        pods carrying the serving label — one dict lookup before the
+        gate check, so non-serving scheduling pays nothing either way
+        (gate off = legacy placement byte-identical)."""
+        from ..api.serving import SERVICE_LABEL
+        if not pod.metadata.labels.get(SERVICE_LABEL):
+            return False
+        from ..util.features import GATES
+        return GATES.enabled("ServingTopologyAware")
+
+    def _add_serving_topology_scores(self, feasible, chip_choices,
+                                     scores) -> None:
+        """Add the slice-level anti-fragmentation term for a serving
+        pod: prefer the node whose chip claim least shrinks its slice's
+        largest free contiguous box (priorities.serving_topology_score).
+        The before-volume is memoized per slice for this pass."""
+        from .priorities import (SERVING_TOPOLOGY_WEIGHT,
+                                 serving_topology_score)
+        from .submesh import largest_free_box_volume
+        before_by_slice: dict[str, int] = {}
+        free_by_slice: dict[str, dict] = {}
+        for info in feasible:
+            node = info.node
+            name = node.metadata.name
+            chosen = chip_choices.get(name)
+            topo = node.status.tpu
+            if not chosen or topo is None or not topo.slice_id:
+                continue
+            sl = self.cache.slices.get(topo.slice_id)
+            if sl is None or not sl.mesh_shape:
+                continue
+            sid = topo.slice_id
+            if sid not in free_by_slice:
+                free_by_slice[sid] = sl.free(self.cache)
+                before_by_slice[sid] = largest_free_box_volume(
+                    set(free_by_slice[sid]), sl.mesh_shape)
+            slice_free = free_by_slice[sid]
+            by_id = {cid: coord for coord, (n, cid) in slice_free.items()
+                     if n == name}
+            cells = [by_id[cid] for cid in chosen if cid in by_id]
+            scores[name] += SERVING_TOPOLOGY_WEIGHT * \
+                serving_topology_score(set(slice_free), sl.mesh_shape,
+                                       cells, before_by_slice[sid])
 
     async def _handle_unschedulable(self, pod: t.Pod, reasons: list[str]) -> None:
         brief = "; ".join(reasons[:3]) or "no nodes available"

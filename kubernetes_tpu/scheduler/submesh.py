@@ -353,6 +353,43 @@ def shape_for_count(count: int, mesh: Sequence[int]) -> Optional[tuple[int, ...]
     return best
 
 
+def largest_free_box_volume(free: set[Coord], mesh: Sequence[int],
+                            torus: bool = True) -> int:
+    """Volume of the largest axis-aligned box of free cells in the mesh
+    — the "how big a gang could this slice still host?" number the
+    serving-placement score protects.
+
+    Scans candidate shapes in descending volume over the same windowed
+    box-sum machinery as :func:`_find_box_numpy`; the shape space is
+    ``prod(mesh)`` candidates (e.g. 64 for a 4x4x4 slice), each checked
+    in O(cells), so the cost is small at the slice sizes placement
+    scoring touches (and callers memoize per scheduling pass anyway).
+    """
+    if not free:
+        return 0
+    mesh_t = tuple(int(m) for m in mesh)
+    rank = len(mesh_t)
+    mask = np.zeros(mesh_t, dtype=np.uint8)
+    for c in free:
+        mask[c] = 1
+    tiled = np.tile(mask, (2,) * rank) if torus else mask
+    core = tuple(slice(0, m) for m in mesh_t)
+    shapes = sorted(
+        itertools.product(*(range(1, m + 1) for m in mesh_t)),
+        key=lambda sh: (-int(np.prod(sh)), sh))
+    upper = len(free)
+    for shape in shapes:
+        vol = int(np.prod(shape))
+        if vol > upper:
+            continue
+        sums = _windowed_sums(tiled, shape)
+        if torus:
+            sums = sums[core]
+        if bool((sums == vol).any()):
+            return vol
+    return 1  # free is non-empty: a 1-cell box always exists
+
+
 def find_box_containing(available: set[Coord], mesh: Sequence[int],
                         shape: Sequence[int], required: Iterable[Coord],
                         torus: bool = True) -> Optional[list[Coord]]:

@@ -1,0 +1,17 @@
+"""Inference serving — the user-traffic half of the fleet.
+
+Pieces (ISSUE 11 / ROADMAP item 2):
+
+- :mod:`.autoscaler` — the pure HPA-analog decision engine the
+  inference controller runs each tick over ``ClusterMonitor.latest()``
+  rollups (tokens/s + busy fraction), with stabilization windows,
+  per-step rate limits, and an explicit staleness refusal.
+- :mod:`.router` — slice-topology-aware endpoint selection over the
+  same Endpoints/Nodes/Pods informers the proxy uses: the client-side
+  load balancer the serving loadgen (``perf/serving_bench.py``) and
+  any in-cluster gateway balance requests with.
+
+The API type lives in :mod:`kubernetes_tpu.api.serving`; the
+reconciler in :mod:`kubernetes_tpu.controllers.inference`; the stub
+token-generating server in :mod:`kubernetes_tpu.workloads.model_server`.
+"""

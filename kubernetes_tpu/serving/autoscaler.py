@@ -1,0 +1,199 @@
+"""HPA-analog autoscaling decisions for inference serving.
+
+Pure functions + a small per-service state record, in the
+``queueing/fairshare.py`` style: the controller feeds one
+:class:`MetricsSample` per tick (derived from ``ClusterMonitor.
+latest()``) and gets a :class:`Decision` back — no API objects, no
+I/O, so the scale-up → stabilize → scale-down choreography is
+unit-testable over a synthetic feed.
+
+The control law (reference: ``replica_calculator.go`` shape, adapted
+to serving):
+
+    desired = ceil(reporting * utilization / target_utilization)
+              [+ ready-but-not-reporting replicas when scaling down]
+
+where ``utilization`` is the mean busy fraction the model servers
+report (the fraction of wall time spent decoding — saturating at 1.0,
+which is why the target defaults to 0.65: headroom IS the scale-up
+signal), and ready replicas missing from the snapshot fold in
+conservatively (idle on the way up, at-target on the way down — see
+:func:`recommend`). Guards, in order:
+
+- **staleness**: a snapshot older than ``max_snapshot_age`` REFUSES to
+  act (the satellite contract for ``ClusterMonitor.latest()``'s
+  ``age_seconds`` field — frozen numbers must not drive scaling);
+- **tolerance** (±0.1 around target): no thrash inside the band;
+- **rate limits**: at most ``scale_up_max_step`` replicas added /
+  ``scale_down_max_step`` removed per decision;
+- **scale-down stabilization**: shrink only to the HIGHEST
+  recommendation seen inside the window (the reference's
+  downscale-stabilization), so a burst's trough does not collapse the
+  fleet the moment traffic dips;
+- clamp to ``[min_replicas, max_replicas]``.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..metrics.registry import Counter, Gauge
+
+#: Dead band around the utilization target (reference:
+#: --horizontal-pod-autoscaler-tolerance).
+TOLERANCE = 0.1
+
+#: Defaults for spec fields left 0 (the admission defaulter fills them
+#: for gated creates; these cover direct engine use and synthetics).
+DEFAULT_SCALE_UP_STEP = 4
+DEFAULT_SCALE_DOWN_STEP = 1
+
+DESIRED = Gauge(
+    "inference_autoscaler_desired_replicas",
+    "Autoscaler's current replica target per InferenceService",
+    labels=("service",))
+
+UTILIZATION = Gauge(
+    "inference_autoscaler_utilization",
+    "Mean busy fraction observed across a service's replicas (0..1)",
+    labels=("service",))
+
+SNAPSHOT_AGE = Gauge(
+    "inference_autoscaler_snapshot_age_seconds",
+    "Age of the ClusterMonitor snapshot behind the last decision",
+    labels=("service",))
+
+SCALE_EVENTS = Counter(
+    "inference_autoscaler_scale_events_total",
+    "Replica-target changes by direction",
+    labels=("service", "direction"))
+
+STALE_REFUSALS = Counter(
+    "inference_autoscaler_stale_refusals_total",
+    "Decisions refused because the metrics snapshot was stale",
+    labels=("service",))
+
+
+@dataclass
+class MetricsSample:
+    """One tick's observation of a service, derived from the monitor
+    snapshot by the controller (or synthesized by tests)."""
+    #: Mean busy fraction across replicas that reported (0..1).
+    utilization: float = 0.0
+    #: Aggregate decode throughput across replicas (tokens/s).
+    tokens_per_sec: float = 0.0
+    #: Replicas that actually reported metrics this tick.
+    reporting: int = 0
+    #: Snapshot age (ClusterMonitor.latest()["age_seconds"]).
+    age_seconds: float = 0.0
+
+
+@dataclass
+class Decision:
+    desired: int
+    reason: str
+    #: True when the engine refused to act (stale feed / no data):
+    #: ``desired`` then just echoes the current target.
+    refused: bool = False
+
+
+@dataclass
+class ServiceState:
+    """Per-service memory between ticks (controller-held; rebuilt from
+    scratch on controller restart — the stabilization window then
+    restarts too, which only ever delays a scale-down)."""
+    #: (monotonic time, recommendation) pairs inside the window.
+    recommendations: list[tuple[float, int]] = field(default_factory=list)
+    last_desired: int = 0
+
+
+def recommend(current: int, ready: int, sample: MetricsSample,
+              target_utilization: float) -> tuple[int, str]:
+    """The raw control law, before guards: what replica count would put
+    mean utilization at target? Ready replicas MISSING from the metrics
+    snapshot (scrape lag after a scale-up) fold in conservatively, the
+    reference replica_calculator move: assumed idle when scaling up (so
+    they cannot amplify the answer) and assumed at-target when scaling
+    down (so a fleet whose load is simply unknown never shrinks on one
+    idle reporter's word)."""
+    target = min(max(target_utilization, 0.05), 1.0)
+    if ready <= 0 or sample.reporting <= 0:
+        return current, "no replicas reporting"
+    util = max(sample.utilization, 0.0)
+    ratio = util / target
+    missing = max(ready - sample.reporting, 0)
+    if abs(ratio - 1.0) <= TOLERANCE:
+        return current, f"within tolerance (util {util:.2f})"
+    if ratio > 1.0:
+        # Missing replicas at 0 load: ceil(reporting * ratio) IS that
+        # fold. Capacity already launching (current > ready) counts —
+        # do not re-order what is already on the way.
+        raw = max(math.ceil(sample.reporting * ratio), current)
+    else:
+        # Missing replicas at target: each holds its own seat.
+        raw = math.ceil(sample.reporting * ratio) + missing
+    return raw, f"util {util:.2f} vs target {target:.2f}"
+
+
+def decide(spec, current: int, ready: int, sample: Optional[MetricsSample],
+           state: ServiceState, now: float,
+           max_snapshot_age: float = 30.0) -> Decision:
+    """One autoscaler tick. ``spec`` is an InferenceServiceSpec (or any
+    object with its scaling fields); ``current`` the present replica
+    target; ``ready`` the replicas actually serving; ``now`` a
+    monotonic clock (injected — the engine never reads time itself).
+    """
+    lo = max(spec.min_replicas, 0) or 1
+    hi = max(spec.max_replicas, lo)
+    if sample is None or sample.age_seconds > max_snapshot_age:
+        # Refusal, not a decision: frozen numbers must not scale the
+        # fleet (and must not age out the stabilization window either,
+        # so no recommendation is recorded).
+        age = sample.age_seconds if sample is not None else float("inf")
+        return Decision(desired=min(max(current, lo), hi), refused=True,
+                        reason=f"metrics snapshot stale "
+                               f"({age:.1f}s > {max_snapshot_age:.0f}s)")
+    raw, why = recommend(current, ready, sample, spec.target_utilization)
+    raw = min(max(raw, lo), hi)
+
+    # Scale-down stabilization: remember this recommendation, then only
+    # shrink to the window's MAXIMUM.
+    window = max(spec.scale_down_stabilization_seconds, 0.0)
+    state.recommendations.append((now, raw))
+    state.recommendations = [(t, r) for t, r in state.recommendations
+                             if now - t <= window]
+    floor = max((r for _t, r in state.recommendations), default=raw)
+
+    desired = raw
+    if desired < current:
+        desired = min(current, floor)
+        if desired > raw:
+            why += f"; held by stabilization window ({window:.0f}s)"
+
+    up_step = spec.scale_up_max_step or DEFAULT_SCALE_UP_STEP
+    down_step = spec.scale_down_max_step or DEFAULT_SCALE_DOWN_STEP
+    if desired > current + up_step:
+        desired = current + up_step
+        why += f"; rate-limited to +{up_step}"
+    elif desired < current - down_step:
+        desired = current - down_step
+        why += f"; rate-limited to -{down_step}"
+    desired = min(max(desired, lo), hi)
+    return Decision(desired=desired, reason=why)
+
+
+def export_metrics(service: str, decision: Decision,
+                   sample: Optional[MetricsSample], current: int) -> None:
+    """Publish the ``inference_autoscaler_*`` family for one tick."""
+    DESIRED.set(float(decision.desired), service=service)
+    if sample is not None:
+        UTILIZATION.set(round(sample.utilization, 4), service=service)
+        if math.isfinite(sample.age_seconds):
+            SNAPSHOT_AGE.set(round(sample.age_seconds, 3), service=service)
+    if decision.refused:
+        STALE_REFUSALS.inc(service=service)
+    elif decision.desired > current:
+        SCALE_EVENTS.inc(service=service, direction="up")
+    elif decision.desired < current:
+        SCALE_EVENTS.inc(service=service, direction="down")

@@ -1,0 +1,201 @@
+"""Slice-topology-aware endpoint routing for inference traffic.
+
+The client-side load balancer the serving loadgen (and any gateway
+embedding this framework) balances requests with — the consumer of the
+same Endpoints informer the per-node proxy programs forwarders from,
+plus the Nodes/Pods informers that give each endpoint a topology
+context.
+
+Preference order (``ServingTopologyAware`` gate):
+
+1. **same-slice consolidation** — endpoints in the slice already
+   hosting the most replicas of this service come first (requests
+   concentrate where the service is packed, which keeps OTHER slices'
+   contiguous boxes cold and reclaimable);
+2. **least-fragmented node** — within a slice, endpoints on nodes with
+   the fewest free chips first (traffic prefers replicas that are not
+   squatting on gang-usable space, so a defrag/scale-down naturally
+   drains the expensive ones);
+3. name, for determinism.
+
+Dispatch is least-outstanding with preference tiebreak: at low load
+the preferred endpoints carry everything; as load grows requests spill
+down the order instead of queueing. With the gate off the order is
+plain sorted names and dispatch is the same least-outstanding loop —
+the legacy client-side balance, byte-identical in behavior.
+"""
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+from typing import Optional
+
+from ..api import types as t
+from ..client.informer import InformerFactory, SharedInformer
+from ..metrics.registry import Counter, Gauge
+
+log = logging.getLogger("serving-router")
+
+ROUTER_ENDPOINTS = Gauge(
+    "serving_router_endpoints",
+    "Ready endpoints the router currently balances across",
+    labels=("service",))
+
+ROUTER_PICKS = Counter(
+    "serving_router_picks_total",
+    "Requests dispatched, by preference tier (0 = most preferred)",
+    labels=("service", "tier"))
+
+
+@dataclass(frozen=True)
+class Endpoint:
+    ip: str
+    port: int
+    pod: str = ""
+    node: str = ""
+    slice_id: str = ""
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.ip}:{self.port}"
+
+
+class TopologyRouter:
+    """One router per (namespace, service). ``start()`` spins shared
+    informers (or rides a caller-provided factory); ``pick``/``done``
+    bracket each request."""
+
+    def __init__(self, client, service: str, namespace: str = "default",
+                 factory: Optional[InformerFactory] = None):
+        self.service = service
+        self.namespace = namespace
+        self._own_factory = factory is None
+        self.factory = factory or InformerFactory(client)
+        self.endpoints: Optional[SharedInformer] = None
+        self.nodes: Optional[SharedInformer] = None
+        self.pods: Optional[SharedInformer] = None
+        #: endpoint -> outstanding request count (caller-maintained
+        #: via pick/done).
+        self._outstanding: dict[Endpoint, int] = {}
+        #: Cached preference order, rebuilt on informer events — the
+        #: per-request pick() must not re-walk endpoints x pods.
+        self._order: Optional[list[Endpoint]] = None
+        self._wired = False
+
+    async def start(self) -> None:
+        self.endpoints = self.factory.informer("endpoints")
+        self.nodes = self.factory.informer("nodes")
+        self.pods = self.factory.informer("pods")
+        for inf in (self.endpoints, self.nodes, self.pods):
+            inf.add_handlers(
+                on_add=lambda _o: self._invalidate(),
+                on_update=lambda _o, _n: self._invalidate(),
+                on_delete=lambda _o: self._invalidate())
+        self._wired = True
+        self.factory.start_all()
+        for inf in (self.endpoints, self.nodes, self.pods):
+            await inf.wait_for_sync()
+
+    def _invalidate(self) -> None:
+        self._order = None
+
+    async def stop(self) -> None:
+        if self._own_factory:
+            await self.factory.stop_all()
+
+    # -- topology model ---------------------------------------------------
+
+    @staticmethod
+    def _gated() -> bool:
+        from ..util.features import GATES
+        return GATES.enabled("ServingTopologyAware")
+
+    def _node_slice(self, node_name: str) -> str:
+        node = self.nodes.get(node_name) if self.nodes else None
+        topo = node.status.tpu if node is not None else None
+        return topo.slice_id if topo is not None else ""
+
+    def _free_chips_by_node(self, nodes: set[str]) -> dict[str, int]:
+        """ONE pod-informer pass for every node of interest (per
+        ranking rebuild, never per node or per request)."""
+        used: dict[str, int] = {}
+        for p in self.pods.list() if self.pods else []:
+            n = p.spec.node_name
+            if n in nodes and t.is_pod_active(p):
+                used[n] = used.get(n, 0) + sum(
+                    r.chip_count() for r in p.spec.tpu_resources)
+        out = {}
+        for n in nodes:
+            node = self.nodes.get(n) if self.nodes else None
+            if node is None:
+                out[n] = 0
+                continue
+            cap = int(node.status.allocatable.get(t.RESOURCE_TPU, 0)
+                      or node.status.capacity.get(t.RESOURCE_TPU, 0))
+            out[n] = max(cap - used.get(n, 0), 0)
+        return out
+
+    def ranked(self) -> list[Endpoint]:
+        """Current ready endpoints in preference order (the unit-tested
+        core; pick() reads the event-invalidated cache of this)."""
+        ep = self.endpoints.get(f"{self.namespace}/{self.service}") \
+            if self.endpoints else None
+        if ep is None:
+            return []
+        port = next((p.port for subset in ep.subsets
+                     for p in subset.ports), 0)
+        out = []
+        for subset in ep.subsets:
+            for a in subset.addresses:
+                if not a.ip:
+                    continue
+                pod_name = (a.target_ref.name if a.target_ref is not None
+                            else a.hostname)
+                out.append(Endpoint(
+                    ip=a.ip, port=port, pod=pod_name, node=a.node_name,
+                    slice_id=self._node_slice(a.node_name)))
+        if not self._gated():
+            out.sort(key=lambda e: (e.pod, e.ip))
+            return out
+        by_slice: dict[str, int] = {}
+        for e in out:
+            by_slice[e.slice_id] = by_slice.get(e.slice_id, 0) + 1
+        free = self._free_chips_by_node({e.node for e in out if e.node})
+        out.sort(key=lambda e: (
+            -by_slice.get(e.slice_id, 0),   # consolidated slice first
+            e.slice_id,                     # stable among equals
+            free.get(e.node, 0),            # least-fragmented node
+            e.pod, e.ip))
+        return out
+
+    # -- dispatch ---------------------------------------------------------
+
+    def pick(self) -> Optional[Endpoint]:
+        """Least-outstanding endpoint, preference order breaking ties.
+        Callers MUST pair with :meth:`done` when the request finishes.
+        The ranking is cached and invalidated by informer events; an
+        unwired router (tests injecting fake informers) re-ranks every
+        time."""
+        if self._order is None or not self._wired:
+            self._order = self.ranked()
+        order = self._order
+        ROUTER_ENDPOINTS.set(float(len(order)), service=self.service)
+        if not order:
+            return None
+        live = set(order)
+        for e in list(self._outstanding):
+            if e not in live and self._outstanding[e] <= 0:
+                del self._outstanding[e]  # departed replica
+        best_i, best = min(
+            enumerate(order),
+            key=lambda pair: (self._outstanding.get(pair[1], 0), pair[0]))
+        self._outstanding[best] = self._outstanding.get(best, 0) + 1
+        ROUTER_PICKS.inc(service=self.service, tier=str(best_i))
+        return best
+
+    def done(self, endpoint: Endpoint) -> None:
+        n = self._outstanding.get(endpoint, 0)
+        if n <= 1:
+            self._outstanding.pop(endpoint, None)
+        else:
+            self._outstanding[endpoint] = n - 1

@@ -105,6 +105,21 @@ KNOWN_FEATURES = {f.name: f for f in [
             "shrink to spec.min_replicas under reclaim instead of "
             "dying. Off = every eviction path is the legacy hard "
             "kill, byte-identical"),
+    Feature("InferenceAutoscaling", False, ALPHA,
+            "autoscaled inference serving (serving/v1 InferenceService, "
+            "controllers/inference.py): reconcile model-server pods via "
+            "a headless Service + Deployment, and an HPA-analog loop "
+            "scaling replicas on ClusterMonitor.latest() rollups with "
+            "stabilization windows and rate limits; warm-pool image "
+            "pre-pull ahead of the first scale-up. Off = the controller "
+            "and the admission defaulter are inert, byte-identical"),
+    Feature("ServingTopologyAware", False, ALPHA,
+            "slice-topology-aware serving placement/routing: the "
+            "scheduler scores serving-labeled pods by how little their "
+            "chip claim shrinks the slice's largest free contiguous "
+            "box (large training gangs keep their sub-meshes), and the "
+            "endpoint router prefers same-slice/least-fragmented "
+            "replicas. Off = legacy placement, byte-identical"),
     Feature("ClusterMonitoring", True, BETA,
             "cluster-level TPU telemetry rollup (monitoring/"
             "aggregator.py): the controller-manager scrapes node "

@@ -42,3 +42,49 @@ def spawn(coro: Coroutine, name: Optional[str] = None,
 
     task.add_done_callback(_done)
     return task
+
+
+async def cancel_task(task: Optional[asyncio.Task], grace: float = 30.0,
+                      name: str = "") -> bool:
+    """Cancel ``task`` and wait for it to actually finish, bounded by a
+    real deadline. Returns True when the task ended inside ``grace``.
+
+    A single ``task.cancel()`` + ``await task`` is NOT enough on
+    CPython ≤3.11: ``asyncio.wait_for`` swallows a cancellation that
+    lands in the same window its watched future completes (CPython
+    GH-86296). Concretely: cancelling a controller-manager mid-startup
+    while it sits in ``informer.wait_for_sync()`` — ``wait_for`` around
+    an Event — eats the CancelledError when the sync fires, and the
+    manager sails on to its run-forever wait with the cancellation
+    consumed; the plain await then hangs until someone cancels again.
+    That was the LocalCluster.stop() "~2min teardown drain" e2e smokes
+    used to dodge by composing components manually. This helper
+    re-cancels on a short tick until the task is genuinely done, so
+    teardown is bounded by ``grace`` instead of by luck.
+    """
+    if task is None or task.done():
+        return True
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + grace
+    task.cancel()
+    while True:
+        try:
+            await asyncio.wait_for(asyncio.shield(task), 0.5)
+            return True
+        except asyncio.CancelledError:
+            if task.done():
+                return True
+            raise  # the CALLER was cancelled; don't absorb it
+        except asyncio.TimeoutError:
+            if loop.time() >= deadline:
+                log.error("task %r still running %0.0fs after cancel; "
+                          "abandoning the wait (teardown stays bounded)",
+                          name or task.get_name(), grace)
+                return False
+            # A swallowed cancellation (GH-86296) leaves the task
+            # healthy and uncancelled: ask again.
+            task.cancel()
+        except Exception:  # noqa: BLE001 — the task's own crash
+            log.exception("task %r raised during cancellation",
+                          name or task.get_name())
+            return True

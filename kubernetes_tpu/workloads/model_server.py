@@ -1,0 +1,313 @@
+"""Stub token-generating model server — the serving workload.
+
+The inference half of the fleet needs a model server the way training
+needed ``lm.train``: a pod that behaves like a production decode
+worker without burning real chips. This one simulates autoregressive
+decode honestly enough for the serving bench to measure real queueing:
+
+- **one decode slot** (an asyncio lock): a replica serves one request
+  at a time, like a single-model single-batch decode loop — extra
+  concurrent requests QUEUE, which is where the p99 and the autoscaler
+  signal come from;
+- per-request service time = prefill (``prompt_tokens`` at 8x decode
+  speed) + decode (``max_tokens`` at ``--rated-tokens-per-sec``);
+- the metrics pipeline's live half: every second the server writes the
+  ``training-metrics.json`` report (the file contract the node agent
+  ingests into ``/stats/summary`` — see workloads/metrics_reporter.py)
+  with actual ``tokens_per_sec``, busy fraction in the ``mfu`` slot,
+  and rolling mean request latency as ``step_time_ms``. The cluster
+  monitor rolls those up; the inference autoscaler scales on them.
+  (The report is written directly, not through TrainingMetricsReporter
+  — that helper probes jax device memory, and a serving stub must not
+  pay a multi-second jax import per replica start.)
+
+HTTP surface (binds the pod IP from ``$POD_IP``):
+
+- ``POST /v1/generate`` ``{"prompt_tokens": N, "max_tokens": M}`` →
+  ``{"tokens": M, "queue_ms": ..., "decode_ms": ...}``;
+- ``GET /healthz`` — readiness (the Deployment template's probe);
+- ``GET /stats`` — the live counters, for debugging.
+
+Tracing: with ``KTPU_TRACE`` armed, a request carrying a
+``traceparent`` header gets a ``serve`` span (queue/decode events);
+``KTPU_TRACE_INGEST=<url>`` spools finished spans to the apiserver's
+``/debug/v1/traces`` so per-request breakdowns reconstruct centrally.
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import os
+import time
+from collections import deque
+from typing import Optional
+
+log = logging.getLogger("model-server")
+
+#: Prefill runs this many times faster than decode (tokens/s) — the
+#: usual order of magnitude between batched prefill and serial decode.
+PREFILL_SPEEDUP = 8.0
+
+REPORT_INTERVAL = 1.0
+
+
+class DecodeEngine:
+    """The simulated chip: one decode at a time, busy-time accounted."""
+
+    def __init__(self, rated_tokens_per_sec: float):
+        self.rated = max(rated_tokens_per_sec, 1.0)
+        self._slot = asyncio.Lock()
+        self.busy_seconds = 0.0
+        self.tokens_out = 0
+        self.requests = 0
+        self.latencies: deque[float] = deque(maxlen=256)
+
+    async def generate(self, prompt_tokens: int, max_tokens: int,
+                       span=None) -> dict:
+        t0 = time.perf_counter()
+        async with self._slot:
+            queued = time.perf_counter() - t0
+            if span is not None:
+                span.event(f"queue_wait {queued * 1e3:.1f}ms")
+            service = (prompt_tokens / (self.rated * PREFILL_SPEEDUP)
+                       + max_tokens / self.rated)
+            t1 = time.perf_counter()
+            await asyncio.sleep(service)
+            decode = time.perf_counter() - t1
+            self.busy_seconds += decode
+            self.tokens_out += max_tokens
+            self.requests += 1
+        total = time.perf_counter() - t0
+        self.latencies.append(total)
+        if span is not None:
+            span.event(f"decode {decode * 1e3:.1f}ms")
+        return {"tokens": max_tokens,
+                "queue_ms": round(queued * 1e3, 2),
+                "decode_ms": round(decode * 1e3, 2),
+                "total_ms": round(total * 1e3, 2)}
+
+
+class ModelServer:
+    def __init__(self, model: str, port: int, rated_tokens_per_sec: float,
+                 host: str = ""):
+        self.model = model
+        self.port = port
+        self.host = host or os.environ.get("POD_IP", "127.0.0.1")
+        self.engine = DecodeEngine(rated_tokens_per_sec)
+        self.step = 0
+        self._runner = None
+        self._report_task: Optional[asyncio.Task] = None
+        self._spool_task: Optional[asyncio.Task] = None
+        self._sent_spans: set[str] = set()
+        self._draining = False
+        self._inflight = 0
+        self._idle = asyncio.Event()
+        #: Window accumulators for the 1s report.
+        self._win_t0 = time.monotonic()
+        self._win_busy0 = 0.0
+        self._win_tokens0 = 0
+
+    # -- HTTP -------------------------------------------------------------
+
+    async def _handle_generate(self, request):
+        from aiohttp import web
+        from .. import tracing
+        try:
+            body = await request.json()
+            prompt = int(body.get("prompt_tokens", 128))
+            max_tokens = int(body.get("max_tokens", 64))
+        except Exception:  # noqa: BLE001 — bad body OR non-numeric
+            return web.json_response({"error": "bad request body"},
+                                     status=400)
+        if prompt < 0 or max_tokens <= 0 or max_tokens > 65536:
+            return web.json_response({"error": "bad token counts"},
+                                     status=400)
+        span = None
+        if tracing.armed():
+            ctx = tracing.decode(request.headers.get("traceparent"))
+            if ctx is not None:
+                span = tracing.start_span(
+                    "serve", component="model-server", parent=ctx,
+                    attrs={"model": self.model})
+        self._inflight += 1
+        self._idle.clear()
+        try:
+            out = await self.engine.generate(prompt, max_tokens, span)
+        finally:
+            self._inflight -= 1
+            if self._inflight == 0:
+                self._idle.set()
+            if span is not None:
+                span.end()
+        out["model"] = self.model
+        return web.json_response(out)
+
+    async def _handle_healthz(self, request):
+        from aiohttp import web
+        if self._draining:
+            # Readiness fails first: endpoints drop this replica while
+            # in-flight requests still complete (graceful scale-down —
+            # a killed replica must not turn its tail into errors).
+            return web.json_response({"ok": False, "draining": True},
+                                     status=503)
+        return web.json_response({"ok": True, "model": self.model})
+
+    async def _handle_stats(self, request):
+        from aiohttp import web
+        e = self.engine
+        return web.json_response({
+            "model": self.model, "requests": e.requests,
+            "tokens_out": e.tokens_out,
+            "busy_seconds": round(e.busy_seconds, 3)})
+
+    # -- metrics report (the /stats/summary feed) -------------------------
+
+    def _write_report(self) -> None:
+        sandbox = os.environ.get("KTPU_SANDBOX", "")
+        if not sandbox:
+            return
+        from .metrics_reporter import REPORT_BASENAME
+        now = time.monotonic()
+        window = max(now - self._win_t0, 1e-6)
+        busy = self.engine.busy_seconds - self._win_busy0
+        tokens = self.engine.tokens_out - self._win_tokens0
+        lats = list(self.engine.latencies)
+        self.step += 1
+        rec = {
+            "step": self.step,
+            "step_time_ms": round(
+                sum(lats) / len(lats) * 1e3, 2) if lats else 0.0,
+            "tokens_per_sec": round(tokens / window, 1),
+            # The generic utilization slot: busy fraction of the decode
+            # slot over the window (the autoscaler's primary signal).
+            "mfu": round(min(busy / window, 1.0), 4),
+            "timestamp": time.time(),
+        }
+        self._win_t0, self._win_busy0 = now, self.engine.busy_seconds
+        self._win_tokens0 = self.engine.tokens_out
+        path = os.path.join(sandbox, REPORT_BASENAME)
+        try:
+            tmp = f"{path}.tmp"
+            with open(tmp, "w") as f:
+                json.dump(rec, f)
+            os.replace(tmp, path)
+        except OSError as e:
+            log.warning("metrics report write failed: %s", e)
+
+    async def _report_loop(self) -> None:
+        while True:
+            await asyncio.sleep(REPORT_INTERVAL)
+            self._write_report()
+
+    # -- trace spool ------------------------------------------------------
+
+    async def _spool_loop(self, ingest_url: str) -> None:
+        import aiohttp
+        from .. import tracing
+        async with aiohttp.ClientSession() as session:
+            while True:
+                await asyncio.sleep(2.0)
+                spans = [s for s in tracing.COLLECTOR.snapshot()
+                         if s.get("span_id") not in self._sent_spans]
+                if not spans:
+                    continue
+                try:
+                    async with session.post(
+                            ingest_url, json={"spans": spans},
+                            timeout=aiohttp.ClientTimeout(total=3)) as r:
+                        if r.status == 200:
+                            self._sent_spans.update(
+                                s["span_id"] for s in spans)
+                            if len(self._sent_spans) > 65536:
+                                self._sent_spans.clear()
+                except Exception as e:  # noqa: BLE001 — telemetry push
+                    log.debug("trace spool failed: %s", e)
+
+    # -- lifecycle --------------------------------------------------------
+
+    async def start(self) -> int:
+        from aiohttp import web
+        app = web.Application()
+        app.router.add_post("/v1/generate", self._handle_generate)
+        app.router.add_get("/healthz", self._handle_healthz)
+        app.router.add_get("/stats", self._handle_stats)
+        self._runner = web.AppRunner(app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        self._report_task = asyncio.get_running_loop().create_task(
+            self._report_loop())
+        ingest = os.environ.get("KTPU_TRACE_INGEST", "")
+        from .. import tracing
+        if ingest and tracing.armed():
+            self._spool_task = asyncio.get_running_loop().create_task(
+                self._spool_loop(ingest))
+        self._write_report()  # first report: replicas count as
+        log.info("model server %r on %s:%d (rated %.0f tok/s)",  # live
+                 self.model, self.host, self.port, self.engine.rated)
+        return self.port
+
+    async def drain(self, timeout: float = 25.0) -> None:
+        """Graceful shutdown half 1 (SIGTERM handler): fail readiness
+        so endpoints drop this replica, then wait for in-flight decode
+        to finish (bounded — the pod's grace period is the real
+        ceiling)."""
+        self._draining = True
+        if self._inflight > 0:
+            self._idle.clear()
+            try:
+                await asyncio.wait_for(self._idle.wait(), timeout)
+            except asyncio.TimeoutError:
+                log.warning("drain timeout with %d in flight",
+                            self._inflight)
+
+    async def stop(self) -> None:
+        for task in (self._report_task, self._spool_task):
+            if task is not None:
+                task.cancel()
+                try:
+                    await task
+                except asyncio.CancelledError:
+                    pass
+        if self._runner is not None:
+            await self._runner.cleanup()
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description="stub model server")
+    parser.add_argument("--model", required=True)
+    parser.add_argument("--port", type=int, default=8100)
+    parser.add_argument("--host", default="")
+    parser.add_argument("--rated-tokens-per-sec", type=float, default=256.0)
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    async def run():
+        import signal
+        server = ModelServer(args.model, args.port,
+                             args.rated_tokens_per_sec, host=args.host)
+        await server.start()
+        done = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        # Graceful scale-down: SIGTERM (the runtime's stop signal)
+        # drains — readiness fails, in-flight requests complete, THEN
+        # the process exits; a reaped replica's tail never becomes
+        # client-visible errors.
+        loop.add_signal_handler(signal.SIGTERM, done.set)
+        try:
+            await done.wait()
+            await server.drain()
+        finally:
+            await server.stop()
+
+    try:
+        asyncio.run(run())
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

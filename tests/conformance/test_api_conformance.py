@@ -57,6 +57,8 @@ def minimal_object(spec) -> object:
         obj.spec.min_available = 0
     if spec.kind == "LocalQueue":
         obj.spec.cluster_queue = "conf-cq"
+    if spec.kind == "InferenceService":
+        obj.spec.model = "conf-model"
     if spec.kind == "PersistentVolume":
         obj.spec.capacity = {"storage": "1Gi"}
         obj.spec.host_path = t.HostPathVolume(path="/tmp/conf-pv")

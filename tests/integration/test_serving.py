@@ -1,0 +1,457 @@
+"""serving/v1 over the in-process control plane.
+
+Acceptance scenarios for ISSUE 11: the reconcile chain
+(InferenceService -> headless Service + Deployment -> pods), gate-off
+byte-identity (no new API traffic at all), the autoscaler
+scale-up -> stabilize -> scale-down loop over a synthetic metrics
+feed, warm-pool image prepull, and the topology-placement guarantee —
+serving replicas must not fragment a contiguous sub-mesh a concurrent
+gang needs (and with the gate off, placement is byte-identical to
+unlabeled pods).
+"""
+import asyncio
+
+import pytest
+
+from kubernetes_tpu.api import errors, serving as s, types as t
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.apiserver.admission import default_chain
+from kubernetes_tpu.apiserver.registry import Registry
+from kubernetes_tpu.client.informer import InformerFactory
+from kubernetes_tpu.client.local import LocalClient
+from kubernetes_tpu.controllers.deployment import DeploymentController
+from kubernetes_tpu.controllers.inference import InferenceServiceController
+from kubernetes_tpu.controllers.replicaset import ReplicaSetController
+from kubernetes_tpu.scheduler.scheduler import Scheduler
+from kubernetes_tpu.serving import autoscaler as eng
+from kubernetes_tpu.util.features import GATES
+
+
+@pytest.fixture
+def gate_on():
+    was = GATES.enabled("InferenceAutoscaling")
+    GATES.set("InferenceAutoscaling", True)
+    yield
+    GATES.set("InferenceAutoscaling", was)
+
+
+@pytest.fixture
+def topo_on():
+    was = GATES.enabled("ServingTopologyAware")
+    GATES.set("ServingTopologyAware", True)
+    yield
+    GATES.set("ServingTopologyAware", was)
+
+
+def _registry() -> Registry:
+    reg = Registry()
+    reg.admission = default_chain(reg)
+    reg.create(t.Namespace(metadata=ObjectMeta(name="default")))
+    return reg
+
+
+def _add_node(reg, name, chips=4, slice_id="", mesh=(2, 2, 1),
+              coords=None):
+    """One TPU node; by default its own single-host slice (the
+    LocalCluster shape)."""
+    node = t.Node(metadata=ObjectMeta(name=name))
+    node.status.capacity = {"cpu": 16.0, "memory": 64 * 2**30,
+                            "pods": 110.0, t.RESOURCE_TPU: float(chips)}
+    node.status.allocatable = dict(node.status.capacity)
+    node.status.conditions = [t.NodeCondition(type=t.NODE_READY,
+                                              status="True")]
+    own = coords if coords is not None else [
+        (x, y, z) for z in range(mesh[2]) for x in range(mesh[0])
+        for y in range(mesh[1])][:chips]
+    node.status.tpu = t.TpuTopology(
+        chip_type="v5p", slice_id=slice_id or f"slice-{name}",
+        mesh_shape=list(mesh),
+        chips=[t.TpuChip(id=f"{name}-c{i}", coords=list(co))
+               for i, co in enumerate(own)])
+    reg.create(node)
+    return node
+
+
+async def _wait(predicate, what: str, timeout: float = 15.0):
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + timeout
+    while not predicate():
+        if loop.time() > deadline:
+            raise AssertionError(f"timeout: {what}")
+        await asyncio.sleep(0.05)
+
+
+def _isvc(name="svc", **kw) -> s.InferenceService:
+    kw.setdefault("model", "m")
+    return s.InferenceService(
+        metadata=ObjectMeta(name=name, namespace="default"),
+        spec=s.InferenceServiceSpec(**kw))
+
+
+# ---------------------------------------------------------------------------
+# reconcile
+# ---------------------------------------------------------------------------
+
+
+async def test_reconcile_creates_service_deployment_pods(gate_on):
+    """InferenceService -> headless Service + owned Deployment at
+    min_replicas -> replica pods carrying the serving label and the
+    model-server command."""
+    reg = _registry()
+    _add_node(reg, "n0")
+    client = LocalClient(reg)
+    factory = InformerFactory(client)
+    inf = InferenceServiceController(client, factory)
+    dep_c = DeploymentController(client, factory)
+    rs_c = ReplicaSetController(client, factory)
+    for c in (inf, dep_c, rs_c):
+        await c.start()
+    try:
+        await client.create(_isvc(min_replicas=2, max_replicas=4,
+                                  chips_per_replica=1))
+        await _wait(lambda: reg_has(reg, "services", "svc"),
+                    "service created")
+        svc = reg.get("services", "default", "svc")
+        assert svc.spec.cluster_ip == "None"  # headless
+        assert svc.spec.selector == {s.SERVICE_LABEL: "svc"}
+        assert svc.spec.ports[0].port == 8100  # admission default
+        await _wait(lambda: reg_has(reg, "deployments", "svc"),
+                    "deployment created")
+        dep = reg.get("deployments", "default", "svc")
+        assert dep.spec.replicas == 2  # warm pool = min, immediately
+        tmpl = dep.spec.template
+        assert tmpl.metadata.labels[s.SERVICE_LABEL] == "svc"
+        cmd = tmpl.spec.containers[0].command
+        assert "kubernetes_tpu.workloads.model_server" in cmd
+        assert tmpl.spec.containers[0].readiness_probe is not None
+        assert tmpl.spec.tpu_resources[0].chips == 1
+
+        def pods_made():
+            pods, _ = reg.list("pods", "default")
+            return sum(1 for p in pods if p.metadata.labels.get(
+                s.SERVICE_LABEL) == "svc") == 2
+        await _wait(pods_made, "replica pods created")
+        # Status mirror catches up.
+        await _wait(lambda: reg.get("inferenceservices", "default",
+                                    "svc").status.replicas == 2,
+                    "status.replicas")
+    finally:
+        for c in (inf, dep_c, rs_c):
+            await c.stop()
+        await factory.stop_all()
+
+
+def reg_has(reg, plural, name, ns="default") -> bool:
+    try:
+        reg.get(plural, ns, name)
+        return True
+    except errors.NotFoundError:
+        return False
+
+
+async def test_gate_off_byte_identity():
+    """Gate off: creating an InferenceService produces NO controller
+    traffic — no Service, no Deployment, no pods, no status writes,
+    store revision frozen after the create; and the stored object is
+    exactly what the client sent (no defaulting)."""
+    assert not GATES.enabled("InferenceAutoscaling")
+    reg = _registry()
+    _add_node(reg, "n0")
+    client = LocalClient(reg)
+    factory = InformerFactory(client)
+    inf = InferenceServiceController(client, factory)
+    await inf.start()
+    try:
+        sent = _isvc(min_replicas=2, max_replicas=4)
+        created = await client.create(sent)
+        assert created.spec.port == 0  # defaulter inert
+        rev_after_create = reg.store.revision
+        await asyncio.sleep(0.6)  # give an armed controller every
+        await inf.autoscale_once()  # chance to misbehave
+        assert reg.store.revision == rev_after_create, \
+            "gate off but the control plane wrote something"
+        assert not reg_has(reg, "services", "svc")
+        assert not reg_has(reg, "deployments", "svc")
+        pods, _ = reg.list("pods", "default")
+        assert pods == []
+        got = reg.get("inferenceservices", "default", "svc")
+        assert got.metadata.annotations == {}
+        assert got.status == s.InferenceServiceStatus()
+    finally:
+        await inf.stop()
+        await factory.stop_all()
+
+
+# ---------------------------------------------------------------------------
+# autoscaler over a synthetic feed
+# ---------------------------------------------------------------------------
+
+
+def _ready_pod(reg, name, svc="svc", node="n0"):
+    pod = t.Pod(metadata=ObjectMeta(
+        name=name, namespace="default",
+        labels={s.SERVICE_LABEL: svc}))
+    pod.spec.containers = [t.Container(name="server", image="img")]
+    pod.spec.node_name = node
+    created = reg.create(pod)
+    created = reg.get("pods", "default", name)
+    fresh = created
+    fresh.status.phase = "Running"
+    fresh.status.conditions = [t.PodCondition(type=t.COND_POD_READY,
+                                              status="True")]
+    reg.update(fresh, subresource="status")
+    return fresh
+
+
+async def test_autoscaler_scale_up_stabilize_down_e2e(gate_on):
+    """The e2e choreography against a live Deployment object: a hot
+    synthetic feed scales the deployment up; cooling traffic holds
+    through the stabilization window, then steps down rate-limited."""
+    reg = _registry()
+    _add_node(reg, "n0", chips=8)
+    client = LocalClient(reg)
+    factory = InformerFactory(client)
+    feed = {"at": 1.0, "age_seconds": 0.2, "pods": {}, "cluster": {}}
+    inf = InferenceServiceController(client, factory,
+                                     metrics_feed=lambda: dict(feed))
+    await inf.start()
+    try:
+        await client.create(_isvc(
+            min_replicas=1, max_replicas=6, chips_per_replica=1,
+            scale_down_stabilization_seconds=600.0))
+        await _wait(lambda: reg_has(reg, "deployments", "svc"),
+                    "deployment created")
+        _ready_pod(reg, "svc-r0")
+        await _wait(lambda: inf.pod_informer.get("default/svc-r0")
+                    is not None, "pod in informer")
+        await _wait(
+            lambda: (inf.pod_informer.get("default/svc-r0").status.phase
+                     == "Running"), "pod ready in informer")
+
+        # Saturated replica: scale up.
+        feed["pods"] = {"default/svc-r0": {"tokens_per_sec": 250.0,
+                                           "mfu": 1.0}}
+        await inf.autoscale_once()
+        dep = reg.get("deployments", "default", "svc")
+        assert dep.spec.replicas == 2
+        await _wait(lambda: reg.get("inferenceservices", "default",
+                                    "svc").status.desired_replicas == 2,
+                    "status.desired")
+        isvc = reg.get("inferenceservices", "default", "svc")
+        assert isvc.status.utilization == 1.0
+        assert 0.0 <= isvc.status.snapshot_age_seconds < 1.0
+
+        # Idle now, but the stabilization window (600s) holds.
+        feed["pods"] = {"default/svc-r0": {"tokens_per_sec": 1.0,
+                                           "mfu": 0.02}}
+        await _wait(lambda: (inf.dep_informer.get("default/svc")
+                             or dep).spec.replicas == 2, "informer dep")
+        await inf.autoscale_once()
+        assert reg.get("deployments", "default",
+                       "svc").spec.replicas == 2
+
+        # Stale feed: REFUSED — replicas frozen, refusal visible.
+        feed["age_seconds"] = 999.0
+        feed["pods"] = {"default/svc-r0": {"tokens_per_sec": 250.0,
+                                           "mfu": 1.0}}
+        await inf.autoscale_once()
+        assert reg.get("deployments", "default",
+                       "svc").spec.replicas == 2
+        await _wait(lambda: "stale" in reg.get(
+            "inferenceservices", "default", "svc").status
+            .last_scale_reason, "stale refusal surfaced")
+
+        # Collapse the window: scale-down proceeds 1 step per tick.
+        feed["age_seconds"] = 0.2
+        feed["pods"] = {"default/svc-r0": {"tokens_per_sec": 1.0,
+                                           "mfu": 0.02}}
+        inf._states["default/svc"].recommendations.clear()
+        isvc = reg.get("inferenceservices", "default", "svc")
+        fresh = isvc
+        fresh.spec.scale_down_stabilization_seconds = 0.0
+        reg.update(fresh)
+        await _wait(lambda: (inf.isvc_informer.get("default/svc").spec
+                             .scale_down_stabilization_seconds == 0.0),
+                    "spec update observed")
+        await inf.autoscale_once()
+        assert reg.get("deployments", "default",
+                       "svc").spec.replicas == 1
+    finally:
+        await inf.stop()
+        await factory.stop_all()
+
+
+# ---------------------------------------------------------------------------
+# warm pool
+# ---------------------------------------------------------------------------
+
+
+async def test_warm_pool_prepulls_on_candidate_nodes(gate_on, tmp_path):
+    """An artifact-image service pre-pulls on candidate nodes: prepull
+    pods appear pinned (pre-bound) to nodes not yet serving the model,
+    and are reaped once Succeeded."""
+    artifact = tmp_path / "model.bin"
+    artifact.write_bytes(b"w" * 1024)
+    reg = _registry()
+    for i in range(3):
+        _add_node(reg, f"n{i}")
+    client = LocalClient(reg)
+    factory = InformerFactory(client)
+    inf = InferenceServiceController(client, factory)
+    await inf.start()
+    try:
+        await client.create(_isvc(
+            min_replicas=1, max_replicas=3, chips_per_replica=1,
+            image=f"file://{artifact}", warm_pool_nodes=2))
+        def prepulls():
+            pods, _ = reg.list("pods", "default")
+            return [p for p in pods
+                    if p.metadata.labels.get(s.PREPULL_LABEL) == "svc"]
+        await _wait(lambda: len(prepulls()) == 2, "prepull pods")
+        nodes = {p.spec.node_name for p in prepulls()}
+        assert len(nodes) == 2 and all(nodes)  # pinned, distinct
+        # One finishes: the controller reaps it and does NOT re-create
+        # on the same (now warm) node.
+        done = prepulls()[0]
+        warm_node = done.spec.node_name
+        fresh = reg.get("pods", "default", done.metadata.name)
+        fresh.status.phase = "Succeeded"
+        reg.update(fresh, subresource="status")
+
+        def reaped():
+            # Graceful delete: with no node agent to finalize, the pod
+            # parks in Terminating — the controller's delete is the
+            # reap signal.
+            try:
+                p = reg.get("pods", "default", done.metadata.name)
+            except errors.NotFoundError:
+                return True
+            return p.metadata.deletion_timestamp is not None
+        await _wait(reaped, "succeeded prepull reaped")
+        await asyncio.sleep(0.3)
+        live = [p for p in prepulls()
+                if p.metadata.deletion_timestamp is None]
+        assert warm_node not in {p.spec.node_name for p in live}
+    finally:
+        await inf.stop()
+        await factory.stop_all()
+
+
+# ---------------------------------------------------------------------------
+# topology-aware placement vs a concurrent gang
+# ---------------------------------------------------------------------------
+
+
+#: Replicas of one service share a controller (the ReplicaSet behind
+#: the managed Deployment) — which is exactly what arms the legacy
+#: SelectorSpread anti-affinity that scatters them across slices.
+_RS_UID = "rs-serving-0001"
+
+
+def _serving_pod(name, chips=1, labeled=True):
+    from kubernetes_tpu.api.meta import OwnerReference
+    pod = t.Pod(metadata=ObjectMeta(
+        name=name, namespace="default",
+        labels={s.SERVICE_LABEL: "svc"} if labeled else {},
+        owner_references=[OwnerReference(
+            api_version="apps/v1", kind="ReplicaSet", name="svc-rs",
+            uid=_RS_UID, controller=True)]))
+    pod.spec.containers = [t.Container(
+        name="server", image="img",
+        resources=t.ResourceRequirements(requests={"cpu": 0.2}),
+        tpu_requests=["tpu"])]
+    pod.spec.tpu_resources = [t.PodTpuRequest(name="tpu", chips=chips)]
+    return pod
+
+
+async def _place_two_serving_pods(labeled: bool):
+    """Fleet: two 4-chip single-host slices. Two 1-chip serving pods.
+    Returns {pod name: (node, chip ids)} after both bind."""
+    reg = _registry()
+    _add_node(reg, "node-a")
+    _add_node(reg, "node-b")
+    client = LocalClient(reg)
+    sched = Scheduler(client, backoff_seconds=0.2)
+    await sched.start()
+    try:
+        for i in range(2):
+            await client.create(_serving_pod(f"serve-{i}",
+                                             labeled=labeled))
+            # Sequential: the second placement must SEE the first
+            # (consolidation is a reaction, not a race).
+            await _wait(lambda i=i: reg.get(
+                "pods", "default", f"serve-{i}").spec.node_name,
+                f"serve-{i} bound")
+        out = {}
+        for i in range(2):
+            p = reg.get("pods", "default", f"serve-{i}")
+            out[p.metadata.name] = (
+                p.spec.node_name,
+                tuple(p.spec.tpu_resources[0].assigned))
+        return out
+    finally:
+        await sched.stop()
+
+
+async def test_topology_gate_keeps_gang_placeable(topo_on):
+    """THE acceptance scenario: with ServingTopologyAware on, two
+    serving replicas consolidate onto one slice, leaving the other
+    slice's full 2x2 box intact — a concurrent gang needing a whole
+    slice still places. (Legacy spread breaks both slices; see the
+    companion test.)"""
+    reg = _registry()
+    _add_node(reg, "node-a")
+    _add_node(reg, "node-b")
+    client = LocalClient(reg)
+    sched = Scheduler(client, backoff_seconds=0.2)
+    await sched.start()
+    try:
+        for i in range(2):
+            await client.create(_serving_pod(f"serve-{i}"))
+            await _wait(lambda i=i: reg.get(
+                "pods", "default", f"serve-{i}").spec.node_name,
+                f"serve-{i} bound")
+        nodes = {reg.get("pods", "default", f"serve-{i}").spec.node_name
+                 for i in range(2)}
+        assert len(nodes) == 1, \
+            f"serving replicas spread across slices: {nodes}"
+        # The other slice is pristine: a whole-slice gang places.
+        await client.create(t.PodGroup(
+            metadata=ObjectMeta(name="gang", namespace="default"),
+            spec=t.PodGroupSpec(min_member=1, slice_shape=[2, 2, 1])))
+        member = t.Pod(metadata=ObjectMeta(name="gang-0",
+                                           namespace="default"))
+        member.spec.containers = [t.Container(
+            name="c", image="img", tpu_requests=["tpu"],
+            resources=t.ResourceRequirements(requests={"cpu": 0.2}))]
+        member.spec.tpu_resources = [t.PodTpuRequest(
+            name="tpu", slice_shape=[2, 2, 1])]
+        member.spec.gang = "gang"
+        await client.create(member)
+        await _wait(lambda: reg.get("pods", "default",
+                                    "gang-0").spec.node_name,
+                    "gang member bound", timeout=20.0)
+        gang_node = reg.get("pods", "default", "gang-0").spec.node_name
+        assert gang_node not in nodes
+    finally:
+        await sched.stop()
+
+
+async def test_legacy_spread_fragments_both_slices():
+    """The CONTRAST case (gate off): the default spreading placement
+    puts one serving replica on each slice — after which a whole-slice
+    gang has nowhere to go. This is exactly the fragmentation the gate
+    exists to prevent (and why the smoke runs gate-on)."""
+    assert not GATES.enabled("ServingTopologyAware")
+    placed = await _place_two_serving_pods(labeled=True)
+    assert len({node for node, _ in placed.values()}) == 2
+
+
+async def test_topology_gate_off_placement_byte_identical():
+    """Gate off: a serving-labeled pod places EXACTLY like an
+    unlabeled one — same nodes, same chip ids (the label alone must
+    not perturb legacy placement)."""
+    assert not GATES.enabled("ServingTopologyAware")
+    labeled = await _place_two_serving_pods(labeled=True)
+    plain = await _place_two_serving_pods(labeled=False)
+    assert labeled == plain

@@ -1,0 +1,118 @@
+"""ControllerManager.stop() must be bounded by a real deadline.
+
+The bug class (the e2e "~2min LocalCluster.stop() teardown drain"):
+CPython's ``asyncio.wait_for`` swallows a task cancellation that lands
+in the same window its watched future completes (GH-86296). A stop()
+racing controller startup — the manager suspended in
+``informer.wait_for_sync()`` exactly as the sync fires — loses its one
+CancelledError there, and the manager proceeds to the run-forever wait
+with the cancellation consumed. ``util.tasks.cancel_task`` re-cancels
+on a tick until the task is genuinely dead, bounded by a grace window.
+"""
+import asyncio
+
+from kubernetes_tpu.api import errors, types as t
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.apiserver.registry import Registry
+from kubernetes_tpu.client.local import LocalClient
+from kubernetes_tpu.controllers import manager as mgr
+from kubernetes_tpu.util.tasks import cancel_task
+
+
+class _SwallowingController:
+    """Models the GH-86296 window deterministically: start() absorbs
+    exactly one CancelledError (what wait_for does when the informer
+    sync lands in the cancellation window)."""
+
+    name = "swallowing"
+
+    def __init__(self, client, factory, **kw):
+        self.stopped = False
+
+    async def start(self):
+        try:
+            await asyncio.sleep(0.05)
+        except asyncio.CancelledError:
+            pass  # the swallow — cancellation consumed, start "succeeds"
+
+    async def stop(self):
+        self.stopped = True
+
+
+def _manager(table):
+    reg = Registry()
+    try:
+        reg.create(t.Namespace(metadata=ObjectMeta(name="default")))
+    except errors.AlreadyExistsError:
+        pass
+    cm = mgr.ControllerManager(LocalClient(reg), controllers=list(table))
+    return cm
+
+
+async def test_stop_survives_swallowed_cancellation(monkeypatch):
+    """stop() called while a controller's start() eats the first
+    CancelledError still terminates promptly (re-cancel loop), instead
+    of hanging on the run-forever wait."""
+    monkeypatch.setitem(mgr.DEFAULT_CONTROLLERS, "swallowing",
+                        _SwallowingController)
+    cm = _manager(["swallowing"])
+    await cm.start()
+    # Cancel while _run_controllers is inside start()'s sleep: the
+    # swallow consumes it, and only the bounded re-cancel saves stop().
+    await asyncio.sleep(0.01)
+    await asyncio.wait_for(cm.stop(), 10.0)
+    assert cm._run_task is None
+    assert not cm.controllers
+
+
+async def test_stop_mid_startup_race_window():
+    """The real shape: stop() immediately after start() — the manager
+    is still inside informer sync waits. Must complete well under the
+    old multi-minute drain regardless of where cancellation lands."""
+    cm = _manager(["replicaset", "deployment", "podgc"])
+    await cm.start()
+    await asyncio.wait_for(cm.stop(), 15.0)
+    assert not cm.controllers
+
+
+async def test_stop_after_full_startup():
+    """The common case stays cheap: a settled manager stops fast."""
+    cm = _manager(["replicaset", "ttl"])
+    await cm.start()
+    await asyncio.sleep(0.3)
+    loop = asyncio.get_running_loop()
+    t0 = loop.time()
+    await asyncio.wait_for(cm.stop(), 10.0)
+    assert loop.time() - t0 < 5.0
+    assert not cm.controllers
+
+
+async def test_cancel_task_abandons_unkillable_after_grace():
+    """A task that refuses to die cannot hold teardown hostage: after
+    the grace window cancel_task returns False and the caller moves on."""
+
+    give_up = False
+
+    async def unkillable():
+        while not give_up:
+            try:
+                await asyncio.sleep(0.05)
+            except asyncio.CancelledError:
+                continue  # pathological: never honors cancellation
+
+    task = asyncio.get_running_loop().create_task(unkillable())
+    await asyncio.sleep(0.01)  # let it enter its catch-everything loop
+    ok = await cancel_task(task, grace=1.2, name="unkillable")
+    assert ok is False
+    assert not task.done()
+    give_up = True  # cleanup: let the pathological loop exit
+    await task
+
+
+async def test_cancel_task_on_done_task_is_noop():
+    async def quick():
+        return 7
+
+    task = asyncio.get_running_loop().create_task(quick())
+    await asyncio.sleep(0.01)
+    assert await cancel_task(task, grace=1.0) is True

@@ -1,0 +1,404 @@
+"""serving/v1 unit tier: API validation + gated admission defaults,
+the autoscaler decision engine over a synthetic feed
+(scale-up -> stabilize -> scale-down), staleness refusal, the
+slice-topology placement score, and the endpoint router's preference
+order."""
+import math
+
+import pytest
+
+from kubernetes_tpu.api import errors, serving as s, types as t
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.apiserver.admission import default_chain
+from kubernetes_tpu.apiserver.registry import Registry
+from kubernetes_tpu.scheduler.priorities import (MAX_SCORE,
+                                                 serving_topology_score)
+from kubernetes_tpu.scheduler.submesh import largest_free_box_volume
+from kubernetes_tpu.serving import autoscaler as eng
+from kubernetes_tpu.util.features import GATES
+
+
+@pytest.fixture
+def gate_on():
+    was = GATES.enabled("InferenceAutoscaling")
+    GATES.set("InferenceAutoscaling", True)
+    yield
+    GATES.set("InferenceAutoscaling", was)
+
+
+def _isvc(**spec_kw) -> s.InferenceService:
+    spec_kw.setdefault("model", "m")
+    return s.InferenceService(
+        metadata=ObjectMeta(name="svc", namespace="default"),
+        spec=s.InferenceServiceSpec(**spec_kw))
+
+
+def _registry() -> Registry:
+    reg = Registry()
+    reg.admission = default_chain(reg)
+    reg.create(t.Namespace(metadata=ObjectMeta(name="default")))
+    return reg
+
+
+# ---------------------------------------------------------------------------
+# validation + defaults
+# ---------------------------------------------------------------------------
+
+
+def test_validate_requires_model():
+    with pytest.raises(errors.InvalidError):
+        s.validate_inferenceservice(_isvc(model=""))
+
+
+def test_validate_replica_window_and_shape():
+    with pytest.raises(errors.InvalidError):
+        s.validate_inferenceservice(_isvc(min_replicas=4, max_replicas=2))
+    with pytest.raises(errors.InvalidError):
+        s.validate_inferenceservice(_isvc(slice_shape=[2, 0]))
+    with pytest.raises(errors.InvalidError):
+        # contradictory chips vs shape volume
+        s.validate_inferenceservice(
+            _isvc(chips_per_replica=3, slice_shape=[2, 2]))
+    # consistent: shape volume == chips
+    s.validate_inferenceservice(
+        _isvc(chips_per_replica=4, slice_shape=[2, 2]))
+    with pytest.raises(errors.InvalidError):
+        s.validate_inferenceservice(_isvc(slo_target_ms=float("nan")))
+    with pytest.raises(errors.InvalidError):
+        s.validate_inferenceservice(_isvc(target_utilization=1.5))
+
+
+def test_chip_geometry_immutable_on_update():
+    old = _isvc(chips_per_replica=2)
+    new = _isvc(chips_per_replica=4)
+    with pytest.raises(errors.InvalidError):
+        s.validate_inferenceservice_update(new, old)
+    s.validate_inferenceservice_update(_isvc(chips_per_replica=2,
+                                             max_replicas=9), old)
+
+
+def test_admission_defaults_gated(gate_on):
+    reg = _registry()
+    created = reg.create(_isvc())
+    sp = created.spec
+    assert sp.min_replicas == 1 and sp.max_replicas == 1
+    assert sp.port == 8100
+    assert sp.slo_target_ms == 2000.0
+    assert sp.rated_tokens_per_sec == 256.0
+    assert sp.target_utilization == 0.65
+    # shape fills the chips count
+    shaped = reg.create(s.InferenceService(
+        metadata=ObjectMeta(name="shaped", namespace="default"),
+        spec=s.InferenceServiceSpec(model="m", slice_shape=[2, 2])))
+    assert shaped.spec.chips_per_replica == 4
+
+
+def test_admission_defaults_inert_gate_off():
+    """Gate off: the created object is byte-identical to what the
+    client sent — no defaulting, no annotations."""
+    assert not GATES.enabled("InferenceAutoscaling")
+    reg = _registry()
+    created = reg.create(_isvc())
+    assert created.spec.min_replicas == 0
+    assert created.spec.port == 0
+    assert created.spec.slo_target_ms == 0.0
+    assert created.metadata.annotations == {}
+
+
+# ---------------------------------------------------------------------------
+# autoscaler engine (synthetic feed)
+# ---------------------------------------------------------------------------
+
+
+def _sample(util, reporting, age=0.5):
+    return eng.MetricsSample(utilization=util, reporting=reporting,
+                             tokens_per_sec=util * reporting * 256.0,
+                             age_seconds=age)
+
+
+def test_engine_scale_up_stabilize_scale_down():
+    """The acceptance choreography over a synthetic feed: overload
+    scales up; on-target holds; idle scales down only after the
+    stabilization window expires, rate-limited per tick."""
+    spec = s.InferenceServiceSpec(
+        model="m", min_replicas=1, max_replicas=8,
+        target_utilization=0.65,
+        scale_down_stabilization_seconds=10.0)
+    state = eng.ServiceState()
+    clock = 100.0
+    current = ready = 1
+
+    d = eng.decide(spec, current, ready, _sample(1.0, 1), state, clock)
+    assert not d.refused and d.desired == 2  # ceil(1 * 1.0/0.65)
+    current = ready = d.desired
+
+    clock += 2
+    d = eng.decide(spec, current, ready, _sample(0.66, 2), state, clock)
+    assert d.desired == 2, d.reason  # within tolerance: hold
+
+    # Load vanishes: the recommendation drops to min, but the window
+    # still holds the earlier high-water recommendation.
+    clock += 2
+    d = eng.decide(spec, current, ready, _sample(0.05, 2), state, clock)
+    assert d.desired == 2 and "stabilization" in d.reason
+
+    # Window expires: now the scale-down proceeds, one step per tick.
+    clock += 11
+    d = eng.decide(spec, current, ready, _sample(0.05, 2), state, clock)
+    assert d.desired == 1
+
+
+def test_engine_rate_limits():
+    spec = s.InferenceServiceSpec(
+        model="m", min_replicas=1, max_replicas=32,
+        target_utilization=0.5, scale_up_max_step=1,
+        scale_down_stabilization_seconds=0.0, scale_down_max_step=2)
+    state = eng.ServiceState()
+    # util 1.0 vs target 0.5 -> raw ceil(2*2.0)=4, capped at +1.
+    d = eng.decide(spec, 2, 2, _sample(1.0, 2), state, 0.0)
+    assert d.desired == 3 and "rate-limited to +1" in d.reason
+    state = eng.ServiceState()
+    d = eng.decide(spec, 8, 8, _sample(0.01, 8), state, 50.0)
+    assert d.desired == 6 and "rate-limited to -2" in d.reason
+
+
+def test_engine_refuses_stale_snapshot():
+    """The satellite contract: a frozen rollup must not scale the
+    fleet — the decision is a refusal, echoing the current target."""
+    spec = s.InferenceServiceSpec(model="m", min_replicas=1,
+                                  max_replicas=8, target_utilization=0.5)
+    state = eng.ServiceState()
+    d = eng.decide(spec, 3, 3, _sample(1.0, 3, age=120.0), state, 0.0,
+                   max_snapshot_age=30.0)
+    assert d.refused and d.desired == 3 and "stale" in d.reason
+    # No-monitor case (age inf) refuses too.
+    d = eng.decide(spec, 3, 3,
+                   _sample(1.0, 3, age=float("inf")), state, 1.0)
+    assert d.refused
+    # The refusal recorded NO recommendation: a later real sample is
+    # not held up by ghost entries.
+    assert state.recommendations == []
+
+
+def test_engine_missing_replicas_fold():
+    """Ready replicas absent from the snapshot (scrape lag) fold in
+    conservatively: idle on the way up, at-target on the way down — an
+    unknown fleet neither amplifies a scale-up nor shrinks."""
+    spec = s.InferenceServiceSpec(
+        model="m", min_replicas=1, max_replicas=16,
+        target_utilization=0.65, scale_up_max_step=16,
+        scale_down_stabilization_seconds=0.0)
+    # 4 ready, only 2 reporting (saturated): desired stays at current —
+    # the 2 silent replicas are assumed idle, so no amplified jump.
+    d = eng.decide(spec, 4, 4, _sample(1.0, 2), eng.ServiceState(), 0.0)
+    assert d.desired == 4
+    # 4 ready, 1 reporting idle: the 3 silent ones hold their seats.
+    d = eng.decide(spec, 4, 4, _sample(0.05, 1), eng.ServiceState(), 0.0)
+    assert d.desired == 4
+    # All 4 reporting idle: NOW the fleet shrinks (rate-limited).
+    d = eng.decide(spec, 4, 4, _sample(0.05, 4), eng.ServiceState(), 0.0)
+    assert d.desired == 3
+
+
+def test_effective_spec_defaults():
+    """Objects created while the gate was off (or updated to zero a
+    field) resolve to safe operating values at read time — a port-0
+    readiness probe must be impossible."""
+    eff = s.effective_spec(s.InferenceServiceSpec(model="m"))
+    assert eff.port == 8100 and eff.target_utilization == 0.65
+    assert eff.min_replicas == 1 and eff.max_replicas == 1
+    eff = s.effective_spec(s.InferenceServiceSpec(
+        model="m", slice_shape=[2, 2], port=9000))
+    assert eff.chips_per_replica == 4 and eff.port == 9000
+
+
+def test_engine_no_reporting_holds():
+    spec = s.InferenceServiceSpec(model="m", min_replicas=1,
+                                  max_replicas=8)
+    d = eng.decide(spec, 2, 2, _sample(0.0, 0), eng.ServiceState(), 0.0)
+    assert not d.refused and d.desired == 2
+
+
+def test_engine_clamps_to_window():
+    spec = s.InferenceServiceSpec(model="m", min_replicas=2,
+                                  max_replicas=4, target_utilization=0.5,
+                                  scale_up_max_step=16)
+    d = eng.decide(spec, 4, 4, _sample(1.0, 4), eng.ServiceState(), 0.0)
+    assert d.desired == 4  # already at max
+    d = eng.decide(spec, 1, 1, _sample(0.4, 1), eng.ServiceState(), 1.0)
+    assert d.desired >= 2  # below min: raised
+
+
+# ---------------------------------------------------------------------------
+# topology score
+# ---------------------------------------------------------------------------
+
+
+def _grid(mesh):
+    import itertools
+    return set(itertools.product(*(range(m) for m in mesh)))
+
+
+def test_largest_free_box_volume():
+    mesh = (4, 4, 1)
+    assert largest_free_box_volume(_grid(mesh), mesh) == 16
+    free = _grid(mesh) - {(1, 1, 0)}  # hole in the middle
+    got = largest_free_box_volume(free, mesh)
+    assert got == 12  # torus: rows 2..0 wrap into a 4x3 slab
+    assert largest_free_box_volume(free, mesh, torus=False) == 8
+    assert largest_free_box_volume(set(), mesh) == 0
+    assert largest_free_box_volume({(0, 0, 0)}, mesh) == 1
+
+
+def test_serving_topology_score_prefers_fragmented_slice():
+    """A 2-chip serving claim scores higher where it does NOT shrink
+    the slice's largest free box — corner of a half-used slice beats
+    the middle of a pristine one."""
+    mesh = (4, 4, 1)
+    pristine = _grid(mesh)
+    # Claim in the middle of the pristine slice: big damage.
+    mid = serving_topology_score(pristine, mesh,
+                                 [(1, 1, 0), (1, 2, 0)], torus=False)
+    # Claim in a corner: less damage.
+    corner = serving_topology_score(pristine, mesh,
+                                    [(0, 0, 0), (0, 1, 0)], torus=False)
+    assert corner > mid
+    # A slice already fragmented to 2x2 boxes loses nothing to a
+    # 2-cell claim inside a dead zone's neighborhood: score is high.
+    ragged = {(0, 0, 0), (0, 1, 0), (1, 0, 0), (1, 1, 0),
+              (3, 3, 0), (3, 2, 0)}
+    ragged_score = serving_topology_score(
+        ragged, mesh, [(3, 3, 0), (3, 2, 0)], torus=False)
+    assert ragged_score >= corner
+    assert serving_topology_score(pristine, mesh, []) == MAX_SCORE / 2
+
+
+# ---------------------------------------------------------------------------
+# endpoint router ordering
+# ---------------------------------------------------------------------------
+
+
+class _FakeInformer:
+    def __init__(self, objs):
+        self._objs = {o.key(): o for o in objs}
+
+    def get(self, key):
+        return self._objs.get(key)
+
+    def list(self):
+        return list(self._objs.values())
+
+
+def _node(name, slice_id, chips=4):
+    n = t.Node(metadata=ObjectMeta(name=name))
+    n.status.capacity = {t.RESOURCE_TPU: float(chips)}
+    n.status.allocatable = dict(n.status.capacity)
+    n.status.tpu = t.TpuTopology(slice_id=slice_id,
+                                 mesh_shape=[2, 2, 1])
+    return n
+
+
+def _tpu_pod(name, node, chips):
+    p = t.Pod(metadata=ObjectMeta(name=name, namespace="default"))
+    p.spec.node_name = node
+    p.spec.tpu_resources = [t.PodTpuRequest(name="tpu", chips=chips)]
+    p.status.phase = "Running"
+    return p
+
+
+def _endpoints(addrs):
+    ep = t.Endpoints(metadata=ObjectMeta(name="svc", namespace="default"))
+    ep.subsets = [t.EndpointSubset(
+        addresses=[t.EndpointAddress(ip=ip, hostname=pod, node_name=node)
+                   for ip, pod, node in addrs],
+        ports=[t.EndpointPort(name="http", port=8100)])]
+    return ep
+
+
+def _router(endpoints, nodes, pods):
+    from kubernetes_tpu.serving.router import TopologyRouter
+    r = TopologyRouter(client=None, service="svc", namespace="default")
+    r.endpoints = _FakeInformer([endpoints])
+    r.nodes = _FakeInformer(nodes)
+    r.pods = _FakeInformer(pods)
+    return r
+
+
+@pytest.fixture
+def topo_gate():
+    was = GATES.enabled("ServingTopologyAware")
+    GATES.set("ServingTopologyAware", True)
+    yield
+    GATES.set("ServingTopologyAware", was)
+
+
+def test_router_prefers_consolidated_slice_and_packed_nodes(topo_gate):
+    # slice-a hosts two replicas (one node nearly full), slice-b one.
+    nodes = [_node("a0", "slice-a"), _node("a1", "slice-a"),
+             _node("b0", "slice-b")]
+    pods = [_tpu_pod("p-a0", "a0", 3), _tpu_pod("p-a1", "a1", 1),
+            _tpu_pod("p-b0", "b0", 1)]
+    ep = _endpoints([("10.0.0.1", "p-a0", "a0"),
+                     ("10.0.0.2", "p-a1", "a1"),
+                     ("10.0.0.3", "p-b0", "b0")])
+    r = _router(ep, nodes, pods)
+    order = [e.pod for e in r.ranked()]
+    # slice-a first (2 endpoints > 1); within it, a0 (1 free chip)
+    # before a1 (3 free); slice-b last.
+    assert order == ["p-a0", "p-a1", "p-b0"]
+
+
+def test_router_gate_off_plain_order():
+    assert not GATES.enabled("ServingTopologyAware")
+    nodes = [_node("a0", "slice-a"), _node("b0", "slice-b")]
+    ep = _endpoints([("10.0.0.2", "p-b", "b0"), ("10.0.0.1", "p-a", "a0")])
+    r = _router(ep, nodes, [])
+    assert [e.pod for e in r.ranked()] == ["p-a", "p-b"]
+
+
+def test_router_pick_least_outstanding(topo_gate):
+    nodes = [_node("a0", "slice-a"), _node("a1", "slice-a")]
+    ep = _endpoints([("10.0.0.1", "p-0", "a0"), ("10.0.0.2", "p-1", "a1")])
+    r = _router(ep, nodes, [])
+    first = r.pick()
+    second = r.pick()
+    assert first is not None and second is not None
+    assert first.pod != second.pod  # spillover once preferred is busy
+    r.done(first)
+    third = r.pick()
+    assert third.pod == first.pod  # freed: preference wins again
+    r.done(second)
+    r.done(third)
+    assert r._outstanding == {}
+
+
+# ---------------------------------------------------------------------------
+# printers
+# ---------------------------------------------------------------------------
+
+
+def test_printer_and_describe():
+    from kubernetes_tpu.cli import printers
+    isvc = _isvc(min_replicas=1, max_replicas=4, chips_per_replica=2,
+                 slo_target_ms=1500.0, rated_tokens_per_sec=128.0)
+    isvc.status.replicas = 3
+    isvc.status.ready_replicas = 2
+    isvc.status.desired_replicas = 3
+    isvc.status.tokens_per_sec = 301.5
+    isvc.status.utilization = 0.71
+    out = printers.print_objects("inferenceservices", [isvc])
+    assert "MODEL" in out and "2/3" in out and "1..4" in out
+    desc = printers.describe(isvc)
+    assert "Replicas: 2/3 ready" in desc
+    assert "1500" in desc and "0.71" in desc
+
+
+def test_monitor_latest_age():
+    from kubernetes_tpu.monitoring.aggregator import ClusterMonitor
+    mon = ClusterMonitor(client=None)
+    assert math.isinf(mon.latest()["age_seconds"])  # never swept
+    import time
+    mon._snapshot = {"at": time.time() - 5.0, "nodes": {}, "pods": {},
+                     "cluster": {}}
+    age = mon.latest()["age_seconds"]
+    assert 4.0 <= age <= 10.0

@@ -145,7 +145,11 @@ async def test_monitor_sweep_publishes_gauges(monkeypatch):
     assert agg.CLUSTER_CHIPS.value(state="total") == 2.0
     assert agg.CLUSTER_DUTY.value() == pytest.approx(50.0)
     assert agg.NODE_DUTY.value(node="n1") == pytest.approx(50.0)
-    assert mon.latest() is snap
+    latest = mon.latest()
+    assert latest["nodes"] == snap["nodes"]
+    assert latest["cluster"] == snap["cluster"]
+    # The explicit staleness signal (consumers refuse old rollups).
+    assert 0.0 <= latest["age_seconds"] < 60.0
 
     # Listed-but-unscrapable (one missed scrape): the last-known
     # aggregate carries forward marked stale — capacity must not flap

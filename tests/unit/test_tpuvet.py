@@ -234,6 +234,31 @@ M = Gauge("tpu_duty_cycle_pct", "re-registered: silently inert")
     assert len(got) == 1 and "already registered" in got[0].message
 
 
+def test_metric_name_serving_and_autoscaler_families():
+    """The inference-serving families (serving_* from the endpoint
+    router, inference_autoscaler_* from the scaling engine) are valid
+    names; collisions within the family still flag."""
+    good = """
+from kubernetes_tpu.metrics.registry import Counter, Gauge
+A = Gauge("serving_router_endpoints", "x", labels=("service",))
+B = Counter("serving_router_picks_total", "x", labels=("service", "tier"))
+C = Gauge("inference_autoscaler_desired_replicas", "x", labels=("service",))
+D = Gauge("inference_autoscaler_utilization", "x", labels=("service",))
+E = Gauge("inference_autoscaler_snapshot_age_seconds", "x",
+          labels=("service",))
+F = Counter("inference_autoscaler_scale_events_total", "x",
+            labels=("service", "direction"))
+G = Counter("inference_autoscaler_stale_refusals_total", "x",
+            labels=("service",))
+"""
+    assert run_source(good, checks=["metric-name"]) == []
+    bad = good + """
+H = Gauge("serving_router_endpoints", "re-registered: silently inert")
+"""
+    got = run_source(bad, checks=["metric-name"])
+    assert len(got) == 1 and "already registered" in got[0].message
+
+
 def test_metric_name_replication_and_redirect_family():
     """The control-plane replication metric family (replication_*) and
     the client leader-redirect counter are valid names, and a duplicate
