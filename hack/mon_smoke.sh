@@ -1,0 +1,169 @@
+#!/usr/bin/env bash
+# kmon smoke (ISSUE 12): three gates, <60s total.
+#
+# 1. Live pipeline: a LocalCluster with ClusterMetricsPipeline (and
+#    AlertNodeTainting) on converges to all four scrape jobs up
+#    (apiserver / scheduler / controller-manager / node), and the real
+#    `ktl query` / `ktl alerts` / `ktl dash` paths render against
+#    /debug/v1/query.
+# 2. Alert lifecycle, deterministically: a chaos/driver.py-injected
+#    sick chip (fixed seed) fires TpuChipSick after its hold-down,
+#    records a Warning Event, taints the node tpu.google.com/degraded,
+#    then the chip recovers, the alert resolves, and the taint clears.
+# 3. Bounded storage: a sustained-churn ingest worth 2 minutes of
+#    5-node scrapes (simulated clock — the bound is structural, it
+#    does not need wall time) holds the TSDB at its ring/series
+#    ceilings with every refusal counted in the dropped-sample
+#    counters, never unbounded growth.
+#
+# Siblings: hack/trace_smoke.sh, hack/serve_smoke.sh; hack/test.sh
+# runs this with the other smokes.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+timeout -k 10 55 env JAX_PLATFORMS=cpu python - <<'EOF'
+import asyncio, contextlib, io, time
+
+from kubernetes_tpu.util.features import GATES
+GATES.set("ClusterMetricsPipeline", True)
+GATES.set("AlertNodeTainting", True)
+
+from kubernetes_tpu.chaos import core as chaos_core
+from kubernetes_tpu.cli import ktl
+from kubernetes_tpu.cluster.local import LocalCluster, NodeSpec
+from kubernetes_tpu.monitoring.rules import TAINT_DEGRADED
+
+
+async def run_ktl(base, *argv):
+    args = ktl.build_parser().parse_args(["--server", base, *argv])
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = await args.fn(args)
+    return rc, buf.getvalue()
+
+
+async def wait_for(probe, timeout, what):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while True:
+        got = await probe()
+        if got:
+            return got
+        assert asyncio.get_running_loop().time() < deadline, \
+            f"mon_smoke: timed out waiting for {what}"
+        await asyncio.sleep(0.15)
+
+
+async def main() -> None:
+    controller = chaos_core.arm(chaos_core.ChaosController(20260805, ()))
+    cluster = LocalCluster(
+        nodes=[NodeSpec(name="mon-0", tpu_chips=4, fake_runtime=True)],
+        tls=False, heartbeat_interval=0.2, status_interval=0.2,
+        monitor_interval=0.25, metrics_interval=0.25)
+    base = await cluster.start()
+    try:
+        await cluster.wait_for_nodes_ready(20.0)
+        pipeline = cluster.controller_manager.get_controller(
+            "metrics-pipeline")
+        assert pipeline is not None
+
+        async def all_up():
+            out = pipeline.query_instant("sum by (job) (up)")
+            got = {e["metric"]["job"]: e["value"][1]
+                   for e in out["result"]}
+            return all(got.get(j) == 1 for j in (
+                "apiserver", "scheduler", "controller-manager", "node"))
+        await wait_for(all_up, 20.0, "scrape convergence (4 jobs up)")
+        print("mon_smoke: scrape converged (4 jobs up)", flush=True)
+
+        rc, out = await run_ktl(base, "query", "sum(tpu_chip_healthy)")
+        assert rc == 0 and "4" in out, out
+        rc, out = await run_ktl(base, "query",
+                                "tpu_chip_healthy", "--range", "30s")
+        assert rc == 0 and "TREND" in out, out
+        rc, out = await run_ktl(base, "alerts")
+        assert rc == 0, out
+        rc, out = await run_ktl(base, "dash", "--range", "1m")
+        assert rc == 0 and "targets up" in out, out
+        print("mon_smoke: ktl query/alerts/dash render", flush=True)
+
+        local = cluster.local_client()
+        controller.trigger(chaos_core.SITE_DEVICE, "unhealthy",
+                           param=5.0)
+        cluster.chaos_driver.tick()
+
+        async def fired():
+            return "TpuChipSick" in pipeline.firing_names()
+        await wait_for(fired, 15.0, "TpuChipSick to fire")
+
+        async def tainted():
+            nodes, _ = await local.list("nodes")
+            return any(t.key == TAINT_DEGRADED
+                       for n in nodes for t in n.spec.taints)
+        await wait_for(tainted, 10.0, "degraded taint")
+        rc, out = await run_ktl(base, "alerts")
+        assert "TpuChipSick" in out and "firing" in out, out
+        print("mon_smoke: sick chip fired + tainted", flush=True)
+
+        async def resolved():
+            return ("TpuChipSick" not in pipeline.firing_names()
+                    and not await tainted())
+        await wait_for(resolved, 20.0, "alert resolve + untaint")
+        evs, _ = await local.list("events")
+        kmon = [(e.type, e.reason) for e in evs
+                if e.source.component == "kmon"]
+        assert ("Warning", "TpuChipSick") in kmon, kmon
+        assert ("Normal", "TpuChipSick") in kmon, kmon
+        print("mon_smoke: alert resolved, node untainted, events "
+              "recorded", flush=True)
+    finally:
+        chaos_core.disarm()
+        await cluster.stop()
+
+
+asyncio.run(main())
+EOF
+
+timeout -k 10 30 env JAX_PLATFORMS=cpu python - <<'EOF'
+# Bounded-storage gate: 2 minutes of sustained 5-node churn on the
+# simulated clock against a deliberately tiny TSDB. The ring/series
+# ceilings must hold and every refusal must be COUNTED — the item-6
+# hygiene bar applied to the monitoring pipeline itself.
+from kubernetes_tpu.monitoring.scrape import ingest_exposition
+from kubernetes_tpu.monitoring.tsdb import TSDB
+
+db = TSDB(retention_seconds=30.0, max_samples_per_series=64,
+          max_series=200)
+
+def payload(n_new_series: int, tick: int) -> str:
+    lines = []
+    for node in range(5):
+        for chip in range(8):
+            lines.append(f'tpu_duty_cycle_pct{{node="n{node}",'
+                         f'chip="c{chip}"}} {30 + (tick % 50)}')
+    # Churning label values: a new pod label set every tick — the
+    # cardinality-explosion scenario the series ceiling exists for.
+    for k in range(n_new_series):
+        lines.append(f'churn_gauge{{pod="p{tick}-{k}"}} 1')
+    return "\n".join(lines)
+
+peak_samples = 0
+for tick in range(480):  # 2 simulated minutes at 0.25s
+    ts = 1000.0 + 0.25 * tick
+    ingest_exposition(db, payload(3, tick), ts, "node", f"n{tick % 5}")
+    if tick % 40 == 0:
+        db.gc(ts)
+    peak_samples = max(peak_samples, db.stats()["samples"])
+
+st = db.stats()
+assert st["series"] <= 200, st
+assert st["samples"] <= 200 * 64, st
+assert st["dropped"].get("series_limit", 0) > 0, \
+    f"churn never hit the series ceiling: {st}"
+assert st["dropped"].get("retention", 0) > 0, \
+    f"retention never pruned: {st}"
+cap = 200 * 64
+print(f"mon_smoke: churn held TSDB at {st['series']} series / "
+      f"{peak_samples} peak samples (cap {cap}); dropped counters "
+      f"{st['dropped']}", flush=True)
+EOF
+echo "mon_smoke: ok"

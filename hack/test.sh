@@ -16,6 +16,9 @@
 # of tracing-off), hack/serve_smoke.sh (<60s inference-serving smoke:
 # InferenceService -> replicas ready -> open-loop burst -> autoscaler
 # scales up -> drain scales down -> SLO report printed),
+# hack/mon_smoke.sh (<60s kmon gate: gate-on LocalCluster scrape
+# convergence, ktl query/alerts/dash, deterministic chaos sick-chip
+# alert fire/taint/resolve, and the bounded-TSDB churn assertion),
 # hack/race.sh (<150s tpusan gate: chaos + queue +
 # preempt + HA smokes under explored task-interleaving schedules with
 # the cluster invariants armed) — all run on full-suite invocations;
@@ -31,6 +34,7 @@ if [ "$#" -eq 0 ] || [ "${KTPU_SMOKE:-}" = "1" ]; then
   ./hack/ha_smoke.sh
   ./hack/trace_smoke.sh
   ./hack/serve_smoke.sh
+  ./hack/mon_smoke.sh
   ./hack/race.sh
 fi
 exec python -m pytest tests/ -q "$@"

@@ -167,6 +167,11 @@ class APIServer:
         #: CodecPool when ApiServerCodecOffload is on (built at
         #: start()); None = all codec work inline, byte-identical.
         self.codec_pool = None
+        #: Callable returning the kmon MetricsPipeline (or None) —
+        #: wired by single-binary composers so /debug/v1/query and
+        #: /debug/v1/alerts can read the co-located TSDB/rule state.
+        #: Unwired (remote controller-manager) or gate-off: 404.
+        self.metrics_pipeline_provider = None
         #: Bounded staleness a follower tolerates before refusing a
         #: read the client marked with X-Ktpu-Max-Staleness (the
         #: client's header value wins when tighter).
@@ -742,6 +747,8 @@ class APIServer:
         # out-of-process components (multi-host agents).
         r.add_get("/debug/v1/traces", self._debug_traces)
         r.add_post("/debug/v1/traces", self._debug_traces_ingest)
+        r.add_get("/debug/v1/query", self._debug_query)
+        r.add_get("/debug/v1/alerts", self._debug_alerts)
         r.add_get("/apis", self._discovery)
         # kubeadm-join analog: exchange a bootstrap token for a durable
         # node credential (bootstrap.py; the CSR-signing step's end
@@ -1099,6 +1106,75 @@ class APIServer:
             "spans": spans,
             "dropped": tracing.COLLECTOR.dropped,
             "buffered": len(tracing.COLLECTOR),
+        })
+
+    def _pipeline_or_404(self):
+        """The co-located kmon pipeline, or NotFound — the route does
+        not exist unless the ClusterMetricsPipeline gate is on AND the
+        composer wired a provider (gate off must be byte-identical, and
+        a remote controller-manager has no in-process TSDB to read)."""
+        from ..util.features import GATES
+        pipeline = (self.metrics_pipeline_provider()
+                    if self.metrics_pipeline_provider is not None
+                    else None)
+        if pipeline is None \
+                or not GATES.enabled("ClusterMetricsPipeline"):
+            raise errors.NotFoundError(
+                "metrics pipeline not enabled (ClusterMetricsPipeline "
+                "gate off, or no co-located controller-manager)")
+        return pipeline
+
+    @staticmethod
+    def _float_param(value, name: str) -> float:
+        try:
+            return float(value)
+        except (TypeError, ValueError):
+            raise errors.BadRequestError(
+                f"query parameter {name!r} must be a number, "
+                f"got {value!r}") from None
+
+    async def _debug_query(self, request):
+        """``GET /debug/v1/query?query=<expr>[&time=][&start=&end=
+        &step=]`` — PromQL-lite over the kmon TSDB. With start/end:
+        a range query (matrix); otherwise instant (vector/scalar).
+        Instant evaluation is one pass over a bounded in-memory store
+        — microseconds, safe inline on the router loop. RANGE queries
+        re-evaluate the expression per step (up to 11k steps × a full
+        series scan each), so they run in a thread — the TSDB is
+        lock-protected for exactly this reader — instead of stalling
+        watches, binds, and heartbeats sharing the router loop."""
+        from ..monitoring.promql import PromQLError
+        pipeline = self._pipeline_or_404()
+        q = request.query
+        expr = q.get("query", "")
+        if not expr:
+            raise errors.BadRequestError("missing 'query' parameter")
+        try:
+            if "start" in q or "end" in q:
+                import time as _time
+                end = (self._float_param(q["end"], "end")
+                       if "end" in q else _time.time())
+                start = (self._float_param(q["start"], "start")
+                         if "start" in q else end - 300.0)
+                step = self._float_param(
+                    q.get("step", "") or str(pipeline.interval), "step")
+                data = await asyncio.to_thread(
+                    pipeline.query_range, expr, start, end, step)
+            else:
+                at = (self._float_param(q["time"], "time")
+                      if "time" in q else None)
+                data = pipeline.query_instant(expr, at)
+        except PromQLError as e:
+            raise errors.BadRequestError(str(e)) from None
+        return web.json_response({"status": "success", "data": data})
+
+    async def _debug_alerts(self, request):
+        """``GET /debug/v1/alerts`` — active (pending + firing) kmon
+        alerts plus pipeline/TSDB bound accounting."""
+        pipeline = self._pipeline_or_404()
+        return web.json_response({
+            "alerts": pipeline.alerts(),
+            "stats": pipeline.stats(),
         })
 
     async def _debug_traces_ingest(self, request):

@@ -1386,21 +1386,104 @@ async def _node_summaries(client, only: str = "") -> list[tuple]:
             *(scrape(node, session) for node in nodes)))
 
 
+async def _stale_node_aggregates(client) -> dict:
+    """Last-known ``tpu_node_*`` points from the kmon TSDB (range
+    queries over /debug/v1/query) for nodes that cannot be scraped live
+    — ``{node: {field: value, "age": seconds}}``. Empty when the
+    ClusterMetricsPipeline gate is off (404) or unreachable: callers
+    then render 'unreachable' exactly as before the pipeline existed."""
+    import time
+    out: dict = {}
+    now = time.time()
+    families = {
+        "tpu_node_chips": None,  # state label fans out below
+        "tpu_node_duty_cycle_avg_pct": "duty_avg_pct",
+        "tpu_node_hbm_used_bytes": "hbm_used_bytes",
+        "tpu_node_hbm_total_bytes": "hbm_total_bytes",
+        "tpu_node_tokens_per_sec": "tokens_per_sec",
+    }
+
+    async def instant(expr: str):
+        async with client._sess().get(
+                f"{client.base_url}/debug/v1/query",
+                params={"query": expr}) as r:
+            if r.status != 200:
+                return None
+            return (await r.json())["data"].get("result", [])
+
+    # All 10 queries in flight at once (two per family): a dead node
+    # already cost this command a scrape timeout; serializing debug
+    # round-trips on top would be the _node_summaries mistake again.
+    try:
+        results = await asyncio.gather(*(
+            instant(expr) for family in families
+            for expr in (f"last_over_time({family}[15m])",
+                         f"timestamp(last_over_time({family}[15m]))")))
+    except Exception:  # noqa: BLE001 — old server / no pipeline
+        return {}
+    for i, (family, field) in enumerate(families.items()):
+        values, stamps = results[2 * i], results[2 * i + 1]
+        if values is None or stamps is None:
+            return {}
+        ts_by_key = {tuple(sorted(e["metric"].items())): e["value"][1]
+                     for e in stamps}
+        for e in values:
+            labels = e["metric"]
+            node = labels.get("node", "")
+            if not node:
+                continue
+            ts = ts_by_key.get(tuple(sorted(labels.items())))
+            if ts is None:
+                continue
+            rec = out.setdefault(node, {"age": now - ts})
+            rec["age"] = min(rec["age"], now - ts)
+            if family == "tpu_node_chips":
+                rec[f"chips_{labels.get('state', '')}"] = e["value"][1]
+            else:
+                rec[field] = e["value"][1]
+    return out
+
+
 async def _top_nodes(client) -> int:
     """``ktl top nodes`` — per-node TPU telemetry rollup (the
-    aggregator's tpu_node_* view, computed from the same scrapes)."""
+    aggregator's tpu_node_* view, computed from the same scrapes).
+    Unscrapable nodes fall back to the kmon TSDB's last-known
+    aggregate, marked with a trailing ``*`` and a real AGE — a dead
+    node must read as stale data, never as silently fresh."""
     from ..monitoring.aggregator import ClusterMonitor
     rows = []
     per_pod: dict = {}
-    for node, summary in await _node_summaries(client):
+    summaries = await _node_summaries(client)
+    stale_info: dict = {}
+    if any(summary is None for _node, summary in summaries):
+        stale_info = await _stale_node_aggregates(client)
+    for node, summary in summaries:
+        name = node.metadata.name
         if summary is None:
-            rows.append([node.metadata.name, "-", "-", "-", "-", "-", "-",
-                         "unreachable"])
+            info = stale_info.get(name)
+            if not info:
+                rows.append([name, "-", "-", "-", "-", "-", "-", "-",
+                             "unreachable"])
+                continue
+            total = int(info.get("chips_total", 0))
+            hbm_total = info.get("hbm_total_bytes", 0.0)
+            tokens = info.get("tokens_per_sec", 0.0)
+            rows.append([
+                f"{name}*",
+                str(total),
+                str(int(info.get("chips_healthy", 0))),
+                str(int(info.get("chips_assigned", 0))),
+                (f"{info.get('duty_avg_pct', 0.0):.1f}%"
+                 if total else "-"),
+                (f"{info.get('hbm_used_bytes', 0.0) / 2**30:.1f}Gi/"
+                 f"{hbm_total / 2**30:.1f}Gi" if hbm_total else "-"),
+                f"{tokens:.0f}" if tokens else "-",
+                printers.age_seconds(info["age"]),
+                "stale"])
             continue
-        agg = ClusterMonitor._aggregate_node(
-            node.metadata.name, summary, per_pod)
+        agg = ClusterMonitor._aggregate_node(name, summary, per_pod)
         rows.append([
-            node.metadata.name,
+            name,
             str(agg["chips"]),
             str(agg["healthy"]),
             str(agg["assigned"]),
@@ -1410,10 +1493,11 @@ async def _top_nodes(client) -> int:
              if agg["hbm_total_bytes"] else "-"),
             (f"{agg['tokens_per_sec']:.0f}"
              if agg["tokens_per_sec"] else "-"),
+            "0s",
             f"{agg['pods']} pods"])
     print(printers.render_table(
         ["NODE", "CHIPS", "HEALTHY", "ASSIGNED", "DUTY", "HBM",
-         "TOK/S", "WORKLOAD"], rows))
+         "TOK/S", "AGE", "WORKLOAD"], rows))
     return 0
 
 
@@ -1692,6 +1776,193 @@ async def cmd_trace(args) -> int:
                 ctx.trace_id)
             print(_render_trace(f"pod {args.namespace}/{name}",
                                 ctx.trace_id, spans, events))
+        return 0
+    finally:
+        await client.close()
+
+
+async def _kmon_get(client, path: str, params: dict) -> dict:
+    """GET a kmon debug surface with the client's own session (CA
+    trust + credentials). 404 = the gate is off — say so instead of
+    printing an empty table that looks like a healthy cluster."""
+    async with client._sess().get(f"{client.base_url}{path}",
+                                  params=params) as r:
+        if r.status == 404:
+            raise SystemExit(
+                "ktl: metrics pipeline not enabled on this cluster "
+                "(start with --feature-gates ClusterMetricsPipeline"
+                "=true)")
+        if r.status != 200:
+            raise SystemExit(f"ktl: {path} answered {r.status}: "
+                             f"{(await r.text())[:200]}")
+        return await r.json()
+
+
+_SPARK_BLOCKS = "▁▂▃▄▅▆▇█"
+
+
+def _sparkline(values: list, width: int = 40) -> str:
+    """Text sparkline, min-max scaled over the FINITE values; NaN/inf
+    (legitimate PromQL division results) render as '·' instead of
+    crashing the int() conversion."""
+    import math
+    if not values:
+        return ""
+    if len(values) > width:
+        # Downsample keep-last per bucket — the newest point always
+        # renders (it is the one being watched).
+        step = len(values) / width
+        values = [values[min(len(values) - 1, int((i + 1) * step) - 1)]
+                  for i in range(width)]
+    finite = [v for v in values if math.isfinite(v)]
+    if not finite:
+        return "·" * len(values)
+    lo, hi = min(finite), max(finite)
+    span = hi - lo
+    out = []
+    for v in values:
+        if not math.isfinite(v):
+            out.append("·")
+        elif span <= 0:
+            out.append(_SPARK_BLOCKS[0])
+        else:
+            out.append(_SPARK_BLOCKS[min(
+                len(_SPARK_BLOCKS) - 1,
+                int((v - lo) / span * len(_SPARK_BLOCKS)))])
+    return "".join(out)
+
+
+def _fmt_metric_labels(labels: dict) -> str:
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items())
+                     if k != "__name__")
+    return "{" + inner + "}" if inner else "{}"
+
+
+async def cmd_query(args) -> int:
+    """``ktl query <expr>`` — PromQL-lite over the kmon TSDB (instant
+    by default; ``--range 5m`` evaluates a range and renders one
+    sparkline per series)."""
+    import json as _json
+    client = make_client(args)
+    try:
+        params = {"query": args.expr}
+        if args.range:
+            import time
+            from ..monitoring.promql import parse_duration
+            window = parse_duration(args.range)
+            now = time.time()
+            params["start"] = f"{now - window:.3f}"
+            params["end"] = f"{now:.3f}"
+            if args.step:
+                params["step"] = str(parse_duration(args.step))
+        data = (await _kmon_get(client, "/debug/v1/query", params))["data"]
+        if args.output == "json":
+            print(_json.dumps(data, indent=2, sort_keys=True))
+            return 0
+        if data["resultType"] == "scalar":
+            print(f"{data['result'][1]:g}")
+            return 0
+        if data["resultType"] == "vector":
+            rows = [[_fmt_metric_labels(e["metric"]),
+                     f"{e['value'][1]:g}"]
+                    for e in data["result"]]
+            print(printers.render_table(["SERIES", "VALUE"], rows))
+            return 0
+        rows = []
+        for series in data["result"]:
+            vals = [v for _ts, v in series["values"]]
+            rows.append([
+                _fmt_metric_labels(series["metric"]),
+                _sparkline(vals),
+                f"{min(vals):g}", f"{max(vals):g}", f"{vals[-1]:g}"])
+        print(printers.render_table(
+            ["SERIES", "TREND", "MIN", "MAX", "LAST"], rows))
+        return 0
+    finally:
+        await client.close()
+
+
+async def cmd_alerts(args) -> int:
+    """``ktl alerts`` — active kmon alerts (pending + firing)."""
+    import json as _json
+    import time
+    client = make_client(args)
+    try:
+        data = await _kmon_get(client, "/debug/v1/alerts", {})
+        if args.output == "json":
+            print(_json.dumps(data, indent=2, sort_keys=True))
+            return 0
+        now = time.time()
+        rows = []
+        for a in data["alerts"]:
+            labels = {k: v for k, v in a["labels"].items()
+                      if k not in ("job", "instance")} \
+                or {k: v for k, v in a["labels"].items()}
+            rows.append([
+                a["name"], a["severity"], a["state"],
+                printers.age_seconds(now - a["active_since"]),
+                _fmt_metric_labels(labels),
+                f"{a['value']:g}"])
+        if not rows:
+            print("No active alerts.")
+            return 0
+        print(printers.render_table(
+            ["ALERT", "SEVERITY", "STATE", "SINCE", "LABELS", "VALUE"],
+            rows))
+        return 0
+    finally:
+        await client.close()
+
+
+#: The dash panels: built-in recording rules (rules.py) + the scrape
+#: health vector. (title, expr) — each renders one sparkline row per
+#: series over the dash window.
+_DASH_PANELS = (
+    ("cluster duty %", "cluster:tpu_duty:avg"),
+    ("tokens/s", "cluster:tpu_tokens:sum"),
+    ("unhealthy chips", "cluster:chips_unhealthy:sum"),
+    ("HBM used (GiB)", "cluster:hbm_used:sum / 1073741824"),
+    ("targets up", "job:up:sum"),
+    ("apiserver busy", "apiserver:loop_busy:max"),
+)
+
+
+async def cmd_dash(args) -> int:
+    """``ktl dash`` — text dashboard over the built-in recording rules
+    (the Grafana-analog single screen)."""
+    import time
+    from ..monitoring.promql import parse_duration
+    client = make_client(args)
+    try:
+        window = parse_duration(args.range)
+        now = time.time()
+        rows = []
+        for title, expr in _DASH_PANELS:
+            data = (await _kmon_get(client, "/debug/v1/query", {
+                "query": expr,
+                "start": f"{now - window:.3f}",
+                "end": f"{now:.3f}"}))["data"]
+            result = data.get("result") or []
+            if not result:
+                rows.append([title, "", "-", "no data"])
+                continue
+            for series in result:
+                vals = [v for _ts, v in series["values"]]
+                label = _fmt_metric_labels(series["metric"])
+                rows.append([
+                    title if series is result[0] else "",
+                    _sparkline(vals, width=32),
+                    f"{vals[-1]:g}",
+                    label if label != "{}" else ""])
+        alerts = (await _kmon_get(client, "/debug/v1/alerts", {}))
+        firing = [a for a in alerts["alerts"] if a["state"] == "firing"]
+        print(f"kmon dash  window={args.range}  "
+              f"firing_alerts={len(firing)}")
+        print(printers.render_table(
+            ["PANEL", "TREND", "LAST", "SERIES"], rows))
+        for a in firing:
+            print(f"  FIRING {a['name']} [{a['severity']}] "
+                  f"{_fmt_metric_labels(a['labels'])} {a['summary']}")
         return 0
     finally:
         await client.close()
@@ -2660,6 +2931,27 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("-n", "--namespace", default="default")
     sp.add_argument("-o", "--output", default="", help="''|json")
 
+    sp = add("query", cmd_query,
+             help="PromQL-lite query over the kmon metrics TSDB")
+    sp.add_argument("expr", help="e.g. 'up == 0', "
+                                 "'rate(tpu_ici_tx_bytes[30s])'")
+    sp.add_argument("--range", default="",
+                    help="evaluate over a trailing window (e.g. 5m) "
+                         "instead of one instant")
+    sp.add_argument("--step", default="",
+                    help="range resolution (default: scrape interval)")
+    sp.add_argument("-o", "--output", default="", help="''|json")
+
+    sp = add("alerts", cmd_alerts,
+             help="active kmon alerts (pending + firing)")
+    sp.add_argument("-o", "--output", default="", help="''|json")
+
+    sp = add("dash", cmd_dash,
+             help="text sparkline dashboard over the kmon recording "
+                  "rules")
+    sp.add_argument("--range", default="5m",
+                    help="dash window (default 5m)")
+
     add("api-resources", cmd_api_resources, help="list server resources")
     add("version", cmd_version, help="client+server version")
 
@@ -2831,10 +3123,12 @@ def main(argv: Optional[list[str]] = None) -> int:
         return 1
     except KeyboardInterrupt:
         return 130
-    except Exception as e:  # noqa: BLE001 — bad jsonpath input must
-        # print cleanly; every other exception stays a loud traceback
+    except Exception as e:  # noqa: BLE001 — bad jsonpath/promql input
+        # must print cleanly; every other exception stays a loud
+        # traceback
+        from ..monitoring.promql import PromQLError
         from .jsonpath import JsonPathError
-        if isinstance(e, JsonPathError):
+        if isinstance(e, (JsonPathError, PromQLError)):
             print(f"Error: {e}", file=sys.stderr)
             return 1
         raise

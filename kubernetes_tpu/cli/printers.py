@@ -12,18 +12,23 @@ from ..api import types as t
 from ..api.meta import now
 
 
-def age(meta) -> str:
-    ts = meta.creation_timestamp
-    if ts is None:
-        return "<unknown>"
-    delta = now() - ts
-    secs = int(delta.total_seconds())
+def age_seconds(secs: float) -> str:
+    """Compact duration (``37s``/``5m``/``2h``/``3d``) — shared by
+    object-age columns and the telemetry staleness columns."""
+    secs = int(secs)
     if secs < 0:
         secs = 0
     for unit, span in (("d", 86400), ("h", 3600), ("m", 60)):
         if secs >= span:
             return f"{secs // span}{unit}"
     return f"{secs}s"
+
+
+def age(meta) -> str:
+    ts = meta.creation_timestamp
+    if ts is None:
+        return "<unknown>"
+    return age_seconds((now() - ts).total_seconds())
 
 
 def render_table(headers: list[str], rows: list[list[str]]) -> str:

@@ -22,18 +22,21 @@ def ssl_kw(ssl_ctx) -> dict:
     return {"ssl": ssl_ctx} if ssl_ctx is not None else {}
 
 
-async def resolve_node_agent(client, node_name: str
+async def resolve_node_agent(client, node_name: str, node: Any = None
                              ) -> Optional[tuple[str, Any]]:
     """(base URL, ssl context or None) for the node's agent server, or
     None when unreachable/unresolvable. ``client`` supplies both the
     Node object and (for TLS nodes) its own credentials
     (``client.ssl_context``). Candidates are PROBED (/healthz) so the
     loopback fallback actually engages when the published address is
-    unreachable — a cheap GET that every consumer needs anyway."""
-    try:
-        node = await client.get("nodes", "", node_name)
-    except errors.StatusError:
-        return None
+    unreachable — a cheap GET that every consumer needs anyway.
+    Callers that already hold the Node object (a sweep that just
+    LISTed the fleet) pass it via ``node`` to skip the per-node GET."""
+    if node is None:
+        try:
+            node = await client.get("nodes", "", node_name)
+        except errors.StatusError:
+            return None
     port = node.status.daemon_endpoints.get("agent")
     if not port:
         return None

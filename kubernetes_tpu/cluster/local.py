@@ -108,6 +108,7 @@ class LocalCluster:
                  heartbeat_interval: float = 5.0,
                  monitor_interval: float = 10.0,
                  autoscale_interval: float = 2.0,
+                 metrics_interval: float = 5.0,
                  authorization_mode: str = "AlwaysAllow",
                  user_groups: Optional[dict] = None,
                  audit_log: str = "",
@@ -132,6 +133,9 @@ class LocalCluster:
         #: (serving smokes shorten these to act inside their budget).
         self.monitor_interval = monitor_interval
         self.autoscale_interval = autoscale_interval
+        #: kmon scrape/rule cadence (mon_smoke shortens it); only read
+        #: when the ClusterMetricsPipeline gate is on.
+        self.metrics_interval = metrics_interval
         self.authorization_mode = authorization_mode
         self.user_groups = user_groups
         self.audit_log = audit_log
@@ -248,7 +252,14 @@ class LocalCluster:
         if self.scheduler_policy:
             from ..scheduler.policy import load_policy
             sched_policy = load_policy(self.scheduler_policy)
-        self.scheduler = Scheduler(local, policy=sched_policy)
+        # kmon (ClusterMetricsPipeline, default off): the scheduler and
+        # controller-manager expose /metrics listeners for the scrape
+        # manager, and the apiserver's /debug/v1/query reads the
+        # co-located pipeline. Gate off: no listeners, no provider —
+        # byte-identical.
+        kmon_on = GATES.enabled("ClusterMetricsPipeline")
+        self.scheduler = Scheduler(local, policy=sched_policy,
+                                   metrics_port=0 if kmon_on else None)
         await self.scheduler.start()
         scrape_ssl = None
         if self.ca is not None:
@@ -260,12 +271,23 @@ class LocalCluster:
             scrape_ssl = client_ssl_context(
                 self.ca.ca_cert_path, self.admin_cert.cert_path,
                 self.admin_cert.key_path, check_hostname=False)
+        component_urls = []
+        if kmon_on and self.scheduler.metrics_listener is not None:
+            component_urls.append(
+                ("scheduler", self.scheduler.metrics_listener.url))
         self.controller_manager = ControllerManager(
             local, node_scrape_ssl=scrape_ssl,
             queueing_fits_probe=self._queueing_fits_probe,
             monitor_interval=self.monitor_interval,
-            autoscale_interval=self.autoscale_interval)
+            autoscale_interval=self.autoscale_interval,
+            metrics_interval=self.metrics_interval,
+            apiserver_urls=[self.base_url],
+            component_urls=component_urls)
         await self.controller_manager.start()
+        if kmon_on:
+            cm = self.controller_manager
+            self.server.metrics_pipeline_provider = \
+                lambda: cm.get_controller("metrics-pipeline")
 
         # Cluster DNS (kube-dns addon analog): A records for services +
         # headless per-pod rank hostnames; agents inject

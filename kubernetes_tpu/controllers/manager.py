@@ -97,12 +97,27 @@ def _cluster_monitor(client, factory, **kw):
 DEFAULT_CONTROLLERS["cluster-monitor"] = _cluster_monitor
 
 
+def _metrics_pipeline(client, factory, **kw):
+    # Lazy like the monitor: kmon machinery is only paid for when the
+    # ClusterMetricsPipeline gate is on (the controller is inert off).
+    from ..monitoring.pipeline import MetricsPipeline
+    return MetricsPipeline(client, factory, **kw)
+
+
+#: kmon Prometheus-analog pipeline (monitoring/pipeline.py): scrape
+#: manager -> bounded TSDB -> PromQL-lite -> recording/alerting rules;
+#: inert unless the ClusterMetricsPipeline gate is on.
+DEFAULT_CONTROLLERS["metrics-pipeline"] = _metrics_pipeline
+
+
 class ControllerManager:
     def __init__(self, client: Client, controllers: Optional[list[str]] = None,
                  leader_elect: bool = False, identity: str = "",
                  node_scrape_ssl=None, queueing_fits_probe=None,
                  monitor_interval: float = 10.0,
-                 autoscale_interval: float = 2.0):
+                 autoscale_interval: float = 2.0,
+                 metrics_interval: float = 5.0,
+                 apiserver_urls=(), component_urls=()):
         self.client = client
         #: Cluster credentials for scraping TLS node servers (the HPA's
         #: real metrics pipeline); the composer wires CA + identity.
@@ -115,6 +130,18 @@ class ControllerManager:
         #: (smokes shorten both; production keeps the defaults).
         self.monitor_interval = monitor_interval
         self.autoscale_interval = autoscale_interval
+        #: kmon scrape/rule-evaluation cadence + the scrape targets the
+        #: composer knows about (apiserver URLs incl. HA replicas;
+        #: (job, url) pairs for component metrics listeners). Only read
+        #: when the ClusterMetricsPipeline gate is on.
+        self.metrics_interval = metrics_interval
+        self.apiserver_urls = list(apiserver_urls)
+        self.component_urls = list(component_urls)
+        #: The manager's own /metrics listener (metrics/http.py),
+        #: started with the controllers when the pipeline gate is on so
+        #: the scrape manager reaches controller-side series the same
+        #: way it reaches the scheduler's.
+        self.metrics_listener = None
         self.names = list(controllers or DEFAULT_CONTROLLERS)
         self.leader_elect = leader_elect
         self.identity = identity or f"cm-{uuid.uuid4().hex[:8]}"
@@ -141,11 +168,38 @@ class ControllerManager:
         if name == "inference":
             return {"autoscale_interval": self.autoscale_interval,
                     "max_snapshot_age": max(3 * self.monitor_interval, 10.0)}
+        if name == "metrics-pipeline":
+            urls = list(self.component_urls)
+            if self.metrics_listener is not None \
+                    and self.metrics_listener.url:
+                urls.append(("controller-manager",
+                             self.metrics_listener.url))
+            kw = {"interval": self.metrics_interval,
+                  "apiserver_urls": self.apiserver_urls,
+                  "component_urls": urls}
+            if self.node_scrape_ssl is not None:
+                kw["ssl_context"] = self.node_scrape_ssl
+            return kw
         return {}
+
+    def get_controller(self, name: str):
+        """A running controller by its table name, or None — the
+        composer's seam for wiring debug surfaces (the apiserver's
+        /debug/v1/query reads the metrics-pipeline through this)."""
+        for c in self.controllers:
+            if getattr(c, "name", "") == name:
+                return c
+        return None
 
     async def _run_controllers(self) -> None:
         """Build fresh controllers + informers (a re-elected manager must
         relist, not trust caches from a previous term)."""
+        from ..util.features import GATES
+        if GATES.enabled("ClusterMetricsPipeline") \
+                and self.metrics_listener is None:
+            from ..metrics.http import MetricsListener
+            self.metrics_listener = MetricsListener(port=0)
+            await self.metrics_listener.start()
         self.factory = InformerFactory(self.client)
         self.controllers = [
             DEFAULT_CONTROLLERS[name](self.client, self.factory,
@@ -162,6 +216,14 @@ class ControllerManager:
                     and getattr(c, "metrics_feed", None) is None \
                     and monitor is not None:
                 c.metrics_feed = monitor.latest
+        # The kmon pipeline records the CO-LOCATED monitor's rollups
+        # into its TSDB (the latest()/query-surface consistency
+        # contract) — same post-construction wiring as the autoscaler.
+        for c in self.controllers:
+            if getattr(c, "name", "") == "metrics-pipeline" \
+                    and getattr(c, "monitor", None) is None \
+                    and monitor is not None:
+                c.monitor = monitor
         for c in self.controllers:
             await c.start()
         log.info("controller-manager: %d controllers running",
@@ -180,6 +242,9 @@ class ControllerManager:
         if self.factory is not None:
             await self.factory.stop_all()
         self.controllers = []
+        if self.metrics_listener is not None:
+            await self.metrics_listener.stop()
+            self.metrics_listener = None
 
     async def start(self) -> None:
         loop = asyncio.get_running_loop()

@@ -1,0 +1,63 @@
+"""Metrics HTTP listener — ``/metrics`` for non-apiserver components.
+
+Until this PR only the apiserver served its registry over HTTP; the
+scheduler and controller-manager exported into the process registry
+with no listener, so a scrape manager could not reach them when they
+run as their own processes. This is the missing kube-scheduler
+``--secure-port /metrics`` analog: a minimal aiohttp app serving the
+(shared or injected) registry's text exposition plus ``/healthz``.
+
+Registry CONTENT is unchanged — the listener renders exactly what the
+component already registered. Loopback HTTP by default: metrics are
+read-only operational data and the kmon scrape manager runs on the
+same trust domain; components that need TLS pass ``ssl_context``.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from aiohttp import web
+
+from .registry import REGISTRY, MetricsRegistry
+
+log = logging.getLogger("metrics.http")
+
+
+class MetricsListener:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 registry: Optional[MetricsRegistry] = None,
+                 ssl_context=None):
+        self.host = host
+        self.port = port
+        self.registry = registry if registry is not None else REGISTRY
+        self._ssl = ssl_context
+        self._runner: Optional[web.AppRunner] = None
+        self.url = ""
+
+    async def start(self) -> str:
+        app = web.Application()
+        app.router.add_get("/metrics", self._metrics)
+        app.router.add_get("/healthz", self._healthz)
+        self._runner = web.AppRunner(app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port,
+                           ssl_context=self._ssl)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]
+        scheme = "https" if self._ssl is not None else "http"
+        self.url = f"{scheme}://{self.host}:{self.port}"
+        log.info("metrics listener on %s", self.url)
+        return self.url
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+
+    async def _metrics(self, request: web.Request) -> web.Response:
+        return web.Response(text=self.registry.render(),
+                            content_type="text/plain")
+
+    async def _healthz(self, request: web.Request) -> web.Response:
+        return web.Response(text="ok")

@@ -1,8 +1,15 @@
-"""Cluster-level monitoring — the metrics-server / DCGM-rollup analog.
+"""Cluster-level monitoring — the metrics-server / DCGM-rollup analog,
+plus kmon, the in-process Prometheus analog.
 
-Aggregates every node's ``/stats/summary`` into cluster-level
-``tpu_cluster_*`` / per-node ``tpu_node_*`` series (aggregator.py) and
-keeps a queryable snapshot — the custom-metrics seam the ROADMAP's
-inference-autoscaling item will scale on.
+Two halves:
+
+- ``aggregator.py`` (ClusterMonitor): every node's ``/stats/summary``
+  rolled into cluster-level ``tpu_cluster_*`` / per-node ``tpu_node_*``
+  series + the ``latest()`` snapshot the inference autoscaler reads.
+- kmon (gate ``ClusterMetricsPipeline``): ``scrape.py`` (scrape
+  manager) -> ``tsdb.py`` (bounded ring store) -> ``promql.py``
+  (PromQL-lite, served at ``/debug/v1/query`` / ``ktl query``) ->
+  ``rules.py`` (recording + alerting rules) -> ``pipeline.py``
+  (the controller tying them together: Events + gated node taints).
 """
 from .aggregator import ClusterMonitor  # noqa: F401

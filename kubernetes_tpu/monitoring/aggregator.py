@@ -319,6 +319,55 @@ class ClusterMonitor:
                                   for a in per_node.values()),
         }
 
+    @staticmethod
+    def rollup_points(snapshot: dict) -> tuple[list, list]:
+        """``(points, stale_nodes)`` for TSDB recording (the kmon
+        pipeline's satellite seam): ``points`` is
+        ``[(name, labels, value), ...]`` mirroring EXACTLY the gauge
+        families :meth:`_export_cluster` / :meth:`_export_node` publish
+        — one mapping, so ``latest()`` and the TSDB can never disagree
+        on a value; ``stale_nodes`` are nodes whose aggregate is the
+        carried-forward last-known copy — those series must NOT advance
+        (their TSDB age is how ``ktl top nodes`` shows staleness)."""
+        points: list = []
+        roll = snapshot.get("cluster") or {}
+        if roll:
+            for state in ("total", "healthy", "unhealthy", "assigned",
+                          "idle"):
+                points.append(("tpu_cluster_chips", {"state": state},
+                               float(roll[f"chips_{state}"])))
+            points.append(("tpu_cluster_duty_cycle_avg_pct", {},
+                           roll["duty_avg_pct"]))
+            points.append(("tpu_cluster_hbm_used_bytes", {},
+                           float(roll["hbm_used_bytes"])))
+            points.append(("tpu_cluster_hbm_total_bytes", {},
+                           float(roll["hbm_total_bytes"])))
+            points.append(("tpu_cluster_tokens_per_sec", {},
+                           round(roll["tokens_per_sec"], 3)))
+        stale_nodes: list = []
+        for name, agg in (snapshot.get("nodes") or {}).items():
+            if agg.get("stale"):
+                stale_nodes.append(name)
+                continue
+            points.append(("tpu_node_chips",
+                           {"node": name, "state": "total"},
+                           float(agg["chips"])))
+            points.append(("tpu_node_chips",
+                           {"node": name, "state": "healthy"},
+                           float(agg["healthy"])))
+            points.append(("tpu_node_chips",
+                           {"node": name, "state": "assigned"},
+                           float(agg["assigned"])))
+            points.append(("tpu_node_duty_cycle_avg_pct",
+                           {"node": name}, agg["duty_avg_pct"]))
+            points.append(("tpu_node_hbm_used_bytes", {"node": name},
+                           float(agg["hbm_used_bytes"])))
+            points.append(("tpu_node_hbm_total_bytes", {"node": name},
+                           float(agg["hbm_total_bytes"])))
+            points.append(("tpu_node_tokens_per_sec", {"node": name},
+                           round(agg["tokens_per_sec"], 3)))
+        return points, stale_nodes
+
     def _prune_departed(self, live: set[str]) -> None:
         for name in self._exported_nodes - live:
             for state in ("total", "healthy", "assigned"):

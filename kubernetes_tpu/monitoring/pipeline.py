@@ -1,0 +1,273 @@
+"""MetricsPipeline — scrape -> TSDB -> rules -> Events/taints.
+
+The controller-manager table entry ("metrics-pipeline") that composes
+the kmon subsystem behind the ``ClusterMetricsPipeline`` gate (alpha,
+default off — gate off means no scrape traffic, no TSDB, and the
+apiserver's ``/debug/v1/query`` route answers 404):
+
+1. :class:`~.scrape.ScrapeManager` sweeps every control-plane and node
+   ``/metrics`` endpoint into the bounded :class:`~.tsdb.TSDB`;
+2. the co-located ClusterMonitor's rollup snapshot is recorded into
+   the same store each tick (``aggregator.rollup_points`` — one value
+   mapping, so ``latest()`` and the query surface cannot disagree;
+   carried-forward stale node aggregates are stale-MARKED, not
+   re-stamped, so their age is visible);
+3. the :class:`~.rules.RuleEngine` evaluates recording + alerting
+   rules; fire/resolve transitions become Events (on the Node when the
+   alert names one, else on the kube-system Namespace), and — behind
+   the ``AlertNodeTainting`` sub-gate — a ``tpu.google.com/degraded``
+   NoSchedule taint on the offending node, removed when the node's
+   last degrading alert resolves. That taint is the seam the ROADMAP
+   item-5 migration controller consumes.
+
+Env knobs: ``KTPU_KMON_RETENTION`` (seconds, default 900),
+``KTPU_KMON_MAX_SERIES`` (default 20000), ``KTPU_KMON_MAX_SAMPLES``
+(per series, default 512).
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import time
+from copy import deepcopy
+from typing import Optional, Sequence
+
+from ..api import errors
+from ..api import types as t
+from ..api.meta import now as meta_now
+from ..client.interface import Client
+from ..client.record import EventRecorder
+from ..util.tasks import spawn
+from . import promql
+from .rules import (TAINT_DEGRADED, RuleEngine, Transition,
+                    builtin_recording_rules, builtin_rules)
+from .scrape import ScrapeManager
+from .tsdb import TSDB
+
+log = logging.getLogger("kmon")
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+class MetricsPipeline:
+    """Controller-table ctor shape (client, factory, **kw); the
+    informer factory is unused — like the ClusterMonitor, a scrape
+    loop needs live endpoints, not a watch cache."""
+
+    name = "metrics-pipeline"
+
+    def __init__(self, client: Client, factory=None,
+                 interval: float = 5.0, ssl_context=None,
+                 apiserver_urls: Sequence[str] = (),
+                 component_urls: Sequence[tuple[str, str]] = ()):
+        self.client = client
+        self.interval = interval
+        retention = _env_float("KTPU_KMON_RETENTION", 900.0)
+        self.tsdb = TSDB(
+            retention_seconds=retention,
+            max_samples_per_series=int(
+                _env_float("KTPU_KMON_MAX_SAMPLES", 512)),
+            max_series=int(_env_float("KTPU_KMON_MAX_SERIES", 20_000)),
+            # Step-aligned keep-last downsampling at the scrape
+            # cadence: two sweeps jittering into one interval cost one
+            # ring slot, and range queries see a regular grid.
+            step=interval)
+        self.scraper = ScrapeManager(
+            client, self.tsdb, interval=interval,
+            ssl_context=ssl_context, apiserver_urls=apiserver_urls,
+            component_urls=component_urls)
+        #: Instant-query freshness: wide enough to bridge a couple of
+        #: missed sweeps, never wider than the Prometheus default (a
+        #: dead target is cut off by staleness markers regardless).
+        self.lookback = min(max(5 * interval, 2.5),
+                            promql.DEFAULT_LOOKBACK)
+        self.rules = RuleEngine(
+            self.tsdb, alert_rules=builtin_rules(interval),
+            recording_rules=builtin_recording_rules(),
+            lookback=self.lookback)
+        self.recorder = EventRecorder(client, "kmon")
+        #: Wired by the controller-manager after construction (both
+        #: live in its table) — rollup recording source.
+        self.monitor = None
+        #: Node -> firing taint-rule alert count (untaint at zero).
+        self._taint_refs: dict[str, int] = {}
+        self._task: Optional[asyncio.Task] = None
+        self.ticks = 0
+
+    # -- lifecycle --------------------------------------------------------
+
+    async def start(self) -> None:
+        from ..util.features import GATES
+        if not GATES.enabled("ClusterMetricsPipeline"):
+            return
+        self._task = spawn(self._loop(), name="metrics-pipeline")
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    async def _loop(self) -> None:
+        while True:
+            try:
+                await self.tick()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 — telemetry loop
+                log.warning("kmon tick failed: %s", e)
+            await asyncio.sleep(self.interval)
+
+    # -- one tick ---------------------------------------------------------
+
+    async def tick(self, now: Optional[float] = None) -> list[Transition]:
+        """Scrape, record rollups, evaluate rules, act on transitions
+        (tests call this directly for exact control)."""
+        now = time.time() if now is None else now
+        await self.scraper.sweep(now)
+        self._record_rollup()
+        transitions = self.rules.evaluate(now)
+        for tr in transitions:
+            try:
+                await self._act(tr)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 — one alert's side
+                # effect failing must not wedge the loop or the rest
+                log.warning("kmon: %s on %s failed: %s",
+                            tr.kind, tr.rule.name, e)
+        self.ticks += 1
+        return transitions
+
+    def _record_rollup(self) -> None:
+        if self.monitor is None:
+            return
+        snap = self.monitor.latest()
+        at = snap.get("at") or 0.0
+        if not at:
+            return
+        from .aggregator import ClusterMonitor
+        points, stale_nodes = ClusterMonitor.rollup_points(snap)
+        for name, labels, value in points:
+            self.tsdb.add(name, labels, value, at)
+        from .tsdb import Matcher
+        for node in stale_nodes:
+            # Only the monitor-owned tpu_node_* families: the node's
+            # directly scraped chip series have their own staleness
+            # edge in the scrape manager.
+            for family in ("tpu_node_chips",
+                           "tpu_node_duty_cycle_avg_pct",
+                           "tpu_node_hbm_used_bytes",
+                           "tpu_node_hbm_total_bytes",
+                           "tpu_node_tokens_per_sec"):
+                self.tsdb.mark_stale(at, matchers=[
+                    Matcher("node", "=", node)], name=family)
+
+    # -- transition side effects -----------------------------------------
+
+    async def _act(self, tr: Transition) -> None:
+        node_name = tr.labels.get("node", "")
+        obj = await self._event_object(node_name)
+        labels = " ".join(f"{k}={v}" for k, v in
+                          sorted(tr.labels.items())) or "cluster"
+        if tr.kind == "firing":
+            if obj is not None:
+                self.recorder.event(
+                    obj, "Warning", tr.rule.name,
+                    f"[{tr.rule.severity}] {tr.rule.summary} "
+                    f"({labels}; value={tr.value:g})")
+            if self._taintable(tr) and node_name:
+                self._taint_refs[node_name] = \
+                    self._taint_refs.get(node_name, 0) + 1
+                await self._set_degraded_taint(node_name, True,
+                                               tr.rule.name)
+        else:
+            if obj is not None:
+                self.recorder.event(
+                    obj, "Normal", tr.rule.name,
+                    f"resolved: {tr.rule.summary} ({labels})")
+            if self._taintable(tr) and node_name:
+                left = self._taint_refs.get(node_name, 1) - 1
+                if left <= 0:
+                    self._taint_refs.pop(node_name, None)
+                    await self._set_degraded_taint(node_name, False, "")
+                else:
+                    self._taint_refs[node_name] = left
+
+    @staticmethod
+    def _taintable(tr: Transition) -> bool:
+        from ..util.features import GATES
+        return tr.rule.taint and GATES.enabled("AlertNodeTainting")
+
+    async def _event_object(self, node_name: str):
+        """The object the alert Event hangs off: the named Node, else
+        the kube-system Namespace (cluster-scoped alerts)."""
+        try:
+            if node_name:
+                return await self.client.get("nodes", "", node_name)
+            return await self.client.get("namespaces", "", "kube-system")
+        except errors.StatusError:
+            return None
+
+    async def _set_degraded_taint(self, node_name: str, on: bool,
+                                  alertname: str) -> None:
+        """Add/remove the degraded NoSchedule taint, conflict-retried:
+        the lifecycle controller rewrites taints on its own cadence and
+        must not be able to starve this write."""
+        for _attempt in range(3):
+            try:
+                node = await self.client.get("nodes", "", node_name)
+            except errors.StatusError:
+                return
+            has = any(taint.key == TAINT_DEGRADED
+                      for taint in node.spec.taints)
+            if has == on:
+                return
+            fresh = deepcopy(node)
+            fresh.spec.taints = [taint for taint in fresh.spec.taints
+                                 if taint.key != TAINT_DEGRADED]
+            if on:
+                fresh.spec.taints.append(t.Taint(
+                    key=TAINT_DEGRADED, value=alertname,
+                    effect="NoSchedule", time_added=meta_now()))
+            try:
+                await self.client.update(fresh)
+                return
+            except errors.ConflictError:
+                continue
+            except errors.NotFoundError:
+                return
+        log.warning("kmon: degraded-taint write on %s kept conflicting",
+                    node_name)
+
+    # -- the query surface (apiserver /debug/v1/*, ktl) -------------------
+
+    def query_instant(self, expr: str, at: Optional[float] = None) -> dict:
+        return promql.query_instant(
+            self.tsdb, expr, time.time() if at is None else at,
+            lookback=self.lookback)
+
+    def query_range(self, expr: str, start: float, end: float,
+                    step: float) -> dict:
+        return promql.query_range(self.tsdb, expr, start, end, step,
+                                  lookback=self.lookback)
+
+    def alerts(self) -> list[dict]:
+        return self.rules.alerts()
+
+    def firing_names(self) -> set[str]:
+        return {i.rule.name for i in self.rules.firing()}
+
+    def stats(self) -> dict:
+        return {"tsdb": self.tsdb.stats(),
+                "sweeps": self.scraper.sweeps, "ticks": self.ticks,
+                "interval": self.interval}

@@ -1,0 +1,590 @@
+"""PromQL-lite — the query half of the kmon pipeline.
+
+A deliberately small, total subset of PromQL evaluated over the
+in-process :class:`~kubernetes_tpu.monitoring.tsdb.TSDB`:
+
+- instant + range selectors: ``name{label="v",other!="x",re=~"a.*"}``,
+  ``name{...}[30s]``;
+- functions: ``rate``, ``increase``, ``avg_over_time``,
+  ``min_over_time``, ``max_over_time``, ``sum_over_time``,
+  ``count_over_time``, ``last_over_time`` (newest raw sample in the
+  window, staleness markers excluded), ``quantile_over_time(q, sel[d])``
+  (nearest-rank over RAW samples, the bench-harness discipline),
+  ``scalar``, ``abs``, ``timestamp`` (the sample timestamp of each
+  element — with ``last_over_time`` this answers "how old is the
+  last known point", the ktl stale-row query);
+- aggregations: ``sum/avg/min/max/count [by (l1, l2)] (expr)``;
+- binary ops: arithmetic ``+ - * /`` and comparisons
+  ``> < >= <= == !=`` between scalars, vector/scalar (comparison
+  filters, PromQL-style), and vector/vector matched one-to-one on
+  identical label sets; set ops ``and``, ``or``, ``unless``.
+
+That grammar covers every query the perf harnesses hand-rolled before
+this PR (single-family gauge reads, loop-busy shares, quantiles) and
+everything the built-in alerting rules need. It is NOT Prometheus:
+no offset/@, no histogram_quantile, no group_left.
+
+Evaluation is pure CPU over in-memory deques — instant queries on a
+bounded TSDB are microseconds, safe on the apiserver loop.
+"""
+from __future__ import annotations
+
+import math
+import re
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+from .tsdb import TSDB, Matcher
+
+#: Instant-selector lookback (Prometheus: 5m). Staleness markers cut a
+#: dead target off immediately; the lookback only bounds how far back a
+#: LIVE series' newest sample may be.
+DEFAULT_LOOKBACK = 300.0
+
+_DURATION_RE = re.compile(r"^(\d+(?:\.\d+)?)(ms|s|m|h|d)?$")
+_DURATION_UNIT = {"ms": 0.001, "s": 1.0, "m": 60.0, "h": 3600.0,
+                  "d": 86400.0, None: 1.0}
+
+_AGG_OPS = ("sum", "avg", "min", "max", "count")
+_RANGE_FNS = {
+    "rate", "increase", "avg_over_time", "min_over_time",
+    "max_over_time", "sum_over_time", "count_over_time",
+    "last_over_time",
+}
+_COMPARISONS = {">", "<", ">=", "<=", "==", "!="}
+
+
+class PromQLError(ValueError):
+    pass
+
+
+def parse_duration(text: str) -> float:
+    m = _DURATION_RE.match(text)
+    if m is None:
+        raise PromQLError(f"bad duration {text!r}")
+    return float(m.group(1)) * _DURATION_UNIT[m.group(2)]
+
+
+# ---------------------------------------------------------------------------
+# lexer
+# ---------------------------------------------------------------------------
+
+_TOKEN_RE = re.compile(r"""
+    (?P<ws>\s+)
+  | (?P<duration>\d+(?:\.\d+)?(?:ms|[smhd])\b)
+  | (?P<number>\d+(?:\.\d+)?(?:[eE][+-]?\d+)?)
+  | (?P<ident>[a-zA-Z_:][a-zA-Z0-9_:]*)
+  | (?P<string>"(?:[^"\\]|\\.)*"|'(?:[^'\\]|\\.)*')
+  | (?P<op>=~|!~|==|!=|>=|<=|[-+*/(){}\[\],><=])
+""", re.VERBOSE)
+
+
+def _lex(text: str) -> list[tuple[str, str]]:
+    out, pos = [], 0
+    while pos < len(text):
+        m = _TOKEN_RE.match(text, pos)
+        if m is None:
+            raise PromQLError(
+                f"unexpected character {text[pos]!r} at {pos} in {text!r}")
+        pos = m.end()
+        kind = m.lastgroup
+        if kind != "ws":
+            out.append((kind, m.group()))
+    out.append(("eof", ""))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# AST
+# ---------------------------------------------------------------------------
+
+@dataclass
+class Selector:
+    name: str
+    matchers: list = field(default_factory=list)
+    range_seconds: float = 0.0  # > 0: range selector
+
+
+@dataclass
+class NumberLit:
+    value: float
+
+
+@dataclass
+class FuncCall:
+    fn: str
+    args: list
+
+
+@dataclass
+class Aggregation:
+    op: str
+    by: tuple
+    expr: object
+
+
+@dataclass
+class BinOp:
+    op: str
+    left: object
+    right: object
+
+
+class _Parser:
+    def __init__(self, text: str):
+        self.text = text
+        self.toks = _lex(text)
+        self.i = 0
+
+    def peek(self) -> tuple[str, str]:
+        return self.toks[self.i]
+
+    def next(self) -> tuple[str, str]:
+        t = self.toks[self.i]
+        self.i += 1
+        return t
+
+    def expect(self, val: str) -> None:
+        kind, got = self.next()
+        if got != val:
+            raise PromQLError(
+                f"expected {val!r}, got {got!r} in {self.text!r}")
+
+    # precedence: or < and/unless < comparison < additive < product
+    def parse(self):
+        e = self.p_or()
+        if self.peek()[0] != "eof":
+            raise PromQLError(
+                f"trailing input at {self.peek()[1]!r} in {self.text!r}")
+        return e
+
+    def p_or(self):
+        e = self.p_and()
+        while self.peek() == ("ident", "or"):
+            self.next()
+            e = BinOp("or", e, self.p_and())
+        return e
+
+    def p_and(self):
+        e = self.p_cmp()
+        while self.peek()[0] == "ident" \
+                and self.peek()[1] in ("and", "unless"):
+            op = self.next()[1]
+            e = BinOp(op, e, self.p_cmp())
+        return e
+
+    def p_cmp(self):
+        e = self.p_add()
+        while self.peek()[0] == "op" and self.peek()[1] in _COMPARISONS:
+            op = self.next()[1]
+            e = BinOp(op, e, self.p_add())
+        return e
+
+    def p_add(self):
+        e = self.p_mul()
+        while self.peek() in (("op", "+"), ("op", "-")):
+            op = self.next()[1]
+            e = BinOp(op, e, self.p_mul())
+        return e
+
+    def p_mul(self):
+        e = self.p_atom()
+        while self.peek() in (("op", "*"), ("op", "/")):
+            op = self.next()[1]
+            e = BinOp(op, e, self.p_atom())
+        return e
+
+    def p_atom(self):
+        kind, val = self.peek()
+        if kind == "op" and val == "(":
+            self.next()
+            e = self.p_or()
+            self.expect(")")
+            return e
+        if kind == "op" and val == "-":
+            self.next()
+            return BinOp("*", NumberLit(-1.0), self.p_atom())
+        if kind in ("number", "duration"):
+            self.next()
+            return NumberLit(parse_duration(val)
+                             if kind == "duration" else float(val))
+        if kind != "ident":
+            raise PromQLError(
+                f"unexpected {val!r} in {self.text!r}")
+        # aggregation / function / selector — disambiguate on lookahead
+        if val in _AGG_OPS and self.toks[self.i + 1][1] in ("(", "by"):
+            return self.p_aggregation()
+        if self.toks[self.i + 1] == ("op", "(") \
+                and (val in _RANGE_FNS
+                     or val in ("quantile_over_time", "scalar", "abs",
+                                "timestamp")):
+            return self.p_func()
+        return self.p_selector()
+
+    def p_aggregation(self):
+        op = self.next()[1]
+        by: tuple = ()
+        if self.peek() == ("ident", "by"):
+            self.next()
+            self.expect("(")
+            labels = []
+            while self.peek()[0] == "ident":
+                labels.append(self.next()[1])
+                if self.peek() == ("op", ","):
+                    self.next()
+            self.expect(")")
+            by = tuple(labels)
+        self.expect("(")
+        e = self.p_or()
+        self.expect(")")
+        return Aggregation(op, by, e)
+
+    def p_func(self):
+        fn = self.next()[1]
+        self.expect("(")
+        args = [self.p_or()]
+        while self.peek() == ("op", ","):
+            self.next()
+            args.append(self.p_or())
+        self.expect(")")
+        return FuncCall(fn, args)
+
+    def p_selector(self):
+        name = self.next()[1]
+        matchers = []
+        if self.peek() == ("op", "{"):
+            self.next()
+            while self.peek()[0] == "ident":
+                label = self.next()[1]
+                kind, op = self.next()
+                if op not in ("=", "!=", "=~", "!~"):
+                    raise PromQLError(f"bad matcher op {op!r}")
+                skind, sval = self.next()
+                if skind != "string":
+                    raise PromQLError(
+                        f"matcher value must be quoted, got {sval!r}")
+                try:
+                    matchers.append(Matcher(label, op, _unquote(sval)))
+                except ValueError as e:  # bad =~/!~ regex
+                    raise PromQLError(str(e)) from None
+                if self.peek() == ("op", ","):
+                    self.next()
+            self.expect("}")
+        rng = 0.0
+        if self.peek() == ("op", "["):
+            self.next()
+            kind, dur = self.next()
+            if kind not in ("duration", "number"):
+                raise PromQLError(f"bad range duration {dur!r}")
+            rng = parse_duration(dur)
+            self.expect("]")
+        return Selector(name, matchers, rng)
+
+
+def _unquote(s: str) -> str:
+    body = s[1:-1]
+    return body.replace('\\"', '"').replace("\\'", "'").replace(
+        "\\\\", "\\")
+
+
+def parse(expr: str):
+    """Parse to an AST (callers cache this for repeated evaluation)."""
+    return _Parser(expr).parse()
+
+
+# ---------------------------------------------------------------------------
+# evaluation
+# ---------------------------------------------------------------------------
+
+#: Instant vector element: (labels dict, value). Range vector element:
+#: (labels dict, [(ts, value), ...]).
+
+
+@dataclass
+class EvalContext:
+    tsdb: TSDB
+    at: float
+    lookback: float = DEFAULT_LOOKBACK
+
+
+def _labels_no_name(labels: dict) -> dict:
+    return {k: v for k, v in labels.items() if k != "__name__"}
+
+
+def _lkey(labels: dict) -> tuple:
+    return tuple(sorted(_labels_no_name(labels).items()))
+
+
+def evaluate(node, ctx: EvalContext):
+    """Scalar (float) or instant vector (list[(labels, value)])."""
+    if isinstance(node, NumberLit):
+        return node.value
+    if isinstance(node, Selector):
+        if node.range_seconds > 0:
+            raise PromQLError(
+                f"range selector {node.name}[...] needs a function "
+                f"(rate/avg_over_time/...)")
+        out = []
+        for labels, _ts, v in ctx.tsdb.select_instant(
+                node.name, node.matchers, ctx.at, ctx.lookback):
+            labels["__name__"] = node.name
+            out.append((labels, v))
+        return out
+    if isinstance(node, FuncCall):
+        return _eval_func(node, ctx)
+    if isinstance(node, Aggregation):
+        return _eval_agg(node, ctx)
+    if isinstance(node, BinOp):
+        return _eval_binop(node, ctx)
+    raise PromQLError(f"cannot evaluate {node!r}")
+
+
+def _eval_range(node, ctx: EvalContext):
+    if not isinstance(node, Selector) or node.range_seconds <= 0:
+        raise PromQLError("expected a range selector, e.g. name[30s]")
+    return ctx.tsdb.select_range(
+        node.name, node.matchers, ctx.at - node.range_seconds, ctx.at)
+
+
+def _rate(samples: list, window: float, counter: bool) -> Optional[float]:
+    if len(samples) < 2:
+        return None
+    first_ts, first_v = samples[0]
+    total = 0.0
+    prev = first_v
+    for _ts, v in samples[1:]:
+        if counter and v < prev:
+            total += prev  # counter reset: the pre-reset value counts
+        prev = v
+    increase = total + prev - first_v
+    span = samples[-1][0] - first_ts
+    if span <= 0:
+        return None
+    return increase / span
+
+
+def _eval_func(node: FuncCall, ctx: EvalContext):
+    fn = node.fn
+    if fn == "scalar":
+        v = evaluate(node.args[0], ctx)
+        if isinstance(v, float):
+            return v
+        return v[0][1] if len(v) == 1 else math.nan
+    if fn == "abs":
+        v = evaluate(node.args[0], ctx)
+        if isinstance(v, float):
+            return abs(v)
+        return [(labels, abs(x)) for labels, x in v]
+    if fn == "timestamp":
+        # Restricted vs Prometheus: the argument must be something
+        # with a REAL sample timestamp — an instant selector, or
+        # last_over_time(sel[d]). (General expressions would need
+        # every element to carry a timestamp through the evaluator
+        # for no current consumer.)
+        arg = node.args[0]
+        if isinstance(arg, Selector) and arg.range_seconds == 0:
+            return [(_labels_no_name(labels), ts)
+                    for labels, ts, _v in ctx.tsdb.select_instant(
+                        arg.name, arg.matchers, ctx.at, ctx.lookback)]
+        if isinstance(arg, FuncCall) and arg.fn == "last_over_time":
+            return [(_labels_no_name(labels), samples[-1][0])
+                    for labels, samples in _eval_range(arg.args[0], ctx)
+                    if samples]
+        raise PromQLError(
+            "timestamp() takes an instant selector or "
+            "last_over_time(sel[d])")
+    if fn == "quantile_over_time":
+        if len(node.args) != 2:
+            raise PromQLError("quantile_over_time(q, sel[d])")
+        q = evaluate(node.args[0], ctx)
+        if not isinstance(q, float):
+            raise PromQLError("quantile_over_time: q must be a scalar")
+        if not 0.0 <= q <= 1.0:
+            # Negative q would wrap around via Python indexing and
+            # silently answer the window max.
+            raise PromQLError(
+                f"quantile_over_time: q must be in [0, 1], got {q:g}")
+        out = []
+        for labels, samples in _eval_range(node.args[1], ctx):
+            vals = sorted(v for _ts, v in samples)
+            idx = min(len(vals) - 1, int(q * len(vals)))
+            out.append((_labels_no_name(labels), vals[idx]))
+        return out
+    if fn not in _RANGE_FNS:
+        raise PromQLError(f"unknown function {fn!r}")
+    out = []
+    rv = _eval_range(node.args[0], ctx)
+    window = node.args[0].range_seconds
+    for labels, samples in rv:
+        labels = _labels_no_name(labels)
+        if fn in ("rate", "increase"):
+            r = _rate(samples, window, counter=True)
+            if r is None:
+                continue
+            out.append((labels, r * window if fn == "increase" else r))
+            continue
+        vals = [v for _ts, v in samples]
+        if fn == "avg_over_time":
+            out.append((labels, sum(vals) / len(vals)))
+        elif fn == "min_over_time":
+            out.append((labels, min(vals)))
+        elif fn == "max_over_time":
+            out.append((labels, max(vals)))
+        elif fn == "sum_over_time":
+            out.append((labels, sum(vals)))
+        elif fn == "count_over_time":
+            out.append((labels, float(len(vals))))
+        elif fn == "last_over_time":
+            out.append((labels, vals[-1]))
+    return out
+
+
+def _eval_agg(node: Aggregation, ctx: EvalContext):
+    v = evaluate(node.expr, ctx)
+    if isinstance(v, float):
+        raise PromQLError(f"{node.op}() needs a vector, got a scalar")
+    groups: dict[tuple, list[float]] = {}
+    group_labels: dict[tuple, dict] = {}
+    for labels, value in v:
+        key = tuple((l, labels.get(l, "")) for l in node.by)
+        groups.setdefault(key, []).append(value)
+        group_labels[key] = dict(key)
+    out = []
+    for key, vals in groups.items():
+        if node.op == "sum":
+            agg = sum(vals)
+        elif node.op == "avg":
+            agg = sum(vals) / len(vals)
+        elif node.op == "min":
+            agg = min(vals)
+        elif node.op == "max":
+            agg = max(vals)
+        else:
+            agg = float(len(vals))
+        out.append((group_labels[key], agg))
+    return out
+
+
+def _apply(op: str, a: float, b: float) -> Optional[float]:
+    """Arithmetic returns a number; comparisons return the LEFT value
+    when true, None when false (PromQL filter semantics)."""
+    if op == "+":
+        return a + b
+    if op == "-":
+        return a - b
+    if op == "*":
+        return a * b
+    if op == "/":
+        if b == 0:
+            return math.nan if a == 0 else math.copysign(math.inf, a)
+        return a / b
+    ok = {"<": a < b, ">": a > b, "<=": a <= b, ">=": a >= b,
+          "==": a == b, "!=": a != b}[op]
+    return a if ok else None
+
+
+def _eval_binop(node: BinOp, ctx: EvalContext):
+    left = evaluate(node.left, ctx)
+    right = evaluate(node.right, ctx)
+    op = node.op
+    if op in ("and", "or", "unless"):
+        if isinstance(left, float) or isinstance(right, float):
+            raise PromQLError(f"{op} needs vectors on both sides")
+        rkeys = {_lkey(labels) for labels, _v in right}
+        if op == "and":
+            return [(l, v) for l, v in left if _lkey(l) in rkeys]
+        if op == "unless":
+            return [(l, v) for l, v in left if _lkey(l) not in rkeys]
+        lkeys = {_lkey(labels) for labels, _v in left}
+        return list(left) + [(l, v) for l, v in right
+                             if _lkey(l) not in lkeys]
+    if isinstance(left, float) and isinstance(right, float):
+        r = _apply(op, left, right)
+        if op in _COMPARISONS:
+            # scalar comparison yields 1/0, not a filter
+            return 1.0 if r is not None else 0.0
+        return r
+    if isinstance(left, float) or isinstance(right, float):
+        vec, scalar, flipped = ((right, left, True)
+                                if isinstance(left, float)
+                                else (left, right, False))
+        out = []
+        for labels, v in vec:
+            a, b = (scalar, v) if flipped else (v, scalar)
+            r = _apply(op, a, b)
+            if r is None:
+                continue
+            if op in _COMPARISONS:
+                r = v  # filter keeps the vector element's own value
+            out.append((_labels_no_name(labels), r))
+        return out
+    # vector (op) vector: one-to-one on identical label sets
+    rindex = {_lkey(labels): v for labels, v in right}
+    out = []
+    for labels, v in left:
+        key = _lkey(labels)
+        if key not in rindex:
+            continue
+        r = _apply(op, v, rindex[key])
+        if r is None:
+            continue
+        out.append((_labels_no_name(labels), r))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# query API (the /debug/v1/query response shape)
+# ---------------------------------------------------------------------------
+
+def query_instant(tsdb: TSDB, expr: str, at: float,
+                  lookback: float = DEFAULT_LOOKBACK) -> dict:
+    """Prometheus-shaped instant query result:
+    ``{"resultType": "vector"|"scalar", "result": ...}``."""
+    v = evaluate(parse(expr), EvalContext(tsdb, at, lookback))
+    if isinstance(v, float):
+        return {"resultType": "scalar", "result": [at, v]}
+    return {"resultType": "vector", "result": [
+        {"metric": _present_labels(labels), "value": [at, value]}
+        for labels, value in sorted(
+            v, key=lambda e: sorted(e[0].items()))]}
+
+
+def query_range(tsdb: TSDB, expr: str, start: float, end: float,
+                step: float,
+                lookback: float = DEFAULT_LOOKBACK) -> dict:
+    """Evaluate the expression at each step in [start, end]:
+    ``{"resultType": "matrix", "result": [{"metric", "values"}]}``."""
+    if not (math.isfinite(start) and math.isfinite(end)
+            and math.isfinite(step)):
+        # inf/NaN bypass the resolution guard (inf/inf is NaN) and
+        # turn the step loop into a CPU-pinned spin — reject early.
+        raise PromQLError("start/end/step must be finite")
+    if step <= 0:
+        raise PromQLError("step must be > 0")
+    if end < start:
+        raise PromQLError("end must be >= start")
+    if (end - start) / step > 11_000:
+        raise PromQLError("range query resolves to more than 11000 "
+                          "points; widen the step")
+    ast = parse(expr)
+    by_series: dict[tuple, dict] = {}
+    t = start
+    while t <= end + 1e-9:
+        v = evaluate(ast, EvalContext(tsdb, t, lookback))
+        if isinstance(v, float):
+            ent = by_series.setdefault((), {"metric": {}, "values": []})
+            ent["values"].append([t, v])
+        else:
+            for labels, value in v:
+                labels = _present_labels(labels)
+                key = tuple(sorted(labels.items()))
+                ent = by_series.setdefault(
+                    key, {"metric": labels, "values": []})
+                ent["values"].append([t, value])
+        t += step
+    return {"resultType": "matrix",
+            "result": [by_series[k] for k in sorted(by_series)]}
+
+
+def _present_labels(labels: dict) -> dict:
+    return dict(sorted(labels.items()))

@@ -1,0 +1,256 @@
+"""Recording + alerting rules — the Prometheus rule-group analog.
+
+Recording rules evaluate a PromQL-lite expression every tick and write
+the result back into the TSDB under a ``level:metric:operation`` name
+(the ``ktl dash`` sparkline sources). Alerting rules evaluate an
+expression whose non-empty result means "this label set is in
+violation"; an element must stay in violation for the rule's ``for:``
+hold-down before the alert FIRES (one noisy scrape must not taint a
+node), and an element that disappears resolves the alert.
+
+The engine is pure state over the TSDB — side effects (Events, node
+taints) belong to the pipeline, which consumes the transition list
+``evaluate`` returns. That split keeps hold-down/resolve logic unit-
+testable with a hand-fed store.
+
+Built-in rules (``builtin_rules(interval)``) express the ROADMAP
+item-5 seam: sick chips (health gone, duty collapse on an assigned
+chip, ICI counter stall), node stragglers vs the fleet mean, apiserver
+loop saturation, stale replication followers, and scrape-target-down.
+Hold-downs scale with the scrape interval so a CI smoke at 0.3s
+intervals and production at 10s get the same *number of confirming
+scrapes*.
+"""
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+from ..metrics.registry import Gauge
+from . import promql
+from .tsdb import TSDB
+
+log = logging.getLogger("kmon.rules")
+
+ALERTS_ACTIVE = Gauge(
+    "kmon_alerts_active",
+    "kmon alerts by rule name and state (pending/firing)",
+    labels=("alertname", "state"))
+
+#: The taint the pipeline applies for node-degrading firing alerts
+#: (behind the AlertNodeTainting gate) — the seam the future migration
+#: controller consumes.
+TAINT_DEGRADED = "tpu.google.com/degraded"
+
+PENDING = "pending"
+FIRING = "firing"
+
+
+@dataclass(frozen=True)
+class RecordingRule:
+    record: str
+    expr: str
+
+
+@dataclass(frozen=True)
+class AlertRule:
+    name: str
+    expr: str
+    for_seconds: float
+    severity: str = "warning"
+    summary: str = ""
+    #: Firing instances whose labels name a node degrade that node
+    #: (pipeline taints it when AlertNodeTainting is on).
+    taint: bool = False
+
+
+@dataclass
+class AlertInstance:
+    rule: AlertRule
+    labels: dict
+    state: str
+    active_since: float
+    value: float
+    firing_since: float = 0.0
+
+    def to_dict(self) -> dict:
+        out = {
+            "name": self.rule.name,
+            "severity": self.rule.severity,
+            "state": self.state,
+            "labels": dict(sorted(self.labels.items())),
+            "value": self.value,
+            "active_since": round(self.active_since, 3),
+            "summary": self.rule.summary,
+        }
+        if self.state == FIRING:
+            out["firing_since"] = round(self.firing_since, 3)
+        return out
+
+
+@dataclass(frozen=True)
+class Transition:
+    kind: str  # "firing" | "resolved"
+    rule: AlertRule
+    labels: dict
+    value: float = 0.0
+
+
+def builtin_recording_rules() -> list[RecordingRule]:
+    return [
+        RecordingRule("cluster:tpu_duty:avg",
+                      "avg(tpu_node_duty_cycle_avg_pct)"),
+        RecordingRule("cluster:tpu_tokens:sum",
+                      "sum(tpu_node_tokens_per_sec)"),
+        RecordingRule("cluster:chips_unhealthy:sum",
+                      "sum(1 - tpu_chip_healthy)"),
+        RecordingRule("cluster:hbm_used:sum",
+                      "sum(tpu_node_hbm_used_bytes)"),
+        RecordingRule("job:up:sum", "sum by (job) (up)"),
+        RecordingRule("apiserver:loop_busy:max",
+                      "max(apiserver_loop_busy_fraction)"),
+    ]
+
+
+def builtin_rules(interval: float) -> list[AlertRule]:
+    """Hold-downs in confirming-scrape units: 2 scrapes for hard
+    binary signals (health bit, up), 4 for derived/rate signals."""
+    short = 2 * interval
+    long = 4 * interval
+    ici_window = max(6 * interval, 2.0)
+    return [
+        AlertRule(
+            "TpuChipSick", "tpu_chip_healthy == 0",
+            for_seconds=short, severity="critical", taint=True,
+            summary="device plugin reports the chip unhealthy"),
+        # The interesting metric sits LEFT of `and` — the alert's
+        # value (ktl alerts VALUE, the Event message) comes from the
+        # left vector, and "duty=2%" diagnoses; "assigned=1" doesn't.
+        AlertRule(
+            "TpuChipDutyCollapse",
+            "tpu_duty_cycle_pct < 5 and tpu_chip_assigned == 1",
+            for_seconds=long, severity="warning", taint=True,
+            summary="assigned chip's duty cycle collapsed (<5%)"),
+        AlertRule(
+            "TpuIciStall",
+            f"rate(tpu_ici_tx_bytes[{ici_window:g}s]) == 0 "
+            "and tpu_chip_assigned == 1",
+            for_seconds=long, severity="warning", taint=True,
+            summary="assigned chip's ICI tx counter stopped moving"),
+        AlertRule(
+            "TpuNodeStraggler",
+            "tpu_node_duty_cycle_avg_pct < 0.5 * "
+            "scalar(avg(tpu_node_duty_cycle_avg_pct))",
+            for_seconds=long, severity="warning",
+            summary="node duty cycle under half the fleet mean"),
+        AlertRule(
+            "ApiServerLoopSaturated",
+            "apiserver_loop_busy_fraction > 0.9",
+            for_seconds=long, severity="critical",
+            summary="apiserver event loop busy fraction above 0.9"),
+        AlertRule(
+            "ReplicationFollowerStale",
+            "scalar(max(replication_commit_revision)) "
+            "- replication_commit_revision > 200",
+            for_seconds=long, severity="warning",
+            summary="replica's committed revision lags the leader"),
+        AlertRule(
+            "ScrapeTargetDown", "up == 0",
+            for_seconds=short, severity="critical",
+            summary="scrape target down"),
+    ]
+
+
+def _instance_key(rule_name: str, labels: dict) -> tuple:
+    return (rule_name,) + tuple(sorted(labels.items()))
+
+
+class RuleEngine:
+    def __init__(self, tsdb: TSDB,
+                 alert_rules: Sequence[AlertRule] = (),
+                 recording_rules: Sequence[RecordingRule] = (),
+                 lookback: float = promql.DEFAULT_LOOKBACK):
+        self.tsdb = tsdb
+        self.alert_rules = list(alert_rules)
+        self.recording_rules = list(recording_rules)
+        self.lookback = lookback
+        self._asts: dict[str, object] = {}
+        self._active: dict[tuple, AlertInstance] = {}
+
+    def _eval(self, expr: str, now: float):
+        ast = self._asts.get(expr)
+        if ast is None:
+            ast = self._asts[expr] = promql.parse(expr)
+        return promql.evaluate(
+            ast, promql.EvalContext(self.tsdb, now, self.lookback))
+
+    def evaluate(self, now: Optional[float] = None) -> list[Transition]:
+        """One tick: recording rules write back, alerting rules step
+        their pending/firing state machines. Returns the edge
+        transitions (fire / resolve) for the pipeline to act on."""
+        now = time.time() if now is None else now
+        for rule in self.recording_rules:
+            try:
+                v = self._eval(rule.expr, now)
+            except promql.PromQLError as e:
+                log.warning("recording rule %s: %s", rule.record, e)
+                continue
+            if isinstance(v, float):
+                self.tsdb.add(rule.record, {}, v, now)
+            else:
+                for labels, value in v:
+                    self.tsdb.add(rule.record, labels, value, now)
+        transitions: list[Transition] = []
+        seen: set[tuple] = set()
+        for rule in self.alert_rules:
+            try:
+                v = self._eval(rule.expr, now)
+            except promql.PromQLError as e:
+                log.warning("alert rule %s: %s", rule.name, e)
+                continue
+            if isinstance(v, float):
+                v = [({}, v)] if v else []
+            for labels, value in v:
+                key = _instance_key(rule.name, labels)
+                seen.add(key)
+                inst = self._active.get(key)
+                if inst is None:
+                    inst = self._active[key] = AlertInstance(
+                        rule=rule, labels=dict(labels), state=PENDING,
+                        active_since=now, value=value)
+                inst.value = value
+                if inst.state == PENDING \
+                        and now - inst.active_since >= rule.for_seconds:
+                    inst.state = FIRING
+                    inst.firing_since = now
+                    transitions.append(Transition(
+                        "firing", rule, dict(inst.labels), value))
+        for key, inst in list(self._active.items()):
+            if key in seen:
+                continue
+            del self._active[key]
+            if inst.state == FIRING:
+                transitions.append(Transition(
+                    "resolved", inst.rule, dict(inst.labels)))
+        self._export()
+        return transitions
+
+    def _export(self) -> None:
+        counts: dict[tuple, int] = {}
+        for inst in self._active.values():
+            k = (inst.rule.name, inst.state)
+            counts[k] = counts.get(k, 0) + 1
+        for name in {r.name for r in self.alert_rules}:
+            for state in (PENDING, FIRING):
+                ALERTS_ACTIVE.set(float(counts.get((name, state), 0)),
+                                  alertname=name, state=state)
+
+    def alerts(self) -> list[dict]:
+        """JSON-able active alerts (pending + firing), stable order."""
+        return [inst.to_dict() for _k, inst in
+                sorted(self._active.items())]
+
+    def firing(self) -> list[AlertInstance]:
+        return [i for i in self._active.values() if i.state == FIRING]

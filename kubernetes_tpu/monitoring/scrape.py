@@ -1,0 +1,290 @@
+"""Scrape manager — the Prometheus scrape loop, in-process.
+
+Discovers control-plane and node ``/metrics`` endpoints, scrapes them
+concurrently over one shared session (the ClusterMonitor pattern: sweep
+time is the slowest single scrape, not the sum), parses the text
+exposition format, and ingests samples into the TSDB with ``job`` and
+``instance`` target labels attached.
+
+Target discovery:
+
+- **apiserver** — every configured apiserver URL (HA replicas each get
+  their own target; the sharded apiserver's per-worker series all ride
+  the one registry, labeled by loop);
+- **scheduler / controller-manager** — the components' metrics
+  listeners (metrics/http.py), handed in by the composer;
+- **node** — LIST Nodes, resolve each agent's daemon endpoint
+  (client/nodeaccess.py — same credential policy as ``ktl top``), and
+  scrape ``/metrics`` filtered to the per-chip ``tpu_*`` families with
+  the target node's own label. The filter matters in single-process
+  clusters where every component shares one registry: without it, N
+  node targets would each re-ingest the whole fleet's series N times.
+
+Per-target bookkeeping series written into the TSDB every sweep:
+``up{job,instance}`` (1/0) and
+``kmon_scrape_duration_seconds{job,instance}``. A failed scrape marks
+every series previously ingested from that target STALE (tsdb.py NaN
+markers), so instant queries stop seeing a dead target immediately —
+carrying a dead apiserver's last loop-busy number forward would hide
+exactly the outage the pipeline exists to surface.
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+import re
+import time
+from dataclasses import dataclass, field
+from typing import Iterable, Optional, Sequence
+
+from ..metrics.registry import Counter
+from .tsdb import TSDB, Matcher
+
+log = logging.getLogger("kmon.scrape")
+
+SCRAPES = Counter(
+    "kmon_scrapes_total",
+    "kmon scrape attempts by job and result",
+    labels=("job", "result"))
+
+SCRAPE_SAMPLES = Counter(
+    "kmon_scrape_samples_total",
+    "Samples ingested from scrapes, by job",
+    labels=("job",))
+
+#: Per-chip node families ingested from node targets (aggregator
+#: rollups enter the TSDB through the pipeline's snapshot recording,
+#: not through node scrapes).
+NODE_FAMILIES = ("tpu_duty_cycle_pct", "tpu_hbm_used_bytes",
+                 "tpu_hbm_total_bytes", "tpu_ici_tx_bytes",
+                 "tpu_ici_rx_bytes", "tpu_ici_links_up",
+                 "tpu_chip_healthy", "tpu_chip_assigned",
+                 "tpu_libtpu_probe_healthy")
+
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+_ESCAPE_RE = re.compile(r'\\(.)')
+_ESCAPES = {'"': '"', "n": "\n", "\\": "\\"}
+
+
+def _unescape_label(raw: str) -> str:
+    """One-pass exposition unescape (\\" \\n \\\\); chained
+    str.replace would mis-handle a literal backslash followed by 'n'
+    (``C:\\\\nightly`` must stay ``C:\\nightly``, not gain a newline).
+    Unknown escapes pass through verbatim, like the Prometheus
+    parser."""
+    return _ESCAPE_RE.sub(
+        lambda m: _ESCAPES.get(m.group(1), "\\" + m.group(1)), raw)
+
+
+def parse_exposition(text: str) -> Iterable[tuple[str, dict, float]]:
+    """(name, labels, value) per sample line of Prometheus text
+    exposition. Comment/TYPE/HELP lines and unparsable lines are
+    skipped — a scrape must never fail on one malformed series."""
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        brace = line.find("{")
+        labels: dict = {}
+        if brace != -1:
+            close = line.rfind("}")
+            if close == -1:
+                continue
+            name = line[:brace]
+            for m in _LABEL_RE.finditer(line[brace + 1:close]):
+                labels[m.group(1)] = _unescape_label(m.group(2))
+            rest = line[close + 1:].strip()
+        else:
+            name, _, rest = line.partition(" ")
+            rest = rest.strip()
+        if not rest:
+            continue
+        value = rest.split()[0]
+        try:
+            yield name, labels, float(value)
+        except ValueError:
+            continue
+
+
+@dataclass
+class ScrapeTarget:
+    """One endpoint the manager scrapes each sweep."""
+    job: str
+    instance: str
+    url: str  # full /metrics URL
+    ssl: object = None
+    #: Metric-name prefixes to ingest; () = everything.
+    families: tuple = ()
+    #: Labels a sample must carry verbatim to be ingested (e.g.
+    #: ``{"node": "node-3"}`` on node targets).
+    require_labels: dict = field(default_factory=dict)
+
+    def wants(self, name: str, labels: dict) -> bool:
+        if self.families and not any(name.startswith(p)
+                                     for p in self.families):
+            return False
+        return all(labels.get(k) == v
+                   for k, v in self.require_labels.items())
+
+
+def ingest_exposition(tsdb: TSDB, text: str, ts: float, job: str,
+                      instance: str, target: Optional[ScrapeTarget] = None
+                      ) -> int:
+    """Parse + ingest one exposition payload; returns samples accepted.
+    Also the perf harnesses' promql-compat seam (perf/__init__.py):
+    one parser, whether the text came from a live scrape or a bench's
+    one-shot GET."""
+    n = 0
+    for name, labels, value in parse_exposition(text):
+        if target is not None and not target.wants(name, labels):
+            continue
+        labels["job"] = job
+        labels["instance"] = instance
+        if tsdb.add(name, labels, value, ts):
+            n += 1
+    return n
+
+
+class ScrapeManager:
+    def __init__(self, client, tsdb: TSDB, interval: float = 5.0,
+                 ssl_context=None,
+                 apiserver_urls: Sequence[str] = (),
+                 component_urls: Sequence[tuple[str, str]] = (),
+                 scrape_timeout: float = 3.0):
+        """``component_urls``: (job, base URL) pairs for scheduler /
+        controller-manager metrics listeners. ``ssl_context`` carries
+        cluster credentials for TLS apiserver + node endpoints."""
+        self.client = client
+        self.tsdb = tsdb
+        self.interval = interval
+        self._ssl = ssl_context
+        self.apiserver_urls = list(apiserver_urls)
+        self.component_urls = list(component_urls)
+        self.scrape_timeout = scrape_timeout
+        #: Instances that succeeded last sweep, per (job, instance) —
+        #: the staleness edge detector.
+        self._was_up: set[tuple[str, str]] = set()
+        self.sweeps = 0
+
+    # -- discovery --------------------------------------------------------
+
+    async def discover(self) -> list[ScrapeTarget]:
+        targets = []
+        for url in self.apiserver_urls:
+            targets.append(ScrapeTarget(
+                job="apiserver", instance=_instance_of(url),
+                url=url.rstrip("/") + "/metrics",
+                ssl=self._ssl if url.startswith("https") else None,
+                families=("apiserver_", "replication_", "chaos_")))
+        for job, url in self.component_urls:
+            families = {"scheduler": ("scheduler_",),
+                        "controller-manager": ("tpu_monitor_", "kmon_")}
+            targets.append(ScrapeTarget(
+                job=job, instance=_instance_of(url),
+                url=url.rstrip("/") + "/metrics",
+                ssl=self._ssl if url.startswith("https") else None,
+                families=families.get(job, ())))
+        from ..api import errors
+        from ..client.nodeaccess import resolve_node_agent
+        try:
+            nodes, _rev = await self.client.list("nodes")
+        except errors.StatusError as e:
+            log.warning("kmon: node list failed: %s", e)
+            nodes = []
+        # Resolve CONCURRENTLY, passing the just-LISTed Node objects:
+        # sequential resolution serializes the 2s /healthz probe
+        # timeouts of every dead node and pushes the whole sweep
+        # behind schedule — the exact failure mode the monitor's
+        # concurrent scrape exists to avoid.
+        conns = await asyncio.gather(
+            *(resolve_node_agent(self.client, n.metadata.name, node=n)
+              for n in nodes))
+        for node, conn in zip(nodes, conns):
+            name = node.metadata.name
+            if conn is None:
+                # Unresolvable counts as a down target: the node is
+                # LISTED, so its absence is signal, not configuration.
+                targets.append(ScrapeTarget(
+                    job="node", instance=name, url="",
+                    families=NODE_FAMILIES,
+                    require_labels={"node": name}))
+                continue
+            base, node_ssl = conn
+            if self._ssl is not None:
+                node_ssl = self._ssl
+            targets.append(ScrapeTarget(
+                job="node", instance=name, url=f"{base}/metrics",
+                ssl=node_ssl, families=NODE_FAMILIES,
+                require_labels={"node": name}))
+        return targets
+
+    # -- the sweep --------------------------------------------------------
+
+    async def sweep(self, now: Optional[float] = None) -> dict:
+        """Discover + scrape every target once; returns
+        ``{instance_key: up}`` (tests drive this directly)."""
+        import aiohttp
+        now = time.time() if now is None else now
+        targets = await self.discover()
+        async with aiohttp.ClientSession() as session:
+            results = await asyncio.gather(
+                *(self._scrape_one(t, session, now) for t in targets))
+        up_now: set[tuple[str, str]] = set()
+        report = {}
+        for target, ok in zip(targets, results):
+            key = (target.job, target.instance)
+            report[f"{target.job}/{target.instance}"] = ok
+            if ok:
+                up_now.add(key)
+            elif key in self._was_up:
+                # Freshly down: stale-mark everything this target fed.
+                self.tsdb.mark_stale(now, matchers=[
+                    Matcher("job", "=", target.job),
+                    Matcher("instance", "=", target.instance)])
+                # ... except its own up series, re-added below.
+        for target in targets:
+            key = (target.job, target.instance)
+            meta = {"job": target.job, "instance": target.instance}
+            self.tsdb.add("up", meta, 1.0 if key in up_now else 0.0, now)
+        self._was_up = up_now
+        self.sweeps += 1
+        self.tsdb.gc(now)
+        return report
+
+    async def _scrape_one(self, target: ScrapeTarget, session,
+                          now: float) -> bool:
+        import aiohttp
+        from ..client.nodeaccess import ssl_kw
+        if not target.url:
+            SCRAPES.inc(job=target.job, result="unreachable")
+            return False
+        t0 = time.perf_counter()
+        try:
+            async with session.get(
+                    target.url,
+                    timeout=aiohttp.ClientTimeout(
+                        total=self.scrape_timeout),
+                    **ssl_kw(target.ssl)) as r:
+                if r.status != 200:
+                    SCRAPES.inc(job=target.job, result="error")
+                    return False
+                text = await r.text()
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001 — target down mid-sweep
+            log.debug("kmon: scrape %s/%s failed: %s",
+                      target.job, target.instance, e)
+            SCRAPES.inc(job=target.job, result="error")
+            return False
+        n = ingest_exposition(self.tsdb, text, now, target.job,
+                              target.instance, target)
+        self.tsdb.add(
+            "kmon_scrape_duration_seconds",
+            {"job": target.job, "instance": target.instance},
+            round(time.perf_counter() - t0, 6), now)
+        SCRAPES.inc(job=target.job, result="ok")
+        SCRAPE_SAMPLES.inc(n, job=target.job)
+        return True
+
+
+def _instance_of(url: str) -> str:
+    return url.split("://", 1)[-1].rstrip("/")

@@ -1,0 +1,320 @@
+"""In-memory ring TSDB — the Prometheus-storage analog, hard-bounded.
+
+One process-local store for the kmon pipeline (scrape.py feeds it,
+promql.py queries it, rules.py records into it). Design constraints,
+in order:
+
+1. **Never unbounded.** Every axis has a ceiling: series count
+   (``max_series``), samples per series (a ring — old samples fall
+   off), and retention age (``retention_seconds``). Anything refused
+   is COUNTED (``kmon_tsdb_dropped_samples_total`` by reason), never
+   silently lost — the ROADMAP item-6 hygiene requirement applied to
+   the monitoring pipeline itself.
+2. **Step-aligned downsampling.** Timestamps quantize to the scrape
+   step (``step`` > 0), keep-last per step: two scrapes landing in one
+   step cost one sample, and range queries see a regular grid instead
+   of jittered scrape instants.
+3. **Explicit staleness.** A failed scrape writes a NaN staleness
+   marker (the Prometheus 2.x mechanism) so instant queries stop
+   returning a dead target's last value immediately instead of after
+   the whole lookback window.
+
+Values are stored as (ts, value) tuples in a ``deque(maxlen=...)`` —
+the ring bound is structural, not a janitor loop that can fall behind.
+"""
+from __future__ import annotations
+
+import math
+import re
+from collections import deque
+from typing import Iterable, Optional, Sequence
+
+from ..metrics.registry import Counter, Gauge
+from ..util.lockdep import make_lock
+
+#: NaN staleness marker (Prometheus uses a special NaN bit pattern;
+#: plain NaN suffices here — no real sample is ever NaN).
+STALE = float("nan")
+
+TSDB_INGESTED = Counter(
+    "kmon_tsdb_ingested_samples_total",
+    "Samples accepted into the kmon TSDB")
+
+TSDB_DROPPED = Counter(
+    "kmon_tsdb_dropped_samples_total",
+    "Samples the kmon TSDB refused, by reason "
+    "(series_limit/out_of_order/retention)",
+    labels=("reason",))
+
+TSDB_SERIES = Gauge(
+    "kmon_tsdb_series",
+    "Live series in the kmon TSDB")
+
+TSDB_SAMPLES = Gauge(
+    "kmon_tsdb_samples",
+    "Samples currently held across all kmon TSDB series")
+
+
+def is_stale(value: float) -> bool:
+    return isinstance(value, float) and math.isnan(value)
+
+
+class Matcher:
+    """One label matcher: ``=``, ``!=``, ``=~`` (anchored), ``!~``."""
+
+    __slots__ = ("label", "op", "value", "_re")
+
+    def __init__(self, label: str, op: str, value: str):
+        if op not in ("=", "!=", "=~", "!~"):
+            raise ValueError(f"unknown matcher op {op!r}")
+        self.label = label
+        self.op = op
+        self.value = value
+        if op in ("=~", "!~"):
+            try:
+                self._re = re.compile(f"^(?:{value})$")
+            except re.error as e:
+                # ValueError, not re.error: callers (the PromQL
+                # parser) turn it into a 400, never a 500.
+                raise ValueError(
+                    f"bad regex in matcher {label}{op}{value!r}: "
+                    f"{e}") from None
+        else:
+            self._re = None
+
+    def matches(self, labels: dict) -> bool:
+        got = labels.get(self.label, "")
+        if self.op == "=":
+            return got == self.value
+        if self.op == "!=":
+            return got != self.value
+        hit = self._re.match(got) is not None
+        return hit if self.op == "=~" else not hit
+
+    def __repr__(self):
+        return f"{self.label}{self.op}{self.value!r}"
+
+
+class Series:
+    __slots__ = ("name", "labels", "samples")
+
+    def __init__(self, name: str, labels: dict, maxlen: int):
+        self.name = name
+        self.labels = dict(labels)
+        self.samples: deque = deque(maxlen=maxlen)
+
+    def latest(self) -> Optional[tuple]:
+        return self.samples[-1] if self.samples else None
+
+
+def series_key(name: str, labels: dict) -> tuple:
+    return (name,) + tuple(sorted(labels.items()))
+
+
+class TSDB:
+    """Bounded in-memory time-series store.
+
+    ``step`` > 0 aligns timestamps down to the step grid (keep-last per
+    bucket). ``max_series`` is a hard ceiling — a label-cardinality
+    explosion drops NEW series (counted), it does not grow the map.
+    """
+
+    def __init__(self, retention_seconds: float = 900.0,
+                 max_samples_per_series: int = 512,
+                 max_series: int = 20_000,
+                 step: float = 0.0):
+        self.retention_seconds = float(retention_seconds)
+        self.max_samples_per_series = int(max_samples_per_series)
+        self.max_series = int(max_series)
+        self.step = float(step)
+        self._series: dict[tuple, Series] = {}
+        #: name -> {series_key: Series}: selector evaluation is
+        #: O(series of that name), not a scan of the whole map —
+        #: range queries re-evaluate selectors per step, so a flat
+        #: scan would multiply to (steps x max_series) comparisons
+        #: under the lock.
+        self._by_name: dict[str, dict[tuple, Series]] = {}
+        #: Reentrant (mark_stale -> add) lock: the pipeline mutates on
+        #: the event loop while the apiserver offloads RANGE queries to
+        #: a thread (query_range re-evaluates per step — inline it
+        #: would stall the router loop; see _debug_query).
+        self._lock = make_lock("kmon.TSDB", rlock=True)
+        #: Instance-local drop counts by reason (tests assert these;
+        #: the kmon_* counters aggregate across instances).
+        self.dropped: dict[str, int] = {}
+        self.ingested = 0
+
+    # -- write path -------------------------------------------------------
+
+    def add(self, name: str, labels: dict, value: float,
+            ts: float) -> bool:
+        """Ingest one sample; False (+ counted drop) when refused."""
+        with self._lock:
+            return self._add(name, labels, value, ts)
+
+    def _add(self, name: str, labels: dict, value: float,
+             ts: float) -> bool:
+        if self.step > 0 and not is_stale(value):
+            ts = ts - (ts % self.step)
+        key = series_key(name, labels)
+        s = self._series.get(key)
+        if s is None:
+            if len(self._series) >= self.max_series:
+                self._drop("series_limit")
+                return False
+            s = self._series[key] = Series(
+                name, labels, self.max_samples_per_series)
+            self._by_name.setdefault(name, {})[key] = s
+        last = s.latest()
+        if last is not None:
+            if ts < last[0]:
+                self._drop("out_of_order")
+                return False
+            if ts == last[0]:
+                # Keep-last within a step bucket (downsampling), and
+                # idempotent re-ingest of the same instant.
+                s.samples[-1] = (ts, value)
+                return True
+        s.samples.append((ts, value))
+        self.ingested += 1
+        TSDB_INGESTED.inc()
+        return True
+
+    def mark_stale(self, ts: float,
+                   matchers: Sequence[Matcher] = (),
+                   name: str = "") -> int:
+        """Append a staleness marker to every matching live series
+        (skipping those already stale). Returns how many were marked.
+        Marker timestamps sit on the step grid like real samples, so a
+        subsequent same-instant live write (e.g. the ``up=0`` the
+        scrape manager records for a down target) lands keep-last on
+        top of the marker instead of colliding out-of-order."""
+        if self.step > 0:
+            ts = ts - (ts % self.step)
+        n = 0
+        with self._lock:
+            for s in list(self._match(name, matchers)):
+                last = s.latest()
+                if last is None or is_stale(last[1]):
+                    continue
+                if self._add(s.name, s.labels, STALE, max(ts, last[0])):
+                    n += 1
+        return n
+
+    def gc(self, now: float) -> int:
+        """Retention prune: drop samples older than the window and
+        delete series that emptied out (or hold only a stale marker
+        older than the window). Returns samples dropped."""
+        horizon = now - self.retention_seconds
+        dropped = 0
+        dead = []
+        with self._lock:
+            for key, s in self._series.items():
+                while s.samples and s.samples[0][0] < horizon:
+                    s.samples.popleft()
+                    dropped += 1
+                if not s.samples:
+                    dead.append(key)
+            for key in dead:
+                s = self._series.pop(key)
+                bucket = self._by_name.get(s.name)
+                if bucket is not None:
+                    bucket.pop(key, None)
+                    if not bucket:
+                        del self._by_name[s.name]
+        if dropped:
+            TSDB_DROPPED.inc(dropped, reason="retention")
+            self.dropped["retention"] = \
+                self.dropped.get("retention", 0) + dropped
+        self._export()
+        return dropped
+
+    # -- read path --------------------------------------------------------
+
+    def _match(self, name: str,
+               matchers: Sequence[Matcher]) -> Iterable[Series]:
+        pool = (self._by_name.get(name, {}).values() if name
+                else self._series.values())
+        for s in pool:
+            if all(m.matches(s.labels) for m in matchers):
+                yield s
+
+    def select_range(self, name: str, matchers: Sequence[Matcher],
+                     start: float, end: float) -> list[tuple[dict, list]]:
+        """[(labels, [(ts, value), ...]), ...] for samples in
+        (start, end], stale markers excluded (a range is data points,
+        the marker only delimits instant lookback)."""
+        out = []
+        with self._lock:
+            for s in self._match(name, matchers):
+                pts = [(ts, v) for ts, v in s.samples
+                       if start < ts <= end and not is_stale(v)]
+                if pts:
+                    out.append((dict(s.labels), pts))
+        return out
+
+    def select_instant(self, name: str, matchers: Sequence[Matcher],
+                       at: float, lookback: float
+                       ) -> list[tuple[dict, float, float]]:
+        """[(labels, ts, value), ...]: per matching series, the newest
+        sample at or before ``at`` within ``lookback`` — unless that
+        sample is a staleness marker, which silences the series."""
+        out = []
+        with self._lock:
+            for s in self._match(name, matchers):
+                picked = None
+                for ts, v in reversed(s.samples):
+                    if ts <= at:
+                        picked = (ts, v)
+                        break
+                if picked is None:
+                    continue
+                ts, v = picked
+                if is_stale(v) or ts < at - lookback:
+                    continue
+                out.append((dict(s.labels), ts, v))
+        return out
+
+    def latest_value(self, name: str, **labels) -> Optional[tuple]:
+        """(ts, value) of the newest sample of one exact series, stale
+        markers included (None when the series does not exist)."""
+        with self._lock:
+            s = self._series.get(series_key(name, labels))
+            return s.latest() if s is not None else None
+
+    def series_names(self) -> list[str]:
+        with self._lock:
+            return sorted({s.name for s in self._series.values()})
+
+    # -- accounting -------------------------------------------------------
+
+    def _drop(self, reason: str) -> None:
+        self.dropped[reason] = self.dropped.get(reason, 0) + 1
+        TSDB_DROPPED.inc(reason=reason)
+
+    def _export(self) -> None:
+        with self._lock:
+            series = len(self._series)
+            samples = sum(len(s.samples) for s in self._series.values())
+        TSDB_SERIES.set(float(series))
+        TSDB_SAMPLES.set(float(samples))
+
+    def stats(self) -> dict:
+        with self._lock:
+            samples = sum(len(s.samples)
+                          for s in self._series.values())
+        self._export()
+        return {
+            "series": len(self._series),
+            "samples": samples,
+            "ingested": self.ingested,
+            "dropped": dict(self.dropped),
+            "max_series": self.max_series,
+            "max_samples_per_series": self.max_samples_per_series,
+            "retention_seconds": self.retention_seconds,
+            # Structural ceiling, not a measurement: ~64B per (ts, v)
+            # tuple pair + object overhead. The point is that it is a
+            # CONSTANT for a given config.
+            "bound_bytes_estimate":
+                self.max_series * self.max_samples_per_series * 64,
+        }

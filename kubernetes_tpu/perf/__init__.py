@@ -28,6 +28,35 @@ def parse_labeled_family(text: str, metric: str, label: str) -> dict:
     return out
 
 
+def query_exposition(text: str, expr: str, label: str = "") -> dict:
+    """Evaluate a PromQL-lite instant expression over ONE scraped
+    /metrics payload (monitoring/promql.py over a throwaway TSDB) —
+    the harness-side twin of the kmon pipeline's query surface, so a
+    bench can ask ``sum(apiserver_loop_busy_fraction)`` instead of
+    hand-rolling another exposition parser. Returns
+    ``{label_value: value}`` when ``label`` is given (the
+    parse_labeled_family shape), else ``{sorted-label-items: value}``;
+    a scalar result comes back as ``{"": value}``. Absent families
+    evaluate to {} — callers treat that as 'server predates the
+    metric', same contract as parse_labeled_family."""
+    from ..monitoring.promql import query_instant
+    from ..monitoring.scrape import ingest_exposition
+    from ..monitoring.tsdb import TSDB
+    db = TSDB()
+    ingest_exposition(db, text, 1.0, "bench", "local")
+    out = query_instant(db, expr, 1.0)
+    if out["resultType"] == "scalar":
+        return {"": out["result"][1]}
+    result: dict = {}
+    for e in out["result"]:
+        labels = {k: v for k, v in e["metric"].items()
+                  if k not in ("__name__", "job", "instance")}
+        key = labels.get(label, "") if label \
+            else tuple(sorted(labels.items()))
+        result[key] = e["value"][1]
+    return result
+
+
 def pct(sorted_vals, q: float) -> float:
     """Nearest-rank percentile from a pre-sorted list — the one
     definition every harness in this package reports with."""

@@ -117,10 +117,12 @@ def _parse_raw_quantiles(text: str) -> dict:
 
 def _parse_loop_busy(text: str) -> dict:
     """Per-loop busy fractions (EWMA gauges) from /metrics text —
-    the loop-lag probe's router/shard attribution snapshot."""
-    from . import parse_labeled_family
-    return parse_labeled_family(text, "apiserver_loop_busy_fraction",
-                                "loop")
+    the loop-lag probe's router/shard attribution snapshot, read
+    through the PromQL-lite engine (the same query `ktl query
+    apiserver_loop_busy_fraction` answers against the live TSDB)."""
+    from . import query_exposition
+    return query_exposition(text, "apiserver_loop_busy_fraction",
+                            label="loop")
 
 
 async def _run_density_rest(n_nodes: int, n_pods: int, timeout: float,

@@ -165,7 +165,7 @@ class _BindCoalescer:
 class Scheduler:
     def __init__(self, client: Client, name: str = "default-scheduler",
                  backoff_seconds: float = 1.0, policy=None,
-                 informer_factory=None):
+                 informer_factory=None, metrics_port: Optional[int] = None):
         self.client = client
         #: Optional shared InformerFactory (reference: the scheduler
         #: rides the controller-manager's SharedInformerFactory). When
@@ -217,6 +217,13 @@ class Scheduler:
         self._queue_spans: dict[str, object] = {}
         #: Loop-lag probe task (scheduler_loop_lag_ms family).
         self._probe_task: Optional[asyncio.Task] = None
+        #: /metrics listener port (kube-scheduler --secure-port analog;
+        #: metrics/http.py). None = no listener, byte-identical to the
+        #: pre-kmon scheduler; 0 = pick a free port. The composer turns
+        #: this on when the ClusterMetricsPipeline gate is set so the
+        #: scrape manager can reach scheduler_* series over HTTP.
+        self.metrics_port = metrics_port
+        self.metrics_listener = None
 
     # -- wiring (reference: factory.go:137 NewConfigFactory) --------------
 
@@ -273,6 +280,10 @@ class Scheduler:
                 self._group_changed_add(g)
         self._probe_task = spawn(loop_lag_probe(m.LOOP_LAG, m.LOOP_BUSY),
                                  name="scheduler-loop-probe")
+        if self.metrics_port is not None:
+            from ..metrics.http import MetricsListener
+            self.metrics_listener = MetricsListener(port=self.metrics_port)
+            await self.metrics_listener.start()
         self._task = asyncio.get_running_loop().create_task(self._run())
 
     async def stop(self) -> None:
@@ -281,6 +292,9 @@ class Scheduler:
         if self._probe_task is not None:
             self._probe_task.cancel()
             self._probe_task = None
+        if self.metrics_listener is not None:
+            await self.metrics_listener.stop()
+            self.metrics_listener = None
         if self._task:
             self._task.cancel()
             try:

@@ -120,6 +120,21 @@ KNOWN_FEATURES = {f.name: f for f in [
             "box (large training gangs keep their sub-meshes), and the "
             "endpoint router prefers same-slice/least-fragmented "
             "replicas. Off = legacy placement, byte-identical"),
+    Feature("ClusterMetricsPipeline", False, ALPHA,
+            "kmon Prometheus-analog metrics pipeline (monitoring/"
+            "pipeline.py): scrape manager over apiserver + component + "
+            "node /metrics endpoints, bounded in-memory TSDB, "
+            "PromQL-lite /debug/v1/query surface (ktl query|alerts|"
+            "dash), and recording/alerting rules whose verdicts become "
+            "Events. Off = no scrape traffic, no TSDB, no metrics "
+            "listeners, /debug/v1/query answers 404 — byte-identical"),
+    Feature("AlertNodeTainting", False, ALPHA,
+            "kmon alert-driven node tainting: firing node-degrading "
+            "alerts (sick chip, duty collapse, ICI stall) add a "
+            "tpu.google.com/degraded NoSchedule taint, removed when "
+            "the node's last degrading alert resolves — the seam a "
+            "migration/defrag controller consumes. Requires "
+            "ClusterMetricsPipeline; off = alerts record Events only"),
     Feature("ClusterMonitoring", True, BETA,
             "cluster-level TPU telemetry rollup (monitoring/"
             "aggregator.py): the controller-manager scrapes node "

@@ -1,0 +1,239 @@
+"""kmon pipeline end to end over a LocalCluster: gate-off
+byte-identicality, scrape convergence, the latest()/TSDB consistency
+contract, the chaos-driven sick-chip alert lifecycle (fire -> Event ->
+gated taint -> resolve -> untaint), and ktl's stale-row rendering."""
+import asyncio
+import contextlib
+import io
+import time
+
+import pytest
+
+from kubernetes_tpu.chaos import core as chaos_core
+from kubernetes_tpu.cli import ktl
+from kubernetes_tpu.cluster.local import LocalCluster, NodeSpec
+from kubernetes_tpu.monitoring.rules import TAINT_DEGRADED
+from kubernetes_tpu.util.features import GATES
+
+
+@pytest.fixture
+def kmon_on():
+    was = GATES.enabled("ClusterMetricsPipeline")
+    GATES.set("ClusterMetricsPipeline", True)
+    yield
+    GATES.set("ClusterMetricsPipeline", was)
+
+
+@pytest.fixture
+def tainting_on():
+    was = GATES.enabled("AlertNodeTainting")
+    GATES.set("AlertNodeTainting", True)
+    yield
+    GATES.set("AlertNodeTainting", was)
+
+
+def make_cluster(nodes=None) -> LocalCluster:
+    return LocalCluster(
+        nodes=nodes or [NodeSpec(name="mon-0", tpu_chips=4,
+                                 fake_runtime=True)],
+        tls=False, heartbeat_interval=0.2, status_interval=0.2,
+        monitor_interval=0.25, metrics_interval=0.25)
+
+
+async def run_ktl(base: str, *argv) -> tuple[int, str]:
+    args = ktl.build_parser().parse_args(["--server", base, *argv])
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = await args.fn(args)
+    return rc, buf.getvalue()
+
+
+async def wait_for(probe, timeout: float = 25.0, what: str = ""):
+    import inspect
+    deadline = asyncio.get_running_loop().time() + timeout
+    while True:
+        got = probe()
+        if inspect.isawaitable(got):
+            got = await got
+        if got:
+            return got
+        assert asyncio.get_running_loop().time() < deadline, \
+            f"timed out waiting for {what}"
+        await asyncio.sleep(0.2)
+
+
+async def test_gate_off_is_byte_identical():
+    """Default gates: no metrics listeners, no pipeline controller
+    running, and the debug routes answer 404."""
+    assert not GATES.enabled("ClusterMetricsPipeline")
+    cluster = make_cluster()
+    base = await cluster.start()
+    try:
+        assert cluster.scheduler.metrics_listener is None
+        assert cluster.controller_manager.metrics_listener is None
+        assert cluster.server.metrics_pipeline_provider is None
+        import aiohttp
+        async with aiohttp.ClientSession() as s:
+            for path in ("/debug/v1/query?query=up",
+                         "/debug/v1/alerts"):
+                async with s.get(f"{base}{path}") as r:
+                    assert r.status == 404
+        # ktl query reports the gate instead of an empty answer.
+        with pytest.raises(SystemExit, match="ClusterMetricsPipeline"):
+            await run_ktl(base, "query", "up")
+    finally:
+        await cluster.stop()
+
+
+async def test_scrape_converges_and_latest_matches_tsdb(kmon_on):
+    cluster = make_cluster()
+    base = await cluster.start()
+    try:
+        await cluster.wait_for_nodes_ready(30.0)
+        pipeline = await wait_for(
+            lambda: _pipeline(cluster), what="pipeline controller")
+
+        async def all_jobs_up():
+            out = pipeline.query_instant("sum by (job) (up)")
+            got = {e["metric"]["job"]: e["value"][1]
+                   for e in out["result"]}
+            return (got.get("apiserver") == 1 and got.get("node") == 1
+                    and got.get("scheduler") == 1
+                    and got.get("controller-manager") == 1)
+        await wait_for(all_jobs_up, what="all four scrape jobs up")
+
+        # Consistency: the autoscaler's snapshot seam and the query
+        # surface must agree on every tpu_cluster_* point. The monitor
+        # sweeps and the pipeline ticks on independent cadences, so
+        # poll for a read landing between "tick recorded snapshot S"
+        # and "monitor produced S+1" — if latest() and the TSDB could
+        # disagree on any value at the same timestamp, no such window
+        # would ever satisfy the exact-equality check and this times
+        # out.
+        from kubernetes_tpu.monitoring.aggregator import ClusterMonitor
+
+        def consistent():
+            snap = pipeline.monitor.latest()
+            if not snap["at"]:
+                return False
+            points, _stale = ClusterMonitor.rollup_points(snap)
+            cluster_points = [p for p in points
+                              if p[0].startswith("tpu_cluster_")]
+            if len(cluster_points) < 9:
+                return False
+            # Sample timestamps sit on the TSDB's step grid.
+            at = snap["at"] - (snap["at"] % pipeline.tsdb.step)
+            return all(
+                pipeline.tsdb.latest_value(name, **labels)
+                == (at, value)
+                for name, labels, value in cluster_points)
+        await wait_for(consistent,
+                       what="latest() == TSDB tpu_cluster_* points")
+
+        # Chip-level series flow through the node job with the node's
+        # own labels only (the single-process dedup filter).
+        out = pipeline.query_instant("tpu_chip_healthy")
+        assert len(out["result"]) == 4
+        assert all(e["metric"]["job"] == "node"
+                   and e["metric"]["node"] == "mon-0"
+                   for e in out["result"])
+
+        # /debug/v1/query range + ktl query run the same engine.
+        rc, text = await run_ktl(base, "query", "sum(tpu_chip_healthy)")
+        assert rc == 0 and "4" in text
+        rc, text = await run_ktl(base, "alerts")
+        assert rc == 0 and "No active alerts" in text
+        rc, text = await run_ktl(base, "dash", "--range", "1m")
+        assert rc == 0 and "targets up" in text
+    finally:
+        await cluster.stop()
+
+
+def _pipeline(cluster):
+    return cluster.controller_manager.get_controller("metrics-pipeline")
+
+
+async def test_chaos_sick_chip_alert_lifecycle(kmon_on, tainting_on):
+    """chaos/driver.py injects chip unhealthy -> TpuChipSick fires
+    after its hold-down -> Warning Event + degraded NoSchedule taint ->
+    chip recovers -> alert resolves -> Normal Event + untaint."""
+    controller = chaos_core.arm(chaos_core.ChaosController(11, ()))
+    cluster = make_cluster()
+    await cluster.start()
+    try:
+        await cluster.wait_for_nodes_ready(30.0)
+        assert cluster.chaos_driver is not None
+        local = cluster.local_client()
+        pipeline = await wait_for(
+            lambda: _pipeline(cluster), what="pipeline controller")
+        await wait_for(lambda: pipeline.ticks >= 2, what="first ticks")
+
+        controller.trigger(chaos_core.SITE_DEVICE, "unhealthy",
+                           param=6.0)
+        cluster.chaos_driver.tick()
+
+        async def fired():
+            return "TpuChipSick" in pipeline.firing_names()
+        await wait_for(fired, what="TpuChipSick to fire")
+
+        async def tainted():
+            nodes, _ = await local.list("nodes")
+            return {n.metadata.name for n in nodes
+                    if any(t.key == TAINT_DEGRADED
+                           for t in n.spec.taints)}
+        names = await wait_for(tainted, what="degraded taint")
+        assert names == {"mon-0"}
+
+        async def resolved():
+            if "TpuChipSick" in pipeline.firing_names():
+                return False
+            return not await tainted()
+        await wait_for(resolved, timeout=30.0,
+                       what="alert resolve + untaint")
+
+        evs, _ = await local.list("events")
+        kmon = [(e.type, e.reason) for e in evs
+                if e.source.component == "kmon"]
+        assert ("Warning", "TpuChipSick") in kmon
+        assert ("Normal", "TpuChipSick") in kmon
+    finally:
+        chaos_core.disarm()
+        await cluster.stop()
+
+
+async def test_top_nodes_marks_carried_forward_stale(kmon_on):
+    """An unscrapable node renders from the TSDB's last-known
+    aggregate: trailing * on the node name, a real AGE, and the row
+    tagged stale instead of silently fresh (or a bare 'unreachable')."""
+    cluster = make_cluster(
+        nodes=[NodeSpec(name="live-0", tpu_chips=4, fake_runtime=True),
+               NodeSpec(name="dead-0", tpu_chips=4, fake_runtime=True)])
+    base = await cluster.start()
+    try:
+        await cluster.wait_for_nodes_ready(30.0)
+        pipeline = await wait_for(
+            lambda: _pipeline(cluster), what="pipeline controller")
+
+        async def node_rollups_recorded():
+            out = pipeline.query_instant(
+                'tpu_node_chips{state="total"}')
+            return len(out["result"]) == 2
+        await wait_for(node_rollups_recorded, what="node rollups")
+
+        # Kill one node's agent server; the Node object stays listed.
+        dead = next(n for n in cluster.nodes if n.name == "dead-0")
+        await dead.agent.stop()
+        await asyncio.sleep(1.0)  # let staleness settle
+
+        rc, text = await run_ktl(base, "top", "nodes")
+        assert rc == 0
+        lines = {line.split()[0].rstrip("*"): line
+                 for line in text.splitlines()
+                 if line.startswith(("live-0", "dead-0"))}
+        assert "live-0" in lines and "stale" not in lines["live-0"]
+        assert lines["dead-0"].startswith("dead-0*"), lines["dead-0"]
+        assert "stale" in lines["dead-0"]
+        # The stale row still carries the last-known chip count.
+        assert lines["dead-0"].split()[1] == "4"
+    finally:
+        await cluster.stop()

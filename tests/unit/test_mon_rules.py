@@ -1,0 +1,127 @@
+"""kmon rule engine (monitoring/rules.py): hold-down, fire/resolve
+transitions, recording rules, and the built-in rule set."""
+from kubernetes_tpu.monitoring import promql
+from kubernetes_tpu.monitoring.rules import (AlertRule, RecordingRule,
+                                             RuleEngine, builtin_rules,
+                                             builtin_recording_rules)
+from kubernetes_tpu.monitoring.tsdb import TSDB
+
+T0 = 1000.0
+
+
+def sick_rule(for_seconds=5.0, **kw):
+    return AlertRule("ChipSick", "healthy == 0",
+                     for_seconds=for_seconds, severity="critical",
+                     taint=True, **kw)
+
+
+def test_holddown_then_fire_then_resolve():
+    db = TSDB()
+    eng = RuleEngine(db, alert_rules=[sick_rule(5.0)])
+    db.add("healthy", {"chip": "c0"}, 0.0, T0)
+    # First sighting: pending, no transition.
+    assert eng.evaluate(T0 + 1) == []
+    assert eng.alerts()[0]["state"] == "pending"
+    # Still inside the hold-down.
+    assert eng.evaluate(T0 + 4) == []
+    # Past the hold-down: exactly one firing edge.
+    trs = eng.evaluate(T0 + 7)
+    assert [(tr.kind, tr.rule.name) for tr in trs] == \
+        [("firing", "ChipSick")]
+    assert trs[0].labels["chip"] == "c0"
+    assert eng.evaluate(T0 + 8) == []  # steady state: no re-fire
+    assert eng.alerts()[0]["state"] == "firing"
+    # Condition clears -> one resolved edge, alert gone.
+    db.add("healthy", {"chip": "c0"}, 1.0, T0 + 9)
+    trs = eng.evaluate(T0 + 10)
+    assert [(tr.kind, tr.rule.name) for tr in trs] == \
+        [("resolved", "ChipSick")]
+    assert eng.alerts() == []
+
+
+def test_pending_that_clears_never_fires():
+    db = TSDB()
+    eng = RuleEngine(db, alert_rules=[sick_rule(5.0)])
+    db.add("healthy", {"chip": "c0"}, 0.0, T0)
+    assert eng.evaluate(T0 + 1) == []
+    db.add("healthy", {"chip": "c0"}, 1.0, T0 + 2)
+    # One noisy scrape must not produce fire OR resolve edges.
+    assert eng.evaluate(T0 + 3) == []
+    assert eng.evaluate(T0 + 10) == []
+    assert eng.alerts() == []
+
+
+def test_per_labelset_instances_are_independent():
+    db = TSDB()
+    eng = RuleEngine(db, alert_rules=[sick_rule(2.0)])
+    db.add("healthy", {"chip": "c0"}, 0.0, T0)
+    eng.evaluate(T0)
+    db.add("healthy", {"chip": "c1"}, 0.0, T0 + 1.5)
+    eng.evaluate(T0 + 1.5)
+    trs = eng.evaluate(T0 + 2.5)  # c0 past hold-down, c1 not yet
+    assert [tr.labels["chip"] for tr in trs] == ["c0"]
+    trs = eng.evaluate(T0 + 4)
+    assert [tr.labels["chip"] for tr in trs] == ["c1"]
+
+
+def test_recording_rule_writes_back():
+    db = TSDB()
+    eng = RuleEngine(db, recording_rules=[
+        RecordingRule("all:duty:avg", "avg(duty)"),
+        RecordingRule("by_node:duty:avg", "avg by (node) (duty)")])
+    db.add("duty", {"node": "n1"}, 80.0, T0)
+    db.add("duty", {"node": "n2"}, 40.0, T0)
+    eng.evaluate(T0 + 1)
+    assert db.latest_value("all:duty:avg") == (T0 + 1, 60.0)
+    assert db.latest_value("by_node:duty:avg", node="n1") == \
+        (T0 + 1, 80.0)
+    # Recorded series are queryable like any other.
+    out = promql.query_instant(db, "all:duty:avg", T0 + 2)
+    assert out["result"][0]["value"][1] == 60.0
+
+
+def test_broken_rule_does_not_wedge_the_engine():
+    db = TSDB()
+    eng = RuleEngine(
+        db,
+        alert_rules=[AlertRule("Bad", "rate(healthy)", 1.0),
+                     sick_rule(0.0)],
+        recording_rules=[RecordingRule("bad:rec", "nope(")])
+    db.add("healthy", {"chip": "c0"}, 0.0, T0)
+    trs = eng.evaluate(T0)
+    assert [tr.rule.name for tr in trs] == ["ChipSick"]
+
+
+def test_builtin_rules_parse_and_scale_with_interval():
+    for interval in (0.3, 10.0):
+        rules = builtin_rules(interval)
+        names = {r.name for r in rules}
+        assert {"TpuChipSick", "TpuChipDutyCollapse", "TpuIciStall",
+                "TpuNodeStraggler", "ApiServerLoopSaturated",
+                "ReplicationFollowerStale",
+                "ScrapeTargetDown"} <= names
+        for r in rules:
+            promql.parse(r.expr)  # must not raise
+            assert r.for_seconds >= 2 * interval
+        taints = {r.name for r in rules if r.taint}
+        assert taints == {"TpuChipSick", "TpuChipDutyCollapse",
+                          "TpuIciStall"}
+    for r in builtin_recording_rules():
+        promql.parse(r.expr)
+        assert ":" in r.record  # level:metric:operation convention
+
+
+def test_builtin_sick_chip_fires_on_fixture():
+    db = TSDB()
+    eng = RuleEngine(db, alert_rules=builtin_rules(0.5))
+    for k in range(5):
+        ts = T0 + 0.5 * k
+        db.add("tpu_chip_healthy",
+               {"node": "n1", "chip": "c0"}, 0.0, ts)
+        db.add("up", {"job": "node", "instance": "n1"}, 1.0, ts)
+        trs = eng.evaluate(ts)
+        if trs:
+            assert (trs[0].rule.name, trs[0].labels["node"]) == \
+                ("TpuChipSick", "n1")
+            return
+    raise AssertionError("TpuChipSick never fired")

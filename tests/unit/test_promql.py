@@ -1,0 +1,199 @@
+"""PromQL-lite golden-query suite: expr -> expected result over a
+fixture store (monitoring/promql.py)."""
+import math
+
+import pytest
+
+from kubernetes_tpu.monitoring import promql
+from kubernetes_tpu.monitoring.tsdb import TSDB
+
+NOW = 1000.0
+
+
+def fixture_store() -> TSDB:
+    """Two nodes x two chips of duty/counter series + up, sampled every
+    10s for 60s ending at NOW."""
+    db = TSDB()
+    duty = {("n1", "c0"): 80.0, ("n1", "c1"): 60.0,
+            ("n2", "c0"): 40.0, ("n2", "c1"): 0.0}
+    for k in range(7):
+        ts = NOW - 60.0 + 10.0 * k
+        for (node, chip), d in duty.items():
+            db.add("duty", {"node": node, "chip": chip}, d, ts)
+            # counter: 100 bytes/s per duty pct, with a reset mid-way
+            # on n1/c0 to exercise counter-reset handling.
+            v = d * 100.0 * k
+            if (node, chip) == ("n1", "c0") and k >= 4:
+                v = d * 100.0 * (k - 4)
+            db.add("ici_tx", {"node": node, "chip": chip}, v, ts)
+        for inst in ("n1", "n2"):
+            db.add("up", {"job": "node", "instance": inst}, 1.0, ts)
+    db.add("up", {"job": "apiserver", "instance": "a1"}, 0.0, NOW)
+    return db
+
+
+def q(expr, at=NOW, lookback=300.0):
+    db = fixture_store()
+    return promql.query_instant(db, expr, at, lookback=lookback)
+
+
+def vec(result):
+    return {tuple(sorted(e["metric"].items())): e["value"][1]
+            for e in result["result"]}
+
+
+def test_instant_selector_and_matchers():
+    out = q('duty{node="n1"}')
+    assert out["resultType"] == "vector"
+    got = vec(out)
+    assert len(got) == 2
+    assert got[(("__name__", "duty"), ("chip", "c0"),
+                ("node", "n1"))] == 80.0
+
+
+def test_regex_matcher_and_ne():
+    assert len(vec(q('duty{chip=~"c.*"}'))) == 4
+    assert len(vec(q('duty{node!="n1"}'))) == 2
+
+
+def test_scalar_literal_and_arith():
+    assert q("3 + 4 * 2")["result"][1] == 11.0
+    assert q("(3 + 4) * 2")["result"][1] == 14.0
+
+
+def test_vector_scalar_arithmetic_and_filter():
+    got = vec(q("duty / 100"))
+    assert got[(("chip", "c0"), ("node", "n1"))] == 0.8
+    # comparison filters and keeps the element's own value
+    got = vec(q("duty > 50"))
+    assert sorted(got.values()) == [60.0, 80.0]
+    # scalar-on-the-left flips operands, not semantics
+    got = vec(q("100 - duty"))
+    assert got[(("chip", "c1"), ("node", "n2"))] == 100.0
+
+
+def test_aggregations():
+    assert q("sum(duty)")["result"][0]["value"][1] == 180.0
+    assert q("avg(duty)")["result"][0]["value"][1] == 45.0
+    assert q("max(duty)")["result"][0]["value"][1] == 80.0
+    assert q("count(duty)")["result"][0]["value"][1] == 4.0
+    got = vec(q("sum by (node) (duty)"))
+    assert got[(("node", "n1"),)] == 140.0
+    assert got[(("node", "n2"),)] == 40.0
+
+
+def test_rate_and_counter_reset():
+    got = vec(q("rate(ici_tx[60s])"))
+    # steady counter: duty*100 per 10s step -> duty*10 per second;
+    # the left-open window (940, 1000] holds k=1..6.
+    assert got[(("chip", "c1"), ("node", "n1"))] == \
+        pytest.approx(600.0)
+    assert got[(("chip", "c1"), ("node", "n2"))] == 0.0
+    # reset series (n1/c0): 8000,16000,24000,reset,0,8000,16000 ->
+    # increase = 24000 + (16000 - 8000) = 32000 over 50s.
+    assert got[(("chip", "c0"), ("node", "n1"))] == \
+        pytest.approx(640.0)
+
+
+def test_increase_is_rate_times_window():
+    r = vec(q("rate(ici_tx[60s])"))[(("chip", "c1"), ("node", "n2"))]
+    inc = vec(q("increase(ici_tx[60s])"))[
+        (("chip", "c1"), ("node", "n2"))]
+    assert inc == pytest.approx(r * 60.0)
+
+
+def test_over_time_functions():
+    got = vec(q('avg_over_time(duty{node="n2"}[60s])'))
+    assert got[(("chip", "c0"), ("node", "n2"))] == 40.0
+    # left-open window: the sample exactly at NOW-60 is excluded
+    got = vec(q('count_over_time(duty{node="n2",chip="c0"}[60s])'))
+    assert got[(("chip", "c0"), ("node", "n2"))] == 6.0
+    got = vec(q('quantile_over_time(0.99, duty{chip="c0"}[60s])'))
+    assert got[(("chip", "c0"), ("node", "n1"))] == 80.0
+
+
+def test_vector_vector_and_set_ops():
+    got = vec(q("duty == 0 and ici_tx == 0"))
+    assert list(got) == [(("chip", "c1"), ("node", "n2"))]
+    assert len(vec(q("duty unless duty > 50"))) == 2
+    # or: union, left wins on overlap
+    assert len(vec(q("duty or duty"))) == 4
+    # vector arithmetic matches on identical label sets
+    got = vec(q("duty + duty"))
+    assert got[(("chip", "c0"), ("node", "n1"))] == 160.0
+
+
+def test_scalar_function():
+    assert q("scalar(sum(duty))")["result"][1] == 180.0
+    # multi-element vector -> NaN, like Prometheus
+    assert math.isnan(q("scalar(duty)")["result"][1])
+
+
+def test_up_expressions_the_rules_use():
+    got = vec(q("up == 0"))
+    assert list(got) == [(("instance", "a1"), ("job", "apiserver"))]
+    got = vec(q("sum by (job) (up)"))
+    assert got[(("job", "node"),)] == 2.0
+
+
+def test_straggler_shape():
+    got = vec(q("duty < 0.5 * scalar(avg(duty))"))
+    # avg = 45 -> threshold 22.5 -> only the 0-duty chip
+    assert list(got) == [(("chip", "c1"), ("node", "n2"))]
+
+
+def test_last_over_time_and_timestamp():
+    got = vec(q('last_over_time(duty{node="n1",chip="c0"}[2m])'))
+    assert got[(("chip", "c0"), ("node", "n1"))] == 80.0
+    # timestamp() of the last sample: the fixture's newest point is
+    # at NOW — and it still answers when evaluated far in the future,
+    # where the plain instant selector has aged out of lookback.
+    got = vec(q('timestamp(last_over_time(duty{node="n1",chip="c0"}'
+                '[30m]))', at=NOW + 1000.0))
+    assert got[(("chip", "c0"), ("node", "n1"))] == NOW
+    # timestamp(instant selector) uses the sample's own ts too.
+    got = vec(q('timestamp(duty{node="n1",chip="c0"})', at=NOW + 10.0))
+    assert got[(("chip", "c0"), ("node", "n1"))] == NOW
+    with pytest.raises(promql.PromQLError):
+        q("timestamp(sum(duty))")
+
+
+def test_range_query_matrix():
+    db = fixture_store()
+    out = promql.query_range(db, "sum(duty)", NOW - 30.0, NOW, 10.0)
+    assert out["resultType"] == "matrix"
+    values = out["result"][0]["values"]
+    assert len(values) == 4
+    assert all(v == 180.0 for _ts, v in values)
+
+
+def test_range_query_bounds():
+    db = fixture_store()
+    with pytest.raises(promql.PromQLError):
+        promql.query_range(db, "duty", 0.0, NOW, 0.001)
+    with pytest.raises(promql.PromQLError):
+        promql.query_range(db, "duty", NOW, 0.0, 1.0)
+
+
+def test_lookback_applies():
+    out = q("duty", at=NOW + 400.0, lookback=300.0)
+    assert out["result"] == []
+
+
+def test_parse_errors():
+    for bad in ("", "duty{", "duty[", "rate(duty)", "duty and 3",
+                "nope(duty)", "duty{x=y}", "sum duty",
+                "quantile_over_time(duty[30s])",
+                'duty{chip=~"["}',  # bad regex -> 400, never a 500
+                "quantile_over_time(2, duty[30s])"):
+        with pytest.raises(promql.PromQLError):
+            db = TSDB()
+            promql.query_instant(db, bad, NOW)
+
+
+def test_recording_rule_names_parse():
+    # level:metric:operation names are valid selectors
+    db = TSDB()
+    db.add("cluster:tpu_duty:avg", {}, 42.0, NOW)
+    out = promql.query_instant(db, "cluster:tpu_duty:avg", NOW + 1)
+    assert out["result"][0]["value"][1] == 42.0

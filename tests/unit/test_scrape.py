@@ -1,0 +1,120 @@
+"""kmon scrape manager (monitoring/scrape.py): exposition parsing,
+family/label filtering, target discovery, and the staleness edge."""
+from kubernetes_tpu.metrics.http import MetricsListener
+from kubernetes_tpu.metrics.registry import (Counter, Gauge,
+                                             MetricsRegistry)
+from kubernetes_tpu.monitoring.scrape import (ScrapeManager, ScrapeTarget,
+                                              ingest_exposition,
+                                              parse_exposition)
+from kubernetes_tpu.monitoring.tsdb import TSDB
+
+EXPO = """\
+# HELP duty Per-chip duty
+# TYPE duty gauge
+duty{node="n1",chip="c0"} 80
+duty{node="n2",chip="c0"} 40
+plain_counter 12.5
+esc{msg="a \\"quoted\\" value"} 1
+winpath{p="C:\\\\nightly\\n2"} 1
+lat_bucket{le="0.1"} 3
+lat_sum 0.42
+lat_count 3
+garbage_line_without_value
+bad_value{x="y"} notanumber
+"""
+
+
+def test_parse_exposition():
+    got = {(name, tuple(sorted(labels.items()))): value
+           for name, labels, value in parse_exposition(EXPO)}
+    assert got[("duty", (("chip", "c0"), ("node", "n1")))] == 80.0
+    assert got[("plain_counter", ())] == 12.5
+    assert got[("esc", (("msg", 'a "quoted" value'),))] == 1.0
+    # \\ then n must stay a literal backslash + 'n', not become a
+    # newline; a real \n escape still decodes.
+    assert got[("winpath", (("p", "C:\\nightly\n2"),))] == 1.0
+    assert got[("lat_bucket", (("le", "0.1"),))] == 3.0
+    assert got[("lat_sum", ())] == 0.42
+    assert ("garbage_line_without_value", ()) not in got
+    assert ("bad_value", (("x", "y"),)) not in got
+
+
+def test_ingest_adds_target_labels_and_filters():
+    db = TSDB()
+    target = ScrapeTarget(job="node", instance="n1", url="",
+                          families=("duty",),
+                          require_labels={"node": "n1"})
+    n = ingest_exposition(db, EXPO, 100.0, "node", "n1", target)
+    # Only n1's duty survives the family + label filter.
+    assert n == 1
+    assert db.latest_value("duty", node="n1", chip="c0",
+                           job="node", instance="n1") == (100.0, 80.0)
+    assert db.series_names() == ["duty"]
+    # Unfiltered ingest takes everything parseable.
+    db2 = TSDB()
+    n = ingest_exposition(db2, EXPO, 100.0, "j", "i")
+    assert n == 8
+
+
+class FakeClient:
+    """list('nodes') -> no nodes: component targets only."""
+
+    async def list(self, resource, namespace=""):
+        assert resource == "nodes"
+        return [], 0
+
+
+async def test_sweep_up_down_and_staleness_edge():
+    reg = MetricsRegistry()
+    Gauge("scheduler_test_gauge", "g", registry=reg).set(7.0)
+    Counter("scheduler_test_total", "c", registry=reg).inc(3.0)
+    listener = MetricsListener(port=0, registry=reg)
+    await listener.start()
+    db = TSDB()
+    mgr = ScrapeManager(FakeClient(), db, interval=0.2,
+                        component_urls=[("scheduler", listener.url)])
+    try:
+        report = await mgr.sweep(now=100.0)
+        inst = listener.url.split("://", 1)[1]
+        assert report == {f"scheduler/{inst}": True}
+        assert db.latest_value("up", job="scheduler",
+                               instance=inst) == (100.0, 1.0)
+        assert db.latest_value("scheduler_test_gauge", job="scheduler",
+                               instance=inst) == (100.0, 7.0)
+        dur = db.latest_value("kmon_scrape_duration_seconds",
+                              job="scheduler", instance=inst)
+        assert dur is not None and dur[1] > 0
+    finally:
+        await listener.stop()
+    # Target gone: up flips to 0 and the target's series go stale.
+    await mgr.sweep(now=101.0)
+    assert db.latest_value("up", job="scheduler",
+                           instance=inst) == (101.0, 0.0)
+    assert db.select_instant("scheduler_test_gauge", (), 102.0,
+                             lookback=300.0) == []
+    # ... but history is preserved for range queries.
+    rng = db.select_range("scheduler_test_gauge", (), 0.0, 1e12)
+    assert rng[0][1] == [(100.0, 7.0)]
+    # Down is an edge, not a level: a second down sweep re-marks
+    # nothing (series already stale).
+    await mgr.sweep(now=102.0)
+    assert db.latest_value("up", job="scheduler",
+                           instance=inst) == (102.0, 0.0)
+
+
+async def test_listed_but_unresolvable_node_is_a_down_target():
+    class OneNodeClient:
+        async def list(self, resource, namespace=""):
+            from kubernetes_tpu.api import types as t
+            from kubernetes_tpu.api.meta import ObjectMeta
+            return [t.Node(metadata=ObjectMeta(name="ghost"))], 0
+
+        async def get(self, resource, namespace, name):
+            from kubernetes_tpu.api import errors
+            raise errors.NotFoundError(f"{resource} {name}")
+
+    db = TSDB()
+    mgr = ScrapeManager(OneNodeClient(), db, interval=0.2)
+    await mgr.sweep(now=100.0)
+    assert db.latest_value("up", job="node",
+                           instance="ghost") == (100.0, 0.0)

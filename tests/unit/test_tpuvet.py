@@ -148,6 +148,29 @@ B = Histogram("tpu_widget_seconds", "y")
     assert run_source(good, checks=["metric-name"]) == []
 
 
+def test_metric_name_kmon_and_scrape_families():
+    """The kmon pipeline's self-metric families (kmon_tsdb_*,
+    kmon_scrape*, kmon_alerts_*) and the Prometheus-conventional
+    colon names recording rules write are all valid; a duplicate
+    inside the family is still caught."""
+    good = """
+from kubernetes_tpu.metrics.registry import Counter, Gauge
+A = Counter("kmon_tsdb_dropped_samples_total", "x", labels=("reason",))
+B = Counter("kmon_scrapes_total", "x", labels=("job", "result"))
+C = Gauge("kmon_tsdb_series", "x")
+D = Gauge("kmon_alerts_active", "x", labels=("alertname", "state"))
+E = Gauge("cluster:tpu_duty:avg", "colons are legal prometheus")
+"""
+    assert run_source(good, checks=["metric-name"]) == []
+    bad = """
+from kubernetes_tpu.metrics.registry import Counter
+A = Counter("kmon_scrapes_total", "x", labels=("job", "result"))
+B = Counter("kmon_scrapes_total", "x")
+"""
+    got = run_source(bad, checks=["metric-name"])
+    assert len(got) == 1 and "already registered" in got[0].message
+
+
 def test_metric_name_batch_and_encode_cache_families():
     """The batch-API and serialize-once-cache metric families
     (apiserver_batch_*, encode_cache_*) are valid names, and a

@@ -1,0 +1,106 @@
+"""kmon TSDB (monitoring/tsdb.py): ring bounds, retention,
+downsampling, staleness — the never-unbounded contract."""
+import math
+
+from kubernetes_tpu.monitoring.tsdb import STALE, Matcher, TSDB, is_stale
+
+
+def test_ring_bound_is_structural():
+    db = TSDB(max_samples_per_series=8)
+    for i in range(100):
+        db.add("m", {"a": "1"}, float(i), 100.0 + i)
+    assert db.stats()["samples"] == 8
+    # The ring keeps the NEWEST samples.
+    pts = db.select_range("m", (), 0.0, 1e12)
+    assert [v for _ts, v in pts[0][1]] == [92.0, 93.0, 94.0, 95.0,
+                                           96.0, 97.0, 98.0, 99.0]
+
+
+def test_series_limit_drops_and_counts():
+    db = TSDB(max_series=3)
+    for i in range(10):
+        db.add("m", {"i": str(i)}, 1.0, 100.0)
+    st = db.stats()
+    assert st["series"] == 3
+    assert st["dropped"]["series_limit"] == 7
+    # Existing series still accept samples at the limit.
+    assert db.add("m", {"i": "0"}, 2.0, 101.0)
+
+
+def test_out_of_order_dropped_same_ts_replaced():
+    db = TSDB()
+    assert db.add("m", {}, 1.0, 100.0)
+    assert not db.add("m", {}, 2.0, 99.0)
+    assert db.dropped["out_of_order"] == 1
+    # Same instant: keep-last, not a new sample.
+    assert db.add("m", {}, 3.0, 100.0)
+    assert db.stats()["samples"] == 1
+    assert db.latest_value("m") == (100.0, 3.0)
+
+
+def test_step_alignment_downsamples_keep_last():
+    db = TSDB(step=10.0)
+    db.add("m", {}, 1.0, 101.0)   # -> bucket 100
+    db.add("m", {}, 2.0, 104.0)   # same bucket, replaces
+    db.add("m", {}, 3.0, 112.0)   # -> bucket 110
+    pts = db.select_range("m", (), 0.0, 1e12)[0][1]
+    assert pts == [(100.0, 2.0), (110.0, 3.0)]
+
+
+def test_retention_gc_prunes_and_counts():
+    db = TSDB(retention_seconds=60.0)
+    db.add("m", {}, 1.0, 100.0)
+    db.add("m", {}, 2.0, 200.0)
+    db.add("gone", {}, 1.0, 100.0)
+    dropped = db.gc(220.0)
+    assert dropped == 2
+    assert db.dropped["retention"] == 2
+    assert db.stats()["series"] == 1  # 'gone' emptied out -> deleted
+    assert db.latest_value("m") == (200.0, 2.0)
+
+
+def test_staleness_marker_silences_instant_not_range():
+    db = TSDB()
+    db.add("m", {"n": "a"}, 5.0, 100.0)
+    db.add("m", {"n": "b"}, 7.0, 100.0)
+    assert db.mark_stale(105.0, matchers=[Matcher("n", "=", "a")]) == 1
+    got = db.select_instant("m", (), 110.0, lookback=300.0)
+    assert [(labels["n"], v) for labels, _ts, v in got] == [("b", 7.0)]
+    # Range queries still see the historical real points.
+    rng = db.select_range("m", [Matcher("n", "=", "a")], 0.0, 1e12)
+    assert rng[0][1] == [(100.0, 5.0)]
+    # Marking again is a no-op (already stale).
+    assert db.mark_stale(106.0, matchers=[Matcher("n", "=", "a")]) == 0
+    # A fresh sample revives the series.
+    db.add("m", {"n": "a"}, 9.0, 120.0)
+    got = db.select_instant("m", [Matcher("n", "=", "a")], 125.0, 300.0)
+    assert got and got[0][2] == 9.0
+
+
+def test_lookback_excludes_old_samples():
+    db = TSDB()
+    db.add("m", {}, 1.0, 100.0)
+    assert db.select_instant("m", (), 500.0, lookback=60.0) == []
+    assert db.select_instant("m", (), 150.0, lookback=60.0) != []
+
+
+def test_matchers():
+    db = TSDB()
+    db.add("m", {"job": "node", "i": "n1"}, 1.0, 100.0)
+    db.add("m", {"job": "node", "i": "n2"}, 2.0, 100.0)
+    db.add("m", {"job": "apiserver", "i": "a"}, 3.0, 100.0)
+
+    def q(*matchers):
+        return sorted(labels["i"] for labels, _ts, _v in
+                      db.select_instant("m", matchers, 101.0, 300.0))
+
+    assert q(Matcher("job", "=", "node")) == ["n1", "n2"]
+    assert q(Matcher("job", "!=", "node")) == ["a"]
+    assert q(Matcher("i", "=~", "n.*")) == ["n1", "n2"]
+    assert q(Matcher("i", "!~", "n1|a")) == ["n2"]
+
+
+def test_stale_helpers():
+    assert is_stale(STALE)
+    assert not is_stale(0.0)
+    assert math.isnan(STALE)
