@@ -47,18 +47,41 @@ def main() -> None:
         # 1000 nodes) through the same three-process REST path, with
         # the control-plane scale-out gates ON (the PR-9 headline; the
         # gated-off path is covered by the 200n arm above and asserted
-        # byte-identical by the unit/chaos suites). Reports TRUE
-        # raw-sample percentiles for bind_call AND api_request_latency
-        # plus per-phase event-loop busy shares.
+        # byte-identical by the unit/chaos suites) PLUS the scheduler
+        # fast path + compact wire codec (the ROADMAP item-3a/3b
+        # headline). Reports TRUE raw-sample percentiles for bind_call
+        # AND api_request_latency, per-phase event-loop busy shares,
+        # and — via a 2% ktrace sample — the span-derived
+        # queue/schedule/bind breakdown whose schedule-stage p99 is the
+        # fast path's judge metric.
         try:
             sched["rest_30k"] = asyncio.run(
                 run_density(n_nodes=1000, n_pods=30000, via="rest",
                             timeout=900.0,
                             create_concurrency=REST_CREATE_CONCURRENCY,
+                            trace_sample=0.02,
+                            # 64-pod batchCreate chunks: measured sweet
+                            # spot on this host once the fast path holds
+                            # >900 pods/s (32 starves the creators, 128
+                            # balloons bind p99 — see README R14 notes).
+                            create_batch=64,
                             feature_gates="ApiServerSharding=true,"
-                                          "ApiServerCodecOffload=true"))
+                                          "ApiServerCodecOffload=true,"
+                                          "SchedulerFastPath=true,"
+                                          "CompactWireCodec=true"))
         except Exception as exc:  # noqa: BLE001
             sched["rest_30k"] = {"error": str(exc)[:200]}
+        # Decode share per codec (perf/decode_share.py): the same REST
+        # arm profiled under JSON and under the compact codec — the
+        # codec win as a first-class number beside the 30k stanza.
+        try:
+            from kubernetes_tpu.perf.decode_share import \
+                run_decode_share_matrix
+            sched["decode_share"] = asyncio.run(
+                run_decode_share_matrix(n_nodes=200, n_pods=6000,
+                                        timeout=300.0))
+        except Exception as exc:  # noqa: BLE001
+            sched["decode_share"] = {"error": str(exc)[:200]}
         # Pod STARTUP latency through the full real stack (HTTP
         # apiserver + scheduler + agents + real processes), vs the
         # reference's 5s p50/p90/p99 SLO (metrics_util.go:46).
@@ -143,6 +166,19 @@ def _headline(chip: dict, sched: dict) -> dict:
         busy30 = rest30.get("apiserver_loop_busy_saturation") or {}
         h["rest30k_loop_busy"] = busy30.get("router")
         h["rest30k_gates"] = rest30.get("feature_gates", "")
+        # Round-14 schema additions (BENCH notes in README): scheduler
+        # fast-path judge metrics — span-derived schedule-stage p99 +
+        # the scheduler's own loop busy share — and the per-codec
+        # decode share from perf/decode_share.py.
+        bd30 = rest30.get("startup_breakdown") or {}
+        h["rest30k_sched_p99_ms"] = (bd30.get("schedule") or {}).get(
+            "p99_ms")
+        h["rest30k_sched_loop_busy"] = rest30.get("scheduler_loop_busy")
+        dshare = sched.get("decode_share") or {}
+        h["decode_share_json"] = (dshare.get("json") or {}).get(
+            "max_share")
+        h["decode_share_compact"] = (dshare.get("compact") or {}).get(
+            "max_share")
         gang = sched.get("gang") or {}
         h["gang_rate"] = gang.get("gangs_per_second")
         pre = gang.get("preemption") or {}

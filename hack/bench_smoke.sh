@@ -27,25 +27,54 @@ if "approx" in (out.get("api_request_latency") or {}):
     sys.exit("bench_smoke: api_request_latency fell back to bucket edges")
 EOF
 
-# Throughput floor on the SCALE-OUT path: the 200n/2k REST arm with
-# ApiServerSharding + ApiServerCodecOffload on must hold >= 400 pods/s
-# (PR 9's control-plane wall was ~340-500 before the watch-fan-out
-# batching; a regression below 400 means a hot-path change undid it).
-timeout -k 10 90 env JAX_PLATFORMS=cpu python - <<'EOF'
+# Throughput floor on the SCALE-OUT path, plus the scheduler fast-path
+# gate check: the 200n/2k REST arm runs twice — sharding+codec-pool
+# gates only, then with SchedulerFastPath+CompactWireCodec stacked on
+# top. Both must bind everything and hold >= 400 pods/s (PR 9's
+# control-plane wall was ~340-500 before the watch-fan-out batching);
+# the stacked run must not LOSE throughput vs the baseline run (the
+# fast path's contract is identical placements, strictly less CPU —
+# 5% grace absorbs shared-VM noise at this short arm), and its
+# span-derived schedule-stage p99 must stay under the 250ms floor
+# (the stage this PR attacks; a regression here means the columnar
+# path stopped engaging).
+timeout -k 10 240 env JAX_PLATFORMS=cpu python - <<'EOF'
 import asyncio, json, sys
 from kubernetes_tpu.perf.density import run_density
 
-out = asyncio.run(run_density(
+BASE_GATES = "ApiServerSharding=true,ApiServerCodecOffload=true"
+off = asyncio.run(run_density(
     n_nodes=200, n_pods=2000, via="rest", timeout=60.0,
-    create_concurrency=16, paced_pods=0,
-    feature_gates="ApiServerSharding=true,ApiServerCodecOffload=true"))
-print(json.dumps(out))
-if out.get("bound", 0) < 2000:
-    sys.exit(f"bench_smoke: only {out.get('bound')}/2000 pods bound "
+    create_concurrency=16, paced_pods=0, feature_gates=BASE_GATES))
+print(json.dumps(off))
+if off.get("bound", 0) < 2000:
+    sys.exit(f"bench_smoke: only {off.get('bound')}/2000 pods bound "
              f"on the gated path")
-rate = out.get("pods_per_second", 0.0)
+rate = off.get("pods_per_second", 0.0)
 if rate < 400:
     sys.exit(f"bench_smoke: gated 200n/2k arm at {rate} pods/s "
              f"(< 400 floor)")
+
+on = asyncio.run(run_density(
+    n_nodes=200, n_pods=2000, via="rest", timeout=60.0,
+    create_concurrency=16, paced_pods=0, trace_sample=0.05,
+    feature_gates=BASE_GATES + ",SchedulerFastPath=true,"
+                  "CompactWireCodec=true"))
+print(json.dumps(on))
+if on.get("bound", 0) < 2000:
+    sys.exit(f"bench_smoke: only {on.get('bound')}/2000 pods bound "
+             f"with SchedulerFastPath+CompactWireCodec on")
+on_rate = on.get("pods_per_second", 0.0)
+if on_rate < max(400.0, 0.95 * rate):
+    sys.exit(f"bench_smoke: fast-path arm at {on_rate} pods/s vs "
+             f"{rate} gates-off — the gated path must never lose")
+sched_p99 = ((on.get("startup_breakdown") or {}).get("schedule")
+             or {}).get("p99_ms")
+if sched_p99 is None:
+    sys.exit("bench_smoke: no span-derived schedule-stage p99 "
+             "(tracing produced no samples?)")
+if sched_p99 > 250.0:
+    sys.exit(f"bench_smoke: schedule-stage p99 {sched_p99}ms "
+             f"(> 250ms floor) — the scheduler fast path regressed")
 EOF
 echo "bench_smoke: ok"

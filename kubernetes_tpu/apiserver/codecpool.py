@@ -67,6 +67,12 @@ def _encode_many(values: list[dict]) -> list[bytes]:
     return [dumps(v, separators=(",", ":")).encode() for v in values]
 
 
+def _encode_many_compact(values: list[dict]) -> list[bytes]:
+    """Compact-codec worker twin (CompactWireCodec LIST misses)."""
+    from ..util.compactcodec import encode_many
+    return encode_many(values)
+
+
 def _decode_bytes(raw: bytes):
     return json.loads(raw)
 
@@ -124,33 +130,36 @@ class CodecPool:
             self._executor.shutdown(wait=False, cancel_futures=True)
             self._executor = None
 
-    async def encode_values(self, values: list[dict]) -> list[bytes]:
+    async def encode_values(self, values: list[dict],
+                            codec: str = "json") -> list[bytes]:
         """Wire-encode ``values`` — through the pool when the batch is
         big enough and a worker exists, inline otherwise. Order is
         preserved; output is byte-identical to the inline path
-        (``json.dumps(v, separators=(",", ":"))``)."""
+        (``json.dumps(v, separators=(",", ":"))``, or the compact
+        codec's ``encode_obj`` when ``codec="compact"``)."""
+        encode = _encode_many if codec == "json" else _encode_many_compact
         if not values:
             return []
         if not self.active:
             CODEC_POOL_INLINE.inc(op="encode", reason="no-workers")
-            return _encode_many(values)
+            return encode(values)
         if len(values) < self.min_encode_items:
             CODEC_POOL_INLINE.inc(op="encode", reason="below-threshold")
-            return _encode_many(values)
+            return encode(values)
         import asyncio
         loop = asyncio.get_running_loop()
         chunks = [values[i:i + self.encode_chunk]
                   for i in range(0, len(values), self.encode_chunk)]
         try:
             futs = [loop.run_in_executor(self._get_executor(),
-                                         _encode_many, c) for c in chunks]
+                                         encode, c) for c in chunks]
             CODEC_POOL_SUBMITS.inc(len(futs), op="encode")
             CODEC_POOL_ITEMS.inc(len(values), op="encode")
             outs = await asyncio.gather(*futs)
         except Exception:  # noqa: BLE001 — a dead pool degrades to inline
             self._broken = True
             CODEC_POOL_INLINE.inc(op="encode", reason="pool-error")
-            return _encode_many(values)
+            return encode(values)
         return [b for chunk in outs for b in chunk]
 
     async def decode_body(self, raw: bytes):

@@ -600,20 +600,25 @@ class Registry:
     # -- serialize-once reads (see encodecache.py) ------------------------
 
     def encoded_value(self, key: str, value: dict, rev: int,
-                      which: str = "cur") -> bytes:
-        """Encoded JSON bytes of a stored object at ``rev``, with the
+                      which: str = "cur", codec: str = "json") -> bytes:
+        """Encoded wire bytes of a stored object at ``rev``, with the
         store-owned resource_version injected — cached so every reader
         of the same revision (GET, LIST assembly, each watch fan-out
-        consumer) shares ONE ``json.dumps``. ``value`` must be the
+        consumer) shares ONE encode. ``codec``: "json" (default) or
+        "compact" (CompactWireCodec msgpack payloads, cached beside
+        the JSON lines under a ``#c``-suffixed ``which`` — same
+        identity, same write invalidation). ``value`` must be the
         store-owned dict (never mutated here: the injection shallow-
         copies)."""
-        line = self.encode_cache.get(key, rev, which)
+        from ..util import compactcodec
+        ck_which = compactcodec.cache_which(which, codec)
+        line = self.encode_cache.get(key, rev, ck_which)
         if line is None:
             obj = {**value,
                    "metadata": {**(value.get("metadata") or {}),
                                 "resource_version": str(rev)}}
-            line = json.dumps(obj, separators=(",", ":")).encode()
-            self.encode_cache.put(key, rev, line, which)
+            line = compactcodec.encode_wire(obj, codec)
+            self.encode_cache.put(key, rev, line, ck_which)
         return line
 
     def get_encoded(self, plural: str, namespace: str, name: str) -> bytes:
@@ -626,25 +631,30 @@ class Registry:
                                   stored.mod_revision)
 
     def list_encoded(self, plural: str, namespace: str = "",
-                     label_selector: str = "") -> tuple[list[bytes], int]:
+                     label_selector: str = "", codec: str = "json"
+                     ) -> tuple[list[bytes], int]:
         """LIST fast path: per-item wire bytes (cache-shared with GET
         and the watch fan-out) + the list revision. Label selectors
         match the raw stored dict, like :meth:`list`; field selectors
         need typed extraction and take the slow path. One snapshot/
         selector walk shared with the codec-pool path
         (:meth:`list_encoded_parts`) — the misses are simply encoded
-        inline here."""
+        inline here. ``codec`` selects the wire encoding (see
+        :meth:`encoded_value`)."""
+        from ..util import compactcodec
         parts, misses, rev = self.list_encoded_parts(plural, namespace,
-                                                     label_selector)
+                                                     label_selector,
+                                                     codec=codec)
         cache = self.encode_cache
+        which = compactcodec.cache_which("cur", codec)
         for idx, key, mrev, value, token in misses:
-            line = json.dumps(value, separators=(",", ":")).encode()
-            cache.finish_async_encode(key, mrev, line, token)
+            line = compactcodec.encode_wire(value, codec)
+            cache.finish_async_encode(key, mrev, line, token, which=which)
             parts[idx] = line
         return parts, rev
 
     def list_encoded_parts(self, plural: str, namespace: str = "",
-                           label_selector: str = ""
+                           label_selector: str = "", codec: str = "json"
                            ) -> tuple[list, list, int]:
         """The codec-pool half of the LIST fast path: cached wire bytes
         where the serialize-once cache has them, and MISS records
@@ -654,11 +664,15 @@ class Registry:
         (``token`` is minted BEFORE the value is read — a write racing
         the pool encode provably invalidates it). Returns
         ``(parts, misses, revision)`` with ``parts[index] is None`` at
-        each miss slot."""
+        each miss slot. ``codec`` keys the cache lookups (compact
+        payloads live beside the JSON lines; one write invalidates
+        both)."""
+        from ..util import compactcodec
         spec = self.spec_for(plural)
         stored, rev = self.store.list(self._prefix(spec, namespace),
                                       copy=False)
         sel = parse_selector(label_selector) if label_selector else None
+        which = compactcodec.cache_which("cur", codec)
         parts: list = []
         misses: list = []
         for s in stored:
@@ -666,7 +680,7 @@ class Registry:
                 raw_labels = (s.value.get("metadata") or {}).get("labels") or {}
                 if not sel.matches(raw_labels):
                     continue
-            line = self.encode_cache.get(s.key, s.mod_revision)
+            line = self.encode_cache.get(s.key, s.mod_revision, which)
             if line is None:
                 token = self.encode_cache.begin_async_encode(s.key)
                 obj = {**s.value,

@@ -1599,6 +1599,13 @@ class APIServer:
             # per-item cached wire bytes (shared with GET and the watch
             # fan-out) — no typed decode/encode per object. Field
             # selectors need typed extraction and stay on the slow path.
+            # CompactWireCodec (gated + client-negotiated via Accept):
+            # the same assembly from compact per-item payloads, framed;
+            # every other client keeps the byte-identical JSON body.
+            from ..util import compactcodec
+            codec = ("compact" if compactcodec.enabled()
+                     and compactcodec.accepts_compact(
+                         request.headers.get("Accept", "")) else "json")
             if self.codec_pool is not None and self.codec_pool.active:
                 # ApiServerCodecOffload: cache MISSES encode in the
                 # process pool (a 30k-pod relist after a write burst is
@@ -1606,17 +1613,18 @@ class APIServer:
                 # through the generation-guarded async seam so a write
                 # racing a pool encode can never resurrect the entry.
                 parts, misses, rev = self.registry.list_encoded_parts(
-                    plural, ns, q.get("label_selector", ""))
+                    plural, ns, q.get("label_selector", ""), codec=codec)
                 if misses:
                     cache = self.registry.encode_cache
+                    which = compactcodec.cache_which("cur", codec)
                     done = 0
                     try:
                         lines = await self.codec_pool.encode_values(
-                            [m[3] for m in misses])
+                            [m[3] for m in misses], codec=codec)
                         for (idx, key, mrev, _val, token), line in zip(
                                 misses, lines):
                             cache.finish_async_encode(key, mrev, line,
-                                                      token)
+                                                      token, which=which)
                             done += 1
                             parts[idx] = line
                     finally:
@@ -1629,10 +1637,17 @@ class APIServer:
                 enc = parts
             else:
                 enc, rev = self.registry.list_encoded(
-                    plural, ns, q.get("label_selector", ""))
+                    plural, ns, q.get("label_selector", ""), codec=codec)
+            if codec == "compact":
+                body = compactcodec.encode_list_body(rev, enc)
+                compactcodec.count_request("compact", "list", len(body))
+                return web.Response(
+                    body=body, content_type=compactcodec.CONTENT_TYPE)
             body = (b'{"kind":"List","api_version":"core/v1","metadata":'
                     b'{"resource_version":"' + str(rev).encode()
                     + b'"},"items":[' + b",".join(enc) + b"]}")
+            if compactcodec.enabled():
+                compactcodec.count_request("json", "list", len(body))
             return web.Response(body=body, content_type="application/json")
         items, rev = self.registry.list(
             plural, ns, q.get("label_selector", ""), q.get("field_selector", ""))
@@ -1651,17 +1666,23 @@ class APIServer:
                 f"query parameter {name!r} must be an integer, got {value!r}") from None
 
     def _encode_watch_event(self, etype: str, payload: dict, rev: int,
-                            which: str, key: str) -> bytes:
-        """One JSON encode per store event, shared by every raw watcher
-        AND the GET/LIST fast paths (the watch cache's serialize-once
-        fan-out, now backed by the registry's encode cache; without
-        this, N pod watchers cost N encodes per event and the apiserver
-        event loop — shared with every in-process component — eats the
-        REST-path latency SLO). Only the object payload is cached; the
-        event envelope is a cheap byte concat per watcher. ``which``
+                            which: str, key: str,
+                            codec: str = "json") -> bytes:
+        """One encode per store event per codec, shared by every raw
+        watcher AND the GET/LIST fast paths (the watch cache's
+        serialize-once fan-out, now backed by the registry's encode
+        cache; without this, N pod watchers cost N encodes per event
+        and the apiserver event loop — shared with every in-process
+        component — eats the REST-path latency SLO). Only the object
+        payload is cached; the event envelope is a cheap byte concat
+        per watcher (a framed fixmap for the compact codec). ``which``
         disambiguates selector-left corpses surfacing at the same
         revision."""
-        obj_b = self.registry.encoded_value(key, payload, rev, which)
+        obj_b = self.registry.encoded_value(key, payload, rev, which,
+                                            codec=codec)
+        if codec == "compact":
+            from ..util import compactcodec
+            return compactcodec.event_frame(etype, obj_b)
         return b'{"type":"' + etype.encode() + b'","object":' + obj_b + b"}\n"
 
     async def _watch(self, request, plural: str, ns: str):
@@ -1683,10 +1704,22 @@ class APIServer:
         except errors.GoneError as e:
             return self._err(e)
         raw_mode = not field_selector
+        # CompactWireCodec: a raw-mode storage-version watcher that
+        # asked for compact gets framed msgpack events off the shared
+        # encode cache; everyone else keeps the byte-identical JSON
+        # line stream (conversion watchers always stream JSON).
+        from ..util import compactcodec
+        compact = (raw_mode and not conv and compactcodec.enabled()
+                   and compactcodec.accepts_compact(
+                       request.headers.get("Accept", "")))
         resp = web.StreamResponse()
-        resp.content_type = "application/json"
+        resp.content_type = (compactcodec.CONTENT_TYPE if compact
+                             else "application/json")
         resp.headers["Transfer-Encoding"] = "chunked"
         await resp.prepare(request)
+        if compactcodec.enabled():
+            compactcodec.count_request(
+                "compact" if compact else "json", "watch")
 
         def event_line(ev) -> Optional[bytes]:
             """Wire line for one event; None ends the stream."""
@@ -1705,8 +1738,9 @@ class APIServer:
                                      "resource_version": str(rev)}})
                     return (json.dumps({"type": etype, "object": obj})
                             .encode() + b"\n")
-                return self._encode_watch_event(etype, payload, rev,
-                                                which, ev_key)
+                return self._encode_watch_event(
+                    etype, payload, rev, which, ev_key,
+                    codec="compact" if compact else "json")
             etype, obj = ev
             if etype == "CLOSED":
                 return None
@@ -1722,10 +1756,16 @@ class APIServer:
                 if ev is None:
                     # Bookmark keeps the connection alive and advances the
                     # client's resume point (reference: watch bookmarks).
-                    await resp.write(json.dumps({
+                    bookmark = {
                         "type": "BOOKMARK",
                         "object": {"metadata": {"resource_version": str(self.registry.store.revision)}},
-                    }).encode() + b"\n")
+                    }
+                    if compact:
+                        await resp.write(compactcodec.frame(
+                            compactcodec.encode_obj(bookmark)))
+                    else:
+                        await resp.write(json.dumps(bookmark).encode()
+                                         + b"\n")
                     continue
                 # Coalesce every event already in flight into ONE
                 # socket write: per-event writes made the fan-out's

@@ -121,6 +121,7 @@ class _RESTWatch(WatchStream):
         self.closed = False
 
     async def _run(self) -> None:
+        from ..util import compactcodec
         try:
             kw = {"headers": self._headers} if self._headers else {}
             async with self._session.get(self._url, params=self._params,
@@ -131,26 +132,43 @@ class _RESTWatch(WatchStream):
                     await self._queue.put(("ERROR", errors.StatusError.from_dict(body)))
                     return
                 self._resp = resp
+                if resp.content_type == compactcodec.CONTENT_TYPE:
+                    # Negotiated compact stream: length-prefixed
+                    # msgpack frames instead of JSON lines; the event
+                    # handling below is shared.
+                    frames = compactcodec.FrameDecoder()
+                    async for chunk in resp.content.iter_any():
+                        for payload in frames.feed(chunk):
+                            if not await self._dispatch(
+                                    compactcodec.decode_event(payload)):
+                                return
+                    return
                 async for line in resp.content:
                     line = line.strip()
                     if not line:
                         continue
-                    c = chaos.CONTROLLER
-                    if c is not None:
-                        fault = c.decide(chaos.SITE_WATCH_REST)
-                        if fault is not None and fault.kind == "drop":
-                            return  # stream ends; consumer relists
-                    msg = json.loads(line)
-                    if msg["type"] == BOOKMARK:
-                        await self._queue.put((BOOKMARK, msg["object"]))
-                        continue
-                    obj = decode_obj(msg["object"])
-                    await self._queue.put((msg["type"], obj))
+                    if not await self._dispatch(json.loads(line)):
+                        return
         except (aiohttp.ClientError, asyncio.CancelledError,
                 ConnectionResetError, asyncio.TimeoutError):
             pass
         finally:
             await self._queue.put(None)
+
+    async def _dispatch(self, msg: dict) -> bool:
+        """Queue one decoded wire event; False ends the stream (chaos
+        drop — the consumer relists, as for a real broken stream)."""
+        c = chaos.CONTROLLER
+        if c is not None:
+            fault = c.decide(chaos.SITE_WATCH_REST)
+            if fault is not None and fault.kind == "drop":
+                return False
+        if msg["type"] == BOOKMARK:
+            await self._queue.put((BOOKMARK, msg["object"]))
+            return True
+        obj = decode_obj(msg["object"])
+        await self._queue.put((msg["type"], obj))
+        return True
 
     def start(self) -> "_RESTWatch":
         self._task = asyncio.get_running_loop().create_task(self._run())
@@ -439,6 +457,15 @@ class RESTClient(Client):
             err.stale = resp.headers.get("X-Ktpu-Stale") == "1"
             err.leader_url = resp.headers.get("X-Ktpu-Leader", "")
             raise err
+        from ..util import compactcodec
+        if resp.content_type == compactcodec.CONTENT_TYPE:
+            # Negotiated compact LIST body (the server only answers
+            # compact when this client asked via Accept): decode to the
+            # exact dict shape resp.json() yields on the JSON path.
+            body = await resp.read()
+            compactcodec.count_request("compact", "list_decode",
+                                       len(body))
+            return compactcodec.decode_list_body(body)
         return await resp.json()
 
     def _read_endpoint(self) -> str:
@@ -689,6 +716,15 @@ class RESTClient(Client):
         data = await self._request("GET", url)
         return decode_obj(data)
 
+    @staticmethod
+    def _list_headers() -> Optional[dict]:
+        """Accept header offering the compact codec when the gate is on
+        (the server still answers JSON unless ITS gate is on too —
+        negotiation, not assumption); None keeps the request bytes
+        identical to the ungated client."""
+        from ..util import compactcodec
+        return compactcodec.accept_header()
+
     async def list(self, plural: str, namespace: str = "", label_selector: str = "",
                    field_selector: str = "", chunk_size: int = 0) -> tuple[list, int]:
         """Full list. ``chunk_size`` > 0 fetches in pages under the
@@ -703,9 +739,12 @@ class RESTClient(Client):
             params["field_selector"] = field_selector
         if chunk_size:
             params["limit"] = str(chunk_size)
+        headers = self._list_headers()
         items: list = []
         while True:
-            data = await self._request("GET", url, params=params)
+            data = await self._request("GET", url, params=params,
+                                       **({"headers": headers}
+                                          if headers else {}))
             items.extend(decode_obj(i) for i in data["items"])
             cont = data["metadata"].get("continue", "")
             if not cont:
@@ -786,14 +825,15 @@ class RESTClient(Client):
         timeout = aiohttp.ClientTimeout(
             total=None, connect=self.connect_timeout,
             sock_read=self.watch_idle_timeout)
-        headers = None
+        headers = self._list_headers()  # compact-codec offer (gated)
         if self.read_affinity:
             # Watches ride followers too (follower stores are fully
             # watchable since PR 8); a stale/ended stream surfaces as
             # CLOSED and the informer relists — through the read
             # path's leader fallback when followers cannot serve.
             url = self._rebase(url, self._read_endpoint())
-            headers = {"X-Ktpu-Max-Staleness": f"{self.max_staleness:.3f}"}
+            headers = dict(headers or {})
+            headers["X-Ktpu-Max-Staleness"] = f"{self.max_staleness:.3f}"
             CLIENT_FOLLOWER_READS.inc(outcome="watch_routed")
         return _RESTWatch(self._sess(), url, params, timeout=timeout,
                           headers=headers).start()

@@ -1,5 +1,5 @@
 """Decode-share measurement — what fraction of control-plane CPU goes
-to JSON wire codec work?
+to wire codec work, PER CODEC?
 
 VERDICT r4 #8: the reference negotiates protobuf on the watch/list hot
 path (``apimachinery/pkg/runtime/serializer/protobuf/protobuf.go``)
@@ -8,11 +8,20 @@ harness produces the NUMBER that decision needs here: it runs the
 three-process REST density arm with cProfile on both the apiserver
 subprocess (KTPU_PROFILE seam in ``apiserver/__main__.py``) and the
 scheduler (this process), then attributes exclusive CPU time to codec
-frames — the ``json`` module (C scanner + Python fallbacks) and the
-scheme's ``to_dict``/``from_dict``/``decode``/``encode`` — versus
-everything else.
+frames — the ``json`` module (C scanner + Python fallbacks), the
+msgpack C packers behind the gated ``CompactWireCodec``
+(util/compactcodec.py), and the scheme's
+``to_dict``/``from_dict``/``decode``/``encode`` — versus everything
+else.
 
-Run: ``python -m kubernetes_tpu.perf.decode_share [nodes] [pods]``.
+Since the compact codec shipped, the harness runs the arm once per
+codec (gates off = JSON baseline; ``CompactWireCodec=true`` = compact
+LIST/watch on every negotiating hop: apiserver, scheduler informers,
+loadgen watcher) and reports the share side by side — the codec win as
+a first-class bench number.
+
+Run: ``python -m kubernetes_tpu.perf.decode_share [nodes] [pods]
+[json|compact|both]`` (default both).
 """
 from __future__ import annotations
 
@@ -24,9 +33,11 @@ import pstats
 import tempfile
 
 #: A frame is "codec" when its file or function matches these — the
-#: full wire path: raw JSON scan/emit + dataclass hydration.
+#: full wire path: raw JSON scan/emit, msgpack pack/unpack (compact
+#: codec), framing, and dataclass hydration.
 _CODEC_FILES = ("json/decoder.py", "json/encoder.py", "json/__init__.py",
-                "json/scanner.py", "api/scheme.py")
+                "json/scanner.py", "api/scheme.py", "util/compactcodec.py",
+                "msgpack/__init__.py", "msgpack/fallback.py")
 _CODEC_FUNCS = ("loads", "dumps", "to_dict", "from_dict", "decode",
                 "encode", "__decode", "raw_decode", "iterencode",
                 "scanstring", "_from_dict", "_to_dict")
@@ -42,13 +53,15 @@ def codec_share(stats_path: str) -> dict:
     for (fname, _line, func), (cc, nc, tt, ct, callers) in \
             st.stats.items():  # noqa: B007
         total += tt
-        # Attribution is FILE-scoped (json stdlib, api/scheme.py) plus
-        # the C-extension json frames; a bare function-name match
-        # would swallow unrelated to_dict/encode/decode frames (aiohttp
-        # charset codecs, errors.to_dict) and inflate the share a
-        # go/no-go threshold sits on.
+        # Attribution is FILE-scoped (json stdlib, api/scheme.py, the
+        # compact codec + msgpack) plus the C-extension json/msgpack
+        # frames; a bare function-name match would swallow unrelated
+        # to_dict/encode/decode frames (aiohttp charset codecs,
+        # errors.to_dict) and inflate the share a go/no-go threshold
+        # sits on.
         is_codec = (any(fname.endswith(f) for f in _CODEC_FILES)
-                    or (fname == "~" and "_json" in func))
+                    or (fname == "~" and ("_json" in func
+                                          or "msgpack" in func)))
         if is_codec:
             codec += tt
             rows.append((tt, f"{os.path.basename(fname)}:{func}"))
@@ -62,9 +75,20 @@ def codec_share(stats_path: str) -> dict:
 
 
 async def run_decode_share(n_nodes: int = 200, n_pods: int = 6000,
-                           timeout: float = 600.0) -> dict:
+                           timeout: float = 600.0,
+                           codec: str = "json") -> dict:
+    """One profiled density arm under one codec. ``codec="compact"``
+    flips ``CompactWireCodec`` on for every hop (run_density applies
+    the gate string in-process, to the apiserver subprocess, and to
+    the loadgen subprocess)."""
     from .density import run_density
-    tmp = tempfile.mkdtemp(prefix="ktpu-decode-")
+    from ..util import compactcodec
+    gates = ""
+    if codec == "compact":
+        if not compactcodec.available():
+            return {"codec": codec, "error": "msgpack unavailable"}
+        gates = "CompactWireCodec=true"
+    tmp = tempfile.mkdtemp(prefix=f"ktpu-decode-{codec}-")
     api_stats = os.path.join(tmp, "apiserver.pstats")
     sched_stats = os.path.join(tmp, "scheduler.pstats")
     os.environ["KTPU_PROFILE"] = api_stats  # inherited by the subprocess
@@ -73,7 +97,8 @@ async def run_decode_share(n_nodes: int = 200, n_pods: int = 6000,
     try:
         density = await run_density(n_nodes=n_nodes, n_pods=n_pods,
                                     via="rest", timeout=timeout,
-                                    create_concurrency=16)
+                                    create_concurrency=16,
+                                    feature_gates=gates)
     finally:
         prof.disable()
         os.environ.pop("KTPU_PROFILE", None)
@@ -84,12 +109,15 @@ async def run_decode_share(n_nodes: int = 200, n_pods: int = 6000,
             break
         await asyncio.sleep(0.1)
     out = {
+        "codec": codec,
         "nodes": n_nodes,
         "pods": n_pods,
         "pods_per_second": density.get("pods_per_second"),
         "scheduler": codec_share(sched_stats),
         "threshold": 0.20,
     }
+    if gates:
+        out["feature_gates"] = gates
     if os.path.exists(api_stats):
         out["apiserver"] = codec_share(api_stats)
         worst = max(out["apiserver"]["share"], out["scheduler"]["share"])
@@ -102,8 +130,31 @@ async def run_decode_share(n_nodes: int = 200, n_pods: int = 6000,
     return out
 
 
+async def run_decode_share_matrix(n_nodes: int = 200, n_pods: int = 6000,
+                                  timeout: float = 600.0) -> dict:
+    """Both codecs, same arm, side by side — the number the 30k-arm
+    stanza carries (``decode_share_json``/``decode_share_compact``)."""
+    out: dict = {"nodes": n_nodes, "pods": n_pods}
+    for codec in ("json", "compact"):
+        try:
+            out[codec] = await run_decode_share(n_nodes, n_pods, timeout,
+                                                codec=codec)
+        except Exception as exc:  # noqa: BLE001 — keep the other arm
+            out[codec] = {"codec": codec, "error": str(exc)[:200]}
+    j = (out.get("json") or {}).get("max_share")
+    c = (out.get("compact") or {}).get("max_share")
+    if j is not None and c is not None:
+        out["share_delta"] = round(j - c, 4)
+    return out
+
+
 if __name__ == "__main__":
     import sys
     nodes = int(sys.argv[1]) if len(sys.argv) > 1 else 200
     pods = int(sys.argv[2]) if len(sys.argv) > 2 else 6000
-    print(json.dumps(asyncio.run(run_decode_share(nodes, pods))))
+    which = sys.argv[3] if len(sys.argv) > 3 else "both"
+    if which == "both":
+        print(json.dumps(asyncio.run(run_decode_share_matrix(nodes, pods))))
+    else:
+        print(json.dumps(asyncio.run(
+            run_decode_share(nodes, pods, codec=which))))

@@ -42,6 +42,24 @@ def hollow_node(name: str, cpu: float = 32.0, mem: float = 128 * 2**30,
     return node
 
 
+def host_fingerprint() -> dict:
+    """Host attribution stanza (ROADMAP 3c): every number this harness
+    has ever published came from three processes sharing ONE core —
+    the sharding/codec-pool gates are load-bearing only with spare
+    cores, so multi-core results must be distinguishable from the
+    1-core VM's. ``same_host`` is structural: apiserver, loadgen, and
+    scheduler all run on this machine (use ``--cores``/taskset notes
+    in loadgen when pinning)."""
+    import os
+    n = os.cpu_count() or 1
+    out = {"cpu_count": n, "same_host": True}
+    if n == 1:
+        out["cores_note"] = ("single-core host: codec pool inline, "
+                             "shard workers per-request tasks — gate "
+                             "wins under-represented")
+    return out
+
+
 def density_pod(name: str, cpu: float = 0.1, mem: float = 64 * 2**20) -> t.Pod:
     return t.Pod(
         metadata=ObjectMeta(name=name, namespace="default",
@@ -129,7 +147,8 @@ async def _run_density_rest(n_nodes: int, n_pods: int, timeout: float,
                             create_concurrency: int,
                             max_pods_per_node: int,
                             paced_pods: int, paced_rate: float,
-                            feature_gates: str = "") -> dict:
+                            feature_gates: str = "",
+                            create_batch: int = 32) -> dict:
     """The via='rest' arm of :func:`run_density`: apiserver and loadgen
     subprocesses, scheduler in-process, everything over HTTP. Every
     child is terminated on any failure path."""
@@ -155,12 +174,20 @@ async def _run_density_rest(n_nodes: int, n_pods: int, timeout: float,
         # Load from a separate process; this process runs ONLY the
         # scheduler (real deployments never co-schedule the load
         # source's CPU with the scheduler's).
-        gen = await asyncio.create_subprocess_exec(
+        loadgen_argv = [
             sys.executable, "-m", "kubernetes_tpu.perf.loadgen",
             "--server", client.base_url, "--pods", str(n_pods),
             "--concurrency", str(create_concurrency),
             "--timeout", str(timeout),
             "--paced-pods", str(paced_pods), "--rate", str(paced_rate),
+            "--create-batch", str(create_batch)]
+        if feature_gates:
+            # Client-side gates (CompactWireCodec) must reach the load
+            # source's process too — its watch stream is half the
+            # decode traffic being measured.
+            loadgen_argv += ["--feature-gates", feature_gates]
+        gen = await asyncio.create_subprocess_exec(
+            *loadgen_argv,
             stdout=asyncio.subprocess.PIPE,
             cwd=os.path.dirname(os.path.dirname(os.path.dirname(
                 os.path.abspath(__file__)))))
@@ -206,6 +233,7 @@ async def _run_density_rest(n_nodes: int, n_pods: int, timeout: float,
         "nodes": n_nodes,
         "via": "rest",
         "max_pods_per_node": max_pods_per_node,
+        "host": host_fingerprint(),
         "api_request_latency": api_latency,
     }
     if feature_gates:
@@ -285,7 +313,8 @@ async def run_density(n_nodes: int = 100, n_pods: int = 3000,
                       paced_pods: int = 300,
                       paced_rate: float = 100.0,
                       feature_gates: str = "",
-                      trace_sample: float = 0.0) -> dict:
+                      trace_sample: float = 0.0,
+                      create_batch: int = 32) -> dict:
     """Create nodes, start the scheduler, pour pods in, wait until every
     pod is bound. Returns throughput + latency percentiles.
 
@@ -312,6 +341,7 @@ async def run_density(n_nodes: int = 100, n_pods: int = 3000,
               sched_metrics.LOOP_LAG):
         m.reset()  # isolate this run from earlier ones in the process
 
+    prev_gates = None
     prev_rate = _arm_tracing(trace_sample)
     prev_env = None
     if prev_rate is not None and via == "rest":
@@ -326,11 +356,22 @@ async def run_density(n_nodes: int = 100, n_pods: int = 3000,
         # grammar, which would silently sample 1% instead of 100%.
         os.environ["KTPU_TRACE"] = str(float(trace_sample))
     try:
+        if feature_gates:
+            # The apiserver subprocess gets the gates via argv; the
+            # IN-PROCESS halves (scheduler: SchedulerFastPath; REST
+            # client: CompactWireCodec) read the process-global table —
+            # applied INSIDE the try so the finally's restore runs on
+            # every exit, and bench arms cannot leak gates into later
+            # runs (a leaked CompactWireCodec would silently corrupt
+            # the decode-share json baseline).
+            from ..util.features import GATES
+            prev_gates = GATES.snapshot()
+            GATES.parse(feature_gates)
         if via == "rest":
             out = await _run_density_rest(
                 n_nodes, n_pods, timeout, create_concurrency,
                 max_pods_per_node, paced_pods, paced_rate,
-                feature_gates=feature_gates)
+                feature_gates=feature_gates, create_batch=create_batch)
         else:
             out = await _run_density_local(
                 n_nodes, n_pods, timeout, via, max_pods_per_node,
@@ -340,6 +381,9 @@ async def run_density(n_nodes: int = 100, n_pods: int = 3000,
             out.update(_trace_breakdown())
         return out
     finally:
+        if prev_gates is not None:
+            from ..util.features import GATES
+            GATES.restore(prev_gates)
         if prev_rate is not None:
             from .. import tracing
             tracing.set_sample_rate(prev_rate)
@@ -462,6 +506,7 @@ async def _run_density_local(n_nodes: int, n_pods: int, timeout: float,
         "nodes": n_nodes,
         "pods": n_pods,
         "via": via,
+        "host": host_fingerprint(),
         "wall_seconds": round(wall, 3),
         "pods_per_second": round(n_pods / wall, 2),
         "max_pods_per_node": max(per_node.values(), default=0),

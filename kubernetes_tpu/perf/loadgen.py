@@ -73,8 +73,14 @@ class _BoundWatcher:
                     self.notify()
 
     async def _run(self) -> None:
+        from ..util import compactcodec
         base = (f"{self.server}/api/core/v1/namespaces/{self.namespace}"
                 f"/pods")
+        # Compact-codec offer when the gate is on in THIS process
+        # (loadgen gets gates via --feature-gates); {} keeps the raw
+        # JSON requests byte-identical. One shared builder with the
+        # typed client, so the two can never negotiate differently.
+        headers = compactcodec.accept_header() or {}
         while True:
             try:
                 # LIST on EVERY connect, including the first: the watch
@@ -82,30 +88,46 @@ class _BoundWatcher:
                 # watch would permanently miss any pod bound before the
                 # stream was accepted (the LIST is empty/cheap then).
                 rv = ""
-                async with self._session.get(base) as resp:
+                async with self._session.get(base,
+                                             headers=headers) as resp:
                     if resp.status != 200:
                         # Error Status body (e.g. 429 shedding):
                         # falling through would watch live-only and
                         # lose binds — retry the LIST instead.
                         await asyncio.sleep(0.2)
                         continue
-                    data = await resp.json()
+                    if resp.content_type == compactcodec.CONTENT_TYPE:
+                        data = compactcodec.decode_list_body(
+                            await resp.read())
+                    else:
+                        data = await resp.json()
                 rv = data.get("metadata", {}).get("resource_version", "")
                 for obj in data.get("items", []):
                     self._note(obj, from_relist=True)
                 url = f"{base}?watch=1"
                 if rv:
                     url += f"&resource_version={rv}"
-                async with self._session.get(url) as resp:
+                async with self._session.get(url,
+                                             headers=headers) as resp:
                     if resp.status != 200:
                         # e.g. 410 Gone (revision compacted): relist.
                         await asyncio.sleep(0.2)
                         continue
-                    async for raw in resp.content:
-                        ev = json.loads(raw)
-                        if ev.get("type") not in ("ADDED", "MODIFIED"):
-                            continue
-                        self._note(ev.get("object") or {})
+                    if resp.content_type == compactcodec.CONTENT_TYPE:
+                        frames = compactcodec.FrameDecoder()
+                        async for chunk in resp.content.iter_any():
+                            for payload in frames.feed(chunk):
+                                ev = compactcodec.decode_event(payload)
+                                if ev.get("type") in ("ADDED",
+                                                      "MODIFIED"):
+                                    self._note(ev.get("object") or {})
+                    else:
+                        async for raw in resp.content:
+                            ev = json.loads(raw)
+                            if ev.get("type") not in ("ADDED",
+                                                      "MODIFIED"):
+                                continue
+                            self._note(ev.get("object") or {})
                     # Stream ended (overflow/server restart): loop back
                     # to the LIST above — it recovers anything missed.
             except asyncio.CancelledError:
@@ -171,7 +193,7 @@ def _loop_busy_share(before: dict, after: dict, wall: float) -> dict:
 async def run_load(server: str, n_pods: int, concurrency: int = 64,
                    timeout: float = 600.0, namespace: str = "default",
                    paced_pods: int = 300, rate: float = 100.0,
-                   create_batch: int = 32) -> dict:
+                   create_batch: int = 32, cores: str = "") -> dict:
     """``create_batch`` > 1 pours the saturation phase through the
     ``{plural}:batchCreate`` subresource (one request per chunk) — the
     efficient client a real bulk submitter would be. The PACED phase
@@ -227,11 +249,18 @@ async def run_load(server: str, n_pods: int, concurrency: int = 64,
         sat_lats = sorted(watcher.bound_at[n] - created_at[n]
                           for n in watcher.bound_at
                           if n in created_at and n not in watcher.relisted)
+        from .density import host_fingerprint
         out.update({
             "pods": n_pods,
             "bound": len(watcher.bound_at),
             "wall_seconds": round(wall, 3),
             "pods_per_second": round(n_pods / wall, 2),
+            # ROADMAP 3c host attribution: every historical number is
+            # three processes on one core; multi-core runs must be
+            # tellable apart. --cores records the operator's pinning
+            # statement (e.g. "taskset 0-3", "4 of 8").
+            "host": {**host_fingerprint(),
+                     **({"cores": cores} if cores else {})},
         })
         if sat_lats:
             out.update({
@@ -277,10 +306,24 @@ async def amain(argv=None) -> int:
     p.add_argument("--create-batch", type=int, default=32,
                    help="saturation-phase pods per :batchCreate request "
                         "(1 = one create per request)")
+    p.add_argument("--feature-gates", default="",
+                   help='"Gate=true,..." applied to this process '
+                        "(CompactWireCodec flips the watch/LIST decode "
+                        "path the harness measures)")
+    p.add_argument("--cores", default="",
+                   help="free-text note recorded in the report: how "
+                        "many host cores this run was given (e.g. "
+                        "'taskset 0-3'); the report always carries "
+                        "cpu_count + same_host so 1-core-VM numbers "
+                        "and multi-core numbers are distinguishable")
     args = p.parse_args(argv)
+    if args.feature_gates:
+        from ..util.features import GATES
+        GATES.parse(args.feature_gates)
     out = await run_load(args.server, args.pods, args.concurrency,
                          args.timeout, paced_pods=args.paced_pods,
-                         rate=args.rate, create_batch=args.create_batch)
+                         rate=args.rate, create_batch=args.create_batch,
+                         cores=args.cores)
     print(json.dumps(out), flush=True)
     return 0
 

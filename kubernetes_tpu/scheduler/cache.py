@@ -215,6 +215,12 @@ class SchedulerCache:
         #: the cache are digest-snapshotted; read-back via bound_copy
         #: asserts nobody mutated them in place.
         self.mutation_detector = CacheMutationDetector("scheduler-cache")
+        #: Optional columnar mirror (fleetarray.FleetSnapshot) under the
+        #: SchedulerFastPath gate: every accounting mutation below marks
+        #: the touched node dirty; node add/remove marks topology dirty
+        #: (row order must track this dict's insertion order). None =
+        #: zero-cost, byte-identical to the ungated cache.
+        self.snapshot = None
 
     # -- reservations ------------------------------------------------------
 
@@ -334,8 +340,12 @@ class SchedulerCache:
         if info is None:
             info = NodeInfo(node=node)
             self.nodes[node.metadata.name] = info
+            if self.snapshot is not None:
+                self.snapshot.mark_topo_dirty()
         else:
             info.node = node
+            if self.snapshot is not None:
+                self.snapshot.mark_dirty(node.metadata.name)
         info.recompute_chips()
         self._rebuild_slice_for(node)
         self.equiv.invalidate_node(node.metadata.name)
@@ -343,6 +353,8 @@ class SchedulerCache:
             self.mutation_detector.capture(f"node/{node.metadata.name}", node)
 
     def remove_node(self, name: str) -> None:
+        if self.snapshot is not None:
+            self.snapshot.mark_topo_dirty()
         self.equiv.invalidate_node(name)
         self.mutation_detector.forget(f"node/{name}")
         info = self.nodes.pop(name, None)
@@ -385,6 +397,8 @@ class SchedulerCache:
         if info is None:
             info = NodeInfo()  # node not seen yet; pods can arrive first
             self.nodes[node_name] = info
+            if self.snapshot is not None:
+                self.snapshot.mark_topo_dirty()
         return info
 
     def add_pod(self, pod: t.Pod) -> None:
@@ -400,6 +414,8 @@ class SchedulerCache:
                 if prev and key in prev.pods:
                     prev.remove_pod(prev.pods[key])
                 self.equiv.invalidate_node(prev_node)
+                if self.snapshot is not None:
+                    self.snapshot.mark_dirty(prev_node)
             else:
                 info = self.nodes[node_name]
                 if key in info.pods:
@@ -410,7 +426,11 @@ class SchedulerCache:
             if old_info and key in old_info.pods:
                 old_info.remove_pod(old_info.pods[key])
             self.equiv.invalidate_node(old_node)
+            if self.snapshot is not None:
+                self.snapshot.mark_dirty(old_node)
         self._node_for(node_name).add_pod(pod)
+        if self.snapshot is not None:
+            self.snapshot.mark_dirty(node_name)
         self._pod_node[key] = node_name
         aff = pod.spec.affinity
         if aff is not None and aff.pod_anti_affinity:
@@ -436,6 +456,8 @@ class SchedulerCache:
             info.remove_pod(existing)
         if node_name:
             self.equiv.invalidate_node(node_name)
+            if self.snapshot is not None:
+                self.snapshot.mark_dirty(node_name)
         self.mutation_detector.forget(key)
 
     # -- assume / forget (bind-in-flight bookkeeping) ---------------------
@@ -453,6 +475,8 @@ class SchedulerCache:
         if aff is not None and aff.pod_anti_affinity:
             self.anti_affinity_pods[pod.key()] = pod
         self.equiv.invalidate_node(node_name)
+        if self.snapshot is not None:
+            self.snapshot.mark_dirty(node_name)
         if self.mutation_detector.enabled:
             self.mutation_detector.capture(pod.key(), pod)
 
@@ -468,4 +492,6 @@ class SchedulerCache:
         if info and key in info.pods:
             info.remove_pod(info.pods[key])
         self.equiv.invalidate_node(node_name)
+        if self.snapshot is not None:
+            self.snapshot.mark_dirty(node_name)
         self.mutation_detector.forget(key)

@@ -1,0 +1,322 @@
+"""Columnar fleet snapshot — the SchedulerFastPath data plane.
+
+ROADMAP item 3a: scheduler-side per-pod CPU (``prioritize`` + the
+predicate loop) now rivals the apiserver's. This module keeps the node
+fleet as numpy columns — allocatable/requested cpu·mem·pods, taint and
+pressure flags, free-chip counts, plus memoized per-slice free-box
+stats from :mod:`.submesh` — maintained **incrementally** from
+:class:`~.cache.SchedulerCache` mutations, so feasibility filtering
+and priority scoring for a pod (and for a whole drained batch of
+pods) become vectorized array ops instead of per-node Python loops in
+``Scheduler._find_placement``.
+
+Exactness contract: for every pod the fast path accepts, the resulting
+placement (node AND chip ids) is **identical** to the scalar path's —
+the mask reproduces ``run_predicates`` verdicts comparison-for-
+comparison, and :meth:`score_rows` mirrors the fused ``prioritize``
+arithmetic term-for-term in the same operation order, so IEEE-754
+float results match bit-for-bit (pinned by the placement-equivalence
+property test). Pods the columns cannot represent exactly — node
+selectors, any affinity (or any anti-affinity pod in the cluster,
+because of symmetry), tolerations, non-core resource requests,
+TPU-claim attribute affinity, active reservations, a non-default
+policy, extenders — are refused (:meth:`feasibility_mask` returns
+None) and take the scalar path unchanged.
+
+Maintenance: the cache calls :meth:`mark_dirty` on per-node accounting
+changes and :meth:`mark_topo_dirty` when the node set (dict order)
+changes; :meth:`refresh` then rewrites only dirty rows — O(1) per
+assume/bind against an O(nodes) rebuild only on node add/remove.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..api import types as t
+
+#: Core resources the columns represent exactly. A pod requesting
+#: anything else (beside the geometrically-handled TPU resource) takes
+#: the scalar path.
+_CORE = (t.RESOURCE_CPU, t.RESOURCE_MEMORY, t.RESOURCE_PODS)
+
+
+class FleetSnapshot:
+    def __init__(self, cache) -> None:
+        self.cache = cache
+        self.names: list[str] = []
+        self._row: dict[str, int] = {}
+        self._dirty: set[str] = set()
+        self._topo_dirty = True
+        #: node -> slice id at last refresh (slice-stat invalidation).
+        self._node_slice: dict[str, str] = {}
+        #: slice id -> (free cells dict, largest free box volume).
+        self._slice_stats: dict[str, tuple] = {}
+        self._alloc: dict[str, np.ndarray] = {}
+        self._req: dict[str, np.ndarray] = {}
+        self._ok = np.zeros(0, dtype=bool)
+        self._schedulable = np.zeros(0, dtype=bool)
+        self._disk_pressure = np.zeros(0, dtype=bool)
+        self._mem_pressure = np.zeros(0, dtype=bool)
+        self._blocking_taints = np.zeros(0, dtype=bool)
+        self._has_tpu = np.zeros(0, dtype=bool)
+        self._tpu_free = np.zeros(0, dtype=np.int64)
+
+    def __len__(self) -> int:
+        return len(self.names)
+
+    # -- invalidation (called from SchedulerCache mutation sites) ---------
+
+    def mark_dirty(self, node_name: str) -> None:
+        """Per-node accounting changed (pod add/remove/assume, node
+        status update): refresh this row lazily before the next mask."""
+        self._dirty.add(node_name)
+
+    def mark_topo_dirty(self) -> None:
+        """Node set changed (add/remove): row order must be rebuilt to
+        match ``list(cache.nodes)`` — ring sampling iterates that."""
+        self._topo_dirty = True
+
+    # -- refresh ----------------------------------------------------------
+
+    def refresh(self) -> None:
+        if self._topo_dirty:
+            self._rebuild()
+            return
+        if not self._dirty:
+            return
+        for name in self._dirty:
+            sid = self._node_slice.get(name)
+            if sid is not None:
+                self._slice_stats.pop(sid, None)
+            i = self._row.get(name)
+            if i is None:
+                # Unknown node mutated without a topo event (defensive:
+                # should not happen — _node_for marks topo dirty).
+                self._topo_dirty = True
+                self._rebuild()
+                return
+            self._write_row(i, self.cache.nodes.get(name))
+        self._dirty.clear()
+
+    def _rebuild(self) -> None:
+        nodes = self.cache.nodes
+        self.names = list(nodes)
+        n = len(self.names)
+        self._row = {name: i for i, name in enumerate(self.names)}
+        for res in _CORE:
+            self._alloc[res] = np.zeros(n)
+            self._req[res] = np.zeros(n)
+        self._ok = np.zeros(n, dtype=bool)
+        self._schedulable = np.zeros(n, dtype=bool)
+        self._disk_pressure = np.zeros(n, dtype=bool)
+        self._mem_pressure = np.zeros(n, dtype=bool)
+        self._blocking_taints = np.zeros(n, dtype=bool)
+        self._has_tpu = np.zeros(n, dtype=bool)
+        self._tpu_free = np.zeros(n, dtype=np.int64)
+        self._node_slice.clear()
+        self._slice_stats.clear()
+        for i, name in enumerate(self.names):
+            self._write_row(i, nodes.get(name))
+        self._dirty.clear()
+        self._topo_dirty = False
+
+    def _write_row(self, i: int, info) -> None:
+        node = info.node if info is not None else None
+        if node is None:
+            self._ok[i] = False
+            self._schedulable[i] = False
+            self._has_tpu[i] = False
+            self._tpu_free[i] = 0
+            for res in _CORE:
+                self._alloc[res][i] = 0.0
+                self._req[res][i] = 0.0
+            return
+        from .predicates import node_is_schedulable
+        self._ok[i] = True
+        alloc = info.allocatable()
+        req = info.requested
+        for res in _CORE:
+            self._alloc[res][i] = alloc.get(res, 0.0)
+            self._req[res][i] = req.get(res, 0.0)
+        self._schedulable[i] = node_is_schedulable(node) is None
+        disk = t.get_node_condition(node.status, t.NODE_DISK_PRESSURE)
+        self._disk_pressure[i] = disk is not None and disk.status == "True"
+        mem = t.get_node_condition(node.status, t.NODE_MEMORY_PRESSURE)
+        self._mem_pressure[i] = mem is not None and mem.status == "True"
+        self._blocking_taints[i] = any(
+            taint.effect in (t.TAINT_NO_SCHEDULE, t.TAINT_NO_EXECUTE)
+            for taint in node.spec.taints)
+        topo = node.status.tpu
+        self._has_tpu[i] = topo is not None
+        self._tpu_free[i] = len(info.free_chips)
+        sid = topo.slice_id if topo is not None else ""
+        if sid:
+            self._node_slice[node.metadata.name] = sid
+            self._slice_stats.pop(sid, None)
+        else:
+            self._node_slice.pop(node.metadata.name, None)
+
+    # -- eligibility + feasibility ---------------------------------------
+
+    def eligible(self, pod: t.Pod, requests: dict) -> bool:
+        """Can the columns answer predicates for this pod EXACTLY?
+        (Tolerations are fine: untainted nodes don't consult them, and
+        the mask patches tainted rows through the real predicate.)"""
+        spec = pod.spec
+        if spec.node_selector or spec.affinity is not None:
+            return False
+        # Anti-affinity symmetry: ANY placed anti-affinity pod can veto
+        # nodes for affinity-free pods too (podaffinity.build_context).
+        if self.cache.anti_affinity_pods:
+            return False
+        for res in requests:
+            if res != t.RESOURCE_TPU and res not in _CORE:
+                return False  # "node lacks resource X" needs the scalar walk
+        # TPU claim attribute affinity filters per-chip — scalar only.
+        return all(not claim.affinity for claim in spec.tpu_resources)
+
+    def feasibility_mask(self, pod: t.Pod,
+                         requests: dict) -> Optional[np.ndarray]:
+        """Boolean row mask equal to ``run_predicates(skip_tpu=True)``
+        verdicts (plus the necessary-condition chip-count prefilter for
+        TPU pods), or None when this pod is not vector-eligible.
+        Callers must have :meth:`refresh`-ed first and must hold the
+        no-reservations / default-policy preconditions."""
+        if not self.eligible(pod, requests):
+            return None
+        fits = self._ok & self._schedulable & ~self._disk_pressure
+        if self._blocking_taints.any():
+            if not pod.spec.tolerations:
+                fits = fits & ~self._blocking_taints
+            else:
+                # Tainted nodes consult the pod's tolerations — run the
+                # REAL predicate on just those rows (typically a
+                # handful per fleet); untainted rows never consult
+                # tolerations, so the column verdict stands.
+                from .predicates import pod_tolerates_taints
+                fits = fits.copy()
+                for i in np.nonzero(fits & self._blocking_taints)[0]:
+                    info = self.cache.nodes.get(self.names[i])
+                    if info is None or info.node is None or \
+                            pod_tolerates_taints(pod, info.node) \
+                            is not None:
+                        fits[i] = False
+        # MemoryPressure rejects best-effort (no memory request) pods.
+        if not requests.get(t.RESOURCE_MEMORY):
+            fits = fits & ~self._mem_pressure
+        for res, want in requests.items():
+            if res == t.RESOURCE_TPU:
+                continue
+            # Same comparison as pod_fits_resources, vectorized:
+            # requested + want > allocatable + 1e-9 -> infeasible.
+            fits = fits & ~(self._req[res] + want
+                            > self._alloc[res] + 1e-9)
+        chips = t.pod_tpu_chip_count(pod)
+        if chips:
+            # Necessary-condition prefilter only: nodes passing still
+            # run select_chips (geometry decides, exactly as scalar).
+            fits = fits & self._has_tpu & (self._tpu_free >= chips)
+        return fits
+
+    @staticmethod
+    def ring_candidates(mask: np.ndarray, start_at: int,
+                        enough: int) -> np.ndarray:
+        """First ``enough`` mask-true row indices in ring order from
+        ``start_at`` — the vector twin of the scalar sampling loop."""
+        n = len(mask)
+        if n == 0:
+            return np.zeros(0, dtype=np.int64)
+        order = np.roll(np.arange(n), -int(start_at))
+        hits = order[mask[order]]
+        return hits[:enough]
+
+    # -- scoring ----------------------------------------------------------
+
+    def score_rows(self, rows: np.ndarray, want: dict, limits: dict,
+                   sibling_counts: Optional[dict],
+                   w_defrag_half: float) -> np.ndarray:
+        """The fused ``prioritize`` arithmetic over candidate rows, in
+        the same operation order so results match the scalar pass
+        bit-for-bit. Covers exactly the vector-eligible pod class: no
+        preferred node affinity (eligibility refused it), zero-chip
+        defrag contribution passed in as the constant
+        ``w_defrag_half``."""
+        half = 5.0  # MAX_SCORE / 2
+        cap_cpu = self._alloc[t.RESOURCE_CPU][rows]
+        cap_mem = self._alloc[t.RESOURCE_MEMORY][rows]
+        req_cpu = self._req[t.RESOURCE_CPU][rows]
+        req_mem = self._req[t.RESOURCE_MEMORY][rows]
+        want_cpu = want.get(t.RESOURCE_CPU, 0.0)
+        want_mem = want.get(t.RESOURCE_MEMORY, 0.0)
+        has_cpu = cap_cpu > 0
+        has_mem = cap_mem > 0
+        frac_cpu = (req_cpu + want_cpu) / np.where(has_cpu, cap_cpu, 1.0)
+        frac_mem = (req_mem + want_mem) / np.where(has_mem, cap_mem, 1.0)
+        free_sum = (np.where(has_cpu,
+                             np.maximum(0.0, 1.0 - frac_cpu), 0.0)
+                    + np.where(has_mem,
+                               np.maximum(0.0, 1.0 - frac_mem), 0.0))
+        n_res = has_cpu.astype(np.float64) + has_mem.astype(np.float64)
+        lr = np.where(n_res > 0,
+                      free_sum / np.where(n_res > 0, n_res, 1.0) * 10.0,
+                      half)
+        total = 1.0 * lr  # w_lr
+        ba = (1.0 - np.abs(np.minimum(1.0, frac_cpu)
+                           - np.minimum(1.0, frac_mem))) * 10.0
+        total = total + 1.0 * np.where(has_cpu & has_mem, ba, half)  # w_ba
+        if limits:  # ResourceLimits, weight 1 (matches fused pass)
+            lim_cpu = limits.get(t.RESOURCE_CPU, 0.0)
+            lim_mem = limits.get(t.RESOURCE_MEMORY, 0.0)
+            bad = np.zeros(len(rows), dtype=bool)
+            if lim_cpu:
+                bad = bad | (cap_cpu - req_cpu < lim_cpu)
+            if lim_mem:
+                bad = bad | (cap_mem - req_mem < lim_mem)
+            total = total + 1.0 * np.where(bad, 0.0, 10.0)
+        total = total + w_defrag_half  # TpuDefrag half-score, chips==0
+        if sibling_counts is not None:  # SelectorSpread, weight 1
+            if not sibling_counts:
+                total = total + 1.0 * half
+            else:
+                worst = max(sibling_counts.values())
+                if worst == 0:
+                    total = total + 1.0 * 10.0
+                else:
+                    mine = np.fromiter(
+                        (sibling_counts.get(self.names[i], 0)
+                         for i in rows), dtype=np.float64,
+                        count=len(rows))
+                    total = total + 1.0 * (10.0 * (worst - mine) / worst)
+        return total
+
+    def select_best(self, rows: np.ndarray,
+                    scores: np.ndarray) -> Optional[str]:
+        """selectHost: max score, lexicographically-largest name among
+        exact-score ties — identical to ``max(scores, key=(score,
+        name))`` over the scalar dict."""
+        if len(rows) == 0:
+            return None
+        top = scores.max()
+        ties = rows[scores == top]
+        return max(self.names[i] for i in ties)
+
+    # -- per-slice free-box stats (submesh.py memo) -----------------------
+
+    def slice_free_stats(self, sl) -> tuple[dict, int]:
+        """(free cells dict, largest free contiguous box volume) for a
+        slice, memoized until any member node's accounting changes —
+        the serving-topology score's before-volume without a per-pass
+        recompute."""
+        st = self._slice_stats.get(sl.slice_id)
+        if st is None:
+            free = sl.free(self.cache)
+            if sl.mesh_shape:
+                from .submesh import largest_free_box_volume
+                vol = largest_free_box_volume(set(free), sl.mesh_shape)
+            else:
+                vol = 0
+            st = (free, vol)
+            self._slice_stats[sl.slice_id] = st
+        return st

@@ -65,3 +65,20 @@ LOOP_LAG = Histogram(
 LOOP_BUSY = Gauge(
     "scheduler_loop_busy_fraction",
     "EWMA busy fraction of the scheduler event loop (loop-lag derived)")
+
+#: SchedulerFastPath batch-drain family: with the gate on, the main
+#: loop drains the queue in batches and places eligible pods through
+#: the columnar snapshot (fleetarray.py); these make the split
+#: vector/masked/scalar visible so a fleet profile can tell whether
+#: the fast path actually engaged.
+BATCH_SIZE = Histogram(
+    "scheduler_batch_size_pods",
+    "Queue items drained per scheduling-loop batch (SchedulerFastPath)",
+    buckets=(1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0))
+
+BATCH_FASTPATH = Counter(
+    "scheduler_batch_fastpath_total",
+    "Placement attempts by path under SchedulerFastPath: vector "
+    "(fully columnar), masked (columnar predicate prefilter + scalar "
+    "chip geometry), scalar (exact fallback)",
+    labels=("path",))

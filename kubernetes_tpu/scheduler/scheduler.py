@@ -224,6 +224,20 @@ class Scheduler:
         #: scrape manager can reach scheduler_* series over HTTP.
         self.metrics_port = metrics_port
         self.metrics_listener = None
+        #: Columnar fleet snapshot (fleetarray.FleetSnapshot) when the
+        #: SchedulerFastPath gate is on at start(); None = the scalar
+        #: per-node loop, byte-identical to the ungated scheduler.
+        self._fleet = None
+        #: Max queue items drained per loop iteration when batching
+        #: (gate on). One condvar acquisition + one snapshot refresh
+        #: amortize over the whole drained batch. KTPU_SCHED_BATCH
+        #: overrides (bench knob; 1 = per-pod drain, batching off).
+        import os
+        try:
+            self.batch_size = max(
+                1, int(os.environ.get("KTPU_SCHED_BATCH", "") or 64))
+        except ValueError:
+            self.batch_size = 64
 
     # -- wiring (reference: factory.go:137 NewConfigFactory) --------------
 
@@ -233,6 +247,14 @@ class Scheduler:
         # tail at density scale.
         from ..util.gctune import tune_control_plane_gc
         tune_control_plane_gc()
+        from ..util.features import GATES
+        if GATES.enabled("SchedulerFastPath"):
+            # Wired before the informers so every cache mutation from
+            # sync/replay onward marks the snapshot dirty; the first
+            # placement's refresh() builds the columns.
+            from .fleetarray import FleetSnapshot
+            self._fleet = FleetSnapshot(self.cache)
+            self.cache.snapshot = self._fleet
         if self._factory is not None:
             pods = self._factory.informer("pods")
             nodes = self._factory.informer("nodes")
@@ -376,7 +398,12 @@ class Scheduler:
     def _pod_added(self, pod: t.Pod) -> None:
         if not pod.spec.node_name and self._relevant(pod):
             self._open_queue_span(pod)
-            spawn(self.queue.add_pod(pod), name="queue-add-pod")
+            if self._fleet is not None:
+                # Fast-path ingest: direct heap push + one coalesced
+                # wake per burst instead of a spawned task per event.
+                self.queue.add_pod_sync(pod)
+            else:
+                spawn(self.queue.add_pod(pod), name="queue-add-pod")
         elif pod.spec.node_name:
             self.cache.add_pod(pod)
             if pod.spec.gang and t.is_pod_active(pod):
@@ -403,7 +430,10 @@ class Scheduler:
                     self.queue.gang_pod_lost(pod)
         elif self._relevant(pod):
             self._open_queue_span(pod)
-            spawn(self.queue.add_pod(pod), name="queue-add-pod")
+            if self._fleet is not None:
+                self.queue.add_pod_sync(pod)
+            else:
+                spawn(self.queue.add_pod(pod), name="queue-add-pod")
 
     def _pod_deleted(self, pod: t.Pod) -> None:
         self.cache.remove_pod(pod)
@@ -432,31 +462,42 @@ class Scheduler:
     # -- main loop --------------------------------------------------------
 
     async def _run(self) -> None:
+        batching = self._fleet is not None
         while not self._stopped:
-            item = await self.queue.pop()
-            if item is None:
+            if batching:
+                # Batch drain (SchedulerFastPath): one condvar round
+                # trip and one mutation-detector sweep per batch; the
+                # item sequence is identical to consecutive pop()s.
+                items = await self.queue.pop_batch(self.batch_size)
+            else:
+                item = await self.queue.pop()
+                items = None if item is None else [item]
+            if items is None:
                 return
             m.PENDING_PODS.set(float(len(self.queue)))
             if self.cache.mutation_detector.enabled:
                 self.cache.verify_cached()
-            try:
-                if isinstance(item, GangUnit):
-                    await self._schedule_gang(item)
-                else:
-                    await self._schedule_one(item)
-            except asyncio.CancelledError:
-                raise
-            except Exception:  # noqa: BLE001
-                log.exception("scheduleOne panic")
-                if isinstance(item, GangUnit):
-                    # A popped gang unit is the ONLY copy of the
-                    # release decision — single pods re-enter via
-                    # informer resyncs, but a dropped gang unit never
-                    # re-releases (all members stay staged, min is
-                    # already known, no further transition fires).
-                    # Found by tpusan: a mid-failover GET panic here
-                    # wedged the gang for good.
-                    await self.queue.requeue(item, self.backoff_seconds)
+            if batching:
+                m.BATCH_SIZE.observe(float(len(items)))
+            for item in items:
+                try:
+                    if isinstance(item, GangUnit):
+                        await self._schedule_gang(item)
+                    else:
+                        await self._schedule_one(item)
+                except asyncio.CancelledError:
+                    raise
+                except Exception:  # noqa: BLE001
+                    log.exception("scheduleOne panic")
+                    if isinstance(item, GangUnit):
+                        # A popped gang unit is the ONLY copy of the
+                        # release decision — single pods re-enter via
+                        # informer resyncs, but a dropped gang unit never
+                        # re-releases (all members stay staged, min is
+                        # already known, no further transition fires).
+                        # Found by tpusan: a mid-failover GET panic here
+                        # wedged the gang for good.
+                        await self.queue.requeue(item, self.backoff_seconds)
 
     async def _schedule_one(self, pod: t.Pod) -> None:
         start = time.perf_counter()
@@ -493,7 +534,7 @@ class Scheduler:
             trace.log_if_long(self.trace_threshold)
             return
 
-        assumed = deepcopy(pod)
+        assumed = self._assume_copy(pod)
         for claim in assumed.spec.tpu_resources:
             for b in bindings:
                 if b.name == claim.name:
@@ -549,30 +590,78 @@ class Scheduler:
         self._bind_tasks.add(task)
         task.add_done_callback(self._bind_tasks.discard)
 
-    def _find_placement(self, pod: t.Pod, return_candidates: bool = False):
+    def _assume_copy(self, pod: t.Pod) -> t.Pod:
+        """The copy assume_pod debits. Fast path: a structural copy
+        that clones exactly what assume mutates (the Pod shell, the
+        spec, each TPU claim + its ``assigned`` list) and shares the
+        rest — the full codec deepcopy was ~30µs/pod of pure
+        allocation churn at 30k scale, for fields nobody writes (the
+        cache discipline is verified by the armed mutation detector).
+        Gate off: the codec deepcopy, byte-identical behavior."""
+        if self._fleet is None:
+            return deepcopy(pod)
+        from dataclasses import replace
+        spec = replace(pod.spec, tpu_resources=[
+            replace(c, assigned=list(c.assigned))
+            for c in pod.spec.tpu_resources])
+        return replace(pod, spec=spec)
+
+    def _find_placement(self, pod: t.Pod, return_candidates: bool = False,
+                        use_fleet: bool = True):
         """findNodesThatFit + PrioritizeNodes + selectHost.
 
         ``return_candidates=True`` stops before selectHost and returns
         (scores, bindings_by_node, reasons) — the extender phase picks
         the host after its filter/prioritize round trips.
 
+        SchedulerFastPath (``use_fleet``, gate on): vector-eligible
+        pods place entirely through the columnar snapshot
+        (:meth:`_place_vector` — identical node choice by
+        construction); TPU pods get the columnar predicate mask and
+        pay only chip geometry per masked-in node. Anything the
+        columns cannot represent exactly — and every unschedulable
+        outcome, which needs the full per-node reason strings — takes
+        this scalar body unchanged.
+
         Chip geometry is computed ONCE per node here (select_chips) and
         reused for the fit decision, the defrag score, and the final
         binding — the reference recomputes nothing because its matcher
         is flat; ours is a box search, so reuse matters.
         """
+        requests = t.pod_resource_requests(pod)  # once per pod
+        fleet = self._fleet
+        mask = None
+        if (use_fleet and fleet is not None and not return_candidates
+                and self.policy is None
+                and not self.cache.has_reservations()):
+            fleet.refresh()
+            mask = fleet.feasibility_mask(pod, requests)
+        wants_tpu = bool(pod.spec.tpu_resources)
+        if mask is not None and not wants_tpu:
+            placed = self._place_vector(pod, fleet, mask, requests)
+            if placed is not None:
+                m.BATCH_FASTPATH.inc(path="vector")
+                return placed
+            # No feasible node under the (exact) mask: the scalar body
+            # below collects the per-node reason strings the event/
+            # condition surface reports.
+            mask = None
+        if fleet is not None and use_fleet and not return_candidates:
+            m.BATCH_FASTPATH.inc(path="masked" if mask is not None
+                                 else "scalar")
         feasible = []
         reasons: list[str] = []
         chip_choices: dict[str, list] = {}
         bindings_by_node: dict[str, list] = {}
-        wants_tpu = bool(pod.spec.tpu_resources)
         # Node sampling (reference: percentageOfNodesToScore +
         # equivalence of findNodesThatFit's numFeasibleNodesToFind): at
         # fleet scale, stop once enough feasible nodes are collected
         # instead of scanning everything per pod. TPU pods always scan
         # fully — chip geometry makes every node's answer distinct.
         # A rotating start offset spreads load across the fleet.
-        names = list(self.cache.nodes)  # insertion-order snapshot; the
+        # With a fleet mask the snapshot's names ARE this insertion-
+        # order list (rebuilt from the same dict at refresh).
+        names = fleet.names if mask is not None else list(self.cache.nodes)
         n = len(names)                  # ring offset does the spreading
         enough = n if (wants_tpu or n <= 100) else max(100, n // 20)
         start_at = self._ring_offset % n if n else 0
@@ -582,7 +671,6 @@ class Scheduler:
         # accounting changes.
         from .equivalence import equivalence_hash
         eq = equivalence_hash(pod)
-        requests = t.pod_resource_requests(pod)  # once per pod
         # Inter-pod affinity context (podaffinity.py): built once per
         # pod; None in affinity-free clusters. NOT part of the
         # equivalence-cached predicates — its verdict depends on other
@@ -603,36 +691,46 @@ class Scheduler:
         my_key = pod.key()
         any_reservations = self.cache.has_reservations()
         for idx in range(n):
-            name = names[(start_at + idx) % n]
+            row = (start_at + idx) % n
+            name = names[row]
+            if mask is not None and not mask[row]:
+                # Columnar verdict: infeasible (exact for the non-TPU
+                # predicates; for TPU pods also the chip-count
+                # prefilter select_chips would refuse anyway). Reasons
+                # are not collected here — an unschedulable outcome
+                # reruns the full scalar pass below.
+                continue
             info = self.cache.nodes.get(name)
             if info is None or info.node is None:
                 continue
-            reserved = False
-            if any_reservations:
-                res_req, res_chips = self.cache.node_reserved(
-                    name, exclude_owner=my_key, below_priority=my_prio)
-                if res_req or res_chips:
-                    # Nominated capacity held for a preemptor this pod
-                    # must not steal: evaluate against a debited view,
-                    # and bypass the equivalence cache (its verdicts
-                    # ignore priority).
-                    from .cache import ReservedNodeView
-                    info = ReservedNodeView(info, res_req, res_chips)
-                    reserved = True
-            cached = (self.cache.equiv.lookup(name, eq)
-                      if eq is not None and not reserved else None)
-            if cached is not None:
-                fits, cached_reasons = cached
-            else:
-                res = run_predicates(pod, info, skip_tpu=True,
-                                     requests=requests,
-                                     enabled=self._enabled_predicates)
-                fits, cached_reasons = res.fits, res.reasons
-                if eq is not None and not reserved:
-                    self.cache.equiv.store(name, eq, fits, cached_reasons)
-            if not fits:
-                reasons.append(f"{name}: {'; '.join(cached_reasons)}")
-                continue
+            if mask is None:
+                reserved = False
+                if any_reservations:
+                    res_req, res_chips = self.cache.node_reserved(
+                        name, exclude_owner=my_key, below_priority=my_prio)
+                    if res_req or res_chips:
+                        # Nominated capacity held for a preemptor this
+                        # pod must not steal: evaluate against a
+                        # debited view, and bypass the equivalence
+                        # cache (its verdicts ignore priority).
+                        from .cache import ReservedNodeView
+                        info = ReservedNodeView(info, res_req, res_chips)
+                        reserved = True
+                cached = (self.cache.equiv.lookup(name, eq)
+                          if eq is not None and not reserved else None)
+                if cached is not None:
+                    fits, cached_reasons = cached
+                else:
+                    res = run_predicates(pod, info, skip_tpu=True,
+                                         requests=requests,
+                                         enabled=self._enabled_predicates)
+                    fits, cached_reasons = res.fits, res.reasons
+                    if eq is not None and not reserved:
+                        self.cache.equiv.store(name, eq, fits,
+                                               cached_reasons)
+                if not fits:
+                    reasons.append(f"{name}: {'; '.join(cached_reasons)}")
+                    continue
             if affinity_ctx is not None and aff_pred_on:
                 why = affinity_ctx.node_allows(info.node)
                 if why is not None:
@@ -651,6 +749,14 @@ class Scheduler:
             if len(feasible) >= enough:
                 break
         if not feasible:
+            if mask is not None:
+                # The masked pass skipped reason collection; the
+                # unschedulable surface (events, conditions, preemption
+                # decisions) needs the exact per-node strings — rerun
+                # the full scalar pass. Placement outcome is unchanged
+                # (the mask is exact); only the cold path pays.
+                return self._find_placement(pod, return_candidates,
+                                            use_fleet=False)
             return None, None, reasons
         sibling_counts = self._sibling_counts(pod)
         scores = prioritize(pod, feasible, sibling_counts, chip_choices,
@@ -675,6 +781,35 @@ class Scheduler:
             return scores, bindings_by_node, reasons
         best = max(scores, key=lambda n: (scores[n], n))
         return best, bindings_by_node.get(best, []), []
+
+    def _place_vector(self, pod: t.Pod, fleet, mask, requests):
+        """Fully columnar placement for a vector-eligible non-TPU pod:
+        ring-sampled candidates and fused priority scores as array ops
+        (fleetarray.score_rows mirrors prioritize() term-for-term, so
+        the chosen node is identical to the scalar path's). Returns
+        None when no node is feasible — the caller reruns the scalar
+        pass for the reason strings WITHOUT having consumed a ring
+        offset here, so the fallback samples exactly as an unmasked
+        call would have."""
+        if not mask.any():
+            return None
+        n = len(fleet)
+        enough = n if n <= 100 else max(100, n // 20)
+        start_at = self._ring_offset % n
+        self._ring_offset += 1
+        rows = fleet.ring_candidates(mask, start_at, enough)
+        limits: dict[str, float] = {}
+        for c in pod.spec.containers:
+            for res, amount in c.resources.limits.items():
+                limits[res] = limits.get(res, 0.0) + t.parse_quantity(amount)
+        from .priorities import MAX_SCORE, TPU_DEFRAG_WEIGHT
+        scores = fleet.score_rows(rows, requests, limits,
+                                  self._sibling_counts(pod),
+                                  TPU_DEFRAG_WEIGHT * (MAX_SCORE / 2))
+        best = fleet.select_best(rows, scores)
+        if best is None:
+            return None
+        return best, [], []
 
     async def _find_placement_extended(self, pod: t.Pod):
         """_find_placement + the extender phase (core/extender.go):
@@ -772,9 +907,17 @@ class Scheduler:
                 continue
             sid = topo.slice_id
             if sid not in free_by_slice:
-                free_by_slice[sid] = sl.free(self.cache)
-                before_by_slice[sid] = largest_free_box_volume(
-                    set(free_by_slice[sid]), sl.mesh_shape)
+                if self._fleet is not None:
+                    # Snapshot memo: survives across placement passes
+                    # until any member node's accounting changes (the
+                    # scalar memo below lives one pass only).
+                    self._fleet.refresh()
+                    free_by_slice[sid], before_by_slice[sid] = \
+                        self._fleet.slice_free_stats(sl)
+                else:
+                    free_by_slice[sid] = sl.free(self.cache)
+                    before_by_slice[sid] = largest_free_box_volume(
+                        set(free_by_slice[sid]), sl.mesh_shape)
             slice_free = free_by_slice[sid]
             by_id = {cid: coord for coord, (n, cid) in slice_free.items()
                      if n == name}

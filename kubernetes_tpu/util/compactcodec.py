@@ -1,0 +1,233 @@
+"""Compact framed wire codec — the LIST/watch twin of the scheduler
+fast path (gate ``CompactWireCodec``, alpha, default off).
+
+Reference motivation: the apiserver negotiates protobuf on the hot
+path because wire-codec CPU dominates the control plane at density
+scale (``apimachinery/pkg/runtime/serializer/protobuf``); this repo's
+go/no-go instrument (``perf/decode_share.py``) puts the JSON share at
+~7% and RISING with every fan-out win. The codec here is deliberately
+small: **length-prefixed msgpack frames**, negotiated per request via
+``Accept``/``Content-Type``. JSON remains the default and the
+fallback — a client that never asks, a server with the gate off, or a
+host without the msgpack wheel all keep the existing byte-identical
+JSON surface.
+
+Wire format (``application/x-ktpu-compact``):
+
+- **frame** — 4-byte big-endian payload length + msgpack payload.
+- **LIST body** — frame 0 is the envelope map ``{"kind": "List",
+  "api_version": "core/v1", "metadata": {"resource_version": str},
+  "n": N}``; frames 1..N are the items. Per-item bytes are cached in
+  the apiserver's serialize-once encode cache beside the JSON lines
+  (same ``(key, revision)`` identity, ``which`` suffixed ``#c``), so
+  fan-out reuse holds for both codecs.
+- **watch stream** — one frame per event: the map ``{"type": etype,
+  "object": obj}``, hand-assembled as a fixmap header + pre-encoded
+  object bytes so the cached per-revision encoding is reused without
+  a re-pack (:func:`event_frame`). Bookmarks are ordinary events.
+
+Value model: msgpack round-trips exactly the JSON value universe the
+scheme's ``to_dict`` emits (str/float/int/bool/None/list/str-keyed
+dict) — the golden corpus test pins compact decode output equal to
+the JSON path's for every core kind, unicode and large lists
+included.
+"""
+from __future__ import annotations
+
+import struct
+from typing import Iterator, Optional
+
+try:  # the wheel is baked into the image; gate stays inert without it
+    import msgpack as _msgpack
+except ImportError:  # pragma: no cover - exercised only on bare hosts
+    _msgpack = None
+
+from ..metrics.registry import Counter
+
+#: Negotiated media type (client Accept -> server Content-Type).
+CONTENT_TYPE = "application/x-ktpu-compact"
+
+_LEN = struct.Struct(">I")
+
+CODEC_WIRE_REQUESTS = Counter(
+    "codec_wire_requests_total",
+    "Wire requests/streams served or consumed per negotiated codec",
+    labels=("codec", "op"))
+
+CODEC_WIRE_BYTES = Counter(
+    "codec_wire_bytes_total",
+    "Payload bytes produced per negotiated codec and operation",
+    labels=("codec", "op"))
+
+
+def available() -> bool:
+    """True when the msgpack wheel is importable on this host."""
+    return _msgpack is not None
+
+
+def enabled() -> bool:
+    """Gate + wheel: the compact codec may be offered/requested."""
+    if _msgpack is None:
+        return False
+    from .features import GATES
+    return GATES.enabled("CompactWireCodec")
+
+
+def accepts_compact(accept_header: str) -> bool:
+    """Does an ``Accept`` header ask for the compact media type?"""
+    return CONTENT_TYPE in (accept_header or "")
+
+
+def accept_header() -> Optional[dict]:
+    """The client-side offer: ONE place builds the negotiation string
+    every client (RESTClient, loadgen's raw watcher) sends, so they
+    can never drift apart. None when the gate/wheel says JSON-only —
+    callers then send byte-identical ungated requests."""
+    if not enabled():
+        return None
+    return {"Accept": CONTENT_TYPE + ", application/json"}
+
+
+def cache_which(which: str, codec: str) -> str:
+    """Encode-cache ``which`` for a codec: compact payloads live
+    beside the JSON lines under a ``#c`` suffix — same ``(key,
+    revision)`` identity, same write invalidation. One mapping shared
+    by every cache reader/writer (registry LIST/GET/watch, the
+    codec-pool completion path) so lookups and inserts can never use
+    different keys."""
+    return which if codec == "json" else which + "#c"
+
+
+def encode_wire(value, codec: str) -> bytes:
+    """One value -> wire bytes under ``codec`` — the single encode
+    dispatch the inline LIST/watch paths share (the pool offload uses
+    the module-level worker twins)."""
+    if codec == "json":
+        import json
+        return json.dumps(value, separators=(",", ":")).encode()
+    return encode_obj(value)
+
+
+# -- scalar object codec ----------------------------------------------------
+
+def encode_obj(value) -> bytes:
+    """msgpack bytes for one JSON-model value (the compact analog of
+    ``json.dumps(value, separators=(",", ":")).encode()``)."""
+    return _msgpack.packb(value, use_bin_type=True)
+
+
+def decode_obj(raw: bytes):
+    """Inverse of :func:`encode_obj`; str keys/values come back as str
+    (never bytes), matching ``json.loads`` output exactly."""
+    return _msgpack.unpackb(raw, raw=False, strict_map_key=False)
+
+
+# -- framing ----------------------------------------------------------------
+
+def frame(payload: bytes) -> bytes:
+    return _LEN.pack(len(payload)) + payload
+
+
+class FrameDecoder:
+    """Incremental frame parser for streamed bodies (watch). Feed raw
+    socket chunks in any fragmentation; complete payloads come out in
+    order. Bounded by one frame of buffered bytes plus the unconsumed
+    tail of the last chunk."""
+
+    def __init__(self) -> None:
+        self._buf = bytearray()
+
+    def feed(self, chunk: bytes) -> Iterator[bytes]:
+        self._buf.extend(chunk)
+        while True:
+            if len(self._buf) < _LEN.size:
+                return
+            (n,) = _LEN.unpack_from(self._buf, 0)
+            end = _LEN.size + n
+            if len(self._buf) < end:
+                return
+            payload = bytes(self._buf[_LEN.size:end])
+            del self._buf[:end]
+            yield payload
+
+
+# -- LIST bodies ------------------------------------------------------------
+
+def list_envelope(revision: int, n_items: int,
+                  continue_token: str = "") -> bytes:
+    meta = {"resource_version": str(revision)}
+    if continue_token:
+        meta["continue"] = continue_token
+    return encode_obj({"kind": "List", "api_version": "core/v1",
+                       "metadata": meta, "n": n_items})
+
+
+def encode_list_body(revision: int, item_payloads: list[bytes],
+                     continue_token: str = "") -> bytes:
+    """Assemble a compact LIST response from per-item msgpack payloads
+    (already encoded — typically straight out of the encode cache)."""
+    parts = [frame(list_envelope(revision, len(item_payloads),
+                                 continue_token))]
+    parts.extend(_LEN.pack(len(p)) + p for p in item_payloads)
+    return b"".join(parts)
+
+
+def decode_list_body(body: bytes) -> dict:
+    """Client half: a compact LIST body back to the dict shape the JSON
+    path's ``resp.json()`` yields ({"kind", "api_version", "metadata",
+    "items": [...]}), so every existing caller decodes identically."""
+    dec = FrameDecoder()
+    frames = iter(dec.feed(body))
+    try:
+        env = decode_obj(next(frames))
+    except StopIteration:
+        raise ValueError("compact LIST body has no envelope frame") \
+            from None
+    n = env.pop("n", 0)
+    items = [decode_obj(p) for p in frames]
+    if len(items) != n:
+        raise ValueError(f"compact LIST body truncated: envelope says "
+                         f"{n} items, got {len(items)}")
+    env["items"] = items
+    return env
+
+
+# -- watch events -----------------------------------------------------------
+
+def _packed_key(name: str) -> bytes:
+    return _msgpack.packb(name) if _msgpack is not None else b""
+
+
+_KEY_TYPE = _packed_key("type")
+_KEY_OBJECT = _packed_key("object")
+
+
+def event_frame(etype: str, obj_payload: bytes) -> bytes:
+    """One watch event as a frame, reusing the object's cached msgpack
+    bytes: a hand-built 2-entry fixmap header + the two pairs — valid
+    msgpack, zero re-encode of the (large) object payload."""
+    payload = (b"\x82" + _KEY_TYPE + _msgpack.packb(etype)
+               + _KEY_OBJECT + obj_payload)
+    return _LEN.pack(len(payload)) + payload
+
+
+def decode_event(payload: bytes) -> dict:
+    """{"type": ..., "object": ...} from one watch frame payload."""
+    return decode_obj(payload)
+
+
+# -- worker-process encode (codec pool) -------------------------------------
+
+def encode_many(values: list) -> list[bytes]:
+    """Compact analog of the codec pool's ``_encode_many``; module
+    level so it pickles by reference into pool workers."""
+    packb = _msgpack.packb
+    return [packb(v, use_bin_type=True) for v in values]
+
+
+def count_request(codec: str, op: str, nbytes: Optional[int] = None) -> None:
+    """One metrics seam for both codecs so the codec_wire_* families
+    compare like for like (the JSON fast path counts here too)."""
+    CODEC_WIRE_REQUESTS.inc(codec=codec, op=op)
+    if nbytes:
+        CODEC_WIRE_BYTES.inc(nbytes, codec=codec, op=op)

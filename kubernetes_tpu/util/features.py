@@ -135,6 +135,24 @@ KNOWN_FEATURES = {f.name: f for f in [
             "the node's last degrading alert resolves — the seam a "
             "migration/defrag controller consumes. Requires "
             "ClusterMetricsPipeline; off = alerts record Events only"),
+    Feature("SchedulerFastPath", False, ALPHA,
+            "columnar scheduler hot path (scheduler/fleetarray.py): a "
+            "numpy fleet snapshot maintained incrementally from cache "
+            "events; feasibility filtering and priority scoring for "
+            "eligible pods (and whole drained queue batches) run as "
+            "vectorized array ops instead of per-node Python loops, "
+            "with exact scalar fallback for pods needing affinity/"
+            "policy/extenders/reservations. Placement decisions are "
+            "identical to the scalar path by construction (property-"
+            "tested); off = the per-pod scalar loop, byte-identical"),
+    Feature("CompactWireCodec", False, ALPHA,
+            "compact framed wire codec for LIST responses and watch "
+            "streams (util/compactcodec.py): length-prefixed msgpack "
+            "frames negotiated via Accept/Content-Type on top of the "
+            "serialize-once encode cache; JSON remains the default "
+            "and the fallback (a client that never asks, or a server "
+            "with the gate off, sees byte-identical JSON). Requires "
+            "the msgpack wheel; without it the gate is inert"),
     Feature("ClusterMonitoring", True, BETA,
             "cluster-level TPU telemetry rollup (monitoring/"
             "aggregator.py): the controller-manager scrapes node "
@@ -180,6 +198,17 @@ class FeatureGates:
 
     def as_dict(self) -> dict[str, bool]:
         return dict(self._enabled)
+
+    def snapshot(self) -> dict[str, bool]:
+        """Current gate values, for a later :meth:`restore` — the
+        save/restore pair harnesses use to flip gates for one run
+        without leaking them into the process."""
+        return dict(self._enabled)
+
+    def restore(self, snap: dict[str, bool]) -> None:
+        """Reinstate a :meth:`snapshot` verbatim (bypasses the GA
+        guard — a snapshot is by construction a legal state)."""
+        self._enabled = dict(snap)
 
 
 #: Process-global gates (reference: utilfeature.DefaultFeatureGate).

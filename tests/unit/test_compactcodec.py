@@ -1,0 +1,154 @@
+"""CompactWireCodec golden byte-compat corpus + framing.
+
+The compact codec's contract is NOT "a similar object model" — it is
+"decode output EQUAL to the JSON path's" for every core kind, so a
+client flipping codecs can never observe a value-level difference.
+The corpus pins that equality over Pod/Node/PodGroup/Binding
+(unicode, large lists, TPU topologies included), and the framing
+layer's incremental parser over every chunk fragmentation.
+"""
+import json
+
+import pytest
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.meta import ObjectMeta, OwnerReference
+from kubernetes_tpu.api.scheme import to_dict
+from kubernetes_tpu.perf.hollow import hollow_topology
+from kubernetes_tpu.util import compactcodec as cc
+
+pytestmark = pytest.mark.skipif(not cc.available(),
+                                reason="msgpack not installed")
+
+
+def _json_path(value):
+    """What the JSON wire path yields for ``value``."""
+    return json.loads(json.dumps(value, separators=(",", ":")))
+
+
+def _corpus() -> list:
+    pod = t.Pod(
+        metadata=ObjectMeta(
+            name="pod-ü", namespace="default",
+            labels={"app": "x"},
+            annotations={"note": "日本語 — ünïcode ✓",
+                         "emoji": "🚀" * 50},
+            owner_references=[OwnerReference(
+                api_version="apps/v1", kind="ReplicaSet", name="rs",
+                uid="u-1", controller=True)]),
+        spec=t.PodSpec(
+            containers=[t.Container(
+                name="c", image="img:latest",
+                resources=t.ResourceRequirements(
+                    requests={"cpu": 0.5, "memory": 2**30},
+                    limits={"cpu": "2", "memory": str(2**31)}))],
+            tpu_resources=[t.PodTpuRequest(
+                name="tpu", chips=4, slice_shape=[2, 2],
+                assigned=[f"chip-{i}" for i in range(4)])]))
+    node = t.Node(metadata=ObjectMeta(
+        name="node-0", labels={"zone": "z1"}))
+    node.status.capacity = {"cpu": 8.0, "memory": float(2**34),
+                            "pods": 110.0}
+    node.status.allocatable = dict(node.status.capacity)
+    node.status.conditions = [
+        t.NodeCondition(type=t.NODE_READY, status="True")]
+    node.status.tpu = hollow_topology("node-0", 64, [4, 4, 4])
+    node.spec.taints = [t.Taint(key="k", value="v",
+                                effect=t.TAINT_NO_SCHEDULE)]
+    group = t.PodGroup(
+        metadata=ObjectMeta(name="gang", namespace="default"),
+        spec=t.PodGroupSpec(min_member=8, slice_shape=[2, 2, 2]))
+    binding = t.Binding(target=t.BindingTarget(
+        node_name="node-0",
+        tpu_bindings=[t.TpuBinding(
+            name="tpu", chip_ids=[f"node-0-c{i}" for i in range(256)])]))
+    return [pod, node, group, binding]
+
+
+def test_golden_corpus_equals_json_path():
+    for obj in _corpus():
+        d = to_dict(obj)
+        via_json = _json_path(d)
+        via_compact = cc.decode_obj(cc.encode_obj(d))
+        assert via_compact == via_json, type(obj).__name__
+
+
+def test_large_list_roundtrip():
+    # A 30k-LIST-shaped items payload: values survive exactly.
+    items = [{"metadata": {"name": f"p{i:05d}",
+                           "resource_version": str(i)},
+              "spec": {"node_name": f"n{i % 997}"},
+              "floats": [i * 0.1, i / 3.0],
+              "nested": {"deep": [[i], [i + 1]]}}
+             for i in range(5000)]
+    assert cc.decode_obj(cc.encode_obj(items)) == _json_path(items)
+
+
+def test_list_body_roundtrip_matches_json_shape():
+    objs = [to_dict(o) for o in _corpus()]
+    payloads = [cc.encode_obj(o) for o in objs]
+    body = cc.encode_list_body(42, payloads)
+    decoded = cc.decode_list_body(body)
+    assert decoded == {
+        "kind": "List", "api_version": "core/v1",
+        "metadata": {"resource_version": "42"},
+        "items": [_json_path(o) for o in objs],
+    }
+
+
+def test_list_body_truncation_detected():
+    payloads = [cc.encode_obj({"a": 1}), cc.encode_obj({"b": 2})]
+    body = cc.encode_list_body(1, payloads)
+    with pytest.raises(ValueError):
+        cc.decode_list_body(body[:len(body) - 3])
+    with pytest.raises(ValueError):
+        cc.decode_list_body(b"")
+
+
+def test_event_frame_reuses_object_payload():
+    obj = to_dict(_corpus()[0])
+    payload = cc.encode_obj(obj)
+    framed = cc.event_frame("MODIFIED", payload)
+    # The pre-encoded object bytes are embedded verbatim (serialize-
+    # once fan-out: no re-pack per watcher).
+    assert payload in framed
+    dec = cc.FrameDecoder()
+    events = [cc.decode_event(p) for p in dec.feed(framed)]
+    assert events == [{"type": "MODIFIED", "object": _json_path(obj)}]
+
+
+def test_frame_decoder_every_fragmentation():
+    frames = [cc.frame(cc.encode_obj({"i": i, "pad": "x" * i}))
+              for i in range(6)]
+    stream = b"".join(frames)
+    expect = [{"i": i, "pad": "x" * i} for i in range(6)]
+    # Split the byte stream at EVERY position: framing must be
+    # agnostic to chunk boundaries (watch bodies arrive arbitrarily).
+    for cut in range(len(stream) + 1):
+        dec = cc.FrameDecoder()
+        out = []
+        for chunk in (stream[:cut], stream[cut:]):
+            out.extend(cc.decode_obj(p) for p in dec.feed(chunk))
+        assert out == expect, cut
+
+
+def test_frame_decoder_byte_at_a_time():
+    frames = [cc.frame(cc.encode_obj(k)) for k in ("a", "bb", "ccc")]
+    dec = cc.FrameDecoder()
+    out = []
+    for b in b"".join(frames):
+        out.extend(cc.decode_obj(p) for p in dec.feed(bytes([b])))
+    assert out == ["a", "bb", "ccc"]
+
+
+def test_enabled_requires_gate():
+    from kubernetes_tpu.util.features import GATES
+    assert not cc.enabled()  # default off
+    GATES.set("CompactWireCodec", True)
+    try:
+        assert cc.enabled()
+        assert cc.accepts_compact(cc.CONTENT_TYPE + ", application/json")
+        assert not cc.accepts_compact("application/json")
+        assert not cc.accepts_compact("")
+    finally:
+        GATES.set("CompactWireCodec", False)

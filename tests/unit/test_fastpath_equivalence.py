@@ -1,0 +1,258 @@
+"""SchedulerFastPath placement-equivalence property test.
+
+The fast path's whole contract: for every pod, the vectorized
+(columnar) `_find_placement` returns the IDENTICAL node and chip ids
+the scalar path returns — same ring offset in, same placement out.
+This drives both paths over ≥20 seeded random fleets (mixed capacity,
+cordons, pressure conditions, taints, TPU topologies, placed pods)
+and a mixed pod population (plain, limits, owner refs, tolerations,
+TPU claims with and without slice shapes, plus scalar-fallback
+classes: selectors, affinity) and asserts equality pod by pod —
+including through interleaved assumes, which exercise the snapshot's
+incremental dirty-row maintenance.
+"""
+import random
+
+import pytest
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.meta import ObjectMeta, OwnerReference
+from kubernetes_tpu.perf.hollow import hollow_topology
+from kubernetes_tpu.scheduler.cache import SchedulerCache
+from kubernetes_tpu.scheduler.fleetarray import FleetSnapshot
+from kubernetes_tpu.scheduler.scheduler import Scheduler
+
+FLEETS = 24
+PODS_PER_FLEET = 40
+
+
+def _bare_scheduler() -> Scheduler:
+    """A Scheduler with just the placement machinery wired (no client,
+    no informers) — _find_placement needs only cache/policy/extenders."""
+    s = Scheduler.__new__(Scheduler)
+    s.cache = SchedulerCache()
+    s.policy = None
+    s._enabled_predicates = None
+    s._priority_weights = None
+    s.extenders = []
+    s._ring_offset = 0
+    s._fleet = None
+    return s
+
+
+def _random_node(rng: random.Random, i: int) -> t.Node:
+    name = f"n{i:04d}"
+    node = t.Node(metadata=ObjectMeta(
+        name=name, labels={"zone": f"z{i % 5}",
+                           "kubernetes.io/hostname": name}))
+    node.status.capacity = {
+        "cpu": rng.choice([0.0, 2.0, 8.0, 32.0]),
+        "memory": rng.choice([float(2**30), float(2**34)]),
+        "pods": float(rng.choice([3, 110]))}
+    node.status.allocatable = dict(node.status.capacity)
+    conds = [t.NodeCondition(type=t.NODE_READY,
+                             status=rng.choice(["True", "True", "True",
+                                                "False"]))]
+    if rng.random() < 0.15:
+        conds.append(t.NodeCondition(type=t.NODE_MEMORY_PRESSURE,
+                                     status="True"))
+    if rng.random() < 0.1:
+        conds.append(t.NodeCondition(type=t.NODE_DISK_PRESSURE,
+                                     status="True"))
+    node.status.conditions = conds
+    node.spec.unschedulable = rng.random() < 0.1
+    if rng.random() < 0.2:
+        node.spec.taints = [t.Taint(
+            key=rng.choice(["dedicated", "degraded"]), value="x",
+            effect=rng.choice([t.TAINT_NO_SCHEDULE, t.TAINT_NO_EXECUTE]))]
+    if rng.random() < 0.3:
+        chips = rng.choice([4, 8])
+        node.status.tpu = hollow_topology(name, chips,
+                                          slice_id=f"slice-{i % 3}")
+        node.status.capacity[t.RESOURCE_TPU] = float(chips)
+        node.status.allocatable[t.RESOURCE_TPU] = float(chips)
+    return node
+
+
+def _random_pod(rng: random.Random, j: int, kind: str = "") -> t.Pod:
+    kind = kind or rng.choice(
+        ["plain", "plain", "plain", "limits", "owned", "tolerating",
+         "tpu", "tpu_shaped", "selector", "affinity", "huge"])
+    pod = t.Pod(
+        metadata=ObjectMeta(name=f"p{j:03d}-{kind}", namespace="default"),
+        spec=t.PodSpec(containers=[t.Container(
+            name="c", image="i",
+            resources=t.ResourceRequirements(
+                requests={"cpu": rng.choice([0.1, 1.0, 4.0]),
+                          "memory": rng.choice([2**26, 2**30])}))]))
+    if kind == "huge":
+        pod.spec.containers[0].resources.requests["cpu"] = 10_000.0
+    elif kind == "limits":
+        pod.spec.containers[0].resources.limits = {
+            "cpu": str(rng.choice([1, 16])), "memory": str(2**33)}
+    elif kind == "owned":
+        pod.metadata.owner_references = [OwnerReference(
+            api_version="apps/v1", kind="ReplicaSet",
+            name="rs", uid=f"rs-{j % 3}", controller=True)]
+    elif kind == "tolerating":
+        pod.spec.tolerations = [t.Toleration(
+            key="dedicated", operator="Exists",
+            effect=t.TAINT_NO_SCHEDULE)]
+    elif kind == "tpu":
+        pod.spec.tpu_resources = [t.PodTpuRequest(
+            name="tpu", chips=rng.choice([1, 2, 4]))]
+    elif kind == "tpu_shaped":
+        pod.spec.tpu_resources = [t.PodTpuRequest(
+            name="tpu", chips=4, slice_shape=[2, 2])]
+    elif kind == "selector":
+        pod.spec.node_selector = {"zone": "z1"}
+    elif kind == "affinity":
+        from kubernetes_tpu.api.selectors import Requirement
+        pod.spec.affinity = t.Affinity(node_preferred=[
+            t.NodeAffinityTerm(match_expressions=[
+                Requirement(key="zone", operator="In", values=["z2"])])])
+    return pod
+
+
+def _build_fleet(rng: random.Random, n_nodes: int) -> Scheduler:
+    s = _bare_scheduler()
+    for i in range(n_nodes):
+        s.cache.set_node(_random_node(rng, i))
+    # Pre-placed pods so requested/free-chip columns are non-trivial.
+    names = list(s.cache.nodes)
+    for k in range(n_nodes):
+        if rng.random() < 0.5:
+            continue
+        p = _random_pod(rng, 900 + k, kind="plain")
+        p.spec.node_name = rng.choice(names)
+        info = s.cache.nodes[p.spec.node_name]
+        topo = info.node.status.tpu if info.node else None
+        if topo is not None and rng.random() < 0.5 and info.free_chips:
+            take = sorted(info.free_chips)[:2]
+            p.spec.tpu_resources = [t.PodTpuRequest(
+                name="tpu", chips=len(take), assigned=take)]
+        s.cache.add_pod(p)
+    return s
+
+
+def _placement(s: Scheduler, pod: t.Pod, offset: int):
+    s._ring_offset = offset
+    node, bindings, reasons = s._find_placement(pod)
+    chips = (sorted(cid for b in bindings for cid in b.chip_ids)
+             if bindings else [])
+    return node, chips, bool(reasons) if node is None else False
+
+
+@pytest.mark.parametrize("fleet_seed", range(FLEETS))
+def test_vector_and_scalar_place_identically(fleet_seed):
+    rng = random.Random(f"fastpath-eq:{fleet_seed}")
+    n_nodes = rng.choice([7, 40, 130, 260])
+    s = _build_fleet(rng, n_nodes)
+    fleet = FleetSnapshot(s.cache)
+    s.cache.snapshot = fleet
+    for j in range(PODS_PER_FLEET):
+        pod = _random_pod(rng, j)
+        offset = rng.randrange(1000)
+        s._fleet = None
+        want = _placement(s, pod, offset)
+        s._fleet = fleet
+        got = _placement(s, pod, offset)
+        assert got == want, (fleet_seed, j, pod.metadata.name, want, got)
+        # Interleave assumes so the snapshot's incremental dirty-row
+        # path (not just the initial rebuild) is what's being tested.
+        if want[0] is not None and rng.random() < 0.5:
+            from kubernetes_tpu.api.scheme import deepcopy
+            assumed = deepcopy(pod)
+            s._fleet = None
+            node, bindings, _ = _placement_full(s, pod, offset)
+            for claim in assumed.spec.tpu_resources:
+                for b in bindings or []:
+                    if b.name == claim.name:
+                        claim.assigned = list(b.chip_ids)
+            s.cache.assume_pod(assumed, node)
+            s._fleet = fleet
+
+
+def _placement_full(s, pod, offset):
+    s._ring_offset = offset
+    return s._find_placement(pod)
+
+
+def test_mask_matches_run_predicates_exactly():
+    """The feasibility mask IS run_predicates(skip_tpu=True) for
+    eligible pods — checked node by node, not just end to end."""
+    from kubernetes_tpu.scheduler.predicates import run_predicates
+    rng = random.Random("mask-eq")
+    s = _build_fleet(rng, 120)
+    fleet = FleetSnapshot(s.cache)
+    fleet.refresh()
+    for j in range(30):
+        pod = _random_pod(rng, j, kind=rng.choice(
+            ["plain", "limits", "tolerating", "tpu", "huge"]))
+        requests = t.pod_resource_requests(pod)
+        mask = fleet.feasibility_mask(pod, requests)
+        assert mask is not None
+        chips = t.pod_tpu_chip_count(pod)
+        for i, name in enumerate(fleet.names):
+            info = s.cache.nodes[name]
+            if info.node is None:
+                assert not mask[i]
+                continue
+            fits = run_predicates(pod, info, skip_tpu=True,
+                                  requests=requests).fits
+            if chips:
+                fits = fits and info.node.status.tpu is not None \
+                    and len(info.free_chips) >= chips
+            assert bool(mask[i]) == fits, (j, name)
+
+
+def test_snapshot_incremental_equals_rebuild():
+    """Dirty-row refresh after arbitrary cache churn must equal a
+    from-scratch snapshot (the incremental-maintenance contract)."""
+    import numpy as np
+    rng = random.Random("incr")
+    s = _build_fleet(rng, 60)
+    fleet = FleetSnapshot(s.cache)
+    s.cache.snapshot = fleet
+    fleet.refresh()
+    names = list(s.cache.nodes)
+    for step in range(40):
+        op = rng.choice(["add", "remove", "set_node", "remove_node",
+                         "new_node"])
+        if op == "add":
+            p = _random_pod(rng, 1000 + step, kind="plain")
+            p.spec.node_name = rng.choice(names)
+            s.cache.add_pod(p)
+        elif op == "remove":
+            name = rng.choice(names)
+            info = s.cache.nodes.get(name)
+            if info and info.pods:
+                s.cache.remove_pod(next(iter(info.pods.values())))
+        elif op == "set_node":
+            name = rng.choice(names)
+            info = s.cache.nodes.get(name)
+            if info and info.node is not None:
+                node = info.node
+                node.spec.unschedulable = not node.spec.unschedulable
+                s.cache.set_node(node)
+        elif op == "remove_node":
+            if len(names) > 10:
+                name = names.pop(rng.randrange(len(names)))
+                s.cache.remove_node(name)
+        else:
+            node = _random_node(rng, 500 + step)
+            s.cache.set_node(node)
+            names.append(node.metadata.name)
+        fleet.refresh()
+        fresh = FleetSnapshot(s.cache)
+        fresh.refresh()
+        assert fleet.names == fresh.names, (step, op)
+        for col in ("_ok", "_schedulable", "_disk_pressure",
+                    "_mem_pressure", "_blocking_taints", "_has_tpu",
+                    "_tpu_free"):
+            assert np.array_equal(getattr(fleet, col),
+                                  getattr(fresh, col)), (step, op, col)
+        for res, arr in fleet._alloc.items():
+            assert np.array_equal(arr, fresh._alloc[res]), (step, op, res)
+        for res, arr in fleet._req.items():
+            assert np.array_equal(arr, fresh._req[res]), (step, op, res)

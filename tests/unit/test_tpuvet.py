@@ -211,6 +211,25 @@ F = Counter("preemption_rounds_total", "re-registered: silently inert")
     assert len(got) == 1 and "already registered" in got[0].message
 
 
+def test_metric_name_scheduler_batch_and_codec_wire_families():
+    """The SchedulerFastPath batch-drain family (scheduler_batch_*)
+    and the compact-wire-codec family (codec_wire_*) are valid names,
+    and a duplicate registration within the family is still caught."""
+    good = """
+from kubernetes_tpu.metrics.registry import Counter, Histogram
+A = Histogram("scheduler_batch_size_pods", "x")
+B = Counter("scheduler_batch_fastpath_total", "x", labels=("path",))
+C = Counter("codec_wire_requests_total", "x", labels=("codec", "op"))
+D = Counter("codec_wire_bytes_total", "x", labels=("codec", "op"))
+"""
+    assert run_source(good, checks=["metric-name"]) == []
+    bad = good + """
+E = Counter("codec_wire_requests_total", "re-registered: silently inert")
+"""
+    got = run_source(bad, checks=["metric-name"])
+    assert len(got) == 1 and "already registered" in got[0].message
+
+
 def test_metric_name_retry_and_chaos_families():
     """The client retry/backoff and chaos-injection metric families
     (client_retry_total, client_backoff_seconds,
