@@ -16,6 +16,12 @@
 # of tracing-off), hack/serve_smoke.sh (<60s inference-serving smoke:
 # InferenceService -> replicas ready -> open-loop burst -> autoscaler
 # scales up -> drain scales down -> SLO report printed),
+# hack/train_smoke.sh (<120s TrainJob gate: a 2-rank jax.distributed
+# gang rendezvouses via framework env + cluster DNS, trains the LM
+# with periodic Orbax checkpoints to a shared PV, survives a mid-run
+# member SIGKILL with a gang recovery round, resumes from the
+# checkpoint with strictly fewer re-run steps than scratch, and
+# ktl trace gang reconstructs the kill->recover->resume timeline),
 # hack/mon_smoke.sh (<60s kmon gate: gate-on LocalCluster scrape
 # convergence, ktl query/alerts/dash, deterministic chaos sick-chip
 # alert fire/taint/resolve, and the bounded-TSDB churn assertion),
@@ -34,6 +40,7 @@ if [ "$#" -eq 0 ] || [ "${KTPU_SMOKE:-}" = "1" ]; then
   ./hack/ha_smoke.sh
   ./hack/trace_smoke.sh
   ./hack/serve_smoke.sh
+  ./hack/train_smoke.sh
   ./hack/mon_smoke.sh
   ./hack/race.sh
 fi

@@ -1,0 +1,190 @@
+#!/usr/bin/env bash
+# Multi-host training smoke (ISSUE 14): the headline TrainJob
+# acceptance, end to end on a real LocalCluster (<120s):
+#
+#   create PVC + TrainJob -> the train controller materializes the
+#   headless Service + PodGroup + 2-rank trainer pod set -> both ranks
+#   (real OS processes) rendezvous via framework env + cluster DNS
+#   (workloads/rendezvous.py; jax.distributed over the resolved pod
+#   IPs) -> the LM trains under pjit/mesh sharding with periodic Orbax
+#   checkpoints to the shared PV -> one member is SIGKILLed mid-run ->
+#   gang recovery round (whole round torn down + recreated, counted
+#   durably in status) -> the recreated gang RESUMES from the Orbax
+#   checkpoint (resumed_step > 0, strictly fewer re-run steps than
+#   restart-from-scratch) -> completes -> `ktl trace gang` reconstructs
+#   the kill -> recover -> resume timeline from one command.
+#
+# Siblings: hack/serve_smoke.sh, hack/preempt_smoke.sh,
+# hack/queue_smoke.sh; hack/test.sh runs them all on full-suite
+# invocations.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+timeout -k 10 120 env JAX_PLATFORMS=cpu python - <<'EOF'
+import asyncio, contextlib, glob, io, json, os, signal, sys, time
+
+from kubernetes_tpu.api import training as tr, types as t
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.cli import ktl
+from kubernetes_tpu.cluster.local import LocalCluster, NodeSpec
+from kubernetes_tpu.util.features import GATES
+
+TOTAL, EVERY, WORKERS = 16, 2, 2
+
+
+async def wait_for(fn, what, timeout=60.0, interval=0.2):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while True:
+        v = await fn() if asyncio.iscoroutinefunction(fn) else fn()
+        if v:
+            return v
+        if asyncio.get_running_loop().time() > deadline:
+            raise SystemExit(f"train_smoke: timeout waiting for {what}")
+        await asyncio.sleep(interval)
+
+
+async def main() -> None:
+    GATES.set("TrainJobController", True)
+    cluster = LocalCluster(
+        nodes=[NodeSpec(name="tw-0"), NodeSpec(name="tw-1")],
+        tls=False, status_interval=0.3, heartbeat_interval=0.3)
+    base = await cluster.start()
+    client = cluster.make_client()
+    t0 = time.monotonic()
+    try:
+        await cluster.wait_for_nodes_ready(20.0)
+        await client.create(t.PersistentVolumeClaim(
+            metadata=ObjectMeta(name="ckpt", namespace="default"),
+            spec=t.PersistentVolumeClaimSpec(
+                resources=t.ResourceRequirements(
+                    requests={"storage": "1Gi"}))))
+
+        async def pvc_bound():
+            pvc = await client.get("persistentvolumeclaims", "default",
+                                   "ckpt")
+            return pvc if pvc.status.phase == t.PVC_BOUND else None
+        pvc = await wait_for(pvc_bound, "PVC bound", 20.0)
+        pv = await client.get("persistentvolumes", "",
+                              pvc.spec.volume_name)
+
+        created = await client.create(tr.TrainJob(
+            metadata=ObjectMeta(name="tj", namespace="default"),
+            spec=tr.TrainJobSpec(
+                model="lm", num_workers=WORKERS, total_steps=TOTAL,
+                checkpoint=tr.TrainCheckpointSpec(pvc="ckpt",
+                                                  every_steps=EVERY),
+                args={"STEP_DELAY": "0.3"})))
+        from kubernetes_tpu.controllers.train import group_name
+        gang = group_name(created)  # uid-suffixed incarnation
+        ckpt_dir = os.path.join(pv.spec.host_path.path, "default", gang)
+
+        # Phase 1: the gang rendezvouses and trains — the controller's
+        # marker read surfaces durable progress in status.
+        async def progressed():
+            tj = await client.get("trainjobs", "default", "tj")
+            return tj if tj.status.last_checkpoint_step >= 3 else None
+        await wait_for(progressed, "checkpoint progress (step >= 3)",
+                       75.0)
+        print(f"train_smoke: gang trained to checkpoint step >= 3 "
+              f"({time.monotonic() - t0:.1f}s)", flush=True)
+
+        # Phase 2: SIGKILL one member's real OS process mid-run.
+        pods, _ = await client.list(
+            "pods", "default",
+            label_selector=f"{tr.TRAINJOB_LABEL}=tj")
+        running = [p for p in pods if p.status.phase == t.POD_RUNNING]
+        assert running, [p.status.phase for p in pods]
+        victim = sorted(running,
+                        key=lambda p: p.metadata.labels[tr.RANK_LABEL])[-1]
+        victim_pid = None
+        for node in cluster.nodes:
+            if node.name != victim.spec.node_name:
+                continue
+            for st in await node.runtime.list_containers():
+                if st.pod_uid == victim.metadata.uid and st.pid:
+                    victim_pid = st.pid
+        assert victim_pid, "victim pid not found"
+        os.kill(victim_pid, signal.SIGKILL)
+        print(f"train_smoke: killed member {victim.metadata.name} "
+              f"(pid {victim_pid})", flush=True)
+
+        # Phase 3: gang recovery round, then completion with resume.
+        async def recovered():
+            tj = await client.get("trainjobs", "default", "tj")
+            return tj if tj.status.restart_rounds >= 1 else None
+        await wait_for(recovered, "gang recovery round", 30.0)
+
+        async def done():
+            tj = await client.get("trainjobs", "default", "tj")
+            if tj.status.phase == tr.TRAIN_FAILED:
+                raise SystemExit(f"train_smoke: job FAILED: "
+                                 f"{tj.status.message}")
+            return tj if tj.status.phase == tr.TRAIN_SUCCEEDED else None
+        tj = await wait_for(done, "job completion", 90.0)
+        st = tj.status
+        print(f"train_smoke: completed after {st.restart_rounds} "
+              f"recovery round(s), {st.resumes} resume(s), last "
+              f"checkpoint step {st.last_checkpoint_step} "
+              f"({time.monotonic() - t0:.1f}s)", flush=True)
+        assert st.restart_rounds >= 1 and st.resumes >= 1, st
+        assert st.last_checkpoint_step > 0, st
+        assert st.succeeded_workers == WORKERS, st
+
+        # Resume measurably beat restart-from-scratch: the completing
+        # attempt started past 0 and re-ran strictly fewer steps.
+        records = []
+        for path in glob.glob(os.path.join(ckpt_dir, "attempt-*.json")):
+            with open(path) as f:
+                records.append(json.load(f))
+        assert records, f"no attempt records in {ckpt_dir}"
+        resumed = [r for r in records if r["resumed_from"] > 0]
+        assert resumed, f"no resumed attempt: {records}"
+        for r in resumed:
+            # Strictly fewer re-run steps than a scratch restart's
+            # TOTAL. (The killed first attempt leaves no completion
+            # record — records are written at attempt end.)
+            assert r["steps_run"] < TOTAL, r
+        print(f"train_smoke: resumed attempt re-ran "
+              f"{min(r['steps_run'] for r in resumed)} steps vs "
+              f"{TOTAL} from scratch", flush=True)
+
+        # Phase 4: the one-command timeline — `ktl trace gang` renders
+        # the kill -> recover -> resume history (round restarts +
+        # resume events interleaved), through the real CLI path.
+        args = ktl.build_parser().parse_args(
+            ["--server", base, "trace", "gang", gang])
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = await args.fn(args)
+        out = buf.getvalue()
+        assert rc == 0 and f"GANG default/{gang}" in out, out[:400]
+        assert "ROUNDS" in out, out[:800]
+        assert "GangMemberFailed" in out, out
+        assert "ResumingFromCheckpoint" in out, out
+        print("train_smoke: ktl trace gang reconstructed the "
+              "kill->recover->resume timeline", flush=True)
+
+        # ktl get trainjobs renders the new kind.
+        args = ktl.build_parser().parse_args(
+            ["--server", base, "get", "trainjobs"])
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = await args.fn(args)
+        assert rc in (0, None) and "tj" in buf.getvalue()
+
+        # trainjob_* metric families carried the same facts.
+        from kubernetes_tpu.controllers import train as trainctl
+        key = "default/tj"
+        assert trainctl.ROUNDS_TOTAL.value(trainjob=key) >= 1
+        assert trainctl.RESUMES_TOTAL.value(trainjob=key) >= 1
+        assert trainctl.LAST_CKPT_STEP.value(trainjob=key) > 0
+    finally:
+        await client.close()
+        await cluster.stop()
+    print(f"train_smoke: OK in {time.monotonic() - t0:.1f}s", flush=True)
+
+
+asyncio.run(main())
+EOF
+
+echo "train_smoke: OK"

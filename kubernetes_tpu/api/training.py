@@ -1,0 +1,326 @@
+"""Training API — multi-host jax.distributed training as a workload.
+
+Kubeflow-TrainJob/JobSet-analog kind (reference: trainer.kubeflow.org
+TrainJob fused with the Indexed-Job gang semantics this tree already
+has; PAPERS.md "Fine-Tuning and Serving Gemma on Cloud TPU" is the
+scenario it exists for):
+
+- :class:`TrainJob` (namespaced): one gang-scheduled multi-host
+  training run — the model/workload ref, the worker count, per-worker
+  chip demand, the checkpoint contract (shared PV + cadence), and the
+  queueing/priority/elastic passthrough into the PodGroup. The train
+  controller (``controllers/train.py``) reconciles it into a headless
+  Service (rank DNS, ``net/dns.py``) plus a gang-annotated indexed pod
+  set running ``workloads/trainer.py``, where every rank discovers the
+  rank-0 coordinator through ``workloads/rendezvous.py`` and the
+  cluster's own DNS — no external coordinator.
+
+Durable progress (``status``): phase, per-rank states, restart rounds,
+resume count, and the last completed checkpoint step all ride the WAL,
+so a restarted control plane knows exactly where the gang is — the
+API-object-as-checkpoint move, as ever.
+
+Everything is gated behind ``TrainJobController`` (alpha, default
+off): with the gate off the controller is inert and the tree's
+behavior is byte-identical.
+"""
+from __future__ import annotations
+
+import datetime
+import math
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .meta import TypedObject
+from .scheme import DEFAULT_SCHEME
+from .validation import ErrorList, validate_object_meta
+
+TRAINING_V1 = "training/v1"
+
+#: Pod label joining a TrainJob to its worker pods (the selector the
+#: headless Service and the controller's bookkeeping key on).
+TRAINJOB_LABEL = "training.tpu/trainjob"
+
+#: Pod label carrying the worker's rank (stable across restart rounds;
+#: mirrors TPU_WORKER_ID).
+RANK_LABEL = "training.tpu/rank"
+
+#: Pod label carrying the WORLD SIZE the pod's rendezvous env was
+#: built for. Elastic gangs change their target between rounds; a
+#: round's members must all agree on one world, and the controller
+#: uses this label to detect a live gang built for a stale target.
+WORLD_LABEL = "training.tpu/world"
+
+#: Coordinator port every rank dials (rank 0 binds it inside
+#: ``jax.distributed.initialize``); spec.coord_port == 0 means this.
+DEFAULT_COORD_PORT = 8476
+
+#: TrainJobStatus.phase values.
+TRAIN_PENDING = "Pending"        # workers not all running yet
+TRAIN_RUNNING = "Running"        # full gang live
+TRAIN_RECOVERING = "Recovering"  # a member died; round restarting
+TRAIN_SUCCEEDED = "Succeeded"
+TRAIN_FAILED = "Failed"
+
+
+@dataclass
+class TrainCheckpointSpec:
+    """The PR-7 checkpoint contract for this job: periodic Orbax saves
+    to a shared volume, so a recovered gang resumes instead of
+    restarting from scratch."""
+
+    #: PersistentVolumeClaim (this namespace) backing the shared
+    #: checkpoint directory. "" = the node-local default base dir —
+    #: resume then only survives same-node restarts.
+    pvc: str = ""
+    #: Save cadence in steps (0 = defaulted to 10 by the controller).
+    every_steps: int = 0
+    #: Graceful-preemption grace (seconds) carried into the PodGroup's
+    #: CheckpointSpec (0 = legacy hard kill on preemption).
+    grace_seconds: float = 0.0
+
+
+@dataclass
+class TrainJobSpec:
+    #: Workload the trainer runs: "lm" (workloads/lm.py under pjit/mesh
+    #: sharding) or "demo" (the exactly-computable counting loop).
+    model: str = "lm"
+    #: Gang size — one rank per pod, all-or-nothing scheduled.
+    num_workers: int = 1
+    #: Per-worker TPU demand: chip count, or a contiguous slice shape
+    #: (shape wins when both are set). 0/empty = CPU-only workers (the
+    #: e2e tier's virtual-device mode).
+    chips_per_worker: int = 0
+    slice_shape: list[int] = field(default_factory=list)
+    #: Whole-gang contiguous sub-mesh shape (PodGroup.spec.slice_shape
+    #: passthrough; empty = no contiguity constraint).
+    gang_slice_shape: list[int] = field(default_factory=list)
+    #: Per-worker CPU request.
+    cpu_per_worker: float = 0.5
+    #: Container image ("" = the built-in host environment).
+    image: str = ""
+    #: Training length/shape knobs forwarded to the trainer env.
+    total_steps: int = 0       # defaulted to 100
+    batch: int = 0             # defaulted by the trainer per model
+    seq: int = 0
+    #: Extra env forwarded verbatim to the trainer (model-size
+    #: overrides, STEP_DELAY for chaos windows, ...). The framework's
+    #: rank/rendezvous env wins on collision — an args entry can never
+    #: scramble TPU_WORKER_ID or the coordinator contract.
+    args: dict[str, str] = field(default_factory=dict)
+    checkpoint: TrainCheckpointSpec = field(
+        default_factory=TrainCheckpointSpec)
+    #: Coordinator port (0 = DEFAULT_COORD_PORT).
+    coord_port: int = 0
+    #: Gang restart budget: a round restart past this fails the job.
+    backoff_limit: int = 6
+    #: Queueing/priority/elastic passthrough into the PodGroup.
+    queue: str = ""
+    priority: Optional[int] = None
+    min_workers: int = 0   # elastic min (0 = fixed-size gang)
+    max_workers: int = 0   # elastic max
+
+
+@dataclass
+class TrainJobStatus:
+    #: One of TRAIN_* above.
+    phase: str = TRAIN_PENDING
+    #: Live member counts (this round).
+    workers: int = 0
+    ready_workers: int = 0
+    succeeded_workers: int = 0
+    #: rank (as string — JSON object keys) -> Pending|Running|
+    #: Succeeded|Failed|Missing. The per-rank view ``ktl describe
+    #: trainjob`` renders.
+    worker_states: dict[str, str] = field(default_factory=dict)
+    #: Completed gang restart rounds (member kill -> teardown ->
+    #: recreate). Durable: counted exactly once per round, rides the
+    #: WAL so a controller crash can never double-count a round.
+    restart_rounds: int = 0
+    #: Rounds that found a checkpoint to resume from (vs restart from
+    #: scratch).
+    resumes: int = 0
+    #: Highest completed checkpoint step observed (marker or PodGroup
+    #: preemption state); -1 = none yet. Monotonic.
+    last_checkpoint_step: int = -1
+    start_time: Optional[datetime.datetime] = None
+    completion_time: Optional[datetime.datetime] = None
+    #: Operator-facing note for the last transition (round restarts,
+    #: failure reasons).
+    message: str = ""
+
+
+@dataclass
+class TrainJob(TypedObject):
+    spec: TrainJobSpec = field(default_factory=TrainJobSpec)
+    status: TrainJobStatus = field(default_factory=TrainJobStatus)
+
+
+def worker_chips(spec: TrainJobSpec) -> int:
+    """Chips one worker claims: the slice shape's volume when shaped,
+    else the flat count."""
+    if spec.slice_shape:
+        return math.prod(int(d) for d in spec.slice_shape)
+    return spec.chips_per_worker
+
+
+def coord_port(spec: TrainJobSpec) -> int:
+    return spec.coord_port or DEFAULT_COORD_PORT
+
+
+def checkpoint_every(spec: TrainJobSpec) -> int:
+    return spec.checkpoint.every_steps or 10
+
+
+def total_steps(spec: TrainJobSpec) -> int:
+    return spec.total_steps or 100
+
+
+def validate_trainjob(tj: TrainJob, is_create: bool = True) -> None:
+    errs = ErrorList()
+    validate_object_meta(tj.metadata, errs)
+    s = tj.spec
+    # Shape/type guard FIRST: the scheme passes unknown-typed JSON
+    # values through untouched, and a string where an int belongs must
+    # become a field error here — not a ValueError/TypeError that the
+    # server surfaces as a 500.
+    for fname, v in (("num_workers", s.num_workers),
+                     ("chips_per_worker", s.chips_per_worker),
+                     ("total_steps", s.total_steps),
+                     ("batch", s.batch), ("seq", s.seq),
+                     ("coord_port", s.coord_port),
+                     ("backoff_limit", s.backoff_limit),
+                     ("min_workers", s.min_workers),
+                     ("max_workers", s.max_workers)):
+        if not isinstance(v, int) or isinstance(v, bool):
+            errs.add(f"spec.{fname}", f"must be an integer (got {v!r})")
+    for fname, v in (("cpu_per_worker", s.cpu_per_worker),
+                     ("checkpoint.every_steps", s.checkpoint.every_steps),
+                     ("checkpoint.grace_seconds",
+                      s.checkpoint.grace_seconds)):
+        if not isinstance(v, (int, float)) or isinstance(v, bool):
+            errs.add(f"spec.{fname}", f"must be a number (got {v!r})")
+    if s.priority is not None and (not isinstance(s.priority, int)
+                                   or isinstance(s.priority, bool)):
+        # Flows verbatim into PodGroup.spec.priority, which the
+        # fair-share sort negates — a string here would wedge
+        # admission for the whole queue, not just this job.
+        errs.add("spec.priority", f"must be an integer or null "
+                                  f"(got {s.priority!r})")
+    for fname, v in (("model", s.model), ("queue", s.queue),
+                     ("image", s.image)):
+        if not isinstance(v, str):
+            errs.add(f"spec.{fname}", f"must be a string (got {v!r})")
+    for fname, shape in (("slice_shape", s.slice_shape),
+                         ("gang_slice_shape", s.gang_slice_shape)):
+        for d in shape:
+            if not isinstance(d, int) or isinstance(d, bool):
+                errs.add(f"spec.{fname}",
+                         f"dimension {d!r} must be an integer")
+    for k, v in s.args.items():
+        # args become process env verbatim; a numeric JSON value
+        # (args: {"STEP_DELAY": 0.3}) would crash every worker at
+        # spawn (subprocess env must be str->str) and burn the whole
+        # backoff budget on recovery rounds.
+        if not isinstance(k, str) or not isinstance(v, str):
+            errs.add("spec.args",
+                     f"{k!r}: keys and values must be strings "
+                     f"(quote numbers: \"0.3\")")
+    errs.raise_if_any("TrainJob", tj.metadata.name)
+    if s.model not in ("lm", "demo"):
+        # Reject at admission: an unknown model would pass every layer,
+        # rendezvous the full gang, crash, and burn the whole backoff
+        # budget on recovery rounds before failing.
+        errs.add("spec.model",
+                 f"must be one of 'lm', 'demo' (got {s.model!r})")
+    if s.num_workers < 1:
+        errs.add("spec.num_workers", "must be >= 1")
+    if s.chips_per_worker < 0:
+        errs.add("spec.chips_per_worker", "must be >= 0")
+    for fname, shape in (("slice_shape", s.slice_shape),
+                         ("gang_slice_shape", s.gang_slice_shape)):
+        for d in shape:
+            if d <= 0:
+                errs.add(f"spec.{fname}", f"dimension {d!r} must be > 0")
+    if s.slice_shape and s.chips_per_worker and \
+            worker_chips(s) != s.chips_per_worker:
+        errs.add("spec.chips_per_worker",
+                 f"contradicts slice_shape volume {worker_chips(s)} "
+                 f"(set one; the shape wins when both are given)")
+    if s.cpu_per_worker < 0 or not math.isfinite(s.cpu_per_worker):
+        errs.add("spec.cpu_per_worker", "must be finite and >= 0")
+    if s.total_steps < 0:
+        errs.add("spec.total_steps", "must be >= 0 (0 = default)")
+    if s.batch < 0 or s.seq < 0:
+        errs.add("spec.batch", "batch/seq must be >= 0 (0 = default)")
+    if s.coord_port < 0 or s.coord_port > 65535:
+        errs.add("spec.coord_port", "must be a port number")
+    if s.backoff_limit < 0:
+        errs.add("spec.backoff_limit", "must be >= 0")
+    ck = s.checkpoint
+    if ck.every_steps < 0:
+        errs.add("spec.checkpoint.every_steps", "must be >= 0 (0 = default)")
+    if not math.isfinite(ck.grace_seconds) or ck.grace_seconds < 0:
+        errs.add("spec.checkpoint.grace_seconds", "must be finite and >= 0")
+    if s.min_workers or s.max_workers:
+        if not 1 <= s.min_workers <= s.max_workers:
+            errs.add("spec.min_workers",
+                     "elastic sizing needs 1 <= min_workers <= max_workers")
+        elif s.max_workers != s.num_workers:
+            errs.add("spec.max_workers",
+                     f"must equal num_workers ({s.num_workers}) — the gang "
+                     f"is created at full size and shrinks elastically")
+    errs.raise_if_any("TrainJob", tj.metadata.name)
+
+
+def validate_trainjob_update(new: TrainJob, old: TrainJob) -> None:
+    validate_trainjob(new, is_create=False)
+    from .errors import InvalidError
+    if (new.spec.num_workers != old.spec.num_workers
+            or new.spec.slice_shape != old.spec.slice_shape
+            or new.spec.chips_per_worker != old.spec.chips_per_worker):
+        # Reshaping a live gang would mix ranks with different world
+        # sizes behind one rendezvous; require delete/recreate (the
+        # Kubeflow operators treat replica counts the same way).
+        raise InvalidError(
+            f"TrainJob {new.metadata.name!r}: gang geometry "
+            f"(spec.num_workers / per-worker chip demand) is immutable "
+            f"(delete and recreate to reshape)")
+    if (new.spec.gang_slice_shape != old.spec.gang_slice_shape
+            or new.spec.queue != old.spec.queue
+            or new.spec.priority != old.spec.priority
+            or new.spec.min_workers != old.spec.min_workers
+            or new.spec.max_workers != old.spec.max_workers
+            or new.spec.checkpoint.grace_seconds
+            != old.spec.checkpoint.grace_seconds):
+        # These pass through into the PodGroup at creation and are
+        # never re-reconciled into a live group — accepting an edit
+        # here would silently do nothing (honest contract: refuse).
+        raise InvalidError(
+            f"TrainJob {new.metadata.name!r}: PodGroup passthrough "
+            f"fields (gang_slice_shape/queue/priority/min_workers/"
+            f"max_workers/checkpoint.grace_seconds) are immutable "
+            f"(delete and recreate to change gang placement)")
+    if new.spec.checkpoint.pvc != old.spec.checkpoint.pvc:
+        # The resolved volume path is frozen into every worker's env
+        # (and cached controller-side); repointing a live job would
+        # split checkpoints across volumes and break resume.
+        raise InvalidError(
+            f"TrainJob {new.metadata.name!r}: spec.checkpoint.pvc is "
+            f"immutable (delete and recreate to move the checkpoint "
+            f"volume)")
+    from dataclasses import replace
+    if replace(new.spec, backoff_limit=old.spec.backoff_limit) \
+            != old.spec:
+        # Everything else (model, training knobs, args, coord_port,
+        # image, cpu) is frozen into each worker pod's env/spec at
+        # creation: a single-rank recreate after an edit would desync
+        # the gang (wrong port, wrong step count, wrong model tree).
+        # Only the restart budget is a pure controller-side knob.
+        raise InvalidError(
+            f"TrainJob {new.metadata.name!r}: spec is immutable except "
+            f"spec.backoff_limit (worker env is frozen at pod "
+            f"creation; delete and recreate to change the workload)")
+
+
+DEFAULT_SCHEME.register(TRAINING_V1, "TrainJob", TrainJob)

@@ -23,8 +23,8 @@ from typing import Any, Callable, Optional
 
 from .. import tracing
 from ..api import errors, extensions as ext, networking as net, \
-    queueing as qapi, rbac as r, serving as sapi, types as t, \
-    validation as val, workloads as w
+    queueing as qapi, rbac as r, serving as sapi, training as tapi, \
+    types as t, validation as val, workloads as w
 from ..api.meta import ObjectMeta, TypedObject, now, stamp as meta_stamp, \
     stamp_new
 from ..api.scheme import DEFAULT_SCHEME, Scheme, from_dict, to_dict
@@ -160,6 +160,10 @@ def builtin_resources() -> list[ResourceSpec]:
                      sapi.SERVING_V1, sapi.InferenceService,
                      validate_create=sapi.validate_inferenceservice,
                      validate_update=sapi.validate_inferenceservice_update),
+        ResourceSpec("trainjobs", "TrainJob",
+                     tapi.TRAINING_V1, tapi.TrainJob,
+                     validate_create=tapi.validate_trainjob,
+                     validate_update=tapi.validate_trainjob_update),
         ResourceSpec("replicasets", "ReplicaSet", "apps/v1", w.ReplicaSet,
                      validate_create=val.validate_replicaset),
         ResourceSpec("deployments", "Deployment", "apps/v1", w.Deployment,

@@ -26,6 +26,7 @@ import argparse
 import asyncio
 import json
 import os
+import re
 import signal
 import sys
 from typing import Any, Optional
@@ -56,6 +57,7 @@ ALIASES = {
     "clusterqueue": "clusterqueues", "cq": "clusterqueues",
     "localqueue": "localqueues", "lq": "localqueues",
     "inferenceservice": "inferenceservices", "isvc": "inferenceservices",
+    "trainjob": "trainjobs", "tj": "trainjobs",
     "event": "events", "ev": "events",
     "quota": "resourcequotas", "resourcequota": "resourcequotas",
     "hpa": "horizontalpodautoscalers",
@@ -1603,15 +1605,19 @@ async def _fetch_trace_spans(client, trace_id: str = "",
     return data.get("spans", [])
 
 
-async def _pod_events(client, namespace: str, pod, trace_id: str) -> list:
+async def _pod_events(client, namespace: str, pod, trace_id: str,
+                      events: Optional[list] = None) -> list:
     """(epoch ts, text, in_trace) for the pod's Events — interleaved
     into the trace rendering; ``in_trace`` marks events whose
-    trace.tpu/trace-id annotation matches (the recorder's breadcrumb)."""
+    trace.tpu/trace-id annotation matches (the recorder's breadcrumb).
+    ``events``: a pre-fetched namespace event list to filter instead
+    of LISTing again (the gang path shares one fetch)."""
     from .. import tracing
-    try:
-        events, _ = await client.list("events", namespace)
-    except errors.StatusError:
-        return []
+    if events is None:
+        try:
+            events, _ = await client.list("events", namespace)
+        except errors.StatusError:
+            return []
     out = []
     for ev in events:
         ref = ev.involved_object
@@ -1626,6 +1632,67 @@ async def _pod_events(client, namespace: str, pod, trace_id: str) -> list:
                     bool(trace_id) and tagged == trace_id))
     out.sort()
     return out
+
+
+def _gang_round_timeline(group, members: list, events: list) -> list:
+    """(epoch, text) rows reconstructing the gang's kill -> recover ->
+    resume history from durable state: ``status.preemption`` round
+    transitions interleaved with the restart/create/delete Events of
+    the group, its member pods, and its controller owner (TrainJob or
+    Job) — so the whole timeline reads from one command even when the
+    members themselves are untraced."""
+    rows = []
+    st = group.status.preemption
+    if st is not None:
+        if st.signaled_time is not None:
+            rows.append((st.signaled_time.timestamp(),
+                         f"preemption round signaled "
+                         f"({len(st.signaled)} members, "
+                         f"{len(st.checkpointed)} checkpointed)"))
+        if st.requeued_time is not None:
+            step = (f" checkpoint_step={st.checkpoint_step}"
+                    if st.checkpoint_step >= 0 else "")
+            rows.append((st.requeued_time.timestamp(),
+                         f"preemption round requeued "
+                         f"outcome={st.outcome or '<none>'}{step} "
+                         f"(rounds={st.rounds})"))
+    names = {group.metadata.name} | {p.metadata.name for p in members}
+    # Prior-round members are deleted, so their kill/failure Events
+    # can't be matched by the CURRENT pod list — match Pod events by
+    # the controllers' exact generated shape `<owner>-<rank>-<hex6>`
+    # instead (anchored: a SIBLING job named `<owner>-2` generates
+    # `<owner>-2-<rank>-<hex6>`, which must not leak into this view).
+    member_pats = []
+    for ref in group.metadata.owner_references:
+        if ref.controller:
+            names.add(ref.name)
+            member_pats.append(re.compile(
+                rf"^{re.escape(ref.name)}-\d+-[0-9a-f]{{6}}$"))
+    for p in members:
+        ts = p.metadata.creation_timestamp
+        if ts is not None:
+            rank = p.metadata.labels.get("training.tpu/rank", "")
+            rank_note = f" rank={rank}" if rank else ""
+            rows.append((ts.timestamp(),
+                         f"member {p.metadata.name} created"
+                         f"{rank_note} (phase {p.status.phase})"))
+    for ev in events:
+        ref = ev.involved_object
+        if ref.name not in names and not (
+                ref.kind == "Pod"
+                and any(p.match(ref.name) for p in member_pats)):
+            continue
+        ts = ev.first_timestamp
+        if ts is None:
+            # No orderable time: a 0.0 epoch would become the t0
+            # anchor and turn every printed offset into epoch scale.
+            continue
+        rows.append((ts.timestamp(),
+                     f"{ev.type} {ev.reason} "
+                     f"[{ev.involved_object.kind}/"
+                     f"{ev.involved_object.name}]: {ev.message}"))
+    rows.sort()
+    return rows
 
 
 def _render_trace(title: str, trace_id: str, spans: list,
@@ -1720,13 +1787,35 @@ async def cmd_trace(args) -> int:
             return 0
         # gang: per-member stage summary + the slowest member's detail.
         from ..tracing import timeline as tlmod
-        group = await client.get("podgroups", args.namespace, args.name)
         pods, _ = await client.list("pods", args.namespace)
         members = sorted((p for p in pods if p.spec.gang == args.name),
                          key=lambda p: p.metadata.name)
-        if not members:
-            raise SystemExit(f"ktl: gang {args.namespace}/{args.name} "
-                             f"has no member pods")
+        try:
+            group = await client.get("podgroups", args.namespace,
+                                     args.name)
+        except errors.NotFoundError:
+            # A queued gang's PodGroup is DELETED at terminal (the
+            # quota-release rule) while the member pods survive — the
+            # timeline must still render. Synthesize a shell group and
+            # graft the controller owner from a member so the Events
+            # filter keeps working; with no members either, there is
+            # genuinely nothing to show.
+            if not members:
+                raise SystemExit(
+                    f"ktl: gang {args.namespace}/{args.name} not found "
+                    f"(no PodGroup and no member pods)")
+            from ..api import types as _t
+            from ..api.meta import get_controller_of
+            group = _t.PodGroup(metadata=_t.ObjectMeta(
+                name=args.name, namespace=args.namespace))
+            owner = get_controller_of(members[0])
+            if owner is not None:
+                group.metadata.owner_references = [owner]
+            group.status.phase = "<released>"
+        # Zero members is a REAL state worth rendering — a recovery
+        # round's teardown window, or a cleaned-up finished gang: the
+        # ROUNDS timeline below still reconstructs the history from
+        # status.preemption and the surviving Events.
         rows, timelines = [], {}
         for p in members:
             ctx = tracing.context_of(p)
@@ -1751,13 +1840,27 @@ async def cmd_trace(args) -> int:
                 f"{dur.get('schedule', 0.0):.1f}ms",
                 f"{dur.get('bind', 0.0):.1f}ms",
                 f"{dur.get('start', 0.0):.1f}ms"])
+        # One event fetch for the whole command: the ROUNDS timeline
+        # and the slowest-member detail filter the same list.
+        try:
+            ns_events, _ = await client.list("events", args.namespace)
+        except errors.StatusError:
+            ns_events = []
+        rounds = _gang_round_timeline(group, members, ns_events)
         if args.output == "json":
+            st = group.status.preemption
             print(json.dumps({
                 "gang": f"{args.namespace}/{args.name}",
                 "phase": group.status.phase,
                 "members": {name: tline
                             for name, (_c, _s, tline)
                             in timelines.items()},
+                "rounds": [{"time": ts, "what": text}
+                           for ts, text in rounds],
+                "preemption": None if st is None else {
+                    "phase": st.phase, "rounds": st.rounds,
+                    "outcome": st.outcome,
+                    "checkpoint_step": st.checkpoint_step},
             }, default=str))
             return 0
         print(f"GANG {args.namespace}/{args.name}  "
@@ -1765,6 +1868,13 @@ async def cmd_trace(args) -> int:
         print(printers.render_table(
             ["POD", "TRACE", "E2E", "QUEUE", "SCHEDULE", "BIND",
              "START"], rows))
+        if rounds:
+            # The kill -> recover -> resume history: preemption round
+            # transitions + restart events, one time-ordered view.
+            t0 = rounds[0][0]
+            print("\nROUNDS")
+            for ts, text in rounds:
+                print(f"  {1e3 * (ts - t0):10.1f}ms  {text}")
         if timelines:
             slowest = max(timelines.items(),
                           key=lambda kv: kv[1][2]["e2e_ms"])
@@ -1773,7 +1883,7 @@ async def cmd_trace(args) -> int:
             events = await _pod_events(
                 client, args.namespace,
                 next(p for p in members if p.metadata.name == name),
-                ctx.trace_id)
+                ctx.trace_id, events=ns_events)
             print(_render_trace(f"pod {args.namespace}/{name}",
                                 ctx.trace_id, spans, events))
         return 0

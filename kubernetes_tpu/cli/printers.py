@@ -269,6 +269,61 @@ def describe_inferenceservice(isvc) -> str:
     return "\n".join(lines) + _describe_fields(isvc)
 
 
+def _trainjobs_table(objs: list, wide: bool) -> str:
+    headers = ["NAME", "MODEL", "WORKERS", "READY", "PHASE", "ROUNDS",
+               "RESUMES", "CKPT-STEP", "AGE"]
+    if wide:
+        headers += ["CHIPS/WORKER", "QUEUE"]
+    rows = []
+    for o in objs:
+        st, sp = o.status, o.spec
+        row = [o.metadata.name, sp.model or "<none>",
+               sp.num_workers,
+               f"{st.ready_workers}/{sp.num_workers}",
+               st.phase, st.restart_rounds, st.resumes,
+               (st.last_checkpoint_step
+                if st.last_checkpoint_step >= 0 else "<none>"),
+               age(o.metadata)]
+        if wide:
+            from ..api.training import worker_chips
+            row += [worker_chips(sp) or "<none>", sp.queue or "<none>"]
+        rows.append(row)
+    return render_table(headers, rows)
+
+
+def describe_trainjob(tj) -> str:
+    """Training summary: gang shape + round/resume/checkpoint state +
+    the per-rank view, then the generic field dump."""
+    sp, st = tj.spec, tj.status
+    from ..api.training import worker_chips
+    lines = [f"Name: {tj.metadata.name}",
+             f"Model: {sp.model or '<none>'}",
+             f"Workers: {st.ready_workers}/{sp.num_workers} ready "
+             f"(phase {st.phase})",
+             f"Per worker: {worker_chips(sp)} chips"
+             + (f" (shape {'x'.join(map(str, sp.slice_shape))})"
+                if sp.slice_shape else ""),
+             f"Rounds: {st.restart_rounds} restarts, {st.resumes} "
+             f"resumed from checkpoint",
+             "Last checkpoint step: "
+             + (str(st.last_checkpoint_step)
+                if st.last_checkpoint_step >= 0 else "<none>")]
+    if sp.checkpoint.pvc:
+        from ..api.training import checkpoint_every
+        lines.append(f"Checkpoint volume: pvc/{sp.checkpoint.pvc} "
+                     f"(every {checkpoint_every(sp)} steps)")
+    if sp.queue:
+        lines.append(f"Queue: {sp.queue}")
+    if st.worker_states:
+        lines.append("Ranks:")
+        for rank in sorted(st.worker_states, key=int):
+            lines.append(f"  {rank}: {st.worker_states[rank]}")
+    if st.message:
+        lines.append(f"Message: {st.message}")
+    lines.append("")
+    return "\n".join(lines) + _describe_fields(tj)
+
+
 def _services_table(objs: list, wide: bool) -> str:
     rows = [[o.metadata.name, o.spec.cluster_ip or "<none>",
              ",".join(f"{p.port}/{p.protocol or 'TCP'}"
@@ -300,6 +355,7 @@ PRINTERS: dict[str, Callable[[list, bool], str]] = {
     "clusterqueues": _clusterqueues_table,
     "localqueues": _localqueues_table,
     "inferenceservices": _inferenceservices_table,
+    "trainjobs": _trainjobs_table,
     "services": _services_table,
     "events": _events_table,
 }
@@ -321,6 +377,8 @@ def describe(obj: Any) -> str:
         return describe_podgroup(obj)
     if type(obj).__name__ == "InferenceService":
         return describe_inferenceservice(obj)
+    if type(obj).__name__ == "TrainJob":
+        return describe_trainjob(obj)
     return _describe_fields(obj)
 
 

@@ -84,6 +84,21 @@ def _inference_controller(client, factory, **kw):
 DEFAULT_CONTROLLERS["inference"] = _inference_controller
 
 
+def _train_controller(client, factory, **kw):
+    # Lazy like the monitor: training/ machinery is only paid for when
+    # built (the controller is inert with the TrainJobController gate
+    # off).
+    from .train import TrainJobController
+    return TrainJobController(client, factory, **kw)
+
+
+#: Multi-host training (training/v1): reconcile TrainJobs into a
+#: headless Service + PodGroup + indexed trainer pod set with gang
+#: recovery + checkpoint resume; inert unless the TrainJobController
+#: gate is on.
+DEFAULT_CONTROLLERS["train"] = _train_controller
+
+
 def _cluster_monitor(client, factory, **kw):
     # Imported lazily: monitoring/ pulls in aiohttp-scrape machinery a
     # controller-only process may never use.

@@ -1,0 +1,637 @@
+"""TrainJob controller — gang-scheduled multi-host training runs.
+
+Reference shape: the Kubeflow training-operator's TrainJob/JobSet
+reconciler fused with this tree's gang semantics. One TrainJob becomes:
+
+- a **headless Service** (per-rank DNS identity — ``net/dns.py``
+  answers ``<hostname>.<svc>.<ns>.svc.<domain>`` from Endpoints, so
+  ``workloads/rendezvous.py`` can resolve rank 0's pod IP with no
+  external coordinator),
+- a **PodGroup** (all-or-nothing placement, queue/priority/elastic/
+  checkpoint passthrough), and
+- an **indexed worker pod set** (one pod per rank, Indexed-Job-style:
+  stable hostname + ``TPU_WORKER_ID``/``TPU_WORKER_HOSTNAMES``/
+  ``KTPU_COORD_PORT`` env) running ``workloads/trainer.py``.
+
+**Gang recovery**: a failed member tears down the whole round —
+every worker is deleted and the next sync recreates the full set
+(counted against ``spec.backoff_limit``). Because the trainer
+checkpoints periodically to the shared PV (the PR 7 contract), the
+recreated gang *resumes* from the last completed step instead of
+restarting; ``status.restart_rounds`` / ``status.resumes`` /
+``status.last_checkpoint_step`` make the round durable in the API
+object (rides the WAL — a restarted controller can never re-count a
+round or forget one).
+
+Everything is inert while the ``TrainJobController`` gate is off —
+no API traffic, byte-identical to the ungated build.
+"""
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+from ..api import errors
+from ..api import training as tr
+from ..api import types as t
+from ..api.meta import controller_ref, is_controlled_by, now
+from ..api.scheme import deepcopy
+from ..client.informer import InformerFactory
+from ..client.interface import Client
+from ..metrics.registry import Counter, Gauge
+from .base import (Controller, PodControl, is_pod_active, is_pod_ready,
+                   merge_container_env, rank_hostnames)
+
+log = logging.getLogger("train")
+
+#: trainjob_* metric families (the tpuvet fixture set): restart
+#: rounds, checkpoint resumes, last durable step, and the rank-ready
+#: gauge the smoke/bench read.
+ROUNDS_TOTAL = Counter("trainjob_restart_rounds_total",
+                       "completed gang recovery rounds",
+                       labels=("trainjob",))
+RESUMES_TOTAL = Counter("trainjob_resumes_total",
+                        "recovery rounds that resumed from a checkpoint",
+                        labels=("trainjob",))
+LAST_CKPT_STEP = Gauge("trainjob_last_checkpoint_step",
+                       "highest completed checkpoint step (-1 = none)",
+                       labels=("trainjob",))
+WORKERS_READY = Gauge("trainjob_workers_ready",
+                      "worker pods currently ready",
+                      labels=("trainjob",))
+
+
+def _gated() -> bool:
+    from ..util.features import GATES
+    return GATES.enabled("TrainJobController")
+
+
+def group_name(tj: tr.TrainJob) -> str:
+    """Gang name — and therefore the checkpoint-directory key
+    (``<base>/<ns>/<gang>`` via the agent's KTPU_JOB_NAME injection).
+    UID-suffixed so the delete-and-recreate workflow the immutability
+    validators mandate gets a FRESH checkpoint directory: resuming a
+    new incarnation from the old job's (possibly reshaped) Orbax tree
+    would crash every rank through the whole backoff budget."""
+    return f"train-{tj.metadata.name}-{tj.metadata.uid[:6]}"
+
+
+def service_name(tj: tr.TrainJob) -> str:
+    return f"{tj.metadata.name}-workers"
+
+
+class TrainJobController(Controller):
+    name = "train-controller"
+
+    def __init__(self, client: Client, factory: InformerFactory):
+        super().__init__(client, factory, workers=1)
+        self.pod_control = PodControl(client, self.recorder)
+        #: TrainJob key -> resolved checkpoint host path. The PVC->PV
+        #: host-path mapping is immutable once Bound, so re-deriving
+        #: it with two API GETs on every 1s resync is pure waste;
+        #: unresolved ("") results are NOT cached (binding is pending).
+        self._ckpt_base: dict[str, str] = {}
+        #: TrainJob keys whose headless Service is known to exist —
+        #: same rationale: a per-tick existence GET per live job is
+        #: pure churn for an object created once and never reconciled.
+        self._svc_ensured: dict[str, None] = {}
+        self.tj_informer = self.watch("trainjobs")
+        self.pod_informer = self.watch("pods")
+        self.group_informer = self.watch("podgroups")
+        self.tj_informer.add_handlers(
+            on_add=self.enqueue_obj,
+            on_update=lambda o, n: self.enqueue_obj(n),
+            on_delete=self._drop_series)
+        self.pod_informer.add_handlers(
+            on_add=self._pod_event, on_delete=self._pod_event,
+            on_update=lambda o, n: self._pod_event(n))
+        self.group_informer.add_handlers(
+            on_update=lambda o, n: self._group_event(n))
+
+    def _pod_event(self, pod: t.Pod) -> None:
+        tj = pod.metadata.labels.get(tr.TRAINJOB_LABEL)
+        if tj:
+            self.enqueue(f"{pod.metadata.namespace}/{tj}")
+
+    def _group_event(self, group: t.PodGroup) -> None:
+        self.enqueue_owner(group, "TrainJob")
+
+    def _drop_series(self, tj) -> None:
+        self._ckpt_base.pop(tj.key(), None)
+        self._svc_ensured.pop(tj.key(), None)
+        for m in (LAST_CKPT_STEP, WORKERS_READY,
+                  ROUNDS_TOTAL, RESUMES_TOTAL):
+            m.remove(trainjob=tj.key())
+
+    # -- reconcile --------------------------------------------------------
+
+    async def sync(self, key: str) -> Optional[float]:
+        if not _gated():
+            return None
+        tj = self.tj_informer.get(key)
+        if tj is None or tj.metadata.deletion_timestamp is not None:
+            return None  # owner refs cascade Service/PodGroup/pods
+        if tj.status.phase in (tr.TRAIN_SUCCEEDED, tr.TRAIN_FAILED):
+            # Level-triggered teardown: the podgroup delete AND the
+            # worker deletes in the terminal transition can be lost to
+            # a crash — re-issuing keeps a finished gang from holding
+            # its queue slot or leaking still-running members (a
+            # Failed write can land with the teardown loop unexecuted).
+            await self._delete_podgroup(tj)
+            for p in self._member_pods(tj):
+                if is_pod_active(p):
+                    await self.pod_control.delete_pod(tj, p)
+            return None
+        await self._ensure_service(tj)
+        await self._ensure_podgroup(tj)
+        return await self._sync_workers(tj)
+
+    # -- discovery substrate ---------------------------------------------
+
+    def _selector_labels(self, tj) -> dict:
+        return {tr.TRAINJOB_LABEL: tj.metadata.name}
+
+    async def _ensure_service(self, tj) -> None:
+        ns = tj.metadata.namespace
+        if tj.key() in self._svc_ensured:
+            return
+        try:
+            # GET-first (the inference controller's pattern): sync
+            # polls every second while the job lives, and a guaranteed
+            # 409 POST per tick is pure apiserver churn. Existence is
+            # then cached — one probe per controller incarnation.
+            await self.client.get("services", ns, service_name(tj))
+            self._svc_ensured[tj.key()] = None
+            return
+        except errors.NotFoundError:
+            pass
+        svc = t.Service(
+            metadata=t.ObjectMeta(
+                name=service_name(tj), namespace=ns,
+                labels=self._selector_labels(tj),
+                owner_references=[controller_ref(
+                    tj, tr.TRAINING_V1, "TrainJob")]),
+            spec=t.ServiceSpec(
+                # Headless: DNS answers per-rank A records straight
+                # from Endpoints — the rendezvous substrate, no VIP.
+                cluster_ip="None",
+                selector=dict(self._selector_labels(tj)),
+                ports=[t.ServicePort(name="coord",
+                                     port=tr.coord_port(tj.spec),
+                                     target_port=tr.coord_port(tj.spec))]))
+        try:
+            await self.client.create(svc)
+            self.recorder.event(tj, "Normal", "CreatedService",
+                                f"created headless service "
+                                f"{service_name(tj)}")
+        except errors.AlreadyExistsError:
+            pass
+        self._svc_ensured[tj.key()] = None
+
+    async def _ensure_podgroup(self, tj) -> None:
+        ns, name = tj.metadata.namespace, group_name(tj)
+        if self.group_informer.get(f"{ns}/{name}") is not None:
+            return
+        s = tj.spec
+        # Explicit admission demand: the queue charge must reflect the
+        # real per-worker chip/CPU footprint — without this a queued
+        # gang using chips_per_worker (no gang_slice_shape to fall
+        # back on) would admit at ZERO charge and bypass fair share.
+        resources: dict[str, float] = {
+            t.RESOURCE_CPU: s.cpu_per_worker * s.num_workers}
+        chips_total = tr.worker_chips(s) * s.num_workers
+        if chips_total:
+            resources[t.RESOURCE_TPU] = float(chips_total)
+        group = t.PodGroup(
+            metadata=t.ObjectMeta(
+                name=name, namespace=ns,
+                owner_references=[controller_ref(
+                    tj, tr.TRAINING_V1, "TrainJob")]),
+            spec=t.PodGroupSpec(
+                # Elastic gangs quorum at their minimum viable size
+                # (validation requires min_member <= min_replicas);
+                # fixed gangs are all-or-nothing at full size.
+                min_member=s.min_workers or s.num_workers,
+                slice_shape=list(s.gang_slice_shape),
+                priority=s.priority,
+                queue=s.queue,
+                resources=resources,
+                min_replicas=s.min_workers,
+                max_replicas=s.max_workers))
+        if s.checkpoint.grace_seconds > 0:
+            group.spec.checkpoint = t.CheckpointSpec(
+                grace_seconds=s.checkpoint.grace_seconds)
+        try:
+            await self.client.create(group)
+        except errors.AlreadyExistsError:
+            pass
+
+    async def _delete_podgroup(self, tj) -> None:
+        """Terminal TrainJob: release the gang's QUEUE hold — a queued
+        PodGroup's lifetime IS its quota charge (the Job controller's
+        rule). Unqueued groups stay for observability (`ktl trace
+        gang`, `describe podgroup`) and ride owner-ref GC when the
+        TrainJob itself is deleted."""
+        from ..util.features import GATES
+        if not GATES.enabled("JobQueueing"):
+            return  # no admission machinery = no quota hold to release
+        ns = tj.metadata.namespace
+        group = self.group_informer.get(f"{ns}/{group_name(tj)}")
+        if group is None or not group.spec.queue:
+            return
+        try:
+            await self.client.delete("podgroups", ns, group_name(tj))
+        except errors.NotFoundError:
+            pass
+
+    # -- checkpoint contract ----------------------------------------------
+
+    async def _checkpoint_base(self, tj) -> str:
+        """Host path of the shared checkpoint volume (the PR 7
+        contract): PVC -> bound PV -> host_path. "" while unbound or
+        claimless — workers then fall back to the node-local default
+        base and resume only survives same-node restarts."""
+        claim = tj.spec.checkpoint.pvc
+        if not claim:
+            return ""
+        cached = self._ckpt_base.get(tj.key())
+        if cached:
+            return cached
+        try:
+            pvc = await self.client.get(
+                "persistentvolumeclaims", tj.metadata.namespace, claim)
+        except errors.NotFoundError:
+            return ""
+        if pvc.status.phase != t.PVC_BOUND or not pvc.spec.volume_name:
+            return ""
+        try:
+            pv = await self.client.get(
+                "persistentvolumes", "", pvc.spec.volume_name)
+        except errors.NotFoundError:
+            return ""
+        if pv.spec.host_path is not None:
+            self._ckpt_base[tj.key()] = pv.spec.host_path.path
+            return pv.spec.host_path.path
+        return ""
+
+    def _ckpt_dir(self, tj, base: str) -> str:
+        """The exact path every worker computes (checkpoint.py
+        checkpoint_dir: <base>/<KTPU_JOB_NAME>, job = <ns>/<gang>)."""
+        from ..preemption import job_checkpoint_dir
+        return job_checkpoint_dir(
+            f"{tj.metadata.namespace}/{group_name(tj)}", base)
+
+    def _marker_step(self, tj, base: str) -> int:
+        """Best-effort read of the trainer-published checkpoint-
+        complete marker on the shared volume (single-binary / co-hosted
+        deployments; a remote controller-manager reads -1 here and
+        falls back to the PodGroup's durable preemption step)."""
+        if not base:
+            return -1
+        from ..preemption import read_marker
+        step = read_marker(self._ckpt_dir(tj, base))  # None-safe reader
+        return step if step is not None else -1
+
+    # -- worker pods -------------------------------------------------------
+
+    def _worker_pod(self, tj, rank: int, ckpt_base: str,
+                    world: int) -> t.Pod:
+        import sys
+        s = tj.spec
+        name, ns = tj.metadata.name, tj.metadata.namespace
+        container = t.Container(
+            name="trainer", image=s.image,
+            command=[sys.executable, "-m",
+                     "kubernetes_tpu.workloads.trainer"],
+            resources=t.ResourceRequirements(
+                requests={t.RESOURCE_CPU: s.cpu_per_worker}))
+        chips = tr.worker_chips(s)
+        pod_spec = t.PodSpec(
+            restart_policy=t.RESTART_NEVER,
+            hostname=f"{name}-{rank}",
+            subdomain=service_name(tj),
+            gang=group_name(tj),
+            # Recovery rounds wait for the FULL old round to leave the
+            # store before recreating; the trainer exits promptly on
+            # SIGTERM (durability comes from the periodic saves + the
+            # preemption protocol, not eviction grace), so the default
+            # 30s would just stall every round restart.
+            termination_grace_period_seconds=5,
+            containers=[container])
+        if chips > 0:
+            pod_spec.tpu_resources = [t.PodTpuRequest(
+                name="tpu", chips=chips, slice_shape=list(s.slice_shape))]
+            container.tpu_requests = ["tpu"]
+        if s.checkpoint.pvc:
+            # The shared checkpoint volume rides the pod spec (a PVC
+            # that never binds fails the start visibly — FailedMount —
+            # instead of silently training without durability).
+            pod_spec.volumes = [t.Volume(
+                name="ckpt", persistent_volume_claim=t.
+                PersistentVolumeClaimVolume(claim_name=s.checkpoint.pvc))]
+            container.volume_mounts = [t.VolumeMount(
+                name="ckpt", mount_path="/ckpt")]
+        # Framework rank env (the rendezvous contract) goes FIRST:
+        # spec.args is merged after, so a colliding user value can
+        # never scramble a rank's identity or coordinator address.
+        rank_env = [
+            t.EnvVar(name="TPU_WORKER_ID", value=str(rank)),
+            t.EnvVar(name="TPU_WORKER_HOSTNAMES", value=rank_hostnames(
+                name, world, service_name(tj), ns)),
+            t.EnvVar(name="KTPU_COORD_PORT",
+                     value=str(tr.coord_port(s))),
+            t.EnvVar(name="MODEL", value=s.model),
+            t.EnvVar(name="TOTAL_STEPS", value=str(tr.total_steps(s))),
+            t.EnvVar(name="CHECKPOINT_EVERY",
+                     value=str(tr.checkpoint_every(s))),
+        ]
+        if s.batch > 0:
+            rank_env.append(t.EnvVar(name="BATCH", value=str(s.batch)))
+        if s.seq > 0:
+            rank_env.append(t.EnvVar(name="SEQ", value=str(s.seq)))
+        if ckpt_base:
+            # Every member and every incarnation computes the same
+            # <base>/<ns>/<gang> dir (workloads/checkpoint.py) — the
+            # agent-injected KTPU_JOB_NAME supplies the tail.
+            rank_env.append(t.EnvVar(name="KTPU_CHECKPOINT_DIR",
+                                     value=ckpt_base))
+        trace = os.environ.get("KTPU_TRACE", "")
+        if trace:
+            rank_env.append(t.EnvVar(name="KTPU_TRACE", value=trace))
+        container.env = rank_env
+        merge_container_env(
+            [container],
+            [t.EnvVar(name=k, value=v) for k, v in sorted(s.args.items())])
+        return t.Pod(
+            metadata=t.ObjectMeta(
+                generate_name=f"{name}-{rank}-", namespace=ns,
+                labels={**self._selector_labels(tj),
+                        tr.RANK_LABEL: str(rank),
+                        tr.WORLD_LABEL: str(world)},
+                owner_references=[controller_ref(
+                    tj, tr.TRAINING_V1, "TrainJob")]),
+            spec=pod_spec)
+
+    def _member_pods(self, tj) -> list[t.Pod]:
+        name, ns = tj.metadata.name, tj.metadata.namespace
+        return [p for p in self.pod_informer.list()
+                if p.metadata.namespace == ns
+                and p.metadata.labels.get(tr.TRAINJOB_LABEL) == name
+                and is_controlled_by(p, tj)]
+
+    def _elastic_world(self, tj) -> int:
+        """The world size the NEXT gang round runs at: the PodGroup's
+        elastic target (fair-share shrink lowers it, regrow raises it)
+        clamped to [1, num_workers]; fixed-size gangs always run full.
+        A shrunk round trains a smaller jax.distributed world resuming
+        from the shared checkpoint — not a crash-looping full gang the
+        scheduler will never fully bind."""
+        s = tj.spec
+        if not s.min_workers:
+            return s.num_workers
+        group = self.group_informer.get(
+            f"{tj.metadata.namespace}/{group_name(tj)}")
+        target = group.status.replicas if group is not None else 0
+        if target <= 0:
+            target = s.num_workers
+        return max(1, min(int(target), s.num_workers))
+
+    async def _sync_workers(self, tj) -> Optional[float]:
+        s = tj.spec
+        pods = self._member_pods(tj)
+        active = [p for p in pods if is_pod_active(p)]
+        failed = [p for p in pods if p.status.phase == t.POD_FAILED]
+        ckpt_base = await self._checkpoint_base(tj)
+        ckpt_step = self._progress_step(tj, ckpt_base)
+        world = self._elastic_world(tj)
+
+        # Completion: every rank OF THE ROUND'S WORLD has a Succeeded
+        # record (a shrunk elastic gang completes at its shrunk size —
+        # the checkpointed work, not the headcount, is the job).
+        done_ranks = {p.metadata.labels.get(tr.RANK_LABEL)
+                      for p in pods if p.status.phase == t.POD_SUCCEEDED}
+        done_world = min(int(p.metadata.labels.get(tr.WORLD_LABEL,
+                                                   s.num_workers))
+                         for p in pods
+                         if p.status.phase == t.POD_SUCCEEDED) \
+            if done_ranks else s.num_workers
+        if len(done_ranks) >= done_world:
+            await self._update_status(tj, pods, tr.TRAIN_SUCCEEDED,
+                                      ckpt_step, message="all ranks "
+                                      "completed")
+            self.recorder.event(tj, "Normal", "Completed",
+                                f"all {done_world} ranks completed")
+            await self._delete_podgroup(tj)
+            return None
+
+        # Gang recovery: a failed member kills the round. The status
+        # write (rounds += 1, phase=Recovering) is the DURABLE round
+        # marker and lands BEFORE any delete — a controller crash
+        # mid-teardown resumes the round instead of re-counting it.
+        if failed:
+            if tj.status.phase != tr.TRAIN_RECOVERING:
+                if tj.status.restart_rounds + 1 > s.backoff_limit:
+                    tj = await self._update_status(
+                        tj, pods, tr.TRAIN_FAILED, ckpt_step,
+                        message=f"member failed and restart budget "
+                                f"({s.backoff_limit}) is exhausted")
+                    if tj.status.phase != tr.TRAIN_FAILED:
+                        # Same discipline as the Recovering branch:
+                        # the terminal phase must be DURABLE before
+                        # any teardown — a conflict-lost write here
+                        # would let the next sync recreate a gang
+                        # past its restart budget.
+                        return 0.05
+                    self.recorder.event(tj, "Warning", "BackoffLimit",
+                                        "gang restart budget exhausted")
+                    for p in active:
+                        await self.pod_control.delete_pod(tj, p)
+                    await self._delete_podgroup(tj)
+                    return None
+                resumed = ckpt_step >= 0
+                want_rounds = tj.status.restart_rounds + 1
+                tj = await self._update_status(
+                    tj, pods, tr.TRAIN_RECOVERING, ckpt_step,
+                    rounds=want_rounds,
+                    resumes=tj.status.resumes + (1 if resumed else 0),
+                    message=f"member {failed[0].metadata.name} failed; "
+                            f"restarting the gang"
+                            + (f" (resuming from step {ckpt_step})"
+                               if resumed else " (no checkpoint yet)"))
+                if tj.status.restart_rounds != want_rounds:
+                    return 0.05  # stale copy lost the write; re-sync
+                ROUNDS_TOTAL.inc(trainjob=tj.key())
+                if resumed:
+                    RESUMES_TOTAL.inc(trainjob=tj.key())
+                self.recorder.event(
+                    tj, "Warning", "GangMemberFailed",
+                    f"tearing down the gang for atomic restart "
+                    f"(round {tj.status.restart_rounds})")
+                if resumed:
+                    self.recorder.event(
+                        tj, "Normal", "ResumingFromCheckpoint",
+                        f"round {tj.status.restart_rounds} will resume "
+                        f"from checkpoint step {ckpt_step}")
+            # The WHOLE round goes — succeeded ranks too: a recreated
+            # gang rendezvouses at full world size (a missing "done"
+            # rank would wedge every peer's initialize), and resume
+            # from the shared checkpoint makes re-running them cheap.
+            for p in pods:
+                await self.pod_control.delete_pod(tj, p)
+            return 0.5  # poll the teardown; recreate next pass
+
+        # Mid-recovery: the WHOLE previous round must actually be gone
+        # before any recreate. Creating replacements beside a still-
+        # Terminating survivor would run two processes for one rank
+        # (same checkpoint dir, and peers can dial the OLD coordinator
+        # and wedge their rendezvous), and a lingering Succeeded pod
+        # would hold its rank out of the new gang's world.
+        if tj.status.phase == tr.TRAIN_RECOVERING and pods:
+            for p in pods:
+                await self.pod_control.delete_pod(tj, p)
+            return 0.5
+
+        # A declared checkpoint PVC must be BOUND before any worker
+        # exists: the resolved host path rides the pod env, which is
+        # frozen at creation — a pod created early would silently
+        # checkpoint to the node-local default and resume would find
+        # nothing on the shared volume after a recovery round.
+        if s.checkpoint.pvc and not ckpt_base and not active:
+            await self._update_status(
+                tj, pods, tr.TRAIN_PENDING, ckpt_step,
+                message=f"waiting for checkpoint pvc/"
+                        f"{s.checkpoint.pvc} to bind")
+            return 0.5
+
+        # Elastic resize: a live gang built for a DIFFERENT world than
+        # the current target restarts as a unit (world size is frozen
+        # into every member's rendezvous env). Not counted against
+        # backoff_limit — a reclaim shrink or an idle-quota regrow is
+        # policy, not a failure; resume from the shared checkpoint
+        # makes the restart cheap.
+        stale_world = [p for p in active
+                       if p.metadata.labels.get(tr.WORLD_LABEL)
+                       not in ("", None, str(world))]
+        if stale_world:
+            if tj.status.phase != tr.TRAIN_RECOVERING:
+                tj = await self._update_status(
+                    tj, pods, tr.TRAIN_RECOVERING, ckpt_step,
+                    message=f"resizing gang to {world} workers "
+                            f"(elastic target moved)")
+                if tj.status.phase != tr.TRAIN_RECOVERING:
+                    return 0.05  # stale copy lost the write; re-sync
+                self.recorder.event(
+                    tj, "Normal", "GangResize",
+                    f"restarting the gang at world size {world}")
+            for p in pods:
+                await self.pod_control.delete_pod(tj, p)
+            return 0.5
+
+        # Round teardown finished (or first pass): create missing ranks.
+        live_ranks = {p.metadata.labels.get(tr.RANK_LABEL)
+                      for p in active}
+        # One live pod per rank: reap duplicates from stale-cache
+        # double creates, oldest wins (the Job controller's rule).
+        by_rank: dict[str, list] = {}
+        for p in active:
+            by_rank.setdefault(
+                p.metadata.labels.get(tr.RANK_LABEL, ""), []).append(p)
+        for rank, grp in by_rank.items():
+            grp.sort(key=lambda p: (
+                p.metadata.creation_timestamp.timestamp()
+                if p.metadata.creation_timestamp else 0.0))
+            for dup in grp[1:]:
+                await self.pod_control.delete_pod(tj, dup)
+        for rank in range(world):
+            if str(rank) in live_ranks or str(rank) in done_ranks:
+                continue
+            pod = self._worker_pod(tj, rank, ckpt_base, world)
+            await self.client.create(pod)
+        # A rank counts toward the gang when it is RUNNING or already
+        # finished — ranks exit independently after the final step, so
+        # a half-complete healthy job must not regress to Pending.
+        running_ranks = {p.metadata.labels.get(tr.RANK_LABEL)
+                         for p in active
+                         if p.status.phase == t.POD_RUNNING}
+        phase = tr.TRAIN_RUNNING if (
+            len(running_ranks | done_ranks) >= world
+            and running_ranks) else tr.TRAIN_PENDING
+        await self._update_status(tj, self._member_pods(tj), phase,
+                                  ckpt_step)
+        # Poll while live: the checkpoint marker advances outside the
+        # API (shared volume), and completion needs a timely read.
+        return 1.0
+
+    def _progress_step(self, tj, ckpt_base: str) -> int:
+        """Durable progress: the trainer's marker on the shared volume
+        when readable, else the PodGroup's preemption checkpoint step;
+        never below what status already recorded (monotonic)."""
+        step = self._marker_step(tj, ckpt_base)
+        group = self.group_informer.get(
+            f"{tj.metadata.namespace}/{group_name(tj)}")
+        if group is not None and group.status.preemption is not None:
+            step = max(step, group.status.preemption.checkpoint_step)
+        return max(step, tj.status.last_checkpoint_step)
+
+    # -- status ------------------------------------------------------------
+
+    async def _update_status(self, tj, pods, phase: str, ckpt_step: int,
+                             rounds: Optional[int] = None,
+                             resumes: Optional[int] = None,
+                             message: str = ""):
+        s = tj.spec
+        states: dict[str, str] = {}
+        for rank in range(s.num_workers):
+            states[str(rank)] = "Missing"
+        ready_ranks: set[str] = set()
+        for p in sorted(pods, key=lambda p: (
+                p.metadata.creation_timestamp.timestamp()
+                if p.metadata.creation_timestamp else 0.0)):
+            rank = p.metadata.labels.get(tr.RANK_LABEL, "")
+            if rank not in states:
+                continue
+            if p.status.phase == t.POD_SUCCEEDED:
+                states[rank] = "Succeeded"
+            elif p.status.phase == t.POD_FAILED:
+                if states[rank] == "Missing":
+                    states[rank] = "Failed"
+            elif is_pod_active(p):
+                states[rank] = p.status.phase or "Pending"
+                if is_pod_ready(p):
+                    # Per RANK, not per pod: a not-yet-reaped
+                    # duplicate must not inflate readiness past the
+                    # gang size.
+                    ready_ranks.add(rank)
+        ready = len(ready_ranks)
+        active = [p for p in pods if is_pod_active(p)]
+        new = tr.TrainJobStatus(
+            phase=phase,
+            workers=len(active),
+            ready_workers=ready,
+            succeeded_workers=sum(
+                1 for v in states.values() if v == "Succeeded"),
+            worker_states=states,
+            restart_rounds=(rounds if rounds is not None
+                            else tj.status.restart_rounds),
+            resumes=(resumes if resumes is not None
+                     else tj.status.resumes),
+            last_checkpoint_step=max(ckpt_step,
+                                     tj.status.last_checkpoint_step),
+            start_time=tj.status.start_time or now(),
+            completion_time=tj.status.completion_time,
+            message=message or tj.status.message)
+        if phase in (tr.TRAIN_SUCCEEDED, tr.TRAIN_FAILED) \
+                and new.completion_time is None:
+            new.completion_time = now()
+        LAST_CKPT_STEP.set(new.last_checkpoint_step, trainjob=tj.key())
+        WORKERS_READY.set(ready, trainjob=tj.key())
+        if new == tj.status:
+            return tj
+        fresh = deepcopy(tj)
+        fresh.status = new
+        try:
+            updated = await self.client.update(fresh, subresource="status")
+            return updated
+        except (errors.ConflictError, errors.NotFoundError):
+            return tj

@@ -71,6 +71,14 @@ class Counter(Metric):
         with self._lock:
             self._values.clear()
 
+    def remove(self, **labels) -> None:
+        """Drop one labeled series — object-scoped counters (e.g. a
+        per-TrainJob restart count) must stop being exported when the
+        object is deleted, or a churning cluster leaks one series per
+        deleted object forever."""
+        with self._lock:
+            self._values.pop(_label_key(self.label_names, labels), None)
+
     def render(self) -> str:
         lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
         with self._lock:

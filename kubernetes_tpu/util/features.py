@@ -153,6 +153,16 @@ KNOWN_FEATURES = {f.name: f for f in [
             "and the fallback (a client that never asks, or a server "
             "with the gate off, sees byte-identical JSON). Requires "
             "the msgpack wheel; without it the gate is inert"),
+    Feature("TrainJobController", False, ALPHA,
+            "multi-host jax.distributed training as a first-class "
+            "workload (training/v1 TrainJob, controllers/train.py): "
+            "reconcile a TrainJob into a headless Service + a "
+            "gang-annotated indexed worker pod set running "
+            "workloads/trainer.py, where every rank discovers the "
+            "rank-0 coordinator through workloads/rendezvous.py and "
+            "the cluster's own DNS; gang recovery rounds on member "
+            "failure with Orbax resume from the shared checkpoint "
+            "volume. Off = the controller is inert, byte-identical"),
     Feature("ClusterMonitoring", True, BETA,
             "cluster-level TPU telemetry rollup (monitoring/"
             "aggregator.py): the controller-manager scrapes node "

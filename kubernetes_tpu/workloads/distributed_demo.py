@@ -2,102 +2,25 @@
 
 ``python -m kubernetes_tpu.workloads.distributed_demo``
 
-Runs the full SURVEY §7 hard-part-3 composition inside a pod, with no
-external coordinator and no test-injected hints:
-
-1. rendezvous from framework env + cluster DNS
-   (:mod:`.rendezvous` — TPU_WORKER_ID / TPU_WORKER_HOSTNAMES /
-   KTPU_DNS_SERVER, all injected by the Job controller, agent, and
-   device plugin),
-2. a sharded train-ish loop over the global ``dp`` mesh (one jit'd
-   step whose input is built with
-   ``jax.make_array_from_process_local_data`` — the multi-host data
-   path — and whose output is the replicated "weights"),
-3. Orbax checkpoint per step (a collective: every rank calls save,
-   the primary host writes, commit is atomic per step) and
-   resume-on-restart, so a gang that is killed and recreated
-   continues instead of starting over.
-
-The math is chosen so the final value is exactly computable by the
-test: step ``s`` adds ``mean_over_ranks(rank + 1 + s)`` to every
-element of ``w`` — any lost step, double-applied step, or
-desynchronized rank produces the wrong final value.
-
-On completion each rank writes ``done-rank<r>-attempt<start_step>`` to
-the checkpoint dir with the final scalar, then exits 0.
-
-Env knobs: TOTAL_STEPS (default 20), STEP_DELAY seconds (default 0),
-CKPT_DIR (default: none — no checkpointing).
+Folded onto the single bootstrap implementation in
+:mod:`kubernetes_tpu.workloads.trainer` (``MODEL=demo``): rendezvous
+from framework env + cluster DNS, the exactly-computable counting loop
+over the global ``dp`` mesh, Orbax checkpoint per step and
+resume-on-restart. The observable contract is unchanged — env knobs
+(TOTAL_STEPS, STEP_DELAY, CKPT_DIR, KTPU_DEMO_PLATFORM), the
+``done-rank<r>-attempt<start>`` files, and the DONE line — so the e2e
+assertions written against the old module hold verbatim.
 """
 from __future__ import annotations
 
 import os
 import sys
-import time
 
 
 def main() -> int:
-    import jax
-    # The e2e tier runs pods on a virtual CPU mesh; a real TPU slice
-    # leaves this unset and gets the libtpu default.
-    if os.environ.get("KTPU_DEMO_PLATFORM", "cpu") == "cpu":
-        jax.config.update("jax_platforms", "cpu")
-
-    from . import rendezvous
-    rank = rendezvous.initialize_from_env()
-
-    import numpy as np
-    import jax.numpy as jnp
-    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-
-    from . import checkpoint as ckpt
-
-    n = jax.process_count()
-    mesh = Mesh(np.array(jax.devices()), ("dp",))
-    repl = NamedSharding(mesh, P())
-    data = NamedSharding(mesh, P("dp"))
-    local = jax.local_device_count()
-
-    total = int(os.environ.get("TOTAL_STEPS", "20"))
-    delay = float(os.environ.get("STEP_DELAY", "0"))
-    ckpt_dir = os.environ.get("CKPT_DIR", "")
-
-    start_step, w_host = 0, np.zeros((8,), np.float32)
-    if ckpt_dir:
-        latest = ckpt.latest_step(ckpt_dir)
-        if latest is not None:
-            state = ckpt.restore(ckpt_dir, {"w": w_host})
-            start_step, w_host = latest, np.asarray(state["w"])
-    w = jax.device_put(jnp.asarray(w_host), repl)
-
-    @jax.jit
-    def step_fn(w, x):
-        # x is dp-sharded global data; its global mean is the update —
-        # XLA inserts the cross-process all-reduce.
-        return w + jnp.mean(x)
-
-    for s in range(start_step, total):
-        # Every device on this process contributes (rank + 1 + s); the
-        # global mean over all ranks is (n-1)/2 + 1 + s.
-        x = jax.make_array_from_process_local_data(
-            data, np.full((local,), rank + 1 + s, np.float32),
-            (local * n,))
-        w = step_fn(w, x)
-        if ckpt_dir:
-            # EVERY rank participates: in a multi-process jax runtime
-            # Orbax's save is a collective (barrier + primary-host
-            # write); a rank-0-only save deadlocks the gang.
-            ckpt.save(s + 1, {"w": np.asarray(w)}, ckpt_dir)
-        if delay:
-            time.sleep(delay)
-
-    final = float(np.asarray(w)[0])
-    print(f"DONE rank={rank} start={start_step} final={final}", flush=True)
-    if ckpt_dir:
-        with open(os.path.join(
-                ckpt_dir, f"done-rank{rank}-attempt{start_step}"), "w") as f:
-            f.write(f"{final}")
-    return 0
+    os.environ.setdefault("MODEL", "demo")
+    from . import trainer
+    return trainer.main()
 
 
 if __name__ == "__main__":

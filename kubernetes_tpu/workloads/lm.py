@@ -63,18 +63,23 @@ class LMConfig:
     #: for configs that need the headroom (bigger batch/longer T).
     loss_chunk: int = 0
     #: Attention kernel: "ring" (sequence-parallel ring over the sp
-    #: axis; degenerates to blockwise on one device) or "flash" (the
+    #: axis; degenerates to blockwise on one device), "flash" (the
     #: pallas TPU flash-attention kernel — fastest single-device path;
-    #: only valid when the sequence axis is unsharded).
+    #: only valid when the sequence axis is unsharded) or "local"
+    #: (reference einsum attention: plain XLA ops the SPMD partitioner
+    #: handles natively, so it runs on any mesh whose attention axes
+    #: (sp, tp) are unsharded — the multi-process data-parallel path
+    #: workloads/trainer.py uses on CPU gangs, where the ring kernel's
+    #: shard_map trips a jax-0.4.37 scan replication bug).
     attn_impl: str = "ring"
 
     def __post_init__(self):
         if self.remat_policy not in ("full", "dots"):
             raise ValueError(f"remat_policy must be 'full' or 'dots', "
                              f"got {self.remat_policy!r}")
-        if self.attn_impl not in ("ring", "flash"):
-            raise ValueError(f"attn_impl must be 'ring' or 'flash', "
-                             f"got {self.attn_impl!r}")
+        if self.attn_impl not in ("ring", "flash", "local"):
+            raise ValueError(f"attn_impl must be 'ring', 'flash' or "
+                             f"'local', got {self.attn_impl!r}")
         if self.loss_chunk < 0:
             raise ValueError(
                 f"loss_chunk must be >= 0 (0 disables chunking), "
@@ -259,6 +264,14 @@ def hidden_states(params: dict, tokens, cfg: LMConfig, mesh) -> jax.Array:
                     "(the pallas custom call has no SPMD partitioning "
                     "rule); use 'ring' on multi-device meshes")
             o = _flash_attention(q, k, v)
+        elif cfg.attn_impl == "local":
+            if mesh.shape.get("sp", 1) != 1 or mesh.shape.get("tp", 1) != 1:
+                raise ValueError(
+                    "attn_impl='local' is batch-parallel only (plain "
+                    "einsum attention, partitioned by the SPMD pass); "
+                    "use 'ring' when sp/tp shard the attention itself")
+            from .ring_attention import reference_attention
+            o = reference_attention(q, k, v).astype(q.dtype)
         else:
             o = ring_attention(q, k, v, mesh)
         o = o.transpose(0, 2, 1, 3).reshape(b, t, h * dh)
@@ -350,6 +363,28 @@ def _is_mixed(cfg: LMConfig) -> bool:
     return cfg.param_dtype != jnp.float32
 
 
+def _mesh_wide(tree, mesh):
+    """Re-place process-local leaves (optax's scalar step counter)
+    replicated onto the global mesh. Multi-process only: a jit over
+    arrays mixing single-process and mesh-spanning shardings is an
+    error, and the restore path shards exactly like the template this
+    tree becomes (resume_or_init -> as_template)."""
+    if jax.process_count() <= 1:
+        return tree
+    import numpy as np
+    repl = NamedSharding(mesh, P())
+    mesh_devices = set(mesh.devices.flat)
+
+    def fix(x):
+        if isinstance(x, jax.Array) and set(x.sharding.device_set) \
+                == mesh_devices:
+            return x
+        host = np.asarray(x)
+        return jax.make_array_from_callback(
+            host.shape, repl, lambda idx: host[idx])
+    return jax.tree_util.tree_map(fix, tree)
+
+
 def init_sharded(rng, cfg: LMConfig, mesh, lr: float = 3e-3):
     """Params + optimizer state, laid out on the mesh. The opt state
     inherits each param's sharding (built by tree ops on sharded
@@ -359,8 +394,9 @@ def init_sharded(rng, cfg: LMConfig, mesh, lr: float = 3e-3):
     if _is_mixed(cfg):
         master = jax.tree_util.tree_map(
             lambda p: p.astype(jnp.float32), params)
-        return params, (make_optimizer(lr).init(master), master)
-    return params, make_optimizer(lr).init(params)
+        return params, _mesh_wide((make_optimizer(lr).init(master), master),
+                                  mesh)
+    return params, _mesh_wide(make_optimizer(lr).init(params), mesh)
 
 
 def make_train_step(cfg: LMConfig, mesh, lr: float = 3e-3):
@@ -391,11 +427,19 @@ def make_forward(cfg: LMConfig, mesh):
 
 def train(cfg: LMConfig, mesh, steps: int, batch: int, seq: int,
           lr: float = 3e-3, ckpt_dir: str = "",
-          checkpoint_every: int = 50, rng_seed: int = 0) -> dict:
+          checkpoint_every: int = 50, rng_seed: int = 0,
+          publish_marker: bool = False,
+          step_callback=None) -> dict:
     """Elastic training loop: resumes from the job's checkpoint when
     one exists (workloads/checkpoint.py — eviction + reschedule is a
     resume, not a restart), saving every ``checkpoint_every`` steps.
-    Returns {"final_step", "loss", "resumed_from"}."""
+    Returns {"final_step", "loss", "resumed_from"}.
+
+    ``publish_marker``: also publish the checkpoint-complete marker
+    after every PERIODIC save (not just the preemption-signaled one) —
+    the durable progress record the TrainJob controller reads for
+    ``status.last_checkpoint_step``. ``step_callback(step)`` runs after
+    each completed step (the trainer's kill-window pacing hook)."""
     from . import checkpoint as ckpt
 
     ckpt_dir = ckpt_dir or ckpt.checkpoint_dir()
@@ -409,6 +453,23 @@ def train(cfg: LMConfig, mesh, steps: int, batch: int, seq: int,
     # A marker left by the PREVIOUS incarnation's preemption round
     # must not satisfy a new round's wait.
     ckpt.clear_marker(ckpt_dir)
+
+    def preempt_agreed() -> bool:
+        """Gang-wide preemption verdict. Multi-process: the signal
+        file lands on each pod at slightly different times, and the
+        Orbax save below is a COLLECTIVE — ranks deciding to save at
+        different step boundaries would enter mismatched collectives
+        and wedge the gang through its whole grace window. One tiny
+        allgather per step makes every rank see the same verdict at
+        the same boundary."""
+        local = ckpt.preempt_requested()
+        if jax.process_count() <= 1:
+            return local
+        import numpy as np
+        from jax.experimental import multihost_utils
+        flags = multihost_utils.process_allgather(
+            np.asarray([1 if local else 0], np.int32))
+        return bool(flags.max() > 0)
     step_fn = make_train_step(cfg, mesh, lr)
     params, opt_state = state["params"], state["opt_state"]
     loss = None
@@ -431,7 +492,7 @@ def train(cfg: LMConfig, mesh, steps: int, batch: int, seq: int,
             loss.block_until_ready()  # honest step time when reporting
             reporter.report(step, _time.perf_counter() - t0, batch * seq,
                             loss=float(loss))
-        if ckpt.preempt_requested():
+        if preempt_agreed():
             # Graceful preemption: the orchestrator signaled this gang
             # (KTPU_PREEMPT / the agent's preempt file). Save NOW,
             # publish the checkpoint-complete marker, and exit cleanly
@@ -446,6 +507,13 @@ def train(cfg: LMConfig, mesh, steps: int, batch: int, seq: int,
         if checkpoint_every and (step + 1) % checkpoint_every == 0:
             ckpt.save(step, {"params": params, "opt_state": opt_state},
                       ckpt_dir)
+            if publish_marker and jax.process_index() == 0:
+                # Only after save() returned: the marker asserts the
+                # step is DURABLE. One writer — Orbax's primary host —
+                # keeps N ranks from racing tmp+rename on one file.
+                ckpt.write_marker(ckpt_dir, step)
+        if step_callback is not None:
+            step_callback(step)
     return {"final_step": steps, "resumed_from": start,
             "loss": float(loss) if loss is not None else None,
             "preempted": False}
@@ -469,4 +537,14 @@ def synthetic_batch(rng, cfg: LMConfig, mesh, batch: int, seq: int):
     noise = jax.random.bernoulli(k_mask, 0.02, toks.shape)
     rand = jax.random.randint(k_val, toks.shape, 0, cfg.vocab)
     toks = jnp.where(noise, rand, toks).astype(jnp.int32)
-    return jax.device_put(toks, NamedSharding(mesh, P(("dp", "fsdp"), None)))
+    sharding = NamedSharding(mesh, P(("dp", "fsdp"), None))
+    if jax.process_count() > 1:
+        # Multi-host data path (SNIPPETS [1]-[3]): every rank computes
+        # the identical global stream (seeded), then contributes only
+        # its addressable shards — device_put cannot place a host array
+        # onto a sharding spanning other processes.
+        import numpy as np
+        host = np.asarray(toks)
+        return jax.make_array_from_callback(
+            host.shape, sharding, lambda idx: host[idx])
+    return jax.device_put(toks, sharding)

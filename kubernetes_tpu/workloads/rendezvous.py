@@ -80,14 +80,35 @@ def _fqdn(hostname: str, domain: str = "cluster.local") -> str:
     return name if name.endswith(f".svc.{domain}") else f"{name}.svc.{domain}"
 
 
+#: Capped-exponential retry shape for DNS resolution and coordinator
+#: dial probes (client/rest.py's backoff discipline, minus the shared
+#: session): base doubles per attempt up to the cap, with full jitter
+#: so N ranks restarting together don't probe in lockstep.
+BACKOFF_BASE = 0.1
+BACKOFF_CAP = 2.0
+
+
+def _backoff(attempt: int, rng: Optional[random.Random] = None) -> float:
+    """Full-jitter capped-exponential delay for ``attempt`` (0-based).
+    The exponent is clamped — a long-timeout resolver loops thousands
+    of attempts, and 2**attempt would overflow float long before the
+    deadline."""
+    cap = min(BACKOFF_CAP, BACKOFF_BASE * (2 ** min(attempt, 16)))
+    return (rng or random).uniform(0.0, cap)
+
+
 def resolve_rank0(timeout: float = 60.0) -> str:
     """Resolve rank 0's pod IP via the cluster DNS, retrying until the
     coordinator pod is scheduled, running, and in Endpoints (the
-    rendezvous race every multi-host bootstrap has)."""
+    rendezvous race every multi-host bootstrap has). Every attempt is
+    a FRESH query — nothing here may cache: after a gang recovery
+    round the replacement rank-0 pod has a new IP, and a cached answer
+    would wedge the whole gang until its init timeout."""
     hostnames = os.environ["TPU_WORKER_HOSTNAMES"].split(",")
     dns = os.environ["KTPU_DNS_SERVER"]
     name = _fqdn(hostnames[0])
     deadline = time.monotonic() + timeout
+    attempt = 0
     while True:
         ip = dns_query(name, dns)
         if ip:
@@ -96,7 +117,53 @@ def resolve_rank0(timeout: float = 60.0) -> str:
             raise TimeoutError(
                 f"rank-0 hostname {name!r} did not resolve via {dns} "
                 f"within {timeout}s")
-        time.sleep(0.5)
+        time.sleep(min(_backoff(attempt),
+                       max(deadline - time.monotonic(), 0.0)))
+        attempt += 1
+
+
+def coordinator_reachable(ip: str, port: int,
+                          timeout: float = 1.0) -> bool:
+    """One bounded TCP dial of the coordinator address. True only when
+    something ACCEPTS on the port — rank 0 binds it inside
+    ``jax.distributed.initialize``, so a refused/timed-out dial means
+    the coordinator is not up (yet, or anymore)."""
+    try:
+        with socket.create_connection((ip, int(port)), timeout=timeout):
+            return True
+    except OSError:
+        return False
+
+
+def resolve_coordinator(port: int, timeout: float = 60.0) -> str:
+    """Resolve AND dial: rank 0's current IP, verified accepting on the
+    coordinator port.
+
+    The re-resolve-after-recovery contract: each attempt re-queries the
+    cluster DNS from scratch, so when a gang recovery round replaces
+    the rank-0 pod (new IP), a non-zero rank that resolved the OLD pod
+    keeps probing, sees the dial fail, and picks up the fresh record on
+    the next loop instead of handing ``jax.distributed.initialize`` a
+    dead address and wedging until its own timeout."""
+    deadline = time.monotonic() + timeout
+    attempt = 0
+    while True:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            raise TimeoutError(
+                f"coordinator did not accept on port {port} within "
+                f"{timeout}s")
+        try:
+            ip = resolve_rank0(timeout=max(remaining, 0.1))
+        except TimeoutError:
+            raise TimeoutError(
+                f"rank-0 did not resolve within {timeout}s") from None
+        if coordinator_reachable(ip, port,
+                                 timeout=min(1.0, max(remaining, 0.1))):
+            return ip
+        time.sleep(min(_backoff(attempt),
+                       max(deadline - time.monotonic(), 0.0)))
+        attempt += 1
 
 
 def initialize_from_env(timeout: float = 60.0) -> int:
@@ -112,7 +179,7 @@ def initialize_from_env(timeout: float = 60.0) -> int:
     if n == 1:
         return 0  # single-process: nothing to rendezvous
     coord_ip = (os.environ.get("POD_IP", "") if rank == 0
-                else resolve_rank0(timeout))
+                else resolve_coordinator(port, timeout))
     if not coord_ip:
         coord_ip = resolve_rank0(timeout)
     # Rank 0 binds its OWN pod IP, not the wildcard: pod IPs are unique

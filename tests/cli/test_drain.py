@@ -10,6 +10,12 @@ import asyncio
 import contextlib
 import io
 
+import pytest
+
+pytest.importorskip(
+    "cryptography",
+    reason="tls=True LocalCluster / PKI paths are environmental without it")
+
 from kubernetes_tpu.api import types as t, workloads as w
 from kubernetes_tpu.api.meta import ObjectMeta, OwnerReference
 from kubernetes_tpu.api.selectors import LabelSelector

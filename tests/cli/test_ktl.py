@@ -10,6 +10,12 @@ import subprocess
 import sys
 import contextlib
 
+import pytest
+
+pytest.importorskip(
+    "cryptography",
+    reason="tls=True LocalCluster / PKI paths are environmental without it")
+
 from kubernetes_tpu.api import types as t
 from kubernetes_tpu.cli import ktl
 from kubernetes_tpu.cluster import LocalCluster

@@ -9,6 +9,12 @@ annotations anywhere.
 import asyncio
 import sys
 
+import pytest
+
+pytest.importorskip(
+    "cryptography",
+    reason="tls=True LocalCluster / PKI paths are environmental without it")
+
 from kubernetes_tpu.api import types as t, workloads as w
 from kubernetes_tpu.api.meta import ObjectMeta
 from kubernetes_tpu.api.selectors import LabelSelector

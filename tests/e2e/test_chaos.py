@@ -4,6 +4,12 @@ a durable cluster restarts from WAL and recovers its state."""
 import asyncio
 import os
 
+import pytest
+
+pytest.importorskip(
+    "cryptography",
+    reason="tls=True LocalCluster / PKI paths are environmental without it")
+
 from kubernetes_tpu.api import types as t
 from kubernetes_tpu.api.meta import ObjectMeta
 from kubernetes_tpu.api.selectors import LabelSelector

@@ -8,6 +8,12 @@ for hardware."""
 import asyncio
 import sys
 
+import pytest
+
+pytest.importorskip(
+    "cryptography",
+    reason="tls=True LocalCluster / PKI paths are environmental without it")
+
 from kubernetes_tpu.api import types as t
 from kubernetes_tpu.api.meta import ObjectMeta
 from kubernetes_tpu.api.selectors import LabelSelector

@@ -21,6 +21,12 @@ import os
 import signal
 import sys
 
+import pytest
+
+pytest.importorskip(
+    "cryptography",
+    reason="tls=True LocalCluster / PKI paths are environmental without it")
+
 from kubernetes_tpu.api import types as t
 from kubernetes_tpu.api import workloads as w
 from kubernetes_tpu.api.meta import ObjectMeta

@@ -11,6 +11,12 @@ import sys
 
 import aiohttp
 
+import pytest
+
+pytest.importorskip(
+    "cryptography",
+    reason="tls=True LocalCluster / PKI paths are environmental without it")
+
 from kubernetes_tpu.api import types as t
 from kubernetes_tpu.api.meta import ObjectMeta
 from kubernetes_tpu.cli.ktl import exec_interactive, forward_port

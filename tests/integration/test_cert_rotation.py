@@ -10,6 +10,10 @@ import os
 
 import pytest
 
+pytest.importorskip(
+    "cryptography",
+    reason="tls=True LocalCluster / PKI paths are environmental without it")
+
 from kubernetes_tpu.api import errors, types as t
 from kubernetes_tpu.api.meta import ObjectMeta
 from kubernetes_tpu.apiserver.authz import make_authorizer

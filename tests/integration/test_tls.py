@@ -10,6 +10,10 @@ import ssl
 import aiohttp
 import pytest
 
+pytest.importorskip(
+    "cryptography",
+    reason="tls=True LocalCluster / PKI paths are environmental without it")
+
 from kubernetes_tpu.api import errors, rbac, types as t
 from kubernetes_tpu.api.meta import ObjectMeta
 from kubernetes_tpu.apiserver import bootstrap

@@ -1,0 +1,390 @@
+"""training/v1 over the in-process control plane.
+
+Acceptance scenarios for ISSUE 14: the reconcile chain (TrainJob ->
+headless Service + PodGroup + indexed worker pod set with the
+rendezvous env contract), gate-off byte-identity (no controller
+traffic at all), the gang-recovery round (member fails -> whole round
+torn down -> recreated, counted durably, resume detected from the
+checkpoint marker), backoff-limit exhaustion, and completion (all
+ranks Succeeded -> phase Succeeded, PodGroup released).
+"""
+import asyncio
+import os
+
+import pytest
+
+from kubernetes_tpu.api import training as tr, types as t
+from kubernetes_tpu.api.errors import InvalidError
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.apiserver.admission import default_chain
+from kubernetes_tpu.apiserver.registry import Registry
+from kubernetes_tpu.client.informer import InformerFactory
+from kubernetes_tpu.client.local import LocalClient
+from kubernetes_tpu.controllers.train import (TrainJobController,
+                                              group_name, service_name)
+from kubernetes_tpu.util.features import GATES
+from kubernetes_tpu.workloads.checkpoint import write_marker
+
+
+@pytest.fixture
+def gate_on():
+    was = GATES.enabled("TrainJobController")
+    GATES.set("TrainJobController", True)
+    yield
+    GATES.set("TrainJobController", was)
+
+
+def _registry() -> Registry:
+    reg = Registry()
+    reg.admission = default_chain(reg)
+    reg.create(t.Namespace(metadata=ObjectMeta(name="default")))
+    return reg
+
+
+def _tj(name="tj", **kw) -> tr.TrainJob:
+    kw.setdefault("model", "lm")
+    kw.setdefault("num_workers", 2)
+    kw.setdefault("total_steps", 8)
+    return tr.TrainJob(metadata=ObjectMeta(name=name, namespace="default"),
+                       spec=tr.TrainJobSpec(**kw))
+
+
+async def _wait(predicate, what: str, timeout: float = 15.0):
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + timeout
+    while not predicate():
+        if loop.time() > deadline:
+            raise AssertionError(f"timeout: {what}")
+        await asyncio.sleep(0.05)
+
+
+def _member_pods(reg, name="tj"):
+    pods, _ = reg.list("pods", "default")
+    return [p for p in pods
+            if p.metadata.labels.get(tr.TRAINJOB_LABEL) == name]
+
+
+def _set_phase(reg, pod, phase):
+    fresh = reg.get("pods", "default", pod.metadata.name)
+    fresh.status.phase = phase
+    if phase == t.POD_RUNNING:
+        fresh.status.conditions = [t.PodCondition(
+            type=t.COND_POD_READY, status="True")]
+    reg.update(fresh, subresource="status")
+
+
+async def _controller(reg):
+    client = LocalClient(reg)
+    factory = InformerFactory(client)
+    ctl = TrainJobController(client, factory)
+    await ctl.start()
+    return ctl, factory
+
+
+async def test_reconcile_creates_service_group_and_workers(gate_on):
+    reg = _registry()
+    ctl, factory = await _controller(reg)
+    try:
+        await LocalClient(reg).create(_tj(coord_port=9000,
+                                          args={"STEP_DELAY": "0.1"}))
+        await _wait(lambda: len(_member_pods(reg)) == 2, "worker pods")
+
+        svc = reg.get("services", "default", "tj-workers")
+        assert svc.spec.cluster_ip == "None"
+        assert svc.spec.selector == {tr.TRAINJOB_LABEL: "tj"}
+        assert svc.spec.ports[0].port == 9000
+
+        gname = group_name(reg.get("trainjobs", "default", "tj"))
+        assert gname.startswith("train-tj-")  # uid-suffixed incarnation
+        group = reg.get("podgroups", "default", gname)
+        assert group.spec.min_member == 2
+        # Explicit admission demand: the queue charge reflects the
+        # per-worker footprint (cpu here; chips when claimed).
+        assert group.spec.resources[t.RESOURCE_CPU] == 1.0
+
+        pods = sorted(_member_pods(reg),
+                      key=lambda p: p.metadata.labels[tr.RANK_LABEL])
+        for rank, pod in enumerate(pods):
+            assert pod.spec.gang == gname
+            assert pod.spec.hostname == f"tj-{rank}"
+            assert pod.spec.subdomain == "tj-workers"
+            env = {e.name: e.value for e in pod.spec.containers[0].env}
+            # The full rendezvous contract rides the env (the agent
+            # adds POD_IP/KTPU_DNS_SERVER at container start).
+            assert env["TPU_WORKER_ID"] == str(rank)
+            assert env["TPU_WORKER_HOSTNAMES"] == \
+                "tj-0.tj-workers.default,tj-1.tj-workers.default"
+            assert env["KTPU_COORD_PORT"] == "9000"
+            assert env["MODEL"] == "lm"
+            assert env["TOTAL_STEPS"] == "8"
+            assert env["STEP_DELAY"] == "0.1"  # spec.args passthrough
+
+        # Full gang running -> phase Running + per-rank states.
+        for p in pods:
+            _set_phase(reg, p, t.POD_RUNNING)
+        await _wait(lambda: reg.get("trainjobs", "default", "tj")
+                    .status.phase == tr.TRAIN_RUNNING, "Running phase")
+        st = reg.get("trainjobs", "default", "tj").status
+        assert st.ready_workers == 2
+        assert st.worker_states == {"0": "Running", "1": "Running"}
+    finally:
+        await ctl.stop()
+        await factory.stop_all()
+
+
+async def test_gate_off_byte_identity():
+    """Gate off: creating a TrainJob produces NO controller traffic —
+    no Service, no PodGroup, no pods, no status writes, store revision
+    frozen after the create."""
+    assert not GATES.enabled("TrainJobController")
+    reg = _registry()
+    ctl, factory = await _controller(reg)
+    try:
+        await LocalClient(reg).create(_tj())
+        rev_after_create = reg.store.revision
+        await asyncio.sleep(0.6)  # give an armed controller every chance
+        assert reg.store.revision == rev_after_create, \
+            "gate off but the control plane wrote something"
+        with pytest.raises(Exception):
+            reg.get("services", "default", "tj-workers")
+        groups, _ = reg.list("podgroups", "default")
+        assert groups == []
+        pods, _ = reg.list("pods", "default")
+        assert pods == []
+        got = reg.get("trainjobs", "default", "tj")
+        assert got.status == tr.TrainJobStatus()
+    finally:
+        await ctl.stop()
+        await factory.stop_all()
+
+
+async def test_member_failure_restarts_round_and_detects_resume(
+        gate_on, tmp_path):
+    """One failed member tears down the WHOLE round (succeeded ranks
+    too — the recreated gang must rendezvous at full world size); the
+    round is durable in status (rounds += 1 exactly once) and counts
+    as a RESUME because the checkpoint marker exists on the shared
+    volume."""
+    reg = _registry()
+    # A bound host-path PV behind the claim, so the controller can
+    # resolve the checkpoint base and read the trainer's marker.
+    base = str(tmp_path / "pv")
+    reg.create(t.PersistentVolume(
+        metadata=ObjectMeta(name="pv0"),
+        spec=t.PersistentVolumeSpec(
+            capacity={"storage": "1Gi"},
+            host_path=t.HostPathVolume(path=base))))
+    pvc = t.PersistentVolumeClaim(
+        metadata=ObjectMeta(name="ckpt", namespace="default"),
+        spec=t.PersistentVolumeClaimSpec(
+            resources=t.ResourceRequirements(
+                requests={"storage": "1Gi"})))
+    reg.create(pvc)
+    fresh = reg.get("persistentvolumeclaims", "default", "ckpt")
+    fresh.spec.volume_name = "pv0"
+    reg.update(fresh)
+    fresh = reg.get("persistentvolumeclaims", "default", "ckpt")
+    fresh.status.phase = t.PVC_BOUND
+    reg.update(fresh, subresource="status")
+
+    ctl, factory = await _controller(reg)
+    try:
+        created = await LocalClient(reg).create(
+            _tj(checkpoint=tr.TrainCheckpointSpec(pvc="ckpt")))
+        # The trainer's durable progress record: marker at step 5 in
+        # the THIS-incarnation checkpoint dir (uid-suffixed gang).
+        ckpt_dir = os.path.join(base, "default", group_name(created))
+        write_marker(ckpt_dir, 5)
+        await _wait(lambda: len(_member_pods(reg)) == 2, "worker pods")
+        pods = sorted(_member_pods(reg),
+                      key=lambda p: p.metadata.labels[tr.RANK_LABEL])
+        env = {e.name: e.value for e in pods[0].spec.containers[0].env}
+        assert env["KTPU_CHECKPOINT_DIR"] == base
+        first_uids = {p.metadata.uid for p in pods}
+
+        _set_phase(reg, pods[0], t.POD_SUCCEEDED)
+        _set_phase(reg, pods[1], t.POD_FAILED)
+
+        def recreated():
+            live = [p for p in _member_pods(reg)
+                    if p.metadata.uid not in first_uids
+                    and p.metadata.deletion_timestamp is None]
+            return len(live) == 2
+        await _wait(recreated, "full gang recreated")
+
+        st = reg.get("trainjobs", "default", "tj").status
+        assert st.restart_rounds == 1
+        assert st.resumes == 1
+        assert st.last_checkpoint_step == 5
+        # The succeeded rank was restarted too.
+        live = [p for p in _member_pods(reg)
+                if p.metadata.deletion_timestamp is None]
+        assert {p.metadata.labels[tr.RANK_LABEL] for p in live} \
+            == {"0", "1"}
+    finally:
+        await ctl.stop()
+        await factory.stop_all()
+
+
+async def test_backoff_limit_exhaustion_fails_the_job(gate_on):
+    reg = _registry()
+    ctl, factory = await _controller(reg)
+    try:
+        await LocalClient(reg).create(_tj(backoff_limit=0))
+        await _wait(lambda: len(_member_pods(reg)) == 2, "worker pods")
+        pods = _member_pods(reg)
+        _set_phase(reg, pods[0], t.POD_FAILED)
+        await _wait(lambda: reg.get("trainjobs", "default", "tj")
+                    .status.phase == tr.TRAIN_FAILED, "Failed phase")
+        # No more workers are created after the terminal transition.
+        await asyncio.sleep(0.3)
+        live = [p for p in _member_pods(reg)
+                if p.metadata.deletion_timestamp is None
+                and p.status.phase not in ("Succeeded", "Failed")]
+        assert live == []
+    finally:
+        await ctl.stop()
+        await factory.stop_all()
+
+
+def _has(reg, plural, name) -> bool:
+    try:
+        reg.get(plural, "default", name)
+        return True
+    except Exception:
+        return False
+
+
+async def test_completion_keeps_unqueued_group_releases_queued(gate_on):
+    """Completion: phase Succeeded; the unqueued PodGroup SURVIVES for
+    observability (ktl trace gang reads it after the run), while a
+    QUEUED gang's group is deleted — its lifetime is the quota hold
+    (the Job controller's rule, gated on JobQueueing)."""
+    reg = _registry()
+    ctl, factory = await _controller(reg)
+    was = GATES.enabled("JobQueueing")
+    GATES.set("JobQueueing", True)
+    try:
+        from kubernetes_tpu.api import queueing as q
+        reg.create(q.ClusterQueue(
+            metadata=ObjectMeta(name="cq"),
+            spec=q.ClusterQueueSpec(nominal_quota={"cpu": 100.0})))
+        reg.create(q.LocalQueue(
+            metadata=ObjectMeta(name="lq", namespace="default"),
+            spec=q.LocalQueueSpec(cluster_queue="cq")))
+        client = LocalClient(reg)
+        await client.create(_tj())
+        await client.create(_tj(name="qj", queue="lq"))
+        await _wait(lambda: len(_member_pods(reg)) == 2, "worker pods")
+        await _wait(lambda: len(_member_pods(reg, "qj")) == 2,
+                    "queued worker pods")
+        for name in ("tj", "qj"):
+            for p in _member_pods(reg, name):
+                _set_phase(reg, p, t.POD_SUCCEEDED)
+            await _wait(lambda n=name: reg.get("trainjobs", "default", n)
+                        .status.phase == tr.TRAIN_SUCCEEDED, "Succeeded")
+        st = reg.get("trainjobs", "default", "tj").status
+        assert st.succeeded_workers == 2
+        assert st.completion_time is not None
+        g_tj = group_name(reg.get("trainjobs", "default", "tj"))
+        g_qj = group_name(reg.get("trainjobs", "default", "qj"))
+        assert _has(reg, "podgroups", g_tj)  # observability
+        await _wait(lambda: not _has(reg, "podgroups", g_qj),
+                    "queued podgroup released")
+    finally:
+        GATES.set("JobQueueing", was)
+        await ctl.stop()
+        await factory.stop_all()
+
+
+async def test_elastic_shrink_resizes_world_without_burning_backoff(
+        gate_on):
+    """Fair-share shrink lowers the PodGroup's elastic target: the
+    gang restarts AT THE SHRUNK WORLD SIZE (world is frozen into every
+    member's rendezvous env, so a resize is a round restart) — and the
+    resize is NOT counted against backoff_limit (policy, not
+    failure)."""
+    reg = _registry()
+    ctl, factory = await _controller(reg)
+    try:
+        await LocalClient(reg).create(
+            _tj(min_workers=1, max_workers=2))
+        await _wait(lambda: len(_member_pods(reg)) == 2, "worker pods")
+        pods = _member_pods(reg)
+        assert all(p.metadata.labels[tr.WORLD_LABEL] == "2"
+                   for p in pods)
+
+        # Reclaim shrink: the queue controller lowers the elastic
+        # target on the group.
+        gname = group_name(reg.get("trainjobs", "default", "tj"))
+        group = reg.get("podgroups", "default", gname)
+        group.status.replicas = 1
+        reg.update(group, subresource="status")
+
+        def resized():
+            live = [p for p in _member_pods(reg)
+                    if p.metadata.deletion_timestamp is None
+                    and p.metadata.labels[tr.WORLD_LABEL] == "1"]
+            return len(live) == 1 and len([
+                p for p in _member_pods(reg)
+                if p.metadata.deletion_timestamp is None]) == 1
+        await _wait(resized, "gang resized to world 1")
+        live = [p for p in _member_pods(reg)
+                if p.metadata.deletion_timestamp is None][0]
+        env = {e.name: e.value for e in live.spec.containers[0].env}
+        assert env["TPU_WORKER_HOSTNAMES"] == "tj-0.tj-workers.default"
+        st = reg.get("trainjobs", "default", "tj").status
+        assert st.restart_rounds == 0  # resize never burns backoff
+    finally:
+        await ctl.stop()
+        await factory.stop_all()
+
+
+def test_validators_and_immutability():
+    tj = _tj(num_workers=0)
+    with pytest.raises(InvalidError):
+        tr.validate_trainjob(tj)
+    tj = _tj(slice_shape=[2, 2], chips_per_worker=3)
+    with pytest.raises(InvalidError):
+        tr.validate_trainjob(tj)
+    tj = _tj(min_workers=3, max_workers=2)
+    with pytest.raises(InvalidError):
+        tr.validate_trainjob(tj)
+    # Elastic max must equal the gang size.
+    tj = _tj(num_workers=4, min_workers=2, max_workers=3)
+    with pytest.raises(InvalidError):
+        tr.validate_trainjob(tj)
+    tr.validate_trainjob(_tj(num_workers=4, min_workers=2,
+                             max_workers=4))
+    old, new = _tj(), _tj(num_workers=3)
+    with pytest.raises(InvalidError):
+        tr.validate_trainjob_update(new, old)
+    # PodGroup passthrough never re-reconciles into a live group —
+    # edits are refused, not silently ignored.
+    with pytest.raises(InvalidError):
+        tr.validate_trainjob_update(_tj(queue="other"), _tj())
+    with pytest.raises(InvalidError):
+        tr.validate_trainjob_update(_tj(gang_slice_shape=[2, 2]), _tj())
+    # The checkpoint volume is frozen into worker env — repointing a
+    # live job is refused.
+    with pytest.raises(InvalidError):
+        tr.validate_trainjob_update(
+            _tj(checkpoint=tr.TrainCheckpointSpec(pvc="b")), _tj())
+    # Worker env is frozen at pod creation: every other spec field is
+    # immutable too; only the restart budget may move.
+    with pytest.raises(InvalidError):
+        tr.validate_trainjob_update(_tj(total_steps=99), _tj())
+    with pytest.raises(InvalidError):
+        tr.validate_trainjob_update(_tj(model="demo"), _tj())
+    tr.validate_trainjob_update(_tj(backoff_limit=2), _tj())
+    # Unknown model refused at admission.
+    with pytest.raises(InvalidError):
+        tr.validate_trainjob(_tj(model="gpt"))
+    # Malformed JSON types become field errors, never a raw
+    # ValueError/TypeError (= a 500 out of the apiserver).
+    with pytest.raises(InvalidError) as e:
+        tr.validate_trainjob(_tj(slice_shape=["2x2"]))
+    assert "spec.slice_shape" in str(e.value)
+    with pytest.raises(InvalidError) as e:
+        tr.validate_trainjob(_tj(num_workers="two"))
+    assert "spec.num_workers" in str(e.value)

@@ -8,6 +8,7 @@ from kubernetes_tpu.api import types as t
 from kubernetes_tpu.api.meta import ObjectMeta
 from kubernetes_tpu.net.dns import (ClusterDNS, make_query,
                                     parse_answer_ips, _parse_query)
+from tests.conftest import requires_cryptography
 from tests.controllers.util import make_plane
 
 
@@ -90,6 +91,7 @@ def test_query_parser_rejects_garbage():
     assert (txn, name, qtype, qclass) == (7, "a.b.svc.cluster.local", 1, 1)
 
 
+@requires_cryptography
 async def test_cluster_injects_dns_env(tmp_path):
     """LocalCluster starts the DNS and pods see KTPU_DNS_SERVER; a pod
     can resolve a service through it (full in-cluster loop)."""

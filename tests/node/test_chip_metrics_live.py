@@ -17,6 +17,7 @@ import aiohttp
 from kubernetes_tpu.api import types as t
 from kubernetes_tpu.api.meta import ObjectMeta
 from kubernetes_tpu.cluster.local import LocalCluster, NodeSpec
+from tests.conftest import requires_cryptography
 from kubernetes_tpu.workloads.metrics_reporter import (
     TrainingMetricsReporter, read_report)
 
@@ -52,6 +53,7 @@ def _worker_src() -> str:
         "    time.sleep(0.05)\n")
 
 
+@requires_cryptography
 async def test_live_pipeline_and_dashboard_names(tmp_path):
     """A training pod with 2 assigned chips reports; summary + metrics
     go LIVE (numbers move between scrapes) and the Grafana dashboard's

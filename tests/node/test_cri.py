@@ -12,6 +12,7 @@ from kubernetes_tpu.node.agent import NodeAgent
 from kubernetes_tpu.node.runtime import (ContainerConfig, FakeRuntime,
                                          ProcessRuntime)
 
+from tests.conftest import requires_cryptography
 from tests.controllers.util import make_plane, wait_for
 
 
@@ -102,6 +103,7 @@ async def test_agent_over_cri_runs_pod(tmp_path):
 
 
 @pytest.mark.asyncio
+@requires_cryptography
 async def test_local_cluster_via_cri(tmp_path):
     """Full cluster with the CRI seam interposed: schedule + run a real
     process pod with the agent talking gRPC to its runtime."""

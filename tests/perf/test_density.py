@@ -2,6 +2,8 @@
 test/integration/scheduler_perf)."""
 from kubernetes_tpu.perf.density import run_density
 
+from tests.conftest import requires_cryptography
+
 
 async def test_density_small():
     res = await run_density(n_nodes=10, n_pods=100, timeout=60,
@@ -22,6 +24,7 @@ async def test_density_respects_capacity():
     assert res["max_pods_per_node"] <= 110
 
 
+@requires_cryptography
 async def test_startup_latency_meets_slo():
     """Pod startup (create -> Running) through the full real stack must
     beat the reference's 5s SLO with wide margin (metrics_util.go:46)."""

@@ -7,6 +7,8 @@ import os
 
 import pytest
 
+from tests.conftest import requires_cryptography
+
 from kubernetes_tpu.storage import encryption as enc
 from kubernetes_tpu.storage.mvcc import MVCCStore
 
@@ -31,6 +33,7 @@ def _aesgcm(secret=None, kid="key1"):
 
 
 class TestProviders:
+    @requires_cryptography
     def test_aesgcm_round_trip_and_kid(self):
         tf = enc.Transformer([enc.AesGcmProvider(
             [enc._Key("key1", b"1" * 32)])])
@@ -42,11 +45,13 @@ class TestProviders:
         # Ciphertext really is opaque: the plaintext never appears.
         assert "marker" not in json.dumps(env)
 
+    @requires_cryptography
     def test_aescbc_round_trip(self):
         tf = enc.Transformer([enc.AesCbcProvider(
             [enc._Key("k", b"2" * 16)])])
         assert tf.for_read(tf.for_write({"x": "y"})) == {"x": "y"}
 
+    @requires_cryptography
     def test_rotation_first_key_writes_all_keys_read(self):
         old = enc.AesGcmProvider([enc._Key("old", b"3" * 32)])
         env = enc.Transformer([old]).for_write({"v": 1})
@@ -58,12 +63,14 @@ class TestProviders:
         assert rotated.for_write({"v": 2})[
             enc.ENVELOPE_FIELD]["kid"] == "new"
 
+    @requires_cryptography
     def test_unknown_kid_fails_loudly(self):
         a = enc.Transformer([enc.AesGcmProvider([enc._Key("a", b"5" * 32)])])
         b = enc.Transformer([enc.AesGcmProvider([enc._Key("b", b"6" * 32)])])
         with pytest.raises(enc.DecryptError, match="kid='a'"):
             b.for_read(a.for_write({}))
 
+    @requires_cryptography
     def test_identity_first_disables_writes_but_still_reads_old(self):
         gcm = enc.AesGcmProvider([enc._Key("k", b"7" * 32)])
         env = enc.Transformer([gcm]).for_write({"s": 1})
@@ -71,6 +78,7 @@ class TestProviders:
         assert migrating.for_write({"s": 2}) == {"s": 2}  # plaintext
         assert migrating.for_read(env) == {"s": 1}  # old data readable
 
+    @requires_cryptography
     def test_corrupt_ciphertext_raises_decrypt_error_with_context(self):
         tf = enc.Transformer([enc.AesGcmProvider([enc._Key("k1", b"c" * 32)])])
         env = tf.for_write({"v": 1})
@@ -79,6 +87,7 @@ class TestProviders:
         with pytest.raises(enc.DecryptError, match="kid='k1'"):
             tf.for_read(env)
 
+    @requires_cryptography
     def test_duplicate_plural_first_entry_wins(self, tmp_path):
         import yaml
         doc = {"kind": "EncryptionConfig", "resources": [
@@ -103,6 +112,7 @@ class TestProviders:
 
 
 class TestConfigFile:
+    @requires_cryptography
     def test_load_builds_prefix_map(self, tmp_path):
         path = _config(tmp_path, [_aesgcm(), {"identity": {}}],
                        resources=("secrets", "configmaps"))
@@ -123,6 +133,7 @@ class TestConfigFile:
             enc.load_encryption_config(path)
 
 
+@requires_cryptography
 class TestMvccAtRest:
     def _transformers(self):
         return {"/registry/secrets/": enc.Transformer(

@@ -1,0 +1,151 @@
+"""workloads/rendezvous.py against the REAL cluster-DNS UDP responder.
+
+Unit tier, no jax import: the resolver half of the multi-host
+bootstrap — rank-0 resolution through ``net/dns.py``'s wire protocol,
+retry-until-registered (the coordinator pod lands in Endpoints after
+the peers start asking), and re-resolve-after-restart (a gang recovery
+round replaces rank 0 with a NEW pod IP; a cached answer or a resolver
+that stops at the first A record would wedge the gang — the dial probe
+must force a fresh query until the CURRENT coordinator accepts).
+"""
+import asyncio
+import random
+import socket
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.net.dns import ClusterDNS
+from kubernetes_tpu.workloads import rendezvous as rdz
+
+from tests.controllers.util import make_plane
+
+
+def _service(name="tj-workers", ns="default"):
+    return t.Service(metadata=ObjectMeta(name=name, namespace=ns),
+                     spec=t.ServiceSpec(cluster_ip="None",
+                                        ports=[t.ServicePort(port=8476)]))
+
+
+def _endpoints(addrs, name="tj-workers", ns="default"):
+    return t.Endpoints(
+        metadata=ObjectMeta(name=name, namespace=ns),
+        subsets=[t.EndpointSubset(addresses=[
+            t.EndpointAddress(ip=ip, hostname=host)
+            for host, ip in addrs])])
+
+
+async def _dns(objs):
+    _reg, client, _ = make_plane()
+    for obj in objs:
+        await client.create(obj)
+    dns = ClusterDNS(client)
+    await dns.start()
+    return dns, client
+
+
+def _rank_env(monkeypatch, dns):
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES",
+                       "tj-0.tj-workers.default,tj-1.tj-workers.default")
+    monkeypatch.setenv("KTPU_DNS_SERVER", dns.address)
+
+
+async def test_resolve_rank0_over_the_wire(monkeypatch):
+    """A real A/IN query against the UDP responder resolves rank 0's
+    pod IP from the headless Endpoints, by rank hostname."""
+    dns, _ = await _dns([
+        _service(),
+        _endpoints([("tj-0", "127.0.0.2"), ("tj-1", "127.0.0.3")])])
+    try:
+        _rank_env(monkeypatch, dns)
+        ip = await asyncio.to_thread(rdz.resolve_rank0, 5.0)
+        assert ip == "127.0.0.2"
+        # The raw query helper agrees (shared wire format). Off-loop:
+        # a blocking recvfrom on the responder's own event loop would
+        # deadlock the reply.
+        assert await asyncio.to_thread(
+            rdz.dns_query, "tj-0.tj-workers.default.svc.cluster.local",
+            dns.address) == "127.0.0.2"
+    finally:
+        await dns.stop()
+
+
+async def test_retry_until_registered(monkeypatch):
+    """Peers start resolving BEFORE the coordinator pod reaches
+    Endpoints (the bootstrap race): NXDOMAIN retries with backoff
+    until the record lands, then returns it."""
+    dns, client = await _dns([_service()])  # no endpoints yet
+    try:
+        _rank_env(monkeypatch, dns)
+        resolver = asyncio.create_task(
+            asyncio.to_thread(rdz.resolve_rank0, 10.0))
+        await asyncio.sleep(0.4)  # several NXDOMAIN rounds
+        assert not resolver.done()
+        await client.create(_endpoints([("tj-0", "127.0.0.4")]))
+        assert await resolver == "127.0.0.4"
+    finally:
+        await dns.stop()
+
+
+async def test_re_resolve_after_coordinator_restart(monkeypatch):
+    """The recovery-round wedge: rank 0's OLD record still resolves
+    (127.0.0.2, nothing listening) while the REPLACEMENT pod has a new
+    IP. resolve_coordinator must keep dialing + re-querying until the
+    record catches up with the live coordinator — never cache the
+    first answer."""
+    dns, client = await _dns([
+        _service(), _endpoints([("tj-0", "127.0.0.2")])])
+    # The replacement coordinator: a real listener on a fresh IP.
+    lsn = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    lsn.bind(("127.0.0.1", 0))
+    lsn.listen(1)
+    port = lsn.getsockname()[1]
+    try:
+        _rank_env(monkeypatch, dns)
+        resolver = asyncio.create_task(
+            asyncio.to_thread(rdz.resolve_coordinator, port, 15.0))
+        await asyncio.sleep(0.4)  # dials of the dead IP fail + retry
+        assert not resolver.done()
+        # Gang recovery lands: the endpoint now names the new pod.
+        ep = await client.get("endpoints", "default", "tj-workers")
+        ep.subsets = _endpoints([("tj-0", "127.0.0.1")]).subsets
+        await client.update(ep)
+        assert await resolver == "127.0.0.1"
+    finally:
+        lsn.close()
+        await dns.stop()
+
+
+async def test_resolve_rank0_times_out(monkeypatch):
+    dns, _ = await _dns([_service()])
+    try:
+        _rank_env(monkeypatch, dns)
+        try:
+            await asyncio.to_thread(rdz.resolve_rank0, 0.6)
+        except TimeoutError as e:
+            assert "did not resolve" in str(e)
+        else:
+            raise AssertionError("expected TimeoutError")
+    finally:
+        await dns.stop()
+
+
+def test_backoff_is_capped_exponential_with_jitter():
+    rng = random.Random(7)
+    delays = [rdz._backoff(a, rng) for a in range(12)]
+    for a, d in enumerate(delays):
+        assert 0.0 <= d <= min(rdz.BACKOFF_CAP,
+                               rdz.BACKOFF_BASE * (2 ** a))
+    # Jitter: not all delays collapse onto the cap or zero.
+    assert len({round(d, 6) for d in delays}) > 3
+
+
+def test_coordinator_reachable_probe():
+    lsn = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    lsn.bind(("127.0.0.1", 0))
+    lsn.listen(1)
+    port = lsn.getsockname()[1]
+    try:
+        assert rdz.coordinator_reachable("127.0.0.1", port)
+    finally:
+        lsn.close()
+    assert not rdz.coordinator_reachable("127.0.0.1", port, timeout=0.2)

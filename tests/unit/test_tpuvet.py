@@ -322,6 +322,26 @@ G = Counter("replication_elections_total", "re-registered: silently inert")
     assert len(got) == 1 and "already registered" in got[0].message
 
 
+def test_metric_name_trainjob_family():
+    """The TrainJob controller's metric family (trainjob_*: recovery
+    rounds, checkpoint resumes, last durable step, rank-ready gauge)
+    are valid names; a duplicate registration within the family still
+    flags."""
+    good = """
+from kubernetes_tpu.metrics.registry import Counter, Gauge
+A = Counter("trainjob_restart_rounds_total", "x", labels=("trainjob",))
+B = Counter("trainjob_resumes_total", "x", labels=("trainjob",))
+C = Gauge("trainjob_last_checkpoint_step", "x", labels=("trainjob",))
+D = Gauge("trainjob_workers_ready", "x", labels=("trainjob",))
+"""
+    assert run_source(good, checks=["metric-name"]) == []
+    bad = good + """
+E = Gauge("trainjob_workers_ready", "re-registered: silently inert")
+"""
+    got = run_source(bad, checks=["metric-name"])
+    assert len(got) == 1 and "already registered" in got[0].message
+
+
 def test_metric_name_scaleout_families():
     """The control-plane scale-out metric families — apiserver shard
     workers (apiserver_shard_*), the process-pool codec offload
