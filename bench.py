@@ -65,6 +65,17 @@ def main() -> None:
                             # >900 pods/s (32 starves the creators, 128
                             # balloons bind p99 — see README R14 notes).
                             create_batch=64,
+                            # CompactWireCodec now covers the WRITE
+                            # path too: create/batchCreate/bind bodies
+                            # + batch responses negotiate msgpack, and
+                            # the loadgen submits pre-encoded template
+                            # batches (ROADMAP item-3a/3b residual).
+                            # WatchFanoutBatch is NOT stacked here: on
+                            # this 1-core host with 2-3 watchers the
+                            # sharded flush engine measured a ~20%
+                            # LOSS (857 vs 1107 pods/s same-day) —
+                            # its coalescing needs fan-out width
+                            # (hollow-node fleets, ROADMAP 6a).
                             feature_gates="ApiServerSharding=true,"
                                           "ApiServerCodecOffload=true,"
                                           "SchedulerFastPath=true,"
@@ -179,6 +190,14 @@ def _headline(chip: dict, sched: dict) -> dict:
             "max_share")
         h["decode_share_compact"] = (dshare.get("compact") or {}).get(
             "max_share")
+        # Write-path residual by verb × direction (the per-op seam
+        # attribution decode_share now carries): the apiserver-side
+        # breakdown is what names the NEXT lever, so it rides the
+        # headline beside the aggregate share.
+        for codec in ("json", "compact"):
+            arm = (dshare.get(codec) or {}).get("apiserver") or {}
+            if arm.get("by_op"):
+                h[f"decode_share_{codec}_by_op"] = arm["by_op"]
         gang = sched.get("gang") or {}
         h["gang_rate"] = gang.get("gangs_per_second")
         pre = gang.get("preemption") or {}

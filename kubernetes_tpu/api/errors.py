@@ -98,6 +98,15 @@ class MethodNotAllowedError(StatusError):
     reason = "MethodNotAllowed"
 
 
+class UnsupportedMediaTypeError(StatusError):
+    """The request body's Content-Type is not one this server decodes
+    (reference: 415 from the negotiated-serializer stack) — distinct
+    from 400 so a codec MISMATCH (compact body at a JSON-only server)
+    is diagnosable apart from a garbled body."""
+    code = 415
+    reason = "UnsupportedMediaType"
+
+
 class ServiceUnavailableError(StatusError):
     code = 503
     reason = "ServiceUnavailable"
@@ -109,7 +118,7 @@ _BY_REASON: dict[str, type[StatusError]] = {
         NotFoundError, AlreadyExistsError, ConflictError, InvalidError,
         BadRequestError, ForbiddenError, UnauthorizedError, TimeoutError_,
         TooManyRequestsError, GoneError, MethodNotAllowedError,
-        ServiceUnavailableError, StatusError,
+        UnsupportedMediaTypeError, ServiceUnavailableError, StatusError,
     ]
 }
 

@@ -77,6 +77,12 @@ def _decode_bytes(raw: bytes):
     return json.loads(raw)
 
 
+def _decode_bytes_compact(raw: bytes):
+    """Compact-codec decode twin (CompactWireCodec write bodies)."""
+    from ..util.compactcodec import decode_body
+    return decode_body(raw)
+
+
 def pool_workers() -> int:
     """Worker count for this host: every core but one (the event loop
     keeps its own), overridable via KTPU_CODEC_POOL_WORKERS. 0 = the
@@ -162,25 +168,36 @@ class CodecPool:
             return encode(values)
         return [b for chunk in outs for b in chunk]
 
-    async def decode_body(self, raw: bytes):
-        """``json.loads`` of a request body — pooled when the body is
-        large enough, inline otherwise. Raises the same
-        ``json.JSONDecodeError`` the inline path would."""
+    async def decode_body(self, raw: bytes, codec: str = "json",
+                          op: str = "other"):
+        """Request-body decode — pooled when the body is large enough,
+        inline otherwise. Raises the same decode errors the inline
+        path would (``json.JSONDecodeError``, or the compact codec's
+        ``ValueError`` family when ``codec="compact"``). ``op`` names
+        the verb: inline decodes route through the per-op decode_share
+        seams so by_op attribution survives the offload gate being
+        stacked (pool decodes run in worker processes, outside any
+        profile — nothing to attribute there)."""
+        from ..util.compactcodec import decode_request
         if not self.active or len(raw) < self.min_decode_bytes:
             reason = ("no-workers" if not self.active
                       else "below-threshold")
             CODEC_POOL_INLINE.inc(op="decode", reason=reason)
-            return json.loads(raw)
+            return decode_request(raw, codec, op)
+        decode = _decode_bytes if codec == "json" else _decode_bytes_compact
         import asyncio
         loop = asyncio.get_running_loop()
         try:
             CODEC_POOL_SUBMITS.inc(op="decode")
             CODEC_POOL_ITEMS.inc(op="decode")
             return await loop.run_in_executor(self._get_executor(),
-                                              _decode_bytes, raw)
-        except json.JSONDecodeError:
+                                              decode, raw)
+        except ValueError:
+            # json.JSONDecodeError and the msgpack/framing errors are
+            # all ValueErrors — the caller's 400 mapping, not a pool
+            # failure.
             raise
         except Exception:  # noqa: BLE001 — a dead pool degrades to inline
             self._broken = True
             CODEC_POOL_INLINE.inc(op="decode", reason="pool-error")
-            return json.loads(raw)
+            return decode_request(raw, codec, op)

@@ -1,0 +1,246 @@
+"""Watch fan-out flush batching (gate ``WatchFanoutBatch``, alpha).
+
+PR 9 made each watcher coalesce its pending events into one
+``resp.write``; the measured residual at density scale is the flush
+DISCIPLINE around those writes: every watch handler awaits its own
+socket send inline, so the drain loop parks on a backpressured
+consumer, and N handlers interleave N small write awaits per event
+burst on the shared router loop. This module centralizes the sends:
+
+- each watcher owns a :class:`WatchSink` — a bounded byte buffer the
+  handler appends encoded event frames to (never awaiting);
+- a small pool of flusher workers (watchers sharded across them
+  round-robin) performs ONE buffered writev-style send per sink per
+  flush round — everything a sink accumulated since its last flush
+  goes out in a single ``resp.write``;
+- a slow consumer can stall only its own shard's round, never the
+  whole fan-out; one whose buffer overflows is CLOSED (the client
+  relists — the same contract as the registry watch queue overflow).
+
+Byte-stream equivalence: frames enter a sink in handler order and
+leave in order, concatenated — the same lines/frames, same per-watcher
+order, as the inline write loop; only the coalescing boundary moves.
+Gate off, the module is never imported on the watch path.
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Optional
+
+from ..metrics.registry import Counter, Gauge, Histogram
+from ..util.tasks import spawn
+
+log = logging.getLogger("apiserver.fanout")
+
+FANOUT_FLUSHES = Counter(
+    "apiserver_fanout_flushes_total",
+    "Buffered watch fan-out socket flushes, by flusher shard",
+    labels=("shard",))
+
+FANOUT_FLUSH_EVENTS = Histogram(
+    "apiserver_fanout_flush_events",
+    "Watch events coalesced into one fan-out flush",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024))
+
+FANOUT_FLUSH_BYTES = Histogram(
+    "apiserver_fanout_flush_bytes",
+    "Bytes per buffered fan-out flush",
+    buckets=(256, 1024, 4096, 16384, 65536, 262144, 1048576))
+
+FANOUT_OVERFLOWS = Counter(
+    "apiserver_fanout_overflows_total",
+    "Watch sinks closed because a slow consumer overflowed its buffer")
+
+FANOUT_SINKS = Gauge(
+    "apiserver_fanout_sinks",
+    "Watch sinks currently registered with the fan-out flusher")
+
+
+class WatchSink:
+    """Per-watcher buffered writer. The owning watch handler is the
+    only pusher; the shard's flusher worker (same event loop) is the
+    only sender while registered. ``closed`` flips on overflow or a
+    dead peer — the handler sees it and ends the stream."""
+
+    __slots__ = ("resp", "closed", "in_flight", "_buf", "_events",
+                 "_shard", "_limit")
+
+    def __init__(self, resp, shard, limit: int):
+        self.resp = resp
+        self.closed = False
+        #: True while the flusher worker awaits a send of taken bytes —
+        #: the final handler-side drain must wait it out to keep the
+        #: byte stream ordered.
+        self.in_flight = False
+        self._buf = bytearray()
+        self._events = 0
+        self._shard = shard
+        self._limit = limit
+
+    def push(self, line: bytes) -> None:
+        """Queue one encoded event frame; wakes the shard's flusher.
+        Overflow closes the sink instead of growing without bound — a
+        consumer that cannot keep up with the fan-out must relist, not
+        balloon apiserver memory."""
+        if self.closed:
+            return
+        if len(self._buf) + len(line) > self._limit:
+            self.closed = True
+            FANOUT_OVERFLOWS.inc()
+            return
+        self._buf += line
+        self._events += 1
+        self._shard.wake.set()
+
+    def take(self) -> tuple[bytes, int]:
+        """Swap out everything pending: (bytes, event count)."""
+        if not self._buf:
+            return b"", 0
+        out, n = bytes(self._buf), self._events
+        self._buf = bytearray()
+        self._events = 0
+        return out, n
+
+
+class _Shard:
+    __slots__ = ("idx", "wake", "sinks", "task", "stopping")
+
+    def __init__(self, idx: int):
+        self.idx = idx
+        self.wake = asyncio.Event()
+        self.sinks: set = set()
+        self.task = None
+        #: Cooperative shutdown flag: cancellation alone is NOT a
+        #: reliable exit on py3.10 — wait_for swallows an outer cancel
+        #: that races the inner write's completion (bpo-37658 family),
+        #: which would leave the worker parked forever and stop()'s
+        #: gather waiting on it.
+        self.stopping = False
+
+
+class FanoutFlusher:
+    """The flush engine: ``shards`` worker tasks, each draining its
+    own subset of sinks per round. Construction is inert (no tasks
+    until the first register); built by the apiserver on the router
+    loop — the loop every watch response writes from."""
+
+    def __init__(self, shards: int = 4, overflow_limit: int = 4 << 20,
+                 write_timeout: float = 5.0):
+        self._shards = [_Shard(i) for i in range(max(1, shards))]
+        self._rr = 0
+        self.overflow_limit = overflow_limit
+        #: Bound on one sink's socket send: a stalled-but-connected
+        #: consumer (TCP zero window) must cost its shard at most this
+        #: long, not park the worker forever — past it the sink is
+        #: closed like an overflow (the client relists).
+        self.write_timeout = write_timeout
+
+    def register(self, resp) -> WatchSink:
+        shard = self._shards[self._rr % len(self._shards)]
+        self._rr += 1
+        if shard.task is None or shard.task.done():
+            # done() covers a worker killed by an unexpected exception
+            # (spawn() logs it): the shard must revive, or a quarter
+            # of all watchers would silently stop receiving events.
+            shard.stopping = False
+            shard.task = spawn(self._run(shard),
+                               name=f"watch-fanout-{shard.idx}")
+        sink = WatchSink(resp, shard, self.overflow_limit)
+        shard.sinks.add(sink)
+        FANOUT_SINKS.set(float(sum(len(s.sinks) for s in self._shards)))
+        return sink
+
+    def discard(self, sink: WatchSink) -> None:
+        """Synchronous removal — safe mid-cancellation, never leaks a
+        sink into future flush rounds."""
+        sink._shard.sinks.discard(sink)
+        FANOUT_SINKS.set(float(sum(len(s.sinks) for s in self._shards)))
+
+    async def drain(self, sink: WatchSink, timeout: float = 1.0) -> None:
+        """Final handler-side flush after :meth:`discard`: wait out an
+        in-flight worker send (ordering), then write the remainder
+        directly — the handler owns the response again. Bounded: a
+        worker parked on a dead/backpressured peer must not pin this
+        handler past ``timeout`` (the stream is ending either way)."""
+        deadline = asyncio.get_running_loop().time() + timeout
+        while sink.in_flight:
+            if asyncio.get_running_loop().time() >= deadline:
+                return
+            await asyncio.sleep(0.005)
+        buf, _n = sink.take()
+        if buf and not sink.closed:
+            await sink.resp.write(buf)
+
+    async def _run(self, shard: _Shard) -> None:
+        label = str(shard.idx)
+        while not shard.stopping:
+            await shard.wake.wait()
+            if shard.stopping:
+                return
+            # Micro-batch: the first push of a burst wakes this worker,
+            # but the pushing handlers are still draining their watch
+            # queues on this same loop — yield once so the whole burst
+            # lands in the sink buffers, then flush it as ONE send per
+            # sink. Without this the worker takes 1-event buffers and
+            # the coalescing the engine exists for never happens.
+            await asyncio.sleep(0)
+            shard.wake.clear()
+            try:
+                for sink in list(shard.sinks):
+                    buf, n = sink.take()
+                    if not buf or sink.closed:
+                        continue
+                    sink.in_flight = True
+                    try:
+                        await asyncio.wait_for(sink.resp.write(buf),
+                                               self.write_timeout)
+                    except asyncio.TimeoutError:
+                        # Stalled consumer: the contract is "a slow
+                        # watcher stalls its shard for one bounded
+                        # round", never indefinitely.
+                        sink.closed = True
+                        FANOUT_OVERFLOWS.inc()
+                        continue
+                    except (OSError, RuntimeError):
+                        # Peer gone (any ConnectionError/BrokenPipe
+                        # flavor) or response already finished: close
+                        # THIS sink only — one dead watcher must never
+                        # kill the shard's worker and silence its
+                        # siblings. Failed sends don't count as
+                        # flushes.
+                        sink.closed = True
+                        continue
+                    finally:
+                        sink.in_flight = False
+                    FANOUT_FLUSHES.inc(shard=label)
+                    FANOUT_FLUSH_EVENTS.observe(float(n))
+                    FANOUT_FLUSH_BYTES.observe(float(len(buf)))
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001
+                # A surprise in the round body (a metrics edit, a
+                # future refactor) must not kill this worker forever —
+                # that would silently stall every sink on the shard
+                # until the next register() revived it.
+                log.warning("fanout shard %s flush round failed: %s",
+                            label, e)
+
+    async def stop(self) -> None:
+        tasks = []
+        for shard in self._shards:
+            # Flag + wake FIRST: a worker that loses its cancel to the
+            # py3.10 wait_for race still exits at the next loop check.
+            shard.stopping = True
+            shard.wake.set()
+            if shard.task is not None:
+                shard.task.cancel()
+                tasks.append(shard.task)
+                shard.task = None
+            shard.sinks.clear()
+        if tasks:
+            # Await the teardown: a worker parked in a write must
+            # unwind before the server tears the loop down, or
+            # shutdown leaves destroyed-pending task warnings behind.
+            await asyncio.gather(*tasks, return_exceptions=True)
+        FANOUT_SINKS.set(0.0)

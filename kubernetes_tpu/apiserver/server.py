@@ -90,6 +90,15 @@ BATCH_REQUESTS = Counter(
     "Batch API requests (:batchCreate / bindings:batch) by kind",
     labels=("kind",))
 
+#: Write-path compact negotiation (CompactWireCodec on the CREATE /
+#: batchCreate / bindings:batch bodies): how many request bodies each
+#: verb decoded from the compact codec — the instrument that says the
+#: write path actually negotiated, not just the LIST/watch half.
+COMPACT_WRITE_REQUESTS = Counter(
+    "apiserver_compact_write_requests_total",
+    "Write-path request bodies decoded from the compact wire codec, "
+    "by verb", labels=("verb",))
+
 BATCH_ITEMS = Counter(
     "apiserver_batch_items_total",
     "Per-item outcomes inside batch API requests",
@@ -182,6 +191,10 @@ class APIServer:
         #: write per event was a measured syscall cost at density
         #: scale (the fan-out's send() dominated apiserver CPU).
         self.watch_write_batch = 128
+        #: FanoutFlusher when WatchFanoutBatch is on (built lazily at
+        #: the first gated watch); None = per-watcher inline writes,
+        #: byte-identical.
+        self.fanout = None
         self.app = web.Application(middlewares=[self._middleware])
         self._routes()
         self._runner: Optional[web.AppRunner] = None
@@ -563,8 +576,15 @@ class APIServer:
         if request.method in ("POST", "PUT", "PATCH") and \
                 self.audit.wants_body(attrs.user, attrs.verb,
                                       attrs.resource, attrs.namespace):
+            from ..util import compactcodec
             try:
-                body = json.loads(await request.read())
+                raw = await request.read()
+                if request.content_type == compactcodec.CONTENT_TYPE:
+                    # Compact-negotiated write bodies audit the same
+                    # decoded value the handler saw, not _unreadable.
+                    body = compactcodec.decode_body(raw)
+                else:
+                    body = json.loads(raw)
             except Exception:  # noqa: BLE001 — audit must never alter
                 body = {"_unreadable": True}  # the response (disconnects,
                 # payload errors, bad JSON all land here)
@@ -1290,17 +1310,30 @@ class APIServer:
         is_watch = request.query.get("watch") in ("1", "true")
         timeout = aiohttp.ClientTimeout(
             total=None if is_watch else 60.0)
+        # Forward the negotiation headers UNTOUCHED (raw header values,
+        # parameters included): the extension decodes the body by the
+        # caller's exact Content-Type — a compact-negotiated write must
+        # not arrive re-labeled, and aiohttp must not substitute its
+        # octet-stream default for a body whose type the caller named.
+        fwd_headers = {}
+        for name in ("Content-Type", "Accept"):
+            value = request.headers.get(name)
+            if value is not None:
+                fwd_headers[name] = value
         try:
             upstream = await self._proxy_sess().request(
                 request.method, url, data=body or None, timeout=timeout,
-                headers={k: v for k, v in request.headers.items()
-                         if k.lower() in ("content-type", "accept")})
+                headers=fwd_headers)
         except (aiohttp.ClientError, asyncio.TimeoutError) as e:
             return self._err(errors.ServiceUnavailableError(
                 f"aggregated apiserver unreachable: {e}"))
         try:
             resp = web.StreamResponse(status=upstream.status)
-            resp.content_type = upstream.content_type or "application/json"
+            # The response Content-Type rides back verbatim too —
+            # ``upstream.content_type`` would strip parameters (e.g.
+            # a charset) the extension set.
+            resp.headers["Content-Type"] = upstream.headers.get(
+                "Content-Type", "application/json")
             await resp.prepare(request)
             async for chunk in upstream.content.iter_any():
                 await resp.write(chunk)
@@ -1318,17 +1351,61 @@ class APIServer:
         ns = request.match_info.get("namespace", "")
         return plural, ns
 
-    async def _body_obj(self, request):
+    async def _body_obj(self, request, op: str = "other"):
+        """Request body -> value, negotiated by ``Content-Type``.
+
+        JSON is the default and the fallback for every media type this
+        server does not know better (the patch media types, bare
+        octet-stream POSTs). The compact codec's type decodes framed
+        msgpack when the gate is on; any OTHER ``application/x-ktpu-*``
+        type — or the compact type at a gate-off server — is a clean
+        415, so a codec mismatch is diagnosable instead of surfacing
+        as "invalid JSON body". ``op`` names the verb for the
+        decode-share seams and the compact-write metrics."""
         raw = await request.read()
+        from ..util import compactcodec
+        ctype = request.content_type
+        if ctype.startswith("application/x-ktpu"):
+            if ctype != compactcodec.CONTENT_TYPE:
+                raise errors.UnsupportedMediaTypeError(
+                    f"unsupported media type {ctype!r}: this server "
+                    f"speaks {compactcodec.CONTENT_TYPE} and "
+                    f"application/json")
+            if not compactcodec.enabled():
+                raise errors.UnsupportedMediaTypeError(
+                    f"{ctype} not negotiated: the CompactWireCodec "
+                    f"gate is off on this server (send "
+                    f"application/json)")
+            try:
+                if self.codec_pool is not None:
+                    data = await self.codec_pool.decode_body(
+                        raw, codec="compact", op=op)
+                else:
+                    data = compactcodec.decode_request(raw, "compact", op)
+            except ValueError as e:
+                raise errors.BadRequestError(
+                    f"invalid compact ({ctype}) body: {e}") from None
+            COMPACT_WRITE_REQUESTS.inc(verb=op)
+            compactcodec.count_request("compact", f"{op}_decode",
+                                       len(raw))
+            return data
         try:
             if self.codec_pool is not None:
                 # ApiServerCodecOffload: large bodies (512-item
                 # batchCreate payloads) parse off the event loop; the
                 # pool's size threshold keeps small ones inline.
-                return await self.codec_pool.decode_body(raw)
-            data = json.loads(raw)
+                data = await self.codec_pool.decode_body(raw, op=op)
+            else:
+                data = compactcodec.decode_request(raw, "json", op)
         except json.JSONDecodeError as e:
-            raise errors.BadRequestError(f"invalid JSON body: {e}") from None
+            raise errors.BadRequestError(
+                f"invalid JSON body ({ctype or 'application/json'}): "
+                f"{e}") from None
+        if compactcodec.enabled():
+            # Like-for-like codec_wire_* accounting (the LIST path's
+            # rule): the JSON half counts too while the gate is on, so
+            # a json-vs-compact write-path delta is computable.
+            compactcodec.count_request("json", f"{op}_decode", len(raw))
         return data
 
     async def _mutate(self, fn, *args):
@@ -1336,6 +1413,17 @@ class APIServer:
         (:meth:`Registry.run`): inline for in-memory stores, worker
         thread when a WAL append can block on disk."""
         return await self.registry.run(fn, *args)
+
+    @staticmethod
+    def _accepts_compact(request) -> bool:
+        """Did this request negotiate a compact RESPONSE body (gate on
+        AND the Accept header asks)? Content-Type (the request body's
+        codec) is negotiated independently in :meth:`_body_obj` — a
+        client may mix."""
+        from ..util import compactcodec
+        return (compactcodec.enabled()
+                and compactcodec.accepts_compact(
+                    request.headers.get("Accept", "")))
 
     # -- verb handlers ----------------------------------------------------
 
@@ -1345,7 +1433,7 @@ class APIServer:
             return await self._batch_create(
                 request, plural[: -len(":batchCreate")], ns)
         spec = self.registry.spec_for(plural)
-        data = await self._body_obj(request)
+        data = await self._body_obj(request, op="create")
         conv = self._conv_version(request, spec)
         created = await self._create_one(plural, ns, spec, data, conv)
         if plural.endswith("webhookconfigurations"):
@@ -1357,10 +1445,29 @@ class APIServer:
             d = to_dict(created)
             rv = d.get("metadata", {}).pop("resource_version", None)
             if rv is not None:
+                from ..util import compactcodec
                 key = self.registry._key(spec, created.metadata.namespace,
                                          created.metadata.name)
+                if self._accepts_compact(request):
+                    # Negotiated compact response: one frame around
+                    # the cached compact payload (shared with the
+                    # watch fan-out's frame for this same revision).
+                    body = compactcodec.encode_response_create(
+                        lambda: compactcodec.frame(
+                            self.registry.encoded_value(
+                                key, d, int(rv), codec="compact")))
+                    compactcodec.count_request("compact",
+                                               "create_encode",
+                                               len(body))
+                    return web.Response(
+                        body=body, status=201,
+                        content_type=compactcodec.CONTENT_TYPE)
+                body = self.registry.encoded_value(key, d, int(rv))
+                if compactcodec.enabled():
+                    compactcodec.count_request("json", "create_encode",
+                                               len(body))
                 return web.Response(
-                    body=self.registry.encoded_value(key, d, int(rv)),
+                    body=body,
                     status=201, content_type="application/json")
         return self._obj_response(created, status=201, convert=conv)
 
@@ -1437,11 +1544,42 @@ class APIServer:
                 await asyncio.sleep(0)  # let watchers/requests breathe
         return outs
 
-    @staticmethod
-    def _batch_response(kind: str, results: list,
-                        emit=None) -> web.Response:
+    def _batch_response(self, request, kind: str, results: list,
+                        emit=None, emit_compact=None,
+                        compact_ok: bool = True) -> web.Response:
         """Positional per-item BatchResult from ``(obj, err)`` pairs;
-        ``emit(obj) -> dict | None`` adds a success payload."""
+        ``emit(obj) -> dict | None`` adds a success payload on the
+        JSON path, ``emit_compact(obj) -> bytes | None`` its compact
+        twin (pre-encoded payload — typically the serialize-once
+        cache line, embedded without a re-pack). The response body is
+        compact when the request negotiated it via Accept (and
+        ``compact_ok`` — version-converting requests stay JSON),
+        byte-identical JSON otherwise."""
+        from ..util import compactcodec
+        if compact_ok and self._accepts_compact(request):
+            def assemble() -> bytes:
+                payloads = []
+                for obj, err in results:
+                    if err is not None:
+                        BATCH_ITEMS.inc(kind=kind, result="error")
+                        payloads.append(compactcodec.batch_item_payload(
+                            err.code, error=err.to_dict()))
+                    else:
+                        BATCH_ITEMS.inc(kind=kind, result="ok")
+                        payloads.append(compactcodec.batch_item_payload(
+                            201, obj_payload=(emit_compact(obj)
+                                              if emit_compact is not None
+                                              else None)))
+                return compactcodec.encode_batch_body(
+                    payloads, envelope={"kind": "BatchResult"})
+            enc_seam = (compactcodec.encode_response_batch_create
+                        if kind == "create"
+                        else compactcodec.encode_response_bind)
+            body = enc_seam(assemble)
+            compactcodec.count_request("compact", f"{kind}_batch_encode",
+                                       len(body))
+            return web.Response(body=body,
+                                content_type=compactcodec.CONTENT_TYPE)
         out_items = []
         for obj, err in results:
             if err is not None:
@@ -1454,7 +1592,19 @@ class APIServer:
                 if payload is not None:
                     item["object"] = payload
                 out_items.append(item)
-        return web.json_response({"kind": "BatchResult", "items": out_items})
+        # The per-verb encode seam (decode_share attribution) produces
+        # exactly web.json_response's default bytes; Response(text=...)
+        # with this content type is the same wire surface.
+        dumps_seam = (compactcodec.dumps_response_batch_create
+                      if kind == "create"
+                      else compactcodec.dumps_response_bind)
+        text = dumps_seam({"kind": "BatchResult", "items": out_items})
+        if compactcodec.enabled():
+            # Like-for-like codec_wire_* accounting with the compact
+            # branch above (the LIST path's rule).
+            compactcodec.count_request("json", f"{kind}_batch_encode",
+                                       len(text))
+        return web.Response(text=text, content_type="application/json")
 
     async def _batch_create(self, request, plural: str, ns: str):
         """POST ``{plural}:batchCreate`` — N creates in one request.
@@ -1464,7 +1614,8 @@ class APIServer:
         error for the batch: the response carries a positional per-item
         status (201 + object, or the item's error Status)."""
         spec = self.registry.spec_for(plural)
-        items = self._batch_items(await self._body_obj(request), "object")
+        items = self._batch_items(
+            await self._body_obj(request, op="batch_create"), "object")
         BATCH_REQUESTS.inc(kind="create")
         conv = self._conv_version(request, spec)
         # ``?echo=0``: omit created objects from the response — bulk
@@ -1510,7 +1661,24 @@ class APIServer:
             return (self.registry.scheme.from_hub(conv, created.kind, d)
                     if conv else d)
 
-        return self._batch_response("create", results, emit)
+        def emit_compact(created):
+            """Echoed object as the serialize-once cache's compact
+            payload — the same bytes the watch fan-out frames for
+            this revision."""
+            if not echo:
+                return None
+            d = to_dict(created)
+            rv = d.get("metadata", {}).pop("resource_version", None)
+            if rv is None:
+                from ..util import compactcodec
+                return compactcodec.encode_obj(d)
+            key = self.registry._key(spec, created.metadata.namespace,
+                                     created.metadata.name)
+            return self.registry.encoded_value(key, d, int(rv),
+                                               codec="compact")
+
+        return self._batch_response(request, "create", results, emit,
+                                    emit_compact, compact_ok=not conv)
 
     async def _bind_batch(self, request):
         """POST ``pods/bindings:batch`` — N scheduler binds, one
@@ -1523,7 +1691,7 @@ class APIServer:
         if plural != "pods":
             raise errors.BadRequestError(
                 f"bindings:batch is a pods subresource, not {plural!r}")
-        items = self._batch_items(await self._body_obj(request),
+        items = self._batch_items(await self._body_obj(request, op="bind"),
                                   '{"name": ..., "target": {...}}')
         BATCH_REQUESTS.inc(kind="bind")
         from ..api.scheme import from_dict
@@ -1550,7 +1718,7 @@ class APIServer:
                 functools.partial(self.registry.bind_pods_batch, ns), pairs)
             for i, res in zip(idxs, outs):
                 results[i] = res
-        return self._batch_response("bind", results)
+        return self._batch_response(request, "bind", results)
 
     async def _get(self, request):
         plural, ns = self._ctx(request)
@@ -1749,23 +1917,27 @@ class APIServer:
                 d = self.registry.scheme.from_hub(conv, spec.kind, d)
             return json.dumps({"type": etype, "object": d}).encode() + b"\n"
 
+        def bookmark_line() -> bytes:
+            # Bookmark keeps the connection alive and advances the
+            # client's resume point (reference: watch bookmarks).
+            bookmark = {
+                "type": "BOOKMARK",
+                "object": {"metadata": {"resource_version": str(self.registry.store.revision)}},
+            }
+            if compact:
+                return compactcodec.frame(compactcodec.encode_obj(bookmark))
+            return json.dumps(bookmark).encode() + b"\n"
+
+        from ..util.features import GATES
+        if GATES.enabled("WatchFanoutBatch"):
+            return await self._watch_fanout(resp, watch, event_line,
+                                            bookmark_line)
         try:
             closed = False
             while not closed:
                 ev = await watch.next(timeout=10.0)
                 if ev is None:
-                    # Bookmark keeps the connection alive and advances the
-                    # client's resume point (reference: watch bookmarks).
-                    bookmark = {
-                        "type": "BOOKMARK",
-                        "object": {"metadata": {"resource_version": str(self.registry.store.revision)}},
-                    }
-                    if compact:
-                        await resp.write(compactcodec.frame(
-                            compactcodec.encode_obj(bookmark)))
-                    else:
-                        await resp.write(json.dumps(bookmark).encode()
-                                         + b"\n")
+                    await resp.write(bookmark_line())
                     continue
                 # Coalesce every event already in flight into ONE
                 # socket write: per-event writes made the fan-out's
@@ -1791,6 +1963,60 @@ class APIServer:
             pass
         finally:
             watch.cancel()
+        return resp
+
+    async def _watch_fanout(self, resp, watch, event_line,
+                            bookmark_line) -> web.StreamResponse:
+        """The WatchFanoutBatch half of :meth:`_watch`: this handler
+        never writes the socket inline — it drains its registry watch
+        queue into a per-watcher sink, and the shared FanoutFlusher's
+        sharded workers coalesce each sink's pending frames into one
+        buffered send per flush round (see apiserver/fanout.py). Same
+        frames, same per-watcher order; a slow consumer stalls only
+        its own shard, an overflowing one is closed (client relists)."""
+        if self.fanout is None:
+            from .fanout import FanoutFlusher
+            self.fanout = FanoutFlusher()
+        # Local ref: stop() may null self.fanout while this handler is
+        # still unwinding — cleanup must use the engine it registered
+        # with.
+        fanout = self.fanout
+        sink = fanout.register(resp)
+        try:
+            closed = False
+            while not closed and not sink.closed:
+                ev = await watch.next(timeout=10.0)
+                if ev is None:
+                    sink.push(bookmark_line())
+                    continue
+                pushed = 0
+                while True:
+                    line = event_line(ev)
+                    if line is None:
+                        closed = True
+                        break
+                    sink.push(line)
+                    if sink.closed:
+                        break
+                    pushed += 1
+                    if pushed % self.watch_write_batch == 0:
+                        # Yield mid-drain: pushes never await, and a
+                        # deep backlog must not monopolize the loop.
+                        await asyncio.sleep(0)
+                    ev = watch.next_nowait()
+                    if ev is None:
+                        break
+        except (ConnectionResetError, asyncio.CancelledError):
+            pass
+        finally:
+            watch.cancel()
+            fanout.discard(sink)
+            try:
+                # Best-effort final flush of frames a worker has not
+                # sent yet; the stream is ending either way.
+                await fanout.drain(sink)
+            except (OSError, RuntimeError, asyncio.CancelledError):
+                pass
         return resp
 
     async def _update(self, request):
@@ -1994,7 +2220,7 @@ class APIServer:
         plural, ns = self._ctx(request)
         sub = request.match_info.get("subresource", "")
         if plural == "pods" and sub == "binding":
-            data = await self._body_obj(request)
+            data = await self._body_obj(request, op="bind")
             from ..api.scheme import from_dict
             from ..api.types import Binding
             binding = from_dict(Binding, data)
@@ -2065,6 +2291,9 @@ class APIServer:
         if self.codec_pool is not None:
             self.codec_pool.shutdown()
             self.codec_pool = None
+        if self.fanout is not None:
+            await self.fanout.stop()
+            self.fanout = None
         await self.webhooks.close()
         if self._proxy_session is not None and not self._proxy_session.closed:
             await self._proxy_session.close()

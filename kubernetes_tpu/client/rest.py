@@ -459,13 +459,15 @@ class RESTClient(Client):
             raise err
         from ..util import compactcodec
         if resp.content_type == compactcodec.CONTENT_TYPE:
-            # Negotiated compact LIST body (the server only answers
-            # compact when this client asked via Accept): decode to the
-            # exact dict shape resp.json() yields on the JSON path.
+            # Negotiated compact body (the server only answers compact
+            # when this client asked via Accept): LIST envelopes,
+            # BatchResult envelopes, and single created objects all
+            # decode to the exact shape resp.json() yields on the
+            # JSON path.
             body = await resp.read()
-            compactcodec.count_request("compact", "list_decode",
+            compactcodec.count_request("compact", "response_decode",
                                        len(body))
-            return compactcodec.decode_list_body(body)
+            return compactcodec.decode_body(body)
         return await resp.json()
 
     def _read_endpoint(self) -> str:
@@ -696,7 +698,8 @@ class RESTClient(Client):
             gvk = (obj.api_version, obj.kind)
         plural = await self._plural_for_kind(gvk[1])
         url = self._url_for(gvk[0], plural, obj.metadata.namespace)
-        data = await self._request("POST", url, json=to_dict(obj))
+        data = await self._request("POST", url,
+                                   **self._write_body_kw(to_dict(obj)))
         return decode_obj(data)
 
     async def _plural_for_kind(self, kind: str) -> str:
@@ -724,6 +727,35 @@ class RESTClient(Client):
         identical to the ungated client."""
         from ..util import compactcodec
         return compactcodec.accept_header()
+
+    @staticmethod
+    def _write_body_kw(payload: dict) -> dict:
+        """Request kwargs for ONE write body (create, binding):
+        framed msgpack with Content-Type/Accept negotiation when the
+        CompactWireCodec gate is on in this process, byte-identical
+        ``json=`` otherwise. A gate-off server from the write-path PR
+        onward answers the compact form with a diagnosable 415; a
+        PRE-codec server (no Content-Type negotiation at all) answers
+        400 "invalid JSON body" — either way a refusal, never a
+        guess."""
+        from ..util import compactcodec
+        headers = compactcodec.write_headers()
+        if headers is None:
+            return {"json": payload}
+        return {"data": compactcodec.encode_obj_body(payload),
+                "headers": headers}
+
+    @staticmethod
+    def _batch_body_kw(items: list) -> dict:
+        """The multi-item twin of :meth:`_write_body_kw` for the
+        ``:batchCreate`` / ``bindings:batch`` bodies."""
+        from ..util import compactcodec
+        headers = compactcodec.write_headers()
+        if headers is None:
+            return {"json": {"items": items}}
+        return {"data": compactcodec.encode_batch_body(
+                    [compactcodec.encode_obj(i) for i in items]),
+                "headers": headers}
 
     async def list(self, plural: str, namespace: str = "", label_selector: str = "",
                    field_selector: str = "", chunk_size: int = 0) -> tuple[list, int]:
@@ -847,7 +879,8 @@ class RESTClient(Client):
         keep-alive session (_sess): sequential binds reuse ONE pooled
         connection, bounded by ``conn_limit_per_host`` under fan-out."""
         url = self._url_for("core/v1", "pods", namespace, name, "binding")
-        data = await self._request("POST", url, json=to_dict(binding))
+        data = await self._request("POST", url,
+                                   **self._write_body_kw(to_dict(binding)))
         return decode_obj(data) if decode else None
 
     async def bind_many(self, namespace: str, bindings: list) -> list:
@@ -865,7 +898,8 @@ class RESTClient(Client):
         url = self._url_for("core/v1", "pods", namespace, "bindings:batch")
         items = [{"name": name, **to_dict(binding)}
                  for name, binding in bindings]
-        data = await self._request("POST", url, json={"items": items})
+        data = await self._request("POST", url,
+                                   **self._batch_body_kw(items))
         out: list = []
         for item in data.get("items", []):
             err = item.get("error")
@@ -900,8 +934,9 @@ class RESTClient(Client):
             url = self._url_for(gv, f"{plural}:batchCreate", ns)
             if not decode:
                 url += "?echo=0"
-            payload = {"items": [to_dict(objs[i]) for i in idxs]}
-            data = await self._request("POST", url, json=payload)
+            data = await self._request(
+                "POST", url,
+                **self._batch_body_kw([to_dict(objs[i]) for i in idxs]))
             items = data.get("items", [])
             for pos, i in enumerate(idxs):
                 if pos >= len(items):
@@ -912,6 +947,37 @@ class RESTClient(Client):
                 elif decode:
                     results[i] = decode_obj(items[pos]["object"])
         return results
+
+    async def create_many_encoded(self, plural: str, namespace: str,
+                                  item_payloads: list,
+                                  api_version: str = "core/v1") -> list:
+        """One ``{plural}:batchCreate`` round trip from PRE-ENCODED
+        compact item payloads (``compactcodec.BodyTemplate`` renders) —
+        the bulk submitter's zero-encode path: no ``to_dict`` walk, no
+        per-object pack, no echoed objects (``?echo=0``). Requires the
+        CompactWireCodec gate in this process; returns positional
+        per-item outcomes (None, or StatusError) like
+        :meth:`create_many`."""
+        from ..util import compactcodec
+        headers = compactcodec.write_headers()
+        if headers is None:
+            raise RuntimeError(
+                "create_many_encoded needs the CompactWireCodec gate "
+                "(and the msgpack wheel) in this process")
+        url = (self._url_for(api_version, f"{plural}:batchCreate",
+                             namespace) + "?echo=0")
+        data = await self._request(
+            "POST", url, data=compactcodec.encode_batch_body(item_payloads),
+            headers=headers)
+        out: list = []
+        for item in data.get("items", []):
+            err = item.get("error")
+            out.append(errors.StatusError.from_dict(err) if err else None)
+        # Positional contract, as in bind_many: a short answer must not
+        # silently mark trailing items created.
+        while len(out) < len(item_payloads):
+            out.append(errors.StatusError("batch response truncated"))
+        return out
 
     async def evict(self, namespace: str, name: str, eviction: Any) -> Any:
         url = self._url_for("core/v1", "pods", namespace, name, "eviction")

@@ -42,14 +42,35 @@ _CODEC_FUNCS = ("loads", "dumps", "to_dict", "from_dict", "decode",
                 "encode", "__decode", "raw_decode", "iterencode",
                 "scanstring", "_from_dict", "_to_dict")
 
+#: Verb × direction attribution: the named per-op seam functions every
+#: write body passes through (util/compactcodec.py — decode_request_*
+#: on the request side, the dumps_/encode_response_* wrappers on the
+#: response side). CUMULATIVE time of these frames is the codec cost
+#: OF THAT VERB AND DIRECTION (json or msgpack children included), so
+#: the next perf PR attacks the measured residual, not a guess.
+_OP_SEAMS = {
+    "decode_request_create": "create.request_decode",
+    "decode_request_batch_create": "batch_create.request_decode",
+    "decode_request_bind": "bind.request_decode",
+    "decode_request_other": "other.request_decode",
+    "encode_response_create": "create.response_encode",
+    "dumps_response_batch_create": "batch_create.response_encode",
+    "encode_response_batch_create": "batch_create.response_encode",
+    "dumps_response_bind": "bind.response_encode",
+    "encode_response_bind": "bind.response_encode",
+}
+
 
 def codec_share(stats_path: str) -> dict:
-    """{total_s, codec_s, share} from a cProfile stats dump, by
-    EXCLUSIVE (tottime) attribution so frames are counted once."""
+    """{total_s, codec_s, share, by_op} from a cProfile stats dump, by
+    EXCLUSIVE (tottime) attribution so frames are counted once;
+    ``by_op`` breaks the write path out by verb × direction from the
+    named seam frames' cumulative time."""
     st = pstats.Stats(stats_path)
     total = 0.0
     codec = 0.0
     rows = []
+    by_op: dict[str, float] = {}
     for (fname, _line, func), (cc, nc, tt, ct, callers) in \
             st.stats.items():  # noqa: B007
         total += tt
@@ -65,12 +86,17 @@ def codec_share(stats_path: str) -> dict:
         if is_codec:
             codec += tt
             rows.append((tt, f"{os.path.basename(fname)}:{func}"))
+        if func in _OP_SEAMS and fname.endswith("util/compactcodec.py"):
+            by_op[_OP_SEAMS[func]] = by_op.get(_OP_SEAMS[func], 0.0) + ct
     rows.sort(reverse=True)
     return {
         "total_cpu_s": round(total, 3),
         "codec_cpu_s": round(codec, 3),
         "share": round(codec / total, 4) if total else 0.0,
         "top_codec_frames": [f"{name} {tt:.2f}s" for tt, name in rows[:6]],
+        "by_op": {op: round(s, 3)
+                  for op, s in sorted(by_op.items(),
+                                      key=lambda kv: -kv[1]) if s > 0.0},
     }
 
 

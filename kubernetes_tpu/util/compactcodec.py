@@ -25,6 +25,23 @@ Wire format (``application/x-ktpu-compact``):
   "object": obj}``, hand-assembled as a fixmap header + pre-encoded
   object bytes so the cached per-revision encoding is reused without
   a re-pack (:func:`event_frame`). Bookmarks are ordinary events.
+- **write bodies** (the full write path: ``CREATE`` /
+  ``{plural}:batchCreate`` / ``bindings:batch`` requests, negotiated
+  per request via ``Content-Type``; and their responses, via
+  ``Accept``) — a single-object body is ONE frame holding the object
+  map; a multi-item body is an envelope frame carrying ``"n": N``
+  (plus any response fields, e.g. ``"kind": "BatchResult"``) followed
+  by N item frames. :func:`decode_body` tells the two apart by the
+  reserved top-level ``"n"`` key — no wire kind carries one — and
+  yields exactly the dict shape the JSON path's ``json.loads`` would
+  (items folded back under ``"items"``), so every existing caller
+  decodes identically.
+- **body templates** — :class:`BodyTemplate` pre-encodes a write body
+  whose items differ only in one string field (a load generator's pod
+  name): render is a small ``packb`` of the varying value between two
+  cached byte halves, so bulk submitters pay ZERO per-item object
+  encode (ROADMAP 3b: the harness's own encode cost was capping the
+  measurement).
 
 Value model: msgpack round-trips exactly the JSON value universe the
 scheme's ``to_dict`` emits (str/float/int/bool/None/list/str-keyed
@@ -34,8 +51,9 @@ included.
 """
 from __future__ import annotations
 
+import json as _json
 import struct
-from typing import Iterator, Optional
+from typing import Callable, Iterator, Optional
 
 try:  # the wheel is baked into the image; gate stays inert without it
     import msgpack as _msgpack
@@ -86,6 +104,18 @@ def accept_header() -> Optional[dict]:
     if not enabled():
         return None
     return {"Accept": CONTENT_TYPE + ", application/json"}
+
+
+def write_headers() -> Optional[dict]:
+    """The write-path negotiation twin of :func:`accept_header`:
+    ``Content-Type`` names the compact request body, ``Accept`` offers
+    compact for the response (a JSON answer stays acceptable — a
+    server with its gate off decodes nothing and 415s, never guesses).
+    None when the gate/wheel says JSON-only."""
+    if not enabled():
+        return None
+    return {"Content-Type": CONTENT_TYPE,
+            "Accept": CONTENT_TYPE + ", application/json"}
 
 
 def cache_which(which: str, codec: str) -> str:
@@ -150,8 +180,14 @@ class FrameDecoder:
             del self._buf[:end]
             yield payload
 
+    @property
+    def pending(self) -> int:
+        """Buffered bytes not yet forming a complete frame — nonzero
+        after a finite body means truncation."""
+        return len(self._buf)
 
-# -- LIST bodies ------------------------------------------------------------
+
+# -- framed bodies (LIST/batch responses, write-path requests) --------------
 
 def list_envelope(revision: int, n_items: int,
                   continue_token: str = "") -> bytes:
@@ -172,24 +208,60 @@ def encode_list_body(revision: int, item_payloads: list[bytes],
     return b"".join(parts)
 
 
-def decode_list_body(body: bytes) -> dict:
-    """Client half: a compact LIST body back to the dict shape the JSON
-    path's ``resp.json()`` yields ({"kind", "api_version", "metadata",
-    "items": [...]}), so every existing caller decodes identically."""
+def encode_obj_body(value) -> bytes:
+    """One-object body (single CREATE request/response, one binding):
+    exactly one frame holding the object map."""
+    return frame(encode_obj(value))
+
+
+def encode_batch_body(item_payloads: list[bytes],
+                      envelope: Optional[dict] = None) -> bytes:
+    """Multi-item body from per-item msgpack payloads (pre-encoded:
+    template renders, cache lines, or plain ``encode_obj`` output):
+    the envelope frame gains ``"n"`` and frames 1..N are the items.
+    Inverse of :func:`decode_body`'s enveloped branch."""
+    env = dict(envelope or {})
+    env["n"] = len(item_payloads)
+    parts = [frame(encode_obj(env))]
+    parts.extend(_LEN.pack(len(p)) + p for p in item_payloads)
+    return b"".join(parts)
+
+
+def decode_body(body: bytes):
+    """Any compact body back to the exact value shape the JSON path's
+    ``json.loads`` yields. An envelope frame (a map carrying the
+    reserved ``"n"`` key — no wire kind has one) folds its item frames
+    back under ``"items"``; anything else must be a single frame and
+    decodes as-is. Truncated or trailing bytes are a ValueError, never
+    a silently short result."""
     dec = FrameDecoder()
-    frames = iter(dec.feed(body))
-    try:
-        env = decode_obj(next(frames))
-    except StopIteration:
-        raise ValueError("compact LIST body has no envelope frame") \
-            from None
-    n = env.pop("n", 0)
-    items = [decode_obj(p) for p in frames]
-    if len(items) != n:
-        raise ValueError(f"compact LIST body truncated: envelope says "
-                         f"{n} items, got {len(items)}")
-    env["items"] = items
-    return env
+    frames = [decode_obj(p) for p in dec.feed(body)]
+    if dec.pending:
+        raise ValueError(f"compact body truncated: {dec.pending} "
+                         f"trailing bytes do not form a frame")
+    if not frames:
+        raise ValueError("compact body has no frames")
+    head = frames[0]
+    if isinstance(head, dict) and "n" in head:
+        env = dict(head)
+        n = env.pop("n")
+        items = frames[1:]
+        if len(items) != n:
+            raise ValueError(f"compact body truncated: envelope says "
+                             f"{n} items, got {len(items)}")
+        env["items"] = items
+        return env
+    if len(frames) != 1:
+        raise ValueError(f"compact body has {len(frames)} frames but "
+                         f"no envelope")
+    return head
+
+
+def decode_list_body(body: bytes) -> dict:
+    """Client half of the LIST fast path — the enveloped branch of
+    :func:`decode_body` (kept as a named entry point for the readers
+    that only ever see LIST bodies: the loadgen's raw watcher)."""
+    return decode_body(body)
 
 
 # -- watch events -----------------------------------------------------------
@@ -200,6 +272,7 @@ def _packed_key(name: str) -> bytes:
 
 _KEY_TYPE = _packed_key("type")
 _KEY_OBJECT = _packed_key("object")
+_KEY_STATUS = _packed_key("status")
 
 
 def event_frame(etype: str, obj_payload: bytes) -> bytes:
@@ -214,6 +287,127 @@ def event_frame(etype: str, obj_payload: bytes) -> bytes:
 def decode_event(payload: bytes) -> dict:
     """{"type": ..., "object": ...} from one watch frame payload."""
     return decode_obj(payload)
+
+
+# -- batch-result items -----------------------------------------------------
+
+def batch_item_payload(status: int, obj_payload: Optional[bytes] = None,
+                       error: Optional[dict] = None) -> bytes:
+    """One BatchResult item as an (unframed) msgpack payload. A
+    success carrying an object embeds the SERIALIZE-ONCE cached bytes
+    verbatim — fixmap header + pre-encoded payload, the
+    :func:`event_frame` trick — so a 512-item echo response costs zero
+    per-object re-packs."""
+    if error is not None:
+        return encode_obj({"status": status, "error": error})
+    if obj_payload is None:
+        return encode_obj({"status": status})
+    return (b"\x82" + _KEY_STATUS + _msgpack.packb(status)
+            + _KEY_OBJECT + obj_payload)
+
+
+# -- pre-encoded body templates ---------------------------------------------
+
+_TEMPLATE_SENTINEL = "\x00ktpu/body-template\x00"
+
+
+class BodyTemplate:
+    """Pre-encoded msgpack payload for one JSON-model dict in which a
+    SINGLE string field varies (``vary`` is its key path, e.g.
+    ``("metadata", "name")``). The dict is encoded once with a
+    sentinel at the varying slot and split around it;
+    :meth:`render` is then two byte concats + one small ``packb`` —
+    no per-item ``to_dict`` walk, no per-item object encode. The bulk
+    submitter's whole batch body becomes
+    ``encode_batch_body([tmpl.render(name) for name in names])``."""
+
+    def __init__(self, value: dict, vary: tuple):
+        if not vary:
+            raise ValueError("vary path must name at least one key")
+        top = dict(value)
+        cur = top
+        for k in vary[:-1]:
+            cur[k] = dict(cur[k])  # copy only the spine being edited
+            cur = cur[k]
+        cur[vary[-1]] = _TEMPLATE_SENTINEL
+        blob = encode_obj(top)
+        sep = _msgpack.packb(_TEMPLATE_SENTINEL)
+        pre, found, suf = blob.partition(sep)
+        if not found or sep in suf:
+            raise ValueError("template payload must contain the vary "
+                             "slot exactly once")
+        self._pre, self._suf = pre, suf
+
+    def render(self, value: str) -> bytes:
+        """The item payload with ``value`` at the varying slot —
+        byte-identical to ``encode_obj`` of the substituted dict."""
+        return self._pre + _msgpack.packb(value) + self._suf
+
+
+# -- per-verb codec seams (decode_share attribution) ------------------------
+# Thin module-level wrappers dispatched by verb × direction so cProfile
+# cumtime attributes wire-codec CPU to the create/batch/bind paths by
+# FRAME NAME (perf/decode_share.py reads these); behavior is exactly
+# the shared json/msgpack codepaths, both codecs.
+
+def _decode_any(raw: bytes, codec: str):
+    if codec == "compact":
+        return decode_body(raw)
+    return _json.loads(raw)
+
+
+def decode_request_create(raw: bytes, codec: str = "json"):
+    return _decode_any(raw, codec)
+
+
+def decode_request_batch_create(raw: bytes, codec: str = "json"):
+    return _decode_any(raw, codec)
+
+
+def decode_request_bind(raw: bytes, codec: str = "json"):
+    return _decode_any(raw, codec)
+
+
+def decode_request_other(raw: bytes, codec: str = "json"):
+    return _decode_any(raw, codec)
+
+
+_DECODE_SEAMS = {"create": decode_request_create,
+                 "batch_create": decode_request_batch_create,
+                 "bind": decode_request_bind}
+
+
+def decode_request(raw: bytes, codec: str, op: str = "other"):
+    """Request-body decode through the ``op``-named seam (the
+    apiserver's ``_body_obj`` inline path; the codec pool's offload
+    decodes in worker processes outside any profile)."""
+    return _DECODE_SEAMS.get(op, decode_request_other)(raw, codec)
+
+
+def dumps_response_batch_create(doc) -> str:
+    """JSON BatchResult encode seam for ``{plural}:batchCreate`` —
+    byte-identical to ``web.json_response``'s default ``json.dumps``."""
+    return _json.dumps(doc)
+
+
+def dumps_response_bind(doc) -> str:
+    """JSON BatchResult encode seam for ``bindings:batch``."""
+    return _json.dumps(doc)
+
+
+def encode_response_create(assemble: Callable[[], bytes]) -> bytes:
+    """Create-response assembly seam (cached-payload fetch + framing)."""
+    return assemble()
+
+
+def encode_response_batch_create(assemble: Callable[[], bytes]) -> bytes:
+    """Compact BatchResult assembly seam for ``:batchCreate``."""
+    return assemble()
+
+
+def encode_response_bind(assemble: Callable[[], bytes]) -> bytes:
+    """Compact BatchResult assembly seam for ``bindings:batch``."""
+    return assemble()
 
 
 # -- worker-process encode (codec pool) -------------------------------------

@@ -146,13 +146,26 @@ KNOWN_FEATURES = {f.name: f for f in [
             "identical to the scalar path by construction (property-"
             "tested); off = the per-pod scalar loop, byte-identical"),
     Feature("CompactWireCodec", False, ALPHA,
-            "compact framed wire codec for LIST responses and watch "
-            "streams (util/compactcodec.py): length-prefixed msgpack "
-            "frames negotiated via Accept/Content-Type on top of the "
+            "compact framed wire codec for the full wire path "
+            "(util/compactcodec.py): LIST responses, watch streams, "
+            "AND the write path — CREATE / {plural}:batchCreate / "
+            "bindings:batch request bodies negotiated via "
+            "Content-Type, batch responses via Accept — as "
+            "length-prefixed msgpack frames on top of the "
             "serialize-once encode cache; JSON remains the default "
             "and the fallback (a client that never asks, or a server "
             "with the gate off, sees byte-identical JSON). Requires "
             "the msgpack wheel; without it the gate is inert"),
+    Feature("WatchFanoutBatch", False, ALPHA,
+            "watch fan-out flush batching (apiserver/fanout.py): "
+            "watch handlers append encoded event frames to "
+            "per-watcher sinks; a small pool of flusher workers — "
+            "watchers sharded across them — coalesces each sink's "
+            "pending frames into one buffered writev-style socket "
+            "send per flush round, so a slow consumer stalls only "
+            "its own shard's round and an overflowing one is closed "
+            "(the client relists). Off = the per-watcher inline "
+            "write loop, byte-identical"),
     Feature("TrainJobController", False, ALPHA,
             "multi-host jax.distributed training as a first-class "
             "workload (training/v1 TrainJob, controllers/train.py): "

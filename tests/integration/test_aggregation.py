@@ -94,6 +94,55 @@ async def test_aggregated_backend_down_returns_503():
         await main.stop()
 
 
+async def test_proxy_forwards_content_type_untouched():
+    """The aggregation passthrough forwards the caller's Content-Type
+    verbatim (parameters included) and returns the extension's
+    response Content-Type verbatim — a compact-negotiated body must
+    not arrive at the extension re-labeled octet-stream."""
+    from aiohttp import web as aioweb
+
+    seen = {}
+
+    async def echo(request):
+        seen["content_type"] = request.headers.get("Content-Type", "")
+        seen["accept"] = request.headers.get("Accept", "")
+        return aioweb.Response(
+            body=b'{"ok": true}',
+            headers={"Content-Type": "application/json; charset=utf-8"})
+
+    app = aioweb.Application()
+    app.router.add_post("/api/metrics.example/v1/widgets", echo)
+    runner = aioweb.AppRunner(app)
+    await runner.setup()
+    site = aioweb.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    ext_port = site._server.sockets[0].getsockname()[1]
+
+    main = APIServer(Registry())
+    main.registry.create(t.Namespace(metadata=ObjectMeta(name="default")))
+    main_port = await main.start()
+    try:
+        main.registry.create(mk_apiservice(f"http://127.0.0.1:{ext_port}"))
+        import aiohttp
+        sent_ct = "application/x-ktpu-compact; profile=test"
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                    f"http://127.0.0.1:{main_port}"
+                    f"/api/metrics.example/v1/widgets",
+                    data=b"\x00\x00\x00\x01\x90",
+                    headers={"Content-Type": sent_ct,
+                             "Accept": "application/x-ktpu-compact"}) as r:
+                assert r.status == 200
+                # Response Content-Type rides back with its parameters.
+                assert r.headers["Content-Type"] == \
+                    "application/json; charset=utf-8"
+        assert seen["content_type"] == sent_ct
+        assert seen["accept"] == "application/x-ktpu-compact"
+    finally:
+        await main.stop()
+        await runner.cleanup()
+
+
 def test_apiservice_validation():
     with pytest.raises(errors.InvalidError):
         ext.validate_apiservice(ext.APIService(

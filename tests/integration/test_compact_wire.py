@@ -142,6 +142,224 @@ async def test_informer_over_compact_sees_same_objects():
         await srv.stop()
 
 
+def _normalized(d: dict) -> dict:
+    """to_dict minus the per-create server stamps (uid, timestamps,
+    resource_version, name) so twin creates compare structurally."""
+    d = {**d, "metadata": {**(d.get("metadata") or {})}}
+    for k in ("uid", "creation_timestamp", "resource_version", "name"):
+        d["metadata"].pop(k, None)
+    return d
+
+
+async def test_compact_create_request_decodes_identical_to_json():
+    """Golden write-path contract: the SAME pod posted as a compact
+    body and as a JSON body produces identical hub objects, and the
+    compact-negotiated response decodes to the JSON response's shape."""
+    reg, srv, base = await _cluster()
+    try:
+        GATES.set("CompactWireCodec", True)
+        url = f"{base}/api/core/v1/namespaces/default/pods"
+        d_json = to_dict(_pod("via-json"))
+        d_compact = to_dict(_pod("via-compact"))
+        async with aiohttp.ClientSession() as s:
+            async with s.post(url, json=d_json) as r1:
+                assert r1.status == 201
+                assert r1.content_type == "application/json"
+                echoed_json = await r1.json()
+            async with s.post(url, data=cc.encode_obj_body(d_compact),
+                              headers={"Content-Type": cc.CONTENT_TYPE,
+                                       "Accept": cc.CONTENT_TYPE}) as r2:
+                assert r2.status == 201
+                assert r2.content_type == cc.CONTENT_TYPE
+                echoed_compact = cc.decode_body(await r2.read())
+        # Response shapes agree modulo the per-object server stamps...
+        assert _normalized(echoed_compact) == _normalized(echoed_json)
+        # ...and so do the STORED hub objects (the decode paths met at
+        # the same registry pipeline).
+        stored_j = to_dict(reg.get("pods", "default", "via-json"))
+        stored_c = to_dict(reg.get("pods", "default", "via-compact"))
+        assert _normalized(stored_j) == _normalized(stored_c)
+    finally:
+        GATES.set("CompactWireCodec", False)
+        await srv.stop()
+
+
+async def test_compact_batch_create_and_bind_via_typed_client():
+    """RESTClient negotiates the write path transparently when the
+    gate is on: create_many (echo on), bind_many, and the pre-encoded
+    create_many_encoded path all round-trip."""
+    reg, srv, base = await _cluster()
+    try:
+        GATES.set("CompactWireCodec", True)
+        client = RESTClient(base)
+        try:
+            outs = await client.create_many(
+                [_pod(f"b{i}") for i in range(4)])
+            assert [o.metadata.name for o in outs] == \
+                ["b0", "b1", "b2", "b3"]
+            assert outs[0].metadata.annotations["note"] == "ünïcode ✓"
+            # Duplicate name -> positional per-item error, not a
+            # request-level failure.
+            dup = await client.create_many([_pod("b0")])
+            assert isinstance(dup[0], Exception)
+
+            # Pre-encoded template submit (the loadgen path).
+            tmpl = cc.BodyTemplate(to_dict(_pod("tmpl")),
+                                   ("metadata", "name"))
+            outs2 = await client.create_many_encoded(
+                "pods", "default", [tmpl.render("t0"), tmpl.render("t1")])
+            assert outs2 == [None, None]
+            assert to_dict(reg.get("pods", "default", "t0"))["metadata"][
+                "annotations"]["note"] == "ünïcode ✓"
+
+            # Batched binds over the compact body + compact response.
+            reg.create(t.Node(metadata=ObjectMeta(name="n1")))
+            res = await client.bind_many("default", [
+                ("b0", t.Binding(target=t.BindingTarget(node_name="n1"))),
+                ("absent", t.Binding(target=t.BindingTarget(
+                    node_name="n1"))),
+            ])
+            assert res[0] is None
+            assert isinstance(res[1], Exception)
+            assert reg.get("pods", "default", "b0").spec.node_name == "n1"
+        finally:
+            await client.close()
+    finally:
+        GATES.set("CompactWireCodec", False)
+        await srv.stop()
+
+
+async def test_content_type_mismatches_diagnosable():
+    """415 for unknown x-ktpu media types and for compact at a
+    gate-off server; 400 naming the codec for a garbled body."""
+    reg, srv, base = await _cluster()
+    try:
+        url = f"{base}/api/core/v1/namespaces/default/pods"
+        async with aiohttp.ClientSession() as s:
+            # Gate OFF + compact body: 415 naming the gate, not
+            # "invalid JSON body".
+            async with s.post(url, data=b"\x00\x00\x00\x01\x90",
+                              headers={"Content-Type":
+                                       cc.CONTENT_TYPE}) as r:
+                assert r.status == 415
+                body = await r.json()
+                assert "CompactWireCodec" in body["message"]
+            GATES.set("CompactWireCodec", True)
+            # Unknown compact-family media type: clean 415.
+            async with s.post(url, data=b"{}",
+                              headers={"Content-Type":
+                                       "application/x-ktpu-other"}) as r:
+                assert r.status == 415
+                assert "x-ktpu-other" in (await r.json())["message"]
+            # Compact type, garbled body: 400 naming the compact codec.
+            async with s.post(url, data=b"junk-not-a-frame",
+                              headers={"Content-Type":
+                                       cc.CONTENT_TYPE}) as r:
+                assert r.status == 400
+                assert "compact" in (await r.json())["message"]
+            # JSON garbled body: 400 still the JSON diagnosis.
+            async with s.post(url, data=b"junk",
+                              headers={"Content-Type":
+                                       "application/json"}) as r:
+                assert r.status == 400
+                assert "JSON" in (await r.json())["message"]
+    finally:
+        GATES.set("CompactWireCodec", False)
+        await srv.stop()
+
+
+async def test_gate_off_write_wire_bytes_identical():
+    """With the gate off, the create/batchCreate response bytes are
+    IDENTICAL whether or not the client offers compact — pinned
+    against the pre-PR JSON formats byte for byte."""
+    import json as _json
+    reg, srv, base = await _cluster()
+    try:
+        url = f"{base}/api/core/v1/namespaces/default/pods"
+        async with aiohttp.ClientSession() as s:
+            # batchCreate (echo=0): the response body carries no
+            # per-create stamps, so two requests compare byte-equal,
+            # and both match the pre-PR web.json_response encoding.
+            payload = {"items": [to_dict(_pod("w1"))]}
+            async with s.post(f"{url}:batchCreate?echo=0",
+                              json=payload) as r1:
+                plain = await r1.read()
+                assert r1.content_type == "application/json"
+            payload = {"items": [to_dict(_pod("w2"))]}
+            async with s.post(f"{url}:batchCreate?echo=0", json=payload,
+                              headers={"Accept": ACCEPT["Accept"]}) as r2:
+                offered = await r2.read()
+                assert r2.content_type == "application/json"
+        assert plain == offered
+        assert plain == _json.dumps(
+            {"kind": "BatchResult", "items": [{"status": 201}]}).encode()
+        # Single create: the serialize-once cached encoding, compact
+        # separators — byte-equal to the canonical pre-PR form.
+        async with aiohttp.ClientSession() as s:
+            async with s.post(url, json=to_dict(_pod("w3")),
+                              headers={"Accept": ACCEPT["Accept"]}) as r3:
+                created = await r3.read()
+                assert r3.content_type == "application/json"
+        d = to_dict(reg.get("pods", "default", "w3"))
+        rv = d["metadata"].pop("resource_version")
+        # The serialize-once encoding appends resource_version last in
+        # metadata (the store injects it into the cached value) — the
+        # same bytes the pre-PR fast path served.
+        assert created == _json.dumps(
+            {**d, "metadata": {**d["metadata"], "resource_version": rv}},
+            separators=(",", ":")).encode()
+    finally:
+        await srv.stop()
+
+
+async def test_watch_fanout_batch_streams_same_events():
+    """WatchFanoutBatch on: the buffered sharded flush path delivers
+    the same events, in order, over both codecs."""
+    reg, srv, base = await _cluster()
+    try:
+        GATES.set("WatchFanoutBatch", True)
+        client = RESTClient(base)
+        try:
+            _, rev = await client.list("pods", "default")
+            stream = await client.watch("pods", "default", rev)
+            for i in range(5):
+                reg.create(_pod(f"f{i}"))
+            got = []
+            while len(got) < 5:
+                etype, obj = await stream.next(timeout=5.0)
+                assert etype == "ADDED"
+                got.append(obj.metadata.name)
+            assert got == [f"f{i}" for i in range(5)]
+            stream.cancel()
+        finally:
+            await client.close()
+    finally:
+        GATES.set("WatchFanoutBatch", False)
+        await srv.stop()
+
+
+async def test_watch_fanout_batch_compact_stream():
+    reg, srv, base = await _cluster()
+    try:
+        GATES.set("CompactWireCodec", True)
+        GATES.set("WatchFanoutBatch", True)
+        client = RESTClient(base)
+        try:
+            _, rev = await client.list("pods", "default")
+            stream = await client.watch("pods", "default", rev)
+            reg.create(_pod("cf0"))
+            etype, obj = await stream.next(timeout=5.0)
+            assert (etype, obj.metadata.name) == ("ADDED", "cf0")
+            assert obj.metadata.annotations["note"] == "ünïcode ✓"
+            stream.cancel()
+        finally:
+            await client.close()
+    finally:
+        GATES.set("CompactWireCodec", False)
+        GATES.set("WatchFanoutBatch", False)
+        await srv.stop()
+
+
 async def test_field_selector_watch_stays_json():
     reg, srv, base = await _cluster()
     try:

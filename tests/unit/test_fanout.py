@@ -1,0 +1,183 @@
+"""WatchFanoutBatch flush engine (apiserver/fanout.py) unit contracts:
+one coalesced buffered send per sink per flush round, per-sink frame
+order preserved, a slow sink stalls only its own shard, overflow
+closes the sink instead of growing without bound, and the final drain
+flushes the remainder in order.
+"""
+import asyncio
+
+from kubernetes_tpu.apiserver.fanout import FanoutFlusher
+
+
+class FakeResp:
+    """StreamResponse stand-in recording each write() call's bytes."""
+
+    def __init__(self, gate: asyncio.Event = None):
+        self.writes: list[bytes] = []
+        self._gate = gate
+
+    async def write(self, data: bytes) -> None:
+        if self._gate is not None:
+            await self._gate.wait()
+        self.writes.append(bytes(data))
+
+
+async def _settle(n: int = 6):
+    # Real (tiny) sleeps: the bounded-write path adds loop hops per
+    # send (wait_for wraps each write in a task), so bare sleep(0)
+    # rounds under-count.
+    for _ in range(n):
+        await asyncio.sleep(0.005)
+
+
+async def test_flush_coalesces_pending_frames_into_one_write():
+    fl = FanoutFlusher(shards=1)
+    resp = FakeResp()
+    sink = fl.register(resp)
+    try:
+        sink.push(b"a\n")
+        sink.push(b"b\n")
+        sink.push(b"c\n")
+        await _settle()
+        # Everything pushed before the flush round left in ONE send,
+        # in push order.
+        assert resp.writes == [b"a\nb\nc\n"]
+        sink.push(b"d\n")
+        await _settle()
+        assert resp.writes == [b"a\nb\nc\n", b"d\n"]
+    finally:
+        fl.discard(sink)
+        await fl.stop()
+
+
+async def test_slow_sink_stalls_only_its_own_shard():
+    # Two shards: the round-robin puts sink0 (slow) and sink1 (fast)
+    # on different shards; the slow write must not delay the fast one.
+    fl = FanoutFlusher(shards=2)
+    gate = asyncio.Event()
+    slow_resp, fast_resp = FakeResp(gate), FakeResp()
+    slow = fl.register(slow_resp)
+    fast = fl.register(fast_resp)
+    try:
+        slow.push(b"s1\n")
+        fast.push(b"f1\n")
+        await _settle()
+        assert fast_resp.writes == [b"f1\n"]  # flushed despite the stall
+        assert slow_resp.writes == []         # still parked on the gate
+        gate.set()
+        await _settle()
+        assert slow_resp.writes == [b"s1\n"]
+    finally:
+        fl.discard(slow)
+        fl.discard(fast)
+        await fl.stop()
+
+
+async def test_overflow_closes_sink_and_stops_buffering():
+    fl = FanoutFlusher(shards=1, overflow_limit=8)
+    gate = asyncio.Event()  # never set: writes hang, buffer grows
+    resp = FakeResp(gate)
+    sink = fl.register(resp)
+    try:
+        sink.push(b"x" * 6)
+        await _settle(2)  # worker takes the 6 bytes, hangs on the gate
+        sink.push(b"y" * 6)  # buffered: 6 < 8
+        sink.push(b"z" * 6)  # 12 > 8 -> overflow
+        assert sink.closed
+        sink.push(b"w")      # pushes after close are dropped
+        buf, n = sink.take()
+        assert buf == b"y" * 6 and n == 1
+    finally:
+        gate.set()
+        fl.discard(sink)
+        await fl.stop()
+
+
+async def test_drain_flushes_remainder_after_discard():
+    fl = FanoutFlusher(shards=1)
+    resp = FakeResp()
+    sink = fl.register(resp)
+    sink.push(b"early\n")
+    await _settle()
+    # Frames pushed but never flushed by a worker (stream ending):
+    sink.push(b"late\n")
+    fl.discard(sink)
+    await fl.drain(sink)
+    assert resp.writes == [b"early\n", b"late\n"]
+    await fl.stop()
+
+
+async def test_dead_peer_closes_sink_not_the_round():
+    # Every ConnectionError flavor a transport raises (reset, broken
+    # pipe, aborted) must close only ITS sink — never kill the shard
+    # worker and silence sibling watchers.
+    class DeadResp(FakeResp):
+        def __init__(self, exc):
+            super().__init__()
+            self._exc = exc
+
+        async def write(self, data: bytes) -> None:
+            raise self._exc
+
+    for exc in (ConnectionResetError, BrokenPipeError,
+                ConnectionAbortedError, RuntimeError):
+        fl = FanoutFlusher(shards=1)
+        dead = fl.register(DeadResp(exc))
+        ok_resp = FakeResp()
+        ok = fl.register(ok_resp)
+        try:
+            dead.push(b"never\n")
+            ok.push(b"fine\n")
+            await _settle()
+            assert dead.closed, exc
+            # Same shard, round (and worker) survive.
+            assert ok_resp.writes == [b"fine\n"], exc
+            ok.push(b"again\n")
+            await _settle()
+            assert ok_resp.writes == [b"fine\n", b"again\n"], exc
+        finally:
+            fl.discard(dead)
+            fl.discard(ok)
+            await fl.stop()
+
+
+async def test_stalled_write_is_bounded_and_closes_the_sink():
+    # A connected-but-not-reading consumer (TCP zero window) parks its
+    # send; the worker must give up after write_timeout and move on —
+    # "one bounded round", never an indefinite shard stall.
+    fl = FanoutFlusher(shards=1, write_timeout=0.05)
+    gate = asyncio.Event()  # never set: the write hangs
+    stalled_resp, ok_resp = FakeResp(gate), FakeResp()
+    stalled = fl.register(stalled_resp)
+    ok = fl.register(ok_resp)
+    try:
+        stalled.push(b"hang\n")
+        ok.push(b"pass\n")
+        await asyncio.sleep(0.2)
+        assert stalled.closed          # timed out, closed like overflow
+        assert ok_resp.writes == [b"pass\n"]  # sibling got its round
+    finally:
+        gate.set()
+        fl.discard(stalled)
+        fl.discard(ok)
+        await fl.stop()
+
+
+async def test_dead_worker_respawns_on_next_register():
+    fl = FanoutFlusher(shards=1)
+    resp = FakeResp()
+    sink = fl.register(resp)
+    shard = sink._shard
+    shard.task.cancel()  # simulate a worker killed by a surprise
+    await _settle()
+    assert shard.task.done()
+    fl.discard(sink)
+    resp2 = FakeResp()
+    sink2 = fl.register(resp2)  # must revive the shard worker
+    try:
+        sink2.push(b"alive\n")
+        await _settle()
+        assert resp2.writes == [b"alive\n"]
+    finally:
+        fl.discard(sink2)
+        await fl.stop()

@@ -372,6 +372,28 @@ N = Counter("codec_pool_submits_total", "re-registered: silently inert")
     assert len(got) == 1 and "already registered" in got[0].message
 
 
+def test_metric_name_compact_write_and_fanout_families():
+    """The compact-write negotiation counter (apiserver_compact_write_*)
+    and the watch fan-out flush families (apiserver_fanout_*) are valid
+    names, and a duplicate registration within the family is still
+    caught."""
+    good = """
+from kubernetes_tpu.metrics.registry import Counter, Gauge, Histogram
+A = Counter("apiserver_compact_write_requests_total", "x", labels=("verb",))
+B = Counter("apiserver_fanout_flushes_total", "x", labels=("shard",))
+C = Histogram("apiserver_fanout_flush_events", "x")
+D = Histogram("apiserver_fanout_flush_bytes", "x")
+E = Counter("apiserver_fanout_overflows_total", "x")
+F = Gauge("apiserver_fanout_sinks", "x")
+"""
+    assert run_source(good, checks=["metric-name"]) == []
+    bad = good + """
+G = Counter("apiserver_fanout_flushes_total", "re-registered: silently inert")
+"""
+    got = run_source(bad, checks=["metric-name"])
+    assert len(got) == 1 and "already registered" in got[0].message
+
+
 def test_metric_name_queueing_family():
     """The job-queueing metric family (queue_*) is valid, and a
     duplicate registration within the family is still caught."""
