@@ -1,0 +1,48 @@
+#!/usr/bin/env bash
+# Control-plane endurance smoke (<90s): a mini sustained-churn run
+# (perf/churn_bench.py) with aggressive hygiene settings — small
+# revision retention, a tiny WAL rotation threshold, WatchBookmarks on
+# — over an in-process apiserver + informer. Asserts the aging loop
+# actually turns: the compact revision advances, the WAL snapshots and
+# truncates at its threshold, retained watch history stays bounded by
+# the retention window (not the write count), the informer's watch
+# never stalls, and api p99 does not climb across the run. Catches
+# "the control plane ages" end to end: compactor wiring, snapshot
+# rotation, bookmark delivery, informer resume.
+# Siblings: hack/bench_smoke.sh (perf arm), hack/chaos.sh (fault arm),
+# hack/test.sh (runs all).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+timeout -k 10 90 env JAX_PLATFORMS=cpu python - <<'EOF'
+import asyncio, json, sys
+from kubernetes_tpu.perf.churn_bench import run_churn
+
+out = asyncio.run(run_churn(
+    duration_s=20.0, compaction=True, live_set=100,
+    wal_max_bytes=256 * 1024, retention_revisions=500,
+    retention_seconds=2.0, compact_interval=0.5))
+out.pop("samples", None)
+print(json.dumps(out))
+# Retention is the conservative AND of both bounds: the revision
+# window (500) plus everything younger than the age window — at the
+# observed rate that is ops_per_s * (2.0s age + up to 2 compactor
+# intervals of drift) more revisions, legitimately retained. Budget
+# both (+ slack) so the bound tracks throughput, not a fixed guess.
+retained = int(500 + out["ops_per_s"] * (2.0 + 2 * 0.5) + 200)
+if out["compactions"] < 2:
+    sys.exit("endurance_smoke: compactor never advanced the floor")
+if out["final_compact_lag"] > retained:
+    sys.exit("endurance_smoke: compact revision lag unbounded")
+if out["wal_snapshots"] < 1:
+    sys.exit("endurance_smoke: WAL never rotated at its threshold")
+if out["wal_bytes_max"] > 2 * 256 * 1024:
+    sys.exit("endurance_smoke: WAL footprint blew past its threshold")
+if out["final_history_entries"] > retained:
+    sys.exit("endurance_smoke: watch history grew past retention")
+if out["informer_rev_lag"] > 100:
+    sys.exit("endurance_smoke: informer watch stalled behind the store")
+if out["api_p99_first_ms"] > 0 and out["api_p99_drift"] > 0.5:
+    sys.exit("endurance_smoke: api p99 climbed across the run")
+EOF
+echo "endurance_smoke: ok"

@@ -25,6 +25,9 @@
 # hack/mon_smoke.sh (<60s kmon gate: gate-on LocalCluster scrape
 # convergence, ktl query/alerts/dash, deterministic chaos sick-chip
 # alert fire/taint/resolve, and the bounded-TSDB churn assertion),
+# hack/endurance_smoke.sh (<90s sustained-churn gate: compact revision
+# advances, WAL snapshots+truncates at its threshold, watch history
+# bounded by retention, informer never stalls, api p99 flat),
 # hack/race.sh (<150s tpusan gate: chaos + queue +
 # preempt + HA smokes under explored task-interleaving schedules with
 # the cluster invariants armed) — all run on full-suite invocations;
@@ -42,6 +45,7 @@ if [ "$#" -eq 0 ] || [ "${KTPU_SMOKE:-}" = "1" ]; then
   ./hack/serve_smoke.sh
   ./hack/train_smoke.sh
   ./hack/mon_smoke.sh
+  ./hack/endurance_smoke.sh
   ./hack/race.sh
 fi
 exec python -m pytest tests/ -q "$@"

@@ -33,6 +33,11 @@ ENCODE_CACHE_MISSES = Counter(
     "Serialize-once cache misses (object encoded)")
 ENCODE_CACHE_ENTRIES = Gauge(
     "encode_cache_entries", "Entries currently held by the encode cache")
+ENCODE_CACHE_BYTES = Gauge(
+    "encode_cache_bytes", "Encoded bytes currently held by the encode cache")
+ENCODE_CACHE_EVICTIONS = Counter(
+    "encode_cache_evictions_total",
+    "Entries evicted by the encode cache's entry/byte ceilings")
 
 
 class EncodeCache:
@@ -44,8 +49,14 @@ class EncodeCache:
     stores). The cache lock is a leaf — it never acquires another lock.
     """
 
-    def __init__(self, limit: int = 16384):
+    def __init__(self, limit: int = 16384, max_bytes: int = 64 * 1024 * 1024):
+        """``limit``: max entries; ``max_bytes``: max total encoded
+        bytes (0 = entries-only bound). Either ceiling triggers the
+        same oldest-quarter eviction — under sustained churn the cache
+        holds a bounded working set, never the write history."""
         self.limit = limit
+        self.max_bytes = max_bytes
+        self._bytes = 0
         self._lock = make_lock("apiserver.EncodeCache")
         #: Insertion-ordered; eviction pops the oldest quarter.
         self._data: dict[tuple[str, int, str], bytes] = {}
@@ -85,23 +96,34 @@ class EncodeCache:
         two can never drift."""
         if ck in self._data:
             return
-        if len(self._data) >= self.limit:
+        # Either ceiling forces an eviction round; a single entry
+        # larger than max_bytes still inserts once the cache is empty
+        # (refusing it would re-encode that object on every request).
+        while self._data and (
+                len(self._data) >= self.limit
+                or (self.max_bytes
+                    and self._bytes + len(line) > self.max_bytes)):
             self._evict_locked()
         self._data[ck] = line
+        self._bytes += len(line)
         self._by_key.setdefault(ck[0], []).append(ck)
         ENCODE_CACHE_ENTRIES.set(float(len(self._data)))
+        ENCODE_CACHE_BYTES.set(float(self._bytes))
 
     def invalidate(self, key: str) -> None:
         """Drop every cached encoding for ``key`` (called on write)."""
         with self._lock:
             for ck in self._by_key.pop(key, ()):
-                self._data.pop(ck, None)
+                old = self._data.pop(ck, None)
+                if old is not None:
+                    self._bytes -= len(old)
             if key in self._pending:
                 # An offloaded encode of this key is in flight: its
                 # dispatch-time token is now stale and its completion
                 # must be discarded (finish_async_encode checks).
                 self._gen[key] = self._gen.get(key, 0) + 1
             ENCODE_CACHE_ENTRIES.set(float(len(self._data)))
+            ENCODE_CACHE_BYTES.set(float(self._bytes))
 
     # -- async (pool-offloaded) encode guard ------------------------------
 
@@ -155,7 +177,9 @@ class EncodeCache:
         # not turn every subsequent put into an eviction.
         drop = max(1, self.limit // 4)
         for ck in list(self._data)[:drop]:
+            self._bytes -= len(self._data[ck])
             del self._data[ck]
+            ENCODE_CACHE_EVICTIONS.inc()
             held = self._by_key.get(ck[0])
             if held is not None:
                 try:
@@ -164,3 +188,12 @@ class EncodeCache:
                     pass
                 if not held:
                     del self._by_key[ck[0]]
+
+    def stats(self) -> dict:
+        """Occupancy + traffic snapshot (the /debug/v1/storage view)."""
+        with self._lock:
+            return {"entries": len(self._data), "bytes": self._bytes,
+                    "limit": self.limit, "max_bytes": self.max_bytes,
+                    "hits": ENCODE_CACHE_HITS.value(),
+                    "misses": ENCODE_CACHE_MISSES.value(),
+                    "evictions": ENCODE_CACHE_EVICTIONS.value()}

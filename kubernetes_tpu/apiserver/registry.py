@@ -18,6 +18,8 @@ from __future__ import annotations
 import asyncio
 import datetime
 import json
+import logging
+import time
 from dataclasses import dataclass, field as dc_field
 from typing import Any, Callable, Optional
 
@@ -29,7 +31,40 @@ from ..api.meta import ObjectMeta, TypedObject, now, stamp as meta_stamp, \
     stamp_new
 from ..api.scheme import DEFAULT_SCHEME, Scheme, from_dict, to_dict
 from ..api.selectors import match_field_selector, parse_selector
+from ..metrics.registry import Counter, Gauge
 from ..storage.mvcc import ADDED, DELETED, MODIFIED, MVCCStore, Watch, WatchEvent
+
+#: Endurance telemetry: the compactor keeps these current each cycle
+#: (the same numbers /debug/v1/storage serves on demand).
+STORAGE_COMPACT_REV = Gauge(
+    "storage_compact_revision",
+    "MVCC compacted floor (watches may not resume at or below it)")
+STORAGE_COMPACTIONS = Counter(
+    "storage_compactions_total",
+    "compactor cycles that advanced the compacted floor")
+STORAGE_WAL_BYTES = Gauge(
+    "storage_wal_bytes", "WAL bytes since the last snapshot truncation")
+STORAGE_HISTORY_LEN = Gauge(
+    "storage_watch_history_entries",
+    "watch-replay events retained in memory")
+
+
+@dataclass
+class CompactionPolicy:
+    """Retention knobs for the periodic MVCC compactor (reference:
+    etcd's ``--auto-compaction-mode/retention`` pair, both modes at
+    once). Every ``interval_seconds`` the compactor advances the
+    store's compacted floor to the newest revision that is BOTH more
+    than ``retention_revisions`` old and older than
+    ``retention_seconds`` (a knob set to 0 drops that bound), so a
+    watcher always gets at least that much resume headroom before a
+    reconnect 410s into a relist. On a replicated registry the floor
+    is additionally clamped to the quorum commit revision — an
+    uncommitted suffix is never compacted out from under a follower
+    catch-up (``committed-never-lost``)."""
+    retention_revisions: int = 10_000
+    retention_seconds: float = 300.0
+    interval_seconds: float = 5.0
 
 
 @dataclass
@@ -238,8 +273,16 @@ class Registry:
 
     def __init__(self, store: Optional[MVCCStore] = None,
                  scheme: Scheme = DEFAULT_SCHEME,
-                 admission: Optional["AdmissionChain"] = None):
+                 admission: Optional["AdmissionChain"] = None,
+                 compaction_policy: Optional[CompactionPolicy] = None):
         self.store = store or MVCCStore()
+        #: None = the compactor never runs (opt-in, like etcd
+        #: autocompaction); see :meth:`start_compactor`.
+        self.compaction_policy = compaction_policy
+        self._compactor_task: Optional[asyncio.Task] = None
+        #: (monotonic time, revision) samples the age-retention bound
+        #: interpolates from; bounded by retention_seconds/interval.
+        self._compact_samples: list[tuple[float, int]] = []
         self.scheme = scheme
         self.admission = admission
         self._by_plural: dict[str, ResourceSpec] = {}
@@ -288,6 +331,76 @@ class Registry:
             return self._by_kind[kind]
         except KeyError:
             raise errors.NotFoundError(f"unknown kind {kind!r}") from None
+
+    # -- periodic compaction ----------------------------------------------
+
+    def compact_once(self) -> int:
+        """One compactor cycle: compute the retention target under
+        :attr:`compaction_policy` and advance the store's compacted
+        floor to it. Returns the floor (unchanged when nothing is old
+        enough yet). Safe to call directly — the endurance smoke and
+        unit tests drive it without the async loop."""
+        policy = self.compaction_policy
+        if policy is None:
+            return self.store.compact_rev
+        now = time.monotonic()
+        rev = self.store.revision
+        self._compact_samples.append((now, rev))
+        target = rev
+        if policy.retention_revisions:
+            target = min(target, rev - policy.retention_revisions)
+        if policy.retention_seconds:
+            # The newest sampled revision already older than the
+            # retention window; no sample that old yet = no age bound
+            # cleared, nothing may be compacted on age grounds.
+            aged = 0
+            cutoff = now - policy.retention_seconds
+            for ts, r in self._compact_samples:
+                if ts > cutoff:
+                    break
+                aged = r
+            target = min(target, aged)
+            # Samples older than the window stay useful only as the
+            # single newest one; drop the rest so the list is bounded.
+            while len(self._compact_samples) > 1 \
+                    and self._compact_samples[1][0] <= cutoff:
+                self._compact_samples.pop(0)
+        if self.replica is not None:
+            # Never compact past quorum: a follower catching up replays
+            # from the commit revision — history above the commit point
+            # must survive (committed-never-lost).
+            target = min(target, self.replica.commit_rev)
+        before = self.store.compact_rev
+        floor = self.store.compact(target) if target > before else before
+        if floor > before:
+            STORAGE_COMPACTIONS.inc()
+        STORAGE_COMPACT_REV.set(floor)
+        STORAGE_WAL_BYTES.set(self.store.wal_bytes)
+        STORAGE_HISTORY_LEN.set(self.store.history_len)
+        return floor
+
+    def start_compactor(self) -> None:
+        """Spawn the periodic compactor on the running loop (no-op
+        without a :class:`CompactionPolicy`). The apiserver calls this
+        from ``start()``; embedded registries may call it directly."""
+        if self.compaction_policy is None or self._compactor_task is not None:
+            return
+
+        async def _loop() -> None:
+            while True:
+                await asyncio.sleep(self.compaction_policy.interval_seconds)
+                try:
+                    self.compact_once()
+                except Exception:  # noqa: BLE001 — keep compacting
+                    logging.getLogger("registry").warning(
+                        "compactor cycle failed", exc_info=True)
+
+        self._compactor_task = asyncio.get_running_loop().create_task(_loop())
+
+    def stop_compactor(self) -> None:
+        if self._compactor_task is not None:
+            self._compactor_task.cancel()
+            self._compactor_task = None
 
     # -- keys -------------------------------------------------------------
 

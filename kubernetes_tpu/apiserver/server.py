@@ -191,6 +191,13 @@ class APIServer:
         #: write per event was a measured syscall cost at density
         #: scale (the fan-out's send() dominated apiserver CPU).
         self.watch_write_batch = 128
+        #: Seconds between under-traffic BOOKMARK frames on a watch
+        #: stream (WatchBookmarks gate; the reference's ~1/min, scaled
+        #: to this cluster's clocks). Idle streams already get a
+        #: bookmark from the 10s next() timeout regardless of gate —
+        #: this adds them while events flow, so a busy informer's
+        #: resume point keeps advancing.
+        self.watch_bookmark_interval = 10.0
         #: FanoutFlusher when WatchFanoutBatch is on (built lazily at
         #: the first gated watch); None = per-watcher inline writes,
         #: byte-identical.
@@ -769,6 +776,7 @@ class APIServer:
         r.add_post("/debug/v1/traces", self._debug_traces_ingest)
         r.add_get("/debug/v1/query", self._debug_query)
         r.add_get("/debug/v1/alerts", self._debug_alerts)
+        r.add_get("/debug/v1/storage", self._debug_storage)
         r.add_get("/apis", self._discovery)
         # kubeadm-join analog: exchange a bootstrap token for a durable
         # node credential (bootstrap.py; the CSR-signing step's end
@@ -1195,6 +1203,35 @@ class APIServer:
         return web.json_response({
             "alerts": pipeline.alerts(),
             "stats": pipeline.stats(),
+        })
+
+    async def _debug_storage(self, request):
+        """``GET /debug/v1/storage`` — the ``ktl describe store`` view:
+        current vs compacted revision, WAL footprint, retained watch
+        history, attached watchers, encode-cache occupancy, and the
+        active compaction policy. The numbers the endurance gate reads;
+        every field is a lock-protected O(1) store read, safe inline."""
+        store = self.registry.store
+        policy = self.registry.compaction_policy
+        rev = store.revision
+        compact_rev = store.compact_rev
+        return web.json_response({
+            "revision": rev,
+            "compact_revision": compact_rev,
+            "compact_lag": rev - compact_rev,
+            "durable": store.durable,
+            "wal_bytes": store.wal_bytes,
+            "wal_records": store.wal_records,
+            "snapshots": store.snapshots,
+            "compactions": store.compactions,
+            "history_entries": store.history_len,
+            "watchers": store.watcher_count,
+            "encode_cache": self.registry.encode_cache.stats(),
+            "compaction_policy": None if policy is None else {
+                "retention_revisions": policy.retention_revisions,
+                "retention_seconds": policy.retention_seconds,
+                "interval_seconds": policy.interval_seconds,
+            },
         })
 
     async def _debug_traces_ingest(self, request):
@@ -1929,15 +1966,25 @@ class APIServer:
             return json.dumps(bookmark).encode() + b"\n"
 
         from ..util.features import GATES
+        # WatchBookmarks: besides the idle-timeout bookmark below
+        # (always on — rest.py's liveness timeout depends on it), a
+        # gated stream also gets a bookmark about every
+        # watch_bookmark_interval seconds WHILE events flow, so a busy
+        # informer's resume point keeps advancing past what the store
+        # may compact. Gate off = no extra frames, byte-identical.
+        bookmarks_on = GATES.enabled("WatchBookmarks")
+        loop = asyncio.get_running_loop()
+        last_bookmark = loop.time()
         if GATES.enabled("WatchFanoutBatch"):
             return await self._watch_fanout(resp, watch, event_line,
-                                            bookmark_line)
+                                            bookmark_line, bookmarks_on)
         try:
             closed = False
             while not closed:
                 ev = await watch.next(timeout=10.0)
                 if ev is None:
                     await resp.write(bookmark_line())
+                    last_bookmark = loop.time()
                     continue
                 # Coalesce every event already in flight into ONE
                 # socket write: per-event writes made the fan-out's
@@ -1957,6 +2004,10 @@ class APIServer:
                     ev = watch.next_nowait()
                     if ev is None:
                         break
+                if bookmarks_on and loop.time() - last_bookmark \
+                        >= self.watch_bookmark_interval:
+                    chunks.append(bookmark_line())
+                    last_bookmark = loop.time()
                 if chunks:
                     await resp.write(b"".join(chunks))
         except (ConnectionResetError, asyncio.CancelledError):
@@ -1966,7 +2017,9 @@ class APIServer:
         return resp
 
     async def _watch_fanout(self, resp, watch, event_line,
-                            bookmark_line) -> web.StreamResponse:
+                            bookmark_line,
+                            bookmarks_on: bool = False
+                            ) -> web.StreamResponse:
         """The WatchFanoutBatch half of :meth:`_watch`: this handler
         never writes the socket inline — it drains its registry watch
         queue into a per-watcher sink, and the shared FanoutFlusher's
@@ -1982,12 +2035,15 @@ class APIServer:
         # with.
         fanout = self.fanout
         sink = fanout.register(resp)
+        loop = asyncio.get_running_loop()
+        last_bookmark = loop.time()
         try:
             closed = False
             while not closed and not sink.closed:
                 ev = await watch.next(timeout=10.0)
                 if ev is None:
                     sink.push(bookmark_line())
+                    last_bookmark = loop.time()
                     continue
                 pushed = 0
                 while True:
@@ -2006,6 +2062,11 @@ class APIServer:
                     ev = watch.next_nowait()
                     if ev is None:
                         break
+                if bookmarks_on and not sink.closed \
+                        and loop.time() - last_bookmark \
+                        >= self.watch_bookmark_interval:
+                    sink.push(bookmark_line())
+                    last_bookmark = loop.time()
         except (ConnectionResetError, asyncio.CancelledError):
             pass
         finally:
@@ -2260,6 +2321,9 @@ class APIServer:
             self.codec_pool = CodecPool()
         self._probe_tasks.append(spawn(
             self._loop_lag_probe("router"), name="apiserver-loop-probe"))
+        # Periodic MVCC compactor (no-op without a CompactionPolicy on
+        # the registry) — aging hygiene runs with the server lifecycle.
+        self.registry.start_compactor()
         self._runner = web.AppRunner(self.app, access_log=None)
         await self._runner.setup()
         # Short shutdown grace: long-lived watch streams would otherwise
@@ -2274,6 +2338,7 @@ class APIServer:
         return self.port
 
     async def stop(self) -> None:
+        self.registry.stop_compactor()
         for task in self._probe_tasks:
             task.cancel()
         self._probe_tasks.clear()

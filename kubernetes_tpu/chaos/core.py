@@ -31,8 +31,11 @@ Fault catalog (site → kinds; ``param`` meaning):
 ``watch.store`` ``overflow`` (MVCC watcher force-overflowed; client relists)
 ``wal``         ``torn`` (crash mid-append: partial record on disk),
                 ``flip`` (corrupted record; CRC catches it on replay),
-                ``crash`` (crash before the record reached the disk buffer).
-                All three stop the store until it is rebuilt from disk.
+                ``crash`` (crash before the record reached the disk buffer),
+                ``compact-crash`` (arms the NEXT snapshot to die after
+                installing snapshot.json, before WAL truncation —
+                recovery must be byte-identical via replay idempotence).
+                All four stop the store until it is rebuilt from disk.
 ``heartbeat``   ``miss`` (param: seconds the node agent mutes lease
                 renewals AND status posts — a network partition)
 ``deviceplugin``  ``unhealthy`` (param: seconds one chip reports unhealthy)
@@ -70,7 +73,7 @@ KINDS = {
     SITE_REST: ("error", "http500", "hang", "slow"),
     SITE_WATCH_REST: ("drop",),
     SITE_WATCH_STORE: ("overflow",),
-    SITE_WAL: ("torn", "flip", "crash"),
+    SITE_WAL: ("torn", "flip", "crash", "compact-crash"),
     SITE_HEARTBEAT: ("miss",),
     SITE_DEVICE: ("unhealthy",),
     # Mid-checkpoint crash: between a graceful-preemption signal and

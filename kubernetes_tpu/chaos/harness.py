@@ -101,8 +101,12 @@ class _Plane:
     store; the harness crashes and rebuilds it."""
 
     def __init__(self, data_dir: str, port: int = 0, queueing: bool = False):
+        # wal_max_records: small enough that the run's write volume
+        # crosses it — snapshot-then-truncate rotation happens UNDER
+        # chaos, so the crash/recovery identity asserts also cover a
+        # WAL that has rotated mid-run.
         self.store = MVCCStore(os.path.join(data_dir, "state"),
-                               fsync="batch")
+                               fsync="batch", wal_max_records=64)
         self.registry = Registry(store=self.store)
         self.registry.admission = default_chain(self.registry)
         try:
@@ -230,6 +234,24 @@ async def run_chaos(seed: int, n_nodes: int = 4, gangs: int = 4,
                     f"bound gang {g.metadata.name} was never admitted"
                 pre_crash_admissions[g.metadata.name] = g.status.admitted_time
 
+        # Online compaction mid-run, with every watch still attached:
+        # discarding history below the head must not disturb streaming
+        # watches (the scheduler keeps converging below) and must not
+        # perturb durability — compaction trims memory, never the WAL,
+        # so the byte-identity asserts that follow also prove replay
+        # is unaffected by a compacted live store.
+        compact_floor = plane.store.compact(
+            max(plane.store.revision // 2, 1))
+        report["compact_floor"] = compact_floor
+        assert plane.store.compact_rev == compact_floor > 0, \
+            "mid-run compaction did not advance the floor"
+        # Deterministic snapshot+truncation before the crash (rotation
+        # by threshold depends on write volume): the crash that follows
+        # now recovers from snapshot + short WAL, so byte-identity is
+        # proven across the rotated layout on every schedule.
+        plane.store.snapshot()
+        report["wal_snapshots"] = plane.store.snapshots
+
         # Mid-run WAL crash: the next store write tears the log and the
         # backend goes down, exactly like a process crash mid-append.
         controller.trigger(core.SITE_WAL, "torn")
@@ -323,6 +345,7 @@ async def run_chaos(seed: int, n_nodes: int = 4, gangs: int = 4,
         report["fingerprints"] = fingerprints
         report["fault_kinds"] = len({(f.site, f.kind)
                                      for f in controller.injected})
+        report["wal_snapshots"] += plane.store.snapshots
         report["elapsed_s"] = round(time.perf_counter() - t0, 2)
         return report
     finally:

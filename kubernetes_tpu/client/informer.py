@@ -22,10 +22,27 @@ from typing import Any, Callable, Optional
 
 from .. import tracing
 from ..api import errors
+from ..metrics.registry import Counter, Gauge
 from .interface import Client
 from .mutation_detector import CacheMutationDetector
 
 log = logging.getLogger("informer")
+
+INFORMER_RELISTS = Counter(
+    "informer_relists_total",
+    "Full LIST+replace cycles (startup, reconnect without a usable "
+    "bookmark, or 410 Gone after compaction)", labels=("plural",))
+INFORMER_BOOKMARK_RESUMES = Counter(
+    "informer_bookmark_resumes_total",
+    "Reconnects resumed from the last bookmark revision, skipping the "
+    "relist (WatchBookmarks gate)", labels=("plural",))
+INFORMER_STORE_ENTRIES = Gauge(
+    "informer_store_entries", "Objects held by informer caches",
+    labels=("store",))
+INFORMER_STORE_EVICTIONS = Counter(
+    "informer_store_evictions_total",
+    "Objects FIFO-evicted by an informer cache's opt-in max_entries "
+    "ceiling", labels=("store",))
 
 ADDED = "ADDED"
 MODIFIED = "MODIFIED"
@@ -42,7 +59,15 @@ class Indexer:
     """Thread-unsafe (single-loop) keyed store with secondary indexes."""
 
     def __init__(self, indexers: Optional[dict[str, Callable[[Any], list[str]]]] = None,
-                 name: str = "indexer"):
+                 name: str = "indexer", max_entries: int = 0):
+        """``max_entries``: opt-in FIFO ceiling (0 = unbounded, the
+        default). Control-loop informers MUST stay unbounded — evicting
+        a live object would corrupt the controller's world view; the
+        ceiling is for telemetry-class caches (event streams, ad-hoc
+        watchers) whose keyspace grows with history, not with live
+        cluster size."""
+        self._name = name
+        self.max_entries = max_entries
         self._items: dict[str, Any] = {}
         self._indexers = dict(indexers or {})
         self._indexes: dict[str, dict[str, set[str]]] = {n: {} for n in self._indexers}
@@ -83,6 +108,12 @@ class Indexer:
         self._update_index(key, old, obj)
         if self.mutation_detector.enabled:
             self.mutation_detector.capture(key, obj)
+        if self.max_entries and len(self._items) > self.max_entries:
+            oldest = next(iter(self._items))
+            if oldest != key:
+                self.remove(oldest)
+                INFORMER_STORE_EVICTIONS.inc(store=self._name)
+        INFORMER_STORE_ENTRIES.set(float(len(self._items)), store=self._name)
         return old
 
     def remove(self, obj_or_key) -> Optional[Any]:
@@ -91,6 +122,7 @@ class Indexer:
         if old is not None:
             self._update_index(key, old, None)
             self.mutation_detector.forget(key)
+        INFORMER_STORE_ENTRIES.set(float(len(self._items)), store=self._name)
         return old
 
     def get(self, key: str) -> Optional[Any]:
@@ -122,14 +154,16 @@ class SharedInformer:
     def __init__(self, client: Client, plural: str, namespace: str = "",
                  label_selector: str = "", field_selector: str = "",
                  resync_period: float = 0.0,
-                 indexers: Optional[dict[str, Callable[[Any], list[str]]]] = None):
+                 indexers: Optional[dict[str, Callable[[Any], list[str]]]] = None,
+                 max_entries: int = 0):
         self.client = client
         self.plural = plural
         self.namespace = namespace
         self.label_selector = label_selector
         self.field_selector = field_selector
         self.resync_period = resync_period
-        self.store = Indexer(indexers, name=f"informer({plural})")
+        self.store = Indexer(indexers, name=f"informer({plural})",
+                             max_entries=max_entries)
         self._handlers: list[tuple[Callable, Callable, Callable]] = []
         self._synced = asyncio.Event()
         self._stopped = False
@@ -194,15 +228,37 @@ class SharedInformer:
                 backoff = min(backoff * 2, 5.0)
 
     async def _list_and_watch(self) -> None:
+        from ..util.features import GATES
+        if GATES.enabled("WatchBookmarks") and self._synced.is_set() \
+                and self.last_sync_resource_version:
+            # Bookmark resume: the cache is already populated and the
+            # server has been advancing our resume point via BOOKMARK
+            # frames — reconnect the watch from it instead of paying a
+            # full LIST + decode + replace. A 410 (the store compacted
+            # past our bookmark) falls through to the relist below —
+            # the one answer to Gone.
+            try:
+                await self._watch_from(self.last_sync_resource_version,
+                                       resumed=True)
+                return
+            except errors.GoneError:
+                log.info("informer(%s): bookmark revision %d compacted; "
+                         "relisting", self.plural,
+                         self.last_sync_resource_version)
         items, rev = await self.client.list(
             self.plural, self.namespace, self.label_selector, self.field_selector)
         self._list_ok = True
+        INFORMER_RELISTS.inc(plural=self.plural)
         self._replace(items)
         self.last_sync_resource_version = rev
         self._synced.set()
+        await self._watch_from(rev, resumed=False)
 
+    async def _watch_from(self, rev: int, resumed: bool) -> None:
         watch = await self.client.watch(
             self.plural, self.namespace, rev, self.label_selector, self.field_selector)
+        if resumed:
+            INFORMER_BOOKMARK_RESUMES.inc(plural=self.plural)
         resync_deadline = (asyncio.get_running_loop().time() + self.resync_period
                            if self.resync_period else None)
         try:
@@ -214,6 +270,10 @@ class SharedInformer:
                     resync_deadline = asyncio.get_running_loop().time() + self.resync_period
                 if ev is None:
                     continue
+                # Anything the stream delivers proves the connection is
+                # live — on a bookmark resume (no LIST happened) this is
+                # the signal that resets run()'s backoff.
+                self._list_ok = True
                 etype, obj = ev
                 if etype == CLOSED:
                     # Stream ended (server restart / connection drop):

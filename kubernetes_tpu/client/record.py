@@ -17,16 +17,28 @@ from ..api import errors
 from ..api.meta import ObjectMeta, now
 from ..api.scheme import DEFAULT_SCHEME
 from ..api.types import Event, EventSource, ObjectReference
+from ..metrics.registry import Counter, Gauge
 from ..util.tasks import spawn
 from .interface import Client
 
 log = logging.getLogger("events")
 
+RECORDER_SEEN_ENTRIES = Gauge(
+    "event_recorder_seen_entries",
+    "Keys in the event recorder's dedup (correlation) map")
+RECORDER_SEEN_EVICTIONS = Counter(
+    "event_recorder_seen_evictions_total",
+    "Dedup keys FIFO-pruned by the recorder's seen_limit ceiling")
+
 
 class EventRecorder:
     def __init__(self, client: Client, component: str, host: str = "",
                  qps: float = 50.0, burst: int = 100,
-                 batch_limit: int = 128):
+                 batch_limit: int = 128, seen_limit: int = 4096):
+        """``seen_limit``: ceiling on the dedup map (the memory bound
+        that keeps a week of event churn from growing this process —
+        a pruned key just pays one extra round trip on its next
+        occurrence)."""
         self.client = client
         self.source = EventSource(component=component, host=host)
         #: First-occurrence events SPOOL and flush as one
@@ -46,7 +58,7 @@ class EventRecorder:
         # e.g. per-pod Scheduled at density scale) and repeats go
         # straight to update without a probing GET.
         self._seen: dict[str, None] = {}
-        self._seen_limit = 4096
+        self._seen_limit = seen_limit
         # Normal-event rate limit (reference: kubelet --event-qps /
         # --event-burst + client-go's sink rate limiter). At 30k-pod
         # density the per-pod Scheduled events alone were a third of
@@ -168,9 +180,12 @@ class EventRecorder:
         if len(self._seen) >= self._seen_limit:
             # FIFO prune (dict preserves insertion order) — a miss
             # just pays one extra round trip.
-            for stale in list(self._seen)[: self._seen_limit // 2]:
+            stale_keys = list(self._seen)[: self._seen_limit // 2]
+            for stale in stale_keys:
                 del self._seen[stale]
+            RECORDER_SEEN_EVICTIONS.inc(float(len(stale_keys)))
         self._seen[key] = None
+        RECORDER_SEEN_ENTRIES.set(float(len(self._seen)))
 
     async def _bump_seen(self, ev: Event, key: str) -> None:
         """count++ on an event this process already created; a

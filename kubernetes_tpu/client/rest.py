@@ -119,6 +119,10 @@ class _RESTWatch(WatchStream):
         self._closed = False
         #: True once the server stream has ended (consumer must reconnect).
         self.closed = False
+        #: Highest revision a BOOKMARK frame carried on this stream —
+        #: the resume point a reconnect may watch from instead of
+        #: relisting (WatchBookmarks); 0 until the first bookmark.
+        self.bookmark_revision = 0
 
     async def _run(self) -> None:
         from ..util import compactcodec
@@ -164,6 +168,11 @@ class _RESTWatch(WatchStream):
             if fault is not None and fault.kind == "drop":
                 return False
         if msg["type"] == BOOKMARK:
+            try:
+                rv = int(msg["object"]["metadata"]["resource_version"])
+                self.bookmark_revision = max(self.bookmark_revision, rv)
+            except (KeyError, TypeError, ValueError):
+                pass
             await self._queue.put((BOOKMARK, msg["object"]))
             return True
         obj = decode_obj(msg["object"])

@@ -1,0 +1,205 @@
+"""Sustained-churn endurance harness — does the control plane age?
+
+Production scale is weeks of traffic, not a 90-second burst: steady
+create/delete churn (the regime of millions of pods/day) grows MVCC
+watch history, the WAL, and every cache unless the aging-hygiene layer
+— periodic revision compaction, threshold WAL snapshot/truncation,
+bounded caches — holds them flat. This harness runs that churn through
+the real wire path (in-process APIServer + RESTClient + a
+SharedInformer riding the watch stream) and SAMPLES the aging
+indicators over time: process RSS, WAL bytes, compact-revision lag,
+retained watch history, encode-cache entries, and api p99.
+
+The gate (ROADMAP item 2b): with compaction on, RSS and api p99 drift
+stay flat (first third vs last third of the run) while WAL bytes stay
+bounded; the compaction-off arm exists to show the contrast — history
+and WAL grow monotonically with write count.
+
+Run directly::
+
+    python -m kubernetes_tpu.perf.churn_bench [duration_s] [on|off|both]
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import shutil
+import tempfile
+import time
+
+from . import pct
+from ..api import types as t
+from ..api.meta import ObjectMeta
+from ..apiserver.registry import CompactionPolicy, Registry
+from ..apiserver.server import APIServer
+from ..client.informer import SharedInformer
+from ..client.rest import RESTClient
+from ..storage.mvcc import MVCCStore
+from ..util.features import GATES
+from .density import host_fingerprint
+
+_PAGE = os.sysconf("SC_PAGE_SIZE") if hasattr(os, "sysconf") else 4096
+
+
+def rss_bytes() -> int:
+    """This process's resident set (0 where /proc is unavailable)."""
+    try:
+        with open("/proc/self/statm") as f:
+            return int(f.read().split()[1]) * _PAGE
+    except (OSError, ValueError, IndexError):
+        return 0
+
+
+def _drift(values: list) -> float:
+    """Relative drift: mean of the last third vs mean of the first
+    third (0.1 = grew 10% over the run). 0.0 when too few samples."""
+    third = len(values) // 3
+    if third < 1:
+        return 0.0
+    first = sum(values[:third]) / third
+    last = sum(values[-third:]) / third
+    if first <= 0:
+        return 0.0
+    return (last - first) / first
+
+
+def _churn_pod(name: str) -> t.Pod:
+    return t.Pod(
+        metadata=ObjectMeta(name=name, namespace="default",
+                            labels={"app": "churn"}),
+        spec=t.PodSpec(containers=[t.Container(name="c", image="pause")]))
+
+
+async def run_churn(duration_s: float = 60.0, compaction: bool = True,
+                    live_set: int = 200, sample_interval: float = 1.0,
+                    wal_max_bytes: int = 4 * 1024 * 1024,
+                    retention_revisions: int = 2000,
+                    retention_seconds: float = 5.0,
+                    compact_interval: float = 1.0) -> dict:
+    """One endurance arm. ``compaction=True`` runs with the full
+    hygiene layer (CompactionPolicy, WAL thresholds, WatchBookmarks);
+    False runs the unbounded legacy configuration — same traffic, so
+    the two reports contrast directly. Unscheduled pods churn through
+    create+delete (unassigned pods hard-delete — no scheduler or node
+    agent needed for storage-path churn)."""
+    data_dir = tempfile.mkdtemp(prefix="ktpu-churn-")
+    snap = GATES.snapshot()
+    store = MVCCStore(
+        os.path.join(data_dir, "state"),
+        wal_max_bytes=wal_max_bytes if compaction else 0)
+    policy = CompactionPolicy(
+        retention_revisions=retention_revisions,
+        retention_seconds=retention_seconds,
+        interval_seconds=compact_interval) if compaction else None
+    registry = Registry(store=store, compaction_policy=policy)
+    server = APIServer(registry)
+    client = None
+    informer = None
+    samples: list[dict] = []
+    lat: list[tuple[float, float]] = []  # (t_done, seconds)
+    try:
+        GATES.set("WatchBookmarks", compaction)
+        await server.start()
+        client = RESTClient(f"http://127.0.0.1:{server.port}")
+        client.backoff_base = 0.02
+        informer = SharedInformer(client, "pods", "default").start()
+        await informer.wait_for_sync()
+
+        loop = asyncio.get_running_loop()
+        t0 = loop.time()
+        deadline = t0 + duration_s
+        next_sample = t0 + sample_interval
+        i = 0
+        while loop.time() < deadline:
+            name = f"churn-{i}"
+            t_op = time.perf_counter()
+            await client.create(_churn_pod(name))
+            lat.append((loop.time(), time.perf_counter() - t_op))
+            if i >= live_set:
+                t_op = time.perf_counter()
+                await client.delete("pods", "default",
+                                    f"churn-{i - live_set}")
+                lat.append((loop.time(), time.perf_counter() - t_op))
+            i += 1
+            if loop.time() >= next_sample:
+                samples.append({
+                    "t": round(loop.time() - t0, 2),
+                    "rss_bytes": rss_bytes(),
+                    "rev": store.revision,
+                    "compact_lag": store.revision - store.compact_rev,
+                    "wal_bytes": store.wal_bytes,
+                    "history_entries": store.history_len,
+                    "encode_cache_entries": len(registry.encode_cache),
+                    "watchers": store.watcher_count,
+                })
+                next_sample += sample_interval
+
+        # Informer liveness: its resume point must have ridden the
+        # stream to (near) the store head — a stalled watch would
+        # freeze it an entire run behind.
+        store_rev = store.revision
+        informer_lag = store_rev - informer.last_sync_resource_version
+        window = 3.0 if duration_s >= 10 else duration_s / 2
+        first = sorted(s for ts, s in lat if ts - t0 <= window)
+        last = sorted(s for ts, s in lat if deadline - ts <= window)
+        out = {
+            "compaction": compaction,
+            "duration_s": duration_s,
+            "ops": len(lat),
+            "ops_per_s": round(len(lat) / duration_s, 1),
+            "live_set": live_set,
+            "final_rev": store_rev,
+            "final_compact_lag": store_rev - store.compact_rev,
+            "final_history_entries": store.history_len,
+            "wal_bytes_max": max((s["wal_bytes"] for s in samples),
+                                 default=store.wal_bytes),
+            "wal_snapshots": store.snapshots,
+            "compactions": store.compactions,
+            "rss_first_mb": round(samples[0]["rss_bytes"] / 2**20, 1)
+            if samples else 0.0,
+            "rss_last_mb": round(samples[-1]["rss_bytes"] / 2**20, 1)
+            if samples else 0.0,
+            "rss_drift": round(_drift([s["rss_bytes"] for s in samples]), 4),
+            "history_drift": round(
+                _drift([s["history_entries"] for s in samples]), 4),
+            "api_p99_first_ms": round(pct(first, 0.99) * 1e3, 2)
+            if first else 0.0,
+            "api_p99_last_ms": round(pct(last, 0.99) * 1e3, 2)
+            if last else 0.0,
+            "informer_rev_lag": informer_lag,
+            "samples": samples,
+        }
+        out["host"] = host_fingerprint()
+        p_first, p_last = out["api_p99_first_ms"], out["api_p99_last_ms"]
+        out["api_p99_drift"] = round((p_last - p_first) / p_first, 4) \
+            if p_first > 0 else 0.0
+        return out
+    finally:
+        GATES.restore(snap)
+        if informer is not None:
+            await informer.stop()
+        if client is not None:
+            await client.close()
+        await server.stop()
+        store.close()
+        shutil.rmtree(data_dir, ignore_errors=True)
+
+
+async def run_endurance(duration_s: float = 60.0, arms: str = "both") -> dict:
+    """The full endurance stanza: the compaction-on arm (the gate) and
+    optionally the unbounded-off arm (the contrast)."""
+    out: dict = {}
+    if arms in ("on", "both"):
+        out["compaction_on"] = await run_churn(duration_s, compaction=True)
+    if arms in ("off", "both"):
+        out["compaction_off"] = await run_churn(duration_s, compaction=False)
+    return out
+
+
+if __name__ == "__main__":
+    import sys
+
+    duration = float(sys.argv[1]) if len(sys.argv) > 1 else 60.0
+    arms = sys.argv[2] if len(sys.argv) > 2 else "both"
+    print(json.dumps(asyncio.run(run_endurance(duration, arms))))

@@ -125,6 +125,12 @@ class Watch:
         #: True when the stream was closed because the consumer was too
         #: slow (the client must relist).
         self.overflowed = False
+        #: Observability flag: the store compacted PAST this watch's
+        #: start revision while it was attached. The stream itself is
+        #: unaffected (replay already happened under the lock; queued
+        #: events are references that survive the history trim) — only
+        #: a RECONNECT from that old revision would now 410.
+        self.compacted = False
 
     def _deliver(self, ev: Optional[WatchEvent]) -> None:
         # Called with store lock held, possibly from a foreign thread.
@@ -302,7 +308,8 @@ class _PrefixIndexedMap(dict):
 class MVCCStore:
     def __init__(self, data_dir: Optional[str] = None, history_limit: int = 100_000,
                  transformers: Optional[dict] = None, fsync: str = "none",
-                 fsync_batch: int = 64, fsync_interval: float = 0.05):
+                 fsync_batch: int = 64, fsync_interval: float = 0.05,
+                 wal_max_bytes: int = 0, wal_max_records: int = 0):
         """``transformers``: key-prefix -> encryption.Transformer,
         applied at the persistence boundary only (WAL append, snapshot
         write, load) — the in-memory store, watch history, and every
@@ -315,7 +322,15 @@ class MVCCStore:
         module docstring); "batch" group-commits: an APPEND fsyncs
         once ``fsync_batch`` records or ``fsync_interval`` seconds
         accumulated since the last sync (idle tails sync at
-        close/snapshot/fsync_now, not on a timer)."""
+        close/snapshot/fsync_now, not on a timer).
+
+        ``wal_max_bytes`` / ``wal_max_records``: WAL rotation
+        thresholds (0 = disabled). When the log crosses either limit
+        the store auto-:meth:`snapshot`\\ s inline on the append path,
+        folding the log into snapshot.json and truncating it — disk
+        footprint and recovery time stay flat under sustained churn
+        instead of growing with total write count (the etcd
+        snap-count discipline)."""
         if fsync not in ("none", "batch", "always"):
             raise ValueError(f"fsync must be none|batch|always, got {fsync!r}")
         self._lock = make_lock("mvcc.Store", rlock=True)
@@ -324,6 +339,19 @@ class MVCCStore:
         self._fsync_interval = fsync_interval
         self._wal_unsynced = 0
         self._wal_last_sync = time.monotonic()
+        self._wal_max_bytes = wal_max_bytes
+        self._wal_max_records = wal_max_records
+        #: Current WAL footprint (bytes / record count since the last
+        #: truncation) — the auto-snapshot trigger and the numbers the
+        #: /debug/v1/storage endpoint and endurance gate read.
+        self._wal_bytes = 0
+        self._wal_records = 0
+        self._snapshots = 0
+        self._compactions = 0
+        #: chaos ``wal:compact-crash``: when armed, the NEXT snapshot
+        #: dies after installing snapshot.json but before truncating
+        #: the WAL (see :meth:`snapshot`).
+        self._compact_crash_armed = False
         #: True once a WAL fault (chaos) crashed the backend: every
         #: further mutation raises until the store is rebuilt from disk.
         self._wal_failed = False
@@ -379,7 +407,11 @@ class MVCCStore:
         if data_dir:
             os.makedirs(data_dir, exist_ok=True)
             self._load()
-            self._wal = open(os.path.join(data_dir, "wal.jsonl"), "a", buffering=1)
+            wal_path = os.path.join(data_dir, "wal.jsonl")
+            self._wal = open(wal_path, "a", buffering=1)
+            # Footprint resumes from the recovered (post-truncation)
+            # log, so rotation thresholds survive a restart.
+            self._wal_bytes = os.path.getsize(wal_path)
         if invariants.SANITIZER is not None:
             # tpusan: every store built while the sanitizer is armed is
             # checked on every write (chaos harness restarts included).
@@ -470,6 +502,7 @@ class MVCCStore:
                 if rec is None:
                     break  # bad CRC / truncated JSON — corrupt cutoff
                 self._apply_wal_record(rec)
+                self._wal_records += 1
             good_end = nl + 1
         return good_end
 
@@ -531,11 +564,30 @@ class MVCCStore:
                 f.flush()
                 os.fsync(f.fileno())
             os.replace(tmp, os.path.join(self._data_dir, "snapshot.json"))
+            if self._compact_crash_armed:
+                # chaos ``wal:compact-crash``: die in the window where
+                # the new snapshot is durable but the old WAL has not
+                # been truncated. Recovery loads the snapshot AND
+                # replays the whole stale log; replay idempotence
+                # (``rec["rev"] <= self._rev`` skipped) must make that
+                # byte-identical to the pre-crash state.
+                self._compact_crash_armed = False
+                self.pre_crash_state = self.state()
+                if self._wal:
+                    self._wal.close()
+                self._wal_failed = True
+                raise errors.ServiceUnavailableError(
+                    "chaos: crashed between snapshot install and WAL "
+                    "truncation (compact-crash)")
             if self._wal:
                 self._wal.close()
             wal_path = os.path.join(self._data_dir, "wal.jsonl")
             open(wal_path, "w").close()
             self._wal = open(wal_path, "a", buffering=1)
+            self._wal_bytes = 0
+            self._wal_records = 0
+            self._wal_unsynced = 0
+            self._snapshots += 1
 
     def close(self) -> None:
         with self._lock:
@@ -582,9 +634,12 @@ class MVCCStore:
             del self._log[:cut]
             del self._log_revs[:cut]
         if self._wal and not self._wal_failed:
-            self._wal.write(self._wal_line(ev.revision, ev.type, ev.key,
-                                           ev.value))
+            line = self._wal_line(ev.revision, ev.type, ev.key, ev.value)
+            self._wal.write(line)
+            self._wal_bytes += len(line)
+            self._wal_records += 1
             self._wal_sync()
+            self._maybe_rotate_wal()
         # Snapshot: an overflowing watcher removes itself from _watches
         # during _deliver; mutating the live list mid-iteration would
         # silently skip the next watcher's delivery of this event.
@@ -617,6 +672,18 @@ class MVCCStore:
                 and time.monotonic() - self._wal_last_sync < self._fsync_interval:
             return
         self.fsync_now()
+
+    def _maybe_rotate_wal(self) -> None:
+        """Threshold-driven WAL rotation, checked after every append
+        (under the store RLock — :meth:`snapshot` re-enters safely).
+        With both thresholds 0 the WAL grows until a manual snapshot,
+        byte-identical to the pre-rotation store."""
+        if self._wal is None or self._wal_failed:
+            return
+        if (self._wal_max_bytes and self._wal_bytes >= self._wal_max_bytes) \
+                or (self._wal_max_records
+                    and self._wal_records >= self._wal_max_records):
+            self.snapshot()
 
     def fsync_now(self) -> None:
         """Flush + fsync the WAL now (quiesce points: snapshot, close,
@@ -667,6 +734,13 @@ class MVCCStore:
             return
         fault = c.decide(chaos.SITE_WAL)
         if fault is None:
+            return
+        if fault.kind == "compact-crash":
+            # Armed, not fired: THIS write proceeds normally; the next
+            # snapshot (manual or threshold-triggered) crashes between
+            # installing snapshot.json and truncating the WAL — the
+            # compaction analog of a torn tail (see :meth:`snapshot`).
+            self._compact_crash_armed = True
             return
         self.pre_crash_state = self.state()
         line = self._wal_line(self._rev + 1, op, key, value)
@@ -954,9 +1028,81 @@ class MVCCStore:
             except ValueError:
                 pass
 
-    def compact(self, revision: int) -> None:
+    def compact(self, revision: int) -> int:
+        """Online revision compaction (etcd ``Compact``): discard event
+        history at or below ``revision`` and advance the compacted
+        floor. Live state is untouched — ``state()``, reads, and WAL
+        replay are byte-identical across a compaction; only how far
+        back a NEW watch may resume changes (a ``start_revision`` below
+        the floor gets GoneError/410 and the client relists).
+
+        Already-attached watches need no cancellation: watch replay is
+        serialized with compaction under the store lock, so any history
+        a live watch was owed has been delivered before the trim, and
+        its queued events are references unaffected by it. They are
+        only FLAGGED (:attr:`Watch.compacted`) — the signal that a
+        reconnect from their start revision would now 410.
+
+        ``revision`` is clamped to the current revision; at or below
+        the existing floor is a no-op. Returns the new floor.
+        Replicated stores must only be compacted at or below the quorum
+        commit revision (the registry compactor enforces this) so
+        committed-never-lost is untouched."""
         with self._lock:
+            revision = min(revision, self._rev)
+            if revision <= self._compact_rev:
+                return self._compact_rev
             idx = bisect.bisect_right(self._log_revs, revision)
-            self._compact_rev = max(self._compact_rev, revision)
+            self._compact_rev = revision
             del self._log[:idx]
             del self._log_revs[:idx]
+            self._compactions += 1
+            for wch in self._watches:
+                if wch.start_revision and wch.start_revision < revision:
+                    wch.compacted = True
+            return self._compact_rev
+
+    # -- endurance observability ------------------------------------------
+    # The numbers /debug/v1/storage serves and the endurance gate reads.
+
+    @property
+    def compact_rev(self) -> int:
+        """Compacted floor: watches may not resume at or below this."""
+        with self._lock:
+            return self._compact_rev
+
+    @property
+    def wal_bytes(self) -> int:
+        """WAL bytes since the last truncation (0 when not durable)."""
+        with self._lock:
+            return self._wal_bytes
+
+    @property
+    def wal_records(self) -> int:
+        """WAL records since the last truncation (0 when not durable)."""
+        with self._lock:
+            return self._wal_records
+
+    @property
+    def history_len(self) -> int:
+        """Watch-replay event history currently retained in memory."""
+        with self._lock:
+            return len(self._log)
+
+    @property
+    def watcher_count(self) -> int:
+        with self._lock:
+            return len(self._watches)
+
+    @property
+    def compactions(self) -> int:
+        """Explicit :meth:`compact` calls that advanced the floor."""
+        with self._lock:
+            return self._compactions
+
+    @property
+    def snapshots(self) -> int:
+        """Snapshot+truncate cycles completed since this store opened
+        (manual and threshold-triggered alike)."""
+        with self._lock:
+            return self._snapshots

@@ -176,6 +176,18 @@ KNOWN_FEATURES = {f.name: f for f in [
             "the cluster's own DNS; gang recovery rounds on member "
             "failure with Orbax resume from the shared checkpoint "
             "volume. Off = the controller is inert, byte-identical"),
+    Feature("WatchBookmarks", False, ALPHA,
+            "periodic watch bookmark frames under traffic (reference: "
+            "WatchBookmark): the apiserver injects BOOKMARK events "
+            "carrying the current store revision into every watch "
+            "stream (JSON and compact codec) about once per bookmark "
+            "interval, and SharedInformer reconnects resume from the "
+            "last bookmark instead of a full LIST+decode; a resume "
+            "below the store's compacted floor still gets 410 Gone "
+            "and falls back to relist. The pre-existing idle-timeout "
+            "bookmark stays on either way (rest.py's liveness check "
+            "depends on it). Off = no under-traffic bookmarks, "
+            "reconnects always relist — byte-identical on the wire"),
     Feature("ClusterMonitoring", True, BETA,
             "cluster-level TPU telemetry rollup (monitoring/"
             "aggregator.py): the controller-manager scrapes node "

@@ -304,3 +304,18 @@ async def test_device_driver_flips_chip_health_and_restores():
         await asyncio.sleep(0.05)
     assert plugin._topology.chips[0].health == "Healthy"
     await driver.stop()
+
+
+def test_compact_crash_kind_parse_and_trigger():
+    """wal:compact-crash is a first-class schedule/trigger kind (the
+    snapshot-installed-but-WAL-untruncated crash window)."""
+    specs = parse_schedule("wal:compact-crash:at=1")
+    assert specs[0] == FaultSpec(core.SITE_WAL, "compact-crash", at=(1,))
+    c = ChaosController(0, specs)
+    f = c.decide(core.SITE_WAL)
+    assert f is not None and f.kind == "compact-crash"
+    c2 = ChaosController(0, ())
+    c2.trigger(core.SITE_WAL, "compact-crash")
+    assert c2.decide(core.SITE_WAL).kind == "compact-crash"
+    with pytest.raises(ValueError):
+        FaultSpec(core.SITE_REST, "compact-crash")  # WAL-site only

@@ -1,0 +1,311 @@
+"""Control-plane endurance contract: the periodic compactor's
+retention math, watch bookmarks end to end (apiserver -> RESTClient ->
+SharedInformer resume), the 410-after-compaction relist path, and the
+memory ceilings (encode cache bytes, recorder dedup map)."""
+import asyncio
+import json
+from types import SimpleNamespace
+
+import aiohttp
+import pytest
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.apiserver.registry import CompactionPolicy, Registry
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.chaos import core
+from kubernetes_tpu.chaos.core import ChaosController
+from kubernetes_tpu.client.informer import (
+    INFORMER_BOOKMARK_RESUMES, INFORMER_RELISTS, SharedInformer)
+from kubernetes_tpu.client.rest import RESTClient
+from kubernetes_tpu.util.features import GATES
+
+
+@pytest.fixture(autouse=True)
+def _chaos_disarmed():
+    yield
+    core.disarm()
+
+
+@pytest.fixture()
+def _bookmarks_on():
+    snap = GATES.snapshot()
+    GATES.set("WatchBookmarks", True)
+    yield
+    GATES.restore(snap)
+
+
+def mk_pod(name):
+    return t.Pod(metadata=ObjectMeta(name=name, namespace="default"),
+                 spec=t.PodSpec(containers=[t.Container(name="c", image="i")]))
+
+
+def _fill(reg, n, prefix="cm"):
+    for i in range(n):
+        reg.create(t.ConfigMap(metadata=ObjectMeta(
+            name=f"{prefix}-{i}", namespace="default")))
+
+
+# ---------------------------------------------------------------------------
+# CompactionPolicy / Registry.compact_once retention math
+# ---------------------------------------------------------------------------
+
+def test_compact_once_revision_retention():
+    reg = Registry(compaction_policy=CompactionPolicy(
+        retention_revisions=5, retention_seconds=0.0))
+    reg.create(t.Namespace(metadata=ObjectMeta(name="default")))
+    _fill(reg, 20)
+    head = reg.store.revision
+    assert reg.compact_once() == head - 5
+    assert reg.store.compact_rev == head - 5
+    # Head untouched -> a second cycle is a no-op, never a regression.
+    assert reg.compact_once() == head - 5
+
+
+def test_compact_once_age_retention():
+    """The age bound compacts only revisions a full retention window
+    old — the first cycle only samples, a later cycle (past the
+    window) may discard up to the sampled revision."""
+    reg = Registry(compaction_policy=CompactionPolicy(
+        retention_revisions=0, retention_seconds=0.05))
+    reg.create(t.Namespace(metadata=ObjectMeta(name="default")))
+    _fill(reg, 10)
+    sampled = reg.store.revision
+    assert reg.compact_once() == 0  # nothing is old enough yet
+    import time
+    time.sleep(0.06)
+    _fill(reg, 5, prefix="young")
+    assert reg.compact_once() == sampled  # young revisions survive
+    assert reg.store.history_len == 5
+
+
+def test_compact_once_never_passes_quorum_commit():
+    """Replicated stores must keep history a catching-up follower will
+    replay: the floor is clamped to the commit revision."""
+    reg = Registry(compaction_policy=CompactionPolicy(
+        retention_revisions=2, retention_seconds=0.0))
+    reg.create(t.Namespace(metadata=ObjectMeta(name="default")))
+    _fill(reg, 20)
+    reg.replica = SimpleNamespace(commit_rev=4)
+    assert reg.compact_once() == 4
+    reg.replica = SimpleNamespace(commit_rev=reg.store.revision)
+    assert reg.compact_once() == reg.store.revision - 2
+
+
+def test_compact_once_without_policy_is_noop():
+    reg = Registry()
+    reg.create(t.Namespace(metadata=ObjectMeta(name="default")))
+    _fill(reg, 5)
+    assert reg.compact_once() == 0
+    assert reg.store.history_len > 0
+
+
+# ---------------------------------------------------------------------------
+# Watch bookmarks on the wire — gated, and byte-absent when off
+# ---------------------------------------------------------------------------
+
+async def _server(**kw):
+    srv = APIServer(**kw)
+    await srv.start()
+    srv.registry.create(t.Namespace(metadata=ObjectMeta(name="default")))
+    return srv
+
+
+async def test_bookmarks_absent_when_gate_off():
+    """Gates off = byte-identical wire: a watch receiving steady
+    traffic sees DATA frames only, never a BOOKMARK."""
+    srv = await _server()
+    srv.watch_bookmark_interval = 0.05
+    url = (f"http://127.0.0.1:{srv.port}/api/core/v1/namespaces/default/"
+           f"configmaps?watch=true&resource_version={srv.registry.store.revision}")
+    try:
+        async with aiohttp.ClientSession() as sess:
+            async with sess.get(url) as resp:
+                for i in range(6):
+                    _fill(srv.registry, 1, prefix=f"w{i}")
+                    line = await asyncio.wait_for(
+                        resp.content.readline(), 2.0)
+                    assert json.loads(line)["type"] != "BOOKMARK"
+                    await asyncio.sleep(0.03)
+    finally:
+        await srv.stop()
+
+
+async def test_bookmarks_flow_under_traffic_when_gated(_bookmarks_on):
+    srv = await _server()
+    srv.watch_bookmark_interval = 0.05
+    url = (f"http://127.0.0.1:{srv.port}/api/core/v1/namespaces/default/"
+           f"configmaps?watch=true&resource_version={srv.registry.store.revision}")
+    try:
+        async with aiohttp.ClientSession() as sess:
+            async with sess.get(url) as resp:
+                saw_bookmark_rv = 0
+                for i in range(20):
+                    _fill(srv.registry, 1, prefix=f"w{i}")
+                    line = await asyncio.wait_for(
+                        resp.content.readline(), 2.0)
+                    msg = json.loads(line)
+                    if msg["type"] == "BOOKMARK":
+                        saw_bookmark_rv = int(
+                            msg["object"]["metadata"]["resource_version"])
+                        break
+                    await asyncio.sleep(0.02)
+        assert saw_bookmark_rv > 0, "no BOOKMARK frame within 20 events"
+    finally:
+        await srv.stop()
+
+
+async def test_rest_watch_tracks_bookmark_revision(_bookmarks_on):
+    srv = await _server()
+    srv.watch_bookmark_interval = 0.05
+    client = RESTClient(f"http://127.0.0.1:{srv.port}")
+    try:
+        w = await client.watch("configmaps", "default",
+                               srv.registry.store.revision)
+        for i in range(20):
+            _fill(srv.registry, 1, prefix=f"rv{i}")
+            await w.next(timeout=0.2)
+            await asyncio.sleep(0.03)  # let the bookmark interval elapse
+            if w.bookmark_revision:
+                break
+        assert w.bookmark_revision > 0
+        w.cancel()
+    finally:
+        await client.close()
+        await srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# Informer resume: bookmark reconnect skips the relist; a compacted
+# resume point 410s and the SAME cycle relists
+# ---------------------------------------------------------------------------
+
+async def test_informer_bookmark_resume_skips_relist(_bookmarks_on):
+    srv = await _server()
+    client = RESTClient(f"http://127.0.0.1:{srv.port}")
+    client.backoff_base = 0.01
+    c = core.arm(ChaosController(1, ()))
+    inf = SharedInformer(client, "pods", "default")
+    inf.start()
+    try:
+        await inf.wait_for_sync()
+        relists = INFORMER_RELISTS.value(plural="pods")
+        resumes = INFORMER_BOOKMARK_RESUMES.value(plural="pods")
+        c.trigger(core.SITE_WATCH_REST, "drop")
+        srv.registry.create(mk_pod("after-drop"))
+        for _ in range(100):
+            if inf.get("default/after-drop") is not None:
+                break
+            await asyncio.sleep(0.05)
+        assert inf.get("default/after-drop") is not None
+        assert INFORMER_BOOKMARK_RESUMES.value(plural="pods") > resumes
+        assert INFORMER_RELISTS.value(plural="pods") == relists, \
+            "bookmark resume paid a full relist"
+    finally:
+        await inf.stop()
+        await client.close()
+        await srv.stop()
+
+
+async def test_informer_compacted_resume_410s_then_relists(_bookmarks_on):
+    """Seeded gap: while the informer's watch is down the store both
+    advances AND compacts past the informer's resume revision. The
+    resume attempt gets a clean 410 and the informer answers with
+    LIST + rewatch in the same cycle — no stall, no tight Gone loop."""
+    srv = await _server()
+    client = RESTClient(f"http://127.0.0.1:{srv.port}")
+    client.backoff_base = 0.01
+    c = core.arm(ChaosController(1, ()))
+    inf = SharedInformer(client, "pods", "default")
+    inf.start()
+    try:
+        await inf.wait_for_sync()
+        relists = INFORMER_RELISTS.value(plural="pods")
+        c.trigger(core.SITE_WATCH_REST, "drop")
+        srv.registry.create(mk_pod("gap-survivor"))
+        _fill(srv.registry, 30)
+        srv.registry.store.compact(srv.registry.store.revision)
+        for _ in range(100):
+            if inf.get("default/gap-survivor") is not None:
+                break
+            await asyncio.sleep(0.05)
+        assert inf.get("default/gap-survivor") is not None
+        assert INFORMER_RELISTS.value(plural="pods") > relists, \
+            "410 did not trigger a relist"
+        # And the informer is live again: new events stream in.
+        srv.registry.create(mk_pod("post-relist"))
+        for _ in range(100):
+            if inf.get("default/post-relist") is not None:
+                break
+            await asyncio.sleep(0.05)
+        assert inf.get("default/post-relist") is not None
+    finally:
+        await inf.stop()
+        await client.close()
+        await srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# /debug/v1/storage
+# ---------------------------------------------------------------------------
+
+async def test_debug_storage_endpoint():
+    srv = await _server(registry=Registry(compaction_policy=CompactionPolicy(
+        retention_revisions=3, retention_seconds=0.0)))
+    _fill(srv.registry, 10)
+    srv.registry.compact_once()
+    try:
+        async with aiohttp.ClientSession() as sess:
+            async with sess.get(
+                    f"http://127.0.0.1:{srv.port}/debug/v1/storage") as r:
+                assert r.status == 200
+                body = await r.json()
+        assert body["revision"] == srv.registry.store.revision
+        assert body["compact_revision"] == body["revision"] - 3
+        assert body["compact_lag"] == 3
+        assert body["history_entries"] == 3
+        assert body["compaction_policy"]["retention_revisions"] == 3
+        assert "entries" in body["encode_cache"]
+    finally:
+        await srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# Memory ceilings
+# ---------------------------------------------------------------------------
+
+def test_encode_cache_byte_ceiling():
+    from kubernetes_tpu.apiserver.encodecache import EncodeCache
+    cache = EncodeCache(limit=1000, max_bytes=1000)
+    for i in range(50):
+        cache.put(f"/k{i}", i + 1, b"x" * 100)
+    st = cache.stats()
+    assert st["bytes"] <= 1000
+    assert st["entries"] <= 10
+    assert st["evictions"] >= 40
+    # Survivors still serve hits.
+    assert cache.get("/k49", 50) == b"x" * 100
+
+
+def test_encode_cache_oversized_entry_still_inserts():
+    from kubernetes_tpu.apiserver.encodecache import EncodeCache
+    cache = EncodeCache(limit=1000, max_bytes=100)
+    cache.put("/small", 1, b"y" * 10)
+    cache.put("/big", 2, b"z" * 500)  # evicts to empty, then inserts
+    assert cache.get("/big", 2) == b"z" * 500
+    assert cache.stats()["entries"] == 1
+
+
+async def test_recorder_seen_map_ceiling():
+    from kubernetes_tpu.client.record import EventRecorder
+
+    class _Null:
+        async def create_many(self, objs, decode=True):
+            return [None] * len(objs)
+
+    rec = EventRecorder(_Null(), "test", seen_limit=10)
+    pod = mk_pod("churny")
+    for i in range(50):
+        rec.event(pod, "Normal", f"Reason{i}", f"msg {i}")
+    await asyncio.sleep(0.05)  # let the flush task drain
+    assert len(rec._seen) <= 10

@@ -295,3 +295,137 @@ def test_wal_group_commit_fsync_batching(tmp_path):
 
     with pytest.raises(ValueError):
         MVCCStore(str(tmp_path), fsync="sometimes")
+
+
+# ---------------------------------------------------------------------------
+# Online compaction + WAL rotation — the endurance contract: discarding
+# watch history and truncating the WAL must be invisible to state(),
+# attached watches, and replay.
+# ---------------------------------------------------------------------------
+
+def _state_json(s: MVCCStore) -> str:
+    import json
+    return json.dumps(s.state(), sort_keys=True)
+
+
+async def test_compact_mid_watch_stream_continues():
+    """Compacting below an attached watch's start revision flags it
+    (a reconnect from that revision would 410) but never cancels the
+    live stream — events keep flowing."""
+    s = MVCCStore()
+    loop = asyncio.get_event_loop()
+    r1 = s.create("/pods/a", {"v": 1})
+    w = s.watch("/pods/", start_revision=r1, loop=loop)
+    for i in range(5):
+        s.create(f"/pods/b{i}", {"i": i})
+    floor = s.compact(s.revision)
+    assert floor == s.revision
+    assert w.compacted and not w.closed
+    # Replayed-then-live delivery is unaffected by the trim.
+    seen = []
+    for _ in range(5):
+        seen.append((await w.next(1)).key)
+    s.create("/pods/live", {})
+    assert (await w.next(1)).key == "/pods/live"
+    w.cancel()
+    # But a NEW watch from below the floor is Gone — relist territory.
+    with pytest.raises(errors.GoneError):
+        s.watch("/pods/", start_revision=r1, loop=loop)
+
+
+def test_compact_clamp_noop_and_counters():
+    s = MVCCStore()
+    for i in range(10):
+        s.create(f"/k{i}", {})
+    before = _state_json(s)
+    # Clamped to the head; history fully trimmed; state untouched.
+    assert s.compact(10 ** 9) == s.revision
+    assert s.history_len == 0
+    assert s.compactions == 1
+    assert _state_json(s) == before
+    # Re-compacting at or below the floor is a no-op, not an error.
+    assert s.compact(1) == s.revision
+    assert s.compactions == 1
+
+
+def test_compact_preserves_replay_identity(tmp_path):
+    """Compaction trims memory, never the WAL: a store compacted
+    mid-run still replays byte-identically from disk."""
+    s = MVCCStore(str(tmp_path))
+    for i in range(20):
+        s.create(f"/k{i}", {"i": i})
+    s.update("/k3", {"i": 33})
+    s.delete("/k4")
+    s.compact(s.revision - 5)
+    assert s.compact_rev == s.revision - 5
+    s.create("/after-compact", {"ok": True})
+    live = _state_json(s)
+    s.close()
+    s2 = MVCCStore(str(tmp_path))
+    assert _state_json(s2) == live
+    # Restart is a full compaction (history is in-memory): the reloaded
+    # floor is the head, not the mid-run value — replay never needed it.
+    assert s2.compact_rev == s2.revision
+    s2.close()
+
+
+def test_wal_rotation_by_records(tmp_path):
+    s = MVCCStore(str(tmp_path), wal_max_records=5)
+    for i in range(17):
+        s.create(f"/k{i}", {"i": i})
+    assert s.snapshots >= 3
+    assert s.wal_records < 5
+    live = _state_json(s)
+    s.close()
+    s2 = MVCCStore(str(tmp_path))
+    assert _state_json(s2) == live
+    assert s2.revision == 17
+    s2.close()
+
+
+def test_wal_rotation_by_bytes(tmp_path):
+    s = MVCCStore(str(tmp_path), wal_max_bytes=256)
+    for i in range(10):
+        s.create(f"/k{i}", {"pad": "x" * 64})
+    assert s.snapshots >= 2
+    assert s.wal_bytes <= 512
+    live = _state_json(s)
+    s.close()
+    s2 = MVCCStore(str(tmp_path))
+    assert _state_json(s2) == live
+    s2.close()
+
+
+def test_chaos_compact_crash_recovers_identical(tmp_path):
+    """The wal:compact-crash fault: die AFTER the snapshot is installed
+    but BEFORE the old WAL is truncated. Replay then sees the snapshot
+    plus every pre-snapshot record again — idempotent replay (rev <=
+    snapshot rev skipped) makes recovery byte-identical anyway."""
+    import json
+    from kubernetes_tpu.chaos import core
+    s = MVCCStore(str(tmp_path))
+    for i in range(5):
+        s.create(f"/k{i}", {"i": i})
+    c = core.arm(core.ChaosController(0, ()))
+    try:
+        c.trigger(core.SITE_WAL, "compact-crash")
+        s.create("/k5", {"i": 5})  # the write arms the crash and lands
+        with pytest.raises(errors.ServiceUnavailableError):
+            s.snapshot()
+    finally:
+        core.disarm()
+    assert s.wal_failed
+    expected = json.dumps(s.pre_crash_state, sort_keys=True)
+    # The crash left BOTH the new snapshot and the full old WAL.
+    assert (tmp_path / "snapshot.json").exists()
+    assert (tmp_path / "wal.jsonl").stat().st_size > 0
+    s2 = MVCCStore(str(tmp_path))
+    assert _state_json(s2) == expected
+    assert s2.revision == 6
+    # Recovery is fully live: writes and a later snapshot both work.
+    s2.create("/k6", {"i": 6})
+    s2.snapshot()
+    s2.close()
+    s3 = MVCCStore(str(tmp_path))
+    assert s3.get("/k6").value == {"i": 6}
+    s3.close()

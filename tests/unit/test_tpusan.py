@@ -274,3 +274,30 @@ def test_invariant_verdicts_replay_by_seed():
     assert v1 == v2
     assert s1.fingerprint() == s2.fingerprint()
     assert v1 and all(inv == "chip-double-book" for inv, _ in v1)
+
+
+def test_wal_replay_identity_across_compaction_and_rotation(tmp_path):
+    """The endurance seam: mid-run revision compaction and threshold
+    WAL rotation must not disturb the wal-replay invariant — the
+    sanitizer's shadow is built from event hooks at write time, so
+    trimming the in-memory history (and truncating the WAL behind a
+    snapshot) changes nothing it compares."""
+    reg = _armed()
+    try:
+        store = MVCCStore(str(tmp_path / "state"), wal_max_records=5)
+        for i in range(8):
+            store.create(f"/registry/configmaps/default/c{i}",
+                         {"metadata": {"name": f"c{i}"}})
+        store.compact(store.revision - 2)   # online trim, watches live
+        for i in range(8, 16):              # rotation fires mid-stream
+            store.create(f"/registry/configmaps/default/c{i}",
+                         {"metadata": {"name": f"c{i}"}})
+        store.update("/registry/configmaps/default/c3",
+                     {"metadata": {"name": "c3"}, "data": {"k": "v"}})
+        store.compact(store.revision)       # full trim before the check
+        reg.check_final()
+        assert store.snapshots >= 2
+    finally:
+        invariants.disarm()
+    assert reg.violations == []
+    assert reg.checks["wal-replay"] == 1

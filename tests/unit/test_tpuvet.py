@@ -680,3 +680,31 @@ def test_cli_exit_codes(tmp_path):
     assert main(["--check", "metric-name", str(bad)]) == 0  # other pass only
     assert main(["--check", "no-such-pass", str(bad)]) == 2
     assert main(["--list"]) == 0
+
+
+def test_metric_name_endurance_families():
+    """The control-plane endurance metric families (storage_*,
+    encode_cache byte/eviction gauges, informer store ceilings, the
+    recorder dedup-map ceiling) are valid names, and a duplicate
+    registration within the family is still caught."""
+    good = """
+from kubernetes_tpu.metrics.registry import Counter, Gauge
+A = Gauge("storage_compact_revision", "x")
+B = Counter("storage_compactions_total", "x")
+C = Gauge("storage_wal_bytes", "x")
+D = Gauge("storage_watch_history_entries", "x")
+E = Gauge("encode_cache_bytes", "x")
+F = Counter("encode_cache_evictions_total", "x")
+G = Counter("informer_relists_total", "x", labels=("plural",))
+H = Counter("informer_bookmark_resumes_total", "x", labels=("plural",))
+I = Gauge("informer_store_entries", "x", labels=("store",))
+J = Counter("informer_store_evictions_total", "x", labels=("store",))
+K = Gauge("event_recorder_seen_entries", "x")
+L = Counter("event_recorder_seen_evictions_total", "x")
+"""
+    assert run_source(good, checks=["metric-name"]) == []
+    bad = good + """
+M = Gauge("storage_compact_revision", "re-registered: silently inert")
+"""
+    got = run_source(bad, checks=["metric-name"])
+    assert len(got) == 1 and "already registered" in got[0].message
