@@ -82,4 +82,38 @@ if sched_p99 > 250.0:
     sys.exit(f"bench_smoke: schedule-stage p99 {sched_p99}ms "
              f"(> 250ms floor) — the scheduler fast path regressed")
 EOF
+
+# Opt-in kloopsan arm (BENCH_LOOPSAN=1): re-run the stacked-gates arm
+# with the event-loop occupancy sanitizer armed in BOTH processes and
+# gate on attribution quality — >= 90% of apiserver and scheduler loop
+# busy-time must land on named seams (the unattributed other:* bucket
+# stays <= 10%). Not on by default: the wrapper costs ~3-5% throughput
+# armed, and this stanza measures attribution, not speed.
+if [ "${BENCH_LOOPSAN:-}" = "1" ]; then
+  timeout -k 10 240 env JAX_PLATFORMS=cpu TPU_LOOPSAN=1 python - <<'EOF'
+import asyncio, json, sys
+from kubernetes_tpu.perf.density import run_density
+
+out = asyncio.run(run_density(
+    n_nodes=200, n_pods=2000, via="rest", timeout=60.0,
+    create_concurrency=16, paced_pods=0,
+    feature_gates="ApiServerSharding=true,ApiServerCodecOffload=true,"
+                  "SchedulerFastPath=true,CompactWireCodec=true"))
+print(json.dumps({k: v for k, v in out.items()
+                  if k.startswith("loopsan") or k == "pods_per_second"}))
+if out.get("bound", 0) < 2000:
+    sys.exit(f"bench_smoke: only {out.get('bound')}/2000 pods bound "
+             f"with loopsan armed")
+for side in ("loopsan_apiserver", "loopsan_scheduler"):
+    snap = out.get(side)
+    if not snap:
+        sys.exit(f"bench_smoke: no {side} stanza — sanitizer never "
+                 f"armed in that process?")
+    share = snap.get("attributed_share", 0.0)
+    if share < 0.90:
+        sys.exit(f"bench_smoke: {side} attributed share {share} "
+                 f"(< 0.90) — the other:* bucket grew; name the seam")
+EOF
+  echo "bench_smoke: loopsan arm ok"
+fi
 echo "bench_smoke: ok"

@@ -10,7 +10,9 @@
 #      explored task-interleaving schedules (alternating plain and
 #      queueing-enabled) with the five cluster invariants checked on
 #      every store write and TPU_LOCKDEP=1 +
-#      TPU_CACHE_MUTATION_DETECTOR=1 armed underneath.
+#      TPU_CACHE_MUTATION_DETECTOR=1 + TPU_LOOPSAN=1 armed underneath
+#      (kloopsan asserts zero slow-callback violations and prints the
+#      occupancy table).
 #   3. tpusan over the two-tenant queue smoke — the fair-share
 #      admission/reclaim path under explored schedules.
 #   4. tpusan over the graceful-preemption storm.
@@ -36,10 +38,16 @@ SEED="${TPU_SAN:-20260804}"
 echo "=== 1/6 tpuvet: static analysis tree-clean ==="
 python -m kubernetes_tpu.analysis kubernetes_tpu
 
-echo "=== 2/6 tpusan: chaos convergence x8 schedules (lockdep + mutation detector armed) ==="
+echo "=== 2/6 tpusan: chaos convergence x8 schedules (lockdep + mutation detector + loopsan armed) ==="
+# TPU_LOOPSAN=1 rides along on this stage: kloopsan times every loop
+# callback and the gate asserts ZERO threshold violations on this
+# small deterministic scenario (a >100ms callback here is a real
+# stall, not load), plus a sane attribution table.
 timeout -k 10 110 env JAX_PLATFORMS=cpu TPU_SAN= TPU_CHAOS= \
+    TPU_LOOPSAN=1 \
     TPU_LOCKDEP=1 TPU_CACHE_MUTATION_DETECTOR=1 python - "$SEED" <<'EOF'
 import json, sys
+from kubernetes_tpu.analysis import loopsan
 from kubernetes_tpu.analysis.invariants import CORE_INVARIANTS
 from kubernetes_tpu.chaos.harness import run_chaos_schedules
 
@@ -49,6 +57,7 @@ try:
     seed = int(sys.argv[1])
 except ValueError:
     seed = int.from_bytes(sys.argv[1].encode(), "big") % (2 ** 31)
+loopsan.maybe_arm()
 rep = run_chaos_schedules(seed, schedules=8, timeout=12.0)
 print(json.dumps({k: v for k, v in rep.items() if k != "schedules"}))
 if rep["distinct_fingerprints"] < 8:
@@ -59,6 +68,19 @@ if rep["distinct_fingerprints"] < 8:
 idle = [n for n in CORE_INVARIANTS if not rep["invariant_checks"].get(n)]
 if idle:
     sys.exit(f"tpusan: invariants never exercised: {idle}")
+snap = loopsan.snapshot(top=5)
+print(json.dumps({"loopsan": {
+    "total_busy_s": snap["total_busy_s"],
+    "attributed_share": snap["attributed_share"],
+    "top_seams": [(r["seam"], r["share"]) for r in snap["seams"]]}}))
+viol = loopsan.violations()
+if viol:
+    for v in viol[:5]:
+        print(f"loopsan violation: {v['seam']} {v['ms']}ms", file=sys.stderr)
+        for line in v["stack"]:
+            print(f"    {line}", file=sys.stderr)
+    sys.exit(f"loopsan: {len(viol)} loop callback(s) exceeded "
+             f"{snap['threshold_ms']:.0f}ms on a deterministic scenario")
 EOF
 
 echo "=== 3/6 tpusan: queue smoke x2 schedules ==="

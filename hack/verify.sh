@@ -16,11 +16,19 @@
 #                        (interprocedural cache-mutation)
 #   status-write         status update with no ConflictError guard and
 #                        not reachable from a controller sync()
+#   hot-path-cost        per-object costly op (deepcopy, json round
+#                        trip, sync file I/O) reachable from a curated
+#                        per-pod hot root (interprocedural)
+#   held-lock-await      sync lock held across an await inside async
+#                        def (the static face of lockdep's
+#                        held-across-await rule)
 #
 # Suppress a single deliberate line with `# tpuvet: ignore[check-name]`.
 # Runtime complements (env-gated): TPU_CACHE_MUTATION_DETECTOR=1,
-# TPU_LOCKDEP=1, and TPU_SAN=<seed> (tpusan interleaving explorer +
-# cluster-invariant sanitizer) — see hack/race.sh for the dynamic gate.
+# TPU_LOCKDEP=1, TPU_SAN=<seed> (tpusan interleaving explorer +
+# cluster-invariant sanitizer), and TPU_LOOPSAN=1 (kloopsan event-loop
+# occupancy sanitizer, hot-path-cost's dynamic half) — see
+# hack/race.sh for the dynamic gate.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 

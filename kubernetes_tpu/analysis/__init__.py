@@ -29,7 +29,8 @@ the apiserver/scheduler startup path.
 _STATIC = ("Finding", "Module", "Pass", "REGISTRY", "register",
            "run_source", "run_tree")
 
-__all__ = list(_STATIC) + ["interleave", "invariants", "passes", "tpuvet"]
+__all__ = list(_STATIC) + ["interleave", "invariants", "loopsan",
+                           "passes", "tpuvet"]
 
 
 def __getattr__(name):

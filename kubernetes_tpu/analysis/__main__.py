@@ -1,11 +1,16 @@
-"""CLI: ``python -m kubernetes_tpu.analysis [--check name]... [path]...``
+"""CLI: ``python -m kubernetes_tpu.analysis [--json] [--check name]... [path]...``
 
 Exit status 0 when the tree is clean, 1 when any finding survives
-suppression — the contract ``hack/verify.sh`` builds on.
+suppression — the contract ``hack/verify.sh`` builds on. ``--json``
+emits one machine-readable document (``{"findings": [...], "count"}``
+with file/line/col/pass/message records) so CI and tooling consume
+findings without parsing the human table; the exit-code contract is
+identical.
 """
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 
@@ -21,6 +26,9 @@ def main(argv=None) -> int:
                     help="run only this pass (repeatable); default: all")
     ap.add_argument("--list", action="store_true",
                     help="list registered passes and exit")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output: one JSON document with "
+                    "file/line/col/pass/message records")
     args = ap.parse_args(argv)
 
     if args.list:
@@ -38,6 +46,15 @@ def main(argv=None) -> int:
     paths = args.paths or [os.path.dirname(os.path.dirname(
         os.path.abspath(__file__)))]
     findings = run_tree(*paths, checks=args.checks)
+    if args.as_json:
+        print(json.dumps({
+            "findings": [
+                {"file": f.path, "line": f.line, "col": f.col,
+                 "pass": f.check, "message": f.message}
+                for f in findings],
+            "count": len(findings),
+        }, indent=1))
+        return 1 if findings else 0
     for f in findings:
         print(f)
     if findings:

@@ -1,0 +1,458 @@
+"""kloopsan — event-loop occupancy sanitizer (``TPU_LOOPSAN=1``).
+
+The dynamic half of the loop-occupancy discipline (the static half is
+the ``hot-path-cost`` tpuvet pass): armed, every asyncio callback the
+loop runs is timed at the ``Handle._run`` choke point — the same place
+asyncio's own debug-mode ``slow_callback_duration`` hooks — and its
+CPU time is charged to a named **seam**: owning component + coroutine
+qualname. Think of it as a deterministic, always-on
+``slow_callback_duration`` with attribution instead of a log line.
+
+Attribution, per callback:
+
+- A ``Task.__step`` callback is introspected through its coroutine
+  await chain (``cr_await``/``gi_yieldfrom``) — the FIRST repo frame
+  names the owning component, the DEEPEST repo frame names the stage
+  the step resumed in (so an apiserver request parked inside aiohttp
+  still charges to ``apiserver:_batch_create``, and a scheduler step
+  parked in ``pop_batch`` charges to the queue stage, not just "the
+  scheduler").
+- A plain function callback charges to its ``__code__`` location
+  (``functools.partial`` unwrapped).
+- The curated :data:`SEAM_MAP` overrides the derived name for the
+  seams the occupancy table is read by: the scheduler loop, apiserver
+  handlers, the MVCC write path, informer ``_notify``, the admission
+  pass, and the watch fan-out.
+- Code outside the repo (aiohttp's HTTP parse/write machinery gets its
+  own ``apiserver.http`` seam) falls into the ``other:*`` bucket —
+  the *unattributed* share the density gate bounds.
+
+Synchronous hot regions that never appear at a resume point (the
+admission pass, the MVCC write, informer ``_notify`` fan-out) carve
+their time out of the enclosing callback through :func:`seam` — a
+nested-span stack per thread, so a batchCreate handler's charge
+decomposes into handler self-time + admission + mvcc.
+
+Callbacks whose TOTAL time exceeds the threshold
+(``TPU_LOOPSAN_SLOW_MS``, default 100ms) are recorded as violations
+with a source-located stack — ``hack/race.sh`` arms this and asserts
+zero.
+
+Seam names derive purely from code objects (file path + qualname), so
+they are deterministic across runs and under ``TPU_SAN`` explored
+schedules.
+
+Disarmed (the default): :func:`maybe_arm` is a no-op, ``Handle._run``
+stays the untouched stdlib attribute (tests assert identity), and
+:func:`seam` returns a shared no-op context manager — one dict-free
+function call per site.
+"""
+from __future__ import annotations
+
+import asyncio
+import functools
+import os
+import threading
+import time
+from typing import Iterable, Optional
+
+from ..metrics.registry import Counter, Gauge
+
+ENV_VAR = "TPU_LOOPSAN"
+THRESHOLD_ENV = "TPU_LOOPSAN_SLOW_MS"
+DEFAULT_SLOW_MS = 100.0
+
+#: Violation list is bounded: a pathological run must not balloon the
+#: sanitizer's own memory (the count keeps climbing in the metric).
+MAX_VIOLATIONS = 200
+
+LOOPSAN_BUSY = Gauge(
+    "loopsan_seam_busy_seconds",
+    "CPU seconds the event loop spent in each attributed seam "
+    "(published at snapshot time, armed only)", labels=("seam",))
+LOOPSAN_CALLS = Gauge(
+    "loopsan_seam_calls",
+    "loop callbacks / nested spans charged to each seam",
+    labels=("seam",))
+LOOPSAN_VIOLATIONS = Counter(
+    "loopsan_violations_total",
+    "loop callbacks whose total time exceeded TPU_LOOPSAN_SLOW_MS",
+    labels=("seam",))
+
+#: Repo package root ( .../kubernetes_tpu ) — frames under it are
+#: attributable; everything else is other:* or a curated foreign seam.
+_PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: Curated seam map: (path suffix, qualname prefix or "", seam name).
+#: First match wins, scanned over every repo frame in the await chain
+#: deepest-first — so the fine-grained stage seams (queue, mvcc) beat
+#: the generic component fallback. Keep this list short and READABLE:
+#: it is the vocabulary of the occupancy table.
+SEAM_MAP: tuple[tuple[str, str, str], ...] = (
+    ("scheduler/queue.py", "", "scheduler.queue"),
+    ("scheduler/scheduler.py", "Scheduler._run", "scheduler.loop"),
+    ("storage/mvcc.py", "", "mvcc.write"),
+    ("client/informer.py", "SharedInformer._notify", "informer.notify"),
+    ("client/informer.py", "", "informer"),
+    ("apiserver/admission.py", "", "admission.pass"),
+    ("apiserver/fanout.py", "", "apiserver.fanout"),
+)
+
+#: Dispatch shims skipped when picking the deepest repo frame — they
+#: wrap every request and would otherwise name every handler the same.
+_SHIM_QUALNAMES = ("_middleware", "_run_handler")
+
+#: Foreign (non-repo) code granted a named seam instead of other:*.
+_FOREIGN_SEAMS: tuple[tuple[str, str], ...] = (
+    (os.sep + "aiohttp" + os.sep, "apiserver.http"),
+)
+
+_perf = time.perf_counter
+
+# ---------------------------------------------------------------------------
+# per-thread accumulation
+# ---------------------------------------------------------------------------
+
+
+class _Frame:
+    __slots__ = ("seam", "start", "child")
+
+    def __init__(self, seam: str, start: float):
+        self.seam = seam
+        self.start = start
+        self.child = 0.0
+
+
+class _ThreadState(threading.local):
+    def __init__(self):
+        self.stack: list[_Frame] = []
+        #: seam -> [calls, busy_s, max_s]
+        self.stats: dict[str, list] = {}
+        with _states_lock:
+            _states.append(self.stats)
+
+
+_states: list[dict] = []      # every thread's stats dict, for merging
+_states_lock = threading.Lock()
+_tls = _ThreadState()
+
+_armed = False
+_orig_handle_run = None
+_threshold_s = DEFAULT_SLOW_MS / 1000.0
+_violations: list[dict] = []
+_violations_lock = threading.Lock()
+
+#: code object -> (is_repo, relpath, component, curated seam or None)
+_code_cache: dict = {}
+
+
+def _charge(stats: dict, seam: str, elapsed: float) -> None:
+    s = stats.get(seam)
+    if s is None:
+        stats[seam] = [1, elapsed, elapsed]
+        return
+    s[0] += 1
+    s[1] += elapsed
+    if elapsed > s[2]:
+        s[2] = elapsed
+
+
+# ---------------------------------------------------------------------------
+# attribution
+# ---------------------------------------------------------------------------
+
+
+def _code_info(code, qualname: str):
+    """(is_repo, relpath, component, curated seam) for one code object,
+    cached — the curated scan runs once per distinct code object ever
+    seen, not per callback."""
+    hit = _code_cache.get(code)
+    if hit is not None:
+        return hit
+    fn = code.co_filename
+    if fn.startswith(_PKG_ROOT):
+        rel = fn[len(_PKG_ROOT) + 1:].replace(os.sep, "/")
+        component = rel.split("/", 1)[0]
+        curated = None
+        for suffix, qprefix, seam_name in SEAM_MAP:
+            if rel.endswith(suffix) and (not qprefix
+                                         or qualname.startswith(qprefix)):
+                curated = seam_name
+                break
+        info = (True, rel, component, curated)
+    else:
+        foreign = None
+        for marker, seam_name in _FOREIGN_SEAMS:
+            if marker in fn:
+                foreign = seam_name
+                break
+        info = (False, fn, foreign or "", None)
+    _code_cache[code] = info
+    return info
+
+
+def _await_chain(coro) -> Iterable[tuple]:
+    """(code, qualname, frame) down the suspended await chain; bounded
+    depth so a pathological chain cannot stall the wrapper."""
+    for _ in range(64):
+        if coro is None:
+            return
+        code = getattr(coro, "cr_code", None)
+        frame = None
+        if code is not None:
+            frame = coro.cr_frame
+            nxt = coro.cr_await
+        else:
+            code = getattr(coro, "gi_code", None)
+            if code is not None:
+                frame = coro.gi_frame
+                nxt = coro.gi_yieldfrom
+            else:
+                code = getattr(coro, "ag_code", None)
+                if code is None:
+                    return  # a Future or foreign awaitable: chain ends
+                frame = getattr(coro, "ag_frame", None)
+                nxt = getattr(coro, "ag_await", None)
+        yield code, getattr(coro, "__qualname__", code.co_name), frame
+        coro = nxt
+
+
+def _attribute(callback) -> tuple[str, list]:
+    """(seam, stack) for one Handle callback. ``stack`` is the repo
+    portion of the await chain as ``file:line qualname`` strings —
+    stored only on violations, but computed inline (it is just the
+    frames already walked)."""
+    cb = callback
+    while isinstance(cb, functools.partial):
+        cb = cb.func
+    owner = getattr(cb, "__self__", None)
+    get_coro = getattr(owner, "get_coro", None)
+    chain: list[tuple] = []
+    if get_coro is not None:          # a Task.__step: walk the coroutine
+        try:
+            chain = list(_await_chain(get_coro()))
+        except Exception:  # noqa: BLE001 — attribution must never raise
+            chain = []
+    elif getattr(cb, "__code__", None) is not None:
+        chain = [(cb.__code__, getattr(cb, "__qualname__",
+                                       cb.__code__.co_name), None)]
+    if not chain:
+        return f"other:{getattr(cb, '__qualname__', repr(cb))}", []
+
+    stack: list[str] = []
+    curated = None
+    first_component = ""
+    deepest_repo = None
+    foreign = ""
+    for code, qualname, frame in chain:
+        is_repo, rel, component, cur = _code_info(code, qualname)
+        if is_repo:
+            line = frame.f_lineno if frame is not None else code.co_firstlineno
+            stack.append(f"{rel}:{line} {qualname}")
+            if not first_component:
+                first_component = component
+            if qualname.rpartition(".")[2] not in _SHIM_QUALNAMES:
+                deepest_repo = (component, qualname)
+            if cur is not None:
+                curated = cur  # deepest curated match wins
+        elif component and not foreign:
+            foreign = component  # a _FOREIGN_SEAMS name, e.g. apiserver.http
+    if curated is not None:
+        return curated, stack
+    if deepest_repo is not None:
+        return f"{deepest_repo[0]}:{deepest_repo[1]}", stack
+    if foreign:
+        return foreign, stack
+    root_q = chain[0][1]
+    return f"other:{root_q}", stack
+
+
+# ---------------------------------------------------------------------------
+# the Handle._run wrapper (installed only when armed)
+# ---------------------------------------------------------------------------
+
+
+def _instrumented_run(self):
+    seam, vstack = _attribute(self._callback)
+    tls = _tls
+    frame = _Frame(seam, _perf())
+    tls.stack.append(frame)
+    try:
+        return _orig_handle_run(self)
+    finally:
+        tls.stack.pop()
+        elapsed = _perf() - frame.start
+        _charge(tls.stats, seam, elapsed - frame.child)
+        if tls.stack:
+            tls.stack[-1].child += elapsed
+        if elapsed > _threshold_s:
+            LOOPSAN_VIOLATIONS.inc(seam=seam)
+            with _violations_lock:
+                if len(_violations) < MAX_VIOLATIONS:
+                    _violations.append({
+                        "seam": seam, "ms": round(elapsed * 1000.0, 3),
+                        "stack": vstack})
+
+
+class _NullSeam:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SEAM = _NullSeam()
+
+
+class _SeamSpan:
+    """Nested synchronous span: charges its self-time to ``name`` and
+    folds its total into the parent frame's child time. Inert when the
+    thread is not inside an instrumented loop callback — off-loop work
+    (a durable store's to_thread write) is not loop occupancy."""
+
+    __slots__ = ("name", "_frame")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._frame = None
+
+    def __enter__(self):
+        if _tls.stack:
+            self._frame = _Frame(self.name, _perf())
+            _tls.stack.append(self._frame)
+        return self
+
+    def __exit__(self, *exc):
+        frame = self._frame
+        if frame is not None:
+            tls = _tls
+            tls.stack.pop()
+            elapsed = _perf() - frame.start
+            _charge(tls.stats, frame.seam, elapsed - frame.child)
+            if tls.stack:
+                tls.stack[-1].child += elapsed
+        return False
+
+
+def seam(name: str):
+    """Carve a named synchronous region out of the enclosing loop
+    callback's charge (admission pass, MVCC write, informer notify).
+    Disarmed this is one shared no-op context manager — no allocation,
+    no timing."""
+    if not _armed:
+        return _NULL_SEAM
+    return _SeamSpan(name)
+
+
+# ---------------------------------------------------------------------------
+# arming / reporting
+# ---------------------------------------------------------------------------
+
+
+def loopsan_requested() -> bool:
+    return os.environ.get(ENV_VAR, "").lower() in ("1", "true", "yes", "on")
+
+
+def enabled() -> bool:
+    return _armed
+
+
+def arm(threshold_ms: Optional[float] = None) -> None:
+    """Patch ``asyncio.events.Handle._run``. Idempotent. Explicit entry
+    for tests; production paths go through :func:`maybe_arm`."""
+    global _armed, _orig_handle_run, _threshold_s
+    if threshold_ms is None:
+        threshold_ms = float(os.environ.get(THRESHOLD_ENV, DEFAULT_SLOW_MS))
+    _threshold_s = threshold_ms / 1000.0
+    if _armed:
+        return
+    _orig_handle_run = asyncio.events.Handle._run
+    asyncio.events.Handle._run = _instrumented_run
+    _armed = True
+
+
+def disarm() -> None:
+    """Restore the stdlib ``Handle._run`` (test isolation)."""
+    global _armed
+    if not _armed:
+        return
+    asyncio.events.Handle._run = _orig_handle_run
+    _armed = False
+
+
+def maybe_arm() -> bool:
+    """Arm iff ``TPU_LOOPSAN`` is set — called from the apiserver and
+    scheduler startup paths; a one-env-check no-op disarmed."""
+    if loopsan_requested():
+        arm()
+        return True
+    return _armed
+
+
+def reset() -> None:
+    """Zero all accumulated stats and violations (run isolation)."""
+    with _states_lock:
+        for stats in _states:
+            stats.clear()
+    with _violations_lock:
+        _violations.clear()
+
+
+def snapshot(top: int = 0) -> dict:
+    """Merge every thread's per-seam stats into the ranked occupancy
+    report: total busy, attributed share, per-seam rows, violations.
+    ``top`` > 0 truncates the seam table (the full charge still counts
+    toward the totals)."""
+    merged: dict[str, list] = {}
+    with _states_lock:
+        snap = [dict(s) for s in _states]
+    for stats in snap:
+        for seam_name, (calls, busy, mx) in stats.items():
+            m = merged.get(seam_name)
+            if m is None:
+                merged[seam_name] = [calls, busy, mx]
+            else:
+                m[0] += calls
+                m[1] += busy
+                if mx > m[2]:
+                    m[2] = mx
+    total = sum(v[1] for v in merged.values())
+    unattributed = sum(v[1] for k, v in merged.items()
+                       if k.startswith("other:"))
+    rows = [{"seam": k, "calls": v[0],
+             "busy_s": round(v[1], 6), "max_ms": round(v[2] * 1000.0, 3),
+             "share": round(v[1] / total, 4) if total else 0.0}
+            for k, v in sorted(merged.items(),
+                               key=lambda kv: -kv[1][1])]
+    if top:
+        rows = rows[:top]
+    with _violations_lock:
+        viol = list(_violations)
+    return {
+        "armed": _armed,
+        "threshold_ms": _threshold_s * 1000.0,
+        "total_busy_s": round(total, 6),
+        "attributed_share": round((total - unattributed) / total, 4)
+        if total else 1.0,
+        "seams": rows,
+        "violations": viol,
+    }
+
+
+def publish_metrics() -> dict:
+    """Export the merged per-seam stats as ``loopsan_*`` gauges (the
+    /debug/v1/loopprof handler and the perf harnesses call this so the
+    metrics page and the JSON report agree) and return the snapshot."""
+    snap = snapshot()
+    for row in snap["seams"]:
+        LOOPSAN_BUSY.set(row["busy_s"], seam=row["seam"])
+        LOOPSAN_CALLS.set(float(row["calls"]), seam=row["seam"])
+    return snap
+
+
+def violations() -> list[dict]:
+    with _violations_lock:
+        return list(_violations)

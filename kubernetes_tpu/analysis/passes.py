@@ -892,3 +892,296 @@ class StatusWritePass(Pass):
                         f"status write in {fname}() has no conflict "
                         f"guard — wrap in try/except ConflictError (or "
                         f"StatusError) with a retry")
+
+
+# ---------------------------------------------------------------------------
+# hot-path-cost (interprocedural)
+# ---------------------------------------------------------------------------
+
+#: Per-object control-plane hot paths: (path suffix, function name).
+#: Anything costly reachable from these via the self-call-graph runs
+#: once per pod/write/event at density scale — exactly the CPU the
+#: loopsan occupancy table attributes at saturation (ROADMAP item 1).
+_HOT_ROOTS = (
+    ("scheduler/scheduler.py", "_schedule_one"),
+    ("scheduler/scheduler.py", "_schedule_gang_inner"),
+    ("scheduler/queue.py", "add_pod_sync"),
+    ("scheduler/queue.py", "pop_batch"),
+    ("apiserver/registry.py", "create"),
+    ("apiserver/registry.py", "update"),
+    ("apiserver/registry.py", "delete"),
+    ("apiserver/registry.py", "create_batch"),
+    ("apiserver/admission.py", "admit"),
+    ("storage/mvcc.py", "_create"),
+    ("storage/mvcc.py", "_update"),
+    ("storage/mvcc.py", "_delete"),
+    ("client/informer.py", "_notify_inner"),
+    ("apiserver/fanout.py", "_run"),
+)
+
+#: module.attr calls that are per-call expensive on the loop.
+_COSTLY_ATTR = {
+    ("copy", "deepcopy"): "copy.deepcopy",
+    ("json", "dumps"): "json.dumps",
+    ("json", "loads"): "json.loads",
+    ("pickle", "dumps"): "pickle.dumps",
+    ("pickle", "loads"): "pickle.loads",
+    ("time", "sleep"): "time.sleep",
+    ("os", "fsync"): "os.fsync",
+    ("compactcodec", "encode_wire"): "compactcodec.encode_wire",
+    ("compactcodec", "encode_obj"): "compactcodec.encode_obj",
+}
+
+#: bare-name calls that are per-call expensive (sync file I/O, copy).
+_COSTLY_NAME = {"deepcopy": "deepcopy", "open": "open"}
+
+
+def _costly_op(call: ast.Call) -> str:
+    f = call.func
+    if (isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name)):
+        return _COSTLY_ATTR.get((f.value.id, f.attr), "")
+    if isinstance(f, ast.Name):
+        return _COSTLY_NAME.get(f.id, "")
+    return ""
+
+
+class _HotPathBody(ast.NodeVisitor):
+    """Costly-op sites and outgoing call names for one function body.
+    Nested defs/lambdas are skipped: the repo idiom hands expensive
+    thunks to ``to_thread``/``run_in_executor``, which is off-loop."""
+
+    def __init__(self) -> None:
+        self.costly: list[tuple[int, int, str]] = []
+        self.calls: set[str] = set()
+
+    def visit_Call(self, node: ast.Call) -> None:
+        op = _costly_op(node)
+        if op:
+            self.costly.append((node.lineno, node.col_offset, op))
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            self.calls.add(f.attr)
+        elif isinstance(f, ast.Name):
+            self.calls.add(f.id)
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node):
+        return
+
+    def visit_AsyncFunctionDef(self, node):
+        return
+
+    def visit_Lambda(self, node):
+        return
+
+
+@register
+class HotPathCostPass(Pass):
+    name = "hot-path-cost"
+    description = ("deepcopy / json round-trip / full codec encode / "
+                   "sleep / sync file-I/O reachable from a curated "
+                   "per-object hot-path root (create, MVCC write, "
+                   "admission, informer notify, scheduler loop, watch "
+                   "fan-out): per-pod CPU on the event loop — batch "
+                   "it, cache it, or move it off-loop")
+
+    def check_module(self, ctx: Context, mod: Module) -> Iterable[Finding]:
+        scratch = ctx.scratch(self.name)
+        summaries = scratch.setdefault("summaries", {})
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            v = _HotPathBody()
+            for stmt in node.body:
+                v.visit(stmt)
+            summaries.setdefault(node.name, []).append({
+                "path": mod.path, "costly": v.costly, "calls": v.calls})
+        return ()
+
+    def finalize(self, ctx: Context) -> Iterable[Finding]:
+        scratch = ctx.scratch(self.name)
+        summaries = scratch.get("summaries", {})
+        #: (path, fn-name) -> summary, reached via resolvable edges.
+        reached: dict = {}
+        frontier: list[tuple[str, dict]] = []
+        for suffix, root in _HOT_ROOTS:
+            for s in summaries.get(root, []):
+                if s["path"].endswith(suffix) \
+                        and (s["path"], root) not in reached:
+                    reached[(s["path"], root)] = f"{suffix}:{root}"
+                    frontier.append((f"{suffix}:{root}", s))
+        while frontier:
+            via, s = frontier.pop()
+            for callee in s["calls"]:
+                for c in self._resolve(summaries, s["path"], callee):
+                    key = (c["path"], callee)
+                    if key not in reached:
+                        reached[key] = via
+                        frontier.append((via, c))
+        emitted = set()
+        for (path, fname), via in sorted(reached.items()):
+            for s in summaries.get(fname, []):
+                if s["path"] != path:
+                    continue
+                for line, col, op in s["costly"]:
+                    if (path, line, col) in emitted:
+                        continue
+                    emitted.add((path, line, col))
+                    yield Finding(
+                        path, line, col, self.name,
+                        f"{op}() in {fname}() is reachable from "
+                        f"hot-path root {via}: per-object cost on the "
+                        f"event loop — batch per chunk, cache the "
+                        f"result, or move it off-loop (to_thread)")
+
+    @staticmethod
+    def _resolve(summaries, caller_path: str, callee: str) -> list:
+        """Plausible definitions of ``callee``: same-module wins;
+        cross-module only when the name is unique tree-wide (the
+        informer-mutation resolution rule — ambiguous names like
+        ``update`` are skipped rather than guessed)."""
+        cands = summaries.get(callee, [])
+        local = [s for s in cands if s["path"] == caller_path]
+        return local if local else (cands if len(cands) == 1 else [])
+
+
+# ---------------------------------------------------------------------------
+# held-lock-await
+# ---------------------------------------------------------------------------
+
+_LOCK_NAME_RE = re.compile(r"(^|_)(lock|mu|mutex|rlock)\d*$", re.IGNORECASE)
+
+#: Constructors whose result is a sync (thread) lock.
+_LOCK_CTORS = {"Lock", "RLock", "DepLock", "make_lock", "allocate_lock"}
+
+
+def _lock_ctor_call(value) -> bool:
+    if not isinstance(value, ast.Call):
+        return False
+    f = value.func
+    name = f.attr if isinstance(f, ast.Attribute) else (
+        f.id if isinstance(f, ast.Name) else "")
+    return name in _LOCK_CTORS
+
+
+def _lock_like(expr, lock_vars: set[str]) -> str:
+    """Lock-ish receiver name for a sync ``with`` item, or ''."""
+    if isinstance(expr, ast.Name):
+        if expr.id in lock_vars or _LOCK_NAME_RE.search(expr.id):
+            return expr.id
+    elif isinstance(expr, ast.Attribute):
+        if _LOCK_NAME_RE.search(expr.attr):
+            return expr.attr
+    elif _lock_ctor_call(expr):
+        return ast.unparse(expr.func)  # e.g. ``with make_lock():``
+    return ""
+
+
+def _first_await(stmts: list[ast.stmt]):
+    """First suspension point lexically inside ``stmts``, skipping
+    nested function scopes (their awaits run on their own frames)."""
+    todo = list(stmts)
+    while todo:
+        node = todo.pop(0)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        if isinstance(node, (ast.Await, ast.AsyncFor, ast.AsyncWith)):
+            return node
+        todo.extend(ast.iter_child_nodes(node))
+    return None
+
+
+class _HeldLockVisitor(ast.NodeVisitor):
+    """Sync locks held across a suspension point in one async body."""
+
+    def __init__(self) -> None:
+        self.lock_vars: set[str] = set()
+        self.hits: list[tuple[int, int, str]] = []
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if _lock_ctor_call(node.value):
+            for target in node.targets:
+                if isinstance(target, ast.Name):
+                    self.lock_vars.add(target.id)
+        self.generic_visit(node)
+
+    def visit_With(self, node: ast.With) -> None:
+        for item in node.items:
+            name = _lock_like(item.context_expr, self.lock_vars)
+            if name and _first_await(node.body) is not None:
+                self.hits.append((node.lineno, node.col_offset, name))
+                break
+        for stmt in node.body:
+            self.visit(stmt)
+
+    def visit_FunctionDef(self, node):
+        return
+
+    def visit_AsyncFunctionDef(self, node):
+        return
+
+    def visit_Lambda(self, node):
+        return
+
+
+def _acquire_release_scan(body: list[ast.stmt],
+                          hits: list[tuple[int, int, str]]) -> None:
+    """Linear same-block scan: ``x.acquire()`` … await … before
+    ``x.release()`` (the explicit-call form ``with`` can't see)."""
+    held: dict[str, int] = {}
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in ("acquire", "release"):
+                recv = node.func.value
+                name = recv.id if isinstance(recv, ast.Name) else (
+                    recv.attr if isinstance(recv, ast.Attribute) else "")
+                if not name:
+                    continue
+                if node.func.attr == "acquire":
+                    held[name] = node.lineno
+                else:
+                    held.pop(name, None)
+        if held and _first_await([stmt]) is not None:
+            for name in list(held):
+                hits.append((stmt.lineno, stmt.col_offset, name))
+                del held[name]  # one finding per lock per block
+    # Recurse into nested statement blocks (try/if/for bodies).
+    for stmt in body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for attr in ("body", "orelse", "finalbody"):
+            sub = getattr(stmt, attr, None)
+            if sub:
+                _acquire_release_scan(sub, hits)
+        for h in getattr(stmt, "handlers", []):
+            _acquire_release_scan(h.body, hits)
+
+
+@register
+class HeldLockAwaitPass(Pass):
+    name = "held-lock-await"
+    description = ("sync (thread) lock held across an await: the loop "
+                   "interleaves arbitrary callbacks at the suspension "
+                   "point while the lock is held — the static twin of "
+                   "lockdep's held-across-await probe (TPU_LOCKDEP)")
+
+    def check_module(self, ctx: Context, mod: Module) -> Iterable[Finding]:
+        if mod.path.endswith("util/lockdep.py"):
+            return ()  # defines the probe; its fixtures hold on purpose
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.AsyncFunctionDef):
+                continue
+            v = _HeldLockVisitor()
+            for stmt in node.body:
+                v.visit(stmt)
+            _acquire_release_scan(node.body, v.hits)
+            for line, col, name in v.hits:
+                yield Finding(
+                    mod.path, line, col, self.name,
+                    f"sync lock {name!r} held across await in "
+                    f"{node.name}() — release before suspending, or "
+                    f"use asyncio.Lock (lockdep would flag this at "
+                    f"runtime as held-across-await)")

@@ -12,6 +12,7 @@ from __future__ import annotations
 import uuid
 from typing import TYPE_CHECKING, Optional
 
+from ..analysis import loopsan
 from ..api import errors, types as t
 from ..api.meta import TypedObject
 
@@ -42,13 +43,14 @@ class AdmissionChain:
         """``dry_run=True`` skips plugins whose validate phase has
         durable side effects (``charges_state`` — the quota charge):
         a dry-run pass must never double-charge against the real one."""
-        for p in self.plugins:
-            obj = p.admit(op, spec, obj, old)
-        for p in self.plugins:
-            if dry_run and getattr(p, "charges_state", False):
-                continue
-            p.validate(op, spec, obj, old)
-        return obj
+        with loopsan.seam("admission.pass"):
+            for p in self.plugins:
+                obj = p.admit(op, spec, obj, old)
+            for p in self.plugins:
+                if dry_run and getattr(p, "charges_state", False):
+                    continue
+                p.validate(op, spec, obj, old)
+            return obj
 
 
 class TpuResourceDefaulter(AdmissionPlugin):

@@ -777,6 +777,10 @@ class APIServer:
         r.add_get("/debug/v1/query", self._debug_query)
         r.add_get("/debug/v1/alerts", self._debug_alerts)
         r.add_get("/debug/v1/storage", self._debug_storage)
+        # loopsan occupancy table (armed via TPU_LOOPSAN=1; disarmed
+        # returns an empty, armed=false snapshot) — the per-seam
+        # attribution behind the coarse loop_busy gauges.
+        r.add_get("/debug/v1/loopprof", self._debug_loopprof)
         r.add_get("/apis", self._discovery)
         # kubeadm-join analog: exchange a bootstrap token for a durable
         # node credential (bootstrap.py; the CSR-signing step's end
@@ -1135,6 +1139,17 @@ class APIServer:
             "dropped": tracing.COLLECTOR.dropped,
             "buffered": len(tracing.COLLECTOR),
         })
+
+    async def _debug_loopprof(self, request):
+        """``GET /debug/v1/loopprof?top=`` — ranked event-loop
+        occupancy by seam from the TPU_LOOPSAN sanitizer, plus any
+        over-threshold callback violations with stacks."""
+        from ..analysis import loopsan
+        top = self._int_param(request.query.get("top", "0") or "0", "top")
+        snap = loopsan.publish_metrics()
+        if top and top > 0:
+            snap["seams"] = snap["seams"][:top]
+        return web.json_response(snap)
 
     def _pipeline_or_404(self):
         """The co-located kmon pipeline, or NotFound — the route does
@@ -2310,7 +2325,11 @@ class APIServer:
         ``certs.server_ssl_context`` makes this an HTTPS-only endpoint
         with x509 client-cert authn (plaintext connections are refused
         by TLS itself — the reference's secure port)."""
+        from ..analysis import loopsan
         from ..util.features import GATES
+        # Arm the loop-occupancy sanitizer before any callback of ours
+        # runs (TPU_LOOPSAN=1; no-op and byte-identical otherwise).
+        loopsan.maybe_arm()
         if self.shards is None and GATES.enabled("ApiServerSharding"):
             from .sharding import ShardPool
             self.shards = ShardPool()

@@ -21,6 +21,7 @@ import random
 from typing import Any, Callable, Optional
 
 from .. import tracing
+from ..analysis import loopsan
 from ..api import errors
 from ..metrics.registry import Counter, Gauge
 from .interface import Client
@@ -324,6 +325,10 @@ class SharedInformer:
             self._notify(MODIFIED, obj, obj)
 
     def _notify(self, etype: str, old: Any, new: Any) -> None:
+        with loopsan.seam("informer.notify"):
+            self._notify_inner(etype, old, new)
+
+    def _notify_inner(self, etype: str, old: Any, new: Any) -> None:
         # ktrace re-attach: the delivered object's durable traceparent
         # annotation becomes the current context around its handlers,
         # so whatever they do (queue adds, status writes, container

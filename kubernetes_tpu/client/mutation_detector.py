@@ -51,7 +51,9 @@ class CacheMutationDetector:
         # codec, which elides default-valued fields — a mutation writing
         # a default-shaped value would slip through a to_dict digest).
         if isinstance(obj, (dict, list, tuple, set)):
-            payload = json.dumps(obj, sort_keys=True, default=repr)
+            # Armed-only debug path (TPU_CACHE_MUTATION_DETECTOR):
+            # never on in production; the digest IS the detector.
+            payload = json.dumps(obj, sort_keys=True, default=repr)  # tpuvet: ignore[hot-path-cost]
         else:
             payload = repr(obj)
         return hashlib.sha1(payload.encode()).hexdigest()

@@ -202,9 +202,17 @@ async def _run_density_rest(n_nodes: int, n_pods: int, timeout: float,
         # apiserver_request_latencies_summary the same way,
         # metrics_util.go:136).
         import aiohttp
+        from ..analysis import loopsan as _loopsan
+        loopprof = {}
         async with aiohttp.ClientSession() as s:
             async with s.get(client.base_url + "/metrics") as r:
                 metrics_text = await r.text()
+            if _loopsan.loopsan_requested():
+                # The apiserver SUBPROCESS armed loopsan from the same
+                # inherited env — its table only exists over there.
+                async with s.get(client.base_url
+                                 + "/debug/v1/loopprof?top=10") as r:
+                    loopprof = await r.json()
         api_latency = _parse_raw_quantiles(metrics_text)
         if not api_latency:
             # Pre-raw-gauge server: bucket-edge quantiles, marked so
@@ -240,9 +248,32 @@ async def _run_density_rest(n_nodes: int, n_pods: int, timeout: float,
         out["feature_gates"] = feature_gates
     if loop_busy:
         out["apiserver_loop_busy"] = loop_busy
+    if loopprof.get("armed"):
+        out["loopsan_apiserver"] = {
+            "total_busy_s": loopprof.get("total_busy_s"),
+            "attributed_share": loopprof.get("attributed_share"),
+            "violations": len(loopprof.get("violations", [])),
+            "top_seams": loopprof.get("seams", []),
+        }
     out.update(_bind_call_percentiles())
     out.update(load)  # pods, wall, pods/s, external schedule latencies
     return out
+
+
+def _loopsan_stanza(key: str = "loopsan", top: int = 10) -> dict:
+    """This process's loopsan occupancy table (ranked seams + the
+    unattributed residual), for the BENCH_* files to track attribution
+    across perf PRs. Empty when TPU_LOOPSAN is not armed."""
+    from ..analysis import loopsan
+    if not loopsan.enabled():
+        return {}
+    snap = loopsan.publish_metrics()
+    return {key: {
+        "total_busy_s": snap["total_busy_s"],
+        "attributed_share": snap["attributed_share"],
+        "violations": len(snap["violations"]),
+        "top_seams": snap["seams"][:top],
+    }}
 
 
 def _scheduler_loop_stats() -> dict:
@@ -377,6 +408,11 @@ async def run_density(n_nodes: int = 100, n_pods: int = 3000,
                 n_nodes, n_pods, timeout, via, max_pods_per_node,
                 paced_pods, paced_rate)
         out.update(_scheduler_loop_stats())
+        # loopsan's per-seam attribution beside the coarse loop_busy
+        # gauges (TPU_LOOPSAN=1): in the REST arm this process runs the
+        # scheduler; the apiserver's table was scraped over HTTP above.
+        out.update(_loopsan_stanza(
+            "loopsan_scheduler" if via == "rest" else "loopsan"))
         if prev_rate is not None:
             out.update(_trace_breakdown())
         return out

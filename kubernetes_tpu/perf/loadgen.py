@@ -179,6 +179,32 @@ async def _scrape_loop_lag(session: aiohttp.ClientSession,
     return parse_labeled_family(text, "apiserver_loop_lag_ms_sum", "loop")
 
 
+async def _scrape_loopprof(session: aiohttp.ClientSession,
+                           server: str) -> dict:
+    """The apiserver's loopsan occupancy table (/debug/v1/loopprof),
+    reported beside the loop-busy shares so BENCH_* files can track
+    WHICH seam owns the busy fraction. {} unless TPU_LOOPSAN is armed
+    (the subprocess inherited the same env) or on any scrape failure."""
+    from ..analysis import loopsan
+    if not loopsan.loopsan_requested():
+        return {}
+    try:
+        async with session.get(f"{server}/debug/v1/loopprof?top=10") as resp:
+            if resp.status != 200:
+                return {}
+            prof = await resp.json()
+    except Exception:  # noqa: BLE001 — attribution is best-effort here
+        return {}
+    if not prof.get("armed"):
+        return {}
+    return {"loopsan_apiserver": {
+        "total_busy_s": prof.get("total_busy_s"),
+        "attributed_share": prof.get("attributed_share"),
+        "violations": len(prof.get("violations", [])),
+        "top_seams": prof.get("seams", []),
+    }}
+
+
 def _loop_busy_share(before: dict, after: dict, wall: float) -> dict:
     """Per-loop busy share over one phase: seconds the loop ran BEHIND
     schedule per second of wall time (loop-lag derived; >0.5 means the
@@ -314,6 +340,7 @@ async def run_load(server: str, n_pods: int, concurrency: int = 64,
                 lag_sat, lag_paced, time.perf_counter() - paced_t0)
             if busy_paced:
                 out["apiserver_loop_busy_paced"] = busy_paced
+        out.update(await _scrape_loopprof(watcher._session, server))
     finally:
         poke.cancel()
         await watcher.stop()

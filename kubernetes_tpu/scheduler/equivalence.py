@@ -35,7 +35,9 @@ def equivalence_hash(pod: t.Pod) -> Optional[int]:
                 for x in pod.spec.tolerations],
         "aff": to_dict(pod.spec.affinity) if pod.spec.affinity else None,
     }
-    return hash(json.dumps(payload, sort_keys=True, default=str))
+    # The dumps IS the cache key: one serialization here saves a
+    # full-fleet predicate pass on every equivalence-class hit.
+    return hash(json.dumps(payload, sort_keys=True, default=str))  # tpuvet: ignore[hot-path-cost]
 
 
 class EquivalenceCache:

@@ -247,6 +247,10 @@ class Scheduler:
         # tail at density scale.
         from ..util.gctune import tune_control_plane_gc
         tune_control_plane_gc()
+        # Arm the loop-occupancy sanitizer (TPU_LOOPSAN=1; inert
+        # otherwise) — idempotent when the apiserver armed it first.
+        from ..analysis import loopsan
+        loopsan.maybe_arm()
         from ..util.features import GATES
         if GATES.enabled("SchedulerFastPath"):
             # Wired before the informers so every cache mutation from
@@ -599,7 +603,10 @@ class Scheduler:
         cache discipline is verified by the armed mutation detector).
         Gate off: the codec deepcopy, byte-identical behavior."""
         if self._fleet is None:
-            return deepcopy(pod)
+            # Gate-off fallback only: SchedulerFastPath arms the
+            # structural copy below; this branch keeps the legacy
+            # arm byte-identical.
+            return deepcopy(pod)  # tpuvet: ignore[hot-path-cost]
         from dataclasses import replace
         spec = replace(pod.spec, tpu_resources=[
             replace(c, assigned=list(c.assigned))
@@ -1456,10 +1463,13 @@ class Scheduler:
         # served its purpose (assume debits the real chips now).
         self.cache.release_reservation(unit.group_key)
 
-        # assume all
+        # assume all — via the structural fast copy (_assume_copy
+        # clones exactly the shell/spec/claims the loop below mutates;
+        # the full deepcopy was per-member allocation churn at gang
+        # scale, the same cost _schedule_one already shed)
         assumed_pods = []
         for pod, node_name, bindings in plan.placements:
-            assumed = deepcopy(pod)
+            assumed = self._assume_copy(pod)
             for claim in assumed.spec.tpu_resources:
                 for b in bindings:
                     if b.name == claim.name:

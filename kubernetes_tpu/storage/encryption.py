@@ -169,9 +169,17 @@ class Transformer:
     def for_write(self, value: dict) -> dict:
         if not self.providers:
             return value
-        env = self.providers[0].encrypt(
-            json.dumps(value, separators=(",", ":")).encode())
-        if env is None:  # identity first = encryption off
+        first = self.providers[0]
+        if isinstance(first, IdentityProvider):
+            # identity first = encryption off: skip the per-write
+            # serialization entirely, don't pay json.dumps only for
+            # encrypt() to answer None (hot-path-cost finding).
+            return value
+        # Reached only with a real (non-identity) provider first:
+        # encryption on means serialize-then-encrypt IS the write.
+        env = first.encrypt(
+            json.dumps(value, separators=(",", ":")).encode())  # tpuvet: ignore[hot-path-cost]
+        if env is None:
             return value
         return {ENVELOPE_FIELD: env}
 

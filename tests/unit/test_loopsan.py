@@ -1,0 +1,233 @@
+"""kloopsan suite: attribution correctness on a scripted loop, seam
+carve-out accounting, threshold violation capture, the disarmed
+zero-overhead contract (no Handle wrapping, shared no-op seam), and
+seam-name determinism under TPU_SAN explored schedules."""
+import asyncio
+import os
+import textwrap
+import time
+
+import pytest
+
+from kubernetes_tpu.analysis import interleave, loopsan
+
+#: Captured at import time, before any test arms: the pristine stdlib
+#: attribute the disarmed contract promises to leave untouched.
+_PRISTINE_RUN = asyncio.events.Handle._run
+
+
+@pytest.fixture(autouse=True)
+def _loopsan_isolation():
+    yield
+    loopsan.disarm()
+    loopsan.reset()
+
+
+def _repo_coro(path_suffix, name):
+    """Compile an async spinner whose code object carries a repo-path
+    filename — the attribution walk sees exactly what it would see for
+    real subsystem code, but the scenario stays fully scripted."""
+    src = textwrap.dedent(f"""
+        import asyncio
+        async def {name}(n):
+            for _ in range(n):
+                await asyncio.sleep(0)
+            return n
+    """)
+    path = os.path.join(loopsan._PKG_ROOT, *path_suffix.split("/"))
+    ns = {}
+    exec(compile(src, path, "exec"), ns)
+    return ns[name]
+
+
+def _burn(ms):
+    end = time.perf_counter() + ms / 1000.0
+    while time.perf_counter() < end:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# disarmed contract
+# ---------------------------------------------------------------------------
+
+def test_disarmed_no_handle_wrapping():
+    """Disarmed is byte-identical asyncio: Handle._run is the pristine
+    stdlib function, seam() is one shared no-op, and running a loop
+    accumulates nothing."""
+    assert not loopsan.enabled()
+    assert asyncio.events.Handle._run is _PRISTINE_RUN
+    assert loopsan.seam("anything") is loopsan._NULL_SEAM
+    assert loopsan.seam("anything") is loopsan.seam("else")
+
+    loopsan.reset()
+    spin = _repo_coro("scheduler/queue.py", "disarmed_spin")
+    asyncio.run(spin(10))
+    snap = loopsan.snapshot()
+    assert snap["armed"] is False
+    assert snap["total_busy_s"] == 0.0
+    assert snap["seams"] == [] and snap["violations"] == []
+
+
+def test_maybe_arm_respects_env(monkeypatch):
+    monkeypatch.delenv(loopsan.ENV_VAR, raising=False)
+    assert loopsan.maybe_arm() is False
+    assert asyncio.events.Handle._run is _PRISTINE_RUN
+    monkeypatch.setenv(loopsan.ENV_VAR, "1")
+    assert loopsan.maybe_arm() is True
+    assert loopsan.enabled()
+
+
+def test_arm_disarm_restores_identity():
+    loopsan.arm(threshold_ms=500)
+    assert asyncio.events.Handle._run is loopsan._instrumented_run
+    loopsan.arm(threshold_ms=500)  # idempotent: no double wrap
+    assert loopsan._orig_handle_run is _PRISTINE_RUN
+    loopsan.disarm()
+    assert asyncio.events.Handle._run is _PRISTINE_RUN
+
+
+# ---------------------------------------------------------------------------
+# attribution on a scripted loop
+# ---------------------------------------------------------------------------
+
+def test_attribution_curated_seams():
+    """Task resume steps charge to the curated seam of the deepest repo
+    frame in the await chain — a scheduler/queue.py spinner lands on
+    scheduler.queue, a storage/mvcc.py spinner on mvcc.write."""
+    spin_q = _repo_coro("scheduler/queue.py", "queue_spin")
+    spin_m = _repo_coro("storage/mvcc.py", "mvcc_spin")
+
+    async def driver():
+        return await asyncio.gather(spin_q(50), spin_m(30))
+
+    loopsan.arm(threshold_ms=10_000)
+    loopsan.reset()
+    assert asyncio.run(driver()) == [50, 30]
+
+    snap = loopsan.snapshot()
+    assert snap["armed"] is True
+    rows = {r["seam"]: r for r in snap["seams"]}
+    # one step per sleep(0) plus the initial step
+    assert rows["scheduler.queue"]["calls"] >= 50
+    assert rows["mvcc.write"]["calls"] >= 30
+    assert snap["total_busy_s"] > 0
+    # shares are normalized over the merged total
+    assert abs(sum(r["share"] for r in snap["seams"]) - 1.0) < 0.01
+    assert snap["violations"] == []
+
+
+def test_attribution_plain_callback_and_derived_seam():
+    """A plain call_soon function charges to its qualname (other:* for
+    non-repo code — the unattributed bucket); a repo coroutine WITHOUT
+    a curated entry derives component:qualname."""
+    spin = _repo_coro("controllers/strange.py", "derived_spin")
+
+    def plain():
+        _burn(1)
+
+    async def driver():
+        asyncio.get_running_loop().call_soon(plain)
+        await spin(5)
+
+    loopsan.arm(threshold_ms=10_000)
+    loopsan.reset()
+    asyncio.run(driver())
+
+    names = {r["seam"] for r in loopsan.snapshot()["seams"]}
+    assert "controllers:derived_spin" in names
+    assert any(n.startswith("other:") and "plain" in n for n in names)
+
+
+def test_seam_carveout_decomposes_parent_charge():
+    """A seam() span inside an instrumented callback charges its
+    self-time to its own name and folds out of the parent — the parent
+    seam's busy excludes the child's."""
+    def handler():
+        _burn(5)
+        with loopsan.seam("admission.pass"):
+            _burn(20)
+
+    async def driver():
+        asyncio.get_running_loop().call_soon(handler)
+        await asyncio.sleep(0.01)
+
+    loopsan.arm(threshold_ms=10_000)
+    loopsan.reset()
+    asyncio.run(driver())
+
+    rows = {r["seam"]: r for r in loopsan.snapshot()["seams"]}
+    carved = rows["admission.pass"]
+    assert carved["calls"] == 1
+    assert carved["busy_s"] >= 0.015
+    parent = next(r for n, r in rows.items()
+                  if n.startswith("other:") and "handler" in n)
+    # parent keeps only its self-time: well under the carved span
+    assert parent["busy_s"] < carved["busy_s"]
+
+
+def test_seam_inert_off_loop_when_armed():
+    """Off-loop work (a to_thread durable write) is not loop occupancy:
+    a seam span outside any instrumented callback charges nothing."""
+    loopsan.arm(threshold_ms=10_000)
+    loopsan.reset()
+    with loopsan.seam("mvcc.write"):
+        _burn(2)
+    assert loopsan.snapshot()["seams"] == []
+
+
+# ---------------------------------------------------------------------------
+# violations
+# ---------------------------------------------------------------------------
+
+def test_threshold_violation_capture():
+    """A callback over TPU_LOOPSAN_SLOW_MS is recorded with its seam,
+    duration, and repo stack; fast callbacks are not."""
+    slow_spin = _repo_coro("storage/mvcc.py", "slow_spin")
+
+    async def driver():
+        await slow_spin(1)
+        _burn(25)          # burns inside the driver's own resume step
+
+    loopsan.arm(threshold_ms=10)
+    loopsan.reset()
+    asyncio.run(driver())
+
+    viol = loopsan.violations()
+    assert viol, "25ms callback above a 10ms threshold must be captured"
+    assert all(v["ms"] >= 10 for v in viol)
+    assert all(set(v) == {"seam", "ms", "stack"} for v in viol)
+    assert loopsan.snapshot()["violations"] == viol
+    # the bound: a pathological run cannot balloon the list
+    assert len(viol) <= loopsan.MAX_VIOLATIONS
+
+    loopsan.reset()
+    assert loopsan.violations() == []
+
+
+# ---------------------------------------------------------------------------
+# determinism under TPU_SAN explored schedules
+# ---------------------------------------------------------------------------
+
+def test_seam_names_deterministic_under_tpusan():
+    """Seam names derive purely from code objects, so every explored
+    schedule — whatever wakeup order the interleaver picks — yields the
+    same curated seam set."""
+    spin_q = _repo_coro("scheduler/queue.py", "san_queue_spin")
+    spin_m = _repo_coro("storage/mvcc.py", "san_mvcc_spin")
+
+    def scenario():
+        async def body():
+            interleave.touch("loopsan-det")
+            await asyncio.gather(spin_q(8), spin_m(8), spin_q(4))
+        return body()
+
+    loopsan.arm(threshold_ms=10_000)
+    seam_sets = []
+    for seed in (0, 1, 7, "loopsan"):
+        loopsan.reset()
+        interleave.run(scenario(), seed)
+        names = {r["seam"] for r in loopsan.snapshot()["seams"]}
+        seam_sets.append(frozenset(
+            n for n in names if not n.startswith("other:")))
+    assert len(set(seam_sets)) == 1
+    assert {"scheduler.queue", "mvcc.write"} <= seam_sets[0]

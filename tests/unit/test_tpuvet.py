@@ -471,7 +471,8 @@ except Exception:  # tpuvet: ignore[swallowed-exception]
 def test_registry_has_all_passes():
     assert {"swallowed-exception", "async-blocking", "feature-gate",
             "metric-name", "cache-mutation", "task-leak",
-            "informer-mutation", "status-write"} <= set(REGISTRY)
+            "informer-mutation", "status-write", "hot-path-cost",
+            "held-lock-await"} <= set(REGISTRY)
 
 
 # ---------------------------------------------------------------------------
@@ -708,3 +709,185 @@ M = Gauge("storage_compact_revision", "re-registered: silently inert")
 """
     got = run_source(bad, checks=["metric-name"])
     assert len(got) == 1 and "already registered" in got[0].message
+
+
+# ---------------------------------------------------------------------------
+# hot-path-cost
+# ---------------------------------------------------------------------------
+
+def test_hot_path_cost_bad():
+    """Costly ops reachable from a curated hot root — directly and one
+    call away through the self-call-graph — are flagged at the op."""
+    bad = """
+import json, copy
+
+def _create(self, key, value):
+    value = self._freeze(value)
+    return self._commit(key, value)
+
+def _freeze(value):
+    return json.loads(json.dumps(value))
+
+def _commit(self, key, value):
+    return copy.deepcopy(value)
+"""
+    got = run_source(bad, path="kubernetes_tpu/storage/mvcc.py",
+                     checks=["hot-path-cost"])
+    assert names(got) == ["hot-path-cost"] * 3
+    assert all("_create" in f.message or "mvcc" in f.message for f in got)
+
+
+def test_hot_path_cost_good():
+    """The same ops in a function NOT reachable from any root, or in a
+    file outside the curated root set, are not findings."""
+    cold = """
+import json, copy
+
+def export_debug_dump(value):
+    return json.dumps(value)
+
+def clone_for_tests(value):
+    return copy.deepcopy(value)
+"""
+    assert run_source(cold, path="kubernetes_tpu/storage/mvcc.py",
+                      checks=["hot-path-cost"]) == []
+    # Identical source with a hot root name, but in a non-root file.
+    other = """
+import json
+def _create(self, key, value):
+    return json.dumps(value)
+"""
+    assert run_source(other, path="kubernetes_tpu/util/other.py",
+                      checks=["hot-path-cost"]) == []
+
+
+def test_hot_path_cost_suppression():
+    src = """
+import json
+def admit(self, obj):
+    return json.dumps(obj)  # tpuvet: ignore[hot-path-cost]
+"""
+    assert run_source(src, path="kubernetes_tpu/apiserver/admission.py",
+                      checks=["hot-path-cost"]) == []
+
+
+def test_hot_path_cost_ambiguous_callee_skipped():
+    """A cross-module callee whose name is NOT unique tree-wide is
+    skipped, not guessed (the informer-mutation resolution rule).
+    Within one module both definitions are same-path candidates."""
+    src = """
+import json
+def _notify_inner(self, etype, old, new):
+    self.helper(new)
+def helper(self, obj):
+    return json.dumps(obj)
+"""
+    got = run_source(src, path="kubernetes_tpu/client/informer.py",
+                     checks=["hot-path-cost"])
+    assert names(got) == ["hot-path-cost"]  # same-module resolution wins
+
+
+# ---------------------------------------------------------------------------
+# held-lock-await
+# ---------------------------------------------------------------------------
+
+def test_held_lock_await_bad():
+    bad = """
+import asyncio, threading
+
+async def with_local_lock():
+    lk = threading.Lock()
+    with lk:
+        await asyncio.sleep(0.1)
+
+async def with_attr_lock(self):
+    with self._lock:
+        await self.client.update(self.obj)
+
+async def explicit_acquire(self):
+    self._mu.acquire()
+    await asyncio.sleep(0)
+    self._mu.release()
+"""
+    got = run_source(bad, checks=["held-lock-await"])
+    assert names(got) == ["held-lock-await"] * 3
+
+
+def test_held_lock_await_good():
+    good = """
+import asyncio
+
+async def release_before_await(self):
+    with self._lock:
+        snapshot = dict(self._data)   # no await under the lock
+    await self.publish(snapshot)
+
+async def async_lock_is_fine(self):
+    async with self._alock:
+        await asyncio.sleep(0)
+
+async def balanced_explicit(self):
+    self._mu.acquire()
+    self._count += 1
+    self._mu.release()
+    await asyncio.sleep(0)
+
+def sync_with_is_out_of_scope(self):
+    with self._lock:
+        self._count += 1
+"""
+    assert run_source(good, checks=["held-lock-await"]) == []
+
+
+def test_held_lock_await_nested_def_not_counted():
+    """An await inside a nested function runs on its own frame — the
+    enclosing `with lock:` does not hold across it."""
+    src = """
+import asyncio
+async def f(self):
+    with self._lock:
+        async def helper():
+            await asyncio.sleep(0)
+        self._pending = helper
+"""
+    assert run_source(src, checks=["held-lock-await"]) == []
+
+
+def test_metric_name_loopsan_family():
+    """The loopsan metric family is valid, and a duplicate
+    registration within the family is still caught."""
+    good = """
+from kubernetes_tpu.metrics.registry import Counter, Gauge
+A = Gauge("loopsan_seam_busy_seconds", "x", labels=("seam",))
+B = Gauge("loopsan_seam_calls", "x", labels=("seam",))
+C = Counter("loopsan_violations_total", "x", labels=("seam",))
+"""
+    assert run_source(good, checks=["metric-name"]) == []
+    bad = good + """
+D = Gauge("loopsan_seam_calls", "re-registered: silently inert")
+"""
+    got = run_source(bad, checks=["metric-name"])
+    assert len(got) == 1 and "already registered" in got[0].message
+
+
+def test_cli_json_output(tmp_path, capsys):
+    """--json: one machine-readable document with file/line/pass
+    records; identical exit-code contract to the human table."""
+    import json as _json
+
+    from kubernetes_tpu.analysis.__main__ import main
+    bad = tmp_path / "bad.py"
+    bad.write_text("try:\n    x()\nexcept Exception:\n    pass\n")
+    good = tmp_path / "good.py"
+    good.write_text("x = 1\n")
+
+    assert main(["--json", str(good)]) == 0
+    doc = _json.loads(capsys.readouterr().out)
+    assert doc == {"findings": [], "count": 0}
+
+    assert main(["--json", str(bad)]) == 1
+    doc = _json.loads(capsys.readouterr().out)
+    assert doc["count"] == len(doc["findings"]) == 1
+    rec = doc["findings"][0]
+    assert rec["file"] == str(bad) and rec["pass"] == "swallowed-exception"
+    assert rec["line"] == 3 and "message" in rec
