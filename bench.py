@@ -76,10 +76,21 @@ def main() -> None:
                             # LOSS (857 vs 1107 pods/s same-day) —
                             # its coalescing needs fan-out width
                             # (hollow-node fleets, ROADMAP 6a).
+                            # BatchWriteTxn: each batchCreate /
+                            # bindings:batch chunk commits as ONE MVCC
+                            # txn (one lock pass, one WAL record, one
+                            # watch round, batched admission).
+                            # Throughput parity-to-slight-win on this
+                            # 1-core in-memory arm (the store was
+                            # never its bottleneck); the measured wins
+                            # are durable-arm WAL amortization (61.5x
+                            # fewer records/create at chunk=64,
+                            # endurance_smoke gate) and chunk p99.
                             feature_gates="ApiServerSharding=true,"
                                           "ApiServerCodecOffload=true,"
                                           "SchedulerFastPath=true,"
-                                          "CompactWireCodec=true"))
+                                          "CompactWireCodec=true,"
+                                          "BatchWriteTxn=true"))
         except Exception as exc:  # noqa: BLE001
             sched["rest_30k"] = {"error": str(exc)[:200]}
         # Decode share per codec (perf/decode_share.py): the same REST

@@ -29,11 +29,13 @@ EOF
 
 # Throughput floor on the SCALE-OUT path, plus the compact-WRITE arm:
 # the 200n/2k REST arm runs twice — sharding+codec-pool gates only,
-# then with SchedulerFastPath + CompactWireCodec stacked on top (the
-# codec gate since the write-path PR negotiates the create/
-# batchCreate/bind request bodies and batch responses too — the
-# loadgen's saturation phase submits pre-encoded compact template
-# batches). WatchFanoutBatch stays OUT of the asserted arm: on a
+# then with SchedulerFastPath + CompactWireCodec + BatchWriteTxn
+# stacked on top (the codec gate since the write-path PR negotiates
+# the create/batchCreate/bind request bodies and batch responses too —
+# the loadgen's saturation phase submits pre-encoded compact template
+# batches; the txn gate commits each chunk as one MVCC transaction,
+# so the smoke drives the batched admission + split-commit path end
+# to end over HTTP). WatchFanoutBatch stays OUT of the asserted arm: on a
 # 1-core host with 2-3 watchers its flush engine measured a loss (it
 # needs fan-out width); its wire behavior is integration-tested.
 # Both arms must bind everything and hold >= 400 pods/s (PR 9's
@@ -64,7 +66,7 @@ on = asyncio.run(run_density(
     n_nodes=200, n_pods=2000, via="rest", timeout=60.0,
     create_concurrency=16, paced_pods=0, trace_sample=0.05,
     feature_gates=BASE_GATES + ",SchedulerFastPath=true,"
-                  "CompactWireCodec=true"))
+                  "CompactWireCodec=true,BatchWriteTxn=true"))
 print(json.dumps(on))
 if on.get("bound", 0) < 2000:
     sys.exit(f"bench_smoke: only {on.get('bound')}/2000 pods bound "
@@ -98,7 +100,8 @@ out = asyncio.run(run_density(
     n_nodes=200, n_pods=2000, via="rest", timeout=60.0,
     create_concurrency=16, paced_pods=0,
     feature_gates="ApiServerSharding=true,ApiServerCodecOffload=true,"
-                  "SchedulerFastPath=true,CompactWireCodec=true"))
+                  "SchedulerFastPath=true,CompactWireCodec=true,"
+                  "BatchWriteTxn=true"))
 print(json.dumps({k: v for k, v in out.items()
                   if k.startswith("loopsan") or k == "pods_per_second"}))
 if out.get("bound", 0) < 2000:
@@ -115,5 +118,45 @@ for side in ("loopsan_apiserver", "loopsan_scheduler"):
                  f"(< 0.90) — the other:* bucket grew; name the seam")
 EOF
   echo "bench_smoke: loopsan arm ok"
+fi
+
+# Opt-in THREAD arm (BENCH_THREADS=1): the stacked-gates arm with the
+# apiserver's shard dispatch forced into REAL worker threads
+# (KTPU_SHARD_MODE=thread — inherited by the apiserver subprocess) on
+# top of the GIL-releasing codec pool. Only meaningful with spare
+# cores: on a 1-core host the thread mode just adds context switches,
+# so the stanza SAYS it skipped instead of silently passing. The JSON
+# carries the host fingerprint (cpu_count, effective cores,
+# shard_mode) so a published number is attributable to its host shape.
+if [ "${BENCH_THREADS:-}" = "1" ]; then
+  timeout -k 10 240 env JAX_PLATFORMS=cpu KTPU_SHARD_MODE=thread python - <<'EOF'
+import asyncio, json, os, sys
+from kubernetes_tpu.perf.density import host_fingerprint, run_density
+
+ncores = os.cpu_count() or 1
+if ncores < 2:
+    print(json.dumps({"host": host_fingerprint()}))
+    print("bench_smoke: BENCH_THREADS arm SKIPPED — 1-core host "
+          "(thread-mode shard dispatch needs spare cores; run on a "
+          "multi-core machine or pin more cores)")
+    sys.exit(0)
+out = asyncio.run(run_density(
+    n_nodes=200, n_pods=2000, via="rest", timeout=60.0,
+    create_concurrency=16, paced_pods=0,
+    feature_gates="ApiServerSharding=true,ApiServerCodecOffload=true,"
+                  "SchedulerFastPath=true,CompactWireCodec=true,"
+                  "BatchWriteTxn=true"))
+print(json.dumps({"host": out.get("host"),
+                  "pods_per_second": out.get("pods_per_second"),
+                  "bound": out.get("bound")}))
+if out.get("bound", 0) < 2000:
+    sys.exit(f"bench_smoke: only {out.get('bound')}/2000 pods bound "
+             f"in thread shard mode")
+host = out.get("host") or {}
+if host.get("shard_mode") != "thread":
+    sys.exit("bench_smoke: shard_mode missing from the host "
+             "fingerprint — KTPU_SHARD_MODE did not reach the harness")
+EOF
+  echo "bench_smoke: threads arm ok"
 fi
 echo "bench_smoke: ok"

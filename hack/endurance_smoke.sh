@@ -45,4 +45,20 @@ if out["informer_rev_lag"] > 100:
 if out["api_p99_first_ms"] > 0 and out["api_p99_drift"] > 0.5:
     sys.exit("endurance_smoke: api p99 climbed across the run")
 EOF
+
+# WAL amortization A/B (PR 18): the same chunked batchCreate traffic
+# twice — per-object WAL records (gate off) vs one BATCH record per
+# chunk (BatchWriteTxn) — read back through /debug/v1/storage's
+# wal_records_per_create. The batched arm must amortize >= 8x at
+# chunk=64 while holding RSS and api p99 drift flat: batch records
+# and the aging hygiene above must compose, not trade off.
+timeout -k 10 120 env JAX_PLATFORMS=cpu python - <<'EOF'
+import asyncio, json
+from kubernetes_tpu.perf.churn_bench import (check_wal_amortization,
+                                             run_wal_amortization)
+
+report = asyncio.run(run_wal_amortization(n_pods=1536, chunk=64))
+print(json.dumps(report))
+check_wal_amortization(report)
+EOF
 echo "endurance_smoke: ok"

@@ -73,6 +73,14 @@ print(json.dumps({"loopsan": {
     "total_busy_s": snap["total_busy_s"],
     "attributed_share": snap["attributed_share"],
     "top_seams": [(r["seam"], r["share"]) for r in snap["seams"]]}}))
+# Child-seam decomposition (PR 18): the queue stage must no longer be
+# one opaque scheduler.queue blob — the sync drain carves out its own
+# scheduler.queue.pop seam on any scenario that binds a pod.
+all_seams = {r["seam"] for r in loopsan.snapshot()["seams"]}
+if "scheduler.queue.pop" not in all_seams:
+    sys.exit("loopsan: scheduler.queue.pop child seam never charged — "
+             "the queue-stage decomposition regressed "
+             f"(seams: {sorted(all_seams)})")
 viol = loopsan.violations()
 if viol:
     for v in viol[:5]:

@@ -19,6 +19,22 @@ from ..api.meta import TypedObject
 if TYPE_CHECKING:
     from .registry import Registry, ResourceSpec
 
+#: Plurals the chain's plugins only ever READ while admitting a write
+#: (policy/config objects: namespaces, priority classes, quota-free
+#: lookups...). The registry memoizes GET/LIST results for exactly
+#: these — and nothing else — for the duration of one batch chunk's
+#: admission pass (``Registry.batch_admission_context``), so a
+#: 64-item chunk pays each lookup once instead of 64 times. The quota
+#: charge path (``resourcequotas``) is deliberately absent: its
+#: read-CAS-retry loop must see fresh state on every attempt. A write
+#: to any of these plurals (NamespaceLifecycle auto-creating a
+#: namespace mid-chunk) invalidates that plural's memo entries.
+BATCH_MEMO_PLURALS = frozenset({
+    "namespaces", "priorityclasses", "serviceaccounts", "limitranges",
+    "podsecuritypolicies", "storageclasses", "localqueues",
+    "clusterqueues",
+})
+
 
 class AdmissionPlugin:
     name = "plugin"

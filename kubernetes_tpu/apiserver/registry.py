@@ -32,7 +32,8 @@ from ..api.meta import ObjectMeta, TypedObject, now, stamp as meta_stamp, \
 from ..api.scheme import DEFAULT_SCHEME, Scheme, from_dict, to_dict
 from ..api.selectors import match_field_selector, parse_selector
 from ..metrics.registry import Counter, Gauge
-from ..storage.mvcc import ADDED, DELETED, MODIFIED, MVCCStore, Watch, WatchEvent
+from ..storage.mvcc import ADDED, DELETED, MODIFIED, MVCCStore, TxnError, \
+    Watch, WatchEvent
 
 #: Endurance telemetry: the compactor keeps these current each cycle
 #: (the same numbers /debug/v1/storage serves on demand).
@@ -47,6 +48,16 @@ STORAGE_WAL_BYTES = Gauge(
 STORAGE_HISTORY_LEN = Gauge(
     "storage_watch_history_entries",
     "watch-replay events retained in memory")
+
+BATCH_TXN_COMMITS = Counter(
+    "apiserver_batch_txn_commits_total",
+    "batch chunks committed as ONE MVCC transaction (BatchWriteTxn)",
+    labels=("kind",))
+BATCH_TXN_SPLITS = Counter(
+    "apiserver_batch_txn_splits_total",
+    "items split out of a batch transaction (per-item rejection; the "
+    "rest of the chunk still commits)",
+    labels=("kind",))
 
 
 @dataclass
@@ -299,6 +310,13 @@ class Registry:
         from .encodecache import EncodeCache
         self.encode_cache = EncodeCache()
         self.store.add_write_hook(self.encode_cache.invalidate)
+        #: Chunk-scoped admission read memo: None outside a batch
+        #: admission pass (the common case — one None check on the
+        #: read paths), a {(verb, plural, ...): result} dict inside
+        #: one (see batch_admission_context / admission.py's
+        #: BATCH_MEMO_PLURALS).
+        self._adm_memo: Optional[dict] = None
+        self.store.add_write_hook(self._adm_memo_invalidate)
         #: Optional storage.replication.ReplicaNode: when set, every
         #: mutation dispatched through :meth:`run` is acknowledged only
         #: once quorum-committed (see run()); None = unreplicated, the
@@ -433,6 +451,34 @@ class Registry:
     # -- CRUD -------------------------------------------------------------
 
     def create(self, obj: TypedObject, dry_run: bool = False) -> TypedObject:
+        spec, obj, key, create_span = self._prepare_create(obj, dry_run)
+        if dry_run:
+            return obj
+        # IP/CIDR allocation happens last — after admission/validation/
+        # dry_run. An already-existing object must surface AlreadyExists
+        # (ktl apply's create-then-update fallback depends on it), never
+        # a VIP-collision error against itself — so claims are skipped
+        # when the key exists, and rollback releases ONLY values this
+        # call allocated (releasing a duplicate explicit value would
+        # free a block the stored owner still holds).
+        rollback: list = []
+        if not self.store.exists(key):
+            rollback = self._claim_ips(obj)
+        try:
+            rev = self.store.create(key, self._encode(obj))
+        except Exception:
+            for release, value in rollback:
+                release(value)
+            raise
+        return self._finish_create(obj, rev, create_span)
+
+    def _prepare_create(self, obj: TypedObject, dry_run: bool = False
+                        ) -> tuple:
+        """Everything before the store write: defaulting, TypeMeta,
+        admission, validation, the create span, the storage key.
+        Returns ``(spec, obj, key, create_span)`` (``key`` is None for
+        dry runs). Shared verbatim by :meth:`create` and the batch txn
+        path so the batch amortizes the COMMIT, never policy."""
         spec = self.spec_for_kind(type(obj).__name__ if not obj.kind else obj.kind)
         obj = self.scheme.default(obj)
         # Stamp TypeMeta like update() does — clients must get fully
@@ -490,29 +536,17 @@ class Registry:
         if spec.validate_create:
             spec.validate_create(obj)
         if dry_run:
-            return obj
-        # IP/CIDR allocation happens last — after admission/validation/
-        # dry_run. An already-existing object must surface AlreadyExists
-        # (ktl apply's create-then-update fallback depends on it), never
-        # a VIP-collision error against itself — so claims are skipped
-        # when the key exists, and rollback releases ONLY values this
-        # call allocated (releasing a duplicate explicit value would
-        # free a block the stored owner still holds).
+            return spec, obj, None, None
         if isinstance(obj, ext.CustomResourceDefinition):
             self._check_crd_collision(obj)
         key = self._key(spec, meta.namespace, meta.name)
-        rollback: list = []
-        if not self.store.exists(key):
-            rollback = self._claim_ips(obj)
-        try:
-            rev = self.store.create(key, self._encode(obj))
-        except Exception:
-            for release, value in rollback:
-                release(value)
-            raise
+        return spec, obj, key, create_span
+
+    def _finish_create(self, obj: TypedObject, rev: int,
+                       create_span) -> TypedObject:
         if isinstance(obj, ext.CustomResourceDefinition):
             self._install_crd(obj)
-        meta.resource_version = str(rev)
+        obj.metadata.resource_version = str(rev)
         if create_span is not None:
             # Ends only on SUCCESS: a failed create's span is dropped
             # (never collected), matching "no object, no trace".
@@ -524,10 +558,17 @@ class Registry:
 
         Each item runs the FULL single-create pipeline (defaulting,
         admission, validation, allocator claims) — the batch only
-        amortizes transport/dispatch overhead, never policy. Returns
-        ``[(created, None) | (None, StatusError), ...]`` positionally;
+        amortizes transport/dispatch overhead, never policy. Under the
+        ``BatchWriteTxn`` gate the chunk commits as ONE store
+        transaction (:meth:`_create_batch_txn`) — one lock hold, one
+        WAL record, one watch round — with per-item rejections
+        split-committed around, so outcomes stay positional either
+        way. Returns ``[(created, None) | (None, StatusError), ...]``;
         partial failure is not an error for the batch (reference: the
         per-item Status list of bulk APIs)."""
+        from ..util.features import GATES
+        if GATES.enabled("BatchWriteTxn") and len(objs) > 1:
+            return self._create_batch_txn(objs)
         out = []
         for obj in objs:
             try:
@@ -535,6 +576,101 @@ class Registry:
             except errors.StatusError as e:
                 out.append((None, e))
         return out
+
+    def _create_batch_txn(self, objs: list) -> list:
+        """One chunk -> one :meth:`MVCCStore.txn`. Validation +
+        admission run first as one batched pass (read-only admission
+        lookups memoized chunk-wide via :meth:`batch_admission_context`
+        — the quota charge path is NOT memoized and still CASes per
+        item); items that fail policy or claims are rejected
+        per-item before the txn; a :class:`TxnError` mid-commit (e.g.
+        a duplicate key racing in from outside the batch) splits that
+        item out and retries the remainder, so one bad item never
+        aborts the chunk."""
+        results: list = [None] * len(objs)
+        prepared: list = []
+        with self.batch_admission_context():
+            for i, obj in enumerate(objs):
+                try:
+                    spec, pobj, key, span = self._prepare_create(obj)
+                    prepared.append((i, pobj, key, span))
+                except errors.StatusError as e:
+                    results[i] = (None, e)
+        pending: list = []
+        for i, pobj, key, span in prepared:
+            try:
+                claims = ([] if self.store.exists(key)
+                          else self._claim_ips(pobj))
+            except errors.StatusError as e:
+                results[i] = (None, e)
+                BATCH_TXN_SPLITS.inc(kind="create")
+                continue
+            pending.append((i, pobj, key, span, claims,
+                            self._encode(pobj)))
+        while pending:
+            ops = [(ADDED, p[2], p[5], None) for p in pending]
+            try:
+                revs = self.store.txn(ops)
+            except TxnError as e:
+                i, _pobj, _key, _span, claims, _val = pending.pop(e.index)
+                for release, value in claims:
+                    release(value)
+                results[i] = (None, e.error)
+                BATCH_TXN_SPLITS.inc(kind="create")
+                continue
+            except errors.StatusError as e:
+                # Store-level failure (follower guard, chaos WAL
+                # crash): nothing committed, every pending item fails.
+                for i, _pobj, _key, claims in (
+                        (p[0], p[1], p[2], p[4]) for p in pending):
+                    for release, value in claims:
+                        release(value)
+                    results[i] = (None, e)
+                break
+            for (i, pobj, key, span, _claims, val), rev in zip(pending,
+                                                               revs):
+                # No inline encode here (hot-path-cost): the response's
+                # emit_compact and the watch fan-out both read this
+                # (key, rev) next and fill the serialize-once cache
+                # through their off-loop/async-encode paths — the first
+                # reader pays ONE encode, everyone else hits.
+                results[i] = (self._finish_create(pobj, rev, span), None)
+            BATCH_TXN_COMMITS.inc(kind="create")
+            break
+        return results
+
+    def batch_admission_context(self):
+        """Context manager arming the chunk-scoped admission read memo
+        (see admission.py's ``BATCH_MEMO_PLURALS``). Reentrant-safe: a
+        nested entry keeps the outer memo. Only successful results are
+        memoized — NamespaceLifecycle's NotFound -> auto-create flow
+        must re-read, and its create invalidates the plural anyway."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def _ctx():
+            if self._adm_memo is not None:
+                yield
+                return
+            self._adm_memo = {}
+            try:
+                yield
+            finally:
+                self._adm_memo = None
+        return _ctx()
+
+    def _adm_memo_invalidate(self, key: str) -> None:
+        # Store write hook (under the store lock): free when no batch
+        # admission pass is active; inside one, a write to a memoized
+        # plural drops that plural's entries.
+        memo = self._adm_memo
+        if not memo:
+            return
+        parts = key.split("/", 3)
+        plural = parts[2] if len(parts) > 2 else ""
+        stale = [k for k in memo if k[1] == plural]
+        for k in stale:
+            del memo[k]
 
     def _ensure_svc_allocator(self) -> None:
         """Lazy-build the VIP allocator, occupancy rebuilt from stored
@@ -710,9 +846,21 @@ class Registry:
             self._node_cidrs.release(obj.spec.pod_cidr)
 
     def get(self, plural: str, namespace: str, name: str) -> TypedObject:
+        memo = self._adm_memo
+        mk = None
+        if memo is not None:
+            from .admission import BATCH_MEMO_PLURALS
+            if plural in BATCH_MEMO_PLURALS:
+                mk = ("get", plural, namespace, name)
+                hit = memo.get(mk)
+                if hit is not None:
+                    return hit
         spec = self.spec_for(plural)
         stored = self.store.get(self._key(spec, namespace, name), copy=False)
-        return self._decode(spec, stored.value, stored.mod_revision)
+        obj = self._decode(spec, stored.value, stored.mod_revision)
+        if mk is not None:
+            memo[mk] = obj
+        return obj
 
     # -- serialize-once reads (see encodecache.py) ------------------------
 
@@ -812,6 +960,16 @@ class Registry:
 
     def list(self, plural: str, namespace: str = "", label_selector: str = "",
              field_selector: str = "") -> tuple[list[TypedObject], int]:
+        memo = self._adm_memo
+        mk = None
+        if memo is not None:
+            from .admission import BATCH_MEMO_PLURALS
+            if plural in BATCH_MEMO_PLURALS:
+                mk = ("list", plural, namespace, label_selector,
+                      field_selector)
+                hit = memo.get(mk)
+                if hit is not None:
+                    return hit
         spec = self.spec_for(plural)
         stored, rev = self.store.list(self._prefix(spec, namespace), copy=False)
         sel = parse_selector(label_selector) if label_selector else None
@@ -832,6 +990,8 @@ class Registry:
                     field_selector, spec.field_extractor(obj)):
                 continue
             out.append(obj)
+        if mk is not None:
+            memo[mk] = (out, rev)
         return out, rev
 
     def list_page(self, plural: str, namespace: str = "",
@@ -1356,63 +1516,68 @@ class Registry:
         target = binding.target
 
         def apply(cur: Optional[dict]) -> dict:
-            # Dict-level on the stored value: a bind touches node_name,
-            # claim assignments, and one condition of a pod that is
-            # otherwise UNCHANGED — the full scheme decode + re-encode
-            # this replaces was a measured per-bind hot-path cost at
-            # density scale. ``cur`` is guaranteed_update's private
-            # copy, so in-place mutation is safe. Semantics mirror
-            # the typed path (update_pod_condition) exactly.
-            meta = cur.get("metadata") or {}
-            if meta.get("deletion_timestamp") is not None:
-                raise errors.ConflictError(f"pod {namespace}/{name} is terminating")
-            spec_d = cur.get("spec") or {}
-            bound_to = spec_d.get("node_name") or ""
-            if bound_to and bound_to != target.node_name:
-                raise errors.ConflictError(
-                    f"pod {namespace}/{name} already bound to {bound_to}")
-            spec_d["node_name"] = target.node_name
-            cur["spec"] = spec_d
-            by_name = {b.name: b for b in target.tpu_bindings}
-            claims = spec_d.get("tpu_resources") or []
-            for claim in claims:
-                b = by_name.pop(claim.get("name", ""), None)
-                if b is not None:
-                    claim["assigned"] = list(b.chip_ids)
-            if by_name:
-                raise errors.BadRequestError(
-                    f"binding names {sorted(by_name)} match no tpu_resources claim")
-            missing = [c.get("name", "") for c in claims
-                       if not c.get("assigned")]
-            if missing:
-                raise errors.BadRequestError(
-                    f"binding must assign chips for claims {missing}")
-            status_d = cur.get("status") or {}
-            conds = status_d.get("conditions") or []
-            existing = next((c for c in conds
-                             if c.get("type") == t.COND_POD_SCHEDULED), None)
-            if existing is None or existing.get("status") != "True" \
-                    or existing.get("reason") or existing.get("message"):
-                newc = to_dict(t.PodCondition(
-                    type=t.COND_POD_SCHEDULED, status="True",
-                    last_transition_time=now()))
-                if existing is not None:
-                    if existing.get("status") == "True":
-                        # Same truth value: transition time is preserved
-                        # (update_pod_condition semantics).
-                        newc["last_transition_time"] = \
-                            existing.get("last_transition_time")
-                    conds.remove(existing)
-                conds.append(newc)
-            status_d["conditions"] = conds
-            cur["status"] = status_d
-            meta.pop("resource_version", None)
-            return cur
+            return self._bind_value(namespace, name, target, cur)
 
         value, rev = self.store.guaranteed_update(key, apply)
         if not decode:
             return None
         return self._decode(spec, value, rev)
+
+    def _bind_value(self, namespace: str, name: str, target,
+                    cur: Optional[dict]) -> dict:
+        # Dict-level on the stored value: a bind touches node_name,
+        # claim assignments, and one condition of a pod that is
+        # otherwise UNCHANGED — the full scheme decode + re-encode
+        # this replaces was a measured per-bind hot-path cost at
+        # density scale. ``cur`` is the caller's private copy
+        # (guaranteed_update's, or the batch path's _freeze), so
+        # in-place mutation is safe. Semantics mirror the typed path
+        # (update_pod_condition) exactly.
+        meta = cur.get("metadata") or {}
+        if meta.get("deletion_timestamp") is not None:
+            raise errors.ConflictError(f"pod {namespace}/{name} is terminating")
+        spec_d = cur.get("spec") or {}
+        bound_to = spec_d.get("node_name") or ""
+        if bound_to and bound_to != target.node_name:
+            raise errors.ConflictError(
+                f"pod {namespace}/{name} already bound to {bound_to}")
+        spec_d["node_name"] = target.node_name
+        cur["spec"] = spec_d
+        by_name = {b.name: b for b in target.tpu_bindings}
+        claims = spec_d.get("tpu_resources") or []
+        for claim in claims:
+            b = by_name.pop(claim.get("name", ""), None)
+            if b is not None:
+                claim["assigned"] = list(b.chip_ids)
+        if by_name:
+            raise errors.BadRequestError(
+                f"binding names {sorted(by_name)} match no tpu_resources claim")
+        missing = [c.get("name", "") for c in claims
+                   if not c.get("assigned")]
+        if missing:
+            raise errors.BadRequestError(
+                f"binding must assign chips for claims {missing}")
+        status_d = cur.get("status") or {}
+        conds = status_d.get("conditions") or []
+        existing = next((c for c in conds
+                         if c.get("type") == t.COND_POD_SCHEDULED), None)
+        if existing is None or existing.get("status") != "True" \
+                or existing.get("reason") or existing.get("message"):
+            newc = to_dict(t.PodCondition(
+                type=t.COND_POD_SCHEDULED, status="True",
+                last_transition_time=now()))
+            if existing is not None:
+                if existing.get("status") == "True":
+                    # Same truth value: transition time is preserved
+                    # (update_pod_condition semantics).
+                    newc["last_transition_time"] = \
+                        existing.get("last_transition_time")
+                conds.remove(existing)
+            conds.append(newc)
+        status_d["conditions"] = conds
+        cur["status"] = status_d
+        meta.pop("resource_version", None)
+        return cur
 
     def bind_pods_batch(self, namespace: str,
                         items: list[tuple[str, t.Binding]]) -> list:
@@ -1424,7 +1589,13 @@ class Registry:
         ``[(None, None) | (None, StatusError), ...]`` positionally —
         success carries no pod echo (callers read results through
         informers), and one failed member never aborts the rest (the
-        gang path owns rollback policy, not the storage layer)."""
+        gang path owns rollback policy, not the storage layer). Under
+        ``BatchWriteTxn`` the chunk commits as one CAS-guarded store
+        transaction (:meth:`_bind_batch_txn`), same per-item
+        semantics."""
+        from ..util.features import GATES
+        if GATES.enabled("BatchWriteTxn") and len(items) > 1:
+            return self._bind_batch_txn(namespace, items)
         out = []
         for name, binding in items:
             try:
@@ -1433,6 +1604,72 @@ class Registry:
             except errors.StatusError as e:
                 out.append((None, e))
         return out
+
+    def _bind_batch_txn(self, namespace: str,
+                        items: list[tuple[str, t.Binding]]) -> list:
+        """One bind chunk -> one :meth:`MVCCStore.txn` of CAS-guarded
+        MODIFIED ops. The new values are computed OUTSIDE the store
+        lock from each pod's current revision; a concurrent writer
+        losing us the CAS aborts the (all-or-nothing) txn and the
+        whole remainder recomputes — the guaranteed_update retry loop,
+        amortized over the chunk. Per-item policy failures (already
+        bound elsewhere, terminating, bad claim names) drop just that
+        item, like the single-bind path."""
+        spec = self.spec_for("pods")
+        results: list = [None] * len(items)
+        pending = [(i, name, binding)
+                   for i, (name, binding) in enumerate(items)]
+        # Convergence is quick in practice (one recompute per losing
+        # race); the cap only guards a livelock under pathological
+        # write pressure, mirroring guaranteed_update's own bound.
+        for _attempt in range(100):
+            if not pending:
+                break
+            ops = []
+            in_txn = []
+            for i, name, binding in pending:
+                key = self._key(spec, namespace, name)
+                try:
+                    cur = self.store.get(key, copy=False)
+                    new = self._bind_value(
+                        namespace, name, binding.target,
+                        MVCCStore._freeze(cur.value))
+                except errors.StatusError as e:
+                    results[i] = (None, e)
+                    BATCH_TXN_SPLITS.inc(kind="bind")
+                    continue
+                ops.append((MODIFIED, key, new, cur.mod_revision))
+                in_txn.append((i, name, binding))
+            pending = in_txn
+            if not ops:
+                break
+            try:
+                self.store.txn(ops)
+            except TxnError as e:
+                if isinstance(e.error, errors.ConflictError):
+                    # CAS lost to a concurrent writer — recompute the
+                    # whole (aborted) chunk against fresh revisions.
+                    continue
+                i, _name, _binding = pending.pop(e.index)
+                results[i] = (None, e.error)
+                BATCH_TXN_SPLITS.inc(kind="bind")
+                continue
+            except errors.StatusError as e:
+                # Store-level failure (follower guard, chaos WAL
+                # crash): nothing committed, per-item outcome for all.
+                for i, _name, _binding in pending:
+                    results[i] = (None, e)
+                pending = []
+                break
+            for i, _name, _binding in pending:
+                results[i] = (None, None)
+            BATCH_TXN_COMMITS.inc(kind="bind")
+            pending = []
+        for i, name, _binding in pending:
+            results[i] = (None, errors.ConflictError(
+                f"pod {namespace}/{name}: batch bind kept losing the "
+                f"revision race; retry"))
+        return results
 
 
 class ObjectWatch:

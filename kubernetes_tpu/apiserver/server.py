@@ -24,6 +24,7 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import os
 import time
 from typing import Optional
 
@@ -1230,6 +1231,10 @@ class APIServer:
         policy = self.registry.compaction_policy
         rev = store.revision
         compact_rev = store.compact_rev
+        # Lifetime records/ops: 1.0 on the per-object write path,
+        # ~1/chunk under BatchWriteTxn — the amortization number the
+        # endurance gate asserts. null until the first durable write.
+        ops_total = store.wal_ops_total
         return web.json_response({
             "revision": rev,
             "compact_revision": compact_rev,
@@ -1237,6 +1242,11 @@ class APIServer:
             "durable": store.durable,
             "wal_bytes": store.wal_bytes,
             "wal_records": store.wal_records,
+            "wal_records_total": store.wal_records_total,
+            "wal_ops_total": ops_total,
+            "wal_records_per_create": (
+                None if not ops_total
+                else store.wal_records_total / ops_total),
             "snapshots": store.snapshots,
             "compactions": store.compactions,
             "history_entries": store.history_len,
@@ -2332,7 +2342,11 @@ class APIServer:
         loopsan.maybe_arm()
         if self.shards is None and GATES.enabled("ApiServerSharding"):
             from .sharding import ShardPool
-            self.shards = ShardPool()
+            # KTPU_SHARD_MODE overrides the auto probe (the
+            # BENCH_THREADS harness arm forces "thread" on multi-core
+            # hosts; "inline" forces the single-loop path).
+            self.shards = ShardPool(
+                mode=os.environ.get("KTPU_SHARD_MODE", "auto"))
             self.shards.on_worker = self._start_shard_probe
         if self.codec_pool is None \
                 and GATES.enabled("ApiServerCodecOffload"):

@@ -23,6 +23,7 @@ from typing import Any, Optional
 import aiohttp
 
 from .. import tracing
+from ..analysis import loopsan
 from ..api import errors
 from ..api.scheme import DEFAULT_SCHEME, to_dict
 from ..api.types import Binding
@@ -175,7 +176,11 @@ class _RESTWatch(WatchStream):
                 pass
             await self._queue.put((BOOKMARK, msg["object"]))
             return True
-        obj = decode_obj(msg["object"])
+        # loopsan child seam: the typed decode of every watch event is
+        # the informer-ingest cost the parent queue-stage share hid —
+        # named for its dominant consumer, the scheduler's pod informer.
+        with loopsan.seam("scheduler.queue.decode"):
+            obj = decode_obj(msg["object"])
         await self._queue.put((msg["type"], obj))
         return True
 

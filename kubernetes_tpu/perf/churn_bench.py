@@ -18,6 +18,7 @@ and WAL grow monotonically with write count.
 Run directly::
 
     python -m kubernetes_tpu.perf.churn_bench [duration_s] [on|off|both]
+    python -m kubernetes_tpu.perf.churn_bench wal [n_pods]   # WAL A/B gate
 """
 from __future__ import annotations
 
@@ -186,6 +187,119 @@ async def run_churn(duration_s: float = 60.0, compaction: bool = True,
         shutil.rmtree(data_dir, ignore_errors=True)
 
 
+async def _wal_arm(n_pods: int, chunk: int, batched: bool) -> dict:
+    """One WAL-amortization arm: ``n_pods`` creates submitted as
+    chunk-sized ``batchCreate`` requests over the real wire path into a
+    fresh durable store, then the ``/debug/v1/storage`` ledger read
+    back. Both arms send IDENTICAL traffic — only the ``BatchWriteTxn``
+    gate differs — so ``wal_records_per_create`` isolates the WAL
+    batching, not a workload change."""
+    data_dir = tempfile.mkdtemp(prefix="ktpu-walamort-")
+    snap = GATES.snapshot()
+    # wal_max_bytes=0 disables snapshot rotation: the lifetime
+    # records/ops counters then count exactly this arm's appends.
+    store = MVCCStore(os.path.join(data_dir, "state"), wal_max_bytes=0)
+    registry = Registry(store=store)
+    server = APIServer(registry)
+    client = None
+    lat: list[float] = []  # per-chunk round-trip seconds
+    rss: list[int] = []
+    try:
+        GATES.set("BatchWriteTxn", batched)
+        await server.start()
+        client = RESTClient(f"http://127.0.0.1:{server.port}")
+        client.backoff_base = 0.02
+        created = 0
+        for base in range(0, n_pods, chunk):
+            pods = [_churn_pod(f"amort-{i}")
+                    for i in range(base, min(base + chunk, n_pods))]
+            t_op = time.perf_counter()
+            results = await client.create_many(pods, decode=False)
+            lat.append(time.perf_counter() - t_op)
+            rss.append(rss_bytes())
+            created += sum(1 for r in results if r is None)
+        ledger = await client._request(
+            "GET", f"{client.base_url}/debug/v1/storage")
+        third = max(1, len(lat) // 3)
+        p99_first = pct(sorted(lat[:third]), 0.99) * 1e3
+        p99_last = pct(sorted(lat[-third:]), 0.99) * 1e3
+        return {
+            "batched": batched,
+            "pods": n_pods,
+            "chunk": chunk,
+            "created": created,
+            "wal_records_total": ledger["wal_records_total"],
+            "wal_ops_total": ledger["wal_ops_total"],
+            "wal_records_per_create": ledger["wal_records_per_create"],
+            "wal_bytes": ledger["wal_bytes"],
+            "rss_first_mb": round(rss[0] / 2**20, 1) if rss else 0.0,
+            "rss_last_mb": round(rss[-1] / 2**20, 1) if rss else 0.0,
+            "rss_drift": round(_drift(rss), 4),
+            "api_p99_first_ms": round(p99_first, 2),
+            "api_p99_last_ms": round(p99_last, 2),
+            "api_p99_drift": round((p99_last - p99_first) / p99_first, 4)
+            if p99_first > 0 else 0.0,
+        }
+    finally:
+        GATES.restore(snap)
+        if client is not None:
+            await client.close()
+        await server.stop()
+        store.close()
+        shutil.rmtree(data_dir, ignore_errors=True)
+
+
+async def run_wal_amortization(n_pods: int = 1536, chunk: int = 64) -> dict:
+    """WAL write-amplification A/B (ROADMAP item 1): the legacy arm
+    pays one framed WAL record per object (records/create == 1.0); the
+    ``BatchWriteTxn`` arm commits each chunk as one MVCC transaction
+    with ONE BATCH record, so records/create falls toward 1/chunk. The
+    legacy arm runs first so the batched arm — the one the drift gate
+    reads — executes on an allocator already warmed by identical
+    traffic."""
+    legacy = await _wal_arm(n_pods, chunk, batched=False)
+    batched = await _wal_arm(n_pods, chunk, batched=True)
+    l_rpc = legacy["wal_records_per_create"] or 0.0
+    b_rpc = batched["wal_records_per_create"] or 0.0
+    return {
+        "legacy": legacy,
+        "batched": batched,
+        "amortization_x": round(l_rpc / b_rpc, 1) if b_rpc else 0.0,
+    }
+
+
+def check_wal_amortization(report: dict) -> None:
+    """The endurance-gate coherence assertion (ROADMAP item 1 +
+    PR 16's aging gate composed): batching must amortize WAL records
+    >= 8x at chunk=64 while the batched arm's RSS and api p99 stay
+    flat across the run — one-record-per-chunk must not come at the
+    price of the aging hygiene the churn gate already holds. Exits
+    non-zero with the offending numbers on violation."""
+    import sys
+
+    legacy, batched = report["legacy"], report["batched"]
+    for arm in (legacy, batched):
+        if arm["created"] < arm["pods"]:
+            sys.exit(f"wal_amortization: only {arm['created']}/"
+                     f"{arm['pods']} pods created "
+                     f"(batched={arm['batched']})")
+    if legacy["wal_records_per_create"] < 0.99:
+        sys.exit(f"wal_amortization: legacy arm records/create "
+                 f"{legacy['wal_records_per_create']} — the gate-off "
+                 f"path stopped writing one record per object, so the "
+                 f"A/B no longer isolates batching")
+    if report["amortization_x"] < 8.0:
+        sys.exit(f"wal_amortization: records/create dropped only "
+                 f"{report['amortization_x']}x with BatchWriteTxn on "
+                 f"(< 8x floor at chunk={batched['chunk']})")
+    if batched["rss_drift"] > 0.3:
+        sys.exit(f"wal_amortization: batched-arm RSS drifted "
+                 f"{batched['rss_drift']} across the run (> 0.3)")
+    if batched["api_p99_first_ms"] > 0 and batched["api_p99_drift"] > 0.5:
+        sys.exit(f"wal_amortization: batched-arm api p99 climbed "
+                 f"{batched['api_p99_drift']} across the run (> 0.5)")
+
+
 async def run_endurance(duration_s: float = 60.0, arms: str = "both") -> dict:
     """The full endurance stanza: the compaction-on arm (the gate) and
     optionally the unbounded-off arm (the contrast)."""
@@ -200,6 +314,12 @@ async def run_endurance(duration_s: float = 60.0, arms: str = "both") -> dict:
 if __name__ == "__main__":
     import sys
 
-    duration = float(sys.argv[1]) if len(sys.argv) > 1 else 60.0
-    arms = sys.argv[2] if len(sys.argv) > 2 else "both"
-    print(json.dumps(asyncio.run(run_endurance(duration, arms))))
+    if len(sys.argv) > 1 and sys.argv[1] == "wal":
+        n = int(sys.argv[2]) if len(sys.argv) > 2 else 1536
+        report = asyncio.run(run_wal_amortization(n_pods=n))
+        print(json.dumps(report))
+        check_wal_amortization(report)
+    else:
+        duration = float(sys.argv[1]) if len(sys.argv) > 1 else 60.0
+        arms = sys.argv[2] if len(sys.argv) > 2 else "both"
+        print(json.dumps(asyncio.run(run_endurance(duration, arms))))

@@ -53,6 +53,15 @@ def host_fingerprint() -> dict:
     import os
     n = os.cpu_count() or 1
     out = {"cpu_count": n, "same_host": True}
+    try:
+        # Effective cores (taskset / loadgen --cores pinning), not the
+        # host's raw count — the number the thread arms actually get.
+        out["cores"] = len(os.sched_getaffinity(0))
+    except (AttributeError, OSError):
+        out["cores"] = n
+    mode = os.environ.get("KTPU_SHARD_MODE")
+    if mode:
+        out["shard_mode"] = mode
     if n == 1:
         out["cores_note"] = ("single-core host: codec pool inline, "
                              "shard workers per-request tasks — gate "
@@ -268,12 +277,28 @@ def _loopsan_stanza(key: str = "loopsan", top: int = 10) -> dict:
     if not loopsan.enabled():
         return {}
     snap = loopsan.publish_metrics()
-    return {key: {
+    out = {
         "total_busy_s": snap["total_busy_s"],
         "attributed_share": snap["attributed_share"],
         "violations": len(snap["violations"]),
         "top_seams": snap["seams"][:top],
-    }}
+    }
+    # The queue stage used to publish as one opaque scheduler.queue
+    # blob (0.97 of scheduler busy-time at 30k density); the child
+    # seams carve it into pop / informer-decode / gang-wake so a
+    # regression names its seam. killed_top_item records what the
+    # decomposition's first ranked table got removed: pop_batch's
+    # peek-then-pop re-ran the purge scan + isinstance dispatch per
+    # item, folded into a single _take_head_locked pass.
+    queue = {r["seam"]: r["share"] for r in snap["seams"]
+             if r["seam"].startswith("scheduler.queue")}
+    if queue:
+        out["queue_stage"] = {
+            "children": queue,
+            "killed_top_item": "pop_batch peek-then-pop double purge "
+                               "scan (folded into _take_head_locked)",
+        }
+    return {key: out}
 
 
 def _scheduler_loop_stats() -> dict:

@@ -14,7 +14,7 @@ import itertools
 from dataclasses import dataclass, field
 from typing import Optional, Union
 
-from ..analysis import interleave
+from ..analysis import interleave, loopsan
 from ..api import types as t
 from ..util.tasks import spawn
 
@@ -133,18 +133,22 @@ class SchedulingQueue:
         AFTER its pods (a relist after a dropped watch reorders
         exactly that way; found by the chaos harness)."""
         interleave.touch(f"gang:{gk}")  # tpusan DPOR hint: release path
-        if gk in self._gang_suspended:
-            return False  # unadmitted: the admission gate (queueing/)
-        staged = self._gangs.get(gk)
-        need = self._gang_min.get(gk)
-        bound = len(self._gang_bound.get(gk, ()))
-        if not staged or need is None or len(staged) + bound < need:
-            return False
-        pods = list(staged.values())
-        best = max(t.pod_priority(p) for p in pods)
-        self._push_entry(f"gang:{gk}", (-best, next(self._seq)),
-                         GangUnit(group_key=gk, pods=pods))
-        return True
+        # loopsan child seam: gang-release wakeups were folded into the
+        # parent queue-stage share — carving them out is what lets the
+        # occupancy table say whether pop, decode, or THIS dominates.
+        with loopsan.seam("scheduler.queue.gang_wake"):
+            if gk in self._gang_suspended:
+                return False  # unadmitted: the admission gate (queueing/)
+            staged = self._gangs.get(gk)
+            need = self._gang_min.get(gk)
+            bound = len(self._gang_bound.get(gk, ()))
+            if not staged or need is None or len(staged) + bound < need:
+                return False
+            pods = list(staged.values())
+            best = max(t.pod_priority(p) for p in pods)
+            self._push_entry(f"gang:{gk}", (-best, next(self._seq)),
+                             GangUnit(group_key=gk, pods=pods))
+            return True
 
     def _wake_soon(self) -> None:
         """Notify the consumer from a sync (informer handler) context.
@@ -238,7 +242,11 @@ class SchedulingQueue:
     async def pop(self) -> Optional[QueueItem]:
         async with self._cond:
             while True:
-                item = self._pop_ready_locked()
+                # Seam wraps only the sync drain, never the cond wait
+                # (spans cannot cross awaits; wait time is idle, not
+                # queue-stage busy).
+                with loopsan.seam("scheduler.queue.pop"):
+                    item = self._pop_ready_locked()
                 if item is not None:
                     return item
                 if self._closed:
@@ -252,10 +260,13 @@ class SchedulingQueue:
             heapq.heappop(self._heap)
         return self._heap[0].item if self._heap else None
 
-    def _pop_ready_locked(self) -> Optional[QueueItem]:
-        """One live item off the heap, or None when empty (lock held)."""
-        if self._peek_ready_locked() is None:
-            return None
+    def _take_head_locked(self) -> QueueItem:
+        """Pop the (already-purged, non-empty) heap top. Callers must
+        have just run :meth:`_peek_ready_locked` — splitting peek from
+        take is what lets :meth:`pop_batch` pay ONE purge scan per
+        item where peek-then-pop paid two (the re-purge + isinstance
+        re-check was the loopsan-attributed top queue-stage item at
+        30k density)."""
         e = heapq.heappop(self._heap)
         if isinstance(e.item, GangUnit):
             self._entries.pop(f"gang:{e.item.group_key}", None)
@@ -267,6 +278,12 @@ class SchedulingQueue:
             self._entries.pop(e.item.key(), None)
         return e.item
 
+    def _pop_ready_locked(self) -> Optional[QueueItem]:
+        """One live item off the heap, or None when empty (lock held)."""
+        if self._peek_ready_locked() is None:
+            return None
+        return self._take_head_locked()
+
     async def pop_batch(self, limit: int = 64) -> Optional[list]:
         """Drain up to ``limit`` ready items in priority order with ONE
         condition acquisition (the SchedulerFastPath batch drain) —
@@ -277,16 +294,17 @@ class SchedulingQueue:
         one-unit-at-a-time atomicity under tpusan. None = closed."""
         async with self._cond:
             while True:
-                out: list = []
-                while len(out) < limit:
-                    head = self._peek_ready_locked()
-                    if head is None:
-                        break
-                    if isinstance(head, GangUnit) and out:
-                        break
-                    out.append(self._pop_ready_locked())
-                    if isinstance(head, GangUnit):
-                        break
+                with loopsan.seam("scheduler.queue.pop"):
+                    out: list = []
+                    while len(out) < limit:
+                        head = self._peek_ready_locked()
+                        if head is None:
+                            break
+                        if isinstance(head, GangUnit) and out:
+                            break
+                        out.append(self._take_head_locked())
+                        if isinstance(head, GangUnit):
+                            break
                 if out:
                     return out
                 if self._closed:

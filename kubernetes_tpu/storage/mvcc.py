@@ -55,6 +55,7 @@ from typing import Any, Callable, Iterable, Optional
 from ..analysis import interleave, invariants, loopsan
 from ..api import errors
 from ..chaos import core as chaos
+from ..metrics.registry import Counter
 from ..util.lockdep import make_lock
 
 ADDED = "ADDED"
@@ -62,6 +63,29 @@ MODIFIED = "MODIFIED"
 DELETED = "DELETED"
 BOOKMARK = "BOOKMARK"
 ERROR = "ERROR"
+#: WAL/replication record kind for one committed transaction: N
+#: sub-records under ONE CRC frame / ONE log entry. Never a watch
+#: event type — events inside a batch keep their per-op kinds.
+BATCH = "BATCH"
+
+MVCC_TXN_COMMITS = Counter(
+    "mvcc_txn_commits_total",
+    "multi-op transactions committed (one WAL record / one watch "
+    "round each)")
+MVCC_TXN_OPS = Counter(
+    "mvcc_txn_ops_total",
+    "individual writes committed through multi-op transactions")
+
+
+class TxnError(Exception):
+    """One op of a :meth:`MVCCStore.txn` failed validation; NOTHING was
+    committed. ``index`` is the offending op's position, ``error`` the
+    per-op StatusError — callers split-commit around it."""
+
+    def __init__(self, index: int, error: Exception):
+        super().__init__(f"txn op {index}: {error}")
+        self.index = index
+        self.error = error
 
 
 @dataclass
@@ -176,6 +200,50 @@ class Watch:
                         self._store._remove_watch(self)
                     return
         self._post(ev)
+
+    def _deliver_batch(self, evs: list[WatchEvent]) -> None:
+        """Deliver one txn's events to this watcher in ONE round: one
+        pending-count bump, one loop wake (``call_soon`` writes the
+        wake-up pipe once per call — per-event delivery paid that
+        syscall N times per watcher per batch). Called with the store
+        lock held, possibly from a foreign thread; ordering vs
+        :meth:`_deliver` is preserved because both go through the same
+        loop's FIFO ``call_soon`` queue."""
+        items: list[WatchEvent] = []
+        for ev in evs:
+            if ev.revision <= self.start_revision:
+                continue
+            c = chaos.CONTROLLER
+            if c is not None and not self.overflowed:
+                fault = c.decide(chaos.SITE_WATCH_STORE)
+                if fault is not None and fault.kind == "overflow":
+                    self.overflowed = True
+                    self._post(None)
+                    self._store._remove_watch(self)
+                    return
+            items.append(ev)
+        if not items:
+            return
+        with self._pending_lock:
+            self._pending += len(items)
+            if self._pending > self._queue_limit:
+                if not self.overflowed:
+                    self.overflowed = True
+                    self._post(None)
+                    self._store._remove_watch(self)
+                return
+        try:
+            running = asyncio.get_running_loop()
+        except RuntimeError:
+            running = None
+        if running is self._loop:
+            self._loop.call_soon(self._enqueue_batch, items)
+        else:
+            self._loop.call_soon_threadsafe(self._enqueue_batch, items)
+
+    def _enqueue_batch(self, items: list[WatchEvent]) -> None:
+        for it in items:
+            self._queue.put_nowait(it)
 
     def _consumed(self) -> None:
         with self._pending_lock:
@@ -362,6 +430,12 @@ class MVCCStore:
         #: /debug/v1/storage endpoint and endurance gate read.
         self._wal_bytes = 0
         self._wal_records = 0
+        #: Lifetime counters (NOT reset by rotation): WAL records ever
+        #: appended vs logical write ops they carried — the
+        #: ``wal_records_per_create`` ratio /debug/v1/storage serves
+        #: and the endurance gate asserts drops >=8x under batching.
+        self._wal_records_total = 0
+        self._wal_ops_total = 0
         self._snapshots = 0
         self._compactions = 0
         #: chaos ``wal:compact-crash``: when armed, the NEXT snapshot
@@ -401,6 +475,11 @@ class MVCCStore:
         #: followers) from a replicated apply (already shipped). Valid
         #: only under the store lock, which is where hooks run.
         self.applying_replicated = False
+        #: True while :meth:`_append_batch` runs a txn's per-event
+        #: hooks: an event hook that captures writes one-by-one (the
+        #: replication leader seam) must skip them — the whole batch
+        #: arrives once through the txn hooks instead.
+        self.in_txn = False
         #: Canonical state captured the instant a WAL crash fault fired
         #: — what recovery from disk must reproduce, byte for byte.
         self.pre_crash_state: Optional[dict] = None
@@ -418,6 +497,8 @@ class MVCCStore:
         self._write_hooks: list[Callable[[str], None]] = []
         #: Full-event listeners (see :meth:`add_event_hook`).
         self._event_hooks: list[Callable[[WatchEvent], None]] = []
+        #: Whole-txn listeners (see :meth:`add_txn_hook`).
+        self._txn_hooks: list[Callable[[list], None]] = []
         self._data_dir = data_dir
         self._wal = None
         if data_dir:
@@ -545,6 +626,19 @@ class MVCCStore:
     def _apply_wal_record(self, rec: dict) -> None:
         if rec["rev"] <= self._rev:
             return
+        if rec.get("op") == BATCH:
+            # One framed line, N sub-records: replay each in commit
+            # order. The whole line shares one CRC so a batch is
+            # all-or-nothing on disk; per-sub idempotence still guards
+            # a replay over a store that already holds a prefix (the
+            # compact-crash stale-log path).
+            term = rec.get("term", 0)
+            for sub in rec["ops"]:
+                if sub["rev"] <= self._rev:
+                    continue
+                self._apply_wal_record(
+                    {**sub, "term": term} if term else sub)
+            return
         self._rev = rec["rev"]
         self.recovered_term = rec.get("term", self.recovered_term)
         key = rec["key"]
@@ -636,6 +730,15 @@ class MVCCStore:
         Same contract: cheap, non-raising, no store re-entry."""
         self._event_hooks.append(fn)
 
+    def add_txn_hook(self, fn: Callable[[list], None]) -> None:
+        """Register ``fn(events)`` to run once per committed :meth:`txn`
+        with the whole batch's events, under the store lock, after the
+        WAL append and before watch delivery — the replication leader's
+        one-log-entry-per-chunk capture seam. Single-op writes never
+        call it. Same contract as the other hooks: cheap, non-raising,
+        no store re-entry."""
+        self._txn_hooks.append(fn)
+
     def _append_event(self, ev: WatchEvent) -> None:
         interleave.touch(ev.key)
         if self.wal_term:
@@ -657,6 +760,8 @@ class MVCCStore:
             self._wal.write(line)
             self._wal_bytes += len(line)
             self._wal_records += 1
+            self._wal_records_total += 1
+            self._wal_ops_total += 1
             self._wal_sync()
             self._maybe_rotate_wal()
         # Snapshot: an overflowing watcher removes itself from _watches
@@ -678,6 +783,71 @@ class MVCCStore:
         # write, and durable stores run it off-loop (to_thread).
         payload = json.dumps(rec, separators=(",", ":"))  # tpuvet: ignore[hot-path-cost]
         return f"{zlib.crc32(payload.encode()):08x} {payload}\n"
+
+    def _wal_batch_line(self, events: list[WatchEvent]) -> str:
+        """One framed WAL line for a whole committed txn. The outer
+        record's ``rev`` is the batch's FINAL revision (so the replay
+        idempotence check — ``rec["rev"] <= self._rev`` skip — covers
+        the batch as one unit) and ``op`` is the :data:`BATCH` kind;
+        ``ops`` carries the sub-records in commit order, each in the
+        legacy single-record shape. One CRC covers the whole line: a
+        torn or flipped batch frame drops the whole chunk on replay —
+        a batch record is atomic on disk by construction."""
+        subs = [{"rev": ev.revision, "op": ev.type, "key": ev.key,
+                 "value": self._disk(ev.key, ev.value)} for ev in events]
+        rec = {"rev": subs[-1]["rev"], "op": BATCH, "ops": subs}
+        if self.wal_term:
+            rec["term"] = self.wal_term
+        # Durable arm only, off-loop (see _wal_line).
+        payload = json.dumps(rec, separators=(",", ":"))  # tpuvet: ignore[hot-path-cost]
+        return f"{zlib.crc32(payload.encode()):08x} {payload}\n"
+
+    def _append_batch(self, events: list[WatchEvent]) -> None:
+        """Commit tail for one txn: per-event hooks and history in
+        commit order, then ONE WAL record, ONE group-commit sync, ONE
+        whole-batch replication hook, and ONE watch-delivery round per
+        watcher (all matching events enqueued before the single loop
+        wake). The single-write path (:meth:`_append_event`) pays each
+        of those per op."""
+        if self.wal_term:
+            self.last_entry_term = self.wal_term
+        self._write_tls.last_rev = events[-1].revision
+        self.in_txn = True
+        try:
+            for ev in events:
+                interleave.touch(ev.key)
+                for hook in self._write_hooks:
+                    hook(ev.key)
+                for hook in self._event_hooks:
+                    hook(ev)
+                self._log.append(ev)
+                self._log_revs.append(ev.revision)
+        finally:
+            self.in_txn = False
+        if len(self._log) > self._history_limit:
+            cut = len(self._log) - self._history_limit
+            self._compact_rev = self._log_revs[cut - 1]
+            del self._log[:cut]
+            del self._log_revs[:cut]
+        if self._wal and not self._wal_failed:
+            line = self._wal_batch_line(events)
+            self._wal.write(line)
+            self._wal_bytes += len(line)
+            self._wal_records += 1
+            self._wal_records_total += 1
+            self._wal_ops_total += len(events)
+            self._wal_sync()
+        for hook in self._txn_hooks:
+            hook(events)
+        # One delivery round per watcher (see _append_event for the
+        # list() snapshot rationale).
+        for wch in list(self._watches):
+            evs = [ev for ev in events if ev.key.startswith(wch.prefix)]
+            if evs:
+                wch._deliver_batch(evs)
+        MVCC_TXN_COMMITS.inc()
+        MVCC_TXN_OPS.inc(float(len(events)))
+        self._maybe_rotate_wal()
 
     def _wal_sync(self) -> None:
         """Group-commit: fsync per policy, decided at APPEND time.
@@ -746,27 +916,52 @@ class MVCCStore:
         and the store refuses every later write (an etcd that lost its
         disk) until rebuilt from ``data_dir`` — at which point recovery
         must reproduce :attr:`pre_crash_state` exactly."""
-        if self._wal is None:
+        fault = self._wal_fault_or_none()
+        if fault is None:
             return
+        self._wal_crash(fault, self._wal_line(self._rev + 1, op, key, value))
+
+    def _wal_chaos_precheck_batch(
+            self, entries: list[tuple[str, str, Optional[dict]]]) -> None:
+        """Batch-txn twin of :meth:`_wal_chaos_precheck`: the injected
+        crash damages the ONE framed batch record the txn would have
+        written (``entries`` = the txn's (op, key, value) triples with
+        hypothetical contiguous revisions), so recovery must drop the
+        whole chunk — a batch record is atomic on disk."""
+        fault = self._wal_fault_or_none()
+        if fault is None:
+            return
+        evs = [WatchEvent(op, key, value, None, self._rev + 1 + j)
+               for j, (op, key, value) in enumerate(entries)]
+        self._wal_crash(fault, self._wal_batch_line(evs))
+
+    def _wal_fault_or_none(self):
+        """Shared decide step for the single/batch WAL chaos prechecks:
+        raises if the WAL already crashed, arms compact-crash, returns
+        the fault to inject (or None when nothing fires)."""
+        if self._wal is None:
+            return None
         if self._wal_failed:
             raise errors.ServiceUnavailableError(
                 "storage backend unavailable (WAL crashed; rebuild the "
                 "store from its data dir to recover)")
         c = chaos.CONTROLLER
         if c is None:
-            return
+            return None
         fault = c.decide(chaos.SITE_WAL)
         if fault is None:
-            return
+            return None
         if fault.kind == "compact-crash":
             # Armed, not fired: THIS write proceeds normally; the next
             # snapshot (manual or threshold-triggered) crashes between
             # installing snapshot.json and truncating the WAL — the
             # compaction analog of a torn tail (see :meth:`snapshot`).
             self._compact_crash_armed = True
-            return
+            return None
+        return fault
+
+    def _wal_crash(self, fault, line: str) -> None:
         self.pre_crash_state = self.state()
-        line = self._wal_line(self._rev + 1, op, key, value)
         if fault.kind == "torn":
             # Crash mid-write: a record prefix, no newline.
             self._wal.write(line[: max(1, len(line) // 2)])
@@ -892,6 +1087,101 @@ class MVCCStore:
             self._append_event(WatchEvent(DELETED, key, obj.value, obj.value, self._rev))
             return self._rev
 
+    def txn(self, ops: list[tuple]) -> list[int]:
+        """Commit N writes as ONE transaction: one lock acquisition,
+        one contiguous revision range, one framed WAL record (see
+        :meth:`_wal_batch_line`), one group-commit sync, one watch
+        round. ``ops`` is ``[(op, key, value, expected_revision)]``
+        with ``op`` in {ADDED, MODIFIED, DELETED}; ``value`` is the
+        new object (ADDED/MODIFIED) and ignored for DELETED;
+        ``expected_revision`` is the usual CAS guard (None skips it).
+        All-or-nothing: any per-op validation failure raises
+        :class:`TxnError` naming the offending index and NOTHING
+        commits — callers split-commit around the bad item. Returns
+        the committed revisions in op order."""
+        with loopsan.seam("mvcc.txn"):
+            return self._txn(ops)
+
+    def _txn(self, ops: list[tuple]) -> list[int]:
+        if not ops:
+            return []
+        with self._lock:
+            self._check_write_guard()
+            # Pass 1 — validate every op against an overlay of the ops
+            # before it WITHOUT touching store state: TxnError must
+            # leave no trace.
+            staged: dict[str, dict] = {}
+            frozen: list[Optional[dict]] = []
+            wal_vals: list[Optional[dict]] = []
+            for i, (op, key, value, expected) in enumerate(ops):
+                try:
+                    st = staged.get(key)
+                    if st is not None:
+                        alive = st["op"] != DELETED
+                        cur_val = st["value"]
+                        cur_rev = None  # mid-txn revs aren't assigned yet
+                    else:
+                        obj = self._data.get(key)
+                        alive = obj is not None
+                        cur_val = obj.value if obj is not None else None
+                        cur_rev = obj.mod_revision if obj is not None else None
+                    if op == ADDED:
+                        if alive:
+                            raise errors.AlreadyExistsError(
+                                f"key {key!r} already exists")
+                        fv = self._freeze(value)
+                        frozen.append(fv)
+                        wal_vals.append(fv)
+                    else:
+                        if not alive:
+                            raise errors.NotFoundError(
+                                f"key {key!r} not found")
+                        if expected is not None:
+                            if cur_rev is None or cur_rev != expected:
+                                raise errors.ConflictError(
+                                    f"key {key!r}: revision mismatch "
+                                    f"(have {cur_rev}, caller expected "
+                                    f"{expected})")
+                        if op == MODIFIED:
+                            fv = self._freeze(value)
+                            frozen.append(fv)
+                            wal_vals.append(fv)
+                        else:
+                            frozen.append(None)
+                            wal_vals.append(cur_val)  # the corpse
+                    staged[key] = {"op": op, "value": frozen[-1]}
+                except errors.StatusError as e:
+                    raise TxnError(i, e) from e
+            self._wal_chaos_precheck_batch(
+                [(op, key, wal_vals[j])
+                 for j, (op, key, _v, _e) in enumerate(ops)])
+            # Pass 2 — apply sequentially under the contiguous range.
+            base = self._rev
+            events: list[WatchEvent] = []
+            for j, (op, key, _value, _expected) in enumerate(ops):
+                rev = base + 1 + j
+                prev_obj = self._data.get(key)
+                if op == DELETED:
+                    corpse = prev_obj.value
+                    del self._data[key]
+                    ev = WatchEvent(DELETED, key, corpse, corpse, rev)
+                elif op == ADDED:
+                    fv = frozen[j]
+                    self._data[key] = StoredObject(
+                        key=key, value=fv, mod_revision=rev,
+                        create_revision=rev)
+                    ev = WatchEvent(ADDED, key, fv, None, rev)
+                else:
+                    fv = frozen[j]
+                    self._data[key] = StoredObject(
+                        key=key, value=fv, mod_revision=rev,
+                        create_revision=prev_obj.create_revision)
+                    ev = WatchEvent(MODIFIED, key, fv, prev_obj.value, rev)
+                events.append(ev)
+            self._rev = base + len(ops)
+            self._append_batch(events)
+            return [e.revision for e in events]
+
     def last_write_in(self, fn, *args) -> tuple:
         """Run ``fn(*args)`` and return ``(result, rev)`` where ``rev``
         is the highest revision the call itself wrote (0 if it wrote
@@ -916,7 +1206,14 @@ class MVCCStore:
         durable and fully watchable. Idempotent: a resent entry at or
         below the current revision is a no-op (returns False).
         ``term`` is the entry's raft term, stamped into the WAL record
-        so the log coordinate survives a restart."""
+        so the log coordinate survives a restart.
+
+        A :data:`BATCH` entry (op == BATCH, ``value["ops"]`` = the
+        txn's sub-records, ``rev`` = the final revision) applies the
+        whole chunk under one lock hold / one WAL record / one watch
+        round, exactly like the leader's :meth:`txn` commit."""
+        if op == BATCH:
+            return self._apply_replicated_batch(value["ops"], rev, term)
         with self._lock:
             if rev <= self._rev:
                 return False
@@ -946,6 +1243,55 @@ class MVCCStore:
             self.applying_replicated = True
             try:
                 self._append_event(ev)
+            finally:
+                self.applying_replicated = False
+            return True
+
+    def _apply_replicated_batch(self, subs: list[dict], rev: int,
+                                term: int = 0) -> bool:
+        """Follower-side :meth:`txn` commit. Idempotent per sub-record:
+        a resend overlapping already-applied revisions re-applies only
+        the unseen suffix (still contiguous with the local head)."""
+        with self._lock:
+            if rev <= self._rev:
+                return False
+            pending = [s for s in subs if s["rev"] > self._rev]
+            if not pending or pending[0]["rev"] != self._rev + 1:
+                head = pending[0]["rev"] if pending else rev
+                raise ValueError(
+                    f"replicated batch head rev {head} leaves a gap "
+                    f"after local rev {self._rev}; replication must "
+                    f"apply contiguously")
+            if term:
+                self.wal_term = term
+            self._wal_chaos_precheck_batch(
+                [(s["op"], s["key"], s["value"]) for s in pending])
+            events: list[WatchEvent] = []
+            for s in pending:
+                key = s["key"]
+                prev_obj = self._data.get(key)
+                if s["op"] == DELETED:
+                    if prev_obj is not None:
+                        del self._data[key]
+                    corpse = (prev_obj.value if prev_obj is not None
+                              else s["value"])
+                    ev = WatchEvent(DELETED, key, corpse, corpse, s["rev"])
+                else:
+                    fv = self._freeze(s["value"])
+                    self._data[key] = StoredObject(
+                        key=key, value=fv, mod_revision=s["rev"],
+                        create_revision=(prev_obj.create_revision
+                                         if prev_obj is not None
+                                         else s["rev"]))
+                    ev = WatchEvent(
+                        s["op"], key, fv,
+                        prev_obj.value if prev_obj is not None else None,
+                        s["rev"])
+                events.append(ev)
+            self._rev = pending[-1]["rev"]
+            self.applying_replicated = True
+            try:
+                self._append_batch(events)
             finally:
                 self.applying_replicated = False
             return True
@@ -1129,6 +1475,21 @@ class MVCCStore:
         """WAL records since the last truncation (0 when not durable)."""
         with self._lock:
             return self._wal_records
+
+    @property
+    def wal_records_total(self) -> int:
+        """Lifetime WAL records appended (survives rotation; 0 when
+        not durable). With :attr:`wal_ops_total` this is the
+        ``wal_records_per_create`` ratio the endurance gate asserts
+        drops under batching."""
+        with self._lock:
+            return self._wal_records_total
+
+    @property
+    def wal_ops_total(self) -> int:
+        """Lifetime logical write ops carried by those records."""
+        with self._lock:
+            return self._wal_ops_total
 
     @property
     def history_len(self) -> int:

@@ -61,7 +61,7 @@ from ..chaos import core as chaos
 from ..metrics.registry import Counter, Gauge
 from ..util.lockdep import make_lock
 from ..util.tasks import spawn
-from .mvcc import MVCCStore, WatchEvent
+from .mvcc import BATCH, MVCCStore, WatchEvent
 
 log = logging.getLogger("replication")
 
@@ -261,6 +261,7 @@ class ReplicaNode:
         self._load_term_state()
         store.writes_blocked = NOT_LEADER
         store.add_event_hook(self._on_store_event)
+        store.add_txn_hook(self._on_store_txn)
         invariants.register_replica_store(self.group, self.node_id, store)
 
     # -- durable term/vote ------------------------------------------------
@@ -357,6 +358,11 @@ class ReplicaNode:
         # (Registry.run dispatches durable-store mutations to_thread).
         if self.store.applying_replicated:
             return  # a replicated apply, not a local write
+        if self.store.in_txn:
+            # A txn's per-event hooks: the whole chunk arrives once
+            # through _on_store_txn as ONE log entry — capturing the
+            # sub-writes here too would double-ship them.
+            return
         # The entry's term is what the WAL record was STAMPED with
         # (store.wal_term, read under the same store lock) — not
         # self.term, which a concurrent step-down on the event loop may
@@ -368,6 +374,31 @@ class ReplicaNode:
         with self._buf_lock:
             self._entries[ev.revision] = entry
             self.last_rev = ev.revision
+            self.last_term = entry.term
+            self._trim_buffer()
+        if self._loop is not None and not self.crashed:
+            try:
+                self._loop.call_soon_threadsafe(self._kick.set)
+            except RuntimeError:
+                pass  # loop already closed: shutdown race, nothing to ship
+
+    def _on_store_txn(self, events: list[WatchEvent]) -> None:
+        # One committed MVCCStore.txn -> ONE log entry carrying all N
+        # sub-writes (mirroring the one WAL record on disk). Same
+        # threading contract as _on_store_event. Every covered revision
+        # maps to the SAME entry object so _term_at and the catch-up
+        # scan resolve mid-batch revisions; the wire builder dedupes by
+        # identity.
+        if self.store.applying_replicated:
+            return
+        subs = [{"rev": ev.revision, "op": ev.type, "key": ev.key,
+                 "value": ev.value} for ev in events]
+        entry = LogEntry(self.store.wal_term, events[-1].revision, BATCH,
+                         "", {"ops": subs})
+        with self._buf_lock:
+            for ev in events:
+                self._entries[ev.revision] = entry
+            self.last_rev = entry.rev
             self.last_term = entry.term
             self._trim_buffer()
         if self._loop is not None and not self.crashed:
@@ -524,9 +555,18 @@ class ReplicaNode:
             nxt = self._next_rev.get(peer, last_rev + 1)
             missing = [r for r in range(nxt, last_rev + 1)
                        if r not in self._entries]
-            entries = ([] if missing else
-                       [self._entries[r].to_wire()
-                        for r in range(nxt, last_rev + 1)])
+            entries: list[dict] = []
+            if not missing:
+                # A batch entry maps every covered revision to one
+                # object — ship it once (identity dedupe), not once
+                # per revision.
+                prev_e = None
+                for r in range(nxt, last_rev + 1):
+                    e = self._entries[r]
+                    if e is prev_e:
+                        continue
+                    entries.append(e.to_wire())
+                    prev_e = e
         if missing and nxt <= last_rev:
             await self._install_snapshot(peer)
             return
@@ -547,8 +587,12 @@ class ReplicaNode:
             self._step_down(resp["term"])
             return
         if resp.get("ok"):
+            # len(entries) undercounts when a batch entry covers
+            # several revisions — the follower acked through last_rev
+            # (the snapshot we captured the wire list under).
+            shipped_to = last_rev if entries else prev_rev
             self._match_rev[peer] = max(self._match_rev.get(peer, 0),
-                                        prev_rev + len(entries))
+                                        shipped_to)
             self._next_rev[peer] = self._match_rev[peer] + 1
             return
         if resp.get("conflict"):
@@ -617,7 +661,16 @@ class ReplicaNode:
         if invariants.SANITIZER is not None:
             for r in range(prev + 1, rev + 1):
                 e = self._entries.get(r)
-                if e is not None:
+                if e is None:
+                    continue
+                if e.op == BATCH:
+                    sub = next((s for s in e.value["ops"]
+                                if s["rev"] == r), None)
+                    if sub is not None:
+                        invariants.note_commit(
+                            self.group, sub["rev"], sub["op"],
+                            sub["key"], sub["value"])
+                else:
                     invariants.note_commit(self.group, e.rev, e.op, e.key,
                                            e.value)
         if self._commit_waiters:
@@ -725,8 +778,11 @@ class ReplicaNode:
                           "down until rebuilt", self.node_id, e.rev, e2)
                 self.crash()
                 raise ReplError(f"{self.node_id}: apply failed") from e2
+            covered = ([s["rev"] for s in e.value["ops"]]
+                       if e.op == BATCH else [e.rev])
             with self._buf_lock:
-                self._entries[e.rev] = e
+                for r in covered:
+                    self._entries[r] = e
                 self.last_rev, self.last_term = e.rev, e.term
                 self._trim_buffer()
             last_rev = e.rev

@@ -188,6 +188,21 @@ KNOWN_FEATURES = {f.name: f for f in [
             "bookmark stays on either way (rest.py's liveness check "
             "depends on it). Off = no under-traffic bookmarks, "
             "reconnects always relist — byte-identical on the wire"),
+    Feature("BatchWriteTxn", False, ALPHA,
+            "transactional batch write path (storage/mvcc.py txn + "
+            "apiserver/registry.py): a {plural}:batchCreate / "
+            "bindings:batch chunk commits as ONE MVCC transaction — "
+            "one store lock hold, one contiguous revision range, one "
+            "CRC-framed BATCH WAL record, one group-commit fsync, one "
+            "replication log entry (wait_commit acks the chunk's "
+            "final revision), one watch-delivery round — with "
+            "validation+admission run as one batched pass per chunk "
+            "(read-only admission lookups memoized chunk-wide) and "
+            "the encode cache filled from the txn's echoed objects. "
+            "Per-item rejections split-commit around the bad item; "
+            "per-item status is preserved either way. Off = the "
+            "per-object write loop, byte-identical wire AND WAL "
+            "bytes"),
     Feature("ClusterMonitoring", True, BETA,
             "cluster-level TPU telemetry rollup (monitoring/"
             "aggregator.py): the controller-manager scrapes node "

@@ -200,3 +200,132 @@ async def test_single_pod_binds_ride_batch_coalescer():
             assert pod.spec.node_name in ("n1", "n2")
     finally:
         await sched.stop()
+
+
+# ---------------------------------------------------------------------------
+# BatchWriteTxn gate on: the chunk commits as ONE MVCC transaction, and
+# a per-item rejection must not abort it — the rest split-commits with
+# per-item status preserved (the regression the txn path must not
+# reintroduce over the legacy per-object loop's semantics).
+# ---------------------------------------------------------------------------
+
+async def _gate_on_server():
+    from kubernetes_tpu.util.features import GATES
+    old = GATES.enabled("BatchWriteTxn")
+    GATES.set("BatchWriteTxn", True)
+    srv, client = await start_server()
+    return srv, client, old
+
+
+async def test_txn_batch_create_split_commit():
+    """One duplicate + one invalid item in 8: the other 6 commit as one
+    txn (contiguous revision range), per-item errors keep their reason
+    and position."""
+    from kubernetes_tpu.apiserver.registry import (BATCH_TXN_COMMITS,
+                                                   BATCH_TXN_SPLITS)
+    from kubernetes_tpu.util.features import GATES
+    srv, client, old = await _gate_on_server()
+    try:
+        srv.registry.create(plain_pod("dup"))
+        commits0 = BATCH_TXN_COMMITS.value(kind="create")
+        splits0 = BATCH_TXN_SPLITS.value(kind="create")
+        objs = [plain_pod(f"t-{i}") for i in range(8)]
+        objs[3].metadata.name = "dup"
+        objs[5].metadata.name = "NOT_A_DNS_NAME"
+        results = await client.create_many(objs)
+        assert len(results) == 8
+        assert isinstance(results[3], errors.AlreadyExistsError)
+        assert isinstance(results[5], errors.StatusError)
+        assert "NOT_A_DNS_NAME" in str(results[5])
+        oks = [r for r in results if not isinstance(r, Exception)]
+        assert len(oks) == 6
+        assert all(o.metadata.uid for o in oks)  # full create pipeline
+        # The 6 survivors committed as ONE txn: contiguous revisions.
+        revs = sorted(int(o.metadata.resource_version) for o in oks)
+        assert revs == list(range(revs[0], revs[0] + 6))
+        assert BATCH_TXN_COMMITS.value(kind="create") == commits0 + 1
+        assert BATCH_TXN_SPLITS.value(kind="create") >= splits0 + 1
+        items, _rev = await client.list("pods", "default")
+        assert len(items) == 7  # dup + 6 new
+    finally:
+        GATES.set("BatchWriteTxn", old)
+        await client.close()
+        await srv.stop()
+
+
+async def test_txn_batch_create_admission_quota():
+    """The batched admission pass (chunk-scoped read memo) still
+    enforces ResourceQuota per item: a quota of 2 admits exactly 2 of
+    4, and the 2 rejections don't abort the chunk's txn."""
+    from kubernetes_tpu.util.features import GATES
+    srv, client, old = await _gate_on_server()
+    try:
+        srv.registry.create(t.ResourceQuota(
+            metadata=ObjectMeta(name="q", namespace="default"),
+            spec=t.ResourceQuotaSpec(hard={"pods": 2.0})))
+        results = await client.create_many(
+            [plain_pod(f"q-{i}") for i in range(4)])
+        oks = [r for r in results if not isinstance(r, Exception)]
+        errs = [r for r in results if isinstance(r, Exception)]
+        assert len(oks) == 2 and len(errs) == 2
+        for e in errs:
+            assert isinstance(e, errors.StatusError)
+            assert "quota" in str(e).lower()
+    finally:
+        GATES.set("BatchWriteTxn", old)
+        await client.close()
+        await srv.stop()
+
+
+async def test_txn_batch_bind_split_commit():
+    """bindings:batch under the txn gate: a ghost pod and an
+    already-bound pod fail per item (404/409), the rest bind in one
+    txn."""
+    from kubernetes_tpu.apiserver.registry import BATCH_TXN_SPLITS
+    from kubernetes_tpu.util.features import GATES
+    srv, client, old = await _gate_on_server()
+    try:
+        splits0 = BATCH_TXN_SPLITS.value(kind="bind")
+        for i in range(6):
+            srv.registry.create(plain_pod(f"w-{i}"))
+        srv.registry.bind_pod("default", "w-0", binding("other-node"))
+        items = [(f"w-{i}", binding()) for i in range(6)]
+        items.insert(3, ("ghost", binding()))
+        results = await client.bind_many("default", items)
+        assert len(results) == 7
+        assert isinstance(results[3], errors.NotFoundError)
+        # w-0 (index 0) was already bound elsewhere: per-item 409.
+        assert isinstance(results[0], errors.ConflictError)
+        for i in range(1, 6):
+            pod = await client.get("pods", "default", f"w-{i}")
+            assert pod.spec.node_name == "n1"
+        assert BATCH_TXN_SPLITS.value(kind="bind") >= splits0 + 2
+    finally:
+        GATES.set("BatchWriteTxn", old)
+        await client.close()
+        await srv.stop()
+
+
+async def test_txn_gate_off_wire_bytes_identical():
+    """Gate off is the byte-identical legacy path: same response wire
+    bytes for the same batch, same WAL shape (one record per create)."""
+    from kubernetes_tpu.util.features import GATES
+    old = GATES.enabled("BatchWriteTxn")
+    bodies = []
+    for gate in (False, True):
+        GATES.set("BatchWriteTxn", gate)
+        srv, client = await start_server()
+        try:
+            objs = [plain_pod(f"x-{i}") for i in range(4)]
+            objs[2].metadata.name = "NOT_A_DNS_NAME"
+            results = await client.create_many(objs)
+            body = [(type(r).__name__ if isinstance(r, Exception)
+                     else r.metadata.name) for r in results]
+            # Normalize: uid/rv differ run to run, names and per-item
+            # error types must not.
+            bodies.append(body)
+        finally:
+            await client.close()
+            await srv.stop()
+    GATES.set("BatchWriteTxn", old)
+    assert bodies[0] == bodies[1]

@@ -429,3 +429,217 @@ def test_chaos_compact_crash_recovers_identical(tmp_path):
     s3 = MVCCStore(str(tmp_path))
     assert s3.get("/k6").value == {"i": 6}
     s3.close()
+
+
+# ---------------------------------------------------------------------------
+# Transactional batch writes — one MVCC txn / ONE framed WAL record per
+# chunk (the batchCreate write path). Golden corrupted-corpus contract:
+# one CRC covers the whole batch frame, so a torn/flipped/never-written
+# record drops the WHOLE chunk on replay and recovery is byte-identical
+# to the state before the txn — a batch is atomic on disk.
+# ---------------------------------------------------------------------------
+
+def test_txn_one_wal_record_contiguous_revs(tmp_path):
+    import json
+    from kubernetes_tpu.storage.mvcc import BATCH
+    s = MVCCStore(str(tmp_path))
+    s.create("/registry/pods/default/seed", {"x": 0})
+    base = s.revision
+    revs = s.txn([
+        (ADDED, "/registry/pods/default/a", {"x": 1}, None),
+        (ADDED, "/registry/pods/default/b", {"x": 2}, None),
+        (MODIFIED, "/registry/pods/default/seed", {"x": 9}, base),
+        (DELETED, "/registry/pods/default/b", None, None),
+    ])
+    assert revs == [base + 1, base + 2, base + 3, base + 4]
+    # One record for the seed create, ONE for the whole txn.
+    assert s.wal_records_total == 2
+    assert s.wal_ops_total == 5
+    live = _state_json(s)
+    s.close()
+    with open(tmp_path / "wal.jsonl") as f:
+        lines = f.readlines()
+    assert len(lines) == 2
+    rec = json.loads(lines[1].split(" ", 1)[1])
+    assert rec["op"] == BATCH
+    assert rec["rev"] == base + 4  # outer rev = the chunk's FINAL rev
+    assert [sub["op"] for sub in rec["ops"]] == [ADDED, ADDED, MODIFIED,
+                                                 DELETED]
+    assert [sub["rev"] for sub in rec["ops"]] == revs
+    s2 = _recovered(tmp_path)
+    assert _state_json(s2) == live
+
+
+def test_txn_error_commits_nothing(tmp_path):
+    from kubernetes_tpu.storage.mvcc import TxnError
+    s = MVCCStore(str(tmp_path))
+    s.create("/k", {"v": 1})
+    before = _state_json(s)
+    recs = s.wal_records_total
+    with pytest.raises(TxnError) as ei:
+        s.txn([(ADDED, "/a", {"v": 2}, None),
+               (ADDED, "/k", {"v": 3}, None)])  # duplicate -> index 1
+    assert ei.value.index == 1
+    assert isinstance(ei.value.error, errors.AlreadyExistsError)
+    # CAS guard inside a txn: same no-trace contract.
+    with pytest.raises(TxnError) as ei2:
+        s.txn([(MODIFIED, "/k", {"v": 4}, 999)])
+    assert isinstance(ei2.value.error, errors.ConflictError)
+    assert _state_json(s) == before
+    assert s.wal_records_total == recs
+    s.close()
+    assert _state_json(_recovered(tmp_path)) == before
+
+
+async def test_txn_watch_one_round_in_order():
+    s = MVCCStore()
+    loop = asyncio.get_event_loop()
+    w = s.watch("/pods/", loop=loop)
+    s.create("/other/x", {})  # outside the prefix: filtered per event
+    s.txn([(ADDED, f"/pods/p{i}", {"i": i}, None) for i in range(4)])
+    evs = [await w.next(1) for _ in range(4)]
+    assert [e.key for e in evs] == [f"/pods/p{i}" for i in range(4)]
+    assert [e.revision for e in evs] == [2, 3, 4, 5]
+    assert [e.type for e in evs] == [ADDED] * 4
+    w.cancel()
+
+
+def _seed_batch_wal(path):
+    """Two single-record writes, then ONE 3-op batch record; returns
+    (wal lines, state before the txn, state after)."""
+    s = MVCCStore(str(path))
+    s.create("/registry/pods/default/a", {"x": 1})
+    s.update("/registry/pods/default/a", {"x": 2})
+    pre_batch = _state_json(s)
+    s.txn([(ADDED, "/registry/pods/default/b", {"y": 1}, None),
+           (ADDED, "/registry/pods/default/c", {"y": 2}, None),
+           (MODIFIED, "/registry/pods/default/a", {"x": 3}, None)])
+    full = _state_json(s)
+    s.close()
+    with open(path / "wal.jsonl") as f:
+        return f.readlines(), pre_batch, full
+
+
+def test_batch_wal_mixed_with_legacy_replays(tmp_path):
+    lines, _pre, full = _seed_batch_wal(tmp_path)
+    assert len(lines) == 3  # 2 singles + 1 batch
+    s = _recovered(tmp_path)
+    assert _state_json(s) == full
+    assert s.revision == 5
+
+
+def test_batch_wal_torn_tail_drops_whole_chunk(tmp_path):
+    lines, pre_batch, _full = _seed_batch_wal(tmp_path)
+    wal = tmp_path / "wal.jsonl"
+    # Crash mid-append of the batch record: half the frame, no newline.
+    with open(wal, "w") as f:
+        f.writelines(lines[:-1])
+        f.write(lines[-1][: len(lines[-1]) // 2])
+    s = _recovered(tmp_path)
+    # NO sub-record applied — not even the ops whose JSON survived the
+    # tear whole: the chunk is atomic on disk.
+    assert _state_json(s) == pre_batch
+    assert s.revision == 2
+    # The torn tail was truncated away, not left to poison appends.
+    with open(wal) as f:
+        assert f.readlines() == lines[:-1]
+
+
+def test_batch_wal_flipped_byte_drops_whole_chunk(tmp_path):
+    lines, pre_batch, _full = _seed_batch_wal(tmp_path)
+    bad = list(lines)
+    payload = bad[-1]
+    pos = len(payload) // 2  # inside the ops array
+    bad[-1] = (payload[:pos]
+               + ("0" if payload[pos] != "0" else "1")
+               + payload[pos + 1:])
+    with open(tmp_path / "wal.jsonl", "w") as f:
+        f.writelines(bad)
+    s = _recovered(tmp_path)
+    assert _state_json(s) == pre_batch
+    assert s.revision == 2
+
+
+def test_batch_wal_replay_idempotent(tmp_path):
+    """A resent/duplicated batch record is skipped whole (outer rev <=
+    current) — replay applies each chunk exactly once."""
+    lines, _pre, full = _seed_batch_wal(tmp_path)
+    with open(tmp_path / "wal.jsonl", "a") as f:
+        f.write(lines[-1])  # the batch record again
+    s = _recovered(tmp_path)
+    assert _state_json(s) == full
+    assert s.revision == 5
+    # And appends keep working on the recovered store.
+    s2 = MVCCStore(str(tmp_path))
+    s2.create("/registry/pods/default/d", {"z": 1})
+    s2.close()
+    assert _recovered(tmp_path).revision == 6
+
+
+def test_txn_chaos_wal_crash_recovers_identical(tmp_path):
+    """The wal:crash fault between txn commit decision and fsync: the
+    batch record never reaches disk, nothing applies in memory, and
+    recovery reproduces pre_crash_state byte-identically."""
+    import json
+    from kubernetes_tpu.chaos import core
+    s = MVCCStore(str(tmp_path))
+    for i in range(3):
+        s.create(f"/k{i}", {"i": i})
+    pre = _state_json(s)
+    c = core.arm(core.ChaosController(0, ()))
+    try:
+        c.trigger(core.SITE_WAL, "crash")
+        with pytest.raises(errors.ServiceUnavailableError):
+            s.txn([(ADDED, "/b0", {"n": 0}, None),
+                   (ADDED, "/b1", {"n": 1}, None)])
+    finally:
+        core.disarm()
+    assert s.wal_failed
+    assert json.dumps(s.pre_crash_state, sort_keys=True) == pre
+    with pytest.raises(errors.ServiceUnavailableError):
+        s.create("/never", {})  # dead disk until rebuilt
+    s2 = MVCCStore(str(tmp_path))
+    assert _state_json(s2) == pre
+    assert s2.revision == 3
+    s2.close()
+
+
+def test_txn_chaos_wal_torn_batch_frame(tmp_path):
+    """The wal:torn fault on a txn damages the ONE batch frame: replay
+    drops the whole chunk, recovery == pre-crash state."""
+    import json
+    from kubernetes_tpu.chaos import core
+    s = MVCCStore(str(tmp_path))
+    s.create("/k", {"v": 1})
+    pre = _state_json(s)
+    c = core.arm(core.ChaosController(0, ()))
+    try:
+        c.trigger(core.SITE_WAL, "torn")
+        with pytest.raises(errors.ServiceUnavailableError):
+            s.txn([(ADDED, "/b0", {"n": 0}, None),
+                   (MODIFIED, "/k", {"v": 2}, None)])
+    finally:
+        core.disarm()
+    assert json.dumps(s.pre_crash_state, sort_keys=True) == pre
+    s2 = MVCCStore(str(tmp_path))
+    assert _state_json(s2) == pre
+    s2.close()
+
+
+def test_txn_wal_replay_invariant_over_batch_path():
+    """tpusan's wal-replay (live ≡ write stream) holds across the batch
+    path: every sub-event reaches the event hooks exactly once, in
+    commit order."""
+    from kubernetes_tpu.analysis import invariants
+    reg = invariants.arm(invariants.InvariantRegistry())
+    try:
+        s = MVCCStore()
+        s.create("/registry/configmaps/default/a", {"x": 1})
+        s.txn([(ADDED, "/registry/configmaps/default/b", {"y": 1}, None),
+               (MODIFIED, "/registry/configmaps/default/a", {"x": 2}, None),
+               (DELETED, "/registry/configmaps/default/b", None, None)])
+        reg.check_final()
+    finally:
+        invariants.disarm()
+    assert reg.checks["wal-replay"] >= 1
+    assert reg.violations == []

@@ -402,3 +402,121 @@ def test_reset_from_state_cancels_watches():
         ev = await asyncio.wait_for(wch.next(1.0), 2.0)
         assert ev is None and wch.closed  # stream ended: client relists
     asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# Transactional batch writes over replication: one MVCC txn ships as ONE
+# log entry, wait_commit acks on the chunk's final revision, and the
+# follower applies the whole chunk atomically (one lock hold, one WAL
+# record, one watch round).
+# ---------------------------------------------------------------------------
+
+async def test_txn_ships_as_one_log_entry_ack_on_final_rev():
+    from kubernetes_tpu.storage.mvcc import BATCH
+    _tr, nodes = await _cluster()
+    try:
+        leader = await repl.wait_for_leader(nodes, 5.0)
+        revs = leader.store.txn(
+            [(ADDED, f"/registry/configmaps/default/t-{i}", {"v": i}, None)
+             for i in range(5)])
+        # Every chunk revision maps to the SAME buffered entry — the
+        # wire ships it once, not once per sub-record.
+        entries = {id(leader._entries[r]) for r in revs}
+        assert len(entries) == 1
+        entry = leader._entries[revs[-1]]
+        assert entry.op == BATCH and entry.rev == revs[-1]
+        assert [s["rev"] for s in entry.value["ops"]] == revs
+        # The ack gate waits on the chunk's FINAL revision.
+        await leader.wait_commit(revs[-1])
+        assert leader.commit_rev >= revs[-1]
+        await repl.wait_converged(nodes, 5.0)
+        states = [_state(n.store) for n in nodes]
+        assert states[0] == states[1] == states[2]
+        for n in nodes:
+            assert n.store.get(
+                "/registry/configmaps/default/t-4").value == {"v": 4}
+    finally:
+        await _teardown(nodes)
+
+
+async def test_txn_mixed_ops_replicate_and_converge():
+    _tr, nodes = await _cluster()
+    try:
+        leader = await repl.wait_for_leader(nodes, 5.0)
+        r0 = leader.store.create("/registry/configmaps/default/base",
+                                 {"v": 0})
+        revs = leader.store.txn([
+            (ADDED, "/registry/configmaps/default/n1", {"v": 1}, None),
+            (MODIFIED, "/registry/configmaps/default/base", {"v": 9}, r0),
+            (ADDED, "/registry/configmaps/default/n2", {"v": 2}, None),
+            (DELETED, "/registry/configmaps/default/n1", None, None),
+        ])
+        await leader.wait_commit(revs[-1])
+        await repl.wait_converged(nodes, 5.0)
+        for n in nodes:
+            assert n.store.get(
+                "/registry/configmaps/default/base").value == {"v": 9}
+            assert not n.store.exists("/registry/configmaps/default/n1")
+            # create_revision survives the replicated batch apply.
+            assert n.store.get(
+                "/registry/configmaps/default/base").create_revision == r0
+        assert _state(nodes[0].store) == _state(nodes[1].store) \
+            == _state(nodes[2].store)
+    finally:
+        await _teardown(nodes)
+
+
+def test_apply_replicated_batch_idempotent_and_partial_overlap():
+    from kubernetes_tpu.storage.mvcc import BATCH
+    subs = [{"rev": r, "op": ADDED,
+             "key": f"/registry/configmaps/d/b{r}", "value": {"v": r}}
+            for r in (1, 2, 3)]
+    store = MVCCStore()
+    assert store.apply_replicated(BATCH, "", {"ops": subs}, 3)
+    assert store.revision == 3
+    # Whole-chunk resend: no-op by the outer (final) revision.
+    assert not store.apply_replicated(BATCH, "", {"ops": subs}, 3)
+    # Partial overlap (leader resent after a single-entry apply got
+    # ahead): only the unseen suffix applies.
+    store2 = MVCCStore()
+    store2.apply_replicated(ADDED, subs[0]["key"], subs[0]["value"], 1)
+    assert store2.apply_replicated(BATCH, "", {"ops": subs}, 3)
+    assert store2.revision == 3
+    assert store2.get("/registry/configmaps/d/b3").value == {"v": 3}
+    # A gapped suffix is a protocol error, exactly like the single path.
+    store3 = MVCCStore()
+    with pytest.raises(ValueError):
+        store3.apply_replicated(BATCH, "", {"ops": subs[2:]}, 3)
+
+
+def test_apply_replicated_batch_writes_one_wal_record(tmp_path):
+    from kubernetes_tpu.storage.mvcc import BATCH
+    store = MVCCStore(str(tmp_path))
+    store.writes_blocked = "not leader"
+    subs = [{"rev": r, "op": ADDED,
+             "key": f"/registry/configmaps/d/b{r}", "value": {"v": r}}
+            for r in (1, 2)]
+    store.apply_replicated(BATCH, "", {"ops": subs}, 2, term=3)
+    assert store.wal_records_total == 1 and store.wal_ops_total == 2
+    store.fsync_now()
+    store.close()
+    recovered = MVCCStore(str(tmp_path))
+    assert _state(recovered) == _state(store)
+    # The batch entry's term survived restart as the recovered log
+    # coordinate (wal_term is the replication layer's stamping term).
+    assert recovered.recovered_term == 3
+    recovered.close()
+
+
+async def test_apply_replicated_batch_one_watch_round():
+    from kubernetes_tpu.storage.mvcc import BATCH
+    store = MVCCStore()
+    wch = store.watch("/registry/configmaps/")
+    subs = [{"rev": r, "op": ADDED,
+             "key": f"/registry/configmaps/d/b{r}", "value": {"v": r}}
+            for r in (1, 2, 3)]
+    store.apply_replicated(BATCH, "", {"ops": subs}, 3)
+    evs = [await asyncio.wait_for(wch.next(1.0), 2.0) for _ in range(3)]
+    assert [e.revision for e in evs] == [1, 2, 3]
+    assert all(e.type == ADDED for e in evs)
+    wch.cancel()

@@ -191,6 +191,25 @@ F = Counter("encode_cache_hits_total", "re-registered: silently inert")
     assert len(got) == 1 and "already registered" in got[0].message
 
 
+def test_metric_name_txn_batch_families():
+    """The transactional-batch-write metric families (mvcc_txn_*,
+    apiserver_batch_txn_*) are valid names, and a duplicate
+    registration within the family is still caught."""
+    good = """
+from kubernetes_tpu.metrics.registry import Counter
+A = Counter("mvcc_txn_commits_total", "x")
+B = Counter("mvcc_txn_ops_total", "x")
+C = Counter("apiserver_batch_txn_commits_total", "x", labels=("kind",))
+D = Counter("apiserver_batch_txn_splits_total", "x", labels=("kind",))
+"""
+    assert run_source(good, checks=["metric-name"]) == []
+    bad = good + """
+E = Counter("mvcc_txn_commits_total", "re-registered: silently inert")
+"""
+    got = run_source(bad, checks=["metric-name"])
+    assert len(got) == 1 and "already registered" in got[0].message
+
+
 def test_metric_name_preemption_and_goodput_family():
     """The graceful-preemption metric family (preemption_*, the
     goodput gauge) are valid names, and a duplicate registration
