@@ -1,0 +1,53 @@
+#!/usr/bin/env bash
+# Live-gang-migration smoke (<90s): the reserve-then-move acceptance
+# scenarios (queueing/harness.py) over an in-process control plane —
+# (1) a degraded-node taint triggers checkpoint-migration off the sick
+# host, with the seeded ``migrate`` chaos site crashing the controller
+# mid-round (the durable status.migration round must resume and still
+# land); (2) the defrag planner moves a small donor gang so a blocked
+# full-slice gang can place. Then the small-scale migration-storm gate
+# (perf/gang_bench.py): migrate goodput must be >= 2x the hard-evict
+# baseline, and the blocked gang must place with defrag on and stay
+# pending with it off.
+# Siblings: hack/preempt_smoke.sh (preemption arm), hack/chaos.sh
+# (fault arm), hack/race.sh (explored-schedule arm), hack/test.sh
+# (runs all).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+timeout -k 10 90 env JAX_PLATFORMS=cpu python - <<'EOF'
+import asyncio, json, sys
+from kubernetes_tpu.queueing.harness import run_migrate_smoke, run_defrag_smoke
+from kubernetes_tpu.perf.gang_bench import run_migration_storm_bench
+
+out = asyncio.run(run_migrate_smoke(seed=20260807, timeout=30.0))
+print(json.dumps(out))
+if out["outcome"] != "moved" or out["reason"] != "degraded-node":
+    sys.exit("migrate_smoke: degraded-node round never moved")
+if not out["off_sick_host"] or out["checkpoint_step"] <= 0:
+    sys.exit("migrate_smoke: gang not re-bound off the sick host "
+             "from a checkpoint")
+if out["crash_faults"] != 1:
+    sys.exit("migrate_smoke: crash-mid-round chaos site never fired")
+
+out = asyncio.run(run_defrag_smoke(seed=20260807, timeout=30.0))
+print(json.dumps(out))
+if out["donor_outcome"] != "moved" or out["donor_reason"] != "defrag":
+    sys.exit("migrate_smoke: defrag round never moved the donor")
+if out["big_bound"] < 16:
+    sys.exit("migrate_smoke: blocked gang never placed after defrag")
+
+storm = asyncio.run(run_migration_storm_bench(2, timeout=30.0,
+                                              placement_runs=1))
+print(json.dumps(storm))
+if storm["migrate"]["goodput"] < 2 * max(storm["evict"]["goodput"], 0.01):
+    sys.exit(f"migrate_smoke: goodput gate failed "
+             f"(migrate {storm['migrate']['goodput']} vs "
+             f"evict {storm['evict']['goodput']})")
+blocked = storm["blocked_gang"]
+if blocked["defrag_on_placed"] < 1 or blocked["defrag_off_placed"]:
+    sys.exit("migrate_smoke: time-to-placement gate failed "
+             f"(defrag on placed {blocked['defrag_on_placed']}, "
+             f"off placed {blocked['defrag_off_placed']})")
+EOF
+echo "migrate_smoke: ok"

@@ -16,10 +16,13 @@
 #   3. tpusan over the two-tenant queue smoke — the fair-share
 #      admission/reclaim path under explored schedules.
 #   4. tpusan over the graceful-preemption storm.
-#   5. tpusan over the kill-the-leader HA scenario — quorum WAL
+#   5. tpusan over live gang-migration rounds — degraded-node
+#      evacuation with the controller crashed mid-round, the
+#      migration-no-strand invariant checked on every group write.
+#   6. tpusan over the kill-the-leader HA scenario — quorum WAL
 #      replication with the election-safety and committed-never-lost
 #      invariants checked live.
-#   6. tpusan over the SCALE-OUT HA scenario — resource-group sharded
+#   7. tpusan over the SCALE-OUT HA scenario — resource-group sharded
 #      apiserver workers (inline dispatch under tpusan) + follower
 #      read/watch affinity + queue-admission traffic, asserting ALL
 #      EIGHT invariants exercised and byte-identical convergence facts.
@@ -35,10 +38,10 @@ cd "$(dirname "$0")/.."
 
 SEED="${TPU_SAN:-20260804}"
 
-echo "=== 1/6 tpuvet: static analysis tree-clean ==="
+echo "=== 1/7 tpuvet: static analysis tree-clean ==="
 python -m kubernetes_tpu.analysis kubernetes_tpu
 
-echo "=== 2/6 tpusan: chaos convergence x8 schedules (lockdep + mutation detector + loopsan armed) ==="
+echo "=== 2/7 tpusan: chaos convergence x8 schedules (lockdep + mutation detector + loopsan armed) ==="
 # TPU_LOOPSAN=1 rides along on this stage: kloopsan times every loop
 # callback and the gate asserts ZERO threshold violations on this
 # small deterministic scenario (a >100ms callback here is a real
@@ -91,7 +94,7 @@ if viol:
              f"{snap['threshold_ms']:.0f}ms on a deterministic scenario")
 EOF
 
-echo "=== 3/6 tpusan: queue smoke x2 schedules ==="
+echo "=== 3/7 tpusan: queue smoke x2 schedules ==="
 timeout -k 10 90 env JAX_PLATFORMS=cpu TPU_SAN= \
     TPU_LOCKDEP=1 TPU_CACHE_MUTATION_DETECTOR=1 python - "$SEED" <<'EOF'
 import json, sys
@@ -103,7 +106,7 @@ if not all(r["reclaimed_gangs"] for r in rep["schedules"]):
     sys.exit("tpusan: reclaim did not run on every schedule")
 EOF
 
-echo "=== 4/6 tpusan: graceful-preemption storm x4 schedules ==="
+echo "=== 4/7 tpusan: graceful-preemption storm x4 schedules ==="
 # Mid-checkpoint member crash + shrink + regrow, byte-identical
 # convergence facts asserted across every explored schedule
 # (run_preempt_smoke_schedules raises on any divergence).
@@ -118,7 +121,31 @@ if not rep["invariant_checks"].get("checkpoint-monotonic"):
     sys.exit("tpusan: checkpoint-monotonic never exercised")
 EOF
 
-echo "=== 5/6 tpusan: kill-the-leader HA x4 schedules ==="
+echo "=== 5/7 tpusan: live-migration rounds x4 schedules ==="
+# Degraded-node evacuation with the seeded ``migrate`` chaos site
+# crashing the controller mid-round on every schedule: the durable
+# status.migration round must resume from status+cache alone and the
+# gang must land off the sick host from a checkpoint. The
+# migration-no-strand invariant (reservation never overlapping the
+# gang's own bound chips; no open round left holding neither a
+# placement nor a reservation) is checked on every group write.
+# Convergence facts byte-identical across schedules
+# (run_migrate_smoke_schedules raises on divergence).
+timeout -k 10 120 env JAX_PLATFORMS=cpu TPU_SAN= TPU_CHAOS= \
+    TPU_LOCKDEP=1 TPU_CACHE_MUTATION_DETECTOR=1 python - "$SEED" <<'EOF'
+import json, sys
+from kubernetes_tpu.queueing.harness import run_migrate_smoke_schedules
+
+rep = run_migrate_smoke_schedules(sys.argv[1], schedules=4)
+print(json.dumps({k: v for k, v in rep.items() if k != "schedules"}))
+if not rep["invariant_checks"].get("migration-no-strand"):
+    sys.exit("tpusan: migration-no-strand never exercised")
+if rep["distinct_fingerprints"] < 4:
+    sys.exit(f"tpusan: only {rep['distinct_fingerprints']} distinct "
+             f"schedules explored, want 4")
+EOF
+
+echo "=== 6/7 tpusan: kill-the-leader HA x4 schedules ==="
 # The replicated-control-plane scenario (3 replicas, leader crashed
 # mid-wave) under explored interleavings: election-safety and
 # committed-never-lost checked on every run, convergence facts
@@ -138,7 +165,7 @@ if rep["facts"]["acked_lost"]:
     sys.exit("tpusan: acknowledged writes lost under exploration")
 EOF
 
-echo "=== 6/6 tpusan: scale-out HA (sharded + follower reads + queued) x4 schedules ==="
+echo "=== 7/7 tpusan: scale-out HA (sharded + follower reads + queued) x4 schedules ==="
 # The PR-9 path: resource-group sharded apiserver workers (inline
 # dispatch under tpusan — the explorer owns the one loop), client
 # follower read/watch affinity with the bounded-staleness leader

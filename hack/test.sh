@@ -8,6 +8,10 @@
 # hack/queue_smoke.sh (<60s two-tenant fair-share admission smoke),
 # hack/preempt_smoke.sh (<60s graceful-preemption storm: signal,
 # checkpoint, shrink, regrow, converge + the goodput gate),
+# hack/migrate_smoke.sh (<90s live gang migration: degraded-node
+# checkpoint-evacuation with the controller crashed mid-round, the
+# defrag donor move unblocking a full-slice gang, and the
+# migration-storm goodput + time-to-placement gates),
 # hack/ha_smoke.sh (<90s replicated control plane: kill the leader
 # mid-wave, standby elected, zero acked writes lost, byte-identical
 # convergence), hack/trace_smoke.sh (ktrace gate: a LocalCluster gang
@@ -40,6 +44,7 @@ if [ "$#" -eq 0 ] || [ "${KTPU_SMOKE:-}" = "1" ]; then
   ./hack/chaos.sh
   ./hack/queue_smoke.sh
   ./hack/preempt_smoke.sh
+  ./hack/migrate_smoke.sh
   ./hack/ha_smoke.sh
   ./hack/trace_smoke.sh
   ./hack/serve_smoke.sh

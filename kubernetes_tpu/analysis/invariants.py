@@ -86,6 +86,20 @@ WAL_REPLAY = "wal-replay"
 #: Evaluated on every podgroup write (trivially when no preemption
 #: state exists), so the check counter moves with ordinary traffic.
 CHECKPOINT_MONOTONIC = "checkpoint-monotonic"
+#: A gang with an OPEN migration round (status.migration.phase in
+#: Reserved/Moving) always holds its source placement OR its target
+#: reservation — a controller that evicted the gang and lost (or
+#: released) the reserved box has stranded it: the "migration" was an
+#: eviction in disguise. And never BOTH charged on the same chips: the
+#: target reservation overlapping the gang's own bound chips would
+#: double-count capacity. Reservations reach the sanitizer through
+#: the cache seams (:func:`note_reservation` /
+#: :func:`note_reservation_gone`); like gang-atomicity the strand
+#: verdict is revision-graced, since the scheduler legally releases
+#: the reservation a few writes before the binds land. Evaluated on
+#: every podgroup write (trivially when no migration state exists), so
+#: the check counter moves with ordinary traffic.
+MIGRATION_NO_STRAND = "migration-no-strand"
 #: At most ONE replica leads any raft term (storage/replication.py
 #: announces every election win via :func:`note_leader`): two leaders
 #: in one term means split-brain — both would accept and ack writes
@@ -105,7 +119,8 @@ COMMITTED_NEVER_LOST = "committed-never-lost"
 REPLICATION_INVARIANTS = (ELECTION_SAFETY, COMMITTED_NEVER_LOST)
 
 CORE_INVARIANTS = (CHIP_DOUBLE_BOOK, QUOTA_CONSERVATION, GANG_ATOMICITY,
-                   ADMISSION_MONOTONICITY, WAL_REPLAY, CHECKPOINT_MONOTONIC)
+                   ADMISSION_MONOTONICITY, WAL_REPLAY, CHECKPOINT_MONOTONIC,
+                   MIGRATION_NO_STRAND)
 
 INVARIANTS = CORE_INVARIANTS + REPLICATION_INVARIANTS
 
@@ -195,6 +210,9 @@ class _StoreState:
         self.lqs: dict = {}             # "ns/name" -> cluster queue name
         self.usage: dict = {}           # cq name -> {resource: charged}
         self.partial_since: dict = {}   # gang key -> revision when partial
+        #: gang key -> revision when its open migration round first held
+        #: NEITHER a placement nor a reservation (migration-no-strand).
+        self.strand_since: dict = {}
         #: The write-stream replay: key -> (canonical value JSON,
         #: mod_rev, create_rev). Serialized at write time so a later
         #: in-place mutation of the stored dict cannot drag the shadow
@@ -235,6 +253,12 @@ class InvariantRegistry:
         self._stores: list[_StoreState] = []
         #: Announced reclaims: unadmits these keys may legally perform.
         self._reclaim_ok: set = set()
+        #: Live scheduler-cache reservations: owner (gang key) ->
+        #: set[(node, chip_id)]. Fed by the cache reserve/release seams
+        #: (TTL expiry flows through release_reservation, so one seam
+        #: covers it); registry-level because reservations are cache
+        #: state, not store state.
+        self._reservations: dict[str, set] = {}
         #: (invariant, key) already reported — one violation per site,
         #: not one per write that re-observes it.
         self._reported: set = set()
@@ -264,6 +288,21 @@ class InvariantRegistry:
         admitted->pending flip of ``group_key`` is legal."""
         self._reclaim_ok.add(group_key)
 
+    def note_reservation(self, owner: str, pairs) -> None:
+        """SchedulerCache.reserve announces a reservation (owner is a
+        gang key, pairs are (node, chip_id) tuples): re-evaluate the
+        owner's migration hold set in every attached store."""
+        self._reservations[owner] = {tuple(p) for p in pairs}
+        for st in self._stores:
+            self._update_strand(st, owner, st.store.revision)
+
+    def note_reservation_gone(self, owner: str) -> None:
+        """SchedulerCache.release_reservation (explicit release AND
+        TTL expiry — both flow through the one seam)."""
+        if self._reservations.pop(owner, None) is not None:
+            for st in self._stores:
+                self._update_strand(st, owner, st.store.revision)
+
     def reseed_store(self, store) -> None:
         """A snapshot install (MVCCStore.reset_from_state) replaced the
         store's contents wholesale, outside the event stream: rebuild
@@ -281,6 +320,7 @@ class InvariantRegistry:
             st.lqs.clear()
             st.usage.clear()
             st.partial_since.clear()
+            st.strand_since.clear()
             st.shadow.clear()
             for key, obj in list(store._data.items()):
                 st.shadow[key] = (_canon(obj.value), obj.mod_revision,
@@ -505,6 +545,7 @@ class InvariantRegistry:
                 self._uncharge(st, prev["cq"], prev["demand"])
             self._reclaim_ok.discard(gk)
             st.partial_since.pop(gk, None)
+            st.strand_since.pop(gk, None)
             return
         self._apply_group(st, gk, ev.value, ev.revision, check=True)
 
@@ -525,9 +566,11 @@ class InvariantRegistry:
         # preempted on its first step) and must stay distinguishable
         # from "never recorded" (-1), or a rewind from 0 goes unseen.
         step = int(step_raw) if isinstance(step_raw, (int, float)) else -1
+        mig = status.get("migration") or {}
         cur = {"admitted": admitted, "cq": cq, "demand": _demand(value),
                "min_member": int(spec.get("min_member", 0) or 0),
-               "ckpt_step": step}
+               "ckpt_step": step,
+               "migration_open": mig.get("phase") in ("Reserved", "Moving")}
         prev = st.groups.get(gk)
         st.groups[gk] = cur
         if check:
@@ -538,6 +581,7 @@ class InvariantRegistry:
                     f"status.preemption.checkpoint_step rewound "
                     f"{prev.get('ckpt_step')} -> {step}: the gang's "
                     f"recorded resume point must only ever rise")
+            self.checks[MIGRATION_NO_STRAND] += 1
         self._update_partial(st, gk, revision)
         if prev is None:
             if admitted and cq:
@@ -610,6 +654,41 @@ class InvariantRegistry:
             st.partial_since.setdefault(gk, revision)
         else:
             st.partial_since.pop(gk, None)
+        self._update_strand(st, gk, revision)
+
+    # -- migration-no-strand ----------------------------------------------
+
+    def _update_strand(self, st: _StoreState, gk: str,
+                       revision: int) -> None:
+        """Re-evaluate the migrating gang's hold set after any change
+        to its bound members, its migration phase, or its reservation.
+        BOTH-charged (reservation overlapping the gang's own bound
+        chips) fires immediately; holding NEITHER starts the
+        revision-graced strand clock (the scheduler releases the
+        reservation a few writes before the binds land)."""
+        info = st.groups.get(gk)
+        if not info or not info.get("migration_open"):
+            st.strand_since.pop(gk, None)
+            return
+        res_pairs = self._reservations.get(gk) or set()
+        bound = st.bound_by_gang.get(gk) or set()
+        if res_pairs:
+            held = set()
+            for pk in bound:
+                held |= st.pod_chips.get(pk, set())
+            overlap = held & res_pairs
+            if overlap:
+                node, cid = sorted(overlap)[0]
+                self._violate(
+                    MIGRATION_NO_STRAND, gk, revision,
+                    f"migration round holds BOTH: target reservation "
+                    f"overlaps {len(overlap)} chip(s) the gang is "
+                    f"still bound to (e.g. {cid} on {node}) — the "
+                    f"same capacity is charged twice")
+        if not bound and not res_pairs:
+            st.strand_since.setdefault(gk, revision)
+        else:
+            st.strand_since.pop(gk, None)
 
     def _check_partials(self, st: _StoreState, revision: int) -> None:
         self.checks[GANG_ATOMICITY] += 1
@@ -622,6 +701,15 @@ class InvariantRegistry:
                     f"gang partially bound ({bound}/{need}) while the "
                     f"store advanced {revision - since} revisions "
                     f"(> {self.partial_grace_revs} quorum grace)")
+        for gk, since in list(st.strand_since.items()):
+            if revision - since > self.partial_grace_revs:
+                self._violate(
+                    MIGRATION_NO_STRAND, gk, revision,
+                    f"gang with an open migration round holds NEITHER "
+                    f"its source placement nor its target reservation "
+                    f"for {revision - since} revisions "
+                    f"(> {self.partial_grace_revs} grace) — stranded: "
+                    f"the migration degraded to an eviction")
 
     # -- final checks -----------------------------------------------------
 
@@ -693,6 +781,20 @@ def note_reclaim(group_key: str) -> None:
     sanitizer is armed."""
     if SANITIZER is not None:
         SANITIZER.note_reclaim(group_key)
+
+
+def note_reservation(owner: str, pairs) -> None:
+    """Seam for SchedulerCache.reserve; no-op unless armed."""
+    if SANITIZER is not None:
+        SANITIZER.note_reservation(owner, pairs)
+
+
+def note_reservation_gone(owner: str) -> None:
+    """Seam for SchedulerCache.release_reservation (covers TTL expiry
+    too — _live_reservations expires through release); no-op unless
+    armed."""
+    if SANITIZER is not None:
+        SANITIZER.note_reservation_gone(owner)
 
 
 def note_store_reset(store) -> None:

@@ -863,6 +863,18 @@ PREEMPT_SIGNAL_MODES = (PREEMPT_SIGNAL_FILE, PREEMPT_SIGNAL_TERM,
 #: agent delivers the in-container signal when it appears.
 PREEMPT_ANNOTATION = "preemption.tpu/checkpoint-by"
 
+#: Live-migration round phases (status.migration.phase). A round is
+#: OPEN in Reserved/Moving and CLOSED ("") otherwise; outcome records
+#: how the last round ended.
+MIGRATE_RESERVED = "Reserved"   # target box reserved, gang not signaled
+MIGRATE_MOVING = "Moving"       # checkpoint round in flight / requeued
+MIGRATE_PHASES = ("", MIGRATE_RESERVED, MIGRATE_MOVING)
+
+#: Why a migration round was opened (status.migration.reason).
+MIGRATE_REASON_DEGRADED = "degraded-node"   # sick-chip taint evacuation
+MIGRATE_REASON_DEFRAG = "defrag"            # fragmentation consolidation
+MIGRATE_REASONS = (MIGRATE_REASON_DEGRADED, MIGRATE_REASON_DEFRAG)
+
 
 @dataclass
 class CheckpointSpec:
@@ -908,6 +920,38 @@ class PreemptionStatus:
     #: "deadline" (timed out into the legacy kill).
     outcome: str = ""
     #: Completed graceful rounds — observability + revision stamp.
+    rounds: int = 0
+
+
+@dataclass
+class MigrationStatus:
+    """Durable live-migration round state (status.migration): rides
+    the WAL like preemption state, so a crashed MigrationController
+    resumes or aborts an open round instead of stranding the gang
+    (tpusan invariant migration-no-strand)."""
+
+    #: "" | Reserved | Moving (MIGRATE_PHASES).
+    phase: str = ""
+    #: Why this round opened: degraded-node | defrag.
+    reason: str = ""
+    #: Slice the reserved target box lives on.
+    target_slice: str = ""
+    #: Mesh coords of the reserved target box, as "x,y,z" strings
+    #: (JSON-stable; a crashed controller re-carves the reservation
+    #: from these).
+    target_cells: list[str] = field(default_factory=list)
+    #: Nodes hosting the target box — the chaos target-node-down kind
+    #: kills one of these between reserve and bind.
+    target_nodes: list[str] = field(default_factory=list)
+    #: When the round opened; unix deadline past which the controller
+    #: aborts the round (close status, release reservation).
+    started_time: Optional[datetime.datetime] = None
+    deadline: float = 0.0
+    #: When the last round closed — the per-gang cooldown anchor.
+    finished_time: Optional[datetime.datetime] = None
+    #: Why the last round ended: "moved" | "aborted" | "no-target".
+    outcome: str = ""
+    #: Completed migration rounds (moved or aborted) — observability.
     rounds: int = 0
 
 
@@ -972,6 +1016,8 @@ class PodGroupStatus:
     admission_cluster_queue: str = ""
     #: Graceful-preemption protocol state (None until first signaled).
     preemption: Optional[PreemptionStatus] = None
+    #: Live-migration round state (None until first migration).
+    migration: Optional[MigrationStatus] = None
     #: Elastic target size (member count the scheduler may bind up
     #: to). 0 on non-elastic gangs; set to max_replicas at admission,
     #: lowered to min_replicas by reclaim shrink, raised again by the

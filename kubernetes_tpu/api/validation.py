@@ -384,6 +384,22 @@ def validate_podgroup(pg: t.PodGroup, is_create: bool = True) -> None:
         if ck.signal not in t.PREEMPT_SIGNAL_MODES:
             errs.add("spec.checkpoint.signal",
                      f"must be one of {t.PREEMPT_SIGNAL_MODES}")
+    mig = pg.status.migration
+    if mig is not None:
+        if mig.phase not in t.MIGRATE_PHASES:
+            errs.add("status.migration.phase",
+                     f"must be one of {t.MIGRATE_PHASES}")
+        if mig.reason and mig.reason not in t.MIGRATE_REASONS:
+            errs.add("status.migration.reason",
+                     f"must be one of {t.MIGRATE_REASONS}")
+        if mig.rounds < 0:
+            errs.add("status.migration.rounds", "must be >= 0")
+        if mig.phase and not mig.target_cells:
+            # An open round without a recorded target box is
+            # unrecoverable after a controller crash — the resume
+            # sweep could neither re-carve nor verify the reservation.
+            errs.add("status.migration.target_cells",
+                     "required while a round is open")
     mn, mx = pg.spec.min_replicas, pg.spec.max_replicas
     if (mn == 0) != (mx == 0):
         errs.add("spec.min_replicas",

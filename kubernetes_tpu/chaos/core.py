@@ -65,9 +65,11 @@ SITE_HEARTBEAT = "heartbeat"
 SITE_DEVICE = "deviceplugin"
 SITE_PREEMPT = "preempt"
 SITE_REPL = "repl"
+SITE_MIGRATE = "migrate"
 
 SITES = (SITE_REST, SITE_WATCH_REST, SITE_WATCH_STORE, SITE_WAL,
-         SITE_HEARTBEAT, SITE_DEVICE, SITE_PREEMPT, SITE_REPL)
+         SITE_HEARTBEAT, SITE_DEVICE, SITE_PREEMPT, SITE_REPL,
+         SITE_MIGRATE)
 
 KINDS = {
     SITE_REST: ("error", "http500", "hang", "slow"),
@@ -88,6 +90,13 @@ KINDS = {
     # itself is harness-controlled (ReplicaNode.crash()), like the WAL
     # crash trigger.
     SITE_REPL: ("drop", "delay", "partition"),
+    # Live-migration rounds (controllers/migrate.py): "crash-mid-round"
+    # kills the controller sweep right after the reservation + durable
+    # status write land (the resume path must finish or abort the round
+    # from status alone); "target-node-down" deletes one target-box
+    # node between reserve and bind (the round must abort cleanly —
+    # close status BEFORE releasing the reservation — never strand).
+    SITE_MIGRATE: ("crash-mid-round", "target-node-down"),
 }
 
 FAULTS_INJECTED = Counter(

@@ -273,6 +273,46 @@ async def cmd_describe(args) -> int:
         await client.close()
 
 
+def _stamp_age(ts) -> str:
+    if ts is None:
+        return "-"
+    from ..api.meta import now as _meta_now
+    return printers.age_seconds((_meta_now() - ts).total_seconds())
+
+
+async def cmd_migrations(args) -> int:
+    """``ktl migrations`` — live gang-migration rounds and recent
+    outcomes (``status.migration`` across PodGroups): the operator's
+    one-stop answer to "is the fleet moving gangs right now, and why"."""
+    client = make_client(args)
+    try:
+        groups, _ = await client.list("podgroups", args.namespace)
+        rows = []
+        for g in sorted(groups, key=lambda g: (g.metadata.namespace,
+                                               g.metadata.name)):
+            mig = g.status.migration
+            if mig is None or (not mig.phase and not mig.outcome):
+                continue
+            phase = mig.phase or "Idle"
+            target = (f"{mig.target_slice}"
+                      f"[{len(mig.target_cells)} chips]"
+                      if mig.target_slice else "-")
+            rows.append([
+                g.metadata.namespace, g.metadata.name, phase,
+                mig.reason or "-", target,
+                mig.outcome or "-", str(mig.rounds),
+                _stamp_age(mig.finished_time or mig.started_time)])
+        if not rows:
+            print("No migration activity found.")
+            return 0
+        print(printers.render_table(
+            ["NAMESPACE", "GANG", "PHASE", "REASON", "TARGET",
+             "LAST-OUTCOME", "ROUNDS", "AGE"], rows))
+        return 0
+    finally:
+        await client.close()
+
+
 #: Marks objects as ktl-applied; prune only ever deletes objects
 #: carrying it (reference: kubectl.kubernetes.io/last-applied-
 #: configuration gating apply --prune).
@@ -1455,6 +1495,7 @@ async def _top_nodes(client) -> int:
     from ..monitoring.aggregator import ClusterMonitor
     rows = []
     per_pod: dict = {}
+    fresh_aggs: dict = {}
     summaries = await _node_summaries(client)
     stale_info: dict = {}
     if any(summary is None for _node, summary in summaries):
@@ -1484,6 +1525,7 @@ async def _top_nodes(client) -> int:
                 "stale"])
             continue
         agg = ClusterMonitor._aggregate_node(name, summary, per_pod)
+        fresh_aggs[name] = agg
         rows.append([
             name,
             str(agg["chips"]),
@@ -1500,6 +1542,23 @@ async def _top_nodes(client) -> int:
     print(printers.render_table(
         ["NODE", "CHIPS", "HEALTHY", "ASSIGNED", "DUTY", "HBM",
          "TOK/S", "AGE", "WORKLOAD"], rows))
+    # Per-slice fragmentation footer — the same rollup the aggregator
+    # exports as tpu_slice_fragmentation and the defrag planner scores
+    # moves with (stale/unreachable nodes' chips are absent here, so a
+    # half-scraped fleet reads "-" rather than a wrong number).
+    frag = ClusterMonitor._fragmentation(fresh_aggs)
+    if frag["slices"]:
+        frows = [[sid, str(rec["free_chips"]),
+                  str(rec["largest_free_box"]),
+                  f"{rec['fragmentation']:.2f}"]
+                 for sid, rec in frag["slices"].items()]
+        if len(frag["slices"]) > 1:
+            frows.append(["(cluster)", str(frag["free_chips"]),
+                          str(frag["largest_free_box"]),
+                          f"{frag['cluster']:.2f}"])
+        print()
+        print(printers.render_table(
+            ["SLICE", "FREE", "LARGEST-BOX", "FRAG"], frows))
     return 0
 
 
@@ -3034,6 +3093,11 @@ def build_parser() -> argparse.ArgumentParser:
                                   "('nodes'/'pods' = TPU telemetry views)")
     sp.add_argument("node", nargs="?", default="")
 
+    sp = add("migrations", cmd_migrations,
+             help="live gang-migration rounds and recent outcomes")
+    sp.add_argument("-n", "--namespace", default="",
+                    help="namespace ('' = all namespaces)")
+
     sp = add("trace", cmd_trace,
              help="render a pod's (or gang's) ktrace lifecycle timeline")
     sp.add_argument("kind", choices=["pod", "gang"])
@@ -3225,7 +3289,30 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv: Optional[list[str]] = None) -> int:
-    args = build_parser().parse_args(argv)
+    argv = list(sys.argv[1:] if argv is None else argv)
+    args = None
+    if "--" in argv:
+        # argparse cannot fill a trailing nargs="*" positional once
+        # options sit between it and the subcommand (bpo-13922):
+        # ``run NAME --image IMG -- CMD...`` dies with "unrecognized
+        # arguments". Trial-parse the head and hand the tail to verbs
+        # that take a command; anything else falls through to the
+        # plain parse (exec's contiguous ``NAME -- CMD`` form already
+        # works there).
+        import contextlib
+        import io
+        i = argv.index("--")
+        head, tail = argv[:i], argv[i + 1:]
+        try:
+            with contextlib.redirect_stderr(io.StringIO()):
+                cand = build_parser().parse_args(head)
+        except SystemExit:
+            cand = None
+        if cand is not None and hasattr(cand, "cmd"):
+            cand.cmd = list(cand.cmd or []) + tail
+            args = cand
+    if args is None:
+        args = build_parser().parse_args(argv)
     try:
         return asyncio.run(args.fn(args))
     except errors.StatusError as e:

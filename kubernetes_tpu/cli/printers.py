@@ -126,17 +126,20 @@ def _elastic_size(pg) -> str:
 def _podgroups_table(objs: list, wide: bool) -> str:
     headers = ["NAME", "MIN-MEMBER", "PHASE", "AGE"]
     if wide:
-        headers += ["SIZE", "PREEMPTION", "CKPT-STEP"]
+        headers += ["SIZE", "PREEMPTION", "CKPT-STEP", "MIGRATION"]
     rows = []
     for o in objs:
         row = [o.metadata.name, o.spec.min_member,
                getattr(o.status, "phase", ""), age(o.metadata)]
         if wide:
             st = o.status.preemption
+            mig = getattr(o.status, "migration", None)
             row += [_elastic_size(o),
                     (st.phase or "<none>") if st else "<none>",
                     (st.checkpoint_step if st and st.checkpoint_step >= 0
-                     else "<none>") if st else "<none>"]
+                     else "<none>") if st else "<none>",
+                    (mig.phase or mig.outcome or "<none>")
+                    if mig else "<none>"]
         rows.append(row)
     return render_table(headers, rows)
 
@@ -166,6 +169,19 @@ def describe_podgroup(pg) -> str:
         if st.signaled:
             lines.append(f"Signaled: {len(st.checkpointed)}/"
                          f"{len(st.signaled)} members checkpointed")
+    mig = getattr(pg.status, "migration", None)
+    if mig is not None and (mig.phase or mig.outcome):
+        line = (f"Migration: phase={mig.phase or '<idle>'} "
+                f"rounds={mig.rounds}")
+        if mig.reason:
+            line += f" reason={mig.reason}"
+        if mig.outcome:
+            line += f" outcome={mig.outcome}"
+        lines.append(line)
+        if mig.target_slice:
+            lines.append(f"Migration target: {mig.target_slice} "
+                         f"({len(mig.target_cells)} chips on "
+                         f"{len(mig.target_nodes)} nodes)")
     lines.append("")
     return "\n".join(lines) + _describe_fields(pg)
 

@@ -109,6 +109,7 @@ class LocalCluster:
                  monitor_interval: float = 10.0,
                  autoscale_interval: float = 2.0,
                  metrics_interval: float = 5.0,
+                 migration_interval: float = 5.0,
                  authorization_mode: str = "AlwaysAllow",
                  user_groups: Optional[dict] = None,
                  audit_log: str = "",
@@ -136,6 +137,9 @@ class LocalCluster:
         #: kmon scrape/rule cadence (mon_smoke shortens it); only read
         #: when the ClusterMetricsPipeline gate is on.
         self.metrics_interval = metrics_interval
+        #: Migration-controller sweep cadence (migrate smokes shorten
+        #: it); only acted on when the GangLiveMigration gate is on.
+        self.migration_interval = migration_interval
         self.authorization_mode = authorization_mode
         self.user_groups = user_groups
         self.audit_log = audit_log
@@ -278,6 +282,10 @@ class LocalCluster:
         self.controller_manager = ControllerManager(
             local, node_scrape_ssl=scrape_ssl,
             queueing_fits_probe=self._queueing_fits_probe,
+            # Migration needs the LIVE scheduler cache (reservations +
+            # slice geometry) — same single-binary wiring as backfill.
+            migration_cache_probe=lambda: self.scheduler.cache,
+            migration_interval=self.migration_interval,
             monitor_interval=self.monitor_interval,
             autoscale_interval=self.autoscale_interval,
             metrics_interval=self.metrics_interval,

@@ -112,6 +112,21 @@ def _cluster_monitor(client, factory, **kw):
 DEFAULT_CONTROLLERS["cluster-monitor"] = _cluster_monitor
 
 
+def _migration_controller(client, factory, **kw):
+    # Lazy like the monitor: migration machinery is only paid for when
+    # built (the controller is inert with the GangLiveMigration gate
+    # off).
+    from .migrate import MigrationController
+    return MigrationController(client, factory, **kw)
+
+
+#: Live gang migration + defragmentation (controllers/migrate.py):
+#: reserve-then-move gangs off degraded nodes and consolidate small
+#: gangs for large pending ones; inert unless the GangLiveMigration
+#: gate is on.
+DEFAULT_CONTROLLERS["migration"] = _migration_controller
+
+
 def _metrics_pipeline(client, factory, **kw):
     # Lazy like the monitor: kmon machinery is only paid for when the
     # ClusterMetricsPipeline gate is on (the controller is inert off).
@@ -129,6 +144,8 @@ class ControllerManager:
     def __init__(self, client: Client, controllers: Optional[list[str]] = None,
                  leader_elect: bool = False, identity: str = "",
                  node_scrape_ssl=None, queueing_fits_probe=None,
+                 migration_cache_probe=None,
+                 migration_interval: float = 5.0,
                  monitor_interval: float = 10.0,
                  autoscale_interval: float = 2.0,
                  metrics_interval: float = 5.0,
@@ -141,6 +158,11 @@ class ControllerManager:
         #: single-binary composer wires the live scheduler cache so
         #: backfill only jumps when a free box actually exists).
         self.queueing_fits_probe = queueing_fits_probe
+        #: Live-scheduler-cache probe for the migration controller —
+        #: reserve-then-move needs the real cache (reservations + slice
+        #: geometry); without it the controller does nothing.
+        self.migration_cache_probe = migration_cache_probe
+        self.migration_interval = migration_interval
         #: Cluster-monitor sweep cadence + inference autoscaler tick
         #: (smokes shorten both; production keeps the defaults).
         self.monitor_interval = monitor_interval
@@ -175,6 +197,11 @@ class ControllerManager:
                 self.client, ssl_context=self.node_scrape_ssl)}
         if name == "job-queueing" and self.queueing_fits_probe is not None:
             return {"fits_probe": self.queueing_fits_probe}
+        if name == "migration":
+            kw = {"interval": self.migration_interval}
+            if self.migration_cache_probe is not None:
+                kw["cache_probe"] = self.migration_cache_probe
+            return kw
         if name == "cluster-monitor":
             kw = {"interval": self.monitor_interval}
             if self.node_scrape_ssl is not None:

@@ -1,0 +1,591 @@
+"""Live gang migration + defragmentation — move gangs BEFORE chips die.
+
+The machinery this controller composes already exists: graceful
+preemption (signal -> checkpoint -> requeue, ``preemption.py``), durable
+recovery rounds (TrainJob resume), and kmon's alert -> taint pipeline
+(``tpu.google.com/degraded`` — "the migration seam"). What was missing
+is the consumer: today a TpuChipSick alert taints a node and the gang
+on it sits there until the chip actually dies, taking unsaved steps
+with it. Kant's defragmentation story (PAPERS.md) is the second
+trigger: small gangs consolidate onto open contiguous boxes so large
+pending gangs can place.
+
+**Reserve-then-move.** A migration round reserves the target
+contiguous sub-mesh in the scheduler cache FIRST (``cache.reserve``,
+owner = the gang key), writes durable ``status.migration`` round state
+(rides the WAL — a crashed controller resumes or aborts from status
+alone), and only then signals the gang through the shared preemption
+engine. A migration with no landing spot degrades to *do nothing* —
+never to an eviction in disguise. The scheduler steers the requeued
+gang onto its own reserved box (``restrict_to`` in ``plan_gang``)
+and releases the reservation when the plan
+lands, so the gang holds its source placement or its target
+reservation at every revision (the tpusan ``migration-no-strand``
+invariant). Abort paths close status BEFORE releasing the reservation
+for the same reason.
+
+**Budget.** ``max_concurrent`` bounds open rounds fleet-wide;
+``cooldown_seconds`` spaces rounds per gang; a gang already inside a
+graceful-preemption round (Signaled/Checkpointing) is never migrated
+on top of it.
+
+Everything is inert while the ``GangLiveMigration`` gate is off — no
+watches consumed, no reservations, no status writes; byte-identical
+to the ungated build.
+"""
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import logging
+import time
+from typing import Callable, Optional
+
+from .. import preemption as gp
+from ..api import errors
+from ..api import types as t
+from ..api.meta import now as meta_now
+from ..client.informer import InformerFactory
+from ..client.interface import Client
+from ..metrics.registry import Counter, Gauge
+from ..monitoring.rules import TAINT_DEGRADED
+from .base import Controller
+
+log = logging.getLogger("migrate")
+
+#: migration_* metric families (the tpuvet fixture set).
+ROUNDS_TOTAL = Counter(
+    "migration_rounds_total",
+    "completed migration rounds by trigger and outcome",
+    labels=("reason", "outcome"))
+ROUNDS_OPEN = Gauge(
+    "migration_rounds_open",
+    "migration rounds currently open (Reserved or Moving)")
+NO_TARGET_TOTAL = Counter(
+    "migration_no_target_total",
+    "migrations skipped because no landing spot existed (the required "
+    "degrade-to-no-op, never an eviction in disguise)",
+    labels=("reason",))
+DEFRAG_GAIN_CHIPS = Gauge(
+    "migration_defrag_gain_chips",
+    "largest-free-box volume gain of the last planned defrag move")
+
+
+def _gated() -> bool:
+    from ..util.features import GATES
+    return GATES.enabled("GangLiveMigration")
+
+
+def _round_open(group: t.PodGroup) -> bool:
+    mig = group.status.migration
+    return mig is not None and mig.phase in (t.MIGRATE_RESERVED,
+                                             t.MIGRATE_MOVING)
+
+
+def _cell_key(coord) -> str:
+    return ",".join(str(int(c)) for c in coord)
+
+
+def _parse_cells(cells: list[str]) -> list[tuple]:
+    return [tuple(int(x) for x in s.split(",")) for s in cells]
+
+
+def _chaos_fault():
+    """The ``migrate`` chaos site, consulted once per started round."""
+    from ..chaos import core as chaos
+    c = chaos.CONTROLLER
+    if c is None:
+        return None
+    return c.decide(chaos.SITE_MIGRATE)
+
+
+class MigrationController(Controller):
+    """Reserve-then-move gang migration off sick chips + defrag."""
+
+    name = "migration"
+
+    def __init__(self, client: Client, factory: InformerFactory,
+                 cache_probe: Optional[Callable] = None,
+                 interval: float = 5.0,
+                 max_concurrent: int = 1,
+                 cooldown_seconds: float = 120.0,
+                 round_timeout_seconds: float = 60.0,
+                 defrag: bool = True):
+        super().__init__(client, factory, workers=1)
+        #: Returns the live SchedulerCache (single-binary composers
+        #: wire the real one) or None — without it the controller can
+        #: neither reserve nor plan, so it does nothing.
+        self.cache_probe = cache_probe
+        self.interval = interval
+        self.max_concurrent = max_concurrent
+        self.cooldown_seconds = cooldown_seconds
+        self.round_timeout_seconds = round_timeout_seconds
+        self.defrag = defrag
+        # Shared-factory informers (one watch per resource cluster-wide,
+        # not one per controller); sync/sweep are gate-checked so the
+        # controller is inert off.
+        self.group_informer = self.watch("podgroups")
+        self.pod_informer = self.watch("pods")
+        self.node_informer = self.watch("nodes")
+        self.group_informer.add_handlers(
+            on_update=lambda o, n: self._group_event(n))
+        self._sweep_task = None
+
+    def _group_event(self, group: t.PodGroup) -> None:
+        if _gated() and _round_open(group):
+            self.enqueue_obj(group)
+
+    async def on_start(self) -> None:
+        self._sweep_task = asyncio.get_running_loop().create_task(
+            self._sweep_loop())
+
+    async def stop(self) -> None:
+        if self._sweep_task is not None:
+            self._sweep_task.cancel()
+            try:
+                await self._sweep_task
+            except asyncio.CancelledError:
+                pass
+            self._sweep_task = None
+        await super().stop()
+
+    async def _sweep_loop(self) -> None:
+        while True:
+            try:
+                await self.sweep_once()
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — keep sweeping
+                log.exception("migration sweep failed")
+            await asyncio.sleep(self.interval)
+
+    # -- per-group reconcile (informer-driven responsiveness) -------------
+
+    async def sync(self, key: str) -> Optional[float]:
+        if not _gated():
+            return None
+        cache = self.cache_probe() if self.cache_probe else None
+        if cache is None:
+            return None
+        ns, name = key.split("/", 1)
+        try:
+            group = await self.client.get("podgroups", ns, name)
+        except errors.NotFoundError:
+            return None
+        if _round_open(group):
+            await self._advance_round(cache, group)
+        return None
+
+    # -- the sweep ---------------------------------------------------------
+
+    async def sweep_once(self) -> None:
+        """One full pass: resume/advance open rounds, then plan new
+        ones under the budget. Also the crash-recovery entry point —
+        everything here reconstructs from status.migration + the
+        cache, never from controller memory."""
+        if not _gated():
+            return  # byte-identical off: not even a metric sample moves
+        cache = self.cache_probe() if self.cache_probe else None
+        if cache is None:
+            return
+        groups = [g for g in self.group_informer.store.list()
+                  if isinstance(g, t.PodGroup)]
+        open_rounds = 0
+        for group in groups:
+            if _round_open(group):
+                open_rounds += 1
+                await self._advance_round(cache, group)
+        ROUNDS_OPEN.set(open_rounds)
+        budget = self.max_concurrent - open_rounds
+        if budget <= 0:
+            return
+        for group, reason, res_cells, slice_id in self._plan(cache, groups):
+            if budget <= 0:
+                break
+            if await self._start_round(cache, group, reason, res_cells,
+                                       slice_id):
+                budget -= 1
+
+    # -- candidate selection ----------------------------------------------
+
+    def _degraded_nodes(self) -> set[str]:
+        out = set()
+        for node in self.node_informer.store.list():
+            taints = getattr(node.spec, "taints", None) or ()
+            if any(taint.key == TAINT_DEGRADED for taint in taints):
+                out.add(node.metadata.name)
+        return out
+
+    def _bound_members(self, group: t.PodGroup) -> list[t.Pod]:
+        ns = group.metadata.namespace
+        name = group.metadata.name
+        return [p for p in self.pod_informer.store.list()
+                if isinstance(p, t.Pod)
+                and p.metadata.namespace == ns and p.spec.gang == name
+                and t.is_pod_active(p) and p.spec.node_name]
+
+    def _migratable(self, group: t.PodGroup) -> bool:
+        """Budget guards shared by both triggers: checkpoint-opted
+        (a gang that cannot checkpoint would just be killed — an
+        eviction in disguise), not mid-preemption, out of cooldown,
+        no open round."""
+        if _round_open(group) or not gp.eligible(group):
+            return False
+        pre = group.status.preemption
+        if pre is not None and pre.phase in (t.PREEMPT_SIGNALED,
+                                             t.PREEMPT_CHECKPOINTING):
+            return False
+        mig = group.status.migration
+        if mig is not None and mig.finished_time is not None:
+            age = (meta_now() - mig.finished_time).total_seconds()
+            if age < self.cooldown_seconds:
+                return False
+        return True
+
+    def _free_cells(self, cache, sl, degraded: set[str],
+                    exclude_owner: str) -> dict:
+        """coord -> (node, chip) a migration may land on: free in the
+        cache, not on a degraded node, not held by anyone else's live
+        reservation."""
+        held = cache.reserved_cells(sl.slice_id, exclude_owner=exclude_owner)
+        return {c: v for c, v in sl.free(cache).items()
+                if v[0] not in degraded and c not in held}
+
+    def _find_target(self, cache, group: t.PodGroup,
+                     degraded: set[str]) -> Optional[tuple[dict, str]]:
+        """First feasible landing box for the gang's shape: (cells
+        coord->(node,chip), slice_id) or None — reserve-first demands
+        the box exists BEFORE anything is signaled."""
+        from ..scheduler.submesh import find_box
+        shape = group.spec.slice_shape
+        if not shape:
+            return None
+        for slice_id in sorted(cache.slices):
+            sl = cache.slices[slice_id]
+            free = self._free_cells(cache, sl, degraded, group.key())
+            box = find_box(set(free), sl.mesh_shape, shape, torus=True)
+            if box is not None:
+                return {c: free[c] for c in box}, slice_id
+        return None
+
+    def _plan(self, cache, groups: list[t.PodGroup]):
+        """Yield (group, reason, cells, slice_id) candidate rounds,
+        evacuation first (sick chips beat utilization)."""
+        degraded = self._degraded_nodes()
+        # 1. Evacuation: gangs with bound members on degraded nodes.
+        if degraded:
+            for group in sorted(groups, key=lambda g: g.key()):
+                if not self._migratable(group):
+                    continue
+                members = self._bound_members(group)
+                if not members or not any(
+                        p.spec.node_name in degraded for p in members):
+                    continue
+                target = self._find_target(cache, group, degraded)
+                if target is None:
+                    NO_TARGET_TOTAL.inc(reason=t.MIGRATE_REASON_DEGRADED)
+                    continue  # degrade to no-op, NEVER evict
+                yield (group, t.MIGRATE_REASON_DEGRADED) + target
+        # 2. Defrag: a pending gang that fits nowhere + a move that
+        # grows the largest free box.
+        if self.defrag:
+            yield from self._plan_defrag(cache, groups, degraded)
+
+    def _plan_defrag(self, cache, groups, degraded):
+        """Score candidate moves by the gain in
+        ``submesh.largest_free_box_volume`` summed over the touched
+        slices; only bother when some pending gang fits nowhere."""
+        from ..scheduler.submesh import find_box, largest_free_box_volume
+        blocked = []
+        for g in groups:
+            if g.spec.slice_shape and not g.status.scheduled \
+                    and g.status.phase == t.PODGROUP_PENDING \
+                    and not _round_open(g):
+                vol = 1
+                for d in g.spec.slice_shape:
+                    vol *= d
+                if self._find_target(cache, g, degraded) is None:
+                    blocked.append((vol, g.key()))
+        if not blocked:
+            return
+        blocked_vol = max(v for v, _ in blocked)
+        free_by_slice = {}
+        for slice_id, sl in cache.slices.items():
+            free_by_slice[slice_id] = self._free_cells(
+                cache, sl, degraded, exclude_owner="")
+        before = {sid: largest_free_box_volume(
+            set(cells), cache.slices[sid].mesh_shape)
+            for sid, cells in free_by_slice.items()}
+        best = None  # (-gain, gang key, group, cells, slice_id)
+        for group in sorted(groups, key=lambda g: g.key()):
+            if not self._migratable(group) or not group.spec.slice_shape:
+                continue
+            members = self._bound_members(group)
+            if not members:
+                continue
+            vol = 1
+            for d in group.spec.slice_shape:
+                vol *= d
+            if vol >= blocked_vol:
+                continue  # moving an equally-large gang cannot help
+            src_cells = self._member_cells(cache, members)
+            if src_cells is None:
+                continue
+            src_slice = src_cells[1]
+            for slice_id, sl_free in free_by_slice.items():
+                sl = cache.slices[slice_id]
+                avail = dict(sl_free)
+                box = find_box(set(avail), sl.mesh_shape,
+                               group.spec.slice_shape, torus=True)
+                if box is None:
+                    continue
+                after = dict(before)
+                tgt_free = set(free_by_slice[slice_id]) - set(box)
+                src_free = set(free_by_slice[src_slice]) \
+                    | set(src_cells[0])
+                if slice_id == src_slice:
+                    src_free -= set(box)
+                    after[slice_id] = largest_free_box_volume(
+                        src_free, sl.mesh_shape)
+                else:
+                    after[slice_id] = largest_free_box_volume(
+                        tgt_free, sl.mesh_shape)
+                    after[src_slice] = largest_free_box_volume(
+                        src_free, cache.slices[src_slice].mesh_shape)
+                gain = sum(after.values()) - sum(before.values())
+                if gain <= 0:
+                    continue
+                cand = (-gain, group.key(), group,
+                        {c: free_by_slice[slice_id][c] for c in box},
+                        slice_id)
+                if best is None or cand[:2] < best[:2]:
+                    best = cand
+        if best is None:
+            NO_TARGET_TOTAL.inc(reason=t.MIGRATE_REASON_DEFRAG)
+            return
+        DEFRAG_GAIN_CHIPS.set(-best[0])
+        yield best[2], t.MIGRATE_REASON_DEFRAG, best[3], best[4]
+
+    def _member_cells(self, cache, members) -> Optional[tuple[list, str]]:
+        """(coords, slice_id) the gang's bound members hold, via the
+        cache's slice geometry; None when unresolvable."""
+        by_node_chip = {}
+        slice_of = {}
+        for sl in cache.slices.values():
+            for coord, (node_name, chip_id) in sl.chips.items():
+                by_node_chip[(node_name, chip_id)] = coord
+                slice_of[(node_name, chip_id)] = sl.slice_id
+        coords = []
+        slice_id = ""
+        for pod in members:
+            for claim in pod.spec.tpu_resources:
+                for cid in claim.assigned:
+                    coord = by_node_chip.get((pod.spec.node_name, cid))
+                    if coord is None:
+                        return None
+                    coords.append(coord)
+                    slice_id = slice_of[(pod.spec.node_name, cid)]
+        return (coords, slice_id) if coords else None
+
+    # -- round lifecycle ---------------------------------------------------
+
+    def _reserve(self, cache, group: t.PodGroup, members: list[t.Pod],
+                 cells: dict, slice_id: str) -> None:
+        """Carve the target box in the scheduler cache (same recipe as
+        gang preemption: CPU/mem pro-rated onto the box hosts so a
+        squatter cannot take the host out from under the gang)."""
+        from ..scheduler.cache import Reservation
+        gang_prio = max((t.pod_priority(p) for p in members), default=0)
+        total_req: dict = {}
+        for p in members:
+            for res, amt in t.pod_resource_requests(p).items():
+                total_req[res] = total_req.get(res, 0.0) + amt
+        chips_per_node: dict[str, int] = {}
+        for _c, (node_name, _cid) in cells.items():
+            chips_per_node[node_name] = chips_per_node.get(node_name, 0) + 1
+        node_requests = {
+            node_name: {res: amt * count / len(cells)
+                        for res, amt in total_req.items()
+                        if res != t.RESOURCE_TPU}
+            for node_name, count in chips_per_node.items()}
+        cache.reserve(Reservation(
+            owner=group.key(), priority=gang_prio, slice_id=slice_id,
+            cells=dict(cells), node_requests=node_requests),
+            ttl=max(2 * self.round_timeout_seconds, 120.0))
+
+    async def _start_round(self, cache, group: t.PodGroup, reason: str,
+                           cells: dict, slice_id: str) -> bool:
+        """Reserve FIRST, then the durable status write, then signal.
+        Any failure after the reservation but before the status write
+        is harmless: the unclaimed reservation just TTL-expires."""
+        members = self._bound_members(group)
+        if not members:
+            return False
+        self._reserve(cache, group, members, cells, slice_id)
+        deadline = time.time() + self.round_timeout_seconds
+        target_nodes = sorted({n for n, _ in cells.values()})
+        target_cells = sorted(_cell_key(c) for c in cells)
+
+        def mutate(cur: t.PodGroup):
+            if _round_open(cur):
+                return False  # raced another round; keep ours out
+            prev = cur.status.migration
+            cur.status.migration = t.MigrationStatus(
+                phase=t.MIGRATE_RESERVED, reason=reason,
+                target_slice=slice_id, target_cells=target_cells,
+                target_nodes=target_nodes, started_time=meta_now(),
+                deadline=deadline,
+                rounds=prev.rounds if prev is not None else 0)
+            return None
+
+        cur = await gp._update_group_status(
+            self.client, group.metadata.namespace, group.metadata.name,
+            mutate)
+        if cur is None:
+            cache.release_reservation(group.key())
+            return False
+        self.recorder.event(
+            group, "Normal", "MigrationReserved",
+            f"{reason}: reserved {len(cells)} chips on {slice_id} "
+            f"({'/'.join(target_nodes)})")
+        # Chaos site "migrate": the round is durable (reservation +
+        # status) — the two kinds attack the window before the bind.
+        fault = _chaos_fault()
+        if fault is not None and fault.kind == "crash-mid-round":
+            # Simulated controller crash: drop the in-memory round on
+            # the floor. The next sweep must resume purely from
+            # status.migration + the cache.
+            log.warning("chaos: migration controller crash mid-round "
+                        "for %s", group.key())
+            return True
+        if fault is not None and fault.kind == "target-node-down":
+            victim = target_nodes[int(fault.param) % len(target_nodes)]
+            log.warning("chaos: deleting migration target node %s "
+                        "between reserve and bind", victim)
+            try:
+                await self.client.delete("nodes", "", victim)
+            except errors.StatusError:
+                pass
+            # Fall through: _advance_round sees the dead target and
+            # aborts the round cleanly (status first, then release).
+        await self._advance_round(cache, cur)
+        return True
+
+    async def _advance_round(self, cache, group: t.PodGroup) -> None:
+        """Drive one open round forward — also the crash-resume path
+        (sweep finds the round in status with no in-memory state)."""
+        mig = group.status.migration
+        gk = group.key()
+        res = cache.reservations.get(gk)
+        # Target nodes gone (chaos target-node-down, real node loss):
+        # the landing spot no longer exists — abort before signaling
+        # anything else. Known nodes = the cache's view.
+        targets_alive = all(n in cache.nodes for n in mig.target_nodes)
+        if not targets_alive:
+            await self._abort(cache, group, "target node lost")
+            return
+        if time.time() > mig.deadline:
+            await self._abort(cache, group, "round deadline exceeded")
+            return
+        members = self._bound_members(group)
+        if mig.phase == t.MIGRATE_RESERVED:
+            if res is None and not self._reclaim_reservation(cache, group):
+                await self._abort(cache, group,
+                                  "reserved box no longer available")
+                return
+            if not members:
+                # Signaled-and-evicted before the Moving stamp landed,
+                # or the gang died: treat as moving — the rebind check
+                # below closes or the deadline aborts.
+                await self._stamp_moving(group)
+                return
+            if await gp.signal_gang(self.client, group, members,
+                                    reason=f"migration:{mig.reason}",
+                                    recorder=self.recorder):
+                await self._stamp_moving(group)
+            else:
+                # Engine refused (gate off / not eligible): a migration
+                # must never degrade to a hard evict — abort the round.
+                await self._abort(cache, group, "preemption engine refused")
+            return
+        # Moving: closed when the gang is bound again and the scheduler
+        # consumed (released) the reservation at plan landing.
+        if members and len(members) >= group.spec.min_member \
+                and res is None:
+            await self._close(group, "moved")
+            return
+        # Keep-alive: refresh the reservation TTL while the round is
+        # legitimately in flight (re-reserve replaces by owner; a copy,
+        # not the cached object — reserve() stamps expires in place).
+        if res is not None:
+            cache.reserve(dataclasses.replace(res),
+                          ttl=max(2 * self.round_timeout_seconds, 120.0))
+        elif not members:
+            # No placement AND no reservation mid-round. The common
+            # cause is the benign scheduler window between CONSUMING
+            # the reservation at plan landing and the binds reaching
+            # the pod store (reclaim fails then — the target cells are
+            # already assumed). Re-carve from the durable target when
+            # the box really is free (binds rolled back); otherwise
+            # leave the round open — the rebind check above closes it
+            # next pass, and the deadline aborts a round that never
+            # converges. Aborting here would misread every successful
+            # landing as a strand.
+            self._reclaim_reservation(cache, group)
+
+    def _reclaim_reservation(self, cache, group: t.PodGroup) -> bool:
+        """Crash recovery: rebuild the reservation from the durable
+        status.migration.target_cells, if the box is still free."""
+        mig = group.status.migration
+        sl = cache.slices.get(mig.target_slice)
+        if sl is None:
+            return False
+        free = sl.free(cache)
+        cells = {}
+        for coord in _parse_cells(mig.target_cells):
+            v = free.get(coord)
+            if v is None:
+                return False
+            cells[coord] = v
+        members = self._bound_members(group)
+        self._reserve(cache, group, members or [], cells, mig.target_slice)
+        return True
+
+    async def _stamp_moving(self, group: t.PodGroup) -> None:
+        def mutate(cur: t.PodGroup):
+            if not _round_open(cur):
+                return False
+            cur.status.migration.phase = t.MIGRATE_MOVING
+            return None
+
+        await gp._update_group_status(
+            self.client, group.metadata.namespace, group.metadata.name,
+            mutate)
+
+    async def _close(self, group: t.PodGroup, outcome: str) -> None:
+        mig = group.status.migration
+
+        def mutate(cur: t.PodGroup):
+            if not _round_open(cur):
+                return False
+            st = cur.status.migration
+            st.phase = ""
+            st.outcome = outcome
+            st.finished_time = meta_now()
+            st.rounds += 1
+            return None
+
+        if await gp._update_group_status(
+                self.client, group.metadata.namespace,
+                group.metadata.name, mutate) is not None:
+            ROUNDS_TOTAL.inc(reason=mig.reason, outcome=outcome)
+            self.recorder.event(group, "Normal", "MigrationFinished",
+                                f"{mig.reason}: {outcome}")
+
+    async def _abort(self, cache, group: t.PodGroup, why: str) -> None:
+        """Close the round, THEN release the reservation — the other
+        order opens a window where the gang holds neither placement
+        nor reservation inside an open round (a strand)."""
+        log.info("migration round for %s aborted: %s", group.key(), why)
+        await self._close(group, "aborted")
+        cache.release_reservation(group.key())

@@ -79,6 +79,18 @@ CLUSTER_TOKENS = Gauge(
     "tpu_cluster_tokens_per_sec",
     "Aggregate live training tokens/s across the cluster")
 
+CLUSTER_FRAGMENTATION = Gauge(
+    "tpu_cluster_fragmentation",
+    "1 - largest free contiguous box / free chips, across all slices "
+    "(0 = one solid block, ->1 = confetti). THE fleet fragmentation "
+    "number: the defrag planner, kmon recording rules and "
+    "`ktl top nodes` all read this same rollup")
+
+SLICE_FRAGMENTATION = Gauge(
+    "tpu_slice_fragmentation",
+    "1 - largest free contiguous box / free chips, per slice",
+    labels=("slice",))
+
 MONITOR_SCRAPES = Counter(
     "tpu_monitor_scrapes_total",
     "Node /stats scrapes by the cluster monitor",
@@ -100,8 +112,9 @@ class ClusterMonitor:
         self._task: Optional[asyncio.Task] = None
         #: Latest aggregated snapshot (see :meth:`latest`).
         self._snapshot: dict = {"at": 0.0, "nodes": {}, "pods": {},
-                                "cluster": {}}
+                                "cluster": {}, "fragmentation": {}}
         self._exported_nodes: set[str] = set()
+        self._exported_slices: set[str] = set()
 
     async def start(self) -> None:
         from ..util.features import GATES
@@ -178,7 +191,9 @@ class ClusterMonitor:
             per_node[name] = agg
             self._export_node(name, agg)
         roll = self._cluster_rollup(per_node)
+        frag = self._fragmentation(per_node)
         self._export_cluster(roll)
+        self._export_fragmentation(frag)
         self._prune_departed(set(names))
         self._snapshot = {
             "at": time.time(),
@@ -187,6 +202,7 @@ class ClusterMonitor:
             # The SAME rollup the gauges exported — the latest()
             # seam and /metrics must never disagree.
             "cluster": roll,
+            "fragmentation": frag,
         }
         return self._snapshot
 
@@ -219,11 +235,20 @@ class ClusterMonitor:
     @staticmethod
     def _aggregate_node(name: str, summary: dict,
                         per_pod: dict) -> dict:
-        chips = (summary.get("tpu") or {}).get("chips") or []
+        tpu = summary.get("tpu") or {}
+        chips = tpu.get("chips") or []
         duty = [c["duty_cycle_pct"] for c in chips
                 if "duty_cycle_pct" in c]
         agg = {
             "chips": len(chips),
+            # Slice geometry + free (healthy, unassigned) cells — the
+            # inputs the fragmentation rollup folds per slice.
+            "slice_id": tpu.get("slice_id") or "",
+            "mesh_shape": list(tpu.get("mesh_shape") or ()),
+            "free_coords": [tuple(c["coords"]) for c in chips
+                            if c.get("coords")
+                            and not c.get("assigned_to")
+                            and c.get("health") == "Healthy"],
             "healthy": sum(1 for c in chips
                            if c.get("health") == "Healthy"),
             "assigned": sum(1 for c in chips if c.get("assigned_to")),
@@ -320,6 +345,56 @@ class ClusterMonitor:
         }
 
     @staticmethod
+    def _fragmentation(per_node: dict) -> dict:
+        """Fold per-node free cells into per-slice + cluster-wide
+        fragmentation: ``1 - largest free contiguous box / free
+        chips`` (:func:`..scheduler.submesh.fragmentation` — the SAME
+        definition the defrag planner scores moves with, so the gauge
+        the operator watches and the planner's objective can never
+        drift apart). Stale node aggregates still contribute their
+        last-known free cells: dropping a slow host's chips would make
+        the fleet look MORE fragmented exactly when a scrape hiccups."""
+        from ..scheduler.submesh import (fragmentation,
+                                         largest_free_box_volume)
+        slices: dict[str, dict] = {}
+        for agg in per_node.values():
+            sid = agg.get("slice_id")
+            mesh = agg.get("mesh_shape")
+            if not sid or not mesh:
+                continue
+            rec = slices.setdefault(sid, {"mesh_shape": list(mesh),
+                                          "free": set()})
+            rec["free"].update(tuple(c) for c in agg.get("free_coords", ()))
+        out: dict = {"slices": {}, "free_chips": 0, "largest_free_box": 0,
+                     "cluster": 0.0}
+        for sid in sorted(slices):
+            free, mesh = slices[sid]["free"], slices[sid]["mesh_shape"]
+            box = largest_free_box_volume(free, mesh) if free else 0
+            out["slices"][sid] = {
+                "free_chips": len(free),
+                "largest_free_box": box,
+                "fragmentation": round(fragmentation(free, mesh), 4),
+            }
+            out["free_chips"] += len(free)
+            # A gang lives on ONE slice, so the cluster's usable block
+            # is the best single-slice box, not a cross-slice sum.
+            out["largest_free_box"] = max(out["largest_free_box"], box)
+        if out["free_chips"]:
+            out["cluster"] = round(
+                1.0 - out["largest_free_box"] / out["free_chips"], 4)
+        return out
+
+    def _export_fragmentation(self, frag: dict) -> None:
+        CLUSTER_FRAGMENTATION.set(frag.get("cluster", 0.0))
+        live: set[str] = set()
+        for sid, rec in (frag.get("slices") or {}).items():
+            SLICE_FRAGMENTATION.set(rec["fragmentation"], slice=sid)
+            live.add(sid)
+        for sid in self._exported_slices - live:
+            SLICE_FRAGMENTATION.remove(slice=sid)
+        self._exported_slices = live
+
+    @staticmethod
     def rollup_points(snapshot: dict) -> tuple[list, list]:
         """``(points, stale_nodes)`` for TSDB recording (the kmon
         pipeline's satellite seam): ``points`` is
@@ -344,6 +419,13 @@ class ClusterMonitor:
                            float(roll["hbm_total_bytes"])))
             points.append(("tpu_cluster_tokens_per_sec", {},
                            round(roll["tokens_per_sec"], 3)))
+        frag = snapshot.get("fragmentation") or {}
+        if frag:
+            points.append(("tpu_cluster_fragmentation", {},
+                           frag.get("cluster", 0.0)))
+            for sid, rec in (frag.get("slices") or {}).items():
+                points.append(("tpu_slice_fragmentation", {"slice": sid},
+                               rec["fragmentation"]))
         stale_nodes: list = []
         for name, agg in (snapshot.get("nodes") or {}).items():
             if agg.get("stale"):

@@ -108,6 +108,8 @@ def builtin_recording_rules() -> list[RecordingRule]:
                       "sum(1 - tpu_chip_healthy)"),
         RecordingRule("cluster:hbm_used:sum",
                       "sum(tpu_node_hbm_used_bytes)"),
+        RecordingRule("cluster:fragmentation:max",
+                      "max(tpu_cluster_fragmentation)"),
         RecordingRule("job:up:sum", "sum by (job) (up)"),
         RecordingRule("apiserver:loop_busy:max",
                       "max(apiserver_loop_busy_fraction)"),

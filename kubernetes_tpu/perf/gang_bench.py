@@ -725,13 +725,427 @@ async def run_reclaim_storm_bench(n_slices: int = 4, seed: int = 20260804,
     }
 
 
+async def _migration_storm_once(n_slices: int, migrate: bool, seed: int,
+                                timeout: float) -> dict:
+    """One seeded degraded-chip storm: the fleet runs checkpoint-opted
+    gangs at 75% fill, then one host per slice goes degraded (the kmon
+    taint). Goodput = fraction of each affected gang's pre-storm
+    virtual training steps retained by its next incarnation:
+
+    - ``migrate=False`` (the hard-evict baseline): the lifecycle path
+      just kills the pods on the sick host; retained = the last
+      PERIODIC checkpoint boundary;
+    - ``migrate=True`` (GangLiveMigration): the controller reserves a
+      target box, checkpoint-migrates, and retained = the step saved
+      on signal.
+    """
+    import math
+    import random
+
+    from .. import preemption as gp
+    from ..api import errors
+    from ..api.meta import now as meta_now
+    from ..api.scheme import deepcopy
+    from ..client.informer import InformerFactory
+    from ..controllers.migrate import MigrationController
+    from ..controllers.queue import QueueController
+    from ..monitoring.rules import TAINT_DEGRADED
+    from ..queueing.harness import make_gang, make_queues
+    from ..util.features import GATES
+
+    was = {g: GATES.enabled(g) for g in
+           ("JobQueueing", "GracefulPreemption", "GangLiveMigration")}
+    GATES.set("JobQueueing", True)
+    GATES.set("GracefulPreemption", True)
+    GATES.set("GangLiveMigration", migrate)
+    gp.CHECKPOINT_WAIT.reset()
+    sched = qc = mc = factory = keeper = stopwatch = None
+    t0 = time.perf_counter()
+    try:
+        reg, fleet_chips, _, members = _bench_fleet(n_slices, None)
+        total_boxes = fleet_chips // math.prod(GANG_SHAPE)
+        # 75% fill: migrations need free boxes to land on (a 100% fleet
+        # correctly degrades to no-op — not what this arm measures).
+        n_gangs = max(1, int(0.75 * total_boxes))
+        for obj in make_queues(nominal_chips=float(fleet_chips)):
+            reg.create(obj)
+        client = LocalClient(reg)
+        factory = InformerFactory(client)
+        sched = Scheduler(client, backoff_seconds=0.2,
+                          informer_factory=factory)
+        qc = QueueController(client, factory, fits_probe=lambda g: True)
+        if migrate:
+            mc = MigrationController(client, factory,
+                                     cache_probe=lambda: sched.cache,
+                                     interval=0.2, max_concurrent=4,
+                                     cooldown_seconds=0.0,
+                                     round_timeout_seconds=30.0,
+                                     defrag=False)
+        await sched.start()
+        await qc.start()
+        if mc is not None:
+            await mc.start()
+
+        gang_names = [f"mig-{i:03d}" for i in range(n_gangs)]
+        for name in gang_names:
+            group, pods = make_gang(name, "tenant-a", "queue-a",
+                                    checkpoint_grace=5.0)
+            await client.create(group)
+            for pod in pods:
+                await client.create(pod)
+
+        def bound_count() -> dict:
+            pods, _ = reg.list("pods", "tenant-a")
+            out: dict = {}
+            for p in pods:
+                if p.spec.node_name and t.is_pod_active(p):
+                    out[p.spec.gang] = out.get(p.spec.gang, 0) + 1
+            return out
+
+        deadline = time.perf_counter() + timeout / 3
+        started: dict[str, float] = {}
+        while len(started) < n_gangs:
+            for g, n in bound_count().items():
+                if n >= members and g not in started:
+                    started[g] = time.perf_counter()
+            if time.perf_counter() > deadline:
+                raise TimeoutError(
+                    f"storm setup: {len(started)}/{n_gangs} gangs bound")
+            await asyncio.sleep(0.05)
+
+        def steps_now(g: str) -> float:
+            return max(0.0,
+                       (time.perf_counter() - started[g]) * STORM_STEP_RATE)
+
+        # Workload stand-in: checkpoint-on-signal + recreate evicted
+        # members with fresh names (both arms need replacements).
+        async def run_keeper():
+            serial = 0
+            while True:
+                groups, _ = reg.list("podgroups", "tenant-a")
+                for g in groups:
+                    st = g.status.preemption
+                    if st is not None and st.phase in (
+                            t.PREEMPT_SIGNALED, t.PREEMPT_CHECKPOINTING):
+                        step = int(steps_now(g.metadata.name))
+                        for member in st.signaled:
+                            if member not in st.checkpointed:
+                                await gp.record_member_checkpoint(
+                                    client, "tenant-a", g.metadata.name,
+                                    member, step)
+                pods, _ = reg.list("pods", "tenant-a")
+                live: dict = {}
+                for p in pods:
+                    if t.is_pod_active(p) \
+                            and p.metadata.deletion_timestamp is None:
+                        live[p.spec.gang] = live.get(p.spec.gang, 0) + 1
+                for g in gang_names:
+                    for _ in range(members - live.get(g, 0)):
+                        serial += 1
+                        pod = make_gang(g, "tenant-a", "queue-a")[1][0]
+                        pod.metadata.name = f"{g}-r{serial}"
+                        await client.create(pod)
+                await asyncio.sleep(0.03)
+
+        keeper = asyncio.create_task(run_keeper())
+
+        # Per-gang stop clock: first eviction event (watch, not poll).
+        stopped: dict[str, float] = {}
+        stream = await client.watch("pods", namespace="tenant-a")
+
+        async def watch_stops():
+            while True:
+                ev = await stream.next()
+                if ev is None or ev[0] == "CLOSED":
+                    return
+                ev_type, pod = ev
+                if pod.spec.gang and pod.spec.gang not in stopped and (
+                        ev_type == "DELETED" or not t.is_pod_active(pod)):
+                    stopped[pod.spec.gang] = time.perf_counter()
+
+        stopwatch = asyncio.create_task(watch_stops())
+        await asyncio.sleep(STORM_WARMUP_S)  # accrue training progress
+
+        # The storm: one seeded host per slice goes degraded.
+        rng = random.Random(seed)
+        pods, _ = reg.list("pods", "tenant-a")
+        node_gang: dict[str, set] = {}
+        for p in pods:
+            if p.spec.node_name and t.is_pod_active(p):
+                node_gang.setdefault(p.spec.node_name, set()).add(
+                    p.spec.gang)
+        by_slice: dict[str, list] = {}
+        for node_name in sorted(node_gang):
+            by_slice.setdefault(
+                node_name.rsplit("-host-", 1)[0], []).append(node_name)
+        victims = [rng.choice(v) for _sl, v in sorted(by_slice.items())]
+        affected = sorted(set().union(*(node_gang[v] for v in victims)))
+        storm_t0 = time.perf_counter()
+        for v in victims:
+            node = deepcopy(reg.get("nodes", "", v))
+            node.spec.taints.append(t.Taint(
+                key=TAINT_DEGRADED, value="TpuChipSick",
+                effect="NoSchedule", time_added=meta_now()))
+            await client.update(node)
+        if not migrate:
+            # Hard-evict baseline: the chip dies under the gang, and
+            # gangs are all-or-nothing — losing a member kills the
+            # whole incarnation (the survivors' box is pinned to the
+            # now-tainted host, so a partial repair cannot land).
+            pods, _ = reg.list("pods", "tenant-a")
+            for p in pods:
+                if p.spec.gang in affected and t.is_pod_active(p):
+                    try:
+                        await client.delete(
+                            "pods", "tenant-a", p.metadata.name,
+                            grace_period_seconds=0)
+                    except errors.StatusError:
+                        pass
+
+        victim_set = set(victims)
+
+        def converged() -> bool:
+            cnt: dict = {}
+            pods, _ = reg.list("pods", "tenant-a")
+            for p in pods:
+                if p.spec.node_name and t.is_pod_active(p) \
+                        and p.spec.gang in affected:
+                    if p.spec.node_name in victim_set:
+                        return False
+                    cnt[p.spec.gang] = cnt.get(p.spec.gang, 0) + 1
+            return all(cnt.get(g, 0) >= members for g in affected)
+
+        deadline = time.perf_counter() + timeout
+        while not converged():
+            if time.perf_counter() > deadline:
+                raise TimeoutError(
+                    f"storm: affected gangs never re-bound off the "
+                    f"degraded hosts ({affected})")
+            await asyncio.sleep(0.05)
+        storm_wall = time.perf_counter() - storm_t0
+
+        pre_total = retained_total = 0.0
+        for gname in affected:
+            stop_at = stopped.get(gname)
+            g = reg.get("podgroups", "tenant-a", gname)
+            st = g.status.preemption
+            pre = max(0.0, ((stop_at or time.perf_counter())
+                            - started[gname]) * STORM_STEP_RATE)
+            if migrate and st is not None:
+                retained = max(0, st.checkpoint_step)
+            else:
+                boundary = STORM_PERIODIC_S * STORM_STEP_RATE
+                retained = (pre // boundary) * boundary
+            if pre < 1.0:
+                continue
+            pre_total += pre
+            retained_total += min(retained, pre)
+        goodput = retained_total / pre_total if pre_total else 0.0
+        mode = "migrate" if migrate else "evict"
+        gp.GOODPUT.set(goodput, mode=mode)
+        stream.cancel()
+        return {
+            "mode": mode,
+            "gangs": n_gangs,
+            "degraded_hosts": len(victims),
+            "affected_gangs": len(affected),
+            "pre_storm_steps": round(pre_total, 1),
+            "retained_steps": round(retained_total, 1),
+            "goodput": round(goodput, 4),
+            "storm_wall_seconds": round(storm_wall, 3),
+            "wall_seconds": round(time.perf_counter() - t0, 3),
+        }
+    finally:
+        for task in (keeper, stopwatch):
+            if task is not None:
+                task.cancel()
+        if mc is not None:
+            await mc.stop()
+        if qc is not None:
+            await qc.stop()
+        if sched is not None:
+            await sched.stop()
+        if factory is not None:
+            await factory.stop_all()
+        for g, on in was.items():
+            GATES.set(g, on)
+
+
+async def _blocked_placement_once(defrag: bool, seed: int,
+                                  timeout: float) -> Optional[float]:
+    """Time-to-placement for a LARGE blocked gang: the defrag-smoke
+    fleet shape (pin gang on slice-000, donor on slice-001, then a
+    full-slice 4x4x4 arrival that fits nowhere). Returns seconds from
+    the big gang's create to all members bound, or None if it never
+    placed — with defrag off that is the expected answer: the gang
+    waits for an operator."""
+    from .. import preemption as gp
+    from ..api.scheme import deepcopy
+    from ..client.informer import InformerFactory
+    from ..controllers.migrate import MigrationController
+    from ..controllers.queue import QueueController
+    from ..queueing.harness import make_gang, make_queues
+    from ..util.features import GATES
+
+    was = {g: GATES.enabled(g) for g in
+           ("JobQueueing", "GracefulPreemption", "GangLiveMigration")}
+    GATES.set("JobQueueing", True)
+    GATES.set("GracefulPreemption", True)
+    GATES.set("GangLiveMigration", True)
+    sched = qc = mc = factory = keeper = None
+    try:
+        reg, fleet_chips, _, members = _bench_fleet(2, None)
+        nodes, _ = reg.list("nodes")
+        for n in nodes:
+            fresh = deepcopy(n)
+            fresh.metadata.labels["slice"] = fresh.status.tpu.slice_id
+            reg.update(fresh)
+        for obj in make_queues(nominal_chips=float(fleet_chips)):
+            reg.create(obj)
+        client = LocalClient(reg)
+        factory = InformerFactory(client)
+        sched = Scheduler(client, backoff_seconds=0.2,
+                          informer_factory=factory)
+        qc = QueueController(client, factory, fits_probe=lambda g: True)
+        mc = MigrationController(client, factory,
+                                 cache_probe=lambda: sched.cache,
+                                 interval=0.2, max_concurrent=1,
+                                 cooldown_seconds=0.0,
+                                 round_timeout_seconds=30.0,
+                                 defrag=defrag)
+        await sched.start()
+        await qc.start()
+        await mc.start()
+
+        def bound(ns: str, gang: str) -> int:
+            pods, _ = reg.list("pods", ns)
+            return sum(1 for p in pods if p.spec.gang == gang
+                       and p.spec.node_name and t.is_pod_active(p))
+
+        async def wait_bound(ns, gang, want, secs) -> bool:
+            deadline = time.perf_counter() + secs
+            while bound(ns, gang) < want:
+                if time.perf_counter() > deadline:
+                    return False
+                await asyncio.sleep(0.05)
+            return True
+
+        pin, pin_pods = make_gang("pin-00", "tenant-a", "queue-a",
+                                  shape=[4, 4, 2])
+        await client.create(pin)
+        for pod in pin_pods:
+            await client.create(pod)
+        assert await wait_bound("tenant-a", "pin-00", 8, timeout / 3)
+        don, don_pods = make_gang("don-00", "tenant-a", "queue-a",
+                                  checkpoint_grace=5.0)
+        for pod in don_pods:
+            pod.spec.node_selector = {"slice": "slice-001"}
+        await client.create(don)
+        for pod in don_pods:
+            await client.create(pod)
+        assert await wait_bound("tenant-a", "don-00", 2, timeout / 3)
+
+        async def run_keeper():
+            serial = 0
+            while True:
+                groups, _ = reg.list("podgroups", "tenant-a")
+                for g in groups:
+                    st = g.status.preemption
+                    if st is not None and st.phase in (
+                            t.PREEMPT_SIGNALED, t.PREEMPT_CHECKPOINTING):
+                        for member in st.signaled:
+                            if member not in st.checkpointed:
+                                await gp.record_member_checkpoint(
+                                    client, "tenant-a", g.metadata.name,
+                                    member, 100 * (st.rounds + 1))
+                pods, _ = reg.list("pods", "tenant-a")
+                live = sum(1 for p in pods if p.spec.gang == "don-00"
+                           and t.is_pod_active(p)
+                           and p.metadata.deletion_timestamp is None)
+                for _ in range(2 - live):
+                    serial += 1
+                    pod = make_gang("don-00", "tenant-a", "queue-a")[1][0]
+                    pod.metadata.name = f"don-00-r{serial}"
+                    await client.create(pod)
+                await asyncio.sleep(0.03)
+
+        keeper = asyncio.create_task(run_keeper())
+        big, big_pods = make_gang("big-00", "tenant-b", "queue-b",
+                                  shape=[4, 4, 4])
+        created = time.perf_counter()
+        await client.create(big)
+        for pod in big_pods:
+            await client.create(pod)
+        # Defrag off: a short bounded wait PROVES it stays blocked.
+        wait_s = timeout if defrag else 4.0
+        if not await wait_bound("tenant-b", "big-00", 16, wait_s):
+            return None
+        return time.perf_counter() - created
+    finally:
+        if keeper is not None:
+            keeper.cancel()
+        if mc is not None:
+            await mc.stop()
+        if qc is not None:
+            await qc.stop()
+        if sched is not None:
+            await sched.stop()
+        if factory is not None:
+            await factory.stop_all()
+        for g, on in was.items():
+            GATES.set(g, on)
+
+
+async def run_migration_storm_bench(n_slices: int = 2,
+                                    seed: int = 20260807,
+                                    timeout: float = 120.0,
+                                    placement_runs: int = 3) -> dict:
+    """The live-migration gate, sibling of the reclaim-storm bench:
+    the SAME seeded degraded-chip storm with the hard-evict baseline
+    and with GangLiveMigration, side by side (bar: migrate goodput
+    >= 2x evict), plus time-to-placement for a large blocked gang with
+    the defrag planner on (p50/p99 over ``placement_runs``) vs off
+    (expected: never places)."""
+    from . import pct
+    evict = await _migration_storm_once(n_slices, False, seed, timeout)
+    migrate = await _migration_storm_once(n_slices, True, seed, timeout)
+    ratio = migrate["goodput"] / max(evict["goodput"], 0.01)
+    on_times = []
+    for i in range(placement_runs):
+        placed = await _blocked_placement_once(True, seed + i, timeout)
+        if placed is not None:
+            on_times.append(placed)
+    off_placed = await _blocked_placement_once(False, seed, timeout)
+    on_sorted = sorted(on_times)
+    return {
+        "slices": n_slices,
+        "seed": seed,
+        "step_rate_per_s": STORM_STEP_RATE,
+        "baseline_periodic_s": STORM_PERIODIC_S,
+        "evict": evict,
+        "migrate": migrate,
+        "goodput_ratio": round(ratio, 2),
+        "blocked_gang": {
+            "defrag_on_placed": len(on_times),
+            "defrag_on_runs": placement_runs,
+            "time_to_placement_p50_ms": (
+                round(pct(on_sorted, 0.5) * 1e3, 1) if on_sorted else None),
+            "time_to_placement_p99_ms": (
+                round(pct(on_sorted, 0.99) * 1e3, 1) if on_sorted else None),
+            "defrag_off_placed": off_placed is not None,
+        },
+    }
+
+
 if __name__ == "__main__":
     import json
     import sys
     argv = [a for a in sys.argv[1:]
-            if a not in ("--queued", "--reclaim-storm")]
+            if a not in ("--queued", "--reclaim-storm",
+                         "--migration-storm")]
     queued = "--queued" in sys.argv[1:]
     storm = "--reclaim-storm" in sys.argv[1:]
+    mig_storm = "--migration-storm" in sys.argv[1:]
     ns = int(argv[0]) if len(argv) > 0 else 8
     ng = int(argv[1]) if len(argv) > 1 else None
     out = asyncio.run(run_gang_bench(ns, ng))
@@ -742,4 +1156,9 @@ if __name__ == "__main__":
     if storm:
         # Checkpoint-aware preemption goodput vs the evict baseline.
         out["reclaim_storm"] = asyncio.run(run_reclaim_storm_bench(ns))
+    if mig_storm:
+        # Live-migration goodput vs hard evict + blocked-gang
+        # time-to-placement with the defrag planner.
+        out["migration_storm"] = asyncio.run(
+            run_migration_storm_bench(min(ns, 4)))
     print(json.dumps(out))

@@ -428,6 +428,341 @@ def run_preempt_smoke_schedules(base_seed, schedules: int = 4,
     return rep
 
 
+async def _migrate_stack(reg, client, factory, interval: float = 0.3):
+    """Scheduler + queue controller + migration controller wired the
+    way the single-binary composer does it (the controller reads the
+    LIVE scheduler cache through the probe)."""
+    from ..controllers.migrate import MigrationController
+    sched = Scheduler(client, backoff_seconds=0.2,
+                      informer_factory=factory)
+    qc = QueueController(client, factory, fits_probe=lambda g: True)
+    mc = MigrationController(client, factory,
+                             cache_probe=lambda: sched.cache,
+                             interval=interval, max_concurrent=1,
+                             cooldown_seconds=0.0,
+                             round_timeout_seconds=30.0)
+    await sched.start()
+    await qc.start()
+    await mc.start()
+    return sched, qc, mc
+
+
+def _member_keeper(reg, client, gang_size: dict):
+    """The TrainJob-controller stand-in: tops each tracked gang back up
+    to its target size with FRESH-named members after an eviction (the
+    preempt-smoke phase-3 step, continuous), and answers preemption
+    signals with deterministic checkpoint markers (100/round)."""
+    from .. import preemption as gp
+
+    async def task():
+        serial = 0
+        while True:
+            groups, _ = reg.list("podgroups", "")
+            for g in groups:
+                st = g.status.preemption
+                if st is not None and st.phase in (
+                        t.PREEMPT_SIGNALED, t.PREEMPT_CHECKPOINTING):
+                    step = 100 * (st.rounds + 1)
+                    for member in st.signaled:
+                        if member in st.checkpointed:
+                            continue
+                        try:
+                            pod = reg.get("pods", g.metadata.namespace,
+                                          member)
+                        except errors.NotFoundError:
+                            continue
+                        if not t.is_pod_active(pod) or not \
+                                pod.metadata.annotations.get(
+                                    t.PREEMPT_ANNOTATION):
+                            continue
+                        await gp.record_member_checkpoint(
+                            client, g.metadata.namespace,
+                            g.metadata.name, member, step)
+            for gname, (ns, queue, want) in gang_size.items():
+                pods, _ = reg.list("pods", ns)
+                live = [p for p in pods
+                        if p.spec.gang == gname and t.is_pod_active(p)
+                        and p.metadata.deletion_timestamp is None]
+                for _ in range(want - len(live)):
+                    serial += 1
+                    pod = make_gang(gname, ns, queue)[1][0]
+                    pod.metadata.name = f"{gname}-r{serial}"
+                    await client.create(pod)
+            await asyncio.sleep(0.05)
+
+    return asyncio.create_task(task())
+
+
+async def run_migrate_smoke(seed: int = 0, timeout: float = 60.0) -> dict:
+    """Live-migration evacuation acceptance (<90s): a bound gang's host
+    goes degraded -> reserve-then-move gets the gang off the sick chips
+    with its checkpoint intact, never a hard evict.
+
+    One 64-chip slice, the GangLiveMigration + GracefulPreemption
+    gates on:
+
+    1. a 2x2x2 checkpoint-opted gang binds (2 members, 2 hosts);
+    2. one of its hosts gets the kmon degraded taint (the harness
+       plays the alert->taint pipeline's part directly);
+    3. the migration controller reserves a target box OFF the sick
+       host, then signals through the preemption engine; the seeded
+       ``migrate`` chaos site crashes the controller mid-round (the
+       next sweep must resume purely from status.migration + cache);
+    4. members checkpoint, evict, and the recreated members bind onto
+       the reserved box — round closes ``moved``, nothing remains on
+       the degraded host, checkpoint_step > 0.
+
+    Deterministic extract lets ``run_migrate_smoke_schedules`` assert
+    byte-identical convergence across explored interleavings. Shared
+    by ``hack/migrate_smoke.sh`` and the integration tier."""
+    from ..api.meta import now as meta_now
+    from ..api.scheme import deepcopy
+    from ..chaos import core as chaos
+    from ..monitoring.rules import TAINT_DEGRADED
+
+    t0 = time.perf_counter()
+    gates = ("JobQueueing", "GracefulPreemption", "GangLiveMigration")
+    was = {g: GATES.enabled(g) for g in gates}
+    for g in gates:
+        GATES.set(g, True)
+    controller = chaos.arm(chaos.ChaosController(int(seed), ()))
+    controller.trigger(chaos.SITE_MIGRATE, "crash-mid-round")
+    sched = qc = mc = factory = keeper = None
+    try:
+        reg = Registry()
+        reg.admission = default_chain(reg)
+        reg.create(t.Namespace(metadata=ObjectMeta(name="default")))
+        from ..perf.gang_bench import build_slice
+        build_slice(reg, 0)  # 4x4x4 = 64 chips over 16 hosts
+        client = LocalClient(reg)
+        for obj in make_queues(nominal_chips=64.0):
+            reg.create(obj)
+        factory = InformerFactory(client)
+        sched, qc, mc = await _migrate_stack(reg, client, factory)
+        loop = asyncio.get_running_loop()
+        gang_size: dict = {}
+        keeper = _member_keeper(reg, client, gang_size)
+
+        def bound_members(ns: str, gang: str) -> list:
+            pods, _ = reg.list("pods", ns)
+            return [p for p in pods if p.spec.gang == gang
+                    and p.spec.node_name and t.is_pod_active(p)]
+
+        def migration(ns: str, gang: str):
+            return reg.get("podgroups", ns, gang).status.migration
+
+        # Phase 1: the gang binds.
+        group, pods = make_gang("eva-00", "tenant-a", "queue-a",
+                                checkpoint_grace=10.0)
+        await client.create(group)
+        for pod in pods:
+            await client.create(pod)
+        gang_size["eva-00"] = ("tenant-a", "queue-a", len(pods))
+        await _wait(lambda: len(bound_members("tenant-a", "eva-00")) >= 2,
+                    loop.time() + timeout / 3, "eva gang bound")
+
+        # Phase 2: one of its hosts goes degraded (what the kmon
+        # alert->taint pipeline does on TpuChipSick).
+        victim = sorted(p.spec.node_name
+                        for p in bound_members("tenant-a", "eva-00"))[0]
+        node = deepcopy(reg.get("nodes", "", victim))
+        node.spec.taints.append(t.Taint(
+            key=TAINT_DEGRADED, value="TpuChipSick", effect="NoSchedule",
+            time_added=meta_now()))
+        await client.update(node)
+
+        # Phase 3: reserve-then-move runs to completion (surviving the
+        # seeded mid-round controller crash).
+        await _wait(lambda: (migration("tenant-a", "eva-00") is not None
+                             and migration("tenant-a", "eva-00").outcome
+                             == "moved"),
+                    loop.time() + timeout, "migration round closed moved")
+        await _wait(lambda: len(bound_members("tenant-a", "eva-00")) >= 2
+                    and all(p.spec.node_name != victim
+                            for p in bound_members("tenant-a", "eva-00")),
+                    loop.time() + timeout, "gang re-bound off sick host")
+        g = reg.get("podgroups", "tenant-a", "eva-00")
+        mig = g.status.migration
+        st = g.status.preemption
+        assert mig.rounds == 1, mig.rounds
+        assert mig.reason == t.MIGRATE_REASON_DEGRADED, mig.reason
+        assert st is not None and st.checkpoint_step > 0, st
+        crash_faults = sum(1 for f in controller.injected
+                           if f.site == chaos.SITE_MIGRATE)
+        assert crash_faults == 1, "mid-round crash never fired"
+        return {
+            "outcome": mig.outcome,
+            "reason": mig.reason,
+            "rounds": mig.rounds,
+            "checkpoint_step": st.checkpoint_step,
+            "bound": len(bound_members("tenant-a", "eva-00")),
+            "off_sick_host": all(
+                p.spec.node_name != victim
+                for p in bound_members("tenant-a", "eva-00")),
+            "crash_faults": crash_faults,
+            "elapsed_s": round(time.perf_counter() - t0, 2),
+        }
+    finally:
+        chaos.disarm()
+        if keeper is not None:
+            keeper.cancel()
+        if mc is not None:
+            await mc.stop()
+        if qc is not None:
+            await qc.stop()
+        if sched is not None:
+            await sched.stop()
+        if factory is not None:
+            await factory.stop_all()  # last: the scheduler rides it too
+        for g, on in was.items():
+            if not on:
+                GATES.set(g, False)
+
+
+async def run_defrag_smoke(seed: int = 0, timeout: float = 60.0) -> dict:
+    """Defragmentation acceptance: a large pending gang fits nowhere
+    until the planner consolidates a small donor gang, scored by the
+    gain in ``largest_free_box_volume``.
+
+    Two 64-chip slices:
+
+    1. a 4x4x2 pin gang (not checkpoint-opted -> never a donor) takes
+       half of slice-000; a 2x2x2 checkpoint-opted donor is steered
+       onto slice-001 (node selector — scaffolding that stands in for
+       historical placement; its recreated members carry none);
+    2. a full-slice 4x4x4 gang arrives: blocked on both slices;
+    3. the defrag planner moves the donor onto slice-000's free half
+       (gain: slice-001 becomes one solid 64-box), the blocked gang
+       binds there — time-to-placement for the big gang is the
+       migration, not an operator page."""
+    from ..api.scheme import deepcopy
+    from ..chaos import core as chaos
+
+    t0 = time.perf_counter()
+    gates = ("JobQueueing", "GracefulPreemption", "GangLiveMigration")
+    was = {g: GATES.enabled(g) for g in gates}
+    for g in gates:
+        GATES.set(g, True)
+    chaos.arm(chaos.ChaosController(int(seed), ()))
+    sched = qc = mc = factory = keeper = None
+    try:
+        reg = Registry()
+        reg.admission = default_chain(reg)
+        reg.create(t.Namespace(metadata=ObjectMeta(name="default")))
+        from ..perf.gang_bench import build_slice
+        build_slice(reg, 0)
+        build_slice(reg, 1)
+        nodes, _ = reg.list("nodes")
+        for n in nodes:
+            fresh = deepcopy(n)
+            fresh.metadata.labels["slice"] = fresh.status.tpu.slice_id
+            reg.update(fresh)
+        client = LocalClient(reg)
+        for obj in make_queues(nominal_chips=128.0):
+            reg.create(obj)
+        factory = InformerFactory(client)
+        sched, qc, mc = await _migrate_stack(reg, client, factory)
+        loop = asyncio.get_running_loop()
+        gang_size: dict = {}
+        keeper = _member_keeper(reg, client, gang_size)
+
+        def bound_members(ns: str, gang: str) -> list:
+            pods, _ = reg.list("pods", ns)
+            return [p for p in pods if p.spec.gang == gang
+                    and p.spec.node_name and t.is_pod_active(p)]
+
+        # Phase 1: stage the fragmentation.
+        pin, pin_pods = make_gang("pin-00", "tenant-a", "queue-a",
+                                  shape=[4, 4, 2])
+        await client.create(pin)
+        for pod in pin_pods:
+            await client.create(pod)
+        await _wait(lambda: len(bound_members("tenant-a", "pin-00")) >= 8,
+                    loop.time() + timeout / 3, "pin gang bound")
+        don, don_pods = make_gang("don-00", "tenant-a", "queue-a",
+                                  checkpoint_grace=10.0)
+        for pod in don_pods:
+            pod.spec.node_selector = {"slice": "slice-001"}
+        await client.create(don)
+        for pod in don_pods:
+            await client.create(pod)
+        gang_size["don-00"] = ("tenant-a", "queue-a", len(don_pods))
+        await _wait(lambda: len(bound_members("tenant-a", "don-00")) >= 2,
+                    loop.time() + timeout / 3, "donor gang bound")
+        assert all(p.spec.node_name.startswith("slice-001")
+                   for p in bound_members("tenant-a", "don-00"))
+
+        # Phase 2: the big gang is blocked on both slices.
+        big, big_pods = make_gang("big-00", "tenant-b", "queue-b",
+                                  shape=[4, 4, 4])
+        await client.create(big)
+        for pod in big_pods:
+            await client.create(pod)
+
+        # Phase 3: defrag moves the donor; the big gang binds.
+        await _wait(lambda: len(bound_members("tenant-b", "big-00")) >= 16,
+                    loop.time() + timeout, "big gang bound after defrag")
+        d = reg.get("podgroups", "tenant-a", "don-00")
+        mig = d.status.migration
+        assert mig is not None and mig.outcome == "moved", mig
+        assert mig.reason == t.MIGRATE_REASON_DEFRAG, mig.reason
+        assert all(p.spec.node_name.startswith("slice-000")
+                   for p in bound_members("tenant-a", "don-00"))
+        st = d.status.preemption
+        assert st is not None and st.checkpoint_step > 0, st
+        big_nodes = {p.spec.node_name
+                     for p in bound_members("tenant-b", "big-00")}
+        assert all(n.startswith("slice-001") for n in big_nodes)
+        return {
+            "donor_outcome": mig.outcome,
+            "donor_reason": mig.reason,
+            "donor_rounds": mig.rounds,
+            "donor_checkpoint_step": st.checkpoint_step,
+            "donor_bound": len(bound_members("tenant-a", "don-00")),
+            "big_bound": len(bound_members("tenant-b", "big-00")),
+            "elapsed_s": round(time.perf_counter() - t0, 2),
+        }
+    finally:
+        chaos.disarm()
+        if keeper is not None:
+            keeper.cancel()
+        if mc is not None:
+            await mc.stop()
+        if qc is not None:
+            await qc.stop()
+        if sched is not None:
+            await sched.stop()
+        if factory is not None:
+            await factory.stop_all()
+        for g, on in was.items():
+            if not on:
+                GATES.set(g, False)
+
+
+def run_migrate_smoke_schedules(base_seed, schedules: int = 4,
+                                mode: str = "dpor",
+                                timeout: float = 60.0) -> dict:
+    """tpusan arm of the live-migration gate: the evacuation story
+    explored under ``schedules`` interleavings with the cluster
+    invariants armed (incl. migration-no-strand), asserting the
+    deterministic convergence facts are byte-identical on every
+    schedule."""
+    from ..analysis import interleave
+
+    keys = ("outcome", "reason", "rounds", "checkpoint_step", "bound",
+            "off_sick_host", "crash_faults")
+    rep = interleave.explore_sanitized(
+        lambda i: run_migrate_smoke(seed=int(base_seed) if str(
+            base_seed).isdigit() else 0, timeout=timeout),
+        base_seed=base_seed, schedules=schedules, mode=mode,
+        extract=lambda v: {k: v[k] for k in keys})
+    outcomes = [{k: r[k] for k in keys} for r in rep["schedules"]]
+    assert all(o == outcomes[0] for o in outcomes), (
+        f"convergence diverged across schedules: {outcomes}")
+    rep["base_seed"] = base_seed
+    return rep
+
+
 def run_queue_smoke_schedules(base_seed, schedules: int = 4,
                               mode: str = "dpor",
                               timeout: float = 30.0) -> dict:

@@ -24,6 +24,7 @@ import time as _time
 from dataclasses import dataclass, field
 from typing import Optional
 
+from ..analysis import invariants as _inv
 from ..api import types as t
 from ..client.mutation_detector import CacheMutationDetector
 
@@ -230,6 +231,11 @@ class SchedulerCache:
         for name in ({res.node_name} | {n for n, _ in res.cells.values()}):
             if name:
                 self.equiv.invalidate_node(name)
+        # tpusan migration-no-strand seam (no-op unless armed).
+        _inv.note_reservation(
+            res.owner,
+            [(n, cid) for n, cid in res.cells.values()]
+            + [(res.node_name, cid) for cid in res.chip_ids])
 
     def release_reservation(self, owner: str) -> None:
         res = self.reservations.pop(owner, None)
@@ -237,6 +243,9 @@ class SchedulerCache:
             for name in ({res.node_name} | {n for n, _ in res.cells.values()}):
                 if name:
                     self.equiv.invalidate_node(name)
+            # TTL expiry (_live_reservations) flows through here too —
+            # the sanitizer sees every way a reservation can die.
+            _inv.note_reservation_gone(owner)
 
     def _live_reservations(self):
         now = _time.monotonic()

@@ -67,12 +67,20 @@ def _non_tpu_predicates(pod: t.Pod, info, enabled=None) -> Optional[str]:
 def plan_gang(group: t.PodGroup, pods: list[t.Pod],
               cache: SchedulerCache,
               must_include: Optional[dict] = None,
+              restrict_to: Optional[dict] = None,
               enabled=None) -> GangPlan | GangFailure:
     """``must_include``: coords -> (node, chip_id) already held by bound
     gang members (partial-bind recovery). A shaped gang must then find a
     full-shape box *containing* those coords, so the recovered gang is
     still one contiguous sub-mesh; only the unbound ``pods`` are
-    planned."""
+    planned.
+
+    ``restrict_to``: coords -> (node, chip_id) the plan may draw from —
+    the live-migration steering seam (GangLiveMigration): a requeued
+    migrating gang is planned INTO its own reserved target box, not
+    wherever the free-space search lands first (which would happily be
+    the spot it just vacated, defeating the defrag move). The caller
+    falls back to an unrestricted plan when this one fails."""
     reasons: list[str] = []
     tpu_pods = [p for p in pods if _pod_chip_demand(p) > 0]
     aux_pods = [p for p in pods if _pod_chip_demand(p) == 0]
@@ -94,11 +102,42 @@ def plan_gang(group: t.PodGroup, pods: list[t.Pod],
     # Slice-independent: computed once, not per candidate slice.
     blocked = cache.reserved_node_chips(exclude_owner=group.key(),
                                         below_priority=gang_priority)
+    on = enabled.__contains__ if enabled is not None else lambda _k: True
+
+    # Hosts no member may land on (kmon's degraded taint, cordons)
+    # must leave the free set BEFORE the box search: find_box is
+    # deterministic, so an infeasible host inside the first-choice box
+    # otherwise wedges the gang — the per-pod predicate pass below can
+    # only reject the plan, never steer the search around the host.
+    node_ok: dict[str, bool] = {}
+
+    def _node_usable(node_name: str) -> bool:
+        ok = node_ok.get(node_name)
+        if ok is None:
+            info = cache.nodes.get(node_name)
+            node = info.node if info is not None else None
+            ok = node is not None and \
+                (not on(PRED_NODE_CONDITION)
+                 or node_is_schedulable(node) is None) and \
+                (not on(PRED_TAINTS) or any(
+                    pod_tolerates_taints(p, node) is None
+                    for p in tpu_pods))
+            node_ok[node_name] = ok
+        return ok
+
     for sl in candidate_slices:
         if must_include and not all(sl.chips.get(c) == nc
                                     for c, nc in must_include.items()):
             continue  # survivors' chips live elsewhere
+        if restrict_to and not all(sl.chips.get(c) == nc
+                                   for c, nc in restrict_to.items()):
+            continue  # reserved target box lives on another slice
         free = sl.free(cache)  # coords -> (node, chip_id)
+        if restrict_to:
+            # Migration steering: only the reserved target box is in
+            # play. The box is exactly gang-shaped, so find_box below
+            # returns it or nothing.
+            free = {c: v for c, v in free.items() if c in restrict_to}
         # Cells held for ANOTHER preemptor (gang-preemption box or a
         # nominated pod's chips) are off-limits to equal-or-lower
         # priority plans; this group's own reservation is its to use.
@@ -110,6 +149,12 @@ def plan_gang(group: t.PodGroup, pods: list[t.Pod],
         if blocked:
             free = {c: (n, cid) for c, (n, cid) in free.items()
                     if cid not in blocked.get(n, ())}
+        if tpu_pods:
+            # Survivor-held coords (must_include) stay implicitly: a
+            # NoSchedule taint lets bound pods remain, and
+            # find_box_containing unions the required coords back in.
+            free = {c: (n, cid) for c, (n, cid) in free.items()
+                    if _node_usable(n)}
         if len(free) < total_chips:
             reasons.append(f"slice {sl.slice_id}: {len(free)} free chips, "
                            f"gang needs {total_chips}")

@@ -1383,8 +1383,28 @@ class Scheduler:
                 await self.queue.requeue(GangUnit(unit.group_key, pods),
                                         self.backoff_seconds)
                 return
+        # Migration steering (GangLiveMigration): a fully-evicted gang
+        # whose migration round reserved a target box re-plans INTO
+        # that box — an unrestricted plan would happily land back on
+        # the cells it just vacated (still free, and best-fit-first),
+        # turning the move into a no-op. If the reserved box has gone
+        # bad (node lost, chips taken) the restricted plan fails and
+        # we fall back to the normal search so a dead target can never
+        # wedge the gang; the migration controller observes the
+        # off-target landing and aborts the round.
+        restrict_to = None
+        if must_include is None:
+            from ..util.features import GATES
+            if GATES.enabled("GangLiveMigration"):
+                res = self.cache.reservations.get(unit.group_key)
+                if res is not None and res.cells:
+                    restrict_to = dict(res.cells)
         plan = plan_gang(group, pods, self.cache, must_include=must_include,
+                         restrict_to=restrict_to,
                          enabled=self._enabled_predicates)
+        if restrict_to is not None and isinstance(plan, GangFailure):
+            plan = plan_gang(group, pods, self.cache,
+                             enabled=self._enabled_predicates)
         m.ALGORITHM_LATENCY.observe(time.perf_counter() - start)
         if isinstance(plan, GangFailure):
             brief = "; ".join(plan.reasons[:3])

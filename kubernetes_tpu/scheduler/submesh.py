@@ -390,6 +390,21 @@ def largest_free_box_volume(free: set[Coord], mesh: Sequence[int],
     return 1  # free is non-empty: a 1-cell box always exists
 
 
+def fragmentation(free: set[Coord], mesh: Sequence[int],
+                  torus: bool = True) -> float:
+    """THE fleet fragmentation definition, shared by the defrag
+    planner (controllers/migrate.py), the ClusterMonitor
+    ``tpu_cluster_fragmentation`` gauge, kmon recording rules, and
+    ``ktl top nodes``: ``1 - largest free contiguous box / free
+    chips``. 0.0 = every free chip reachable as one box (including the
+    empty slice — nothing to defragment); approaching 1.0 = free
+    capacity shredded into unusably small boxes.
+    """
+    if not free:
+        return 0.0
+    return 1.0 - largest_free_box_volume(free, mesh, torus) / len(free)
+
+
 def find_box_containing(available: set[Coord], mesh: Sequence[int],
                         shape: Sequence[int], required: Iterable[Coord],
                         torus: bool = True) -> Optional[list[Coord]]:

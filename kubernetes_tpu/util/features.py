@@ -135,6 +135,18 @@ KNOWN_FEATURES = {f.name: f for f in [
             "the node's last degrading alert resolves — the seam a "
             "migration/defrag controller consumes. Requires "
             "ClusterMetricsPipeline; off = alerts record Events only"),
+    Feature("GangLiveMigration", False, ALPHA,
+            "live gang migration + defragmentation (controllers/"
+            "migrate.py): reserve-then-move — CAS a target contiguous "
+            "sub-mesh reservation in the scheduler cache FIRST, then "
+            "checkpoint-migrate the gang through the graceful "
+            "preemption engine onto the reserved box; triggers are "
+            "tpu.google.com/degraded taints (evacuate sick chips "
+            "before they fail) and a defrag planner scoring moves by "
+            "the gain in largest_free_box_volume, under a budget "
+            "(max concurrent rounds, per-gang cooldown). Requires "
+            "GracefulPreemption for actual moves. Off = no watches, "
+            "no reservations, no status writes — byte-identical"),
     Feature("SchedulerFastPath", False, ALPHA,
             "columnar scheduler hot path (scheduler/fleetarray.py): a "
             "numpy fleet snapshot maintained incrementally from cache "

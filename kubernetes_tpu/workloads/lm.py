@@ -404,6 +404,17 @@ def make_train_step(cfg: LMConfig, mesh, lr: float = 3e-3):
     the fp32 master when params are stored low-precision)."""
     opt = make_optimizer(lr)
 
+    def pin(params):
+        # Without an output constraint GSPMD is free to reshard the
+        # updated params away from param_specs (e.g. the embedding
+        # picks up a tp axis), which breaks buffer donation AND the
+        # checkpoint contract: restore shards like an init_sharded
+        # template, so a drifted live layout would reshard every leaf
+        # on resume.
+        return jax.tree.map(
+            lambda p, s: lax.with_sharding_constraint(
+                p, NamedSharding(mesh, s)), params, param_specs(cfg))
+
     def step(params, opt_state, batch):
         loss, grads = jax.value_and_grad(loss_fn)(params, batch, cfg, mesh)
         if _is_mixed(cfg):
@@ -414,9 +425,9 @@ def make_train_step(cfg: LMConfig, mesh, lr: float = 3e-3):
             master = optax.apply_updates(master, updates)
             params = jax.tree_util.tree_map(
                 lambda mstr, p: mstr.astype(p.dtype), master, params)
-            return params, (inner, master), loss
+            return pin(params), (inner, pin(master)), loss
         updates, opt_state = opt.update(grads, opt_state, params)
-        return optax.apply_updates(params, updates), opt_state, loss
+        return pin(optax.apply_updates(params, updates)), opt_state, loss
 
     return jax.jit(step, donate_argnums=(0, 1))
 

@@ -82,9 +82,13 @@ def ring_attention(q, k, v, mesh, *, seq_axis: str = "sp",
     the ring axis. Degenerates to ordinary blockwise attention when
     the ring has one member."""
     spec = P(batch_axes, "tp", seq_axis, None)
+    # check_rep=False: jax 0.4.37's replication-type inference flags a
+    # mismatched scan carry on the fori_loop ring (the K/V blocks) and
+    # upstream's own error text prescribes exactly this workaround; the
+    # numerics tests against reference_attention keep it honest.
     fn = _shard_map(
         functools.partial(_ring_block, axis=seq_axis), mesh=mesh,
-        in_specs=(spec, spec, spec), out_specs=spec)
+        in_specs=(spec, spec, spec), out_specs=spec, check_rep=False)
     return fn(q, k, v)
 
 

@@ -1,0 +1,263 @@
+"""MigrationController planner unit tests: reserve-first ordering,
+the no-landing-spot no-op, the round budget (max_concurrent +
+per-gang cooldown), defrag gain scoring, and gate-off inertness.
+
+The world is hand-built — gang_bench's 4x4x4 slices in a Registry, a
+SchedulerCache primed from those nodes, and informer stores stuffed
+directly (no started informers) — so every planner decision is
+deterministic and inspectable without a running scheduler."""
+import pytest
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.meta import ObjectMeta, now as meta_now
+from kubernetes_tpu.api.scheme import deepcopy
+from kubernetes_tpu.apiserver.admission import default_chain
+from kubernetes_tpu.apiserver.registry import Registry
+from kubernetes_tpu.client.informer import InformerFactory
+from kubernetes_tpu.client.local import LocalClient
+from kubernetes_tpu.controllers import migrate
+from kubernetes_tpu.monitoring.rules import TAINT_DEGRADED
+from kubernetes_tpu.perf.gang_bench import build_slice
+from kubernetes_tpu.queueing.harness import make_gang
+from kubernetes_tpu.scheduler.cache import SchedulerCache
+from kubernetes_tpu.util.features import GATES
+
+
+@pytest.fixture
+def migration_on():
+    was = {g: GATES.enabled(g)
+           for g in ("GangLiveMigration", "GracefulPreemption")}
+    GATES.set("GangLiveMigration", True)
+    GATES.set("GracefulPreemption", True)
+    yield
+    for g, v in was.items():
+        GATES.set(g, v)
+
+
+def make_world(n_slices=1, **mc_kw):
+    reg = Registry()
+    reg.admission = default_chain(reg)
+    reg.create(t.Namespace(metadata=ObjectMeta(name="default")))
+    for i in range(n_slices):
+        build_slice(reg, i)
+    cache = SchedulerCache()
+    nodes, _ = reg.list("nodes")
+    for n in nodes:
+        cache.set_node(n)
+    client = LocalClient(reg)
+    factory = InformerFactory(client)
+    kw = dict(cache_probe=lambda: cache, interval=3600.0,
+              max_concurrent=1, cooldown_seconds=120.0,
+              round_timeout_seconds=60.0)
+    kw.update(mc_kw)
+    mc = migrate.MigrationController(client, factory, **kw)
+    for n in nodes:
+        mc.node_informer.store.upsert(n)
+    return reg, cache, client, mc
+
+
+def bind_gang(reg, cache, mc, name, hosts, shape=(2, 2, 2),
+              checkpoint=True):
+    """A gang bound onto whole hosts (each build_slice host owns one
+    2x2x1 tile of 4 chips), mirrored into registry + cache + stores."""
+    group, pods = make_gang(name, "default", "lq", shape=list(shape),
+                            checkpoint_grace=10.0 if checkpoint else None)
+    reg.create(group)
+    group = reg.get("podgroups", "default", name)
+    mc.group_informer.store.upsert(group)
+    for pod, host in zip(pods, hosts):
+        pod.spec.node_name = host
+        pod.status.phase = t.POD_RUNNING
+        pod.spec.tpu_resources[0].assigned = [
+            f"{host}-c{i}" for i in range(4)]
+        cache.add_pod(pod)
+        mc.pod_informer.store.upsert(pod)
+    return group
+
+
+def taint_host(mc, host):
+    node = deepcopy(mc.node_informer.store.get(host))
+    node.spec.taints.append(t.Taint(
+        key=TAINT_DEGRADED, value="TpuChipSick",
+        effect=t.TAINT_NO_SCHEDULE, time_added=meta_now()))
+    mc.node_informer.store.upsert(node)
+
+
+def open_rounds(reg):
+    groups, _ = reg.list("podgroups", "default")
+    return [g.metadata.name for g in groups
+            if g.status.migration is not None
+            and g.status.migration.phase in (t.MIGRATE_RESERVED,
+                                             t.MIGRATE_MOVING)]
+
+
+async def test_gate_off_sweep_is_inert():
+    """Gate off: a degraded host under a migratable gang produces no
+    reservation, no status write — byte-identical to the ungated
+    build."""
+    assert not GATES.enabled("GangLiveMigration")
+    reg, cache, _client, mc = make_world(n_slices=2)
+    bind_gang(reg, cache, mc, "ev-00",
+              ["slice-000-host-00", "slice-000-host-04"])
+    taint_host(mc, "slice-000-host-00")
+    await mc.sweep_once()
+    assert cache.reservations == {}
+    group = reg.get("podgroups", "default", "ev-00")
+    assert group.status.migration is None
+
+
+async def test_evacuation_reserves_off_the_sick_host(migration_on):
+    """Degraded taint under a bound member: the round reserves a box
+    that avoids the degraded host BEFORE signaling, and the gang ends
+    the sweep Signaled with the reservation still held."""
+    reg, cache, _client, mc = make_world(n_slices=1)
+    bind_gang(reg, cache, mc, "ev-00",
+              ["slice-000-host-00", "slice-000-host-04"])
+    taint_host(mc, "slice-000-host-00")
+    await mc.sweep_once()
+    assert open_rounds(reg) == ["ev-00"]
+    res = cache.reservations.get("default/ev-00")
+    assert res is not None and len(res.cells) == 8
+    assert all(n != "slice-000-host-00" for n, _ in res.cells.values())
+    group = reg.get("podgroups", "default", "ev-00")
+    assert group.status.migration.reason == t.MIGRATE_REASON_DEGRADED
+    assert group.status.migration.phase == t.MIGRATE_MOVING
+    pre = group.status.preemption
+    assert pre is not None and pre.phase == t.PREEMPT_SIGNALED
+    assert sorted(pre.signaled) == ["ev-00-0", "ev-00-1"]
+
+
+async def test_no_landing_spot_degrades_to_noop(migration_on):
+    """A full slice: nowhere to land means NO round — no reservation,
+    no signal, no status write; only the no-target counter moves. A
+    migration must never become an eviction in disguise."""
+    reg, cache, _client, mc = make_world(n_slices=1)
+    bind_gang(reg, cache, mc, "ev-00",
+              ["slice-000-host-00", "slice-000-host-04"])
+    fillers = [(by + bx * 2 + z * 4, by + bx * 2 + (z + 1) * 4)
+               for z in (0, 2) for bx in range(2) for by in range(2)]
+    for i, (h0, h1) in enumerate(f for f in fillers if f != (0, 4)):
+        bind_gang(reg, cache, mc, f"fill-{i:02d}",
+                  [f"slice-000-host-{h0:02d}", f"slice-000-host-{h1:02d}"],
+                  checkpoint=False)
+    taint_host(mc, "slice-000-host-00")
+    before = migrate.NO_TARGET_TOTAL.value(reason=t.MIGRATE_REASON_DEGRADED)
+    await mc.sweep_once()
+    assert open_rounds(reg) == []
+    assert cache.reservations == {}
+    assert reg.get("podgroups", "default", "ev-00").status.migration is None
+    after = migrate.NO_TARGET_TOTAL.value(reason=t.MIGRATE_REASON_DEGRADED)
+    assert after == before + 1
+
+
+async def test_max_concurrent_bounds_open_rounds(migration_on):
+    """Two sick gangs, budget 1: one round per sweep; the open round
+    blocks the second until the budget is raised."""
+    reg, cache, _client, mc = make_world(n_slices=2, max_concurrent=1)
+    bind_gang(reg, cache, mc, "ev-00",
+              ["slice-000-host-00", "slice-000-host-04"])
+    bind_gang(reg, cache, mc, "ev-01",
+              ["slice-001-host-00", "slice-001-host-04"])
+    taint_host(mc, "slice-000-host-00")
+    taint_host(mc, "slice-001-host-00")
+    await mc.sweep_once()
+    assert open_rounds(reg) == ["ev-00"]
+    # The open round is re-listed by the next sweep (informer echo).
+    mc.group_informer.store.upsert(reg.get("podgroups", "default", "ev-00"))
+    await mc.sweep_once()
+    assert open_rounds(reg) == ["ev-00"]
+    mc.max_concurrent = 2
+    await mc.sweep_once()
+    assert sorted(open_rounds(reg)) == ["ev-00", "ev-01"]
+
+
+async def test_cooldown_spaces_rounds_per_gang(migration_on):
+    """A gang that just finished a round is not re-migrated until
+    cooldown_seconds have passed."""
+    reg, cache, _client, mc = make_world(n_slices=1,
+                                         cooldown_seconds=300.0)
+    group = bind_gang(reg, cache, mc, "ev-00",
+                      ["slice-000-host-00", "slice-000-host-04"])
+    cooled = deepcopy(group)
+    cooled.status.migration = t.MigrationStatus(
+        phase="", outcome="moved", finished_time=meta_now(), rounds=1)
+    mc.group_informer.store.upsert(cooled)
+    taint_host(mc, "slice-000-host-00")
+    await mc.sweep_once()
+    assert open_rounds(reg) == []
+    mc.cooldown_seconds = 0.0
+    await mc.sweep_once()
+    assert open_rounds(reg) == ["ev-00"]
+
+
+async def test_raced_round_releases_the_reservation(migration_on):
+    """Reserve-first's failure leg: the reservation is taken before
+    the durable status CAS; when the CAS loses (another round already
+    open on the fresh copy), the reservation must be released, not
+    leaked until TTL."""
+    reg, cache, client, mc = make_world(n_slices=1)
+    stale = bind_gang(reg, cache, mc, "ev-00",
+                      ["slice-000-host-00", "slice-000-host-04"])
+    from kubernetes_tpu import preemption as gp
+
+    def mutate(cur):
+        cur.status.migration = t.MigrationStatus(
+            phase=t.MIGRATE_RESERVED, reason=t.MIGRATE_REASON_DEGRADED,
+            target_slice="slice-000", deadline=9e18)
+        return None
+    assert await gp._update_group_status(
+        client, "default", "ev-00", mutate) is not None
+    target = mc._find_target(cache, stale, {"slice-000-host-00"})
+    assert target is not None
+    started = await mc._start_round(
+        cache, stale, t.MIGRATE_REASON_DEGRADED, *target)
+    assert started is False
+    assert cache.reservations == {}
+
+
+async def test_defrag_moves_the_small_donor_for_gain(migration_on):
+    """Defrag scoring: a 4x4x4 gang is blocked on both slices; moving
+    the 2x2x2 donor cross-slice merges slice-000's free space into one
+    4x4x2 box (gain = 16 largest-free-box chips). The pinned 4x4x2
+    gang (no checkpoint opt-in) is never a donor."""
+    reg, cache, _client, mc = make_world(n_slices=2)
+    # slice-000: pin fills z=2..3 (hosts 8..15), donor holds the
+    # (0..1, 0..1, 0..1) box (hosts 0 and 4).
+    bind_gang(reg, cache, mc, "pin-00",
+              [f"slice-000-host-{h:02d}" for h in range(8, 16)],
+              shape=(4, 4, 2), checkpoint=False)
+    bind_gang(reg, cache, mc, "don-00",
+              ["slice-000-host-00", "slice-000-host-04"])
+    # slice-001: a filler so the big gang cannot land there either.
+    bind_gang(reg, cache, mc, "fil-00",
+              ["slice-001-host-00", "slice-001-host-04"],
+              checkpoint=False)
+    big, _pods = make_gang("big-00", "default", "lq", shape=[4, 4, 4])
+    big.status.phase = t.PODGROUP_PENDING
+    mc.group_informer.store.upsert(big)
+    groups = [g for g in mc.group_informer.store.list()
+              if isinstance(g, t.PodGroup)]
+    plans = list(mc._plan(cache, groups))
+    assert [(g.key(), reason) for g, reason, _c, _s in plans] == \
+        [("default/don-00", t.MIGRATE_REASON_DEFRAG)]
+    _g, _reason, cells, slice_id = plans[0]
+    assert slice_id == "slice-001"
+    assert len(cells) == 8
+    assert migrate.DEFRAG_GAIN_CHIPS.value() == 16.0
+
+
+async def test_defrag_off_plans_nothing(migration_on):
+    """defrag=False: the evacuation trigger still works but no
+    utilization-driven move is ever planned."""
+    reg, cache, _client, mc = make_world(n_slices=2, defrag=False)
+    bind_gang(reg, cache, mc, "pin-00",
+              [f"slice-000-host-{h:02d}" for h in range(8, 16)],
+              shape=(4, 4, 2), checkpoint=False)
+    bind_gang(reg, cache, mc, "don-00",
+              ["slice-000-host-00", "slice-000-host-04"])
+    big, _pods = make_gang("big-00", "default", "lq", shape=[4, 4, 4])
+    big.status.phase = t.PODGROUP_PENDING
+    mc.group_informer.store.upsert(big)
+    groups = [g for g in mc.group_informer.store.list()
+              if isinstance(g, t.PodGroup)]
+    assert list(mc._plan(cache, groups)) == []

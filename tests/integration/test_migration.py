@@ -1,0 +1,157 @@
+"""Live gang migration end to end.
+
+Two tiers: the harness acceptance scenarios (evacuation with the
+controller crashed mid-round, the defrag donor move) and the full
+LocalCluster lifecycle — chaos injects a sick chip, kmon's TpuChipSick
+alert taints the node, the migration controller checkpoint-moves the
+gang onto the healthy slice BEFORE the chip dies, and the taint lifts
+when the alert resolves. The gang must never lose its checkpoint and
+no chip may ever be double-booked."""
+import asyncio
+import inspect
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.chaos import core as chaos_core
+from kubernetes_tpu.cluster.local import LocalCluster, NodeSpec
+from kubernetes_tpu.monitoring.rules import TAINT_DEGRADED
+from kubernetes_tpu.queueing.harness import (
+    _member_keeper, make_gang, run_defrag_smoke, run_migrate_smoke)
+from kubernetes_tpu.util.features import GATES
+
+GATES_ON = ("ClusterMetricsPipeline", "AlertNodeTainting",
+            "GracefulPreemption", "GangLiveMigration")
+
+
+async def wait_for(probe, timeout: float = 40.0, what: str = ""):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while True:
+        got = probe()
+        if inspect.isawaitable(got):
+            got = await got
+        if got:
+            return got
+        assert asyncio.get_running_loop().time() < deadline, \
+            f"timed out waiting for {what}"
+        await asyncio.sleep(0.2)
+
+
+async def test_migrate_smoke_harness():
+    """Degraded-node evacuation with the seeded crash-mid-round chaos
+    site: the durable round resumes and the gang lands off the sick
+    host from its checkpoint."""
+    out = await run_migrate_smoke(seed=11, timeout=45.0)
+    assert out["outcome"] == "moved"
+    assert out["reason"] == "degraded-node"
+    assert out["off_sick_host"]
+    assert out["checkpoint_step"] > 0
+    assert out["crash_faults"] == 1
+
+
+async def test_defrag_smoke_harness():
+    """The defrag planner moves the small donor so the blocked
+    full-slice gang can place."""
+    out = await run_defrag_smoke(seed=11, timeout=45.0)
+    assert out["donor_outcome"] == "moved"
+    assert out["donor_reason"] == "defrag"
+    assert out["big_bound"] >= 16
+
+
+async def test_chaos_sick_chip_checkpoint_migration_lifecycle():
+    """chaos chip fault -> TpuChipSick fires -> degraded taint ->
+    reserve-then-move migration off the sick node with the checkpoint
+    intact -> chip recovers -> alert resolves -> untaint. Zero
+    double-booked chips at every step the test observes."""
+    was = {g: GATES.enabled(g) for g in GATES_ON}
+    for g in GATES_ON:
+        GATES.set(g, True)
+    controller = chaos_core.arm(chaos_core.ChaosController(19, ()))
+    cluster = LocalCluster(
+        nodes=[NodeSpec(name="mig-0", tpu_chips=4, fake_runtime=True),
+               NodeSpec(name="mig-1", tpu_chips=4, fake_runtime=True)],
+        tls=False, heartbeat_interval=0.2, status_interval=0.2,
+        monitor_interval=0.25, metrics_interval=0.25,
+        migration_interval=0.3)
+    keeper = None
+    try:
+        await cluster.start()
+        await cluster.wait_for_nodes_ready(30.0)
+        local = cluster.local_client()
+        reg = cluster.registry
+
+        # A checkpoint-opted gang needing a full node (2x2x1 = 4
+        # chips): the scheduler's sorted-slice order binds it on
+        # mig-0, which is also the chaos driver's first device plugin.
+        group, pods = make_gang("mig-gang", "default", "",
+                                shape=[2, 2, 1], checkpoint_grace=5.0)
+        await local.create(group)
+        for pod in pods:
+            await local.create(pod)
+        keeper = _member_keeper(reg, local, {
+            "mig-gang": ("default", "", 1)})
+
+        def bound_nodes():
+            pods_now, _ = reg.list("pods", "default")
+            return {p.spec.node_name for p in pods_now
+                    if p.spec.gang == "mig-gang" and t.is_pod_active(p)
+                    and p.spec.node_name}
+        await wait_for(lambda: bound_nodes() == {"mig-0"},
+                       what="gang bound on mig-0")
+
+        # The fault window is finite: make sure kmon is scraping
+        # before opening it, or the sick chip heals unobserved.
+        pipeline = await wait_for(
+            lambda: cluster.controller_manager.get_controller(
+                "metrics-pipeline"), what="pipeline controller")
+        await wait_for(lambda: pipeline.ticks >= 2, what="first ticks")
+
+        controller.trigger(chaos_core.SITE_DEVICE, "unhealthy",
+                           param=8.0)
+        cluster.chaos_driver.tick()
+
+        def tainted():
+            nodes, _ = reg.list("nodes")
+            return {n.metadata.name for n in nodes
+                    if any(ta.key == TAINT_DEGRADED
+                           for ta in n.spec.taints)}
+        await wait_for(lambda: tainted() == {"mig-0"},
+                       what="TpuChipSick degraded taint on mig-0")
+
+        def moved():
+            g = reg.get("podgroups", "default", "mig-gang")
+            mig = g.status.migration
+            return mig is not None and mig.outcome == "moved" \
+                and mig.phase == ""
+        await wait_for(moved, what="migration round to close moved")
+        await wait_for(lambda: bound_nodes() == {"mig-1"},
+                       what="gang re-bound off the sick node")
+
+        g = reg.get("podgroups", "default", "mig-gang")
+        assert g.status.migration.reason == "degraded-node"
+        assert g.status.migration.rounds >= 1
+        # The move went through the checkpoint protocol, not a kill.
+        assert g.status.preemption is not None
+        assert g.status.preemption.checkpoint_step > 0
+
+        # No chip is ever charged twice across active pods.
+        pods_now, _ = reg.list("pods", "")
+        seen = set()
+        for p in pods_now:
+            if not t.is_pod_active(p):
+                continue
+            for claim in p.spec.tpu_resources:
+                for cid in claim.assigned:
+                    assert cid not in seen, f"chip {cid} double-booked"
+                    seen.add(cid)
+
+        # The chip heals (chaos restores after param seconds): the
+        # alert resolves and the taint lifts — the node returns to the
+        # pool without anyone restarting anything.
+        await wait_for(lambda: not tainted(), timeout=40.0,
+                       what="alert resolve + untaint")
+    finally:
+        if keeper is not None:
+            keeper.cancel()
+        chaos_core.disarm()
+        await cluster.stop()
+        for g, v in was.items():
+            GATES.set(g, v)

@@ -69,13 +69,16 @@ async def test_udp_wire_round_trip():
         loop = asyncio.get_running_loop()
         sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
         sock.setblocking(False)
+        # Connected-UDP + sock_sendall: loop.sock_sendto only exists
+        # on Python >= 3.11.
+        sock.connect(("127.0.0.1", dns.port))
         query = make_query("api.default.svc.cluster.local")
-        await loop.sock_sendto(sock, query, ("127.0.0.1", dns.port))
+        await loop.sock_sendall(sock, query)
         data = await asyncio.wait_for(loop.sock_recv(sock, 512), 5.0)
         assert parse_answer_ips(data) == ["10.96.0.1"]
         # NXDOMAIN for unknown names.
-        await loop.sock_sendto(sock, make_query("gone.default.svc.cluster.local"),
-                               ("127.0.0.1", dns.port))
+        await loop.sock_sendall(
+            sock, make_query("gone.default.svc.cluster.local"))
         data = await asyncio.wait_for(loop.sock_recv(sock, 512), 5.0)
         assert parse_answer_ips(data) == []
         sock.close()

@@ -301,3 +301,80 @@ def test_wal_replay_identity_across_compaction_and_rotation(tmp_path):
         invariants.disarm()
     assert reg.violations == []
     assert reg.checks["wal-replay"] == 1
+
+
+# ---------------------------------------------------------------------------
+# migration-no-strand (PR 19)
+# ---------------------------------------------------------------------------
+
+def _migrating_group(name, phase="Moving", min_member=1):
+    g = _group(name, False, queue="", min_member=min_member)
+    g["status"]["migration"] = {"phase": phase, "reason": "degraded-node"}
+    return g
+
+
+def test_catches_migration_both_charged():
+    """A target reservation overlapping chips the gang is still bound
+    to charges the same capacity twice — fires immediately, no grace."""
+    reg = _armed()
+    try:
+        store = MVCCStore()
+        store.create("/registry/podgroups/default/gg",
+                     _migrating_group("gg"))
+        store.create("/registry/pods/default/m0",
+                     _pod("m0", node="n1", chips=("c0",), gang="gg"))
+        invariants.note_reservation("default/gg", [("n1", "c0")])
+        reg.check_final()
+    finally:
+        invariants.disarm()
+    assert [v.invariant for v in reg.violations] == ["migration-no-strand"]
+    assert "charged twice" in reg.violations[0].message
+
+
+def test_catches_migration_strand():
+    """An open round holding NEITHER a placement nor a reservation
+    past the revision grace: the migration degraded to an eviction."""
+    reg = _armed(partial_grace_revs=3)
+    try:
+        store = MVCCStore()
+        store.create("/registry/podgroups/default/gg",
+                     _migrating_group("gg"))
+        for i in range(5):  # unrelated cluster progress burns the grace
+            store.create(f"/registry/configmaps/default/c{i}",
+                         {"metadata": {"name": f"c{i}"}})
+        reg.check_final()
+    finally:
+        invariants.disarm()
+    assert [v.invariant for v in reg.violations] == ["migration-no-strand"]
+    assert "stranded" in reg.violations[0].message
+
+
+def test_migration_round_lifecycle_is_clean():
+    """The healthy reserve-then-move shape: disjoint reservation while
+    bound, reservation consumed as the rebind lands, round closed —
+    the strand clock must never fire."""
+    reg = _armed(partial_grace_revs=3)
+    try:
+        store = MVCCStore()
+        store.create("/registry/podgroups/default/gg",
+                     _migrating_group("gg"))
+        store.create("/registry/pods/default/m0",
+                     _pod("m0", node="n1", chips=("c0",), gang="gg"))
+        invariants.note_reservation("default/gg", [("n2", "c9")])
+        # Scheduler consumes the reservation, then the rebind lands a
+        # couple of writes later (within the revision grace).
+        store.delete("/registry/pods/default/m0")
+        invariants.note_reservation_gone("default/gg")
+        store.create("/registry/pods/default/m0r",
+                     _pod("m0r", node="n2", chips=("c9",), gang="gg"))
+        closed = _group("gg", False, queue="")
+        closed["status"]["migration"] = {"phase": "", "outcome": "moved"}
+        store.update("/registry/podgroups/default/gg", closed)
+        for i in range(5):
+            store.create(f"/registry/configmaps/default/c{i}",
+                         {"metadata": {"name": f"c{i}"}})
+        reg.check_final()
+    finally:
+        invariants.disarm()
+    assert reg.violations == []
+    assert reg.checks["migration-no-strand"] > 0

@@ -210,6 +210,26 @@ E = Counter("mvcc_txn_commits_total", "re-registered: silently inert")
     assert len(got) == 1 and "already registered" in got[0].message
 
 
+def test_metric_name_migration_families():
+    """The live-migration metric families (migration_*, the shared
+    fragmentation gauge) are valid names, and a duplicate
+    registration within the family is still caught."""
+    good = """
+from kubernetes_tpu.metrics.registry import Counter, Gauge
+A = Counter("migration_rounds_total", "x", labels=("reason", "outcome"))
+B = Gauge("migration_rounds_open", "x")
+C = Counter("migration_no_target_total", "x", labels=("reason",))
+D = Gauge("migration_defrag_gain_chips", "x")
+E = Gauge("tpu_cluster_fragmentation", "x")
+"""
+    assert run_source(good, checks=["metric-name"]) == []
+    bad = good + """
+F = Counter("migration_rounds_total", "re-registered: silently inert")
+"""
+    got = run_source(bad, checks=["metric-name"])
+    assert len(got) == 1 and "already registered" in got[0].message
+
+
 def test_metric_name_preemption_and_goodput_family():
     """The graceful-preemption metric family (preemption_*, the
     goodput gauge) are valid names, and a duplicate registration
