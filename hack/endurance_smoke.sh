@@ -1,5 +1,5 @@
 #!/usr/bin/env bash
-# Control-plane endurance smoke (<90s): a mini sustained-churn run
+# Control-plane endurance smoke: a mini sustained-churn run
 # (perf/churn_bench.py) with aggressive hygiene settings — small
 # revision retention, a tiny WAL rotation threshold, WatchBookmarks on
 # — over an in-process apiserver + informer. Asserts the aging loop
@@ -8,9 +8,12 @@
 # the retention window (not the write count), the informer's watch
 # never stalls, and api p99 does not climb across the run. Catches
 # "the control plane ages" end to end: compactor wiring, snapshot
-# rotation, bookmark delivery, informer resume.
+# rotation, bookmark delivery, informer resume. The final stanza adds
+# WIDTH to the aging axis: a 1k-hollow-node fleet churning against the
+# durable stack (WAL + online compaction on), asserting RSS and api
+# p99 stay flat while a thousand real NodeAgents heartbeat.
 # Siblings: hack/bench_smoke.sh (perf arm), hack/chaos.sh (fault arm),
-# hack/test.sh (runs all).
+# hack/fleet_smoke.sh (pure width arm), hack/test.sh (runs all).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -60,5 +63,41 @@ from kubernetes_tpu.perf.churn_bench import (check_wal_amortization,
 report = asyncio.run(run_wal_amortization(n_pods=1536, chunk=64))
 print(json.dumps(report))
 check_wal_amortization(report)
+EOF
+
+# Hollow-fleet width stanza (PR 20): 1k real NodeAgents (hollow —
+# FakeRuntime, slimmed) churning against the DURABLE stack with WAL
+# rotation and online compaction on. Endurance so far proved the
+# control plane survives sustained WRITES; this proves it survives
+# sustained WIDTH — a thousand heartbeat/status/lease writers plus
+# a thousand indexed pod watches — without RSS or api p99 drifting.
+# Runs via `python -m` (the fleet workers use multiprocessing spawn,
+# which cannot bootstrap from a stdin heredoc).
+FLEET_OUT="$(mktemp /tmp/endurance_fleet.XXXXXX.json)"
+trap 'rm -f "$FLEET_OUT"' EXIT
+timeout -k 10 290 env JAX_PLATFORMS=cpu \
+    python -m kubernetes_tpu.perf.fleet_bench endurance 1000 3000 \
+    > "$FLEET_OUT"
+env FLEET_OUT="$FLEET_OUT" python - <<'EOF'
+import json, os, sys
+
+r = json.load(open(os.environ["FLEET_OUT"]))
+print(json.dumps({k: v for k, v in r.items() if k != "loopsan"}))
+if not r["durable"]:
+    sys.exit("endurance_smoke: fleet stanza ran without the WAL stack")
+st = r["stages"][0]
+if st["width"] != 1000:
+    sys.exit(f"endurance_smoke: fleet width {st['width']} != 1000")
+if st["watchers_indexed"] < 1000:
+    sys.exit("endurance_smoke: per-node watches fell off the index "
+             f"path ({st['watchers_indexed']} < 1000)")
+c = st["churn"]
+if c["api_p99_first_ms"] > 0 and c["api_p99_drift"] > 0.5:
+    sys.exit("endurance_smoke: api p99 climbed across the fleet churn "
+             f"(drift {c['api_p99_drift']})")
+b = st["budget"]
+if b.get("rss_drift", 0.0) > 0.3:
+    sys.exit("endurance_smoke: fleet RSS drifted across the churn "
+             f"({b['rss_drift']})")
 EOF
 echo "endurance_smoke: ok"

@@ -1,0 +1,59 @@
+#!/usr/bin/env bash
+# fleet_smoke.sh — hollow-node fleet width smoke (<120s).
+#
+# Boots >= 500 hollow nodes (real NodeAgents over FakeRuntime, sharded
+# across worker processes) against an in-process apiserver, waits for
+# the fleet-wide readiness barrier, runs a churn slice through full
+# pod lifecycles (create -> schedule -> bind -> run -> graceful
+# delete), and asserts:
+#
+#   - every node reached Ready inside the barrier budget
+#   - per-node pod watches use indexed dispatch (watchers == nodes)
+#   - the churn slice completed and drained to zero pods
+#   - RSS/fd budget accounting was captured (peak per 1k nodes)
+#
+# The bench runs via `python -m` (NOT a stdin heredoc): the fleet
+# workers use the multiprocessing `spawn` start method, which
+# re-imports __main__ and cannot bootstrap from stdin.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+NODES="${KTPU_FLEET_SMOKE_NODES:-500}"
+PODS="${KTPU_FLEET_SMOKE_PODS:-1000}"
+OUT="$(mktemp /tmp/fleet_smoke.XXXXXX.json)"
+trap 'rm -f "$OUT"' EXIT
+
+timeout -k 10 115 env JAX_PLATFORMS=cpu \
+    python -m kubernetes_tpu.perf.fleet_bench smoke "$NODES" "$PODS" \
+    > "$OUT"
+
+env FLEET_SMOKE_OUT="$OUT" FLEET_SMOKE_NODES="$NODES" \
+    FLEET_SMOKE_PODS="$PODS" python - <<'EOF'
+import json, os, sys
+
+r = json.load(open(os.environ["FLEET_SMOKE_OUT"]))
+nodes = int(os.environ["FLEET_SMOKE_NODES"])
+pods = int(os.environ["FLEET_SMOKE_PODS"])
+print(json.dumps(r, indent=1))
+
+if r["nodes"] != nodes:
+    sys.exit(f"expected {nodes} nodes, ran {r['nodes']}")
+if r["ready_s"] > 90.0:
+    sys.exit(f"readiness barrier too slow: {r['ready_s']}s > 90s")
+# Every hollow node holds one pod watch with a spec.nodeName field
+# selector; indexed dispatch means watcher count == node count.
+if r["watchers_indexed"] < nodes:
+    sys.exit(f"indexed watchers {r['watchers_indexed']} < {nodes} — "
+             "per-node watches fell off the index path")
+c = r["churn"]
+if c["pods"] != pods:
+    sys.exit(f"churn ran {c['pods']} pods, wanted {pods}")
+if c["pods_per_s"] <= 0:
+    sys.exit("churn throughput not positive")
+b = r["budget"]
+if not b or b.get("rss_peak_per_1k_nodes_mb", 0) <= 0:
+    sys.exit(f"budget accounting missing/empty: {b}")
+print(f"fleet_smoke: ok — {nodes} nodes ready in {r['ready_s']}s, "
+      f"{c['pods_per_s']} pods/s churn (api p99 {c['api_p99_ms']}ms), "
+      f"{b['rss_peak_per_1k_nodes_mb']}MB peak RSS per 1k nodes")
+EOF

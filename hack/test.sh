@@ -32,6 +32,12 @@
 # hack/endurance_smoke.sh (<90s sustained-churn gate: compact revision
 # advances, WAL snapshots+truncates at its threshold, watch history
 # bounded by retention, informer never stalls, api p99 flat),
+# hack/endurance_smoke.sh also carries the hollow-fleet width stanza
+# (1k-node churn on the durable stack asserting flat RSS/api-p99
+# drift), hack/fleet_smoke.sh (<120s hollow-node fleet gate: >= 500
+# real NodeAgents over FakeRuntime sharded across worker processes
+# all Ready, per-node watches on the indexed dispatch path, a churn
+# slice through full pod lifecycles, RSS/fd budget accounting),
 # hack/race.sh (<150s tpusan gate: chaos + queue +
 # preempt + HA smokes under explored task-interleaving schedules with
 # the cluster invariants armed) — all run on full-suite invocations;
@@ -51,6 +57,7 @@ if [ "$#" -eq 0 ] || [ "${KTPU_SMOKE:-}" = "1" ]; then
   ./hack/train_smoke.sh
   ./hack/mon_smoke.sh
   ./hack/endurance_smoke.sh
+  ./hack/fleet_smoke.sh
   ./hack/race.sh
 fi
 exec python -m pytest tests/ -q "$@"

@@ -153,6 +153,46 @@ def _merge_secret_string_data(sec: t.Secret) -> None:
     sec.string_data = {}
 
 
+def _raw_pod_node_name(value: dict) -> Optional[str]:
+    """Store-side watch-index extractor: the raw-dict mirror of
+    ``_pod_fields``'s ``spec.node_name`` (no typed decode — it runs
+    under the store lock on every pod write)."""
+    spec = value.get("spec")
+    return spec.get("node_name") if isinstance(spec, dict) else None
+
+
+#: plural -> {field-selector key -> store watch-index name}: fields a
+#: single-equality watch selector can subscribe to by bucket instead of
+#: the O(watchers) prefix scan. spec.node_name is THE width field — one
+#: per-node pod watcher per kubelet-analog, 5k of them at hollow-fleet
+#: scale.
+_WATCH_INDEXED_FIELDS = {
+    "pods": {"spec.node_name": "pods.spec.node_name"},
+}
+
+
+def _watch_index_hint(plural: str,
+                      field_selector: str) -> Optional[tuple[str, str]]:
+    """(index name, value) when the selector contains an equality term
+    on an indexed field. Correctness: field selectors AND their terms,
+    so every object the full selector matches extracts to that value —
+    bucket delivery (which also fires for the PREVIOUS value, covering
+    set-leave transitions) is a strict superset of what the watcher's
+    filter can surface."""
+    fields = _WATCH_INDEXED_FIELDS.get(plural)
+    if not fields or not field_selector:
+        return None
+    for part in field_selector.split(","):
+        part = part.strip()
+        if not part or "!=" in part or "=" not in part:
+            continue
+        key, _, val = part.partition("=")
+        name = fields.get(key.strip())
+        if name and val.strip():
+            return (name, val.strip())
+    return None
+
+
 def _event_fields(ev: t.Event) -> dict:
     return {
         "metadata.name": ev.metadata.name,
@@ -302,6 +342,12 @@ class Registry:
         #: /12 -> 4096 node /24 blocks (reference-scale kubemark fleets
         #: run 1000+ hollow nodes; a /16's 256 blocks exhaust there).
         self.cluster_cidr = "10.64.0.0/12"
+        #: --node-cidr-mask-size analog: prefix length of each node's
+        #: pod block. /24 = 4096 blocks of 254 pods under the /12; a
+        #: 5k-node hollow fleet sets 26 (16384 blocks of 62 pods) —
+        #: same trade GKE makes for large clusters. Read once, when
+        #: the allocator is first built.
+        self.node_cidr_mask_size = 24
         self._svc_ips = None     # lazy ServiceIPAllocator
         self._node_cidrs = None  # lazy CIDRAllocator
         # Serialize-once response cache (encodecache.py): encoded JSON
@@ -324,6 +370,12 @@ class Registry:
         self.replica = None
         for spec in builtin_resources():
             self.add_resource(spec)
+        # Keyed watch dispatch (see MVCCStore.register_watch_index):
+        # per-node pod watchers subscribe by node name, so fleet width
+        # costs one dict lookup per pod event, not a scan of every
+        # watcher.
+        self.store.register_watch_index(
+            "pods.spec.node_name", "/registry/pods/", _raw_pod_node_name)
         # Durable restart: re-install custom resources already defined.
         stored, _rev = self.store.list(
             "/registry/customresourcedefinitions/", copy=False)
@@ -689,7 +741,8 @@ class Registry:
     def _ensure_node_allocator(self) -> None:
         if self._node_cidrs is None:
             from ..net.ipam import CIDRAllocator
-            alloc = CIDRAllocator(self.cluster_cidr)
+            alloc = CIDRAllocator(self.cluster_cidr,
+                                  node_prefix_len=self.node_cidr_mask_size)
             stored, _rev = self.store.list("/registry/nodes/", copy=False)
             for s in stored:
                 cidr = (s.value.get("spec") or {}).get("pod_cidr", "")
@@ -1289,7 +1342,9 @@ class Registry:
               label_selector: str = "", field_selector: str = "",
               loop: Optional[asyncio.AbstractEventLoop] = None) -> "ObjectWatch":
         spec = self.spec_for(plural)
-        raw = self.store.watch(self._prefix(spec, namespace), start_revision, loop=loop)
+        raw = self.store.watch(self._prefix(spec, namespace), start_revision,
+                               loop=loop,
+                               index=_watch_index_hint(plural, field_selector))
         return ObjectWatch(self, spec, raw, label_selector, field_selector)
 
     def watch_raw(self, plural: str, namespace: str = "",

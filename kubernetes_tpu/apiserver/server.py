@@ -105,6 +105,31 @@ BATCH_ITEMS = Counter(
     "Per-item outcomes inside batch API requests",
     labels=("kind", "result"))
 
+#: Watch fan-out accounting, ALWAYS ON (the gated WatchFanoutBatch path
+#: has its own apiserver_fanout_* families): how many streams are open
+#: and what each coalesced write round carries. ``dispatch`` says how
+#: the store delivers to the stream — "indexed" rides a keyed bucket
+#: (per-node pod watchers at fleet width), "scan" pays the per-event
+#: prefix scan. The fleet bench reads bytes/round and stream width here.
+WATCH_STREAMS = Gauge(
+    "apiserver_watch_streams",
+    "Open watch streams by store dispatch mode",
+    labels=("dispatch",))
+
+WATCH_ROUNDS = Counter(
+    "apiserver_watch_rounds_total",
+    "Coalesced watch write rounds (one buffered socket send each)")
+
+WATCH_ROUND_BYTES = Histogram(
+    "apiserver_watch_round_bytes",
+    "Bytes per coalesced watch write round",
+    buckets=(256, 1024, 4096, 16384, 65536, 262144, 1048576),
+    sample_limit=20_000)
+
+WATCH_EVENTS_SENT = Counter(
+    "apiserver_watch_events_sent_total",
+    "Watch event frames written to clients (bookmarks excluded)")
+
 #: Per-request item cap for the batch subresources — one request must
 #: not monopolize the event loop (the reference bounds list chunks the
 #: same way; callers split larger batches).
@@ -2000,46 +2025,61 @@ class APIServer:
         bookmarks_on = GATES.enabled("WatchBookmarks")
         loop = asyncio.get_running_loop()
         last_bookmark = loop.time()
-        if GATES.enabled("WatchFanoutBatch"):
-            return await self._watch_fanout(resp, watch, event_line,
-                                            bookmark_line, bookmarks_on)
+        # Always-on width accounting: how the store dispatches to this
+        # stream (keyed bucket vs prefix scan) + per-round volume below.
+        dispatch = ("indexed"
+                    if getattr(watch._raw, "index", None) is not None
+                    else "scan")
+        WATCH_STREAMS.inc(dispatch=dispatch)
         try:
-            closed = False
-            while not closed:
-                ev = await watch.next(timeout=10.0)
-                if ev is None:
-                    await resp.write(bookmark_line())
-                    last_bookmark = loop.time()
-                    continue
-                # Coalesce every event already in flight into ONE
-                # socket write: per-event writes made the fan-out's
-                # send() syscalls the apiserver's single largest CPU
-                # cost at density scale (N watchers x M events). The
-                # byte stream is identical — same lines, same order —
-                # and consumers iterate by line regardless of framing.
-                chunks: list = []
-                while True:
-                    line = event_line(ev)
-                    if line is None:
-                        closed = True
-                        break
-                    chunks.append(line)
-                    if len(chunks) >= self.watch_write_batch:
-                        break
-                    ev = watch.next_nowait()
+            if GATES.enabled("WatchFanoutBatch"):
+                return await self._watch_fanout(resp, watch, event_line,
+                                                bookmark_line, bookmarks_on)
+            try:
+                closed = False
+                while not closed:
+                    ev = await watch.next(timeout=10.0)
                     if ev is None:
-                        break
-                if bookmarks_on and loop.time() - last_bookmark \
-                        >= self.watch_bookmark_interval:
-                    chunks.append(bookmark_line())
-                    last_bookmark = loop.time()
-                if chunks:
-                    await resp.write(b"".join(chunks))
-        except (ConnectionResetError, asyncio.CancelledError):
-            pass
+                        await resp.write(bookmark_line())
+                        last_bookmark = loop.time()
+                        continue
+                    # Coalesce every event already in flight into ONE
+                    # socket write: per-event writes made the fan-out's
+                    # send() syscalls the apiserver's single largest CPU
+                    # cost at density scale (N watchers x M events). The
+                    # byte stream is identical — same lines, same order —
+                    # and consumers iterate by line regardless of framing.
+                    chunks: list = []
+                    while True:
+                        line = event_line(ev)
+                        if line is None:
+                            closed = True
+                            break
+                        chunks.append(line)
+                        if len(chunks) >= self.watch_write_batch:
+                            break
+                        ev = watch.next_nowait()
+                        if ev is None:
+                            break
+                    n_events = len(chunks)
+                    if bookmarks_on and loop.time() - last_bookmark \
+                            >= self.watch_bookmark_interval:
+                        chunks.append(bookmark_line())
+                        last_bookmark = loop.time()
+                    if chunks:
+                        buf = b"".join(chunks)
+                        WATCH_ROUNDS.inc()
+                        WATCH_ROUND_BYTES.observe(float(len(buf)))
+                        if n_events:
+                            WATCH_EVENTS_SENT.inc(float(n_events))
+                        await resp.write(buf)
+            except (ConnectionResetError, asyncio.CancelledError):
+                pass
+            finally:
+                watch.cancel()
+            return resp
         finally:
-            watch.cancel()
-        return resp
+            WATCH_STREAMS.dec(dispatch=dispatch)
 
     async def _watch_fanout(self, resp, watch, event_line,
                             bookmark_line,

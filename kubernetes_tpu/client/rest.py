@@ -219,7 +219,8 @@ class RESTClient(Client):
                  client_key: str = "", check_hostname: bool = True,
                  impersonate_user: str = "",
                  impersonate_groups: tuple = (),
-                 read_affinity: bool = False):
+                 read_affinity: bool = False,
+                 session: Optional["aiohttp.ClientSession"] = None):
         """``base_url`` may name SEVERAL apiserver endpoints — a
         comma-separated string or a list — for a replicated control
         plane: requests pin to one endpoint and fail over to the next
@@ -236,6 +237,17 @@ class RESTClient(Client):
         ``impersonate_user``/``impersonate_groups``: act as another
         identity (kubectl --as / --as-group; RBAC 'impersonate' verb
         required server-side).
+        ``session``: a SHARED ``aiohttp.ClientSession`` this client
+        rides instead of building its own session + connector. The
+        hollow fleet multiplexes thousands of per-node clients onto one
+        connector pool per event loop this way — N clients otherwise
+        cost N connectors (and N keep-alive sockets minimum). A shared
+        session is NOT owned: ``close()`` leaves it open (the fleet
+        closes it once), and this client's auth headers attach per
+        request instead of per session so sharing never mixes
+        credentials (impersonation's repeated-header form is the one
+        identity a shared session cannot carry — those clients keep
+        their own session).
         ``read_affinity=True`` (multi-endpoint planes only): GETs,
         LISTs, and watches route to FOLLOWER endpoints round-robin —
         bounded-staleness reads carrying X-Ktpu-Max-Staleness
@@ -281,6 +293,12 @@ class RESTClient(Client):
             self._ssl = client_ssl_context(ca_file, client_cert, client_key,
                                            check_hostname=check_hostname)
         self._session: Optional[aiohttp.ClientSession] = None
+        #: Shared (unowned) session, if the composer provided one.
+        self._shared_session = session
+        if session is not None and impersonate_groups:
+            raise ValueError(
+                "shared sessions cannot carry repeated Impersonate-Group "
+                "headers; give impersonating clients their own session")
         #: Per-request deadlines (client-go rest.Config.Timeout analog).
         #: The old default — ClientTimeout(total=None) — meant one
         #: dropped connection hung its controller forever; now every
@@ -407,6 +425,9 @@ class RESTClient(Client):
         ``conn_limit_per_host`` bounds the burst-parallelism fan-out to
         one host; beyond it requests queue on the pool rather than
         opening sockets the apiserver must accept/teardown."""
+        if self._shared_session is not None \
+                and not self._shared_session.closed:
+            return self._shared_session
         if self._session is None or self._session.closed:
             kw = {"ssl": self._ssl} if self._ssl is not None else {}
             connector = aiohttp.TCPConnector(
@@ -414,6 +435,17 @@ class RESTClient(Client):
             self._session = aiohttp.ClientSession(headers=self._headers,
                                                   connector=connector)
         return self._session
+
+    def _identity_kw(self, kw: dict) -> dict:
+        """On a shared session, this client's identity headers ride the
+        REQUEST (the session's defaults belong to whoever built it).
+        Owned sessions already carry them as defaults — no-op."""
+        if self._shared_session is not None and self._headers:
+            headers = dict(kw.pop("headers", None) or {})
+            for k, v in self._headers.items():
+                headers.setdefault(k, v)
+            kw["headers"] = headers
+        return kw
 
     def _url_for(self, api_version: str, plural: str, namespace: str,
                  name: str = "", subresource: str = "") -> str:
@@ -575,6 +607,7 @@ class RESTClient(Client):
         """
         if idempotent is None:
             idempotent = method == "GET"
+        kw = self._identity_kw(kw)
         ct = aiohttp.ClientTimeout(
             total=self.total_timeout if timeout is None else timeout,
             connect=self.connect_timeout)
@@ -881,6 +914,10 @@ class RESTClient(Client):
             headers = dict(headers or {})
             headers["X-Ktpu-Max-Staleness"] = f"{self.max_staleness:.3f}"
             CLIENT_FOLLOWER_READS.inc(outcome="watch_routed")
+        if self._shared_session is not None and self._headers:
+            headers = dict(headers or {})
+            for k, v in self._headers.items():
+                headers.setdefault(k, v)
         return _RESTWatch(self._sess(), url, params, timeout=timeout,
                           headers=headers).start()
 

@@ -1,0 +1,8 @@
+"""Hollow-node fleet subsystem — see :mod:`kubernetes_tpu.hollow.fleet`
+for what a hollow node is (and deliberately is not)."""
+from .device import StaticDeviceManager, hollow_topology
+from .fleet import HollowFleet, open_fds, rss_bytes
+from .proc import ProcFleet
+
+__all__ = ["HollowFleet", "ProcFleet", "StaticDeviceManager",
+           "hollow_topology", "open_fds", "rss_bytes"]

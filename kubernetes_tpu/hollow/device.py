@@ -1,0 +1,75 @@
+"""Static device manager + stub topology for hollow nodes.
+
+Reference: ``pkg/kubelet/cm/devicemanager/plugin/stub.go`` — kubemark's
+hollow kubelet wires device plugins through a stub rather than real
+gRPC sockets, because one process cannot host thousands of gRPC
+servers, and the seam under test is the manager's admission/options
+surface, not the wire.
+"""
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+from ..api import types as t
+from ..node.devicemanager import DeviceManager
+
+
+class StaticDeviceManager(DeviceManager):
+    """Device manager with a fixed topology and local (no-RPC) admit/
+    options — the device_plugin_stub.go equivalent for fleets."""
+
+    def __init__(self, topology: t.TpuTopology, resource: str = t.RESOURCE_TPU):
+        # Deliberately no super().__init__: no plugin dir, no watcher.
+        self._topology = topology
+        self._topology_resource = resource
+        self.on_topology_changed = None
+        self.ready = asyncio.Event()
+        self.ready.set()
+
+    async def start(self) -> None:  # no watcher task
+        return
+
+    async def stop(self) -> None:
+        return
+
+    async def admit_pod(self, pod: t.Pod) -> Optional[str]:
+        known = {c.id: c for c in self._topology.chips}
+        for cid in t.pod_tpu_assigned(pod):
+            chip = known.get(cid)
+            if chip is None:
+                return f"assigned chip {cid!r} does not exist on this node"
+            if chip.health != t.TPU_HEALTHY:
+                return f"assigned chip {cid!r} is {chip.health}"
+        return None
+
+    async def container_options(self, pod: t.Pod, container: t.Container):
+        env: dict[str, str] = {}
+        for claim_name in container.tpu_requests:
+            claim = t.pod_tpu_request(pod, claim_name)
+            if claim is None or not claim.assigned:
+                continue
+            env["TPU_VISIBLE_CHIPS"] = ",".join(claim.assigned)
+            env["TPU_WORKER_ID"] = str(self._topology.worker_index)
+            env["TPU_MESH_SHAPE"] = "x".join(
+                str(d) for d in self._topology.mesh_shape)
+        return env, [], [], {}
+
+
+def hollow_topology(name: str, chips: int, mesh_shape=None,
+                    slice_id: str = "") -> t.TpuTopology:
+    """Stub TPU topology for hollow nodes — the single source for both
+    agent-backed fleets (:mod:`kubernetes_tpu.hollow.fleet`) and
+    API-object-only nodes (:func:`kubernetes_tpu.perf.density.hollow_node`)."""
+    shape = list(mesh_shape) if mesh_shape else (
+        [2, 2, chips // 4] if chips % 4 == 0 else [chips, 1, 1])
+    if shape[0] * shape[1] * shape[2] != chips:
+        raise ValueError(f"mesh_shape {shape} != {chips} chips")
+    return t.TpuTopology(
+        chip_type="v5p", slice_id=slice_id or f"slice-{name}",
+        mesh_shape=shape,
+        chips=[t.TpuChip(
+            id=f"{name}-c{i}", health=t.TPU_HEALTHY,
+            coords=[i % shape[0], (i // shape[0]) % shape[1],
+                    i // (shape[0] * shape[1])],
+            attributes={"chip_type": "v5p"}) for i in range(chips)])

@@ -1,0 +1,237 @@
+"""Hollow-node fleet — the kubemark analog, grown into a subsystem.
+
+Reference: ``cmd/kubemark/hollow-node.go`` + ``pkg/kubemark/
+hollow_kubelet.go:49`` — a real kubelet wired to a fake docker client
+and mock cadvisor, deployed by the hundreds so control-plane scale
+runs (``test/e2e/scalability/``) need no real machines.
+
+Here a hollow node is the *real* :class:`NodeAgent` — sync loop, PLEG,
+per-pod workers, status posts, heartbeat Lease, and a per-node pod
+watch with a ``spec.node_name`` field selector (so apiserver watcher
+count equals node count) — over :class:`FakeRuntime` (containers "run"
+instantly) and :class:`StaticDeviceManager` (fixed stub topology, no
+gRPC socket). What makes thousands of them fit in one process:
+
+- **shared aiohttp session** (one unbounded connector per fleet shard)
+  instead of a session + connector pool per node;
+- **shared services informer** — one services watch per shard, not one
+  per node;
+- **slim agents** (``NodeAgent(slim=True)``): no problem detector, no
+  container GC, no dynamic config — subsystems that exist for real
+  hosts, with zero wire-visible traffic of their own (the parity test
+  in ``tests/integration/test_hollow_parity.py`` holds that line);
+- **phase jitter**: status/heartbeat loops offset deterministically
+  per node so a fleet booted in one burst never renews all its leases
+  in the same scheduling bucket (no thundering herd by construction —
+  ``fleet_bench`` measures the storm both ways);
+- **stretched worker resync** — 100k idle pod workers on a 2 s backstop
+  would wake 50k times/s fleet-wide for nothing.
+
+:class:`HollowFleet` is one shard on the current event loop;
+:mod:`kubernetes_tpu.hollow.proc` multiplexes shards over worker
+processes. Both report RSS / fd / boot-latency budgets through the
+``hollow_fleet_*`` metric families.
+"""
+from __future__ import annotations
+
+import asyncio
+import os
+import time
+from typing import Optional
+
+import aiohttp
+
+from ..api import types as t
+from ..client.informer import SharedInformer
+from ..client.rest import RESTClient
+from ..metrics.registry import REGISTRY as METRICS  # noqa: F401 (re-export)
+from ..metrics.registry import Gauge, Histogram
+from ..node.agent import NodeAgent
+from ..node.runtime import FakeRuntime
+from .device import StaticDeviceManager, hollow_topology
+
+FLEET_NODES = Gauge(
+    "hollow_fleet_nodes",
+    "Hollow nodes in this fleet shard by lifecycle state "
+    "(started = agent boot finished; ready = Ready per apiserver).",
+    labels=("state",))
+FLEET_RSS = Gauge(
+    "hollow_fleet_rss_bytes",
+    "Resident set size of this fleet shard's process.")
+FLEET_FDS = Gauge(
+    "hollow_fleet_open_fds",
+    "Open file descriptors in this fleet shard's process.")
+NODE_START = Histogram(
+    "hollow_fleet_node_start_seconds",
+    "Per-node agent boot latency (register + informer sync + loops).",
+    buckets=(0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+             30.0),
+    sample_limit=10_000)
+
+_PAGE = os.sysconf("SC_PAGE_SIZE") if hasattr(os, "sysconf") else 4096
+
+
+def rss_bytes() -> int:
+    """Resident set size of this process from /proc/self/statm."""
+    try:
+        with open("/proc/self/statm") as f:
+            return int(f.read().split()[1]) * _PAGE
+    except (OSError, IndexError, ValueError):
+        return 0
+
+
+def open_fds() -> int:
+    try:
+        return len(os.listdir("/proc/self/fd"))
+    except OSError:
+        return 0
+
+
+class HollowFleet:
+    """N hollow node agents against one apiserver URL, on one loop.
+
+    ``phase_jitter=None`` (default) spreads each periodic loop across
+    its full interval; pass ``0.0`` to boot a deliberately phase-locked
+    fleet (the thundering-herd control arm). ``share_session=True``
+    multiplexes every node's HTTP + watch traffic over one connector;
+    per-node watch streams still hold one socket each (the connector is
+    unbounded for that reason)."""
+
+    def __init__(self, base_url: str, n_nodes: int, tpu_chips: int = 0,
+                 status_interval: float = 10.0,
+                 heartbeat_interval: float = 5.0,
+                 pleg_interval: float = 2.0,
+                 name_prefix: str = "hollow",
+                 slim: bool = True,
+                 phase_jitter: Optional[float] = None,
+                 worker_resync: float = 15.0,
+                 share_session: bool = True):
+        self.base_url = base_url
+        self.n_nodes = n_nodes
+        self.tpu_chips = tpu_chips
+        self.status_interval = status_interval
+        self.heartbeat_interval = heartbeat_interval
+        self.pleg_interval = pleg_interval
+        self.name_prefix = name_prefix
+        self.slim = slim
+        self.phase_jitter = (max(status_interval, heartbeat_interval)
+                             if phase_jitter is None else phase_jitter)
+        self.worker_resync = worker_resync
+        self.share_session = share_session
+        self.agents: list[NodeAgent] = []
+        self._clients: list[RESTClient] = []
+        self._session: Optional[aiohttp.ClientSession] = None
+        self._fleet_client: Optional[RESTClient] = None
+        self._svc_informer: Optional[SharedInformer] = None
+
+    # -- lifecycle --------------------------------------------------------
+
+    def _client(self) -> RESTClient:
+        if self._session is not None:
+            return RESTClient(self.base_url, session=self._session)
+        return RESTClient(self.base_url)
+
+    async def start(self, start_concurrency: int = 32) -> None:
+        if self.share_session:
+            # One connector for the whole shard. Unbounded: each node's
+            # pod watch parks a connection for its lifetime, so any
+            # limit below n_nodes deadlocks the boot.
+            self._session = aiohttp.ClientSession(
+                connector=aiohttp.TCPConnector(limit=0, limit_per_host=0))
+        self._fleet_client = self._client()
+        self._svc_informer = SharedInformer(self._fleet_client, "services")
+        self._svc_informer.start()
+        await self._svc_informer.wait_for_sync()
+
+        names = [f"{self.name_prefix}-{i:04d}" for i in range(self.n_nodes)]
+        it = iter(names)
+
+        async def worker():
+            for name in it:
+                dm = (StaticDeviceManager(hollow_topology(name, self.tpu_chips))
+                      if self.tpu_chips else None)
+                client = self._client()
+                agent = NodeAgent(
+                    client, name, FakeRuntime(), device_manager=dm,
+                    status_interval=self.status_interval,
+                    heartbeat_interval=self.heartbeat_interval,
+                    pleg_interval=self.pleg_interval,
+                    server_port=None,  # 5000 HTTP servers would be silly
+                    slim=self.slim,
+                    phase_jitter=self.phase_jitter,
+                    worker_resync=self.worker_resync,
+                    services_informer=self._svc_informer)
+                t0 = time.monotonic()
+                await agent.start()
+                NODE_START.observe(time.monotonic() - t0)
+                self.agents.append(agent)
+                self._clients.append(client)
+                FLEET_NODES.set(float(len(self.agents)), state="started")
+        await asyncio.gather(*(worker() for _ in range(start_concurrency)))
+        self.sample()
+
+    async def wait_ready(self, timeout: float = 120.0,
+                         poll: float = 1.0) -> float:
+        """Fleet-wide readiness barrier: block until every node of this
+        shard is Ready per the apiserver; return elapsed seconds."""
+        assert self._fleet_client is not None, "call start() first"
+        prefix = f"{self.name_prefix}-"
+        t0 = time.monotonic()
+        deadline = t0 + timeout
+        while True:
+            nodes, _ = await self._fleet_client.list("nodes")
+            ready = sum(
+                1 for n in nodes
+                if n.metadata.name.startswith(prefix)
+                and (c := t.get_node_condition(n.status, t.NODE_READY))
+                is not None and c.status == "True")
+            FLEET_NODES.set(float(ready), state="ready")
+            if ready >= self.n_nodes:
+                return time.monotonic() - t0
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"{ready}/{self.n_nodes} hollow nodes Ready "
+                    f"after {timeout:.0f}s")
+            await asyncio.sleep(poll)
+
+    # -- accounting -------------------------------------------------------
+
+    def sample(self) -> None:
+        """Refresh the process-budget gauges (RSS / fds)."""
+        FLEET_RSS.set(float(rss_bytes()))
+        FLEET_FDS.set(float(open_fds()))
+
+    def stats(self) -> dict:
+        """Picklable budget snapshot — what proc.py ships over the pipe
+        and fleet_bench folds into its report."""
+        self.sample()
+        qs = NODE_START.raw_quantiles((0.5, 0.99)) or [0.0, 0.0]
+        return {
+            "nodes": len(self.agents),
+            "ready": int(FLEET_NODES.value(state="ready")),
+            "rss_bytes": rss_bytes(),
+            "open_fds": open_fds(),
+            "node_start_p50_s": qs[0],
+            "node_start_p99_s": qs[1],
+            "pid": os.getpid(),
+        }
+
+    async def stop(self) -> None:
+        async def stop_one(agent: NodeAgent, client: RESTClient):
+            try:
+                await agent.stop()
+            finally:
+                await client.close()  # no-op for shared sessions
+        await asyncio.gather(
+            *(stop_one(a, c) for a, c in zip(self.agents, self._clients)),
+            return_exceptions=True)
+        self.agents, self._clients = [], []
+        if self._svc_informer is not None:
+            await self._svc_informer.stop()
+            self._svc_informer = None
+        if self._fleet_client is not None:
+            await self._fleet_client.close()
+            self._fleet_client = None
+        if self._session is not None:
+            await self._session.close()
+            self._session = None

@@ -1,0 +1,164 @@
+"""Multi-process hollow fleet: shards of :class:`HollowFleet` spread
+over worker processes.
+
+One asyncio loop serializes everything on it; past a few hundred hollow
+nodes the shard's own bookkeeping (PLEG ticks, heartbeat posts, watch
+decode) competes with itself. Workers give each shard its own loop AND
+its own RSS/fd budget line — ``stats()`` reports per-process, so "RSS
+per 1k hollow nodes" is a measurement, not an estimate.
+
+Protocol (parent <-> worker, one Pipe each): the worker boots its
+shard, waits for its readiness barrier, sends ``("ready", stats)``;
+then serves ``"stats"`` / ``"stop"`` commands until told to exit.
+Workers use the ``spawn`` start method — forking a parent with a live
+event loop and executor threads duplicates locks in undefined states.
+"""
+from __future__ import annotations
+
+import asyncio
+import multiprocessing as mp
+import time
+from typing import Optional
+
+
+def _worker_main(conn, base_url: str, cfg: dict) -> None:
+    asyncio.run(_worker_async(conn, base_url, cfg))
+
+
+async def _worker_async(conn, base_url: str, cfg: dict) -> None:
+    from .fleet import HollowFleet
+
+    start_concurrency = cfg.pop("start_concurrency", 32)
+    ready_timeout = cfg.pop("ready_timeout", 120.0)
+    # Big shards poll the barrier less often: each poll LISTs (and
+    # decodes) the entire node fleet, and four workers hammering that
+    # every second would slow the very boots being waited on.
+    ready_poll = cfg.pop(
+        "ready_poll", max(1.0, cfg.get("n_nodes", 0) / 500.0))
+    fleet = HollowFleet(base_url, **cfg)
+    try:
+        await fleet.start(start_concurrency=start_concurrency)
+        await fleet.wait_ready(timeout=ready_timeout, poll=ready_poll)
+        conn.send(("ready", fleet.stats()))
+    except Exception as exc:  # noqa: BLE001 — shipped to the parent
+        conn.send(("error", repr(exc)))
+        try:
+            await fleet.stop()
+        finally:
+            conn.close()
+        return
+    loop = asyncio.get_running_loop()
+    while True:
+        cmd = await loop.run_in_executor(None, conn.recv)
+        if cmd == "stats":
+            conn.send(("stats", fleet.stats()))
+        elif cmd == "stop":
+            await fleet.stop()
+            conn.send(("stopped", {}))
+            conn.close()
+            return
+
+
+class ProcFleet:
+    """``n_nodes`` hollow nodes sharded over ``n_procs`` workers.
+
+    Node names are ``<prefix>-w<k>-<i>`` so every shard's readiness
+    barrier counts only its own nodes. ``node_kw`` passes through to
+    each shard's :class:`HollowFleet`."""
+
+    def __init__(self, base_url: str, n_nodes: int, n_procs: int = 2,
+                 name_prefix: str = "hollow", **node_kw):
+        if n_procs < 1:
+            raise ValueError("n_procs must be >= 1")
+        self.base_url = base_url
+        self.n_nodes = n_nodes
+        self.n_procs = n_procs
+        self.name_prefix = name_prefix
+        self.node_kw = node_kw
+        self._procs: list = []
+        self._conns: list = []
+        self._ready_stats: list[dict] = []
+
+    def _shard_sizes(self) -> list[int]:
+        base, rem = divmod(self.n_nodes, self.n_procs)
+        return [base + (1 if i < rem else 0) for i in range(self.n_procs)]
+
+    async def start(self, start_concurrency: int = 32,
+                    ready_timeout: float = 120.0) -> float:
+        """Spawn the workers and block on every shard's readiness
+        barrier; return wall seconds until the LAST shard was ready."""
+        ctx = mp.get_context("spawn")
+        t0 = time.monotonic()
+        for idx, count in enumerate(self._shard_sizes()):
+            if count == 0:
+                continue
+            parent, child = ctx.Pipe()
+            cfg = dict(self.node_kw,
+                       n_nodes=count,
+                       name_prefix=f"{self.name_prefix}-w{idx}",
+                       start_concurrency=start_concurrency,
+                       ready_timeout=ready_timeout)
+            proc = ctx.Process(target=_worker_main,
+                               args=(child, self.base_url, cfg),
+                               daemon=True, name=f"hollow-w{idx}")
+            proc.start()
+            child.close()
+            self._procs.append(proc)
+            self._conns.append(parent)
+        loop = asyncio.get_running_loop()
+
+        async def wait_ready(conn):
+            # spawn re-imports the package per worker; the barrier
+            # budget covers boot + import, with slack for the parent's
+            # own loop being busy serving the boots.
+            kind, payload = await asyncio.wait_for(
+                loop.run_in_executor(None, conn.recv),
+                timeout=ready_timeout + 60.0)
+            if kind != "ready":
+                raise RuntimeError(f"hollow worker failed: {payload}")
+            return payload
+
+        try:
+            self._ready_stats = list(await asyncio.gather(
+                *(wait_ready(c) for c in self._conns)))
+        except BaseException:
+            self.kill()
+            raise
+        return time.monotonic() - t0
+
+    async def _rpc(self, conn, cmd: str, timeout: float) -> Optional[dict]:
+        loop = asyncio.get_running_loop()
+        conn.send(cmd)
+        kind, payload = await asyncio.wait_for(
+            loop.run_in_executor(None, conn.recv), timeout=timeout)
+        if kind == "error":
+            raise RuntimeError(f"hollow worker failed: {payload}")
+        return payload
+
+    async def stats(self, timeout: float = 30.0) -> list[dict]:
+        """One budget snapshot per live worker shard."""
+        return list(await asyncio.gather(
+            *(self._rpc(c, "stats", timeout) for c in self._conns)))
+
+    async def stop(self, timeout: float = 120.0) -> None:
+        try:
+            await asyncio.gather(
+                *(self._rpc(c, "stop", timeout) for c in self._conns),
+                return_exceptions=True)
+        finally:
+            for proc in self._procs:
+                proc.join(timeout=10.0)
+            self.kill()
+
+    def kill(self) -> None:
+        """Hard teardown — also the failure path, so it never raises."""
+        for conn in self._conns:
+            try:
+                conn.close()
+            except OSError:
+                pass
+        for proc in self._procs:
+            if proc.is_alive():
+                proc.terminate()
+                proc.join(timeout=5.0)
+        self._procs, self._conns = [], []

@@ -144,6 +144,13 @@ class TSDB:
         self.dropped: dict[str, int] = {}
         self.ingested = 0
 
+    @property
+    def series_count(self) -> int:
+        """Live series (the number the ``max_series`` ceiling bounds) —
+        the fleet-width cardinality gate reads this."""
+        with self._lock:
+            return len(self._series)
+
     # -- write path -------------------------------------------------------
 
     def add(self, name: str, labels: dict, value: float,

@@ -66,10 +66,38 @@ class NodeAgent:
                  chip_metrics=None,
                  dynamic_config: bool = True,
                  reserved: Optional[cm.Reserved] = None,
-                 pod_manifest_path: str = ""):
+                 pod_manifest_path: str = "",
+                 services_informer: Optional[SharedInformer] = None,
+                 phase_jitter: float = 0.0,
+                 worker_resync: float = 2.0,
+                 slim: bool = False):
+        """Fleet-multiplexing knobs (the hollow fleet sets all four;
+        single-agent composers keep the defaults, byte-identical):
+
+        ``services_informer``: an already-started informer to SHARE
+        instead of opening a per-agent services watch — N hollow agents
+        on one loop need one services stream, not N (the ``proxy``
+        sharing below is the same idea for proxied nodes).
+        ``phase_jitter``: max seconds (capped at the loop's interval)
+        by which the status and heartbeat loops offset their phase,
+        deterministically from the node name — a fleet started in one
+        burst must not renew 5k leases in the same 100 ms bucket ever
+        after (no thundering herd by construction; fleet_bench measures
+        the storm both ways).
+        ``worker_resync``: idle pod-worker resync backstop. The 2 s
+        default means 100k idle pod workers wake 50k times/s fleet-wide
+        for nothing; hollow fleets stretch it.
+        ``slim``: drop per-node subsystems that exist for real hosts —
+        problem detector, container GC, dynamic config — keeping the
+        sync loop / PLEG / status / lease / admission wire behavior
+        identical (the parity test asserts exactly that)."""
         self.client = client
         self.node_name = node_name
         self.runtime = runtime
+        self.phase_jitter = max(0.0, phase_jitter)
+        self.worker_resync = worker_resync
+        self.slim = slim
+        self._shared_svc_informer = services_informer
         self.device_manager = device_manager
         self.capacity = capacity or {"cpu": 4.0, "memory": 8.0 * 2**30}
         self.capacity.setdefault(t.RESOURCE_PODS, float(max_pods))
@@ -79,8 +107,9 @@ class NodeAgent:
         #: Dead-container GC (container_gc.go); runtime + pod_source
         #: are (re)bound at start(). Set to None to disable.
         from .containergc import ContainerGC
-        self.container_gc: Optional[ContainerGC] = ContainerGC(
-            runtime, lambda: [])
+        self.container_gc: Optional[ContainerGC] = None
+        if not slim:
+            self.container_gc = ContainerGC(runtime, lambda: [])
         self.labels = labels or {}
         self.status_interval = status_interval
         self.heartbeat_interval = heartbeat_interval
@@ -147,7 +176,7 @@ class NodeAgent:
         #: discovery piggybacks on the node-status loop, so an agent
         #: with no config-source annotation pays nothing.
         self.dynamic_config = None
-        if dynamic_config:
+        if dynamic_config and not slim:
             from .dynamicconfig import DynamicConfigManager
             self.dynamic_config = DynamicConfigManager(
                 self, checkpoint_dir=self._node_dir)
@@ -162,9 +191,11 @@ class NodeAgent:
         #: Node problem detector (problemdetector.py); PLEG-health
         #: check wired by default, operators append LogPatternChecks.
         from .problemdetector import PlegHealthCheck, ProblemDetector
-        self.problem_detector = ProblemDetector(checks=[PlegHealthCheck(
-            last_relist=lambda: self._pleg_last_relist,
-            interval=pleg_interval)])
+        self.problem_detector: Optional[ProblemDetector] = None
+        if not slim:
+            self.problem_detector = ProblemDetector(checks=[PlegHealthCheck(
+                last_relist=lambda: self._pleg_last_relist,
+                interval=pleg_interval)])
         self._restart_counts: dict[str, dict[str, int]] = {}
         self._restart_at: dict[str, dict[str, float]] = {}
         self._admitted: set[str] = set()
@@ -255,7 +286,12 @@ class NodeAgent:
                 on_pod=self._static_pod_changed,
                 on_gone=self._static_pod_gone)
             self.static_source.start()
-        if self.proxy is not None:
+        if self._shared_svc_informer is not None:
+            # Fleet-shared services informer (hollow fleet): one watch
+            # stream per worker loop, not one per node.
+            self._svc_informer = self._shared_svc_informer
+            self._own_svc_informer = False
+        elif self.proxy is not None:
             # Share the proxy's services informer (it is already
             # started): one watch stream per node, not two.
             self._svc_informer = self.proxy.services_informer
@@ -431,7 +467,24 @@ class NodeAgent:
             return True
         return False
 
+    def _phase_offset(self, interval: float) -> float:
+        """Deterministic per-node phase offset in [0, min(phase_jitter,
+        interval)): a fleet booted in one burst spreads its periodic
+        traffic across the interval instead of renewing every lease in
+        the same scheduling bucket forever. Derived from the node name
+        (crc32), not random — TPU_SAN schedules replay identically."""
+        span = min(self.phase_jitter, interval)
+        if span <= 0.0:
+            return 0.0
+        from zlib import crc32
+        return (crc32(self.node_name.encode()) % 10_000) / 10_000.0 * span
+
     async def _node_status_loop(self) -> None:
+        # First post happens synchronously at start (_register_node);
+        # only the steady-state period is phase-shifted.
+        off = self._phase_offset(self.status_interval)
+        if off:
+            await asyncio.sleep(off)
         while not self._stopped:
             try:
                 if not self._chaos_partitioned():
@@ -443,6 +496,9 @@ class NodeAgent:
     async def _heartbeat_loop(self) -> None:
         """Cheap liveness signal via a Lease (modern kubelet pattern;
         the node controller reads renew_time)."""
+        off = self._phase_offset(self.heartbeat_interval)
+        if off:
+            await asyncio.sleep(off)
         while not self._stopped:
             try:
                 if not self._chaos_partitioned():
@@ -674,7 +730,8 @@ class NodeAgent:
                 if done:
                     return
                 try:
-                    await asyncio.wait_for(wake.wait(), timeout=2.0)
+                    await asyncio.wait_for(wake.wait(),
+                                           timeout=self.worker_resync)
                 except asyncio.TimeoutError:
                     pass
         except asyncio.CancelledError:

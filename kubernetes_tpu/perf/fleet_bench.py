@@ -1,0 +1,663 @@
+"""Hollow-fleet width bench — the kubemark-analog scale harness.
+
+Reference: ``test/e2e/scalability`` driven against a kubemark cluster
+(``test/kubemark/start-kubemark.sh``): thousands of hollow kubelets
+against one real control plane, measuring pods/s, API latency, and
+watch fan-out at WIDTH, not depth. Here the ramp is 1k -> 5k hollow
+nodes (``kubernetes_tpu.hollow``) with 100k pods of sustained
+create->schedule->run->delete churn against the in-process REST
+control plane, reporting per stage:
+
+- pods/s and client-observed api p50/p99 (+ first-vs-last-third drift,
+  the endurance gate's instrument at width);
+- watch-dispatch accounting: indexed vs scan stream counts, write
+  rounds, bytes/round, events (the ``apiserver_watch_*`` families);
+- RSS/fd budget: parent + every fleet worker process, sampled through
+  the churn, reported as peak RSS per 1k hollow nodes;
+- per-seam loop occupancy (kloopsan) when ``TPU_LOOPSAN`` is armed.
+
+Sub-benches: ``fanout`` re-measures the parked ``WatchFanoutBatch``
+gate honestly at >= 256 hollow-node watchers and records a verdict;
+``storm`` measures the heartbeat-herd tail with phase jitter on vs
+off; ``smoke`` is the <120s CI slice (``hack/fleet_smoke.sh``).
+
+Run directly::
+
+    python -m kubernetes_tpu.perf.fleet_bench                  # full ramp
+    python -m kubernetes_tpu.perf.fleet_bench full [pods] [widths] [procs]
+    python -m kubernetes_tpu.perf.fleet_bench smoke [nodes] [pods]
+    python -m kubernetes_tpu.perf.fleet_bench fanout [watchers] [pods]
+    python -m kubernetes_tpu.perf.fleet_bench storm [nodes]
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import shutil
+import tempfile
+import time
+from typing import Optional
+
+from . import pct
+from ..api import types as t
+from ..api.meta import ObjectMeta
+from ..apiserver.admission import default_chain
+from ..apiserver.registry import CompactionPolicy, Registry
+from ..apiserver.server import (WATCH_EVENTS_SENT, WATCH_ROUND_BYTES,
+                                WATCH_ROUNDS, WATCH_STREAMS, APIServer)
+from ..client.rest import RESTClient
+from ..hollow import HollowFleet, ProcFleet, rss_bytes
+from ..scheduler.scheduler import Scheduler
+from ..storage.mvcc import MVCCStore
+from ..util.features import GATES
+from .churn_bench import _drift
+from .density import _loopsan_stanza, host_fingerprint
+
+#: Width-run gates: the endurance hygiene (bookmarks) + the scheduler
+#: fast path, i.e. the stack a production-shaped deployment runs.
+#: WatchFanoutBatch deliberately stays at its default — it is the
+#: subject of the A/B below, not part of the baseline.
+FLEET_GATES = {"WatchBookmarks": True, "SchedulerFastPath": True}
+
+
+class FleetStack:
+    """In-process control plane for width runs: Registry + APIServer
+    (+ Scheduler), gates-on, optionally durable (WAL + compaction — the
+    endurance stanza's configuration)."""
+
+    def __init__(self, durable: bool = False, scheduler: bool = True,
+                 gates: Optional[dict] = None):
+        self.durable = durable
+        self.with_scheduler = scheduler
+        self.gates = dict(FLEET_GATES if gates is None else gates)
+        self.data_dir = ""
+        self.store: Optional[MVCCStore] = None
+        self.registry: Optional[Registry] = None
+        self.server: Optional[APIServer] = None
+        self.sched: Optional[Scheduler] = None
+        self.client: Optional[RESTClient] = None
+        self._sched_client: Optional[RESTClient] = None
+        self._gate_snap = None
+        self.base_url = ""
+
+    async def start(self) -> str:
+        self._gate_snap = GATES.snapshot()
+        for name, on in self.gates.items():
+            GATES.set(name, on)
+        if self.durable:
+            self.data_dir = tempfile.mkdtemp(prefix="ktpu-fleet-")
+            self.store = MVCCStore(os.path.join(self.data_dir, "state"),
+                                   wal_max_bytes=4 * 1024 * 1024)
+            policy = CompactionPolicy(retention_revisions=2000,
+                                      retention_seconds=5.0,
+                                      interval_seconds=1.0)
+            self.registry = Registry(store=self.store,
+                                     compaction_policy=policy)
+        else:
+            self.registry = Registry()
+            self.store = self.registry.store
+        self.registry.admission = default_chain(self.registry)
+        # --node-cidr-mask-size analog: /26 pod blocks (16384 under
+        # the /12) — a 5k-node ramp exhausts the default /24's 4096.
+        self.registry.node_cidr_mask_size = 26
+        for ns in ("default", "kube-system"):
+            self.registry.create(t.Namespace(metadata=ObjectMeta(name=ns)))
+        self.server = APIServer(self.registry)
+        await self.server.start()
+        self.base_url = f"http://127.0.0.1:{self.server.port}"
+        self.client = RESTClient(self.base_url)
+        self.client.backoff_base = 0.02
+        if self.with_scheduler:
+            self._sched_client = RESTClient(self.base_url)
+            self.sched = Scheduler(self._sched_client, backoff_seconds=0.5)
+            await self.sched.start()
+        return self.base_url
+
+    async def stop(self) -> None:
+        if self.sched is not None:
+            await self.sched.stop()
+        for c in (self.client, self._sched_client):
+            if c is not None:
+                await c.close()
+        if self.server is not None:
+            await self.server.stop()
+        if self.durable and self.store is not None:
+            self.store.close()
+        if self.data_dir:
+            shutil.rmtree(self.data_dir, ignore_errors=True)
+        if self._gate_snap is not None:
+            GATES.restore(self._gate_snap)
+
+
+def fleet_pod(name: str) -> t.Pod:
+    """Schedulable-everywhere churn pod: tiny requests so the fleet's
+    capacity, not the workload, bounds the live set."""
+    return t.Pod(
+        metadata=ObjectMeta(name=name, namespace="default",
+                            labels={"app": "fleet-churn"}),
+        spec=t.PodSpec(containers=[t.Container(
+            name="c", image="pause",
+            resources=t.ResourceRequirements(
+                requests={"cpu": 0.001, "memory": float(2**20)}))]))
+
+
+def _watch_counters() -> dict:
+    """Cumulative apiserver watch-accounting snapshot (deltas between
+    two snapshots attribute a stage's fan-out volume)."""
+    return {
+        "streams_indexed": WATCH_STREAMS.value(dispatch="indexed"),
+        "streams_scan": WATCH_STREAMS.value(dispatch="scan"),
+        "rounds": WATCH_ROUNDS.value(),
+        "round_bytes_sum": WATCH_ROUND_BYTES.sum(),
+        "round_count": WATCH_ROUND_BYTES.count(),
+        "events_sent": WATCH_EVENTS_SENT.value(),
+    }
+
+
+def _watch_stanza(before: dict, after: dict) -> dict:
+    rounds = after["round_count"] - before["round_count"]
+    by = after["round_bytes_sum"] - before["round_bytes_sum"]
+    out = {
+        "streams_indexed": after["streams_indexed"],
+        "streams_scan": after["streams_scan"],
+        "rounds": int(rounds),
+        "events_sent": int(after["events_sent"] - before["events_sent"]),
+        "bytes_total": int(by),
+        "bytes_per_round_mean": round(by / rounds, 1) if rounds else 0.0,
+    }
+    p99 = WATCH_ROUND_BYTES.raw_quantile(0.99)
+    if p99 is not None:
+        # Raw-sample p99 is cumulative across the process (retention is
+        # first-N), marked so stage rows are not over-read.
+        out["bytes_per_round_p99_cumulative"] = p99
+    return out
+
+
+async def _churn_slice(client: RESTClient, n_pods: int, live_set: int,
+                       name_prefix: str = "fc",
+                       sample_interval: float = 5.0,
+                       drain_timeout: float = 300.0,
+                       concurrency: int = 8,
+                       on_sample=None) -> dict:
+    """``n_pods`` full pod lifecycles with a bounded live set, driven
+    by ``concurrency`` closed-loop workers: each creates pod i, then
+    (graceful-)deletes its pod from ``live_set/concurrency`` creates
+    ago — deletion completes only when the owning hollow agent confirms
+    teardown, so the slice exercises watch -> schedule -> run ->
+    terminate end to end. Drains to zero before returning."""
+    lat: list[tuple[float, float]] = []
+    loop = asyncio.get_running_loop()
+    t0 = loop.time()
+    sampler_stop = asyncio.Event()
+
+    async def sampler():
+        while not sampler_stop.is_set():
+            try:
+                await asyncio.wait_for(sampler_stop.wait(),
+                                       timeout=sample_interval)
+            except asyncio.TimeoutError:
+                await on_sample()
+
+    it = iter(range(n_pods))
+    concurrency = max(1, min(concurrency, n_pods))
+    per_worker_live = max(1, live_set // concurrency)
+
+    async def worker():
+        pending: list[str] = []  # this worker's not-yet-deleted pods
+        for i in it:
+            name = f"{name_prefix}-{i:06d}"
+            t_op = time.perf_counter()
+            await client.create(fleet_pod(name))
+            lat.append((loop.time(), time.perf_counter() - t_op))
+            pending.append(name)
+            if len(pending) > per_worker_live:
+                victim = pending.pop(0)
+                t_op = time.perf_counter()
+                await client.delete("pods", "default", victim)
+                lat.append((loop.time(), time.perf_counter() - t_op))
+        for victim in pending:
+            await client.delete("pods", "default", victim)
+
+    sample_task = (asyncio.ensure_future(sampler())
+                   if on_sample is not None else None)
+    try:
+        await asyncio.gather(*(worker() for _ in range(concurrency)))
+    finally:
+        if sample_task is not None:
+            sampler_stop.set()
+            await sample_task
+    # Graceful deletions finish when the agents ack: wait for zero.
+    deadline = loop.time() + drain_timeout
+    while True:
+        pods, _ = await client.list("pods", "default",
+                                    label_selector="app=fleet-churn")
+        if not pods:
+            break
+        if loop.time() > deadline:
+            raise TimeoutError(
+                f"{len(pods)} churn pods still present after "
+                f"{drain_timeout:.0f}s drain")
+        await asyncio.sleep(min(2.0, 0.2 + len(pods) / 500.0))
+    wall = loop.time() - t0
+    ordered = sorted(s for _, s in lat)
+    window = max(3.0, wall / 6)
+    first = sorted(s for ts, s in lat if ts - t0 <= window)
+    last = sorted(s for ts, s in lat if (t0 + wall) - ts <= window)
+    out = {
+        "pods": n_pods,
+        "live_set": live_set,
+        "ops": len(lat),
+        "wall_s": round(wall, 1),
+        "pods_per_s": round(n_pods / wall, 1) if wall else 0.0,
+        "ops_per_s": round(len(lat) / wall, 1) if wall else 0.0,
+        "api_p50_ms": round(pct(ordered, 0.5) * 1e3, 2) if ordered else 0.0,
+        "api_p99_ms": round(pct(ordered, 0.99) * 1e3, 2) if ordered else 0.0,
+        "api_p99_first_ms": round(pct(first, 0.99) * 1e3, 2) if first else 0.0,
+        "api_p99_last_ms": round(pct(last, 0.99) * 1e3, 2) if last else 0.0,
+    }
+    p_first = out["api_p99_first_ms"]
+    out["api_p99_drift"] = round(
+        (out["api_p99_last_ms"] - p_first) / p_first, 4) if p_first else 0.0
+    return out
+
+
+async def kmon_cardinality(client: RESTClient, base_url: str,
+                           n_nodes: int) -> dict:
+    """Satellite: the kmon TSDB at fleet width. Every hollow node is a
+    discovered-but-unresolvable scrape target (no agent server), so the
+    fleet contributes one ``up{job=node}`` series per node; the gate is
+    that total cardinality stays under ``KTPU_KMON_MAX_SERIES`` with
+    overflow counted by reason, never crashing the pipeline."""
+    from ..monitoring.scrape import ScrapeManager
+    from ..monitoring.tsdb import TSDB
+    max_series = int(os.environ.get("KTPU_KMON_MAX_SERIES", "20000"))
+    tsdb = TSDB(max_series=max_series)
+    mgr = ScrapeManager(client, tsdb, apiserver_urls=[base_url])
+    t0 = time.perf_counter()
+    await mgr.sweep()
+    await mgr.sweep()
+    return {
+        "nodes": n_nodes,
+        "sweeps": 2,
+        "sweep_s": round((time.perf_counter() - t0) / 2, 2),
+        "series": tsdb.series_count,
+        "max_series": max_series,
+        "under_limit": tsdb.series_count <= max_series,
+        "dropped": dict(tsdb.dropped),
+    }
+
+
+async def _budget_sampler(fleets: list, samples: list) -> None:
+    """Append {rss_total, fds_parent, per-worker} rows every call —
+    parent RSS (apiserver+scheduler+driver) plus every fleet worker's,
+    via the stats RPC."""
+    worker_rss = 0
+    worker_fds = 0
+    for fleet in fleets:
+        try:
+            for s in await fleet.stats(timeout=60.0):
+                worker_rss += s["rss_bytes"]
+                worker_fds += s["open_fds"]
+        except (RuntimeError, asyncio.TimeoutError, OSError, EOFError):
+            pass
+    samples.append({
+        "rss_parent": rss_bytes(),
+        "rss_workers": worker_rss,
+        "rss_total": rss_bytes() + worker_rss,
+        "fds_parent": _open_fds(),
+        "fds_workers": worker_fds,
+    })
+
+
+def _open_fds() -> int:
+    try:
+        return len(os.listdir("/proc/self/fd"))
+    except OSError:
+        return 0
+
+
+def _budget_stanza(samples: list, width: int) -> dict:
+    if not samples:
+        return {}
+    peak = max(s["rss_total"] for s in samples)
+    out = {
+        "rss_parent_mb": round(samples[-1]["rss_parent"] / 2**20, 1),
+        "rss_workers_mb": round(samples[-1]["rss_workers"] / 2**20, 1),
+        "rss_peak_total_mb": round(peak / 2**20, 1),
+        "rss_peak_per_1k_nodes_mb": round(peak / 2**20 / width * 1000, 1)
+        if width else 0.0,
+        "rss_drift": round(_drift([s["rss_total"] for s in samples]), 4),
+        "fds_parent": samples[-1]["fds_parent"],
+        "fds_workers": samples[-1]["fds_workers"],
+    }
+    return out
+
+
+async def run_fleet_bench(widths=(1000, 2500, 5000),
+                          pods_total: int = 100_000,
+                          n_procs: int = 4,
+                          live_set: int = 2000,
+                          heartbeat_interval: float = 60.0,
+                          status_interval: float = 300.0,
+                          pleg_interval: float = 30.0,
+                          worker_resync: float = 60.0,
+                          durable: bool = False,
+                          with_kmon: bool = True,
+                          phase_jitter: Optional[float] = None,
+                          warmup_s: float = 0.0) -> dict:
+    """The full ramp: grow the fleet stage by stage (1k -> 5k), run a
+    width-proportional slice of the 100k-pod churn at each width, and
+    account the budget. Fleet stages STACK — stage 3 churns against
+    all 5k nodes with every earlier stage's agents still heartbeating."""
+    widths = list(widths)
+    stack = FleetStack(durable=durable)
+    fleets: list[ProcFleet] = []
+    stages: list[dict] = []
+    weight_sum = sum(widths)
+    out: dict = {
+        "widths": widths,
+        "pods_total": pods_total,
+        "gates": dict(FLEET_GATES),
+        "durable": durable,
+        "intervals": {"heartbeat_s": heartbeat_interval,
+                      "status_s": status_interval,
+                      "pleg_s": pleg_interval,
+                      "worker_resync_s": worker_resync,
+                      "phase_jitter_s": phase_jitter,
+                      "warmup_s": warmup_s},
+        "host": host_fingerprint(),
+    }
+    try:
+        base = await stack.start()
+        total = 0
+        for si, width in enumerate(widths):
+            delta = width - total
+            if delta <= 0:
+                raise ValueError(f"widths must be increasing: {widths}")
+            node_kw = dict(heartbeat_interval=heartbeat_interval,
+                           status_interval=status_interval,
+                           pleg_interval=pleg_interval,
+                           worker_resync=worker_resync)
+            if phase_jitter is not None:
+                node_kw["phase_jitter"] = phase_jitter
+            fleet = ProcFleet(
+                base, delta,
+                n_procs=max(1, min(n_procs, delta // 250 or 1)),
+                name_prefix=f"hf{si}", **node_kw)
+            ready_s = await fleet.start(
+                start_concurrency=32,
+                ready_timeout=120.0 + delta * 0.25)
+            fleets.append(fleet)
+            total = width
+            if warmup_s > 0.0:
+                # Let the jittered heartbeat/status phases come fully
+                # online before measuring — otherwise load ramps ACROSS
+                # the churn window and the drift stats report the ramp,
+                # not a leak.
+                await asyncio.sleep(warmup_s)
+            quota = max(1, round(pods_total * width / weight_sum))
+            budget_samples: list[dict] = []
+            before = _watch_counters()
+            churn = await _churn_slice(
+                stack.client, quota, min(live_set, quota),
+                name_prefix=f"fc{si}",
+                drain_timeout=300.0 + quota * 0.05,
+                on_sample=lambda: _budget_sampler(fleets, budget_samples))
+            await _budget_sampler(fleets, budget_samples)
+            stage = {
+                "width": width,
+                "new_nodes": delta,
+                "ready_s": round(ready_s, 1),
+                "watchers_indexed": stack.store.indexed_watcher_count,
+                "churn": churn,
+                "watch": _watch_stanza(before, _watch_counters()),
+                "budget": _budget_stanza(budget_samples, width),
+            }
+            stages.append(stage)
+        out["stages"] = stages
+        if with_kmon:
+            out["kmon_cardinality"] = await kmon_cardinality(
+                stack.client, base, total)
+        out.update(_loopsan_stanza("loopsan", top=10))
+    finally:
+        for fleet in fleets:
+            try:
+                await fleet.stop()
+            except (RuntimeError, OSError, EOFError,
+                    asyncio.TimeoutError):
+                fleet.kill()
+        await stack.stop()
+    return out
+
+
+# -- WatchFanoutBatch A/B at width (satellite: un-park or retire) --------
+
+async def _fanout_arm(gate: bool, n_nodes: int, n_pods: int,
+                      live_set: int) -> dict:
+    snap = GATES.snapshot()
+    stack = FleetStack()
+    fleet = None
+    try:
+        GATES.set("WatchFanoutBatch", gate)
+        base = await stack.start()
+        fleet = HollowFleet(base, n_nodes,
+                            heartbeat_interval=20.0,
+                            status_interval=120.0,
+                            pleg_interval=15.0)
+        await fleet.start(start_concurrency=64)
+        await fleet.wait_ready(timeout=120.0 + n_nodes * 0.25,
+                               poll=max(1.0, n_nodes / 500.0))
+        before = _watch_counters()
+        churn = await _churn_slice(stack.client, n_pods, live_set,
+                                   name_prefix="fa",
+                                   drain_timeout=300.0)
+        return {
+            "gate_on": gate,
+            "watchers": n_nodes,
+            "churn": churn,
+            "watch": _watch_stanza(before, _watch_counters()),
+        }
+    finally:
+        GATES.restore(snap)
+        if fleet is not None:
+            await fleet.stop()
+        await stack.stop()
+
+
+async def run_fanout_ab(n_nodes: int = 256, n_pods: int = 3000,
+                        live_set: int = 500) -> dict:
+    """Re-measure the parked ``WatchFanoutBatch`` gate honestly at
+    >= 256 hollow-node watchers. The regime it was parked in no longer
+    exists: per-node pod watches are INDEX-dispatched, so a pod event
+    reaches one watcher, not all N — the batch path's shared-sink
+    coalescing has nothing to coalesce. Both arms run identical churn
+    with real per-node watchers; the verdict key records what the
+    numbers say, and README/ROADMAP carry it forward."""
+    off = await _fanout_arm(False, n_nodes, n_pods, live_set)
+    on = await _fanout_arm(True, n_nodes, n_pods, live_set)
+    p_off, p_on = off["churn"]["api_p99_ms"], on["churn"]["api_p99_ms"]
+    thr_off = off["churn"]["pods_per_s"]
+    thr_on = on["churn"]["pods_per_s"]
+    d_p99 = (p_on - p_off) / p_off if p_off else 0.0
+    d_thr = (thr_on - thr_off) / thr_off if thr_off else 0.0
+    if d_thr > 0.10 or d_p99 < -0.10:
+        verdict = "un-park: gate wins at indexed-dispatch width"
+    elif d_thr < -0.10 or d_p99 > 0.10:
+        verdict = ("retire: gate regresses at indexed-dispatch width "
+                   "(shared-sink overhead, nothing to coalesce)")
+    else:
+        verdict = ("retire: no measurable win at indexed-dispatch "
+                   "width — per-pod events reach one watcher, the "
+                   "batch path has nothing to batch")
+    return {
+        "watchers": n_nodes,
+        "off": off,
+        "on": on,
+        "delta_p99": round(d_p99, 4),
+        "delta_pods_per_s": round(d_thr, 4),
+        "verdict": verdict,
+    }
+
+
+# -- heartbeat storm: jitter on vs off -----------------------------------
+
+async def _storm_arm(jitter_on: bool, n_nodes: int, interval: float,
+                     window_intervals: int) -> dict:
+    stack = FleetStack(scheduler=False)
+    fleet = None
+    try:
+        base = await stack.start()
+        fleet = HollowFleet(
+            base, n_nodes,
+            heartbeat_interval=interval,
+            status_interval=3600.0,  # quiet: only heartbeats in frame
+            pleg_interval=3600.0,
+            phase_jitter=interval if jitter_on else 0.0)
+        await fleet.start(start_concurrency=64)
+        await fleet.wait_ready(timeout=120.0 + n_nodes * 0.25,
+                               poll=max(1.0, n_nodes / 500.0))
+        # Steady state first: the boot's own stagger must not be
+        # mistaken for jitter.
+        await asyncio.sleep(interval)
+        wch = stack.store.watch("/registry/leases/")
+        arrivals: list[float] = []
+        t0 = time.monotonic()
+        window = interval * window_intervals
+        try:
+            while time.monotonic() - t0 < window:
+                ev = await wch.next(timeout=0.5)
+                if ev is not None:
+                    arrivals.append(time.monotonic() - t0)
+        finally:
+            wch.cancel()
+        bucket = interval / 20.0
+        counts: dict[int, int] = {}
+        for a in arrivals:
+            counts[int(a / bucket)] = counts.get(int(a / bucket), 0) + 1
+        n_buckets = max(1, int(window / bucket))
+        uniform = len(arrivals) / n_buckets  # renewals if perfectly spread
+        peak = max(counts.values(), default=0)
+        return {
+            "jitter_on": jitter_on,
+            "nodes": n_nodes,
+            "heartbeat_interval_s": interval,
+            "window_s": round(window, 1),
+            "renewals": len(arrivals),
+            "bucket_ms": round(bucket * 1e3, 1),
+            "peak_bucket": peak,
+            "uniform_bucket": round(uniform, 1),
+            # The tail number: how many x the uniform rate the worst
+            # bucket carries. 1.0 = perfectly spread; interval/bucket
+            # (here 20) = the whole fleet in one bucket.
+            "storm_factor": round(peak / uniform, 1) if uniform else 0.0,
+        }
+    finally:
+        if fleet is not None:
+            await fleet.stop()
+        await stack.stop()
+
+
+async def run_heartbeat_storm(n_nodes: int = 256, interval: float = 5.0,
+                              window_intervals: int = 3) -> dict:
+    """Thundering-herd A/B: the same fleet with phase jitter off
+    (every loop fires interval-aligned from its boot instant) vs on
+    (deterministic per-node offset across the interval). Measured as
+    lease-renewal arrivals per interval/20 bucket at the store."""
+    off = await _storm_arm(False, n_nodes, interval, window_intervals)
+    on = await _storm_arm(True, n_nodes, interval, window_intervals)
+    return {
+        "jitter_off": off,
+        "jitter_on": on,
+        "storm_reduction_x": round(
+            off["storm_factor"] / on["storm_factor"], 1)
+        if on["storm_factor"] else 0.0,
+    }
+
+
+# -- smoke (hack/fleet_smoke.sh) -----------------------------------------
+
+async def run_smoke(n_nodes: int = 500, n_pods: int = 1000,
+                    n_procs: int = 2) -> dict:
+    """The CI slice: >= 500 hollow nodes across worker processes all
+    Ready inside the budget, a churn slice through full lifecycles,
+    watcher count == node count, budget accounting attached."""
+    stack = FleetStack()
+    fleet = None
+    try:
+        base = await stack.start()
+        fleet = ProcFleet(base, n_nodes, n_procs=n_procs,
+                          name_prefix="hs",
+                          heartbeat_interval=15.0,
+                          status_interval=60.0,
+                          pleg_interval=10.0,
+                          worker_resync=30.0)
+        ready_s = await fleet.start(start_concurrency=32,
+                                    ready_timeout=90.0)
+        budget_samples: list[dict] = []
+        churn = await _churn_slice(
+            stack.client, n_pods, min(200, n_pods),
+            name_prefix="sm", sample_interval=3.0,
+            drain_timeout=120.0,
+            on_sample=lambda: _budget_sampler([fleet], budget_samples))
+        await _budget_sampler([fleet], budget_samples)
+        return {
+            "nodes": n_nodes,
+            "procs": n_procs,
+            "ready_s": round(ready_s, 1),
+            "watchers_indexed": stack.store.indexed_watcher_count,
+            "churn": churn,
+            "budget": _budget_stanza(budget_samples, n_nodes),
+            "host": host_fingerprint(),
+        }
+    finally:
+        if fleet is not None:
+            try:
+                await fleet.stop()
+            except (RuntimeError, OSError, EOFError,
+                    asyncio.TimeoutError):
+                fleet.kill()
+        await stack.stop()
+
+
+if __name__ == "__main__":
+    import sys
+
+    argv = sys.argv[1:]
+    mode = argv[0] if argv and not argv[0].isdigit() else "full"
+    if mode == "smoke":
+        nodes = int(argv[1]) if len(argv) > 1 else 500
+        pods = int(argv[2]) if len(argv) > 2 else 1000
+        print(json.dumps(asyncio.run(run_smoke(nodes, pods))))
+    elif mode == "fanout":
+        watchers = int(argv[1]) if len(argv) > 1 else 256
+        pods = int(argv[2]) if len(argv) > 2 else 3000
+        print(json.dumps(asyncio.run(run_fanout_ab(watchers, pods))))
+    elif mode == "storm":
+        nodes = int(argv[1]) if len(argv) > 1 else 256
+        print(json.dumps(asyncio.run(run_heartbeat_storm(nodes))))
+    elif mode == "endurance":
+        # hack/endurance_smoke.sh's width stanza: one 1k-node stage of
+        # churn on the DURABLE stack (WAL + online compaction), short
+        # agent intervals so heartbeat/status traffic shows inside the
+        # stanza's budget. The caller asserts flat RSS/api-p99 drift.
+        nodes = int(argv[1]) if len(argv) > 1 else 1000
+        pods = int(argv[2]) if len(argv) > 2 else 4000
+        print(json.dumps(asyncio.run(run_fleet_bench(
+            widths=(nodes,), pods_total=pods, n_procs=2,
+            live_set=min(1000, pods),
+            heartbeat_interval=10.0, status_interval=60.0,
+            pleg_interval=10.0, worker_resync=30.0,
+            durable=True, with_kmon=False,
+            phase_jitter=10.0, warmup_s=12.0))))
+    else:
+        args = argv[1:] if mode == "full" else argv
+        pods = int(args[0]) if len(args) > 0 else 100_000
+        widths = tuple(int(w) for w in args[1].split(",")) \
+            if len(args) > 1 else (1000, 2500, 5000)
+        procs = int(args[2]) if len(args) > 2 else 4
+        report = asyncio.run(run_fleet_bench(
+            widths=widths, pods_total=pods, n_procs=procs))
+        report["fanout_ab"] = asyncio.run(run_fanout_ab())
+        report["heartbeat_storm"] = asyncio.run(run_heartbeat_storm())
+        print(json.dumps(report))

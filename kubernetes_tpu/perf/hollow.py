@@ -1,134 +1,8 @@
-"""Hollow node fleet — the kubemark analog.
+"""Back-compat shim — the hollow fleet grew into its own subsystem at
+:mod:`kubernetes_tpu.hollow` (device stub, single-loop shard,
+multi-process sharding). Import from there; this module keeps the old
+``perf.hollow`` names working."""
+from ..hollow.device import StaticDeviceManager, hollow_topology
+from ..hollow.fleet import HollowFleet
 
-Reference: ``cmd/kubemark/hollow-node.go`` + ``pkg/kubemark/
-hollow_kubelet.go:49`` — a real kubelet wired to a fake docker client
-and mock cadvisor, deployed by the hundreds so control-plane scale
-runs (``test/e2e/scalability/``) need no real machines.
-
-Here a hollow node is the *real* :class:`NodeAgent` (sync loop, PLEG,
-workers, status/heartbeat) over the **REST** client, with a
-:class:`FakeRuntime` (containers "run" instantly) and a
-:class:`StaticDeviceManager` (fixed stub topology, no gRPC socket —
-one process cannot host 1000 gRPC servers, and the seam under test is
-the manager's admission/options surface, not the wire).
-"""
-from __future__ import annotations
-
-import asyncio
-from typing import Optional
-
-from ..api import types as t
-from ..client.rest import RESTClient
-from ..node.agent import NodeAgent
-from ..node.devicemanager import DeviceManager
-from ..node.runtime import FakeRuntime
-
-
-class StaticDeviceManager(DeviceManager):
-    """Device manager with a fixed topology and local (no-RPC) admit/
-    options — the device_plugin_stub.go equivalent for fleets."""
-
-    def __init__(self, topology: t.TpuTopology, resource: str = t.RESOURCE_TPU):
-        # Deliberately no super().__init__: no plugin dir, no watcher.
-        self._topology = topology
-        self._topology_resource = resource
-        self.on_topology_changed = None
-        self.ready = asyncio.Event()
-        self.ready.set()
-
-    async def start(self) -> None:  # no watcher task
-        return
-
-    async def stop(self) -> None:
-        return
-
-    async def admit_pod(self, pod: t.Pod) -> Optional[str]:
-        known = {c.id: c for c in self._topology.chips}
-        for cid in t.pod_tpu_assigned(pod):
-            chip = known.get(cid)
-            if chip is None:
-                return f"assigned chip {cid!r} does not exist on this node"
-            if chip.health != t.TPU_HEALTHY:
-                return f"assigned chip {cid!r} is {chip.health}"
-        return None
-
-    async def container_options(self, pod: t.Pod, container: t.Container):
-        env: dict[str, str] = {}
-        for claim_name in container.tpu_requests:
-            claim = t.pod_tpu_request(pod, claim_name)
-            if claim is None or not claim.assigned:
-                continue
-            env["TPU_VISIBLE_CHIPS"] = ",".join(claim.assigned)
-            env["TPU_WORKER_ID"] = str(self._topology.worker_index)
-            env["TPU_MESH_SHAPE"] = "x".join(
-                str(d) for d in self._topology.mesh_shape)
-        return env, [], [], {}
-
-
-def hollow_topology(name: str, chips: int, mesh_shape=None,
-                    slice_id: str = "") -> t.TpuTopology:
-    """Stub TPU topology for hollow nodes — the single source for both
-    agent-backed fleets (here) and API-object-only nodes
-    (:func:`kubernetes_tpu.perf.density.hollow_node`)."""
-    shape = list(mesh_shape) if mesh_shape else (
-        [2, 2, chips // 4] if chips % 4 == 0 else [chips, 1, 1])
-    if shape[0] * shape[1] * shape[2] != chips:
-        raise ValueError(f"mesh_shape {shape} != {chips} chips")
-    return t.TpuTopology(
-        chip_type="v5p", slice_id=slice_id or f"slice-{name}",
-        mesh_shape=shape,
-        chips=[t.TpuChip(
-            id=f"{name}-c{i}", health=t.TPU_HEALTHY,
-            coords=[i % shape[0], (i // shape[0]) % shape[1],
-                    i // (shape[0] * shape[1])],
-            attributes={"chip_type": "v5p"}) for i in range(chips)])
-
-
-class HollowFleet:
-    """N hollow node agents against one apiserver URL."""
-
-    def __init__(self, base_url: str, n_nodes: int, tpu_chips: int = 0,
-                 status_interval: float = 10.0,
-                 heartbeat_interval: float = 5.0,
-                 pleg_interval: float = 2.0,
-                 name_prefix: str = "hollow"):
-        self.base_url = base_url
-        self.n_nodes = n_nodes
-        self.tpu_chips = tpu_chips
-        self.status_interval = status_interval
-        self.heartbeat_interval = heartbeat_interval
-        self.pleg_interval = pleg_interval
-        self.name_prefix = name_prefix
-        self.agents: list[NodeAgent] = []
-        self._clients: list[RESTClient] = []
-
-    async def start(self, start_concurrency: int = 32) -> None:
-        names = [f"{self.name_prefix}-{i:04d}" for i in range(self.n_nodes)]
-        it = iter(names)
-
-        async def worker():
-            for name in it:
-                dm = (StaticDeviceManager(hollow_topology(name, self.tpu_chips))
-                      if self.tpu_chips else None)
-                client = RESTClient(self.base_url)
-                agent = NodeAgent(
-                    client, name, FakeRuntime(), device_manager=dm,
-                    status_interval=self.status_interval,
-                    heartbeat_interval=self.heartbeat_interval,
-                    pleg_interval=self.pleg_interval,
-                    server_port=None)  # 1000 HTTP servers would be silly
-                await agent.start()
-                self.agents.append(agent)
-                self._clients.append(client)
-        await asyncio.gather(*(worker() for _ in range(start_concurrency)))
-
-    async def stop(self) -> None:
-        async def stop_one(agent: NodeAgent, client: RESTClient):
-            try:
-                await agent.stop()
-            finally:
-                await client.close()
-        await asyncio.gather(
-            *(stop_one(a, c) for a, c in zip(self.agents, self._clients)),
-            return_exceptions=True)
-        self.agents, self._clients = [], []
+__all__ = ["StaticDeviceManager", "hollow_topology", "HollowFleet"]

@@ -155,6 +155,10 @@ class Watch:
         #: events are references that survive the history trim) — only
         #: a RECONNECT from that old revision would now 410.
         self.compacted = False
+        #: ``(index name, value)`` when subscribed through a dispatch
+        #: index (see ``MVCCStore.register_watch_index``); None = plain
+        #: prefix-scan delivery.
+        self.index: Optional[tuple[str, str]] = None
 
     def _post(self, item: Optional[WatchEvent]) -> None:
         """Enqueue onto the consumer loop from wherever we are.
@@ -493,6 +497,17 @@ class MVCCStore:
         self._log_revs: list[int] = []
         self._history_limit = history_limit
         self._watches: list[Watch] = []
+        #: Watch dispatch index (see :meth:`register_watch_index`):
+        #: name -> (prefix, extractor(raw value dict) -> str | None).
+        self._watch_indexes: dict[str, tuple[str, Callable]] = {}
+        #: (index name, extracted value) -> watches subscribed to that
+        #: bucket. Indexed watches live here INSTEAD of the plain scan
+        #: list; ``self._watches`` stays the authoritative union for
+        #: bookkeeping (close/compact/count).
+        self._watch_buckets: dict[tuple[str, str], list[Watch]] = {}
+        #: Watches delivered by the O(watchers) prefix scan (everything
+        #: without an index hint).
+        self._plain_watches: list[Watch] = []
         #: Key-level write listeners (see :meth:`add_write_hook`).
         self._write_hooks: list[Callable[[str], None]] = []
         #: Full-event listeners (see :meth:`add_event_hook`).
@@ -767,9 +782,39 @@ class MVCCStore:
         # Snapshot: an overflowing watcher removes itself from _watches
         # during _deliver; mutating the live list mid-iteration would
         # silently skip the next watcher's delivery of this event.
-        for wch in list(self._watches):
+        for wch in list(self._plain_watches):
             if ev.key.startswith(wch.prefix):
                 wch._deliver(ev)
+        if self._watch_buckets:
+            self._dispatch_indexed(ev)
+
+    def _dispatch_indexed(self, ev: WatchEvent) -> None:
+        """Deliver one event to the watch buckets it belongs to.
+
+        Cost is O(indexes + matching watchers), NOT O(all watchers):
+        at hollow-fleet width (5k per-node pod watchers) the plain
+        prefix scan above would evaluate every watcher for every pod
+        event — the extractor runs once per registered index instead,
+        and only the bucket whose value matches gets a delivery. Both
+        the current and previous value's buckets are notified so
+        selector transitions (a bind moving a pod INTO a node's
+        selected set, a reschedule moving it out) surface exactly like
+        the unindexed path — the ObjectWatch filter on top keeps the
+        transition semantics."""
+        for name, (prefix, extract) in self._watch_indexes.items():
+            if not ev.key.startswith(prefix):
+                continue
+            cur = extract(ev.value) if ev.value is not None else None
+            prev = extract(ev.prev_value) if ev.prev_value is not None else None
+            for val in ((cur,) if cur == prev or prev is None
+                        else (cur, prev) if cur is not None else (prev,)):
+                if not val:
+                    continue
+                bucket = self._watch_buckets.get((name, val))
+                if bucket:
+                    for wch in list(bucket):
+                        if ev.key.startswith(wch.prefix):
+                            wch._deliver(ev)
 
     def _wal_line(self, rev: int, op: str, key: str,
                   value: Optional[dict]) -> str:
@@ -841,10 +886,35 @@ class MVCCStore:
             hook(events)
         # One delivery round per watcher (see _append_event for the
         # list() snapshot rationale).
-        for wch in list(self._watches):
+        for wch in list(self._plain_watches):
             evs = [ev for ev in events if ev.key.startswith(wch.prefix)]
             if evs:
                 wch._deliver_batch(evs)
+        if self._watch_buckets:
+            # Group the batch by bucket in ONE pass over the events,
+            # then one delivery round per touched bucket — same
+            # O(indexes) per-event cost as _dispatch_indexed, same
+            # single loop wake per watcher as the plain path.
+            grouped: dict[tuple[str, str], list[WatchEvent]] = {}
+            for ev in events:
+                for name, (prefix, extract) in self._watch_indexes.items():
+                    if not ev.key.startswith(prefix):
+                        continue
+                    cur = (extract(ev.value)
+                           if ev.value is not None else None)
+                    prev = (extract(ev.prev_value)
+                            if ev.prev_value is not None else None)
+                    for val in ((cur,) if cur == prev or prev is None
+                                else (cur, prev) if cur is not None
+                                else (prev,)):
+                        if val and (name, val) in self._watch_buckets:
+                            grouped.setdefault((name, val), []).append(ev)
+            for bkey, evs in grouped.items():
+                for wch in list(self._watch_buckets.get(bkey, ())):
+                    mine = [ev for ev in evs
+                            if ev.key.startswith(wch.prefix)]
+                    if mine:
+                        wch._deliver_batch(mine)
         MVCC_TXN_COMMITS.inc()
         MVCC_TXN_OPS.inc(float(len(events)))
         self._maybe_rotate_wal()
@@ -1381,12 +1451,41 @@ class MVCCStore:
 
     # -- watch ------------------------------------------------------------
 
+    def register_watch_index(self, name: str, prefix: str,
+                             extractor: Callable[[dict], Optional[str]]) -> None:
+        """Declare a watch dispatch index: ``extractor(raw value dict)``
+        returns the index value for any key under ``prefix`` (None/""
+        = unindexed object). Watches opened with ``index=(name, value)``
+        are delivered ONLY events whose current or previous value
+        extracts to ``value`` — O(1) bucket dispatch instead of the
+        O(watchers) prefix scan. The registry registers
+        ``pods.spec.node_name`` so hollow-fleet width (one per-node
+        field-selector watcher per node) costs one dict lookup per pod
+        event, not 5k prefix checks + 5k typed decodes. Extractors run
+        under the store lock on the write path: they must be cheap,
+        non-raising dict lookups. Idempotent re-registration with the
+        same prefix is allowed (LocalCluster restarts)."""
+        with self._lock:
+            old = self._watch_indexes.get(name)
+            if old is not None and old[0] != prefix:
+                raise ValueError(
+                    f"watch index {name!r} already registered for "
+                    f"prefix {old[0]!r}")
+            self._watch_indexes[name] = (prefix, extractor)
+
     def watch(self, prefix: str, start_revision: int = 0,
-              loop: Optional[asyncio.AbstractEventLoop] = None) -> Watch:
+              loop: Optional[asyncio.AbstractEventLoop] = None,
+              index: Optional[tuple[str, str]] = None) -> Watch:
         """Stream events for keys under ``prefix`` with revision >
         ``start_revision``. Raises GoneError if that history was compacted
         (client must relist). ``start_revision=0`` means 'live only from
         now' (callers normally pass the revision a LIST returned).
+
+        ``index=(name, value)`` subscribes via a registered dispatch
+        index (see :meth:`register_watch_index`): the watch receives
+        only events whose extracted value matches — a strict superset
+        of what a ``field=value`` selector on that attribute matches,
+        so selector filtering above stays correct and cheap.
 
         Must either be called on a running event loop or be given the
         ``loop`` events should be delivered to (worker threads pass the
@@ -1400,18 +1499,28 @@ class MVCCStore:
                     "pass loop= explicitly when watching from a worker thread"
                 ) from None
         with self._lock:
+            if index is not None and index[0] not in self._watch_indexes:
+                raise ValueError(f"unknown watch index {index[0]!r}")
             if start_revision and start_revision < self._compact_rev:
                 raise errors.GoneError(
                     f"revision {start_revision} compacted (compact_rev={self._compact_rev})"
                 )
             wch = Watch(self, prefix, loop, start_revision=start_revision)
+            wch.index = index
             if start_revision:
+                # Replay filters by prefix only — the index applies to
+                # live dispatch; a few extra replayed events are
+                # dropped by the selector filter above.
                 idx = bisect.bisect_right(self._log_revs, start_revision)
                 for ev in self._log[idx:]:
                     if ev.key.startswith(prefix):
                         wch._deliver(ev)
             if not wch.overflowed:  # replay itself may have overflowed
                 self._watches.append(wch)
+                if index is not None:
+                    self._watch_buckets.setdefault(index, []).append(wch)
+                else:
+                    self._plain_watches.append(wch)
             return wch
 
     def _remove_watch(self, wch: Watch) -> None:
@@ -1420,6 +1529,21 @@ class MVCCStore:
                 self._watches.remove(wch)
             except ValueError:
                 pass
+            index = getattr(wch, "index", None)
+            if index is not None:
+                bucket = self._watch_buckets.get(index)
+                if bucket is not None:
+                    try:
+                        bucket.remove(wch)
+                    except ValueError:
+                        pass
+                    if not bucket:
+                        del self._watch_buckets[index]
+            else:
+                try:
+                    self._plain_watches.remove(wch)
+                except ValueError:
+                    pass
 
     def compact(self, revision: int) -> int:
         """Online revision compaction (etcd ``Compact``): discard event
@@ -1501,6 +1625,13 @@ class MVCCStore:
     def watcher_count(self) -> int:
         with self._lock:
             return len(self._watches)
+
+    @property
+    def indexed_watcher_count(self) -> int:
+        """Watches riding a dispatch index bucket (fleet width minus
+        the handful of informer/controller prefix scans)."""
+        with self._lock:
+            return sum(len(b) for b in self._watch_buckets.values())
 
     @property
     def compactions(self) -> int:

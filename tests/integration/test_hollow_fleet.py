@@ -1,0 +1,151 @@
+"""Hollow-fleet subsystem integration: readiness barrier, indexed
+per-node watches, shared-session multiplexing, slimming, and the
+multi-process sharding path (reference: kubemark's hollow-node
+e2e wiring, ``test/kubemark/start-kubemark.sh``)."""
+import asyncio
+
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.apiserver.admission import default_chain
+from kubernetes_tpu.apiserver.registry import Registry
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.hollow import HollowFleet, ProcFleet
+
+
+async def _stack():
+    reg = Registry()
+    reg.admission = default_chain(reg)
+    for ns in ("default", "kube-system"):
+        reg.create(t.Namespace(metadata=ObjectMeta(name=ns)))
+    server = APIServer(reg)
+    port = await server.start()
+    return reg, server, f"http://127.0.0.1:{port}"
+
+
+async def test_fleet_ready_indexed_watchers_shared_session():
+    reg, server, base = await _stack()
+    fleet = HollowFleet(base, n_nodes=16, status_interval=5.0,
+                        heartbeat_interval=2.0, pleg_interval=1.0)
+    try:
+        await fleet.start()
+        elapsed = await fleet.wait_ready(timeout=30.0, poll=0.2)
+        assert elapsed < 30.0
+        # One pod watch per node, every one riding the
+        # pods.spec.node_name dispatch index — watcher width equals
+        # fleet width, nothing fell back to the O(watchers) scan.
+        assert reg.store.indexed_watcher_count == 16
+        # Shared-session multiplexing: every node client rides the
+        # fleet's one connector pool instead of opening its own.
+        assert fleet._session is not None
+        assert all(c._shared_session is fleet._session
+                   for c in fleet._clients)
+        # Slim agents shed the per-node subsystems a hollow node
+        # cannot meaningfully exercise.
+        assert all(a.slim for a in fleet.agents)
+        assert all(a.problem_detector is None for a in fleet.agents)
+        assert all(a.container_gc is None for a in fleet.agents)
+        # All agents share the fleet-wide services informer.
+        assert len({id(a._svc_informer) for a in fleet.agents}) == 1
+        # Budget accounting is live and picklable.
+        stats = fleet.stats()
+        assert stats["ready"] == 16
+        assert stats["rss_bytes"] > 0 and stats["open_fds"] > 0
+    finally:
+        await fleet.stop()
+        await server.stop()
+
+
+async def test_fleet_phase_jitter_spreads_loops_deterministically():
+    reg, server, base = await _stack()
+    fleet = HollowFleet(base, n_nodes=8, status_interval=60.0,
+                        heartbeat_interval=30.0, pleg_interval=30.0,
+                        phase_jitter=30.0)
+    try:
+        await fleet.start()
+        await fleet.wait_ready(timeout=30.0, poll=0.2)
+        offs = [a._phase_offset(30.0) for a in fleet.agents]
+        # Pure function of the node name: recomputing gives the same
+        # phases (determinism the TPU_SAN harness relies on), and the
+        # spread actually uses the window instead of clustering at 0.
+        assert offs == [a._phase_offset(30.0) for a in fleet.agents]
+        assert all(0.0 <= o < 30.0 for o in offs)
+        assert max(offs) - min(offs) > 30.0 / 4
+    finally:
+        await fleet.stop()
+        await server.stop()
+
+
+async def test_proc_fleet_shards_boot_and_report():
+    reg, server, base = await _stack()
+    fleet = ProcFleet(base, n_nodes=12, n_procs=2, name_prefix="pw",
+                      status_interval=10.0, heartbeat_interval=5.0,
+                      pleg_interval=2.0)
+    try:
+        ready_s = await fleet.start(start_concurrency=8,
+                                    ready_timeout=60.0)
+        assert ready_s < 60.0
+        nodes, _ = await asyncio.wait_for(_list_nodes(reg), 10.0)
+        ready = [n for n in nodes
+                 if n.metadata.name.startswith("pw-w")
+                 and (c := t.get_node_condition(n.status, t.NODE_READY))
+                 and c.status == "True"]
+        assert len(ready) == 12
+        # Stats RPC: one budget row per worker shard, 6 nodes each.
+        rows = await fleet.stats()
+        assert len(rows) == 2
+        assert sorted(r["nodes"] for r in rows) == [6, 6]
+        assert all(r["rss_bytes"] > 0 for r in rows)
+        assert len({r["pid"] for r in rows}) == 2
+    finally:
+        await fleet.stop()
+        await server.stop()
+
+
+async def _list_nodes(reg):
+    from kubernetes_tpu.client.local import LocalClient
+    return await LocalClient(reg).list("nodes")
+
+
+async def test_kmon_cardinality_bounded_at_fleet_width():
+    """Satellite 2: the kmon scrape manager pointed at a hollow fleet
+    must stay under its series ceiling — and when the ceiling is too
+    small for the width, the overflow is COUNTED per reason, never
+    silent. Hollow nodes expose no metrics endpoint, so each costs
+    exactly one ``up{job=node}`` series; the apiserver target adds its
+    own families."""
+    from kubernetes_tpu.client.local import LocalClient
+    from kubernetes_tpu.monitoring.scrape import ScrapeManager
+    from kubernetes_tpu.monitoring.tsdb import TSDB
+
+    reg, server, base = await _stack()
+    fleet = HollowFleet(base, n_nodes=24, status_interval=10.0,
+                        heartbeat_interval=5.0, pleg_interval=5.0)
+    try:
+        await fleet.start()
+        await fleet.wait_ready(timeout=30.0, poll=0.2)
+        client = LocalClient(reg)
+
+        # Roomy ceiling: everything fits, nothing dropped.
+        tsdb = TSDB(max_series=2000)
+        mgr = ScrapeManager(client, tsdb, apiserver_urls=[base])
+        await mgr.sweep()
+        await mgr.sweep()
+        assert tsdb.series_count <= 2000
+        # One up{job=node,...} series per hollow node.
+        node_up = [s for s in tsdb.select_instant(
+            "up", [], at=float("inf"), lookback=float("inf"))
+            if s[0].get("job") == "node"]
+        assert len(node_up) == 24
+        assert tsdb.dropped.get("series_limit", 0) == 0
+
+        # Ceiling below the width: the TSDB refuses NEW series and
+        # accounts every refusal under kmon_tsdb_dropped_samples_total
+        # {reason=series_limit} (instance-local mirror asserted here).
+        small = TSDB(max_series=10)
+        mgr2 = ScrapeManager(client, small, apiserver_urls=[base])
+        await mgr2.sweep()
+        assert small.series_count == 10
+        assert small.dropped.get("series_limit", 0) > 0
+    finally:
+        await fleet.stop()
+        await server.stop()

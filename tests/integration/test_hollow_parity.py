@@ -1,0 +1,149 @@
+"""Hollow-vs-real agent WIRE PARITY (PR 20, satellite 4).
+
+A hollow node is only a valid width instrument if the control plane
+cannot tell it from a real one: same node status shape, same lease
+shape, same pod-status trajectory through one full lifecycle
+(create -> bind ack -> Running -> graceful delete). This test runs the
+SAME lifecycle against a full agent and a slim hollow agent and
+compares the wire objects field-by-field after normalizing identity
+(names, UIDs, timestamps, revisions) — asserting that the ONLY
+differences are the two declared ones:
+
+ - daemon endpoints: a hollow node has no kubelet server port;
+ - problem-detector conditions: slim agents shed the detector, so its
+   extra condition types are absent (Ready itself must still match).
+"""
+import asyncio
+import re
+
+from kubernetes_tpu.api import scheme, types as t
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.apiserver.admission import default_chain
+from kubernetes_tpu.apiserver.registry import Registry
+from kubernetes_tpu.client.local import LocalClient
+from kubernetes_tpu.node.agent import NodeAgent
+from kubernetes_tpu.node.runtime import FakeRuntime
+
+_TS = re.compile(r"^\d{4}-\d{2}-\d{2}[T ]\d{2}:\d{2}:\d{2}")
+
+
+def _normalize(obj, node: str, pod: str):
+    """Zero out identity so two different nodes' wire objects become
+    comparable: node/pod names, UIDs, revisions, and anything that
+    parses as a timestamp."""
+    if isinstance(obj, dict):
+        out = {}
+        for k, v in sorted(obj.items()):
+            if k in ("uid", "resourceVersion", "resource_version",
+                     "container_id", "containerID",
+                     "pod_ip", "podIP", "host_ip", "hostIP"):
+                out[k] = "X" if v else v
+            elif k in ("creationTimestamp", "deletionTimestamp"):
+                out[k] = "TS" if v else v
+            else:
+                out[k] = _normalize(v, node, pod)
+        return out
+    if isinstance(obj, list):
+        return [_normalize(v, node, pod) for v in obj]
+    if isinstance(obj, str):
+        if _TS.match(obj):
+            return "TS"
+        return obj.replace(node, "NODE").replace(pod, "POD")
+    return obj
+
+
+async def _lifecycle(reg, agent_name: str, pod_name: str, **agent_kw):
+    """Boot one agent, run one pod through create -> bind -> Running ->
+    graceful delete; return the normalized wire shapes observed."""
+    client = LocalClient(reg)
+    agent = NodeAgent(client, agent_name, FakeRuntime(),
+                      status_interval=0.3, heartbeat_interval=0.3,
+                      pleg_interval=0.15, **agent_kw)
+    shapes = {}
+    try:
+        await agent.start()
+        pod = t.Pod(metadata=ObjectMeta(name=pod_name,
+                                        namespace="default"),
+                    spec=t.PodSpec(containers=[
+                        t.Container(name="c", image="pause")]))
+        await client.create(pod)
+        await client.bind("default", pod_name,
+                          t.Binding(target=t.BindingTarget(
+                              node_name=agent_name)))
+        # Bind ack: the agent's pod watch (spec.nodeName selector)
+        # picks the pod up, admits, starts it, posts Running.
+        for _ in range(200):
+            got = reg.get("pods", "default", pod_name)
+            if got.status.phase == t.POD_RUNNING:
+                break
+            await asyncio.sleep(0.05)
+        assert got.status.phase == t.POD_RUNNING, got.status.phase
+        shapes["pod_running"] = _normalize(
+            scheme.to_dict(got.status), agent_name, pod_name)
+        shapes["bind_ack"] = {
+            "node_name": got.spec.node_name.replace(agent_name, "NODE"),
+            "has_start_time": got.status.start_time is not None,
+        }
+        # One more status round so node/lease reflect the running pod.
+        await asyncio.sleep(0.5)
+        node = reg.get("nodes", "", agent_name)
+        shapes["node_status"] = _normalize(
+            scheme.to_dict(node.status), agent_name, pod_name)
+        lease = reg.get("leases", "kube-system", f"node-{agent_name}")
+        shapes["lease"] = _normalize(
+            scheme.to_dict(lease.spec), agent_name, pod_name)
+        # Graceful delete: two-phase — apiserver stamps the timestamp,
+        # the agent tears down and confirms with a grace-0 delete.
+        await client.delete("pods", "default", pod_name,
+                            grace_period_seconds=5)
+        for _ in range(200):
+            try:
+                reg.get("pods", "default", pod_name)
+            except Exception:  # noqa: BLE001 — NotFound = confirmed
+                break
+            await asyncio.sleep(0.05)
+        else:
+            raise AssertionError("agent never confirmed the delete")
+        shapes["delete_confirmed"] = True
+    finally:
+        await agent.stop()
+    return shapes
+
+
+def _split_conditions(node_status: dict):
+    conds = {c["type"]: c for c in node_status.pop("conditions", [])}
+    return conds, node_status
+
+
+async def test_hollow_agent_is_wire_identical_to_real():
+    reg = Registry()
+    reg.admission = default_chain(reg)
+    for ns in ("default", "kube-system"):
+        reg.create(t.Namespace(metadata=ObjectMeta(name=ns)))
+
+    real = await _lifecycle(reg, "real-0", "pr-0", slim=False)
+    hollow = await _lifecycle(reg, "hollow-0", "ph-0", slim=True,
+                              server_port=None, phase_jitter=0.0)
+
+    # Pod trajectory and bind ack: identical, no exceptions.
+    assert hollow["pod_running"] == real["pod_running"]
+    assert hollow["bind_ack"] == real["bind_ack"]
+    assert hollow["delete_confirmed"] and real["delete_confirmed"]
+
+    # Lease: identical shape (holder identity normalizes to NODE).
+    assert hollow["lease"] == real["lease"]
+
+    # Node status: strip the two DECLARED deltas, then field-by-field.
+    h_conds, h_rest = _split_conditions(hollow["node_status"])
+    r_conds, r_rest = _split_conditions(real["node_status"])
+    # Declared delta 1: no kubelet port on a hollow node.
+    assert r_rest.pop("daemon_endpoints", None) is not None
+    h_rest.pop("daemon_endpoints", None)
+    assert h_rest == r_rest
+    # Declared delta 2: problem-detector conditions exist only on the
+    # real agent; every condition type BOTH report must match exactly.
+    for typ in set(h_conds) & set(r_conds):
+        assert h_conds[typ] == r_conds[typ], typ
+    assert set(h_conds) <= set(r_conds)
+    ready = h_conds.get(t.NODE_READY)
+    assert ready is not None and ready["status"] == "True"

@@ -1,0 +1,90 @@
+"""Fleet determinism under TPU_SAN explored schedules (PR 20,
+satellite 4): a burst of hollow-node boots — N agents concurrently
+registering and posting their first heartbeat against one in-memory
+control plane — replays IDENTICALLY by seed (same schedule fingerprint,
+same store write order), while distinct seeds genuinely permute the
+boot interleaving. This is the property the width harness leans on:
+a 5k-node ramp that raced nondeterministically could never be
+debugged from a seed."""
+import asyncio
+
+from kubernetes_tpu.analysis import interleave
+from kubernetes_tpu.api import types as t
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.apiserver.admission import default_chain
+from kubernetes_tpu.apiserver.registry import Registry
+from kubernetes_tpu.client.local import LocalClient
+from kubernetes_tpu.node.agent import NodeAgent
+from kubernetes_tpu.node.runtime import FakeRuntime
+
+N_AGENTS = 6
+SCHEDULES = 8
+
+
+def _boot_burst():
+    """N hollow agents boot concurrently: register (node create +
+    first status post) then renew the heartbeat lease — the exact
+    write burst a fleet start throws at the apiserver, minus loops
+    and sockets (timer-free, so the schedule is the only freedom)."""
+    async def scenario():
+        reg = Registry()
+        reg.admission = default_chain(reg)
+        for ns in ("default", "kube-system"):
+            reg.create(t.Namespace(metadata=ObjectMeta(name=ns)))
+        client = LocalClient(reg)
+
+        async def boot(i):
+            agent = NodeAgent(client, f"hn-{i}", FakeRuntime(),
+                              slim=True, server_port=None,
+                              phase_jitter=30.0)
+            interleave.touch(f"node:{agent.node_name}")
+            await agent._register_node()
+            await agent._renew_heartbeat()
+            return agent._phase_offset(30.0)
+
+        offsets = await asyncio.gather(
+            *(boot(i) for i in range(N_AGENTS)))
+        # The observable trace: every store write, in commit order.
+        trace = tuple((ev.type, ev.key, ev.revision)
+                      for ev in reg.store._log)
+        return trace, tuple(offsets)
+    return scenario()
+
+
+def test_same_seed_replays_boot_burst_identically():
+    for seed in (0, 11, "fleet"):
+        (t1, o1), s1 = interleave.run(_boot_burst(), seed)
+        (t2, o2), s2 = interleave.run(_boot_burst(), seed)
+        assert s1.fingerprint() == s2.fingerprint()
+        assert t1 == t2
+        # Phase offsets are a pure function of node names — identical
+        # across runs AND across schedules by construction.
+        assert o1 == o2
+
+
+def test_distinct_seeds_permute_the_boot_order():
+    results = interleave.explore(lambda i: _boot_burst(),
+                                 base_seed="fleet-diversity",
+                                 schedules=SCHEDULES)
+    # The boot burst's decision space is small enough that two seeds
+    # can legitimately land on the same schedule — require genuine
+    # diversity, not a perfect bijection.
+    assert len({r.fingerprint for r in results}) >= SCHEDULES // 2 + 1
+    assert all(r.decisions > 0 for r in results)
+
+
+def test_schedules_change_write_order_not_final_state():
+    traces = set()
+    offsets = set()
+    for seed in range(6):
+        (trace, offs), _ = interleave.run(_boot_burst(), seed)
+        traces.add(trace)
+        offsets.add(offs)
+        # Whatever the interleaving, the END STATE is the same fleet:
+        # every agent registered exactly once, every lease renewed.
+        keys = {k for _, k, _ in trace}
+        for i in range(N_AGENTS):
+            assert f"/registry/nodes/hn-{i}" in keys
+            assert f"/registry/leases/kube-system/node-hn-{i}" in keys
+    assert len(traces) > 1, "seeds never permuted the boot burst"
+    assert len(offsets) == 1, "phase offsets must not depend on seed"

@@ -269,6 +269,30 @@ E = Counter("codec_wire_requests_total", "re-registered: silently inert")
     assert len(got) == 1 and "already registered" in got[0].message
 
 
+def test_metric_name_hollow_fleet_and_watch_fanout_families():
+    """The hollow-fleet width-harness families (hollow_fleet_*) and
+    the watch fan-out accounting families (apiserver_watch_*) are
+    valid names, and a duplicate registration within the family is
+    still caught."""
+    good = """
+from kubernetes_tpu.metrics.registry import Counter, Gauge, Histogram
+A = Gauge("hollow_fleet_nodes", "x", labels=("state",))
+B = Gauge("hollow_fleet_rss_bytes", "x")
+C = Gauge("hollow_fleet_open_fds", "x")
+D = Histogram("hollow_fleet_node_start_seconds", "x")
+E = Gauge("apiserver_watch_streams", "x", labels=("dispatch",))
+F = Counter("apiserver_watch_rounds_total", "x")
+G = Histogram("apiserver_watch_round_bytes", "x")
+H = Counter("apiserver_watch_events_sent_total", "x")
+"""
+    assert run_source(good, checks=["metric-name"]) == []
+    bad = good + """
+I = Gauge("hollow_fleet_nodes", "re-registered: silently inert")
+"""
+    got = run_source(bad, checks=["metric-name"])
+    assert len(got) == 1 and "already registered" in got[0].message
+
+
 def test_metric_name_retry_and_chaos_families():
     """The client retry/backoff and chaos-injection metric families
     (client_retry_total, client_backoff_seconds,

@@ -1,0 +1,175 @@
+"""Indexed watch dispatch contract tests (PR 20, hollow-fleet width).
+
+A watch opened with ``index=("pods.spec.node_name", "node-a")`` must
+see exactly the events a plain prefix watch filtered to that node
+would see — including selector TRANSITIONS (a bind moving a pod into
+the bucket, a reschedule moving it out) — while costing O(1) bucket
+dispatch on the write path instead of the O(watchers) prefix scan.
+"""
+import asyncio
+
+import pytest
+
+from kubernetes_tpu.storage import MVCCStore
+from kubernetes_tpu.storage.mvcc import ADDED, DELETED, MODIFIED
+
+
+def _node_name(value: dict):
+    return (value.get("spec") or {}).get("nodeName")
+
+
+def _store() -> MVCCStore:
+    s = MVCCStore()
+    s.register_watch_index("pods.spec.node_name", "/registry/pods/",
+                           _node_name)
+    return s
+
+
+def _drain(wch):
+    out = []
+    while True:
+        ev = wch.next_nowait()
+        if ev is None:
+            break
+        out.append(ev)
+    return out
+
+
+async def _settle():
+    # Deliveries are call_soon'd onto the loop; yield so they land.
+    for _ in range(3):
+        await asyncio.sleep(0)
+
+
+def test_register_rejects_prefix_conflict():
+    s = _store()
+    # Idempotent re-registration with the same prefix is allowed.
+    s.register_watch_index("pods.spec.node_name", "/registry/pods/",
+                           _node_name)
+    with pytest.raises(ValueError):
+        s.register_watch_index("pods.spec.node_name", "/registry/jobs/",
+                               _node_name)
+
+
+def test_watch_unknown_index_rejected():
+    async def run():
+        s = _store()
+        with pytest.raises(ValueError):
+            s.watch("/registry/pods/", index=("no.such.index", "x"))
+    asyncio.run(run())
+
+
+def test_bucket_receives_only_its_nodes_events():
+    async def run():
+        s = _store()
+        wa = s.watch("/registry/pods/",
+                     index=("pods.spec.node_name", "node-a"))
+        wb = s.watch("/registry/pods/",
+                     index=("pods.spec.node_name", "node-b"))
+        assert s.indexed_watcher_count == 2
+        s.create("/registry/pods/default/p1",
+                 {"spec": {"nodeName": "node-a"}})
+        s.create("/registry/pods/default/p2",
+                 {"spec": {"nodeName": "node-b"}})
+        s.create("/registry/pods/default/p3", {"spec": {}})  # unbound
+        await _settle()
+        assert [e.key for e in _drain(wa)] == \
+            ["/registry/pods/default/p1"]
+        assert [e.key for e in _drain(wb)] == \
+            ["/registry/pods/default/p2"]
+        wa.cancel()
+        wb.cancel()
+        assert s.indexed_watcher_count == 0
+    asyncio.run(run())
+
+
+def test_enter_and_leave_transitions_reach_both_buckets():
+    async def run():
+        s = _store()
+        wa = s.watch("/registry/pods/",
+                     index=("pods.spec.node_name", "node-a"))
+        wb = s.watch("/registry/pods/",
+                     index=("pods.spec.node_name", "node-b"))
+        # Unbound create: extracts to None, reaches no bucket.
+        rev = s.create("/registry/pods/default/p", {"spec": {}})
+        await _settle()
+        assert _drain(wa) == [] and _drain(wb) == []
+        # Bind (None -> node-a): ENTERS a's bucket.
+        rev = s.update("/registry/pods/default/p",
+                       {"spec": {"nodeName": "node-a"}},
+                       expected_revision=rev)
+        # Reschedule (node-a -> node-b): a sees it LEAVE (its selector
+        # filter turns that into DELETED), b sees it arrive.
+        rev = s.update("/registry/pods/default/p",
+                       {"spec": {"nodeName": "node-b"}},
+                       expected_revision=rev)
+        # Delete while on node-b: only b's bucket.
+        s.delete("/registry/pods/default/p")
+        await _settle()
+        a_types = [e.type for e in _drain(wa)]
+        b_types = [e.type for e in _drain(wb)]
+        assert a_types == [MODIFIED, MODIFIED]  # bind in, move out
+        assert b_types == [MODIFIED, DELETED]
+    asyncio.run(run())
+
+
+def test_txn_batch_dispatch_one_round_per_bucket():
+    async def run():
+        s = _store()
+        wa = s.watch("/registry/pods/",
+                     index=("pods.spec.node_name", "node-a"))
+        plain = s.watch("/registry/pods/")
+        s.txn([
+            (ADDED, "/registry/pods/default/b1",
+             {"spec": {"nodeName": "node-a"}}, None),
+            (ADDED, "/registry/pods/default/b2",
+             {"spec": {"nodeName": "node-z"}}, None),
+            (ADDED, "/registry/pods/default/b3",
+             {"spec": {"nodeName": "node-a"}}, None),
+        ])
+        await _settle()
+        assert [e.key for e in _drain(wa)] == \
+            ["/registry/pods/default/b1", "/registry/pods/default/b3"]
+        # The plain prefix watch coexists and still sees everything.
+        assert len(_drain(plain)) == 3
+        wa.cancel()
+        plain.cancel()
+    asyncio.run(run())
+
+
+def test_indexed_and_plain_counts_are_disjoint():
+    async def run():
+        s = _store()
+        plain = s.watch("/registry/pods/")
+        idx = s.watch("/registry/pods/",
+                      index=("pods.spec.node_name", "node-a"))
+        assert s.watcher_count == 2
+        assert s.indexed_watcher_count == 1
+        idx.cancel()
+        assert s.watcher_count == 1
+        assert s.indexed_watcher_count == 0
+        plain.cancel()
+        assert s.watcher_count == 0
+    asyncio.run(run())
+
+
+def test_indexed_watch_replay_filters_by_prefix():
+    async def run():
+        s = _store()
+        s.create("/registry/_sentinel", {})  # rev 1: replay anchor
+        rev0 = s.create("/registry/pods/default/old",
+                        {"spec": {"nodeName": "node-a"}})
+        s.create("/registry/pods/default/other",
+                 {"spec": {"nodeName": "node-b"}})
+        # Replay is prefix-only (the selector filter above drops the
+        # extras); live dispatch after attach is bucket-only.
+        w = s.watch("/registry/pods/", start_revision=rev0 - 1,
+                    index=("pods.spec.node_name", "node-a"))
+        s.create("/registry/pods/default/new",
+                 {"spec": {"nodeName": "node-b"}})
+        await _settle()
+        keys = [e.key for e in _drain(w)]
+        assert "/registry/pods/default/old" in keys
+        assert "/registry/pods/default/new" not in keys
+        w.cancel()
+    asyncio.run(run())
